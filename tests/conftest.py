@@ -1,0 +1,27 @@
+"""Test configuration: run on a virtual 8-device CPU mesh.
+
+Mirrors the reference's tier-3 strategy (SURVEY.md §4): Trino boots a
+multi-node cluster inside one JVM (DistributedQueryRunner); we boot a
+multi-device mesh inside one process via XLA's host-platform device
+partitioning. Real-TPU runs use bench.py, not the test suite.
+
+NOTE: this environment injects a sitecustomize that imports jax at
+interpreter startup with JAX_PLATFORMS=axon already in the env, so
+setting os.environ here is too late for jax's config default — we must
+force the platform through jax.config *after* import, before any
+backend is initialized.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

@@ -1,0 +1,63 @@
+"""Columnar core tests — analogue of trino-spi block/type unit tests."""
+
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.block import Column, Dictionary, RelBatch, bucket_capacity
+
+
+def test_bucket_capacity():
+    assert bucket_capacity(0) == 16
+    assert bucket_capacity(16) == 16
+    assert bucket_capacity(17) == 32
+    assert bucket_capacity(1000) == 1024
+
+
+def test_column_roundtrip_ints():
+    c = Column.from_pylist(T.BIGINT, [1, 2, None, 4])
+    assert c.capacity == 16
+    assert c.to_pylist(count=4) == [1, 2, None, 4]
+
+
+def test_column_roundtrip_strings():
+    c = Column.from_pylist(T.VARCHAR, ["b", "a", None, "b"])
+    assert c.to_pylist(count=4) == ["b", "a", None, "b"]
+    # sorted dictionary → code order == lexical order
+    assert c.dictionary.values == ("a", "b")
+
+
+def test_column_decimal():
+    t = T.decimal(12, 2)
+    c = Column.from_numpy(t, np.asarray([12345, -50], dtype=np.int64))
+    assert c.to_pylist(count=2) == [123.45, -0.5]
+
+
+def test_dictionary_unify():
+    a, b = Dictionary(["x", "y"]), Dictionary(["y", "z"])
+    m, ra, rb = Dictionary.unify(a, b)
+    assert m.values == ("x", "y", "z")
+    assert list(ra) == [0, 1] and list(rb) == [1, 2]
+
+
+def test_batch_mask_compact_roundtrip():
+    b = RelBatch.from_pydict(
+        [("k", T.BIGINT), ("v", T.DOUBLE)],
+        {"k": [1, 2, 3, 4, 5], "v": [1.0, 2.0, 3.0, 4.0, 5.0]},
+    )
+    assert b.row_count() == 5
+    import jax.numpy as jnp
+
+    keep = jnp.asarray([True, False, True, False, True] + [True] * 11)
+    f = b.mask(keep)
+    assert f.row_count() == 3
+    c = f.compact()
+    assert c.row_count() == 3
+    assert c.to_pylists() == [[1, 1.0], [3, 3.0], [5, 5.0]]
+
+
+def test_batch_gather():
+    import jax.numpy as jnp
+
+    b = RelBatch.from_pydict([("k", T.INTEGER)], {"k": [10, 20, 30]})
+    g = b.gather(jnp.asarray([2, 0]), jnp.asarray([True, True]))
+    assert g.to_pylists() == [[30], [10]]

@@ -1,0 +1,211 @@
+"""Kernel unit tests vs numpy oracles — analogue of Trino's operator
+unit tests (TestGroupByHash, TestHashJoinOperator etc., SURVEY.md §4.1)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from trino_tpu.ops import groupby, join, sort
+from trino_tpu.ops.hashing import hash32, hash64, partition_of
+
+
+def test_hash_deterministic_and_spread():
+    x = jnp.arange(1000, dtype=jnp.int64)
+    h1 = np.asarray(hash32([x], [jnp.ones(1000, bool)]))
+    h2 = np.asarray(hash32([x], [jnp.ones(1000, bool)]))
+    assert (h1 == h2).all()
+    # good spread into 8 partitions
+    parts = np.asarray(partition_of(jnp.asarray(h1), 8))
+    counts = np.bincount(parts, minlength=8)
+    assert counts.min() > 60  # roughly uniform
+
+    h64 = np.asarray(hash64([x], [jnp.ones(1000, bool)]))
+    assert len(np.unique(h64)) == 1000
+    assert (h64 >= 0).all()
+
+
+def _group_oracle(keys, mask):
+    seen = {}
+    gids = []
+    for i in range(len(mask)):
+        if not mask[i]:
+            gids.append(None)
+            continue
+        k = tuple(col[i] for col in keys)
+        gids.append(seen.setdefault(k, len(seen)))
+    return gids, len(seen)
+
+
+@pytest.mark.parametrize("n,card", [(64, 4), (512, 100), (256, 256)])
+def test_assign_group_ids_matches_oracle(n, card):
+    rng = np.random.default_rng(7)
+    k1 = rng.integers(0, card, n).astype(np.int64)
+    k2 = rng.integers(0, 3, n).astype(np.int32)
+    mask = rng.random(n) > 0.1
+    C = 1024
+    gid, table, overflow = groupby.assign_group_ids(
+        [jnp.asarray(k1), jnp.asarray(k2)],
+        [jnp.ones(n, bool), jnp.ones(n, bool)],
+        jnp.asarray(mask),
+        C,
+    )
+    assert not bool(overflow)
+    gid = np.asarray(gid)
+    oracle_gids, n_groups = _group_oracle([k1, k2], mask)
+    assert int(table.num_groups()) == n_groups
+    # same key -> same gid; different keys -> different gid
+    remap = {}
+    for i in range(n):
+        if not mask[i]:
+            assert gid[i] == C
+            continue
+        og = oracle_gids[i]
+        if og in remap:
+            assert gid[i] == remap[og], f"row {i}"
+        else:
+            assert gid[i] not in remap.values()
+            remap[og] = gid[i]
+    # table stores the right keys at each slot
+    sk1 = np.asarray(table.slot_keys[0])
+    for i in range(n):
+        if mask[i]:
+            assert sk1[gid[i]] == k1[i]
+
+
+def test_group_ids_null_is_its_own_group():
+    k = jnp.asarray([1, 1, 1, 5], dtype=jnp.int64)
+    v = jnp.asarray([True, False, False, True])
+    gid, table, _ = groupby.assign_group_ids(
+        [k], [v], jnp.ones(4, bool), 16
+    )
+    gid = np.asarray(gid)
+    assert gid[1] == gid[2]  # NULL == NULL for grouping
+    assert gid[0] != gid[1] and gid[0] != gid[3]
+    assert int(table.num_groups()) == 3
+
+
+def test_group_overflow_flag():
+    n = 64
+    k = jnp.arange(n, dtype=jnp.int64)
+    gid, table, overflow = groupby.assign_group_ids(
+        [k], [jnp.ones(n, bool)], jnp.ones(n, bool), 32
+    )
+    assert bool(overflow)
+
+
+def test_segment_aggregates():
+    gid = jnp.asarray([0, 1, 0, 2, 16, 1], dtype=jnp.int32)  # 16 = dead
+    vals = jnp.asarray([1.0, 2.0, 3.0, 4.0, 100.0, 6.0])
+    w = jnp.asarray([True, True, True, True, False, True])
+    s = np.asarray(groupby.seg_sum(gid, vals, w, 16))
+    assert s[0] == 4.0 and s[1] == 8.0 and s[2] == 4.0
+    c = np.asarray(groupby.seg_count(gid, w, 16))
+    assert c[0] == 2 and c[1] == 2 and c[2] == 1
+    mn = np.asarray(groupby.seg_min(gid, vals, w, 16))
+    mx = np.asarray(groupby.seg_max(gid, vals, w, 16))
+    assert mn[0] == 1.0 and mx[1] == 6.0
+
+
+def _join_oracle(bkeys, blive, pkeys, plive):
+    out = set()
+    for i, (pk, pl) in enumerate(zip(pkeys, plive)):
+        if not pl:
+            continue
+        for j, (bk, bl) in enumerate(zip(bkeys, blive)):
+            if bl and bk == pk:
+                out.add((i, j))
+    return out
+
+
+@pytest.mark.parametrize("nb,np_,card", [(32, 32, 8), (128, 256, 20), (64, 64, 1000)])
+def test_join_probe_matches_oracle(nb, np_, card):
+    rng = np.random.default_rng(3)
+    bk = rng.integers(0, card, nb).astype(np.int64)
+    pk = rng.integers(0, card, np_).astype(np.int64)
+    blive = rng.random(nb) > 0.2
+    plive = rng.random(np_) > 0.2
+    ls = join.build_lookup(
+        [jnp.asarray(bk)], [jnp.ones(nb, bool)], jnp.asarray(blive)
+    )
+    lo, counts, total = join.probe_counts(
+        ls, [jnp.asarray(pk)], [jnp.ones(np_, bool)], jnp.asarray(plive)
+    )
+    cap = max(16, 1 << int(np.ceil(np.log2(max(1, int(total))))))
+    pi, bi, ok = join.expand_matches(
+        ls, [jnp.asarray(pk)], [jnp.ones(np_, bool)], lo, counts, cap
+    )
+    got = {
+        (int(p), int(b))
+        for p, b, o in zip(np.asarray(pi), np.asarray(bi), np.asarray(ok))
+        if o
+    }
+    assert got == _join_oracle(bk, blive, pk, plive)
+
+
+def test_join_null_keys_never_match():
+    bk = jnp.asarray([1, 2], dtype=jnp.int64)
+    bv = jnp.asarray([True, False])
+    pk = jnp.asarray([1, 2], dtype=jnp.int64)
+    pv = jnp.asarray([False, True])
+    ls = join.build_lookup([bk], [bv], jnp.ones(2, bool))
+    lo, counts, total = join.probe_counts(ls, [pk], [pv], jnp.ones(2, bool))
+    assert int(total) == 0
+
+
+def test_semi_and_outer_flags():
+    bk = jnp.asarray([1, 1, 3], dtype=jnp.int64)
+    pk = jnp.asarray([1, 2, 3, 4], dtype=jnp.int64)
+    ls = join.build_lookup([bk], [jnp.ones(3, bool)], jnp.ones(3, bool))
+    lo, counts, total = join.probe_counts(
+        ls, [pk], [jnp.ones(4, bool)], jnp.ones(4, bool)
+    )
+    pi, bi, ok = join.expand_matches(ls, [pk], [jnp.ones(4, bool)], lo, counts, 16)
+    pm = np.asarray(join.probe_matched_flags(4, pi, ok))
+    assert list(pm) == [True, False, True, False]
+    bm = np.asarray(join.build_matched_flags(3, bi, ok))
+    assert list(bm) == [True, True, True]
+
+
+def test_sort_multi_key_with_nulls_and_desc():
+    a = jnp.asarray([3, 1, 2, 1, 2], dtype=jnp.int64)
+    av = jnp.asarray([True, True, False, True, True])
+    b = jnp.asarray([1.0, 9.0, 5.0, 7.0, 2.0])
+    live = jnp.asarray([True, True, True, True, True])
+    order = sort.sort_order(
+        [a, b], [av, None], [False, True], [False, False], live
+    )
+    # a asc nulls last, then b desc: rows (1,b9),(3,b7),(4,b2),(0,b1),(2=null)
+    assert list(np.asarray(order)) == [1, 3, 4, 0, 2]
+
+
+def test_sort_dead_rows_last():
+    a = jnp.asarray([5, 4, 3, 2], dtype=jnp.int64)
+    live = jnp.asarray([True, False, True, True])
+    order = sort.sort_order([a], [None], [False], [False], live)
+    assert list(np.asarray(order)) == [3, 2, 0, 1]
+
+
+def test_sort_nan_is_largest_both_directions():
+    x = jnp.asarray([1.0, float("nan"), 2.0])
+    live = jnp.ones(3, bool)
+    asc = sort.sort_order([x], [None], [False], [False], live)
+    assert list(np.asarray(asc)) == [0, 2, 1]
+    desc = sort.sort_order([x], [None], [True], [False], live)
+    assert list(np.asarray(desc)) == [1, 2, 0]
+
+
+def test_temporal_coercion():
+    from trino_tpu import types as T
+
+    assert T.common_super_type(T.DATE, T.TIMESTAMP) == T.TIMESTAMP
+    assert T.common_super_type(T.DATE, T.INTERVAL_DAY) is None
+    assert T.common_super_type(T.DATE, T.BIGINT) is None
+    assert T.arithmetic_result_type("+", T.DATE, T.INTERVAL_DAY) == T.DATE
+
+
+def test_decimal_supertype_overflow_raises():
+    from trino_tpu import types as T
+
+    with pytest.raises(TypeError):
+        T.common_super_type(T.decimal(18, 0), T.decimal(18, 18))
+    assert T.common_super_type(T.decimal(12, 2), T.decimal(10, 4)) == T.decimal(14, 4)

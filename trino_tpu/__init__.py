@@ -1,0 +1,31 @@
+"""trino_tpu — a TPU-native distributed SQL query engine.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of Trino
+(reference: linzebing/trino, surveyed in SURVEY.md): a coordinator
+parses/plans/schedules SQL; workers execute columnar operator pipelines
+compiled to XLA, sharded over a `jax.sharding.Mesh`.
+
+Layer map (mirrors SURVEY.md §1, re-imagined TPU-first):
+
+- ``trino_tpu.types`` / ``trino_tpu.block``  — columnar data model: the
+  analogue of trino-spi's Page/Block/Type (spi/Page.java:31,
+  spi/block/Block.java:25) as device-resident structure-of-arrays with
+  validity masks and dictionary-encoded strings.
+- ``trino_tpu.ops``      — XLA/Pallas kernels: group-by hash, join
+  build/probe, sort/topN — the analogue of Trino's JIT bytecode layer
+  (main/sql/gen/, SURVEY §2.9).
+- ``trino_tpu.expr``     — typed expression IR + trace-to-XLA compiler
+  (RowExpression / PageProcessor analogue).
+- ``trino_tpu.sql``      — lexer/parser/analyzer (trino-parser analogue).
+- ``trino_tpu.planner``  — logical plan, optimizer rules, fragmenter.
+- ``trino_tpu.exec``     — operators, driver loop, task runtime,
+  schedulers (pipelined + fault-tolerant).
+- ``trino_tpu.parallel`` — mesh, sharded exchanges (all_to_all over ICI),
+  serialized-page host exchange.
+- ``trino_tpu.connectors`` — connector SPI + tpch/memory/blackhole.
+- ``trino_tpu.runtime``  — session, config, memory pools, stats, tracing.
+"""
+
+from trino_tpu import jaxcfg as _jaxcfg  # noqa: F401  (side effect: x64)
+
+__version__ = "0.1.0"

@@ -1,0 +1,308 @@
+"""Columnar data model: device-resident structure-of-arrays batches.
+
+Analogue of trino-spi's Page/Block layer (spi/Page.java:31 — a Page is
+positionCount x Block[]; spi/block/Block.java:25; DictionaryBlock /
+RunLengthEncodedBlock / VariableWidthBlock — SURVEY.md §2.5), re-designed
+for XLA's static-shape model:
+
+- A ``Column`` is one fixed-capacity device array plus an optional
+  validity mask (NULLs) and an optional host-side string dictionary
+  (VARCHAR values live on device as int32 codes — the DictionaryBlock
+  idea made mandatory, which is the standard TPU answer to varlen data).
+- A ``RelBatch`` is N columns sharing a capacity plus a ``live`` row mask.
+  Where Trino pages have a dynamic positionCount, we keep static
+  capacity (bucketed powers of two) and mask dead rows — filters only
+  flip mask bits, and compaction is an explicit (cheap, vectorized)
+  operation. This keeps every operator a fixed-shape XLA program.
+
+Both are registered as pytrees so jitted kernels take them directly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trino_tpu import types as T
+
+MIN_CAPACITY = 16
+
+
+def bucket_capacity(n: int) -> int:
+    """Static-shape discipline: round row counts up to a power of two so
+    the set of compiled kernel shapes stays small (the analogue of
+    Trino's adaptive page sizes without dynamic shapes)."""
+    c = MIN_CAPACITY
+    while c < n:
+        c <<= 1
+    return c
+
+
+class Dictionary:
+    """Host-side sorted string dictionary. Device arrays hold int32 codes.
+
+    Values are sorted, so *within one dictionary* code order == lexical
+    order, making <, >=, BETWEEN on strings pure int comparisons on
+    device. Cross-dictionary operations go through ``unify``.
+    """
+
+    __slots__ = ("values", "_index")
+
+    def __init__(self, values: Sequence[str]):
+        vals = sorted(set(values))
+        self.values: tuple = tuple(vals)
+        self._index = {v: i for i, v in enumerate(vals)}
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def __hash__(self):
+        return hash(self.values)
+
+    def __eq__(self, other):
+        return isinstance(other, Dictionary) and self.values == other.values
+
+    def code(self, value: str) -> int:
+        """Code for value; -1 if absent (compares unequal to everything)."""
+        return self._index.get(value, -1)
+
+    def code_lower_bound(self, value: str) -> int:
+        """Smallest code whose value >= `value` (for range predicates)."""
+        import bisect
+
+        return bisect.bisect_left(self.values, value)
+
+    def encode(self, values: Sequence[str]) -> np.ndarray:
+        return np.asarray([self._index[v] for v in values], dtype=np.int32)
+
+    def decode(self, codes: np.ndarray) -> list:
+        return [self.values[c] if c >= 0 else None for c in codes]
+
+    @staticmethod
+    def unify(a: "Dictionary", b: "Dictionary"):
+        """Merged dictionary plus remap arrays old-code -> new-code."""
+        merged = Dictionary(a.values + b.values)
+        remap_a = np.asarray([merged._index[v] for v in a.values], dtype=np.int32)
+        remap_b = np.asarray([merged._index[v] for v in b.values], dtype=np.int32)
+        return merged, remap_a, remap_b
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class Column:
+    """One column: fixed-capacity device array + validity + dictionary."""
+
+    type: T.DataType
+    data: jnp.ndarray  # shape (capacity,), dtype = type.dtype
+    valid: Optional[jnp.ndarray] = None  # bool (capacity,), None = all valid
+    dictionary: Optional[Dictionary] = None
+
+    # -- pytree --
+    def tree_flatten(self):
+        return (self.data, self.valid), (self.type, self.dictionary)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        data, valid = children
+        return cls(aux[0], data, valid, aux[1])
+
+    @property
+    def capacity(self) -> int:
+        return int(self.data.shape[0])
+
+    def valid_mask(self) -> jnp.ndarray:
+        if self.valid is None:
+            return jnp.ones(self.data.shape[0], dtype=jnp.bool_)
+        return self.valid
+
+    def with_data(self, data, valid="__same__") -> "Column":
+        return Column(
+            self.type,
+            data,
+            self.valid if isinstance(valid, str) else valid,
+            self.dictionary,
+        )
+
+    def gather(self, positions: jnp.ndarray, positions_valid=None) -> "Column":
+        """Vectorized position copy — the PositionsAppender analogue
+        (main/operator/output/PositionsAppender*.java)."""
+        pos = jnp.clip(positions, 0, self.data.shape[0] - 1)
+        data = jnp.take(self.data, pos)
+        valid = None
+        if self.valid is not None:
+            valid = jnp.take(self.valid, pos)
+        if positions_valid is not None:
+            valid = positions_valid if valid is None else (valid & positions_valid)
+        return Column(self.type, data, valid, self.dictionary)
+
+    # -- host conversion (tests / client protocol) --
+    @staticmethod
+    def from_numpy(
+        type_: T.DataType,
+        values: np.ndarray,
+        valid: Optional[np.ndarray] = None,
+        dictionary: Optional[Dictionary] = None,
+        capacity: Optional[int] = None,
+    ) -> "Column":
+        n = len(values)
+        cap = capacity if capacity is not None else bucket_capacity(n)
+        data = np.zeros(cap, dtype=type_.dtype)
+        data[:n] = values
+        v = None
+        if valid is not None:
+            v = np.zeros(cap, dtype=bool)
+            v[:n] = valid
+        return Column(type_, jnp.asarray(data), None if v is None else jnp.asarray(v), dictionary)
+
+    @staticmethod
+    def from_pylist(type_: T.DataType, values: Sequence[Any], capacity=None) -> "Column":
+        has_null = any(v is None for v in values)
+        if type_.is_string:
+            dictionary = Dictionary([v for v in values if v is not None])
+            arr = np.asarray(
+                [dictionary.code(v) if v is not None else 0 for v in values],
+                dtype=np.int32,
+            )
+        elif type_.is_decimal:
+            dictionary = None
+            sf = T.decimal_scale_factor(type_)
+            arr = np.asarray(
+                [round(v * sf) if v is not None else 0 for v in values],
+                dtype=type_.dtype,
+            )
+        else:
+            dictionary = None
+            fill = 0
+            arr = np.asarray(
+                [v if v is not None else fill for v in values], dtype=type_.dtype
+            )
+        valid = None
+        if has_null:
+            valid = np.asarray([v is not None for v in values], dtype=bool)
+        return Column.from_numpy(type_, arr, valid, dictionary, capacity)
+
+    def to_pylist(self, count: Optional[int] = None, live: Optional[np.ndarray] = None):
+        data = np.asarray(self.data)
+        valid = np.asarray(self.valid) if self.valid is not None else np.ones(len(data), bool)
+        if live is not None:
+            keep = np.asarray(live)
+            data, valid = data[keep], valid[keep]
+        if count is not None:
+            data, valid = data[:count], valid[:count]
+        out = []
+        for x, ok in zip(data, valid):
+            if not ok:
+                out.append(None)
+            elif self.type.is_string:
+                out.append(self.dictionary.values[int(x)] if self.dictionary else str(int(x)))
+            elif self.type.is_decimal:
+                out.append(int(x) / T.decimal_scale_factor(self.type))
+            elif self.type.kind == T.TypeKind.BOOLEAN:
+                out.append(bool(x))
+            elif self.type.is_floating:
+                out.append(float(x))
+            else:
+                out.append(int(x))
+        return out
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class RelBatch:
+    """A batch of rows: columns share capacity; `live` masks real rows.
+
+    The Page analogue. ``live=None`` means all `capacity` rows are live
+    (the common full-batch fast path, like a Page with no mask).
+    """
+
+    columns: list  # list[Column]
+    live: Optional[jnp.ndarray] = None  # bool (capacity,)
+
+    def tree_flatten(self):
+        return (self.columns, self.live), (len(self.columns),)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(list(children[0]), children[1])
+
+    @property
+    def capacity(self) -> int:
+        return self.columns[0].capacity if self.columns else 0
+
+    @property
+    def width(self) -> int:
+        return len(self.columns)
+
+    def live_mask(self) -> jnp.ndarray:
+        if self.live is None:
+            return jnp.ones(self.capacity, dtype=jnp.bool_)
+        return self.live
+
+    def row_count(self) -> int:
+        """Host-synced live-row count (test/protocol use; kernels use masks)."""
+        if self.live is None:
+            return self.capacity
+        return int(jnp.sum(self.live))
+
+    def column(self, i: int) -> Column:
+        return self.columns[i]
+
+    def with_columns(self, columns, live="__same__") -> "RelBatch":
+        return RelBatch(list(columns), self.live if isinstance(live, str) else live)
+
+    def mask(self, keep: jnp.ndarray) -> "RelBatch":
+        """Filter: AND `keep` into the live mask (no data movement)."""
+        live = keep if self.live is None else (self.live & keep)
+        return RelBatch(self.columns, live)
+
+    def gather(self, positions: jnp.ndarray, positions_live=None) -> "RelBatch":
+        cols = [c.gather(positions) for c in self.columns]
+        return RelBatch(cols, positions_live)
+
+    def compact(self) -> "RelBatch":
+        """Front-pack live rows (stable) — Page.compact analogue
+        (spi/Page.java:180). Output capacity unchanged; dead tail rows
+        get live=False. Pure vectorized: stable argsort on ~live."""
+        if self.live is None:
+            return self
+        order = jnp.argsort(~self.live, stable=True)
+        n_live = jnp.sum(self.live)
+        idx = jnp.arange(self.capacity)
+        new_live = idx < n_live
+        cols = [c.gather(order) for c in self.columns]
+        return RelBatch(cols, new_live)
+
+    def select(self, indices: Sequence[int]) -> "RelBatch":
+        return RelBatch([self.columns[i] for i in indices], self.live)
+
+    # -- host conversion --
+    @staticmethod
+    def from_pydict(schema, data: dict, capacity=None) -> "RelBatch":
+        """schema: list[(name, DataType)] — names are positional only."""
+        n = None
+        cols = []
+        for name, typ in schema:
+            vals = data[name]
+            n = len(vals) if n is None else n
+            assert len(vals) == n
+        cap = capacity if capacity is not None else bucket_capacity(n or 0)
+        for name, typ in schema:
+            cols.append(Column.from_pylist(typ, data[name], capacity=cap))
+        live = None
+        if (n or 0) != cap:
+            lv = np.zeros(cap, dtype=bool)
+            lv[: n or 0] = True
+            live = jnp.asarray(lv)
+        return RelBatch(cols, live)
+
+    def to_pylists(self):
+        """Rows as list of python lists, live rows only, in order."""
+        live = None
+        if self.live is not None:
+            live = np.asarray(self.live)
+        cols = [c.to_pylist(live=live) for c in self.columns]
+        return [list(row) for row in zip(*cols)] if cols else []

@@ -1,0 +1,13 @@
+"""Central JAX configuration for trino_tpu.
+
+Imported for side effect before any jax.numpy use. We enable x64 because
+SQL semantics need BIGINT (int64) and DECIMAL-as-scaled-int64 exactness
+(Trino models decimals as Int128/long — spi/type/DecimalType; we use
+int64 which covers TPC-H's decimal(12,2) aggregates). Hot kernels
+(hashing, probing) deliberately downcast to int32/uint32 lanes so the
+TPU VPU runs native-width ops.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)

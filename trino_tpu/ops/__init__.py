@@ -1,0 +1,7 @@
+"""XLA/Pallas kernels — the engine's "generated code" layer.
+
+Where Trino JIT-compiles JVM bytecode per query (main/sql/gen/,
+SURVEY.md §2.9: ExpressionCompiler, JoinCompiler, AccumulatorCompiler),
+this package holds jax-traceable kernels that `jax.jit` specializes per
+shape/dtype at first call — same role, compiler-native mechanism.
+"""

@@ -1,0 +1,171 @@
+"""Group-by hash kernels.
+
+Analogue of Trino's GroupByHash (main/operator/GroupByHash.java:30;
+MultiChannelGroupByHash.putIfAbsent:264 open-addressing linear probe) —
+re-designed as a *vectorized, fixed-capacity* linear-probe table:
+
+- Capacity is a power of two chosen by the host (bucketed), replacing
+  tryRehash (MultiChannelGroupByHash.java:350) with
+  rebuild-at-larger-capacity on overflow — static shapes for XLA.
+- Insertion is data-parallel over all rows at once: each round, every
+  unresolved row inspects its probe slot; empty slots are claimed by a
+  min-row-id scatter race (one winner per slot per round, losers retry),
+  occupied slots compare keys. Rounds loop via lax.while_loop. This is
+  the standard way to express a concurrent hash-table insert as a
+  sequence of dense vector ops — the whole batch makes progress each
+  round instead of Trino's per-row scalar loop.
+- SQL GROUP BY semantics: NULL is its own group, so validity bits are
+  part of the key.
+
+Aggregation itself is masked segment scatter-add/min/max into (C,)
+accumulators — XLA turns these into efficient sorted-scatter updates.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from trino_tpu.ops.hashing import hash32
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class GroupTable:
+    """Fixed-capacity group table: slot i holds the keys of group id i."""
+
+    slot_keys: List[jnp.ndarray]  # each (C,)
+    slot_valids: List[jnp.ndarray]  # each (C,) bool
+    slot_used: jnp.ndarray  # (C,) bool
+
+    def tree_flatten(self):
+        return (self.slot_keys, self.slot_valids, self.slot_used), (len(self.slot_keys),)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(list(children[0]), list(children[1]), children[2])
+
+    @property
+    def capacity(self) -> int:
+        return int(self.slot_used.shape[0])
+
+    def num_groups(self) -> jnp.ndarray:
+        return jnp.sum(self.slot_used)
+
+
+def _keys_equal(a_keys, a_valids, b_keys, b_valids):
+    """GROUP-BY equality: NULL == NULL (IS NOT DISTINCT FROM)."""
+    eq = None
+    for ak, av, bk, bv in zip(a_keys, a_valids, b_keys, b_valids):
+        e = ((ak == bk) & av & bv) | (~av & ~bv)
+        eq = e if eq is None else (eq & e)
+    return eq
+
+
+@partial(jax.jit, static_argnames=("capacity",), donate_argnums=())
+def assign_group_ids(
+    keys: Sequence[jnp.ndarray],
+    valids: Sequence[jnp.ndarray],
+    mask: jnp.ndarray,
+    capacity: int,
+):
+    """Map each live row to a group id in [0, capacity).
+
+    Returns (group_ids, table, overflowed). Dead rows get id = capacity
+    (callers scatter with mode='drop'). `overflowed` is True if the
+    table filled up — host rebuilds at 2x capacity (rehash analogue).
+    """
+    assert capacity & (capacity - 1) == 0
+    n = keys[0].shape[0]
+    C = capacity
+    keys = [k for k in keys]
+    valids = [v for v in valids]
+
+    h = (hash32(keys, valids) & jnp.uint32(C - 1)).astype(jnp.int32)
+
+    slot_keys = [jnp.zeros(C, dtype=k.dtype) for k in keys]
+    slot_valids = [jnp.zeros(C, dtype=jnp.bool_) for _ in keys]
+    slot_used = jnp.zeros(C, dtype=jnp.bool_)
+    gid = jnp.where(mask, -1, C).astype(jnp.int32)
+    probe = jnp.zeros(n, dtype=jnp.int32)
+    row_id = jnp.arange(n, dtype=jnp.int32)
+
+    def cond(state):
+        gid, probe, slot_keys, slot_valids, slot_used, it = state
+        return jnp.any(gid < 0) & (it < C + 2)
+
+    def body(state):
+        gid, probe, slot_keys, slot_valids, slot_used, it = state
+        active = gid < 0
+        pos = (h + probe) & (C - 1)
+        occ = jnp.take(slot_used, pos)
+        slot_k = [jnp.take(sk, pos) for sk in slot_keys]
+        slot_v = [jnp.take(sv, pos) for sv in slot_valids]
+        match = occ & _keys_equal(slot_k, slot_v, keys, valids)
+        gid = jnp.where(active & match, pos, gid)
+        # claim race for empty slots: min row id wins the slot this round
+        want = active & ~occ & ~match
+        claim = jnp.full(C, n, dtype=jnp.int32)
+        claim = claim.at[jnp.where(want, pos, C)].min(row_id, mode="drop")
+        winner = want & (jnp.take(claim, pos) == row_id)
+        wpos = jnp.where(winner, pos, C)
+        for i in range(len(keys)):
+            slot_keys[i] = slot_keys[i].at[wpos].set(keys[i], mode="drop")
+            slot_valids[i] = slot_valids[i].at[wpos].set(valids[i], mode="drop")
+        slot_used = slot_used.at[wpos].set(True, mode="drop")
+        gid = jnp.where(winner, pos, gid)
+        # occupied-with-different-key rows advance; claim losers retry same slot
+        advance = active & occ & ~match
+        probe = jnp.where(advance, probe + 1, probe)
+        return gid, probe, slot_keys, slot_valids, slot_used, it + 1
+
+    gid, probe, slot_keys, slot_valids, slot_used, it = jax.lax.while_loop(
+        cond, body, (gid, probe, slot_keys, slot_valids, slot_used, jnp.int32(0))
+    )
+    overflowed = jnp.any(gid < 0)
+    gid = jnp.where(gid < 0, C, gid)
+    return gid, GroupTable(slot_keys, slot_valids, slot_used), overflowed
+
+
+# ---------------------------------------------------------------------------
+# Masked segment accumulators — the Accumulator/GroupedAccumulator analogue
+# (main/operator/aggregation/GroupedAccumulator.java:21). Each returns the
+# new accumulator state array(s) of shape (C,).
+# ---------------------------------------------------------------------------
+
+
+def seg_sum(gid, values, weight_mask, capacity, dtype=None):
+    dtype = dtype or values.dtype
+    z = jnp.zeros(capacity + 1, dtype=dtype)
+    contrib = jnp.where(weight_mask, values.astype(dtype), jnp.zeros((), dtype))
+    return z.at[gid].add(contrib)[:capacity]
+
+
+def seg_count(gid, weight_mask, capacity):
+    z = jnp.zeros(capacity + 1, dtype=jnp.int64)
+    return z.at[gid].add(weight_mask.astype(jnp.int64))[:capacity]
+
+
+def seg_min(gid, values, weight_mask, capacity):
+    info = jnp.iinfo(values.dtype) if jnp.issubdtype(values.dtype, jnp.integer) else None
+    big = info.max if info else jnp.inf
+    z = jnp.full(capacity + 1, big, dtype=values.dtype)
+    contrib = jnp.where(weight_mask, values, jnp.asarray(big, dtype=values.dtype))
+    return z.at[gid].min(contrib)[:capacity]
+
+
+def seg_max(gid, values, weight_mask, capacity):
+    info = jnp.iinfo(values.dtype) if jnp.issubdtype(values.dtype, jnp.integer) else None
+    small = info.min if info else -jnp.inf
+    z = jnp.full(capacity + 1, small, dtype=values.dtype)
+    contrib = jnp.where(weight_mask, values, jnp.asarray(small, dtype=values.dtype))
+    return z.at[gid].max(contrib)[:capacity]
+
+
+def seg_any(gid, flags, weight_mask, capacity):
+    z = jnp.zeros(capacity + 1, dtype=jnp.bool_)
+    return z.at[gid].max(flags & weight_mask)[:capacity]

@@ -1,0 +1,155 @@
+"""Hash-join build/probe kernels.
+
+Analogue of Trino's PagesIndex + PagesHash + JoinProbe family
+(main/operator/PagesIndex.java:80, join/DefaultPagesHash.java:44,
+join/LookupJoinOperator.java:36) — re-designed around sorting, which is
+what TPUs do well, instead of pointer-chasing:
+
+- Build ("LookupSource"): hash the build keys to 64 bits, sort build
+  rows by hash. The sorted-hash array + permutation IS the lookup
+  structure — duplicates are adjacent runs, playing the role of Trino's
+  PositionLinks chains without linked lists.
+- Probe: vectorized binary search (searchsorted) gives each probe row
+  its candidate run [lo, hi); run lengths handle duplicate build keys.
+- Fan-out (dynamic output size): two-phase — count matches, host picks
+  a bucketed output capacity, then a dense expansion pass materializes
+  (probe_row, build_row) pairs. Hash collisions are culled by an exact
+  key-equality verify on the expanded pairs.
+- Outer/semi/anti variants derive from the same expansion plus
+  scatter-or'd matched flags (probe side) and a build-side matched
+  bitmap (the LookupOuterOperator analogue for RIGHT/FULL joins).
+
+SQL join-key semantics: NULL never matches NULL.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from trino_tpu.ops.hashing import hash64
+
+_NO_MATCH_HASH = jnp.int64(-1)  # probes that must find nothing
+_DEAD_BUILD_HASH = jnp.iinfo(jnp.int64).max  # dead build rows sort last
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class LookupSource:
+    """Device-resident build side: sorted hashes + row permutation."""
+
+    sorted_hash: jnp.ndarray  # (B,) int64, dead rows = MAX
+    perm: jnp.ndarray  # (B,) int32 — build row index at each sorted pos
+    key_cols: List[jnp.ndarray]  # original (unsorted) build key columns
+    key_valids: List[jnp.ndarray]
+    build_live: jnp.ndarray  # (B,) bool
+
+    def tree_flatten(self):
+        return (
+            (self.sorted_hash, self.perm, self.key_cols, self.key_valids, self.build_live),
+            (),
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        sh, perm, kc, kv, bl = children
+        return cls(sh, perm, list(kc), list(kv), bl)
+
+    @property
+    def build_capacity(self) -> int:
+        return int(self.perm.shape[0])
+
+
+@jax.jit
+def build_lookup(
+    keys: Sequence[jnp.ndarray],
+    valids: Sequence[jnp.ndarray],
+    live: jnp.ndarray,
+) -> LookupSource:
+    """Build phase — HashBuilderOperator analogue, one sort instead of
+    row-at-a-time inserts (join/HashBuilderOperator.java:58)."""
+    any_null = None
+    for v in valids:
+        any_null = ~v if any_null is None else (any_null | ~v)
+    usable = live if any_null is None else (live & ~any_null)
+    h = hash64(list(keys), list(valids))
+    h = jnp.where(usable, h, _DEAD_BUILD_HASH)
+    perm = jnp.argsort(h).astype(jnp.int32)
+    return LookupSource(jnp.take(h, perm), perm, list(keys), list(valids), usable)
+
+
+@jax.jit
+def probe_counts(
+    ls: LookupSource,
+    probe_keys: Sequence[jnp.ndarray],
+    probe_valids: Sequence[jnp.ndarray],
+    probe_live: jnp.ndarray,
+):
+    """Phase 1: per-probe-row candidate run [lo, hi). Returns
+    (lo, counts, total) — `total` is a device scalar the host reads to
+    size the output batch."""
+    any_null = None
+    for v in probe_valids:
+        any_null = ~v if any_null is None else (any_null | ~v)
+    usable = probe_live if any_null is None else (probe_live & ~any_null)
+    ph = hash64(list(probe_keys), list(probe_valids))
+    ph = jnp.where(usable, ph, _NO_MATCH_HASH)
+    lo = jnp.searchsorted(ls.sorted_hash, ph, side="left").astype(jnp.int32)
+    hi = jnp.searchsorted(ls.sorted_hash, ph, side="right").astype(jnp.int32)
+    counts = hi - lo
+    return lo, counts, jnp.sum(counts)
+
+
+@partial(jax.jit, static_argnames=("out_capacity",))
+def expand_matches(
+    ls: LookupSource,
+    probe_keys: Sequence[jnp.ndarray],
+    probe_valids: Sequence[jnp.ndarray],
+    lo: jnp.ndarray,
+    counts: jnp.ndarray,
+    out_capacity: int,
+):
+    """Phase 2: materialize candidate pairs, verify exact key equality.
+
+    Returns (probe_idx, build_idx, pair_live) each (out_capacity,).
+    """
+    off = jnp.cumsum(counts)  # inclusive
+    total = off[-1] if counts.shape[0] else jnp.int32(0)
+    j = jnp.arange(out_capacity, dtype=jnp.int32)
+    # which probe row produced output j
+    pi = jnp.searchsorted(off, j, side="right").astype(jnp.int32)
+    pi_c = jnp.clip(pi, 0, counts.shape[0] - 1)
+    start = jnp.take(off, pi_c) - jnp.take(counts, pi_c)
+    spos = jnp.take(lo, pi_c) + (j - start)
+    spos = jnp.clip(spos, 0, ls.perm.shape[0] - 1)
+    bi = jnp.take(ls.perm, spos)
+    in_range = j < total
+    # exact verify (hash collisions): join equality — NULLs never match
+    ok = in_range
+    for pk, pv, bk, bv in zip(probe_keys, probe_valids, ls.key_cols, ls.key_valids):
+        a = jnp.take(pk, pi_c)
+        av = jnp.take(pv, pi_c)
+        b = jnp.take(bk, jnp.clip(bi, 0, bk.shape[0] - 1))
+        bvv = jnp.take(bv, jnp.clip(bi, 0, bv.shape[0] - 1))
+        ok = ok & (a == b) & av & bvv
+    return pi_c, bi, ok
+
+
+def probe_matched_flags(probe_capacity, pi, pair_live):
+    """Per-probe-row 'has >=1 verified match' — drives semi/anti joins
+    (HashSemiJoinOperator analogue) and LEFT-outer row emission."""
+    z = jnp.zeros(probe_capacity + 1, dtype=jnp.bool_)
+    idx = jnp.where(pair_live, pi, probe_capacity)
+    return z.at[idx].max(True, mode="drop")[:probe_capacity]
+
+
+def build_matched_flags(build_capacity, bi, pair_live, prior=None):
+    """Build-side matched bitmap for RIGHT/FULL outer joins
+    (join/LookupOuterOperator.java analogue)."""
+    z = prior if prior is not None else jnp.zeros(build_capacity, dtype=jnp.bool_)
+    idx = jnp.where(pair_live, bi, build_capacity)
+    return z.at[idx].max(True, mode="drop")

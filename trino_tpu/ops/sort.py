@@ -1,0 +1,82 @@
+"""Sort / TopN kernels.
+
+Analogue of Trino's OrderByOperator + OrderingCompiler + TopNOperator
+(main/operator/OrderByOperator.java:44, main/sql/gen/OrderingCompiler.java,
+TopNOperator.java:35). Trino JIT-compiles row comparators over a
+PagesIndex; the TPU-native form is multi-key radix-style sorting:
+a sequence of stable argsorts from least- to most-significant key
+(dense vector sorts, which XLA maps to fast on-chip sorting networks)
+instead of comparator calls. Strings sort by dictionary code (our
+dictionaries are sorted, so code order == lexical order).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class SortKey:
+    """channel + ordering; mirrors Trino's SortOrder
+    (spi/connector/SortOrder.java: ASC/DESC x NULLS FIRST/LAST)."""
+
+    channel: int
+    descending: bool = False
+    nulls_first: bool = False
+
+
+def _float_total_order_bits(data: jnp.ndarray) -> jnp.ndarray:
+    """Map floats to integers whose order is IEEE total order with
+    NaN largest — Trino's Double.compare semantics (NaN > +Inf)."""
+    if data.dtype == jnp.float64:
+        u, s, full = jnp.uint64, jnp.int64, jnp.uint64(0x8000000000000000)
+    else:
+        u, s, full = jnp.uint32, jnp.int32, jnp.uint32(0x80000000)
+    bits = data.view(u)
+    neg = (bits & full) != 0
+    flipped = jnp.where(neg, ~bits, bits | full)
+    return flipped.view(s)
+
+
+def _order_value(data: jnp.ndarray, descending: bool) -> jnp.ndarray:
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        data = _float_total_order_bits(data)
+    if not descending:
+        return data
+    if data.dtype == jnp.bool_:
+        return ~data
+    # signed ints: flip order without overflow on INT_MIN
+    return jnp.invert(data)
+
+
+def sort_order(
+    key_data: List[jnp.ndarray],
+    key_valids: List[Optional[jnp.ndarray]],
+    descending: List[bool],
+    nulls_first: List[bool],
+    live: Optional[jnp.ndarray],
+) -> jnp.ndarray:
+    """Permutation putting live rows in ORDER BY order, dead rows last.
+
+    Stable-argsort chain: least-significant key first; within each key,
+    value sort then null-rank sort (composing (null_rank, value));
+    finally dead rows to the back.
+    """
+    n = key_data[0].shape[0]
+    order = jnp.arange(n, dtype=jnp.int32)
+    for data, valid, desc, nf in reversed(
+        list(zip(key_data, key_valids, descending, nulls_first))
+    ):
+        v = _order_value(jnp.take(data, order), desc)
+        order = jnp.take(order, jnp.argsort(v, stable=True))
+        if valid is not None:
+            nv = jnp.take(valid, order)
+            null_rank = jnp.where(nv, 1, 0) if nf else jnp.where(nv, 0, 1)
+            order = jnp.take(order, jnp.argsort(null_rank, stable=True))
+    if live is not None:
+        dead = ~jnp.take(live, order)
+        order = jnp.take(order, jnp.argsort(dead, stable=True))
+    return order

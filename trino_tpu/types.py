@@ -1,0 +1,255 @@
+"""SQL type system.
+
+Analogue of trino-spi's type layer (spi/type/, ~80 type classes,
+SURVEY.md §2.5) re-designed for XLA: every SQL type maps to a fixed-width
+physical dtype so batches are static-shape device arrays. Variable-width
+VARCHAR is represented as int32 dictionary codes plus a host-side
+dictionary (the moral equivalent of Trino's DictionaryBlock,
+spi/block/DictionaryBlock.java) — see block.py.
+
+Trino compiles per-type equal/hash/compare operators at runtime via
+TypeOperators invokedynamic handles (spi/type/TypeOperators.java:64);
+here the analogue is simply that each type exposes its physical dtype and
+the generic jnp ops specialize at trace time under jax.jit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class TypeKind(enum.Enum):
+    BOOLEAN = "boolean"
+    TINYINT = "tinyint"
+    SMALLINT = "smallint"
+    INTEGER = "integer"
+    BIGINT = "bigint"
+    REAL = "real"
+    DOUBLE = "double"
+    DECIMAL = "decimal"
+    VARCHAR = "varchar"
+    CHAR = "char"
+    DATE = "date"
+    TIMESTAMP = "timestamp"
+    INTERVAL_DAY = "interval day to second"
+    INTERVAL_YEAR = "interval year to month"
+    UNKNOWN = "unknown"  # type of NULL literal
+
+
+@dataclasses.dataclass(frozen=True)
+class DataType:
+    """A SQL data type. Parametric types carry precision/scale/length."""
+
+    kind: TypeKind
+    precision: Optional[int] = None  # decimal precision / varchar length
+    scale: Optional[int] = None  # decimal scale
+
+    # ---- classification -------------------------------------------------
+    @property
+    def is_string(self) -> bool:
+        return self.kind in (TypeKind.VARCHAR, TypeKind.CHAR)
+
+    @property
+    def is_integerlike(self) -> bool:
+        return self.kind in (
+            TypeKind.TINYINT,
+            TypeKind.SMALLINT,
+            TypeKind.INTEGER,
+            TypeKind.BIGINT,
+            TypeKind.DATE,
+            TypeKind.TIMESTAMP,
+            TypeKind.INTERVAL_DAY,
+            TypeKind.INTERVAL_YEAR,
+        )
+
+    @property
+    def is_decimal(self) -> bool:
+        return self.kind == TypeKind.DECIMAL
+
+    @property
+    def is_floating(self) -> bool:
+        return self.kind in (TypeKind.REAL, TypeKind.DOUBLE)
+
+    @property
+    def is_numeric(self) -> bool:
+        return self.is_integerlike or self.is_decimal or self.is_floating
+
+    @property
+    def is_orderable(self) -> bool:
+        return self.kind != TypeKind.UNKNOWN
+
+    # ---- physical layout ------------------------------------------------
+    @property
+    def dtype(self) -> np.dtype:
+        """Physical on-device dtype for one value of this type."""
+        k = self.kind
+        if k == TypeKind.BOOLEAN:
+            return np.dtype(np.bool_)
+        if k == TypeKind.TINYINT:
+            return np.dtype(np.int8)
+        if k == TypeKind.SMALLINT:
+            return np.dtype(np.int16)
+        if k in (TypeKind.INTEGER, TypeKind.DATE):
+            return np.dtype(np.int32)
+        if k in (
+            TypeKind.BIGINT,
+            TypeKind.TIMESTAMP,
+            TypeKind.DECIMAL,
+            TypeKind.INTERVAL_DAY,
+        ):
+            return np.dtype(np.int64)
+        if k == TypeKind.INTERVAL_YEAR:
+            return np.dtype(np.int32)
+        if k == TypeKind.REAL:
+            return np.dtype(np.float32)
+        if k == TypeKind.DOUBLE:
+            return np.dtype(np.float64)
+        if k in (TypeKind.VARCHAR, TypeKind.CHAR):
+            return np.dtype(np.int32)  # dictionary codes
+        if k == TypeKind.UNKNOWN:
+            return np.dtype(np.int8)
+        raise ValueError(f"no physical dtype for {self}")
+
+    def __str__(self) -> str:
+        if self.kind == TypeKind.DECIMAL:
+            return f"decimal({self.precision},{self.scale})"
+        if self.kind == TypeKind.VARCHAR and self.precision is not None:
+            return f"varchar({self.precision})"
+        if self.kind == TypeKind.CHAR and self.precision is not None:
+            return f"char({self.precision})"
+        return self.kind.value
+
+
+# Singletons for the common non-parametric types.
+BOOLEAN = DataType(TypeKind.BOOLEAN)
+TINYINT = DataType(TypeKind.TINYINT)
+SMALLINT = DataType(TypeKind.SMALLINT)
+INTEGER = DataType(TypeKind.INTEGER)
+BIGINT = DataType(TypeKind.BIGINT)
+REAL = DataType(TypeKind.REAL)
+DOUBLE = DataType(TypeKind.DOUBLE)
+DATE = DataType(TypeKind.DATE)
+TIMESTAMP = DataType(TypeKind.TIMESTAMP)
+VARCHAR = DataType(TypeKind.VARCHAR)
+INTERVAL_DAY = DataType(TypeKind.INTERVAL_DAY)
+INTERVAL_YEAR = DataType(TypeKind.INTERVAL_YEAR)
+UNKNOWN = DataType(TypeKind.UNKNOWN)
+
+
+def decimal(precision: int, scale: int) -> DataType:
+    if precision > 18:
+        # int64 holds 18 digits; Trino goes to 38 via Int128. We cap at 18
+        # for now; a two-lane int64 repr is the extension point.
+        raise ValueError("decimal precision > 18 not supported yet")
+    return DataType(TypeKind.DECIMAL, precision, scale)
+
+
+def varchar(length: Optional[int] = None) -> DataType:
+    return DataType(TypeKind.VARCHAR, length)
+
+
+def char(length: int) -> DataType:
+    return DataType(TypeKind.CHAR, length)
+
+
+# ---------------------------------------------------------------------------
+# Type arithmetic / coercion — the analogue of Trino's TypeCoercion
+# (main/type/TypeCoercion.java): implicit-cast lattice used by the analyzer.
+# ---------------------------------------------------------------------------
+
+_NUMERIC_LADDER = [
+    TypeKind.TINYINT,
+    TypeKind.SMALLINT,
+    TypeKind.INTEGER,
+    TypeKind.BIGINT,
+    TypeKind.DECIMAL,
+    TypeKind.REAL,
+    TypeKind.DOUBLE,
+]
+
+
+_TEMPORAL = {
+    TypeKind.DATE,
+    TypeKind.TIMESTAMP,
+    TypeKind.INTERVAL_DAY,
+    TypeKind.INTERVAL_YEAR,
+}
+
+
+def common_super_type(a: DataType, b: DataType) -> Optional[DataType]:
+    """Least common type both operands coerce to, or None."""
+    if a == b:
+        return a
+    if a.kind == TypeKind.UNKNOWN:
+        return b
+    if b.kind == TypeKind.UNKNOWN:
+        return a
+    if a.is_string and b.is_string:
+        return VARCHAR
+    # temporal kinds are "integerlike" physically but never join the
+    # numeric coercion ladder
+    if a.kind in _TEMPORAL or b.kind in _TEMPORAL:
+        if {a.kind, b.kind} == {TypeKind.DATE, TypeKind.TIMESTAMP}:
+            return TIMESTAMP
+        return None
+    if a.kind == b.kind == TypeKind.DECIMAL:
+        scale = max(a.scale, b.scale)
+        intd = max(a.precision - a.scale, b.precision - b.scale)
+        if intd + scale > 18:
+            # cannot represent both operands exactly in int64 decimals;
+            # Trino raises for unrepresentable common decimals too
+            raise TypeError(
+                f"no common decimal type for {a} and {b} (needs precision {intd + scale})"
+            )
+        return decimal(intd + scale, scale)
+    if a.is_numeric and b.is_numeric:
+        ia = _NUMERIC_LADDER.index(a.kind)
+        ib = _NUMERIC_LADDER.index(b.kind)
+        hi, hik = (a, a.kind) if ia >= ib else (b, b.kind)
+        lo = b if ia >= ib else a
+        if hik == TypeKind.DECIMAL and lo.is_integerlike:
+            # integer widens into decimal with same scale
+            return decimal(18, hi.scale)
+        if hik in (TypeKind.REAL, TypeKind.DOUBLE) and (
+            lo.is_decimal or lo.is_integerlike or lo.is_floating
+        ):
+            return DOUBLE if hik == TypeKind.DOUBLE or lo.kind == TypeKind.DOUBLE else hi
+        return hi
+    return None
+
+
+def arithmetic_result_type(op: str, a: DataType, b: DataType) -> DataType:
+    """Result type of a binary arithmetic expression after coercion."""
+    # date/interval arithmetic
+    if a.kind == TypeKind.DATE and b.kind in (TypeKind.INTERVAL_DAY, TypeKind.INTERVAL_YEAR):
+        return DATE
+    if b.kind == TypeKind.DATE and a.kind in (TypeKind.INTERVAL_DAY, TypeKind.INTERVAL_YEAR):
+        return DATE
+    if a.kind == TypeKind.TIMESTAMP or b.kind == TypeKind.TIMESTAMP:
+        if a.kind in (TypeKind.INTERVAL_DAY,) or b.kind in (TypeKind.INTERVAL_DAY,):
+            return TIMESTAMP
+    common = common_super_type(a, b)
+    if common is None:
+        raise TypeError(f"cannot apply {op} to {a} and {b}")
+    if common.is_decimal:
+        if op == "*":
+            return decimal(18, min((a.scale or 0) + (b.scale or 0), 18))
+        if op == "/":
+            # Trino: scale = max(a.scale, b.scale); we follow.
+            return decimal(18, max(a.scale or 0, b.scale or 0))
+        if op == "%":
+            return common
+        return common
+    if common.is_integerlike and op == "/":
+        return common  # integer division
+    return common
+
+
+def decimal_scale_factor(t: DataType) -> int:
+    assert t.is_decimal
+    return 10 ** (t.scale or 0)
