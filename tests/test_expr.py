@@ -1,0 +1,302 @@
+"""Expression engine tests — IR lowering vs a python oracle.
+
+Tier-1 analogue of Trino's operator/scalar and TestPageProcessor tests
+(SURVEY.md §4.1)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.block import Column, Dictionary, RelBatch
+from trino_tpu.expr import ir
+from trino_tpu.expr.compile import ExprBinder, bind_expr
+
+
+def batch_of(schema, data):
+    return RelBatch.from_pydict(schema, data)
+
+
+def col(i, t):
+    return ir.InputRef(i, t)
+
+
+def lit(v, t):
+    return ir.Literal(v, t)
+
+
+SCHEMA = [
+    ("a", T.BIGINT),
+    ("b", T.BIGINT),
+    ("d", T.DOUBLE),
+    ("s", T.VARCHAR),
+    ("p", T.decimal(12, 2)),
+]
+DATA = {
+    "a": [1, 2, None, 4, 5],
+    "b": [10, None, 30, 40, 0],
+    "d": [1.5, -2.5, 3.0, None, 0.0],
+    "s": ["apple", "banana", None, "cherry", "apple"],
+    "p": [1.25, 2.50, 3.75, None, -1.00],
+}
+
+
+@pytest.fixture(scope="module")
+def batch():
+    return batch_of(SCHEMA, DATA)
+
+
+def run(expr, batch, count=5):
+    out = bind_expr(expr, batch).eval_batch(batch)
+    return out.to_pylist(count=count)
+
+
+def test_arith_add(batch):
+    assert run(ir.call("add", T.BIGINT, col(0, T.BIGINT), col(1, T.BIGINT)), batch) == [
+        11, None, None, 44, 5]
+
+
+def test_arith_mul_literal(batch):
+    assert run(ir.call("mul", T.BIGINT, col(0, T.BIGINT), lit(3, T.BIGINT)), batch) == [
+        3, 6, None, 12, 15]
+
+
+def test_int_division_by_zero_is_null(batch):
+    out = run(ir.call("div", T.BIGINT, col(0, T.BIGINT), col(1, T.BIGINT)), batch)
+    assert out == [0, None, None, 0, None]
+
+
+def test_comparison_and_3vl(batch):
+    # a > 1 AND b > 10: NULL AND x rules
+    e = ir.and_(
+        ir.comparison("gt", col(0, T.BIGINT), lit(1, T.BIGINT)),
+        ir.comparison("gt", col(1, T.BIGINT), lit(10, T.BIGINT)),
+    )
+    # rows: (1,10)->F, (2,NULL)->NULL, (NULL,30)->NULL, (4,40)->T, (5,0)->F
+    assert run(e, batch) == [False, None, None, True, False]
+
+
+def test_or_3vl(batch):
+    e = ir.or_(
+        ir.comparison("gt", col(0, T.BIGINT), lit(3, T.BIGINT)),
+        ir.comparison("gt", col(1, T.BIGINT), lit(10, T.BIGINT)),
+    )
+    # (1,10)->F|F=F, (2,NULL)->F|N=N, (NULL,30)->N|T=T, (4,40)->T, (5,0)->T|F=T
+    assert run(e, batch) == [False, None, True, True, True]
+
+
+def test_not_null(batch):
+    e = ir.not_(ir.is_null(col(0, T.BIGINT)))
+    assert run(e, batch) == [True, True, False, True, True]
+
+
+def test_string_eq_literal(batch):
+    e = ir.comparison("eq", col(3, T.VARCHAR), lit("apple", T.VARCHAR))
+    assert run(e, batch) == [True, False, None, False, True]
+
+
+def test_string_lt_absent_literal(batch):
+    # 'b' sorts between 'apple' and 'banana'
+    e = ir.comparison("lt", col(3, T.VARCHAR), lit("b", T.VARCHAR))
+    assert run(e, batch) == [True, False, None, False, True]
+
+
+def test_string_literal_on_left(batch):
+    # 'b' < s  ⇔  s > 'b'
+    e = ir.comparison("lt", lit("b", T.VARCHAR), col(3, T.VARCHAR))
+    assert run(e, batch) == [False, True, None, True, False]
+
+
+def test_string_eq_absent_literal(batch):
+    e = ir.comparison("eq", col(3, T.VARCHAR), lit("mango", T.VARCHAR))
+    assert run(e, batch) == [False, False, None, False, False]
+
+
+def test_like(batch):
+    e = ir.Call("like", (col(3, T.VARCHAR), lit("%an%", T.VARCHAR)), T.BOOLEAN)
+    assert run(e, batch) == [False, True, None, False, False]
+
+
+def test_substr(batch):
+    e = ir.Call(
+        "substr", (col(3, T.VARCHAR), lit(1, T.BIGINT), lit(3, T.BIGINT)), T.VARCHAR
+    )
+    assert run(e, batch) == ["app", "ban", None, "che", "app"]
+
+
+def test_in_list(batch):
+    e = ir.InList(col(3, T.VARCHAR), (lit("apple", T.VARCHAR), lit("mango", T.VARCHAR)))
+    assert run(e, batch) == [True, False, None, False, True]
+
+
+def test_case(batch):
+    e = ir.Case(
+        conds=(ir.comparison("gt", col(0, T.BIGINT), lit(3, T.BIGINT)),
+               ir.comparison("gt", col(0, T.BIGINT), lit(1, T.BIGINT))),
+        results=(lit(100, T.BIGINT), lit(200, T.BIGINT)),
+        default=lit(0, T.BIGINT),
+        type=T.BIGINT,
+    )
+    assert run(e, batch) == [0, 200, 0, 100, 100]
+
+
+def test_case_null_default(batch):
+    e = ir.Case(
+        conds=(ir.comparison("gt", col(0, T.BIGINT), lit(3, T.BIGINT)),),
+        results=(lit(1, T.BIGINT),),
+        default=None,
+        type=T.BIGINT,
+    )
+    assert run(e, batch) == [None, None, None, 1, 1]
+
+
+def test_coalesce(batch):
+    e = ir.Call("coalesce", (col(0, T.BIGINT), col(1, T.BIGINT)), T.BIGINT)
+    assert run(e, batch) == [1, 2, 30, 4, 5]
+
+
+def test_decimal_add(batch):
+    t = T.decimal(12, 2)
+    e = ir.call("add", t, col(4, t), col(4, t))
+    assert run(e, batch) == [2.5, 5.0, 7.5, None, -2.0]
+
+
+def test_decimal_mul_scale(batch):
+    # p * p -> scale 4
+    t = T.decimal(18, 4)
+    e = ir.call("mul", t, col(4, T.decimal(12, 2)), col(4, T.decimal(12, 2)))
+    assert run(e, batch) == [1.5625, 6.25, 14.0625, None, 1.0]
+
+
+def test_decimal_one_minus(batch):
+    # TPC-H staple: (1 - p)
+    t = T.decimal(18, 2)
+    e = ir.call("sub", t, lit(1, T.BIGINT), col(4, T.decimal(12, 2)))
+    assert run(e, batch) == [-0.25, -1.5, -2.75, None, 2.0]
+
+
+def test_decimal_div(batch):
+    t = T.decimal(18, 2)
+    e = ir.call("div", t, col(4, T.decimal(12, 2)), lit(2, T.BIGINT))
+    # 1.25/2=0.63 (half away), 2.50/2=1.25, 3.75/2=1.88, NULL, -0.50
+    assert run(e, batch) == [0.63, 1.25, 1.88, None, -0.5]
+
+
+def test_decimal_compare(batch):
+    e = ir.comparison("ge", col(4, T.decimal(12, 2)), lit(2.5, T.decimal(12, 2)))
+    assert run(e, batch) == [False, True, True, None, False]
+
+
+def test_cast_decimal_to_double(batch):
+    e = ir.Cast(col(4, T.decimal(12, 2)), T.DOUBLE)
+    assert run(e, batch) == [1.25, 2.5, 3.75, None, -1.0]
+
+
+def test_extract_year():
+    b = batch_of([("dt", T.DATE)], {"dt": [0, 10957, 19723]})  # 1970-01-01, 2000-01-01, 2024-01-01
+    e = ir.Call("extract_year", (col(0, T.DATE),), T.BIGINT)
+    assert run(e, b, count=3) == [1970, 2000, 2024]
+
+
+def test_extract_month_day():
+    import datetime
+    days = [(datetime.date(1995, 3, 17) - datetime.date(1970, 1, 1)).days]
+    b = batch_of([("dt", T.DATE)], {"dt": days})
+    assert run(ir.Call("extract_month", (col(0, T.DATE),), T.BIGINT), b, 1) == [3]
+    assert run(ir.Call("extract_day", (col(0, T.DATE),), T.BIGINT), b, 1) == [17]
+
+
+def test_mod_sign(batch):
+    e = ir.call("mod", T.BIGINT, lit(-7, T.BIGINT), lit(3, T.BIGINT))
+    assert run(e, batch)[0] == -1  # SQL mod keeps dividend sign
+
+
+def test_bound_under_jit(batch):
+    """The bound closure must trace cleanly under jax.jit."""
+    e = ir.call("add", T.BIGINT, col(0, T.BIGINT), col(1, T.BIGINT))
+    bound = bind_expr(e, batch)
+
+    @jax.jit
+    def go(cols, valids):
+        return bound.fn(cols, valids)
+
+    d, v = go([c.data for c in batch.columns], [c.valid for c in batch.columns])
+    assert int(d[0]) == 11
+
+
+# ---- regressions from review findings ----
+
+
+def test_coalesce_priority():
+    b = batch_of([("a", T.BIGINT)], {"a": [1, 2, None, 4, 5]})
+    e = ir.Call(
+        "coalesce",
+        (col(0, T.BIGINT), lit(7, T.BIGINT), col(0, T.BIGINT)),
+        T.BIGINT,
+    )
+    assert run(e, b) == [1, 2, 7, 4, 5]
+
+
+def test_decimal_vs_integer_compare():
+    b = batch_of([("p", T.decimal(12, 2))], {"p": [1.25, 2.5, 3.75, None, -1.0]})
+    e = ir.comparison("ge", col(0, T.decimal(12, 2)), lit(2, T.BIGINT))
+    assert run(e, b) == [False, True, True, None, False]
+
+
+def test_integer_division_truncates():
+    b = batch_of([("a", T.BIGINT)], {"a": [-7, 7, -7, 7]})
+    e = ir.call("div", T.BIGINT, col(0, T.BIGINT), lit(2, T.BIGINT))
+    assert run(e, b, count=4) == [-3, 3, -3, 3]
+
+
+def test_single_value_string_column_keeps_nulls():
+    b = batch_of([("s", T.VARCHAR), ("t", T.VARCHAR)],
+                 {"s": ["x", None, "x"], "t": ["x", "x", "y"]})
+    e = ir.comparison("eq", col(0, T.VARCHAR), col(1, T.VARCHAR))
+    assert run(e, b, count=3) == [True, None, False]
+
+
+def test_round_with_scale():
+    b = batch_of([("d", T.DOUBLE)], {"d": [1.234, -2.345, 2.5]})
+    e = ir.Call("round", (col(0, T.DOUBLE), lit(2, T.BIGINT)), T.DOUBLE)
+    assert run(e, b, count=3) == [1.23, -2.35, 2.5]
+    e0 = ir.Call("round", (col(0, T.DOUBLE),), T.DOUBLE)
+    assert run(e0, b, count=3) == [1.0, -2.0, 3.0]  # half away from zero
+
+
+def test_cast_half_away():
+    b = batch_of([("d", T.DOUBLE)], {"d": [-2.5, 2.5, 0.125]})
+    e = ir.Cast(col(0, T.DOUBLE), T.BIGINT)
+    assert run(e, b, count=3) == [-3, 3, 0]
+
+
+def test_in_list_with_null_option():
+    b = batch_of([("a", T.BIGINT)], {"a": [1, 2, 3]})
+    e = ir.InList(col(0, T.BIGINT), (lit(1, T.BIGINT), lit(None, T.BIGINT)))
+    assert run(e, b, count=3) == [True, None, None]
+
+
+def test_empty_or_is_false():
+    assert isinstance(ir.or_(), ir.Literal)
+    assert ir.or_().value is False
+
+
+def test_floor_on_decimal():
+    b = batch_of([("p", T.decimal(12, 2))], {"p": [1.25, -1.25, 3.0]})
+    e = ir.Call("floor", (col(0, T.decimal(12, 2)),), T.BIGINT)
+    assert run(e, b, count=3) == [1, -2, 3]
+
+
+def test_substr_negative_start():
+    b = batch_of([("s", T.VARCHAR)], {"s": ["hello"]})
+    e = ir.Call("substr", (col(0, T.VARCHAR), lit(-2, T.BIGINT)), T.VARCHAR)
+    assert run(e, b, count=1) == ["lo"]
+    e0 = ir.Call("substr", (col(0, T.VARCHAR), lit(0, T.BIGINT)), T.VARCHAR)
+    assert run(e0, b, count=1) == [""]
+
+
+def test_string_fn_on_null_literal():
+    b = batch_of([("a", T.BIGINT)], {"a": [1, 2]})
+    e = ir.Call("length", (ir.Cast(lit(None, T.UNKNOWN), T.VARCHAR),), T.BIGINT)
+    assert run(e, b, count=2) == [None, None]

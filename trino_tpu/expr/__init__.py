@@ -1,0 +1,22 @@
+"""Expression layer: typed IR + trace-to-XLA compiler.
+
+Analogue of Trino's RowExpression IR (main/sql/relational/RowExpression.java:18)
+and the runtime bytecode compilers ExpressionCompiler / PageFunctionCompiler
+(main/sql/gen/ExpressionCompiler.java:57, PageFunctionCompiler.java:103 —
+SURVEY.md §2.9). Where Trino emits JVM bytecode per expression at query
+setup, we lower the IR to jax.numpy ops at trace time; `jax.jit` around the
+enclosing operator plays the role of the generated PageProcessor
+(main/operator/project/PageProcessor.java:53), with XLA doing the loop
+fusion that Trino hand-rolls per-position.
+"""
+
+from trino_tpu.expr.ir import (  # noqa: F401
+    Call,
+    Case,
+    Cast,
+    Expr,
+    InList,
+    InputRef,
+    Literal,
+)
+from trino_tpu.expr.compile import bind_expr, ExprBinder  # noqa: F401

@@ -1,0 +1,722 @@
+"""Expression binder/lowerer: typed IR -> pure-jnp closures.
+
+The PageFunctionCompiler analogue (main/sql/gen/PageFunctionCompiler.java:103,
+ExpressionCompiler.java:57). Binding happens once per pipeline against the
+input schema (types + per-column string dictionaries, which are stable for
+a whole table scan — the TPU answer to VariableWidthBlock); the result is
+a closure of jax.numpy ops that the enclosing operator jits. All string
+logic (LIKE, ordering, substr) is resolved on the host against dictionary
+*values* (|dict| items), never per row; devices only see int32 code ops.
+
+Value model: every expression evaluates to ``(data, valid)`` where
+``valid=None`` means all-valid — mirroring Block's mayHaveNull fast path.
+SQL three-valued logic is implemented in the and/or/not lowerings.
+
+Known deviation from Trino: division by zero yields NULL instead of
+raising USER_ERROR (data-dependent errors can't abort an XLA program;
+an error-flag sideband is the planned extension).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.block import Column, Dictionary, RelBatch
+from trino_tpu.expr import functions as F
+from trino_tpu.expr.ir import Call, Case, Cast, Expr, InList, InputRef, Literal
+
+Value = Tuple[jnp.ndarray, Optional[jnp.ndarray]]
+EvalFn = Callable[[List[jnp.ndarray], List[Optional[jnp.ndarray]]], Value]
+
+
+@dataclasses.dataclass
+class Bound:
+    """A bound (lowered) expression: jnp closure + static result metadata.
+
+    ``const_value`` is set only for bound literals — a column that happens
+    to have one distinct dictionary value is NOT a constant (it can still
+    hold NULLs and its mask must survive)."""
+
+    type: T.DataType
+    fn: EvalFn
+    dictionary: Optional[Dictionary] = None
+    const_value: object = None
+    is_const: bool = False
+
+    def eval_batch(self, batch: RelBatch) -> Column:
+        data, valid = self.fn(
+            [c.data for c in batch.columns], [c.valid for c in batch.columns]
+        )
+        return Column(self.type, data, valid, self.dictionary)
+
+
+def merge_valid(*valids: Optional[jnp.ndarray]) -> Optional[jnp.ndarray]:
+    out = None
+    for v in valids:
+        if v is None:
+            continue
+        out = v if out is None else (out & v)
+    return out
+
+
+def _const(shape_src: jnp.ndarray, value, dtype) -> jnp.ndarray:
+    return jnp.full(shape_src.shape, value, dtype=dtype)
+
+
+class ExprBinder:
+    """Binds IR against an input schema. One instance per pipeline."""
+
+    def __init__(self, input_types: Sequence[T.DataType], input_dicts: Sequence[Optional[Dictionary]]):
+        self.input_types = list(input_types)
+        self.input_dicts = list(input_dicts)
+
+    @classmethod
+    def for_batch(cls, batch: RelBatch) -> "ExprBinder":
+        return cls([c.type for c in batch.columns], [c.dictionary for c in batch.columns])
+
+    # ---- dispatch ----
+    def bind(self, e: Expr) -> Bound:
+        if isinstance(e, InputRef):
+            return self._bind_input(e)
+        if isinstance(e, Literal):
+            return self._bind_literal(e)
+        if isinstance(e, Cast):
+            return self._bind_cast(e)
+        if isinstance(e, Case):
+            return self._bind_case(e)
+        if isinstance(e, InList):
+            return self._bind_in(e)
+        if isinstance(e, Call):
+            return self._bind_call(e)
+        raise NotImplementedError(f"cannot bind {e!r}")
+
+    # ---- leaves ----
+    def _bind_input(self, e: InputRef) -> Bound:
+        i = e.index
+        return Bound(
+            self.input_types[i],
+            lambda cols, valids, i=i: (cols[i], valids[i]),
+            self.input_dicts[i],
+        )
+
+    def _bind_literal(self, e: Literal) -> Bound:
+        t = e.type
+        if e.value is None:
+            def fn(cols, valids):
+                ref = cols[0] if cols else jnp.zeros(1)
+                return (
+                    _const(ref, 0, t.dtype),
+                    _const(ref, False, jnp.bool_),
+                )
+            return Bound(t, fn)
+        if t.is_string:
+            d = Dictionary([e.value])
+            def sfn(cols, valids, d=d):
+                ref = cols[0] if cols else jnp.zeros(1)
+                return _const(ref, 0, jnp.int32), None
+            return Bound(t, sfn, d, const_value=e.value, is_const=True)
+        v = e.value
+        if t.is_decimal:
+            v = round(v * T.decimal_scale_factor(t))
+        def vfn(cols, valids, v=v, t=t):
+            ref = cols[0] if cols else jnp.zeros(1)
+            return _const(ref, v, t.dtype), None
+        return Bound(t, vfn, const_value=e.value, is_const=True)
+
+    # ---- cast ----
+    def _bind_cast(self, e: Cast) -> Bound:
+        a = self.bind(e.arg)
+        src, dst = a.type, e.type
+        if src == dst or (src.is_string and dst.is_string):
+            return Bound(dst, a.fn, a.dictionary)
+        if src.kind == T.TypeKind.UNKNOWN:  # NULL literal cast
+            def nfn(cols, valids, afn=a.fn, dst=dst):
+                d, _ = afn(cols, valids)
+                return _const(d, 0, dst.dtype), _const(d, False, jnp.bool_)
+            return Bound(dst, nfn)
+        if src.is_decimal and dst.is_decimal:
+            return self._rescaled(a, src.scale or 0, dst.scale or 0, dst)
+        if src.is_decimal and dst.is_floating:
+            sf = T.decimal_scale_factor(src)
+            def dffn(cols, valids, afn=a.fn):
+                d, v = afn(cols, valids)
+                return d.astype(dst.dtype) / sf, v
+            return Bound(dst, dffn)
+        if src.is_integerlike and dst.is_decimal:
+            sf = T.decimal_scale_factor(dst)
+            def idfn(cols, valids, afn=a.fn):
+                d, v = afn(cols, valids)
+                return d.astype(dst.dtype) * sf, v
+            return Bound(dst, idfn)
+        if src.is_floating and dst.is_decimal:
+            sf = T.decimal_scale_factor(dst)
+            def fdfn(cols, valids, afn=a.fn):
+                d, v = afn(cols, valids)
+                return F.round_half_away(d * sf).astype(dst.dtype), v
+            return Bound(dst, fdfn)
+        if (src.is_integerlike or src.kind == T.TypeKind.BOOLEAN) and (
+            dst.is_integerlike or dst.is_floating
+        ):
+            def iifn(cols, valids, afn=a.fn):
+                d, v = afn(cols, valids)
+                return d.astype(dst.dtype), v
+            return Bound(dst, iifn)
+        if src.is_floating and (dst.is_integerlike or dst.is_floating):
+            def fifn(cols, valids, afn=a.fn):
+                d, v = afn(cols, valids)
+                if dst.is_integerlike:
+                    d = F.round_half_away(d)
+                return d.astype(dst.dtype), v
+            return Bound(dst, fifn)
+        raise NotImplementedError(f"cast {src} -> {dst}")
+
+    def _rescaled(self, a: Bound, sfrom: int, sto: int, out_type: T.DataType) -> Bound:
+        if sfrom == sto:
+            return Bound(out_type, a.fn)
+        if sto > sfrom:
+            m = 10 ** (sto - sfrom)
+            def up(cols, valids, afn=a.fn):
+                d, v = afn(cols, valids)
+                return d * m, v
+            return Bound(out_type, up)
+        m = 10 ** (sfrom - sto)
+        def down(cols, valids, afn=a.fn):
+            d, v = afn(cols, valids)
+            return F.div_round_half_away(d, _const(d, m, d.dtype)), v
+        return Bound(out_type, down)
+
+    # ---- CASE ----
+    def _bind_case(self, e: Case) -> Bound:
+        conds = [self.bind(c) for c in e.conds]
+        results = [self.bind(r) for r in e.results]
+        default = self.bind(e.default) if e.default is not None else None
+        # unify string results onto one dictionary
+        out_dict = None
+        if e.type.is_string:
+            merged = None
+            for r in results + ([default] if default is not None else []):
+                if r.dictionary is not None:
+                    merged = (
+                        r.dictionary
+                        if merged is None
+                        else Dictionary.unify(merged, r.dictionary)[0]
+                    )
+            out_dict = merged
+            results = [self._remap_to(r, out_dict) for r in results]
+            if default is not None:
+                default = self._remap_to(default, out_dict)
+        out_t = e.type
+
+        def fn(cols, valids):
+            # else branch (or NULL)
+            if default is not None:
+                data, valid = default.fn(cols, valids)
+                data = data.astype(out_t.dtype)
+            else:
+                ref, _ = conds[0].fn(cols, valids)
+                data = _const(ref, 0, out_t.dtype)
+                valid = _const(ref, False, jnp.bool_)
+            # fold WHENs back-to-front so the first true wins
+            for cb, rb in reversed(list(zip(conds, results))):
+                cd, cv = cb.fn(cols, valids)
+                take = cd if cv is None else (cd & cv)  # NULL cond = false
+                rd, rv = rb.fn(cols, valids)
+                data = jnp.where(take, rd.astype(out_t.dtype), data)
+                rvv = rv if rv is not None else _const(rd, True, jnp.bool_)
+                vv = valid if valid is not None else _const(rd, True, jnp.bool_)
+                valid = jnp.where(take, rvv, vv)
+            return data, valid
+
+        return Bound(out_t, fn, out_dict)
+
+    def _remap_to(self, b: Bound, target: Dictionary) -> Bound:
+        if b.dictionary is None or b.dictionary == target:
+            return Bound(b.type, b.fn, target)
+        remap = jnp.asarray(
+            [target.code(v) for v in b.dictionary.values], dtype=jnp.int32
+        )
+        def fn(cols, valids, bfn=b.fn, remap=remap):
+            d, v = bfn(cols, valids)
+            return jnp.take(remap, jnp.clip(d, 0, remap.shape[0] - 1)), v
+        return Bound(b.type, fn, target)
+
+    # ---- IN list ----
+    def _bind_in(self, e: InList) -> Bound:
+        v = self.bind(e.value)
+        has_null_option = any(o.value is None for o in e.options)
+        if v.type.is_string:
+            codes = [v.dictionary.code(o.value) for o in e.options if o.value is not None]
+            opts = np.asarray([c for c in codes if c >= 0], dtype=np.int32)
+        else:
+            sf = T.decimal_scale_factor(v.type) if v.type.is_decimal else 1
+            opts = np.asarray(
+                [round(o.value * sf) if v.type.is_decimal else o.value
+                 for o in e.options if o.value is not None],
+                dtype=v.type.dtype,
+            )
+        opts_j = jnp.asarray(opts)
+        def fn(cols, valids):
+            d, val = v.fn(cols, valids)
+            if opts_j.shape[0] == 0:
+                hit = _const(d, False, jnp.bool_)
+            else:
+                hit = (d[:, None] == opts_j[None, :]).any(axis=1)
+            # SQL 3VL: `x IN (a, NULL)` is NULL (not FALSE) when no a matches
+            if has_null_option:
+                val = hit if val is None else (val & hit)
+            return hit, val
+        return Bound(T.BOOLEAN, fn)
+
+    # ---- calls ----
+    def _bind_call(self, e: Call) -> Bound:
+        name = e.name
+        if name in ("and", "or"):
+            return self._bind_logical(e)
+        args = [self.bind(a) for a in e.args]
+        if name == "not":
+            (a,) = args
+            def notfn(cols, valids):
+                d, v = a.fn(cols, valids)
+                return ~d, v
+            return Bound(T.BOOLEAN, notfn)
+        if name == "is_null":
+            (a,) = args
+            def infn(cols, valids):
+                d, v = a.fn(cols, valids)
+                if v is None:
+                    return _const(d, False, jnp.bool_), None
+                return ~v, None
+            return Bound(T.BOOLEAN, infn)
+        if name == "coalesce":
+            return self._bind_coalesce(e, args)
+        if name in ("eq", "ne", "lt", "le", "gt", "ge"):
+            return self._bind_comparison(name, args)
+        if name in ("add", "sub", "mul", "div", "mod"):
+            return self._bind_arith(name, e.type, args)
+        if name == "negate":
+            (a,) = args
+            def negfn(cols, valids):
+                d, v = a.fn(cols, valids)
+                return -d, v
+            return Bound(e.type, negfn, a.dictionary)
+        if name in ("extract_year", "extract_month", "extract_day"):
+            (a,) = args
+            part = {"extract_year": F.extract_year, "extract_month": F.extract_month,
+                    "extract_day": F.extract_day}[name]
+            def exfn(cols, valids):
+                d, v = a.fn(cols, valids)
+                days = d
+                if a.type.kind == T.TypeKind.TIMESTAMP:
+                    days = d // (86400 * 1000 * 1000)
+                return part(days).astype(jnp.int64), v
+            return Bound(T.BIGINT, exfn)
+        if name == "like":
+            return self._bind_like(e, args)
+        if name in ("substr", "substring"):
+            return self._bind_dict_transform(
+                args[0],
+                e,
+                lambda s: self._py_substr(s, e.args[1], e.args[2] if len(e.args) > 2 else None),
+            )
+        if name in ("upper", "lower"):
+            return self._bind_dict_transform(
+                args[0], e, (str.upper if name == "upper" else str.lower)
+            )
+        if name == "length":
+            a = args[0]
+            if a.dictionary is None:
+                return self._null_of(a, T.BIGINT)
+            table = jnp.asarray([len(v) for v in a.dictionary.values], dtype=jnp.int64)
+            def lenfn(cols, valids):
+                d, v = a.fn(cols, valids)
+                return jnp.take(table, jnp.clip(d, 0, table.shape[0] - 1)), v
+            return Bound(T.BIGINT, lenfn)
+        if name == "abs":
+            (a,) = args
+            def absfn(cols, valids):
+                d, v = a.fn(cols, valids)
+                return jnp.abs(d), v
+            return Bound(e.type, absfn)
+        if name == "round":
+            a = args[0]
+            if len(args) > 1:
+                assert args[1].is_const, "round() scale must be constant"
+                ndig = int(args[1].const_value)
+            else:
+                ndig = 0
+            if a.type.is_decimal:
+                s = a.type.scale or 0
+                if ndig >= s:
+                    return Bound(e.type, a.fn)
+                m = 10 ** (s - ndig)
+                def rdfn(cols, valids, afn=a.fn, m=m):
+                    d, v = afn(cols, valids)
+                    return F.div_round_half_away(d, _const(d, m, d.dtype)) * m, v
+                return Bound(e.type, rdfn)
+            def rfn(cols, valids, afn=a.fn, ndig=ndig):
+                d, v = afn(cols, valids)
+                sf = 10.0 ** ndig
+                out = F.round_half_away(d.astype(jnp.float64) * sf) / sf
+                if e.type.is_integerlike:
+                    out = out.astype(e.type.dtype)
+                return out, v
+            return Bound(e.type, rfn)
+        if name in ("sqrt", "ln", "exp", "floor", "ceil"):
+            (a,) = args[:1]
+            jf = {"sqrt": jnp.sqrt, "ln": jnp.log, "exp": jnp.exp,
+                  "floor": jnp.floor, "ceil": jnp.ceil}[name]
+            descale = T.decimal_scale_factor(a.type) if a.type.is_decimal else 1
+            out_scale = T.decimal_scale_factor(e.type) if e.type.is_decimal else None
+            def mfn(cols, valids):
+                d, v = a.fn(cols, valids)
+                out = jf(d.astype(jnp.float64) / descale)
+                if out_scale is not None:
+                    out = F.round_half_away(out * out_scale).astype(e.type.dtype)
+                elif e.type.is_integerlike:
+                    out = out.astype(e.type.dtype)
+                return out, v
+            return Bound(e.type, mfn)
+        raise NotImplementedError(f"scalar function {name}")
+
+    @staticmethod
+    def _py_substr(s: str, start_lit: Expr, len_lit: Optional[Expr]) -> str:
+        """Trino substr: 1-based; negative start counts from the end;
+        start of 0 yields empty (StringFunctions.substr)."""
+        start = int(start_lit.value)
+        n = int(len_lit.value) if len_lit is not None else None
+        if start == 0:
+            return ""
+        begin = start - 1 if start > 0 else max(len(s) + start, 0)
+        if start < 0 and len(s) + start < 0:
+            return ""
+        end = len(s) if n is None else begin + max(n, 0)
+        return s[begin:end]
+
+    def _null_of(self, ref: Bound, out_type: T.DataType) -> Bound:
+        def fn(cols, valids, rfn=ref.fn):
+            d, _ = rfn(cols, valids)
+            return _const(d, 0, out_type.dtype), _const(d, False, jnp.bool_)
+        return Bound(out_type, fn, Dictionary([]) if out_type.is_string else None)
+
+    def _bind_dict_transform(self, a: Bound, e: Call, pyfn) -> Bound:
+        """String function on a dictionary column: transform |dict| values
+        on host, remap codes on device (DictionaryAwarePageProjection
+        analogue — main/operator/project/DictionaryAwarePageProjection.java)."""
+        if a.dictionary is None:  # NULL-literal string argument
+            return self._null_of(a, e.type)
+        src = a.dictionary
+        transformed = [pyfn(v) for v in src.values]
+        new_dict = Dictionary(transformed)
+        remap = jnp.asarray([new_dict.code(t) for t in transformed], dtype=jnp.int32)
+        def fn(cols, valids):
+            d, v = a.fn(cols, valids)
+            return jnp.take(remap, jnp.clip(d, 0, remap.shape[0] - 1)), v
+        return Bound(e.type, fn, new_dict)
+
+    def _bind_like(self, e: Call, args) -> Bound:
+        a = args[0]
+        if a.dictionary is None:
+            return self._null_of(a, T.BOOLEAN)
+        pattern = e.args[1]
+        assert isinstance(pattern, Literal), "LIKE pattern must be constant"
+        escape = e.args[2].value if len(e.args) > 2 else None
+        table = jnp.asarray(F.dictionary_like_table(a.dictionary, pattern.value, escape))
+        def fn(cols, valids):
+            d, v = a.fn(cols, valids)
+            return jnp.take(table, jnp.clip(d, 0, table.shape[0] - 1)), v
+        return Bound(T.BOOLEAN, fn)
+
+    def _bind_coalesce(self, e: Call, args) -> Bound:
+        out_dict = None
+        if e.type.is_string:
+            merged = None
+            for a in args:
+                if a.dictionary is not None:
+                    merged = a.dictionary if merged is None else Dictionary.unify(merged, a.dictionary)[0]
+            out_dict = merged
+            args = [self._remap_to(a, out_dict) for a in args]
+        def fn(cols, valids):
+            data, valid = args[-1].fn(cols, valids)
+            data = data.astype(e.type.dtype)
+            # fold right-to-left: an earlier argument overrides wherever
+            # it is valid, so the first valid argument wins per row
+            for a in reversed(args[:-1]):
+                d, v = a.fn(cols, valids)
+                if v is None:  # all-valid argument shadows everything after it
+                    data, valid = d.astype(e.type.dtype), None
+                    continue
+                data = jnp.where(v, d.astype(e.type.dtype), data)
+                vv = valid if valid is not None else _const(d, True, jnp.bool_)
+                valid = v | vv
+            return data, valid
+        return Bound(e.type, fn, out_dict)
+
+    # ---- 3VL and/or ----
+    def _bind_logical(self, e: Call) -> Bound:
+        args = [self.bind(a) for a in e.args]
+        is_and = e.name == "and"
+        def fn(cols, valids):
+            datas, vals = [], []
+            for a in args:
+                d, v = a.fn(cols, valids)
+                datas.append(d)
+                vals.append(v)
+            if is_and:
+                # value: false dominates; nulls treated true for the value lane
+                data = None
+                for d, v in zip(datas, vals):
+                    lane = d if v is None else (d | ~v)
+                    data = lane if data is None else (data & lane)
+                # valid: all valid, or some valid-false forces definite false
+                valid = merge_valid(*vals)
+                if valid is not None:
+                    for d, v in zip(datas, vals):
+                        definite_false = (~d) if v is None else (v & ~d)
+                        valid = valid | definite_false
+            else:
+                data = None
+                for d, v in zip(datas, vals):
+                    lane = d if v is None else (d & v)  # null -> false lane
+                    data = lane if data is None else (data | lane)
+                valid = merge_valid(*vals)
+                if valid is not None:
+                    for d, v in zip(datas, vals):
+                        definite_true = d if v is None else (v & d)
+                        valid = valid | definite_true
+            return data, valid
+        return Bound(T.BOOLEAN, fn)
+
+    # ---- comparisons ----
+    def _bind_comparison(self, op: str, args) -> Bound:
+        a, b = args
+        if a.type.is_string or b.type.is_string:
+            return self._bind_string_comparison(op, a, b)
+        # decimal: rescale BOTH sides (incl. a bare-integer side) to the
+        # common scale so scaled int64 compares against scaled int64
+        if a.type.is_decimal or b.type.is_decimal:
+            sc = max(a.type.scale or 0 if a.type.is_decimal else 0,
+                     b.type.scale or 0 if b.type.is_decimal else 0)
+            def to_scale(x: Bound) -> Bound:
+                if x.type.is_decimal:
+                    return self._rescaled(x, x.type.scale or 0, sc, T.decimal(18, sc))
+                if x.type.is_integerlike:
+                    m = 10 ** sc
+                    def up(cols, valids, xfn=x.fn):
+                        d, v = xfn(cols, valids)
+                        return d.astype(jnp.int64) * m, v
+                    return Bound(T.decimal(18, sc), up)
+                return x  # floating side compares via promote below
+            a, b = to_scale(a), to_scale(b)
+            if a.type.is_floating or b.type.is_floating:
+                # mixed decimal/double: bring decimal down to double
+                def to_double(x: Bound) -> Bound:
+                    if not x.type.is_decimal:
+                        return x
+                    sf = T.decimal_scale_factor(x.type)
+                    def dn(cols, valids, xfn=x.fn):
+                        d, v = xfn(cols, valids)
+                        return d.astype(jnp.float64) / sf, v
+                    return Bound(T.DOUBLE, dn)
+                a, b = to_double(a), to_double(b)
+        jf = {
+            "eq": lambda x, y: x == y, "ne": lambda x, y: x != y,
+            "lt": lambda x, y: x < y, "le": lambda x, y: x <= y,
+            "gt": lambda x, y: x > y, "ge": lambda x, y: x >= y,
+        }[op]
+        def fn(cols, valids):
+            ad, av = a.fn(cols, valids)
+            bd, bv = b.fn(cols, valids)
+            if ad.dtype != bd.dtype:
+                ct = jnp.promote_types(ad.dtype, bd.dtype)
+                ad, bd = ad.astype(ct), bd.astype(ct)
+            return jf(ad, bd), merge_valid(av, bv)
+        return Bound(T.BOOLEAN, fn)
+
+    def _bind_string_comparison(self, op: str, a: Bound, b: Bound) -> Bound:
+        """String comparison on dictionary codes. Because dictionaries are
+        sorted, code order == lexical order within one dictionary; a
+        constant compares via its bisect position even when absent."""
+        jf = {
+            "eq": lambda x, y: x == y, "ne": lambda x, y: x != y,
+            "lt": lambda x, y: x < y, "le": lambda x, y: x <= y,
+            "gt": lambda x, y: x > y, "ge": lambda x, y: x >= y,
+        }
+        flip = {"lt": "gt", "gt": "lt", "le": "ge", "ge": "le", "eq": "eq", "ne": "ne"}
+
+        for lit, col, effective in ((b, a, op), (a, b, flip[op])):
+            if not lit.is_const or col.is_const or col.dictionary is None:
+                continue
+            v = lit.const_value
+            d = col.dictionary
+            code = d.code(v)
+            if code >= 0:  # present: direct code comparison
+                cmpfn = jf[effective]
+                def pfn(cols, valids, colb=col, code=code, cmpfn=cmpfn):
+                    cd, cv = colb.fn(cols, valids)
+                    return cmpfn(cd, code), cv
+                return Bound(T.BOOLEAN, pfn)
+            if effective == "eq":
+                return self._const_bool(col, False)
+            if effective == "ne":
+                return self._const_bool(col, True)
+            lb = d.code_lower_bound(v)
+            # value absent at bisect position lb: col >/>= v ⇔ code >= lb;
+            # col </<= v ⇔ code < lb
+            ge_side = effective in ("gt", "ge")
+            def bfn(cols, valids, colb=col, lb=lb, ge_side=ge_side):
+                cd, cv = colb.fn(cols, valids)
+                return (cd >= lb) if ge_side else (cd < lb), cv
+            return Bound(T.BOOLEAN, bfn)
+
+        # column vs column (or equal-dictionary cases): unify then compare
+        da, db = a.dictionary, b.dictionary
+        if da is not None and db is not None and da != db:
+            merged, _, _ = Dictionary.unify(da, db)
+            a = self._remap_to(a, merged)
+            b = self._remap_to(b, merged)
+        cmpfn = jf[op]
+        def fn(cols, valids):
+            ad, av = a.fn(cols, valids)
+            bd, bv = b.fn(cols, valids)
+            return cmpfn(ad, bd), merge_valid(av, bv)
+        return Bound(T.BOOLEAN, fn)
+
+    @staticmethod
+    def _const_bool(ref: Bound, value: bool) -> Bound:
+        def fn(cols, valids, ref=ref):
+            d, v = ref.fn(cols, valids)
+            return _const(d, value, jnp.bool_), v
+        return Bound(T.BOOLEAN, fn)
+
+    # ---- arithmetic ----
+    def _bind_arith(self, op: str, out_type: T.DataType, args) -> Bound:
+        a, b = args
+        if out_type.is_decimal or a.type.is_decimal or b.type.is_decimal:
+            return self._bind_decimal_arith(op, out_type, a, b)
+        jf = {
+            "add": lambda x, y: x + y,
+            "sub": lambda x, y: x - y,
+            "mul": lambda x, y: x * y,
+        }.get(op)
+        def fn(cols, valids):
+            ad, av = a.fn(cols, valids)
+            bd, bv = b.fn(cols, valids)
+            valid = merge_valid(av, bv)
+            ad = ad.astype(out_type.dtype)
+            bd = bd.astype(out_type.dtype)
+            if op == "div":
+                zero = bd == 0
+                if out_type.is_floating:
+                    d = ad / jnp.where(zero, jnp.ones((), bd.dtype), bd)
+                else:
+                    d = F.div_trunc(ad, bd)  # SQL truncates toward zero
+                nv = valid if valid is not None else _const(ad, True, jnp.bool_)
+                return d, jnp.where(zero, False, nv)
+            if op == "mod":
+                zero = bd == 0
+                safe = jnp.where(zero, 1, bd)
+                # SQL mod takes the dividend's sign (C semantics), unlike
+                # python's floor mod
+                if out_type.is_floating:
+                    d = jnp.fmod(ad, safe)
+                else:
+                    d = jnp.sign(ad) * (jnp.abs(ad) % jnp.abs(safe))
+                nv = valid if valid is not None else _const(ad, True, jnp.bool_)
+                return d, jnp.where(zero, False, nv)
+            return jf(ad, bd), valid
+        return Bound(out_type, fn)
+
+    def _bind_decimal_arith(self, op: str, out_type: T.DataType, a: Bound, b: Bound) -> Bound:
+        sa = a.type.scale or 0 if a.type.is_decimal else 0
+        sb = b.type.scale or 0 if b.type.is_decimal else 0
+        so = out_type.scale or 0
+
+        def to_scaled(x: Bound, s: int):
+            if x.type.is_decimal:
+                return x, x.type.scale or 0
+            if x.type.is_integerlike:
+                def fi(cols, valids, xfn=x.fn):
+                    d, v = xfn(cols, valids)
+                    return d.astype(jnp.int64), v
+                return Bound(T.decimal(18, 0), fi), 0
+            raise NotImplementedError(f"decimal arith with {x.type}")
+
+        if (a.type.is_floating or b.type.is_floating) or out_type.is_floating:
+            # promote to double
+            def ffn(cols, valids):
+                ad, av = a.fn(cols, valids)
+                bd, bv = b.fn(cols, valids)
+                if a.type.is_decimal:
+                    ad = ad.astype(jnp.float64) / T.decimal_scale_factor(a.type)
+                if b.type.is_decimal:
+                    bd = bd.astype(jnp.float64) / T.decimal_scale_factor(b.type)
+                valid = merge_valid(av, bv)
+                jf = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply}.get(op)
+                if op == "div":
+                    zero = bd == 0
+                    return ad / jnp.where(zero, 1.0, bd), (
+                        jnp.where(zero, False, valid if valid is not None else _const(ad, True, jnp.bool_))
+                    )
+                return jf(ad, bd), valid
+            return Bound(out_type, ffn)
+
+        a, sa = to_scaled(a, sa)
+        b, sb = to_scaled(b, sb)
+        def fn(cols, valids):
+            ad, av = a.fn(cols, valids)
+            bd, bv = b.fn(cols, valids)
+            ad = ad.astype(jnp.int64)
+            bd = bd.astype(jnp.int64)
+            valid = merge_valid(av, bv)
+            if op in ("add", "sub"):
+                cs = max(sa, sb)
+                if sa < cs:
+                    ad = ad * (10 ** (cs - sa))
+                if sb < cs:
+                    bd = bd * (10 ** (cs - sb))
+                d = ad + bd if op == "add" else ad - bd
+                if cs != so:
+                    d = d * (10 ** (so - cs)) if so > cs else F.div_round_half_away(
+                        d, _const(d, 10 ** (cs - so), jnp.int64))
+                return d, valid
+            if op == "mul":
+                d = ad * bd  # scale sa+sb
+                cs = sa + sb
+                if cs != so:
+                    d = d * (10 ** (so - cs)) if so > cs else F.div_round_half_away(
+                        d, _const(d, 10 ** (cs - so), jnp.int64))
+                return d, valid
+            if op == "div":
+                # result scale so: d = round(a * 10^(sb + so - sa) / b)
+                shift = sb + so - sa
+                num = ad * (10 ** shift) if shift >= 0 else F.div_round_half_away(
+                    ad, _const(ad, 10 ** (-shift), jnp.int64))
+                zero = bd == 0
+                d = F.div_round_half_away(num, jnp.where(zero, 1, bd))
+                nv = valid if valid is not None else _const(ad, True, jnp.bool_)
+                return jnp.where(zero, 0, d), jnp.where(zero, False, nv)
+            if op == "mod":
+                cs = max(sa, sb)
+                if sa < cs:
+                    ad = ad * (10 ** (cs - sa))
+                if sb < cs:
+                    bd = bd * (10 ** (cs - sb))
+                zero = bd == 0
+                safe = jnp.where(zero, 1, bd)
+                d = jnp.sign(ad) * (jnp.abs(ad) % jnp.abs(safe))
+                nv = valid if valid is not None else _const(ad, True, jnp.bool_)
+                return d, jnp.where(zero, False, nv)
+            raise NotImplementedError(op)
+        return Bound(out_type, fn)
+
+
+def bind_expr(expr: Expr, batch_or_types, dicts=None) -> Bound:
+    """Bind against a RelBatch (tests) or explicit (types, dicts)."""
+    if isinstance(batch_or_types, RelBatch):
+        return ExprBinder.for_batch(batch_or_types).bind(expr)
+    return ExprBinder(batch_or_types, dicts or [None] * len(batch_or_types)).bind(expr)

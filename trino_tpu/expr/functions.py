@@ -1,0 +1,120 @@
+"""Vectorized scalar helpers used by the expression compiler.
+
+The device-side bodies of the scalar function library (Trino's
+main/operator/scalar/, ~140 files — SURVEY.md §2.10). Only functions
+whose semantics need real code live here; trivial jnp mappings are
+declared inline in compile.py's registry.
+"""
+
+from __future__ import annotations
+
+import datetime
+import re
+
+import jax.numpy as jnp
+import numpy as np
+
+EPOCH = datetime.date(1970, 1, 1)
+
+
+def date_to_days(d: datetime.date) -> int:
+    return (d - EPOCH).days
+
+
+def days_to_date(days: int) -> datetime.date:
+    return EPOCH + datetime.timedelta(days=int(days))
+
+
+# -- civil-calendar decomposition, vectorized (Howard Hinnant's algorithm) --
+# Pure int32 arithmetic: runs on the TPU VPU without host round-trips, the
+# replacement for Trino's Joda-based DateTimeFunctions (extract YEAR/...).
+
+
+def civil_from_days(days: jnp.ndarray):
+    """days since 1970-01-01 -> (year, month, day), vectorized."""
+    z = days.astype(jnp.int32) + 719468
+    era = jnp.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097  # [0, 146096]
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365  # [0, 399]
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)  # [0, 365]
+    mp = (5 * doy + 2) // 153  # [0, 11]
+    d = doy - (153 * mp + 2) // 5 + 1  # [1, 31]
+    m = jnp.where(mp < 10, mp + 3, mp - 9)  # [1, 12]
+    year = jnp.where(m <= 2, y + 1, y)
+    return year, m, d
+
+
+def extract_year(days):
+    return civil_from_days(days)[0]
+
+
+def extract_month(days):
+    return civil_from_days(days)[1]
+
+
+def extract_day(days):
+    return civil_from_days(days)[2]
+
+
+def add_months_scalar(d: datetime.date, months: int) -> datetime.date:
+    """Host-side date + INTERVAL YEAR/MONTH (constant folding path)."""
+    y = d.year + (d.month - 1 + months) // 12
+    m = (d.month - 1 + months) % 12 + 1
+    # clamp day like SQL (e.g. Jan 31 + 1 month = Feb 28/29)
+    last = (
+        datetime.date(y + (m == 12), m % 12 + 1, 1) - datetime.timedelta(days=1)
+    ).day
+    return datetime.date(y, m, min(d.day, last))
+
+
+# -- decimal arithmetic on scaled int64 --
+
+
+def round_half_away(x: jnp.ndarray) -> jnp.ndarray:
+    """Float rounding half away from zero — Trino's MathFunctions.round /
+    cast-to-integer convention (NOT banker's rounding like jnp.round)."""
+    return jnp.sign(x) * jnp.floor(jnp.abs(x) + 0.5)
+
+
+def div_trunc(num: jnp.ndarray, den: jnp.ndarray) -> jnp.ndarray:
+    """Integer division truncating toward zero (SQL), not floor."""
+    den_safe = jnp.where(den == 0, jnp.ones((), den.dtype), den)
+    sign = jnp.where((num < 0) ^ (den_safe < 0), -1, 1).astype(num.dtype)
+    return sign * (jnp.abs(num) // jnp.abs(den_safe))
+
+
+def div_round_half_away(num: jnp.ndarray, den: jnp.ndarray) -> jnp.ndarray:
+    """Integer divide rounding half away from zero — Trino's decimal
+    division rounding (lib ... Decimals). Division by zero yields 0; the
+    caller turns it into NULL."""
+    den_safe = jnp.where(den == 0, jnp.ones((), den.dtype), den)
+    sign = jnp.where((num < 0) ^ (den_safe < 0), -1, 1).astype(num.dtype)
+    q = (jnp.abs(num) + jnp.abs(den_safe) // 2) // jnp.abs(den_safe)
+    return sign * q
+
+
+def like_to_regex(pattern: str, escape: str | None = None) -> "re.Pattern":
+    """SQL LIKE pattern -> anchored python regex (host side: evaluated
+    over dictionary values only, never per row)."""
+    out = []
+    i = 0
+    while i < len(pattern):
+        c = pattern[i]
+        if escape and c == escape and i + 1 < len(pattern):
+            out.append(re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if c == "%":
+            out.append(".*")
+        elif c == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(c))
+        i += 1
+    return re.compile("^" + "".join(out) + "$", re.DOTALL)
+
+
+def dictionary_like_table(dictionary, pattern: str, escape=None) -> np.ndarray:
+    rx = like_to_regex(pattern, escape)
+    return np.asarray([rx.match(v) is not None for v in dictionary.values], dtype=bool)

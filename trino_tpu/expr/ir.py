@@ -1,0 +1,144 @@
+"""Typed expression IR.
+
+The post-analysis relational expression tree — Trino's RowExpression
+(main/sql/relational/RowExpression.java:18, CallExpression.java:26).
+Nodes are immutable and carry their result DataType; the analyzer has
+already resolved names to channel indices and inserted coercions, so
+lowering (compile.py) is purely mechanical.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence, Tuple
+
+from trino_tpu import types as T
+
+
+class Expr:
+    """Base class. Every node has .type (DataType)."""
+
+    type: T.DataType
+
+    def children(self) -> Sequence["Expr"]:
+        return ()
+
+
+@dataclasses.dataclass(frozen=True)
+class InputRef(Expr):
+    """Reference to input channel `index` — RowExpression's InputReferenceExpression."""
+
+    index: int
+    type: T.DataType
+
+    def __repr__(self):
+        return f"$[{self.index}:{self.type}]"
+
+
+@dataclasses.dataclass(frozen=True)
+class Literal(Expr):
+    """Constant. `value` is a python scalar (str for VARCHAR — lowered
+    against the batch dictionary at bind time), or None for NULL."""
+
+    value: Any
+    type: T.DataType
+
+    def __repr__(self):
+        return f"lit({self.value!r}:{self.type})"
+
+
+@dataclasses.dataclass(frozen=True)
+class Call(Expr):
+    """Function/operator application — CallExpression. `name` indexes the
+    scalar function registry (functions.py)."""
+
+    name: str
+    args: Tuple[Expr, ...]
+    type: T.DataType
+
+    def children(self):
+        return self.args
+
+    def __repr__(self):
+        return f"{self.name}({', '.join(map(repr, self.args))})"
+
+
+@dataclasses.dataclass(frozen=True)
+class Cast(Expr):
+    arg: Expr
+    type: T.DataType
+
+    def children(self):
+        return (self.arg,)
+
+    def __repr__(self):
+        return f"cast({self.arg!r} as {self.type})"
+
+
+@dataclasses.dataclass(frozen=True)
+class Case(Expr):
+    """Searched CASE: WHEN conds[i] THEN results[i] ... ELSE default.
+    default may be None (NULL)."""
+
+    conds: Tuple[Expr, ...]
+    results: Tuple[Expr, ...]
+    default: Optional[Expr]
+    type: T.DataType
+
+    def children(self):
+        out = list(self.conds) + list(self.results)
+        if self.default is not None:
+            out.append(self.default)
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class InList(Expr):
+    """`value IN (literal, ...)` — constant list only (dynamic IN becomes
+    a semi-join in the planner, like Trino)."""
+
+    value: Expr
+    options: Tuple[Literal, ...]
+    type: T.DataType = T.BOOLEAN
+
+    def children(self):
+        return (self.value,)
+
+
+# ---------------------------------------------------------------------------
+# Convenience constructors used by analyzer/planner.
+# ---------------------------------------------------------------------------
+
+
+def call(name: str, type_: T.DataType, *args: Expr) -> Call:
+    return Call(name, tuple(args), type_)
+
+
+def and_(*args: Expr) -> Expr:
+    args = tuple(a for a in args if a is not None)
+    if not args:
+        return Literal(True, T.BOOLEAN)
+    if len(args) == 1:
+        return args[0]
+    return Call("and", args, T.BOOLEAN)
+
+
+def or_(*args: Expr) -> Expr:
+    args = tuple(a for a in args if a is not None)
+    if not args:
+        return Literal(False, T.BOOLEAN)
+    if len(args) == 1:
+        return args[0]
+    return Call("or", tuple(args), T.BOOLEAN)
+
+
+def not_(a: Expr) -> Expr:
+    return Call("not", (a,), T.BOOLEAN)
+
+
+def comparison(op: str, left: Expr, right: Expr) -> Call:
+    return Call(op, (left, right), T.BOOLEAN)
+
+
+def is_null(a: Expr) -> Call:
+    return Call("is_null", (a,), T.BOOLEAN)
