@@ -1,0 +1,86 @@
+"""SQLite result oracle — the H2QueryRunner analogue
+(testing/trino-testing/…/H2QueryRunner.java, SURVEY.md §4.3): loads the
+same generated data into sqlite and cross-checks query results.
+
+Decimals load as exact scaled INTEGERs would lose SQL semantics in
+sqlite arithmetic, so they load as REAL; numeric comparisons use
+tolerance. Dates load as epoch-day INTEGERs; queries against the oracle
+must phrase date literals as epoch days (helpers below).
+"""
+
+from __future__ import annotations
+
+import datetime
+import sqlite3
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.connectors.tpch import TABLES, base_row_count, generate_column
+
+
+def epoch_days(s: str) -> int:
+    y, m, d = map(int, s.split("-"))
+    return (datetime.date(y, m, d) - datetime.date(1970, 1, 1)).days
+
+
+def load_tpch_sqlite(conn: sqlite3.Connection, sf: float, tables: Sequence[str] = None):
+    """Load generated TPC-H data into sqlite tables (same generator, so
+    the oracle sees byte-identical data)."""
+    for table in tables or TABLES:
+        cols = TABLES[table]
+        coldefs = ", ".join(
+            f"{n} {'TEXT' if t.is_string else 'REAL' if t.is_decimal or t.is_floating else 'INTEGER'}"
+            for n, t in cols
+        )
+        conn.execute(f"CREATE TABLE {table} ({coldefs})")
+        n_base = base_row_count(table, sf)
+        step = 100_000
+        for a in range(0, n_base, step):
+            b = min(a + step, n_base)
+            arrays = []
+            nrows = None
+            for name, typ in cols:
+                data, d = generate_column(table, name, sf, a, b)
+                nrows = len(data)
+                if typ.is_string:
+                    vals = [d.values[c] for c in data]
+                elif typ.is_decimal:
+                    sfac = T.decimal_scale_factor(typ)
+                    vals = (np.asarray(data, dtype=np.float64) / sfac).tolist()
+                else:
+                    vals = np.asarray(data).tolist()
+                arrays.append(vals)
+            rows = list(zip(*arrays))
+            ph = ", ".join("?" * len(cols))
+            conn.executemany(f"INSERT INTO {table} VALUES ({ph})", rows)
+    conn.commit()
+
+
+def sqlite_rows(conn: sqlite3.Connection, sql: str) -> List[tuple]:
+    return [tuple(r) for r in conn.execute(sql).fetchall()]
+
+
+def assert_rows_match(actual: List[list], expected: List[tuple], ordered: bool,
+                      rel_tol: float = 1e-9, abs_tol: float = 1e-6):
+    def norm(rows):
+        return [tuple(r) for r in rows]
+
+    a, e = norm(actual), norm(expected)
+    if not ordered:
+        a = sorted(a, key=repr)
+        e = sorted(e, key=repr)
+    assert len(a) == len(e), f"row count {len(a)} != {len(e)}\nactual={a[:5]}\nexpected={e[:5]}"
+    for ra, re_ in zip(a, e):
+        assert len(ra) == len(re_), f"width {ra} vs {re_}"
+        for x, y in zip(ra, re_):
+            if isinstance(x, float) or isinstance(y, float):
+                if x is None or y is None:
+                    assert x is None and y is None, f"{ra} vs {re_}"
+                else:
+                    assert abs(x - y) <= max(abs_tol, rel_tol * max(abs(x), abs(y))), (
+                        f"{x} != {y} in {ra} vs {re_}"
+                    )
+            else:
+                assert x == y, f"{x!r} != {y!r} in row {ra} vs {re_}"

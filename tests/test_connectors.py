@@ -1,0 +1,195 @@
+"""Connector tests: TPC-H generator invariants, memory store round-trip,
+blackhole sink — tier-1 analogue of the reference's per-plugin tests."""
+
+import sqlite3
+
+import numpy as np
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.block import RelBatch
+from trino_tpu.connectors.blackhole import create_blackhole_connector
+from trino_tpu.connectors.memory import create_memory_connector
+from trino_tpu.connectors.spi import ColumnMetadata
+from trino_tpu.connectors.tpch import (
+    TABLES,
+    base_row_count,
+    create_tpch_connector,
+    generate_column,
+    lineitem_row_count,
+    order_index_to_key,
+)
+
+SF = 0.01  # tiny
+
+
+def test_row_counts_tiny():
+    assert base_row_count("region", SF) == 5
+    assert base_row_count("nation", SF) == 25
+    assert base_row_count("customer", SF) == 1500
+    assert base_row_count("orders", SF) == 15000
+    assert base_row_count("supplier", SF) == 100
+    assert base_row_count("part", SF) == 2000
+    assert base_row_count("partsupp", SF) == 8000
+    # lineitem ~4x orders
+    n = lineitem_row_count(SF)
+    assert 15000 * 3 < n < 15000 * 5
+
+
+def test_determinism_and_split_independence():
+    full, _ = generate_column("orders", "o_custkey", SF, 0, 1000)
+    again, _ = generate_column("orders", "o_custkey", SF, 0, 1000)
+    np.testing.assert_array_equal(full, again)
+    a, _ = generate_column("orders", "o_custkey", SF, 0, 400)
+    b, _ = generate_column("orders", "o_custkey", SF, 400, 1000)
+    np.testing.assert_array_equal(full, np.concatenate([a, b]))
+
+
+def test_lineitem_split_independence():
+    full, _ = generate_column("lineitem", "l_extendedprice", SF, 0, 500)
+    a, _ = generate_column("lineitem", "l_extendedprice", SF, 0, 123)
+    b, _ = generate_column("lineitem", "l_extendedprice", SF, 123, 500)
+    np.testing.assert_array_equal(full, np.concatenate([a, b]))
+
+
+def test_custkey_never_divisible_by_3():
+    ck, _ = generate_column("orders", "o_custkey", SF, 0, 15000)
+    assert (ck % 3 != 0).all()
+    assert ck.min() >= 1
+    assert ck.max() <= 1500
+
+
+def test_referential_integrity_lineitem_orders():
+    lk, _ = generate_column("lineitem", "l_orderkey", SF, 0, 15000)
+    ok, _ = generate_column("orders", "o_orderkey", SF, 0, 15000)
+    assert set(np.unique(lk)) <= set(ok.tolist())
+
+
+def test_partsupp_covers_lineitem_pairs():
+    lp, _ = generate_column("lineitem", "l_partkey", SF, 0, 2000)
+    ls, _ = generate_column("lineitem", "l_suppkey", SF, 0, 2000)
+    pp, _ = generate_column("partsupp", "ps_partkey", SF, 0, 8000)
+    ps, _ = generate_column("partsupp", "ps_suppkey", SF, 0, 8000)
+    pairs = set(zip(pp.tolist(), ps.tolist()))
+    lpairs = set(zip(lp.tolist(), ls.tolist()))
+    assert lpairs <= pairs
+
+
+def test_sparse_orderkeys():
+    idx = np.arange(16, dtype=np.int64)
+    keys = order_index_to_key(idx)
+    assert keys[:8].tolist() == [1, 2, 3, 4, 5, 6, 7, 8]
+    assert keys[8:16].tolist() == [33, 34, 35, 36, 37, 38, 39, 40]
+
+
+def test_string_dictionaries_decode():
+    data, d = generate_column("lineitem", "l_returnflag", SF, 0, 100)
+    vals = {d.values[c] for c in data}
+    assert vals <= {"A", "N", "R"}
+    data, d = generate_column("orders", "o_orderpriority", SF, 0, 100)
+    assert all(d.values[c][0] in "12345" for c in data)
+
+
+def test_comment_like_targets_exist():
+    data, d = generate_column("orders", "o_comment", SF, 0, 15000)
+    import re
+
+    rx = re.compile("^.*special.*requests.*$")
+    frac = np.mean([bool(rx.match(d.values[c])) for c in data])
+    assert 0.001 < frac < 0.1
+
+
+def test_dates_in_range():
+    od, _ = generate_column("orders", "o_orderdate", SF, 0, 15000)
+    import datetime
+
+    lo = (datetime.date(1992, 1, 1) - datetime.date(1970, 1, 1)).days
+    hi = (datetime.date(1998, 8, 2) - datetime.date(1970, 1, 1)).days
+    assert od.min() >= lo and od.max() <= hi
+    ship, _ = generate_column("lineitem", "l_shipdate", SF, 0, 100)
+    commit, _ = generate_column("lineitem", "l_commitdate", SF, 0, 100)
+    receipt, _ = generate_column("lineitem", "l_receiptdate", SF, 0, 100)
+    assert (receipt > ship).all()
+
+
+def test_page_source_batches():
+    conn = create_tpch_connector()
+    h = conn.metadata.get_table_handle("tiny", "customer")
+    splits = conn.split_manager.get_splits(h, 4)
+    assert len(splits) == 4
+    total = 0
+    for s in splits:
+        for batch in conn.page_source.batches(s, ["c_custkey", "c_mktsegment"], 512):
+            total += batch.row_count()
+            assert batch.width == 2
+    assert total == 1500
+
+
+def test_tpch_table_stats():
+    conn = create_tpch_connector()
+    h = conn.metadata.get_table_handle("tiny", "lineitem")
+    st = conn.metadata.get_table_statistics(h)
+    assert st.row_count == lineitem_row_count(SF)
+
+
+def test_sqlite_oracle_loads():
+    from tests.oracle import load_tpch_sqlite, sqlite_rows
+
+    conn = sqlite3.connect(":memory:")
+    load_tpch_sqlite(conn, SF, tables=["region", "nation", "customer"])
+    assert sqlite_rows(conn, "SELECT count(*) FROM customer") == [(1500,)]
+    rows = sqlite_rows(conn, "SELECT n_name FROM nation ORDER BY n_nationkey LIMIT 1")
+    assert rows == [("ALGERIA",)]
+
+
+# ---- memory connector ----
+
+
+def test_memory_roundtrip():
+    conn = create_memory_connector()
+    h = conn.metadata.create_table(
+        "default", "t",
+        [ColumnMetadata("id", T.BIGINT), ColumnMetadata("name", T.VARCHAR)],
+    )
+    sink = conn.page_sink(h)
+    sink.append(RelBatch.from_pydict(
+        [("id", T.BIGINT), ("name", T.VARCHAR)],
+        {"id": [1, 2, None], "name": ["x", None, "z"]},
+    ))
+    sink.append(RelBatch.from_pydict(
+        [("id", T.BIGINT), ("name", T.VARCHAR)],
+        {"id": [4], "name": ["a"]},
+    ))
+    assert sink.finish() == 4
+    splits = conn.split_manager.get_splits(h, 1)
+    rows = []
+    for s in splits:
+        for b in conn.page_source.batches(s, ["id", "name"], 1024):
+            rows.extend(b.to_pylists())
+    assert rows == [[1, "x"], [2, None], [None, "z"], [4, "a"]]
+
+
+def test_memory_dictionary_grows_across_inserts():
+    conn = create_memory_connector()
+    h = conn.metadata.create_table("default", "t", [ColumnMetadata("s", T.VARCHAR)])
+    sink = conn.page_sink(h)
+    sink.append(RelBatch.from_pydict([("s", T.VARCHAR)], {"s": ["m", "z"]}))
+    sink.append(RelBatch.from_pydict([("s", T.VARCHAR)], {"s": ["a"]}))
+    d = conn.metadata.column_dictionary(h, "s")
+    assert d.values == ("a", "m", "z")
+    (split,) = conn.split_manager.get_splits(h, 1)
+    rows = []
+    for b in conn.page_source.batches(split, ["s"], 64):
+        rows.extend(b.to_pylists())
+    assert [r[0] for r in rows] == ["m", "z", "a"]
+
+
+def test_blackhole():
+    conn = create_blackhole_connector()
+    h = conn.metadata.create_table("default", "sink", [ColumnMetadata("x", T.BIGINT)])
+    sink = conn.page_sink(h)
+    sink.append(RelBatch.from_pydict([("x", T.BIGINT)], {"x": [1, 2, 3]}))
+    assert sink.finish() == 3
+    (split,) = conn.split_manager.get_splits(h, 8)
+    batches = list(conn.page_source.batches(split, ["x"], 64))
+    assert sum(b.row_count() for b in batches) == 0

@@ -300,3 +300,39 @@ def test_string_fn_on_null_literal():
     b = batch_of([("a", T.BIGINT)], {"a": [1, 2]})
     e = ir.Call("length", (ir.Cast(lit(None, T.UNKNOWN), T.VARCHAR),), T.BIGINT)
     assert run(e, b, count=2) == [None, None]
+
+
+def test_all_null_string_column_like():
+    b = batch_of([("s", T.VARCHAR)], {"s": [None, None]})
+    e = ir.Call("like", (col(0, T.VARCHAR), lit("a%", T.VARCHAR)), T.BOOLEAN)
+    assert run(e, b, count=2) == [None, None]
+
+
+def test_extract_year_negative_days():
+    b = batch_of([("dt", T.DATE)], {"dt": [-1, -365]})  # 1969-12-31, 1969-01-01
+    e = ir.Call("extract_year", (col(0, T.DATE),), T.BIGINT)
+    assert run(e, b, count=2) == [1969, 1969]
+
+
+def test_cast_preserves_constness():
+    b = batch_of([("d", T.DOUBLE)], {"d": [1.234]})
+    e = ir.Call(
+        "round",
+        (col(0, T.DOUBLE), ir.Cast(lit(1, T.INTEGER), T.BIGINT)),
+        T.DOUBLE,
+    )
+    assert run(e, b, count=1) == [1.2]
+
+
+def test_float_div_by_zero_is_infinite():
+    b = batch_of([("d", T.DOUBLE)], {"d": [1.0, -1.0, 0.0]})
+    e = ir.call("div", T.DOUBLE, col(0, T.DOUBLE), lit(0.0, T.DOUBLE))
+    out = run(e, b, count=3)
+    assert out[0] == float("inf") and out[1] == float("-inf")
+    assert out[2] != out[2]  # NaN
+
+
+def test_decimal_literal_half_away():
+    b = batch_of([("p", T.decimal(3, 2))], {"p": [0.13]})
+    e = ir.comparison("eq", col(0, T.decimal(3, 2)), lit(0.125, T.decimal(3, 2)))
+    assert run(e, b, count=1) == [True]  # 0.125 -> 0.13 half away, not 0.12

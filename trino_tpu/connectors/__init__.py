@@ -1,0 +1,19 @@
+"""Connector layer: the plugin SPI plus built-in connectors.
+
+Analogue of trino-spi's connector surface (spi/connector/ ~100
+interfaces, spi/Plugin.java:35 — SURVEY.md §2.12) with the essential
+built-ins: tpch (plugin/trino-tpch), memory (plugin/trino-memory),
+blackhole (plugin/trino-blackhole).
+"""
+
+from trino_tpu.connectors.spi import (  # noqa: F401
+    CatalogManager,
+    ColumnMetadata,
+    Connector,
+    ConnectorMetadata,
+    ConnectorPageSource,
+    ConnectorSplitManager,
+    Split,
+    TableHandle,
+    TableMetadata,
+)

@@ -1,0 +1,223 @@
+"""In-memory connector.
+
+Analogue of plugin/trino-memory (MemoryPagesStore — SURVEY.md §2.12):
+tables live as lists of host-side column arrays; supports CREATE TABLE,
+INSERT (page sink), and scan. String columns keep one growing
+table-wide dictionary so scans stay pipeline-bindable (see spi.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.block import Column, Dictionary, RelBatch, bucket_capacity
+from trino_tpu.connectors.spi import (
+    ColumnMetadata,
+    Connector,
+    ConnectorMetadata,
+    ConnectorPageSource,
+    ConnectorPageSink,
+    ConnectorSplitManager,
+    Split,
+    TableHandle,
+    TableMetadata,
+    TableStatistics,
+)
+
+
+@dataclasses.dataclass
+class _StoredColumn:
+    type: T.DataType
+    data: np.ndarray  # host array, dense (no padding)
+    valid: Optional[np.ndarray]
+    dictionary: Optional[Dictionary]
+
+
+@dataclasses.dataclass
+class _StoredTable:
+    schema: str
+    name: str
+    columns: List[ColumnMetadata]
+    data: Dict[str, _StoredColumn] = dataclasses.field(default_factory=dict)
+    row_count: int = 0
+
+
+class _Store:
+    """The MemoryPagesStore analogue; guarded for concurrent inserts."""
+
+    def __init__(self):
+        self.tables: Dict[Tuple[str, str], _StoredTable] = {}
+        self.lock = threading.Lock()
+        self._ids = itertools.count()
+
+
+class MemoryMetadata(ConnectorMetadata):
+    def __init__(self, store: _Store):
+        self.store = store
+
+    def list_schemas(self) -> List[str]:
+        return sorted({s for s, _ in self.store.tables} | {"default"})
+
+    def list_tables(self, schema: str) -> List[str]:
+        return sorted(n for s, n in self.store.tables if s == schema)
+
+    def get_table_handle(self, schema: str, table: str) -> Optional[TableHandle]:
+        if (schema, table) not in self.store.tables:
+            return None
+        return TableHandle("memory", schema, table)
+
+    def get_table_metadata(self, handle: TableHandle) -> TableMetadata:
+        t = self.store.tables[(handle.schema, handle.table)]
+        return TableMetadata(handle.schema, handle.table, tuple(t.columns))
+
+    def column_dictionary(self, handle: TableHandle, column: str) -> Optional[Dictionary]:
+        t = self.store.tables[(handle.schema, handle.table)]
+        sc = t.data.get(column)
+        return sc.dictionary if sc is not None else None
+
+    def get_table_statistics(self, handle: TableHandle) -> TableStatistics:
+        t = self.store.tables[(handle.schema, handle.table)]
+        return TableStatistics(row_count=float(t.row_count))
+
+    def create_table(self, schema: str, table: str, columns: Sequence[ColumnMetadata]) -> TableHandle:
+        with self.store.lock:
+            if (schema, table) in self.store.tables:
+                raise ValueError(f"table '{schema}.{table}' already exists")
+            st = _StoredTable(schema, table, list(columns))
+            for c in columns:
+                st.data[c.name] = _StoredColumn(
+                    c.type,
+                    np.zeros(0, dtype=c.type.dtype),
+                    None,
+                    Dictionary([]) if c.type.is_string else None,
+                )
+            self.store.tables[(schema, table)] = st
+        return TableHandle("memory", schema, table)
+
+    def drop_table(self, handle: TableHandle) -> None:
+        with self.store.lock:
+            self.store.tables.pop((handle.schema, handle.table), None)
+
+
+class MemorySplitManager(ConnectorSplitManager):
+    def __init__(self, store: _Store):
+        self.store = store
+
+    def get_splits(self, handle: TableHandle, target_split_count: int) -> List[Split]:
+        t = self.store.tables[(handle.schema, handle.table)]
+        n = t.row_count
+        k = max(1, min(target_split_count, max(n, 1)))
+        per = -(-max(n, 1) // k)
+        return [
+            Split(handle, s, (a, min(a + per, n)))
+            for s, a in enumerate(range(0, max(n, 1), per))
+        ]
+
+
+class MemoryPageSource(ConnectorPageSource):
+    def __init__(self, store: _Store):
+        self.store = store
+
+    def batches(self, split: Split, columns: Sequence[str], batch_rows: int) -> Iterator[RelBatch]:
+        t = self.store.tables[(split.table.schema, split.table.table)]
+        lo, hi = split.row_range
+        for a in range(lo, hi, batch_rows):
+            b = min(a + batch_rows, hi)
+            n = b - a
+            cap = bucket_capacity(n)
+            cols = []
+            for name in columns:
+                sc = t.data[name]
+                arr = np.zeros(cap, dtype=sc.type.dtype)
+                arr[:n] = sc.data[a:b]
+                valid = None
+                if sc.valid is not None:
+                    v = np.zeros(cap, dtype=bool)
+                    v[:n] = sc.valid[a:b]
+                    valid = jnp.asarray(v)
+                cols.append(Column(sc.type, jnp.asarray(arr), valid, sc.dictionary))
+            live = None
+            if n != cap:
+                lv = np.zeros(cap, dtype=bool)
+                lv[:n] = True
+                live = jnp.asarray(lv)
+            yield RelBatch(cols, live)
+        if hi == lo:  # empty table: one empty batch so schemas propagate
+            yield RelBatch(
+                [
+                    Column(t.data[name].type,
+                           jnp.zeros(16, dtype=t.data[name].type.dtype),
+                           None, t.data[name].dictionary)
+                    for name in columns
+                ],
+                jnp.zeros(16, dtype=jnp.bool_),
+            )
+
+
+class MemoryPageSink(ConnectorPageSink):
+    """Appends batches; string columns re-encode into the table's growing
+    dictionary (unify) so the table dictionary stays authoritative."""
+
+    def __init__(self, store: _Store, handle: TableHandle):
+        self.store = store
+        self.handle = handle
+        self.rows = 0
+
+    def append(self, batch: RelBatch) -> None:
+        key = (self.handle.schema, self.handle.table)
+        live = np.asarray(batch.live_mask())
+        with self.store.lock:
+            t = self.store.tables[key]
+            n = int(live.sum())
+            for cm, col in zip(t.columns, batch.columns):
+                sc = t.data[cm.name]
+                data = np.asarray(col.data)[live]
+                valid = np.asarray(col.valid)[live] if col.valid is not None else None
+                if cm.type.is_string:
+                    incoming = col.dictionary or Dictionary([])
+                    merged, remap_old, remap_new = Dictionary.unify(sc.dictionary, incoming)
+                    if len(remap_old):
+                        sc.data = remap_old[sc.data] if len(sc.data) else sc.data
+                    data = remap_new[np.clip(data, 0, max(len(incoming) - 1, 0))] if len(incoming) else data
+                    sc.dictionary = merged
+                    # back-patch: table dictionary object changes identity;
+                    # readers pick up the new one on next scan
+                sc.data = np.concatenate([sc.data, data.astype(sc.type.dtype)])
+                if valid is not None or sc.valid is not None:
+                    old_valid = (
+                        sc.valid if sc.valid is not None
+                        else np.ones(t.row_count, dtype=bool)
+                    )
+                    new_valid = valid if valid is not None else np.ones(n, dtype=bool)
+                    sc.valid = np.concatenate([old_valid, new_valid])
+            t.row_count += n
+            self.rows += n
+
+    def finish(self) -> int:
+        return self.rows
+
+
+class MemoryConnector(Connector):
+    def __init__(self):
+        store = _Store()
+        super().__init__(
+            "memory",
+            MemoryMetadata(store),
+            MemorySplitManager(store),
+            MemoryPageSource(store),
+        )
+        self.store = store
+
+    def page_sink(self, handle: TableHandle) -> ConnectorPageSink:
+        return MemoryPageSink(self.store, handle)
+
+
+def create_memory_connector() -> Connector:
+    return MemoryConnector()

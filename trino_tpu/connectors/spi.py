@@ -1,0 +1,177 @@
+"""Connector SPI.
+
+The plugin boundary between the engine and data sources — the analogue
+of spi/connector/: ConnectorMetadata (spi/connector/ConnectorMetadata.java:64),
+ConnectorSplitManager, ConnectorPageSourceProvider
+(spi/connector/ConnectorPageSource.java:24), ConnectorPageSinkProvider,
+and the Plugin registration surface (spi/Plugin.java:35), reduced to the
+capability set the engine consumes. TPU-first deltas from the reference:
+
+- Page sources yield ``RelBatch`` (device-ready SoA) instead of
+  Page/Block, and declare *table-stable dictionaries* per string column
+  so expression binding happens once per pipeline (see expr/compile.py).
+- Splits carry explicit row ranges; a split is the unit of source
+  parallelism (SOURCE_DISTRIBUTION — SystemPartitioningHandle.java:55)
+  and of retry in FTE mode.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+from trino_tpu import types as T
+from trino_tpu.block import Dictionary, RelBatch
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnMetadata:
+    name: str
+    type: T.DataType
+
+
+@dataclasses.dataclass(frozen=True)
+class TableMetadata:
+    schema: str
+    name: str
+    columns: Tuple[ColumnMetadata, ...]
+
+    def column_index(self, name: str) -> int:
+        for i, c in enumerate(self.columns):
+            if c.name == name:
+                return i
+        raise KeyError(name)
+
+
+@dataclasses.dataclass(frozen=True)
+class TableHandle:
+    """Engine-side opaque reference to a connector table."""
+
+    catalog: str
+    schema: str
+    table: str
+    # connector-private payload (e.g. tpch scale factor)
+    payload: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Split:
+    """A retryable unit of scan work (spi/connector/ConnectorSplit.java).
+    `row_range` is [start, end) within the table for generator/memory
+    connectors; `payload` is connector-private."""
+
+    table: TableHandle
+    seq: int
+    row_range: Optional[Tuple[int, int]] = None
+    payload: Any = None
+
+
+@dataclasses.dataclass
+class TableStatistics:
+    """CBO inputs (spi/statistics/TableStatistics.java)."""
+
+    row_count: Optional[float] = None
+    # per-column: distinct count, null fraction, min, max
+    columns: Dict[str, Tuple[Optional[float], Optional[float], Any, Any]] = dataclasses.field(
+        default_factory=dict
+    )
+
+
+class ConnectorMetadata:
+    """Per-connector metadata surface (ConnectorMetadata.java:64)."""
+
+    def list_schemas(self) -> List[str]:
+        raise NotImplementedError
+
+    def list_tables(self, schema: str) -> List[str]:
+        raise NotImplementedError
+
+    def get_table_handle(self, schema: str, table: str) -> Optional[TableHandle]:
+        raise NotImplementedError
+
+    def get_table_metadata(self, handle: TableHandle) -> TableMetadata:
+        raise NotImplementedError
+
+    def column_dictionary(self, handle: TableHandle, column: str) -> Optional[Dictionary]:
+        """Table-stable dictionary for a string column (None for
+        non-string). Called at plan time so binding can be pipeline-wide."""
+        return None
+
+    def get_table_statistics(self, handle: TableHandle) -> TableStatistics:
+        return TableStatistics()
+
+    # -- writes (optional capability) --
+    def create_table(self, schema: str, table: str, columns: Sequence[ColumnMetadata]) -> TableHandle:
+        raise NotImplementedError(f"{type(self).__name__} does not support CREATE TABLE")
+
+    def drop_table(self, handle: TableHandle) -> None:
+        raise NotImplementedError(f"{type(self).__name__} does not support DROP TABLE")
+
+
+class ConnectorSplitManager:
+    def get_splits(self, handle: TableHandle, target_split_count: int) -> List[Split]:
+        raise NotImplementedError
+
+
+class ConnectorPageSource:
+    """Produces batches for one split (ConnectorPageSource.java:24).
+    `columns` is the pruned projection (channel names)."""
+
+    def batches(self, split: Split, columns: Sequence[str], batch_rows: int) -> Iterator[RelBatch]:
+        raise NotImplementedError
+
+
+class ConnectorPageSink:
+    """Accepts batches for a write (ConnectorPageSinkProvider analogue)."""
+
+    def append(self, batch: RelBatch) -> None:
+        raise NotImplementedError
+
+    def finish(self) -> int:
+        """Commit; returns row count written."""
+        raise NotImplementedError
+
+
+class Connector:
+    """One catalog's capability bundle (spi/connector/Connector.java)."""
+
+    def __init__(
+        self,
+        name: str,
+        metadata: ConnectorMetadata,
+        split_manager: Optional[ConnectorSplitManager] = None,
+        page_source: Optional[ConnectorPageSource] = None,
+    ):
+        self.name = name
+        self.metadata = metadata
+        self.split_manager = split_manager
+        self.page_source = page_source
+
+    def page_sink(self, handle: TableHandle) -> ConnectorPageSink:
+        raise NotImplementedError(f"connector {self.name} does not support writes")
+
+
+class CatalogManager:
+    """Engine-wide catalog registry — MetadataManager/CatalogManager
+    analogue (main/metadata/MetadataManager.java)."""
+
+    def __init__(self):
+        self._catalogs: Dict[str, Connector] = {}
+
+    def register(self, catalog: str, connector: Connector) -> None:
+        self._catalogs[catalog] = connector
+
+    def get(self, catalog: str) -> Connector:
+        if catalog not in self._catalogs:
+            raise KeyError(f"catalog '{catalog}' not registered")
+        return self._catalogs[catalog]
+
+    def catalogs(self) -> List[str]:
+        return sorted(self._catalogs)
+
+    def resolve_table(self, catalog: str, schema: str, table: str) -> Tuple[Connector, TableHandle]:
+        conn = self.get(catalog)
+        handle = conn.metadata.get_table_handle(schema, table)
+        if handle is None:
+            raise KeyError(f"table '{catalog}.{schema}.{table}' does not exist")
+        return conn, handle

@@ -20,6 +20,7 @@ an error-flag sideband is the planned extension).
 from __future__ import annotations
 
 import dataclasses
+import math
 from typing import Callable, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
@@ -53,6 +54,14 @@ class Bound:
             [c.data for c in batch.columns], [c.valid for c in batch.columns]
         )
         return Column(self.type, data, valid, self.dictionary)
+
+
+def scale_decimal_value(v, t: T.DataType) -> int:
+    """Python value -> scaled int64 payload, rounding half away from zero
+    (matches the device-side cast path; python round() is banker's)."""
+    sf = T.decimal_scale_factor(t)
+    x = v * sf
+    return int(math.floor(abs(x) + 0.5)) * (1 if x >= 0 else -1)
 
 
 def merge_valid(*valids: Optional[jnp.ndarray]) -> Optional[jnp.ndarray]:
@@ -122,7 +131,7 @@ class ExprBinder:
             return Bound(t, sfn, d, const_value=e.value, is_const=True)
         v = e.value
         if t.is_decimal:
-            v = round(v * T.decimal_scale_factor(t))
+            v = scale_decimal_value(v, t)
         def vfn(cols, valids, v=v, t=t):
             ref = cols[0] if cols else jnp.zeros(1)
             return _const(ref, v, t.dtype), None
@@ -131,6 +140,15 @@ class ExprBinder:
     # ---- cast ----
     def _bind_cast(self, e: Cast) -> Bound:
         a = self.bind(e.arg)
+        out = self._bind_cast_from(e, a)
+        # a cast of a constant is still a constant (same logical value);
+        # needed e.g. for round(x, CAST(1 AS BIGINT)) scale arguments
+        if a.is_const:
+            out.is_const = True
+            out.const_value = a.const_value
+        return out
+
+    def _bind_cast_from(self, e: Cast, a: Bound) -> Bound:
         src, dst = a.type, e.type
         if src == dst or (src.is_string and dst.is_string):
             return Bound(dst, a.fn, a.dictionary)
@@ -255,7 +273,7 @@ class ExprBinder:
         else:
             sf = T.decimal_scale_factor(v.type) if v.type.is_decimal else 1
             opts = np.asarray(
-                [round(o.value * sf) if v.type.is_decimal else o.value
+                [scale_decimal_value(o.value, v.type) if v.type.is_decimal else o.value
                  for o in e.options if o.value is not None],
                 dtype=v.type.dtype,
             )
@@ -329,7 +347,7 @@ class ExprBinder:
             )
         if name == "length":
             a = args[0]
-            if a.dictionary is None:
+            if a.dictionary is None or len(a.dictionary) == 0:
                 return self._null_of(a, T.BIGINT)
             table = jnp.asarray([len(v) for v in a.dictionary.values], dtype=jnp.int64)
             def lenfn(cols, valids):
@@ -407,7 +425,7 @@ class ExprBinder:
         """String function on a dictionary column: transform |dict| values
         on host, remap codes on device (DictionaryAwarePageProjection
         analogue — main/operator/project/DictionaryAwarePageProjection.java)."""
-        if a.dictionary is None:  # NULL-literal string argument
+        if a.dictionary is None or len(a.dictionary) == 0:  # NULL-only input
             return self._null_of(a, e.type)
         src = a.dictionary
         transformed = [pyfn(v) for v in src.values]
@@ -420,7 +438,7 @@ class ExprBinder:
 
     def _bind_like(self, e: Call, args) -> Bound:
         a = args[0]
-        if a.dictionary is None:
+        if a.dictionary is None or len(a.dictionary) == 0:
             return self._null_of(a, T.BOOLEAN)
         pattern = e.args[1]
         assert isinstance(pattern, Literal), "LIKE pattern must be constant"
@@ -610,11 +628,11 @@ class ExprBinder:
             ad = ad.astype(out_type.dtype)
             bd = bd.astype(out_type.dtype)
             if op == "div":
-                zero = bd == 0
                 if out_type.is_floating:
-                    d = ad / jnp.where(zero, jnp.ones((), bd.dtype), bd)
-                else:
-                    d = F.div_trunc(ad, bd)  # SQL truncates toward zero
+                    # IEEE semantics like Trino: x/0 = ±Inf, 0/0 = NaN
+                    return ad / bd, valid
+                zero = bd == 0
+                d = F.div_trunc(ad, bd)  # SQL truncates toward zero
                 nv = valid if valid is not None else _const(ad, True, jnp.bool_)
                 return d, jnp.where(zero, False, nv)
             if op == "mod":
@@ -658,10 +676,7 @@ class ExprBinder:
                 valid = merge_valid(av, bv)
                 jf = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply}.get(op)
                 if op == "div":
-                    zero = bd == 0
-                    return ad / jnp.where(zero, 1.0, bd), (
-                        jnp.where(zero, False, valid if valid is not None else _const(ad, True, jnp.bool_))
-                    )
+                    return ad / bd, valid  # IEEE Inf/NaN, like Trino doubles
                 return jf(ad, bd), valid
             return Bound(out_type, ffn)
 

@@ -31,9 +31,13 @@ def days_to_date(days: int) -> datetime.date:
 
 
 def civil_from_days(days: jnp.ndarray):
-    """days since 1970-01-01 -> (year, month, day), vectorized."""
+    """days since 1970-01-01 -> (year, month, day), vectorized.
+
+    Hinnant's civil_from_days restated for floor division: the original
+    compensates C truncating division with a (z - 146096) shift; jnp's
+    // already floors, so the era is simply z // 146097."""
     z = days.astype(jnp.int32) + 719468
-    era = jnp.where(z >= 0, z, z - 146096) // 146097
+    era = z // 146097
     doe = z - era * 146097  # [0, 146096]
     yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365  # [0, 399]
     y = yoe + era * 400
