@@ -1,0 +1,328 @@
+"""Exec-layer tests: hand-built operator pipelines vs the sqlite oracle
+(the tier-2 LocalQueryRunner strategy, SURVEY.md §4.2, before the SQL
+frontend exists)."""
+
+import sqlite3
+
+import pytest
+
+from tests.oracle import assert_rows_match, epoch_days, load_tpch_sqlite, sqlite_rows
+from trino_tpu import types as T
+from trino_tpu.connectors.tpch import create_tpch_connector
+from trino_tpu.exec import (
+    AggSpec,
+    CollectorSink,
+    CrossJoinBuildSink,
+    CrossJoinOperator,
+    Driver,
+    FilterProjectOperator,
+    HashAggregationOperator,
+    HashBuildSink,
+    JoinBridge,
+    LimitOperator,
+    LookupJoinOperator,
+    Pipeline,
+    SortOperator,
+    TableScanOperator,
+    TopNOperator,
+)
+from trino_tpu.expr.compile import ExprBinder
+from trino_tpu.expr.ir import Call, InputRef, Literal
+from trino_tpu.ops.sort import SortKey
+
+SF = 0.01
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    conn = sqlite3.connect(":memory:")
+    load_tpch_sqlite(conn, SF)
+    yield conn
+    conn.close()
+
+
+@pytest.fixture(scope="module")
+def tpch():
+    return create_tpch_connector()
+
+
+def scan(tpch, table, columns, batch_rows=65536, schema="tiny"):
+    handle = tpch.metadata.get_table_handle(schema, table)
+    splits = tpch.split_manager.get_splits(handle, 1)
+    op = TableScanOperator(tpch.page_source, splits, columns, batch_rows)
+    meta = tpch.metadata.get_table_metadata(handle)
+    types = [meta.columns[meta.column_index(c)].type for c in columns]
+    dicts = [tpch.metadata.column_dictionary(handle, c) for c in columns]
+    return op, types, dicts
+
+
+def run(ops):
+    sink = CollectorSink()
+    Driver(Pipeline(ops + [sink])).run()
+    return sink.rows()
+
+
+def test_scan_filter_project(oracle, tpch):
+    op, types, dicts = scan(tpch, "lineitem", ["l_orderkey", "l_quantity"])
+    b = ExprBinder(types, dicts)
+    flt = b.bind(
+        Call("lt", (InputRef(1, types[1]), Literal(25, T.decimal(12, 2))), T.BOOLEAN)
+    )
+    proj = [b.bind(InputRef(0, types[0]))]
+    rows = run([op, FilterProjectOperator(flt, proj)])
+    expected = sqlite_rows(
+        oracle, "SELECT l_orderkey FROM lineitem WHERE l_quantity < 25"
+    )
+    assert_rows_match(rows, expected, ordered=False)
+
+
+def test_hash_aggregation(oracle, tpch):
+    cols = ["l_returnflag", "l_linestatus", "l_quantity"]
+    op, types, dicts = scan(tpch, "lineitem", cols)
+    agg = HashAggregationOperator(
+        [0, 1],
+        [
+            AggSpec("sum", 2, T.decimal(18, 2)),
+            AggSpec("count_star", None, T.BIGINT),
+            AggSpec("avg", 2, T.DOUBLE),
+            AggSpec("min", 2, T.decimal(12, 2)),
+            AggSpec("max", 2, T.decimal(12, 2)),
+        ],
+        list(zip(types, dicts)),
+        initial_capacity=16,  # force growth paths
+    )
+    rows = run([op, agg])
+    expected = sqlite_rows(
+        oracle,
+        "SELECT l_returnflag, l_linestatus, ROUND(SUM(l_quantity), 2), COUNT(*),"
+        " AVG(l_quantity), MIN(l_quantity), MAX(l_quantity)"
+        " FROM lineitem GROUP BY 1, 2",
+    )
+    assert_rows_match(rows, expected, ordered=False)
+
+
+def test_global_aggregation_empty_input(oracle, tpch):
+    op, types, dicts = scan(tpch, "lineitem", ["l_quantity"])
+    b = ExprBinder(types, dicts)
+    flt = b.bind(
+        Call("lt", (InputRef(0, types[0]), Literal(-1, T.decimal(12, 2))), T.BOOLEAN)
+    )
+    agg = HashAggregationOperator(
+        [],
+        [AggSpec("sum", 0, T.decimal(18, 2)), AggSpec("count_star", None, T.BIGINT)],
+        list(zip(types, dicts)),
+    )
+    rows = run([op, FilterProjectOperator(flt, [b.bind(InputRef(0, types[0]))]), agg])
+    assert rows == [[None, 0]]
+
+
+def test_inner_join(oracle, tpch):
+    bridge = JoinBridge()
+    bop, btypes, bdicts = scan(tpch, "customer", ["c_custkey", "c_mktsegment"])
+    build_sink = HashBuildSink(bridge, [0], list(zip(btypes, bdicts)))
+    Driver(Pipeline([bop, build_sink])).run()
+
+    pop, ptypes, pdicts = scan(tpch, "orders", ["o_custkey", "o_totalprice"])
+    join = LookupJoinOperator(bridge, [0], "inner", list(zip(ptypes, pdicts)))
+    rows = run([pop, join])
+    expected = sqlite_rows(
+        oracle,
+        "SELECT o_custkey, o_totalprice, c_custkey, c_mktsegment"
+        " FROM orders JOIN customer ON o_custkey = c_custkey",
+    )
+    assert_rows_match(rows, expected, ordered=False)
+
+
+def test_semi_anti_join(oracle, tpch):
+    for jt, sql in [
+        (
+            "semi",
+            "SELECT c_custkey FROM customer WHERE EXISTS"
+            " (SELECT 1 FROM orders WHERE o_custkey = c_custkey)",
+        ),
+        (
+            "anti",
+            "SELECT c_custkey FROM customer WHERE NOT EXISTS"
+            " (SELECT 1 FROM orders WHERE o_custkey = c_custkey)",
+        ),
+    ]:
+        bridge = JoinBridge()
+        bop, btypes, bdicts = scan(tpch, "orders", ["o_custkey"])
+        Driver(
+            Pipeline([bop, HashBuildSink(bridge, [0], list(zip(btypes, bdicts)))])
+        ).run()
+        pop, ptypes, pdicts = scan(tpch, "customer", ["c_custkey"])
+        join = LookupJoinOperator(bridge, [0], jt, list(zip(ptypes, pdicts)))
+        rows = run([pop, join])
+        assert_rows_match(rows, sqlite_rows(oracle, sql), ordered=False)
+
+
+def test_left_join(oracle, tpch):
+    bridge = JoinBridge()
+    bop, btypes, bdicts = scan(tpch, "orders", ["o_custkey", "o_totalprice"])
+    Driver(
+        Pipeline([bop, HashBuildSink(bridge, [0], list(zip(btypes, bdicts)))])
+    ).run()
+    pop, ptypes, pdicts = scan(tpch, "customer", ["c_custkey"])
+    join = LookupJoinOperator(bridge, [0], "left", list(zip(ptypes, pdicts)))
+    rows = run([pop, join])
+    expected = sqlite_rows(
+        oracle,
+        "SELECT c_custkey, o_custkey, o_totalprice FROM customer"
+        " LEFT JOIN orders ON o_custkey = c_custkey",
+    )
+    assert_rows_match(rows, expected, ordered=False)
+
+
+def test_join_residual_filter(oracle, tpch):
+    """Residual non-equi condition applied inside the join (Q21 pattern)."""
+    bridge = JoinBridge()
+    bop, btypes, bdicts = scan(tpch, "lineitem", ["l_orderkey", "l_suppkey"])
+    Driver(
+        Pipeline([bop, HashBuildSink(bridge, [0], list(zip(btypes, bdicts)))])
+    ).run()
+    pop, ptypes, pdicts = scan(tpch, "lineitem", ["l_orderkey", "l_suppkey"])
+    pair_types = ptypes + btypes
+    pair_dicts = pdicts + bdicts
+    rb = ExprBinder(pair_types, pair_dicts)
+    residual = rb.bind(
+        Call("ne", (InputRef(1, ptypes[1]), InputRef(3, btypes[1])), T.BOOLEAN)
+    )
+    join = LookupJoinOperator(
+        bridge, [0], "semi", list(zip(ptypes, pdicts)), residual=residual
+    )
+    rows = run([pop, join])
+    expected = sqlite_rows(
+        oracle,
+        "SELECT l1.l_orderkey, l1.l_suppkey FROM lineitem l1 WHERE EXISTS"
+        " (SELECT 1 FROM lineitem l2 WHERE l2.l_orderkey = l1.l_orderkey"
+        "  AND l2.l_suppkey <> l1.l_suppkey)",
+    )
+    assert_rows_match(rows, expected, ordered=False)
+
+
+def test_cross_join_scalar(oracle, tpch):
+    # scalar subquery: orders with o_totalprice > (SELECT AVG(o_totalprice)...)
+    sop, stypes, sdicts = scan(tpch, "orders", ["o_totalprice"])
+    agg = HashAggregationOperator(
+        [], [AggSpec("avg", 0, T.DOUBLE)], list(zip(stypes, sdicts))
+    )
+    bridge = JoinBridge()
+    Driver(
+        Pipeline([sop, agg, CrossJoinBuildSink(bridge, [(T.DOUBLE, None)])])
+    ).run()
+    pop, ptypes, pdicts = scan(tpch, "orders", ["o_orderkey", "o_totalprice"])
+    cross = CrossJoinOperator(bridge)
+    b = ExprBinder(ptypes + [T.DOUBLE], pdicts + [None])
+    flt = b.bind(Call("gt", (InputRef(1, ptypes[1]), InputRef(2, T.DOUBLE)), T.BOOLEAN))
+    rows = run([pop, cross, FilterProjectOperator(flt, [b.bind(InputRef(0, ptypes[0]))])])
+    expected = sqlite_rows(
+        oracle,
+        "SELECT o_orderkey FROM orders WHERE o_totalprice >"
+        " (SELECT AVG(o_totalprice) FROM orders)",
+    )
+    assert_rows_match(rows, expected, ordered=False)
+
+
+def test_topn_and_sort(oracle, tpch):
+    op, types, dicts = scan(tpch, "orders", ["o_orderkey", "o_totalprice"])
+    topn = TopNOperator(
+        [SortKey(1, descending=True), SortKey(0)], 10, list(zip(types, dicts))
+    )
+    rows = run([op, topn])
+    expected = sqlite_rows(
+        oracle,
+        "SELECT o_orderkey, o_totalprice FROM orders"
+        " ORDER BY o_totalprice DESC, o_orderkey LIMIT 10",
+    )
+    assert_rows_match(rows, expected, ordered=True)
+
+    op2, types2, dicts2 = scan(tpch, "customer", ["c_custkey", "c_mktsegment"])
+    sort = SortOperator([SortKey(1), SortKey(0, descending=True)], list(zip(types2, dicts2)))
+    rows2 = run([op2, sort])
+    expected2 = sqlite_rows(
+        oracle,
+        "SELECT c_custkey, c_mktsegment FROM customer"
+        " ORDER BY c_mktsegment, c_custkey DESC",
+    )
+    assert_rows_match(rows2, expected2, ordered=True)
+
+
+def test_limit(tpch):
+    op, types, dicts = scan(tpch, "orders", ["o_orderkey"], batch_rows=1000)
+    rows = run([op, LimitOperator(2500)])
+    assert len(rows) == 2500
+
+
+def test_q1_pipeline(oracle, tpch):
+    """Hand-built TPC-H Q1 — the minimum end-to-end slice of SURVEY §7.4
+    at the operator level (the SQL frontend repeats this from text)."""
+    cols = [
+        "l_returnflag", "l_linestatus", "l_quantity", "l_extendedprice",
+        "l_discount", "l_tax", "l_shipdate",
+    ]
+    op, types, dicts = scan(tpch, "lineitem", cols)
+    b = ExprBinder(types, dicts)
+    dec = T.decimal(12, 2)
+    one = Literal(1, T.decimal(12, 2))
+    flt = b.bind(
+        Call("le", (InputRef(6, T.DATE), Literal(epoch_days("1998-09-02"), T.DATE)), T.BOOLEAN)
+    )
+    disc_price = Call(
+        "mul",
+        (
+            InputRef(3, dec),
+            Call("sub", (one, InputRef(4, dec)), T.decimal(12, 2)),
+        ),
+        T.decimal(18, 4),
+    )
+    charge = Call(
+        "mul",
+        (disc_price, Call("add", (one, InputRef(5, dec)), T.decimal(12, 2))),
+        T.decimal(18, 6),
+    )
+    projections = [
+        b.bind(InputRef(0, types[0])),
+        b.bind(InputRef(1, types[1])),
+        b.bind(InputRef(2, dec)),
+        b.bind(InputRef(3, dec)),
+        b.bind(disc_price),
+        b.bind(charge),
+        b.bind(InputRef(4, dec)),
+    ]
+    proj_schema = [(p.type, p.dictionary) for p in projections]
+    agg = HashAggregationOperator(
+        [0, 1],
+        [
+            AggSpec("sum", 2, T.decimal(18, 2)),
+            AggSpec("sum", 3, T.decimal(18, 2)),
+            AggSpec("sum", 4, T.decimal(18, 4)),
+            AggSpec("sum", 5, T.decimal(18, 6)),
+            AggSpec("avg", 2, T.DOUBLE),
+            AggSpec("avg", 3, T.DOUBLE),
+            AggSpec("avg", 6, T.DOUBLE),
+            AggSpec("count_star", None, T.BIGINT),
+        ],
+        proj_schema,
+    )
+    agg_schema = [(types[0], dicts[0]), (types[1], dicts[1])] + [
+        (T.decimal(18, 2), None), (T.decimal(18, 2), None), (T.decimal(18, 4), None),
+        (T.decimal(18, 6), None), (T.DOUBLE, None), (T.DOUBLE, None),
+        (T.DOUBLE, None), (T.BIGINT, None),
+    ]
+    sort = SortOperator([SortKey(0), SortKey(1)], agg_schema)
+    rows = run([op, FilterProjectOperator(flt, projections), agg, sort])
+    expected = sqlite_rows(
+        oracle,
+        f"""
+        SELECT l_returnflag, l_linestatus,
+               ROUND(SUM(l_quantity), 2), ROUND(SUM(l_extendedprice), 2),
+               ROUND(SUM(l_extendedprice * (1 - l_discount)), 4),
+               ROUND(SUM(l_extendedprice * (1 - l_discount) * (1 + l_tax)), 6),
+               AVG(l_quantity), AVG(l_extendedprice), AVG(l_discount), COUNT(*)
+        FROM lineitem WHERE l_shipdate <= {epoch_days('1998-09-02')}
+        GROUP BY l_returnflag, l_linestatus
+        ORDER BY l_returnflag, l_linestatus
+        """,
+    )
+    assert_rows_match(rows, expected, ordered=True, abs_tol=1e-4)

@@ -306,3 +306,54 @@ class RelBatch:
             live = np.asarray(self.live)
         cols = [c.to_pylist(live=live) for c in self.columns]
         return [list(row) for row in zip(*cols)] if cols else []
+
+
+def unify_column_dicts(cols: Sequence[Column]) -> list:
+    """Remap a set of same-type string columns onto one merged dictionary
+    (no-op when dictionaries already agree, the table-stable fast path)."""
+    dicts = [c.dictionary for c in cols]
+    present = [d for d in dicts if d is not None]
+    if not present or all(d == present[0] for d in dicts if d is not None):
+        return list(cols)
+    merged = present[0]
+    for d in present[1:]:
+        merged, _, _ = Dictionary.unify(merged, d)
+    out = []
+    for c in cols:
+        if c.dictionary is None or c.dictionary == merged:
+            out.append(Column(c.type, c.data, c.valid, merged))
+            continue
+        remap = jnp.asarray(
+            [merged.code(v) for v in c.dictionary.values], dtype=jnp.int32
+        )
+        data = jnp.take(remap, jnp.clip(c.data, 0, max(len(c.dictionary) - 1, 0)))
+        out.append(Column(c.type, data, c.valid, merged))
+    return out
+
+
+def concat_batches(batches: Sequence["RelBatch"]) -> "RelBatch":
+    """Concatenate batches (PagesIndex-style consolidation —
+    main/operator/PagesIndex.java:80 addPage). Output capacity is the sum
+    of input capacities (already powers of two stay bucketed enough)."""
+    batches = list(batches)
+    if len(batches) == 1:
+        return batches[0]
+    width = batches[0].width
+    cols = []
+    for i in range(width):
+        parts = unify_column_dicts([b.columns[i] for b in batches])
+        data = jnp.concatenate([p.data for p in parts])
+        if any(p.valid is not None for p in parts):
+            valid = jnp.concatenate(
+                [
+                    p.valid
+                    if p.valid is not None
+                    else jnp.ones(p.data.shape[0], dtype=jnp.bool_)
+                    for p in parts
+                ]
+            )
+        else:
+            valid = None
+        cols.append(Column(parts[0].type, data, valid, parts[0].dictionary))
+    live = jnp.concatenate([b.live_mask() for b in batches])
+    return RelBatch(cols, live)

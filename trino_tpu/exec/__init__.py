@@ -1,0 +1,18 @@
+from trino_tpu.exec.driver import Driver, Pipeline, run_pipelines
+from trino_tpu.exec.operators import (
+    AggSpec,
+    CollectorSink,
+    CrossJoinBuildSink,
+    CrossJoinOperator,
+    FilterProjectOperator,
+    HashAggregationOperator,
+    HashBuildSink,
+    JoinBridge,
+    LimitOperator,
+    LookupJoinOperator,
+    Operator,
+    SortOperator,
+    TableScanOperator,
+    TopNOperator,
+    ValuesOperator,
+)

@@ -1,0 +1,70 @@
+"""Driver: the host loop moving device batches through an operator
+pipeline.
+
+Analogue of main/operator/Driver.java:65 (processInternal:371 — for each
+adjacent operator pair, page = current.getOutput(); next.addInput(page);
+finish cascade :417). TPU-first delta: the loop never touches data; it
+only launches jitted device programs and handles the (rare) host-sync
+points (join fan-out sizing, group-table growth). Trino's 1s-quantum
+cooperative scheduling is unnecessary single-pipeline; the multi-driver
+form arrives with the task runtime layer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Sequence
+
+from trino_tpu.exec.operators import Operator
+
+
+@dataclasses.dataclass
+class Pipeline:
+    """An ordered operator chain ending in a sink. Pipelines are executed
+    in dependency order (build pipelines before their probe pipelines —
+    the ordering Trino derives from LocalExecutionPlanner's pipeline
+    DAG)."""
+
+    operators: List[Operator]
+
+
+class Driver:
+    """Runs one pipeline to completion (Driver.processInternal analogue)."""
+
+    def __init__(self, pipeline: Pipeline):
+        self.ops = pipeline.operators
+        self._finish_signalled = [False] * len(self.ops)
+
+    def run(self) -> None:
+        ops = self.ops
+        n = len(ops)
+        while not ops[-1].is_finished():
+            progressed = False
+            for i in range(n - 1):
+                cur, nxt = ops[i], ops[i + 1]
+                if nxt.is_finished():
+                    continue
+                # move as many batches as the pair allows (Driver.java:389)
+                while nxt.needs_input():
+                    out = cur.get_output()
+                    if out is None:
+                        break
+                    nxt.add_input(out)
+                    progressed = True
+                # finish cascade (Driver.java:417)
+                if cur.is_finished() and not self._finish_signalled[i + 1]:
+                    nxt.finish()
+                    self._finish_signalled[i + 1] = True
+                    progressed = True
+            if not progressed and not ops[-1].is_finished():
+                raise RuntimeError(
+                    "pipeline stalled: "
+                    + ", ".join(
+                        f"{type(o).__name__}(fin={o.is_finished()})" for o in ops
+                    )
+                )
+
+
+def run_pipelines(pipelines: Sequence[Pipeline]) -> None:
+    for p in pipelines:
+        Driver(p).run()

@@ -1,0 +1,774 @@
+"""Physical operators.
+
+Analogue of Trino's operator layer (main/operator/Operator.java:21-96 —
+needsInput/addInput/getOutput/finish/isBlocked; SURVEY.md §2.6), pulled
+batch-at-a-time by the host Driver while all data-parallel work runs as
+jit-compiled XLA programs over RelBatch pytrees. TPU-first deltas:
+
+- Operators never loop over rows; each add_input/get_output launches a
+  fixed-shape device program (the analogue of the JIT'd PageProcessor /
+  GroupByHash / PagesHash inner loops, compiled by jax.jit instead of
+  airlift-bytecode — SURVEY.md §2.9).
+- Filters only flip `live` mask bits; dead rows ride along until an
+  explicit compact (static shapes).
+- Dynamic result sizes (join fan-out, group counts) are handled by the
+  two-phase count/expand pattern with host-chosen bucketed capacities.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.block import (
+    Column,
+    Dictionary,
+    RelBatch,
+    bucket_capacity,
+    concat_batches,
+)
+from trino_tpu.expr.compile import Bound
+from trino_tpu.ops import groupby as G
+from trino_tpu.ops import join as J
+from trino_tpu.ops.sort import SortKey, sort_order
+
+
+class Operator:
+    """Pull/push contract (main/operator/Operator.java:21)."""
+
+    def needs_input(self) -> bool:
+        return not self._finishing
+
+    def add_input(self, batch: RelBatch) -> None:
+        raise NotImplementedError
+
+    def get_output(self) -> Optional[RelBatch]:
+        return None
+
+    def finish(self) -> None:
+        """No more input will arrive (Operator.finish)."""
+        self._finishing = True
+
+    def is_finished(self) -> bool:
+        raise NotImplementedError
+
+    _finishing = False
+
+
+def empty_batch(schema: Sequence[Tuple[T.DataType, Optional[Dictionary]]],
+                capacity: int = 16) -> RelBatch:
+    cols = [
+        Column(t, jnp.zeros(capacity, dtype=t.dtype), None, d) for t, d in schema
+    ]
+    return RelBatch(cols, jnp.zeros(capacity, dtype=jnp.bool_))
+
+
+def batch_schema(batch: RelBatch) -> List[Tuple[T.DataType, Optional[Dictionary]]]:
+    return [(c.type, c.dictionary) for c in batch.columns]
+
+
+# ---------------------------------------------------------------------------
+# Sources
+# ---------------------------------------------------------------------------
+
+
+class TableScanOperator(Operator):
+    """Pulls batches from a ConnectorPageSource over a list of splits
+    (TableScanOperator.java:47)."""
+
+    def __init__(self, page_source, splits, columns: Sequence[str], batch_rows: int):
+        self._iters = iter(
+            batch
+            for split in splits
+            for batch in page_source.batches(split, columns, batch_rows)
+        )
+        self._done = False
+
+    def needs_input(self) -> bool:
+        return False
+
+    def get_output(self) -> Optional[RelBatch]:
+        if self._done:
+            return None
+        nxt = next(self._iters, None)
+        if nxt is None:
+            self._done = True
+            return None
+        return nxt
+
+    def is_finished(self) -> bool:
+        return self._done
+
+
+class ValuesOperator(Operator):
+    """Emits a fixed list of batches (ValuesOperator.java)."""
+
+    def __init__(self, batches: Sequence[RelBatch]):
+        self._batches = list(batches)
+
+    def needs_input(self) -> bool:
+        return False
+
+    def get_output(self) -> Optional[RelBatch]:
+        if self._batches:
+            return self._batches.pop(0)
+        return None
+
+    def is_finished(self) -> bool:
+        return not self._batches
+
+
+# ---------------------------------------------------------------------------
+# Filter + project
+# ---------------------------------------------------------------------------
+
+
+class FilterProjectOperator(Operator):
+    """Bound filter/projections fused into one jitted device program —
+    the FilterAndProjectOperator + PageProcessor analogue
+    (main/operator/FilterAndProjectOperator.java:40, project/PageProcessor.java:53)."""
+
+    def __init__(self, filter_bound: Optional[Bound], projections: Sequence[Bound]):
+        self._out: Optional[RelBatch] = None
+        self._done = False
+        projections = list(projections)
+
+        def fn(batch: RelBatch) -> RelBatch:
+            cols = [c.data for c in batch.columns]
+            valids = [c.valid for c in batch.columns]
+            live = batch.live
+            if filter_bound is not None:
+                d, v = filter_bound.fn(cols, valids)
+                keep = d if v is None else (d & v)
+                live = keep if live is None else (live & keep)
+            out_cols = []
+            for b in projections:
+                data, valid = b.fn(cols, valids)
+                out_cols.append(Column(b.type, data, valid, b.dictionary))
+            return RelBatch(out_cols, live)
+
+        self._fn = jax.jit(fn)
+
+    def needs_input(self) -> bool:
+        return self._out is None and not self._finishing
+
+    def add_input(self, batch: RelBatch) -> None:
+        self._out = self._fn(batch)
+
+    def get_output(self) -> Optional[RelBatch]:
+        out, self._out = self._out, None
+        return out
+
+    def is_finished(self) -> bool:
+        return self._finishing and self._out is None
+
+
+# ---------------------------------------------------------------------------
+# Limit
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def _limit_batch(batch: RelBatch, remaining: jnp.ndarray):
+    live = batch.live_mask()
+    rank = jnp.cumsum(live.astype(jnp.int64))  # 1-based among live rows
+    keep = live & (rank <= remaining)
+    taken = jnp.minimum(rank[-1] if live.shape[0] else jnp.int64(0), remaining)
+    return RelBatch(batch.columns, keep), taken
+
+
+class LimitOperator(Operator):
+    """LIMIT n (LimitOperator.java): masks rows past the remaining count."""
+
+    def __init__(self, n: int):
+        self._remaining = n
+        self._out: Optional[RelBatch] = None
+
+    def needs_input(self) -> bool:
+        return self._out is None and self._remaining > 0 and not self._finishing
+
+    def add_input(self, batch: RelBatch) -> None:
+        out, taken = _limit_batch(batch, jnp.int64(self._remaining))
+        self._remaining -= int(taken)
+        self._out = out
+
+    def get_output(self) -> Optional[RelBatch]:
+        out, self._out = self._out, None
+        return out
+
+    def is_finished(self) -> bool:
+        return self._out is None and (self._finishing or self._remaining <= 0)
+
+
+# ---------------------------------------------------------------------------
+# Sort / TopN
+# ---------------------------------------------------------------------------
+
+
+def _apply_sort(batch: RelBatch, keys: Sequence[SortKey]) -> jnp.ndarray:
+    return sort_order(
+        [batch.columns[k.channel].data for k in keys],
+        [batch.columns[k.channel].valid for k in keys],
+        [k.descending for k in keys],
+        [k.nulls_first for k in keys],
+        batch.live,
+    )
+
+
+@jax.jit
+def _gather_sorted(batch: RelBatch, order: jnp.ndarray):
+    n_live = jnp.sum(batch.live_mask())
+    live = jnp.arange(order.shape[0]) < n_live
+    return batch.gather(order, live)
+
+
+class SortOperator(Operator):
+    """Full ORDER BY: consolidate + one device sort at finish
+    (OrderByOperator.java:44; comparator chains become stable argsorts)."""
+
+    def __init__(self, keys: Sequence[SortKey],
+                 input_schema: Sequence[Tuple[T.DataType, Optional[Dictionary]]]):
+        self._keys = list(keys)
+        self._schema = list(input_schema)
+        self._inputs: List[RelBatch] = []
+        self._out: Optional[RelBatch] = None
+        self._emitted = False
+
+    def add_input(self, batch: RelBatch) -> None:
+        self._inputs.append(batch)
+
+    def finish(self) -> None:
+        if self._finishing:
+            return
+        self._finishing = True
+        batches = self._inputs or [empty_batch(self._schema)]
+        merged = concat_batches(batches)
+        order = _apply_sort(merged, self._keys)
+        self._out = _gather_sorted(merged, order)
+        self._inputs = []
+
+    def get_output(self) -> Optional[RelBatch]:
+        out, self._out = self._out, None
+        if out is not None:
+            self._emitted = True
+        return out
+
+    def is_finished(self) -> bool:
+        return self._finishing and self._out is None
+
+
+class TopNOperator(Operator):
+    """ORDER BY + LIMIT n with a bounded device reservoir
+    (TopNOperator.java:35)."""
+
+    def __init__(self, keys: Sequence[SortKey], n: int,
+                 input_schema: Sequence[Tuple[T.DataType, Optional[Dictionary]]]):
+        self._keys = list(keys)
+        self._n = n
+        self._schema = list(input_schema)
+        self._reservoir: Optional[RelBatch] = None
+        self._out: Optional[RelBatch] = None
+
+    def add_input(self, batch: RelBatch) -> None:
+        merged = (
+            batch
+            if self._reservoir is None
+            else concat_batches([self._reservoir, batch])
+        )
+        order = _apply_sort(merged, self._keys)
+        cap = bucket_capacity(min(self._n, merged.capacity))
+        top = order[:cap]
+        n_live = jnp.minimum(jnp.sum(merged.live_mask()), self._n)
+        live = jnp.arange(cap) < n_live
+        self._reservoir = merged.gather(top, live)
+
+    def finish(self) -> None:
+        if self._finishing:
+            return
+        self._finishing = True
+        self._out = (
+            self._reservoir
+            if self._reservoir is not None
+            else empty_batch(self._schema)
+        )
+
+    def get_output(self) -> Optional[RelBatch]:
+        out, self._out = self._out, None
+        return out
+
+    def is_finished(self) -> bool:
+        return self._finishing and self._out is None
+
+
+# ---------------------------------------------------------------------------
+# Hash aggregation
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class AggSpec:
+    """One aggregate: kind in {sum,count,count_star,avg,min,max,any},
+    arg_channel indexes the operator's input (None for count_star),
+    out_type is the SQL result type."""
+
+    kind: str
+    arg_channel: Optional[int]
+    out_type: T.DataType
+    distinct: bool = False
+
+
+def _agg_state_init(spec: AggSpec, arg_dtype, capacity: int):
+    """(value_state, count_state) arrays of shape (capacity,)."""
+    if spec.kind in ("count", "count_star"):
+        return (jnp.zeros(capacity, dtype=jnp.int64),)
+    if spec.kind in ("sum", "avg"):
+        acc_dt = jnp.float64 if np.issubdtype(arg_dtype, np.floating) else jnp.int64
+        return (
+            jnp.zeros(capacity, dtype=acc_dt),
+            jnp.zeros(capacity, dtype=jnp.int64),
+        )
+    if spec.kind in ("min", "max"):
+        if np.issubdtype(arg_dtype, np.floating):
+            extreme = jnp.inf if spec.kind == "min" else -jnp.inf
+        elif arg_dtype == np.bool_:
+            extreme = True if spec.kind == "min" else False
+        else:
+            info = np.iinfo(arg_dtype)
+            extreme = info.max if spec.kind == "min" else info.min
+        return (
+            jnp.full(capacity, extreme, dtype=arg_dtype),
+            jnp.zeros(capacity, dtype=jnp.int64),
+        )
+    if spec.kind == "any":
+        return (
+            jnp.zeros(capacity, dtype=arg_dtype),
+            jnp.zeros(capacity, dtype=jnp.int64),
+        )
+    raise NotImplementedError(spec.kind)
+
+
+def _agg_state_update(spec: AggSpec, state, gid, data, valid, live, capacity):
+    """Scatter one batch into the running state. gid == capacity drops."""
+    weight = live if valid is None else (live & valid)
+    idx = jnp.where(weight, gid, capacity)
+    if spec.kind in ("count", "count_star"):
+        (cnt,) = state
+        return (cnt.at[idx].add(1, mode="drop"),)
+    if spec.kind in ("sum", "avg"):
+        acc, cnt = state
+        return (
+            acc.at[idx].add(data.astype(acc.dtype), mode="drop"),
+            cnt.at[idx].add(1, mode="drop"),
+        )
+    if spec.kind in ("min", "max"):
+        acc, cnt = state
+        op = acc.at[idx].min if spec.kind == "min" else acc.at[idx].max
+        return (op(data, mode="drop"), cnt.at[idx].add(1, mode="drop"))
+    if spec.kind == "any":
+        acc, cnt = state
+        first = cnt == 0
+        upd = acc.at[idx].set(data, mode="drop")
+        return (jnp.where(first, upd, acc), cnt.at[idx].add(1, mode="drop"))
+    raise NotImplementedError(spec.kind)
+
+
+def _agg_state_migrate(state, remap, new_capacity):
+    """Move accumulator state through a table rebuild: new[remap[i]] = old[i]."""
+    out = []
+    for arr in state:
+        if np.issubdtype(np.dtype(arr.dtype), np.floating):
+            fresh = jnp.zeros(new_capacity, dtype=arr.dtype)
+        else:
+            fresh = jnp.zeros(new_capacity, dtype=arr.dtype)
+        out.append(fresh.at[remap].set(arr, mode="drop"))
+    return tuple(out)
+
+
+def _agg_output(spec: AggSpec, state, arg_type: Optional[T.DataType],
+                arg_dict: Optional[Dictionary]) -> Column:
+    """Finalize a state into the SQL result column. Decimal accumulators
+    hold scaled int64 at the ARG's scale; rescale to the output type."""
+    out_t = spec.out_type
+    if spec.kind in ("count", "count_star"):
+        (cnt,) = state
+        return Column(out_t, cnt.astype(jnp.int64), None, None)
+    acc, cnt = state
+    has = cnt > 0
+    arg_sf = (
+        T.decimal_scale_factor(arg_type)
+        if arg_type is not None and arg_type.is_decimal
+        else 1
+    )
+    out_sf = T.decimal_scale_factor(out_t) if out_t.is_decimal else None
+    if spec.kind == "sum":
+        if out_t.is_floating:
+            return Column(out_t, acc.astype(out_t.dtype) / arg_sf, has, None)
+        if out_sf is not None and out_sf != arg_sf:
+            acc = acc * (out_sf // arg_sf) if out_sf > arg_sf else acc // (arg_sf // out_sf)
+        return Column(out_t, acc.astype(out_t.dtype), has, None)
+    if spec.kind == "avg":
+        q = acc.astype(jnp.float64) / jnp.maximum(cnt, 1)
+        if out_t.is_floating:
+            return Column(out_t, (q / arg_sf).astype(out_t.dtype), has, None)
+        # decimal average: rescale to the output scale, round half away
+        q = q * (out_sf / arg_sf)
+        data = (jnp.sign(q) * jnp.floor(jnp.abs(q) + 0.5)).astype(out_t.dtype)
+        return Column(out_t, data, has, None)
+    if spec.kind in ("min", "max", "any"):
+        safe = jnp.where(has, acc, jnp.zeros((), dtype=acc.dtype))
+        if out_t.is_floating and arg_sf != 1:
+            return Column(out_t, safe.astype(out_t.dtype) / arg_sf, has, None)
+        return Column(out_t, safe.astype(out_t.dtype), has, arg_dict)
+    raise NotImplementedError(spec.kind)
+
+
+class HashAggregationOperator(Operator):
+    """GROUP BY + aggregates over the streaming group table
+    (HashAggregationOperator.java:53 + GroupByHash; rebuild-on-overflow
+    replaces tryRehash). `group_channels` select the key columns;
+    aggregates read their arg channels. Output schema =
+    [group keys..., aggregate results...]."""
+
+    def __init__(
+        self,
+        group_channels: Sequence[int],
+        aggregates: Sequence[AggSpec],
+        input_schema: Sequence[Tuple[T.DataType, Optional[Dictionary]]],
+        initial_capacity: int = 1024,
+    ):
+        self._group_channels = list(group_channels)
+        self._aggs = list(aggregates)
+        self._schema = list(input_schema)
+        self._global = not self._group_channels
+        cap = 1 if self._global else initial_capacity
+        self._capacity = cap
+        key_dtypes = [self._schema[c][0].dtype for c in self._group_channels]
+        self._table = G.new_group_table(key_dtypes, cap) if not self._global else None
+        self._states = [
+            _agg_state_init(
+                a,
+                self._schema[a.arg_channel][0].dtype
+                if a.arg_channel is not None
+                else np.int64,
+                cap,
+            )
+            for a in self._aggs
+        ]
+        self._out: Optional[RelBatch] = None
+        self._seen_any = False
+
+        @jax.jit
+        def _update_states(states, gid, batch: RelBatch, capacity_arr):
+            capacity = capacity_arr.shape[0]
+            live = batch.live_mask()
+            new_states = []
+            for a, st in zip(self._aggs, states):
+                if a.arg_channel is None:
+                    data, valid = jnp.zeros_like(live, dtype=jnp.int64), None
+                else:
+                    col = batch.columns[a.arg_channel]
+                    data, valid = col.data, col.valid
+                new_states.append(
+                    _agg_state_update(a, st, gid, data, valid, live, capacity)
+                )
+            return new_states
+
+        self._update_states = _update_states
+
+    def add_input(self, batch: RelBatch) -> None:
+        self._seen_any = True
+        if self._global:
+            gid = jnp.where(batch.live_mask(), 0, 1).astype(jnp.int32)
+        else:
+            keys = [batch.columns[c].data for c in self._group_channels]
+            valids = [batch.columns[c].valid_mask() for c in self._group_channels]
+            gid, table, overflowed = G.insert_group_ids(
+                self._table, keys, valids, batch.live_mask()
+            )
+            self._table = table
+            if bool(overflowed):
+                self._grow(self._capacity * 2)
+                # retry against the grown table (keys inserted by the
+                # failed round carry zero state, so re-inserting is safe:
+                # accumulation below runs exactly once)
+                gid, self._table, overflowed = G.insert_group_ids(
+                    self._table, keys, valids, batch.live_mask()
+                )
+                assert not bool(overflowed)
+            # keep load factor below ~62% so probe chains stay short
+            elif int(self._table.num_groups()) * 8 > self._capacity * 5:
+                self._grow_after = True
+        self._states = self._update_states(
+            self._states, gid, batch, jnp.zeros(self._capacity)
+        )
+        if getattr(self, "_grow_after", False):
+            self._grow_after = False
+            self._grow(self._capacity * 2)
+
+    def _grow(self, new_capacity: int) -> None:
+        self._table, remap = G.grow_table(self._table, new_capacity)
+        self._states = [
+            _agg_state_migrate(st, remap, new_capacity) for st in self._states
+        ]
+        self._capacity = new_capacity
+
+    def finish(self) -> None:
+        if self._finishing:
+            return
+        self._finishing = True
+        cols: List[Column] = []
+        if self._global:
+            live = jnp.ones(1, dtype=jnp.bool_)
+        else:
+            live = self._table.slot_used
+            for ch, sk, sv in zip(
+                self._group_channels, self._table.slot_keys, self._table.slot_valids
+            ):
+                t, d = self._schema[ch]
+                cols.append(Column(t, sk, sv, d))
+        for a, st in zip(self._aggs, self._states):
+            arg_t, arg_d = (
+                self._schema[a.arg_channel] if a.arg_channel is not None else (None, None)
+            )
+            cols.append(_agg_output(a, st, arg_t, arg_d))
+        self._out = RelBatch(cols, live)
+
+    def get_output(self) -> Optional[RelBatch]:
+        out, self._out = self._out, None
+        return out
+
+    def is_finished(self) -> bool:
+        return self._finishing and self._out is None
+
+
+# ---------------------------------------------------------------------------
+# Hash join
+# ---------------------------------------------------------------------------
+
+
+class JoinBridge:
+    """Build->probe handoff (PartitionedLookupSourceFactory analogue,
+    join/PartitionedLookupSourceFactory.java:56). The planner runs the
+    build pipeline to completion before starting the probe pipeline."""
+
+    def __init__(self):
+        self.lookup_source: Optional[J.LookupSource] = None
+        self.build_batch: Optional[RelBatch] = None
+
+
+class HashBuildSink(Operator):
+    """Consumes the build side, consolidates, builds the LookupSource
+    (HashBuilderOperator.java:58 — one sort instead of row inserts)."""
+
+    def __init__(self, bridge: JoinBridge, key_channels: Sequence[int],
+                 input_schema: Sequence[Tuple[T.DataType, Optional[Dictionary]]]):
+        self._bridge = bridge
+        self._keys = list(key_channels)
+        self._schema = list(input_schema)
+        self._inputs: List[RelBatch] = []
+
+    def add_input(self, batch: RelBatch) -> None:
+        self._inputs.append(batch)
+
+    def finish(self) -> None:
+        if self._finishing:
+            return
+        self._finishing = True
+        merged = concat_batches(self._inputs or [empty_batch(self._schema)])
+        keys = [merged.columns[c].data for c in self._keys]
+        valids = [merged.columns[c].valid_mask() for c in self._keys]
+        self._bridge.lookup_source = J.build_lookup(keys, valids, merged.live_mask())
+        self._bridge.build_batch = merged
+        self._inputs = []
+
+    def get_output(self) -> Optional[RelBatch]:
+        return None
+
+    def is_finished(self) -> bool:
+        return self._finishing
+
+
+class LookupJoinOperator(Operator):
+    """Probe side (LookupJoinOperator.java:36). join_type in
+    {inner, left, semi, anti}. Output schema for inner/left =
+    [probe columns..., build columns...]; for semi/anti = probe columns.
+
+    `residual` (optional Bound over the concatenated pair schema) is
+    evaluated on candidate pairs BEFORE match flags are computed, which
+    is what makes filtered semi/anti joins (Q21-style `l2.suppkey <>
+    l1.suppkey`) correct.
+    """
+
+    def __init__(
+        self,
+        bridge: JoinBridge,
+        key_channels: Sequence[int],
+        join_type: str,
+        probe_schema: Sequence[Tuple[T.DataType, Optional[Dictionary]]],
+        residual: Optional[Bound] = None,
+    ):
+        self._bridge = bridge
+        self._keys = list(key_channels)
+        self._type = join_type
+        self._probe_schema = list(probe_schema)
+        self._residual = residual
+        self._outputs: List[RelBatch] = []
+
+    def needs_input(self) -> bool:
+        return not self._outputs and not self._finishing
+
+    def _pair_batch(self, probe: RelBatch, pi, bi, ok) -> RelBatch:
+        build = self._bridge.build_batch
+        cols = [c.gather(pi) for c in probe.columns]
+        cols += [c.gather(bi) for c in build.columns]
+        return RelBatch(cols, ok)
+
+    def add_input(self, probe: RelBatch) -> None:
+        ls = self._bridge.lookup_source
+        keys = [probe.columns[c].data for c in self._keys]
+        valids = [probe.columns[c].valid_mask() for c in self._keys]
+        live = probe.live_mask()
+        lo, counts, total = J.probe_counts(ls, keys, valids, live)
+        total = int(total)
+        out_cap = bucket_capacity(max(total, 1))
+        pi, bi, ok = J.expand_matches(ls, keys, valids, lo, counts, out_cap)
+        pairs = self._pair_batch(probe, pi, bi, ok)
+        if self._residual is not None:
+            cols = [c.data for c in pairs.columns]
+            vs = [c.valid for c in pairs.columns]
+            d, v = self._residual.fn(cols, vs)
+            keep = d if v is None else (d & v)
+            ok = ok & keep
+            pairs = RelBatch(pairs.columns, ok)
+        if self._type == "inner":
+            self._outputs.append(pairs)
+            return
+        matched = J.probe_matched_flags(probe.capacity, pi, ok)
+        if self._type == "semi":
+            self._outputs.append(probe.mask(matched))
+            return
+        if self._type == "anti":
+            self._outputs.append(probe.mask(~matched))
+            return
+        if self._type == "left":
+            self._outputs.append(pairs)
+            # unmatched probe rows keep probe columns, NULL build columns
+            build = self._bridge.build_batch
+            nulls = [
+                Column(
+                    c.type,
+                    jnp.zeros(probe.capacity, dtype=c.type.dtype),
+                    jnp.zeros(probe.capacity, dtype=jnp.bool_),
+                    c.dictionary,
+                )
+                for c in build.columns
+            ]
+            self._outputs.append(
+                RelBatch(list(probe.columns) + nulls, live & ~matched)
+            )
+            return
+        raise NotImplementedError(self._type)
+
+    def get_output(self) -> Optional[RelBatch]:
+        if self._outputs:
+            return self._outputs.pop(0)
+        return None
+
+    def is_finished(self) -> bool:
+        return self._finishing and not self._outputs
+
+
+# ---------------------------------------------------------------------------
+# Cross join (NestedLoopJoinOperator.java analogue)
+# ---------------------------------------------------------------------------
+
+
+class CrossJoinBuildSink(Operator):
+    """Collects the (small) build side of a cross join."""
+
+    def __init__(self, bridge: JoinBridge,
+                 input_schema: Sequence[Tuple[T.DataType, Optional[Dictionary]]]):
+        self._bridge = bridge
+        self._schema = list(input_schema)
+        self._inputs: List[RelBatch] = []
+
+    def add_input(self, batch: RelBatch) -> None:
+        self._inputs.append(batch)
+
+    def finish(self) -> None:
+        if self._finishing:
+            return
+        self._finishing = True
+        merged = concat_batches(self._inputs or [empty_batch(self._schema)]).compact()
+        self._bridge.build_batch = merged
+        self._inputs = []
+
+    def is_finished(self) -> bool:
+        return self._finishing
+
+
+class CrossJoinOperator(Operator):
+    """Probe x build cartesian product; build side expected small
+    (scalar-subquery bridges are 1 row)."""
+
+    def __init__(self, bridge: JoinBridge):
+        self._bridge = bridge
+        self._outputs: List[RelBatch] = []
+
+    def needs_input(self) -> bool:
+        return not self._outputs and not self._finishing
+
+    def add_input(self, probe: RelBatch) -> None:
+        build = self._bridge.build_batch
+        n_build = build.row_count()
+        for b in range(n_build):
+            bcols = [
+                Column(
+                    c.type,
+                    jnp.broadcast_to(c.data[b], (probe.capacity,)),
+                    None
+                    if c.valid is None
+                    else jnp.broadcast_to(c.valid[b], (probe.capacity,)),
+                    c.dictionary,
+                )
+                for c in build.columns
+            ]
+            self._outputs.append(RelBatch(list(probe.columns) + bcols, probe.live))
+
+    def get_output(self) -> Optional[RelBatch]:
+        if self._outputs:
+            return self._outputs.pop(0)
+        return None
+
+    def is_finished(self) -> bool:
+        return self._finishing and not self._outputs
+
+
+# ---------------------------------------------------------------------------
+# Sink
+# ---------------------------------------------------------------------------
+
+
+class CollectorSink(Operator):
+    """Terminal sink gathering result batches (the coordinator-protocol
+    Query.getNextResult analogue for the in-process runner)."""
+
+    def __init__(self):
+        self.batches: List[RelBatch] = []
+
+    def add_input(self, batch: RelBatch) -> None:
+        self.batches.append(batch)
+
+    def is_finished(self) -> bool:
+        return self._finishing
+
+    def rows(self) -> List[list]:
+        out = []
+        for b in self.batches:
+            out.extend(b.to_pylists())
+        return out

@@ -66,30 +66,41 @@ def _keys_equal(a_keys, a_valids, b_keys, b_valids):
     return eq
 
 
-@partial(jax.jit, static_argnames=("capacity",), donate_argnums=())
-def assign_group_ids(
+def new_group_table(key_dtypes: Sequence, capacity: int) -> GroupTable:
+    """Fresh empty table (host helper for streaming aggregation)."""
+    assert capacity & (capacity - 1) == 0
+    return GroupTable(
+        [jnp.zeros(capacity, dtype=dt) for dt in key_dtypes],
+        [jnp.zeros(capacity, dtype=jnp.bool_) for _ in key_dtypes],
+        jnp.zeros(capacity, dtype=jnp.bool_),
+    )
+
+
+@jax.jit
+def insert_group_ids(
+    table: GroupTable,
     keys: Sequence[jnp.ndarray],
     valids: Sequence[jnp.ndarray],
     mask: jnp.ndarray,
-    capacity: int,
 ):
-    """Map each live row to a group id in [0, capacity).
+    """Map each live row to a group id in [0, C), inserting new groups
+    into `table` (streaming multi-batch form of assign_group_ids — the
+    putIfAbsent analogue, MultiChannelGroupByHash.java:264).
 
-    Returns (group_ids, table, overflowed). Dead rows get id = capacity
+    Returns (group_ids, table', overflowed). Dead rows get id = C
     (callers scatter with mode='drop'). `overflowed` is True if the
     table filled up — host rebuilds at 2x capacity (rehash analogue).
     """
-    assert capacity & (capacity - 1) == 0
+    C = table.capacity
     n = keys[0].shape[0]
-    C = capacity
     keys = [k for k in keys]
     valids = [v for v in valids]
 
     h = (hash32(keys, valids) & jnp.uint32(C - 1)).astype(jnp.int32)
 
-    slot_keys = [jnp.zeros(C, dtype=k.dtype) for k in keys]
-    slot_valids = [jnp.zeros(C, dtype=jnp.bool_) for _ in keys]
-    slot_used = jnp.zeros(C, dtype=jnp.bool_)
+    slot_keys = list(table.slot_keys)
+    slot_valids = list(table.slot_valids)
+    slot_used = table.slot_used
     gid = jnp.where(mask, -1, C).astype(jnp.int32)
     probe = jnp.zeros(n, dtype=jnp.int32)
     row_id = jnp.arange(n, dtype=jnp.int32)
@@ -129,6 +140,33 @@ def assign_group_ids(
     overflowed = jnp.any(gid < 0)
     gid = jnp.where(gid < 0, C, gid)
     return gid, GroupTable(slot_keys, slot_valids, slot_used), overflowed
+
+
+@partial(jax.jit, static_argnames=("capacity",))
+def assign_group_ids(
+    keys: Sequence[jnp.ndarray],
+    valids: Sequence[jnp.ndarray],
+    mask: jnp.ndarray,
+    capacity: int,
+):
+    """One-shot form: insert a single batch into a fresh table."""
+    table = new_group_table([k.dtype for k in keys], capacity)
+    return insert_group_ids(table, keys, valids, mask)
+
+
+def grow_table(table: GroupTable, new_capacity: int):
+    """Rebuild at a larger capacity — the tryRehash analogue
+    (MultiChannelGroupByHash.java:350). Returns (new_table, remap) where
+    remap[old_slot] = new group id (or new_capacity for unused slots) so
+    callers migrate accumulator state with a scatter."""
+    remap, table2, overflowed = insert_group_ids(
+        new_group_table([k.dtype for k in table.slot_keys], new_capacity),
+        table.slot_keys,
+        table.slot_valids,
+        table.slot_used,
+    )
+    assert not bool(overflowed)
+    return table2, remap
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,292 @@
+"""SQL AST.
+
+Analogue of trino-parser's tree package (261 node classes in
+parser/sql/tree/ — SURVEY.md §2.1), reduced to the analytic-SQL subset
+the engine executes (TPC-H/TPC-DS-shaped queries first). Nodes are
+frozen dataclasses; the analyzer never mutates them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+
+class Node:
+    pass
+
+
+class Expression(Node):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# literals & leaves
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Identifier(Expression):
+    """Possibly-qualified name: parts = ("l", "quantity") for l.quantity."""
+
+    parts: Tuple[str, ...]
+
+    def __str__(self):
+        return ".".join(self.parts)
+
+
+@dataclasses.dataclass(frozen=True)
+class NumberLiteral(Expression):
+    text: str  # original text; analyzer decides integer vs decimal vs double
+
+
+@dataclasses.dataclass(frozen=True)
+class StringLiteral(Expression):
+    value: str
+
+
+@dataclasses.dataclass(frozen=True)
+class BooleanLiteral(Expression):
+    value: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class NullLiteral(Expression):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class DateLiteral(Expression):
+    value: str  # 'YYYY-MM-DD'
+
+
+@dataclasses.dataclass(frozen=True)
+class TimestampLiteral(Expression):
+    value: str
+
+
+@dataclasses.dataclass(frozen=True)
+class IntervalLiteral(Expression):
+    value: str
+    unit: str  # day/month/year
+    sign: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class Star(Expression):
+    """`*` or `alias.*` in a select list or count(*)."""
+
+    qualifier: Optional[str] = None
+
+
+# ---------------------------------------------------------------------------
+# compound expressions
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class BinaryOp(Expression):
+    op: str  # and or + - * / % = <> < <= > >=
+    left: Expression
+    right: Expression
+
+
+@dataclasses.dataclass(frozen=True)
+class UnaryOp(Expression):
+    op: str  # not, -, +
+    operand: Expression
+
+
+@dataclasses.dataclass(frozen=True)
+class IsNullPredicate(Expression):
+    operand: Expression
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Between(Expression):
+    value: Expression
+    low: Expression
+    high: Expression
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class InList(Expression):
+    value: Expression
+    options: Tuple[Expression, ...]
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class InSubquery(Expression):
+    value: Expression
+    query: "Query"
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Exists(Expression):
+    query: "Query"
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalarSubquery(Expression):
+    query: "Query"
+
+
+@dataclasses.dataclass(frozen=True)
+class Like(Expression):
+    value: Expression
+    pattern: Expression
+    escape: Optional[Expression] = None
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class FunctionCall(Expression):
+    name: str
+    args: Tuple[Expression, ...]
+    distinct: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Extract(Expression):
+    field: str  # year/month/day
+    operand: Expression
+
+
+@dataclasses.dataclass(frozen=True)
+class TypeName(Node):
+    name: str
+    params: Tuple[int, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class Cast(Expression):
+    operand: Expression
+    target: TypeName
+
+
+@dataclasses.dataclass(frozen=True)
+class WhenClause(Node):
+    condition: Expression
+    result: Expression
+
+
+@dataclasses.dataclass(frozen=True)
+class Case(Expression):
+    """Searched or simple CASE (operand set for the simple form)."""
+
+    operand: Optional[Expression]
+    whens: Tuple[WhenClause, ...]
+    default: Optional[Expression]
+
+
+# ---------------------------------------------------------------------------
+# relations
+# ---------------------------------------------------------------------------
+
+
+class Relation(Node):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class TableRef(Relation):
+    """catalog.schema.table with optional alias."""
+
+    name: Tuple[str, ...]
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class SubqueryRelation(Relation):
+    query: "Query"
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Join(Relation):
+    kind: str  # inner/left/right/full/cross
+    left: Relation
+    right: Relation
+    condition: Optional[Expression] = None  # ON expr; None for CROSS
+    using: Tuple[str, ...] = ()
+
+
+# ---------------------------------------------------------------------------
+# query structure
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class SelectItem(Node):
+    expr: Expression
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class SortItem(Node):
+    expr: Expression
+    descending: bool = False
+    nulls_first: Optional[bool] = None  # None = SQL default (last for ASC)
+
+
+@dataclasses.dataclass(frozen=True)
+class QuerySpec(Node):
+    select: Tuple[SelectItem, ...]
+    distinct: bool = False
+    from_: Optional[Relation] = None
+    where: Optional[Expression] = None
+    group_by: Tuple[Expression, ...] = ()
+    having: Optional[Expression] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class SetOperation(Node):
+    """UNION/INTERSECT/EXCEPT [ALL|DISTINCT] of two query bodies."""
+
+    op: str  # union/intersect/except
+    all: bool
+    left: Node  # QuerySpec | SetOperation
+    right: Node
+
+
+@dataclasses.dataclass(frozen=True)
+class WithQuery(Node):
+    name: str
+    query: "Query"
+    column_names: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class Query(Node):
+    body: Node  # QuerySpec | SetOperation
+    with_: Tuple[WithQuery, ...] = ()
+    order_by: Tuple[SortItem, ...] = ()
+    limit: Optional[int] = None
+    offset: int = 0
+
+
+# other statements
+@dataclasses.dataclass(frozen=True)
+class ExplainStatement(Node):
+    query: Query
+    analyze: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowTables(Node):
+    schema: Optional[Tuple[str, ...]] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowSchemas(Node):
+    catalog: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowColumns(Node):
+    table: Tuple[str, ...] = ()
