@@ -101,6 +101,32 @@ def test_hash_aggregation(oracle, tpch):
     assert_rows_match(rows, expected, ordered=False)
 
 
+def test_hash_aggregation_growth(oracle, tpch):
+    """High-cardinality keys from a tiny initial table: exercises the
+    grow_table rebuild + accumulator migration paths (incl. min/max
+    extreme re-init and multi-doubling in one batch)."""
+    cols = ["l_partkey", "l_quantity"]
+    op, types, dicts = scan(tpch, "lineitem", cols, batch_rows=4096)
+    agg = HashAggregationOperator(
+        [0],
+        [
+            AggSpec("sum", 1, T.decimal(18, 2)),
+            AggSpec("min", 1, T.decimal(12, 2)),
+            AggSpec("max", 1, T.decimal(12, 2)),
+            AggSpec("count_star", None, T.BIGINT),
+        ],
+        list(zip(types, dicts)),
+        initial_capacity=16,
+    )
+    rows = run([op, agg])
+    expected = sqlite_rows(
+        oracle,
+        "SELECT l_partkey, ROUND(SUM(l_quantity), 2), MIN(l_quantity),"
+        " MAX(l_quantity), COUNT(*) FROM lineitem GROUP BY 1",
+    )
+    assert_rows_match(rows, expected, ordered=False)
+
+
 def test_global_aggregation_empty_input(oracle, tpch):
     op, types, dicts = scan(tpch, "lineitem", ["l_quantity"])
     b = ExprBinder(types, dicts)

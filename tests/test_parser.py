@@ -1,0 +1,161 @@
+"""Parser tests (tier 1 — parser round-trips, SURVEY.md §4.1)."""
+
+import pytest
+
+from tests.tpch_queries import QUERIES
+from trino_tpu.sql import ast
+from trino_tpu.sql.parser import ParsingError, parse, parse_query
+
+
+def test_simple_select():
+    q = parse_query("SELECT a, b AS x FROM t WHERE a > 1")
+    spec = q.body
+    assert isinstance(spec, ast.QuerySpec)
+    assert spec.select[0].expr == ast.Identifier(("a",))
+    assert spec.select[1].alias == "x"
+    assert isinstance(spec.from_, ast.TableRef)
+    assert spec.from_.name == ("t",)
+    assert isinstance(spec.where, ast.BinaryOp)
+    assert spec.where.op == "gt"
+
+
+def test_precedence():
+    q = parse_query("SELECT 1 + 2 * 3 = 7 AND NOT a OR b")
+    e = q.body.select[0].expr
+    # ((1 + (2*3)) = 7 AND (NOT a)) OR b
+    assert isinstance(e, ast.BinaryOp) and e.op == "or"
+    land = e.left
+    assert land.op == "and"
+    cmp_ = land.left
+    assert cmp_.op == "eq"
+    add = cmp_.left
+    assert add.op == "add"
+    assert add.right.op == "mul"
+    assert isinstance(land.right, ast.UnaryOp) and land.right.op == "not"
+
+
+def test_between_in_like_is():
+    q = parse_query(
+        "SELECT * FROM t WHERE a BETWEEN 1 AND 2 AND b NOT IN (1, 2)"
+        " AND c LIKE 'x%' ESCAPE '#' AND d IS NOT NULL AND e NOT LIKE 'y'"
+    )
+    w = q.body.where
+    parts = []
+
+    def flatten(e):
+        if isinstance(e, ast.BinaryOp) and e.op == "and":
+            flatten(e.left)
+            flatten(e.right)
+        else:
+            parts.append(e)
+
+    flatten(w)
+    assert isinstance(parts[0], ast.Between)
+    assert isinstance(parts[1], ast.InList) and parts[1].negated
+    assert isinstance(parts[2], ast.Like) and parts[2].escape is not None
+    assert isinstance(parts[3], ast.IsNullPredicate) and parts[3].negated
+    assert isinstance(parts[4], ast.Like) and parts[4].negated
+
+
+def test_joins():
+    q = parse_query(
+        "SELECT * FROM a JOIN b ON a.x = b.x LEFT OUTER JOIN c USING (y), d"
+    )
+    rel = q.body.from_
+    assert isinstance(rel, ast.Join) and rel.kind == "cross"
+    inner = rel.left
+    assert inner.kind == "left" and inner.using == ("y",)
+    assert inner.left.kind == "inner"
+
+
+def test_subqueries_and_case():
+    q = parse_query(
+        """
+        SELECT CASE WHEN a > 0 THEN 'pos' ELSE 'neg' END,
+               CASE b WHEN 1 THEN 'one' END
+        FROM t
+        WHERE EXISTS (SELECT 1 FROM u WHERE u.id = t.id)
+          AND a IN (SELECT x FROM v)
+          AND b > (SELECT avg(x) FROM v)
+        """
+    )
+    c1 = q.body.select[0].expr
+    assert isinstance(c1, ast.Case) and c1.operand is None and c1.default is not None
+    c2 = q.body.select[1].expr
+    assert c2.operand is not None and c2.default is None
+
+
+def test_literals():
+    q = parse_query(
+        "SELECT date '1998-12-01' - interval '90' day, 1.5, .5e2, 'it''s', NULL, TRUE"
+    )
+    items = [i.expr for i in q.body.select]
+    assert isinstance(items[0], ast.BinaryOp)
+    assert isinstance(items[0].left, ast.DateLiteral)
+    assert isinstance(items[0].right, ast.IntervalLiteral)
+    assert items[0].right.unit == "day"
+    assert items[1] == ast.NumberLiteral("1.5")
+    assert items[3] == ast.StringLiteral("it's")
+    assert isinstance(items[4], ast.NullLiteral)
+    assert items[5] == ast.BooleanLiteral(True)
+
+
+def test_cast_extract_functions():
+    q = parse_query(
+        "SELECT CAST(a AS decimal(12, 2)), extract(year from d),"
+        " count(*), count(DISTINCT x), substring(s, 1, 2) FROM t"
+    )
+    items = [i.expr for i in q.body.select]
+    assert items[0].target == ast.TypeName("decimal", (12, 2))
+    assert items[1] == ast.Extract("year", ast.Identifier(("d",)))
+    assert items[2] == ast.FunctionCall("count", (ast.Star(),))
+    assert items[3].distinct
+    assert items[4].name == "substring"
+
+
+def test_group_order_limit():
+    q = parse_query(
+        "SELECT a, sum(b) FROM t GROUP BY a HAVING sum(b) > 10"
+        " ORDER BY 2 DESC NULLS FIRST, a ASC LIMIT 5"
+    )
+    assert q.body.group_by == (ast.Identifier(("a",)),)
+    assert q.body.having is not None
+    assert q.limit == 5
+    assert q.order_by[0].descending and q.order_by[0].nulls_first is True
+    assert not q.order_by[1].descending
+
+
+def test_with_and_union():
+    q = parse_query(
+        "WITH r (a, b) AS (SELECT 1, 2) SELECT * FROM r"
+        " UNION ALL SELECT * FROM r UNION SELECT 3, 4"
+    )
+    assert q.with_[0].name == "r" and q.with_[0].column_names == ("a", "b")
+    body = q.body
+    assert isinstance(body, ast.SetOperation) and body.op == "union" and not body.all
+    assert isinstance(body.left, ast.SetOperation) and body.left.all
+
+
+def test_show_and_explain():
+    assert isinstance(parse("SHOW TABLES FROM tpch.tiny"), ast.ShowTables)
+    assert isinstance(parse("SHOW SCHEMAS"), ast.ShowSchemas)
+    e = parse("EXPLAIN ANALYZE SELECT 1")
+    assert isinstance(e, ast.ExplainStatement) and e.analyze
+
+
+def test_errors():
+    with pytest.raises(ParsingError):
+        parse("SELECT FROM t")
+    with pytest.raises(ParsingError):
+        parse("SELECT a FROM t WHERE")
+    with pytest.raises(ParsingError):
+        parse("SELECT a b c FROM t")
+    with pytest.raises(ParsingError):
+        parse("SELECT cast(a AS notatype) FROM t")
+
+
+@pytest.mark.parametrize("qid", sorted(QUERIES))
+def test_tpch_queries_parse(qid):
+    q = parse_query(QUERIES[qid])
+    assert isinstance(q, ast.Query)
+    assert isinstance(q.body, ast.QuerySpec)

@@ -237,7 +237,6 @@ class SortOperator(Operator):
         self._schema = list(input_schema)
         self._inputs: List[RelBatch] = []
         self._out: Optional[RelBatch] = None
-        self._emitted = False
 
     def add_input(self, batch: RelBatch) -> None:
         self._inputs.append(batch)
@@ -254,8 +253,6 @@ class SortOperator(Operator):
 
     def get_output(self) -> Optional[RelBatch]:
         out, self._out = self._out, None
-        if out is not None:
-            self._emitted = True
         return out
 
     def is_finished(self) -> bool:
@@ -377,16 +374,14 @@ def _agg_state_update(spec: AggSpec, state, gid, data, valid, live, capacity):
     raise NotImplementedError(spec.kind)
 
 
-def _agg_state_migrate(state, remap, new_capacity):
-    """Move accumulator state through a table rebuild: new[remap[i]] = old[i]."""
-    out = []
-    for arr in state:
-        if np.issubdtype(np.dtype(arr.dtype), np.floating):
-            fresh = jnp.zeros(new_capacity, dtype=arr.dtype)
-        else:
-            fresh = jnp.zeros(new_capacity, dtype=arr.dtype)
-        out.append(fresh.at[remap].set(arr, mode="drop"))
-    return tuple(out)
+def _agg_state_migrate(spec: AggSpec, arg_dtype, state, remap, new_capacity):
+    """Move accumulator state through a table rebuild: new[remap[i]] = old[i].
+    Fresh slots must hold the same identity element as _agg_state_init
+    (min/max extremes, not zero)."""
+    fresh = _agg_state_init(spec, arg_dtype, new_capacity)
+    return tuple(
+        f.at[remap].set(arr, mode="drop") for f, arr in zip(fresh, state)
+    )
 
 
 def _agg_output(spec: AggSpec, state, arg_type: Optional[T.DataType],
@@ -463,8 +458,8 @@ class HashAggregationOperator(Operator):
         self._seen_any = False
 
         @jax.jit
-        def _update_states(states, gid, batch: RelBatch, capacity_arr):
-            capacity = capacity_arr.shape[0]
+        def _update_states(states, gid, batch: RelBatch):
+            capacity = states[0][0].shape[0]
             live = batch.live_mask()
             new_states = []
             for a, st in zip(self._aggs, states):
@@ -491,21 +486,18 @@ class HashAggregationOperator(Operator):
                 self._table, keys, valids, batch.live_mask()
             )
             self._table = table
-            if bool(overflowed):
+            # grow-and-retry until the whole batch fits (keys inserted by
+            # a failed round carry zero state, so re-inserting is safe:
+            # accumulation below runs exactly once)
+            while bool(overflowed):
                 self._grow(self._capacity * 2)
-                # retry against the grown table (keys inserted by the
-                # failed round carry zero state, so re-inserting is safe:
-                # accumulation below runs exactly once)
                 gid, self._table, overflowed = G.insert_group_ids(
                     self._table, keys, valids, batch.live_mask()
                 )
-                assert not bool(overflowed)
             # keep load factor below ~62% so probe chains stay short
-            elif int(self._table.num_groups()) * 8 > self._capacity * 5:
+            if int(self._table.num_groups()) * 8 > self._capacity * 5:
                 self._grow_after = True
-        self._states = self._update_states(
-            self._states, gid, batch, jnp.zeros(self._capacity)
-        )
+        self._states = self._update_states(self._states, gid, batch)
         if getattr(self, "_grow_after", False):
             self._grow_after = False
             self._grow(self._capacity * 2)
@@ -513,9 +505,17 @@ class HashAggregationOperator(Operator):
     def _grow(self, new_capacity: int) -> None:
         self._table, remap = G.grow_table(self._table, new_capacity)
         self._states = [
-            _agg_state_migrate(st, remap, new_capacity) for st in self._states
+            _agg_state_migrate(a, self._arg_dtype(a), st, remap, new_capacity)
+            for a, st in zip(self._aggs, self._states)
         ]
         self._capacity = new_capacity
+
+    def _arg_dtype(self, a: AggSpec):
+        return (
+            self._schema[a.arg_channel][0].dtype
+            if a.arg_channel is not None
+            else np.int64
+        )
 
     def finish(self) -> None:
         if self._finishing:

@@ -1,0 +1,654 @@
+"""SQL lexer + recursive-descent/Pratt parser -> AST.
+
+Analogue of trino-parser's ANTLR grammar + AstBuilder
+(core/trino-parser/src/main/antlr4/.../SqlBase.g4, 1,284 lines;
+parser/sql/parser/AstBuilder.java:332 — SURVEY.md §2.1). A generated
+parser buys nothing on this subset, so this is a hand-written Pratt
+parser with Trino's precedence table; error messages carry line:col like
+Trino's ParsingException.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Tuple
+
+from trino_tpu.sql import ast
+
+# ---------------------------------------------------------------------------
+# Lexer
+# ---------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+|--[^\n]*|/\*.*?\*/)
+  | (?P<number>\d+(\.\d*)?([eE][+-]?\d+)?|\.\d+([eE][+-]?\d+)?)
+  | (?P<string>'(?:[^']|'')*')
+  | (?P<qident>"(?:[^"]|"")*")
+  | (?P<ident>[A-Za-z_][A-Za-z_0-9$]*)
+  | (?P<op><>|!=|>=|<=|\|\||[-+*/%(),.;=<>\[\]])
+    """,
+    re.VERBOSE | re.DOTALL,
+)
+
+
+class Token:
+    __slots__ = ("kind", "text", "pos", "line", "col")
+
+    def __init__(self, kind, text, pos, line, col):
+        self.kind = kind  # number/string/ident/qident/op/eof
+        self.text = text
+        self.pos = pos
+        self.line = line
+        self.col = col
+
+    @property
+    def upper(self):
+        return self.text.upper()
+
+    def __repr__(self):
+        return f"Token({self.kind}, {self.text!r} @{self.line}:{self.col})"
+
+
+class ParsingError(ValueError):
+    pass
+
+
+def tokenize(sql: str) -> List[Token]:
+    out = []
+    pos = 0
+    line, col = 1, 1
+    while pos < len(sql):
+        m = _TOKEN_RE.match(sql, pos)
+        if m is None:
+            raise ParsingError(f"line {line}:{col}: unexpected character {sql[pos]!r}")
+        text = m.group(0)
+        kind = m.lastgroup
+        if kind != "ws":
+            out.append(Token(kind, text, pos, line, col))
+        nl = text.count("\n")
+        if nl:
+            line += nl
+            col = len(text) - text.rfind("\n")
+        else:
+            col += len(text)
+        pos = m.end()
+    out.append(Token("eof", "", pos, line, col))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Parser
+# ---------------------------------------------------------------------------
+
+_RESERVED_STOP = {
+    # words that terminate an expression / select item / relation
+    "FROM", "WHERE", "GROUP", "HAVING", "ORDER", "LIMIT", "OFFSET", "UNION",
+    "INTERSECT", "EXCEPT", "ON", "JOIN", "INNER", "LEFT", "RIGHT", "FULL",
+    "CROSS", "AS", "AND", "OR", "NOT", "BY", "ASC", "DESC", "NULLS", "FIRST",
+    "LAST", "WHEN", "THEN", "ELSE", "END", "CASE", "BETWEEN", "IN", "LIKE",
+    "IS", "NULL", "EXISTS", "DISTINCT", "ALL", "SELECT", "WITH", "USING",
+    "ESCAPE", "OUTER",
+}
+
+# words that can never start a bare identifier expression
+_HARD_RESERVED = {
+    "SELECT", "FROM", "WHERE", "GROUP", "HAVING", "ORDER", "BY", "LIMIT",
+    "OFFSET", "UNION", "INTERSECT", "EXCEPT", "JOIN", "INNER", "LEFT",
+    "RIGHT", "FULL", "OUTER", "CROSS", "ON", "USING", "AND", "OR", "NOT",
+    "BETWEEN", "IN", "LIKE", "IS", "WHEN", "THEN", "ELSE", "END", "AS",
+    "DISTINCT", "ALL", "WITH", "ESCAPE",
+}
+
+_TYPE_NAMES = {
+    "BOOLEAN", "TINYINT", "SMALLINT", "INT", "INTEGER", "BIGINT", "REAL",
+    "DOUBLE", "DECIMAL", "NUMERIC", "VARCHAR", "CHAR", "DATE", "TIMESTAMP",
+}
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.tokens = tokenize(sql)
+        self.i = 0
+
+    # -- token helpers --
+    def peek(self, ahead: int = 0) -> Token:
+        return self.tokens[min(self.i + ahead, len(self.tokens) - 1)]
+
+    def next(self) -> Token:
+        t = self.tokens[self.i]
+        self.i += 1
+        return t
+
+    def at_kw(self, *words: str) -> bool:
+        t = self.peek()
+        return t.kind == "ident" and t.upper in words
+
+    def accept_kw(self, *words: str) -> bool:
+        if self.at_kw(*words):
+            self.next()
+            return True
+        return False
+
+    def expect_kw(self, word: str) -> None:
+        if not self.accept_kw(word):
+            t = self.peek()
+            raise ParsingError(
+                f"line {t.line}:{t.col}: expected {word}, found {t.text!r}"
+            )
+
+    def at_op(self, *ops: str) -> bool:
+        t = self.peek()
+        return t.kind == "op" and t.text in ops
+
+    def accept_op(self, *ops: str) -> bool:
+        if self.at_op(*ops):
+            self.next()
+            return True
+        return False
+
+    def expect_op(self, op: str) -> None:
+        if not self.accept_op(op):
+            t = self.peek()
+            raise ParsingError(f"line {t.line}:{t.col}: expected {op!r}, found {t.text!r}")
+
+    def error(self, msg: str) -> ParsingError:
+        t = self.peek()
+        return ParsingError(f"line {t.line}:{t.col}: {msg} (found {t.text!r})")
+
+    # -- entry --
+    def parse_statement(self) -> ast.Node:
+        if self.at_kw("EXPLAIN"):
+            self.next()
+            analyze = self.accept_kw("ANALYZE")
+            stmt: ast.Node = ast.ExplainStatement(self.parse_query(), analyze)
+        elif self.at_kw("SHOW"):
+            stmt = self._parse_show()
+        else:
+            stmt = self.parse_query()
+        self.accept_op(";")
+        t = self.peek()
+        if t.kind != "eof":
+            raise self.error("unexpected trailing input")
+        return stmt
+
+    def _parse_show(self) -> ast.Node:
+        self.expect_kw("SHOW")
+        if self.accept_kw("TABLES"):
+            schema = None
+            if self.accept_kw("FROM") or self.accept_kw("IN"):
+                schema = self._parse_qualified_name()
+            return ast.ShowTables(schema)
+        if self.accept_kw("SCHEMAS"):
+            catalog = None
+            if self.accept_kw("FROM") or self.accept_kw("IN"):
+                catalog = self._parse_name()
+            return ast.ShowSchemas(catalog)
+        if self.accept_kw("COLUMNS"):
+            self.expect_kw("FROM")
+            return ast.ShowColumns(self._parse_qualified_name())
+        raise self.error("expected TABLES, SCHEMAS or COLUMNS after SHOW")
+
+    # -- query --
+    def parse_query(self) -> ast.Query:
+        with_ = ()
+        if self.accept_kw("WITH"):
+            ctes = []
+            while True:
+                name = self._parse_name()
+                colnames: Tuple[str, ...] = ()
+                if self.accept_op("("):
+                    cols = [self._parse_name()]
+                    while self.accept_op(","):
+                        cols.append(self._parse_name())
+                    self.expect_op(")")
+                    colnames = tuple(cols)
+                self.expect_kw("AS")
+                self.expect_op("(")
+                q = self.parse_query()
+                self.expect_op(")")
+                ctes.append(ast.WithQuery(name, q, colnames))
+                if not self.accept_op(","):
+                    break
+            with_ = tuple(ctes)
+        body = self._parse_query_body()
+        order_by: Tuple[ast.SortItem, ...] = ()
+        if self.accept_kw("ORDER"):
+            self.expect_kw("BY")
+            items = [self._parse_sort_item()]
+            while self.accept_op(","):
+                items.append(self._parse_sort_item())
+            order_by = tuple(items)
+        offset = 0
+        limit = None
+        if self.accept_kw("OFFSET"):
+            offset = int(self.next().text)
+            self.accept_kw("ROW") or self.accept_kw("ROWS")
+        if self.accept_kw("LIMIT"):
+            t = self.next()
+            if t.kind == "ident" and t.upper == "ALL":
+                limit = None
+            else:
+                limit = int(t.text)
+        if self.accept_kw("OFFSET"):
+            offset = int(self.next().text)
+            self.accept_kw("ROW") or self.accept_kw("ROWS")
+        return ast.Query(body, with_, order_by, limit, offset)
+
+    def _parse_query_body(self) -> ast.Node:
+        # INTERSECT binds tighter than UNION/EXCEPT (SqlBase.g4 queryTerm)
+        left = self._parse_intersect_term()
+        while self.at_kw("UNION", "EXCEPT"):
+            op = self.next().upper.lower()
+            all_ = self.accept_kw("ALL")
+            if not all_:
+                self.accept_kw("DISTINCT")
+            right = self._parse_intersect_term()
+            left = ast.SetOperation(op, all_, left, right)
+        return left
+
+    def _parse_intersect_term(self) -> ast.Node:
+        left = self._parse_query_term()
+        while self.at_kw("INTERSECT"):
+            self.next()
+            all_ = self.accept_kw("ALL")
+            if not all_:
+                self.accept_kw("DISTINCT")
+            right = self._parse_query_term()
+            left = ast.SetOperation("intersect", all_, left, right)
+        return left
+
+    def _parse_query_term(self) -> ast.Node:
+        if self.accept_op("("):
+            body = self._parse_query_body()
+            self.expect_op(")")
+            return body
+        return self._parse_query_spec()
+
+    def _parse_query_spec(self) -> ast.QuerySpec:
+        self.expect_kw("SELECT")
+        distinct = False
+        if self.accept_kw("DISTINCT"):
+            distinct = True
+        else:
+            self.accept_kw("ALL")
+        select = [self._parse_select_item()]
+        while self.accept_op(","):
+            select.append(self._parse_select_item())
+        from_ = None
+        if self.accept_kw("FROM"):
+            from_ = self._parse_relation()
+        where = None
+        if self.accept_kw("WHERE"):
+            where = self.parse_expr()
+        group_by: Tuple[ast.Expression, ...] = ()
+        if self.accept_kw("GROUP"):
+            self.expect_kw("BY")
+            items = [self.parse_expr()]
+            while self.accept_op(","):
+                items.append(self.parse_expr())
+            group_by = tuple(items)
+        having = None
+        if self.accept_kw("HAVING"):
+            having = self.parse_expr()
+        return ast.QuerySpec(tuple(select), distinct, from_, where, group_by, having)
+
+    def _parse_select_item(self) -> ast.SelectItem:
+        if self.at_op("*"):
+            self.next()
+            return ast.SelectItem(ast.Star())
+        # alias.*
+        if (
+            self.peek().kind in ("ident", "qident")
+            and self.peek(1).kind == "op"
+            and self.peek(1).text == "."
+            and self.peek(2).kind == "op"
+            and self.peek(2).text == "*"
+        ):
+            qual = self._parse_name()
+            self.next()
+            self.next()
+            return ast.SelectItem(ast.Star(qual))
+        expr = self.parse_expr()
+        alias = None
+        if self.accept_kw("AS"):
+            alias = self._parse_name()
+        elif self.peek().kind in ("ident", "qident") and self.peek().upper not in _RESERVED_STOP:
+            alias = self._parse_name()
+        return ast.SelectItem(expr, alias)
+
+    def _parse_sort_item(self) -> ast.SortItem:
+        expr = self.parse_expr()
+        descending = False
+        if self.accept_kw("DESC"):
+            descending = True
+        else:
+            self.accept_kw("ASC")
+        nulls_first = None
+        if self.accept_kw("NULLS"):
+            if self.accept_kw("FIRST"):
+                nulls_first = True
+            else:
+                self.expect_kw("LAST")
+                nulls_first = False
+        return ast.SortItem(expr, descending, nulls_first)
+
+    # -- relations --
+    def _parse_relation(self) -> ast.Relation:
+        left = self._parse_table_primary()
+        while True:
+            if self.accept_op(","):
+                right = self._parse_table_primary()
+                left = ast.Join("cross", left, right)
+                continue
+            kind = None
+            if self.at_kw("CROSS"):
+                self.next()
+                self.expect_kw("JOIN")
+                left = ast.Join("cross", left, self._parse_table_primary())
+                continue
+            if self.at_kw("JOIN"):
+                self.next()
+                kind = "inner"
+            elif self.at_kw("INNER"):
+                self.next()
+                self.expect_kw("JOIN")
+                kind = "inner"
+            elif self.at_kw("LEFT", "RIGHT", "FULL"):
+                kind = self.next().upper.lower()
+                self.accept_kw("OUTER")
+                self.expect_kw("JOIN")
+            else:
+                return left
+            right = self._parse_table_primary()
+            if self.accept_kw("ON"):
+                cond = self.parse_expr()
+                left = ast.Join(kind, left, right, cond)
+            elif self.accept_kw("USING"):
+                self.expect_op("(")
+                cols = [self._parse_name()]
+                while self.accept_op(","):
+                    cols.append(self._parse_name())
+                self.expect_op(")")
+                left = ast.Join(kind, left, right, None, tuple(cols))
+            else:
+                raise self.error("expected ON or USING after JOIN")
+
+    def _parse_table_primary(self) -> ast.Relation:
+        if self.accept_op("("):
+            # subquery or parenthesized join
+            if self.at_kw("SELECT", "WITH"):
+                q = self.parse_query()
+                self.expect_op(")")
+                alias = self._parse_opt_alias()
+                return ast.SubqueryRelation(q, alias)
+            rel = self._parse_relation()
+            self.expect_op(")")
+            return rel
+        name = self._parse_qualified_name()
+        alias = self._parse_opt_alias()
+        return ast.TableRef(name, alias)
+
+    def _parse_opt_alias(self) -> Optional[str]:
+        if self.accept_kw("AS"):
+            return self._parse_name()
+        if self.peek().kind in ("ident", "qident") and self.peek().upper not in _RESERVED_STOP:
+            return self._parse_name()
+        return None
+
+    def _parse_name(self) -> str:
+        t = self.next()
+        if t.kind == "qident":
+            return t.text[1:-1].replace('""', '"')
+        if t.kind != "ident":
+            raise ParsingError(f"line {t.line}:{t.col}: expected identifier, found {t.text!r}")
+        return t.text.lower()
+
+    def _parse_qualified_name(self) -> Tuple[str, ...]:
+        parts = [self._parse_name()]
+        while self.at_op(".") and self.peek(1).kind in ("ident", "qident"):
+            self.next()
+            parts.append(self._parse_name())
+        return tuple(parts)
+
+    # -- expressions (Pratt) --
+    def parse_expr(self) -> ast.Expression:
+        return self._parse_or()
+
+    def _parse_or(self) -> ast.Expression:
+        left = self._parse_and()
+        while self.accept_kw("OR"):
+            left = ast.BinaryOp("or", left, self._parse_and())
+        return left
+
+    def _parse_and(self) -> ast.Expression:
+        left = self._parse_not()
+        while self.accept_kw("AND"):
+            left = ast.BinaryOp("and", left, self._parse_not())
+        return left
+
+    def _parse_not(self) -> ast.Expression:
+        if self.accept_kw("NOT"):
+            return ast.UnaryOp("not", self._parse_not())
+        return self._parse_predicate()
+
+    def _parse_predicate(self) -> ast.Expression:
+        left = self._parse_additive()
+        while True:
+            negated = False
+            if self.at_kw("NOT"):
+                nxt = self.peek(1)
+                if nxt.kind == "ident" and nxt.upper in ("BETWEEN", "IN", "LIKE"):
+                    self.next()
+                    negated = True
+                else:
+                    break
+            if self.accept_kw("BETWEEN"):
+                low = self._parse_additive()
+                self.expect_kw("AND")
+                high = self._parse_additive()
+                left = ast.Between(left, low, high, negated)
+                continue
+            if self.accept_kw("IN"):
+                self.expect_op("(")
+                if self.at_kw("SELECT", "WITH"):
+                    q = self.parse_query()
+                    self.expect_op(")")
+                    left = ast.InSubquery(left, q, negated)
+                else:
+                    opts = [self.parse_expr()]
+                    while self.accept_op(","):
+                        opts.append(self.parse_expr())
+                    self.expect_op(")")
+                    left = ast.InList(left, tuple(opts), negated)
+                continue
+            if self.accept_kw("LIKE"):
+                pattern = self._parse_additive()
+                escape = None
+                if self.accept_kw("ESCAPE"):
+                    escape = self._parse_additive()
+                left = ast.Like(left, pattern, escape, negated)
+                continue
+            if self.accept_kw("IS"):
+                neg = self.accept_kw("NOT")
+                if self.accept_kw("NULL"):
+                    left = ast.IsNullPredicate(left, neg)
+                elif self.accept_kw("DISTINCT"):
+                    self.expect_kw("FROM")
+                    right = self._parse_additive()
+                    eq = ast.BinaryOp("is_distinct", left, right)
+                    left = ast.UnaryOp("not", eq) if neg else eq
+                else:
+                    raise self.error("expected NULL or DISTINCT FROM after IS")
+                continue
+            if self.peek().kind == "op" and self.peek().text in ("=", "<>", "!=", "<", "<=", ">", ">="):
+                op = self.next().text
+                op = {"=": "eq", "<>": "ne", "!=": "ne", "<": "lt", "<=": "le",
+                      ">": "gt", ">=": "ge"}[op]
+                right = self._parse_additive()
+                left = ast.BinaryOp(op, left, right)
+                continue
+            break
+        return left
+
+    def _parse_additive(self) -> ast.Expression:
+        left = self._parse_multiplicative()
+        while self.at_op("+", "-", "||"):
+            op = self.next().text
+            right = self._parse_multiplicative()
+            if op == "||":
+                left = ast.FunctionCall("concat", (left, right))
+            else:
+                left = ast.BinaryOp({"+": "add", "-": "sub"}[op], left, right)
+        return left
+
+    def _parse_multiplicative(self) -> ast.Expression:
+        left = self._parse_unary()
+        while self.at_op("*", "/", "%"):
+            op = self.next().text
+            right = self._parse_unary()
+            left = ast.BinaryOp({"*": "mul", "/": "div", "%": "mod"}[op], left, right)
+        return left
+
+    def _parse_unary(self) -> ast.Expression:
+        if self.accept_op("-"):
+            return ast.UnaryOp("negate", self._parse_unary())
+        if self.accept_op("+"):
+            return self._parse_unary()
+        return self._parse_primary()
+
+    def _parse_primary(self) -> ast.Expression:
+        t = self.peek()
+        if t.kind == "number":
+            self.next()
+            return ast.NumberLiteral(t.text)
+        if t.kind == "string":
+            self.next()
+            return ast.StringLiteral(t.text[1:-1].replace("''", "'"))
+        if t.kind == "op" and t.text == "(":
+            self.next()
+            if self.at_kw("SELECT", "WITH"):
+                q = self.parse_query()
+                self.expect_op(")")
+                return ast.ScalarSubquery(q)
+            e = self.parse_expr()
+            self.expect_op(")")
+            return e
+        if t.kind in ("ident", "qident"):
+            u = t.upper
+            if t.kind == "ident" and u in _HARD_RESERVED:
+                raise self.error("expected expression")
+            if u == "NULL":
+                self.next()
+                return ast.NullLiteral()
+            if u in ("TRUE", "FALSE"):
+                self.next()
+                return ast.BooleanLiteral(u == "TRUE")
+            if u == "DATE" and self.peek(1).kind == "string":
+                self.next()
+                return ast.DateLiteral(self.next().text[1:-1])
+            if u == "TIMESTAMP" and self.peek(1).kind == "string":
+                self.next()
+                return ast.TimestampLiteral(self.next().text[1:-1])
+            if u == "INTERVAL":
+                self.next()
+                sign = 1
+                if self.accept_op("-"):
+                    sign = -1
+                v = self.next()
+                if v.kind != "string":
+                    raise self.error("expected interval string")
+                unit = self._parse_name()
+                return ast.IntervalLiteral(v.text[1:-1], unit.lower(), sign)
+            if u == "CASE":
+                return self._parse_case()
+            if u == "CAST":
+                self.next()
+                self.expect_op("(")
+                operand = self.parse_expr()
+                self.expect_kw("AS")
+                target = self._parse_type()
+                self.expect_op(")")
+                return ast.Cast(operand, target)
+            if u == "EXISTS" and self.peek(1).kind == "op" and self.peek(1).text == "(":
+                self.next()
+                self.expect_op("(")
+                q = self.parse_query()
+                self.expect_op(")")
+                return ast.Exists(q)
+            if u == "EXTRACT" and self.peek(1).kind == "op" and self.peek(1).text == "(":
+                self.next()
+                self.expect_op("(")
+                field = self._parse_name()
+                self.expect_kw("FROM")
+                operand = self.parse_expr()
+                self.expect_op(")")
+                return ast.Extract(field.lower(), operand)
+            # function call?
+            if self.peek(1).kind == "op" and self.peek(1).text == "(":
+                name = self._parse_name()
+                self.expect_op("(")
+                if name == "count" and self.at_op("*"):
+                    self.next()
+                    self.expect_op(")")
+                    return ast.FunctionCall("count", (ast.Star(),))
+                distinct = self.accept_kw("DISTINCT")
+                args: List[ast.Expression] = []
+                if not self.at_op(")"):
+                    args.append(self.parse_expr())
+                    while self.accept_op(","):
+                        args.append(self.parse_expr())
+                self.expect_op(")")
+                return ast.FunctionCall(name, tuple(args), distinct)
+            # identifier (possibly qualified)
+            return ast.Identifier(self._parse_qualified_name())
+        raise self.error("expected expression")
+
+    def _parse_case(self) -> ast.Expression:
+        self.expect_kw("CASE")
+        operand = None
+        if not self.at_kw("WHEN"):
+            operand = self.parse_expr()
+        whens = []
+        while self.accept_kw("WHEN"):
+            cond = self.parse_expr()
+            self.expect_kw("THEN")
+            result = self.parse_expr()
+            whens.append(ast.WhenClause(cond, result))
+        default = None
+        if self.accept_kw("ELSE"):
+            default = self.parse_expr()
+        self.expect_kw("END")
+        return ast.Case(operand, tuple(whens), default)
+
+    def _parse_type(self) -> ast.TypeName:
+        t = self.next()
+        if t.kind != "ident" or t.upper not in _TYPE_NAMES:
+            raise ParsingError(f"line {t.line}:{t.col}: unknown type {t.text!r}")
+        name = t.upper.lower()
+        if name == "int":
+            name = "integer"
+        if name == "numeric":
+            name = "decimal"
+        params: Tuple[int, ...] = ()
+        if name == "double" and self.at_kw("PRECISION"):
+            self.next()
+        if self.at_op("("):
+            self.next()
+            ps = [int(self.next().text)]
+            while self.accept_op(","):
+                ps.append(int(self.next().text))
+            self.expect_op(")")
+            params = tuple(ps)
+        return ast.TypeName(name, params)
+
+
+def parse(sql: str) -> ast.Node:
+    return Parser(sql).parse_statement()
+
+
+def parse_query(sql: str) -> ast.Query:
+    node = parse(sql)
+    if not isinstance(node, ast.Query):
+        raise ParsingError("expected a query")
+    return node

@@ -1,0 +1,206 @@
+"""Logical plan IR.
+
+Analogue of Trino's plan-node layer (main/sql/planner/plan/, 59 classes
+— SURVEY.md §2.2), reduced to the relational core the executor runs.
+Conventions that keep physical planning mechanical:
+
+- Every node's output schema is an ordered list of Field(name, type);
+  expressions inside nodes are typed IR (trino_tpu.expr.ir) whose
+  InputRefs index the CHILD's output channels.
+- Aggregate/Join key and argument expressions are always plain channel
+  references — the analyzer inserts Project nodes to materialize
+  anything more complex (the HashGenerationOptimizer discipline).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+from trino_tpu import types as T
+from trino_tpu.expr.ir import Expr
+from trino_tpu.ops.sort import SortKey
+
+
+@dataclasses.dataclass(frozen=True)
+class Field:
+    name: Optional[str]
+    type: T.DataType
+
+
+class PlanNode:
+    fields: Tuple[Field, ...]
+
+    def children(self) -> Sequence["PlanNode"]:
+        return ()
+
+
+@dataclasses.dataclass(frozen=True)
+class ScanNode(PlanNode):
+    """Connector table scan (TableScanNode analogue). `columns` are the
+    pruned connector column names, 1:1 with fields."""
+
+    catalog: str
+    handle: object  # connectors.spi.TableHandle
+    columns: Tuple[str, ...]
+    fields: Tuple[Field, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class ValuesNode(PlanNode):
+    fields: Tuple[Field, ...]
+    rows: Tuple[Tuple[object, ...], ...]  # python literal values
+
+
+@dataclasses.dataclass(frozen=True)
+class FilterNode(PlanNode):
+    child: PlanNode
+    predicate: Expr
+    fields: Tuple[Field, ...]
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
+class ProjectNode(PlanNode):
+    child: PlanNode
+    exprs: Tuple[Expr, ...]
+    fields: Tuple[Field, ...]
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
+class AggCall:
+    """kind in {sum,count,count_star,avg,min,max,any}; arg_channel
+    indexes the child schema (None for count_star)."""
+
+    kind: str
+    arg_channel: Optional[int]
+    out_type: T.DataType
+    distinct: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class AggregateNode(PlanNode):
+    """Output schema = [group key channels..., agg results...]
+    (AggregationNode analogue)."""
+
+    child: PlanNode
+    group_channels: Tuple[int, ...]
+    aggs: Tuple[AggCall, ...]
+    fields: Tuple[Field, ...]
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
+class JoinNode(PlanNode):
+    """kind in {inner,left,semi,anti,cross}. Left is the probe side.
+    Output schema: left fields + right fields (inner/left/cross);
+    left fields only (semi/anti). `residual` is typed over the
+    concatenated left+right schema and runs inside the join, before
+    match flags (JoinNode.filter analogue)."""
+
+    kind: str
+    left: PlanNode
+    right: PlanNode
+    left_keys: Tuple[int, ...]
+    right_keys: Tuple[int, ...]
+    residual: Optional[Expr]
+    fields: Tuple[Field, ...]
+
+    def children(self):
+        return (self.left, self.right)
+
+
+@dataclasses.dataclass(frozen=True)
+class SortNode(PlanNode):
+    child: PlanNode
+    keys: Tuple[SortKey, ...]
+    fields: Tuple[Field, ...]
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
+class TopNNode(PlanNode):
+    child: PlanNode
+    keys: Tuple[SortKey, ...]
+    count: int
+    fields: Tuple[Field, ...]
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
+class LimitNode(PlanNode):
+    child: PlanNode
+    count: Optional[int]
+    offset: int
+    fields: Tuple[Field, ...]
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
+class UnionAllNode(PlanNode):
+    """Concatenation of same-width children (UNION ALL; distinct unions
+    get an AggregateNode on top)."""
+
+    inputs: Tuple[PlanNode, ...]
+    fields: Tuple[Field, ...]
+
+    def children(self):
+        return self.inputs
+
+
+@dataclasses.dataclass(frozen=True)
+class OutputNode(PlanNode):
+    """Root: names the result columns (OutputNode analogue)."""
+
+    child: PlanNode
+    names: Tuple[str, ...]
+    fields: Tuple[Field, ...]
+
+    def children(self):
+        return (self.child,)
+
+
+def explain_text(node: PlanNode, indent: int = 0) -> str:
+    """EXPLAIN rendering (textual plan like Trino's PlanPrinter)."""
+    pad = "  " * indent
+    name = type(node).__name__.replace("Node", "")
+    detail = ""
+    if isinstance(node, ScanNode):
+        h = node.handle
+        detail = f" {node.catalog}.{h.schema}.{h.table} {list(node.columns)}"
+    elif isinstance(node, FilterNode):
+        detail = f" {node.predicate!r}"
+    elif isinstance(node, ProjectNode):
+        detail = f" {[repr(e) for e in node.exprs]}"
+    elif isinstance(node, AggregateNode):
+        detail = f" keys={list(node.group_channels)} aggs={[a.kind for a in node.aggs]}"
+    elif isinstance(node, JoinNode):
+        detail = (
+            f" {node.kind} L{list(node.left_keys)}=R{list(node.right_keys)}"
+            + (" +residual" if node.residual is not None else "")
+        )
+    elif isinstance(node, (SortNode, TopNNode)):
+        detail = f" keys={[(k.channel, 'desc' if k.descending else 'asc') for k in node.keys]}"
+        if isinstance(node, TopNNode):
+            detail += f" n={node.count}"
+    elif isinstance(node, LimitNode):
+        detail = f" n={node.count} offset={node.offset}"
+    elif isinstance(node, OutputNode):
+        detail = f" {list(node.names)}"
+    lines = [f"{pad}{name}{detail}"]
+    for c in node.children():
+        lines.append(explain_text(c, indent + 1))
+    return "\n".join(lines)
