@@ -1,0 +1,125 @@
+"""Benchmark entry point — prints ONE JSON line.
+
+Metric: TPC-H Q1 wall-clock through the full SQL engine (parse ->
+analyze -> plan -> jitted device pipeline) on tpch.sf1, steady state
+(compile excluded; Trino's benchto methodology of prewarm + repeat runs,
+SURVEY.md §6). `vs_baseline` is the speedup of the default device
+(the TPU chip under the driver) over this host's CPU backend running
+the identical engine, measured in a subprocess — the reference
+publishes no absolute numbers (BASELINE.md), so the CPU path of the
+same columnar engine is the comparison point.
+
+Env knobs: BENCH_SF (default 1), BENCH_RUNS (default 3),
+BENCH_SKIP_CPU=1 to skip the CPU-subprocess baseline.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+SF = float(os.environ.get("BENCH_SF", "1"))
+RUNS = int(os.environ.get("BENCH_RUNS", "3"))
+
+Q1 = """
+select l_returnflag, l_linestatus, sum(l_quantity) as sum_qty,
+  sum(l_extendedprice) as sum_base_price,
+  sum(l_extendedprice * (1 - l_discount)) as sum_disc_price,
+  sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge,
+  avg(l_quantity) as avg_qty, avg(l_extendedprice) as avg_price,
+  avg(l_discount) as avg_disc, count(*) as count_order
+from lineitem
+where l_shipdate <= date '1998-12-01' - interval '90' day
+group by l_returnflag, l_linestatus
+order by l_returnflag, l_linestatus
+"""
+
+
+Q1_COLUMNS = [
+    "l_returnflag", "l_linestatus", "l_quantity", "l_extendedprice",
+    "l_discount", "l_tax", "l_shipdate",
+]
+
+
+def run_bench() -> float:
+    """Median steady-state Q1 wall-clock in seconds on this process's
+    default jax platform. lineitem is pre-loaded into the memory
+    connector (device-resident after the prewarm scan) so the metric is
+    the query engine, not the data generator."""
+    from trino_tpu.connectors.memory import create_memory_connector
+    from trino_tpu.connectors.spi import ColumnMetadata
+    from trino_tpu.connectors.tpch import TABLES, base_row_count, generate_column
+    from trino_tpu.engine import LocalQueryRunner, Session
+
+    mem = create_memory_connector()
+    types = dict(TABLES["lineitem"])
+    base = base_row_count("lineitem", SF)
+    arrays, dicts = [], []
+    for name in Q1_COLUMNS:
+        data, d = generate_column("lineitem", name, SF, 0, base)
+        arrays.append(data)
+        dicts.append(d)
+    mem.load_table(
+        "bench", "lineitem",
+        [ColumnMetadata(n, types[n]) for n in Q1_COLUMNS],
+        arrays, None, dicts,
+    )
+
+    r = LocalQueryRunner(Session(catalog="memory", schema="bench"))
+    r.register_catalog("memory", mem)
+
+    rows = r.execute(Q1).rows  # prewarm: host->device + compile
+    assert len(rows) == 4, rows
+    times = []
+    for _ in range(RUNS):
+        t0 = time.perf_counter()
+        r.execute(Q1)
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2]
+
+
+def main() -> None:
+    if os.environ.get("BENCH_INNER") == "1":
+        print(json.dumps({"seconds": run_bench()}))
+        return
+
+    import jax
+
+    device_time = run_bench()
+    platform = jax.devices()[0].platform
+
+    vs_baseline = 1.0
+    if platform != "cpu" and os.environ.get("BENCH_SKIP_CPU") != "1":
+        env = dict(os.environ, BENCH_INNER="1", JAX_PLATFORMS="cpu")
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env,
+                capture_output=True,
+                text=True,
+                timeout=1800,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+            cpu_time = json.loads(out.stdout.strip().splitlines()[-1])["seconds"]
+            vs_baseline = cpu_time / device_time
+        except Exception:
+            vs_baseline = 1.0
+
+    print(
+        json.dumps(
+            {
+                "metric": f"tpch_sf{SF:g}_q1_wall",
+                "value": round(device_time, 4),
+                "unit": "s",
+                "vs_baseline": round(vs_baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -300,11 +300,14 @@ class RelBatch:
         return RelBatch(cols, live)
 
     def to_pylists(self):
-        """Rows as list of python lists, live rows only, in order."""
+        """Rows as list of python lists, live rows only, in order. The
+        whole batch moves device->host in ONE transfer (remote devices
+        pay a round trip per fetch)."""
+        host = jax.device_get(self)
         live = None
-        if self.live is not None:
-            live = np.asarray(self.live)
-        cols = [c.to_pylist(live=live) for c in self.columns]
+        if host.live is not None:
+            live = np.asarray(host.live)
+        cols = [c.to_pylist(live=live) for c in host.columns]
         return [list(row) for row in zip(*cols)] if cols else []
 
 

@@ -47,6 +47,10 @@ class _StoredTable:
     columns: List[ColumnMetadata]
     data: Dict[str, _StoredColumn] = dataclasses.field(default_factory=dict)
     row_count: int = 0
+    version: int = 0  # bumped on writes; invalidates the device cache
+    # device-resident batch cache: the Page/Block layer as persistent SoA
+    # device arrays (SURVEY.md §2.5 "the layer that becomes TPU-resident")
+    device_cache: Dict[tuple, list] = dataclasses.field(default_factory=dict)
 
 
 class _Store:
@@ -128,6 +132,20 @@ class MemoryPageSource(ConnectorPageSource):
     def batches(self, split: Split, columns: Sequence[str], batch_rows: int) -> Iterator[RelBatch]:
         t = self.store.tables[(split.table.schema, split.table.table)]
         lo, hi = split.row_range
+        cache_key = (t.version, tuple(columns), batch_rows, lo, hi)
+        cached = t.device_cache.get(cache_key)
+        if cached is not None:
+            yield from cached
+            return
+        out = []
+        for batch in self._materialize(t, columns, batch_rows, lo, hi):
+            out.append(batch)
+            yield batch
+        for k in [k for k in t.device_cache if k[0] != t.version]:
+            del t.device_cache[k]  # drop stale versions only
+        t.device_cache[cache_key] = out
+
+    def _materialize(self, t, columns: Sequence[str], batch_rows: int, lo, hi) -> Iterator[RelBatch]:
         for a in range(lo, hi, batch_rows):
             b = min(a + batch_rows, hi)
             n = b - a
@@ -198,6 +216,7 @@ class MemoryPageSink(ConnectorPageSink):
                     new_valid = valid if valid is not None else np.ones(n, dtype=bool)
                     sc.valid = np.concatenate([old_valid, new_valid])
             t.row_count += n
+            t.version += 1
             self.rows += n
 
     def finish(self) -> int:
@@ -217,6 +236,31 @@ class MemoryConnector(Connector):
 
     def page_sink(self, handle: TableHandle) -> ConnectorPageSink:
         return MemoryPageSink(self.store, handle)
+
+    def load_table(
+        self,
+        schema: str,
+        table: str,
+        columns: Sequence[ColumnMetadata],
+        arrays: Sequence[np.ndarray],
+        valids: Sequence[Optional[np.ndarray]] = None,
+        dictionaries: Sequence[Optional[Dictionary]] = None,
+    ) -> None:
+        """Bulk-load dense host columns (benchmark/fixture path)."""
+        handle = self.metadata.create_table(schema, table, columns)
+        t = self.store.tables[(schema, table)]
+        n = len(arrays[0]) if arrays else 0
+        for i, (cm, arr) in enumerate(zip(columns, arrays)):
+            t.data[cm.name] = _StoredColumn(
+                cm.type,
+                np.asarray(arr, dtype=cm.type.dtype),
+                valids[i] if valids else None,
+                dictionaries[i] if dictionaries else (
+                    Dictionary([]) if cm.type.is_string else None
+                ),
+            )
+        t.row_count = n
+        t.version += 1
 
 
 def create_memory_connector() -> Connector:

@@ -1,0 +1,128 @@
+"""In-process query engine: SQL text in, rows out.
+
+Analogue of Trino's LocalQueryRunner (main/testing/LocalQueryRunner.java:264
+— plan and execute SQL fully in-process with real operators, SURVEY.md
+§4.2) plus the session/catalog surface of Session + MetadataManager.
+The distributed runner (coordinator/worker split over fragments) layers
+on top of the same plans.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+from trino_tpu import types as T
+from trino_tpu.connectors.spi import CatalogManager, Connector
+from trino_tpu.exec import CollectorSink, Driver, Pipeline
+from trino_tpu.sql import ast
+from trino_tpu.sql.analyzer import AnalysisError, Analyzer
+from trino_tpu.sql.local_planner import LocalPlanner
+from trino_tpu.sql.parser import parse
+from trino_tpu.sql.plan import OutputNode, explain_text
+
+
+@dataclasses.dataclass
+class Session:
+    """Per-query context (main/Session.java analogue; properties grow
+    with the session-property system)."""
+
+    catalog: str = "tpch"
+    schema: str = "tiny"
+    batch_rows: int = 1 << 20
+    target_splits: int = 1
+
+
+@dataclasses.dataclass
+class MaterializedResult:
+    """QueryAssertions' MaterializedResult analogue."""
+
+    rows: List[list]
+    column_names: List[str]
+    column_types: List[T.DataType]
+
+    def only_value(self):
+        assert len(self.rows) == 1 and len(self.rows[0]) == 1, self.rows
+        return self.rows[0][0]
+
+
+class LocalQueryRunner:
+    def __init__(self, session: Optional[Session] = None):
+        self.session = session or Session()
+        self.catalogs = CatalogManager()
+        # SQL text -> (OutputNode, PhysicalPlan): re-executing a cached
+        # query reuses every jitted device program (the reference's
+        # expression/operator caches keyed on expression, §2.9)
+        self._plan_cache: dict = {}
+
+    def register_catalog(self, name: str, connector: Connector) -> None:
+        self.catalogs.register(name, connector)
+
+    # -- entry point --
+    def execute(self, sql: str) -> MaterializedResult:
+        stmt = parse(sql)
+        if isinstance(stmt, ast.Query):
+            return self._execute_query(stmt, sql_key=sql)
+        if isinstance(stmt, ast.ExplainStatement):
+            plan = self._analyze(stmt.query)
+            return MaterializedResult(
+                [[explain_text(plan)]], ["Query Plan"], [T.VARCHAR]
+            )
+        if isinstance(stmt, ast.ShowSchemas):
+            cat = stmt.catalog or self.session.catalog
+            conn = self.catalogs.get(cat)
+            rows = [[s] for s in conn.metadata.list_schemas()]
+            return MaterializedResult(rows, ["Schema"], [T.VARCHAR])
+        if isinstance(stmt, ast.ShowTables):
+            cat, schema = self.session.catalog, self.session.schema
+            if stmt.schema:
+                if len(stmt.schema) == 2:
+                    cat, schema = stmt.schema
+                else:
+                    schema = stmt.schema[0]
+            conn = self.catalogs.get(cat)
+            rows = [[t] for t in conn.metadata.list_tables(schema)]
+            return MaterializedResult(rows, ["Table"], [T.VARCHAR])
+        if isinstance(stmt, ast.ShowColumns):
+            parts = stmt.table
+            cat, schema = self.session.catalog, self.session.schema
+            table = parts[-1]
+            if len(parts) == 2:
+                schema = parts[0]
+            elif len(parts) == 3:
+                cat, schema = parts[0], parts[1]
+            conn, handle = self.catalogs.resolve_table(cat, schema, table)
+            meta = conn.metadata.get_table_metadata(handle)
+            rows = [[c.name, str(c.type)] for c in meta.columns]
+            return MaterializedResult(rows, ["Column", "Type"], [T.VARCHAR, T.VARCHAR])
+        raise AnalysisError(f"cannot execute {type(stmt).__name__}")
+
+    def _analyze(self, q: ast.Query) -> OutputNode:
+        analyzer = Analyzer(self.catalogs, self.session.catalog, self.session.schema)
+        return analyzer.plan(q)
+
+    def _execute_query(self, q: ast.Query, sql_key: Optional[str] = None) -> MaterializedResult:
+        cached = self._plan_cache.get(sql_key) if sql_key else None
+        if cached is None:
+            output = self._analyze(q)
+            planner = LocalPlanner(
+                self.catalogs,
+                batch_rows=self.session.batch_rows,
+                target_splits=self.session.target_splits,
+            )
+            physical = planner.plan(output)
+            if sql_key:
+                self._plan_cache[sql_key] = (output, physical)
+        else:
+            output, physical = cached
+        pipelines, chain = physical.instantiate()
+        sink = CollectorSink()
+        chain.append(sink)
+        for p in pipelines:
+            Driver(p).run()
+        Driver(Pipeline(chain)).run()
+        return MaterializedResult(
+            sink.rows(),
+            list(output.names),
+            [f.type for f in output.fields],
+        )

@@ -18,6 +18,7 @@ jit-compiled XLA programs over RelBatch pytrees. TPU-first deltas:
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -128,31 +129,47 @@ class ValuesOperator(Operator):
 # ---------------------------------------------------------------------------
 
 
+def make_filter_project_fn(
+    filter_bound: Optional[Bound], projections: Sequence[Bound]
+):
+    """Compile the fused filter+project device program once; shared by
+    every operator instance the factory creates (the PageProcessor cache
+    discipline — PageFunctionCompiler.java:103 caches per expression)."""
+    projections = list(projections)
+
+    def fn(batch: RelBatch) -> RelBatch:
+        cols = [c.data for c in batch.columns]
+        valids = [c.valid for c in batch.columns]
+        live = batch.live
+        if filter_bound is not None:
+            d, v = filter_bound.fn(cols, valids)
+            keep = d if v is None else (d & v)  # NULL predicate = drop
+            live = keep if live is None else (live & keep)
+        out_cols = []
+        for b in projections:
+            data, valid = b.fn(cols, valids)
+            out_cols.append(Column(b.type, data, valid, b.dictionary))
+        return RelBatch(out_cols, live)
+
+    return jax.jit(fn)
+
+
 class FilterProjectOperator(Operator):
     """Bound filter/projections fused into one jitted device program —
     the FilterAndProjectOperator + PageProcessor analogue
     (main/operator/FilterAndProjectOperator.java:40, project/PageProcessor.java:53)."""
 
-    def __init__(self, filter_bound: Optional[Bound], projections: Sequence[Bound]):
+    def __init__(
+        self,
+        filter_bound: Optional[Bound],
+        projections: Sequence[Bound],
+        fn=None,
+    ):
         self._out: Optional[RelBatch] = None
         self._done = False
-        projections = list(projections)
-
-        def fn(batch: RelBatch) -> RelBatch:
-            cols = [c.data for c in batch.columns]
-            valids = [c.valid for c in batch.columns]
-            live = batch.live
-            if filter_bound is not None:
-                d, v = filter_bound.fn(cols, valids)
-                keep = d if v is None else (d & v)
-                live = keep if live is None else (live & keep)
-            out_cols = []
-            for b in projections:
-                data, valid = b.fn(cols, valids)
-                out_cols.append(Column(b.type, data, valid, b.dictionary))
-            return RelBatch(out_cols, live)
-
-        self._fn = jax.jit(fn)
+        self._fn = fn if fn is not None else make_filter_project_fn(
+            filter_bound, projections
+        )
 
     def needs_input(self) -> bool:
         return self._out is None and not self._finishing
@@ -174,26 +191,34 @@ class FilterProjectOperator(Operator):
 
 
 @jax.jit
-def _limit_batch(batch: RelBatch, remaining: jnp.ndarray):
+def _limit_batch(batch: RelBatch, skip: jnp.ndarray, remaining: jnp.ndarray):
     live = batch.live_mask()
     rank = jnp.cumsum(live.astype(jnp.int64))  # 1-based among live rows
-    keep = live & (rank <= remaining)
-    taken = jnp.minimum(rank[-1] if live.shape[0] else jnp.int64(0), remaining)
-    return RelBatch(batch.columns, keep), taken
+    keep = live & (rank > skip) & (rank <= skip + remaining)
+    n_live = rank[-1] if live.shape[0] else jnp.int64(0)
+    skipped = jnp.minimum(n_live, skip)
+    taken = jnp.minimum(n_live - skipped, remaining)
+    return RelBatch(batch.columns, keep), skipped, taken
 
 
 class LimitOperator(Operator):
-    """LIMIT n (LimitOperator.java): masks rows past the remaining count."""
+    """LIMIT n OFFSET k (LimitOperator.java): masks rows outside the
+    remaining window."""
 
-    def __init__(self, n: int):
-        self._remaining = n
+    def __init__(self, n: Optional[int], offset: int = 0):
+        self._remaining = n if n is not None else (1 << 60)
+        self._skip = offset
         self._out: Optional[RelBatch] = None
 
     def needs_input(self) -> bool:
         return self._out is None and self._remaining > 0 and not self._finishing
 
     def add_input(self, batch: RelBatch) -> None:
-        out, taken = _limit_batch(batch, jnp.int64(self._remaining))
+        out, skipped, taken = _limit_batch(
+            batch, jnp.int64(self._skip), jnp.int64(self._remaining)
+        )
+        skipped, taken = jax.device_get((skipped, taken))  # one round trip
+        self._skip -= int(skipped)
         self._remaining -= int(taken)
         self._out = out
 
@@ -220,11 +245,28 @@ def _apply_sort(batch: RelBatch, keys: Sequence[SortKey]) -> jnp.ndarray:
     )
 
 
-@jax.jit
-def _gather_sorted(batch: RelBatch, order: jnp.ndarray):
-    n_live = jnp.sum(batch.live_mask())
+@partial(jax.jit, static_argnames=("keys",))
+def _concat_sort(parts: Tuple[RelBatch, ...], keys: Tuple[SortKey, ...]) -> RelBatch:
+    """Consolidate + sort + front-pack in ONE device program — eager op
+    dispatch is a per-op host round trip on remote-attached TPUs, so
+    whole-phase fusion matters beyond XLA fusion itself."""
+    merged = concat_batches(list(parts))
+    order = _apply_sort(merged, keys)
+    n_live = jnp.sum(merged.live_mask())
     live = jnp.arange(order.shape[0]) < n_live
-    return batch.gather(order, live)
+    return merged.gather(order, live)
+
+
+@partial(jax.jit, static_argnames=("keys", "n", "cap"))
+def _topn_merge(
+    parts: Tuple[RelBatch, ...], keys: Tuple[SortKey, ...], n: int, cap: int
+) -> RelBatch:
+    merged = concat_batches(list(parts))
+    order = _apply_sort(merged, keys)
+    top = order[:cap]
+    n_live = jnp.minimum(jnp.sum(merged.live_mask()), n)
+    live = jnp.arange(cap) < n_live
+    return merged.gather(top, live)
 
 
 class SortOperator(Operator):
@@ -246,9 +288,7 @@ class SortOperator(Operator):
             return
         self._finishing = True
         batches = self._inputs or [empty_batch(self._schema)]
-        merged = concat_batches(batches)
-        order = _apply_sort(merged, self._keys)
-        self._out = _gather_sorted(merged, order)
+        self._out = _concat_sort(tuple(batches), tuple(self._keys))
         self._inputs = []
 
     def get_output(self) -> Optional[RelBatch]:
@@ -272,17 +312,13 @@ class TopNOperator(Operator):
         self._out: Optional[RelBatch] = None
 
     def add_input(self, batch: RelBatch) -> None:
-        merged = (
-            batch
+        parts = (
+            (batch,)
             if self._reservoir is None
-            else concat_batches([self._reservoir, batch])
+            else (self._reservoir, batch)
         )
-        order = _apply_sort(merged, self._keys)
-        cap = bucket_capacity(min(self._n, merged.capacity))
-        top = order[:cap]
-        n_live = jnp.minimum(jnp.sum(merged.live_mask()), self._n)
-        live = jnp.arange(cap) < n_live
-        self._reservoir = merged.gather(top, live)
+        cap = bucket_capacity(min(self._n, sum(p.capacity for p in parts)))
+        self._reservoir = _topn_merge(parts, tuple(self._keys), self._n, cap)
 
     def finish(self) -> None:
         if self._finishing:
@@ -422,12 +458,97 @@ def _agg_output(spec: AggSpec, state, arg_type: Optional[T.DataType],
     raise NotImplementedError(spec.kind)
 
 
+_BATCH_REDUCER = {"sum": "sum", "avg": "sum", "count": "count",
+                  "count_star": "count", "min": "min", "max": "max",
+                  "any": "first"}
+# merging two partial states: counts add, mins min, firsts keep-first
+_MERGE_REDUCER = {"sum": "sum", "avg": "sum", "count": "sum",
+                  "count_star": "sum", "min": "min", "max": "max",
+                  "any": "first"}
+
+@partial(jax.jit, static_argnames=("reducers", "out_capacity"))
+def _merge_group_states(a, b, reducers: tuple, out_capacity: int):
+    """Concat two (keys, valids, used, vals, cnts) group-state sets and
+    re-group-reduce them — the whole merge is ONE device program."""
+    keys = [jnp.concatenate([x, y]) for x, y in zip(a[0], b[0])]
+    valids = [jnp.concatenate([x, y]) for x, y in zip(a[1], b[1])]
+    mask = jnp.concatenate([a[2], b[2]])
+    values, vvalids, reds = [], [], []
+    for i, mred in enumerate(reducers):
+        v = jnp.concatenate([a[3][i], b[3][i]])
+        c = jnp.concatenate([a[4][i], b[4][i]])
+        values.append(v)
+        vvalids.append((c > 0) if mred == "first" else None)
+        reds.append(mred)
+        values.append(c)
+        vvalids.append(None)
+        reds.append("sum")
+    gk, gv, used, vals, _, _, ovf = G.sort_group_reduce(
+        keys, valids, mask, values, tuple(vvalids), tuple(reds), out_capacity
+    )
+    return (gk, gv, used, list(vals[0::2]), list(vals[1::2])), ovf
+
+
+_GLOBAL_FN_CACHE: Dict[Tuple[AggSpec, ...], object] = {}
+
+
+def _global_update_fn(aggs: Tuple[AggSpec, ...]):
+    """Jitted whole-batch reduction for GROUP-BY-less aggregation —
+    shared across instances (AccumulatorCompiler cache analogue)."""
+    if aggs not in _GLOBAL_FN_CACHE:
+
+        @jax.jit
+        def update(states, batch: RelBatch):
+            live = batch.live_mask()
+            out = []
+            for a, (val, cnt) in zip(aggs, states):
+                if a.arg_channel is None:
+                    data, valid = live.astype(jnp.int64), None
+                else:
+                    col = batch.columns[a.arg_channel]
+                    data, valid = col.data, col.valid
+                w = live if valid is None else (live & valid)
+                n = jnp.sum(w.astype(jnp.int64))
+                if a.kind in ("count", "count_star"):
+                    out.append((val + n, cnt + n))
+                elif a.kind in ("sum", "avg"):
+                    contrib = jnp.where(w, data.astype(val.dtype), 0)
+                    out.append((val + jnp.sum(contrib), cnt + n))
+                elif a.kind in ("min", "max"):
+                    if jnp.issubdtype(data.dtype, jnp.floating):
+                        neutral = jnp.inf if a.kind == "min" else -jnp.inf
+                    elif data.dtype == jnp.bool_:
+                        neutral = a.kind == "min"
+                    else:
+                        info = jnp.iinfo(data.dtype)
+                        neutral = info.max if a.kind == "min" else info.min
+                    masked = jnp.where(w, data, jnp.asarray(neutral, data.dtype))
+                    red = jnp.min(masked) if a.kind == "min" else jnp.max(masked)
+                    op = jnp.minimum if a.kind == "min" else jnp.maximum
+                    out.append((op(val, red.astype(val.dtype)), cnt + n))
+                elif a.kind == "any":
+                    first = data[jnp.argmax(w)]
+                    new_val = jnp.where(
+                        cnt > 0, val, jnp.where(jnp.any(w), first, val)
+                    )
+                    out.append((new_val, cnt + n))
+                else:
+                    raise NotImplementedError(a.kind)
+            return out
+
+        _GLOBAL_FN_CACHE[aggs] = update
+    return _GLOBAL_FN_CACHE[aggs]
+
+
 class HashAggregationOperator(Operator):
-    """GROUP BY + aggregates over the streaming group table
-    (HashAggregationOperator.java:53 + GroupByHash; rebuild-on-overflow
-    replaces tryRehash). `group_channels` select the key columns;
-    aggregates read their arg channels. Output schema =
-    [group keys..., aggregate results...]."""
+    """GROUP BY + aggregates (HashAggregationOperator.java:53 +
+    GroupByHash). The engine-path implementation is the SORT-BASED
+    group-reduce (ops/groupby.sort_group_reduce) — XLA lowers scatters
+    near-serially on TPU, so the linear-probe table is reserved for the
+    mesh-exchange partials while this operator reduces each batch by
+    sort + segmented scans and then merges per-batch group states the
+    same way (partial->final within one operator). Output schema =
+    [group keys..., aggregate results...]; group rows come out dense."""
 
     def __init__(
         self,
@@ -440,82 +561,87 @@ class HashAggregationOperator(Operator):
         self._aggs = list(aggregates)
         self._schema = list(input_schema)
         self._global = not self._group_channels
-        cap = 1 if self._global else initial_capacity
-        self._capacity = cap
-        key_dtypes = [self._schema[c][0].dtype for c in self._group_channels]
-        self._table = G.new_group_table(key_dtypes, cap) if not self._global else None
-        self._states = [
-            _agg_state_init(
-                a,
-                self._schema[a.arg_channel][0].dtype
-                if a.arg_channel is not None
-                else np.int64,
-                cap,
-            )
-            for a in self._aggs
-        ]
+        self._cap = initial_capacity
+        # accumulated group state: (keys, valids, used, vals, cnts)
+        self._acc = None
+        self._gstate = None
         self._out: Optional[RelBatch] = None
-        self._seen_any = False
+        if self._global:
+            self._update = _global_update_fn(tuple(self._aggs))
 
-        @jax.jit
-        def _update_states(states, gid, batch: RelBatch):
-            capacity = states[0][0].shape[0]
-            live = batch.live_mask()
-            new_states = []
-            for a, st in zip(self._aggs, states):
-                if a.arg_channel is None:
-                    data, valid = jnp.zeros_like(live, dtype=jnp.int64), None
-                else:
-                    col = batch.columns[a.arg_channel]
-                    data, valid = col.data, col.valid
-                new_states.append(
-                    _agg_state_update(a, st, gid, data, valid, live, capacity)
-                )
-            return new_states
-
-        self._update_states = _update_states
+    # -- grouped path --
+    def _batch_values(self, batch: RelBatch):
+        live = batch.live_mask()
+        values, vvalids, reds = [], [], []
+        for a in self._aggs:
+            if a.arg_channel is None:
+                values.append(live.astype(jnp.int64))
+                vvalids.append(None)
+            else:
+                col = batch.columns[a.arg_channel]
+                values.append(col.data)
+                vvalids.append(col.valid)
+            reds.append(_BATCH_REDUCER[a.kind])
+        return live, values, vvalids, tuple(reds)
 
     def add_input(self, batch: RelBatch) -> None:
-        self._seen_any = True
         if self._global:
-            gid = jnp.where(batch.live_mask(), 0, 1).astype(jnp.int32)
-        else:
-            keys = [batch.columns[c].data for c in self._group_channels]
-            valids = [batch.columns[c].valid_mask() for c in self._group_channels]
-            gid, table, overflowed = G.insert_group_ids(
-                self._table, keys, valids, batch.live_mask()
+            if self._gstate is None:
+                self._gstate = self._global_init()
+            self._gstate = self._update(self._gstate, batch)
+            return
+        keys = [batch.columns[c].data for c in self._group_channels]
+        valids = [batch.columns[c].valid_mask() for c in self._group_channels]
+        live, values, vvalids, reds = self._batch_values(batch)
+        while True:
+            gk, gv, used, vals, cnts, _, ovf = G.sort_group_reduce(
+                keys, valids, live, values, vvalids, reds, self._cap
             )
-            self._table = table
-            # grow-and-retry until the whole batch fits (keys inserted by
-            # a failed round carry zero state, so re-inserting is safe:
-            # accumulation below runs exactly once)
-            while bool(overflowed):
-                self._grow(self._capacity * 2)
-                gid, self._table, overflowed = G.insert_group_ids(
-                    self._table, keys, valids, batch.live_mask()
+            if not bool(ovf):
+                break
+            self._cap *= 2  # rebuild-at-larger-capacity (tryRehash analogue)
+        new = (gk, gv, used, vals, cnts)
+        self._acc = new if self._acc is None else self._merge(self._acc, new)
+
+    def _merge(self, a, b):
+        """Merge two group-state sets (partial->final merge), one device
+        program per attempt; host doubles capacity on overflow."""
+        reducers = tuple(_MERGE_REDUCER[x.kind] for x in self._aggs)
+        while True:
+            merged, ovf = _merge_group_states(tuple(a), tuple(b), reducers, self._cap)
+            if not bool(ovf):
+                return merged
+            self._cap *= 2
+
+    # -- global path --
+    def _global_init(self):
+        states = []
+        for a in self._aggs:
+            dt = (
+                self._schema[a.arg_channel][0].dtype
+                if a.arg_channel is not None
+                else np.dtype(np.int64)
+            )
+            if a.kind in ("count", "count_star"):
+                val = jnp.int64(0)
+            elif a.kind in ("sum", "avg"):
+                acc_dt = (
+                    jnp.float64 if np.issubdtype(dt, np.floating) else jnp.int64
                 )
-            # keep load factor below ~62% so probe chains stay short
-            if int(self._table.num_groups()) * 8 > self._capacity * 5:
-                self._grow_after = True
-        self._states = self._update_states(self._states, gid, batch)
-        if getattr(self, "_grow_after", False):
-            self._grow_after = False
-            self._grow(self._capacity * 2)
-
-    def _grow(self, new_capacity: int) -> None:
-        self._table, remap = G.grow_table(self._table, new_capacity)
-        self._states = [
-            _agg_state_migrate(a, self._arg_dtype(a), st, remap, new_capacity)
-            for a, st in zip(self._aggs, self._states)
-        ]
-        self._capacity = new_capacity
-
-    def _arg_dtype(self, a: AggSpec):
-        return (
-            self._schema[a.arg_channel][0].dtype
-            if a.arg_channel is not None
-            else np.int64
-        )
+                val = jnp.zeros((), dtype=acc_dt)
+            elif a.kind in ("min", "max"):
+                if np.issubdtype(dt, np.floating):
+                    v = np.inf if a.kind == "min" else -np.inf
+                elif dt == np.bool_:
+                    v = a.kind == "min"
+                else:
+                    info = np.iinfo(dt)
+                    v = info.max if a.kind == "min" else info.min
+                val = jnp.asarray(v, dtype=dt)
+            else:  # any
+                val = jnp.zeros((), dtype=dt)
+            states.append((val, jnp.int64(0)))
+        return states
 
     def finish(self) -> None:
         if self._finishing:
@@ -523,20 +649,45 @@ class HashAggregationOperator(Operator):
         self._finishing = True
         cols: List[Column] = []
         if self._global:
+            states = self._gstate if self._gstate is not None else self._global_init()
             live = jnp.ones(1, dtype=jnp.bool_)
-        else:
-            live = self._table.slot_used
-            for ch, sk, sv in zip(
-                self._group_channels, self._table.slot_keys, self._table.slot_valids
-            ):
-                t, d = self._schema[ch]
-                cols.append(Column(t, sk, sv, d))
-        for a, st in zip(self._aggs, self._states):
-            arg_t, arg_d = (
-                self._schema[a.arg_channel] if a.arg_channel is not None else (None, None)
+            for a, (val, cnt) in zip(self._aggs, states):
+                state = (
+                    (val[None],)
+                    if a.kind in ("count", "count_star")
+                    else (val[None], cnt[None])
+                )
+                arg_t, arg_d = (
+                    self._schema[a.arg_channel]
+                    if a.arg_channel is not None
+                    else (None, None)
+                )
+                cols.append(_agg_output(a, state, arg_t, arg_d))
+            self._out = RelBatch(cols, live)
+            return
+        if self._acc is None:
+            # no input: empty group set
+            key_dts = [self._schema[c][0].dtype for c in self._group_channels]
+            self._acc = (
+                [jnp.zeros(16, dtype=dt) for dt in key_dts],
+                [jnp.zeros(16, dtype=jnp.bool_) for _ in key_dts],
+                jnp.zeros(16, dtype=jnp.bool_),
+                [jnp.zeros(16, dtype=jnp.int64) for _ in self._aggs],
+                [jnp.zeros(16, dtype=jnp.int64) for _ in self._aggs],
             )
-            cols.append(_agg_output(a, st, arg_t, arg_d))
-        self._out = RelBatch(cols, live)
+        gk, gv, used, vals, cnts = self._acc
+        for ch, k, v in zip(self._group_channels, gk, gv):
+            t, d = self._schema[ch]
+            cols.append(Column(t, k, v, d))
+        for a, val, cnt in zip(self._aggs, vals, cnts):
+            state = (val,) if a.kind in ("count", "count_star") else (val, cnt)
+            arg_t, arg_d = (
+                self._schema[a.arg_channel]
+                if a.arg_channel is not None
+                else (None, None)
+            )
+            cols.append(_agg_output(a, state, arg_t, arg_d))
+        self._out = RelBatch(cols, used)
 
     def get_output(self) -> Optional[RelBatch]:
         out, self._out = self._out, None
@@ -561,6 +712,16 @@ class JoinBridge:
         self.build_batch: Optional[RelBatch] = None
 
 
+@partial(jax.jit, static_argnames=("key_channels",))
+def _consolidate_build(parts: Tuple[RelBatch, ...], key_channels: Tuple[int, ...]):
+    """Consolidate build batches + build the LookupSource in one device
+    program (HashBuilderOperator.java:58)."""
+    merged = concat_batches(list(parts))
+    keys = [merged.columns[c].data for c in key_channels]
+    valids = [merged.columns[c].valid_mask() for c in key_channels]
+    return J.build_lookup(keys, valids, merged.live_mask()), merged
+
+
 class HashBuildSink(Operator):
     """Consumes the build side, consolidates, builds the LookupSource
     (HashBuilderOperator.java:58 — one sort instead of row inserts)."""
@@ -579,10 +740,9 @@ class HashBuildSink(Operator):
         if self._finishing:
             return
         self._finishing = True
-        merged = concat_batches(self._inputs or [empty_batch(self._schema)])
-        keys = [merged.columns[c].data for c in self._keys]
-        valids = [merged.columns[c].valid_mask() for c in self._keys]
-        self._bridge.lookup_source = J.build_lookup(keys, valids, merged.live_mask())
+        parts = tuple(self._inputs or [empty_batch(self._schema)])
+        ls, merged = _consolidate_build(parts, tuple(self._keys))
+        self._bridge.lookup_source = ls
         self._bridge.build_batch = merged
         self._inputs = []
 
@@ -591,6 +751,62 @@ class HashBuildSink(Operator):
 
     def is_finished(self) -> bool:
         return self._finishing
+
+
+@partial(jax.jit, static_argnames=("out_cap",))
+def _expand_pairs(ls, probe: RelBatch, build: RelBatch, keys, valids, lo, counts, out_cap: int):
+    """Expansion + pair gather in one device program (JoinProbe +
+    LookupJoinPageBuilder fused — join/LookupJoinOperator.java:36)."""
+    pi, bi, ok = J.expand_matches(ls, keys, valids, lo, counts, out_cap)
+    cols = [c.gather(pi) for c in probe.columns]
+    cols += [c.gather(bi) for c in build.columns]
+    return pi, bi, ok, RelBatch(cols, ok)
+
+
+@jax.jit
+def _segment_any(counts, pi, ok, probe_capacity):
+    """Per-probe-row 'any verified pair' WITHOUT scatter: pi is emitted
+    in nondecreasing order by expand_matches, so each probe row's pairs
+    are the segment [off-counts, off) — reduce via cumsum+gather."""
+    e = ok.shape[0]
+    okc = jnp.cumsum(ok.astype(jnp.int32))
+    exc = okc - ok.astype(jnp.int32)
+    off = jnp.cumsum(counts)
+    start = off - counts
+    seg = jnp.take(okc, jnp.clip(off - 1, 0, max(e - 1, 0))) - jnp.take(
+        exc, jnp.clip(start, 0, max(e - 1, 0))
+    )
+    return (counts > 0) & (seg > 0)
+
+
+@jax.jit
+def _left_unmatched(probe: RelBatch, build: RelBatch, matched):
+    """Unmatched probe rows with NULL build columns (LEFT outer arm)."""
+    nulls = [
+        Column(
+            c.type,
+            jnp.zeros(probe.capacity, dtype=c.data.dtype),
+            jnp.zeros(probe.capacity, dtype=jnp.bool_),
+            c.dictionary,
+        )
+        for c in build.columns
+    ]
+    return RelBatch(
+        list(probe.columns) + nulls, probe.live_mask() & ~matched
+    )
+
+
+def make_residual_fn(residual: Bound):
+    """Plan-time compiled residual evaluator over pair batches."""
+
+    @jax.jit
+    def fn(pairs: RelBatch):
+        cols = [c.data for c in pairs.columns]
+        vs = [c.valid for c in pairs.columns]
+        d, v = residual.fn(cols, vs)
+        return d if v is None else (d & v)
+
+    return fn
 
 
 class LookupJoinOperator(Operator):
@@ -611,44 +827,42 @@ class LookupJoinOperator(Operator):
         join_type: str,
         probe_schema: Sequence[Tuple[T.DataType, Optional[Dictionary]]],
         residual: Optional[Bound] = None,
+        residual_fn=None,
     ):
         self._bridge = bridge
         self._keys = list(key_channels)
         self._type = join_type
         self._probe_schema = list(probe_schema)
         self._residual = residual
+        self._residual_fn = (
+            residual_fn
+            if residual_fn is not None
+            else (make_residual_fn(residual) if residual is not None else None)
+        )
         self._outputs: List[RelBatch] = []
 
     def needs_input(self) -> bool:
         return not self._outputs and not self._finishing
 
-    def _pair_batch(self, probe: RelBatch, pi, bi, ok) -> RelBatch:
-        build = self._bridge.build_batch
-        cols = [c.gather(pi) for c in probe.columns]
-        cols += [c.gather(bi) for c in build.columns]
-        return RelBatch(cols, ok)
-
     def add_input(self, probe: RelBatch) -> None:
         ls = self._bridge.lookup_source
+        build = self._bridge.build_batch
         keys = [probe.columns[c].data for c in self._keys]
         valids = [probe.columns[c].valid_mask() for c in self._keys]
         live = probe.live_mask()
         lo, counts, total = J.probe_counts(ls, keys, valids, live)
         total = int(total)
         out_cap = bucket_capacity(max(total, 1))
-        pi, bi, ok = J.expand_matches(ls, keys, valids, lo, counts, out_cap)
-        pairs = self._pair_batch(probe, pi, bi, ok)
-        if self._residual is not None:
-            cols = [c.data for c in pairs.columns]
-            vs = [c.valid for c in pairs.columns]
-            d, v = self._residual.fn(cols, vs)
-            keep = d if v is None else (d & v)
-            ok = ok & keep
+        pi, bi, ok, pairs = _expand_pairs(
+            ls, probe, build, keys, valids, lo, counts, out_cap
+        )
+        if self._residual_fn is not None:
+            ok = ok & self._residual_fn(pairs)
             pairs = RelBatch(pairs.columns, ok)
         if self._type == "inner":
             self._outputs.append(pairs)
             return
-        matched = J.probe_matched_flags(probe.capacity, pi, ok)
+        matched = _segment_any(counts, pi, ok, probe.capacity)
         if self._type == "semi":
             self._outputs.append(probe.mask(matched))
             return
@@ -657,20 +871,7 @@ class LookupJoinOperator(Operator):
             return
         if self._type == "left":
             self._outputs.append(pairs)
-            # unmatched probe rows keep probe columns, NULL build columns
-            build = self._bridge.build_batch
-            nulls = [
-                Column(
-                    c.type,
-                    jnp.zeros(probe.capacity, dtype=c.type.dtype),
-                    jnp.zeros(probe.capacity, dtype=jnp.bool_),
-                    c.dictionary,
-                )
-                for c in build.columns
-            ]
-            self._outputs.append(
-                RelBatch(list(probe.columns) + nulls, live & ~matched)
-            )
+            self._outputs.append(_left_unmatched(probe, build, matched))
             return
         raise NotImplementedError(self._type)
 
@@ -686,6 +887,27 @@ class LookupJoinOperator(Operator):
 # ---------------------------------------------------------------------------
 # Cross join (NestedLoopJoinOperator.java analogue)
 # ---------------------------------------------------------------------------
+
+
+@jax.jit
+def _consolidate_compact(parts: Tuple[RelBatch, ...]) -> RelBatch:
+    return concat_batches(list(parts)).compact()
+
+
+@partial(jax.jit, static_argnames=("b",))
+def _cross_row(probe: RelBatch, build: RelBatch, b: int) -> RelBatch:
+    bcols = [
+        Column(
+            c.type,
+            jnp.broadcast_to(c.data[b], (probe.capacity,)),
+            None
+            if c.valid is None
+            else jnp.broadcast_to(c.valid[b], (probe.capacity,)),
+            c.dictionary,
+        )
+        for c in build.columns
+    ]
+    return RelBatch(list(probe.columns) + bcols, probe.live)
 
 
 class CrossJoinBuildSink(Operator):
@@ -704,7 +926,7 @@ class CrossJoinBuildSink(Operator):
         if self._finishing:
             return
         self._finishing = True
-        merged = concat_batches(self._inputs or [empty_batch(self._schema)]).compact()
+        merged = _consolidate_compact(tuple(self._inputs or [empty_batch(self._schema)]))
         self._bridge.build_batch = merged
         self._inputs = []
 
@@ -727,18 +949,7 @@ class CrossJoinOperator(Operator):
         build = self._bridge.build_batch
         n_build = build.row_count()
         for b in range(n_build):
-            bcols = [
-                Column(
-                    c.type,
-                    jnp.broadcast_to(c.data[b], (probe.capacity,)),
-                    None
-                    if c.valid is None
-                    else jnp.broadcast_to(c.valid[b], (probe.capacity,)),
-                    c.dictionary,
-                )
-                for c in build.columns
-            ]
-            self._outputs.append(RelBatch(list(probe.columns) + bcols, probe.live))
+            self._outputs.append(_cross_row(probe, build, b))
 
     def get_output(self) -> Optional[RelBatch]:
         if self._outputs:
@@ -752,6 +963,50 @@ class CrossJoinOperator(Operator):
 # ---------------------------------------------------------------------------
 # Sink
 # ---------------------------------------------------------------------------
+
+
+class BufferSink(Operator):
+    """Collects batches for a later pipeline (the LocalExchange handoff,
+    main/operator/exchange/LocalExchange.java:67 — single-buffer form)."""
+
+    def __init__(self):
+        self.batches: List[RelBatch] = []
+
+    def add_input(self, batch: RelBatch) -> None:
+        self.batches.append(batch)
+
+    def is_finished(self) -> bool:
+        return self._finishing
+
+
+class BufferSource(Operator):
+    """Replays one or more BufferSinks' batches (consumer side of the
+    handoff). The producing pipelines must run first."""
+
+    def __init__(self, sinks: Sequence[BufferSink]):
+        self._sinks = list(sinks)
+        self._batches: Optional[List[RelBatch]] = None
+        self._i = 0
+
+    def needs_input(self) -> bool:
+        return False
+
+    def _all(self) -> List[RelBatch]:
+        # producers are guaranteed finished before this pipeline runs
+        if self._batches is None:
+            self._batches = [b for s in self._sinks for b in s.batches]
+        return self._batches
+
+    def get_output(self) -> Optional[RelBatch]:
+        batches = self._all()
+        if self._i < len(batches):
+            b = batches[self._i]
+            self._i += 1
+            return b
+        return None
+
+    def is_finished(self) -> bool:
+        return self._i >= len(self._all())
 
 
 class CollectorSink(Operator):
@@ -768,7 +1023,10 @@ class CollectorSink(Operator):
         return self._finishing
 
     def rows(self) -> List[list]:
+        # ONE bulk device->host transfer for every result batch: remote
+        # devices pay a round trip per fetch, so never fetch per column
+        host_batches = jax.device_get(self.batches)
         out = []
-        for b in self.batches:
+        for b in host_batches:
             out.extend(b.to_pylists())
         return out

@@ -159,6 +159,13 @@ class ExprBinder:
             return Bound(dst, nfn)
         if src.is_decimal and dst.is_decimal:
             return self._rescaled(a, src.scale or 0, dst.scale or 0, dst)
+        if src.is_decimal and dst.is_integerlike:
+            sf = T.decimal_scale_factor(src)
+            def difn(cols, valids, afn=a.fn):
+                d, v = afn(cols, valids)
+                q = F.div_round_half_away(d, _const(d, sf, d.dtype))
+                return q.astype(dst.dtype), v
+            return Bound(dst, difn)
         if src.is_decimal and dst.is_floating:
             sf = T.decimal_scale_factor(src)
             def dffn(cols, valids, afn=a.fn):

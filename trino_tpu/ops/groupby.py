@@ -207,3 +207,149 @@ def seg_max(gid, values, weight_mask, capacity):
 def seg_any(gid, flags, weight_mask, capacity):
     z = jnp.zeros(capacity + 1, dtype=jnp.bool_)
     return z.at[gid].max(flags & weight_mask)[:capacity]
+
+
+# ---------------------------------------------------------------------------
+# Sort-based group-reduce — the TPU-native fast path.
+#
+# XLA lowers scatters to (near-)serial loops on TPU, so the linear-probe
+# table above is only used where its streaming API is required (the
+# mesh-exchange partial tables). The single-device aggregation hot path
+# instead sorts rows by key (TPU sorts are fast), finds segment
+# boundaries, and reduces segments with cumsum+gather (sums/counts) and
+# segmented associative scans (min/max/first) — zero scatters end to end.
+# Group ids come out dense [0, n_groups), which also makes the output
+# batch compact for free.
+# ---------------------------------------------------------------------------
+
+
+def _group_sort_order(keys, valids, mask):
+    """Stable lexicographic order by (live desc, key columns); invalid
+    (NULL) key lanes neutralized so NULL == NULL groups together."""
+    n = keys[0].shape[0]
+    order = jnp.arange(n, dtype=jnp.int32)
+    for k, v in reversed(list(zip(keys, valids))):
+        kk = jnp.where(v, k, jnp.zeros((), dtype=k.dtype))
+        order = jnp.take(order, jnp.argsort(jnp.take(kk, order), stable=True))
+        order = jnp.take(
+            order, jnp.argsort(jnp.take(~v, order), stable=True)
+        )
+    order = jnp.take(order, jnp.argsort(jnp.take(~mask, order), stable=True))
+    return order
+
+
+def _seg_scan(op, neutral, flags, vals):
+    """Segmented inclusive scan: `flags` marks segment starts; `op` must
+    be associative. Runs as one lax.associative_scan (log-depth on TPU)."""
+
+    def combine(a, b):
+        af, av = a
+        bf, bv = b
+        return af | bf, jnp.where(bf, bv, op(av, bv))
+
+    _, out = jax.lax.associative_scan(combine, (flags, vals))
+    return out
+
+
+@partial(jax.jit, static_argnames=("reducers", "out_capacity"))
+def sort_group_reduce(
+    keys: Sequence[jnp.ndarray],
+    valids: Sequence[jnp.ndarray],
+    mask: jnp.ndarray,
+    values: Sequence[jnp.ndarray],
+    value_valids: Sequence[Optional[jnp.ndarray]],
+    reducers: tuple,  # per value: 'sum' | 'count' | 'min' | 'max' | 'first'
+    out_capacity: int,
+):
+    """Group by `keys` and reduce each value column in one pass.
+
+    Returns (group_keys, group_valids, used, results, counts, n_groups,
+    overflowed): group arrays of shape (out_capacity,) dense from 0;
+    `results[i]` is reducer i's per-group result; `counts[i]` the number
+    of non-null contributions (for SQL empty-group NULL semantics).
+    """
+    n = keys[0].shape[0]
+    order = _group_sort_order(keys, valids, mask)
+    sm = jnp.take(mask, order)
+    sk = [jnp.take(k, order) for k in keys]
+    sv = [jnp.take(v, order) for v in valids]
+
+    # segment boundaries among live rows (NULL == NULL)
+    same = None
+    for k, v in zip(sk, sv):
+        prev_k = jnp.roll(k, 1)
+        prev_v = jnp.roll(v, 1)
+        eq = ((k == prev_k) & v & prev_v) | (~v & ~prev_v)
+        same = eq if same is None else (same & eq)
+    if same is None:  # no keys: single segment
+        same = jnp.ones(n, dtype=jnp.bool_)
+    first_row = jnp.arange(n) == 0
+    prev_live = jnp.roll(sm, 1) & ~first_row
+    boundary = sm & (first_row | ~same | ~prev_live)
+    gid_sorted = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    n_groups = jnp.sum(boundary.astype(jnp.int32)) if n else jnp.int32(0)
+    overflowed = n_groups > out_capacity
+
+    # segment start positions, compacted to (out_capacity,)
+    sidx = jnp.where(boundary, jnp.arange(n, dtype=jnp.int32), jnp.int32(n))
+    starts = jnp.sort(sidx)[:out_capacity]
+    used = starts < n
+    safe_starts = jnp.clip(starts, 0, max(n - 1, 0))
+    next_starts = jnp.concatenate(
+        [starts[1:], jnp.full((1,), n, dtype=starts.dtype)]
+    )
+    ends = jnp.clip(jnp.where(used, next_starts, 1) - 1, 0, max(n - 1, 0))
+
+    group_keys = [jnp.take(k, safe_starts) for k in sk]
+    group_valids = [jnp.take(v, safe_starts) & used for v in sv]
+
+    results = []
+    counts = []
+    for v, vv, red in zip(values, value_valids, reducers):
+        sv_ = jnp.take(v, order)
+        w = sm if vv is None else (sm & jnp.take(vv, order))
+        cnt_c = jnp.cumsum(w.astype(jnp.int64))
+        cnt_ex = cnt_c - w.astype(jnp.int64)
+        cnt = jnp.take(cnt_c, ends) - jnp.take(cnt_ex, safe_starts)
+        counts.append(jnp.where(used, cnt, 0))
+        if red in ("sum", "count"):
+            acc_dt = (
+                jnp.float64
+                if jnp.issubdtype(sv_.dtype, jnp.floating)
+                else jnp.int64
+            )
+            contrib = jnp.where(w, sv_.astype(acc_dt), jnp.zeros((), acc_dt))
+            if red == "count":
+                contrib = w.astype(jnp.int64)
+            c = jnp.cumsum(contrib)
+            ex = c - contrib
+            out = jnp.take(c, ends) - jnp.take(ex, safe_starts)
+        elif red in ("min", "max"):
+            if jnp.issubdtype(sv_.dtype, jnp.floating):
+                neutral = jnp.inf if red == "min" else -jnp.inf
+            elif sv_.dtype == jnp.bool_:
+                neutral = red == "min"
+            else:
+                info = jnp.iinfo(sv_.dtype)
+                neutral = info.max if red == "min" else info.min
+            contrib = jnp.where(w, sv_, jnp.asarray(neutral, dtype=sv_.dtype))
+            op = jnp.minimum if red == "min" else jnp.maximum
+            scanned = _seg_scan(op, neutral, boundary, contrib)
+            out = jnp.take(scanned, ends)
+        elif red == "first":
+            # first non-null value per segment: segmented keep-first scan
+            def combine(a, b):
+                af, ah, av = a
+                bf, bh, bv = b
+                h = jnp.where(bf, bh, ah | bh)
+                val = jnp.where(bf, bv, jnp.where(ah, av, bv))
+                return af | bf, h, val
+
+            _, _, scanned = jax.lax.associative_scan(
+                combine, (boundary, w, sv_)
+            )
+            out = jnp.take(scanned, ends)
+        else:
+            raise ValueError(red)
+        results.append(out)
+    return group_keys, group_valids, used, results, counts, n_groups, overflowed

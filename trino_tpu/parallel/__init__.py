@@ -1,0 +1,4 @@
+from trino_tpu.parallel.exchange import (
+    distributed_groupby_step,
+    partition_for_exchange,
+)

@@ -1,0 +1,1336 @@
+"""Analyzer + logical planner: AST -> typed logical plan.
+
+Plays the role of Trino's Analyzer/StatementAnalyzer + LogicalPlanner/
+RelationPlanner/QueryPlanner (main/sql/analyzer/StatementAnalyzer.java:391,
+main/sql/planner/LogicalPlanner.java:232 — SURVEY.md §2.1/2.2), fused
+into one pass: name/type resolution happens while the plan is built, so
+expressions come out as channel-indexed typed IR directly.
+
+Capabilities mirrored from the reference that shape this file:
+- implicit-join reordering: FROM lists + WHERE equi-conjuncts become a
+  greedy hash-join tree with smaller side as build (the stats-lite
+  stand-in for the CBO's join ordering, main/cost/).
+- subquery planning: EXISTS/NOT EXISTS -> semi/anti joins with residual
+  filters; IN (subquery) -> semi/anti joins; scalar subqueries ->
+  cross join (uncorrelated) or group-by + left join (correlated equi
+  pattern) — the TransformCorrelated* / TransformExistsApplyToCorrelatedJoin
+  rule family (main/sql/planner/iterative/rule/).
+- aggregation analysis: group keys + aggregate calls pre-projected to
+  channels; SELECT/HAVING/ORDER BY rewritten over the aggregate output
+  (AggregationAnalyzer analogue).
+
+Known deviations (documented, revisit with the type-system hardening):
+- NOT IN (subquery) uses NOT EXISTS (null-unaware) semantics.
+- decimal / decimal division returns DOUBLE.
+- avg() returns DOUBLE for every argument type.
+- an uncorrelated scalar subquery returning ZERO rows drops outer rows
+  (plain cross join) instead of yielding NULL; global-aggregate scalars
+  (the common case) always return one row and are unaffected.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from trino_tpu import types as T
+from trino_tpu.connectors.spi import CatalogManager
+from trino_tpu.expr import ir
+from trino_tpu.ops.sort import SortKey
+from trino_tpu.sql import ast
+from trino_tpu.sql import plan as P
+
+AGG_FUNCS = {"sum", "count", "avg", "min", "max", "any_value", "arbitrary"}
+
+_EPOCH = datetime.date(1970, 1, 1)
+
+
+class AnalysisError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Scopes
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ScopeField:
+    qualifier: Optional[str]
+    name: Optional[str]
+    type: T.DataType
+
+
+class Scope:
+    """Channel-aligned name table for one plan node's output."""
+
+    def __init__(self, fields: Sequence[ScopeField]):
+        self.fields = list(fields)
+
+    def __len__(self):
+        return len(self.fields)
+
+    def try_resolve(self, parts: Tuple[str, ...]) -> Optional[Tuple[int, T.DataType]]:
+        if len(parts) == 1:
+            qualifier, name = None, parts[0]
+        elif len(parts) == 2:
+            qualifier, name = parts
+        else:
+            return None
+        hits = [
+            (i, f.type)
+            for i, f in enumerate(self.fields)
+            if f.name == name and (qualifier is None or f.qualifier == qualifier)
+        ]
+        if len(hits) > 1:
+            raise AnalysisError(f"column '{'.'.join(parts)}' is ambiguous")
+        return hits[0] if hits else None
+
+    def resolve(self, parts: Tuple[str, ...]) -> Tuple[int, T.DataType]:
+        hit = self.try_resolve(parts)
+        if hit is None:
+            raise AnalysisError(f"column '{'.'.join(parts)}' cannot be resolved")
+        return hit
+
+    @staticmethod
+    def concat(a: "Scope", b: "Scope") -> "Scope":
+        return Scope(a.fields + b.fields)
+
+
+def _plan_fields(scope: Scope) -> Tuple[P.Field, ...]:
+    return tuple(P.Field(f.name, f.type) for f in scope.fields)
+
+
+# ---------------------------------------------------------------------------
+# Expression conversion
+# ---------------------------------------------------------------------------
+
+
+def _number_literal(text: str) -> ir.Literal:
+    if "e" in text.lower():
+        return ir.Literal(float(text), T.DOUBLE)
+    if "." in text:
+        frac = text.split(".")[1]
+        scale = len(frac)
+        digits = len(text.replace(".", "").lstrip("0")) or 1
+        return ir.Literal(float(text), T.decimal(max(digits, scale + 1), scale))
+    v = int(text)
+    return ir.Literal(v, T.BIGINT)
+
+
+def _date_days(value: str) -> int:
+    y, m, d = map(int, value.split("-"))
+    return (datetime.date(y, m, d) - _EPOCH).days
+
+
+def _shift_date(days: int, unit: str, n: int) -> int:
+    d = _EPOCH + datetime.timedelta(days=days)
+    if unit == "day":
+        return days + n
+    if unit == "month":
+        m = d.month - 1 + n
+        y = d.year + m // 12
+        m = m % 12 + 1
+        import calendar
+
+        day = min(d.day, calendar.monthrange(y, m)[1])
+        return (datetime.date(y, m, day) - _EPOCH).days
+    if unit == "year":
+        return _shift_date(days, "month", 12 * n)
+    raise AnalysisError(f"unsupported interval unit {unit}")
+
+
+def _unify_types(types: Sequence[T.DataType]) -> T.DataType:
+    types = [t for t in types if t.kind != T.TypeKind.UNKNOWN]
+    if not types:
+        return T.UNKNOWN
+    if any(t.is_string for t in types):
+        return T.VARCHAR
+    if any(t.is_floating for t in types):
+        return T.DOUBLE
+    if any(t.is_decimal for t in types):
+        scale = max((t.scale or 0) for t in types if t.is_decimal)
+        return T.decimal(18, scale)
+    if any(t.kind == T.TypeKind.DATE for t in types):
+        return T.DATE
+    if any(t.kind == T.TypeKind.BOOLEAN for t in types):
+        return T.BOOLEAN
+    return T.BIGINT
+
+
+def _arith_type(op: str, lt: T.DataType, rt: T.DataType) -> T.DataType:
+    if lt.kind == T.TypeKind.DATE or rt.kind == T.TypeKind.DATE:
+        return T.DATE
+    if lt.is_floating or rt.is_floating:
+        return T.DOUBLE
+    if lt.is_decimal or rt.is_decimal:
+        sa = lt.scale or 0 if lt.is_decimal else 0
+        sb = rt.scale or 0 if rt.is_decimal else 0
+        if op == "div":
+            return T.DOUBLE  # documented deviation
+        if op == "mul":
+            return T.decimal(18, min(sa + sb, 12))
+        if op == "mod":
+            return T.decimal(18, max(sa, sb))
+        return T.decimal(18, max(sa, sb))
+    return T.BIGINT
+
+
+class ExprConverter:
+    """AST expression -> typed IR over one scope, honoring replacement
+    channels installed by aggregation/subquery planning."""
+
+    def __init__(
+        self,
+        scope: Scope,
+        replacements: Optional[Dict[ast.Expression, Tuple[int, T.DataType]]] = None,
+    ):
+        self.scope = scope
+        self.replacements = replacements or {}
+
+    def convert(self, e: ast.Expression) -> ir.Expr:
+        if e in self.replacements:
+            ch, t = self.replacements[e]
+            return ir.InputRef(ch, t)
+        if isinstance(e, ast.Identifier):
+            ch, t = self.scope.resolve(e.parts)
+            return ir.InputRef(ch, t)
+        if isinstance(e, ast.NumberLiteral):
+            return _number_literal(e.text)
+        if isinstance(e, ast.StringLiteral):
+            return ir.Literal(e.value, T.VARCHAR)
+        if isinstance(e, ast.BooleanLiteral):
+            return ir.Literal(e.value, T.BOOLEAN)
+        if isinstance(e, ast.NullLiteral):
+            return ir.Literal(None, T.UNKNOWN)
+        if isinstance(e, ast.DateLiteral):
+            return ir.Literal(_date_days(e.value), T.DATE)
+        if isinstance(e, ast.TimestampLiteral):
+            raise AnalysisError("timestamp literals not yet supported")
+        if isinstance(e, ast.IntervalLiteral):
+            raise AnalysisError("intervals are only supported in date arithmetic")
+        if isinstance(e, ast.BinaryOp):
+            return self._convert_binary(e)
+        if isinstance(e, ast.UnaryOp):
+            if e.op == "not":
+                return ir.not_(self.convert(e.operand))
+            if e.op == "negate":
+                a = self.convert(e.operand)
+                if isinstance(a, ir.Literal) and a.value is not None:
+                    return ir.Literal(-a.value, a.type)
+                return ir.Call("negate", (a,), a.type)
+        if isinstance(e, ast.IsNullPredicate):
+            x = ir.is_null(self.convert(e.operand))
+            return ir.not_(x) if e.negated else x
+        if isinstance(e, ast.Between):
+            v = self.convert(e.value)
+            lo = self.convert(e.low)
+            hi = self.convert(e.high)
+            x = ir.and_(ir.comparison("ge", v, lo), ir.comparison("le", v, hi))
+            return ir.not_(x) if e.negated else x
+        if isinstance(e, ast.InList):
+            v = self.convert(e.value)
+            opts = []
+            for o in e.options:
+                lit = self.convert(o)
+                if not isinstance(lit, ir.Literal):
+                    raise AnalysisError("IN list items must be literals")
+                opts.append(lit)
+            x: ir.Expr = ir.InList(v, tuple(opts))
+            return ir.not_(x) if e.negated else x
+        if isinstance(e, ast.Like):
+            v = self.convert(e.value)
+            pat = self.convert(e.pattern)
+            if not isinstance(pat, ir.Literal):
+                raise AnalysisError("LIKE pattern must be a literal")
+            args = [v, pat]
+            if e.escape is not None:
+                esc = self.convert(e.escape)
+                args.append(esc)
+            x = ir.Call("like", tuple(args), T.BOOLEAN)
+            return ir.not_(x) if e.negated else x
+        if isinstance(e, ast.Case):
+            return self._convert_case(e)
+        if isinstance(e, ast.Cast):
+            return self._convert_cast(e)
+        if isinstance(e, ast.Extract):
+            a = self.convert(e.operand)
+            if e.field not in ("year", "month", "day"):
+                raise AnalysisError(f"extract({e.field}) not supported")
+            return ir.Call(f"extract_{e.field}", (a,), T.BIGINT)
+        if isinstance(e, ast.FunctionCall):
+            return self._convert_call(e)
+        if isinstance(e, (ast.Exists, ast.InSubquery, ast.ScalarSubquery)):
+            raise AnalysisError(
+                "subquery in unsupported position (only WHERE/HAVING conjuncts)"
+            )
+        raise AnalysisError(f"cannot analyze expression {e!r}")
+
+    # -- binary --
+    def _convert_binary(self, e: ast.BinaryOp) -> ir.Expr:
+        op = e.op
+        if op in ("and", "or"):
+            return ir.Call(op, (self.convert(e.left), self.convert(e.right)), T.BOOLEAN)
+        if op in ("eq", "ne", "lt", "le", "gt", "ge"):
+            return ir.comparison(op, self.convert(e.left), self.convert(e.right))
+        if op == "is_distinct":
+            l, r = self.convert(e.left), self.convert(e.right)
+            # NOT ((a=b, null-safe false) OR (a NULL AND b NULL)) — the
+            # eq lane must be made definite (coalesce) so the result is
+            # never NULL, matching Trino's IS DISTINCT FROM
+            eq_definite = ir.Call(
+                "coalesce",
+                (ir.comparison("eq", l, r), ir.Literal(False, T.BOOLEAN)),
+                T.BOOLEAN,
+            )
+            same = ir.or_(eq_definite, ir.and_(ir.is_null(l), ir.is_null(r)))
+            return ir.not_(same)
+        if op in ("add", "sub", "mul", "div", "mod"):
+            # date +- interval
+            if isinstance(e.right, ast.IntervalLiteral) and op in ("add", "sub"):
+                return self._date_interval(e.left, e.right, op)
+            l = self.convert(e.left)
+            r = self.convert(e.right)
+            out_t = _arith_type(op, l.type, r.type)
+            return ir.Call(op, (l, r), out_t)
+        raise AnalysisError(f"operator {op} not supported")
+
+    def _date_interval(self, date_ast, interval: ast.IntervalLiteral, op) -> ir.Expr:
+        n = int(interval.value) * interval.sign * (1 if op == "add" else -1)
+        d = self.convert(date_ast)
+        if isinstance(d, ir.Literal) and d.type.kind == T.TypeKind.DATE:
+            return ir.Literal(_shift_date(d.value, interval.unit, n), T.DATE)
+        if interval.unit == "day":
+            return ir.Call("add", (d, ir.Literal(n, T.DATE)), T.DATE)
+        raise AnalysisError(
+            "month/year interval arithmetic requires a constant date operand"
+        )
+
+    def _convert_case(self, e: ast.Case) -> ir.Expr:
+        whens = list(e.whens)
+        if e.operand is not None:
+            conds = [
+                self.convert(ast.BinaryOp("eq", e.operand, w.condition)) for w in whens
+            ]
+        else:
+            conds = [self.convert(w.condition) for w in whens]
+        results = [self.convert(w.result) for w in whens]
+        default = self.convert(e.default) if e.default is not None else None
+        out_t = _unify_types(
+            [r.type for r in results] + ([default.type] if default is not None else [])
+        )
+        return ir.Case(tuple(conds), tuple(results), default, out_t)
+
+    def _convert_cast(self, e: ast.Cast) -> ir.Expr:
+        a = self.convert(e.operand)
+        t = e.target
+        mapping = {
+            "boolean": T.BOOLEAN, "tinyint": T.TINYINT, "smallint": T.SMALLINT,
+            "integer": T.INTEGER, "bigint": T.BIGINT, "real": T.REAL,
+            "double": T.DOUBLE, "date": T.DATE, "timestamp": T.TIMESTAMP,
+        }
+        if t.name in mapping:
+            return ir.Cast(a, mapping[t.name])
+        if t.name == "decimal":
+            p = t.params[0] if t.params else 18
+            s = t.params[1] if len(t.params) > 1 else 0
+            return ir.Cast(a, T.decimal(min(p, 18), s))
+        if t.name in ("varchar", "char"):
+            return ir.Cast(a, T.VARCHAR)
+        raise AnalysisError(f"cannot cast to {t.name}")
+
+    def _convert_call(self, e: ast.FunctionCall) -> ir.Expr:
+        name = e.name
+        if name in AGG_FUNCS:
+            raise AnalysisError(
+                f"aggregate function {name}() in a non-aggregate context"
+            )
+        args = tuple(self.convert(a) for a in e.args)
+        if name in ("substr", "substring"):
+            return ir.Call("substr", args, T.VARCHAR)
+        if name in ("upper", "lower"):
+            return ir.Call(name, args, T.VARCHAR)
+        if name == "length":
+            return ir.Call(name, args, T.BIGINT)
+        if name == "abs":
+            return ir.Call(name, args, args[0].type)
+        if name == "round":
+            return ir.Call(name, args, args[0].type)
+        if name in ("sqrt", "ln", "exp"):
+            return ir.Call(name, args, T.DOUBLE)
+        if name in ("floor", "ceil", "ceiling"):
+            nm = "ceil" if name == "ceiling" else name
+            out = T.DOUBLE if args[0].type.is_floating else T.BIGINT
+            return ir.Call(nm, args, out)
+        if name == "coalesce":
+            out = _unify_types([a.type for a in args])
+            return ir.Call(name, args, out)
+        raise AnalysisError(f"unknown function {name}()")
+
+
+# ---------------------------------------------------------------------------
+# Helpers over AST predicates
+# ---------------------------------------------------------------------------
+
+
+def split_conjuncts(e: Optional[ast.Expression]) -> List[ast.Expression]:
+    if e is None:
+        return []
+    if isinstance(e, ast.BinaryOp) and e.op == "and":
+        return split_conjuncts(e.left) + split_conjuncts(e.right)
+    return [e]
+
+
+def conjoin(parts: Sequence[ast.Expression]) -> Optional[ast.Expression]:
+    out = None
+    for p in parts:
+        out = p if out is None else ast.BinaryOp("and", out, p)
+    return out
+
+
+def _idents(e: ast.Expression) -> List[ast.Identifier]:
+    """All identifiers in an expression, NOT descending into subqueries."""
+    out: List[ast.Identifier] = []
+
+    def walk(x):
+        if isinstance(x, ast.Identifier):
+            out.append(x)
+            return
+        if isinstance(x, (ast.Exists, ast.InSubquery, ast.ScalarSubquery)):
+            return  # inner scope owns those
+        if dataclasses.is_dataclass(x):
+            for f in dataclasses.fields(x):
+                walk(getattr(x, f.name))
+        elif isinstance(x, tuple):
+            for i in x:
+                walk(i)
+
+    walk(e)
+    return out
+
+
+def _has_subquery(e: ast.Expression) -> bool:
+    found = False
+
+    def walk(x):
+        nonlocal found
+        if found:
+            return
+        if isinstance(x, (ast.Exists, ast.InSubquery, ast.ScalarSubquery)):
+            found = True
+            return
+        if dataclasses.is_dataclass(x):
+            for f in dataclasses.fields(x):
+                walk(getattr(x, f.name))
+        elif isinstance(x, tuple):
+            for i in x:
+                walk(i)
+
+    walk(e)
+    return found
+
+
+def _scalar_subqueries(e: ast.Expression) -> List[ast.ScalarSubquery]:
+    out: List[ast.ScalarSubquery] = []
+
+    def walk(x):
+        if isinstance(x, ast.ScalarSubquery):
+            out.append(x)
+            return
+        if isinstance(x, (ast.Exists, ast.InSubquery)):
+            return
+        if dataclasses.is_dataclass(x):
+            for f in dataclasses.fields(x):
+                walk(getattr(x, f.name))
+        elif isinstance(x, tuple):
+            for i in x:
+                walk(i)
+
+    walk(e)
+    return out
+
+
+def _find_agg_calls(e: ast.Expression) -> List[ast.FunctionCall]:
+    out: List[ast.FunctionCall] = []
+
+    def walk(x):
+        if isinstance(x, ast.FunctionCall) and x.name in AGG_FUNCS:
+            out.append(x)
+            return  # no nested aggregates
+        if isinstance(x, (ast.Exists, ast.InSubquery, ast.ScalarSubquery)):
+            return
+        if dataclasses.is_dataclass(x):
+            for f in dataclasses.fields(x):
+                walk(getattr(x, f.name))
+        elif isinstance(x, tuple):
+            for i in x:
+                walk(i)
+
+    walk(e)
+    return out
+
+
+def _common_or_conjuncts(e: ast.Expression) -> List[ast.Expression]:
+    """Factor conjuncts common to every branch of an OR (Q19's
+    `p_partkey = l_partkey` pattern) — ExtractCommonPredicatesExpressionRewriter
+    analogue. The OR itself stays; the extracted conjuncts are implied."""
+    branches: List[ast.Expression] = []
+
+    def flatten_or(x):
+        if isinstance(x, ast.BinaryOp) and x.op == "or":
+            flatten_or(x.left)
+            flatten_or(x.right)
+        else:
+            branches.append(x)
+
+    flatten_or(e)
+    if len(branches) < 2:
+        return []
+    sets = [split_conjuncts(b) for b in branches]
+    common = [c for c in sets[0] if all(c in s for s in sets[1:])]
+    return common
+
+
+# ---------------------------------------------------------------------------
+# Plan builder
+# ---------------------------------------------------------------------------
+
+
+class Builder:
+    """Mutable (node, scope, replacements) triple threaded through
+    planning; replacements map AST expressions to output channels."""
+
+    def __init__(self, node: P.PlanNode, scope: Scope):
+        self.node = node
+        self.scope = scope
+        self.replacements: Dict[ast.Expression, Tuple[int, T.DataType]] = {}
+
+    def converter(self) -> ExprConverter:
+        return ExprConverter(self.scope, self.replacements)
+
+    def filter(self, predicate: ir.Expr) -> None:
+        self.node = P.FilterNode(self.node, predicate, self.node.fields)
+
+
+@dataclasses.dataclass
+class RelationItem:
+    """One FROM item during join planning."""
+
+    node: P.PlanNode
+    scope: Scope
+    rows: float  # stats estimate
+
+
+class Analyzer:
+    def __init__(self, catalogs: CatalogManager, default_catalog: str, default_schema: str):
+        self.catalogs = catalogs
+        self.catalog = default_catalog
+        self.schema = default_schema
+
+    # ---- statements ----
+    def plan(self, stmt: ast.Node) -> P.OutputNode:
+        if isinstance(stmt, ast.Query):
+            node, scope, names = self.plan_query(stmt, {})
+            return P.OutputNode(node, tuple(names), node.fields)
+        raise AnalysisError(f"cannot plan {type(stmt).__name__}")
+
+    # ---- queries ----
+    def plan_query(
+        self, q: ast.Query, ctes: Dict[str, ast.WithQuery]
+    ) -> Tuple[P.PlanNode, Scope, List[str]]:
+        ctes = dict(ctes)
+        for w in q.with_:
+            ctes[w.name] = w
+        if isinstance(q.body, ast.QuerySpec):
+            return self.plan_query_spec(
+                q.body, q.order_by, q.limit, q.offset, ctes
+            )
+        if isinstance(q.body, ast.SetOperation):
+            return self._plan_set_op(q, ctes)
+        raise AnalysisError("unsupported query body")
+
+    def _plan_set_op(self, q: ast.Query, ctes) -> Tuple[P.PlanNode, Scope, List[str]]:
+        def plan_body(body) -> Tuple[P.PlanNode, Scope, List[str]]:
+            if isinstance(body, ast.QuerySpec):
+                return self.plan_query_spec(body, (), None, 0, ctes)
+            if isinstance(body, ast.SetOperation):
+                return plan_set(body)
+            raise AnalysisError("unsupported set operation term")
+
+        def plan_set(s: ast.SetOperation) -> Tuple[P.PlanNode, Scope, List[str]]:
+            ln, lscope, lnames = plan_body(s.left)
+            rn, rscope, _ = plan_body(s.right)
+            if len(lscope) != len(rscope):
+                raise AnalysisError("set operation inputs differ in width")
+            for lf, rf in zip(ln.fields, rn.fields):
+                if lf.type != rf.type:
+                    raise AnalysisError(
+                        f"set operation column types differ: {lf.type} vs {rf.type}"
+                    )
+            if s.op != "union":
+                raise AnalysisError(f"{s.op} not yet supported")
+            fields = ln.fields
+            node: P.PlanNode = P.UnionAllNode((ln, rn), fields)
+            if not s.all:
+                node = P.AggregateNode(
+                    node, tuple(range(len(fields))), (), fields
+                )
+            return node, Scope([ScopeField(None, f.name, f.type) for f in fields]), lnames
+
+        node, scope, names = plan_set(q.body)
+        if q.order_by or q.limit is not None or q.offset:
+            raise AnalysisError(
+                "ORDER BY/LIMIT/OFFSET over set operations not yet supported"
+            )
+        return node, scope, names
+
+    # ---- the heart: one SELECT block ----
+    def plan_query_spec(
+        self,
+        spec: ast.QuerySpec,
+        order_by: Tuple[ast.SortItem, ...],
+        limit: Optional[int],
+        offset: int,
+        ctes: Dict[str, ast.WithQuery],
+    ) -> Tuple[P.PlanNode, Scope, List[str]]:
+        builder, leftovers = self._plan_from_where(spec, ctes)
+
+        # remaining predicates (subqueries, cross-item non-equi, ...)
+        for conj in leftovers:
+            self._plan_predicate(builder, conj, ctes)
+
+        # -- aggregation analysis --
+        select_items = self._expand_stars(spec, builder.scope)
+        select_exprs = [it.expr for it in select_items]
+        group_asts = self._resolve_group_ordinals(spec.group_by, select_exprs)
+        agg_calls: List[ast.FunctionCall] = []
+        for e in select_exprs + ([spec.having] if spec.having else []) + [
+            s.expr for s in order_by
+        ]:
+            for c in _find_agg_calls(e):
+                if c not in agg_calls:
+                    agg_calls.append(c)
+        if group_asts or agg_calls:
+            self._plan_aggregation(builder, group_asts, agg_calls, ctes)
+            if spec.having is not None:
+                self._plan_predicate(builder, spec.having, ctes)
+
+        # -- select projection (+ hidden order-by channels) --
+        conv = builder.converter()
+        out_exprs = [conv.convert(e) for e in select_exprs]
+        names = [self._output_name(it, i) for i, it in enumerate(select_items)]
+
+        sort_keys: List[SortKey] = []
+        hidden = 0
+        for s in order_by:
+            ch = self._order_by_channel(s.expr, select_items, select_exprs, names)
+            if ch is None:
+                out_exprs.append(conv.convert(s.expr))
+                ch = len(out_exprs) - 1
+                hidden += 1
+            desc = s.descending
+            nf = s.nulls_first if s.nulls_first is not None else desc
+            sort_keys.append(SortKey(ch, desc, nf))
+
+        fields = tuple(
+            P.Field(names[i] if i < len(names) else None, e.type)
+            for i, e in enumerate(out_exprs)
+        )
+        node: P.PlanNode = P.ProjectNode(builder.node, tuple(out_exprs), fields)
+
+        if spec.distinct:
+            if hidden:
+                raise AnalysisError("DISTINCT with non-selected ORDER BY expression")
+            node = P.AggregateNode(node, tuple(range(len(fields))), (), fields)
+
+        if sort_keys:
+            if limit is not None and offset == 0:
+                node = P.TopNNode(node, tuple(sort_keys), limit, node.fields)
+            else:
+                node = P.SortNode(node, tuple(sort_keys), node.fields)
+                if limit is not None or offset:
+                    node = P.LimitNode(node, limit, offset, node.fields)
+        elif limit is not None or offset:
+            node = P.LimitNode(node, limit, offset, node.fields)
+
+        if hidden:
+            keep = tuple(range(len(names)))
+            kept_fields = tuple(node.fields[i] for i in keep)
+            node = P.ProjectNode(
+                node,
+                tuple(ir.InputRef(i, node.fields[i].type) for i in keep),
+                kept_fields,
+            )
+
+        out_scope = Scope([ScopeField(None, f.name, f.type) for f in node.fields])
+        return node, out_scope, names
+
+    # ---- FROM/WHERE with join ordering ----
+    def _plan_from_where(
+        self, spec: ast.QuerySpec, ctes
+    ) -> Tuple[Builder, List[ast.Expression]]:
+        conjunct_pool: List[ast.Expression] = []
+        where_conjuncts = split_conjuncts(spec.where)
+        for c in where_conjuncts:
+            conjunct_pool.extend(_common_or_conjuncts(c))
+        conjunct_pool.extend(where_conjuncts)
+
+        if spec.from_ is None:
+            node = P.ValuesNode((P.Field("dummy", T.BIGINT),), ((0,),))
+            b = Builder(node, Scope([ScopeField(None, None, T.BIGINT)]))
+            return b, conjunct_pool
+
+        items: List[RelationItem] = []
+        self._collect_relations(spec.from_, items, conjunct_pool, ctes)
+
+        # classify conjuncts
+        leftovers: List[ast.Expression] = []
+        item_filters: Dict[int, List[ast.Expression]] = {i: [] for i in range(len(items))}
+        join_edges: List[Tuple[int, int, ast.Identifier, ast.Identifier]] = []
+        seen: Set[int] = set()
+        for c in conjunct_pool:
+            if id(c) in seen:
+                continue
+            seen.add(id(c))
+            if _has_subquery(c):
+                leftovers.append(c)
+                continue
+            owners = self._items_of(c, items)
+            if owners is None:
+                leftovers.append(c)  # references outer scope etc.
+                continue
+            if len(owners) == 1:
+                item_filters[next(iter(owners))].append(c)
+                continue
+            edge = self._equi_edge(c, items)
+            if edge is not None:
+                join_edges.append(edge)
+            else:
+                leftovers.append(c)
+
+        # apply single-item filters (predicate pushdown)
+        for i, item in enumerate(items):
+            if item_filters[i]:
+                conv = ExprConverter(item.scope)
+                pred = ir.and_(*[conv.convert(c) for c in item_filters[i]])
+                item.node = P.FilterNode(item.node, pred, item.node.fields)
+                item.rows = max(item.rows / 3.0, 1.0)
+
+        # greedy join-order assembly
+        joined = [0]
+        current = items[0]
+        current_offsets = {0: 0}
+        pending_edges = list(join_edges)
+        while len(joined) < len(items):
+            # pick a connected item (smallest) else smallest remaining
+            candidates: Dict[int, List] = {}
+            for e in pending_edges:
+                a, b_, _, _ = e
+                if (a in joined) != (b_ in joined):
+                    new = b_ if a in joined else a
+                    candidates.setdefault(new, []).append(e)
+            if candidates:
+                new = min(candidates, key=lambda i: items[i].rows)
+                edges = candidates[new]
+            else:
+                remaining = [i for i in range(len(items)) if i not in joined]
+                new = min(remaining, key=lambda i: items[i].rows)
+                edges = []
+            current, current_offsets = self._join_items(
+                current, current_offsets, items, new, edges
+            )
+            joined.append(new)
+            pending_edges = [e for e in pending_edges if e not in edges]
+
+        builder = Builder(current.node, current.scope)
+        # any pending equi edges not used as keys become filters
+        for a, b_, ia, ib in pending_edges:
+            leftovers.append(ast.BinaryOp("eq", ia, ib))
+        return builder, leftovers
+
+    def _join_items(self, current, offsets, items, new_idx, edges):
+        """Hash-join `current` (accumulated) with items[new_idx]; smaller
+        side becomes the build side (the CostCalculator-lite rule)."""
+        new = items[new_idx]
+        cur_keys: List[int] = []
+        new_keys: List[int] = []
+        for a, b_, ia, ib in edges:
+            if a in offsets:
+                cur_ident, new_ident = ia, ib
+            else:
+                cur_ident, new_ident = ib, ia
+            cur_keys.append(current.scope.resolve(cur_ident.parts)[0])
+            new_keys.append(new.scope.resolve(new_ident.parts)[0])
+        if not edges:
+            # cross join: build = new side
+            node = P.JoinNode(
+                "cross", current.node, new.node, (), (), None,
+                current.node.fields + new.node.fields,
+            )
+            scope = Scope.concat(current.scope, new.scope)
+            item = RelationItem(node, scope, current.rows * max(new.rows, 1.0))
+            offsets = dict(offsets)
+            offsets[new_idx] = len(current.scope)
+            return item, offsets
+        if new.rows <= current.rows:
+            # probe = current, build = new
+            node = P.JoinNode(
+                "inner", current.node, new.node,
+                tuple(cur_keys), tuple(new_keys), None,
+                current.node.fields + new.node.fields,
+            )
+            scope = Scope.concat(current.scope, new.scope)
+            offsets = dict(offsets)
+            offsets[new_idx] = len(current.scope)
+        else:
+            # probe = new, build = current (swap sides)
+            node = P.JoinNode(
+                "inner", new.node, current.node,
+                tuple(new_keys), tuple(cur_keys), None,
+                new.node.fields + current.node.fields,
+            )
+            scope = Scope.concat(new.scope, current.scope)
+            shift = len(new.scope)
+            offsets = {k: v + shift for k, v in offsets.items()}
+            offsets[new_idx] = 0
+        rows = max(current.rows, new.rows)
+        return RelationItem(node, scope, rows), offsets
+
+    def _items_of(self, e: ast.Expression, items) -> Optional[Set[int]]:
+        owners: Set[int] = set()
+        for ident in _idents(e):
+            hit = None
+            for i, item in enumerate(items):
+                r = item.scope.try_resolve(ident.parts)
+                if r is not None:
+                    if hit is not None:
+                        raise AnalysisError(f"column '{ident}' is ambiguous")
+                    hit = i
+            if hit is None:
+                return None  # outer reference or unknown
+            owners.add(hit)
+        return owners
+
+    def _equi_edge(self, c, items):
+        if not (isinstance(c, ast.BinaryOp) and c.op == "eq"):
+            return None
+        if not (isinstance(c.left, ast.Identifier) and isinstance(c.right, ast.Identifier)):
+            return None
+        la = self._items_of(c.left, items)
+        ra = self._items_of(c.right, items)
+        if la is None or ra is None or len(la) != 1 or len(ra) != 1:
+            return None
+        a, b = next(iter(la)), next(iter(ra))
+        if a == b:
+            return None
+        return (a, b, c.left, c.right)
+
+    def _collect_relations(self, rel: ast.Relation, items, conjunct_pool, ctes):
+        if isinstance(rel, ast.Join):
+            if rel.kind == "cross":
+                self._collect_relations(rel.left, items, conjunct_pool, ctes)
+                self._collect_relations(rel.right, items, conjunct_pool, ctes)
+                return
+            if rel.kind == "inner":
+                self._collect_relations(rel.left, items, conjunct_pool, ctes)
+                self._collect_relations(rel.right, items, conjunct_pool, ctes)
+                if rel.condition is not None:
+                    conjunct_pool.extend(split_conjuncts(rel.condition))
+                for col in rel.using:
+                    raise AnalysisError("USING not yet supported")
+                return
+            # outer joins: plan as one composite item
+            items.append(self._plan_outer_join(rel, ctes))
+            return
+        items.append(self._plan_relation_leaf(rel, ctes))
+
+    def _plan_outer_join(self, rel: ast.Join, ctes) -> RelationItem:
+        left_items: List[RelationItem] = []
+        pool: List[ast.Expression] = []
+        self._collect_relations(rel.left, left_items, pool, ctes)
+        if len(left_items) != 1 or pool:
+            raise AnalysisError("complex outer-join left side not yet supported")
+        left = left_items[0]
+        right = self._plan_relation_leaf_any(rel.right, ctes)
+        if rel.kind == "right":
+            left, right = right, left
+        elif rel.kind == "full":
+            raise AnalysisError("FULL OUTER JOIN not yet supported")
+        lkeys: List[int] = []
+        rkeys: List[int] = []
+        residuals: List[ast.Expression] = []
+        for c in split_conjuncts(rel.condition):
+            if isinstance(c, ast.BinaryOp) and c.op == "eq" and isinstance(
+                c.left, ast.Identifier
+            ) and isinstance(c.right, ast.Identifier):
+                l_hit = left.scope.try_resolve(c.left.parts)
+                r_hit = right.scope.try_resolve(c.right.parts)
+                if l_hit is not None and r_hit is not None:
+                    lkeys.append(l_hit[0])
+                    rkeys.append(r_hit[0])
+                    continue
+                l_hit2 = left.scope.try_resolve(c.right.parts)
+                r_hit2 = right.scope.try_resolve(c.left.parts)
+                if l_hit2 is not None and r_hit2 is not None:
+                    lkeys.append(l_hit2[0])
+                    rkeys.append(r_hit2[0])
+                    continue
+            residuals.append(c)
+        residual_ir = None
+        if residuals:
+            conv = ExprConverter(Scope.concat(left.scope, right.scope))
+            residual_ir = ir.and_(*[conv.convert(c) for c in residuals])
+        node = P.JoinNode(
+            "left", left.node, right.node, tuple(lkeys), tuple(rkeys),
+            residual_ir, left.node.fields + right.node.fields,
+        )
+        return RelationItem(
+            node, Scope.concat(left.scope, right.scope), max(left.rows, right.rows)
+        )
+
+    def _plan_relation_leaf_any(self, rel, ctes) -> RelationItem:
+        items: List[RelationItem] = []
+        pool: List[ast.Expression] = []
+        self._collect_relations(rel, items, pool, ctes)
+        if len(items) != 1 or pool:
+            raise AnalysisError("nested join tree not yet supported here")
+        return items[0]
+
+    def _plan_relation_leaf(self, rel: ast.Relation, ctes) -> RelationItem:
+        if isinstance(rel, ast.TableRef):
+            name = rel.name
+            if len(name) == 1 and name[0] in ctes:
+                w = ctes[name[0]]
+                inner_ctes = {k: v for k, v in ctes.items() if k != name[0]}
+                node, scope, names = self.plan_query(w.query, inner_ctes)
+                out_names = list(w.column_names) if w.column_names else names
+                qual = rel.alias or name[0]
+                sc = Scope(
+                    [
+                        ScopeField(qual, n, f.type)
+                        for n, f in zip(out_names, node.fields)
+                    ]
+                )
+                return RelationItem(node, sc, 1000.0)
+            return self._plan_table(rel)
+        if isinstance(rel, ast.SubqueryRelation):
+            node, scope, names = self.plan_query(rel.query, ctes)
+            sc = Scope(
+                [ScopeField(rel.alias, n, f.type) for n, f in zip(names, node.fields)]
+            )
+            return RelationItem(node, sc, 1000.0)
+        raise AnalysisError(f"unsupported relation {type(rel).__name__}")
+
+    def _plan_table(self, rel: ast.TableRef) -> RelationItem:
+        parts = rel.name
+        if len(parts) == 1:
+            catalog, schema, table = self.catalog, self.schema, parts[0]
+        elif len(parts) == 2:
+            catalog, schema, table = self.catalog, parts[0], parts[1]
+        else:
+            catalog, schema, table = parts
+        conn, handle = self.catalogs.resolve_table(catalog, schema, table)
+        meta = conn.metadata.get_table_metadata(handle)
+        columns = tuple(c.name for c in meta.columns)
+        fields = tuple(P.Field(c.name, c.type) for c in meta.columns)
+        node = P.ScanNode(catalog, handle, columns, fields)
+        qual = rel.alias or table
+        scope = Scope([ScopeField(qual, c.name, c.type) for c in meta.columns])
+        stats = conn.metadata.get_table_statistics(handle)
+        rows = stats.row_count or 1000.0
+        return RelationItem(node, scope, rows)
+
+    # ---- predicates with subqueries ----
+    def _plan_predicate(self, builder: Builder, e: ast.Expression, ctes) -> None:
+        for conj in split_conjuncts(e):
+            if isinstance(conj, ast.Exists):
+                self._plan_exists(builder, conj.query, False, ctes)
+                continue
+            if (
+                isinstance(conj, ast.UnaryOp)
+                and conj.op == "not"
+                and isinstance(conj.operand, ast.Exists)
+            ):
+                self._plan_exists(builder, conj.operand.query, True, ctes)
+                continue
+            if isinstance(conj, ast.InSubquery):
+                self._plan_in_subquery(builder, conj, ctes)
+                continue
+            for sub in _scalar_subqueries(conj):
+                if sub not in builder.replacements:
+                    self._plan_scalar_subquery(builder, sub, ctes)
+            pred = builder.converter().convert(conj)
+            builder.filter(pred)
+
+    def _plan_exists(self, builder: Builder, q: ast.Query, negated: bool, ctes) -> None:
+        if not isinstance(q.body, ast.QuerySpec) or q.body.group_by or q.with_:
+            raise AnalysisError("EXISTS subquery too complex")
+        spec = q.body
+        inner_items: List[RelationItem] = []
+        pool: List[ast.Expression] = []
+        self._collect_relations(spec.from_, inner_items, pool, ctes)
+        pool.extend(split_conjuncts(spec.where))
+        (
+            inner,
+            probe_keys,
+            build_keys,
+            residuals,
+        ) = self._decorrelate(builder, inner_items, pool)
+        residual_ir = None
+        if residuals:
+            conv = ExprConverter(Scope.concat(builder.scope, inner.scope))
+            residual_ir = ir.and_(*[conv.convert(c) for c in residuals])
+        kind = "anti" if negated else "semi"
+        builder.node = P.JoinNode(
+            kind, builder.node, inner.node,
+            tuple(probe_keys), tuple(build_keys), residual_ir, builder.node.fields,
+        )
+        # scope unchanged: semi/anti output = probe columns
+
+    def _decorrelate(self, builder: Builder, inner_items, pool):
+        """Assemble the subquery side and split its conjuncts into inner
+        filters / correlation equi keys / cross-scope residuals."""
+        inner_filters: List[ast.Expression] = []
+        corr_pairs: List[Tuple[ast.Identifier, ast.Identifier]] = []
+        residuals: List[ast.Expression] = []
+        inner_scope_probe = Scope(
+            [f for it in inner_items for f in it.scope.fields]
+        )
+        for c in pool:
+            if _has_subquery(c):
+                raise AnalysisError("nested subquery inside EXISTS not supported")
+            refs_inner = refs_outer = False
+            for ident in _idents(c):
+                if inner_scope_probe.try_resolve(ident.parts) is not None:
+                    refs_inner = True
+                elif builder.scope.try_resolve(ident.parts) is not None:
+                    refs_outer = True
+                else:
+                    raise AnalysisError(f"cannot resolve {ident}")
+            if refs_outer and not refs_inner:
+                # outer-only predicate inside subquery: apply to outer
+                self._plan_predicate(builder, c, {})
+                continue
+            if not refs_outer:
+                inner_filters.append(c)
+                continue
+            if (
+                isinstance(c, ast.BinaryOp)
+                and c.op == "eq"
+                and isinstance(c.left, ast.Identifier)
+                and isinstance(c.right, ast.Identifier)
+            ):
+                l_inner = inner_scope_probe.try_resolve(c.left.parts)
+                r_inner = inner_scope_probe.try_resolve(c.right.parts)
+                if l_inner is None and r_inner is not None:
+                    corr_pairs.append((c.left, c.right))
+                    continue
+                if r_inner is None and l_inner is not None:
+                    corr_pairs.append((c.right, c.left))
+                    continue
+            residuals.append(c)
+
+        # assemble the inner side with its own greedy join order
+        inner_builder, inner_leftovers = self._assemble_items(
+            inner_items, inner_filters
+        )
+        for c in inner_leftovers:
+            pred = ExprConverter(inner_builder.scope).convert(c)
+            inner_builder.filter(pred)
+        inner = RelationItem(inner_builder.node, inner_builder.scope, 0.0)
+        probe_keys = [builder.scope.resolve(o.parts)[0] for o, _ in corr_pairs]
+        build_keys = [inner.scope.resolve(i.parts)[0] for _, i in corr_pairs]
+        return inner, probe_keys, build_keys, residuals
+
+    def _assemble_items(self, items, conjuncts) -> Tuple[Builder, List[ast.Expression]]:
+        """Greedy-join a prepared item list with a conjunct pool (shared
+        by FROM planning and subquery decorrelation)."""
+        spec_like_pool = list(conjuncts)
+        leftovers: List[ast.Expression] = []
+        item_filters: Dict[int, List[ast.Expression]] = {
+            i: [] for i in range(len(items))
+        }
+        join_edges = []
+        for c in spec_like_pool:
+            owners = self._items_of(c, items)
+            if owners is None:
+                leftovers.append(c)
+                continue
+            if len(owners) == 1:
+                item_filters[next(iter(owners))].append(c)
+                continue
+            edge = self._equi_edge(c, items)
+            if edge is not None:
+                join_edges.append(edge)
+            else:
+                leftovers.append(c)
+        for i, item in enumerate(items):
+            if item_filters[i]:
+                conv = ExprConverter(item.scope)
+                pred = ir.and_(*[conv.convert(c) for c in item_filters[i]])
+                item.node = P.FilterNode(item.node, pred, item.node.fields)
+                item.rows = max(item.rows / 3.0, 1.0)
+        joined = [0]
+        current = items[0]
+        offsets = {0: 0}
+        pending = list(join_edges)
+        while len(joined) < len(items):
+            candidates: Dict[int, List] = {}
+            for e in pending:
+                a, b_, _, _ = e
+                if (a in joined) != (b_ in joined):
+                    new = b_ if a in joined else a
+                    candidates.setdefault(new, []).append(e)
+            if candidates:
+                new = min(candidates, key=lambda i: items[i].rows)
+                edges = candidates[new]
+            else:
+                remaining = [i for i in range(len(items)) if i not in joined]
+                new = min(remaining, key=lambda i: items[i].rows)
+                edges = []
+            current, offsets = self._join_items(current, offsets, items, new, edges)
+            joined.append(new)
+            pending = [e for e in pending if e not in edges]
+        return Builder(current.node, current.scope), leftovers
+
+    def _plan_in_subquery(self, builder: Builder, conj: ast.InSubquery, ctes) -> None:
+        node, scope, _ = self.plan_query(conj.query, ctes)
+        if len(node.fields) != 1:
+            raise AnalysisError("IN subquery must return one column")
+        value = conj.value
+        if not isinstance(value, ast.Identifier):
+            raise AnalysisError("IN (subquery) value must be a column")
+        probe_ch = builder.scope.resolve(value.parts)[0]
+        kind = "anti" if conj.negated else "semi"
+        # NOTE: NOT IN uses NOT EXISTS (null-unaware) semantics — see module doc
+        builder.node = P.JoinNode(
+            kind, builder.node, node, (probe_ch,), (0,), None, builder.node.fields
+        )
+
+    def _plan_scalar_subquery(self, builder: Builder, sub: ast.ScalarSubquery, ctes) -> None:
+        q = sub.query
+        # classify correlation by probing the subquery's FROM scopes
+        correlated = False
+        if isinstance(q.body, ast.QuerySpec) and q.body.from_ is not None:
+            probe_items: List[RelationItem] = []
+            pool: List[ast.Expression] = []
+            self._collect_relations(q.body.from_, probe_items, pool, ctes)
+            probe_scope = Scope([f for it in probe_items for f in it.scope.fields])
+            for c in pool + split_conjuncts(q.body.where):
+                for ident in _idents(c):
+                    if probe_scope.try_resolve(ident.parts) is None:
+                        if builder.scope.try_resolve(ident.parts) is not None:
+                            correlated = True
+        if not correlated:
+            node, scope, _ = self.plan_query(q, ctes)
+            if len(node.fields) != 1:
+                raise AnalysisError("scalar subquery must return one column")
+            ch = len(builder.scope)
+            t = node.fields[0].type
+            builder.node = P.JoinNode(
+                "cross", builder.node, node, (), (), None,
+                builder.node.fields + node.fields,
+            )
+            builder.scope = Scope(
+                builder.scope.fields + [ScopeField(None, None, t)]
+            )
+            builder.replacements[sub] = (ch, t)
+            return
+        self._plan_correlated_scalar(builder, q, sub, ctes)
+
+    def _plan_correlated_scalar(self, builder, q: ast.Query, sub, ctes) -> None:
+        """Correlated scalar aggregate -> group the subquery by its
+        correlation keys and LEFT-join (the TransformCorrelatedScalar-
+        AggregationToJoin rule)."""
+        if not isinstance(q.body, ast.QuerySpec) or q.body.group_by or q.with_:
+            raise AnalysisError("unsupported correlated scalar subquery shape")
+        spec = q.body
+        if len(spec.select) != 1:
+            raise AnalysisError("scalar subquery must select one expression")
+        inner_items: List[RelationItem] = []
+        pool: List[ast.Expression] = []
+        self._collect_relations(spec.from_, inner_items, pool, ctes)
+        pool.extend(split_conjuncts(spec.where))
+        inner_scope_probe = Scope([f for it in inner_items for f in it.scope.fields])
+        inner_filters: List[ast.Expression] = []
+        corr_pairs: List[Tuple[ast.Identifier, ast.Identifier]] = []
+        for c in pool:
+            refs_outer = False
+            for ident in _idents(c):
+                if inner_scope_probe.try_resolve(ident.parts) is None:
+                    if builder.scope.try_resolve(ident.parts) is not None:
+                        refs_outer = True
+                    else:
+                        raise AnalysisError(f"cannot resolve {ident}")
+            if not refs_outer:
+                inner_filters.append(c)
+                continue
+            if (
+                isinstance(c, ast.BinaryOp)
+                and c.op == "eq"
+                and isinstance(c.left, ast.Identifier)
+                and isinstance(c.right, ast.Identifier)
+            ):
+                l_inner = inner_scope_probe.try_resolve(c.left.parts)
+                r_inner = inner_scope_probe.try_resolve(c.right.parts)
+                if l_inner is None and r_inner is not None:
+                    corr_pairs.append((c.left, c.right))
+                    continue
+                if r_inner is None and l_inner is not None:
+                    corr_pairs.append((c.right, c.left))
+                    continue
+            raise AnalysisError(
+                "only equality correlation supported in scalar subqueries"
+            )
+        if not corr_pairs:
+            raise AnalysisError("correlated scalar subquery without equi correlation")
+        # synthetic query: SELECT <inner keys>..., <value> FROM ... GROUP BY keys
+        key_idents = tuple(i for _, i in corr_pairs)
+        synth_spec = ast.QuerySpec(
+            select=tuple(ast.SelectItem(i) for i in key_idents)
+            + (spec.select[0],),
+            from_=spec.from_,
+            where=conjoin(inner_filters),
+            group_by=key_idents,
+        )
+        node, scope, _ = self.plan_query_spec(synth_spec, (), None, 0, ctes)
+        k = len(key_idents)
+        value_t = node.fields[k].type
+        probe_keys = tuple(builder.scope.resolve(o.parts)[0] for o, _ in corr_pairs)
+        ch = len(builder.scope) + k
+        builder.node = P.JoinNode(
+            "left", builder.node, node, probe_keys, tuple(range(k)), None,
+            builder.node.fields + node.fields,
+        )
+        builder.scope = Scope(
+            builder.scope.fields
+            + [ScopeField(None, None, f.type) for f in node.fields]
+        )
+        builder.replacements[sub] = (ch, value_t)
+
+    # ---- aggregation ----
+    def _plan_aggregation(self, builder: Builder, group_asts, agg_calls, ctes) -> None:
+        conv = builder.converter()
+        key_irs = [conv.convert(g) for g in group_asts]
+        pre_exprs: List[ir.Expr] = list(key_irs)
+        aggs: List[P.AggCall] = []
+        for call in agg_calls:
+            kind = call.name
+            distinct = call.distinct
+            if kind == "count" and (
+                not call.args or isinstance(call.args[0], ast.Star)
+            ):
+                aggs.append(P.AggCall("count_star", None, T.BIGINT, False))
+                continue
+            if kind in ("any_value", "arbitrary"):
+                kind = "any"
+            if len(call.args) != 1:
+                raise AnalysisError(f"{call.name}() takes one argument")
+            arg = conv.convert(call.args[0])
+            arg_ch = len(pre_exprs)
+            pre_exprs.append(arg)
+            out_t = self._agg_out_type(kind, arg.type)
+            aggs.append(P.AggCall(kind, arg_ch, out_t, distinct))
+
+        pre_fields = tuple(
+            P.Field(
+                g.parts[-1] if isinstance(g, ast.Identifier) else None,
+                e.type,
+            )
+            for g, e in zip(group_asts, key_irs)
+        ) + tuple(P.Field(None, e.type) for e in pre_exprs[len(key_irs):])
+        pre = P.ProjectNode(builder.node, tuple(pre_exprs), pre_fields)
+
+        k = len(key_irs)
+        out_fields = tuple(pre_fields[:k]) + tuple(
+            P.Field(None, a.out_type) for a in aggs
+        )
+        builder.node = P.AggregateNode(
+            pre, tuple(range(k)), tuple(aggs), out_fields
+        )
+        # post-agg scope: group keys keep (qualifier, name) when they were
+        # plain identifiers so ORDER BY/SELECT can re-resolve them
+        post_fields = []
+        replacements: Dict[ast.Expression, Tuple[int, T.DataType]] = {}
+        for i, (g, e) in enumerate(zip(group_asts, key_irs)):
+            if isinstance(g, ast.Identifier):
+                qualifier = g.parts[0] if len(g.parts) == 2 else None
+                name = g.parts[-1]
+            else:
+                qualifier, name = None, None
+            post_fields.append(ScopeField(qualifier, name, e.type))
+            replacements[g] = (i, e.type)
+        for j, (call, a) in enumerate(zip(agg_calls, aggs)):
+            post_fields.append(ScopeField(None, None, a.out_type))
+            replacements[call] = (k + j, a.out_type)
+        builder.scope = Scope(post_fields)
+        builder.replacements = replacements
+
+    @staticmethod
+    def _agg_out_type(kind: str, arg_t: T.DataType) -> T.DataType:
+        if kind == "count":
+            return T.BIGINT
+        if kind == "avg":
+            return T.DOUBLE  # documented deviation
+        if kind == "sum":
+            if arg_t.is_decimal:
+                return T.decimal(18, arg_t.scale or 0)
+            if arg_t.is_floating:
+                return T.DOUBLE
+            return T.BIGINT
+        if kind in ("min", "max", "any"):
+            return arg_t
+        raise AnalysisError(f"unknown aggregate {kind}")
+
+    # ---- select helpers ----
+    def _expand_stars(self, spec: ast.QuerySpec, scope: Scope) -> List[ast.SelectItem]:
+        out: List[ast.SelectItem] = []
+        for item in spec.select:
+            if isinstance(item.expr, ast.Star):
+                q = item.expr.qualifier
+                for f in scope.fields:
+                    if f.name is None:
+                        continue
+                    if q is not None and f.qualifier != q:
+                        continue
+                    parts = (f.qualifier, f.name) if f.qualifier else (f.name,)
+                    out.append(ast.SelectItem(ast.Identifier(parts)))
+            else:
+                out.append(item)
+        return out
+
+    @staticmethod
+    def _resolve_group_ordinals(group_by, select_exprs) -> List[ast.Expression]:
+        out = []
+        for g in group_by:
+            if isinstance(g, ast.NumberLiteral) and g.text.isdigit():
+                idx = int(g.text) - 1
+                if not 0 <= idx < len(select_exprs):
+                    raise AnalysisError(f"GROUP BY ordinal {g.text} out of range")
+                out.append(select_exprs[idx])
+            else:
+                out.append(g)
+        return out
+
+    @staticmethod
+    def _output_name(item: ast.SelectItem, i: int) -> str:
+        if item.alias:
+            return item.alias
+        if isinstance(item.expr, ast.Identifier):
+            return item.expr.parts[-1]
+        return f"_col{i}"
+
+    @staticmethod
+    def _order_by_channel(e, select_items, select_exprs, names) -> Optional[int]:
+        if isinstance(e, ast.NumberLiteral) and e.text.isdigit():
+            idx = int(e.text) - 1
+            if not 0 <= idx < len(select_exprs):
+                raise AnalysisError(f"ORDER BY ordinal {e.text} out of range")
+            return idx
+        if isinstance(e, ast.Identifier) and len(e.parts) == 1:
+            if e.parts[0] in names:
+                return names.index(e.parts[0])
+        if e in select_exprs:
+            return select_exprs.index(e)
+        return None
