@@ -1,0 +1,427 @@
+"""Distributed execution tests: serde, buffers, exchange client,
+fragmenter, and the multi-worker DistributedQueryRunner vs the sqlite
+oracle (the tier-3 strategy, SURVEY.md §4.3)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from tests.oracle import assert_rows_match, sqlite_rows
+from tests.test_tpch import to_sqlite
+from tests.tpch_queries import QUERIES
+from trino_tpu import types as T
+from trino_tpu.block import Column, Dictionary, RelBatch
+from trino_tpu.connectors.tpch import create_tpch_connector
+from trino_tpu.engine import Session
+from trino_tpu.exec.serde import (
+    Page,
+    concat_pages,
+    deserialize_batch,
+    deserialize_page,
+    serialize_batch,
+    serialize_page,
+)
+from trino_tpu.runtime import DistributedQueryRunner
+from trino_tpu.runtime.buffers import OutputBuffer
+from trino_tpu.runtime.exchange import DirectExchangeClient, ExchangeLocation
+from trino_tpu.sql.fragmenter import plan_distributed
+from trino_tpu.sql.analyzer import Analyzer
+from trino_tpu.sql.parser import parse
+from trino_tpu.sql import plan as P
+
+
+# ---------------------------------------------------------------------------
+# serde
+# ---------------------------------------------------------------------------
+
+
+def _sample_batch():
+    return RelBatch.from_pydict(
+        [("a", T.BIGINT), ("b", T.VARCHAR), ("c", T.DOUBLE)],
+        {
+            "a": [1, 2, None, 4, 5],
+            "b": ["x", "y", "x", None, "zz"],
+            "c": [1.5, -2.25, 0.0, 3.75, None],
+        },
+    )
+
+
+def test_serde_roundtrip():
+    b = _sample_batch()
+    out = deserialize_batch(serialize_batch(b))
+    assert out.to_pylists() == b.to_pylists()
+
+
+def test_serde_compression_roundtrip():
+    b = _sample_batch()
+    raw = serialize_batch(b, compress=False)
+    packed = serialize_batch(b, compress=True)
+    assert raw[0] == 0 and packed[0] == 1
+    assert deserialize_batch(raw).to_pylists() == deserialize_batch(packed).to_pylists()
+
+
+def test_page_concat_unifies_dictionaries():
+    p1 = Page.from_batch(
+        RelBatch.from_pydict([("s", T.VARCHAR)], {"s": ["a", "b"]})
+    )
+    p2 = Page.from_batch(
+        RelBatch.from_pydict([("s", T.VARCHAR)], {"s": ["c", "a"]})
+    )
+    merged = concat_pages([p1, p2])
+    assert merged.row_count == 4
+    batch = merged.to_batch()
+    assert [r[0] for r in batch.to_pylists()] == ["a", "b", "c", "a"]
+
+
+# ---------------------------------------------------------------------------
+# buffers + exchange client (pull + ack)
+# ---------------------------------------------------------------------------
+
+
+def _page_of(values):
+    return Page.from_batch(
+        RelBatch.from_pydict([("v", T.BIGINT)], {"v": values})
+    )
+
+
+def test_output_buffer_token_ack():
+    buf = OutputBuffer(1)
+    buf.enqueue(0, _page_of([1]))
+    buf.enqueue(0, _page_of([2]))
+    pages, token, complete = buf.get_pages(0, 0)
+    assert len(pages) == 2 and token == 2 and not complete
+    # re-request same token: at-least-once redelivery
+    pages2, token2, _ = buf.get_pages(0, 0)
+    assert len(pages2) == 2 and token2 == 2
+    buf.set_no_more_pages()
+    # advancing to token 2 acks both and reports completion
+    pages3, token3, complete3 = buf.get_pages(0, 2)
+    assert pages3 == [] and complete3
+    assert buf.is_fully_consumed()
+
+
+def test_output_buffer_backpressure_unblocks():
+    buf = OutputBuffer(1, max_bytes=8)
+    buf.enqueue(0, _page_of([1, 2, 3]))
+    done = threading.Event()
+
+    def producer():
+        buf.enqueue(0, _page_of([4]))  # blocks until consumer acks
+        done.set()
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    assert not done.wait(0.1)
+    _, token, _ = buf.get_pages(0, 0)
+    buf.get_pages(0, token)  # ack
+    assert done.wait(2.0)
+
+
+def test_exchange_client_pulls_all_locations():
+    bufs = [OutputBuffer(1), OutputBuffer(1)]
+    bufs[0].enqueue(0, _page_of([1, 2]))
+    bufs[1].enqueue(0, _page_of([3]))
+    for b in bufs:
+        b.set_no_more_pages()
+    client = DirectExchangeClient(
+        [ExchangeLocation(b.get_pages, 0) for b in bufs], long_poll_s=0.05
+    )
+    got = []
+    while not client.is_finished():
+        p = client.poll()
+        if p is not None:
+            got.extend(int(x) for x in p.columns[0])
+    assert sorted(got) == [1, 2, 3]
+
+
+def test_aborted_buffer_fails_consumer():
+    buf = OutputBuffer(1)
+    buf.abort()
+    with pytest.raises(RuntimeError):
+        buf.get_pages(0, 0)
+
+
+# ---------------------------------------------------------------------------
+# fragmenter
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def catalogs():
+    from trino_tpu.connectors.spi import CatalogManager
+
+    c = CatalogManager()
+    c.register("tpch", create_tpch_connector())
+    return c
+
+
+def _fragments(catalogs, sql):
+    analyzer = Analyzer(catalogs, "tpch", "tiny")
+    output = analyzer.plan(parse(sql))
+    return plan_distributed(output, catalogs)
+
+
+def test_fragmenter_groupby_shape(catalogs):
+    sp = _fragments(
+        catalogs, "select l_returnflag, sum(l_quantity) from lineitem group by l_returnflag"
+    )
+    frags = {f.id: f for f in sp.all_fragments()}
+    assert len(frags) == 3
+    # leaf: source-partitioned partial agg with hash output
+    leaf = [f for f in frags.values() if f.partitioning == "source"]
+    assert len(leaf) == 1 and leaf[0].output_kind == "hash"
+    # middle: hash-partitioned final agg
+    mid = [f for f in frags.values() if f.partitioning == "hash"]
+    assert len(mid) == 1
+
+    def find_steps(n, acc):
+        if isinstance(n, P.AggregateNode):
+            acc.append(n.step)
+        for c in n.children():
+            find_steps(c, acc)
+        return acc
+
+    steps = []
+    for f in frags.values():
+        find_steps(f.root, steps)
+    assert sorted(steps) == ["final", "partial"]
+
+
+def test_fragmenter_broadcast_join(catalogs):
+    # nation (25 rows) broadcasts under the default threshold
+    sp = _fragments(
+        catalogs,
+        "select n_name, s_name from supplier, nation where s_nationkey = n_nationkey",
+    )
+    kinds = {f.output_kind for f in sp.all_fragments()}
+    assert "broadcast" in kinds
+
+
+def test_fragmenter_distributed_sort(catalogs):
+    sp = _fragments(
+        catalogs, "select o_orderkey from orders order by o_orderkey"
+    )
+    # local sort lives in the source fragment; the gather merges
+    src = [f for f in sp.all_fragments() if f.partitioning == "source"][0]
+    assert src.output_merge_keys
+
+    def has_sort(n):
+        return isinstance(n, P.SortNode) or any(has_sort(c) for c in n.children())
+
+    assert has_sort(src.root)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end vs the sqlite oracle
+# ---------------------------------------------------------------------------
+
+SF = 0.01
+DIST_QUERIES = [1, 3, 4, 6, 10, 12, 13, 14, 18, 19]
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    import sqlite3
+
+    from tests.oracle import load_tpch_sqlite
+
+    conn = sqlite3.connect(":memory:")
+    load_tpch_sqlite(conn, SF)
+    yield conn
+    conn.close()
+
+
+@pytest.fixture(scope="module")
+def runner():
+    r = DistributedQueryRunner(
+        Session(catalog="tpch", schema="tiny"), n_workers=2, hash_partitions=2
+    )
+    r.register_catalog("tpch", create_tpch_connector())
+    return r
+
+
+@pytest.mark.parametrize("qid", DIST_QUERIES)
+def test_distributed_tpch(qid, runner, oracle):
+    sql = QUERIES[qid]
+    res = runner.execute(sql)
+    expected = sqlite_rows(oracle, to_sqlite(sql))
+    assert_rows_match(
+        res.rows, expected, ordered=("order by" in sql), abs_tol=1e-2
+    )
+
+
+def test_distributed_explain(runner):
+    plan = runner.execute(
+        "EXPLAIN SELECT count(*) FROM orders"
+    ).only_value()
+    assert "Fragment" in plan and "RemoteSource" in plan
+
+
+# ---------------------------------------------------------------------------
+# HTTP worker topology + discovery
+# ---------------------------------------------------------------------------
+
+
+def test_http_worker_topology():
+    """Coordinator schedules over workers behind real HTTP servers; pages
+    stream over the wire with token/ack pulls."""
+    from trino_tpu.connectors.spi import CatalogManager
+    from trino_tpu.runtime.http import HttpWorkerClient, WorkerServer
+    from trino_tpu.runtime.worker import Worker
+
+    servers, handles = [], []
+    try:
+        for i in range(2):
+            cats = CatalogManager()
+            cats.register("tpch", create_tpch_connector())
+            servers.append(WorkerServer(Worker(f"w{i}", cats)))
+            handles.append(HttpWorkerClient(servers[-1].uri))
+        r = DistributedQueryRunner(
+            Session(catalog="tpch", schema="tiny"),
+            worker_handles=handles,
+            hash_partitions=2,
+        )
+        r.register_catalog("tpch", create_tpch_connector())
+        res = r.execute(
+            "SELECT l_returnflag, count(*) FROM lineitem"
+            " GROUP BY l_returnflag ORDER BY l_returnflag"
+        )
+        assert [row[0] for row in res.rows] == ["A", "N", "R"]
+        assert sum(row[1] for row in res.rows) == 60064
+        # worker status + graceful shutdown surface
+        st = handles[0].status()
+        assert st["state"] == "active"
+        handles[0].shutdown_gracefully()
+        assert handles[0].status()["state"] == "shutting_down"
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_http_task_failure_reported():
+    from trino_tpu.connectors.spi import CatalogManager
+    from trino_tpu.runtime.http import HttpWorkerClient, WorkerServer
+    from trino_tpu.runtime.worker import Worker
+
+    # worker with NO catalogs: tasks fail at plan time
+    srv = WorkerServer(Worker("w0", CatalogManager()))
+    try:
+        handle = HttpWorkerClient(srv.uri)
+        r = DistributedQueryRunner(
+            Session(catalog="tpch", schema="tiny"), worker_handles=[handle]
+        )
+        r.register_catalog("tpch", create_tpch_connector())
+        with pytest.raises(RuntimeError, match="query failed"):
+            r.execute("SELECT count(*) FROM orders")
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# fault-tolerant execution (BaseFailureRecoveryTest analogue, SURVEY §4.4)
+# ---------------------------------------------------------------------------
+
+
+FTE_QUERY = (
+    "SELECT l_returnflag, sum(l_quantity), count(*) FROM lineitem"
+    " GROUP BY l_returnflag ORDER BY l_returnflag"
+)
+
+
+@pytest.fixture()
+def fte_cluster():
+    from trino_tpu.connectors.spi import CatalogManager
+    from trino_tpu.runtime.failure import FailureInjector
+    from trino_tpu.runtime.worker import Worker
+
+    inj = FailureInjector()
+    cats = CatalogManager()
+    cats.register("tpch", create_tpch_connector())
+    workers = [Worker(f"w{i}", cats, failure_injector=inj) for i in range(2)]
+    r = DistributedQueryRunner(
+        Session(catalog="tpch", schema="tiny", retry_policy="task"),
+        worker_handles=workers,
+        hash_partitions=2,
+    )
+    r.register_catalog("tpch", create_tpch_connector())
+    return r, inj
+
+
+def test_fte_survives_task_failure_at_start(fte_cluster):
+    r, inj = fte_cluster
+    baseline = r.execute(FTE_QUERY).rows
+    inj.inject(fragment_id=0, partition=0, attempts=(0,), where="start")
+    assert r.execute(FTE_QUERY).rows == baseline
+
+
+def test_fte_survives_task_failure_after_output(fte_cluster):
+    r, inj = fte_cluster
+    baseline = r.execute(FTE_QUERY).rows
+    inj.inject(fragment_id=1, partition=1, attempts=(0, 1), where="mid")
+    assert r.execute(FTE_QUERY).rows == baseline
+
+
+def test_fte_retries_exhausted(fte_cluster):
+    from trino_tpu.runtime.fte import TaskRetriesExceeded
+
+    r, inj = fte_cluster
+    inj.inject(fragment_id=0, attempts=tuple(range(10)), where="start")
+    with pytest.raises(TaskRetriesExceeded):
+        r.execute(FTE_QUERY)
+
+
+def test_query_retry_policy(fte_cluster):
+    r, inj = fte_cluster
+    baseline = r.execute(FTE_QUERY).rows
+    r2 = DistributedQueryRunner(
+        Session(catalog="tpch", schema="tiny", retry_policy="query"),
+        worker_handles=r.workers,
+        hash_partitions=2,
+    )
+    r2.catalogs = r.catalogs
+    inj.inject(fragment_id=0, partition=0, attempts=(0,), where="start", max_hits=1)
+    assert r2.execute(FTE_QUERY).rows == baseline
+
+
+def test_spool_commit_roundtrip(tmp_path):
+    from trino_tpu.runtime.spool import (
+        SpoolingExchangeSink,
+        is_committed,
+        read_spool,
+    )
+
+    sink = SpoolingExchangeSink(str(tmp_path), "q1.0.0", 2)
+    sink.enqueue(0, _page_of([1, 2]))
+    sink.enqueue(1, _page_of([3]))
+    sink.enqueue(0, _page_of([4]))
+    assert not is_committed(str(tmp_path), "q1.0.0")
+    sink.set_no_more_pages()
+    assert is_committed(str(tmp_path), "q1.0.0")
+    pages, token, complete = read_spool(str(tmp_path / "q1.0.0"), 0, 0)
+    assert complete and token == 2
+    assert [int(x) for p in pages for x in p.columns[0]] == [1, 2, 4]
+
+
+def test_discovery_heartbeat_marks_failed_worker():
+    from trino_tpu.runtime.discovery import NodeManager
+
+    class FlakyHandle:
+        worker_id = "flaky"
+        alive = True
+
+        def status(self):
+            if not self.alive:
+                raise ConnectionError("down")
+            return {"state": "active"}
+
+    nm = NodeManager()
+    h = FlakyHandle()
+    nm.register(h)
+    nm.ping_once()
+    assert nm.all_states()["flaky"] == "active"
+    h.alive = False
+    for _ in range(8):
+        nm.ping_once()
+    assert nm.all_states()["flaky"] == "failed"
+    assert nm.active_workers() == []

@@ -25,12 +25,19 @@ from trino_tpu.sql.plan import OutputNode, explain_text
 @dataclasses.dataclass
 class Session:
     """Per-query context (main/Session.java analogue; properties grow
-    with the session-property system)."""
+    with the session-property system). retry_policy mirrors Trino's
+    `retry_policy` session property: "none" (pipelined), "query"
+    (whole-query retry inside the pipelined scheduler,
+    PipelinedQueryScheduler.scheduleRetryWithDelay:394) or "task"
+    (FTE over spooled exchange, SURVEY.md §3.5)."""
 
     catalog: str = "tpch"
     schema: str = "tiny"
     batch_rows: int = 1 << 20
     target_splits: int = 1
+    retry_policy: str = "none"
+    query_retries: int = 2
+    task_retries: int = 3
 
 
 @dataclasses.dataclass

@@ -57,6 +57,14 @@ class Driver:
                     self._finish_signalled[i + 1] = True
                     progressed = True
             if not progressed and not ops[-1].is_finished():
+                if any(o.is_blocked() for o in ops):
+                    # blocked on remote pages / buffer space: yield the
+                    # thread (Driver.java:446 union of blocked futures,
+                    # collapsed to a poll-and-sleep)
+                    import time
+
+                    time.sleep(0.001)
+                    continue
                 raise RuntimeError(
                     "pipeline stalled: "
                     + ", ".join(

@@ -1,0 +1,201 @@
+"""Exchange boundary operators: partitioned output + remote source.
+
+Analogues of main/operator/output/PartitionedOutputOperator.java:46
+(PagePartitioner:191 — hash rows into per-partition appenders feeding
+the OutputBuffer) and main/operator/ExchangeOperator.java:44 /
+MergeOperator.java:46 (a SourceOperator wrapping the exchange client,
+optionally merge-sorting). SURVEY.md §2.8, §3.4.
+
+TPU-first delta: partition ids are computed on device in one jitted
+kernel over the whole batch; the host then splits the already-compacted
+wire Page with numpy boolean masks (pages cross the process boundary on
+the host side anyway). Dead rows never reach the wire.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trino_tpu.block import RelBatch
+from trino_tpu.exec.operators import Operator, _concat_sort
+from trino_tpu.exec.serde import Page
+from trino_tpu.ops.hashing import (
+    canonical_hash_input,
+    dictionary_code_hashes,
+    hash32,
+    partition_of,
+)
+from trino_tpu.ops.sort import SortKey
+
+
+@partial(jax.jit, static_argnames=("n", "has_lut"))
+def _partition_ids(keys, valids, luts, live, n: int, has_lut: tuple):
+    """Device kernel: row -> destination partition (dead rows -> -1).
+    Keys are canonicalized (dtype-widened; dictionary codes mapped to
+    value hashes via `luts`) so co-partitioned fragments agree."""
+    lanes = []
+    li = 0
+    for k, h in zip(keys, has_lut):
+        if h:
+            lanes.append(canonical_hash_input(k, luts[li]))
+            li += 1
+        else:
+            lanes.append(canonical_hash_input(k))
+    pid = partition_of(hash32(lanes, list(valids)), n)
+    return jnp.where(live, pid, -1)
+
+
+def split_page(page: Page, pid: np.ndarray, n: int) -> List[Page]:
+    """Split a compacted wire page by per-row partition id (host side)."""
+    out = []
+    for p in range(n):
+        m = pid == p
+        rows = int(m.sum())
+        out.append(
+            Page(
+                page.types,
+                [c[m] for c in page.columns],
+                [None if v is None else v[m] for v in page.valids],
+                page.dictionaries,
+                rows,
+            )
+        )
+    return out
+
+
+class PartitionedOutputOperator(Operator):
+    """Terminal sink of every fragment pipeline: splits each output batch
+    into the task's OutputBuffer partitions. kind: "single" | "hash" |
+    "broadcast" | "arbitrary" (the SystemPartitioningHandle set,
+    SystemPartitioningHandle.java:48–55)."""
+
+    def __init__(
+        self,
+        buffer,  # runtime.buffers.OutputBuffer
+        kind: str,
+        hash_channels: Sequence[int] = (),
+        n_partitions: int = 1,
+    ):
+        assert kind in ("single", "hash", "broadcast", "arbitrary"), kind
+        self._buffer = buffer
+        self._kind = kind
+        self._hash_channels = list(hash_channels)
+        self._n = n_partitions
+        self._rr = 0
+        self._finishing = False
+        self._lut_cache: dict = {}
+
+    def _code_hashes(self, dictionary):
+        # keyed by the VALUES tuple, not object identity: per-page
+        # dictionaries die after their batch, and a recycled address must
+        # not serve a stale LUT
+        lut = self._lut_cache.get(dictionary.values)
+        if lut is None:
+            lut = jnp.asarray(dictionary_code_hashes(dictionary.values))
+            self._lut_cache[dictionary.values] = lut
+        return lut
+
+    def add_input(self, batch: RelBatch) -> None:
+        if self._kind == "hash" and self._n > 1:
+            keys, valids, luts, has_lut = [], [], [], []
+            for c in self._hash_channels:
+                col = batch.columns[c]
+                keys.append(col.data)
+                valids.append(col.valid_mask())
+                if col.dictionary is not None:
+                    luts.append(self._code_hashes(col.dictionary))
+                    has_lut.append(True)
+                else:
+                    has_lut.append(False)
+            pid = _partition_ids(
+                tuple(keys), tuple(valids), tuple(luts),
+                batch.live_mask(), self._n, tuple(has_lut),
+            )
+            page = Page.from_batch(batch)
+            live = (
+                np.asarray(jax.device_get(batch.live)).astype(bool)
+                if batch.live is not None
+                else np.ones(batch.capacity, dtype=bool)
+            )
+            pid_np = np.asarray(jax.device_get(pid))[live]
+            for p, part in enumerate(split_page(page, pid_np, self._n)):
+                if part.row_count:
+                    self._buffer.enqueue(p, part)
+            return
+        page = Page.from_batch(batch)
+        if page.row_count == 0:
+            return
+        if self._kind == "broadcast":
+            for p in range(self._n):
+                self._buffer.enqueue(p, page)
+        elif self._kind == "arbitrary":
+            self._buffer.enqueue(self._rr % self._n, page)
+            self._rr += 1
+        else:
+            self._buffer.enqueue(0, page)
+
+    def finish(self) -> None:
+        if not self._finishing:
+            self._finishing = True
+            self._buffer.set_no_more_pages()
+
+    def is_finished(self) -> bool:
+        return self._finishing
+
+
+class RemoteSourceOperator(Operator):
+    """Source operator pulling wire pages from an exchange client.
+    With `merge_keys` it behaves like MergeOperator: waits for all
+    producers, then emits one merged sorted batch."""
+
+    def __init__(
+        self,
+        source,  # poll() -> Optional[Page]; is_finished() -> bool
+        merge_keys: Optional[Sequence[SortKey]] = None,
+    ):
+        self._source = source
+        self._merge_keys = tuple(merge_keys) if merge_keys else None
+        self._pending: List[RelBatch] = []
+        self._done = False
+
+    def needs_input(self) -> bool:
+        return False
+
+    def get_output(self) -> Optional[RelBatch]:
+        if self._done:
+            return None
+        if self._merge_keys is not None:
+            page = self._source.poll()
+            while page is not None:
+                if page.row_count:
+                    self._pending.append(page.to_batch())
+                page = self._source.poll()
+            if not self._source.is_finished():
+                return None
+            self._done = True
+            if not self._pending:
+                return None
+            out = _concat_sort(tuple(self._pending), self._merge_keys)
+            self._pending = []
+            return out
+        page = self._source.poll()
+        if page is None:
+            if self._source.is_finished():
+                self._done = True
+            return None
+        if page.row_count == 0:
+            return None
+        return page.to_batch()
+
+    def is_blocked(self) -> bool:
+        return not self._done and not self._source.is_finished()
+
+    def is_finished(self) -> bool:
+        # _done is set by get_output once the source reports finished and
+        # the last page has been drained (or merged and emitted)
+        return self._done

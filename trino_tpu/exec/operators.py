@@ -58,6 +58,13 @@ class Operator:
     def is_finished(self) -> bool:
         raise NotImplementedError
 
+    def is_blocked(self) -> bool:
+        """True when the operator is waiting on an async event (remote
+        pages, buffer space) — Operator.isBlocked's ListenableFuture
+        collapsed to a poll (the driver sleeps instead of parking on a
+        future)."""
+        return False
+
     _finishing = False
 
 
@@ -355,6 +362,19 @@ class AggSpec:
     distinct: bool = False
 
 
+def minmax_neutral(dtype, kind: str):
+    """Identity element for min/max accumulators: the single source of
+    truth shared by every aggregation path (batch init, global fold,
+    partial-state merge) — keep these in sync or partial->final
+    aggregation silently diverges from single-step."""
+    if jnp.issubdtype(np.dtype(dtype), np.floating):
+        return np.inf if kind == "min" else -np.inf
+    if np.dtype(dtype) == np.bool_:
+        return kind == "min"
+    info = np.iinfo(np.dtype(dtype))
+    return info.max if kind == "min" else info.min
+
+
 def _agg_state_init(spec: AggSpec, arg_dtype, capacity: int):
     """(value_state, count_state) arrays of shape (capacity,)."""
     if spec.kind in ("count", "count_star"):
@@ -366,15 +386,8 @@ def _agg_state_init(spec: AggSpec, arg_dtype, capacity: int):
             jnp.zeros(capacity, dtype=jnp.int64),
         )
     if spec.kind in ("min", "max"):
-        if np.issubdtype(arg_dtype, np.floating):
-            extreme = jnp.inf if spec.kind == "min" else -jnp.inf
-        elif arg_dtype == np.bool_:
-            extreme = True if spec.kind == "min" else False
-        else:
-            info = np.iinfo(arg_dtype)
-            extreme = info.max if spec.kind == "min" else info.min
         return (
-            jnp.full(capacity, extreme, dtype=arg_dtype),
+            jnp.full(capacity, minmax_neutral(arg_dtype, spec.kind), dtype=arg_dtype),
             jnp.zeros(capacity, dtype=jnp.int64),
         )
     if spec.kind == "any":
@@ -458,6 +471,43 @@ def _agg_output(spec: AggSpec, state, arg_type: Optional[T.DataType],
     raise NotImplementedError(spec.kind)
 
 
+def agg_state_meta(
+    spec: AggSpec,
+    input_schema: Sequence[Tuple[T.DataType, "Optional[Dictionary]"]],
+) -> List[Tuple[T.DataType, "Optional[Dictionary]"]]:
+    """Wire schema of one aggregate's partial state: (value, count)
+    columns. This is the accumulator-serialization contract between
+    PARTIAL and FINAL aggregation steps (the analogue of Trino's
+    aggregation state serialized to Blocks for partial->final,
+    main/operator/aggregation/ — SURVEY.md §2.6)."""
+    if spec.kind in ("count", "count_star"):
+        return [(T.BIGINT, None), (T.BIGINT, None)]
+    arg_t, arg_d = input_schema[spec.arg_channel]
+    if spec.kind in ("sum", "avg"):
+        if arg_t.is_floating:
+            val_t = T.DOUBLE
+        elif arg_t.is_decimal:
+            val_t = T.DataType(T.TypeKind.DECIMAL, 18, arg_t.scale)
+        else:
+            val_t = T.BIGINT
+        return [(val_t, None), (T.BIGINT, None)]
+    # min/max/any carry the argument representation through the wire
+    return [(arg_t, arg_d), (T.BIGINT, None)]
+
+
+def partial_output_schema(
+    aggs: Sequence[AggSpec],
+    group_channels: Sequence[int],
+    input_schema: Sequence[Tuple[T.DataType, "Optional[Dictionary]"]],
+) -> List[Tuple[T.DataType, "Optional[Dictionary]"]]:
+    """Schema of a PARTIAL aggregation's output batch:
+    [group keys..., (value, count) per aggregate...]."""
+    out = [input_schema[c] for c in group_channels]
+    for a in aggs:
+        out.extend(agg_state_meta(a, input_schema))
+    return out
+
+
 _BATCH_REDUCER = {"sum": "sum", "avg": "sum", "count": "count",
                   "count_star": "count", "min": "min", "max": "max",
                   "any": "first"}
@@ -515,13 +565,7 @@ def _global_update_fn(aggs: Tuple[AggSpec, ...]):
                     contrib = jnp.where(w, data.astype(val.dtype), 0)
                     out.append((val + jnp.sum(contrib), cnt + n))
                 elif a.kind in ("min", "max"):
-                    if jnp.issubdtype(data.dtype, jnp.floating):
-                        neutral = jnp.inf if a.kind == "min" else -jnp.inf
-                    elif data.dtype == jnp.bool_:
-                        neutral = a.kind == "min"
-                    else:
-                        info = jnp.iinfo(data.dtype)
-                        neutral = info.max if a.kind == "min" else info.min
+                    neutral = minmax_neutral(data.dtype, a.kind)
                     masked = jnp.where(w, data, jnp.asarray(neutral, data.dtype))
                     red = jnp.min(masked) if a.kind == "min" else jnp.max(masked)
                     op = jnp.minimum if a.kind == "min" else jnp.maximum
@@ -556,7 +600,17 @@ class HashAggregationOperator(Operator):
         aggregates: Sequence[AggSpec],
         input_schema: Sequence[Tuple[T.DataType, Optional[Dictionary]]],
         initial_capacity: int = 1024,
+        step: str = "single",
+        arg_meta: Optional[Sequence[Tuple[Optional[T.DataType], Optional[Dictionary]]]] = None,
     ):
+        """step: "single" (raw rows in, results out), "partial" (raw rows
+        in, serialized accumulator state out) or "final" (accumulator
+        state in, results out) — AggregationNode.Step analogue. In final
+        mode the input layout is partial_output_schema's and `arg_meta`
+        carries each aggregate's ORIGINAL argument (type, dictionary)
+        for finalization (decimal rescale, dictionary decode)."""
+        assert step in ("single", "partial", "final"), step
+        self._step = step
         self._group_channels = list(group_channels)
         self._aggs = list(aggregates)
         self._schema = list(input_schema)
@@ -566,7 +620,14 @@ class HashAggregationOperator(Operator):
         self._acc = None
         self._gstate = None
         self._out: Optional[RelBatch] = None
-        if self._global:
+        if arg_meta is not None:
+            self._arg_meta = list(arg_meta)
+        else:
+            self._arg_meta = [
+                input_schema[a.arg_channel] if a.arg_channel is not None else (None, None)
+                for a in self._aggs
+            ]
+        if self._global and step != "final":
             self._update = _global_update_fn(tuple(self._aggs))
 
     # -- grouped path --
@@ -585,6 +646,9 @@ class HashAggregationOperator(Operator):
         return live, values, vvalids, tuple(reds)
 
     def add_input(self, batch: RelBatch) -> None:
+        if self._step == "final":
+            self._add_state_input(batch)
+            return
         if self._global:
             if self._gstate is None:
                 self._gstate = self._global_init()
@@ -613,6 +677,86 @@ class HashAggregationOperator(Operator):
                 return merged
             self._cap *= 2
 
+    # -- final step: consume serialized accumulator state --
+    def _add_state_input(self, batch: RelBatch) -> None:
+        """Ingest a partial_output_schema-layout batch (the exchange's
+        output) directly as a group-state set and merge it in."""
+        k = len(self._group_channels)
+        live = batch.live_mask()
+        if self._global:
+            self._merge_global_state(batch, live)
+            return
+        keys = [batch.columns[c].data for c in range(k)]
+        valids = [batch.columns[c].valid_mask() for c in range(k)]
+        vals = [batch.columns[k + 2 * i].data for i in range(len(self._aggs))]
+        cnts = [batch.columns[k + 2 * i + 1].data for i in range(len(self._aggs))]
+        new = ([*keys], [*valids], live, [*vals], [*cnts])
+        self._acc = new if self._acc is None else self._merge(self._acc, new)
+
+    def _merge_global_state(self, batch: RelBatch, live) -> None:
+        """Global (no GROUP BY) final step: fold incoming single-row
+        states with the merge reducers."""
+        if self._gstate is None:
+            self._gstate = self._global_init()
+        out = []
+        for i, a in enumerate(self._aggs):
+            val, cnt = self._gstate[i]
+            v_in = batch.columns[2 * i].data
+            c_in = batch.columns[2 * i + 1].data.astype(jnp.int64)
+            c_in = jnp.where(live, c_in, 0)
+            n = jnp.sum(c_in)
+            red = _MERGE_REDUCER[a.kind]
+            if red == "sum":
+                neutral = jnp.zeros((), dtype=val.dtype)
+                contrib = jnp.where(live, v_in.astype(val.dtype), neutral)
+                out.append((val + jnp.sum(contrib), cnt + n))
+            elif red in ("min", "max"):
+                neutral = minmax_neutral(v_in.dtype, red)
+                present = live & (c_in > 0)
+                masked = jnp.where(present, v_in, jnp.asarray(neutral, v_in.dtype))
+                r = jnp.min(masked) if red == "min" else jnp.max(masked)
+                op = jnp.minimum if red == "min" else jnp.maximum
+                out.append((op(val, r.astype(val.dtype)), cnt + n))
+            else:  # first
+                present = live & (c_in > 0)
+                first = v_in[jnp.argmax(present)]
+                new_val = jnp.where(
+                    cnt > 0, val, jnp.where(jnp.any(present), first, val)
+                )
+                out.append((new_val, cnt + n))
+        self._gstate = out
+
+    # -- partial step: emit serialized accumulator state --
+    def _emit_partial(self) -> None:
+        meta = [agg_state_meta(a, self._schema) for a in self._aggs] if not self._global else None
+        cols: List[Column] = []
+        if self._global:
+            states = self._gstate if self._gstate is not None else self._global_init()
+            for a, (val, cnt) in zip(self._aggs, states):
+                vt, vd = agg_state_meta(a, self._schema)[0]
+                cols.append(Column(vt, val[None].astype(vt.dtype), None, vd))
+                cols.append(Column(T.BIGINT, cnt[None].astype(jnp.int64), None, None))
+            self._out = RelBatch(cols, jnp.ones(1, dtype=jnp.bool_))
+            return
+        if self._acc is None:
+            key_dts = [self._schema[c][0].dtype for c in self._group_channels]
+            self._acc = (
+                [jnp.zeros(16, dtype=dt) for dt in key_dts],
+                [jnp.zeros(16, dtype=jnp.bool_) for _ in key_dts],
+                jnp.zeros(16, dtype=jnp.bool_),
+                [jnp.zeros(16, dtype=jnp.int64) for _ in self._aggs],
+                [jnp.zeros(16, dtype=jnp.int64) for _ in self._aggs],
+            )
+        gk, gv, used, vals, cnts = self._acc
+        for ch, kk, vv in zip(self._group_channels, gk, gv):
+            t, d = self._schema[ch]
+            cols.append(Column(t, kk, vv, d))
+        for (vmeta, _cmeta), val, cnt in zip(meta, vals, cnts):
+            vt, vd = vmeta
+            cols.append(Column(vt, val.astype(vt.dtype), None, vd))
+            cols.append(Column(T.BIGINT, cnt.astype(jnp.int64), None, None))
+        self._out = RelBatch(cols, used)
+
     # -- global path --
     def _global_init(self):
         states = []
@@ -630,14 +774,7 @@ class HashAggregationOperator(Operator):
                 )
                 val = jnp.zeros((), dtype=acc_dt)
             elif a.kind in ("min", "max"):
-                if np.issubdtype(dt, np.floating):
-                    v = np.inf if a.kind == "min" else -np.inf
-                elif dt == np.bool_:
-                    v = a.kind == "min"
-                else:
-                    info = np.iinfo(dt)
-                    v = info.max if a.kind == "min" else info.min
-                val = jnp.asarray(v, dtype=dt)
+                val = jnp.asarray(minmax_neutral(dt, a.kind), dtype=dt)
             else:  # any
                 val = jnp.zeros((), dtype=dt)
             states.append((val, jnp.int64(0)))
@@ -647,21 +784,20 @@ class HashAggregationOperator(Operator):
         if self._finishing:
             return
         self._finishing = True
+        if self._step == "partial":
+            self._emit_partial()
+            return
         cols: List[Column] = []
         if self._global:
             states = self._gstate if self._gstate is not None else self._global_init()
             live = jnp.ones(1, dtype=jnp.bool_)
-            for a, (val, cnt) in zip(self._aggs, states):
+            for i, (a, (val, cnt)) in enumerate(zip(self._aggs, states)):
                 state = (
                     (val[None],)
                     if a.kind in ("count", "count_star")
                     else (val[None], cnt[None])
                 )
-                arg_t, arg_d = (
-                    self._schema[a.arg_channel]
-                    if a.arg_channel is not None
-                    else (None, None)
-                )
+                arg_t, arg_d = self._arg_meta[i]
                 cols.append(_agg_output(a, state, arg_t, arg_d))
             self._out = RelBatch(cols, live)
             return
@@ -679,13 +815,9 @@ class HashAggregationOperator(Operator):
         for ch, k, v in zip(self._group_channels, gk, gv):
             t, d = self._schema[ch]
             cols.append(Column(t, k, v, d))
-        for a, val, cnt in zip(self._aggs, vals, cnts):
+        for i, (a, val, cnt) in enumerate(zip(self._aggs, vals, cnts)):
             state = (val,) if a.kind in ("count", "count_star") else (val, cnt)
-            arg_t, arg_d = (
-                self._schema[a.arg_channel]
-                if a.arg_channel is not None
-                else (None, None)
-            )
+            arg_t, arg_d = self._arg_meta[i]
             cols.append(_agg_output(a, state, arg_t, arg_d))
         self._out = RelBatch(cols, used)
 

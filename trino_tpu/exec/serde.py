@@ -1,0 +1,195 @@
+"""Page wire format: host-side serialized batches for remote exchange.
+
+Analogue of Trino's serialized-page format (main/execution/buffer/
+PagesSerdeUtil.java:53 — length-prefixed header with positionCount +
+codec markers, per-block encodings; PageSerializer.java:18 adds LZ4;
+SURVEY.md §2.8). TPU-first delta: the wire unit is a host ``Page`` —
+compacted numpy SoA columns — because pages cross process/host
+boundaries only after leaving the device. Compression is zlib (the
+stdlib stand-in for airlift LZ4; the native C++ serde plugs in behind
+the same two functions).
+
+Framing:  [u8 codec] [u32 raw_len] [body]
+  codec: 0 = raw pickle-v5 body, 1 = zlib-compressed body.
+The body is a pickle of the Page's schema descriptor + numpy buffers —
+protocol 5 keeps the bulk column bytes as contiguous buffers, which is
+what the C++ path mmaps/compresses without copies.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pickle
+import struct
+import zlib
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.block import Column, Dictionary, RelBatch, bucket_capacity
+
+_HEADER = struct.Struct("<BI")
+COMPRESS_MIN_BYTES = 1 << 13  # below this, compression costs more than it saves
+
+
+@dataclasses.dataclass
+class Page:
+    """Host-side compacted batch: the unit of exchange between tasks.
+
+    `columns[i]` has exactly `row_count` entries (no capacity padding —
+    dead rows never cross the wire, like Page.compact before serialize).
+    """
+
+    types: List[T.DataType]
+    columns: List[np.ndarray]
+    valids: List[Optional[np.ndarray]]
+    dictionaries: List[Optional[Tuple[str, ...]]]
+    row_count: int
+
+    @property
+    def width(self) -> int:
+        return len(self.columns)
+
+    def size_bytes(self) -> int:
+        n = 0
+        for c in self.columns:
+            n += c.nbytes
+        for v in self.valids:
+            if v is not None:
+                n += v.nbytes
+        return n
+
+    @staticmethod
+    def from_batch(batch: RelBatch) -> "Page":
+        """Device batch -> compacted host page (one device->host copy)."""
+        import jax
+
+        host = jax.device_get(batch)
+        live = (
+            np.asarray(host.live).astype(bool)
+            if host.live is not None
+            else np.ones(batch.capacity, dtype=bool)
+        )
+        cols, valids, dicts, typs = [], [], [], []
+        for c in host.columns:
+            data = np.asarray(c.data)[live]
+            cols.append(np.ascontiguousarray(data))
+            valids.append(
+                np.ascontiguousarray(np.asarray(c.valid)[live])
+                if c.valid is not None
+                else None
+            )
+            dicts.append(c.dictionary.values if c.dictionary is not None else None)
+            typs.append(c.type)
+        return Page(typs, cols, valids, dicts, int(live.sum()))
+
+    def to_batch(self, capacity: Optional[int] = None) -> RelBatch:
+        """Host page -> device batch (padded back to bucketed capacity)."""
+        import jax.numpy as jnp
+
+        cap = capacity if capacity is not None else bucket_capacity(self.row_count)
+        out = []
+        for t, data, valid, dvals in zip(
+            self.types, self.columns, self.valids, self.dictionaries
+        ):
+            d = Dictionary(dvals) if dvals is not None else None
+            # Dictionary values are sorted + deduped on construction; wire
+            # pages are encoded against the exact tuple, so re-encode codes
+            # if sorting changed positions (it never does for tables whose
+            # dictionaries were built by Dictionary itself).
+            if d is not None and d.values != tuple(dvals):
+                remap = np.asarray([d.code(v) for v in dvals], dtype=np.int32)
+                data = remap[data]
+            out.append(Column.from_numpy(t, data, valid, d, capacity=cap))
+        live = None
+        if self.row_count != cap:
+            lv = np.zeros(cap, dtype=bool)
+            lv[: self.row_count] = True
+            live = jnp.asarray(lv)
+        return RelBatch(out, live)
+
+
+def serialize_page(page: Page, compress: Optional[bool] = None) -> bytes:
+    desc = (
+        page.types,
+        page.dictionaries,
+        page.row_count,
+        [c.dtype.str for c in page.columns],
+        [c.tobytes() for c in page.columns],
+        [None if v is None else v.tobytes() for v in page.valids],
+    )
+    body = pickle.dumps(desc, protocol=5)
+    if compress is None:
+        compress = len(body) >= COMPRESS_MIN_BYTES
+    if compress:
+        packed = zlib.compress(body, 1)
+        return _HEADER.pack(1, len(body)) + packed
+    return _HEADER.pack(0, len(body)) + body
+
+
+def deserialize_page(data: bytes) -> Page:
+    codec, raw_len = _HEADER.unpack_from(data, 0)
+    body = data[_HEADER.size :]
+    if codec == 1:
+        body = zlib.decompress(body)
+        assert len(body) == raw_len
+    types, dicts, rows, dtypes, col_bufs, valid_bufs = pickle.loads(body)
+    cols = [
+        np.frombuffer(b, dtype=np.dtype(ds)).copy()
+        for ds, b in zip(dtypes, col_bufs)
+    ]
+    valids = [
+        None if b is None else np.frombuffer(b, dtype=bool).copy()
+        for b in valid_bufs
+    ]
+    return Page(list(types), cols, valids, list(dicts), rows)
+
+
+def serialize_batch(batch: RelBatch, compress: Optional[bool] = None) -> bytes:
+    return serialize_page(Page.from_batch(batch), compress)
+
+
+def deserialize_batch(data: bytes) -> RelBatch:
+    return deserialize_page(data).to_batch()
+
+
+def concat_pages(pages: Sequence[Page]) -> Page:
+    """Merge wire pages into one (consumer-side consolidation). String
+    columns are re-encoded onto a unified dictionary."""
+    pages = [p for p in pages if p.row_count > 0] or list(pages[:1])
+    if len(pages) == 1:
+        return pages[0]
+    width = pages[0].width
+    types = pages[0].types
+    cols, valids, dicts = [], [], []
+    for i in range(width):
+        dvals = [p.dictionaries[i] for p in pages]
+        if any(d is not None for d in dvals):
+            merged = Dictionary([v for d in dvals if d is not None for v in d])
+            parts = []
+            for p, d in zip(pages, dvals):
+                remap = np.asarray(
+                    [merged.code(v) for v in (d or ())], dtype=np.int32
+                )
+                c = p.columns[i]
+                parts.append(remap[c] if len(remap) else c)
+            cols.append(np.concatenate(parts))
+            dicts.append(merged.values)
+        else:
+            cols.append(np.concatenate([p.columns[i] for p in pages]))
+            dicts.append(None)
+        if any(p.valids[i] is not None for p in pages):
+            valids.append(
+                np.concatenate(
+                    [
+                        p.valids[i]
+                        if p.valids[i] is not None
+                        else np.ones(p.row_count, dtype=bool)
+                        for p in pages
+                    ]
+                )
+            )
+        else:
+            valids.append(None)
+    return Page(types, cols, valids, dicts, sum(p.row_count for p in pages))

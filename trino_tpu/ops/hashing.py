@@ -78,3 +78,31 @@ def partition_of(h: jnp.ndarray, num_partitions: int) -> jnp.ndarray:
     if num_partitions & (num_partitions - 1) == 0:
         return (h & jnp.uint32(num_partitions - 1)).astype(jnp.int32)
     return (h % jnp.uint32(num_partitions)).astype(jnp.int32)
+
+
+def dictionary_code_hashes(values: Sequence[str]) -> "np.ndarray":
+    """Per-code value hash for a dictionary column: hashing the string
+    VALUE (crc32), not the code, so two sides of an exchange with
+    different dictionaries partition equal strings identically — the
+    cross-fragment analogue of TypeOperators' per-type hash contract."""
+    import numpy as np
+    import zlib
+
+    return np.asarray(
+        [zlib.crc32(v.encode("utf-8")) for v in values], dtype=np.uint32
+    )
+
+
+def canonical_hash_input(data: jnp.ndarray, code_hashes=None) -> jnp.ndarray:
+    """Normalize a key column for cross-fragment hash partitioning:
+    integer-like -> int64, floating -> float64, dictionary codes -> the
+    per-value hash (via `code_hashes`). Equal SQL values must produce
+    equal lanes regardless of physical dtype or dictionary identity."""
+    if code_hashes is not None:
+        idx = jnp.clip(data, 0, code_hashes.shape[0] - 1).astype(jnp.int32)
+        return jnp.take(jnp.asarray(code_hashes), idx).astype(jnp.uint32)
+    if jnp.issubdtype(data.dtype, jnp.floating):
+        return data.astype(jnp.float64)
+    if data.dtype == jnp.bool_:
+        return data.astype(jnp.int64)
+    return data.astype(jnp.int64)

@@ -1,0 +1,14 @@
+"""Distributed runtime: workers, tasks, buffers, exchange, scheduling.
+
+The coordinator/worker split of the reference (SURVEY.md §1 layers 2–9)
+— a Python/host control plane around the XLA device data plane. The
+in-process form (threads standing in for worker hosts) is the tier-3
+DistributedQueryRunner test topology; the HTTP form runs the same task
+runtime behind a real wire.
+"""
+
+from trino_tpu.runtime.buffers import OutputBuffer
+from trino_tpu.runtime.coordinator import DistributedQueryRunner
+from trino_tpu.runtime.worker import Worker
+
+__all__ = ["OutputBuffer", "DistributedQueryRunner", "Worker"]

@@ -1,0 +1,317 @@
+"""Coordinator: distributed planning + pipelined all-at-once scheduling.
+
+Analogue of SqlQueryExecution (planQuery/planDistribution,
+SqlQueryExecution.java:457/503) + PipelinedQueryScheduler.java:155
+(StageManager creating every stage up front, tasks streaming pages
+between stages through pull+ack buffers — SURVEY.md §3.1–§3.4).
+The DistributedQueryRunner facade mirrors
+testing/trino-testing/DistributedQueryRunner.java:84: one coordinator +
+N workers in one process, real exchange data plane between tasks.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Dict, List, Optional
+
+from trino_tpu import types as T
+from trino_tpu.connectors.spi import CatalogManager, Connector
+from trino_tpu.engine import MaterializedResult, Session
+from trino_tpu.runtime.task import TaskId, TaskSpec
+from trino_tpu.runtime.worker import Worker
+from trino_tpu.sql import ast
+from trino_tpu.sql.analyzer import Analyzer
+from trino_tpu.sql.fragmenter import SubPlan, explain_distributed, plan_distributed
+from trino_tpu.sql.local_planner import LocalPlanner
+from trino_tpu.sql.parser import parse
+from trino_tpu.exec.serde import Page
+
+_query_counter = itertools.count(1)
+
+
+class QueryScheduler:
+    """Schedules one query's SubPlan over the workers (pipelined mode:
+    every stage starts immediately; pages stream between running stages)."""
+
+    def __init__(
+        self,
+        query_id: str,
+        subplan: SubPlan,
+        workers: List[Worker],
+        catalogs: CatalogManager,
+        session: Session,
+        hash_partitions: Optional[int] = None,
+    ):
+        self.query_id = query_id
+        self.subplan = subplan
+        self.workers = workers
+        self.catalogs = catalogs
+        self.session = session
+        self.hash_partitions = hash_partitions or min(len(workers), 4)
+        # fragment id -> [(worker handle, task id string)]
+        self.tasks: Dict[int, List] = {}
+        self._schemas: Dict[int, list] = {}
+
+    # -- fragment schema propagation (coordinator-side planning pass) --
+    def _topo(self, sp: SubPlan, out: List[SubPlan]) -> None:
+        for c in sp.children:
+            self._topo(c, out)
+        out.append(sp)
+
+    def _fragment_schema(self, sp: SubPlan, remote: dict) -> list:
+        """Coordinator-side planning pass for the fragment's output
+        schema (dictionaries included) so worker-side planning of
+        consumer fragments can bind expressions."""
+        planner = LocalPlanner(
+            self.catalogs,
+            batch_rows=self.session.batch_rows,
+            remote_schemas=remote,
+        )
+        physical = planner.plan(sp.fragment.root)
+        return physical.schema
+
+    def _task_count(self, sp: SubPlan) -> int:
+        p = sp.fragment.partitioning
+        if p == "single":
+            return 1
+        if p == "source":
+            return max(1, len(self.workers))
+        return self.hash_partitions
+
+    def start(self):
+        """Create all tasks bottom-up (producers first so consumers can
+        reference their buffers); returns the root task."""
+        order: List[SubPlan] = []
+        self._topo(self.subplan, order)
+        task_counts: Dict[int, int] = {}
+        consumer_counts: Dict[int, int] = {}
+        # first pass: task counts; consumer partition counts per producer
+        for sp in order:
+            task_counts[sp.fragment.id] = self._task_count(sp)
+        for sp in order:
+            for c in sp.children:
+                consumer_counts[c.fragment.id] = task_counts[sp.fragment.id]
+        rr = itertools.count()
+        for sp in order:
+            f = sp.fragment
+            tc = task_counts[f.id]
+            n_out = consumer_counts.get(f.id, 1)
+            remote = {
+                c.fragment.id: self._schemas[c.fragment.id]
+                for c in sp.children
+            }
+            self._schemas[f.id] = self._fragment_schema(sp, remote)
+            input_locations = {
+                c.fragment.id: [
+                    handle.results_location(tid)
+                    for handle, tid in self.tasks[c.fragment.id]
+                ]
+                for c in sp.children
+            }
+            created = []
+            for p in range(tc):
+                task_id = TaskId(self.query_id, f.id, p)
+                spec = TaskSpec(
+                    task_id=task_id,
+                    fragment=f,
+                    n_output_partitions=n_out,
+                    remote_schemas=remote,
+                    scan_slice=(p, tc) if f.partitioning == "source" else None,
+                    input_locations=input_locations,
+                    batch_rows=self.session.batch_rows,
+                    target_splits=max(self.session.target_splits, tc),
+                )
+                worker = self.workers[next(rr) % len(self.workers)]
+                worker.create_task(spec)
+                created.append((worker, str(task_id)))
+            self.tasks[f.id] = created
+        return self.tasks[self.subplan.fragment.id][0]
+
+    def failed_tasks(self) -> List[str]:
+        out = []
+        for ts in self.tasks.values():
+            for handle, tid in ts:
+                try:
+                    st = handle.task_state(tid)
+                except Exception as e:
+                    out.append(f"{tid}: status fetch failed ({e})")
+                    continue
+                if st["state"] == "failed":
+                    out.append(f"{tid}: {st.get('failure')}")
+        return out
+
+    def abort(self) -> None:
+        for ts in self.tasks.values():
+            for handle, tid in ts:
+                try:
+                    handle.remove_task(tid)
+                except Exception:
+                    pass
+
+
+class DistributedQueryRunner:
+    """Multi-worker engine in one process (DistributedQueryRunner.java:84
+    analogue): same SQL surface as LocalQueryRunner, but every query runs
+    through fragments, tasks and the page exchange."""
+
+    def __init__(
+        self,
+        session: Optional[Session] = None,
+        n_workers: int = 2,
+        hash_partitions: Optional[int] = None,
+        worker_handles: Optional[List] = None,
+    ):
+        """Default topology: N in-process Workers sharing the coordinator
+        CatalogManager. Pass `worker_handles` (e.g. HttpWorkerClient
+        instances) to schedule over remote workers instead — catalogs
+        must then be registered on each worker process separately, as in
+        the reference's per-node catalog loading."""
+        self.session = session or Session()
+        self.catalogs = CatalogManager()
+        if worker_handles is not None:
+            self.workers = list(worker_handles)
+        else:
+            self.workers = [
+                Worker(f"worker-{i}", self.catalogs) for i in range(n_workers)
+            ]
+        self.hash_partitions = hash_partitions
+
+    def register_catalog(self, name: str, connector: Connector) -> None:
+        self.catalogs.register(name, connector)
+
+    # -- entry point --
+    def execute(self, sql: str) -> MaterializedResult:
+        stmt = parse(sql)
+        if isinstance(stmt, ast.ExplainStatement):
+            output = self._analyze(stmt.query)
+            subplan = plan_distributed(output, self.catalogs)
+            return MaterializedResult(
+                [[explain_distributed(subplan)]], ["Query Plan"], [T.VARCHAR]
+            )
+        if not isinstance(stmt, ast.Query):
+            # metadata statements take the single-node path
+            from trino_tpu.engine import LocalQueryRunner
+
+            lqr = LocalQueryRunner(self.session)
+            lqr.catalogs = self.catalogs
+            return lqr.execute(sql)
+        output = self._analyze(stmt)
+        subplan = plan_distributed(output, self.catalogs)
+        result_meta = (list(output.names), [f.type for f in output.fields])
+        if self.session.retry_policy == "task":
+            rows = self._execute_fte(subplan)
+            return MaterializedResult(rows, *result_meta)
+        attempts = (
+            1 + self.session.query_retries
+            if self.session.retry_policy == "query"
+            else 1
+        )
+        last_error: Optional[BaseException] = None
+        for _ in range(attempts):
+            query_id = f"q{next(_query_counter)}"
+            scheduler = QueryScheduler(
+                query_id,
+                subplan,
+                self.workers,
+                self.catalogs,
+                self.session,
+                self.hash_partitions,
+            )
+            root_handle, root_tid = scheduler.start()
+            try:
+                rows = self._collect(scheduler, root_handle, root_tid)
+                return MaterializedResult(rows, *result_meta)
+            except RuntimeError as e:
+                last_error = e  # retry_policy=QUERY: whole-query re-run
+            finally:
+                scheduler.abort()
+        raise last_error
+
+    def _execute_fte(self, subplan) -> List[list]:
+        """retry_policy=TASK: FTE over the spooled exchange."""
+        import shutil
+        import tempfile
+
+        from trino_tpu.runtime.fte import FaultTolerantQueryScheduler
+        from trino_tpu.runtime.spool import read_spool
+
+        query_id = f"q{next(_query_counter)}"
+        spool_dir = tempfile.mkdtemp(prefix=f"trino-tpu-spool-{query_id}-")
+        try:
+            scheduler = FaultTolerantQueryScheduler(
+                query_id,
+                subplan,
+                self.workers,
+                self.catalogs,
+                self.session,
+                spool_dir,
+                self.hash_partitions,
+                max_task_retries=self.session.task_retries,
+            )
+            _, root_key = scheduler.run()
+            import os
+
+            root_dir = os.path.join(spool_dir, root_key)
+            rows: List[list] = []
+            token = 0
+            while True:
+                pages, token, complete = read_spool(root_dir, 0, token)
+                for page in pages:
+                    rows.extend(_page_rows(page))
+                if complete:
+                    return rows
+        finally:
+            shutil.rmtree(spool_dir, ignore_errors=True)
+
+    def _analyze(self, q: ast.Query):
+        analyzer = Analyzer(
+            self.catalogs, self.session.catalog, self.session.schema
+        )
+        return analyzer.plan(q)
+
+    def _collect(self, scheduler: QueryScheduler, handle, tid) -> List[list]:
+        """Pull the root stage's single output partition (the
+        Query.getNextResult / removePagesFromExchange path,
+        server/protocol/Query.java:450)."""
+        rows: List[list] = []
+        token = 0
+        while True:
+            failed = scheduler.failed_tasks()
+            if failed:
+                raise RuntimeError("query failed: " + "; ".join(failed))
+            pages, token, complete = handle.get_results(
+                tid, 0, token, max_pages=16, wait=0.2
+            )
+            for page in pages:
+                rows.extend(_page_rows(page))
+            if complete:
+                return rows
+
+
+def _page_rows(page: Page) -> List[list]:
+    """Decode a wire page to python rows (host-side, no device round
+    trip) — the protocol-encoding path of Column.to_pylist."""
+    import numpy as np
+
+    cols = []
+    for t, data, valid, dvals in zip(
+        page.types, page.columns, page.valids, page.dictionaries
+    ):
+        vals = []
+        ok = valid if valid is not None else np.ones(len(data), dtype=bool)
+        for x, o in zip(data, ok):
+            if not o:
+                vals.append(None)
+            elif t.is_string:
+                vals.append(dvals[int(x)] if dvals else str(int(x)))
+            elif t.is_decimal:
+                vals.append(int(x) / T.decimal_scale_factor(t))
+            elif t.kind == T.TypeKind.BOOLEAN:
+                vals.append(bool(x))
+            elif t.is_floating:
+                vals.append(float(x))
+            else:
+                vals.append(int(x))
+        cols.append(vals)
+    return [list(r) for r in zip(*cols)] if cols else []

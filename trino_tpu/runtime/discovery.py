@@ -1,0 +1,91 @@
+"""Node discovery + heartbeat failure detection.
+
+Analogue of DiscoveryNodeManager (main/metadata/DiscoveryNodeManager.java:70
+— workers announce, coordinator tracks ACTIVE/SHUTTING_DOWN) and
+HeartbeatFailureDetector (main/failuredetector/HeartbeatFailureDetector.java:78
+— continuous pings with decay-based failure stats). SURVEY.md §5.3.
+
+Collapsed to the engine's needs: a registry of worker handles, a
+background pinger with an exponentially-decayed failure rate, and an
+active-set the scheduler consults per scheduling pass (which is how
+workers join/leave mid-stream in FTE mode).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+
+class NodeState:
+    def __init__(self, handle):
+        self.handle = handle
+        self.state = "active"  # active | shutting_down | failed
+        self.failure_rate = 0.0  # exponentially decayed
+        self.last_seen = time.monotonic()
+
+
+class NodeManager:
+    """Tracks workers; the heartbeat loop updates liveness. `handle` is
+    anything with .worker_id and .status() (in-process Worker gets a
+    trivial status)."""
+
+    DECAY = 0.8  # per-ping decay of the failure rate
+    FAIL_THRESHOLD = 0.6
+
+    def __init__(self, ping_interval: float = 1.0):
+        self._nodes: Dict[str, NodeState] = {}
+        self._lock = threading.Lock()
+        self._interval = ping_interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def register(self, handle) -> None:
+        with self._lock:
+            self._nodes[handle.worker_id] = NodeState(handle)
+
+    def active_workers(self) -> List:
+        with self._lock:
+            return [
+                n.handle
+                for n in self._nodes.values()
+                if n.state == "active"
+            ]
+
+    def all_states(self) -> Dict[str, str]:
+        with self._lock:
+            return {k: n.state for k, n in self._nodes.items()}
+
+    # -- heartbeat loop (HeartbeatFailureDetector.ping:350) --
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            self.ping_once()
+
+    def ping_once(self) -> None:
+        with self._lock:
+            nodes = list(self._nodes.values())
+        for n in nodes:
+            try:
+                status = n.handle.status()
+                n.failure_rate *= self.DECAY
+                n.last_seen = time.monotonic()
+                reported = status.get("state", "active")
+                if n.state != "failed" or n.failure_rate < self.FAIL_THRESHOLD:
+                    n.state = (
+                        "shutting_down"
+                        if reported == "shutting_down"
+                        else "active"
+                    )
+            except Exception:
+                n.failure_rate = n.failure_rate * self.DECAY + (1 - self.DECAY)
+                if n.failure_rate >= self.FAIL_THRESHOLD:
+                    n.state = "failed"

@@ -1,0 +1,105 @@
+"""Consumer-side exchange client: concurrent pullers over producer tasks.
+
+Analogue of main/operator/DirectExchangeClient.java:57 +
+HttpPageBufferClient.java:99 (SURVEY.md §3.4): one puller per producer
+location long-polls pages with an advancing token (each request acks the
+previous batch), feeding a memory-bounded shared queue the
+RemoteSourceOperator drains. Backpressure: pullers pause while the local
+queue is over budget (scheduleRequestIfNecessary's memory gate).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+from trino_tpu.exec.serde import Page
+
+# fetch(partition, token, max_pages, wait) -> (pages, next_token, complete)
+Fetch = Callable[[int, int, int, float], tuple]
+
+
+class ExchangeLocation:
+    """One producer task's result partition."""
+
+    def __init__(self, fetch: Fetch, partition: int):
+        self.fetch = fetch
+        self.partition = partition
+
+
+class DirectExchangeClient:
+    """Pulls pages from every location into one queue. poll() never
+    blocks; is_finished() is true once every location completed and the
+    queue drained."""
+
+    def __init__(
+        self,
+        locations: List[ExchangeLocation],
+        max_buffered_pages: int = 64,
+        long_poll_s: float = 0.5,
+    ):
+        self._locations = list(locations)
+        self._queue: List[Page] = []
+        self._lock = threading.Condition()
+        self._open = 0
+        self._max_buffered = max_buffered_pages
+        self._long_poll_s = long_poll_s
+        self._failure: Optional[BaseException] = None
+        self._closed = False
+        self._threads: List[threading.Thread] = []
+        for loc in self._locations:
+            t = threading.Thread(target=self._pull_loop, args=(loc,), daemon=True)
+            self._open += 1
+            self._threads.append(t)
+        for t in self._threads:
+            t.start()
+
+    def _pull_loop(self, loc: ExchangeLocation) -> None:
+        token = 0
+        try:
+            while not self._closed:
+                with self._lock:
+                    while (
+                        len(self._queue) >= self._max_buffered
+                        and not self._closed
+                    ):
+                        self._lock.wait(timeout=0.1)
+                    if self._closed:
+                        return
+                pages, token, complete = loc.fetch(
+                    loc.partition, token, 16, self._long_poll_s
+                )
+                if pages:
+                    with self._lock:
+                        self._queue.extend(pages)
+                        self._lock.notify_all()
+                if complete:
+                    return
+        except BaseException as e:  # surfaced to the driver via poll()
+            with self._lock:
+                self._failure = e
+        finally:
+            with self._lock:
+                self._open -= 1
+                self._lock.notify_all()
+
+    def poll(self) -> Optional[Page]:
+        with self._lock:
+            if self._failure is not None:
+                raise RuntimeError("exchange pull failed") from self._failure
+            if self._queue:
+                page = self._queue.pop(0)
+                self._lock.notify_all()
+                return page
+            return None
+
+    def is_finished(self) -> bool:
+        with self._lock:
+            if self._failure is not None:
+                raise RuntimeError("exchange pull failed") from self._failure
+            return self._open == 0 and not self._queue
+
+    def close(self) -> None:
+        self._closed = True
+        with self._lock:
+            self._lock.notify_all()

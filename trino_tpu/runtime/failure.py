@@ -1,0 +1,66 @@
+"""Failure injection for fault-tolerance tests.
+
+Analogue of main/execution/FailureInjector.java:40 (injected per
+(stage, partition, attempt); types incl. TASK_FAILURE and request
+failures — SURVEY.md §5.3, BaseFailureRecoveryTest.java:53). The
+injector lives on the Worker; TaskExecution consults it at task start
+("start") and after the first output page ("mid") so retries exercise
+both the nothing-produced and partially-produced paths.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+class InjectedFailure(RuntimeError):
+    pass
+
+
+@dataclasses.dataclass
+class FailureRule:
+    fragment_id: Optional[int] = None  # None = any
+    partition: Optional[int] = None
+    attempts: Tuple[int, ...] = (0,)  # which attempt numbers fail
+    where: str = "start"  # "start" | "mid"
+    max_hits: int = 1_000_000
+
+
+class FailureInjector:
+    def __init__(self):
+        self._rules: List[FailureRule] = []
+        self._hits: Dict[int, int] = {}
+        self._lock = threading.Lock()
+
+    def inject(self, **kw) -> FailureRule:
+        rule = FailureRule(**kw)
+        with self._lock:
+            self._rules.append(rule)
+        return rule
+
+    def clear(self) -> None:
+        with self._lock:
+            self._rules.clear()
+            self._hits.clear()
+
+    def check(self, task_id, where: str) -> None:
+        """Raise InjectedFailure if a rule matches (task_id carries
+        fragment/partition/attempt)."""
+        with self._lock:
+            for i, r in enumerate(self._rules):
+                if r.where != where:
+                    continue
+                if r.fragment_id is not None and r.fragment_id != task_id.fragment_id:
+                    continue
+                if r.partition is not None and r.partition != task_id.partition:
+                    continue
+                if getattr(task_id, "attempt", 0) not in r.attempts:
+                    continue
+                if self._hits.get(i, 0) >= r.max_hits:
+                    continue
+                self._hits[i] = self._hits.get(i, 0) + 1
+                raise InjectedFailure(
+                    f"injected {where} failure at {task_id}"
+                )

@@ -1,0 +1,170 @@
+"""Fault-tolerant (task-retry) query scheduling over spooled exchange.
+
+Analogue of EventDrivenFaultTolerantQueryScheduler.java:160 (SURVEY.md
+§3.5): stages execute bottom-up; every task's output is spooled through
+the external exchange (runtime/spool.py) so tasks are idempotent and
+individually re-runnable. On failure a partition is re-launched as
+attempt+1 — on a different active worker when one exists (the
+BinPackingNodeAllocator's re-placement, reduced to avoid-the-failed-
+node) — and consumers read exactly one committed attempt per partition
+(ExchangeSourceOutputSelector de-duplication). Workers joining between
+rounds are picked up because the active set is re-read per launch
+(FTE elasticity, §5.3).
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Dict, List, Optional, Tuple
+
+from trino_tpu.runtime.task import TaskId, TaskSpec
+from trino_tpu.sql.fragmenter import SubPlan
+
+
+class TaskRetriesExceeded(RuntimeError):
+    pass
+
+
+class FaultTolerantQueryScheduler:
+    def __init__(
+        self,
+        query_id: str,
+        subplan: SubPlan,
+        workers: List,  # worker handles (or a NodeManager via active_fn)
+        catalogs,
+        session,
+        spool_dir: str,
+        hash_partitions: Optional[int] = None,
+        max_task_retries: int = 3,
+        active_workers_fn=None,
+    ):
+        self.query_id = query_id
+        self.subplan = subplan
+        self.workers = workers
+        self.catalogs = catalogs
+        self.session = session
+        self.spool_dir = spool_dir
+        self.hash_partitions = hash_partitions or min(len(workers), 4)
+        self.max_task_retries = max_task_retries
+        self._active_fn = active_workers_fn or (lambda: self.workers)
+        self._schemas: Dict[int, list] = {}
+        # (fragment, partition) -> committed task key
+        self.committed: Dict[Tuple[int, int], str] = {}
+        self.retries = 0
+
+    # scheduling is stage-by-stage: children complete before parents run
+    def run(self) -> Tuple[object, str]:
+        """Execute every stage; returns (root worker handle, root task
+        key) for result fetching (root output is spooled too, so any
+        handle can serve it — we return the one that ran it)."""
+        order: List[SubPlan] = []
+        self._topo(self.subplan, order)
+        task_counts = {sp.fragment.id: self._task_count(sp) for sp in order}
+        consumer_counts: Dict[int, int] = {}
+        for sp in order:
+            for c in sp.children:
+                consumer_counts[c.fragment.id] = task_counts[sp.fragment.id]
+        root_handle = None
+        for sp in order:
+            root_handle = self._run_stage(
+                sp, task_counts[sp.fragment.id],
+                consumer_counts.get(sp.fragment.id, 1),
+            )
+        root_key = self.committed[(self.subplan.fragment.id, 0)]
+        return root_handle, root_key
+
+    def _topo(self, sp: SubPlan, out: List[SubPlan]) -> None:
+        for c in sp.children:
+            self._topo(c, out)
+        out.append(sp)
+
+    def _task_count(self, sp: SubPlan) -> int:
+        p = sp.fragment.partitioning
+        if p == "single":
+            return 1
+        if p == "source":
+            return max(1, len(self.workers))
+        return self.hash_partitions
+
+    def _fragment_schema(self, sp: SubPlan) -> list:
+        from trino_tpu.sql.local_planner import LocalPlanner
+
+        remote = {
+            c.fragment.id: self._schemas[c.fragment.id] for c in sp.children
+        }
+        planner = LocalPlanner(
+            self.catalogs,
+            batch_rows=self.session.batch_rows,
+            remote_schemas=remote,
+        )
+        return planner.plan(sp.fragment.root).schema
+
+    def _run_stage(self, sp: SubPlan, tc: int, n_out: int):
+        f = sp.fragment
+        self._schemas[f.id] = self._fragment_schema(sp)
+        remote = {
+            c.fragment.id: self._schemas[c.fragment.id] for c in sp.children
+        }
+        input_locations = {
+            c.fragment.id: [
+                ("spool", self.spool_dir, self.committed[(c.fragment.id, p)])
+                for p in range(
+                    len([
+                        k for k in self.committed if k[0] == c.fragment.id
+                    ])
+                )
+            ]
+            for c in sp.children
+        }
+        pending = {p: 0 for p in range(tc)}  # partition -> attempt
+        running: Dict[int, Tuple[object, str]] = {}
+        last_handle = None
+        avoid: Dict[int, object] = {}  # partition -> failed handle
+        while pending or running:
+            active = list(self._active_fn())
+            if not active:
+                raise TaskRetriesExceeded("no active workers")
+            # launch
+            for p in sorted(pending):
+                attempt = pending.pop(p)
+                candidates = [w for w in active if w is not avoid.get(p)] or active
+                handle = candidates[
+                    (p + attempt) % len(candidates)
+                ]
+                task_id = TaskId(self.query_id, f.id, p, attempt)
+                spec = TaskSpec(
+                    task_id=task_id,
+                    fragment=f,
+                    n_output_partitions=n_out,
+                    remote_schemas=remote,
+                    scan_slice=(p, tc) if f.partitioning == "source" else None,
+                    input_locations=input_locations,
+                    batch_rows=self.session.batch_rows,
+                    target_splits=max(self.session.target_splits, tc),
+                    spool_dir=self.spool_dir,
+                )
+                handle.create_task(spec)
+                running[p] = (handle, str(task_id), attempt)
+            # poll
+            time.sleep(0.01)
+            for p, (handle, tid, attempt) in list(running.items()):
+                try:
+                    st = handle.task_state(tid)
+                except Exception as e:
+                    st = {"state": "failed", "failure": f"worker unreachable: {e}"}
+                if st["state"] == "finished":
+                    del running[p]
+                    self.committed[(f.id, p)] = tid
+                    last_handle = handle
+                elif st["state"] == "failed":
+                    del running[p]
+                    if attempt + 1 > self.max_task_retries:
+                        raise TaskRetriesExceeded(
+                            f"task {tid} failed after {attempt + 1} attempts: "
+                            f"{st.get('failure')}"
+                        )
+                    self.retries += 1
+                    avoid[p] = handle
+                    pending[p] = attempt + 1
+        return last_handle

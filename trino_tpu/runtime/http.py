@@ -1,0 +1,250 @@
+"""HTTP task protocol: worker server + coordinator-side remote client.
+
+Analogue of the reference's internal communication (SURVEY.md §5.8):
+control plane = task create/status/delete (main/server/TaskResource.java:92,
+HttpRemoteTask §3.2), data plane = pull-based binary page streams with
+token/ack semantics (GET /v1/task/{id}/results/{partition}/{token},
+TaskResource.java:321). JSON for control, the serde wire format for
+pages. Task specs travel as pickled fragments (the stand-in for Trino's
+JSON plan codec — both sides are trusted engine processes).
+
+Endpoints served by WorkerServer:
+  POST   /v1/task/{taskId}                     create/update task
+  GET    /v1/task/{taskId}/status              task state JSON
+  GET    /v1/task/{taskId}/results/{p}/{tok}   pull pages (long-poll)
+  DELETE /v1/task/{taskId}                     abort + remove
+  GET    /v1/status                            worker heartbeat/info
+  PUT    /v1/shutdown                          graceful shutdown (drain)
+"""
+
+from __future__ import annotations
+
+import json
+import pickle
+import struct
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional, Tuple
+
+from trino_tpu.exec.serde import Page, deserialize_page, serialize_page
+from trino_tpu.runtime.worker import Worker
+
+_U32 = struct.Struct("<I")
+
+
+def pack_pages(pages: List[Page]) -> bytes:
+    out = [_U32.pack(len(pages))]
+    for p in pages:
+        body = serialize_page(p)
+        out.append(_U32.pack(len(body)))
+        out.append(body)
+    return b"".join(out)
+
+
+def unpack_pages(data: bytes) -> List[Page]:
+    (n,) = _U32.unpack_from(data, 0)
+    off = _U32.size
+    pages = []
+    for _ in range(n):
+        (ln,) = _U32.unpack_from(data, off)
+        off += _U32.size
+        pages.append(deserialize_page(data[off : off + ln]))
+        off += ln
+    return pages
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    worker: Worker = None  # set by server factory
+    server_ref = None
+
+    def log_message(self, *args):  # quiet
+        pass
+
+    def _json(self, code: int, obj) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _bytes(self, code: int, body: bytes, headers=()) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in headers:
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    # -- routes --
+    def do_GET(self):
+        parts = [p for p in self.path.split("?")[0].split("/") if p]
+        try:
+            if parts[:2] == ["v1", "status"]:
+                w = self.worker
+                self._json(
+                    200,
+                    {
+                        "worker_id": w.worker_id,
+                        "state": self.server_ref.state,
+                        "tasks": len(w.task_ids()),
+                    },
+                )
+                return
+            if parts[:2] == ["v1", "task"] and len(parts) >= 4:
+                task_id = parts[2]
+                if parts[3] == "status":
+                    self._json(200, self.worker.task_state(task_id))
+                    return
+                if parts[3] == "results" and len(parts) == 6:
+                    partition, token = int(parts[4]), int(parts[5])
+                    wait = 0.0
+                    if "?" in self.path and "wait=" in self.path:
+                        wait = float(self.path.split("wait=")[1].split("&")[0])
+                    pages, next_token, complete = self.worker.get_results(
+                        task_id, partition, token, wait=wait
+                    )
+                    self._bytes(
+                        200,
+                        pack_pages(pages),
+                        [
+                            ("X-Next-Token", str(next_token)),
+                            ("X-Complete", "1" if complete else "0"),
+                        ],
+                    )
+                    return
+            self._json(404, {"error": f"no route {self.path}"})
+        except KeyError:
+            self._json(404, {"error": f"unknown task {self.path}"})
+        except Exception as e:  # engine-internal; report upstream
+            self._json(500, {"error": repr(e)})
+
+    def do_POST(self):
+        parts = [p for p in self.path.split("/") if p]
+        try:
+            if parts[:2] == ["v1", "task"] and len(parts) == 3:
+                if self.server_ref.state != "active":
+                    self._json(503, {"error": "worker shutting down"})
+                    return
+                ln = int(self.headers.get("Content-Length", "0"))
+                spec = pickle.loads(self.rfile.read(ln))
+                task = self.worker.create_task(spec)
+                self._json(200, {"task_id": str(task.spec.task_id), "state": task.state})
+                return
+            self._json(404, {"error": f"no route {self.path}"})
+        except Exception as e:
+            self._json(500, {"error": repr(e)})
+
+    def do_DELETE(self):
+        parts = [p for p in self.path.split("/") if p]
+        try:
+            if parts[:2] == ["v1", "task"] and len(parts) == 3:
+                self.worker.remove_task(parts[2])
+                self._json(200, {})
+                return
+            self._json(404, {"error": f"no route {self.path}"})
+        except Exception as e:
+            self._json(500, {"error": repr(e)})
+
+    def do_PUT(self):
+        parts = [p for p in self.path.split("/") if p]
+        if parts[:2] == ["v1", "shutdown"]:
+            # graceful shutdown (GracefulShutdownHandler.java:43): stop
+            # accepting tasks; running tasks drain
+            self.server_ref.state = "shutting_down"
+            self._json(200, {"state": "shutting_down"})
+            return
+        self._json(404, {"error": f"no route {self.path}"})
+
+
+class WorkerServer:
+    """HTTP front of one Worker (TrinoServer worker bootstrap analogue)."""
+
+    def __init__(self, worker: Worker, port: int = 0):
+        self.worker = worker
+        self.state = "active"
+        handler = type("BoundHandler", (_Handler,), {"worker": worker, "server_ref": self})
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self.port = self._httpd.server_port
+        self.uri = f"http://127.0.0.1:{self.port}"
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class HttpWorkerClient:
+    """Coordinator-side proxy for a remote worker (HttpRemoteTask +
+    ContinuousTaskStatusFetcher collapsed into synchronous calls with
+    retry/backoff in RequestErrorTracker style)."""
+
+    def __init__(self, uri: str, timeout: float = 30.0):
+        self.uri = uri.rstrip("/")
+        self.timeout = timeout
+        self.worker_id = uri
+
+    def _req(self, method: str, path: str, body: Optional[bytes] = None):
+        req = urllib.request.Request(
+            self.uri + path, data=body, method=method
+        )
+        return urllib.request.urlopen(req, timeout=self.timeout)
+
+    def create_task(self, spec) -> str:
+        body = pickle.dumps(spec, protocol=5)
+        with self._req("POST", f"/v1/task/{spec.task_id}", body) as r:
+            out = json.loads(r.read())
+        if "error" in out:
+            raise RuntimeError(out["error"])
+        return out["task_id"]
+
+    def task_state(self, task_id) -> dict:
+        with self._req("GET", f"/v1/task/{task_id}/status") as r:
+            return json.loads(r.read())
+
+    def get_results(
+        self, task_id, partition: int, token: int,
+        max_pages: int = 16, wait: float = 0.0,
+    ) -> Tuple[List[Page], int, bool]:
+        path = f"/v1/task/{task_id}/results/{partition}/{token}?wait={wait}"
+        with self._req("GET", path) as r:
+            data = r.read()
+            next_token = int(r.headers["X-Next-Token"])
+            complete = r.headers["X-Complete"] == "1"
+        return unpack_pages(data), next_token, complete
+
+    def remove_task(self, task_id) -> None:
+        try:
+            self._req("DELETE", f"/v1/task/{task_id}").close()
+        except (urllib.error.URLError, OSError):
+            pass
+
+    def results_location(self, task_id):
+        """Picklable location descriptor for TaskSpec.input_locations
+        (resolved worker-side by task._resolve_fetch)."""
+        return ("http", self.uri, str(task_id))
+
+    def status(self) -> dict:
+        with self._req("GET", "/v1/status") as r:
+            return json.loads(r.read())
+
+    def shutdown_gracefully(self) -> None:
+        self._req("PUT", "/v1/shutdown").close()
+
+
+def http_fetch(uri: str, task_id: str):
+    """Location descriptor -> fetch callable for TaskSpec.input_locations
+    (the HttpPageBufferClient pull side)."""
+    client = HttpWorkerClient(uri)
+
+    def fetch(partition: int, token: int, max_pages: int, wait: float):
+        return client.get_results(task_id, partition, token, max_pages, wait)
+
+    return fetch

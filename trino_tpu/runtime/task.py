@@ -1,0 +1,191 @@
+"""Worker-side task runtime: plan a fragment, run its drivers, feed the
+output buffer.
+
+Analogue of main/execution/SqlTask / SqlTaskExecution.java:84 (drivers
+from DriverFactories per split/task lifecycle) + SqlTaskManager.updateTask
+(SqlTaskManager.java:466 — LocalExecutionPlanner.plan at task creation,
+:520). TPU-first delta: one thread per task runs its pipelines in
+dependency order (build sides before probes); blocking on exchange input
+and buffer backpressure happens inside operators, so Trino's 1-second
+cooperative quanta are unnecessary — device kernels are the quanta.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import traceback
+from typing import Callable, Dict, List, Optional, Tuple
+
+from trino_tpu.exec.driver import Driver, Pipeline
+from trino_tpu.exec.exchange_ops import PartitionedOutputOperator
+from trino_tpu.runtime.buffers import OutputBuffer
+from trino_tpu.runtime.exchange import DirectExchangeClient, ExchangeLocation
+from trino_tpu.sql.fragmenter import PlanFragment
+from trino_tpu.sql.local_planner import LocalPlanner, Schema
+
+
+@dataclasses.dataclass(frozen=True)
+class TaskId:
+    query_id: str
+    fragment_id: int
+    partition: int
+    attempt: int = 0  # FTE retries re-run a partition as attempt+1
+
+    def __str__(self) -> str:
+        base = f"{self.query_id}.{self.fragment_id}.{self.partition}"
+        return f"{base}.a{self.attempt}" if self.attempt else base
+
+
+@dataclasses.dataclass
+class TaskSpec:
+    """Everything a worker needs to run one task (TaskUpdateRequest
+    analogue: fragment + splits + output buffer layout + input
+    locations). `input_locations` maps producer fragment id -> list of
+    fetch callables (one per producer task)."""
+
+    task_id: TaskId
+    fragment: PlanFragment
+    n_output_partitions: int
+    remote_schemas: Dict[int, Schema]
+    scan_slice: Optional[Tuple[int, int]]  # (task_index, task_count)
+    input_locations: Dict[int, List[Callable]]  # fid -> [fetch]
+    batch_rows: int = 1 << 20
+    target_splits: int = 1
+    # FTE: spool output to this directory instead of a live buffer
+    # (SpoolingExchangeOutputBuffer path, SURVEY.md §3.5)
+    spool_dir: Optional[str] = None
+
+
+def _resolve_fetch(location):
+    """An input location is either a direct fetch callable (in-process
+    topology) or a descriptor — ("http", uri, task_id) for live pull
+    between processes, ("spool", base_dir, task_key) for a committed
+    FTE attempt — the wire forms a pickled TaskSpec carries."""
+    if callable(location):
+        return location
+    kind, a, b = location
+    if kind == "http":
+        from trino_tpu.runtime.http import http_fetch
+
+        return http_fetch(a, b)
+    assert kind == "spool", kind
+    from trino_tpu.runtime.spool import spool_fetch
+
+    return spool_fetch(a, b)
+
+
+class _MidFailureBuffer:
+    """Buffer proxy that lets the FailureInjector kill a task AFTER it
+    produced output (the partially-spooled retry path of
+    BaseFailureRecoveryTest)."""
+
+    def __init__(self, inner, injector, task_id):
+        self._inner = inner
+        self._injector = injector
+        self._task_id = task_id
+        self._produced = False
+
+    def enqueue(self, partition, page):
+        self._inner.enqueue(partition, page)
+        if not self._produced:
+            self._produced = True
+            self._injector.check(self._task_id, "mid")
+
+    def set_no_more_pages(self):
+        self._inner.set_no_more_pages()
+
+
+class TaskExecution:
+    """One running task: plans the fragment, runs drivers on a thread,
+    exposes its OutputBuffer for consumers (TaskStateMachine states
+    collapsed to PLANNED/RUNNING/FINISHED/FAILED)."""
+
+    def __init__(self, spec: TaskSpec, catalogs, failure_injector=None):
+        self.spec = spec
+        if spec.spool_dir is not None:
+            from trino_tpu.runtime.spool import SpoolingExchangeSink
+
+            self.buffer = SpoolingExchangeSink(
+                spec.spool_dir, str(spec.task_id), spec.n_output_partitions
+            )
+        else:
+            self.buffer = OutputBuffer(spec.n_output_partitions)
+        self.state = "planned"
+        self.failure: Optional[str] = None
+        self._clients: List[DirectExchangeClient] = []
+        self._catalogs = catalogs
+        self._injector = failure_injector
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle --
+    def start(self) -> None:
+        self.state = "running"
+        self._thread = threading.Thread(
+            target=self._run, name=str(self.spec.task_id), daemon=True
+        )
+        self._thread.start()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def abort(self) -> None:
+        self.buffer.abort()
+        for c in self._clients:
+            c.close()
+
+    # -- execution --
+    def _make_remote_source(self, fragment_ids) -> DirectExchangeClient:
+        locations = []
+        my_partition = self.spec.task_id.partition
+        for fid in fragment_ids:
+            for loc in self.spec.input_locations.get(fid, []):
+                locations.append(
+                    ExchangeLocation(_resolve_fetch(loc), my_partition)
+                )
+        client = DirectExchangeClient(locations)
+        self._clients.append(client)
+        return client
+
+    def _run(self) -> None:
+        spec = self.spec
+        try:
+            if self._injector is not None:
+                self._injector.check(spec.task_id, "start")
+            planner = LocalPlanner(
+                self._catalogs,
+                batch_rows=spec.batch_rows,
+                target_splits=spec.target_splits,
+                remote_schemas=spec.remote_schemas,
+                scan_slice=spec.scan_slice,
+            )
+            physical = planner.plan(spec.fragment.root)
+            ctx = {"make_remote_source": self._make_remote_source}
+            pipelines, chain = physical.instantiate(ctx)
+            sink_buffer = self.buffer
+            if self._injector is not None:
+                sink_buffer = _MidFailureBuffer(
+                    self.buffer, self._injector, spec.task_id
+                )
+            chain.append(
+                PartitionedOutputOperator(
+                    sink_buffer,
+                    spec.fragment.output_kind,
+                    spec.fragment.output_channels,
+                    spec.n_output_partitions,
+                )
+            )
+            for p in pipelines:
+                Driver(p).run()
+            Driver(Pipeline(chain)).run()
+            self.state = "finished"
+        except BaseException as e:
+            self.failure = "".join(
+                traceback.format_exception_only(type(e), e)
+            ).strip()
+            self.state = "failed"
+            self.buffer.abort()
+        finally:
+            for c in self._clients:
+                c.close()

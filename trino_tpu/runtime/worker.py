@@ -1,0 +1,90 @@
+"""Worker: the per-host task manager.
+
+Analogue of main/execution/SqlTaskManager.java:109 (updateTask:466 —
+idempotent task creation, local planning, driver execution) plus the
+results side of TaskResource. The same object serves the in-process
+topology (coordinator holds a direct reference — the tier-3
+DistributedQueryRunner arrangement) and the HTTP server (worker_http
+wraps these methods behind /v1/task endpoints).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from trino_tpu.connectors.spi import CatalogManager
+from trino_tpu.runtime.task import TaskExecution, TaskId, TaskSpec
+
+
+class Worker:
+    def __init__(
+        self,
+        worker_id: str,
+        catalogs: Optional[CatalogManager] = None,
+        failure_injector=None,
+    ):
+        self.worker_id = worker_id
+        self.catalogs = catalogs or CatalogManager()
+        self.failure_injector = failure_injector
+        self._tasks: Dict[str, TaskExecution] = {}
+        self._lock = threading.Lock()
+
+    # -- task lifecycle (SqlTaskManager.updateTask) --
+    def create_task(self, spec: TaskSpec) -> TaskExecution:
+        key = str(spec.task_id)
+        with self._lock:
+            existing = self._tasks.get(key)
+            if existing is not None:
+                return existing  # idempotent re-delivery
+            task = TaskExecution(spec, self.catalogs, self.failure_injector)
+            self._tasks[key] = task
+        task.start()
+        return task
+
+    def get_task(self, task_id) -> TaskExecution:
+        return self._tasks[str(task_id)]
+
+    def task_state(self, task_id) -> dict:
+        t = self._tasks[str(task_id)]
+        return {"state": t.state, "failure": t.failure}
+
+    def get_results(
+        self, task_id, partition: int, token: int,
+        max_pages: int = 16, wait: float = 0.0,
+    ):
+        return self._tasks[str(task_id)].buffer.get_pages(
+            partition, token, max_pages, wait
+        )
+
+    def remove_task(self, task_id) -> None:
+        with self._lock:
+            t = self._tasks.pop(str(task_id), None)
+        if t is not None:
+            t.abort()
+
+    def abort_query(self, query_id: str) -> None:
+        with self._lock:
+            doomed = [
+                k for k in self._tasks if k.startswith(query_id + ".")
+            ]
+            tasks = [self._tasks.pop(k) for k in doomed]
+        for t in tasks:
+            t.abort()
+
+    def task_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._tasks)
+
+    # -- handle API shared with HttpWorkerClient --
+    def results_location(self, task_id):
+        """Fetch handle consumers put into TaskSpec.input_locations:
+        in-process = the buffer's bound method (zero-copy)."""
+        return self._tasks[str(task_id)].buffer.get_pages
+
+    def status(self) -> dict:
+        return {
+            "worker_id": self.worker_id,
+            "state": "active",
+            "tasks": len(self.task_ids()),
+        }

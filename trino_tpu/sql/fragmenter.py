@@ -1,0 +1,463 @@
+"""Distributed planning: AddExchanges + PlanFragmenter.
+
+Analogue of main/sql/planner/optimizations/AddExchanges.java:140 (insert
+REMOTE partitioned/broadcast/gathering exchanges by partitioning
+properties, :266–276) and main/sql/planner/PlanFragmenter.java (cut the
+plan at remote exchanges into a SubPlan tree of PlanFragments with
+SystemPartitioningHandle-style handles — SURVEY.md §2.2, §2.7).
+
+Two passes:
+1. `add_exchanges(root)` — a properties-driven visitor that tracks each
+   subtree's distribution (`single` / `source` / `hash(channels)` /
+   `any`) and inserts ExchangeNodes where an operator needs a different
+   one: partial->FINAL aggregation around a hash repartition, partitioned
+   or broadcast joins, local-sort + merging gather, partial limits.
+2. `fragment(root)` — cuts at every ExchangeNode, producing PlanFragments
+   whose leaves are ScanNodes or RemoteSourceNodes.
+
+TPU mapping: each "hash" fragment's tasks later become mesh shards; the
+exchange rides ICI all_to_all when producer and consumer tasks share a
+slice, and the host page wire across hosts (parallel/exchange.py holds
+the collective form of the same repartition).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from trino_tpu import types as T
+from trino_tpu.exec.operators import agg_state_meta
+from trino_tpu.sql import plan as P
+
+# -- distribution properties ------------------------------------------------
+
+SINGLE = ("single",)
+SOURCE = ("source",)
+ANY = ("any",)  # distributed, partitioning unknown (post-project remap loss)
+
+
+def hash_dist(channels: Tuple[int, ...]):
+    return ("hash", tuple(channels))
+
+
+def is_distributed(dist) -> bool:
+    return dist != SINGLE
+
+
+# -- fragments ---------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanFragment:
+    """One schedulable stage (PlanFragment analogue). `partitioning` is
+    how this fragment's tasks are laid out: "single" | "hash" | "source";
+    `output_kind` + `output_channels` describe the PartitionedOutput at
+    its root ("single" | "hash" | "broadcast" | "arbitrary")."""
+
+    id: int
+    root: P.PlanNode
+    partitioning: str
+    output_kind: str
+    output_channels: Tuple[int, ...] = ()
+    output_merge_keys: Tuple = ()
+
+
+@dataclasses.dataclass
+class SubPlan:
+    fragment: PlanFragment
+    children: List["SubPlan"]
+
+    def all_fragments(self) -> List[PlanFragment]:
+        out = [self.fragment]
+        for c in self.children:
+            out.extend(c.all_fragments())
+        return out
+
+
+# -- pass 1: AddExchanges ----------------------------------------------------
+
+
+class _AddExchanges:
+    def __init__(self, estimate_rows, broadcast_threshold: int):
+        self._estimate = estimate_rows
+        self._broadcast_threshold = broadcast_threshold
+
+    def visit(self, node: P.PlanNode):
+        m = getattr(self, f"_{type(node).__name__}", None)
+        if m is None:
+            raise NotImplementedError(f"AddExchanges: {type(node).__name__}")
+        return m(node)
+
+    # leaves
+    def _ScanNode(self, node):
+        return node, SOURCE
+
+    def _ValuesNode(self, node):
+        return node, SINGLE
+
+    # pass-through (channels unchanged)
+    def _FilterNode(self, node):
+        child, dist = self.visit(node.child)
+        return dataclasses.replace(node, child=child), dist
+
+    def _LimitNode(self, node):
+        child, dist = self.visit(node.child)
+        if not is_distributed(dist):
+            return dataclasses.replace(node, child=child), dist
+        # partial limit per task, gather, final limit (LimitNode partial)
+        pre = None
+        if node.count is not None:
+            pre = P.LimitNode(child, node.count + node.offset, 0, node.fields)
+        gathered = _gather(pre if pre is not None else child)
+        return (
+            P.LimitNode(gathered, node.count, node.offset, node.fields),
+            SINGLE,
+        )
+
+    def _ProjectNode(self, node):
+        child, dist = self.visit(node.child)
+        out = dataclasses.replace(node, child=child)
+        if dist[0] != "hash":
+            return out, dist
+        # remap hash channels through identity projections; a lost key
+        # degrades the property to "any" (still distributed)
+        mapping: Dict[int, int] = {}
+        from trino_tpu.expr.ir import InputRef
+
+        for i, e in enumerate(node.exprs):
+            if isinstance(e, InputRef) and e.index not in mapping:
+                mapping[e.index] = i
+        new_channels = []
+        for c in dist[1]:
+            if c not in mapping:
+                return out, ANY
+            new_channels.append(mapping[c])
+        return out, hash_dist(tuple(new_channels))
+
+    def _SortNode(self, node):
+        child, dist = self.visit(node.child)
+        if not is_distributed(dist):
+            return dataclasses.replace(node, child=child), dist
+        # local sort per task + merging gather (distributed sort,
+        # MergeOperator.java:46 / dist-sort.rst)
+        local = P.SortNode(child, node.keys, node.fields)
+        ex = P.ExchangeNode(
+            local, "gather", (), node.fields, merge_keys=tuple(node.keys)
+        )
+        return ex, SINGLE
+
+    def _TopNNode(self, node):
+        child, dist = self.visit(node.child)
+        if not is_distributed(dist):
+            return dataclasses.replace(node, child=child), dist
+        partial = P.TopNNode(child, node.keys, node.count, node.fields)
+        gathered = _gather(partial)
+        return P.TopNNode(gathered, node.keys, node.count, node.fields), SINGLE
+
+    def _UnionAllNode(self, node):
+        new_inputs = []
+        for child in node.inputs:
+            c, dist = self.visit(child)
+            if is_distributed(dist):
+                c = _gather(c)
+            new_inputs.append(c)
+        return dataclasses.replace(node, inputs=tuple(new_inputs)), SINGLE
+
+    def _OutputNode(self, node):
+        child, dist = self.visit(node.child)
+        if is_distributed(dist):
+            child = _gather(child)
+        return dataclasses.replace(node, child=child), SINGLE
+
+    # aggregation: partial -> hash exchange -> final
+    def _AggregateNode(self, node):
+        child, dist = self.visit(node.child)
+        if not is_distributed(dist) or any(a.distinct for a in node.aggs):
+            # distinct aggregation runs single-step after a gather (the
+            # MarkDistinct distributed form is future work)
+            if is_distributed(dist):
+                child = _gather(child)
+            return dataclasses.replace(node, child=child), SINGLE
+        groups = tuple(node.group_channels)
+        if groups and dist == hash_dist(groups):
+            # child already partitioned on the exact grouping keys
+            out = dataclasses.replace(node, child=child)
+            return out, hash_dist(tuple(range(len(groups))))
+        k = len(groups)
+        partial_fields = _partial_fields(node, child)
+        partial = dataclasses.replace(
+            node, child=child, step="partial", fields=tuple(partial_fields)
+        )
+        final_aggs = tuple(
+            dataclasses.replace(a, arg_channel=k + 2 * i)
+            for i, a in enumerate(node.aggs)
+        )
+        if not groups:
+            gathered = _gather(partial)
+            final = P.AggregateNode(
+                gathered, (), final_aggs, node.fields, step="final"
+            )
+            return final, SINGLE
+        ex = P.ExchangeNode(
+            partial, "repartition", tuple(range(k)), tuple(partial_fields)
+        )
+        final = P.AggregateNode(
+            ex, tuple(range(k)), final_aggs, node.fields, step="final"
+        )
+        return final, hash_dist(tuple(range(k)))
+
+    # joins: partitioned or broadcast
+    def _JoinNode(self, node):
+        left, ldist = self.visit(node.left)
+        right, rdist = self.visit(node.right)
+        if not is_distributed(ldist) and not is_distributed(rdist):
+            return dataclasses.replace(node, left=left, right=right), SINGLE
+
+        build_rows = self._estimate(node.right)
+        broadcast = (
+            node.kind == "cross"
+            or not node.right_keys
+            or build_rows <= self._broadcast_threshold
+        )
+        if broadcast:
+            # Replicate the build side whenever EITHER side is
+            # distributed. A single-distribution build must still cross a
+            # fragment boundary when the probe is multi-task: its internal
+            # gather exchanges deliver to one consumer partition only, so
+            # leaving it inline would starve every probe task but one.
+            if is_distributed(rdist) or is_distributed(ldist):
+                right = P.ExchangeNode(
+                    right, "broadcast", (), _fields_of(node.right)
+                )
+            out_dist = ldist if is_distributed(ldist) else SINGLE
+            return (
+                dataclasses.replace(node, left=left, right=right),
+                out_dist,
+            )
+        # partitioned join: both sides hash-distributed on the join keys
+        lkeys, rkeys = tuple(node.left_keys), tuple(node.right_keys)
+        if ldist != hash_dist(lkeys):
+            left = P.ExchangeNode(left, "repartition", lkeys, _fields_of(node.left))
+        if rdist != hash_dist(rkeys):
+            right = P.ExchangeNode(right, "repartition", rkeys, _fields_of(node.right))
+        out = dataclasses.replace(node, left=left, right=right)
+        # semi/anti keep only left columns; inner/left keep left prefix —
+        # either way the left keys' positions survive unchanged
+        return out, hash_dist(lkeys)
+
+
+def _fields_of(node: P.PlanNode) -> Tuple[P.Field, ...]:
+    return tuple(node.fields)
+
+
+def _gather(node: P.PlanNode) -> P.ExchangeNode:
+    return P.ExchangeNode(node, "gather", (), tuple(node.fields))
+
+
+def _partial_fields(node: P.AggregateNode, child: P.PlanNode) -> List[P.Field]:
+    """Fields of the partial step's output (partial_output_schema shape)."""
+    child_types = [(f.type, None) for f in child.fields]
+    fields = [child.fields[c] for c in node.group_channels]
+    for a in node.aggs:
+        spec = _spec_of(a)
+        (vt, _), _ = agg_state_meta(spec, child_types)
+        name = a.kind if a.arg_channel is None else f"{a.kind}_{a.arg_channel}"
+        fields.append(P.Field(f"{name}_val", vt))
+        fields.append(P.Field(f"{name}_cnt", T.BIGINT))
+    return fields
+
+
+def _spec_of(a: P.AggCall):
+    from trino_tpu.exec.operators import AggSpec
+
+    return AggSpec(a.kind, a.arg_channel, a.out_type, a.distinct)
+
+
+# -- row estimation (pre-CBO heuristic) --------------------------------------
+
+
+def make_row_estimator(catalogs):
+    """Crude bottom-up cardinality estimate used for the broadcast-vs-
+    partitioned decision until the CBO lands (DeterminePartitionCount /
+    CostCalculatorUsingExchanges analogue)."""
+
+    def estimate(node: P.PlanNode) -> float:
+        if isinstance(node, P.ScanNode):
+            try:
+                stats = catalogs.get(node.catalog).metadata.get_table_statistics(
+                    node.handle
+                )
+                if stats and stats.row_count is not None:
+                    return float(stats.row_count)
+            except Exception:
+                pass
+            return 1e9
+        if isinstance(node, P.FilterNode):
+            return estimate(node.child) * 0.33
+        if isinstance(node, P.AggregateNode):
+            return max(estimate(node.child) * 0.1, 1.0)
+        if isinstance(node, P.JoinNode):
+            if node.kind in ("semi", "anti"):
+                return estimate(node.left)
+            return max(estimate(node.left), estimate(node.right))
+        if isinstance(node, (P.TopNNode,)):
+            return float(node.count)
+        if isinstance(node, P.LimitNode):
+            return float(node.count or 1e9)
+        if isinstance(node, P.ValuesNode):
+            return float(len(node.rows))
+        kids = node.children()
+        if not kids:
+            return 1e6
+        return max(estimate(c) for c in kids)
+
+    return estimate
+
+
+# -- pass 2: fragment cutting ------------------------------------------------
+
+
+class _Fragmenter:
+    def __init__(self):
+        self.fragments: Dict[int, PlanFragment] = {}
+        self.children: Dict[int, List[int]] = {}
+        self._next_id = 0
+
+    def cut(self, root: P.PlanNode) -> SubPlan:
+        """Cut the exchange-annotated plan; the root fragment is always
+        single-partitioned (the coordinator-consumed stage)."""
+        new_root, child_ids = self._rewrite(root)
+        fid = self._new_fragment(new_root, "single", (), ())
+        self.children[fid] = child_ids
+        return self._subplan(fid)
+
+    def _subplan(self, fid: int) -> SubPlan:
+        return SubPlan(
+            self.fragments[fid],
+            [self._subplan(c) for c in self.children.get(fid, [])],
+        )
+
+    def _new_fragment(self, root, output_kind, output_channels, merge_keys) -> int:
+        fid = self._next_id
+        self._next_id += 1
+        self.fragments[fid] = PlanFragment(
+            id=fid,
+            root=root,
+            partitioning=_fragment_partitioning(root),
+            output_kind=output_kind,
+            output_channels=tuple(output_channels),
+            output_merge_keys=tuple(merge_keys),
+        )
+        return fid
+
+    def _rewrite(self, node: P.PlanNode) -> Tuple[P.PlanNode, List[int]]:
+        """Replace each ExchangeNode subtree with a RemoteSourceNode and
+        register the producer fragment. Returns (node', child fragment
+        ids referenced anywhere below node)."""
+        if isinstance(node, P.ExchangeNode):
+            child, grandchildren = self._rewrite(node.child)
+            if node.kind == "gather":
+                out_kind, out_channels = "single", ()
+            elif node.kind == "repartition":
+                out_kind, out_channels = "hash", node.hash_channels
+            else:
+                out_kind, out_channels = "broadcast", ()
+            fid = self._new_fragment(child, out_kind, out_channels, node.merge_keys)
+            self.children[fid] = grandchildren
+            rs = P.RemoteSourceNode(
+                (fid,), tuple(node.fields), tuple(node.merge_keys)
+            )
+            return rs, [fid]
+        kids = list(node.children())
+        if not kids:
+            return node, []
+        new_kids, ids = [], []
+        for c in kids:
+            nc, cids = self._rewrite(c)
+            new_kids.append(nc)
+            ids.extend(cids)
+        return _replace_children(node, new_kids), ids
+
+
+def _replace_children(node: P.PlanNode, kids: List[P.PlanNode]) -> P.PlanNode:
+    if isinstance(node, P.JoinNode):
+        return dataclasses.replace(node, left=kids[0], right=kids[1])
+    if isinstance(node, P.UnionAllNode):
+        return dataclasses.replace(node, inputs=tuple(kids))
+    return dataclasses.replace(node, child=kids[0])
+
+
+def _fragment_partitioning(root: P.PlanNode) -> str:
+    """Task layout of a fragment, derived from its leaves: connector
+    splits ("source"), hash-partitioned remote input ("hash"), else a
+    single task. Broadcast-only remote inputs pair with whatever the
+    other leaves say (a broadcast build feeding a source-distributed
+    probe keeps "source")."""
+    def any_node(n, pred) -> bool:
+        return pred(n) or any(any_node(c, pred) for c in n.children())
+
+    if any_node(root, lambda n: isinstance(n, P.ScanNode)):
+        return "source"
+    # consumer of a hash repartition is hash-partitioned; a gather/
+    # broadcast-only consumer runs single — plan_distributed refines
+    # this once producers are known (consumes_hash_input).
+    if any_node(root, lambda n: isinstance(n, P.RemoteSourceNode)):
+        return "hash"
+    return "single"
+
+
+def consumes_hash_input(fragment: PlanFragment, producers: Dict[int, PlanFragment]) -> bool:
+    """True when any remote source feeding this fragment is
+    hash-partitioned output (fixed task count > 1 is meaningful)."""
+    found = [False]
+
+    def walk(n):
+        if isinstance(n, P.RemoteSourceNode):
+            for fid in n.fragment_ids:
+                if producers[fid].output_kind == "hash":
+                    found[0] = True
+        for c in n.children():
+            walk(c)
+
+    walk(fragment.root)
+    return found[0]
+
+
+# -- public entry ------------------------------------------------------------
+
+
+def plan_distributed(
+    root: P.OutputNode,
+    catalogs,
+    broadcast_threshold: int = 1_000_000,
+) -> SubPlan:
+    """Logical plan -> SubPlan tree of PlanFragments (the
+    LogicalPlanner->AddExchanges->PlanFragmenter.createSubPlans path)."""
+    adder = _AddExchanges(make_row_estimator(catalogs), broadcast_threshold)
+    annotated, _ = adder.visit(root)
+    subplan = _Fragmenter().cut(annotated)
+    # refine "hash" vs "single" partitioning now that producers are known
+    frags = {f.id: f for f in subplan.all_fragments()}
+
+    def refine(sp: SubPlan):
+        f = sp.fragment
+        if f.partitioning == "hash" and not consumes_hash_input(f, frags):
+            sp.fragment = dataclasses.replace(f, partitioning="single")
+        for c in sp.children:
+            refine(c)
+
+    refine(subplan)
+    return subplan
+
+
+def explain_distributed(subplan: SubPlan) -> str:
+    """EXPLAIN (TYPE DISTRIBUTED) rendering: one section per fragment."""
+    lines = []
+    for f in sorted(subplan.all_fragments(), key=lambda f: f.id):
+        out = f.output_kind
+        if f.output_channels:
+            out += f" on={list(f.output_channels)}"
+        lines.append(f"Fragment {f.id} [{f.partitioning}] output={out}")
+        lines.append(P.explain_text(f.root, indent=1))
+    return "\n".join(lines)

@@ -64,8 +64,12 @@ class PhysicalPlan:
         self.chain_factories = chain
         self.schema = schema
 
-    def instantiate(self) -> Tuple[List[Pipeline], List[Operator]]:
-        ctx: dict = {}
+    def instantiate(
+        self, ctx: Optional[dict] = None
+    ) -> Tuple[List[Pipeline], List[Operator]]:
+        """`ctx` seeds the per-execution context; the task runtime
+        injects "make_remote_source" for RemoteSourceNode leaves."""
+        ctx = {} if ctx is None else ctx
         pipelines = [
             Pipeline([f(ctx) for f in fs]) for fs in self.pipeline_factories
         ]
@@ -79,10 +83,19 @@ class LocalPlanner:
         catalogs: CatalogManager,
         batch_rows: int = 1 << 20,
         target_splits: int = 1,
+        remote_schemas: Optional[Dict[int, "Schema"]] = None,
+        scan_slice: Optional[Tuple[int, int]] = None,
     ):
+        """`remote_schemas` maps producer fragment id -> output Schema
+        (with dictionaries) for RemoteSourceNode leaves; `scan_slice`
+        (task_index, task_count) restricts scans to this task's share of
+        the connector splits (the SourcePartitionedScheduler assignment,
+        collapsed to deterministic round-robin)."""
         self.catalogs = catalogs
         self.batch_rows = batch_rows
         self.target_splits = target_splits
+        self.remote_schemas = remote_schemas or {}
+        self.scan_slice = scan_slice
         self.pipelines: List[List[Factory]] = []
         self._next_key = 0
 
@@ -117,6 +130,9 @@ class LocalPlanner:
     def _visit_ScanNode(self, node: P.ScanNode):
         conn = self.catalogs.get(node.catalog)
         splits = conn.split_manager.get_splits(node.handle, self.target_splits)
+        if self.scan_slice is not None:
+            idx, count = self.scan_slice
+            splits = splits[idx::count]
         columns = list(node.columns)
         page_source = conn.page_source
         batch_rows = self.batch_rows
@@ -138,6 +154,25 @@ class LocalPlanner:
         batch = RelBatch.from_pydict(schema_t, data)
         schema: Schema = [(c.type, c.dictionary) for c in batch.columns]
         return [lambda ctx: ValuesOperator([batch])], schema
+
+    def _visit_RemoteSourceNode(self, node: P.RemoteSourceNode):
+        """Exchange client as a source operator (ExchangeOperator.java:44;
+        with merge_keys, MergeOperator.java:46). The execution context
+        provides "make_remote_source": (fragment_ids) -> page source."""
+        from trino_tpu.exec.exchange_ops import RemoteSourceOperator
+
+        schemas = [self.remote_schemas[fid] for fid in node.fragment_ids]
+        assert schemas and all(
+            [t for t, _ in s] == [t for t, _ in schemas[0]] for s in schemas
+        ), "remote source fragments must share one schema"
+        schema: Schema = schemas[0]
+        fragment_ids = tuple(node.fragment_ids)
+        merge_keys = list(node.merge_keys) if node.merge_keys else None
+        return [
+            lambda ctx: RemoteSourceOperator(
+                ctx["make_remote_source"](fragment_ids), merge_keys
+            )
+        ], schema
 
     def _visit_FilterNode(self, node: P.FilterNode):
         chain, schema = self._visit(node.child)
@@ -166,12 +201,24 @@ class LocalPlanner:
             return self._distinct_agg(node, chain, schema)
         specs = [AggSpec(a.kind, a.arg_channel, a.out_type) for a in node.aggs]
         groups = list(node.group_channels)
+        step = node.step
         chain.append(
-            lambda ctx: HashAggregationOperator(groups, specs, schema)
+            lambda ctx: HashAggregationOperator(groups, specs, schema, step=step)
         )
+        if step == "partial":
+            from trino_tpu.exec.operators import partial_output_schema
+
+            return chain, partial_output_schema(specs, groups, schema)
         out_schema: Schema = [schema[c] for c in node.group_channels] + [
             (a.out_type, None) for a in node.aggs
         ]
+        if step == "final":
+            # keys and min/max/any results keep the dictionaries that
+            # rode through the state wire format
+            out_schema = [schema[c] for c in range(len(groups))] + [
+                (a.out_type, schema[len(groups) + 2 * i][1])
+                for i, a in enumerate(node.aggs)
+            ]
         return chain, out_schema
 
     def _distinct_agg(self, node: P.AggregateNode, chain, schema: Schema):

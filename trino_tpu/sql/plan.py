@@ -86,12 +86,16 @@ class AggCall:
 @dataclasses.dataclass(frozen=True)
 class AggregateNode(PlanNode):
     """Output schema = [group key channels..., agg results...]
-    (AggregationNode analogue)."""
+    (AggregationNode analogue). `step` is the AggregationNode.Step:
+    single | partial (emits serialized accumulator state) | final
+    (consumes state from the exchange). In partial/final steps the
+    output/input layout follows operators.partial_output_schema."""
 
     child: PlanNode
     group_channels: Tuple[int, ...]
     aggs: Tuple[AggCall, ...]
     fields: Tuple[Field, ...]
+    step: str = "single"
 
     def children(self):
         return (self.child,)
@@ -173,6 +177,35 @@ class OutputNode(PlanNode):
         return (self.child,)
 
 
+@dataclasses.dataclass(frozen=True)
+class ExchangeNode(PlanNode):
+    """Remote exchange in the distributed plan (ExchangeNode REMOTE scope
+    + the SystemPartitioningHandle family, SURVEY.md §2.2/§2.7).
+    kind: "gather" (to one task; with merge_keys = merging gather),
+    "repartition" (FIXED_HASH on hash_channels), "broadcast"
+    (FIXED_BROADCAST replication). Inserted by the AddExchanges pass;
+    the fragmenter cuts the plan here."""
+
+    child: PlanNode
+    kind: str
+    hash_channels: Tuple[int, ...]
+    fields: Tuple[Field, ...]
+    merge_keys: Tuple = ()
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
+class RemoteSourceNode(PlanNode):
+    """Leaf of a fragment: pages arriving from producer fragments
+    (RemoteSourceNode analogue)."""
+
+    fragment_ids: Tuple[int, ...]
+    fields: Tuple[Field, ...]
+    merge_keys: Tuple = ()
+
+
 def explain_text(node: PlanNode, indent: int = 0) -> str:
     """EXPLAIN rendering (textual plan like Trino's PlanPrinter)."""
     pad = "  " * indent
@@ -187,6 +220,16 @@ def explain_text(node: PlanNode, indent: int = 0) -> str:
         detail = f" {[repr(e) for e in node.exprs]}"
     elif isinstance(node, AggregateNode):
         detail = f" keys={list(node.group_channels)} aggs={[a.kind for a in node.aggs]}"
+        if node.step != "single":
+            detail += f" step={node.step}"
+    elif isinstance(node, ExchangeNode):
+        detail = f" {node.kind}"
+        if node.hash_channels:
+            detail += f" on={list(node.hash_channels)}"
+        if node.merge_keys:
+            detail += " merge"
+    elif isinstance(node, RemoteSourceNode):
+        detail = f" fragments={list(node.fragment_ids)}"
     elif isinstance(node, JoinNode):
         detail = (
             f" {node.kind} L{list(node.left_keys)}=R{list(node.right_keys)}"
