@@ -1,0 +1,56 @@
+"""Scalar function library through the SQL surface (the
+main/operator/scalar/ coverage tier, SURVEY.md §2.10)."""
+
+import pytest
+
+from trino_tpu.connectors.tpch import create_tpch_connector
+from trino_tpu.engine import LocalQueryRunner, Session
+
+
+@pytest.fixture(scope="module")
+def runner():
+    r = LocalQueryRunner(Session(catalog="tpch", schema="tiny"))
+    r.register_catalog("tpch", create_tpch_connector())
+    return r
+
+
+CASES = [
+    ("SELECT 'a' || 'b' || 'c'", "abc"),
+    ("SELECT concat(n_name, '_x') FROM nation WHERE n_nationkey = 0", "ALGERIA_x"),
+    (
+        "SELECT n_name || '-' || r_name FROM nation, region"
+        " WHERE n_regionkey = r_regionkey AND n_nationkey = 0",
+        "ALGERIA-AFRICA",
+    ),
+    ("SELECT trim('  hi  ')", "hi"),
+    ("SELECT ltrim('  hi  ')", "hi  "),
+    ("SELECT rtrim('  hi  ')", "  hi"),
+    ("SELECT replace('banana', 'na', 'NA')", "baNANA"),
+    ("SELECT reverse('abc')", "cba"),
+    ("SELECT nullif(1, 1)", None),
+    ("SELECT nullif(2, 1)", 2),
+    ("SELECT greatest(1, 5, 3)", 5),
+    ("SELECT least(1.5, 0.5)", 0.5),
+    ("SELECT power(2, 10)", 1024.0),
+    ("SELECT sign(-5)", -1),
+    ("SELECT sign(2.5)", 1.0),
+    ("SELECT mod(10, 3)", 1),
+    ("SELECT year(date '1995-03-15')", 1995),
+    ("SELECT month(date '1995-03-15')", 3),
+    ("SELECT day(date '1995-03-15')", 15),
+    ("SELECT if(1 > 2, 'yes', 'no')", "no"),
+    ("SELECT if(1 < 2, 'yes', 'no')", "yes"),
+    ("SELECT starts_with(n_name, 'AL') FROM nation WHERE n_nationkey = 0", True),
+    ("SELECT log10(100)", 2.0),
+    ("SELECT log2(8)", 3.0),
+    ("SELECT greatest(1, NULL, 3)", None),
+]
+
+
+@pytest.mark.parametrize("sql,want", CASES)
+def test_scalar_function(sql, want, runner):
+    got = runner.execute(sql).only_value()
+    if isinstance(want, float):
+        assert got is not None and abs(got - want) < 1e-9
+    else:
+        assert got == want

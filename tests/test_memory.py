@@ -1,0 +1,109 @@
+"""Memory pools, revocation, spill (SURVEY.md §5.4 — revocable memory +
+spill-to-disk; results must be identical with and without spilling)."""
+
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.block import RelBatch
+from trino_tpu.connectors.tpch import create_tpch_connector
+from trino_tpu.engine import LocalQueryRunner, Session
+from trino_tpu.exec.spill import FileSpiller
+from trino_tpu.runtime.memory import (
+    ExceededMemoryLimitError,
+    MemoryContext,
+    MemoryPool,
+)
+
+
+def test_pool_reserve_free():
+    pool = MemoryPool(1000)
+    assert pool.try_reserve(600)
+    assert not pool.try_reserve(600)
+    pool.free(600)
+    assert pool.try_reserve(600)
+
+
+def test_pool_limit_enforced():
+    pool = MemoryPool(100)
+    with pytest.raises(ExceededMemoryLimitError):
+        pool.reserve(200)
+
+
+def test_pool_revokes_largest_first():
+    pool = MemoryPool(1000)
+    revoked = []
+
+    def make(name, bytes_):
+        ctx = MemoryContext(pool)
+
+        def revoke():
+            revoked.append(name)
+            ctx.set_bytes(0)
+            ctx.set_revocable_bytes(0)
+
+        ctx.set_revoker(revoke)
+        ctx.set_bytes(bytes_)
+        ctx.set_revocable_bytes(bytes_)
+        return ctx
+
+    make("small", 200)
+    make("big", 700)
+    # 100 free; reserving 400 must revoke "big" first and then fit
+    pool.reserve(400)
+    assert revoked == ["big"]
+
+
+def test_spiller_roundtrip():
+    sp = FileSpiller()
+    b = RelBatch.from_pydict(
+        [("a", T.BIGINT), ("s", T.VARCHAR)],
+        {"a": [1, 2, 3], "s": ["x", "y", "x"]},
+    )
+    sp.spill(b)
+    sp.spill(b)
+    assert sp.batch_count == 2
+    out = list(sp.unspill())
+    assert len(out) == 2
+    assert out[0].to_pylists() == b.to_pylists()
+    sp.close()
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    r = LocalQueryRunner(Session(catalog="tpch", schema="tiny"))
+    r.register_catalog("tpch", create_tpch_connector())
+    return r
+
+
+AGG_Q = (
+    "select l_orderkey, sum(l_quantity) q, count(*) c from lineitem"
+    " group by l_orderkey order by q desc, l_orderkey limit 10"
+)
+SORT_Q = (
+    "select l_orderkey, l_extendedprice from lineitem"
+    " order by l_extendedprice desc, l_orderkey limit 20"
+)
+
+
+def test_aggregation_spills_and_matches(baseline):
+    base = baseline.execute(AGG_Q).rows
+    r = LocalQueryRunner(
+        Session(
+            catalog="tpch", schema="tiny",
+            batch_rows=8192, memory_pool_bytes=256 * 1024,
+        )
+    )
+    r.register_catalog("tpch", create_tpch_connector())
+    assert r.execute(AGG_Q).rows == base
+
+
+def test_sort_spills_and_matches(baseline):
+    base = baseline.execute(SORT_Q).rows
+    r = LocalQueryRunner(
+        Session(
+            catalog="tpch", schema="tiny",
+            batch_rows=4096, memory_pool_bytes=256 * 1024,
+        )
+    )
+    r.register_catalog("tpch", create_tpch_connector())
+    assert r.execute(SORT_Q).rows == base

@@ -1,0 +1,124 @@
+"""Window functions + set operations vs the sqlite oracle
+(AbstractTestWindowQueries analogue, SURVEY.md §4.3)."""
+
+import sqlite3
+
+import pytest
+
+from tests.oracle import assert_rows_match, load_tpch_sqlite, sqlite_rows
+from tests.test_tpch import to_sqlite
+from trino_tpu.connectors.tpch import create_tpch_connector
+from trino_tpu.engine import LocalQueryRunner, Session
+
+SF = 0.01
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    conn = sqlite3.connect(":memory:")
+    load_tpch_sqlite(conn, SF)
+    yield conn
+    conn.close()
+
+
+@pytest.fixture(scope="module")
+def runner():
+    r = LocalQueryRunner(Session(catalog="tpch", schema="tiny"))
+    r.register_catalog("tpch", create_tpch_connector())
+    return r
+
+
+WINDOW_QUERIES = [
+    # ranking family
+    "select n_regionkey, n_name, row_number() over (partition by n_regionkey order by n_name) rn"
+    " from nation order by n_regionkey, n_name",
+    "select n_regionkey, n_name,"
+    " rank() over (partition by n_regionkey order by substr(n_name,1,1)) r,"
+    " dense_rank() over (partition by n_regionkey order by substr(n_name,1,1)) dr"
+    " from nation order by n_regionkey, n_name",
+    "select n_regionkey, n_name, ntile(3) over (partition by n_regionkey order by n_name) b"
+    " from nation order by n_regionkey, n_name",
+    # whole-partition aggregates
+    "select s_nationkey, s_name, sum(s_acctbal) over (partition by s_nationkey) tot,"
+    " count(*) over (partition by s_nationkey) c"
+    " from supplier order by s_nationkey, s_name",
+    "select o_orderkey, avg(o_totalprice) over (partition by o_orderpriority) a"
+    " from orders where o_orderkey < 100 order by o_orderkey",
+    # running frames
+    "select s_nationkey, s_name, s_acctbal, sum(s_acctbal) over"
+    " (partition by s_nationkey order by s_name rows between unbounded preceding and current row) run"
+    " from supplier order by s_nationkey, s_name",
+    "select s_name, min(s_acctbal) over"
+    " (order by s_suppkey rows between unbounded preceding and current row) m"
+    " from supplier order by s_suppkey",
+    # default RANGE frame with peers (sum over order-by with duplicates)
+    "select o_custkey, o_orderkey, sum(o_orderkey) over"
+    " (partition by o_custkey order by o_orderdate) s"
+    " from orders where o_custkey < 30 order by o_custkey, o_orderkey",
+    # navigation
+    "select o_custkey, o_orderkey, lag(o_orderkey) over (partition by o_custkey order by o_orderkey) prev,"
+    " lead(o_orderkey, 2) over (partition by o_custkey order by o_orderkey) nxt2"
+    " from orders where o_custkey < 20 order by o_custkey, o_orderkey",
+    "select n_name, first_value(n_name) over (partition by n_regionkey order by n_name) f,"
+    " last_value(n_name) over (partition by n_regionkey) l"
+    " from nation order by n_name",
+    # window over aggregated input
+    "select n_regionkey, count(*) c, rank() over (order by count(*) desc) r"
+    " from nation group by n_regionkey order by r, n_regionkey",
+]
+
+
+@pytest.mark.parametrize("sql", WINDOW_QUERIES)
+def test_window_query(sql, runner, oracle):
+    got = runner.execute(sql).rows
+    want = sqlite_rows(oracle, to_sqlite(sql))
+    assert_rows_match(got, want, ordered=True, abs_tol=1e-2)
+
+
+SET_QUERIES = [
+    "select c_custkey from customer where c_custkey < 100 intersect"
+    " select o_custkey from orders order by 1",
+    "select c_custkey from customer where c_custkey < 100 except"
+    " select o_custkey from orders order by c_custkey limit 5",
+    "select n_regionkey from nation intersect select r_regionkey from region order by 1 desc",
+    "select o_orderstatus from orders except select 'O' order by 1",
+    "select c_mktsegment from customer intersect select 'BUILDING'",
+    "select n_name from nation where n_regionkey = 0 union"
+    " select n_name from nation where n_regionkey = 1 order by 1 limit 4",
+]
+
+
+@pytest.mark.parametrize("sql", SET_QUERIES)
+def test_set_operation(sql, runner, oracle):
+    got = runner.execute(sql).rows
+    want = sqlite_rows(oracle, to_sqlite(sql))
+    assert_rows_match(got, want, ordered="order by" in sql, abs_tol=1e-2)
+
+
+def test_unsupported_frame_rejected(runner):
+    from trino_tpu.sql.parser import ParsingError
+
+    with pytest.raises(ParsingError):
+        runner.execute(
+            "select sum(n_nationkey) over (order by n_name"
+            " rows between 2 preceding and current row) from nation"
+        )
+
+
+def test_window_distributed(oracle):
+    """Window functions through the fragmenter: repartition on the
+    PARTITION BY keys, window per task."""
+    from trino_tpu.runtime import DistributedQueryRunner
+
+    r = DistributedQueryRunner(
+        Session(catalog="tpch", schema="tiny"), n_workers=2, hash_partitions=2
+    )
+    r.register_catalog("tpch", create_tpch_connector())
+    sql = (
+        "select s_nationkey, s_name, sum(s_acctbal) over (partition by s_nationkey) t,"
+        " row_number() over (partition by s_nationkey order by s_name) rn"
+        " from supplier order by s_nationkey, s_name"
+    )
+    got = r.execute(sql).rows
+    want = sqlite_rows(oracle, to_sqlite(sql))
+    assert_rows_match(got, want, ordered=True, abs_tol=1e-2)

@@ -193,21 +193,8 @@ class Column:
             data, valid = data[keep], valid[keep]
         if count is not None:
             data, valid = data[:count], valid[:count]
-        out = []
-        for x, ok in zip(data, valid):
-            if not ok:
-                out.append(None)
-            elif self.type.is_string:
-                out.append(self.dictionary.values[int(x)] if self.dictionary else str(int(x)))
-            elif self.type.is_decimal:
-                out.append(int(x) / T.decimal_scale_factor(self.type))
-            elif self.type.kind == T.TypeKind.BOOLEAN:
-                out.append(bool(x))
-            elif self.type.is_floating:
-                out.append(float(x))
-            else:
-                out.append(int(x))
-        return out
+        dict_values = self.dictionary.values if self.dictionary else None
+        return decode_values(self.type, data, valid, dict_values)
 
 
 @jax.tree_util.register_pytree_node_class
@@ -309,6 +296,26 @@ class RelBatch:
             live = np.asarray(host.live)
         cols = [c.to_pylist(live=live) for c in host.columns]
         return [list(row) for row in zip(*cols)] if cols else []
+
+
+def decode_values(type_: T.DataType, data, valid, dict_values) -> list:
+    """Physical values -> python values (the single host-side decode rule
+    set, shared by Column.to_pylist and the wire-page protocol decode)."""
+    out = []
+    for x, ok in zip(data, valid):
+        if not ok:
+            out.append(None)
+        elif type_.is_string:
+            out.append(dict_values[int(x)] if dict_values else str(int(x)))
+        elif type_.is_decimal:
+            out.append(int(x) / T.decimal_scale_factor(type_))
+        elif type_.kind == T.TypeKind.BOOLEAN:
+            out.append(bool(x))
+        elif type_.is_floating:
+            out.append(float(x))
+        else:
+            out.append(int(x))
+    return out
 
 
 def unify_column_dicts(cols: Sequence[Column]) -> list:

@@ -38,6 +38,9 @@ class Session:
     retry_policy: str = "none"
     query_retries: int = 2
     task_retries: int = 3
+    # per-query memory budget (None = unlimited); exceeding it triggers
+    # revocation/spill, then ExceededMemoryLimitError
+    memory_pool_bytes: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -122,7 +125,12 @@ class LocalQueryRunner:
                 self._plan_cache[sql_key] = (output, physical)
         else:
             output, physical = cached
-        pipelines, chain = physical.instantiate()
+        ctx: dict = {}
+        if self.session.memory_pool_bytes is not None:
+            from trino_tpu.runtime.memory import MemoryPool
+
+            ctx["memory_pool"] = MemoryPool(self.session.memory_pool_bytes)
+        pipelines, chain = physical.instantiate(ctx)
         sink = CollectorSink()
         chain.append(sink)
         for p in pipelines:

@@ -18,6 +18,7 @@ jit-compiled XLA programs over RelBatch pytrees. TPU-first deltas:
 from __future__ import annotations
 
 import dataclasses
+import threading as _threading
 from functools import partial
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -281,22 +282,78 @@ class SortOperator(Operator):
     (OrderByOperator.java:44; comparator chains become stable argsorts)."""
 
     def __init__(self, keys: Sequence[SortKey],
-                 input_schema: Sequence[Tuple[T.DataType, Optional[Dictionary]]]):
+                 input_schema: Sequence[Tuple[T.DataType, Optional[Dictionary]]],
+                 memory_context=None):
         self._keys = list(keys)
         self._schema = list(input_schema)
         self._inputs: List[RelBatch] = []
         self._out: Optional[RelBatch] = None
+        # revocable accumulation (OrderByOperator's spill path): revoke
+        # compacts buffered input into a sorted run on disk; finish
+        # re-reads runs for the final device sort (which materializes —
+        # the streaming k-way merge is the MergeOperator's job upstream)
+        self._memory = memory_context
+        self._spiller = None
+        self._in_finish = False
+        # cross-thread revocation (see HashAggregationOperator) serializes
+        # all buffered-state mutation on this lock
+        self._state_lock = _threading.Lock()
+        if self._memory is not None:
+            self._memory.set_revoker(self._revoke_memory)
 
     def add_input(self, batch: RelBatch) -> None:
-        self._inputs.append(batch)
+        with self._state_lock:
+            self._inputs.append(batch)
+        self._track_memory()
+
+    def _track_memory(self) -> None:
+        """Bounds ACCUMULATION memory; the final sort materializes the
+        output batch outside the accounted state (same exemption as the
+        aggregation finish — see HashAggregationOperator._track_memory)."""
+        if self._memory is None:
+            return
+        from trino_tpu.runtime.memory import batch_bytes
+
+        total = sum(batch_bytes(b) for b in self._inputs)
+        try:
+            self._memory.set_bytes(total)
+        except Exception:
+            if not self._inputs:
+                raise
+            self._revoke_memory()
+            return
+        self._memory.set_revocable_bytes(total)
+
+    def _revoke_memory(self) -> None:
+        with self._state_lock:
+            if not self._inputs or self._in_finish:
+                return
+            if self._spiller is None:
+                from trino_tpu.exec.spill import FileSpiller
+
+                self._spiller = FileSpiller()
+            run = _concat_sort(tuple(self._inputs), tuple(self._keys)).compact()
+            self._spiller.spill(run)
+            self._inputs = []
+        self._track_memory()
 
     def finish(self) -> None:
         if self._finishing:
             return
         self._finishing = True
-        batches = self._inputs or [empty_batch(self._schema)]
+        with self._state_lock:
+            self._in_finish = True
+            batches = list(self._inputs)
+            self._inputs = []
+            spiller, self._spiller = self._spiller, None
+        if spiller is not None:
+            batches.extend(spiller.unspill())
+            spiller.close()
+        batches = batches or [empty_batch(self._schema)]
         self._out = _concat_sort(tuple(batches), tuple(self._keys))
-        self._inputs = []
+        if self._memory is not None:
+            self._memory.set_bytes(0)
+            self._memory.set_revocable_bytes(0)
 
     def get_output(self) -> Optional[RelBatch]:
         out, self._out = self._out, None
@@ -336,6 +393,196 @@ class TopNOperator(Operator):
             if self._reservoir is not None
             else empty_batch(self._schema)
         )
+
+    def get_output(self) -> Optional[RelBatch]:
+        out, self._out = self._out, None
+        return out
+
+    def is_finished(self) -> bool:
+        return self._finishing and self._out is None
+
+
+# ---------------------------------------------------------------------------
+# Window functions
+# ---------------------------------------------------------------------------
+
+
+@partial(
+    jax.jit,
+    static_argnames=("partition_channels", "order_keys", "functions", "frame"),
+)
+def _window_compute(
+    batch: RelBatch,
+    partition_channels: tuple,
+    order_keys: tuple,
+    functions: tuple,  # (kind, arg_channel, out_dtype_str, offset, arg_scale_factor, out_is_float)
+    frame: str,
+):
+    """One device program computing every window column over the sorted
+    batch (the whole WindowOperator inner loop as segmented scans —
+    ops/window.py). Traced under jit by the operator."""
+    from trino_tpu.ops import window as W
+
+    live = batch.live_mask()
+    n = batch.capacity
+    part_cols = [batch.columns[c] for c in partition_channels]
+    key_data = [c.data for c in part_cols]
+    key_valids = [c.valid for c in part_cols]
+    descending = [False] * len(part_cols)
+    nulls_first = [False] * len(part_cols)
+    for k in order_keys:
+        col = batch.columns[k.channel]
+        key_data.append(col.data)
+        key_valids.append(col.valid)
+        descending.append(k.descending)
+        nulls_first.append(k.nulls_first)
+    order = (
+        sort_order(key_data, key_valids, descending, nulls_first, live)
+        if key_data
+        else jnp.argsort(~live, stable=True)
+    )
+    s_live = jnp.take(live, order)
+    s_cols = [c.gather(order) for c in batch.columns]
+
+    # partition boundaries (dead tail isolated as its own segment)
+    part_inputs = [jnp.take(d, order) for d in key_data[: len(part_cols)]]
+    part_vmasks = [
+        None if v is None else jnp.take(v, order)
+        for v in key_valids[: len(part_cols)]
+    ]
+    part_start = W.segment_starts(
+        part_inputs + [s_live], part_vmasks + [None], n
+    )
+    peer_inputs = [
+        jnp.take(batch.columns[k.channel].data, order) for k in order_keys
+    ]
+    peer_vmasks = [
+        None
+        if batch.columns[k.channel].valid is None
+        else jnp.take(batch.columns[k.channel].valid, order)
+        for k in order_keys
+    ]
+    peer_start = part_start | W.segment_starts(peer_inputs, peer_vmasks, n) if peer_inputs else part_start
+
+    out_cols = []
+    for kind, arg_ch, out_dt, offset, arg_sf, out_float in functions:
+        out_dtype = np.dtype(out_dt)
+        if kind == "row_number":
+            out_cols.append((W.row_number(part_start).astype(out_dtype), None))
+        elif kind == "rank":
+            out_cols.append((W.rank(part_start, peer_start).astype(out_dtype), None))
+        elif kind == "dense_rank":
+            out_cols.append((W.dense_rank(part_start, peer_start).astype(out_dtype), None))
+        elif kind == "ntile":
+            out_cols.append((W.ntile(offset, part_start).astype(out_dtype), None))
+        elif kind in ("lead", "lag"):
+            col = s_cols[arg_ch]
+            off = offset if kind == "lag" else -offset
+            data, valid = W.shift_in_partition(col.data, col.valid, part_start, off)
+            out_cols.append((data, valid & s_live))
+        elif kind == "first_value":
+            col = s_cols[arg_ch]
+            data, valid = W.first_value(col.data, col.valid, part_start)
+            out_cols.append((data, valid))
+        elif kind == "last_value":
+            col = s_cols[arg_ch]
+            data, valid = W.last_value(col.data, col.valid, part_start, peer_start, frame)
+            out_cols.append((data, valid))
+        elif kind in ("count", "count_star"):
+            if arg_ch is None:
+                vals, valid = None, None
+            else:
+                vals, valid = s_cols[arg_ch].data, s_cols[arg_ch].valid
+            v, _ = W.windowed_agg("count", vals, valid, s_live, part_start, peer_start, frame, 0)
+            out_cols.append((v.astype(out_dtype), None))
+        elif kind in ("sum", "avg", "min", "max"):
+            col = s_cols[arg_ch]
+            if kind in ("min", "max"):
+                vals = col.data
+                neutral = minmax_neutral(col.data.dtype, kind)
+            else:
+                acc_dt = (
+                    jnp.float64
+                    if jnp.issubdtype(col.data.dtype, jnp.floating)
+                    else jnp.int64
+                )
+                vals = col.data.astype(acc_dt)
+                neutral = 0
+            v, cnt = W.windowed_agg(kind, vals, col.valid, s_live, part_start, peer_start, frame, neutral)
+            has = cnt > 0
+            if kind == "avg":
+                q = v.astype(jnp.float64) / jnp.maximum(cnt, 1) / arg_sf
+                out_cols.append((q.astype(out_dtype), has))
+            elif kind == "sum" and out_float:
+                out_cols.append(((v / arg_sf).astype(out_dtype), has))
+            else:
+                safe = jnp.where(has, v, jnp.zeros((), v.dtype))
+                out_cols.append((safe.astype(out_dtype), has))
+        else:
+            raise NotImplementedError(f"window function {kind}")
+    return s_cols, s_live, out_cols
+
+
+class WindowOperator(Operator):
+    """Blocking window evaluation (WindowOperator.java:69): consume all
+    input, sort once by (partition, order), emit child columns + window
+    results in sorted order."""
+
+    def __init__(
+        self,
+        partition_channels: Sequence[int],
+        order_keys: Sequence[SortKey],
+        functions: Sequence,  # plan.WindowFuncSpec
+        frame: str,
+        input_schema: Sequence[Tuple[T.DataType, Optional[Dictionary]]],
+    ):
+        self._partition = tuple(partition_channels)
+        self._order = tuple(order_keys)
+        self._specs = list(functions)
+        self._frame = frame
+        self._schema = list(input_schema)
+        self._inputs: List[RelBatch] = []
+        self._out: Optional[RelBatch] = None
+        # static per-function tuples for the jitted kernel
+        fns = []
+        for s in self._specs:
+            # decimal args are int64 at the arg scale; divide only when
+            # the OUTPUT leaves the scaled domain (avg -> DOUBLE, float
+            # sums). Decimal sum/min/max keep the arg scale unchanged.
+            arg_sf = 1
+            out_float = s.out_type.is_floating
+            if s.arg_channel is not None:
+                arg_t = self._schema[s.arg_channel][0]
+                if arg_t.is_decimal and (s.kind == "avg" or out_float):
+                    arg_sf = T.decimal_scale_factor(arg_t)
+            fns.append(
+                (s.kind, s.arg_channel, s.out_type.dtype.str, s.offset,
+                 arg_sf, out_float)
+            )
+        self._fns = tuple(fns)
+
+    def add_input(self, batch: RelBatch) -> None:
+        self._inputs.append(batch)
+
+    def finish(self) -> None:
+        if self._finishing:
+            return
+        self._finishing = True
+        parts = self._inputs or [empty_batch(self._schema)]
+        merged = concat_batches(parts)
+        self._inputs = []
+        s_cols, s_live, out_cols = _window_compute(
+            merged, self._partition, self._order, self._fns, self._frame
+        )
+        cols = list(s_cols)
+        for spec, (data, valid) in zip(self._specs, out_cols):
+            d = None
+            if spec.arg_channel is not None and spec.kind in (
+                "lead", "lag", "first_value", "last_value", "min", "max"
+            ):
+                d = s_cols[spec.arg_channel].dictionary
+            cols.append(Column(spec.out_type, data, valid, d))
+        self._out = RelBatch(cols, s_live)
 
     def get_output(self) -> Optional[RelBatch]:
         out, self._out = self._out, None
@@ -601,14 +848,15 @@ class HashAggregationOperator(Operator):
         input_schema: Sequence[Tuple[T.DataType, Optional[Dictionary]]],
         initial_capacity: int = 1024,
         step: str = "single",
-        arg_meta: Optional[Sequence[Tuple[Optional[T.DataType], Optional[Dictionary]]]] = None,
+        memory_context=None,
     ):
         """step: "single" (raw rows in, results out), "partial" (raw rows
         in, serialized accumulator state out) or "final" (accumulator
         state in, results out) — AggregationNode.Step analogue. In final
-        mode the input layout is partial_output_schema's and `arg_meta`
-        carries each aggregate's ORIGINAL argument (type, dictionary)
-        for finalization (decimal rescale, dictionary decode)."""
+        mode the input layout is partial_output_schema's, whose state
+        value columns carry each aggregate's original argument
+        representation (decimal scale, dictionary) — finalization reads
+        it straight from the input schema."""
         assert step in ("single", "partial", "final"), step
         self._step = step
         self._group_channels = list(group_channels)
@@ -620,13 +868,24 @@ class HashAggregationOperator(Operator):
         self._acc = None
         self._gstate = None
         self._out: Optional[RelBatch] = None
-        if arg_meta is not None:
-            self._arg_meta = list(arg_meta)
-        else:
-            self._arg_meta = [
-                input_schema[a.arg_channel] if a.arg_channel is not None else (None, None)
-                for a in self._aggs
-            ]
+        # spill support (SpillableHashAggregationBuilder analogue):
+        # revoke() serializes the group state in the partial wire format
+        # and resets; finish() merges spilled state back via the same
+        # FINAL-step machinery the distributed exchange uses.
+        self._memory = memory_context
+        self._spiller = None
+        self._in_finish = False
+        # revocation runs on the RESERVING thread (MemoryPool.reserve
+        # calls the victim's callback), so every state mutation and the
+        # revoke itself serialize on this lock; accounting calls happen
+        # OUTSIDE it to keep lock ordering acyclic across operators
+        self._state_lock = _threading.Lock()
+        if self._memory is not None and not self._global:
+            self._memory.set_revoker(self._revoke_memory)
+        self._arg_meta = [
+            input_schema[a.arg_channel] if a.arg_channel is not None else (None, None)
+            for a in self._aggs
+        ]
         if self._global and step != "final":
             self._update = _global_update_fn(tuple(self._aggs))
 
@@ -665,7 +924,9 @@ class HashAggregationOperator(Operator):
                 break
             self._cap *= 2  # rebuild-at-larger-capacity (tryRehash analogue)
         new = (gk, gv, used, vals, cnts)
-        self._acc = new if self._acc is None else self._merge(self._acc, new)
+        with self._state_lock:
+            self._acc = new if self._acc is None else self._merge(self._acc, new)
+        self._track_memory()
 
     def _merge(self, a, b):
         """Merge two group-state sets (partial->final merge), one device
@@ -691,7 +952,9 @@ class HashAggregationOperator(Operator):
         vals = [batch.columns[k + 2 * i].data for i in range(len(self._aggs))]
         cnts = [batch.columns[k + 2 * i + 1].data for i in range(len(self._aggs))]
         new = ([*keys], [*valids], live, [*vals], [*cnts])
-        self._acc = new if self._acc is None else self._merge(self._acc, new)
+        with self._state_lock:
+            self._acc = new if self._acc is None else self._merge(self._acc, new)
+        self._track_memory()
 
     def _merge_global_state(self, batch: RelBatch, live) -> None:
         """Global (no GROUP BY) final step: fold incoming single-row
@@ -727,17 +990,10 @@ class HashAggregationOperator(Operator):
         self._gstate = out
 
     # -- partial step: emit serialized accumulator state --
-    def _emit_partial(self) -> None:
-        meta = [agg_state_meta(a, self._schema) for a in self._aggs] if not self._global else None
-        cols: List[Column] = []
-        if self._global:
-            states = self._gstate if self._gstate is not None else self._global_init()
-            for a, (val, cnt) in zip(self._aggs, states):
-                vt, vd = agg_state_meta(a, self._schema)[0]
-                cols.append(Column(vt, val[None].astype(vt.dtype), None, vd))
-                cols.append(Column(T.BIGINT, cnt[None].astype(jnp.int64), None, None))
-            self._out = RelBatch(cols, jnp.ones(1, dtype=jnp.bool_))
-            return
+    def _partial_state_batch(self) -> RelBatch:
+        """Current grouped state as a partial-wire-format batch (the
+        accumulator serialization shared by the exchange AND the
+        spiller)."""
         if self._acc is None:
             key_dts = [self._schema[c][0].dtype for c in self._group_channels]
             self._acc = (
@@ -747,15 +1003,70 @@ class HashAggregationOperator(Operator):
                 [jnp.zeros(16, dtype=jnp.int64) for _ in self._aggs],
                 [jnp.zeros(16, dtype=jnp.int64) for _ in self._aggs],
             )
+        cols: List[Column] = []
         gk, gv, used, vals, cnts = self._acc
         for ch, kk, vv in zip(self._group_channels, gk, gv):
             t, d = self._schema[ch]
             cols.append(Column(t, kk, vv, d))
-        for (vmeta, _cmeta), val, cnt in zip(meta, vals, cnts):
-            vt, vd = vmeta
+        for a, val, cnt in zip(self._aggs, vals, cnts):
+            vt, vd = agg_state_meta(a, self._schema)[0]
             cols.append(Column(vt, val.astype(vt.dtype), None, vd))
             cols.append(Column(T.BIGINT, cnt.astype(jnp.int64), None, None))
-        self._out = RelBatch(cols, used)
+        return RelBatch(cols, used)
+
+    def _emit_partial(self) -> None:
+        if self._global:
+            cols: List[Column] = []
+            states = self._gstate if self._gstate is not None else self._global_init()
+            for a, (val, cnt) in zip(self._aggs, states):
+                vt, vd = agg_state_meta(a, self._schema)[0]
+                cols.append(Column(vt, val[None].astype(vt.dtype), None, vd))
+                cols.append(Column(T.BIGINT, cnt[None].astype(jnp.int64), None, None))
+            self._out = RelBatch(cols, jnp.ones(1, dtype=jnp.bool_))
+            return
+        self._out = self._partial_state_batch()
+
+    # -- spill (revocable memory) --
+    def _revoke_memory(self) -> None:
+        """startMemoryRevoke/finishMemoryRevoke collapsed: dump the group
+        state to disk in the partial wire format and reset. May be called
+        from ANOTHER task's thread (MemoryPool.reserve picks victims), so
+        the whole snapshot-spill-reset runs under the state lock."""
+        with self._state_lock:
+            if self._acc is None or self._in_finish:
+                # nothing to give back, or finishing (finish owns state)
+                return
+            if self._spiller is None:
+                from trino_tpu.exec.spill import FileSpiller
+
+                self._spiller = FileSpiller()
+            self._spiller.spill(self._partial_state_batch())
+            self._acc = None
+        self._track_memory()
+
+    def _track_memory(self) -> None:
+        """Account the accumulation-state footprint. The pool bounds
+        ACCUMULATION memory; the finish-phase merge+finalize produces the
+        operator's output (not operator state) and is exempt — the
+        partitioned-spill refinement (grace merge of 1/N partitions at a
+        time) is the next step toward bounding finish too."""
+        if self._memory is None or self._in_finish:
+            return
+        total = 0
+        if self._acc is not None:
+            gk, gv, used, vals, cnts = self._acc
+            for arr in [*gk, *gv, used, *vals, *cnts]:
+                total += arr.size * arr.dtype.itemsize
+        try:
+            self._memory.set_bytes(total)
+        except Exception:
+            # pool exhausted even after revoking others: spill our own
+            # state (self-revocation) and account the reset footprint
+            if self._acc is None:
+                raise
+            self._revoke_memory()
+            return
+        self._memory.set_revocable_bytes(total)
 
     # -- global path --
     def _global_init(self):
@@ -784,6 +1095,19 @@ class HashAggregationOperator(Operator):
         if self._finishing:
             return
         self._finishing = True
+        with self._state_lock:
+            # flips revocation off atomically; from here finish owns state
+            self._in_finish = True
+            spiller, self._spiller = self._spiller, None
+        if spiller is not None:
+            # merge-on-unspill: spilled partial states re-enter through
+            # the FINAL-step ingestion path
+            for b in spiller.unspill():
+                self._add_state_input(b)
+            spiller.close()
+        if self._memory is not None and not self._global:
+            self._memory.set_bytes(0)
+            self._memory.set_revocable_bytes(0)
         if self._step == "partial":
             self._emit_partial()
             return
@@ -842,6 +1166,8 @@ class JoinBridge:
     def __init__(self):
         self.lookup_source: Optional[J.LookupSource] = None
         self.build_batch: Optional[RelBatch] = None
+        # build-side key dictionaries, for probe-side code remapping
+        self.key_dicts: Optional[List[Optional[Dictionary]]] = None
 
 
 @partial(jax.jit, static_argnames=("key_channels",))
@@ -876,6 +1202,9 @@ class HashBuildSink(Operator):
         ls, merged = _consolidate_build(parts, tuple(self._keys))
         self._bridge.lookup_source = ls
         self._bridge.build_batch = merged
+        self._bridge.key_dicts = [
+            merged.columns[c].dictionary for c in self._keys
+        ]
         self._inputs = []
 
     def get_output(self) -> Optional[RelBatch]:
@@ -972,6 +1301,7 @@ class LookupJoinOperator(Operator):
             else (make_residual_fn(residual) if residual is not None else None)
         )
         self._outputs: List[RelBatch] = []
+        self._remap_cache: Dict[tuple, jnp.ndarray] = {}
 
     def needs_input(self) -> bool:
         return not self._outputs and not self._finishing
@@ -979,7 +1309,32 @@ class LookupJoinOperator(Operator):
     def add_input(self, probe: RelBatch) -> None:
         ls = self._bridge.lookup_source
         build = self._bridge.build_batch
-        keys = [probe.columns[c].data for c in self._keys]
+        keys = []
+        for i, c in enumerate(self._keys):
+            col = probe.columns[c]
+            build_dict = self._bridge.key_dicts[i] if self._bridge.key_dicts else None
+            if (
+                col.dictionary is not None
+                and build_dict is not None
+                and col.dictionary != build_dict
+            ):
+                # cross-dictionary string join: remap probe codes onto the
+                # build dictionary by VALUE; absent values -> -1 (never
+                # matches a build code). TypeOperators' equality contract
+                # for the dictionary-encoded representation.
+                ck = (col.dictionary.values, build_dict.values)
+                remap = self._remap_cache.get(ck)
+                if remap is None:
+                    remap = jnp.asarray(
+                        [build_dict.code(v) for v in col.dictionary.values],
+                        dtype=jnp.int32,
+                    )
+                    self._remap_cache[ck] = remap
+                keys.append(
+                    jnp.take(remap, jnp.clip(col.data, 0, len(col.dictionary) - 1))
+                )
+            else:
+                keys.append(col.data)
         valids = [probe.columns[c].valid_mask() for c in self._keys]
         live = probe.live_mask()
         lo, counts, total = J.probe_counts(ls, keys, valids, live)

@@ -1,0 +1,58 @@
+"""Spill-to-disk: out-of-core state for aggregation/sort/join.
+
+Analogue of main/spiller/ (FileSingleStreamSpiller — serialized pages to
+local disk; GenericPartitioningSpiller — hash-partitioned spill files;
+docs/admin/spill.rst — SURVEY.md §5.4). The wire serde is the spill
+format, so spilled state is exactly what an exchange would ship: for
+aggregation that means partial-state pages merge back with the same
+FINAL-step machinery used by the distributed path (HBM -> host-disk
+eviction reuses the partial->final contract)."""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Iterator, List, Optional
+
+from trino_tpu.block import RelBatch
+from trino_tpu.exec.serde import deserialize_page, serialize_batch
+
+
+class FileSpiller:
+    """Append-only single-stream spiller (FileSingleStreamSpiller)."""
+
+    def __init__(self, spill_dir: Optional[str] = None):
+        self._dir = spill_dir or tempfile.gettempdir()
+        fd, self._path = tempfile.mkstemp(
+            prefix="trino-tpu-spill-", suffix=".pages", dir=self._dir
+        )
+        self._file = os.fdopen(fd, "wb+")
+        self._offsets: List[tuple] = []  # (offset, length)
+        self.spilled_bytes = 0
+
+    def spill(self, batch: RelBatch) -> None:
+        data = serialize_batch(batch)
+        off = self._file.tell()
+        self._file.write(data)
+        self._offsets.append((off, len(data)))
+        self.spilled_bytes += len(data)
+
+    @property
+    def batch_count(self) -> int:
+        return len(self._offsets)
+
+    def unspill(self) -> Iterator[RelBatch]:
+        """Read batches back (merge-on-unspill consumes these)."""
+        self._file.flush()
+        for off, ln in self._offsets:
+            self._file.seek(off)
+            yield deserialize_page(self._file.read(ln)).to_batch()
+
+    def close(self) -> None:
+        try:
+            self._file.close()
+        finally:
+            try:
+                os.unlink(self._path)
+            except OSError:
+                pass

@@ -73,6 +73,18 @@ def merge_valid(*valids: Optional[jnp.ndarray]) -> Optional[jnp.ndarray]:
     return out
 
 
+def _format_cast_text(v, src_type: T.DataType):
+    """SQL text form for cast-to-varchar constant folding."""
+    if v is None:
+        return None
+    if src_type.kind == T.TypeKind.BOOLEAN:
+        return "true" if v else "false"
+    if src_type.is_decimal:
+        s = src_type.scale or 0
+        return f"{v:.{s}f}" if s else str(int(v))
+    return str(v)
+
+
 def _const(shape_src: jnp.ndarray, value, dtype) -> jnp.ndarray:
     return jnp.full(shape_src.shape, value, dtype=dtype)
 
@@ -138,6 +150,10 @@ class ExprBinder:
         return Bound(t, vfn, const_value=e.value, is_const=True)
 
     # ---- cast ----
+    # int->varchar enumerates this value domain as a static dictionary;
+    # values outside it become NULL (documented deviation)
+    _SMALL_INT_CAST_RANGE = (0, 4096)
+
     def _bind_cast(self, e: Cast) -> Bound:
         a = self.bind(e.arg)
         out = self._bind_cast_from(e, a)
@@ -198,6 +214,35 @@ class ExprBinder:
                     d = F.round_half_away(d)
                 return d.astype(dst.dtype), v
             return Bound(dst, fifn)
+        if dst.is_string:
+            # cast-to-varchar: constants fold; small integer domains get
+            # an enumerated dictionary. Arbitrary numeric columns would
+            # need a runtime-built dictionary (the static-dictionary
+            # model's known limit; SURVEY.md §7 hard parts).
+            if a.is_const:
+                text = _format_cast_text(a.const_value, src)
+                d = Dictionary([text] if text is not None else [])
+                def cfn(cols, valids, d=d, text=text):
+                    ref = cols[0] if cols else jnp.zeros(1)
+                    if text is None:
+                        return _const(ref, 0, jnp.int32), _const(ref, False, jnp.bool_)
+                    return _const(ref, 0, jnp.int32), None
+                return Bound(dst, cfn, d, const_value=text, is_const=True)
+            if src.is_integerlike:
+                lo, hi = self._SMALL_INT_CAST_RANGE
+                values = [str(i) for i in range(lo, hi)]
+                d = Dictionary(values)
+                codes = jnp.asarray(
+                    [d.code(str(i)) for i in range(lo, hi)], dtype=jnp.int32
+                )
+                def sfn(cols, valids, afn=a.fn):
+                    data, v = afn(cols, valids)
+                    in_range = (data >= lo) & (data < hi)
+                    idx = jnp.clip(data - lo, 0, hi - lo - 1).astype(jnp.int32)
+                    out = jnp.take(codes, idx)
+                    vv = in_range if v is None else (v & in_range)
+                    return out, vv
+                return Bound(dst, sfn, d)
         raise NotImplementedError(f"cast {src} -> {dst}")
 
     def _rescaled(self, a: Bound, sfrom: int, sto: int, out_type: T.DataType) -> Bound:
@@ -391,6 +436,102 @@ class ExprBinder:
                     out = out.astype(e.type.dtype)
                 return out, v
             return Bound(e.type, rfn)
+        if name in ("trim", "ltrim", "rtrim", "reverse"):
+            pyf = {"trim": str.strip, "ltrim": str.lstrip,
+                   "rtrim": str.rstrip, "reverse": lambda s: s[::-1]}[name]
+            return self._bind_dict_transform(args[0], e, pyf)
+        if name == "replace":
+            frm, to = e.args[1], e.args[2] if len(e.args) > 2 else Literal("", T.VARCHAR)
+            assert isinstance(frm, Literal) and isinstance(to, Literal), (
+                "replace() search/replacement must be constants"
+            )
+            return self._bind_dict_transform(
+                args[0], e, lambda s: s.replace(frm.value, to.value)
+            )
+        if name == "starts_with":
+            a, prefix = args[0], e.args[1]
+            assert isinstance(prefix, Literal), "starts_with() prefix must be constant"
+            if a.dictionary is None or len(a.dictionary) == 0:
+                return self._null_of(a, T.BOOLEAN)
+            table = jnp.asarray(
+                [v.startswith(prefix.value) for v in a.dictionary.values],
+                dtype=jnp.bool_,
+            )
+            def swfn(cols, valids, afn=a.fn):
+                d, v = afn(cols, valids)
+                return jnp.take(table, jnp.clip(d, 0, table.shape[0] - 1)), v
+            return Bound(T.BOOLEAN, swfn)
+        if name == "concat":
+            return self._bind_concat(e, args)
+        if name == "nullif":
+            a, b = args
+            # route equality through the comparison binder so dictionary
+            # unification and decimal rescaling apply (TypeOperators'
+            # equality contract), then keep a's representation
+            eqb = self._bind_comparison("eq", [a, b])
+            def nifn(cols, valids):
+                da, va = a.fn(cols, valids)
+                de, ve = eqb.fn(cols, valids)
+                eq = de if ve is None else (de & ve)
+                v = va if va is not None else _const(da, True, jnp.bool_)
+                return da, v & ~eq
+            return Bound(e.type, nifn, a.dictionary)
+        if name in ("greatest", "least"):
+            jf = jnp.maximum if name == "greatest" else jnp.minimum
+            out_dict = None
+            if e.type.is_string:
+                # unified dictionaries are sorted, so code order ==
+                # lexical order and max/min on codes is correct
+                merged = None
+                for a in args:
+                    if a.dictionary is not None:
+                        merged = (
+                            a.dictionary
+                            if merged is None
+                            else Dictionary.unify(merged, a.dictionary)[0]
+                        )
+                if merged is None:
+                    return self._null_of(args[0], e.type)
+                args = [self._remap_to(a, merged) for a in args]
+                out_dict = merged
+            def glfn(cols, valids):
+                data, valid = args[0].fn(cols, valids)
+                data = data.astype(e.type.dtype)
+                for a in args[1:]:
+                    d, v = a.fn(cols, valids)
+                    data = jf(data, d.astype(e.type.dtype))
+                    if v is not None:  # NULL poisons (Trino semantics)
+                        valid = v if valid is None else (valid & v)
+                return data, valid
+            return Bound(e.type, glfn, out_dict)
+        if name == "power":
+            a, b = args
+            dsa = T.decimal_scale_factor(a.type) if a.type.is_decimal else 1
+            dsb = T.decimal_scale_factor(b.type) if b.type.is_decimal else 1
+            def pwfn(cols, valids):
+                da, va = a.fn(cols, valids)
+                db, vb = b.fn(cols, valids)
+                out = jnp.power(da.astype(jnp.float64) / dsa,
+                                db.astype(jnp.float64) / dsb)
+                v = va
+                if vb is not None:
+                    v = vb if v is None else (v & vb)
+                return out, v
+            return Bound(T.DOUBLE, pwfn)
+        if name in ("log2", "log10"):
+            (a,) = args[:1]
+            base = 2.0 if name == "log2" else 10.0
+            ds = T.decimal_scale_factor(a.type) if a.type.is_decimal else 1
+            def lgfn(cols, valids):
+                d, v = a.fn(cols, valids)
+                return jnp.log(d.astype(jnp.float64) / ds) / np.log(base), v
+            return Bound(T.DOUBLE, lgfn)
+        if name == "sign":
+            (a,) = args
+            def sgfn(cols, valids):
+                d, v = a.fn(cols, valids)
+                return jnp.sign(d).astype(e.type.dtype), v
+            return Bound(e.type, sgfn)
         if name in ("sqrt", "ln", "exp", "floor", "ceil"):
             (a,) = args[:1]
             jf = {"sqrt": jnp.sqrt, "ln": jnp.log, "exp": jnp.exp,
@@ -442,6 +583,48 @@ class ExprBinder:
             d, v = a.fn(cols, valids)
             return jnp.take(remap, jnp.clip(d, 0, remap.shape[0] - 1)), v
         return Bound(e.type, fn, new_dict)
+
+    def _bind_concat(self, e: Call, args) -> Bound:
+        """String concatenation on dictionary columns. Constant operands
+        fold into a dictionary transform; two dictionary operands build
+        the pairwise dictionary (bounded) with codes ca*|B|+cb."""
+        if len(args) > 2:
+            # left-fold longer chains into pairwise concats
+            acc = args[0]
+            for i in range(1, len(args)):
+                pair = Call("concat", (e.args[0], e.args[i]), T.VARCHAR)
+                acc = self._bind_concat(pair, [acc, args[i]])
+            return acc
+        a, b = args
+        if b.is_const:
+            suffix = b.const_value or ""
+            return self._bind_dict_transform(a, e, lambda s: s + suffix)
+        if a.is_const:
+            prefix = a.const_value or ""
+            return self._bind_dict_transform(b, e, lambda s: prefix + s)
+        if a.dictionary is None or b.dictionary is None:
+            return self._null_of(a, T.VARCHAR)
+        da, db = a.dictionary, b.dictionary
+        if len(da) * len(db) > 1 << 18:
+            raise NotImplementedError(
+                "concat of two high-cardinality string columns"
+            )
+        pairs = [x + y for x in da.values for y in db.values]
+        new_dict = Dictionary(pairs)
+        remap = jnp.asarray(
+            [new_dict.code(p) for p in pairs], dtype=jnp.int32
+        ).reshape(len(da), len(db))
+        def fn(cols, valids):
+            dca, va = a.fn(cols, valids)
+            dcb, vb = b.fn(cols, valids)
+            ca = jnp.clip(dca, 0, len(da) - 1)
+            cb = jnp.clip(dcb, 0, len(db) - 1)
+            out = remap[ca, cb]
+            v = va
+            if vb is not None:
+                v = vb if v is None else (v & vb)
+            return out, v
+        return Bound(T.VARCHAR, fn, new_dict)
 
     def _bind_like(self, e: Call, args) -> Bound:
         a = args[0]

@@ -81,6 +81,13 @@ class OutputBuffer:
                     raise RuntimeError("output buffer aborted (task failed)")
                 q = self._pages[partition]
                 first = self._first_token[partition]
+                if token < first:
+                    # below the acked watermark: the data is gone; spinning
+                    # would hang the consumer (Trino's results protocol
+                    # rejects rewinds past the acknowledged token)
+                    raise RuntimeError(
+                        f"token {token} below acknowledged watermark {first}"
+                    )
                 # ack: drop pages below the requested token
                 if token > first:
                     drop = min(token - first, len(q))

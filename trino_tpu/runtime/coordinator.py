@@ -53,42 +53,23 @@ class QueryScheduler:
         self.tasks: Dict[int, List] = {}
         self._schemas: Dict[int, list] = {}
 
-    # -- fragment schema propagation (coordinator-side planning pass) --
-    def _topo(self, sp: SubPlan, out: List[SubPlan]) -> None:
-        for c in sp.children:
-            self._topo(c, out)
-        out.append(sp)
-
-    def _fragment_schema(self, sp: SubPlan, remote: dict) -> list:
-        """Coordinator-side planning pass for the fragment's output
-        schema (dictionaries included) so worker-side planning of
-        consumer fragments can bind expressions."""
-        planner = LocalPlanner(
-            self.catalogs,
-            batch_rows=self.session.batch_rows,
-            remote_schemas=remote,
-        )
-        physical = planner.plan(sp.fragment.root)
-        return physical.schema
-
-    def _task_count(self, sp: SubPlan) -> int:
-        p = sp.fragment.partitioning
-        if p == "single":
-            return 1
-        if p == "source":
-            return max(1, len(self.workers))
-        return self.hash_partitions
-
     def start(self):
         """Create all tasks bottom-up (producers first so consumers can
         reference their buffers); returns the root task."""
-        order: List[SubPlan] = []
-        self._topo(self.subplan, order)
+        from trino_tpu.runtime.stages import (
+            fragment_schema,
+            stage_task_count,
+            topo_order,
+        )
+
+        order = topo_order(self.subplan)
         task_counts: Dict[int, int] = {}
         consumer_counts: Dict[int, int] = {}
         # first pass: task counts; consumer partition counts per producer
         for sp in order:
-            task_counts[sp.fragment.id] = self._task_count(sp)
+            task_counts[sp.fragment.id] = stage_task_count(
+                sp, len(self.workers), self.hash_partitions
+            )
         for sp in order:
             for c in sp.children:
                 consumer_counts[c.fragment.id] = task_counts[sp.fragment.id]
@@ -101,7 +82,9 @@ class QueryScheduler:
                 c.fragment.id: self._schemas[c.fragment.id]
                 for c in sp.children
             }
-            self._schemas[f.id] = self._fragment_schema(sp, remote)
+            self._schemas[f.id] = fragment_schema(
+                self.catalogs, self.session, sp, remote
+            )
             input_locations = {
                 c.fragment.id: [
                     handle.results_location(tid)
@@ -218,11 +201,17 @@ class DistributedQueryRunner:
                 self.session,
                 self.hash_partitions,
             )
-            root_handle, root_tid = scheduler.start()
             try:
+                # start() inside the try: a mid-launch failure must still
+                # abort the tasks already created, and counts as a
+                # retryable attempt under retry_policy=QUERY. Worker
+                # crashes surface as OSError/URLError, not RuntimeError,
+                # so catch broadly here — analysis errors were raised
+                # before this loop.
+                root_handle, root_tid = scheduler.start()
                 rows = self._collect(scheduler, root_handle, root_tid)
                 return MaterializedResult(rows, *result_meta)
-            except RuntimeError as e:
+            except Exception as e:
                 last_error = e  # retry_policy=QUERY: whole-query re-run
             finally:
                 scheduler.abort()
@@ -291,27 +280,15 @@ class DistributedQueryRunner:
 
 def _page_rows(page: Page) -> List[list]:
     """Decode a wire page to python rows (host-side, no device round
-    trip) — the protocol-encoding path of Column.to_pylist."""
+    trip) via the shared decode rules."""
     import numpy as np
+
+    from trino_tpu.block import decode_values
 
     cols = []
     for t, data, valid, dvals in zip(
         page.types, page.columns, page.valids, page.dictionaries
     ):
-        vals = []
         ok = valid if valid is not None else np.ones(len(data), dtype=bool)
-        for x, o in zip(data, ok):
-            if not o:
-                vals.append(None)
-            elif t.is_string:
-                vals.append(dvals[int(x)] if dvals else str(int(x)))
-            elif t.is_decimal:
-                vals.append(int(x) / T.decimal_scale_factor(t))
-            elif t.kind == T.TypeKind.BOOLEAN:
-                vals.append(bool(x))
-            elif t.is_floating:
-                vals.append(float(x))
-            else:
-                vals.append(int(x))
-        cols.append(vals)
+        cols.append(decode_values(t, data, ok, dvals))
     return [list(r) for r in zip(*cols)] if cols else []

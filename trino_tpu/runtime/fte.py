@@ -58,9 +58,15 @@ class FaultTolerantQueryScheduler:
         """Execute every stage; returns (root worker handle, root task
         key) for result fetching (root output is spooled too, so any
         handle can serve it — we return the one that ran it)."""
-        order: List[SubPlan] = []
-        self._topo(self.subplan, order)
-        task_counts = {sp.fragment.id: self._task_count(sp) for sp in order}
+        from trino_tpu.runtime.stages import stage_task_count, topo_order
+
+        order = topo_order(self.subplan)
+        task_counts = {
+            sp.fragment.id: stage_task_count(
+                sp, len(self.workers), self.hash_partitions
+            )
+            for sp in order
+        }
         consumer_counts: Dict[int, int] = {}
         for sp in order:
             for c in sp.children:
@@ -74,38 +80,16 @@ class FaultTolerantQueryScheduler:
         root_key = self.committed[(self.subplan.fragment.id, 0)]
         return root_handle, root_key
 
-    def _topo(self, sp: SubPlan, out: List[SubPlan]) -> None:
-        for c in sp.children:
-            self._topo(c, out)
-        out.append(sp)
-
-    def _task_count(self, sp: SubPlan) -> int:
-        p = sp.fragment.partitioning
-        if p == "single":
-            return 1
-        if p == "source":
-            return max(1, len(self.workers))
-        return self.hash_partitions
-
-    def _fragment_schema(self, sp: SubPlan) -> list:
-        from trino_tpu.sql.local_planner import LocalPlanner
-
-        remote = {
-            c.fragment.id: self._schemas[c.fragment.id] for c in sp.children
-        }
-        planner = LocalPlanner(
-            self.catalogs,
-            batch_rows=self.session.batch_rows,
-            remote_schemas=remote,
-        )
-        return planner.plan(sp.fragment.root).schema
-
     def _run_stage(self, sp: SubPlan, tc: int, n_out: int):
+        from trino_tpu.runtime.stages import fragment_schema
+
         f = sp.fragment
-        self._schemas[f.id] = self._fragment_schema(sp)
         remote = {
             c.fragment.id: self._schemas[c.fragment.id] for c in sp.children
         }
+        self._schemas[f.id] = fragment_schema(
+            self.catalogs, self.session, sp, remote
+        )
         input_locations = {
             c.fragment.id: [
                 ("spool", self.spool_dir, self.committed[(c.fragment.id, p)])
@@ -144,7 +128,20 @@ class FaultTolerantQueryScheduler:
                     target_splits=max(self.session.target_splits, tc),
                     spool_dir=self.spool_dir,
                 )
-                handle.create_task(spec)
+                try:
+                    handle.create_task(spec)
+                except Exception as exc:
+                    # launch failure == task failure: re-queue on another
+                    # node, same retry budget (the status-failure path)
+                    if attempt + 1 > self.max_task_retries:
+                        raise TaskRetriesExceeded(
+                            f"task {task_id} could not launch after "
+                            f"{attempt + 1} attempts: {exc}"
+                        )
+                    self.retries += 1
+                    avoid[p] = handle
+                    pending[p] = attempt + 1
+                    continue
                 running[p] = (handle, str(task_id), attempt)
             # poll
             time.sleep(0.01)

@@ -1,0 +1,154 @@
+"""Memory accounting: pools, contexts, revocation.
+
+Analogue of lib/trino-memory-context (LocalMemoryContext /
+AggregatedMemoryContext), main/memory/ MemoryPool and the revocable-
+memory protocol (Operator.startMemoryRevoke, Operator.java:60–81;
+MemoryRevokingScheduler, main/execution/MemoryRevokingScheduler.java —
+SURVEY.md §5.4). TPU mapping: "user memory" tracks HBM-resident batch
+state (group tables, build sides, sort buffers); revoking moves state to
+host/disk through the spiller, the HBM->DRAM/SSD eviction path.
+
+Simplifications kept honest: reservation is synchronous (reserve either
+fits, triggers revocation, or raises ExceededMemoryLimitError — the
+blocked-future form arrives with async drivers)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+
+class ExceededMemoryLimitError(RuntimeError):
+    pass
+
+
+class MemoryPool:
+    """A byte budget shared by all operators of a query/worker
+    (main/memory/MemoryPool.java). Revocation targets registered
+    revocable contexts largest-first until the reservation fits."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = max_bytes
+        self._reserved = 0
+        self._lock = threading.Lock()
+        # context id -> (revocable bytes, revoke callback)
+        self._revocable: Dict[int, tuple] = {}
+        self._next_id = 0
+
+    @property
+    def reserved_bytes(self) -> int:
+        return self._reserved
+
+    def free_bytes(self) -> int:
+        return self.max_bytes - self._reserved
+
+    def try_reserve(self, bytes_: int) -> bool:
+        with self._lock:
+            if self._reserved + bytes_ > self.max_bytes:
+                return False
+            self._reserved += bytes_
+            return True
+
+    def reserve(self, bytes_: int, for_ctx: Optional[int] = None) -> None:
+        """Reserve, revoking others' revocable memory if needed
+        (MemoryRevokingScheduler's revoke-largest-first policy)."""
+        if self.try_reserve(bytes_):
+            return
+        # revoke largest revocable contexts until it fits
+        while True:
+            with self._lock:
+                candidates = [
+                    (cid, rb, cb)
+                    for cid, (rb, cb) in self._revocable.items()
+                    if rb > 0 and cid != for_ctx
+                ]
+            if not candidates:
+                break
+            cid, rb, cb = max(candidates, key=lambda t: t[1])
+            cb()  # operator spills and releases its revocable bytes
+            if self.try_reserve(bytes_):
+                return
+        if self.try_reserve(bytes_):
+            return
+        raise ExceededMemoryLimitError(
+            f"cannot reserve {bytes_} bytes "
+            f"(reserved {self._reserved}/{self.max_bytes})"
+        )
+
+    def free(self, bytes_: int) -> None:
+        with self._lock:
+            self._reserved -= bytes_
+            assert self._reserved >= 0, "double free in memory pool"
+
+    # -- revocable registry --
+    def register_revocable(self, revoke: Callable[[], None]) -> int:
+        with self._lock:
+            cid = self._next_id
+            self._next_id += 1
+            self._revocable[cid] = (0, revoke)
+            return cid
+
+    def set_revocable(self, cid: int, bytes_: int) -> None:
+        with self._lock:
+            _, cb = self._revocable[cid]
+            self._revocable[cid] = (bytes_, cb)
+
+    def unregister_revocable(self, cid: int) -> None:
+        with self._lock:
+            self._revocable.pop(cid, None)
+
+
+class MemoryContext:
+    """Per-operator accounting handle (LocalMemoryContext analogue):
+    setBytes semantics — the operator reports its current footprint and
+    the delta hits the pool."""
+
+    def __init__(self, pool: MemoryPool, revoke: Optional[Callable[[], None]] = None):
+        self.pool = pool
+        self._bytes = 0
+        self._revocable_bytes = 0
+        self._cid = (
+            pool.register_revocable(revoke) if revoke is not None else None
+        )
+
+    def set_revoker(self, revoke: Callable[[], None]) -> None:
+        """Late-bind the revoke callback (operators register themselves
+        after construction — Operator.startMemoryRevoke wiring)."""
+        assert self._cid is None, "revoker already set"
+        self._cid = self.pool.register_revocable(revoke)
+
+    @property
+    def reserved_bytes(self) -> int:
+        return self._bytes
+
+    def set_bytes(self, bytes_: int) -> None:
+        delta = bytes_ - self._bytes
+        if delta > 0:
+            self.pool.reserve(delta, for_ctx=self._cid)
+        elif delta < 0:
+            self.pool.free(-delta)
+        self._bytes = bytes_
+
+    def set_revocable_bytes(self, bytes_: int) -> None:
+        """The portion of this context's footprint a revoke() can free
+        (spillable state)."""
+        assert self._cid is not None, "context registered without revoke"
+        self._revocable_bytes = bytes_
+        self.pool.set_revocable(self._cid, bytes_)
+
+    def close(self) -> None:
+        self.set_bytes(0)
+        if self._cid is not None:
+            self.pool.unregister_revocable(self._cid)
+
+
+def batch_bytes(batch) -> int:
+    """Device footprint of a RelBatch (capacity x dtype widths +
+    masks)."""
+    n = batch.capacity
+    total = n  # live mask (bool)
+    for c in batch.columns:
+        total += n * c.data.dtype.itemsize
+        if c.valid is not None:
+            total += n
+    return total

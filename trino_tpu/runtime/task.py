@@ -101,7 +101,8 @@ class TaskExecution:
     exposes its OutputBuffer for consumers (TaskStateMachine states
     collapsed to PLANNED/RUNNING/FINISHED/FAILED)."""
 
-    def __init__(self, spec: TaskSpec, catalogs, failure_injector=None):
+    def __init__(self, spec: TaskSpec, catalogs, failure_injector=None,
+                 memory_pool=None):
         self.spec = spec
         if spec.spool_dir is not None:
             from trino_tpu.runtime.spool import SpoolingExchangeSink
@@ -116,6 +117,7 @@ class TaskExecution:
         self._clients: List[DirectExchangeClient] = []
         self._catalogs = catalogs
         self._injector = failure_injector
+        self._memory_pool = memory_pool
         self._thread: Optional[threading.Thread] = None
 
     # -- lifecycle --
@@ -162,6 +164,8 @@ class TaskExecution:
             )
             physical = planner.plan(spec.fragment.root)
             ctx = {"make_remote_source": self._make_remote_source}
+            if self._memory_pool is not None:
+                ctx["memory_pool"] = self._memory_pool
             pipelines, chain = physical.instantiate(ctx)
             sink_buffer = self.buffer
             if self._injector is not None:

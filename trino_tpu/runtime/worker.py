@@ -23,10 +23,16 @@ class Worker:
         worker_id: str,
         catalogs: Optional[CatalogManager] = None,
         failure_injector=None,
+        memory_pool_bytes: Optional[int] = None,
     ):
         self.worker_id = worker_id
         self.catalogs = catalogs or CatalogManager()
         self.failure_injector = failure_injector
+        self.memory_pool = None
+        if memory_pool_bytes is not None:
+            from trino_tpu.runtime.memory import MemoryPool
+
+            self.memory_pool = MemoryPool(memory_pool_bytes)
         self._tasks: Dict[str, TaskExecution] = {}
         self._lock = threading.Lock()
 
@@ -37,7 +43,9 @@ class Worker:
             existing = self._tasks.get(key)
             if existing is not None:
                 return existing  # idempotent re-delivery
-            task = TaskExecution(spec, self.catalogs, self.failure_injector)
+            task = TaskExecution(
+                spec, self.catalogs, self.failure_injector, self.memory_pool
+            )
             self._tasks[key] = task
         task.start()
         return task

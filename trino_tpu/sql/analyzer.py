@@ -366,6 +366,44 @@ class ExprConverter:
         if name == "coalesce":
             out = _unify_types([a.type for a in args])
             return ir.Call(name, args, out)
+        if name == "concat":
+            return ir.Call("concat", args, T.VARCHAR)
+        if name in ("trim", "ltrim", "rtrim", "reverse"):
+            return ir.Call(name, args, T.VARCHAR)
+        if name == "replace":
+            return ir.Call(name, args, T.VARCHAR)
+        if name == "starts_with":
+            return ir.Call(name, args, T.BOOLEAN)
+        if name == "nullif":
+            if len(args) != 2:
+                raise AnalysisError("nullif() takes two arguments")
+            return ir.Call(name, args, args[0].type)
+        if name in ("greatest", "least"):
+            out = _unify_types([a.type for a in args])
+            cast_args = tuple(
+                a if a.type == out else ir.Cast(a, out) for a in args
+            )
+            return ir.Call(name, cast_args, out)
+        if name in ("power", "pow"):
+            return ir.Call("power", args, T.DOUBLE)
+        if name in ("log2", "log10"):
+            return ir.Call(name, args, T.DOUBLE)
+        if name == "sign":
+            out = T.DOUBLE if args[0].type.is_floating else T.BIGINT
+            return ir.Call(name, args, out)
+        if name == "mod":
+            out_t = _arith_type("mod", args[0].type, args[1].type)
+            return ir.Call("mod", args, out_t)
+        if name in ("year", "month", "day"):
+            return ir.Call(f"extract_{name}", args, T.BIGINT)
+        if name == "if":
+            if len(args) not in (2, 3):
+                raise AnalysisError("if() takes 2 or 3 arguments")
+            default = args[2] if len(args) == 3 else None
+            out = _unify_types(
+                [args[1].type] + ([default.type] if default is not None else [])
+            )
+            return ir.Case((args[0],), (args[1],), default, out)
         raise AnalysisError(f"unknown function {name}()")
 
 
@@ -439,6 +477,32 @@ def _scalar_subqueries(e: ast.Expression) -> List[ast.ScalarSubquery]:
             out.append(x)
             return
         if isinstance(x, (ast.Exists, ast.InSubquery)):
+            return
+        if dataclasses.is_dataclass(x):
+            for f in dataclasses.fields(x):
+                walk(getattr(x, f.name))
+        elif isinstance(x, tuple):
+            for i in x:
+                walk(i)
+
+    walk(e)
+    return out
+
+
+WINDOW_ONLY_FUNCS = {
+    "row_number", "rank", "dense_rank", "ntile", "lead", "lag",
+    "first_value", "last_value",
+}
+
+
+def _find_window_calls(e: ast.Expression) -> List[ast.WindowCall]:
+    out: List[ast.WindowCall] = []
+
+    def walk(x):
+        if isinstance(x, ast.WindowCall):
+            out.append(x)
+            return
+        if isinstance(x, (ast.Exists, ast.InSubquery, ast.ScalarSubquery)):
             return
         if dataclasses.is_dataclass(x):
             for f in dataclasses.fields(x):
@@ -568,21 +632,55 @@ class Analyzer:
                     raise AnalysisError(
                         f"set operation column types differ: {lf.type} vs {rf.type}"
                     )
-            if s.op != "union":
-                raise AnalysisError(f"{s.op} not yet supported")
             fields = ln.fields
-            node: P.PlanNode = P.UnionAllNode((ln, rn), fields)
-            if not s.all:
-                node = P.AggregateNode(
-                    node, tuple(range(len(fields))), (), fields
+            if s.op == "union":
+                node: P.PlanNode = P.UnionAllNode((ln, rn), fields)
+                if not s.all:
+                    node = P.AggregateNode(
+                        node, tuple(range(len(fields))), (), fields
+                    )
+            else:
+                # INTERSECT/EXCEPT via dedup + semi/anti join on all
+                # columns (the SetOperationNodeTranslator strategy).
+                # Deviation: NULL rows follow join semantics (never
+                # match), not the standard's NULLs-equal grouping.
+                if s.all:
+                    raise AnalysisError(f"{s.op} ALL not supported")
+                w = len(fields)
+                dedup = P.AggregateNode(ln, tuple(range(w)), (), fields)
+                kind = "semi" if s.op == "intersect" else "anti"
+                node = P.JoinNode(
+                    kind, dedup, rn, tuple(range(w)), tuple(range(w)),
+                    None, fields,
                 )
             return node, Scope([ScopeField(None, f.name, f.type) for f in fields]), lnames
 
         node, scope, names = plan_set(q.body)
-        if q.order_by or q.limit is not None or q.offset:
-            raise AnalysisError(
-                "ORDER BY/LIMIT/OFFSET over set operations not yet supported"
-            )
+        # ORDER BY / LIMIT / OFFSET over the set operation's output
+        sort_keys: List[SortKey] = []
+        for s in q.order_by:
+            ch = None
+            if isinstance(s.expr, ast.NumberLiteral) and s.expr.text.isdigit():
+                ch = int(s.expr.text) - 1
+            elif isinstance(s.expr, ast.Identifier) and len(s.expr.parts) == 1:
+                name = s.expr.parts[0]
+                if name in names:
+                    ch = names.index(name)
+            if ch is None or not (0 <= ch < len(names)):
+                raise AnalysisError(
+                    "ORDER BY over set operations must reference output columns"
+                )
+            nf = s.nulls_first if s.nulls_first is not None else s.descending
+            sort_keys.append(SortKey(ch, s.descending, nf))
+        if sort_keys:
+            if q.limit is not None and not q.offset:
+                node = P.TopNNode(node, tuple(sort_keys), q.limit, node.fields)
+            else:
+                node = P.SortNode(node, tuple(sort_keys), node.fields)
+                if q.limit is not None or q.offset:
+                    node = P.LimitNode(node, q.limit, q.offset, node.fields)
+        elif q.limit is not None or q.offset:
+            node = P.LimitNode(node, q.limit, q.offset, node.fields)
         return node, scope, names
 
     # ---- the heart: one SELECT block ----
@@ -615,6 +713,16 @@ class Analyzer:
             self._plan_aggregation(builder, group_asts, agg_calls, ctes)
             if spec.having is not None:
                 self._plan_predicate(builder, spec.having, ctes)
+
+        # -- window functions (evaluated after aggregation, like Trino's
+        # WindowNode above the AggregationNode) --
+        window_calls: List[ast.WindowCall] = []
+        for e in select_exprs + [s.expr for s in order_by]:
+            for c in _find_window_calls(e):
+                if c not in window_calls:
+                    window_calls.append(c)
+        if window_calls:
+            self._plan_windows(builder, window_calls)
 
         # -- select projection (+ hidden order-by channels) --
         conv = builder.converter()
@@ -1266,6 +1374,97 @@ class Analyzer:
             replacements[call] = (k + j, a.out_type)
         builder.scope = Scope(post_fields)
         builder.replacements = replacements
+
+    def _plan_windows(self, builder: Builder, calls: List[ast.WindowCall]) -> None:
+        """Plan WindowNodes: one per distinct (partition, order, frame)
+        spec, functions sharing a spec computed together (Trino merges
+        window specs the same way in PlanWindowFunctions). Each call's
+        result channel is registered as a replacement so SELECT/ORDER BY
+        conversion sees a plain channel reference."""
+        by_spec: Dict[ast.WindowSpec, List[ast.WindowCall]] = {}
+        for c in calls:
+            by_spec.setdefault(c.spec, []).append(c)
+        for spec, group in by_spec.items():
+            conv = builder.converter()
+            width = len(builder.scope)
+            # pre-projection: identity + partition keys + order keys + args
+            pre_exprs: List[ir.Expr] = [
+                ir.InputRef(i, f.type) for i, f in enumerate(builder.scope.fields)
+            ]
+
+            def channel_of(e: ast.Expression) -> int:
+                x = conv.convert(e)
+                if isinstance(x, ir.InputRef):
+                    return x.index
+                pre_exprs.append(x)
+                return len(pre_exprs) - 1
+
+            part_channels = tuple(channel_of(e) for e in spec.partition_by)
+            order_keys = []
+            for s in spec.order_by:
+                ch = channel_of(s.expr)
+                nf = s.nulls_first if s.nulls_first is not None else s.descending
+                order_keys.append(SortKey(ch, s.descending, nf))
+            functions: List[P.WindowFuncSpec] = []
+            for c in group:
+                functions.append(self._window_func(c, channel_of, conv))
+            pre_fields = tuple(
+                P.Field(None, e.type) for e in pre_exprs
+            )
+            pre = P.ProjectNode(builder.node, tuple(pre_exprs), pre_fields)
+            out_fields = pre_fields + tuple(
+                P.Field(None, f.out_type) for f in functions
+            )
+            builder.node = P.WindowNode(
+                pre, part_channels, tuple(order_keys), tuple(functions),
+                spec.frame, out_fields,
+            )
+            new_fields = list(builder.scope.fields)
+            for e in pre_exprs[width:]:
+                new_fields.append(ScopeField(None, None, e.type))
+            for i, (c, f) in enumerate(zip(group, functions)):
+                new_fields.append(ScopeField(None, None, f.out_type))
+                builder.replacements[c] = (len(pre_exprs) + i, f.out_type)
+            builder.scope = Scope(new_fields)
+
+    def _window_func(self, c: ast.WindowCall, channel_of, conv) -> P.WindowFuncSpec:
+        name = c.name
+        if name in ("row_number", "rank", "dense_rank"):
+            if c.args:
+                raise AnalysisError(f"{name}() takes no arguments")
+            return P.WindowFuncSpec(name, None, T.BIGINT)
+        if name == "ntile":
+            n = c.args[0] if c.args else None
+            if not isinstance(n, ast.NumberLiteral) or not n.text.isdigit():
+                raise AnalysisError("ntile() requires a literal integer")
+            return P.WindowFuncSpec("ntile", None, T.BIGINT, offset=int(n.text))
+        if name in ("lead", "lag"):
+            if not c.args:
+                raise AnalysisError(f"{name}() requires an argument")
+            ch = channel_of(c.args[0])
+            off = 1
+            if len(c.args) > 1:
+                a1 = c.args[1]
+                if not isinstance(a1, ast.NumberLiteral) or not a1.text.isdigit():
+                    raise AnalysisError(f"{name}() offset must be a literal integer")
+                off = int(a1.text)
+            if len(c.args) > 2:
+                raise AnalysisError(f"{name}() default values not supported")
+            t = conv.convert(c.args[0]).type
+            return P.WindowFuncSpec(name, ch, t, offset=off)
+        if name in ("first_value", "last_value"):
+            ch = channel_of(c.args[0])
+            t = conv.convert(c.args[0]).type
+            return P.WindowFuncSpec(name, ch, t)
+        if name == "count":
+            if not c.args or isinstance(c.args[0], ast.Star):
+                return P.WindowFuncSpec("count_star", None, T.BIGINT)
+            return P.WindowFuncSpec("count", channel_of(c.args[0]), T.BIGINT)
+        if name in ("sum", "avg", "min", "max"):
+            ch = channel_of(c.args[0])
+            t = conv.convert(c.args[0]).type
+            return P.WindowFuncSpec(name, ch, self._agg_out_type(name, t))
+        raise AnalysisError(f"unknown window function {name}()")
 
     @staticmethod
     def _agg_out_type(kind: str, arg_t: T.DataType) -> T.DataType:

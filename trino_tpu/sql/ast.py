@@ -152,6 +152,29 @@ class FunctionCall(Expression):
 
 
 @dataclasses.dataclass(frozen=True)
+class WindowSpec(Node):
+    """OVER (PARTITION BY ... ORDER BY ... [frame]) — parser/sql/tree/
+    WindowSpecification analogue. frame: "range" (default: current row
+    + peers), "rows" (UNBOUNDED PRECEDING..CURRENT ROW) or "partition"
+    (UNBOUNDED..UNBOUNDED, or no ORDER BY)."""
+
+    partition_by: Tuple[Expression, ...] = ()
+    order_by: Tuple["SortItem", ...] = ()
+    frame: str = "range"
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowCall(Expression):
+    """A window function invocation: fn(args) OVER spec. Deliberately a
+    separate node from FunctionCall so aggregate detection never
+    confuses sum(x) OVER (...) with the aggregate sum(x)."""
+
+    name: str
+    args: Tuple[Expression, ...]
+    spec: WindowSpec
+
+
+@dataclasses.dataclass(frozen=True)
 class Extract(Expression):
     field: str  # year/month/day
     operand: Expression

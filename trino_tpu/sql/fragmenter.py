@@ -207,6 +207,23 @@ class _AddExchanges:
         )
         return final, hash_dist(tuple(range(k)))
 
+    def _WindowNode(self, node):
+        child, dist = self.visit(node.child)
+        if not is_distributed(dist):
+            return dataclasses.replace(node, child=child), dist
+        keys = tuple(node.partition_channels)
+        if not keys:
+            # no PARTITION BY: the whole input is one window partition
+            child = _gather(child)
+            return dataclasses.replace(node, child=child), SINGLE
+        if dist != hash_dist(keys):
+            child = P.ExchangeNode(
+                child, "repartition", keys, tuple(node.child.fields)
+            )
+        out = dataclasses.replace(node, child=child)
+        # window appends columns; partition channel positions survive
+        return out, hash_dist(keys)
+
     # joins: partitioned or broadcast
     def _JoinNode(self, node):
         left, ldist = self.visit(node.left)

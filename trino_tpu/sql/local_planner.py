@@ -50,6 +50,17 @@ Schema = List[Tuple[T.DataType, Optional[Dictionary]]]
 Factory = Callable[[dict], Operator]
 
 
+def _mem_ctx(ctx: dict):
+    """Per-operator MemoryContext when the execution context carries a
+    pool (OperatorContext.newLocalUserMemoryContext analogue)."""
+    pool = ctx.get("memory_pool")
+    if pool is None:
+        return None
+    from trino_tpu.runtime.memory import MemoryContext
+
+    return MemoryContext(pool)
+
+
 class PhysicalPlan:
     """Cached executable form of one query: factory pipelines + the main
     chain; instantiate() stamps a fresh operator DAG."""
@@ -203,7 +214,9 @@ class LocalPlanner:
         groups = list(node.group_channels)
         step = node.step
         chain.append(
-            lambda ctx: HashAggregationOperator(groups, specs, schema, step=step)
+            lambda ctx: HashAggregationOperator(
+                groups, specs, schema, step=step, memory_context=_mem_ctx(ctx)
+            )
         )
         if step == "partial":
             from trino_tpu.exec.operators import partial_output_schema
@@ -283,10 +296,33 @@ class LocalPlanner:
             return probe_chain, probe_schema
         return probe_chain, probe_schema + build_schema
 
+    def _visit_WindowNode(self, node: P.WindowNode):
+        from trino_tpu.exec.operators import WindowOperator
+
+        chain, schema = self._visit(node.child)
+        partition = list(node.partition_channels)
+        order = list(node.order_keys)
+        fns = list(node.functions)
+        frame = node.frame
+        chain.append(
+            lambda ctx: WindowOperator(partition, order, fns, frame, schema)
+        )
+        out_schema: Schema = list(schema)
+        for f in fns:
+            d = None
+            if f.arg_channel is not None and f.kind in (
+                "lead", "lag", "first_value", "last_value", "min", "max"
+            ):
+                d = schema[f.arg_channel][1]
+            out_schema.append((f.out_type, d))
+        return chain, out_schema
+
     def _visit_SortNode(self, node: P.SortNode):
         chain, schema = self._visit(node.child)
         keys = list(node.keys)
-        chain.append(lambda ctx: SortOperator(keys, schema))
+        chain.append(
+            lambda ctx: SortOperator(keys, schema, memory_context=_mem_ctx(ctx))
+        )
         return chain, schema
 
     def _visit_TopNNode(self, node: P.TopNNode):

@@ -591,18 +591,76 @@ class Parser:
                 if name == "count" and self.at_op("*"):
                     self.next()
                     self.expect_op(")")
-                    return ast.FunctionCall("count", (ast.Star(),))
-                distinct = self.accept_kw("DISTINCT")
-                args: List[ast.Expression] = []
-                if not self.at_op(")"):
-                    args.append(self.parse_expr())
-                    while self.accept_op(","):
-                        args.append(self.parse_expr())
-                self.expect_op(")")
-                return ast.FunctionCall(name, tuple(args), distinct)
+                    args = (ast.Star(),)
+                    distinct = False
+                else:
+                    distinct = self.accept_kw("DISTINCT")
+                    arglist: List[ast.Expression] = []
+                    if not self.at_op(")"):
+                        arglist.append(self.parse_expr())
+                        while self.accept_op(","):
+                            arglist.append(self.parse_expr())
+                    self.expect_op(")")
+                    args = tuple(arglist)
+                if self.at_kw("OVER"):
+                    if distinct:
+                        raise self.error(
+                            "DISTINCT in window aggregates is not supported"
+                        )
+                    self.next()
+                    return ast.WindowCall(name, args, self._parse_window_spec())
+                return ast.FunctionCall(name, args, distinct)
             # identifier (possibly qualified)
             return ast.Identifier(self._parse_qualified_name())
         raise self.error("expected expression")
+
+    def _parse_window_spec(self) -> ast.WindowSpec:
+        """OVER (PARTITION BY ... ORDER BY ... [ROWS|RANGE frame])
+        (SqlBase.g4 windowSpecification). Frames beyond the three
+        UNBOUNDED/CURRENT-ROW shapes are rejected at parse time."""
+        self.expect_op("(")
+        partition: List[ast.Expression] = []
+        if self.accept_kw("PARTITION"):
+            self.expect_kw("BY")
+            partition.append(self.parse_expr())
+            while self.accept_op(","):
+                partition.append(self.parse_expr())
+        order: List[ast.SortItem] = []
+        if self.accept_kw("ORDER"):
+            self.expect_kw("BY")
+            order.append(self._parse_sort_item())
+            while self.accept_op(","):
+                order.append(self._parse_sort_item())
+        frame = "range" if order else "partition"
+        if self.at_kw("ROWS", "RANGE", "GROUPS"):
+            unit = self.next().upper
+            if unit == "GROUPS":
+                raise self.error("GROUPS frames not supported")
+
+            def bound() -> str:
+                if self.accept_kw("UNBOUNDED"):
+                    if self.accept_kw("PRECEDING"):
+                        return "unbounded_preceding"
+                    self.expect_kw("FOLLOWING")
+                    return "unbounded_following"
+                self.expect_kw("CURRENT")
+                self.expect_kw("ROW")
+                return "current_row"
+
+            if self.accept_kw("BETWEEN"):
+                start = bound()
+                self.expect_kw("AND")
+                end = bound()
+            else:
+                start, end = bound(), "current_row"
+            if start != "unbounded_preceding":
+                raise self.error("only UNBOUNDED PRECEDING frame starts supported")
+            if end == "unbounded_following":
+                frame = "partition"
+            else:
+                frame = "rows" if unit == "ROWS" else "range"
+        self.expect_op(")")
+        return ast.WindowSpec(tuple(partition), tuple(order), frame)
 
     def _parse_case(self) -> ast.Expression:
         self.expect_kw("CASE")

@@ -122,6 +122,36 @@ class JoinNode(PlanNode):
 
 
 @dataclasses.dataclass(frozen=True)
+class WindowFuncSpec:
+    """One window function: kind in {row_number, rank, dense_rank, ntile,
+    lead, lag, first_value, last_value, sum, avg, min, max, count,
+    count_star}; arg_channel indexes the child schema (None for rank
+    family / count_star); `offset` is lead/lag's offset or ntile's n."""
+
+    kind: str
+    arg_channel: Optional[int]
+    out_type: T.DataType
+    offset: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowNode(PlanNode):
+    """Window functions over (partition, order) — WindowNode analogue.
+    Output schema = child fields + one field per function. `frame`:
+    "range" | "rows" | "partition" (ops/window.py semantics)."""
+
+    child: PlanNode
+    partition_channels: Tuple[int, ...]
+    order_keys: Tuple[SortKey, ...]
+    functions: Tuple[WindowFuncSpec, ...]
+    frame: str
+    fields: Tuple[Field, ...]
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
 class SortNode(PlanNode):
     child: PlanNode
     keys: Tuple[SortKey, ...]
