@@ -1,0 +1,139 @@
+"""Client protocol, CLI, session properties, config, resource groups
+(SURVEY.md §2.11, §5.6, §2.3)."""
+
+import threading
+import time
+
+import pytest
+
+from trino_tpu.client import Client, QueryError
+from trino_tpu.cli import format_table
+from trino_tpu.config import SYSTEM_PROPERTIES, load_properties_file
+from trino_tpu.connectors.tpch import create_tpch_connector
+from trino_tpu.engine import LocalQueryRunner, Session
+from trino_tpu.runtime.resource_groups import (
+    QueryQueueFullError,
+    ResourceGroupManager,
+    ResourceGroupSpec,
+    Selector,
+)
+from trino_tpu.runtime.server import CoordinatorServer
+
+
+@pytest.fixture(scope="module")
+def server():
+    lq = LocalQueryRunner(Session(catalog="tpch", schema="tiny"))
+    lq.register_catalog("tpch", create_tpch_connector())
+    srv = CoordinatorServer(lq)
+    yield srv
+    srv.stop()
+
+
+def test_client_roundtrip(server):
+    c = Client(server.uri)
+    r = c.execute(
+        "select n_regionkey, count(*) c from nation group by n_regionkey order by 1"
+    )
+    assert r.column_names == ["n_regionkey", "c"]
+    assert r.rows == [[i, 5] for i in range(5)]
+
+
+def test_client_error_propagates(server):
+    c = Client(server.uri)
+    with pytest.raises(QueryError, match="does not exist"):
+        c.execute("select * from tpch.tiny.nope")
+
+
+def test_client_pagination(server):
+    c = Client(server.uri)
+    r = c.execute("select o_orderkey from orders order by o_orderkey")
+    assert len(r.rows) == 15000
+    assert r.rows[0] == [1]
+
+
+def test_cli_format_table():
+    out = format_table(["a", "bb"], [[1, None], [22, "x"]])
+    lines = out.splitlines()
+    assert lines[0].split("|")[0].strip() == "a"
+    assert "NULL" in out
+    assert "(2 rows)" in out
+
+
+# -- session properties / config --
+
+
+def test_set_show_session():
+    lq = LocalQueryRunner(Session(catalog="tpch", schema="tiny"))
+    lq.register_catalog("tpch", create_tpch_connector())
+    lq.execute("SET SESSION batch_rows = 8192")
+    assert lq.session.batch_rows == 8192
+    lq.execute("SET SESSION enable_dynamic_filtering = false")
+    assert lq.session.enable_dynamic_filtering is False
+    rows = lq.execute("SHOW SESSION").rows
+    names = [r[0] for r in rows]
+    assert "batch_rows" in names and "retry_policy" in names
+    with pytest.raises(Exception):
+        lq.execute("SET SESSION no_such_prop = 1")
+
+
+def test_property_registry_validation():
+    assert SYSTEM_PROPERTIES.validate("batch_rows", "4096") == 4096
+    assert SYSTEM_PROPERTIES.validate("enable_dynamic_filtering", "false") is False
+    with pytest.raises(ValueError):
+        SYSTEM_PROPERTIES.validate("retry_policy", 7)
+
+
+def test_load_properties_file(tmp_path):
+    p = tmp_path / "config.properties"
+    p.write_text("# comment\nbatch_rows=1024\nretry_policy = task\n\n")
+    props = load_properties_file(str(p))
+    assert props == {"batch_rows": "1024", "retry_policy": "task"}
+
+
+# -- resource groups --
+
+
+def test_resource_group_concurrency_and_queue():
+    mgr = ResourceGroupManager(
+        ResourceGroupSpec("global", max_concurrency=1, max_queued=1)
+    )
+    lease1 = mgr.acquire()
+    assert mgr.stats()["global"][0] == 1
+    # second query queues; third is rejected (queue full)
+    entered = threading.Event()
+    released = []
+
+    def second():
+        entered.set()
+        lease = mgr.acquire(timeout=10)
+        released.append(lease)
+
+    t = threading.Thread(target=second, daemon=True)
+    t.start()
+    entered.wait()
+    time.sleep(0.05)  # let it enter the queue
+    with pytest.raises(QueryQueueFullError):
+        mgr.acquire(timeout=0.01)
+    mgr.release(lease1)
+    t.join(5)
+    assert released
+    mgr.release(released[0])
+    assert mgr.stats()["global"] == (0, 0)
+
+
+def test_resource_group_selectors():
+    spec = ResourceGroupSpec(
+        "global",
+        max_concurrency=10,
+        sub_groups=[ResourceGroupSpec("etl", max_concurrency=1)],
+    )
+    mgr = ResourceGroupManager(
+        spec, [Selector(("global", "etl"), user_pattern="etl-.*")]
+    )
+    lease = mgr.acquire(user="etl-nightly")
+    assert mgr.stats()["global.etl"][0] == 1
+    # non-matching user routes to the root group
+    lease2 = mgr.acquire(user="alice")
+    assert mgr.stats()["global"][0] == 2
+    mgr.release(lease)
+    mgr.release(lease2)

@@ -1,0 +1,106 @@
+"""Stats propagation + cost-based decisions (main/cost/ analogue,
+SURVEY.md §2.2): estimates vs actual row counts, broadcast decisions,
+adaptive partition counts."""
+
+import pytest
+
+from trino_tpu.connectors.spi import CatalogManager
+from trino_tpu.connectors.tpch import create_tpch_connector
+from trino_tpu.sql.analyzer import Analyzer
+from trino_tpu.sql.fragmenter import plan_distributed
+from trino_tpu.sql.parser import parse
+from trino_tpu.sql.stats import StatsCalculator, determine_partition_count
+
+
+@pytest.fixture(scope="module")
+def catalogs():
+    c = CatalogManager()
+    c.register("tpch", create_tpch_connector())
+    return c
+
+
+@pytest.fixture(scope="module")
+def estimator(catalogs):
+    an = Analyzer(catalogs, "tpch", "tiny")
+    calc = StatsCalculator(catalogs)
+
+    def est(sql: str) -> float:
+        return calc.stats(an.plan(parse(sql))).row_count
+
+    return est
+
+
+# (sql, actual rows at tiny/sf0.01, allowed relative error)
+CASES = [
+    ("select * from lineitem", 60064, 0.01),
+    ("select * from orders", 15000, 0.01),
+    (
+        "select * from lineitem where l_shipdate <= date '1998-09-02'",
+        59144, 0.10,
+    ),
+    ("select * from lineitem where l_quantity < 24", 27885, 0.10),
+    (
+        "select * from orders, customer where o_custkey = c_custkey",
+        15000, 0.05,
+    ),
+    (
+        "select * from lineitem, orders where l_orderkey = o_orderkey",
+        60064, 0.05,
+    ),
+    (
+        "select l_returnflag, count(*) from lineitem group by l_returnflag",
+        3, 0.01,
+    ),
+    (
+        "select l_orderkey, count(*) from lineitem group by l_orderkey",
+        15000, 0.05,
+    ),
+]
+
+
+@pytest.mark.parametrize("sql,actual,tol", CASES)
+def test_estimate_accuracy(sql, actual, tol, estimator):
+    est = estimator(sql)
+    assert abs(est - actual) <= max(actual * tol, 2), (est, actual)
+
+
+def test_determine_partition_count():
+    assert determine_partition_count(100, 64) == 1
+    assert determine_partition_count(3.2e6, 64) == 4
+    assert determine_partition_count(1e12, 64) == 64
+
+
+def test_broadcast_vs_partitioned(catalogs):
+    an = Analyzer(catalogs, "tpch", "tiny")
+    # nation build side (25 rows) -> broadcast
+    sp = plan_distributed(
+        an.plan(parse(
+            "select * from supplier, nation where s_nationkey = n_nationkey"
+        )),
+        catalogs,
+    )
+    assert "broadcast" in {f.output_kind for f in sp.all_fragments()}
+    # force partitioned with a tiny threshold
+    sp2 = plan_distributed(
+        an.plan(parse(
+            "select * from supplier, nation where s_nationkey = n_nationkey"
+        )),
+        catalogs,
+        broadcast_threshold=10,
+    )
+    hash_outs = [f for f in sp2.all_fragments() if f.output_kind == "hash"]
+    assert len(hash_outs) == 2  # both sides repartitioned
+
+
+def test_suggested_partitions_annotated(catalogs):
+    an = Analyzer(catalogs, "tpch", "tiny")
+    sp = plan_distributed(
+        an.plan(parse(
+            "select l_orderkey, sum(l_quantity) from lineitem group by l_orderkey"
+        )),
+        catalogs,
+    )
+    hash_frags = [f for f in sp.all_fragments() if f.partitioning == "hash"]
+    assert hash_frags and all(
+        f.suggested_partitions is not None for f in hash_frags
+    )

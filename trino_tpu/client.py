@@ -1,0 +1,67 @@
+"""Python client for the statement protocol.
+
+Analogue of client/trino-client's StatementClientV1 (StatementClientV1.
+java:65, advance():334 — POST /v1/statement then follow nextUri until
+the results are exhausted; SURVEY.md §2.11)."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+import urllib.request
+from typing import List, Optional
+
+
+class QueryError(RuntimeError):
+    pass
+
+
+@dataclasses.dataclass
+class ClientResult:
+    query_id: str
+    columns: List[dict]
+    rows: List[list]
+
+    @property
+    def column_names(self) -> List[str]:
+        return [c["name"] for c in self.columns]
+
+
+class Client:
+    def __init__(self, uri: str, timeout: float = 60.0, poll_interval: float = 0.05):
+        self.uri = uri.rstrip("/")
+        self.timeout = timeout
+        self.poll_interval = poll_interval
+
+    def _request(self, method: str, url: str, body: Optional[bytes] = None) -> dict:
+        req = urllib.request.Request(url, data=body, method=method)
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return json.loads(r.read())
+
+    def execute(self, sql: str) -> ClientResult:
+        """Submit and drain: the StatementClientV1 polling loop."""
+        out = self._request(
+            "POST", f"{self.uri}/v1/statement", sql.encode("utf-8")
+        )
+        columns: List[dict] = []
+        rows: List[list] = []
+        query_id = out.get("id", "")
+        deadline = time.monotonic() + self.timeout
+        while True:
+            if "error" in out:
+                raise QueryError(out["error"].get("message", "query failed"))
+            if out.get("columns"):
+                columns = out["columns"]
+            rows.extend(out.get("data", ()))
+            next_uri = out.get("nextUri")
+            if next_uri is None:
+                return ClientResult(query_id, columns, rows)
+            if time.monotonic() > deadline:
+                raise QueryError(f"query {query_id} timed out client-side")
+            if not out.get("data"):
+                time.sleep(self.poll_interval)
+            out = self._request("GET", next_uri)
+
+    def cancel(self, query_id: str) -> None:
+        self._request("DELETE", f"{self.uri}/v1/statement/executing/{query_id}")

@@ -1,0 +1,111 @@
+"""Config + session-property system.
+
+Analogue of airlift @Config binding (etc/config.properties -> typed
+config objects; 353 @Config annotations in trino-main) and the typed
+session-property registry (main/SystemSessionProperties.java, ~200
+properties — SURVEY.md §5.6). Properties are declared once with type +
+default + description; SET SESSION goes through `validate`, and config
+files bind by the same registry."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class PropertyMetadata:
+    name: str
+    type: type  # bool | int | float | str
+    default: Any
+    description: str
+    allowed: Optional[tuple] = None  # enum-valued string properties
+
+    def parse(self, text: str) -> Any:
+        if self.type is bool:
+            if text.lower() in ("true", "1", "on"):
+                return True
+            if text.lower() in ("false", "0", "off"):
+                return False
+            raise ValueError(f"{self.name}: expected boolean, got {text!r}")
+        return self.type(text)
+
+
+class PropertyRegistry:
+    def __init__(self):
+        self._props: Dict[str, PropertyMetadata] = {}
+
+    def register(
+        self, name: str, type_: type, default, description: str,
+        allowed: Optional[tuple] = None,
+    ) -> None:
+        self._props[name] = PropertyMetadata(
+            name, type_, default, description, allowed
+        )
+
+    def validate(self, name: str, value: Any) -> Any:
+        meta = self._props.get(name)
+        if meta is None:
+            raise ValueError(f"unknown session property {name!r}")
+        if isinstance(value, str) and meta.type is not str:
+            value = meta.parse(value)
+        elif not isinstance(value, meta.type):
+            raise ValueError(
+                f"{name}: expected {meta.type.__name__}, got {type(value).__name__}"
+            )
+        if meta.allowed is not None and value not in meta.allowed:
+            raise ValueError(
+                f"{name}: must be one of {meta.allowed}, got {value!r}"
+            )
+        return value
+
+    def default(self, name: str) -> Any:
+        return self._props[name].default
+
+    def all(self) -> List[PropertyMetadata]:
+        return sorted(self._props.values(), key=lambda m: m.name)
+
+
+# The engine's system session properties (SystemSessionProperties
+# analogue — the switchboard the executor consults per query).
+SYSTEM_PROPERTIES = PropertyRegistry()
+for _name, _type, _default, _desc, _allowed in [
+    ("batch_rows", int, 1 << 20, "max rows per device batch", None),
+    ("target_splits", int, 1, "target connector split count per scan", None),
+    ("hash_partition_count", int, 4, "tasks per hash-distributed stage", None),
+    ("retry_policy", str, "none", "none | query | task",
+     ("none", "query", "task")),
+    ("query_retries", int, 2, "whole-query retry attempts", None),
+    ("task_retries", int, 3, "per-task retry attempts (FTE)", None),
+    ("memory_pool_bytes", int, 0, "per-query memory budget (0 = unlimited)", None),
+    ("enable_dynamic_filtering", bool, True, "probe-side join pruning", None),
+    ("broadcast_join_threshold", int, 1_000_000,
+     "max estimated build rows for a broadcast join", None),
+]:
+    SYSTEM_PROPERTIES.register(_name, _type, _default, _desc, _allowed)
+
+
+def load_properties_file(path: str) -> Dict[str, str]:
+    """key=value config file (etc/config.properties format: # comments,
+    blank lines ignored)."""
+    out: Dict[str, str] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            if "=" not in line:
+                raise ValueError(f"malformed config line: {line!r}")
+            k, v = line.split("=", 1)
+            out[k.strip()] = v.strip()
+    return out
+
+
+def bind_session(session, overrides: Dict[str, Any]) -> None:
+    """Apply validated property values onto a Session (the
+    SessionPropertyManager.validate path)."""
+    for name, value in overrides.items():
+        value = SYSTEM_PROPERTIES.validate(name, value)
+        if name == "memory_pool_bytes":
+            value = value or None  # 0 means unlimited
+        setattr(session, name, value)

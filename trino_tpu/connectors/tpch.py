@@ -734,7 +734,87 @@ class TpchMetadata(ConnectorMetadata):
             rows = float(lineitem_row_count(sf))
         else:
             rows = float(base_row_count(handle.table, sf))
-        return TableStatistics(row_count=rows)
+        return TableStatistics(
+            row_count=rows, columns=_column_statistics(handle.table, sf, rows)
+        )
+
+
+def _days(y: int, m: int, d: int) -> int:
+    import datetime
+
+    return (datetime.date(y, m, d) - datetime.date(1970, 1, 1)).days
+
+
+def _column_statistics(table: str, sf: float, rows: float):
+    """Analytic per-column (ndv, null_fraction, low, high) for the CBO —
+    the spec's value domains, like the reference's TpchMetadata statistic
+    tables (plugin/trino-tpch ... StatisticsEstimator). Dates are epoch
+    days; decimals raw values."""
+    nc, np_, ns, no = _n_customers(sf), _n_parts(sf), _n_suppliers(sf), _n_orders(sf)
+    d92, d98 = _days(1992, 1, 1), _days(1998, 12, 31)
+    stats = {
+        "region": {
+            "r_regionkey": (5, 0.0, 0, 4),
+            "r_name": (5, 0.0, None, None),
+        },
+        "nation": {
+            "n_nationkey": (25, 0.0, 0, 24),
+            "n_regionkey": (5, 0.0, 0, 4),
+            "n_name": (25, 0.0, None, None),
+        },
+        "supplier": {
+            "s_suppkey": (ns, 0.0, 1, ns),
+            "s_nationkey": (25, 0.0, 0, 24),
+            "s_acctbal": (ns, 0.0, -999.99, 9999.99),
+        },
+        "customer": {
+            "c_custkey": (nc, 0.0, 1, nc),
+            "c_nationkey": (25, 0.0, 0, 24),
+            "c_mktsegment": (5, 0.0, None, None),
+            "c_acctbal": (nc, 0.0, -999.99, 9999.99),
+        },
+        "part": {
+            "p_partkey": (np_, 0.0, 1, np_),
+            "p_size": (50, 0.0, 1, 50),
+            "p_brand": (25, 0.0, None, None),
+            "p_mfgr": (5, 0.0, None, None),
+            "p_type": (150, 0.0, None, None),
+            "p_retailprice": (np_ // 10 or 1, 0.0, 900.0, 2100.0),
+        },
+        "partsupp": {
+            "ps_partkey": (np_, 0.0, 1, np_),
+            "ps_suppkey": (ns, 0.0, 1, ns),
+            "ps_availqty": (9999, 0.0, 1, 9999),
+            "ps_supplycost": (1000, 0.0, 1.0, 1000.0),
+        },
+        "orders": {
+            "o_orderkey": (no, 0.0, 1, (no >> 3 << 5) + 8),
+            "o_custkey": (max(nc * 2 // 3, 1), 0.0, 1, nc),
+            "o_orderdate": (2406, 0.0, d92, _days(1998, 8, 2)),
+            "o_orderstatus": (3, 0.0, None, None),
+            "o_orderpriority": (5, 0.0, None, None),
+            "o_totalprice": (rows * 0.9, 0.0, 850.0, 560000.0),
+        },
+        "lineitem": {
+            "l_orderkey": (no, 0.0, 1, (no >> 3 << 5) + 8),
+            "l_partkey": (np_, 0.0, 1, np_),
+            "l_suppkey": (ns, 0.0, 1, ns),
+            "l_linenumber": (7, 0.0, 1, 7),
+            "l_quantity": (50, 0.0, 1, 50),
+            "l_discount": (11, 0.0, 0.0, 0.10),
+            "l_tax": (9, 0.0, 0.0, 0.08),
+            "l_returnflag": (3, 0.0, None, None),
+            "l_linestatus": (2, 0.0, None, None),
+            "l_shipdate": (2526, 0.0, d92 + 1, d98),
+            "l_commitdate": (2466, 0.0, d92 + 30, d98 - 30),
+            "l_receiptdate": (2554, 0.0, d92 + 2, d98 + 30),
+            "l_extendedprice": (rows * 0.5, 0.0, 900.0, 105000.0),
+        },
+    }
+    return {
+        k: (float(ndv), nf, lo, hi)
+        for k, (ndv, nf, lo, hi) in stats.get(table, {}).items()
+    }
 
 
 class TpchSplitManager(ConnectorSplitManager):

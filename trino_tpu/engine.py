@@ -41,6 +41,16 @@ class Session:
     # per-query memory budget (None = unlimited); exceeding it triggers
     # revocation/spill, then ExceededMemoryLimitError
     memory_pool_bytes: Optional[int] = None
+    hash_partition_count: int = 4
+    enable_dynamic_filtering: bool = True
+    broadcast_join_threshold: int = 1_000_000
+
+    def set_property(self, name: str, value) -> None:
+        """SET SESSION entry point — validated through the typed
+        registry (config.SYSTEM_PROPERTIES)."""
+        from trino_tpu.config import bind_session
+
+        bind_session(self, {name: value})
 
 
 @dataclasses.dataclass
@@ -64,6 +74,10 @@ class LocalQueryRunner:
         # query reuses every jitted device program (the reference's
         # expression/operator caches keyed on expression, §2.9)
         self._plan_cache: dict = {}
+        from trino_tpu.runtime.events import EventListenerManager
+
+        self.event_listeners = EventListenerManager()
+        self._query_seq = 0
 
     def register_catalog(self, name: str, connector: Connector) -> None:
         self.catalogs.register(name, connector)
@@ -72,11 +86,34 @@ class LocalQueryRunner:
     def execute(self, sql: str) -> MaterializedResult:
         stmt = parse(sql)
         if isinstance(stmt, ast.Query):
-            return self._execute_query(stmt, sql_key=sql)
+            return self._run_tracked(sql, stmt)
         if isinstance(stmt, ast.ExplainStatement):
+            if stmt.analyze:
+                return self._explain_analyze(stmt.query)
             plan = self._analyze(stmt.query)
             return MaterializedResult(
                 [[explain_text(plan)]], ["Query Plan"], [T.VARCHAR]
+            )
+        if isinstance(stmt, ast.SetSession):
+            # plan-shaping properties are part of the plan-cache key, so
+            # no explicit invalidation is needed
+            self.session.set_property(stmt.name, stmt.value)
+            return MaterializedResult([[True]], ["result"], [T.BOOLEAN])
+        if isinstance(stmt, ast.ShowSession):
+            from trino_tpu.config import SYSTEM_PROPERTIES
+
+            rows = []
+            for meta in SYSTEM_PROPERTIES.all():
+                current = getattr(self.session, meta.name, None)
+                if meta.name == "memory_pool_bytes":
+                    current = self.session.memory_pool_bytes or 0
+                rows.append(
+                    [meta.name, str(current), str(meta.default), meta.description]
+                )
+            return MaterializedResult(
+                rows,
+                ["Name", "Value", "Default", "Description"],
+                [T.VARCHAR] * 4,
             )
         if isinstance(stmt, ast.ShowSchemas):
             cat = stmt.catalog or self.session.catalog
@@ -111,33 +148,116 @@ class LocalQueryRunner:
         analyzer = Analyzer(self.catalogs, self.session.catalog, self.session.schema)
         return analyzer.plan(q)
 
-    def _execute_query(self, q: ast.Query, sql_key: Optional[str] = None) -> MaterializedResult:
-        cached = self._plan_cache.get(sql_key) if sql_key else None
-        if cached is None:
+    def _run_tracked(self, sql: str, stmt: ast.Query) -> MaterializedResult:
+        """Query lifecycle: span tree + event listener dispatch around
+        the actual execution (SqlQueryExecution's tracing shape)."""
+        import time as _time
+
+        from trino_tpu.runtime.events import (
+            QueryCompletedEvent,
+            QueryCreatedEvent,
+        )
+        from trino_tpu.utils.tracing import TRACER
+
+        self._query_seq += 1
+        query_id = f"local-{self._query_seq}"
+        t0 = _time.monotonic()
+        self.event_listeners.query_created(
+            QueryCreatedEvent(query_id, sql, _time.time())
+        )
+        try:
+            with TRACER.span("query", query_id=query_id):
+                result = self._execute_query(stmt, sql_key=sql)
+        except BaseException as e:
+            self.event_listeners.query_completed(
+                QueryCompletedEvent(
+                    query_id, sql, "failed", _time.monotonic() - t0,
+                    failure=repr(e),
+                )
+            )
+            raise
+        self.event_listeners.query_completed(
+            QueryCompletedEvent(
+                query_id, sql, "finished", _time.monotonic() - t0,
+                rows=len(result.rows),
+            )
+        )
+        return result
+
+    def _plan(self, q: ast.Query, sql_key: Optional[str]):
+        from trino_tpu.utils.tracing import TRACER
+
+        # cache key includes the plan-shaping session properties, so
+        # set_property takes effect however it was invoked
+        cache_key = None
+        if sql_key is not None:
+            cache_key = (
+                sql_key,
+                self.session.batch_rows,
+                self.session.target_splits,
+                self.session.enable_dynamic_filtering,
+            )
+        cached = self._plan_cache.get(cache_key) if cache_key else None
+        if cached is not None:
+            return cached
+        with TRACER.span("analyze"):
             output = self._analyze(q)
+        with TRACER.span("plan"):
             planner = LocalPlanner(
                 self.catalogs,
                 batch_rows=self.session.batch_rows,
                 target_splits=self.session.target_splits,
+                dynamic_filtering=self.session.enable_dynamic_filtering,
             )
             physical = planner.plan(output)
-            if sql_key:
-                self._plan_cache[sql_key] = (output, physical)
-        else:
-            output, physical = cached
+        if cache_key:
+            self._plan_cache[cache_key] = (output, physical)
+        return output, physical
+
+    def _execution_ctx(self) -> dict:
         ctx: dict = {}
         if self.session.memory_pool_bytes is not None:
             from trino_tpu.runtime.memory import MemoryPool
 
             ctx["memory_pool"] = MemoryPool(self.session.memory_pool_bytes)
-        pipelines, chain = physical.instantiate(ctx)
+        return ctx
+
+    def _execute_query(self, q: ast.Query, sql_key: Optional[str] = None) -> MaterializedResult:
+        from trino_tpu.utils.tracing import TRACER
+
+        output, physical = self._plan(q, sql_key)
+        pipelines, chain = physical.instantiate(self._execution_ctx())
         sink = CollectorSink()
         chain.append(sink)
-        for p in pipelines:
-            Driver(p).run()
-        Driver(Pipeline(chain)).run()
+        with TRACER.span("execute"):
+            for p in pipelines:
+                Driver(p).run()
+            Driver(Pipeline(chain)).run()
         return MaterializedResult(
             sink.rows(),
             list(output.names),
             [f.type for f in output.fields],
         )
+
+    def _explain_analyze(self, q: ast.Query) -> MaterializedResult:
+        """EXPLAIN ANALYZE: run with instrumented operators, render plan
+        + per-operator stats (ExplainAnalyzeOperator analogue)."""
+        from trino_tpu.exec.stats import instrument, render_stats
+
+        output, physical = self._plan(q, sql_key=None)
+        pipelines, chain = physical.instantiate(self._execution_ctx())
+        sink = CollectorSink()
+        chain.append(sink)
+        groups = []
+        wrapped_pipelines = []
+        for p in pipelines:
+            ops, stats = instrument(p.operators)
+            groups.append(stats)
+            wrapped_pipelines.append(Pipeline(ops))
+        main_ops, main_stats = instrument(chain)
+        groups.append(main_stats)
+        for p in wrapped_pipelines:
+            Driver(p).run()
+        Driver(Pipeline(main_ops)).run()
+        text = explain_text(output) + "\n\n" + render_stats(groups)
+        return MaterializedResult([[text]], ["Query Plan"], [T.VARCHAR])

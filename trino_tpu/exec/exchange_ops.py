@@ -51,20 +51,34 @@ def _partition_ids(keys, valids, luts, live, n: int, has_lut: tuple):
 
 
 def split_page(page: Page, pid: np.ndarray, n: int) -> List[Page]:
-    """Split a compacted wire page by per-row partition id (host side)."""
+    """Split a compacted wire page by per-row partition id: ONE native
+    scatter pass over all partitions (PagePartitioner's per-partition
+    appenders collapsed; trino_tpu/native)."""
+    from trino_tpu import native
+
+    flat: List[np.ndarray] = []
+    valid_pos: List[int] = []
+    for c in page.columns:
+        flat.append(c)
+    for v in page.valids:
+        if v is not None:
+            valid_pos.append(len(flat))
+            flat.append(v)
+    parts = native.partition_scatter(flat, pid, n)
+    width = page.width
     out = []
     for p in range(n):
-        m = pid == p
-        rows = int(m.sum())
-        out.append(
-            Page(
-                page.types,
-                [c[m] for c in page.columns],
-                [None if v is None else v[m] for v in page.valids],
-                page.dictionaries,
-                rows,
-            )
-        )
+        cols = parts[p][:width]
+        valids: List = []
+        vi = width
+        for v in page.valids:
+            if v is None:
+                valids.append(None)
+            else:
+                valids.append(parts[p][vi])
+                vi += 1
+        rows = len(cols[0]) if cols else 0
+        out.append(Page(page.types, cols, valids, page.dictionaries, rows))
     return out
 
 

@@ -1168,6 +1168,8 @@ class JoinBridge:
         self.build_batch: Optional[RelBatch] = None
         # build-side key dictionaries, for probe-side code remapping
         self.key_dicts: Optional[List[Optional[Dictionary]]] = None
+        # build-side key channel indexes (dynamic-filter domains)
+        self.build_key_channels: List[int] = []
 
 
 @partial(jax.jit, static_argnames=("key_channels",))
@@ -1205,6 +1207,7 @@ class HashBuildSink(Operator):
         self._bridge.key_dicts = [
             merged.columns[c].dictionary for c in self._keys
         ]
+        self._bridge.build_key_channels = list(self._keys)
         self._inputs = []
 
     def get_output(self) -> Optional[RelBatch]:
@@ -1369,6 +1372,93 @@ class LookupJoinOperator(Operator):
 
     def is_finished(self) -> bool:
         return self._finishing and not self._outputs
+
+
+@partial(jax.jit, static_argnames=("channels",))
+def _df_domains(build: RelBatch, channels: tuple):
+    """Per-key min/max over the build side's live+valid rows."""
+    live = build.live_mask()
+    out = []
+    for c in channels:
+        col = build.columns[c]
+        w = live if col.valid is None else (live & col.valid)
+        lo_n = minmax_neutral(col.data.dtype, "min")
+        hi_n = minmax_neutral(col.data.dtype, "max")
+        lo = jnp.min(jnp.where(w, col.data, jnp.asarray(lo_n, col.data.dtype)))
+        hi = jnp.max(jnp.where(w, col.data, jnp.asarray(hi_n, col.data.dtype)))
+        out.append((lo, hi, jnp.any(w)))
+    return out
+
+
+@jax.jit
+def _df_filter(batch: RelBatch, keys, domains):
+    """Drop probe rows outside [lo, hi] on every key (NULL keys never
+    match an inner/semi join, so they drop too)."""
+    keep = batch.live_mask()
+    for (c_data, c_valid), (lo, hi, any_rows) in zip(keys, domains):
+        ok = (c_data >= lo) & (c_data <= hi) & any_rows
+        if c_valid is not None:
+            ok = ok & c_valid
+        keep = keep & ok
+    return batch.mask(keep)
+
+
+class DynamicFilterOperator(Operator):
+    """Probe-side pruning from build-side key domains — the LOCAL form
+    of dynamic filtering (DynamicFilterSourceOperator + DynamicFilter
+    SPI, SURVEY.md §5.6): the build pipeline has already completed when
+    the probe pipeline starts, so the bridge's build batch supplies
+    min/max domains directly. The coordinator-distributed form (domains
+    shipped to remote scan fragments) rides the same domain computation.
+    Applies to inner/semi probes only; dictionary-coded keys are skipped
+    unless both sides share the dictionary (code order is only
+    meaningful within one dictionary)."""
+
+    def __init__(self, bridge: JoinBridge, key_channels: Sequence[int]):
+        self._bridge = bridge
+        self._keys = list(key_channels)
+        self._domains = None
+        self._active_channels: Optional[List[int]] = None
+        self._out: Optional[RelBatch] = None
+
+    def _prepare(self, probe: RelBatch) -> None:
+        build = self._bridge.build_batch
+        key_dicts = self._bridge.key_dicts or [None] * len(self._keys)
+        active = []
+        for i, c in enumerate(self._keys):
+            probe_dict = probe.columns[c].dictionary
+            if key_dicts[i] is None and probe_dict is None:
+                active.append((i, c))
+            elif key_dicts[i] is not None and key_dicts[i] == probe_dict:
+                active.append((i, c))
+        self._active_channels = active
+        if active:
+            all_domains = _df_domains(
+                build, tuple(self._bridge.build_key_channels)
+            )
+            self._domains = [all_domains[i] for i, _ in active]
+
+    def needs_input(self) -> bool:
+        return self._out is None and not self._finishing
+
+    def add_input(self, batch: RelBatch) -> None:
+        if self._active_channels is None:
+            self._prepare(batch)
+        if not self._active_channels:
+            self._out = batch
+            return
+        keys = tuple(
+            (batch.columns[c].data, batch.columns[c].valid)
+            for _, c in self._active_channels
+        )
+        self._out = _df_filter(batch, keys, tuple(self._domains))
+
+    def get_output(self) -> Optional[RelBatch]:
+        out, self._out = self._out, None
+        return out
+
+    def is_finished(self) -> bool:
+        return self._finishing and self._out is None
 
 
 # ---------------------------------------------------------------------------

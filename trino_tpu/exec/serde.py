@@ -62,8 +62,11 @@ class Page:
 
     @staticmethod
     def from_batch(batch: RelBatch) -> "Page":
-        """Device batch -> compacted host page (one device->host copy)."""
+        """Device batch -> compacted host page (one device->host copy;
+        live-row extraction via the native mask_gather sweep)."""
         import jax
+
+        from trino_tpu import native
 
         host = jax.device_get(batch)
         live = (
@@ -71,15 +74,26 @@ class Page:
             if host.live is not None
             else np.ones(batch.capacity, dtype=bool)
         )
-        cols, valids, dicts, typs = [], [], [], []
+        flat: List[np.ndarray] = []
+        valid_idx: List[Optional[int]] = []
         for c in host.columns:
-            data = np.asarray(c.data)[live]
-            cols.append(np.ascontiguousarray(data))
-            valids.append(
-                np.ascontiguousarray(np.asarray(c.valid)[live])
-                if c.valid is not None
-                else None
-            )
+            flat.append(np.asarray(c.data))
+            if c.valid is not None:
+                valid_idx.append(len(flat))
+                flat.append(np.asarray(c.valid))
+            else:
+                valid_idx.append(None)
+        compacted = native.mask_compact(flat, live)
+        cols, valids, dicts, typs = [], [], [], []
+        i = 0
+        for c, vi in zip(host.columns, valid_idx):
+            cols.append(compacted[i])
+            i += 1
+            if vi is not None:
+                valids.append(compacted[i])
+                i += 1
+            else:
+                valids.append(None)
             dicts.append(c.dictionary.values if c.dictionary is not None else None)
             typs.append(c.type)
         return Page(typs, cols, valids, dicts, int(live.sum()))

@@ -1,0 +1,113 @@
+"""Operator/driver statistics.
+
+Analogue of OperatorStats/OperationTimer (main/operator/ — per-operator
+CPU/wall recorded on every getOutput/addInput, Driver.java:403/408,
+aggregated Driver->Pipeline->Task->Query and rendered by EXPLAIN ANALYZE
+— SURVEY.md §5.1). TPU caveat recorded honestly: wall time here measures
+HOST dispatch time; XLA executes asynchronously, so per-operator device
+time only appears at host-sync points (row_count, device_get) — the
+final sync is attributed to the sink that forces it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import List, Optional
+
+
+@dataclasses.dataclass
+class OperatorStats:
+    operator: str = ""
+    add_input_calls: int = 0
+    get_output_calls: int = 0
+    input_batches: int = 0
+    output_batches: int = 0
+    input_rows: int = 0
+    output_rows: int = 0
+    add_input_s: float = 0.0
+    get_output_s: float = 0.0
+    finish_s: float = 0.0
+
+    @property
+    def total_s(self) -> float:
+        return self.add_input_s + self.get_output_s + self.finish_s
+
+    def line(self) -> str:
+        return (
+            f"{self.operator}: in={self.input_rows} rows/"
+            f"{self.input_batches} batches, out={self.output_rows} rows/"
+            f"{self.output_batches} batches, "
+            f"wall={self.total_s * 1000:.1f}ms "
+            f"(add={self.add_input_s * 1000:.1f} "
+            f"get={self.get_output_s * 1000:.1f} "
+            f"finish={self.finish_s * 1000:.1f})"
+        )
+
+
+class InstrumentedOperator:
+    """Transparent timing wrapper around one operator — the
+    OperationTimer discipline without touching operator code."""
+
+    def __init__(self, inner, stats: OperatorStats, count_rows: bool):
+        self.inner = inner
+        self.stats = stats
+        self.stats.operator = type(inner).__name__
+        self._count_rows = count_rows
+
+    def needs_input(self) -> bool:
+        return self.inner.needs_input()
+
+    def add_input(self, batch) -> None:
+        t0 = time.monotonic()
+        self.inner.add_input(batch)
+        self.stats.add_input_s += time.monotonic() - t0
+        self.stats.add_input_calls += 1
+        self.stats.input_batches += 1
+        if self._count_rows:
+            self.stats.input_rows += batch.row_count()
+
+    def get_output(self):
+        t0 = time.monotonic()
+        out = self.inner.get_output()
+        self.stats.get_output_s += time.monotonic() - t0
+        self.stats.get_output_calls += 1
+        if out is not None:
+            self.stats.output_batches += 1
+            if self._count_rows:
+                self.stats.output_rows += out.row_count()
+        return out
+
+    def finish(self) -> None:
+        t0 = time.monotonic()
+        self.inner.finish()
+        self.stats.finish_s += time.monotonic() - t0
+
+    def is_finished(self) -> bool:
+        return self.inner.is_finished()
+
+    def is_blocked(self) -> bool:
+        return self.inner.is_blocked()
+
+    def __getattr__(self, name):
+        # pass through operator-specific surface (e.g. CollectorSink.rows)
+        return getattr(self.inner, name)
+
+
+def instrument(operators, count_rows: bool = True):
+    """Wrap a pipeline's operators; returns (wrapped, [OperatorStats])."""
+    stats = [OperatorStats() for _ in operators]
+    wrapped = [
+        InstrumentedOperator(op, st, count_rows)
+        for op, st in zip(operators, stats)
+    ]
+    return wrapped, stats
+
+
+def render_stats(groups: List[List[OperatorStats]]) -> str:
+    lines = []
+    for i, group in enumerate(groups):
+        lines.append(f"Pipeline {i}:")
+        for st in group:
+            lines.append("  " + st.line())
+    return "\n".join(lines)

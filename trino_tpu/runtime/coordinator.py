@@ -48,7 +48,9 @@ class QueryScheduler:
         self.workers = workers
         self.catalogs = catalogs
         self.session = session
-        self.hash_partitions = hash_partitions or min(len(workers), 4)
+        self.hash_partitions = hash_partitions or min(
+            len(workers), session.hash_partition_count
+        )
         # fragment id -> [(worker handle, task id string)]
         self.tasks: Dict[int, List] = {}
         self._schemas: Dict[int, list] = {}
@@ -104,6 +106,7 @@ class QueryScheduler:
                     input_locations=input_locations,
                     batch_rows=self.session.batch_rows,
                     target_splits=max(self.session.target_splits, tc),
+                    dynamic_filtering=self.session.enable_dynamic_filtering,
                 )
                 worker = self.workers[next(rr) % len(self.workers)]
                 worker.create_task(spec)
@@ -180,7 +183,11 @@ class DistributedQueryRunner:
             lqr.catalogs = self.catalogs
             return lqr.execute(sql)
         output = self._analyze(stmt)
-        subplan = plan_distributed(output, self.catalogs)
+        subplan = plan_distributed(
+            output,
+            self.catalogs,
+            broadcast_threshold=self.session.broadcast_join_threshold,
+        )
         result_meta = (list(output.names), [f.type for f in output.fields])
         if self.session.retry_policy == "task":
             rows = self._execute_fte(subplan)

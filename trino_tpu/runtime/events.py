@@ -1,0 +1,74 @@
+"""Event listener SPI.
+
+Analogue of spi/eventlistener/EventListener.java:16 (queryCreated /
+queryCompleted / splitCompleted; plugins like trino-http-event-listener
+— SURVEY.md §5.5). Listeners are registered on the engine/coordinator;
+failures in listeners never fail queries (dispatch swallows + records)."""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class QueryCreatedEvent:
+    query_id: str
+    sql: str
+    create_time: float
+
+
+@dataclasses.dataclass
+class QueryCompletedEvent:
+    query_id: str
+    sql: str
+    state: str  # finished | failed
+    wall_s: float
+    rows: int = 0
+    failure: Optional[str] = None
+
+
+@dataclasses.dataclass
+class SplitCompletedEvent:
+    query_id: str
+    task_id: str
+    wall_s: float
+
+
+class EventListener:
+    """Subclass and override; unimplemented events are ignored."""
+
+    def query_created(self, event: QueryCreatedEvent) -> None:
+        pass
+
+    def query_completed(self, event: QueryCompletedEvent) -> None:
+        pass
+
+    def split_completed(self, event: SplitCompletedEvent) -> None:
+        pass
+
+
+class EventListenerManager:
+    def __init__(self):
+        self._listeners: List[EventListener] = []
+        self.dispatch_failures = 0
+
+    def add(self, listener: EventListener) -> None:
+        self._listeners.append(listener)
+
+    def _fire(self, method: str, event) -> None:
+        for lst in self._listeners:
+            try:
+                getattr(lst, method)(event)
+            except Exception:
+                self.dispatch_failures += 1
+
+    def query_created(self, event: QueryCreatedEvent) -> None:
+        self._fire("query_created", event)
+
+    def query_completed(self, event: QueryCompletedEvent) -> None:
+        self._fire("query_completed", event)
+
+    def split_completed(self, event: SplitCompletedEvent) -> None:
+        self._fire("split_completed", event)

@@ -127,6 +127,7 @@ class FaultTolerantQueryScheduler:
                     batch_rows=self.session.batch_rows,
                     target_splits=max(self.session.target_splits, tc),
                     spool_dir=self.spool_dir,
+                    dynamic_filtering=self.session.enable_dynamic_filtering,
                 )
                 try:
                     handle.create_task(spec)

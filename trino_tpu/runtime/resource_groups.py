@@ -1,0 +1,142 @@
+"""Resource groups: hierarchical admission control.
+
+Analogue of main/execution/resourcegroups/ (InternalResourceGroupManager,
+InternalResourceGroup with hard/soft concurrency + queue limits,
+selector-based routing — SURVEY.md §2.3) and the file-based config
+plugin (trino-resource-group-managers). Groups form a tree; a query is
+admitted when every group on its path has a free concurrency slot, else
+it queues FIFO (the WeightedFairQueue reduces to FIFO until weights
+land). Selectors map (user, source) -> group path."""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+class QueryQueueFullError(RuntimeError):
+    pass
+
+
+@dataclasses.dataclass
+class ResourceGroupSpec:
+    name: str
+    max_concurrency: int = 10
+    max_queued: int = 100
+    sub_groups: List["ResourceGroupSpec"] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass(frozen=True)
+class Selector:
+    """Routes queries to a group path; regexes over user/source."""
+
+    group: Tuple[str, ...]
+    user_pattern: Optional[str] = None
+    source_pattern: Optional[str] = None
+
+    def matches(self, user: str, source: str) -> bool:
+        if self.user_pattern and not re.fullmatch(self.user_pattern, user):
+            return False
+        if self.source_pattern and not re.fullmatch(self.source_pattern, source):
+            return False
+        return True
+
+
+class _Group:
+    def __init__(self, spec: ResourceGroupSpec, parent: Optional["_Group"]):
+        self.spec = spec
+        self.parent = parent
+        self.running = 0
+        self.queued = 0
+        self.children: Dict[str, _Group] = {
+            c.name: _Group(c, self) for c in spec.sub_groups
+        }
+
+    def path(self) -> str:
+        parts = []
+        g: Optional[_Group] = self
+        while g is not None:
+            parts.append(g.spec.name)
+            g = g.parent
+        return ".".join(reversed(parts))
+
+
+class ResourceGroupManager:
+    """Admission: acquire() blocks while the target group (or any
+    ancestor) is at max_concurrency; raises QueryQueueFullError when the
+    queue cap is hit (the dispatcher's resource-group submit path,
+    DispatchManager.createQueryInternal:219)."""
+
+    def __init__(self, root: ResourceGroupSpec, selectors: List[Selector] = ()):
+        self._root = _Group(root, None)
+        self._selectors = list(selectors)
+        self._lock = threading.Condition()
+
+    def _resolve(self, user: str, source: str) -> _Group:
+        for s in self._selectors:
+            if s.matches(user, source):
+                g = self._root
+                for name in s.group:
+                    if name == self._root.spec.name:
+                        continue
+                    g = g.children[name]
+                return g
+        return self._root
+
+    def _chain(self, g: _Group) -> List[_Group]:
+        out = []
+        while g is not None:
+            out.append(g)
+            g = g.parent
+        return out
+
+    def acquire(self, user: str = "user", source: str = "", timeout: float = 60.0):
+        """Returns a lease token (the group) once admitted."""
+        group = self._resolve(user, source)
+        chain = self._chain(group)
+        with self._lock:
+            if group.queued >= group.spec.max_queued:
+                raise QueryQueueFullError(
+                    f"group {group.path()} queue is full "
+                    f"({group.spec.max_queued})"
+                )
+            for g in chain:
+                g.queued += 1
+            try:
+                ok = self._lock.wait_for(
+                    lambda: all(
+                        g.running < g.spec.max_concurrency for g in chain
+                    ),
+                    timeout=timeout,
+                )
+                if not ok:
+                    raise QueryQueueFullError(
+                        f"group {group.path()} admission timed out"
+                    )
+                for g in chain:
+                    g.running += 1
+            finally:
+                for g in chain:
+                    g.queued -= 1
+        return group
+
+    def release(self, group: _Group) -> None:
+        with self._lock:
+            for g in self._chain(group):
+                g.running -= 1
+            self._lock.notify_all()
+
+    def stats(self) -> Dict[str, Tuple[int, int]]:
+        """group path -> (running, queued)."""
+        out: Dict[str, Tuple[int, int]] = {}
+
+        def walk(g: _Group) -> None:
+            out[g.path()] = (g.running, g.queued)
+            for c in g.children.values():
+                walk(c)
+
+        with self._lock:
+            walk(self._root)
+        return out

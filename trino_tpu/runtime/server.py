@@ -1,0 +1,179 @@
+"""Coordinator HTTP server: the client statement protocol.
+
+Analogue of the reference's client protocol (client/trino-client
+StatementClientV1.java:65 — POST /v1/statement, poll nextUri, token-
+paged results; QueuedStatementResource.java:106 +
+ExecutingStatementResource.java:73 — SURVEY.md §2.11, §3.1). Queries
+run asynchronously on an executor; clients poll:
+
+  POST /v1/statement               SQL text -> {id, nextUri, stats}
+  GET  /v1/statement/executing/{id}/{token}
+                                   {columns, data, nextUri?, stats}
+  DELETE /v1/statement/executing/{id}     cancel
+
+Data pages out in row chunks per poll (the JSON protocol's data field).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+ROWS_PER_PAGE = 4096
+
+
+class _QueryJob:
+    def __init__(self, query_id: str, sql: str):
+        self.query_id = query_id
+        self.sql = sql
+        self.state = "queued"
+        self.rows: List[list] = []
+        self.columns: List[dict] = []
+        self.error: Optional[str] = None
+        self.lock = threading.Lock()
+
+    def snapshot(self, token: int):
+        with self.lock:
+            return (
+                self.state,
+                self.columns,
+                self.rows[token : token + ROWS_PER_PAGE],
+                len(self.rows),
+                self.error,
+            )
+
+
+class CoordinatorServer:
+    """HTTP front for any runner with .execute(sql) -> MaterializedResult
+    (LocalQueryRunner or DistributedQueryRunner)."""
+
+    def __init__(
+        self,
+        runner,
+        port: int = 0,
+        max_concurrent: int = 4,
+        resource_groups=None,  # runtime.resource_groups.ResourceGroupManager
+    ):
+        self.runner = runner
+        self.resource_groups = resource_groups
+        self._jobs: Dict[str, _QueryJob] = {}
+        self._pool = ThreadPoolExecutor(max_workers=max_concurrent)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _json(self, code: int, obj) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                parts = [p for p in self.path.split("/") if p]
+                if parts == ["v1", "statement"]:
+                    ln = int(self.headers.get("Content-Length", "0"))
+                    sql = self.rfile.read(ln).decode("utf-8")
+                    job = outer._submit(sql)
+                    self._json(200, outer._response(job, 0))
+                    return
+                self._json(404, {"error": "no route"})
+
+            def do_GET(self):
+                parts = [p for p in self.path.split("/") if p]
+                if (
+                    len(parts) == 5
+                    and parts[:3] == ["v1", "statement", "executing"]
+                ):
+                    job = outer._jobs.get(parts[3])
+                    if job is None:
+                        self._json(404, {"error": "unknown query"})
+                        return
+                    self._json(200, outer._response(job, int(parts[4])))
+                    return
+                self._json(404, {"error": "no route"})
+
+            def do_DELETE(self):
+                parts = [p for p in self.path.split("/") if p]
+                if (
+                    len(parts) == 4
+                    and parts[:3] == ["v1", "statement", "executing"]
+                ):
+                    outer._jobs.pop(parts[3], None)
+                    self._json(200, {})
+                    return
+                self._json(404, {"error": "no route"})
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_port
+        self.uri = f"http://127.0.0.1:{self.port}"
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def _submit(self, sql: str) -> _QueryJob:
+        job = _QueryJob(uuid.uuid4().hex[:16], sql)
+        self._jobs[job.query_id] = job
+
+        def run():
+            lease = None
+            try:
+                if self.resource_groups is not None:
+                    # admission queueing (resource-group submit path)
+                    lease = self.resource_groups.acquire()
+                job.state = "running"
+                result = self.runner.execute(sql)
+                with job.lock:
+                    job.columns = [
+                        {"name": n, "type": str(t)}
+                        for n, t in zip(result.column_names, result.column_types)
+                    ]
+                    job.rows = result.rows
+                    job.state = "finished"
+            except Exception as e:
+                with job.lock:
+                    job.error = str(e)
+                    job.state = "failed"
+            finally:
+                if lease is not None:
+                    self.resource_groups.release(lease)
+
+        self._pool.submit(run)
+        return job
+
+    def _response(self, job: _QueryJob, token: int) -> dict:
+        state, columns, data, total, error = job.snapshot(token)
+        out = {
+            "id": job.query_id,
+            "stats": {"state": state.upper()},
+        }
+        if state == "failed":
+            out["error"] = {"message": error}
+            return out
+        if state != "finished":
+            out["nextUri"] = f"{self.uri}/v1/statement/executing/{job.query_id}/{token}"
+            return out
+        out["columns"] = columns
+        if data:
+            out["data"] = data
+        next_token = token + len(data)
+        if next_token < total:
+            out["nextUri"] = (
+                f"{self.uri}/v1/statement/executing/{job.query_id}/{next_token}"
+            )
+        return out
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._pool.shutdown(wait=False)

@@ -24,13 +24,17 @@ def topo_order(subplan: SubPlan) -> List[SubPlan]:
 
 
 def stage_task_count(sp: SubPlan, n_workers: int, hash_partitions: int) -> int:
-    """Task-count policy per fragment partitioning (the
-    DeterminePartitionCount stand-in until stats drive it)."""
+    """Task-count policy per fragment partitioning; hash stages take the
+    stats-driven suggestion (DeterminePartitionCount.java:90) capped by
+    the session's hash_partition_count."""
     p = sp.fragment.partitioning
     if p == "single":
         return 1
     if p == "source":
         return max(1, n_workers)
+    suggested = sp.fragment.suggested_partitions
+    if suggested is not None:
+        return max(1, min(hash_partitions, suggested))
     return hash_partitions
 
 

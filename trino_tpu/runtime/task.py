@@ -55,6 +55,7 @@ class TaskSpec:
     # FTE: spool output to this directory instead of a live buffer
     # (SpoolingExchangeOutputBuffer path, SURVEY.md §3.5)
     spool_dir: Optional[str] = None
+    dynamic_filtering: bool = True
 
 
 def _resolve_fetch(location):
@@ -161,6 +162,7 @@ class TaskExecution:
                 target_splits=spec.target_splits,
                 remote_schemas=spec.remote_schemas,
                 scan_slice=spec.scan_slice,
+                dynamic_filtering=spec.dynamic_filtering,
             )
             physical = planner.plan(spec.fragment.root)
             ctx = {"make_remote_source": self._make_remote_source}

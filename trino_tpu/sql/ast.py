@@ -301,6 +301,17 @@ class ExplainStatement(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class SetSession(Node):
+    name: str
+    value: str  # literal text; engine validates via the property registry
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowSession(Node):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
 class ShowTables(Node):
     schema: Optional[Tuple[str, ...]] = None
 

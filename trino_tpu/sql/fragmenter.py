@@ -53,7 +53,9 @@ class PlanFragment:
     """One schedulable stage (PlanFragment analogue). `partitioning` is
     how this fragment's tasks are laid out: "single" | "hash" | "source";
     `output_kind` + `output_channels` describe the PartitionedOutput at
-    its root ("single" | "hash" | "broadcast" | "arbitrary")."""
+    its root ("single" | "hash" | "broadcast" | "arbitrary").
+    `suggested_partitions` is the stats-driven task count for hash
+    fragments (DeterminePartitionCount.java:90)."""
 
     id: int
     root: P.PlanNode
@@ -61,6 +63,7 @@ class PlanFragment:
     output_kind: str
     output_channels: Tuple[int, ...] = ()
     output_merge_keys: Tuple = ()
+    suggested_partitions: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -291,45 +294,16 @@ def _spec_of(a: P.AggCall):
     return AggSpec(a.kind, a.arg_channel, a.out_type, a.distinct)
 
 
-# -- row estimation (pre-CBO heuristic) --------------------------------------
+# -- row estimation: the cost-based StatsCalculator (sql/stats.py) -----------
 
 
 def make_row_estimator(catalogs):
-    """Crude bottom-up cardinality estimate used for the broadcast-vs-
-    partitioned decision until the CBO lands (DeterminePartitionCount /
-    CostCalculatorUsingExchanges analogue)."""
+    """Cardinality estimates for the broadcast-vs-partitioned decision,
+    backed by the stats-propagation framework (main/cost/ analogue)."""
+    from trino_tpu.sql.stats import StatsCalculator
 
-    def estimate(node: P.PlanNode) -> float:
-        if isinstance(node, P.ScanNode):
-            try:
-                stats = catalogs.get(node.catalog).metadata.get_table_statistics(
-                    node.handle
-                )
-                if stats and stats.row_count is not None:
-                    return float(stats.row_count)
-            except Exception:
-                pass
-            return 1e9
-        if isinstance(node, P.FilterNode):
-            return estimate(node.child) * 0.33
-        if isinstance(node, P.AggregateNode):
-            return max(estimate(node.child) * 0.1, 1.0)
-        if isinstance(node, P.JoinNode):
-            if node.kind in ("semi", "anti"):
-                return estimate(node.left)
-            return max(estimate(node.left), estimate(node.right))
-        if isinstance(node, (P.TopNNode,)):
-            return float(node.count)
-        if isinstance(node, P.LimitNode):
-            return float(node.count or 1e9)
-        if isinstance(node, P.ValuesNode):
-            return float(len(node.rows))
-        kids = node.children()
-        if not kids:
-            return 1e6
-        return max(estimate(c) for c in kids)
-
-    return estimate
+    calc = StatsCalculator(catalogs)
+    return lambda node: calc.stats(node).row_count
 
 
 # -- pass 2: fragment cutting ------------------------------------------------
@@ -451,16 +425,41 @@ def plan_distributed(
 ) -> SubPlan:
     """Logical plan -> SubPlan tree of PlanFragments (the
     LogicalPlanner->AddExchanges->PlanFragmenter.createSubPlans path)."""
-    adder = _AddExchanges(make_row_estimator(catalogs), broadcast_threshold)
+    estimate = make_row_estimator(catalogs)
+    adder = _AddExchanges(estimate, broadcast_threshold)
     annotated, _ = adder.visit(root)
     subplan = _Fragmenter().cut(annotated)
-    # refine "hash" vs "single" partitioning now that producers are known
+    # refine "hash" vs "single" partitioning now that producers are known,
+    # and derive stats-driven partition counts per hash stage
     frags = {f.id: f for f in subplan.all_fragments()}
+    from trino_tpu.sql.stats import determine_partition_count
+
+    def hash_input_rows(fragment: PlanFragment) -> float:
+        total = [0.0]
+
+        def walk(n):
+            if isinstance(n, P.RemoteSourceNode):
+                for fid in n.fragment_ids:
+                    prod = frags[fid]
+                    if prod.output_kind == "hash":
+                        total[0] += estimate(prod.root)
+            for c in n.children():
+                walk(c)
+
+        walk(fragment.root)
+        return total[0]
 
     def refine(sp: SubPlan):
         f = sp.fragment
-        if f.partitioning == "hash" and not consumes_hash_input(f, frags):
-            sp.fragment = dataclasses.replace(f, partitioning="single")
+        if f.partitioning == "hash":
+            if not consumes_hash_input(f, frags):
+                sp.fragment = dataclasses.replace(f, partitioning="single")
+            else:
+                rows = hash_input_rows(f)
+                sp.fragment = dataclasses.replace(
+                    f,
+                    suggested_partitions=determine_partition_count(rows, 1 << 10),
+                )
         for c in sp.children:
             refine(c)
 

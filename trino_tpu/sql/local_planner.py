@@ -96,6 +96,7 @@ class LocalPlanner:
         target_splits: int = 1,
         remote_schemas: Optional[Dict[int, "Schema"]] = None,
         scan_slice: Optional[Tuple[int, int]] = None,
+        dynamic_filtering: bool = True,
     ):
         """`remote_schemas` maps producer fragment id -> output Schema
         (with dictionaries) for RemoteSourceNode leaves; `scan_slice`
@@ -107,6 +108,7 @@ class LocalPlanner:
         self.target_splits = target_splits
         self.remote_schemas = remote_schemas or {}
         self.scan_slice = scan_slice
+        self.dynamic_filtering = dynamic_filtering
         self.pipelines: List[List[Factory]] = []
         self._next_key = 0
 
@@ -286,6 +288,12 @@ class LocalPlanner:
             )
         lkeys = list(node.left_keys)
         kind = node.kind
+        if kind in ("inner", "semi") and self.dynamic_filtering:
+            from trino_tpu.exec.operators import DynamicFilterOperator
+
+            probe_chain.append(
+                lambda ctx: DynamicFilterOperator(bridge_of(ctx), lkeys)
+            )
         probe_chain.append(
             lambda ctx: LookupJoinOperator(
                 bridge_of(ctx), lkeys, kind, probe_schema,

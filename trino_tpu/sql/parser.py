@@ -164,6 +164,18 @@ class Parser:
             stmt: ast.Node = ast.ExplainStatement(self.parse_query(), analyze)
         elif self.at_kw("SHOW"):
             stmt = self._parse_show()
+        elif self.at_kw("SET"):
+            self.next()
+            self.expect_kw("SESSION")
+            name = self._parse_name()
+            self.expect_op("=")
+            t = self.next()
+            value = (
+                t.text[1:-1].replace("''", "'")
+                if t.kind == "string"
+                else t.text
+            )
+            stmt = ast.SetSession(name, value)
         else:
             stmt = self.parse_query()
         self.accept_op(";")
@@ -187,7 +199,9 @@ class Parser:
         if self.accept_kw("COLUMNS"):
             self.expect_kw("FROM")
             return ast.ShowColumns(self._parse_qualified_name())
-        raise self.error("expected TABLES, SCHEMAS or COLUMNS after SHOW")
+        if self.accept_kw("SESSION"):
+            return ast.ShowSession()
+        raise self.error("expected TABLES, SCHEMAS, COLUMNS or SESSION after SHOW")
 
     # -- query --
     def parse_query(self) -> ast.Query:

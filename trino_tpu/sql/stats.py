@@ -1,0 +1,231 @@
+"""Statistics propagation + cost-based decisions.
+
+Analogue of main/cost/ (StatsCalculator rule set: FilterStatsCalculator,
+JoinStatsRule, AggregationStatsRule; CostCalculatorUsingExchanges —
+SURVEY.md §2.2) reduced to the estimates the planner consults: row
+counts and per-channel (ndv, null_fraction, low, high). Consumers:
+broadcast-vs-partitioned join choice and adaptive partition counts
+(DeterminePartitionCount.java:90), plus EXPLAIN row estimates."""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional
+
+from trino_tpu.expr import ir
+from trino_tpu.sql import plan as P
+
+UNKNOWN_FILTER_COEFFICIENT = 0.33  # fallback selectivity
+
+
+@dataclasses.dataclass
+class ColStats:
+    ndv: Optional[float] = None
+    null_fraction: Optional[float] = None
+    low: Optional[float] = None
+    high: Optional[float] = None
+
+
+@dataclasses.dataclass
+class PlanStats:
+    row_count: float
+    columns: Dict[int, ColStats] = dataclasses.field(default_factory=dict)
+
+    def col(self, ch: int) -> ColStats:
+        return self.columns.get(ch, ColStats())
+
+
+class StatsCalculator:
+    def __init__(self, catalogs):
+        self._catalogs = catalogs
+        self._memo: Dict[int, PlanStats] = {}
+
+    def stats(self, node: P.PlanNode) -> PlanStats:
+        # memo holds the node itself: id() alone would collide once a
+        # previously-estimated node is garbage collected
+        key = id(node)
+        hit = self._memo.get(key)
+        if hit is not None and hit[0] is node:
+            return hit[1]
+        m = getattr(self, f"_{type(node).__name__}", None)
+        out = m(node) if m is not None else self._default(node)
+        self._memo[key] = (node, out)
+        return out
+
+    def _default(self, node: P.PlanNode) -> PlanStats:
+        kids = node.children()
+        if not kids:
+            return PlanStats(1e6)
+        return self.stats(kids[0])
+
+    # -- leaves --
+    def _ScanNode(self, node: P.ScanNode) -> PlanStats:
+        try:
+            ts = self._catalogs.get(node.catalog).metadata.get_table_statistics(
+                node.handle
+            )
+        except Exception:
+            return PlanStats(1e9)
+        rows = float(ts.row_count) if ts.row_count is not None else 1e9
+        cols: Dict[int, ColStats] = {}
+        for i, name in enumerate(node.columns):
+            t = ts.columns.get(name)
+            if t is not None:
+                ndv, nf, lo, hi = t
+                cols[i] = ColStats(
+                    ndv,
+                    nf,
+                    _as_float(lo),
+                    _as_float(hi),
+                )
+        return PlanStats(rows, cols)
+
+    def _ValuesNode(self, node: P.ValuesNode) -> PlanStats:
+        return PlanStats(float(len(node.rows)))
+
+    # -- relational --
+    def _FilterNode(self, node: P.FilterNode) -> PlanStats:
+        child = self.stats(node.child)
+        sel = _selectivity(node.predicate, child)
+        rows = max(child.row_count * sel, 1.0)
+        cols = {
+            ch: dataclasses.replace(
+                cs, ndv=min(cs.ndv, rows) if cs.ndv is not None else None
+            )
+            for ch, cs in child.columns.items()
+        }
+        return PlanStats(rows, cols)
+
+    def _ProjectNode(self, node: P.ProjectNode) -> PlanStats:
+        child = self.stats(node.child)
+        cols: Dict[int, ColStats] = {}
+        for i, e in enumerate(node.exprs):
+            if isinstance(e, ir.InputRef):
+                cs = child.columns.get(e.index)
+                if cs is not None:
+                    cols[i] = cs
+        return PlanStats(child.row_count, cols)
+
+    def _AggregateNode(self, node: P.AggregateNode) -> PlanStats:
+        child = self.stats(node.child)
+        if not node.group_channels:
+            return PlanStats(1.0)
+        ndv_prod = 1.0
+        for c in node.group_channels:
+            ndv = child.col(c).ndv
+            ndv_prod *= ndv if ndv is not None else math.sqrt(child.row_count)
+        rows = max(min(child.row_count, ndv_prod), 1.0)
+        cols = {
+            i: child.col(c) for i, c in enumerate(node.group_channels)
+        }
+        return PlanStats(rows, cols)
+
+    def _JoinNode(self, node: P.JoinNode) -> PlanStats:
+        left = self.stats(node.left)
+        right = self.stats(node.right)
+        if node.kind == "cross":
+            return PlanStats(left.row_count * right.row_count, dict(left.columns))
+        if node.kind in ("semi", "anti"):
+            return PlanStats(
+                max(left.row_count * 0.5, 1.0), dict(left.columns)
+            )
+        # equi-join estimate: |L|*|R| / max(ndv of the key pair)
+        denom = 1.0
+        for lk, rk in zip(node.left_keys, node.right_keys):
+            ndv_l = left.col(lk).ndv
+            ndv_r = right.col(rk).ndv
+            key_ndv = max(
+                ndv_l if ndv_l is not None else math.sqrt(left.row_count),
+                ndv_r if ndv_r is not None else math.sqrt(right.row_count),
+            )
+            denom *= max(key_ndv, 1.0)
+        rows = max(left.row_count * right.row_count / denom, 1.0)
+        if node.kind == "left":
+            rows = max(rows, left.row_count)
+        cols = dict(left.columns)
+        width_l = len(node.left.fields)
+        for ch, cs in right.columns.items():
+            cols[width_l + ch] = cs
+        return PlanStats(rows, cols)
+
+    def _WindowNode(self, node: P.WindowNode) -> PlanStats:
+        return self.stats(node.child)
+
+    def _SortNode(self, node: P.SortNode) -> PlanStats:
+        return self.stats(node.child)
+
+    def _TopNNode(self, node: P.TopNNode) -> PlanStats:
+        child = self.stats(node.child)
+        return PlanStats(min(child.row_count, float(node.count)), dict(child.columns))
+
+    def _LimitNode(self, node: P.LimitNode) -> PlanStats:
+        child = self.stats(node.child)
+        if node.count is None:
+            return child
+        return PlanStats(
+            min(child.row_count, float(node.count)), dict(child.columns)
+        )
+
+    def _UnionAllNode(self, node: P.UnionAllNode) -> PlanStats:
+        return PlanStats(sum(self.stats(c).row_count for c in node.inputs))
+
+    def _OutputNode(self, node: P.OutputNode) -> PlanStats:
+        return self.stats(node.child)
+
+    def _ExchangeNode(self, node: P.ExchangeNode) -> PlanStats:
+        return self.stats(node.child)
+
+    def _RemoteSourceNode(self, node: P.RemoteSourceNode) -> PlanStats:
+        return PlanStats(1e6)
+
+
+def _as_float(v) -> Optional[float]:
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return None
+
+
+def _selectivity(e: ir.Expr, child: PlanStats) -> float:
+    """FilterStatsCalculator-style predicate selectivity."""
+    if isinstance(e, ir.Call):
+        if e.name == "and":
+            return _selectivity(e.args[0], child) * _selectivity(e.args[1], child)
+        if e.name == "or":
+            a = _selectivity(e.args[0], child)
+            b = _selectivity(e.args[1], child)
+            return min(a + b, 1.0)
+        if e.name == "not":
+            return max(1.0 - _selectivity(e.args[0], child), 0.05)
+        if e.name in ("eq", "ne", "lt", "le", "gt", "ge") and len(e.args) == 2:
+            col, lit = e.args
+            op = e.name
+            if isinstance(lit, ir.InputRef) and isinstance(col, ir.Literal):
+                # normalizing `lit OP col` to `col OP' lit` flips the
+                # comparison direction
+                col, lit = lit, col
+                op = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le"}.get(op, op)
+            if isinstance(col, ir.InputRef) and isinstance(lit, ir.Literal):
+                cs = child.col(col.index)
+                if op == "eq":
+                    return 1.0 / cs.ndv if cs.ndv else 0.1
+                if op == "ne":
+                    return 1.0 - (1.0 / cs.ndv if cs.ndv else 0.1)
+                lo, hi = cs.low, cs.high
+                v = _as_float(lit.value)
+                if lo is not None and hi is not None and v is not None and hi > lo:
+                    frac = (v - lo) / (hi - lo)
+                    frac = min(max(frac, 0.0), 1.0)
+                    return frac if op in ("lt", "le") else 1.0 - frac
+                return UNKNOWN_FILTER_COEFFICIENT
+    return UNKNOWN_FILTER_COEFFICIENT
+
+
+def determine_partition_count(
+    rows: float, max_partitions: int, rows_per_partition: float = 1e6
+) -> int:
+    """Adaptive stage parallelism from stats
+    (DeterminePartitionCount.java:90)."""
+    want = math.ceil(rows / rows_per_partition)
+    return max(1, min(max_partitions, want))

@@ -1,0 +1,83 @@
+"""Tracing spans.
+
+Analogue of the reference's OpenTelemetry integration (main/tracing/
+TracingMetadata.java:106, ScopedSpan, spans per planning phase —
+SqlQueryExecution.java:459–462; SURVEY.md §5.1), reduced to an
+in-process recorder with the same span tree shape: a query span with
+parse/analyze/plan/schedule/execute children, exportable as JSON. An
+OTLP exporter slots in behind `Tracer.export` without touching call
+sites."""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class Span:
+    name: str
+    start_s: float
+    end_s: Optional[float] = None
+    attributes: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    children: List["Span"] = dataclasses.field(default_factory=list)
+
+    @property
+    def duration_s(self) -> float:
+        return (self.end_s or time.monotonic()) - self.start_s
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "duration_ms": round(self.duration_s * 1000, 3),
+            "attributes": dict(self.attributes),
+            "children": [c.to_dict() for c in self.children],
+        }
+
+
+class Tracer:
+    """Per-thread span stack; roots are retained for export."""
+
+    def __init__(self):
+        self._local = threading.local()
+        self._roots: List[Span] = []
+        self._lock = threading.Lock()
+
+    def _stack(self) -> List[Span]:
+        if not hasattr(self._local, "stack"):
+            self._local.stack = []
+        return self._local.stack
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attributes):
+        s = Span(name, time.monotonic(), attributes=dict(attributes))
+        stack = self._stack()
+        if stack:
+            stack[-1].children.append(s)
+        else:
+            with self._lock:
+                self._roots.append(s)
+        stack.append(s)
+        try:
+            yield s
+        finally:
+            s.end_s = time.monotonic()
+            stack.pop()
+
+    def roots(self) -> List[Span]:
+        with self._lock:
+            return list(self._roots)
+
+    def export(self) -> List[dict]:
+        return [r.to_dict() for r in self.roots()]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._roots.clear()
+
+
+# process-wide default tracer (the GlobalOpenTelemetry stand-in)
+TRACER = Tracer()
