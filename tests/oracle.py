@@ -58,6 +58,40 @@ def load_tpch_sqlite(conn: sqlite3.Connection, sf: float, tables: Sequence[str] 
     conn.commit()
 
 
+def load_tpcds_sqlite(conn: sqlite3.Connection, sf: float, tables: Sequence[str] = None):
+    """Load the TPC-DS generator's data into sqlite (same generator,
+    byte-identical rows)."""
+    from trino_tpu.connectors import tpcds as D
+
+    for table in tables or D.TABLES:
+        cols = D.TABLES[table]
+        coldefs = ", ".join(
+            f"{n} {'TEXT' if t.is_string else 'REAL' if t.is_decimal or t.is_floating else 'INTEGER'}"
+            for n, t in cols
+        )
+        conn.execute(f"CREATE TABLE {table} ({coldefs})")
+        n_rows = D.row_count(table, sf)
+        step = 100_000
+        for a in range(0, n_rows, step):
+            b = min(a + step, n_rows)
+            arrays = []
+            for name, typ in cols:
+                data, d = D.generate_column(table, name, sf, a, b)
+                if typ.is_string:
+                    vals = [d.values[c] for c in data]
+                elif typ.is_decimal:
+                    sfac = T.decimal_scale_factor(typ)
+                    vals = (np.asarray(data, dtype=np.float64) / sfac).tolist()
+                else:
+                    vals = np.asarray(data).tolist()
+                arrays.append(vals)
+            ph = ", ".join("?" * len(cols))
+            conn.executemany(
+                f"INSERT INTO {table} VALUES ({ph})", list(zip(*arrays))
+            )
+    conn.commit()
+
+
 def sqlite_rows(conn: sqlite3.Connection, sql: str) -> List[tuple]:
     return [tuple(r) for r in conn.execute(sql).fetchall()]
 

@@ -1,0 +1,120 @@
+"""DDL/DML (CREATE/INSERT/CTAS/DROP via TableWriter) + VALUES +
+GROUPING SETS/ROLLUP/CUBE (SURVEY.md §2.6 table writes, §2.2)."""
+
+import sqlite3
+
+import pytest
+
+from tests.oracle import load_tpch_sqlite, sqlite_rows
+from trino_tpu.connectors.memory import create_memory_connector
+from trino_tpu.connectors.tpch import create_tpch_connector
+from trino_tpu.engine import LocalQueryRunner, Session
+
+
+@pytest.fixture()
+def runner():
+    r = LocalQueryRunner(Session(catalog="memory", schema="default"))
+    r.register_catalog("memory", create_memory_connector())
+    r.register_catalog("tpch", create_tpch_connector())
+    return r
+
+
+def test_create_insert_select_drop(runner):
+    assert runner.execute("CREATE TABLE t (a bigint, b varchar, c double)").rows
+    assert runner.execute(
+        "INSERT INTO t VALUES (1, 'x', 1.5), (2, 'y', -2.25), (3, NULL, 0.0)"
+    ).only_value() == 3
+    assert runner.execute("SELECT * FROM t ORDER BY a").rows == [
+        [1, "x", 1.5], [2, "y", -2.25], [3, None, 0.0],
+    ]
+    # partial column list: missing columns become NULL
+    assert runner.execute("INSERT INTO t (a) VALUES (99)").only_value() == 1
+    assert runner.execute("SELECT count(*), sum(a) FROM t").rows == [[4, 105]]
+    runner.execute("DROP TABLE t")
+    with pytest.raises(Exception):
+        runner.execute("SELECT * FROM t")
+
+
+def test_insert_from_query_with_coercion(runner):
+    runner.execute("CREATE TABLE s (k bigint, total double)")
+    n = runner.execute(
+        "INSERT INTO s SELECT n_regionkey, count(*) FROM tpch.tiny.nation"
+        " GROUP BY n_regionkey"
+    ).only_value()
+    assert n == 5
+    assert runner.execute("SELECT sum(total) FROM s").only_value() == 25.0
+
+
+def test_ctas(runner):
+    runner.execute(
+        "CREATE TABLE agg AS SELECT n_regionkey, count(*) c"
+        " FROM tpch.tiny.nation GROUP BY n_regionkey"
+    )
+    assert runner.execute("SELECT * FROM agg ORDER BY n_regionkey").rows == [
+        [i, 5] for i in range(5)
+    ]
+
+
+def test_values_standalone(runner):
+    assert runner.execute("VALUES (1, 'a'), (2, 'b')").rows == [
+        [1, "a"], [2, "b"],
+    ]
+    assert runner.execute("SELECT 1 UNION ALL VALUES (2)").rows in (
+        [[1], [2]], [[2], [1]],
+    )
+
+
+# -- grouping sets ----------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tpch_runner():
+    r = LocalQueryRunner(Session(catalog="tpch", schema="tiny"))
+    r.register_catalog("tpch", create_tpch_connector())
+    return r
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    conn = sqlite3.connect(":memory:")
+    load_tpch_sqlite(conn, 0.01)
+    yield conn
+    conn.close()
+
+
+def _norm(rows):
+    key = lambda r: tuple((v is None, v) for v in r)  # noqa: E731
+    return sorted(
+        [[round(v, 2) if isinstance(v, float) else v for v in r] for r in rows],
+        key=key,
+    )
+
+
+GS_CASES = [
+    (
+        "select n_regionkey, count(*) c from nation group by rollup(n_regionkey)",
+        "select n_regionkey, count(*) from nation group by n_regionkey"
+        " union all select null, count(*) from nation",
+    ),
+    (
+        "select l_returnflag, l_linestatus, sum(l_quantity) q from lineitem"
+        " group by cube(l_returnflag, l_linestatus)",
+        "select l_returnflag, l_linestatus, sum(l_quantity) from lineitem group by 1,2"
+        " union all select l_returnflag, null, sum(l_quantity) from lineitem group by 1"
+        " union all select null, l_linestatus, sum(l_quantity) from lineitem group by 2"
+        " union all select null, null, sum(l_quantity) from lineitem",
+    ),
+    (
+        "select l_returnflag, l_linestatus, count(*) from lineitem"
+        " group by grouping sets ((l_returnflag), (l_linestatus))",
+        "select l_returnflag, null, count(*) from lineitem group by 1"
+        " union all select null, l_linestatus, count(*) from lineitem group by 2",
+    ),
+]
+
+
+@pytest.mark.parametrize("sql,oracle_sql", GS_CASES)
+def test_grouping_sets(sql, oracle_sql, tpch_runner, oracle):
+    got = _norm(tpch_runner.execute(sql).rows)
+    want = _norm(sqlite_rows(oracle, oracle_sql))
+    assert got == want

@@ -1,0 +1,117 @@
+"""TPC-DS connector + reporting-query family vs the sqlite oracle
+(plugin/trino-tpcds analogue, SURVEY.md §2.12)."""
+
+import sqlite3
+
+import pytest
+
+from tests.oracle import assert_rows_match, load_tpcds_sqlite, sqlite_rows
+from trino_tpu.connectors.tpcds import create_tpcds_connector, row_count
+from trino_tpu.engine import LocalQueryRunner, Session
+
+SF = 0.01
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    conn = sqlite3.connect(":memory:")
+    load_tpcds_sqlite(conn, SF)
+    yield conn
+    conn.close()
+
+
+@pytest.fixture(scope="module")
+def runner():
+    r = LocalQueryRunner(Session(catalog="tpcds", schema="tiny"))
+    r.register_catalog("tpcds", create_tpcds_connector())
+    return r
+
+
+def test_row_counts(runner):
+    assert runner.execute("SELECT count(*) FROM store_sales").only_value() == row_count("store_sales", SF)
+    assert runner.execute("SELECT count(*) FROM date_dim").only_value() == row_count("date_dim", SF)
+    assert runner.execute("SELECT count(*) FROM item").only_value() == row_count("item", SF)
+
+
+# The classic star-join reporting family (q3/q42/q52/q55 shapes), with
+# predicates that select real rows at tiny scale.
+QUERIES = [
+    # q3 shape: brand revenue by year for one category in one month
+    """
+    select d_year, i_brand_id, i_brand, sum(ss_ext_sales_price) sum_agg
+    from date_dim, store_sales, item
+    where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+      and i_category = 'Books' and d_moy = 11
+    group by d_year, i_brand_id, i_brand
+    order by d_year, sum_agg desc, i_brand_id
+    limit 10
+    """,
+    # q42 shape: category revenue in one year/month
+    """
+    select d_year, i_category_id, i_category, sum(ss_ext_sales_price) s
+    from date_dim, store_sales, item
+    where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+      and d_moy = 12 and d_year = 2000
+    group by d_year, i_category_id, i_category
+    order by s desc, d_year, i_category_id, i_category
+    limit 10
+    """,
+    # q52 shape: brand revenue one year/month
+    """
+    select d_year, i_brand_id brand_id, i_brand brand, sum(ss_ext_sales_price) ext_price
+    from date_dim, store_sales, item
+    where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+      and d_moy = 11 and d_year = 1999
+    group by d_year, i_brand, i_brand_id
+    order by d_year, ext_price desc, brand_id
+    limit 10
+    """,
+    # q55 shape
+    """
+    select i_brand_id brand_id, i_brand brand, sum(ss_ext_sales_price) ext_price
+    from date_dim, store_sales, item
+    where d_date_sk = ss_sold_date_sk and ss_item_sk = i_item_sk
+      and i_category = 'Music' and d_moy = 12 and d_year = 2001
+    group by i_brand, i_brand_id
+    order by ext_price desc, brand_id
+    limit 10
+    """,
+    # store-dimension join + state rollup
+    """
+    select s_state, count(*) c, sum(ss_net_profit) p
+    from store_sales, store
+    where ss_store_sk = s_store_sk
+    group by s_state
+    order by s_state
+    """,
+    # customer dimension join
+    """
+    select c_birth_year, count(*) c
+    from store_sales, customer
+    where ss_customer_sk = c_customer_sk and c_birth_year < 1940
+    group by c_birth_year
+    order by c_birth_year
+    """,
+]
+
+
+@pytest.mark.parametrize("qi", range(len(QUERIES)))
+def test_tpcds_query(qi, runner, oracle):
+    sql = QUERIES[qi]
+    got = runner.execute(sql).rows
+    want = sqlite_rows(oracle, sql)
+    assert want, "oracle returned no rows — predicate selects nothing"
+    assert_rows_match(got, want, ordered=True, abs_tol=1e-2)
+
+
+def test_tpcds_distributed(oracle):
+    from trino_tpu.runtime import DistributedQueryRunner
+
+    r = DistributedQueryRunner(
+        Session(catalog="tpcds", schema="tiny"), n_workers=2, hash_partitions=2
+    )
+    r.register_catalog("tpcds", create_tpcds_connector())
+    sql = QUERIES[4]
+    got = r.execute(sql).rows
+    want = sqlite_rows(oracle, sql)
+    assert_rows_match(got, want, ordered=True, abs_tol=1e-2)

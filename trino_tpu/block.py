@@ -32,6 +32,25 @@ from trino_tpu import types as T
 MIN_CAPACITY = 16
 
 
+_ONES_CACHE: dict = {}
+
+
+def ones_mask(n: int) -> jnp.ndarray:
+    """Cached all-true mask of length n. valid_mask/live_mask are called
+    on the host side of every operator; a fresh jnp.ones per call is one
+    device dispatch each — ruinous over a tunneled device link. Inside a
+    jit trace the created value is a Tracer and MUST NOT be cached (it
+    would leak out of its trace); there it folds into the program as a
+    constant anyway."""
+    a = _ONES_CACHE.get(n)
+    if a is not None:
+        return a
+    a = jnp.ones(n, dtype=jnp.bool_)
+    if isinstance(n, int) and not isinstance(a, jax.core.Tracer):
+        _ONES_CACHE[n] = a
+    return a
+
+
 def bucket_capacity(n: int) -> int:
     """Static-shape discipline: round row counts up to a power of two so
     the set of compiled kernel shapes stays small (the analogue of
@@ -116,7 +135,7 @@ class Column:
 
     def valid_mask(self) -> jnp.ndarray:
         if self.valid is None:
-            return jnp.ones(self.data.shape[0], dtype=jnp.bool_)
+            return ones_mask(self.data.shape[0])
         return self.valid
 
     def with_data(self, data, valid="__same__") -> "Column":
@@ -226,7 +245,7 @@ class RelBatch:
 
     def live_mask(self) -> jnp.ndarray:
         if self.live is None:
-            return jnp.ones(self.capacity, dtype=jnp.bool_)
+            return ones_mask(self.capacity)
         return self.live
 
     def row_count(self) -> int:

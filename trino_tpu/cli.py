@@ -52,11 +52,13 @@ class _LocalBackend:
     def __init__(self, catalog: str, schema: str):
         from trino_tpu.connectors.blackhole import create_blackhole_connector
         from trino_tpu.connectors.memory import create_memory_connector
+        from trino_tpu.connectors.tpcds import create_tpcds_connector
         from trino_tpu.connectors.tpch import create_tpch_connector
         from trino_tpu.engine import LocalQueryRunner, Session
 
         self._runner = LocalQueryRunner(Session(catalog=catalog, schema=schema))
         self._runner.register_catalog("tpch", create_tpch_connector())
+        self._runner.register_catalog("tpcds", create_tpcds_connector())
         self._runner.register_catalog("memory", create_memory_connector())
         self._runner.register_catalog("blackhole", create_blackhole_connector())
 

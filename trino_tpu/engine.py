@@ -66,6 +66,14 @@ class MaterializedResult:
         return self.rows[0][0]
 
 
+def _raise_deferred_checks(ctx: dict) -> None:
+    """Assertions deferred to the end-of-query sync point (the results
+    are already materialized, so these bools are cheap)."""
+    for flag, msg in ctx.get("deferred_checks", ()):
+        if bool(flag):
+            raise RuntimeError(msg)
+
+
 class LocalQueryRunner:
     def __init__(self, session: Optional[Session] = None):
         self.session = session or Session()
@@ -94,6 +102,27 @@ class LocalQueryRunner:
             return MaterializedResult(
                 [[explain_text(plan)]], ["Query Plan"], [T.VARCHAR]
             )
+        if isinstance(stmt, ast.CreateTable):
+            from trino_tpu.connectors.spi import ColumnMetadata
+            from trino_tpu.sql.analyzer import resolve_type
+
+            conn, schema, table = self._resolve_target(stmt.table)
+            cols = [
+                ColumnMetadata(n, resolve_type(t)) for n, t in stmt.columns
+            ]
+            conn.metadata.create_table(schema, table, cols)
+            return MaterializedResult([[True]], ["result"], [T.BOOLEAN])
+        if isinstance(stmt, ast.CreateTableAs):
+            return self._execute_ctas(stmt)
+        if isinstance(stmt, ast.Insert):
+            return self._execute_insert(stmt.table, stmt.columns, stmt.query)
+        if isinstance(stmt, ast.DropTable):
+            conn, schema, table = self._resolve_target(stmt.table)
+            handle = conn.metadata.get_table_handle(schema, table)
+            if handle is None:
+                raise AnalysisError(f"table {schema}.{table} does not exist")
+            conn.metadata.drop_table(handle)
+            return MaterializedResult([[True]], ["result"], [T.BOOLEAN])
         if isinstance(stmt, ast.SetSession):
             # plan-shaping properties are part of the plan-cache key, so
             # no explicit invalidation is needed
@@ -147,6 +176,89 @@ class LocalQueryRunner:
     def _analyze(self, q: ast.Query) -> OutputNode:
         analyzer = Analyzer(self.catalogs, self.session.catalog, self.session.schema)
         return analyzer.plan(q)
+
+    # -- DML (BeginTableWrite/TableWriter/TableFinish path) --
+    def _resolve_target(self, parts):
+        cat, schema = self.session.catalog, self.session.schema
+        table = parts[-1]
+        if len(parts) == 2:
+            schema = parts[0]
+        elif len(parts) == 3:
+            cat, schema = parts[0], parts[1]
+        return self.catalogs.get(cat), schema, table
+
+    def _execute_ctas(self, stmt: ast.CreateTableAs) -> MaterializedResult:
+        from trino_tpu.connectors.spi import ColumnMetadata
+
+        output = self._analyze(stmt.query)
+        conn, schema, table = self._resolve_target(stmt.table)
+        cols = [
+            ColumnMetadata(n or f"_col{i}", f.type)
+            for i, (n, f) in enumerate(zip(output.names, output.fields))
+        ]
+        conn.metadata.create_table(schema, table, cols)
+        return self._write_into(conn, schema, table, output, list(output.names))
+
+    def _execute_insert(self, parts, columns, query: ast.Query) -> MaterializedResult:
+        conn, schema, table = self._resolve_target(parts)
+        output = self._analyze(query)
+        return self._write_into(
+            conn, schema, table, output,
+            list(columns) if columns else None,
+        )
+
+    def _write_into(
+        self, conn, schema: str, table: str, output: OutputNode,
+        insert_columns: Optional[List[str]],
+    ) -> MaterializedResult:
+        """Coerce the source onto the table schema and stream it into
+        the connector page sink (TableWriterOperator)."""
+        from trino_tpu.expr import ir
+        from trino_tpu.exec.operators import TableWriterOperator
+        from trino_tpu.sql import plan as P
+
+        handle = conn.metadata.get_table_handle(schema, table)
+        if handle is None:
+            raise AnalysisError(f"table {schema}.{table} does not exist")
+        meta = conn.metadata.get_table_metadata(handle)
+        src_fields = output.fields
+        if insert_columns is None:
+            insert_columns = [c.name for c in meta.columns[: len(src_fields)]]
+        if len(insert_columns) != len(src_fields):
+            raise AnalysisError(
+                f"INSERT has {len(src_fields)} columns but {len(insert_columns)} targets"
+            )
+        if len(set(insert_columns)) != len(insert_columns):
+            raise AnalysisError("duplicate target column names in INSERT/CTAS")
+        src_of = {name: i for i, name in enumerate(insert_columns)}
+        exprs = []
+        for col in meta.columns:
+            i = src_of.get(col.name)
+            if i is None:
+                exprs.append(ir.Cast(ir.Literal(None, T.UNKNOWN), col.type))
+                continue
+            e: ir.Expr = ir.InputRef(i, src_fields[i].type)
+            if src_fields[i].type != col.type:
+                e = ir.Cast(e, col.type)
+            exprs.append(e)
+        fields = tuple(P.Field(c.name, c.type) for c in meta.columns)
+        node = P.ProjectNode(output.child, tuple(exprs), fields)
+        planner = LocalPlanner(
+            self.catalogs,
+            batch_rows=self.session.batch_rows,
+            target_splits=self.session.target_splits,
+            dynamic_filtering=self.session.enable_dynamic_filtering,
+        )
+        physical = planner.plan(node)
+        ctx = self._execution_ctx()
+        pipelines, chain = physical.instantiate(ctx)
+        writer = TableWriterOperator(conn.page_sink(handle))
+        chain.append(writer)
+        for p in pipelines:
+            Driver(p).run()
+        Driver(Pipeline(chain)).run()
+        _raise_deferred_checks(ctx)
+        return MaterializedResult([[writer.rows_written]], ["rows"], [T.BIGINT])
 
     def _run_tracked(self, sql: str, stmt: ast.Query) -> MaterializedResult:
         """Query lifecycle: span tree + event listener dispatch around
@@ -226,15 +338,21 @@ class LocalQueryRunner:
         from trino_tpu.utils.tracing import TRACER
 
         output, physical = self._plan(q, sql_key)
-        pipelines, chain = physical.instantiate(self._execution_ctx())
+        ctx = self._execution_ctx()
+        pipelines, chain = physical.instantiate(ctx)
         sink = CollectorSink()
         chain.append(sink)
         with TRACER.span("execute"):
             for p in pipelines:
                 Driver(p).run()
             Driver(Pipeline(chain)).run()
+            checks = ctx.get("deferred_checks", ())
+            rows, flags = sink.rows_with(tuple(f for f, _ in checks))
+            for v, (_, msg) in zip(flags, checks):
+                if v:
+                    raise RuntimeError(msg)
         return MaterializedResult(
-            sink.rows(),
+            rows,
             list(output.names),
             [f.type for f in output.fields],
         )
@@ -245,7 +363,8 @@ class LocalQueryRunner:
         from trino_tpu.exec.stats import instrument, render_stats
 
         output, physical = self._plan(q, sql_key=None)
-        pipelines, chain = physical.instantiate(self._execution_ctx())
+        ctx = self._execution_ctx()
+        pipelines, chain = physical.instantiate(ctx)
         sink = CollectorSink()
         chain.append(sink)
         groups = []
@@ -259,5 +378,6 @@ class LocalQueryRunner:
         for p in wrapped_pipelines:
             Driver(p).run()
         Driver(Pipeline(main_ops)).run()
+        _raise_deferred_checks(ctx)
         text = explain_text(output) + "\n\n" + render_stats(groups)
         return MaterializedResult([[text]], ["Query Plan"], [T.VARCHAR])

@@ -65,6 +65,7 @@ def split_page(page: Page, pid: np.ndarray, n: int) -> List[Page]:
             valid_pos.append(len(flat))
             flat.append(v)
     parts = native.partition_scatter(flat, pid, n)
+    counts = np.bincount(pid[pid >= 0], minlength=n)
     width = page.width
     out = []
     for p in range(n):
@@ -77,8 +78,9 @@ def split_page(page: Page, pid: np.ndarray, n: int) -> List[Page]:
             else:
                 valids.append(parts[p][vi])
                 vi += 1
-        rows = len(cols[0]) if cols else 0
-        out.append(Page(page.types, cols, valids, page.dictionaries, rows))
+        out.append(
+            Page(page.types, cols, valids, page.dictionaries, int(counts[p]))
+        )
     return out
 
 

@@ -162,6 +162,16 @@ def make_filter_project_fn(
     return jax.jit(fn)
 
 
+def compose_batch_fns(f1, f2):
+    """Fuse two per-batch device programs into one (plan-time; the
+    composed jit is cached with the plan). On remote-attached devices
+    every separate program launch costs a host round trip, so the
+    planner folds adjacent filter/project stages — and folds them into
+    the consuming blocking operator's kernel — the way XLA fusion folds
+    elementwise ops into the matmul."""
+    return jax.jit(lambda b: f2(f1(b)))
+
+
 class FilterProjectOperator(Operator):
     """Bound filter/projections fused into one jitted device program —
     the FilterAndProjectOperator + PageProcessor analogue
@@ -253,6 +263,17 @@ def _apply_sort(batch: RelBatch, keys: Sequence[SortKey]) -> jnp.ndarray:
     )
 
 
+@partial(jax.jit, static_argnames=("keys", "pre_fn"))
+def _concat_sort_pre(
+    parts: Tuple[RelBatch, ...], keys: Tuple[SortKey, ...], pre_fn
+) -> RelBatch:
+    """_concat_sort with a fused upstream filter/project applied to each
+    part inside the same program."""
+    return _concat_sort.__wrapped__(
+        tuple(pre_fn(p) for p in parts), keys
+    )
+
+
 @partial(jax.jit, static_argnames=("keys",))
 def _concat_sort(parts: Tuple[RelBatch, ...], keys: Tuple[SortKey, ...]) -> RelBatch:
     """Consolidate + sort + front-pack in ONE device program — eager op
@@ -283,9 +304,10 @@ class SortOperator(Operator):
 
     def __init__(self, keys: Sequence[SortKey],
                  input_schema: Sequence[Tuple[T.DataType, Optional[Dictionary]]],
-                 memory_context=None):
+                 memory_context=None, pre_fn=None):
         self._keys = list(keys)
         self._schema = list(input_schema)
+        self._pre = pre_fn  # fused upstream filter/project (plan-time jit)
         self._inputs: List[RelBatch] = []
         self._out: Optional[RelBatch] = None
         # revocable accumulation (OrderByOperator's spill path): revoke
@@ -332,10 +354,15 @@ class SortOperator(Operator):
                 from trino_tpu.exec.spill import FileSpiller
 
                 self._spiller = FileSpiller()
-            run = _concat_sort(tuple(self._inputs), tuple(self._keys)).compact()
+            run = self._sorted(tuple(self._inputs)).compact()
             self._spiller.spill(run)
             self._inputs = []
         self._track_memory()
+
+    def _sorted(self, parts: tuple) -> RelBatch:
+        if self._pre is not None:
+            return _concat_sort_pre(parts, tuple(self._keys), self._pre)
+        return _concat_sort(parts, tuple(self._keys))
 
     def finish(self) -> None:
         if self._finishing:
@@ -347,10 +374,19 @@ class SortOperator(Operator):
             self._inputs = []
             spiller, self._spiller = self._spiller, None
         if spiller is not None:
-            batches.extend(spiller.unspill())
+            # spilled runs already passed the fused pre stage; fold the
+            # remaining raw inputs first, then merge runs un-prefixed
+            folded = [self._sorted(tuple(batches))] if batches else []
+            folded.extend(spiller.unspill())
             spiller.close()
-        batches = batches or [empty_batch(self._schema)]
-        self._out = _concat_sort(tuple(batches), tuple(self._keys))
+            self._out = _concat_sort(tuple(folded), tuple(self._keys))
+        elif batches:
+            self._out = self._sorted(tuple(batches))
+        else:
+            # no input at all: emit the (post-pre) empty schema directly
+            self._out = _concat_sort(
+                (empty_batch(self._schema),), tuple(self._keys)
+            )
         if self._memory is not None:
             self._memory.set_bytes(0)
             self._memory.set_revocable_bytes(0)
@@ -368,14 +404,20 @@ class TopNOperator(Operator):
     (TopNOperator.java:35)."""
 
     def __init__(self, keys: Sequence[SortKey], n: int,
-                 input_schema: Sequence[Tuple[T.DataType, Optional[Dictionary]]]):
+                 input_schema: Sequence[Tuple[T.DataType, Optional[Dictionary]]],
+                 pre_fn=None):
         self._keys = list(keys)
         self._n = n
         self._schema = list(input_schema)
+        self._pre = pre_fn
         self._reservoir: Optional[RelBatch] = None
         self._out: Optional[RelBatch] = None
 
     def add_input(self, batch: RelBatch) -> None:
+        if self._pre is not None:
+            # fused into the same program as the reservoir merge below
+            # only when shapes allow; one extra launch is still bounded
+            batch = self._pre(batch)
         parts = (
             (batch,)
             if self._reservoir is None
@@ -764,16 +806,22 @@ _MERGE_REDUCER = {"sum": "sum", "avg": "sum", "count": "sum",
                   "any": "first"}
 
 @partial(jax.jit, static_argnames=("reducers", "out_capacity"))
-def _merge_group_states(a, b, reducers: tuple, out_capacity: int):
-    """Concat two (keys, valids, used, vals, cnts) group-state sets and
-    re-group-reduce them — the whole merge is ONE device program."""
-    keys = [jnp.concatenate([x, y]) for x, y in zip(a[0], b[0])]
-    valids = [jnp.concatenate([x, y]) for x, y in zip(a[1], b[1])]
-    mask = jnp.concatenate([a[2], b[2]])
+def _merge_group_states(states: tuple, reducers: tuple, out_capacity: int):
+    """Concat N (keys, valids, used, vals, cnts) group-state sets and
+    re-group-reduce them — the whole N-way merge is ONE device program
+    (per-batch pairwise merges would cost a program launch each)."""
+    n_keys = len(states[0][0])
+    keys = [
+        jnp.concatenate([s[0][i] for s in states]) for i in range(n_keys)
+    ]
+    valids = [
+        jnp.concatenate([s[1][i] for s in states]) for i in range(n_keys)
+    ]
+    mask = jnp.concatenate([s[2] for s in states])
     values, vvalids, reds = [], [], []
     for i, mred in enumerate(reducers):
-        v = jnp.concatenate([a[3][i], b[3][i]])
-        c = jnp.concatenate([a[4][i], b[4][i]])
+        v = jnp.concatenate([s[3][i] for s in states])
+        c = jnp.concatenate([s[4][i] for s in states])
         values.append(v)
         vvalids.append((c > 0) if mred == "first" else None)
         reds.append(mred)
@@ -783,7 +831,58 @@ def _merge_group_states(a, b, reducers: tuple, out_capacity: int):
     gk, gv, used, vals, _, _, ovf = G.sort_group_reduce(
         keys, valids, mask, values, tuple(vvalids), tuple(reds), out_capacity
     )
-    return (gk, gv, used, list(vals[0::2]), list(vals[1::2])), ovf
+    return (tuple(gk), tuple(gv), used, tuple(vals[0::2]), tuple(vals[1::2])), ovf
+
+
+@jax.jit
+def _any_flags(flags: tuple):
+    return jnp.any(jnp.stack(flags))
+
+
+@partial(jax.jit, static_argnames=("groups", "aggs", "cap", "pre_fn", "dense_dims"))
+def _agg_ingest(batch: RelBatch, groups: tuple, aggs: tuple, cap: int, pre_fn,
+                dense_dims=None):
+    """Fused upstream filter/project + per-batch group-reduce in ONE
+    device program (scan->filter->project->partial-aggregate is the Q1
+    hot path; separate launches pay a host round trip each on
+    remote-attached devices)."""
+    if pre_fn is not None:
+        batch = pre_fn(batch)
+    keys = [batch.columns[c].data for c in groups]
+    valids = [batch.columns[c].valid_mask() for c in groups]
+    live = batch.live_mask()
+    values, vvalids, reds = [], [], []
+    for a in aggs:
+        if a.arg_channel is None:
+            values.append(live.astype(jnp.int64))
+            vvalids.append(None)
+        else:
+            col = batch.columns[a.arg_channel]
+            values.append(col.data)
+            vvalids.append(col.valid)
+        reds.append(_BATCH_REDUCER[a.kind])
+    if dense_dims is not None:
+        return G.dense_group_reduce(
+            keys, valids, live, values, tuple(vvalids), tuple(reds),
+            dense_dims, cap,
+        )
+    return G.sort_group_reduce(
+        keys, valids, live, values, tuple(vvalids), tuple(reds), cap
+    )
+
+
+@partial(jax.jit, static_argnames=("aggs", "arg_types"))
+def _finalize_grouped(acc, aggs: tuple, arg_types: tuple):
+    """Whole grouped finalize as ONE device program (the eager
+    per-aggregate finalize costs one host dispatch per op — ruinous over
+    a tunneled device link)."""
+    gk, gv, used, vals, cnts = acc
+    out = []
+    for a, val, cnt, arg_t in zip(aggs, vals, cnts, arg_types):
+        state = (val,) if a.kind in ("count", "count_star") else (val, cnt)
+        col = _agg_output(a, state, arg_t, None)
+        out.append((col.data, col.valid))
+    return out
 
 
 _GLOBAL_FN_CACHE: Dict[Tuple[AggSpec, ...], object] = {}
@@ -849,6 +948,8 @@ class HashAggregationOperator(Operator):
         initial_capacity: int = 1024,
         step: str = "single",
         memory_context=None,
+        deferred_checks: Optional[List] = None,
+        pre_fn=None,
     ):
         """step: "single" (raw rows in, results out), "partial" (raw rows
         in, serialized accumulator state out) or "final" (accumulator
@@ -859,13 +960,17 @@ class HashAggregationOperator(Operator):
         it straight from the input schema."""
         assert step in ("single", "partial", "final"), step
         self._step = step
+        self._pre = pre_fn  # fused upstream stage (plan-time jit)
         self._group_channels = list(group_channels)
         self._aggs = list(aggregates)
         self._schema = list(input_schema)
         self._global = not self._group_channels
         self._cap = initial_capacity
-        # accumulated group state: (keys, valids, used, vals, cnts)
+        # accumulated group state: (keys, valids, used, vals, cnts);
+        # per-batch states collect in _pending and merge in ONE N-way
+        # device program at the next materialization point
         self._acc = None
+        self._pending: List[tuple] = []
         self._gstate = None
         self._out: Optional[RelBatch] = None
         # spill support (SpillableHashAggregationBuilder analogue):
@@ -886,6 +991,45 @@ class HashAggregationOperator(Operator):
             input_schema[a.arg_channel] if a.arg_channel is not None else (None, None)
             for a in self._aggs
         ]
+        # Static group-cardinality bound: dictionary-coded and boolean
+        # keys bound the distinct-group count at PLAN time, so the table
+        # can never overflow and the per-batch host sync on the overflow
+        # flag disappears (the host<->device round trip dominates on a
+        # tunneled device — the reason Trino precomputes hash channels
+        # is the same "decide statically, not per row" discipline).
+        bound = 1
+        dims = []
+        for c in self._group_channels:
+            t, d = self._schema[c]
+            if t.is_string and d is not None and len(d) > 0:
+                dims.append(len(d))
+                bound *= len(d) + 1  # +1: the NULL group
+            elif t.kind == T.TypeKind.BOOLEAN:
+                dims.append(2)
+                bound *= 3  # true/false/null
+            else:
+                bound = 0
+                break
+        self._static_bound = bound if 0 < bound <= (1 << 16) else None
+        # dense-slot reduce: tiny bounded domains skip sorting entirely
+        # (per-group masked reductions unroll into one fused program)
+        self._dense_dims = (
+            tuple(dims)
+            if self._static_bound is not None
+            and bound <= 64
+            and self._group_channels
+            and all(
+                _BATCH_REDUCER[a.kind] in ("sum", "count", "min", "max")
+                for a in self._aggs
+            )
+            else None
+        )
+        self._deferred_ovf: List = []
+        # execution-level list of (device flag, message): checked ONCE
+        # after results materialize, so no mid-query host sync
+        self._checks = deferred_checks
+        if self._static_bound is not None:
+            self._cap = max(bucket_capacity(self._static_bound), 16)
         if self._global and step != "final":
             self._update = _global_update_fn(tuple(self._aggs))
 
@@ -906,37 +1050,58 @@ class HashAggregationOperator(Operator):
 
     def add_input(self, batch: RelBatch) -> None:
         if self._step == "final":
+            if self._pre is not None:
+                batch = self._pre(batch)
             self._add_state_input(batch)
             return
         if self._global:
+            if self._pre is not None:
+                batch = self._pre(batch)
             if self._gstate is None:
                 self._gstate = self._global_init()
             self._gstate = self._update(self._gstate, batch)
             return
-        keys = [batch.columns[c].data for c in self._group_channels]
-        valids = [batch.columns[c].valid_mask() for c in self._group_channels]
-        live, values, vvalids, reds = self._batch_values(batch)
         while True:
-            gk, gv, used, vals, cnts, _, ovf = G.sort_group_reduce(
-                keys, valids, live, values, vvalids, reds, self._cap
+            gk, gv, used, vals, cnts, _, ovf = _agg_ingest(
+                batch, tuple(self._group_channels), tuple(self._aggs),
+                self._cap, self._pre, self._dense_dims,
             )
+            if self._static_bound is not None:
+                # overflow impossible by the plan-time bound: defer the
+                # flag and verify ONCE at finish (fail-loud guard against
+                # a runtime dictionary outgrowing the plan-time one)
+                self._deferred_ovf.append(ovf)
+                break
             if not bool(ovf):
                 break
             self._cap *= 2  # rebuild-at-larger-capacity (tryRehash analogue)
-        new = (gk, gv, used, vals, cnts)
+        new = (tuple(gk), tuple(gv), used, tuple(vals), tuple(cnts))
         with self._state_lock:
-            self._acc = new if self._acc is None else self._merge(self._acc, new)
+            self._pending.append(new)
         self._track_memory()
 
-    def _merge(self, a, b):
-        """Merge two group-state sets (partial->final merge), one device
-        program per attempt; host doubles capacity on overflow."""
+    def _merge_pending_locked(self) -> None:
+        """Fold _pending (+ current acc) into ONE merged state with a
+        single N-way device program (caller holds _state_lock)."""
+        states = ([self._acc] if self._acc is not None else []) + self._pending
+        self._pending = []
+        if not states:
+            return
+        if len(states) == 1:
+            self._acc = states[0]
+            return
         reducers = tuple(_MERGE_REDUCER[x.kind] for x in self._aggs)
         while True:
-            merged, ovf = _merge_group_states(tuple(a), tuple(b), reducers, self._cap)
+            merged, ovf = _merge_group_states(
+                tuple(states), reducers, self._cap
+            )
+            if self._static_bound is not None:
+                self._deferred_ovf.append(ovf)
+                break
             if not bool(ovf):
-                return merged
+                break
             self._cap *= 2
+        self._acc = merged
 
     # -- final step: consume serialized accumulator state --
     def _add_state_input(self, batch: RelBatch) -> None:
@@ -951,9 +1116,9 @@ class HashAggregationOperator(Operator):
         valids = [batch.columns[c].valid_mask() for c in range(k)]
         vals = [batch.columns[k + 2 * i].data for i in range(len(self._aggs))]
         cnts = [batch.columns[k + 2 * i + 1].data for i in range(len(self._aggs))]
-        new = ([*keys], [*valids], live, [*vals], [*cnts])
+        new = (tuple(keys), tuple(valids), live, tuple(vals), tuple(cnts))
         with self._state_lock:
-            self._acc = new if self._acc is None else self._merge(self._acc, new)
+            self._pending.append(new)
         self._track_memory()
 
     def _merge_global_state(self, batch: RelBatch, live) -> None:
@@ -1033,9 +1198,11 @@ class HashAggregationOperator(Operator):
         from ANOTHER task's thread (MemoryPool.reserve picks victims), so
         the whole snapshot-spill-reset runs under the state lock."""
         with self._state_lock:
-            if self._acc is None or self._in_finish:
-                # nothing to give back, or finishing (finish owns state)
-                return
+            if self._in_finish:
+                return  # finish owns state
+            self._merge_pending_locked()
+            if self._acc is None:
+                return  # nothing to give back
             if self._spiller is None:
                 from trino_tpu.exec.spill import FileSpiller
 
@@ -1053,8 +1220,8 @@ class HashAggregationOperator(Operator):
         if self._memory is None or self._in_finish:
             return
         total = 0
-        if self._acc is not None:
-            gk, gv, used, vals, cnts = self._acc
+        for st in ([self._acc] if self._acc is not None else []) + list(self._pending):
+            gk, gv, used, vals, cnts = st
             for arr in [*gk, *gv, used, *vals, *cnts]:
                 total += arr.size * arr.dtype.itemsize
         try:
@@ -1062,7 +1229,7 @@ class HashAggregationOperator(Operator):
         except Exception:
             # pool exhausted even after revoking others: spill our own
             # state (self-revocation) and account the reset footprint
-            if self._acc is None:
+            if self._acc is None and not self._pending:
                 raise
             self._revoke_memory()
             return
@@ -1105,9 +1272,23 @@ class HashAggregationOperator(Operator):
             for b in spiller.unspill():
                 self._add_state_input(b)
             spiller.close()
+        with self._state_lock:
+            self._merge_pending_locked()
         if self._memory is not None and not self._global:
             self._memory.set_bytes(0)
             self._memory.set_revocable_bytes(0)
+        if self._deferred_ovf:
+            flag = _any_flags(tuple(self._deferred_ovf))
+            msg = (
+                "group table overflowed its plan-time bound "
+                "(runtime dictionary larger than planned)"
+            )
+            if self._checks is not None:
+                # deferred to the end-of-query sync point
+                self._checks.append((flag, msg))
+            elif bool(flag):
+                raise RuntimeError(msg)
+            self._deferred_ovf = []
         if self._step == "partial":
             self._emit_partial()
             return
@@ -1139,10 +1320,16 @@ class HashAggregationOperator(Operator):
         for ch, k, v in zip(self._group_channels, gk, gv):
             t, d = self._schema[ch]
             cols.append(Column(t, k, v, d))
-        for i, (a, val, cnt) in enumerate(zip(self._aggs, vals, cnts)):
-            state = (val,) if a.kind in ("count", "count_star") else (val, cnt)
-            arg_t, arg_d = self._arg_meta[i]
-            cols.append(_agg_output(a, state, arg_t, arg_d))
+        outs = _finalize_grouped(
+            (tuple(gk), tuple(gv), used, tuple(vals), tuple(cnts)),
+            tuple(self._aggs),
+            tuple(t for t, _ in self._arg_meta),
+        )
+        for a, (arg_t, arg_d), (data, valid) in zip(
+            self._aggs, self._arg_meta, outs
+        ):
+            d = arg_d if a.kind in ("min", "max", "any") else None
+            cols.append(Column(a.out_type, data, valid, d))
         self._out = RelBatch(cols, used)
 
     def get_output(self) -> Optional[RelBatch]:
@@ -1542,6 +1729,29 @@ class CrossJoinOperator(Operator):
 # ---------------------------------------------------------------------------
 
 
+class TableWriterOperator(Operator):
+    """Terminal sink writing batches into a connector page sink
+    (TableWriterOperator + TableFinishOperator collapsed — the commit
+    handshake is the sink's finish(), whose row count lands in
+    `rows_written`; SURVEY.md §2.6)."""
+
+    def __init__(self, sink):
+        self._sink = sink
+        self.rows_written = 0
+
+    def add_input(self, batch: RelBatch) -> None:
+        self._sink.append(batch)
+
+    def finish(self) -> None:
+        if self._finishing:
+            return
+        self._finishing = True
+        self.rows_written = self._sink.finish()
+
+    def is_finished(self) -> bool:
+        return self._finishing
+
+
 class BufferSink(Operator):
     """Collects batches for a later pipeline (the LocalExchange handoff,
     main/operator/exchange/LocalExchange.java:67 — single-buffer form)."""
@@ -1600,10 +1810,13 @@ class CollectorSink(Operator):
         return self._finishing
 
     def rows(self) -> List[list]:
-        # ONE bulk device->host transfer for every result batch: remote
-        # devices pay a round trip per fetch, so never fetch per column
-        host_batches = jax.device_get(self.batches)
+        return self.rows_with(())[0]
+
+    def rows_with(self, extra: tuple):
+        """Fetch all result batches PLUS auxiliary device values (e.g.
+        deferred assertion flags) in ONE device->host round trip."""
+        host_batches, host_extra = jax.device_get((self.batches, list(extra)))
         out = []
         for b in host_batches:
             out.extend(b.to_pylists())
-        return out
+        return out, host_extra

@@ -251,6 +251,116 @@ def _seg_scan(op, neutral, flags, vals):
     return out
 
 
+@partial(jax.jit, static_argnames=("dims", "reducers", "out_capacity"))
+def dense_group_reduce(
+    keys: Sequence[jnp.ndarray],
+    valids: Sequence[jnp.ndarray],
+    mask: jnp.ndarray,
+    values: Sequence[jnp.ndarray],
+    value_valids: Sequence[Optional[jnp.ndarray]],
+    reducers: tuple,
+    dims: tuple,  # per key: dictionary size (codes in [0, d)); NULL -> d
+    out_capacity: int,
+):
+    """Group-reduce for PLAN-TIME-BOUNDED key domains (dictionary/bool
+    codes): the group id is the dense mixed-radix composition of the
+    codes — no sort, no hash table, no scatter. Each group reduces with
+    a masked whole-column reduction; the per-group loop unrolls into one
+    fused XLA program (total domain is capped small by the caller).
+    Same output contract as sort_group_reduce; group ids are slot
+    positions rather than dense-from-zero, which every consumer already
+    handles via `used`."""
+    n = mask.shape[0]
+    radices = tuple(d + 1 for d in dims)  # one extra slot per key: NULL
+    total = 1
+    for r in radices:
+        total *= r
+    assert total <= out_capacity
+    gid = jnp.zeros(n, dtype=jnp.int32)
+    out_of_domain = jnp.asarray(False)
+    for k, v, d, r in zip(keys, valids, dims, radices):
+        raw = k.astype(jnp.int32)
+        # a live valid code outside [0, d) means the runtime dictionary
+        # outgrew the plan-time bound — surface it via the overflow flag
+        # (fail-loud, same contract as sort_group_reduce)
+        out_of_domain = out_of_domain | jnp.any(
+            mask & v & ((raw < 0) | (raw >= d))
+        )
+        code = jnp.clip(raw, 0, d - 1)
+        code = jnp.where(v, code, d)
+        gid = gid * r + code
+
+    def pad(x, fill=0):
+        return jnp.pad(x, (0, out_capacity - total), constant_values=fill)
+
+    # decode slot -> key codes/valids (mixed radix, last key fastest)
+    slots = jnp.arange(total, dtype=jnp.int32)
+    digits = []
+    rem = slots
+    for r in reversed(radices):
+        digits.append(rem % r)
+        rem = rem // r
+    digits.reverse()
+    group_keys = []
+    group_valids = []
+    for (k, d), digit in zip(zip(keys, dims), digits):
+        group_keys.append(pad(jnp.clip(digit, 0, d - 1).astype(k.dtype)))
+        group_valids.append(pad(digit < d, False))
+
+    results = []
+    counts = []
+    for v, vv, red in zip(values, value_valids, reducers):
+        w = mask if vv is None else (mask & vv)
+        outs = []
+        cnts = []
+        for g in range(total):
+            sel = w & (gid == g)
+            cnts.append(jnp.sum(sel.astype(jnp.int64)))
+            if red in ("sum", "count"):
+                acc_dt = (
+                    jnp.float64
+                    if jnp.issubdtype(v.dtype, jnp.floating)
+                    else jnp.int64
+                )
+                contrib = (
+                    sel.astype(jnp.int64)
+                    if red == "count"
+                    else jnp.where(sel, v.astype(acc_dt), jnp.zeros((), acc_dt))
+                )
+                outs.append(jnp.sum(contrib))
+            elif red in ("min", "max"):
+                if jnp.issubdtype(v.dtype, jnp.floating):
+                    neutral = jnp.inf if red == "min" else -jnp.inf
+                elif v.dtype == jnp.bool_:
+                    neutral = red == "min"
+                else:
+                    info = jnp.iinfo(v.dtype)
+                    neutral = info.max if red == "min" else info.min
+                contrib = jnp.where(sel, v, jnp.asarray(neutral, v.dtype))
+                outs.append(
+                    jnp.min(contrib) if red == "min" else jnp.max(contrib)
+                )
+            else:
+                raise ValueError(red)
+        results.append(pad(jnp.stack(outs)))
+        counts.append(pad(jnp.stack(cnts)))
+    # used: any live row landed in the slot
+    row_cnt = jnp.stack(
+        [jnp.sum((mask & (gid == g)).astype(jnp.int32)) for g in range(total)]
+    )
+    used = pad(row_cnt > 0, False)
+    n_groups = jnp.sum(used.astype(jnp.int32))
+    return (
+        group_keys,
+        group_valids,
+        used,
+        results,
+        counts,
+        n_groups,
+        out_of_domain,
+    )
+
+
 @partial(jax.jit, static_argnames=("reducers", "out_capacity"))
 def sort_group_reduce(
     keys: Sequence[jnp.ndarray],

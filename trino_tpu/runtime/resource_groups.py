@@ -97,11 +97,12 @@ class ResourceGroupManager:
         group = self._resolve(user, source)
         chain = self._chain(group)
         with self._lock:
-            if group.queued >= group.spec.max_queued:
-                raise QueryQueueFullError(
-                    f"group {group.path()} queue is full "
-                    f"({group.spec.max_queued})"
-                )
+            for g in chain:  # queue caps apply at EVERY level of the tree
+                if g.queued >= g.spec.max_queued:
+                    raise QueryQueueFullError(
+                        f"group {g.path()} queue is full "
+                        f"({g.spec.max_queued})"
+                    )
             for g in chain:
                 g.queued += 1
             try:

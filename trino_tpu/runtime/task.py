@@ -185,6 +185,9 @@ class TaskExecution:
             for p in pipelines:
                 Driver(p).run()
             Driver(Pipeline(chain)).run()
+            from trino_tpu.engine import _raise_deferred_checks
+
+            _raise_deferred_checks(ctx)
             self.state = "finished"
         except BaseException as e:
             self.failure = "".join(

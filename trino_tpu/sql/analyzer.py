@@ -324,21 +324,7 @@ class ExprConverter:
 
     def _convert_cast(self, e: ast.Cast) -> ir.Expr:
         a = self.convert(e.operand)
-        t = e.target
-        mapping = {
-            "boolean": T.BOOLEAN, "tinyint": T.TINYINT, "smallint": T.SMALLINT,
-            "integer": T.INTEGER, "bigint": T.BIGINT, "real": T.REAL,
-            "double": T.DOUBLE, "date": T.DATE, "timestamp": T.TIMESTAMP,
-        }
-        if t.name in mapping:
-            return ir.Cast(a, mapping[t.name])
-        if t.name == "decimal":
-            p = t.params[0] if t.params else 18
-            s = t.params[1] if len(t.params) > 1 else 0
-            return ir.Cast(a, T.decimal(min(p, 18), s))
-        if t.name in ("varchar", "char"):
-            return ir.Cast(a, T.VARCHAR)
-        raise AnalysisError(f"cannot cast to {t.name}")
+        return ir.Cast(a, resolve_type(e.target))
 
     def _convert_call(self, e: ast.FunctionCall) -> ir.Expr:
         name = e.name
@@ -515,6 +501,39 @@ def _find_window_calls(e: ast.Expression) -> List[ast.WindowCall]:
     return out
 
 
+def resolve_type(t: ast.TypeName) -> T.DataType:
+    """TypeName AST -> DataType (shared by CAST analysis and DDL)."""
+    mapping = {
+        "boolean": T.BOOLEAN, "tinyint": T.TINYINT, "smallint": T.SMALLINT,
+        "integer": T.INTEGER, "bigint": T.BIGINT, "real": T.REAL,
+        "double": T.DOUBLE, "date": T.DATE, "timestamp": T.TIMESTAMP,
+    }
+    if t.name in mapping:
+        return mapping[t.name]
+    if t.name == "decimal":
+        p = t.params[0] if t.params else 18
+        s = t.params[1] if len(t.params) > 1 else 0
+        return T.decimal(min(p, 18), s)
+    if t.name in ("varchar", "char"):
+        return T.VARCHAR
+    raise AnalysisError(f"unsupported type {t.name}")
+
+
+def _const_fold(x: ir.Expr) -> Optional[ir.Literal]:
+    """Literal, negate(Literal) or cast(Literal) -> folded Literal."""
+    if isinstance(x, ir.Literal):
+        return x
+    if isinstance(x, ir.Call) and x.name == "negate":
+        inner = _const_fold(x.args[0])
+        if inner is not None and inner.value is not None:
+            return ir.Literal(-inner.value, x.type)
+    if isinstance(x, ir.Cast):
+        inner = _const_fold(x.arg)
+        if inner is not None:
+            return ir.Literal(inner.value, x.type)
+    return None
+
+
 def _find_agg_calls(e: ast.Expression) -> List[ast.FunctionCall]:
     out: List[ast.FunctionCall] = []
 
@@ -612,7 +631,51 @@ class Analyzer:
             )
         if isinstance(q.body, ast.SetOperation):
             return self._plan_set_op(q, ctes)
+        if isinstance(q.body, ast.ValuesBody):
+            if q.order_by or q.limit is not None or q.offset:
+                raise AnalysisError("ORDER BY/LIMIT over VALUES not supported")
+            return self._plan_values_body(q.body)
         raise AnalysisError("unsupported query body")
+
+    def _plan_values_body(self, body: ast.ValuesBody):
+        """VALUES rows -> ValuesNode: cells must be constant-foldable
+        (Values analogue of parser/sql/tree/Values)."""
+        conv = ExprConverter(Scope([]))
+        rows = []
+        col_types: List[Optional[T.DataType]] = []
+        for r in body.rows:
+            vals = []
+            for i, cell in enumerate(r):
+                lit = _const_fold(conv.convert(cell))
+                if lit is None:
+                    raise AnalysisError("VALUES cells must be constants")
+                vals.append(lit.value)
+                t = lit.type
+                if i >= len(col_types):
+                    col_types.append(t)
+                else:
+                    prev = col_types[i]
+                    if prev is None or prev.kind == T.TypeKind.UNKNOWN:
+                        col_types[i] = t
+                    elif t.kind != T.TypeKind.UNKNOWN and t != prev:
+                        u = T.common_super_type(prev, t)
+                        if u is None:
+                            raise AnalysisError(
+                                f"VALUES column {i}: incompatible types {prev} and {t}"
+                            )
+                        col_types[i] = u
+            if len(r) != len(body.rows[0]):
+                raise AnalysisError("VALUES rows differ in width")
+            rows.append(tuple(vals))
+        types = [
+            t if t is not None and t.kind != T.TypeKind.UNKNOWN else T.BIGINT
+            for t in col_types
+        ]
+        names = [f"_col{i}" for i in range(len(types))]
+        fields = tuple(P.Field(n, t) for n, t in zip(names, types))
+        node = P.ValuesNode(fields, tuple(rows))
+        scope = Scope([ScopeField(None, n, t) for n, t in zip(names, types)])
+        return node, scope, names
 
     def _plan_set_op(self, q: ast.Query, ctes) -> Tuple[P.PlanNode, Scope, List[str]]:
         def plan_body(body) -> Tuple[P.PlanNode, Scope, List[str]]:
@@ -620,6 +683,8 @@ class Analyzer:
                 return self.plan_query_spec(body, (), None, 0, ctes)
             if isinstance(body, ast.SetOperation):
                 return plan_set(body)
+            if isinstance(body, ast.ValuesBody):
+                return self._plan_values_body(body)
             raise AnalysisError("unsupported set operation term")
 
         def plan_set(s: ast.SetOperation) -> Tuple[P.PlanNode, Scope, List[str]]:
@@ -709,7 +774,13 @@ class Analyzer:
             for c in _find_agg_calls(e):
                 if c not in agg_calls:
                     agg_calls.append(c)
-        if group_asts or agg_calls:
+        if spec.group_by_sets is not None:
+            self._plan_grouping_sets(
+                builder, group_asts, spec.group_by_sets, agg_calls, ctes
+            )
+            if spec.having is not None:
+                self._plan_predicate(builder, spec.having, ctes)
+        elif group_asts or agg_calls:
             self._plan_aggregation(builder, group_asts, agg_calls, ctes)
             if spec.having is not None:
                 self._plan_predicate(builder, spec.having, ctes)
@@ -1372,6 +1443,64 @@ class Analyzer:
         for j, (call, a) in enumerate(zip(agg_calls, aggs)):
             post_fields.append(ScopeField(None, None, a.out_type))
             replacements[call] = (k + j, a.out_type)
+        builder.scope = Scope(post_fields)
+        builder.replacements = replacements
+
+    def _plan_grouping_sets(
+        self, builder: Builder, group_asts, sets, agg_calls, ctes
+    ) -> None:
+        """ROLLUP/CUBE/GROUPING SETS as a UNION ALL of per-set
+        aggregations over the same source, each projected onto the
+        canonical [all keys..., aggs...] layout with typed NULLs for
+        absent keys (the GroupIdNode expansion, unrolled)."""
+        base_node, base_scope = builder.node, builder.scope
+        base_repl = dict(builder.replacements)
+        key_types = [
+            ExprConverter(base_scope, base_repl).convert(g).type
+            for g in group_asts
+        ]
+        branches = []
+        # larger sets first so the union schema carries real dictionaries
+        for s in sorted(sets, key=len, reverse=True):
+            b = Builder(base_node, base_scope)
+            b.replacements = dict(base_repl)
+            self._plan_aggregation(
+                b, [group_asts[i] for i in s], agg_calls, ctes
+            )
+            k_set = len(s)
+            exprs: List[ir.Expr] = []
+            fields: List[P.Field] = []
+            pos_of = {g: p for p, g in enumerate(s)}
+            for j, t in enumerate(key_types):
+                if j in pos_of:
+                    exprs.append(ir.InputRef(pos_of[j], t))
+                else:
+                    exprs.append(ir.Cast(ir.Literal(None, T.UNKNOWN), t))
+                fields.append(P.Field(None, t))
+            for i2, call in enumerate(agg_calls):
+                t = b.node.fields[k_set + i2].type
+                exprs.append(ir.InputRef(k_set + i2, t))
+                fields.append(P.Field(None, t))
+            branches.append(
+                P.ProjectNode(b.node, tuple(exprs), tuple(fields))
+            )
+        union_fields = branches[0].fields
+        builder.node = P.UnionAllNode(tuple(branches), union_fields)
+        post_fields = []
+        replacements: Dict[ast.Expression, Tuple[int, T.DataType]] = {}
+        for j, (g, t) in enumerate(zip(group_asts, key_types)):
+            if isinstance(g, ast.Identifier):
+                qualifier = g.parts[0] if len(g.parts) == 2 else None
+                name = g.parts[-1]
+            else:
+                qualifier, name = None, None
+            post_fields.append(ScopeField(qualifier, name, t))
+            replacements[g] = (j, t)
+        k = len(group_asts)
+        for i2, call in enumerate(agg_calls):
+            t = union_fields[k + i2].type
+            post_fields.append(ScopeField(None, None, t))
+            replacements[call] = (k + i2, t)
         builder.scope = Scope(post_fields)
         builder.replacements = replacements
 

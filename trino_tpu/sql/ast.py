@@ -265,6 +265,9 @@ class QuerySpec(Node):
     where: Optional[Expression] = None
     group_by: Tuple[Expression, ...] = ()
     having: Optional[Expression] = None
+    # GROUPING SETS/ROLLUP/CUBE: index tuples into group_by (None =
+    # plain GROUP BY over all of group_by)
+    group_by_sets: Optional[Tuple[Tuple[int, ...], ...]] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -298,6 +301,37 @@ class Query(Node):
 class ExplainStatement(Node):
     query: Query
     analyze: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ValuesBody(Node):
+    """VALUES (...), (...) as a query body."""
+
+    rows: Tuple[Tuple[Expression, ...], ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class CreateTable(Node):
+    table: Tuple[str, ...]
+    columns: Tuple[Tuple[str, TypeName], ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class CreateTableAs(Node):
+    table: Tuple[str, ...]
+    query: "Query"
+
+
+@dataclasses.dataclass(frozen=True)
+class Insert(Node):
+    table: Tuple[str, ...]
+    columns: Optional[Tuple[str, ...]]
+    query: "Query"
+
+
+@dataclasses.dataclass(frozen=True)
+class DropTable(Node):
+    table: Tuple[str, ...]
 
 
 @dataclasses.dataclass(frozen=True)

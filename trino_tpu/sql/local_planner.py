@@ -168,6 +168,35 @@ class LocalPlanner:
         schema: Schema = [(c.type, c.dictionary) for c in batch.columns]
         return [lambda ctx: ValuesOperator([batch])], schema
 
+    # -- fusion helpers (program-count reduction; see compose_batch_fns) --
+    @staticmethod
+    def _append_fp(chain: List[Factory], fn) -> None:
+        """Append a filter/project stage, folding it into a directly
+        preceding one so adjacent stages share a device program."""
+        from trino_tpu.exec.operators import compose_batch_fns
+
+        prev = chain[-1] if chain else None
+        pf = getattr(prev, "fused_fn", None)
+        if pf is not None:
+            chain.pop()
+            fn = compose_batch_fns(pf, fn)
+
+        def factory(ctx, fn=fn):
+            return FilterProjectOperator(None, (), fn=fn)
+
+        factory.fused_fn = fn
+        chain.append(factory)
+
+    @staticmethod
+    def _take_fused(chain: List[Factory]):
+        """Pop a trailing fused filter/project stage so a blocking
+        consumer (agg/sort/topn) can run it inside its own kernel."""
+        prev = chain[-1] if chain else None
+        pf = getattr(prev, "fused_fn", None)
+        if pf is not None:
+            chain.pop()
+        return pf
+
     def _visit_RemoteSourceNode(self, node: P.RemoteSourceNode):
         """Exchange client as a source operator (ExchangeOperator.java:44;
         with merge_keys, MergeOperator.java:46). The execution context
@@ -191,7 +220,7 @@ class LocalPlanner:
         chain, schema = self._visit(node.child)
         flt = self._bind(node.predicate, schema)
         fn = make_filter_project_fn(flt, self._identity(schema))
-        chain.append(lambda ctx: FilterProjectOperator(None, (), fn=fn))
+        self._append_fp(chain, fn)
         return chain, schema
 
     def _visit_ProjectNode(self, node: P.ProjectNode):
@@ -205,7 +234,7 @@ class LocalPlanner:
             chain, schema = self._visit(child)
         bounds = [self._bind(e, schema) for e in node.exprs]
         fn = make_filter_project_fn(flt, bounds)
-        chain.append(lambda ctx: FilterProjectOperator(None, (), fn=fn))
+        self._append_fp(chain, fn)
         return chain, [(b.type, b.dictionary) for b in bounds]
 
     def _visit_AggregateNode(self, node: P.AggregateNode):
@@ -215,9 +244,12 @@ class LocalPlanner:
         specs = [AggSpec(a.kind, a.arg_channel, a.out_type) for a in node.aggs]
         groups = list(node.group_channels)
         step = node.step
+        pre = self._take_fused(chain)
         chain.append(
             lambda ctx: HashAggregationOperator(
-                groups, specs, schema, step=step, memory_context=_mem_ctx(ctx)
+                groups, specs, schema, step=step, memory_context=_mem_ctx(ctx),
+                deferred_checks=ctx.setdefault("deferred_checks", []),
+                pre_fn=pre,
             )
         )
         if step == "partial":
@@ -328,8 +360,11 @@ class LocalPlanner:
     def _visit_SortNode(self, node: P.SortNode):
         chain, schema = self._visit(node.child)
         keys = list(node.keys)
+        pre = self._take_fused(chain)
         chain.append(
-            lambda ctx: SortOperator(keys, schema, memory_context=_mem_ctx(ctx))
+            lambda ctx: SortOperator(
+                keys, schema, memory_context=_mem_ctx(ctx), pre_fn=pre
+            )
         )
         return chain, schema
 
@@ -337,7 +372,8 @@ class LocalPlanner:
         chain, schema = self._visit(node.child)
         keys = list(node.keys)
         count = node.count
-        chain.append(lambda ctx: TopNOperator(keys, count, schema))
+        pre = self._take_fused(chain)
+        chain.append(lambda ctx: TopNOperator(keys, count, schema, pre_fn=pre))
         return chain, schema
 
     def _visit_LimitNode(self, node: P.LimitNode):
@@ -359,13 +395,25 @@ class LocalPlanner:
             )
             self.pipelines.append(chain)
         # string columns must agree on dictionaries across inputs for the
-        # shared buffer to be bindable downstream
+        # shared buffer to be bindable downstream; an all-NULL input
+        # (None/empty dictionary, e.g. grouping-set NULL keys) is
+        # compatible with anything
+        def _dict_rank(d):
+            return 0 if d is None or len(d) == 0 else 1
+
+        out_schema = list(schemas[0])
         for s in schemas[1:]:
-            for (t0, d0), (t1, d1) in zip(schemas[0], s):
-                if t0.is_string and d0 != d1:
+            for i, ((t0, d0), (t1, d1)) in enumerate(zip(out_schema, s)):
+                if not t0.is_string:
+                    continue
+                if _dict_rank(d0) == 0:
+                    out_schema[i] = (t0, d1)
+                elif _dict_rank(d1) == 0 or d0 == d1:
+                    continue
+                else:
                     raise NotImplementedError(
                         "UNION of string columns with differing dictionaries"
                     )
         return [
             lambda ctx: BufferSource([ctx[k] for k in sink_keys])
-        ], schemas[0]
+        ], out_schema

@@ -164,6 +164,25 @@ class Parser:
             stmt: ast.Node = ast.ExplainStatement(self.parse_query(), analyze)
         elif self.at_kw("SHOW"):
             stmt = self._parse_show()
+        elif self.at_kw("CREATE"):
+            stmt = self._parse_create()
+        elif self.at_kw("INSERT"):
+            self.next()
+            self.expect_kw("INTO")
+            table = self._parse_qualified_name()
+            columns = None
+            if self.at_op("(") :
+                self.next()
+                cols = [self._parse_name()]
+                while self.accept_op(","):
+                    cols.append(self._parse_name())
+                self.expect_op(")")
+                columns = tuple(cols)
+            stmt = ast.Insert(table, columns, self.parse_query())
+        elif self.at_kw("DROP"):
+            self.next()
+            self.expect_kw("TABLE")
+            stmt = ast.DropTable(self._parse_qualified_name())
         elif self.at_kw("SET"):
             self.next()
             self.expect_kw("SESSION")
@@ -183,6 +202,22 @@ class Parser:
         if t.kind != "eof":
             raise self.error("unexpected trailing input")
         return stmt
+
+    def _parse_create(self) -> ast.Node:
+        self.expect_kw("CREATE")
+        self.expect_kw("TABLE")
+        table = self._parse_qualified_name()
+        if self.accept_kw("AS"):
+            return ast.CreateTableAs(table, self.parse_query())
+        self.expect_op("(")
+        cols = []
+        while True:
+            name = self._parse_name()
+            cols.append((name, self._parse_type()))
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        return ast.CreateTable(table, tuple(cols))
 
     def _parse_show(self) -> ast.Node:
         self.expect_kw("SHOW")
@@ -277,7 +312,21 @@ class Parser:
             body = self._parse_query_body()
             self.expect_op(")")
             return body
+        if self.at_kw("VALUES"):
+            self.next()
+            rows = [self._parse_values_row()]
+            while self.accept_op(","):
+                rows.append(self._parse_values_row())
+            return ast.ValuesBody(tuple(rows))
         return self._parse_query_spec()
+
+    def _parse_values_row(self) -> tuple:
+        self.expect_op("(")
+        row = [self.parse_expr()]
+        while self.accept_op(","):
+            row.append(self.parse_expr())
+        self.expect_op(")")
+        return tuple(row)
 
     def _parse_query_spec(self) -> ast.QuerySpec:
         self.expect_kw("SELECT")
@@ -296,16 +345,71 @@ class Parser:
         if self.accept_kw("WHERE"):
             where = self.parse_expr()
         group_by: Tuple[ast.Expression, ...] = ()
+        group_by_sets = None
         if self.accept_kw("GROUP"):
             self.expect_kw("BY")
-            items = [self.parse_expr()]
-            while self.accept_op(","):
-                items.append(self.parse_expr())
-            group_by = tuple(items)
+            group_by, group_by_sets = self._parse_group_by()
         having = None
         if self.accept_kw("HAVING"):
             having = self.parse_expr()
-        return ast.QuerySpec(tuple(select), distinct, from_, where, group_by, having)
+        return ast.QuerySpec(
+            tuple(select), distinct, from_, where, group_by, having,
+            group_by_sets,
+        )
+
+    def _parse_group_by(self):
+        """Plain list, ROLLUP(...), CUBE(...) or GROUPING SETS
+        (SqlBase.g4 groupingElement)."""
+        if self.at_kw("ROLLUP", "CUBE"):
+            kind = self.next().upper
+            self.expect_op("(")
+            exprs = [self.parse_expr()]
+            while self.accept_op(","):
+                exprs.append(self.parse_expr())
+            self.expect_op(")")
+            n = len(exprs)
+            if kind == "ROLLUP":
+                sets = tuple(tuple(range(i)) for i in range(n, -1, -1))
+            else:  # CUBE: all subsets, larger first
+                import itertools as _it
+
+                sets = tuple(
+                    s
+                    for size in range(n, -1, -1)
+                    for s in _it.combinations(range(n), size)
+                )
+            return tuple(exprs), sets
+        if self.at_kw("GROUPING"):
+            self.next()
+            self.expect_kw("SETS")
+            self.expect_op("(")
+            raw_sets = []
+            while True:
+                self.expect_op("(")
+                one = []
+                if not self.at_op(")"):
+                    one.append(self.parse_expr())
+                    while self.accept_op(","):
+                        one.append(self.parse_expr())
+                self.expect_op(")")
+                raw_sets.append(tuple(one))
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+            exprs: List[ast.Expression] = []
+            index_sets = []
+            for s in raw_sets:
+                idx = []
+                for e in s:
+                    if e not in exprs:
+                        exprs.append(e)
+                    idx.append(exprs.index(e))
+                index_sets.append(tuple(idx))
+            return tuple(exprs), tuple(index_sets)
+        items = [self.parse_expr()]
+        while self.accept_op(","):
+            items.append(self.parse_expr())
+        return tuple(items), None
 
     def _parse_select_item(self) -> ast.SelectItem:
         if self.at_op("*"):

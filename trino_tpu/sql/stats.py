@@ -39,7 +39,8 @@ class PlanStats:
 class StatsCalculator:
     def __init__(self, catalogs):
         self._catalogs = catalogs
-        self._memo: Dict[int, PlanStats] = {}
+        # id(node) -> (node, stats); the node reference keeps the id alive
+        self._memo: Dict[int, tuple] = {}
 
     def stats(self, node: P.PlanNode) -> PlanStats:
         # memo holds the node itself: id() alone would collide once a
