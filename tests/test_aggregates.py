@@ -1,0 +1,173 @@
+"""Composite aggregate library: variance/covariance/moment family,
+boolean/conditional aggregates, approx_distinct — lowered by the
+analyzer onto shared sum/count/min/max primitives plus a finisher
+projection (the accumulator-state analogue of Trino's
+main/operator/aggregation/ library, e.g. VarianceState; SURVEY.md
+§2.6 "Aggregation functions")."""
+
+import math
+
+import numpy as np
+import pytest
+
+from trino_tpu.connectors.tpch import create_tpch_connector
+from trino_tpu.engine import LocalQueryRunner, Session
+
+
+@pytest.fixture(scope="module")
+def runner():
+    r = LocalQueryRunner(Session(catalog="tpch", schema="tiny"))
+    r.register_catalog("tpch", create_tpch_connector())
+    return r
+
+
+V = "(VALUES (1.0), (2.0), (3.0), (4.0), (10.0)) t(x)"
+X = np.array([1.0, 2.0, 3.0, 4.0, 10.0])
+
+PAIRS = "(VALUES (1.0, 2.0), (2.0, 3.5), (3.0, 3.0), (4.0, 8.0)) t(y, x)"
+YS = np.array([1.0, 2.0, 3.0, 4.0])
+XS = np.array([2.0, 3.5, 3.0, 8.0])
+
+
+def _one(runner, sql):
+    return runner.execute(sql).only_value()
+
+
+CASES = [
+    (f"SELECT var_samp(x) FROM {V}", np.var(X, ddof=1)),
+    (f"SELECT var_pop(x) FROM {V}", np.var(X)),
+    (f"SELECT variance(x) FROM {V}", np.var(X, ddof=1)),
+    (f"SELECT stddev_samp(x) FROM {V}", np.std(X, ddof=1)),
+    (f"SELECT stddev_pop(x) FROM {V}", np.std(X)),
+    (f"SELECT stddev(x) FROM {V}", np.std(X, ddof=1)),
+    (f"SELECT geometric_mean(x) FROM {V}", float(np.exp(np.mean(np.log(X))))),
+    (f"SELECT covar_samp(y, x) FROM {PAIRS}", float(np.cov(YS, XS, ddof=1)[0, 1])),
+    (
+        f"SELECT covar_pop(y, x) FROM {PAIRS}",
+        float(((YS - YS.mean()) * (XS - XS.mean())).mean()),
+    ),
+    (f"SELECT corr(y, x) FROM {PAIRS}", float(np.corrcoef(YS, XS)[0, 1])),
+    (
+        f"SELECT regr_slope(y, x) FROM {PAIRS}",
+        float(np.cov(YS, XS, ddof=1)[0, 1] / np.var(XS, ddof=1)),
+    ),
+    (
+        f"SELECT regr_intercept(y, x) FROM {PAIRS}",
+        float(
+            YS.mean()
+            - (np.cov(YS, XS, ddof=1)[0, 1] / np.var(XS, ddof=1)) * XS.mean()
+        ),
+    ),
+]
+
+
+@pytest.mark.parametrize("sql,want", CASES)
+def test_numeric_aggregate(sql, want, runner):
+    got = _one(runner, sql)
+    assert got == pytest.approx(want, rel=1e-9, abs=1e-9), sql
+
+
+def test_moments(runner):
+    n = len(X)
+    m = X.mean()
+    m2 = ((X - m) ** 2).sum()
+    m3 = ((X - m) ** 3).sum()
+    m4 = ((X - m) ** 4).sum()
+    skew = math.sqrt(n) * m3 / m2**1.5
+    kurt = (
+        n * (n + 1) * (n - 1) / ((n - 2) * (n - 3)) * m4 / m2**2
+        - 3 * (n - 1) ** 2 / ((n - 2) * (n - 3))
+    )
+    assert _one(runner, f"SELECT skewness(x) FROM {V}") == pytest.approx(skew)
+    assert _one(runner, f"SELECT kurtosis(x) FROM {V}") == pytest.approx(kurt)
+
+
+def test_boolean_and_conditional_aggregates(runner):
+    b = "(VALUES (true), (false), (NULL), (true)) t(b)"
+    assert _one(runner, f"SELECT bool_and(b) FROM {b}") is False
+    assert _one(runner, f"SELECT bool_or(b) FROM {b}") is True
+    assert _one(runner, f"SELECT every(b) FROM {b}") is False
+    assert _one(runner, f"SELECT count_if(b) FROM {b}") == 2
+    t = "(VALUES (true), (true)) t(b)"
+    assert _one(runner, f"SELECT bool_and(b) FROM {t}") is True
+    # count_if over an empty relation is 0, not NULL
+    assert (
+        _one(runner, "SELECT count_if(b) FROM (VALUES (true)) t(b) WHERE b = false")
+        == 0
+    )
+
+
+def test_null_and_small_n_semantics(runner):
+    one = "(VALUES (42.0)) t(x)"
+    assert _one(runner, f"SELECT var_samp(x) FROM {one}") is None
+    assert _one(runner, f"SELECT var_pop(x) FROM {one}") == 0.0
+    assert _one(runner, f"SELECT stddev_samp(x) FROM {one}") is None
+    empty = "(VALUES (1.0)) t(x) WHERE x < 0"
+    assert _one(runner, f"SELECT var_pop(x) FROM {empty}") is None
+    assert _one(runner, f"SELECT geometric_mean(x) FROM {empty}") is None
+    # NULL rows are ignored; pairwise masking for two-arg aggregates
+    withnull = "(VALUES (1.0), (NULL), (3.0)) t(x)"
+    assert _one(runner, f"SELECT var_pop(x) FROM {withnull}") == 1.0
+    pairnull = "(VALUES (1.0, 2.0), (NULL, 5.0), (2.0, NULL), (3.0, 4.0)) t(y, x)"
+    want = float(
+        ((np.array([1.0, 3.0]) - 2.0) * (np.array([2.0, 4.0]) - 3.0)).mean()
+    )
+    assert _one(runner, f"SELECT covar_pop(y, x) FROM {pairnull}") == pytest.approx(
+        want
+    )
+
+
+def test_approx_distinct(runner):
+    got = _one(runner, "SELECT approx_distinct(l_suppkey) FROM lineitem")
+    assert got == 100
+    got = _one(
+        runner,
+        "SELECT approx_distinct(o_custkey) FROM orders",
+    )
+    exact = _one(runner, "SELECT count(DISTINCT o_custkey) FROM orders")
+    assert got == exact
+
+
+def test_grouped_composite(runner):
+    """Grouped finisher projection + oracle per group."""
+    rows = runner.execute(
+        "SELECT l_returnflag, var_samp(l_quantity), count_if(l_quantity > 25)"
+        " FROM lineitem GROUP BY l_returnflag ORDER BY l_returnflag"
+    ).rows
+    data = runner.execute("SELECT l_returnflag, l_quantity FROM lineitem").rows
+    by_flag = {}
+    for f, q in data:
+        by_flag.setdefault(f, []).append(q)
+    assert len(rows) == len(by_flag)
+    for flag, var, cnt in rows:
+        qs = np.array(by_flag[flag], dtype=float)
+        assert var == pytest.approx(float(np.var(qs, ddof=1)), rel=1e-9)
+        assert cnt == int((qs > 25).sum())
+
+
+def test_dedup_with_grouping_sets(runner):
+    """Two textually-distinct but structurally-identical aggregates
+    dedup to one accumulator; the finisher projection must still emit
+    one channel per call (ROLLUP expansion indexes per call)."""
+    rows = runner.execute(
+        "SELECT g, sum(x), sum(t.x) FROM"
+        " (VALUES (1, 1.0), (1, 2.0), (2, 3.0)) t(g, x)"
+        " GROUP BY ROLLUP(g) ORDER BY g"
+    ).rows
+    assert rows == [[1, 3.0, 3.0], [2, 3.0, 3.0], [None, 6.0, 6.0]]
+
+
+def test_composite_shares_primitives(runner):
+    """corr + covar + stddev over the same columns dedup their moment
+    primitives; results must still all be correct."""
+    got = runner.execute(
+        f"SELECT corr(y, x), covar_pop(y, x), var_pop(x), avg(x) FROM {PAIRS}"
+    ).rows[0]
+    want = [
+        float(np.corrcoef(YS, XS)[0, 1]),
+        float(((YS - YS.mean()) * (XS - XS.mean())).mean()),
+        float(np.var(XS)),
+        float(XS.mean()),
+    ]
+    for g, w in zip(got, want):
+        assert g == pytest.approx(w, rel=1e-9)

@@ -42,6 +42,22 @@ from trino_tpu.sql import ast
 from trino_tpu.sql import plan as P
 
 AGG_FUNCS = {"sum", "count", "avg", "min", "max", "any_value", "arbitrary"}
+# Composite aggregates lowered onto the primitive (sum/count/min/max)
+# machinery by _plan_aggregation: each expands to shared primitive
+# accumulators plus a finisher expression over their outputs — the
+# moral equivalent of Trino's multi-field accumulator states
+# (main/operator/aggregation/, e.g. VarianceState), except the state
+# fields ARE primitive aggregates so partial->final distribution and
+# spill ride the existing wire format unchanged.
+COMPOSITE_AGG_FUNCS = {
+    "stddev", "stddev_samp", "stddev_pop",
+    "variance", "var_samp", "var_pop",
+    "skewness", "kurtosis",
+    "geometric_mean", "count_if", "bool_and", "bool_or", "every",
+    "corr", "covar_pop", "covar_samp", "regr_slope", "regr_intercept",
+    "approx_distinct",
+}
+AGG_FUNCS = AGG_FUNCS | COMPOSITE_AGG_FUNCS
 
 _EPOCH = datetime.date(1970, 1, 1)
 
@@ -1095,6 +1111,13 @@ class Analyzer:
             return self._plan_table(rel)
         if isinstance(rel, ast.SubqueryRelation):
             node, scope, names = self.plan_query(rel.query, ctes)
+            if rel.column_aliases:
+                if len(rel.column_aliases) != len(node.fields):
+                    raise AnalysisError(
+                        f"column alias list has {len(rel.column_aliases)} "
+                        f"names but relation has {len(node.fields)} columns"
+                    )
+                names = list(rel.column_aliases)
             sc = Scope(
                 [ScopeField(rel.alias, n, f.type) for n, f in zip(names, node.fields)]
             )
@@ -1394,23 +1417,51 @@ class Analyzer:
         key_irs = [conv.convert(g) for g in group_asts]
         pre_exprs: List[ir.Expr] = list(key_irs)
         aggs: List[P.AggCall] = []
+        prim_cache: Dict[tuple, int] = {}
+
+        def add_prim(kind, arg_ir, out_t, distinct=False) -> int:
+            """Append one primitive accumulator, deduplicated
+            structurally so composites sharing a moment (e.g. corr and
+            covar_pop over the same pair) compute it once."""
+            key = (kind, arg_ir, distinct)
+            if key in prim_cache:
+                return prim_cache[key]
+            if arg_ir is None:
+                spec = P.AggCall(kind, None, out_t, distinct)
+            else:
+                arg_ch = len(pre_exprs)
+                pre_exprs.append(arg_ir)
+                spec = P.AggCall(kind, arg_ch, out_t, distinct)
+            aggs.append(spec)
+            prim_cache[key] = len(aggs) - 1
+            return len(aggs) - 1
+
+        # per original call: ("plain", prim_idx) or ("comp", finisher, out_t)
+        # where finisher(ref) builds the result expression from
+        # ref(prim_idx) -> InputRef over the AggregateNode's output
+        per_call: List[tuple] = []
         for call in agg_calls:
             kind = call.name
             distinct = call.distinct
             if kind == "count" and (
                 not call.args or isinstance(call.args[0], ast.Star)
             ):
-                aggs.append(P.AggCall("count_star", None, T.BIGINT, False))
+                per_call.append(
+                    ("plain", add_prim("count_star", None, T.BIGINT))
+                )
+                continue
+            if kind in COMPOSITE_AGG_FUNCS:
+                per_call.append(
+                    self._expand_composite_agg(call, conv, add_prim)
+                )
                 continue
             if kind in ("any_value", "arbitrary"):
                 kind = "any"
             if len(call.args) != 1:
                 raise AnalysisError(f"{call.name}() takes one argument")
             arg = conv.convert(call.args[0])
-            arg_ch = len(pre_exprs)
-            pre_exprs.append(arg)
             out_t = self._agg_out_type(kind, arg.type)
-            aggs.append(P.AggCall(kind, arg_ch, out_t, distinct))
+            per_call.append(("plain", add_prim(kind, arg, out_t, distinct)))
 
         pre_fields = tuple(
             P.Field(
@@ -1428,6 +1479,43 @@ class Analyzer:
         builder.node = P.AggregateNode(
             pre, tuple(range(k)), tuple(aggs), out_fields
         )
+
+        def ref(prim_idx: int) -> ir.InputRef:
+            return ir.InputRef(k + prim_idx, aggs[prim_idx].out_type)
+
+        # the finisher projection is also needed when dedup collapsed two
+        # textually-identical plain aggregates: downstream (grouping
+        # sets, select resolution) assumes one output channel per call
+        plain_chans = [e[1] for e in per_call if e[0] == "plain"]
+        has_comp = (
+            any(tag == "comp" for tag, *_ in per_call)
+            or len(set(plain_chans)) < len(plain_chans)
+        )
+        if has_comp:
+            # finisher projection over the accumulator outputs (the
+            # Accumulator.evaluateFinal step, as a plan-level Project)
+            post_exprs: List[ir.Expr] = [
+                ir.InputRef(i, e.type) for i, e in enumerate(key_irs)
+            ]
+            call_types: List[T.DataType] = []
+            for entry in per_call:
+                if entry[0] == "plain":
+                    e: ir.Expr = ref(entry[1])
+                else:
+                    e = entry[1](ref)
+                post_exprs.append(e)
+                call_types.append(e.type)
+            node_fields = tuple(pre_fields[:k]) + tuple(
+                P.Field(None, t) for t in call_types
+            )
+            builder.node = P.ProjectNode(
+                builder.node, tuple(post_exprs), node_fields
+            )
+            chan_of_call = [k + j for j in range(len(per_call))]
+        else:
+            call_types = [aggs[e[1]].out_type for e in per_call]
+            chan_of_call = [k + e[1] for e in per_call]
+
         # post-agg scope: group keys keep (qualifier, name) when they were
         # plain identifiers so ORDER BY/SELECT can re-resolve them
         post_fields = []
@@ -1440,11 +1528,284 @@ class Analyzer:
                 qualifier, name = None, None
             post_fields.append(ScopeField(qualifier, name, e.type))
             replacements[g] = (i, e.type)
-        for j, (call, a) in enumerate(zip(agg_calls, aggs)):
-            post_fields.append(ScopeField(None, None, a.out_type))
-            replacements[call] = (k + j, a.out_type)
-        builder.scope = Scope(post_fields)
+        n_chan = len(builder.node.fields)
+        chan_fields = [None] * (n_chan - k)
+        for call, ch, t in zip(agg_calls, chan_of_call, call_types):
+            replacements[call] = (ch, t)
+            chan_fields[ch - k] = ScopeField(None, None, t)
+        for j in range(n_chan - k):
+            if chan_fields[j] is None:  # deduped-away duplicate channel
+                chan_fields[j] = ScopeField(
+                    None, None, builder.node.fields[k + j].type
+                )
+        builder.scope = Scope(post_fields + chan_fields)
         builder.replacements = replacements
+
+    def _expand_composite_agg(self, call: ast.FunctionCall, conv, add_prim):
+        """Lower one composite aggregate to primitive accumulators plus a
+        finisher expression (SURVEY.md §2.6 aggregation functions: the
+        ~130-function library is built from shared moment/flag
+        primitives instead of one compiled accumulator per function)."""
+        kind = call.name
+        if call.distinct:
+            raise AnalysisError(f"DISTINCT {kind}() is not supported")
+
+        def dbl(e: ir.Expr) -> ir.Expr:
+            return e if e.type == T.DOUBLE else ir.Cast(e, T.DOUBLE)
+
+        def lit(v) -> ir.Expr:
+            return ir.Literal(float(v), T.DOUBLE)
+
+        def mul(a, b):
+            return ir.call("mul", T.DOUBLE, a, b)
+
+        def sub(a, b):
+            return ir.call("sub", T.DOUBLE, a, b)
+
+        def addx(a, b):
+            return ir.call("add", T.DOUBLE, a, b)
+
+        def div(a, b):
+            return ir.call("div", T.DOUBLE, a, b)
+
+        def sqrt(a):
+            return ir.call("sqrt", T.DOUBLE, a)
+
+        def guard(cond_null: ir.Expr, value: ir.Expr) -> ir.Expr:
+            """CASE WHEN cond THEN NULL ELSE value END."""
+            return ir.Case(
+                (cond_null,), (ir.Literal(None, value.type),), value, value.type
+            )
+
+        def nneg(v: ir.Expr) -> ir.Expr:
+            """Clamp tiny negative central moments (float error) to 0."""
+            return ir.Case(
+                (ir.comparison("lt", v, lit(0)),), (lit(0),), v, T.DOUBLE
+            )
+
+        if kind == "approx_distinct":
+            # Exact distinct count satisfies the approximate contract
+            # (error 0 <= the documented 2.3% HLL standard error);
+            # sketch-based cardinality is planned work. Known limit: it
+            # inherits the engine's lone-distinct-aggregate restriction
+            # (local_planner._distinct_agg), so it cannot yet be mixed
+            # with other aggregates in one SELECT.
+            if len(call.args) < 1:
+                raise AnalysisError("approx_distinct() takes an argument")
+            arg = conv.convert(call.args[0])
+            return ("plain", add_prim("count", arg, T.BIGINT, distinct=True))
+
+        if kind in ("count_if", "bool_and", "bool_or", "every"):
+            if len(call.args) != 1:
+                raise AnalysisError(f"{kind}() takes one argument")
+            b = conv.convert(call.args[0])
+            if b.type.kind != T.TypeKind.BOOLEAN:
+                raise AnalysisError(f"{kind}() argument must be boolean")
+            # NULL-preserving 0/1 encoding of the flag
+            ib = ir.Case(
+                (ir.is_null(b), b),
+                (ir.Literal(None, T.BIGINT), ir.Literal(1, T.BIGINT)),
+                ir.Literal(0, T.BIGINT),
+                T.BIGINT,
+            )
+            if kind == "count_if":
+                i = add_prim("sum", ib, T.BIGINT)
+                return (
+                    "comp",
+                    lambda ref, i=i: ir.Case(
+                        (ir.is_null(ref(i)),),
+                        (ir.Literal(0, T.BIGINT),),
+                        ref(i),
+                        T.BIGINT,
+                    ),
+                    T.BIGINT,
+                )
+            prim = "min" if kind in ("bool_and", "every") else "max"
+            i = add_prim(prim, ib, T.BIGINT)
+            return (
+                "comp",
+                lambda ref, i=i: ir.comparison(
+                    "eq", ref(i), ir.Literal(1, T.BIGINT)
+                ),
+                T.BOOLEAN,
+            )
+
+        if kind in (
+            "stddev", "stddev_samp", "stddev_pop", "variance", "var_samp",
+            "var_pop", "geometric_mean", "skewness", "kurtosis",
+        ):
+            if len(call.args) != 1:
+                raise AnalysisError(f"{kind}() takes one argument")
+            x = dbl(conv.convert(call.args[0]))
+            n_i = add_prim("count", x, T.BIGINT)
+            if kind == "geometric_mean":
+                sl_i = add_prim("sum", ir.call("ln", T.DOUBLE, x), T.DOUBLE)
+
+                def fin_geo(ref):
+                    n = dbl(ref(n_i))
+                    return guard(
+                        ir.comparison("eq", ref(n_i), ir.Literal(0, T.BIGINT)),
+                        ir.call("exp", T.DOUBLE, div(ref(sl_i), n)),
+                    )
+
+                return ("comp", fin_geo, T.DOUBLE)
+            s_i = add_prim("sum", x, T.DOUBLE)
+            ss_i = add_prim("sum", mul(x, x), T.DOUBLE)
+            if kind in ("skewness", "kurtosis"):
+                s3_i = add_prim("sum", mul(mul(x, x), x), T.DOUBLE)
+                if kind == "kurtosis":
+                    s4_i = add_prim("sum", mul(mul(x, x), mul(x, x)), T.DOUBLE)
+
+                def fin_moment(ref, want=kind):
+                    n = dbl(ref(n_i))
+                    s, ss = ref(s_i), ref(ss_i)
+                    mean = div(s, n)
+                    m2 = nneg(sub(ss, mul(s, mean)))  # sum((x-mean)^2)
+                    # sum((x-mean)^3) from raw moments
+                    m3 = addx(
+                        sub(ref(s3_i), mul(lit(3), mul(mean, ss))),
+                        mul(lit(2), mul(n, mul(mean, mul(mean, mean)))),
+                    )
+                    if want == "skewness":
+                        # sqrt(n) * m3 / m2^1.5, NULL when n < 3 or m2 == 0
+                        val = div(
+                            mul(sqrt(n), m3), mul(m2, sqrt(m2))
+                        )
+                        bad = ir.or_(
+                            ir.comparison(
+                                "lt", ref(n_i), ir.Literal(3, T.BIGINT)
+                            ),
+                            ir.comparison("le", m2, lit(0)),
+                        )
+                        return guard(bad, val)
+                    # sample excess kurtosis:
+                    # n(n+1)(n-1)/((n-2)(n-3)) * m4/m2^2
+                    #   - 3(n-1)^2/((n-2)(n-3)),   NULL when n < 4 or m2 == 0
+                    m4 = sub(
+                        addx(
+                            sub(
+                                ref(s4_i),
+                                mul(lit(4), mul(mean, ref(s3_i))),
+                            ),
+                            mul(lit(6), mul(mul(mean, mean), ss)),
+                        ),
+                        mul(
+                            lit(3),
+                            mul(n, mul(mul(mean, mean), mul(mean, mean))),
+                        ),
+                    )
+                    n1, n2, n3 = sub(n, lit(1)), sub(n, lit(2)), sub(n, lit(3))
+                    term1 = mul(
+                        div(mul(n, mul(addx(n, lit(1)), n1)), mul(n2, n3)),
+                        div(m4, mul(m2, m2)),
+                    )
+                    term2 = div(mul(lit(3), mul(n1, n1)), mul(n2, n3))
+                    bad = ir.or_(
+                        ir.comparison("lt", ref(n_i), ir.Literal(4, T.BIGINT)),
+                        ir.comparison("le", m2, lit(0)),
+                    )
+                    return guard(bad, sub(term1, term2))
+
+                return ("comp", fin_moment, T.DOUBLE)
+
+            pop = kind.endswith("_pop")
+
+            def fin_var(ref, pop=pop, want=kind):
+                n = dbl(ref(n_i))
+                s = ref(s_i)
+                m2 = nneg(sub(ref(ss_i), div(mul(s, s), n)))
+                denom = n if pop else sub(n, lit(1))
+                v = div(m2, denom)
+                min_n = 1 if pop else 2
+                bad = ir.comparison(
+                    "lt", ref(n_i), ir.Literal(min_n, T.BIGINT)
+                )
+                if want.startswith("stddev"):
+                    v = sqrt(v)
+                return guard(bad, v)
+
+            return ("comp", fin_var, T.DOUBLE)
+
+        # two-argument covariance family: rows where EITHER argument is
+        # NULL are excluded from every moment (pairwise masking)
+        if kind in ("corr", "covar_pop", "covar_samp", "regr_slope",
+                    "regr_intercept"):
+            if len(call.args) != 2:
+                raise AnalysisError(f"{kind}() takes two arguments")
+            y0 = dbl(conv.convert(call.args[0]))
+            x0 = dbl(conv.convert(call.args[1]))
+            both = ir.and_(ir.not_(ir.is_null(y0)), ir.not_(ir.is_null(x0)))
+
+            def masked(e):
+                return ir.Case((both,), (e,), ir.Literal(None, T.DOUBLE),
+                               T.DOUBLE)
+
+            y, x = masked(y0), masked(x0)
+            n_i = add_prim("count", y, T.BIGINT)
+            sy_i = add_prim("sum", y, T.DOUBLE)
+            sx_i = add_prim("sum", x, T.DOUBLE)
+            sxy_i = add_prim("sum", mul(y, x), T.DOUBLE)
+            if kind in ("corr",):
+                sxx_i = add_prim("sum", mul(x, x), T.DOUBLE)
+                syy_i = add_prim("sum", mul(y, y), T.DOUBLE)
+
+                def fin_corr(ref):
+                    n = dbl(ref(n_i))
+                    cxy = sub(ref(sxy_i), div(mul(ref(sx_i), ref(sy_i)), n))
+                    vx = nneg(
+                        sub(ref(sxx_i), div(mul(ref(sx_i), ref(sx_i)), n))
+                    )
+                    vy = nneg(
+                        sub(ref(syy_i), div(mul(ref(sy_i), ref(sy_i)), n))
+                    )
+                    denom = sqrt(mul(vx, vy))
+                    bad = ir.or_(
+                        ir.comparison(
+                            "eq", ref(n_i), ir.Literal(0, T.BIGINT)
+                        ),
+                        ir.comparison("le", denom, lit(0)),
+                    )
+                    return guard(bad, div(cxy, denom))
+
+                return ("comp", fin_corr, T.DOUBLE)
+            if kind in ("regr_slope", "regr_intercept"):
+                sxx_i = add_prim("sum", mul(x, x), T.DOUBLE)
+
+                def fin_regr(ref, want=kind):
+                    n = dbl(ref(n_i))
+                    cxy = sub(ref(sxy_i), div(mul(ref(sx_i), ref(sy_i)), n))
+                    vx = sub(ref(sxx_i), div(mul(ref(sx_i), ref(sx_i)), n))
+                    slope = div(cxy, vx)
+                    bad = ir.or_(
+                        ir.comparison(
+                            "eq", ref(n_i), ir.Literal(0, T.BIGINT)
+                        ),
+                        ir.comparison("le", nneg(vx), lit(0)),
+                    )
+                    if want == "regr_slope":
+                        return guard(bad, slope)
+                    intercept = sub(
+                        div(ref(sy_i), n), mul(slope, div(ref(sx_i), n))
+                    )
+                    return guard(bad, intercept)
+
+                return ("comp", fin_regr, T.DOUBLE)
+
+            pop = kind == "covar_pop"
+
+            def fin_covar(ref, pop=pop):
+                n = dbl(ref(n_i))
+                cxy = sub(ref(sxy_i), div(mul(ref(sx_i), ref(sy_i)), n))
+                denom = n if pop else sub(n, lit(1))
+                min_n = 1 if pop else 2
+                bad = ir.comparison(
+                    "lt", ref(n_i), ir.Literal(min_n, T.BIGINT)
+                )
+                return guard(bad, div(cxy, denom))
+
+            return ("comp", fin_covar, T.DOUBLE)
+
+        raise AnalysisError(f"unknown aggregate {kind}")
 
     def _plan_grouping_sets(
         self, builder: Builder, group_asts, sets, agg_calls, ctes

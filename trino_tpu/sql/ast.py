@@ -226,8 +226,12 @@ class TableRef(Relation):
 
 @dataclasses.dataclass(frozen=True)
 class SubqueryRelation(Relation):
+    """Derived table, optionally with derived column aliases:
+    `(query) AS t(c1, c2)` (SqlBase.g4 aliasedRelation/columnAliases)."""
+
     query: "Query"
     alias: Optional[str] = None
+    column_aliases: Tuple[str, ...] = ()
 
 
 @dataclasses.dataclass(frozen=True)

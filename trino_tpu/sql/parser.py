@@ -173,11 +173,7 @@ class Parser:
             columns = None
             if self.at_op("(") :
                 self.next()
-                cols = [self._parse_name()]
-                while self.accept_op(","):
-                    cols.append(self._parse_name())
-                self.expect_op(")")
-                columns = tuple(cols)
+                columns = self._parse_name_list()
             stmt = ast.Insert(table, columns, self.parse_query())
         elif self.at_kw("DROP"):
             self.next()
@@ -484,28 +480,41 @@ class Parser:
                 left = ast.Join(kind, left, right, cond)
             elif self.accept_kw("USING"):
                 self.expect_op("(")
-                cols = [self._parse_name()]
-                while self.accept_op(","):
-                    cols.append(self._parse_name())
-                self.expect_op(")")
-                left = ast.Join(kind, left, right, None, tuple(cols))
+                left = ast.Join(kind, left, right, None, self._parse_name_list())
             else:
                 raise self.error("expected ON or USING after JOIN")
 
     def _parse_table_primary(self) -> ast.Relation:
         if self.accept_op("("):
-            # subquery or parenthesized join
-            if self.at_kw("SELECT", "WITH"):
+            # subquery (incl. inline VALUES) or parenthesized join
+            if self.at_kw("SELECT", "WITH", "VALUES"):
                 q = self.parse_query()
                 self.expect_op(")")
-                alias = self._parse_opt_alias()
-                return ast.SubqueryRelation(q, alias)
+                alias, cols = self._parse_opt_alias_with_columns()
+                return ast.SubqueryRelation(q, alias, cols)
             rel = self._parse_relation()
             self.expect_op(")")
             return rel
         name = self._parse_qualified_name()
         alias = self._parse_opt_alias()
         return ast.TableRef(name, alias)
+
+    def _parse_opt_alias_with_columns(self):
+        """`[AS] alias [(col, ...)]` — derived column aliases."""
+        alias = self._parse_opt_alias()
+        cols: Tuple[str, ...] = ()
+        if alias is not None and self.accept_op("("):
+            cols = self._parse_name_list()
+        return alias, cols
+
+    def _parse_name_list(self) -> Tuple[str, ...]:
+        """Comma-separated identifiers up to and including the closing
+        ')' (the opening '(' is already consumed)."""
+        names = [self._parse_name()]
+        while self.accept_op(","):
+            names.append(self._parse_name())
+        self.expect_op(")")
+        return tuple(names)
 
     def _parse_opt_alias(self) -> Optional[str]:
         if self.accept_kw("AS"):
