@@ -54,3 +54,151 @@ def test_scalar_function(sql, want, runner):
         assert got is not None and abs(got - want) < 1e-9
     else:
         assert got == want
+
+
+MATH_CASES = [
+    ("SELECT sin(0)", 0.0),
+    ("SELECT cos(0)", 1.0),
+    ("SELECT tan(0)", 0.0),
+    ("SELECT asin(1)", 1.5707963267948966),
+    ("SELECT acos(1)", 0.0),
+    ("SELECT atan(1)", 0.7853981633974483),
+    ("SELECT atan2(1, 1)", 0.7853981633974483),
+    ("SELECT tanh(0)", 0.0),
+    ("SELECT cbrt(27)", 3.0),
+    ("SELECT degrees(pi())", 180.0),
+    ("SELECT radians(180) - pi()", 0.0),
+    ("SELECT log(2, 8)", 3.0),
+    ("SELECT truncate(3.78)", 3.0),
+    ("SELECT truncate(-3.78)", -3.0),
+    ("SELECT is_nan(nan())", True),
+    ("SELECT is_infinite(infinity())", True),
+    ("SELECT is_finite(1.0)", True),
+    ("SELECT bitwise_and(12, 10)", 8),
+    ("SELECT bitwise_or(12, 10)", 14),
+    ("SELECT bitwise_xor(12, 10)", 6),
+    ("SELECT bitwise_not(0)", -1),
+    ("SELECT bitwise_left_shift(1, 4)", 16),
+    ("SELECT bitwise_right_shift(16, 2)", 4),
+    ("SELECT chr(65)", "A"),
+    ("SELECT e()", 2.718281828459045),
+]
+
+
+@pytest.mark.parametrize("sql,want", MATH_CASES)
+def test_math_function(sql, want, runner):
+    got = runner.execute(sql).only_value()
+    if isinstance(want, float):
+        assert got is not None and abs(got - want) < 1e-12
+    else:
+        assert got == want
+
+
+STRING_CASES = [
+    ("SELECT strpos(n_name, 'GER') FROM nation WHERE n_nationkey = 0", 3),
+    ("SELECT strpos(n_name, 'ZZZ') FROM nation WHERE n_nationkey = 0", 0),
+    ("SELECT ends_with(n_name, 'RIA') FROM nation WHERE n_nationkey = 0", True),
+    ("SELECT codepoint(n_name) FROM nation WHERE n_nationkey = 0", ord("A")),
+    ("SELECT split_part(n_comment, ' ', 1) IS NOT NULL FROM nation WHERE n_nationkey = 0", True),
+    ("SELECT lpad(n_name, 10, '.') FROM nation WHERE n_nationkey = 0", "...ALGERIA"),
+    ("SELECT rpad(n_name, 9, '!') FROM nation WHERE n_nationkey = 0", "ALGERIA!!"),
+    ("SELECT lpad(n_name, 3, '.') FROM nation WHERE n_nationkey = 0", "ALG"),
+    ("SELECT translate(n_name, 'AL', 'al') FROM nation WHERE n_nationkey = 0", "alGERIa"),
+    ("SELECT regexp_like(n_name, '^AL') FROM nation WHERE n_nationkey = 0", True),
+    ("SELECT regexp_like(n_name, '^XX') FROM nation WHERE n_nationkey = 0", False),
+    ("SELECT regexp_extract(n_name, '([A-Z]+)IA$', 1) FROM nation WHERE n_nationkey = 0", "ALGER"),
+    ("SELECT regexp_extract(n_name, 'XYZ') FROM nation WHERE n_nationkey = 0", None),
+    ("SELECT regexp_replace(n_name, 'A', 'x') FROM nation WHERE n_nationkey = 0", "xLGERIx"),
+    ("SELECT regexp_count(n_name, 'A') FROM nation WHERE n_nationkey = 0", 2),
+    ("SELECT typeof(1)", "bigint"),
+]
+
+
+@pytest.mark.parametrize("sql,want", STRING_CASES)
+def test_string_function(sql, want, runner):
+    assert runner.execute(sql).only_value() == want
+
+
+DATE_CASES = [
+    ("SELECT quarter(date '1995-05-15')", 2),
+    ("SELECT week(date '2026-01-01')", 1),
+    ("SELECT day_of_week(date '2026-07-30')", 4),   # Thursday
+    ("SELECT day_of_year(date '1995-02-01')", 32),
+    ("SELECT extract(quarter from date '1995-11-15')", 4),
+    ("SELECT extract(dow from date '2026-07-27')", 1),  # Monday
+    ("SELECT date_trunc('month', date '1995-05-15') = date '1995-05-01'", True),
+    ("SELECT date_trunc('year', date '1995-05-15') = date '1995-01-01'", True),
+    ("SELECT date_trunc('quarter', date '1995-05-15') = date '1995-04-01'", True),
+    ("SELECT date_trunc('week', date '2026-07-30') = date '2026-07-27'", True),
+    ("SELECT date_add('day', 17, date '1995-12-20') = date '1996-01-06'", True),
+    ("SELECT date_add('month', 1, date '1996-01-31') = date '1996-02-29'", True),
+    ("SELECT date_add('year', -4, date '2000-02-29') = date '1996-02-29'", True),
+    ("SELECT date_diff('day', date '1995-12-20', date '1996-01-06')", 17),
+    ("SELECT date_diff('month', date '1995-01-31', date '1995-03-01')", 1),
+    ("SELECT date_diff('year', date '1995-06-01', date '1997-05-31')", 1),
+    ("SELECT date_diff('week', date '1995-01-01', date '1995-01-15')", 2),
+    ("SELECT last_day_of_month(date '1996-02-10') = date '1996-02-29'", True),
+    ("SELECT last_day_of_month(date '1995-04-10') = date '1995-04-30'", True),
+]
+
+
+@pytest.mark.parametrize("sql,want", DATE_CASES)
+def test_date_function(sql, want, runner):
+    assert runner.execute(sql).only_value() == want
+
+
+def test_date_functions_on_columns(runner):
+    """Vectorized paths over a real date column vs python datetime."""
+    import datetime
+
+    rows = runner.execute(
+        "SELECT o_orderdate, quarter(o_orderdate), week(o_orderdate),"
+        " day_of_week(o_orderdate), day_of_year(o_orderdate),"
+        " date_trunc('month', o_orderdate),"
+        " date_add('month', 2, o_orderdate),"
+        " date_diff('day', o_orderdate, date '1998-01-01')"
+        " FROM orders LIMIT 500"
+    ).rows
+    assert len(rows) == 500
+    epoch = datetime.date(1970, 1, 1)
+    for d, q, w, dw, dy, tm, am, dd in rows:
+        # DATE values surface as epoch-day integers (tests/oracle.py
+        # convention)
+        d = epoch + datetime.timedelta(days=d)
+        tm = epoch + datetime.timedelta(days=tm)
+        am = epoch + datetime.timedelta(days=am)
+        assert q == (d.month - 1) // 3 + 1
+        assert w == d.isocalendar()[1]
+        assert dw == d.isoweekday()
+        assert dy == d.timetuple().tm_yday
+        assert tm == d.replace(day=1)
+        y = d.year + (d.month + 1) // 12
+        m = (d.month + 1) % 12 + 1
+        import calendar
+
+        want_am = datetime.date(y, m, min(d.day, calendar.monthrange(y, m)[1]))
+        assert am == want_am, (d, am, want_am)
+        assert dd == (datetime.date(1998, 1, 1) - d).days
+
+
+def test_review_regressions(runner):
+    # logical (zero-fill) right shift, not arithmetic
+    assert (
+        runner.execute("SELECT bitwise_right_shift(-8, 2)").only_value()
+        == (-8 % (1 << 64)) >> 2
+    )
+    # \$ escapes the dollar in regexp_replace templates
+    assert (
+        runner.execute(
+            "SELECT regexp_replace(n_name, '^ALGERIA$', '\\$1')"
+            " FROM nation WHERE n_nationkey = 0"
+        ).only_value()
+        == "$1"
+    )
+    # codepoint of the empty string is NULL, not 0
+    assert (
+        runner.execute(
+            "SELECT codepoint(ltrim(' ')) FROM nation WHERE n_nationkey = 0"
+        ).only_value()
+        is None
+    )

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import re as _re
 from typing import Callable, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
@@ -33,6 +34,24 @@ from trino_tpu.expr.ir import Call, Case, Cast, Expr, InList, InputRef, Literal
 
 Value = Tuple[jnp.ndarray, Optional[jnp.ndarray]]
 EvalFn = Callable[[List[jnp.ndarray], List[Optional[jnp.ndarray]]], Value]
+
+# double -> double elementwise library (MathFunctions.java analogues);
+# each entry fuses into the enclosing jitted pipeline
+_UNARY_DOUBLE_FNS = {
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "asin": jnp.arcsin, "acos": jnp.arccos, "atan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "cbrt": jnp.cbrt, "degrees": jnp.degrees, "radians": jnp.radians,
+}
+
+_MICROS_PER_DAY = 86400 * 1000 * 1000
+# sub-day date_trunc/date_add/date_diff units (TIMESTAMP is epoch micros)
+_MICROS_PER_UNIT = {
+    "hour": 3600 * 1000 * 1000,
+    "minute": 60 * 1000 * 1000,
+    "second": 1000 * 1000,
+    "millisecond": 1000,
+}
 
 
 @dataclasses.dataclass
@@ -534,7 +553,7 @@ class ExprBinder:
             return Bound(e.type, sgfn)
         if name in ("sqrt", "ln", "exp", "floor", "ceil"):
             (a,) = args[:1]
-            jf = {"sqrt": jnp.sqrt, "ln": jnp.log, "exp": jnp.exp,
+            jf = {"sqrt": F.sqrt_exact, "ln": jnp.log, "exp": jnp.exp,
                   "floor": jnp.floor, "ceil": jnp.ceil}[name]
             descale = T.decimal_scale_factor(a.type) if a.type.is_decimal else 1
             out_scale = T.decimal_scale_factor(e.type) if e.type.is_decimal else None
@@ -547,7 +566,344 @@ class ExprBinder:
                     out = out.astype(e.type.dtype)
                 return out, v
             return Bound(e.type, mfn)
+        if name in _UNARY_DOUBLE_FNS:
+            (a,) = args[:1]
+            jf = _UNARY_DOUBLE_FNS[name]
+            ds = T.decimal_scale_factor(a.type) if a.type.is_decimal else 1
+            def udfn(cols, valids):
+                d, v = a.fn(cols, valids)
+                return jf(d.astype(jnp.float64) / ds), v
+            return Bound(T.DOUBLE, udfn)
+        if name in ("is_nan", "is_infinite", "is_finite"):
+            (a,) = args
+            jf = {"is_nan": jnp.isnan, "is_infinite": jnp.isinf,
+                  "is_finite": jnp.isfinite}[name]
+            def ckfn(cols, valids):
+                d, v = a.fn(cols, valids)
+                return jf(d.astype(jnp.float64)), v
+            return Bound(T.BOOLEAN, ckfn)
+        if name in ("atan2", "log"):
+            a, b = args
+            def bifn(cols, valids):
+                da, va = a.fn(cols, valids)
+                db, vb = b.fn(cols, valids)
+                x = da.astype(jnp.float64)
+                y = db.astype(jnp.float64)
+                if name == "atan2":
+                    out = jnp.arctan2(x, y)
+                else:  # log(base, x)
+                    out = jnp.log(y) / jnp.log(x)
+                return out, merge_valid(va, vb)
+            return Bound(T.DOUBLE, bifn)
+        if name == "truncate":
+            a = args[0]
+            ndig = 0
+            if len(args) > 1:
+                assert args[1].is_const, "truncate() scale must be constant"
+                ndig = int(args[1].const_value)
+            if a.type.is_decimal:
+                s = a.type.scale or 0
+                if ndig >= s:
+                    return Bound(e.type, a.fn)
+                m = 10 ** (s - ndig)
+                def tdfn(cols, valids, afn=a.fn, m=m):
+                    d, v = afn(cols, valids)
+                    return F.div_trunc(d, _const(d, m, d.dtype)) * m, v
+                return Bound(e.type, tdfn)
+            def trfn(cols, valids, afn=a.fn, ndig=ndig):
+                d, v = afn(cols, valids)
+                sf = 10.0 ** ndig
+                x = d.astype(jnp.float64) * sf
+                return jnp.sign(x) * jnp.floor(jnp.abs(x)) / sf, v
+            return Bound(T.DOUBLE, trfn)
+        if name in ("bitwise_and", "bitwise_or", "bitwise_xor",
+                    "bitwise_left_shift", "bitwise_right_shift"):
+            a, b = args
+            def logical_rshift(x, n):
+                # Trino's bitwise_right_shift is a LOGICAL zero-fill
+                # shift (the arithmetic variant is a separate function)
+                return jnp.right_shift(
+                    x.astype(jnp.uint64), n.astype(jnp.uint64)
+                ).astype(jnp.int64)
+            jf = {"bitwise_and": jnp.bitwise_and,
+                  "bitwise_or": jnp.bitwise_or,
+                  "bitwise_xor": jnp.bitwise_xor,
+                  "bitwise_left_shift": jnp.left_shift,
+                  "bitwise_right_shift": logical_rshift}[name]
+            def bwfn(cols, valids):
+                da, va = a.fn(cols, valids)
+                db, vb = b.fn(cols, valids)
+                return (
+                    jf(da.astype(jnp.int64), db.astype(jnp.int64)),
+                    merge_valid(va, vb),
+                )
+            return Bound(T.BIGINT, bwfn)
+        if name == "bitwise_not":
+            (a,) = args
+            def bnfn(cols, valids):
+                d, v = a.fn(cols, valids)
+                return jnp.bitwise_not(d.astype(jnp.int64)), v
+            return Bound(T.BIGINT, bnfn)
+        # -- string functions over dictionary values (host-side transform,
+        # device-side code remap — never per row) --
+        if name == "strpos":
+            sub = e.args[1]
+            assert isinstance(sub, Literal), "strpos() substring must be constant"
+            return self._bind_dict_table(
+                args[0], T.BIGINT,
+                lambda s: s.find(sub.value) + 1, jnp.int64,
+            )
+        if name == "ends_with":
+            suffix = e.args[1]
+            assert isinstance(suffix, Literal), "ends_with() suffix must be constant"
+            return self._bind_dict_table(
+                args[0], T.BOOLEAN,
+                lambda s: s.endswith(suffix.value), jnp.bool_,
+            )
+        if name == "codepoint":
+            # empty string has no code point: NULL (Trino raises; a
+            # data-dependent error can't abort an XLA program — the
+            # module-docstring deviation applies)
+            a = args[0]
+            if a.dictionary is None or len(a.dictionary) == 0:
+                return self._null_of(a, T.BIGINT)
+            table = jnp.asarray(
+                [ord(v[0]) if v else 0 for v in a.dictionary.values],
+                dtype=jnp.int64,
+            )
+            ok_t = jnp.asarray(
+                [len(v) > 0 for v in a.dictionary.values], dtype=jnp.bool_
+            )
+            def cpfn(cols, valids, afn=a.fn):
+                d, v = afn(cols, valids)
+                idx = jnp.clip(d, 0, table.shape[0] - 1)
+                ok = jnp.take(ok_t, idx)
+                return jnp.take(table, idx), ok if v is None else (v & ok)
+            return Bound(T.BIGINT, cpfn)
+        if name == "split_part":
+            delim, idx = e.args[1], e.args[2]
+            assert isinstance(delim, Literal) and isinstance(idx, Literal), (
+                "split_part() delimiter/index must be constants"
+            )
+            n = int(idx.value)
+            assert n >= 1, "split_part() index is 1-based"
+            def sp(s: str) -> str:
+                parts = s.split(delim.value)
+                return parts[n - 1] if n <= len(parts) else ""
+            return self._bind_dict_transform(args[0], e, sp)
+        if name in ("lpad", "rpad"):
+            size = e.args[1]
+            pad = e.args[2] if len(e.args) > 2 else Literal(" ", T.VARCHAR)
+            assert isinstance(size, Literal) and isinstance(pad, Literal), (
+                f"{name}() size/padstring must be constants"
+            )
+            width, fill = int(size.value), pad.value or " "
+            def padfn(s: str) -> str:
+                if len(s) >= width:
+                    return s[:width]
+                need = width - len(s)
+                padding = (fill * need)[:need]
+                return padding + s if name == "lpad" else s + padding
+            return self._bind_dict_transform(args[0], e, padfn)
+        if name == "translate":
+            frm, to = e.args[1], e.args[2]
+            assert isinstance(frm, Literal) and isinstance(to, Literal), (
+                "translate() from/to must be constants"
+            )
+            table = {}
+            for i, c in enumerate(frm.value):
+                if c not in table:
+                    table[c] = to.value[i] if i < len(to.value) else None
+            def trl(s: str) -> str:
+                return "".join(
+                    table.get(c, c) for c in s if table.get(c, c) is not None
+                )
+            return self._bind_dict_transform(args[0], e, trl)
+        if name in ("regexp_like", "regexp_count"):
+            pat = e.args[1]
+            assert isinstance(pat, Literal), "regexp pattern must be constant"
+            rx = _re.compile(pat.value)
+            if name == "regexp_like":
+                return self._bind_dict_table(
+                    args[0], T.BOOLEAN,
+                    lambda s: rx.search(s) is not None, jnp.bool_,
+                )
+            return self._bind_dict_table(
+                args[0], T.BIGINT,
+                lambda s: sum(1 for _ in rx.finditer(s)), jnp.int64,
+            )
+        if name == "regexp_extract":
+            pat = e.args[1]
+            assert isinstance(pat, Literal), "regexp pattern must be constant"
+            group = 0
+            if len(e.args) > 2:
+                g = e.args[2]
+                assert isinstance(g, Literal), "regexp group must be constant"
+                group = int(g.value)
+            rx = _re.compile(pat.value)
+            # NULL result for non-matches: transform to a sentinel and
+            # mask it out (dictionary transforms are total functions)
+            a = args[0]
+            if a.dictionary is None or len(a.dictionary) == 0:
+                return self._null_of(a, T.VARCHAR)
+            hits, matched = [], []
+            for v in a.dictionary.values:
+                m = rx.search(v)
+                ok = m is not None and (group == 0 or m.group(group) is not None)
+                matched.append(ok)
+                hits.append(m.group(group) if ok else "")
+            new_dict = Dictionary(hits)
+            remap = jnp.asarray(
+                [new_dict.code(h) for h in hits], dtype=jnp.int32
+            )
+            ok_t = jnp.asarray(matched, dtype=jnp.bool_)
+            def refn(cols, valids, afn=a.fn):
+                d, v = afn(cols, valids)
+                idx = jnp.clip(d, 0, remap.shape[0] - 1)
+                ok = jnp.take(ok_t, idx)
+                return jnp.take(remap, idx), ok if v is None else (v & ok)
+            return Bound(T.VARCHAR, refn, new_dict)
+        if name == "regexp_replace":
+            pat = e.args[1]
+            rep = e.args[2] if len(e.args) > 2 else Literal("", T.VARCHAR)
+            assert isinstance(pat, Literal) and isinstance(rep, Literal), (
+                "regexp_replace() pattern/replacement must be constants"
+            )
+            rx = _re.compile(pat.value)
+            # Trino replacement template: $N = group ref, \$ = literal
+            # dollar, \\ = literal backslash. Parse once into segments
+            # and substitute with a callable (avoids python \-escape
+            # reinterpretation of the template).
+            segs: List[object] = []  # str literal | int group number
+            buf: List[str] = []
+            t = rep.value
+            i = 0
+            while i < len(t):
+                c = t[i]
+                if c == "\\" and i + 1 < len(t):
+                    buf.append(t[i + 1])
+                    i += 2
+                elif c == "$" and i + 1 < len(t) and t[i + 1].isdigit():
+                    j = i + 1
+                    while j < len(t) and t[j].isdigit():
+                        j += 1
+                    if buf:
+                        segs.append("".join(buf))
+                        buf = []
+                    segs.append(int(t[i + 1:j]))
+                    i = j
+                else:
+                    buf.append(c)
+                    i += 1
+            if buf:
+                segs.append("".join(buf))
+            def rrepl(m):
+                return "".join(
+                    s if isinstance(s, str) else (m.group(s) or "")
+                    for s in segs
+                )
+            return self._bind_dict_transform(
+                args[0], e, lambda s: rx.sub(rrepl, s)
+            )
+        # -- date arithmetic (vectorized civil calendar, functions.py) --
+        if name in ("quarter", "week", "day_of_week", "day_of_year"):
+            a = args[0]
+            part = {"quarter": lambda d: (F.extract_month(d) - 1) // 3 + 1,
+                    "week": F.week_of_year,
+                    "day_of_week": F.day_of_week,
+                    "day_of_year": F.day_of_year}[name]
+            def dpfn(cols, valids):
+                d, v = a.fn(cols, valids)
+                return part(self._to_days(a, d)).astype(jnp.int64), v
+            return Bound(T.BIGINT, dpfn)
+        if name == "date_trunc":
+            unit = e.args[0]
+            assert isinstance(unit, Literal), "date_trunc unit must be constant"
+            a = args[1]
+            u = unit.value.lower()
+            if a.type.kind == T.TypeKind.TIMESTAMP:
+                def ttfn(cols, valids):
+                    d, v = a.fn(cols, valids)
+                    if u in _MICROS_PER_UNIT:
+                        q = _MICROS_PER_UNIT[u]
+                        return (d // q) * q, v
+                    days = F.date_trunc_days(u, d // _MICROS_PER_DAY)
+                    return days.astype(jnp.int64) * _MICROS_PER_DAY, v
+                return Bound(T.TIMESTAMP, ttfn)
+            def tdfn2(cols, valids):
+                d, v = a.fn(cols, valids)
+                return F.date_trunc_days(u, d).astype(a.type.dtype), v
+            return Bound(a.type, tdfn2)
+        if name == "date_add":
+            unit = e.args[0]
+            assert isinstance(unit, Literal), "date_add unit must be constant"
+            u = unit.value.lower()
+            nb, a = args[1], args[2]
+            if a.type.kind == T.TypeKind.TIMESTAMP:
+                def tafn(cols, valids):
+                    d, v = a.fn(cols, valids)
+                    n, vn = nb.fn(cols, valids)
+                    if u in _MICROS_PER_UNIT:
+                        out = d + n.astype(jnp.int64) * _MICROS_PER_UNIT[u]
+                    else:
+                        rem = d % _MICROS_PER_DAY
+                        days = F.date_add_days(u, n, d // _MICROS_PER_DAY)
+                        out = days.astype(jnp.int64) * _MICROS_PER_DAY + rem
+                    return out, merge_valid(v, vn)
+                return Bound(T.TIMESTAMP, tafn)
+            def dafn(cols, valids):
+                d, v = a.fn(cols, valids)
+                n, vn = nb.fn(cols, valids)
+                out = F.date_add_days(u, n, d).astype(a.type.dtype)
+                return out, merge_valid(v, vn)
+            return Bound(a.type, dafn)
+        if name == "date_diff":
+            unit = e.args[0]
+            assert isinstance(unit, Literal), "date_diff unit must be constant"
+            u = unit.value.lower()
+            a, b = args[1], args[2]
+            def ddfn(cols, valids):
+                da, va = a.fn(cols, valids)
+                db, vb = b.fn(cols, valids)
+                xa = self._to_days(a, da)
+                xb = self._to_days(b, db)
+                if u in _MICROS_PER_UNIT:
+                    assert a.type.kind == T.TypeKind.TIMESTAMP
+                    out = F.div_trunc(
+                        db - da, _const(da, _MICROS_PER_UNIT[u], jnp.int64)
+                    )
+                else:
+                    out = F.date_diff_days(u, xa, xb)
+                return out.astype(jnp.int64), merge_valid(va, vb)
+            return Bound(T.BIGINT, ddfn)
+        if name == "last_day_of_month":
+            a = args[0]
+            def ldfn(cols, valids):
+                d, v = a.fn(cols, valids)
+                days = F.last_day_of_month_days(self._to_days(a, d))
+                return days.astype(T.DATE.dtype), v
+            return Bound(T.DATE, ldfn)
         raise NotImplementedError(f"scalar function {name}")
+
+    @staticmethod
+    def _to_days(a: Bound, data: jnp.ndarray) -> jnp.ndarray:
+        """DATE (epoch days) or TIMESTAMP (epoch micros) -> epoch days."""
+        if a.type.kind == T.TypeKind.TIMESTAMP:
+            return data // (86400 * 1000 * 1000)
+        return data
+
+    def _bind_dict_table(self, a: Bound, out_type: T.DataType, pyfn, dtype) -> Bound:
+        """Non-string-valued function of a dictionary column: evaluate
+        over |dict| values on host, take() the result table on device."""
+        if a.dictionary is None or len(a.dictionary) == 0:
+            return self._null_of(a, out_type)
+        table = jnp.asarray(
+            [pyfn(v) for v in a.dictionary.values], dtype=dtype
+        )
+        def fn(cols, valids):
+            d, v = a.fn(cols, valids)
+            return jnp.take(table, jnp.clip(d, 0, table.shape[0] - 1)), v
+        return Bound(out_type, fn)
 
     @staticmethod
     def _py_substr(s: str, start_lit: Expr, len_lit: Optional[Expr]) -> str:

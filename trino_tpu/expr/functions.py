@@ -122,3 +122,133 @@ def like_to_regex(pattern: str, escape: str | None = None) -> "re.Pattern":
 def dictionary_like_table(dictionary, pattern: str, escape=None) -> np.ndarray:
     rx = like_to_regex(pattern, escape)
     return np.asarray([rx.match(v) is not None for v in dictionary.values], dtype=bool)
+
+
+# -- civil-calendar composition + date arithmetic (DateTimeFunctions
+# analogues: date_trunc/date_add/date_diff/week/quarter/... — all pure
+# int32 VPU arithmetic, no host round-trips) --
+
+
+def days_from_civil(y: jnp.ndarray, m: jnp.ndarray, d: jnp.ndarray):
+    """(year, month, day) -> days since 1970-01-01 (Hinnant's
+    days_from_civil with floor division)."""
+    y = y.astype(jnp.int32) - (m <= 2)
+    era = y // 400
+    yoe = y - era * 400  # [0, 399]
+    mp = jnp.where(m > 2, m - 3, m + 9)  # [0, 11]
+    doy = (153 * mp + 2) // 5 + d - 1  # [0, 365]
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy  # [0, 146096]
+    return era * 146097 + doe - 719468
+
+
+def days_in_month(y: jnp.ndarray, m: jnp.ndarray):
+    """Length of month m in year y, vectorized."""
+    leap = ((y % 4 == 0) & (y % 100 != 0)) | (y % 400 == 0)
+    lengths = jnp.asarray([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31],
+                          dtype=jnp.int32)
+    base = jnp.take(lengths, jnp.clip(m - 1, 0, 11))
+    return jnp.where((m == 2) & leap, 29, base)
+
+
+def day_of_week(days: jnp.ndarray):
+    """ISO day-of-week: Monday=1..Sunday=7 (1970-01-01 was a Thursday)."""
+    return (days.astype(jnp.int32) + 3) % 7 + 1
+
+
+def day_of_year(days: jnp.ndarray):
+    y, _, _ = civil_from_days(days)
+    jan1 = days_from_civil(y, jnp.ones_like(y), jnp.ones_like(y))
+    return days.astype(jnp.int32) - jan1 + 1
+
+
+def week_of_year(days: jnp.ndarray):
+    """ISO-8601 week number: the week containing this date's Thursday."""
+    days = days.astype(jnp.int32)
+    thursday = days - (day_of_week(days) - 4)
+    y, _, _ = civil_from_days(thursday)
+    jan1 = days_from_civil(y, jnp.ones_like(y), jnp.ones_like(y))
+    return (thursday - jan1) // 7 + 1
+
+
+def date_trunc_days(unit: str, days: jnp.ndarray):
+    """date_trunc on epoch-day values (DATE resolution units)."""
+    days = days.astype(jnp.int32)
+    if unit == "day":
+        return days
+    if unit == "week":  # ISO week start: Monday
+        return days - (day_of_week(days) - 1)
+    y, m, d = civil_from_days(days)
+    one = jnp.ones_like(y)
+    if unit == "month":
+        return days_from_civil(y, m, one)
+    if unit == "quarter":
+        return days_from_civil(y, ((m - 1) // 3) * 3 + 1, one)
+    if unit == "year":
+        return days_from_civil(y, one, one)
+    raise ValueError(f"unsupported date_trunc unit {unit!r}")
+
+
+def add_months_vec(days: jnp.ndarray, n: jnp.ndarray):
+    """date + n months with SQL end-of-month clamping, vectorized."""
+    y, m, d = civil_from_days(days)
+    total = y * 12 + (m - 1) + n.astype(jnp.int32)
+    ny = total // 12
+    nm = total % 12 + 1
+    nd = jnp.minimum(d, days_in_month(ny, nm))
+    return days_from_civil(ny, nm, nd)
+
+
+def date_add_days(unit: str, n: jnp.ndarray, days: jnp.ndarray):
+    n = n.astype(jnp.int32)
+    days = days.astype(jnp.int32)
+    if unit == "day":
+        return days + n
+    if unit == "week":
+        return days + 7 * n
+    if unit == "month":
+        return add_months_vec(days, n)
+    if unit == "quarter":
+        return add_months_vec(days, 3 * n)
+    if unit == "year":
+        return add_months_vec(days, 12 * n)
+    raise ValueError(f"unsupported date_add unit {unit!r}")
+
+
+def date_diff_days(unit: str, a: jnp.ndarray, b: jnp.ndarray):
+    """date_diff(unit, a, b) = signed count of unit boundaries from a to
+    b (Trino: b - a). Month/year counts are full months elapsed."""
+    a = a.astype(jnp.int32)
+    b = b.astype(jnp.int32)
+    if unit == "day":
+        return b - a
+    if unit == "week":
+        return div_trunc(b - a, jnp.full_like(b, 7))
+    if unit in ("month", "quarter", "year"):
+        ya, ma, da = civil_from_days(a)
+        yb, mb, db = civil_from_days(b)
+        months = (yb * 12 + mb) - (ya * 12 + ma)
+        # back off one month if the day-of-month hasn't been reached
+        months = months - jnp.where(
+            (months > 0) & (db < da), 1, 0
+        ) + jnp.where((months < 0) & (db > da), 1, 0)
+        if unit == "month":
+            return months
+        if unit == "quarter":
+            return div_trunc(months, jnp.full_like(months, 3))
+        return div_trunc(months, jnp.full_like(months, 12))
+    raise ValueError(f"unsupported date_diff unit {unit!r}")
+
+
+def last_day_of_month_days(days: jnp.ndarray):
+    y, m, _ = civil_from_days(days)
+    return days_from_civil(y, m, days_in_month(y, m))
+
+
+def sqrt_exact(x: jnp.ndarray) -> jnp.ndarray:
+    """sqrt with integer-root snapping: TPU's software-emulated f64
+    sqrt can come out 1 ulp low (sqrt(49) = 7 - 2.8e-14), which breaks
+    floor/truncate-of-sqrt idioms; snap to the nearest integer when it
+    is the exact root (MathFunctions.sqrt contract on the JVM)."""
+    y = jnp.sqrt(x)
+    yr = round_half_away(y)
+    return jnp.where(yr * yr == x, yr, y)

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import dataclasses
 import datetime
+import math
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from trino_tpu import types as T
@@ -272,9 +273,14 @@ class ExprConverter:
             return self._convert_cast(e)
         if isinstance(e, ast.Extract):
             a = self.convert(e.operand)
-            if e.field not in ("year", "month", "day"):
-                raise AnalysisError(f"extract({e.field}) not supported")
-            return ir.Call(f"extract_{e.field}", (a,), T.BIGINT)
+            if e.field in ("year", "month", "day"):
+                return ir.Call(f"extract_{e.field}", (a,), T.BIGINT)
+            canon = {"quarter": "quarter", "week": "week",
+                     "dow": "day_of_week", "day_of_week": "day_of_week",
+                     "doy": "day_of_year", "day_of_year": "day_of_year"}
+            if e.field in canon:
+                return ir.Call(canon[e.field], (a,), T.BIGINT)
+            raise AnalysisError(f"extract({e.field}) not supported")
         if isinstance(e, ast.FunctionCall):
             return self._convert_call(e)
         if isinstance(e, (ast.Exists, ast.InSubquery, ast.ScalarSubquery)):
@@ -406,6 +412,66 @@ class ExprConverter:
                 [args[1].type] + ([default.type] if default is not None else [])
             )
             return ir.Case((args[0],), (args[1],), default, out)
+        if name in ("sin", "cos", "tan", "asin", "acos", "atan", "sinh",
+                    "cosh", "tanh", "cbrt", "degrees", "radians"):
+            return ir.Call(name, args, T.DOUBLE)
+        if name in ("atan2", "log"):
+            if len(args) != 2:
+                raise AnalysisError(f"{name}() takes two arguments")
+            return ir.Call(name, args, T.DOUBLE)
+        if name == "pi":
+            return ir.Literal(math.pi, T.DOUBLE)
+        if name == "e":
+            return ir.Literal(math.e, T.DOUBLE)
+        if name == "nan":
+            return ir.Literal(float("nan"), T.DOUBLE)
+        if name == "infinity":
+            return ir.Literal(float("inf"), T.DOUBLE)
+        if name in ("is_nan", "is_infinite", "is_finite"):
+            return ir.Call(name, args, T.BOOLEAN)
+        if name == "truncate":
+            out = args[0].type if args[0].type.is_decimal else T.DOUBLE
+            return ir.Call(name, args, out)
+        if name in ("bitwise_and", "bitwise_or", "bitwise_xor",
+                    "bitwise_not", "bitwise_left_shift",
+                    "bitwise_right_shift"):
+            return ir.Call(name, args, T.BIGINT)
+        if name in ("strpos", "codepoint"):
+            return ir.Call(name, args, T.BIGINT)
+        if name in ("ends_with", "regexp_like"):
+            return ir.Call(name, args, T.BOOLEAN)
+        if name in ("split_part", "lpad", "rpad", "translate",
+                    "regexp_extract", "regexp_replace"):
+            return ir.Call(name, args, T.VARCHAR)
+        if name == "regexp_count":
+            return ir.Call(name, args, T.BIGINT)
+        if name == "chr":
+            if not isinstance(args[0], ir.Literal):
+                raise AnalysisError("chr() argument must be a constant")
+            return ir.Literal(chr(int(args[0].value)), T.VARCHAR)
+        if name in ("quarter", "week", "day_of_week", "dow", "day_of_year",
+                    "doy", "day_of_month"):
+            canon = {"dow": "day_of_week", "doy": "day_of_year",
+                     "day_of_month": "extract_day"}.get(name, name)
+            return ir.Call(canon, args, T.BIGINT)
+        if name == "date_trunc":
+            if len(args) != 2:
+                raise AnalysisError("date_trunc() takes two arguments")
+            return ir.Call(name, args, args[1].type)
+        if name == "date_add":
+            if len(args) != 3:
+                raise AnalysisError("date_add() takes three arguments")
+            return ir.Call(name, args, args[2].type)
+        if name == "date_diff":
+            if len(args) != 3:
+                raise AnalysisError("date_diff() takes three arguments")
+            return ir.Call(name, args, T.BIGINT)
+        if name == "last_day_of_month":
+            return ir.Call(name, args, T.DATE)
+        if name == "typeof":
+            if len(args) != 1:
+                raise AnalysisError("typeof() takes one argument")
+            return ir.Literal(str(args[0].type), T.VARCHAR)
         raise AnalysisError(f"unknown function {name}()")
 
 

@@ -243,11 +243,7 @@ class Parser:
                 name = self._parse_name()
                 colnames: Tuple[str, ...] = ()
                 if self.accept_op("("):
-                    cols = [self._parse_name()]
-                    while self.accept_op(","):
-                        cols.append(self._parse_name())
-                    self.expect_op(")")
-                    colnames = tuple(cols)
+                    colnames = self._parse_name_list()
                 self.expect_kw("AS")
                 self.expect_op("(")
                 q = self.parse_query()
