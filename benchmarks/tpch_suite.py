@@ -1,0 +1,99 @@
+"""TPC-H suite runner — the benchto-benchmarks analogue
+(testing/trino-benchto-benchmarks/.../tpch.yaml: prewarm runs + N
+measured runs per query, wall-clock; SURVEY.md §6).
+
+Usage:
+    python benchmarks/tpch_suite.py [--sf 0.1] [--runs 3] [--prewarm 1]
+                                    [--queries 1,6,3] [--distributed N]
+
+Prints one JSON line per query:
+    {"query": "q01", "sf": 0.1, "median_s": ..., "runs": [...],
+     "rows": ..., "engine": "local"|"distributed-N"}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tests"))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sf", type=float, default=0.1)
+    ap.add_argument("--runs", type=int, default=3)
+    ap.add_argument("--prewarm", type=int, default=1)
+    ap.add_argument("--queries", type=str, default="")
+    ap.add_argument(
+        "--distributed", type=int, default=0,
+        help="run through the distributed runtime with N workers",
+    )
+    args = ap.parse_args()
+
+    from tpch_queries import QUERIES  # tests/tpch_queries.py
+
+    qids = (
+        [int(q) for q in args.queries.split(",")]
+        if args.queries
+        else sorted(QUERIES)
+    )
+
+    from trino_tpu.connectors.tpch import create_tpch_connector
+    from trino_tpu.engine import Session
+
+    # the tpch connector resolves the scale factor from the schema name
+    session = Session(catalog="tpch", schema=f"sf{args.sf:g}")
+    if args.distributed:
+        from trino_tpu.runtime.coordinator import DistributedQueryRunner
+
+        runner = DistributedQueryRunner(
+            session=session, n_workers=args.distributed
+        )
+        engine = f"distributed-{args.distributed}"
+    else:
+        from trino_tpu.engine import LocalQueryRunner
+
+        runner = LocalQueryRunner(session)
+        engine = "local"
+    runner.register_catalog("tpch", create_tpch_connector())
+
+    for qid in qids:
+        sql = QUERIES[qid]
+        try:
+            for _ in range(args.prewarm):
+                res = runner.execute(sql)
+            times = []
+            for _ in range(args.runs):
+                t0 = time.perf_counter()
+                res = runner.execute(sql)
+                times.append(time.perf_counter() - t0)
+            print(
+                json.dumps(
+                    {
+                        "query": f"q{qid:02d}",
+                        "sf": args.sf,
+                        "median_s": round(statistics.median(times), 4),
+                        "runs": [round(t, 4) for t in times],
+                        "rows": len(res.rows),
+                        "engine": engine,
+                    }
+                ),
+                flush=True,
+            )
+        except Exception as ex:  # keep the suite going (benchto behavior)
+            print(
+                json.dumps(
+                    {"query": f"q{qid:02d}", "sf": args.sf,
+                     "error": f"{type(ex).__name__}: {ex}"[:200]}
+                ),
+                flush=True,
+            )
+
+if __name__ == "__main__":
+    main()

@@ -209,3 +209,97 @@ def test_decimal_supertype_overflow_raises():
     with pytest.raises(TypeError):
         T.common_super_type(T.decimal(18, 0), T.decimal(18, 18))
     assert T.common_super_type(T.decimal(12, 2), T.decimal(10, 4)) == T.decimal(14, 4)
+
+
+class TestMxuGroupby:
+    """Pallas MXU one-hot contraction kernel (ops/mxu_groupby.py) — the
+    GroupByHash+accumulate hot loop on the systolic array (SURVEY.md
+    §3.3). Interpret mode on CPU computes the identical program."""
+
+    def _check(self, n, c, n_vals, seed, live_frac=1.0):
+        import jax
+        import numpy as np
+        import jax.numpy as jnp
+        from trino_tpu.ops.mxu_groupby import (
+            grouped_sum_mxu, grouped_sum_reference,
+        )
+
+        rng = np.random.default_rng(seed)
+        gid = jnp.asarray(rng.integers(0, c, n, dtype=np.int32))
+        live = jnp.asarray(rng.random(n) < live_frac)
+        vals = tuple(
+            jnp.asarray(rng.integers(-(10**12), 10**12, n).astype(np.int64))
+            for _ in range(n_vals)
+        )
+        interp = jax.default_backend() != "tpu"
+        got = grouped_sum_mxu(gid, vals, live, c, interpret=interp)
+        want = grouped_sum_reference(gid, vals, live, c)
+        for g, w in zip(got, want):
+            assert np.array_equal(np.asarray(g), np.asarray(w))
+
+    def test_exact_int64_sums(self):
+        self._check(n=3000, c=300, n_vals=2, seed=1)
+
+    def test_masked_rows_and_row_padding(self):
+        # n not a multiple of the 256-row tile; 30% dead rows
+        self._check(n=1001, c=17, n_vals=1, seed=2, live_frac=0.7)
+
+    def test_many_values_multi_sublane_tile(self):
+        # >7 value columns forces a8 > 8 (two sublane tiles of planes)
+        self._check(n=2048, c=100, n_vals=9, seed=3)
+
+    def test_mxu_group_reduce_contract(self):
+        """mxu_group_reduce matches dense_group_reduce on the same
+        bounded-domain inputs (sum/count reducers)."""
+        import numpy as np
+        import jax.numpy as jnp
+        from trino_tpu.ops.groupby import dense_group_reduce, mxu_group_reduce
+
+        rng = np.random.default_rng(4)
+        n, d0, d1 = 5000, 5, 7
+        keys = [
+            jnp.asarray(rng.integers(0, d0, n).astype(np.int64)),
+            jnp.asarray(rng.integers(0, d1, n).astype(np.int64)),
+        ]
+        valids = [
+            jnp.asarray(rng.random(n) < 0.9),
+            jnp.ones(n, dtype=jnp.bool_),
+        ]
+        mask = jnp.asarray(rng.random(n) < 0.8)
+        values = [
+            jnp.asarray(rng.integers(-1000, 1000, n).astype(np.int64)),
+            jnp.ones(n, dtype=jnp.int64),
+        ]
+        vvalids = [jnp.asarray(rng.random(n) < 0.95), None]
+        args = (keys, valids, mask, values, tuple(vvalids),
+                ("sum", "count"), (d0, d1), 64)
+        want = dense_group_reduce(*args)
+        got = mxu_group_reduce(*args)
+        for g, w in zip(got[:5], want[:5]):
+            for ga, wa in zip(
+                (g if isinstance(g, (list, tuple)) else [g]),
+                (w if isinstance(w, (list, tuple)) else [w]),
+            ):
+                assert np.array_equal(np.asarray(ga), np.asarray(wa))
+        assert int(got[5]) == int(want[5])
+
+    def test_engine_routes_through_mxu(self, monkeypatch):
+        """A bounded-dictionary GROUP BY in the (64, 2048] band runs
+        through the Pallas path and matches the sort-path answer."""
+        monkeypatch.setenv("TRINO_TPU_FORCE_MXU", "1")
+        from trino_tpu.connectors.tpch import create_tpch_connector
+        from trino_tpu.engine import LocalQueryRunner, Session
+
+        sql = (
+            "SELECT s_name, count(*), sum(ps_availqty)"
+            " FROM partsupp, supplier WHERE ps_suppkey = s_suppkey"
+            " GROUP BY s_name ORDER BY s_name"
+        )
+        r = LocalQueryRunner(Session(catalog="tpch", schema="tiny"))
+        r.register_catalog("tpch", create_tpch_connector())
+        forced = r.execute(sql).rows
+        monkeypatch.setenv("TRINO_TPU_FORCE_MXU", "0")
+        r2 = LocalQueryRunner(Session(catalog="tpch", schema="tiny"))
+        r2.register_catalog("tpch", create_tpch_connector())
+        assert forced == r2.execute(sql).rows
+        assert len(forced) == 100  # one row per supplier

@@ -30,6 +30,7 @@ import numpy as np
 from trino_tpu import types as T
 from trino_tpu.block import Column, Dictionary, RelBatch
 from trino_tpu.expr import functions as F
+from trino_tpu.ops.gather import take_clip
 from trino_tpu.expr.ir import Call, Case, Cast, Expr, InList, InputRef, Literal
 
 Value = Tuple[jnp.ndarray, Optional[jnp.ndarray]]
@@ -258,7 +259,7 @@ class ExprBinder:
                     data, v = afn(cols, valids)
                     in_range = (data >= lo) & (data < hi)
                     idx = jnp.clip(data - lo, 0, hi - lo - 1).astype(jnp.int32)
-                    out = jnp.take(codes, idx)
+                    out = take_clip(codes, idx)
                     vv = in_range if v is None else (v & in_range)
                     return out, vv
                 return Bound(dst, sfn, d)
@@ -331,7 +332,7 @@ class ExprBinder:
         )
         def fn(cols, valids, bfn=b.fn, remap=remap):
             d, v = bfn(cols, valids)
-            return jnp.take(remap, jnp.clip(d, 0, remap.shape[0] - 1)), v
+            return take_clip(remap, d), v
         return Bound(b.type, fn, target)
 
     # ---- IN list ----
@@ -423,7 +424,7 @@ class ExprBinder:
             table = jnp.asarray([len(v) for v in a.dictionary.values], dtype=jnp.int64)
             def lenfn(cols, valids):
                 d, v = a.fn(cols, valids)
-                return jnp.take(table, jnp.clip(d, 0, table.shape[0] - 1)), v
+                return take_clip(table, d), v
             return Bound(T.BIGINT, lenfn)
         if name == "abs":
             (a,) = args
@@ -478,7 +479,7 @@ class ExprBinder:
             )
             def swfn(cols, valids, afn=a.fn):
                 d, v = afn(cols, valids)
-                return jnp.take(table, jnp.clip(d, 0, table.shape[0] - 1)), v
+                return take_clip(table, d), v
             return Bound(T.BOOLEAN, swfn)
         if name == "concat":
             return self._bind_concat(e, args)
@@ -677,8 +678,8 @@ class ExprBinder:
             def cpfn(cols, valids, afn=a.fn):
                 d, v = afn(cols, valids)
                 idx = jnp.clip(d, 0, table.shape[0] - 1)
-                ok = jnp.take(ok_t, idx)
-                return jnp.take(table, idx), ok if v is None else (v & ok)
+                ok = take_clip(ok_t, idx)
+                return take_clip(table, idx), ok if v is None else (v & ok)
             return Bound(T.BIGINT, cpfn)
         if name == "split_part":
             delim, idx = e.args[1], e.args[2]
@@ -760,8 +761,8 @@ class ExprBinder:
             def refn(cols, valids, afn=a.fn):
                 d, v = afn(cols, valids)
                 idx = jnp.clip(d, 0, remap.shape[0] - 1)
-                ok = jnp.take(ok_t, idx)
-                return jnp.take(remap, idx), ok if v is None else (v & ok)
+                ok = take_clip(ok_t, idx)
+                return take_clip(remap, idx), ok if v is None else (v & ok)
             return Bound(T.VARCHAR, refn, new_dict)
         if name == "regexp_replace":
             pat = e.args[1]
@@ -902,7 +903,7 @@ class ExprBinder:
         )
         def fn(cols, valids):
             d, v = a.fn(cols, valids)
-            return jnp.take(table, jnp.clip(d, 0, table.shape[0] - 1)), v
+            return take_clip(table, d), v
         return Bound(out_type, fn)
 
     @staticmethod
@@ -937,7 +938,7 @@ class ExprBinder:
         remap = jnp.asarray([new_dict.code(t) for t in transformed], dtype=jnp.int32)
         def fn(cols, valids):
             d, v = a.fn(cols, valids)
-            return jnp.take(remap, jnp.clip(d, 0, remap.shape[0] - 1)), v
+            return take_clip(remap, d), v
         return Bound(e.type, fn, new_dict)
 
     def _bind_concat(self, e: Call, args) -> Bound:
@@ -992,7 +993,7 @@ class ExprBinder:
         table = jnp.asarray(F.dictionary_like_table(a.dictionary, pattern.value, escape))
         def fn(cols, valids):
             d, v = a.fn(cols, valids)
-            return jnp.take(table, jnp.clip(d, 0, table.shape[0] - 1)), v
+            return take_clip(table, d), v
         return Bound(T.BOOLEAN, fn)
 
     def _bind_coalesce(self, e: Call, args) -> Bound:

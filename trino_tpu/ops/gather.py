@@ -1,0 +1,18 @@
+"""Gather with explicit clip mode.
+
+jnp.take's default out-of-bounds mode ('fill') lowers to a guarded
+gather that is catastrophically slower on TPU (measured on v5e: 20.7ms
+vs 0.09ms for a 1M-row gather from a 128k table — 230x). Every gather
+in this engine indexes with values that are in range by construction
+(argsort permutations, pre-clipped positions, cumsum offsets), so clip
+mode is semantics-preserving and is the engine-wide default.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def take_clip(arr, indices, *args, **kwargs):
+    kwargs.setdefault("mode", "clip")
+    return jnp.take(arr, indices, *args, **kwargs)

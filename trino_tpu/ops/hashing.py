@@ -68,9 +68,12 @@ def hash64(
     """64-bit hash from two independently-seeded 32-bit streams."""
     lo = hash32(columns, valids, seed=0)
     hi = hash32(columns, valids, seed=0x243F6A88)
+    # 62-bit mask: leaves headroom above the hash range for the join's
+    # NULL-probe / dead-build sentinels AND for the (value << 1) | tag
+    # encoding of ops/join.sorted_run_bounds to stay within uint64
     return (hi.astype(jnp.uint64) << jnp.uint64(32) | lo.astype(jnp.uint64)).astype(
         jnp.int64
-    ) & jnp.int64(0x7FFFFFFFFFFFFFFF)
+    ) & jnp.int64(0x3FFFFFFFFFFFFFFF)
 
 
 def partition_of(h: jnp.ndarray, num_partitions: int) -> jnp.ndarray:

@@ -1,0 +1,146 @@
+"""Pallas MXU grouped-aggregation kernel.
+
+The GroupByHash + accumulate hot loop (Trino
+main/operator/GroupByHash.java:30 probe + Aggregator.processPage,
+SURVEY.md §3.3) mapped onto the systolic array: per row tile, the
+transposed group-membership one-hot matrix is contracted against the
+byte-limb decomposition of the value columns on the MXU —
+
+    acc[L, C] += limbs(values_tile)[L, R] @ one_hot_T(gid_tile)[C, R]^T
+
+Exactness: int64 values are split into eight 8-bit limbs *inside the
+kernel* (from two int32 halves — no HBM blowup); a 256-row tile bounds
+every per-tile limb sum by 256*255 < 2^16, so the f32 MXU contraction
+is exact, and the int32 accumulator holds 2^15 tiles (8.4M rows) per
+call. XLA recombines limbs into int64 afterwards; two's-complement
+wraparound makes the limb sum equal the true int64 sum mod 2^64 —
+exactly SQL BIGINT arithmetic.
+
+Layout notes (the part that makes this TPU-native rather than a CUDA
+translation): all row-major (N, k) arrays with tiny k are poison under
+TPU (8, 128) tiling (the lane dim pads to 128 — measured 128x HBM
+expansion), so every input is transposed to (k, N) with rows as
+sublanes, and the group-id vector rides as an extra row of the lo-limb
+plane. Index-map constants must be np.int32: under jax x64 they trace
+as i64 and Mosaic fails to legalize the index-map signature.
+
+CPU/test path: pallas interpret mode computes the identical program.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Sequence
+
+import jax
+import jax.experimental.pallas as pl
+import jax.numpy as jnp
+import numpy as np
+
+ROW_TILE = 256
+MAX_CAPACITY = 2048
+# per-tile limb sums are < 2^16, so the int32 accumulator holds 2^15
+# tiles before it can wrap — callers must split or fall back past this
+MAX_ROWS = ROW_TILE << 15
+_I0 = np.int32(0)
+
+
+def _make_kernel(a8: int):
+    def kernel(lo_ref, hi_ref, out_ref):
+        @pl.when(pl.program_id(0) == 0)
+        def _():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        C = out_ref.shape[1]
+        R = lo_ref.shape[1]
+        gid = lo_ref[a8 - 1:a8, :]  # (1, R); dead rows carry >= C
+        onehot_t = (
+            jax.lax.broadcasted_iota(jnp.int32, (C, R), 0) == gid
+        ).astype(jnp.float32)  # (C, R)
+        planes = []
+        for src in (lo_ref[:], hi_ref[:]):
+            for j in range(4):
+                planes.append(
+                    ((src >> (8 * j)) & 0xFF).astype(jnp.float32)
+                )
+        limbs = jnp.concatenate(planes, axis=0)  # (8*a8, R)
+        contrib = jax.lax.dot_general(
+            limbs, onehot_t, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (8*a8, C)
+        out_ref[:] += contrib.astype(jnp.int32)
+
+    return kernel
+
+
+@partial(jax.jit, static_argnames=("capacity", "interpret"))
+def grouped_sum_mxu(
+    gid: jnp.ndarray,
+    values: Sequence[jnp.ndarray],
+    live: jnp.ndarray,
+    capacity: int,
+    interpret: bool = False,
+) -> List[jnp.ndarray]:
+    """Per-group int64 sums of each value column, with the live-row
+    count appended last. gid in [0, capacity) for live rows; dead or
+    masked rows are dropped."""
+    assert capacity <= MAX_CAPACITY, capacity
+    n = gid.shape[0]
+    assert n <= MAX_ROWS, (n, "int32 limb accumulator would overflow")
+    n_pad = -n % ROW_TILE
+    C = max(128, -(-capacity // 128) * 128)
+
+    gid = jnp.where(live, gid, capacity).astype(jnp.int32)
+    cols = [v.astype(jnp.int64) for v in values]
+    cols.append(jnp.ones(n, dtype=jnp.int64))  # count
+    a = len(cols)
+    a8 = -(-(a + 1) // 8) * 8  # + the gid row, padded to sublane tile
+
+    lo_rows, hi_rows = [], []
+    for v in cols:
+        if n_pad:
+            v = jnp.concatenate([v, jnp.zeros(n_pad, v.dtype)])
+        lo_rows.append(v.astype(jnp.int32))  # truncating wrap: low 32
+        hi_rows.append((v >> 32).astype(jnp.int32))
+    if n_pad:
+        gid = jnp.concatenate([gid, jnp.full(n_pad, capacity, jnp.int32)])
+    zero_row = jnp.zeros(n + n_pad, jnp.int32)
+    lo_rows.extend([zero_row] * (a8 - a - 1) + [gid])
+    hi_rows.extend([zero_row] * (a8 - a))
+    lo = jnp.stack(lo_rows, axis=0)  # (a8, N')
+    hi = jnp.stack(hi_rows, axis=0)
+
+    num_tiles = (n + n_pad) // ROW_TILE
+    out = pl.pallas_call(
+        _make_kernel(a8),
+        grid=(num_tiles,),
+        in_specs=[
+            pl.BlockSpec((a8, ROW_TILE), lambda i: (_I0, i)),
+            pl.BlockSpec((a8, ROW_TILE), lambda i: (_I0, i)),
+        ],
+        out_specs=pl.BlockSpec((8 * a8, C), lambda i: (_I0, _I0)),
+        out_shape=jax.ShapeDtypeStruct((8 * a8, C), jnp.int32),
+        interpret=interpret,
+    )(lo, hi)
+
+    # XLA epilogue: recombine limb-plane rows -> int64 per value
+    results = []
+    for k in range(a):
+        acc = jnp.zeros(C, dtype=jnp.int64)
+        for j in range(4):
+            acc = acc + (out[j * a8 + k].astype(jnp.int64) << (8 * j))
+            acc = acc + (
+                out[(4 + j) * a8 + k].astype(jnp.int64) << (32 + 8 * j)
+            )
+        results.append(acc[:capacity])
+    return results
+
+
+def grouped_sum_reference(gid, values, live, capacity):
+    """Scatter-based oracle with identical semantics."""
+    idx = jnp.where(live, gid, capacity)
+    outs = []
+    for v in list(values) + [jnp.ones(gid.shape[0], jnp.int64)]:
+        z = jnp.zeros(capacity + 1, dtype=jnp.int64)
+        outs.append(z.at[idx].add(v.astype(jnp.int64))[:capacity])
+    return outs

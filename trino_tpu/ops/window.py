@@ -23,6 +23,8 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from trino_tpu.ops.gather import take_clip
+
 
 def segment_starts(
     part_cols, part_valids, n: int
@@ -70,7 +72,7 @@ def rank(part_start: jnp.ndarray, peer_start: jnp.ndarray) -> jnp.ndarray:
 
 def dense_rank(part_start: jnp.ndarray, peer_start: jnp.ndarray) -> jnp.ndarray:
     groups = jnp.cumsum(peer_start.astype(jnp.int64))
-    at_seg_start = jnp.take(groups, _seg_start_index(part_start))
+    at_seg_start = take_clip(groups, _seg_start_index(part_start))
     return groups - at_seg_start + 1
 
 
@@ -78,7 +80,7 @@ def _running_sum(vals: jnp.ndarray, part_start: jnp.ndarray) -> jnp.ndarray:
     """Segmented inclusive cumulative sum."""
     cs = jnp.cumsum(vals)
     seg_start = _seg_start_index(part_start)
-    base = jnp.take(cs, seg_start) - jnp.take(vals, seg_start)
+    base = take_clip(cs, seg_start) - take_clip(vals, seg_start)
     return cs - base
 
 
@@ -123,11 +125,11 @@ def windowed_agg(
         return out_run, cnt_run
     if frame == "partition":
         end = _seg_end_index(part_start)
-        return jnp.take(out_run, end), jnp.take(cnt_run, end)
+        return take_clip(out_run, end), take_clip(cnt_run, end)
     # "range": value at the END of the current peer group
     assert peer_start is not None
     end = _peer_end_index(part_start, peer_start)
-    return jnp.take(out_run, end), jnp.take(cnt_run, end)
+    return take_clip(out_run, end), take_clip(cnt_run, end)
 
 
 def _peer_end_index(part_start: jnp.ndarray, peer_start: jnp.ndarray) -> jnp.ndarray:
@@ -151,9 +153,9 @@ def shift_in_partition(
     src = jnp.clip(idx - offset, 0, n - 1)
     seg = jnp.cumsum(part_start.astype(jnp.int32))
     ok = (idx - offset >= 0) & (idx - offset < n)
-    ok = ok & (jnp.take(seg, src) == seg)
-    out = jnp.take(vals, src)
-    out_valid = ok if valid is None else (ok & jnp.take(valid, src))
+    ok = ok & (take_clip(seg, src) == seg)
+    out = take_clip(vals, src)
+    out_valid = ok if valid is None else (ok & take_clip(valid, src))
     return out, out_valid
 
 
@@ -163,8 +165,8 @@ def value_at(
     index: jnp.ndarray,
 ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
     """first_value/last_value: gather at a per-row frame boundary index."""
-    out = jnp.take(vals, index)
-    return out, None if valid is None else jnp.take(valid, index)
+    out = take_clip(vals, index)
+    return out, None if valid is None else take_clip(valid, index)
 
 
 def first_value(vals, valid, part_start):

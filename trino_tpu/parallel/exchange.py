@@ -25,6 +25,7 @@ from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as PSpec
 
 from trino_tpu.ops import groupby as G
+from trino_tpu.ops.gather import take_clip
 from trino_tpu.ops.hashing import hash64
 
 
@@ -48,10 +49,10 @@ def partition_for_exchange(
     target = jnp.where(live, target, n_shards)  # dead rows go nowhere
     # stable order by destination; rank within destination = slot index
     order = jnp.argsort(target, stable=True)
-    sorted_target = jnp.take(target, order)
+    sorted_target = take_clip(target, order)
     idx = jnp.arange(sorted_target.shape[0], dtype=jnp.int32)
     dest_start = jnp.searchsorted(sorted_target, jnp.arange(n_shards, dtype=jnp.int32))
-    slot = idx - jnp.take(dest_start, jnp.clip(sorted_target, 0, n_shards - 1))
+    slot = idx - take_clip(dest_start, jnp.clip(sorted_target, 0, n_shards - 1))
     overflowed = jnp.any((slot >= block_rows) & (sorted_target < n_shards))
     flat = jnp.where(
         sorted_target < n_shards,
@@ -62,14 +63,14 @@ def partition_for_exchange(
 
     def scatter(col):
         z = jnp.zeros(n_shards * block_rows + 1, dtype=col.dtype)
-        return z.at[flat].set(jnp.take(col, order), mode="drop")[:-1].reshape(
+        return z.at[flat].set(take_clip(col, order), mode="drop")[:-1].reshape(
             n_shards, block_rows
         )
 
     live_blocks = (
         jnp.zeros(n_shards * block_rows + 1, dtype=jnp.bool_)
         .at[flat]
-        .set(jnp.take(live, order), mode="drop")[:-1]
+        .set(take_clip(live, order), mode="drop")[:-1]
         .reshape(n_shards, block_rows)
     )
     key_blocks = [scatter(k) for k in keys]
