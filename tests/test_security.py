@@ -1,0 +1,333 @@
+"""Security (authenticators + access control) and transactions —
+SURVEY.md §2.10 'Security' and 'Transactions' rows: the reference's
+main/server/security/ authenticators, the AccessControl SPI with
+file-based rules, and main/transaction/'s engine transaction manager
+coordinating connector handles."""
+
+import urllib.error
+import urllib.request
+
+import pytest
+
+from trino_tpu.connectors.memory import create_memory_connector
+from trino_tpu.connectors.spi import ColumnMetadata
+from trino_tpu.connectors.tpch import create_tpch_connector
+from trino_tpu.engine import LocalQueryRunner, Session
+from trino_tpu.security import (
+    AccessDeniedError,
+    AuthenticationError,
+    FileBasedAccessControl,
+    Identity,
+    InsecureAuthenticator,
+    JwtAuthenticator,
+    PasswordAuthenticator,
+)
+from trino_tpu import types as T
+
+
+def make_runner(access_control=None, user="alice"):
+    r = LocalQueryRunner(
+        Session(catalog="tpch", schema="tiny", user=user),
+        access_control=access_control,
+    )
+    r.register_catalog("tpch", create_tpch_connector())
+    return r
+
+
+class TestAccessControl:
+    RULES = [
+        {"user": "admin", "privileges": ["OWNERSHIP"]},
+        {"user": "alice", "table": "nation|region", "privileges": ["SELECT"]},
+        {"user": "bob", "privileges": ["SELECT", "INSERT"]},
+    ]
+
+    def test_allowed_select(self):
+        r = make_runner(FileBasedAccessControl(self.RULES), user="alice")
+        assert r.execute("SELECT count(*) FROM nation").only_value() == 25
+
+    def test_denied_table(self):
+        r = make_runner(FileBasedAccessControl(self.RULES), user="alice")
+        with pytest.raises(AccessDeniedError):
+            r.execute("SELECT count(*) FROM orders")
+
+    def test_denied_join_partner(self):
+        # every scanned table is checked, not just the first
+        r = make_runner(FileBasedAccessControl(self.RULES), user="alice")
+        with pytest.raises(AccessDeniedError):
+            r.execute(
+                "SELECT count(*) FROM nation, orders WHERE o_custkey = n_nationkey"
+            )
+
+    def test_no_rule_denies(self):
+        r = make_runner(FileBasedAccessControl(self.RULES), user="mallory")
+        with pytest.raises(AccessDeniedError):
+            r.execute("SELECT 1 FROM nation")
+
+    def test_plan_cache_rechecks(self):
+        """The same SQL must re-check on every execution even when the
+        plan is cached (a cached plan is not an authz grant)."""
+        rules = FileBasedAccessControl(self.RULES)
+        r = make_runner(rules, user="alice")
+        sql = "SELECT count(*) FROM nation"
+        assert r.execute(sql).only_value() == 25
+        r.session.user = "mallory"
+        with pytest.raises(AccessDeniedError):
+            r.execute(sql)
+
+    def test_ownership_gates_ddl(self):
+        rules = FileBasedAccessControl(self.RULES)
+        r = LocalQueryRunner(
+            Session(catalog="memory", schema="s", user="bob"),
+            access_control=rules,
+        )
+        r.register_catalog("memory", create_memory_connector())
+        with pytest.raises(AccessDeniedError):
+            r.execute("CREATE TABLE t (x bigint)")
+        r.session.user = "admin"
+        r.execute("CREATE TABLE t (x bigint)")
+        r.session.user = "bob"  # INSERT granted, DROP not
+        r.execute("INSERT INTO t VALUES (1)")
+        with pytest.raises(AccessDeniedError):
+            r.execute("DROP TABLE t")
+
+
+class TestAuthenticators:
+    def test_insecure_header(self):
+        ident = InsecureAuthenticator().authenticate({"X-Trino-User": "zoe"})
+        assert ident.user == "zoe"
+
+    def test_password_roundtrip(self):
+        import base64
+
+        auth = PasswordAuthenticator(
+            {"alice": PasswordAuthenticator.hash_password("secret")}
+        )
+        hdr = {
+            "Authorization": "Basic "
+            + base64.b64encode(b"alice:secret").decode()
+        }
+        assert auth.authenticate(hdr).user == "alice"
+        bad = {
+            "Authorization": "Basic "
+            + base64.b64encode(b"alice:wrong").decode()
+        }
+        with pytest.raises(AuthenticationError):
+            auth.authenticate(bad)
+
+    def test_jwt_roundtrip_and_tamper(self):
+        auth = JwtAuthenticator("sekrit")
+        token = auth.issue("carol")
+        assert (
+            auth.authenticate({"Authorization": f"Bearer {token}"}).user
+            == "carol"
+        )
+        tampered = token[:-2] + ("AA" if token[-2:] != "AA" else "BB")
+        with pytest.raises(AuthenticationError):
+            auth.authenticate({"Authorization": f"Bearer {tampered}"})
+        with pytest.raises(AuthenticationError):
+            JwtAuthenticator("other").authenticate(
+                {"Authorization": f"Bearer {token}"}
+            )
+
+    def test_jwt_expiry(self):
+        auth = JwtAuthenticator("sekrit")
+        token = auth.issue("carol", ttl_seconds=-10)
+        with pytest.raises(AuthenticationError):
+            auth.authenticate({"Authorization": f"Bearer {token}"})
+
+    def test_server_401(self):
+        from trino_tpu.runtime.server import CoordinatorServer
+
+        r = make_runner()
+        srv = CoordinatorServer(
+            r, authenticator=PasswordAuthenticator(
+                {"alice": PasswordAuthenticator.hash_password("pw")}
+            ),
+        )
+        try:
+            req = urllib.request.Request(
+                srv.uri + "/v1/statement", data=b"SELECT 1", method="POST"
+            )
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req, timeout=10)
+            assert exc.value.code == 401
+            import base64
+
+            req = urllib.request.Request(
+                srv.uri + "/v1/statement", data=b"SELECT 1", method="POST",
+                headers={
+                    "Authorization": "Basic "
+                    + base64.b64encode(b"alice:pw").decode()
+                },
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                assert resp.status == 200
+        finally:
+            srv.stop()
+
+
+class TestTransactions:
+    def _memory_runner(self):
+        r = LocalQueryRunner(Session(catalog="memory", schema="s", user="u"))
+        r.register_catalog("memory", create_memory_connector())
+        r.execute("CREATE TABLE t (x bigint)")
+        return r
+
+    def test_commit_publishes(self):
+        r = self._memory_runner()
+        r.execute("START TRANSACTION")
+        r.execute("INSERT INTO t VALUES (1), (2)")
+        # read-committed: pending writes not visible before commit
+        assert r.execute("SELECT count(*) FROM t").only_value() == 0
+        r.execute("COMMIT")
+        assert r.execute("SELECT count(*) FROM t").only_value() == 2
+
+    def test_rollback_discards(self):
+        r = self._memory_runner()
+        r.execute("START TRANSACTION")
+        r.execute("INSERT INTO t VALUES (1)")
+        r.execute("ROLLBACK")
+        assert r.execute("SELECT count(*) FROM t").only_value() == 0
+
+    def test_multi_statement_transaction(self):
+        r = self._memory_runner()
+        r.execute("START TRANSACTION")
+        r.execute("INSERT INTO t VALUES (1)")
+        r.execute("INSERT INTO t VALUES (2), (3)")
+        r.execute("COMMIT")
+        assert r.execute("SELECT count(*) FROM t").only_value() == 3
+
+    def test_autocommit_without_transaction(self):
+        r = self._memory_runner()
+        r.execute("INSERT INTO t VALUES (7)")
+        assert r.execute("SELECT count(*) FROM t").only_value() == 1
+
+    def test_nested_begin_rejected(self):
+        from trino_tpu.transaction import TransactionError
+
+        r = self._memory_runner()
+        r.execute("START TRANSACTION")
+        with pytest.raises(TransactionError):
+            r.execute("START TRANSACTION")
+
+    def test_start_transaction_modifiers_parse(self):
+        r = self._memory_runner()
+        r.execute("START TRANSACTION ISOLATION LEVEL SERIALIZABLE, READ WRITE")
+        r.execute("COMMIT")
+        r.execute("START TRANSACTION READ ONLY")
+        r.execute("ROLLBACK")
+
+
+class TestReviewRegressions:
+    def test_http_identity_drives_access_control(self):
+        """The HTTP-authenticated principal, not the runner's static
+        session user, decides access."""
+        import base64
+        import json
+        import time
+
+        from trino_tpu.runtime.server import CoordinatorServer
+
+        rules = FileBasedAccessControl(
+            [{"user": "alice", "privileges": ["SELECT"]}]
+        )
+        r = make_runner(rules, user="alice")  # static session user allowed
+        srv = CoordinatorServer(
+            r,
+            authenticator=PasswordAuthenticator({
+                "alice": PasswordAuthenticator.hash_password("a"),
+                "mallory": PasswordAuthenticator.hash_password("m"),
+            }),
+        )
+        try:
+            def run_as(user, pw):
+                hdr = {
+                    "Authorization": "Basic "
+                    + base64.b64encode(f"{user}:{pw}".encode()).decode()
+                }
+                req = urllib.request.Request(
+                    srv.uri + "/v1/statement",
+                    data=b"SELECT count(*) FROM nation",
+                    method="POST", headers=hdr,
+                )
+                resp = json.load(urllib.request.urlopen(req, timeout=60))
+                for _ in range(300):
+                    if resp["stats"]["state"] in ("FINISHED", "FAILED"):
+                        break
+                    nxt = urllib.request.Request(resp["nextUri"], headers=hdr)
+                    resp = json.load(urllib.request.urlopen(nxt, timeout=60))
+                    time.sleep(0.05)
+                return resp
+
+            ok = run_as("alice", "a")
+            assert ok["stats"]["state"] == "FINISHED", ok
+            denied = run_as("mallory", "m")
+            assert denied["stats"]["state"] == "FAILED"
+            assert "Access Denied" in denied["error"]["message"]
+        finally:
+            srv.stop()
+
+    def test_failed_commit_does_not_wedge_session(self):
+        from trino_tpu.transaction import TransactionError
+
+        r = LocalQueryRunner(Session(catalog="memory", schema="s", user="u"))
+        r.register_catalog("memory", create_memory_connector())
+        r.execute("CREATE TABLE t (x bigint)")
+        r.execute("START TRANSACTION")
+        r.execute("INSERT INTO t VALUES (1)")
+        r.execute("DROP TABLE t")  # makes the staged replay fail
+        with pytest.raises(TransactionError):
+            r.execute("COMMIT")
+        # the session is usable again: a new transaction can start
+        r.execute("START TRANSACTION")
+        r.execute("ROLLBACK")
+
+    def test_read_only_transaction_rejects_writes(self):
+        from trino_tpu.transaction import TransactionError
+
+        r = LocalQueryRunner(Session(catalog="memory", schema="s", user="u"))
+        r.register_catalog("memory", create_memory_connector())
+        r.execute("CREATE TABLE t (x bigint)")
+        r.execute("START TRANSACTION READ ONLY")
+        with pytest.raises(TransactionError):
+            r.execute("INSERT INTO t VALUES (1)")
+        r.execute("ROLLBACK")
+        assert r.execute("SELECT count(*) FROM t").only_value() == 0
+
+    def test_commit_outside_transaction_raises(self):
+        from trino_tpu.transaction import TransactionError
+
+        r = make_runner()
+        with pytest.raises(TransactionError):
+            r.execute("COMMIT")
+        with pytest.raises(TransactionError):
+            r.execute("ROLLBACK")
+
+    def test_isolation_level_two_word_forms(self):
+        r = LocalQueryRunner(Session(catalog="memory", schema="s", user="u"))
+        r.register_catalog("memory", create_memory_connector())
+        for level in (
+            "READ COMMITTED", "READ UNCOMMITTED", "REPEATABLE READ",
+            "SERIALIZABLE",
+        ):
+            r.execute(f"START TRANSACTION ISOLATION LEVEL {level}")
+            r.execute("ROLLBACK")
+
+    def test_unauthenticated_delete_rejected(self):
+        from trino_tpu.runtime.server import CoordinatorServer
+
+        r = make_runner()
+        srv = CoordinatorServer(
+            r, authenticator=PasswordAuthenticator(
+                {"alice": PasswordAuthenticator.hash_password("pw")}
+            ),
+        )
+        try:
+            req = urllib.request.Request(
+                srv.uri + "/v1/statement/executing/deadbeef", method="DELETE"
+            )
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(req, timeout=10)
+            assert exc.value.code == 401
+        finally:
+            srv.stop()

@@ -223,6 +223,44 @@ class MemoryPageSink(ConnectorPageSink):
         return self.rows
 
 
+class MemoryTransactionHandle:
+    """Buffers writes until commit (read-committed: in-transaction
+    scans do NOT see the transaction's own pending writes — a
+    documented simplification; the reference's memory connector has no
+    cross-statement write transactions at all)."""
+
+    def __init__(self, store: _Store):
+        self.store = store
+        self._pending: List[tuple] = []  # (handle, batch)
+
+    def stage(self, handle: TableHandle, batch: RelBatch) -> None:
+        self._pending.append((handle, batch))
+
+    def commit(self) -> None:
+        for handle, batch in self._pending:
+            MemoryPageSink(self.store, handle).append(batch)
+        self._pending.clear()
+
+    def rollback(self) -> None:
+        self._pending.clear()
+
+
+class _TransactionalMemorySink(ConnectorPageSink):
+    def __init__(self, txn: MemoryTransactionHandle, handle: TableHandle):
+        self.txn = txn
+        self.handle = handle
+        self.rows = 0
+
+    def append(self, batch: RelBatch) -> None:
+        self.txn.stage(self.handle, batch)
+        import jax
+
+        self.rows += int(jax.device_get(batch.live_mask()).sum())
+
+    def finish(self) -> int:
+        return self.rows  # publish happens at transaction commit
+
+
 class MemoryConnector(Connector):
     def __init__(self):
         store = _Store()
@@ -234,7 +272,12 @@ class MemoryConnector(Connector):
         )
         self.store = store
 
-    def page_sink(self, handle: TableHandle) -> ConnectorPageSink:
+    def begin_transaction(self, read_only: bool = False):
+        return MemoryTransactionHandle(self.store)
+
+    def page_sink(self, handle: TableHandle, transaction=None) -> ConnectorPageSink:
+        if isinstance(transaction, MemoryTransactionHandle):
+            return _TransactionalMemorySink(transaction, handle)
         return MemoryPageSink(self.store, handle)
 
     def load_table(

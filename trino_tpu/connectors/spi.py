@@ -147,8 +147,20 @@ class Connector:
         self.split_manager = split_manager
         self.page_source = page_source
 
-    def page_sink(self, handle: TableHandle) -> ConnectorPageSink:
+    def page_sink(self, handle: TableHandle, transaction=None) -> ConnectorPageSink:
+        """`transaction` is this connector's ConnectorTransactionHandle
+        (trino_tpu.transaction) when the write runs inside an explicit
+        transaction; connectors that support transactional writes buffer
+        until its commit. Autocommit (None) publishes at finish()."""
         raise NotImplementedError(f"connector {self.name} does not support writes")
+
+    def begin_transaction(self, read_only: bool = False):
+        """Optional: return a connector transaction handle
+        (spi/transaction/ConnectorTransactionHandle analogue). Default
+        is autocommit semantics."""
+        from trino_tpu.transaction import ConnectorTransactionHandle
+
+        return ConnectorTransactionHandle()
 
 
 class CatalogManager:

@@ -33,6 +33,7 @@ class Session:
 
     catalog: str = "tpch"
     schema: str = "tiny"
+    user: str = "user"
     batch_rows: int = 1 << 20
     target_splits: int = 1
     retry_policy: str = "none"
@@ -75,7 +76,14 @@ def _raise_deferred_checks(ctx: dict) -> None:
 
 
 class LocalQueryRunner:
-    def __init__(self, session: Optional[Session] = None):
+    def __init__(
+        self,
+        session: Optional[Session] = None,
+        access_control=None,
+    ):
+        from trino_tpu.security import AllowAllAccessControl, Identity
+        from trino_tpu.transaction import TransactionManager
+
         self.session = session or Session()
         self.catalogs = CatalogManager()
         # SQL text -> (OutputNode, PhysicalPlan): re-executing a cached
@@ -86,13 +94,81 @@ class LocalQueryRunner:
 
         self.event_listeners = EventListenerManager()
         self._query_seq = 0
+        self.access_control = access_control or AllowAllAccessControl()
+        self.transactions = TransactionManager(self.catalogs)
+        self._current_txn: Optional[str] = None
+        import threading as _threading
+
+        # per-request identity override (HTTP front passes the
+        # authenticated principal; the runner is shared across threads)
+        self._identity_override = _threading.local()
+
+    @property
+    def identity(self):
+        from trino_tpu.security import Identity
+
+        override = getattr(self._identity_override, "value", None)
+        return override or Identity(self.session.user)
+
+    def _check_scans(self, plan) -> None:
+        """AccessControl over every table the plan reads (the analyzer
+        already resolved views/CTEs away, so ScanNodes are the full
+        read set — StatementAnalyzer's table references)."""
+        from trino_tpu.sql.plan import ScanNode
+
+        def walk(node):
+            if isinstance(node, ScanNode):
+                h = node.handle
+                self.access_control.check_can_select(
+                    self.identity, h.catalog, h.schema, h.table,
+                    node.columns,
+                )
+            for c in node.children():
+                walk(c)
+
+        walk(plan)
 
     def register_catalog(self, name: str, connector: Connector) -> None:
         self.catalogs.register(name, connector)
 
     # -- entry point --
-    def execute(self, sql: str) -> MaterializedResult:
+    def execute(self, sql: str, identity=None) -> MaterializedResult:
+        """`identity` overrides the session user for this statement —
+        the HTTP front passes the authenticated principal here."""
+        if identity is not None:
+            self._identity_override.value = identity
+            try:
+                return self.execute(sql)
+            finally:
+                self._identity_override.value = None
+        from trino_tpu.transaction import TransactionError
+
         stmt = parse(sql)
+        self.access_control.check_can_execute_query(self.identity)
+        if isinstance(stmt, ast.StartTransaction):
+            if self._current_txn is not None:
+                raise TransactionError("transaction already in progress")
+            self._current_txn = self.transactions.begin(stmt.read_only)
+            return MaterializedResult([[True]], ["result"], [T.BOOLEAN])
+        if isinstance(stmt, ast.Commit):
+            if self._current_txn is None:
+                raise TransactionError("NOT_IN_TRANSACTION: no transaction in progress")
+            try:
+                self.transactions.commit(self._current_txn)
+            finally:
+                # a failed commit still ends the transaction (the
+                # reference's semantics) — never wedge the session
+                self._current_txn = None
+                self._invalidate_plans()
+            return MaterializedResult([[True]], ["result"], [T.BOOLEAN])
+        if isinstance(stmt, ast.Rollback):
+            if self._current_txn is None:
+                raise TransactionError("NOT_IN_TRANSACTION: no transaction in progress")
+            try:
+                self.transactions.rollback(self._current_txn)
+            finally:
+                self._current_txn = None
+            return MaterializedResult([[True]], ["result"], [T.BOOLEAN])
         if isinstance(stmt, ast.Query):
             return self._run_tracked(sql, stmt)
         if isinstance(stmt, ast.ExplainStatement):
@@ -107,10 +183,14 @@ class LocalQueryRunner:
             from trino_tpu.sql.analyzer import resolve_type
 
             conn, schema, table = self._resolve_target(stmt.table)
+            self.access_control.check_can_create_table(
+                self.identity, conn.name, schema, table
+            )
             cols = [
                 ColumnMetadata(n, resolve_type(t)) for n, t in stmt.columns
             ]
             conn.metadata.create_table(schema, table, cols)
+            self._invalidate_plans()
             return MaterializedResult([[True]], ["result"], [T.BOOLEAN])
         if isinstance(stmt, ast.CreateTableAs):
             return self._execute_ctas(stmt)
@@ -118,12 +198,19 @@ class LocalQueryRunner:
             return self._execute_insert(stmt.table, stmt.columns, stmt.query)
         if isinstance(stmt, ast.DropTable):
             conn, schema, table = self._resolve_target(stmt.table)
+            self.access_control.check_can_drop_table(
+                self.identity, conn.name, schema, table
+            )
             handle = conn.metadata.get_table_handle(schema, table)
             if handle is None:
                 raise AnalysisError(f"table {schema}.{table} does not exist")
             conn.metadata.drop_table(handle)
+            self._invalidate_plans()
             return MaterializedResult([[True]], ["result"], [T.BOOLEAN])
         if isinstance(stmt, ast.SetSession):
+            self.access_control.check_can_set_session_property(
+                self.identity, stmt.name
+            )
             # plan-shaping properties are part of the plan-cache key, so
             # no explicit invalidation is needed
             self.session.set_property(stmt.name, stmt.value)
@@ -177,6 +264,12 @@ class LocalQueryRunner:
         analyzer = Analyzer(self.catalogs, self.session.catalog, self.session.schema)
         return analyzer.plan(q)
 
+    def _invalidate_plans(self) -> None:
+        """Cached physical plans capture split lists (data snapshots) at
+        plan time, so any write/DDL invalidates them — the analogue of
+        the reference re-planning every query against current metadata."""
+        self._plan_cache.clear()
+
     # -- DML (BeginTableWrite/TableWriter/TableFinish path) --
     def _resolve_target(self, parts):
         cat, schema = self.session.catalog, self.session.schema
@@ -191,7 +284,11 @@ class LocalQueryRunner:
         from trino_tpu.connectors.spi import ColumnMetadata
 
         output = self._analyze(stmt.query)
+        self._check_scans(output)
         conn, schema, table = self._resolve_target(stmt.table)
+        self.access_control.check_can_create_table(
+            self.identity, conn.name, schema, table
+        )
         cols = [
             ColumnMetadata(n or f"_col{i}", f.type)
             for i, (n, f) in enumerate(zip(output.names, output.fields))
@@ -201,7 +298,11 @@ class LocalQueryRunner:
 
     def _execute_insert(self, parts, columns, query: ast.Query) -> MaterializedResult:
         conn, schema, table = self._resolve_target(parts)
+        self.access_control.check_can_insert(
+            self.identity, conn.name, schema, table
+        )
         output = self._analyze(query)
+        self._check_scans(output)
         return self._write_into(
             conn, schema, table, output,
             list(columns) if columns else None,
@@ -252,12 +353,26 @@ class LocalQueryRunner:
         physical = planner.plan(node)
         ctx = self._execution_ctx()
         pipelines, chain = physical.instantiate(ctx)
-        writer = TableWriterOperator(conn.page_sink(handle))
+        txn_handle = None
+        if self._current_txn is not None:
+            from trino_tpu.transaction import TransactionError
+
+            if self.transactions.is_read_only(self._current_txn):
+                raise TransactionError(
+                    "READ_ONLY_VIOLATION: cannot write in a read-only transaction"
+                )
+            txn_handle = self.transactions.join(
+                self._current_txn, conn.name, conn
+            )
+        writer = TableWriterOperator(
+            conn.page_sink(handle, transaction=txn_handle)
+        )
         chain.append(writer)
         for p in pipelines:
             Driver(p).run()
         Driver(Pipeline(chain)).run()
         _raise_deferred_checks(ctx)
+        self._invalidate_plans()
         return MaterializedResult([[writer.rows_written]], ["rows"], [T.BIGINT])
 
     def _run_tracked(self, sql: str, stmt: ast.Query) -> MaterializedResult:
@@ -311,9 +426,12 @@ class LocalQueryRunner:
             )
         cached = self._plan_cache.get(cache_key) if cache_key else None
         if cached is not None:
+            # access control re-checks on every execution, cached or not
+            self._check_scans(cached[0])
             return cached
         with TRACER.span("analyze"):
             output = self._analyze(q)
+        self._check_scans(output)
         with TRACER.span("plan"):
             planner = LocalPlanner(
                 self.catalogs,

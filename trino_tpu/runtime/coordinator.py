@@ -167,7 +167,9 @@ class DistributedQueryRunner:
         self.catalogs.register(name, connector)
 
     # -- entry point --
-    def execute(self, sql: str) -> MaterializedResult:
+    def execute(self, sql: str, identity=None) -> MaterializedResult:
+        # identity is accepted for HTTP-front API parity; per-statement
+        # access control currently runs in the in-process runner only
         stmt = parse(sql)
         if isinstance(stmt, ast.ExplainStatement):
             output = self._analyze(stmt.query)

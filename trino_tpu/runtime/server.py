@@ -57,9 +57,13 @@ class CoordinatorServer:
         port: int = 0,
         max_concurrent: int = 4,
         resource_groups=None,  # runtime.resource_groups.ResourceGroupManager
+        authenticator=None,  # security.Authenticator; None = insecure
     ):
+        from trino_tpu.security import AuthenticationError, InsecureAuthenticator
+
         self.runner = runner
         self.resource_groups = resource_groups
+        self.authenticator = authenticator or InsecureAuthenticator()
         self._jobs: Dict[str, _QueryJob] = {}
         self._pool = ThreadPoolExecutor(max_workers=max_concurrent)
         outer = self
@@ -78,17 +82,43 @@ class CoordinatorServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _auth(self):
+                """Authenticate or answer 401 (the reference's
+                authenticator filter chain, main/server/security/)."""
+                try:
+                    return outer.authenticator.authenticate(self.headers)
+                except AuthenticationError as ex:
+                    # drain the request body first: HTTP/1.1 keep-alive
+                    # would otherwise parse the unread body bytes as
+                    # the connection's next request line
+                    ln = int(self.headers.get("Content-Length", "0") or 0)
+                    if ln:
+                        self.rfile.read(ln)
+                    body = json.dumps({"error": f"Unauthorized: {ex}"}).encode()
+                    self.send_response(401)
+                    self.send_header("WWW-Authenticate", "Basic, Bearer")
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return None
+
             def do_POST(self):
+                identity = self._auth()
+                if identity is None:
+                    return
                 parts = [p for p in self.path.split("/") if p]
                 if parts == ["v1", "statement"]:
                     ln = int(self.headers.get("Content-Length", "0"))
                     sql = self.rfile.read(ln).decode("utf-8")
-                    job = outer._submit(sql)
+                    job = outer._submit(sql, identity)
                     self._json(200, outer._response(job, 0))
                     return
                 self._json(404, {"error": "no route"})
 
             def do_GET(self):
+                if self._auth() is None:
+                    return
                 parts = [p for p in self.path.split("/") if p]
                 if (
                     len(parts) == 5
@@ -103,6 +133,8 @@ class CoordinatorServer:
                 self._json(404, {"error": "no route"})
 
             def do_DELETE(self):
+                if self._auth() is None:
+                    return
                 parts = [p for p in self.path.split("/") if p]
                 if (
                     len(parts) == 4
@@ -121,7 +153,7 @@ class CoordinatorServer:
         )
         self._thread.start()
 
-    def _submit(self, sql: str) -> _QueryJob:
+    def _submit(self, sql: str, identity=None) -> _QueryJob:
         job = _QueryJob(uuid.uuid4().hex[:16], sql)
         self._jobs[job.query_id] = job
 
@@ -132,7 +164,7 @@ class CoordinatorServer:
                     # admission queueing (resource-group submit path)
                     lease = self.resource_groups.acquire()
                 job.state = "running"
-                result = self.runner.execute(sql)
+                result = self.runner.execute(sql, identity=identity)
                 with job.lock:
                     job.columns = [
                         {"name": n, "type": str(t)}

@@ -345,6 +345,25 @@ class SetSession(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class StartTransaction(Node):
+    """START TRANSACTION [READ ONLY | READ WRITE] (isolation modes are
+    accepted and ignored — the reference's connectors mostly run
+    read-committed-at-best anyway)."""
+
+    read_only: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Commit(Node):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class Rollback(Node):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
 class ShowSession(Node):
     pass
 

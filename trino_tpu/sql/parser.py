@@ -158,7 +158,39 @@ class Parser:
 
     # -- entry --
     def parse_statement(self) -> ast.Node:
-        if self.at_kw("EXPLAIN"):
+        if self.at_kw("START"):
+            self.next()
+            self.expect_kw("TRANSACTION")
+            read_only = False
+            # modifiers: ISOLATION LEVEL <words>, READ ONLY / READ WRITE
+            while True:
+                if self.accept_kw("ISOLATION"):
+                    self.expect_kw("LEVEL")
+                    # READ UNCOMMITTED|COMMITTED / REPEATABLE READ /
+                    # SERIALIZABLE — two-word forms consume both words
+                    first = self._parse_name()
+                    if first in ("read", "repeatable"):
+                        self._parse_name()
+                    self.accept_op(",")
+                    continue
+                if self.accept_kw("READ"):
+                    if self.accept_kw("ONLY"):
+                        read_only = True
+                    else:
+                        self.expect_kw("WRITE")
+                    self.accept_op(",")
+                    continue
+                break
+            stmt: ast.Node = ast.StartTransaction(read_only)
+        elif self.at_kw("COMMIT"):
+            self.next()
+            self.accept_kw("WORK")
+            stmt = ast.Commit()
+        elif self.at_kw("ROLLBACK"):
+            self.next()
+            self.accept_kw("WORK")
+            stmt = ast.Rollback()
+        elif self.at_kw("EXPLAIN"):
             self.next()
             analyze = self.accept_kw("ANALYZE")
             stmt: ast.Node = ast.ExplainStatement(self.parse_query(), analyze)
