@@ -1,0 +1,245 @@
+"""Client & service tier: DB-API driver, web UI / stats REST, proxy,
+verifier (SURVEY.md §2.11: trino-jdbc, Web UI, trino-proxy,
+trino-verifier)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from trino_tpu import dbapi
+from trino_tpu.connectors.memory import create_memory_connector
+from trino_tpu.connectors.tpch import create_tpch_connector
+from trino_tpu.engine import LocalQueryRunner, Session
+from trino_tpu.runtime.server import CoordinatorServer
+
+
+@pytest.fixture(scope="module")
+def server():
+    r = LocalQueryRunner(Session(catalog="tpch", schema="tiny"))
+    r.register_catalog("tpch", create_tpch_connector())
+    srv = CoordinatorServer(r)
+    yield srv
+    srv.stop()
+
+
+class TestDbapi:
+    def test_basic_query(self, server):
+        conn = dbapi.connect(server.uri, user="tester")
+        cur = conn.cursor()
+        cur.execute("SELECT n_nationkey, n_name FROM nation ORDER BY n_nationkey")
+        assert cur.rowcount == 25
+        assert [d[0] for d in cur.description] == ["n_nationkey", "n_name"]
+        first = cur.fetchone()
+        assert first == [0, "ALGERIA"]
+        rest = cur.fetchall()
+        assert len(rest) == 24
+        assert cur.fetchone() is None
+
+    def test_qmark_binding(self, server):
+        conn = dbapi.connect(server.uri)
+        cur = conn.cursor()
+        cur.execute(
+            "SELECT n_name FROM nation WHERE n_nationkey = ? AND n_name <> ?",
+            (3, "it's"),
+        )
+        assert cur.fetchall() == [["CANADA"]]
+
+    def test_qmark_skips_string_literals(self, server):
+        cur = dbapi.connect(server.uri).cursor()
+        cur.execute("SELECT 'a?b', ?", (7,))
+        assert cur.fetchall() == [["a?b", 7]]
+
+    def test_param_types(self, server):
+        import datetime
+
+        cur = dbapi.connect(server.uri).cursor()
+        cur.execute(
+            "SELECT ?, ?, ?, ?",
+            (1.5, True, None, datetime.date(1995, 3, 15)),
+        )
+        row = cur.fetchall()[0]
+        assert row[0] == 1.5 and row[1] is True and row[2] is None
+
+    def test_error_surfaces(self, server):
+        cur = dbapi.connect(server.uri).cursor()
+        with pytest.raises(dbapi.DatabaseError):
+            cur.execute("SELECT * FROM no_such_table")
+
+    def test_iteration_and_fetchmany(self, server):
+        cur = dbapi.connect(server.uri).cursor()
+        cur.execute("SELECT r_name FROM region ORDER BY r_name")
+        assert len(cur.fetchmany(2)) == 2
+        assert len(list(cur)) == 3
+
+    def test_transactions_via_dbapi(self):
+        r = LocalQueryRunner(Session(catalog="memory", schema="s"))
+        r.register_catalog("memory", create_memory_connector())
+        srv = CoordinatorServer(r)
+        try:
+            dbapi.connect(srv.uri).cursor().execute("CREATE TABLE t (x bigint)")
+            conn = dbapi.connect(srv.uri, autocommit=False)
+            cur = conn.cursor()
+            cur.execute("INSERT INTO t VALUES (1)")
+            conn.rollback()
+            cur2 = dbapi.connect(srv.uri).cursor()
+            cur2.execute("SELECT count(*) FROM t")
+            assert cur2.fetchall() == [[0]]
+            cur.execute("INSERT INTO t VALUES (2)")
+            conn.commit()
+            cur2.execute("SELECT count(*) FROM t")
+            assert cur2.fetchall() == [[1]]
+        finally:
+            srv.stop()
+
+
+class TestUiAndStats:
+    def test_cluster_stats_and_query_list(self, server):
+        dbapi.connect(server.uri).cursor().execute("SELECT 1")
+        stats = json.load(
+            urllib.request.urlopen(server.uri + "/v1/cluster", timeout=10)
+        )
+        assert stats["total_queries"] >= 1
+        queries = json.load(
+            urllib.request.urlopen(server.uri + "/v1/query", timeout=10)
+        )
+        assert any(q["sql"] == "SELECT 1" for q in queries)
+
+    def test_ui_page(self, server):
+        html = urllib.request.urlopen(server.uri + "/ui", timeout=10).read()
+        assert b"trino-tpu coordinator" in html
+
+
+class TestProxy:
+    def test_round_robin_and_sticky_polling(self, server):
+        from trino_tpu.service.proxy import ProxyServer
+
+        proxy = ProxyServer([server.uri, server.uri])
+        try:
+            cur = dbapi.connect(proxy.uri).cursor()
+            cur.execute("SELECT count(*) FROM lineitem")
+            assert cur.fetchall() == [[60064]]
+            # UI stats route through too
+            stats = json.load(
+                urllib.request.urlopen(proxy.uri + "/v1/cluster", timeout=10)
+            )
+            assert stats["total_queries"] >= 1
+        finally:
+            proxy.stop()
+
+
+class TestVerifier:
+    def test_match_and_mismatch(self, server):
+        from trino_tpu.client import Client
+        from trino_tpu.service.verifier import (
+            Verifier, client_target, runner_target,
+        )
+
+        control = LocalQueryRunner(Session(catalog="tpch", schema="tiny"))
+        control.register_catalog("tpch", create_tpch_connector())
+        v = Verifier(
+            runner_target(control), client_target(Client(server.uri))
+        )
+        results = v.verify_suite(
+            {
+                "counts": "SELECT n_regionkey, count(*) FROM nation GROUP BY n_regionkey",
+                "ordered": "SELECT r_name FROM region ORDER BY r_name",
+            }
+        )
+        assert all(r.status == "match" for r in results), results
+
+        # a genuinely different answer must be flagged
+        lying = Verifier(
+            runner_target(control),
+            lambda sql: [[999]],
+        )
+        r = lying.verify("x", "SELECT count(*) FROM region")
+        assert r.status == "mismatch" and r.detail
+
+    def test_error_classification(self):
+        from trino_tpu.service.verifier import Verifier
+
+        v = Verifier(lambda sql: [[1]], lambda sql: 1 / 0)
+        assert v.verify("e", "SELECT 1").status == "test_error"
+        v2 = Verifier(lambda sql: 1 / 0, lambda sql: [[1]])
+        assert v2.verify("e", "SELECT 1").status == "control_error"
+
+
+class TestReviewRegressions:
+    def test_cross_connection_transaction_isolation(self):
+        """Two HTTP connections must not share transaction state (the
+        protocol threads X-Trino-Transaction-Id per connection)."""
+        r = LocalQueryRunner(Session(catalog="memory", schema="s"))
+        r.register_catalog("memory", create_memory_connector())
+        srv = CoordinatorServer(r)
+        try:
+            dbapi.connect(srv.uri).cursor().execute("CREATE TABLE t (x bigint)")
+            a = dbapi.connect(srv.uri, autocommit=False)
+            b = dbapi.connect(srv.uri)  # autocommit
+            a.cursor().execute("INSERT INTO t VALUES (1)")  # staged in A's txn
+            b.cursor().execute("INSERT INTO t VALUES (2)")  # autocommit NOW
+            check = dbapi.connect(srv.uri).cursor()
+            check.execute("SELECT count(*) FROM t")
+            assert check.fetchall() == [[1]]  # only B's row is visible
+            a.rollback()
+            check.execute("SELECT count(*) FROM t")
+            assert check.fetchall() == [[1]]  # A's row discarded, B's kept
+        finally:
+            srv.stop()
+
+    def test_dbapi_question_mark_in_comment(self, server):
+        cur = dbapi.connect(server.uri).cursor()
+        cur.execute("SELECT ? -- really?\n", (5,))
+        assert cur.fetchall() == [[5]]
+        cur.execute("SELECT ? /* hm? */", (6,))
+        assert cur.fetchall() == [[6]]
+
+    def test_proxy_preserves_content_type(self, server):
+        from trino_tpu.service.proxy import ProxyServer
+
+        proxy = ProxyServer([server.uri])
+        try:
+            resp = urllib.request.urlopen(proxy.uri + "/ui", timeout=10)
+            assert "text/html" in resp.headers.get("Content-Type", "")
+            assert b"trino-tpu coordinator" in resp.read()
+        finally:
+            proxy.stop()
+
+    def test_verifier_subquery_order_by_not_ordered(self):
+        from trino_tpu.service.verifier import _has_top_level_order_by
+
+        assert _has_top_level_order_by("SELECT a FROM t ORDER BY a")
+        assert not _has_top_level_order_by(
+            "SELECT count(*) FROM (SELECT x FROM t ORDER BY x LIMIT 3) q"
+        )
+
+    def test_read_only_blocks_ddl(self):
+        from trino_tpu.transaction import TransactionError
+
+        r = LocalQueryRunner(Session(catalog="memory", schema="s"))
+        r.register_catalog("memory", create_memory_connector())
+        r.execute("START TRANSACTION READ ONLY")
+        import pytest as _pytest
+
+        with _pytest.raises(TransactionError):
+            r.execute("CREATE TABLE nope (x bigint)")
+        with _pytest.raises(TransactionError):
+            r.execute("CREATE TABLE nope AS SELECT 1")
+        r.execute("ROLLBACK")
+        # neither DDL left anything behind
+        assert r.execute("SHOW TABLES").rows == []
+
+    def test_distributed_runner_transactions(self):
+        from trino_tpu.runtime.coordinator import DistributedQueryRunner
+
+        d = DistributedQueryRunner(
+            Session(catalog="memory", schema="s"), n_workers=2
+        )
+        d.register_catalog("memory", create_memory_connector())
+        d.execute("CREATE TABLE t (x bigint)")
+        d.execute("START TRANSACTION")
+        d.execute("INSERT INTO t VALUES (1)")
+        d.execute("ROLLBACK")
+        assert d.execute("SELECT count(*) FROM t").only_value() == 0
+        d.execute("INSERT INTO t VALUES (2)")
+        assert d.execute("SELECT count(*) FROM t").only_value() == 1

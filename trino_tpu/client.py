@@ -29,13 +29,22 @@ class ClientResult:
 
 
 class Client:
-    def __init__(self, uri: str, timeout: float = 60.0, poll_interval: float = 0.05):
+    def __init__(self, uri: str, timeout: float = 60.0,
+                 poll_interval: float = 0.05, headers: Optional[dict] = None):
         self.uri = uri.rstrip("/")
         self.timeout = timeout
         self.poll_interval = poll_interval
+        self.headers = dict(headers or {})
+        # this connection's transaction (X-Trino-Transaction-Id model:
+        # the client carries the id; the server holds no session state)
+        self.transaction_id: Optional[str] = None
 
     def _request(self, method: str, url: str, body: Optional[bytes] = None) -> dict:
-        req = urllib.request.Request(url, data=body, method=method)
+        headers = dict(self.headers)
+        headers["X-Trino-Transaction-Id"] = self.transaction_id or "NONE"
+        req = urllib.request.Request(
+            url, data=body, method=method, headers=headers
+        )
         with urllib.request.urlopen(req, timeout=self.timeout) as r:
             return json.loads(r.read())
 
@@ -53,6 +62,10 @@ class Client:
                 raise QueryError(out["error"].get("message", "query failed"))
             if out.get("columns"):
                 columns = out["columns"]
+            if out.get("startedTransactionId"):
+                self.transaction_id = out["startedTransactionId"]
+            if out.get("clearedTransactionId"):
+                self.transaction_id = None
             rows.extend(out.get("data", ()))
             next_uri = out.get("nextUri")
             if next_uri is None:

@@ -61,6 +61,10 @@ class MaterializedResult:
     rows: List[list]
     column_names: List[str]
     column_types: List[T.DataType]
+    # transaction protocol surface (StatementClientV1's
+    # X-Trino-Started-Transaction-Id / Clear-Transaction-Id headers)
+    started_transaction_id: Optional[str] = None
+    cleared_transaction: bool = False
 
     def only_value(self):
         assert len(self.rows) == 1 and len(self.rows[0]) == 1, self.rows
@@ -102,6 +106,8 @@ class LocalQueryRunner:
         # per-request identity override (HTTP front passes the
         # authenticated principal; the runner is shared across threads)
         self._identity_override = _threading.local()
+        # per-statement active transaction (explicit protocol threading)
+        self._stmt_txn = _threading.local()
 
     @property
     def identity(self):
@@ -132,43 +138,88 @@ class LocalQueryRunner:
         self.catalogs.register(name, connector)
 
     # -- entry point --
-    def execute(self, sql: str, identity=None) -> MaterializedResult:
-        """`identity` overrides the session user for this statement —
-        the HTTP front passes the authenticated principal here."""
+    def execute(
+        self, sql: str, identity=None, transaction_id: Optional[str] = None
+    ) -> MaterializedResult:
+        """`identity` overrides the session user for this statement (the
+        HTTP front passes the authenticated principal).
+
+        `transaction_id` selects EXPLICIT transaction threading — the
+        protocol model, where each client connection carries its own
+        transaction id (X-Trino-Transaction-Id) and the shared runner
+        holds no cross-client state. Pass the sentinel "NONE" for an
+        autocommit statement in explicit mode. When None (embedded
+        use), the runner's own session transaction applies."""
+        stmt = parse(sql)
+        explicit = transaction_id is not None
+        active = (
+            None if transaction_id in (None, "NONE") else transaction_id
+        )
+        if not explicit:
+            active = self._current_txn
         if identity is not None:
             self._identity_override.value = identity
-            try:
-                return self.execute(sql)
-            finally:
+        self._stmt_txn.value = active
+        try:
+            return self._dispatch(stmt, sql, active, explicit)
+        finally:
+            self._stmt_txn.value = None
+            if identity is not None:
                 self._identity_override.value = None
+
+    def _active_txn(self) -> Optional[str]:
+        return getattr(self._stmt_txn, "value", None)
+
+    def _check_writable(self) -> None:
+        txn = self._active_txn()
+        if txn is not None and self.transactions.is_read_only(txn):
+            from trino_tpu.transaction import TransactionError
+
+            raise TransactionError(
+                "READ_ONLY_VIOLATION: cannot write in a read-only transaction"
+            )
+
+    def _dispatch(
+        self, stmt, sql: str, active: Optional[str], explicit: bool
+    ) -> MaterializedResult:
         from trino_tpu.transaction import TransactionError
 
-        stmt = parse(sql)
         self.access_control.check_can_execute_query(self.identity)
         if isinstance(stmt, ast.StartTransaction):
-            if self._current_txn is not None:
+            if active is not None:
                 raise TransactionError("transaction already in progress")
-            self._current_txn = self.transactions.begin(stmt.read_only)
-            return MaterializedResult([[True]], ["result"], [T.BOOLEAN])
+            new_txn = self.transactions.begin(stmt.read_only)
+            if not explicit:
+                self._current_txn = new_txn
+            return MaterializedResult(
+                [[True]], ["result"], [T.BOOLEAN],
+                started_transaction_id=new_txn,
+            )
         if isinstance(stmt, ast.Commit):
-            if self._current_txn is None:
+            if active is None:
                 raise TransactionError("NOT_IN_TRANSACTION: no transaction in progress")
             try:
-                self.transactions.commit(self._current_txn)
+                self.transactions.commit(active)
             finally:
                 # a failed commit still ends the transaction (the
                 # reference's semantics) — never wedge the session
-                self._current_txn = None
+                if not explicit:
+                    self._current_txn = None
                 self._invalidate_plans()
-            return MaterializedResult([[True]], ["result"], [T.BOOLEAN])
+            return MaterializedResult(
+                [[True]], ["result"], [T.BOOLEAN], cleared_transaction=True
+            )
         if isinstance(stmt, ast.Rollback):
-            if self._current_txn is None:
+            if active is None:
                 raise TransactionError("NOT_IN_TRANSACTION: no transaction in progress")
             try:
-                self.transactions.rollback(self._current_txn)
+                self.transactions.rollback(active)
             finally:
-                self._current_txn = None
-            return MaterializedResult([[True]], ["result"], [T.BOOLEAN])
+                if not explicit:
+                    self._current_txn = None
+            return MaterializedResult(
+                [[True]], ["result"], [T.BOOLEAN], cleared_transaction=True
+            )
         if isinstance(stmt, ast.Query):
             return self._run_tracked(sql, stmt)
         if isinstance(stmt, ast.ExplainStatement):
@@ -186,6 +237,7 @@ class LocalQueryRunner:
             self.access_control.check_can_create_table(
                 self.identity, conn.name, schema, table
             )
+            self._check_writable()
             cols = [
                 ColumnMetadata(n, resolve_type(t)) for n, t in stmt.columns
             ]
@@ -201,6 +253,7 @@ class LocalQueryRunner:
             self.access_control.check_can_drop_table(
                 self.identity, conn.name, schema, table
             )
+            self._check_writable()
             handle = conn.metadata.get_table_handle(schema, table)
             if handle is None:
                 raise AnalysisError(f"table {schema}.{table} does not exist")
@@ -289,6 +342,7 @@ class LocalQueryRunner:
         self.access_control.check_can_create_table(
             self.identity, conn.name, schema, table
         )
+        self._check_writable()  # before the table is created
         cols = [
             ColumnMetadata(n or f"_col{i}", f.type)
             for i, (n, f) in enumerate(zip(output.names, output.fields))
@@ -353,17 +407,13 @@ class LocalQueryRunner:
         physical = planner.plan(node)
         ctx = self._execution_ctx()
         pipelines, chain = physical.instantiate(ctx)
-        txn_handle = None
-        if self._current_txn is not None:
-            from trino_tpu.transaction import TransactionError
-
-            if self.transactions.is_read_only(self._current_txn):
-                raise TransactionError(
-                    "READ_ONLY_VIOLATION: cannot write in a read-only transaction"
-                )
-            txn_handle = self.transactions.join(
-                self._current_txn, conn.name, conn
-            )
+        self._check_writable()
+        active = self._active_txn()
+        txn_handle = (
+            self.transactions.join(active, conn.name, conn)
+            if active is not None
+            else None
+        )
         writer = TableWriterOperator(
             conn.page_sink(handle, transaction=txn_handle)
         )

@@ -166,8 +166,19 @@ class DistributedQueryRunner:
     def register_catalog(self, name: str, connector: Connector) -> None:
         self.catalogs.register(name, connector)
 
+    def _embedded_runner(self):
+        if getattr(self, "_embedded", None) is None:
+            from trino_tpu.engine import LocalQueryRunner
+
+            lqr = LocalQueryRunner(self.session)
+            lqr.catalogs = self.catalogs
+            self._embedded = lqr
+        return self._embedded
+
     # -- entry point --
-    def execute(self, sql: str, identity=None) -> MaterializedResult:
+    def execute(
+        self, sql: str, identity=None, transaction_id=None
+    ) -> MaterializedResult:
         # identity is accepted for HTTP-front API parity; per-statement
         # access control currently runs in the in-process runner only
         stmt = parse(sql)
@@ -178,12 +189,14 @@ class DistributedQueryRunner:
                 [[explain_distributed(subplan)]], ["Query Plan"], [T.VARCHAR]
             )
         if not isinstance(stmt, ast.Query):
-            # metadata statements take the single-node path
-            from trino_tpu.engine import LocalQueryRunner
-
-            lqr = LocalQueryRunner(self.session)
-            lqr.catalogs = self.catalogs
-            return lqr.execute(sql)
+            # metadata/DML/transaction statements take the single-node
+            # path — through ONE persistent embedded runner, so
+            # transaction state survives across statements (a throwaway
+            # runner per statement would silently autocommit)
+            return self._embedded_runner().execute(
+                sql, identity=identity,
+                transaction_id=transaction_id,
+            )
         output = self._analyze(stmt)
         subplan = plan_distributed(
             output,

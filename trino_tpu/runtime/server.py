@@ -25,6 +25,45 @@ from typing import Dict, List, Optional
 
 ROWS_PER_PAGE = 4096
 
+# Minimal coordinator dashboard (the reference ships a React SPA under
+# main/server/ui/ + webapp assets; this is the same information surface
+# — cluster stats + query list — as one self-contained page).
+_UI_HTML = """<!doctype html>
+<html><head><title>trino-tpu</title>
+<style>
+ body { font-family: system-ui, sans-serif; margin: 2rem; }
+ h1 { font-size: 1.3rem; } .stats span { margin-right: 2rem; }
+ table { border-collapse: collapse; margin-top: 1rem; width: 100%; }
+ td, th { border: 1px solid #ccc; padding: 4px 8px; font-size: 0.85rem;
+          text-align: left; }
+ .finished { color: #2a7d2a; } .failed { color: #b22; }
+ .running, .queued { color: #b80; }
+</style></head>
+<body>
+<h1>trino-tpu coordinator</h1>
+<div class="stats" id="stats">loading…</div>
+<table><thead><tr><th>query id</th><th>state</th><th>rows</th>
+<th>sql</th></tr></thead><tbody id="queries"></tbody></table>
+<script>
+async function tick() {
+  try {
+    const s = await (await fetch('/v1/cluster')).json();
+    document.getElementById('stats').innerHTML =
+      `<span>queries: ${s.total_queries}</span>` +
+      `<span>running: ${s.running_queries}</span>` +
+      `<span>finished: ${s.finished_queries}</span>` +
+      `<span>failed: ${s.failed_queries}</span>`;
+    const q = await (await fetch('/v1/query')).json();
+    document.getElementById('queries').innerHTML = q.map(j =>
+      `<tr><td>${j.id}</td><td class="${j.state}">${j.state}</td>` +
+      `<td>${j.rows}</td><td><code>${j.sql.replace(/</g,'&lt;')}</code></td></tr>`
+    ).join('');
+  } catch (e) { /* server gone */ }
+}
+tick(); setInterval(tick, 2000);
+</script></body></html>
+"""
+
 
 class _QueryJob:
     def __init__(self, query_id: str, sql: str):
@@ -34,6 +73,8 @@ class _QueryJob:
         self.rows: List[list] = []
         self.columns: List[dict] = []
         self.error: Optional[str] = None
+        self.started_transaction_id: Optional[str] = None
+        self.cleared_transaction = False
         self.lock = threading.Lock()
 
     def snapshot(self, token: int):
@@ -111,7 +152,11 @@ class CoordinatorServer:
                 if parts == ["v1", "statement"]:
                     ln = int(self.headers.get("Content-Length", "0"))
                     sql = self.rfile.read(ln).decode("utf-8")
-                    job = outer._submit(sql, identity)
+                    # per-connection transaction threading: the client
+                    # carries its transaction id on every request
+                    # (StatementClientV1's X-Trino-Transaction-Id)
+                    txn = self.headers.get("X-Trino-Transaction-Id", "NONE")
+                    job = outer._submit(sql, identity, txn)
                     self._json(200, outer._response(job, 0))
                     return
                 self._json(404, {"error": "no route"})
@@ -129,6 +174,25 @@ class CoordinatorServer:
                         self._json(404, {"error": "unknown query"})
                         return
                     self._json(200, outer._response(job, int(parts[4])))
+                    return
+                # observability REST surface (QueryResource /
+                # ClusterStatsResource analogues) + the web UI page
+                if parts == ["v1", "cluster"]:
+                    self._json(200, outer.cluster_stats())
+                    return
+                if parts == ["v1", "query"]:
+                    self._json(200, outer.query_list())
+                    return
+                if len(parts) == 2 and parts[0] == "v1" and parts[1] == "info":
+                    self._json(200, {"starting": False, "uptime": "n/a"})
+                    return
+                if parts == ["ui"] or parts == []:
+                    body = _UI_HTML.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/html; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                     return
                 self._json(404, {"error": "no route"})
 
@@ -153,7 +217,33 @@ class CoordinatorServer:
         )
         self._thread.start()
 
-    def _submit(self, sql: str, identity=None) -> _QueryJob:
+    def cluster_stats(self) -> dict:
+        """ClusterStatsResource analogue."""
+        states = [j.state for j in list(self._jobs.values())]
+        return {
+            "total_queries": len(states),
+            "running_queries": sum(1 for s in states if s in ("queued", "running")),
+            "finished_queries": sum(1 for s in states if s == "finished"),
+            "failed_queries": sum(1 for s in states if s == "failed"),
+        }
+
+    def query_list(self) -> list:
+        """QueryResource GET /v1/query analogue."""
+        out = []
+        for job in list(self._jobs.values()):
+            with job.lock:
+                out.append(
+                    {
+                        "id": job.query_id,
+                        "state": job.state,
+                        "rows": len(job.rows),
+                        "sql": job.sql[:200],
+                        "error": job.error,
+                    }
+                )
+        return out
+
+    def _submit(self, sql: str, identity=None, transaction_id="NONE") -> _QueryJob:
         job = _QueryJob(uuid.uuid4().hex[:16], sql)
         self._jobs[job.query_id] = job
 
@@ -164,13 +254,21 @@ class CoordinatorServer:
                     # admission queueing (resource-group submit path)
                     lease = self.resource_groups.acquire()
                 job.state = "running"
-                result = self.runner.execute(sql, identity=identity)
+                result = self.runner.execute(
+                    sql, identity=identity, transaction_id=transaction_id
+                )
                 with job.lock:
                     job.columns = [
                         {"name": n, "type": str(t)}
                         for n, t in zip(result.column_names, result.column_types)
                     ]
                     job.rows = result.rows
+                    job.started_transaction_id = getattr(
+                        result, "started_transaction_id", None
+                    )
+                    job.cleared_transaction = getattr(
+                        result, "cleared_transaction", False
+                    )
                     job.state = "finished"
             except Exception as e:
                 with job.lock:
@@ -196,6 +294,10 @@ class CoordinatorServer:
             out["nextUri"] = f"{self.uri}/v1/statement/executing/{job.query_id}/{token}"
             return out
         out["columns"] = columns
+        if job.started_transaction_id:
+            out["startedTransactionId"] = job.started_transaction_id
+        if job.cleared_transaction:
+            out["clearedTransactionId"] = True
         if data:
             out["data"] = data
         next_token = token + len(data)

@@ -92,6 +92,7 @@ class TransactionManager:
                 handle.commit()
             except Exception as ex:  # noqa: BLE001 - aggregate and rethrow
                 errors.append(f"{catalog}: {ex}")
+        self._prune(transaction_id)
         if errors:
             raise TransactionError("commit failed: " + "; ".join(errors))
 
@@ -100,6 +101,13 @@ class TransactionManager:
         tx.completed = True
         for handle in tx.handles.values():
             handle.rollback()
+        self._prune(transaction_id)
+
+    def _prune(self, transaction_id: str) -> None:
+        """Completed transactions leave the registry immediately — a
+        long-lived coordinator must not accumulate them."""
+        with self._lock:
+            self._transactions.pop(transaction_id, None)
 
     def is_active(self, transaction_id: str) -> bool:
         tx = self._transactions.get(transaction_id)
