@@ -159,3 +159,97 @@ def test_tpch_queries_parse(qid):
     q = parse_query(QUERIES[qid])
     assert isinstance(q, ast.Query)
     assert isinstance(q.body, ast.QuerySpec)
+
+
+class TestUnnestAndArrays:
+    """UNNEST + constant arrays (main/operator/unnest/ surface;
+    SURVEY.md §2.6 'Set ops / misc' row)."""
+
+    @staticmethod
+    def _runner():
+        from trino_tpu.connectors.tpch import create_tpch_connector
+        from trino_tpu.engine import LocalQueryRunner, Session
+
+        r = LocalQueryRunner(Session(catalog="tpch", schema="tiny"))
+        r.register_catalog("tpch", create_tpch_connector())
+        return r
+
+    def test_basic_unnest(self):
+        r = self._runner()
+        assert r.execute(
+            "SELECT * FROM UNNEST(ARRAY[1, 2, 3]) AS t(x)"
+        ).rows == [[1], [2], [3]]
+
+    def test_multi_array_zip_with_ordinality(self):
+        r = self._runner()
+        rows = r.execute(
+            "SELECT x, y, o FROM UNNEST(ARRAY['a','b'], ARRAY[10,20,30])"
+            " WITH ORDINALITY AS t(x, y, o)"
+        ).rows
+        assert rows == [["a", 10, 1], ["b", 20, 2], [None, 30, 3]]
+
+    def test_sequence(self):
+        r = self._runner()
+        assert r.execute(
+            "SELECT sum(x) FROM UNNEST(sequence(1, 100)) AS t(x)"
+        ).only_value() == 5050
+        assert r.execute(
+            "SELECT count(*) FROM UNNEST(sequence(10, 1, -3)) AS t(x)"
+        ).only_value() == 4
+
+    def test_unnest_join(self):
+        r = self._runner()
+        rows = r.execute(
+            "SELECT n_name FROM nation, UNNEST(ARRAY[0, 5]) AS u(k)"
+            " WHERE n_nationkey = k ORDER BY n_name"
+        ).rows
+        assert rows == [["ALGERIA"], ["ETHIOPIA"]]
+
+    def test_array_functions(self):
+        r = self._runner()
+        row = r.execute(
+            "SELECT cardinality(ARRAY[1,2,3]), element_at(ARRAY[5,6], -1),"
+            " element_at(ARRAY[5,6], 9), contains(ARRAY[1,2], 2),"
+            " contains(ARRAY[1,NULL], 9), array_join(ARRAY[1,2,3], '-'),"
+            " array_max(ARRAY[4,9,2]), array_min(ARRAY[4,9,2]),"
+            " cardinality(sequence(1, 10))"
+        ).rows[0]
+        assert row == [3, 6, None, True, None, "1-2-3", 9, 2, 10]
+
+    def test_empty_array(self):
+        r = self._runner()
+        assert r.execute(
+            "SELECT count(*) FROM UNNEST(ARRAY[]) AS t(x)"
+        ).only_value() == 0
+        assert r.execute("SELECT cardinality(ARRAY[])").only_value() == 0
+
+    def test_array_column_rejected_cleanly(self):
+        from trino_tpu.sql.analyzer import AnalysisError
+
+        r = self._runner()
+        import pytest as _pytest
+
+        with _pytest.raises(AnalysisError):
+            r.execute("SELECT * FROM nation, UNNEST(n_name) AS u(x)")
+        with _pytest.raises(AnalysisError):
+            r.execute("SELECT cardinality(n_name) FROM nation")
+
+    def test_array_review_regressions(self):
+        from trino_tpu.sql.analyzer import AnalysisError
+
+        r = self._runner()
+        import pytest as _pytest
+
+        # NULL probe -> NULL (three-valued logic)
+        assert r.execute("SELECT contains(ARRAY[1,2], NULL)").only_value() is None
+        # incompatible element types fail at analysis, not execution
+        with _pytest.raises(AnalysisError):
+            r.execute("SELECT * FROM UNNEST(ARRAY[1, 'a']) AS t(x)")
+        with _pytest.raises(AnalysisError):
+            r.execute("SELECT array_max(ARRAY[1, 'a'])")
+        # boolean vs integer is a type mismatch, not a python equality
+        with _pytest.raises(AnalysisError):
+            r.execute("SELECT contains(ARRAY[0], false)")
+        # step sign contradicting direction is an error, not empty
+        with _pytest.raises(AnalysisError):
+            r.execute("SELECT * FROM UNNEST(sequence(1, 100, -3)) AS t(x)")

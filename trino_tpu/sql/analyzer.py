@@ -354,6 +354,22 @@ class ExprConverter:
             raise AnalysisError(
                 f"aggregate function {name}() in a non-aggregate context"
             )
+        # constant-array functions fold at analysis time (arrays exist
+        # only as constants — see _plan_unnest)
+        if name in ("cardinality", "element_at", "contains", "array_max",
+                    "array_min", "array_join"):
+            arr = (
+                _const_array_values(e.args[0]) if e.args else None
+            )
+            if arr is None:
+                raise AnalysisError(
+                    f"{name}() supports constant arrays only"
+                )
+            return self._fold_array_call(name, arr, e.args[1:])
+        if name == "sequence":
+            raise AnalysisError(
+                "sequence() is usable inside UNNEST or array functions"
+            )
         args = tuple(self.convert(a) for a in e.args)
         if name in ("substr", "substring"):
             return ir.Call("substr", args, T.VARCHAR)
@@ -473,6 +489,80 @@ class ExprConverter:
                 raise AnalysisError("typeof() takes one argument")
             return ir.Literal(str(args[0].type), T.VARCHAR)
         raise AnalysisError(f"unknown function {name}()")
+
+    def _fold_array_call(
+        self, name: str, arr: List[ir.Literal], rest: tuple
+    ) -> ir.Expr:
+        elem_t = _array_element_type(arr)  # raises on mixed types
+        if name == "cardinality":
+            return ir.Literal(len(arr), T.BIGINT)
+        if name == "element_at":
+            idx = _const_fold(self.convert(rest[0])) if rest else None
+            if idx is None or idx.value is None:
+                raise AnalysisError("element_at() index must be constant")
+            i = int(idx.value)
+            # 1-based; negative counts from the end; OOB -> NULL
+            pos = i - 1 if i > 0 else len(arr) + i
+            if i == 0:
+                raise AnalysisError("element_at() index cannot be 0")
+            if 0 <= pos < len(arr):
+                return arr[pos]
+            return ir.Literal(None, elem_t)
+        if name == "contains":
+            probe = _const_fold(self.convert(rest[0])) if rest else None
+            if probe is None:
+                raise AnalysisError("contains() value must be constant")
+            if probe.value is None:
+                return ir.Literal(None, T.BOOLEAN)  # NULL probe -> NULL
+            if (
+                probe.type.kind != T.TypeKind.UNKNOWN
+                and arr
+                and T.common_super_type(elem_t, probe.type) is None
+            ):
+                raise AnalysisError(
+                    f"contains(): cannot compare {elem_t} with {probe.type}"
+                )
+            # avoid python bool==int conflation: compare type kinds too
+            def same(a, b):
+                return a == b and isinstance(a, bool) == isinstance(b, bool)
+
+            if any(
+                l.value is not None and same(l.value, probe.value)
+                for l in arr
+            ):
+                return ir.Literal(True, T.BOOLEAN)
+            # NULL element makes a non-match indeterminate (SQL IN)
+            if any(l.value is None for l in arr):
+                return ir.Literal(None, T.BOOLEAN)
+            return ir.Literal(False, T.BOOLEAN)
+        if name in ("array_max", "array_min"):
+            vals = [l.value for l in arr if l.value is not None]
+            if not vals or len(vals) != len(arr):  # Trino: NULL if any NULL
+                return ir.Literal(None, elem_t)
+            return ir.Literal(
+                max(vals) if name == "array_max" else min(vals), elem_t
+            )
+        if name == "array_join":
+            sep = _const_fold(self.convert(rest[0])) if rest else None
+            if sep is None or sep.value is None:
+                raise AnalysisError("array_join() delimiter must be constant")
+            null_repl = None
+            if len(rest) > 1:
+                nr = _const_fold(self.convert(rest[1]))
+                null_repl = nr.value if nr else None
+            parts = []
+            for l in arr:
+                if l.value is None:
+                    if null_repl is not None:
+                        parts.append(str(null_repl))
+                else:
+                    v = l.value
+                    parts.append(
+                        ("true" if v else "false")
+                        if isinstance(v, bool) else str(v)
+                    )
+            return ir.Literal(str(sep.value).join(parts), T.VARCHAR)
+        raise AnalysisError(f"unknown array function {name}")
 
 
 # ---------------------------------------------------------------------------
@@ -599,6 +689,62 @@ def resolve_type(t: ast.TypeName) -> T.DataType:
     if t.name in ("varchar", "char"):
         return T.VARCHAR
     raise AnalysisError(f"unsupported type {t.name}")
+
+
+def _array_element_type(arr: List[ir.Literal]) -> T.DataType:
+    """Unified element type; mixed incompatible elements fail loudly at
+    analysis time (ARRAY[1, 'a'] must not crash at execution)."""
+    t: Optional[T.DataType] = None
+    for lit in arr:
+        if lit.type.kind == T.TypeKind.UNKNOWN:
+            continue
+        if t is None:
+            t = lit.type
+            continue
+        u = T.common_super_type(t, lit.type)
+        if u is None:
+            raise AnalysisError(
+                f"array elements have incompatible types {t} and {lit.type}"
+            )
+        t = u
+    return t or T.BIGINT
+
+
+def _const_array_values(e: ast.Expression) -> Optional[List[ir.Literal]]:
+    """Fold a constant array expression (ARRAY[...] of foldable cells,
+    or sequence(lo, hi[, step]) with literal bounds) to its elements."""
+    conv = ExprConverter(Scope([]))
+    if isinstance(e, ast.ArrayLiteral):
+        out = []
+        for cell in e.elements:
+            lit = _const_fold(conv.convert(cell))
+            if lit is None:
+                return None
+            out.append(lit)
+        return out
+    if isinstance(e, ast.FunctionCall) and e.name == "sequence":
+        args = [_const_fold(conv.convert(a)) for a in e.args]
+        if any(a is None or a.value is None for a in args):
+            return None
+        if len(args) == 2:
+            lo, hi, step = int(args[0].value), int(args[1].value), 1
+        elif len(args) == 3:
+            lo, hi, step = (int(a.value) for a in args)
+        else:
+            raise AnalysisError("sequence() takes 2 or 3 arguments")
+        if step == 0:
+            raise AnalysisError("sequence() step must not be zero")
+        if (hi - lo) * step < 0:
+            raise AnalysisError(
+                "sequence() step sign contradicts the start/stop direction"
+            )
+        if abs((hi - lo) // step) > 1_000_000:
+            raise AnalysisError("sequence() result too large")
+        stop = hi + (1 if step > 0 else -1)
+        return [
+            ir.Literal(v, T.BIGINT) for v in range(lo, stop, step)
+        ]
+    return None
 
 
 def _const_fold(x: ir.Expr) -> Optional[ir.Literal]:
@@ -1175,6 +1321,8 @@ class Analyzer:
                 )
                 return RelationItem(node, sc, 1000.0)
             return self._plan_table(rel)
+        if isinstance(rel, ast.UnnestRelation):
+            return self._plan_unnest(rel)
         if isinstance(rel, ast.SubqueryRelation):
             node, scope, names = self.plan_query(rel.query, ctes)
             if rel.column_aliases:
@@ -1189,6 +1337,50 @@ class Analyzer:
             )
             return RelationItem(node, sc, 1000.0)
         raise AnalysisError(f"unsupported relation {type(rel).__name__}")
+
+    def _plan_unnest(self, rel: ast.UnnestRelation) -> RelationItem:
+        """UNNEST over constant arrays (ARRAY[...] literals and
+        sequence(...)) — the UnnestOperator's surface
+        (main/operator/unnest/UnnestOperator.java) for the array values
+        this engine can hold; array-typed COLUMNS need the nested
+        column representation (offsets + flat values), planned work.
+        Multiple arrays zip positionally, short ones padded with NULL
+        (Trino's multi-argument UNNEST semantics)."""
+        columns = []
+        for e in rel.arrays:
+            vals = _const_array_values(e)
+            if vals is None:
+                raise AnalysisError(
+                    "UNNEST supports constant arrays (ARRAY[...] /"
+                    " sequence(...)); array-typed columns are not yet"
+                    " representable"
+                )
+            columns.append(vals)
+        n = max((len(c) for c in columns), default=0)
+        col_types = [_array_element_type(c) for c in columns]
+        rows = []
+        for i in range(n):
+            row = [
+                (c[i].value if i < len(c) else None) for c in columns
+            ]
+            if rel.ordinality:
+                row.append(i + 1)
+            rows.append(tuple(row))
+        if rel.ordinality:
+            col_types.append(T.BIGINT)
+        names = list(rel.column_aliases) if rel.column_aliases else [
+            f"_col{i}" for i in range(len(col_types))
+        ]
+        if len(names) != len(col_types):
+            raise AnalysisError(
+                f"UNNEST alias has {len(names)} columns, produces {len(col_types)}"
+            )
+        fields = tuple(P.Field(nm, t) for nm, t in zip(names, col_types))
+        node = P.ValuesNode(fields, tuple(rows))
+        scope = Scope(
+            [ScopeField(rel.alias, nm, t) for nm, t in zip(names, col_types)]
+        )
+        return RelationItem(node, scope, float(max(n, 1)))
 
     def _plan_table(self, rel: ast.TableRef) -> RelationItem:
         parts = rel.name

@@ -315,6 +315,24 @@ class ValuesBody(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class ArrayLiteral(Expression):
+    """ARRAY[e1, e2, ...]."""
+
+    elements: Tuple[Expression, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class UnnestRelation(Relation):
+    """UNNEST(a1, a2, ...) [WITH ORDINALITY] [AS t(c1, ...)] — zips the
+    arrays into rows (UnnestOperator analogue, main/operator/unnest/)."""
+
+    arrays: Tuple[Expression, ...]
+    ordinality: bool = False
+    alias: Optional[str] = None
+    column_aliases: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
 class CreateTable(Node):
     table: Tuple[str, ...]
     columns: Tuple[Tuple[str, TypeName], ...]

@@ -513,6 +513,23 @@ class Parser:
                 raise self.error("expected ON or USING after JOIN")
 
     def _parse_table_primary(self) -> ast.Relation:
+        if (
+            self.at_kw("UNNEST")
+            and self.peek(1).kind == "op"
+            and self.peek(1).text == "("
+        ):
+            self.next()
+            self.expect_op("(")
+            arrays = [self.parse_expr()]
+            while self.accept_op(","):
+                arrays.append(self.parse_expr())
+            self.expect_op(")")
+            ordinality = False
+            if self.accept_kw("WITH"):
+                self.expect_kw("ORDINALITY")
+                ordinality = True
+            alias, cols = self._parse_opt_alias_with_columns()
+            return ast.UnnestRelation(tuple(arrays), ordinality, alias, cols)
         if self.accept_op("("):
             # subquery (incl. inline VALUES) or parenthesized join
             if self.at_kw("SELECT", "WITH", "VALUES"):
@@ -731,6 +748,16 @@ class Parser:
                 q = self.parse_query()
                 self.expect_op(")")
                 return ast.Exists(q)
+            if u == "ARRAY" and self.peek(1).kind == "op" and self.peek(1).text == "[":
+                self.next()
+                self.expect_op("[")
+                elements: List[ast.Expression] = []
+                if not self.at_op("]"):
+                    elements.append(self.parse_expr())
+                    while self.accept_op(","):
+                        elements.append(self.parse_expr())
+                self.expect_op("]")
+                return ast.ArrayLiteral(tuple(elements))
             if u == "EXTRACT" and self.peek(1).kind == "op" and self.peek(1).text == "(":
                 self.next()
                 self.expect_op("(")
