@@ -171,3 +171,134 @@ def test_composite_shares_primitives(runner):
     ]
     for g, w in zip(got, want):
         assert g == pytest.approx(w, rel=1e-9)
+
+
+class TestHolisticAggregates:
+    """min_by / max_by / approx_percentile — order-statistic aggregates
+    on the collect path (exec/operators._finish_holistic; the planner
+    forces single-step, SURVEY.md §2.6 aggregation functions)."""
+
+    def test_min_max_by_global(self, runner):
+        rows = runner.execute(
+            "SELECT max_by(n_name, n_nationkey), min_by(n_name, n_nationkey)"
+            " FROM nation"
+        ).rows
+        data = runner.execute("SELECT n_name, n_nationkey FROM nation").rows
+        assert rows[0][0] == max(data, key=lambda r: r[1])[0]
+        assert rows[0][1] == min(data, key=lambda r: r[1])[0]
+
+    def test_min_max_by_grouped_oracle(self, runner):
+        rows = runner.execute(
+            "SELECT l_returnflag, max_by(l_orderkey, l_extendedprice),"
+            " min_by(l_orderkey, l_extendedprice)"
+            " FROM lineitem GROUP BY l_returnflag ORDER BY l_returnflag"
+        ).rows
+        data = runner.execute(
+            "SELECT l_returnflag, l_orderkey, l_extendedprice FROM lineitem"
+        ).rows
+        by_flag = {}
+        for f, ok, price in data:
+            by_flag.setdefault(f, []).append((ok, price))
+        for flag, got_max, got_min in rows:
+            prices = by_flag[flag]
+            best = max(p for _, p in prices)
+            worst = min(p for _, p in prices)
+            assert got_max in [ok for ok, p in prices if p == best]
+            assert got_min in [ok for ok, p in prices if p == worst]
+
+    def test_min_by_ignores_null_ordering_rows(self, runner):
+        got = runner.execute(
+            "SELECT max_by(x, y) FROM (VALUES (1, 10), (2, NULL), (3, 5)) t(x, y)"
+        ).only_value()
+        assert got == 1
+        # all-NULL ordering column -> NULL
+        assert runner.execute(
+            "SELECT max_by(x, y) FROM (VALUES (1, NULL)) t(x, y)"
+        ).only_value() is None
+
+    def test_approx_percentile_oracle(self, runner):
+        import numpy as np
+
+        qs = np.array(
+            [v[0] for v in runner.execute("SELECT l_quantity FROM lineitem").rows],
+            dtype=float,
+        )
+        for p in (0.0, 0.25, 0.5, 0.9, 1.0):
+            got = runner.execute(
+                f"SELECT approx_percentile(l_quantity, {p}) FROM lineitem"
+            ).only_value()
+            want = float(np.sort(qs)[int(np.floor(p * (len(qs) - 1) + 0.5))])
+            assert got == want, (p, got, want)
+
+    def test_approx_percentile_grouped(self, runner):
+        import numpy as np
+
+        rows = runner.execute(
+            "SELECT l_linestatus, approx_percentile(l_extendedprice, 0.5)"
+            " FROM lineitem GROUP BY l_linestatus ORDER BY l_linestatus"
+        ).rows
+        data = runner.execute(
+            "SELECT l_linestatus, l_extendedprice FROM lineitem"
+        ).rows
+        groups = {}
+        for s, p in data:
+            groups.setdefault(s, []).append(float(p))
+        for status, got in rows:
+            xs = np.sort(np.array(groups[status]))
+            want = float(xs[int(np.floor(0.5 * (len(xs) - 1) + 0.5))])
+            assert got == pytest.approx(want), status
+
+    def test_mixed_with_regular_aggregates(self, runner):
+        rows = runner.execute(
+            "SELECT n_regionkey, count(*), max_by(n_name, n_nationkey),"
+            " sum(n_nationkey) FROM nation GROUP BY n_regionkey"
+            " ORDER BY n_regionkey"
+        ).rows
+        data = runner.execute(
+            "SELECT n_regionkey, n_nationkey, n_name FROM nation"
+        ).rows
+        by_rk = {}
+        for rk, nk, nm in data:
+            by_rk.setdefault(rk, []).append((nk, nm))
+        for rk, cnt, mb, s in rows:
+            assert cnt == len(by_rk[rk])
+            assert s == sum(nk for nk, _ in by_rk[rk])
+            assert mb == max(by_rk[rk])[1]
+
+    def test_string_dictionary_preserved_through_min_max(self, runner):
+        # regression: single-step min/max over a string column must keep
+        # its dictionary (previously rendered raw codes)
+        assert runner.execute(
+            "SELECT min(n_name), max(n_name) FROM nation"
+        ).rows == [["ALGERIA", "VIETNAM"]]
+
+    def test_empty_input_semantics(self, runner):
+        rows = runner.execute(
+            "SELECT max_by(n_name, n_nationkey), approx_percentile(n_nationkey, 0.5),"
+            " count(*) FROM nation WHERE n_nationkey < 0"
+        ).rows
+        assert rows == [[None, None, 0]]
+
+    def test_distributed_forces_single_step(self):
+        from trino_tpu.connectors.tpch import create_tpch_connector
+        from trino_tpu.runtime.coordinator import DistributedQueryRunner
+        from trino_tpu.engine import Session
+
+        d = DistributedQueryRunner(
+            Session(catalog="tpch", schema="tiny"), n_workers=2
+        )
+        d.register_catalog("tpch", create_tpch_connector())
+        rows = d.execute(
+            "SELECT l_returnflag, approx_percentile(l_quantity, 0.5),"
+            " max_by(l_orderkey, l_extendedprice)"
+            " FROM lineitem GROUP BY l_returnflag ORDER BY l_returnflag"
+        ).rows
+        assert len(rows) == 3 and all(r[1] is not None for r in rows)
+
+    def test_zero_batches_global(self, runner):
+        # truly-empty input (LIMIT 0: no batches reach the operator)
+        rows = runner.execute(
+            "SELECT max_by(x, y), approx_percentile(y, 0.5), count(*) FROM"
+            " (SELECT n_nationkey x, n_regionkey y FROM nation LIMIT 0) t"
+        ).rows
+        assert rows == [[None, None, 0]]

@@ -643,14 +643,21 @@ class WindowOperator(Operator):
 
 @dataclasses.dataclass(frozen=True)
 class AggSpec:
-    """One aggregate: kind in {sum,count,count_star,avg,min,max,any},
-    arg_channel indexes the operator's input (None for count_star),
-    out_type is the SQL result type."""
+    """One aggregate: kind in {sum,count,count_star,avg,min,max,any} or
+    the holistic kinds {min_by,max_by,approx_percentile} (which need the
+    raw rows, not mergeable accumulators — the planner forces them
+    single-step); arg_channel indexes the operator's input (None for
+    count_star), out_type is the SQL result type."""
 
     kind: str
     arg_channel: Optional[int]
     out_type: T.DataType
     distinct: bool = False
+    arg2_channel: Optional[int] = None
+    percentile: Optional[float] = None
+
+
+HOLISTIC_KINDS = ("min_by", "max_by", "approx_percentile")
 
 
 def minmax_neutral(dtype, kind: str):
@@ -988,12 +995,19 @@ class HashAggregationOperator(Operator):
         self._memory = memory_context
         self._spiller = None
         self._in_finish = False
+        # holistic aggregates (min_by/max_by/approx_percentile) need the
+        # raw rows: collect batches, reduce once at finish (the planner
+        # guarantees step == "single"); no spill, no partial wire format
+        self._holistic = any(a.kind in HOLISTIC_KINDS for a in self._aggs)
+        if self._holistic:
+            assert step == "single", "holistic aggregates run single-step"
+        self._collected: List[RelBatch] = []
         # revocation runs on the RESERVING thread (MemoryPool.reserve
         # calls the victim's callback), so every state mutation and the
         # revoke itself serialize on this lock; accounting calls happen
         # OUTSIDE it to keep lock ordering acyclic across operators
         self._state_lock = _threading.Lock()
-        if self._memory is not None and not self._global:
+        if self._memory is not None and not self._global and not self._holistic:
             self._memory.set_revoker(self._revoke_memory)
         self._arg_meta = [
             input_schema[a.arg_channel] if a.arg_channel is not None else (None, None)
@@ -1027,7 +1041,7 @@ class HashAggregationOperator(Operator):
             and bound <= 64
             and self._group_channels
             and all(
-                _BATCH_REDUCER[a.kind] in ("sum", "count", "min", "max")
+                _BATCH_REDUCER.get(a.kind) in ("sum", "count", "min", "max")
                 for a in self._aggs
             )
             else None
@@ -1048,7 +1062,7 @@ class HashAggregationOperator(Operator):
             and bound <= 2048
             and self._group_channels
             and all(
-                _BATCH_REDUCER[a.kind] in ("sum", "count")
+                _BATCH_REDUCER.get(a.kind) in ("sum", "count")
                 and _int_kind(a)
                 for a in self._aggs
             )
@@ -1083,6 +1097,22 @@ class HashAggregationOperator(Operator):
         return live, values, vvalids, tuple(reds)
 
     def add_input(self, batch: RelBatch) -> None:
+        if self._holistic:
+            if self._pre is not None:
+                batch = self._pre(batch)
+            self._collected.append(batch)
+            if self._memory is not None:
+                # the collect path buffers raw rows: account them so the
+                # pool sees the pressure (not revocable — no sketch to
+                # spill; oversized holistic inputs fail loudly instead)
+                total = 0
+                for b in self._collected:
+                    for c in b.columns:
+                        total += c.data.size * c.data.dtype.itemsize
+                        if c.valid is not None:
+                            total += c.valid.size
+                self._memory.set_bytes(total)
+            return
         if self._step == "final":
             if self._pre is not None:
                 batch = self._pre(batch)
@@ -1225,6 +1255,105 @@ class HashAggregationOperator(Operator):
             return
         self._out = self._partial_state_batch()
 
+    # -- holistic (collect) path: min_by/max_by/approx_percentile --
+    def _finish_holistic(self) -> RelBatch:
+        """One pass over ALL collected rows: regular aggregates via
+        sort_group_reduce, order statistics via grouped_argbest /
+        grouped_percentile — all three sort by the same key chain, so
+        their group slots align (ops/groupby._segment_bounds)."""
+        if self._collected:
+            mega = concat_batches(self._collected)
+        else:
+            mega = None
+        if mega is None or mega.live_mask().shape[0] == 0:
+            # zero rows collected: one all-dead row keeps every shape
+            # non-empty so the global path can slice its single slot
+            cols = [
+                Column(t, jnp.zeros(1, dtype=t.dtype),
+                       jnp.zeros(1, dtype=jnp.bool_), d)
+                for t, d in self._schema
+            ]
+            mega = RelBatch(cols, jnp.zeros(1, dtype=jnp.bool_))
+        self._collected = []
+        keys = [mega.columns[c].data for c in self._group_channels]
+        valids = [mega.columns[c].valid_mask() for c in self._group_channels]
+        live = mega.live_mask()
+
+        regular = [
+            (i, a) for i, a in enumerate(self._aggs)
+            if a.kind not in HOLISTIC_KINDS
+        ]
+        values, vvalids, reds = [], [], []
+        for _, a in regular:
+            if a.arg_channel is None:
+                values.append(live.astype(jnp.int64))
+                vvalids.append(None)
+            else:
+                col = mega.columns[a.arg_channel]
+                values.append(col.data)
+                vvalids.append(col.valid)
+            reds.append(_BATCH_REDUCER[a.kind])
+
+        cap = self._cap
+        while True:
+            gk, gv, used, vals, cnts, _, ovf = G.sort_group_reduce(
+                tuple(keys), tuple(valids), live, tuple(values),
+                tuple(vvalids), tuple(reds), cap,
+            )
+            if not self._group_channels or not bool(ovf):
+                break
+            cap *= 2
+        self._cap = cap
+
+        agg_cols: Dict[int, Column] = {}
+        for (i, a), val, cnt in zip(regular, vals, cnts):
+            arg_t, arg_d = self._arg_meta[i]
+            state = (val,) if a.kind in ("count", "count_star") else (val, cnt)
+            agg_cols[i] = _agg_output(a, state, arg_t, arg_d)
+        # one key sort shared by every argbest kernel (percentile needs
+        # its own value pre-ordering and sorts separately)
+        shared_order = (
+            G.key_order(tuple(keys), tuple(valids), live)
+            if any(a.kind in ("min_by", "max_by") for a in self._aggs)
+            else None
+        )
+        for i, a in enumerate(self._aggs):
+            if a.kind not in HOLISTIC_KINDS:
+                continue
+            xcol = mega.columns[a.arg_channel]
+            if a.kind in ("min_by", "max_by"):
+                bycol = mega.columns[a.arg2_channel]
+                data, valid = G.grouped_argbest(
+                    tuple(keys), tuple(valids), live,
+                    bycol.data, bycol.valid, xcol.data, xcol.valid,
+                    a.kind, cap, order=shared_order,
+                )
+            else:  # approx_percentile
+                data, valid = G.grouped_percentile(
+                    tuple(keys), tuple(valids), live,
+                    xcol.data, xcol.valid, a.percentile, cap,
+                )
+            agg_cols[i] = Column(
+                a.out_type, data.astype(a.out_type.dtype), valid,
+                xcol.dictionary,
+            )
+
+        out_cols: List[Column] = []
+        for ch, kk, vv in zip(self._group_channels, gk, gv):
+            t, d = self._schema[ch]
+            out_cols.append(Column(t, kk, vv, d))
+        for i in range(len(self._aggs)):
+            out_cols.append(agg_cols[i])
+        if self._global:
+            # global aggregation over empty input still yields ONE row
+            # (counts 0, other aggregates NULL) — slot 0 carries it
+            return RelBatch(
+                [Column(c.type, c.data[:1], None if c.valid is None
+                        else c.valid[:1], c.dictionary) for c in out_cols],
+                jnp.ones(1, dtype=jnp.bool_),
+            )
+        return RelBatch(out_cols, used)
+
     # -- spill (revocable memory) --
     def _revoke_memory(self) -> None:
         """startMemoryRevoke/finishMemoryRevoke collapsed: dump the group
@@ -1296,6 +1425,9 @@ class HashAggregationOperator(Operator):
         if self._finishing:
             return
         self._finishing = True
+        if self._holistic:
+            self._out = self._finish_holistic()
+            return
         with self._state_lock:
             # flips revocation off atomically; from here finish owns state
             self._in_finish = True

@@ -224,6 +224,54 @@ def seg_any(gid, flags, weight_mask, capacity):
 # ---------------------------------------------------------------------------
 
 
+def _key_order(keys, valids, mask, order=None):
+    """Stable permutation grouping equal key tuples (NULL == NULL),
+    live rows first. LSD-radix chain of single-key stable argsorts —
+    NOT one multi-key lax.sort, whose XLA:TPU compile time explodes
+    with array count x length (3 keys + 10 operands at 16k rows took
+    108s to compile); single-key sorts compile in seconds. An incoming
+    `order` acts as the least-significant pre-ordering (within-group
+    value order for order statistics)."""
+    n = mask.shape[0]
+    if order is None:
+        order = jnp.arange(n, dtype=jnp.int32)
+    for k, v in reversed(list(zip(keys, valids))):
+        kk = jnp.where(v, k, jnp.zeros((), dtype=k.dtype))
+        order = take_clip(order, jnp.argsort(take_clip(kk, order), stable=True))
+        order = take_clip(order, jnp.argsort(take_clip(~v, order), stable=True))
+    order = take_clip(order, jnp.argsort(take_clip(~mask, order), stable=True))
+    return order
+
+
+def _segment_bounds(sk, sv, sm, n, out_capacity):
+    """Per-group segment geometry over key-sorted rows: boundary flags,
+    compacted (starts, safe_starts, ends, used), n_groups, overflowed.
+    Group ordering is the sorted key order — deterministic, so two
+    passes over identically-sorted rows align slot for slot."""
+    same = None
+    for k, v in zip(sk, sv):
+        prev_k = jnp.roll(k, 1)
+        prev_v = jnp.roll(v, 1)
+        eq = ((k == prev_k) & v & prev_v) | (~v & ~prev_v)
+        same = eq if same is None else (same & eq)
+    if same is None:  # no keys: single segment
+        same = jnp.ones(n, dtype=jnp.bool_)
+    first_row = jnp.arange(n) == 0
+    prev_live = jnp.roll(sm, 1) & ~first_row
+    boundary = sm & (first_row | ~same | ~prev_live)
+    n_groups = jnp.sum(boundary.astype(jnp.int32)) if n else jnp.int32(0)
+    overflowed = n_groups > out_capacity
+    sidx = jnp.where(boundary, jnp.arange(n, dtype=jnp.int32), jnp.int32(n))
+    starts = jnp.sort(sidx)[:out_capacity]
+    used = starts < n
+    safe_starts = jnp.clip(starts, 0, max(n - 1, 0))
+    next_starts = jnp.concatenate(
+        [starts[1:], jnp.full((1,), n, dtype=starts.dtype)]
+    )
+    ends = jnp.clip(jnp.where(used, next_starts, 1) - 1, 0, max(n - 1, 0))
+    return boundary, starts, safe_starts, ends, used, n_groups, overflowed
+
+
 def _seg_scan(op, neutral, flags, vals):
     """Segmented inclusive scan: `flags` marks segment starts; `op` must
     be associative. Runs as one lax.associative_scan (log-depth on TPU)."""
@@ -457,19 +505,8 @@ def sort_group_reduce(
     `results[i]` is reducer i's per-group result; `counts[i]` the number
     of non-null contributions (for SQL empty-group NULL semantics).
     """
-    n = keys[0].shape[0]
-    # LSD-radix chain of single-key stable argsorts, then gather every
-    # column once by the final permutation. (A single multi-key
-    # multi-operand lax.sort would be fewer passes, but XLA:TPU sort
-    # compile time explodes with array count x length — 3 keys + 10
-    # operands at 16k rows took 108s to compile; the chain compiles in
-    # seconds and the clip-mode gathers are ~ms each, ops/gather.py.)
-    order = jnp.arange(n, dtype=jnp.int32)
-    for k, v in reversed(list(zip(keys, valids))):
-        kk = jnp.where(v, k, jnp.zeros((), dtype=k.dtype))
-        order = take_clip(order, jnp.argsort(take_clip(kk, order), stable=True))
-        order = take_clip(order, jnp.argsort(take_clip(~v, order), stable=True))
-    order = take_clip(order, jnp.argsort(take_clip(~mask, order), stable=True))
+    n = mask.shape[0]
+    order = _key_order(keys, valids, mask)
     sm = take_clip(mask, order)
     sk = [take_clip(k, order) for k in keys]
     sv = [take_clip(v, order) for v in valids]
@@ -478,32 +515,9 @@ def sort_group_reduce(
         None if vv is None else take_clip(vv, order) for vv in value_valids
     ]
 
-    # segment boundaries among live rows (NULL == NULL)
-    same = None
-    for k, v in zip(sk, sv):
-        prev_k = jnp.roll(k, 1)
-        prev_v = jnp.roll(v, 1)
-        eq = ((k == prev_k) & v & prev_v) | (~v & ~prev_v)
-        same = eq if same is None else (same & eq)
-    if same is None:  # no keys: single segment
-        same = jnp.ones(n, dtype=jnp.bool_)
-    first_row = jnp.arange(n) == 0
-    prev_live = jnp.roll(sm, 1) & ~first_row
-    boundary = sm & (first_row | ~same | ~prev_live)
-    gid_sorted = jnp.cumsum(boundary.astype(jnp.int32)) - 1
-    n_groups = jnp.sum(boundary.astype(jnp.int32)) if n else jnp.int32(0)
-    overflowed = n_groups > out_capacity
-
-    # segment start positions, compacted to (out_capacity,)
-    sidx = jnp.where(boundary, jnp.arange(n, dtype=jnp.int32), jnp.int32(n))
-    starts = jnp.sort(sidx)[:out_capacity]
-    used = starts < n
-    safe_starts = jnp.clip(starts, 0, max(n - 1, 0))
-    next_starts = jnp.concatenate(
-        [starts[1:], jnp.full((1,), n, dtype=starts.dtype)]
+    (boundary, starts, safe_starts, ends, used, n_groups, overflowed) = (
+        _segment_bounds(sk, sv, sm, n, out_capacity)
     )
-    ends = jnp.clip(jnp.where(used, next_starts, 1) - 1, 0, max(n - 1, 0))
-
     group_keys = [take_clip(k, safe_starts) for k in sk]
     group_valids = [take_clip(v, safe_starts) & used for v in sv]
 
@@ -556,3 +570,127 @@ def sort_group_reduce(
             raise ValueError(red)
         results.append(out)
     return group_keys, group_valids, used, results, counts, n_groups, overflowed
+
+
+# ---------------------------------------------------------------------------
+# Holistic (order-statistic) grouped aggregates — min_by/max_by and
+# approx_percentile need the raw rows, not mergeable accumulators
+# (Trino's MinMaxByNStateFactory / qdigest aggregations). The planner
+# runs them single-step after a gather; these kernels share the key
+# sort + segment geometry with sort_group_reduce, so their per-slot
+# outputs align with its group ordering exactly.
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def key_order(keys, valids, mask):
+    """Jitted public form of the grouping sort permutation, so callers
+    computing several order statistics over the same keys sort ONCE and
+    pass the permutation into each kernel."""
+    return _key_order(keys, valids, mask)
+
+
+@partial(jax.jit, static_argnames=("kind", "out_capacity"))
+def grouped_argbest(
+    keys, valids, mask, by, by_valid, x, x_valid, kind: str,
+    out_capacity: int, order=None,
+):
+    """min_by/max_by: x at the row with the smallest/largest `by` per
+    group (rows with NULL `by` are ignored; ties keep the first row in
+    sort order — Trino returns an arbitrary one). Returns
+    (x_data, x_valid) aligned with sort_group_reduce's group slots."""
+    n = mask.shape[0]
+    if order is None:
+        order = _key_order(keys, valids, mask)
+    sm = take_clip(mask, order)
+    sk = [take_clip(k, order) for k in keys]
+    sv = [take_clip(v, order) for v in valids]
+    boundary, starts, safe_starts, ends, used, _, _ = _segment_bounds(
+        sk, sv, sm, n, out_capacity
+    )
+    w = sm if by_valid is None else (sm & take_clip(by_valid, order))
+    s_by = take_clip(by, order)
+    s_x = take_clip(x, order)
+    s_xv = (
+        jnp.ones(n, dtype=jnp.bool_)
+        if x_valid is None
+        else take_clip(x_valid, order)
+    )
+    if jnp.issubdtype(s_by.dtype, jnp.floating):
+        neutral = jnp.inf if kind == "min_by" else -jnp.inf
+    elif s_by.dtype == jnp.bool_:
+        neutral = kind == "min_by"
+    else:
+        info = jnp.iinfo(s_by.dtype)
+        neutral = info.max if kind == "min_by" else info.min
+    nb = jnp.where(w, s_by, jnp.asarray(neutral, s_by.dtype))
+    better = (
+        (lambda new, cur: new < cur)
+        if kind == "min_by"
+        else (lambda new, cur: new > cur)
+    )
+
+    def combine(a, bseg):
+        af, ah, ab, ax, av = a
+        bf, bh, bb, bx, bv = bseg
+        # segment restart: right side starts fresh
+        take_right = bf | (bh & (~ah | better(bb, ab)))
+        return (
+            af | bf,
+            jnp.where(bf, bh, ah | bh),
+            jnp.where(take_right, bb, ab),
+            jnp.where(take_right, bx, ax),
+            jnp.where(take_right, bv, av),
+        )
+
+    _, has_run, _, x_run, xv_run = jax.lax.associative_scan(
+        combine, (boundary, w, nb, s_x, s_xv)
+    )
+    out_x = take_clip(x_run, ends)
+    out_valid = take_clip(has_run & xv_run, ends) & used
+    return jnp.where(used, out_x, jnp.zeros((), out_x.dtype)), out_valid
+
+
+@partial(jax.jit, static_argnames=("fraction", "out_capacity"))
+def grouped_percentile(
+    keys, valids, mask, x, x_valid, fraction: float, out_capacity: int,
+):
+    """approx_percentile(x, fraction) per group, computed EXACTLY by
+    nearest-rank over the sorted segment (exact answers satisfy the
+    approximate contract; the reference's qdigest sketch trades
+    accuracy for mergeability we don't need single-step). NULL x rows
+    are excluded. Returns (data, valid) aligned with
+    sort_group_reduce's group slots."""
+    from trino_tpu.ops.sort import _order_value
+
+    n = mask.shape[0]
+    xv = (
+        jnp.ones(n, dtype=jnp.bool_) if x_valid is None else x_valid
+    )
+    # pre-order: x ascending, NULL x last within each group
+    pre = jnp.argsort(_order_value(x, False), stable=True).astype(jnp.int32)
+    pre = take_clip(pre, jnp.argsort(take_clip(~xv, pre), stable=True))
+    order = _key_order(keys, valids, mask, order=pre)
+    sm = take_clip(mask, order)
+    sk = [take_clip(k, order) for k in keys]
+    sv = [take_clip(v, order) for v in valids]
+    boundary, starts, safe_starts, ends, used, _, _ = _segment_bounds(
+        sk, sv, sm, n, out_capacity
+    )
+    w = sm & take_clip(xv, order)
+    s_x = take_clip(x, order)
+    cnt_c = jnp.cumsum(w.astype(jnp.int64))
+    cnt_ex = cnt_c - w.astype(jnp.int64)
+    cnt = take_clip(cnt_c, ends) - take_clip(cnt_ex, safe_starts)
+    # nearest rank: index floor(fraction * (cnt-1) + 0.5) into the
+    # valid prefix of the segment (invalid rows sorted to its tail)
+    rank = jnp.floor(
+        fraction * (cnt - 1).astype(jnp.float64) + 0.5
+    ).astype(jnp.int64)
+    rank = jnp.clip(rank, 0, jnp.maximum(cnt - 1, 0))
+    idx = jnp.clip(
+        safe_starts.astype(jnp.int64) + rank, 0, max(n - 1, 0)
+    ).astype(jnp.int32)
+    out = take_clip(s_x, idx)
+    valid = used & (cnt > 0)
+    return jnp.where(valid, out, jnp.zeros((), out.dtype)), valid

@@ -58,7 +58,14 @@ COMPOSITE_AGG_FUNCS = {
     "corr", "covar_pop", "covar_samp", "regr_slope", "regr_intercept",
     "approx_distinct",
 }
-AGG_FUNCS = AGG_FUNCS | COMPOSITE_AGG_FUNCS
+# Holistic aggregates: need the raw rows (order statistics), so the
+# fragmenter runs them single-step after a gather and the operator
+# takes its collect path. Single source of truth for the kind set:
+# exec/operators.HOLISTIC_KINDS (fragmenter gates on it too).
+from trino_tpu.exec.operators import HOLISTIC_KINDS as _HOLISTIC_KINDS
+
+HOLISTIC_AGG_FUNCS = set(_HOLISTIC_KINDS)
+AGG_FUNCS = AGG_FUNCS | COMPOSITE_AGG_FUNCS | HOLISTIC_AGG_FUNCS
 
 _EPOCH = datetime.date(1970, 1, 1)
 
@@ -549,7 +556,11 @@ class ExprConverter:
             null_repl = None
             if len(rest) > 1:
                 nr = _const_fold(self.convert(rest[1]))
-                null_repl = nr.value if nr else None
+                if nr is None:
+                    raise AnalysisError(
+                        "array_join() null replacement must be constant"
+                    )
+                null_repl = nr.value  # NULL replacement -> skip nulls
             parts = []
             for l in arr:
                 if l.value is None:
@@ -1712,6 +1723,43 @@ class Analyzer:
                 per_call.append(
                     self._expand_composite_agg(call, conv, add_prim)
                 )
+                continue
+            if kind in ("min_by", "max_by"):
+                if len(call.args) != 2 or distinct:
+                    raise AnalysisError(f"{kind}(x, y) takes two arguments")
+                x = conv.convert(call.args[0])
+                y = conv.convert(call.args[1])
+                x_ch = len(pre_exprs)
+                pre_exprs.append(x)
+                y_ch = len(pre_exprs)
+                pre_exprs.append(y)
+                aggs.append(
+                    P.AggCall(kind, x_ch, x.type, arg2_channel=y_ch)
+                )
+                per_call.append(("plain", len(aggs) - 1))
+                continue
+            if kind == "approx_percentile":
+                if len(call.args) != 2 or distinct:
+                    raise AnalysisError(
+                        "approx_percentile(x, fraction) takes two arguments"
+                    )
+                x = conv.convert(call.args[0])
+                frac = _const_fold(conv.convert(call.args[1]))
+                if frac is None or frac.value is None:
+                    raise AnalysisError(
+                        "approx_percentile() fraction must be a constant"
+                    )
+                p = float(frac.value)
+                if not 0.0 <= p <= 1.0:
+                    raise AnalysisError(
+                        "approx_percentile() fraction must be in [0, 1]"
+                    )
+                x_ch = len(pre_exprs)
+                pre_exprs.append(x)
+                aggs.append(
+                    P.AggCall("approx_percentile", x_ch, x.type, percentile=p)
+                )
+                per_call.append(("plain", len(aggs) - 1))
                 continue
             if kind in ("any_value", "arbitrary"):
                 kind = "any"

@@ -176,9 +176,15 @@ class _AddExchanges:
     # aggregation: partial -> hash exchange -> final
     def _AggregateNode(self, node):
         child, dist = self.visit(node.child)
-        if not is_distributed(dist) or any(a.distinct for a in node.aggs):
-            # distinct aggregation runs single-step after a gather (the
-            # MarkDistinct distributed form is future work)
+        from trino_tpu.exec.operators import HOLISTIC_KINDS
+
+        holistic = any(a.kind in HOLISTIC_KINDS for a in node.aggs)
+        if not is_distributed(dist) or holistic or any(
+            a.distinct for a in node.aggs
+        ):
+            # distinct and holistic aggregation run single-step after a
+            # gather (the MarkDistinct distributed form and mergeable
+            # holistic sketches are future work)
             if is_distributed(dist):
                 child = _gather(child)
             return dataclasses.replace(node, child=child), SINGLE
@@ -291,7 +297,8 @@ def _partial_fields(node: P.AggregateNode, child: P.PlanNode) -> List[P.Field]:
 def _spec_of(a: P.AggCall):
     from trino_tpu.exec.operators import AggSpec
 
-    return AggSpec(a.kind, a.arg_channel, a.out_type, a.distinct)
+    return AggSpec(a.kind, a.arg_channel, a.out_type, a.distinct,
+                   a.arg2_channel, a.percentile)
 
 
 # -- row estimation: the cost-based StatsCalculator (sql/stats.py) -----------

@@ -241,7 +241,11 @@ class LocalPlanner:
         chain, schema = self._visit(node.child)
         if any(a.distinct for a in node.aggs):
             return self._distinct_agg(node, chain, schema)
-        specs = [AggSpec(a.kind, a.arg_channel, a.out_type) for a in node.aggs]
+        specs = [
+            AggSpec(a.kind, a.arg_channel, a.out_type,
+                    arg2_channel=a.arg2_channel, percentile=a.percentile)
+            for a in node.aggs
+        ]
         groups = list(node.group_channels)
         step = node.step
         pre = self._take_fused(chain)
@@ -256,8 +260,20 @@ class LocalPlanner:
             from trino_tpu.exec.operators import partial_output_schema
 
             return chain, partial_output_schema(specs, groups, schema)
+        # min/max/any and the holistic kinds return a value from the
+        # argument column, so its dictionary must ride along (a string
+        # result without its dictionary renders as raw codes)
+        def _out_dict(a):
+            if (
+                a.kind in ("min", "max", "any", "min_by", "max_by",
+                           "approx_percentile")
+                and a.arg_channel is not None
+            ):
+                return schema[a.arg_channel][1]
+            return None
+
         out_schema: Schema = [schema[c] for c in node.group_channels] + [
-            (a.out_type, None) for a in node.aggs
+            (a.out_type, _out_dict(a)) for a in node.aggs
         ]
         if step == "final":
             # keys and min/max/any results keep the dictionaries that

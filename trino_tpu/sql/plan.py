@@ -74,13 +74,17 @@ class ProjectNode(PlanNode):
 
 @dataclasses.dataclass(frozen=True)
 class AggCall:
-    """kind in {sum,count,count_star,avg,min,max,any}; arg_channel
-    indexes the child schema (None for count_star)."""
+    """kind in {sum,count,count_star,avg,min,max,any} plus the holistic
+    kinds {min_by,max_by,approx_percentile}; arg_channel indexes the
+    child schema (None for count_star). arg2_channel is min_by/max_by's
+    ordering argument; percentile is approx_percentile's fraction."""
 
     kind: str
     arg_channel: Optional[int]
     out_type: T.DataType
     distinct: bool = False
+    arg2_channel: Optional[int] = None
+    percentile: Optional[float] = None
 
 
 @dataclasses.dataclass(frozen=True)
