@@ -118,3 +118,139 @@ def test_grouping_sets(sql, oracle_sql, tpch_runner, oracle):
     got = _norm(tpch_runner.execute(sql).rows)
     want = _norm(sqlite_rows(oracle, oracle_sql))
     assert got == want
+
+
+class TestDeleteUpdate:
+    """DELETE / UPDATE via read-rewrite (the memory-connector analogue
+    of Trino's row-level delete/update; SURVEY.md §2.6 TableDelete)."""
+
+    @staticmethod
+    def _runner():
+        from trino_tpu.connectors.memory import create_memory_connector
+
+        r = LocalQueryRunner(Session(catalog="memory", schema="s"))
+        r.register_catalog("memory", create_memory_connector())
+        r.execute("CREATE TABLE t (x bigint, name varchar)")
+        r.execute(
+            "INSERT INTO t VALUES (1, 'a'), (2, 'b'), (3, 'c'), (4, 'd')"
+        )
+        return r
+
+    def test_delete_predicate(self):
+        r = self._runner()
+        assert r.execute("DELETE FROM t WHERE x > 2").only_value() == 2
+        assert r.execute("SELECT x FROM t ORDER BY x").rows == [[1], [2]]
+
+    def test_delete_all(self):
+        r = self._runner()
+        assert r.execute("DELETE FROM t").only_value() == 4
+        assert r.execute("SELECT count(*) FROM t").only_value() == 0
+        # table still exists and accepts inserts
+        r.execute("INSERT INTO t VALUES (9, 'z')")
+        assert r.execute("SELECT count(*) FROM t").only_value() == 1
+
+    def test_delete_null_predicate_keeps_row(self):
+        r = self._runner()
+        r.execute("INSERT INTO t VALUES (NULL, 'n')")
+        # x > 2 is NULL for the NULL row -> not deleted
+        assert r.execute("DELETE FROM t WHERE x > 2").only_value() == 2
+        assert r.execute("SELECT count(*) FROM t").only_value() == 3
+
+    def test_update_with_predicate(self):
+        r = self._runner()
+        assert (
+            r.execute("UPDATE t SET name = 'z', x = x + 10 WHERE x = 2").only_value()
+            == 1
+        )
+        rows = r.execute("SELECT x, name FROM t ORDER BY x").rows
+        assert rows == [[1, "a"], [3, "c"], [4, "d"], [12, "z"]]
+
+    def test_update_all_rows_with_coercion(self):
+        r = self._runner()
+        # x + 0.5 is DOUBLE: the rewrite must cast back onto the BIGINT
+        # column (round half away: 1.5->2, 2.5->3, 3.5->4, 4.5->5)
+        assert r.execute("UPDATE t SET x = x + 0.5").only_value() == 4
+        assert r.execute("SELECT sum(x) FROM t").only_value() == 14
+
+    def test_duplicate_assignment_rejected(self):
+        from trino_tpu.sql.analyzer import AnalysisError
+
+        r = self._runner()
+        with pytest.raises(AnalysisError):
+            r.execute("UPDATE t SET x = 1, x = 2")
+
+    def test_update_requires_update_privilege(self):
+        from trino_tpu.connectors.memory import create_memory_connector
+        from trino_tpu.security import AccessDeniedError, FileBasedAccessControl
+
+        ac = FileBasedAccessControl(
+            [{"user": "u", "privileges": ["SELECT", "INSERT", "OWNERSHIP"]}]
+        )
+        r = LocalQueryRunner(
+            Session(catalog="memory", schema="s", user="u"), access_control=ac
+        )
+        r.register_catalog("memory", create_memory_connector())
+        r.execute("CREATE TABLE t (x bigint)")
+        r.execute("INSERT INTO t VALUES (1)")
+        # drop to INSERT-only: UPDATE must be denied (insert != update)
+        r.access_control = FileBasedAccessControl(
+            [{"user": "u", "privileges": ["SELECT", "INSERT"]}]
+        )
+        with pytest.raises(AccessDeniedError):
+            r.execute("UPDATE t SET x = 0")
+
+    def test_dml_subquery_scan_is_checked(self):
+        """The rewrite query's scans go through access control — a
+        WHERE-clause subquery must not read tables the user cannot
+        SELECT from."""
+        from trino_tpu.connectors.memory import create_memory_connector
+        from trino_tpu.security import AccessDeniedError, FileBasedAccessControl
+
+        r = LocalQueryRunner(Session(catalog="memory", schema="s", user="u"))
+        r.register_catalog("memory", create_memory_connector())
+        r.execute("CREATE TABLE t (x bigint)")
+        r.execute("CREATE TABLE secret (v bigint)")
+        r.execute("INSERT INTO t VALUES (1)")
+        r.execute("INSERT INTO secret VALUES (1)")
+        r.access_control = FileBasedAccessControl(
+            [{"user": "u", "table": "t", "privileges":
+              ["SELECT", "INSERT", "DELETE", "UPDATE"]}]
+        )
+        with pytest.raises(AccessDeniedError):
+            r.execute("DELETE FROM t WHERE x IN (SELECT v FROM secret)")
+        with pytest.raises(AccessDeniedError):
+            r.execute("UPDATE t SET x = 2 WHERE x IN (SELECT v FROM secret)")
+
+    def test_update_unknown_column(self):
+        from trino_tpu.sql.analyzer import AnalysisError
+
+        r = self._runner()
+        with pytest.raises(AnalysisError):
+            r.execute("UPDATE t SET nope = 1")
+
+    def test_dml_rejected_in_explicit_transaction(self):
+        from trino_tpu.transaction import TransactionError
+
+        r = self._runner()
+        r.execute("START TRANSACTION")
+        with pytest.raises(TransactionError):
+            r.execute("DELETE FROM t WHERE x = 1")
+        r.execute("ROLLBACK")
+
+    def test_access_control_gates_delete(self):
+        from trino_tpu.connectors.memory import create_memory_connector
+        from trino_tpu.security import AccessDeniedError, FileBasedAccessControl
+
+        ac = FileBasedAccessControl(
+            [{"user": "u", "privileges": ["SELECT", "INSERT", "OWNERSHIP"]}]
+        )
+        # note: OWNERSHIP implies all, so use a SELECT-only user
+        ac2 = FileBasedAccessControl([{"user": "u", "privileges": ["SELECT"]}])
+        r = LocalQueryRunner(
+            Session(catalog="memory", schema="s", user="u"), access_control=ac
+        )
+        r.register_catalog("memory", create_memory_connector())
+        r.execute("CREATE TABLE t (x bigint)")
+        r.access_control = ac2
+        with pytest.raises(AccessDeniedError):
+            r.execute("DELETE FROM t WHERE x = 1")

@@ -105,6 +105,15 @@ class MemoryMetadata(ConnectorMetadata):
             self.store.tables[(schema, table)] = st
         return TableHandle("memory", schema, table)
 
+    def truncate_table(self, handle: TableHandle) -> None:
+        with self.store.lock:
+            t = self.store.tables[(handle.schema, handle.table)]
+            for sc in t.data.values():
+                sc.data = sc.data[:0]
+                sc.valid = None
+            t.row_count = 0
+            t.version += 1
+
     def drop_table(self, handle: TableHandle) -> None:
         with self.store.lock:
             self.store.tables.pop((handle.schema, handle.table), None)
@@ -274,6 +283,37 @@ class MemoryConnector(Connector):
 
     def begin_transaction(self, read_only: bool = False):
         return MemoryTransactionHandle(self.store)
+
+    def replace_rows(self, handle: TableHandle, batches) -> None:
+        """Atomically replace the table's rows with `batches` (the
+        DELETE/UPDATE rewrite commit): stage into a detached copy of
+        the table, then swap under the store lock — a mid-stage failure
+        leaves the original untouched."""
+        key = (handle.schema, handle.table)
+        with self.store.lock:
+            t = self.store.tables[key]
+            staging = _StoredTable(t.schema, t.name, list(t.columns))
+            for cm in t.columns:
+                src = t.data[cm.name]
+                staging.data[cm.name] = _StoredColumn(
+                    cm.type,
+                    src.data[:0],
+                    None,
+                    src.dictionary,  # keep the table dictionary stable
+                )
+        staging_store = _Store()
+        staging_store.tables[key] = staging
+        sink = MemoryPageSink(staging_store, handle)
+        for b in batches:
+            sink.append(b)
+        with self.store.lock:
+            t = self.store.tables.get(key)
+            if t is None:
+                raise KeyError(f"table {key} dropped during rewrite")
+            t.data = staging.data
+            t.row_count = staging.row_count
+            t.version += 1
+            t.device_cache.clear()
 
     def page_sink(self, handle: TableHandle, transaction=None) -> ConnectorPageSink:
         if isinstance(transaction, MemoryTransactionHandle):

@@ -107,6 +107,12 @@ class ConnectorMetadata:
     def drop_table(self, handle: TableHandle) -> None:
         raise NotImplementedError(f"{type(self).__name__} does not support DROP TABLE")
 
+    def truncate_table(self, handle: TableHandle) -> None:
+        """Remove all rows, keeping the table (DELETE/UPDATE rewrite
+        support; the reference's ConnectorMetadata.executeDelete
+        whole-table path)."""
+        raise NotImplementedError(f"{type(self).__name__} does not support DELETE")
+
 
 class ConnectorSplitManager:
     def get_splits(self, handle: TableHandle, target_split_count: int) -> List[Split]:

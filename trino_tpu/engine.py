@@ -248,6 +248,15 @@ class LocalQueryRunner:
             return self._execute_ctas(stmt)
         if isinstance(stmt, ast.Insert):
             return self._execute_insert(stmt.table, stmt.columns, stmt.query)
+        if isinstance(stmt, ast.Delete):
+            return self._execute_rewrite_dml(stmt.table, stmt.where, None)
+        if isinstance(stmt, ast.Update):
+            names = [c for c, _ in stmt.assignments]
+            if len(set(names)) != len(names):
+                raise AnalysisError("multiple assignments for the same column")
+            return self._execute_rewrite_dml(
+                stmt.table, stmt.where, dict(stmt.assignments)
+            )
         if isinstance(stmt, ast.DropTable):
             conn, schema, table = self._resolve_target(stmt.table)
             self.access_control.check_can_drop_table(
@@ -361,6 +370,142 @@ class LocalQueryRunner:
             conn, schema, table, output,
             list(columns) if columns else None,
         )
+
+    def _execute_rewrite_dml(
+        self, parts, where, assignments: Optional[dict]
+    ) -> MaterializedResult:
+        """DELETE (assignments=None) / UPDATE as a read-rewrite: scan
+        the surviving/updated rows into device batches, truncate, and
+        re-append — the memory-connector analogue of the reference's
+        row-level delete/update pushdown. Affected-row count comes from
+        a matched-rows count pass."""
+        from trino_tpu.transaction import TransactionError
+
+        conn, schema, table = self._resolve_target(parts)
+        check = (
+            self.access_control.check_can_delete
+            if assignments is None
+            else self.access_control.check_can_update
+        )
+        check(self.identity, conn.name, schema, table)
+        self._check_writable()
+        if self._active_txn() is not None:
+            raise TransactionError(
+                "DELETE/UPDATE inside an explicit transaction is not supported"
+            )
+        handle = conn.metadata.get_table_handle(schema, table)
+        if handle is None:
+            raise AnalysisError(f"table {schema}.{table} does not exist")
+        meta = conn.metadata.get_table_metadata(handle)
+        if assignments is not None:
+            known = {c.name for c in meta.columns}
+            for col in assignments:
+                if col not in known:
+                    raise AnalysisError(f"unknown column {col} in UPDATE")
+        rel = ast.TableRef(parts)
+        matched = (
+            where
+            if where is not None
+            else ast.BooleanLiteral(True)
+        )
+        count_q = ast.Query(
+            ast.QuerySpec(
+                (ast.SelectItem(ast.FunctionCall("count", (ast.Star(),))),),
+                from_=rel,
+                where=where,
+            )
+        )
+        affected = self._execute_query(count_q).only_value()
+
+        if assignments is None:
+            # keep rows where the predicate is NOT TRUE
+            keep = (
+                ast.UnaryOp(
+                    "not",
+                    ast.FunctionCall(
+                        "coalesce", (where, ast.BooleanLiteral(False))
+                    ),
+                )
+                if where is not None
+                else None
+            )
+            if keep is None:  # unconditional DELETE = truncate
+                conn.metadata.truncate_table(handle)
+                self._invalidate_plans()
+                return MaterializedResult([[affected]], ["rows"], [T.BIGINT])
+            select = tuple(
+                ast.SelectItem(ast.Identifier((c.name,))) for c in meta.columns
+            )
+            rewrite_q = ast.Query(
+                ast.QuerySpec(select, from_=rel, where=keep)
+            )
+        else:
+            # per column: CASE WHEN pred THEN new ELSE old END
+            items = []
+            for c in meta.columns:
+                old = ast.Identifier((c.name,))
+                if c.name in assignments:
+                    new = assignments[c.name]
+                    e = (
+                        ast.Case(
+                            None,
+                            (ast.WhenClause(matched, new),),
+                            old,
+                        )
+                        if where is not None
+                        else new
+                    )
+                else:
+                    e = old
+                items.append(ast.SelectItem(e, c.name))
+            rewrite_q = ast.Query(ast.QuerySpec(tuple(items), from_=rel))
+
+        output = self._analyze(rewrite_q)
+        # SET-clause subqueries may scan other tables: same SELECT
+        # access checks as any query
+        self._check_scans(output)
+        # coerce rewritten columns back onto the table schema (UPDATE
+        # expressions may widen types), same as the INSERT path
+        from trino_tpu.expr import ir
+        from trino_tpu.sql import plan as P
+
+        exprs = []
+        for i, col in enumerate(meta.columns):
+            e: ir.Expr = ir.InputRef(i, output.fields[i].type)
+            if output.fields[i].type != col.type:
+                e = ir.Cast(e, col.type)
+            exprs.append(e)
+        fields = tuple(P.Field(c.name, c.type) for c in meta.columns)
+        node = P.ProjectNode(output.child, tuple(exprs), fields)
+        planner = LocalPlanner(
+            self.catalogs,
+            batch_rows=self.session.batch_rows,
+            target_splits=self.session.target_splits,
+            dynamic_filtering=self.session.enable_dynamic_filtering,
+        )
+        physical = planner.plan(node)
+        ctx = self._execution_ctx()
+        pipelines, chain = physical.instantiate(ctx)
+        sink = CollectorSink()
+        chain.append(sink)
+        for p in pipelines:
+            Driver(p).run()
+        Driver(Pipeline(chain)).run()
+        _raise_deferred_checks(ctx)
+        # commit the rewrite: connectors with replace_rows do it
+        # atomically (stage-then-swap); the fallback truncate+append is
+        # NOT crash-atomic
+        replace = getattr(conn, "replace_rows", None)
+        if replace is not None:
+            replace(handle, sink.batches)
+        else:
+            conn.metadata.truncate_table(handle)
+            writer_sink = conn.page_sink(handle)
+            for b in sink.batches:
+                writer_sink.append(b)
+            writer_sink.finish()
+        self._invalidate_plans()
+        return MaterializedResult([[affected]], ["rows"], [T.BIGINT])
 
     def _write_into(
         self, conn, schema: str, table: str, output: OutputNode,

@@ -66,6 +66,11 @@ class AccessControl:
     ) -> None:
         pass
 
+    def check_can_update(
+        self, identity: Identity, catalog: str, schema: str, table: str
+    ) -> None:
+        pass
+
     def check_can_create_table(
         self, identity: Identity, catalog: str, schema: str, table: str
     ) -> None:
@@ -86,7 +91,7 @@ class AllowAllAccessControl(AccessControl):
     """Default (main/security/AllowAllAccessControl analogue)."""
 
 
-PRIVILEGES = ("SELECT", "INSERT", "DELETE", "OWNERSHIP")
+PRIVILEGES = ("SELECT", "INSERT", "DELETE", "UPDATE", "OWNERSHIP")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -150,6 +155,9 @@ class FileBasedAccessControl(AccessControl):
 
     def check_can_delete(self, identity, catalog, schema, table):
         self._check("DELETE", identity, catalog, schema, table)
+
+    def check_can_update(self, identity, catalog, schema, table):
+        self._check("UPDATE", identity, catalog, schema, table)
 
     def check_can_create_table(self, identity, catalog, schema, table):
         self._check("OWNERSHIP", identity, catalog, schema, table)

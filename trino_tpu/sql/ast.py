@@ -363,6 +363,23 @@ class SetSession(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class Delete(Node):
+    """DELETE FROM table [WHERE predicate]."""
+
+    table: Tuple[str, ...]
+    where: Optional[Expression] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Update(Node):
+    """UPDATE table SET col = expr [, ...] [WHERE predicate]."""
+
+    table: Tuple[str, ...]
+    assignments: Tuple[Tuple[str, Expression], ...]
+    where: Optional[Expression] = None
+
+
+@dataclasses.dataclass(frozen=True)
 class StartTransaction(Node):
     """START TRANSACTION [READ ONLY | READ WRITE] (isolation modes are
     accepted and ignored — the reference's connectors mostly run

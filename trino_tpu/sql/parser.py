@@ -207,6 +207,29 @@ class Parser:
                 self.next()
                 columns = self._parse_name_list()
             stmt = ast.Insert(table, columns, self.parse_query())
+        elif self.at_kw("DELETE"):
+            self.next()
+            self.expect_kw("FROM")
+            table = self._parse_qualified_name()
+            where = None
+            if self.accept_kw("WHERE"):
+                where = self.parse_expr()
+            stmt = ast.Delete(table, where)
+        elif self.at_kw("UPDATE"):
+            self.next()
+            table = self._parse_qualified_name()
+            self.expect_kw("SET")
+            assignments = []
+            while True:
+                col = self._parse_name()
+                self.expect_op("=")
+                assignments.append((col, self.parse_expr()))
+                if not self.accept_op(","):
+                    break
+            where = None
+            if self.accept_kw("WHERE"):
+                where = self.parse_expr()
+            stmt = ast.Update(table, tuple(assignments), where)
         elif self.at_kw("DROP"):
             self.next()
             self.expect_kw("TABLE")
