@@ -122,3 +122,37 @@ def test_window_distributed(oracle):
     got = r.execute(sql).rows
     want = sqlite_rows(oracle, to_sqlite(sql))
     assert_rows_match(got, want, ordered=True, abs_tol=1e-2)
+
+
+def test_percent_rank_and_cume_dist(runner):
+    """percent_rank/cume_dist vs hand-computed oracle."""
+    rows = runner.execute(
+        "SELECT n_regionkey, n_nationkey,"
+        " percent_rank() OVER (PARTITION BY n_regionkey ORDER BY n_nationkey),"
+        " cume_dist() OVER (PARTITION BY n_regionkey ORDER BY n_nationkey)"
+        " FROM nation ORDER BY n_regionkey, n_nationkey"
+    ).rows
+    by_rk = {}
+    for rk, nk, pr, cd in rows:
+        by_rk.setdefault(rk, []).append((nk, pr, cd))
+    for rk, items in by_rk.items():
+        n = len(items)
+        for i, (nk, pr, cd) in enumerate(items):
+            want_pr = 0.0 if n == 1 else i / (n - 1)
+            want_cd = (i + 1) / n
+            assert abs(pr - want_pr) < 1e-12, (rk, nk)
+            assert abs(cd - want_cd) < 1e-12, (rk, nk)
+
+
+def test_cume_dist_with_peers(runner):
+    # ties share the peer group: cume_dist counts through the group end
+    rows = runner.execute(
+        "SELECT x, cume_dist() OVER (ORDER BY x) FROM"
+        " (VALUES (1), (2), (2), (3)) t(x) ORDER BY x"
+    ).rows
+    assert [r[1] for r in rows] == [0.25, 0.75, 0.75, 1.0]
+    rows2 = runner.execute(
+        "SELECT x, percent_rank() OVER (ORDER BY x) FROM"
+        " (VALUES (1), (2), (2), (3)) t(x) ORDER BY x"
+    ).rows
+    assert [r[1] for r in rows2] == [0.0, 1 / 3, 1 / 3, 1.0]

@@ -517,6 +517,10 @@ def _window_compute(
             out_cols.append((W.rank(part_start, peer_start).astype(out_dtype), None))
         elif kind == "dense_rank":
             out_cols.append((W.dense_rank(part_start, peer_start).astype(out_dtype), None))
+        elif kind == "percent_rank":
+            out_cols.append((W.percent_rank(part_start, peer_start).astype(out_dtype), None))
+        elif kind == "cume_dist":
+            out_cols.append((W.cume_dist(part_start, peer_start).astype(out_dtype), None))
         elif kind == "ntile":
             out_cols.append((W.ntile(offset, part_start).astype(out_dtype), None))
         elif kind in ("lead", "lag"):

@@ -70,6 +70,26 @@ def rank(part_start: jnp.ndarray, peer_start: jnp.ndarray) -> jnp.ndarray:
     return (peer_first - _seg_start_index(part_start) + 1).astype(jnp.int64)
 
 
+def percent_rank(part_start: jnp.ndarray, peer_start: jnp.ndarray):
+    """(rank - 1) / (partition rows - 1); 0 for single-row partitions
+    (WindowFunctions: PercentRankFunction semantics)."""
+    size = (
+        _seg_end_index(part_start) - _seg_start_index(part_start) + 1
+    ).astype(jnp.float64)
+    r = rank(part_start, peer_start).astype(jnp.float64)
+    return jnp.where(size > 1, (r - 1) / jnp.maximum(size - 1, 1), 0.0)
+
+
+def cume_dist(part_start: jnp.ndarray, peer_start: jnp.ndarray):
+    """(rows at or before the current peer group end) / partition rows
+    (CumulativeDistributionFunction semantics)."""
+    start = _seg_start_index(part_start)
+    size = (_seg_end_index(part_start) - start + 1).astype(jnp.float64)
+    end = _peer_end_index(part_start, peer_start)
+    at_or_before = (end - start + 1).astype(jnp.float64)
+    return at_or_before / size
+
+
 def dense_rank(part_start: jnp.ndarray, peer_start: jnp.ndarray) -> jnp.ndarray:
     groups = jnp.cumsum(peer_start.astype(jnp.int64))
     at_seg_start = take_clip(groups, _seg_start_index(part_start))

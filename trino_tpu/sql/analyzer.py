@@ -659,8 +659,8 @@ def _scalar_subqueries(e: ast.Expression) -> List[ast.ScalarSubquery]:
 
 
 WINDOW_ONLY_FUNCS = {
-    "row_number", "rank", "dense_rank", "ntile", "lead", "lag",
-    "first_value", "last_value",
+    "row_number", "rank", "dense_rank", "percent_rank", "cume_dist",
+    "ntile", "lead", "lag", "first_value", "last_value",
 }
 
 
@@ -2229,6 +2229,10 @@ class Analyzer:
             if c.args:
                 raise AnalysisError(f"{name}() takes no arguments")
             return P.WindowFuncSpec(name, None, T.BIGINT)
+        if name in ("percent_rank", "cume_dist"):
+            if c.args:
+                raise AnalysisError(f"{name}() takes no arguments")
+            return P.WindowFuncSpec(name, None, T.DOUBLE)
         if name == "ntile":
             n = c.args[0] if c.args else None
             if not isinstance(n, ast.NumberLiteral) or not n.text.isdigit():

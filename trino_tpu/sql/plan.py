@@ -127,7 +127,8 @@ class JoinNode(PlanNode):
 
 @dataclasses.dataclass(frozen=True)
 class WindowFuncSpec:
-    """One window function: kind in {row_number, rank, dense_rank, ntile,
+    """One window function: kind in {row_number, rank, dense_rank,
+    percent_rank, cume_dist, ntile,
     lead, lag, first_value, last_value, sum, avg, min, max, count,
     count_star}; arg_channel indexes the child schema (None for rank
     family / count_star); `offset` is lead/lag's offset or ntile's n."""
