@@ -302,3 +302,40 @@ class TestHolisticAggregates:
             " (SELECT n_nationkey x, n_regionkey y FROM nation LIMIT 0) t"
         ).rows
         assert rows == [[None, None, 0]]
+
+    def test_listagg_and_string_agg(self, runner):
+        assert runner.execute(
+            "SELECT listagg(r_name, ', ') FROM region"
+        ).only_value() == "AFRICA, AMERICA, ASIA, EUROPE, MIDDLE EAST"
+        rows = runner.execute(
+            "SELECT n_regionkey, string_agg(n_name, '|') FROM nation"
+            " WHERE n_nationkey < 6 GROUP BY n_regionkey ORDER BY n_regionkey"
+        ).rows
+        assert rows == [
+            [0, "ALGERIA|ETHIOPIA"],
+            [1, "ARGENTINA|BRAZIL|CANADA"],
+            [4, "EGYPT"],
+        ]
+        # empty input -> NULL; non-string arg rejected
+        assert runner.execute(
+            "SELECT listagg(r_name, '-') FROM region WHERE r_regionkey < 0"
+        ).only_value() is None
+        from trino_tpu.sql.analyzer import AnalysisError
+
+        with pytest.raises(AnalysisError):
+            runner.execute("SELECT listagg(r_regionkey, '-') FROM region")
+
+    def test_listagg_downstream_expressions_fail_loudly(self, runner):
+        """Plan-time string ops cannot know listagg's execution-time
+        dictionary; they must raise cleanly, never return wrong rows."""
+        for sql in (
+            "SELECT k, s FROM (SELECT n_regionkey k, string_agg(n_name,'|') s"
+            " FROM nation GROUP BY n_regionkey) t WHERE s = 'EGYPT'",
+            "SELECT upper(s) FROM (SELECT listagg(r_name, '-') s FROM region) t",
+        ):
+            with pytest.raises(NotImplementedError):
+                runner.execute(sql)
+        from trino_tpu.sql.analyzer import AnalysisError
+
+        with pytest.raises(AnalysisError):
+            runner.execute("SELECT listagg(r_name, 7) FROM region")

@@ -386,3 +386,15 @@ def concat_batches(batches: Sequence["RelBatch"]) -> "RelBatch":
         cols.append(Column(parts[0].type, data, valid, parts[0].dictionary))
     live = jnp.concatenate([b.live_mask() for b in batches])
     return RelBatch(cols, live)
+
+
+class RuntimeDictionary(Dictionary):
+    """Plan-time placeholder for a string column whose dictionary is
+    created at EXECUTION time (listagg output: the aggregate builds new
+    strings). Pure column references pass the runtime dictionary
+    through (operators.make_filter_project_fn); any plan-time-bound
+    string operation cannot know the values and must fail loudly at
+    bind time rather than treat the column as all-NULL."""
+
+    def __init__(self):
+        super().__init__([])

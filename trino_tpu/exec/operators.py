@@ -158,7 +158,20 @@ def make_filter_project_fn(
         out_cols = []
         for b in projections:
             data, valid = b.fn(cols, valids)
-            out_cols.append(Column(b.type, data, valid, b.dictionary))
+            d = b.dictionary
+            from trino_tpu.block import RuntimeDictionary
+
+            if (
+                (d is None or isinstance(d, RuntimeDictionary))
+                and b.type.is_string
+                and b.input_ref is not None
+                and b.input_ref < len(batch.columns)
+            ):
+                # runtime-dictionary passthrough for pure column refs:
+                # the dictionary is pytree aux data, so a new runtime
+                # dictionary (listagg output) retraces this program
+                d = batch.columns[b.input_ref].dictionary
+            out_cols.append(Column(b.type, data, valid, d))
         return RelBatch(out_cols, live)
 
     return jax.jit(fn)
@@ -651,7 +664,9 @@ class AggSpec:
     the holistic kinds {min_by,max_by,approx_percentile} (which need the
     raw rows, not mergeable accumulators — the planner forces them
     single-step); arg_channel indexes the operator's input (None for
-    count_star), out_type is the SQL result type."""
+    count_star), out_type is the SQL result type. The holistic set
+    below (HOLISTIC_KINDS) is the single source of truth the fragmenter
+    gates single-step planning on."""
 
     kind: str
     arg_channel: Optional[int]
@@ -659,9 +674,10 @@ class AggSpec:
     distinct: bool = False
     arg2_channel: Optional[int] = None
     percentile: Optional[float] = None
+    separator: Optional[str] = None  # listagg
 
 
-HOLISTIC_KINDS = ("min_by", "max_by", "approx_percentile")
+HOLISTIC_KINDS = ("min_by", "max_by", "approx_percentile", "listagg")
 
 
 def minmax_neutral(dtype, kind: str):
@@ -1332,6 +1348,11 @@ class HashAggregationOperator(Operator):
                     bycol.data, bycol.valid, xcol.data, xcol.valid,
                     a.kind, cap, order=shared_order,
                 )
+            elif a.kind == "listagg":
+                agg_cols[i] = self._listagg_column(
+                    a, keys, valids, live, xcol, cap
+                )
+                continue
             else:  # approx_percentile
                 data, valid = G.grouped_percentile(
                     tuple(keys), tuple(valids), live,
@@ -1357,6 +1378,36 @@ class HashAggregationOperator(Operator):
                 jnp.ones(1, dtype=jnp.bool_),
             )
         return RelBatch(out_cols, used)
+
+    def _listagg_column(self, a: AggSpec, keys, valids, live, xcol, cap):
+        """listagg/string_agg: concatenating group members into NEW
+        strings is host-side work by nature (Trino's
+        ListaggAggregationFunction builds its VARCHAR on the heap too);
+        the device groups and value-orders the rows, the host joins
+        dictionary values per dense group id. Element order is the
+        value's lexical order (deterministic; WITHIN GROUP custom
+        orderings are future work)."""
+        gid, w, codes, n_groups, _ = G.grouped_rows_sorted(
+            tuple(keys), tuple(valids), live, xcol.data, xcol.valid, cap
+        )
+        gid_h, w_h, codes_h, n_h = jax.device_get((gid, w, codes, n_groups))
+        dict_values = xcol.dictionary.values if xcol.dictionary else []
+        parts: List[List[str]] = [[] for _ in range(int(n_h))]
+        for g, ok, c in zip(gid_h, w_h, codes_h):
+            if ok and 0 <= g < len(parts) and 0 <= c < len(dict_values):
+                parts[g].append(dict_values[int(c)])
+        sep = a.separator or ""
+        strings = [sep.join(p) for p in parts]
+        out_dict = Dictionary(strings)
+        data = np.zeros(cap, dtype=np.int32)
+        valid = np.zeros(cap, dtype=bool)
+        for g, s in enumerate(strings):
+            if parts[g]:
+                data[g] = out_dict.code(s)
+                valid[g] = True
+        return Column(
+            T.VARCHAR, jnp.asarray(data), jnp.asarray(valid), out_dict
+        )
 
     # -- spill (revocable memory) --
     def _revoke_memory(self) -> None:

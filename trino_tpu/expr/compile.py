@@ -68,6 +68,10 @@ class Bound:
     dictionary: Optional[Dictionary] = None
     const_value: object = None
     is_const: bool = False
+    # set for pure input references: runtime-dictionary passthrough
+    # (aggregates like listagg create dictionaries at execution time
+    # that plan-time binding cannot know)
+    input_ref: Optional[int] = None
 
     def eval_batch(self, batch: RelBatch) -> Column:
         data, valid = self.fn(
@@ -143,6 +147,7 @@ class ExprBinder:
             self.input_types[i],
             lambda cols, valids, i=i: (cols[i], valids[i]),
             self.input_dicts[i],
+            input_ref=i,
         )
 
     def _bind_literal(self, e: Literal) -> Bound:
@@ -921,6 +926,14 @@ class ExprBinder:
         return s[begin:end]
 
     def _null_of(self, ref: Bound, out_type: T.DataType) -> Bound:
+        from trino_tpu.block import RuntimeDictionary
+
+        if isinstance(ref.dictionary, RuntimeDictionary):
+            raise NotImplementedError(
+                "expressions over runtime-dictionary strings (listagg"
+                " output) are not supported yet — materialize the"
+                " aggregate first (e.g. CTAS) and operate on the table"
+            )
         def fn(cols, valids, rfn=ref.fn):
             d, _ = rfn(cols, valids)
             return _const(d, 0, out_type.dtype), _const(d, False, jnp.bool_)
@@ -1106,6 +1119,17 @@ class ExprBinder:
         """String comparison on dictionary codes. Because dictionaries are
         sorted, code order == lexical order within one dictionary; a
         constant compares via its bisect position even when absent."""
+        from trino_tpu.block import RuntimeDictionary
+
+        if isinstance(a.dictionary, RuntimeDictionary) or isinstance(
+            b.dictionary, RuntimeDictionary
+        ):
+            # same contract as _null_of: plan-time string ops cannot
+            # know an execution-time dictionary (listagg output)
+            self._null_of(
+                a if isinstance(a.dictionary, RuntimeDictionary) else b,
+                T.BOOLEAN,
+            )
         jf = {
             "eq": lambda x, y: x == y, "ne": lambda x, y: x != y,
             "lt": lambda x, y: x < y, "le": lambda x, y: x <= y,

@@ -694,3 +694,29 @@ def grouped_percentile(
     out = take_clip(s_x, idx)
     valid = used & (cnt > 0)
     return jnp.where(valid, out, jnp.zeros((), out.dtype)), valid
+
+
+@partial(jax.jit, static_argnames=("out_capacity",))
+def grouped_rows_sorted(keys, valids, mask, x, x_valid, out_capacity):
+    """Rows grouped and value-ordered for HOST-side assembly (listagg:
+    building new strings is host work by nature — Trino's
+    ListaggAggregationFunction builds its VARCHAR on the heap too).
+    Returns (dense_gid_per_sorted_row, weight, sorted_x, n_groups,
+    overflowed); dense gids index sort_group_reduce's compacted slots
+    1:1 (same sort chain, same segment ordering)."""
+    n = mask.shape[0]
+    xv = jnp.ones(n, dtype=jnp.bool_) if x_valid is None else x_valid
+    from trino_tpu.ops.sort import _order_value
+
+    pre = jnp.argsort(_order_value(x, False), stable=True).astype(jnp.int32)
+    pre = take_clip(pre, jnp.argsort(take_clip(~xv, pre), stable=True))
+    order = _key_order(keys, valids, mask, order=pre)
+    sm = take_clip(mask, order)
+    sk = [take_clip(k, order) for k in keys]
+    sv = [take_clip(v, order) for v in valids]
+    boundary, starts, safe_starts, ends, used, n_groups, overflowed = (
+        _segment_bounds(sk, sv, sm, n, out_capacity)
+    )
+    gid = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    w = sm & take_clip(xv, order)
+    return gid, w, take_clip(x, order), n_groups, overflowed

@@ -64,7 +64,7 @@ COMPOSITE_AGG_FUNCS = {
 # exec/operators.HOLISTIC_KINDS (fragmenter gates on it too).
 from trino_tpu.exec.operators import HOLISTIC_KINDS as _HOLISTIC_KINDS
 
-HOLISTIC_AGG_FUNCS = set(_HOLISTIC_KINDS)
+HOLISTIC_AGG_FUNCS = set(_HOLISTIC_KINDS) | {"string_agg"}
 AGG_FUNCS = AGG_FUNCS | COMPOSITE_AGG_FUNCS | HOLISTIC_AGG_FUNCS
 
 _EPOCH = datetime.date(1970, 1, 1)
@@ -1735,6 +1735,32 @@ class Analyzer:
                 pre_exprs.append(y)
                 aggs.append(
                     P.AggCall(kind, x_ch, x.type, arg2_channel=y_ch)
+                )
+                per_call.append(("plain", len(aggs) - 1))
+                continue
+            if kind in ("listagg", "string_agg"):
+                if len(call.args) != 2 or distinct:
+                    raise AnalysisError(
+                        f"{kind}(x, separator) takes two arguments"
+                    )
+                x = conv.convert(call.args[0])
+                if not x.type.is_string:
+                    raise AnalysisError(f"{kind}() aggregates VARCHAR values")
+                sep = _const_fold(conv.convert(call.args[1]))
+                if (
+                    sep is None
+                    or sep.value is None
+                    or not sep.type.is_string
+                ):
+                    raise AnalysisError(
+                        f"{kind}() separator must be a constant string"
+                    )
+                x_ch = len(pre_exprs)
+                pre_exprs.append(x)
+                aggs.append(
+                    P.AggCall(
+                        "listagg", x_ch, T.VARCHAR, separator=str(sep.value)
+                    )
                 )
                 per_call.append(("plain", len(aggs) - 1))
                 continue

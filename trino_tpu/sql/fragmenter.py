@@ -298,7 +298,7 @@ def _spec_of(a: P.AggCall):
     from trino_tpu.exec.operators import AggSpec
 
     return AggSpec(a.kind, a.arg_channel, a.out_type, a.distinct,
-                   a.arg2_channel, a.percentile)
+                   a.arg2_channel, a.percentile, a.separator)
 
 
 # -- row estimation: the cost-based StatsCalculator (sql/stats.py) -----------

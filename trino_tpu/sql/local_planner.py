@@ -243,7 +243,8 @@ class LocalPlanner:
             return self._distinct_agg(node, chain, schema)
         specs = [
             AggSpec(a.kind, a.arg_channel, a.out_type,
-                    arg2_channel=a.arg2_channel, percentile=a.percentile)
+                    arg2_channel=a.arg2_channel, percentile=a.percentile,
+                    separator=a.separator)
             for a in node.aggs
         ]
         groups = list(node.group_channels)
@@ -270,6 +271,12 @@ class LocalPlanner:
                 and a.arg_channel is not None
             ):
                 return schema[a.arg_channel][1]
+            if a.kind == "listagg":
+                # created at execution time; plan-time string ops over
+                # it must fail loudly (expr/compile._null_of)
+                from trino_tpu.block import RuntimeDictionary
+
+                return RuntimeDictionary()
             return None
 
         out_schema: Schema = [schema[c] for c in node.group_channels] + [

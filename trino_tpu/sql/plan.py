@@ -85,6 +85,7 @@ class AggCall:
     distinct: bool = False
     arg2_channel: Optional[int] = None
     percentile: Optional[float] = None
+    separator: Optional[str] = None  # listagg
 
 
 @dataclasses.dataclass(frozen=True)
