@@ -234,8 +234,12 @@ def oracle():
 
 @pytest.fixture(scope="module")
 def runner():
+    # mesh_execution off: this suite covers the HTTP page-exchange data
+    # plane (workers/tasks/buffers); tests/test_mesh.py covers the
+    # collective data plane
     r = DistributedQueryRunner(
-        Session(catalog="tpch", schema="tiny"), n_workers=2, hash_partitions=2
+        Session(catalog="tpch", schema="tiny", mesh_execution=False),
+        n_workers=2, hash_partitions=2,
     )
     r.register_catalog("tpch", create_tpch_connector())
     return r

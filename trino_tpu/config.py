@@ -81,6 +81,8 @@ for _name, _type, _default, _desc, _allowed in [
     ("enable_dynamic_filtering", bool, True, "probe-side join pruning", None),
     ("broadcast_join_threshold", int, 1_000_000,
      "max estimated build rows for a broadcast join", None),
+    ("mesh_execution", bool, True,
+     "run colocated fragments over the device-mesh collective exchange", None),
 ]:
     SYSTEM_PROPERTIES.register(_name, _type, _default, _desc, _allowed)
 

@@ -45,6 +45,11 @@ class Session:
     hash_partition_count: int = 4
     enable_dynamic_filtering: bool = True
     broadcast_join_threshold: int = 1_000_000
+    # distributed data plane: run mesh-colocated fragments as ONE
+    # shard_map program with all_to_all/all_gather exchanges over ICI
+    # (parallel/mesh_plan.py); ineligible plans and cross-host/FTE
+    # topologies fall back to the HTTP page exchange
+    mesh_execution: bool = True
 
     def set_property(self, name: str, value) -> None:
         """SET SESSION entry point — validated through the typed

@@ -123,7 +123,11 @@ class PartitionedOutputOperator(Operator):
                 col = batch.columns[c]
                 keys.append(col.data)
                 valids.append(col.valid_mask())
-                if col.dictionary is not None:
+                # lut only for NON-EMPTY dictionaries (indexing an empty
+                # lut is invalid; an empty dictionary means an all-NULL
+                # column) — keep in sync with mesh_plan._partition_ids so
+                # both data planes route co-partitioned rows identically
+                if col.dictionary is not None and len(col.dictionary) > 0:
                     luts.append(self._code_hashes(col.dictionary))
                     has_lut.append(True)
                 else:
@@ -200,11 +204,14 @@ class RemoteSourceOperator(Operator):
             self._pending = []
             return out
         page = self._source.poll()
+        # skip zero-row pages INSIDE the call: returning None for one
+        # while is_blocked() reports "drained, not blocked" would let the
+        # driver diagnose a stall one poll before _done could be set
+        while page is not None and page.row_count == 0:
+            page = self._source.poll()
         if page is None:
             if self._source.is_finished():
                 self._done = True
-            return None
-        if page.row_count == 0:
             return None
         return page.to_batch()
 

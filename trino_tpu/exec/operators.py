@@ -307,6 +307,10 @@ def _topn_merge(
 ) -> RelBatch:
     merged = concat_batches(list(parts))
     order = _apply_sort(merged, keys)
+    # clamp to the merged capacity: a bucketed cap larger than the
+    # concatenated parts (mixed part capacities, e.g. 16+64=80 -> 128)
+    # would slice order short while building a longer live mask
+    cap = min(cap, int(order.shape[0]))
     top = order[:cap]
     n_live = jnp.minimum(jnp.sum(merged.live_mask()), n)
     live = jnp.arange(cap) < n_live

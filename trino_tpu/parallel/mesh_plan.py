@@ -1,0 +1,870 @@
+"""Mesh-resident distributed execution: ICI collectives as the SQL data plane.
+
+The reference's distributed data plane is HTTP page streams between
+worker JVMs, stitched by AddExchanges-inserted REMOTE exchanges
+(optimizations/AddExchanges.java:266-276) and PartitionedOutputOperator
+(output/PartitionedOutputOperator.java:46). The TPU-native form of the
+same plan is ONE SPMD program over a `jax.sharding.Mesh`:
+
+- every fragment's operator pipeline becomes a per-shard traced function
+  over a fixed-capacity local RelBatch;
+- a FIXED_HASH exchange between fragments becomes an on-device hash
+  partition + `lax.all_to_all` over the mesh axis (ICI);
+- a FIXED_BROADCAST exchange becomes `lax.all_gather`;
+- the final gather boundary ships per-shard results to the host, where
+  the root (single-partition) fragment runs through the ordinary local
+  operator pipeline (merge-sorting RemoteSource included).
+
+The compiler consumes the SAME SubPlan the HTTP scheduler would run
+(sql/fragmenter.plan_distributed), so planning decisions — partial/final
+aggregation, broadcast-vs-partitioned joins, merge exchanges, adaptive
+partition counts — are shared between both data planes; only the
+transport differs. Mesh execution is selected when all tasks would be
+colocated on one host's device mesh (in-process workers); cross-host /
+elastic / FTE execution keeps the pull+ack HTTP exchange.
+
+Static-shape discipline: per-shard batch capacities are fixed at trace
+time; group tables and join fan-out use host-chosen capacities with
+device overflow flags and a double-and-retrace protocol (the tryRehash
+analogue). An all_to_all send block equals the sender's batch capacity,
+so exchange overflow is impossible by construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as PSpec
+
+from trino_tpu import types as T
+from trino_tpu.block import (
+    Column,
+    RelBatch,
+    bucket_capacity,
+    concat_batches,
+    unify_column_dicts,
+)
+from trino_tpu.exec.operators import (
+    _BATCH_REDUCER,
+    _MERGE_REDUCER,
+    AggSpec,
+    _agg_output,
+    _expand_pairs,
+    _left_unmatched,
+    _segment_any,
+    agg_state_meta,
+    make_filter_project_fn,
+    make_residual_fn,
+)
+from trino_tpu.exec.serde import Page
+from trino_tpu.expr.compile import ExprBinder
+from trino_tpu.ops import groupby as G
+from trino_tpu.ops import join as J
+from trino_tpu.ops.gather import take_clip
+from trino_tpu.ops.hashing import (
+    canonical_hash_input,
+    dictionary_code_hashes,
+    hash32,
+    partition_of,
+)
+from trino_tpu.ops.sort import sort_order
+from trino_tpu.sql import plan as P
+from trino_tpu.sql.fragmenter import SubPlan
+
+AXIS = "shard"
+
+# Trace-time counters, monotonically increasing for the process life
+# (capacity-overflow retraces count again). Tests must assert on
+# before/after deltas, never absolute values.
+MESH_COUNTERS = {"queries": 0, "all_to_all": 0, "all_gather": 0}
+
+
+class MeshUnsupported(Exception):
+    """Plan shape the mesh compiler cannot run; the coordinator falls
+    back to the host page-exchange data plane."""
+
+
+# ---------------------------------------------------------------------------
+# Eligibility
+# ---------------------------------------------------------------------------
+
+
+def _check_node(n: P.PlanNode) -> None:
+    if isinstance(n, (P.WindowNode, P.UnionAllNode, P.OutputNode)):
+        raise MeshUnsupported(type(n).__name__)
+    if isinstance(n, P.AggregateNode):
+        for a in n.aggs:
+            if a.distinct or a.kind not in _BATCH_REDUCER:
+                raise MeshUnsupported(f"agg {a.kind}")
+    if isinstance(n, P.JoinNode) and n.kind not in (
+        "inner", "left", "semi", "anti", "cross"
+    ):
+        raise MeshUnsupported(f"join {n.kind}")
+    if isinstance(n, P.LimitNode) and n.count is None:
+        raise MeshUnsupported("offset-only limit")
+    for c in n.children():
+        _check_node(c)
+
+
+def _scan_nodes(n: P.PlanNode) -> List[P.ScanNode]:
+    out = []
+    if isinstance(n, P.ScanNode):
+        out.append(n)
+    for c in n.children():
+        out.extend(_scan_nodes(c))
+    return out
+
+
+def _contains_scan(n: P.PlanNode) -> bool:
+    return bool(_scan_nodes(n))
+
+
+# ---------------------------------------------------------------------------
+# In-trace exchange primitives
+# ---------------------------------------------------------------------------
+
+
+def _partition_ids(batch: RelBatch, channels: Sequence[int], n: int):
+    """Row -> destination shard by canonicalized key hash (dictionary
+    codes mapped through value-hash LUTs so co-partitioned producers
+    agree — the exchange_ops._partition_ids contract). Dead rows -> -1."""
+    lanes, valids = [], []
+    for ch in channels:
+        col = batch.columns[ch]
+        if col.dictionary is not None and len(col.dictionary) > 0:
+            lut = jnp.asarray(dictionary_code_hashes(col.dictionary.values))
+            lanes.append(canonical_hash_input(col.data, lut))
+        else:
+            lanes.append(canonical_hash_input(col.data))
+        valids.append(col.valid_mask())
+    pid = partition_of(hash32(lanes, valids), n)
+    return jnp.where(batch.live_mask(), pid, -1)
+
+
+def _scatter_to_blocks(arrays, live, pid, n: int, block: int):
+    """Scatter local rows into (n, block) destination blocks (the
+    PagePartitioner analogue, on device). pid < 0 drops the row. With
+    block == batch capacity overflow is impossible."""
+    tgt = jnp.where(pid < 0, n, pid).astype(jnp.int32)
+    order = jnp.argsort(tgt, stable=True)
+    st = take_clip(tgt, order)
+    idx = jnp.arange(st.shape[0], dtype=jnp.int32)
+    dest_start = jnp.searchsorted(st, jnp.arange(n, dtype=jnp.int32))
+    slot = idx - take_clip(dest_start, jnp.clip(st, 0, n - 1))
+    flat = jnp.where(
+        st < n,
+        jnp.clip(st, 0, n - 1) * block + jnp.clip(slot, 0, block - 1),
+        n * block,
+    )
+
+    def scat(col):
+        z = jnp.zeros(n * block + 1, dtype=col.dtype)
+        return z.at[flat].set(take_clip(col, order), mode="drop")[:-1].reshape(
+            n, block
+        )
+
+    out = [scat(a) for a in arrays]
+    live_b = scat(live)
+    return out, live_b
+
+
+def _exchange_hash(batch: RelBatch, channels: Sequence[int], n: int) -> RelBatch:
+    """FIXED_HASH remote exchange as partition + all_to_all over ICI."""
+    block = batch.capacity
+    pid = _partition_ids(batch, channels, n)
+    arrays = []
+    for c in batch.columns:
+        arrays.append(c.data)
+        arrays.append(c.valid_mask())
+    blocks, live_b = _scatter_to_blocks(arrays, batch.live_mask(), pid, n, block)
+    MESH_COUNTERS["all_to_all"] += 1
+    ex = [jax.lax.all_to_all(b, AXIS, 0, 0, tiled=True) for b in blocks]
+    live_ex = jax.lax.all_to_all(live_b, AXIS, 0, 0, tiled=True)
+    cols = [
+        Column(c.type, ex[2 * i].reshape(-1), ex[2 * i + 1].reshape(-1),
+               c.dictionary)
+        for i, c in enumerate(batch.columns)
+    ]
+    return RelBatch(cols, live_ex.reshape(-1))
+
+
+def _replicate(batch: RelBatch) -> RelBatch:
+    """FIXED_BROADCAST exchange as all_gather (every shard gets all rows)."""
+    MESH_COUNTERS["all_gather"] += 1
+
+    def ag(x):
+        return jax.lax.all_gather(x, AXIS, tiled=True)
+
+    cols = [
+        Column(c.type, ag(c.data), ag(c.valid_mask()), c.dictionary)
+        for c in batch.columns
+    ]
+    return RelBatch(cols, ag(batch.live_mask()))
+
+
+def _local_partition(batch: RelBatch, channels: Sequence[int], n: int) -> RelBatch:
+    """Hash output of a REPLICATED producer: every shard already holds
+    all rows, so each keeps only its own partition (no collective)."""
+    pid = _partition_ids(batch, channels, n)
+    me = jax.lax.axis_index(AXIS).astype(pid.dtype)
+    return batch.mask(pid == me)
+
+
+# ---------------------------------------------------------------------------
+# Fragment-body compiler (runs at trace time, inside shard_map)
+# ---------------------------------------------------------------------------
+
+
+class _FragVisitor:
+    """Compiles one fragment's plan tree into per-shard array math over
+    the local RelBatch (the LocalExecutionPlanner analogue for the mesh
+    data plane)."""
+
+    def __init__(self, executor: "MeshExecutor", frag_id: int,
+                 feeds: Dict[int, RelBatch], ctx: Dict[int, RelBatch],
+                 caps: Dict[str, int], flags: List[Tuple[str, jnp.ndarray]]):
+        self.ex = executor
+        self.frag_id = frag_id
+        self.feeds = feeds  # id(ScanNode) -> local RelBatch
+        self.ctx = ctx  # fragment id -> post-exchange local RelBatch
+        self.caps = caps
+        self.flags = flags
+        self._site_counter = 0
+
+    def _site(self, kind: str) -> str:
+        self._site_counter += 1
+        return f"f{self.frag_id}:{kind}{self._site_counter}"
+
+    def visit(self, node: P.PlanNode) -> RelBatch:
+        m = getattr(self, f"_visit_{type(node).__name__}", None)
+        if m is None:
+            raise MeshUnsupported(type(node).__name__)
+        return m(node)
+
+    # -- leaves --
+    def _visit_ScanNode(self, node):
+        return self.feeds[id(node)]
+
+    def _visit_ValuesNode(self, node):
+        data = {f.name or f"_c{i}": [] for i, f in enumerate(node.fields)}
+        keys = list(data)
+        for row in node.rows:
+            for k, v in zip(keys, row):
+                data[k].append(v)
+        schema_t = [(k, f.type) for k, f in zip(keys, node.fields)]
+        return RelBatch.from_pydict(schema_t, data)
+
+    def _visit_RemoteSourceNode(self, node):
+        parts = [self.ctx[fid] for fid in node.fragment_ids]
+        if len(parts) == 1:
+            return parts[0]
+        return concat_batches(parts)
+
+    # -- row transforms --
+    def _bind(self, e, batch: RelBatch):
+        types = [c.type for c in batch.columns]
+        dicts = [c.dictionary for c in batch.columns]
+        return ExprBinder(types, dicts).bind(e)
+
+    def _identity(self, batch: RelBatch):
+        from trino_tpu.expr.ir import InputRef
+
+        return [
+            self._bind(InputRef(i, c.type), batch)
+            for i, c in enumerate(batch.columns)
+        ]
+
+    def _visit_FilterNode(self, node):
+        batch = self.visit(node.child)
+        flt = self._bind(node.predicate, batch)
+        fn = make_filter_project_fn(flt, self._identity(batch))
+        return fn(batch)
+
+    def _visit_ProjectNode(self, node):
+        child = node.child
+        flt = None
+        if isinstance(child, P.FilterNode):
+            batch = self.visit(child.child)
+            flt = self._bind(child.predicate, batch)
+        else:
+            batch = self.visit(child)
+        bounds = [self._bind(e, batch) for e in node.exprs]
+        fn = make_filter_project_fn(flt, bounds)
+        return fn(batch)
+
+    # -- aggregation --
+    def _agg_specs(self, node) -> Tuple[AggSpec, ...]:
+        return tuple(
+            AggSpec(a.kind, a.arg_channel, a.out_type, a.distinct,
+                    a.arg2_channel, a.percentile, a.separator)
+            for a in node.aggs
+        )
+
+    def _initial_agg_cap(self, node, batch: RelBatch) -> int:
+        """Dictionary/boolean-bounded key domains fix the capacity at
+        plan time (the HashAggregationOperator static-bound rule)."""
+        bound = 1
+        for ch in node.group_channels:
+            c = batch.columns[ch]
+            if c.type.is_string and c.dictionary is not None and len(c.dictionary) > 0:
+                bound *= len(c.dictionary) + 1
+            elif c.type.kind == T.TypeKind.BOOLEAN:
+                bound *= 3
+            else:
+                return 1024
+        if 0 < bound <= (1 << 16):
+            return max(bucket_capacity(bound), 16)
+        return 1024
+
+    def _batch_agg_inputs(self, aggs, batch: RelBatch):
+        live = batch.live_mask()
+        values, vvalids, reds = [], [], []
+        for a in aggs:
+            if a.arg_channel is None:
+                values.append(live.astype(jnp.int64))
+                vvalids.append(None)
+            else:
+                col = batch.columns[a.arg_channel]
+                values.append(col.data)
+                vvalids.append(col.valid)
+            reds.append(_BATCH_REDUCER[a.kind])
+        return live, values, vvalids, reds
+
+    def _visit_AggregateNode(self, node):
+        batch = self.visit(node.child)
+        if node.step == "final":
+            return self._agg_final(node, batch)
+        if not node.group_channels:
+            if node.step != "partial":
+                raise MeshUnsupported("global single-step agg in mesh fragment")
+            return self._global_partial(node, batch)
+        return self._agg_grouped(node, batch)
+
+    def _agg_grouped(self, node, batch: RelBatch) -> RelBatch:
+        """Grouped partial OR single-step aggregation (raw rows in)."""
+        aggs = self._agg_specs(node)
+        groups = tuple(node.group_channels)
+        keys = [batch.columns[c].data for c in groups]
+        valids = [batch.columns[c].valid_mask() for c in groups]
+        live, values, vvalids, reds = self._batch_agg_inputs(aggs, batch)
+        site = self._site("agg")
+        cap = self.caps.setdefault(site, self._initial_agg_cap(node, batch))
+        gk, gv, used, vals, cnts, _, ovf = G.sort_group_reduce(
+            tuple(keys), tuple(valids), live, tuple(values), tuple(vvalids),
+            tuple(reds), cap,
+        )
+        self.flags.append((site, ovf))
+        cols: List[Column] = []
+        for ch, kk, vv in zip(groups, gk, gv):
+            c = batch.columns[ch]
+            cols.append(Column(c.type, kk, vv, c.dictionary))
+        schema = [(c.type, c.dictionary) for c in batch.columns]
+        if node.step == "partial":
+            # accumulator wire format (operators.partial_output_schema)
+            for a, val, cnt in zip(aggs, vals, cnts):
+                vt, vd = agg_state_meta(a, schema)[0]
+                cols.append(Column(vt, val.astype(vt.dtype), None, vd))
+                cols.append(Column(T.BIGINT, cnt.astype(jnp.int64), None, None))
+            return RelBatch(cols, used)
+        # single step: finalize in place (the operator finish path)
+        for a, val, cnt in zip(aggs, vals, cnts):
+            arg_t, arg_d = (
+                schema[a.arg_channel] if a.arg_channel is not None else (None, None)
+            )
+            state = (val,) if a.kind in ("count", "count_star") else (val, cnt)
+            out = _agg_output(a, state, arg_t, None)
+            d = arg_d if a.kind in ("min", "max", "any") else None
+            cols.append(Column(a.out_type, out.data, out.valid, d))
+        return RelBatch(cols, used)
+
+    def _global_partial(self, node, batch: RelBatch) -> RelBatch:
+        """GROUP-BY-less partial: one wire row of accumulator state."""
+        aggs = self._agg_specs(node)
+        live, values, vvalids, reds = self._batch_agg_inputs(aggs, batch)
+        schema = [(c.type, c.dictionary) for c in batch.columns]
+        cols: List[Column] = []
+        for a, data, vvalid, red in zip(aggs, values, vvalids, reds):
+            w = live if vvalid is None else (live & vvalid)
+            n = jnp.sum(w.astype(jnp.int64))
+            if red == "count":
+                val = n
+            elif red == "sum":
+                acc_dt = (
+                    jnp.float64
+                    if jnp.issubdtype(data.dtype, jnp.floating)
+                    else jnp.int64
+                )
+                val = jnp.sum(jnp.where(w, data.astype(acc_dt), 0))
+            elif red in ("min", "max"):
+                from trino_tpu.exec.operators import minmax_neutral
+
+                neutral = minmax_neutral(data.dtype, red)
+                masked = jnp.where(w, data, jnp.asarray(neutral, data.dtype))
+                val = jnp.min(masked) if red == "min" else jnp.max(masked)
+            else:  # first
+                val = data[jnp.argmax(w)]
+            vt, vd = agg_state_meta(a, schema)[0]
+            cols.append(Column(vt, val[None].astype(vt.dtype), None, vd))
+            cols.append(Column(T.BIGINT, n[None].astype(jnp.int64), None, None))
+        return RelBatch(cols, jnp.ones(1, dtype=jnp.bool_))
+
+    def _agg_final(self, node, batch: RelBatch) -> RelBatch:
+        """FINAL step over partial-wire-format state rows: merge-reduce
+        per group then finalize (HashAggregationOperator final mode)."""
+        k = len(node.group_channels)
+        keys = [batch.columns[c].data for c in range(k)]
+        valids = [batch.columns[c].valid_mask() for c in range(k)]
+        live = batch.live_mask()
+        values, vvalids, reds = [], [], []
+        for a in node.aggs:
+            val_col = batch.columns[a.arg_channel]
+            cnt_col = batch.columns[a.arg_channel + 1]
+            red = _MERGE_REDUCER[a.kind]
+            values.append(val_col.data)
+            vvalids.append((cnt_col.data > 0) if red == "first" else None)
+            reds.append(red)
+            values.append(cnt_col.data)
+            vvalids.append(None)
+            reds.append("sum")
+        site = self._site("aggf")
+        cap = self.caps.setdefault(site, self._initial_agg_cap(node, batch))
+        gk, gv, used, vals, _, _, ovf = G.sort_group_reduce(
+            tuple(keys), tuple(valids), live, tuple(values), tuple(vvalids),
+            tuple(reds), cap,
+        )
+        self.flags.append((site, ovf))
+        cols: List[Column] = []
+        for c_idx, kk, vv in zip(range(k), gk, gv):
+            c = batch.columns[c_idx]
+            cols.append(Column(c.type, kk, vv, c.dictionary))
+        for i, a in enumerate(node.aggs):
+            val = vals[2 * i]
+            cnt = vals[2 * i + 1].astype(jnp.int64)
+            arg_col = batch.columns[a.arg_channel]
+            state = (val,) if a.kind in ("count", "count_star") else (val, cnt)
+            out = _agg_output(a, state, arg_col.type, None)
+            d = arg_col.dictionary if a.kind in ("min", "max", "any") else None
+            cols.append(Column(a.out_type, out.data, out.valid, d))
+        return RelBatch(cols, used)
+
+    # -- joins --
+    def _visit_JoinNode(self, node):
+        build = self.visit(node.right)
+        probe = self.visit(node.left)
+        if node.kind == "cross":
+            return self._cross_join(node, probe, build)
+        rkeys = list(node.right_keys)
+        lkeys = list(node.left_keys)
+        b_keys = [build.columns[c].data for c in rkeys]
+        b_valids = [build.columns[c].valid_mask() for c in rkeys]
+        ls = J.build_lookup(b_keys, b_valids, build.live_mask())
+        keys = []
+        for i, c in enumerate(lkeys):
+            col = probe.columns[c]
+            bd = build.columns[rkeys[i]].dictionary
+            if (
+                col.dictionary is not None
+                and bd is not None
+                and col.dictionary != bd
+            ):
+                # cross-dictionary string join: remap probe codes onto
+                # the build dictionary by value (LookupJoinOperator rule)
+                remap = jnp.asarray(
+                    [bd.code(v) for v in col.dictionary.values], dtype=jnp.int32
+                )
+                keys.append(take_clip(remap, col.data))
+            else:
+                keys.append(col.data)
+        valids = [probe.columns[c].valid_mask() for c in lkeys]
+        lo, counts, total = J.probe_counts(ls, keys, valids, probe.live_mask())
+        site = self._site("join")
+        out_cap = self.caps.setdefault(site, bucket_capacity(max(probe.capacity, 16)))
+        self.flags.append((site, total > out_cap))
+        pi, bi, ok, pairs = _expand_pairs(
+            ls, probe, build, keys, valids, lo, counts, out_cap
+        )
+        if node.residual is not None:
+            rfn = make_residual_fn(self._bind_pair(node.residual, probe, build))
+            ok = ok & rfn(pairs)
+            pairs = RelBatch(pairs.columns, ok)
+        if node.kind == "inner":
+            return pairs
+        matched = _segment_any(counts, pi, ok, probe.capacity)
+        if node.kind == "semi":
+            return probe.mask(matched)
+        if node.kind == "anti":
+            return probe.mask(~matched)
+        # left outer: matched pairs + unmatched probe rows with NULL build
+        return concat_batches([pairs, _left_unmatched(probe, build, matched)])
+
+    def _bind_pair(self, e, probe: RelBatch, build: RelBatch):
+        cols = list(probe.columns) + list(build.columns)
+        return ExprBinder(
+            [c.type for c in cols], [c.dictionary for c in cols]
+        ).bind(e)
+
+    def _cross_join(self, node, probe: RelBatch, build: RelBatch) -> RelBatch:
+        probe_c = probe.compact()
+        build_c = build.compact()
+        site = self._site("cross")
+        nb = self.caps.setdefault(site, 16)
+        n_l = jnp.sum(probe_c.live_mask().astype(jnp.int32))
+        n_r = jnp.sum(build_c.live_mask().astype(jnp.int32))
+        self.flags.append((site, n_r > nb))
+        k = jnp.arange(probe_c.capacity * nb, dtype=jnp.int32)
+        pi = k // nb
+        bi = k % nb
+        live = (pi < n_l) & (bi < n_r)
+        cols = [c.gather(pi) for c in probe_c.columns]
+        cols += [c.gather(bi) for c in build_c.columns]
+        return RelBatch(cols, live)
+
+    # -- ordering / limits --
+    def _sorted(self, batch: RelBatch, keys) -> RelBatch:
+        datas = [batch.columns[k.channel].data for k in keys]
+        valids = [batch.columns[k.channel].valid for k in keys]
+        order = sort_order(
+            datas, valids, [k.descending for k in keys],
+            [k.nulls_first for k in keys], batch.live_mask(),
+        )
+        return batch.gather(order, take_clip(batch.live_mask(), order))
+
+    def _visit_SortNode(self, node):
+        return self._sorted(self.visit(node.child), node.keys)
+
+    def _visit_TopNNode(self, node):
+        out = self._sorted(self.visit(node.child), node.keys)
+        idx = jnp.arange(out.capacity, dtype=jnp.int32)
+        return out.mask(idx < node.count)
+
+    def _visit_LimitNode(self, node):
+        out = self.visit(node.child).compact()
+        idx = jnp.arange(out.capacity, dtype=jnp.int32)
+        keep = (idx >= node.offset) & (idx < node.offset + node.count)
+        return out.mask(keep)
+
+
+# ---------------------------------------------------------------------------
+# Executor
+# ---------------------------------------------------------------------------
+
+
+class _ListSource:
+    """make_remote_source duck type over pre-materialized pages."""
+
+    def __init__(self, pages: List[Page]):
+        self._pages = list(pages)
+
+    def poll(self) -> Optional[Page]:
+        return self._pages.pop(0) if self._pages else None
+
+    def is_finished(self) -> bool:
+        return not self._pages
+
+
+@dataclasses.dataclass
+class _GatherOut:
+    fid: int
+    local_capacity: int
+    replicated: bool
+    batch: RelBatch  # global (n * local_capacity,) arrays
+
+
+class MeshExecutor:
+    """Runs a SubPlan with the device mesh as the exchange data plane.
+
+    All non-root fragments execute as one shard_map/jit program; the root
+    fragment consumes the gathered results through the ordinary local
+    pipeline (so sort-merge gathers, final TopN/limit and output
+    decoration share code with the HTTP path)."""
+
+    def __init__(self, catalogs, session, devices=None):
+        self.catalogs = catalogs
+        self.session = session
+        devs = list(devices) if devices is not None else list(jax.devices())
+        self.n = len(devs)
+        self.mesh = Mesh(np.array(devs), (AXIS,))
+
+    # -- public --
+    def execute(self, subplan: SubPlan) -> List[list]:
+        from trino_tpu.runtime.stages import topo_order
+
+        order = topo_order(subplan)
+        if len(order) < 2:
+            raise MeshUnsupported("single-fragment plan")
+        mesh_sps = order[:-1]
+        root_sp = order[-1]
+        for sp in mesh_sps:
+            _check_node(sp.fragment.root)
+        root_child_ids = {c.fragment.id for c in root_sp.children}
+        repl = self._replicated_map(mesh_sps)
+        feeds, feed_args = self._load_scans(mesh_sps)
+        MESH_COUNTERS["queries"] += 1
+
+        caps: Dict[str, int] = {}
+        for _ in range(12):
+            flag_sites: List[str] = []
+            out_meta: List[Tuple[int, bool]] = []
+            program = self._build_program(
+                mesh_sps, root_child_ids, repl, feeds, caps, flag_sites, out_meta
+            )
+            outs, flags = program(*feed_args)
+            flags_np = np.asarray(jax.device_get(flags)).reshape(self.n, -1)
+            over = flags_np.max(axis=0)
+            overflowed = [
+                site for site, o in zip(flag_sites, over) if bool(o)
+            ]
+            if not overflowed:
+                break
+            for site in overflowed:
+                caps[site] *= 2
+        else:
+            raise RuntimeError("mesh capacity retry limit exceeded")
+
+        sources = {}
+        for (fid, replicated), batch in zip(out_meta, outs):
+            sources[fid] = self._shard_pages(batch, replicated)
+        return self._run_root(subplan, root_sp, sources)
+
+    # -- planning helpers --
+    def _replicated_map(self, mesh_sps) -> Dict[int, bool]:
+        """Compile-time data placement per fragment: a fragment with no
+        scans whose inputs are all replicated executes replicated (every
+        shard computes the full result deterministically)."""
+        repl: Dict[int, bool] = {}
+        for sp in mesh_sps:
+            frag = sp.fragment
+            if _contains_scan(frag.root):
+                repl[frag.id] = False
+                continue
+            child_ok = True
+            for c in sp.children:
+                k = c.fragment.output_kind
+                # hash input -> sharded; broadcast/gather input -> the
+                # exchange itself replicates it
+                if k == "hash":
+                    child_ok = False
+            repl[frag.id] = child_ok
+        return repl
+
+    def _load_scans(self, mesh_sps):
+        """Host side of SOURCE distribution: each shard scans its slice
+        of the connector splits; slices stack into one globally-sharded
+        RelBatch per ScanNode (the SourcePartitionedScheduler assignment
+        collapsed onto the mesh)."""
+        from trino_tpu.exec.operators import TableScanOperator
+
+        feeds: Dict[int, int] = {}  # id(node) -> feed position
+        feed_args: List[RelBatch] = []
+        sharding = NamedSharding(self.mesh, PSpec(AXIS))
+        for sp in mesh_sps:
+            for node in _scan_nodes(sp.fragment.root):
+                conn = self.catalogs.get(node.catalog)
+                splits = conn.split_manager.get_splits(
+                    node.handle, max(self.session.target_splits, self.n)
+                )
+                schema = [
+                    (f.type, conn.metadata.column_dictionary(node.handle, c))
+                    for c, f in zip(node.columns, node.fields)
+                ]
+                shard_batches = []
+                for s in range(self.n):
+                    my = splits[s:: self.n]
+                    op = TableScanOperator(
+                        conn.page_source, my, list(node.columns),
+                        self.session.batch_rows,
+                    )
+                    parts = []
+                    while not op.is_finished():
+                        b = op.get_output()
+                        if b is None:
+                            break
+                        parts.append(b)
+                    if parts:
+                        shard_batches.append(concat_batches(parts))
+                    else:
+                        shard_batches.append(_empty_batch(schema))
+                feeds[id(node)] = len(feed_args)
+                feed_args.append(
+                    jax.device_put(_stack_shards(shard_batches, self.n), sharding)
+                )
+        return feeds, feed_args
+
+    def _build_program(self, mesh_sps, root_child_ids, repl, feeds, caps,
+                       flag_sites, out_meta):
+        n = self.n
+
+        def body(*feed_batches):
+            # host-visible side lists are cleared at trace entry so a
+            # re-trace (jit weak-type promotion etc.) cannot double-append
+            # and misalign out_meta with the traced outputs
+            flag_sites.clear()
+            out_meta.clear()
+            ctx: Dict[int, RelBatch] = {}
+            flags: List[Tuple[str, jnp.ndarray]] = []
+            outputs: List[RelBatch] = []
+            for sp in mesh_sps:
+                frag = sp.fragment
+                local_feeds = {
+                    key: feed_batches[pos] for key, pos in feeds.items()
+                }
+                vis = _FragVisitor(self, frag.id, local_feeds, ctx, caps, flags)
+                batch = vis.visit(frag.root)
+                if frag.id in root_child_ids:
+                    outputs.append(batch)
+                    out_meta.append((frag.id, repl[frag.id]))
+                    continue
+                kind = frag.output_kind
+                if kind == "hash":
+                    if repl[frag.id]:
+                        ctx[frag.id] = _local_partition(
+                            batch, frag.output_channels, n
+                        )
+                    else:
+                        ctx[frag.id] = _exchange_hash(
+                            batch, frag.output_channels, n
+                        )
+                elif kind == "broadcast":
+                    ctx[frag.id] = batch if repl[frag.id] else _replicate(batch)
+                else:  # gather consumed by another mesh fragment
+                    ctx[frag.id] = batch if repl[frag.id] else _replicate(batch)
+            if flags:
+                flag_sites.extend(s for s, _ in flags)
+                flag_arr = jnp.stack([f for _, f in flags])
+            else:
+                flag_arr = jnp.zeros(1, dtype=jnp.bool_)
+            return tuple(outputs), flag_arr
+
+        f = shard_map(
+            body,
+            mesh=self.mesh,
+            in_specs=tuple(PSpec(AXIS) for _ in feeds),
+            out_specs=PSpec(AXIS),
+            check_rep=False,
+        )
+        return jax.jit(f)
+
+    # -- host boundary --
+    def _shard_pages(self, batch: RelBatch, replicated: bool) -> List[Page]:
+        host = jax.device_get(batch)
+        global_cap = host.columns[0].data.shape[0] if host.columns else 0
+        cap = global_cap // self.n
+        shards = range(1) if replicated else range(self.n)
+        pages = []
+        for s in shards:
+            sl = slice(s * cap, (s + 1) * cap)
+            live = (
+                np.asarray(host.live)[sl].astype(bool)
+                if host.live is not None
+                else np.ones(cap, dtype=bool)
+            )
+            cols, valids, dicts, typs = [], [], [], []
+            for c in host.columns:
+                cols.append(np.asarray(c.data)[sl][live])
+                valids.append(
+                    np.asarray(c.valid)[sl][live] if c.valid is not None else None
+                )
+                dicts.append(
+                    c.dictionary.values if c.dictionary is not None else None
+                )
+                typs.append(c.type)
+            if int(live.sum()):
+                pages.append(Page(typs, cols, valids, dicts, int(live.sum())))
+        return pages
+
+    def _run_root(self, subplan, root_sp, sources: Dict[int, List[Page]]):
+        """Execute the root (single-partition) fragment on the host local
+        pipeline, consuming the mesh results as its remote sources."""
+        from trino_tpu.exec import CollectorSink, Driver, Pipeline
+        from trino_tpu.runtime.stages import fragment_schema, topo_order
+        from trino_tpu.sql.local_planner import LocalPlanner
+
+        schemas: Dict[int, list] = {}
+        for sp in topo_order(subplan):
+            remote = {c.fragment.id: schemas[c.fragment.id] for c in sp.children}
+            schemas[sp.fragment.id] = fragment_schema(
+                self.catalogs, self.session, sp, remote
+            )
+        planner = LocalPlanner(
+            self.catalogs,
+            batch_rows=self.session.batch_rows,
+            remote_schemas={
+                c.fragment.id: schemas[c.fragment.id] for c in root_sp.children
+            },
+            dynamic_filtering=False,
+        )
+        physical = planner.plan(root_sp.fragment.root)
+        ctx = {
+            "make_remote_source": lambda fids: _ListSource(
+                [p for fid in fids for p in sources[fid]]
+            )
+        }
+        pipelines, chain = physical.instantiate(ctx)
+        sink = CollectorSink()
+        chain.append(sink)
+        for p in pipelines:
+            Driver(p).run()
+        Driver(Pipeline(chain)).run()
+        rows: List[list] = []
+        for b in sink.batches:
+            rows.extend(b.to_pylists())
+        return rows
+
+
+# ---------------------------------------------------------------------------
+# Host-side batch assembly
+# ---------------------------------------------------------------------------
+
+
+def _empty_batch(schema) -> RelBatch:
+    cols = [
+        Column(t, jnp.zeros(16, dtype=t.dtype), jnp.zeros(16, dtype=jnp.bool_), d)
+        for t, d in schema
+    ]
+    return RelBatch(cols, jnp.zeros(16, dtype=jnp.bool_))
+
+
+def _stack_shards(batches: List[RelBatch], n: int) -> RelBatch:
+    """Pad per-shard batches to one capacity, unify dictionaries, and
+    stack into host arrays of shape (n * cap,) ready for a sharded
+    device_put (leading-dim sharding makes shard s's rows local to
+    device s)."""
+    assert len(batches) == n
+    cap = bucket_capacity(max(b.capacity for b in batches))
+    width = batches[0].width
+    cols: List[Column] = []
+    for i in range(width):
+        parts = unify_column_dicts([b.columns[i] for b in batches])
+        datas, valids = [], []
+        for p in parts:
+            d = np.asarray(jax.device_get(p.data))
+            v = (
+                np.asarray(jax.device_get(p.valid)).astype(bool)
+                if p.valid is not None
+                else np.ones(d.shape[0], dtype=bool)
+            )
+            if d.shape[0] < cap:
+                d = np.concatenate([d, np.zeros(cap - d.shape[0], d.dtype)])
+                v = np.concatenate([v, np.zeros(cap - v.shape[0], bool)])
+            datas.append(d)
+            valids.append(v)
+        cols.append(
+            Column(
+                parts[0].type,
+                np.concatenate(datas),
+                np.concatenate(valids),
+                parts[0].dictionary,
+            )
+        )
+    lives = []
+    for b in batches:
+        lv = np.asarray(jax.device_get(b.live_mask())).astype(bool)
+        if lv.shape[0] < cap:
+            lv = np.concatenate([lv, np.zeros(cap - lv.shape[0], bool)])
+        lives.append(lv)
+    return RelBatch(cols, np.concatenate(lives))

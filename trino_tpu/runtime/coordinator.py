@@ -157,11 +157,19 @@ class DistributedQueryRunner:
         self.catalogs = CatalogManager()
         if worker_handles is not None:
             self.workers = list(worker_handles)
+            self._in_process_workers = False
         else:
             self.workers = [
                 Worker(f"worker-{i}", self.catalogs) for i in range(n_workers)
             ]
+            self._in_process_workers = True
         self.hash_partitions = hash_partitions
+
+    def _mesh_colocated(self) -> bool:
+        """Mesh execution applies when every task would run in THIS
+        process (tasks then share the host's device mesh). Remote worker
+        handles mean cross-host scheduling — keep the page exchange."""
+        return self._in_process_workers
 
     def register_catalog(self, name: str, connector: Connector) -> None:
         self.catalogs.register(name, connector)
@@ -207,6 +215,20 @@ class DistributedQueryRunner:
         if self.session.retry_policy == "task":
             rows = self._execute_fte(subplan)
             return MaterializedResult(rows, *result_meta)
+        if self.session.mesh_execution and self._mesh_colocated():
+            # tasks share one host's device mesh: the exchange rides ICI
+            # collectives in one SPMD program (parallel/mesh_plan.py);
+            # unsupported plan shapes fall back to the page exchange
+            from trino_tpu.parallel.mesh_plan import MeshExecutor
+
+            try:
+                rows = MeshExecutor(self.catalogs, self.session).execute(subplan)
+                return MaterializedResult(rows, *result_meta)
+            except Exception:
+                # MeshUnsupported (plan shape) or any mesh runtime
+                # failure: the page-exchange path below re-executes the
+                # query from scratch, keeping retry_policy semantics
+                pass
         attempts = (
             1 + self.session.query_retries
             if self.session.retry_policy == "query"
