@@ -109,10 +109,15 @@ class PartitionedOutputOperator(Operator):
     def _code_hashes(self, dictionary):
         # keyed by the VALUES tuple, not object identity: per-page
         # dictionaries die after their batch, and a recycled address must
-        # not serve a stale LUT
+        # not serve a stale LUT. Returns None when hashing.dictionary_lut
+        # says codes hash directly (absent/empty dictionary).
+        from trino_tpu.ops.hashing import dictionary_lut
+
+        if dictionary is None or len(dictionary) == 0:
+            return None
         lut = self._lut_cache.get(dictionary.values)
         if lut is None:
-            lut = jnp.asarray(dictionary_code_hashes(dictionary.values))
+            lut = jnp.asarray(dictionary_lut(dictionary))
             self._lut_cache[dictionary.values] = lut
         return lut
 
@@ -123,12 +128,9 @@ class PartitionedOutputOperator(Operator):
                 col = batch.columns[c]
                 keys.append(col.data)
                 valids.append(col.valid_mask())
-                # lut only for NON-EMPTY dictionaries (indexing an empty
-                # lut is invalid; an empty dictionary means an all-NULL
-                # column) — keep in sync with mesh_plan._partition_ids so
-                # both data planes route co-partitioned rows identically
-                if col.dictionary is not None and len(col.dictionary) > 0:
-                    luts.append(self._code_hashes(col.dictionary))
+                lut = self._code_hashes(col.dictionary)
+                if lut is not None:
+                    luts.append(lut)
                     has_lut.append(True)
                 else:
                     has_lut.append(False)

@@ -96,6 +96,18 @@ def dictionary_code_hashes(values: Sequence[str]) -> "np.ndarray":
     )
 
 
+def dictionary_lut(dictionary) -> "Optional[np.ndarray]":
+    """The single routing rule both data planes share: dictionary codes
+    hash through a per-value LUT when the dictionary is NON-EMPTY; an
+    absent or empty dictionary (all-NULL column) hashes codes directly
+    (indexing an empty LUT would be invalid). Used by the page-exchange
+    PartitionedOutputOperator AND the mesh exchange's _partition_ids —
+    co-partitioned producers on either plane must route identically."""
+    if dictionary is None or len(dictionary) == 0:
+        return None
+    return dictionary_code_hashes(dictionary.values)
+
+
 def canonical_hash_input(data: jnp.ndarray, code_hashes=None) -> jnp.ndarray:
     """Normalize a key column for cross-fragment hash partitioning:
     integer-like -> int64, floating -> float64, dictionary codes -> the

@@ -68,7 +68,7 @@ from trino_tpu.ops import join as J
 from trino_tpu.ops.gather import take_clip
 from trino_tpu.ops.hashing import (
     canonical_hash_input,
-    dictionary_code_hashes,
+    dictionary_lut,
     hash32,
     partition_of,
 )
@@ -136,9 +136,9 @@ def _partition_ids(batch: RelBatch, channels: Sequence[int], n: int):
     lanes, valids = [], []
     for ch in channels:
         col = batch.columns[ch]
-        if col.dictionary is not None and len(col.dictionary) > 0:
-            lut = jnp.asarray(dictionary_code_hashes(col.dictionary.values))
-            lanes.append(canonical_hash_input(col.data, lut))
+        lut = dictionary_lut(col.dictionary)
+        if lut is not None:
+            lanes.append(canonical_hash_input(col.data, jnp.asarray(lut)))
         else:
             lanes.append(canonical_hash_input(col.data))
         valids.append(col.valid_mask())
@@ -261,9 +261,14 @@ class _FragVisitor:
 
     def _visit_RemoteSourceNode(self, node):
         parts = [self.ctx[fid] for fid in node.fragment_ids]
-        if len(parts) == 1:
-            return parts[0]
-        return concat_batches(parts)
+        out = parts[0] if len(parts) == 1 else concat_batches(parts)
+        if node.merge_keys:
+            # a merge-gather consumed mid-mesh arrives as an all_gather
+            # of locally-sorted runs (shard-major, globally unsorted);
+            # restore the global order with a full re-sort (the mesh form
+            # of the MergeOperator)
+            out = self._sorted(out, node.merge_keys)
+        return out
 
     # -- row transforms --
     def _bind(self, e, batch: RelBatch):
@@ -604,7 +609,6 @@ class MeshExecutor:
         root_child_ids = {c.fragment.id for c in root_sp.children}
         repl = self._replicated_map(mesh_sps)
         feeds, feed_args = self._load_scans(mesh_sps)
-        MESH_COUNTERS["queries"] += 1
 
         caps: Dict[str, int] = {}
         for _ in range(12):
@@ -625,6 +629,10 @@ class MeshExecutor:
                 caps[site] *= 2
         else:
             raise RuntimeError("mesh capacity retry limit exceeded")
+        # count only after the program has actually produced results —
+        # a failure above falls back to the page exchange, which must not
+        # register as a mesh-executed query
+        MESH_COUNTERS["queries"] += 1
 
         sources = {}
         for (fid, replicated), batch in zip(out_meta, outs):

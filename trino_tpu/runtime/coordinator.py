@@ -219,16 +219,23 @@ class DistributedQueryRunner:
             # tasks share one host's device mesh: the exchange rides ICI
             # collectives in one SPMD program (parallel/mesh_plan.py);
             # unsupported plan shapes fall back to the page exchange
-            from trino_tpu.parallel.mesh_plan import MeshExecutor
+            from trino_tpu.parallel.mesh_plan import MeshExecutor, MeshUnsupported
 
             try:
                 rows = MeshExecutor(self.catalogs, self.session).execute(subplan)
                 return MaterializedResult(rows, *result_meta)
+            except MeshUnsupported:
+                pass  # expected: plan shape outside the mesh compiler
             except Exception:
-                # MeshUnsupported (plan shape) or any mesh runtime
-                # failure: the page-exchange path below re-executes the
-                # query from scratch, keeping retry_policy semantics
-                pass
+                # unexpected mesh runtime failure: the page-exchange path
+                # below re-executes from scratch (correctness preserved),
+                # but surface the regression instead of hiding it
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "mesh execution failed; falling back to page exchange",
+                    exc_info=True,
+                )
         attempts = (
             1 + self.session.query_retries
             if self.session.retry_policy == "query"
