@@ -58,14 +58,18 @@ class Client:
         query_id = out.get("id", "")
         deadline = time.monotonic() + self.timeout
         while True:
-            if "error" in out:
-                raise QueryError(out["error"].get("message", "query failed"))
-            if out.get("columns"):
-                columns = out["columns"]
+            # transaction headers apply even on FAILED responses: a
+            # failed COMMIT/ROLLBACK still cleared the server-side
+            # transaction, and keeping a dead id would wedge every later
+            # statement on this connection with "unknown transaction"
             if out.get("startedTransactionId"):
                 self.transaction_id = out["startedTransactionId"]
             if out.get("clearedTransactionId"):
                 self.transaction_id = None
+            if "error" in out:
+                raise QueryError(out["error"].get("message", "query failed"))
+            if out.get("columns"):
+                columns = out["columns"]
             rows.extend(out.get("data", ()))
             next_uri = out.get("nextUri")
             if next_uri is None:

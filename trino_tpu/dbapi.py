@@ -218,15 +218,32 @@ class Connection:
             raise InterfaceError("connection is closed")
         return Cursor(self)
 
+    def _clear_txn(self):
+        self._in_txn = False
+        if hasattr(self._client, "transaction_id"):
+            self._client.transaction_id = None
+
+    def _end_txn(self, sql: str):
+        """Issue COMMIT/ROLLBACK. A SERVER-reported failure still prunes
+        the server-side transaction, so local state must clear too or
+        every later statement wedges on a dead id. A TRANSPORT failure
+        (the statement may never have reached the server) keeps local
+        state so the application can retry."""
+        try:
+            self._client.execute(sql)
+        except QueryError:
+            self._clear_txn()
+            raise
+        else:
+            self._clear_txn()
+
     def commit(self):
         if self._in_txn:
-            self._client.execute("COMMIT")
-            self._in_txn = False
+            self._end_txn("COMMIT")
 
     def rollback(self):
         if self._in_txn:
-            self._client.execute("ROLLBACK")
-            self._in_txn = False
+            self._end_txn("ROLLBACK")
 
     def close(self):
         if self._in_txn:

@@ -1337,7 +1337,7 @@ class HashAggregationOperator(Operator):
         # one key sort shared by every argbest kernel (percentile needs
         # its own value pre-ordering and sorts separately)
         shared_order = (
-            G.key_order(tuple(keys), tuple(valids), live)
+            G.key_order(tuple(keys), tuple(valids), live, cap)
             if any(a.kind in ("min_by", "max_by") for a in self._aggs)
             else None
         )

@@ -1124,11 +1124,13 @@ class ExprBinder:
         if isinstance(a.dictionary, RuntimeDictionary) or isinstance(
             b.dictionary, RuntimeDictionary
         ):
-            # same contract as _null_of: plan-time string ops cannot
-            # know an execution-time dictionary (listagg output)
-            self._null_of(
-                a if isinstance(a.dictionary, RuntimeDictionary) else b,
-                T.BOOLEAN,
+            # plan-time string ops cannot know an execution-time
+            # dictionary (listagg output) — fail loudly HERE rather than
+            # rely on _null_of's internal guard; falling through would
+            # compare raw codes across dictionaries and return wrong rows
+            raise NotImplementedError(
+                "string comparison over an execution-time dictionary "
+                "(listagg output) is not supported"
             )
         jf = {
             "eq": lambda x, y: x == y, "ne": lambda x, y: x != y,

@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from trino_tpu.ops.gather import take_clip
-from trino_tpu.ops.hashing import hash32
+from trino_tpu.ops.hashing import hash32, hash64
 
 
 @jax.tree_util.register_pytree_node_class
@@ -224,23 +224,76 @@ def seg_any(gid, flags, weight_mask, capacity):
 # ---------------------------------------------------------------------------
 
 
-def _key_order(keys, valids, mask, order=None):
+def _order_seed(out_capacity: int) -> int:
+    """Hash seed tied to the retry capacity: every overflow-doubling
+    ALSO reseeds, so a detected 62-bit hash collision (p ~ 1e-7 per
+    batch) cannot recur on the rerun."""
+    return out_capacity.bit_length() * 0x9E37
+
+
+_DEAD_ROW_HASH = jnp.iinfo(jnp.int64).max  # above every 62-bit hash
+
+
+def _group_hash(keys, valids, mask, seed: int):
+    """62-bit key-tuple hash (validity folded in: NULL == NULL groups),
+    dead rows forced last."""
+    if keys:
+        h = hash64(list(keys), list(valids), seed=seed)
+    else:
+        h = jnp.zeros(mask.shape[0], dtype=jnp.int64)
+    return jnp.where(mask, h, _DEAD_ROW_HASH)
+
+
+def _key_order(keys, valids, mask, order=None, seed: int = 0):
     """Stable permutation grouping equal key tuples (NULL == NULL),
-    live rows first. LSD-radix chain of single-key stable argsorts —
-    NOT one multi-key lax.sort, whose XLA:TPU compile time explodes
-    with array count x length (3 keys + 10 operands at 16k rows took
-    108s to compile); single-key sorts compile in seconds. An incoming
-    `order` acts as the least-significant pre-ordering (within-group
-    value order for order statistics)."""
+    live rows first. MUST order groups exactly like sort_group_reduce
+    so order-statistic kernels' slots align with its group slots:
+    a single key sorts exactly by (liveness class, order-mapped key);
+    several keys sort by the 62-bit tuple hash (collision probability
+    ~1e-7 per 1M-row batch; sort_group_reduce DETECTS collisions via an
+    independent stream and the reseeding retry re-runs the whole
+    family). An incoming `order` acts as the least-significant
+    pre-ordering (within-group value order for order statistics —
+    stability preserves it)."""
+    from trino_tpu.ops.sort import _order_value
+
     n = mask.shape[0]
     if order is None:
         order = jnp.arange(n, dtype=jnp.int32)
-    for k, v in reversed(list(zip(keys, valids))):
-        kk = jnp.where(v, k, jnp.zeros((), dtype=k.dtype))
-        order = take_clip(order, jnp.argsort(take_clip(kk, order), stable=True))
-        order = take_clip(order, jnp.argsort(take_clip(~v, order), stable=True))
-    order = take_clip(order, jnp.argsort(take_clip(~mask, order), stable=True))
-    return order
+    if len(keys) == 1:
+        k, v = keys[0], valids[0]
+        kb = (
+            _order_value(k, False)
+            if jnp.issubdtype(k.dtype, jnp.floating)
+            else k
+        )
+        kb = jnp.where(v & mask, kb, jnp.zeros((), kb.dtype))
+        cls = jnp.where(mask, jnp.where(v, 0, 1), 2).astype(jnp.int8)
+        order = take_clip(
+            order, jnp.argsort(take_clip(kb, order), stable=True)
+        )
+        return take_clip(
+            order, jnp.argsort(take_clip(cls, order), stable=True)
+        )
+    hm = _group_hash(keys, valids, mask, seed)
+    return take_clip(
+        order, jnp.argsort(take_clip(hm, order), stable=True)
+    )
+
+
+def _hash_collision(boundary, sorted_hash, sorted_mask):
+    """True iff some group boundary falls INSIDE an equal-hash run of
+    live rows — i.e. two distinct key tuples shared a 62-bit hash, so
+    their rows interleave and the segment geometry is wrong. Exact:
+    equal keys always share a hash, so a run containing one key tuple
+    never trips this."""
+    n = boundary.shape[0]
+    first = jnp.arange(n) == 0
+    prev_h = jnp.roll(sorted_hash, 1)
+    prev_m = jnp.roll(sorted_mask, 1)
+    return jnp.any(
+        boundary & ~first & sorted_mask & prev_m & (sorted_hash == prev_h)
+    )
 
 
 def _segment_bounds(sk, sv, sm, n, out_capacity):
@@ -488,6 +541,62 @@ def dense_group_reduce(
     )
 
 
+def _segment_geometry(boundary, n: int, out_capacity: int):
+    """starts/safe_starts/ends/used/n_groups/overflow from boundary
+    flags. Compaction of boundary positions uses top_k when the capacity
+    is small relative to n (the common case — far cheaper than a second
+    full sort), else a full sort."""
+    n_groups = jnp.sum(boundary.astype(jnp.int32)) if n else jnp.int32(0)
+    overflowed = n_groups > out_capacity
+    sidx = jnp.where(boundary, jnp.arange(n, dtype=jnp.int32), jnp.int32(n))
+    if out_capacity * 4 <= n:
+        starts = -jax.lax.top_k(-sidx, out_capacity)[0]
+    else:
+        starts = jnp.sort(sidx)[:out_capacity]
+        if starts.shape[0] < out_capacity:
+            starts = jnp.pad(
+                starts, (0, out_capacity - starts.shape[0]),
+                constant_values=n,
+            )
+    used = starts < n
+    safe_starts = jnp.clip(starts, 0, max(n - 1, 0))
+    next_starts = jnp.concatenate(
+        [starts[1:], jnp.full((1,), n, dtype=starts.dtype)]
+    )
+    ends = jnp.clip(jnp.where(used, next_starts, 1) - 1, 0, max(n - 1, 0))
+    return starts, safe_starts, ends, used, n_groups, overflowed
+
+
+# sorts with more operands than this gather their remaining payloads
+# post-sort instead (XLA:TPU sort compile time grows ~linearly with
+# operand count, ~7s each at 1M rows)
+_MAX_SORT_OPERANDS = 10
+
+
+def _fast_cumsum(x: jnp.ndarray) -> jnp.ndarray:
+    """Inclusive scan via a (tiles, 256) two-level decomposition: the
+    1-D lowering runs log2(n) full passes; the 2-D form does one short
+    lane scan per tile plus a tiny inter-tile scan."""
+    n = x.shape[0]
+    tile = 256
+    if n % tile:
+        return jnp.cumsum(x)
+    x2 = x.reshape(n // tile, tile)
+    intra = jnp.cumsum(x2, axis=1)
+    totals = intra[:, -1]
+    offs = jnp.cumsum(totals) - totals
+    return (intra + offs[:, None]).reshape(-1)
+
+
+def _segment_sums_at(c: jnp.ndarray, ends, used):
+    """Per-segment totals from an inclusive scan: segments tile the live
+    prefix contiguously, so sum(g) = c[end_g] - c[end_{g-1}] — ONE
+    capacity-sized gather + a shifted diff, instead of two gathers."""
+    at_ends = jnp.where(used, take_clip(c, ends), jnp.zeros((), c.dtype))
+    prev = jnp.concatenate([jnp.zeros(1, c.dtype), at_ends[:-1]])
+    return jnp.where(used, at_ends - prev, jnp.zeros((), c.dtype))
+
+
 @partial(jax.jit, static_argnames=("reducers", "out_capacity"))
 def sort_group_reduce(
     keys: Sequence[jnp.ndarray],
@@ -504,43 +613,182 @@ def sort_group_reduce(
     overflowed): group arrays of shape (out_capacity,) dense from 0;
     `results[i]` is reducer i's per-group result; `counts[i]` the number
     of non-null contributions (for SQL empty-group NULL semantics).
-    """
-    n = mask.shape[0]
-    order = _key_order(keys, valids, mask)
-    sm = take_clip(mask, order)
-    sk = [take_clip(k, order) for k in keys]
-    sv = [take_clip(v, order) for v in valids]
-    sorted_values = [take_clip(v, order) for v in values]
-    sorted_vvalids = [
-        None if vv is None else take_clip(vv, order) for vv in value_valids
-    ]
 
-    (boundary, starts, safe_starts, ends, used, n_groups, overflowed) = (
-        _segment_bounds(sk, sv, sm, n, out_capacity)
+    Engine hot path (GroupByHash analogue). ONE multi-operand lax.sort
+    does all the data movement: the grouping key (exact (class, key)
+    for a single key column; the 62-bit tuple hash for several) sorts
+    value columns riding as payload operands, so per-column random
+    gathers — ~10ms per 1M rows on TPU, the old design's dominant cost —
+    disappear. Segment boundaries come from the sorted key itself, and
+    boundary compaction uses top_k instead of a second full sort.
+    """
+    from trino_tpu.ops.sort import _order_value
+
+    n = mask.shape[0]
+    seed = _order_seed(out_capacity)
+    iota = jnp.arange(n, dtype=jnp.int32)
+
+    single_key = len(keys) == 1
+    if single_key:
+        # exact: class (0 valid / 1 NULL / 2 dead) + order-mapped key
+        k, v = keys[0], valids[0]
+        kb = _order_value(k, False) if jnp.issubdtype(
+            k.dtype, jnp.floating
+        ) else k
+        kb = jnp.where(v & mask, kb, jnp.zeros((), kb.dtype))
+        cls = jnp.where(mask, jnp.where(v, 0, 1), 2).astype(jnp.int8)
+        sort_keys = (cls, kb)
+        num_keys = 2
+        extra = []
+    else:
+        # tuple hash; collisions detected via an independent 32-bit
+        # stream riding as payload, resolved by the reseeding retry
+        hm = _group_hash(keys, valids, mask, seed)
+        sort_keys = (hm,)
+        num_keys = 1
+        extra = (
+            [hash32(list(keys), list(valids), seed=seed + 0x7F4A)]
+            if keys
+            else []
+        )
+
+    # payload assembly: row ids, collision stream, then value columns
+    # (+ their validity) until the operand budget forces gathers
+    payloads: List[jnp.ndarray] = [iota] + extra
+    carried: List[Optional[int]] = []  # per value: payload idx or None
+    carried_vv: List[Optional[int]] = []
+    for val, vv, red in zip(values, value_valids, reducers):
+        vi = None
+        if red != "count" and len(sort_keys) + len(payloads) < _MAX_SORT_OPERANDS:
+            vi = len(payloads)
+            payloads.append(val)
+        carried.append(vi)
+        wi = None
+        if vv is not None and len(sort_keys) + len(payloads) < _MAX_SORT_OPERANDS:
+            wi = len(payloads)
+            payloads.append(vv)
+        carried_vv.append(wi)
+    # multi-key: group key columns ride too when budget allows, so the
+    # output extraction reads sorted data at `starts` (one cap-sized
+    # gather) instead of chaining through the row permutation (two)
+    carried_keys: List[Optional[int]] = []
+    carried_kv: List[Optional[int]] = []
+    if not single_key:
+        for k, v in zip(keys, valids):
+            ki = None
+            if len(sort_keys) + len(payloads) < _MAX_SORT_OPERANDS:
+                ki = len(payloads)
+                payloads.append(k)
+            carried_keys.append(ki)
+            kvi = None
+            if len(sort_keys) + len(payloads) < _MAX_SORT_OPERANDS:
+                kvi = len(payloads)
+                payloads.append(v)
+            carried_kv.append(kvi)
+
+    sorted_ops = jax.lax.sort(
+        sort_keys + tuple(payloads), num_keys=num_keys, is_stable=False
     )
-    group_keys = [take_clip(k, safe_starts) for k in sk]
-    group_valids = [take_clip(v, safe_starts) & used for v in sv]
+    order = sorted_ops[num_keys]
+
+    first = iota == 0
+    if single_key:
+        s_cls, s_kb = sorted_ops[0], sorted_ops[1]
+        sm = s_cls < 2
+        changed = (s_cls != jnp.roll(s_cls, 1)) | (s_kb != jnp.roll(s_kb, 1))
+        boundary = sm & (first | changed)
+        collision = jnp.asarray(False)
+    else:
+        hs = sorted_ops[0]
+        sm = hs != _DEAD_ROW_HASH
+        boundary = sm & (first | (hs != jnp.roll(hs, 1)))
+        if extra:
+            h2s = sorted_ops[num_keys + 1]
+            rep = _seg_scan(
+                lambda a, b: a, jnp.uint32(0), boundary, h2s
+            )
+            collision = jnp.any(sm & (h2s != rep))
+        else:
+            collision = jnp.asarray(False)
+
+    starts, safe_starts, ends, used, n_groups, overflowed = (
+        _segment_geometry(boundary, n, out_capacity)
+    )
+    overflowed = overflowed | collision
+
+    def sorted_payload(idx, col):
+        if idx is not None:
+            return sorted_ops[num_keys + idx]
+        return take_clip(col, order)
+
+    # group key columns: read the SORTED key at each segment start —
+    # one capacity-sized gather per column, no permutation chase
+    if single_key:
+        if jnp.issubdtype(keys[0].dtype, jnp.floating):
+            # the sorted operand holds order-mapped BITS; recover the
+            # float through the row permutation instead
+            kvals = take_clip(keys[0], take_clip(order, safe_starts))
+        else:
+            kvals = take_clip(sorted_ops[1], safe_starts)
+        group_keys = [
+            jnp.where(used, kvals, jnp.zeros((), keys[0].dtype))
+        ]
+        group_valids = [
+            (take_clip(sorted_ops[0], safe_starts) == 0) & used
+        ]
+    else:
+        group_keys = []
+        group_valids = []
+        for i, (k, v) in enumerate(zip(keys, valids)):
+            sk_full = sorted_payload(carried_keys[i], k)
+            sv_full = sorted_payload(carried_kv[i], v)
+            group_keys.append(
+                jnp.where(
+                    used, take_clip(sk_full, safe_starts),
+                    jnp.zeros((), k.dtype),
+                )
+            )
+            group_valids.append(take_clip(sv_full, safe_starts) & used)
+
+    # per-segment live-row count straight from the geometry (no scan);
+    # the LAST segment's `ends` extends to n-1 past the dead tail, so
+    # clamp to the final live row
+    n_live = jnp.sum(sm.astype(jnp.int32))
+    seg_rows = jnp.where(
+        used,
+        (jnp.minimum(ends, n_live - 1) - safe_starts + 1).astype(jnp.int64),
+        0,
+    )
 
     results = []
     counts = []
-    for sv_, svv, red in zip(sorted_values, sorted_vvalids, reducers):
+    for i, (val, vv, red) in enumerate(zip(values, value_valids, reducers)):
+        svv = None if vv is None else sorted_payload(carried_vv[i], vv)
+        sv_ = (
+            sorted_payload(carried[i], val)
+            if red != "count"
+            else jnp.zeros(n, dtype=jnp.int64)
+        )
         w = sm if svv is None else (sm & svv)
-        cnt_c = jnp.cumsum(w.astype(jnp.int64))
-        cnt_ex = cnt_c - w.astype(jnp.int64)
-        cnt = take_clip(cnt_c, ends) - take_clip(cnt_ex, safe_starts)
-        counts.append(jnp.where(used, cnt, 0))
+        if svv is None:
+            cnt = seg_rows
+        else:
+            cnt = _segment_sums_at(
+                _fast_cumsum(w.astype(jnp.int64)), ends, used
+            )
+        counts.append(cnt)
         if red in ("sum", "count"):
+            if red == "count":
+                out = cnt
+                results.append(out)
+                continue
             acc_dt = (
                 jnp.float64
                 if jnp.issubdtype(sv_.dtype, jnp.floating)
                 else jnp.int64
             )
             contrib = jnp.where(w, sv_.astype(acc_dt), jnp.zeros((), acc_dt))
-            if red == "count":
-                contrib = w.astype(jnp.int64)
-            c = jnp.cumsum(contrib)
-            ex = c - contrib
-            out = take_clip(c, ends) - take_clip(ex, safe_starts)
+            out = _segment_sums_at(_fast_cumsum(contrib), ends, used)
         elif red in ("min", "max"):
             if jnp.issubdtype(sv_.dtype, jnp.floating):
                 neutral = jnp.inf if red == "min" else -jnp.inf
@@ -582,12 +830,14 @@ def sort_group_reduce(
 # ---------------------------------------------------------------------------
 
 
-@jax.jit
-def key_order(keys, valids, mask):
+@partial(jax.jit, static_argnames=("out_capacity",))
+def key_order(keys, valids, mask, out_capacity: int = 0):
     """Jitted public form of the grouping sort permutation, so callers
     computing several order statistics over the same keys sort ONCE and
-    pass the permutation into each kernel."""
-    return _key_order(keys, valids, mask)
+    pass the permutation into each kernel. `out_capacity` must match the
+    capacity passed to the kernels sharing this order (it seeds the
+    group hash, and slot alignment requires one ordering)."""
+    return _key_order(keys, valids, mask, seed=_order_seed(out_capacity))
 
 
 @partial(jax.jit, static_argnames=("kind", "out_capacity"))
@@ -601,7 +851,9 @@ def grouped_argbest(
     (x_data, x_valid) aligned with sort_group_reduce's group slots."""
     n = mask.shape[0]
     if order is None:
-        order = _key_order(keys, valids, mask)
+        order = _key_order(
+            keys, valids, mask, seed=_order_seed(out_capacity)
+        )
     sm = take_clip(mask, order)
     sk = [take_clip(k, order) for k in keys]
     sv = [take_clip(v, order) for v in valids]
@@ -670,7 +922,9 @@ def grouped_percentile(
     # pre-order: x ascending, NULL x last within each group
     pre = jnp.argsort(_order_value(x, False), stable=True).astype(jnp.int32)
     pre = take_clip(pre, jnp.argsort(take_clip(~xv, pre), stable=True))
-    order = _key_order(keys, valids, mask, order=pre)
+    order = _key_order(
+        keys, valids, mask, order=pre, seed=_order_seed(out_capacity)
+    )
     sm = take_clip(mask, order)
     sk = [take_clip(k, order) for k in keys]
     sv = [take_clip(v, order) for v in valids]
@@ -710,10 +964,15 @@ def grouped_rows_sorted(keys, valids, mask, x, x_valid, out_capacity):
 
     pre = jnp.argsort(_order_value(x, False), stable=True).astype(jnp.int32)
     pre = take_clip(pre, jnp.argsort(take_clip(~xv, pre), stable=True))
-    order = _key_order(keys, valids, mask, order=pre)
+    seed = _order_seed(out_capacity)
+    order = _key_order(keys, valids, mask, order=pre, seed=seed)
     sm = take_clip(mask, order)
     sk = [take_clip(k, order) for k in keys]
     sv = [take_clip(v, order) for v in valids]
+    # no collision overlay here: the caller (_finish_holistic) settles
+    # capacity/seed through sort_group_reduce's detector over the SAME
+    # keys and seed first, which flags exactly the collisions this
+    # ordering could have
     boundary, starts, safe_starts, ends, used, n_groups, overflowed = (
         _segment_bounds(sk, sv, sm, n, out_capacity)
     )

@@ -64,10 +64,13 @@ def hash32(
 def hash64(
     columns: Sequence[jnp.ndarray],
     valids: Optional[Sequence[Optional[jnp.ndarray]]] = None,
+    seed: int = 0,
 ) -> jnp.ndarray:
-    """64-bit hash from two independently-seeded 32-bit streams."""
-    lo = hash32(columns, valids, seed=0)
-    hi = hash32(columns, valids, seed=0x243F6A88)
+    """64-bit hash from two independently-seeded 32-bit streams. `seed`
+    reseeds both streams (the group-by sort path reseeds on retry so a
+    62-bit hash collision cannot recur)."""
+    lo = hash32(columns, valids, seed=seed)
+    hi = hash32(columns, valids, seed=0x243F6A88 + seed)
     # 62-bit mask: leaves headroom above the hash range for the join's
     # NULL-probe / dead-build sentinels AND for the (value << 1) | tag
     # encoding of ops/join.sorted_run_bounds to stay within uint64

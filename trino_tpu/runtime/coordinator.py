@@ -147,13 +147,19 @@ class DistributedQueryRunner:
         n_workers: int = 2,
         hash_partitions: Optional[int] = None,
         worker_handles: Optional[List] = None,
+        access_control=None,
     ):
         """Default topology: N in-process Workers sharing the coordinator
         CatalogManager. Pass `worker_handles` (e.g. HttpWorkerClient
         instances) to schedule over remote workers instead — catalogs
         must then be registered on each worker process separately, as in
-        the reference's per-node catalog loading."""
+        the reference's per-node catalog loading. `access_control` guards
+        distributed Query statements AND the embedded single-node runner
+        (same policy object on both paths)."""
+        from trino_tpu.security import AllowAllAccessControl
+
         self.session = session or Session()
+        self.access_control = access_control or AllowAllAccessControl()
         self.catalogs = CatalogManager()
         if worker_handles is not None:
             self.workers = list(worker_handles)
@@ -178,20 +184,42 @@ class DistributedQueryRunner:
         if getattr(self, "_embedded", None) is None:
             from trino_tpu.engine import LocalQueryRunner
 
-            lqr = LocalQueryRunner(self.session)
+            lqr = LocalQueryRunner(
+                self.session, access_control=self.access_control
+            )
             lqr.catalogs = self.catalogs
             self._embedded = lqr
         return self._embedded
+
+    def _check_access(self, output, identity) -> None:
+        """AccessControl for distributed Query statements (the
+        LocalQueryRunner._check_scans policy applied to the same plan
+        the fragmenter will cut)."""
+        from trino_tpu.security import Identity
+        from trino_tpu.sql.plan import ScanNode
+
+        ident = identity or Identity(self.session.user)
+        self.access_control.check_can_execute_query(ident)
+
+        def walk(node):
+            if isinstance(node, ScanNode):
+                h = node.handle
+                self.access_control.check_can_select(
+                    ident, h.catalog, h.schema, h.table, node.columns
+                )
+            for c in node.children():
+                walk(c)
+
+        walk(output)
 
     # -- entry point --
     def execute(
         self, sql: str, identity=None, transaction_id=None
     ) -> MaterializedResult:
-        # identity is accepted for HTTP-front API parity; per-statement
-        # access control currently runs in the in-process runner only
         stmt = parse(sql)
         if isinstance(stmt, ast.ExplainStatement):
             output = self._analyze(stmt.query)
+            self._check_access(output, identity)
             subplan = plan_distributed(output, self.catalogs)
             return MaterializedResult(
                 [[explain_distributed(subplan)]], ["Query Plan"], [T.VARCHAR]
@@ -206,6 +234,7 @@ class DistributedQueryRunner:
                 transaction_id=transaction_id,
             )
         output = self._analyze(stmt)
+        self._check_access(output, identity)
         subplan = plan_distributed(
             output,
             self.catalogs,

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 import uuid
 from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -66,15 +67,18 @@ tick(); setInterval(tick, 2000);
 
 
 class _QueryJob:
-    def __init__(self, query_id: str, sql: str):
+    def __init__(self, query_id: str, sql: str, user: Optional[str] = None):
         self.query_id = query_id
         self.sql = sql
+        self.user = user
         self.state = "queued"
         self.rows: List[list] = []
         self.columns: List[dict] = []
         self.error: Optional[str] = None
         self.started_transaction_id: Optional[str] = None
         self.cleared_transaction = False
+        self.finished_at: Optional[float] = None  # monotonic, for TTL expiry
+        self.drained = False  # final result page delivered to the client
         self.lock = threading.Lock()
 
     def snapshot(self, token: int):
@@ -162,7 +166,8 @@ class CoordinatorServer:
                 self._json(404, {"error": "no route"})
 
             def do_GET(self):
-                if self._auth() is None:
+                identity = self._auth()
+                if identity is None:
                     return
                 parts = [p for p in self.path.split("/") if p]
                 if (
@@ -181,7 +186,7 @@ class CoordinatorServer:
                     self._json(200, outer.cluster_stats())
                     return
                 if parts == ["v1", "query"]:
-                    self._json(200, outer.query_list())
+                    self._json(200, outer.query_list(identity))
                     return
                 if len(parts) == 2 and parts[0] == "v1" and parts[1] == "info":
                     self._json(200, {"starting": False, "uptime": "n/a"})
@@ -227,24 +232,56 @@ class CoordinatorServer:
             "failed_queries": sum(1 for s in states if s == "failed"),
         }
 
-    def query_list(self) -> list:
-        """QueryResource GET /v1/query analogue."""
+    def query_list(self, identity=None) -> list:
+        """QueryResource GET /v1/query analogue. SQL text and errors are
+        visible only to the query's owner (other users see state-level
+        metadata, the reference's query-details access rule)."""
         out = []
+        user = getattr(identity, "user", None)
         for job in list(self._jobs.values()):
             with job.lock:
+                visible = (
+                    identity is None or job.user is None or job.user == user
+                )
                 out.append(
                     {
                         "id": job.query_id,
                         "state": job.state,
                         "rows": len(job.rows),
-                        "sql": job.sql[:200],
-                        "error": job.error,
+                        "sql": job.sql[:200] if visible else None,
+                        "error": job.error if visible else None,
                     }
                 )
         return out
 
+    # completed-job retention (QueryTracker TTL analogue,
+    # main/execution/QueryTracker.java): evict after TTL or beyond a cap,
+    # oldest first — an unbounded _jobs map leaks in a long-lived server.
+    # The cap only evicts DRAINED jobs (final page delivered); a client
+    # mid-pagination is protected until the TTL, which bounds abandoned
+    # queries regardless.
+    COMPLETED_TTL_S = 300.0
+    MAX_COMPLETED = 200
+
+    def _evict_completed(self) -> None:
+        now = time.monotonic()
+        for qid, j in list(self._jobs.items()):
+            if j.finished_at is not None and now - j.finished_at > self.COMPLETED_TTL_S:
+                self._jobs.pop(qid, None)
+        drained = sorted(
+            (j.finished_at, qid)
+            for qid, j in list(self._jobs.items())
+            if j.finished_at is not None and j.drained
+        )
+        if len(drained) > self.MAX_COMPLETED:
+            for _, qid in drained[: len(drained) - self.MAX_COMPLETED]:
+                self._jobs.pop(qid, None)
+
     def _submit(self, sql: str, identity=None, transaction_id="NONE") -> _QueryJob:
-        job = _QueryJob(uuid.uuid4().hex[:16], sql)
+        self._evict_completed()
+        job = _QueryJob(
+            uuid.uuid4().hex[:16], sql, getattr(identity, "user", None)
+        )
         self._jobs[job.query_id] = job
 
         def run():
@@ -270,10 +307,18 @@ class CoordinatorServer:
                         result, "cleared_transaction", False
                     )
                     job.state = "finished"
+                    job.finished_at = time.monotonic()
             except Exception as e:
                 with job.lock:
                     job.error = str(e)
                     job.state = "failed"
+                    job.finished_at = time.monotonic()
+                    # TransactionManager prunes the transaction even when
+                    # COMMIT/ROLLBACK fail — tell the client its id is
+                    # dead or every later statement wedges on it
+                    head = sql.lstrip().upper()
+                    if head.startswith("COMMIT") or head.startswith("ROLLBACK"):
+                        job.cleared_transaction = True
             finally:
                 if lease is not None:
                     self.resource_groups.release(lease)
@@ -289,6 +334,9 @@ class CoordinatorServer:
         }
         if state == "failed":
             out["error"] = {"message": error}
+            if job.cleared_transaction:
+                out["clearedTransactionId"] = True
+            job.drained = True  # error delivered: cap-evictable
             return out
         if state != "finished":
             out["nextUri"] = f"{self.uri}/v1/statement/executing/{job.query_id}/{token}"
@@ -305,6 +353,8 @@ class CoordinatorServer:
             out["nextUri"] = (
                 f"{self.uri}/v1/statement/executing/{job.query_id}/{next_token}"
             )
+        else:
+            job.drained = True  # final page delivered: cap-evictable
         return out
 
     def stop(self) -> None:

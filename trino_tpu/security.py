@@ -190,13 +190,25 @@ class PasswordAuthenticator(Authenticator):
     hash_password(); plaintext never lives in memory at check time."""
 
     def __init__(self, users: Dict[str, str]):
-        """users: user -> salt$sha256hex (see hash_password)."""
+        """users: user -> pbkdf2$<iters>$<salt>$<hex> (see hash_password;
+        legacy salt$sha256hex entries still verify)."""
         self.users = dict(users)
 
     @staticmethod
-    def hash_password(password: str, salt: str = "trino") -> str:
-        digest = hashlib.sha256((salt + password).encode()).hexdigest()
-        return f"{salt}${digest}"
+    def hash_password(password: str, salt: Optional[str] = None,
+                      iterations: int = 100_000) -> str:
+        """PBKDF2-HMAC-SHA256 with a per-user random salt (the
+        reference's password-file authenticator uses bcrypt/PBKDF2;
+        one unsalted SHA-256 round is brute-forceable and makes equal
+        passwords visibly equal across users)."""
+        import secrets
+
+        if salt is None:
+            salt = secrets.token_hex(16)
+        digest = hashlib.pbkdf2_hmac(
+            "sha256", password.encode(), salt.encode(), iterations
+        ).hex()
+        return f"pbkdf2${iterations}${salt}${digest}"
 
     def authenticate(self, headers) -> Identity:
         auth = headers.get("Authorization", "")
@@ -211,8 +223,15 @@ class PasswordAuthenticator(Authenticator):
         stored = self.users.get(user)
         if stored is None:
             raise AuthenticationError("unknown user")
-        salt, _, digest = stored.partition("$")
-        expect = hashlib.sha256((salt + password).encode()).hexdigest()
+        parts = stored.split("$")
+        if parts[0] == "pbkdf2" and len(parts) == 4:
+            _, iters, salt, digest = parts
+            expect = hashlib.pbkdf2_hmac(
+                "sha256", password.encode(), salt.encode(), int(iters)
+            ).hex()
+        else:  # legacy salt$sha256hex entries
+            salt, _, digest = stored.partition("$")
+            expect = hashlib.sha256((salt + password).encode()).hexdigest()
         if not hmac.compare_digest(expect, digest):
             raise AuthenticationError("bad password")
         return Identity(user)
