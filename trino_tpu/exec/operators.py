@@ -526,7 +526,7 @@ def _window_compute(
     peer_start = part_start | W.segment_starts(peer_inputs, peer_vmasks, n) if peer_inputs else part_start
 
     out_cols = []
-    for kind, arg_ch, out_dt, offset, arg_sf, out_float in functions:
+    for kind, arg_ch, out_dt, offset, arg_sf, out_float, out_sf in functions:
         out_dtype = np.dtype(out_dt)
         if kind == "row_number":
             out_cols.append((W.row_number(part_start).astype(out_dtype), None))
@@ -577,6 +577,11 @@ def _window_compute(
             has = cnt > 0
             if kind == "avg":
                 q = v.astype(jnp.float64) / jnp.maximum(cnt, 1) / arg_sf
+                if out_sf is not None:
+                    # decimal avg: rescale into the output's scaled-int64
+                    # domain, rounding half away (same as _agg_output)
+                    q = q * out_sf
+                    q = jnp.sign(q) * jnp.floor(jnp.abs(q) + 0.5)
                 out_cols.append((q.astype(out_dtype), has))
             elif kind == "sum" and out_float:
                 out_cols.append(((v / arg_sf).astype(out_dtype), has))
@@ -616,13 +621,20 @@ class WindowOperator(Operator):
             # sums). Decimal sum/min/max keep the arg scale unchanged.
             arg_sf = 1
             out_float = s.out_type.is_floating
+            # decimal OUTPUT scale factor: avg over decimal re-scales its
+            # float quotient back into the output's scaled-int64 domain
+            out_sf = (
+                T.decimal_scale_factor(s.out_type)
+                if s.out_type.is_decimal
+                else None
+            )
             if s.arg_channel is not None:
                 arg_t = self._schema[s.arg_channel][0]
                 if arg_t.is_decimal and (s.kind == "avg" or out_float):
                     arg_sf = T.decimal_scale_factor(arg_t)
             fns.append(
                 (s.kind, s.arg_channel, s.out_type.dtype.str, s.offset,
-                 arg_sf, out_float)
+                 arg_sf, out_float, out_sf)
             )
         self._fns = tuple(fns)
 
@@ -861,10 +873,14 @@ def _merge_group_states(states: tuple, reducers: tuple, out_capacity: int):
         values.append(c)
         vvalids.append(None)
         reds.append("sum")
-    gk, gv, used, vals, _, _, ovf = G.sort_group_reduce(
+    gk, gv, used, vals, _, ngroups, ovf = G.sort_group_reduce(
         keys, valids, mask, values, tuple(vvalids), tuple(reds), out_capacity
     )
-    return (tuple(gk), tuple(gv), used, tuple(vals[0::2]), tuple(vals[1::2])), ovf
+    return (
+        (tuple(gk), tuple(gv), used, tuple(vals[0::2]), tuple(vals[1::2])),
+        ngroups,
+        ovf,
+    )
 
 
 @jax.jit
@@ -1150,7 +1166,7 @@ class HashAggregationOperator(Operator):
             self._gstate = self._update(self._gstate, batch)
             return
         while True:
-            gk, gv, used, vals, cnts, _, ovf = _agg_ingest(
+            gk, gv, used, vals, cnts, ngroups, ovf = _agg_ingest(
                 batch, tuple(self._group_channels), tuple(self._aggs),
                 self._cap, self._pre, self._dense_dims, self._mxu_dims,
             )
@@ -1162,7 +1178,12 @@ class HashAggregationOperator(Operator):
                 break
             if not bool(ovf):
                 break
-            self._cap *= 2  # rebuild-at-larger-capacity (tryRehash analogue)
+            # rebuild-at-larger-capacity (tryRehash analogue); the exact
+            # group count is known, so jump straight there — a x2 ladder
+            # would compile one XLA program per rung
+            self._cap = max(
+                self._cap * 2, bucket_capacity(int(ngroups))
+            )
         new = (tuple(gk), tuple(gv), used, tuple(vals), tuple(cnts))
         with self._state_lock:
             self._pending.append(new)
@@ -1180,7 +1201,7 @@ class HashAggregationOperator(Operator):
             return
         reducers = tuple(_MERGE_REDUCER[x.kind] for x in self._aggs)
         while True:
-            merged, ovf = _merge_group_states(
+            merged, ngroups, ovf = _merge_group_states(
                 tuple(states), reducers, self._cap
             )
             if self._static_bound is not None:
@@ -1188,7 +1209,7 @@ class HashAggregationOperator(Operator):
                 break
             if not bool(ovf):
                 break
-            self._cap *= 2
+            self._cap = max(self._cap * 2, bucket_capacity(int(ngroups)))
         self._acc = merged
 
     # -- final step: consume serialized accumulator state --
@@ -1320,13 +1341,13 @@ class HashAggregationOperator(Operator):
 
         cap = self._cap
         while True:
-            gk, gv, used, vals, cnts, _, ovf = G.sort_group_reduce(
+            gk, gv, used, vals, cnts, ngroups, ovf = G.sort_group_reduce(
                 tuple(keys), tuple(valids), live, tuple(values),
                 tuple(vvalids), tuple(reds), cap,
             )
             if not self._group_channels or not bool(ovf):
                 break
-            cap *= 2
+            cap = max(cap * 2, bucket_capacity(int(ngroups)))
         self._cap = cap
 
         agg_cols: Dict[int, Column] = {}
@@ -2019,6 +2040,55 @@ class BufferSource(Operator):
 
     def is_finished(self) -> bool:
         return self._i >= len(self._all())
+
+
+class EnforceSingleRowOperator(Operator):
+    """Scalar-subquery cardinality guard (the reference's
+    EnforceSingleRowOperator): exactly one input row passes through;
+    ZERO rows produce one all-NULL row (the SQL scalar-subquery empty
+    result); more than one raises. The row-count sync happens once at
+    finish — scalar subqueries are tiny by construction."""
+
+    def __init__(self, input_schema: Sequence[Tuple[T.DataType, Optional[Dictionary]]]):
+        self._schema = list(input_schema)
+        self._inputs: List[RelBatch] = []
+        self._out: Optional[RelBatch] = None
+
+    def add_input(self, batch: RelBatch) -> None:
+        self._inputs.append(batch)
+
+    def finish(self) -> None:
+        if self._finishing:
+            return
+        self._finishing = True
+        total = sum(b.row_count() for b in self._inputs)
+        if total > 1:
+            raise RuntimeError("Scalar sub-query has returned multiple rows")
+        if total == 1:
+            merged = concat_batches(self._inputs) if len(self._inputs) > 1 \
+                else self._inputs[0]
+            self._out = merged.compact()
+            self._inputs = []
+            return
+        # zero rows: one all-NULL row
+        cols = [
+            Column(
+                t,
+                jnp.zeros(16, dtype=t.dtype),
+                jnp.zeros(16, dtype=jnp.bool_),
+                d,
+            )
+            for t, d in self._schema
+        ]
+        live = jnp.zeros(16, dtype=jnp.bool_).at[0].set(True)
+        self._out = RelBatch(cols, live)
+
+    def get_output(self) -> Optional[RelBatch]:
+        out, self._out = self._out, None
+        return out
+
+    def is_finished(self) -> bool:
+        return self._finishing and self._out is None
 
 
 class CollectorSink(Operator):

@@ -10,16 +10,18 @@ stdlib stand-in for airlift LZ4; the native C++ serde plugs in behind
 the same two functions).
 
 Framing:  [u8 codec] [u32 raw_len] [body]
-  codec: 0 = raw pickle-v5 body, 1 = zlib-compressed body.
-The body is a pickle of the Page's schema descriptor + numpy buffers —
-protocol 5 keeps the bulk column bytes as contiguous buffers, which is
-what the C++ path mmaps/compresses without copies.
+  codec: 0 = raw body, 1 = zlib-compressed body.
+The body is a SELF-DESCRIBING binary layout (see _encode_body) — typed
+column descriptors + raw numpy buffers. No object deserializer ever
+touches wire bytes: pages arrive over worker HTTP ports, and a pickle
+body there would be remote code execution for anyone who can reach the
+port (the reference's wire is likewise a typed binary layout with
+LZ4+AES, PagesSerdeUtil.java:53 / PagesSerdeFactory.java:24-44).
 """
 
 from __future__ import annotations
 
 import dataclasses
-import pickle
 import struct
 import zlib
 from typing import List, Optional, Sequence, Tuple
@@ -124,16 +126,106 @@ class Page:
         return RelBatch(out, live)
 
 
+# --- self-describing binary page body (no pickle: bytes received from a
+# worker's HTTP port must never reach an object deserializer — the
+# reference's page wire is likewise a typed binary layout,
+# PagesSerdeUtil.java:53). Layout, little-endian:
+#   magic u32 'TPG1' | row_count u32 | width u16
+#   per column:
+#     kind u8 (TypeKind ordinal) | precision i16 (-1 none) | scale i16
+#     dtype_len u8 | dtype ascii  (numpy dtype str, e.g. '<i8')
+#     flags u8 (1 = validity present, 2 = dictionary present)
+#     [dict_count u32 | per value: len u32 + utf8]   (if dictionary)
+#     data_nbytes u64 | raw column bytes
+#     [row_count validity bytes]                     (if validity)
+
+_MAGIC = 0x54504731  # 'TPG1'
+_KINDS = list(T.TypeKind)
+_KIND_ID = {k: i for i, k in enumerate(_KINDS)}
+_COL_HEAD = struct.Struct("<BhhB")
+_U16 = struct.Struct("<H")
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+
+
+def _encode_body(page: Page) -> bytes:
+    out = bytearray()
+    out += _U32.pack(_MAGIC)
+    out += _U32.pack(page.row_count)
+    out += _U16.pack(page.width)
+    for t, col, valid, dvals in zip(
+        page.types, page.columns, page.valids, page.dictionaries
+    ):
+        p = -1 if t.precision is None else int(t.precision)
+        s = -1 if t.scale is None else int(t.scale)
+        out += _COL_HEAD.pack(_KIND_ID[t.kind], p, s, 0)
+        ds = col.dtype.str.encode("ascii")
+        out += bytes([len(ds)]) + ds
+        flags = (1 if valid is not None else 0) | (2 if dvals is not None else 0)
+        out += bytes([flags])
+        if dvals is not None:
+            out += _U32.pack(len(dvals))
+            for v in dvals:
+                vb = v.encode("utf-8")
+                out += _U32.pack(len(vb)) + vb
+        data = col.tobytes()
+        out += _U64.pack(len(data)) + data
+        if valid is not None:
+            out += np.ascontiguousarray(valid, dtype=np.bool_).tobytes()
+    return bytes(out)
+
+
+def _decode_body(body) -> Page:
+    mv = memoryview(body)
+    off = 0
+
+    def take(n):
+        nonlocal off
+        piece = mv[off : off + n]
+        off += n
+        return piece
+
+    (magic,) = _U32.unpack(take(4))
+    if magic != _MAGIC:
+        raise ValueError("bad page magic")
+    (rows,) = _U32.unpack(take(4))
+    (width,) = _U16.unpack(take(2))
+    types: List[T.DataType] = []
+    cols: List[np.ndarray] = []
+    valids: List[Optional[np.ndarray]] = []
+    dicts: List[Optional[Tuple[str, ...]]] = []
+    for _ in range(width):
+        kind_id, p, s, _pad = _COL_HEAD.unpack(take(_COL_HEAD.size))
+        t = T.DataType(
+            _KINDS[kind_id], None if p < 0 else p, None if s < 0 else s
+        )
+        (ds_len,) = take(1)
+        dtype = np.dtype(bytes(take(ds_len)).decode("ascii"))
+        (flags,) = take(1)
+        dvals = None
+        if flags & 2:
+            (n_vals,) = _U32.unpack(take(4))
+            vals = []
+            for _ in range(n_vals):
+                (vl,) = _U32.unpack(take(4))
+                vals.append(bytes(take(vl)).decode("utf-8"))
+            dvals = tuple(vals)
+        (nbytes,) = _U64.unpack(take(8))
+        col = np.frombuffer(take(nbytes), dtype=dtype).copy()
+        if col.shape[0] != rows:
+            raise ValueError("column length does not match row count")
+        valid = None
+        if flags & 1:
+            valid = np.frombuffer(take(rows), dtype=np.bool_).copy()
+        types.append(t)
+        cols.append(col)
+        valids.append(valid)
+        dicts.append(dvals)
+    return Page(types, cols, valids, dicts, rows)
+
+
 def serialize_page(page: Page, compress: Optional[bool] = None) -> bytes:
-    desc = (
-        page.types,
-        page.dictionaries,
-        page.row_count,
-        [c.dtype.str for c in page.columns],
-        [c.tobytes() for c in page.columns],
-        [None if v is None else v.tobytes() for v in page.valids],
-    )
-    body = pickle.dumps(desc, protocol=5)
+    body = _encode_body(page)
     if compress is None:
         compress = len(body) >= COMPRESS_MIN_BYTES
     if compress:
@@ -147,17 +239,9 @@ def deserialize_page(data: bytes) -> Page:
     body = data[_HEADER.size :]
     if codec == 1:
         body = zlib.decompress(body)
-        assert len(body) == raw_len
-    types, dicts, rows, dtypes, col_bufs, valid_bufs = pickle.loads(body)
-    cols = [
-        np.frombuffer(b, dtype=np.dtype(ds)).copy()
-        for ds, b in zip(dtypes, col_bufs)
-    ]
-    valids = [
-        None if b is None else np.frombuffer(b, dtype=bool).copy()
-        for b in valid_bufs
-    ]
-    return Page(list(types), cols, valids, list(dicts), rows)
+        if len(body) != raw_len:
+            raise ValueError("corrupt page frame")
+    return _decode_body(body)
 
 
 def serialize_batch(batch: RelBatch, compress: Optional[bool] = None) -> bytes:

@@ -262,11 +262,12 @@ def _key_order(keys, valids, mask, order=None, seed: int = 0):
         order = jnp.arange(n, dtype=jnp.int32)
     if len(keys) == 1:
         k, v = keys[0], valids[0]
-        kb = (
-            _order_value(k, False)
-            if jnp.issubdtype(k.dtype, jnp.floating)
-            else k
-        )
+        if jnp.issubdtype(k.dtype, jnp.floating):
+            kb = _order_value(
+                jnp.where(k == 0, jnp.zeros((), k.dtype), k), False
+            )
+        else:
+            kb = k
         kb = jnp.where(v & mask, kb, jnp.zeros((), kb.dtype))
         cls = jnp.where(mask, jnp.where(v, 0, 1), 2).astype(jnp.int8)
         order = take_clip(
@@ -281,19 +282,9 @@ def _key_order(keys, valids, mask, order=None, seed: int = 0):
     )
 
 
-def _hash_collision(boundary, sorted_hash, sorted_mask):
-    """True iff some group boundary falls INSIDE an equal-hash run of
-    live rows — i.e. two distinct key tuples shared a 62-bit hash, so
-    their rows interleave and the segment geometry is wrong. Exact:
-    equal keys always share a hash, so a run containing one key tuple
-    never trips this."""
-    n = boundary.shape[0]
-    first = jnp.arange(n) == 0
-    prev_h = jnp.roll(sorted_hash, 1)
-    prev_m = jnp.roll(sorted_mask, 1)
-    return jnp.any(
-        boundary & ~first & sorted_mask & prev_m & (sorted_hash == prev_h)
-    )
+# (collision detection lives inline in sort_group_reduce: an
+# independent 32-bit stream rides the sort and any in-run variation
+# flags the overflow/reseed retry)
 
 
 def _segment_bounds(sk, sv, sm, n, out_capacity):
@@ -631,10 +622,14 @@ def sort_group_reduce(
     single_key = len(keys) == 1
     if single_key:
         # exact: class (0 valid / 1 NULL / 2 dead) + order-mapped key
+        # (-0.0 normalized to +0.0 first: SQL groups them together)
         k, v = keys[0], valids[0]
-        kb = _order_value(k, False) if jnp.issubdtype(
-            k.dtype, jnp.floating
-        ) else k
+        if jnp.issubdtype(k.dtype, jnp.floating):
+            kb = _order_value(
+                jnp.where(k == 0, jnp.zeros((), k.dtype), k), False
+            )
+        else:
+            kb = k
         kb = jnp.where(v & mask, kb, jnp.zeros((), kb.dtype))
         cls = jnp.where(mask, jnp.where(v, 0, 1), 2).astype(jnp.int8)
         sort_keys = (cls, kb)

@@ -27,8 +27,13 @@ def _fmix32(x: jnp.ndarray) -> jnp.ndarray:
 
 
 def _to_lanes(data: jnp.ndarray) -> tuple:
-    """View a column as one or two uint32 lanes (hi lane only for 64-bit)."""
+    """View a column as one or two uint32 lanes (hi lane only for 64-bit).
+    Floats normalize -0.0 to +0.0 first: SQL equality treats them equal,
+    so their hash lanes must match (group-by, join probe, and exchange
+    routing all flow through here)."""
     dt = data.dtype
+    if jnp.issubdtype(dt, jnp.floating):
+        data = jnp.where(data == 0, jnp.zeros((), dt), data)
     if dt in (jnp.int64, jnp.uint64, jnp.float64):
         bits = (
             data.view(jnp.uint64)

@@ -95,7 +95,9 @@ class MeshUnsupported(Exception):
 
 
 def _check_node(n: P.PlanNode) -> None:
-    if isinstance(n, (P.WindowNode, P.UnionAllNode, P.OutputNode)):
+    if isinstance(
+        n, (P.WindowNode, P.UnionAllNode, P.OutputNode, P.EnforceSingleRowNode)
+    ):
         raise MeshUnsupported(type(n).__name__)
     if isinstance(n, P.AggregateNode):
         for a in n.aggs:
@@ -359,11 +361,11 @@ class _FragVisitor:
         live, values, vvalids, reds = self._batch_agg_inputs(aggs, batch)
         site = self._site("agg")
         cap = self.caps.setdefault(site, self._initial_agg_cap(node, batch))
-        gk, gv, used, vals, cnts, _, ovf = G.sort_group_reduce(
+        gk, gv, used, vals, cnts, ngroups, ovf = G.sort_group_reduce(
             tuple(keys), tuple(valids), live, tuple(values), tuple(vvalids),
             tuple(reds), cap,
         )
-        self.flags.append((site, ovf))
+        self.flags.append((site, jnp.where(ovf, ngroups, 0).astype(jnp.int32)))
         cols: List[Column] = []
         for ch, kk, vv in zip(groups, gk, gv):
             c = batch.columns[ch]
@@ -438,11 +440,11 @@ class _FragVisitor:
             reds.append("sum")
         site = self._site("aggf")
         cap = self.caps.setdefault(site, self._initial_agg_cap(node, batch))
-        gk, gv, used, vals, _, _, ovf = G.sort_group_reduce(
+        gk, gv, used, vals, _, ngroups, ovf = G.sort_group_reduce(
             tuple(keys), tuple(valids), live, tuple(values), tuple(vvalids),
             tuple(reds), cap,
         )
-        self.flags.append((site, ovf))
+        self.flags.append((site, jnp.where(ovf, ngroups, 0).astype(jnp.int32)))
         cols: List[Column] = []
         for c_idx, kk, vv in zip(range(k), gk, gv):
             c = batch.columns[c_idx]
@@ -489,7 +491,9 @@ class _FragVisitor:
         lo, counts, total = J.probe_counts(ls, keys, valids, probe.live_mask())
         site = self._site("join")
         out_cap = self.caps.setdefault(site, bucket_capacity(max(probe.capacity, 16)))
-        self.flags.append((site, total > out_cap))
+        self.flags.append(
+            (site, jnp.where(total > out_cap, total, 0).astype(jnp.int32))
+        )
         pi, bi, ok, pairs = _expand_pairs(
             ls, probe, build, keys, valids, lo, counts, out_cap
         )
@@ -520,7 +524,7 @@ class _FragVisitor:
         nb = self.caps.setdefault(site, 16)
         n_l = jnp.sum(probe_c.live_mask().astype(jnp.int32))
         n_r = jnp.sum(build_c.live_mask().astype(jnp.int32))
-        self.flags.append((site, n_r > nb))
+        self.flags.append((site, jnp.where(n_r > nb, n_r, 0).astype(jnp.int32)))
         k = jnp.arange(probe_c.capacity * nb, dtype=jnp.int32)
         pi = k // nb
         bi = k % nb
@@ -621,12 +625,16 @@ class MeshExecutor:
             flags_np = np.asarray(jax.device_get(flags)).reshape(self.n, -1)
             over = flags_np.max(axis=0)
             overflowed = [
-                site for site, o in zip(flag_sites, over) if bool(o)
+                (site, int(o)) for site, o in zip(flag_sites, over) if o
             ]
             if not overflowed:
                 break
-            for site in overflowed:
-                caps[site] *= 2
+            for site, needed in overflowed:
+                # flags carry the exact required size: jump straight
+                # there rather than climbing a x2 retrace ladder
+                caps[site] = max(
+                    caps[site] * 2, bucket_capacity(max(needed, 16))
+                )
         else:
             raise RuntimeError("mesh capacity retry limit exceeded")
         # count only after the program has actually produced results —
@@ -745,7 +753,7 @@ class MeshExecutor:
                 flag_sites.extend(s for s, _ in flags)
                 flag_arr = jnp.stack([f for _, f in flags])
             else:
-                flag_arr = jnp.zeros(1, dtype=jnp.bool_)
+                flag_arr = jnp.zeros(1, dtype=jnp.int32)
             return tuple(outputs), flag_arr
 
         f = shard_map(

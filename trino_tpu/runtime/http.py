@@ -5,8 +5,12 @@ control plane = task create/status/delete (main/server/TaskResource.java:92,
 HttpRemoteTask §3.2), data plane = pull-based binary page streams with
 token/ack semantics (GET /v1/task/{id}/results/{partition}/{token},
 TaskResource.java:321). JSON for control, the serde wire format for
-pages. Task specs travel as pickled fragments (the stand-in for Trino's
-JSON plan codec — both sides are trusted engine processes).
+pages (a typed binary layout — no object deserialization on wire
+bytes). Task specs still travel as pickled fragments (the stand-in for
+Trino's JSON plan codec), which is why internal authentication gates
+EVERY endpoint when a shared secret is configured
+(TRINO_TPU_INTERNAL_SECRET; InternalAuthenticationManager analogue) —
+only authenticated engine peers may post specs.
 
 Endpoints served by WorkerServer:
   POST   /v1/task/{taskId}                     create/update task
@@ -32,6 +36,15 @@ from trino_tpu.exec.serde import Page, deserialize_page, serialize_page
 from trino_tpu.runtime.worker import Worker
 
 _U32 = struct.Struct("<I")
+
+
+def default_internal_secret() -> Optional[str]:
+    """Cluster-wide shared secret for engine-internal HTTP, from the
+    environment (the config.properties internal-communication.shared-secret
+    analogue). None disables internal auth (single-process embedding)."""
+    import os
+
+    return os.environ.get("TRINO_TPU_INTERNAL_SECRET") or None
 
 
 def pack_pages(pages: List[Page]) -> bytes:
@@ -80,8 +93,29 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _authorized(self) -> bool:
+        """Internal-comms gate (InternalAuthenticationManager analogue):
+        when the server carries a shared secret, every request must
+        present a valid X-Trino-Internal-Bearer."""
+        auth = self.server_ref.internal_auth
+        if auth is None:
+            return True
+        from trino_tpu.security import AuthenticationError
+
+        try:
+            auth.verify(self.headers)
+            return True
+        except AuthenticationError as ex:
+            ln = int(self.headers.get("Content-Length", "0") or 0)
+            if ln:
+                self.rfile.read(ln)
+            self._json(401, {"error": f"Unauthorized: {ex}"})
+            return False
+
     # -- routes --
     def do_GET(self):
+        if not self._authorized():
+            return
         parts = [p for p in self.path.split("?")[0].split("/") if p]
         try:
             if parts[:2] == ["v1", "status"]:
@@ -124,6 +158,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(500, {"error": repr(e)})
 
     def do_POST(self):
+        if not self._authorized():
+            return
         parts = [p for p in self.path.split("/") if p]
         try:
             if parts[:2] == ["v1", "task"] and len(parts) == 3:
@@ -140,6 +176,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(500, {"error": repr(e)})
 
     def do_DELETE(self):
+        if not self._authorized():
+            return
         parts = [p for p in self.path.split("/") if p]
         try:
             if parts[:2] == ["v1", "task"] and len(parts) == 3:
@@ -151,6 +189,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(500, {"error": repr(e)})
 
     def do_PUT(self):
+        if not self._authorized():
+            return
         parts = [p for p in self.path.split("/") if p]
         if parts[:2] == ["v1", "shutdown"]:
             # graceful shutdown (GracefulShutdownHandler.java:43): stop
@@ -162,11 +202,21 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class WorkerServer:
-    """HTTP front of one Worker (TrinoServer worker bootstrap analogue)."""
+    """HTTP front of one Worker (TrinoServer worker bootstrap analogue).
+    `internal_secret` turns on shared-secret authentication of every
+    endpoint (InternalAuthenticationManager analogue)."""
 
-    def __init__(self, worker: Worker, port: int = 0):
+    def __init__(self, worker: Worker, port: int = 0,
+                 internal_secret: Optional[str] = "__env__"):
         self.worker = worker
         self.state = "active"
+        self.internal_auth = None
+        if internal_secret == "__env__":
+            internal_secret = default_internal_secret()
+        if internal_secret is not None:
+            from trino_tpu.security import InternalAuthenticator
+
+            self.internal_auth = InternalAuthenticator(internal_secret)
         handler = type("BoundHandler", (_Handler,), {"worker": worker, "server_ref": self})
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.port = self._httpd.server_port
@@ -186,14 +236,25 @@ class HttpWorkerClient:
     ContinuousTaskStatusFetcher collapsed into synchronous calls with
     retry/backoff in RequestErrorTracker style)."""
 
-    def __init__(self, uri: str, timeout: float = 30.0):
+    def __init__(self, uri: str, timeout: float = 30.0,
+                 internal_secret: Optional[str] = "__env__"):
         self.uri = uri.rstrip("/")
         self.timeout = timeout
         self.worker_id = uri
+        self._auth = None
+        if internal_secret == "__env__":
+            internal_secret = default_internal_secret()
+        if internal_secret is not None:
+            from trino_tpu.security import InternalAuthenticator
+
+            self._auth = InternalAuthenticator(internal_secret)
 
     def _req(self, method: str, path: str, body: Optional[bytes] = None):
+        headers = {}
+        if self._auth is not None:
+            headers[self._auth.HEADER] = self._auth.token()
         req = urllib.request.Request(
-            self.uri + path, data=body, method=method
+            self.uri + path, data=body, method=method, headers=headers
         )
         return urllib.request.urlopen(req, timeout=self.timeout)
 

@@ -245,6 +245,35 @@ def _b64url_dec(s: str) -> bytes:
     return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
 
 
+class InternalAuthenticator:
+    """Shared-secret authentication for engine-internal HTTP (the
+    InternalAuthenticationManager analogue): workers and coordinators
+    exchange an HS256 JWT in X-Trino-Internal-Bearer. Tokens are minted
+    short-lived and re-minted on expiry."""
+
+    HEADER = "X-Trino-Internal-Bearer"
+
+    def __init__(self, secret: str):
+        self._jwt = JwtAuthenticator(secret)
+        self._token: Optional[str] = None
+        self._token_exp = 0.0
+
+    def token(self) -> str:
+        now = time.time()
+        if self._token is None or now > self._token_exp - 30:
+            self._token = self._jwt.issue("trino-internal", ttl_seconds=300)
+            self._token_exp = now + 300
+        return self._token
+
+    def verify(self, headers) -> None:
+        """Raises AuthenticationError when the internal bearer is
+        missing or invalid."""
+        tok = headers.get(self.HEADER, "")
+        if not tok:
+            raise AuthenticationError("missing internal bearer")
+        self._jwt.authenticate({"Authorization": f"Bearer {tok}"})
+
+
 class JwtAuthenticator(Authenticator):
     """Bearer JWT with HS256 (the reference's JWT authenticator reduced
     to the shared-secret HMAC form — no external crypto deps)."""

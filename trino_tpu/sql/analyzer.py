@@ -19,13 +19,16 @@ Capabilities mirrored from the reference that shape this file:
   channels; SELECT/HAVING/ORDER BY rewritten over the aggregate output
   (AggregationAnalyzer analogue).
 
-Known deviations (documented, revisit with the type-system hardening):
-- NOT IN (subquery) uses NOT EXISTS (null-unaware) semantics.
-- decimal / decimal division returns DOUBLE.
-- avg() returns DOUBLE for every argument type.
-- an uncorrelated scalar subquery returning ZERO rows drops outer rows
-  (plain cross join) instead of yielding NULL; global-aggregate scalars
-  (the common case) always return one row and are unaffected.
+Known deviations (documented):
+- decimal division/avg scales: physical decimals are scaled int64
+  (precision <= 18), so division yields decimal(18, max(6, s1, s2))
+  instead of Trino's scale = max(6, s1 + p2 + 1) (which requires
+  int128), and magnitudes near 10^(18 - scale) can overflow the
+  64-bit representation.
+Formerly-deviant semantics now implemented faithfully: NULL-aware
+NOT IN (filter + anti join + subquery-NULL-count guard), scalar
+subqueries yielding NULL on zero rows and raising on >1
+(EnforceSingleRowNode), decimal-typed division and avg.
 """
 
 from __future__ import annotations
@@ -192,7 +195,10 @@ def _arith_type(op: str, lt: T.DataType, rt: T.DataType) -> T.DataType:
         sa = lt.scale or 0 if lt.is_decimal else 0
         sb = rt.scale or 0 if rt.is_decimal else 0
         if op == "div":
-            return T.DOUBLE  # documented deviation
+            # decimal quotient (Trino's rule is scale = max(6, s1+p2+1),
+            # which needs int128; with 18-digit physical decimals the
+            # scale caps so the magnitude keeps headroom)
+            return T.decimal(18, max(6, sa, sb))
         if op == "mul":
             return T.decimal(18, min(sa + sb, 12))
         if op == "mod":
@@ -1572,11 +1578,59 @@ class Analyzer:
         value = conj.value
         if not isinstance(value, ast.Identifier):
             raise AnalysisError("IN (subquery) value must be a column")
-        probe_ch = builder.scope.resolve(value.parts)[0]
-        kind = "anti" if conj.negated else "semi"
-        # NOTE: NOT IN uses NOT EXISTS (null-unaware) semantics — see module doc
+        probe_ch, probe_t = builder.scope.resolve(value.parts)
+        if not conj.negated:
+            builder.node = P.JoinNode(
+                "semi", builder.node, node, (probe_ch,), (0,), None,
+                builder.node.fields,
+            )
+            return
+        # NULL-aware NOT IN. `x NOT IN S` is TRUE iff x matches nothing
+        # in S, S contains no NULL (one NULL makes every non-match
+        # UNKNOWN), and x itself is non-NULL — EXCEPT that S being empty
+        # makes the predicate TRUE for every row, NULL x included.
+        # Planned as: anti join (NULL probes survive: they match
+        # nothing) -> cross join with ONE scalar aggregate of S giving
+        # (count(*), count(col)) -> filter
+        # (count(*) = count(col)) AND (x IS NOT NULL OR count(*) = 0).
+        # The shape of Trino's null-aware semi-join rewrite family.
+        # NOTE: the subquery plan `node` appears twice (build side AND
+        # count source), so its subtree executes twice — shared-subtree
+        # materialization (CTE reuse) is the planned fix.
         builder.node = P.JoinNode(
-            kind, builder.node, node, (probe_ch,), (0,), None, builder.node.fields
+            "anti", builder.node, node, (probe_ch,), (0,), None,
+            builder.node.fields,
+        )
+        sub_t = node.fields[0].type
+        counts = P.AggregateNode(
+            node,
+            (),
+            (
+                P.AggCall("count_star", None, T.BIGINT),
+                P.AggCall("count", 0, T.BIGINT),
+            ),
+            (P.Field(None, T.BIGINT), P.Field(None, T.BIGINT)),
+        )
+        total_ch = len(builder.scope)
+        builder.node = P.JoinNode(
+            "cross", builder.node, counts, (), (), None,
+            builder.node.fields + counts.fields,
+        )
+        builder.scope = Scope(
+            builder.scope.fields
+            + [ScopeField(None, None, T.BIGINT), ScopeField(None, None, T.BIGINT)]
+        )
+        total = ir.InputRef(total_ch, T.BIGINT)
+        nonnull = ir.InputRef(total_ch + 1, T.BIGINT)
+        zero = ir.Literal(0, T.BIGINT)
+        builder.filter(
+            ir.and_(
+                ir.comparison("eq", total, nonnull),
+                ir.or_(
+                    ir.not_(ir.is_null(ir.InputRef(probe_ch, probe_t))),
+                    ir.comparison("eq", total, zero),
+                ),
+            )
         )
 
     def _plan_scalar_subquery(self, builder: Builder, sub: ast.ScalarSubquery, ctes) -> None:
@@ -1597,6 +1651,18 @@ class Analyzer:
             node, scope, _ = self.plan_query(q, ctes)
             if len(node.fields) != 1:
                 raise AnalysisError("scalar subquery must return one column")
+            # cardinality guard: zero rows must yield NULL (not drop the
+            # outer rows) and >1 rows must raise — a global aggregate
+            # always returns exactly one row, so it skips the guard
+            probe = node
+            while isinstance(probe, P.ProjectNode):
+                probe = probe.child
+            always_one = (
+                isinstance(probe, P.AggregateNode)
+                and not probe.group_channels
+            )
+            if not always_one:
+                node = P.EnforceSingleRowNode(node, node.fields)
             ch = len(builder.scope)
             t = node.fields[0].type
             builder.node = P.JoinNode(
@@ -2297,7 +2363,10 @@ class Analyzer:
         if kind == "count":
             return T.BIGINT
         if kind == "avg":
-            return T.DOUBLE  # documented deviation
+            # Trino: avg(decimal(p, s)) -> decimal(p, s)
+            if arg_t.is_decimal:
+                return T.decimal(18, arg_t.scale or 0)
+            return T.DOUBLE
         if kind == "sum":
             if arg_t.is_decimal:
                 return T.decimal(18, arg_t.scale or 0)

@@ -138,6 +138,12 @@ class _AddExchanges:
             new_channels.append(mapping[c])
         return out, hash_dist(tuple(new_channels))
 
+    def _EnforceSingleRowNode(self, node):
+        child, dist = self.visit(node.child)
+        if is_distributed(dist):
+            child = _gather(child)
+        return dataclasses.replace(node, child=child), SINGLE
+
     def _SortNode(self, node):
         child, dist = self.visit(node.child)
         if not is_distributed(dist):

@@ -190,6 +190,19 @@ class LimitNode(PlanNode):
 
 
 @dataclasses.dataclass(frozen=True)
+class EnforceSingleRowNode(PlanNode):
+    """Scalar-subquery cardinality guard (EnforceSingleRowOperator
+    analogue): exactly one input row passes through; zero rows yield one
+    all-NULL row; more than one raises at execution."""
+
+    child: PlanNode
+    fields: Tuple[Field, ...]
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
 class UnionAllNode(PlanNode):
     """Concatenation of same-width children (UNION ALL; distinct unions
     get an AggregateNode on top)."""
