@@ -156,11 +156,21 @@ def run_benches() -> dict:
             for c in cols:
                 if c not in cur:
                     cur.append(c)
-    runners = {sf: _make_runner(sf, tables) for sf, tables in by_sf.items()}
+    runners = {}
+    for sf, tables in by_sf.items():
+        print(f"bench: generating sf={sf:g} tables...", file=sys.stderr, flush=True)
+        runners[sf] = _make_runner(sf, tables)
     for name, sf in _configs():
         runs = RUNS if sf <= 1 else max(2, RUNS - 1)
+        print(f"bench: running {name} sf={sf:g}...", file=sys.stderr, flush=True)
+        t0 = time.time()
         out[f"{name}_sf{sf:g}"] = round(
             _median_wall(runners[sf], SQL[name], runs), 4
+        )
+        print(
+            f"bench: {name} sf={sf:g} wall={out[f'{name}_sf{sf:g}']}s "
+            f"(total {time.time()-t0:.0f}s incl. prewarm)",
+            file=sys.stderr, flush=True,
         )
     return out
 

@@ -269,7 +269,10 @@ def test_fragmenter_distributed_sort(catalogs):
 # ---------------------------------------------------------------------------
 
 SF = 0.01
-DIST_QUERIES = [1, 3, 4, 6, 10, 12, 13, 14, 18, 19]
+# ALL 22 TPC-H queries through the page-exchange path at 4 workers
+# (AbstractTestQueries breadth; the mesh plane covers its own subset in
+# tests/test_mesh.py)
+DIST_QUERIES = sorted(QUERIES)
 
 
 @pytest.fixture(scope="module")
@@ -291,7 +294,7 @@ def runner():
     # collective data plane
     r = DistributedQueryRunner(
         Session(catalog="tpch", schema="tiny", mesh_execution=False),
-        n_workers=2, hash_partitions=2,
+        n_workers=4, hash_partitions=4,
     )
     r.register_catalog("tpch", create_tpch_connector())
     return r
@@ -457,6 +460,43 @@ def test_fte_survives_task_failure_after_output(fte_cluster):
     baseline = r.execute(FTE_QUERY).rows
     inj.inject(fragment_id=1, partition=1, attempts=(0, 1), where="mid")
     assert r.execute(FTE_QUERY).rows == baseline
+
+
+FTE_SHAPES = {
+    # each shape exercises a different stage topology under retry:
+    # plain partial->final aggregation; partitioned join + aggregation;
+    # distributed sort with a MERGE-SORTED exchange; semi join + TopN
+    "agg": FTE_QUERY,
+    "join_agg": (
+        "SELECT o_orderpriority, count(*) FROM orders, lineitem"
+        " WHERE o_orderkey = l_orderkey AND l_shipmode = 'MAIL'"
+        " GROUP BY o_orderpriority ORDER BY o_orderpriority"
+    ),
+    "sort_merge": (
+        "SELECT l_orderkey, l_extendedprice FROM lineitem"
+        " WHERE l_suppkey < 3 ORDER BY l_extendedprice DESC, l_orderkey"
+    ),
+    "semi_topn": (
+        "SELECT o_orderkey, o_totalprice FROM orders WHERE o_orderkey IN"
+        " (SELECT l_orderkey FROM lineitem WHERE l_quantity > 49)"
+        " ORDER BY o_totalprice DESC, o_orderkey LIMIT 20"
+    ),
+}
+
+
+@pytest.mark.parametrize("where", ["start", "mid"])
+@pytest.mark.parametrize("shape", sorted(FTE_SHAPES))
+def test_fte_injection_matrix(fte_cluster, shape, where):
+    """Every task's FIRST attempt dies (wildcard rule over all
+    fragments) at `where`; the FTE scheduler must retry each and still
+    produce exact results — including the merge-sorted exchange stage
+    (BaseFailureRecoveryTest.java:78 breadth)."""
+    r, inj = fte_cluster
+    sql = FTE_SHAPES[shape]
+    baseline = r.execute(sql).rows
+    inj.clear()
+    inj.inject(attempts=(0,), where=where)
+    assert r.execute(sql).rows == baseline
 
 
 def test_fte_retries_exhausted(fte_cluster):

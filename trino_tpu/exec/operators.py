@@ -1166,9 +1166,14 @@ class HashAggregationOperator(Operator):
             self._gstate = self._update(self._gstate, batch)
             return
         while True:
+            # a batch can never have more groups than rows, so the
+            # per-batch table caps at the batch capacity regardless of
+            # how large the operator's table has grown (an oversized
+            # per-batch cap multiplies every state array for nothing)
+            cap = min(self._cap, bucket_capacity(batch.capacity))
             gk, gv, used, vals, cnts, ngroups, ovf = _agg_ingest(
                 batch, tuple(self._group_channels), tuple(self._aggs),
-                self._cap, self._pre, self._dense_dims, self._mxu_dims,
+                cap, self._pre, self._dense_dims, self._mxu_dims,
             )
             if self._static_bound is not None:
                 # overflow impossible by the plan-time bound: defer the
@@ -1200,9 +1205,16 @@ class HashAggregationOperator(Operator):
             self._acc = states[0]
             return
         reducers = tuple(_MERGE_REDUCER[x.kind] for x in self._aggs)
+        # distinct groups across N states cannot exceed the concatenated
+        # slot count, so the merge table caps there (bounds the output
+        # arrays by the data, not by a possibly-overgrown _cap)
+        concat_len = sum(int(s[2].shape[0]) for s in states)
         while True:
+            cap = min(
+                max(self._cap, 16), bucket_capacity(max(concat_len, 16))
+            )
             merged, ngroups, ovf = _merge_group_states(
-                tuple(states), reducers, self._cap
+                tuple(states), reducers, cap
             )
             if self._static_bound is not None:
                 self._deferred_ovf.append(ovf)

@@ -8,6 +8,24 @@ int64 which covers TPC-H's decimal(12,2) aggregates). Hot kernels
 TPU VPU runs native-width ops.
 """
 
+import os
+
 import jax
 
 jax.config.update("jax_enable_x64", True)
+
+# Persistent compilation cache: the engine compiles one XLA program per
+# (operator, shape) and TPU compiles are tens of seconds over a
+# tunneled device — caching them on disk makes every process after the
+# first (test runs, bench prewarm, the driver's bench) hit warm
+# executables. Opt out with TRINO_TPU_NO_COMPILE_CACHE=1.
+if os.environ.get("TRINO_TPU_NO_COMPILE_CACHE") != "1":
+    _cache_dir = os.environ.get(
+        "TRINO_TPU_COMPILE_CACHE", os.path.expanduser("~/.trino_tpu_xla_cache")
+    )
+    try:
+        os.makedirs(_cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # cache is an optimization; never fail import over it

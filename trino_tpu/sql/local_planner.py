@@ -111,7 +111,6 @@ class LocalPlanner:
         self.dynamic_filtering = dynamic_filtering
         self.pipelines: List[List[Factory]] = []
         self._next_key = 0
-        self._stats = None  # lazy StatsCalculator (capacity seeding)
 
     # -- public --
     def plan(self, root: P.PlanNode) -> PhysicalPlan:
@@ -238,30 +237,6 @@ class LocalPlanner:
         self._append_fp(chain, fn)
         return chain, [(b.type, b.dictionary) for b in bounds]
 
-    def _estimated_group_capacity(self, node: P.PlanNode) -> int:
-        """Stats-seeded initial group-table capacity (the CBO feeding
-        physical planning, like DeterminePartitionCount does for stages).
-        A good seed avoids overflow retries, each of which compiles a
-        fresh XLA program. Plans with remote sources keep the default —
-        their stats aren't visible from a fragment."""
-        def has_remote(n):
-            return isinstance(n, P.RemoteSourceNode) or any(
-                has_remote(c) for c in n.children()
-            )
-
-        if has_remote(node):
-            return 1024
-        try:
-            from trino_tpu.block import bucket_capacity
-            from trino_tpu.sql.stats import StatsCalculator
-
-            if self._stats is None:
-                self._stats = StatsCalculator(self.catalogs)
-            est = self._stats.stats(node).row_count
-            return min(max(bucket_capacity(int(est * 1.3) + 16), 1024), 1 << 24)
-        except Exception:
-            return 1024
-
     def _visit_AggregateNode(self, node: P.AggregateNode):
         chain, schema = self._visit(node.child)
         if any(a.distinct for a in node.aggs):
@@ -275,14 +250,11 @@ class LocalPlanner:
         groups = list(node.group_channels)
         step = node.step
         pre = self._take_fused(chain)
-        init_cap = (
-            self._estimated_group_capacity(node) if groups else 1024
-        )
         chain.append(
             lambda ctx: HashAggregationOperator(
                 groups, specs, schema, step=step, memory_context=_mem_ctx(ctx),
                 deferred_checks=ctx.setdefault("deferred_checks", []),
-                pre_fn=pre, initial_capacity=init_cap,
+                pre_fn=pre,
             )
         )
         if step == "partial":
