@@ -77,28 +77,61 @@ TABLES: Dict[str, List[Tuple[str, T.DataType]]] = {
     "date_dim": [
         ("d_date_sk", T.BIGINT), ("d_date", T.DATE), ("d_year", T.BIGINT),
         ("d_moy", T.BIGINT), ("d_dom", T.BIGINT), ("d_qoy", T.BIGINT),
+        ("d_week_seq", T.BIGINT), ("d_month_seq", T.BIGINT),
         ("d_day_name", T.VARCHAR)],
+    "time_dim": [
+        ("t_time_sk", T.BIGINT), ("t_hour", T.BIGINT),
+        ("t_minute", T.BIGINT)],
     "item": [
         ("i_item_sk", T.BIGINT), ("i_item_id", T.VARCHAR),
+        ("i_item_desc", T.VARCHAR),
         ("i_brand_id", T.BIGINT), ("i_brand", T.VARCHAR),
+        ("i_class", T.VARCHAR),
         ("i_category_id", T.BIGINT), ("i_category", T.VARCHAR),
-        ("i_manufact_id", T.BIGINT), ("i_current_price", _DEC)],
+        ("i_manufact_id", T.BIGINT), ("i_manager_id", T.BIGINT),
+        ("i_current_price", _DEC)],
     "store": [
         ("s_store_sk", T.BIGINT), ("s_store_id", T.VARCHAR),
-        ("s_store_name", T.VARCHAR), ("s_state", T.VARCHAR)],
+        ("s_store_name", T.VARCHAR), ("s_state", T.VARCHAR),
+        ("s_gmt_offset", _DEC)],
+    "warehouse": [
+        ("w_warehouse_sk", T.BIGINT), ("w_warehouse_name", T.VARCHAR)],
     "customer": [
         ("c_customer_sk", T.BIGINT), ("c_customer_id", T.VARCHAR),
         ("c_first_name", T.VARCHAR), ("c_last_name", T.VARCHAR),
         ("c_birth_year", T.BIGINT)],
+    "customer_demographics": [
+        ("cd_demo_sk", T.BIGINT), ("cd_gender", T.VARCHAR),
+        ("cd_marital_status", T.VARCHAR),
+        ("cd_education_status", T.VARCHAR)],
+    "household_demographics": [
+        ("hd_demo_sk", T.BIGINT), ("hd_buy_potential", T.VARCHAR),
+        ("hd_dep_count", T.BIGINT), ("hd_vehicle_count", T.BIGINT)],
     "promotion": [
         ("p_promo_sk", T.BIGINT), ("p_promo_id", T.VARCHAR),
         ("p_channel_email", T.VARCHAR), ("p_channel_event", T.VARCHAR)],
     "store_sales": [
-        ("ss_sold_date_sk", T.BIGINT), ("ss_item_sk", T.BIGINT),
-        ("ss_customer_sk", T.BIGINT), ("ss_store_sk", T.BIGINT),
+        ("ss_sold_date_sk", T.BIGINT), ("ss_sold_time_sk", T.BIGINT),
+        ("ss_item_sk", T.BIGINT),
+        ("ss_customer_sk", T.BIGINT), ("ss_cdemo_sk", T.BIGINT),
+        ("ss_hdemo_sk", T.BIGINT), ("ss_store_sk", T.BIGINT),
         ("ss_promo_sk", T.BIGINT), ("ss_quantity", T.BIGINT),
+        ("ss_list_price", _DEC), ("ss_coupon_amt", _DEC),
         ("ss_sales_price", _DEC), ("ss_ext_sales_price", _DEC),
         ("ss_net_profit", _DEC)],
+    "catalog_sales": [
+        ("cs_sold_date_sk", T.BIGINT), ("cs_ship_date_sk", T.BIGINT),
+        ("cs_bill_cdemo_sk", T.BIGINT), ("cs_bill_hdemo_sk", T.BIGINT),
+        ("cs_item_sk", T.BIGINT), ("cs_promo_sk", T.BIGINT),
+        ("cs_order_number", T.BIGINT), ("cs_quantity", T.BIGINT),
+        ("cs_list_price", _DEC), ("cs_sales_price", _DEC)],
+    "catalog_returns": [
+        ("cr_item_sk", T.BIGINT), ("cr_order_number", T.BIGINT),
+        ("cr_return_quantity", T.BIGINT)],
+    "inventory": [
+        ("inv_date_sk", T.BIGINT), ("inv_item_sk", T.BIGINT),
+        ("inv_warehouse_sk", T.BIGINT),
+        ("inv_quantity_on_hand", T.BIGINT)],
 }
 
 
@@ -106,14 +139,30 @@ def _scaled(base: int, sf: float) -> int:
     return max(1, int(round(base * sf)))
 
 
+# weekly inventory snapshots (the official generator's cadence)
+INV_WEEKS = DATE_ROWS // 7
+
+
+def _n_warehouses(sf: float) -> int:
+    return max(1, int(round(5 * sf ** 0.5)))
+
+
 def row_count(table: str, sf: float) -> int:
     return {
         "date_dim": DATE_ROWS,
+        "time_dim": 86_400 // 60,  # one row per minute of day
         "item": _scaled(18_000, sf),
         "store": max(1, int(round(12 * sf ** 0.5))),
+        "warehouse": _n_warehouses(sf),
         "customer": _scaled(100_000, sf),
+        "customer_demographics": 1920,  # fixed-size cross of demographics
+        "household_demographics": 720,
         "promotion": _scaled(300, sf),
         "store_sales": _scaled(2_880_000, sf),
+        "catalog_sales": _scaled(1_440_000, sf),
+        "catalog_returns": _scaled(144_000, sf),
+        # weekly snapshot of every (item, warehouse) pair
+        "inventory": _scaled(18_000, sf) * _n_warehouses(sf) * INV_WEEKS,
     }[table]
 
 
@@ -151,6 +200,10 @@ def generate_column(table: str, col: str, sf: float, lo: int, hi: int):
             return DATE_SK0 + keys, None
         if col == "d_date":
             return days.astype(np.int32), None
+        if col == "d_week_seq":
+            # Monday-aligned consecutive week numbers (1970-01-05 was a
+            # Monday, so days-since-epoch+3 is week-stable)
+            return ((days + 3) // 7).astype(np.int64), None
         dates = [(_EPOCH + datetime.timedelta(days=int(x))) for x in days]
         if col == "d_year":
             return np.asarray([d.year for d in dates], dtype=np.int64), None
@@ -160,9 +213,103 @@ def generate_column(table: str, col: str, sf: float, lo: int, hi: int):
             return np.asarray([d.day for d in dates], dtype=np.int64), None
         if col == "d_qoy":
             return np.asarray([(d.month - 1) // 3 + 1 for d in dates], dtype=np.int64), None
+        if col == "d_month_seq":
+            # months since 1900-01 (the official sequence's epoch)
+            return np.asarray(
+                [(d.year - 1900) * 12 + d.month - 1 for d in dates],
+                dtype=np.int64,
+            ), None
         if col == "d_day_name":
             d = Dictionary(DAY_NAMES)
             return d.encode([DAY_NAMES[x.weekday()] for x in dates]), d
+    if table == "time_dim":
+        if col == "t_time_sk":
+            return keys + 1, None
+        if col == "t_hour":
+            return keys // 60, None
+        if col == "t_minute":
+            return keys % 60, None
+    if table == "warehouse":
+        if col == "w_warehouse_sk":
+            return keys + 1, None
+        if col == "w_warehouse_name":
+            d = _name_dict("warehouse", 32)
+            return _uniform(table, col, keys, 0, len(d) - 1).astype(np.int32), d
+    if table == "customer_demographics":
+        if col == "cd_demo_sk":
+            return keys + 1, None
+        if col == "cd_gender":
+            d = Dictionary(["M", "F"])
+            return (keys % 2).astype(np.int32), d
+        if col == "cd_marital_status":
+            vals = ["M", "S", "D", "W", "U"]
+            d = Dictionary(vals)
+            return d.encode([vals[int(k) // 2 % 5] for k in keys]), d
+        if col == "cd_education_status":
+            vals = ["Primary", "Secondary", "College", "2 yr Degree",
+                    "4 yr Degree", "Advanced Degree", "Unknown"]
+            d = Dictionary(vals)
+            return d.encode([vals[int(k) // 10 % 7] for k in keys]), d
+    if table == "household_demographics":
+        if col == "hd_demo_sk":
+            return keys + 1, None
+        if col == "hd_buy_potential":
+            vals = ["0-500", "501-1000", "1001-5000", "5001-10000",
+                    ">10000", "Unknown"]
+            d = Dictionary(vals)
+            return d.encode([vals[int(k) % 6] for k in keys]), d
+        if col == "hd_dep_count":
+            return (keys % 10).astype(np.int64), None
+        if col == "hd_vehicle_count":
+            return (keys % 5).astype(np.int64), None
+    if table == "catalog_sales":
+        if col == "cs_sold_date_sk":
+            return DATE_SK0 + _uniform(table, col, keys, 0, DATE_ROWS - 8), None
+        if col == "cs_ship_date_sk":
+            sold = _uniform(table, "cs_sold_date_sk", keys, 0, DATE_ROWS - 8)
+            lag = _uniform(table, "cs_ship_lag", keys, 1, 7)
+            return DATE_SK0 + sold + lag, None
+        if col == "cs_bill_cdemo_sk":
+            return _uniform(table, col, keys, 1, row_count("customer_demographics", sf)), None
+        if col == "cs_bill_hdemo_sk":
+            return _uniform(table, col, keys, 1, row_count("household_demographics", sf)), None
+        if col == "cs_item_sk":
+            return _uniform(table, col, keys, 1, row_count("item", sf)), None
+        if col == "cs_promo_sk":
+            return _uniform(table, col, keys, 1, row_count("promotion", sf)), None
+        if col == "cs_order_number":
+            return keys // 4 + 1, None  # ~4 lines per order
+        if col == "cs_quantity":
+            return _uniform(table, col, keys, 1, 100), None
+        if col == "cs_list_price":
+            return _uniform(table, col, keys, 100, 30000), None
+        if col == "cs_sales_price":
+            return _uniform(table, col, keys, 10, 30000), None
+    if table == "catalog_returns":
+        # returns reference a deterministic subset of catalog_sales lines
+        sale_rows = row_count("catalog_sales", sf)
+        src = _uniform(table, "cr_source_row", keys, 0, max(sale_rows - 1, 0))
+        if col == "cr_item_sk":
+            return _uniform("catalog_sales", "cs_item_sk", src, 1, row_count("item", sf)), None
+        if col == "cr_order_number":
+            return src // 4 + 1, None
+        if col == "cr_return_quantity":
+            return _uniform(table, col, keys, 1, 20), None
+    if table == "inventory":
+        n_items = row_count("item", sf)
+        n_wh = _n_warehouses(sf)
+        week = keys // (n_items * n_wh)
+        rem = keys % (n_items * n_wh)
+        if col == "inv_date_sk":
+            # Monday of week `week` within the date_dim range
+            first_monday = (7 - ((DATE_START + 3) % 7)) % 7
+            return DATE_SK0 + first_monday + week * 7, None
+        if col == "inv_item_sk":
+            return rem // n_wh + 1, None
+        if col == "inv_warehouse_sk":
+            return rem % n_wh + 1, None
+        if col == "inv_quantity_on_hand":
+            return _uniform(table, col, keys, 0, 1000), None
     if table == "item":
         if col == "i_item_sk":
             return keys + 1, None
@@ -188,6 +335,17 @@ def generate_column(table: str, col: str, sf: float, lo: int, hi: int):
             ), d
         if col == "i_manufact_id":
             return _uniform(table, col, keys, 1, 1000), None
+        if col == "i_manager_id":
+            return _uniform(table, col, keys, 1, 100), None
+        if col == "i_item_desc":
+            d = _name_dict("item_desc", 2000)
+            return _uniform(table, col, keys, 0, len(d) - 1).astype(np.int32), d
+        if col == "i_class":
+            vals = [f"class{j:02d}" for j in range(16)]
+            d = Dictionary(vals)
+            return d.encode(
+                [vals[int(x)] for x in _uniform(table, col, keys, 0, 15)]
+            ), d
         if col == "i_current_price":
             return _uniform(table, col, keys, 99, 9999), None
     if table == "store":
@@ -204,6 +362,8 @@ def generate_column(table: str, col: str, sf: float, lo: int, hi: int):
             return d.encode(
                 [STATES[int(x)] for x in _uniform(table, col, keys, 0, len(STATES) - 1)]
             ), d
+        if col == "s_gmt_offset":
+            return np.full(n, -500, dtype=np.int64), None  # -5.00
     if table == "customer":
         if col == "c_customer_sk":
             return keys + 1, None
@@ -229,16 +389,27 @@ def generate_column(table: str, col: str, sf: float, lo: int, hi: int):
     if table == "store_sales":
         if col == "ss_sold_date_sk":
             return DATE_SK0 + _uniform(table, col, keys, 0, DATE_ROWS - 1), None
+        if col == "ss_sold_time_sk":
+            return _uniform(table, col, keys, 1, row_count("time_dim", sf)), None
         if col == "ss_item_sk":
             return _uniform(table, col, keys, 1, row_count("item", sf)), None
         if col == "ss_customer_sk":
             return _uniform(table, col, keys, 1, row_count("customer", sf)), None
+        if col == "ss_cdemo_sk":
+            return _uniform(table, col, keys, 1, row_count("customer_demographics", sf)), None
+        if col == "ss_hdemo_sk":
+            return _uniform(table, col, keys, 1, row_count("household_demographics", sf)), None
         if col == "ss_store_sk":
             return _uniform(table, col, keys, 1, row_count("store", sf)), None
         if col == "ss_promo_sk":
             return _uniform(table, col, keys, 1, row_count("promotion", sf)), None
         if col == "ss_quantity":
             return _uniform(table, col, keys, 1, 100), None
+        if col == "ss_list_price":
+            return _uniform(table, col, keys, 100, 30000), None
+        if col == "ss_coupon_amt":
+            amt = _uniform(table, col, keys, 0, 5000)
+            return np.where(amt < 4000, 0, amt), None
         if col == "ss_sales_price":
             return _uniform(table, col, keys, 10, 20000), None
         if col == "ss_ext_sales_price":
@@ -299,6 +470,9 @@ class TpcdsMetadata(ConnectorMetadata):
         key_col = {
             "date_dim": "d_date_sk", "item": "i_item_sk", "store": "s_store_sk",
             "customer": "c_customer_sk", "promotion": "p_promo_sk",
+            "warehouse": "w_warehouse_sk", "time_dim": "t_time_sk",
+            "customer_demographics": "cd_demo_sk",
+            "household_demographics": "hd_demo_sk",
         }.get(handle.table)
         if key_col:
             cols[key_col] = (rows, 0.0, 1.0, rows)

@@ -26,7 +26,6 @@ from trino_tpu.exec.operators import Operator, _concat_sort
 from trino_tpu.exec.serde import Page
 from trino_tpu.ops.hashing import (
     canonical_hash_input,
-    dictionary_code_hashes,
     hash32,
     partition_of,
 )
@@ -84,6 +83,48 @@ def split_page(page: Page, pid: np.ndarray, n: int) -> List[Page]:
     return out
 
 
+def hash_split_batch(
+    batch: RelBatch,
+    key_channels: Sequence[int],
+    n: int,
+    lut_cache: Optional[dict] = None,
+) -> List[Page]:
+    """Split a device batch into n wire pages by canonical key hash —
+    the PagePartitioner core, shared by the exchange output operator and
+    the grace-join partitioner (both must route equal keys identically)."""
+    from trino_tpu.ops.hashing import dictionary_lut
+
+    lut_cache = lut_cache if lut_cache is not None else {}
+    keys, valids, luts, has_lut = [], [], [], []
+    for c in key_channels:
+        col = batch.columns[c]
+        lut = None
+        if col.dictionary is not None and len(col.dictionary) > 0:
+            lut = lut_cache.get(col.dictionary.values)
+            if lut is None:
+                lut = jnp.asarray(dictionary_lut(col.dictionary))
+                lut_cache[col.dictionary.values] = lut
+        if lut is not None:
+            luts.append(lut)
+            has_lut.append(True)
+        else:
+            has_lut.append(False)
+        keys.append(col.data)
+        valids.append(col.valid_mask())
+    pid = _partition_ids(
+        tuple(keys), tuple(valids), tuple(luts),
+        batch.live_mask(), n, tuple(has_lut),
+    )
+    page = Page.from_batch(batch)
+    live = (
+        np.asarray(jax.device_get(batch.live)).astype(bool)
+        if batch.live is not None
+        else np.ones(batch.capacity, dtype=bool)
+    )
+    pid_np = np.asarray(jax.device_get(pid))[live]
+    return split_page(page, pid_np, n)
+
+
 class PartitionedOutputOperator(Operator):
     """Terminal sink of every fragment pipeline: splits each output batch
     into the task's OutputBuffer partitions. kind: "single" | "hash" |
@@ -106,46 +147,12 @@ class PartitionedOutputOperator(Operator):
         self._finishing = False
         self._lut_cache: dict = {}
 
-    def _code_hashes(self, dictionary):
-        # keyed by the VALUES tuple, not object identity: per-page
-        # dictionaries die after their batch, and a recycled address must
-        # not serve a stale LUT. Returns None when hashing.dictionary_lut
-        # says codes hash directly (absent/empty dictionary).
-        from trino_tpu.ops.hashing import dictionary_lut
-
-        if dictionary is None or len(dictionary) == 0:
-            return None
-        lut = self._lut_cache.get(dictionary.values)
-        if lut is None:
-            lut = jnp.asarray(dictionary_lut(dictionary))
-            self._lut_cache[dictionary.values] = lut
-        return lut
-
     def add_input(self, batch: RelBatch) -> None:
         if self._kind == "hash" and self._n > 1:
-            keys, valids, luts, has_lut = [], [], [], []
-            for c in self._hash_channels:
-                col = batch.columns[c]
-                keys.append(col.data)
-                valids.append(col.valid_mask())
-                lut = self._code_hashes(col.dictionary)
-                if lut is not None:
-                    luts.append(lut)
-                    has_lut.append(True)
-                else:
-                    has_lut.append(False)
-            pid = _partition_ids(
-                tuple(keys), tuple(valids), tuple(luts),
-                batch.live_mask(), self._n, tuple(has_lut),
+            parts = hash_split_batch(
+                batch, self._hash_channels, self._n, self._lut_cache
             )
-            page = Page.from_batch(batch)
-            live = (
-                np.asarray(jax.device_get(batch.live)).astype(bool)
-                if batch.live is not None
-                else np.ones(batch.capacity, dtype=bool)
-            )
-            pid_np = np.asarray(jax.device_get(pid))[live]
-            for p, part in enumerate(split_page(page, pid_np, self._n)):
+            for p, part in enumerate(parts):
                 if part.row_count:
                     self._buffer.enqueue(p, part)
             return

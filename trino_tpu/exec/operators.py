@@ -1310,7 +1310,11 @@ class HashAggregationOperator(Operator):
                 cols.append(Column(T.BIGINT, cnt[None].astype(jnp.int64), None, None))
             self._out = RelBatch(cols, jnp.ones(1, dtype=jnp.bool_))
             return
-        self._out = self._partial_state_batch()
+        out = self._partial_state_batch()
+        if out.capacity >= _SHRINK_MIN_CAPACITY and self._dense_dims is None \
+                and self._mxu_dims is None:
+            out = _shrink_prefix(out, int(jnp.sum(out.live_mask())))
+        self._out = out
 
     # -- holistic (collect) path: min_by/max_by/approx_percentile --
     def _finish_holistic(self) -> RelBatch:
@@ -1588,7 +1592,13 @@ class HashAggregationOperator(Operator):
         ):
             d = arg_d if a.kind in ("min", "max", "any") else None
             cols.append(Column(a.out_type, data, valid, d))
-        self._out = RelBatch(cols, used)
+        out = RelBatch(cols, used)
+        if out.capacity >= _SHRINK_MIN_CAPACITY and self._dense_dims is None \
+                and self._mxu_dims is None:
+            # sort-path group rows are prefix-dense: hand downstream
+            # operators the live size, not the table capacity
+            out = _shrink_prefix(out, int(jnp.sum(used)))
+        self._out = out
 
     def get_output(self) -> Optional[RelBatch]:
         out, self._out = self._out, None
@@ -1606,7 +1616,9 @@ class HashAggregationOperator(Operator):
 class JoinBridge:
     """Build->probe handoff (PartitionedLookupSourceFactory analogue,
     join/PartitionedLookupSourceFactory.java:56). The planner runs the
-    build pipeline to completion before starting the probe pipeline."""
+    build pipeline to completion before starting the probe pipeline.
+    When the build side spilled (grace mode), `grace` carries the
+    hash-partitioned build pages instead of a device lookup source."""
 
     def __init__(self):
         self.lookup_source: Optional[J.LookupSource] = None
@@ -1615,6 +1627,9 @@ class JoinBridge:
         self.key_dicts: Optional[List[Optional[Dictionary]]] = None
         # build-side key channel indexes (dynamic-filter domains)
         self.build_key_channels: List[int] = []
+        # grace mode: partitioned build spill + schema to rebuild from
+        self.grace = None  # Optional[spill.GracePartitionSpill]
+        self.build_schema: Optional[list] = None
 
 
 @partial(jax.jit, static_argnames=("key_channels",))
@@ -1627,25 +1642,143 @@ def _consolidate_build(parts: Tuple[RelBatch, ...], key_channels: Tuple[int, ...
     return J.build_lookup(keys, valids, merged.live_mask()), merged
 
 
+GRACE_PARTITIONS = 8
+
+# batches whose capacity dwarfs their live count get host-compacted at
+# blocking boundaries: every downstream kernel then compiles at the
+# small shape. XLA:TPU compile time for sort-heavy programs grows
+# brutally with array length (a 15M-row probe compile was measured in
+# HOURS over the tunneled device), so keeping dead capacity out of the
+# sort kernels matters more than the one host round trip.
+_SHRINK_MIN_CAPACITY = 1 << 17
+
+
+def _shrink_prefix(batch: RelBatch, live_count: int) -> RelBatch:
+    """Slice a PREFIX-dense batch (live rows packed from slot 0 — the
+    sort-path aggregation output contract) down to a bucketed capacity."""
+    new_cap = max(bucket_capacity(live_count), 16)
+    if new_cap >= batch.capacity:
+        return batch
+    cols = [
+        Column(
+            c.type,
+            c.data[:new_cap],
+            None if c.valid is None else c.valid[:new_cap],
+            c.dictionary,
+        )
+        for c in batch.columns
+    ]
+    live = None if batch.live is None else batch.live[:new_cap]
+    return RelBatch(cols, live)
+
+
 class HashBuildSink(Operator):
     """Consumes the build side, consolidates, builds the LookupSource
-    (HashBuilderOperator.java:58 — one sort instead of row inserts)."""
+    (HashBuilderOperator.java:58 — one sort instead of row inserts).
+
+    Out-of-core: under memory pressure the revocation protocol flips
+    the sink into GRACE mode (HashBuilderOperator spill states,
+    HashBuilderOperator.java:163-206): accumulated and future batches
+    hash-partition to disk and the probe runs partition-wise."""
 
     def __init__(self, bridge: JoinBridge, key_channels: Sequence[int],
-                 input_schema: Sequence[Tuple[T.DataType, Optional[Dictionary]]]):
+                 input_schema: Sequence[Tuple[T.DataType, Optional[Dictionary]]],
+                 memory_context=None):
         self._bridge = bridge
         self._keys = list(key_channels)
         self._schema = list(input_schema)
         self._inputs: List[RelBatch] = []
+        self._memory = memory_context
+        self._grace = None
+        self._state_lock = _threading.Lock()
+        if self._memory is not None:
+            self._memory.set_revoker(self._revoke_memory)
 
     def add_input(self, batch: RelBatch) -> None:
-        self._inputs.append(batch)
+        with self._state_lock:
+            if self._grace is not None:
+                self._grace.add(batch)
+                return
+            self._inputs.append(batch)
+        self._track_memory()
+
+    def _track_memory(self) -> None:
+        if self._memory is None:
+            return
+        from trino_tpu.runtime.memory import batch_bytes
+
+        with self._state_lock:
+            total = sum(batch_bytes(b) for b in self._inputs)
+        try:
+            self._memory.set_bytes(total)
+        except Exception:
+            if total == 0:
+                raise
+            self._revoke_memory()
+            return
+        # a concurrent revocation may have spilled the inputs between the
+        # snapshot and set_bytes; advertise only what is STILL revocable
+        # (set_bytes cannot run under _state_lock — the pool's victim
+        # callbacks re-enter this operator)
+        with self._state_lock:
+            still = sum(batch_bytes(b) for b in self._inputs)
+        self._memory.set_revocable_bytes(min(total, still))
+
+    def _revoke_memory(self) -> None:
+        """startMemoryRevoke: dump accumulated build rows into the
+        hash-partitioned spill and continue in grace mode."""
+        with self._state_lock:
+            if self._finishing or self._grace is not None and not self._inputs:
+                return
+            if self._grace is None:
+                from trino_tpu.exec.spill import GracePartitionSpill
+
+                self._grace = GracePartitionSpill(
+                    GRACE_PARTITIONS, self._keys
+                )
+            for b in self._inputs:
+                self._grace.add(b)
+            self._inputs = []
+        if self._memory is not None:
+            self._memory.set_bytes(0)
+            self._memory.set_revocable_bytes(0)
 
     def finish(self) -> None:
         if self._finishing:
             return
-        self._finishing = True
-        parts = tuple(self._inputs or [empty_batch(self._schema)])
+        with self._state_lock:
+            self._finishing = True
+            grace, inputs = self._grace, self._inputs
+            self._inputs = []
+        if grace is not None:
+            for b in inputs:
+                grace.add(b)
+            self._bridge.grace = grace
+            self._bridge.build_schema = self._schema
+            self._bridge.build_key_channels = list(self._keys)
+            if self._memory is not None:
+                self._memory.set_bytes(0)
+                self._memory.set_revocable_bytes(0)
+            return
+        parts = tuple(inputs or [empty_batch(self._schema)])
+        total_cap = sum(b.capacity for b in parts)
+        if total_cap >= _SHRINK_MIN_CAPACITY:
+            # sparse build side (e.g. a HAVING-filtered aggregate):
+            # host-compact so the lookup build and every probe compile
+            # at the live size, not the upstream capacity
+            counts = jax.device_get(
+                [jnp.sum(b.live_mask().astype(jnp.int32)) for b in parts]
+            )
+            n_live = int(sum(int(c) for c in counts))
+            target = max(bucket_capacity(n_live), 16)
+            if target * 4 <= total_cap:
+                from trino_tpu.exec.serde import Page as _Page
+                from trino_tpu.exec.serde import concat_pages
+
+                merged_host = concat_pages(
+                    [_Page.from_batch(b) for b in parts]
+                )
+                parts = (merged_host.to_batch(target),)
         ls, merged = _consolidate_build(parts, tuple(self._keys))
         self._bridge.lookup_source = ls
         self._bridge.build_batch = merged
@@ -1653,7 +1786,12 @@ class HashBuildSink(Operator):
             merged.columns[c].dictionary for c in self._keys
         ]
         self._bridge.build_key_channels = list(self._keys)
-        self._inputs = []
+        if self._memory is not None:
+            # the retained build side still occupies its reservation,
+            # but it is NOT revocable anymore (the probe needs it live);
+            # leaving revocable bytes registered would make the pool's
+            # revoke loop pick a victim that can never release
+            self._memory.set_revocable_bytes(0)
 
     def get_output(self) -> Optional[RelBatch]:
         return None
@@ -1750,17 +1888,35 @@ class LookupJoinOperator(Operator):
         )
         self._outputs: List[RelBatch] = []
         self._remap_cache: Dict[tuple, jnp.ndarray] = {}
+        # grace mode: probe rows hash-partition to disk alongside the
+        # spilled build; partitions join pairwise at finish
+        self._probe_spill = None
 
     def needs_input(self) -> bool:
         return not self._outputs and not self._finishing
 
     def add_input(self, probe: RelBatch) -> None:
-        ls = self._bridge.lookup_source
-        build = self._bridge.build_batch
+        if self._bridge.grace is not None:
+            if self._probe_spill is None:
+                from trino_tpu.exec.spill import GracePartitionSpill
+
+                self._probe_spill = GracePartitionSpill(
+                    self._bridge.grace.n, self._keys
+                )
+            self._probe_spill.add(probe)
+            return
+        self._probe_one(
+            self._bridge.lookup_source,
+            self._bridge.build_batch,
+            self._bridge.key_dicts,
+            probe,
+        )
+
+    def _probe_one(self, ls, build, key_dicts, probe: RelBatch) -> None:
         keys = []
         for i, c in enumerate(self._keys):
             col = probe.columns[c]
-            build_dict = self._bridge.key_dicts[i] if self._bridge.key_dicts else None
+            build_dict = key_dicts[i] if key_dicts else None
             if (
                 col.dictionary is not None
                 and build_dict is not None
@@ -1809,6 +1965,47 @@ class LookupJoinOperator(Operator):
             self._outputs.append(_left_unmatched(probe, build, matched))
             return
         raise NotImplementedError(self._type)
+
+    def finish(self) -> None:
+        if self._finishing:
+            return
+        self._finishing = True
+        if self._bridge.grace is None:
+            return
+        # grace probe (PartitionedConsumption analogue): for each hash
+        # partition, rebuild that slice of the build side on device and
+        # probe its probe-side pages — partition-wise correctness holds
+        # because both sides routed by the same canonical key hash
+        grace = self._bridge.grace
+        for p in range(grace.n):
+            build_pages = grace.partition_pages(p)
+            probe_pages = (
+                self._probe_spill.partition_pages(p)
+                if self._probe_spill is not None
+                else []
+            )
+            if not probe_pages:
+                continue
+            parts = tuple(
+                [pg.to_batch() for pg in build_pages]
+                or [empty_batch(self._bridge.build_schema)]
+            )
+            ls, merged = _consolidate_build(
+                parts, tuple(self._bridge.build_key_channels)
+            )
+            key_dicts = [
+                merged.columns[c].dictionary
+                for c in self._bridge.build_key_channels
+            ]
+            for pg in probe_pages:
+                self._probe_one(ls, merged, key_dicts, pg.to_batch())
+        if self._probe_spill is not None:
+            self._probe_spill.close()
+            self._probe_spill = None
+        # the build spill is fully consumed too: release its files (the
+        # probe operator is the bridge's single consumer)
+        grace.close()
+        self._bridge.grace = None
 
     def get_output(self) -> Optional[RelBatch]:
         if self._outputs:
@@ -1868,6 +2065,9 @@ class DynamicFilterOperator(Operator):
 
     def _prepare(self, probe: RelBatch) -> None:
         build = self._bridge.build_batch
+        if build is None:  # grace mode: no device build to read domains from
+            self._active_channels = []
+            return
         key_dicts = self._bridge.key_dicts or [None] * len(self._keys)
         active = []
         for i, c in enumerate(self._keys):

@@ -15,7 +15,7 @@ import tempfile
 from typing import Iterator, List, Optional
 
 from trino_tpu.block import RelBatch
-from trino_tpu.exec.serde import deserialize_page, serialize_batch
+from trino_tpu.exec.serde import Page, deserialize_page, serialize_batch, serialize_page
 
 
 class FileSpiller:
@@ -31,7 +31,12 @@ class FileSpiller:
         self.spilled_bytes = 0
 
     def spill(self, batch: RelBatch) -> None:
-        data = serialize_batch(batch)
+        self._append(serialize_batch(batch))
+
+    def spill_page(self, page: Page) -> None:
+        self._append(serialize_page(page))
+
+    def _append(self, data: bytes) -> None:
         off = self._file.tell()
         self._file.write(data)
         self._offsets.append((off, len(data)))
@@ -43,10 +48,14 @@ class FileSpiller:
 
     def unspill(self) -> Iterator[RelBatch]:
         """Read batches back (merge-on-unspill consumes these)."""
+        for page in self.unspill_pages():
+            yield page.to_batch()
+
+    def unspill_pages(self) -> Iterator[Page]:
         self._file.flush()
         for off, ln in self._offsets:
             self._file.seek(off)
-            yield deserialize_page(self._file.read(ln)).to_batch()
+            yield deserialize_page(self._file.read(ln))
 
     def close(self) -> None:
         try:
@@ -56,3 +65,39 @@ class FileSpiller:
                 os.unlink(self._path)
             except OSError:
                 pass
+
+
+class GracePartitionSpill:
+    """Hash-partitioned spill of one JOIN side (GenericPartitioningSpiller
+    + PartitionedLookupSourceFactory.java:56 analogue): rows route to one
+    of N partition files by canonical key hash — the same routing the
+    exchange uses, so build and probe sides agree — and the join later
+    builds + probes one partition at a time (grace hash join)."""
+
+    def __init__(self, n_partitions: int, key_channels,
+                 spill_dir: Optional[str] = None):
+        self.n = n_partitions
+        self.key_channels = list(key_channels)
+        self._spillers = [
+            FileSpiller(spill_dir) for _ in range(n_partitions)
+        ]
+        self._lut_cache: dict = {}
+        self.spilled_bytes = 0
+
+    def add(self, batch: RelBatch) -> None:
+        from trino_tpu.exec.exchange_ops import hash_split_batch
+
+        pages = hash_split_batch(
+            batch, self.key_channels, self.n, self._lut_cache
+        )
+        for p, page in enumerate(pages):
+            if page.row_count:
+                self._spillers[p].spill_page(page)
+        self.spilled_bytes = sum(s.spilled_bytes for s in self._spillers)
+
+    def partition_pages(self, p: int) -> List[Page]:
+        return list(self._spillers[p].unspill_pages())
+
+    def close(self) -> None:
+        for s in self._spillers:
+            s.close()

@@ -51,16 +51,21 @@ class MemoryPool:
 
     def reserve(self, bytes_: int, for_ctx: Optional[int] = None) -> None:
         """Reserve, revoking others' revocable memory if needed
-        (MemoryRevokingScheduler's revoke-largest-first policy)."""
+        (MemoryRevokingScheduler's revoke-largest-first policy). A victim
+        whose callback does not actually lower its registered revocable
+        bytes is skipped on later rounds — re-picking it would spin
+        forever (a revoke can legitimately no-op, e.g. an operator whose
+        state just became non-spillable)."""
         if self.try_reserve(bytes_):
             return
         # revoke largest revocable contexts until it fits
+        unhelpful: set = set()
         while True:
             with self._lock:
                 candidates = [
                     (cid, rb, cb)
                     for cid, (rb, cb) in self._revocable.items()
-                    if rb > 0 and cid != for_ctx
+                    if rb > 0 and cid != for_ctx and cid not in unhelpful
                 ]
             if not candidates:
                 break
@@ -68,6 +73,10 @@ class MemoryPool:
             cb()  # operator spills and releases its revocable bytes
             if self.try_reserve(bytes_):
                 return
+            with self._lock:
+                rb_after = self._revocable.get(cid, (0, None))[0]
+            if rb_after >= rb:
+                unhelpful.add(cid)
         if self.try_reserve(bytes_):
             return
         raise ExceededMemoryLimitError(

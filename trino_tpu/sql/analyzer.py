@@ -1273,9 +1273,16 @@ class Analyzer:
         left_items: List[RelationItem] = []
         pool: List[ast.Expression] = []
         self._collect_relations(rel.left, left_items, pool, ctes)
-        if len(left_items) != 1 or pool:
-            raise AnalysisError("complex outer-join left side not yet supported")
-        left = left_items[0]
+        if len(left_items) == 1 and not pool:
+            left = left_items[0]
+        else:
+            # composite left side (a join tree feeding the outer join —
+            # the q72 shape): assemble it with the shared greedy-join
+            # machinery, leftovers become pre-join filters
+            lb, leftovers = self._assemble_items(left_items, pool)
+            for c in leftovers:
+                lb.filter(ExprConverter(lb.scope).convert(c))
+            left = RelationItem(lb.node, lb.scope, 1000.0)
         right = self._plan_relation_leaf_any(rel.right, ctes)
         if rel.kind == "right":
             left, right = right, left

@@ -333,7 +333,10 @@ class LocalPlanner:
             return probe_chain, probe_schema + build_schema
         rkeys = list(node.right_keys)
         build_chain.append(
-            lambda ctx: HashBuildSink(bridge_of(ctx), rkeys, build_schema)
+            lambda ctx: HashBuildSink(
+                bridge_of(ctx), rkeys, build_schema,
+                memory_context=_mem_ctx(ctx),
+            )
         )
         self.pipelines.append(build_chain)
         residual_fn = None
