@@ -693,7 +693,9 @@ class AggSpec:
     separator: Optional[str] = None  # listagg
 
 
-HOLISTIC_KINDS = ("min_by", "max_by", "approx_percentile", "listagg")
+HOLISTIC_KINDS = (
+    "min_by", "max_by", "approx_percentile", "listagg", "approx_distinct"
+)
 
 
 def minmax_neutral(dtype, kind: str):
@@ -1394,6 +1396,13 @@ class HashAggregationOperator(Operator):
                     a, keys, valids, live, xcol, cap
                 )
                 continue
+            elif a.kind == "approx_distinct":
+                cnts_d = G.grouped_count_distinct(
+                    tuple(keys), tuple(valids), live,
+                    xcol.data, xcol.valid, cap,
+                )
+                agg_cols[i] = Column(T.BIGINT, cnts_d, None, None)
+                continue
             else:  # approx_percentile
                 data, valid = G.grouped_percentile(
                     tuple(keys), tuple(valids), live,
@@ -1978,14 +1987,14 @@ class LookupJoinOperator(Operator):
         # because both sides routed by the same canonical key hash
         grace = self._bridge.grace
         for p in range(grace.n):
-            build_pages = grace.partition_pages(p)
             probe_pages = (
                 self._probe_spill.partition_pages(p)
                 if self._probe_spill is not None
                 else []
             )
             if not probe_pages:
-                continue
+                continue  # before touching the build spill: no probe rows
+            build_pages = grace.partition_pages(p)
             parts = tuple(
                 [pg.to_batch() for pg in build_pages]
                 or [empty_batch(self._bridge.build_schema)]

@@ -946,6 +946,43 @@ def grouped_percentile(
 
 
 @partial(jax.jit, static_argnames=("out_capacity",))
+def grouped_count_distinct(keys, valids, mask, x, x_valid, out_capacity):
+    """Distinct non-NULL x per group (approx_distinct's contract with
+    error 0 — exact answers satisfy the approximate bound; the
+    mergeable HLL sketch is planned work). Rows pre-order by (valid x
+    first, x ascending) so equal values sit adjacent within each group;
+    a distinct value = a valid row at a group boundary or where x
+    changes. Slots align with sort_group_reduce's group ordering."""
+    from trino_tpu.ops.sort import _order_value
+
+    n = mask.shape[0]
+    xv = jnp.ones(n, dtype=jnp.bool_) if x_valid is None else x_valid
+    xb = (
+        _order_value(x, False)
+        if jnp.issubdtype(x.dtype, jnp.floating)
+        else x
+    )
+    pre = jnp.argsort(xb, stable=True).astype(jnp.int32)
+    pre = take_clip(pre, jnp.argsort(take_clip(~xv, pre), stable=True))
+    order = _key_order(
+        keys, valids, mask, order=pre, seed=_order_seed(out_capacity)
+    )
+    sm = take_clip(mask, order)
+    sk = [take_clip(k, order) for k in keys]
+    sv = [take_clip(v, order) for v in valids]
+    boundary, starts, safe_starts, ends, used, _, _ = _segment_bounds(
+        sk, sv, sm, n, out_capacity
+    )
+    sx = take_clip(xb, order)
+    sxv = take_clip(xv, order) & sm
+    first = jnp.arange(n) == 0
+    flag = sxv & (boundary | first | (sx != jnp.roll(sx, 1)))
+    c = jnp.cumsum(flag.astype(jnp.int64))
+    cnt = take_clip(c, ends) - take_clip(c - flag.astype(jnp.int64), safe_starts)
+    return jnp.where(used, cnt, 0)
+
+
+@partial(jax.jit, static_argnames=("out_capacity",))
 def grouped_rows_sorted(keys, valids, mask, x, x_valid, out_capacity):
     """Rows grouped and value-ordered for HOST-side assembly (listagg:
     building new strings is host work by nature — Trino's

@@ -59,7 +59,6 @@ COMPOSITE_AGG_FUNCS = {
     "skewness", "kurtosis",
     "geometric_mean", "count_if", "bool_and", "bool_or", "every",
     "corr", "covar_pop", "covar_samp", "regr_slope", "regr_intercept",
-    "approx_distinct",
 }
 # Holistic aggregates: need the raw rows (order statistics), so the
 # fragmenter runs them single-step after a gather and the operator
@@ -1797,6 +1796,22 @@ class Analyzer:
                     self._expand_composite_agg(call, conv, add_prim)
                 )
                 continue
+            if kind == "approx_distinct":
+                # exact distinct count through the holistic (gathered)
+                # path: mixable with any other aggregates in one SELECT,
+                # unlike the old lone-DISTINCT rewrite. The optional
+                # max-standard-error argument is accepted and ignored
+                # (exact answers satisfy any error bound).
+                if len(call.args) not in (1, 2) or distinct:
+                    raise AnalysisError(
+                        "approx_distinct(x[, e]) takes one or two arguments"
+                    )
+                x = conv.convert(call.args[0])
+                x_ch = len(pre_exprs)
+                pre_exprs.append(x)
+                aggs.append(P.AggCall("approx_distinct", x_ch, T.BIGINT))
+                per_call.append(("plain", len(aggs) - 1))
+                continue
             if kind in ("min_by", "max_by"):
                 if len(call.args) != 2 or distinct:
                     raise AnalysisError(f"{kind}(x, y) takes two arguments")
@@ -1987,18 +2002,6 @@ class Analyzer:
             return ir.Case(
                 (ir.comparison("lt", v, lit(0)),), (lit(0),), v, T.DOUBLE
             )
-
-        if kind == "approx_distinct":
-            # Exact distinct count satisfies the approximate contract
-            # (error 0 <= the documented 2.3% HLL standard error);
-            # sketch-based cardinality is planned work. Known limit: it
-            # inherits the engine's lone-distinct-aggregate restriction
-            # (local_planner._distinct_agg), so it cannot yet be mixed
-            # with other aggregates in one SELECT.
-            if len(call.args) < 1:
-                raise AnalysisError("approx_distinct() takes an argument")
-            arg = conv.convert(call.args[0])
-            return ("plain", add_prim("count", arg, T.BIGINT, distinct=True))
 
         if kind in ("count_if", "bool_and", "bool_or", "every"):
             if len(call.args) != 1:
