@@ -181,9 +181,13 @@ def run_benches() -> dict:
     return out
 
 
-def probe_gbs(n: int = 8_000_000) -> float:
+def probe_gbs(n: int = 1_000_000) -> float:
     """Hash-probe throughput in GB/s of probe-side key bytes (the
-    BASELINE.json 'hash-probe GB/s per chip' metric)."""
+    BASELINE.json 'hash-probe GB/s per chip' metric). n matches
+    benchmarks/micro.py's join_probe shape so the compile is already
+    cached; the slope-based _measure amortizes dispatch overhead, and
+    the reported number carries its row count in `extra` so readings at
+    different n are not silently compared."""
     import jax.numpy as jnp
     import numpy as np
 
@@ -222,9 +226,19 @@ def _run_one_subprocess(name: str, sf: float, platform_env: dict,
             timeout=timeout_s,
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
+        for line in out.stderr.splitlines():
+            if line.startswith("bench:"):
+                print(line, file=sys.stderr, flush=True)
         return json.loads(out.stdout.strip().splitlines()[-1])[
             f"{name}_sf{sf:g}"
         ]
+    except subprocess.TimeoutExpired as ex:
+        for line in (ex.stderr or "").splitlines():
+            if line.startswith("bench:"):
+                print(line, file=sys.stderr, flush=True)
+        print(f"bench: {name} sf={sf:g} skipped (TimeoutExpired)",
+              file=sys.stderr, flush=True)
+        return None
     except Exception as ex:
         print(f"bench: {name} sf={sf:g} skipped ({type(ex).__name__})",
               file=sys.stderr, flush=True)
@@ -242,7 +256,7 @@ def main() -> None:
     device: dict = {}
     for name, sf in _configs():
         secs = _run_one_subprocess(
-            name, sf, {}, int(os.environ.get("BENCH_CONFIG_TIMEOUT", "4500"))
+            name, sf, {}, int(os.environ.get("BENCH_CONFIG_TIMEOUT", "1800"))
         )
         if secs is not None:
             device[f"{name}_sf{sf:g}"] = secs
@@ -263,7 +277,7 @@ def main() -> None:
             secs = _run_one_subprocess(
                 name, sf,
                 {"JAX_PLATFORMS": "cpu", "BENCH_RUNS": "1"},
-                int(os.environ.get("BENCH_CPU_TIMEOUT", "3600")),
+                int(os.environ.get("BENCH_CPU_TIMEOUT", "1800")),
             )
             if secs is not None:
                 baseline[key] = secs
@@ -275,7 +289,7 @@ def main() -> None:
             extra[k]["cpu_s"] = baseline[k]
             extra[k]["vs_cpu"] = round(baseline[k] / v, 3)
     if gbs is not None:
-        extra["hash_probe"] = {"gb_s": gbs}
+        extra["hash_probe"] = {"gb_s": gbs, "rows": 1_000_000}
 
     if not device:
         # even total failure must emit the driver's one JSON line
@@ -286,13 +300,17 @@ def main() -> None:
             )
         )
         return
-    # headline: the largest completed north-star config
+    # headline: the largest completed north-star config, preferring one
+    # whose CPU baseline actually completed (a missing comparison must
+    # not masquerade as a measured 1.0x)
     order = [f"q18_sf{SF_LARGE:g}", f"q3_sf{SF_LARGE:g}", "q3_sf1", "q1_sf1"]
-    headline = next(
-        (k for k in order if k in device), sorted(device)[0]
-    )
+    with_vs = [k for k in order if k in device and "vs_cpu" in extra[k]]
+    candidates = with_vs or [k for k in order if k in device] or sorted(device)
+    headline = candidates[0]
     value = device[headline]
     vs = extra[headline].get("vs_cpu", 1.0)
+    if "vs_cpu" not in extra[headline]:
+        extra["note"] = "cpu baseline missing for headline; vs_baseline unmeasured"
     print(
         json.dumps(
             {
