@@ -181,7 +181,10 @@ def run_benches() -> dict:
     return out
 
 
-def probe_gbs(n: int = 1_000_000) -> float:
+PROBE_ROWS = 1_000_000
+
+
+def probe_gbs(n: int = PROBE_ROWS) -> float:
     """Hash-probe throughput in GB/s of probe-side key bytes (the
     BASELINE.json 'hash-probe GB/s per chip' metric). n matches
     benchmarks/micro.py's join_probe shape so the compile is already
@@ -289,7 +292,7 @@ def main() -> None:
             extra[k]["cpu_s"] = baseline[k]
             extra[k]["vs_cpu"] = round(baseline[k] / v, 3)
     if gbs is not None:
-        extra["hash_probe"] = {"gb_s": gbs, "rows": 1_000_000}
+        extra["hash_probe"] = {"gb_s": gbs, "rows": PROBE_ROWS}
 
     if not device:
         # even total failure must emit the driver's one JSON line
@@ -311,6 +314,17 @@ def main() -> None:
     vs = extra[headline].get("vs_cpu", 1.0)
     if "vs_cpu" not in extra[headline]:
         extra["note"] = "cpu baseline missing for headline; vs_baseline unmeasured"
+    else:
+        # demotion must be loud: a larger config completed on device but
+        # lost its CPU baseline, so the headline metric name changed
+        passed_over = [
+            k for k in order[: order.index(headline)] if k in device
+        ]
+        if passed_over:
+            extra["note"] = (
+                f"headline demoted to {headline}; completed without cpu "
+                f"baseline: {', '.join(passed_over)}"
+            )
     print(
         json.dumps(
             {

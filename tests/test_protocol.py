@@ -137,3 +137,52 @@ def test_resource_group_selectors():
     assert mgr.stats()["global"][0] == 2
     mgr.release(lease)
     mgr.release(lease2)
+
+# -- query TTL tracking (QueryTracker analogue) --
+
+
+def test_abandoned_query_expires(server):
+    import urllib.request
+
+    # submit directly so we control polling
+    req = urllib.request.Request(
+        f"{server.uri}/v1/statement",
+        data=b"select count(*) from nation",
+        method="POST",
+    )
+    import json as _json
+
+    resp = _json.loads(urllib.request.urlopen(req).read())
+    qid = resp["id"]
+    job = server._jobs[qid]
+    # wait for it to finish but never drain the results
+    for _ in range(100):
+        if job.state == "finished":
+            break
+        time.sleep(0.05)
+    assert job.state == "finished"
+    # simulate client silence past the TTL, then trigger the sweep
+    old = server.CLIENT_TTL_S
+    server.CLIENT_TTL_S = 0.0
+    try:
+        time.sleep(0.01)
+        server._evict_completed()
+    finally:
+        server.CLIENT_TTL_S = old
+    assert job.abandoned and job.state == "failed"
+    assert "abandoned" in job.error
+    assert job.rows == []
+
+
+def test_completed_job_evicted_after_ttl(server):
+    c = Client(server.uri)
+    c.execute("select 1")
+    # every fully-drained job older than the completed TTL is evicted
+    old = server.COMPLETED_TTL_S
+    server.COMPLETED_TTL_S = 0.0
+    try:
+        time.sleep(0.01)
+        server._evict_completed()
+    finally:
+        server.COMPLETED_TTL_S = old
+    assert all(j.finished_at is None for j in server._jobs.values())

@@ -1171,8 +1171,14 @@ class HashAggregationOperator(Operator):
             # a batch can never have more groups than rows, so the
             # per-batch table caps at the batch capacity regardless of
             # how large the operator's table has grown (an oversized
-            # per-batch cap multiplies every state array for nothing)
-            cap = min(self._cap, bucket_capacity(batch.capacity))
+            # per-batch cap multiplies every state array for nothing).
+            # The dense/MXU paths are exempt: they address slots by
+            # mixed-radix position, so the table must hold the FULL
+            # domain even when the batch has fewer rows than slots.
+            if self._dense_dims is not None or self._mxu_dims is not None:
+                cap = self._cap
+            else:
+                cap = min(self._cap, bucket_capacity(batch.capacity))
             gk, gv, used, vals, cnts, ngroups, ovf = _agg_ingest(
                 batch, tuple(self._group_channels), tuple(self._aggs),
                 cap, self._pre, self._dense_dims, self._mxu_dims,

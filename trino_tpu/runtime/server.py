@@ -79,10 +79,13 @@ class _QueryJob:
         self.cleared_transaction = False
         self.finished_at: Optional[float] = None  # monotonic, for TTL expiry
         self.drained = False  # final result page delivered to the client
+        self.abandoned = False
+        self.last_heartbeat = time.monotonic()  # any client poll refreshes
         self.lock = threading.Lock()
 
     def snapshot(self, token: int):
         with self.lock:
+            self.last_heartbeat = time.monotonic()
             return (
                 self.state,
                 self.columns,
@@ -262,12 +265,45 @@ class CoordinatorServer:
     # queries regardless.
     COMPLETED_TTL_S = 300.0
     MAX_COMPLETED = 200
+    # abandoned-query expiry (QueryTracker.failAbandonedQueries analogue,
+    # main/execution/QueryTracker.java + query.client.timeout): a live
+    # query whose client stopped polling fails after this long so it
+    # cannot pin results/resources forever
+    CLIENT_TTL_S = 300.0
 
     def _evict_completed(self) -> None:
         now = time.monotonic()
         for qid, j in list(self._jobs.items()):
-            if j.finished_at is not None and now - j.finished_at > self.COMPLETED_TTL_S:
+            # age from the LATER of finish and last client poll: a client
+            # still paginating keeps refreshing last_heartbeat and must
+            # not lose its remaining pages to the hard pop
+            last_activity = max(
+                j.finished_at or 0.0, j.last_heartbeat
+            )
+            if (
+                j.finished_at is not None
+                and now - last_activity > self.COMPLETED_TTL_S
+            ):
                 self._jobs.pop(qid, None)
+                continue
+            with j.lock:
+                if (
+                    j.finished_at is None
+                    and now - j.last_heartbeat > self.CLIENT_TTL_S
+                ) or (
+                    j.state == "finished"
+                    and not j.drained
+                    and now - j.last_heartbeat > self.CLIENT_TTL_S
+                ):
+                    j.abandoned = True
+                    j.state = "failed"
+                    j.error = (
+                        "Query abandoned: no client heartbeat for "
+                        f"{self.CLIENT_TTL_S:.0f}s"
+                    )
+                    j.rows = []
+                    j.finished_at = now
+                    j.drained = True
         drained = sorted(
             (j.finished_at, qid)
             for qid, j in list(self._jobs.items())
@@ -290,11 +326,16 @@ class CoordinatorServer:
                 if self.resource_groups is not None:
                     # admission queueing (resource-group submit path)
                     lease = self.resource_groups.acquire()
-                job.state = "running"
+                with job.lock:
+                    if job.abandoned:
+                        return  # expired while queued: don't run or revive
+                    job.state = "running"
                 result = self.runner.execute(
                     sql, identity=identity, transaction_id=transaction_id
                 )
                 with job.lock:
+                    if job.abandoned:
+                        return  # expired while executing: keep the verdict
                     job.columns = [
                         {"name": n, "type": str(t)}
                         for n, t in zip(result.column_names, result.column_types)
@@ -310,6 +351,8 @@ class CoordinatorServer:
                     job.finished_at = time.monotonic()
             except Exception as e:
                 with job.lock:
+                    if job.abandoned:
+                        return
                     job.error = str(e)
                     job.state = "failed"
                     job.finished_at = time.monotonic()

@@ -190,8 +190,11 @@ class TaskExecution:
             _raise_deferred_checks(ctx)
             self.state = "finished"
         except BaseException as e:
+            # full traceback, not just the message: TaskInfo failures
+            # travel to the coordinator and are the only evidence a
+            # remote crash leaves behind (TaskStatus.getFailures)
             self.failure = "".join(
-                traceback.format_exception_only(type(e), e)
+                traceback.format_exception(type(e), e, e.__traceback__)
             ).strip()
             self.state = "failed"
             self.buffer.abort()
