@@ -1,0 +1,140 @@
+"""StateMachine listeners/latching (runtime/state_machine.py —
+StateMachine.java:44 analogue) and its task-lifecycle integration."""
+
+import threading
+
+from trino_tpu.runtime.state_machine import (
+    StateMachine,
+    query_state_machine,
+    task_state_machine,
+)
+
+
+def test_transitions_and_listeners():
+    sm = StateMachine("q1", "queued", ("finished", "failed"))
+    seen = []
+    sm.add_listener(seen.append)
+    assert seen == ["queued"]  # immediate fire with current state
+    assert sm.set("running")
+    assert sm.set("finished")
+    assert seen == ["queued", "running", "finished"]
+
+
+def test_terminal_latches():
+    sm = StateMachine("t", "running", ("finished", "failed"))
+    assert sm.set("failed")
+    assert not sm.set("finished")  # terminal latched
+    assert sm.get() == "failed"
+    assert sm.is_terminal()
+
+
+def test_compare_and_set():
+    sm = StateMachine("t", "a", ())
+    assert not sm.compare_and_set("b", "c")
+    assert sm.compare_and_set("a", "b")
+    assert sm.get() == "b"
+
+
+def test_wait_for_unblocks():
+    sm = query_state_machine("q")
+    done = []
+
+    def waiter():
+        done.append(sm.wait_for(lambda s: s == "finished", timeout=5))
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    sm.set("running")
+    sm.set("finished")
+    t.join(5)
+    assert done == ["finished"]
+
+
+def test_wait_for_timeout():
+    sm = StateMachine("t", "a", ())
+    assert sm.wait_for(lambda s: s == "never", timeout=0.05) == "a"
+
+
+def test_listener_may_reenter():
+    # listeners fire outside the lock: re-entrant calls must not deadlock
+    sm = StateMachine("t", "a", ("z",))
+    calls = []
+
+    def listener(s):
+        calls.append(s)
+        if s == "b":
+            sm.set("z")
+
+    sm.add_listener(listener)
+    sm.set("b")
+    assert sm.get() == "z"
+    assert calls == ["a", "b", "z"]
+
+
+def test_task_execution_uses_state_machine():
+    from trino_tpu.runtime.state_machine import TASK_TERMINAL
+    from trino_tpu.runtime.task import TaskExecution, TaskId, TaskSpec
+    from trino_tpu.sql.fragmenter import PlanFragment
+    from trino_tpu.sql.plan import Field, ValuesNode
+    from trino_tpu import types as T
+
+    node = ValuesNode((Field("a", T.BIGINT),), ((1,), (2,)))
+    frag = PlanFragment(0, node, "single", "single")
+    spec = TaskSpec(
+        task_id=TaskId("q0", 0, 0),
+        fragment=frag,
+        n_output_partitions=1,
+        remote_schemas={},
+        scan_slice=None,
+        input_locations={},
+    )
+    t = TaskExecution(spec, None)
+    states = []
+    t.add_state_listener(states.append)
+    t.start()
+    t.join(10)
+    assert t.state == "finished"
+    assert states[0] == "planned" and states[-1] in TASK_TERMINAL
+    # terminal latch: abort after finish keeps the verdict
+    t.abort()
+    assert t.state == "finished"
+
+
+# -- metrics registry (runtime/metrics.py, JMX surface analogue) --
+
+
+def test_metrics_registry():
+    from trino_tpu.runtime.metrics import MetricsRegistry
+
+    m = MetricsRegistry()
+    m.increment("a")
+    m.increment("a", 2)
+    m.register_gauge("g", lambda: 7.5)
+    m.register_gauge("bad", lambda: 1 / 0)  # must not poison snapshots
+    snap = m.snapshot()
+    assert snap["a"] == 3.0 and snap["g"] == 7.5 and "bad" not in snap
+
+
+def test_metrics_endpoint():
+    import json
+    import urllib.request
+
+    from trino_tpu.connectors.tpch import create_tpch_connector
+    from trino_tpu.engine import LocalQueryRunner, Session
+    from trino_tpu.runtime.metrics import METRICS
+    from trino_tpu.runtime.server import CoordinatorServer
+    from trino_tpu.client import Client
+
+    lq = LocalQueryRunner(Session(catalog="tpch", schema="tiny"))
+    lq.register_catalog("tpch", create_tpch_connector())
+    srv = CoordinatorServer(lq)
+    try:
+        before = METRICS.counter("queries.finished")
+        Client(srv.uri).execute("select 1")
+        snap = json.loads(
+            urllib.request.urlopen(f"{srv.uri}/v1/metrics").read()
+        )
+        assert snap["queries.submitted"] >= 1
+        assert snap["queries.finished"] >= before + 1
+    finally:
+        srv.stop()

@@ -164,9 +164,12 @@ QUERIES = {
       and d1.d_year = 1999
       and cd_marital_status = 'D'
     group by i_item_desc, w_warehouse_name, d1.d_week_seq
-    order by total_cnt desc, i_item_desc, w_warehouse_name, d_week_seq
+    order by total_cnt desc, i_item_desc, w_warehouse_name, d1.d_week_seq
     limit 100
     """,
+    # ^ spec text says bare `d_week_seq`, which the standard resolves to
+    # the OUTPUT column; sqlite (the oracle) instead reports ambiguity
+    # against d1/d2/d3, so the template qualifies it — same plan shape
     "q82": """
     select i_item_id, i_item_desc, i_current_price
     from item, inventory, date_dim, store_sales

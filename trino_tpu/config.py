@@ -83,6 +83,11 @@ for _name, _type, _default, _desc, _allowed in [
      "max estimated build rows for a broadcast join", None),
     ("mesh_execution", bool, True,
      "run colocated fragments over the device-mesh collective exchange", None),
+    ("enable_optimizer", bool, True,
+     "run the iterative plan-optimizer pipeline", None),
+    ("join_reordering_strategy", str, "automatic",
+     "cost-based join reordering: automatic | none",
+     ("automatic", "none")),
 ]:
     SYSTEM_PROPERTIES.register(_name, _type, _default, _desc, _allowed)
 

@@ -50,6 +50,10 @@ class Session:
     # (parallel/mesh_plan.py); ineligible plans and cross-host/FTE
     # topologies fall back to the HTTP page exchange
     mesh_execution: bool = True
+    # optimizer (sql/optimizer.py): the iterative rule pipeline and the
+    # cost-based join reorderer (JOIN_REORDERING_STRATEGY analogue)
+    enable_optimizer: bool = True
+    join_reordering_strategy: str = "automatic"
 
     def set_property(self, name: str, value) -> None:
         """SET SESSION entry point — validated through the typed
@@ -298,6 +302,25 @@ class LocalQueryRunner:
                 ["Name", "Value", "Default", "Description"],
                 [T.VARCHAR] * 4,
             )
+        if isinstance(stmt, ast.ShowFunctions):
+            from trino_tpu.expr.registry import REGISTRY
+
+            rows = []
+            for m in REGISTRY.all():
+                arity = (
+                    str(m.min_arity)
+                    if m.max_arity == m.min_arity
+                    else f"{m.min_arity}..{m.max_arity or 'N'}"
+                )
+                rows.append(
+                    [m.name, m.returns, arity, m.category, m.description]
+                )
+            return MaterializedResult(
+                rows,
+                ["Function", "Return Type", "Arity", "Function Type",
+                 "Description"],
+                [T.VARCHAR] * 5,
+            )
         if isinstance(stmt, ast.ShowSchemas):
             cat = stmt.catalog or self.session.catalog
             conn = self.catalogs.get(cat)
@@ -328,8 +351,10 @@ class LocalQueryRunner:
         raise AnalysisError(f"cannot execute {type(stmt).__name__}")
 
     def _analyze(self, q: ast.Query) -> OutputNode:
+        from trino_tpu.sql.optimizer import optimize
+
         analyzer = Analyzer(self.catalogs, self.session.catalog, self.session.schema)
-        return analyzer.plan(q)
+        return optimize(analyzer.plan(q), self.catalogs, self.session)
 
     def _invalidate_plans(self) -> None:
         """Cached physical plans capture split lists (data snapshots) at

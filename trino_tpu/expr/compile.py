@@ -889,7 +889,280 @@ class ExprBinder:
                 days = F.last_day_of_month_days(self._to_days(a, d))
                 return days.astype(T.DATE.dtype), v
             return Bound(T.DATE, ldfn)
+        if name == "year_of_week":
+            a = args[0]
+            def yowfn(cols, valids):
+                d, v = a.fn(cols, valids)
+                return (
+                    F.year_of_week(self._to_days(a, d)).astype(jnp.int64),
+                    v,
+                )
+            return Bound(T.BIGINT, yowfn)
+        bound = self._bind_registry_scalar(name, e, args)
+        if bound is not None:
+            return bound
         raise NotImplementedError(f"scalar function {name}")
+
+    # -- registry-resolved breadth (expr/registry.py): hashing/encoding,
+    # URL, JSON, string distances. All dictionary-wise: the python body
+    # runs over |dict| values on host, codes remap on device (the
+    # DictionaryAwarePageProjection discipline — per-row host work never
+    # happens) --
+    def _bind_registry_scalar(self, name, e, args):
+        import base64 as _b64
+        import hashlib as _hashlib
+        import zlib as _zlib
+
+        if name in ("md5", "sha1", "sha256"):
+            return self._bind_dict_transform(
+                args[0], e,
+                lambda s, algo=name: _hashlib.new(algo, s.encode()).hexdigest(),
+            )
+        if name == "crc32":
+            return self._bind_dict_table(
+                args[0], T.BIGINT,
+                lambda s: _zlib.crc32(s.encode()), jnp.int64,
+            )
+        if name == "to_hex":
+            return self._bind_dict_transform(
+                args[0], e, lambda s: s.encode().hex().upper()
+            )
+        if name == "from_hex":
+            return self._bind_dict_transform(
+                args[0], e,
+                lambda s: bytes.fromhex(s).decode("utf-8", "replace"),
+            )
+        if name == "to_base64":
+            return self._bind_dict_transform(
+                args[0], e, lambda s: _b64.b64encode(s.encode()).decode()
+            )
+        if name == "from_base64":
+            return self._bind_dict_transform(
+                args[0], e,
+                lambda s: _b64.b64decode(s.encode()).decode("utf-8", "replace"),
+            )
+        if name in ("levenshtein_distance", "hamming_distance"):
+            other = e.args[1]
+            assert isinstance(other, Literal), (
+                f"{name}() second argument must be a constant"
+            )
+            t = other.value
+
+            def _lev(s, t=t):
+                if len(s) < len(t):
+                    s, t = t, s
+                prev = list(range(len(t) + 1))
+                for i, cs in enumerate(s):
+                    cur = [i + 1]
+                    for j, ct in enumerate(t):
+                        cur.append(min(
+                            prev[j + 1] + 1, cur[j] + 1,
+                            prev[j] + (cs != ct),
+                        ))
+                    prev = cur
+                return prev[-1]
+
+            def _ham(s, t=t):
+                if len(s) != len(t):
+                    raise ValueError(
+                        "hamming_distance: strings must be the same length"
+                    )
+                return sum(a != b for a, b in zip(s, t))
+
+            fn = _lev if name == "levenshtein_distance" else _ham
+            return self._bind_dict_table(args[0], T.BIGINT, fn, jnp.int64)
+        if name.startswith("url_"):
+            return self._bind_url_fn(name, e, args)
+        if name in ("json_extract_scalar", "json_array_length", "json_size"):
+            return self._bind_json_fn(name, e, args)
+        if name == "from_iso8601_date":
+            import datetime as _dt
+
+            return self._bind_dict_table(
+                args[0], T.DATE,
+                lambda s: (_dt.date.fromisoformat(s)
+                           - _dt.date(1970, 1, 1)).days,
+                T.DATE.dtype,
+            )
+        return None
+
+    def _bind_url_fn(self, name, e, args):
+        from urllib.parse import quote, unquote, urlsplit
+
+        if name == "url_encode":
+            return self._bind_dict_transform(
+                args[0], e, lambda s: quote(s, safe="")
+            )
+        if name == "url_decode":
+            return self._bind_dict_transform(args[0], e, unquote)
+
+        def part(s, name=name):
+            try:
+                u = urlsplit(s)
+                if name == "url_extract_port":
+                    return u.port  # raises ValueError on ':abc' ports
+            except ValueError:
+                return None
+            if name == "url_extract_protocol":
+                return u.scheme or None
+            if name == "url_extract_host":
+                return u.hostname
+            if name == "url_extract_path":
+                return u.path
+            if name == "url_extract_query":
+                return u.query if "?" in s else None
+            if name == "url_extract_fragment":
+                return u.fragment if "#" in s else None
+            return None
+
+        if name == "url_extract_port":
+            return self._bind_dict_table_nullable(
+                args[0], T.BIGINT, part, jnp.int64
+            )
+        if name == "url_extract_parameter":
+            from urllib.parse import parse_qs
+
+            plit = e.args[1]
+            assert isinstance(plit, Literal), (
+                "url_extract_parameter() name must be a constant"
+            )
+
+            def param(s, p=plit.value):
+                try:
+                    vals = parse_qs(
+                        urlsplit(s).query, keep_blank_values=True
+                    ).get(p)
+                except ValueError:
+                    return None
+                return vals[0] if vals else None
+
+            return self._bind_dict_transform_nullable(args[0], e, param)
+        return self._bind_dict_transform_nullable(args[0], e, part)
+
+    def _bind_json_fn(self, name, e, args):
+        import json as _json
+
+        def nav(s, path, keep_tokens=False):
+            """$.a.b[0] JSONPath subset over parsed JSON; None on any
+            miss (JsonFunctions' lenient semantics). keep_tokens parses
+            numbers as their literal text so 7.0 renders '7.0' exactly
+            as the document wrote it (Trino emits the parser token)."""
+            try:
+                if keep_tokens:
+                    v = _json.loads(s, parse_float=str, parse_int=str)
+                else:
+                    v = _json.loads(s)
+            except (ValueError, TypeError):
+                return _MISS
+            if not path.startswith("$"):
+                return _MISS
+            i = 1
+            while i < len(path):
+                if path[i] == ".":
+                    j = i + 1
+                    while j < len(path) and path[j] not in ".[":
+                        j += 1
+                    key = path[i + 1:j]
+                    if not isinstance(v, dict) or key not in v:
+                        return _MISS
+                    v = v[key]
+                    i = j
+                elif path[i] == "[":
+                    j = path.index("]", i)
+                    try:
+                        idx = int(path[i + 1:j])
+                    except ValueError:
+                        return _MISS
+                    if not isinstance(v, list) or not (
+                        -len(v) <= idx < len(v)
+                    ):
+                        return _MISS
+                    v = v[idx]
+                    i = j + 1
+                else:
+                    return _MISS
+            return v
+
+        _MISS = object()
+        if name == "json_array_length":
+            def jal(s):
+                try:
+                    v = _json.loads(s)
+                except (ValueError, TypeError):
+                    return None
+                return len(v) if isinstance(v, list) else None
+
+            return self._bind_dict_table_nullable(
+                args[0], T.BIGINT, jal, jnp.int64
+            )
+        plit = e.args[1]
+        assert isinstance(plit, Literal), (
+            f"{name}() path must be a constant"
+        )
+        path = plit.value
+        if name == "json_size":
+            def jsz(s, path=path):
+                v = nav(s, path)
+                if v is _MISS:
+                    return None
+                return len(v) if isinstance(v, (dict, list)) else 0
+
+            return self._bind_dict_table_nullable(
+                args[0], T.BIGINT, jsz, jnp.int64
+            )
+
+        def jes(s, path=path):
+            v = nav(s, path, keep_tokens=True)
+            if v is _MISS or v is None or isinstance(v, (dict, list)):
+                return None
+            if isinstance(v, bool):
+                return "true" if v else "false"
+            return str(v)  # numbers are their literal tokens (parse hooks)
+
+        return self._bind_dict_transform_nullable(args[0], e, jes)
+
+    def _bind_dict_transform_nullable(self, a: Bound, e, pyfn) -> Bound:
+        """Like _bind_dict_transform but pyfn may return None -> NULL:
+        validity is a second per-code table ANDed into the mask."""
+        from trino_tpu.block import Dictionary
+
+        if a.dictionary is None or len(a.dictionary) == 0:
+            return self._null_of(a, e.type)
+        transformed = [pyfn(v) for v in a.dictionary.values]
+        new_dict = Dictionary([t if t is not None else "" for t in transformed])
+        remap = jnp.asarray(
+            [new_dict.code(t if t is not None else "") for t in transformed],
+            dtype=jnp.int32,
+        )
+        valid_tbl = jnp.asarray(
+            [t is not None for t in transformed], dtype=jnp.bool_
+        )
+
+        def fn(cols, valids):
+            d, v = a.fn(cols, valids)
+            ok = take_clip(valid_tbl, d)
+            return take_clip(remap, d), ok if v is None else (v & ok)
+
+        return Bound(e.type, fn, new_dict)
+
+    def _bind_dict_table_nullable(self, a: Bound, out_type, pyfn, dtype) -> Bound:
+        """Like _bind_dict_table but pyfn may return None -> NULL."""
+        if a.dictionary is None or len(a.dictionary) == 0:
+            return self._null_of(a, out_type)
+        results = [pyfn(v) for v in a.dictionary.values]
+        table = jnp.asarray(
+            [r if r is not None else 0 for r in results], dtype=dtype
+        )
+        valid_tbl = jnp.asarray(
+            [r is not None for r in results], dtype=jnp.bool_
+        )
+
+        def fn(cols, valids):
+            d, v = a.fn(cols, valids)
+            ok = take_clip(valid_tbl, d)
+            return take_clip(table, d), ok if v is None else (v & ok)
+
+        return Bound(out_type, fn)
 
     @staticmethod
     def _to_days(a: Bound, data: jnp.ndarray) -> jnp.ndarray:

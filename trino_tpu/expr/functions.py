@@ -170,6 +170,14 @@ def week_of_year(days: jnp.ndarray):
     return (thursday - jan1) // 7 + 1
 
 
+def year_of_week(days: jnp.ndarray):
+    """ISO week-numbering year: the calendar year of this date's
+    Thursday (DateTimeFunctions.yearOfWeekFromDate)."""
+    days = days.astype(jnp.int32)
+    thursday = days - (day_of_week(days) - 4)
+    return civil_from_days(thursday)[0]
+
+
 def date_trunc_days(unit: str, days: jnp.ndarray):
     """date_trunc on epoch-day values (DATE resolution units)."""
     days = days.astype(jnp.int32)

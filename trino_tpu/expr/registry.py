@@ -1,0 +1,283 @@
+"""Function registry: the catalog of callable functions.
+
+Analogue of the reference's function-resolution layer
+(main/metadata/SystemFunctionBundle.java:351 registering ~1,400
+functions, FunctionResolver + Signature matching — SURVEY.md §2.10).
+Each entry declares name, aliases, arity bounds, category, a one-line
+description (surfaced by SHOW FUNCTIONS), and a return-type rule.
+
+Resolution order in the analyzer: special forms first (CASE-like `if`,
+constant folds such as `pi()`/`chr()`, aggregate/window detection), then
+this registry. Entries whose `type_rule` is None are typed by the
+analyzer's special-case code and exist here for the catalog surface;
+entries WITH a rule are fully resolved from the registry — every newly
+added scalar goes that way, so breadth grows declaratively.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from trino_tpu import types as T
+
+
+@dataclasses.dataclass(frozen=True)
+class FunctionMetadata:
+    name: str
+    category: str            # scalar | aggregate | window
+    min_arity: int
+    max_arity: Optional[int]  # None = variadic
+    returns: str             # signature text for SHOW FUNCTIONS
+    description: str
+    aliases: Tuple[str, ...] = ()
+    # arg types -> result DataType; None = analyzer special-cases typing
+    type_rule: Optional[Callable[[Sequence[T.DataType]], T.DataType]] = None
+    canonical: Optional[str] = None  # IR name when != `name`
+
+
+class FunctionRegistry:
+    def __init__(self):
+        self._by_name: Dict[str, FunctionMetadata] = {}
+
+    def register(self, meta: FunctionMetadata) -> None:
+        for n in (meta.name, *meta.aliases):
+            self._by_name[n] = meta
+
+    def get(self, name: str) -> Optional[FunctionMetadata]:
+        return self._by_name.get(name.lower())
+
+    def resolve(self, name: str, arg_types: Sequence[T.DataType]):
+        """(canonical_name, out_type) or None if the registry doesn't
+        own this name's typing (analyzer special case or unknown)."""
+        meta = self.get(name)
+        if meta is None or meta.type_rule is None:
+            return None
+        n = len(arg_types)
+        if n < meta.min_arity or (
+            meta.max_arity is not None and n > meta.max_arity
+        ):
+            want = (
+                str(meta.min_arity)
+                if meta.max_arity == meta.min_arity
+                else f"{meta.min_arity}..{meta.max_arity or 'N'}"
+            )
+            raise ValueError(
+                f"{meta.name}() expects {want} arguments, got {n}"
+            )
+        return meta.canonical or meta.name, meta.type_rule(arg_types)
+
+    def all(self) -> List[FunctionMetadata]:
+        seen = {}
+        for meta in self._by_name.values():
+            seen[meta.name] = meta
+        return sorted(seen.values(), key=lambda m: (m.category, m.name))
+
+
+REGISTRY = FunctionRegistry()
+
+_VARCHAR = lambda a: T.VARCHAR  # noqa: E731
+_BIGINT = lambda a: T.BIGINT  # noqa: E731
+_DOUBLE = lambda a: T.DOUBLE  # noqa: E731
+_BOOLEAN = lambda a: T.BOOLEAN  # noqa: E731
+_SAME = lambda a: a[0]  # noqa: E731
+
+
+def _reg(name, category, lo, hi, returns, desc, aliases=(),
+         rule=None, canonical=None):
+    REGISTRY.register(FunctionMetadata(
+        name, category, lo, hi, returns, desc, tuple(aliases), rule,
+        canonical,
+    ))
+
+
+# --- scalars typed by the analyzer's special cases (catalog entries) ---
+for name, lo, hi, ret, desc, aliases in [
+    ("abs", 1, 1, "same", "absolute value", ()),
+    ("round", 1, 2, "same", "round to scale digits, half away from zero", ()),
+    ("floor", 1, 1, "bigint|double", "largest integer <= x", ()),
+    ("ceil", 1, 1, "bigint|double", "smallest integer >= x", ("ceiling",)),
+    ("sqrt", 1, 1, "double", "square root", ()),
+    ("ln", 1, 1, "double", "natural logarithm", ()),
+    ("exp", 1, 1, "double", "Euler's number raised to x", ()),
+    ("power", 2, 2, "double", "x raised to y", ("pow",)),
+    ("log2", 1, 1, "double", "base-2 logarithm", ()),
+    ("log10", 1, 1, "double", "base-10 logarithm", ()),
+    ("log", 2, 2, "double", "logarithm of x in base b", ()),
+    ("mod", 2, 2, "same", "remainder truncated toward zero", ()),
+    ("sign", 1, 1, "bigint|double", "signum", ()),
+    ("truncate", 1, 2, "same", "truncate toward zero", ()),
+    ("sin", 1, 1, "double", "sine", ()),
+    ("cos", 1, 1, "double", "cosine", ()),
+    ("tan", 1, 1, "double", "tangent", ()),
+    ("asin", 1, 1, "double", "arc sine", ()),
+    ("acos", 1, 1, "double", "arc cosine", ()),
+    ("atan", 1, 1, "double", "arc tangent", ()),
+    ("atan2", 2, 2, "double", "two-argument arc tangent", ()),
+    ("sinh", 1, 1, "double", "hyperbolic sine", ()),
+    ("cosh", 1, 1, "double", "hyperbolic cosine", ()),
+    ("tanh", 1, 1, "double", "hyperbolic tangent", ()),
+    ("cbrt", 1, 1, "double", "cube root", ()),
+    ("degrees", 1, 1, "double", "radians to degrees", ()),
+    ("radians", 1, 1, "double", "degrees to radians", ()),
+    ("pi", 0, 0, "double", "the constant pi", ()),
+    ("e", 0, 0, "double", "Euler's number", ()),
+    ("nan", 0, 0, "double", "NaN", ()),
+    ("infinity", 0, 0, "double", "positive infinity", ()),
+    ("is_nan", 1, 1, "boolean", "true if x is NaN", ()),
+    ("is_infinite", 1, 1, "boolean", "true if x is infinite", ()),
+    ("is_finite", 1, 1, "boolean", "true if x is finite", ()),
+    ("bitwise_and", 2, 2, "bigint", "bitwise AND", ()),
+    ("bitwise_or", 2, 2, "bigint", "bitwise OR", ()),
+    ("bitwise_xor", 2, 2, "bigint", "bitwise XOR", ()),
+    ("bitwise_not", 1, 1, "bigint", "bitwise NOT", ()),
+    ("bitwise_left_shift", 2, 2, "bigint", "shift left", ()),
+    ("bitwise_right_shift", 2, 2, "bigint", "logical shift right", ()),
+    ("greatest", 1, None, "same", "largest of the arguments", ()),
+    ("least", 1, None, "same", "smallest of the arguments", ()),
+    ("coalesce", 1, None, "same", "first non-null argument", ()),
+    ("nullif", 2, 2, "same", "NULL if equal, else first argument", ()),
+    ("if", 2, 3, "same", "conditional value", ()),
+    ("typeof", 1, 1, "varchar", "type of the argument", ()),
+    ("substr", 2, 3, "varchar", "substring from position", ("substring",)),
+    ("upper", 1, 1, "varchar", "uppercase", ()),
+    ("lower", 1, 1, "varchar", "lowercase", ()),
+    ("length", 1, 1, "bigint", "string length in characters", ()),
+    ("trim", 1, 1, "varchar", "strip leading+trailing whitespace", ()),
+    ("ltrim", 1, 1, "varchar", "strip leading whitespace", ()),
+    ("rtrim", 1, 1, "varchar", "strip trailing whitespace", ()),
+    ("reverse", 1, 1, "varchar", "reverse the characters", ()),
+    ("replace", 2, 3, "varchar", "replace occurrences", ()),
+    ("concat", 2, None, "varchar", "concatenate strings", ()),
+    ("starts_with", 2, 2, "boolean", "prefix test", ()),
+    ("ends_with", 2, 2, "boolean", "suffix test", ()),
+    ("strpos", 2, 2, "bigint", "1-based position of substring (0 = absent)", ()),
+    ("codepoint", 1, 1, "bigint", "code point of the single character", ()),
+    ("chr", 1, 1, "varchar", "character for a code point", ()),
+    ("split_part", 3, 3, "varchar", "field at index after splitting", ()),
+    ("lpad", 3, 3, "varchar", "pad on the left", ()),
+    ("rpad", 3, 3, "varchar", "pad on the right", ()),
+    ("translate", 3, 3, "varchar", "per-character mapping", ()),
+    ("regexp_like", 2, 2, "boolean", "regex match test", ()),
+    ("regexp_extract", 2, 3, "varchar", "first regex match or group", ()),
+    ("regexp_replace", 2, 3, "varchar", "replace regex matches", ()),
+    ("regexp_count", 2, 2, "bigint", "count regex matches", ()),
+    ("year", 1, 1, "bigint", "year of a date", ()),
+    ("month", 1, 1, "bigint", "month of a date", ()),
+    ("day", 1, 1, "bigint", "day of month", ("day_of_month",)),
+    ("quarter", 1, 1, "bigint", "quarter of the year", ()),
+    ("week", 1, 1, "bigint", "ISO week of the year", ("week_of_year",)),
+    ("day_of_week", 1, 1, "bigint", "ISO day of week (Mon=1)", ("dow",)),
+    ("day_of_year", 1, 1, "bigint", "day of the year", ("doy",)),
+    ("date_trunc", 2, 2, "date", "truncate to unit", ()),
+    ("date_add", 3, 3, "date", "add n units", ()),
+    ("date_diff", 3, 3, "bigint", "signed unit boundaries between dates", ()),
+    ("last_day_of_month", 1, 1, "date", "last day of the month", ()),
+    ("cardinality", 1, 1, "bigint", "array length", ()),
+    ("sequence", 2, 3, "array", "integer sequence array", ()),
+    ("contains", 2, 2, "boolean", "array containment", ()),
+    ("element_at", 2, 2, "element", "array element at index", ()),
+    ("array_min", 1, 1, "element", "smallest array element", ()),
+    ("array_max", 1, 1, "element", "largest array element", ()),
+    ("array_position", 2, 2, "bigint", "1-based index of value", ()),
+    ("array_distinct", 1, 1, "array", "distinct elements", ()),
+    ("array_sort", 1, 1, "array", "sorted elements", ()),
+    ("array_join", 2, 3, "varchar", "join elements with separator", ()),
+]:
+    _reg(name, "scalar", lo, hi, ret, desc, aliases)
+
+# --- registry-typed scalars (added breadth; typing resolved HERE) ---
+for name, lo, hi, rule, ret, desc, aliases in [
+    # hashing / encoding (operator/scalar/VarbinaryFunctions analogues;
+    # digests render as lowercase hex varchar — the engine's varbinary
+    # carrier is dictionary-encoded varchar)
+    ("md5", 1, 1, _VARCHAR, "varchar", "MD5 digest as lowercase hex", ()),
+    ("sha1", 1, 1, _VARCHAR, "varchar", "SHA-1 digest as lowercase hex", ()),
+    ("sha256", 1, 1, _VARCHAR, "varchar", "SHA-256 digest as lowercase hex", ()),
+    ("crc32", 1, 1, _BIGINT, "bigint", "CRC-32 checksum", ()),
+    ("to_hex", 1, 1, _VARCHAR, "varchar", "bytes to uppercase hex", ()),
+    ("from_hex", 1, 1, _VARCHAR, "varchar", "hex to bytes (as varchar)", ()),
+    ("to_base64", 1, 1, _VARCHAR, "varchar", "bytes to base64", ()),
+    ("from_base64", 1, 1, _VARCHAR, "varchar", "base64 to bytes (as varchar)", ()),
+    # string breadth (NOTE: no `repeat` — the reference's repeat(e, n)
+    # returns ARRAY, which this engine only has as constants; occupying
+    # the name with string semantics would silently diverge)
+    ("levenshtein_distance", 2, 2, _BIGINT, "bigint",
+     "edit distance to a constant", ()),
+    ("hamming_distance", 2, 2, _BIGINT, "bigint",
+     "differing positions vs a constant of equal length", ()),
+    # URL functions (operator/scalar/UrlFunctions)
+    ("url_extract_protocol", 1, 1, _VARCHAR, "varchar", "scheme of a URL", ()),
+    ("url_extract_host", 1, 1, _VARCHAR, "varchar", "host of a URL", ()),
+    ("url_extract_port", 1, 1, _BIGINT, "bigint", "port of a URL", ()),
+    ("url_extract_path", 1, 1, _VARCHAR, "varchar", "path of a URL", ()),
+    ("url_extract_query", 1, 1, _VARCHAR, "varchar", "query of a URL", ()),
+    ("url_extract_fragment", 1, 1, _VARCHAR, "varchar", "fragment of a URL", ()),
+    ("url_extract_parameter", 2, 2, _VARCHAR, "varchar",
+     "value of a query parameter", ()),
+    ("url_encode", 1, 1, _VARCHAR, "varchar", "percent-encode", ()),
+    ("url_decode", 1, 1, _VARCHAR, "varchar", "percent-decode", ()),
+    # JSON (operator/scalar/JsonFunctions; path subset $.a.b[0])
+    ("json_extract_scalar", 2, 2, _VARCHAR, "varchar",
+     "scalar at a JSONPath ($.a.b[0] subset)", ()),
+    ("json_array_length", 1, 1, _BIGINT, "bigint",
+     "length of a JSON array", ()),
+    ("json_size", 2, 2, _BIGINT, "bigint",
+     "size of the value at a JSONPath", ()),
+    # date breadth
+    ("year_of_week", 1, 1, _BIGINT, "bigint",
+     "ISO week-numbering year", ("yow",)),
+    ("from_iso8601_date", 1, 1, lambda a: T.DATE, "date",
+     "parse YYYY-MM-DD", ()),
+]:
+    _reg(name, "scalar", lo, hi, ret, desc, aliases, rule)
+
+# --- aggregates (typed/validated in the analyzer; catalog surface) ---
+for name, lo, hi, ret, desc in [
+    ("count", 0, 1, "bigint", "row or non-null count"),
+    ("sum", 1, 1, "same", "sum"),
+    ("avg", 1, 1, "double|decimal", "arithmetic mean"),
+    ("min", 1, 1, "same", "minimum"),
+    ("max", 1, 1, "same", "maximum"),
+    ("count_if", 1, 1, "bigint", "count of TRUE"),
+    ("bool_and", 1, 1, "boolean", "TRUE if every value is TRUE"),
+    ("bool_or", 1, 1, "boolean", "TRUE if any value is TRUE"),
+    ("every", 1, 1, "boolean", "alias of bool_and"),
+    ("arbitrary", 1, 1, "same", "any value"),
+    ("any_value", 1, 1, "same", "any value"),
+    ("variance", 1, 1, "double", "sample variance"),
+    ("var_samp", 1, 1, "double", "sample variance"),
+    ("var_pop", 1, 1, "double", "population variance"),
+    ("stddev", 1, 1, "double", "sample standard deviation"),
+    ("stddev_samp", 1, 1, "double", "sample standard deviation"),
+    ("stddev_pop", 1, 1, "double", "population standard deviation"),
+    ("skewness", 1, 1, "double", "skewness"),
+    ("kurtosis", 1, 1, "double", "excess kurtosis"),
+    ("covar_samp", 2, 2, "double", "sample covariance"),
+    ("covar_pop", 2, 2, "double", "population covariance"),
+    ("corr", 2, 2, "double", "correlation coefficient"),
+    ("regr_slope", 2, 2, "double", "linear regression slope"),
+    ("regr_intercept", 2, 2, "double", "linear regression intercept"),
+    ("approx_distinct", 1, 1, "bigint", "approximate distinct count"),
+    ("approx_percentile", 2, 2, "same", "approximate percentile"),
+    ("min_by", 2, 2, "same", "value at the minimum of the second argument"),
+    ("max_by", 2, 2, "same", "value at the maximum of the second argument"),
+    ("listagg", 1, 2, "varchar", "concatenated values"),
+    ("string_agg", 1, 2, "varchar", "concatenated values"),
+]:
+    _reg(name, "aggregate", lo, hi, ret, desc)
+
+# --- window functions ---
+for name, lo, hi, ret, desc in [
+    ("row_number", 0, 0, "bigint", "sequential row number"),
+    ("rank", 0, 0, "bigint", "rank with gaps"),
+    ("dense_rank", 0, 0, "bigint", "rank without gaps"),
+    ("percent_rank", 0, 0, "double", "relative rank in [0,1]"),
+    ("cume_dist", 0, 0, "double", "cumulative distribution"),
+    ("ntile", 1, 1, "bigint", "bucket number of n roughly-equal buckets"),
+    ("lead", 1, 3, "same", "value at a following row"),
+    ("lag", 1, 3, "same", "value at a preceding row"),
+    ("first_value", 1, 1, "same", "first value of the frame"),
+    ("last_value", 1, 1, "same", "last value of the frame"),
+]:
+    _reg(name, "window", lo, hi, ret, desc)

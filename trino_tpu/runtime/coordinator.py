@@ -334,10 +334,12 @@ class DistributedQueryRunner:
             shutil.rmtree(spool_dir, ignore_errors=True)
 
     def _analyze(self, q: ast.Query):
+        from trino_tpu.sql.optimizer import optimize
+
         analyzer = Analyzer(
             self.catalogs, self.session.catalog, self.session.schema
         )
-        return analyzer.plan(q)
+        return optimize(analyzer.plan(q), self.catalogs, self.session)
 
     def _collect(self, scheduler: QueryScheduler, handle, tid) -> List[list]:
         """Pull the root stage's single output partition (the

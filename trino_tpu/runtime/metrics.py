@@ -1,0 +1,52 @@
+"""Process-wide metrics registry.
+
+Analogue of the reference's JMX metrics surface (airlift @Managed beans
+exported through the jmx connector / GET /v1/jmx/mbean): named counters
+and gauges that subsystems bump, snapshotted as JSON by the
+coordinator's `/v1/metrics` endpoint. Counters are monotonically
+increasing; gauges are set-to-current.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, Callable[[], float]] = {}
+        self._lock = threading.Lock()
+
+    def increment(self, name: str, delta: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0.0) + delta
+
+    def counter(self, name: str) -> float:
+        with self._lock:
+            return self._counters.get(name, 0.0)
+
+    def register_gauge(self, name: str, fn: Callable[[], float]) -> None:
+        """fn is evaluated at snapshot time (@Managed getter analogue)."""
+        with self._lock:
+            self._gauges[name] = fn
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            out = dict(self._counters)
+            gauges = list(self._gauges.items())
+        for name, fn in gauges:
+            try:
+                out[name] = float(fn())
+            except Exception:
+                pass  # a failing gauge must not poison the snapshot
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+
+
+# the process singleton (MBeanServer analogue)
+METRICS = MetricsRegistry()

@@ -188,6 +188,11 @@ class CoordinatorServer:
                 if parts == ["v1", "cluster"]:
                     self._json(200, outer.cluster_stats())
                     return
+                if parts == ["v1", "metrics"]:
+                    from trino_tpu.runtime.metrics import METRICS
+
+                    self._json(200, METRICS.snapshot())
+                    return
                 if parts == ["v1", "query"]:
                     self._json(200, outer.query_list(identity))
                     return
@@ -314,11 +319,14 @@ class CoordinatorServer:
                 self._jobs.pop(qid, None)
 
     def _submit(self, sql: str, identity=None, transaction_id="NONE") -> _QueryJob:
+        from trino_tpu.runtime.metrics import METRICS
+
         self._evict_completed()
         job = _QueryJob(
             uuid.uuid4().hex[:16], sql, getattr(identity, "user", None)
         )
         self._jobs[job.query_id] = job
+        METRICS.increment("queries.submitted")
 
         def run():
             lease = None
@@ -349,7 +357,9 @@ class CoordinatorServer:
                     )
                     job.state = "finished"
                     job.finished_at = time.monotonic()
+                METRICS.increment("queries.finished")
             except Exception as e:
+                METRICS.increment("queries.failed")
                 with job.lock:
                     if job.abandoned:
                         return

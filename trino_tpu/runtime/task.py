@@ -113,13 +113,29 @@ class TaskExecution:
             )
         else:
             self.buffer = OutputBuffer(spec.n_output_partitions)
-        self.state = "planned"
+        # listener-driven lifecycle (TaskStateMachine analogue,
+        # runtime/state_machine.py); `.state` stays the string API the
+        # worker/coordinator protocol reads
+        from trino_tpu.runtime.state_machine import task_state_machine
+
+        self._state_machine = task_state_machine(str(spec.task_id))
         self.failure: Optional[str] = None
         self._clients: List[DirectExchangeClient] = []
         self._catalogs = catalogs
         self._injector = failure_injector
         self._memory_pool = memory_pool
         self._thread: Optional[threading.Thread] = None
+
+    @property
+    def state(self) -> str:
+        return self._state_machine.get()
+
+    @state.setter
+    def state(self, value: str) -> None:
+        self._state_machine.set(value)
+
+    def add_state_listener(self, fn) -> None:
+        self._state_machine.add_listener(fn)
 
     # -- lifecycle --
     def start(self) -> None:
@@ -134,6 +150,9 @@ class TaskExecution:
             self._thread.join(timeout)
 
     def abort(self) -> None:
+        # terminal states latch: aborting an already-finished/failed
+        # task keeps its verdict (TaskStateMachine.abort contract)
+        self._state_machine.set("aborted")
         self.buffer.abort()
         for c in self._clients:
             c.close()

@@ -500,6 +500,18 @@ class ExprConverter:
             if len(args) != 1:
                 raise AnalysisError("typeof() takes one argument")
             return ir.Literal(str(args[0].type), T.VARCHAR)
+        # registry-resolved scalars (expr/registry.py): every function
+        # not special-cased above types through the declarative catalog
+        # (FunctionResolver analogue)
+        from trino_tpu.expr.registry import REGISTRY
+
+        try:
+            hit = REGISTRY.resolve(name, [a.type for a in args])
+        except ValueError as ex:
+            raise AnalysisError(str(ex))
+        if hit is not None:
+            canonical, out_t = hit
+            return ir.Call(canonical, args, out_t)
         raise AnalysisError(f"unknown function {name}()")
 
     def _fold_array_call(

@@ -416,3 +416,8 @@ class ShowSchemas(Node):
 @dataclasses.dataclass(frozen=True)
 class ShowColumns(Node):
     table: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowFunctions(Node):
+    pass

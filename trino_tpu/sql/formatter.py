@@ -390,4 +390,6 @@ def format_statement(node: ast.Node) -> str:
         return "SHOW SCHEMAS"
     if isinstance(node, ast.ShowColumns):
         return f"SHOW COLUMNS FROM {_name(node.table)}"
+    if isinstance(node, ast.ShowFunctions):
+        return "SHOW FUNCTIONS"
     raise NotImplementedError(f"cannot format {type(node).__name__}")

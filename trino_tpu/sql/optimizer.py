@@ -1,0 +1,673 @@
+"""Plan optimizer: memo, iterative rule engine, cost-based join reorder.
+
+Analogue of the reference's optimizer stack (SURVEY.md §2.2):
+
+- `Memo` — group-per-subtree plan store whose nodes point at child
+  *groups* (main/sql/planner/iterative/Memo.java:37). Rules replace a
+  group's representative without rebuilding the whole tree.
+- `IterativeOptimizer` — applies a rule set to every group to fixpoint
+  (main/sql/planner/iterative/IterativeOptimizer.java:63). Rules get a
+  `Context` with a GroupRef resolver and a StatsCalculator, mirroring
+  Rule.Context's Lookup + StatsProvider.
+- `ReorderJoins` — cost-based join-order search over maximal inner-join
+  regions: DPsub over connected sub-graphs with probe/build orientation
+  chosen by cost, replacing the analyzer's greedy smaller-side order
+  (main/sql/planner/iterative/rule/ReorderJoins.java:84 + main/cost/
+  JoinStatsRule / CostCalculatorUsingExchanges). Output schema is
+  restored with a permutation Project so enclosing plans are untouched.
+
+The pass pipeline (`optimize`) mirrors PlanOptimizers.java's staged
+list: simplification to fixpoint, then join reordering, then a cleanup
+fixpoint for the projections reordering introduces.
+
+The rule inventory is deliberately smaller than the reference's ~220:
+the analyzer already plans subqueries/pushdowns during translation, so
+the rules here are the ones with post-translation leverage. Each rule
+cites its reference analogue.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from trino_tpu.expr import ir
+from trino_tpu.sql import plan as P
+from trino_tpu.sql.cost import CostCalculator
+from trino_tpu.sql.stats import StatsCalculator
+
+MAX_DP_LEAVES = 10       # beyond this, keep the analyzer's greedy order
+MAX_FIXPOINT_PASSES = 16
+
+
+# ---------------------------------------------------------------------------
+# expression utilities
+# ---------------------------------------------------------------------------
+
+
+def expr_refs(e: ir.Expr) -> set:
+    """Channels an expression reads."""
+    out: set = set()
+
+    def walk(x: ir.Expr):
+        if isinstance(x, ir.InputRef):
+            out.add(x.index)
+        for c in x.children():
+            walk(c)
+
+    walk(e)
+    return out
+
+
+def substitute(e: ir.Expr, mapping: Dict[int, ir.Expr]) -> ir.Expr:
+    """Replace InputRefs by expressions (projection inlining)."""
+    if isinstance(e, ir.InputRef):
+        return mapping[e.index]
+    if isinstance(e, ir.Call):
+        return ir.Call(e.name, tuple(substitute(a, mapping) for a in e.args), e.type)
+    if isinstance(e, ir.Cast):
+        return ir.Cast(substitute(e.arg, mapping), e.type)
+    if isinstance(e, ir.Case):
+        return ir.Case(
+            tuple(substitute(c, mapping) for c in e.conds),
+            tuple(substitute(r, mapping) for r in e.results),
+            substitute(e.default, mapping) if e.default is not None else None,
+            e.type,
+        )
+    if isinstance(e, ir.InList):
+        return ir.InList(substitute(e.value, mapping), e.options, e.type)
+    return e  # Literal
+
+
+def shift_refs(e: ir.Expr, delta: int) -> ir.Expr:
+    if isinstance(e, ir.InputRef):
+        return ir.InputRef(e.index + delta, e.type)
+    if isinstance(e, ir.Call):
+        return ir.Call(e.name, tuple(shift_refs(a, delta) for a in e.args), e.type)
+    if isinstance(e, ir.Cast):
+        return ir.Cast(shift_refs(e.arg, delta), e.type)
+    if isinstance(e, ir.Case):
+        return ir.Case(
+            tuple(shift_refs(c, delta) for c in e.conds),
+            tuple(shift_refs(r, delta) for r in e.results),
+            shift_refs(e.default, delta) if e.default is not None else None,
+            e.type,
+        )
+    if isinstance(e, ir.InList):
+        return ir.InList(shift_refs(e.value, delta), e.options, e.type)
+    return e
+
+
+def split_conjuncts(e: ir.Expr) -> List[ir.Expr]:
+    if isinstance(e, ir.Call) and e.name == "and":
+        out: List[ir.Expr] = []
+        for a in e.args:
+            out.extend(split_conjuncts(a))
+        return out
+    return [e]
+
+
+# ---------------------------------------------------------------------------
+# child plumbing for frozen plan nodes
+# ---------------------------------------------------------------------------
+
+
+def with_children(node: P.PlanNode, new_children: Sequence[P.PlanNode]) -> P.PlanNode:
+    kids = tuple(node.children())
+    if len(kids) != len(new_children):
+        raise ValueError("child arity mismatch")
+    if all(a is b for a, b in zip(kids, new_children)):
+        return node
+    if isinstance(node, P.JoinNode):
+        left, right = new_children
+        return dataclasses.replace(node, left=left, right=right)
+    if isinstance(node, P.UnionAllNode):
+        return dataclasses.replace(node, inputs=tuple(new_children))
+    return dataclasses.replace(node, child=new_children[0])
+
+
+# ---------------------------------------------------------------------------
+# Memo
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class GroupRef(P.PlanNode):
+    """Placeholder child pointing at a memo group
+    (iterative/GroupReference.java)."""
+
+    group: int
+    fields: Tuple[P.Field, ...]
+
+    def children(self):
+        return ()
+
+
+class Memo:
+    """Plan store: every subtree lives in a group; nodes reference child
+    groups through GroupRef (Memo.java:37 — without multi-expression
+    exploration groups; one representative per group, like the
+    reference's, which also keeps exactly one node per group and relies
+    on rules returning full replacements)."""
+
+    def __init__(self, root: P.PlanNode):
+        self._nodes: Dict[int, P.PlanNode] = {}
+        self._next = 0
+        self.root = self._insert(root)
+
+    def _insert(self, node: P.PlanNode) -> int:
+        if isinstance(node, GroupRef):
+            return node.group
+        kids = [
+            GroupRef(self._insert(c), c.fields)
+            if not isinstance(c, GroupRef) else c
+            for c in node.children()
+        ]
+        gid = self._next
+        self._next += 1
+        self._nodes[gid] = with_children(node, kids) if kids else node
+        return gid
+
+    def node(self, gid: int) -> P.PlanNode:
+        return self._nodes[gid]
+
+    def resolve(self, node: P.PlanNode) -> P.PlanNode:
+        """GroupRef -> its group's current representative."""
+        if isinstance(node, GroupRef):
+            return self._nodes[node.group]
+        return node
+
+    def replace(self, gid: int, new_subtree: P.PlanNode) -> None:
+        """Install a replacement for a group; fresh (non-GroupRef)
+        children get groups of their own."""
+        kids = [
+            c if isinstance(c, GroupRef)
+            else GroupRef(self._insert(c), c.fields)
+            for c in new_subtree.children()
+        ]
+        self._nodes[gid] = (
+            with_children(new_subtree, kids) if kids else new_subtree
+        )
+
+    def extract(self, gid: Optional[int] = None) -> P.PlanNode:
+        gid = self.root if gid is None else gid
+        node = self._nodes[gid]
+        kids = [
+            self.extract(c.group) if isinstance(c, GroupRef) else c
+            for c in node.children()
+        ]
+        return with_children(node, kids) if kids else node
+
+    def groups(self) -> List[int]:
+        return list(self._nodes)
+
+
+@dataclasses.dataclass
+class Context:
+    """Rule.Context analogue: lookup + stats."""
+
+    memo: Memo
+    stats: Optional[StatsCalculator] = None
+
+    def resolve(self, node: P.PlanNode) -> P.PlanNode:
+        return self.memo.resolve(node)
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+
+class Rule:
+    """apply() returns a replacement subtree (children may be the
+    matched node's GroupRef children) or None for no match."""
+
+    name = "rule"
+
+    def apply(self, node: P.PlanNode, ctx: Context) -> Optional[P.PlanNode]:
+        raise NotImplementedError
+
+
+class MergeFilters(Rule):
+    """Filter(Filter(x)) -> Filter(x, p1 AND p2)
+    (rule/MergeFilters.java)."""
+
+    name = "merge_filters"
+
+    def apply(self, node, ctx):
+        if not isinstance(node, P.FilterNode):
+            return None
+        child = ctx.resolve(node.child)
+        if not isinstance(child, P.FilterNode):
+            return None
+        return P.FilterNode(
+            child.child,
+            ir.and_(child.predicate, node.predicate),
+            node.fields,
+        )
+
+
+class RemoveIdentityProject(Rule):
+    """Project that reproduces its child verbatim disappears
+    (rule/RemoveRedundantIdentityProjections.java)."""
+
+    name = "remove_identity_project"
+
+    def apply(self, node, ctx):
+        if not isinstance(node, P.ProjectNode):
+            return None
+        child = ctx.resolve(node.child)
+        if len(node.exprs) != len(child.fields):
+            return None
+        if node.fields != child.fields:
+            return None
+        for i, e in enumerate(node.exprs):
+            if not (isinstance(e, ir.InputRef) and e.index == i):
+                return None
+        # splice the child's group in place of this one
+        return child if not isinstance(node.child, GroupRef) else ctx.memo.node(
+            node.child.group
+        )
+
+
+class InlineProjections(Rule):
+    """Project(Project(x)) -> Project(x) when safe: every inner
+    expression is trivial or referenced at most once
+    (rule/InlineProjections.java's duplication guard)."""
+
+    name = "inline_projections"
+
+    def apply(self, node, ctx):
+        if not isinstance(node, P.ProjectNode):
+            return None
+        child = ctx.resolve(node.child)
+        if not isinstance(child, P.ProjectNode):
+            return None
+        counts: Dict[int, int] = {}
+        for e in node.exprs:
+            for r in expr_refs(e):
+                counts[r] = counts.get(r, 0) + 1
+        for idx, inner in enumerate(child.exprs):
+            trivial = isinstance(inner, (ir.InputRef, ir.Literal))
+            if not trivial and counts.get(idx, 0) > 1:
+                return None
+        mapping = dict(enumerate(child.exprs))
+        return P.ProjectNode(
+            child.child,
+            tuple(substitute(e, mapping) for e in node.exprs),
+            node.fields,
+        )
+
+
+class PushFilterThroughProject(Rule):
+    """Filter(Project(x)) -> Project(Filter(x)) by substituting the
+    projection into the predicate (rule/PushdownFilterIntoProject
+    family); filters run earlier and joins below become visible to
+    reordering."""
+
+    name = "push_filter_through_project"
+
+    def apply(self, node, ctx):
+        if not isinstance(node, P.FilterNode):
+            return None
+        child = ctx.resolve(node.child)
+        if not isinstance(child, P.ProjectNode):
+            return None
+        mapping = dict(enumerate(child.exprs))
+        pred = substitute(node.predicate, mapping)
+        grandchild = child.child
+        return P.ProjectNode(
+            P.FilterNode(
+                grandchild,
+                pred,
+                ctx.resolve(grandchild).fields
+                if isinstance(grandchild, GroupRef)
+                else grandchild.fields,
+            ),
+            child.exprs,
+            child.fields,
+        )
+
+
+class PushFilterIntoJoin(Rule):
+    """Split a post-join filter's conjuncts to the join sides they
+    reference (rule/PushPredicateIntoTableScan's ancestor pass,
+    PredicatePushDown.java): inner joins only — under outer joins a
+    pushed predicate changes NULL-extension semantics."""
+
+    name = "push_filter_into_join"
+
+    def apply(self, node, ctx):
+        if not isinstance(node, P.FilterNode):
+            return None
+        join = ctx.resolve(node.child)
+        if not isinstance(join, P.JoinNode) or join.kind not in ("inner", "cross"):
+            return None
+        left = ctx.resolve(join.left)
+        width_l = len(left.fields)
+        width = len(join.fields)
+        left_parts: List[ir.Expr] = []
+        right_parts: List[ir.Expr] = []
+        keep: List[ir.Expr] = []
+        for c in split_conjuncts(node.predicate):
+            refs = expr_refs(c)
+            if refs and max(refs) < width_l:
+                left_parts.append(c)
+            elif refs and min(refs) >= width_l and max(refs) < width:
+                right_parts.append(c)
+            else:
+                keep.append(c)
+        if not left_parts and not right_parts:
+            return None
+        new_left = join.left
+        if left_parts:
+            new_left = P.FilterNode(
+                join.left, ir.and_(*left_parts), left.fields
+            )
+        new_right = join.right
+        if right_parts:
+            rfields = ctx.resolve(join.right).fields
+            new_right = P.FilterNode(
+                join.right,
+                ir.and_(*[shift_refs(c, -width_l) for c in right_parts]),
+                rfields,
+            )
+        out: P.PlanNode = dataclasses.replace(
+            join, left=new_left, right=new_right
+        )
+        if keep:
+            out = P.FilterNode(out, ir.and_(*keep), node.fields)
+        return out
+
+
+class LimitOverSortToTopN(Rule):
+    """Limit(Sort(x)) -> TopN (rule/MergeLimitWithSort.java)."""
+
+    name = "limit_over_sort_to_topn"
+
+    def apply(self, node, ctx):
+        if not isinstance(node, P.LimitNode) or node.count is None:
+            return None
+        if node.offset:
+            return None
+        child = ctx.resolve(node.child)
+        if not isinstance(child, P.SortNode):
+            return None
+        return P.TopNNode(child.child, child.keys, node.count, node.fields)
+
+
+class EvaluateEmptyJoin(Rule):
+    """Inner join with a zero-row Values side is empty
+    (rule/EvaluateEmptyIntersect / RemoveEmpty* family)."""
+
+    name = "evaluate_empty_join"
+
+    def apply(self, node, ctx):
+        if not isinstance(node, P.JoinNode) or node.kind not in ("inner", "cross"):
+            return None
+        for side in (node.left, node.right):
+            s = ctx.resolve(side)
+            if isinstance(s, P.ValuesNode) and not s.rows:
+                return P.ValuesNode(node.fields, ())
+        return None
+
+
+SIMPLIFICATION_RULES: Tuple[Rule, ...] = (
+    MergeFilters(),
+    InlineProjections(),
+    RemoveIdentityProject(),
+    PushFilterThroughProject(),
+    PushFilterIntoJoin(),
+    LimitOverSortToTopN(),
+    EvaluateEmptyJoin(),
+)
+
+
+class IterativeOptimizer:
+    """Fixpoint driver (IterativeOptimizer.java:63): visit every memo
+    group, offer each rule the group's representative, install
+    replacements, repeat until a full pass fires nothing."""
+
+    def __init__(self, rules: Sequence[Rule] = SIMPLIFICATION_RULES):
+        self._rules = tuple(rules)
+
+    def optimize(
+        self, root: P.PlanNode, stats: Optional[StatsCalculator] = None
+    ) -> P.PlanNode:
+        memo = Memo(root)
+        ctx = Context(memo, stats)
+        for _ in range(MAX_FIXPOINT_PASSES):
+            fired = False
+            for gid in memo.groups():
+                if gid not in memo._nodes:
+                    continue
+                progress = True
+                while progress:
+                    progress = False
+                    node = memo.node(gid)
+                    for rule in self._rules:
+                        result = rule.apply(node, ctx)
+                        if result is not None and result is not node:
+                            memo.replace(gid, result)
+                            progress = True
+                            fired = True
+                            break
+            if not fired:
+                break
+        return memo.extract()
+
+
+# ---------------------------------------------------------------------------
+# cost-based join reordering
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _Region:
+    """A maximal tree of clean inner joins. leaves are the non-region
+    subtrees in original concat order; edges are equi-join pairs
+    ((leaf_i, off_i), (leaf_j, off_j))."""
+
+    leaves: List[P.PlanNode]
+    edges: List[Tuple[Tuple[int, int], Tuple[int, int]]]
+
+
+def _is_region_join(node: P.PlanNode) -> bool:
+    return (
+        isinstance(node, P.JoinNode)
+        and node.kind == "inner"
+        and node.residual is None
+    )
+
+
+def _extract_region(root: P.JoinNode) -> _Region:
+    leaves: List[P.PlanNode] = []
+    edges: List[Tuple[Tuple[int, int], Tuple[int, int]]] = []
+
+    def locate(layout: List[int], ch: int) -> Tuple[int, int]:
+        off = ch
+        for leaf_idx in layout:
+            w = len(leaves[leaf_idx].fields)
+            if off < w:
+                return (leaf_idx, off)
+            off -= w
+        raise AssertionError("key channel outside layout")
+
+    def walk(node: P.PlanNode) -> List[int]:
+        if _is_region_join(node):
+            left_layout = walk(node.left)
+            right_layout = walk(node.right)
+            for lk, rk in zip(node.left_keys, node.right_keys):
+                edges.append((locate(left_layout, lk), locate(right_layout, rk)))
+            return left_layout + right_layout
+        leaves.append(node)
+        return [len(leaves) - 1]
+
+    walk(root)
+    return _Region(leaves, edges)
+
+
+class ReorderJoins:
+    """DPsub join-order search over a region (ReorderJoins.java:84 — the
+    reference enumerates partitions per multi-join node with a cost
+    comparator and a result limit; this explores all connected subsets,
+    feasible at the region sizes analytic queries produce). Cross joins
+    are admitted only to connect otherwise-disconnected components and
+    only one leaf at a time, mirroring EliminateCrossJoins' bias."""
+
+    def __init__(self, stats: StatsCalculator, cost: CostCalculator):
+        self._stats = stats
+        self._cost = cost
+
+    def rewrite(self, node: P.PlanNode) -> P.PlanNode:
+        if _is_region_join(node):
+            return self._reorder(node)
+        kids = [self.rewrite(c) for c in node.children()]
+        return with_children(node, kids)
+
+    # -- region machinery --
+    def _reorder(self, root: P.JoinNode) -> P.PlanNode:
+        region = _extract_region(root)
+        # recurse into leaves first (nested regions under aggregates etc.)
+        region.leaves = [self.rewrite(l) for l in region.leaves]
+        n = len(region.leaves)  # a join region always has >= 2 leaves
+        if n > MAX_DP_LEAVES:
+            # oversized region: keep the analyzer's greedy order
+            return self._rebuild_original(root, region)
+        plan, layout = self._dp(region)
+        if plan is None:
+            return self._rebuild_original(root, region)
+        if layout == tuple(range(n)):
+            return plan
+        # permutation project restoring the original output order
+        widths = [len(l.fields) for l in region.leaves]
+        new_offsets: Dict[int, int] = {}
+        pos = 0
+        for leaf_idx in layout:
+            new_offsets[leaf_idx] = pos
+            pos += widths[leaf_idx]
+        exprs: List[ir.Expr] = []
+        fields: List[P.Field] = []
+        for leaf_idx in range(n):
+            base = new_offsets[leaf_idx]
+            for off, f in enumerate(region.leaves[leaf_idx].fields):
+                exprs.append(ir.InputRef(base + off, f.type))
+                fields.append(f)
+        return P.ProjectNode(plan, tuple(exprs), tuple(fields))
+
+    def _rebuild_original(self, node: P.PlanNode, region: _Region,
+                          counter: Optional[List[int]] = None) -> P.PlanNode:
+        """Original structure with (recursively-rewritten) leaves."""
+        if counter is None:
+            counter = [0]
+        if _is_region_join(node):
+            left = self._rebuild_original(node.left, region, counter)
+            right = self._rebuild_original(node.right, region, counter)
+            return dataclasses.replace(node, left=left, right=right)
+        leaf = region.leaves[counter[0]]
+        counter[0] += 1
+        return leaf
+
+    def _dp(self, region: _Region):
+        n = len(region.leaves)
+        full = (1 << n) - 1
+        # best[mask] = (total_cost, plan, layout)
+        best: Dict[int, Tuple[float, P.PlanNode, Tuple[int, ...]]] = {}
+        for i, leaf in enumerate(region.leaves):
+            best[1 << i] = (self._cost.cost(leaf).total, leaf, (i,))
+
+        def crossing(s1: int, s2: int):
+            out = []
+            for (a, b) in region.edges:
+                (la, _), (lb, _) = a, b
+                if (s1 >> la) & 1 and (s2 >> lb) & 1:
+                    out.append((a, b))
+                elif (s2 >> la) & 1 and (s1 >> lb) & 1:
+                    out.append((b, a))
+            return out
+
+        def offsets(layout: Tuple[int, ...]) -> Dict[int, int]:
+            out: Dict[int, int] = {}
+            pos = 0
+            for li in layout:
+                out[li] = pos
+                pos += len(region.leaves[li].fields)
+            return out
+
+        def make_join(probe, build, keys):
+            (_, pplan, playout) = probe
+            (_, bplan, blayout) = build
+            poff = offsets(playout)
+            boff = offsets(blayout)
+            lkeys = tuple(poff[l] + o for ((l, o), _) in keys)
+            rkeys = tuple(boff[l] + o for (_, (l, o)) in keys)
+            kind = "inner" if keys else "cross"
+            node = P.JoinNode(
+                kind, pplan, bplan, lkeys, rkeys, None,
+                pplan.fields + bplan.fields,
+            )
+            return (self._cost.cost(node).total, node, playout + blayout)
+
+        for mask in range(1, full + 1):
+            if mask in best or bin(mask).count("1") < 2:
+                continue
+            lowest = mask & -mask
+            entry = None
+            s1 = (mask - 1) & mask
+            while s1:
+                s2 = mask ^ s1
+                if (s1 & lowest) and s1 in best and s2 in best:
+                    keys = crossing(s1, s2)
+                    candidates = []
+                    if keys:
+                        # orientation: either side may probe
+                        candidates.append(make_join(
+                            best[s1], best[s2],
+                            [(a, b) for (a, b) in keys],
+                        ))
+                        candidates.append(make_join(
+                            best[s2], best[s1],
+                            [(b, a) for (a, b) in keys],
+                        ))
+                    elif bin(s2).count("1") == 1 or bin(s1).count("1") == 1:
+                        # cross join admitted one leaf at a time
+                        candidates.append(make_join(best[s1], best[s2], []))
+                    for cand in candidates:
+                        if entry is None or cand[0] < entry[0]:
+                            entry = cand
+                s1 = (s1 - 1) & mask
+            if entry is not None:
+                best[mask] = entry
+        hit = best.get(full)
+        if hit is None:
+            return None, None
+        return hit[1], hit[2]
+
+
+# ---------------------------------------------------------------------------
+# pipeline
+# ---------------------------------------------------------------------------
+
+
+def optimize(
+    root: P.PlanNode,
+    catalogs,
+    session=None,
+) -> P.PlanNode:
+    """The PlanOptimizers pipeline: iterative simplification, cost-based
+    join reordering, cleanup. `session.enable_optimizer` gates the whole
+    pass; `session.join_reordering_strategy` gates the CBO step
+    ("automatic" | "none" — SystemSessionProperties
+    JOIN_REORDERING_STRATEGY)."""
+    if session is not None and not getattr(session, "enable_optimizer", True):
+        return root
+    strategy = getattr(session, "join_reordering_strategy", "automatic")
+    stats = StatsCalculator(catalogs)
+    it = IterativeOptimizer()
+    root = it.optimize(root, stats)
+    if strategy == "automatic":
+        cost = CostCalculator(stats)
+        root = ReorderJoins(stats, cost).rewrite(root)
+        root = it.optimize(root, stats)
+    return root

@@ -287,7 +287,11 @@ class Parser:
             return ast.ShowColumns(self._parse_qualified_name())
         if self.accept_kw("SESSION"):
             return ast.ShowSession()
-        raise self.error("expected TABLES, SCHEMAS, COLUMNS or SESSION after SHOW")
+        if self.accept_kw("FUNCTIONS"):
+            return ast.ShowFunctions()
+        raise self.error(
+            "expected TABLES, SCHEMAS, COLUMNS, SESSION or FUNCTIONS after SHOW"
+        )
 
     # -- query --
     def parse_query(self) -> ast.Query:
