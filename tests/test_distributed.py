@@ -562,3 +562,16 @@ def test_discovery_heartbeat_marks_failed_worker():
         nm.ping_once()
     assert nm.all_states()["flaky"] == "failed"
     assert nm.active_workers() == []
+
+
+def test_distributed_explain_analyze(runner):
+    """Operator stats cross the wire: every fragment reports per-operator
+    rows/batches summed over its tasks (TaskInfo stats path)."""
+    out = runner.execute(
+        "EXPLAIN ANALYZE select o_orderstatus, count(*) from orders"
+        " group by o_orderstatus"
+    ).rows[0][0]
+    assert "Fragment" in out and "tasks]" in out
+    assert "Pipeline" in out
+    # scan operators in the source fragment must report real row counts
+    assert "in=15000 rows" in out or "out=15000 rows" in out, out

@@ -291,3 +291,12 @@ def test_show_functions(runner):
     assert len(rows) > 140
     cats = {r[3] for r in rows}
     assert cats == {"scalar", "aggregate", "window"}
+
+
+def test_const_arg_enforced_at_analysis(runner):
+    # column where a constant is required -> AnalysisError, not a
+    # binder assertion mid-execution
+    with pytest.raises(Exception, match="must be a constant"):
+        runner.execute(
+            "select levenshtein_distance(n_name, n_comment) from nation"
+        )

@@ -1,0 +1,115 @@
+"""Polymorphic table functions: FROM TABLE(fn(...)) syntax, built-in
+sequence/exclude_columns, and the connector TableFunction SPI
+(spi/ptf/ConnectorTableFunction analogue)."""
+
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.connectors.spi import ColumnMetadata, TableFunction
+from trino_tpu.connectors.tpch import create_tpch_connector
+from trino_tpu.engine import LocalQueryRunner, Session
+
+
+@pytest.fixture(scope="module")
+def runner():
+    r = LocalQueryRunner(Session(catalog="tpch", schema="tiny"))
+    conn = create_tpch_connector()
+    # a connector-provided ptf: multiplication table
+    def times_table(args):
+        n = int(args.get("n", args.get("_0", 3)))
+        cols = [
+            ColumnMetadata("a", T.BIGINT),
+            ColumnMetadata("b", T.BIGINT),
+            ColumnMetadata("product", T.BIGINT),
+        ]
+        rows = [
+            (i, j, i * j)
+            for i in range(1, n + 1)
+            for j in range(1, n + 1)
+        ]
+        return cols, rows
+
+    conn.table_functions["times_table"] = TableFunction(
+        "times_table", times_table, "n x n multiplication table"
+    )
+    r.register_catalog("tpch", conn)
+    return r
+
+
+def test_sequence_positional(runner):
+    rows = runner.execute(
+        "select * from TABLE(sequence(1, 5))"
+    ).rows
+    assert rows == [[1], [2], [3], [4], [5]]
+
+
+def test_sequence_named_args_and_step(runner):
+    rows = runner.execute(
+        "select * from TABLE(sequence(start => 0, stop => 10, step => 5))"
+    ).rows
+    assert rows == [[0], [5], [10]]
+
+
+def test_sequence_column_name(runner):
+    rows = runner.execute(
+        "select sequential_number + 1 from TABLE(sequence(1, 3))"
+    ).rows
+    assert rows == [[2], [3], [4]]
+
+
+def test_sequence_aliased(runner):
+    rows = runner.execute(
+        "select t.n from TABLE(sequence(2, 4)) as t(n) where t.n <> 3"
+    ).rows
+    assert rows == [[2], [4]]
+
+
+def test_sequence_joins_with_tables(runner):
+    rows = runner.execute(
+        "select count(*) from TABLE(sequence(1, 3)) s, region r"
+    ).rows
+    assert rows == [[15]]
+
+
+def test_exclude_columns(runner):
+    rows = runner.execute(
+        "select * from TABLE(exclude_columns("
+        " input => TABLE(region), columns => DESCRIPTOR(r_comment)))"
+        " order by r_regionkey limit 1"
+    ).rows
+    assert rows == [[0, "AFRICA"]]
+
+
+def test_exclude_columns_unknown_column_errors(runner):
+    with pytest.raises(Exception, match="no such columns"):
+        runner.execute(
+            "select * from TABLE(exclude_columns("
+            " input => TABLE(region), columns => DESCRIPTOR(nope)))"
+        )
+
+
+def test_connector_table_function(runner):
+    rows = runner.execute(
+        "select product from TABLE(times_table(n => 4))"
+        " where a = 4 and b = 4"
+    ).rows
+    assert rows == [[16]]
+
+
+def test_unknown_table_function_errors(runner):
+    with pytest.raises(Exception, match="unknown table function"):
+        runner.execute("select * from TABLE(no_such_fn(1))")
+
+
+def test_formatter_roundtrip():
+    from trino_tpu.sql.formatter import format_statement
+    from trino_tpu.sql.parser import parse
+
+    for sql in [
+        "SELECT * FROM TABLE(sequence(1, 5))",
+        "SELECT * FROM TABLE(sequence(start => 1, stop => 5)) AS t(n)",
+        "SELECT * FROM TABLE(exclude_columns(input => TABLE(r),"
+        " columns => DESCRIPTOR(a, b)))",
+    ]:
+        tree = parse(sql)
+        assert parse(format_statement(tree)) == tree

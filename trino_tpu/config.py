@@ -88,6 +88,8 @@ for _name, _type, _default, _desc, _allowed in [
     ("join_reordering_strategy", str, "automatic",
      "cost-based join reordering: automatic | none",
      ("automatic", "none")),
+    ("enable_speculative_execution", bool, True,
+     "FTE: duplicate straggler tasks, first finisher wins", None),
 ]:
     SYSTEM_PROPERTIES.register(_name, _type, _default, _desc, _allowed)
 

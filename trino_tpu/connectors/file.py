@@ -1,0 +1,540 @@
+"""Local-filesystem file connector (CSV / JSON-lines).
+
+The SPI-generality proof (SURVEY.md §2.12): unlike tpch/memory —
+which are in-process — this connector reads external data through the
+full SPI surface (schema discovery, type inference, splits, page
+source, sink, DDL), the shape of plugin/trino-hive's
+file-format path reduced to local files:
+
+  root/
+    <schema>/                directory per schema
+      <table>.csv            single-file table (header row)
+      <table>.jsonl          single-file table (one JSON object/line)
+      <table>/part-*.csv     multi-file table (writes append parts)
+
+TPU-first deltas match the other connectors: parsed files become
+host-side SoA columns with table-stable dictionaries for strings
+(spi.py contract), cached per (path, mtime) so repeated scans skip the
+parse; batches pad to power-of-two capacities for stable compile
+shapes.
+"""
+
+from __future__ import annotations
+
+import csv
+import dataclasses
+import datetime
+import json
+import os
+import threading
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.block import Column, Dictionary, RelBatch, bucket_capacity
+from trino_tpu.connectors.spi import (
+    ColumnMetadata,
+    Connector,
+    ConnectorMetadata,
+    ConnectorPageSink,
+    ConnectorPageSource,
+    ConnectorSplitManager,
+    Split,
+    TableHandle,
+    TableMetadata,
+    TableStatistics,
+)
+
+_EPOCH = datetime.date(1970, 1, 1)
+_SAMPLE_ROWS = 100  # rows examined for type inference
+
+
+# ---------------------------------------------------------------------------
+# type inference (the hive-connector column-coercion analogue, local form)
+# ---------------------------------------------------------------------------
+
+
+def _classify(text: str) -> str:
+    if text == "":
+        return "null"
+    low = text.lower()
+    if low in ("true", "false"):
+        return "boolean"
+    try:
+        int(text)
+        return "bigint"
+    except ValueError:
+        pass
+    try:
+        float(text)
+        return "double"
+    except ValueError:
+        pass
+    try:
+        datetime.date.fromisoformat(text)
+        return "date"
+    except ValueError:
+        pass
+    return "varchar"
+
+
+_WIDEN = {
+    frozenset(("bigint", "double")): "double",
+}
+
+
+def _unify_kinds(kinds) -> str:
+    kinds = {k for k in kinds if k != "null"}
+    if not kinds:
+        return "varchar"
+    if len(kinds) == 1:
+        return next(iter(kinds))
+    widened = _WIDEN.get(frozenset(kinds))
+    return widened or "varchar"
+
+
+_KIND_TO_TYPE = {
+    "boolean": T.BOOLEAN,
+    "bigint": T.BIGINT,
+    "double": T.DOUBLE,
+    "date": T.DATE,
+    "varchar": T.VARCHAR,
+}
+
+
+def _parse_cell(text: str, t: T.DataType):
+    """-> (value, is_null) in the column's storage representation."""
+    if text == "":
+        return 0, True
+    if t.kind == T.TypeKind.BOOLEAN:
+        return text.lower() == "true", False
+    if t.kind == T.TypeKind.DATE:
+        return (datetime.date.fromisoformat(text) - _EPOCH).days, False
+    if t.kind == T.TypeKind.DOUBLE:
+        return float(text), False
+    if t.is_string:
+        return text, False
+    return int(float(text)), False  # bigint; tolerate "3.0"
+
+
+# ---------------------------------------------------------------------------
+# parsed-table cache
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _ParsedTable:
+    columns: List[ColumnMetadata]
+    data: Dict[str, np.ndarray]
+    valid: Dict[str, Optional[np.ndarray]]
+    dictionaries: Dict[str, Optional[Dictionary]]
+    row_count: int
+    stamp: tuple  # (paths, mtimes) fingerprint
+
+
+class _FileStore:
+    def __init__(self, root: str):
+        self.root = root
+        self.lock = threading.Lock()
+        self._cache: Dict[Tuple[str, str], _ParsedTable] = {}
+
+    # -- layout --
+    def table_paths(self, schema: str, table: str) -> List[str]:
+        base = os.path.join(self.root, schema)
+        for ext in (".csv", ".jsonl"):
+            p = os.path.join(base, table + ext)
+            if os.path.isfile(p):
+                return [p]
+        d = os.path.join(base, table)
+        if os.path.isdir(d):
+            return sorted(
+                os.path.join(d, f)
+                for f in os.listdir(d)
+                if f.endswith((".csv", ".jsonl"))
+            )
+        return []
+
+    def _stamp(self, paths: List[str]) -> tuple:
+        return tuple((p, os.path.getmtime(p)) for p in paths)
+
+    def declared_schema(self, schema: str, table: str):
+        """Declared column types from the table's sidecar schema file
+        (the metastore-schema analogue: DDL-declared types win over
+        data inference, exactly hive's schema-vs-file split). None for
+        bare files that were never CREATEd."""
+        p = os.path.join(self.root, schema, table, ".schema.json")
+        if not os.path.isfile(p):
+            return None
+        with open(p) as f:
+            decl = json.load(f)
+        return [
+            ColumnMetadata(
+                c["name"],
+                T.DataType(
+                    T.TypeKind(c["kind"]), c.get("precision"), c.get("scale")
+                ),
+            )
+            for c in decl
+        ]
+
+    def parsed(self, schema: str, table: str) -> _ParsedTable:
+        paths = self.table_paths(schema, table)
+        if not paths:
+            raise KeyError(f"no files for table {schema}.{table}")
+        stamp = self._stamp(paths)
+        key = (schema, table)
+        with self.lock:
+            hit = self._cache.get(key)
+            if hit is not None and hit.stamp == stamp:
+                return hit
+        parsed = self._parse(
+            paths, stamp, self.declared_schema(schema, table)
+        )
+        with self.lock:
+            self._cache[key] = parsed
+        return parsed
+
+    # -- parsing --
+    def _rows_of(self, path: str) -> Tuple[List[str], List[List[str]]]:
+        """-> (column names, rows of raw strings)."""
+        if path.endswith(".jsonl"):
+            names: List[str] = []
+            rows: List[dict] = []
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    obj = json.loads(line)
+                    for k in obj:
+                        if k not in names:
+                            names.append(k)
+                    rows.append(obj)
+            out = []
+            for obj in rows:
+                out.append([
+                    "" if obj.get(k) is None else str(obj.get(k))
+                    for k in names
+                ])
+            return names, out
+        with open(path, newline="") as f:
+            reader = csv.reader(f)
+            try:
+                names = next(reader)
+            except StopIteration:
+                return [], []
+            return names, [row for row in reader]
+
+    def _parse(
+        self, paths: List[str], stamp: tuple, declared=None
+    ) -> _ParsedTable:
+        names: List[str] = []
+        all_rows: List[List[str]] = []
+        for p in paths:
+            file_names, rows = self._rows_of(p)
+            if not names:
+                names = file_names
+            elif file_names and file_names != names:
+                raise ValueError(
+                    f"schema mismatch across parts: {file_names} vs {names}"
+                )
+            all_rows.extend(rows)
+        if declared is not None:
+            if not names:
+                names = [c.name for c in declared]
+            columns = list(declared)
+        else:
+            # infer each column's type from a sample
+            kinds = []
+            for i in range(len(names)):
+                sample = (
+                    row[i] if i < len(row) else ""
+                    for row in all_rows[:_SAMPLE_ROWS]
+                )
+                kinds.append(_unify_kinds(_classify(c) for c in sample))
+            columns = [
+                ColumnMetadata(n, _KIND_TO_TYPE[k])
+                for n, k in zip(names, kinds)
+            ]
+        data: Dict[str, np.ndarray] = {}
+        valid: Dict[str, Optional[np.ndarray]] = {}
+        dicts: Dict[str, Optional[Dictionary]] = {}
+        n = len(all_rows)
+        for i, cm in enumerate(columns):
+            vals = []
+            nulls = np.zeros(n, dtype=bool)
+            for r, row in enumerate(all_rows):
+                cell = row[i] if i < len(row) else ""
+                v, is_null = _parse_cell(cell, cm.type)
+                nulls[r] = is_null
+                vals.append(v)
+            if cm.type.is_string:
+                d = Dictionary(sorted({v for v in vals if isinstance(v, str)}))
+                codes = np.asarray(
+                    [d.code(v) if isinstance(v, str) else 0 for v in vals],
+                    dtype=np.int32,
+                )
+                data[cm.name] = codes
+                dicts[cm.name] = d
+            else:
+                data[cm.name] = np.asarray(vals, dtype=cm.type.dtype)
+                dicts[cm.name] = None
+            valid[cm.name] = ~nulls if nulls.any() else None
+        return _ParsedTable(columns, data, valid, dicts, n, stamp)
+
+
+# ---------------------------------------------------------------------------
+# SPI surfaces
+# ---------------------------------------------------------------------------
+
+
+class FileMetadata(ConnectorMetadata):
+    def __init__(self, store: _FileStore):
+        self.store = store
+
+    def list_schemas(self) -> List[str]:
+        root = self.store.root
+        if not os.path.isdir(root):
+            return []
+        return sorted(
+            d for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d))
+        )
+
+    def list_tables(self, schema: str) -> List[str]:
+        base = os.path.join(self.store.root, schema)
+        if not os.path.isdir(base):
+            return []
+        out = set()
+        for f in os.listdir(base):
+            p = os.path.join(base, f)
+            if os.path.isfile(p) and f.endswith((".csv", ".jsonl")):
+                out.add(f.rsplit(".", 1)[0])
+            elif os.path.isdir(p):
+                out.add(f)
+        return sorted(out)
+
+    def get_table_handle(self, schema: str, table: str) -> Optional[TableHandle]:
+        if not self.store.table_paths(schema, table):
+            return None
+        return TableHandle("file", schema, table)
+
+    def get_table_metadata(self, handle: TableHandle) -> TableMetadata:
+        parsed = self.store.parsed(handle.schema, handle.table)
+        return TableMetadata(
+            handle.schema, handle.table, tuple(parsed.columns)
+        )
+
+    def column_dictionary(self, handle: TableHandle, column: str):
+        parsed = self.store.parsed(handle.schema, handle.table)
+        return parsed.dictionaries.get(column)
+
+    def get_table_statistics(self, handle: TableHandle) -> TableStatistics:
+        parsed = self.store.parsed(handle.schema, handle.table)
+        cols = {}
+        for cm in parsed.columns:
+            arr = parsed.data[cm.name]
+            if cm.type.is_string or len(arr) == 0:
+                continue
+            cols[cm.name] = (
+                float(len(np.unique(arr))), 0.0,
+                float(arr.min()), float(arr.max()),
+            )
+        return TableStatistics(
+            row_count=float(parsed.row_count), columns=cols
+        )
+
+    def create_table(
+        self, schema: str, table: str, columns: Sequence[ColumnMetadata]
+    ) -> TableHandle:
+        d = os.path.join(self.store.root, schema, table)
+        if self.store.table_paths(schema, table):
+            raise ValueError(f"table '{schema}.{table}' already exists")
+        os.makedirs(d, exist_ok=True)
+        # a header-only part records the column ORDER; the sidecar
+        # schema file records the declared TYPES (metastore analogue)
+        with open(os.path.join(d, "part-0.csv"), "w", newline="") as f:
+            csv.writer(f).writerow([c.name for c in columns])
+        with open(os.path.join(d, ".schema.json"), "w") as f:
+            json.dump(
+                [
+                    {
+                        "name": c.name,
+                        "kind": c.type.kind.value,
+                        "precision": c.type.precision,
+                        "scale": c.type.scale,
+                    }
+                    for c in columns
+                ],
+                f,
+            )
+        return TableHandle("file", schema, table)
+
+    def drop_table(self, handle: TableHandle) -> None:
+        import shutil
+
+        for p in self.store.table_paths(handle.schema, handle.table):
+            parent = os.path.dirname(p)
+            if os.path.basename(parent) == handle.table:
+                shutil.rmtree(parent, ignore_errors=True)
+                break
+            os.unlink(p)
+        with self.store.lock:
+            self.store._cache.pop((handle.schema, handle.table), None)
+
+
+class FileSplitManager(ConnectorSplitManager):
+    """One split per row range of the parsed table — the unit of source
+    parallelism and FTE retry, like the hive connector's per-file
+    splits collapsed onto the parse cache."""
+
+    def __init__(self, store: _FileStore):
+        self.store = store
+
+    def get_splits(self, handle: TableHandle, target_split_count: int) -> List[Split]:
+        parsed = self.store.parsed(handle.schema, handle.table)
+        n = parsed.row_count
+        k = max(1, min(target_split_count, max(n, 1)))
+        per = -(-max(n, 1) // k)
+        return [
+            Split(handle, s, (a, min(a + per, n)))
+            for s, a in enumerate(range(0, max(n, 1), per))
+        ]
+
+
+class FilePageSource(ConnectorPageSource):
+    def __init__(self, store: _FileStore):
+        self.store = store
+
+    def batches(
+        self, split: Split, columns: Sequence[str], batch_rows: int
+    ) -> Iterator[RelBatch]:
+        t = self.store.parsed(split.table.schema, split.table.table)
+        lo, hi = split.row_range
+        types = {c.name: c.type for c in t.columns}
+        for a in range(lo, hi, batch_rows):
+            b = min(a + batch_rows, hi)
+            n = b - a
+            cap = bucket_capacity(n)
+            cols = []
+            for name in columns:
+                typ = types[name]
+                arr = np.zeros(cap, dtype=typ.dtype)
+                arr[:n] = t.data[name][a:b]
+                v = None
+                if t.valid[name] is not None:
+                    vm = np.zeros(cap, dtype=bool)
+                    vm[:n] = t.valid[name][a:b]
+                    v = jnp.asarray(vm)
+                cols.append(
+                    Column(typ, jnp.asarray(arr), v, t.dictionaries[name])
+                )
+            live = None
+            if n != cap:
+                lv = np.zeros(cap, dtype=bool)
+                lv[:n] = True
+                live = jnp.asarray(lv)
+            yield RelBatch(cols, live)
+        if hi == lo:
+            yield RelBatch(
+                [
+                    Column(
+                        types[name],
+                        jnp.zeros(16, dtype=types[name].dtype),
+                        None,
+                        t.dictionaries[name],
+                    )
+                    for name in columns
+                ],
+                jnp.zeros(16, dtype=jnp.bool_),
+            )
+
+
+class FilePageSink(ConnectorPageSink):
+    """Each write lands a new part file (hive's write-then-rename
+    discipline: parts are written under a dotted temp name and renamed
+    into place at finish, so readers never see partial parts)."""
+
+    def __init__(self, store: _FileStore, handle: TableHandle):
+        self.store = store
+        self.handle = handle
+        self.rows = 0
+        d = os.path.join(store.root, handle.schema, handle.table)
+        if os.path.isfile(d + ".csv") or os.path.isfile(d + ".jsonl"):
+            raise ValueError(
+                "single-file tables are read-only; CREATE the table to "
+                "get a multi-part directory"
+            )
+        os.makedirs(d, exist_ok=True)
+        # unique part names: concurrent INSERTs must never collide on a
+        # count-derived index (hive's UUID-suffixed write files)
+        import uuid
+
+        part = uuid.uuid4().hex[:12]
+        self._final = os.path.join(d, f"part-{part}.csv")
+        self._tmp = os.path.join(d, f".part-{part}.csv.tmp")
+        self._file = open(self._tmp, "w", newline="")
+        self._writer = csv.writer(self._file)
+        parsed = self.store.parsed(handle.schema, handle.table)
+        self._columns = parsed.columns
+        self._writer.writerow([c.name for c in self._columns])
+
+    def append(self, batch: RelBatch) -> None:
+        import jax
+
+        live = np.asarray(jax.device_get(batch.live_mask()))
+        host_cols = []
+        for cm, col in zip(self._columns, batch.columns):
+            data = np.asarray(jax.device_get(col.data))[live]
+            valid = (
+                np.asarray(jax.device_get(col.valid))[live]
+                if col.valid is not None
+                else None
+            )
+            host_cols.append((cm, data, valid, col.dictionary))
+        n = int(live.sum())
+        for r in range(n):
+            row = []
+            for cm, data, valid, d in host_cols:
+                if valid is not None and not valid[r]:
+                    row.append("")
+                elif cm.type.is_string:
+                    row.append(d.values[int(data[r])] if d else "")
+                elif cm.type.kind == T.TypeKind.DATE:
+                    row.append(
+                        (_EPOCH + datetime.timedelta(days=int(data[r])))
+                        .isoformat()
+                    )
+                elif cm.type.kind == T.TypeKind.BOOLEAN:
+                    row.append("true" if data[r] else "false")
+                else:
+                    row.append(data[r])
+            self._writer.writerow(row)
+        self.rows += n
+
+    def finish(self) -> int:
+        self._file.close()
+        os.replace(self._tmp, self._final)
+        return self.rows
+
+
+class FileConnector(Connector):
+    def __init__(self, root: str):
+        store = _FileStore(root)
+        super().__init__(
+            "file",
+            FileMetadata(store),
+            FileSplitManager(store),
+            FilePageSource(store),
+        )
+        self.store = store
+
+    def page_sink(self, handle: TableHandle, transaction=None) -> ConnectorPageSink:
+        return FilePageSink(self.store, handle)
+
+
+def create_file_connector(root: str) -> Connector:
+    """plugin entry point (Plugin.getConnectorFactories analogue)."""
+    return FileConnector(root)

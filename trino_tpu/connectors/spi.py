@@ -138,6 +138,20 @@ class ConnectorPageSink:
         raise NotImplementedError
 
 
+@dataclasses.dataclass(frozen=True)
+class TableFunction:
+    """A connector-provided polymorphic table function
+    (spi/ptf/ConnectorTableFunction.java analogue, reduced to the
+    scalar-argument form: `fn(args) -> (columns, rows)` evaluated at
+    plan time; table-valued arguments are handled engine-side for the
+    built-ins, see sql/analyzer.py)."""
+
+    name: str
+    # fn(args: dict[str, value]) -> (List[ColumnMetadata], List[List])
+    fn: Any
+    description: str = ""
+
+
 class Connector:
     """One catalog's capability bundle (spi/connector/Connector.java)."""
 
@@ -147,11 +161,13 @@ class Connector:
         metadata: ConnectorMetadata,
         split_manager: Optional[ConnectorSplitManager] = None,
         page_source: Optional[ConnectorPageSource] = None,
+        table_functions: Optional[Dict[str, "TableFunction"]] = None,
     ):
         self.name = name
         self.metadata = metadata
         self.split_manager = split_manager
         self.page_source = page_source
+        self.table_functions = table_functions or {}
 
     def page_sink(self, handle: TableHandle, transaction=None) -> ConnectorPageSink:
         """`transaction` is this connector's ConnectorTransactionHandle

@@ -54,6 +54,9 @@ class Session:
     # cost-based join reorderer (JOIN_REORDERING_STRATEGY analogue)
     enable_optimizer: bool = True
     join_reordering_strategy: str = "automatic"
+    # FTE straggler mitigation: duplicate slow tasks, first wins
+    # (retry-policy=TASK speculative execution)
+    enable_speculative_execution: bool = True
 
     def set_property(self, name: str, value) -> None:
         """SET SESSION entry point — validated through the typed

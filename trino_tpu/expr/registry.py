@@ -34,6 +34,10 @@ class FunctionMetadata:
     # arg types -> result DataType; None = analyzer special-cases typing
     type_rule: Optional[Callable[[Sequence[T.DataType]], T.DataType]] = None
     canonical: Optional[str] = None  # IR name when != `name`
+    # argument positions that must be literal constants — checked at
+    # ANALYSIS time so a column argument fails with AnalysisError, not
+    # a binder assertion mid-execution
+    const_args: Tuple[int, ...] = ()
 
 
 class FunctionRegistry:
@@ -84,10 +88,10 @@ _SAME = lambda a: a[0]  # noqa: E731
 
 
 def _reg(name, category, lo, hi, returns, desc, aliases=(),
-         rule=None, canonical=None):
+         rule=None, canonical=None, const_args=()):
     REGISTRY.register(FunctionMetadata(
         name, category, lo, hi, returns, desc, tuple(aliases), rule,
-        canonical,
+        canonical, tuple(const_args),
     ))
 
 
@@ -186,8 +190,9 @@ for name, lo, hi, ret, desc, aliases in [
 ]:
     _reg(name, "scalar", lo, hi, ret, desc, aliases)
 
-# --- registry-typed scalars (added breadth; typing resolved HERE) ---
-for name, lo, hi, rule, ret, desc, aliases in [
+# --- registry-typed scalars (added breadth; typing resolved HERE).
+# Each entry: (name, lo, hi, rule, ret, desc, aliases[, const_args]) ---
+for entry in [
     # hashing / encoding (operator/scalar/VarbinaryFunctions analogues;
     # digests render as lowercase hex varchar — the engine's varbinary
     # carrier is dictionary-encoded varchar)
@@ -203,9 +208,9 @@ for name, lo, hi, rule, ret, desc, aliases in [
     # returns ARRAY, which this engine only has as constants; occupying
     # the name with string semantics would silently diverge)
     ("levenshtein_distance", 2, 2, _BIGINT, "bigint",
-     "edit distance to a constant", ()),
+     "edit distance to a constant", (), (1,)),
     ("hamming_distance", 2, 2, _BIGINT, "bigint",
-     "differing positions vs a constant of equal length", ()),
+     "differing positions vs a constant of equal length", (), (1,)),
     # URL functions (operator/scalar/UrlFunctions)
     ("url_extract_protocol", 1, 1, _VARCHAR, "varchar", "scheme of a URL", ()),
     ("url_extract_host", 1, 1, _VARCHAR, "varchar", "host of a URL", ()),
@@ -214,23 +219,26 @@ for name, lo, hi, rule, ret, desc, aliases in [
     ("url_extract_query", 1, 1, _VARCHAR, "varchar", "query of a URL", ()),
     ("url_extract_fragment", 1, 1, _VARCHAR, "varchar", "fragment of a URL", ()),
     ("url_extract_parameter", 2, 2, _VARCHAR, "varchar",
-     "value of a query parameter", ()),
+     "value of a query parameter", (), (1,)),
     ("url_encode", 1, 1, _VARCHAR, "varchar", "percent-encode", ()),
     ("url_decode", 1, 1, _VARCHAR, "varchar", "percent-decode", ()),
     # JSON (operator/scalar/JsonFunctions; path subset $.a.b[0])
     ("json_extract_scalar", 2, 2, _VARCHAR, "varchar",
-     "scalar at a JSONPath ($.a.b[0] subset)", ()),
+     "scalar at a JSONPath ($.a.b[0] subset)", (), (1,)),
     ("json_array_length", 1, 1, _BIGINT, "bigint",
      "length of a JSON array", ()),
     ("json_size", 2, 2, _BIGINT, "bigint",
-     "size of the value at a JSONPath", ()),
+     "size of the value at a JSONPath", (), (1,)),
     # date breadth
     ("year_of_week", 1, 1, _BIGINT, "bigint",
      "ISO week-numbering year", ("yow",)),
     ("from_iso8601_date", 1, 1, lambda a: T.DATE, "date",
      "parse YYYY-MM-DD", ()),
 ]:
-    _reg(name, "scalar", lo, hi, ret, desc, aliases, rule)
+    name, lo, hi, rule, ret, desc, aliases = entry[:7]
+    const_args = entry[7] if len(entry) > 7 else ()
+    _reg(name, "scalar", lo, hi, ret, desc, aliases, rule,
+         const_args=const_args)
 
 # --- aggregates (typed/validated in the analyzer; catalog surface) ---
 for name, lo, hi, ret, desc in [

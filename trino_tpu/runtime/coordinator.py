@@ -42,12 +42,14 @@ class QueryScheduler:
         catalogs: CatalogManager,
         session: Session,
         hash_partitions: Optional[int] = None,
+        collect_stats: bool = False,
     ):
         self.query_id = query_id
         self.subplan = subplan
         self.workers = workers
         self.catalogs = catalogs
         self.session = session
+        self.collect_stats = collect_stats
         self.hash_partitions = hash_partitions or min(
             len(workers), session.hash_partition_count
         )
@@ -75,7 +77,17 @@ class QueryScheduler:
         for sp in order:
             for c in sp.children:
                 consumer_counts[c.fragment.id] = task_counts[sp.fragment.id]
-        rr = itertools.count()
+        from trino_tpu.runtime.node_scheduler import UniformNodeSelector
+
+        # least-loaded placement with a per-node cap (NodeScheduler /
+        # UniformNodeSelector analogue; replaces blind round-robin)
+        selector = UniformNodeSelector(
+            max_tasks_per_node=max(
+                2,
+                (sum(task_counts.values()) + len(self.workers) - 1)
+                // max(len(self.workers), 1),
+            )
+        )
         for sp in order:
             f = sp.fragment
             tc = task_counts[f.id]
@@ -107,8 +119,9 @@ class QueryScheduler:
                     batch_rows=self.session.batch_rows,
                     target_splits=max(self.session.target_splits, tc),
                     dynamic_filtering=self.session.enable_dynamic_filtering,
+                    collect_stats=self.collect_stats,
                 )
-                worker = self.workers[next(rr) % len(self.workers)]
+                worker = selector.select(self.workers)
                 worker.create_task(spec)
                 created.append((worker, str(task_id)))
             self.tasks[f.id] = created
@@ -221,6 +234,8 @@ class DistributedQueryRunner:
             output = self._analyze(stmt.query)
             self._check_access(output, identity)
             subplan = plan_distributed(output, self.catalogs)
+            if stmt.analyze:
+                return self._explain_analyze(subplan)
             return MaterializedResult(
                 [[explain_distributed(subplan)]], ["Query Plan"], [T.VARCHAR]
             )
@@ -296,6 +311,64 @@ class DistributedQueryRunner:
             finally:
                 scheduler.abort()
         raise last_error
+
+    def _explain_analyze(self, subplan) -> MaterializedResult:
+        """Distributed EXPLAIN ANALYZE: run the query with operator
+        instrumentation on, pull each task's OperatorStats from its
+        status (the TaskInfo aggregation path, Driver -> Task -> Stage),
+        and render the fragment plan annotated with per-stage operator
+        lines summed across that stage's tasks."""
+        query_id = f"q{next(_query_counter)}"
+        scheduler = QueryScheduler(
+            query_id, subplan, self.workers, self.catalogs, self.session,
+            self.hash_partitions, collect_stats=True,
+        )
+        try:
+            root_handle, root_tid = scheduler.start()
+            self._collect(scheduler, root_handle, root_tid)
+            lines = [explain_distributed(subplan)]
+            for fid in sorted(scheduler.tasks):
+                merged: List[List[dict]] = []
+                n_tasks = 0
+                for handle, tid in scheduler.tasks[fid]:
+                    st = handle.task_state(tid)
+                    stats = st.get("stats")
+                    if stats is None:
+                        continue
+                    n_tasks += 1
+                    for pi, group in enumerate(stats):
+                        while len(merged) <= pi:
+                            merged.append([])
+                        for oi, op in enumerate(group):
+                            if oi >= len(merged[pi]):
+                                merged[pi].append(dict(op))
+                            else:
+                                acc = merged[pi][oi]
+                                for k, v in op.items():
+                                    if isinstance(v, (int, float)):
+                                        acc[k] = acc.get(k, 0) + v
+                lines.append(f"\nFragment {fid} [{n_tasks} tasks]:")
+                for pi, group in enumerate(merged):
+                    lines.append(f"  Pipeline {pi}:")
+                    for op in group:
+                        total_ms = (
+                            op.get("add_input_s", 0.0)
+                            + op.get("get_output_s", 0.0)
+                            + op.get("finish_s", 0.0)
+                        ) * 1000
+                        lines.append(
+                            f"    {op.get('operator')}: "
+                            f"in={op.get('input_rows', 0)} rows/"
+                            f"{op.get('input_batches', 0)} batches, "
+                            f"out={op.get('output_rows', 0)} rows/"
+                            f"{op.get('output_batches', 0)} batches, "
+                            f"wall={total_ms:.1f}ms"
+                        )
+            return MaterializedResult(
+                [["\n".join(lines)]], ["Query Plan"], [T.VARCHAR]
+            )
+        finally:
+            scheduler.abort()
 
     def _execute_fte(self, subplan) -> List[list]:
         """retry_policy=TASK: FTE over the spooled exchange."""

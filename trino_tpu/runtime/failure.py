@@ -26,6 +26,9 @@ class FailureRule:
     attempts: Tuple[int, ...] = (0,)  # which attempt numbers fail
     where: str = "start"  # "start" | "mid"
     max_hits: int = 1_000_000
+    # straggler simulation: sleep this long instead of raising
+    # (drives the speculative-execution path in tests)
+    stall_s: float = 0.0
 
 
 class FailureInjector:
@@ -61,6 +64,14 @@ class FailureInjector:
                 if self._hits.get(i, 0) >= r.max_hits:
                     continue
                 self._hits[i] = self._hits.get(i, 0) + 1
+                if r.stall_s > 0:
+                    stall = r.stall_s
+                    break  # sleep outside the lock
                 raise InjectedFailure(
                     f"injected {where} failure at {task_id}"
                 )
+            else:
+                return
+        import time
+
+        time.sleep(stall)

@@ -26,6 +26,12 @@ class TaskRetriesExceeded(RuntimeError):
     pass
 
 
+class _LaunchFailed(Exception):
+    def __init__(self, handle, exc):
+        self.handle = handle
+        self.exc = exc
+
+
 class FaultTolerantQueryScheduler:
     def __init__(
         self,
@@ -52,6 +58,22 @@ class FaultTolerantQueryScheduler:
         # (fragment, partition) -> committed task key
         self.committed: Dict[Tuple[int, int], str] = {}
         self.retries = 0
+        # memory-aware placement (BinPackingNodeAllocatorService +
+        # PartitionMemoryEstimator analogues, runtime/node_scheduler.py)
+        from trino_tpu.runtime.node_scheduler import (
+            BinPackingNodeAllocator,
+            PartitionMemoryEstimator,
+        )
+
+        self.allocator = BinPackingNodeAllocator()
+        self.estimator = PartitionMemoryEstimator()
+        # straggler mitigation: duplicate attempts for tasks running far
+        # beyond the stage's median; first finisher commits
+        # (FTE speculative execution)
+        self.enable_speculation = getattr(
+            session, "enable_speculative_execution", True
+        )
+        self.speculative_hits = 0
 
     # scheduling is stage-by-stage: children complete before parents run
     def run(self) -> Tuple[object, str]:
@@ -101,68 +123,144 @@ class FaultTolerantQueryScheduler:
             ]
             for c in sp.children
         }
+        est_bytes = self.estimator.estimate(f.id)
         pending = {p: 0 for p in range(tc)}  # partition -> attempt
-        running: Dict[int, Tuple[object, str]] = {}
+        # partition -> [(handle, tid, attempt, started_at)]; entry 0 is
+        # the primary, entry 1 (if any) the speculative duplicate
+        running: Dict[int, List[Tuple]] = {}
+        durations: List[float] = []  # completed-task wall times
         last_handle = None
         avoid: Dict[int, object] = {}  # partition -> failed handle
-        while pending or running:
+
+        def launch(p: int, attempt: int, avoid_h=None):
             active = list(self._active_fn())
             if not active:
+                raise TaskRetriesExceeded("no active workers")
+            # memory-aware bin packing; failed node excluded
+            handle = self.allocator.acquire(active, est_bytes, avoid=avoid_h)
+            task_id = TaskId(self.query_id, f.id, p, attempt)
+            spec = TaskSpec(
+                task_id=task_id,
+                fragment=f,
+                n_output_partitions=n_out,
+                remote_schemas=remote,
+                scan_slice=(p, tc) if f.partitioning == "source" else None,
+                input_locations=input_locations,
+                batch_rows=self.session.batch_rows,
+                target_splits=max(self.session.target_splits, tc),
+                spool_dir=self.spool_dir,
+                dynamic_filtering=self.session.enable_dynamic_filtering,
+            )
+            try:
+                handle.create_task(spec)
+            except Exception as exc:
+                self.allocator.release(handle, est_bytes)
+                raise _LaunchFailed(handle, exc)
+            return (handle, str(task_id), attempt, time.monotonic())
+
+        def settle(p: int, winner, losers):
+            """Commit the winner; cancel+release live sibling attempts.
+            Entries that already FAILED were released in the poll loop
+            and must not be passed here (double-release would corrupt
+            the allocator's reservations)."""
+            handle, tid, _, t0 = winner
+            durations.append(time.monotonic() - t0)
+            self.committed[(f.id, p)] = tid
+            self.allocator.release(handle, est_bytes)
+            for h, other_tid, _, _ in losers:
+                self.allocator.release(h, est_bytes)
+                try:
+                    h.remove_task(other_tid)
+                except Exception:
+                    pass
+            return handle
+
+        while pending or running:
+            if not list(self._active_fn()):
                 raise TaskRetriesExceeded("no active workers")
             # launch
             for p in sorted(pending):
                 attempt = pending.pop(p)
-                candidates = [w for w in active if w is not avoid.get(p)] or active
-                handle = candidates[
-                    (p + attempt) % len(candidates)
-                ]
-                task_id = TaskId(self.query_id, f.id, p, attempt)
-                spec = TaskSpec(
-                    task_id=task_id,
-                    fragment=f,
-                    n_output_partitions=n_out,
-                    remote_schemas=remote,
-                    scan_slice=(p, tc) if f.partitioning == "source" else None,
-                    input_locations=input_locations,
-                    batch_rows=self.session.batch_rows,
-                    target_splits=max(self.session.target_splits, tc),
-                    spool_dir=self.spool_dir,
-                    dynamic_filtering=self.session.enable_dynamic_filtering,
-                )
                 try:
-                    handle.create_task(spec)
-                except Exception as exc:
+                    running[p] = [launch(p, attempt, avoid.get(p))]
+                except _LaunchFailed as lf:
                     # launch failure == task failure: re-queue on another
                     # node, same retry budget (the status-failure path)
                     if attempt + 1 > self.max_task_retries:
                         raise TaskRetriesExceeded(
-                            f"task {task_id} could not launch after "
-                            f"{attempt + 1} attempts: {exc}"
+                            f"task {self.query_id}.{f.id}.{p} could not "
+                            f"launch after {attempt + 1} attempts: {lf.exc}"
                         )
                     self.retries += 1
-                    avoid[p] = handle
+                    avoid[p] = lf.handle
                     pending[p] = attempt + 1
-                    continue
-                running[p] = (handle, str(task_id), attempt)
             # poll
             time.sleep(0.01)
-            for p, (handle, tid, attempt) in list(running.items()):
-                try:
-                    st = handle.task_state(tid)
-                except Exception as e:
-                    st = {"state": "failed", "failure": f"worker unreachable: {e}"}
-                if st["state"] == "finished":
-                    del running[p]
-                    self.committed[(f.id, p)] = tid
-                    last_handle = handle
-                elif st["state"] == "failed":
-                    del running[p]
-                    if attempt + 1 > self.max_task_retries:
-                        raise TaskRetriesExceeded(
-                            f"task {tid} failed after {attempt + 1} attempts: "
-                            f"{st.get('failure')}"
+            now = time.monotonic()
+            median = sorted(durations)[len(durations) // 2] if durations else None
+            for p, entries in list(running.items()):
+                finished_entry = None
+                next_entries = []
+                for entry in entries:
+                    handle, tid, attempt, t0 = entry
+                    try:
+                        st = handle.task_state(tid)
+                    except Exception as e:
+                        st = {
+                            "state": "failed",
+                            "failure": f"worker unreachable: {e}",
+                        }
+                    if st["state"] == "finished":
+                        if finished_entry is None:
+                            finished_entry = entry
+                        else:  # both attempts finished: keep the first
+                            next_entries.append(entry)
+                        continue
+                    if st["state"] == "failed":
+                        self.allocator.release(handle, est_bytes)
+                        self.estimator.register_failure(
+                            f.id, st.get("failure")
                         )
-                    self.retries += 1
-                    avoid[p] = handle
-                    pending[p] = attempt + 1
+                        if len(entries) == 1 and attempt + 1 > self.max_task_retries:
+                            raise TaskRetriesExceeded(
+                                f"task {tid} failed after {attempt + 1} "
+                                f"attempts: {st.get('failure')}"
+                            )
+                        self.retries += 1
+                        avoid[p] = handle
+                        continue  # drop this attempt, keep any sibling
+                    next_entries.append(entry)
+                if finished_entry is not None:
+                    last_handle = settle(p, finished_entry, next_entries)
+                    del running[p]
+                    continue
+                if not next_entries:
+                    del running[p]
+                    next_attempt = entries[-1][2] + 1
+                    if next_attempt > self.max_task_retries:
+                        raise TaskRetriesExceeded(
+                            f"partition {p} of fragment {f.id} failed "
+                            f"after {next_attempt} attempts"
+                        )
+                    pending[p] = next_attempt
+                    continue
+                running[p] = next_entries
+                # speculation: the stage is mostly done, this partition
+                # is a straggler, and no duplicate is in flight yet
+                if (
+                    self.enable_speculation
+                    and len(next_entries) == 1
+                    and median is not None
+                    and len(durations) * 2 >= tc
+                    and now - next_entries[0][3]
+                    > max(2.0 * median, 0.25)
+                    and next_entries[0][2] < self.max_task_retries
+                ):
+                    handle, _, attempt, _ = next_entries[0]
+                    try:
+                        dup = launch(p, attempt + 1, avoid_h=handle)
+                        running[p].append(dup)
+                        self.speculative_hits += 1
+                    except _LaunchFailed:
+                        pass  # speculation is best-effort
         return last_handle

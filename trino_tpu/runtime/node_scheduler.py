@@ -1,0 +1,153 @@
+"""Node selection + memory-aware task placement.
+
+Analogues of the reference's scheduling policies (SURVEY.md §2.3):
+
+- `UniformNodeSelector` — least-loaded placement with a per-node task
+  cap and optional locality preference
+  (main/execution/scheduler/NodeScheduler.java:54,
+  UniformNodeSelector.java:67 — maxSplitsPerNode / preferred-host
+  selection, with tasks as this engine's scheduling unit).
+- `PartitionMemoryEstimator` — per-fragment task-memory estimates that
+  GROW after memory failures, so retries re-place onto roomier nodes
+  (ExponentialGrowthPartitionMemoryEstimator).
+- `BinPackingNodeAllocator` — fits estimated task memory into per-node
+  budgets, choosing the node with the most free room
+  (BinPackingNodeAllocatorService.java:82). When nothing fits it falls
+  back to the emptiest node rather than queueing — this engine's
+  workers spill under pressure, so over-admission degrades instead of
+  OOM-killing.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+
+class UniformNodeSelector:
+    """Pick the active node with the fewest running tasks; nodes at the
+    cap are skipped (all-at-cap falls back to global least-loaded, the
+    reference's best-effort under full cluster)."""
+
+    def __init__(self, max_tasks_per_node: Optional[int] = None):
+        self.max_tasks_per_node = max_tasks_per_node
+        # local assignment ledger: placements increment locally; each
+        # handle's remote status() is probed ONCE (its pre-existing
+        # load), not per placement — a slow worker must not serialize
+        # every launch behind an HTTP round trip
+        self._assigned: Dict[int, int] = {}
+        self._baseline: Dict[int, int] = {}
+        self._lock = threading.Lock()
+
+    def _load(self, handle) -> int:
+        key = id(handle)
+        if key not in self._baseline:
+            try:
+                self._baseline[key] = int(handle.status().get("tasks", 0))
+            except Exception:
+                self._baseline[key] = 0
+        return self._baseline[key] + self._assigned.get(key, 0)
+
+    def select(self, active: Sequence, preferred: Sequence = ()) -> object:
+        if not active:
+            raise RuntimeError("no active workers")
+        with self._lock:
+            pools = [p for p in (list(preferred), list(active)) if p]
+            for pool in pools:
+                loads = [(self._load(h), i, h) for i, h in enumerate(pool)]
+                loads.sort(key=lambda t: (t[0], t[1]))
+                for load, _, h in loads:
+                    if (
+                        self.max_tasks_per_node is None
+                        or load < self.max_tasks_per_node
+                    ):
+                        self._assigned[id(h)] = (
+                            self._assigned.get(id(h), 0) + 1
+                        )
+                        return h
+            # every node at cap: least-loaded overall
+            _, _, h = min(
+                ((self._load(h), i, h) for i, h in enumerate(active)),
+                key=lambda t: (t[0], t[1]),
+            )
+            self._assigned[id(h)] = self._assigned.get(id(h), 0) + 1
+            return h
+
+    def release(self, handle) -> None:
+        with self._lock:
+            n = self._assigned.get(id(handle), 0)
+            if n > 1:
+                self._assigned[id(handle)] = n - 1
+            else:
+                self._assigned.pop(id(handle), None)
+
+
+class PartitionMemoryEstimator:
+    """Per-fragment estimated task memory; doubles after each
+    memory-classed failure (the reference's exponential growth)."""
+
+    GROWTH = 2.0
+
+    def __init__(self, default_bytes: int = 64 << 20):
+        self.default_bytes = default_bytes
+        self._est: Dict[int, float] = {}
+
+    def estimate(self, fragment_id: int) -> int:
+        return int(self._est.get(fragment_id, self.default_bytes))
+
+    def register_failure(self, fragment_id: int, failure: Optional[str]) -> None:
+        text = (failure or "").lower()
+        if "memory" in text or "oom" in text:
+            cur = self._est.get(fragment_id, self.default_bytes)
+            self._est[fragment_id] = cur * self.GROWTH
+
+
+class BinPackingNodeAllocator:
+    """Track estimated bytes outstanding per node; place each task on
+    the node with the most free budget that fits."""
+
+    DEFAULT_NODE_BYTES = 1 << 30
+
+    def __init__(self, capacity_fn=None):
+        """capacity_fn(handle) -> node budget in bytes (defaults to the
+        handle's memory pool size, else DEFAULT_NODE_BYTES)."""
+        self._capacity_fn = capacity_fn or self._default_capacity
+        self._used: Dict[int, float] = {}
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _default_capacity(handle) -> int:
+        pool = getattr(handle, "memory_pool", None)
+        total = getattr(pool, "total_bytes", None)
+        return int(total) if total else BinPackingNodeAllocator.DEFAULT_NODE_BYTES
+
+    def free_bytes(self, handle) -> float:
+        return self._capacity_fn(handle) - self._used.get(id(handle), 0.0)
+
+    def acquire(
+        self, active: Sequence, estimated_bytes: int,
+        avoid: Optional[object] = None,
+    ) -> object:
+        candidates = [h for h in active if h is not avoid] or list(active)
+        if not candidates:
+            raise RuntimeError("no active workers")
+        with self._lock:
+            fitting = [
+                h for h in candidates
+                if self.free_bytes(h) >= estimated_bytes
+            ]
+            pool = fitting or candidates  # over-admit rather than starve
+            best = max(
+                range(len(pool)), key=lambda i: self.free_bytes(pool[i])
+            )
+            h = pool[best]
+            self._used[id(h)] = self._used.get(id(h), 0.0) + estimated_bytes
+            return h
+
+    def release(self, handle, estimated_bytes: int) -> None:
+        with self._lock:
+            left = self._used.get(id(handle), 0.0) - estimated_bytes
+            if left > 0:
+                self._used[id(handle)] = left
+            else:
+                self._used.pop(id(handle), None)

@@ -58,31 +58,33 @@ class StateMachine:
                 current = self._state
             fn(current)
 
-    def _fire(self, listeners, state) -> None:
-        with self._dispatch:
-            for fn in listeners:
-                fn(state)
-
     def set(self, new_state: str) -> bool:
         """Unconditional transition; returns False if already terminal
-        (terminal states latch, StateMachine.setIf contract)."""
-        with self._lock:
-            if self._state in self._terminal or new_state == self._state:
-                return False
-            self._state = new_state
-            listeners = list(self._listeners)
-            self._changed.notify_all()
-        self._fire(listeners, new_state)
+        (terminal states latch, StateMachine.setIf contract). The
+        dispatch lock is held ACROSS transition + delivery so two
+        concurrent set() calls cannot deliver their states to listeners
+        out of transition order."""
+        with self._dispatch:
+            with self._lock:
+                if self._state in self._terminal or new_state == self._state:
+                    return False
+                self._state = new_state
+                listeners = list(self._listeners)
+                self._changed.notify_all()
+            for fn in listeners:
+                fn(new_state)
         return True
 
     def compare_and_set(self, expected: str, new_state: str) -> bool:
-        with self._lock:
-            if self._state != expected or self._state in self._terminal:
-                return False
-            self._state = new_state
-            listeners = list(self._listeners)
-            self._changed.notify_all()
-        self._fire(listeners, new_state)
+        with self._dispatch:
+            with self._lock:
+                if self._state != expected or self._state in self._terminal:
+                    return False
+                self._state = new_state
+                listeners = list(self._listeners)
+                self._changed.notify_all()
+            for fn in listeners:
+                fn(new_state)
         return True
 
     def wait_for(

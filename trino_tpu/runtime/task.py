@@ -56,6 +56,10 @@ class TaskSpec:
     # (SpoolingExchangeOutputBuffer path, SURVEY.md §3.5)
     spool_dir: Optional[str] = None
     dynamic_filtering: bool = True
+    # EXPLAIN ANALYZE: wrap operators with timing/row instrumentation
+    # and report OperatorStats in task status (TaskInfo.getStats path).
+    # Off by default — row counting forces a per-batch device sync.
+    collect_stats: bool = False
 
 
 def _resolve_fetch(location):
@@ -125,6 +129,17 @@ class TaskExecution:
         self._injector = failure_injector
         self._memory_pool = memory_pool
         self._thread: Optional[threading.Thread] = None
+        self._stat_groups = None  # [[OperatorStats]] when collect_stats
+
+    def operator_stats(self):
+        """JSON-ready [[dict]] per pipeline, or None."""
+        import dataclasses as _dc
+
+        if self._stat_groups is None:
+            return None
+        return [
+            [_dc.asdict(s) for s in group] for group in self._stat_groups
+        ]
 
     @property
     def state(self) -> str:
@@ -201,6 +216,18 @@ class TaskExecution:
                     spec.n_output_partitions,
                 )
             )
+            if spec.collect_stats:
+                # distributed EXPLAIN ANALYZE: per-operator stats travel
+                # back in task status (OperatorStats -> TaskInfo path)
+                from trino_tpu.exec.stats import instrument
+
+                stat_groups = []
+                for p in pipelines:
+                    p.operators, stats = instrument(p.operators)
+                    stat_groups.append(stats)
+                chain, stats = instrument(chain)
+                stat_groups.append(stats)
+                self._stat_groups = stat_groups
             for p in pipelines:
                 Driver(p).run()
             Driver(Pipeline(chain)).run()

@@ -55,7 +55,11 @@ class Worker:
 
     def task_state(self, task_id) -> dict:
         t = self._tasks[str(task_id)]
-        return {"state": t.state, "failure": t.failure}
+        out = {"state": t.state, "failure": t.failure}
+        stats = t.operator_stats()
+        if stats is not None:
+            out["stats"] = stats
+        return out
 
     def get_results(
         self, task_id, partition: int, token: int,

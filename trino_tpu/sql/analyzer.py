@@ -511,6 +511,13 @@ class ExprConverter:
             raise AnalysisError(str(ex))
         if hit is not None:
             canonical, out_t = hit
+            meta = REGISTRY.get(name)
+            for pos in meta.const_args:
+                if pos < len(args) and not isinstance(args[pos], ir.Literal):
+                    raise AnalysisError(
+                        f"{meta.name}(): argument {pos + 1} must be a"
+                        " constant"
+                    )
             return ir.Call(canonical, args, out_t)
         raise AnalysisError(f"unknown function {name}()")
 
@@ -1358,6 +1365,8 @@ class Analyzer:
             return self._plan_table(rel)
         if isinstance(rel, ast.UnnestRelation):
             return self._plan_unnest(rel)
+        if isinstance(rel, ast.TableFunctionRelation):
+            return self._plan_table_function(rel, ctes)
         if isinstance(rel, ast.SubqueryRelation):
             node, scope, names = self.plan_query(rel.query, ctes)
             if rel.column_aliases:
@@ -1416,6 +1425,132 @@ class Analyzer:
             [ScopeField(rel.alias, nm, t) for nm, t in zip(names, col_types)]
         )
         return RelationItem(node, scope, float(max(n, 1)))
+
+    def _plan_table_function(
+        self, rel: ast.TableFunctionRelation, ctes
+    ) -> RelationItem:
+        """FROM TABLE(fn(...)) — polymorphic table functions
+        (spi/ptf/ConnectorTableFunction.java surface). Built-ins
+        `sequence` and `exclude_columns` are engine-side
+        (the reference's io.trino.operator.table.Sequence /
+        ExcludeColumns); other names resolve to the connector's
+        TableFunction registry and evaluate at plan time over literal
+        arguments."""
+        fn_name = rel.name[-1].lower()
+        # assemble arguments: positional list + named dict
+        named: Dict[str, ast.Expression] = {
+            k.lower(): v for k, v in rel.named_args
+        }
+
+        def scalar(e) -> object:
+            if e is None:
+                raise AnalysisError(
+                    f"table function {fn_name}(): missing required argument"
+                )
+            conv = ExprConverter(Scope([]))
+            lit = conv.convert(e)
+            if not isinstance(lit, ir.Literal):
+                raise AnalysisError(
+                    f"table function {fn_name}() arguments must be"
+                    " constants"
+                )
+            return lit.value
+
+        if fn_name == "sequence" and len(rel.name) == 1:
+            args = list(rel.args)
+            start = scalar(named.get("start", args[0] if args else None))
+            stop = scalar(named.get("stop", args[1] if len(args) > 1 else None))
+            step_e = named.get("step", args[2] if len(args) > 2 else None)
+            step = scalar(step_e) if step_e is not None else 1
+            if step == 0:
+                raise AnalysisError("sequence() step must not be zero")
+            start, stop, step = int(start), int(stop), int(step)
+            count = max(0, (stop - start) // step + 1)
+            if count > 10_000_000:
+                # plan-time materialization cap (the reference streams
+                # this function; a runaway range must not OOM analysis)
+                raise AnalysisError(
+                    f"sequence() would produce {count} rows"
+                    " (limit 10000000)"
+                )
+            vals = list(range(start, stop + (1 if step > 0 else -1), step))
+            names = list(rel.column_aliases) or ["sequential_number"]
+            fields = (P.Field(names[0], T.BIGINT),)
+            node = P.ValuesNode(fields, tuple((v,) for v in vals))
+            scope = Scope([ScopeField(rel.alias, names[0], T.BIGINT)])
+            return RelationItem(node, scope, float(max(len(vals), 1)))
+        if fn_name == "exclude_columns" and len(rel.name) == 1:
+            args = list(rel.args)
+            tbl = named.get("input", args[0] if args else None)
+            desc = named.get("columns", args[1] if len(args) > 1 else None)
+            if not isinstance(tbl, ast.TableArg) or not isinstance(
+                desc, ast.Descriptor
+            ):
+                raise AnalysisError(
+                    "exclude_columns(input => TABLE(...), columns =>"
+                    " DESCRIPTOR(...))"
+                )
+            item = self._plan_relation_leaf_any(tbl.relation, ctes)
+            drop = {n.lower() for n in desc.names}
+            keep = [
+                (i, f)
+                for i, f in enumerate(item.scope.fields)
+                if (f.name or "").lower() not in drop
+            ]
+            missing = drop - {
+                (f.name or "").lower() for f in item.scope.fields
+            }
+            if missing:
+                raise AnalysisError(
+                    f"exclude_columns: no such columns {sorted(missing)}"
+                )
+            if not keep:
+                raise AnalysisError("exclude_columns removed every column")
+            exprs = tuple(ir.InputRef(i, f.type) for i, f in keep)
+            fields = tuple(
+                P.Field(f.name, f.type) for _, f in keep
+            )
+            node = P.ProjectNode(item.node, exprs, fields)
+            scope = Scope(
+                [ScopeField(rel.alias, f.name, f.type) for _, f in keep]
+            )
+            return RelationItem(node, scope, item.rows)
+        # connector-provided table function
+        catalog = rel.name[0] if len(rel.name) > 1 else self.catalog
+        try:
+            conn = self.catalogs.get(catalog)
+        except KeyError:
+            raise AnalysisError(f"unknown catalog '{catalog}'")
+        tf = conn.table_functions.get(fn_name)
+        if tf is None:
+            raise AnalysisError(
+                f"unknown table function {'.'.join(rel.name)}()"
+            )
+        call_args = {k: scalar(v) for k, v in named.items()}
+        for i, a in enumerate(rel.args):
+            call_args[f"_{i}"] = scalar(a)
+        columns, rows = tf.fn(call_args)
+        names = (
+            list(rel.column_aliases)
+            if rel.column_aliases
+            else [c.name for c in columns]
+        )
+        if len(names) != len(columns):
+            raise AnalysisError(
+                f"alias has {len(names)} columns, function produces"
+                f" {len(columns)}"
+            )
+        fields = tuple(
+            P.Field(nm, c.type) for nm, c in zip(names, columns)
+        )
+        node = P.ValuesNode(fields, tuple(tuple(r) for r in rows))
+        scope = Scope(
+            [
+                ScopeField(rel.alias, nm, c.type)
+                for nm, c in zip(names, columns)
+            ]
+        )
+        return RelationItem(node, scope, float(max(len(rows), 1)))
 
     def _plan_table(self, rel: ast.TableRef) -> RelationItem:
         parts = rel.name

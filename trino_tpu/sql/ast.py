@@ -333,6 +333,34 @@ class UnnestRelation(Relation):
 
 
 @dataclasses.dataclass(frozen=True)
+class Descriptor(Expression):
+    """DESCRIPTOR(name, ...) — a column-name list argument to a table
+    function (spi/ptf Descriptor analogue)."""
+
+    names: Tuple[str, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class TableArg(Expression):
+    """TABLE(relation) argument to a polymorphic table function."""
+
+    relation: Relation
+
+
+@dataclasses.dataclass(frozen=True)
+class TableFunctionRelation(Relation):
+    """FROM TABLE(fn(arg, name => arg, ...)) — the SQL-standard
+    table-function invocation (SqlBase.g4 tableFunctionCall;
+    spi/ptf/ConnectorTableFunction analogue)."""
+
+    name: Tuple[str, ...]
+    args: Tuple[Expression, ...] = ()
+    named_args: Tuple[Tuple[str, Expression], ...] = ()
+    alias: Optional[str] = None
+    column_aliases: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
 class CreateTable(Node):
     table: Tuple[str, ...]
     columns: Tuple[Tuple[str, TypeName], ...]

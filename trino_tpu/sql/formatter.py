@@ -226,6 +226,20 @@ def _relation(r: ast.Relation) -> str:
         elif r.condition is not None:
             text += f" ON {_expr(r.condition)}"
         return text
+    if isinstance(r, ast.TableFunctionRelation):
+        parts = []
+        for a in r.args:
+            parts.append(_tf_arg(a))
+        for n, a in r.named_args:
+            parts.append(f"{_ident(n)} => {_tf_arg(a)}")
+        text = f"TABLE({_name(r.name)}({', '.join(parts)}))"
+        if r.alias:
+            text += f" AS {_ident(r.alias)}"
+            if r.column_aliases:
+                text += "(" + ", ".join(
+                    _ident(c) for c in r.column_aliases
+                ) + ")"
+        return text
     if isinstance(r, ast.UnnestRelation):
         text = "UNNEST(" + ", ".join(_expr(a) for a in r.arrays) + ")"
         if r.ordinality:
@@ -238,6 +252,14 @@ def _relation(r: ast.Relation) -> str:
                 ) + ")"
         return text
     raise NotImplementedError(f"cannot format {type(r).__name__}")
+
+
+def _tf_arg(a) -> str:
+    if isinstance(a, ast.TableArg):
+        return f"TABLE({_relation(a.relation)})"
+    if isinstance(a, ast.Descriptor):
+        return "DESCRIPTOR(" + ", ".join(_ident(n) for n in a.names) + ")"
+    return _expr(a)
 
 
 # ---------------------------------------------------------------------------

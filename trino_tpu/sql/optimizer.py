@@ -314,6 +314,15 @@ class PushFilterThroughProject(Rule):
         child = ctx.resolve(node.child)
         if not isinstance(child, P.ProjectNode):
             return None
+        # duplication guard (the reference's isInliningCandidate): only
+        # push when every projection the predicate touches is trivial —
+        # otherwise the expensive expression runs in the filter AND in
+        # the retained Project
+        for r in expr_refs(node.predicate):
+            if r < len(child.exprs) and not isinstance(
+                child.exprs[r], (ir.InputRef, ir.Literal)
+            ):
+                return None
         mapping = dict(enumerate(child.exprs))
         pred = substitute(node.predicate, mapping)
         grandchild = child.child

@@ -26,7 +26,7 @@ _TOKEN_RE = re.compile(
   | (?P<string>'(?:[^']|'')*')
   | (?P<qident>"(?:[^"]|"")*")
   | (?P<ident>[A-Za-z_][A-Za-z_0-9$]*)
-  | (?P<op><>|!=|>=|<=|\|\||[-+*/%(),.;=<>\[\]])
+  | (?P<op><>|!=|>=|<=|=>|\|\||[-+*/%(),.;=<>\[\]])
     """,
     re.VERBOSE | re.DOTALL,
 )
@@ -557,6 +557,38 @@ class Parser:
                 ordinality = True
             alias, cols = self._parse_opt_alias_with_columns()
             return ast.UnnestRelation(tuple(arrays), ordinality, alias, cols)
+        if (
+            self.at_kw("TABLE")
+            and self.peek(1).kind == "op"
+            and self.peek(1).text == "("
+        ):
+            # FROM TABLE(fn(...)) — table-function invocation
+            self.next()
+            self.expect_op("(")
+            name = self._parse_qualified_name()
+            self.expect_op("(")
+            args: list = []
+            named: list = []
+            if not self.at_op(")"):
+                while True:
+                    if (
+                        self.peek().kind in ("ident", "qident")
+                        and self.peek(1).kind == "op"
+                        and self.peek(1).text == "=>"
+                    ):
+                        pname = self._parse_name()
+                        self.next()  # =>
+                        named.append((pname, self._parse_tf_arg()))
+                    else:
+                        args.append(self._parse_tf_arg())
+                    if not self.accept_op(","):
+                        break
+            self.expect_op(")")
+            self.expect_op(")")
+            alias, cols = self._parse_opt_alias_with_columns()
+            return ast.TableFunctionRelation(
+                name, tuple(args), tuple(named), alias, cols
+            )
         if self.accept_op("("):
             # subquery (incl. inline VALUES) or parenthesized join
             if self.at_kw("SELECT", "WITH", "VALUES"):
@@ -570,6 +602,29 @@ class Parser:
         name = self._parse_qualified_name()
         alias = self._parse_opt_alias()
         return ast.TableRef(name, alias)
+
+    def _parse_tf_arg(self) -> ast.Expression:
+        """One table-function argument: scalar expression, TABLE(rel),
+        or DESCRIPTOR(col, ...)."""
+        if (
+            self.at_kw("TABLE")
+            and self.peek(1).kind == "op"
+            and self.peek(1).text == "("
+        ):
+            self.next()
+            self.expect_op("(")
+            rel = self._parse_relation()
+            self.expect_op(")")
+            return ast.TableArg(rel)
+        if (
+            self.at_kw("DESCRIPTOR")
+            and self.peek(1).kind == "op"
+            and self.peek(1).text == "("
+        ):
+            self.next()
+            self.expect_op("(")
+            return ast.Descriptor(self._parse_name_list())
+        return self.parse_expr()
 
     def _parse_opt_alias_with_columns(self):
         """`[AS] alias [(col, ...)]` — derived column aliases."""
