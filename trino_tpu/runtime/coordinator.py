@@ -347,23 +347,17 @@ class DistributedQueryRunner:
                                 for k, v in op.items():
                                     if isinstance(v, (int, float)):
                                         acc[k] = acc.get(k, 0) + v
+                # rehydrate + render through the shared OperatorStats
+                # formatter so local and distributed EXPLAIN ANALYZE
+                # cannot drift apart
+                from trino_tpu.exec.stats import OperatorStats, render_stats
+
+                groups = [
+                    [OperatorStats(**op) for op in group]
+                    for group in merged
+                ]
                 lines.append(f"\nFragment {fid} [{n_tasks} tasks]:")
-                for pi, group in enumerate(merged):
-                    lines.append(f"  Pipeline {pi}:")
-                    for op in group:
-                        total_ms = (
-                            op.get("add_input_s", 0.0)
-                            + op.get("get_output_s", 0.0)
-                            + op.get("finish_s", 0.0)
-                        ) * 1000
-                        lines.append(
-                            f"    {op.get('operator')}: "
-                            f"in={op.get('input_rows', 0)} rows/"
-                            f"{op.get('input_batches', 0)} batches, "
-                            f"out={op.get('output_rows', 0)} rows/"
-                            f"{op.get('output_batches', 0)} batches, "
-                            f"wall={total_ms:.1f}ms"
-                        )
+                lines.append(render_stats(groups))
             return MaterializedResult(
                 [["\n".join(lines)]], ["Query Plan"], [T.VARCHAR]
             )

@@ -123,11 +123,15 @@ class FaultTolerantQueryScheduler:
             ]
             for c in sp.children
         }
-        est_bytes = self.estimator.estimate(f.id)
         pending = {p: 0 for p in range(tc)}  # partition -> attempt
-        # partition -> [(handle, tid, attempt, started_at)]; entry 0 is
-        # the primary, entry 1 (if any) the speculative duplicate
+        # partition -> [(handle, tid, attempt, started_at, est_bytes)];
+        # entry 0 is the primary, entry 1 (if any) the speculative dup
         running: Dict[int, List[Tuple]] = {}
+        # highest attempt number ever assigned per partition: retry and
+        # speculative numbers must never collide with a FAILED attempt's
+        # id — create_task is idempotent by id and would hand back the
+        # dead TaskExecution
+        attempt_hwm: Dict[int, int] = {p: 0 for p in range(tc)}
         durations: List[float] = []  # completed-task wall times
         last_handle = None
         avoid: Dict[int, object] = {}  # partition -> failed handle
@@ -136,8 +140,11 @@ class FaultTolerantQueryScheduler:
             active = list(self._active_fn())
             if not active:
                 raise TaskRetriesExceeded("no active workers")
-            # memory-aware bin packing; failed node excluded
+            # memory-aware bin packing; the estimate is re-read PER
+            # LAUNCH so register_failure's growth affects the retry
+            est_bytes = self.estimator.estimate(f.id)
             handle = self.allocator.acquire(active, est_bytes, avoid=avoid_h)
+            attempt_hwm[p] = max(attempt_hwm[p], attempt)
             task_id = TaskId(self.query_id, f.id, p, attempt)
             spec = TaskSpec(
                 task_id=task_id,
@@ -156,19 +163,19 @@ class FaultTolerantQueryScheduler:
             except Exception as exc:
                 self.allocator.release(handle, est_bytes)
                 raise _LaunchFailed(handle, exc)
-            return (handle, str(task_id), attempt, time.monotonic())
+            return (handle, str(task_id), attempt, time.monotonic(), est_bytes)
 
         def settle(p: int, winner, losers):
             """Commit the winner; cancel+release live sibling attempts.
             Entries that already FAILED were released in the poll loop
             and must not be passed here (double-release would corrupt
             the allocator's reservations)."""
-            handle, tid, _, t0 = winner
+            handle, tid, _, t0, est = winner
             durations.append(time.monotonic() - t0)
             self.committed[(f.id, p)] = tid
-            self.allocator.release(handle, est_bytes)
-            for h, other_tid, _, _ in losers:
-                self.allocator.release(h, est_bytes)
+            self.allocator.release(handle, est)
+            for h, other_tid, _, _, other_est in losers:
+                self.allocator.release(h, other_est)
                 try:
                     h.remove_task(other_tid)
                 except Exception:
@@ -193,7 +200,7 @@ class FaultTolerantQueryScheduler:
                         )
                     self.retries += 1
                     avoid[p] = lf.handle
-                    pending[p] = attempt + 1
+                    pending[p] = attempt_hwm[p] + 1
             # poll
             time.sleep(0.01)
             now = time.monotonic()
@@ -202,7 +209,7 @@ class FaultTolerantQueryScheduler:
                 finished_entry = None
                 next_entries = []
                 for entry in entries:
-                    handle, tid, attempt, t0 = entry
+                    handle, tid, attempt, t0, est = entry
                     try:
                         st = handle.task_state(tid)
                     except Exception as e:
@@ -217,7 +224,7 @@ class FaultTolerantQueryScheduler:
                             next_entries.append(entry)
                         continue
                     if st["state"] == "failed":
-                        self.allocator.release(handle, est_bytes)
+                        self.allocator.release(handle, est)
                         self.estimator.register_failure(
                             f.id, st.get("failure")
                         )
@@ -236,7 +243,7 @@ class FaultTolerantQueryScheduler:
                     continue
                 if not next_entries:
                     del running[p]
-                    next_attempt = entries[-1][2] + 1
+                    next_attempt = attempt_hwm[p] + 1
                     if next_attempt > self.max_task_retries:
                         raise TaskRetriesExceeded(
                             f"partition {p} of fragment {f.id} failed "
@@ -254,11 +261,11 @@ class FaultTolerantQueryScheduler:
                     and len(durations) * 2 >= tc
                     and now - next_entries[0][3]
                     > max(2.0 * median, 0.25)
-                    and next_entries[0][2] < self.max_task_retries
+                    and attempt_hwm[p] < self.max_task_retries
                 ):
-                    handle, _, attempt, _ = next_entries[0]
+                    handle = next_entries[0][0]
                     try:
-                        dup = launch(p, attempt + 1, avoid_h=handle)
+                        dup = launch(p, attempt_hwm[p] + 1, avoid_h=handle)
                         running[p].append(dup)
                         self.speculative_hits += 1
                     except _LaunchFailed:

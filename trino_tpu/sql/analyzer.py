@@ -1367,6 +1367,8 @@ class Analyzer:
             return self._plan_unnest(rel)
         if isinstance(rel, ast.TableFunctionRelation):
             return self._plan_table_function(rel, ctes)
+        if isinstance(rel, ast.MatchRecognizeRelation):
+            return self._plan_match_recognize(rel, ctes)
         if isinstance(rel, ast.SubqueryRelation):
             node, scope, names = self.plan_query(rel.query, ctes)
             if rel.column_aliases:
@@ -1425,6 +1427,182 @@ class Analyzer:
             [ScopeField(rel.alias, nm, t) for nm, t in zip(names, col_types)]
         )
         return RelationItem(node, scope, float(max(n, 1)))
+
+    @staticmethod
+    def _pattern_vars(node) -> Set[str]:
+        return _pattern_var_names(node)
+
+    def _plan_match_recognize(
+        self, rel: ast.MatchRecognizeRelation, ctes
+    ) -> RelationItem:
+        """Row pattern recognition (StatementAnalyzer's
+        analyzePatternRecognition — SURVEY.md §2.6). Supported subset:
+        ONE ROW PER MATCH; DEFINE conditions over current-row columns
+        and PREV/NEXT(col [, n]) (vectorized as shifted columns —
+        references to OTHER variables' rows, e.g. LAST(A.price) inside
+        DEFINE, need running match state and are rejected); measures
+        FIRST/LAST(var.col), var.col, MATCH_NUMBER(), CLASSIFIER()."""
+        if rel.rows_per_match != "one":
+            raise AnalysisError(
+                "only ONE ROW PER MATCH is supported"
+            )
+        item = self._plan_relation_leaf_any(rel.input, ctes)
+        scope = item.scope
+        pattern_vars = _pattern_var_names(rel.pattern)
+        define_vars = {v.lower() for v, _ in rel.defines}
+        for v in define_vars:
+            if v not in pattern_vars:
+                raise AnalysisError(
+                    f"DEFINE variable '{v}' does not appear in PATTERN"
+                )
+
+        def channel_of(e: ast.Expression) -> int:
+            if not isinstance(e, ast.Identifier):
+                raise AnalysisError(
+                    "MATCH_RECOGNIZE partition/order items must be columns"
+                )
+            return scope.resolve(e.parts)[0]
+
+        partition_channels = tuple(channel_of(e) for e in rel.partition_by)
+        order_keys = tuple(
+            SortKey(channel_of(s.expr), s.descending)
+            for s in rel.order_by
+        )
+        # -- DEFINE conditions -> ir over the extended schema --
+        shifts: List[Tuple[int, int]] = []  # (channel, roll offset)
+        shift_index: Dict[Tuple[int, int], int] = {}
+        base_width = len(scope.fields)
+
+        def shifted_field(ch: int, off: int) -> ast.Identifier:
+            key = (ch, off)
+            if key not in shift_index:
+                shift_index[key] = len(shifts)
+                shifts.append(key)
+            return ast.Identifier((f"__shift{shift_index[key]}",))
+
+        def rewrite(e: ast.Expression, var: str) -> ast.Expression:
+            if isinstance(e, ast.Identifier):
+                if len(e.parts) == 2 and e.parts[0].lower() in pattern_vars:
+                    if e.parts[0].lower() != var:
+                        raise AnalysisError(
+                            f"DEFINE {var.upper()}: references to other"
+                            f" variables' rows ({e.parts[0]}.{e.parts[1]})"
+                            " are not supported — use PREV/NEXT navigation"
+                        )
+                    return ast.Identifier((e.parts[1],))
+                return e
+            if isinstance(e, ast.FunctionCall) and e.name.lower() in (
+                "prev", "next"
+            ):
+                if not e.args or not isinstance(e.args[0], ast.Identifier):
+                    raise AnalysisError(
+                        f"{e.name}() supports a column reference argument"
+                    )
+                inner = rewrite(e.args[0], var)
+                ch = scope.resolve(inner.parts)[0]
+                n = 1
+                if len(e.args) > 1:
+                    if not isinstance(e.args[1], ast.NumberLiteral):
+                        raise AnalysisError(
+                            f"{e.name}() offset must be a number literal"
+                        )
+                    n = int(e.args[1].text)
+                off = n if e.name.lower() == "prev" else -n
+                return shifted_field(ch, off)
+            # rebuild recursively over dataclass fields
+            import dataclasses as _dc
+
+            if _dc.is_dataclass(e) and isinstance(e, ast.Node):
+                changes = {}
+                for f in _dc.fields(e):
+                    v = getattr(e, f.name)
+                    if isinstance(v, ast.Expression):
+                        changes[f.name] = rewrite(v, var)
+                    elif isinstance(v, tuple) and v and isinstance(
+                        v[0], ast.Expression
+                    ):
+                        changes[f.name] = tuple(rewrite(x, var) for x in v)
+                if changes:
+                    return _dc.replace(e, **changes)
+            return e
+
+        # conversions happen against an extended scope that appends one
+        # pseudo-column per distinct (channel, offset)
+        defines_ir: List[Tuple[str, ir.Expr]] = []
+        rewritten = [
+            (v.lower(), rewrite(cond, v.lower())) for v, cond in rel.defines
+        ]
+        ext_fields = list(scope.fields)
+        for i, (ch, _off) in enumerate(shifts):
+            ext_fields.append(
+                ScopeField(None, f"__shift{i}", scope.fields[ch].type)
+            )
+        ext_scope = Scope(ext_fields)
+        for v, cond in rewritten:
+            conv = ExprConverter(ext_scope)
+            pred = conv.convert(cond)
+            if pred.type.kind != T.TypeKind.BOOLEAN:
+                raise AnalysisError(
+                    f"DEFINE {v.upper()} must be a boolean condition"
+                )
+            defines_ir.append((v, pred))
+        # -- measures --
+        measures: List[P.MeasureSpec] = []
+        for mi in rel.measures:
+            e = mi.expr
+            if isinstance(e, ast.FunctionCall) and e.name.lower() in (
+                "match_number", "classifier"
+            ):
+                kind = e.name.lower()
+                measures.append(P.MeasureSpec(
+                    kind, mi.name,
+                    T.BIGINT if kind == "match_number" else T.VARCHAR,
+                ))
+                continue
+            kind = "last"
+            if isinstance(e, ast.FunctionCall) and e.name.lower() in (
+                "first", "last"
+            ):
+                kind = e.name.lower()
+                if len(e.args) != 1:
+                    raise AnalysisError(f"{e.name}() takes one argument")
+                e = e.args[0]
+            if not isinstance(e, ast.Identifier):
+                raise AnalysisError(
+                    "measures support FIRST/LAST(var.col), var.col,"
+                    " MATCH_NUMBER() and CLASSIFIER()"
+                )
+            var = None
+            parts = e.parts
+            if len(parts) == 2 and parts[0].lower() in pattern_vars:
+                var = parts[0].lower()
+                parts = (parts[1],)
+            ch, t = scope.resolve(parts)
+            measures.append(P.MeasureSpec(kind, mi.name, t, var, ch))
+        # -- output schema: partition columns + measures --
+        out_fields: List[P.Field] = []
+        out_scope_fields: List[ScopeField] = []
+        for ch in partition_channels:
+            f = scope.fields[ch]
+            out_fields.append(P.Field(f.name, f.type))
+            out_scope_fields.append(ScopeField(rel.alias, f.name, f.type))
+        for m in measures:
+            out_fields.append(P.Field(m.name, m.out_type))
+            out_scope_fields.append(
+                ScopeField(rel.alias, m.name, m.out_type)
+            )
+        node = P.MatchRecognizeNode(
+            item.node,
+            partition_channels,
+            order_keys,
+            tuple(defines_ir),
+            tuple(shifts),
+            rel.pattern,
+            tuple(measures),
+            rel.after_match,
+            tuple(out_fields),
+        )
+        return RelationItem(node, Scope(out_scope_fields), item.rows / 4.0)
 
     def _plan_table_function(
         self, rel: ast.TableFunctionRelation, ctes
@@ -2585,3 +2763,16 @@ class Analyzer:
         if e in select_exprs:
             return select_exprs.index(e)
         return None
+
+
+def _pattern_var_names(node) -> Set[str]:
+    """Variable names (lowercased) appearing in a pattern tuple-AST."""
+    kind = node[0]
+    if kind == "var":
+        return {node[1].lower()}
+    if kind in ("seq", "alt"):
+        out: Set[str] = set()
+        for p in node[1]:
+            out |= _pattern_var_names(p)
+        return out
+    return _pattern_var_names(node[1])

@@ -333,6 +333,32 @@ class UnnestRelation(Relation):
 
 
 @dataclasses.dataclass(frozen=True)
+class MeasureItem(Node):
+    """One MEASURES entry: expr AS name."""
+
+    expr: Expression
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class MatchRecognizeRelation(Relation):
+    """<relation> MATCH_RECOGNIZE (...) — SQL row pattern recognition
+    (SqlBase.g4 patternRecognition; main/operator/window/pattern/).
+    `pattern` is a small tuple AST: ("var", name) | ("seq", [...]) |
+    ("alt", [...]) | ("star"|"plus"|"opt", node) | ("rep", node, n, m)."""
+
+    input: Relation
+    partition_by: Tuple[Expression, ...] = ()
+    order_by: Tuple["SortItem", ...] = ()
+    measures: Tuple[MeasureItem, ...] = ()
+    rows_per_match: str = "one"  # "one" | "all"
+    after_match: str = "past_last"  # "past_last" | "next_row"
+    pattern: object = None
+    defines: Tuple[Tuple[str, Expression], ...] = ()
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
 class Descriptor(Expression):
     """DESCRIPTOR(name, ...) — a column-name list argument to a table
     function (spi/ptf Descriptor analogue)."""

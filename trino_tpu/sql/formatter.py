@@ -226,6 +226,37 @@ def _relation(r: ast.Relation) -> str:
         elif r.condition is not None:
             text += f" ON {_expr(r.condition)}"
         return text
+    if isinstance(r, ast.MatchRecognizeRelation):
+        inner = []
+        if r.partition_by:
+            inner.append(
+                "PARTITION BY " + ", ".join(_expr(x) for x in r.partition_by)
+            )
+        if r.order_by:
+            inner.append(
+                "ORDER BY " + ", ".join(_sort_item(s) for s in r.order_by)
+            )
+        if r.measures:
+            inner.append("MEASURES " + ", ".join(
+                f"{_expr(m.expr)} AS {_ident(m.name)}" for m in r.measures
+            ))
+        inner.append(
+            "ONE ROW PER MATCH" if r.rows_per_match == "one"
+            else "ALL ROWS PER MATCH"
+        )
+        inner.append(
+            "AFTER MATCH SKIP PAST LAST ROW"
+            if r.after_match == "past_last"
+            else "AFTER MATCH SKIP TO NEXT ROW"
+        )
+        inner.append(f"PATTERN ({_pattern(r.pattern)})")
+        inner.append("DEFINE " + ", ".join(
+            f"{_ident(v)} AS {_expr(c)}" for v, c in r.defines
+        ))
+        text = f"{_relation(r.input)} MATCH_RECOGNIZE ({' '.join(inner)})"
+        if r.alias:
+            text += f" AS {_ident(r.alias)}"
+        return text
     if isinstance(r, ast.TableFunctionRelation):
         parts = []
         for a in r.args:
@@ -252,6 +283,35 @@ def _relation(r: ast.Relation) -> str:
                 ) + ")"
         return text
     raise NotImplementedError(f"cannot format {type(r).__name__}")
+
+
+def _pattern(node) -> str:
+    kind = node[0]
+    if kind == "var":
+        return _ident(node[1])
+    if kind == "seq":
+        return " ".join(
+            f"({_pattern(p)})" if p[0] == "alt" else _pattern(p)
+            for p in node[1]
+        )
+    if kind == "alt":
+        return " | ".join(_pattern(p) for p in node[1])
+    inner = node[1]
+    body = (
+        f"({_pattern(inner)})"
+        if inner[0] in ("seq", "alt")
+        else _pattern(inner)
+    )
+    if kind == "star":
+        return body + "*"
+    if kind == "plus":
+        return body + "+"
+    if kind == "opt":
+        return body + "?"
+    lo, hi = node[2], node[3]
+    if hi == lo:
+        return f"{body}{{{lo}}}"
+    return f"{body}{{{lo},{'' if hi is None else hi}}}"
 
 
 def _tf_arg(a) -> str:

@@ -390,6 +390,35 @@ class LocalPlanner:
         chain.append(lambda ctx: EnforceSingleRowOperator(schema))
         return chain, schema
 
+    def _visit_MatchRecognizeNode(self, node: P.MatchRecognizeNode):
+        from trino_tpu.exec.match_recognize import MatchRecognizeOperator
+
+        chain, schema = self._visit(node.child)
+        # bind DEFINE predicates over the extended schema (child +
+        # shifted copies); evaluation is one device program per define,
+        # fused by XLA (exec/match_recognize.py)
+        ext_schema: Schema = list(schema) + [
+            schema[ch] for ch, _off in node.shifts
+        ]
+        define_fns = [
+            (var, self._bind(pred, ext_schema).fn)
+            for var, pred in node.defines
+        ]
+        chain.append(
+            lambda ctx: MatchRecognizeOperator(node, schema, define_fns)
+        )
+        out_schema: Schema = []
+        for ch in node.partition_channels:
+            out_schema.append(schema[ch])
+        for m in node.measures:
+            if m.kind == "classifier":
+                out_schema.append((m.out_type, None))  # runtime dict
+            elif m.channel is not None:
+                out_schema.append((m.out_type, schema[m.channel][1]))
+            else:
+                out_schema.append((m.out_type, None))
+        return chain, out_schema
+
     def _visit_SortNode(self, node: P.SortNode):
         chain, schema = self._visit(node.child)
         keys = list(node.keys)

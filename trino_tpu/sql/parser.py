@@ -26,7 +26,7 @@ _TOKEN_RE = re.compile(
   | (?P<string>'(?:[^']|'')*')
   | (?P<qident>"(?:[^"]|"")*")
   | (?P<ident>[A-Za-z_][A-Za-z_0-9$]*)
-  | (?P<op><>|!=|>=|<=|=>|\|\||[-+*/%(),.;=<>\[\]])
+  | (?P<op><>|!=|>=|<=|=>|\|\||[-+*/%(),.;=<>\[\]?{}|])
     """,
     re.VERBOSE | re.DOTALL,
 )
@@ -88,7 +88,7 @@ _RESERVED_STOP = {
     "CROSS", "AS", "AND", "OR", "NOT", "BY", "ASC", "DESC", "NULLS", "FIRST",
     "LAST", "WHEN", "THEN", "ELSE", "END", "CASE", "BETWEEN", "IN", "LIKE",
     "IS", "NULL", "EXISTS", "DISTINCT", "ALL", "SELECT", "WITH", "USING",
-    "ESCAPE", "OUTER",
+    "ESCAPE", "OUTER", "MATCH_RECOGNIZE",
 }
 
 # words that can never start a bare identifier expression
@@ -600,8 +600,118 @@ class Parser:
             self.expect_op(")")
             return rel
         name = self._parse_qualified_name()
+        if self.at_kw("MATCH_RECOGNIZE"):
+            return self._parse_match_recognize(ast.TableRef(name, None))
         alias = self._parse_opt_alias()
         return ast.TableRef(name, alias)
+
+    def _parse_match_recognize(self, input_rel: ast.Relation) -> ast.Relation:
+        """MATCH_RECOGNIZE (PARTITION BY ... ORDER BY ... MEASURES ...
+        [ONE|ALL] ROW[S] PER MATCH [AFTER MATCH SKIP ...]
+        PATTERN (...) DEFINE ...) — SqlBase.g4 patternRecognition."""
+        self.expect_kw("MATCH_RECOGNIZE")
+        self.expect_op("(")
+        partition_by: list = []
+        order_by: list = []
+        measures: list = []
+        rows_per_match = "one"
+        after_match = "past_last"
+        if self.accept_kw("PARTITION"):
+            self.expect_kw("BY")
+            partition_by.append(self.parse_expr())
+            while self.accept_op(","):
+                partition_by.append(self.parse_expr())
+        if self.accept_kw("ORDER"):
+            self.expect_kw("BY")
+            order_by.append(self._parse_sort_item())
+            while self.accept_op(","):
+                order_by.append(self._parse_sort_item())
+        if self.accept_kw("MEASURES"):
+            while True:
+                e = self.parse_expr()
+                self.expect_kw("AS")
+                measures.append(ast.MeasureItem(e, self._parse_name()))
+                if not self.accept_op(","):
+                    break
+        if self.at_kw("ONE", "ALL"):
+            rows_per_match = self.next().upper.lower()
+            self.accept_kw("ROW") or self.expect_kw("ROWS")
+            self.expect_kw("PER")
+            self.expect_kw("MATCH")
+        if self.accept_kw("AFTER"):
+            self.expect_kw("MATCH")
+            self.expect_kw("SKIP")
+            if self.accept_kw("PAST"):
+                self.expect_kw("LAST")
+                self.expect_kw("ROW")
+                after_match = "past_last"
+            elif self.accept_kw("TO"):
+                self.expect_kw("NEXT")
+                self.expect_kw("ROW")
+                after_match = "next_row"
+            else:
+                raise self.error(
+                    "expected PAST LAST ROW or TO NEXT ROW after SKIP"
+                )
+        self.expect_kw("PATTERN")
+        self.expect_op("(")
+        pattern = self._parse_pattern_alt()
+        self.expect_op(")")
+        self.expect_kw("DEFINE")
+        defines = []
+        while True:
+            var = self._parse_name()
+            self.expect_kw("AS")
+            defines.append((var, self.parse_expr()))
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        alias = self._parse_opt_alias()
+        return ast.MatchRecognizeRelation(
+            input_rel, tuple(partition_by), tuple(order_by),
+            tuple(measures), rows_per_match, after_match, pattern,
+            tuple(defines), alias,
+        )
+
+    def _parse_pattern_alt(self):
+        parts = [self._parse_pattern_seq()]
+        while self.accept_op("|"):
+            parts.append(self._parse_pattern_seq())
+        return parts[0] if len(parts) == 1 else ("alt", parts)
+
+    def _parse_pattern_seq(self):
+        parts = []
+        while not (self.at_op(")") or self.at_op("|")):
+            parts.append(self._parse_pattern_quantified())
+        if not parts:
+            raise self.error("empty pattern")
+        return parts[0] if len(parts) == 1 else ("seq", parts)
+
+    def _parse_pattern_quantified(self):
+        if self.accept_op("("):
+            prim = self._parse_pattern_alt()
+            self.expect_op(")")
+        else:
+            prim = ("var", self._parse_name())
+        if self.accept_op("*"):
+            return ("star", prim)
+        if self.accept_op("+"):
+            return ("plus", prim)
+        if self.accept_op("?"):
+            return ("opt", prim)
+        if self.accept_op("{"):
+            t = self.next()
+            if t.kind != "number":
+                raise self.error("expected a number in {n,m} quantifier")
+            n = int(t.text)
+            m = n
+            if self.accept_op(","):
+                m = None
+                if self.peek().kind == "number":
+                    m = int(self.next().text)
+            self.expect_op("}")
+            return ("rep", prim, n, m)
+        return prim
 
     def _parse_tf_arg(self) -> ast.Expression:
         """One table-function argument: scalar expression, TABLE(rel),

@@ -158,6 +158,43 @@ class WindowNode(PlanNode):
 
 
 @dataclasses.dataclass(frozen=True)
+class MeasureSpec:
+    """One MATCH_RECOGNIZE measure. kind: "first" | "last" (value of
+    `channel` at the first/last row tagged `var`; var None = the whole
+    match) | "match_number" | "classifier"."""
+
+    kind: str
+    name: str
+    out_type: T.DataType
+    var: Optional[str] = None
+    channel: Optional[int] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class MatchRecognizeNode(PlanNode):
+    """Row pattern recognition (PatternRecognitionNode analogue,
+    main/sql/planner/plan/PatternRecognitionNode.java). `defines` maps
+    var -> typed predicate over the EXTENDED child schema (child
+    channels + the shifted copies listed in `shifts`: channel c shifted
+    by offset o appears at extended channel len(child.fields) + i).
+    Output schema (ONE ROW PER MATCH): partition channels' fields +
+    one field per measure."""
+
+    child: PlanNode
+    partition_channels: Tuple[int, ...]
+    order_keys: Tuple[SortKey, ...]
+    defines: Tuple[Tuple[str, Expr], ...]
+    shifts: Tuple[Tuple[int, int], ...]  # (child channel, offset)
+    pattern: object
+    measures: Tuple[MeasureSpec, ...]
+    after_match: str  # "past_last" | "next_row"
+    fields: Tuple[Field, ...]
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
 class SortNode(PlanNode):
     child: PlanNode
     keys: Tuple[SortKey, ...]
