@@ -1,0 +1,200 @@
+"""ARRAY-typed columns (block.py ArrayColumn — spi/block/ArrayBlock
+analogue) and lateral UNNEST over them (exec/unnest.py), plus
+vectorized cardinality."""
+
+import numpy as np
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.block import ArrayColumn
+from trino_tpu.connectors.memory import create_memory_connector
+from trino_tpu.connectors.spi import ColumnMetadata
+from trino_tpu.engine import LocalQueryRunner, Session
+
+
+@pytest.fixture(scope="module")
+def runner():
+    mem = create_memory_connector()
+    mem.load_table(
+        "default", "orders_tags",
+        [
+            ColumnMetadata("id", T.BIGINT),
+            ColumnMetadata("name", T.VARCHAR),
+            ColumnMetadata("tags", T.array_of(T.VARCHAR)),
+            ColumnMetadata("scores", T.array_of(T.BIGINT)),
+        ],
+        [
+            np.asarray([1, 2, 3, 4], dtype=np.int64),
+            ["ann", "bob", "cid", "dee"],
+            [["red", "blue"], ["green"], [], ["red", "green", "blue"]],
+            [[10, 20], [30], [], [1, 2, 3]],
+        ],
+        None,
+        [None, None, None, None],
+    )
+    r = LocalQueryRunner(Session(catalog="memory", schema="default"))
+    r.register_catalog("memory", mem)
+    return r
+
+
+def test_array_column_roundtrip():
+    col = ArrayColumn.from_pylists(T.BIGINT, [[1, 2], [], None, [3]])
+    assert col.to_pylist(count=4) == [[1, 2], [], None, [3]]
+
+
+def test_cardinality_on_column(runner):
+    rows = runner.execute(
+        "select id, cardinality(tags) from orders_tags order by id"
+    ).rows
+    assert rows == [[1, 2], [2, 1], [3, 0], [4, 3]]
+
+
+def test_unnest_array_column(runner):
+    rows = runner.execute(
+        "select id, t from orders_tags, UNNEST(tags) as u(t)"
+        " order by id, t"
+    ).rows
+    assert rows == [
+        [1, "blue"], [1, "red"], [2, "green"],
+        [4, "blue"], [4, "green"], [4, "red"],
+    ]
+
+
+def test_unnest_empty_arrays_produce_no_rows(runner):
+    rows = runner.execute(
+        "select count(*) from orders_tags, UNNEST(scores) as u(s)"
+        " where id = 3"
+    ).rows
+    assert rows == [[0]]
+
+
+def test_unnest_with_ordinality(runner):
+    rows = runner.execute(
+        "select id, s, o from orders_tags, UNNEST(scores)"
+        " WITH ORDINALITY as u(s, o) where id = 4 order by o"
+    ).rows
+    assert rows == [[4, 1, 1], [4, 2, 2], [4, 3, 3]]
+
+
+def test_unnest_multi_array_zip(runner):
+    # tags has 2/1/0/3 elements, scores 2/1/0/3: zip aligns
+    rows = runner.execute(
+        "select id, t, s from orders_tags, UNNEST(tags, scores)"
+        " as u(t, s) where id = 1 order by s"
+    ).rows
+    assert rows == [[1, "red", 10], [1, "blue", 20]]
+
+
+def test_unnest_aggregation(runner):
+    rows = runner.execute(
+        "select t, count(*) c from orders_tags, UNNEST(tags) as u(t)"
+        " group by t order by t"
+    ).rows
+    assert rows == [["blue", 2], ["green", 2], ["red", 2]]
+
+
+def test_unnest_filter_on_source(runner):
+    rows = runner.execute(
+        "select name, s from orders_tags, UNNEST(scores) as u(s)"
+        " where id >= 2 and s > 1 order by s"
+    ).rows
+    assert rows == [["dee", 2], ["dee", 3], ["bob", 30]]
+
+
+def test_constant_unnest_still_works(runner):
+    rows = runner.execute(
+        "select * from UNNEST(ARRAY[7, 8]) as u(v) order by v"
+    ).rows
+    assert rows == [[7], [8]]
+
+
+def test_array_type_rendering(runner):
+    rows = runner.execute("SHOW COLUMNS FROM orders_tags").rows
+    d = dict(rows)
+    assert d["tags"] == "array(varchar)"
+    assert d["scores"] == "array(bigint)"
+
+
+def test_array_cannot_cross_exchange():
+    from trino_tpu.block import RelBatch
+    from trino_tpu.exec.serde import Page
+
+    col = ArrayColumn.from_pylists(T.BIGINT, [[1], [2, 3]])
+    with pytest.raises(NotImplementedError, match="cross an exchange"):
+        Page.from_batch(RelBatch([col]))
+
+
+def test_select_array_column_directly(runner):
+    rows = runner.execute(
+        "select id, scores from orders_tags order by id"
+    ).rows
+    assert rows == [
+        [1, [10, 20]], [2, [30]], [3, []], [4, [1, 2, 3]],
+    ]
+
+
+def test_ctas_and_insert_arrays(runner):
+    runner.execute(
+        "create table arr_copy as select id, scores from orders_tags"
+        " where id <= 2"
+    )
+    assert runner.execute(
+        "select id, scores from arr_copy order by id"
+    ).rows == [[1, [10, 20]], [2, [30]]]
+    runner.execute(
+        "insert into arr_copy select id, scores from orders_tags"
+        " where id = 4"
+    )
+    assert runner.execute(
+        "select s from arr_copy, UNNEST(scores) u(s) where id = 4"
+        " order by s"
+    ).rows == [[1], [2], [3]]
+
+
+def test_ctas_string_arrays(runner):
+    runner.execute(
+        "create table tag_copy as select id, tags from orders_tags"
+    )
+    rows = runner.execute(
+        "select t, count(*) from tag_copy, UNNEST(tags) u(t)"
+        " group by t order by t"
+    ).rows
+    assert rows == [["blue", 2], ["green", 2], ["red", 2]]
+
+
+def test_unnest_empty_table():
+    mem = create_memory_connector()
+    mem.load_table(
+        "d", "empty",
+        [ColumnMetadata("id", T.BIGINT),
+         ColumnMetadata("arr", T.array_of(T.BIGINT))],
+        [np.zeros(0, dtype=np.int64), []], None, [None, None],
+    )
+    r = LocalQueryRunner(Session(catalog="m", schema="d"))
+    r.register_catalog("m", mem)
+    assert r.execute(
+        "select id, x from empty, UNNEST(arr) as u(x)"
+    ).rows == []
+
+
+def test_nested_arrays_roundtrip():
+    inner = T.array_of(T.BIGINT)
+    col = ArrayColumn.from_pylists(inner, [[[1, 2], [3]], [[4]]])
+    assert col.to_pylist(count=2) == [[[1, 2], [3]], [[4]]]
+
+
+def test_unnest_nested_arrays():
+    mem = create_memory_connector()
+    mem.load_table(
+        "d", "nested",
+        [ColumnMetadata("id", T.BIGINT),
+         ColumnMetadata("nest", T.array_of(T.array_of(T.BIGINT)))],
+        [np.asarray([1, 2], dtype=np.int64), [[[1, 2], [3]], [[4]]]],
+        None, [None, None],
+    )
+    r = LocalQueryRunner(Session(catalog="m", schema="d"))
+    r.register_catalog("m", mem)
+    rows = r.execute(
+        "select id, x from nested, UNNEST(nest) as u(x) order by id"
+    ).rows
+    assert rows == [[1, [1, 2]], [1, [3]], [2, [4]]]

@@ -179,6 +179,8 @@ class Column:
 
     @staticmethod
     def from_pylist(type_: T.DataType, values: Sequence[Any], capacity=None) -> "Column":
+        if type_.kind == T.TypeKind.ARRAY:
+            return ArrayColumn.from_pylists(type_.element, values, capacity)
         has_null = any(v is None for v in values)
         if type_.is_string:
             dictionary = Dictionary([v for v in values if v is not None])
@@ -214,6 +216,126 @@ class Column:
             data, valid = data[:count], valid[:count]
         dict_values = self.dictionary.values if self.dictionary else None
         return decode_values(self.type, data, valid, dict_values)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class ArrayColumn(Column):
+    """ARRAY-typed column: per-row views into one flattened element
+    column (spi/block/ArrayBlock.java's offsets+values layout, SoA
+    form). `data` holds per-row LENGTHS — so generic vectorized code
+    that only needs cardinality (the common aggregate/filter case)
+    reads an ordinary int32 array — while `starts` + `flat` carry the
+    element storage. gather() moves only the per-row views; the flat
+    child is shared, never re-laid-out.
+
+    Array columns flow scan -> (filter/project passthrough) -> UNNEST
+    within a task; they do not cross exchanges (the page wire format
+    rejects them loudly — nested columns on the wire are planned work).
+    """
+
+    starts: Optional[jnp.ndarray] = None  # int32 (capacity,)
+    flat: Optional[Column] = None  # flattened elements
+
+    def tree_flatten(self):
+        return (
+            (self.data, self.valid, self.starts, self.flat),
+            (self.type, self.dictionary),
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        data, valid, starts, flat = children
+        return cls(aux[0], data, valid, aux[1], starts, flat)
+
+    def gather(self, positions: jnp.ndarray, positions_valid=None) -> "ArrayColumn":
+        pos = jnp.clip(positions, 0, self.data.shape[0] - 1)
+        lengths = jnp.take(self.data, pos)
+        starts = jnp.take(self.starts, pos)
+        valid = None
+        if self.valid is not None:
+            valid = jnp.take(self.valid, pos)
+        if positions_valid is not None:
+            valid = positions_valid if valid is None else (valid & positions_valid)
+        return ArrayColumn(
+            self.type, lengths, valid, self.dictionary, starts, self.flat
+        )
+
+    def with_data(self, data, valid="__same__") -> "ArrayColumn":
+        return ArrayColumn(
+            self.type,
+            data,
+            self.valid if isinstance(valid, str) else valid,
+            self.dictionary,
+            self.starts,
+            self.flat,
+        )
+
+    @staticmethod
+    def from_pylists(element_type: T.DataType, values, capacity=None,
+                     dictionary: Optional["Dictionary"] = None) -> "ArrayColumn":
+        """values: sequence of python lists (None = NULL array).
+        `dictionary`: table-stable element dictionary for string
+        elements (keeps plan-time binding valid across batches)."""
+        n = len(values)
+        cap = capacity if capacity is not None else bucket_capacity(n)
+        lengths = np.zeros(cap, dtype=np.int32)
+        starts = np.zeros(cap, dtype=np.int32)
+        flat_vals: list = []
+        valid = None
+        if any(v is None for v in values):
+            valid = np.zeros(cap, dtype=bool)
+        pos = 0
+        for i, v in enumerate(values):
+            starts[i] = pos
+            if v is None:
+                continue
+            if valid is not None:
+                valid[i] = True
+            lengths[i] = len(v)
+            flat_vals.extend(v)
+            pos += len(v)
+        if dictionary is not None and element_type.is_string:
+            codes = np.asarray(
+                [dictionary.code(v) if v is not None else 0 for v in flat_vals],
+                dtype=np.int32,
+            )
+            fvalid = (
+                np.asarray([v is not None for v in flat_vals], dtype=bool)
+                if any(v is None for v in flat_vals)
+                else None
+            )
+            flat = Column.from_numpy(element_type, codes, fvalid, dictionary)
+        else:
+            flat = Column.from_pylist(element_type, flat_vals)
+        return ArrayColumn(
+            T.array_of(element_type),
+            jnp.asarray(lengths),
+            jnp.asarray(valid) if valid is not None else None,
+            None,
+            jnp.asarray(starts),
+            flat,
+        )
+
+    def to_pylist(self, count: Optional[int] = None, live: Optional[np.ndarray] = None):
+        lengths = np.asarray(self.data)
+        starts = np.asarray(self.starts)
+        valid = (
+            np.asarray(self.valid)
+            if self.valid is not None
+            else np.ones(len(lengths), bool)
+        )
+        flat_vals = self.flat.to_pylist()
+        rows = []
+        for s, ln, ok in zip(starts, lengths, valid):
+            rows.append(
+                list(flat_vals[int(s):int(s) + int(ln)]) if ok else None
+            )
+        if live is not None:
+            rows = [r for r, k in zip(rows, np.asarray(live)) if k]
+        if count is not None:
+            rows = rows[:count]
+        return rows
 
 
 @jax.tree_util.register_pytree_node_class

@@ -96,6 +96,12 @@ class MemoryMetadata(ConnectorMetadata):
                 raise ValueError(f"table '{schema}.{table}' already exists")
             st = _StoredTable(schema, table, list(columns))
             for c in columns:
+                if c.type.kind == T.TypeKind.ARRAY:
+                    st.data[c.name] = _StoredColumn(
+                        c.type, [], None,
+                        Dictionary([]) if c.type.element.is_string else None,
+                    )
+                    continue
                 st.data[c.name] = _StoredColumn(
                     c.type,
                     np.zeros(0, dtype=c.type.dtype),
@@ -155,6 +161,8 @@ class MemoryPageSource(ConnectorPageSource):
         t.device_cache[cache_key] = out
 
     def _materialize(self, t, columns: Sequence[str], batch_rows: int, lo, hi) -> Iterator[RelBatch]:
+        from trino_tpu.block import ArrayColumn
+
         for a in range(lo, hi, batch_rows):
             b = min(a + batch_rows, hi)
             n = b - a
@@ -162,6 +170,14 @@ class MemoryPageSource(ConnectorPageSource):
             cols = []
             for name in columns:
                 sc = t.data[name]
+                if sc.type.kind == T.TypeKind.ARRAY:
+                    # array columns store python lists host-side; the
+                    # batch view flattens the slice (ArrayBlock layout)
+                    cols.append(ArrayColumn.from_pylists(
+                        sc.type.element, list(sc.data[a:b]) + [None] * (cap - n),
+                        capacity=cap, dictionary=sc.dictionary,
+                    ))
+                    continue
                 arr = np.zeros(cap, dtype=sc.type.dtype)
                 arr[:n] = sc.data[a:b]
                 valid = None
@@ -177,15 +193,20 @@ class MemoryPageSource(ConnectorPageSource):
                 live = jnp.asarray(lv)
             yield RelBatch(cols, live)
         if hi == lo:  # empty table: one empty batch so schemas propagate
-            yield RelBatch(
-                [
-                    Column(t.data[name].type,
-                           jnp.zeros(16, dtype=t.data[name].type.dtype),
-                           None, t.data[name].dictionary)
-                    for name in columns
-                ],
-                jnp.zeros(16, dtype=jnp.bool_),
-            )
+            cols = []
+            for name in columns:
+                sc = t.data[name]
+                if sc.type.kind == T.TypeKind.ARRAY:
+                    cols.append(ArrayColumn.from_pylists(
+                        sc.type.element, [None] * 16, capacity=16,
+                        dictionary=sc.dictionary,
+                    ))
+                    continue
+                cols.append(Column(
+                    sc.type, jnp.zeros(16, dtype=sc.type.dtype),
+                    None, sc.dictionary,
+                ))
+            yield RelBatch(cols, jnp.zeros(16, dtype=jnp.bool_))
 
 
 class MemoryPageSink(ConnectorPageSink):
@@ -198,6 +219,8 @@ class MemoryPageSink(ConnectorPageSink):
         self.rows = 0
 
     def append(self, batch: RelBatch) -> None:
+        from trino_tpu.block import ArrayColumn
+
         key = (self.handle.schema, self.handle.table)
         live = np.asarray(batch.live_mask())
         with self.store.lock:
@@ -205,6 +228,27 @@ class MemoryPageSink(ConnectorPageSink):
             n = int(live.sum())
             for cm, col in zip(t.columns, batch.columns):
                 sc = t.data[cm.name]
+                if cm.type.kind == T.TypeKind.ARRAY:
+                    if not isinstance(col, ArrayColumn):
+                        raise TypeError(
+                            f"column {cm.name}: expected ARRAY data"
+                        )
+                    # decode to the host list-of-lists store (and fold
+                    # string elements into the table dictionary)
+                    rows = [
+                        r for r, k in zip(col.to_pylist(), live) if k
+                    ]
+                    if cm.type.element.is_string:
+                        merged = Dictionary(
+                            (sc.dictionary.values if sc.dictionary else ())
+                            + tuple(
+                                v for r in rows if r is not None
+                                for v in r if v is not None
+                            )
+                        )
+                        sc.dictionary = merged
+                    sc.data = list(sc.data) + rows
+                    continue
                 data = np.asarray(col.data)[live]
                 valid = np.asarray(col.valid)[live] if col.valid is not None else None
                 if cm.type.is_string:
@@ -334,11 +378,31 @@ class MemoryConnector(Connector):
         t = self.store.tables[(schema, table)]
         n = len(arrays[0]) if arrays else 0
         for i, (cm, arr) in enumerate(zip(columns, arrays)):
+            if cm.type.kind == T.TypeKind.ARRAY:
+                # python list-of-lists storage; strings get one
+                # table-stable element dictionary
+                d = None
+                if cm.type.element.is_string:
+                    d = Dictionary([
+                        v for row in arr if row is not None
+                        for v in row if v is not None
+                    ])
+                t.data[cm.name] = _StoredColumn(cm.type, list(arr), None, d)
+                continue
+            d = dictionaries[i] if dictionaries else None
+            if cm.type.is_string and d is None:
+                # convenience: raw python strings -> dictionary + codes
+                vals = list(arr)
+                d = Dictionary([v for v in vals if v is not None])
+                arr = np.asarray(
+                    [d.code(v) if v is not None else 0 for v in vals],
+                    dtype=np.int32,
+                )
             t.data[cm.name] = _StoredColumn(
                 cm.type,
                 np.asarray(arr, dtype=cm.type.dtype),
                 valids[i] if valids else None,
-                dictionaries[i] if dictionaries else (
+                d if d is not None else (
                     Dictionary([]) if cm.type.is_string else None
                 ),
             )

@@ -157,6 +157,16 @@ def make_filter_project_fn(
             live = keep if live is None else (live & keep)
         out_cols = []
         for b in projections:
+            if b.type.is_array:
+                # ARRAY columns pass through WHOLE (starts+flat would be
+                # silently dropped by the (data, valid) rebuild — the
+                # lengths array masquerading as values)
+                if b.input_ref is None or b.input_ref >= len(batch.columns):
+                    raise NotImplementedError(
+                        "computed ARRAY expressions are not supported"
+                    )
+                out_cols.append(batch.columns[b.input_ref])
+                continue
             data, valid = b.fn(cols, valids)
             d = b.dictionary
             from trino_tpu.block import RuntimeDictionary

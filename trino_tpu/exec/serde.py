@@ -69,6 +69,16 @@ class Page:
         import jax
 
         from trino_tpu import native
+        from trino_tpu.block import ArrayColumn
+
+        for c in batch.columns:
+            if isinstance(c, ArrayColumn):
+                # nested columns have no wire layout yet; losing the
+                # flat element store silently would corrupt data
+                raise NotImplementedError(
+                    "ARRAY columns cannot cross an exchange — UNNEST"
+                    " them in the producing fragment"
+                )
 
         host = jax.device_get(batch)
         live = (

@@ -1,0 +1,116 @@
+"""UNNEST over ARRAY columns.
+
+Analogue of main/operator/unnest/UnnestOperator.java. TPU-first split:
+index CONSTRUCTION (which (row, element) pairs exist) is cheap integer
+work done on host from the lengths arrays; all DATA movement — the
+replicated child columns and the flattened element gathers — runs as
+vectorized device gathers at bucketed output capacity. The flat element
+store never moves host-side.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.block import ArrayColumn, Column, RelBatch, bucket_capacity
+
+
+class UnnestOperator:
+    """One batch in -> one expanded batch out (streaming per batch; no
+    consolidation needed, expansion is row-local)."""
+
+    def __init__(self, array_channels, ordinality: bool, input_schema):
+        self._channels = list(array_channels)
+        self._ordinality = ordinality
+        self._schema = input_schema
+        self._out: Optional[RelBatch] = None
+        self._finishing = False
+
+    def needs_input(self) -> bool:
+        return self._out is None and not self._finishing
+
+    def is_blocked(self) -> bool:
+        return False
+
+    def finish(self) -> None:
+        self._finishing = True
+
+    def is_finished(self) -> bool:
+        return self._finishing and self._out is None
+
+    def get_output(self) -> Optional[RelBatch]:
+        out, self._out = self._out, None
+        return out
+
+    def add_input(self, batch: RelBatch) -> None:
+        live = np.asarray(jax.device_get(batch.live_mask()))
+        arrays: List[ArrayColumn] = []
+        for ch in self._channels:
+            col = batch.columns[ch]
+            if not isinstance(col, ArrayColumn):
+                raise TypeError(
+                    "UNNEST argument is not an ARRAY column "
+                    "(array values cannot cross an exchange yet)"
+                )
+            arrays.append(col)
+        lengths = []
+        for col in arrays:
+            ln = np.asarray(jax.device_get(col.data)).astype(np.int64)
+            if col.valid is not None:
+                ln = np.where(
+                    np.asarray(jax.device_get(col.valid)), ln, 0
+                )
+            lengths.append(np.where(live, ln, 0))
+        starts = [
+            np.asarray(jax.device_get(col.starts)) for col in arrays
+        ]
+        # zip semantics: per row, max length across the arrays
+        per_row = np.maximum.reduce(lengths)
+        total = int(per_row.sum())
+        row_idx = np.repeat(np.arange(len(per_row)), per_row)
+        # element index within the row: global position - row's start
+        cum = np.concatenate([[0], np.cumsum(per_row)[:-1]])
+        elem_idx = np.arange(total, dtype=np.int64) - cum[row_idx]
+        cap = bucket_capacity(max(total, 1))
+        pad_rows = np.zeros(cap, dtype=np.int64)
+        pad_rows[:total] = row_idx
+        pad_elems = np.zeros(cap, dtype=np.int64)
+        pad_elems[:total] = elem_idx
+        d_rows = jnp.asarray(pad_rows)
+        d_elems = jnp.asarray(pad_elems)
+        live_out = np.zeros(cap, dtype=bool)
+        live_out[:total] = True
+        d_live = jnp.asarray(live_out)
+        # replicate child columns (device gather)
+        out_cols = [c.gather(d_rows) for c in batch.columns]
+        # element columns: flat gather with per-array zip-padding NULLs
+        for col, ln, st in zip(arrays, lengths, starts):
+            flat_pos = jnp.asarray(st[pad_rows]) + d_elems
+            in_range = d_elems < jnp.asarray(ln[pad_rows])
+            ecol = col.flat.gather(flat_pos)
+            valid = (
+                in_range
+                if ecol.valid is None
+                else (ecol.valid & in_range)
+            )
+            if isinstance(ecol, ArrayColumn):
+                # ARRAY(ARRAY(...)): the gathered element is itself an
+                # array view — keep starts/flat, just merge validity
+                out_cols.append(ArrayColumn(
+                    col.type.element, ecol.data, valid,
+                    ecol.dictionary, ecol.starts, ecol.flat,
+                ))
+                continue
+            out_cols.append(
+                Column(col.type.element, ecol.data, valid, ecol.dictionary)
+            )
+        if self._ordinality:
+            out_cols.append(
+                Column(T.BIGINT, (d_elems + 1).astype(jnp.int64), None, None)
+            )
+        self._out = RelBatch(out_cols, d_live)

@@ -889,6 +889,13 @@ class ExprBinder:
                 days = F.last_day_of_month_days(self._to_days(a, d))
                 return days.astype(T.DATE.dtype), v
             return Bound(T.DATE, ldfn)
+        if name == "array_length":
+            # ArrayColumn.data IS the per-row lengths array
+            a = args[0]
+            def alfn(cols, valids):
+                d, v = a.fn(cols, valids)
+                return d.astype(jnp.int64), v
+            return Bound(T.BIGINT, alfn)
         if name == "year_of_week":
             a = args[0]
             def yowfn(cols, valids):

@@ -374,8 +374,18 @@ class ExprConverter:
                 _const_array_values(e.args[0]) if e.args else None
             )
             if arr is None:
+                # ARRAY-typed column reference: cardinality vectorizes
+                # over the lengths array (ArrayColumn.data IS lengths);
+                # element navigation needs flat access and goes through
+                # UNNEST instead
+                if name == "cardinality" and e.args:
+                    ref = self.convert(e.args[0])
+                    if ref.type.is_array:
+                        return ir.Call("array_length", (ref,), T.BIGINT)
                 raise AnalysisError(
-                    f"{name}() supports constant arrays only"
+                    f"{name}() supports constant arrays"
+                    + (" and array columns" if name == "cardinality" else "")
+                    + " only"
                 )
             return self._fold_array_call(name, arr, e.args[1:])
         if name == "sequence":
@@ -860,6 +870,14 @@ class Builder:
 
 
 @dataclasses.dataclass
+class _DeferredUnnest:
+    """Marker for UNNEST over column references; resolved against the
+    sibling FROM items after they all plan."""
+
+    rel: "ast.UnnestRelation"
+
+
+@dataclasses.dataclass
 class RelationItem:
     """One FROM item during join planning."""
 
@@ -1125,6 +1143,7 @@ class Analyzer:
 
         items: List[RelationItem] = []
         self._collect_relations(spec.from_, items, conjunct_pool, ctes)
+        items = self._resolve_lateral_unnests(items)
 
         # classify conjuncts
         leftovers: List[ast.Expression] = []
@@ -1269,6 +1288,14 @@ class Analyzer:
         return (a, b, c.left, c.right)
 
     def _collect_relations(self, rel: ast.Relation, items, conjunct_pool, ctes):
+        if isinstance(rel, ast.UnnestRelation) and all(
+            isinstance(a, ast.Identifier) for a in rel.arrays
+        ):
+            # lateral UNNEST over columns of a sibling relation:
+            # deferred until every FROM item is planned
+            # (_resolve_lateral_unnests)
+            items.append(_DeferredUnnest(rel))
+            return
         if isinstance(rel, ast.Join):
             if rel.kind == "cross":
                 self._collect_relations(rel.left, items, conjunct_pool, ctes)
@@ -1342,9 +1369,87 @@ class Analyzer:
         items: List[RelationItem] = []
         pool: List[ast.Expression] = []
         self._collect_relations(rel, items, pool, ctes)
+        items = self._resolve_lateral_unnests(items)
         if len(items) != 1 or pool:
             raise AnalysisError("nested join tree not yet supported here")
         return items[0]
+
+    def _resolve_lateral_unnests(self, items) -> list:
+        """Fold _DeferredUnnest markers (UNNEST over column references,
+        `FROM t, UNNEST(t.arr)`) into their source items as UnnestNodes
+        — the reference's correlated-unnest planning
+        (RelationPlanner.planJoinUnnest)."""
+        markers = [
+            (i, it) for i, it in enumerate(items)
+            if isinstance(it, _DeferredUnnest)
+        ]
+        if not markers:
+            return items
+        out = [it for it in items if not isinstance(it, _DeferredUnnest)]
+        for _, marker in markers:
+            rel = marker.rel
+            # locate the single source item owning every referenced column
+            owner_idx = None
+            channels: List[int] = []
+            elem_types: List[T.DataType] = []
+            for e in rel.arrays:
+                hit = None
+                for j, it in enumerate(out):
+                    r = it.scope.try_resolve(e.parts)
+                    if r is not None:
+                        if hit is not None:
+                            raise AnalysisError(
+                                f"UNNEST argument '{e}' is ambiguous"
+                            )
+                        hit = (j, r[0], r[1])
+                if hit is None:
+                    raise AnalysisError(
+                        f"UNNEST argument '{e}' not found (constant"
+                        " arrays and array columns are supported)"
+                    )
+                j, ch, t = hit
+                if not t.is_array:
+                    raise AnalysisError(
+                        f"UNNEST argument '{e}' is {t}, not an array"
+                    )
+                if owner_idx is None:
+                    owner_idx = j
+                elif owner_idx != j:
+                    raise AnalysisError(
+                        "UNNEST arguments must come from one relation"
+                    )
+                channels.append(ch)
+                elem_types.append(t.element)
+            src = out[owner_idx]
+            n_new = len(channels) + (1 if rel.ordinality else 0)
+            names = list(rel.column_aliases) if rel.column_aliases else [
+                f"_col{i}" for i in range(n_new)
+            ]
+            if len(names) != n_new:
+                raise AnalysisError(
+                    f"UNNEST alias has {len(names)} columns,"
+                    f" produces {n_new}"
+                )
+            new_fields = [
+                P.Field(nm, t) for nm, t in zip(names, elem_types)
+            ]
+            if rel.ordinality:
+                new_fields.append(P.Field(names[-1], T.BIGINT))
+            node = P.UnnestNode(
+                src.node,
+                tuple(channels),
+                rel.ordinality,
+                src.node.fields + tuple(new_fields),
+            )
+            scope = Scope(
+                src.scope.fields
+                + [
+                    ScopeField(rel.alias, f.name, f.type)
+                    for f in new_fields
+                ]
+            )
+            out[owner_idx] = RelationItem(node, scope, src.rows * 3.0)
+        return out
 
     def _plan_relation_leaf(self, rel: ast.Relation, ctes) -> RelationItem:
         if isinstance(rel, ast.TableRef):

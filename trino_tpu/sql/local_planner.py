@@ -390,6 +390,23 @@ class LocalPlanner:
         chain.append(lambda ctx: EnforceSingleRowOperator(schema))
         return chain, schema
 
+    def _visit_UnnestNode(self, node: P.UnnestNode):
+        from trino_tpu.exec.unnest import UnnestOperator
+
+        chain, schema = self._visit(node.child)
+        channels = list(node.array_channels)
+        ordinality = node.ordinality
+        chain.append(
+            lambda ctx: UnnestOperator(channels, ordinality, schema)
+        )
+        out_schema: Schema = list(schema)
+        for ch in channels:
+            elem_t = schema[ch][0].element
+            out_schema.append((elem_t, schema[ch][1]))
+        if ordinality:
+            out_schema.append((T.BIGINT, None))
+        return chain, out_schema
+
     def _visit_MatchRecognizeNode(self, node: P.MatchRecognizeNode):
         from trino_tpu.exec.match_recognize import MatchRecognizeOperator
 

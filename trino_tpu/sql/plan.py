@@ -158,6 +158,23 @@ class WindowNode(PlanNode):
 
 
 @dataclasses.dataclass(frozen=True)
+class UnnestNode(PlanNode):
+    """Lateral UNNEST over ARRAY-typed child columns (UnnestNode
+    analogue, main/sql/planner/plan/UnnestNode.java + UnnestOperator).
+    Output = child fields + one element field per array channel
+    (+ ordinality). Multi-array zip pads short arrays with NULL; rows
+    whose arrays are all empty produce no output (inner semantics)."""
+
+    child: PlanNode
+    array_channels: Tuple[int, ...]
+    ordinality: bool
+    fields: Tuple[Field, ...]
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
 class MeasureSpec:
     """One MATCH_RECOGNIZE measure. kind: "first" | "last" (value of
     `channel` at the first/last row tagged `var`; var None = the whole

@@ -38,16 +38,21 @@ class TypeKind(enum.Enum):
     TIMESTAMP = "timestamp"
     INTERVAL_DAY = "interval day to second"
     INTERVAL_YEAR = "interval year to month"
+    ARRAY = "array"
     UNKNOWN = "unknown"  # type of NULL literal
 
 
 @dataclasses.dataclass(frozen=True)
 class DataType:
-    """A SQL data type. Parametric types carry precision/scale/length."""
+    """A SQL data type. Parametric types carry precision/scale/length;
+    ARRAY carries its element type (spi/type/ArrayType analogue —
+    physical layout is offsets + flattened element column, block.py
+    ArrayColumn)."""
 
     kind: TypeKind
     precision: Optional[int] = None  # decimal precision / varchar length
     scale: Optional[int] = None  # decimal scale
+    element: Optional["DataType"] = None  # ARRAY element type
 
     # ---- classification -------------------------------------------------
     @property
@@ -113,9 +118,19 @@ class DataType:
             return np.dtype(np.int32)  # dictionary codes
         if k == TypeKind.UNKNOWN:
             return np.dtype(np.int8)
+        if k == TypeKind.ARRAY:
+            # the per-row physical value is the array LENGTH; element
+            # data lives in the flattened child column (ArrayColumn)
+            return np.dtype(np.int32)
         raise ValueError(f"no physical dtype for {self}")
 
+    @property
+    def is_array(self) -> bool:
+        return self.kind == TypeKind.ARRAY
+
     def __str__(self) -> str:
+        if self.kind == TypeKind.ARRAY:
+            return f"array({self.element})"
         if self.kind == TypeKind.DECIMAL:
             return f"decimal({self.precision},{self.scale})"
         if self.kind == TypeKind.VARCHAR and self.precision is not None:
@@ -151,6 +166,10 @@ def decimal(precision: int, scale: int) -> DataType:
 
 def varchar(length: Optional[int] = None) -> DataType:
     return DataType(TypeKind.VARCHAR, length)
+
+
+def array_of(element: DataType) -> DataType:
+    return DataType(TypeKind.ARRAY, element=element)
 
 
 def char(length: int) -> DataType:
