@@ -232,11 +232,24 @@ def _run_one_subprocess(name: str, sf: float, platform_env: dict,
         for line in out.stderr.splitlines():
             if line.startswith("bench:"):
                 print(line, file=sys.stderr, flush=True)
+        if not out.stdout.strip():
+            # inner crash: surface the traceback tail, not an IndexError
+            for line in out.stderr.splitlines()[-15:]:
+                print(f"bench[inner]: {line}", file=sys.stderr, flush=True)
+            print(
+                f"bench: {name} sf={sf:g} inner exited rc={out.returncode}"
+                " with no result",
+                file=sys.stderr, flush=True,
+            )
+            return None
         return json.loads(out.stdout.strip().splitlines()[-1])[
             f"{name}_sf{sf:g}"
         ]
     except subprocess.TimeoutExpired as ex:
-        for line in (ex.stderr or "").splitlines():
+        err = ex.stderr or b""
+        if isinstance(err, bytes):  # communicate() yields bytes on timeout
+            err = err.decode("utf-8", "replace")
+        for line in err.splitlines():
             if line.startswith("bench:"):
                 print(line, file=sys.stderr, flush=True)
         print(f"bench: {name} sf={sf:g} skipped (TimeoutExpired)",

@@ -198,3 +198,16 @@ def test_unnest_nested_arrays():
         "select id, x from nested, UNNEST(nest) as u(x) order by id"
     ).rows
     assert rows == [[1, [1, 2]], [1, [3]], [2, [4]]]
+
+
+def test_arrays_rejected_as_keys(runner):
+    for sql, where in [
+        ("select tags, count(*) from orders_tags group by tags",
+         "grouping"),
+        ("select id from orders_tags order by tags", "sort"),
+        ("select a.id from orders_tags a, orders_tags b"
+         " where a.tags = b.tags", ""),
+        ("select distinct tags from orders_tags", "grouping"),
+    ]:
+        with pytest.raises(Exception, match="ARRAY|array"):
+            runner.execute(sql)

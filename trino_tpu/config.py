@@ -90,6 +90,9 @@ for _name, _type, _default, _desc, _allowed in [
      ("automatic", "none")),
     ("enable_speculative_execution", bool, True,
      "FTE: duplicate straggler tasks, first finisher wins", None),
+    ("task_concurrency", int, 2,
+     "intra-task pipeline parallelism via the local exchange (1 = off)",
+     None),
 ]:
     SYSTEM_PROPERTIES.register(_name, _type, _default, _desc, _allowed)
 

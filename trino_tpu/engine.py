@@ -57,6 +57,9 @@ class Session:
     # FTE straggler mitigation: duplicate slow tasks, first wins
     # (retry-policy=TASK speculative execution)
     enable_speculative_execution: bool = True
+    # intra-task pipeline parallelism (LocalExchange): parallel build
+    # pipelines + host IO overlapped with device compute; 1 = off
+    task_concurrency: int = 2
 
     def set_property(self, name: str, value) -> None:
         """SET SESSION entry point — validated through the typed

@@ -125,6 +125,29 @@ def hash_split_batch(
     return split_page(page, pid_np, n)
 
 
+class SkewedPartitionRebalancer:
+    """Load-balanced routing for "arbitrary" output partitions
+    (output/SkewedPartitionRebalancer.java analogue, reduced to its
+    essence: the reference shifts traffic off skewed scaled-writer
+    partitions once max/mean exceeds a threshold; routing every page to
+    the least-loaded partition by cumulative bytes achieves the same
+    bound continuously — valid precisely because "arbitrary" consumers
+    need no key colocation)."""
+
+    def __init__(self, n_partitions: int):
+        self._bytes = [0.0] * max(n_partitions, 1)
+
+    def pick(self, size_bytes: int) -> int:
+        i = min(range(len(self._bytes)), key=lambda p: self._bytes[p])
+        self._bytes[i] += max(size_bytes, 1)
+        return i
+
+    def skew(self) -> float:
+        """max/mean load (1.0 = perfectly even) — observability hook."""
+        mean = sum(self._bytes) / len(self._bytes)
+        return (max(self._bytes) / mean) if mean else 1.0
+
+
 class PartitionedOutputOperator(Operator):
     """Terminal sink of every fragment pipeline: splits each output batch
     into the task's OutputBuffer partitions. kind: "single" | "hash" |
@@ -143,7 +166,7 @@ class PartitionedOutputOperator(Operator):
         self._kind = kind
         self._hash_channels = list(hash_channels)
         self._n = n_partitions
-        self._rr = 0
+        self._rebalancer = SkewedPartitionRebalancer(n_partitions)
         self._finishing = False
         self._lut_cache: dict = {}
 
@@ -163,8 +186,11 @@ class PartitionedOutputOperator(Operator):
             for p in range(self._n):
                 self._buffer.enqueue(p, page)
         elif self._kind == "arbitrary":
-            self._buffer.enqueue(self._rr % self._n, page)
-            self._rr += 1
+            # least-loaded by bytes, not blind round-robin: uneven page
+            # sizes otherwise skew downstream tasks
+            self._buffer.enqueue(
+                self._rebalancer.pick(page.size_bytes()), page
+            )
         else:
             self._buffer.enqueue(0, page)
 

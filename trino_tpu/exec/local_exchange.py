@@ -1,0 +1,183 @@
+"""Intra-task local exchange.
+
+Analogue of main/operator/exchange/LocalExchange.java:67 (+
+LocalExchangeSinkOperator / LocalExchangeSourceOperator): a bounded
+in-memory crossing between drivers of ONE task, so pipelines overlap —
+host-side work (remote-page deserialization, spool reads) runs on one
+thread while the device-compute pipeline consumes on another, and
+independent hash-build pipelines run concurrently.
+
+TPU-first framing: there is one device, so this is NOT about parallel
+device compute — XLA serializes kernels anyway. The win is overlapping
+the HOST phases (serde, HTTP pulls, split decoding) with device
+execution, which the reference gets from its multi-driver pipelines
+(Trino runs ~N drivers per pipeline per task; here the device pipeline
+stays single-driver and the host-side producers fan in).
+
+Modes: "arbitrary" (any consumer takes the next batch — the
+least-loaded-queue policy doubles as the SkewedPartitionRebalancer's
+local form), "broadcast" (every consumer sees every batch),
+"round_robin" (strict rotation).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import List, Optional
+
+
+class LocalExchange:
+    def __init__(
+        self,
+        n_consumers: int = 1,
+        mode: str = "arbitrary",
+        max_buffered_batches: int = 4,
+    ):
+        assert mode in ("arbitrary", "broadcast", "round_robin")
+        self.mode = mode
+        self._queues: List[deque] = [deque() for _ in range(n_consumers)]
+        self._lock = threading.Lock()
+        self._not_full = threading.Condition(self._lock)
+        self._not_empty = threading.Condition(self._lock)
+        self._max = max_buffered_batches
+        self._producers = 0
+        self._producers_done = False
+        self._aborted = False
+        self._rr = 0
+
+    def abort(self) -> None:
+        """Tear down (consumer failed): drop buffered batches, unblock
+        producers (put becomes a no-op), finish consumers."""
+        with self._lock:
+            self._aborted = True
+            self._producers_done = True
+            for q in self._queues:
+                q.clear()
+            self._not_full.notify_all()
+            self._not_empty.notify_all()
+
+    # -- producer side --
+    def add_producer(self) -> None:
+        with self._lock:
+            self._producers += 1
+
+    def producer_finished(self) -> None:
+        with self._lock:
+            self._producers -= 1
+            if self._producers <= 0:
+                self._producers_done = True
+                self._not_empty.notify_all()
+
+    def put(self, batch) -> None:
+        with self._not_full:
+            while (
+                not self._aborted
+                and min(len(q) for q in self._queues) >= self._max
+            ):
+                self._not_full.wait(0.1)
+            if self._aborted:
+                return
+            if self.mode == "broadcast":
+                for q in self._queues:
+                    q.append(batch)
+            elif self.mode == "round_robin":
+                self._queues[self._rr % len(self._queues)].append(batch)
+                self._rr += 1
+            else:  # arbitrary: least-loaded queue (local skew rebalance)
+                target = min(
+                    range(len(self._queues)), key=lambda i: len(self._queues[i])
+                )
+                self._queues[target].append(batch)
+            self._not_empty.notify_all()
+
+    # -- consumer side --
+    def get(self, consumer: int, timeout: float = 0.1):
+        """(batch | None, done). done=True only when producers finished
+        AND this consumer's queue drained."""
+        with self._not_empty:
+            q = self._queues[consumer]
+            if not q and not self._producers_done:
+                self._not_empty.wait(timeout)
+            if q:
+                batch = q.popleft()
+                self._not_full.notify_all()
+                return batch, False
+            return None, self._producers_done
+
+
+class LocalExchangeSinkOperator:
+    """Terminal operator of a producer pipeline: pushes into the
+    exchange (LocalExchangeSinkOperator.java)."""
+
+    def __init__(self, exchange: LocalExchange):
+        self._ex = exchange
+        self._finished = False
+        exchange.add_producer()
+
+    def needs_input(self) -> bool:
+        return not self._finished
+
+    def add_input(self, batch) -> None:
+        self._ex.put(batch)
+
+    def get_output(self):
+        return None
+
+    def finish(self) -> None:
+        if not self._finished:
+            self._finished = True
+            self._ex.producer_finished()
+
+    def is_finished(self) -> bool:
+        return self._finished
+
+    def is_blocked(self) -> bool:
+        return False
+
+
+class LocalExchangeSourceOperator:
+    """Leaf operator of a consumer pipeline: pulls from the exchange
+    (LocalExchangeSourceOperator.java)."""
+
+    def __init__(self, exchange: LocalExchange, consumer: int = 0):
+        self._ex = exchange
+        self._consumer = consumer
+        self._done = False
+        self._pending = None
+
+    def needs_input(self) -> bool:
+        return False
+
+    def add_input(self, batch) -> None:
+        raise RuntimeError("source operator takes no input")
+
+    def get_output(self):
+        if self._pending is not None:
+            out, self._pending = self._pending, None
+            return out
+        if self._done:
+            return None
+        batch, done = self._ex.get(self._consumer, timeout=0.05)
+        if done:
+            self._done = True
+        return batch
+
+    def finish(self) -> None:
+        pass
+
+    def is_finished(self) -> bool:
+        return self._done and self._pending is None
+
+    def is_blocked(self) -> bool:
+        # blocked while waiting for producers (lets the Driver yield)
+        if self._done or self._pending is not None:
+            return False
+        batch, done = self._ex.get(self._consumer, timeout=0.0)
+        if done:
+            self._done = True
+            return False
+        if batch is not None:
+            self._pending = batch
+            return False
+        return True

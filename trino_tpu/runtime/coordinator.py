@@ -120,6 +120,7 @@ class QueryScheduler:
                     target_splits=max(self.session.target_splits, tc),
                     dynamic_filtering=self.session.enable_dynamic_filtering,
                     collect_stats=self.collect_stats,
+                    task_concurrency=self.session.task_concurrency,
                 )
                 worker = selector.select(self.workers)
                 worker.create_task(spec)

@@ -157,6 +157,7 @@ class FaultTolerantQueryScheduler:
                 target_splits=max(self.session.target_splits, tc),
                 spool_dir=self.spool_dir,
                 dynamic_filtering=self.session.enable_dynamic_filtering,
+                task_concurrency=self.session.task_concurrency,
             )
             try:
                 handle.create_task(spec)

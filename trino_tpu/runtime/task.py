@@ -60,6 +60,10 @@ class TaskSpec:
     # and report OperatorStats in task status (TaskInfo.getStats path).
     # Off by default — row counting forces a per-batch device sync.
     collect_stats: bool = False
+    # intra-task pipeline parallelism (LocalExchange): run hash-build
+    # pipelines concurrently and overlap remote-page pulls with the
+    # compute chain (task.concurrency analogue)
+    task_concurrency: int = 2
 
 
 def _resolve_fetch(location):
@@ -228,9 +232,7 @@ class TaskExecution:
                 chain, stats = instrument(chain)
                 stat_groups.append(stats)
                 self._stat_groups = stat_groups
-            for p in pipelines:
-                Driver(p).run()
-            Driver(Pipeline(chain)).run()
+            self._run_pipelines(pipelines, chain, spec.task_concurrency)
             from trino_tpu.engine import _raise_deferred_checks
 
             _raise_deferred_checks(ctx)
@@ -247,3 +249,67 @@ class TaskExecution:
         finally:
             for c in self._clients:
                 c.close()
+
+    def _run_pipelines(self, pipelines, chain, concurrency: int) -> None:
+        """Drive the task's pipelines. concurrency > 1 enables the
+        intra-task parallel form (LocalExchange.java:67 discipline): a
+        chain headed by a remote source splits at a LocalExchange so
+        page pulls + deserialization (host) overlap the device compute
+        downstream. Build pipelines run sequentially in planner order —
+        they can be DEPENDENT (a join-on-join build side embeds the
+        inner join's probe; see _visit_JoinNode), so concurrent starts
+        need a bridge-readiness protocol the operators don't have."""
+        from trino_tpu.exec.exchange_ops import RemoteSourceOperator
+        from trino_tpu.exec.local_exchange import (
+            LocalExchange,
+            LocalExchangeSinkOperator,
+            LocalExchangeSourceOperator,
+        )
+
+        def drive(p):
+            Driver(p).run()
+
+        # build pipelines run SEQUENTIALLY: the local planner emits them
+        # in dependency order (a join-on-join build side embeds the
+        # inner join's probe, which reads the inner build's bridge —
+        # concurrent starts would probe an unfinished lookup source)
+        for p in pipelines:
+            drive(p)
+        head = chain[0] if chain else None
+        if (
+            concurrency > 1
+            and len(chain) > 1
+            and isinstance(head, RemoteSourceOperator)
+        ):
+            # overlap remote-page pulls/deserialization with the device
+            # compute downstream (the LocalExchange split)
+            ex = LocalExchange(n_consumers=1, mode="arbitrary")
+            producer = Pipeline([head, LocalExchangeSinkOperator(ex)])
+            consumer = Pipeline(
+                [LocalExchangeSourceOperator(ex)] + list(chain[1:])
+            )
+            perr: List[BaseException] = []
+
+            def run_producer():
+                try:
+                    drive(producer)
+                except BaseException as e:
+                    perr.append(e)
+                    ex.producer_finished()  # unblock the consumer
+
+            t = threading.Thread(target=run_producer, daemon=True)
+            t.start()
+            try:
+                drive(consumer)
+            except BaseException:
+                # a failed consumer must not abandon the producer
+                # blocked in put(): abort drops buffered pages and
+                # makes further puts no-ops
+                ex.abort()
+                t.join(5)
+                raise
+            t.join()
+            if perr:
+                raise perr[0]
+        else:
+            drive(Pipeline(chain))

@@ -896,7 +896,9 @@ class Analyzer:
     def plan(self, stmt: ast.Node) -> P.OutputNode:
         if isinstance(stmt, ast.Query):
             node, scope, names = self.plan_query(stmt, {})
-            return P.OutputNode(node, tuple(names), node.fields)
+            out = P.OutputNode(node, tuple(names), node.fields)
+            _validate_array_usage(out)
+            return out
         raise AnalysisError(f"cannot plan {type(stmt).__name__}")
 
     # ---- queries ----
@@ -2881,3 +2883,43 @@ def _pattern_var_names(node) -> Set[str]:
             out |= _pattern_var_names(p)
         return out
     return _pattern_var_names(node[1])
+
+
+def _validate_array_usage(node: P.PlanNode) -> None:
+    """ARRAY columns have no value-wise ordering/hash operators (the
+    physical per-row value is the LENGTH — block.py ArrayColumn), so
+    using them as grouping/sort/join/partition keys would silently
+    collapse distinct arrays of equal length. Reject at analysis time
+    (the reference's ArrayType has real operators; until this engine's
+    do, fail loudly)."""
+
+    def bad(where: str):
+        raise AnalysisError(
+            f"ARRAY values cannot be used as {where} (use UNNEST or"
+            " cardinality to operate on array contents)"
+        )
+
+    def check(child: P.PlanNode, channels, where: str):
+        for ch in channels:
+            if child.fields[ch].type.is_array:
+                bad(where)
+
+    if isinstance(node, P.AggregateNode):
+        check(node.child, node.group_channels, "grouping keys")
+        for a in node.aggs:
+            for ch in (a.arg_channel, a.arg2_channel):
+                if ch is not None and node.child.fields[ch].type.is_array:
+                    bad("aggregate arguments")
+    elif isinstance(node, P.JoinNode):
+        check(node.left, node.left_keys, "join keys")
+        check(node.right, node.right_keys, "join keys")
+    elif isinstance(node, (P.SortNode, P.TopNNode)):
+        check(node.child, [k.channel for k in node.keys], "sort keys")
+    elif isinstance(node, P.WindowNode):
+        check(node.child, node.partition_channels, "window partition keys")
+        check(node.child, [k.channel for k in node.order_keys],
+              "window order keys")
+    elif isinstance(node, P.MatchRecognizeNode):
+        check(node.child, node.partition_channels, "pattern partition keys")
+    for c in node.children():
+        _validate_array_usage(c)
