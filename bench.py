@@ -13,11 +13,20 @@ in a subprocess — the reference publishes no absolute numbers
 (BASELINE.md), so the same engine's CPU path is the comparison point,
 standing in for the "32-vCPU Java worker" of the north star.
 
+The headline JSON line is re-emitted after EVERY completed config, so
+the last stdout line is always the best complete result no matter when
+the process is killed (the driver runs this under a hard timeout; a
+bench that loses finished measurements to a later config's overrun
+ships nothing).
+
 Env knobs:
   BENCH_FAST=1     -> only Q1 SF1 (smoke)
   BENCH_RUNS=N     -> steady-state repetitions (default 3)
   BENCH_SKIP_CPU=1 -> skip the CPU-subprocess baseline
   BENCH_SF_LARGE=N -> scale factor for the large configs (default 10)
+  BENCH_DEADLINE=N -> global wall budget in seconds (default 900);
+                      remaining configs are skipped when short, SF-large
+                      CPU baselines first
 """
 
 from __future__ import annotations
@@ -26,6 +35,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 RUNS = int(os.environ.get("BENCH_RUNS", "3"))
@@ -183,6 +193,11 @@ def run_benches() -> dict:
 
 PROBE_ROWS = 1_000_000
 
+# env for the CPU-baseline subprocess: BENCH_PLATFORM is what actually
+# demotes the child (sitecustomize pins JAX_PLATFORMS before we run);
+# JAX_PLATFORMS rides along for the compile-cache opt-out in jaxcfg
+_CPU_ENV = {"JAX_PLATFORMS": "cpu", "BENCH_PLATFORM": "cpu", "BENCH_RUNS": "1"}
+
 
 def probe_gbs(n: int = PROBE_ROWS) -> float:
     """Hash-probe throughput in GB/s of probe-side key bytes (the
@@ -217,87 +232,82 @@ def _run_one_subprocess(name: str, sf: float, platform_env: dict,
                         timeout_s: int):
     """One config in an isolated subprocess (a first-compile that runs
     away must never wedge the whole bench — the driver runs this
-    un-supervised at round end). Returns seconds or None."""
+    un-supervised at round end). Child stderr streams live to our
+    stderr as it happens (buffering it until completion destroys the
+    progress trail when a timeout kills the child). Returns
+    (seconds, platform) or (None, None)."""
     env = dict(os.environ, BENCH_INNER="1", BENCH_ONLY=f"{name}:{sf:g}")
     env.update(platform_env)
+    tag = "cpu" if platform_env.get("JAX_PLATFORMS") == "cpu" else "dev"
+    out_lines: list = []
+    err_tail: list = []
+
+    def _pump_err(pipe):
+        for line in pipe:
+            line = line.rstrip("\n")
+            err_tail.append(line)
+            del err_tail[:-15]
+            if line.startswith("bench:"):
+                print(f"[{tag}] {line}", file=sys.stderr, flush=True)
+
+    def _pump_out(pipe):
+        for line in pipe:
+            out_lines.append(line.rstrip("\n"))
+
     try:
-        out = subprocess.run(
+        proc = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__)],
             env=env,
-            capture_output=True,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
             text=True,
-            timeout=timeout_s,
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
-        for line in out.stderr.splitlines():
-            if line.startswith("bench:"):
-                print(line, file=sys.stderr, flush=True)
-        if not out.stdout.strip():
-            # inner crash: surface the traceback tail, not an IndexError
-            for line in out.stderr.splitlines()[-15:]:
-                print(f"bench[inner]: {line}", file=sys.stderr, flush=True)
-            print(
-                f"bench: {name} sf={sf:g} inner exited rc={out.returncode}"
-                " with no result",
-                file=sys.stderr, flush=True,
-            )
-            return None
-        return json.loads(out.stdout.strip().splitlines()[-1])[
-            f"{name}_sf{sf:g}"
-        ]
-    except subprocess.TimeoutExpired as ex:
-        err = ex.stderr or b""
-        if isinstance(err, bytes):  # communicate() yields bytes on timeout
-            err = err.decode("utf-8", "replace")
-        for line in err.splitlines():
-            if line.startswith("bench:"):
-                print(line, file=sys.stderr, flush=True)
-        print(f"bench: {name} sf={sf:g} skipped (TimeoutExpired)",
-              file=sys.stderr, flush=True)
-        return None
     except Exception as ex:
-        print(f"bench: {name} sf={sf:g} skipped ({type(ex).__name__})",
+        print(f"bench: {name} sf={sf:g} [{tag}] skipped ({type(ex).__name__})",
               file=sys.stderr, flush=True)
-        return None
-
-
-def main() -> None:
-    if os.environ.get("BENCH_INNER") == "1":
-        print(json.dumps(run_benches()))
-        return
-
-    # device configs run FIRST, before this process touches jax: a
-    # parent holding the TPU could wedge children on device-exclusive
-    # backends
-    device: dict = {}
-    for name, sf in _configs():
-        secs = _run_one_subprocess(
-            name, sf, {}, int(os.environ.get("BENCH_CONFIG_TIMEOUT", "1800"))
+        return None, None
+    threads = [
+        threading.Thread(target=_pump_err, args=(proc.stderr,), daemon=True),
+        threading.Thread(target=_pump_out, args=(proc.stdout,), daemon=True),
+    ]
+    for t in threads:
+        t.start()
+    try:
+        proc.wait(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+        print(f"bench: {name} sf={sf:g} [{tag}] skipped (timeout {timeout_s}s)",
+              file=sys.stderr, flush=True)
+        return None, None
+    for t in threads:
+        t.join(timeout=5)
+    payload = [ln for ln in out_lines if ln.strip()]
+    if not payload:
+        # inner crash: surface the traceback tail, not an IndexError
+        for line in err_tail:
+            print(f"bench[inner/{tag}]: {line}", file=sys.stderr, flush=True)
+        print(
+            f"bench: {name} sf={sf:g} [{tag}] inner exited "
+            f"rc={proc.returncode} with no result",
+            file=sys.stderr, flush=True,
         )
-        if secs is not None:
-            device[f"{name}_sf{sf:g}"] = secs
+        return None, None
+    try:
+        rec = json.loads(payload[-1])
+        return rec[f"{name}_sf{sf:g}"], rec.get("_platform")
+    except Exception as ex:
+        print(f"bench: {name} sf={sf:g} [{tag}] unparseable result "
+              f"({type(ex).__name__})", file=sys.stderr, flush=True)
+        return None, None
 
-    import jax
 
-    platform = jax.devices()[0].platform
-    gbs = probe_gbs() if platform != "cpu" else None
-
-    baseline = {}
-    if platform != "cpu" and os.environ.get("BENCH_SKIP_CPU") != "1":
-        # one baseline run per config: the CPU engine at SF10 is minutes
-        # per execution and the comparison needs one honest number
-        for name, sf in _configs():
-            key = f"{name}_sf{sf:g}"
-            if key not in device:
-                continue
-            secs = _run_one_subprocess(
-                name, sf,
-                {"JAX_PLATFORMS": "cpu", "BENCH_RUNS": "1"},
-                int(os.environ.get("BENCH_CPU_TIMEOUT", "1800")),
-            )
-            if secs is not None:
-                baseline[key] = secs
-
+def _emit(device: dict, baseline: dict, gbs) -> None:
+    """Print the driver's ONE JSON line reflecting everything measured
+    so far (flushed). Called after every completed config: the LAST
+    stdout line is the record, so each call supersedes the previous and
+    a kill at any point still leaves a complete result behind."""
     extra = {}
     for k, v in device.items():
         extra[k] = {"wall_s": v}
@@ -313,7 +323,8 @@ def main() -> None:
             json.dumps(
                 {"metric": "bench_failed", "value": 0.0, "unit": "s",
                  "vs_baseline": 0.0, "extra": {}}
-            )
+            ),
+            flush=True,
         )
         return
     # headline: the largest completed north-star config, preferring one
@@ -327,7 +338,7 @@ def main() -> None:
     vs = extra[headline].get("vs_cpu", 1.0)
     if "vs_cpu" not in extra[headline]:
         extra["note"] = "cpu baseline missing for headline; vs_baseline unmeasured"
-    else:
+    elif headline in order:
         # demotion must be loud: a larger config completed on device but
         # lost its CPU baseline, so the headline metric name changed
         passed_over = [
@@ -347,8 +358,104 @@ def main() -> None:
                 "vs_baseline": vs,
                 "extra": extra,
             }
-        )
+        ),
+        flush=True,
     )
+
+
+def main() -> None:
+    if os.environ.get("BENCH_INNER") == "1":
+        import jax
+
+        # This environment injects a sitecustomize that imports jax with
+        # JAX_PLATFORMS pinned to the TPU plugin before bench.py runs, so
+        # the env var alone cannot demote a child to CPU — the config
+        # update below (legal until a backend initializes) is what makes
+        # the "CPU baseline" subprocess actually run on CPU.
+        plat = os.environ.get("BENCH_PLATFORM")
+        if plat:
+            jax.config.update("jax_platforms", plat)
+        rec = run_benches()
+        rec["_platform"] = jax.devices()[0].platform
+        print(json.dumps(rec))
+        return
+
+    t_start = time.time()
+    deadline = float(os.environ.get("BENCH_DEADLINE", "900"))
+    cfg_timeout = int(os.environ.get("BENCH_CONFIG_TIMEOUT", "1800"))
+    cpu_timeout = int(os.environ.get("BENCH_CPU_TIMEOUT", "1800"))
+    skip_cpu = os.environ.get("BENCH_SKIP_CPU") == "1"
+
+    def remaining() -> float:
+        return deadline - (time.time() - t_start)
+
+    device: dict = {}
+    baseline: dict = {}
+    gbs = None
+    platform = None
+    _emit(device, baseline, gbs)  # a parseable line exists from the start
+
+    # device configs run as subprocesses BEFORE this process touches
+    # jax: a parent holding the TPU could wedge children on
+    # device-exclusive backends
+    cfgs = _configs()
+    for name, sf in cfgs:
+        key = f"{name}_sf{sf:g}"
+        budget = min(cfg_timeout, remaining() - 20)
+        if budget < 60:
+            print(f"bench: deadline — skipping {key} and later configs",
+                  file=sys.stderr, flush=True)
+            break
+        secs, plat = _run_one_subprocess(name, sf, {}, int(budget))
+        if secs is not None:
+            device[key] = secs
+            platform = plat or platform
+            _emit(device, baseline, gbs)
+        # small-SF CPU baselines interleave right behind their device
+        # run — they are cheap and give the headline a measured
+        # vs_baseline as early as possible. SF-large baselines wait
+        # until every device config has had its shot (skipped first).
+        if (secs is not None and sf <= 1 and platform not in (None, "cpu")
+                and not skip_cpu):
+            budget = min(cpu_timeout, remaining() - 20)
+            if budget >= 60:
+                b, _ = _run_one_subprocess(
+                    name, sf, _CPU_ENV,
+                    int(budget),
+                )
+                if b is not None:
+                    baseline[key] = b
+                    _emit(device, baseline, gbs)
+
+    # probe throughput (parent imports jax here — device children done)
+    if platform not in (None, "cpu") and remaining() > 60:
+        try:
+            gbs = probe_gbs()
+            _emit(device, baseline, gbs)
+        except Exception as ex:
+            print(f"bench: probe_gbs skipped ({type(ex).__name__})",
+                  file=sys.stderr, flush=True)
+
+    # SF-large CPU baselines last: first to go when budget runs short
+    if platform not in (None, "cpu") and not skip_cpu:
+        for name, sf in cfgs:
+            key = f"{name}_sf{sf:g}"
+            if sf <= 1 or key not in device or key in baseline:
+                continue
+            budget = min(cpu_timeout, remaining() - 20)
+            if budget < 120:
+                print(f"bench: deadline — skipping cpu baseline for {key}",
+                      file=sys.stderr, flush=True)
+                continue
+            b, _ = _run_one_subprocess(
+                name, sf, _CPU_ENV,
+                int(budget),
+            )
+            if b is not None:
+                baseline[key] = b
+                _emit(device, baseline, gbs)
+
+    _emit(device, baseline, gbs)
 
 
 if __name__ == "__main__":

@@ -121,7 +121,13 @@ def _parse_cell(text: str, t: T.DataType):
             return float(text), False
         if t.is_string:
             return text, False
-        return int(float(text)), False  # bigint; tolerate "3.0"
+        # bigint: int(text) first — int(float(text)) loses precision past
+        # 2^53 (9007199254740993 would read back as ...992); the float
+        # path only tolerates decimal-looking text like "3.0"
+        try:
+            return int(text), False
+        except ValueError:
+            return int(float(text)), False
     except (ValueError, OverflowError):
         return 0, True
 

@@ -71,10 +71,20 @@ class LocalExchange:
 
     def put(self, batch) -> None:
         with self._not_full:
-            while (
-                not self._aborted
-                and min(len(q) for q in self._queues) >= self._max
-            ):
+            # the gate must watch the queue(s) this put will grow:
+            # broadcast appends to EVERY queue (bound = fullest);
+            # round_robin appends to a specific queue (bound = that one);
+            # arbitrary appends to the shortest (bound = min). Gating
+            # everything on min would let a slow consumer's queue grow
+            # without limit while a fast consumer keeps the min small.
+            def _level() -> int:
+                if self.mode == "broadcast":
+                    return max(len(q) for q in self._queues)
+                if self.mode == "round_robin":
+                    return len(self._queues[self._rr % len(self._queues)])
+                return min(len(q) for q in self._queues)
+
+            while not self._aborted and _level() >= self._max:
                 self._not_full.wait(0.1)
             if self._aborted:
                 return

@@ -127,17 +127,19 @@ def find_matches(
         tags: List[str] = []
         got = None
         for endpos in _match_here(pattern, masks, pos, end, tags, budget):
-            if endpos > pos:  # empty matches produce no output row
-                got = (pos, endpos, list(tags[: endpos - pos]))
-                break  # generator order is greedy-first
+            # greedy-first generator order: the first yield IS the match.
+            # An empty match (endpos == pos) still produces an output row
+            # (SQL standard ONE ROW PER MATCH; NULL measures, no tags).
+            got = (pos, endpos, list(tags[: endpos - pos]))
+            break
         if got is None:
             pos += 1
             continue
         out.append(got)
         if after_match == "next_row":
             pos = got[0] + 1
-        else:  # past_last
-            pos = got[1]
+        else:  # past_last; an empty match must still advance
+            pos = max(got[1], got[0] + 1)
     return out
 
 
@@ -329,6 +331,8 @@ class MatchRecognizeOperator:
         if m.kind == "match_number":
             return (match_no, True)
         if m.kind == "classifier":
+            if not tags:  # empty match: CLASSIFIER() is NULL
+                return (0, False)
             return (cl_dict.code(tags[-1]), True)
         # first/last over rows tagged var (or the whole match)
         if m.var is None:

@@ -1671,10 +1671,11 @@ GRACE_PARTITIONS = 8
 
 # batches whose capacity dwarfs their live count get host-compacted at
 # blocking boundaries: every downstream kernel then compiles at the
-# small shape. XLA:TPU compile time for sort-heavy programs grows
-# brutally with array length (a 15M-row probe compile was measured in
-# HOURS over the tunneled device), so keeping dead capacity out of the
-# sort kernels matters more than the one host round trip.
+# small shape and moves less HBM. (An earlier note here blamed sort
+# compile time "growing brutally with array length"; r3 measurement
+# localized that to lax.associative_scan — now banned, see
+# ops/groupby.py — while sort itself compiles in ~20-60s at any
+# multi-million-row shape. Compaction remains worthwhile for runtime.)
 _SHRINK_MIN_CAPACITY = 1 << 17
 
 

@@ -970,14 +970,21 @@ class ExprBinder:
                 return prev[-1]
 
             def _ham(s, t=t):
+                # dict-table evaluation covers every table-stable
+                # dictionary value, including ones the query never
+                # selects — a mismatched length must not fail the whole
+                # bind (the reference raises per-ROW). NULL for those
+                # entries; rows that actually select them get NULL
+                # instead of Trino's error (documented divergence).
                 if len(s) != len(t):
-                    raise ValueError(
-                        "hamming_distance: strings must be the same length"
-                    )
+                    return None
                 return sum(a != b for a, b in zip(s, t))
 
-            fn = _lev if name == "levenshtein_distance" else _ham
-            return self._bind_dict_table(args[0], T.BIGINT, fn, jnp.int64)
+            if name == "hamming_distance":
+                return self._bind_dict_table_nullable(
+                    args[0], T.BIGINT, _ham, jnp.int64
+                )
+            return self._bind_dict_table(args[0], T.BIGINT, _lev, jnp.int64)
         if name.startswith("url_"):
             return self._bind_url_fn(name, e, args)
         if name in ("json_extract_scalar", "json_array_length", "json_size"):

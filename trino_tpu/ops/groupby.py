@@ -316,17 +316,42 @@ def _segment_bounds(sk, sv, sm, n, out_capacity):
     return boundary, starts, safe_starts, ends, used, n_groups, overflowed
 
 
-def _seg_scan(op, neutral, flags, vals):
-    """Segmented inclusive scan: `flags` marks segment starts; `op` must
-    be associative. Runs as one lax.associative_scan (log-depth on TPU)."""
+# NOTE on scans: multi-operand lax.associative_scan compiles
+# pathologically on XLA:TPU at multi-million-element shapes (measured
+# HANGING >400s where a full sort of the same array compiles in ~60s).
+# Everything here therefore uses cumsum / scatter / gather / segment
+# reduces, which compile flat regardless of length.
 
-    def combine(a, b):
-        af, av = a
-        bf, bv = b
-        return af | bf, jnp.where(bf, bv, op(av, bv))
 
-    _, out = jax.lax.associative_scan(combine, (flags, vals))
-    return out
+def _seg_id(boundary: jnp.ndarray) -> jnp.ndarray:
+    """Per row: its segment ordinal (rows before the first boundary get
+    -1; callers clip or mask)."""
+    return jnp.cumsum(boundary.astype(jnp.int32)) - 1
+
+
+def _seg_first(boundary: jnp.ndarray, vals: jnp.ndarray) -> jnp.ndarray:
+    """Per row: vals at its segment's FIRST position (keep-first
+    broadcast). Rows before the first boundary read segment 0's value."""
+    n = boundary.shape[0]
+    g = _seg_id(boundary)
+    S = jnp.zeros(n, jnp.int32).at[
+        jnp.where(boundary, g, n)
+    ].set(jnp.arange(n, dtype=jnp.int32), mode="drop")
+    return take_clip(vals, take_clip(S, g))
+
+
+def _seg_reduce(red, contrib, boundary, num_segments: int):
+    """Per-SEGMENT min/max reduction (not a running scan — the grouped
+    consumers only read each segment's total). Returns an array indexed
+    by segment ordinal, aligned with _segment_bounds' group slots. bool
+    participates via an int32 round-trip (segment_min lacks bool)."""
+    g = _seg_id(boundary)
+    as_bool = contrib.dtype == jnp.bool_
+    if as_bool:
+        contrib = contrib.astype(jnp.int32)
+    fn = jax.ops.segment_min if red == "min" else jax.ops.segment_max
+    out = fn(contrib, g, num_segments=num_segments)
+    return out.astype(jnp.bool_) if as_bool else out
 
 
 def _dense_gid(keys, valids, mask, dims, radices):
@@ -699,9 +724,7 @@ def sort_group_reduce(
         boundary = sm & (first | (hs != jnp.roll(hs, 1)))
         if extra:
             h2s = sorted_ops[num_keys + 1]
-            rep = _seg_scan(
-                lambda a, b: a, jnp.uint32(0), boundary, h2s
-            )
+            rep = _seg_first(boundary, h2s)
             collision = jnp.any(sm & (h2s != rep))
         else:
             collision = jnp.asarray(False)
@@ -793,22 +816,19 @@ def sort_group_reduce(
                 info = jnp.iinfo(sv_.dtype)
                 neutral = info.max if red == "min" else info.min
             contrib = jnp.where(w, sv_, jnp.asarray(neutral, dtype=sv_.dtype))
-            op = jnp.minimum if red == "min" else jnp.maximum
-            scanned = _seg_scan(op, neutral, boundary, contrib)
-            out = take_clip(scanned, ends)
-        elif red == "first":
-            # first non-null value per segment: segmented keep-first scan
-            def combine(a, b):
-                af, ah, av = a
-                bf, bh, bv = b
-                h = jnp.where(bf, bh, ah | bh)
-                val = jnp.where(bf, bv, jnp.where(ah, av, bv))
-                return af | bf, h, val
-
-            _, _, scanned = jax.lax.associative_scan(
-                combine, (boundary, w, sv_)
+            out = _seg_reduce(
+                "min" if red == "min" else "max",
+                contrib, boundary, ends.shape[0],
             )
-            out = take_clip(scanned, ends)
+        elif red == "first":
+            # first non-null value per segment: the smallest row index
+            # whose value is non-null, then one gather
+            pos = jax.ops.segment_min(
+                jnp.where(w, jnp.arange(n, dtype=jnp.int32), jnp.int32(n)),
+                _seg_id(boundary),
+                num_segments=ends.shape[0],
+            )
+            out = take_clip(sv_, pos)
         else:
             raise ValueError(red)
         results.append(out)
@@ -871,30 +891,23 @@ def grouped_argbest(
         info = jnp.iinfo(s_by.dtype)
         neutral = info.max if kind == "min_by" else info.min
     nb = jnp.where(w, s_by, jnp.asarray(neutral, s_by.dtype))
-    better = (
-        (lambda new, cur: new < cur)
-        if kind == "min_by"
-        else (lambda new, cur: new > cur)
+    # two segment reduces + gathers instead of a 5-operand associative
+    # scan (see the scan NOTE above): (1) the best `by` per segment,
+    # (2) the FIRST row attaining it (ties keep first in sort order).
+    # NaN `by` values diverge from the old scan (NaN poisons the
+    # reduce -> NULL result, where the scan kept the first valid row);
+    # SQL comparison keys are NaN-free in practice.
+    cap = ends.shape[0]
+    g = _seg_id(boundary)
+    best = _seg_reduce("min" if kind == "min_by" else "max", nb, boundary, cap)
+    is_best = w & (nb == take_clip(best, g))
+    pos = jax.ops.segment_min(
+        jnp.where(is_best, jnp.arange(n, dtype=jnp.int32), jnp.int32(n)),
+        g, num_segments=cap,
     )
-
-    def combine(a, bseg):
-        af, ah, ab, ax, av = a
-        bf, bh, bb, bx, bv = bseg
-        # segment restart: right side starts fresh
-        take_right = bf | (bh & (~ah | better(bb, ab)))
-        return (
-            af | bf,
-            jnp.where(bf, bh, ah | bh),
-            jnp.where(take_right, bb, ab),
-            jnp.where(take_right, bx, ax),
-            jnp.where(take_right, bv, av),
-        )
-
-    _, has_run, _, x_run, xv_run = jax.lax.associative_scan(
-        combine, (boundary, w, nb, s_x, s_xv)
-    )
-    out_x = take_clip(x_run, ends)
-    out_valid = take_clip(has_run & xv_run, ends) & used
+    has = pos < n
+    out_x = take_clip(s_x, pos)
+    out_valid = has & take_clip(s_xv, pos) & used
     return jnp.where(used, out_x, jnp.zeros((), out_x.dtype)), out_valid
 
 

@@ -4,8 +4,11 @@ Analogue of Trino's WindowOperator + window function implementations
 (main/operator/WindowOperator.java:69, operator/window/ — PagesIndex
 sorted by partition+order keys, then per-frame accumulation). TPU-first
 delta: one multi-key argsort puts rows in (partition, order) order, then
-every function is a vectorized segmented scan (cumsum / associative
-scan) over the whole column — no per-row frame loops. Frames supported:
+every function is a vectorized segmented scan over the whole column —
+no per-row frame loops. Scans use only cumsum/cummax/cummin primitives:
+lax.associative_scan (any operand count) HANGS the XLA:TPU compiler at
+multi-million-element shapes (see ops/groupby.py's scan NOTE).
+Frames supported:
 
 - whole partition      (no ORDER BY, or ROWS/RANGE UNBOUNDED..UNBOUNDED)
 - running rows         (ROWS UNBOUNDED PRECEDING..CURRENT ROW)
@@ -104,18 +107,72 @@ def _running_sum(vals: jnp.ndarray, part_start: jnp.ndarray) -> jnp.ndarray:
     return cs - base
 
 
+def _enc64(vals: jnp.ndarray) -> jnp.ndarray:
+    """Order-preserving uint64 encoding (x < y <=> enc(x) < enc(y))."""
+    if vals.dtype == jnp.bool_:
+        return vals.astype(jnp.uint64)
+    if jnp.issubdtype(vals.dtype, jnp.floating):
+        bits = jax.lax.bitcast_convert_type(
+            vals.astype(jnp.float64), jnp.uint64
+        )
+        neg = (bits >> jnp.uint64(63)) == jnp.uint64(1)
+        return jnp.where(neg, ~bits, bits | (jnp.uint64(1) << jnp.uint64(63)))
+    return vals.astype(jnp.int64).astype(jnp.uint64) ^ (
+        jnp.uint64(1) << jnp.uint64(63)
+    )
+
+
+def _dec64(enc: jnp.ndarray, dtype) -> jnp.ndarray:
+    """Inverse of _enc64."""
+    if dtype == jnp.bool_:
+        return enc != jnp.uint64(0)
+    if jnp.issubdtype(dtype, jnp.floating):
+        top = (enc >> jnp.uint64(63)) == jnp.uint64(1)
+        bits = jnp.where(
+            top, enc & ~(jnp.uint64(1) << jnp.uint64(63)), ~enc
+        )
+        return jax.lax.bitcast_convert_type(bits, jnp.float64).astype(dtype)
+    return (enc ^ (jnp.uint64(1) << jnp.uint64(63))).astype(jnp.int64).astype(
+        dtype
+    )
+
+
 def _scan_minmax(vals: jnp.ndarray, part_start: jnp.ndarray, kind: str) -> jnp.ndarray:
-    """Segmented running min/max via an associative scan over
-    (restart_flag, value) pairs."""
-    op = jnp.minimum if kind == "min" else jnp.maximum
+    """Segmented running min/max WITHOUT lax.associative_scan (whose
+    XLA:TPU compile hangs at multi-million-element shapes — see
+    ops/groupby.py's scan NOTE; lax.cummax compiles flat).
 
-    def combine(a, b):
-        af, av = a
-        bf, bv = b
-        return af | bf, jnp.where(bf, bv, op(av, bv))
-
-    _, out = jax.lax.associative_scan(combine, (part_start, vals))
-    return out
+    Strategy: encode order-preservingly into uint64 (negated for min so
+    max machinery serves both), then two cummax passes: (1) over
+    (segment_id || hi32) — the per-segment running max of the high half
+    with automatic reset, since a later segment's id dominates; (2) over
+    (hi-change-points || lo32) restricted to rows attaining the current
+    hi — the running lo among hi-ties, reset whenever run_hi advances.
+    Exact for every 64-bit-encodable type."""
+    n = vals.shape[0]
+    enc = _enc64(vals)
+    if kind == "min":
+        enc = ~enc
+    first = jnp.arange(n) == 0
+    g = jnp.maximum(
+        jnp.cumsum(part_start.astype(jnp.int64)) - 1, 0
+    ).astype(jnp.uint64)
+    hi = enc >> jnp.uint64(32)
+    lo = enc & jnp.uint64(0xFFFFFFFF)
+    run_ph = jax.lax.cummax((g << jnp.uint64(32)) | hi)
+    run_hi = run_ph & jnp.uint64(0xFFFFFFFF)
+    change = (run_ph != jnp.roll(run_ph, 1)) | first
+    g2 = (jnp.cumsum(change.astype(jnp.int64)) - 1).astype(jnp.uint64)
+    # rows below the current hi contribute 0 (neutral: lo >= 0, and the
+    # row that set run_hi always contributes at its g2 segment start)
+    contrib = jnp.where(hi == run_hi, lo, jnp.uint64(0))
+    run_lo = jax.lax.cummax((g2 << jnp.uint64(32)) | contrib) & jnp.uint64(
+        0xFFFFFFFF
+    )
+    out = (run_hi << jnp.uint64(32)) | run_lo
+    if kind == "min":
+        out = ~out
+    return _dec64(out, vals.dtype)
 
 
 def windowed_agg(

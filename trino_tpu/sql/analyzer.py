@@ -1145,7 +1145,7 @@ class Analyzer:
 
         items: List[RelationItem] = []
         self._collect_relations(spec.from_, items, conjunct_pool, ctes)
-        items = self._resolve_lateral_unnests(items)
+        items, decl_segments = self._resolve_lateral_unnests(items)
 
         # classify conjuncts
         leftovers: List[ast.Expression] = []
@@ -1206,6 +1206,22 @@ class Analyzer:
             joined.append(new)
             pending_edges = [e for e in pending_edges if e not in edges]
 
+        # restore FROM declaration order: greedy assembly (and the
+        # build/probe swap in _join_items) concatenates scopes in join
+        # order, but SELECT * and positional semantics follow the FROM
+        # clause — re-project when the two differ
+        perm: List[int] = []
+        for pi, lo, hi in decl_segments:
+            base = current_offsets[pi]
+            perm.extend(range(base + lo, base + hi))
+        if perm != list(range(len(current.scope.fields))):
+            fields = tuple(current.node.fields[c] for c in perm)
+            exprs = tuple(
+                ir.InputRef(c, current.node.fields[c].type) for c in perm
+            )
+            node = P.ProjectNode(current.node, exprs, fields)
+            scope = Scope([current.scope.fields[c] for c in perm])
+            current = RelationItem(node, scope, current.rows)
         builder = Builder(current.node, current.scope)
         # any pending equi edges not used as keys become filters
         for a, b_, ia, ib in pending_edges:
@@ -1371,24 +1387,42 @@ class Analyzer:
         items: List[RelationItem] = []
         pool: List[ast.Expression] = []
         self._collect_relations(rel, items, pool, ctes)
-        items = self._resolve_lateral_unnests(items)
+        # single-item requirement => segments are always in order here
+        items, _ = self._resolve_lateral_unnests(items)
         if len(items) != 1 or pool:
             raise AnalysisError("nested join tree not yet supported here")
         return items[0]
 
-    def _resolve_lateral_unnests(self, items) -> list:
+    def _resolve_lateral_unnests(self, items):
         """Fold _DeferredUnnest markers (UNNEST over column references,
         `FROM t, UNNEST(t.arr)`) into their source items as UnnestNodes
         — the reference's correlated-unnest planning
-        (RelationPlanner.planJoinUnnest)."""
+        (RelationPlanner.planJoinUnnest).
+
+        Returns (physical_items, segments): `segments` lists, in FROM
+        declaration order, (physical_idx, field_lo, field_hi) ranges so
+        the caller can re-project the assembled join back to declaration
+        order — the unnest's columns belong at the MARKER's position in
+        SELECT *, not at the end of its owner's columns."""
+        out = [it for it in items if not isinstance(it, _DeferredUnnest)]
+        # declaration-ordered slots; marker slots are patched as folded
+        segments: List = []
+        slot_of_marker: Dict[int, int] = {}
+        phys = 0
+        for i, it in enumerate(items):
+            if isinstance(it, _DeferredUnnest):
+                slot_of_marker[i] = len(segments)
+                segments.append(None)
+            else:
+                segments.append((phys, 0, len(it.scope.fields)))
+                phys += 1
         markers = [
             (i, it) for i, it in enumerate(items)
             if isinstance(it, _DeferredUnnest)
         ]
         if not markers:
-            return items
-        out = [it for it in items if not isinstance(it, _DeferredUnnest)]
-        for _, marker in markers:
+            return items, segments
+        for marker_pos, marker in markers:
             rel = marker.rel
             # locate the single source item owning every referenced column
             owner_idx = None
@@ -1450,8 +1484,12 @@ class Analyzer:
                     for f in new_fields
                 ]
             )
+            w_before = len(src.scope.fields)
+            segments[slot_of_marker[marker_pos]] = (
+                owner_idx, w_before, w_before + len(new_fields)
+            )
             out[owner_idx] = RelationItem(node, scope, src.rows * 3.0)
-        return out
+        return out, segments
 
     def _plan_relation_leaf(self, rel: ast.Relation, ctes) -> RelationItem:
         if isinstance(rel, ast.TableRef):
