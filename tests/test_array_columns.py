@@ -115,13 +115,17 @@ def test_array_type_rendering(runner):
     assert d["scores"] == "array(bigint)"
 
 
-def test_array_cannot_cross_exchange():
+def test_array_crosses_exchange():
+    """r2 raised here ("ARRAY columns cannot cross an exchange"); the
+    TPG2 nested encodings made arrays first-class on the wire — see
+    test_nested_types.py for the full matrix."""
     from trino_tpu.block import RelBatch
-    from trino_tpu.exec.serde import Page
+    from trino_tpu.exec.serde import Page, deserialize_page, serialize_page
 
     col = ArrayColumn.from_pylists(T.BIGINT, [[1], [2, 3]])
-    with pytest.raises(NotImplementedError, match="cross an exchange"):
-        Page.from_batch(RelBatch([col]))
+    page = Page.from_batch(RelBatch([col]))
+    back = deserialize_page(serialize_page(page)).to_batch()
+    assert back.columns[0].to_pylist(count=2) == [[1], [2, 3]]
 
 
 def test_select_array_column_directly(runner):

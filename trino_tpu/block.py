@@ -181,6 +181,12 @@ class Column:
     def from_pylist(type_: T.DataType, values: Sequence[Any], capacity=None) -> "Column":
         if type_.kind == T.TypeKind.ARRAY:
             return ArrayColumn.from_pylists(type_.element, values, capacity)
+        if type_.kind == T.TypeKind.MAP:
+            return MapColumn.from_pydicts(
+                type_.key, type_.element, values, capacity
+            )
+        if type_.kind == T.TypeKind.ROW:
+            return RowColumn.from_pytuples(type_, values, capacity)
         has_null = any(v is None for v in values)
         if type_.is_string:
             dictionary = Dictionary([v for v in values if v is not None])
@@ -230,8 +236,9 @@ class ArrayColumn(Column):
     child is shared, never re-laid-out.
 
     Array columns flow scan -> (filter/project passthrough) -> UNNEST
-    within a task; they do not cross exchanges (the page wire format
-    rejects them loudly — nested columns on the wire are planned work).
+    within a task, and cross exchanges via the TPG2 nested wire
+    encodings (exec/serde.py — offsets + recursively-encoded flat child,
+    the ArrayBlockEncoding analogue).
     """
 
     starts: Optional[jnp.ndarray] = None  # int32 (capacity,)
@@ -330,6 +337,205 @@ class ArrayColumn(Column):
         for s, ln, ok in zip(starts, lengths, valid):
             rows.append(
                 list(flat_vals[int(s):int(s) + int(ln)]) if ok else None
+            )
+        if live is not None:
+            rows = [r for r, k in zip(rows, np.asarray(live)) if k]
+        if count is not None:
+            rows = rows[:count]
+        return rows
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class MapColumn(Column):
+    """MAP-typed column: per-row entry views into two flattened child
+    columns (spi/block/MapBlock.java's keys+values layout, SoA form).
+    `data` holds per-row entry COUNTS so cardinality() reads an ordinary
+    int32 array; `starts` + `flat_keys`/`flat_values` carry the entries.
+    gather() moves only the per-row views; the flat children are shared."""
+
+    starts: Optional[jnp.ndarray] = None  # int32 (capacity,)
+    flat_keys: Optional[Column] = None
+    flat_values: Optional[Column] = None
+
+    def tree_flatten(self):
+        return (
+            (self.data, self.valid, self.starts, self.flat_keys,
+             self.flat_values),
+            (self.type, self.dictionary),
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        data, valid, starts, fk, fv = children
+        return cls(aux[0], data, valid, aux[1], starts, fk, fv)
+
+    def gather(self, positions: jnp.ndarray, positions_valid=None) -> "MapColumn":
+        pos = jnp.clip(positions, 0, self.data.shape[0] - 1)
+        lengths = jnp.take(self.data, pos)
+        starts = jnp.take(self.starts, pos)
+        valid = None
+        if self.valid is not None:
+            valid = jnp.take(self.valid, pos)
+        if positions_valid is not None:
+            valid = positions_valid if valid is None else (valid & positions_valid)
+        return MapColumn(
+            self.type, lengths, valid, self.dictionary, starts,
+            self.flat_keys, self.flat_values,
+        )
+
+    def with_data(self, data, valid="__same__") -> "MapColumn":
+        return MapColumn(
+            self.type,
+            data,
+            self.valid if isinstance(valid, str) else valid,
+            self.dictionary,
+            self.starts,
+            self.flat_keys,
+            self.flat_values,
+        )
+
+    @staticmethod
+    def from_pydicts(key_type: T.DataType, value_type: T.DataType, values,
+                     capacity=None) -> "MapColumn":
+        """values: sequence of python dicts (None = NULL map)."""
+        n = len(values)
+        cap = capacity if capacity is not None else bucket_capacity(n)
+        lengths = np.zeros(cap, dtype=np.int32)
+        starts = np.zeros(cap, dtype=np.int32)
+        fk: list = []
+        fv: list = []
+        valid = None
+        if any(v is None for v in values):
+            valid = np.zeros(cap, dtype=bool)
+        pos = 0
+        for i, v in enumerate(values):
+            starts[i] = pos
+            if v is None:
+                continue
+            if valid is not None:
+                valid[i] = True
+            lengths[i] = len(v)
+            for k, x in v.items():
+                fk.append(k)
+                fv.append(x)
+            pos += len(v)
+        return MapColumn(
+            T.map_of(key_type, value_type),
+            jnp.asarray(lengths),
+            jnp.asarray(valid) if valid is not None else None,
+            None,
+            jnp.asarray(starts),
+            Column.from_pylist(key_type, fk),
+            Column.from_pylist(value_type, fv),
+        )
+
+    def to_pylist(self, count: Optional[int] = None, live: Optional[np.ndarray] = None):
+        lengths = np.asarray(self.data)
+        starts = np.asarray(self.starts)
+        valid = (
+            np.asarray(self.valid)
+            if self.valid is not None
+            else np.ones(len(lengths), bool)
+        )
+        ks = self.flat_keys.to_pylist()
+        vs = self.flat_values.to_pylist()
+        rows = []
+        for s, ln, ok in zip(starts, lengths, valid):
+            if not ok:
+                rows.append(None)
+            else:
+                s, ln = int(s), int(ln)
+                rows.append(dict(zip(ks[s:s + ln], vs[s:s + ln])))
+        if live is not None:
+            rows = [r for r, k in zip(rows, np.asarray(live)) if k]
+        if count is not None:
+            rows = rows[:count]
+        return rows
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class RowColumn(Column):
+    """ROW-typed column: parallel child columns, one per field
+    (spi/block/RowBlock.java). `data` is a per-row presence byte (int8 1)
+    so generic code sees an ordinary array; NULL rows ride `valid`."""
+
+    children: Optional[list] = None  # list[Column], same capacity
+
+    def tree_flatten(self):
+        return (
+            (self.data, self.valid, self.children),
+            (self.type, self.dictionary),
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        data, valid, kids = children
+        return cls(aux[0], data, valid, aux[1], list(kids))
+
+    def gather(self, positions: jnp.ndarray, positions_valid=None) -> "RowColumn":
+        pos = jnp.clip(positions, 0, self.data.shape[0] - 1)
+        data = jnp.take(self.data, pos)
+        valid = None
+        if self.valid is not None:
+            valid = jnp.take(self.valid, pos)
+        if positions_valid is not None:
+            valid = positions_valid if valid is None else (valid & positions_valid)
+        return RowColumn(
+            self.type, data, valid, self.dictionary,
+            [c.gather(positions, positions_valid) for c in self.children],
+        )
+
+    def with_data(self, data, valid="__same__") -> "RowColumn":
+        return RowColumn(
+            self.type,
+            data,
+            self.valid if isinstance(valid, str) else valid,
+            self.dictionary,
+            self.children,
+        )
+
+    @staticmethod
+    def from_pytuples(row_type: T.DataType, values, capacity=None) -> "RowColumn":
+        """values: sequence of python tuples/lists (None = NULL row)."""
+        n = len(values)
+        cap = capacity if capacity is not None else bucket_capacity(n)
+        presence = np.zeros(cap, dtype=np.int8)
+        presence[:n] = 1
+        valid = None
+        if any(v is None for v in values):
+            valid = np.zeros(cap, dtype=bool)
+            for i, v in enumerate(values):
+                valid[i] = v is not None
+        kids = []
+        for fi, (_, ft) in enumerate(row_type.row_fields):
+            kids.append(
+                Column.from_pylist(
+                    ft,
+                    [None if v is None else v[fi] for v in values],
+                    capacity=cap,
+                )
+            )
+        return RowColumn(
+            row_type,
+            jnp.asarray(presence),
+            jnp.asarray(valid) if valid is not None else None,
+            None,
+            kids,
+        )
+
+    def to_pylist(self, count: Optional[int] = None, live: Optional[np.ndarray] = None):
+        valid = (
+            np.asarray(self.valid)
+            if self.valid is not None
+            else np.ones(self.capacity, bool)
+        )
+        kid_vals = [c.to_pylist() for c in self.children]
+        rows = []
+        for i in range(self.capacity):
+            rows.append(
+                tuple(kv[i] for kv in kid_vals) if valid[i] else None
             )
         if live is not None:
             rows = [r for r, k in zip(rows, np.asarray(live)) if k]

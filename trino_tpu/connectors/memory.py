@@ -150,6 +150,9 @@ class MemoryMetadata(ConnectorMetadata):
                         Dictionary([]) if c.type.element.is_string else None,
                     )
                     continue
+                if c.type.is_nested:  # MAP / ROW: python-object storage
+                    st.data[c.name] = _StoredColumn(c.type, [], None, None)
+                    continue
                 st.data[c.name] = _StoredColumn(
                     c.type,
                     np.zeros(0, dtype=c.type.dtype),
@@ -229,6 +232,11 @@ class MemoryPageSource(ConnectorPageSource):
                         capacity=cap, dictionary=sc.dictionary,
                     ))
                     continue
+                if sc.type.is_nested:  # MAP / ROW
+                    cols.append(Column.from_pylist(
+                        sc.type, list(sc.data[a:b]), capacity=cap,
+                    ))
+                    continue
                 arr = np.zeros(cap, dtype=sc.type.dtype)
                 arr[:n] = sc.data[a:b]
                 valid = None
@@ -251,6 +259,11 @@ class MemoryPageSource(ConnectorPageSource):
                     cols.append(ArrayColumn.from_pylists(
                         sc.type.element, [None] * 16, capacity=16,
                         dictionary=sc.dictionary,
+                    ))
+                    continue
+                if sc.type.is_nested:  # MAP / ROW
+                    cols.append(Column.from_pylist(
+                        sc.type, [None] * 16, capacity=16,
                     ))
                     continue
                 cols.append(Column(
@@ -439,6 +452,9 @@ class MemoryConnector(Connector):
                         for v in row if v is not None
                     ])
                 t.data[cm.name] = _StoredColumn(cm.type, list(arr), None, d)
+                continue
+            if cm.type.is_nested:  # MAP / ROW: python-object storage
+                t.data[cm.name] = _StoredColumn(cm.type, list(arr), None, None)
                 continue
             d = dictionaries[i] if dictionaries else None
             if cm.type.is_string and d is None:

@@ -290,7 +290,10 @@ class MatchRecognizeOperator:
         live = np.zeros(cap, dtype=bool)
         live[:n] = True
         ext_batch = RelBatch(cols, jnp.asarray(live))
-        ext_cols = [c.data for c in ext_batch.columns]
+        # nested columns ride whole (make_filter_project_fn contract)
+        ext_cols = [
+            c if c.type.is_nested else c.data for c in ext_batch.columns
+        ]
         ext_vs = [c.valid for c in ext_batch.columns]
         masks: Dict[str, np.ndarray] = {}
         for var, fn in self._define_fns:

@@ -148,7 +148,12 @@ def make_filter_project_fn(
     projections = list(projections)
 
     def fn(batch: RelBatch) -> RelBatch:
-        cols = [c.data for c in batch.columns]
+        # nested columns (ARRAY/MAP/ROW) ride the cols list WHOLE — their
+        # starts/flat/children would be silently dropped by a bare data
+        # array; nested-aware bindings unwrap what they need
+        cols = [
+            c if c.type.is_nested else c.data for c in batch.columns
+        ]
         valids = [c.valid for c in batch.columns]
         live = batch.live
         if filter_bound is not None:
@@ -157,17 +162,17 @@ def make_filter_project_fn(
             live = keep if live is None else (live & keep)
         out_cols = []
         for b in projections:
-            if b.type.is_array:
-                # ARRAY columns pass through WHOLE (starts+flat would be
-                # silently dropped by the (data, valid) rebuild — the
-                # lengths array masquerading as values)
-                if b.input_ref is None or b.input_ref >= len(batch.columns):
-                    raise NotImplementedError(
-                        "computed ARRAY expressions are not supported"
-                    )
-                out_cols.append(batch.columns[b.input_ref])
-                continue
             data, valid = b.fn(cols, valids)
+            if isinstance(data, Column):
+                # nested-typed result (column passthrough, map_keys,
+                # row_pack, ...): already a full Column; merge validity
+                if valid is not None:
+                    v0 = data.valid
+                    data = data.with_data(
+                        data.data, valid if v0 is None else (v0 & valid)
+                    )
+                out_cols.append(data)
+                continue
             d = b.dictionary
             from trino_tpu.block import RuntimeDictionary
 
@@ -1874,7 +1879,12 @@ def make_residual_fn(residual: Bound):
 
     @jax.jit
     def fn(pairs: RelBatch):
-        cols = [c.data for c in pairs.columns]
+        # nested columns ride whole (same contract as
+        # make_filter_project_fn) so map/row navigation works in
+        # residual conjuncts too
+        cols = [
+            c if c.type.is_nested else c.data for c in pairs.columns
+        ]
         vs = [c.valid for c in pairs.columns]
         d, v = residual.fn(cols, vs)
         return d if v is None else (d & v)

@@ -32,6 +32,143 @@ from trino_tpu import types as T
 from trino_tpu.block import Column, Dictionary, RelBatch, bucket_capacity
 
 _HEADER = struct.Struct("<BI")
+
+
+@dataclasses.dataclass
+class HostNested:
+    """Host-side compacted NESTED column (ARRAY/MAP/ROW): per-row
+    physical array (lengths / entry counts / presence), validity, and
+    child columns — the ArrayBlockEncoding/MapBlock/RowBlock analogue.
+    Children are HostNested too (leaves have no children)."""
+
+    type: T.DataType
+    data: np.ndarray
+    valid: Optional[np.ndarray]
+    dictionary: Optional[Tuple[str, ...]]
+    children: List["HostNested"]
+
+    def nbytes(self) -> int:
+        n = self.data.nbytes + (self.valid.nbytes if self.valid is not None else 0)
+        return n + sum(c.nbytes() for c in self.children)
+
+    def to_pylist(self) -> list:
+        """Decode to python values (lists / dicts / tuples / scalars) —
+        the host-side result path, no device round trip."""
+        from trino_tpu.block import decode_values
+
+        t = self.type
+        n = len(self.data)
+        valid = self.valid if self.valid is not None else np.ones(n, bool)
+        if t.kind in (T.TypeKind.ARRAY, T.TypeKind.MAP):
+            lengths = self.data
+            offs = np.concatenate([[0], np.cumsum(lengths)])
+            if t.kind == T.TypeKind.ARRAY:
+                flat = self.children[0].to_pylist()
+                return [
+                    list(flat[offs[i]:offs[i + 1]]) if valid[i] else None
+                    for i in range(n)
+                ]
+            ks = self.children[0].to_pylist()
+            vs = self.children[1].to_pylist()
+            return [
+                dict(zip(ks[offs[i]:offs[i + 1]], vs[offs[i]:offs[i + 1]]))
+                if valid[i] else None
+                for i in range(n)
+            ]
+        if t.kind == T.TypeKind.ROW:
+            kid_vals = [c.to_pylist() for c in self.children]
+            return [
+                tuple(kv[i] for kv in kid_vals) if valid[i] else None
+                for i in range(n)
+            ]
+        return decode_values(t, self.data, valid, self.dictionary)
+
+
+def _slice_ranges(starts: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+    """Flat positions of the concatenated [starts[i], starts[i]+len[i])
+    slices — the vectorized gather list for nested compaction."""
+    total = int(lengths.sum())
+    if total == 0:
+        return np.zeros(0, dtype=np.int64)
+    out_off = np.concatenate([[0], np.cumsum(lengths)[:-1]])
+    return np.repeat(starts.astype(np.int64), lengths) + (
+        np.arange(total, dtype=np.int64) - np.repeat(out_off, lengths)
+    )
+
+
+def _compact_nested(col, idx: np.ndarray) -> HostNested:
+    """Device-host nested column -> HostNested keeping rows `idx`
+    (recursively flattening only those rows' element slices)."""
+    from trino_tpu.block import ArrayColumn, MapColumn, RowColumn
+
+    data = np.asarray(col.data)[idx]
+    valid = np.asarray(col.valid)[idx] if col.valid is not None else None
+    if isinstance(col, (ArrayColumn, MapColumn)):
+        lengths = data.astype(np.int64)
+        if valid is not None:
+            lengths = np.where(valid, lengths, 0)
+        starts = np.asarray(col.starts)[idx]
+        flat_idx = _slice_ranges(starts, lengths)
+        if isinstance(col, ArrayColumn):
+            kids = [_compact_nested(col.flat, flat_idx)]
+        else:
+            kids = [
+                _compact_nested(col.flat_keys, flat_idx),
+                _compact_nested(col.flat_values, flat_idx),
+            ]
+        return HostNested(col.type, lengths.astype(np.int32), valid, None, kids)
+    if isinstance(col, RowColumn):
+        kids = [_compact_nested(c, idx) for c in col.children]
+        return HostNested(col.type, data, valid, None, kids)
+    # leaf
+    dvals = col.dictionary.values if col.dictionary is not None else None
+    return HostNested(col.type, data, valid, dvals, [])
+
+
+def _nested_to_device(hn: HostNested, capacity: int):
+    """HostNested -> device column (padded to `capacity`)."""
+    import jax.numpy as jnp
+
+    from trino_tpu.block import ArrayColumn, MapColumn, RowColumn
+
+    t = hn.type
+    if t.kind in (T.TypeKind.ARRAY, T.TypeKind.MAP):
+        n = len(hn.data)
+        lengths = np.zeros(capacity, dtype=np.int32)
+        lengths[:n] = hn.data
+        starts = np.zeros(capacity, dtype=np.int32)
+        cum = np.cumsum(hn.data) - hn.data
+        starts[:n] = cum
+        valid = None
+        if hn.valid is not None:
+            v = np.zeros(capacity, dtype=bool)
+            v[:n] = hn.valid
+            valid = jnp.asarray(v)
+        total = int(hn.data.sum())
+        child_cap = max(bucket_capacity(total), 16)
+        if t.kind == T.TypeKind.ARRAY:
+            return ArrayColumn(
+                t, jnp.asarray(lengths), valid, None, jnp.asarray(starts),
+                _nested_to_device(hn.children[0], child_cap),
+            )
+        return MapColumn(
+            t, jnp.asarray(lengths), valid, None, jnp.asarray(starts),
+            _nested_to_device(hn.children[0], child_cap),
+            _nested_to_device(hn.children[1], child_cap),
+        )
+    if t.kind == T.TypeKind.ROW:
+        n = len(hn.data)
+        presence = np.zeros(capacity, dtype=np.int8)
+        presence[:n] = hn.data
+        valid = None
+        if hn.valid is not None:
+            v = np.zeros(capacity, dtype=bool)
+            v[:n] = hn.valid
+            valid = jnp.asarray(v)
+        kids = [_nested_to_device(c, capacity) for c in hn.children]
+        return RowColumn(t, jnp.asarray(presence), valid, None, kids)
+    d = Dictionary(hn.dictionary) if hn.dictionary is not None else None
+    return Column.from_numpy(t, hn.data, hn.valid, d, capacity=capacity)
 COMPRESS_MIN_BYTES = 1 << 13  # below this, compression costs more than it saves
 
 
@@ -56,7 +193,7 @@ class Page:
     def size_bytes(self) -> int:
         n = 0
         for c in self.columns:
-            n += c.nbytes
+            n += c.nbytes() if isinstance(c, HostNested) else c.nbytes
         for v in self.valids:
             if v is not None:
                 n += v.nbytes
@@ -65,20 +202,13 @@ class Page:
     @staticmethod
     def from_batch(batch: RelBatch) -> "Page":
         """Device batch -> compacted host page (one device->host copy;
-        live-row extraction via the native mask_gather sweep)."""
+        live-row extraction via the native mask_gather sweep for flat
+        columns; nested columns — ARRAY/MAP/ROW — compact recursively
+        into HostNested trees, flattening only the live rows' slices)."""
         import jax
 
         from trino_tpu import native
-        from trino_tpu.block import ArrayColumn
-
-        for c in batch.columns:
-            if isinstance(c, ArrayColumn):
-                # nested columns have no wire layout yet; losing the
-                # flat element store silently would corrupt data
-                raise NotImplementedError(
-                    "ARRAY columns cannot cross an exchange — UNNEST"
-                    " them in the producing fragment"
-                )
+        from trino_tpu.block import ArrayColumn, MapColumn, RowColumn
 
         host = jax.device_get(batch)
         live = (
@@ -86,9 +216,16 @@ class Page:
             if host.live is not None
             else np.ones(batch.capacity, dtype=bool)
         )
+        nested = [
+            isinstance(c, (ArrayColumn, MapColumn, RowColumn))
+            for c in host.columns
+        ]
+        live_idx = np.nonzero(live)[0] if any(nested) else None
         flat: List[np.ndarray] = []
         valid_idx: List[Optional[int]] = []
-        for c in host.columns:
+        for c, nest in zip(host.columns, nested):
+            if nest:
+                continue
             flat.append(np.asarray(c.data))
             if c.valid is not None:
                 valid_idx.append(len(flat))
@@ -98,7 +235,15 @@ class Page:
         compacted = native.mask_compact(flat, live)
         cols, valids, dicts, typs = [], [], [], []
         i = 0
-        for c, vi in zip(host.columns, valid_idx):
+        vi_iter = iter(valid_idx)
+        for c, nest in zip(host.columns, nested):
+            if nest:
+                cols.append(_compact_nested(c, live_idx))
+                valids.append(None)  # validity lives inside the HostNested
+                dicts.append(None)
+                typs.append(c.type)
+                continue
+            vi = next(vi_iter)
             cols.append(compacted[i])
             i += 1
             if vi is not None:
@@ -119,6 +264,9 @@ class Page:
         for t, data, valid, dvals in zip(
             self.types, self.columns, self.valids, self.dictionaries
         ):
+            if isinstance(data, HostNested):
+                out.append(_nested_to_device(data, cap))
+                continue
             d = Dictionary(dvals) if dvals is not None else None
             # Dictionary values are sorted + deduped on construction; wire
             # pages are encoded against the exact tuple, so re-encode codes
@@ -139,23 +287,136 @@ class Page:
 # --- self-describing binary page body (no pickle: bytes received from a
 # worker's HTTP port must never reach an object deserializer — the
 # reference's page wire is likewise a typed binary layout,
-# PagesSerdeUtil.java:53). Layout, little-endian:
-#   magic u32 'TPG1' | row_count u32 | width u16
-#   per column:
-#     kind u8 (TypeKind ordinal) | precision i16 (-1 none) | scale i16
+# PagesSerdeUtil.java:53; nested encodings per ArrayBlockEncoding /
+# MapBlock / RowBlock). Layout, little-endian:
+#   magic u32 'TPG2' | row_count u32 | width u16
+#   per column (recursive; nested children are columns at their own
+#   row counts — flattened elements for ARRAY/MAP, parallel fields
+#   for ROW):
+#     type descriptor: kind u8 | precision i16 (-1 none) | scale i16
+#       | n_sub u8 | per sub: name_len u8 + utf8 name + descriptor
 #     dtype_len u8 | dtype ascii  (numpy dtype str, e.g. '<i8')
 #     flags u8 (1 = validity present, 2 = dictionary present)
 #     [dict_count u32 | per value: len u32 + utf8]   (if dictionary)
-#     data_nbytes u64 | raw column bytes
-#     [row_count validity bytes]                     (if validity)
+#     n_rows u32 (this level)
+#     data_nbytes u64 | raw per-row physical bytes (values / lengths /
+#       entry counts / presence)
+#     [n_rows validity bytes]                        (if validity)
+#     n_children u8 | child columns...
 
-_MAGIC = 0x54504731  # 'TPG1'
+_MAGIC = 0x54504732  # 'TPG2'
 _KINDS = list(T.TypeKind)
 _KIND_ID = {k: i for i, k in enumerate(_KINDS)}
 _COL_HEAD = struct.Struct("<BhhB")
 _U16 = struct.Struct("<H")
 _U32 = struct.Struct("<I")
 _U64 = struct.Struct("<Q")
+
+
+def _enc_type(out: bytearray, t: T.DataType) -> None:
+    p = -1 if t.precision is None else int(t.precision)
+    s = -1 if t.scale is None else int(t.scale)
+    if t.kind == T.TypeKind.ARRAY:
+        subs = [(None, t.element)]
+    elif t.kind == T.TypeKind.MAP:
+        subs = [(None, t.key), (None, t.element)]
+    elif t.kind == T.TypeKind.ROW:
+        subs = list(t.row_fields)
+    else:
+        subs = []
+    out += _COL_HEAD.pack(_KIND_ID[t.kind], p, s, len(subs))
+    for name, st in subs:
+        nb = (name or "").encode("utf-8")
+        out += bytes([len(nb)]) + nb
+        _enc_type(out, st)
+
+
+def _dec_type(take) -> T.DataType:
+    kind_id, p, s, n_sub = _COL_HEAD.unpack(take(_COL_HEAD.size))
+    kind = _KINDS[kind_id]
+    subs = []
+    for _ in range(n_sub):
+        (nl,) = take(1)
+        name = bytes(take(nl)).decode("utf-8") or None
+        subs.append((name, _dec_type(take)))
+    if kind == T.TypeKind.ARRAY:
+        return T.array_of(subs[0][1])
+    if kind == T.TypeKind.MAP:
+        return T.map_of(subs[0][1], subs[1][1])
+    if kind == T.TypeKind.ROW:
+        return T.DataType(kind, row_fields=tuple(subs))
+    return T.DataType(kind, None if p < 0 else p, None if s < 0 else s)
+
+
+def _enc_col(out: bytearray, t: T.DataType, data: np.ndarray,
+             valid: Optional[np.ndarray],
+             dvals: Optional[Tuple[str, ...]],
+             children: List[HostNested]) -> None:
+    _enc_type(out, t)
+    ds = data.dtype.str.encode("ascii")
+    out += bytes([len(ds)]) + ds
+    flags = (1 if valid is not None else 0) | (2 if dvals is not None else 0)
+    out += bytes([flags])
+    if dvals is not None:
+        out += _U32.pack(len(dvals))
+        for v in dvals:
+            vb = v.encode("utf-8")
+            out += _U32.pack(len(vb)) + vb
+    n_rows = int(data.shape[0])
+    out += _U32.pack(n_rows)
+    raw = np.ascontiguousarray(data).tobytes()
+    out += _U64.pack(len(raw)) + raw
+    if valid is not None:
+        out += np.ascontiguousarray(valid, dtype=np.bool_).tobytes()
+    out += bytes([len(children)])
+    for c in children:
+        _enc_col(out, c.type, c.data, c.valid, c.dictionary, c.children)
+
+
+def _dec_col(take):
+    """-> (type, data, valid, dvals, children: List[HostNested])."""
+    t = _dec_type(take)
+    (ds_len,) = take(1)
+    dtype = np.dtype(bytes(take(ds_len)).decode("ascii"))
+    (flags,) = take(1)
+    dvals = None
+    if flags & 2:
+        (n_vals,) = _U32.unpack(take(4))
+        vals = []
+        for _ in range(n_vals):
+            (vl,) = _U32.unpack(take(4))
+            vals.append(bytes(take(vl)).decode("utf-8"))
+        dvals = tuple(vals)
+    (n_rows,) = _U32.unpack(take(4))
+    (nbytes,) = _U64.unpack(take(8))
+    data = np.frombuffer(take(nbytes), dtype=dtype).copy()
+    if data.shape[0] != n_rows:
+        raise ValueError("column length does not match row count")
+    valid = None
+    if flags & 1:
+        valid = np.frombuffer(take(n_rows), dtype=np.bool_).copy()
+    (n_children,) = take(1)
+    children = []
+    for _ in range(n_children):
+        ct, cd, cv, cdv, cc = _dec_col(take)
+        children.append(HostNested(ct, cd, cv, cdv, cc))
+    # structural validation: a corrupt nested frame must fail loudly,
+    # not decode into clamped gathers / silently-truncated slices
+    if t.kind in (T.TypeKind.ARRAY, T.TypeKind.MAP):
+        want = int(data.astype(np.int64).sum()) if n_rows else 0
+        for c in children:
+            if c.data.shape[0] != want:
+                raise ValueError(
+                    "nested child length does not match sum of parent"
+                    " lengths"
+                )
+    elif t.kind == T.TypeKind.ROW:
+        for c in children:
+            if c.data.shape[0] != n_rows:
+                raise ValueError(
+                    "row child length does not match parent row count"
+                )
+    return t, data, valid, dvals, children
 
 
 def _encode_body(page: Page) -> bytes:
@@ -166,22 +427,11 @@ def _encode_body(page: Page) -> bytes:
     for t, col, valid, dvals in zip(
         page.types, page.columns, page.valids, page.dictionaries
     ):
-        p = -1 if t.precision is None else int(t.precision)
-        s = -1 if t.scale is None else int(t.scale)
-        out += _COL_HEAD.pack(_KIND_ID[t.kind], p, s, 0)
-        ds = col.dtype.str.encode("ascii")
-        out += bytes([len(ds)]) + ds
-        flags = (1 if valid is not None else 0) | (2 if dvals is not None else 0)
-        out += bytes([flags])
-        if dvals is not None:
-            out += _U32.pack(len(dvals))
-            for v in dvals:
-                vb = v.encode("utf-8")
-                out += _U32.pack(len(vb)) + vb
-        data = col.tobytes()
-        out += _U64.pack(len(data)) + data
-        if valid is not None:
-            out += np.ascontiguousarray(valid, dtype=np.bool_).tobytes()
+        if isinstance(col, HostNested):
+            _enc_col(out, col.type, col.data, col.valid, col.dictionary,
+                     col.children)
+        else:
+            _enc_col(out, t, col, valid, dvals, [])
     return bytes(out)
 
 
@@ -201,36 +451,24 @@ def _decode_body(body) -> Page:
     (rows,) = _U32.unpack(take(4))
     (width,) = _U16.unpack(take(2))
     types: List[T.DataType] = []
-    cols: List[np.ndarray] = []
+    cols: List = []
     valids: List[Optional[np.ndarray]] = []
     dicts: List[Optional[Tuple[str, ...]]] = []
     for _ in range(width):
-        kind_id, p, s, _pad = _COL_HEAD.unpack(take(_COL_HEAD.size))
-        t = T.DataType(
-            _KINDS[kind_id], None if p < 0 else p, None if s < 0 else s
-        )
-        (ds_len,) = take(1)
-        dtype = np.dtype(bytes(take(ds_len)).decode("ascii"))
-        (flags,) = take(1)
-        dvals = None
-        if flags & 2:
-            (n_vals,) = _U32.unpack(take(4))
-            vals = []
-            for _ in range(n_vals):
-                (vl,) = _U32.unpack(take(4))
-                vals.append(bytes(take(vl)).decode("utf-8"))
-            dvals = tuple(vals)
-        (nbytes,) = _U64.unpack(take(8))
-        col = np.frombuffer(take(nbytes), dtype=dtype).copy()
-        if col.shape[0] != rows:
-            raise ValueError("column length does not match row count")
-        valid = None
-        if flags & 1:
-            valid = np.frombuffer(take(rows), dtype=np.bool_).copy()
+        t, data, valid, dvals, children = _dec_col(take)
+        if t.is_nested:
+            if data.shape[0] != rows:
+                raise ValueError("column length does not match row count")
+            cols.append(HostNested(t, data, valid, dvals, children))
+            valids.append(None)
+            dicts.append(None)
+        else:
+            if data.shape[0] != rows:
+                raise ValueError("column length does not match row count")
+            cols.append(data)
+            valids.append(valid)
+            dicts.append(dvals)
         types.append(t)
-        cols.append(col)
-        valids.append(valid)
-        dicts.append(dvals)
     return Page(types, cols, valids, dicts, rows)
 
 

@@ -24,6 +24,7 @@ import math
 import re as _re
 from typing import Callable, List, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -109,7 +110,11 @@ def _format_cast_text(v, src_type: T.DataType):
     return str(v)
 
 
-def _const(shape_src: jnp.ndarray, value, dtype) -> jnp.ndarray:
+def _const(shape_src, value, dtype) -> jnp.ndarray:
+    # shape reference may be a nested Column object (nested columns flow
+    # through the cols list whole — their data array carries the shape)
+    if hasattr(shape_src, "data") and not hasattr(shape_src, "shape"):
+        shape_src = shape_src.data
     return jnp.full(shape_src.shape, value, dtype=dtype)
 
 
@@ -890,12 +895,17 @@ class ExprBinder:
                 return days.astype(T.DATE.dtype), v
             return Bound(T.DATE, ldfn)
         if name == "array_length":
-            # ArrayColumn.data IS the per-row lengths array
+            # ArrayColumn/MapColumn.data IS the per-row lengths array
             a = args[0]
             def alfn(cols, valids):
                 d, v = a.fn(cols, valids)
+                if isinstance(d, Column):
+                    d = d.data
                 return d.astype(jnp.int64), v
             return Bound(T.BIGINT, alfn)
+        if name in ("map_subscript", "array_subscript", "map_keys",
+                    "map_values", "row_field", "row_pack"):
+            return self._bind_nested_op(name, e, args)
         if name == "year_of_week":
             a = args[0]
             def yowfn(cols, valids):
@@ -1158,6 +1168,202 @@ class ExprBinder:
             return take_clip(remap, d), ok if v is None else (v & ok)
 
         return Bound(e.type, fn, new_dict)
+
+    def _bind_nested_op(self, name: str, e, args) -> Bound:
+        """MAP/ROW/ARRAY navigation (MethodHandle operators on
+        MapType/RowType/ArrayType in the reference — MapSubscript,
+        RowFieldReference, spi/block accessors). Inputs arrive as whole
+        Column objects through the cols list; results are either plain
+        (data, valid) pairs (subscript, row_field) or full Columns
+        (map_keys/map_values/row_pack — nested outputs)."""
+        from trino_tpu.block import ArrayColumn, MapColumn, RowColumn
+
+        out_t = e.type
+
+        if name == "row_pack":
+            kids = list(args)
+
+            def packfn(cols, valids, kids=kids, out_t=out_t):
+                built = []
+                for b in kids:
+                    d, v = b.fn(cols, valids)
+                    if isinstance(d, Column):
+                        built.append(d)
+                    else:
+                        built.append(Column(b.type, d, v, b.dictionary))
+                ref = built[0].data if built else jnp.zeros(1)
+                presence = jnp.ones(ref.shape[0], jnp.int8)
+                return RowColumn(out_t, presence, None, None, built), None
+
+            return Bound(out_t, packfn)
+
+        a = args[0]
+
+        if name == "row_field":
+            fi = int(args[1].const_value)
+            # string/nested fields return the child COLUMN whole (its
+            # runtime dictionary / starts+flat are batch data that a
+            # bare (data, valid) pair would drop); plain scalars return
+            # the pair so arithmetic composes
+            as_column = out_t.is_string or out_t.is_nested
+
+            def rffn(cols, valids, a=a, fi=fi, as_column=as_column):
+                d, v = a.fn(cols, valids)
+                child = d.children[fi]
+                cv = child.valid
+                pv = d.valid if v is None else v
+                merged = (
+                    pv if cv is None else (cv if pv is None else (cv & pv))
+                )
+                if as_column:
+                    return child.with_data(child.data, merged), None
+                return child.data, merged
+
+            return Bound(out_t, rffn)
+
+        if name in ("map_keys", "map_values"):
+            def mkfn(cols, valids, a=a, name=name, out_t=out_t):
+                d, v = a.fn(cols, valids)
+                flat = d.flat_keys if name == "map_keys" else d.flat_values
+                valid = d.valid if v is None else v
+                return (
+                    ArrayColumn(out_t, d.data, valid, None, d.starts, flat),
+                    None,
+                )
+            return Bound(out_t, mkfn)
+
+        # subscripts: array access is one gather; map access is a
+        # bounded vectorized scan over each row's entry slice
+        # (lax.while_loop with a device-dependent trip count =
+        # max entry count — compile-safe; see the groupby scan NOTE)
+        k = args[1]
+
+        if name == "array_subscript":
+            def asfn(cols, valids, a=a, k=k, out_t=out_t):
+                d, v = a.fn(cols, valids)
+                kd, kv = k.fn(cols, valids)
+                lengths = d.data
+                starts = d.starts
+                flat = d.flat
+                F = flat.data.shape[0]
+                idx = kd.astype(jnp.int64)
+                # 1-based; negative counts from the end (element_at)
+                eff = jnp.where(idx > 0, idx - 1, lengths.astype(jnp.int64) + idx)
+                ok = (eff >= 0) & (eff < lengths.astype(jnp.int64))
+                pos = jnp.clip(
+                    starts.astype(jnp.int64) + jnp.where(ok, eff, 0), 0,
+                    max(F - 1, 0),
+                )
+                data = jnp.take(flat.data, pos)
+                valid = ok
+                if flat.valid is not None:
+                    valid = valid & jnp.take(flat.valid, pos)
+                if d.valid is not None:
+                    valid = valid & d.valid
+                if v is not None:
+                    valid = valid & v
+                if kv is not None:
+                    valid = valid & kv
+                if out_t.is_string:
+                    return Column(out_t, data, valid, flat.dictionary), None
+                return data, valid
+
+            return Bound(out_t, asfn)
+
+        assert name == "map_subscript"
+
+        def msfn(cols, valids, a=a, k=k, out_t=out_t):
+            d, v = a.fn(cols, valids)
+            lengths = d.data.astype(jnp.int32)
+            starts = d.starts
+            fk, fv = d.flat_keys, d.flat_values
+            F = fk.data.shape[0]
+            kd, kv = k.fn(cols, valids)
+            kdict = k.dictionary
+            if isinstance(kd, Column):
+                # whole-Column key (e.g. a row_field string): its
+                # RUNTIME dictionary is static pytree aux at trace time
+                if kd.dictionary is not None:
+                    kdict = kd.dictionary
+                if kv is None:
+                    kv = kd.valid
+                kd = kd.data
+            if fk.dictionary is not None and k.is_const:
+                # constant string key: encode through the flat-key
+                # dictionary (static pytree aux — folds at trace time)
+                code = fk.dictionary._index.get(k.const_value, -1)
+                target = jnp.full(lengths.shape, code, jnp.int32)
+            elif fk.dictionary is not None and kdict is not None:
+                # vectorized string key: remap key codes into the
+                # flat-key dictionary (both static at trace time)
+                remap = jnp.asarray(
+                    [
+                        fk.dictionary._index.get(val, -1)
+                        for val in kdict.values
+                    ],
+                    jnp.int32,
+                )
+                target = jnp.take(remap, jnp.clip(kd, 0, len(kdict) - 1))
+            elif fk.dictionary is not None:
+                # a string key whose dictionary is unknown at trace time
+                # would compare codes across DIFFERENT dictionaries —
+                # silently wrong matches; fail loudly instead
+                raise NotImplementedError(
+                    "map subscript with a computed string key (no"
+                    " plan-time dictionary) is not supported; use a"
+                    " constant key or a string column"
+                )
+            else:
+                target = kd.astype(fk.data.dtype)
+
+            def cond(state):
+                i, found, val, fvok = state
+                return i < jnp.max(lengths)
+
+            def body(state):
+                i, found, val, fvok = state
+                active = i < lengths
+                pos = jnp.clip(starts + i, 0, max(F - 1, 0))
+                key_here = jnp.take(fk.data, pos)
+                kok = (
+                    jnp.take(fk.valid, pos)
+                    if fk.valid is not None
+                    else jnp.ones_like(active)
+                )
+                hit = active & kok & (key_here == target) & ~found
+                v_here = jnp.take(fv.data, pos)
+                vok = (
+                    jnp.take(fv.valid, pos)
+                    if fv.valid is not None
+                    else jnp.ones_like(active)
+                )
+                return (
+                    i + 1,
+                    found | hit,
+                    jnp.where(hit, v_here, val),
+                    jnp.where(hit, vok, fvok),
+                )
+
+            n = lengths.shape[0]
+            init = (
+                jnp.int32(0),
+                jnp.zeros(n, jnp.bool_),
+                jnp.zeros(n, fv.data.dtype),
+                jnp.zeros(n, jnp.bool_),
+            )
+            _, found, val, fvok = jax.lax.while_loop(cond, body, init)
+            valid = found & fvok
+            if d.valid is not None:
+                valid = valid & d.valid
+            if v is not None:
+                valid = valid & v
+            if kv is not None:
+                valid = valid & kv
+            if out_t.is_string:
+                return Column(out_t, val, valid, fv.dictionary), None
+            return val, valid
+
+        return Bound(out_t, msfn)
 
     def _bind_dict_table_nullable(self, a: Bound, out_type, pyfn, dtype) -> Bound:
         """Like _bind_dict_table but pyfn may return None -> NULL."""

@@ -435,10 +435,15 @@ def _page_rows(page: Page) -> List[list]:
 
     from trino_tpu.block import decode_values
 
+    from trino_tpu.exec.serde import HostNested
+
     cols = []
     for t, data, valid, dvals in zip(
         page.types, page.columns, page.valids, page.dictionaries
     ):
+        if isinstance(data, HostNested):
+            cols.append(data.to_pylist())
+            continue
         ok = valid if valid is not None else np.ones(len(data), dtype=bool)
         cols.append(decode_values(t, data, ok, dvals))
     return [list(r) for r in zip(*cols)] if cols else []

@@ -6,11 +6,14 @@ HttpRemoteTask §3.2), data plane = pull-based binary page streams with
 token/ack semantics (GET /v1/task/{id}/results/{partition}/{token},
 TaskResource.java:321). JSON for control, the serde wire format for
 pages (a typed binary layout — no object deserialization on wire
-bytes). Task specs still travel as pickled fragments (the stand-in for
-Trino's JSON plan codec), which is why internal authentication gates
-EVERY endpoint when a shared secret is configured
-(TRINO_TPU_INTERNAL_SECRET; InternalAuthenticationManager analogue) —
-only authenticated engine peers may post specs.
+bytes). Task specs travel as typed, allowlist-decoded JSON
+(runtime/codec.py — the TaskUpdateRequest Jackson-codec analogue; a
+request body can only instantiate registered plan/task dataclasses,
+never arbitrary objects). Internal authentication additionally gates
+EVERY endpoint (TRINO_TPU_INTERNAL_SECRET;
+InternalAuthenticationManager analogue), and a NETWORKED worker
+refuses to start without a secret — require_secret=False is for
+single-process embedding and tests only.
 
 Endpoints served by WorkerServer:
   POST   /v1/task/{taskId}                     create/update task
@@ -24,7 +27,6 @@ Endpoints served by WorkerServer:
 from __future__ import annotations
 
 import json
-import pickle
 import struct
 import threading
 import urllib.error
@@ -33,6 +35,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional, Tuple
 
 from trino_tpu.exec.serde import Page, deserialize_page, serialize_page
+from trino_tpu.runtime import codec
 from trino_tpu.runtime.worker import Worker
 
 _U32 = struct.Struct("<I")
@@ -167,7 +170,7 @@ class _Handler(BaseHTTPRequestHandler):
                     self._json(503, {"error": "worker shutting down"})
                     return
                 ln = int(self.headers.get("Content-Length", "0"))
-                spec = pickle.loads(self.rfile.read(ln))
+                spec = codec.loads(self.rfile.read(ln))
                 task = self.worker.create_task(spec)
                 self._json(200, {"task_id": str(task.spec.task_id), "state": task.state})
                 return
@@ -207,12 +210,23 @@ class WorkerServer:
     endpoint (InternalAuthenticationManager analogue)."""
 
     def __init__(self, worker: Worker, port: int = 0,
-                 internal_secret: Optional[str] = "__env__"):
+                 internal_secret: Optional[str] = "__env__",
+                 require_secret: bool = True):
         self.worker = worker
         self.state = "active"
         self.internal_auth = None
         if internal_secret == "__env__":
             internal_secret = default_internal_secret()
+        if internal_secret is None and require_secret:
+            # a worker port without auth accepts task specs from anyone
+            # who can reach it; default-config deployments must not be
+            # open. Single-process embeddings/tests opt out explicitly.
+            raise RuntimeError(
+                "refusing to start a networked worker without an internal "
+                "secret: set TRINO_TPU_INTERNAL_SECRET (or pass "
+                "internal_secret=...), or pass require_secret=False for "
+                "single-process embedding"
+            )
         if internal_secret is not None:
             from trino_tpu.security import InternalAuthenticator
 
@@ -259,7 +273,7 @@ class HttpWorkerClient:
         return urllib.request.urlopen(req, timeout=self.timeout)
 
     def create_task(self, spec) -> str:
-        body = pickle.dumps(spec, protocol=5)
+        body = codec.dumps(spec)
         with self._req("POST", f"/v1/task/{spec.task_id}", body) as r:
             out = json.loads(r.read())
         if "error" in out:

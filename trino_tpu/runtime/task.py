@@ -70,7 +70,7 @@ def _resolve_fetch(location):
     """An input location is either a direct fetch callable (in-process
     topology) or a descriptor — ("http", uri, task_id) for live pull
     between processes, ("spool", base_dir, task_key) for a committed
-    FTE attempt — the wire forms a pickled TaskSpec carries."""
+    FTE attempt — the wire forms a codec-encoded TaskSpec carries."""
     if callable(location):
         return location
     kind, a, b = location

@@ -223,8 +223,30 @@ class ExprConverter:
             ch, t = self.replacements[e]
             return ir.InputRef(ch, t)
         if isinstance(e, ast.Identifier):
+            hit = self.scope.try_resolve(e.parts)
+            if hit is None and len(e.parts) >= 2:
+                # ROW field access: resolve the prefix as a row-typed
+                # column, the last part as its field (RowType dereference,
+                # spi/type/RowType field access)
+                base = self.scope.try_resolve(e.parts[:-1])
+                if base is not None and base[1].is_row:
+                    ch, rt = base
+                    fname = e.parts[-1].lower()
+                    for fi, (n, ft) in enumerate(rt.row_fields):
+                        if n is not None and n.lower() == fname:
+                            return ir.Call(
+                                "row_field",
+                                (ir.InputRef(ch, rt),
+                                 ir.Literal(fi, T.BIGINT)),
+                                ft,
+                            )
+                    raise AnalysisError(
+                        f"row type has no field {e.parts[-1]!r}"
+                    )
             ch, t = self.scope.resolve(e.parts)
             return ir.InputRef(ch, t)
+        if isinstance(e, ast.Subscript):
+            return self._convert_subscript(e)
         if isinstance(e, ast.NumberLiteral):
             return _number_literal(e.text)
         if isinstance(e, ast.StringLiteral):
@@ -366,28 +388,52 @@ class ExprConverter:
             raise AnalysisError(
                 f"aggregate function {name}() in a non-aggregate context"
             )
-        # constant-array functions fold at analysis time (arrays exist
-        # only as constants — see _plan_unnest)
+        # constant-array functions fold at analysis time; column-typed
+        # arguments vectorize over the nested layouts
         if name in ("cardinality", "element_at", "contains", "array_max",
                     "array_min", "array_join"):
             arr = (
                 _const_array_values(e.args[0]) if e.args else None
             )
             if arr is None:
-                # ARRAY-typed column reference: cardinality vectorizes
-                # over the lengths array (ArrayColumn.data IS lengths);
-                # element navigation needs flat access and goes through
-                # UNNEST instead
-                if name == "cardinality" and e.args:
+                if e.args:
                     ref = self.convert(e.args[0])
-                    if ref.type.is_array:
+                    # cardinality vectorizes over the lengths array
+                    # (ArrayColumn/MapColumn.data IS lengths)
+                    if name == "cardinality" and (
+                        ref.type.is_array or ref.type.is_map
+                    ):
                         return ir.Call("array_length", (ref,), T.BIGINT)
+                    if name == "element_at" and ref.type.is_map:
+                        key = self.convert(e.args[1])
+                        return ir.Call(
+                            "map_subscript", (ref, key), ref.type.element
+                        )
+                    if name == "element_at" and ref.type.is_array:
+                        idx = self.convert(e.args[1])
+                        return ir.Call(
+                            "array_subscript", (ref, idx), ref.type.element
+                        )
                 raise AnalysisError(
                     f"{name}() supports constant arrays"
-                    + (" and array columns" if name == "cardinality" else "")
+                    + (" and array/map columns"
+                       if name in ("cardinality", "element_at") else "")
                     + " only"
                 )
             return self._fold_array_call(name, arr, e.args[1:])
+        if name in ("map_keys", "map_values"):
+            ref = self.convert(e.args[0]) if e.args else None
+            if ref is None or not ref.type.is_map:
+                raise AnalysisError(f"{name}() requires a map argument")
+            out_t = T.array_of(
+                ref.type.key if name == "map_keys" else ref.type.element
+            )
+            return ir.Call(name, (ref,), out_t)
+        if name == "row":
+            args = tuple(self.convert(a) for a in e.args)
+            return ir.Call(
+                "row_pack", args, T.row_of(*[a.type for a in args])
+            )
         if name == "sequence":
             raise AnalysisError(
                 "sequence() is usable inside UNNEST or array functions"
@@ -530,6 +576,25 @@ class ExprConverter:
                     )
             return ir.Call(canonical, args, out_t)
         raise AnalysisError(f"unknown function {name}()")
+
+    def _convert_subscript(self, e) -> ir.Expr:
+        """a[i] / m[k] (Trino's SubscriptExpression). Missing map keys
+        and out-of-range array positions yield NULL (element_at
+        semantics; the reference raises for bare [] on missing keys —
+        documented divergence, NULL degrades instead of failing)."""
+        if isinstance(e.operand, ast.ArrayLiteral):
+            arr = _const_array_values(e.operand)
+            if arr is not None:
+                return self._fold_array_call("element_at", arr, (e.index,))
+        base = self.convert(e.operand)
+        idx = self.convert(e.index)
+        if base.type.is_map:
+            return ir.Call("map_subscript", (base, idx), base.type.element)
+        if base.type.is_array:
+            return ir.Call("array_subscript", (base, idx), base.type.element)
+        raise AnalysisError(
+            f"subscript requires an array or map operand, got {base.type}"
+        )
 
     def _fold_array_call(
         self, name: str, arr: List[ir.Literal], rest: tuple
@@ -733,6 +798,12 @@ def resolve_type(t: ast.TypeName) -> T.DataType:
         return T.decimal(min(p, 18), s)
     if t.name in ("varchar", "char"):
         return T.VARCHAR
+    if t.name == "array":
+        return T.array_of(resolve_type(t.args[0][1]))
+    if t.name == "map":
+        return T.map_of(resolve_type(t.args[0][1]), resolve_type(t.args[1][1]))
+    if t.name == "row":
+        return T.row_of(*[(n, resolve_type(st)) for n, st in t.args])
     raise AnalysisError(f"unsupported type {t.name}")
 
 
@@ -2924,29 +2995,29 @@ def _pattern_var_names(node) -> Set[str]:
 
 
 def _validate_array_usage(node: P.PlanNode) -> None:
-    """ARRAY columns have no value-wise ordering/hash operators (the
-    physical per-row value is the LENGTH — block.py ArrayColumn), so
-    using them as grouping/sort/join/partition keys would silently
-    collapse distinct arrays of equal length. Reject at analysis time
-    (the reference's ArrayType has real operators; until this engine's
-    do, fail loudly)."""
+    """Nested columns (ARRAY/MAP/ROW) have no value-wise ordering/hash
+    operators (the physical per-row value is the LENGTH for array/map
+    and a constant presence byte for row — block.py), so using them as
+    grouping/sort/join/partition keys would silently collapse distinct
+    values. Reject at analysis time (the reference's ArrayType/MapType/
+    RowType have real operators; until this engine's do, fail loudly)."""
 
     def bad(where: str):
         raise AnalysisError(
-            f"ARRAY values cannot be used as {where} (use UNNEST or"
-            " cardinality to operate on array contents)"
+            f"ARRAY/MAP/ROW values cannot be used as {where} (use UNNEST,"
+            " subscripts or cardinality to operate on nested contents)"
         )
 
     def check(child: P.PlanNode, channels, where: str):
         for ch in channels:
-            if child.fields[ch].type.is_array:
+            if child.fields[ch].type.is_nested:
                 bad(where)
 
     if isinstance(node, P.AggregateNode):
         check(node.child, node.group_channels, "grouping keys")
         for a in node.aggs:
             for ch in (a.arg_channel, a.arg2_channel):
-                if ch is not None and node.child.fields[ch].type.is_array:
+                if ch is not None and node.child.fields[ch].type.is_nested:
                     bad("aggregate arguments")
     elif isinstance(node, P.JoinNode):
         check(node.left, node.left_keys, "join keys")

@@ -184,6 +184,9 @@ class Extract(Expression):
 class TypeName(Node):
     name: str
     params: Tuple[int, ...] = ()
+    # nested type arguments: ((field_name | None, TypeName), ...) for
+    # array(T) / map(K, V) / row(name T, ...)
+    args: Tuple = ()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -319,6 +322,14 @@ class ArrayLiteral(Expression):
     """ARRAY[e1, e2, ...]."""
 
     elements: Tuple[Expression, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class Subscript(Expression):
+    """Postfix element access: a[i] (array) / m[k] (map)."""
+
+    operand: Expression
+    index: Expression
 
 
 @dataclasses.dataclass(frozen=True)

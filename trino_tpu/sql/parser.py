@@ -103,6 +103,7 @@ _HARD_RESERVED = {
 _TYPE_NAMES = {
     "BOOLEAN", "TINYINT", "SMALLINT", "INT", "INTEGER", "BIGINT", "REAL",
     "DOUBLE", "DECIMAL", "NUMERIC", "VARCHAR", "CHAR", "DATE", "TIMESTAMP",
+    "ARRAY", "MAP", "ROW",
 }
 
 
@@ -879,7 +880,14 @@ class Parser:
             return ast.UnaryOp("negate", self._parse_unary())
         if self.accept_op("+"):
             return self._parse_unary()
-        return self._parse_primary()
+        e = self._parse_primary()
+        # postfix subscript: array/map element access a[i] / m[k]
+        while self.at_op("["):
+            self.next()
+            idx = self.parse_expr()
+            self.expect_op("]")
+            e = ast.Subscript(e, idx)
+        return e
 
     def _parse_primary(self) -> ast.Expression:
         t = self.peek()
@@ -1062,6 +1070,39 @@ class Parser:
             name = "integer"
         if name == "numeric":
             name = "decimal"
+        if name == "array":
+            # array(T) or array<T>
+            close = ">" if self.accept_op("<") else ")"
+            if close == ")":
+                self.expect_op("(")
+            elem = self._parse_type()
+            self.expect_op(close)
+            return ast.TypeName("array", (), ((None, elem),))
+        if name == "map":
+            close = ">" if self.accept_op("<") else ")"
+            if close == ")":
+                self.expect_op("(")
+            k = self._parse_type()
+            self.expect_op(",")
+            v = self._parse_type()
+            self.expect_op(close)
+            return ast.TypeName("map", (), ((None, k), (None, v)))
+        if name == "row":
+            self.expect_op("(")
+            fields = []
+            while True:
+                # "name type" or bare "type" (anonymous field)
+                fname = None
+                if (
+                    self.peek().kind == "ident"
+                    and self.peek(1).kind == "ident"
+                ):
+                    fname = self._parse_name()
+                fields.append((fname, self._parse_type()))
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+            return ast.TypeName("row", (), tuple(fields))
         params: Tuple[int, ...] = ()
         if name == "double" and self.at_kw("PRECISION"):
             self.next()

@@ -39,6 +39,8 @@ class TypeKind(enum.Enum):
     INTERVAL_DAY = "interval day to second"
     INTERVAL_YEAR = "interval year to month"
     ARRAY = "array"
+    MAP = "map"
+    ROW = "row"
     UNKNOWN = "unknown"  # type of NULL literal
 
 
@@ -52,7 +54,10 @@ class DataType:
     kind: TypeKind
     precision: Optional[int] = None  # decimal precision / varchar length
     scale: Optional[int] = None  # decimal scale
-    element: Optional["DataType"] = None  # ARRAY element type
+    element: Optional["DataType"] = None  # ARRAY element / MAP value type
+    key: Optional["DataType"] = None  # MAP key type
+    # ROW fields: ((name, type), ...); names may be None (anonymous)
+    row_fields: Optional[Tuple[Tuple[Optional[str], "DataType"], ...]] = None
 
     # ---- classification -------------------------------------------------
     @property
@@ -118,19 +123,43 @@ class DataType:
             return np.dtype(np.int32)  # dictionary codes
         if k == TypeKind.UNKNOWN:
             return np.dtype(np.int8)
-        if k == TypeKind.ARRAY:
-            # the per-row physical value is the array LENGTH; element
-            # data lives in the flattened child column (ArrayColumn)
+        if k in (TypeKind.ARRAY, TypeKind.MAP):
+            # the per-row physical value is the LENGTH (cardinality);
+            # element/entry data lives in flattened child columns
+            # (ArrayColumn / MapColumn)
             return np.dtype(np.int32)
+        if k == TypeKind.ROW:
+            # per-row physical value is a presence byte; fields live in
+            # parallel child columns (RowColumn)
+            return np.dtype(np.int8)
         raise ValueError(f"no physical dtype for {self}")
 
     @property
     def is_array(self) -> bool:
         return self.kind == TypeKind.ARRAY
 
+    @property
+    def is_map(self) -> bool:
+        return self.kind == TypeKind.MAP
+
+    @property
+    def is_row(self) -> bool:
+        return self.kind == TypeKind.ROW
+
+    @property
+    def is_nested(self) -> bool:
+        return self.kind in (TypeKind.ARRAY, TypeKind.MAP, TypeKind.ROW)
+
     def __str__(self) -> str:
         if self.kind == TypeKind.ARRAY:
             return f"array({self.element})"
+        if self.kind == TypeKind.MAP:
+            return f"map({self.key}, {self.element})"
+        if self.kind == TypeKind.ROW:
+            parts = [
+                (f"{n} {t}" if n else str(t)) for n, t in self.row_fields
+            ]
+            return f"row({', '.join(parts)})"
         if self.kind == TypeKind.DECIMAL:
             return f"decimal({self.precision},{self.scale})"
         if self.kind == TypeKind.VARCHAR and self.precision is not None:
@@ -170,6 +199,25 @@ def varchar(length: Optional[int] = None) -> DataType:
 
 def array_of(element: DataType) -> DataType:
     return DataType(TypeKind.ARRAY, element=element)
+
+
+def map_of(key: DataType, value: DataType) -> DataType:
+    """MAP(key, value) — spi/type/MapType analogue. Physical layout:
+    per-row entry counts + two flattened child columns (block.MapColumn)."""
+    return DataType(TypeKind.MAP, key=key, element=value)
+
+
+def row_of(*fields) -> DataType:
+    """ROW(name type, ...) — spi/type/RowType analogue. Accepts
+    (name, type) pairs or bare types (anonymous fields)."""
+    out = []
+    for f in fields:
+        if isinstance(f, DataType):
+            out.append((None, f))
+        else:
+            n, t = f
+            out.append((n, t))
+    return DataType(TypeKind.ROW, row_fields=tuple(out))
 
 
 def char(length: int) -> DataType:
