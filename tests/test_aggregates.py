@@ -118,14 +118,17 @@ def test_null_and_small_n_semantics(runner):
 
 
 def test_approx_distinct(runner):
+    # r3: approx_distinct is a real mergeable HLL sketch (2048 registers,
+    # 2.3% standard error) rather than the old exact holistic gather —
+    # assert within 3 sigma of truth, like the reference's tests
     got = _one(runner, "SELECT approx_distinct(l_suppkey) FROM lineitem")
-    assert got == 100
+    assert abs(got - 100) <= 7
     got = _one(
         runner,
         "SELECT approx_distinct(o_custkey) FROM orders",
     )
     exact = _one(runner, "SELECT count(DISTINCT o_custkey) FROM orders")
-    assert got == exact
+    assert abs(got - exact) / exact < 0.07
 
 
 def test_grouped_composite(runner):
@@ -295,7 +298,10 @@ class TestHolisticAggregates:
         for status, got in rows:
             xs = np.sort(np.array(groups[status]))
             want = float(xs[int(np.floor(0.5 * (len(xs) - 1) + 0.5))])
-            assert got == pytest.approx(want), status
+            # r3: approx_percentile is a mergeable quantile-bucket sketch
+            # (<= 1.6% relative bucket width, sql/optimizer
+            # RewriteApproxPercentile) — assert the documented bound
+            assert got == pytest.approx(want, rel=0.016), status
 
     def test_mixed_with_regular_aggregates(self, runner):
         rows = runner.execute(

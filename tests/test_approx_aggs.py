@@ -1,0 +1,166 @@
+"""Mergeable approx_distinct / approx_percentile (VERDICT r2 missing #1).
+
+The optimizer rewrites both onto plain mergeable aggregations
+(sql/optimizer.RewriteApproxDistinct / RewriteApproxPercentile) that
+ride the existing partial->final wire, spill, and mesh paths — no raw
+rows are gathered. Reference parity:
+operator/aggregation/ApproximateCountDistinctAggregations.java (HLL
+state) and ApproximateDoublePercentileAggregations.java (qdigest).
+
+Documented error bounds: approx_distinct 2048 HLL registers, standard
+error 1.04/sqrt(2048) = 2.3% (tests allow 3 sigma); approx_percentile
+quantile buckets of <= 1.6% relative width (sign+exp+6 mantissa bits),
+exact for single-valued buckets.
+"""
+
+import numpy as np
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.connectors.memory import create_memory_connector
+from trino_tpu.connectors.spi import ColumnMetadata
+from trino_tpu.engine import LocalQueryRunner, Session
+
+
+def _load(mem, n=40000, seed=11):
+    rng = np.random.default_rng(seed)
+    k = rng.integers(0, 4, n).astype(np.int64)
+    x = rng.integers(0, 2500, n).astype(np.int64)
+    y = rng.normal(50.0, 10.0, n)
+    xv = rng.random(n) >= 0.03  # a few NULLs
+    mem.load_table(
+        "default", "d",
+        [
+            ColumnMetadata("k", T.BIGINT),
+            ColumnMetadata("x", T.BIGINT),
+            ColumnMetadata("y", T.DOUBLE),
+        ],
+        [k, x, y],
+        [None, xv, None],
+        [None, None, None],
+    )
+    return k, x, y, xv
+
+
+@pytest.fixture(scope="module")
+def data_runner():
+    mem = create_memory_connector()
+    truth = _load(mem)
+    r = LocalQueryRunner(Session(catalog="memory", schema="default"))
+    r.register_catalog("memory", mem)
+    return r, truth
+
+
+def test_approx_distinct_grouped_accuracy(data_runner):
+    r, (k, x, _, xv) = data_runner
+    res = r.execute(
+        "select k, approx_distinct(x) from d group by k order by k"
+    )
+    for kk, est in res.rows:
+        t = len(set(x[(k == kk) & xv]))
+        assert abs(est - t) / t < 0.07, (kk, est, t)  # 3 sigma
+
+
+def test_approx_distinct_mixed_and_global(data_runner):
+    r, (k, x, y, xv) = data_runner
+    res = r.execute(
+        "select k, approx_distinct(x), count(x), sum(x), min(x), avg(y)"
+        " from d group by k order by k"
+    )
+    for kk, est, cnt, s, mn, avg in res.rows:
+        sel = k == kk
+        assert cnt == int((sel & xv).sum())
+        assert s == int(x[sel & xv].sum())
+        assert mn == int(x[sel & xv].min())
+        assert abs(avg - float(y[sel].mean())) < 1e-9
+    g = r.execute("select approx_distinct(x) from d").rows[0][0]
+    t = len(set(x[xv]))
+    assert abs(g - t) / t < 0.07
+    assert r.execute("select approx_distinct(x) from d where k > 9").rows \
+        == [[0]]
+
+
+def test_approx_distinct_all_null_group():
+    mem = create_memory_connector()
+    mem.load_table(
+        "default", "nulls",
+        [ColumnMetadata("k", T.BIGINT), ColumnMetadata("x", T.BIGINT)],
+        [np.asarray([1, 1, 2], dtype=np.int64),
+         np.asarray([5, 6, 0], dtype=np.int64)],
+        [None, np.asarray([True, True, False])],
+        [None, None],
+    )
+    r = LocalQueryRunner(Session(catalog="memory", schema="default"))
+    r.register_catalog("memory", mem)
+    res = r.execute(
+        "select k, approx_distinct(x) from nulls group by k order by k"
+    )
+    assert res.rows == [[1, 2], [2, 0]]  # all-NULL group stays, counts 0
+
+
+def test_approx_percentile_accuracy(data_runner):
+    r, (k, _, y, _) = data_runner
+    res = r.execute(
+        "select k, approx_percentile(y, 0.5), approx_percentile(y, 0.9),"
+        " count(*) from d group by k order by k"
+    )
+    for kk, p50, p90, cnt in res.rows:
+        sel = y[k == kk]
+        assert cnt == len(sel)
+        assert abs(p50 - np.median(sel)) / abs(np.median(sel)) < 0.02
+        t90 = np.percentile(sel, 90)
+        assert abs(p90 - t90) / abs(t90) < 0.02
+
+
+def test_approx_aggs_are_mergeable_plans(data_runner):
+    """The rewrite must eliminate the holistic single-step gather: the
+    EXPLAIN'd plan contains two aggregation levels and the hll/pctl
+    finishers, not an approx_distinct/approx_percentile holistic agg."""
+    r, _ = data_runner
+    plan = r.execute(
+        "EXPLAIN select k, approx_distinct(x) from d group by k"
+    ).rows[0][0]
+    assert "approx_distinct" not in plan
+    assert "hll_estimate" in plan
+    plan2 = r.execute(
+        "EXPLAIN select k, approx_percentile(y, 0.5) from d group by k"
+    ).rows[0][0]
+    assert "pctl_merge" in plan2
+
+
+def test_approx_aggs_distributed_two_workers():
+    """2-worker distributed run at inputs > one batch: states merge
+    through the partial->final wire (the VERDICT done criterion)."""
+    from trino_tpu.runtime import DistributedQueryRunner
+
+    mem = create_memory_connector()
+    k, x, y, xv = _load(mem, n=50000, seed=23)
+    r = DistributedQueryRunner(
+        Session(catalog="memory", schema="default", batch_rows=1 << 13),
+        n_workers=2, hash_partitions=2,
+    )
+    r.register_catalog("memory", mem)
+    res = r.execute(
+        "select k, approx_distinct(x), approx_percentile(y, 0.5)"
+        " from d group by k order by k"
+    )
+    assert len(res.rows) == 4
+    for kk, est, p50 in res.rows:
+        t = len(set(x[(k == kk) & xv]))
+        assert abs(est - t) / t < 0.07, (kk, est, t)
+        med = float(np.median(y[k == kk]))
+        assert abs(p50 - med) / abs(med) < 0.02
+
+
+def test_approx_distinct_on_strings():
+    mem = create_memory_connector()
+    words = [f"w{i % 700}" for i in range(5000)]
+    mem.load_table(
+        "default", "s",
+        [ColumnMetadata("w", T.VARCHAR)],
+        [words], None, [None],
+    )
+    r = LocalQueryRunner(Session(catalog="memory", schema="default"))
+    r.register_catalog("memory", mem)
+    est = r.execute("select approx_distinct(w) from s").rows[0][0]
+    assert abs(est - 700) / 700 < 0.07

@@ -1,0 +1,166 @@
+"""r3 function-breadth families (VERDICT r2 missing #6): bitwise, math
+remainder, datetime, JSON, string remainder — each oracle-checked
+against python/known values. Reference: BitwiseFunctions.java,
+MathFunctions.java, DateTimeFunctions.java, JsonFunctions.java,
+StringFunctions.java."""
+
+import math
+
+import pytest
+
+from trino_tpu.connectors.memory import create_memory_connector
+from trino_tpu.engine import LocalQueryRunner, Session
+
+
+@pytest.fixture(scope="module")
+def runner():
+    r = LocalQueryRunner(Session(catalog="memory", schema="default"))
+    r.register_catalog("memory", create_memory_connector())
+    return r
+
+
+def _one(runner, sql):
+    return runner.execute(sql).rows[0]
+
+
+def test_bitwise_family(runner):
+    assert _one(runner, "select bitwise_and(19,25), bitwise_or(19,25),"
+                " bitwise_xor(19,25), bitwise_not(19)") == [17, 27, 10, -20]
+    assert _one(runner, "select bitwise_left_shift(1,3),"
+                " bitwise_right_shift_arithmetic(-8,1)") == [8, -4]
+    # logical right shift is zero-filling on the 64-bit pattern
+    assert _one(runner, "select bitwise_right_shift(-8,1)") == [
+        (-8 % (1 << 64)) >> 1
+    ]
+    assert _one(runner, "select bit_count(9), bit_count(-7, 64),"
+                " bit_count(-7, 8)") == [2, 62, 6]
+
+
+def test_math_remainder(runner):
+    pi, e_, cot1 = _one(
+        runner, "select pi(), e(), round(cot(1.0), 6)"
+    )
+    assert pi == pytest.approx(math.pi)
+    assert e_ == pytest.approx(math.e)
+    assert cot1 == pytest.approx(round(1 / math.tan(1.0), 6))
+    assert _one(runner, "select is_nan(nan()), is_infinite(infinity())") \
+        == [True, True]
+    assert _one(
+        runner,
+        "select width_bucket(3.14, 0.0, 4.0, 3),"
+        " width_bucket(-1.0, 0.0, 4.0, 3), width_bucket(9.9, 0.0, 4.0, 3)",
+    ) == [3, 0, 4]
+    cdf, inv = _one(
+        runner,
+        "select round(normal_cdf(0.0, 1.0, 1.96), 3),"
+        " round(inverse_normal_cdf(0.0, 1.0, 0.975), 2)",
+    )
+    assert cdf == pytest.approx(0.975)
+    assert inv == pytest.approx(1.96)
+
+
+def test_datetime_breadth(runner):
+    ts = "date_parse('2024-03-05 10:30:45', '%Y-%m-%d %H:%i:%s')"
+    assert _one(
+        runner,
+        f"select hour({ts}), minute({ts}), second({ts}), year({ts})",
+    ) == [10, 30, 45, 2024]
+    assert _one(runner, "select hour(from_unixtime(3700)),"
+                " minute(from_unixtime(3700))") == [1, 1]
+    assert _one(runner, "select to_unixtime(from_unixtime(12.5))") == [12.5]
+    # invalid text parses to NULL, not an error
+    assert _one(
+        runner, "select date_parse('nope', '%Y-%m-%d')"
+    ) == [None]
+
+
+def test_json_breadth(runner):
+    runner.execute("create table jdoc (d varchar)")
+    runner.execute(
+        """insert into jdoc values ('{"a": [1, 2, {"b": 7}]}'),"""
+        """ ('[1,2,3]'), ('"x"'), ('nope')"""
+    )
+    rows = runner.execute(
+        "select json_extract(d, '$.a[2]'), is_json_scalar(d),"
+        " json_array_contains(d, 2), json_array_get(d, 1),"
+        " json_parse(d) from jdoc"
+    ).rows
+    assert rows == [
+        ['{"b":7}', False, None, None, '{"a":[1,2,{"b":7}]}'],
+        [None, False, True, "2", "[1,2,3]"],
+        [None, True, None, None, '"x"'],
+        [None, None, None, None, None],
+    ]
+
+
+def test_string_remainder(runner):
+    runner.execute("create table sw (w varchar)")
+    runner.execute("insert into sw values ('Robert'), ('Tymczak')")
+    rows = runner.execute(
+        "select soundex(w), regexp_position(w, 'm'), normalize(w) from sw"
+    ).rows
+    assert rows == [
+        ["R163", -1, "Robert"],
+        ["T522", 3, "Tymczak"],
+    ]
+
+
+def test_show_functions_breadth(runner):
+    rows = runner.execute("SHOW FUNCTIONS").rows
+    names = {r[0] for r in rows}
+    for want in ("bitwise_and", "width_bucket", "json_extract",
+                 "normal_cdf", "soundex", "from_unixtime", "bit_count"):
+        assert want in names, want
+    assert len(rows) >= 180, len(rows)
+    assert "asinh" in names
+
+
+# --- FULL OUTER JOIN (engine-wide; previously raised at analysis) ---
+
+
+def test_full_outer_join(runner):
+    runner.execute("create table fa (x bigint, p varchar)")
+    runner.execute("insert into fa values (1,'a1'), (2,'a2'), (3,'a3')")
+    runner.execute("create table fb (y bigint, q varchar)")
+    runner.execute("insert into fb values (2,'b2'), (3,'b3'), (4,'b4')")
+    rows = runner.execute(
+        "select x, p, y, q from fa full outer join fb on x = y"
+    ).rows
+    key = lambda t: (t[0] is None, t[0] or 0, t[2] or 0)
+    assert sorted(rows, key=key) == [
+        [1, "a1", None, None],
+        [2, "a2", 2, "b2"],
+        [3, "a3", 3, "b3"],
+        [None, None, 4, "b4"],
+    ]
+    # SELECT * follows declared order for RIGHT joins too
+    assert runner.execute(
+        "select * from fa right join fb on x = y order by y"
+    ).rows == [
+        [2, "a2", 2, "b2"],
+        [3, "a3", 3, "b3"],
+        [None, None, 4, "b4"],
+    ]
+
+
+def test_full_join_distributed_and_mesh():
+    from trino_tpu.parallel import mesh_plan
+    from trino_tpu.runtime import DistributedQueryRunner
+
+    r = DistributedQueryRunner(
+        Session(catalog="memory", schema="default"), n_workers=2,
+        hash_partitions=2,
+    )
+    r.register_catalog("memory", create_memory_connector())
+    r.execute("create table fa (x bigint)")
+    r.execute("insert into fa values (1), (2), (3)")
+    r.execute("create table fb (y bigint)")
+    r.execute("insert into fb values (2), (3), (4)")
+    before = mesh_plan.MESH_COUNTERS["queries"]
+    res = r.execute("select x, y from fa full join fb on x = y")
+    assert res.data_plane == "mesh"
+    assert mesh_plan.MESH_COUNTERS["queries"] == before + 1
+    key = lambda t: (t[0] is None, t[0] or 0, t[1] or 0)
+    assert sorted(res.rows, key=key) == [
+        [1, None], [2, 2], [3, 3], [None, 4],
+    ]

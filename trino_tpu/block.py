@@ -51,6 +51,31 @@ def ones_mask(n: int) -> jnp.ndarray:
     return a
 
 
+def null_column(t, capacity: int, dictionary=None):
+    """All-NULL column of any type at a given capacity — outer-join
+    padding (the null-RowBlock the reference builds in LookupOuter
+    paths). Nested types get structurally-valid empty layouts, not flat
+    zero arrays masquerading as lengths."""
+    invalid = jnp.zeros(capacity, dtype=jnp.bool_)
+    if t.is_array:
+        return ArrayColumn(
+            t, jnp.zeros(capacity, jnp.int32), invalid, None,
+            jnp.zeros(capacity, jnp.int32), null_column(t.element, 16),
+        )
+    if t.is_map:
+        return MapColumn(
+            t, jnp.zeros(capacity, jnp.int32), invalid, None,
+            jnp.zeros(capacity, jnp.int32),
+            null_column(t.key, 16), null_column(t.element, 16),
+        )
+    if t.is_row:
+        return RowColumn(
+            t, jnp.zeros(capacity, jnp.int8), invalid, None,
+            [null_column(ft, capacity) for _, ft in t.row_fields],
+        )
+    return Column(t, jnp.zeros(capacity, dtype=t.dtype), invalid, dictionary)
+
+
 def bucket_capacity(n: int) -> int:
     """Static-shape discipline: round row counts up to a power of two so
     the set of compiled kernel shapes stays small (the analogue of
@@ -688,6 +713,65 @@ def unify_column_dicts(cols: Sequence[Column]) -> list:
     return out
 
 
+def _concat_valid(parts):
+    if any(p.valid is not None for p in parts):
+        return jnp.concatenate(
+            [
+                p.valid
+                if p.valid is not None
+                else jnp.ones(p.data.shape[0], dtype=jnp.bool_)
+                for p in parts
+            ]
+        )
+    return None
+
+
+def _concat_columns(parts: list):
+    """Concatenate column fragments of one schema slot, preserving
+    NESTED layouts: array/map flats concatenate with starts rebased by
+    the preceding flats' capacities; row children concatenate
+    recursively. (A plain data-concat would splice per-row LENGTHS and
+    drop the element stores.)"""
+    first = parts[0]
+    if isinstance(first, (ArrayColumn, MapColumn)):
+        data = jnp.concatenate([p.data for p in parts])
+        valid = _concat_valid(parts)
+        starts = []
+        off = 0
+        flats1 = []
+        flats2 = []
+        for p in parts:
+            starts.append(p.starts + off)
+            if isinstance(p, ArrayColumn):
+                off += p.flat.capacity
+                flats1.append(p.flat)
+            else:
+                off += p.flat_keys.capacity
+                flats1.append(p.flat_keys)
+                flats2.append(p.flat_values)
+        starts = jnp.concatenate(starts)
+        if isinstance(first, ArrayColumn):
+            return ArrayColumn(
+                first.type, data, valid, None, starts,
+                _concat_columns(flats1),
+            )
+        return MapColumn(
+            first.type, data, valid, None, starts,
+            _concat_columns(flats1), _concat_columns(flats2),
+        )
+    if isinstance(first, RowColumn):
+        data = jnp.concatenate([p.data for p in parts])
+        valid = _concat_valid(parts)
+        kids = [
+            _concat_columns([p.children[i] for p in parts])
+            for i in range(len(first.children))
+        ]
+        return RowColumn(first.type, data, valid, None, kids)
+    parts = unify_column_dicts(parts)
+    data = jnp.concatenate([p.data for p in parts])
+    return Column(parts[0].type, data, _concat_valid(parts), parts[0].dictionary)
+
+
 def concat_batches(batches: Sequence["RelBatch"]) -> "RelBatch":
     """Concatenate batches (PagesIndex-style consolidation —
     main/operator/PagesIndex.java:80 addPage). Output capacity is the sum
@@ -696,22 +780,10 @@ def concat_batches(batches: Sequence["RelBatch"]) -> "RelBatch":
     if len(batches) == 1:
         return batches[0]
     width = batches[0].width
-    cols = []
-    for i in range(width):
-        parts = unify_column_dicts([b.columns[i] for b in batches])
-        data = jnp.concatenate([p.data for p in parts])
-        if any(p.valid is not None for p in parts):
-            valid = jnp.concatenate(
-                [
-                    p.valid
-                    if p.valid is not None
-                    else jnp.ones(p.data.shape[0], dtype=jnp.bool_)
-                    for p in parts
-                ]
-            )
-        else:
-            valid = None
-        cols.append(Column(parts[0].type, data, valid, parts[0].dictionary))
+    cols = [
+        _concat_columns([b.columns[i] for b in batches])
+        for i in range(width)
+    ]
     live = jnp.concatenate([b.live_mask() for b in batches])
     return RelBatch(cols, live)
 

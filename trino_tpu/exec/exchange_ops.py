@@ -52,23 +52,38 @@ def _partition_ids(keys, valids, luts, live, n: int, has_lut: tuple):
 def split_page(page: Page, pid: np.ndarray, n: int) -> List[Page]:
     """Split a compacted wire page by per-row partition id: ONE native
     scatter pass over all partitions (PagePartitioner's per-partition
-    appenders collapsed; trino_tpu/native)."""
+    appenders collapsed; trino_tpu/native). Nested columns (HostNested)
+    partition by per-partition row-index gather — their flattened
+    children follow the selected rows' slices."""
     from trino_tpu import native
+    from trino_tpu.exec.serde import HostNested, slice_host_nested
 
+    nested_idx = [
+        i for i, c in enumerate(page.columns) if isinstance(c, HostNested)
+    ]
     flat: List[np.ndarray] = []
     valid_pos: List[int] = []
-    for c in page.columns:
-        flat.append(c)
+    for i, c in enumerate(page.columns):
+        if i in nested_idx:
+            # placeholder keeps column positions aligned in `parts`
+            flat.append(np.zeros(len(pid), dtype=np.int8))
+        else:
+            flat.append(c)
     for v in page.valids:
         if v is not None:
             valid_pos.append(len(flat))
             flat.append(v)
     parts = native.partition_scatter(flat, pid, n)
     counts = np.bincount(pid[pid >= 0], minlength=n)
+    nested_rows = (
+        {p: np.nonzero(pid == p)[0] for p in range(n)} if nested_idx else {}
+    )
     width = page.width
     out = []
     for p in range(n):
-        cols = parts[p][:width]
+        cols = list(parts[p][:width])
+        for i in nested_idx:
+            cols[i] = slice_host_nested(page.columns[i], nested_rows[p])
         valids: List = []
         vi = width
         for v in page.valids:

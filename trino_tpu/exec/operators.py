@@ -706,10 +706,16 @@ class AggSpec:
     arg2_channel: Optional[int] = None
     percentile: Optional[float] = None
     separator: Optional[str] = None  # listagg
+    arg3_channel: Optional[int] = None  # pctl_merge bucket-max channel
 
 
+# pctl_merge is the bounded MERGE half of the mergeable approx_percentile
+# (sql/optimizer.RewriteApproxPercentile): it buffers quantile-bucket
+# summaries, never raw rows. approx_distinct / approx_percentile appear
+# here only as the enable_optimizer=False fallback.
 HOLISTIC_KINDS = (
-    "min_by", "max_by", "approx_percentile", "listagg", "approx_distinct"
+    "min_by", "max_by", "approx_percentile", "listagg", "approx_distinct",
+    "pctl_merge",
 )
 
 
@@ -1424,6 +1430,14 @@ class HashAggregationOperator(Operator):
                 )
                 agg_cols[i] = Column(T.BIGINT, cnts_d, None, None)
                 continue
+            elif a.kind == "pctl_merge":
+                ccol = mega.columns[a.arg2_channel]
+                mxcol = mega.columns[a.arg3_channel]
+                data, valid = G.grouped_weighted_percentile(
+                    tuple(keys), tuple(valids), live,
+                    xcol.data, xcol.valid, ccol.data, mxcol.data,
+                    a.percentile, cap,
+                )
             else:  # approx_percentile
                 data, valid = G.grouped_percentile(
                     tuple(keys), tuple(valids), live,
@@ -1859,18 +1873,29 @@ def _segment_any(counts, pi, ok, probe_capacity):
 
 @jax.jit
 def _left_unmatched(probe: RelBatch, build: RelBatch, matched):
-    """Unmatched probe rows with NULL build columns (LEFT outer arm)."""
+    """Unmatched probe rows with NULL build columns (LEFT outer arm).
+    null_column keeps nested build columns structurally valid."""
+    from trino_tpu.block import null_column
+
     nulls = [
-        Column(
-            c.type,
-            jnp.zeros(probe.capacity, dtype=c.data.dtype),
-            jnp.zeros(probe.capacity, dtype=jnp.bool_),
-            c.dictionary,
-        )
+        null_column(c.type, probe.capacity, c.dictionary)
         for c in build.columns
     ]
     return RelBatch(
         list(probe.columns) + nulls, probe.live_mask() & ~matched
+    )
+
+
+def _right_unmatched(probe_schema, build: RelBatch, matched_b):
+    """Unmatched BUILD rows with NULL probe columns (the RIGHT/FULL
+    outer arm — join/LookupOuterOperator.java analogue)."""
+    from trino_tpu.block import null_column
+
+    nulls = [
+        null_column(t, build.capacity, d) for t, d in probe_schema
+    ]
+    return RelBatch(
+        nulls + list(build.columns), build.live_mask() & ~matched_b
     )
 
 
@@ -1927,6 +1952,9 @@ class LookupJoinOperator(Operator):
         # grace mode: probe rows hash-partition to disk alongside the
         # spilled build; partitions join pairwise at finish
         self._probe_spill = None
+        # FULL outer: build-side matched bitmap accumulated across probe
+        # batches; unmatched build rows emit at finish (LookupOuter)
+        self._build_matched = None
 
     def needs_input(self) -> bool:
         return not self._outputs and not self._finishing
@@ -2000,6 +2028,13 @@ class LookupJoinOperator(Operator):
             self._outputs.append(pairs)
             self._outputs.append(_left_unmatched(probe, build, matched))
             return
+        if self._type == "full":
+            self._outputs.append(pairs)
+            self._outputs.append(_left_unmatched(probe, build, matched))
+            self._build_matched = J.build_matched_flags(
+                build.capacity, bi, ok, prior=self._build_matched
+            )
+            return
         raise NotImplementedError(self._type)
 
     def finish(self) -> None:
@@ -2007,6 +2042,16 @@ class LookupJoinOperator(Operator):
             return
         self._finishing = True
         if self._bridge.grace is None:
+            if self._type == "full":
+                build = self._bridge.build_batch
+                mb = (
+                    self._build_matched
+                    if self._build_matched is not None
+                    else jnp.zeros(build.capacity, dtype=jnp.bool_)
+                )
+                self._outputs.append(
+                    _right_unmatched(self._probe_schema, build, mb)
+                )
             return
         # grace probe (PartitionedConsumption analogue): for each hash
         # partition, rebuild that slice of the build side on device and
@@ -2019,7 +2064,7 @@ class LookupJoinOperator(Operator):
                 if self._probe_spill is not None
                 else []
             )
-            if not probe_pages:
+            if not probe_pages and self._type != "full":
                 continue  # before touching the build spill: no probe rows
             build_pages = grace.partition_pages(p)
             parts = tuple(
@@ -2033,8 +2078,21 @@ class LookupJoinOperator(Operator):
                 merged.columns[c].dictionary
                 for c in self._bridge.build_key_channels
             ]
+            # full outer: matched flags are PER PARTITION (each build row
+            # lives in exactly one hash partition, so partition-local
+            # flags are complete)
+            self._build_matched = None
             for pg in probe_pages:
                 self._probe_one(ls, merged, key_dicts, pg.to_batch())
+            if self._type == "full":
+                mb = (
+                    self._build_matched
+                    if self._build_matched is not None
+                    else jnp.zeros(merged.capacity, dtype=jnp.bool_)
+                )
+                self._outputs.append(
+                    _right_unmatched(self._probe_schema, merged, mb)
+                )
         if self._probe_spill is not None:
             self._probe_spill.close()
             self._probe_spill = None

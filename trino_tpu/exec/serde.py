@@ -96,6 +96,24 @@ def _slice_ranges(starts: np.ndarray, lengths: np.ndarray) -> np.ndarray:
     )
 
 
+def slice_host_nested(hn: HostNested, idx: np.ndarray) -> HostNested:
+    """Row selection over an already-compacted HostNested (exchange
+    partitioning): keeps the selected rows and exactly their element
+    slices, recursively."""
+    data = hn.data[idx]
+    valid = hn.valid[idx] if hn.valid is not None else None
+    t = hn.type
+    if t.kind in (T.TypeKind.ARRAY, T.TypeKind.MAP):
+        starts = (np.cumsum(hn.data) - hn.data).astype(np.int64)
+        flat_idx = _slice_ranges(starts[idx], data.astype(np.int64))
+        kids = [slice_host_nested(c, flat_idx) for c in hn.children]
+        return HostNested(t, data, valid, hn.dictionary, kids)
+    if t.kind == T.TypeKind.ROW:
+        kids = [slice_host_nested(c, idx) for c in hn.children]
+        return HostNested(t, data, valid, hn.dictionary, kids)
+    return HostNested(t, data, valid, hn.dictionary, [])
+
+
 def _compact_nested(col, idx: np.ndarray) -> HostNested:
     """Device-host nested column -> HostNested keeping rows `idx`
     (recursively flattening only those rows' element slices)."""

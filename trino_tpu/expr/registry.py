@@ -234,6 +234,66 @@ for entry in [
      "ISO week-numbering year", ("yow",)),
     ("from_iso8601_date", 1, 1, lambda a: T.DATE, "date",
      "parse YYYY-MM-DD", ()),
+    # r3 breadth: JSON family (JsonFunctions.java)
+    ("json_extract", 2, 2, _VARCHAR, "varchar",
+     "JSON text of the value at a JSONPath", (), (1,)),
+    ("json_format", 1, 1, _VARCHAR, "varchar",
+     "canonical JSON text", ()),
+    ("json_parse", 1, 1, _VARCHAR, "varchar",
+     "parse and canonicalize JSON text", ()),
+    ("is_json_scalar", 1, 1, _BOOLEAN, "boolean",
+     "TRUE if the JSON document is a scalar", ()),
+    ("json_array_contains", 2, 2, _BOOLEAN, "boolean",
+     "TRUE if the JSON array contains the value", (), (1,)),
+    ("json_array_get", 2, 2, _VARCHAR, "varchar",
+     "JSON text of the array element at index", (), (1,)),
+    # r3 breadth: bitwise (BitwiseFunctions.java)
+    ("bitwise_and", 2, 2, _BIGINT, "bigint", "bitwise AND", ()),
+    ("bitwise_or", 2, 2, _BIGINT, "bigint", "bitwise OR", ()),
+    ("bitwise_xor", 2, 2, _BIGINT, "bigint", "bitwise XOR", ()),
+    ("bitwise_left_shift", 2, 2, _BIGINT, "bigint",
+     "shift left on the 64-bit pattern", ()),
+    ("bitwise_right_shift", 2, 2, _BIGINT, "bigint",
+     "logical (zero-filling) right shift", ()),
+    ("bitwise_right_shift_arithmetic", 2, 2, _BIGINT, "bigint",
+     "arithmetic (sign-extending) right shift", ()),
+    ("bit_count", 1, 2, _BIGINT, "bigint",
+     "number of set bits in the 64-bit pattern", ()),
+    # r3 breadth: math remainder (MathFunctions.java)
+    ("e", 0, 0, _DOUBLE, "double", "Euler's number", ()),
+    ("pi", 0, 0, _DOUBLE, "double", "pi", ()),
+    ("nan", 0, 0, _DOUBLE, "double", "IEEE NaN", ()),
+    ("infinity", 0, 0, _DOUBLE, "double", "IEEE +Infinity", ()),
+    ("cot", 1, 1, _DOUBLE, "double", "cotangent", ()),
+    ("normal_cdf", 3, 3, _DOUBLE, "double",
+     "normal CDF at x for (mean, sd)", ()),
+    ("inverse_normal_cdf", 3, 3, _DOUBLE, "double",
+     "normal quantile at p for (mean, sd)", ()),
+    ("width_bucket", 4, 4, _BIGINT, "bigint",
+     "equi-width histogram bucket of x over [lo, hi)", ()),
+    # r3 breadth: datetime (DateTimeFunctions.java)
+    ("hour", 1, 1, _BIGINT, "bigint", "hour of day [0,23]", ()),
+    ("minute", 1, 1, _BIGINT, "bigint", "minute of hour [0,59]", ()),
+    ("second", 1, 1, _BIGINT, "bigint", "second of minute [0,59]", ()),
+    ("millisecond", 1, 1, _BIGINT, "bigint",
+     "millisecond of second [0,999]", ()),
+    ("from_unixtime", 1, 1, lambda a: T.TIMESTAMP, "timestamp",
+     "epoch seconds -> timestamp", ()),
+    ("to_unixtime", 1, 1, _DOUBLE, "double",
+     "timestamp -> epoch seconds", ()),
+    ("date_parse", 2, 2, lambda a: T.TIMESTAMP, "timestamp",
+     "parse with MySQL-style format tokens", (), (1,)),
+    # r3 breadth: string remainder (StringFunctions.java)
+    ("soundex", 1, 1, _VARCHAR, "varchar", "American Soundex code", ()),
+    ("normalize", 1, 1, _VARCHAR, "varchar",
+     "Unicode NFC normalization", ()),
+    ("regexp_position", 2, 2, _BIGINT, "bigint",
+     "1-based position of the first regexp match (-1 = none)", (), (1,)),
+    ("asinh", 1, 1, _DOUBLE, "double", "inverse hyperbolic sine", ()),
+    ("acosh", 1, 1, _DOUBLE, "double", "inverse hyperbolic cosine", ()),
+    ("atanh", 1, 1, _DOUBLE, "double", "inverse hyperbolic tangent", ()),
+    ("expm1", 1, 1, _DOUBLE, "double", "exp(x) - 1, accurate near 0", ()),
+    ("log1p", 1, 1, _DOUBLE, "double", "ln(1 + x), accurate near 0", ()),
 ]:
     name, lo, hi, rule, ret, desc, aliases = entry[:7]
     const_args = entry[7] if len(entry) > 7 else ()

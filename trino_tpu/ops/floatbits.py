@@ -1,0 +1,115 @@
+"""TPU-safe float64 key decomposition.
+
+This TPU backend's x64-demotion pass cannot compile
+`bitcast_convert_type` involving 64-bit FLOATS (measured:
+f64->u64 and f64->u32 both fail; f32->u32, i64<->u64, and u64
+arithmetic all work, and `jnp.frexp` on f64 fails too because it
+lowers through the same bitcast). Everything that needs "the bits of a
+double" — hashing, order keys, the window min/max encodings, quantile
+buckets — therefore goes through `f64_lanes`, which decomposes a
+float64 into FOUR uint32 lanes using only f32 bitcasts and exact
+power-of-two float arithmetic:
+
+  lane1 = order-flipped bits of f32(x)        (coarse, order-preserving)
+  lane2 = sign-adjusted range bucket k         (which 2^254 window)
+  lane3 = order-flipped bits of f32(x*2^-254k) (fine, within-window)
+  lane4 = exact residual of that rescale in 2^-30 ulp(f32) quanta
+
+Properties: lexicographic (lane1..lane4) is a TOTAL ORDER of
+float64 matching SQL semantics (-0.0 == +0.0, NaN canonical and
+largest) and INJECTIVE over every normal double (subnormals are
+flushed to zero by this backend — DAZ — so treating them as zero
+matches what the engine's own comparisons do).
+
+The residual math is exact, not approximate: x - f64(f32(x)) is a
+multiple of ulp64(x) = 2^(e-52), bounded by ulp32(x)/2 = 2^(e-24), so
+dividing by ulp32 (an exact power of two obtained from f32 nextafter)
+yields a multiple of 2^-29 in [-1/2, 1/2] — scaling by 2^29 gives an
+exact integer in [-2^28, 2^28].
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_SIGN32 = jnp.uint32(0x80000000)
+
+
+def _flip32(bits: jnp.ndarray) -> jnp.ndarray:
+    """IEEE-754 bits -> unsigned order-preserving key (standard flip)."""
+    neg = (bits & _SIGN32) != 0
+    return jnp.where(neg, ~bits, bits | _SIGN32)
+
+
+def _f32_lane(x32: jnp.ndarray) -> jnp.ndarray:
+    return _flip32(jax.lax.bitcast_convert_type(x32, jnp.uint32))
+
+
+def _resid_lane(x: jnp.ndarray, a32: jnp.ndarray) -> jnp.ndarray:
+    """Exact within-f32-tie refinement for normal-range x: residual in
+    2^-30 quanta (the extra bit covers binade-boundary rounding, where
+    the residual is a multiple of HALF the regular quantum), offset to
+    unsigned."""
+    au = jnp.abs(a32)
+    ulp = (jnp.nextafter(au, jnp.float32(jnp.inf)) - au).astype(jnp.float64)
+    q = (x - a32.astype(jnp.float64)) / jnp.maximum(ulp, 1e-300)
+    return ((q * float(1 << 30)).astype(jnp.int32)
+            + jnp.int32(1 << 29)).astype(jnp.uint32)
+
+
+def f64_lanes(x: jnp.ndarray):
+    """float64 -> (lane1..lane4) uint32 tuple; see module doc.
+
+    Range handling picks a per-element EXACT power-of-two rescale
+    2^(-254k), k in [-4, 4], by direct threshold comparisons (windows of
+    width 2^254 on a 2^254 step — no gaps, no iteration), bringing every
+    nonzero normal double into the f32-normal window. k rides as its own
+    order lane (sign-adjusted: for negatives a larger magnitude is a
+    SMALLER value). Subnormal doubles are zero on this backend (DAZ —
+    its arithmetic and comparisons already treat them as 0), so the
+    zero pin is consistent with engine semantics."""
+    x = jnp.where(x == 0, jnp.float64(0.0), x)  # -0.0 == +0.0
+    nan = jnp.isnan(x)
+    zero = x == 0
+    inf = jnp.isinf(x)
+
+    m = jnp.abs(x)
+    k = jnp.zeros(x.shape, jnp.int32)
+    for j in range(1, 5):
+        k = k + (m >= jnp.float64(2.0) ** (254 * j - 126)).astype(jnp.int32)
+        k = k - (m < jnp.float64(2.0) ** (-254 * (j - 1) - 126)).astype(
+            jnp.int32
+        )
+    scales = jnp.asarray(
+        [jnp.float64(2.0) ** (-254 * kk) for kk in range(-4, 5)],
+        dtype=jnp.float64,
+    )
+    xs = x * jnp.take(scales, jnp.clip(k + 4, 0, 8))
+    a = xs.astype(jnp.float32)
+
+    lane1 = _f32_lane(x.astype(jnp.float32))
+    # sign-adjusted range bucket: ascending in VALUE
+    sb = jnp.where(x > 0, 8 + k, 8 - k).astype(jnp.uint32)
+    lane2 = sb
+    lane3 = _f32_lane(a)
+    lane4 = _resid_lane(xs, a)
+
+    for special in (zero, nan):
+        lane2 = jnp.where(special, jnp.uint32(0), lane2)
+        lane3 = jnp.where(special, jnp.uint32(0), lane3)
+        lane4 = jnp.where(special, jnp.uint32(0), lane4)
+    # +inf is the LARGEST member of its saturated-f32 class, -inf the
+    # SMALLEST of its class — pin refinement lanes to the extremes
+    hi = jnp.uint32(0xFFFFFFFF)
+    pos_inf = inf & (x > 0)
+    neg_inf = inf & (x < 0)
+    lane2 = jnp.where(pos_inf, hi, jnp.where(neg_inf, jnp.uint32(0), lane2))
+    lane3 = jnp.where(pos_inf, hi, jnp.where(neg_inf, jnp.uint32(0), lane3))
+    lane4 = jnp.where(pos_inf, hi, jnp.where(neg_inf, jnp.uint32(0), lane4))
+    return lane1, lane2, lane3, lane4
+
+
+def f32_bits_ordered(x: jnp.ndarray) -> jnp.ndarray:
+    """float32 -> order-preserving uint32 (f32 bitcasts are TPU-safe)."""
+    return _f32_lane(x)

@@ -287,6 +287,16 @@ def _key_order(keys, valids, mask, order=None, seed: int = 0):
 # flags the overflow/reseed retry)
 
 
+
+def _eq_vals(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Value equality for grouping: SQL groups NaNs together, but float
+    == is false for NaN — make NaN equal NaN (floats only; cheap no-op
+    for ints)."""
+    eq = a == b
+    if jnp.issubdtype(a.dtype, jnp.floating):
+        eq = eq | (jnp.isnan(a) & jnp.isnan(b))
+    return eq
+
 def _segment_bounds(sk, sv, sm, n, out_capacity):
     """Per-group segment geometry over key-sorted rows: boundary flags,
     compacted (starts, safe_starts, ends, used), n_groups, overflowed.
@@ -296,7 +306,7 @@ def _segment_bounds(sk, sv, sm, n, out_capacity):
     for k, v in zip(sk, sv):
         prev_k = jnp.roll(k, 1)
         prev_v = jnp.roll(v, 1)
-        eq = ((k == prev_k) & v & prev_v) | (~v & ~prev_v)
+        eq = (_eq_vals(k, prev_k) & v & prev_v) | (~v & ~prev_v)
         same = eq if same is None else (same & eq)
     if same is None:  # no keys: single segment
         same = jnp.ones(n, dtype=jnp.bool_)
@@ -715,7 +725,9 @@ def sort_group_reduce(
     if single_key:
         s_cls, s_kb = sorted_ops[0], sorted_ops[1]
         sm = s_cls < 2
-        changed = (s_cls != jnp.roll(s_cls, 1)) | (s_kb != jnp.roll(s_kb, 1))
+        changed = (s_cls != jnp.roll(s_cls, 1)) | ~_eq_vals(
+            s_kb, jnp.roll(s_kb, 1)
+        )
         boundary = sm & (first | changed)
         collision = jnp.asarray(False)
     else:
@@ -912,6 +924,78 @@ def grouped_argbest(
 
 
 @partial(jax.jit, static_argnames=("fraction", "out_capacity"))
+def grouped_weighted_percentile(
+    keys, valids, mask, mn, mn_valid, cnt, mx,
+    fraction: float, out_capacity: int,
+):
+    """Percentile over per-BUCKET summaries (count, min, max) — the
+    merge half of the mergeable approx_percentile
+    (sql/optimizer.RewriteApproxPercentile): rows are quantile-bucket
+    summaries, weights are exact element counts, and the estimate
+    interpolates between the chosen bucket's min and max. Exact when
+    the bucket holds one distinct value. Returns (data, valid) aligned
+    with sort_group_reduce's group slots."""
+    from trino_tpu.ops.sort import _order_value
+
+    n = mask.shape[0]
+    mv = jnp.ones(n, jnp.bool_) if mn_valid is None else mn_valid
+    # pre-order: bucket min ascending (bucket ids are order-preserving,
+    # so min-order == bucket order); invalid rows last
+    pre = jnp.argsort(_order_value(mn, False), stable=True).astype(jnp.int32)
+    pre = take_clip(pre, jnp.argsort(take_clip(~mv, pre), stable=True))
+    order = _key_order(
+        keys, valids, mask, order=pre, seed=_order_seed(out_capacity)
+    )
+    sm = take_clip(mask, order)
+    sk = [take_clip(k, order) for k in keys]
+    sv = [take_clip(v, order) for v in valids]
+    boundary, starts, safe_starts, ends, used, _, _ = _segment_bounds(
+        sk, sv, sm, n, out_capacity
+    )
+    w = sm & take_clip(mv, order)
+    s_mn = take_clip(mn, order)
+    s_mx = take_clip(mx, order)
+    s_c = jnp.where(w, take_clip(cnt, order).astype(jnp.int64), 0)
+    cum = jnp.cumsum(s_c)
+    cum_ex = cum - s_c
+    # per segment: total weight N, target rank R = floor(f*(N-1)+0.5)
+    N = take_clip(cum, ends) - take_clip(cum_ex, safe_starts)
+    R = jnp.clip(
+        jnp.floor(fraction * (N - 1).astype(jnp.float64) + 0.5)
+        .astype(jnp.int64),
+        0, jnp.maximum(N - 1, 0),
+    )
+    g = _seg_id(boundary)
+    base = take_clip(cum_ex, safe_starts)  # per-slot segment weight offset
+    cum_in = cum - take_clip(base, g)  # within-segment inclusive weight
+    R_row = take_clip(R, g)
+    hit = w & (cum_in > R_row)
+    pos = jax.ops.segment_min(
+        jnp.where(hit, jnp.arange(n, dtype=jnp.int32), jnp.int32(n)),
+        g, num_segments=ends.shape[0],
+    )
+    safe_pos = jnp.clip(pos, 0, max(n - 1, 0))
+    c_at = jnp.maximum(take_clip(s_c, safe_pos), 1)
+    p_in = R - (take_clip(cum_in, safe_pos) - c_at)
+    lo_v = take_clip(s_mn, safe_pos)
+    hi_v = take_clip(s_mx, safe_pos)
+    frac_in = jnp.where(
+        c_at > 1,
+        p_in.astype(jnp.float64) / (c_at - 1).astype(jnp.float64),
+        0.0,
+    )
+    est = lo_v.astype(jnp.float64) + (
+        hi_v.astype(jnp.float64) - lo_v.astype(jnp.float64)
+    ) * frac_in
+    if jnp.issubdtype(mn.dtype, jnp.floating):
+        out = est.astype(mn.dtype)
+    else:
+        out = (jnp.sign(est) * jnp.floor(jnp.abs(est) + 0.5)).astype(mn.dtype)
+    valid = used & (N > 0) & (pos < n)
+    return jnp.where(valid, out, jnp.zeros((), out.dtype)), valid
+
+
+@partial(jax.jit, static_argnames=("fraction", "out_capacity"))
 def grouped_percentile(
     keys, valids, mask, x, x_valid, fraction: float, out_capacity: int,
 ):
@@ -989,7 +1073,7 @@ def grouped_count_distinct(keys, valids, mask, x, x_valid, out_capacity):
     sx = take_clip(xb, order)
     sxv = take_clip(xv, order) & sm
     first = jnp.arange(n) == 0
-    flag = sxv & (boundary | first | (sx != jnp.roll(sx, 1)))
+    flag = sxv & (boundary | first | ~_eq_vals(sx, jnp.roll(sx, 1)))
     c = jnp.cumsum(flag.astype(jnp.int64))
     cnt = take_clip(c, ends) - take_clip(c - flag.astype(jnp.int64), safe_starts)
     return jnp.where(used, cnt, 0)

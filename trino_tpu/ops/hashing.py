@@ -34,12 +34,14 @@ def _to_lanes(data: jnp.ndarray) -> tuple:
     dt = data.dtype
     if jnp.issubdtype(dt, jnp.floating):
         data = jnp.where(data == 0, jnp.zeros((), dt), data)
-    if dt in (jnp.int64, jnp.uint64, jnp.float64):
-        bits = (
-            data.view(jnp.uint64)
-            if dt != jnp.int64
-            else data.astype(jnp.int64).view(jnp.uint64)
-        )
+    if dt == jnp.float64:
+        # f64 bitcasts do not compile on this TPU backend; the 3-lane
+        # decomposition is injective + NaN/-0 canonical (ops/floatbits)
+        from trino_tpu.ops.floatbits import f64_lanes
+
+        return f64_lanes(data)
+    if dt in (jnp.int64, jnp.uint64):
+        bits = data.astype(jnp.int64).view(jnp.uint64)
         lo = (bits & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
         hi = (bits >> jnp.uint64(32)).astype(jnp.uint32)
         return lo, hi

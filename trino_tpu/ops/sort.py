@@ -32,22 +32,18 @@ class SortKey:
     nulls_first: bool = False
 
 
-def _float_total_order_bits(data: jnp.ndarray) -> jnp.ndarray:
-    """Map floats to integers whose order is IEEE total order with
-    NaN largest — Trino's Double.compare semantics (NaN > +Inf)."""
-    if data.dtype == jnp.float64:
-        u, s, full = jnp.uint64, jnp.int64, jnp.uint64(0x8000000000000000)
-    else:
-        u, s, full = jnp.uint32, jnp.int32, jnp.uint32(0x80000000)
-    bits = data.view(u)
-    neg = (bits & full) != 0
-    flipped = jnp.where(neg, ~bits, bits | full)
-    return flipped.view(s)
-
-
 def _order_value(data: jnp.ndarray, descending: bool) -> jnp.ndarray:
+    """Single sortable key with Trino ordering semantics. Floats stay
+    FLOATS: XLA's sort/argsort is a total order with NaN LAST, which is
+    exactly Trino's ascending order (Double.compare, NaN > +Inf) — and
+    64-bit float bitcasts do not compile on this TPU backend, so the
+    old int-bits mapping is off the table for f64. Descending floats
+    negate the value; NaN (still last after negation, but Trino wants
+    it FIRST when descending) is fixed by the caller's nan pass
+    (sort_order) — the pre-ordering callers are ascending-only."""
     if jnp.issubdtype(data.dtype, jnp.floating):
-        data = _float_total_order_bits(data)
+        data = jnp.where(data == 0, jnp.zeros((), data.dtype), data)
+        return -data if descending else data
     if not descending:
         return data
     if data.dtype == jnp.bool_:
@@ -78,6 +74,11 @@ def sort_order(
     ):
         v = _order_value(take_clip(data, order), desc)
         order = take_clip(order, jnp.argsort(v, stable=True))
+        if desc and jnp.issubdtype(data.dtype, jnp.floating):
+            # descending floats: NaN must come FIRST (it is the largest
+            # value — Double.compare), but negation leaves it last
+            nanrank = jnp.where(jnp.isnan(take_clip(data, order)), 0, 1)
+            order = take_clip(order, jnp.argsort(nanrank, stable=True))
         if valid is not None:
             nv = take_clip(valid, order)
             null_rank = jnp.where(nv, 1, 0) if nf else jnp.where(nv, 0, 1)

@@ -107,34 +107,30 @@ def _running_sum(vals: jnp.ndarray, part_start: jnp.ndarray) -> jnp.ndarray:
     return cs - base
 
 
-def _enc64(vals: jnp.ndarray) -> jnp.ndarray:
-    """Order-preserving uint64 encoding (x < y <=> enc(x) < enc(y))."""
-    if vals.dtype == jnp.bool_:
-        return vals.astype(jnp.uint64)
-    if jnp.issubdtype(vals.dtype, jnp.floating):
-        bits = jax.lax.bitcast_convert_type(
-            vals.astype(jnp.float64), jnp.uint64
-        )
-        neg = (bits >> jnp.uint64(63)) == jnp.uint64(1)
-        return jnp.where(neg, ~bits, bits | (jnp.uint64(1) << jnp.uint64(63)))
-    return vals.astype(jnp.int64).astype(jnp.uint64) ^ (
-        jnp.uint64(1) << jnp.uint64(63)
-    )
+def _minmax_lanes(vals: jnp.ndarray, kind: str):
+    """Order-encode values as uint32 lanes for the cummax chain. For
+    "min" the lanes are complemented (reversed lexicographic order =
+    complement of each lane). 64-bit float BITCASTS do not compile on
+    this TPU backend, so floats go through ops/floatbits.f64_lanes."""
+    from trino_tpu.ops.floatbits import f32_bits_ordered, f64_lanes
 
-
-def _dec64(enc: jnp.ndarray, dtype) -> jnp.ndarray:
-    """Inverse of _enc64."""
-    if dtype == jnp.bool_:
-        return enc != jnp.uint64(0)
-    if jnp.issubdtype(dtype, jnp.floating):
-        top = (enc >> jnp.uint64(63)) == jnp.uint64(1)
-        bits = jnp.where(
-            top, enc & ~(jnp.uint64(1) << jnp.uint64(63)), ~enc
+    if vals.dtype == jnp.float64:
+        lanes = list(f64_lanes(vals))
+    elif vals.dtype == jnp.float32:
+        lanes = [f32_bits_ordered(vals)]
+    elif vals.dtype == jnp.bool_:
+        lanes = [vals.astype(jnp.uint32)]
+    else:
+        enc = vals.astype(jnp.int64).astype(jnp.uint64) ^ (
+            jnp.uint64(1) << jnp.uint64(63)
         )
-        return jax.lax.bitcast_convert_type(bits, jnp.float64).astype(dtype)
-    return (enc ^ (jnp.uint64(1) << jnp.uint64(63))).astype(jnp.int64).astype(
-        dtype
-    )
+        lanes = [
+            (enc >> jnp.uint64(32)).astype(jnp.uint32),
+            (enc & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32),
+        ]
+    if kind == "min":
+        lanes = [~l for l in lanes]
+    return lanes
 
 
 def _scan_minmax(vals: jnp.ndarray, part_start: jnp.ndarray, kind: str) -> jnp.ndarray:
@@ -142,37 +138,39 @@ def _scan_minmax(vals: jnp.ndarray, part_start: jnp.ndarray, kind: str) -> jnp.n
     XLA:TPU compile hangs at multi-million-element shapes — see
     ops/groupby.py's scan NOTE; lax.cummax compiles flat).
 
-    Strategy: encode order-preservingly into uint64 (negated for min so
-    max machinery serves both), then two cummax passes: (1) over
-    (segment_id || hi32) — the per-segment running max of the high half
-    with automatic reset, since a later segment's id dominates; (2) over
-    (hi-change-points || lo32) restricted to rows attaining the current
-    hi — the running lo among hi-ties, reset whenever run_hi advances.
-    Exact for every 64-bit-encodable type."""
+    One cummax pass per order lane over (segment_id || lane): a later
+    segment's id dominates, giving automatic reset; rows not attaining
+    the running prefix contribute the neutral 0 to later lanes; run
+    boundaries for the next lane are wherever the current run value
+    advances. A FINAL pass carries the row index, so the result is
+    GATHERED from the actual values — exact for every dtype, no bit
+    decode."""
     n = vals.shape[0]
-    enc = _enc64(vals)
-    if kind == "min":
-        enc = ~enc
     first = jnp.arange(n) == 0
     g = jnp.maximum(
         jnp.cumsum(part_start.astype(jnp.int64)) - 1, 0
     ).astype(jnp.uint64)
-    hi = enc >> jnp.uint64(32)
-    lo = enc & jnp.uint64(0xFFFFFFFF)
-    run_ph = jax.lax.cummax((g << jnp.uint64(32)) | hi)
-    run_hi = run_ph & jnp.uint64(0xFFFFFFFF)
-    change = (run_ph != jnp.roll(run_ph, 1)) | first
-    g2 = (jnp.cumsum(change.astype(jnp.int64)) - 1).astype(jnp.uint64)
-    # rows below the current hi contribute 0 (neutral: lo >= 0, and the
-    # row that set run_hi always contributes at its g2 segment start)
-    contrib = jnp.where(hi == run_hi, lo, jnp.uint64(0))
-    run_lo = jax.lax.cummax((g2 << jnp.uint64(32)) | contrib) & jnp.uint64(
-        0xFFFFFFFF
-    )
-    out = (run_hi << jnp.uint64(32)) | run_lo
-    if kind == "min":
-        out = ~out
-    return _dec64(out, vals.dtype)
+    lanes = _minmax_lanes(vals, kind)
+    # final lane: row index — cummax yields the LATEST row attaining the
+    # full prefix; all attaining rows hold the identical value (the lane
+    # encoding is injective), so any witness gathers correctly
+    lanes.append(jnp.arange(n, dtype=jnp.uint32))
+    attained = jnp.ones(n, dtype=jnp.bool_)
+    g_cur = g
+    run_lane = None
+    for i, lane in enumerate(lanes):
+        contrib = jnp.where(attained, lane, jnp.uint32(0))
+        packed = (g_cur << jnp.uint64(32)) | contrib.astype(jnp.uint64)
+        run = jax.lax.cummax(packed)
+        run_lane = (run & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+        if i + 1 < len(lanes):
+            change = (run != jnp.roll(run, 1)) | first
+            g_cur = jnp.maximum(
+                jnp.cumsum(change.astype(jnp.int64)) - 1, 0
+            ).astype(jnp.uint64)
+            attained = attained & (lane == run_lane)
+    pos = run_lane.astype(jnp.int32)
+    return take_clip(vals, pos)
 
 
 def windowed_agg(

@@ -56,6 +56,7 @@ from trino_tpu.exec.operators import (
     _agg_output,
     _expand_pairs,
     _left_unmatched,
+    _right_unmatched,
     _segment_any,
     agg_state_meta,
     make_filter_project_fn,
@@ -81,7 +82,7 @@ AXIS = "shard"
 # Trace-time counters, monotonically increasing for the process life
 # (capacity-overflow retraces count again). Tests must assert on
 # before/after deltas, never absolute values.
-MESH_COUNTERS = {"queries": 0, "all_to_all": 0, "all_gather": 0}
+MESH_COUNTERS = {"queries": 0, "all_to_all": 0, "all_gather": 0, "fallbacks": 0}
 
 
 class MeshUnsupported(Exception):
@@ -95,16 +96,14 @@ class MeshUnsupported(Exception):
 
 
 def _check_node(n: P.PlanNode) -> None:
-    if isinstance(
-        n, (P.WindowNode, P.UnionAllNode, P.OutputNode, P.EnforceSingleRowNode)
-    ):
+    if isinstance(n, (P.WindowNode, P.OutputNode)):
         raise MeshUnsupported(type(n).__name__)
     if isinstance(n, P.AggregateNode):
         for a in n.aggs:
             if a.distinct or a.kind not in _BATCH_REDUCER:
                 raise MeshUnsupported(f"agg {a.kind}")
     if isinstance(n, P.JoinNode) and n.kind not in (
-        "inner", "left", "semi", "anti", "cross"
+        "inner", "left", "full", "semi", "anti", "cross"
     ):
         raise MeshUnsupported(f"join {n.kind}")
     if isinstance(n, P.LimitNode) and n.count is None:
@@ -508,6 +507,19 @@ class _FragVisitor:
             return probe.mask(matched)
         if node.kind == "anti":
             return probe.mask(~matched)
+        if node.kind == "full":
+            # hash-partitioned full outer: every build row lives on
+            # exactly one shard, so shard-local matched flags are
+            # complete (the fragmenter never broadcasts full joins)
+            matched_b = J.build_matched_flags(build.capacity, bi, ok)
+            return concat_batches([
+                pairs,
+                _left_unmatched(probe, build, matched),
+                _right_unmatched(
+                    [(c.type, c.dictionary) for c in probe.columns],
+                    build, matched_b,
+                ),
+            ])
         # left outer: matched pairs + unmatched probe rows with NULL build
         return concat_batches([pairs, _left_unmatched(probe, build, matched)])
 
@@ -532,6 +544,47 @@ class _FragVisitor:
         cols = [c.gather(pi) for c in probe_c.columns]
         cols += [c.gather(bi) for c in build_c.columns]
         return RelBatch(cols, live)
+
+    def _visit_UnionAllNode(self, node):
+        outs = [self.visit(c) for c in node.inputs]
+        # string columns must share dictionaries for the concatenated
+        # column to stay bindable (same rule as the local UnionAll);
+        # all-NULL/empty inputs are compatible with anything
+        base = outs[0]
+        for other in outs[1:]:
+            for c0, c1 in zip(base.columns, other.columns):
+                if not c0.type.is_string:
+                    continue
+                d0, d1 = c0.dictionary, c1.dictionary
+                if (
+                    d0 is not None and len(d0) > 0
+                    and d1 is not None and len(d1) > 0
+                    and d0 != d1
+                ):
+                    raise MeshUnsupported("union dictionary mismatch")
+        return concat_batches(outs)
+
+    def _visit_EnforceSingleRowNode(self, node):
+        child = self.visit(node.child)
+        full = _replicate(child)  # all shards see the full row set
+        live = full.live_mask()
+        n = jnp.sum(live.astype(jnp.int32))
+        # >1 rows is a QUERY ERROR (not a capacity retry): err: flags
+        # raise in the executor instead of resizing
+        self.flags.append((
+            f"err:single_row:{self._site('sr')}",
+            jnp.where(n > 1, n, 0).astype(jnp.int32),
+        ))
+        order = jnp.argsort(jnp.where(live, 0, 1), stable=True)
+        pos = order[:16]
+        idx = jnp.arange(16, dtype=jnp.int32)
+        cols = []
+        for c in full.columns:
+            g = c.gather(pos)
+            valid = g.valid_mask() & (idx < n)  # 0 rows -> all-NULL row
+            cols.append(g.with_data(g.data, valid))
+        out_live = jnp.where(n > 0, idx < n, idx == 0)
+        return RelBatch(cols, out_live)
 
     # -- ordering / limits --
     def _sorted(self, batch: RelBatch, keys) -> RelBatch:
@@ -630,6 +683,10 @@ class MeshExecutor:
             if not overflowed:
                 break
             for site, needed in overflowed:
+                if site.startswith("err:single_row"):
+                    raise RuntimeError(
+                        "Scalar sub-query has returned multiple rows"
+                    )
                 # flags carry the exact required size: jump straight
                 # there rather than climbing a x2 retrace ladder
                 caps[site] = max(
@@ -680,6 +737,12 @@ class MeshExecutor:
         sharding = NamedSharding(self.mesh, PSpec(AXIS))
         for sp in mesh_sps:
             for node in _scan_nodes(sp.fragment.root):
+                if id(node) in feeds:
+                    # the planner may reuse one ScanNode object in several
+                    # plan positions (e.g. the NOT IN rewrite's subquery);
+                    # one feed serves them all — a second append would
+                    # misalign in_specs with feed_args
+                    continue
                 conn = self.catalogs.get(node.catalog)
                 splits = conn.split_manager.get_splits(
                     node.handle, max(self.session.target_splits, self.n)

@@ -184,6 +184,8 @@ class DistributedQueryRunner:
             ]
             self._in_process_workers = True
         self.hash_partitions = hash_partitions
+        # why the last query left the mesh plane (None = it didn't)
+        self.last_mesh_fallback: Optional[str] = None
 
     def _mesh_colocated(self) -> bool:
         """Mesh execution applies when every task would run in THIS
@@ -250,6 +252,9 @@ class DistributedQueryRunner:
                 transaction_id=transaction_id,
             )
         output = self._analyze(stmt)
+        # reset BEFORE any plane decision: a stale reason from an earlier
+        # query must not read as applying to this one
+        self.last_mesh_fallback = None
         self._check_access(output, identity)
         subplan = plan_distributed(
             output,
@@ -259,7 +264,7 @@ class DistributedQueryRunner:
         result_meta = (list(output.names), [f.type for f in output.fields])
         if self.session.retry_policy == "task":
             rows = self._execute_fte(subplan)
-            return MaterializedResult(rows, *result_meta)
+            return MaterializedResult(rows, *result_meta, data_plane="fte")
         if self.session.mesh_execution and self._mesh_colocated():
             # tasks share one host's device mesh: the exchange rides ICI
             # collectives in one SPMD program (parallel/mesh_plan.py);
@@ -268,9 +273,16 @@ class DistributedQueryRunner:
 
             try:
                 rows = MeshExecutor(self.catalogs, self.session).execute(subplan)
-                return MaterializedResult(rows, *result_meta)
-            except MeshUnsupported:
-                pass  # expected: plan shape outside the mesh compiler
+                return MaterializedResult(
+                    rows, *result_meta, data_plane="mesh"
+                )
+            except MeshUnsupported as ex:
+                # fallback must be OBSERVABLE, not silent: count it and
+                # record why (EXPLAIN ANALYZE / stats surface this)
+                from trino_tpu.parallel.mesh_plan import MESH_COUNTERS
+
+                MESH_COUNTERS["fallbacks"] += 1
+                self.last_mesh_fallback = str(ex)
             except Exception:
                 # unexpected mesh runtime failure: the page-exchange path
                 # below re-executes from scratch (correctness preserved),
@@ -306,7 +318,9 @@ class DistributedQueryRunner:
                 # before this loop.
                 root_handle, root_tid = scheduler.start()
                 rows = self._collect(scheduler, root_handle, root_tid)
-                return MaterializedResult(rows, *result_meta)
+                return MaterializedResult(
+                    rows, *result_meta, data_plane="http"
+                )
             except Exception as e:
                 last_error = e  # retry_policy=QUERY: whole-query re-run
             finally:

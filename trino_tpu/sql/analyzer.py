@@ -1418,10 +1418,12 @@ class Analyzer:
                 lb.filter(ExprConverter(lb.scope).convert(c))
             left = RelationItem(lb.node, lb.scope, 1000.0)
         right = self._plan_relation_leaf_any(rel.right, ctes)
-        if rel.kind == "right":
+        swapped = rel.kind == "right"
+        if swapped:
+            # RIGHT join plans as LEFT with sides swapped (the reference
+            # does the same in RelationPlanner); the output projection
+            # below restores declared column order
             left, right = right, left
-        elif rel.kind == "full":
-            raise AnalysisError("FULL OUTER JOIN not yet supported")
         lkeys: List[int] = []
         rkeys: List[int] = []
         residuals: List[ast.Expression] = []
@@ -1446,13 +1448,29 @@ class Analyzer:
         if residuals:
             conv = ExprConverter(Scope.concat(left.scope, right.scope))
             residual_ir = ir.and_(*[conv.convert(c) for c in residuals])
+        kind = "full" if rel.kind == "full" else "left"
         node = P.JoinNode(
-            "left", left.node, right.node, tuple(lkeys), tuple(rkeys),
+            kind, left.node, right.node, tuple(lkeys), tuple(rkeys),
             residual_ir, left.node.fields + right.node.fields,
         )
-        return RelationItem(
+        item = RelationItem(
             node, Scope.concat(left.scope, right.scope), max(left.rows, right.rows)
         )
+        if swapped:
+            # restore declared column order (probe side was moved left)
+            w_r = len(left.scope.fields)  # right relation is now probe
+            perm = list(range(w_r, w_r + len(right.scope.fields))) + list(
+                range(w_r)
+            )
+            exprs = tuple(
+                ir.InputRef(c, node.fields[c].type) for c in perm
+            )
+            fields = tuple(node.fields[c] for c in perm)
+            scope = Scope([item.scope.fields[c] for c in perm])
+            item = RelationItem(
+                P.ProjectNode(node, exprs, fields), scope, item.rows
+            )
+        return item
 
     def _plan_relation_leaf_any(self, rel, ctes) -> RelationItem:
         items: List[RelationItem] = []

@@ -247,7 +247,10 @@ class _AddExchanges:
             return dataclasses.replace(node, left=left, right=right), SINGLE
 
         build_rows = self._estimate(node.right)
-        broadcast = (
+        # FULL outer can never broadcast: a replicated build would emit
+        # its unmatched rows once PER TASK (AddExchanges enforces the
+        # same partitioned-only rule for full joins)
+        broadcast = node.kind != "full" and (
             node.kind == "cross"
             or not node.right_keys
             or build_rows <= self._broadcast_threshold
@@ -304,7 +307,8 @@ def _spec_of(a: P.AggCall):
     from trino_tpu.exec.operators import AggSpec
 
     return AggSpec(a.kind, a.arg_channel, a.out_type, a.distinct,
-                   a.arg2_channel, a.percentile, a.separator)
+                   a.arg2_channel, a.percentile, a.separator,
+                   a.arg3_channel)
 
 
 # -- row estimation: the cost-based StatsCalculator (sql/stats.py) -----------

@@ -86,6 +86,7 @@ class AggCall:
     arg2_channel: Optional[int] = None
     percentile: Optional[float] = None
     separator: Optional[str] = None  # listagg
+    arg3_channel: Optional[int] = None  # pctl_merge bucket-max channel
 
 
 @dataclasses.dataclass(frozen=True)
