@@ -104,6 +104,77 @@ _KIND_TO_TYPE = {
 }
 
 
+def _parquet_type(c) -> T.DataType:
+    """Parquet physical+converted type -> engine type."""
+    from trino_tpu.connectors import parquet_format as PQ
+
+    if c.physical == PQ.T_BOOLEAN:
+        return T.BOOLEAN
+    if c.physical == PQ.T_INT32:
+        if c.converted == PQ.C_DATE:
+            return T.DATE
+        if c.converted == PQ.C_DECIMAL:
+            return T.decimal(min(c.precision or 9, 18), c.scale or 0)
+        return T.INTEGER
+    if c.physical == PQ.T_INT64:
+        if c.converted == PQ.C_DECIMAL:
+            return T.decimal(min(c.precision or 18, 18), c.scale or 0)
+        if c.converted == PQ.C_TIMESTAMP_MICROS:
+            return T.TIMESTAMP
+        return T.BIGINT
+    if c.physical == PQ.T_FLOAT:
+        return T.REAL
+    if c.physical == PQ.T_DOUBLE:
+        return T.DOUBLE
+    if c.physical == PQ.T_BYTE_ARRAY:
+        return T.VARCHAR
+    raise ValueError(f"unsupported parquet physical type {c.physical}")
+
+
+def _to_parquet_column(cm, data, valid, dictionary):
+    """Engine host column -> ParquetColumn (write path)."""
+    from trino_tpu.connectors import parquet_format as PQ
+
+    t = cm.type
+    if t.is_string:
+        vals = [
+            (dictionary.values[int(v)] if dictionary else "").encode("utf-8")
+            for v in data
+        ]
+        return PQ.ParquetColumn(cm.name, PQ.T_BYTE_ARRAY, PQ.C_UTF8,
+                                values=vals, valid=valid)
+    if t.kind == T.TypeKind.BOOLEAN:
+        return PQ.ParquetColumn(cm.name, PQ.T_BOOLEAN,
+                                values=np.asarray(data, bool), valid=valid)
+    if t.kind == T.TypeKind.DATE:
+        return PQ.ParquetColumn(cm.name, PQ.T_INT32, PQ.C_DATE,
+                                values=np.asarray(data, np.int32),
+                                valid=valid)
+    if t.kind == T.TypeKind.INTEGER:
+        return PQ.ParquetColumn(cm.name, PQ.T_INT32,
+                                values=np.asarray(data, np.int32),
+                                valid=valid)
+    if t.is_decimal:
+        return PQ.ParquetColumn(cm.name, PQ.T_INT64, PQ.C_DECIMAL,
+                                scale=t.scale, precision=t.precision,
+                                values=np.asarray(data, np.int64),
+                                valid=valid)
+    if t.kind == T.TypeKind.TIMESTAMP:
+        return PQ.ParquetColumn(cm.name, PQ.T_INT64, PQ.C_TIMESTAMP_MICROS,
+                                values=np.asarray(data, np.int64),
+                                valid=valid)
+    if t.kind == T.TypeKind.REAL:
+        return PQ.ParquetColumn(cm.name, PQ.T_FLOAT,
+                                values=np.asarray(data, np.float32),
+                                valid=valid)
+    if t.kind == T.TypeKind.DOUBLE:
+        return PQ.ParquetColumn(cm.name, PQ.T_DOUBLE,
+                                values=np.asarray(data, np.float64),
+                                valid=valid)
+    return PQ.ParquetColumn(cm.name, PQ.T_INT64,
+                            values=np.asarray(data, np.int64), valid=valid)
+
+
 def _parse_cell(text: str, t: T.DataType):
     """-> (value, is_null) in the column's storage representation.
     Cells that fail to parse as the inferred/declared type become NULL
@@ -156,7 +227,7 @@ class _FileStore:
     # -- layout --
     def table_paths(self, schema: str, table: str) -> List[str]:
         base = os.path.join(self.root, schema)
-        for ext in (".csv", ".jsonl"):
+        for ext in (".csv", ".jsonl", ".parquet"):
             p = os.path.join(base, table + ext)
             if os.path.isfile(p):
                 return [p]
@@ -165,7 +236,7 @@ class _FileStore:
             return sorted(
                 os.path.join(d, f)
                 for f in os.listdir(d)
-                if f.endswith((".csv", ".jsonl"))
+                if f.endswith((".csv", ".jsonl", ".parquet"))
             )
         return []
 
@@ -202,9 +273,17 @@ class _FileStore:
             hit = self._cache.get(key)
             if hit is not None and hit.stamp == stamp:
                 return hit
-        parsed = self._parse(
-            paths, stamp, self.declared_schema(schema, table)
-        )
+        pq = [p for p in paths if p.endswith(".parquet")]
+        if pq and len(pq) != len(paths):
+            raise ValueError(
+                f"table {schema}.{table} mixes parquet and text parts"
+            )
+        if pq:
+            parsed = self._parse_parquet(paths, stamp)
+        else:
+            parsed = self._parse(
+                paths, stamp, self.declared_schema(schema, table)
+            )
         with self.lock:
             self._cache[key] = parsed
         return parsed
@@ -297,6 +376,55 @@ class _FileStore:
             valid[cm.name] = ~nulls if nulls.any() else None
         return _ParsedTable(columns, data, valid, dicts, n, stamp)
 
+    def _parse_parquet(self, paths: List[str], stamp: tuple) -> _ParsedTable:
+        """Typed parquet parts -> the parsed-table form (the
+        lib/trino-parquet read path reduced to the engine's types)."""
+        from trino_tpu.connectors import parquet_format as PQ
+
+        per_file = [PQ.read_parquet(p) for p in paths]
+        first_cols, _ = per_file[0]
+        columns: List[ColumnMetadata] = []
+        for c in first_cols:
+            columns.append(ColumnMetadata(c.name, _parquet_type(c)))
+        data: Dict[str, np.ndarray] = {}
+        valid: Dict[str, Optional[np.ndarray]] = {}
+        dicts: Dict[str, Optional[Dictionary]] = {}
+        n = sum(nr for _, nr in per_file)
+        for i, cm in enumerate(columns):
+            parts = [cols[i] for cols, _ in per_file]
+            if any(p.name != cm.name for p in parts):
+                raise ValueError("schema mismatch across parquet parts")
+            valids = [
+                p.valid
+                if p.valid is not None
+                else np.ones(
+                    len(p.values) if isinstance(p.values, list)
+                    else p.values.shape[0], bool
+                )
+                for p in parts
+            ]
+            v = np.concatenate(valids) if valids else np.ones(0, bool)
+            if cm.type.is_string:
+                texts: List[Optional[str]] = []
+                for p, pv in zip(parts, valids):
+                    for b, ok in zip(p.values, pv):
+                        texts.append(
+                            b.decode("utf-8") if ok else None
+                        )
+                d = Dictionary(sorted({t for t in texts if t is not None}))
+                data[cm.name] = np.asarray(
+                    [d.code(t) if t is not None else 0 for t in texts],
+                    dtype=np.int32,
+                )
+                dicts[cm.name] = d
+            else:
+                data[cm.name] = np.concatenate(
+                    [np.asarray(p.values) for p in parts]
+                ).astype(cm.type.dtype)
+                dicts[cm.name] = None
+            valid[cm.name] = v if not v.all() else None
+        return _ParsedTable(columns, data, valid, dicts, n, stamp)
+
 
 # ---------------------------------------------------------------------------
 # SPI surfaces
@@ -304,8 +432,9 @@ class _FileStore:
 
 
 class FileMetadata(ConnectorMetadata):
-    def __init__(self, store: _FileStore):
+    def __init__(self, store: _FileStore, file_format: str = "csv"):
         self.store = store
+        self.file_format = file_format
 
     def list_schemas(self) -> List[str]:
         root = self.store.root
@@ -374,10 +503,23 @@ class FileMetadata(ConnectorMetadata):
         if self.store.table_paths(schema, table):
             raise ValueError(f"table '{schema}.{table}' already exists")
         os.makedirs(d, exist_ok=True)
-        # a header-only part records the column ORDER; the sidecar
-        # schema file records the declared TYPES (metastore analogue)
-        with open(os.path.join(d, "part-0.csv"), "w", newline="") as f:
-            csv.writer(f).writerow([c.name for c in columns])
+        # a header-only/empty part records the column ORDER and (for
+        # parquet) the TYPES; the sidecar schema file records declared
+        # types for the text formats (metastore analogue)
+        if self.file_format == "parquet":
+            from trino_tpu.connectors import parquet_format as PQ
+
+            empty = [
+                _to_parquet_column(
+                    c, np.zeros(0, dtype=c.type.dtype)
+                    if not c.type.is_string else [], None, None
+                )
+                for c in columns
+            ]
+            PQ.write_parquet(os.path.join(d, "part-0.parquet"), empty, 0)
+        else:
+            with open(os.path.join(d, "part-0.csv"), "w", newline="") as f:
+                csv.writer(f).writerow([c.name for c in columns])
         with open(os.path.join(d, ".schema.json"), "w") as f:
             json.dump(
                 [
@@ -541,21 +683,109 @@ class FilePageSink(ConnectorPageSink):
         return self.rows
 
 
+class ParquetPageSink(ConnectorPageSink):
+    """Columnar write path: batches buffer host-side and land as ONE
+    parquet part at finish (write-then-rename, like the CSV sink)."""
+
+    def __init__(self, store: _FileStore, handle: TableHandle):
+        import uuid
+
+        self.store = store
+        self.handle = handle
+        self.rows = 0
+        d = os.path.join(store.root, handle.schema, handle.table)
+        os.makedirs(d, exist_ok=True)
+        part = uuid.uuid4().hex[:12]
+        self._final = os.path.join(d, f"part-{part}.parquet")
+        self._tmp = os.path.join(d, f".part-{part}.parquet.tmp")
+        parsed = store.parsed(handle.schema, handle.table)
+        self._columns = parsed.columns
+        self._bufs = [([], []) for _ in self._columns]  # (data, valid)
+        self._dicts = [None] * len(self._columns)
+
+    def append(self, batch: RelBatch) -> None:
+        import jax
+
+        live = np.asarray(jax.device_get(batch.live_mask()))
+        for i, (cm, col) in enumerate(zip(self._columns, batch.columns)):
+            data = np.asarray(jax.device_get(col.data))[live]
+            valid = (
+                np.asarray(jax.device_get(col.valid))[live]
+                if col.valid is not None
+                else np.ones(len(data), bool)
+            )
+            if cm.type.is_string:
+                # decode now: dictionaries differ per batch; a missing
+                # dictionary (NULL-only projections, outer-join padding)
+                # decodes as empty strings under an all-false mask
+                d = col.dictionary
+                data = [
+                    d.values[int(v)] if ok and d else ""
+                    for v, ok in zip(data, valid)
+                ]
+            self._bufs[i][0].append(data)
+            self._bufs[i][1].append(valid)
+        self.rows += int(live.sum())
+
+    def finish(self) -> int:
+        from trino_tpu.connectors import parquet_format as PQ
+
+        cols = []
+        for cm, (datas, valids) in zip(self._columns, self._bufs):
+            if cm.type.is_string:
+                flat = [v for part in datas for v in part]
+                valid = np.concatenate(valids) if valids else np.zeros(0, bool)
+                vals = [s.encode("utf-8") for s in flat]
+                cols.append(PQ.ParquetColumn(
+                    cm.name, PQ.T_BYTE_ARRAY, PQ.C_UTF8,
+                    values=vals,
+                    valid=None if valid.all() else valid,
+                ))
+                continue
+            data = (
+                np.concatenate(datas) if datas
+                else np.zeros(0, dtype=cm.type.dtype)
+            )
+            valid = np.concatenate(valids) if valids else np.zeros(0, bool)
+            cols.append(_to_parquet_column(
+                cm, data, None if valid.all() else valid, None
+            ))
+        PQ.write_parquet(self._tmp, cols, self.rows)
+        os.replace(self._tmp, self._final)
+        return self.rows
+
+
 class FileConnector(Connector):
-    def __init__(self, root: str):
+    """`file_format` chooses the WRITE format for CREATE/INSERT parts
+    ("csv" default, "parquet" for the columnar path); reads always
+    dispatch by extension."""
+
+    def __init__(self, root: str, file_format: str = "csv"):
         store = _FileStore(root)
         super().__init__(
             "file",
-            FileMetadata(store),
+            FileMetadata(store, file_format),
             FileSplitManager(store),
             FilePageSource(store),
         )
         self.store = store
+        self.file_format = file_format
 
     def page_sink(self, handle: TableHandle, transaction=None) -> ConnectorPageSink:
+        # the TABLE's existing parts decide the write format — an INSERT
+        # must never land a mismatched part next to them (which would
+        # fail every subsequent read); the connector's configured format
+        # only applies to freshly created tables
+        paths = self.store.table_paths(handle.schema, handle.table)
+        if paths:
+            fmt = "parquet" if paths[0].endswith(".parquet") else "csv"
+        else:
+            fmt = self.file_format
+        if fmt == "parquet":
+            return ParquetPageSink(self.store, handle)
         return FilePageSink(self.store, handle)
 
 
-def create_file_connector(root: str) -> Connector:
+def create_file_connector(root: str, file_format: str = "csv") -> Connector:
     """plugin entry point (Plugin.getConnectorFactories analogue)."""
-    return FileConnector(root)
+    return FileConnector(root, file_format)

@@ -1,0 +1,538 @@
+"""Minimal Parquet reader/writer (pure python, no external deps).
+
+Analogue of lib/trino-parquet (28.1k LoC in the reference): the subset
+the engine's types need — PLAIN encoding, UNCOMPRESSED pages, data page
+v1, optional fields via RLE/bit-packed definition levels, and the
+Thrift Compact Protocol for the footer metadata. Physical/logical
+types covered:
+
+  BOOLEAN              <- boolean
+  INT32 (+DATE)        <- integer, date
+  INT64 (+DECIMAL/TIMESTAMP_MICROS) <- bigint, decimal(<=18), timestamp
+  FLOAT / DOUBLE       <- real, double
+  BYTE_ARRAY (+UTF8)   <- varchar
+
+The format follows the parquet-format spec directly (file magic PAR1,
+footer = thrift FileMetaData + little-endian length + PAR1; each column
+chunk = one v1 data page). The reader skips unknown thrift fields, so
+files written by other engines with extra metadata (statistics, CRCs,
+column indexes) still read as long as pages are PLAIN + uncompressed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+MAGIC = b"PAR1"
+
+# thrift compact type ids
+_CT_STOP = 0
+_CT_TRUE = 1
+_CT_FALSE = 2
+_CT_BYTE = 3
+_CT_I16 = 4
+_CT_I32 = 5
+_CT_I64 = 6
+_CT_DOUBLE = 7
+_CT_BINARY = 8
+_CT_LIST = 9
+_CT_SET = 10
+_CT_MAP = 11
+_CT_STRUCT = 12
+
+# parquet physical types
+T_BOOLEAN = 0
+T_INT32 = 1
+T_INT64 = 2
+T_INT96 = 3
+T_FLOAT = 4
+T_DOUBLE = 5
+T_BYTE_ARRAY = 6
+T_FIXED = 7
+
+# converted (logical) types
+C_UTF8 = 0
+C_DECIMAL = 5
+C_DATE = 6
+C_TIMESTAMP_MICROS = 10
+
+
+# ---------------------------------------------------------------------------
+# thrift compact protocol
+# ---------------------------------------------------------------------------
+
+
+def _uvarint(x: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = x & 0x7F
+        x >>= 7
+        if x:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _zigzag(x: int) -> int:
+    return (x << 1) ^ (x >> 63)
+
+
+def _unzigzag(x: int) -> int:
+    return (x >> 1) ^ -(x & 1)
+
+
+class _Writer:
+    def __init__(self):
+        self.buf = bytearray()
+        self._last_fid = [0]
+
+    def _field(self, fid: int, ctype: int) -> None:
+        delta = fid - self._last_fid[-1]
+        if 0 < delta <= 15:
+            self.buf.append((delta << 4) | ctype)
+        else:
+            self.buf.append(ctype)
+            self.buf += _uvarint(_zigzag(fid))
+        self._last_fid[-1] = fid
+
+    def i32(self, fid: int, v: int) -> None:
+        self._field(fid, _CT_I32)
+        self.buf += _uvarint(_zigzag(v))
+
+    def i64(self, fid: int, v: int) -> None:
+        self._field(fid, _CT_I64)
+        self.buf += _uvarint(_zigzag(v))
+
+    def string(self, fid: int, s: str) -> None:
+        self._field(fid, _CT_BINARY)
+        b = s.encode("utf-8")
+        self.buf += _uvarint(len(b))
+        self.buf += b
+
+    def list_begin(self, fid: int, etype: int, n: int) -> None:
+        self._field(fid, _CT_LIST)
+        if n < 15:
+            self.buf.append((n << 4) | etype)
+        else:
+            self.buf.append(0xF0 | etype)
+            self.buf += _uvarint(n)
+
+    def list_i32_elem(self, v: int) -> None:
+        self.buf += _uvarint(_zigzag(v))
+
+    def list_string_elem(self, s: str) -> None:
+        b = s.encode("utf-8")
+        self.buf += _uvarint(len(b))
+        self.buf += b
+
+    def struct_begin(self, fid: int) -> None:
+        self._field(fid, _CT_STRUCT)
+        self._last_fid.append(0)
+
+    def struct_end(self) -> None:
+        self.buf.append(_CT_STOP)
+        self._last_fid.pop()
+
+    def root_end(self) -> None:
+        self.buf.append(_CT_STOP)
+
+
+class _Reader:
+    def __init__(self, data: bytes, pos: int = 0):
+        self.d = data
+        self.pos = pos
+
+    def _uvarint(self) -> int:
+        x = 0
+        shift = 0
+        while True:
+            b = self.d[self.pos]
+            self.pos += 1
+            x |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return x
+            shift += 7
+
+    def _zig(self) -> int:
+        return _unzigzag(self._uvarint())
+
+    def read_struct(self) -> Dict[int, Any]:
+        """Generic struct -> {field_id: value}; unknown fields kept
+        (values are ints/bytes/lists/dicts)."""
+        out: Dict[int, Any] = {}
+        last = 0
+        while True:
+            head = self.d[self.pos]
+            self.pos += 1
+            if head == _CT_STOP:
+                return out
+            ctype = head & 0x0F
+            delta = head >> 4
+            if delta:
+                fid = last + delta
+            else:
+                fid = self._zig()
+            last = fid
+            out[fid] = self._value(ctype)
+
+    def _value(self, ctype: int):
+        if ctype == _CT_TRUE:
+            return True
+        if ctype == _CT_FALSE:
+            return False
+        if ctype == _CT_BYTE:
+            v = self.d[self.pos]
+            self.pos += 1
+            return v
+        if ctype in (_CT_I16, _CT_I32, _CT_I64):
+            return self._zig()
+        if ctype == _CT_DOUBLE:
+            v = struct.unpack_from("<d", self.d, self.pos)[0]
+            self.pos += 8
+            return v
+        if ctype == _CT_BINARY:
+            n = self._uvarint()
+            v = self.d[self.pos:self.pos + n]
+            self.pos += n
+            return v
+        if ctype == _CT_LIST or ctype == _CT_SET:
+            head = self.d[self.pos]
+            self.pos += 1
+            etype = head & 0x0F
+            n = head >> 4
+            if n == 0xF:
+                n = self._uvarint()
+            return [self._value(etype) for _ in range(n)]
+        if ctype == _CT_STRUCT:
+            return self.read_struct()
+        if ctype == _CT_MAP:
+            n = self._uvarint()
+            if n == 0:
+                return {}
+            kv = self.d[self.pos]
+            self.pos += 1
+            kt, vt = kv >> 4, kv & 0x0F
+            return {
+                self._value(kt): self._value(vt) for _ in range(n)
+            }
+        raise ValueError(f"thrift compact type {ctype}")
+
+
+# ---------------------------------------------------------------------------
+# column model
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ParquetColumn:
+    """One leaf column: name, physical/converted types, values +
+    validity (None = all valid)."""
+
+    name: str
+    physical: int
+    converted: Optional[int] = None
+    scale: Optional[int] = None
+    precision: Optional[int] = None
+    values: Any = None          # np.ndarray, or list[bytes] for BYTE_ARRAY
+    valid: Optional[np.ndarray] = None
+
+
+def _bitpack_levels(valid: np.ndarray) -> bytes:
+    """Definition levels (bit width 1) as one BIT_PACKED run of the
+    RLE/bit-packed hybrid."""
+    n = len(valid)
+    groups = (n + 7) // 8
+    padded = np.zeros(groups * 8, dtype=bool)
+    padded[:n] = valid
+    packed = np.packbits(padded, bitorder="little").tobytes()
+    return _uvarint((groups << 1) | 1) + packed
+
+
+def _read_levels(data: bytes, pos: int, n: int) -> Tuple[np.ndarray, int]:
+    """RLE/bit-packed hybrid, bit width 1, length-prefixed (v1 pages)."""
+    (total_len,) = struct.unpack_from("<I", data, pos)
+    pos += 4
+    end = pos + total_len
+    out = np.zeros(n, dtype=np.uint8)
+    i = 0
+    r = _Reader(data, pos)
+    while i < n and r.pos < end:
+        header = r._uvarint()
+        if header & 1:  # bit-packed: (groups << 1) | 1
+            groups = header >> 1
+            cnt = groups * 8
+            raw = np.frombuffer(
+                r.d[r.pos:r.pos + groups], dtype=np.uint8
+            )
+            r.pos += groups
+            bits = np.unpackbits(raw, bitorder="little")[:cnt]
+            take = min(cnt, n - i)
+            out[i:i + take] = bits[:take]
+            i += take
+        else:  # RLE run: (count << 1); value in 1 byte (bit width 1)
+            count = header >> 1
+            val = r.d[r.pos]
+            r.pos += 1
+            take = min(count, n - i)
+            out[i:i + take] = val & 1
+            i += take
+    return out.astype(bool), end
+
+
+def _plain_encode(col: ParquetColumn) -> bytes:
+    vals = col.values
+    if col.physical == T_BOOLEAN:
+        arr = np.asarray(vals, dtype=bool)
+        return np.packbits(arr, bitorder="little").tobytes()
+    if col.physical == T_INT32:
+        return np.asarray(vals, dtype="<i4").tobytes()
+    if col.physical == T_INT64:
+        return np.asarray(vals, dtype="<i8").tobytes()
+    if col.physical == T_FLOAT:
+        return np.asarray(vals, dtype="<f4").tobytes()
+    if col.physical == T_DOUBLE:
+        return np.asarray(vals, dtype="<f8").tobytes()
+    if col.physical == T_BYTE_ARRAY:
+        out = bytearray()
+        for b in vals:
+            if isinstance(b, str):
+                b = b.encode("utf-8")
+            out += struct.pack("<I", len(b))
+            out += b
+        return bytes(out)
+    raise ValueError(f"physical type {col.physical}")
+
+
+def _plain_decode(physical: int, data: bytes, n: int):
+    if physical == T_BOOLEAN:
+        bits = np.unpackbits(
+            np.frombuffer(data, dtype=np.uint8), bitorder="little"
+        )[:n]
+        return bits.astype(bool)
+    if physical == T_INT32:
+        return np.frombuffer(data, dtype="<i4", count=n).copy()
+    if physical == T_INT64:
+        return np.frombuffer(data, dtype="<i8", count=n).copy()
+    if physical == T_FLOAT:
+        return np.frombuffer(data, dtype="<f4", count=n).copy()
+    if physical == T_DOUBLE:
+        return np.frombuffer(data, dtype="<f8", count=n).copy()
+    if physical == T_BYTE_ARRAY:
+        out = []
+        pos = 0
+        for _ in range(n):
+            (ln,) = struct.unpack_from("<I", data, pos)
+            pos += 4
+            out.append(data[pos:pos + ln])
+            pos += ln
+        return out
+    raise ValueError(f"physical type {physical}")
+
+
+# ---------------------------------------------------------------------------
+# write
+# ---------------------------------------------------------------------------
+
+
+def write_parquet(path: str, columns: List[ParquetColumn], num_rows: int) -> None:
+    body = bytearray(MAGIC)
+    chunk_meta = []  # (col, data_page_offset, page_bytes_len, num_values)
+    for col in columns:
+        offset = len(body)
+        # page payload: [def levels if optional] + PLAIN values (non-null)
+        payload = bytearray()
+        if col.valid is not None:
+            levels = _bitpack_levels(np.asarray(col.valid, dtype=bool))
+            payload += struct.pack("<I", len(levels))
+            payload += levels
+            if col.physical == T_BYTE_ARRAY:
+                vals = [v for v, ok in zip(col.values, col.valid) if ok]
+            else:
+                vals = np.asarray(col.values)[np.asarray(col.valid, bool)]
+            dense = dataclasses.replace(col, values=vals)
+            payload += _plain_encode(dense)
+        else:
+            payload += _plain_encode(col)
+        ph = _Writer()
+        ph.i32(1, 0)                    # DATA_PAGE
+        ph.i32(2, len(payload))         # uncompressed size
+        ph.i32(3, len(payload))         # compressed size (== uncompressed)
+        ph.struct_begin(5)              # data_page_header
+        ph.i32(1, num_rows)             # num_values (incl. nulls)
+        ph.i32(2, 0)                    # PLAIN
+        ph.i32(3, 3)                    # def levels: RLE
+        ph.i32(4, 3)                    # rep levels: RLE (absent, flat)
+        ph.struct_end()
+        ph.root_end()
+        body += ph.buf
+        body += payload
+        chunk_meta.append((col, offset, len(ph.buf) + len(payload)))
+
+    # footer
+    w = _Writer()
+    w.i32(1, 1)  # version
+    # schema: root + leaves
+    w.list_begin(2, _CT_STRUCT, len(columns) + 1)
+    root = _Writer()
+    root.string(4, "schema")
+    root.i32(5, len(columns))
+    root.root_end()
+    w.buf += root.buf
+    for col in columns:
+        se = _Writer()
+        se.i32(1, col.physical)
+        se.i32(3, 1 if col.valid is not None else 0)  # optional/required
+        se.string(4, col.name)
+        if col.converted is not None:
+            se.i32(6, col.converted)
+        if col.scale is not None:
+            se.i32(7, col.scale)
+        if col.precision is not None:
+            se.i32(8, col.precision)
+        se.root_end()
+        w.buf += se.buf
+    w.i64(3, num_rows)
+    w.list_begin(4, _CT_STRUCT, 1)  # one row group
+    rg = _Writer()
+    rg.list_begin(1, _CT_STRUCT, len(columns))
+    total = 0
+    for col, offset, nbytes in chunk_meta:
+        cc = _Writer()
+        cc.i64(2, offset)               # file_offset
+        cc.struct_begin(3)              # meta_data
+        cc.i32(1, col.physical)
+        cc.list_begin(2, _CT_I32, 1)
+        cc.list_i32_elem(0)             # PLAIN
+        cc.list_begin(3, _CT_BINARY, 1)
+        cc.list_string_elem(col.name)
+        cc.i32(4, 0)                    # UNCOMPRESSED
+        cc.i64(5, num_rows)
+        cc.i64(6, nbytes)
+        cc.i64(7, nbytes)
+        cc.i64(9, offset)               # data_page_offset
+        cc.struct_end()
+        cc.root_end()
+        rg.buf += cc.buf
+        total += nbytes
+    rg.i64(2, total)
+    rg.i64(3, num_rows)
+    rg.root_end()
+    w.buf += rg.buf
+    w.string(6, "trino-tpu")
+    w.root_end()
+
+    body += w.buf
+    body += struct.pack("<I", len(w.buf))
+    body += MAGIC
+    with open(path, "wb") as f:
+        f.write(body)
+
+
+# ---------------------------------------------------------------------------
+# read
+# ---------------------------------------------------------------------------
+
+
+def read_parquet(path: str) -> Tuple[List[ParquetColumn], int]:
+    with open(path, "rb") as f:
+        data = f.read()
+    if data[:4] != MAGIC or data[-4:] != MAGIC:
+        raise ValueError("not a parquet file")
+    (meta_len,) = struct.unpack_from("<I", data, len(data) - 8)
+    meta = _Reader(data, len(data) - 8 - meta_len).read_struct()
+    schema = meta[2]
+    num_rows = meta[3]
+    row_groups = meta[4]
+    # leaves (skip the root element); nested schemas unsupported
+    leaves = []
+    for se in schema[1:]:
+        if 5 in se and se.get(5, 0) > 0 and 1 not in se:
+            raise ValueError("nested parquet schemas not supported")
+        leaves.append(se)
+    cols: List[ParquetColumn] = [
+        ParquetColumn(
+            name=se[4].decode("utf-8"),
+            physical=se[1],
+            converted=se.get(6),
+            scale=se.get(7),
+            precision=se.get(8),
+            valid=None if se.get(3, 0) == 0 else np.zeros(0, bool),
+        )
+        for se in leaves
+    ]
+    chunks: List[List[Tuple[np.ndarray, Any]]] = [[] for _ in cols]
+    for rg in row_groups:
+        for ci, cc in enumerate(rg[1]):
+            md = cc[3]
+            codec = md.get(4, 0)
+            if codec != 0:
+                raise ValueError(
+                    f"unsupported parquet codec {codec} (UNCOMPRESSED only)"
+                )
+            pos = md.get(9, cc.get(2))
+            n_remaining = md[5]
+            while n_remaining > 0:
+                r = _Reader(data, pos)
+                ph = r.read_struct()
+                page_len = ph[3]
+                page_start = r.pos
+                dph = ph.get(5)
+                if dph is None:  # dictionary page etc.: skip
+                    pos = page_start + page_len
+                    continue
+                n_vals = dph[1]
+                if dph.get(2, 0) != 0:
+                    raise ValueError("unsupported parquet encoding (PLAIN only)")
+                if cols[ci].valid is not None:
+                    valid, vpos = _read_levels(data, page_start, n_vals)
+                    vals = _plain_decode(
+                        cols[ci].physical, data[vpos:page_start + page_len],
+                        int(valid.sum()),
+                    )
+                else:
+                    valid = None
+                    vals = _plain_decode(
+                        cols[ci].physical,
+                        data[page_start:page_start + page_len], n_vals,
+                    )
+                chunks[ci].append((valid, vals))
+                n_remaining -= n_vals
+                pos = page_start + page_len
+    for ci, col in enumerate(cols):
+        parts = chunks[ci]
+        if col.physical == T_BYTE_ARRAY:
+            dense: List[bytes] = []
+            for _, v in parts:
+                dense.extend(v)
+        else:
+            dense = (
+                np.concatenate([v for _, v in parts])
+                if parts
+                else np.zeros(0)
+            )
+        if col.valid is not None:
+            valid = (
+                np.concatenate([v for v, _ in parts])
+                if parts
+                else np.zeros(0, bool)
+            )
+            # re-expand to row positions (nulls get placeholder zeros)
+            if col.physical == T_BYTE_ARRAY:
+                out: List[bytes] = []
+                it = iter(dense)
+                for ok in valid:
+                    out.append(next(it) if ok else b"")
+                col.values = out
+            else:
+                full = np.zeros(len(valid), dtype=dense.dtype)
+                full[valid] = dense
+                col.values = full
+            col.valid = valid
+        else:
+            col.values = dense
+    return cols, num_rows
