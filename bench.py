@@ -24,9 +24,11 @@ Env knobs:
   BENCH_RUNS=N     -> steady-state repetitions (default 3)
   BENCH_SKIP_CPU=1 -> skip the CPU-subprocess baseline
   BENCH_SF_LARGE=N -> scale factor for the large configs (default 10)
-  BENCH_DEADLINE=N -> global wall budget in seconds (default 900);
+  BENCH_DEADLINE=N -> global wall budget in seconds (default 2700);
                       remaining configs are skipped when short, SF-large
-                      CPU baselines first
+                      CPU baselines first (the driver's own timeout can
+                      land anytime — the last emitted line always holds
+                      the best complete result)
 """
 
 from __future__ import annotations
@@ -176,7 +178,13 @@ def _make_runner(sf: float, table_columns):
             [ColumnMetadata(n, types[n]) for n in cols],
             arrays, None, dicts,
         )
-    r = LocalQueryRunner(Session(catalog="memory", schema="bench"))
+    # BENCH_BATCH_ROWS exists for batch-size experiments; the default
+    # stays at the engine default because the driver's compile cache is
+    # warm for those shapes — a cold shape set could eat the budget
+    batch_rows = int(os.environ.get("BENCH_BATCH_ROWS", str(1 << 20)))
+    r = LocalQueryRunner(
+        Session(catalog="memory", schema="bench", batch_rows=batch_rows)
+    )
     r.register_catalog("memory", mem)
     return r
 
@@ -460,7 +468,11 @@ def main() -> None:
         return
 
     t_start = time.time()
-    deadline = float(os.environ.get("BENCH_DEADLINE", "900"))
+    # the driver applies its own outer timeout and the incremental
+    # emission keeps the last stdout line parseable whenever the kill
+    # lands — so the self-deadline is generous and merely orders work
+    # (device configs before CPU baselines, SF-large baselines last)
+    deadline = float(os.environ.get("BENCH_DEADLINE", "2700"))
     cfg_timeout = int(os.environ.get("BENCH_CONFIG_TIMEOUT", "1800"))
     cpu_timeout = int(os.environ.get("BENCH_CPU_TIMEOUT", "1800"))
     skip_cpu = os.environ.get("BENCH_SKIP_CPU") == "1"

@@ -144,10 +144,23 @@ def test_tpch_slice_roundtrips_through_parquet(tmp_path):
     assert got == want
 
 
-def test_unsupported_codec_fails_loud(tmp_path):
+def test_corruption_and_unsupported_fail_loud(tmp_path):
+    import struct
+
     p = str(tmp_path / "t.parquet")
     write_parquet(p, _sample_columns(), 10)
     raw = bytearray(open(p, "rb").read())
-    # corrupt: flip the footer length so the thrift parse lands mid-data
+    # corrupt the footer length: the thrift parse lands mid-data
+    bad = str(tmp_path / "bad.parquet")
+    raw2 = bytearray(raw)
+    raw2[-8:-4] = struct.pack("<I", 7)
+    open(bad, "wb").write(bytes(raw2))
     with pytest.raises(Exception):
+        read_parquet(bad)
+    # truncated magic
+    open(bad, "wb").write(bytes(raw[:-2]))
+    with pytest.raises(ValueError):
+        read_parquet(bad)
+    # missing file
+    with pytest.raises(OSError):
         read_parquet(p + ".missing")

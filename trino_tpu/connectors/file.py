@@ -127,6 +127,16 @@ def _parquet_type(c) -> T.DataType:
     if c.physical == PQ.T_DOUBLE:
         return T.DOUBLE
     if c.physical == PQ.T_BYTE_ARRAY:
+        if c.converted not in (None, PQ.C_UTF8):
+            raise ValueError(
+                f"unsupported BYTE_ARRAY converted type {c.converted}"
+            )
+        if c.converted is None:
+            # raw VARBINARY has no engine representation yet
+            raise ValueError(
+                "BYTE_ARRAY without UTF8 annotation (varbinary) is not"
+                " supported"
+            )
         return T.VARCHAR
     raise ValueError(f"unsupported parquet physical type {c.physical}")
 
@@ -171,8 +181,14 @@ def _to_parquet_column(cm, data, valid, dictionary):
         return PQ.ParquetColumn(cm.name, PQ.T_DOUBLE,
                                 values=np.asarray(data, np.float64),
                                 valid=valid)
-    return PQ.ParquetColumn(cm.name, PQ.T_INT64,
-                            values=np.asarray(data, np.int64), valid=valid)
+    if t.kind in (T.TypeKind.BIGINT, T.TypeKind.TINYINT,
+                  T.TypeKind.SMALLINT):
+        # narrow ints widen to INT64 (parquet has no INT8/16 physical);
+        # they read back as BIGINT — documented widening, not drift
+        return PQ.ParquetColumn(cm.name, PQ.T_INT64,
+                                values=np.asarray(data, np.int64),
+                                valid=valid)
+    raise ValueError(f"cannot write {t} to parquet")
 
 
 def _parse_cell(text: str, t: T.DataType):
@@ -390,10 +406,16 @@ class _FileStore:
         valid: Dict[str, Optional[np.ndarray]] = {}
         dicts: Dict[str, Optional[Dictionary]] = {}
         n = sum(nr for _, nr in per_file)
+        first_sig = [(c.name, c.physical, c.converted) for c in first_cols]
+        for cols_f, _ in per_file[1:]:
+            sig = [(c.name, c.physical, c.converted) for c in cols_f]
+            if sig != first_sig:
+                raise ValueError(
+                    f"schema mismatch across parquet parts: {sig} vs"
+                    f" {first_sig}"
+                )
         for i, cm in enumerate(columns):
             parts = [cols[i] for cols, _ in per_file]
-            if any(p.name != cm.name for p in parts):
-                raise ValueError("schema mismatch across parquet parts")
             valids = [
                 p.valid
                 if p.valid is not None
@@ -694,6 +716,12 @@ class ParquetPageSink(ConnectorPageSink):
         self.handle = handle
         self.rows = 0
         d = os.path.join(store.root, handle.schema, handle.table)
+        for ext in (".parquet", ".csv", ".jsonl"):
+            if os.path.isfile(d + ext):
+                raise ValueError(
+                    "single-file tables are read-only; CREATE the table"
+                    " to get a multi-part directory"
+                )
         os.makedirs(d, exist_ok=True)
         part = uuid.uuid4().hex[:12]
         self._final = os.path.join(d, f"part-{part}.parquet")

@@ -12,7 +12,8 @@ Value model: every expression evaluates to ``(data, valid)`` where
 ``valid=None`` means all-valid — mirroring Block's mayHaveNull fast path.
 SQL three-valued logic is implemented in the and/or/not lowerings.
 
-Known deviation from Trino: division by zero yields NULL instead of
+Known deviation from Trino: CONSTANT zero divisors error at bind time
+(DIVISION_BY_ZERO); a data-dependent zero divisor yields NULL instead of
 raising USER_ERROR (data-dependent errors can't abort an XLA program;
 an error-flag sideband is the planned extension).
 """
@@ -316,8 +317,17 @@ class ExprBinder:
     # ---- CASE ----
     def _bind_case(self, e: Case) -> Bound:
         conds = [self.bind(c) for c in e.conds]
-        results = [self.bind(r) for r in e.results]
-        default = self.bind(e.default) if e.default is not None else None
+        # branches may never be selected: bind-time constant errors
+        # (division by zero) must not fail the whole query for a branch
+        # a FALSE condition guards (Trino defers constant-folding errors
+        # to branch evaluation) — inside branches the constant-zero
+        # check degrades to the runtime NULL behavior
+        self._in_branch = getattr(self, "_in_branch", 0) + 1
+        try:
+            results = [self.bind(r) for r in e.results]
+            default = self.bind(e.default) if e.default is not None else None
+        finally:
+            self._in_branch -= 1
         # unify string results onto one dictionary
         out_dict = None
         if e.type.is_string:
@@ -2127,6 +2137,11 @@ class ExprBinder:
             "sub": lambda x, y: x - y,
             "mul": lambda x, y: x * y,
         }.get(op)
+        if op in ("div", "mod") and b.is_const and b.const_value == 0 \
+                and not out_type.is_floating \
+                and not getattr(self, "_in_branch", 0):
+            raise ValueError("Division by zero")
+
         def fn(cols, valids):
             ad, av = a.fn(cols, valids)
             bd, bv = b.fn(cols, valids)
@@ -2156,6 +2171,9 @@ class ExprBinder:
         return Bound(out_type, fn)
 
     def _bind_decimal_arith(self, op: str, out_type: T.DataType, a: Bound, b: Bound) -> Bound:
+        if op in ("div", "mod") and b.is_const and b.const_value == 0 \
+                and not getattr(self, "_in_branch", 0):
+            raise ValueError("Division by zero")
         sa = a.type.scale or 0 if a.type.is_decimal else 0
         sb = b.type.scale or 0 if b.type.is_decimal else 0
         so = out_type.scale or 0
