@@ -21,6 +21,8 @@ from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+# jax.shard_map (v0.8+) drops check_rep; keep the experimental
+# import until the new API's replication checking is adopted
 from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as PSpec
 
