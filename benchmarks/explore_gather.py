@@ -1,0 +1,73 @@
+"""Primitive exploration for the hash-probe redesign (round 4).
+
+Measures candidate TPU primitives for "N random lookups into a B-row
+table" — the inner op of a hash-join probe — to pick the design for
+ops/join.py. Run on the real device; prints one JSON line per probe.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from benchmarks.micro import _measure  # noqa: E402
+
+
+def report(name, rows, secs):
+    ms = secs * 1e3
+    print(json.dumps({"bench": name, "rows": rows, "ms": round(ms, 3),
+                      "gb_s": round(rows * 8 / secs / 1e9, 2)}), flush=True)
+
+
+def main():
+    N = 1 << 20
+    rng = np.random.default_rng(0)
+
+    for B in (1 << 17, 1 << 20):
+        table64 = jnp.asarray(rng.integers(0, 1 << 60, B).astype(np.int64))
+        table32 = jnp.asarray(rng.integers(0, 1 << 30, B).astype(np.int32))
+        idx = jnp.asarray(rng.integers(0, B, N).astype(np.int32))
+
+        f = jax.jit(lambda t, i: jnp.take(t, i, axis=0))
+        report(f"take_i64_B{B}", N, _measure(f, table64, idx))
+        report(f"take_i32_B{B}", N, _measure(f, table32, idx))
+
+        # chained dependent gathers (open-addressing simulation: 2 rounds)
+        def chain(t, i):
+            a = jnp.take(t, i, axis=0)
+            i2 = (i + (a & 7).astype(jnp.int32)) % B
+            return jnp.take(t, i2, axis=0)
+        report(f"take_chain2_i32_B{B}", N, _measure(jax.jit(chain), table32, idx))
+
+        # scatter-add (group-by accumulate analogue)
+        def scat(t, i):
+            return jnp.zeros(B, jnp.int32).at[i].add(t_probe32)
+        t_probe32 = jnp.asarray(rng.integers(0, 100, N).astype(np.int32))
+        report(f"scatter_add_B{B}", N, _measure(jax.jit(scat), table32, idx))
+
+    # sorts for reference
+    k64 = jnp.asarray(rng.integers(0, 1 << 60, N).astype(np.int64))
+    k32 = jnp.asarray(rng.integers(0, 1 << 30, N).astype(np.int32))
+    report("sort_i64_1M", N, _measure(jax.jit(jnp.sort), k64))
+    report("sort_i32_1M", N, _measure(jax.jit(jnp.sort), k32))
+    v32 = jnp.asarray(rng.integers(0, 1 << 30, N).astype(np.int32))
+    f2 = jax.jit(lambda k, v: jax.lax.sort((k, v), num_keys=1))
+    report("sortkv_i32_1M", N, _measure(f2, k32, v32))
+
+    # searchsorted 1M into 128k (XLA native)
+    ss_tab = jnp.sort(jnp.asarray(rng.integers(0, 1 << 60, 1 << 17).astype(np.int64)))
+    q = jnp.asarray(rng.integers(0, 1 << 60, N).astype(np.int64))
+    f3 = jax.jit(lambda t, x: jnp.searchsorted(t, x))
+    report("searchsorted_1M_into_128k", N, _measure(f3, ss_tab, q))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,146 @@
+"""Isolate Mosaic construct costs for the merge kernel redesign."""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import trino_tpu.jaxcfg  # noqa: F401,E402
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from benchmarks.devtime import devtime  # noqa: E402
+
+N = 1 << 20
+GRID = N // 1024
+
+
+def run(tag, fn, *args):
+    try:
+        out = fn(*args)
+        jax.block_until_ready(out)
+        print(tag, round(devtime(fn, *args) * 1e3, 3), "ms", flush=True)
+    except Exception as e:  # noqa: BLE001
+        print(tag, "FAILED:", type(e).__name__, str(e)[:200], flush=True)
+
+
+def main():
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    rng = np.random.default_rng(0)
+    at = jnp.asarray(rng.integers(0, 1 << 30, (GRID * 128, 8)).astype(np.int32))
+    b2 = jnp.asarray(rng.integers(0, 1 << 30, (1024, 128)).astype(np.int32))
+
+    def call(kernel, nscratch=0):
+        scr = [pltpu.SMEM((2,), jnp.int32)] + [
+            pltpu.VMEM((128, 8), jnp.int32) for _ in range(nscratch)
+        ]
+        def f(at, b2):
+            with jax.enable_x64(False):
+                return pl.pallas_call(
+                    kernel,
+                    grid=(GRID,),
+                    in_specs=[
+                        pl.BlockSpec((128, 8), lambda i: (i, 0),
+                                     memory_space=pltpu.VMEM),
+                        pl.BlockSpec(b2.shape, lambda i: (0, 0),
+                                     memory_space=pltpu.VMEM),
+                    ],
+                    out_specs=pl.BlockSpec((128, 8), lambda i: (i, 0),
+                                           memory_space=pltpu.VMEM),
+                    out_shape=jax.ShapeDtypeStruct(at.shape, jnp.int32),
+                    scratch_shapes=scr,
+                )(at, b2)
+        return f
+
+    # V0: 2 static windows, static slices, unrolled — the floor
+    def k0(a_ref, b_ref, o_ref, cur):
+        acc = jnp.zeros((128, 8), jnp.int32)
+        for w in range(2):
+            b_win = b_ref[w : w + 1, :]
+            cols = []
+            for c in range(8):
+                a_col = a_ref[:, c : c + 1]
+                cols.append(acc[:, c : c + 1] + jnp.sum(
+                    (b_win < a_col).astype(jnp.int32), axis=1,
+                    keepdims=True, dtype=jnp.int32))
+            acc = jnp.concatenate(cols, axis=1)
+        o_ref[:, :] = acc
+    run("v0 2win static unrolled", call(k0), at, b2)
+
+    # V1: 2 windows via fori_loop with STATIC bound, dynamic pl.ds
+    def k1(a_ref, b_ref, o_ref, cur):
+        def body(w, acc):
+            b_win = b_ref[pl.ds(w, 1), :]
+            cols = []
+            for c in range(8):
+                a_col = a_ref[:, c : c + 1]
+                cols.append(acc[:, c : c + 1] + jnp.sum(
+                    (b_win < a_col).astype(jnp.int32), axis=1,
+                    keepdims=True, dtype=jnp.int32))
+            acc = jnp.concatenate(cols, axis=1)
+            return acc
+        acc = jax.lax.fori_loop(0, 2, body, jnp.zeros((128, 8), jnp.int32))
+        o_ref[:, :] = acc
+    run("v1 2win fori dynamic-ds", call(k1), at, b2)
+
+    # V2: 2 windows, fori with DYNAMIC bound from SMEM scalar
+    def k2(a_ref, b_ref, o_ref, cur):
+        @pl.when(pl.program_id(0) == 0)
+        def _():
+            cur[0] = jnp.int32(0)
+        def body(w, acc):
+            b_win = b_ref[pl.ds(w, 1), :]
+            cols = []
+            for c in range(8):
+                a_col = a_ref[:, c : c + 1]
+                cols.append(acc[:, c : c + 1] + jnp.sum(
+                    (b_win < a_col).astype(jnp.int32), axis=1,
+                    keepdims=True, dtype=jnp.int32))
+            acc = jnp.concatenate(cols, axis=1)
+            return acc
+        end = cur[0] + jnp.int32(2)
+        acc = jax.lax.fori_loop(cur[0], end, body,
+                                jnp.zeros((128, 8), jnp.int32))
+        o_ref[:, :] = acc
+    run("v2 2win fori smem-bound", call(k2), at, b2)
+
+    # V3: V0 + 2 scalar VMEM reads per window (the while-cond pattern)
+    def k3(a_ref, b_ref, o_ref, cur):
+        acc = jnp.zeros((128, 8), jnp.int32)
+        t = jnp.int32(0)
+        for w in range(2):
+            t = t + b_ref[w, 0] + b_ref[w, 127]
+            b_win = b_ref[w : w + 1, :]
+            cols = []
+            for c in range(8):
+                a_col = a_ref[:, c : c + 1]
+                cols.append(acc[:, c : c + 1] + jnp.sum(
+                    (b_win < a_col).astype(jnp.int32), axis=1,
+                    keepdims=True, dtype=jnp.int32))
+            acc = jnp.concatenate(cols, axis=1)
+        o_ref[:, :] = acc + t
+    run("v3 2win + scalar vmem reads", call(k3), at, b2)
+
+    # V4: bigger window: 8 static window rows (1024 B elems), unrolled
+    def k4(a_ref, b_ref, o_ref, cur):
+        acc = jnp.zeros((128, 8), jnp.int32)
+        for w in range(8):
+            b_win = b_ref[w : w + 1, :]
+            cols = []
+            for c in range(8):
+                a_col = a_ref[:, c : c + 1]
+                cols.append(acc[:, c : c + 1] + jnp.sum(
+                    (b_win < a_col).astype(jnp.int32), axis=1,
+                    keepdims=True, dtype=jnp.int32))
+            acc = jnp.concatenate(cols, axis=1)
+        o_ref[:, :] = acc
+    run("v4 8win static unrolled", call(k4), at, b2)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,139 @@
+"""Can Pallas TPU gather from a VMEM-resident table at speed?
+
+Tests lowering + throughput of candidate in-kernel gather formulations
+for the bucket-hash probe. Each variant: 1M lookups into a 128K table.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import traceback
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.experimental import pallas as pl  # noqa: E402
+from jax.experimental.pallas import tpu as pltpu  # noqa: E402
+
+from benchmarks.micro import _measure  # noqa: E402
+
+N = 1 << 20
+B = 1 << 17
+TILE = 2048  # probe rows per grid step
+
+
+def report(name, secs):
+    ms = secs * 1e3
+    print(json.dumps({"bench": name, "ms": round(ms, 3),
+                      "gb_s": round(N * 8 / secs / 1e9, 2)}), flush=True)
+
+
+def try_variant(name, fn, *args):
+    try:
+        out = fn(*args)
+        jax.block_until_ready(out)
+        report(name, _measure(fn, *args))
+        return np.asarray(jax.tree_util.tree_leaves(out)[0])
+    except Exception:
+        print(f"{name}: FAILED", flush=True)
+        traceback.print_exc()
+        return None
+
+
+def main():
+    rng = np.random.default_rng(0)
+    table = jnp.asarray(rng.integers(0, 1 << 30, B).astype(np.int32))
+    idx = jnp.asarray(rng.integers(0, B, N).astype(np.int32))
+    table2d = table.reshape(B // 128, 128)
+    idx2d = idx.reshape(N // TILE, TILE)
+
+    # V1: flat take inside kernel, full table in VMEM
+    def k1(tab_ref, idx_ref, out_ref):
+        out_ref[:] = jnp.take(tab_ref[:].reshape(-1), idx_ref[:].reshape(-1),
+                              axis=0).reshape(out_ref.shape)
+
+    def v1(tab, ix):
+        return pl.pallas_call(
+            k1,
+            grid=(N // TILE,),
+            in_specs=[
+                pl.BlockSpec((B // 128, 128), lambda i: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, TILE), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((1, TILE), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((N // TILE, TILE), jnp.int32),
+        )(tab, ix)
+
+    got = try_variant("pallas_take_flat", jax.jit(v1), table2d, idx2d)
+    if got is not None:
+        want = np.asarray(table)[np.asarray(idx)].reshape(N // TILE, TILE)
+        print("correct:", bool((got == want).all()), flush=True)
+
+    # V2: row gather: table2d[idx_rows] via take axis=0 (128-wide rows)
+    ROWT = 512
+    ridx = jnp.asarray(rng.integers(0, B // 128, N).astype(np.int32))
+    ridx2d = ridx.reshape(N // ROWT, ROWT)
+
+    def k2(tab_ref, idx_ref, out_ref):
+        rows = jnp.take(tab_ref[:], idx_ref[0, :], axis=0)  # (ROWT,128)
+        out_ref[0, :] = jnp.sum(rows, axis=1)
+
+    def v2(tab, ix):
+        return pl.pallas_call(
+            k2,
+            grid=(N // ROWT,),
+            in_specs=[
+                pl.BlockSpec((B // 128, 128), lambda i: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, ROWT), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((1, ROWT), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((N // ROWT, ROWT), jnp.int32),
+        )(tab, ix)
+
+    got = try_variant("pallas_take_rows128", jax.jit(v2), table2d, ridx2d)
+    if got is not None:
+        want = np.asarray(table2d)[np.asarray(ridx)].sum(axis=1).reshape(
+            N // ROWT, ROWT)
+        print("correct:", bool((got == want).all()), flush=True)
+
+    # V3: take_along_axis within lanes: per-row gather from its own
+    # 128-wide row (the two-level decomposition needs this)
+    val = jnp.asarray(rng.integers(0, 128, (N // 128, 128)).astype(np.int32))
+    src = jnp.asarray(rng.integers(0, 1 << 30, (N // 128, 128)).astype(np.int32))
+
+    def k3(src_ref, sel_ref, out_ref):
+        out_ref[:] = jnp.take_along_axis(src_ref[:], sel_ref[:], axis=1)
+
+    def v3(s, sel):
+        return pl.pallas_call(
+            k3,
+            grid=(N // 128 // 64,),
+            in_specs=[
+                pl.BlockSpec((64, 128), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((64, 128), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((64, 128), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((N // 128, 128), jnp.int32),
+        )(s, sel)
+
+    got = try_variant("pallas_take_along_lanes", jax.jit(v3), src, val)
+    if got is not None:
+        want = np.take_along_axis(np.asarray(src), np.asarray(val), axis=1)
+        print("correct:", bool((got == want).all()), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -87,7 +87,7 @@ def test_mesh_program_contains_collective():
     from trino_tpu import types as T
     from trino_tpu.block import Column, RelBatch
     from trino_tpu.parallel.mesh_plan import AXIS, _exchange_hash
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     devs = jax.devices()
     mesh = Mesh(np.array(devs), (AXIS,))
@@ -105,7 +105,7 @@ def test_mesh_program_contains_collective():
 
     f = shard_map(
         body, mesh=mesh, in_specs=(PSpec(AXIS),), out_specs=PSpec(AXIS),
-        check_rep=False,
+        check_vma=False,
     )
     jaxpr = jax.make_jaxpr(f)(jnp.arange(16 * n, dtype=jnp.int64))
     assert "all_to_all" in str(jaxpr)

@@ -619,7 +619,38 @@ class RelBatch:
         return RelBatch(self.columns, live)
 
     def gather(self, positions: jnp.ndarray, positions_live=None) -> "RelBatch":
-        cols = [c.gather(positions) for c in self.columns]
+        """Batch-wide position copy. Random gathers cost ~16 device
+        cycles PER ELEMENT on TPU (measured r4: 16.5ms/M for int64,
+        index pattern irrelevant), so the validity masks of all flat
+        columns are packed into ONE int32 bitmask and gathered once
+        instead of one bool gather per nullable column."""
+        flat_nullable = [
+            i for i, c in enumerate(self.columns)
+            if c.valid is not None and not c.type.is_nested
+            # consolidation paths carry mixed-capacity columns; only
+            # full-capacity ones can share the packed mask + positions
+            and c.data.shape[0] == self.capacity
+            and c.valid.shape[0] == self.capacity
+        ]
+        if len(flat_nullable) < 2 or len(flat_nullable) > 32:
+            cols = [c.gather(positions) for c in self.columns]
+            return RelBatch(cols, positions_live)
+        pos = jnp.clip(positions, 0, self.capacity - 1)
+        bitpos = {i: k for k, i in enumerate(flat_nullable)}
+        bits = None
+        for i, k in bitpos.items():
+            b = self.columns[i].valid.astype(jnp.int32) << k
+            bits = b if bits is None else (bits | b)
+        gbits = jnp.take(bits, pos)
+        cols = []
+        for i, c in enumerate(self.columns):
+            k = bitpos.get(i)
+            if k is not None:
+                data = jnp.take(c.data, pos)
+                valid = (gbits >> k) & 1 != 0
+                cols.append(Column(c.type, data, valid, c.dictionary))
+            else:
+                cols.append(c.gather(positions))
         return RelBatch(cols, positions_live)
 
     def compact(self) -> "RelBatch":

@@ -251,23 +251,28 @@ def _limit_batch(batch: RelBatch, skip: jnp.ndarray, remaining: jnp.ndarray):
 
 class LimitOperator(Operator):
     """LIMIT n OFFSET k (LimitOperator.java): masks rows outside the
-    remaining window."""
+    remaining window. The skip/remaining counters live ON DEVICE —
+    reading them back per batch would cost a full tunnel round trip
+    (~130ms measured); the cost is only that the operator cannot
+    early-terminate its upstream, which engine sources bound anyway."""
 
     def __init__(self, n: Optional[int], offset: int = 0):
-        self._remaining = n if n is not None else (1 << 60)
-        self._skip = offset
+        self._skip = None  # device scalars, lazily initialized
+        self._remaining = None
+        self._init = (n if n is not None else (1 << 60), offset)
         self._out: Optional[RelBatch] = None
 
     def needs_input(self) -> bool:
-        return self._out is None and self._remaining > 0 and not self._finishing
+        return self._out is None and not self._finishing
 
     def add_input(self, batch: RelBatch) -> None:
-        out, skipped, taken = _limit_batch(
-            batch, jnp.int64(self._skip), jnp.int64(self._remaining)
-        )
-        skipped, taken = jax.device_get((skipped, taken))  # one round trip
-        self._skip -= int(skipped)
-        self._remaining -= int(taken)
+        if self._remaining is None:
+            n, offset = self._init
+            self._remaining = jnp.int64(n)
+            self._skip = jnp.int64(offset)
+        out, skipped, taken = _limit_batch(batch, self._skip, self._remaining)
+        self._skip = self._skip - skipped
+        self._remaining = self._remaining - taken
         self._out = out
 
     def get_output(self) -> Optional[RelBatch]:
@@ -275,7 +280,7 @@ class LimitOperator(Operator):
         return out
 
     def is_finished(self) -> bool:
-        return self._out is None and (self._finishing or self._remaining <= 0)
+        return self._out is None and self._finishing
 
 
 # ---------------------------------------------------------------------------
@@ -1049,6 +1054,9 @@ class HashAggregationOperator(Operator):
         # device program at the next materialization point
         self._acc = None
         self._pending: List[tuple] = []
+        # deferred per-batch overflow records: (pending index, device
+        # ovf flag, device ngroups, retained input batch, capacity)
+        self._pending_meta: List[tuple] = []
         self._gstate = None
         self._out: Optional[RelBatch] = None
         # spill support (SpillableHashAggregationBuilder analogue):
@@ -1188,44 +1196,79 @@ class HashAggregationOperator(Operator):
                 self._gstate = self._global_init()
             self._gstate = self._update(self._gstate, batch)
             return
-        while True:
-            # a batch can never have more groups than rows, so the
-            # per-batch table caps at the batch capacity regardless of
-            # how large the operator's table has grown (an oversized
-            # per-batch cap multiplies every state array for nothing).
-            # The dense/MXU paths are exempt: they address slots by
-            # mixed-radix position, so the table must hold the FULL
-            # domain even when the batch has fewer rows than slots.
-            if self._dense_dims is not None or self._mxu_dims is not None:
-                cap = self._cap
-            else:
-                cap = min(self._cap, bucket_capacity(batch.capacity))
+        # a batch can never have more groups than rows, so the
+        # per-batch table caps at the batch capacity regardless of
+        # how large the operator's table has grown (an oversized
+        # per-batch cap multiplies every state array for nothing).
+        # The dense/MXU paths are exempt: they address slots by
+        # mixed-radix position, so the table must hold the FULL
+        # domain even when the batch has fewer rows than slots.
+        if self._dense_dims is not None or self._mxu_dims is not None:
+            cap = self._cap
+        else:
+            cap = min(self._cap, bucket_capacity(batch.capacity))
+        gk, gv, used, vals, cnts, ngroups, ovf = _agg_ingest(
+            batch, tuple(self._group_channels), tuple(self._aggs),
+            cap, self._pre, self._dense_dims, self._mxu_dims,
+        )
+        new = (tuple(gk), tuple(gv), used, tuple(vals), tuple(cnts))
+        if self._static_bound is not None:
+            # overflow impossible by the plan-time bound: defer the
+            # flag and verify ONCE at finish (fail-loud guard against
+            # a runtime dictionary outgrowing the plan-time one)
+            self._deferred_ovf.append(ovf)
+            with self._state_lock:
+                self._pending.append(new)
+        else:
+            # Deferred rehash: reading `ovf` here costs a ~130ms tunnel
+            # round trip PER BATCH. The flag + group count start an
+            # async host copy now and are READ one batch later (depth-1
+            # pipeline: the copy overlaps the next batch's upstream
+            # device work), so an overflow replays immediately at the
+            # true group count (the tryRehash analogue) and grows
+            # self._cap for the batches that follow.
+            for scalar in (ovf, ngroups):
+                try:
+                    scalar.copy_to_host_async()
+                except AttributeError:
+                    pass
+            with self._state_lock:
+                self._pending.append(new)
+                self._pending_meta.append(
+                    (len(self._pending) - 1, ovf, ngroups, batch, cap)
+                )
+                while len(self._pending_meta) > 1:
+                    self._resolve_one_locked()
+        self._track_memory()
+
+    def _resolve_one_locked(self) -> None:
+        """Settle the OLDEST deferred per-batch overflow record; its
+        flag has been copying to the host since ingest (caller holds
+        _state_lock). The flag also covers sort_group_reduce's 62-bit
+        hash-collision detector, so the replay LOOPS (capacity doubling
+        reseeds via _order_seed) until it comes back clean — same
+        semantics as the old per-batch retry ladder."""
+        idx, ovf, ngroups, batch, cap = self._pending_meta.pop(0)
+        while bool(ovf):
+            cap = max(cap * 2, bucket_capacity(int(ngroups)))
+            self._cap = max(self._cap, cap)
             gk, gv, used, vals, cnts, ngroups, ovf = _agg_ingest(
                 batch, tuple(self._group_channels), tuple(self._aggs),
                 cap, self._pre, self._dense_dims, self._mxu_dims,
             )
-            if self._static_bound is not None:
-                # overflow impossible by the plan-time bound: defer the
-                # flag and verify ONCE at finish (fail-loud guard against
-                # a runtime dictionary outgrowing the plan-time one)
-                self._deferred_ovf.append(ovf)
-                break
-            if not bool(ovf):
-                break
-            # rebuild-at-larger-capacity (tryRehash analogue); the exact
-            # group count is known, so jump straight there — a x2 ladder
-            # would compile one XLA program per rung
-            self._cap = max(
-                self._cap * 2, bucket_capacity(int(ngroups))
+            self._pending[idx] = (
+                tuple(gk), tuple(gv), used, tuple(vals), tuple(cnts)
             )
-        new = (tuple(gk), tuple(gv), used, tuple(vals), tuple(cnts))
-        with self._state_lock:
-            self._pending.append(new)
-        self._track_memory()
+
+    def _resolve_pending_locked(self) -> None:
+        """Drain every deferred overflow record (merge points)."""
+        while self._pending_meta:
+            self._resolve_one_locked()
 
     def _merge_pending_locked(self) -> None:
         """Fold _pending (+ current acc) into ONE merged state with a
         single N-way device program (caller holds _state_lock)."""
+        self._resolve_pending_locked()
         states = ([self._acc] if self._acc is not None else []) + self._pending
         self._pending = []
         if not states:
@@ -1522,11 +1565,16 @@ class HashAggregationOperator(Operator):
         time) is the next step toward bounding finish too."""
         if self._memory is None or self._in_finish:
             return
+        from trino_tpu.runtime.memory import batch_bytes
+
         total = 0
         for st in ([self._acc] if self._acc is not None else []) + list(self._pending):
             gk, gv, used, vals, cnts = st
             for arr in [*gk, *gv, used, *vals, *cnts]:
                 total += arr.size * arr.dtype.itemsize
+        # the depth-1 deferred-rehash queue retains one input batch
+        for _, _, _, b, _ in self._pending_meta:
+            total += batch_bytes(b)
         try:
             self._memory.set_bytes(total)
         except Exception:
@@ -1845,13 +1893,32 @@ class HashBuildSink(Operator):
         return self._finishing
 
 
-@partial(jax.jit, static_argnames=("out_cap",))
-def _expand_pairs(ls, probe: RelBatch, build: RelBatch, keys, valids, lo, counts, out_cap: int):
+@partial(jax.jit, static_argnames=("out_cap", "pkc", "bkc"))
+def _expand_pairs(ls, probe: RelBatch, build: RelBatch, keys, valids,
+                  lo, counts, out_cap: int, pkc=None, bkc=None):
     """Expansion + pair gather in one device program (JoinProbe +
-    LookupJoinPageBuilder fused — join/LookupJoinOperator.java:36)."""
-    pi, bi, ok = J.expand_matches(ls, keys, valids, lo, counts, out_cap)
-    cols = [c.gather(pi) for c in probe.columns]
-    cols += [c.gather(bi) for c in build.columns]
+    LookupJoinPageBuilder fused — join/LookupJoinOperator.java:36).
+
+    When the join keys are plain pass-through columns (pkc/bkc name
+    them in the probe/build schemas), the hash-collision verify runs on
+    the GATHERED pair columns — the expansion would gather them anyway,
+    so the separate per-key verify gathers disappear."""
+    on_pairs = pkc is not None
+    pi, bi, ok = J.expand_matches(
+        ls, keys, valids, lo, counts, out_cap, verify=not on_pairs
+    )
+    pairs_probe = probe.gather(pi)
+    pairs_build = build.gather(bi)
+    if on_pairs:
+        for pc, bc in zip(pkc, bkc):
+            a = pairs_probe.columns[pc]
+            b = pairs_build.columns[bc]
+            ok = ok & (a.data == b.data)
+            if a.valid is not None:
+                ok = ok & a.valid
+            if b.valid is not None:
+                ok = ok & b.valid
+    cols = list(pairs_probe.columns) + list(pairs_build.columns)
     return pi, bi, ok, RelBatch(cols, ok)
 
 
@@ -1955,6 +2022,16 @@ class LookupJoinOperator(Operator):
         # FULL outer: build-side matched bitmap accumulated across probe
         # batches; unmatched build rows emit at finish (LookupOuter)
         self._build_matched = None
+        # Pipelined expansion (the per-batch `int(total)` host read
+        # costs a full ~130ms tunnel round trip on remote-attached
+        # TPUs — measured to dominate TPC-H SF10 wall time): batch i's
+        # match total starts copying to the host the moment its count
+        # pass is dispatched, and is only READ when batch i+1 arrives —
+        # by then the copy has overlapped with the next batch's
+        # upstream device work, so the expansion still gets its EXACT
+        # bucketed capacity (small outputs stay small) without a
+        # blocking round trip per batch.
+        self._probe_pending: List[dict] = []
 
     def needs_input(self) -> bool:
         return not self._outputs and not self._finishing
@@ -1978,6 +2055,7 @@ class LookupJoinOperator(Operator):
 
     def _probe_one(self, ls, build, key_dicts, probe: RelBatch) -> None:
         keys = []
+        remapped = False
         for i, c in enumerate(self._keys):
             col = probe.columns[c]
             build_dict = key_dicts[i] if key_dicts else None
@@ -2001,15 +2079,40 @@ class LookupJoinOperator(Operator):
                 keys.append(
                     take_clip(remap, col.data)
                 )
+                remapped = True
             else:
                 keys.append(col.data)
         valids = [probe.columns[c].valid_mask() for c in self._keys]
         live = probe.live_mask()
         lo, counts, total = J.probe_counts(ls, keys, valids, live)
-        total = int(total)
-        out_cap = bucket_capacity(max(total, 1))
+        try:
+            total.copy_to_host_async()
+        except AttributeError:
+            pass
+        self._probe_pending.append({
+            "ls": ls, "build": build, "probe": probe, "keys": keys,
+            "valids": valids, "lo": lo, "counts": counts, "total": total,
+            "remapped": remapped,
+        })
+        # depth-1 pipeline: settle the PREVIOUS batch — its total has
+        # been in flight while this batch's upstream ran on device
+        while len(self._probe_pending) > 1:
+            self._expand_oldest()
+
+    def _expand_oldest(self) -> None:
+        rec = self._probe_pending.pop(0)
+        ls, build, probe = rec["ls"], rec["build"], rec["probe"]
+        out_cap = bucket_capacity(max(int(rec["total"]), 1))
+        # pair-column verify only when every key is a pass-through
+        # column (a dictionary remap substitutes codes the pair batch
+        # does not carry)
+        pkc = bkc = None
+        if not rec.get("remapped") and self._bridge.build_key_channels:
+            pkc = tuple(self._keys)
+            bkc = tuple(self._bridge.build_key_channels)
         pi, bi, ok, pairs = _expand_pairs(
-            ls, probe, build, keys, valids, lo, counts, out_cap
+            ls, probe, build, rec["keys"], rec["valids"],
+            rec["lo"], rec["counts"], out_cap, pkc=pkc, bkc=bkc,
         )
         if self._residual_fn is not None:
             ok = ok & self._residual_fn(pairs)
@@ -2017,7 +2120,7 @@ class LookupJoinOperator(Operator):
         if self._type == "inner":
             self._outputs.append(pairs)
             return
-        matched = _segment_any(counts, pi, ok, probe.capacity)
+        matched = _segment_any(rec["counts"], pi, ok, probe.capacity)
         if self._type == "semi":
             self._outputs.append(probe.mask(matched))
             return
@@ -2037,10 +2140,16 @@ class LookupJoinOperator(Operator):
             return
         raise NotImplementedError(self._type)
 
+    def _resolve_spec(self) -> None:
+        """Drain every pending probe batch (finish / partition end)."""
+        while self._probe_pending:
+            self._expand_oldest()
+
     def finish(self) -> None:
         if self._finishing:
             return
         self._finishing = True
+        self._resolve_spec()
         if self._bridge.grace is None:
             if self._type == "full":
                 build = self._bridge.build_batch
@@ -2084,6 +2193,7 @@ class LookupJoinOperator(Operator):
             self._build_matched = None
             for pg in probe_pages:
                 self._probe_one(ls, merged, key_dicts, pg.to_batch())
+            self._resolve_spec()
             if self._type == "full":
                 mb = (
                     self._build_matched

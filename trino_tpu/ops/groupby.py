@@ -735,60 +735,202 @@ def sort_group_reduce(
         sm = hs != _DEAD_ROW_HASH
         boundary = sm & (first | (hs != jnp.roll(hs, 1)))
         if extra:
+            # rows of one segment are adjacent after the sort, so "some
+            # row's independent stream differs from its segment's" ⟺
+            # "some adjacent pair inside a segment differs" — a dense
+            # roll+compare instead of _seg_first's scatter+gather
+            # (scatters cost ~117ms/M on this TPU)
             h2s = sorted_ops[num_keys + 1]
-            rep = _seg_first(boundary, h2s)
-            collision = jnp.any(sm & (h2s != rep))
+            collision = jnp.any(
+                sm & ~boundary & (h2s != jnp.roll(h2s, 1))
+            )
         else:
             collision = jnp.asarray(False)
-
-    starts, safe_starts, ends, used, n_groups, overflowed = (
-        _segment_geometry(boundary, n, out_capacity)
-    )
-    overflowed = overflowed | collision
 
     def sorted_payload(idx, col):
         if idx is not None:
             return sorted_ops[num_keys + idx]
         return take_clip(col, order)
 
-    # group key columns: read the SORTED key at each segment start —
-    # one capacity-sized gather per column, no permutation chase
-    if single_key:
-        if jnp.issubdtype(keys[0].dtype, jnp.floating):
-            # the sorted operand holds order-mapped BITS; recover the
-            # float through the row permutation instead
-            kvals = take_clip(keys[0], take_clip(order, safe_starts))
-        else:
-            kvals = take_clip(sorted_ops[1], safe_starts)
-        group_keys = [
-            jnp.where(used, kvals, jnp.zeros((), keys[0].dtype))
-        ]
-        group_valids = [
-            (take_clip(sorted_ops[0], safe_starts) == 0) & used
-        ]
-    else:
-        group_keys = []
-        group_valids = []
-        for i, (k, v) in enumerate(zip(keys, valids)):
-            sk_full = sorted_payload(carried_keys[i], k)
-            sv_full = sorted_payload(carried_kv[i], v)
-            group_keys.append(
-                jnp.where(
-                    used, take_clip(sk_full, safe_starts),
-                    jnp.zeros((), k.dtype),
-                )
-            )
-            group_valids.append(take_clip(sv_full, safe_starts) & used)
+    # -- boundary compaction + per-group extraction ------------------
+    # Large group counts (cap*4 > n) use CARRIED compaction: the
+    # boundary-position sort carries every per-group output value as a
+    # payload operand, so the cap-sized gathers of the gather path
+    # (~16.5ms per 1M gathered elements on this TPU — they dominated
+    # Q18's 1.5M-group aggregation) disappear. Segment sums ride as
+    # exclusive prefix sums whose shifted diff is the per-group total;
+    # non-boundary filler entries carry the grand total so the last
+    # group's diff closes correctly. Small caps keep the top_k + tiny
+    # gather path (a full multi-operand n-sort would cost more).
+    big_cap = out_capacity * 4 > n > 0
+    iota32 = jnp.arange(n, dtype=jnp.int32)
+    sidx = jnp.where(boundary, iota32, jnp.int32(n))
 
-    # per-segment live-row count straight from the geometry (no scan);
-    # the LAST segment's `ends` extends to n-1 past the dead tail, so
-    # clamp to the final live row
-    n_live = jnp.sum(sm.astype(jnp.int32))
-    seg_rows = jnp.where(
-        used,
-        (jnp.minimum(ends, n_live - 1) - safe_starts + 1).astype(jnp.int64),
-        0,
-    )
+    carry_cols: List[jnp.ndarray] = []
+    carry_totals: dict = {}
+
+    def carry(arr):
+        if arr.dtype == jnp.bool_:
+            arr = arr.astype(jnp.int8)
+        carry_cols.append(arr)
+        return len(carry_cols) - 1
+
+    def excl_carry(contrib):
+        """Exclusive cumsum at boundaries, grand total elsewhere."""
+        c = _fast_cumsum(contrib)
+        total = c[-1] if n else jnp.zeros((), contrib.dtype)
+        slot = carry(jnp.where(boundary, c - contrib, total))
+        carry_totals[slot] = total
+        return slot
+
+    plan: dict = {}
+    if big_cap:
+        if single_key:
+            plan["kb"] = carry(sorted_ops[1])
+            plan["cls"] = carry(sorted_ops[0].astype(jnp.int32))
+        else:
+            plan["mk"] = []
+            for i, (k, v) in enumerate(zip(keys, valids)):
+                plan["mk"].append((
+                    carry(sorted_payload(carried_keys[i], k)),
+                    carry(sorted_payload(carried_kv[i], v)),
+                ))
+        plan["rows"] = excl_carry(sm.astype(jnp.int64))
+        plan["vals"] = []
+        for i, (val, vv, red) in enumerate(
+            zip(values, value_valids, reducers)
+        ):
+            svv = None if vv is None else sorted_payload(carried_vv[i], vv)
+            w = sm if svv is None else (sm & svv)
+            cnt_slot = None if svv is None else excl_carry(w.astype(jnp.int64))
+            sum_slot = None
+            if red == "sum":
+                sv_ = sorted_payload(carried[i], val)
+                acc_dt = (
+                    jnp.float64
+                    if jnp.issubdtype(sv_.dtype, jnp.floating)
+                    else jnp.int64
+                )
+                contrib = jnp.where(
+                    w, sv_.astype(acc_dt), jnp.zeros((), acc_dt)
+                )
+                sum_slot = excl_carry(contrib)
+            plan["vals"].append((cnt_slot, sum_slot))
+        # compaction sorts share the boundary-position key; payloads
+        # chunk under the operand budget (compile time grows with
+        # operand count)
+        comp: List[jnp.ndarray] = []
+        starts_full = None
+        budget = _MAX_SORT_OPERANDS - 1
+        for c0 in range(0, len(carry_cols), budget):
+            chunk = carry_cols[c0 : c0 + budget]
+            out = jax.lax.sort(tuple([sidx] + chunk), num_keys=1)
+            starts_full = out[0]
+            comp.extend(out[1:])
+        starts = starts_full[:out_capacity]
+        if starts.shape[0] < out_capacity:
+            starts = jnp.pad(
+                starts, (0, out_capacity - starts.shape[0]),
+                constant_values=n,
+            )
+        comp = [c[:out_capacity] for c in comp]
+        used = starts < n
+        safe_starts = jnp.clip(starts, 0, max(n - 1, 0))
+        next_starts = jnp.concatenate(
+            [starts[1:], jnp.full((1,), n, dtype=starts.dtype)]
+        )
+        ends = jnp.clip(jnp.where(used, next_starts, 1) - 1, 0, max(n - 1, 0))
+        n_groups = jnp.sum(boundary.astype(jnp.int32)) if n else jnp.int32(0)
+        overflowed = (n_groups > out_capacity) | collision
+
+        def pad_slot(slot, fill=0):
+            c = comp[slot]
+            if c.shape[0] < out_capacity:
+                c = jnp.pad(c, (0, out_capacity - c.shape[0]))
+                c = jnp.where(
+                    jnp.arange(out_capacity) < comp[slot].shape[0],
+                    c, jnp.asarray(fill, c.dtype),
+                )
+            return c
+
+        def seg_total(slot):
+            total = carry_totals[slot]
+            e = pad_slot(slot, fill=total)
+            nxt = jnp.concatenate([e[1:], total[None]])
+            # unused slots carry the grand total (the filler), so the
+            # last used group's diff reads total - its prefix
+            return jnp.where(used, nxt - e, jnp.zeros((), e.dtype))
+
+        if single_key:
+            kvals = pad_slot(plan["kb"])
+            if jnp.issubdtype(keys[0].dtype, jnp.floating):
+                # the carried operand holds order-mapped BITS; recover
+                # through the row permutation (cap-sized, rare path)
+                kvals = take_clip(keys[0], take_clip(order, safe_starts))
+            group_keys = [
+                jnp.where(
+                    used, kvals.astype(keys[0].dtype),
+                    jnp.zeros((), keys[0].dtype),
+                )
+            ]
+            group_valids = [(pad_slot(plan["cls"], fill=2) == 0) & used]
+        else:
+            group_keys = []
+            group_valids = []
+            for i, (k, v) in enumerate(zip(keys, valids)):
+                ks, vs_ = plan["mk"][i]
+                group_keys.append(
+                    jnp.where(
+                        used, pad_slot(ks).astype(k.dtype),
+                        jnp.zeros((), k.dtype),
+                    )
+                )
+                group_valids.append((pad_slot(vs_) != 0) & used)
+        seg_rows = seg_total(plan["rows"])
+    else:
+        starts, safe_starts, ends, used, n_groups, overflowed = (
+            _segment_geometry(boundary, n, out_capacity)
+        )
+        overflowed = overflowed | collision
+
+        # group key columns: read the SORTED key at each segment start —
+        # one capacity-sized gather per column, no permutation chase
+        if single_key:
+            if jnp.issubdtype(keys[0].dtype, jnp.floating):
+                # the sorted operand holds order-mapped BITS; recover the
+                # float through the row permutation instead
+                kvals = take_clip(keys[0], take_clip(order, safe_starts))
+            else:
+                kvals = take_clip(sorted_ops[1], safe_starts)
+            group_keys = [
+                jnp.where(used, kvals, jnp.zeros((), keys[0].dtype))
+            ]
+            group_valids = [
+                (take_clip(sorted_ops[0], safe_starts) == 0) & used
+            ]
+        else:
+            group_keys = []
+            group_valids = []
+            for i, (k, v) in enumerate(zip(keys, valids)):
+                sk_full = sorted_payload(carried_keys[i], k)
+                sv_full = sorted_payload(carried_kv[i], v)
+                group_keys.append(
+                    jnp.where(
+                        used, take_clip(sk_full, safe_starts),
+                        jnp.zeros((), k.dtype),
+                    )
+                )
+                group_valids.append(take_clip(sv_full, safe_starts) & used)
+
+        # per-segment live-row count straight from the geometry (no
+        # scan); the LAST segment's `ends` extends to n-1 past the dead
+        # tail, so clamp to the final live row
+        n_live = jnp.sum(sm.astype(jnp.int32))
+        seg_rows = jnp.where(
+            used,
+            (jnp.minimum(ends, n_live - 1) - safe_starts + 1).astype(jnp.int64),
+            0,
+        )
 
     results = []
     counts = []
@@ -802,6 +944,8 @@ def sort_group_reduce(
         w = sm if svv is None else (sm & svv)
         if svv is None:
             cnt = seg_rows
+        elif big_cap:
+            cnt = seg_total(plan["vals"][i][0])
         else:
             cnt = _segment_sums_at(
                 _fast_cumsum(w.astype(jnp.int64)), ends, used
@@ -810,6 +954,10 @@ def sort_group_reduce(
         if red in ("sum", "count"):
             if red == "count":
                 out = cnt
+                results.append(out)
+                continue
+            if big_cap:
+                out = seg_total(plan["vals"][i][1])
                 results.append(out)
                 continue
             acc_dt = (

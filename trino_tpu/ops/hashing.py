@@ -78,9 +78,9 @@ def hash64(
     62-bit hash collision cannot recur)."""
     lo = hash32(columns, valids, seed=seed)
     hi = hash32(columns, valids, seed=0x243F6A88 + seed)
-    # 62-bit mask: leaves headroom above the hash range for the join's
-    # NULL-probe / dead-build sentinels AND for the (value << 1) | tag
-    # encoding of ops/join.sorted_run_bounds to stay within uint64
+    # 62-bit mask: leaves headroom above the hash range for sentinel
+    # values (ops/groupby._DEAD_ROW_HASH sorts dead rows last; the join
+    # moved to a 32-bit domain with its own u32 sentinels in r4)
     return (hi.astype(jnp.uint64) << jnp.uint64(32) | lo.astype(jnp.uint64)).astype(
         jnp.int64
     ) & jnp.int64(0x3FFFFFFFFFFFFFFF)

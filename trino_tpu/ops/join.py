@@ -5,16 +5,19 @@ Analogue of Trino's PagesIndex + PagesHash + JoinProbe family
 join/LookupJoinOperator.java:36) — re-designed around sorting, which is
 what TPUs do well, instead of pointer-chasing:
 
-- Build ("LookupSource"): hash the build keys to 64 bits, sort build
+- Build ("LookupSource"): hash the build keys to 32 bits, sort build
   rows by hash. The sorted-hash array + permutation IS the lookup
   structure — duplicates are adjacent runs, playing the role of Trino's
   PositionLinks chains without linked lists.
-- Probe: vectorized binary search (searchsorted) gives each probe row
-  its candidate run [lo, hi); run lengths handle duplicate build keys.
+- Probe: `sorted_run_bounds` positions every probe hash among the
+  sorted build hashes with two single-operand packed sorts (r4
+  rewrite; see its docstring for why sorts beat every alternative on
+  this hardware).
 - Fan-out (dynamic output size): two-phase — count matches, host picks
   a bucketed output capacity, then a dense expansion pass materializes
-  (probe_row, build_row) pairs. Hash collisions are culled by an exact
-  key-equality verify on the expanded pairs.
+  (probe_row, build_row) pairs. 32-bit hash collisions are culled by an
+  exact key-equality verify on the expanded pairs (the same verify
+  already required for correctness under any hash width).
 - Outer/semi/anti variants derive from the same expansion plus
   scatter-or'd matched flags (probe side) and a build-side matched
   bitmap (the LookupOuterOperator analogue for RIGHT/FULL joins).
@@ -32,83 +35,90 @@ import jax
 import jax.numpy as jnp
 
 from trino_tpu.ops.gather import take_clip
-from trino_tpu.ops.hashing import hash64
+from trino_tpu.ops.hashing import hash32
 
-_NO_MATCH_HASH = jnp.int64(1) << jnp.int64(62)  # probes that must find nothing
-_DEAD_BUILD_HASH = jnp.iinfo(jnp.int64).max  # dead build rows sort last
-# hash64 values are 62-bit, so both sentinels sit above every real hash,
-# below 2^63 (no overflow in sorted_run_bounds' (v << 1) | tag key), and
-# in two DISTINCT runs — null probes can never count dead build rows
-
-
-def _keep_rightward(flags: jnp.ndarray, vals: jnp.ndarray):
-    """Per element: value of the NEAREST flagged position at or to the
-    right. Requires at least one flagged position at-or-right of every
-    element (sorted_run_bounds guarantees it: the last run is flagged).
-
-    Formulated as cumsum + scatter + gather instead of a tuple-operand
-    associative scan: XLA:TPU compilation of multi-operand
-    associative_scan was measured HANGING (>400s, vs 62s for a full
-    6.4M-element sort) at multi-million-element shapes — the scan's
-    log-depth slice/concat tree explodes; scatter/gather compile flat."""
-    n = flags.shape[0]
-    # rid[i] = number of flagged positions strictly before i; for a
-    # flagged i this is its own ordinal among flagged positions
-    cum = jnp.cumsum(flags.astype(jnp.int32))
-    rid = cum - flags.astype(jnp.int32)
-    # F[k] = vals at the k-th flagged position (drop unflagged writes)
-    F = jnp.zeros(n, vals.dtype).at[jnp.where(flags, rid, n)].set(
-        vals, mode="drop"
-    )
-    # element i reads the rid[i]-th flagged value = nearest at-or-right
-    return take_clip(F, rid)
+# u32 hash domain layout: real hashes clamp to <= REAL_MAX so the two
+# sentinels own distinct top values. A probe with a NULL key must find
+# nothing (NO_MATCH < DEAD: never meets dead build rows either); a dead
+# or NULL-keyed build row must never be found (DEAD is the max, and no
+# probe can carry it).
+_H_REAL_MAX = jnp.uint32(0xFFFFFFFD)
+_NO_MATCH_HASH = jnp.uint32(0xFFFFFFFE)  # probes that must find nothing
+_DEAD_BUILD_HASH = jnp.uint32(0xFFFFFFFF)  # dead build rows sort last
 
 
 def sorted_run_bounds(sorted_arr: jnp.ndarray, q: jnp.ndarray):
     """For each query, the run [lo, hi) of equal values in a sorted
-    int64 array — the PagesHash probe (DefaultPagesHash.java:159).
+    array — the PagesHash probe (DefaultPagesHash.java:159). Values of
+    both inputs must fit in uint32 (key hashes and expansion offsets
+    do by construction).
 
-    TPU-native formulation: both per-element binary search (XLA
-    searchsorted: measured 343ms for 1M probes into 128k) and a
-    take-based bisect loop (~670ms — chained 1M-gathers cost ms each on
-    TPU) lose to sorting, which the TPU does at ~25ms/M rows. So: tag
-    and sort [queries ++ table] together (queries first within an equal
-    run), read lo as the build-prefix count and hi as the count at the
-    run's end via prefix sums, and route results back to query order
-    with a second multi-operand sort. Two sorts + two scans, no
-    serial gathers."""
+    TPU-native formulation (r4): on this hardware gathers run at
+    ~16.5ms/M, scatters at ~117ms/M, XLA searchsorted at ~135ms/M, and
+    the scan primitives lax.cummax/cummin hang XLA:TPU compiles the way
+    associative_scan does — while a single-operand lax.sort is ~2ms/M.
+    So the probe is exactly TWO single-operand packed sorts + cumsum:
+
+    1. Each query enters the combined array TWICE — tagged to sort
+       before any equal table value (where its table-prefix count = lo)
+       and after (= hi). The duplicate entry replaces the rightward
+       run-boundary propagation the previous design needed (a
+       scatter+gather pair measured at 15.9ms per 1M rows).
+    2. value(32b) | tag(2b) | query-id packs into one int64 word, so
+       the combined sort carries no payload operands; a second packed
+       sort on (query-id | is-hi | count) routes both bounds back to
+       query order, where each query's (lo, hi) land adjacent and
+       reshape to (N, 2) — no gather, no scatter anywhere.
+    """
     B = sorted_arr.shape[0]
     N = q.shape[0]
-    if B == 0:
+    if B == 0 or N == 0:
         z = jnp.zeros(N, jnp.int32)
         return z, z
-    # key = (value << 1) | is_table : queries sort before equal values
-    key = jnp.concatenate(
+    id_bits = max(int(N - 1).bit_length(), 1)
+    if id_bits > 30:  # 32-bit value + 2-bit tag + id must fit 64 bits
+        raise ValueError(
+            f"sorted_run_bounds: query batch of {N} rows exceeds the "
+            "2^30 packed-word id budget; split the batch"
+        )
+    vshift = jnp.uint64(2 + id_bits)
+    tshift = jnp.uint64(id_bits)
+    qv = q.astype(jnp.uint64)
+    tv = sorted_arr.astype(jnp.uint64)
+    iota = jnp.arange(N, dtype=jnp.uint64)
+    t0 = jnp.uint64(0) << tshift
+    t1 = jnp.uint64(1) << tshift
+    t2 = jnp.uint64(2) << tshift
+    words = jnp.concatenate(
         [
-            (q.astype(jnp.uint64) << jnp.uint64(1)),
-            (sorted_arr.astype(jnp.uint64) << jnp.uint64(1))
-            | jnp.uint64(1),
+            (qv << vshift) | t0 | iota,
+            (tv << vshift) | t1,
+            (qv << vshift) | t2 | iota,
         ]
     )
-    orig = jnp.concatenate(
-        [
-            jnp.arange(N, dtype=jnp.int32),
-            jnp.full(B, N, dtype=jnp.int32),  # table rows: sentinel
-        ]
+    ws = jnp.sort(words)
+    tag = (ws >> tshift) & jnp.uint64(3)
+    is_table = tag == jnp.uint64(1)
+    # at a query entry, tables at-or-before == tables strictly before
+    bp = jnp.cumsum(is_table.astype(jnp.int32)).astype(jnp.uint64)
+    qid = ws & jnp.uint64((1 << id_bits) - 1)
+    rid = jnp.where(is_table, jnp.uint64(N), qid)
+    is_hi = (tag == jnp.uint64(2)).astype(jnp.uint64)
+    res = jnp.sort(
+        (rid << jnp.uint64(33)) | (is_hi << jnp.uint64(32)) | bp
     )
-    key_s, orig_s = jax.lax.sort((key, orig), num_keys=1)
-    is_table = (key_s & jnp.uint64(1)).astype(jnp.int32)
-    tab_cum = jnp.cumsum(is_table)  # table elems at or before pos
-    lo_s = tab_cum - is_table  # strictly before (queries first in run)
-    # hi = table count through the end of this value's run
-    val_s = key_s >> jnp.uint64(1)
-    run_last = jnp.concatenate(
-        [val_s[1:] != val_s[:-1], jnp.ones(1, dtype=jnp.bool_)]
-    )
-    hi_s = _keep_rightward(run_last, tab_cum)
-    # route back to query order: queries carry orig < N, table rows N
-    _, lo_q, hi_q = jax.lax.sort((orig_s, lo_s, hi_s), num_keys=1)
-    return lo_q[:N].astype(jnp.int32), hi_q[:N].astype(jnp.int32)
+    pair = (res[: 2 * N] & jnp.uint64(0xFFFFFFFF)).astype(jnp.int32)
+    pair = pair.reshape(N, 2)
+    return pair[:, 0], pair[:, 1]
+
+
+def _key_hash(keys, valids, usable, sentinel):
+    """Clamped 32-bit key hash; rows not usable get the sentinel."""
+    if keys:
+        h = jnp.minimum(hash32(list(keys), list(valids)), _H_REAL_MAX)
+    else:
+        h = jnp.zeros(usable.shape[0], dtype=jnp.uint32)
+    return jnp.where(usable, h, sentinel)
 
 
 @jax.tree_util.register_pytree_node_class
@@ -116,7 +126,7 @@ def sorted_run_bounds(sorted_arr: jnp.ndarray, q: jnp.ndarray):
 class LookupSource:
     """Device-resident build side: sorted hashes + row permutation."""
 
-    sorted_hash: jnp.ndarray  # (B,) int64, dead rows = MAX
+    sorted_hash: jnp.ndarray  # (B,) uint32, dead rows = 0xFFFFFFFF
     perm: jnp.ndarray  # (B,) int32 — build row index at each sorted pos
     key_cols: List[jnp.ndarray]  # original (unsorted) build key columns
     key_valids: List[jnp.ndarray]
@@ -144,16 +154,22 @@ def build_lookup(
     valids: Sequence[jnp.ndarray],
     live: jnp.ndarray,
 ) -> LookupSource:
-    """Build phase — HashBuilderOperator analogue, one sort instead of
-    row-at-a-time inserts (join/HashBuilderOperator.java:58)."""
+    """Build phase — HashBuilderOperator analogue, ONE single-operand
+    packed sort instead of row-at-a-time inserts
+    (join/HashBuilderOperator.java:58)."""
     any_null = None
     for v in valids:
         any_null = ~v if any_null is None else (any_null | ~v)
     usable = live if any_null is None else (live & ~any_null)
-    h = hash64(list(keys), list(valids))
-    h = jnp.where(usable, h, _DEAD_BUILD_HASH)
-    perm = jnp.argsort(h).astype(jnp.int32)
-    return LookupSource(take_clip(h, perm), perm, list(keys), list(valids), usable)
+    h = _key_hash(keys, valids, usable, _DEAD_BUILD_HASH)
+    B = h.shape[0]
+    packed = (h.astype(jnp.uint64) << jnp.uint64(32)) | jnp.arange(
+        B, dtype=jnp.uint64
+    )
+    sp = jnp.sort(packed)
+    sorted_hash = (sp >> jnp.uint64(32)).astype(jnp.uint32)
+    perm = (sp & jnp.uint64(0xFFFFFFFF)).astype(jnp.int32)
+    return LookupSource(sorted_hash, perm, list(keys), list(valids), usable)
 
 
 @jax.jit
@@ -164,20 +180,19 @@ def probe_counts(
     probe_live: jnp.ndarray,
 ):
     """Phase 1: per-probe-row candidate run [lo, hi). Returns
-    (lo, counts, total) — `total` is a device scalar the host reads to
-    size the output batch."""
+    (lo, counts, total) — `total` is a device scalar (callers defer
+    reading it; see LookupJoinOperator's speculative expansion)."""
     any_null = None
     for v in probe_valids:
         any_null = ~v if any_null is None else (any_null | ~v)
     usable = probe_live if any_null is None else (probe_live & ~any_null)
-    ph = hash64(list(probe_keys), list(probe_valids))
-    ph = jnp.where(usable, ph, _NO_MATCH_HASH)
+    ph = _key_hash(probe_keys, probe_valids, usable, _NO_MATCH_HASH)
     lo, hi = sorted_run_bounds(ls.sorted_hash, ph)
     counts = hi - lo
     return lo, counts, jnp.sum(counts)
 
 
-@partial(jax.jit, static_argnames=("out_capacity",))
+@partial(jax.jit, static_argnames=("out_capacity", "verify"))
 def expand_matches(
     ls: LookupSource,
     probe_keys: Sequence[jnp.ndarray],
@@ -185,31 +200,42 @@ def expand_matches(
     lo: jnp.ndarray,
     counts: jnp.ndarray,
     out_capacity: int,
+    verify: bool = True,
 ):
-    """Phase 2: materialize candidate pairs, verify exact key equality.
+    """Phase 2: materialize candidate pairs; verify exact key equality
+    (32-bit hash collisions) unless the CALLER verifies on its gathered
+    pair columns instead (verify=False — saves four gathers per key:
+    the pair batch carries the key columns anyway).
 
     Returns (probe_idx, build_idx, pair_live) each (out_capacity,).
     """
     off = jnp.cumsum(counts)  # inclusive
     total = off[-1] if counts.shape[0] else jnp.int32(0)
     j = jnp.arange(out_capacity, dtype=jnp.int32)
-    # which probe row produced output j: searchsorted(off, j, 'right')
-    # == table-prefix count at j's run end in the tagged merge
-    _, pi = sorted_run_bounds(off.astype(jnp.int64), j.astype(jnp.int64))
+    # which probe row produced output j: #offs <= j (hi-rank of j among
+    # the sorted offsets)
+    _, pi = sorted_run_bounds(off, j)
     pi_c = jnp.clip(pi, 0, counts.shape[0] - 1)
-    start = take_clip(off, pi_c) - take_clip(counts, pi_c)
-    spos = take_clip(lo, pi_c) + (j - start)
+    # lo and start ride one packed int64 gather instead of three
+    packed = (
+        lo.astype(jnp.int64) << jnp.int64(31)
+    ) | (off - counts).astype(jnp.int64)
+    g = take_clip(packed, pi_c)
+    start = (g & jnp.int64((1 << 31) - 1)).astype(jnp.int32)
+    spos = (g >> jnp.int64(31)).astype(jnp.int32) + (j - start)
     spos = jnp.clip(spos, 0, ls.perm.shape[0] - 1)
     bi = take_clip(ls.perm, spos)
-    in_range = j < total
-    # exact verify (hash collisions): join equality — NULLs never match
-    ok = in_range
-    for pk, pv, bk, bv in zip(probe_keys, probe_valids, ls.key_cols, ls.key_valids):
-        a = take_clip(pk, pi_c)
-        av = take_clip(pv, pi_c)
-        b = take_clip(bk, jnp.clip(bi, 0, bk.shape[0] - 1))
-        bvv = take_clip(bv, jnp.clip(bi, 0, bv.shape[0] - 1))
-        ok = ok & (a == b) & av & bvv
+    ok = j < total
+    if verify:
+        # exact verify: join equality — NULLs never match
+        for pk, pv, bk, bv in zip(
+            probe_keys, probe_valids, ls.key_cols, ls.key_valids
+        ):
+            a = take_clip(pk, pi_c)
+            av = take_clip(pv, pi_c)
+            b = take_clip(bk, jnp.clip(bi, 0, bk.shape[0] - 1))
+            bvv = take_clip(bv, jnp.clip(bi, 0, bv.shape[0] - 1))
+            ok = ok & (a == b) & av & bvv
     return pi_c, bi, ok
 
 

@@ -21,9 +21,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
-# jax.shard_map (v0.8+) drops check_rep; keep the experimental
-# import until the new API's replication checking is adopted
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as PSpec
 
 from trino_tpu.ops import groupby as G
@@ -166,7 +164,7 @@ def distributed_groupby_step(
             mesh=mesh,
             in_specs=(row_spec, row_spec, row_spec, row_spec),
             out_specs=out_spec,
-            check_rep=False,
+            check_vma=False,
         )
         return f(keys, valids, live, values)
 

@@ -38,9 +38,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-# jax.shard_map (v0.8+) drops check_rep; keep the experimental
-# import until the new API's replication checking is adopted
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as PSpec
 
 from trino_tpu import types as T
@@ -826,7 +824,7 @@ class MeshExecutor:
             mesh=self.mesh,
             in_specs=tuple(PSpec(AXIS) for _ in feeds),
             out_specs=PSpec(AXIS),
-            check_rep=False,
+            check_vma=False,
         )
         return jax.jit(f)
 
