@@ -196,7 +196,10 @@ class TestApproxDistinct:
             "SELECT count(distinct l_suppkey) FROM lineitem"
             " WHERE l_returnflag = 'A'"
         ).only_value()
-        assert rows[0][1] == check
+        # r4 un-gated the mergeable HLL rewrite for mixed aggregate
+        # sets (VERDICT r3 item #3), so the result is approximate:
+        # 2048 registers, 3 sigma of the 2.3% standard error
+        assert abs(rows[0][1] - check) <= max(3 * 0.023 * check, 1)
 
     def test_distributed_matches_local(self, runner):
         from trino_tpu.connectors.tpch import create_tpch_connector

@@ -164,3 +164,75 @@ def test_approx_distinct_on_strings():
     r.register_catalog("memory", mem)
     est = r.execute("select approx_distinct(w) from s").rows[0][0]
     assert abs(est - 700) / 700 < 0.07
+
+
+class TestMultiSketch:
+    """N approx aggregates per node (VERDICT r3 item #3): the tagged
+    UNION ALL rewrite (sql/optimizer.RewriteMultiSketch) keeps every
+    combination mergeable — no holistic raw-row fallback."""
+
+    def test_two_approx_distinct(self, data_runner):
+        r, (k, x, y, xv) = data_runner
+        rows = r.execute(
+            "select k, approx_distinct(x), approx_distinct(y), count(*) "
+            "from d group by k order by k"
+        ).rows
+        import numpy as np
+
+        for row in rows:
+            kk, ax, ay, cnt = row
+            sel = k == kk
+            true_x = len(np.unique(x[sel & xv]))
+            true_y = len(np.unique(y[sel]))
+            assert abs(ax - true_x) <= 3 * 0.023 * max(true_x, 1)
+            assert abs(ay - true_y) <= 3 * 0.023 * max(true_y, 1)
+            assert cnt == int(sel.sum())
+
+    def test_distinct_plus_percentile_plus_avg(self, data_runner):
+        r, (k, x, y, xv) = data_runner
+        rows = r.execute(
+            "select k, approx_distinct(x), approx_percentile(y, 0.5), "
+            "avg(y), sum(x) from d group by k order by k"
+        ).rows
+        import numpy as np
+
+        for row in rows:
+            kk, ax, p50, avg_y, sum_x = row
+            sel = k == kk
+            true_x = len(np.unique(x[sel & xv]))
+            med = float(np.quantile(y[sel], 0.5))
+            assert abs(ax - true_x) <= 3 * 0.023 * max(true_x, 1)
+            assert abs(p50 - med) <= 0.02 * max(abs(med), 1.0)
+            assert abs(avg_y - float(y[sel].mean())) < 1e-6
+            assert sum_x == int(x[sel & xv].sum())
+
+    def test_global_two_sketches(self, data_runner):
+        r, (k, x, y, xv) = data_runner
+        (ax, p90) = r.execute(
+            "select approx_distinct(x), approx_percentile(y, 0.9) from d"
+        ).rows[0]
+        import numpy as np
+
+        true_x = len(np.unique(x[xv]))
+        q90 = float(np.quantile(y, 0.9))
+        assert abs(ax - true_x) <= 3 * 0.023 * true_x
+        assert abs(p90 - q90) <= 0.02 * abs(q90)
+
+    def test_avg_decimal_coexists(self):
+        """avg over DECIMAL re-aggregates exactly through the rewrite
+        (decimal(38,s) partial sums + HALF_UP division)."""
+        r = LocalQueryRunner(Session(catalog="memory", schema="t"))
+        r.register_catalog("memory", create_memory_connector())
+        r.execute("create table memory.t.m (g bigint, d decimal(12,2), x bigint)")
+        r.execute(
+            "insert into m values (1, 10.10, 7), (1, 20.30, 8), "
+            "(2, 5.55, 7), (2, 5.45, 9), (1, 0.02, 7)"
+        )
+        rows = r.execute(
+            "select g, avg(d), approx_distinct(x), approx_distinct(d) "
+            "from m group by g order by g"
+        ).rows
+        assert rows[0][0] == 1 and abs(rows[0][1] - 10.14) < 1e-9
+        assert rows[1][0] == 2 and abs(rows[1][1] - 5.50) < 1e-9
+        assert rows[0][2] == 2 and rows[0][3] == 3
+        assert rows[1][2] == 2 and rows[1][3] == 2

@@ -1,0 +1,79 @@
+"""Property tests for ops/floatbits.f64_lanes (ADVICE r3): the 4-lane
+key must be a total order matching SQL double semantics and INJECTIVE
+over normal doubles — including the f32-saturation boundary region
+where the r3 windows collapsed distinct values."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from trino_tpu.ops.floatbits import f64_lanes
+
+
+def keys_of(vals):
+    lanes = f64_lanes(jnp.asarray(vals, jnp.float64))
+    arrs = [np.asarray(l, dtype=np.uint64) for l in lanes]
+    return [tuple(int(a[i]) for a in arrs) for i in range(len(vals))]
+
+
+MAXF32 = float(np.finfo(np.float32).max)
+
+
+def _interesting_values():
+    rng = np.random.default_rng(7)
+    vals = []
+    # saturation boundary: the r3 regression pair plus a dense sweep
+    vals += [MAXF32 * (1 + 1e-9), MAXF32 * (1 + 2e-9)]
+    vals += list(MAXF32 * (1 + rng.uniform(0, 1e3, 50)))
+    vals += [MAXF32, np.nextafter(MAXF32, np.inf), 2.0 ** 128, 2.0 ** 200]
+    # huge normals through the top window
+    vals += list(rng.uniform(1, 2, 30) * 2.0 ** rng.integers(120, 1023, 30))
+    # tiny normals
+    vals += list(rng.uniform(1, 2, 30) * 2.0 ** -rng.integers(100, 1021, 30).astype(float))
+    # window boundaries +- ulps
+    for e in (-630, -378, -126, 126, 378, 630, 882):
+        b = 2.0 ** e
+        vals += [np.nextafter(b, 0), b, np.nextafter(b, np.inf)]
+    # ordinary values
+    vals += list(rng.standard_normal(100) * 10 ** rng.integers(-10, 10, 100).astype(float))
+    vals += [0.0, -0.0, 1.0, -1.0]
+    out = []
+    for v in vals:
+        f = float(v)
+        if np.isfinite(f) and f != 0 and abs(f) >= 2.2250738585072014e-308:
+            out.append(f)
+        elif f == 0:
+            out.append(f)
+    # negatives of everything
+    return out + [-v for v in out]
+
+
+def test_injective_over_normals():
+    vals = _interesting_values()
+    ks = keys_of(vals)
+    seen = {}
+    for v, k in zip(vals, ks):
+        canon = 0.0 if v == 0 else v
+        if k in seen:
+            assert seen[k] == canon, (
+                f"collision: {seen[k]!r} and {v!r} share key {k}"
+            )
+        seen[k] = canon
+
+
+def test_order_matches_double_order():
+    vals = sorted(set(v for v in _interesting_values()))
+    ks = keys_of(vals)
+    for i in range(len(vals) - 1):
+        if vals[i] == vals[i + 1]:
+            continue
+        assert ks[i] < ks[i + 1], (vals[i], vals[i + 1], ks[i], ks[i + 1])
+
+
+def test_specials():
+    vals = [float("-inf"), -1.0, -0.0, 0.0, 1.0, float("inf"), float("nan")]
+    ks = keys_of(vals)
+    assert ks[2] == ks[3]  # -0.0 == +0.0
+    assert ks[0] < ks[1] < ks[2] < ks[4] < ks[5]
+    assert ks[6] > ks[5]  # NaN largest (SQL/Double.compare order)

@@ -203,11 +203,13 @@ def test_temporal_coercion():
     assert T.arithmetic_result_type("+", T.DATE, T.INTERVAL_DAY) == T.DATE
 
 
-def test_decimal_supertype_overflow_raises():
+def test_decimal_supertype_widens_to_int128():
     from trino_tpu import types as T
 
-    with pytest.raises(TypeError):
-        T.common_super_type(T.decimal(18, 0), T.decimal(18, 18))
+    # r4: wide operand pairs widen into the Int128 carrier (capped at
+    # 38) instead of raising — spi/type/Decimals MAX_PRECISION
+    wide = T.common_super_type(T.decimal(18, 0), T.decimal(18, 18))
+    assert wide == T.decimal(36, 18) and wide.is_long_decimal
     assert T.common_super_type(T.decimal(12, 2), T.decimal(10, 4)) == T.decimal(14, 4)
 
 

@@ -51,6 +51,15 @@ def ones_mask(n: int) -> jnp.ndarray:
     return a
 
 
+def phys_zeros(t, capacity: int):
+    """Zero device array in a type's physical shape: (capacity,) for
+    flat types, (capacity, 2) int64 limb pairs for decimal(>18) (the
+    Int128ArrayBlock analogue — types.DataType.lanes)."""
+    if t.lanes == 2:
+        return jnp.zeros((capacity, 2), dtype=t.dtype)
+    return jnp.zeros(capacity, dtype=t.dtype)
+
+
 def null_column(t, capacity: int, dictionary=None):
     """All-NULL column of any type at a given capacity — outer-join
     padding (the null-RowBlock the reference builds in LookupOuter
@@ -73,7 +82,7 @@ def null_column(t, capacity: int, dictionary=None):
             t, jnp.zeros(capacity, jnp.int8), invalid, None,
             [null_column(ft, capacity) for _, ft in t.row_fields],
         )
-    return Column(t, jnp.zeros(capacity, dtype=t.dtype), invalid, dictionary)
+    return Column(t, phys_zeros(t, capacity), invalid, dictionary)
 
 
 def bucket_capacity(n: int) -> int:
@@ -175,7 +184,7 @@ class Column:
         """Vectorized position copy — the PositionsAppender analogue
         (main/operator/output/PositionsAppender*.java)."""
         pos = jnp.clip(positions, 0, self.data.shape[0] - 1)
-        data = jnp.take(self.data, pos)
+        data = jnp.take(self.data, pos, axis=0)
         valid = None
         if self.valid is not None:
             valid = jnp.take(self.valid, pos)
@@ -194,7 +203,8 @@ class Column:
     ) -> "Column":
         n = len(values)
         cap = capacity if capacity is not None else bucket_capacity(n)
-        data = np.zeros(cap, dtype=type_.dtype)
+        shape = (cap, 2) if type_.lanes == 2 else (cap,)
+        data = np.zeros(shape, dtype=type_.dtype)
         data[:n] = values
         v = None
         if valid is not None:
@@ -222,10 +232,27 @@ class Column:
         elif type_.is_decimal:
             dictionary = None
             sf = T.decimal_scale_factor(type_)
-            arr = np.asarray(
-                [round(v * sf) if v is not None else 0 for v in values],
-                dtype=type_.dtype,
-            )
+
+            def scaled(v):
+                from decimal import Decimal
+
+                if isinstance(v, float):
+                    return round(v * sf)
+                return int(Decimal(str(v)) * sf)
+
+            if type_.is_long_decimal:
+                from trino_tpu.ops.int128 import from_python
+
+                pairs = [
+                    from_python(scaled(v)) if v is not None else (0, 0)
+                    for v in values
+                ]
+                arr = np.asarray(pairs, dtype=np.int64).reshape(-1, 2)
+            else:
+                arr = np.asarray(
+                    [scaled(v) if v is not None else 0 for v in values],
+                    dtype=type_.dtype,
+                )
         else:
             dictionary = None
             fill = 0
@@ -501,7 +528,7 @@ class RowColumn(Column):
 
     def gather(self, positions: jnp.ndarray, positions_valid=None) -> "RowColumn":
         pos = jnp.clip(positions, 0, self.data.shape[0] - 1)
-        data = jnp.take(self.data, pos)
+        data = jnp.take(self.data, pos, axis=0)
         valid = None
         if self.valid is not None:
             valid = jnp.take(self.valid, pos)
@@ -646,7 +673,7 @@ class RelBatch:
         for i, c in enumerate(self.columns):
             k = bitpos.get(i)
             if k is not None:
-                data = jnp.take(c.data, pos)
+                data = jnp.take(c.data, pos, axis=0)
                 valid = (gbits >> k) & 1 != 0
                 cols.append(Column(c.type, data, valid, c.dictionary))
             else:
@@ -711,7 +738,13 @@ def decode_values(type_: T.DataType, data, valid, dict_values) -> list:
         elif type_.is_string:
             out.append(dict_values[int(x)] if dict_values else str(int(x)))
         elif type_.is_decimal:
-            out.append(int(x) / T.decimal_scale_factor(type_))
+            if type_.is_long_decimal:
+                from trino_tpu.ops.int128 import to_python
+
+                v = to_python(int(x[0]), int(x[1]))
+                out.append(v / T.decimal_scale_factor(type_))
+            else:
+                out.append(int(x) / T.decimal_scale_factor(type_))
         elif type_.kind == T.TypeKind.BOOLEAN:
             out.append(bool(x))
         elif type_.is_floating:

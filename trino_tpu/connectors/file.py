@@ -406,9 +406,18 @@ class _FileStore:
         valid: Dict[str, Optional[np.ndarray]] = {}
         dicts: Dict[str, Optional[Dictionary]] = {}
         n = sum(nr for _, nr in per_file)
-        first_sig = [(c.name, c.physical, c.converted) for c in first_cols]
+        # scale/precision are part of the signature: DECIMAL parts with
+        # different scales would otherwise concatenate their scaled
+        # int64 payloads unrescaled (ADVICE r3)
+        def _sig(cols):
+            return [
+                (c.name, c.physical, c.converted, c.scale, c.precision)
+                for c in cols
+            ]
+
+        first_sig = _sig(first_cols)
         for cols_f, _ in per_file[1:]:
-            sig = [(c.name, c.physical, c.converted) for c in cols_f]
+            sig = _sig(cols_f)
             if sig != first_sig:
                 raise ValueError(
                     f"schema mismatch across parquet parts: {sig} vs"

@@ -153,9 +153,10 @@ class MemoryMetadata(ConnectorMetadata):
                 if c.type.is_nested:  # MAP / ROW: python-object storage
                     st.data[c.name] = _StoredColumn(c.type, [], None, None)
                     continue
+                shape = (0, 2) if c.type.lanes == 2 else (0,)
                 st.data[c.name] = _StoredColumn(
                     c.type,
-                    np.zeros(0, dtype=c.type.dtype),
+                    np.zeros(shape, dtype=c.type.dtype),
                     None,
                     Dictionary([]) if c.type.is_string else None,
                 )
@@ -237,7 +238,8 @@ class MemoryPageSource(ConnectorPageSource):
                         sc.type, list(sc.data[a:b]), capacity=cap,
                     ))
                     continue
-                arr = np.zeros(cap, dtype=sc.type.dtype)
+                shape = (cap, 2) if sc.type.lanes == 2 else (cap,)
+                arr = np.zeros(shape, dtype=sc.type.dtype)
                 arr[:n] = sc.data[a:b]
                 valid = None
                 if sc.valid is not None:
@@ -266,8 +268,10 @@ class MemoryPageSource(ConnectorPageSource):
                         sc.type, [None] * 16, capacity=16,
                     ))
                     continue
+                from trino_tpu.block import phys_zeros
+
                 cols.append(Column(
-                    sc.type, jnp.zeros(16, dtype=sc.type.dtype),
+                    sc.type, phys_zeros(sc.type, 16),
                     None, sc.dictionary,
                 ))
             yield RelBatch(cols, jnp.zeros(16, dtype=jnp.bool_))

@@ -73,8 +73,10 @@ class Operator:
 
 def empty_batch(schema: Sequence[Tuple[T.DataType, Optional[Dictionary]]],
                 capacity: int = 16) -> RelBatch:
+    from trino_tpu.block import phys_zeros
+
     cols = [
-        Column(t, jnp.zeros(capacity, dtype=t.dtype), None, d) for t, d in schema
+        Column(t, phys_zeros(t, capacity), None, d) for t, d in schema
     ]
     return RelBatch(cols, jnp.zeros(capacity, dtype=jnp.bool_))
 
@@ -546,7 +548,7 @@ def _window_compute(
     peer_start = part_start | W.segment_starts(peer_inputs, peer_vmasks, n) if peer_inputs else part_start
 
     out_cols = []
-    for kind, arg_ch, out_dt, offset, arg_sf, out_float, out_sf in functions:
+    for kind, arg_ch, out_dt, offset, arg_sf, out_float, out_sf, out_lanes in functions:
         out_dtype = np.dtype(out_dt)
         if kind == "row_number":
             out_cols.append((W.row_number(part_start).astype(out_dtype), None))
@@ -582,6 +584,10 @@ def _window_compute(
             out_cols.append((v.astype(out_dtype), None))
         elif kind in ("sum", "avg", "min", "max"):
             col = s_cols[arg_ch]
+            if getattr(col.data, "ndim", 1) == 2:
+                raise NotImplementedError(
+                    "window aggregates over decimal(>18) arguments"
+                )
             if kind in ("min", "max"):
                 vals = col.data
                 neutral = minmax_neutral(col.data.dtype, kind)
@@ -607,10 +613,47 @@ def _window_compute(
                 out_cols.append(((v / arg_sf).astype(out_dtype), has))
             else:
                 safe = jnp.where(has, v, jnp.zeros((), v.dtype))
-                out_cols.append((safe.astype(out_dtype), has))
+                if out_lanes == 2:
+                    # sum(decimal) -> decimal(38,s): widen the int64
+                    # accumulator into limb pairs (same contract as
+                    # _agg_output's short-input long-output sum)
+                    from trino_tpu.ops import int128 as I128
+
+                    h, lo = I128.from_i64(safe.astype(jnp.int64))
+                    out_cols.append((jnp.stack([h, lo], axis=-1), has))
+                else:
+                    out_cols.append((safe.astype(out_dtype), has))
         else:
             raise NotImplementedError(f"window function {kind}")
     return s_cols, s_live, out_cols
+
+
+def window_fn_tuples(specs, schema) -> tuple:
+    """Static per-function tuples for the jitted window kernel —
+    shared by WindowOperator and the mesh fragment compiler."""
+    fns = []
+    for s in specs:
+        # decimal args are int64 at the arg scale; divide only when
+        # the OUTPUT leaves the scaled domain (avg -> DOUBLE, float
+        # sums). Decimal sum/min/max keep the arg scale unchanged.
+        arg_sf = 1
+        out_float = s.out_type.is_floating
+        # decimal OUTPUT scale factor: avg over decimal re-scales its
+        # float quotient back into the output's scaled-int64 domain
+        out_sf = (
+            T.decimal_scale_factor(s.out_type)
+            if s.out_type.is_decimal
+            else None
+        )
+        if s.arg_channel is not None:
+            arg_t = schema[s.arg_channel][0]
+            if arg_t.is_decimal and (s.kind == "avg" or out_float):
+                arg_sf = T.decimal_scale_factor(arg_t)
+        fns.append(
+            (s.kind, s.arg_channel, s.out_type.dtype.str, s.offset,
+             arg_sf, out_float, out_sf, s.out_type.lanes)
+        )
+    return tuple(fns)
 
 
 class WindowOperator(Operator):
@@ -633,30 +676,7 @@ class WindowOperator(Operator):
         self._schema = list(input_schema)
         self._inputs: List[RelBatch] = []
         self._out: Optional[RelBatch] = None
-        # static per-function tuples for the jitted kernel
-        fns = []
-        for s in self._specs:
-            # decimal args are int64 at the arg scale; divide only when
-            # the OUTPUT leaves the scaled domain (avg -> DOUBLE, float
-            # sums). Decimal sum/min/max keep the arg scale unchanged.
-            arg_sf = 1
-            out_float = s.out_type.is_floating
-            # decimal OUTPUT scale factor: avg over decimal re-scales its
-            # float quotient back into the output's scaled-int64 domain
-            out_sf = (
-                T.decimal_scale_factor(s.out_type)
-                if s.out_type.is_decimal
-                else None
-            )
-            if s.arg_channel is not None:
-                arg_t = self._schema[s.arg_channel][0]
-                if arg_t.is_decimal and (s.kind == "avg" or out_float):
-                    arg_sf = T.decimal_scale_factor(arg_t)
-            fns.append(
-                (s.kind, s.arg_channel, s.out_type.dtype.str, s.offset,
-                 arg_sf, out_float, out_sf)
-            )
-        self._fns = tuple(fns)
+        self._fns = window_fn_tuples(self._specs, self._schema)
 
     def add_input(self, batch: RelBatch) -> None:
         self._inputs.append(batch)
@@ -803,6 +823,30 @@ def _agg_output(spec: AggSpec, state, arg_type: Optional[T.DataType],
     if spec.kind in ("count", "count_star"):
         (cnt,) = state
         return Column(out_t, cnt.astype(jnp.int64), None, None)
+    if len(state) == 3:
+        # Int128 limb-join state (sum/avg over a long-decimal arg)
+        from trino_tpu.ops import int128 as I128
+
+        h, lo, cnt = state
+        has = cnt > 0
+        if spec.kind in ("min", "max", "any"):
+            return Column(
+                out_t, jnp.stack([h, lo], axis=-1), has, arg_dict
+            )
+        if spec.kind == "avg":
+            h, lo = I128.div_round_i64(
+                h, lo, jnp.maximum(cnt, 1).astype(jnp.int64)
+            )
+        arg_s = arg_type.scale or 0
+        out_s = out_t.scale or 0
+        if out_s > arg_s:
+            h, lo = I128.rescale_up(h, lo, out_s - arg_s)
+        elif arg_s > out_s:
+            h, lo = I128.rescale_down_round(h, lo, arg_s - out_s)
+        if out_t.is_long_decimal:
+            return Column(out_t, jnp.stack([h, lo], axis=-1), has, None)
+        x, _ = I128.to_i64(h, lo)
+        return Column(out_t, x.astype(out_t.dtype), has, None)
     acc, cnt = state
     has = cnt > 0
     arg_sf = (
@@ -816,6 +860,14 @@ def _agg_output(spec: AggSpec, state, arg_type: Optional[T.DataType],
             return Column(out_t, acc.astype(out_t.dtype) / arg_sf, has, None)
         if out_sf is not None and out_sf != arg_sf:
             acc = acc * (out_sf // arg_sf) if out_sf > arg_sf else acc // (arg_sf // out_sf)
+        if out_t.is_long_decimal:
+            # sum(decimal) -> decimal(38, s): the int64 accumulator
+            # widens into limb pairs (exact while per-batch partials fit
+            # int64; the limb-split accumulator is the extension point)
+            from trino_tpu.ops import int128 as I128
+
+            h, lo = I128.from_i64(acc.astype(jnp.int64))
+            return Column(out_t, jnp.stack([h, lo], axis=-1), has, None)
         return Column(out_t, acc.astype(out_t.dtype), has, None)
     if spec.kind == "avg":
         q = acc.astype(jnp.float64) / jnp.maximum(cnt, 1)
@@ -846,6 +898,10 @@ def agg_state_meta(
         return [(T.BIGINT, None), (T.BIGINT, None)]
     arg_t, arg_d = input_schema[spec.arg_channel]
     if spec.kind in ("sum", "avg"):
+        if arg_t.is_long_decimal:
+            # four int64 limb-sum slots (value, count) each — the
+            # Int128 accumulator's wire form (_limb_split)
+            return [(T.BIGINT, None), (T.BIGINT, None)] * 4
         if arg_t.is_floating:
             val_t = T.DOUBLE
         elif arg_t.is_decimal:
@@ -868,6 +924,50 @@ def partial_output_schema(
     for a in aggs:
         out.extend(agg_state_meta(a, input_schema))
     return out
+
+
+# -- Int128 sum accumulation (DecimalSumAggregation analogue) --------------
+# A long-decimal (n, 2) argument cannot ride the 1-D sort-carry
+# aggregation kernels, and a single int64 accumulator would overflow; it
+# splits into FOUR 32-bit limb columns whose int64 sums are each exact
+# for < 2^31 rows, recombined into (hi, lo) at finalize:
+#   value = l0 + l1*2^32 + h0*2^64 + h1*2^96   (h1 signed, rest unsigned)
+
+_LIMB_MASK = 0xFFFFFFFF
+
+
+def _agg_slot_count(spec: "AggSpec", arg_type: Optional[T.DataType]) -> int:
+    """State (value, count) slot pairs one aggregate occupies."""
+    if (
+        spec.kind in ("sum", "avg")
+        and arg_type is not None
+        and arg_type.is_long_decimal
+    ):
+        return 4
+    return 1
+
+
+def _limb_split(d: jnp.ndarray) -> List[jnp.ndarray]:
+    h, lo = d[:, 0], d[:, 1]
+    m = jnp.int64(_LIMB_MASK)
+    return [
+        lo & m,
+        (lo >> jnp.int64(32)) & m,
+        h & m,
+        h >> jnp.int64(32),
+    ]
+
+
+def _limb_join(sums: Sequence[jnp.ndarray]):
+    """Four limb-sum arrays -> (hi, lo) Int128."""
+    from trino_tpu.ops import int128 as I128
+
+    h, lo = I128.from_i64(sums[3].astype(jnp.int64))
+    for s in (sums[2], sums[1], sums[0]):
+        h, lo = I128.mul_128_64(h, lo, jnp.int64(1 << 32))
+        ah, al = I128.from_i64(s.astype(jnp.int64))
+        h, lo = I128.add(h, lo, ah, al)
+    return h, lo
 
 
 _BATCH_REDUCER = {"sum": "sum", "avg": "sum", "count": "count",
@@ -926,14 +1026,42 @@ def _agg_ingest(batch: RelBatch, groups: tuple, aggs: tuple, cap: int, pre_fn,
     remote-attached devices)."""
     if pre_fn is not None:
         batch = pre_fn(batch)
-    keys = [batch.columns[c].data for c in groups]
-    valids = [batch.columns[c].valid_mask() for c in groups]
+    keys, valids = [], []
+    for c in groups:
+        col = batch.columns[c]
+        v = col.valid_mask()
+        if getattr(col.data, "ndim", 1) == 2:
+            # long-decimal key: group by its two int64 limbs (pair
+            # equality == value equality; output reassembles them)
+            keys.extend([col.data[:, 0], col.data[:, 1]])
+            valids.extend([v, v])
+        else:
+            keys.append(col.data)
+            valids.append(v)
     live = batch.live_mask()
     values, vvalids, reds = [], [], []
     for a in aggs:
         if a.arg_channel is None:
             values.append(live.astype(jnp.int64))
             vvalids.append(None)
+        elif getattr(batch.columns[a.arg_channel].data, "ndim", 1) == 2:
+            if a.kind == "count":
+                # count() reads only the validity mask
+                values.append(live.astype(jnp.int64))
+                vvalids.append(batch.columns[a.arg_channel].valid)
+                reds.append("count")
+                continue
+            if a.kind not in ("sum", "avg"):
+                raise NotImplementedError(
+                    f"{a.kind}() over decimal(>18) arguments"
+                )
+            # long-decimal sum/avg: four 32-bit limb slots (_limb_split)
+            col = batch.columns[a.arg_channel]
+            for piece in _limb_split(col.data):
+                values.append(piece)
+                vvalids.append(col.valid)
+                reds.append("sum")
+            continue
         else:
             col = batch.columns[a.arg_channel]
             values.append(col.data)
@@ -961,8 +1089,18 @@ def _finalize_grouped(acc, aggs: tuple, arg_types: tuple):
     a tunneled device link)."""
     gk, gv, used, vals, cnts = acc
     out = []
-    for a, val, cnt, arg_t in zip(aggs, vals, cnts, arg_types):
-        state = (val,) if a.kind in ("count", "count_star") else (val, cnt)
+    si = 0
+    for a, arg_t in zip(aggs, arg_types):
+        k = _agg_slot_count(a, arg_t)
+        if k > 1:
+            # Int128 sum from limb slots; the count rides slot 0
+            h, lo = _limb_join(vals[si : si + k])
+            state = (h, lo, cnts[si])
+        elif a.kind in ("count", "count_star"):
+            state = (vals[si],)
+        else:
+            state = (vals[si], cnts[si])
+        si += k
         col = _agg_output(a, state, arg_t, None)
         out.append((col.data, col.valid))
     return out
@@ -971,16 +1109,22 @@ def _finalize_grouped(acc, aggs: tuple, arg_types: tuple):
 _GLOBAL_FN_CACHE: Dict[Tuple[AggSpec, ...], object] = {}
 
 
-def _global_update_fn(aggs: Tuple[AggSpec, ...]):
+def _global_update_fn(aggs: Tuple[AggSpec, ...], long_flags: tuple = ()):
     """Jitted whole-batch reduction for GROUP-BY-less aggregation —
-    shared across instances (AccumulatorCompiler cache analogue)."""
-    if aggs not in _GLOBAL_FN_CACHE:
+    shared across instances (AccumulatorCompiler cache analogue).
+    long_flags marks aggregates whose argument is a long decimal: their
+    sum state is an Int128 (hi, lo) pair accumulated from limb sums."""
+    if not long_flags:
+        long_flags = (False,) * len(aggs)
+    if (aggs, long_flags) not in _GLOBAL_FN_CACHE:
 
         @jax.jit
         def update(states, batch: RelBatch):
+            from trino_tpu.ops import int128 as I128
+
             live = batch.live_mask()
             out = []
-            for a, (val, cnt) in zip(aggs, states):
+            for a, is_long, (val, cnt) in zip(aggs, long_flags, states):
                 if a.arg_channel is None:
                     data, valid = live.astype(jnp.int64), None
                 else:
@@ -990,9 +1134,45 @@ def _global_update_fn(aggs: Tuple[AggSpec, ...]):
                 n = jnp.sum(w.astype(jnp.int64))
                 if a.kind in ("count", "count_star"):
                     out.append((val + n, cnt + n))
+                elif is_long and a.kind in ("sum", "avg"):
+                    limb_sums = [
+                        jnp.sum(jnp.where(w, piece, jnp.int64(0)))
+                        for piece in _limb_split(data)
+                    ]
+                    bh, bl = _limb_join(limb_sums)
+                    h, lo = I128.add(val[0], val[1], bh, bl)
+                    out.append((jnp.stack([h, lo]), cnt + n))
                 elif a.kind in ("sum", "avg"):
                     contrib = jnp.where(w, data.astype(val.dtype), 0)
                     out.append((val + jnp.sum(contrib), cnt + n))
+                elif is_long and a.kind in ("min", "max"):
+                    # lexicographic (hi, unsigned lo) batch reduce, then
+                    # an Int128 compare against the running state
+                    h, lo = data[:, 0], data[:, 1]
+                    big_h = jnp.iinfo(jnp.int64).max
+                    sgn = jnp.int64(-0x8000000000000000)
+                    lo_u = lo ^ sgn
+                    if a.kind == "min":
+                        h_m = jnp.where(w, h, big_h)
+                        m1 = jnp.min(h_m)
+                        lo_m = jnp.where(w & (h == m1), lo_u, big_h)
+                        m2 = jnp.min(lo_m) ^ sgn
+                    else:
+                        h_m = jnp.where(w, h, -big_h - 1)
+                        m1 = jnp.max(h_m)
+                        lo_m = jnp.where(w & (h == m1), lo_u, -big_h - 1)
+                        m2 = jnp.max(lo_m) ^ sgn
+                    from trino_tpu.ops import int128 as I128x
+
+                    better = I128x.lt(m1, m2, val[0], val[1])
+                    if a.kind == "max":
+                        better = I128x.lt(val[0], val[1], m1, m2)
+                    better = better & (n > 0)
+                    first = cnt == 0
+                    take = (better | first) & (n > 0)
+                    nh = jnp.where(take, m1, val[0])
+                    nl = jnp.where(take, m2, val[1])
+                    out.append((jnp.stack([nh, nl]), cnt + n))
                 elif a.kind in ("min", "max"):
                     neutral = minmax_neutral(data.dtype, a.kind)
                     masked = jnp.where(w, data, jnp.asarray(neutral, data.dtype))
@@ -1009,8 +1189,8 @@ def _global_update_fn(aggs: Tuple[AggSpec, ...]):
                     raise NotImplementedError(a.kind)
             return out
 
-        _GLOBAL_FN_CACHE[aggs] = update
-    return _GLOBAL_FN_CACHE[aggs]
+        _GLOBAL_FN_CACHE[(aggs, long_flags)] = update
+    return _GLOBAL_FN_CACHE[(aggs, long_flags)]
 
 
 class HashAggregationOperator(Operator):
@@ -1042,6 +1222,12 @@ class HashAggregationOperator(Operator):
         representation (decimal scale, dictionary) — finalization reads
         it straight from the input schema."""
         assert step in ("single", "partial", "final"), step
+        if step != "single" and any(
+            input_schema[c][0].is_long_decimal for c in group_channels
+        ):
+            raise NotImplementedError(
+                "partial/final aggregation over decimal(>18) group keys"
+            )
         self._step = step
         self._pre = pre_fn  # fused upstream stage (plan-time jit)
         self._group_channels = list(group_channels)
@@ -1084,6 +1270,12 @@ class HashAggregationOperator(Operator):
             input_schema[a.arg_channel] if a.arg_channel is not None else (None, None)
             for a in self._aggs
         ]
+        # state (value, count) slot pairs across all aggregates: long-
+        # decimal sums occupy four limb slots (_agg_slot_count)
+        self._n_slots = sum(
+            _agg_slot_count(a, m[0])
+            for a, m in zip(self._aggs, self._arg_meta)
+        )
         # Static group-cardinality bound: dictionary-coded and boolean
         # keys bound the distinct-group count at PLAN time, so the table
         # can never overflow and the per-batch host sync on the overflow
@@ -1150,7 +1342,14 @@ class HashAggregationOperator(Operator):
         if self._static_bound is not None:
             self._cap = max(bucket_capacity(self._static_bound), 16)
         if self._global and step != "final":
-            self._update = _global_update_fn(tuple(self._aggs))
+            self._update = _global_update_fn(
+                tuple(self._aggs),
+                tuple(
+                    a.arg_channel is not None
+                    and input_schema[a.arg_channel][0].is_long_decimal
+                    for a in self._aggs
+                ),
+            )
 
     # -- grouped path --
     def _batch_values(self, batch: RelBatch):
@@ -1160,6 +1359,22 @@ class HashAggregationOperator(Operator):
             if a.arg_channel is None:
                 values.append(live.astype(jnp.int64))
                 vvalids.append(None)
+            elif getattr(batch.columns[a.arg_channel].data, "ndim", 1) == 2:
+                if a.kind == "count":
+                    values.append(live.astype(jnp.int64))
+                    vvalids.append(batch.columns[a.arg_channel].valid)
+                    reds.append("count")
+                    continue
+                if a.kind not in ("sum", "avg"):
+                    raise NotImplementedError(
+                        f"{a.kind}() over decimal(>18) arguments"
+                    )
+                col = batch.columns[a.arg_channel]
+                for piece in _limb_split(col.data):
+                    values.append(piece)
+                    vvalids.append(col.valid)
+                    reds.append("sum")
+                continue
             else:
                 col = batch.columns[a.arg_channel]
                 values.append(col.data)
@@ -1276,7 +1491,13 @@ class HashAggregationOperator(Operator):
         if len(states) == 1:
             self._acc = states[0]
             return
-        reducers = tuple(_MERGE_REDUCER[x.kind] for x in self._aggs)
+        reducers = []
+        for i, x in enumerate(self._aggs):
+            n_slots = _agg_slot_count(x, self._arg_meta[i][0])
+            reducers.extend(
+                ["sum"] * n_slots if n_slots > 1 else [_MERGE_REDUCER[x.kind]]
+            )
+        reducers = tuple(reducers)
         # distinct groups across N states cannot exceed the concatenated
         # slot count, so the merge table caps there (bounds the output
         # arrays by the data, not by a possibly-overgrown _cap)
@@ -1307,8 +1528,20 @@ class HashAggregationOperator(Operator):
             return
         keys = [batch.columns[c].data for c in range(k)]
         valids = [batch.columns[c].valid_mask() for c in range(k)]
-        vals = [batch.columns[k + 2 * i].data for i in range(len(self._aggs))]
-        cnts = [batch.columns[k + 2 * i + 1].data for i in range(len(self._aggs))]
+        if self._step == "final":
+            # final-step input IS the partial wire layout; the
+            # fragmenter gates Int128 states to single-step, so slots
+            # and aggregates correspond 1:1 here
+            n_slots = len(self._aggs)
+        else:
+            # spill round trip within a single-step operator: the slot
+            # layout comes from the input schema (limb slots included)
+            n_slots = sum(
+                len(agg_state_meta(a, self._schema)) // 2
+                for a in self._aggs
+            )
+        vals = [batch.columns[k + 2 * i].data for i in range(n_slots)]
+        cnts = [batch.columns[k + 2 * i + 1].data for i in range(n_slots)]
         new = (tuple(keys), tuple(valids), live, tuple(vals), tuple(cnts))
         with self._state_lock:
             self._pending.append(new)
@@ -1353,23 +1586,40 @@ class HashAggregationOperator(Operator):
         accumulator serialization shared by the exchange AND the
         spiller)."""
         if self._acc is None:
-            key_dts = [self._schema[c][0].dtype for c in self._group_channels]
+            key_dts = []
+            for c in self._group_channels:
+                t = self._schema[c][0]
+                key_dts.extend([t.dtype] * t.lanes)
             self._acc = (
                 [jnp.zeros(16, dtype=dt) for dt in key_dts],
                 [jnp.zeros(16, dtype=jnp.bool_) for _ in key_dts],
                 jnp.zeros(16, dtype=jnp.bool_),
-                [jnp.zeros(16, dtype=jnp.int64) for _ in self._aggs],
-                [jnp.zeros(16, dtype=jnp.int64) for _ in self._aggs],
+                [jnp.zeros(16, dtype=jnp.int64) for _ in range(self._n_slots)],
+                [jnp.zeros(16, dtype=jnp.int64) for _ in range(self._n_slots)],
             )
         cols: List[Column] = []
         gk, gv, used, vals, cnts = self._acc
+        if any(
+            self._schema[c][0].is_long_decimal for c in self._group_channels
+        ):
+            raise NotImplementedError(
+                "state serialization over decimal(>18) group keys"
+            )
         for ch, kk, vv in zip(self._group_channels, gk, gv):
             t, d = self._schema[ch]
             cols.append(Column(t, kk, vv, d))
-        for a, val, cnt in zip(self._aggs, vals, cnts):
-            vt, vd = agg_state_meta(a, self._schema)[0]
-            cols.append(Column(vt, val.astype(vt.dtype), None, vd))
-            cols.append(Column(T.BIGINT, cnt.astype(jnp.int64), None, None))
+        si = 0
+        for i, a in enumerate(self._aggs):
+            metas = agg_state_meta(a, self._schema)
+            for j in range(0, len(metas), 2):
+                vt, vd = metas[j]
+                cols.append(
+                    Column(vt, vals[si].astype(vt.dtype), None, vd)
+                )
+                cols.append(
+                    Column(T.BIGINT, cnts[si].astype(jnp.int64), None, None)
+                )
+                si += 1
         return RelBatch(cols, used)
 
     def _emit_partial(self) -> None:
@@ -1421,6 +1671,22 @@ class HashAggregationOperator(Operator):
             if a.arg_channel is None:
                 values.append(live.astype(jnp.int64))
                 vvalids.append(None)
+            elif getattr(mega.columns[a.arg_channel].data, "ndim", 1) == 2:
+                if a.kind == "count":
+                    values.append(live.astype(jnp.int64))
+                    vvalids.append(mega.columns[a.arg_channel].valid)
+                    reds.append("count")
+                    continue
+                if a.kind not in ("sum", "avg"):
+                    raise NotImplementedError(
+                        f"{a.kind}() over decimal(>18) arguments"
+                    )
+                col = mega.columns[a.arg_channel]
+                for piece in _limb_split(col.data):
+                    values.append(piece)
+                    vvalids.append(col.valid)
+                    reds.append("sum")
+                continue
             else:
                 col = mega.columns[a.arg_channel]
                 values.append(col.data)
@@ -1439,9 +1705,18 @@ class HashAggregationOperator(Operator):
         self._cap = cap
 
         agg_cols: Dict[int, Column] = {}
-        for (i, a), val, cnt in zip(regular, vals, cnts):
+        si = 0
+        for (i, a) in regular:
             arg_t, arg_d = self._arg_meta[i]
-            state = (val,) if a.kind in ("count", "count_star") else (val, cnt)
+            kslots = _agg_slot_count(a, arg_t)
+            if kslots > 1:
+                h, lo = _limb_join(vals[si : si + kslots])
+                state = (h, lo, cnts[si])
+            elif a.kind in ("count", "count_star"):
+                state = (vals[si],)
+            else:
+                state = (vals[si], cnts[si])
+            si += kslots
             agg_cols[i] = _agg_output(a, state, arg_t, arg_d)
         # one key sort shared by every argbest kernel (percentile needs
         # its own value pre-ordering and sorts separately)
@@ -1598,12 +1873,24 @@ class HashAggregationOperator(Operator):
             if a.kind in ("count", "count_star"):
                 val = jnp.int64(0)
             elif a.kind in ("sum", "avg"):
-                acc_dt = (
-                    jnp.float64 if np.issubdtype(dt, np.floating) else jnp.int64
-                )
-                val = jnp.zeros((), dtype=acc_dt)
+                if (
+                    a.arg_channel is not None
+                    and self._schema[a.arg_channel][0].is_long_decimal
+                ):
+                    val = jnp.zeros(2, dtype=jnp.int64)  # Int128 (hi, lo)
+                else:
+                    acc_dt = (
+                        jnp.float64 if np.issubdtype(dt, np.floating) else jnp.int64
+                    )
+                    val = jnp.zeros((), dtype=acc_dt)
             elif a.kind in ("min", "max"):
-                val = jnp.asarray(minmax_neutral(dt, a.kind), dtype=dt)
+                if (
+                    a.arg_channel is not None
+                    and self._schema[a.arg_channel][0].is_long_decimal
+                ):
+                    val = jnp.zeros(2, dtype=jnp.int64)  # replaced on first row
+                else:
+                    val = jnp.asarray(minmax_neutral(dt, a.kind), dtype=dt)
             else:  # any
                 val = jnp.zeros((), dtype=dt)
             states.append((val, jnp.int64(0)))
@@ -1651,29 +1938,45 @@ class HashAggregationOperator(Operator):
             states = self._gstate if self._gstate is not None else self._global_init()
             live = jnp.ones(1, dtype=jnp.bool_)
             for i, (a, (val, cnt)) in enumerate(zip(self._aggs, states)):
-                state = (
-                    (val[None],)
-                    if a.kind in ("count", "count_star")
-                    else (val[None], cnt[None])
-                )
                 arg_t, arg_d = self._arg_meta[i]
+                long_arg = arg_t is not None and arg_t.is_long_decimal
+                if a.kind in ("count", "count_star"):
+                    state = (val[None],)
+                elif long_arg and a.kind in ("sum", "avg", "min", "max"):
+                    # Int128 (hi, lo) scalar state
+                    state = (val[0][None], val[1][None], cnt[None])
+                else:
+                    state = (val[None], cnt[None])
                 cols.append(_agg_output(a, state, arg_t, arg_d))
             self._out = RelBatch(cols, live)
             return
         if self._acc is None:
-            # no input: empty group set
-            key_dts = [self._schema[c][0].dtype for c in self._group_channels]
+            # no input: empty group set (long-decimal keys occupy two
+            # int64 limb slots — the split-key layout of _agg_ingest)
+            key_dts = []
+            for c in self._group_channels:
+                t = self._schema[c][0]
+                key_dts.extend([t.dtype] * t.lanes)
             self._acc = (
                 [jnp.zeros(16, dtype=dt) for dt in key_dts],
                 [jnp.zeros(16, dtype=jnp.bool_) for _ in key_dts],
                 jnp.zeros(16, dtype=jnp.bool_),
-                [jnp.zeros(16, dtype=jnp.int64) for _ in self._aggs],
-                [jnp.zeros(16, dtype=jnp.int64) for _ in self._aggs],
+                [jnp.zeros(16, dtype=jnp.int64) for _ in range(self._n_slots)],
+                [jnp.zeros(16, dtype=jnp.int64) for _ in range(self._n_slots)],
             )
         gk, gv, used, vals, cnts = self._acc
-        for ch, k, v in zip(self._group_channels, gk, gv):
+        ki = 0
+        for ch in self._group_channels:
             t, d = self._schema[ch]
-            cols.append(Column(t, k, v, d))
+            if t.lanes == 2:  # reassemble split long-decimal limbs
+                cols.append(Column(
+                    t, jnp.stack([gk[ki], gk[ki + 1]], axis=-1),
+                    gv[ki], d,
+                ))
+                ki += 2
+            else:
+                cols.append(Column(t, gk[ki], gv[ki], d))
+                ki += 1
         outs = _finalize_grouped(
             (tuple(gk), tuple(gv), used, tuple(vals), tuple(cnts)),
             tuple(self._aggs),
@@ -1729,8 +2032,16 @@ def _consolidate_build(parts: Tuple[RelBatch, ...], key_channels: Tuple[int, ...
     """Consolidate build batches + build the LookupSource in one device
     program (HashBuilderOperator.java:58)."""
     merged = concat_batches(list(parts))
-    keys = [merged.columns[c].data for c in key_channels]
-    valids = [merged.columns[c].valid_mask() for c in key_channels]
+    keys, valids = [], []
+    for c in key_channels:
+        col = merged.columns[c]
+        v = col.valid_mask()
+        if getattr(col.data, "ndim", 1) == 2:  # long-decimal limbs
+            keys.extend([col.data[:, 0], col.data[:, 1]])
+            valids.extend([v, v])
+        else:
+            keys.append(col.data)
+            valids.append(v)
     return J.build_lookup(keys, valids, merged.live_mask()), merged
 
 
@@ -1913,13 +2224,62 @@ def _expand_pairs(ls, probe: RelBatch, build: RelBatch, keys, valids,
         for pc, bc in zip(pkc, bkc):
             a = pairs_probe.columns[pc]
             b = pairs_build.columns[bc]
-            ok = ok & (a.data == b.data)
+            eqd = a.data == b.data
+            if getattr(eqd, "ndim", 1) == 2:  # long-decimal limb pairs
+                eqd = eqd.all(axis=-1)
+            ok = ok & eqd
             if a.valid is not None:
                 ok = ok & a.valid
             if b.valid is not None:
                 ok = ok & b.valid
     cols = list(pairs_probe.columns) + list(pairs_build.columns)
     return pi, bi, ok, RelBatch(cols, ok)
+
+
+@jax.jit
+def _fanout_le_one(counts):
+    """Device flag: no probe row has more than one candidate match."""
+    return jnp.all(counts <= 1)
+
+
+@partial(jax.jit, static_argnames=("pkc", "bkc"))
+def _expand_pairs_fanout1(ls, probe: RelBatch, build: RelBatch, keys,
+                          valids, lo, counts, pkc=None, bkc=None):
+    """Fanout<=1 expansion (every probe row matches at most one build
+    row — the PK-side FK join that dominates TPC-H/DS): the pair batch
+    IS the probe batch with the matched build row appended. The probe
+    columns pass through untouched — no offsets, no repeat machinery,
+    and none of the ~16ms/M-element random gathers the general
+    expansion pays per probe column. Caller guarantees max(counts) <= 1
+    (checked on device alongside the deferred total)."""
+    spos = jnp.clip(lo, 0, ls.perm.shape[0] - 1)
+    bi = take_clip(ls.perm, spos)
+    ok = counts > 0
+    pairs_build = build.gather(bi)
+    if pkc is not None:
+        for pc, bc in zip(pkc, bkc):
+            a = probe.columns[pc]
+            b = pairs_build.columns[bc]
+            eqd = a.data == b.data
+            if getattr(eqd, "ndim", 1) == 2:  # long-decimal limb pairs
+                eqd = eqd.all(axis=-1)
+            ok = ok & eqd
+            if a.valid is not None:
+                ok = ok & a.valid
+            if b.valid is not None:
+                ok = ok & b.valid
+    else:
+        for pk, pv, bk, bv in zip(keys, valids, ls.key_cols, ls.key_valids):
+            b = take_clip(bk, jnp.clip(bi, 0, bk.shape[0] - 1))
+            bvv = take_clip(bv, jnp.clip(bi, 0, bv.shape[0] - 1))
+            eqd = pk == b
+            if getattr(eqd, "ndim", 1) == 2:
+                eqd = eqd.all(axis=-1)
+            ok = ok & eqd & pv & bvv
+    live = probe.live_mask() & ok
+    pi = jnp.arange(probe.capacity, dtype=jnp.int32)
+    cols = list(probe.columns) + list(pairs_build.columns)
+    return pi, bi, live, RelBatch(cols, live)
 
 
 @jax.jit
@@ -2055,9 +2415,11 @@ class LookupJoinOperator(Operator):
 
     def _probe_one(self, ls, build, key_dicts, probe: RelBatch) -> None:
         keys = []
+        valids = []
         remapped = False
         for i, c in enumerate(self._keys):
             col = probe.columns[c]
+            v = col.valid_mask()
             build_dict = key_dicts[i] if key_dicts else None
             if (
                 col.dictionary is not None
@@ -2079,20 +2441,28 @@ class LookupJoinOperator(Operator):
                 keys.append(
                     take_clip(remap, col.data)
                 )
+                valids.append(v)
                 remapped = True
+            elif getattr(col.data, "ndim", 1) == 2:
+                # long-decimal key: probe by its two int64 limbs (the
+                # build side split identically in _consolidate_build)
+                keys.extend([col.data[:, 0], col.data[:, 1]])
+                valids.extend([v, v])
             else:
                 keys.append(col.data)
-        valids = [probe.columns[c].valid_mask() for c in self._keys]
+                valids.append(v)
         live = probe.live_mask()
         lo, counts, total = J.probe_counts(ls, keys, valids, live)
-        try:
-            total.copy_to_host_async()
-        except AttributeError:
-            pass
+        fan1 = _fanout_le_one(counts)
+        for scalar in (total, fan1):
+            try:
+                scalar.copy_to_host_async()
+            except AttributeError:
+                pass
         self._probe_pending.append({
             "ls": ls, "build": build, "probe": probe, "keys": keys,
             "valids": valids, "lo": lo, "counts": counts, "total": total,
-            "remapped": remapped,
+            "fan1": fan1, "remapped": remapped,
         })
         # depth-1 pipeline: settle the PREVIOUS batch — its total has
         # been in flight while this batch's upstream ran on device
@@ -2102,7 +2472,6 @@ class LookupJoinOperator(Operator):
     def _expand_oldest(self) -> None:
         rec = self._probe_pending.pop(0)
         ls, build, probe = rec["ls"], rec["build"], rec["probe"]
-        out_cap = bucket_capacity(max(int(rec["total"]), 1))
         # pair-column verify only when every key is a pass-through
         # column (a dictionary remap substitutes codes the pair batch
         # does not carry)
@@ -2110,17 +2479,39 @@ class LookupJoinOperator(Operator):
         if not rec.get("remapped") and self._bridge.build_key_channels:
             pkc = tuple(self._keys)
             bkc = tuple(self._bridge.build_key_channels)
-        pi, bi, ok, pairs = _expand_pairs(
-            ls, probe, build, rec["keys"], rec["valids"],
-            rec["lo"], rec["counts"], out_cap, pkc=pkc, bkc=bkc,
-        )
-        if self._residual_fn is not None:
-            ok = ok & self._residual_fn(pairs)
-            pairs = RelBatch(pairs.columns, ok)
+        total = int(rec["total"])
+        dense = total * 4 >= rec["probe"].capacity
+        if dense and "fan1" in rec and bool(rec["fan1"]):
+            # fanout<=1 (PK-side FK join) AND most probe rows match:
+            # pairs = probe batch + one matched build row, probe
+            # columns untouched — skips the repeat expansion AND every
+            # probe-side gather. Sparse joins keep the exact-capacity
+            # expansion below: reusing the 4M-padded probe batch for a
+            # 30k-match join would drag the FULL padding through every
+            # downstream operator (measured 4x on TPC-H Q3)
+            pi, bi, ok, pairs = _expand_pairs_fanout1(
+                ls, probe, build, rec["keys"], rec["valids"],
+                rec["lo"], rec["counts"], pkc=pkc, bkc=bkc,
+            )
+            if self._residual_fn is not None:
+                ok = ok & self._residual_fn(pairs)
+                pairs = RelBatch(pairs.columns, ok)
+            matched = ok
+        else:
+            out_cap = bucket_capacity(max(total, 1))
+            pi, bi, ok, pairs = _expand_pairs(
+                ls, probe, build, rec["keys"], rec["valids"],
+                rec["lo"], rec["counts"], out_cap, pkc=pkc, bkc=bkc,
+            )
+            if self._residual_fn is not None:
+                ok = ok & self._residual_fn(pairs)
+                pairs = RelBatch(pairs.columns, ok)
+            matched = None
         if self._type == "inner":
             self._outputs.append(pairs)
             return
-        matched = _segment_any(rec["counts"], pi, ok, probe.capacity)
+        if matched is None:
+            matched = _segment_any(rec["counts"], pi, ok, probe.capacity)
         if self._type == "semi":
             self._outputs.append(probe.mask(matched))
             return
@@ -2275,6 +2666,8 @@ class DynamicFilterOperator(Operator):
         key_dicts = self._bridge.key_dicts or [None] * len(self._keys)
         active = []
         for i, c in enumerate(self._keys):
+            if getattr(probe.columns[c].data, "ndim", 1) == 2:
+                continue  # long-decimal keys: no scalar min/max domain
             probe_dict = probe.columns[c].dictionary
             if key_dicts[i] is None and probe_dict is None:
                 active.append((i, c))
@@ -2322,10 +2715,19 @@ def _consolidate_compact(parts: Tuple[RelBatch, ...]) -> RelBatch:
 
 @partial(jax.jit, static_argnames=("b",))
 def _cross_row(probe: RelBatch, build: RelBatch, b: int) -> RelBatch:
+    def bcast(c):
+        # long-decimal columns broadcast their (2,) limb row
+        shape = (
+            (probe.capacity, 2)
+            if getattr(c.data, "ndim", 1) == 2
+            else (probe.capacity,)
+        )
+        return jnp.broadcast_to(c.data[b], shape)
+
     bcols = [
         Column(
             c.type,
-            jnp.broadcast_to(c.data[b], (probe.capacity,)),
+            bcast(c),
             None
             if c.valid is None
             else jnp.broadcast_to(c.valid[b], (probe.capacity,)),

@@ -408,6 +408,8 @@ def _dec_col(take):
     (n_rows,) = _U32.unpack(take(4))
     (nbytes,) = _U64.unpack(take(8))
     data = np.frombuffer(take(nbytes), dtype=dtype).copy()
+    if t.lanes == 2:  # long-decimal (n, 2) limb pairs flatten on wire
+        data = data.reshape(-1, 2)
     if data.shape[0] != n_rows:
         raise ValueError("column length does not match row count")
     valid = None

@@ -32,6 +32,7 @@ import numpy as np
 from trino_tpu import types as T
 from trino_tpu.block import Column, Dictionary, RelBatch
 from trino_tpu.expr import functions as F
+from trino_tpu.ops import int128 as I128
 from trino_tpu.ops.gather import take_clip
 from trino_tpu.expr.ir import Call, Case, Cast, Expr, InList, InputRef, Literal
 
@@ -85,9 +86,13 @@ class Bound:
 
 
 def scale_decimal_value(v, t: T.DataType) -> int:
-    """Python value -> scaled int64 payload, rounding half away from zero
-    (matches the device-side cast path; python round() is banker's)."""
+    """Python value -> scaled int payload, rounding half away from zero
+    (matches the device-side cast path; python round() is banker's).
+    Integer inputs scale exactly — float round-tripping would corrupt
+    >53-bit (long-decimal) magnitudes."""
     sf = T.decimal_scale_factor(t)
+    if isinstance(v, int) and not isinstance(v, bool):
+        return v * sf
     x = v * sf
     return int(math.floor(abs(x) + 0.5)) * (1 if x >= 0 else -1)
 
@@ -134,12 +139,137 @@ def _py_soundex(s: str) -> str:
     return ("".join(out) + "000")[:4]
 
 
+def _dict_code_const(probe: "Bound", dictionary):
+    """Constant probe -> comparable device value: dictionary code for
+    string elements (absent value = sentinel that matches nothing).
+    Column-valued probes need per-row flat broadcasting the vectorized
+    paths do not do yet — fail loudly instead of silently mismatching."""
+    if not probe.is_const or probe.const_value is None:
+        raise NotImplementedError(
+            "array/map search functions take a constant search value"
+        )
+    if dictionary is not None:
+        code = dictionary.code(probe.const_value)
+        return jnp.int32(code if code is not None and code >= 0 else -2)
+    return jnp.asarray(probe.const_value)
+
+
+def minmax_like(dtype, is_min: bool):
+    import numpy as _np
+
+    if _np.issubdtype(_np.dtype(dtype), _np.floating):
+        return _np.inf if is_min else -_np.inf
+    info = _np.iinfo(_np.dtype(dtype))
+    return info.max if is_min else info.min
+
+
+# Probability/statistics scalar family (MathFunctions *_cdf /
+# WilsonInterval): plain float64 formulas over jax.scipy.special.
+def _make_prob_fns():
+    import jax.scipy.special as jsp
+
+    def binomial_cdf(n, p, k):
+        kf = jnp.floor(k)
+        return jnp.where(
+            kf >= n, 1.0,
+            jnp.where(kf < 0, 0.0, jsp.betainc(n - kf, kf + 1.0, 1.0 - p)),
+        )
+
+    def wilson(s, n, z, sign):
+        p = s / n
+        z2 = z * z
+        center = p + z2 / (2 * n)
+        half = z * jnp.sqrt(p * (1 - p) / n + z2 / (4 * n * n))
+        return (center + sign * half) / (1 + z2 / n)
+
+    return {
+        "cauchy_cdf": (3, lambda m, s, x: 0.5 + jnp.arctan2(x - m, s) / jnp.pi),
+        "chi_squared_cdf": (2, lambda df, x: jsp.gammainc(df / 2.0, x / 2.0)),
+        "gamma_cdf": (3, lambda sh, sc, x: jsp.gammainc(sh, x / sc)),
+        "poisson_cdf": (2, lambda lam, k: jsp.gammaincc(jnp.floor(k) + 1.0, lam)),
+        "beta_cdf": (3, jsp.betainc),
+        "f_cdf": (3, lambda d1, d2, x: jsp.betainc(
+            d1 / 2.0, d2 / 2.0, d1 * x / (d1 * x + d2))),
+        "binomial_cdf": (3, binomial_cdf),
+        "laplace_cdf": (3, lambda m, b, x: jnp.where(
+            x < m, 0.5 * jnp.exp((x - m) / b),
+            1.0 - 0.5 * jnp.exp(-(x - m) / b))),
+        "logistic_cdf": (3, lambda a, b, x: 1.0 / (1.0 + jnp.exp(-(x - a) / b))),
+        "weibull_cdf": (3, lambda a, b, x: jnp.where(
+            x <= 0, 0.0, 1.0 - jnp.exp(-((x / b) ** a)))),
+        "inverse_beta_cdf": (3, lambda a, b, p: jsp.betaincinv(a, b, p))
+        if hasattr(jsp, "betaincinv") else None,
+        "wilson_interval_lower": (3, lambda s, n, z: wilson(s, n, z, -1.0)),
+        "wilson_interval_upper": (3, lambda s, n, z: wilson(s, n, z, 1.0)),
+    }
+
+
+_PROB_FNS = {k: v for k, v in _make_prob_fns().items() if v is not None}
+
+
 def _const(shape_src, value, dtype) -> jnp.ndarray:
     # shape reference may be a nested Column object (nested columns flow
     # through the cols list whole — their data array carries the shape)
     if hasattr(shape_src, "data") and not hasattr(shape_src, "shape"):
         shape_src = shape_src.data
-    return jnp.full(shape_src.shape, value, dtype=dtype)
+    n = shape_src.shape[0]
+    return jnp.full((n,), value, dtype=dtype)
+
+
+# -- Int128 lane plumbing (decimal(19..38): (n, 2) limb arrays) -------------
+
+
+def _rows_of(shape_src) -> int:
+    if hasattr(shape_src, "data") and not hasattr(shape_src, "shape"):
+        shape_src = shape_src.data
+    return shape_src.shape[0]
+
+
+def _phys_const(shape_src, t: T.DataType, value128=(0, 0)):
+    """Zero/constant array in t's physical shape."""
+    n = _rows_of(shape_src)
+    if t.lanes == 2:
+        return jnp.broadcast_to(
+            jnp.asarray(value128, jnp.int64), (n, 2)
+        )
+    return jnp.full((n,), value128[1], dtype=t.dtype)
+
+
+def _split2(d):
+    return d[:, 0], d[:, 1]
+
+
+def _join2(h, lo):
+    return jnp.stack([h, lo], axis=-1)
+
+
+def _lift128(d, t: T.DataType):
+    """Physical numeric data (scaled int64 decimal or integer) ->
+    (hi, lo) limbs at the same scale."""
+    if t.is_long_decimal:
+        return _split2(d)
+    return I128.from_i64(d.astype(jnp.int64))
+
+
+def _f64_of_decimal(d, t: T.DataType):
+    """Decimal physical -> float64 value (lossy beyond 2^53, like any
+    decimal->double cast)."""
+    sf = T.decimal_scale_factor(t)
+    if t.is_long_decimal:
+        h, lo = _split2(d)
+        u = jnp.where(
+            lo < 0, lo.astype(jnp.float64) + 2.0 ** 64,
+            lo.astype(jnp.float64),
+        )
+        return (h.astype(jnp.float64) * 2.0 ** 64 + u) / sf
+    return d.astype(jnp.float64) / sf
+
+
+def _where_lanes(cond, a, b):
+    """jnp.where that broadcasts the condition over 2-lane decimals."""
+    if getattr(a, "ndim", 1) == 2 or getattr(b, "ndim", 1) == 2:
+        cond = cond[:, None]
+    return jnp.where(cond, a, b)
 
 
 class ExprBinder:
@@ -185,10 +315,40 @@ class ExprBinder:
             def fn(cols, valids):
                 ref = cols[0] if cols else jnp.zeros(1)
                 return (
-                    _const(ref, 0, t.dtype),
+                    _phys_const(ref, t),
                     _const(ref, False, jnp.bool_),
                 )
             return Bound(t, fn)
+        if t.is_array and isinstance(e.value, tuple):
+            # constant ARRAY[...] literal: CANONICAL layout — one flat
+            # slice PER ROW (tiled). Shared-slice views would break
+            # every repacking consumer (filter/array_distinct/...,
+            # which assume non-overlapping [start, start+len) extents).
+            from trino_tpu.block import ArrayColumn, Column as BCol
+
+            proto = BCol.from_pylist(
+                t.element, list(e.value) or [None],
+                capacity=max(len(e.value), 1),
+            )
+            k = len(e.value)
+            def afn(cols, valids, proto=proto, k=k, t=t):
+                ref = cols[0] if cols else jnp.zeros(1)
+                n = _rows_of(ref)
+                reps = max(k, 1)
+                flat = Column(
+                    t.element,
+                    jnp.tile(proto.data[:reps], (n,) + (1,) * (proto.data.ndim - 1)),
+                    None if proto.valid is None else jnp.tile(proto.valid[:reps], n),
+                    proto.dictionary,
+                )
+                return (
+                    ArrayColumn(
+                        t, jnp.full(n, k, jnp.int32), None, None,
+                        jnp.arange(n, dtype=jnp.int32) * reps, flat,
+                    ),
+                    None,
+                )
+            return Bound(t, afn, const_value=e.value, is_const=True)
         if t.is_string:
             d = Dictionary([e.value])
             def sfn(cols, valids, d=d):
@@ -198,6 +358,12 @@ class ExprBinder:
         v = e.value
         if t.is_decimal:
             v = scale_decimal_value(v, t)
+            if t.is_long_decimal:
+                pair = I128.from_python(v)
+                def lfn(cols, valids, pair=pair, t=t):
+                    ref = cols[0] if cols else jnp.zeros(1)
+                    return _phys_const(ref, t, pair), None
+                return Bound(t, lfn, const_value=e.value, is_const=True)
         def vfn(cols, valids, v=v, t=t):
             ref = cols[0] if cols else jnp.zeros(1)
             return _const(ref, v, t.dtype), None
@@ -225,11 +391,20 @@ class ExprBinder:
         if src.kind == T.TypeKind.UNKNOWN:  # NULL literal cast
             def nfn(cols, valids, afn=a.fn, dst=dst):
                 d, _ = afn(cols, valids)
-                return _const(d, 0, dst.dtype), _const(d, False, jnp.bool_)
+                return _phys_const(d, dst), _const(d, False, jnp.bool_)
             return Bound(dst, nfn)
         if src.is_decimal and dst.is_decimal:
             return self._rescaled(a, src.scale or 0, dst.scale or 0, dst)
         if src.is_decimal and dst.is_integerlike:
+            if src.is_long_decimal:
+                k = src.scale or 0
+                def dlifn(cols, valids, afn=a.fn, k=k):
+                    d, v = afn(cols, valids)
+                    h, lo = I128.rescale_down_round(*_split2(d), k)
+                    x, ok = I128.to_i64(h, lo)
+                    v2 = ok if v is None else (v & ok)
+                    return x.astype(dst.dtype), v2
+                return Bound(dst, dlifn)
             sf = T.decimal_scale_factor(src)
             def difn(cols, valids, afn=a.fn):
                 d, v = afn(cols, valids)
@@ -237,12 +412,18 @@ class ExprBinder:
                 return q.astype(dst.dtype), v
             return Bound(dst, difn)
         if src.is_decimal and dst.is_floating:
-            sf = T.decimal_scale_factor(src)
-            def dffn(cols, valids, afn=a.fn):
+            def dffn(cols, valids, afn=a.fn, src=src):
                 d, v = afn(cols, valids)
-                return d.astype(dst.dtype) / sf, v
+                return _f64_of_decimal(d, src).astype(dst.dtype), v
             return Bound(dst, dffn)
         if src.is_integerlike and dst.is_decimal:
+            if dst.is_long_decimal:
+                k = dst.scale or 0
+                def ilfn(cols, valids, afn=a.fn, k=k):
+                    d, v = afn(cols, valids)
+                    h, lo = I128.rescale_up(*I128.from_i64(d.astype(jnp.int64)), k)
+                    return _join2(h, lo), v
+                return Bound(dst, ilfn)
             sf = T.decimal_scale_factor(dst)
             def idfn(cols, valids, afn=a.fn):
                 d, v = afn(cols, valids)
@@ -250,6 +431,18 @@ class ExprBinder:
             return Bound(dst, idfn)
         if src.is_floating and dst.is_decimal:
             sf = T.decimal_scale_factor(dst)
+            if dst.is_long_decimal:
+                def flfn(cols, valids, afn=a.fn):
+                    d, v = afn(cols, valids)
+                    x = F.round_half_away(d.astype(jnp.float64) * sf)
+                    # split the (lossy beyond 2^53 anyway) float into limbs
+                    h = jnp.floor(x / 2.0 ** 64)
+                    lo_f = x - h * 2.0 ** 64
+                    lo = jnp.where(
+                        lo_f >= 2.0 ** 63, lo_f - 2.0 ** 64, lo_f
+                    ).astype(jnp.int64)
+                    return _join2(h.astype(jnp.int64), lo), v
+                return Bound(dst, flfn)
             def fdfn(cols, valids, afn=a.fn):
                 d, v = afn(cols, valids)
                 return F.round_half_away(d * sf).astype(dst.dtype), v
@@ -297,9 +490,75 @@ class ExprBinder:
                     vv = in_range if v is None else (v & in_range)
                     return out, vv
                 return Bound(dst, sfn, d)
+        if src.is_string and dst.is_decimal:
+            from decimal import Decimal, InvalidOperation
+
+            def parse(txt):
+                try:
+                    v = Decimal(txt) * (10 ** (dst.scale or 0))
+                    return int(v.to_integral_value())
+                except (InvalidOperation, ValueError):
+                    return None
+
+            if a.is_const:
+                sv = parse(str(a.const_value))
+                if sv is None:
+                    def nullfn(cols, valids):
+                        ref = cols[0] if cols else jnp.zeros(1)
+                        return _phys_const(ref, dst), _const(ref, False, jnp.bool_)
+                    return Bound(dst, nullfn)
+                if dst.is_long_decimal:
+                    pair = I128.from_python(sv)
+                    def lcfn(cols, valids, pair=pair):
+                        ref = cols[0] if cols else jnp.zeros(1)
+                        return _phys_const(ref, dst, pair), None
+                    return Bound(dst, lcfn, const_value=a.const_value, is_const=True)
+                def scfn(cols, valids, sv=sv):
+                    ref = cols[0] if cols else jnp.zeros(1)
+                    return _const(ref, sv, dst.dtype), None
+                return Bound(dst, scfn, const_value=a.const_value, is_const=True)
+            if a.dictionary is not None:
+                parsed = [parse(v) for v in a.dictionary.values]
+                ok_tab = jnp.asarray(
+                    [p is not None for p in parsed] or [False], jnp.bool_
+                )
+                if dst.is_long_decimal:
+                    pairs = [
+                        I128.from_python(p if p is not None else 0)
+                        for p in parsed
+                    ] or [(0, 0)]
+                    tab = jnp.asarray(pairs, jnp.int64)
+                else:
+                    tab = jnp.asarray(
+                        [p if p is not None else 0 for p in parsed] or [0],
+                        jnp.int64,
+                    )
+                def dfn(cols, valids, afn=a.fn):
+                    d, v = afn(cols, valids)
+                    idx = jnp.clip(d, 0, tab.shape[0] - 1)
+                    out = jnp.take(tab, idx, axis=0)
+                    okv = jnp.take(ok_tab, idx)
+                    return out, okv if v is None else (v & okv)
+                return Bound(dst, dfn)
         raise NotImplementedError(f"cast {src} -> {dst}")
 
     def _rescaled(self, a: Bound, sfrom: int, sto: int, out_type: T.DataType) -> Bound:
+        in_long = a.type.is_long_decimal
+        out_long = out_type.is_long_decimal
+        if in_long or out_long:
+            atype = a.type
+            def lfn(cols, valids, afn=a.fn):
+                d, v = afn(cols, valids)
+                h, lo = _lift128(d, atype)
+                if sto > sfrom:
+                    h, lo = I128.rescale_up(h, lo, sto - sfrom)
+                elif sfrom > sto:
+                    h, lo = I128.rescale_down_round(h, lo, sfrom - sto)
+                if out_long:
+                    return _join2(h, lo), v
+                x, ok = I128.to_i64(h, lo)
+                return x, ok if v is None else (v & ok)
+            return Bound(out_type, lfn)
         if sfrom == sto:
             return Bound(out_type, a.fn)
         if sto > sfrom:
@@ -343,6 +602,13 @@ class ExprBinder:
             results = [self._remap_to(r, out_dict) for r in results]
             if default is not None:
                 default = self._remap_to(default, out_dict)
+        elif e.type.is_decimal:
+            # numeric branches coerce through the REAL cast path (a
+            # dtype view is not enough once scales differ or the output
+            # is an Int128 (n, 2) decimal)
+            results = [self._coerce_bound(r, e.type) for r in results]
+            if default is not None:
+                default = self._coerce_bound(default, e.type)
         out_t = e.type
 
         def fn(cols, valids):
@@ -352,20 +618,381 @@ class ExprBinder:
                 data = data.astype(out_t.dtype)
             else:
                 ref, _ = conds[0].fn(cols, valids)
-                data = _const(ref, 0, out_t.dtype)
+                data = _phys_const(ref, out_t)
                 valid = _const(ref, False, jnp.bool_)
             # fold WHENs back-to-front so the first true wins
             for cb, rb in reversed(list(zip(conds, results))):
                 cd, cv = cb.fn(cols, valids)
                 take = cd if cv is None else (cd & cv)  # NULL cond = false
                 rd, rv = rb.fn(cols, valids)
-                data = jnp.where(take, rd.astype(out_t.dtype), data)
+                data = _where_lanes(take, rd.astype(out_t.dtype), data)
                 rvv = rv if rv is not None else _const(rd, True, jnp.bool_)
                 vv = valid if valid is not None else _const(rd, True, jnp.bool_)
                 valid = jnp.where(take, rvv, vv)
             return data, valid
 
         return Bound(out_t, fn, out_dict)
+
+    # ---- higher-order (lambda) functions over nested columns ----
+    @staticmethod
+    def _lambda_body_ir(body):
+        """Rewrite LambdaVar leaves into InputRefs so the body binds as
+        an ordinary expression over the flat element column(s)."""
+        from trino_tpu.expr import ir as _ir
+
+        def sub(x):
+            if isinstance(x, _ir.LambdaVar):
+                return _ir.InputRef(x.index, x.type)
+            if isinstance(x, _ir.Call):
+                return _ir.Call(x.name, tuple(sub(a) for a in x.args), x.type)
+            if isinstance(x, _ir.Cast):
+                return _ir.Cast(sub(x.arg), x.type)
+            if isinstance(x, _ir.Case):
+                return _ir.Case(
+                    tuple(sub(c) for c in x.conds),
+                    tuple(sub(r) for r in x.results),
+                    None if x.default is None else sub(x.default),
+                    x.type,
+                )
+            if isinstance(x, _ir.InList):
+                return _ir.InList(sub(x.value), x.options)
+            return x  # Literal / InputRef
+
+        return sub(body)
+
+    @staticmethod
+    def _seg_counts(flags, starts, lengths):
+        """Per-row count of true flags inside [start, start+len)."""
+        f32 = flags.astype(jnp.int32)
+        ce = jnp.cumsum(f32)
+        exc = ce - f32
+        n = flags.shape[0]
+        ends = jnp.clip(starts + lengths - 1, 0, max(n - 1, 0))
+        s = jnp.clip(starts, 0, max(n - 1, 0))
+        cnt = take_clip(ce, ends) - take_clip(exc, s)
+        return jnp.where(lengths > 0, cnt, 0)
+
+    def _bind_lambda_fn(self, e: Call) -> Bound:
+        from trino_tpu.block import ArrayColumn, MapColumn
+        from trino_tpu.expr import ir as _ir
+
+        name = e.name
+        coll_b = self.bind(e.args[0])
+        lam: _ir.LambdaExpr = e.args[1]
+        body_ir = self._lambda_body_ir(lam.body)
+        out_t = e.type
+
+        def body_over(flat_cols):
+            """Bind + evaluate the body over flat element Columns."""
+            binder = ExprBinder(
+                [c.type for c in flat_cols],
+                [c.dictionary for c in flat_cols],
+            )
+            b = binder.bind(body_ir)
+            cols = [
+                c if c.type.is_nested else c.data for c in flat_cols
+            ]
+            vals = [c.valid for c in flat_cols]
+            d, v = b.fn(cols, vals)
+            return d, v, b.dictionary
+
+        def fn(cols, valids):
+            c, cv = coll_b.fn(cols, valids)
+            if name in ("transform", "filter", "any_match", "all_match",
+                        "none_match"):
+                flat = [c.flat]
+            else:
+                flat = [c.flat_keys, c.flat_values]
+            d, v, bdict = body_over(flat)
+            lengths = c.data
+            starts = c.starts
+            if name == "transform":
+                out_flat = Column(out_t.element, d, v, bdict)
+                return (
+                    ArrayColumn(out_t, lengths, c.valid, None, starts,
+                                out_flat),
+                    cv,
+                )
+            if name in ("any_match", "all_match", "none_match"):
+                keep = d if v is None else (d & v)
+                cnt = self._seg_counts(keep, starts, lengths)
+                if name == "any_match":
+                    return cnt > 0, cv
+                if name == "none_match":
+                    return cnt == 0, cv
+                return cnt == lengths, cv
+            # filter / map_filter / transform_values / transform_keys
+            if name in ("filter", "map_filter"):
+                keep = d if v is None else (d & v)
+                cnt = self._seg_counts(keep, starts, lengths)
+                order = jnp.argsort(~keep, stable=True)
+                new_starts = jnp.cumsum(cnt) - cnt
+                if name == "filter":
+                    return (
+                        ArrayColumn(out_t, cnt, c.valid, None,
+                                    new_starts.astype(jnp.int32),
+                                    c.flat.gather(order)),
+                        cv,
+                    )
+                return (
+                    MapColumn(out_t, cnt, c.valid, None,
+                              new_starts.astype(jnp.int32),
+                              c.flat_keys.gather(order),
+                              c.flat_values.gather(order)),
+                    cv,
+                )
+            if name == "transform_values":
+                return (
+                    MapColumn(out_t, lengths, c.valid, None, starts,
+                              c.flat_keys, Column(out_t.element, d, v, bdict)),
+                    cv,
+                )
+            # transform_keys
+            return (
+                MapColumn(out_t, lengths, c.valid, None, starts,
+                          Column(out_t.key, d, v, bdict), c.flat_values),
+                cv,
+            )
+
+        return Bound(out_t, fn)
+
+    # ---- array/map column functions (ArrayFunctions analogues) ----
+    _ARRAY_FNS = (
+        "slice", "trim_array", "repeat", "array_sort", "array_distinct",
+        "array_position", "array_remove", "array_contains",
+        "array_min_col", "array_max_col", "map_contains_key", "split",
+    )
+
+    @staticmethod
+    def _flat_rowids(starts, lengths, n_flat):
+        """Row index of every flat element (canonical non-overlapping
+        slices — constant arrays fold before reaching here)."""
+        iota = jnp.arange(n_flat, dtype=jnp.int32)
+        return (
+            jnp.searchsorted(starts, iota, side="right").astype(jnp.int32)
+            - 1
+        )
+
+    def _bind_array_fn(self, e: Call, args) -> Bound:
+        from trino_tpu.block import ArrayColumn, MapColumn
+
+        name = e.name
+        out_t = e.type
+        a = args[0]
+
+        def compact(c, keep, out_t):
+            cnt = self._seg_counts(keep, c.starts, c.data)
+            order = jnp.argsort(~keep, stable=True)
+            new_starts = (jnp.cumsum(cnt) - cnt).astype(jnp.int32)
+            return ArrayColumn(
+                out_t, cnt, c.valid, None, new_starts,
+                c.flat.gather(order),
+            )
+
+        def fn(cols, valids):
+            c, cv = a.fn(cols, valids)
+            if name == "repeat":
+                # repeat(x, n): each row's value tiled n times; x rides
+                # as a plain scalar column
+                n_rep = int(args[1].const_value)
+                x = c  # scalar data array
+                rows = _rows_of(x)
+                flat = Column(
+                    out_t.element, jnp.repeat(x, n_rep, axis=0),
+                    None if cv is None else jnp.repeat(cv, n_rep),
+                    a.dictionary,
+                )
+                return (
+                    ArrayColumn(
+                        out_t,
+                        jnp.full(rows, n_rep, jnp.int32),
+                        None,
+                        None,
+                        (jnp.arange(rows, dtype=jnp.int32) * n_rep),
+                        flat,
+                    ),
+                    None,
+                )
+            lengths, starts = c.data, c.starts
+            if name == "slice":
+                start = args[1]
+                ln = args[2]
+                sd, sv = start.fn(cols, valids)
+                ld, lv = ln.fn(cols, valids)
+                sd = sd.astype(jnp.int32)
+                ld = jnp.maximum(ld.astype(jnp.int32), 0)
+                off = jnp.where(sd > 0, sd - 1, lengths + sd)
+                off = jnp.clip(off, 0, lengths)
+                new_len = jnp.clip(ld, 0, lengths - off)
+                return (
+                    ArrayColumn(out_t, new_len, c.valid, None,
+                                starts + off, c.flat),
+                    merge_valid(cv, sv, lv),
+                )
+            if name == "trim_array":
+                nd, nv = args[1].fn(cols, valids)
+                new_len = jnp.clip(
+                    lengths - nd.astype(jnp.int32), 0, lengths
+                )
+                return (
+                    ArrayColumn(out_t, new_len, c.valid, None, starts,
+                                c.flat),
+                    merge_valid(cv, nv),
+                )
+            if name == "map_contains_key":
+                probe = args[1]
+                kflat = c.flat_keys
+                pd = _dict_code_const(probe, kflat.dictionary)
+                match = kflat.data == pd
+                if kflat.valid is not None:
+                    match = match & kflat.valid
+                cnt = self._seg_counts(match, starts, lengths)
+                return cnt > 0, cv
+            flat = c.flat
+            n_flat = flat.data.shape[0]
+            if name == "array_contains":
+                probe = args[1]
+                pd = _dict_code_const(probe, flat.dictionary)
+                match = flat.data == pd
+                if flat.valid is not None:
+                    match = match & flat.valid
+                cnt = self._seg_counts(match, starts, lengths)
+                return cnt > 0, cv
+            rowid = self._flat_rowids(starts, lengths, n_flat)
+            cap = lengths.shape[0]
+            if name in ("array_min_col", "array_max_col"):
+                vals = flat.data
+                big = minmax_like(vals.dtype, name.endswith("min_col"))
+                w = (
+                    jnp.ones(n_flat, jnp.bool_)
+                    if flat.valid is None else flat.valid
+                )
+                contrib = jnp.where(w, vals, jnp.asarray(big, vals.dtype))
+                red = (
+                    jax.ops.segment_min
+                    if name.endswith("min_col")
+                    else jax.ops.segment_max
+                )
+                out = red(contrib, rowid, num_segments=cap)
+                has = self._seg_counts(w, starts, lengths) > 0
+                valid = has if cv is None else (has & cv)
+                return out, valid
+            if name == "array_position":
+                probe = args[1]
+                pd = _dict_code_const(probe, flat.dictionary)
+                match = flat.data == pd
+                if flat.valid is not None:
+                    match = match & flat.valid
+                pos_in_row = (
+                    jnp.arange(n_flat, dtype=jnp.int32)
+                    - take_clip(starts, rowid)
+                )
+                score = jnp.where(match, pos_in_row, jnp.int32(1 << 30))
+                first = jax.ops.segment_min(
+                    score, rowid, num_segments=cap
+                )
+                out = jnp.where(
+                    first < (1 << 30), first.astype(jnp.int64) + 1,
+                    jnp.int64(0),
+                )
+                return out, cv
+            if name == "array_remove":
+                probe = args[1]
+                pd = _dict_code_const(probe, flat.dictionary)
+                keep = flat.data != pd
+                if flat.valid is not None:
+                    keep = keep | ~flat.valid  # NULL elements stay
+                return compact(c, keep, out_t), cv
+            # array_sort / array_distinct: stable in-segment value sort
+            from trino_tpu.ops.sort import _order_value
+
+            ov = _order_value(
+                flat.data
+                if getattr(flat.data, "ndim", 1) == 1
+                else flat.data[:, 0],
+                False,
+            )
+            iota = jnp.arange(n_flat, dtype=jnp.int32)
+            _, sval, perm = jax.lax.sort(
+                (rowid, ov, iota), num_keys=2
+            )
+            sorted_flat = flat.gather(perm)
+            if name == "array_sort":
+                return (
+                    ArrayColumn(out_t, lengths, c.valid, None, starts,
+                                sorted_flat),
+                    cv,
+                )
+            # array_distinct (sorted order; Trino keeps first
+            # occurrence — documented ordering deviation)
+            srow = jax.lax.sort((rowid, ov, iota), num_keys=2)[0]
+            first_elem = jnp.concatenate([
+                jnp.ones(1, jnp.bool_),
+                (sval[1:] != sval[:-1]) | (srow[1:] != srow[:-1]),
+            ]) if n_flat else jnp.ones(0, jnp.bool_)
+            c_sorted = ArrayColumn(
+                out_t, lengths, c.valid, None, starts, sorted_flat
+            )
+            # keep flags are in SORTED flat order; recompute per-row
+            # counts against the sorted layout's segments: rows keep
+            # their [start, start+len) extents after an in-segment sort
+            return compact(c_sorted, first_elem, out_t), cv
+
+        return Bound(out_t, fn)
+
+    def _bind_split(self, e: Call, args) -> Bound:
+        """split(string, delimiter): per-dictionary-value split. The
+        output is CANONICAL — each row owns a W-wide flat slot (W = max
+        part count over the dictionary) with its true length, so
+        repacking consumers (filter/array_distinct/...) stay correct."""
+        from trino_tpu.block import ArrayColumn
+
+        a, delim = args[0], args[1]
+        assert delim.is_const, "split() delimiter must be constant"
+        sep = str(delim.const_value)
+        values = a.dictionary.values if a.dictionary else []
+        parts_per_code = [v.split(sep) if sep else [v] for v in values]
+        W = max((len(p) for p in parts_per_code), default=1)
+        out_dict = Dictionary(
+            sorted({p for parts in parts_per_code for p in parts}) or [""]
+        )
+        # (codes, W) table: row c = the parts of dictionary value c,
+        # padded with 0 (dead tail, masked by the true length)
+        table = np.zeros((max(len(values), 1), W), dtype=np.int32)
+        lens = np.zeros(max(len(values), 1), dtype=np.int32)
+        for c, parts in enumerate(parts_per_code):
+            lens[c] = len(parts)
+            for j, pv in enumerate(parts):
+                table[c, j] = out_dict.code(pv)
+        table_j = jnp.asarray(table)
+        lens_j = jnp.asarray(lens)
+        out_t = e.type
+
+        def fn(cols, valids):
+            d, v = a.fn(cols, valids)
+            code = jnp.clip(d, 0, max(len(values) - 1, 0))
+            rows = code.shape[0]
+            flat_codes = jnp.take(table_j, code, axis=0).reshape(-1)
+            flat = Column(T.VARCHAR, flat_codes, None, out_dict)
+            return (
+                ArrayColumn(
+                    out_t, take_clip(lens_j, code), v, None,
+                    jnp.arange(rows, dtype=jnp.int32) * W, flat,
+                ),
+                v,
+            )
+
+        return Bound(out_t, fn)
+
+    def _coerce_bound(self, b: Bound, out_t: T.DataType) -> Bound:
+        """Coerce an already-bound expression to a target type via the
+        cast machinery (branch unification for CASE/COALESCE)."""
+        if b.type == out_t:
+            return b
+        import types as _pytypes
+
+        shim = _pytypes.SimpleNamespace(type=out_t)
+        return self._bind_cast_from(shim, b)
 
     def _remap_to(self, b: Bound, target: Dictionary) -> Bound:
         if b.dictionary is None or b.dictionary == target:
@@ -406,8 +1033,20 @@ class ExprBinder:
         return Bound(T.BOOLEAN, fn)
 
     # ---- calls ----
+    _LAMBDA_FNS = (
+        "transform", "filter", "any_match", "all_match", "none_match",
+        "transform_values", "transform_keys", "map_filter",
+    )
+
     def _bind_call(self, e: Call) -> Bound:
         name = e.name
+        if name in self._LAMBDA_FNS:
+            return self._bind_lambda_fn(e)
+        if name in self._ARRAY_FNS:
+            args = [self.bind(a) for a in e.args]
+            if name == "split":
+                return self._bind_split(e, args)
+            return self._bind_array_fn(e, args)
         if name in ("and", "or"):
             return self._bind_logical(e)
         args = [self.bind(a) for a in e.args]
@@ -704,6 +1343,28 @@ class ExprBinder:
                 return 1.0 / jnp.tan(d.astype(jnp.float64) / sf_a), v
 
             return Bound(T.DOUBLE, cotfn)
+        if name in _PROB_FNS:
+            arity, pf = _PROB_FNS[name]
+            def probfn(cols, valids, args=args, pf=pf):
+                outs = [a.fn(cols, valids) for a in args]
+                v = merge_valid(*[o[1] for o in outs])
+                ds = [
+                    _f64_of_decimal(o[0], a.type)
+                    if a.type.is_decimal
+                    else o[0].astype(jnp.float64)
+                    for a, o in zip(args, outs)
+                ]
+                return pf(*ds), v
+            return Bound(T.DOUBLE, probfn)
+        if name == "year_of_week":
+            a = args[0]
+            def yowfn(cols, valids, a=a):
+                d, v = a.fn(cols, valids)
+                days = self._to_days(a, d)
+                # ISO week-year = calendar year of that week's Thursday
+                thu = days - (F.day_of_week(days) - 1) + 3
+                return F.extract_year(thu).astype(jnp.int64), v
+            return Bound(T.BIGINT, yowfn)
         if name in ("normal_cdf", "inverse_normal_cdf", "width_bucket"):
             # numeric args arrive in their PHYSICAL form (decimal =
             # scaled int64): descale to doubles before the math
@@ -787,7 +1448,10 @@ class ExprBinder:
             def fufn(cols, valids, a=a, sf_a=sf_a):
                 d, v = a.fn(cols, valids)
                 secs = d.astype(jnp.float64) / sf_a
-                return (secs * 1e6).astype(jnp.int64), v
+                # rint, not truncation: negative fractional epochs
+                # (pre-1970) must round to the nearest microsecond
+                # (ADVICE r3: -0.5s is -500000us, not 0)
+                return jnp.rint(secs * 1e6).astype(jnp.int64), v
 
             return Bound(T.TIMESTAMP, fufn)
         if name == "to_unixtime":
@@ -2012,38 +2676,95 @@ class ExprBinder:
             return data, valid
         return Bound(T.BOOLEAN, fn)
 
+    def _bind_decimal128_comparison(self, op: str, a: Bound, b: Bound) -> Bound:
+        """Compare with at least one Int128-carried decimal: lift both
+        sides to limb pairs at the common scale and compare
+        lexicographically."""
+        sa = a.type.scale or 0 if a.type.is_decimal else 0
+        sb = b.type.scale or 0 if b.type.is_decimal else 0
+        sc = max(sa, sb)
+        at, bt = a.type, b.type
+
+        def fn(cols, valids):
+            ad, av = a.fn(cols, valids)
+            bd, bv = b.fn(cols, valids)
+            ah, al = _lift128(ad, at)
+            bh, bl = _lift128(bd, bt)
+            # scale unification can wrap mod 2^128 at extreme
+            # value x scale-gap combinations; those rows fall back to
+            # an approximate float64 comparison (documented corner)
+            wrap = jnp.zeros(ah.shape, jnp.bool_)
+
+            def lim(k):
+                return tuple(
+                    jnp.int64(x)
+                    for x in I128.from_python((2 ** 127 - 1) // 10 ** k)
+                )
+
+            if sa < sc:
+                lh, ll = lim(sc - sa)
+                xh, xl = I128.abs_(ah, al)
+                wrap = wrap | ~I128.lt(xh, xl, lh, ll)
+                ah, al = I128.rescale_up(ah, al, sc - sa)
+            if sb < sc:
+                lh, ll = lim(sc - sb)
+                xh, xl = I128.abs_(bh, bl)
+                wrap = wrap | ~I128.lt(xh, xl, lh, ll)
+                bh, bl = I128.rescale_up(bh, bl, sc - sb)
+            eqv = I128.eq(ah, al, bh, bl)
+            ltv = I128.lt(ah, al, bh, bl)
+            fa = _f64_of_decimal(ad, at) if at.is_decimal else ad.astype(jnp.float64)
+            fb = _f64_of_decimal(bd, bt) if bt.is_decimal else bd.astype(jnp.float64)
+            eqv = jnp.where(wrap, fa == fb, eqv)
+            ltv = jnp.where(wrap, fa < fb, ltv)
+            out = {
+                "eq": eqv, "ne": ~eqv, "lt": ltv, "le": ltv | eqv,
+                "gt": ~(ltv | eqv), "ge": ~ltv,
+            }[op]
+            return out, merge_valid(av, bv)
+
+        return Bound(T.BOOLEAN, fn)
+
     # ---- comparisons ----
     def _bind_comparison(self, op: str, args) -> Bound:
         a, b = args
         if a.type.is_string or b.type.is_string:
             return self._bind_string_comparison(op, a, b)
         # decimal: rescale BOTH sides (incl. a bare-integer side) to the
-        # common scale so scaled int64 compares against scaled int64
+        # common scale so scaled int64 compares against scaled int64;
+        # a long-decimal side switches the whole compare to Int128 limbs
+        if (a.type.is_long_decimal or b.type.is_long_decimal) and not (
+            a.type.is_floating or b.type.is_floating
+        ):
+            return self._bind_decimal128_comparison(op, a, b)
         if a.type.is_decimal or b.type.is_decimal:
-            sc = max(a.type.scale or 0 if a.type.is_decimal else 0,
-                     b.type.scale or 0 if b.type.is_decimal else 0)
-            def to_scale(x: Bound) -> Bound:
-                if x.type.is_decimal:
-                    return self._rescaled(x, x.type.scale or 0, sc, T.decimal(18, sc))
-                if x.type.is_integerlike:
-                    m = 10 ** sc
-                    def up(cols, valids, xfn=x.fn):
-                        d, v = xfn(cols, valids)
-                        return d.astype(jnp.int64) * m, v
-                    return Bound(T.decimal(18, sc), up)
-                return x  # floating side compares via promote below
-            a, b = to_scale(a), to_scale(b)
             if a.type.is_floating or b.type.is_floating:
-                # mixed decimal/double: bring decimal down to double
+                # mixed decimal/double: bring the decimal side (short
+                # or Int128) to double BEFORE any rescale — a detour
+                # through decimal(18) would overflow large values
                 def to_double(x: Bound) -> Bound:
                     if not x.type.is_decimal:
                         return x
-                    sf = T.decimal_scale_factor(x.type)
-                    def dn(cols, valids, xfn=x.fn):
+                    xt = x.type
+                    def dn(cols, valids, xfn=x.fn, xt=xt):
                         d, v = xfn(cols, valids)
-                        return d.astype(jnp.float64) / sf, v
+                        return _f64_of_decimal(d, xt), v
                     return Bound(T.DOUBLE, dn)
                 a, b = to_double(a), to_double(b)
+            else:
+                sc = max(a.type.scale or 0 if a.type.is_decimal else 0,
+                         b.type.scale or 0 if b.type.is_decimal else 0)
+                def to_scale(x: Bound) -> Bound:
+                    if x.type.is_decimal:
+                        return self._rescaled(x, x.type.scale or 0, sc, T.decimal(18, sc))
+                    if x.type.is_integerlike:
+                        m = 10 ** sc
+                        def up(cols, valids, xfn=x.fn):
+                            d, v = xfn(cols, valids)
+                            return d.astype(jnp.int64) * m, v
+                        return Bound(T.decimal(18, sc), up)
+                    return x
+                a, b = to_scale(a), to_scale(b)
         jf = {
             "eq": lambda x, y: x == y, "ne": lambda x, y: x != y,
             "lt": lambda x, y: x < y, "le": lambda x, y: x <= y,
@@ -2204,6 +2925,15 @@ class ExprBinder:
                 return jf(ad, bd), valid
             return Bound(out_type, ffn)
 
+        if (
+            a.type.is_long_decimal
+            or b.type.is_long_decimal
+            or out_type.is_long_decimal
+        ):
+            return self._bind_decimal128_arith(
+                op, out_type, a, b, sa, sb, so
+            )
+
         a, sa = to_scaled(a, sa)
         b, sb = to_scaled(b, sb)
         def fn(cols, valids):
@@ -2251,6 +2981,105 @@ class ExprBinder:
                 nv = valid if valid is not None else _const(ad, True, jnp.bool_)
                 return d, jnp.where(zero, False, nv)
             raise NotImplementedError(op)
+        return Bound(out_type, fn)
+
+    def _bind_decimal128_arith(
+        self, op: str, out_type: T.DataType, a: Bound, b: Bound,
+        sa: int, sb: int, so: int,
+    ) -> Bound:
+        """Int128-carried decimal arithmetic (DecimalOperators long
+        paths, spi/type/Int128Math.java). Result overflow past 38
+        digits and a divisor beyond int64 yield NULL (Trino raises
+        Decimal overflow — same deviation class as the engine's
+        division-by-zero NULL, see analyzer deviation notes)."""
+        at, bt = a.type, b.type
+
+        def out128(h, lo, valid):
+            ovf = I128.overflows_38(h, lo)
+            valid = (
+                ~ovf if valid is None else (valid & ~ovf)
+            )
+            if out_type.is_long_decimal:
+                return _join2(h, lo), valid
+            x, ok = I128.to_i64(h, lo)
+            return x, valid & ok
+
+        def fn(cols, valids):
+            ad, av = a.fn(cols, valids)
+            bd, bv = b.fn(cols, valids)
+            valid = merge_valid(av, bv)
+            ah, al = _lift128(ad, at)
+            bh, bl = _lift128(bd, bt)
+            if op in ("add", "sub"):
+                cs = max(sa, sb)
+                if sa < cs:
+                    ah, al = I128.rescale_up(ah, al, cs - sa)
+                if sb < cs:
+                    bh, bl = I128.rescale_up(bh, bl, cs - sb)
+                h, lo = (
+                    I128.add(ah, al, bh, bl)
+                    if op == "add"
+                    else I128.sub(ah, al, bh, bl)
+                )
+                if so > cs:
+                    h, lo = I128.rescale_up(h, lo, so - cs)
+                elif cs > so:
+                    h, lo = I128.rescale_down_round(h, lo, cs - so)
+                return out128(h, lo, valid)
+            if op == "mul":
+                h, lo = I128.mul_128(ah, al, bh, bl)
+                cs = sa + sb
+                if so > cs:
+                    h, lo = I128.rescale_up(h, lo, so - cs)
+                elif cs > so:
+                    h, lo = I128.rescale_down_round(h, lo, cs - so)
+                return out128(h, lo, valid)
+            if op in ("div", "mod"):
+                d64, ok_b = I128.to_i64(bh, bl)
+                zero = (bh == 0) & (bl == 0)
+                bad = zero | ~ok_b
+                safe = jnp.where(bad, jnp.int64(1), d64)
+                if op == "div":
+                    # result scale so: round(a * 10^(sb + so - sa) / b).
+                    # The rescale wraps mod 2^128 for |a| beyond
+                    # ~1.7e38/10^rf — those rows go NULL (the module's
+                    # overflow contract) instead of wrapping silently.
+                    rf = sb + so - sa
+                    lim_h, lim_l = (
+                        jnp.int64(x)
+                        for x in I128.from_python((2 ** 127 - 1) // 10 ** rf)
+                    )
+                    aah, aal = I128.abs_(ah, al)
+                    bad = bad | ~I128.lt(aah, aal, lim_h, lim_l)
+                    nh, nl = I128.rescale_up(ah, al, rf)
+                    h, lo = I128.div_round_i64(nh, nl, safe)
+                else:
+                    cs = max(sa, sb)
+                    if sa < cs:
+                        ah, al = I128.rescale_up(ah, al, cs - sa)
+                    # safe is b at scale sb; align to cs — int64 wrap
+                    # here would silently corrupt the remainder, so
+                    # out-of-range divisors go NULL like the int64-
+                    # overflow divisor case above
+                    lim = (2 ** 63 - 1) // (10 ** (cs - sb))
+                    bad = bad | (jnp.abs(safe) > lim)
+                    safe = jnp.where(bad, jnp.int64(1), safe)
+                    safe = safe * jnp.int64(10 ** (cs - sb))
+                    pa_h, pa_l = I128.abs_(ah, al)
+                    _, _, r = I128.divmod_u128_u64(pa_h, pa_l, jnp.abs(safe))
+                    sgn = I128.sign(ah, al)
+                    h, lo = I128.mul_128_64(
+                        jnp.int64(0) * r, r, sgn
+                    )
+                d, valid2 = out128(h, lo, valid)
+                nv = (
+                    valid2
+                    if valid2 is not None
+                    else _const(ad, True, jnp.bool_)
+                )
+                return d, jnp.where(bad, False, nv)
+            raise NotImplementedError(op)
+
         return Bound(out_type, fn)
 
 

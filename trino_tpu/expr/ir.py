@@ -48,6 +48,36 @@ class Literal(Expr):
 
 
 @dataclasses.dataclass(frozen=True)
+class LambdaVar(Expr):
+    """Parameter slot inside a lambda body (LambdaArgumentDeclaration
+    analogue): index 0..n-1 within the enclosing LambdaExpr."""
+
+    index: int
+    type: T.DataType
+
+    def __repr__(self):
+        return f"$lam{self.index}:{self.type}"
+
+
+@dataclasses.dataclass(frozen=True)
+class LambdaExpr(Expr):
+    """A lambda passed to a higher-order function: `body` is an Expr
+    over LambdaVar leaves only (captures of outer columns are rejected
+    at analysis — documented deviation from the reference's
+    LambdaExpression capture support)."""
+
+    body: Expr
+    n_params: int
+    type: T.DataType  # the body's result type
+
+    def children(self):
+        return (self.body,)
+
+    def __repr__(self):
+        return f"(lambda/{self.n_params} -> {self.body!r})"
+
+
+@dataclasses.dataclass(frozen=True)
 class Call(Expr):
     """Function/operator application — CallExpression. `name` indexes the
     scalar function registry (functions.py)."""

@@ -349,3 +349,62 @@ for name, lo, hi, ret, desc in [
     ("last_value", 1, 1, "same", "last value of the frame"),
 ]:
     _reg(name, "window", lo, hi, ret, desc)
+
+# --- r4 breadth: probability/statistics, bitwise, datetime, array/map,
+# lambdas (implementations in expr/compile.py) ---
+for name, lo, hi, desc in [
+    ("cauchy_cdf", 3, 3, "Cauchy cdf at x for (median, scale)"),
+    ("chi_squared_cdf", 2, 2, "chi-squared cdf at x for df"),
+    ("gamma_cdf", 3, 3, "gamma cdf at x for (shape, scale)"),
+    ("poisson_cdf", 2, 2, "Poisson cdf at k for lambda"),
+    ("beta_cdf", 3, 3, "beta cdf at x for (a, b)"),
+    ("f_cdf", 3, 3, "F cdf at x for (df1, df2)"),
+    ("binomial_cdf", 3, 3, "binomial cdf at k for (trials, p)"),
+    ("laplace_cdf", 3, 3, "Laplace cdf at x for (mean, scale)"),
+    ("logistic_cdf", 3, 3, "logistic cdf at x for (a, b)"),
+    ("weibull_cdf", 3, 3, "Weibull cdf at x for (a, b)"),
+    ("wilson_interval_lower", 3, 3, "Wilson score interval lower bound"),
+    ("wilson_interval_upper", 3, 3, "Wilson score interval upper bound"),
+]:
+    _reg(name, "scalar", lo, hi, "double", desc, rule=_DOUBLE)
+
+_reg("year_of_week", "scalar", 1, 1, "bigint",
+     "ISO week-numbering year", aliases=("yow",), rule=_BIGINT)
+
+_ARRAY0 = lambda a: a[0]  # noqa: E731
+for name, lo, hi, ret, desc, rule in [
+    ("slice", 3, 3, "array(E)", "subarray from position for length", _ARRAY0),
+    ("trim_array", 2, 2, "array(E)", "array minus its last n elements", _ARRAY0),
+    ("array_sort", 1, 1, "array(E)", "ascending sort of the elements", _ARRAY0),
+    ("array_distinct", 1, 1, "array(E)", "distinct elements (sorted)", _ARRAY0),
+    ("array_remove", 2, 2, "array(E)", "elements not equal to the value", _ARRAY0),
+    ("array_position", 2, 2, "bigint", "1-based position of the value (0 = absent)", _BIGINT),
+]:
+    _reg(name, "scalar", lo, hi, ret, desc, rule=rule)
+_reg("repeat", "scalar", 2, 2, "array(E)", "value repeated n times",
+     rule=lambda a: T.array_of(a[0]), const_args=(1,))
+_reg("split", "scalar", 2, 2, "array(varchar)", "split on a delimiter",
+     rule=lambda a: T.array_of(T.VARCHAR), const_args=(1,))
+_reg("map_contains_key", "scalar", 2, 2, "boolean",
+     "whether the map has the key", rule=_BOOLEAN)
+
+for name, lo, hi, ret, desc in [
+    ("transform", 2, 2, "array(U)", "apply a lambda to every element"),
+    ("filter", 2, 2, "array(E)", "elements where the lambda is true"),
+    ("any_match", 2, 2, "boolean", "lambda true for any element"),
+    ("all_match", 2, 2, "boolean", "lambda true for every element"),
+    ("none_match", 2, 2, "boolean", "lambda true for no element"),
+    ("transform_values", 2, 2, "map(K,V2)", "apply a lambda to map values"),
+    ("transform_keys", 2, 2, "map(K2,V)", "apply a lambda to map keys"),
+    ("map_filter", 2, 2, "map(K,V)", "entries where the lambda is true"),
+]:
+    _reg(name, "scalar", lo, hi, ret, desc)
+
+for name, lo, hi, ret, desc in [
+    ("arrays_overlap", 2, 2, "boolean", "whether the arrays share an element"),
+    ("array_intersect", 2, 2, "array(E)", "elements in both arrays"),
+    ("array_union", 2, 2, "array(E)", "union of the arrays' elements"),
+    ("array_except", 2, 2, "array(E)", "elements only in the first array"),
+    ("flatten", 1, 1, "array(E)", "concatenate an array of arrays"),
+]:
+    _reg(name, "scalar", lo, hi, ret, desc)

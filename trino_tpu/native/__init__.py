@@ -121,10 +121,18 @@ def mask_compact(columns: List[np.ndarray], mask: np.ndarray) -> List[np.ndarray
     out = []
     for col in columns:
         col = np.ascontiguousarray(col)
-        buf = np.empty(n_live, dtype=col.dtype)
-        w = lib.mask_gather(
-            _ptr(col), col.dtype.itemsize, _ptr(mask), len(mask), _ptr(buf)
-        )
+        if col.ndim == 2:
+            # long-decimal (n, k) limb rows: one gather of k-wide items
+            item = col.dtype.itemsize * col.shape[1]
+            buf = np.empty((n_live, col.shape[1]), dtype=col.dtype)
+            w = lib.mask_gather(
+                _ptr(col), item, _ptr(mask), len(mask), _ptr(buf)
+            )
+        else:
+            buf = np.empty(n_live, dtype=col.dtype)
+            w = lib.mask_gather(
+                _ptr(col), col.dtype.itemsize, _ptr(mask), len(mask), _ptr(buf)
+            )
         assert w == n_live
         out.append(buf)
     return out

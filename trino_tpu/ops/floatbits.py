@@ -11,8 +11,8 @@ float64 into FOUR uint32 lanes using only f32 bitcasts and exact
 power-of-two float arithmetic:
 
   lane1 = order-flipped bits of f32(x)        (coarse, order-preserving)
-  lane2 = sign-adjusted range bucket k         (which 2^254 window)
-  lane3 = order-flipped bits of f32(x*2^-254k) (fine, within-window)
+  lane2 = sign-adjusted range bucket k         (which 2^216 window)
+  lane3 = order-flipped bits of f32(x*2^-216k) (fine, within-window)
   lane4 = exact residual of that rescale in 2^-30 ulp(f32) quanta
 
 Properties: lexicographic (lane1..lane4) is a TOTAL ORDER of
@@ -62,9 +62,10 @@ def f64_lanes(x: jnp.ndarray):
     """float64 -> (lane1..lane4) uint32 tuple; see module doc.
 
     Range handling picks a per-element EXACT power-of-two rescale
-    2^(-254k), k in [-4, 4], by direct threshold comparisons (windows of
-    width 2^254 on a 2^254 step — no gaps, no iteration), bringing every
-    nonzero normal double into the f32-normal window. k rides as its own
+    2^(-216k), k in [-5, 5], by direct threshold comparisons (windows of
+    width 2^216 on a 2^216 step — no gaps, no iteration), bringing every
+    nonzero normal double into [2^-90, 2^126), where f32(xs) cannot
+    saturate AND ulp32(xs) is itself normal (DAZ-safe residuals). k rides as its own
     order lane (sign-adjusted: for negatives a larger magnitude is a
     SMALLER value). Subnormal doubles are zero on this backend (DAZ —
     its arithmetic and comparisons already treat them as 0), so the
@@ -74,18 +75,30 @@ def f64_lanes(x: jnp.ndarray):
     zero = x == 0
     inf = jnp.isinf(x)
 
+    # Window step 2^216 with windows [2^(216k-90), 2^(216(k+1)-90)):
+    # every rescaled xs = m * 2^(-216k) lands in [2^-90, 2^126). Both
+    # window edges matter (ADVICE r3, property-tested in
+    # tests/test_floatbits.py):
+    #  - top < f32_max: f32(xs) never saturates to inf, so distinct
+    #    doubles above f32_max keep distinct refinement lanes;
+    #  - bottom >= 2^-90: ulp32(xs) >= 2^-113 stays NORMAL — near the
+    #    f32 min-normal, ulp32 is itself subnormal and this backend's
+    #    DAZ flushes it to 0, zeroing the residual lane.
+    # The rescale applies as TWO exact half-step power-of-two
+    # multiplies (2^(216*5) overflows f64 as a single constant).
     m = jnp.abs(x)
     k = jnp.zeros(x.shape, jnp.int32)
-    for j in range(1, 5):
-        k = k + (m >= jnp.float64(2.0) ** (254 * j - 126)).astype(jnp.int32)
-        k = k - (m < jnp.float64(2.0) ** (-254 * (j - 1) - 126)).astype(
+    for j in range(1, 6):
+        k = k + (m >= jnp.float64(2.0) ** (216 * j - 90)).astype(jnp.int32)
+        k = k - (m < jnp.float64(2.0) ** (-216 * (j - 1) - 90)).astype(
             jnp.int32
         )
-    scales = jnp.asarray(
-        [jnp.float64(2.0) ** (-254 * kk) for kk in range(-4, 5)],
+    half_scales = jnp.asarray(
+        [jnp.float64(2.0) ** (-108 * kk) for kk in range(-5, 6)],
         dtype=jnp.float64,
     )
-    xs = x * jnp.take(scales, jnp.clip(k + 4, 0, 8))
+    s = jnp.take(half_scales, jnp.clip(k + 5, 0, 10))
+    xs = (x * s) * s
     a = xs.astype(jnp.float32)
 
     lane1 = _f32_lane(x.astype(jnp.float32))

@@ -291,10 +291,12 @@ def _key_order(keys, valids, mask, order=None, seed: int = 0):
 def _eq_vals(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Value equality for grouping: SQL groups NaNs together, but float
     == is false for NaN — make NaN equal NaN (floats only; cheap no-op
-    for ints)."""
+    for ints). Long-decimal limb pairs (n, 2) compare per row."""
     eq = a == b
     if jnp.issubdtype(a.dtype, jnp.floating):
         eq = eq | (jnp.isnan(a) & jnp.isnan(b))
+    if getattr(eq, "ndim", 1) == 2:
+        eq = eq.all(axis=-1)
     return eq
 
 def _segment_bounds(sk, sv, sm, n, out_capacity):

@@ -32,6 +32,11 @@ def _to_lanes(data: jnp.ndarray) -> tuple:
     so their hash lanes must match (group-by, join probe, and exchange
     routing all flow through here)."""
     dt = data.dtype
+    if getattr(data, "ndim", 1) == 2:
+        # long-decimal limb pairs (n, 2) int64: four u32 lanes
+        hi_l = _to_lanes(data[:, 0])
+        lo_l = _to_lanes(data[:, 1])
+        return (*lo_l, *hi_l)
     if jnp.issubdtype(dt, jnp.floating):
         data = jnp.where(data == 0, jnp.zeros((), dt), data)
     if dt == jnp.float64:

@@ -1,0 +1,280 @@
+"""Int128 arithmetic over (hi, lo) int64 limb pairs.
+
+Analogue of Trino's Int128 / Int128Math (spi/type/Int128.java:23,
+spi/type/Int128Math.java) — the carrier for decimal(19..38). TPU-first
+representation: a long-decimal COLUMN is one (n, 2) int64 array
+(column 0 = signed high limb, column 1 = low limb holding unsigned
+bits), mirroring Int128ArrayBlock's long[2n] layout but vectorized.
+All kernels here take/return separate (hi, lo) arrays; the block layer
+stacks them.
+
+Two's-complement across the pair: value = hi * 2^64 + (lo as u64).
+Carries use the standard unsigned-compare trick; 64x64 -> 128 products
+decompose into 32-bit half-limbs so every partial product is exact in
+int64 (TPU has no native 128-bit ops; XLA int64 is itself emulated on
+32-bit lanes, so staying in small exact pieces is the fast path too).
+
+Division: HALF_UP decimal division with divisors up to 2^63 (the
+rescaled-divisor magnitudes real queries produce); the quotient digits
+come from schoolbook long division over 32-bit chunks. Divisors beyond
+int64 raise (Trino supports them; extension point documented).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+_MASK32 = jnp.int64(0xFFFFFFFF)
+_U64_SIGN = jnp.int64(-0x8000000000000000)  # 1 << 63 as int64
+
+
+def _u64_lt(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Unsigned < over int64 bit patterns (flip sign bit, signed <)."""
+    return (a ^ _U64_SIGN) < (b ^ _U64_SIGN)
+
+
+def from_i64(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Sign-extend an int64 into (hi, lo)."""
+    x = x.astype(jnp.int64)
+    return x >> jnp.int64(63), x
+
+
+def add(ah, al, bh, bl):
+    lo = al + bl  # wrapping add of the low bits
+    carry = _u64_lt(lo, al).astype(jnp.int64)
+    return ah + bh + carry, lo
+
+
+def neg(h, lo):
+    nh, nl = ~h, ~lo
+    lo2 = nl + jnp.int64(1)
+    carry = (lo2 == 0).astype(jnp.int64)  # only wraps when nl was all-1s
+    return nh + carry, lo2
+
+
+def sub(ah, al, bh, bl):
+    nh, nl = neg(bh, bl)
+    return add(ah, al, nh, nl)
+
+
+def eq(ah, al, bh, bl):
+    return (ah == bh) & (al == bl)
+
+
+def lt(ah, al, bh, bl):
+    """Signed 128-bit less-than."""
+    return (ah < bh) | ((ah == bh) & _u64_lt(al, bl))
+
+
+def sign(h, lo):
+    """-1 / 0 / +1 as int64."""
+    is_zero = (h == 0) & (lo == 0)
+    return jnp.where(is_zero, jnp.int64(0), jnp.where(h < 0, jnp.int64(-1), jnp.int64(1)))
+
+
+def abs_(h, lo):
+    nh, nl = neg(h, lo)
+    negv = h < 0
+    return jnp.where(negv, nh, h), jnp.where(negv, nl, lo)
+
+
+def _umul64(a: jnp.ndarray, b: jnp.ndarray):
+    """Unsigned 64x64 -> (hi, lo) via 32-bit half-limbs (each partial
+    product < 2^64 and exact in int64's bit pattern)."""
+    a0 = a & _MASK32
+    a1 = (a >> jnp.int64(32)) & _MASK32
+    b0 = b & _MASK32
+    b1 = (b >> jnp.int64(32)) & _MASK32
+    p00 = a0 * b0
+    p01 = a0 * b1
+    p10 = a1 * b0
+    p11 = a1 * b1
+    # partial products can wrap int64's sign bit; every right shift
+    # must be LOGICAL, i.e. masked after the arithmetic shift
+    mid = (
+        ((p00 >> jnp.int64(32)) & _MASK32)
+        + (p01 & _MASK32)
+        + (p10 & _MASK32)
+    )
+    lo = (p00 & _MASK32) | (mid << jnp.int64(32))
+    hi = (
+        p11
+        + ((p01 >> jnp.int64(32)) & _MASK32)
+        + ((p10 >> jnp.int64(32)) & _MASK32)
+        + (mid >> jnp.int64(32))
+    )
+    return hi, lo
+
+
+def mul_i64(a: jnp.ndarray, b: jnp.ndarray):
+    """Signed full 64x64 -> 128 product."""
+    hi, lo = _umul64(a, b)
+    # signed correction: for each negative operand subtract the other
+    # from the high limb (standard mulhi fixup)
+    hi = hi - jnp.where(a < 0, b, jnp.int64(0)) - jnp.where(b < 0, a, jnp.int64(0))
+    return hi, lo
+
+
+def mul_128(ah, al, bh, bl):
+    """Full 128x128 product mod 2^128: u128(al,bl) cross terms —
+    (ah*2^64 + al)(bh*2^64 + bl) = al*bl + 2^64 (ah*bl + al*bh)."""
+    ph, pl = _umul64(al, bl)
+    cross = ah * bl + al * bh  # wrapping int64 is exactly mod 2^64
+    return ph + cross, pl
+
+
+def mul_128_64(h, lo, m: jnp.ndarray):
+    """(hi, lo) * signed-64 m with |m| <= 2^62, result mod 2^128
+    (callers bound magnitudes to 38 digits so the wrap never triggers
+    in-range): |value| * |m| = u128(|lo|-part) with sign fixup, plus
+    h*m into the high limb."""
+    am = jnp.abs(m)
+    ph, pl = _umul64(lo, am)  # u64(lo) * |m|
+    nh, nl = neg(ph, pl)
+    m_neg = m < 0
+    ph = jnp.where(m_neg, nh, ph)
+    pl = jnp.where(m_neg, nl, pl)
+    return ph + h * m, pl
+
+
+_POW10_63 = [10 ** k for k in range(19)]  # fits int64 through 10^18
+
+
+def pow10_128(k: int) -> Tuple[int, int]:
+    """10^k as (hi, lo) python ints, k <= 38."""
+    v = 10 ** k
+    return (v >> 64) & ((1 << 64) - 1), v & ((1 << 64) - 1)
+
+
+def _const64(v: int) -> jnp.ndarray:
+    """int64 scalar from a python int given as a 64-bit pattern."""
+    if v >= 1 << 63:
+        v -= 1 << 64
+    return jnp.int64(v)
+
+
+def rescale_up(h, lo, k: int):
+    """(hi, lo) * 10^k for 0 <= k <= 38 (two int64-multiplier steps)."""
+    if k == 0:
+        return h, lo
+    while k > 18:
+        h, lo = mul_128_64(h, lo, jnp.int64(10 ** 18))
+        k -= 18
+    return mul_128_64(h, lo, jnp.int64(10 ** k))
+
+
+def divmod_u128_u64(h, lo, d: jnp.ndarray):
+    """Unsigned 128 / unsigned-63-bit divisor -> (quotient (hi,lo),
+    remainder i64). Schoolbook over 32-bit digits: at each step the
+    partial remainder < d * 2^32 <= 2^95, kept exact as (r_hi, r_lo)
+    with r_hi < 2^31."""
+    # digits of the dividend, most-significant first
+    digits = [
+        (h >> jnp.int64(32)) & _MASK32,
+        h & _MASK32,
+        (lo >> jnp.int64(32)) & _MASK32,
+        lo & _MASK32,
+    ]
+    q = []
+    r = jnp.zeros_like(h)
+    for dig in digits:
+        # partial = r * 2^32 + dig as a (signed-safe) 128-bit value:
+        # r < d <= 2^63 so partial < 2^95, hi limb < 2^31
+        p_hi = r >> jnp.int64(32)
+        p_lo = (r << jnp.int64(32)) | dig
+        # float-seeded quotient-digit estimate; est <= 2^32 so the
+        # f64 mantissa bounds the absolute error to a few units
+        num = p_hi.astype(jnp.float64) * (2.0 ** 64) + jnp.where(
+            p_lo < 0,
+            p_lo.astype(jnp.float64) + 2.0 ** 64,
+            p_lo.astype(jnp.float64),
+        )
+        est = jnp.clip(
+            jnp.floor(num / d.astype(jnp.float64)), 0.0, 2.0 ** 32
+        ).astype(jnp.int64)
+        # exact 128-bit remainder rem = partial - est * d, signed
+        prod = _umul64(est, d)
+        rem_h, rem_l = sub(p_hi, p_lo, prod[0], prod[1])
+        # bounded correction (float error is a few ulp of est)
+        for _ in range(4):
+            over = rem_h < 0
+            est = est - over.astype(jnp.int64)
+            ah2, al2 = add(rem_h, rem_l, jnp.int64(0), d)
+            rem_h = jnp.where(over, ah2, rem_h)
+            rem_l = jnp.where(over, al2, rem_l)
+        for _ in range(4):
+            under = ~lt(rem_h, rem_l, jnp.int64(0), d)
+            est = est + under.astype(jnp.int64)
+            sh2, sl2 = sub(rem_h, rem_l, jnp.int64(0), d)
+            rem_h = jnp.where(under, sh2, rem_h)
+            rem_l = jnp.where(under, sl2, rem_l)
+        q.append(est & _MASK32)
+        r = rem_l
+    qh = (q[0] << jnp.int64(32)) | q[1]
+    ql = (q[2] << jnp.int64(32)) | q[3]
+    return qh, ql, r
+
+
+def div_round_i64(h, lo, d: jnp.ndarray):
+    """Signed (hi,lo) / signed nonzero int64 d, HALF_UP rounding
+    (Trino Int128Math.divideRoundUp semantics for 64-bit divisors)."""
+    ah, al = abs_(h, lo)
+    ad = jnp.abs(d)
+    qh, ql, r = divmod_u128_u64(ah, al, ad)
+    round_up = ~_u64_lt(r + r, ad)  # 2r >= d
+    qh2, ql2 = add(qh, ql, jnp.int64(0), round_up.astype(jnp.int64))
+    negv = (sign(h, lo) * jnp.sign(d)) < 0
+    nh, nl = neg(qh2, ql2)
+    return jnp.where(negv, nh, qh2), jnp.where(negv, nl, ql2)
+
+
+def rescale_down_round(h, lo, k: int):
+    """(hi, lo) / 10^k with HALF_UP rounding, 0 <= k <= 38."""
+    if k == 0:
+        return h, lo
+    while k > 18:
+        h, lo = div_round_i64(h, lo, jnp.int64(10 ** 18))
+        k -= 18
+    return div_round_i64(h, lo, jnp.int64(10 ** k))
+
+
+def to_i64(h, lo):
+    """(value mod 2^64) as int64 plus an in-range flag (value
+    representable in int64)."""
+    ok = h == (lo >> jnp.int64(63))
+    return lo, ok
+
+
+# 38-digit overflow bound: |value| < 10^38
+_BOUND = 10 ** 38
+_BOUND_HI = _const64((_BOUND >> 64) & ((1 << 64) - 1))
+_BOUND_LO = _const64(_BOUND & ((1 << 64) - 1))
+
+
+def overflows_38(h, lo):
+    """|value| >= 10^38 (Decimals.overflows analogue)."""
+    ah, al = abs_(h, lo)
+    # note abs(-2^127) wraps negative; treat hi<0 after abs as overflow
+    ge = ~lt(ah, al, _BOUND_HI, _BOUND_LO)
+    return ge | (ah < 0)
+
+
+# -- host conversion ---------------------------------------------------------
+
+
+def to_python(h: int, lo: int) -> int:
+    """(hi, lo) host ints -> python int value."""
+    return (int(h) << 64) | (int(lo) & ((1 << 64) - 1))
+
+
+def from_python(v: int) -> Tuple[int, int]:
+    """python int -> (hi, lo) as int64-representable host ints."""
+    lo = v & ((1 << 64) - 1)
+    h = (v >> 64) & ((1 << 64) - 1)
+    if lo >= 1 << 63:
+        lo -= 1 << 64
+    if h >= 1 << 63:
+        h -= 1 << 64
+    return h, lo

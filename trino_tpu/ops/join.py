@@ -235,7 +235,10 @@ def expand_matches(
             av = take_clip(pv, pi_c)
             b = take_clip(bk, jnp.clip(bi, 0, bk.shape[0] - 1))
             bvv = take_clip(bv, jnp.clip(bi, 0, bv.shape[0] - 1))
-            ok = ok & (a == b) & av & bvv
+            eqd = a == b
+            if getattr(eqd, "ndim", 1) == 2:  # long-decimal limb pairs
+                eqd = eqd.all(axis=-1)
+            ok = ok & eqd & av & bvv
     return pi_c, bi, ok
 
 

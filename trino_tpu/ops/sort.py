@@ -72,8 +72,17 @@ def sort_order(
     for data, valid, desc, nf in reversed(
         list(zip(key_data, key_valids, descending, nulls_first))
     ):
-        v = _order_value(take_clip(data, order), desc)
-        order = take_clip(order, jnp.argsort(v, stable=True))
+        if getattr(data, "ndim", 1) == 2:
+            # long-decimal limb pairs: stable LSD chain — low limb in
+            # UNSIGNED order first, then the signed high limb
+            lo_u = data[:, 1] ^ jnp.int64(-0x8000000000000000)
+            v = _order_value(take_clip(lo_u, order), desc)
+            order = take_clip(order, jnp.argsort(v, stable=True))
+            v = _order_value(take_clip(data[:, 0], order), desc)
+            order = take_clip(order, jnp.argsort(v, stable=True))
+        else:
+            v = _order_value(take_clip(data, order), desc)
+            order = take_clip(order, jnp.argsort(v, stable=True))
         if desc and jnp.issubdtype(data.dtype, jnp.floating):
             # descending floats: NaN must come FIRST (it is the largest
             # value — Double.compare), but negation leaves it last

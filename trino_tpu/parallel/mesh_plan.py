@@ -96,18 +96,32 @@ class MeshUnsupported(Exception):
 
 
 def _check_node(n: P.PlanNode) -> None:
-    if isinstance(n, (P.WindowNode, P.OutputNode)):
+    if isinstance(n, P.OutputNode):
         raise MeshUnsupported(type(n).__name__)
+    if isinstance(n, P.WindowNode) and not n.partition_channels:
+        # PARTITION BY-less windows are one global partition; the
+        # fragmenter gathers them to the root, so a distributed one
+        # reaching here is a plan bug — fall back loudly
+        raise MeshUnsupported("window without partition keys")
     if isinstance(n, P.AggregateNode):
         for a in n.aggs:
             if a.distinct or a.kind not in _BATCH_REDUCER:
                 raise MeshUnsupported(f"agg {a.kind}")
+            # Int128 accumulators have no mesh partial format yet
+            child = n.children()[0]
+            if (
+                a.arg_channel is not None
+                and child.fields[a.arg_channel].type.is_long_decimal
+            ):
+                raise MeshUnsupported("agg over decimal(>18)")
+        child = n.children()[0]
+        for c in n.group_channels:
+            if child.fields[c].type.is_long_decimal:
+                raise MeshUnsupported("group key decimal(>18)")
     if isinstance(n, P.JoinNode) and n.kind not in (
         "inner", "left", "full", "semi", "anti", "cross"
     ):
         raise MeshUnsupported(f"join {n.kind}")
-    if isinstance(n, P.LimitNode) and n.count is None:
-        raise MeshUnsupported("offset-only limit")
     for c in n.children():
         _check_node(c)
 
@@ -607,8 +621,40 @@ class _FragVisitor:
     def _visit_LimitNode(self, node):
         out = self.visit(node.child).compact()
         idx = jnp.arange(out.capacity, dtype=jnp.int32)
-        keep = (idx >= node.offset) & (idx < node.offset + node.count)
+        keep = idx >= node.offset
+        if node.count is not None:
+            keep = keep & (idx < node.offset + node.count)
         return out.mask(keep)
+
+    def _visit_WindowNode(self, node):
+        """Window over hash-distributed partition keys: the fragmenter
+        repartitioned the child on PARTITION BY (an all_to_all on this
+        plane), so every window partition is shard-local and the local
+        window kernel applies per shard unchanged
+        (optimizations/AddExchanges.java:140 window distribution)."""
+        from trino_tpu.exec.operators import (
+            _window_compute, window_fn_tuples,
+        )
+
+        batch = self.visit(node.child)
+        schema = [(c.type, c.dictionary) for c in batch.columns]
+        fns = window_fn_tuples(list(node.functions), schema)
+        s_cols, s_live, out_cols = _window_compute(
+            batch,
+            tuple(node.partition_channels),
+            tuple(node.order_keys),
+            fns,
+            node.frame,
+        )
+        cols = list(s_cols)
+        for spec, (data, valid) in zip(node.functions, out_cols):
+            d = None
+            if spec.arg_channel is not None and spec.kind in (
+                "lead", "lag", "first_value", "last_value", "min", "max"
+            ):
+                d = s_cols[spec.arg_channel].dictionary
+            cols.append(Column(spec.out_type, data, valid, d))
+        return RelBatch(cols, s_live)
 
 
 # ---------------------------------------------------------------------------

@@ -20,15 +20,17 @@ Capabilities mirrored from the reference that shape this file:
   (AggregationAnalyzer analogue).
 
 Known deviations (documented):
-- decimal division/avg scales: physical decimals are scaled int64
-  (precision <= 18), so division yields decimal(18, max(6, s1, s2))
-  instead of Trino's scale = max(6, s1 + p2 + 1) (which requires
-  int128), and magnitudes near 10^(18 - scale) can overflow the
-  64-bit representation.
+- decimal overflow past 38 digits and an Int128 division whose divisor
+  exceeds int64 yield NULL rows instead of Trino's NUMERIC_VALUE_OUT_OF
+  _RANGE error (same deviation class as data-dependent division by
+  zero — a deferred error-flag sideband is the planned fix).
 Formerly-deviant semantics now implemented faithfully: NULL-aware
 NOT IN (filter + anti join + subquery-NULL-count guard), scalar
 subqueries yielding NULL on zero rows and raising on >1
-(EnforceSingleRowNode), decimal-typed division and avg.
+(EnforceSingleRowNode), decimal-typed division and avg, and (r4) the
+full Trino decimal type algebra — precisions to 38 carried as Int128
+limb pairs (ops/int128.py), DecimalOperators result typing for
++,-,*,/,%, sum -> decimal(38,s), HALF_UP rescales.
 """
 
 from __future__ import annotations
@@ -142,6 +144,10 @@ def _number_literal(text: str) -> ir.Literal:
         digits = len(text.replace(".", "").lstrip("0")) or 1
         return ir.Literal(float(text), T.decimal(max(digits, scale + 1), scale))
     v = int(text)
+    if abs(v) > 2 ** 63 - 1:
+        # beyond BIGINT: an exact decimal literal (Trino types big
+        # integer literals DECIMAL(n, 0))
+        return ir.Literal(v, T.decimal(min(len(str(abs(v))), 38), 0))
     return ir.Literal(v, T.BIGINT)
 
 
@@ -177,7 +183,12 @@ def _unify_types(types: Sequence[T.DataType]) -> T.DataType:
         return T.DOUBLE
     if any(t.is_decimal for t in types):
         scale = max((t.scale or 0) for t in types if t.is_decimal)
-        return T.decimal(18, scale)
+        intd = max(
+            (T._as_decimal_shape(t)[0] - T._as_decimal_shape(t)[1])
+            for t in types
+            if t.is_numeric
+        )
+        return T.decimal(min(intd + scale, T.MAX_DECIMAL_PRECISION), scale)
     if any(t.kind == T.TypeKind.DATE for t in types):
         return T.DATE
     if any(t.kind == T.TypeKind.BOOLEAN for t in types):
@@ -191,18 +202,9 @@ def _arith_type(op: str, lt: T.DataType, rt: T.DataType) -> T.DataType:
     if lt.is_floating or rt.is_floating:
         return T.DOUBLE
     if lt.is_decimal or rt.is_decimal:
-        sa = lt.scale or 0 if lt.is_decimal else 0
-        sb = rt.scale or 0 if rt.is_decimal else 0
-        if op == "div":
-            # decimal quotient (Trino's rule is scale = max(6, s1+p2+1),
-            # which needs int128; with 18-digit physical decimals the
-            # scale caps so the magnitude keeps headroom)
-            return T.decimal(18, max(6, sa, sb))
-        if op == "mul":
-            return T.decimal(18, min(sa + sb, 12))
-        if op == "mod":
-            return T.decimal(18, max(sa, sb))
-        return T.decimal(18, max(sa, sb))
+        # Trino's exact decimal operator typing incl. Int128 results
+        # (main/type/DecimalOperators.java longVariables)
+        return T.decimal_arith_type(op, lt, rt)
     return T.BIGINT
 
 
@@ -223,6 +225,11 @@ class ExprConverter:
             ch, t = self.replacements[e]
             return ir.InputRef(ch, t)
         if isinstance(e, ast.Identifier):
+            lam_scope = getattr(self, "_lambda_scope", None)
+            if lam_scope and len(e.parts) == 1:
+                lv = lam_scope.get(e.parts[0].lower())
+                if lv is not None:
+                    return lv
             hit = self.scope.try_resolve(e.parts)
             if hit is None and len(e.parts) >= 2:
                 # ROW field access: resolve the prefix as a row-typed
@@ -317,6 +324,22 @@ class ExprConverter:
             raise AnalysisError(f"extract({e.field}) not supported")
         if isinstance(e, ast.FunctionCall):
             return self._convert_call(e)
+        if isinstance(e, ast.Lambda):
+            raise AnalysisError(
+                "lambda expressions are only valid as higher-order "
+                "function arguments (transform, filter, ...)"
+            )
+        if isinstance(e, ast.ArrayLiteral):
+            vals = _const_array_values(e)
+            if vals is None:
+                raise AnalysisError(
+                    "ARRAY[...] literals must contain constants"
+                )
+            elems = [self.convert(x) for x in e.elements]
+            elem_t = _unify_types([x.type for x in elems]) if elems else T.BIGINT
+            return ir.Literal(
+                tuple(x.value for x in elems), T.array_of(elem_t)
+            )
         if isinstance(e, (ast.Exists, ast.InSubquery, ast.ScalarSubquery)):
             raise AnalysisError(
                 "subquery in unsupported position (only WHERE/HAVING conjuncts)"
@@ -382,16 +405,32 @@ class ExprConverter:
         a = self.convert(e.operand)
         return ir.Cast(a, resolve_type(e.target))
 
+    # higher-order (lambda-taking) functions: (collection positions,
+    # lambda position, param-type derivation) — ArrayFunctions /
+    # MapTransformValuesFunction analogues
+    _LAMBDA_FUNCS = {
+        "transform", "filter", "any_match", "all_match", "none_match",
+        "transform_values", "transform_keys", "map_filter",
+    }
+
     def _convert_call(self, e: ast.FunctionCall) -> ir.Expr:
         name = e.name
         if name in AGG_FUNCS:
             raise AnalysisError(
                 f"aggregate function {name}() in a non-aggregate context"
             )
+        if name in self._LAMBDA_FUNCS and len(e.args) == 2 and isinstance(
+            e.args[1], ast.Lambda
+        ):
+            return self._convert_lambda_call(name, e)
         # constant-array functions fold at analysis time; column-typed
         # arguments vectorize over the nested layouts
         if name in ("cardinality", "element_at", "contains", "array_max",
-                    "array_min", "array_join"):
+                    "array_min", "array_join", "array_position",
+                    "array_remove", "array_sort", "array_distinct",
+                    "slice", "trim_array", "arrays_overlap",
+                    "array_intersect", "array_union", "array_except",
+                    "flatten"):
             arr = (
                 _const_array_values(e.args[0]) if e.args else None
             )
@@ -414,6 +453,15 @@ class ExprConverter:
                         return ir.Call(
                             "array_subscript", (ref, idx), ref.type.element
                         )
+                    if name == "contains" and ref.type.is_array:
+                        probe = self.convert(e.args[1])
+                        return ir.Call(
+                            "array_contains", (ref, probe), T.BOOLEAN
+                        )
+                    if name in ("array_min", "array_max") and ref.type.is_array:
+                        return ir.Call(
+                            f"{name}_col", (ref,), ref.type.element
+                        )
                 raise AnalysisError(
                     f"{name}() supports constant arrays"
                     + (" and array/map columns"
@@ -421,6 +469,10 @@ class ExprConverter:
                     + " only"
                 )
             return self._fold_array_call(name, arr, e.args[1:])
+        if name in self._LAMBDA_FUNCS:
+            raise AnalysisError(
+                f"{name}() takes a lambda as its second argument"
+            )
         if name in ("map_keys", "map_values"):
             ref = self.convert(e.args[0]) if e.args else None
             if ref is None or not ref.type.is_map:
@@ -441,6 +493,58 @@ class ExprConverter:
         args = tuple(self.convert(a) for a in e.args)
         if name in ("substr", "substring"):
             return ir.Call("substr", args, T.VARCHAR)
+        return self._convert_plain_call(name, e, args)
+
+    def _convert_lambda_call(self, name: str, e: ast.FunctionCall) -> ir.Expr:
+        coll = self.convert(e.args[0])
+        lam: ast.Lambda = e.args[1]
+        if name in ("transform", "filter", "any_match", "all_match",
+                    "none_match"):
+            if not coll.type.is_array:
+                raise AnalysisError(f"{name}() requires an array argument")
+            if len(lam.params) != 1:
+                raise AnalysisError(f"{name}() lambda takes one parameter")
+            param_types = [coll.type.element]
+        else:
+            if not coll.type.is_map:
+                raise AnalysisError(f"{name}() requires a map argument")
+            if len(lam.params) != 2:
+                raise AnalysisError(f"{name}() lambda takes (key, value)")
+            param_types = [coll.type.key, coll.type.element]
+        prev = getattr(self, "_lambda_scope", None)
+        self._lambda_scope = {
+            p: ir.LambdaVar(i, t)
+            for i, (p, t) in enumerate(zip(lam.params, param_types))
+        }
+        try:
+            body = self.convert(lam.body)
+        finally:
+            self._lambda_scope = prev
+        if _refers_outside_lambda(body):
+            raise AnalysisError(
+                f"{name}() lambda may only reference its parameters "
+                "(outer-column captures are not supported yet)"
+            )
+        lam_ir = ir.LambdaExpr(body, len(lam.params), body.type)
+        if name == "transform":
+            out_t = T.array_of(body.type)
+        elif name == "filter":
+            out_t = coll.type
+        elif name in ("any_match", "all_match", "none_match"):
+            if body.type.kind != T.TypeKind.BOOLEAN:
+                raise AnalysisError(f"{name}() lambda must return boolean")
+            out_t = T.BOOLEAN
+        elif name == "map_filter":
+            if body.type.kind != T.TypeKind.BOOLEAN:
+                raise AnalysisError(f"{name}() lambda must return boolean")
+            out_t = coll.type
+        elif name == "transform_values":
+            out_t = T.map_of(coll.type.key, body.type)
+        else:  # transform_keys
+            out_t = T.map_of(body.type, coll.type.element)
+        return ir.Call(name, (coll, lam_ir), out_t)
+
+    def _convert_plain_call(self, name, e, args) -> ir.Expr:
         if name in ("upper", "lower"):
             return ir.Call(name, args, T.VARCHAR)
         if name == "length":
@@ -672,6 +776,83 @@ class ExprConverter:
                         if isinstance(v, bool) else str(v)
                     )
             return ir.Literal(str(sep.value).join(parts), T.VARCHAR)
+        # r4 breadth: constant-array forms fold at analysis; COLUMN
+        # arrays take the vectorized binder paths (expr/compile
+        # _bind_array_fn) where layouts are canonical
+        vals = [l.value for l in arr]
+
+        def lit_arr(pyvals, t=None):
+            return ir.Literal(tuple(pyvals), T.array_of(t or elem_t))
+
+        def other_array(idx=0):
+            o = _const_array_values(rest[idx]) if len(rest) > idx else None
+            if o is None:
+                raise AnalysisError(f"{name}() requires constant arrays")
+            return [
+                _const_fold(self.convert(x)).value for x in rest[idx].elements
+            ]
+
+        if name == "array_position":
+            probe = _const_fold(self.convert(rest[0])) if rest else None
+            if probe is None:
+                raise AnalysisError("array_position() value must be constant")
+            for i, v in enumerate(vals):
+                if v is not None and v == probe.value:
+                    return ir.Literal(i + 1, T.BIGINT)
+            return ir.Literal(0, T.BIGINT)
+        if name == "array_remove":
+            probe = _const_fold(self.convert(rest[0])) if rest else None
+            if probe is None:
+                raise AnalysisError("array_remove() value must be constant")
+            return lit_arr([v for v in vals if v is None or v != probe.value])
+        if name == "array_sort":
+            nn = sorted(v for v in vals if v is not None)
+            return lit_arr(nn + [None] * (len(vals) - len(nn)))
+        if name == "array_distinct":
+            seen, out = set(), []
+            has_null = False
+            for v in vals:
+                if v is None:
+                    has_null = True
+                elif v not in seen:
+                    seen.add(v)
+                    out.append(v)
+            return lit_arr(out + ([None] if has_null else []))
+        if name in ("slice", "trim_array"):
+            a1 = _const_fold(self.convert(rest[0]))
+            if name == "trim_array":
+                n = int(a1.value)
+                return lit_arr(vals[: max(len(vals) - n, 0)])
+            a2 = _const_fold(self.convert(rest[1]))
+            start, ln = int(a1.value), int(a2.value)
+            pos = start - 1 if start > 0 else len(vals) + start
+            return lit_arr(vals[max(pos, 0): max(pos, 0) + max(ln, 0)])
+        if name in ("arrays_overlap", "array_intersect", "array_union",
+                    "array_except"):
+            other = other_array()
+            sa = [v for v in vals if v is not None]
+            sb = [v for v in other if v is not None]
+            if name == "arrays_overlap":
+                if set(sa) & set(sb):
+                    return ir.Literal(True, T.BOOLEAN)
+                if None in vals or None in other:
+                    return ir.Literal(None, T.BOOLEAN)
+                return ir.Literal(False, T.BOOLEAN)
+            if name == "array_intersect":
+                return lit_arr(sorted(set(sa) & set(sb)))
+            if name == "array_union":
+                u = sorted(set(sa) | set(sb))
+                if None in vals or None in other:
+                    u = u + [None]
+                return lit_arr(u)
+            return lit_arr(sorted(set(sa) - set(sb)))
+        if name == "flatten":
+            out = []
+            for x in arr:  # elements are themselves array literals
+                if x.value is None:
+                    continue
+                out.extend(x.value)
+            return lit_arr(out, elem_t.element if elem_t.is_array else elem_t)
         raise AnalysisError(f"unknown array function {name}")
 
 
@@ -795,7 +976,7 @@ def resolve_type(t: ast.TypeName) -> T.DataType:
     if t.name == "decimal":
         p = t.params[0] if t.params else 18
         s = t.params[1] if len(t.params) > 1 else 0
-        return T.decimal(min(p, 18), s)
+        return T.decimal(min(p, T.MAX_DECIMAL_PRECISION), s)
     if t.name in ("varchar", "char"):
         return T.VARCHAR
     if t.name == "array":
@@ -876,6 +1057,14 @@ def _const_fold(x: ir.Expr) -> Optional[ir.Literal]:
         if inner is not None:
             return ir.Literal(inner.value, x.type)
     return None
+
+
+def _refers_outside_lambda(body: ir.Expr) -> bool:
+    """True when a lambda body references anything but its parameters
+    and constants (outer-column captures — unsupported)."""
+    if isinstance(body, ir.InputRef):
+        return True
+    return any(_refers_outside_lambda(c) for c in body.children())
 
 
 def _find_agg_calls(e: ast.Expression) -> List[ast.FunctionCall]:
@@ -2933,12 +3122,15 @@ class Analyzer:
             return T.BIGINT
         if kind == "avg":
             # Trino: avg(decimal(p, s)) -> decimal(p, s)
+            # (DecimalAverageAggregation @OutputFunction("decimal(p,s)"))
             if arg_t.is_decimal:
-                return T.decimal(18, arg_t.scale or 0)
+                return T.decimal(arg_t.precision or 18, arg_t.scale or 0)
             return T.DOUBLE
         if kind == "sum":
+            # Trino: sum(decimal(p, s)) -> decimal(38, s)
+            # (DecimalSumAggregation @OutputFunction("decimal(38,s)"))
             if arg_t.is_decimal:
-                return T.decimal(18, arg_t.scale or 0)
+                return T.decimal(T.MAX_DECIMAL_PRECISION, arg_t.scale or 0)
             if arg_t.is_floating:
                 return T.DOUBLE
             return T.BIGINT

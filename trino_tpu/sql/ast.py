@@ -318,6 +318,15 @@ class ValuesBody(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class Lambda(Expression):
+    """`x -> expr` / `(x, y) -> expr` — argument to higher-order
+    functions (parser/sql/tree/LambdaExpression.java analogue)."""
+
+    params: Tuple[str, ...]
+    body: "Expression"
+
+
+@dataclasses.dataclass(frozen=True)
 class ArrayLiteral(Expression):
     """ARRAY[e1, e2, ...]."""
 

@@ -531,18 +531,24 @@ def _reagg_ok(o: P.AggCall) -> bool:
     """Can this plain aggregate re-aggregate through two levels?"""
     if o.distinct:
         return False
-    return o.kind in _REAGG_KINDS or (
-        o.kind == "avg" and o.out_type.is_floating
-    )
+    # avg re-aggregates as (sum, count): float avgs in double, decimal
+    # avgs EXACTLY via a decimal(38,s) sum + HALF_UP division (the
+    # DecimalAverageAggregation contract) — VERDICT r3 item #3
+    return o.kind in _REAGG_KINDS or o.kind == "avg"
 
 
 def _reagg_a1_calls(o: P.AggCall, pos: int, arg_ch, a1_aggs, a1_fields):
     """Append o's LEVEL-1 state aggregates; returns their slot indexes."""
     slots = []
     if o.kind == "avg":
+        sum_t = (
+            T.decimal(T.MAX_DECIMAL_PRECISION, o.out_type.scale or 0)
+            if o.out_type.is_decimal
+            else T.DOUBLE
+        )
         slots.append(len(a1_aggs))
-        a1_aggs.append(P.AggCall("sum", arg_ch, T.DOUBLE))
-        a1_fields.append(P.Field(f"$s{pos}", T.DOUBLE))
+        a1_aggs.append(P.AggCall("sum", arg_ch, sum_t))
+        a1_fields.append(P.Field(f"$s{pos}", sum_t))
         slots.append(len(a1_aggs))
         a1_aggs.append(P.AggCall("count", arg_ch, T.BIGINT))
         a1_fields.append(P.Field(f"$c{pos}", T.BIGINT))
@@ -556,7 +562,12 @@ def _reagg_a1_calls(o: P.AggCall, pos: int, arg_ch, a1_aggs, a1_fields):
 def _reagg_a2_call(o: P.AggCall, si: int):
     """(kind, out_type) of the LEVEL-2 re-aggregate for state slot si."""
     if o.kind == "avg":
-        return "sum", (T.DOUBLE if si == 0 else T.BIGINT)
+        sum_t = (
+            T.decimal(T.MAX_DECIMAL_PRECISION, o.out_type.scale or 0)
+            if o.out_type.is_decimal
+            else T.DOUBLE
+        )
+        return "sum", (sum_t if si == 0 else T.BIGINT)
     return _REAGG_MAP[o.kind], o.out_type
 
 
@@ -565,6 +576,234 @@ def _reagg_final_expr(o: P.AggCall, chs, ref):
     if o.kind == "avg":
         return ir.Call("div", (ref(chs[0]), ref(chs[1])), o.out_type)
     return ref(chs[0])
+
+
+class RewriteMultiSketch:
+    """SEVERAL approx sketch aggregates in one node -> tagged UNION ALL
+    expansion (VERDICT r3 item #3 — the single-sketch rewrites below
+    were gated to exactly one approx aggregate per node; this removes
+    the holistic raw-row fallback for every approx_distinct /
+    approx_percentile combination).
+
+    Each sketch's register/bucket file becomes a grouping dimension as
+    in the single rewrites, but the dimensions cannot share one GROUP
+    BY (a (k, b1, b2) grouping would be the register-file PRODUCT). So
+    the child replicates once per sketch through UNION ALL with a $tag
+    column, every branch computing ONLY its sketch's bucket/payload
+    (NULL elsewhere), and plain re-aggregable siblings riding branch 0
+    alone (their inputs are NULL on other branches, which every
+    mergeable aggregate ignores; count(*) becomes count($one) with
+    $one NULL off branch 0). One A1 over (k, tag, bucket), one A2 over
+    k with per-tag CASE masks, then the original output layout.
+
+    Trade-off: the child subtree evaluates once per sketch — still
+    mergeable end to end (partial/final wire, spill, mesh collectives),
+    unlike the holistic path's full raw-row gather to one node.
+    approx_percentile payloads travel as DOUBLE here (its bucket
+    interpolation is double-precision already)."""
+
+    _SKETCH_KINDS = ("approx_distinct", "approx_percentile")
+
+    def rewrite(self, node: P.PlanNode) -> P.PlanNode:
+        kids = [self.rewrite(c) for c in node.children()]
+        node = with_children(node, kids)
+        if not isinstance(node, P.AggregateNode) or node.step != "single":
+            return node
+        sketches = [
+            (i, a) for i, a in enumerate(node.aggs)
+            if a.kind in self._SKETCH_KINDS and not a.distinct
+        ]
+        if len(sketches) < 2:
+            return node  # single sketches keep their leaner rewrites
+        sk_pos = {i for i, _ in sketches}
+        others = [
+            (i, a) for i, a in enumerate(node.aggs) if i not in sk_pos
+        ]
+        if not all(_reagg_ok(o) for _, o in others):
+            return node
+        return self._expand(node, sketches, others)
+
+    def _expand(self, node: P.AggregateNode, sketches, others):
+        child = node.child
+        K = len(node.group_channels)
+        ref = lambda ch, nd: ir.InputRef(ch, nd.fields[ch].type)
+        null = lambda t: ir.Literal(None, t)
+
+        # -- branches: one projection of the child per sketch ----------
+        branches: List[P.PlanNode] = []
+        branch_fields: Optional[Tuple[P.Field, ...]] = None
+        for t, (pos, a) in enumerate(sketches):
+            exprs: List[ir.Expr] = [
+                ref(c, child) for c in node.group_channels
+            ]
+            fields: List[P.Field] = [
+                child.fields[c] for c in node.group_channels
+            ]
+            exprs.append(ir.Literal(t, T.BIGINT))
+            fields.append(P.Field("$tag", T.BIGINT))
+            x = ref(a.arg_channel, child)
+            if a.kind == "approx_distinct":
+                exprs += [
+                    ir.Call("hll_bucket", (x,), T.BIGINT),
+                    ir.Call("hll_rho", (x,), T.BIGINT),
+                    null(T.DOUBLE),
+                ]
+            else:
+                exprs += [
+                    ir.Call("pctl_bucket", (x,), T.BIGINT),
+                    null(T.BIGINT),
+                    ir.Cast(x, T.DOUBLE),
+                ]
+            fields += [
+                P.Field("$b", T.BIGINT),
+                P.Field("$rho", T.BIGINT),
+                P.Field("$x", T.DOUBLE),
+            ]
+            for pos2, o in others:
+                if o.arg_channel is None:
+                    # count(*) marker: 1 on branch 0, NULL elsewhere
+                    exprs.append(
+                        ir.Literal(1, T.BIGINT) if t == 0 else null(T.BIGINT)
+                    )
+                    fields.append(P.Field(f"$one{pos2}", T.BIGINT))
+                else:
+                    ft = child.fields[o.arg_channel]
+                    exprs.append(
+                        ref(o.arg_channel, child) if t == 0 else null(ft.type)
+                    )
+                    fields.append(ft)
+            branches.append(P.ProjectNode(child, tuple(exprs), tuple(fields)))
+            branch_fields = branches[-1].fields
+        u = P.UnionAllNode(tuple(branches), branch_fields)
+
+        # -- A1: group by (k, tag, b) ---------------------------------
+        # union layout: [k... | $tag=K | $b=K+1 | $rho=K+2 | $x=K+3 |
+        # other args from K+4]
+        rho_u, x_u = K + 2, K + 3
+        a1_aggs: List[P.AggCall] = [
+            P.AggCall("max", rho_u, T.BIGINT),   # $maxrho
+            P.AggCall("count", x_u, T.BIGINT),   # $c  (pctl)
+            P.AggCall("min", x_u, T.DOUBLE),     # $mn (pctl)
+            P.AggCall("max", x_u, T.DOUBLE),     # $mx (pctl)
+        ]
+        a1_fields = list(u.fields[: K + 2]) + [
+            P.Field("$maxrho", T.BIGINT), P.Field("$c", T.BIGINT),
+            P.Field("$mn", T.DOUBLE), P.Field("$mx", T.DOUBLE),
+        ]
+        state_slots: Dict[int, List[int]] = {}
+        for j, (pos2, o) in enumerate(others):
+            arg = K + 4 + j  # the per-other column in the union layout
+            # count(*) must count ONLY branch-0 rows: it aggregates the
+            # $one marker (NULL off branch 0) as a plain count
+            o_eff = (
+                o if o.arg_channel is not None
+                else P.AggCall("count", arg, o.out_type)
+            )
+            state_slots[pos2] = _reagg_a1_calls(
+                o_eff, pos2, arg, a1_aggs, a1_fields,
+            )
+        a1 = P.AggregateNode(
+            u, tuple(range(K + 2)), tuple(a1_aggs), tuple(a1_fields),
+            "single",
+        )
+        # A1 layout: [k..., $tag, $b, $maxrho, $c, $mn, $mx, states...]
+
+        # -- L2: weights + per-tag masks ------------------------------
+        tag_ch, b_ch = K, K + 1
+        mr, c_ch, mn_ch, mx_ch = K + 2, K + 3, K + 4, K + 5
+        exprs2: List[ir.Expr] = [ref(c, a1) for c in range(K)]
+        fields2: List[P.Field] = list(a1.fields[:K])
+
+        def mask(t, e, out_t):
+            return ir.Case(
+                (ir.Call(
+                    "eq", (ref(tag_ch, a1), ir.Literal(t, T.BIGINT)),
+                    T.BOOLEAN,
+                ),),
+                (e,),
+                None,
+                out_t,
+            )
+
+        sk_ch: Dict[int, List[int]] = {}
+        for t, (pos, a) in enumerate(sketches):
+            chs = []
+            if a.kind == "approx_distinct":
+                w = ir.Call(
+                    "hll_weight_rho", (ref(mr, a1), ref(b_ch, a1)), T.DOUBLE
+                )
+                chs.append(len(exprs2))
+                exprs2.append(mask(t, w, T.DOUBLE))
+                fields2.append(P.Field(f"$w{t}", T.DOUBLE))
+                chs.append(len(exprs2))
+                exprs2.append(mask(t, ref(b_ch, a1), T.BIGINT))
+                fields2.append(P.Field(f"$mb{t}", T.BIGINT))
+            else:
+                for src, ot in ((mn_ch, T.DOUBLE), (c_ch, T.BIGINT),
+                                (mx_ch, T.DOUBLE)):
+                    chs.append(len(exprs2))
+                    exprs2.append(mask(t, ref(src, a1), ot))
+                    fields2.append(P.Field(f"$p{t}_{src}", ot))
+            sk_ch[pos] = chs
+        state_ch2: Dict[int, List[int]] = {}
+        for pos2, o in others:
+            state_ch2[pos2] = []
+            for slot in state_slots[pos2]:
+                state_ch2[pos2].append(len(exprs2))
+                exprs2.append(ref(K + 2 + slot, a1))
+                fields2.append(a1.fields[K + 2 + slot])
+        l2 = P.ProjectNode(a1, tuple(exprs2), tuple(fields2))
+
+        # -- A2: group by k -------------------------------------------
+        a2_aggs: List[P.AggCall] = []
+        a2_fields = list(l2.fields[:K])
+        out_ch: Dict[int, List[int]] = {}
+        for t, (pos, a) in enumerate(sketches):
+            chs = sk_ch[pos]
+            if a.kind == "approx_distinct":
+                out_ch[pos] = [K + len(a2_aggs), K + len(a2_aggs) + 1]
+                a2_aggs.append(P.AggCall("sum", chs[0], T.DOUBLE))
+                a2_fields.append(P.Field(f"$sw{t}", T.DOUBLE))
+                a2_aggs.append(P.AggCall("count", chs[1], T.BIGINT))
+                a2_fields.append(P.Field(f"$cnt{t}", T.BIGINT))
+            else:
+                out_ch[pos] = [K + len(a2_aggs)]
+                a2_aggs.append(P.AggCall(
+                    "pctl_merge", chs[0], a.out_type,
+                    arg2_channel=chs[1], arg3_channel=chs[2],
+                    percentile=a.percentile,
+                ))
+                a2_fields.append(P.Field(f"$p{t}", a.out_type))
+        final_ch: Dict[int, List[int]] = {}
+        for pos2, o in others:
+            final_ch[pos2] = []
+            for si, ch2 in enumerate(state_ch2[pos2]):
+                re_kind, out_t = _reagg_a2_call(o, si)
+                final_ch[pos2].append(K + len(a2_aggs))
+                a2_aggs.append(P.AggCall(re_kind, ch2, out_t))
+                a2_fields.append(P.Field(f"$f{pos2}_{si}", out_t))
+        a2 = P.AggregateNode(
+            l2, tuple(range(K)), tuple(a2_aggs), tuple(a2_fields), "single"
+        )
+
+        # -- restore the original output layout -----------------------
+        exprs4: List[ir.Expr] = [ref(c, a2) for c in range(K)]
+        smap = dict(sketches)
+        for i, a in enumerate(node.aggs):
+            if i in smap:
+                if a.kind == "approx_distinct":
+                    exprs4.append(ir.Call(
+                        "hll_estimate",
+                        (ref(out_ch[i][0], a2), ref(out_ch[i][1], a2)),
+                        T.BIGINT,
+                    ))
+                else:
+                    exprs4.append(ref(out_ch[i][0], a2))
+            else:
+                exprs4.append(_reagg_final_expr(
+                    a, final_ch[i], lambda c: ref(c, a2)
+                ))
+        return P.ProjectNode(a2, tuple(exprs4), tuple(node.fields))
 
 
 class RewriteApproxDistinct:
@@ -1065,6 +1304,7 @@ def optimize(
     stats = StatsCalculator(catalogs)
     it = IterativeOptimizer()
     root = it.optimize(root, stats)
+    root = RewriteMultiSketch().rewrite(root)
     root = RewriteApproxDistinct().rewrite(root)
     root = RewriteApproxPercentile().rewrite(root)
     root = RewriteDistinctAggs().rewrite(root)

@@ -26,7 +26,7 @@ _TOKEN_RE = re.compile(
   | (?P<string>'(?:[^']|'')*')
   | (?P<qident>"(?:[^"]|"")*")
   | (?P<ident>[A-Za-z_][A-Za-z_0-9$]*)
-  | (?P<op><>|!=|>=|<=|=>|\|\||[-+*/%(),.;=<>\[\]?{}|])
+  | (?P<op><>|!=|>=|<=|=>|->|\|\||[-+*/%(),.;=<>\[\]?{}|])
     """,
     re.VERBOSE | re.DOTALL,
 )
@@ -778,7 +778,41 @@ class Parser:
 
     # -- expressions (Pratt) --
     def parse_expr(self) -> ast.Expression:
+        lam = self._try_parse_lambda()
+        if lam is not None:
+            return lam
         return self._parse_or()
+
+    def _try_parse_lambda(self) -> "Optional[ast.Lambda]":
+        """`x -> expr` or `(x, y) -> expr` (LambdaExpression.java);
+        only consumed when the arrow is actually present."""
+        t = self.peek()
+        if t.kind == "ident" and self.peek(1).kind == "op" \
+                and self.peek(1).text == "->":
+            name = self.next().text
+            self.next()  # ->
+            return ast.Lambda((name.lower(),), self.parse_expr())
+        if t.kind == "op" and t.text == "(":
+            # lookahead: ( ident [, ident]* ) ->
+            i = 1
+            names = []
+            while True:
+                tk = self.peek(i)
+                if tk.kind != "ident":
+                    return None
+                names.append(tk.text.lower())
+                nxt = self.peek(i + 1)
+                if nxt.kind == "op" and nxt.text == ",":
+                    i += 2
+                    continue
+                if nxt.kind == "op" and nxt.text == ")":
+                    arrow = self.peek(i + 2)
+                    if arrow.kind == "op" and arrow.text == "->":
+                        for _ in range(i + 3):
+                            self.next()
+                        return ast.Lambda(tuple(names), self.parse_expr())
+                return None
+        return None
 
     def _parse_or(self) -> ast.Expression:
         left = self._parse_and()

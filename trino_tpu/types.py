@@ -82,6 +82,19 @@ class DataType:
         return self.kind == TypeKind.DECIMAL
 
     @property
+    def is_long_decimal(self) -> bool:
+        """decimal(19..38): Int128 carrier — physically an (n, 2) int64
+        array of (signed high, unsigned low) limbs, the vectorized
+        Int128ArrayBlock (spi/block/Int128ArrayBlock.java)."""
+        return self.kind == TypeKind.DECIMAL and (self.precision or 0) > 18
+
+    @property
+    def lanes(self) -> int:
+        """Trailing physical lanes per value (1 for flat types, 2 for
+        long decimals); device arrays are (capacity,) or (capacity, lanes)."""
+        return 2 if self.is_long_decimal else 1
+
+    @property
     def is_floating(self) -> bool:
         return self.kind in (TypeKind.REAL, TypeKind.DOUBLE)
 
@@ -185,11 +198,15 @@ INTERVAL_YEAR = DataType(TypeKind.INTERVAL_YEAR)
 UNKNOWN = DataType(TypeKind.UNKNOWN)
 
 
+MAX_DECIMAL_PRECISION = 38  # spi/type/Decimals.java MAX_PRECISION
+MAX_SHORT_PRECISION = 18  # fits a scaled int64 lane
+
+
 def decimal(precision: int, scale: int) -> DataType:
-    if precision > 18:
-        # int64 holds 18 digits; Trino goes to 38 via Int128. We cap at 18
-        # for now; a two-lane int64 repr is the extension point.
-        raise ValueError("decimal precision > 18 not supported yet")
+    if precision > MAX_DECIMAL_PRECISION:
+        raise ValueError(
+            f"decimal precision {precision} exceeds {MAX_DECIMAL_PRECISION}"
+        )
     return DataType(TypeKind.DECIMAL, precision, scale)
 
 
@@ -267,27 +284,68 @@ def common_super_type(a: DataType, b: DataType) -> Optional[DataType]:
     if a.kind == b.kind == TypeKind.DECIMAL:
         scale = max(a.scale, b.scale)
         intd = max(a.precision - a.scale, b.precision - b.scale)
-        if intd + scale > 18:
-            # cannot represent both operands exactly in int64 decimals;
-            # Trino raises for unrepresentable common decimals too
-            raise TypeError(
-                f"no common decimal type for {a} and {b} (needs precision {intd + scale})"
-            )
-        return decimal(intd + scale, scale)
+        return decimal(min(intd + scale, MAX_DECIMAL_PRECISION), scale)
     if a.is_numeric and b.is_numeric:
         ia = _NUMERIC_LADDER.index(a.kind)
         ib = _NUMERIC_LADDER.index(b.kind)
         hi, hik = (a, a.kind) if ia >= ib else (b, b.kind)
         lo = b if ia >= ib else a
         if hik == TypeKind.DECIMAL and lo.is_integerlike:
-            # integer widens into decimal with same scale
-            return decimal(18, hi.scale)
+            # integer widens into decimal at its digit capacity
+            # (DecimalCasts: tinyint->3, smallint->5, int->10, bigint->19)
+            ip = integer_decimal_precision(lo)
+            s = hi.scale or 0
+            p = min(max(hi.precision - s, ip) + s, MAX_DECIMAL_PRECISION)
+            return decimal(p, s)
         if hik in (TypeKind.REAL, TypeKind.DOUBLE) and (
             lo.is_decimal or lo.is_integerlike or lo.is_floating
         ):
             return DOUBLE if hik == TypeKind.DOUBLE or lo.kind == TypeKind.DOUBLE else hi
         return hi
     return None
+
+
+def integer_decimal_precision(t: DataType) -> int:
+    """Digit capacity of an integer kind when it coerces to decimal
+    (DecimalCasts: tinyint 3, smallint 5, integer 10, bigint 19)."""
+    return {
+        TypeKind.TINYINT: 3,
+        TypeKind.SMALLINT: 5,
+        TypeKind.INTEGER: 10,
+    }.get(t.kind, 19)
+
+
+def _as_decimal_shape(t: DataType):
+    if t.is_decimal:
+        return t.precision or 0, t.scale or 0
+    return integer_decimal_precision(t), 0
+
+
+def decimal_arith_type(op: str, a: DataType, b: DataType) -> DataType:
+    """Trino's exact decimal operator result types
+    (main/type/DecimalOperators.java signature longVariables):
+      +/-: p = min(38, max(p1-s1, p2-s2) + max(s1,s2) + 1), s = max(s1,s2)
+      *:   p = min(38, p1 + p2),                            s = s1 + s2
+      /:   p = min(38, p1 + s2 + max(s2 - s1, 0)),          s = max(s1,s2)
+      %:   p = min(p1-s1, p2-s2) + max(s1,s2),              s = max(s1,s2)
+    """
+    p1, s1 = _as_decimal_shape(a)
+    p2, s2 = _as_decimal_shape(b)
+    cap = MAX_DECIMAL_PRECISION
+    if op in ("add", "sub", "+", "-"):
+        return decimal(min(cap, max(p1 - s1, p2 - s2) + max(s1, s2) + 1),
+                       max(s1, s2))
+    if op in ("mul", "*"):
+        if s1 + s2 > cap:
+            raise TypeError(
+                f"decimal multiply scale {s1 + s2} exceeds {cap}"
+            )
+        return decimal(min(cap, p1 + p2), s1 + s2)
+    if op in ("div", "/"):
+        return decimal(min(cap, p1 + s2 + max(s2 - s1, 0)), max(s1, s2))
+    if op in ("mod", "%"):
+        return decimal(min(p1 - s1, p2 - s2) + max(s1, s2), max(s1, s2))
+    raise TypeError(f"unknown decimal op {op}")
 
 
 def arithmetic_result_type(op: str, a: DataType, b: DataType) -> DataType:
@@ -300,18 +358,14 @@ def arithmetic_result_type(op: str, a: DataType, b: DataType) -> DataType:
     if a.kind == TypeKind.TIMESTAMP or b.kind == TypeKind.TIMESTAMP:
         if a.kind in (TypeKind.INTERVAL_DAY,) or b.kind in (TypeKind.INTERVAL_DAY,):
             return TIMESTAMP
+    if (a.is_decimal or b.is_decimal) and not (
+        a.is_floating or b.is_floating
+    ):
+        opname = {"+": "add", "-": "sub", "*": "mul", "/": "div", "%": "mod"}.get(op, op)
+        return decimal_arith_type(opname, a, b)
     common = common_super_type(a, b)
     if common is None:
         raise TypeError(f"cannot apply {op} to {a} and {b}")
-    if common.is_decimal:
-        if op == "*":
-            return decimal(18, min((a.scale or 0) + (b.scale or 0), 18))
-        if op == "/":
-            # Trino: scale = max(a.scale, b.scale); we follow.
-            return decimal(18, max(a.scale or 0, b.scale or 0))
-        if op == "%":
-            return common
-        return common
     if common.is_integerlike and op == "/":
         return common  # integer division
     return common
