@@ -164,3 +164,75 @@ def test_corruption_and_unsupported_fail_loud(tmp_path):
     # missing file
     with pytest.raises(OSError):
         read_parquet(p + ".missing")
+
+
+class TestR4Features:
+    """GZIP compression, dictionary pages, row-group statistics +
+    predicate pruning (VERDICT r3 item #9; lib/trino-parquet)."""
+
+    def _cols(self, n=1000, seed=3):
+        import numpy as np
+
+        from trino_tpu.connectors import parquet_format as PQ
+
+        rng = np.random.default_rng(seed)
+        return [
+            PQ.ParquetColumn("k", PQ.T_INT64,
+                             values=np.arange(n, dtype=np.int64)),
+            PQ.ParquetColumn(
+                "s", PQ.T_BYTE_ARRAY, converted=PQ.C_UTF8,
+                values=[f"v{int(x)}" for x in rng.integers(0, 8, n)],
+            ),
+            PQ.ParquetColumn(
+                "d", PQ.T_DOUBLE, values=rng.standard_normal(n),
+                valid=rng.random(n) > 0.1,
+            ),
+        ], n
+
+    def test_gzip_and_dictionary_roundtrip(self, tmp_path):
+        import numpy as np
+
+        from trino_tpu.connectors import parquet_format as PQ
+
+        cols, n = self._cols()
+        plain = tmp_path / "plain.parquet"
+        gz = tmp_path / "gz.parquet"
+        PQ.write_parquet(str(plain), cols, n, codec="none",
+                         use_dictionary=False)
+        PQ.write_parquet(str(gz), cols, n, codec="gzip")
+        assert gz.stat().st_size < 0.6 * plain.stat().st_size
+        rcols, rn = PQ.read_parquet(str(gz))
+        assert rn == n
+        assert np.array_equal(rcols[0].values, cols[0].values)
+        got_s = [
+            b.decode() if isinstance(b, (bytes, bytearray)) else b
+            for b in rcols[1].values
+        ]
+        assert got_s == cols[1].values
+        ok = rcols[2].valid
+        assert np.array_equal(ok, cols[2].valid)
+        assert np.allclose(
+            np.asarray(rcols[2].values)[ok], np.asarray(cols[2].values)[ok]
+        )
+
+    def test_row_group_pruning(self, tmp_path):
+        import numpy as np
+
+        from trino_tpu.connectors import parquet_format as PQ
+
+        cols, n = self._cols(n=4000)
+        path = tmp_path / "rg.parquet"
+        PQ.write_parquet(str(path), cols, n, codec="gzip",
+                         row_group_rows=1000)
+        # k in [2500, 2600]: only the third row group can match
+        rcols, rn = PQ.read_parquet(
+            str(path), predicate={"k": (2500, 2600)}
+        )
+        assert rn == 1000
+        ks = np.asarray(rcols[0].values)
+        assert ks.min() == 2000 and ks.max() == 2999
+        # contradiction prunes everything
+        rcols2, rn2 = PQ.read_parquet(
+            str(path), predicate={"k": (10**9, None)}
+        )
+        assert rn2 == 0

@@ -38,10 +38,19 @@ class Client:
         # this connection's transaction (X-Trino-Transaction-Id model:
         # the client carries the id; the server holds no session state)
         self.transaction_id: Optional[str] = None
+        # prepared statements are also client session state
+        # (X-Trino-Prepared-Statement / addedPrepare protocol)
+        self.prepared: dict = {}
 
     def _request(self, method: str, url: str, body: Optional[bytes] = None) -> dict:
         headers = dict(self.headers)
         headers["X-Trino-Transaction-Id"] = self.transaction_id or "NONE"
+        if self.prepared:
+            import urllib.parse as _up
+
+            headers["X-Trino-Prepared-Statement"] = ",".join(
+                f"{k}={_up.quote(v)}" for k, v in self.prepared.items()
+            )
         req = urllib.request.Request(
             url, data=body, method=method, headers=headers
         )
@@ -66,6 +75,11 @@ class Client:
                 self.transaction_id = out["startedTransactionId"]
             if out.get("clearedTransactionId"):
                 self.transaction_id = None
+            if out.get("addedPrepare"):
+                ap = out["addedPrepare"]
+                self.prepared[ap["name"]] = ap["sql"]
+            if out.get("deallocatedPrepare"):
+                self.prepared.pop(out["deallocatedPrepare"], None)
             if "error" in out:
                 raise QueryError(out["error"].get("message", "query failed"))
             if out.get("columns"):

@@ -787,7 +787,12 @@ class ParquetPageSink(ConnectorPageSink):
             cols.append(_to_parquet_column(
                 cm, data, None if valid.all() else valid, None
             ))
-        PQ.write_parquet(self._tmp, cols, self.rows)
+        # gzip + dictionary pages by default (r4); 64k-row groups give
+        # the reader's min/max pruning real skip granularity
+        PQ.write_parquet(
+            self._tmp, cols, self.rows, codec="gzip",
+            row_group_rows=1 << 16,
+        )
         os.replace(self._tmp, self._final)
         return self.rows
 

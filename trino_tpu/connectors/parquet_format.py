@@ -130,6 +130,11 @@ class _Writer:
         self.buf += _uvarint(len(b))
         self.buf += b
 
+    def bytes_field(self, fid: int, b: bytes) -> None:
+        self._field(fid, _CT_BINARY)
+        self.buf += _uvarint(len(b))
+        self.buf += b
+
     def struct_begin(self, fid: int) -> None:
         self._field(fid, _CT_STRUCT)
         self._last_fid.append(0)
@@ -338,40 +343,233 @@ def _plain_decode(physical: int, data: bytes, n: int):
 # write
 # ---------------------------------------------------------------------------
 
+# parquet compression codecs this codec speaks (stdlib only: SNAPPY has
+# no stdlib decoder, LZ4/ZSTD none either — GZIP is the portable one)
+CODEC_UNCOMPRESSED = 0
+CODEC_GZIP = 2
+_CODEC_NAMES = {"none": CODEC_UNCOMPRESSED, "gzip": CODEC_GZIP}
 
-def write_parquet(path: str, columns: List[ParquetColumn], num_rows: int) -> None:
-    body = bytearray(MAGIC)
-    chunk_meta = []  # (col, data_page_offset, page_bytes_len, num_values)
-    for col in columns:
-        offset = len(body)
-        # page payload: [def levels if optional] + PLAIN values (non-null)
-        payload = bytearray()
-        if col.valid is not None:
-            levels = _bitpack_levels(np.asarray(col.valid, dtype=bool))
-            payload += struct.pack("<I", len(levels))
-            payload += levels
-            if col.physical == T_BYTE_ARRAY:
-                vals = [v for v, ok in zip(col.values, col.valid) if ok]
-            else:
-                vals = np.asarray(col.values)[np.asarray(col.valid, bool)]
-            dense = dataclasses.replace(col, values=vals)
-            payload += _plain_encode(dense)
+
+def _compress(codec: int, payload: bytes) -> bytes:
+    if codec == CODEC_GZIP:
+        import gzip
+
+        return gzip.compress(payload, compresslevel=1)
+    return payload
+
+
+def _decompress(codec: int, payload: bytes, uncompressed_size: int) -> bytes:
+    if codec == CODEC_GZIP:
+        import gzip
+
+        return gzip.decompress(payload)
+    if codec == CODEC_UNCOMPRESSED:
+        return payload
+    raise ValueError(
+        f"unsupported parquet codec {codec} (UNCOMPRESSED/GZIP only)"
+    )
+
+
+def _pack_indices(idx: np.ndarray, bit_width: int) -> bytes:
+    """Dictionary indices as ONE bit-packed run of the RLE/bit-packed
+    hybrid (preceded by the 1-byte bit width, per RLE_DICTIONARY)."""
+    n = len(idx)
+    groups = (n + 7) // 8
+    padded = np.zeros(groups * 8, dtype=np.uint64)
+    padded[:n] = idx.astype(np.uint64)
+    # bit-pack little-endian within each 8-value group
+    bits = np.zeros((groups * 8, bit_width), dtype=np.uint8)
+    for b in range(bit_width):
+        bits[:, b] = (padded >> np.uint64(b)) & np.uint64(1)
+    packed = np.packbits(bits.reshape(-1), bitorder="little").tobytes()
+    return (
+        bytes([bit_width])
+        + _uvarint((groups << 1) | 1)
+        + packed
+    )
+
+
+def _unpack_indices(data: bytes, n: int) -> np.ndarray:
+    """Inverse of _pack_indices (also accepts RLE runs)."""
+    bit_width = data[0]
+    out = np.zeros(n, dtype=np.int64)
+    r = _Reader(data, 1)
+    i = 0
+    while i < n:
+        header = r._uvarint()
+        if header & 1:
+            groups = header >> 1
+            nbytes = groups * bit_width
+            raw = np.frombuffer(r.d[r.pos:r.pos + nbytes], np.uint8)
+            r.pos += nbytes
+            bits = np.unpackbits(raw, bitorder="little").reshape(
+                -1, bit_width
+            )
+            vals = np.zeros(len(bits), dtype=np.int64)
+            for b in range(bit_width):
+                vals |= bits[:, b].astype(np.int64) << b
+            take = min(len(vals), n - i)
+            out[i:i + take] = vals[:take]
+            i += take
         else:
-            payload += _plain_encode(col)
-        ph = _Writer()
-        ph.i32(1, 0)                    # DATA_PAGE
-        ph.i32(2, len(payload))         # uncompressed size
-        ph.i32(3, len(payload))         # compressed size (== uncompressed)
-        ph.struct_begin(5)              # data_page_header
-        ph.i32(1, num_rows)             # num_values (incl. nulls)
-        ph.i32(2, 0)                    # PLAIN
-        ph.i32(3, 3)                    # def levels: RLE
-        ph.i32(4, 3)                    # rep levels: RLE (absent, flat)
-        ph.struct_end()
-        ph.root_end()
-        body += ph.buf
-        body += payload
-        chunk_meta.append((col, offset, len(ph.buf) + len(payload)))
+            count = header >> 1
+            nbytes = (bit_width + 7) // 8
+            val = int.from_bytes(r.d[r.pos:r.pos + nbytes], "little")
+            r.pos += nbytes
+            take = min(count, n - i)
+            out[i:i + take] = val
+            i += take
+    return out
+
+
+def _chunk_stats(col: ParquetColumn, valid_mask) -> Optional[Tuple[bytes, bytes, int]]:
+    """(min_value, max_value, null_count) little-endian-encoded per the
+    Statistics struct, or None when the type has no cheap ordering."""
+    vals = col.values
+    nulls = 0
+    if valid_mask is not None:
+        nulls = int((~valid_mask).sum())
+        if col.physical == T_BYTE_ARRAY:
+            vals = [v for v, ok in zip(vals, valid_mask) if ok]
+        else:
+            vals = np.asarray(vals)[valid_mask]
+    if len(vals) == 0:
+        return None
+    if col.physical == T_BYTE_ARRAY:
+        bs = [
+            v.encode("utf-8") if isinstance(v, str) else v for v in vals
+        ]
+        return min(bs), max(bs), nulls
+    arr = np.asarray(vals)
+    fmt = {T_INT32: "<i4", T_INT64: "<i8", T_FLOAT: "<f4",
+           T_DOUBLE: "<f8"}.get(col.physical)
+    if fmt is None:
+        return None
+    return (
+        np.asarray(arr.min(), fmt).tobytes(),
+        np.asarray(arr.max(), fmt).tobytes(),
+        nulls,
+    )
+
+
+def _write_chunk(body: bytearray, col: ParquetColumn, codec: int,
+                 use_dictionary: bool):
+    """One column chunk (optionally dictionary-encoded BYTE_ARRAY):
+    returns (offsets + metadata dict for the footer)."""
+    n = (
+        len(col.values)
+        if col.physical == T_BYTE_ARRAY or not hasattr(col.values, "shape")
+        else int(np.asarray(col.values).shape[0])
+    )
+    valid = None if col.valid is None else np.asarray(col.valid, bool)
+    dict_page_offset = None
+    encoding = 0  # PLAIN
+    first_offset = len(body)
+
+    payload = bytearray()
+    if valid is not None:
+        levels = _bitpack_levels(valid)
+        payload += struct.pack("<I", len(levels))
+        payload += levels
+        if col.physical == T_BYTE_ARRAY:
+            dense_vals = [v for v, ok in zip(col.values, valid) if ok]
+        else:
+            dense_vals = np.asarray(col.values)[valid]
+    else:
+        dense_vals = col.values
+
+    if use_dictionary and col.physical == T_BYTE_ARRAY:
+        bs = [
+            v.encode("utf-8") if isinstance(v, str) else v
+            for v in dense_vals
+        ]
+        uniq = sorted(set(bs))
+        if len(uniq) and len(uniq) * 2 <= max(len(bs), 1):
+            code = {v: i for i, v in enumerate(uniq)}
+            idx = np.asarray([code[v] for v in bs], np.int64)
+            bw = max(int(len(uniq) - 1).bit_length(), 1)
+            # dictionary page first
+            dpl = _compress(codec, _plain_encode(dataclasses.replace(
+                col, values=uniq, valid=None
+            )))
+            raw_len = len(_plain_encode(dataclasses.replace(
+                col, values=uniq, valid=None
+            )))
+            dh = _Writer()
+            dh.i32(1, 2)            # DICTIONARY_PAGE
+            dh.i32(2, raw_len)
+            dh.i32(3, len(dpl))
+            dh.struct_begin(7)      # dictionary_page_header
+            dh.i32(1, len(uniq))
+            dh.i32(2, 0)            # PLAIN
+            dh.struct_end()
+            dh.root_end()
+            dict_page_offset = len(body)
+            first_offset = dict_page_offset
+            body += dh.buf
+            body += dpl
+            payload += _pack_indices(idx, bw)
+            encoding = 8  # RLE_DICTIONARY
+    if encoding == 0:
+        payload += _plain_encode(
+            dataclasses.replace(col, values=dense_vals, valid=None)
+        )
+
+    raw = bytes(payload)
+    comp = _compress(codec, raw)
+    ph = _Writer()
+    ph.i32(1, 0)                    # DATA_PAGE
+    ph.i32(2, len(raw))             # uncompressed size
+    ph.i32(3, len(comp))            # compressed size
+    ph.struct_begin(5)              # data_page_header
+    ph.i32(1, n)                    # num_values (incl. nulls)
+    ph.i32(2, encoding)
+    ph.i32(3, 3)                    # def levels: RLE
+    ph.i32(4, 3)                    # rep levels: RLE (absent, flat)
+    ph.struct_end()
+    ph.root_end()
+    data_page_offset = len(body)
+    if dict_page_offset is None:
+        first_offset = data_page_offset
+    body += ph.buf
+    body += comp
+    nbytes = len(body) - first_offset
+    stats = _chunk_stats(col, valid)
+    return dict_page_offset, data_page_offset, first_offset, nbytes, n, stats
+
+
+def write_parquet(path: str, columns: List[ParquetColumn], num_rows: int,
+                  codec: str = "none", row_group_rows: Optional[int] = None,
+                  use_dictionary: bool = True) -> None:
+    """`codec`: none | gzip. `row_group_rows` splits the file into
+    multiple row groups whose per-chunk min/max statistics feed
+    read_parquet's predicate pruning."""
+    codec_id = _CODEC_NAMES[codec]
+    if row_group_rows is None or row_group_rows >= num_rows:
+        row_group_rows = max(num_rows, 1)
+    body = bytearray(MAGIC)
+    groups = []  # list of (chunk_meta list, rows_in_group)
+    for g0 in range(0, max(num_rows, 1), row_group_rows):
+        g1 = min(g0 + row_group_rows, num_rows)
+        chunk_meta = []
+        for col in columns:
+            sl_vals = (
+                col.values[g0:g1]
+                if col.physical == T_BYTE_ARRAY
+                else np.asarray(col.values)[g0:g1]
+            )
+            sl = dataclasses.replace(
+                col,
+                values=sl_vals,
+                valid=None if col.valid is None
+                else np.asarray(col.valid, bool)[g0:g1],
+            )
+            chunk_meta.append(
+                (col, _write_chunk(body, sl, codec_id, use_dictionary))
+            )
+        groups.append((chunk_meta, g1 - g0))
+        if num_rows == 0:
+            break
 
     # footer
     w = _Writer()
@@ -397,32 +595,44 @@ def write_parquet(path: str, columns: List[ParquetColumn], num_rows: int) -> Non
         se.root_end()
         w.buf += se.buf
     w.i64(3, num_rows)
-    w.list_begin(4, _CT_STRUCT, 1)  # one row group
-    rg = _Writer()
-    rg.list_begin(1, _CT_STRUCT, len(columns))
-    total = 0
-    for col, offset, nbytes in chunk_meta:
-        cc = _Writer()
-        cc.i64(2, offset)               # file_offset
-        cc.struct_begin(3)              # meta_data
-        cc.i32(1, col.physical)
-        cc.list_begin(2, _CT_I32, 1)
-        cc.list_i32_elem(0)             # PLAIN
-        cc.list_begin(3, _CT_BINARY, 1)
-        cc.list_string_elem(col.name)
-        cc.i32(4, 0)                    # UNCOMPRESSED
-        cc.i64(5, num_rows)
-        cc.i64(6, nbytes)
-        cc.i64(7, nbytes)
-        cc.i64(9, offset)               # data_page_offset
-        cc.struct_end()
-        cc.root_end()
-        rg.buf += cc.buf
-        total += nbytes
-    rg.i64(2, total)
-    rg.i64(3, num_rows)
-    rg.root_end()
-    w.buf += rg.buf
+    w.list_begin(4, _CT_STRUCT, len(groups))
+    for chunk_meta, g_rows in groups:
+        rg = _Writer()
+        rg.list_begin(1, _CT_STRUCT, len(columns))
+        total = 0
+        for col, (dict_off, data_off, first_off, nbytes, nvals, stats) in chunk_meta:
+            cc = _Writer()
+            cc.i64(2, first_off)            # file_offset
+            cc.struct_begin(3)              # meta_data
+            cc.i32(1, col.physical)
+            cc.list_begin(2, _CT_I32, 2 if dict_off is not None else 1)
+            cc.list_i32_elem(0)             # PLAIN
+            if dict_off is not None:
+                cc.list_i32_elem(8)         # RLE_DICTIONARY
+            cc.list_begin(3, _CT_BINARY, 1)
+            cc.list_string_elem(col.name)
+            cc.i32(4, codec_id)
+            cc.i64(5, nvals)
+            cc.i64(6, nbytes)
+            cc.i64(7, nbytes)
+            cc.i64(9, data_off)             # data_page_offset
+            if dict_off is not None:
+                cc.i64(11, dict_off)        # dictionary_page_offset
+            if stats is not None:
+                mn, mx, nulls = stats
+                cc.struct_begin(12)         # statistics
+                cc.bytes_field(5, mx)       # max_value
+                cc.bytes_field(6, mn)       # min_value
+                cc.i64(3, nulls)
+                cc.struct_end()
+            cc.struct_end()
+            cc.root_end()
+            rg.buf += cc.buf
+            total += nbytes
+        rg.i64(2, total)
+        rg.i64(3, g_rows)
+        rg.root_end()
+        w.buf += rg.buf
     w.string(6, "trino-tpu")
     w.root_end()
 
@@ -438,7 +648,19 @@ def write_parquet(path: str, columns: List[ParquetColumn], num_rows: int) -> Non
 # ---------------------------------------------------------------------------
 
 
-def read_parquet(path: str) -> Tuple[List[ParquetColumn], int]:
+def _decode_stat(physical: int, raw: bytes):
+    fmt = {T_INT32: "<i4", T_INT64: "<i8", T_FLOAT: "<f4",
+           T_DOUBLE: "<f8"}.get(physical)
+    if fmt is None:
+        return raw  # BYTE_ARRAY: compare as bytes
+    return np.frombuffer(raw, fmt)[0].item()
+
+
+def read_parquet(path: str, predicate: Optional[Dict[str, tuple]] = None
+                 ) -> Tuple[List[ParquetColumn], int]:
+    """`predicate`: {column: (lo, hi)} closed ranges (None = unbounded
+    side); row groups whose min/max statistics prove emptiness are
+    skipped entirely (lib/trino-parquet predicate pushdown analogue)."""
     with open(path, "rb") as f:
         data = f.read()
     if data[:4] != MAGIC or data[-4:] != MAGIC:
@@ -466,39 +688,80 @@ def read_parquet(path: str) -> Tuple[List[ParquetColumn], int]:
         for se in leaves
     ]
     chunks: List[List[Tuple[np.ndarray, Any]]] = [[] for _ in cols]
+    rows_read = 0
     for rg in row_groups:
+        # row-group pruning from chunk statistics (min_value/max_value)
+        if predicate:
+            skip = False
+            for ci, cc in enumerate(rg[1]):
+                name = cols[ci].name
+                if name not in predicate:
+                    continue
+                st = cc[3].get(12)
+                if not st or 5 not in st or 6 not in st:
+                    continue
+                lo, hi = predicate[name]
+                mn = _decode_stat(cols[ci].physical, st[6])
+                mx = _decode_stat(cols[ci].physical, st[5])
+                if (hi is not None and mn is not None and mn > hi) or (
+                    lo is not None and mx is not None and mx < lo
+                ):
+                    skip = True
+                    break
+            if skip:
+                continue
+        rows_read += rg.get(3, 0)
         for ci, cc in enumerate(rg[1]):
             md = cc[3]
             codec = md.get(4, 0)
-            if codec != 0:
-                raise ValueError(
-                    f"unsupported parquet codec {codec} (UNCOMPRESSED only)"
-                )
-            pos = md.get(9, cc.get(2))
+            pos = md.get(11, md.get(9, cc.get(2)))
             n_remaining = md[5]
+            dictionary = None
             while n_remaining > 0:
                 r = _Reader(data, pos)
                 ph = r.read_struct()
+                raw_len = ph[2]
                 page_len = ph[3]
                 page_start = r.pos
+                page = _decompress(
+                    codec, data[page_start:page_start + page_len], raw_len
+                )
+                if ph.get(7) is not None:  # dictionary page
+                    n_dict = ph[7][1]
+                    dictionary = _plain_decode(
+                        cols[ci].physical, page, n_dict
+                    )
+                    pos = page_start + page_len
+                    continue
                 dph = ph.get(5)
-                if dph is None:  # dictionary page etc.: skip
+                if dph is None:  # index/other pages: skip
                     pos = page_start + page_len
                     continue
                 n_vals = dph[1]
-                if dph.get(2, 0) != 0:
-                    raise ValueError("unsupported parquet encoding (PLAIN only)")
+                enc = dph.get(2, 0)
                 if cols[ci].valid is not None:
-                    valid, vpos = _read_levels(data, page_start, n_vals)
-                    vals = _plain_decode(
-                        cols[ci].physical, data[vpos:page_start + page_len],
-                        int(valid.sum()),
-                    )
+                    valid, vpos = _read_levels(page, 0, n_vals)
+                    body_bytes = page[vpos:]
+                    n_dense = int(valid.sum())
                 else:
                     valid = None
+                    body_bytes = page
+                    n_dense = n_vals
+                if enc in (2, 8):  # PLAIN_DICTIONARY / RLE_DICTIONARY
+                    if dictionary is None:
+                        raise ValueError("dictionary page missing")
+                    idx = _unpack_indices(body_bytes, n_dense)
+                    if cols[ci].physical == T_BYTE_ARRAY:
+                        vals = [dictionary[int(i)] for i in idx]
+                    else:
+                        vals = np.asarray(dictionary)[idx]
+                elif enc == 0:
                     vals = _plain_decode(
-                        cols[ci].physical,
-                        data[page_start:page_start + page_len], n_vals,
+                        cols[ci].physical, body_bytes, n_dense
+                    )
+                else:
+                    raise ValueError(
+                        f"unsupported parquet encoding {enc}"
                     )
                 chunks[ci].append((valid, vals))
                 n_remaining -= n_vals
@@ -535,4 +798,6 @@ def read_parquet(path: str) -> Tuple[List[ParquetColumn], int]:
             col.valid = valid
         else:
             col.values = dense
-    return cols, num_rows
+    # with predicate pruning the returned row count covers the ROW
+    # GROUPS ACTUALLY READ, matching the data arrays
+    return cols, (rows_read if predicate else num_rows)

@@ -10,7 +10,7 @@ on top of the same plans.
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 from trino_tpu import types as T
 from trino_tpu.connectors.spi import CatalogManager, Connector
@@ -80,6 +80,10 @@ class MaterializedResult:
     # X-Trino-Started-Transaction-Id / Clear-Transaction-Id headers)
     started_transaction_id: Optional[str] = None
     cleared_transaction: bool = False
+    # prepared-statement protocol surface (X-Trino-Added-Prepare /
+    # X-Trino-Deallocated-Prepare response headers)
+    added_prepare: Optional[tuple] = None
+    deallocated_prepare: Optional[str] = None
     # which data plane executed the query: "local" (single-process),
     # "mesh" (ICI collectives), "http" (page exchange), "fte" (spooled).
     # Surfaces the silent mesh fallback (VERDICT r2 weak #4).
@@ -109,6 +113,10 @@ class LocalQueryRunner:
 
         self.session = session or Session()
         self.catalogs = CatalogManager()
+        # PREPARE store: name -> (ast statement, formatted text); the
+        # HTTP protocol's prepared-statement headers mirror this
+        self._prepared: Dict[str, tuple] = {}
+        self._request_prepared: Optional[Dict[str, str]] = None
         # SQL text -> (OutputNode, PhysicalPlan): re-executing a cached
         # query reuses every jitted device program (the reference's
         # expression/operator caches keyed on expression, §2.9)
@@ -158,7 +166,8 @@ class LocalQueryRunner:
 
     # -- entry point --
     def execute(
-        self, sql: str, identity=None, transaction_id: Optional[str] = None
+        self, sql: str, identity=None, transaction_id: Optional[str] = None,
+        prepared: Optional[Dict[str, str]] = None,
     ) -> MaterializedResult:
         """`identity` overrides the session user for this statement (the
         HTTP front passes the authenticated principal).
@@ -179,10 +188,12 @@ class LocalQueryRunner:
         if identity is not None:
             self._identity_override.value = identity
         self._stmt_txn.value = active
+        self._request_prepared = prepared
         try:
             return self._dispatch(stmt, sql, active, explicit)
         finally:
             self._stmt_txn.value = None
+            self._request_prepared = None
             if identity is not None:
                 self._identity_override.value = None
 
@@ -204,6 +215,54 @@ class LocalQueryRunner:
         from trino_tpu.transaction import TransactionError
 
         self.access_control.check_can_execute_query(self.identity)
+        if isinstance(stmt, ast.Prepare):
+            # PREPARE name FROM stmt (tree/Prepare.java:25; the protocol
+            # threads these via X-Trino-Prepared-Statement headers —
+            # runtime/server mirrors this session store per request)
+            from trino_tpu.sql.formatter import format_statement
+
+            try:
+                text = format_statement(stmt.statement)
+            except Exception:
+                text = stmt.sql or ""
+            self._prepared[stmt.name] = (stmt.statement, text)
+            res = MaterializedResult([[True]], ["result"], [T.BOOLEAN])
+            res.added_prepare = (stmt.name, text)
+            return res
+        if isinstance(stmt, ast.ExecuteStmt):
+            # request-carried statements (X-Trino-Prepared-Statement)
+            # take precedence: they are CLIENT-session state, while the
+            # instance store is shared across every caller
+            hit = None
+            if self._request_prepared:
+                text = self._request_prepared.get(stmt.name)
+                if text is not None:
+                    hit = (parse(text), text)
+            if hit is None:
+                hit = self._prepared.get(stmt.name)
+            if hit is None:
+                raise ValueError(
+                    f"Prepared statement not found: {stmt.name}"
+                )
+            body = ast.substitute_parameters(hit[0], stmt.parameters)
+            # plan-cache key must identify the PREPARED text + bound
+            # parameters — distinct statements can share one EXECUTE
+            # text (the dbapi always names its statement "stmt")
+            from trino_tpu.sql.formatter import format_expression
+
+            pkey = hit[1] + " /*USING*/ " + ",".join(
+                format_expression(pv) for pv in stmt.parameters
+            )
+            return self._dispatch(body, pkey, active, explicit)
+        if isinstance(stmt, ast.Deallocate):
+            if stmt.name not in self._prepared:
+                raise ValueError(
+                    f"Prepared statement not found: {stmt.name}"
+                )
+            del self._prepared[stmt.name]
+            res = MaterializedResult([[True]], ["result"], [T.BOOLEAN])
+            res.deallocated_prepare = stmt.name
+            return res
         if isinstance(stmt, ast.StartTransaction):
             if active is not None:
                 raise TransactionError("transaction already in progress")

@@ -936,6 +936,26 @@ def partial_output_schema(
 _LIMB_MASK = 0xFFFFFFFF
 
 
+def _append_long_decimal_slots(a, col, live, values, vvalids, reds) -> None:
+    """Value-slot assembly for an aggregate over a decimal(>18) (n, 2)
+    column: count reads only validity, sum/avg limb-split into four
+    exact int64 slots; everything else is unimplemented. Shared by the
+    three ingest paths (per-batch, streaming, holistic)."""
+    if a.kind == "count":
+        values.append(live.astype(jnp.int64))
+        vvalids.append(col.valid)
+        reds.append("count")
+        return
+    if a.kind not in ("sum", "avg"):
+        raise NotImplementedError(
+            f"{a.kind}() over decimal(>18) arguments"
+        )
+    for piece in _limb_split(col.data):
+        values.append(piece)
+        vvalids.append(col.valid)
+        reds.append("sum")
+
+
 def _agg_slot_count(spec: "AggSpec", arg_type: Optional[T.DataType]) -> int:
     """State (value, count) slot pairs one aggregate occupies."""
     if (
@@ -1045,22 +1065,9 @@ def _agg_ingest(batch: RelBatch, groups: tuple, aggs: tuple, cap: int, pre_fn,
             values.append(live.astype(jnp.int64))
             vvalids.append(None)
         elif getattr(batch.columns[a.arg_channel].data, "ndim", 1) == 2:
-            if a.kind == "count":
-                # count() reads only the validity mask
-                values.append(live.astype(jnp.int64))
-                vvalids.append(batch.columns[a.arg_channel].valid)
-                reds.append("count")
-                continue
-            if a.kind not in ("sum", "avg"):
-                raise NotImplementedError(
-                    f"{a.kind}() over decimal(>18) arguments"
-                )
-            # long-decimal sum/avg: four 32-bit limb slots (_limb_split)
-            col = batch.columns[a.arg_channel]
-            for piece in _limb_split(col.data):
-                values.append(piece)
-                vvalids.append(col.valid)
-                reds.append("sum")
+            _append_long_decimal_slots(
+                a, batch.columns[a.arg_channel], live, values, vvalids, reds
+            )
             continue
         else:
             col = batch.columns[a.arg_channel]
@@ -1360,20 +1367,10 @@ class HashAggregationOperator(Operator):
                 values.append(live.astype(jnp.int64))
                 vvalids.append(None)
             elif getattr(batch.columns[a.arg_channel].data, "ndim", 1) == 2:
-                if a.kind == "count":
-                    values.append(live.astype(jnp.int64))
-                    vvalids.append(batch.columns[a.arg_channel].valid)
-                    reds.append("count")
-                    continue
-                if a.kind not in ("sum", "avg"):
-                    raise NotImplementedError(
-                        f"{a.kind}() over decimal(>18) arguments"
-                    )
-                col = batch.columns[a.arg_channel]
-                for piece in _limb_split(col.data):
-                    values.append(piece)
-                    vvalids.append(col.valid)
-                    reds.append("sum")
+                _append_long_decimal_slots(
+                    a, batch.columns[a.arg_channel], live,
+                    values, vvalids, reds,
+                )
                 continue
             else:
                 col = batch.columns[a.arg_channel]
@@ -1672,20 +1669,10 @@ class HashAggregationOperator(Operator):
                 values.append(live.astype(jnp.int64))
                 vvalids.append(None)
             elif getattr(mega.columns[a.arg_channel].data, "ndim", 1) == 2:
-                if a.kind == "count":
-                    values.append(live.astype(jnp.int64))
-                    vvalids.append(mega.columns[a.arg_channel].valid)
-                    reds.append("count")
-                    continue
-                if a.kind not in ("sum", "avg"):
-                    raise NotImplementedError(
-                        f"{a.kind}() over decimal(>18) arguments"
-                    )
-                col = mega.columns[a.arg_channel]
-                for piece in _limb_split(col.data):
-                    values.append(piece)
-                    vvalids.append(col.valid)
-                    reds.append("sum")
+                _append_long_decimal_slots(
+                    a, mega.columns[a.arg_channel], live,
+                    values, vvalids, reds,
+                )
                 continue
             else:
                 col = mega.columns[a.arg_channel]

@@ -93,6 +93,12 @@ def scale_decimal_value(v, t: T.DataType) -> int:
     sf = T.decimal_scale_factor(t)
     if isinstance(v, int) and not isinstance(v, bool):
         return v * sf
+    import decimal as _d
+
+    if isinstance(v, _d.Decimal):
+        return int(
+            (v * sf).to_integral_value(rounding=_d.ROUND_HALF_UP)
+        )
     x = v * sf
     return int(math.floor(abs(x) + 0.5)) * (1 if x >= 0 else -1)
 
@@ -139,9 +145,10 @@ def _py_soundex(s: str) -> str:
     return ("".join(out) + "000")[:4]
 
 
-def _dict_code_const(probe: "Bound", dictionary):
+def _dict_code_const(probe: "Bound", dictionary, elem_type=None):
     """Constant probe -> comparable device value: dictionary code for
-    string elements (absent value = sentinel that matches nothing).
+    string elements (absent value = sentinel that matches nothing);
+    decimal probes scale into the element's scaled-int physical form.
     Column-valued probes need per-row flat broadcasting the vectorized
     paths do not do yet — fail loudly instead of silently mismatching."""
     if not probe.is_const or probe.const_value is None:
@@ -151,6 +158,12 @@ def _dict_code_const(probe: "Bound", dictionary):
     if dictionary is not None:
         code = dictionary.code(probe.const_value)
         return jnp.int32(code if code is not None and code >= 0 else -2)
+    if elem_type is not None and elem_type.is_decimal:
+        if elem_type.is_long_decimal:
+            raise NotImplementedError(
+                "array/map search over decimal(>18) elements"
+            )
+        return jnp.int64(scale_decimal_value(probe.const_value, elem_type))
     return jnp.asarray(probe.const_value)
 
 
@@ -494,9 +507,11 @@ class ExprBinder:
             from decimal import Decimal, InvalidOperation
 
             def parse(txt):
+                from decimal import ROUND_HALF_UP
+
                 try:
                     v = Decimal(txt) * (10 ** (dst.scale or 0))
-                    return int(v.to_integral_value())
+                    return int(v.to_integral_value(rounding=ROUND_HALF_UP))
                 except (InvalidOperation, ValueError):
                     return None
 
@@ -551,6 +566,16 @@ class ExprBinder:
                 d, v = afn(cols, valids)
                 h, lo = _lift128(d, atype)
                 if sto > sfrom:
+                    # scale-up can wrap mod 2^128: overflowing rows go
+                    # NULL (the Int128 module's overflow contract)
+                    lim_h, lim_l = (
+                        jnp.int64(x) for x in I128.from_python(
+                            (2 ** 127 - 1) // 10 ** (sto - sfrom)
+                        )
+                    )
+                    ah_, al_ = I128.abs_(h, lo)
+                    ok = I128.lt(ah_, al_, lim_h, lim_l)
+                    v = ok if v is None else (v & ok)
                     h, lo = I128.rescale_up(h, lo, sto - sfrom)
                 elif sfrom > sto:
                     h, lo = I128.rescale_down_round(h, lo, sfrom - sto)
@@ -842,7 +867,7 @@ class ExprBinder:
             if name == "map_contains_key":
                 probe = args[1]
                 kflat = c.flat_keys
-                pd = _dict_code_const(probe, kflat.dictionary)
+                pd = _dict_code_const(probe, kflat.dictionary, kflat.type)
                 match = kflat.data == pd
                 if kflat.valid is not None:
                     match = match & kflat.valid
@@ -852,7 +877,7 @@ class ExprBinder:
             n_flat = flat.data.shape[0]
             if name == "array_contains":
                 probe = args[1]
-                pd = _dict_code_const(probe, flat.dictionary)
+                pd = _dict_code_const(probe, flat.dictionary, flat.type)
                 match = flat.data == pd
                 if flat.valid is not None:
                     match = match & flat.valid
@@ -879,7 +904,7 @@ class ExprBinder:
                 return out, valid
             if name == "array_position":
                 probe = args[1]
-                pd = _dict_code_const(probe, flat.dictionary)
+                pd = _dict_code_const(probe, flat.dictionary, flat.type)
                 match = flat.data == pd
                 if flat.valid is not None:
                     match = match & flat.valid
@@ -898,7 +923,7 @@ class ExprBinder:
                 return out, cv
             if name == "array_remove":
                 probe = args[1]
-                pd = _dict_code_const(probe, flat.dictionary)
+                pd = _dict_code_const(probe, flat.dictionary, flat.type)
                 keep = flat.data != pd
                 if flat.valid is not None:
                     keep = keep | ~flat.valid  # NULL elements stay

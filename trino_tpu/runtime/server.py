@@ -76,6 +76,8 @@ class _QueryJob:
         self.columns: List[dict] = []
         self.error: Optional[str] = None
         self.started_transaction_id: Optional[str] = None
+        self.added_prepare = None
+        self.deallocated_prepare = None
         self.cleared_transaction = False
         self.finished_at: Optional[float] = None  # monotonic, for TTL expiry
         self.drained = False  # final result page delivered to the client
@@ -163,7 +165,20 @@ class CoordinatorServer:
                     # carries its transaction id on every request
                     # (StatementClientV1's X-Trino-Transaction-Id)
                     txn = self.headers.get("X-Trino-Transaction-Id", "NONE")
-                    job = outer._submit(sql, identity, txn)
+                    # prepared statements are CLIENT session state,
+                    # carried per request (X-Trino-Prepared-Statement:
+                    # name=urlencoded-sql, repeatable)
+                    import urllib.parse as _up
+
+                    prepared = {}
+                    for hv in self.headers.get_all(
+                        "X-Trino-Prepared-Statement"
+                    ) or []:
+                        for part in hv.split(","):
+                            if "=" in part:
+                                k, v = part.split("=", 1)
+                                prepared[k.strip()] = _up.unquote(v)
+                    job = outer._submit(sql, identity, txn, prepared)
                     self._json(200, outer._response(job, 0))
                     return
                 self._json(404, {"error": "no route"})
@@ -318,7 +333,8 @@ class CoordinatorServer:
             for _, qid in drained[: len(drained) - self.MAX_COMPLETED]:
                 self._jobs.pop(qid, None)
 
-    def _submit(self, sql: str, identity=None, transaction_id="NONE") -> _QueryJob:
+    def _submit(self, sql: str, identity=None, transaction_id="NONE",
+                prepared=None) -> _QueryJob:
         from trino_tpu.runtime.metrics import METRICS
 
         self._evict_completed()
@@ -339,7 +355,8 @@ class CoordinatorServer:
                         return  # expired while queued: don't run or revive
                     job.state = "running"
                 result = self.runner.execute(
-                    sql, identity=identity, transaction_id=transaction_id
+                    sql, identity=identity, transaction_id=transaction_id,
+                    prepared=prepared or None,
                 )
                 with job.lock:
                     if job.abandoned:
@@ -349,6 +366,12 @@ class CoordinatorServer:
                         for n, t in zip(result.column_names, result.column_types)
                     ]
                     job.rows = result.rows
+                    job.added_prepare = getattr(
+                        result, "added_prepare", None
+                    )
+                    job.deallocated_prepare = getattr(
+                        result, "deallocated_prepare", None
+                    )
                     job.started_transaction_id = getattr(
                         result, "started_transaction_id", None
                     )
@@ -395,6 +418,12 @@ class CoordinatorServer:
             out["nextUri"] = f"{self.uri}/v1/statement/executing/{job.query_id}/{token}"
             return out
         out["columns"] = columns
+        if job.added_prepare:
+            out["addedPrepare"] = {
+                "name": job.added_prepare[0], "sql": job.added_prepare[1],
+            }
+        if job.deallocated_prepare:
+            out["deallocatedPrepare"] = job.deallocated_prepare
         if job.started_transaction_id:
             out["startedTransactionId"] = job.started_transaction_id
         if job.cleared_transaction:

@@ -142,6 +142,15 @@ def _number_literal(text: str) -> ir.Literal:
         frac = text.split(".")[1]
         scale = len(frac)
         digits = len(text.replace(".", "").lstrip("0")) or 1
+        if digits > 15:
+            # float would corrupt digits beyond ~2^53; carry the exact
+            # value (scale_decimal_value handles Decimal exactly)
+            import decimal as _d
+
+            return ir.Literal(
+                _d.Decimal(text),
+                T.decimal(min(max(digits, scale + 1), 38), scale),
+            )
         return ir.Literal(float(text), T.decimal(max(digits, scale + 1), scale))
     v = int(text)
     if abs(v) > 2 ** 63 - 1:
@@ -462,6 +471,18 @@ class ExprConverter:
                         return ir.Call(
                             f"{name}_col", (ref,), ref.type.element
                         )
+                    if ref.type.is_array and name in (
+                        "array_sort", "array_distinct", "array_remove",
+                        "array_position", "slice", "trim_array",
+                    ):
+                        rest_ir = tuple(
+                            self.convert(x) for x in e.args[1:]
+                        )
+                        out_t = (
+                            T.BIGINT if name == "array_position"
+                            else ref.type
+                        )
+                        return ir.Call(name, (ref,) + rest_ir, out_t)
                 raise AnalysisError(
                     f"{name}() supports constant arrays"
                     + (" and array/map columns"

@@ -305,6 +305,39 @@ class Query(Node):
 
 # other statements
 @dataclasses.dataclass(frozen=True)
+class Parameter(Expression):
+    """`?` placeholder in a prepared statement (tree/Parameter.java);
+    EXECUTE ... USING substitutes literals positionally before
+    analysis."""
+
+    index: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Prepare(Node):
+    """PREPARE name FROM <statement> (tree/Prepare.java:25)."""
+
+    name: str
+    statement: "Node"
+    sql: str  # original statement text (SHOW/DESCRIBE surfaces)
+
+
+@dataclasses.dataclass(frozen=True)
+class ExecuteStmt(Node):
+    """EXECUTE name [USING expr, ...] (tree/Execute.java)."""
+
+    name: str
+    parameters: Tuple[Expression, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class Deallocate(Node):
+    """DEALLOCATE PREPARE name (tree/Deallocate.java)."""
+
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
 class ExplainStatement(Node):
     query: Query
     analyze: bool = False
@@ -495,3 +528,33 @@ class ShowColumns(Node):
 @dataclasses.dataclass(frozen=True)
 class ShowFunctions(Node):
     pass
+
+
+def substitute_parameters(node, values):
+    """Positionally replace Parameter placeholders with literal
+    expressions (EXECUTE ... USING binding — the analyzer rejects any
+    Parameter that survives)."""
+    import dataclasses as _dc
+
+    def sub(x):
+        if isinstance(x, Parameter):
+            if x.index >= len(values):
+                raise ValueError(
+                    f"prepared statement needs {x.index + 1} parameters, "
+                    f"got {len(values)}"
+                )
+            return values[x.index]
+        if _dc.is_dataclass(x) and isinstance(x, Node):
+            changes = {}
+            for f in _dc.fields(x):
+                v = getattr(x, f.name)
+                nv = sub(v)
+                if nv is not v:
+                    changes[f.name] = nv
+            return _dc.replace(x, **changes) if changes else x
+        if isinstance(x, tuple):
+            out = tuple(sub(e) for e in x)
+            return out if any(a is not b for a, b in zip(out, x)) else x
+        return x
+
+    return sub(node)

@@ -60,6 +60,8 @@ _OP_TEXT = {
 
 
 def format_expression(e: ast.Expression) -> str:
+    if isinstance(e, ast.Parameter):
+        return "?"
     return _expr(e, 0)
 
 
@@ -68,6 +70,8 @@ def _maybe_paren(text: str, prec: int, limit: int) -> str:
 
 
 def _expr(e, limit: int = 0) -> str:
+    if isinstance(e, ast.Parameter):
+        return "?"
     if isinstance(e, ast.Identifier):
         return _name(e.parts)
     if isinstance(e, ast.NumberLiteral):

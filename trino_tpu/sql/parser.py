@@ -191,6 +191,26 @@ class Parser:
             self.next()
             self.accept_kw("WORK")
             stmt = ast.Rollback()
+        elif self.at_kw("PREPARE"):
+            # PREPARE name FROM <statement> (tree/Prepare.java:25)
+            self.next()
+            pname = self._parse_name()
+            self.expect_kw("FROM")
+            inner = self.parse_statement()
+            stmt = ast.Prepare(pname, inner, "")
+        elif self.at_kw("EXECUTE"):
+            self.next()
+            pname = self._parse_name()
+            params: List[ast.Expression] = []
+            if self.accept_kw("USING"):
+                params.append(self.parse_expr())
+                while self.accept_op(","):
+                    params.append(self.parse_expr())
+            stmt = ast.ExecuteStmt(pname, tuple(params))
+        elif self.at_kw("DEALLOCATE"):
+            self.next()
+            self.expect_kw("PREPARE")
+            stmt = ast.Deallocate(self._parse_name())
         elif self.at_kw("EXPLAIN"):
             self.next()
             analyze = self.accept_kw("ANALYZE")
@@ -925,6 +945,12 @@ class Parser:
 
     def _parse_primary(self) -> ast.Expression:
         t = self.peek()
+        if t.kind == "op" and t.text == "?":
+            # prepared-statement parameter placeholder (tree/Parameter)
+            self.next()
+            idx = getattr(self, "_param_count", 0)
+            self._param_count = idx + 1
+            return ast.Parameter(idx)
         if t.kind == "number":
             self.next()
             return ast.NumberLiteral(t.text)
