@@ -51,6 +51,11 @@ class _StoredTable:
     # device-resident batch cache: the Page/Block layer as persistent SoA
     # device arrays (SURVEY.md §2.5 "the layer that becomes TPU-resident")
     device_cache: Dict[tuple, list] = dataclasses.field(default_factory=dict)
+    # declared bucketing: ordered key column names; splits are then 1:1
+    # with engine-hash buckets (spi.ConnectorMetadata.table_partitioning)
+    bucketed_by: Optional[Tuple[str, ...]] = None
+    # (version, n_buckets) -> int32 bucket id per row
+    bucket_cache: Dict[tuple, np.ndarray] = dataclasses.field(default_factory=dict)
 
 
 class _Store:
@@ -87,6 +92,10 @@ class MemoryMetadata(ConnectorMetadata):
         t = self.store.tables[(handle.schema, handle.table)]
         sc = t.data.get(column)
         return sc.dictionary if sc is not None else None
+
+    def table_partitioning(self, handle: TableHandle):
+        t = self.store.tables[(handle.schema, handle.table)]
+        return t.bucketed_by
 
     def get_table_statistics(self, handle: TableHandle) -> TableStatistics:
         """Row count + sampled per-column (ndv, null_fraction, min, max).
@@ -187,6 +196,18 @@ class MemorySplitManager(ConnectorSplitManager):
     def get_splits(self, handle: TableHandle, target_split_count: int) -> List[Split]:
         t = self.store.tables[(handle.schema, handle.table)]
         n = t.row_count
+        if t.bucketed_by and target_split_count > 1:
+            # bucketed table: EXACTLY the requested count, split i = the
+            # rows whose engine key-hash lands in partition i of k. The
+            # scheduler's task p <- splits[p::tc] rule then puts bucket i
+            # on task i, which is what the planner's cancelled exchange
+            # assumed (spi.ConnectorMetadata.table_partitioning). A
+            # single-task request skips the hash: one full row-range
+            # split IS the 1-bucket partitioning
+            return [
+                Split(handle, i, None, ("bucket", i, target_split_count))
+                for i in range(target_split_count)
+            ]
         k = max(1, min(target_split_count, max(n, 1)))
         per = -(-max(n, 1) // k)
         return [
@@ -201,26 +222,78 @@ class MemoryPageSource(ConnectorPageSource):
 
     def batches(self, split: Split, columns: Sequence[str], batch_rows: int) -> Iterator[RelBatch]:
         t = self.store.tables[(split.table.schema, split.table.table)]
-        lo, hi = split.row_range
-        cache_key = (t.version, tuple(columns), batch_rows, lo, hi)
+        if split.payload is not None and split.payload[0] == "bucket":
+            _, bi, nb = split.payload
+            idx = np.nonzero(self._bucket_ids(t, nb) == bi)[0]
+            lo = hi = None
+            cache_key = (t.version, tuple(columns), batch_rows, "bucket", bi, nb)
+        else:
+            lo, hi = split.row_range
+            idx = None
+            cache_key = (t.version, tuple(columns), batch_rows, lo, hi)
         cached = t.device_cache.get(cache_key)
         if cached is not None:
             yield from cached
             return
         out = []
-        for batch in self._materialize(t, columns, batch_rows, lo, hi):
+        for batch in self._materialize(t, columns, batch_rows, lo, hi, idx):
             out.append(batch)
             yield batch
         for k in [k for k in t.device_cache if k[0] != t.version]:
-            del t.device_cache[k]  # drop stale versions only
+            # pop, not del: parallel tasks snapshot the same stale keys
+            t.device_cache.pop(k, None)
         t.device_cache[cache_key] = out
 
-    def _materialize(self, t, columns: Sequence[str], batch_rows: int, lo, hi) -> Iterator[RelBatch]:
+    def _bucket_ids(self, t, nb: int) -> np.ndarray:
+        """Row -> bucket id with the engine's own exchange hash (the
+        lock-step host replica, ops/hashing.hash32_np), so a split of a
+        bucketed table holds exactly the rows a runtime repartition on
+        the same keys would have routed to that partition. Cached per
+        (table version, bucket count)."""
+        key = (t.version, nb)
+        got = t.bucket_cache.get(key)
+        if got is not None:
+            return got
+        from trino_tpu.ops.hashing import (
+            dictionary_lut, hash32_np, partition_of_np,
+        )
+
+        n = t.row_count
+        lanes, valids = [], []
+        for name in t.bucketed_by:
+            sc = t.data[name]
+            lut = dictionary_lut(sc.dictionary)
+            if lut is not None:
+                codes = np.clip(np.asarray(sc.data[:n]), 0, len(lut) - 1)
+                lanes.append(lut[codes.astype(np.int64)])
+            else:
+                lanes.append(np.asarray(sc.data[:n]).astype(np.int64))
+            valids.append(None if sc.valid is None else sc.valid[:n])
+        bids = partition_of_np(hash32_np(lanes, valids), nb)
+        for k in [k for k in t.bucket_cache if k[0] != t.version]:
+            # pop, not del: parallel tasks snapshot the same stale keys
+            t.bucket_cache.pop(k, None)
+        t.bucket_cache[key] = bids
+        return bids
+
+    def _materialize(self, t, columns: Sequence[str], batch_rows: int,
+                     lo, hi, idx: Optional[np.ndarray] = None) -> Iterator[RelBatch]:
+        """Chunk either a contiguous [lo, hi) row range (plain splits —
+        ndarray slicing, one memcpy per column) or an explicit row-index
+        array (bucket splits — gathered copy)."""
         from trino_tpu.block import ArrayColumn
 
-        for a in range(lo, hi, batch_rows):
-            b = min(a + batch_rows, hi)
-            n = b - a
+        if idx is None:
+            total = hi - lo
+            sels = (slice(a, min(a + batch_rows, hi))
+                    for a in range(lo, hi, batch_rows))
+        else:
+            total = len(idx)
+            sels = (idx[a: a + batch_rows]
+                    for a in range(0, total, batch_rows))
+        for sel in sels:
+            ranged = isinstance(sel, slice)
+            n = (sel.stop - sel.start) if ranged else len(sel)
             cap = bucket_capacity(n)
             cols = []
             for name in columns:
@@ -228,23 +301,27 @@ class MemoryPageSource(ConnectorPageSource):
                 if sc.type.kind == T.TypeKind.ARRAY:
                     # array columns store python lists host-side; the
                     # batch view flattens the slice (ArrayBlock layout)
+                    rows = (list(sc.data[sel]) if ranged
+                            else [sc.data[j] for j in sel])
                     cols.append(ArrayColumn.from_pylists(
-                        sc.type.element, list(sc.data[a:b]) + [None] * (cap - n),
+                        sc.type.element, rows + [None] * (cap - n),
                         capacity=cap, dictionary=sc.dictionary,
                     ))
                     continue
                 if sc.type.is_nested:  # MAP / ROW
+                    rows = (list(sc.data[sel]) if ranged
+                            else [sc.data[j] for j in sel])
                     cols.append(Column.from_pylist(
-                        sc.type, list(sc.data[a:b]), capacity=cap,
+                        sc.type, rows, capacity=cap,
                     ))
                     continue
                 shape = (cap, 2) if sc.type.lanes == 2 else (cap,)
                 arr = np.zeros(shape, dtype=sc.type.dtype)
-                arr[:n] = sc.data[a:b]
+                arr[:n] = sc.data[sel]
                 valid = None
                 if sc.valid is not None:
                     v = np.zeros(cap, dtype=bool)
-                    v[:n] = sc.valid[a:b]
+                    v[:n] = sc.valid[sel]
                     valid = jnp.asarray(v)
                 cols.append(Column(sc.type, jnp.asarray(arr), valid, sc.dictionary))
             live = None
@@ -253,7 +330,7 @@ class MemoryPageSource(ConnectorPageSource):
                 lv[:n] = True
                 live = jnp.asarray(lv)
             yield RelBatch(cols, live)
-        if hi == lo:  # empty table: one empty batch so schemas propagate
+        if total == 0:  # empty split: one empty batch so schemas propagate
             cols = []
             for name in columns:
                 sc = t.data[name]
@@ -440,10 +517,36 @@ class MemoryConnector(Connector):
         arrays: Sequence[np.ndarray],
         valids: Sequence[Optional[np.ndarray]] = None,
         dictionaries: Sequence[Optional[Dictionary]] = None,
+        bucketed_by: Optional[Sequence[str]] = None,
     ) -> None:
-        """Bulk-load dense host columns (benchmark/fixture path)."""
+        """Bulk-load dense host columns (benchmark/fixture path).
+        `bucketed_by` declares engine-hash bucketing on the named key
+        columns (integer-family or dictionary-string types): splits then
+        become hash buckets and co-bucketed joins/aggregations plan
+        exchange-free (spi.ConnectorMetadata.table_partitioning)."""
         handle = self.metadata.create_table(schema, table, columns)
         t = self.store.tables[(schema, table)]
+        if bucketed_by:
+            by_name = {cm.name: cm for cm in columns}
+            for c in bucketed_by:
+                cm = by_name.get(c)
+                if cm is None:
+                    raise ValueError(f"bucketed_by column {c!r} not in table")
+                ok = cm.type.is_string or (
+                    not cm.type.is_nested
+                    and cm.type.kind != T.TypeKind.ARRAY
+                    and cm.type.lanes == 1
+                    and np.issubdtype(np.dtype(cm.type.dtype), np.integer)
+                )
+                if not ok:
+                    # float keys need the 3-lane f64 decomposition and
+                    # long decimals the 4-lane limb split; neither has a
+                    # host-side replica yet
+                    raise ValueError(
+                        f"bucketed_by column {c!r}: only integer-family "
+                        f"and string types can declare bucketing"
+                    )
+            t.bucketed_by = tuple(bucketed_by)
         n = len(arrays[0]) if arrays else 0
         for i, (cm, arr) in enumerate(zip(columns, arrays)):
             if cm.type.kind == T.TypeKind.ARRAY:

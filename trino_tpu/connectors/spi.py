@@ -100,6 +100,20 @@ class ConnectorMetadata:
     def get_table_statistics(self, handle: TableHandle) -> TableStatistics:
         return TableStatistics()
 
+    def table_partitioning(self, handle: TableHandle) -> Optional[Tuple[str, ...]]:
+        """Declared bucketing of a table: the ordered key columns whose
+        engine-hash buckets the connector's splits are 1:1 with (split i
+        holds exactly the rows where partition_of(hash32(keys), n)==i),
+        or None when splits are arbitrary row ranges. The planner uses
+        this to cancel repartition exchanges over co-bucketed tables —
+        the ConnectorTablePartitioning / NodePartitioningManager.java:96
+        seat (TpchNodePartitioningProvider.java:46 declares the same for
+        the reference's tpch connector). A connector must only declare
+        this if its split manager honors ANY requested split count with
+        engine-hash buckets (ops/hashing.hash32_np is the lock-step
+        host-side bucket function)."""
+        return None
+
     # -- writes (optional capability) --
     def create_table(self, schema: str, table: str, columns: Sequence[ColumnMetadata]) -> TableHandle:
         raise NotImplementedError(f"{type(self).__name__} does not support CREATE TABLE")

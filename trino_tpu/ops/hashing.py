@@ -123,6 +123,63 @@ def dictionary_lut(dictionary) -> "Optional[np.ndarray]":
     return dictionary_code_hashes(dictionary.values)
 
 
+def _fmix32_np(x):
+    """Host-side replica of `_fmix32` (numpy, bit-for-bit)."""
+    import numpy as np
+
+    x = x.astype(np.uint32)
+    x = x ^ (x >> 16)
+    x = (x * np.uint32(0x85EBCA6B)).astype(np.uint32)
+    x = x ^ (x >> 13)
+    x = (x * np.uint32(0xC2B2AE35)).astype(np.uint32)
+    x = x ^ (x >> 16)
+    return x
+
+
+def hash32_np(columns, valids=None, seed: int = 0):
+    """Host-side replica of `hash32` for connector bucketing (the
+    ConnectorBucketNodeMap / TpchNodePartitioningProvider.java:70 bucket
+    function seat): a connector that pre-buckets rows with this routes
+    them EXACTLY like the runtime exchanges route them, so a declared
+    table partitioning can cancel a repartition exchange. Accepts the
+    canonical lane dtypes only — int64 (integer-family keys) or uint32
+    (dictionary value hashes from `dictionary_code_hashes`). MUST stay
+    in bit-for-bit lock-step with `hash32`/`_to_lanes`
+    (tests/test_bucketed.py asserts parity)."""
+    import numpy as np
+
+    def lanes_of(col):
+        if col.dtype == np.uint32:
+            return (col,)
+        bits = col.astype(np.int64).view(np.uint64)
+        lo = (bits & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        hi = (bits >> np.uint64(32)).astype(np.uint32)
+        return lo, hi
+
+    h = np.full(len(columns[0]), np.uint32((0x9E3779B9 + seed) & 0xFFFFFFFF), dtype=np.uint32)
+    for i, col in enumerate(columns):
+        for lane in lanes_of(np.asarray(col)):
+            v = lane
+            if valids is not None and valids[i] is not None:
+                v = np.where(valids[i], v, np.uint32(0xA5A5A5A5))
+            h = h ^ (
+                (_fmix32_np((v + np.uint32(i + 1)).astype(np.uint32))
+                 + np.uint32(0x9E3779B9)
+                 + (h << 6).astype(np.uint32)
+                 + (h >> 2)).astype(np.uint32)
+            )
+    return _fmix32_np(h)
+
+
+def partition_of_np(h, num_partitions: int):
+    """Host-side replica of `partition_of`."""
+    import numpy as np
+
+    if num_partitions & (num_partitions - 1) == 0:
+        return (h & np.uint32(num_partitions - 1)).astype(np.int32)
+    return (h % np.uint32(num_partitions)).astype(np.int32)
+
+
 def canonical_hash_input(data: jnp.ndarray, code_hashes=None) -> jnp.ndarray:
     """Normalize a key column for cross-fragment hash partitioning:
     integer-like -> int64, floating -> float64, dictionary codes -> the

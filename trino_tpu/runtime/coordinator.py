@@ -236,7 +236,11 @@ class DistributedQueryRunner:
         if isinstance(stmt, ast.ExplainStatement):
             output = self._analyze(stmt.query)
             self._check_access(output, identity)
-            subplan = plan_distributed(output, self.catalogs)
+            subplan = plan_distributed(
+                output, self.catalogs,
+                broadcast_threshold=self.session.broadcast_join_threshold,
+                target_splits=self.session.target_splits,
+            )
             if stmt.analyze:
                 return self._explain_analyze(subplan)
             return MaterializedResult(
@@ -260,6 +264,7 @@ class DistributedQueryRunner:
             output,
             self.catalogs,
             broadcast_threshold=self.session.broadcast_join_threshold,
+            target_splits=self.session.target_splits,
         )
         result_meta = (list(output.names), [f.type for f in output.fields])
         if self.session.retry_policy == "task":

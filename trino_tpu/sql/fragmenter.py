@@ -82,9 +82,14 @@ class SubPlan:
 
 
 class _AddExchanges:
-    def __init__(self, estimate_rows, broadcast_threshold: int):
+    def __init__(self, estimate_rows, broadcast_threshold: int,
+                 scan_partitioning=None):
         self._estimate = estimate_rows
         self._broadcast_threshold = broadcast_threshold
+        # ScanNode -> Optional[hash_dist(...)] from declared connector
+        # bucketing (AddExchanges' use of actual table partitioning via
+        # NodePartitioningManager.java:96)
+        self._scan_partitioning = scan_partitioning
 
     def visit(self, node: P.PlanNode):
         m = getattr(self, f"_{type(node).__name__}", None)
@@ -94,6 +99,13 @@ class _AddExchanges:
 
     # leaves
     def _ScanNode(self, node):
+        if self._scan_partitioning is not None:
+            dist = self._scan_partitioning(node)
+            if dist is not None:
+                # the connector's splits ARE hash buckets on these
+                # channels: downstream joins/aggs on the same keys skip
+                # their repartition exchange (co-bucketed execution)
+                return node, dist
         return node, SOURCE
 
     def _ValuesNode(self, node):
@@ -405,6 +417,38 @@ def _replace_children(node: P.PlanNode, kids: List[P.PlanNode]) -> P.PlanNode:
     return dataclasses.replace(node, child=kids[0])
 
 
+def _make_scan_partitioning(catalogs, target_splits: int):
+    """ScanNode -> Optional[hash_dist] from the connector's declared
+    bucketing (spi.ConnectorMetadata.table_partitioning). The derived
+    property relies on both schedulers' split assignment rule — task p
+    of tc scans splits[p::tc] of get_splits(max(target_splits, tc)) — so
+    bucket i lands on task i only when the connector returns EXACTLY tc
+    splits; with a session target_splits > 1 the request can exceed tc
+    and fold several buckets onto one task, where a runtime-repartitioned
+    third side would no longer align. Bucketing is therefore only
+    claimed at the default split target."""
+    if target_splits > 1:
+        return None
+
+    def resolve(node):
+        try:
+            conn = catalogs.get(node.catalog)
+            cols = conn.metadata.table_partitioning(node.handle)
+        except Exception:
+            return None
+        if not cols:
+            return None
+        try:
+            chans = tuple(node.columns.index(c) for c in cols)
+        except ValueError:
+            # a pruned-away bucket column: splits are still buckets, but
+            # the property is unverifiable downstream — stay SOURCE
+            return None
+        return hash_dist(chans)
+
+    return resolve
+
+
 def _fragment_partitioning(root: P.PlanNode) -> str:
     """Task layout of a fragment, derived from its leaves: connector
     splits ("source"), hash-partitioned remote input ("hash"), else a
@@ -448,11 +492,15 @@ def plan_distributed(
     root: P.OutputNode,
     catalogs,
     broadcast_threshold: int = 1_000_000,
+    target_splits: int = 1,
 ) -> SubPlan:
     """Logical plan -> SubPlan tree of PlanFragments (the
     LogicalPlanner->AddExchanges->PlanFragmenter.createSubPlans path)."""
     estimate = make_row_estimator(catalogs)
-    adder = _AddExchanges(estimate, broadcast_threshold)
+    adder = _AddExchanges(
+        estimate, broadcast_threshold,
+        scan_partitioning=_make_scan_partitioning(catalogs, target_splits),
+    )
     annotated, _ = adder.visit(root)
     subplan = _Fragmenter().cut(annotated)
     # refine "hash" vs "single" partitioning now that producers are known,
