@@ -111,16 +111,30 @@ def test_mesh_program_contains_collective():
     assert "all_to_all" in str(jaxpr)
 
 
-def test_mesh_fallback_on_unsupported(runner):
-    """Window functions are not mesh-compiled yet; the coordinator must
-    fall back to the page-exchange path and still answer correctly."""
+def test_mesh_window_runs_on_mesh(runner):
+    """r4: partitioned window functions mesh-compile (partition-local
+    after the all_to_all repartition — mesh_plan._visit_WindowNode)."""
     before = mesh_plan.MESH_COUNTERS["queries"]
     res = runner.execute(
         "select o_custkey, row_number() over "
         "(partition by o_custkey order by o_orderkey) rn "
         "from orders where o_custkey < 10"
     )
-    assert mesh_plan.MESH_COUNTERS["queries"] == before
+    assert mesh_plan.MESH_COUNTERS["queries"] == before + 1
+    assert len(res.rows) > 0
+
+
+def test_mesh_fallback_on_unsupported(runner):
+    """r4 closed the plan-shape gaps (windows, offsets, distinct via
+    single-step gather), so the remaining deterministic MeshUnsupported
+    is a plan with no distributed fragment at all. The coordinator must
+    fall back to the page-exchange path, still answer correctly, and
+    record WHY (observable fallback)."""
+    before = dict(mesh_plan.MESH_COUNTERS)
+    res = runner.execute("select 1")
+    assert mesh_plan.MESH_COUNTERS["queries"] == before["queries"]
+    assert mesh_plan.MESH_COUNTERS["fallbacks"] == before["fallbacks"] + 1
+    assert runner.last_mesh_fallback is not None
     assert len(res.rows) > 0
 
 

@@ -381,9 +381,13 @@ class LocalQueryRunner:
                     if m.max_arity == m.min_arity
                     else f"{m.min_arity}..{m.max_arity or 'N'}"
                 )
-                rows.append(
-                    [m.name, m.returns, arity, m.category, m.description]
-                )
+                # one row per callable name — aliases are rows, as in the
+                # reference's SHOW FUNCTIONS (ceiling, pow, dow, ...)
+                for nm in (m.name, *m.aliases):
+                    rows.append(
+                        [nm, m.returns, arity, m.category, m.description]
+                    )
+            rows.sort(key=lambda r: (r[3], r[0]))
             return MaterializedResult(
                 rows,
                 ["Function", "Return Type", "Arity", "Function Type",

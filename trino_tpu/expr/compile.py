@@ -124,6 +124,14 @@ def _format_cast_text(v, src_type: T.DataType):
     return str(v)
 
 
+def _try_decode(fn):
+    """bytes-producing thunk -> utf-8 varchar carrier, NULL on error."""
+    try:
+        return fn().decode("utf-8", "replace")
+    except Exception:
+        return None
+
+
 def _py_soundex(s: str) -> str:
     """American Soundex (StringFunctions.soundex)."""
     codes = {
@@ -178,6 +186,28 @@ def minmax_like(dtype, is_min: bool):
 
 # Probability/statistics scalar family (MathFunctions *_cdf /
 # WilsonInterval): plain float64 formulas over jax.scipy.special.
+def _betaincinv(jsp):
+    """Beta quantile via fixed 64-step bisection on betainc (this jax
+    has no betaincinv; bisection is branch-free and jit-stable — the
+    reference inverts with Apache commons' ContinuedFraction)."""
+    if hasattr(jsp, "betaincinv"):
+        return lambda a, b, p: jsp.betaincinv(a, b, p)
+
+    def inv(a, b, p):
+        lo = jnp.zeros_like(p)
+        hi = jnp.ones_like(p)
+        for _ in range(64):
+            mid = 0.5 * (lo + hi)
+            below = jsp.betainc(a, b, mid) < p
+            lo = jnp.where(below, mid, lo)
+            hi = jnp.where(below, hi, mid)
+        x = 0.5 * (lo + hi)
+        bad = (p < 0) | (p > 1) | (a <= 0) | (b <= 0)
+        return jnp.where(bad, jnp.nan, x)
+
+    return inv
+
+
 def _make_prob_fns():
     import jax.scipy.special as jsp
 
@@ -210,8 +240,7 @@ def _make_prob_fns():
         "logistic_cdf": (3, lambda a, b, x: 1.0 / (1.0 + jnp.exp(-(x - a) / b))),
         "weibull_cdf": (3, lambda a, b, x: jnp.where(
             x <= 0, 0.0, 1.0 - jnp.exp(-((x / b) ** a)))),
-        "inverse_beta_cdf": (3, lambda a, b, p: jsp.betaincinv(a, b, p))
-        if hasattr(jsp, "betaincinv") else None,
+        "inverse_beta_cdf": (3, _betaincinv(jsp)),
         "wilson_interval_lower": (3, lambda s, n, z: wilson(s, n, z, -1.0)),
         "wilson_interval_upper": (3, lambda s, n, z: wilson(s, n, z, 1.0)),
     }
@@ -503,6 +532,23 @@ class ExprBinder:
                     vv = in_range if v is None else (v & in_range)
                     return out, vv
                 return Bound(dst, sfn, d)
+        if src.is_string and dst.kind == T.TypeKind.DATE:
+            import datetime as _dt
+
+            def d_of(s):
+                try:
+                    return (_dt.date.fromisoformat(s.strip())
+                            - _dt.date(1970, 1, 1)).days
+                except ValueError:
+                    return None  # the reference raises; NULL divergence
+
+            return self._bind_dict_table_nullable(a, dst, d_of, dst.dtype)
+        if src.is_string and dst.kind == T.TypeKind.TIMESTAMP:
+            from trino_tpu.expr.pyfns import iso_to_micros
+
+            return self._bind_dict_table_nullable(
+                a, dst, iso_to_micros, jnp.int64
+            )
         if src.is_string and dst.is_decimal:
             from decimal import Decimal, InvalidOperation
 
@@ -1350,6 +1396,48 @@ class ExprBinder:
                 return jax.lax.population_count(x).astype(jnp.int64), v
 
             return Bound(T.BIGINT, bcfn)
+        if name == "rand":
+            # pseudorandom per bind: a fresh PRNG key is drawn host-side
+            # when the expression binds (per query/batch-shape), rows get
+            # independent draws from it. The reference's rand() is
+            # likewise non-deterministic per evaluation (MathFunctions).
+            import os as _os
+
+            seed = int.from_bytes(_os.urandom(4), "little")
+            bounds = [a for a in args]
+
+            def rndfn(cols, valids, seed=seed, bounds=bounds):
+                ref = cols[0] if cols else jnp.zeros(1)
+                if hasattr(ref, "data") and not hasattr(ref, "shape"):
+                    ref = ref.data
+                n = ref.shape[0]
+                # fold the batch CONTENT into the key: a bind-time seed
+                # alone would replay the identical "random" vector for
+                # every batch of a multi-batch scan (biased sampling).
+                # astype truncation, not bitcast — f64 bitcasts don't
+                # compile on this TPU backend
+                x = ref.reshape(-1)[:1024]
+                if jnp.issubdtype(x.dtype, jnp.floating):
+                    x = jnp.rint(x * 4096.0)
+                entropy = jnp.sum(x.astype(jnp.int64)).astype(jnp.uint32)
+                key = jax.random.fold_in(jax.random.PRNGKey(seed), entropy)
+                u = jax.random.uniform(key, (n,), dtype=jnp.float64)
+                if not bounds:
+                    return u, None
+                vs = [b.fn(cols, valids) for b in bounds]
+                v = merge_valid(*[x[1] for x in vs])
+                if len(vs) == 1:
+                    hi = vs[0][0].astype(jnp.float64)
+                    return jnp.floor(u * hi).astype(jnp.int64), v
+                lo = vs[0][0].astype(jnp.float64)
+                hi = vs[1][0].astype(jnp.float64)
+                return (
+                    (lo + jnp.floor(u * (hi - lo))).astype(jnp.int64),
+                    v,
+                )
+
+            out_t = T.DOUBLE if not args else T.BIGINT
+            return Bound(out_t, rndfn)
         if name in ("e", "pi", "nan", "infinity"):
             val = {"e": math.e, "pi": math.pi, "nan": float("nan"),
                    "infinity": float("inf")}[name]
@@ -1466,6 +1554,29 @@ class ExprBinder:
                 return out.astype(jnp.int64), v
 
             return Bound(T.BIGINT, tmfn)
+        if name == "from_unixtime_nanos":
+            a = args[0]
+
+            def funfn(cols, valids, a=a):
+                d, v = a.fn(cols, valids)
+                # floor division, not truncation: -1ns is microsecond -1
+                # (the reference truncates toward negative infinity)
+                return (
+                    jnp.floor_divide(d.astype(jnp.int64), jnp.int64(1000)),
+                    v,
+                )
+
+            return Bound(T.TIMESTAMP, funfn)
+        if name in ("timezone_hour", "timezone_minute"):
+            # engine timestamps are UTC instants (no with-time-zone
+            # physical type yet): the session offset is 0
+            a = args[0]
+
+            def tzfn(cols, valids, a=a):
+                d, v = a.fn(cols, valids)
+                return jnp.zeros(d.shape[:1], dtype=jnp.int64), v
+
+            return Bound(T.BIGINT, tzfn)
         if name == "from_unixtime":
             a = args[0]
             sf_a = T.decimal_scale_factor(a.type) if a.type.is_decimal else 1
@@ -1960,10 +2071,193 @@ class ExprBinder:
         import hashlib as _hashlib
         import zlib as _zlib
 
-        if name in ("md5", "sha1", "sha256"):
+        if name in ("md5", "sha1", "sha256", "sha512"):
             return self._bind_dict_transform(
                 args[0], e,
                 lambda s, algo=name: _hashlib.new(algo, s.encode()).hexdigest(),
+            )
+        if name in ("hmac_md5", "hmac_sha1", "hmac_sha256", "hmac_sha512"):
+            import hmac as _hmac
+
+            key = e.args[1]
+            assert isinstance(key, Literal), f"{name}() key must be constant"
+            if key.value is None:
+                return self._null_of(args[0], e.type)
+            algo = name[5:]
+            return self._bind_dict_transform(
+                args[0], e,
+                lambda s, k=key.value, a=algo: _hmac.new(
+                    k.encode(), s.encode(), a
+                ).hexdigest(),
+            )
+        if name == "xxhash64":
+            from trino_tpu.expr.pyfns import xxhash64 as _xx
+
+            return self._bind_dict_transform(
+                args[0], e, lambda s: format(_xx(s.encode()), "016x")
+            )
+        if name == "murmur3":
+            from trino_tpu.expr.pyfns import murmur3_x64_128 as _mm
+
+            return self._bind_dict_transform(
+                args[0], e, lambda s: _mm(s.encode()).hex()
+            )
+        if name == "to_base32":
+            return self._bind_dict_transform(
+                args[0], e, lambda s: _b64.b32encode(s.encode()).decode()
+            )
+        if name == "from_base32":
+            return self._bind_dict_transform_nullable(
+                args[0], e, lambda s: _try_decode(
+                    lambda: _b64.b32decode(s.encode())
+                )
+            )
+        if name == "to_base64url":
+            return self._bind_dict_transform(
+                args[0], e,
+                lambda s: _b64.urlsafe_b64encode(s.encode()).decode(),
+            )
+        if name == "from_base64url":
+            return self._bind_dict_transform_nullable(
+                args[0], e, lambda s: _try_decode(
+                    lambda: _b64.urlsafe_b64decode(s.encode())
+                )
+            )
+        if name in ("from_big_endian_32", "from_big_endian_64"):
+            want = 4 if name.endswith("32") else 8
+
+            def befn(s, want=want):
+                b = s.encode()
+                if len(b) != want:
+                    return None  # the reference raises; NULL divergence
+                v = int.from_bytes(b, "big", signed=True)
+                return v
+
+            return self._bind_dict_table_nullable(
+                args[0], T.BIGINT, befn, jnp.int64
+            )
+        if name in ("from_ieee754_32", "from_ieee754_64"):
+            import struct as _struct
+
+            want, code = (4, ">f") if name.endswith("32") else (8, ">d")
+
+            def ieeefn(s, want=want, code=code):
+                b = s.encode()
+                if len(b) != want:
+                    return None
+                return _struct.unpack(code, b)[0]
+
+            return self._bind_dict_table_nullable(
+                args[0], T.DOUBLE, ieeefn, jnp.float64
+            )
+        if name == "luhn_check":
+            def luhn(s):
+                if not s or not s.isdigit():
+                    return False
+                total = 0
+                for i, ch in enumerate(reversed(s)):
+                    d = ord(ch) - 48
+                    if i % 2 == 1:
+                        d *= 2
+                        if d > 9:
+                            d -= 9
+                    total += d
+                return total % 10 == 0
+
+            return self._bind_dict_table(
+                args[0], T.BOOLEAN, luhn, jnp.bool_
+            )
+        if name in ("strrpos", "index"):
+            sub = e.args[1]
+            assert isinstance(sub, Literal), (
+                f"{name}() substring must be constant"
+            )
+            if sub.value is None:
+                return self._null_of(args[0], T.BIGINT)
+            finder = (
+                (lambda s, t=sub.value: s.rfind(t) + 1)
+                if name == "strrpos"
+                else (lambda s, t=sub.value: s.find(t) + 1)
+            )
+            return self._bind_dict_table(
+                args[0], T.BIGINT, finder, jnp.int64
+            )
+        if name in ("to_utf8", "from_utf8"):
+            # the engine's varbinary carrier IS utf-8-decoded varchar, so
+            # both directions normalize through encode/decode (invalid
+            # sequences cannot occur on the carrier; from_utf8's
+            # replacement contract is preserved by construction)
+            return self._bind_dict_transform(
+                args[0], e,
+                lambda s: s.encode("utf-8").decode("utf-8", "replace"),
+            )
+        if name == "word_stem":
+            from trino_tpu.expr.pyfns import porter_stem
+
+            return self._bind_dict_transform(args[0], e, porter_stem)
+        if name == "char2hexint":
+            return self._bind_dict_transform(
+                args[0], e,
+                lambda s: s.encode("utf-16-be").hex().upper(),
+            )
+        if name == "from_base":
+            radix = e.args[1]
+            assert isinstance(radix, Literal), "from_base() radix must be constant"
+            if radix.value is None:
+                return self._null_of(args[0], T.BIGINT)
+            r = int(radix.value)
+            if not 2 <= r <= 36:
+                raise ValueError("from_base() radix must be in [2, 36]")
+
+            def fb(s, r=r):
+                try:
+                    return int(s, r)
+                except ValueError:
+                    return None  # the reference raises; NULL divergence
+
+            return self._bind_dict_table_nullable(
+                args[0], T.BIGINT, fb, jnp.int64
+            )
+        if name in ("from_iso8601_timestamp", "from_iso8601_timestamp_nanos"):
+            from trino_tpu.expr.pyfns import iso_to_micros
+
+            trim = name.endswith("nanos")
+            return self._bind_dict_table_nullable(
+                args[0], T.TIMESTAMP,
+                lambda s, trim=trim: iso_to_micros(s, trim_nanos=trim),
+                jnp.int64,
+            )
+        if name in ("parse_datetime", "to_timestamp", "to_date"):
+            import datetime as _dt
+
+            from trino_tpu.expr.pyfns import (
+                dt_to_micros, joda_to_strptime, oracle_to_strptime,
+            )
+
+            fmt = e.args[1]
+            assert isinstance(fmt, Literal), f"{name}() format must be constant"
+            if fmt.value is None:
+                return self._null_of(
+                    args[0], T.DATE if name == "to_date" else T.TIMESTAMP
+                )
+            py = (joda_to_strptime(fmt.value) if name == "parse_datetime"
+                  else oracle_to_strptime(fmt.value))
+
+            def pdfn(s, py=py):
+                try:
+                    dt = _dt.datetime.strptime(s, py)
+                except ValueError:
+                    return None  # the reference raises; NULL divergence
+                if name == "to_date":
+                    return (dt.date() - _dt.date(1970, 1, 1)).days
+                return dt_to_micros(dt)
+
+            if name == "to_date":
+                return self._bind_dict_table_nullable(
+                    args[0], T.DATE, pdfn, T.DATE.dtype
+                )
+            return self._bind_dict_table_nullable(
+                args[0], T.TIMESTAMP, pdfn, jnp.int64
             )
         if name == "crc32":
             return self._bind_dict_table(

@@ -1,0 +1,307 @@
+"""Host-side (dictionary-wise) scalar helpers.
+
+Pure-python implementations backing the string/binary breadth functions
+in expr/compile.py. These run once per DICTIONARY VALUE at bind time
+(the DictionaryAwarePageProjection discipline), never per row, so plain
+python is the right tool. Digest algorithms follow the reference's
+operator/scalar/VarbinaryFunctions.java; the pattern translators cover
+the documented token subset of DateTimeFunctions.java:961
+(parse_datetime, Joda) and the Teradata to_date family.
+"""
+
+from __future__ import annotations
+
+_M64 = (1 << 64) - 1
+
+
+def xxhash64(data: bytes, seed: int = 0) -> int:
+    """XXH64 (the reference's xxhash64(); io.airlift.slice.XxHash64)."""
+    P1, P2, P3, P4, P5 = (
+        0x9E3779B185EBCA87, 0xC2B2AE3D27D4EB4F, 0x165667B19E3779F9,
+        0x85EBCA77C2B2AE63, 0x27D4EB2F165667C5,
+    )
+
+    def rotl(x, r):
+        return ((x << r) | (x >> (64 - r))) & _M64
+
+    n = len(data)
+    if n >= 32:
+        v1 = (seed + P1 + P2) & _M64
+        v2 = (seed + P2) & _M64
+        v3 = seed & _M64
+        v4 = (seed - P1) & _M64
+        i = 0
+        while i <= n - 32:
+            for j, v in enumerate((v1, v2, v3, v4)):
+                lane = int.from_bytes(data[i + 8 * j:i + 8 * j + 8], "little")
+                v = rotl((v + lane * P2) & _M64, 31) * P1 & _M64
+                if j == 0:
+                    v1 = v
+                elif j == 1:
+                    v2 = v
+                elif j == 2:
+                    v3 = v
+                else:
+                    v4 = v
+            i += 32
+        h = (rotl(v1, 1) + rotl(v2, 7) + rotl(v3, 12) + rotl(v4, 18)) & _M64
+        for v in (v1, v2, v3, v4):
+            h = ((h ^ (rotl((v * P2) & _M64, 31) * P1 & _M64)) * P1 + P4) & _M64
+    else:
+        h = (seed + P5) & _M64
+        i = 0
+    h = (h + n) & _M64
+    while i <= n - 8:
+        k = rotl((int.from_bytes(data[i:i + 8], "little") * P2) & _M64, 31)
+        h = ((rotl(h ^ (k * P1 & _M64), 27) * P1) + P4) & _M64
+        i += 8
+    if i <= n - 4:
+        h = ((rotl(h ^ (int.from_bytes(data[i:i + 4], "little") * P1 & _M64),
+                   23) * P2) + P3) & _M64
+        i += 4
+    while i < n:
+        h = (rotl(h ^ (data[i] * P5 & _M64), 11) * P1) & _M64
+        i += 1
+    h ^= h >> 33
+    h = (h * P2) & _M64
+    h ^= h >> 29
+    h = (h * P3) & _M64
+    h ^= h >> 32
+    return h
+
+
+def murmur3_x64_128(data: bytes, seed: int = 0) -> bytes:
+    """MurmurHash3 x64_128 (the reference's murmur3())."""
+    C1, C2 = 0x87C37B91114253D5, 0x4CF5AD432745937F
+
+    def rotl(x, r):
+        return ((x << r) | (x >> (64 - r))) & _M64
+
+    def fmix(k):
+        k ^= k >> 33
+        k = (k * 0xFF51AFD7ED558CCD) & _M64
+        k ^= k >> 33
+        k = (k * 0xC4CEB9FE1A85EC53) & _M64
+        k ^= k >> 33
+        return k
+
+    h1 = h2 = seed & _M64
+    n = len(data)
+    nblocks = n // 16
+    for b in range(nblocks):
+        k1 = int.from_bytes(data[16 * b:16 * b + 8], "little")
+        k2 = int.from_bytes(data[16 * b + 8:16 * b + 16], "little")
+        h1 ^= (rotl((k1 * C1) & _M64, 31) * C2) & _M64
+        h1 = ((rotl(h1, 27) + h2) * 5 + 0x52DCE729) & _M64
+        h2 ^= (rotl((k2 * C2) & _M64, 33) * C1) & _M64
+        h2 = ((rotl(h2, 31) + h1) * 5 + 0x38495AB5) & _M64
+    tail = data[16 * nblocks:]
+    k1 = k2 = 0
+    for i in range(len(tail) - 1, 7, -1):
+        k2 = (k2 << 8) | tail[i]
+    for i in range(min(len(tail), 8) - 1, -1, -1):
+        k1 = (k1 << 8) | tail[i]
+    if len(tail) > 8:
+        h2 ^= (rotl((k2 * C2) & _M64, 33) * C1) & _M64
+    if len(tail) > 0:
+        h1 ^= (rotl((k1 * C1) & _M64, 31) * C2) & _M64
+    h1 = (h1 ^ n) & _M64
+    h2 = (h2 ^ n) & _M64
+    h1 = (h1 + h2) & _M64
+    h2 = (h2 + h1) & _M64
+    h1 = fmix(h1)
+    h2 = fmix(h2)
+    h1 = (h1 + h2) & _M64
+    h2 = (h2 + h1) & _M64
+    return h1.to_bytes(8, "little") + h2.to_bytes(8, "little")
+
+
+def porter_stem(word: str) -> str:
+    """Porter (1980) stemmer — the algorithm behind the reference's
+    word_stem() (Lucene EnglishStemmer for 'en')."""
+    w = word.lower()
+    if len(w) <= 2:
+        return w
+    vowels = "aeiou"
+
+    def is_cons(s, i):
+        c = s[i]
+        if c in vowels:
+            return False
+        if c == "y":
+            return i == 0 or not is_cons(s, i - 1)
+        return True
+
+    def measure(s):
+        m, i, n = 0, 0, len(s)
+        while i < n and is_cons(s, i):
+            i += 1
+        while True:
+            while i < n and not is_cons(s, i):
+                i += 1
+            if i >= n:
+                return m
+            m += 1
+            while i < n and is_cons(s, i):
+                i += 1
+
+    def has_vowel(s):
+        return any(not is_cons(s, i) for i in range(len(s)))
+
+    def ends_cvc(s):
+        if len(s) < 3:
+            return False
+        if not (is_cons(s, -3 + len(s)) and not is_cons(s, len(s) - 2)
+                and is_cons(s, len(s) - 1)):
+            return False
+        return s[-1] not in "wxy"
+
+    def double_cons(s):
+        return (len(s) >= 2 and s[-1] == s[-2] and is_cons(s, len(s) - 1))
+
+    # step 1a
+    if w.endswith("sses"):
+        w = w[:-2]
+    elif w.endswith("ies"):
+        w = w[:-2]
+    elif w.endswith("ss"):
+        pass
+    elif w.endswith("s"):
+        w = w[:-1]
+    # step 1b
+    flag = False
+    if w.endswith("eed"):
+        if measure(w[:-3]) > 0:
+            w = w[:-1]
+    elif w.endswith("ed") and has_vowel(w[:-2]):
+        w, flag = w[:-2], True
+    elif w.endswith("ing") and has_vowel(w[:-3]):
+        w, flag = w[:-3], True
+    if flag:
+        if w.endswith(("at", "bl", "iz")):
+            w += "e"
+        elif double_cons(w) and not w.endswith(("l", "s", "z")):
+            w = w[:-1]
+        elif measure(w) == 1 and ends_cvc(w):
+            w += "e"
+    # step 1c
+    if w.endswith("y") and has_vowel(w[:-1]):
+        w = w[:-1] + "i"
+    # steps 2-4: suffix tables (condition: measure of the stem)
+    for suffixes, m_min in (
+        ((("ational", "ate"), ("tional", "tion"), ("enci", "ence"),
+          ("anci", "ance"), ("izer", "ize"), ("abli", "able"),
+          ("alli", "al"), ("entli", "ent"), ("eli", "e"), ("ousli", "ous"),
+          ("ization", "ize"), ("ation", "ate"), ("ator", "ate"),
+          ("alism", "al"), ("iveness", "ive"), ("fulness", "ful"),
+          ("ousness", "ous"), ("aliti", "al"), ("iviti", "ive"),
+          ("biliti", "ble")), 0),
+        ((("icate", "ic"), ("ative", ""), ("alize", "al"), ("iciti", "ic"),
+          ("ical", "ic"), ("ful", ""), ("ness", "")), 0),
+        ((("al", ""), ("ance", ""), ("ence", ""), ("er", ""), ("ic", ""),
+          ("able", ""), ("ible", ""), ("ant", ""), ("ement", ""),
+          ("ment", ""), ("ent", ""), ("ou", ""), ("ism", ""), ("ate", ""),
+          ("iti", ""), ("ous", ""), ("ive", ""), ("ize", "")), 1),
+    ):
+        for suf, rep in suffixes:
+            if w.endswith(suf):
+                stem = w[: len(w) - len(suf)]
+                if measure(stem) > m_min:
+                    # step 4 "ion" needs s/t before (handled via ou/ion)
+                    w = stem + rep
+                break
+    # step 5a
+    if w.endswith("e"):
+        m = measure(w[:-1])
+        if m > 1 or (m == 1 and not ends_cvc(w[:-1])):
+            w = w[:-1]
+    # step 5b
+    if measure(w) > 1 and double_cons(w) and w.endswith("l"):
+        w = w[:-1]
+    return w
+
+
+# longest token first WITHIN each letter family — a shorter prefix
+# listed earlier would shadow the longer token ('MM' before 'MMM' turned
+# month names into '%m%m')
+_JODA = [
+    ("yyyy", "%Y"), ("yyy", "%Y"), ("yy", "%y"),
+    ("MMM", "%b"), ("MM", "%m"), ("M", "%m"),
+    ("dd", "%d"), ("d", "%d"),
+    ("HH", "%H"), ("H", "%H"), ("hh", "%I"),
+    ("mm", "%M"), ("m", "%M"),
+    ("SSS", "%f"), ("ss", "%S"), ("s", "%S"),
+    ("a", "%p"), ("EEE", "%a"), ("ZZ", "%z"), ("Z", "%z"),
+]
+
+_ORACLE = [
+    ("yyyy", "%Y"), ("yy", "%y"), ("mm", "%m"), ("dd", "%d"),
+    ("hh24", "%H"), ("hh", "%I"), ("mi", "%M"), ("ss", "%S"),
+]
+
+
+def _translate(fmt: str, table, casefold: bool) -> str:
+    out, i = [], 0
+    while i < len(fmt):
+        if fmt[i] == "'":  # Joda literal quoting
+            j = fmt.find("'", i + 1)
+            if j < 0:
+                out.append(fmt[i + 1:])
+                break
+            out.append(fmt[i + 1:j].replace("%", "%%"))
+            i = j + 1
+            continue
+        for tok, rep in table:
+            # Joda tokens are case-sensitive (MM = month, mm = minute);
+            # the Oracle/Teradata table is case-insensitive
+            hit = fmt.startswith(tok, i) or (
+                casefold and fmt.lower().startswith(tok, i)
+            )
+            if hit:
+                out.append(rep)
+                i += len(tok)
+                break
+        else:
+            out.append(fmt[i].replace("%", "%%"))
+            i += 1
+    return "".join(out)
+
+
+def joda_to_strptime(fmt: str) -> str:
+    return _translate(fmt, _JODA, casefold=False)
+
+
+def iso_to_micros(s: str, trim_nanos: bool = False):
+    """ISO-8601 text -> UTC epoch microseconds, None if unparseable.
+    The ONE conversion shared by timestamp literals, varchar->timestamp
+    casts, and the from_iso8601 functions (exact integer arithmetic —
+    total_seconds() would lose microseconds past ~year 2255)."""
+    import datetime as _dt
+
+    v = s.strip().replace("Z", "+00:00").replace("z", "+00:00")
+    if trim_nanos and "." in v:
+        head, _, frac = v.partition(".")
+        tz = ""
+        for sep in ("+", "-"):
+            p = frac.find(sep)
+            if p > 0:
+                frac, tz = frac[:p], frac[p:]
+        v = f"{head}.{frac[:6]}{tz}"
+    try:
+        dt = _dt.datetime.fromisoformat(v)
+    except ValueError:
+        return None
+    return dt_to_micros(dt)
+
+
+def dt_to_micros(dt) -> int:
+    """tz-aware or naive datetime -> UTC epoch microseconds, exactly."""
+    import datetime as _dt
+
+    if dt.tzinfo is not None:
+        dt = dt.astimezone(_dt.timezone.utc).replace(tzinfo=None)
+    return (dt - _dt.datetime(1970, 1, 1)) // _dt.timedelta(microseconds=1)
+
+
+def oracle_to_strptime(fmt: str) -> str:
+    return _translate(fmt, _ORACLE, casefold=True)

@@ -294,6 +294,78 @@ for entry in [
     ("atanh", 1, 1, _DOUBLE, "double", "inverse hyperbolic tangent", ()),
     ("expm1", 1, 1, _DOUBLE, "double", "exp(x) - 1, accurate near 0", ()),
     ("log1p", 1, 1, _DOUBLE, "double", "ln(1 + x), accurate near 0", ()),
+    # r4 breadth: binary/digest family (VarbinaryFunctions.java,
+    # HmacFunctions.java — digests render as lowercase hex varchar on the
+    # engine's dictionary-varchar varbinary carrier)
+    ("sha512", 1, 1, _VARCHAR, "varchar", "SHA-512 digest as lowercase hex", ()),
+    ("xxhash64", 1, 1, _VARCHAR, "varchar",
+     "XXHash64 of the UTF-8 bytes as 16 hex digits", ()),
+    ("murmur3", 1, 1, _VARCHAR, "varchar",
+     "Murmur3 x64_128 of the UTF-8 bytes as 32 hex digits", ()),
+    ("hmac_md5", 2, 2, _VARCHAR, "varchar",
+     "HMAC-MD5 with a constant key, as lowercase hex", (), (1,)),
+    ("hmac_sha1", 2, 2, _VARCHAR, "varchar",
+     "HMAC-SHA1 with a constant key, as lowercase hex", (), (1,)),
+    ("hmac_sha256", 2, 2, _VARCHAR, "varchar",
+     "HMAC-SHA256 with a constant key, as lowercase hex", (), (1,)),
+    ("hmac_sha512", 2, 2, _VARCHAR, "varchar",
+     "HMAC-SHA512 with a constant key, as lowercase hex", (), (1,)),
+    ("to_base32", 1, 1, _VARCHAR, "varchar", "bytes to RFC 4648 base32", ()),
+    ("from_base32", 1, 1, _VARCHAR, "varchar",
+     "base32 to bytes (as varchar)", ()),
+    ("to_base64url", 1, 1, _VARCHAR, "varchar",
+     "bytes to URL-safe base64", ()),
+    ("from_base64url", 1, 1, _VARCHAR, "varchar",
+     "URL-safe base64 to bytes (as varchar)", ()),
+    ("from_big_endian_32", 1, 1, _BIGINT, "bigint",
+     "big-endian 4-byte value to integer (NULL if not 4 bytes)", ()),
+    ("from_big_endian_64", 1, 1, _BIGINT, "bigint",
+     "big-endian 8-byte value to bigint (NULL if not 8 bytes)", ()),
+    ("from_ieee754_32", 1, 1, _DOUBLE, "double",
+     "IEEE 754 big-endian 4-byte value to real (NULL if not 4 bytes)", ()),
+    ("from_ieee754_64", 1, 1, _DOUBLE, "double",
+     "IEEE 754 big-endian 8-byte value to double (NULL if not 8 bytes)", ()),
+    # r4 breadth: string remainder
+    ("luhn_check", 1, 1, _BOOLEAN, "boolean",
+     "Luhn checksum validity of a digit string", ()),
+    ("strrpos", 2, 2, _BIGINT, "bigint",
+     "1-based position of the LAST occurrence of a constant (0 = absent)",
+     (), (1,)),
+    ("to_utf8", 1, 1, _VARCHAR, "varchar",
+     "varchar to its UTF-8 bytes (identity on the varchar carrier)", ()),
+    ("from_utf8", 1, 1, _VARCHAR, "varchar",
+     "UTF-8 bytes to varchar, invalid sequences replaced", ()),
+    ("word_stem", 1, 1, _VARCHAR, "varchar",
+     "Porter stem of an English word", ()),
+    ("char2hexint", 1, 1, _VARCHAR, "varchar",
+     "Teradata: hex of the UTF-16BE code units", ()),
+    ("index", 2, 2, _BIGINT, "bigint",
+     "Teradata alias of strpos (constant substring)", (), (1,)),
+    # r4 breadth: datetime parse family (DateTimeFunctions.java:961
+    # parse_datetime and the from_iso8601 group)
+    ("from_iso8601_timestamp", 1, 1, lambda a: T.TIMESTAMP, "timestamp",
+     "parse an ISO-8601 timestamp (offsets applied, stored UTC)", ()),
+    ("from_iso8601_timestamp_nanos", 1, 1, lambda a: T.TIMESTAMP,
+     "timestamp",
+     "parse an ISO-8601 timestamp with nanoseconds (micros kept)", ()),
+    ("parse_datetime", 2, 2, lambda a: T.TIMESTAMP, "timestamp",
+     "parse with a constant Joda-style pattern (yyyy/MM/dd/HH/mm/ss)",
+     (), (1,)),
+    ("to_date", 2, 2, lambda a: T.DATE, "date",
+     "Teradata: parse with an Oracle-style pattern (yyyy-mm-dd)", (), (1,)),
+    ("to_timestamp", 2, 2, lambda a: T.TIMESTAMP, "timestamp",
+     "Teradata: parse with an Oracle-style pattern", (), (1,)),
+    ("from_unixtime_nanos", 1, 1, lambda a: T.TIMESTAMP, "timestamp",
+     "epoch nanoseconds to timestamp (truncated to micros)", ()),
+    ("timezone_hour", 1, 1, _BIGINT, "bigint",
+     "hour offset of the session zone (engine timestamps are UTC: 0)", ()),
+    ("timezone_minute", 1, 1, _BIGINT, "bigint",
+     "minute offset of the session zone (engine timestamps are UTC: 0)", ()),
+    # r4 breadth: math remainder
+    ("from_base", 2, 2, _BIGINT, "bigint",
+     "parse as an integer in a constant radix 2..36", (), (1,)),
+    ("inverse_beta_cdf", 3, 3, _DOUBLE, "double",
+     "beta quantile at p for (a, b)", ()),
 ]:
     name, lo, hi, rule, ret, desc, aliases = entry[:7]
     const_args = entry[7] if len(entry) > 7 else ()
@@ -370,6 +442,52 @@ for name, lo, hi, desc in [
 
 _reg("year_of_week", "scalar", 1, 1, "bigint",
      "ISO week-numbering year", aliases=("yow",), rule=_BIGINT)
+
+# --- r4 breadth: analyzer-special-cased additions (typing/desugaring in
+# sql/analyzer.py; constant folding where the value is session-fixed) ---
+for name, lo, hi, ret, desc, aliases in [
+    ("now", 0, 0, "timestamp", "query start timestamp", ()),
+    ("current_timezone", 0, 0, "varchar", "session zone name (UTC)", ()),
+    ("date", 1, 1, "date", "cast to date", ()),
+    ("rand", 0, 2, "double|bigint",
+     "uniform random: () in [0,1), (n) in [0,n), (lo,hi) in [lo,hi)",
+     ("random",)),
+    ("concat_ws", 2, None, "varchar",
+     "concatenate with a constant separator, skipping NULLs", ()),
+    ("position", 2, 2, "bigint",
+     "1-based position of a constant substring (0 = absent)", ()),
+    ("uuid", 0, 0, "varchar", "random UUID (one per query)", ()),
+    ("version", 0, 0, "varchar", "engine version", ()),
+    ("human_readable_seconds", 1, 1, "varchar",
+     "seconds as weeks/days/hours/minutes/seconds text (constant)", ()),
+    ("parse_duration", 1, 1, "interval day to second",
+     "parse a duration literal like '3.5d' (constant)", ()),
+    ("parse_data_size", 1, 1, "decimal(38,0)",
+     "parse a size literal like '2.3MB' to bytes (constant)", ()),
+    ("to_milliseconds", 1, 1, "bigint",
+     "day-to-second interval to milliseconds", ()),
+    ("to_iso8601", 1, 1, "varchar",
+     "date/timestamp as ISO-8601 text (constant argument)", ()),
+    ("to_base", 2, 2, "varchar",
+     "integer rendered in radix 2..36 (constant arguments)", ()),
+    ("to_big_endian_32", 1, 1, "varbinary",
+     "integer to big-endian 4 bytes (constant argument)", ()),
+    ("to_big_endian_64", 1, 1, "varbinary",
+     "bigint to big-endian 8 bytes (constant argument)", ()),
+    ("to_ieee754_32", 1, 1, "varbinary",
+     "real to IEEE 754 big-endian 4 bytes (constant argument)", ()),
+    ("to_ieee754_64", 1, 1, "varbinary",
+     "double to IEEE 754 big-endian 8 bytes (constant argument)", ()),
+    ("format_number", 1, 1, "varchar",
+     "number with a unit suffix like 1.23K (constant argument)", ()),
+    ("bar", 2, 4, "varchar",
+     "ANSI render of x in [0,1] as a width-n bar (constant arguments)", ()),
+    ("rgb", 3, 3, "color", "color from RGB components (constants)", ()),
+    ("color", 1, 1, "color", "color from a name or #hex (constant)", ()),
+    ("render", 2, 2, "varchar",
+     "value wrapped in an ANSI color (constant arguments)", ()),
+]:
+    _reg(name, "scalar", lo, hi, ret, desc, aliases)
 
 _ARRAY0 = lambda a: a[0]  # noqa: E731
 for name, lo, hi, ret, desc, rule in [

@@ -274,7 +274,12 @@ class ExprConverter:
         if isinstance(e, ast.DateLiteral):
             return ir.Literal(_date_days(e.value), T.DATE)
         if isinstance(e, ast.TimestampLiteral):
-            raise AnalysisError("timestamp literals not yet supported")
+            from trino_tpu.expr.pyfns import iso_to_micros
+
+            micros = iso_to_micros(e.value)
+            if micros is None:
+                raise AnalysisError(f"invalid timestamp: {e.value!r}")
+            return ir.Literal(micros, T.TIMESTAMP)
         if isinstance(e, ast.IntervalLiteral):
             raise AnalysisError("intervals are only supported in date arithmetic")
         if isinstance(e, ast.BinaryOp):
@@ -422,12 +427,322 @@ class ExprConverter:
         "transform_values", "transform_keys", "map_filter",
     }
 
+    def _convert_breadth_call(self, name, e) -> Optional[ir.Expr]:
+        """r4 breadth: session-fixed zero-arg functions, cast shorthands,
+        desugarings, and constant folds for string-producing functions of
+        non-string inputs (the engine's varchar columns are dictionary
+        codes, so a per-row numeric->string projection has no vectorized
+        carrier; constants fold here, columns get a clean AnalysisError).
+        Reference seats: DateTimeFunctions.java (now/current_timezone),
+        MathFunctions.java (to_base/random), ColorFunctions.java,
+        StringFunctions.java:162 (concat_ws)."""
+        import datetime as _dt
+
+        def _arity(lo, hi=None):
+            n = len(e.args)
+            hi_ = lo if hi is None else hi
+            if not lo <= n <= hi_:
+                want = str(lo) if hi_ == lo else f"{lo}..{hi_}"
+                raise AnalysisError(
+                    f"{name}() expects {want} arguments, got {n}"
+                )
+
+        def _need_const(args, which=None):
+            vals = []
+            for i, a in enumerate(args):
+                c = self.convert(a)
+                if which is not None and i not in which:
+                    vals.append(c)
+                    continue
+                if not isinstance(c, ir.Literal):
+                    raise AnalysisError(
+                        f"{name}(): argument {i + 1} must be a constant"
+                        " (column-valued inputs have no varchar carrier)"
+                    )
+                vals.append(c)
+            return vals
+
+        if name == "now":
+            import time as _time
+
+            if e.args:
+                raise AnalysisError("now() takes no arguments")
+            return ir.Literal(int(_time.time() * 1e6), T.TIMESTAMP)
+        if name == "current_timezone":
+            return ir.Literal("UTC", T.VARCHAR)
+        if name == "uuid":
+            import uuid as _uuid
+
+            return ir.Literal(str(_uuid.uuid4()), T.VARCHAR)
+        if name == "version":
+            return ir.Literal("trino_tpu 0.4", T.VARCHAR)
+        if name == "date":
+            if len(e.args) != 1:
+                raise AnalysisError("date() takes one argument")
+            a = self.convert(e.args[0])
+            if isinstance(a, ir.Literal) and a.type.is_string:
+                if a.value is None:
+                    return ir.Literal(None, T.DATE)
+                return ir.Literal(_date_days(str(a.value)), T.DATE)
+            return ir.Cast(a, T.DATE)
+        if name in ("rand", "random"):
+            args = tuple(self.convert(a) for a in e.args)
+            if len(args) > 2:
+                raise AnalysisError("rand() takes at most two arguments")
+            return ir.Call(
+                "rand", args, T.DOUBLE if not args else T.BIGINT
+            )
+        if name == "position":
+            if len(e.args) != 2:
+                raise AnalysisError("position() takes two arguments")
+            sub = self.convert(e.args[0])
+            hay = self.convert(e.args[1])
+            if not isinstance(sub, ir.Literal):
+                # the strpos binder's dictionary-table form needs a
+                # constant needle; fail at ANALYSIS, not mid-execution
+                raise AnalysisError(
+                    "position(): the substring must be a constant"
+                )
+            return ir.Call("strpos", (hay, sub), T.BIGINT)
+        if name == "concat_ws":
+            if len(e.args) < 2:
+                raise AnalysisError("concat_ws() needs separator + values")
+            sep = self.convert(e.args[0])
+            if not isinstance(sep, ir.Literal):
+                raise AnalysisError("concat_ws() separator must be constant")
+            if sep.value is None:
+                return ir.Literal(None, T.VARCHAR)
+            vals = [self.convert(a) for a in e.args[1:]]
+            # NULL literals fold away here (the runtime Case below only
+            # handles column nulls; the concat binder has no NULL-only
+            # constant dictionary)
+            vals = [
+                v for v in vals
+                if not (isinstance(v, ir.Literal) and v.value is None)
+            ]
+            if not vals:
+                return ir.Literal("", T.VARCHAR)
+            # NULL-skipping desugar: every NON-NULL value contributes
+            # ``sep || value`` (NULL contributes ''), then ONE leading
+            # separator is stripped — so NULLs vanish without doubling
+            # separators while '' is kept (Trino's contract). Stays
+            # inside the dictionary-concat machinery.
+            sepl = ir.Literal(sep.value, T.VARCHAR)
+            pieces = []
+            for v in vals:
+                sv = v if v.type.is_string else ir.Cast(v, T.VARCHAR)
+                pieces.append(ir.Case(
+                    (ir.is_null(v),), (ir.Literal("", T.VARCHAR),),
+                    ir.Call("concat", (sepl, sv), T.VARCHAR), T.VARCHAR,
+                ))
+            glued = pieces[0]
+            for p in pieces[1:]:
+                glued = ir.Call("concat", (glued, p), T.VARCHAR)
+            return ir.Call(
+                "substr",
+                (glued, ir.Literal(len(sep.value) + 1, T.BIGINT)),
+                T.VARCHAR,
+            )
+        if name == "human_readable_seconds":
+            _arity(1)
+            (a,) = _need_const(e.args)
+            if a.value is None:
+                return ir.Literal(None, T.VARCHAR)
+            secs = int(round(float(a.value)))
+            units = [("week", 604800), ("day", 86400), ("hour", 3600),
+                     ("minute", 60), ("second", 1)]
+            neg, secs = secs < 0, abs(secs)
+            parts = []
+            for uname, u in units:
+                q, secs = divmod(secs, u)
+                if q:
+                    parts.append(f"{q} {uname}{'s' if q != 1 else ''}")
+            txt = ", ".join(parts) or "0 seconds"
+            return ir.Literal(("-" if neg else "") + txt, T.VARCHAR)
+        if name == "parse_duration":
+            _arity(1)
+            (a,) = _need_const(e.args)
+            if a.value is None:
+                return ir.Literal(None, T.INTERVAL_DAY)
+            import re as _re
+
+            m = _re.fullmatch(
+                r"\s*([0-9.]+)\s*(ns|us|ms|s|m|h|d)\s*", str(a.value)
+            )
+            if not m:
+                raise AnalysisError(f"invalid duration: {a.value!r}")
+            mult = {"ns": 1e-3, "us": 1.0, "ms": 1e3, "s": 1e6,
+                    "m": 6e7, "h": 3.6e9, "d": 8.64e10}[m.group(2)]
+            return ir.Literal(
+                int(float(m.group(1)) * mult), T.INTERVAL_DAY
+            )
+        if name == "parse_data_size":
+            _arity(1)
+            (a,) = _need_const(e.args)
+            if a.value is None:
+                return ir.Literal(None, T.decimal(38, 0))
+            import re as _re
+
+            m = _re.fullmatch(
+                r"\s*([0-9.]+)\s*([kMGTPE]?B)\s*", str(a.value)
+            )
+            if not m:
+                raise AnalysisError(f"invalid data size: {a.value!r}")
+            exp = {"B": 0, "kB": 1, "MB": 2, "GB": 3, "TB": 4,
+                   "PB": 5, "EB": 6}[m.group(2)]
+            return ir.Literal(
+                int(float(m.group(1)) * (1024 ** exp)),
+                T.decimal(38, 0),
+            )
+        if name == "to_milliseconds":
+            _arity(1)
+            (a,) = _need_const(e.args)
+            if a.type.kind != T.TypeKind.INTERVAL_DAY:
+                raise AnalysisError(
+                    "to_milliseconds() takes a day-to-second interval"
+                )
+            v = None if a.value is None else int(a.value) // 1000
+            return ir.Literal(v, T.BIGINT)
+        if name == "to_iso8601":
+            _arity(1)
+            (a,) = _need_const(e.args)
+            if a.value is None:
+                return ir.Literal(None, T.VARCHAR)
+            if a.type.kind == T.TypeKind.DATE:
+                d = _dt.date(1970, 1, 1) + _dt.timedelta(days=int(a.value))
+                return ir.Literal(d.isoformat(), T.VARCHAR)
+            if a.type.kind == T.TypeKind.TIMESTAMP:
+                ts = _dt.datetime(1970, 1, 1) + _dt.timedelta(
+                    microseconds=int(a.value)
+                )
+                return ir.Literal(ts.isoformat(), T.VARCHAR)
+            raise AnalysisError("to_iso8601() takes a date or timestamp")
+        if name == "to_base":
+            _arity(2)
+            a, r = _need_const(e.args)
+            if a.value is None or r.value is None:
+                return ir.Literal(None, T.VARCHAR)
+            radix = int(r.value)
+            if not 2 <= radix <= 36:
+                raise AnalysisError("to_base() radix must be in [2, 36]")
+            v, digits = abs(int(a.value)), "0123456789abcdefghijklmnopqrstuvwxyz"
+            out = ""
+            while True:
+                v, rem = divmod(v, radix)
+                out = digits[rem] + out
+                if v == 0:
+                    break
+            return ir.Literal(
+                ("-" if int(a.value) < 0 else "") + out, T.VARCHAR
+            )
+        if name in ("to_big_endian_32", "to_big_endian_64",
+                    "to_ieee754_32", "to_ieee754_64"):
+            import struct as _struct
+
+            _arity(1)
+            (a,) = _need_const(e.args)
+            if a.value is None:
+                return ir.Literal(None, T.VARCHAR)
+            if name == "to_big_endian_32":
+                b = int(a.value).to_bytes(4, "big", signed=True)
+            elif name == "to_big_endian_64":
+                b = int(a.value).to_bytes(8, "big", signed=True)
+            elif name == "to_ieee754_32":
+                b = _struct.pack(">f", float(a.value))
+            else:
+                b = _struct.pack(">d", float(a.value))
+            # utf-8-replace decode: the engine's varbinary carrier (bytes
+            # >= 0x80 do not round-trip — same documented limitation as
+            # from_base64 of arbitrary bytes)
+            return ir.Literal(b.decode("utf-8", "replace"), T.VARCHAR)
+        if name == "format_number":
+            _arity(1)
+            (a,) = _need_const(e.args)
+            if a.value is None:
+                return ir.Literal(None, T.VARCHAR)
+            v = float(a.value)
+            for div, suf in ((1e12, "T"), (1e9, "B"), (1e6, "M"),
+                             (1e3, "K")):
+                if abs(v) >= div:
+                    return ir.Literal(
+                        f"{v / div:.2f}".rstrip("0").rstrip(".") + suf,
+                        T.VARCHAR,
+                    )
+            txt = f"{v:.2f}".rstrip("0").rstrip(".")
+            return ir.Literal(txt, T.VARCHAR)
+        if name == "rgb":
+            _arity(3)
+            r, g, b = _need_const(e.args)
+            if None in (r.value, g.value, b.value):
+                return ir.Literal(None, T.BIGINT)
+            for c in (r, g, b):
+                if not 0 <= int(c.value) <= 255:
+                    raise AnalysisError("rgb() components must be in [0,255]")
+            return ir.Literal(
+                (int(r.value) << 16) | (int(g.value) << 8) | int(b.value),
+                T.BIGINT,
+            )
+        if name == "color":
+            _arity(1)
+            (a,) = _need_const(e.args)
+            if a.value is None:
+                return ir.Literal(None, T.BIGINT)
+            s = str(a.value)
+            named = {"black": 0x000000, "red": 0xFF0000, "green": 0x00FF00,
+                     "yellow": 0xFFFF00, "blue": 0x0000FF,
+                     "magenta": 0xFF00FF, "cyan": 0x00FFFF,
+                     "white": 0xFFFFFF}
+            if s.lower() in named:
+                return ir.Literal(named[s.lower()], T.BIGINT)
+            if s.startswith("#") and len(s) == 4:
+                r, g, b = (int(c * 2, 16) for c in s[1:])
+                return ir.Literal((r << 16) | (g << 8) | b, T.BIGINT)
+            if s.startswith("#") and len(s) == 7:
+                return ir.Literal(int(s[1:], 16), T.BIGINT)
+            raise AnalysisError(f"invalid color: {s!r}")
+        if name == "render":
+            _arity(2)
+            v, c = _need_const(e.args)
+            if v.value is None or c.value is None:
+                return ir.Literal(None, T.VARCHAR)
+            rgb24 = int(c.value)
+            r, g, b = (rgb24 >> 16) & 255, (rgb24 >> 8) & 255, rgb24 & 255
+            return ir.Literal(
+                f"\x1b[38;2;{r};{g};{b}m{v.value}\x1b[0m", T.VARCHAR
+            )
+        if name == "bar":
+            _arity(2, 4)
+            args = _need_const(e.args)
+            if args[0].value is None or args[1].value is None:
+                return ir.Literal(None, T.VARCHAR)
+            x = float(args[0].value)
+            width = int(args[1].value)
+            lo = int(args[2].value) if len(args) > 2 else 0xFF0000
+            hi = int(args[3].value) if len(args) > 3 else 0x00FF00
+            x = min(max(x, 0.0), 1.0)
+            n = int(round(x * width))
+            out = []
+            for i in range(n):
+                t = i / max(width - 1, 1)
+                r = int(((lo >> 16) & 255) * (1 - t) + ((hi >> 16) & 255) * t)
+                g = int(((lo >> 8) & 255) * (1 - t) + ((hi >> 8) & 255) * t)
+                b = int((lo & 255) * (1 - t) + (hi & 255) * t)
+                out.append(f"\x1b[38;2;{r};{g};{b}m█")
+            return ir.Literal(
+                "".join(out) + ("\x1b[0m" if out else "") + " " * (width - n),
+                T.VARCHAR,
+            )
+        return None
+
     def _convert_call(self, e: ast.FunctionCall) -> ir.Expr:
         name = e.name
         if name in AGG_FUNCS:
             raise AnalysisError(
                 f"aggregate function {name}() in a non-aggregate context"
             )
+        breadth = self._convert_breadth_call(name, e)
+        if breadth is not None:
+            return breadth
         if name in self._LAMBDA_FUNCS and len(e.args) == 2 and isinstance(
             e.args[1], ast.Lambda
         ):
