@@ -1,0 +1,165 @@
+"""r4 function-breadth batch 2: collect-path aggregates (array_agg,
+map_agg, histogram, ...), moment-sum composites (regr_* family, entropy,
+checksum), and nth_value. Oracles: pandas/python recomputation.
+
+Reference seats: ArrayAggregationFunction, MapAggregationFunction,
+Histogram, NumericHistogramAggregation (Ben-Haim/Tom-Tov),
+DoubleRegressionAggregation, EntropyAggregation,
+ChecksumAggregationFunction, NthValueFunction."""
+
+import math
+
+import numpy as np
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.connectors.memory import MemoryConnector
+from trino_tpu.connectors.spi import ColumnMetadata
+from trino_tpu.engine import LocalQueryRunner, Session
+
+G = np.array([1, 1, 1, 2, 2, 3], dtype=np.int64)
+K = ["a", "b", "a", "c", "c", None]
+V = np.array([10, 20, 30, 40, 50, 60], dtype=np.int64)
+
+
+@pytest.fixture(scope="module")
+def runner():
+    conn = MemoryConnector()
+    conn.load_table(
+        "default", "t",
+        [ColumnMetadata("g", T.BIGINT), ColumnMetadata("k", T.VARCHAR),
+         ColumnMetadata("v", T.BIGINT)],
+        [G, K, V],
+        valids=[None, np.array([1, 1, 1, 1, 1, 0], bool), None],
+    )
+    r = LocalQueryRunner(Session(catalog="memory", schema="default"))
+    r.register_catalog("memory", conn)
+    return r
+
+
+def one(runner, sql):
+    return runner.execute(sql).rows[0][0]
+
+
+class TestCollectAggregates:
+    def test_array_agg_grouped(self, runner):
+        rows = runner.execute(
+            "select g, array_agg(v) from t group by g order by g").rows
+        assert [sorted(r[1]) for r in rows] == [
+            [10, 20, 30], [40, 50], [60]]
+
+    def test_array_agg_keeps_nulls(self, runner):
+        got = one(runner, "select array_agg(k) from t where g = 3")
+        assert got == [None]
+
+    def test_array_agg_empty_group_is_null(self, runner):
+        assert one(runner,
+                   "select array_agg(v) from t where g > 99") is None
+
+    def test_map_agg(self, runner):
+        rows = runner.execute(
+            "select g, map_agg(k, v) from t group by g order by g").rows
+        assert rows[0][1] == {"a": 30, "b": 20}  # later key wins
+        assert rows[1][1] == {"c": 50}
+        assert rows[2][1] is None  # only a NULL key
+
+    def test_multimap_agg(self, runner):
+        rows = runner.execute(
+            "select g, multimap_agg(k, v) from t group by g order by g"
+        ).rows
+        assert rows[0][1] == {"a": [10, 30], "b": [20]}
+
+    def test_histogram(self, runner):
+        assert one(runner, "select histogram(k) from t") == {
+            "a": 2, "b": 1, "c": 2}
+
+    def test_map_union(self, runner):
+        got = one(runner, "select map_union(m) from ("
+                          "select map_agg(k, v) m from t group by g)")
+        assert got == {"a": 30, "b": 20, "c": 50}
+
+    def test_numeric_histogram_bucket_count(self, runner):
+        h = one(runner, "select numeric_histogram(2, v) from t")
+        assert len(h) == 2
+        assert sum(h.values()) == 6  # weights preserve row count
+        # centroid means partition the sorted values
+        assert h == {25.0: 4.0, 55.0: 2.0}
+
+    def test_approx_most_frequent(self, runner):
+        assert one(runner,
+                   "select approx_most_frequent(1, k, 10) from t") == {"a": 2}
+
+    def test_bitwise_aggs(self, runner):
+        rows = runner.execute(
+            "select g, bitwise_or_agg(v), bitwise_and_agg(v), "
+            "bitwise_xor_agg(v) from t group by g order by g").rows
+        assert rows[0][1:] == [10 | 20 | 30, 10 & 20 & 30, 10 ^ 20 ^ 30]
+        assert rows[2][1:] == [60, 60, 60]
+
+
+class TestCompositeAggregates:
+    def test_regr_family_vs_numpy(self, runner):
+        y, x = V.astype(float), G.astype(float)
+        n = len(x)
+        got = runner.execute(
+            "select regr_count(v, g), regr_avgx(v, g), regr_avgy(v, g), "
+            "regr_sxx(v, g), regr_sxy(v, g), regr_syy(v, g), "
+            "regr_r2(v, g) from t").rows[0]
+        sxx = float(np.sum((x - x.mean()) ** 2))
+        sxy = float(np.sum((x - x.mean()) * (y - y.mean())))
+        syy = float(np.sum((y - y.mean()) ** 2))
+        r2 = sxy * sxy / (sxx * syy)
+        want = [n, x.mean(), y.mean(), sxx, sxy, syy, r2]
+        for g, w in zip(got, want):
+            assert abs(g - w) < 1e-9 * max(1.0, abs(w))
+
+    def test_regr_r2_constant_x_is_null(self, runner):
+        assert one(runner,
+                   "select regr_r2(v, 1) from t") is None
+
+    def test_entropy(self, runner):
+        got = one(runner, "select entropy(v) from t where g = 1")
+        c = np.array([10.0, 20.0, 30.0])
+        p = c / c.sum()
+        want = float(-(p * np.log2(p)).sum())
+        assert abs(got - want) < 1e-12
+
+    def test_entropy_empty_is_zero(self, runner):
+        assert one(runner, "select entropy(v) from t where g > 99") == 0.0
+
+    def test_checksum_order_insensitive(self, runner):
+        a = one(runner, "select checksum(v) from t")
+        b = one(runner, "select checksum(v) from "
+                        "(select v from t order by v desc)")
+        assert a == b and a is not None
+
+    def test_checksum_detects_difference(self, runner):
+        a = one(runner, "select checksum(v) from t")
+        b = one(runner, "select checksum(v + 1) from t")
+        assert a != b
+
+    def test_checksum_strings_and_empty(self, runner):
+        assert one(runner, "select checksum(k) from t") is not None
+        assert one(runner,
+                   "select checksum(v) from t where g > 99") is None
+
+    def test_geometric_mean(self, runner):
+        got = one(runner, "select geometric_mean(v) from t")
+        want = float(np.exp(np.mean(np.log(V.astype(float)))))
+        assert abs(got - want) < 1e-9
+
+
+class TestNthValue:
+    def test_nth_value_default_frame(self, runner):
+        rows = runner.execute(
+            "select g, v, nth_value(v, 2) over "
+            "(partition by g order by v) from t order by g, v").rows
+        # default RANGE frame: row 1 of each partition sees < 2 rows
+        assert [r[2] for r in rows] == [None, 20, 20, None, 50, None]
+
+    def test_nth_value_one_is_first_value(self, runner):
+        rows = runner.execute(
+            "select nth_value(v, 1) over (partition by g order by v), "
+            "first_value(v) over (partition by g order by v) "
+            "from t").rows
+        assert all(r[0] == r[1] for r in rows)

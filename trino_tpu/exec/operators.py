@@ -575,6 +575,12 @@ def _window_compute(
             col = s_cols[arg_ch]
             data, valid = W.last_value(col.data, col.valid, part_start, peer_start, frame)
             out_cols.append((data, valid))
+        elif kind == "nth_value":
+            col = s_cols[arg_ch]
+            data, valid = W.nth_value(
+                col.data, col.valid, part_start, peer_start, frame, offset
+            )
+            out_cols.append((data, valid & s_live if valid is not None else None))
         elif kind in ("count", "count_star"):
             if arg_ch is None:
                 vals, valid = None, None
@@ -695,7 +701,8 @@ class WindowOperator(Operator):
         for spec, (data, valid) in zip(self._specs, out_cols):
             d = None
             if spec.arg_channel is not None and spec.kind in (
-                "lead", "lag", "first_value", "last_value", "min", "max"
+                "lead", "lag", "first_value", "last_value", "nth_value",
+                "min", "max"
             ):
                 d = s_cols[spec.arg_channel].dictionary
             cols.append(Column(spec.out_type, data, valid, d))
@@ -732,16 +739,53 @@ class AggSpec:
     percentile: Optional[float] = None
     separator: Optional[str] = None  # listagg
     arg3_channel: Optional[int] = None  # pctl_merge bucket-max channel
+    param: Optional[float] = None  # numeric_histogram/approx_most_frequent b
 
 
 # pctl_merge is the bounded MERGE half of the mergeable approx_percentile
 # (sql/optimizer.RewriteApproxPercentile): it buffers quantile-bucket
 # summaries, never raw rows. approx_distinct / approx_percentile appear
 # here only as the enable_optimizer=False fallback.
+# r4 collect-path aggregates: per-group containers are assembled
+# host-side from the device's group-contiguous row order (the
+# reference's ArrayAggregationFunction and MapAggregationFunction
+# likewise build their Blocks on the heap). Finalized by
+# _collect_column.
+_COLLECT_KINDS = (
+    "array_agg", "map_agg", "multimap_agg", "histogram",
+    "numeric_histogram", "approx_most_frequent", "map_union",
+    "bitwise_and_agg", "bitwise_or_agg", "bitwise_xor_agg",
+)
+
 HOLISTIC_KINDS = (
     "min_by", "max_by", "approx_percentile", "listagg", "approx_distinct",
     "pctl_merge",
-)
+) + _COLLECT_KINDS
+
+
+def _bht_histogram(vals, b: int):
+    """Ben-Haim/Tom-Tov streaming histogram, batch form: merge the two
+    closest centroids until <= b remain (the reference's
+    NumericHistogram, operator/aggregation/NumericHistogramAggregation).
+    Returns {centroid: weight} or None for empty input."""
+    if not vals or b <= 0:
+        return None
+    pts: List[List[float]] = []
+    for v in sorted(float(x) for x in vals):
+        if pts and pts[-1][0] == v:
+            pts[-1][1] += 1.0
+        else:
+            pts.append([v, 1.0])
+    while len(pts) > b:
+        bi, bgap = 0, float("inf")
+        for i in range(len(pts) - 1):
+            gap = pts[i + 1][0] - pts[i][0]
+            if gap < bgap:
+                bi, bgap = i, gap
+        (v1, c1), (v2, c2) = pts[bi], pts[bi + 1]
+        pts[bi] = [(v1 * c1 + v2 * c2) / (c1 + c2), c1 + c2]
+        del pts[bi + 1]
+    return {v: c for v, c in pts}
 
 
 def minmax_neutral(dtype, kind: str):
@@ -1728,6 +1772,11 @@ class HashAggregationOperator(Operator):
                     a, keys, valids, live, xcol, cap
                 )
                 continue
+            elif a.kind in _COLLECT_KINDS:
+                agg_cols[i] = self._collect_column(
+                    a, keys, valids, live, mega, cap
+                )
+                continue
             elif a.kind == "approx_distinct":
                 cnts_d = G.grouped_count_distinct(
                     tuple(keys), tuple(valids), live,
@@ -1761,13 +1810,117 @@ class HashAggregationOperator(Operator):
             out_cols.append(agg_cols[i])
         if self._global:
             # global aggregation over empty input still yields ONE row
-            # (counts 0, other aggregates NULL) — slot 0 carries it
+            # (counts 0, other aggregates NULL) — slot 0 carries it.
+            # Nested (map/array) outputs slice through gather: rebuilding
+            # a flat Column from .data would drop their starts/flat
+            # arrays (the lengths array alone is not the value)
+            pos = jnp.zeros(1, dtype=jnp.int32)
             return RelBatch(
-                [Column(c.type, c.data[:1], None if c.valid is None
-                        else c.valid[:1], c.dictionary) for c in out_cols],
+                [c.gather(pos) if c.type.is_nested
+                 or c.type.kind == T.TypeKind.ARRAY
+                 else Column(c.type, c.data[:1], None if c.valid is None
+                             else c.valid[:1], c.dictionary)
+                 for c in out_cols],
                 jnp.ones(1, dtype=jnp.bool_),
             )
         return RelBatch(out_cols, used)
+
+    def _collect_column(self, a: AggSpec, keys, valids, live, mega, cap):
+        """Collect-path aggregates (array_agg/map_agg/histogram/...):
+        the device delivers group-contiguous, value-ordered row order
+        (ops/groupby.grouped_rows_order); the host assembles each
+        group's container. Holistic by construction — the fragmenter
+        runs these single-step after a gather, exactly like listagg
+        (reference: ArrayAggregationFunction / MapAggregationFunction /
+        Histogram build their result Blocks on the heap too)."""
+        xcol = mega.columns[a.arg_channel]
+        gid, sm, order, n_groups, overflowed = G.grouped_rows_order(
+            tuple(keys), tuple(valids), live, xcol.data, xcol.valid, cap
+        )
+        gid_h, sm_h, ord_h, n_h, ov_h = jax.device_get(
+            (gid, sm, order, n_groups, overflowed)
+        )
+        if bool(ov_h):
+            # the finish loop settles capacity through sort_group_reduce
+            # before holistic finalizers run, so this cannot fire unless
+            # that invariant breaks — fail loudly, not with a bad gather
+            raise RuntimeError("collect aggregate group overflow")
+        n_h = int(n_h)
+
+        def pyvals(ch):
+            lst = jax.device_get(mega.columns[ch]).to_pylist()
+            return [lst[i] for i in ord_h]
+
+        xs = pyvals(a.arg_channel)
+        ys = pyvals(a.arg2_channel) if a.arg2_channel is not None else None
+        groups: List[list] = [[] for _ in range(n_h)]
+        for j, (g, ok) in enumerate(zip(gid_h, sm_h)):
+            if ok and 0 <= g < n_h:
+                groups[g].append(
+                    (xs[j], ys[j]) if ys is not None else xs[j]
+                )
+
+        kind = a.kind
+        if kind in ("bitwise_and_agg", "bitwise_or_agg", "bitwise_xor_agg"):
+            op = {"bitwise_and_agg": lambda s, v: s & v,
+                  "bitwise_or_agg": lambda s, v: s | v,
+                  "bitwise_xor_agg": lambda s, v: s ^ v}[kind]
+            data = np.zeros(cap, dtype=np.int64)
+            valid = np.zeros(cap, dtype=bool)
+            for g, vals in enumerate(groups):
+                vals = [v for v in vals if v is not None]
+                if not vals:
+                    continue
+                acc = vals[0]
+                for v in vals[1:]:
+                    acc = op(acc, v)
+                # wrap to signed 64-bit (python ints are unbounded)
+                acc &= (1 << 64) - 1
+                data[g] = acc - (1 << 64) if acc >= (1 << 63) else acc
+                valid[g] = True
+            return Column(
+                T.BIGINT, jnp.asarray(data), jnp.asarray(valid), None
+            )
+
+        out_vals: List[object] = [None] * cap
+        for g, vals in enumerate(groups):
+            if kind == "array_agg":
+                # NULL elements are kept (the reference's array_agg does)
+                out_vals[g] = vals if vals else None
+            elif kind == "map_agg":
+                m = {k: v for k, v in vals if k is not None}
+                out_vals[g] = m or None
+            elif kind == "multimap_agg":
+                mm: Dict[object, list] = {}
+                for k, v in vals:
+                    if k is not None:
+                        mm.setdefault(k, []).append(v)
+                out_vals[g] = mm or None
+            elif kind == "histogram":
+                h: Dict[object, int] = {}
+                for v in vals:
+                    if v is not None:
+                        h[v] = h.get(v, 0) + 1
+                out_vals[g] = h or None
+            elif kind == "approx_most_frequent":
+                b = int(a.param or 3)
+                h = {}
+                for v in vals:
+                    if v is not None:
+                        h[v] = h.get(v, 0) + 1
+                top = sorted(h.items(), key=lambda kv: (-kv[1], str(kv[0])))
+                out_vals[g] = dict(top[:b]) or None
+            elif kind == "numeric_histogram":
+                out_vals[g] = _bht_histogram(
+                    [v for v in vals if v is not None], int(a.param or 10)
+                )
+            elif kind == "map_union":
+                merged: Dict[object, object] = {}
+                for m in vals:
+                    if m:
+                        merged.update(m)
+                out_vals[g] = merged or None
+        return Column.from_pylist(a.out_type, out_vals, capacity=cap)
 
     def _listagg_column(self, a: AggSpec, keys, valids, live, xcol, cap):
         """listagg/string_agg: concatenating group members into NEW

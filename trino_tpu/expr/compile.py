@@ -2150,6 +2150,23 @@ class ExprBinder:
             return self._bind_dict_table_nullable(
                 args[0], T.DOUBLE, ieeefn, jnp.float64
             )
+        if name == "checksum_hash":
+            # internal: per-row 62-bit value hash for checksum() — NULL
+            # hashes to a constant lane (never NULL itself) so the
+            # summing primitive includes every row, like the reference's
+            # ChecksumAggregationFunction hashing null positions
+            from trino_tpu.ops import hashing as H
+
+            a = args[0]
+            lut = H.dictionary_lut(getattr(a, "dictionary", None))
+
+            def ckfn(cols, valids, a=a, lut=lut):
+                d, v = a.fn(cols, valids)
+                if lut is not None:
+                    d = H.canonical_hash_input(d, jnp.asarray(lut))
+                return H.hash64([d], [v]), None
+
+            return Bound(T.BIGINT, ckfn)
         if name == "luhn_check":
             def luhn(s):
                 if not s or not s.isdigit():

@@ -404,6 +404,32 @@ for name, lo, hi, ret, desc in [
     ("max_by", 2, 2, "same", "value at the maximum of the second argument"),
     ("listagg", 1, 2, "varchar", "concatenated values"),
     ("string_agg", 1, 2, "varchar", "concatenated values"),
+    # r4 breadth: collect-path aggregates (host-assembled containers)
+    ("array_agg", 1, 1, "array(E)", "all values, NULLs included"),
+    ("map_agg", 2, 2, "map(K,V)", "map of key/value pairs"),
+    ("multimap_agg", 2, 2, "map(K,array(V))",
+     "map of keys to all their values"),
+    ("map_union", 1, 1, "map(K,V)", "union of the input maps"),
+    ("histogram", 1, 1, "map(E,bigint)", "value counts"),
+    ("numeric_histogram", 2, 3, "map(double,double)",
+     "approximate b-bucket histogram (Ben-Haim/Tom-Tov)"),
+    ("approx_most_frequent", 2, 3, "map(E,bigint)",
+     "top-b values by frequency"),
+    ("bitwise_and_agg", 1, 1, "bigint", "bitwise AND of all values"),
+    ("bitwise_or_agg", 1, 1, "bigint", "bitwise OR of all values"),
+    ("bitwise_xor_agg", 1, 1, "bigint", "bitwise XOR of all values"),
+    # r4 breadth: moment-sum composites
+    ("checksum", 1, 1, "bigint",
+     "order-insensitive 64-bit checksum (rendered as bigint)"),
+    ("entropy", 1, 1, "double", "log-2 entropy of count weights"),
+    ("geometric_mean", 1, 1, "double", "geometric mean"),
+    ("regr_avgx", 2, 2, "double", "mean of x over non-null pairs"),
+    ("regr_avgy", 2, 2, "double", "mean of y over non-null pairs"),
+    ("regr_count", 2, 2, "bigint", "count of non-null pairs"),
+    ("regr_r2", 2, 2, "double", "coefficient of determination"),
+    ("regr_sxx", 2, 2, "double", "sum of squares of x"),
+    ("regr_sxy", 2, 2, "double", "sum of products x*y"),
+    ("regr_syy", 2, 2, "double", "sum of squares of y"),
 ]:
     _reg(name, "aggregate", lo, hi, ret, desc)
 
@@ -419,6 +445,7 @@ for name, lo, hi, ret, desc in [
     ("lag", 1, 3, "same", "value at a preceding row"),
     ("first_value", 1, 1, "same", "first value of the frame"),
     ("last_value", 1, 1, "same", "last value of the frame"),
+    ("nth_value", 2, 2, "same", "value at offset n within the frame"),
 ]:
     _reg(name, "window", lo, hi, ret, desc)
 

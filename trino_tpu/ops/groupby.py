@@ -1230,11 +1230,12 @@ def grouped_count_distinct(keys, valids, mask, x, x_valid, out_capacity):
 
 
 @partial(jax.jit, static_argnames=("out_capacity",))
-def grouped_rows_sorted(keys, valids, mask, x, x_valid, out_capacity):
-    """Rows grouped and value-ordered for HOST-side assembly (listagg:
-    building new strings is host work by nature — Trino's
-    ListaggAggregationFunction builds its VARCHAR on the heap too).
-    Returns (dense_gid_per_sorted_row, weight, sorted_x, n_groups,
+def grouped_rows_order(keys, valids, mask, x, x_valid, out_capacity):
+    """Rows grouped and value-ordered for HOST-side assembly, returned
+    as a row ORDER so the assembler (array_agg, map_agg, histogram —
+    the collect-path aggregates) can gather ANY number of argument
+    columns into the same group-contiguous, value-ordered layout.
+    Returns (dense_gid_per_sorted_row, group_live, order, n_groups,
     overflowed); dense gids index sort_group_reduce's compacted slots
     1:1 (same sort chain, same segment ordering)."""
     n = mask.shape[0]
@@ -1256,5 +1257,19 @@ def grouped_rows_sorted(keys, valids, mask, x, x_valid, out_capacity):
         _segment_bounds(sk, sv, sm, n, out_capacity)
     )
     gid = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    return gid, sm, order, n_groups, overflowed
+
+
+def grouped_rows_sorted(keys, valids, mask, x, x_valid, out_capacity):
+    """grouped_rows_order with the value column pre-gathered (listagg:
+    building new strings is host work by nature — Trino's
+    ListaggAggregationFunction builds its VARCHAR on the heap too).
+    Returns (dense_gid_per_sorted_row, weight, sorted_x, n_groups,
+    overflowed)."""
+    gid, sm, order, n_groups, overflowed = grouped_rows_order(
+        keys, valids, mask, x, x_valid, out_capacity
+    )
+    n = mask.shape[0]
+    xv = jnp.ones(n, dtype=jnp.bool_) if x_valid is None else x_valid
     w = sm & take_clip(xv, order)
     return gid, w, take_clip(x, order), n_groups, overflowed

@@ -258,6 +258,24 @@ def last_value(vals, valid, part_start, peer_start, frame: str):
     return value_at(vals, valid, _peer_end_index(part_start, peer_start))
 
 
+def nth_value(vals, valid, part_start, peer_start, frame: str, n: int):
+    """nth_value(x, n): frame-start + (n-1), NULL when the frame holds
+    fewer than n rows. Frame-end selection mirrors last_value."""
+    n_rows = vals.shape[0]
+    start = _seg_start_index(part_start)
+    if frame == "rows":
+        end = jnp.arange(n_rows, dtype=jnp.int32)
+    elif frame == "partition":
+        end = _seg_end_index(part_start)
+    else:
+        end = _peer_end_index(part_start, peer_start)
+    idx = start + jnp.int32(n - 1)
+    data, v = value_at(vals, valid, jnp.minimum(idx, end))
+    in_frame = idx <= end
+    vv = in_frame if v is None else (v & in_frame)
+    return data, vv
+
+
 def ntile(n_buckets: int, part_start: jnp.ndarray) -> jnp.ndarray:
     """ntile(n): bucket 1..n by position within the partition."""
     rn = row_number(part_start) - 1

@@ -321,7 +321,8 @@ class _FragVisitor:
     def _agg_specs(self, node) -> Tuple[AggSpec, ...]:
         return tuple(
             AggSpec(a.kind, a.arg_channel, a.out_type, a.distinct,
-                    a.arg2_channel, a.percentile, a.separator)
+                    a.arg2_channel, a.percentile, a.separator,
+                    a.arg3_channel, a.param)
             for a in node.aggs
         )
 
@@ -650,7 +651,8 @@ class _FragVisitor:
         for spec, (data, valid) in zip(node.functions, out_cols):
             d = None
             if spec.arg_channel is not None and spec.kind in (
-                "lead", "lag", "first_value", "last_value", "min", "max"
+                "lead", "lag", "first_value", "last_value", "nth_value",
+                "min", "max"
             ):
                 d = s_cols[spec.arg_channel].dictionary
             cols.append(Column(spec.out_type, data, valid, d))

@@ -61,6 +61,11 @@ COMPOSITE_AGG_FUNCS = {
     "skewness", "kurtosis",
     "geometric_mean", "count_if", "bool_and", "bool_or", "every",
     "corr", "covar_pop", "covar_samp", "regr_slope", "regr_intercept",
+    # r4 breadth: the full regression family (DoubleRegressionAggregation)
+    # plus entropy/checksum — all derivable from the same moment sums
+    "regr_avgx", "regr_avgy", "regr_count", "regr_r2",
+    "regr_sxx", "regr_sxy", "regr_syy",
+    "entropy", "checksum",
 }
 # Holistic aggregates: need the raw rows (order statistics), so the
 # fragmenter runs them single-step after a gather and the operator
@@ -483,7 +488,10 @@ class ExprConverter:
             if isinstance(a, ir.Literal) and a.type.is_string:
                 if a.value is None:
                     return ir.Literal(None, T.DATE)
-                return ir.Literal(_date_days(str(a.value)), T.DATE)
+                try:
+                    return ir.Literal(_date_days(str(a.value)), T.DATE)
+                except ValueError:
+                    raise AnalysisError(f"invalid date: {a.value!r}")
             return ir.Cast(a, T.DATE)
         if name in ("rand", "random"):
             args = tuple(self.convert(a) for a in e.args)
@@ -492,6 +500,18 @@ class ExprConverter:
             return ir.Call(
                 "rand", args, T.DOUBLE if not args else T.BIGINT
             )
+        if name == "from_base":
+            # validate the constant radix HERE (analysis time) and fall
+            # through to the registry for typing — the binder twin's
+            # check would surface as a raw ValueError mid-execution
+            if len(e.args) == 2:
+                r = self.convert(e.args[1])
+                if isinstance(r, ir.Literal) and r.value is not None \
+                        and not 2 <= int(r.value) <= 36:
+                    raise AnalysisError(
+                        "from_base() radix must be in [2, 36]"
+                    )
+            return None
         if name == "position":
             if len(e.args) != 2:
                 raise AnalysisError("position() takes two arguments")
@@ -1276,7 +1296,7 @@ def _scalar_subqueries(e: ast.Expression) -> List[ast.ScalarSubquery]:
 
 WINDOW_ONLY_FUNCS = {
     "row_number", "rank", "dense_rank", "percent_rank", "cume_dist",
-    "ntile", "lead", "lag", "first_value", "last_value",
+    "ntile", "lead", "lag", "first_value", "last_value", "nth_value",
 }
 
 
@@ -2910,6 +2930,80 @@ class Analyzer:
                 )
                 per_call.append(("plain", len(aggs) - 1))
                 continue
+            if kind in ("array_agg", "histogram", "map_union",
+                        "bitwise_and_agg", "bitwise_or_agg",
+                        "bitwise_xor_agg"):
+                if len(call.args) != 1 or distinct:
+                    raise AnalysisError(f"{kind}(x) takes one argument")
+                x = conv.convert(call.args[0])
+                if kind == "array_agg":
+                    out_t = T.array_of(x.type)
+                elif kind == "histogram":
+                    out_t = T.map_of(x.type, T.BIGINT)
+                elif kind == "map_union":
+                    if not x.type.is_map:
+                        raise AnalysisError("map_union() aggregates maps")
+                    out_t = x.type
+                else:
+                    if x.type.is_string or x.type.is_nested or \
+                            x.type.kind == T.TypeKind.ARRAY:
+                        raise AnalysisError(
+                            f"{kind}() aggregates integer values"
+                        )
+                    out_t = T.BIGINT
+                x_ch = len(pre_exprs)
+                pre_exprs.append(x)
+                aggs.append(P.AggCall(kind, x_ch, out_t))
+                per_call.append(("plain", len(aggs) - 1))
+                continue
+            if kind in ("map_agg", "multimap_agg"):
+                if len(call.args) != 2 or distinct:
+                    raise AnalysisError(f"{kind}(k, v) takes two arguments")
+                k = conv.convert(call.args[0])
+                v = conv.convert(call.args[1])
+                out_t = (T.map_of(k.type, v.type) if kind == "map_agg"
+                         else T.map_of(k.type, T.array_of(v.type)))
+                k_ch = len(pre_exprs)
+                pre_exprs.append(k)
+                v_ch = len(pre_exprs)
+                pre_exprs.append(v)
+                aggs.append(
+                    P.AggCall(kind, k_ch, out_t, arg2_channel=v_ch)
+                )
+                per_call.append(("plain", len(aggs) - 1))
+                continue
+            if kind in ("numeric_histogram", "approx_most_frequent"):
+                # (buckets, x[, capacity]) — buckets must be constant;
+                # the trailing capacity argument is accepted and ignored
+                # (the collect path is exact within the gathered rows)
+                lo, hi = (2, 3)
+                if not lo <= len(call.args) <= hi or distinct:
+                    raise AnalysisError(
+                        f"{kind}(buckets, x[, capacity]) arguments"
+                    )
+                b = _const_fold(conv.convert(call.args[0]))
+                if b is None or b.value is None:
+                    raise AnalysisError(
+                        f"{kind}() bucket count must be a constant"
+                    )
+                if int(b.value) < 1:
+                    raise AnalysisError(
+                        f"{kind}() bucket count must be positive"
+                    )
+                x = conv.convert(call.args[1])
+                if kind == "numeric_histogram":
+                    if x.type.kind != T.TypeKind.DOUBLE:
+                        x = ir.Cast(x, T.DOUBLE)
+                    out_t = T.map_of(T.DOUBLE, T.DOUBLE)
+                else:
+                    out_t = T.map_of(x.type, T.BIGINT)
+                x_ch = len(pre_exprs)
+                pre_exprs.append(x)
+                aggs.append(
+                    P.AggCall(kind, x_ch, out_t, param=float(b.value))
+                )
+                per_call.append(("plain", len(aggs) - 1))
+                continue
             if kind in ("listagg", "string_agg"):
                 if len(call.args) != 2 or distinct:
                     raise AnalysisError(
@@ -3220,6 +3314,127 @@ class Analyzer:
 
         # two-argument covariance family: rows where EITHER argument is
         # NULL are excluded from every moment (pairwise masking)
+        if kind == "entropy":
+            # -sum(c/S * log2(c/S)) = (ln(S) - sum(c ln c)/S) / ln 2,
+            # from two plain sums (the reference's EntropyAggregation
+            # keeps the same two-moment state)
+            if len(call.args) != 1:
+                raise AnalysisError("entropy(c) takes one argument")
+            c0 = dbl(conv.convert(call.args[0]))
+            bad_in = ir.comparison("lt", c0, lit(0))
+            c = ir.Case((bad_in,), (ir.Literal(None, T.DOUBLE),), c0,
+                        T.DOUBLE)
+            s_i = add_prim("sum", c, T.DOUBLE)
+            clnc = mul(c, ir.Case(
+                (ir.comparison("le", c, lit(0)),), (lit(0),),
+                ir.call("ln", T.DOUBLE, c), T.DOUBLE,
+            ))
+            slnc_i = add_prim("sum", clnc, T.DOUBLE)
+
+            def fin_entropy(ref):
+                s = ref(s_i)
+                ent = div(
+                    sub(ir.call("ln", T.DOUBLE, s), div(ref(slnc_i), s)),
+                    lit(math.log(2.0)),
+                )
+                zero = ir.or_(
+                    ir.is_null(s), ir.comparison("le", s, lit(0))
+                )
+                return ir.Case((zero,), (lit(0),), ent, T.DOUBLE)
+
+            return ("comp", fin_entropy, T.DOUBLE)
+        if kind == "checksum":
+            # order-insensitive 64-bit checksum: wrapping sum of per-row
+            # value hashes (the reference's ChecksumAggregationFunction
+            # sums XxHash64 values; rendered as BIGINT here — the
+            # varbinary carrier documents this divergence)
+            if len(call.args) != 1:
+                raise AnalysisError("checksum(x) takes one argument")
+            x = conv.convert(call.args[0])
+            if x.type.is_nested or x.type.kind == T.TypeKind.ARRAY:
+                raise AnalysisError(
+                    "checksum() over nested types is not supported"
+                )
+            h = ir.Call("checksum_hash", (x,), T.BIGINT)
+            i = add_prim("sum", h, T.BIGINT)
+            return ("comp", lambda ref, i=i: ref(i), T.BIGINT)
+        if kind in ("regr_avgx", "regr_avgy", "regr_count", "regr_r2",
+                    "regr_sxx", "regr_sxy", "regr_syy"):
+            if len(call.args) != 2:
+                raise AnalysisError(f"{kind}(y, x) takes two arguments")
+            y0 = dbl(conv.convert(call.args[0]))
+            x0 = dbl(conv.convert(call.args[1]))
+            both = ir.and_(ir.not_(ir.is_null(y0)), ir.not_(ir.is_null(x0)))
+
+            def masked(ex):
+                return ir.Case((both,), (ex,), ir.Literal(None, T.DOUBLE),
+                               T.DOUBLE)
+
+            y, x = masked(y0), masked(x0)
+            n_i = add_prim("count", y, T.BIGINT)
+            if kind == "regr_count":
+                return ("comp", lambda ref, i=n_i: ref(i), T.BIGINT)
+            sy_i = add_prim("sum", y, T.DOUBLE)
+            sx_i = add_prim("sum", x, T.DOUBLE)
+
+            def zero_guard(ref, value):
+                return guard(
+                    ir.comparison("eq", ref(n_i), ir.Literal(0, T.BIGINT)),
+                    value,
+                )
+
+            if kind == "regr_avgx":
+                return ("comp", lambda ref: zero_guard(
+                    ref, div(ref(sx_i), dbl(ref(n_i)))), T.DOUBLE)
+            if kind == "regr_avgy":
+                return ("comp", lambda ref: zero_guard(
+                    ref, div(ref(sy_i), dbl(ref(n_i)))), T.DOUBLE)
+            sxy_i = add_prim("sum", mul(y, x), T.DOUBLE)
+            sxx_i = add_prim("sum", mul(x, x), T.DOUBLE)
+            if kind == "regr_sxy":
+                return ("comp", lambda ref: zero_guard(ref, sub(
+                    ref(sxy_i),
+                    div(mul(ref(sx_i), ref(sy_i)), dbl(ref(n_i))),
+                )), T.DOUBLE)
+            if kind == "regr_sxx":
+                return ("comp", lambda ref: zero_guard(ref, nneg(sub(
+                    ref(sxx_i),
+                    div(mul(ref(sx_i), ref(sx_i)), dbl(ref(n_i))),
+                ))), T.DOUBLE)
+            syy_i = add_prim("sum", mul(y, y), T.DOUBLE)
+            if kind == "regr_syy":
+                return ("comp", lambda ref: zero_guard(ref, nneg(sub(
+                    ref(syy_i),
+                    div(mul(ref(sy_i), ref(sy_i)), dbl(ref(n_i))),
+                ))), T.DOUBLE)
+
+            # regr_r2: square of corr; vx == 0 -> NULL, vy == 0 -> 1
+            def fin_r2(ref):
+                n = dbl(ref(n_i))
+                vx = nneg(
+                    sub(ref(sxx_i), div(mul(ref(sx_i), ref(sx_i)), n))
+                )
+                vy = nneg(
+                    sub(ref(syy_i), div(mul(ref(sy_i), ref(sy_i)), n))
+                )
+                cxy = sub(ref(sxy_i), div(mul(ref(sx_i), ref(sy_i)), n))
+                r2 = div(mul(cxy, cxy), mul(vx, vy))
+                return ir.Case(
+                    (
+                        ir.or_(
+                            ir.comparison(
+                                "eq", ref(n_i), ir.Literal(0, T.BIGINT)
+                            ),
+                            ir.comparison("le", vx, lit(0)),
+                        ),
+                        ir.comparison("le", vy, lit(0)),
+                    ),
+                    (ir.Literal(None, T.DOUBLE), lit(1)),
+                    r2,
+                    T.DOUBLE,
+                )
+
+            return ("comp", fin_r2, T.DOUBLE)
         if kind in ("corr", "covar_pop", "covar_samp", "regr_slope",
                     "regr_intercept"):
             if len(call.args) != 2:
@@ -3442,6 +3657,20 @@ class Analyzer:
             ch = channel_of(c.args[0])
             t = conv.convert(c.args[0]).type
             return P.WindowFuncSpec(name, ch, t)
+        if name == "nth_value":
+            if len(c.args) != 2:
+                raise AnalysisError("nth_value(x, n) takes two arguments")
+            a1 = c.args[1]
+            if not isinstance(a1, ast.NumberLiteral) or not a1.text.isdigit():
+                raise AnalysisError(
+                    "nth_value() offset must be a literal positive integer"
+                )
+            n = int(a1.text)
+            if n < 1:
+                raise AnalysisError("nth_value() offset must be >= 1")
+            ch = channel_of(c.args[0])
+            t = conv.convert(c.args[0]).type
+            return P.WindowFuncSpec(name, ch, t, offset=n)
         if name == "count":
             if not c.args or isinstance(c.args[0], ast.Star):
                 return P.WindowFuncSpec("count_star", None, T.BIGINT)
@@ -3562,6 +3791,10 @@ def _validate_array_usage(node: P.PlanNode) -> None:
     if isinstance(node, P.AggregateNode):
         check(node.child, node.group_channels, "grouping keys")
         for a in node.aggs:
+            if a.kind in ("map_union", "array_agg"):
+                # collect-path aggregates consume the nested VALUE
+                # host-side (no value-wise device operator needed)
+                continue
             for ch in (a.arg_channel, a.arg2_channel):
                 if ch is not None and node.child.fields[ch].type.is_nested:
                     bad("aggregate arguments")

@@ -244,7 +244,8 @@ class LocalPlanner:
         specs = [
             AggSpec(a.kind, a.arg_channel, a.out_type,
                     arg2_channel=a.arg2_channel, percentile=a.percentile,
-                    separator=a.separator, arg3_channel=a.arg3_channel)
+                    separator=a.separator, arg3_channel=a.arg3_channel,
+                    param=a.param)
             for a in node.aggs
         ]
         groups = list(node.group_channels)

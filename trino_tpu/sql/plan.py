@@ -87,6 +87,7 @@ class AggCall:
     percentile: Optional[float] = None
     separator: Optional[str] = None  # listagg
     arg3_channel: Optional[int] = None  # pctl_merge bucket-max channel
+    param: Optional[float] = None  # numeric_histogram/approx_most_frequent b
 
 
 @dataclasses.dataclass(frozen=True)
