@@ -1,0 +1,160 @@
+"""r4 function-breadth batch 3: sketch functions (HyperLogLog/TDigest on
+the varchar carrier), regexp array functions, format, array folds, and
+the SHOW FUNCTIONS catalog gate (VERDICT r3 item 7: >= 400 rows)."""
+
+import numpy as np
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.connectors.memory import MemoryConnector
+from trino_tpu.connectors.spi import ColumnMetadata
+from trino_tpu.engine import LocalQueryRunner, Session
+
+N = 5000
+
+
+@pytest.fixture(scope="module")
+def runner():
+    conn = MemoryConnector()
+    rng = np.random.default_rng(0)
+    g = rng.integers(0, 3, N).astype(np.int64)
+    v = rng.integers(0, 1000, N).astype(np.int64)
+    conn.load_table(
+        "default", "t",
+        [ColumnMetadata("g", T.BIGINT), ColumnMetadata("v", T.BIGINT)],
+        [g, v],
+    )
+    r = LocalQueryRunner(Session(catalog="memory", schema="default"))
+    r.register_catalog("memory", conn)
+    return r, g, v
+
+
+def one(r, sql):
+    return r.execute(sql).rows[0][0]
+
+
+class TestHyperLogLog:
+    def test_grouped_estimate_within_error(self, runner):
+        r, g, v = runner
+        import pandas as pd
+
+        true = pd.DataFrame({"g": g, "v": v}).groupby("g").v.nunique()
+        rows = r.execute("select g, cardinality(approx_set(v)) "
+                         "from t group by g order by g").rows
+        for (grp, est) in rows:
+            t = true[grp]
+            assert abs(est - t) / t < 0.05  # p=12 -> ~1.6% stderr
+
+    def test_merge_of_group_sketches(self, runner):
+        r, g, v = runner
+        est = one(r, "select cardinality(merge(s)) from "
+                     "(select approx_set(v) s from t group by g)")
+        true = len(set(v.tolist()))
+        assert abs(est - true) / true < 0.05
+
+    def test_empty_approx_set(self, runner):
+        r, _, _ = runner
+        assert one(r, "select cardinality(empty_approx_set())") == 0
+
+    def test_digest_is_inspectable(self, runner):
+        r, _, _ = runner
+        assert one(r, "select approx_set(v) from t").startswith("hll:")
+
+
+class TestTDigest:
+    def test_median(self, runner):
+        r, _, v = runner
+        got = one(r, "select value_at_quantile(tdigest_agg(v), 0.5) from t")
+        assert abs(got - float(np.median(v))) < 15
+
+    def test_tail_quantile(self, runner):
+        r, _, v = runner
+        got = one(r, "select value_at_quantile(tdigest_agg(v), 0.99) from t")
+        assert abs(got - float(np.quantile(v, 0.99))) < 15
+
+    def test_merge_of_group_digests(self, runner):
+        r, _, v = runner
+        got = one(r, "select value_at_quantile(merge(d), 0.5) from "
+                     "(select tdigest_agg(v) d from t group by g)")
+        assert abs(got - float(np.median(v))) < 20
+
+    def test_quantile_at_value_roundtrip(self, runner):
+        r, _, v = runner
+        q = one(r, "select quantile_at_value(tdigest_agg(v), 500.0) from t")
+        assert abs(q - 0.5) < 0.03
+
+    def test_accessor_over_table_column(self, runner):
+        r, _, v = runner
+        conn = MemoryConnector()
+        digest = one(r, "select tdigest_agg(v) from t")
+        conn.load_table("default", "d", [ColumnMetadata("d", T.VARCHAR)],
+                        [[digest]])
+        r2 = LocalQueryRunner(Session(catalog="m2", schema="default"))
+        r2.register_catalog("m2", conn)
+        got = one(r2, "select value_at_quantile(d, 0.5) from d")
+        assert abs(got - float(np.median(v))) < 15
+
+
+class TestRegexpArrays:
+    def test_regexp_split(self, runner):
+        r, _, _ = runner
+        assert one(r, "select regexp_split('a1b22c', '[0-9]+')") == \
+            ["a", "b", "c"]
+
+    def test_regexp_extract_all(self, runner):
+        r, _, _ = runner
+        assert one(r, "select regexp_extract_all('a1b22c333', '[0-9]+')") \
+            == ["1", "22", "333"]
+        assert one(r, "select regexp_extract_all('a1b2', '([a-z])[0-9]', 1)"
+                   ) == ["a", "b"]
+
+    def test_no_match_is_empty_array(self, runner):
+        r, _, _ = runner
+        assert one(r, "select cardinality("
+                      "regexp_extract_all('xyz', '[0-9]'))") == 0
+
+
+class TestMiscBreadth:
+    def test_format(self, runner):
+        r, _, _ = runner
+        assert one(r, "select format('%s=%d (%.1f%%)', 'x', 7, 2.5)") == \
+            "x=7 (2.5%)"
+
+    def test_contains_sequence(self, runner):
+        r, _, _ = runner
+        assert one(r, "select contains_sequence(array[1,2,3,4], "
+                      "array[2,3])") is True
+        assert one(r, "select contains_sequence(array[1,2,3,4], "
+                      "array[2,4])") is False
+
+    def test_shuffle_permutes(self, runner):
+        r, _, _ = runner
+        got = one(r, "select array_sort(shuffle(array[3,1,2]))")
+        assert got == [1, 2, 3]
+
+    def test_array_reverse_and_concat(self, runner):
+        r, _, _ = runner
+        assert one(r, "select reverse(array[1,2,3])") == [3, 2, 1]
+        assert one(r, "select concat(array[1,2], array[3])") == [1, 2, 3]
+
+    def test_date_format_and_to_char(self, runner):
+        r, _, _ = runner
+        assert one(r, "select date_format(timestamp '2020-05-06 07:08:09'"
+                      ", '%Y-%m-%d %H:%i:%s')") == "2020-05-06 07:08:09"
+        assert one(r, "select to_char(date '2021-02-03', 'yyyy/mm/dd')") \
+            == "2021/02/03"
+
+    def test_map_keys_values_registered(self, runner):
+        r, _, _ = runner
+        rows = r.execute("show functions").rows
+        names = {row[0] for row in rows}
+        assert {"map_keys", "map_values", "regexp_split", "approx_set",
+                "tdigest_agg", "merge", "nth_value"} <= names
+
+
+def test_show_functions_meets_target(runner):
+    """VERDICT r3 item 7: SHOW FUNCTIONS >= 400 rows. Rows are the
+    reference's unit — one per callable name, alias, and concrete
+    per-type overload (registry.FunctionMetadata.overloads)."""
+    r, _, _ = runner
+    assert len(r.execute("show functions").rows) >= 400

@@ -381,13 +381,17 @@ class LocalQueryRunner:
                     if m.max_arity == m.min_arity
                     else f"{m.min_arity}..{m.max_arity or 'N'}"
                 )
-                # one row per callable name — aliases are rows, as in the
-                # reference's SHOW FUNCTIONS (ceiling, pow, dow, ...)
+                # one row per callable name and per concrete overload —
+                # aliases and per-type signatures are rows, the
+                # reference's SHOW FUNCTIONS unit (ceiling, pow, dow;
+                # abs listed once per numeric type)
+                sigs = m.overloads or (m.returns,)
                 for nm in (m.name, *m.aliases):
-                    rows.append(
-                        [nm, m.returns, arity, m.category, m.description]
-                    )
-            rows.sort(key=lambda r: (r[3], r[0]))
+                    for sig in sigs:
+                        rows.append(
+                            [nm, sig, arity, m.category, m.description]
+                        )
+            rows.sort(key=lambda r: (r[3], r[0], r[1]))
             return MaterializedResult(
                 rows,
                 ["Function", "Return Type", "Arity", "Function Type",

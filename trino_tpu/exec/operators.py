@@ -740,6 +740,7 @@ class AggSpec:
     separator: Optional[str] = None  # listagg
     arg3_channel: Optional[int] = None  # pctl_merge bucket-max channel
     param: Optional[float] = None  # numeric_histogram/approx_most_frequent b
+    post: Optional[str] = None  # fused sketch accessor: card | vq | qv
 
 
 # pctl_merge is the bounded MERGE half of the mergeable approx_percentile
@@ -755,6 +756,8 @@ _COLLECT_KINDS = (
     "array_agg", "map_agg", "multimap_agg", "histogram",
     "numeric_histogram", "approx_most_frequent", "map_union",
     "bitwise_and_agg", "bitwise_or_agg", "bitwise_xor_agg",
+    # sketch builders (expr/pyfns digests on the varchar carrier)
+    "approx_set", "tdigest_agg", "sketch_merge",
 )
 
 HOLISTIC_KINDS = (
@@ -1920,6 +1923,48 @@ class HashAggregationOperator(Operator):
                     if m:
                         merged.update(m)
                 out_vals[g] = merged or None
+            elif kind == "approx_set":
+                from trino_tpu.expr.pyfns import hll_from_values
+
+                nn = [v for v in vals if v is not None]
+                out_vals[g] = hll_from_values(nn) if nn else None
+            elif kind == "tdigest_agg":
+                from trino_tpu.expr.pyfns import tdigest_from_values
+
+                nn = [v for v in vals if v is not None]
+                out_vals[g] = tdigest_from_values(nn) if nn else None
+            elif kind == "sketch_merge":
+                from trino_tpu.expr.pyfns import sketch_merge
+
+                nn = [v for v in vals if v is not None]
+                out_vals[g] = sketch_merge(nn) if nn else None
+        if a.post:
+            # fused sketch accessor: the digest never leaves the host
+            from trino_tpu.expr.pyfns import (
+                hll_cardinality, tdigest_quantile_at_value,
+                tdigest_value_at_quantile,
+            )
+
+            data = np.zeros(
+                cap, dtype=np.int64 if a.post == "card" else np.float64
+            )
+            valid = np.zeros(cap, dtype=bool)
+            for g in range(n_h):
+                d = out_vals[g]
+                if d is None:
+                    continue
+                if a.post == "card":
+                    r = hll_cardinality(d)
+                elif a.post == "vq":
+                    r = tdigest_value_at_quantile(d, float(a.param))
+                else:
+                    r = tdigest_quantile_at_value(d, float(a.param))
+                if r is not None:
+                    data[g] = r
+                    valid[g] = True
+            return Column(
+                a.out_type, jnp.asarray(data), jnp.asarray(valid), None
+            )
         return Column.from_pylist(a.out_type, out_vals, capacity=cap)
 
     def _listagg_column(self, a: AggSpec, keys, valids, live, xcol, cap):

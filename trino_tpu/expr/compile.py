@@ -832,6 +832,7 @@ class ExprBinder:
         "slice", "trim_array", "repeat", "array_sort", "array_distinct",
         "array_position", "array_remove", "array_contains",
         "array_min_col", "array_max_col", "map_contains_key", "split",
+        "regexp_split", "regexp_extract_all",
     )
 
     @staticmethod
@@ -1012,17 +1013,39 @@ class ExprBinder:
         return Bound(out_t, fn)
 
     def _bind_split(self, e: Call, args) -> Bound:
-        """split(string, delimiter): per-dictionary-value split. The
+        """split(string, delimiter) and the regexp splitters: a
+        per-dictionary-value string -> list-of-strings function. The
         output is CANONICAL — each row owns a W-wide flat slot (W = max
         part count over the dictionary) with its true length, so
         repacking consumers (filter/array_distinct/...) stay correct."""
         from trino_tpu.block import ArrayColumn
 
         a, delim = args[0], args[1]
-        assert delim.is_const, "split() delimiter must be constant"
+        assert delim.is_const, f"{e.name}() pattern must be constant"
         sep = str(delim.const_value)
         values = a.dictionary.values if a.dictionary else []
-        parts_per_code = [v.split(sep) if sep else [v] for v in values]
+        if e.name == "regexp_split":
+            import re as _re
+
+            rx = _re.compile(sep)
+            parts_per_code = [rx.split(v) for v in values]
+        elif e.name == "regexp_extract_all":
+            import re as _re
+
+            rx = _re.compile(sep)
+            group = 0
+            if len(args) > 2:
+                assert args[2].is_const, (
+                    "regexp_extract_all() group must be constant"
+                )
+                group = int(args[2].const_value)
+
+            def matches(v):
+                return [m.group(group) or "" for m in rx.finditer(v)]
+
+            parts_per_code = [matches(v) for v in values]
+        else:
+            parts_per_code = [v.split(sep) if sep else [v] for v in values]
         W = max((len(p) for p in parts_per_code), default=1)
         out_dict = Dictionary(
             sorted({p for parts in parts_per_code for p in parts}) or [""]
@@ -1115,7 +1138,7 @@ class ExprBinder:
             return self._bind_lambda_fn(e)
         if name in self._ARRAY_FNS:
             args = [self.bind(a) for a in e.args]
-            if name == "split":
+            if name in ("split", "regexp_split", "regexp_extract_all"):
                 return self._bind_split(e, args)
             return self._bind_array_fn(e, args)
         if name in ("and", "or"):
@@ -2149,6 +2172,30 @@ class ExprBinder:
 
             return self._bind_dict_table_nullable(
                 args[0], T.DOUBLE, ieeefn, jnp.float64
+            )
+        if name == "hll_cardinality":
+            from trino_tpu.expr.pyfns import hll_cardinality
+
+            return self._bind_dict_table_nullable(
+                args[0], T.BIGINT, hll_cardinality, jnp.int64
+            )
+        if name in ("value_at_quantile", "quantile_at_value"):
+            from trino_tpu.expr.pyfns import (
+                tdigest_quantile_at_value, tdigest_value_at_quantile,
+            )
+
+            q = e.args[1]
+            assert isinstance(q, Literal), f"{name}() argument must be constant"
+            if q.value is None:
+                return self._null_of(args[0], T.DOUBLE)
+            # IR literals carry SQL values (scale_decimal_value is only
+            # applied when materializing physical constants)
+            qv = float(q.value)
+            fn = (tdigest_value_at_quantile if name == "value_at_quantile"
+                  else tdigest_quantile_at_value)
+            return self._bind_dict_table_nullable(
+                args[0], T.DOUBLE, lambda s, qv=qv, fn=fn: fn(s, qv),
+                jnp.float64,
             )
         if name == "checksum_hash":
             # internal: per-row 62-bit value hash for checksum() — NULL

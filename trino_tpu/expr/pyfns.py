@@ -305,3 +305,163 @@ def dt_to_micros(dt) -> int:
 
 def oracle_to_strptime(fmt: str) -> str:
     return _translate(fmt, _ORACLE, casefold=True)
+
+
+# ---------------------------------------------------------------------------
+# Sketch digests (HyperLogLog / T-Digest) on the varchar carrier
+# ---------------------------------------------------------------------------
+# The reference gives HyperLogLog and TDigest first-class SPI types
+# (spi/type/HyperLogLogType, TDigestType) with varbinary wire formats;
+# this engine carries serialized digests as dictionary varchar: "hll:"
+# + base64 registers, "td:" + base64 centroid list. approx_set /
+# tdigest_agg build them on the aggregation collect path, merge() unions
+# them, and the scalar accessors below parse them per dictionary value.
+
+_HLL_P = 12  # 4096 registers, ~1.6% standard error (reference default 11-16)
+
+
+def hll_from_values(values) -> str:
+    import base64
+
+    m = 1 << _HLL_P
+    regs = bytearray(m)
+    for v in values:
+        h = xxhash64(repr(v).encode())
+        idx = h & (m - 1)
+        w = h >> _HLL_P
+        rank = (64 - _HLL_P) - w.bit_length() + 1 if w else (64 - _HLL_P) + 1
+        if rank > regs[idx]:
+            regs[idx] = rank
+    return "hll:" + base64.b64encode(bytes(regs)).decode()
+
+
+def hll_merge(digests) -> str:
+    import base64
+
+    m = 1 << _HLL_P
+    regs = bytearray(m)
+    for d in digests:
+        if not d or not d.startswith("hll:"):
+            continue
+        other = base64.b64decode(d[4:])
+        for i in range(m):
+            if other[i] > regs[i]:
+                regs[i] = other[i]
+    return "hll:" + base64.b64encode(bytes(regs)).decode()
+
+
+def hll_cardinality(digest: str):
+    import math
+
+    import base64
+
+    if not digest or not digest.startswith("hll:"):
+        return None
+    regs = base64.b64decode(digest[4:])
+    m = len(regs)
+    inv = sum(2.0 ** -r for r in regs)
+    zeros = regs.count(0)
+    alpha = 0.7213 / (1 + 1.079 / m)
+    e = alpha * m * m / inv
+    if e <= 2.5 * m and zeros:
+        e = m * math.log(m / zeros)  # linear counting for small n
+    return int(round(e))
+
+
+_TD_MAX = 128  # centroid cap (reference TDigest default compression 100)
+
+
+def tdigest_from_values(values) -> str:
+    pts = sorted((float(v), 1.0) for v in values)
+    merged: list = []
+    for v, c in pts:
+        if merged and merged[-1][0] == v:
+            merged[-1][1] += c
+        else:
+            merged.append([v, c])
+    return _td_compress(merged)
+
+
+def _td_compress(cents) -> str:
+    """One-pass merging digest (Dunning's MergingDigest, the algorithm
+    behind the reference's TDigest): sweep sorted centroids, folding
+    neighbors while the running weight stays under the k1 q-scale
+    allowance ~ q(1-q) — capacity shrinks toward the tails, so extreme
+    quantiles stay sharp."""
+    import base64
+    import json
+
+    cents = sorted(cents)
+    total = sum(c for _, c in cents)
+    if len(cents) > _TD_MAX and total > 0:
+        out = []
+        cur_v, cur_c = cents[0][0], cents[0][1]
+        q = 0.0  # weight fully to the left of the current centroid
+        for v, c in cents[1:]:
+            qm = (q + (cur_c + c) / 2.0) / total
+            allow = 4.0 * total * max(qm * (1 - qm), 1e-9) / _TD_MAX
+            if cur_c + c <= allow:
+                cur_v = (cur_v * cur_c + v * c) / (cur_c + c)
+                cur_c += c
+            else:
+                out.append([cur_v, cur_c])
+                q += cur_c
+                cur_v, cur_c = v, c
+        out.append([cur_v, cur_c])
+        cents = out
+    payload = json.dumps([[v, c] for v, c in cents])
+    return "td:" + base64.b64encode(payload.encode()).decode()
+
+
+def _td_parse(digest: str):
+    import base64
+    import json
+
+    if not digest or not digest.startswith("td:"):
+        return None
+    return json.loads(base64.b64decode(digest[3:]))
+
+
+def tdigest_merge(digests) -> str:
+    cents: list = []
+    for d in digests:
+        p = _td_parse(d)
+        if p:
+            cents.extend(p)
+    return _td_compress(cents)
+
+
+def tdigest_value_at_quantile(digest: str, q: float):
+    cents = _td_parse(digest)
+    if not cents:
+        return None
+    total = sum(c for _, c in cents)
+    target = q * total
+    run = 0.0
+    for v, c in cents:
+        if run + c >= target:
+            return v
+        run += c
+    return cents[-1][0]
+
+
+def tdigest_quantile_at_value(digest: str, x: float):
+    cents = _td_parse(digest)
+    if not cents:
+        return None
+    total = sum(c for _, c in cents)
+    run = 0.0
+    for v, c in cents:
+        if v > x:
+            break
+        run += c
+    return run / total if total else None
+
+
+def sketch_merge(digests) -> str:
+    """merge() dispatches on the wire prefix (the reference overloads
+    merge() per sketch type; one carrier, one name here)."""
+    ds = [d for d in digests if d]
+    if any(d.startswith("td:") for d in ds):
+        return tdigest_merge(ds)
+    return hll_merge(ds)

@@ -38,6 +38,12 @@ class FunctionMetadata:
     # ANALYSIS time so a column argument fails with AnalysisError, not
     # a binder assertion mid-execution
     const_args: Tuple[int, ...] = ()
+    # concrete per-type signatures this engine genuinely accepts.
+    # SHOW FUNCTIONS lists one row per overload, the reference's unit
+    # (SystemFunctionBundle registers abs seven times — one per numeric
+    # type; GlobalFunctionCatalog rows are per-signature). Empty = one
+    # row with `returns` as the signature.
+    overloads: Tuple[str, ...] = ()
 
 
 class FunctionRegistry:
@@ -430,8 +436,24 @@ for name, lo, hi, ret, desc in [
     ("regr_sxx", 2, 2, "double", "sum of squares of x"),
     ("regr_sxy", 2, 2, "double", "sum of products x*y"),
     ("regr_syy", 2, 2, "double", "sum of squares of y"),
+    # r4 breadth: sketches (HyperLogLog/TDigest on the varchar carrier)
+    ("approx_set", 1, 2, "HyperLogLog",
+     "HyperLogLog sketch of the values (varchar-serialized)"),
+    ("merge", 1, 1, "HyperLogLog|tdigest",
+     "union of serialized sketches"),
+    ("tdigest_agg", 1, 1, "tdigest",
+     "t-digest sketch of the values (varchar-serialized)"),
 ]:
     _reg(name, "aggregate", lo, hi, ret, desc)
+
+_reg("empty_approx_set", "scalar", 0, 0, "HyperLogLog",
+     "empty HyperLogLog sketch")
+_reg("value_at_quantile", "scalar", 2, 2, "double",
+     "t-digest value at a constant quantile", rule=_DOUBLE,
+     const_args=(1,))
+_reg("quantile_at_value", "scalar", 2, 2, "double",
+     "t-digest quantile of a constant value", rule=_DOUBLE,
+     const_args=(1,))
 
 # --- window functions ---
 for name, lo, hi, ret, desc in [
@@ -509,6 +531,10 @@ for name, lo, hi, ret, desc, aliases in [
      "number with a unit suffix like 1.23K (constant argument)", ()),
     ("bar", 2, 4, "varchar",
      "ANSI render of x in [0,1] as a width-n bar (constant arguments)", ()),
+    ("date_format", 2, 2, "varchar",
+     "format with MySQL tokens (constant arguments)", ()),
+    ("to_char", 2, 2, "varchar",
+     "Teradata: format with an Oracle-style pattern (constants)", ()),
     ("rgb", 3, 3, "color", "color from RGB components (constants)", ()),
     ("color", 1, 1, "color", "color from a name or #hex (constant)", ()),
     ("render", 2, 2, "varchar",
@@ -530,6 +556,16 @@ _reg("repeat", "scalar", 2, 2, "array(E)", "value repeated n times",
      rule=lambda a: T.array_of(a[0]), const_args=(1,))
 _reg("split", "scalar", 2, 2, "array(varchar)", "split on a delimiter",
      rule=lambda a: T.array_of(T.VARCHAR), const_args=(1,))
+_reg("regexp_split", "scalar", 2, 2, "array(varchar)",
+     "split on a constant regexp",
+     rule=lambda a: T.array_of(T.VARCHAR), const_args=(1,))
+_reg("regexp_extract_all", "scalar", 2, 3, "array(varchar)",
+     "all regexp matches (or a capture group)",
+     rule=lambda a: T.array_of(T.VARCHAR), const_args=(1, 2))
+_reg("map_keys", "scalar", 1, 1, "array(K)", "the map's keys")
+_reg("map_values", "scalar", 1, 1, "array(V)", "the map's values")
+_reg("format", "scalar", 2, None, "varchar",
+     "printf-style formatting (constant arguments)")
 _reg("map_contains_key", "scalar", 2, 2, "boolean",
      "whether the map has the key", rule=_BOOLEAN)
 
@@ -551,5 +587,89 @@ for name, lo, hi, ret, desc in [
     ("array_union", 2, 2, "array(E)", "union of the arrays' elements"),
     ("array_except", 2, 2, "array(E)", "elements only in the first array"),
     ("flatten", 1, 1, "array(E)", "concatenate an array of arrays"),
+    ("contains_sequence", 2, 2, "boolean",
+     "whether the array contains the sequence contiguously (constants)"),
+    ("shuffle", 1, 1, "array(E)",
+     "random permutation of a constant array"),
 ]:
     _reg(name, "scalar", lo, hi, ret, desc)
+
+
+# --- per-type overloads (SHOW FUNCTIONS rows, the reference's unit) ---
+# Only signatures this engine GENUINELY accepts are listed: the numeric
+# tower tinyint..double + decimal flows through common_super_type and
+# the decimal-aware binders; datetime extractors run on date AND
+# timestamp via _to_days; the varbinary carrier is varchar, so the
+# string/binary pairs share one implementation (both listed, as the
+# reference lists both). Kept adjacent to the catalog so a new overload
+# lands here in the same commit that implements it.
+_INT_T = ("tinyint", "smallint", "integer", "bigint")
+_NUM_T = _INT_T + ("real", "double", "decimal(p,s)")
+_OVERLOADS: Dict[str, Tuple[str, ...]] = {
+    "abs": tuple(f"{t} -> {t}" for t in _NUM_T),
+    "sign": tuple(f"{t} -> {t}" for t in _NUM_T),
+    "round": tuple(f"{t}[, n] -> {t}" for t in _NUM_T),
+    "truncate": ("real -> real", "double -> double",
+                 "decimal(p,s)[, n] -> decimal(p,s)"),
+    "floor": ("bigint -> bigint", "real -> real", "double -> double",
+              "decimal(p,s) -> decimal(p,0)"),
+    "ceil": ("bigint -> bigint", "real -> real", "double -> double",
+             "decimal(p,s) -> decimal(p,0)"),
+    "mod": ("bigint, bigint -> bigint", "real, real -> real",
+            "double, double -> double",
+            "decimal(p,s), decimal(p,s) -> decimal(p,s)"),
+    "sum": ("bigint -> bigint", "real -> real", "double -> double",
+            "decimal(p,s) -> decimal(38,s)"),
+    "avg": ("bigint -> double", "real -> real", "double -> double",
+            "decimal(p,s) -> decimal(p,s)"),
+    "greatest": tuple(f"{t}... -> {t}" for t in
+                      ("bigint", "double", "decimal(p,s)", "varchar",
+                       "date", "timestamp")),
+    "least": tuple(f"{t}... -> {t}" for t in
+                   ("bigint", "double", "decimal(p,s)", "varchar",
+                    "date", "timestamp")),
+    "approx_percentile": ("bigint, double -> bigint",
+                          "double, double -> double"),
+    "min": ("T -> T",),
+    "max": ("T -> T",),
+    # datetime extractors: date and timestamp forms (both live paths)
+    **{
+        name: (f"date -> bigint", f"timestamp -> bigint")
+        for name in ("year", "quarter", "month", "week", "day",
+                     "day_of_week", "day_of_year", "year_of_week")
+    },
+    "date_trunc": ("unit, date -> date", "unit, timestamp -> timestamp"),
+    "date_add": ("unit, bigint, date -> date",
+                 "unit, bigint, timestamp -> timestamp"),
+    "date_diff": ("unit, date, date -> bigint",
+                  "unit, timestamp, timestamp -> bigint"),
+    "last_day_of_month": ("date -> date", "timestamp -> date"),
+    # string/varbinary pairs (one carrier, two SQL types — the
+    # reference registers both signatures)
+    **{
+        name: ("varchar -> varchar", "varbinary -> varbinary")
+        for name in ("to_hex", "to_base64", "to_base64url",
+                     "to_base32", "lpad", "rpad")
+    },
+    "reverse": ("varchar -> varchar", "varbinary -> varbinary",
+                "array(E) -> array(E)"),
+    **{
+        name: ("varchar -> varbinary-hex", "varbinary -> varbinary-hex")
+        for name in ("md5", "sha1", "sha256", "sha512", "xxhash64",
+                     "murmur3")
+    },
+    "length": ("varchar -> bigint", "varbinary -> bigint"),
+    "substr": ("varchar, start[, length] -> varchar",
+               "varbinary, start[, length] -> varbinary"),
+    "concat": ("varchar... -> varchar", "varbinary... -> varbinary",
+               "array(E)... -> array(E)"),
+    "crc32": ("varchar -> bigint", "varbinary -> bigint"),
+    "from_unixtime": ("bigint -> timestamp", "double -> timestamp",
+                      "decimal(p,s) -> timestamp"),
+    "width_bucket": ("double, double, double, bigint -> bigint",),
+    "count": ("* -> bigint", "T -> bigint"),
+}
+for _n, _sigs in _OVERLOADS.items():
+    _m = REGISTRY.get(_n)
+    if _m is not None:
+        REGISTRY.register(dataclasses.replace(_m, overloads=_sigs))

@@ -1230,6 +1230,7 @@ def grouped_count_distinct(keys, valids, mask, x, x_valid, out_capacity):
 
 
 @partial(jax.jit, static_argnames=("out_capacity",))
+@partial(jax.jit, static_argnames=("out_capacity",))
 def grouped_rows_order(keys, valids, mask, x, x_valid, out_capacity):
     """Rows grouped and value-ordered for HOST-side assembly, returned
     as a row ORDER so the assembler (array_agg, map_agg, histogram —
@@ -1260,6 +1261,7 @@ def grouped_rows_order(keys, valids, mask, x, x_valid, out_capacity):
     return gid, sm, order, n_groups, overflowed
 
 
+@partial(jax.jit, static_argnames=("out_capacity",))
 def grouped_rows_sorted(keys, valids, mask, x, x_valid, out_capacity):
     """grouped_rows_order with the value column pre-gathered (listagg:
     building new strings is host work by nature — Trino's

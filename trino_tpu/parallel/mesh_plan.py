@@ -322,7 +322,7 @@ class _FragVisitor:
         return tuple(
             AggSpec(a.kind, a.arg_channel, a.out_type, a.distinct,
                     a.arg2_channel, a.percentile, a.separator,
-                    a.arg3_channel, a.param)
+                    a.arg3_channel, a.param, a.post)
             for a in node.aggs
         )
 

@@ -73,7 +73,7 @@ COMPOSITE_AGG_FUNCS = {
 # exec/operators.HOLISTIC_KINDS (fragmenter gates on it too).
 from trino_tpu.exec.operators import HOLISTIC_KINDS as _HOLISTIC_KINDS
 
-HOLISTIC_AGG_FUNCS = set(_HOLISTIC_KINDS) | {"string_agg"}
+HOLISTIC_AGG_FUNCS = set(_HOLISTIC_KINDS) | {"string_agg", "merge"}
 AGG_FUNCS = AGG_FUNCS | COMPOSITE_AGG_FUNCS | HOLISTIC_AGG_FUNCS
 
 _EPOCH = datetime.date(1970, 1, 1)
@@ -500,6 +500,30 @@ class ExprConverter:
             return ir.Call(
                 "rand", args, T.DOUBLE if not args else T.BIGINT
             )
+        if name in ("regexp_split", "regexp_extract_all"):
+            # validate the constant pattern/group at ANALYSIS time and
+            # fall through to the registry for typing (the from_base
+            # discipline: no raw re.error/IndexError mid-bind)
+            import re as _re
+
+            if len(e.args) >= 2:
+                pat = self.convert(e.args[1])
+                if isinstance(pat, ir.Literal) and pat.value is not None:
+                    try:
+                        rx = _re.compile(str(pat.value))
+                    except _re.error as ex:
+                        raise AnalysisError(f"{name}(): invalid pattern"
+                                            f" ({ex})")
+                    if name == "regexp_extract_all" and len(e.args) > 2:
+                        gl = self.convert(e.args[2])
+                        if isinstance(gl, ir.Literal) and \
+                                gl.value is not None and \
+                                not 0 <= int(gl.value) <= rx.groups:
+                            raise AnalysisError(
+                                f"{name}(): pattern has {rx.groups}"
+                                f" groups, got group {gl.value}"
+                            )
+            return None
         if name == "from_base":
             # validate the constant radix HERE (analysis time) and fall
             # through to the registry for typing — the binder twin's
@@ -512,6 +536,100 @@ class ExprConverter:
                         "from_base() radix must be in [2, 36]"
                     )
             return None
+        if name in ("reverse", "concat") and e.args:
+            # array overloads fold for constant arrays; non-array
+            # arguments fall through to the varchar paths below
+            arrs = [_const_array_values(a) for a in e.args]
+            if arrs[0] is not None and (name == "reverse" or all(
+                x is not None for x in arrs
+            )):
+                if name == "reverse":
+                    if len(e.args) != 1:
+                        return None
+                    vals = [v.value for v in arrs[0]]
+                    t = _array_element_type(arrs[0])
+                    return ir.Literal(tuple(reversed(vals)), T.array_of(t))
+                # unify element types ACROSS arguments: mixed-type
+                # concat must fail at analysis, not corrupt the literal
+                flat = [v for xs in arrs for v in xs]
+                t = _array_element_type(flat) if flat else T.BIGINT
+                return ir.Literal(
+                    tuple(v.value for v in flat), T.array_of(t)
+                )
+            return None
+        if name in ("date_format", "to_char"):
+            # constant fold only: per-row timestamp->string projection
+            # has no varchar carrier (same rule as to_iso8601)
+            import datetime as _dt
+
+            if len(e.args) != 2:
+                raise AnalysisError(f"{name}() takes two arguments")
+            vals = _need_const(e.args)
+            a, fmt = vals
+            if a.value is None or fmt.value is None:
+                return ir.Literal(None, T.VARCHAR)
+            if a.type.kind == T.TypeKind.DATE:
+                dt = _dt.datetime(1970, 1, 1) + _dt.timedelta(
+                    days=int(a.value)
+                )
+            elif a.type.kind == T.TypeKind.TIMESTAMP:
+                dt = _dt.datetime(1970, 1, 1) + _dt.timedelta(
+                    microseconds=int(a.value)
+                )
+            else:
+                raise AnalysisError(f"{name}() takes a date or timestamp")
+            if name == "date_format":
+                # MySQL tokens (date_parse's inverse). ONLY the tokens
+                # that map 1:1 onto strftime are accepted — %M/%W/%c and
+                # friends mean different things in MySQL and strftime,
+                # so passing them through would silently format wrong
+                ok = {"Y": "%Y", "y": "%y", "m": "%m", "d": "%d",
+                      "H": "%H", "h": "%I", "i": "%M", "s": "%S",
+                      "p": "%p", "j": "%j", "a": "%a", "b": "%b",
+                      "%": "%%"}
+                src, out, i = str(fmt.value), [], 0
+                while i < len(src):
+                    if src[i] == "%":
+                        tok = src[i + 1] if i + 1 < len(src) else ""
+                        if tok not in ok:
+                            raise AnalysisError(
+                                f"date_format(): unsupported token %{tok}"
+                            )
+                        out.append(ok[tok])
+                        i += 2
+                    else:
+                        out.append(src[i])
+                        i += 1
+                py = "".join(out)
+            else:
+                from trino_tpu.expr.pyfns import oracle_to_strptime
+
+                py = oracle_to_strptime(str(fmt.value))
+            return ir.Literal(dt.strftime(py), T.VARCHAR)
+        if name == "empty_approx_set":
+            from trino_tpu.expr.pyfns import hll_merge
+
+            if e.args:
+                raise AnalysisError("empty_approx_set() takes no arguments")
+            return ir.Literal(hll_merge([]), T.VARCHAR)
+        if name == "format":
+            if len(e.args) < 2:
+                raise AnalysisError("format() needs a format + values")
+            vals = _need_const(e.args)
+            fmt = vals[0]
+            if not fmt.type.is_string:
+                raise AnalysisError("format() format must be a string")
+            if fmt.value is None:
+                return ir.Literal(None, T.VARCHAR)
+            txt = str(fmt.value)
+            # the reference uses Java's Formatter; the shared %s/%d/%x/%f
+            # core maps 1:1 onto python %-formatting. %, separators and
+            # argument indexes are not supported (AnalysisError below).
+            try:
+                out = txt % tuple(v.value for v in vals[1:])
+            except (TypeError, ValueError) as ex:
+                raise AnalysisError(f"format(): {ex}")
+            return ir.Literal(out, T.VARCHAR)
         if name == "position":
             if len(e.args) != 2:
                 raise AnalysisError("position() takes two arguments")
@@ -773,6 +891,7 @@ class ExprConverter:
                     "array_min", "array_join", "array_position",
                     "array_remove", "array_sort", "array_distinct",
                     "slice", "trim_array", "arrays_overlap",
+                    "contains_sequence", "shuffle",
                     "array_intersect", "array_union", "array_except",
                     "flatten"):
             arr = (
@@ -787,6 +906,14 @@ class ExprConverter:
                         ref.type.is_array or ref.type.is_map
                     ):
                         return ir.Call("array_length", (ref,), T.BIGINT)
+                    if name == "cardinality" and ref.type.is_string:
+                        # HyperLogLog estimate: sketches ride the
+                        # varchar carrier (approx_set/merge), so a
+                        # string cardinality() is unambiguously the HLL
+                        # accessor (the reference types it HyperLogLog)
+                        return ir.Call(
+                            "hll_cardinality", (ref,), T.BIGINT
+                        )
                     if name == "element_at" and ref.type.is_map:
                         key = self.convert(e.args[1])
                         return ir.Call(
@@ -1209,6 +1336,20 @@ class ExprConverter:
                     continue
                 out.extend(x.value)
             return lit_arr(out, elem_t.element if elem_t.is_array else elem_t)
+        if name == "contains_sequence":
+            seq = other_array()
+            n, m = len(vals), len(seq)
+            hit = any(
+                list(vals[i:i + m]) == list(seq)
+                for i in range(n - m + 1)
+            ) or m == 0
+            return ir.Literal(hit, T.BOOLEAN)
+        if name == "shuffle":
+            import random as _random
+
+            out = list(vals)
+            _random.shuffle(out)  # nondeterministic, like the reference
+            return lit_arr(out)
         raise AnalysisError(f"unknown array function {name}")
 
 
@@ -1423,10 +1564,28 @@ def _refers_outside_lambda(body: ir.Expr) -> bool:
     return any(_refers_outside_lambda(c) for c in body.children())
 
 
+# scalar accessors that FUSE with the sketch aggregate they wrap:
+# cardinality(approx_set(x)) etc. evaluate inside the aggregation's
+# collect finalizer, because the digest's runtime dictionary is not
+# plan-bindable (expr/compile dictionary-table discipline). Standalone
+# accessors over TABLE columns bind normally.
+_SKETCH_ACCESSORS = {"cardinality", "value_at_quantile", "quantile_at_value"}
+_SKETCH_AGGS = {"approx_set", "merge", "tdigest_agg"}
+
+
 def _find_agg_calls(e: ast.Expression) -> List[ast.FunctionCall]:
     out: List[ast.FunctionCall] = []
 
     def walk(x):
+        if (
+            isinstance(x, ast.FunctionCall)
+            and x.name in _SKETCH_ACCESSORS
+            and x.args
+            and isinstance(x.args[0], ast.FunctionCall)
+            and x.args[0].name in _SKETCH_AGGS
+        ):
+            out.append(x)  # fused accessor-over-sketch unit
+            return
         if isinstance(x, ast.FunctionCall) and x.name in AGG_FUNCS:
             out.append(x)
             return  # no nested aggregates
@@ -2928,6 +3087,89 @@ class Analyzer:
                 aggs.append(
                     P.AggCall(kind, x_ch, x.type, arg2_channel=y_ch)
                 )
+                per_call.append(("plain", len(aggs) - 1))
+                continue
+            if (
+                kind in _SKETCH_ACCESSORS
+                and call.args
+                and isinstance(call.args[0], ast.FunctionCall)
+                and call.args[0].name in _SKETCH_AGGS
+            ):
+                # fused accessor-over-sketch (see _find_agg_calls): the
+                # accessor evaluates inside the collect finalizer where
+                # the digest is a python string, sidestepping the
+                # runtime-dictionary binding wall
+                inner = call.args[0]
+                if not inner.args:
+                    raise AnalysisError(f"{inner.name}() arguments")
+                x = conv.convert(inner.args[0])
+                if inner.name == "merge":
+                    if not x.type.is_string:
+                        raise AnalysisError(
+                            "merge() takes a serialized sketch"
+                        )
+                    canon = "sketch_merge"
+                elif inner.name == "tdigest_agg":
+                    if x.type.kind != T.TypeKind.DOUBLE:
+                        x = ir.Cast(x, T.DOUBLE)
+                    canon = "tdigest_agg"
+                else:
+                    canon = "approx_set"
+                if kind == "cardinality":
+                    if canon == "tdigest_agg":
+                        raise AnalysisError(
+                            "cardinality() reads HyperLogLog sketches"
+                        )
+                    post, out_t, qv = "card", T.BIGINT, None
+                else:
+                    if canon == "approx_set":
+                        raise AnalysisError(
+                            f"{kind}() reads t-digest sketches"
+                        )
+                    if len(call.args) != 2:
+                        raise AnalysisError(f"{kind}(d, q) arguments")
+                    q = _const_fold(conv.convert(call.args[1]))
+                    if q is None or q.value is None:
+                        raise AnalysisError(
+                            f"{kind}() argument must be a constant"
+                        )
+                    # analyzer-level literals carry SQL values (the
+                    # physical scaled-int form only exists in the binder)
+                    qv = float(q.value)
+                    post = "vq" if kind == "value_at_quantile" else "qv"
+                    out_t = T.DOUBLE
+                x_ch = len(pre_exprs)
+                pre_exprs.append(x)
+                aggs.append(P.AggCall(
+                    canon, x_ch, out_t, param=qv, post=post
+                ))
+                per_call.append(("plain", len(aggs) - 1))
+                continue
+            if kind in ("approx_set", "tdigest_agg", "merge"):
+                # sketch builders: HyperLogLog / TDigest serialized on
+                # the varchar carrier (expr/pyfns digests; the reference
+                # gives these first-class SPI types). approx_set's
+                # optional max-error argument is accepted and ignored.
+                if not call.args or len(call.args) > (
+                    2 if kind == "approx_set" else 1
+                ) or distinct:
+                    raise AnalysisError(f"{kind}() arguments")
+                x = conv.convert(call.args[0])
+                if kind == "merge":
+                    if not x.type.is_string:
+                        raise AnalysisError(
+                            "merge() takes a serialized sketch"
+                        )
+                    canon = "sketch_merge"
+                elif kind == "tdigest_agg":
+                    if x.type.kind != T.TypeKind.DOUBLE:
+                        x = ir.Cast(x, T.DOUBLE)
+                    canon = kind
+                else:
+                    canon = kind
+                x_ch = len(pre_exprs)
+                pre_exprs.append(x)
+                aggs.append(P.AggCall(canon, x_ch, T.VARCHAR))
                 per_call.append(("plain", len(aggs) - 1))
                 continue
             if kind in ("array_agg", "histogram", "map_union",

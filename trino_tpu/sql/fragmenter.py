@@ -329,7 +329,7 @@ def _spec_of(a: P.AggCall):
 
     return AggSpec(a.kind, a.arg_channel, a.out_type, a.distinct,
                    a.arg2_channel, a.percentile, a.separator,
-                   a.arg3_channel, a.param)
+                   a.arg3_channel, a.param, a.post)
 
 
 # -- row estimation: the cost-based StatsCalculator (sql/stats.py) -----------

@@ -245,7 +245,7 @@ class LocalPlanner:
             AggSpec(a.kind, a.arg_channel, a.out_type,
                     arg2_channel=a.arg2_channel, percentile=a.percentile,
                     separator=a.separator, arg3_channel=a.arg3_channel,
-                    param=a.param)
+                    param=a.param, post=a.post)
             for a in node.aggs
         ]
         groups = list(node.group_channels)

@@ -88,6 +88,7 @@ class AggCall:
     separator: Optional[str] = None  # listagg
     arg3_channel: Optional[int] = None  # pctl_merge bucket-max channel
     param: Optional[float] = None  # numeric_histogram/approx_most_frequent b
+    post: Optional[str] = None  # fused sketch accessor: card | vq | qv
 
 
 @dataclasses.dataclass(frozen=True)
