@@ -26,6 +26,27 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 
+# Persistent XLA compilation cache: the suite compiles 1000+ programs
+# and the per-module clear_caches() below (segfault workaround) forces
+# recompiles of shared kernels — with the disk cache those recompiles
+# become cache hits (keyed by HLO hash, so code changes invalidate
+# naturally). TRINO_TPU_NO_COMPILE_CACHE=1 disables for experiments.
+if os.environ.get("TRINO_TPU_NO_COMPILE_CACHE") != "1":
+    import tempfile
+
+    _cache_dir = os.environ.get(
+        "TRINO_TPU_COMPILE_CACHE",
+        os.path.join(
+            tempfile.gettempdir(),
+            f"trino_tpu_test_xla_cache_{os.getuid()}",  # per-user
+        ),
+    )
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    # bound the on-disk cache (LRU-evicted by jax past this size)
+    jax.config.update("jax_compilation_cache_max_size", 2 * 1024**3)
+
 import pytest  # noqa: E402
 
 
