@@ -423,6 +423,143 @@ class EvaluateEmptyJoin(Rule):
         return None
 
 
+class MergeLimits(Rule):
+    """Limit(Limit(x)) -> one Limit with the tighter count and summed
+    offsets (rule/MergeLimits.java)."""
+
+    name = "merge_limits"
+
+    def apply(self, node, ctx):
+        if not isinstance(node, P.LimitNode):
+            return None
+        child = ctx.resolve(node.child)
+        if not isinstance(child, P.LimitNode):
+            return None
+        # outer sees child's post-offset stream: child rows
+        # [child.offset, child.offset+child.count); outer then skips
+        # node.offset more and takes node.count
+        counts = []
+        if child.count is not None:
+            counts.append(max(child.count - node.offset, 0))
+        if node.count is not None:
+            counts.append(node.count)
+        return P.LimitNode(
+            child.child,
+            min(counts) if counts else None,
+            child.offset + node.offset,
+            node.fields,
+        )
+
+
+class PushLimitThroughProject(Rule):
+    """Limit(Project(x)) -> Project(Limit(x)) — projections are
+    row-wise, so limiting first shrinks the projected batch
+    (rule/PushLimitThroughProject.java). Only fires when the projection
+    is not itself sitting on another Limit (avoid ping-ponging with
+    MergeLimits)."""
+
+    name = "push_limit_through_project"
+
+    def apply(self, node, ctx):
+        if not isinstance(node, P.LimitNode):
+            return None
+        child = ctx.resolve(node.child)
+        if not isinstance(child, P.ProjectNode):
+            return None
+        inner = ctx.resolve(child.child)
+        if isinstance(inner, (P.LimitNode, P.TopNNode)):
+            return None
+        limited = P.LimitNode(
+            child.child, node.count, node.offset, tuple(inner.fields)
+            if hasattr(inner, "fields") else tuple(child.child.fields),
+        )
+        return P.ProjectNode(limited, child.exprs, node.fields)
+
+
+class PushTopNThroughProject(Rule):
+    """TopN(Project(x)) -> Project(TopN(x)) when every sort key maps to
+    a direct input column of the projection
+    (rule/PushTopNThroughProject.java)."""
+
+    name = "push_topn_through_project"
+
+    def apply(self, node, ctx):
+        if not isinstance(node, P.TopNNode):
+            return None
+        child = ctx.resolve(node.child)
+        if not isinstance(child, P.ProjectNode):
+            return None
+        inner = ctx.resolve(child.child)
+        if isinstance(inner, (P.TopNNode, P.SortNode, P.LimitNode)):
+            return None
+        remapped = []
+        for k in node.keys:
+            ex = child.exprs[k.channel]
+            if not isinstance(ex, ir.InputRef):
+                return None
+            remapped.append(dataclasses.replace(k, channel=ex.index))
+        topn = P.TopNNode(
+            child.child, tuple(remapped), node.count,
+            tuple(child.child.fields)
+            if hasattr(child.child, "fields") else tuple(inner.fields),
+        )
+        return P.ProjectNode(topn, child.exprs, node.fields)
+
+
+class RemoveTrivialFilters(Rule):
+    """Filter(TRUE) disappears; Filter(FALSE/NULL) becomes an empty
+    Values (rule/RemoveTrivialFilters.java)."""
+
+    name = "remove_trivial_filters"
+
+    def apply(self, node, ctx):
+        if not isinstance(node, P.FilterNode):
+            return None
+        p = node.predicate
+        if not isinstance(p, ir.Literal):
+            return None
+        if p.value is True:
+            child = ctx.resolve(node.child)
+            return child
+        return P.ValuesNode(node.fields, ())
+
+
+class PushLimitThroughUnion(Rule):
+    """Limit(n, Union(a, b)) -> Limit(n, Union(Limit(n+off, a), ...)):
+    each branch needs at most the outer window
+    (rule/PushLimitThroughUnion.java). Fires once per union (inner
+    limits mark it)."""
+
+    name = "push_limit_through_union"
+
+    def apply(self, node, ctx):
+        if not isinstance(node, P.LimitNode) or node.count is None:
+            return None
+        child = ctx.resolve(node.child)
+        if not isinstance(child, P.UnionAllNode):
+            return None
+        want = node.count + node.offset
+        new_inputs = []
+        changed = False
+        for inp in child.inputs:
+            r = ctx.resolve(inp)
+            if isinstance(r, P.LimitNode) and r.count is not None \
+                    and r.count <= want:
+                new_inputs.append(inp)
+                continue
+            new_inputs.append(P.LimitNode(
+                inp, want, 0,
+                tuple(r.fields) if hasattr(r, "fields") else node.fields,
+            ))
+            changed = True
+        if not changed:
+            return None
+        return P.LimitNode(
+            dataclasses.replace(child, inputs=tuple(new_inputs)),
+            node.count, node.offset, node.fields,
+        )
+
+
 SIMPLIFICATION_RULES: Tuple[Rule, ...] = (
     MergeFilters(),
     InlineProjections(),
@@ -431,6 +568,11 @@ SIMPLIFICATION_RULES: Tuple[Rule, ...] = (
     PushFilterIntoJoin(),
     LimitOverSortToTopN(),
     EvaluateEmptyJoin(),
+    MergeLimits(),
+    PushLimitThroughProject(),
+    PushTopNThroughProject(),
+    RemoveTrivialFilters(),
+    PushLimitThroughUnion(),
 )
 
 
