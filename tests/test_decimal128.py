@@ -164,3 +164,116 @@ class TestRelational:
         ).rows
         want = VALS[0] + VALS[1]
         assert abs(Decimal(str(rows[0][0])) - want) < abs(want) * Decimal("1e-12")
+
+
+class TestFullDivision:
+    """128/128 division — divisors beyond int64 (VERDICT r4 item #4;
+    reference spi/type/Int128Math.java full divide). HALF_UP rounding,
+    remainder takes the dividend's sign."""
+
+    @pytest.fixture(scope="class")
+    def rd(self):
+        r = LocalQueryRunner(Session(catalog="memory", schema="t"))
+        r.register_catalog("memory", create_memory_connector())
+        r.execute("create table memory.t.dd (a decimal(38,2), b decimal(38,2))")
+        r.execute(
+            "insert into dd values "
+            "(12345678901234567890123456789012.45, 98765432109876543210987654.32), "
+            "(-9999999999999999999999999999999.99, 12345678901234567890.12), "
+            "(1.00, 33333333333333333333333333333333.33), "
+            "(-5000000000000000000000000000000.00, -7000000000000000000000000000000.00)"
+        )
+        return r
+
+    def test_div_128_divisor(self, rd):
+        res = rd.execute("select a / b from dd")
+        out_t = res.column_types[0]
+        scale = out_t.scale or 0
+        rows = res.rows
+        a_vals = [
+            Decimal("12345678901234567890123456789012.45"),
+            Decimal("-9999999999999999999999999999999.99"),
+            Decimal("1.00"),
+            Decimal("-5000000000000000000000000000000.00"),
+        ]
+        b_vals = [
+            Decimal("98765432109876543210987654.32"),
+            Decimal("12345678901234567890.12"),
+            Decimal("33333333333333333333333333333333.33"),
+            Decimal("-7000000000000000000000000000000.00"),
+        ]
+        for (got,), a, b in zip(rows, a_vals, b_vals):
+            # Trino divide typing (DecimalOperators): round HALF_UP at
+            # the RESULT type's scale
+            exp = float(
+                (a / b).quantize(
+                    Decimal(1).scaleb(-scale), rounding=ROUND_HALF_UP
+                )
+            )
+            assert got is not None
+            assert abs(got - exp) <= abs(exp) * 1e-9 + 1e-6, (got, exp)
+
+    def test_mod_128_divisor(self, rd):
+        rows = rd.execute("select a % b from dd").rows
+        a_vals = [
+            Decimal("12345678901234567890123456789012.45"),
+            Decimal("-9999999999999999999999999999999.99"),
+            Decimal("1.00"),
+            Decimal("-5000000000000000000000000000000.00"),
+        ]
+        b_vals = [
+            Decimal("98765432109876543210987654.32"),
+            Decimal("12345678901234567890.12"),
+            Decimal("33333333333333333333333333333333.33"),
+            Decimal("-7000000000000000000000000000000.00"),
+        ]
+        for (got,), a, b in zip(rows, a_vals, b_vals):
+            m = abs(a) % abs(b)
+            exp = float(m if a >= 0 else -m)
+            assert got is not None
+            assert abs(got - exp) <= abs(exp) * 1e-9 + 1e-6, (got, exp)
+
+    def test_div_overflow_nulls(self, rd):
+        # rescaled dividend beyond 2^127: documented NULL (Trino raises
+        # NUMERIC_VALUE_OUT_OF_RANGE; deviation recorded in analyzer.py)
+        rows = rd.execute(
+            "select a / 0.000001 from dd where a < -1e30"
+        ).rows
+        assert all(v is None for (v,) in rows)
+
+
+class TestHolisticLongDecimal:
+    """min_by/max_by with Int128 `by` and `x` columns (grouped_argbest
+    lexicographic limb reduce; was silently wrong before r5)."""
+
+    @pytest.fixture(scope="class")
+    def rh(self):
+        r = LocalQueryRunner(Session(catalog="memory", schema="t"))
+        r.register_catalog("memory", create_memory_connector())
+        r.execute(
+            "create table memory.t.hb (k bigint, x decimal(38,2), y bigint)"
+        )
+        r.execute(
+            "insert into hb values "
+            "(1, 99999999999999999999999999999999999.01, 10), "
+            "(1, -99999999999999999999999999999999999.02, 20), "
+            "(1, 5.00, 30), "
+            "(2, 12345678901234567890123456789.00, 40), "
+            "(2, 12345678901234567890123456788.99, 50)"
+        )
+        return r
+
+    def test_min_by_long_decimal_by(self, rh):
+        rows = rh.execute(
+            "select k, min_by(y, x), max_by(y, x) from hb group by k order by k"
+        ).rows
+        assert rows == [[1, 20, 10], [2, 50, 40]]
+
+    def test_min_max_with_holistic_mix(self, rh):
+        # a holistic aggregate alongside an Int128 extreme exercises the
+        # _finish_holistic slots->state path (review finding r5)
+        rows = rh.execute(
+            "select k, min(x), min_by(y, x) from hb group by k order by k"
+        ).rows
+        assert rows[0][2] == 20 and rows[1][2] == 50
+        assert abs(rows[0][1] - (-1e35)) < 1e23

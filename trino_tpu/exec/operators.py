@@ -946,9 +946,15 @@ def agg_state_meta(
     arg_t, arg_d = input_schema[spec.arg_channel]
     if spec.kind in ("sum", "avg"):
         if arg_t.is_long_decimal:
-            # four int64 limb-sum slots (value, count) each — the
-            # Int128 accumulator's wire form (_limb_split)
-            return [(T.BIGINT, None), (T.BIGINT, None)] * 4
+            # ONE (hi, lo) Int128 value column at the argument's scale:
+            # per-state limb sums join into an exact Int128 before the
+            # wire, and the final step limb-splits them again — so long
+            # decimals ride any exchange as an ordinary (n, 2) column
+            # (Int128ArrayBlock on the page wire, AddExchanges.java:140)
+            return [
+                (T.DataType(T.TypeKind.DECIMAL, 38, arg_t.scale), None),
+                (T.BIGINT, None),
+            ]
         if arg_t.is_floating:
             val_t = T.DOUBLE
         elif arg_t.is_decimal:
@@ -985,13 +991,24 @@ _LIMB_MASK = 0xFFFFFFFF
 
 def _append_long_decimal_slots(a, col, live, values, vvalids, reds) -> None:
     """Value-slot assembly for an aggregate over a decimal(>18) (n, 2)
-    column: count reads only validity, sum/avg limb-split into four
-    exact int64 slots; everything else is unimplemented. Shared by the
+    column: count reads only validity; sum/avg limb-split into four
+    exact int64 slots; min/max ride the coupled (hi, lo) lexicographic
+    reducers; any picks both limbs at the same first row. Shared by the
     three ingest paths (per-batch, streaming, holistic)."""
     if a.kind == "count":
         values.append(live.astype(jnp.int64))
         vvalids.append(col.valid)
         reds.append("count")
+        return
+    if a.kind in ("min", "max"):
+        values.extend([col.data[:, 0], col.data[:, 1]])
+        vvalids.extend([col.valid, col.valid])
+        reds.extend([f"{a.kind}128h", f"{a.kind}128l"])
+        return
+    if a.kind == "any":
+        values.extend([col.data[:, 0], col.data[:, 1]])
+        vvalids.extend([col.valid, col.valid])
+        reds.extend(["first", "first"])
         return
     if a.kind not in ("sum", "avg"):
         raise NotImplementedError(
@@ -1005,13 +1022,64 @@ def _append_long_decimal_slots(a, col, live, values, vvalids, reds) -> None:
 
 def _agg_slot_count(spec: "AggSpec", arg_type: Optional[T.DataType]) -> int:
     """State (value, count) slot pairs one aggregate occupies."""
-    if (
-        spec.kind in ("sum", "avg")
-        and arg_type is not None
-        and arg_type.is_long_decimal
-    ):
+    if arg_type is None or not arg_type.is_long_decimal:
+        return 1
+    if spec.kind in ("sum", "avg"):
         return 4
+    if spec.kind in ("min", "max", "any"):
+        return 2
     return 1
+
+
+def _slots_to_state(spec: "AggSpec", arg_type: Optional[T.DataType],
+                    vals, cnts, si: int):
+    """One aggregate's finalize-ready state from its value/count slots
+    starting at `si`. Returns (state, next_si) — the ONE slots->state
+    switch shared by every finalize path (4 limb-sum slots join into an
+    Int128; 2 slots ARE the (hi, lo) pair; count reads one slot)."""
+    kslots = _agg_slot_count(spec, arg_type)
+    if kslots == 4:
+        h, lo = _limb_join(vals[si: si + 4])
+        state = (h, lo, cnts[si])
+    elif kslots == 2:
+        state = (vals[si], vals[si + 1], cnts[si])
+    elif spec.kind in ("count", "count_star"):
+        state = (vals[si],)
+    else:
+        state = (vals[si], cnts[si])
+    return state, si + kslots
+
+
+def _slots_to_wire_column(spec: "AggSpec", arg_type: Optional[T.DataType],
+                          vt, vd, vals, si: int):
+    """One aggregate's wire-format VALUE column from its slots at `si`
+    (the serialization half of _slots_to_state: partial emit and spill
+    share it on both data planes). Returns (column, next_si)."""
+    kslots = _agg_slot_count(spec, arg_type)
+    if kslots == 4:
+        h, lo = _limb_join(vals[si: si + 4])
+        col = Column(vt, jnp.stack([h, lo], axis=-1), None, vd)
+    elif kslots == 2:
+        col = Column(
+            vt, jnp.stack([vals[si], vals[si + 1]], axis=-1), None, vd
+        )
+    else:
+        col = Column(vt, vals[si].astype(vt.dtype), None, vd)
+    return col, si + kslots
+
+
+def _slot_merge_reducers(spec: "AggSpec", arg_type: Optional[T.DataType]):
+    """Per-slot reducers for MERGING two group states of one aggregate
+    (the _MERGE_REDUCER analogue at slot granularity: long-decimal sums
+    merge as four limb sums, extremes as the coupled (hi, lo) pair)."""
+    if arg_type is not None and arg_type.is_long_decimal:
+        if spec.kind in ("sum", "avg"):
+            return ["sum"] * 4
+        if spec.kind in ("min", "max"):
+            return [f"{spec.kind}128h", f"{spec.kind}128l"]
+        if spec.kind == "any":
+            return ["first", "first"]
+    return [_MERGE_REDUCER[spec.kind]]
 
 
 def _limb_split(d: jnp.ndarray) -> List[jnp.ndarray]:
@@ -1023,6 +1091,22 @@ def _limb_split(d: jnp.ndarray) -> List[jnp.ndarray]:
         h & m,
         h >> jnp.int64(32),
     ]
+
+
+def _lex128_reduce(h, lo, w, kind: str):
+    """Masked whole-array Int128 min/max over (hi, lo) rows: signed hi
+    first, then unsigned lo among rows holding the winning hi
+    (Int128Math.compare's lexicographic order, vectorized)."""
+    big = jnp.iinfo(jnp.int64).max
+    sgn = jnp.int64(-0x8000000000000000)
+    lo_u = lo ^ sgn
+    if kind == "min":
+        m1 = jnp.min(jnp.where(w, h, big))
+        m2 = jnp.min(jnp.where(w & (h == m1), lo_u, big)) ^ sgn
+    else:
+        m1 = jnp.max(jnp.where(w, h, -big - 1))
+        m2 = jnp.max(jnp.where(w & (h == m1), lo_u, -big - 1)) ^ sgn
+    return m1, m2
 
 
 def _limb_join(sums: Sequence[jnp.ndarray]):
@@ -1145,16 +1229,7 @@ def _finalize_grouped(acc, aggs: tuple, arg_types: tuple):
     out = []
     si = 0
     for a, arg_t in zip(aggs, arg_types):
-        k = _agg_slot_count(a, arg_t)
-        if k > 1:
-            # Int128 sum from limb slots; the count rides slot 0
-            h, lo = _limb_join(vals[si : si + k])
-            state = (h, lo, cnts[si])
-        elif a.kind in ("count", "count_star"):
-            state = (vals[si],)
-        else:
-            state = (vals[si], cnts[si])
-        si += k
+        state, si = _slots_to_state(a, arg_t, vals, cnts, si)
         col = _agg_output(a, state, arg_t, None)
         out.append((col.data, col.valid))
     return out
@@ -1202,20 +1277,7 @@ def _global_update_fn(aggs: Tuple[AggSpec, ...], long_flags: tuple = ()):
                 elif is_long and a.kind in ("min", "max"):
                     # lexicographic (hi, unsigned lo) batch reduce, then
                     # an Int128 compare against the running state
-                    h, lo = data[:, 0], data[:, 1]
-                    big_h = jnp.iinfo(jnp.int64).max
-                    sgn = jnp.int64(-0x8000000000000000)
-                    lo_u = lo ^ sgn
-                    if a.kind == "min":
-                        h_m = jnp.where(w, h, big_h)
-                        m1 = jnp.min(h_m)
-                        lo_m = jnp.where(w & (h == m1), lo_u, big_h)
-                        m2 = jnp.min(lo_m) ^ sgn
-                    else:
-                        h_m = jnp.where(w, h, -big_h - 1)
-                        m1 = jnp.max(h_m)
-                        lo_m = jnp.where(w & (h == m1), lo_u, -big_h - 1)
-                        m2 = jnp.max(lo_m) ^ sgn
+                    m1, m2 = _lex128_reduce(data[:, 0], data[:, 1], w, a.kind)
                     from trino_tpu.ops import int128 as I128x
 
                     better = I128x.lt(m1, m2, val[0], val[1])
@@ -1276,12 +1338,6 @@ class HashAggregationOperator(Operator):
         representation (decimal scale, dictionary) — finalization reads
         it straight from the input schema."""
         assert step in ("single", "partial", "final"), step
-        if step != "single" and any(
-            input_schema[c][0].is_long_decimal for c in group_channels
-        ):
-            raise NotImplementedError(
-                "partial/final aggregation over decimal(>18) group keys"
-            )
         self._step = step
         self._pre = pre_fn  # fused upstream stage (plan-time jit)
         self._group_channels = list(group_channels)
@@ -1359,6 +1415,13 @@ class HashAggregationOperator(Operator):
             and self._group_channels
             and all(
                 _BATCH_REDUCER.get(a.kind) in ("sum", "count", "min", "max")
+                # long-decimal extremes need the coupled (hi, lo)
+                # reducers only the sort path implements
+                and not (
+                    a.kind in ("min", "max")
+                    and a.arg_channel is not None
+                    and self._schema[a.arg_channel][0].is_long_decimal
+                )
                 for a in self._aggs
             )
             else None
@@ -1537,10 +1600,7 @@ class HashAggregationOperator(Operator):
             return
         reducers = []
         for i, x in enumerate(self._aggs):
-            n_slots = _agg_slot_count(x, self._arg_meta[i][0])
-            reducers.extend(
-                ["sum"] * n_slots if n_slots > 1 else [_MERGE_REDUCER[x.kind]]
-            )
+            reducers.extend(_slot_merge_reducers(x, self._arg_meta[i][0]))
         reducers = tuple(reducers)
         # distinct groups across N states cannot exceed the concatenated
         # slot count, so the merge table caps there (bounds the output
@@ -1570,22 +1630,36 @@ class HashAggregationOperator(Operator):
         if self._global:
             self._merge_global_state(batch, live)
             return
-        keys = [batch.columns[c].data for c in range(k)]
-        valids = [batch.columns[c].valid_mask() for c in range(k)]
-        if self._step == "final":
-            # final-step input IS the partial wire layout; the
-            # fragmenter gates Int128 states to single-step, so slots
-            # and aggregates correspond 1:1 here
-            n_slots = len(self._aggs)
-        else:
-            # spill round trip within a single-step operator: the slot
-            # layout comes from the input schema (limb slots included)
-            n_slots = sum(
-                len(agg_state_meta(a, self._schema)) // 2
-                for a in self._aggs
-            )
-        vals = [batch.columns[k + 2 * i].data for i in range(n_slots)]
-        cnts = [batch.columns[k + 2 * i + 1].data for i in range(n_slots)]
+        # the wire layout is uniform — k key columns then ONE
+        # (value, count) pair per aggregate; long-decimal columns arrive
+        # as (n, 2) limb pairs and split back into the internal slot
+        # layout here (keys into limb key lanes, sums into four 32-bit
+        # limb-sum slots, extremes/firsts into (hi, lo) slots)
+        keys, valids = [], []
+        for c in range(k):
+            col = batch.columns[c]
+            v = col.valid_mask()
+            if getattr(col.data, "ndim", 1) == 2:
+                keys.extend([col.data[:, 0], col.data[:, 1]])
+                valids.extend([v, v])
+            else:
+                keys.append(col.data)
+                valids.append(v)
+        vals, cnts = [], []
+        for i, a in enumerate(self._aggs):
+            val_col = batch.columns[k + 2 * i]
+            cnt = batch.columns[k + 2 * i + 1].data.astype(jnp.int64)
+            if getattr(val_col.data, "ndim", 1) == 2:
+                if a.kind in ("sum", "avg"):
+                    pieces = _limb_split(val_col.data)
+                else:  # min/max/any: the slots ARE the (hi, lo) pair
+                    pieces = [val_col.data[:, 0], val_col.data[:, 1]]
+                for p in pieces:
+                    vals.append(p)
+                    cnts.append(cnt)
+            else:
+                vals.append(val_col.data)
+                cnts.append(cnt)
         new = (tuple(keys), tuple(valids), live, tuple(vals), tuple(cnts))
         with self._state_lock:
             self._pending.append(new)
@@ -1596,6 +1670,8 @@ class HashAggregationOperator(Operator):
         states with the merge reducers."""
         if self._gstate is None:
             self._gstate = self._global_init()
+        from trino_tpu.ops import int128 as I128
+
         out = []
         for i, a in enumerate(self._aggs):
             val, cnt = self._gstate[i]
@@ -1604,6 +1680,36 @@ class HashAggregationOperator(Operator):
             c_in = jnp.where(live, c_in, 0)
             n = jnp.sum(c_in)
             red = _MERGE_REDUCER[a.kind]
+            if getattr(v_in, "ndim", 1) == 2:
+                # Int128 partial states: merge in limb arithmetic
+                present = live & (c_in > 0)
+                if red == "sum":
+                    limb_sums = [
+                        jnp.sum(jnp.where(live, piece, jnp.int64(0)))
+                        for piece in _limb_split(v_in)
+                    ]
+                    bh, bl = _limb_join(limb_sums)
+                    h, lo = I128.add(val[0], val[1], bh, bl)
+                    out.append((jnp.stack([h, lo]), cnt + n))
+                elif red in ("min", "max"):
+                    h, lo = v_in[:, 0], v_in[:, 1]
+                    m1, m2 = _lex128_reduce(h, lo, present, red)
+                    better = (
+                        I128.lt(m1, m2, val[0], val[1])
+                        if red == "min"
+                        else I128.lt(val[0], val[1], m1, m2)
+                    )
+                    take = (better | (cnt == 0)) & jnp.any(present)
+                    nh = jnp.where(take, m1, val[0])
+                    nl = jnp.where(take, m2, val[1])
+                    out.append((jnp.stack([nh, nl]), cnt + n))
+                else:  # first
+                    first = v_in[jnp.argmax(present)]
+                    new_val = jnp.where(
+                        cnt > 0, val, jnp.where(jnp.any(present), first, val)
+                    )
+                    out.append((new_val, cnt + n))
+                continue
             if red == "sum":
                 neutral = jnp.zeros((), dtype=val.dtype)
                 contrib = jnp.where(live, v_in.astype(val.dtype), neutral)
@@ -1643,27 +1749,24 @@ class HashAggregationOperator(Operator):
             )
         cols: List[Column] = []
         gk, gv, used, vals, cnts = self._acc
-        if any(
-            self._schema[c][0].is_long_decimal for c in self._group_channels
-        ):
-            raise NotImplementedError(
-                "state serialization over decimal(>18) group keys"
-            )
-        for ch, kk, vv in zip(self._group_channels, gk, gv):
+        ki = 0
+        for ch in self._group_channels:
             t, d = self._schema[ch]
-            cols.append(Column(t, kk, vv, d))
+            if t.lanes == 2:  # reassemble split long-decimal key limbs
+                cols.append(Column(
+                    t, jnp.stack([gk[ki], gk[ki + 1]], axis=-1), gv[ki], d,
+                ))
+                ki += 2
+            else:
+                cols.append(Column(t, gk[ki], gv[ki], d))
+                ki += 1
         si = 0
-        for i, a in enumerate(self._aggs):
-            metas = agg_state_meta(a, self._schema)
-            for j in range(0, len(metas), 2):
-                vt, vd = metas[j]
-                cols.append(
-                    Column(vt, vals[si].astype(vt.dtype), None, vd)
-                )
-                cols.append(
-                    Column(T.BIGINT, cnts[si].astype(jnp.int64), None, None)
-                )
-                si += 1
+        for a, (arg_t, _) in zip(self._aggs, self._arg_meta):
+            vt, vd = agg_state_meta(a, self._schema)[0]
+            cnt = cnts[si]
+            col, si = _slots_to_wire_column(a, arg_t, vt, vd, vals, si)
+            cols.append(col)
+            cols.append(Column(T.BIGINT, cnt.astype(jnp.int64), None, None))
         return RelBatch(cols, used)
 
     def _emit_partial(self) -> None:
@@ -1742,15 +1845,7 @@ class HashAggregationOperator(Operator):
         si = 0
         for (i, a) in regular:
             arg_t, arg_d = self._arg_meta[i]
-            kslots = _agg_slot_count(a, arg_t)
-            if kslots > 1:
-                h, lo = _limb_join(vals[si : si + kslots])
-                state = (h, lo, cnts[si])
-            elif a.kind in ("count", "count_star"):
-                state = (vals[si],)
-            else:
-                state = (vals[si], cnts[si])
-            si += kslots
+            state, si = _slots_to_state(a, arg_t, vals, cnts, si)
             agg_cols[i] = _agg_output(a, state, arg_t, arg_d)
         # one key sort shared by every argbest kernel (percentile needs
         # its own value pre-ordering and sorts separately)
@@ -2077,7 +2172,13 @@ class HashAggregationOperator(Operator):
                 else:
                     val = jnp.asarray(minmax_neutral(dt, a.kind), dtype=dt)
             else:  # any
-                val = jnp.zeros((), dtype=dt)
+                if (
+                    a.arg_channel is not None
+                    and self._schema[a.arg_channel][0].is_long_decimal
+                ):
+                    val = jnp.zeros(2, dtype=jnp.int64)
+                else:
+                    val = jnp.zeros((), dtype=dt)
             states.append((val, jnp.int64(0)))
         return states
 
@@ -2127,7 +2228,7 @@ class HashAggregationOperator(Operator):
                 long_arg = arg_t is not None and arg_t.is_long_decimal
                 if a.kind in ("count", "count_star"):
                     state = (val[None],)
-                elif long_arg and a.kind in ("sum", "avg", "min", "max"):
+                elif long_arg and a.kind in ("sum", "avg", "min", "max", "any"):
                     # Int128 (hi, lo) scalar state
                     state = (val[0][None], val[1][None], cnt[None])
                 else:

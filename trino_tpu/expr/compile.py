@@ -3418,10 +3418,9 @@ class ExprBinder:
                     h, lo = I128.rescale_down_round(h, lo, cs - so)
                 return out128(h, lo, valid)
             if op in ("div", "mod"):
-                d64, ok_b = I128.to_i64(bh, bl)
                 zero = (bh == 0) & (bl == 0)
-                bad = zero | ~ok_b
-                safe = jnp.where(bad, jnp.int64(1), d64)
+                bad = zero
+                long_divisor = getattr(bt, "is_long_decimal", False)
                 if op == "div":
                     # result scale so: round(a * 10^(sb + so - sa) / b).
                     # The rescale wraps mod 2^128 for |a| beyond
@@ -3435,25 +3434,47 @@ class ExprBinder:
                     aah, aal = I128.abs_(ah, al)
                     bad = bad | ~I128.lt(aah, aal, lim_h, lim_l)
                     nh, nl = I128.rescale_up(ah, al, rf)
-                    h, lo = I128.div_round_i64(nh, nl, safe)
+                    if long_divisor:
+                        # full 128/128 (Int128Math.divideRoundUp); the
+                        # bit-serial kernel handles any nonzero divisor
+                        sdh = jnp.where(bad, jnp.int64(0), bh)
+                        sdl = jnp.where(bad, jnp.int64(1), bl)
+                        h, lo = I128.div_round_128(nh, nl, sdh, sdl)
+                    else:
+                        # short divisor always fits int64: digitwise
+                        # schoolbook fast path
+                        d64, _ = I128.to_i64(bh, bl)
+                        safe = jnp.where(bad, jnp.int64(1), d64)
+                        h, lo = I128.div_round_i64(nh, nl, safe)
                 else:
                     cs = max(sa, sb)
                     if sa < cs:
                         ah, al = I128.rescale_up(ah, al, cs - sa)
-                    # safe is b at scale sb; align to cs — int64 wrap
-                    # here would silently corrupt the remainder, so
-                    # out-of-range divisors go NULL like the int64-
-                    # overflow divisor case above
-                    lim = (2 ** 63 - 1) // (10 ** (cs - sb))
-                    bad = bad | (jnp.abs(safe) > lim)
-                    safe = jnp.where(bad, jnp.int64(1), safe)
-                    safe = safe * jnp.int64(10 ** (cs - sb))
-                    pa_h, pa_l = I128.abs_(ah, al)
-                    _, _, r = I128.divmod_u128_u64(pa_h, pa_l, jnp.abs(safe))
-                    sgn = I128.sign(ah, al)
-                    h, lo = I128.mul_128_64(
-                        jnp.int64(0) * r, r, sgn
-                    )
+                    if long_divisor or (bt.precision or 18) + (cs - sb) > 18:
+                        # divisor rescaled to cs in 128-bit limbs; guard
+                        # the 128-bit wrap like the dividend rescale
+                        lim_h, lim_l = (
+                            jnp.int64(x)
+                            for x in I128.from_python(
+                                (2 ** 127 - 1) // 10 ** (cs - sb)
+                            )
+                        )
+                        bah, bal = I128.abs_(bh, bl)
+                        bad = bad | ~I128.lt(bah, bal, lim_h, lim_l)
+                        sdh = jnp.where(bad, jnp.int64(0), bh)
+                        sdl = jnp.where(bad, jnp.int64(1), bl)
+                        sdh, sdl = I128.rescale_up(sdh, sdl, cs - sb)
+                        h, lo = I128.mod_128(ah, al, sdh, sdl)
+                    else:
+                        d64, _ = I128.to_i64(bh, bl)
+                        safe = jnp.where(bad, jnp.int64(1), d64)
+                        safe = safe * jnp.int64(10 ** (cs - sb))
+                        pa_h, pa_l = I128.abs_(ah, al)
+                        _, _, r = I128.divmod_u128_u64(
+                            pa_h, pa_l, jnp.abs(safe)
+                        )
+                        sgn = I128.sign(ah, al)
+                        h, lo = I128.mul_128_64(jnp.int64(0) * r, r, sgn)
                 d, valid2 = out128(h, lo, valid)
                 nv = (
                     valid2

@@ -656,6 +656,22 @@ def sort_group_reduce(
     seed = _order_seed(out_capacity)
     iota = jnp.arange(n, dtype=jnp.int32)
 
+    # long-decimal (n, 2) limb-pair keys split into two int64 key lanes
+    # here (lax.sort operands must share one shape) and restack on
+    # output, so every caller passes columns as-is (Int128ArrayBlock
+    # keys group like any other type, spi/block/Int128ArrayBlock.java)
+    key_lanes = [2 if getattr(k, "ndim", 1) == 2 else 1 for k in keys]
+    if any(l == 2 for l in key_lanes):
+        nk, nv = [], []
+        for k, v, l in zip(keys, valids, key_lanes):
+            if l == 2:
+                nk.extend([k[:, 0], k[:, 1]])
+                nv.extend([v, v])
+            else:
+                nk.append(k)
+                nv.append(v)
+        keys, valids = nk, nv
+
     single_key = len(keys) == 1
     if single_key:
         # exact: class (0 valid / 1 NULL / 2 dead) + order-mapped key
@@ -982,6 +998,34 @@ def sort_group_reduce(
                 "min" if red == "min" else "max",
                 contrib, boundary, ends.shape[0],
             )
+        elif red in ("min128h", "max128h"):
+            # Int128 extreme, high limb: plain signed min/max. The LOW
+            # limb rides the NEXT slot with the matching *128l reducer.
+            base = red[:3]
+            info = jnp.iinfo(jnp.int64)
+            neutral = info.max if base == "min" else info.min
+            contrib = jnp.where(w, sv_, jnp.asarray(neutral, jnp.int64))
+            out = _seg_reduce(base, contrib, boundary, ends.shape[0])
+        elif red in ("min128l", "max128l"):
+            # Int128 extreme, low limb: unsigned min/max among rows
+            # whose high limb equals the group's extreme (lexicographic
+            # (hi, lo-as-u64) = Int128 order; Int128Math.compare). The
+            # matching *128h slot precedes this one, though not always
+            # adjacently (state merges interleave count slots).
+            base = red[:3]
+            hi_idx = max(
+                j for j in range(i) if reducers[j] == f"{base}128h"
+            )
+            s_hi = sorted_payload(carried[hi_idx], values[hi_idx])
+            hi_grp = results[hi_idx]
+            g = jnp.clip(_seg_id(boundary), 0, ends.shape[0] - 1)
+            hi_row = take_clip(hi_grp, g)
+            sgn = jnp.int64(-0x8000000000000000)
+            info = jnp.iinfo(jnp.int64)
+            neutral = info.max if base == "min" else info.min
+            sel = w & (s_hi == hi_row)
+            contrib = jnp.where(sel, sv_ ^ sgn, jnp.asarray(neutral, jnp.int64))
+            out = _seg_reduce(base, contrib, boundary, ends.shape[0]) ^ sgn
         elif red == "first":
             # first non-null value per segment: the smallest row index
             # whose value is non-null, then one gather
@@ -994,6 +1038,20 @@ def sort_group_reduce(
         else:
             raise ValueError(red)
         results.append(out)
+    if any(l == 2 for l in key_lanes):
+        gk2, gv2 = [], []
+        i = 0
+        for l in key_lanes:
+            if l == 2:
+                gk2.append(
+                    jnp.stack([group_keys[i], group_keys[i + 1]], axis=-1)
+                )
+                gv2.append(group_valids[i])
+            else:
+                gk2.append(group_keys[i])
+                gv2.append(group_valids[i])
+            i += l
+        group_keys, group_valids = gk2, gv2
     return group_keys, group_valids, used, results, counts, n_groups, overflowed
 
 
@@ -1013,8 +1071,17 @@ def key_order(keys, valids, mask, out_capacity: int = 0):
     computing several order statistics over the same keys sort ONCE and
     pass the permutation into each kernel. `out_capacity` must match the
     capacity passed to the kernels sharing this order (it seeds the
-    group hash, and slot alignment requires one ordering)."""
-    return _key_order(keys, valids, mask, seed=_order_seed(out_capacity))
+    group hash, and slot alignment requires one ordering). Long-decimal
+    (n, 2) keys split into limb lanes like sort_group_reduce."""
+    nk, nv = [], []
+    for k, v in zip(keys, valids):
+        if getattr(k, "ndim", 1) == 2:
+            nk.extend([k[:, 0], k[:, 1]])
+            nv.extend([v, v])
+        else:
+            nk.append(k)
+            nv.append(v)
+    return _key_order(tuple(nk), tuple(nv), mask, seed=_order_seed(out_capacity))
 
 
 @partial(jax.jit, static_argnames=("kind", "out_capacity"))
@@ -1025,8 +1092,20 @@ def grouped_argbest(
     """min_by/max_by: x at the row with the smallest/largest `by` per
     group (rows with NULL `by` are ignored; ties keep the first row in
     sort order — Trino returns an arbitrary one). Returns
-    (x_data, x_valid) aligned with sort_group_reduce's group slots."""
+    (x_data, x_valid) aligned with sort_group_reduce's group slots.
+    Long-decimal (n, 2) group keys, `by`, and `x` columns all
+    supported (keys split into limb lanes; Int128 `by` reduces
+    lexicographically; `x` gathers row-wise)."""
     n = mask.shape[0]
+    nk, nv = [], []
+    for k_, v_ in zip(keys, valids):
+        if getattr(k_, "ndim", 1) == 2:
+            nk.extend([k_[:, 0], k_[:, 1]])
+            nv.extend([v_, v_])
+        else:
+            nk.append(k_)
+            nv.append(v_)
+    keys, valids = tuple(nk), tuple(nv)
     if order is None:
         order = _key_order(
             keys, valids, mask, seed=_order_seed(out_capacity)
@@ -1038,21 +1117,12 @@ def grouped_argbest(
         sk, sv, sm, n, out_capacity
     )
     w = sm if by_valid is None else (sm & take_clip(by_valid, order))
-    s_by = take_clip(by, order)
-    s_x = take_clip(x, order)
+    s_x = take_clip(x, order, axis=0)
     s_xv = (
         jnp.ones(n, dtype=jnp.bool_)
         if x_valid is None
         else take_clip(x_valid, order)
     )
-    if jnp.issubdtype(s_by.dtype, jnp.floating):
-        neutral = jnp.inf if kind == "min_by" else -jnp.inf
-    elif s_by.dtype == jnp.bool_:
-        neutral = kind == "min_by"
-    else:
-        info = jnp.iinfo(s_by.dtype)
-        neutral = info.max if kind == "min_by" else info.min
-    nb = jnp.where(w, s_by, jnp.asarray(neutral, s_by.dtype))
     # two segment reduces + gathers instead of a 5-operand associative
     # scan (see the scan NOTE above): (1) the best `by` per segment,
     # (2) the FIRST row attaining it (ties keep first in sort order).
@@ -1061,16 +1131,42 @@ def grouped_argbest(
     # SQL comparison keys are NaN-free in practice.
     cap = ends.shape[0]
     g = _seg_id(boundary)
-    best = _seg_reduce("min" if kind == "min_by" else "max", nb, boundary, cap)
-    is_best = w & (nb == take_clip(best, g))
+    red = "min" if kind == "min_by" else "max"
+    if getattr(by, "ndim", 1) == 2:
+        # Int128 `by`: lexicographic (signed hi, unsigned lo) best
+        s_bh = take_clip(by[:, 0], order)
+        s_bl = take_clip(by[:, 1], order)
+        sgn = jnp.int64(-0x8000000000000000)
+        info = jnp.iinfo(jnp.int64)
+        neutral = info.max if kind == "min_by" else info.min
+        nbh = jnp.where(w, s_bh, jnp.asarray(neutral, jnp.int64))
+        best_h = _seg_reduce(red, nbh, boundary, cap)
+        at_h = w & (s_bh == take_clip(best_h, g))
+        lo_u = s_bl ^ sgn
+        nbl = jnp.where(at_h, lo_u, jnp.asarray(neutral, jnp.int64))
+        best_l = _seg_reduce(red, nbl, boundary, cap)
+        is_best = at_h & (lo_u == take_clip(best_l, g))
+    else:
+        s_by = take_clip(by, order)
+        if jnp.issubdtype(s_by.dtype, jnp.floating):
+            neutral = jnp.inf if kind == "min_by" else -jnp.inf
+        elif s_by.dtype == jnp.bool_:
+            neutral = kind == "min_by"
+        else:
+            info = jnp.iinfo(s_by.dtype)
+            neutral = info.max if kind == "min_by" else info.min
+        nb = jnp.where(w, s_by, jnp.asarray(neutral, s_by.dtype))
+        best = _seg_reduce(red, nb, boundary, cap)
+        is_best = w & (nb == take_clip(best, g))
     pos = jax.ops.segment_min(
         jnp.where(is_best, jnp.arange(n, dtype=jnp.int32), jnp.int32(n)),
         g, num_segments=cap,
     )
     has = pos < n
-    out_x = take_clip(s_x, pos)
+    out_x = take_clip(s_x, pos, axis=0)
     out_valid = has & take_clip(s_xv, pos) & used
-    return jnp.where(used, out_x, jnp.zeros((), out_x.dtype)), out_valid
+    used_b = used[:, None] if getattr(out_x, "ndim", 1) == 2 else used
+    return jnp.where(used_b, out_x, jnp.zeros((), out_x.dtype)), out_valid
 
 
 @partial(jax.jit, static_argnames=("fraction", "out_capacity"))

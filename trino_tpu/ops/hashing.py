@@ -63,7 +63,10 @@ def hash32(
     seed: int = 0,
 ) -> jnp.ndarray:
     """Combined 32-bit hash over key columns; NULL hashes distinctly."""
-    h = jnp.full(columns[0].shape, jnp.uint32(0x9E3779B9 + seed), dtype=jnp.uint32)
+    # rows only: a leading (n, 2) limb-pair column must not make h 2-D
+    h = jnp.full(
+        columns[0].shape[:1], jnp.uint32(0x9E3779B9 + seed), dtype=jnp.uint32
+    )
     for i, col in enumerate(columns):
         for lane in _to_lanes(col):
             v = lane
@@ -149,6 +152,10 @@ def hash32_np(columns, valids=None, seed: int = 0):
     import numpy as np
 
     def lanes_of(col):
+        if getattr(col, "ndim", 1) == 2:
+            # long-decimal limb pairs: lo-limb lanes then hi-limb lanes
+            # (the _to_lanes order)
+            return (*lanes_of(col[:, 1]), *lanes_of(col[:, 0]))
         if col.dtype == np.uint32:
             return (col,)
         bits = col.astype(np.int64).view(np.uint64)

@@ -14,10 +14,11 @@ decompose into 32-bit half-limbs so every partial product is exact in
 int64 (TPU has no native 128-bit ops; XLA int64 is itself emulated on
 32-bit lanes, so staying in small exact pieces is the fast path too).
 
-Division: HALF_UP decimal division with divisors up to 2^63 (the
-rescaled-divisor magnitudes real queries produce); the quotient digits
-come from schoolbook long division over 32-bit chunks. Divisors beyond
-int64 raise (Trino supports them; extension point documented).
+Division: HALF_UP decimal division. Divisors that fit int64 take the
+schoolbook 32-bit-digit path (divmod_u128_u64); full 128-bit divisors
+take the bit-serial restoring division (divmod_u128_u128), one
+lax.fori_loop of 128 static steps — the complete Int128Math.divide
+surface (spi/type/Int128Math.java).
 """
 
 from __future__ import annotations
@@ -215,6 +216,79 @@ def divmod_u128_u64(h, lo, d: jnp.ndarray):
     qh = (q[0] << jnp.int64(32)) | q[1]
     ql = (q[2] << jnp.int64(32)) | q[3]
     return qh, ql, r
+
+
+def _u128_lt(ah, al, bh, bl):
+    """Unsigned 128-bit less-than over limb pairs."""
+    return _u64_lt(ah, bh) | ((ah == bh) & _u64_lt(al, bl))
+
+
+def divmod_u128_u128(uh, ul, dh, dl):
+    """Unsigned 128 / unsigned 128 -> (q_hi, q_lo, r_hi, r_lo), d != 0.
+
+    Restoring bit-serial long division as ONE lax.fori_loop of 128
+    steps — static control flow, fully vectorized over the batch (the
+    divisor-beyond-int64 completion of Int128Math.divide,
+    spi/type/Int128Math.java; the 32-bit-digit schoolbook
+    divmod_u128_u64 stays the fast path for short divisors)."""
+    import jax
+
+    zero = jnp.zeros_like(uh)
+
+    def body(i, st):
+        qh, ql, rh, rl = st
+        shift = jnp.int64(127) - i.astype(jnp.int64)
+        bit = jnp.where(
+            shift >= 64,
+            (uh >> jnp.clip(shift - 64, 0, 63)) & jnp.int64(1),
+            (ul >> jnp.clip(shift, 0, 63)) & jnp.int64(1),
+        )
+        rh = (rh << jnp.int64(1)) | ((rl >> jnp.int64(63)) & jnp.int64(1))
+        rl = (rl << jnp.int64(1)) | bit
+        ge = ~_u128_lt(rh, rl, dh, dl)
+        sh, sl = sub(rh, rl, dh, dl)
+        rh = jnp.where(ge, sh, rh)
+        rl = jnp.where(ge, sl, rl)
+        qbit = ge.astype(jnp.int64)
+        qh = qh | jnp.where(
+            shift >= 64, qbit << jnp.clip(shift - 64, 0, 63), zero
+        )
+        ql = ql | jnp.where(
+            shift < 64, qbit << jnp.clip(shift, 0, 63), zero
+        )
+        return qh, ql, rh, rl
+
+    qh, ql, rh, rl = jax.lax.fori_loop(
+        0, 128, body, (zero, zero, zero, zero)
+    )
+    return qh, ql, rh, rl
+
+
+def div_round_128(h, lo, dh, dl):
+    """Signed 128 / signed nonzero 128, HALF_UP rounding — the full
+    Int128Math.divideRoundUp (divisors beyond int64 included)."""
+    ah, al = abs_(h, lo)
+    bh_a, bl_a = abs_(dh, dl)
+    qh, ql, rh, rl = divmod_u128_u128(ah, al, bh_a, bl_a)
+    # round up when 2r >= d (r < d < 2^127 so 2r fits unsigned 128)
+    r2h = (rh << jnp.int64(1)) | ((rl >> jnp.int64(63)) & jnp.int64(1))
+    r2l = rl << jnp.int64(1)
+    round_up = ~_u128_lt(r2h, r2l, bh_a, bl_a)
+    qh, ql = add(qh, ql, jnp.int64(0), round_up.astype(jnp.int64))
+    negv = (sign(h, lo) * sign(dh, dl)) < 0
+    nh, nl = neg(qh, ql)
+    return jnp.where(negv, nh, qh), jnp.where(negv, nl, ql)
+
+
+def mod_128(h, lo, dh, dl):
+    """Signed 128 %% signed nonzero 128; result takes the DIVIDEND's
+    sign (Int128Math.remainder)."""
+    ah, al = abs_(h, lo)
+    bh_a, bl_a = abs_(dh, dl)
+    _, _, rh, rl = divmod_u128_u128(ah, al, bh_a, bl_a)
+    negv = h < 0
+    nh, nl = neg(rh, rl)
+    return jnp.where(negv, nh, rh), jnp.where(negv, nl, rl)
 
 
 def div_round_i64(h, lo, d: jnp.ndarray):

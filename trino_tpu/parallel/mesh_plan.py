@@ -51,13 +51,20 @@ from trino_tpu.block import (
 )
 from trino_tpu.exec.operators import (
     _BATCH_REDUCER,
-    _MERGE_REDUCER,
     AggSpec,
     _agg_output,
+    _agg_slot_count,
+    _append_long_decimal_slots,
     _expand_pairs,
     _left_unmatched,
+    _lex128_reduce,
+    _limb_join,
+    _limb_split,
     _right_unmatched,
     _segment_any,
+    _slot_merge_reducers,
+    _slots_to_state,
+    _slots_to_wire_column,
     agg_state_meta,
     make_filter_project_fn,
     make_residual_fn,
@@ -107,17 +114,6 @@ def _check_node(n: P.PlanNode) -> None:
         for a in n.aggs:
             if a.distinct or a.kind not in _BATCH_REDUCER:
                 raise MeshUnsupported(f"agg {a.kind}")
-            # Int128 accumulators have no mesh partial format yet
-            child = n.children()[0]
-            if (
-                a.arg_channel is not None
-                and child.fields[a.arg_channel].type.is_long_decimal
-            ):
-                raise MeshUnsupported("agg over decimal(>18)")
-        child = n.children()[0]
-        for c in n.group_channels:
-            if child.fields[c].type.is_long_decimal:
-                raise MeshUnsupported("group key decimal(>18)")
     if isinstance(n, P.JoinNode) and n.kind not in (
         "inner", "left", "full", "semi", "anti", "cross"
     ):
@@ -178,9 +174,13 @@ def _scatter_to_blocks(arrays, live, pid, n: int, block: int):
     )
 
     def scat(col):
-        z = jnp.zeros(n * block + 1, dtype=col.dtype)
-        return z.at[flat].set(take_clip(col, order), mode="drop")[:-1].reshape(
-            n, block
+        # trailing lanes (long-decimal (cap, 2) limb pairs) scatter
+        # row-wise into (n, block, lanes) blocks
+        tail = col.shape[1:]
+        z = jnp.zeros((n * block + 1,) + tail, dtype=col.dtype)
+        taken = take_clip(col, order, axis=0)
+        return z.at[flat].set(taken, mode="drop")[:-1].reshape(
+            (n, block) + tail
         )
 
     out = [scat(a) for a in arrays]
@@ -200,11 +200,12 @@ def _exchange_hash(batch: RelBatch, channels: Sequence[int], n: int) -> RelBatch
     MESH_COUNTERS["all_to_all"] += 1
     ex = [jax.lax.all_to_all(b, AXIS, 0, 0, tiled=True) for b in blocks]
     live_ex = jax.lax.all_to_all(live_b, AXIS, 0, 0, tiled=True)
-    cols = [
-        Column(c.type, ex[2 * i].reshape(-1), ex[2 * i + 1].reshape(-1),
-               c.dictionary)
-        for i, c in enumerate(batch.columns)
-    ]
+    cols = []
+    for i, c in enumerate(batch.columns):
+        d = ex[2 * i]
+        # (n, block, lanes...) -> rows-major local layout
+        d = d.reshape((-1,) + d.shape[2:])
+        cols.append(Column(c.type, d, ex[2 * i + 1].reshape(-1), c.dictionary))
     return RelBatch(cols, live_ex.reshape(-1))
 
 
@@ -343,12 +344,20 @@ class _FragVisitor:
         return 1024
 
     def _batch_agg_inputs(self, aggs, batch: RelBatch):
+        """Value slots + reducers per aggregate (long-decimal args split
+        into their limb-slot layout, same as the local _agg_ingest)."""
         live = batch.live_mask()
         values, vvalids, reds = [], [], []
         for a in aggs:
             if a.arg_channel is None:
                 values.append(live.astype(jnp.int64))
                 vvalids.append(None)
+            elif getattr(batch.columns[a.arg_channel].data, "ndim", 1) == 2:
+                _append_long_decimal_slots(
+                    a, batch.columns[a.arg_channel], live,
+                    values, vvalids, reds,
+                )
+                continue
             else:
                 col = batch.columns[a.arg_channel]
                 values.append(col.data)
@@ -386,18 +395,29 @@ class _FragVisitor:
             cols.append(Column(c.type, kk, vv, c.dictionary))
         schema = [(c.type, c.dictionary) for c in batch.columns]
         if node.step == "partial":
-            # accumulator wire format (operators.partial_output_schema)
-            for a, val, cnt in zip(aggs, vals, cnts):
+            # accumulator wire format (operators.partial_output_schema):
+            # long-decimal limb slots join into ONE (n, 2) value column
+            si = 0
+            for a in aggs:
+                arg_t = (
+                    schema[a.arg_channel][0]
+                    if a.arg_channel is not None else None
+                )
                 vt, vd = agg_state_meta(a, schema)[0]
-                cols.append(Column(vt, val.astype(vt.dtype), None, vd))
-                cols.append(Column(T.BIGINT, cnt.astype(jnp.int64), None, None))
+                cnt = cnts[si]
+                col, si = _slots_to_wire_column(a, arg_t, vt, vd, vals, si)
+                cols.append(col)
+                cols.append(
+                    Column(T.BIGINT, cnt.astype(jnp.int64), None, None)
+                )
             return RelBatch(cols, used)
         # single step: finalize in place (the operator finish path)
-        for a, val, cnt in zip(aggs, vals, cnts):
+        si = 0
+        for a in aggs:
             arg_t, arg_d = (
                 schema[a.arg_channel] if a.arg_channel is not None else (None, None)
             )
-            state = (val,) if a.kind in ("count", "count_star") else (val, cnt)
+            state, si = _slots_to_state(a, arg_t, vals, cnts, si)
             out = _agg_output(a, state, arg_t, None)
             d = arg_d if a.kind in ("min", "max", "any") else None
             cols.append(Column(a.out_type, out.data, out.valid, d))
@@ -406,12 +426,39 @@ class _FragVisitor:
     def _global_partial(self, node, batch: RelBatch) -> RelBatch:
         """GROUP-BY-less partial: one wire row of accumulator state."""
         aggs = self._agg_specs(node)
-        live, values, vvalids, reds = self._batch_agg_inputs(aggs, batch)
+        live = batch.live_mask()
         schema = [(c.type, c.dictionary) for c in batch.columns]
         cols: List[Column] = []
-        for a, data, vvalid, red in zip(aggs, values, vvalids, reds):
+        for a in aggs:
+            if a.arg_channel is None:
+                data, vvalid = live.astype(jnp.int64), None
+            else:
+                col = batch.columns[a.arg_channel]
+                data, vvalid = col.data, col.valid
             w = live if vvalid is None else (live & vvalid)
             n = jnp.sum(w.astype(jnp.int64))
+            red = _BATCH_REDUCER[a.kind]
+            vt, vd = agg_state_meta(a, schema)[0]
+            if getattr(data, "ndim", 1) == 2 and red != "count":
+                # Int128 arg: one (1, 2) limb-pair state value (count
+                # states stay scalar BIGINT regardless of arg type)
+                if red == "sum":
+                    limb_sums = [
+                        jnp.sum(jnp.where(w, piece, jnp.int64(0)))
+                        for piece in _limb_split(data)
+                    ]
+                    h, lo = _limb_join(limb_sums)
+                elif red in ("min", "max"):
+                    h, lo = _lex128_reduce(data[:, 0], data[:, 1], w, red)
+                else:  # first
+                    first = data[jnp.argmax(w)]
+                    h, lo = first[0], first[1]
+                val = jnp.stack([h, lo])[None, :]
+                cols.append(Column(vt, val, None, vd))
+                cols.append(
+                    Column(T.BIGINT, n[None].astype(jnp.int64), None, None)
+                )
+                continue
             if red == "count":
                 val = n
             elif red == "sum":
@@ -429,14 +476,15 @@ class _FragVisitor:
                 val = jnp.min(masked) if red == "min" else jnp.max(masked)
             else:  # first
                 val = data[jnp.argmax(w)]
-            vt, vd = agg_state_meta(a, schema)[0]
             cols.append(Column(vt, val[None].astype(vt.dtype), None, vd))
             cols.append(Column(T.BIGINT, n[None].astype(jnp.int64), None, None))
         return RelBatch(cols, jnp.ones(1, dtype=jnp.bool_))
 
     def _agg_final(self, node, batch: RelBatch) -> RelBatch:
         """FINAL step over partial-wire-format state rows: merge-reduce
-        per group then finalize (HashAggregationOperator final mode)."""
+        per group then finalize (HashAggregationOperator final mode).
+        Long-decimal state values arrive as (n, 2) limb pairs and split
+        into their internal slot layout for the merge."""
         k = len(node.group_channels)
         keys = [batch.columns[c].data for c in range(k)]
         valids = [batch.columns[c].valid_mask() for c in range(k)]
@@ -445,13 +493,23 @@ class _FragVisitor:
         for a in node.aggs:
             val_col = batch.columns[a.arg_channel]
             cnt_col = batch.columns[a.arg_channel + 1]
-            red = _MERGE_REDUCER[a.kind]
-            values.append(val_col.data)
-            vvalids.append((cnt_col.data > 0) if red == "first" else None)
-            reds.append(red)
-            values.append(cnt_col.data)
-            vvalids.append(None)
-            reds.append("sum")
+            cnt = cnt_col.data
+            mreds = _slot_merge_reducers(a, val_col.type)
+            if getattr(val_col.data, "ndim", 1) == 2:
+                pieces = (
+                    _limb_split(val_col.data)
+                    if a.kind in ("sum", "avg")
+                    else [val_col.data[:, 0], val_col.data[:, 1]]
+                )
+            else:
+                pieces = [val_col.data]
+            for p, mred in zip(pieces, mreds):
+                values.append(p)
+                vvalids.append((cnt > 0) if mred == "first" else None)
+                reds.append(mred)
+                values.append(cnt)
+                vvalids.append(None)
+                reds.append("sum")
         site = self._site("aggf")
         cap = self.caps.setdefault(site, self._initial_agg_cap(node, batch))
         gk, gv, used, vals, _, ngroups, ovf = G.sort_group_reduce(
@@ -463,11 +521,13 @@ class _FragVisitor:
         for c_idx, kk, vv in zip(range(k), gk, gv):
             c = batch.columns[c_idx]
             cols.append(Column(c.type, kk, vv, c.dictionary))
-        for i, a in enumerate(node.aggs):
-            val = vals[2 * i]
-            cnt = vals[2 * i + 1].astype(jnp.int64)
+        # de-interleave the merged (value, cnt) stream into slot lists
+        vals_v = [v for v in vals[0::2]]
+        vals_c = [c.astype(jnp.int64) for c in vals[1::2]]
+        si = 0
+        for a in node.aggs:
             arg_col = batch.columns[a.arg_channel]
-            state = (val,) if a.kind in ("count", "count_star") else (val, cnt)
+            state, si = _slots_to_state(a, arg_col.type, vals_v, vals_c, si)
             out = _agg_output(a, state, arg_col.type, None)
             d = arg_col.dictionary if a.kind in ("min", "max", "any") else None
             cols.append(Column(a.out_type, out.data, out.valid, d))
@@ -481,13 +541,27 @@ class _FragVisitor:
             return self._cross_join(node, probe, build)
         rkeys = list(node.right_keys)
         lkeys = list(node.left_keys)
-        b_keys = [build.columns[c].data for c in rkeys]
-        b_valids = [build.columns[c].valid_mask() for c in rkeys]
+        b_keys, b_valids = [], []
+        for c in rkeys:
+            col = build.columns[c]
+            v = col.valid_mask()
+            if getattr(col.data, "ndim", 1) == 2:
+                # long-decimal key: build/probe by its two int64 limbs
+                b_keys.extend([col.data[:, 0], col.data[:, 1]])
+                b_valids.extend([v, v])
+            else:
+                b_keys.append(col.data)
+                b_valids.append(v)
         ls = J.build_lookup(b_keys, b_valids, build.live_mask())
-        keys = []
+        keys, valids = [], []
         for i, c in enumerate(lkeys):
             col = probe.columns[c]
+            v = col.valid_mask()
             bd = build.columns[rkeys[i]].dictionary
+            if getattr(col.data, "ndim", 1) == 2:
+                keys.extend([col.data[:, 0], col.data[:, 1]])
+                valids.extend([v, v])
+                continue
             if (
                 col.dictionary is not None
                 and bd is not None
@@ -501,7 +575,7 @@ class _FragVisitor:
                 keys.append(take_clip(remap, col.data))
             else:
                 keys.append(col.data)
-        valids = [probe.columns[c].valid_mask() for c in lkeys]
+            valids.append(v)
         lo, counts, total = J.probe_counts(ls, keys, valids, probe.live_mask())
         site = self._site("join")
         out_cap = self.caps.setdefault(site, bucket_capacity(max(probe.capacity, 16)))
@@ -950,7 +1024,12 @@ class MeshExecutor:
 
 def _empty_batch(schema) -> RelBatch:
     cols = [
-        Column(t, jnp.zeros(16, dtype=t.dtype), jnp.zeros(16, dtype=jnp.bool_), d)
+        Column(
+            t,
+            jnp.zeros((16, 2) if t.lanes == 2 else (16,), dtype=t.dtype),
+            jnp.zeros(16, dtype=jnp.bool_),
+            d,
+        )
         for t, d in schema
     ]
     return RelBatch(cols, jnp.zeros(16, dtype=jnp.bool_))
@@ -976,7 +1055,8 @@ def _stack_shards(batches: List[RelBatch], n: int) -> RelBatch:
                 else np.ones(d.shape[0], dtype=bool)
             )
             if d.shape[0] < cap:
-                d = np.concatenate([d, np.zeros(cap - d.shape[0], d.dtype)])
+                pad = np.zeros((cap - d.shape[0],) + d.shape[1:], d.dtype)
+                d = np.concatenate([d, pad])
                 v = np.concatenate([v, np.zeros(cap - v.shape[0], bool)])
             datas.append(d)
             valids.append(v)

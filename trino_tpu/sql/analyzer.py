@@ -20,10 +20,11 @@ Capabilities mirrored from the reference that shape this file:
   (AggregationAnalyzer analogue).
 
 Known deviations (documented):
-- decimal overflow past 38 digits and an Int128 division whose divisor
-  exceeds int64 yield NULL rows instead of Trino's NUMERIC_VALUE_OUT_OF
-  _RANGE error (same deviation class as data-dependent division by
-  zero — a deferred error-flag sideband is the planned fix).
+- decimal overflow past 38 digits yields NULL rows instead of Trino's
+  NUMERIC_VALUE_OUT_OF_RANGE error (same deviation class as
+  data-dependent division by zero — a deferred error-flag sideband is
+  the planned fix). Int128 division is complete: divisors beyond int64
+  run the 128/128 bit-serial kernel (ops/int128.divmod_u128_u128).
 Formerly-deviant semantics now implemented faithfully: NULL-aware
 NOT IN (filter + anti join + subquery-NULL-count guard), scalar
 subqueries yielding NULL on zero rows and raising on >1

@@ -197,16 +197,7 @@ class _AddExchanges:
         from trino_tpu.exec.operators import HOLISTIC_KINDS
 
         holistic = any(a.kind in HOLISTIC_KINDS for a in node.aggs)
-        # Int128 accumulators and group keys do not have a partial wire
-        # format yet: their aggregation runs single-step after a gather
-        long_decimal = any(
-            a.arg_channel is not None
-            and child.fields[a.arg_channel].type.is_long_decimal
-            for a in node.aggs
-        ) or any(
-            child.fields[c].type.is_long_decimal for c in node.group_channels
-        )
-        if not is_distributed(dist) or holistic or long_decimal or any(
+        if not is_distributed(dist) or holistic or any(
             a.distinct for a in node.aggs
         ):
             # distinct and holistic aggregation run single-step after a
