@@ -749,6 +749,10 @@ def decode_values(type_: T.DataType, data, valid, dict_values) -> list:
             out.append(bool(x))
         elif type_.is_floating:
             out.append(float(x))
+        elif type_.kind == T.TypeKind.TIMESTAMP_TZ:
+            from trino_tpu.ops.tz import format_tstz
+
+            out.append(format_tstz(int(x)))
         else:
             out.append(int(x))
     return out

@@ -34,6 +34,9 @@ class Session:
     catalog: str = "tpch"
     schema: str = "tiny"
     user: str = "user"
+    # session time zone (Session.java getTimeZoneKey): fixes literal
+    # parsing, timestamp<->tstz casts, now()/current_date
+    timezone: str = "UTC"
     batch_rows: int = 1 << 20
     target_splits: int = 1
     retry_policy: str = "none"
@@ -428,8 +431,10 @@ class LocalQueryRunner:
         raise AnalysisError(f"cannot execute {type(stmt).__name__}")
 
     def _analyze(self, q: ast.Query) -> OutputNode:
+        from trino_tpu.sql.analyzer import set_session_zone
         from trino_tpu.sql.optimizer import optimize
 
+        set_session_zone(self.session.timezone)
         analyzer = Analyzer(self.catalogs, self.session.catalog, self.session.schema)
         return optimize(analyzer.plan(q), self.catalogs, self.session)
 

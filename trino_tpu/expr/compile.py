@@ -121,6 +121,10 @@ def _format_cast_text(v, src_type: T.DataType):
     if src_type.is_decimal:
         s = src_type.scale or 0
         return f"{v:.{s}f}" if s else str(int(v))
+    if src_type.kind == T.TypeKind.TIMESTAMP_TZ:
+        from trino_tpu.ops.tz import format_tstz
+
+        return format_tstz(int(v))
     return str(v)
 
 
@@ -416,6 +420,96 @@ class ExprBinder:
     # values outside it become NULL (documented deviation)
     _SMALL_INT_CAST_RANGE = (0, 4096)
 
+    def _bind_tstz(self, name: str, e: Call, args) -> Bound:
+        """TIMESTAMP WITH TIME ZONE kernels over the packed int64
+        encoding (instant_millis << 12 | zone_id — ops/tz.py;
+        spi/type/DateTimeEncoding.java). Zone rules are static sorted
+        transition tables baked into the trace; per-row-zone reads use
+        the registry transition matrix (one take + searchsorted)."""
+        from trino_tpu.ops import tz as TZ
+
+        SHIFT = jnp.int64(TZ.MILLIS_SHIFT)
+        MASK = jnp.int64(TZ.ZONE_MASK)
+
+        def const_int(b: Bound, what: str) -> int:
+            if not b.is_const or b.const_value is None:
+                raise NotImplementedError(f"{name}() {what} must be constant")
+            return int(b.const_value)
+
+        if name == "at_timezone_id":
+            a, z = args
+            zid = const_int(z, "zone")
+            def atfn(cols, valids):
+                d, v = a.fn(cols, valids)
+                return (d & ~MASK) | jnp.int64(zid), v
+            return Bound(e.type, atfn)
+        if name == "tstz_shift":
+            a, ms = args
+            def shfn(cols, valids):
+                d, v = a.fn(cols, valids)
+                mdata, mv = ms.fn(cols, valids)
+                out = d + (mdata.astype(jnp.int64) << SHIFT)
+                if mv is not None:
+                    v = mv if v is None else (v & mv)
+                return out, v
+            return Bound(e.type, shfn)
+        if name == "tstz_to_instant_ts":
+            (a,) = args
+            def instfn(cols, valids):
+                d, v = a.fn(cols, valids)
+                return (d >> SHIFT) * 1000, v
+            return Bound(e.type, instfn)
+        if name == "tstz_rewall":
+            wall, orig = args
+            def rwfn(cols, valids):
+                w, wv = wall.fn(cols, valids)
+                o, ov = orig.fn(cols, valids)
+                zids = (o & MASK).astype(jnp.int32)
+                wall_ms = jnp.floor_divide(w.astype(jnp.int64), 1000)
+                inst = TZ.wall_to_instant_rowwise(wall_ms, zids)
+                v = wv if ov is None else (ov if wv is None else (wv & ov))
+                return (inst << SHIFT) | zids.astype(jnp.int64), v
+            return Bound(e.type, rwfn)
+        if name == "ts_to_tstz":
+            a, z = args
+            zid = const_int(z, "zone")
+            def ttfn(cols, valids):
+                d, v = a.fn(cols, valids)
+                wall_ms = jnp.floor_divide(d.astype(jnp.int64), 1000)
+                inst = TZ.wall_to_instant_millis(wall_ms, zid)
+                return (inst << SHIFT) | jnp.int64(zid), v
+            return Bound(e.type, ttfn)
+        if name == "tstz_to_ts":
+            (a,) = args
+            def ftfn(cols, valids):
+                d, v = a.fn(cols, valids)
+                ms = d >> SHIFT
+                zids = (d & MASK).astype(jnp.int32)
+                off = TZ.offset_millis_rowwise(ms, zids)
+                return (ms + off) * 1000, v
+            return Bound(e.type, ftfn)
+        if name == "parse_tstz":
+            a, z = args
+            zone = TZ.zone_name(const_int(z, "zone"))
+            return self._bind_dict_table_nullable(
+                a, e.type, lambda s: TZ.parse_tstz(s, zone), jnp.int64
+            )
+        # timezone_hour / timezone_minute: signed offset components
+        (a,) = args
+        def tzfn(cols, valids):
+            d, v = a.fn(cols, valids)
+            ms = d >> SHIFT
+            zids = (d & MASK).astype(jnp.int32)
+            off = TZ.offset_millis_rowwise(ms, zids)
+            sgn = jnp.sign(off)
+            mag = jnp.abs(off)
+            if name == "tstz_timezone_hour":
+                out = sgn * (mag // 3_600_000)
+            else:
+                out = sgn * ((mag % 3_600_000) // 60_000)
+            return out.astype(jnp.int64), v
+        return Bound(T.BIGINT, tzfn)
+
     def _bind_cast(self, e: Cast) -> Bound:
         a = self.bind(e.arg)
         out = self._bind_cast_from(e, a)
@@ -489,6 +583,25 @@ class ExprBinder:
                 d, v = afn(cols, valids)
                 return F.round_half_away(d * sf).astype(dst.dtype), v
             return Bound(dst, fdfn)
+        if (
+            src.kind == T.TypeKind.TIMESTAMP
+            and dst.kind == T.TypeKind.DATE
+        ):
+            def tdfn(cols, valids, afn=a.fn):
+                d, v = afn(cols, valids)
+                days = jnp.floor_divide(
+                    d.astype(jnp.int64), _MICROS_PER_DAY
+                )
+                return days.astype(jnp.int32), v
+            return Bound(dst, tdfn)
+        if (
+            src.kind == T.TypeKind.DATE
+            and dst.kind == T.TypeKind.TIMESTAMP
+        ):
+            def dtfn(cols, valids, afn=a.fn):
+                d, v = afn(cols, valids)
+                return d.astype(jnp.int64) * _MICROS_PER_DAY, v
+            return Bound(dst, dtfn)
         if (src.is_integerlike or src.kind == T.TypeKind.BOOLEAN) and (
             dst.is_integerlike or dst.is_floating
         ):
@@ -1170,6 +1283,12 @@ class ExprBinder:
                 d, v = a.fn(cols, valids)
                 return -d, v
             return Bound(e.type, negfn, a.dictionary)
+        if name in (
+            "at_timezone_id", "ts_to_tstz", "tstz_to_ts", "parse_tstz",
+            "tstz_shift", "tstz_timezone_hour", "tstz_timezone_minute",
+            "tstz_to_instant_ts", "tstz_rewall",
+        ):
+            return self._bind_tstz(name, e, args)
         if name in ("extract_year", "extract_month", "extract_day"):
             (a,) = args
             part = {"extract_year": F.extract_year, "extract_month": F.extract_month,
@@ -3113,6 +3232,16 @@ class ExprBinder:
         a, b = args
         if a.type.is_string or b.type.is_string:
             return self._bind_string_comparison(op, a, b)
+        if a.type.kind == T.TypeKind.TIMESTAMP_TZ and a.type == b.type:
+            # tstz compares by INSTANT only — two values naming one
+            # instant in different zones are equal (DateTimes.java;
+            # the packed zone bits must not tie-break equality)
+            def strip(x: Bound) -> Bound:
+                def sfn(cols, valids, xfn=x.fn):
+                    d, v = xfn(cols, valids)
+                    return d >> jnp.int64(12), v
+                return Bound(T.BIGINT, sfn)
+            a, b = strip(a), strip(b)
         # decimal: rescale BOTH sides (incl. a bare-integer side) to the
         # common scale so scaled int64 compares against scaled int64;
         # a long-decimal side switches the whole compare to Int128 limbs

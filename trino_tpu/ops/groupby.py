@@ -244,7 +244,27 @@ def _group_hash(keys, valids, mask, seed: int):
     return jnp.where(mask, h, _DEAD_ROW_HASH)
 
 
+def split_limb_keys(keys, valids):
+    """Expand long-decimal (n, 2) limb-pair key columns into two int64
+    key lanes (lax.sort operands must share one shape). EVERY grouping
+    kernel normalizes through this before sorting/segmenting — pair
+    equality == value equality, so grouping semantics are unchanged
+    (Int128ArrayBlock keys, spi/block/Int128ArrayBlock.java)."""
+    if not any(getattr(k, "ndim", 1) == 2 for k in keys):
+        return tuple(keys), tuple(valids)
+    nk, nv = [], []
+    for k, v in zip(keys, valids):
+        if getattr(k, "ndim", 1) == 2:
+            nk.extend([k[:, 0], k[:, 1]])
+            nv.extend([v, v])
+        else:
+            nk.append(k)
+            nv.append(v)
+    return tuple(nk), tuple(nv)
+
+
 def _key_order(keys, valids, mask, order=None, seed: int = 0):
+    keys, valids = split_limb_keys(keys, valids)
     """Stable permutation grouping equal key tuples (NULL == NULL),
     live rows first. MUST order groups exactly like sort_group_reduce
     so order-statistic kernels' slots align with its group slots:
@@ -319,6 +339,13 @@ def _segment_bounds(sk, sv, sm, n, out_capacity):
     overflowed = n_groups > out_capacity
     sidx = jnp.where(boundary, jnp.arange(n, dtype=jnp.int32), jnp.int32(n))
     starts = jnp.sort(sidx)[:out_capacity]
+    if starts.shape[0] < out_capacity:
+        # fewer rows than group slots: pad so every caller's group
+        # arrays come out (out_capacity,) — an unpadded short array
+        # misaligns against sort_group_reduce's padded key columns
+        starts = jnp.pad(
+            starts, (0, out_capacity - starts.shape[0]), constant_values=n
+        )
     used = starts < n
     safe_starts = jnp.clip(starts, 0, max(n - 1, 0))
     next_starts = jnp.concatenate(
@@ -661,16 +688,7 @@ def sort_group_reduce(
     # output, so every caller passes columns as-is (Int128ArrayBlock
     # keys group like any other type, spi/block/Int128ArrayBlock.java)
     key_lanes = [2 if getattr(k, "ndim", 1) == 2 else 1 for k in keys]
-    if any(l == 2 for l in key_lanes):
-        nk, nv = [], []
-        for k, v, l in zip(keys, valids, key_lanes):
-            if l == 2:
-                nk.extend([k[:, 0], k[:, 1]])
-                nv.extend([v, v])
-            else:
-                nk.append(k)
-                nv.append(v)
-        keys, valids = nk, nv
+    keys, valids = split_limb_keys(keys, valids)
 
     single_key = len(keys) == 1
     if single_key:
@@ -1073,15 +1091,7 @@ def key_order(keys, valids, mask, out_capacity: int = 0):
     capacity passed to the kernels sharing this order (it seeds the
     group hash, and slot alignment requires one ordering). Long-decimal
     (n, 2) keys split into limb lanes like sort_group_reduce."""
-    nk, nv = [], []
-    for k, v in zip(keys, valids):
-        if getattr(k, "ndim", 1) == 2:
-            nk.extend([k[:, 0], k[:, 1]])
-            nv.extend([v, v])
-        else:
-            nk.append(k)
-            nv.append(v)
-    return _key_order(tuple(nk), tuple(nv), mask, seed=_order_seed(out_capacity))
+    return _key_order(keys, valids, mask, seed=_order_seed(out_capacity))
 
 
 @partial(jax.jit, static_argnames=("kind", "out_capacity"))
@@ -1097,15 +1107,7 @@ def grouped_argbest(
     supported (keys split into limb lanes; Int128 `by` reduces
     lexicographically; `x` gathers row-wise)."""
     n = mask.shape[0]
-    nk, nv = [], []
-    for k_, v_ in zip(keys, valids):
-        if getattr(k_, "ndim", 1) == 2:
-            nk.extend([k_[:, 0], k_[:, 1]])
-            nv.extend([v_, v_])
-        else:
-            nk.append(k_)
-            nv.append(v_)
-    keys, valids = tuple(nk), tuple(nv)
+    keys, valids = split_limb_keys(keys, valids)
     if order is None:
         order = _key_order(
             keys, valids, mask, seed=_order_seed(out_capacity)
@@ -1189,6 +1191,7 @@ def grouped_weighted_percentile(
     # so min-order == bucket order); invalid rows last
     pre = jnp.argsort(_order_value(mn, False), stable=True).astype(jnp.int32)
     pre = take_clip(pre, jnp.argsort(take_clip(~mv, pre), stable=True))
+    keys, valids = split_limb_keys(keys, valids)
     order = _key_order(
         keys, valids, mask, order=pre, seed=_order_seed(out_capacity)
     )
@@ -1260,6 +1263,7 @@ def grouped_percentile(
     # pre-order: x ascending, NULL x last within each group
     pre = jnp.argsort(_order_value(x, False), stable=True).astype(jnp.int32)
     pre = take_clip(pre, jnp.argsort(take_clip(~xv, pre), stable=True))
+    keys, valids = split_limb_keys(keys, valids)
     order = _key_order(
         keys, valids, mask, order=pre, seed=_order_seed(out_capacity)
     )
@@ -1307,6 +1311,7 @@ def grouped_count_distinct(keys, valids, mask, x, x_valid, out_capacity):
     )
     pre = jnp.argsort(xb, stable=True).astype(jnp.int32)
     pre = take_clip(pre, jnp.argsort(take_clip(~xv, pre), stable=True))
+    keys, valids = split_limb_keys(keys, valids)
     order = _key_order(
         keys, valids, mask, order=pre, seed=_order_seed(out_capacity)
     )
@@ -1342,6 +1347,7 @@ def grouped_rows_order(keys, valids, mask, x, x_valid, out_capacity):
     pre = jnp.argsort(_order_value(x, False), stable=True).astype(jnp.int32)
     pre = take_clip(pre, jnp.argsort(take_clip(~xv, pre), stable=True))
     seed = _order_seed(out_capacity)
+    keys, valids = split_limb_keys(keys, valids)
     order = _key_order(keys, valids, mask, order=pre, seed=seed)
     sm = take_clip(mask, order)
     sk = [take_clip(k, order) for k in keys]

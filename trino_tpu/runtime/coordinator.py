@@ -423,6 +423,9 @@ class DistributedQueryRunner:
     def _analyze(self, q: ast.Query):
         from trino_tpu.sql.optimizer import optimize
 
+        from trino_tpu.sql.analyzer import set_session_zone
+
+        set_session_zone(self.session.timezone)
         analyzer = Analyzer(
             self.catalogs, self.session.catalog, self.session.schema
         )

@@ -36,6 +36,7 @@ limb pairs (ops/int128.py), DecimalOperators result typing for
 
 from __future__ import annotations
 
+import contextvars
 import dataclasses
 import datetime
 import math
@@ -211,6 +212,30 @@ def _unify_types(types: Sequence[T.DataType]) -> T.DataType:
     return T.BIGINT
 
 
+# Per-query session time zone (Session.timezone), read by literal
+# parsing and zone-dependent cast rewrites. A contextvar keeps
+# concurrent server queries isolated (Session.java getTimeZoneKey).
+_SESSION_ZONE = contextvars.ContextVar("trino_tpu_session_zone", default="UTC")
+
+
+def session_zone() -> str:
+    return _SESSION_ZONE.get()
+
+
+def set_session_zone(zone: str) -> None:
+    _SESSION_ZONE.set(zone)
+
+
+# functions whose tstz argument reads the LOCAL wall clock in the
+# value's own zone (extract-family + formatting; DateTimes.java)
+_TSTZ_WALL_FNS = {
+    "year", "month", "day", "hour", "minute", "second", "millisecond",
+    "quarter", "week", "dow", "doy", "day_of_week", "day_of_year",
+    "day_of_month", "year_of_week", "yow", "format_datetime",
+    "date_format", "last_day_of_month", "to_iso8601",
+}
+
+
 def _arith_type(op: str, lt: T.DataType, rt: T.DataType) -> T.DataType:
     if lt.kind == T.TypeKind.DATE or rt.kind == T.TypeKind.DATE:
         return T.DATE
@@ -265,6 +290,10 @@ class ExprConverter:
                     raise AnalysisError(
                         f"row type has no field {e.parts[-1]!r}"
                     )
+            if hit is None and len(e.parts) == 1:
+                special = self._zero_arg_special(e.parts[0].lower())
+                if special is not None:
+                    return special
             ch, t = self.scope.resolve(e.parts)
             return ir.InputRef(ch, t)
         if isinstance(e, ast.Subscript):
@@ -281,11 +310,22 @@ class ExprConverter:
             return ir.Literal(_date_days(e.value), T.DATE)
         if isinstance(e, ast.TimestampLiteral):
             from trino_tpu.expr.pyfns import iso_to_micros
+            from trino_tpu.ops import tz as TZ
 
+            # a trailing zone name/offset makes the literal a TIMESTAMP
+            # WITH TIME ZONE (parser/sql/tree/TimestampLiteral + the
+            # DateTimes.java literal parse)
+            if TZ.literal_has_zone(e.value):
+                packed = TZ.parse_tstz(e.value, session_zone())
+                if packed is None:
+                    raise AnalysisError(f"invalid timestamp: {e.value!r}")
+                return ir.Literal(packed, T.TIMESTAMP_TZ)
             micros = iso_to_micros(e.value)
             if micros is None:
                 raise AnalysisError(f"invalid timestamp: {e.value!r}")
             return ir.Literal(micros, T.TIMESTAMP)
+        if isinstance(e, ast.AtTimeZone):
+            return self._convert_at_timezone(e)
         if isinstance(e, ast.IntervalLiteral):
             raise AnalysisError("intervals are only supported in date arithmetic")
         if isinstance(e, ast.BinaryOp):
@@ -305,7 +345,11 @@ class ExprConverter:
             v = self.convert(e.value)
             lo = self.convert(e.low)
             hi = self.convert(e.high)
-            x = ir.and_(ir.comparison("ge", v, lo), ir.comparison("le", v, hi))
+            v1, lo = self._coerce_temporal_pair(v, lo)
+            v2, hi = self._coerce_temporal_pair(v1, hi)
+            x = ir.and_(
+                ir.comparison("ge", v2, lo), ir.comparison("le", v2, hi)
+            )
             return ir.not_(x) if e.negated else x
         if isinstance(e, ast.InList):
             v = self.convert(e.value)
@@ -334,8 +378,22 @@ class ExprConverter:
             return self._convert_cast(e)
         if isinstance(e, ast.Extract):
             a = self.convert(e.operand)
+            if a.type.kind == T.TypeKind.TIMESTAMP_TZ:
+                if e.field in ("timezone_hour", "timezone_minute"):
+                    return ir.Call(f"tstz_{e.field}", (a,), T.BIGINT)
+                # civil fields read the LOCAL wall clock in the value's
+                # own zone (DateTimes.java extract semantics)
+                a = ir.Call("tstz_to_ts", (a,), T.TIMESTAMP)
             if e.field in ("year", "month", "day"):
                 return ir.Call(f"extract_{e.field}", (a,), T.BIGINT)
+            if e.field in ("hour", "minute", "second"):
+                # time-of-day fields need a timestamp operand (Trino
+                # rejects DATE here with a type error)
+                if a.type.kind != T.TypeKind.TIMESTAMP:
+                    raise AnalysisError(
+                        f"cannot extract {e.field} from {a.type}"
+                    )
+                return ir.Call(e.field, (a,), T.BIGINT)
             canon = {"quarter": "quarter", "week": "week",
                      "dow": "day_of_week", "day_of_week": "day_of_week",
                      "doy": "day_of_year", "day_of_year": "day_of_year"}
@@ -372,7 +430,10 @@ class ExprConverter:
         if op in ("and", "or"):
             return ir.Call(op, (self.convert(e.left), self.convert(e.right)), T.BOOLEAN)
         if op in ("eq", "ne", "lt", "le", "gt", "ge"):
-            return ir.comparison(op, self.convert(e.left), self.convert(e.right))
+            l, r = self._coerce_temporal_pair(
+                self.convert(e.left), self.convert(e.right)
+            )
+            return ir.comparison(op, l, r)
         if op == "is_distinct":
             l, r = self.convert(e.left), self.convert(e.right)
             # NOT ((a=b, null-safe false) OR (a NULL AND b NULL)) — the
@@ -400,6 +461,23 @@ class ExprConverter:
         d = self.convert(date_ast)
         if isinstance(d, ir.Literal) and d.type.kind == T.TypeKind.DATE:
             return ir.Literal(_shift_date(d.value, interval.unit, n), T.DATE)
+        if d.type.kind == T.TypeKind.TIMESTAMP_TZ:
+            # fixed-duration shift on the INSTANT (zone bits untouched;
+            # Trino adds exact millis for day-second intervals)
+            unit_ms = {
+                "day": 86_400_000, "hour": 3_600_000,
+                "minute": 60_000, "second": 1_000,
+            }.get(interval.unit)
+            if unit_ms is None:
+                raise AnalysisError(
+                    "month/year intervals on timestamp with time zone "
+                    "are not supported"
+                )
+            return ir.Call(
+                "tstz_shift",
+                (d, ir.Literal(n * unit_ms, T.BIGINT)),
+                T.TIMESTAMP_TZ,
+            )
         if interval.unit == "day":
             return ir.Call("add", (d, ir.Literal(n, T.DATE)), T.DATE)
         raise AnalysisError(
@@ -423,7 +501,112 @@ class ExprConverter:
 
     def _convert_cast(self, e: ast.Cast) -> ir.Expr:
         a = self.convert(e.operand)
-        return ir.Cast(a, resolve_type(e.target))
+        return self._cast_to(a, resolve_type(e.target))
+
+    def _cast_to(self, a: ir.Expr, dst: T.DataType) -> ir.Expr:
+        """Casts involving TIMESTAMP WITH TIME ZONE rewrite into calls
+        carrying the session zone as a literal (the zone must be fixed
+        at ANALYSIS time — Session.getTimeZoneKey — because bound
+        expressions run on workers with no session)."""
+        src = a.type
+        TSTZ = T.TypeKind.TIMESTAMP_TZ
+        if dst.kind == TSTZ and src.kind != TSTZ:
+            from trino_tpu.ops import tz as TZ
+
+            sz = ir.Literal(TZ.zone_id(session_zone()), T.INTEGER)
+            if src.kind == T.TypeKind.TIMESTAMP:
+                return ir.Call("ts_to_tstz", (a, sz), T.TIMESTAMP_TZ)
+            if src.kind == T.TypeKind.DATE:
+                ts = ir.Cast(a, T.TIMESTAMP)
+                return ir.Call("ts_to_tstz", (ts, sz), T.TIMESTAMP_TZ)
+            if src.is_string or src.kind == T.TypeKind.UNKNOWN:
+                return ir.Call("parse_tstz", (a, sz), T.TIMESTAMP_TZ)
+            raise AnalysisError(
+                f"cannot cast {src} to timestamp with time zone"
+            )
+        if src.kind == TSTZ and dst.kind != TSTZ:
+            if dst.kind == T.TypeKind.TIMESTAMP:
+                return ir.Call("tstz_to_ts", (a,), T.TIMESTAMP)
+            if dst.kind == T.TypeKind.DATE:
+                return ir.Cast(
+                    ir.Call("tstz_to_ts", (a,), T.TIMESTAMP), T.DATE
+                )
+            if dst.is_string:
+                # constant folding in the binder (_format_cast_text);
+                # column-valued follows the timestamp->varchar limit
+                return ir.Cast(a, dst)
+            raise AnalysisError(
+                f"cannot cast timestamp with time zone to {dst}"
+            )
+        return ir.Cast(a, dst)
+
+    def _coerce_temporal_pair(self, l: ir.Expr, r: ir.Expr):
+        """Mixed TIMESTAMP/DATE vs TIMESTAMP WITH TIME ZONE comparison:
+        the zone-less side coerces to tstz at the session zone (the
+        implicit coercion Trino's type system inserts) — raw int64
+        compare of micros against the packed encoding would be silent
+        garbage."""
+        TSTZ = T.TypeKind.TIMESTAMP_TZ
+        plain = (T.TypeKind.TIMESTAMP, T.TypeKind.DATE)
+
+        def lift(x: ir.Expr) -> ir.Expr:
+            if x.type.kind == T.TypeKind.DATE:
+                x = ir.Cast(x, T.TIMESTAMP)
+            return self._cast_to(x, T.TIMESTAMP_TZ)
+
+        if l.type.kind == TSTZ and r.type.kind in plain:
+            return l, lift(r)
+        if r.type.kind == TSTZ and l.type.kind in plain:
+            return lift(l), r
+        return l, r
+
+    def _zero_arg_special(self, name: str) -> Optional[ir.Expr]:
+        """Parenless standard temporal pseudo-columns (SqlBase.g4
+        specialDateTimeFunction): CURRENT_TIMESTAMP / CURRENT_DATE /
+        LOCALTIMESTAMP / CURRENT_TIMEZONE, all at the session zone."""
+        import time as _time
+
+        from trino_tpu.ops import tz as TZ
+
+        if name == "current_timestamp":
+            return ir.Literal(
+                TZ.pack_py(
+                    int(_time.time() * 1000), TZ.zone_id(session_zone())
+                ),
+                T.TIMESTAMP_TZ,
+            )
+        if name in ("current_date", "localtimestamp"):
+            zid = TZ.zone_id(session_zone())
+            now_ms = int(_time.time() * 1000)
+            wall_ms = now_ms + TZ.offset_millis_py(zid, now_ms)
+            if name == "localtimestamp":
+                return ir.Literal(wall_ms * 1000, T.TIMESTAMP)
+            return ir.Literal(wall_ms // 86_400_000, T.DATE)
+        if name == "current_timezone":
+            return ir.Literal(session_zone(), T.VARCHAR)
+        return None
+
+    def _convert_at_timezone(self, e: "ast.AtTimeZone") -> ir.Expr:
+        from trino_tpu.ops import tz as TZ
+
+        a = self.convert(e.operand)
+        z = self.convert(e.zone)
+        if not (
+            isinstance(z, ir.Literal) and z.type.is_string
+            and z.value is not None
+        ):
+            raise AnalysisError("AT TIME ZONE requires a constant zone")
+        try:
+            zid = TZ.zone_id(str(z.value))
+        except ValueError as ex:
+            raise AnalysisError(str(ex))
+        if a.type.kind == T.TypeKind.TIMESTAMP:
+            a = self._cast_to(a, T.TIMESTAMP_TZ)
+        if a.type.kind != T.TypeKind.TIMESTAMP_TZ:
+            raise AnalysisError("AT TIME ZONE requires a timestamp operand")
+        return ir.Call(
+            "at_timezone_id", (a, ir.Literal(zid, T.INTEGER)), T.TIMESTAMP_TZ
+        )
 
     # higher-order (lambda-taking) functions: (collection positions,
     # lambda position, param-type derivation) — ArrayFunctions /
@@ -471,11 +654,50 @@ class ExprConverter:
         if name == "now":
             import time as _time
 
+            from trino_tpu.ops import tz as TZ
+
             if e.args:
                 raise AnalysisError("now() takes no arguments")
-            return ir.Literal(int(_time.time() * 1e6), T.TIMESTAMP)
+            # now()/current_timestamp: TIMESTAMP WITH TIME ZONE at the
+            # session zone (DateTimeFunctions.java currentTimestamp)
+            return ir.Literal(
+                TZ.pack_py(
+                    int(_time.time() * 1000), TZ.zone_id(session_zone())
+                ),
+                T.TIMESTAMP_TZ,
+            )
         if name == "current_timezone":
-            return ir.Literal("UTC", T.VARCHAR)
+            return ir.Literal(session_zone(), T.VARCHAR)
+        if name in ("with_timezone", "at_timezone"):
+            from trino_tpu.ops import tz as TZ
+
+            if len(e.args) != 2:
+                raise AnalysisError(f"{name}() takes two arguments")
+            a = self.convert(e.args[0])
+            z = self.convert(e.args[1])
+            if not (isinstance(z, ir.Literal) and z.value is not None):
+                raise AnalysisError(f"{name}() zone must be a constant")
+            try:
+                zid = TZ.zone_id(str(z.value))
+            except ValueError as ex:
+                raise AnalysisError(str(ex))
+            if name == "with_timezone":
+                # wall time reinterpreted IN the given zone
+                if a.type.kind != T.TypeKind.TIMESTAMP:
+                    raise AnalysisError("with_timezone() takes a timestamp")
+                return ir.Call(
+                    "ts_to_tstz", (a, ir.Literal(zid, T.INTEGER)),
+                    T.TIMESTAMP_TZ,
+                )
+            # at_timezone: same instant, displayed in the given zone
+            if a.type.kind == T.TypeKind.TIMESTAMP:
+                a = self._cast_to(a, T.TIMESTAMP_TZ)
+            if a.type.kind != T.TypeKind.TIMESTAMP_TZ:
+                raise AnalysisError("at_timezone() takes a timestamp")
+            return ir.Call(
+                "at_timezone_id", (a, ir.Literal(zid, T.INTEGER)),
+                T.TIMESTAMP_TZ,
+            )
         if name == "uuid":
             import uuid as _uuid
 
@@ -1074,6 +1296,17 @@ class ExprConverter:
         if name == "mod":
             out_t = _arith_type("mod", args[0].type, args[1].type)
             return ir.Call("mod", args, out_t)
+        if (
+            name in _TSTZ_WALL_FNS
+            and args
+            and args[0].type.kind == T.TypeKind.TIMESTAMP_TZ
+        ):
+            # civil-field/formatting functions read the LOCAL wall clock
+            # in the value's own zone (DateTimes.java) — rewrite the
+            # tstz argument to its wall-clock timestamp
+            args = [
+                ir.Call("tstz_to_ts", (args[0],), T.TIMESTAMP), *args[1:]
+            ]
         if name in ("year", "month", "day"):
             return ir.Call(f"extract_{name}", args, T.BIGINT)
         if name == "if":
@@ -1121,6 +1354,13 @@ class ExprConverter:
             if not isinstance(args[0], ir.Literal):
                 raise AnalysisError("chr() argument must be a constant")
             return ir.Literal(chr(int(args[0].value)), T.VARCHAR)
+        TSTZ_K = T.TypeKind.TIMESTAMP_TZ
+        if name == "to_unixtime" and args and args[0].type.kind == TSTZ_K:
+            # unix time is the INSTANT, not the wall clock
+            args = [
+                ir.Call("tstz_to_instant_ts", (args[0],), T.TIMESTAMP),
+                *args[1:],
+            ]
         if name in ("quarter", "week", "day_of_week", "dow", "day_of_year",
                     "doy", "day_of_month"):
             canon = {"dow": "day_of_week", "doy": "day_of_year",
@@ -1129,14 +1369,63 @@ class ExprConverter:
         if name == "date_trunc":
             if len(args) != 2:
                 raise AnalysisError("date_trunc() takes two arguments")
+            if args[1].type.kind == TSTZ_K:
+                # truncate on the wall clock in the value's zone, then
+                # restore the instant/zone packing (DateTimes.java
+                # truncation semantics)
+                wall = ir.Call("tstz_to_ts", (args[1],), T.TIMESTAMP)
+                trunc = ir.Call("date_trunc", (args[0], wall), T.TIMESTAMP)
+                return ir.Call(
+                    "tstz_rewall", (trunc, args[1]), T.TIMESTAMP_TZ
+                )
             return ir.Call(name, args, args[1].type)
         if name == "date_add":
             if len(args) != 3:
                 raise AnalysisError("date_add() takes three arguments")
+            if args[2].type.kind == TSTZ_K:
+                unit = (
+                    str(args[0].value).lower()
+                    if isinstance(args[0], ir.Literal) else None
+                )
+                sub_day = {"millisecond": 1, "second": 1000,
+                           "minute": 60_000, "hour": 3_600_000}
+                if unit in sub_day:
+                    # exact-duration shift on the instant
+                    ms = ir.Call(
+                        "mul",
+                        (args[1], ir.Literal(sub_day[unit], T.BIGINT)),
+                        T.BIGINT,
+                    )
+                    return ir.Call(
+                        "tstz_shift", (args[2], ms), T.TIMESTAMP_TZ
+                    )
+                # calendar units move the wall clock in the value's zone
+                wall = ir.Call("tstz_to_ts", (args[2],), T.TIMESTAMP)
+                moved = ir.Call(
+                    "date_add", (args[0], args[1], wall), T.TIMESTAMP
+                )
+                return ir.Call(
+                    "tstz_rewall", (moved, args[2]), T.TIMESTAMP_TZ
+                )
             return ir.Call(name, args, args[2].type)
         if name == "date_diff":
             if len(args) != 3:
                 raise AnalysisError("date_diff() takes three arguments")
+            if any(a.type.kind == TSTZ_K for a in args[1:]):
+                unit = (
+                    str(args[0].value).lower()
+                    if isinstance(args[0], ir.Literal) else None
+                )
+                sub_day = ("millisecond", "second", "minute", "hour")
+                conv = (
+                    "tstz_to_instant_ts" if unit in sub_day else "tstz_to_ts"
+                )
+                new_args = [args[0]]
+                for a in args[1:]:
+                    if a.type.kind == TSTZ_K:
+                        a = ir.Call(conv, (a,), T.TIMESTAMP)
+                    new_args.append(a)
+                return ir.Call(name, tuple(new_args), T.BIGINT)
             return ir.Call(name, args, T.BIGINT)
         if name == "last_day_of_month":
             return ir.Call(name, args, T.DATE)
@@ -1468,6 +1757,7 @@ def resolve_type(t: ast.TypeName) -> T.DataType:
         "boolean": T.BOOLEAN, "tinyint": T.TINYINT, "smallint": T.SMALLINT,
         "integer": T.INTEGER, "bigint": T.BIGINT, "real": T.REAL,
         "double": T.DOUBLE, "date": T.DATE, "timestamp": T.TIMESTAMP,
+        "timestamp with time zone": T.TIMESTAMP_TZ,
     }
     if t.name in mapping:
         return mapping[t.name]

@@ -73,6 +73,14 @@ class IntervalLiteral(Expression):
 
 
 @dataclasses.dataclass(frozen=True)
+class AtTimeZone(Expression):
+    """expr AT TIME ZONE zone (parser/sql/tree/AtTimeZone.java)."""
+
+    operand: Expression
+    zone: Expression
+
+
+@dataclasses.dataclass(frozen=True)
 class Star(Expression):
     """`*` or `alias.*` in a select list or count(*)."""
 

@@ -941,6 +941,21 @@ class Parser:
             idx = self.parse_expr()
             self.expect_op("]")
             e = ast.Subscript(e, idx)
+        # postfix AT TIME ZONE 'zone' — binds tighter than * and +
+        # (SqlBase.g4 valueExpression lists AT before the arithmetic
+        # alternatives), so `ts AT TIME ZONE 'z' + interval` parses.
+        # Full three-keyword lookahead: a bare `at` stays usable as an
+        # alias/identifier
+        while (
+            self.at_kw("AT")
+            and getattr(self.peek(1), "upper", "") == "TIME"
+            and getattr(self.peek(2), "upper", "") == "ZONE"
+        ):
+            self.next()
+            self.next()
+            self.next()
+            zone = self._parse_primary()
+            e = ast.AtTimeZone(e, zone)
         return e
 
     def _parse_primary(self) -> ast.Expression:
@@ -1173,6 +1188,12 @@ class Parser:
                 ps.append(int(self.next().text))
             self.expect_op(")")
             params = tuple(ps)
+        if name == "timestamp" and self.at_kw("WITH"):
+            # TIMESTAMP [(p)] WITH TIME ZONE
+            self.next()
+            self.expect_kw("TIME")
+            self.expect_kw("ZONE")
+            return ast.TypeName("timestamp with time zone", params)
         return ast.TypeName(name, params)
 
 

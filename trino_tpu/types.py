@@ -36,6 +36,9 @@ class TypeKind(enum.Enum):
     CHAR = "char"
     DATE = "date"
     TIMESTAMP = "timestamp"
+    # packed (instant_millis << 12 | zone_id) int64 — the reference's
+    # short encoding (spi/type/DateTimeEncoding.java); ops/tz.py
+    TIMESTAMP_TZ = "timestamp with time zone"
     INTERVAL_DAY = "interval day to second"
     INTERVAL_YEAR = "interval year to month"
     ARRAY = "array"
@@ -122,6 +125,7 @@ class DataType:
         if k in (
             TypeKind.BIGINT,
             TypeKind.TIMESTAMP,
+            TypeKind.TIMESTAMP_TZ,
             TypeKind.DECIMAL,
             TypeKind.INTERVAL_DAY,
         ):
@@ -192,6 +196,7 @@ REAL = DataType(TypeKind.REAL)
 DOUBLE = DataType(TypeKind.DOUBLE)
 DATE = DataType(TypeKind.DATE)
 TIMESTAMP = DataType(TypeKind.TIMESTAMP)
+TIMESTAMP_TZ = DataType(TypeKind.TIMESTAMP_TZ)
 VARCHAR = DataType(TypeKind.VARCHAR)
 INTERVAL_DAY = DataType(TypeKind.INTERVAL_DAY)
 INTERVAL_YEAR = DataType(TypeKind.INTERVAL_YEAR)
@@ -260,6 +265,7 @@ _NUMERIC_LADDER = [
 _TEMPORAL = {
     TypeKind.DATE,
     TypeKind.TIMESTAMP,
+    TypeKind.TIMESTAMP_TZ,
     TypeKind.INTERVAL_DAY,
     TypeKind.INTERVAL_YEAR,
 }
