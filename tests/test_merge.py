@@ -1,0 +1,168 @@
+"""MERGE — VERDICT r4 item #10 (parser/sql/tree/Merge.java).
+
+Planned as a read-rewrite: survivors (target LEFT JOIN source, first
+matching WHEN MATCHED arm per row) plus inserts (NOT EXISTS anti join,
+first matching WHEN NOT MATCHED arm), with Trino's multiple-match
+cardinality error. Oracle: hand-computed upsert matrices."""
+
+import pytest
+
+from trino_tpu.engine import LocalQueryRunner, Session
+from trino_tpu.connectors.memory import create_memory_connector
+
+
+@pytest.fixture()
+def r():
+    r = LocalQueryRunner(Session(catalog="memory", schema="t"))
+    r.register_catalog("memory", create_memory_connector())
+    r.execute("create table memory.t.tgt (id bigint, v varchar, amt double)")
+    r.execute(
+        "insert into tgt values (1, 'a', 10.0), (2, 'b', 20.0), "
+        "(3, 'c', 30.0)"
+    )
+    r.execute("create table memory.t.src (id bigint, v varchar, amt double)")
+    r.execute(
+        "insert into src values (2, 'B', 200.0), (3, 'C', -1.0), "
+        "(4, 'd', 40.0), (5, 'e', 50.0)"
+    )
+    return r
+
+
+def rows(r):
+    return sorted(r.execute("select id, v, amt from tgt").rows)
+
+
+class TestMergeMatrix:
+    def test_full_upsert(self, r):
+        res = r.execute(
+            "merge into tgt t using src s on t.id = s.id "
+            "when matched and s.amt < 0 then delete "
+            "when matched then update set v = s.v, amt = s.amt "
+            "when not matched then insert (id, v, amt) "
+            "values (s.id, s.v, s.amt)"
+        )
+        # 2 matched (one deleted, one updated) + 2 inserted
+        assert res.rows == [[4]]
+        assert rows(r) == [
+            [1, "a", 10.0], [2, "B", 200.0],
+            [4, "d", 40.0], [5, "e", 50.0],
+        ]
+
+    def test_clause_order_first_match_wins(self, r):
+        r.execute(
+            "merge into tgt t using src s on t.id = s.id "
+            "when matched and s.amt < 0 then delete "
+            "when matched then update set amt = s.amt "
+            "when not matched and s.amt > 45 then insert (id, v, amt) "
+            "values (s.id, s.v, s.amt)"
+        )
+        assert rows(r) == [
+            [1, "a", 10.0], [2, "b", 200.0], [5, "e", 50.0]
+        ]
+
+    def test_update_only(self, r):
+        res = r.execute(
+            "merge into tgt t using src s on t.id = s.id "
+            "when matched then update set amt = t.amt + s.amt"
+        )
+        assert res.rows == [[2]]
+        assert rows(r) == [
+            [1, "a", 10.0], [2, "b", 220.0], [3, "c", 29.0]
+        ]
+
+    def test_delete_only(self, r):
+        res = r.execute(
+            "merge into tgt t using src s on t.id = s.id "
+            "when matched then delete"
+        )
+        assert res.rows == [[2]]
+        assert rows(r) == [[1, "a", 10.0]]
+
+    def test_insert_only_with_default_null(self, r):
+        res = r.execute(
+            "merge into tgt t using src s on t.id = s.id "
+            "when not matched then insert (id) values (s.id)"
+        )
+        assert res.rows == [[2]]
+        assert rows(r)[-2:] == [[4, None, None], [5, None, None]]
+
+    def test_subquery_source(self, r):
+        res = r.execute(
+            "merge into tgt t using "
+            "(select id, amt * 2 as amt2 from src where amt > 0) s "
+            "on t.id = s.id "
+            "when matched then update set amt = s.amt2 "
+            "when not matched then insert (id, amt) values (s.id, s.amt2)"
+        )
+        assert res.rows == [[3]]
+        assert rows(r) == [
+            [1, "a", 10.0], [2, "b", 400.0], [3, "c", 30.0],
+            [4, None, 80.0], [5, None, 100.0],
+        ]
+
+    def test_multiple_match_is_error(self, r):
+        r.execute("create table memory.t.dup (id bigint)")
+        r.execute("insert into dup values (2), (2)")
+        with pytest.raises(RuntimeError, match="more than one source row"):
+            r.execute(
+                "merge into tgt t using dup s on t.id = s.id "
+                "when matched then delete"
+            )
+        # target unchanged after the failed statement
+        assert rows(r) == [
+            [1, "a", 10.0], [2, "b", 20.0], [3, "c", 30.0]
+        ]
+
+    def test_no_matches_noop(self, r):
+        res = r.execute(
+            "merge into tgt t using (select id from src where id > 100) s "
+            "on t.id = s.id when matched then delete"
+        )
+        assert res.rows == [[0]]
+        assert len(rows(r)) == 3
+
+
+class TestScaledWriters:
+    """Writer scale-out with observed volume (SystemPartitioningHandle
+    SCALED_WRITER_* + ScaledWriterScheduler) — counter-asserted."""
+
+    def test_large_write_scales_out(self):
+        from trino_tpu.exec.operators import ScaledWriterSink
+
+        r = LocalQueryRunner(
+            Session(catalog="memory", schema="t", batch_rows=1 << 16,
+                    task_concurrency=4)
+        )
+        r.register_catalog("memory", create_memory_connector())
+        r.execute("create table memory.t.small (x bigint)")
+        r.execute("create table memory.t.big2 (x bigint)")
+        before = dict(ScaledWriterSink.COUNTERS)
+        # small write: one writer
+        r.execute("insert into small values (1), (2)")
+        assert ScaledWriterSink.COUNTERS["scale_ups"] == before["scale_ups"]
+        # integration: a bulk insert routes through the scaled sink
+        r.execute(
+            "insert into big2 select x from unnest(sequence(1, 5000)) "
+            "as u(x)"
+        )
+        assert r.execute("select count(*) from big2").rows == [[5000]]
+        # volume-based scaling needs real volume; drive the sink
+        # directly for a deterministic assert
+        made = []
+        class FakeSink:
+            def __init__(self):
+                made.append(self)
+                self.rows = 0
+            def append(self, b):
+                self.rows += b.capacity
+            def finish(self):
+                return self.rows
+        class FakeBatch:
+            capacity = 1 << 20
+        s = ScaledWriterSink(FakeSink, max_writers=4, scale_rows=1 << 21)
+        for _ in range(12):
+            s.append(FakeBatch())
+        total = s.finish()
+        assert total == 12 * (1 << 20)
+        assert len(made) > 1, "writer count never scaled"
+        assert ScaledWriterSink.COUNTERS["max_writers"] >= len(made)

@@ -331,6 +331,8 @@ class LocalQueryRunner:
             return self._execute_insert(stmt.table, stmt.columns, stmt.query)
         if isinstance(stmt, ast.Delete):
             return self._execute_rewrite_dml(stmt.table, stmt.where, None)
+        if isinstance(stmt, ast.Merge):
+            return self._execute_merge(stmt)
         if isinstance(stmt, ast.Update):
             names = [c for c, _ in stmt.assignments]
             if len(set(names)) != len(names):
@@ -572,52 +574,282 @@ class LocalQueryRunner:
                 items.append(ast.SelectItem(e, c.name))
             rewrite_q = ast.Query(ast.QuerySpec(tuple(items), from_=rel))
 
-        output = self._analyze(rewrite_q)
-        # SET-clause subqueries may scan other tables: same SELECT
-        # access checks as any query
-        self._check_scans(output)
-        # coerce rewritten columns back onto the table schema (UPDATE
-        # expressions may widen types), same as the INSERT path
+        self._replace_table_from_queries(conn, handle, meta, [rewrite_q])
+        return MaterializedResult([[affected]], ["rows"], [T.BIGINT])
+
+    def _replace_table_from_queries(self, conn, handle, meta, queries) -> None:
+        """Materialize each rewrite query, coerce onto the table
+        schema, and swap the combined batches in as the table's new
+        contents (shared by DELETE/UPDATE/MERGE read-rewrites; MERGE
+        runs survivors and inserts as separate queries so their string
+        columns keep independent dictionaries)."""
         from trino_tpu.expr import ir
         from trino_tpu.sql import plan as P
 
-        exprs = []
-        for i, col in enumerate(meta.columns):
-            e: ir.Expr = ir.InputRef(i, output.fields[i].type)
-            if output.fields[i].type != col.type:
-                e = ir.Cast(e, col.type)
-            exprs.append(e)
-        fields = tuple(P.Field(c.name, c.type) for c in meta.columns)
-        node = P.ProjectNode(output.child, tuple(exprs), fields)
-        planner = LocalPlanner(
-            self.catalogs,
-            batch_rows=self.session.batch_rows,
-            target_splits=self.session.target_splits,
-            dynamic_filtering=self.session.enable_dynamic_filtering,
-        )
-        physical = planner.plan(node)
-        ctx = self._execution_ctx()
-        pipelines, chain = physical.instantiate(ctx)
-        sink = CollectorSink()
-        chain.append(sink)
-        for p in pipelines:
-            Driver(p).run()
-        Driver(Pipeline(chain)).run()
-        _raise_deferred_checks(ctx)
+        batches = []
+        counts = []
+        for rewrite_q in queries:
+            output = self._analyze(rewrite_q)
+            # rewrite subqueries may scan other tables: same SELECT
+            # access checks as any query
+            self._check_scans(output)
+            # coerce rewritten columns back onto the table schema
+            # (UPDATE expressions may widen types), as the INSERT path
+            exprs = []
+            for i, col in enumerate(meta.columns):
+                e: ir.Expr = ir.InputRef(i, output.fields[i].type)
+                if output.fields[i].type != col.type:
+                    e = ir.Cast(e, col.type)
+                exprs.append(e)
+            fields = tuple(P.Field(c.name, c.type) for c in meta.columns)
+            node = P.ProjectNode(output.child, tuple(exprs), fields)
+            planner = LocalPlanner(
+                self.catalogs,
+                batch_rows=self.session.batch_rows,
+                target_splits=self.session.target_splits,
+                dynamic_filtering=self.session.enable_dynamic_filtering,
+            )
+            physical = planner.plan(node)
+            ctx = self._execution_ctx()
+            pipelines, chain = physical.instantiate(ctx)
+            sink = CollectorSink()
+            chain.append(sink)
+            for p in pipelines:
+                Driver(p).run()
+            Driver(Pipeline(chain)).run()
+            _raise_deferred_checks(ctx)
+            counts.append(sum(int(b.row_count()) for b in sink.batches))
+            batches.extend(sink.batches)
         # commit the rewrite: connectors with replace_rows do it
         # atomically (stage-then-swap); the fallback truncate+append is
         # NOT crash-atomic
         replace = getattr(conn, "replace_rows", None)
         if replace is not None:
-            replace(handle, sink.batches)
+            replace(handle, batches)
         else:
             conn.metadata.truncate_table(handle)
             writer_sink = conn.page_sink(handle)
-            for b in sink.batches:
+            for b in batches:
                 writer_sink.append(b)
             writer_sink.finish()
         self._invalidate_plans()
-        return MaterializedResult([[affected]], ["rows"], [T.BIGINT])
+        return counts
+
+    def _execute_merge(self, stmt: ast.Merge) -> MaterializedResult:
+        """MERGE as a read-rewrite over the existing query machinery
+        (parser/sql/tree/Merge.java; the reference plans MERGE onto its
+        row-change paradigm — here the whole statement compiles to ONE
+        survivors-UNION-ALL-inserts query that becomes the table's new
+        contents, the same strategy as DELETE/UPDATE):
+
+        - survivors: target LEFT JOIN source; per column a CASE chain
+          applies the FIRST matching WHEN MATCHED arm; rows whose first
+          arm is DELETE drop.
+        - inserts: source rows with NO target match (NOT EXISTS) and a
+          matching WHEN NOT MATCHED arm.
+        - a target row matching >1 source rows is an error (Trino's
+          MERGE cardinality rule), checked with a row_number-keyed
+          grouped count before the rewrite."""
+        from trino_tpu.transaction import TransactionError
+
+        conn, schema, table = self._resolve_target(stmt.table)
+        # each privilege gates only on the arms actually present
+        # (Trino checks UPDATE/DELETE/INSERT per MERGE case kind)
+        if any(c.action == "update" for c in stmt.clauses):
+            self.access_control.check_can_update(
+                self.identity, conn.name, schema, table
+            )
+        if any(not c.matched for c in stmt.clauses):
+            self.access_control.check_can_insert(
+                self.identity, conn.name, schema, table
+            )
+        if any(c.action == "delete" for c in stmt.clauses):
+            self.access_control.check_can_delete(
+                self.identity, conn.name, schema, table
+            )
+        self._check_writable()
+        if self._active_txn() is not None:
+            raise TransactionError(
+                "MERGE inside an explicit transaction is not supported"
+            )
+        handle = conn.metadata.get_table_handle(schema, table)
+        if handle is None:
+            raise AnalysisError(f"table {schema}.{table} does not exist")
+        meta = conn.metadata.get_table_metadata(handle)
+        known = {c.name for c in meta.columns}
+        for cl in stmt.clauses:
+            for col, _ in cl.assignments:
+                if col not in known:
+                    raise AnalysisError(f"unknown column {col} in MERGE")
+            if cl.action == "insert":
+                cols = cl.insert_columns or tuple(
+                    c.name for c in meta.columns
+                )
+                if len(cols) != len(cl.insert_values):
+                    raise AnalysisError(
+                        "MERGE INSERT column/value count mismatch"
+                    )
+                for col in cols:
+                    if col not in known:
+                        raise AnalysisError(
+                            f"unknown column {col} in MERGE INSERT"
+                        )
+
+        t_alias = stmt.target_alias or table
+        s_alias = getattr(stmt.source, "alias", None)
+        if s_alias is None and isinstance(stmt.source, ast.TableRef):
+            s_alias = stmt.source.name[-1]
+        if s_alias is None:
+            raise AnalysisError("MERGE source requires an alias")
+        target_rel = ast.TableRef(stmt.table, alias=t_alias)
+        true_lit = ast.BooleanLiteral(True)
+        false_lit = ast.BooleanLiteral(False)
+
+        def tcol(name: str) -> ast.Identifier:
+            return ast.Identifier((t_alias, name))
+
+        # cardinality rule: no target row may match more than one
+        # source row (io.trino MERGE_TARGET_ROW_MULTIPLE_MATCHES)
+        rid_target = ast.SubqueryRelation(
+            ast.Query(ast.QuerySpec(
+                (ast.SelectItem(ast.Star()),
+                 ast.SelectItem(
+                     ast.WindowCall("row_number", (), ast.WindowSpec()),
+                     "__merge_rid",
+                 )),
+                from_=ast.TableRef(stmt.table),
+            )),
+            alias=t_alias,
+        )
+        dup_q = ast.Query(ast.QuerySpec(
+            (ast.SelectItem(ast.FunctionCall("count", (ast.Star(),))),),
+            from_=ast.SubqueryRelation(
+                ast.Query(ast.QuerySpec(
+                    (ast.SelectItem(tcol("__merge_rid")),),
+                    from_=ast.Join(
+                        "inner", rid_target, stmt.source, stmt.on
+                    ),
+                    group_by=(tcol("__merge_rid"),),
+                    having=ast.BinaryOp(
+                        "gt",
+                        ast.FunctionCall("count", (ast.Star(),)),
+                        ast.NumberLiteral("1"),
+                    ),
+                )),
+                alias="__merge_dups",
+            ),
+        ))
+        if self._execute_query(dup_q).only_value() > 0:
+            raise RuntimeError(
+                "One MERGE target table row matched more than one "
+                "source row"
+            )
+
+        # matched flag rides the source side of the LEFT JOIN
+        flagged_source = ast.SubqueryRelation(
+            ast.Query(ast.QuerySpec(
+                (ast.SelectItem(ast.Star()),
+                 ast.SelectItem(true_lit, "__merge_m")),
+                from_=stmt.source,
+            )),
+            alias=s_alias,
+        )
+        matched = ast.FunctionCall(
+            "coalesce",
+            (ast.Identifier((s_alias, "__merge_m")), false_lit),
+        )
+        m_clauses = [c for c in stmt.clauses if c.matched]
+        nm_clauses = [c for c in stmt.clauses if not c.matched]
+
+        # survivors: per column, the FIRST matching arm's value
+        items = []
+        for col in meta.columns:
+            old = tcol(col.name)
+            whens = []
+            for cl in m_clauses:
+                cond = cl.condition or true_lit
+                val = dict(cl.assignments).get(col.name, old) \
+                    if cl.action == "update" else old
+                whens.append(ast.WhenClause(
+                    ast.BinaryOp("and", matched, cond), val
+                ))
+            e = ast.Case(None, tuple(whens), old) if whens else old
+            items.append(ast.SelectItem(e, col.name))
+        # a row drops iff matched AND its first applicable arm is DELETE
+        del_whens = [
+            ast.WhenClause(
+                cl.condition or true_lit,
+                true_lit if cl.action == "delete" else false_lit,
+            )
+            for cl in m_clauses
+        ]
+        drop = ast.BinaryOp(
+            "and", matched,
+            ast.Case(None, tuple(del_whens), false_lit)
+            if del_whens else false_lit,
+        )
+        survivors = ast.QuerySpec(
+            tuple(items),
+            from_=ast.Join("left", target_rel, flagged_source, stmt.on),
+            where=ast.UnaryOp("not", drop),
+        )
+
+        # affected rows: matched pairs whose first arm applies + inserts
+        m_any = None
+        for cl in m_clauses:
+            c = cl.condition or true_lit
+            m_any = c if m_any is None else ast.BinaryOp("or", m_any, c)
+        updated = 0
+        if m_clauses:
+            updated = self._execute_query(ast.Query(ast.QuerySpec(
+                (ast.SelectItem(ast.FunctionCall("count", (ast.Star(),))),),
+                from_=ast.Join("inner", target_rel, stmt.source, stmt.on),
+                where=m_any,
+            ))).only_value()
+
+        if nm_clauses:
+            anti = ast.Exists(ast.Query(ast.QuerySpec(
+                (ast.SelectItem(ast.NumberLiteral("1")),),
+                from_=target_rel,
+                where=stmt.on,
+            )), negated=True)
+            nm_any = None
+            for cl in nm_clauses:
+                c = cl.condition or true_lit
+                nm_any = c if nm_any is None else ast.BinaryOp("or", nm_any, c)
+            ins_items = []
+            for col in meta.columns:
+                whens = []
+                for cl in nm_clauses:
+                    cols = cl.insert_columns or tuple(
+                        c.name for c in meta.columns
+                    )
+                    vmap = dict(zip(cols, cl.insert_values))
+                    val = vmap.get(col.name, ast.NullLiteral())
+                    whens.append(ast.WhenClause(
+                        cl.condition or true_lit, val
+                    ))
+                ins_items.append(ast.SelectItem(
+                    ast.Case(None, tuple(whens), ast.NullLiteral()),
+                    col.name,
+                ))
+            ins_where = ast.BinaryOp("and", anti, nm_any)
+            insert_spec = ast.QuerySpec(
+                tuple(ins_items), from_=stmt.source, where=ins_where,
+            )
+
+        queries = [ast.Query(survivors)]
+        if nm_clauses:
+            queries.append(ast.Query(insert_spec))
+        counts = self._replace_table_from_queries(
+            conn, handle, meta, queries
+        )
+        # the insert rewrite IS the anti-join — its materialized row
+        # count is the inserted count (no third join execution)
+        inserted = counts[1] if nm_clauses else 0
+        return MaterializedResult(
+            [[updated + inserted]], ["rows"], [T.BIGINT]
+        )
 
     def _write_into(
         self, conn, schema: str, table: str, output: OutputNode,
@@ -671,9 +903,19 @@ class LocalQueryRunner:
             if active is not None
             else None
         )
-        writer = TableWriterOperator(
-            conn.page_sink(handle, transaction=txn_handle)
-        )
+        if txn_handle is None and self.session.task_concurrency > 1:
+            # autocommit bulk writes scale out with observed volume
+            # (ScaledWriterSink); transactional writes keep ONE sink so
+            # the commit stays a single handshake
+            from trino_tpu.exec.operators import ScaledWriterSink
+
+            sink_impl = ScaledWriterSink(
+                lambda: conn.page_sink(handle),
+                max_writers=self.session.task_concurrency,
+            )
+        else:
+            sink_impl = conn.page_sink(handle, transaction=txn_handle)
+        writer = TableWriterOperator(sink_impl)
         chain.append(writer)
         for p in pipelines:
             Driver(p).run()
@@ -736,6 +978,12 @@ class LocalQueryRunner:
             # access control re-checks on every execution, cached or not
             self._check_scans(cached[0])
             return cached
+        from trino_tpu.sql.analyzer import (
+            plan_is_volatile,
+            reset_volatile_plan,
+        )
+
+        reset_volatile_plan()
         with TRACER.span("analyze"):
             output = self._analyze(q)
         self._check_scans(output)
@@ -747,7 +995,9 @@ class LocalQueryRunner:
                 dynamic_filtering=self.session.enable_dynamic_filtering,
             )
             physical = planner.plan(output)
-        if cache_key:
+        # plans with analysis-time-folded volatile values (now(),
+        # current_date, uuid()) re-analyze every execution
+        if cache_key and not plan_is_volatile():
             self._plan_cache[cache_key] = (output, physical)
         return output, physical
 

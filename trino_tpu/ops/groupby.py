@@ -264,7 +264,6 @@ def split_limb_keys(keys, valids):
 
 
 def _key_order(keys, valids, mask, order=None, seed: int = 0):
-    keys, valids = split_limb_keys(keys, valids)
     """Stable permutation grouping equal key tuples (NULL == NULL),
     live rows first. MUST order groups exactly like sort_group_reduce
     so order-statistic kernels' slots align with its group slots:
@@ -277,6 +276,7 @@ def _key_order(keys, valids, mask, order=None, seed: int = 0):
     stability preserves it)."""
     from trino_tpu.ops.sort import _order_value
 
+    keys, valids = split_limb_keys(keys, valids)
     n = mask.shape[0]
     if order is None:
         order = jnp.arange(n, dtype=jnp.int32)

@@ -13,11 +13,14 @@ with zero unpacking). Zone rules become per-zone sorted transition
 tables; the offset at an instant is one `searchsorted` + `take` on
 device — no per-row host callbacks, no data-dependent control flow.
 
-Documented deviation: two values naming the SAME instant in DIFFERENT
-zones compare unequal here (the zone id tie-breaks), where Trino
-compares instants only. Mixed-zone columns arise only from
-heterogeneous varchar parsing; uniform-zone columns (the practical
-case) behave identically.
+Documented deviation: COMPARISONS (=, <, BETWEEN, IN, IS DISTINCT)
+strip the zone bits and compare instants only — Trino semantics. The
+KEY paths (GROUP BY, JOIN keys, DISTINCT, hash partitioning) still key
+on the full packed value, so two values naming the same instant in
+DIFFERENT zones group/join as distinct where Trino conflates them.
+Mixed-zone columns arise only from heterogeneous varchar parsing;
+uniform-zone columns (the practical case) behave identically on every
+path.
 
 The zone registry is deterministic: UTC = 0; fixed offsets ±14:00 map
 minutes -840..840 onto ids 1..1681; IANA names (sorted) start at 1800.

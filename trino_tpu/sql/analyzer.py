@@ -217,6 +217,11 @@ def _unify_types(types: Sequence[T.DataType]) -> T.DataType:
 # concurrent server queries isolated (Session.java getTimeZoneKey).
 _SESSION_ZONE = contextvars.ContextVar("trino_tpu_session_zone", default="UTC")
 
+# set when analysis folds a VOLATILE value (now()/current_date/...)
+# into the plan — such plans must not enter the SQL-text plan cache
+# (a cached `select now()` would return its first timestamp forever)
+_VOLATILE_PLAN = contextvars.ContextVar("trino_tpu_volatile_plan", default=False)
+
 
 def session_zone() -> str:
     return _SESSION_ZONE.get()
@@ -224,6 +229,18 @@ def session_zone() -> str:
 
 def set_session_zone(zone: str) -> None:
     _SESSION_ZONE.set(zone)
+
+
+def reset_volatile_plan() -> None:
+    _VOLATILE_PLAN.set(False)
+
+
+def mark_volatile_plan() -> None:
+    _VOLATILE_PLAN.set(True)
+
+
+def plan_is_volatile() -> bool:
+    return _VOLATILE_PLAN.get()
 
 
 # functions whose tstz argument reads the LOCAL wall clock in the
@@ -358,6 +375,9 @@ class ExprConverter:
                 lit = self.convert(o)
                 if not isinstance(lit, ir.Literal):
                     raise AnalysisError("IN list items must be literals")
+                v, lit = self._coerce_temporal_pair(v, lit)
+                if not isinstance(lit, ir.Literal):
+                    raise AnalysisError("IN list items must be literals")
                 opts.append(lit)
             x: ir.Expr = ir.InList(v, tuple(opts))
             return ir.not_(x) if e.negated else x
@@ -435,7 +455,9 @@ class ExprConverter:
             )
             return ir.comparison(op, l, r)
         if op == "is_distinct":
-            l, r = self.convert(e.left), self.convert(e.right)
+            l, r = self._coerce_temporal_pair(
+                self.convert(e.left), self.convert(e.right)
+            )
             # NOT ((a=b, null-safe false) OR (a NULL AND b NULL)) — the
             # eq lane must be made definite (coalesce) so the result is
             # never NULL, matching Trino's IS DISTINCT FROM
@@ -550,6 +572,22 @@ class ExprConverter:
         plain = (T.TypeKind.TIMESTAMP, T.TypeKind.DATE)
 
         def lift(x: ir.Expr) -> ir.Expr:
+            if isinstance(x, ir.Literal):
+                # fold at analysis time so IN-list items stay literals
+                if x.value is None:
+                    return ir.Literal(None, T.TIMESTAMP_TZ)
+                from trino_tpu.ops import tz as TZ
+
+                micros = int(x.value)
+                if x.type.kind == T.TypeKind.DATE:
+                    micros = micros * 86_400_000_000
+                zid = TZ.zone_id(session_zone())
+                wall_ms = micros // 1000
+                off1 = TZ.offset_millis_py(zid, wall_ms)
+                off2 = TZ.offset_millis_py(zid, wall_ms - off1)
+                return ir.Literal(
+                    TZ.pack_py(wall_ms - off2, zid), T.TIMESTAMP_TZ
+                )
             if x.type.kind == T.TypeKind.DATE:
                 x = ir.Cast(x, T.TIMESTAMP)
             return self._cast_to(x, T.TIMESTAMP_TZ)
@@ -569,6 +607,7 @@ class ExprConverter:
         from trino_tpu.ops import tz as TZ
 
         if name == "current_timestamp":
+            mark_volatile_plan()
             return ir.Literal(
                 TZ.pack_py(
                     int(_time.time() * 1000), TZ.zone_id(session_zone())
@@ -576,6 +615,7 @@ class ExprConverter:
                 T.TIMESTAMP_TZ,
             )
         if name in ("current_date", "localtimestamp"):
+            mark_volatile_plan()
             zid = TZ.zone_id(session_zone())
             now_ms = int(_time.time() * 1000)
             wall_ms = now_ms + TZ.offset_millis_py(zid, now_ms)
@@ -660,6 +700,7 @@ class ExprConverter:
                 raise AnalysisError("now() takes no arguments")
             # now()/current_timestamp: TIMESTAMP WITH TIME ZONE at the
             # session zone (DateTimeFunctions.java currentTimestamp)
+            mark_volatile_plan()
             return ir.Literal(
                 TZ.pack_py(
                     int(_time.time() * 1000), TZ.zone_id(session_zone())
@@ -701,6 +742,7 @@ class ExprConverter:
         if name == "uuid":
             import uuid as _uuid
 
+            mark_volatile_plan()
             return ir.Literal(str(_uuid.uuid4()), T.VARCHAR)
         if name == "version":
             return ir.Literal("trino_tpu 0.4", T.VARCHAR)
@@ -2982,7 +3024,7 @@ class Analyzer:
     def _plan_predicate(self, builder: Builder, e: ast.Expression, ctes) -> None:
         for conj in split_conjuncts(e):
             if isinstance(conj, ast.Exists):
-                self._plan_exists(builder, conj.query, False, ctes)
+                self._plan_exists(builder, conj.query, conj.negated, ctes)
                 continue
             if (
                 isinstance(conj, ast.UnaryOp)

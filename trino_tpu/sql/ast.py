@@ -495,6 +495,31 @@ class Update(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class MergeClause(Node):
+    """One WHEN [NOT] MATCHED [AND cond] THEN action arm
+    (parser/sql/tree/MergeCase.java subclasses)."""
+
+    matched: bool
+    condition: Optional[Expression]
+    action: str  # "update" | "delete" | "insert"
+    assignments: Tuple[Tuple[str, Expression], ...] = ()
+    insert_columns: Optional[Tuple[str, ...]] = None
+    insert_values: Tuple[Expression, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class Merge(Node):
+    """MERGE INTO target USING source ON cond WHEN ... THEN ...
+    (parser/sql/tree/Merge.java)."""
+
+    table: Tuple[str, ...]
+    target_alias: Optional[str]
+    source: Relation
+    on: Expression
+    clauses: Tuple[MergeClause, ...]
+
+
+@dataclasses.dataclass(frozen=True)
 class StartTransaction(Node):
     """START TRANSACTION [READ ONLY | READ WRITE] (isolation modes are
     accepted and ignored — the reference's connectors mostly run

@@ -251,6 +251,8 @@ class Parser:
             if self.accept_kw("WHERE"):
                 where = self.parse_expr()
             stmt = ast.Update(table, tuple(assignments), where)
+        elif self.at_kw("MERGE"):
+            stmt = self._parse_merge()
         elif self.at_kw("DROP"):
             self.next()
             self.expect_kw("TABLE")
@@ -522,6 +524,69 @@ class Parser:
                 self.expect_kw("LAST")
                 nulls_first = False
         return ast.SortItem(expr, descending, nulls_first)
+
+    def _parse_merge(self) -> "ast.Merge":
+        """MERGE INTO target [[AS] alias] USING source ON cond
+        WHEN [NOT] MATCHED [AND c] THEN UPDATE SET ... | DELETE |
+        INSERT [(cols)] VALUES (...)  (parser/sql/tree/Merge.java)."""
+        self.next()
+        self.expect_kw("INTO")
+        table = self._parse_qualified_name()
+        target_alias = None
+        if self.accept_kw("AS"):
+            target_alias = self._parse_name()
+        elif self.peek().kind == "ident" and not self.at_kw("USING"):
+            target_alias = self._parse_name()
+        self.expect_kw("USING")
+        source = self._parse_table_primary()
+        self.expect_kw("ON")
+        on = self.parse_expr()
+        clauses = []
+        while self.at_kw("WHEN"):
+            self.next()
+            matched = not self.accept_kw("NOT")
+            self.expect_kw("MATCHED")
+            cond = None
+            if self.accept_kw("AND"):
+                cond = self.parse_expr()
+            self.expect_kw("THEN")
+            if matched and self.accept_kw("UPDATE"):
+                self.expect_kw("SET")
+                assignments = []
+                while True:
+                    col = self._parse_name()
+                    self.expect_op("=")
+                    assignments.append((col, self.parse_expr()))
+                    if not self.accept_op(","):
+                        break
+                clauses.append(ast.MergeClause(
+                    True, cond, "update", tuple(assignments)
+                ))
+            elif matched and self.accept_kw("DELETE"):
+                clauses.append(ast.MergeClause(True, cond, "delete"))
+            elif not matched and self.accept_kw("INSERT"):
+                cols = None
+                if self.at_op("("):
+                    self.next()
+                    cols = self._parse_name_list()
+                self.expect_kw("VALUES")
+                self.expect_op("(")
+                vals = [self.parse_expr()]
+                while self.accept_op(","):
+                    vals.append(self.parse_expr())
+                self.expect_op(")")
+                clauses.append(ast.MergeClause(
+                    False, cond, "insert",
+                    insert_columns=cols, insert_values=tuple(vals),
+                ))
+            else:
+                raise self.error(
+                    "expected UPDATE/DELETE (matched) or INSERT "
+                    "(not matched)"
+                )
+        if not clauses:
+            raise self.error("MERGE requires at least one WHEN clause")
+        return ast.Merge(table, target_alias, source, on, tuple(clauses))
 
     # -- relations --
     def _parse_relation(self) -> ast.Relation:
