@@ -1,0 +1,152 @@
+"""r5 function breadth — VERDICT r4 item #6.
+
+qdigest family (quantile parity vs Python statistics), split_to_map,
+session pseudo-columns, format_datetime Joda tokens, and the catalog
+row count (SHOW FUNCTIONS lists one row per genuinely-accepted
+overload, the reference's unit — SystemFunctionBundle.java:351)."""
+
+import statistics
+
+import pytest
+
+from trino_tpu.engine import LocalQueryRunner, Session
+from trino_tpu.connectors.memory import create_memory_connector
+
+
+@pytest.fixture(scope="module")
+def r():
+    r = LocalQueryRunner(
+        Session(catalog="memory", schema="t", user="alice")
+    )
+    r.register_catalog("memory", create_memory_connector())
+    return r
+
+
+class TestQdigest:
+    @pytest.fixture(scope="class")
+    def rq(self, r):
+        import random
+
+        random.seed(7)
+        self_vals = [
+            (i % 3, random.gauss(100, 25)) for i in range(600)
+        ]
+        r.execute("create table memory.t.qd (g bigint, x double)")
+        r.execute(
+            "insert into qd values "
+            + ", ".join(f"({g},{x})" for g, x in self_vals)
+        )
+        return r, self_vals
+
+    def test_value_at_quantile(self, rq):
+        r, vals = rq
+        rows = r.execute(
+            "select g, value_at_quantile(qdigest_agg(x), 0.5) "
+            "from qd group by g order by g"
+        ).rows
+        for g, med in rows:
+            exp = statistics.median([x for gg, x in vals if gg == g])
+            assert abs(med - exp) <= 3.0, (g, med, exp)
+
+    def test_values_at_quantiles(self, rq):
+        r, vals = rq
+        rows = r.execute(
+            "select g, values_at_quantiles(qdigest_agg(x), "
+            "array[0.1, 0.5, 0.9]) from qd group by g order by g"
+        ).rows
+        for g, arr in rows:
+            assert len(arr) == 3
+            assert arr[0] <= arr[1] <= arr[2]
+            exp = statistics.median([x for gg, x in vals if gg == g])
+            assert abs(arr[1] - exp) <= 3.0
+
+    def test_qdigest_bigint(self, rq):
+        r, _ = rq
+        (v,) = r.execute(
+            "select value_at_quantile(qdigest_agg(g), 0.99) from qd"
+        ).rows[0]
+        assert v == 2.0
+
+    def test_quantile_at_value(self, rq):
+        r, vals = rq
+        (q,) = r.execute(
+            "select quantile_at_value(qdigest_agg(x), 100.0) from qd"
+        ).rows[0]
+        frac = sum(1 for _, x in vals if x <= 100.0) / len(vals)
+        assert abs(q - frac) < 0.1
+
+
+class TestSplitToMap:
+    def test_basic(self, r):
+        r.execute("create table memory.t.sm (txt varchar)")
+        r.execute(
+            "insert into sm values ('a=1,b=2'), ('k=v'), ('')"
+        )
+        rows = r.execute("select split_to_map(txt, ',', '=') from sm").rows
+        assert rows[0][0] == {"a": "1", "b": "2"}
+        assert rows[1][0] == {"k": "v"}
+        assert rows[2][0] == {}
+
+    def test_element_and_cardinality(self, r):
+        rows = r.execute(
+            "select element_at(split_to_map(txt, ',', '='), 'a'), "
+            "cardinality(split_to_map(txt, ',', '=')) from sm order by 2"
+        ).rows
+        assert [x[1] for x in rows] == [0, 1, 2]
+
+
+class TestSessionPseudoColumns:
+    def test_current_catalog_schema_user(self, r):
+        rows = r.execute(
+            "select current_catalog, current_schema, current_user"
+        ).rows
+        assert rows == [["memory", "t", "alice"]]
+
+
+class TestJodaTokens:
+    def test_full_month_day_names(self, r):
+        (v,) = r.execute(
+            "select format_datetime(timestamp '2024-07-04 15:30:45', "
+            "'EEEE, MMMM d yyyy')"
+        ).rows[0]
+        assert v == "Thursday, July 04 2024"
+
+    def test_day_of_year_and_half_day(self, r):
+        (v,) = r.execute(
+            "select format_datetime(timestamp '2024-02-01 13:05:00', "
+            "'DDD h a')"
+        ).rows[0]
+        assert v == "032 01 PM"
+
+    def test_parse_full_month(self, r):
+        (v,) = r.execute(
+            "select parse_datetime('July 4, 2024', 'MMMM d, yyyy')"
+        ).rows[0]
+        import datetime as dt
+
+        assert v == int(
+            (dt.datetime(2024, 7, 4) - dt.datetime(1970, 1, 1))
+            .total_seconds() * 1e6
+        )
+
+    def test_format_tstz_wall_clock(self, r):
+        (v,) = r.execute(
+            "select format_datetime(timestamp "
+            "'2024-07-04 15:30:45 America/New_York', 'yyyy-MM-dd HH:mm')"
+        ).rows[0]
+        assert v == "2024-07-04 15:30"
+
+
+class TestCatalogBreadth:
+    def test_row_count_and_agg_rows(self, r):
+        rows = r.execute("show functions").rows
+        assert len(rows) >= 630, len(rows)
+        aggs = [x for x in rows if str(x[3]).lower() == "aggregate"]
+        assert len(aggs) >= 200, len(aggs)
+
+    def test_generic_overload_types_listed(self, r):
+        rows = r.execute("show functions").rows
+        min_rows = [x for x in rows if x[0] == "min"]
+        assert len(min_rows) >= 12
+        sigs = " ".join(str(x[1]) for x in min_rows)
+        assert "timestamp with time zone" in sigs

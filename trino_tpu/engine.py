@@ -433,10 +433,17 @@ class LocalQueryRunner:
         raise AnalysisError(f"cannot execute {type(stmt).__name__}")
 
     def _analyze(self, q: ast.Query) -> OutputNode:
-        from trino_tpu.sql.analyzer import set_session_zone
+        from trino_tpu.sql.analyzer import (
+            set_session_info,
+            set_session_zone,
+        )
         from trino_tpu.sql.optimizer import optimize
 
         set_session_zone(self.session.timezone)
+        set_session_info(
+            self.session.catalog, self.session.schema,
+            self.identity.user,
+        )
         analyzer = Analyzer(self.catalogs, self.session.catalog, self.session.schema)
         return optimize(analyzer.plan(q), self.catalogs, self.session)
 
@@ -577,12 +584,15 @@ class LocalQueryRunner:
         self._replace_table_from_queries(conn, handle, meta, [rewrite_q])
         return MaterializedResult([[affected]], ["rows"], [T.BIGINT])
 
-    def _replace_table_from_queries(self, conn, handle, meta, queries) -> None:
+    def _replace_table_from_queries(
+        self, conn, handle, meta, queries
+    ) -> List[int]:
         """Materialize each rewrite query, coerce onto the table
         schema, and swap the combined batches in as the table's new
         contents (shared by DELETE/UPDATE/MERGE read-rewrites; MERGE
         runs survivors and inserts as separate queries so their string
-        columns keep independent dictionaries)."""
+        columns keep independent dictionaries). Returns the per-query
+        materialized row counts (MERGE reads the insert count)."""
         from trino_tpu.expr import ir
         from trino_tpu.sql import plan as P
 
@@ -678,7 +688,12 @@ class LocalQueryRunner:
         meta = conn.metadata.get_table_metadata(handle)
         known = {c.name for c in meta.columns}
         for cl in stmt.clauses:
-            for col, _ in cl.assignments:
+            set_names = [c for c, _ in cl.assignments]
+            if len(set(set_names)) != len(set_names):
+                raise AnalysisError(
+                    "multiple assignments for the same column in MERGE"
+                )
+            for col in set_names:
                 if col not in known:
                     raise AnalysisError(f"unknown column {col} in MERGE")
             if cl.action == "insert":
@@ -739,7 +754,10 @@ class LocalQueryRunner:
                 alias="__merge_dups",
             ),
         ))
-        if self._execute_query(dup_q).only_value() > 0:
+        if (
+            any(c.matched for c in stmt.clauses)
+            and self._execute_query(dup_q).only_value() > 0
+        ):
             raise RuntimeError(
                 "One MERGE target table row matched more than one "
                 "source row"

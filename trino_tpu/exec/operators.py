@@ -2040,6 +2040,18 @@ class HashAggregationOperator(Operator):
                 tdigest_value_at_quantile,
             )
 
+            if a.post == "vaq":
+                # values_at_quantiles: one array(double) per group
+                arrs: List[object] = [None] * cap
+                for g in range(n_h):
+                    d = out_vals[g]
+                    if d is None:
+                        continue
+                    arrs[g] = [
+                        tdigest_value_at_quantile(d, float(q))
+                        for q in (a.param or ())
+                    ]
+                return Column.from_pylist(a.out_type, arrs, capacity=cap)
             data = np.zeros(
                 cap, dtype=np.int64 if a.post == "card" else np.float64
             )

@@ -2316,6 +2316,115 @@ class ExprBinder:
                 args[0], T.DOUBLE, lambda s, qv=qv, fn=fn: fn(s, qv),
                 jnp.float64,
             )
+        if name == "split_to_map":
+            from trino_tpu.block import MapColumn
+
+            a = args[0]
+            for i in (1, 2):
+                assert args[i].is_const, (
+                    "split_to_map() delimiters must be constants"
+                )
+            ed, kd = str(args[1].const_value), str(args[2].const_value)
+            values = a.dictionary.values if a.dictionary else []
+            per_code = []
+            for v in values:
+                pairs = []
+                for entry in (v.split(ed) if v else []):
+                    if not entry:
+                        continue
+                    k, _, val = entry.partition(kd)
+                    pairs.append((k, val))
+                if len({k for k, _ in pairs}) != len(pairs):
+                    raise ValueError(
+                        "split_to_map() duplicate keys in input"
+                    )
+                per_code.append(pairs)
+            W = max((len(p) for p in per_code), default=1)
+            key_dict = Dictionary(
+                sorted({k for ps in per_code for k, _ in ps}) or [""]
+            )
+            val_dict = Dictionary(
+                sorted({v for ps in per_code for _, v in ps}) or [""]
+            )
+            kt = np.zeros((max(len(values), 1), W), dtype=np.int32)
+            vt = np.zeros((max(len(values), 1), W), dtype=np.int32)
+            lens = np.zeros(max(len(values), 1), dtype=np.int32)
+            for c, ps in enumerate(per_code):
+                lens[c] = len(ps)
+                for j, (k, v) in enumerate(ps):
+                    kt[c, j] = key_dict.code(k)
+                    vt[c, j] = val_dict.code(v)
+            kt_j, vt_j, lens_j = map(jnp.asarray, (kt, vt, lens))
+            out_t = e.type
+
+            def smfn(cols, valids):
+                d, v = a.fn(cols, valids)
+                code = jnp.clip(d, 0, max(len(values) - 1, 0))
+                rows = code.shape[0]
+                return (
+                    MapColumn(
+                        out_t, take_clip(lens_j, code), v, None,
+                        jnp.arange(rows, dtype=jnp.int32) * W,
+                        Column(
+                            T.VARCHAR,
+                            jnp.take(kt_j, code, axis=0).reshape(-1),
+                            None, key_dict,
+                        ),
+                        Column(
+                            T.VARCHAR,
+                            jnp.take(vt_j, code, axis=0).reshape(-1),
+                            None, val_dict,
+                        ),
+                    ),
+                    v,
+                )
+
+            return Bound(out_t, smfn)
+        if name == "values_at_quantiles":
+            from trino_tpu.block import ArrayColumn
+            from trino_tpu.expr.pyfns import tdigest_value_at_quantile
+
+            a = args[0]
+            qs = e.args[1]
+            assert isinstance(qs, Literal), (
+                "values_at_quantiles() fractions must be a constant array"
+            )
+            fracs = [float(x) for x in (qs.value or ())]
+            values = a.dictionary.values if a.dictionary else []
+            W = max(len(fracs), 1)
+            table = np.zeros((max(len(values), 1), W), dtype=np.float64)
+            okm = np.zeros((max(len(values), 1), W), dtype=bool)
+            for c, dv in enumerate(values):
+                for j, q in enumerate(fracs):
+                    rv = tdigest_value_at_quantile(dv, q)
+                    if rv is not None:
+                        table[c, j] = rv
+                        okm[c, j] = True
+            table_j = jnp.asarray(table)
+            ok_j = jnp.asarray(okm)
+            out_t = e.type
+
+            def vqfn(cols, valids):
+                d, v = a.fn(cols, valids)
+                code = jnp.clip(d, 0, max(len(values) - 1, 0))
+                rows = code.shape[0]
+                flat = Column(
+                    T.DOUBLE,
+                    jnp.take(table_j, code, axis=0).reshape(-1),
+                    jnp.take(ok_j, code, axis=0).reshape(-1),
+                    None,
+                )
+                return (
+                    ArrayColumn(
+                        out_t,
+                        jnp.full(rows, len(fracs), dtype=jnp.int32),
+                        v, None,
+                        jnp.arange(rows, dtype=jnp.int32) * W, flat,
+                    ),
+                    v,
+                )
+
+            return Bound(out_t, vqfn)
         if name == "checksum_hash":
             # internal: per-row 62-bit value hash for checksum() — NULL
             # hashes to a constant lane (never NULL itself) so the

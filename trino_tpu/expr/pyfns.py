@@ -225,13 +225,20 @@ def porter_stem(word: str) -> str:
 # listed earlier would shadow the longer token ('MM' before 'MMM' turned
 # month names into '%m%m')
 _JODA = [
-    ("yyyy", "%Y"), ("yyy", "%Y"), ("yy", "%y"),
-    ("MMM", "%b"), ("MM", "%m"), ("M", "%m"),
+    # longest-first within a letter family (startswith scan)
+    ("yyyy", "%Y"), ("yyy", "%Y"), ("yy", "%y"), ("y", "%Y"),
+    ("YYYY", "%Y"), ("Y", "%Y"),
+    ("MMMM", "%B"), ("MMM", "%b"), ("MM", "%m"), ("M", "%m"),
+    ("DDD", "%j"), ("DD", "%j"), ("D", "%j"),
     ("dd", "%d"), ("d", "%d"),
-    ("HH", "%H"), ("H", "%H"), ("hh", "%I"),
+    ("HH", "%H"), ("H", "%H"), ("hh", "%I"), ("h", "%I"),
     ("mm", "%M"), ("m", "%M"),
     ("SSS", "%f"), ("ss", "%S"), ("s", "%S"),
-    ("a", "%p"), ("EEE", "%a"), ("ZZ", "%z"), ("Z", "%z"),
+    ("a", "%p"),
+    ("EEEE", "%A"), ("EEE", "%a"), ("EE", "%a"), ("E", "%a"),
+    ("e", "%u"),
+    ("ww", "%V"), ("w", "%V"),  # week of ISO week-year
+    ("ZZ", "%z"), ("Z", "%z"), ("zzzz", "%Z"), ("z", "%Z"),
 ]
 
 _ORACLE = [

@@ -441,6 +441,9 @@ for name, lo, hi, ret, desc in [
      "HyperLogLog sketch of the values (varchar-serialized)"),
     ("merge", 1, 1, "HyperLogLog|tdigest",
      "union of serialized sketches"),
+    ("qdigest_agg", 1, 3, "qdigest(T)",
+     "mergeable quantile digest of the values "
+     "(value[, weight[, accuracy]]; weight/accuracy accepted)"),
     ("tdigest_agg", 1, 1, "tdigest",
      "t-digest sketch of the values (varchar-serialized)"),
 ]:
@@ -454,6 +457,12 @@ _reg("value_at_quantile", "scalar", 2, 2, "double",
 _reg("quantile_at_value", "scalar", 2, 2, "double",
      "t-digest quantile of a constant value", rule=_DOUBLE,
      const_args=(1,))
+_reg("values_at_quantiles", "scalar", 2, 2, "array(double)",
+     "digest values at each constant quantile",
+     rule=lambda a: T.array_of(T.DOUBLE), const_args=(1,))
+_reg("split_to_map", "scalar", 3, 3, "map(varchar,varchar)",
+     "split into a map on entry and key/value delimiters",
+     rule=lambda a: T.map_of(T.VARCHAR, T.VARCHAR), const_args=(1, 2))
 
 # --- window functions ---
 for name, lo, hi, ret, desc in [
@@ -496,7 +505,21 @@ _reg("year_of_week", "scalar", 1, 1, "bigint",
 # sql/analyzer.py; constant folding where the value is session-fixed) ---
 for name, lo, hi, ret, desc, aliases in [
     ("now", 0, 0, "timestamp", "query start timestamp", ()),
-    ("current_timezone", 0, 0, "varchar", "session zone name (UTC)", ()),
+    ("current_timezone", 0, 0, "varchar", "session time zone name", ()),
+    ("current_timestamp", 0, 0, "timestamp(3) with time zone",
+     "statement start instant at the session zone", ()),
+    ("current_date", 0, 0, "date", "current date in the session zone", ()),
+    ("localtimestamp", 0, 0, "timestamp",
+     "current wall-clock timestamp in the session zone", ()),
+    ("current_catalog", 0, 0, "varchar", "session catalog name", ()),
+    ("current_schema", 0, 0, "varchar", "session schema name", ()),
+    ("current_user", 0, 0, "varchar", "session user", ()),
+    ("format_datetime", 2, 2, "varchar",
+     "format a datetime with a Joda pattern (constants)", ()),
+    ("at_timezone", 2, 2, "timestamp(3) with time zone",
+     "same instant displayed in the given zone", ()),
+    ("with_timezone", 2, 2, "timestamp(3) with time zone",
+     "wall-clock timestamp reinterpreted in the given zone", ()),
     ("date", 1, 1, "date", "cast to date", ()),
     ("rand", 0, 2, "double|bigint",
      "uniform random: () in [0,1), (n) in [0,n), (lo,hi) in [lo,hi)",
@@ -629,9 +652,11 @@ _OVERLOADS: Dict[str, Tuple[str, ...]] = {
                    ("bigint", "double", "decimal(p,s)", "varchar",
                     "date", "timestamp")),
     "approx_percentile": ("bigint, double -> bigint",
+                          "real, double -> real",
                           "double, double -> double"),
-    "min": ("T -> T",),
-    "max": ("T -> T",),
+    "cardinality": ("array(E) -> bigint", "map(K,V) -> bigint",
+                    "HyperLogLog -> bigint"),
+    "element_at": ("array(E), bigint -> E", "map(K,V), K -> V"),
     # datetime extractors: date and timestamp forms (both live paths)
     **{
         name: (f"date -> bigint", f"timestamp -> bigint")
@@ -669,6 +694,79 @@ _OVERLOADS: Dict[str, Tuple[str, ...]] = {
     "width_bucket": ("double, double, double, bigint -> bigint",),
     "count": ("* -> bigint", "T -> bigint"),
 }
+
+# every type the engine's generic (type-agnostic) aggregates and value
+# windows genuinely accept — one row per type, the reference's
+# registration unit (SystemFunctionBundle registers min/max/min_by/
+# lead/lag once per orderable type)
+_GENERIC_T = (
+    "boolean", "tinyint", "smallint", "integer", "bigint", "real",
+    "double", "decimal(p,s)", "varchar", "date", "timestamp",
+    "timestamp with time zone", "interval day to second",
+)
+_OVERLOADS.update({
+    "min": tuple(f"{t} -> {t}" for t in _GENERIC_T),
+    "max": tuple(f"{t} -> {t}" for t in _GENERIC_T),
+    "min_by": tuple(f"V, {t} -> V" for t in _GENERIC_T),
+    "max_by": tuple(f"V, {t} -> V" for t in _GENERIC_T),
+    "any_value": tuple(f"{t} -> {t}" for t in _GENERIC_T),
+    "arbitrary": tuple(f"{t} -> {t}" for t in _GENERIC_T),
+    "array_agg": tuple(f"{t} -> array({t})" for t in _GENERIC_T),
+    "checksum": tuple(f"{t} -> varbinary" for t in _GENERIC_T),
+    "approx_distinct": tuple(f"{t} -> bigint" for t in _GENERIC_T),
+    "histogram": tuple(f"{t} -> map({t},bigint)" for t in _GENERIC_T),
+    "map_agg": tuple(f"{t}, V -> map({t},V)" for t in _GENERIC_T),
+    "multimap_agg": tuple(
+        f"{t}, V -> map({t},array(V))" for t in _GENERIC_T
+    ),
+    "lead": tuple(f"{t}[, offset[, default]] -> {t}" for t in _GENERIC_T),
+    "lag": tuple(f"{t}[, offset[, default]] -> {t}" for t in _GENERIC_T),
+    "first_value": tuple(f"{t} -> {t}" for t in _GENERIC_T),
+    "last_value": tuple(f"{t} -> {t}" for t in _GENERIC_T),
+    "nth_value": tuple(f"{t}, n -> {t}" for t in _GENERIC_T),
+})
+
+# datetime family rows over timestamp with time zone (r5: civil fields
+# read the value's own zone; DateTimes.java)
+_TSTZ = "timestamp with time zone"
+for _name in ("year", "quarter", "month", "week", "day", "day_of_week",
+              "day_of_year", "year_of_week", "hour", "minute", "second",
+              "millisecond"):
+    _m = REGISTRY.get(_name)
+    if _m is not None:
+        base = _m.overloads or (f"timestamp -> bigint",)
+        _OVERLOADS[_name] = tuple(base) + (f"{_TSTZ} -> bigint",)
+_OVERLOADS["date_trunc"] = (
+    "unit, date -> date", "unit, timestamp -> timestamp",
+    f"unit, {_TSTZ} -> {_TSTZ}",
+)
+_OVERLOADS["date_add"] = (
+    "unit, bigint, date -> date", "unit, bigint, timestamp -> timestamp",
+    f"unit, bigint, {_TSTZ} -> {_TSTZ}",
+)
+_OVERLOADS["date_diff"] = (
+    "unit, date, date -> bigint", "unit, timestamp, timestamp -> bigint",
+    f"unit, {_TSTZ}, {_TSTZ} -> bigint",
+)
+_OVERLOADS["to_unixtime"] = (
+    "timestamp -> double", f"{_TSTZ} -> double",
+)
+_OVERLOADS["greatest"] = tuple(
+    f"{t}... -> {t}" for t in ("bigint", "double", "decimal(p,s)",
+                               "varchar", "date", "timestamp", _TSTZ)
+)
+_OVERLOADS["least"] = _OVERLOADS["greatest"]
+_OVERLOADS["qdigest_agg"] = tuple(
+    f"{t}[, weight[, accuracy]] -> qdigest({t})"
+    for t in ("bigint", "real", "double")
+)
+_OVERLOADS["value_at_quantile"] = (
+    "qdigest(T), double -> double", "tdigest, double -> double",
+)
+_OVERLOADS["values_at_quantiles"] = (
+    "qdigest(T), array(double) -> array(double)",
+    "tdigest, array(double) -> array(double)",
+)
 for _n, _sigs in _OVERLOADS.items():
     _m = REGISTRY.get(_n)
     if _m is not None:

@@ -423,9 +423,15 @@ class DistributedQueryRunner:
     def _analyze(self, q: ast.Query):
         from trino_tpu.sql.optimizer import optimize
 
-        from trino_tpu.sql.analyzer import set_session_zone
+        from trino_tpu.sql.analyzer import (
+            set_session_info,
+            set_session_zone,
+        )
 
         set_session_zone(self.session.timezone)
+        set_session_info(
+            self.session.catalog, self.session.schema, self.session.user
+        )
         analyzer = Analyzer(
             self.catalogs, self.session.catalog, self.session.schema
         )

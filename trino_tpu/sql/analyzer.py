@@ -231,6 +231,17 @@ def set_session_zone(zone: str) -> None:
     _SESSION_ZONE.set(zone)
 
 
+# catalog/schema/user for the parenless session pseudo-columns
+# (CURRENT_CATALOG / CURRENT_SCHEMA / CURRENT_USER)
+_SESSION_INFO = contextvars.ContextVar(
+    "trino_tpu_session_info", default=("", "", "user")
+)
+
+
+def set_session_info(catalog: str, schema: str, user: str) -> None:
+    _SESSION_INFO.set((catalog, schema, user))
+
+
 def reset_volatile_plan() -> None:
     _VOLATILE_PLAN.set(False)
 
@@ -375,10 +386,25 @@ class ExprConverter:
                 lit = self.convert(o)
                 if not isinstance(lit, ir.Literal):
                     raise AnalysisError("IN list items must be literals")
-                v, lit = self._coerce_temporal_pair(v, lit)
-                if not isinstance(lit, ir.Literal):
-                    raise AnalysisError("IN list items must be literals")
                 opts.append(lit)
+            # temporal coercion over the WHOLE list at once: lifting v
+            # mid-loop would leave earlier options un-lifted
+            TSTZ_K = T.TypeKind.TIMESTAMP_TZ
+            if v.type.kind == TSTZ_K or any(
+                o.type.kind == TSTZ_K for o in opts
+            ):
+                coerced = []
+                for lit in opts:
+                    v, lit = self._coerce_temporal_pair(v, lit)
+                    coerced.append(lit)
+                opts = []
+                for lit in coerced:
+                    v, lit = self._coerce_temporal_pair(v, lit)
+                    if not isinstance(lit, ir.Literal):
+                        raise AnalysisError(
+                            "IN list items must be literals"
+                        )
+                    opts.append(lit)
             x: ir.Expr = ir.InList(v, tuple(opts))
             return ir.not_(x) if e.negated else x
         if isinstance(e, ast.Like):
@@ -623,7 +649,16 @@ class ExprConverter:
                 return ir.Literal(wall_ms * 1000, T.TIMESTAMP)
             return ir.Literal(wall_ms // 86_400_000, T.DATE)
         if name == "current_timezone":
+            mark_volatile_plan()
             return ir.Literal(session_zone(), T.VARCHAR)
+        if name in ("current_catalog", "current_schema", "current_user"):
+            # session-dependent folds: the plan cache key carries no
+            # identity/zone, so these plans must not be cached
+            mark_volatile_plan()
+            cat, sch, usr = _SESSION_INFO.get()
+            v = {"current_catalog": cat, "current_schema": sch,
+                 "current_user": usr}[name]
+            return ir.Literal(v, T.VARCHAR)
         return None
 
     def _convert_at_timezone(self, e: "ast.AtTimeZone") -> ir.Expr:
@@ -822,7 +857,7 @@ class ExprConverter:
                     tuple(v.value for v in flat), T.array_of(t)
                 )
             return None
-        if name in ("date_format", "to_char"):
+        if name in ("date_format", "to_char", "format_datetime"):
             # constant fold only: per-row timestamp->string projection
             # has no varchar carrier (same rule as to_iso8601)
             import datetime as _dt
@@ -840,6 +875,17 @@ class ExprConverter:
             elif a.type.kind == T.TypeKind.TIMESTAMP:
                 dt = _dt.datetime(1970, 1, 1) + _dt.timedelta(
                     microseconds=int(a.value)
+                )
+            elif a.type.kind == T.TypeKind.TIMESTAMP_TZ:
+                # format the LOCAL wall clock in the value's own zone
+                from trino_tpu.ops import tz as TZ
+
+                ms = int(a.value) >> TZ.MILLIS_SHIFT
+                off = TZ.offset_millis_py(
+                    int(a.value) & TZ.ZONE_MASK, ms
+                )
+                dt = _dt.datetime(1970, 1, 1) + _dt.timedelta(
+                    milliseconds=ms + off
                 )
             else:
                 raise AnalysisError(f"{name}() takes a date or timestamp")
@@ -866,6 +912,10 @@ class ExprConverter:
                         out.append(src[i])
                         i += 1
                 py = "".join(out)
+            elif name == "format_datetime":
+                from trino_tpu.expr.pyfns import joda_to_strptime
+
+                py = joda_to_strptime(str(fmt.value))
             else:
                 from trino_tpu.expr.pyfns import oracle_to_strptime
 
@@ -1902,8 +1952,9 @@ def _refers_outside_lambda(body: ir.Expr) -> bool:
 # collect finalizer, because the digest's runtime dictionary is not
 # plan-bindable (expr/compile dictionary-table discipline). Standalone
 # accessors over TABLE columns bind normally.
-_SKETCH_ACCESSORS = {"cardinality", "value_at_quantile", "quantile_at_value"}
-_SKETCH_AGGS = {"approx_set", "merge", "tdigest_agg"}
+_SKETCH_ACCESSORS = {"cardinality", "value_at_quantile",
+                     "quantile_at_value", "values_at_quantiles"}
+_SKETCH_AGGS = {"approx_set", "merge", "tdigest_agg", "qdigest_agg"}
 
 
 def _find_agg_calls(e: ast.Expression) -> List[ast.FunctionCall]:
@@ -3442,7 +3493,7 @@ class Analyzer:
                             "merge() takes a serialized sketch"
                         )
                     canon = "sketch_merge"
-                elif inner.name == "tdigest_agg":
+                elif inner.name in ("tdigest_agg", "qdigest_agg"):
                     if x.type.kind != T.TypeKind.DOUBLE:
                         x = ir.Cast(x, T.DOUBLE)
                     canon = "tdigest_agg"
@@ -3466,11 +3517,17 @@ class Analyzer:
                         raise AnalysisError(
                             f"{kind}() argument must be a constant"
                         )
-                    # analyzer-level literals carry SQL values (the
-                    # physical scaled-int form only exists in the binder)
-                    qv = float(q.value)
-                    post = "vq" if kind == "value_at_quantile" else "qv"
-                    out_t = T.DOUBLE
+                    if kind == "values_at_quantiles":
+                        qv = tuple(float(x) for x in q.value)
+                        post = "vaq"
+                        out_t = T.array_of(T.DOUBLE)
+                    else:
+                        # analyzer-level literals carry SQL values (the
+                        # physical scaled-int form only exists in the
+                        # binder)
+                        qv = float(q.value)
+                        post = "vq" if kind == "value_at_quantile" else "qv"
+                        out_t = T.DOUBLE
                 x_ch = len(pre_exprs)
                 pre_exprs.append(x)
                 aggs.append(P.AggCall(
@@ -3478,14 +3535,14 @@ class Analyzer:
                 ))
                 per_call.append(("plain", len(aggs) - 1))
                 continue
-            if kind in ("approx_set", "tdigest_agg", "merge"):
+            if kind in ("approx_set", "tdigest_agg", "qdigest_agg",
+                        "merge"):
                 # sketch builders: HyperLogLog / TDigest serialized on
                 # the varchar carrier (expr/pyfns digests; the reference
                 # gives these first-class SPI types). approx_set's
                 # optional max-error argument is accepted and ignored.
-                if not call.args or len(call.args) > (
-                    2 if kind == "approx_set" else 1
-                ) or distinct:
+                max_args = {"approx_set": 2, "qdigest_agg": 3}.get(kind, 1)
+                if not call.args or len(call.args) > max_args or distinct:
                     raise AnalysisError(f"{kind}() arguments")
                 x = conv.convert(call.args[0])
                 if kind == "merge":
@@ -3494,10 +3551,14 @@ class Analyzer:
                             "merge() takes a serialized sketch"
                         )
                     canon = "sketch_merge"
-                elif kind == "tdigest_agg":
+                elif kind in ("tdigest_agg", "qdigest_agg"):
+                    # one mergeable digest carrier serves both SQL
+                    # sketch types (lib/trino-qdigest vs TDigest — the
+                    # quantile API is identical; accuracy here is
+                    # exact-collection grade either way)
                     if x.type.kind != T.TypeKind.DOUBLE:
                         x = ir.Cast(x, T.DOUBLE)
-                    canon = kind
+                    canon = "tdigest_agg"
                 else:
                     canon = kind
                 x_ch = len(pre_exprs)
