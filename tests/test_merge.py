@@ -166,3 +166,23 @@ class TestScaledWriters:
         assert total == 12 * (1 << 20)
         assert len(made) > 1, "writer count never scaled"
         assert ScaledWriterSink.COUNTERS["max_writers"] >= len(made)
+
+
+class TestInsertOnlyMultiMatch:
+    def test_insert_only_merge_with_duplicate_matches(self):
+        """Insert-only MERGE legally allows several source rows to
+        match one target row; survivors must not fan out (r5 review)."""
+        r = LocalQueryRunner(Session(catalog="memory", schema="t"))
+        r.register_catalog("memory", create_memory_connector())
+        r.execute("create table memory.t.tg2 (k bigint, v bigint)")
+        r.execute("insert into tg2 values (1, 100), (2, 200)")
+        r.execute("create table memory.t.sr2 (k bigint, v bigint)")
+        r.execute("insert into sr2 values (1, 111), (1, 112), (3, 300)")
+        res = r.execute(
+            "merge into tg2 using sr2 s on tg2.k = s.k "
+            "when not matched then insert (k, v) values (s.k, s.v)"
+        )
+        assert res.rows == [[1]]
+        assert sorted(r.execute("select k, v from tg2").rows) == [
+            [1, 100], [2, 200], [3, 300]
+        ]

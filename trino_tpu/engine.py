@@ -779,7 +779,18 @@ class LocalQueryRunner:
         m_clauses = [c for c in stmt.clauses if c.matched]
         nm_clauses = [c for c in stmt.clauses if not c.matched]
 
-        # survivors: per column, the FIRST matching arm's value
+        # survivors: per column, the FIRST matching arm's value. With
+        # no WHEN MATCHED arm the target is untouched — and must NOT
+        # join (a LEFT JOIN would fan out on multiple source matches,
+        # which insert-only MERGE legally allows)
+        if not m_clauses:
+            survivors = ast.QuerySpec(
+                tuple(
+                    ast.SelectItem(tcol(c.name), c.name)
+                    for c in meta.columns
+                ),
+                from_=target_rel,
+            )
         items = []
         for col in meta.columns:
             old = tcol(col.name)
@@ -806,11 +817,12 @@ class LocalQueryRunner:
             ast.Case(None, tuple(del_whens), false_lit)
             if del_whens else false_lit,
         )
-        survivors = ast.QuerySpec(
-            tuple(items),
-            from_=ast.Join("left", target_rel, flagged_source, stmt.on),
-            where=ast.UnaryOp("not", drop),
-        )
+        if m_clauses:
+            survivors = ast.QuerySpec(
+                tuple(items),
+                from_=ast.Join("left", target_rel, flagged_source, stmt.on),
+                where=ast.UnaryOp("not", drop),
+            )
 
         # affected rows: matched pairs whose first arm applies + inserts
         m_any = None

@@ -2816,6 +2816,40 @@ class LookupJoinOperator(Operator):
         if self._type == "anti":
             self._outputs.append(probe.mask(~matched))
             return
+        if self._type in ("mark", "mark_exists"):
+            # mark join: probe rows pass through with an appended
+            # BOOLEAN match column (SemiJoinNode's semiJoinOutput — the
+            # device for subqueries in general positions: under OR, in
+            # the SELECT list). "mark" carries IN's three-valued
+            # semantics on the validity lane: no match is UNKNOWN when
+            # the probe key is NULL against a nonempty build, or the
+            # build side contains NULL keys; "mark_exists" is two-valued.
+            valid = None
+            if self._type == "mark":
+                build = self._bridge.build_batch
+                b_live = build.live_mask()
+                nonempty = jnp.any(b_live)
+                has_null = jnp.zeros((), dtype=jnp.bool_)
+                for ch in self._bridge.build_key_channels:
+                    bc = build.columns[ch]
+                    if bc.valid is not None:
+                        has_null = has_null | jnp.any(b_live & ~bc.valid)
+                pv = None
+                for vv in rec["valids"]:
+                    pv = vv if pv is None else (pv & vv)
+                probe_null = (
+                    ~pv if pv is not None
+                    else jnp.zeros_like(matched)
+                )
+                unknown = (~matched) & (
+                    (probe_null & nonempty) | has_null
+                )
+                valid = ~unknown
+            col = Column(T.BOOLEAN, matched, valid, None)
+            self._outputs.append(
+                RelBatch(list(probe.columns) + [col], probe.live_mask())
+            )
+            return
         if self._type == "left":
             self._outputs.append(pairs)
             self._outputs.append(_left_unmatched(probe, build, matched))

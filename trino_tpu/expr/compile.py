@@ -2335,35 +2335,50 @@ class ExprBinder:
                     k, _, val = entry.partition(kd)
                     pairs.append((k, val))
                 if len({k for k, _ in pairs}) != len(pairs):
-                    raise ValueError(
-                        "split_to_map() duplicate keys in input"
-                    )
+                    # the reference RAISES when the offending row is
+                    # evaluated; a bind-time raise would fail rows the
+                    # query never touches, so malformed rows degrade to
+                    # NULL instead (same class as the subscript
+                    # deviation documented in the analyzer)
+                    pairs = None
                 per_code.append(pairs)
-            W = max((len(p) for p in per_code), default=1)
+            W = max((len(p) for p in per_code if p is not None), default=1)
             key_dict = Dictionary(
-                sorted({k for ps in per_code for k, _ in ps}) or [""]
+                sorted({
+                    k for ps in per_code if ps for k, _ in ps
+                }) or [""]
             )
             val_dict = Dictionary(
-                sorted({v for ps in per_code for _, v in ps}) or [""]
+                sorted({
+                    v for ps in per_code if ps for _, v in ps
+                }) or [""]
             )
             kt = np.zeros((max(len(values), 1), W), dtype=np.int32)
             vt = np.zeros((max(len(values), 1), W), dtype=np.int32)
             lens = np.zeros(max(len(values), 1), dtype=np.int32)
+            okc = np.ones(max(len(values), 1), dtype=bool)
             for c, ps in enumerate(per_code):
+                if ps is None:
+                    okc[c] = False
+                    continue
                 lens[c] = len(ps)
                 for j, (k, v) in enumerate(ps):
                     kt[c, j] = key_dict.code(k)
                     vt[c, j] = val_dict.code(v)
-            kt_j, vt_j, lens_j = map(jnp.asarray, (kt, vt, lens))
+            kt_j, vt_j, lens_j, ok_j = map(
+                jnp.asarray, (kt, vt, lens, okc)
+            )
             out_t = e.type
 
             def smfn(cols, valids):
                 d, v = a.fn(cols, valids)
                 code = jnp.clip(d, 0, max(len(values) - 1, 0))
                 rows = code.shape[0]
+                row_ok = take_clip(ok_j, code)
+                vv = row_ok if v is None else (v & row_ok)
                 return (
                     MapColumn(
-                        out_t, take_clip(lens_j, code), v, None,
+                        out_t, take_clip(lens_j, code), vv, None,
                         jnp.arange(rows, dtype=jnp.int32) * W,
                         Column(
                             T.VARCHAR,
@@ -2376,7 +2391,7 @@ class ExprBinder:
                             None, val_dict,
                         ),
                     ),
-                    v,
+                    vv,
                 )
 
             return Bound(out_t, smfn)

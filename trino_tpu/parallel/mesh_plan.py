@@ -115,7 +115,8 @@ def _check_node(n: P.PlanNode) -> None:
             if a.distinct or a.kind not in _BATCH_REDUCER:
                 raise MeshUnsupported(f"agg {a.kind}")
     if isinstance(n, P.JoinNode) and n.kind not in (
-        "inner", "left", "full", "semi", "anti", "cross"
+        "inner", "left", "full", "semi", "anti", "cross",
+        "mark", "mark_exists",
     ):
         raise MeshUnsupported(f"join {n.kind}")
     for c in n.children():
@@ -596,6 +597,37 @@ class _FragVisitor:
             return probe.mask(matched)
         if node.kind == "anti":
             return probe.mask(~matched)
+        if node.kind in ("mark", "mark_exists"):
+            # appended BOOLEAN match column; "mark" (IN) adds the
+            # three-valued lanes. Build-side emptiness/null flags are
+            # GLOBAL properties — psum over the mesh axis (a shard with
+            # an empty build slice must not report empty)
+            valid = None
+            if node.kind == "mark":
+                b_live = build.live_mask()
+                nonempty = jax.lax.psum(
+                    jnp.any(b_live).astype(jnp.int32), AXIS
+                ) > 0
+                hn = jnp.zeros((), dtype=jnp.bool_)
+                for c in rkeys:
+                    bc = build.columns[c]
+                    if bc.valid is not None:
+                        hn = hn | jnp.any(b_live & ~bc.valid)
+                has_null = jax.lax.psum(hn.astype(jnp.int32), AXIS) > 0
+                pv = None
+                for vv in valids:
+                    pv = vv if pv is None else (pv & vv)
+                probe_null = (
+                    ~pv if pv is not None else jnp.zeros_like(matched)
+                )
+                unknown = (~matched) & (
+                    (probe_null & nonempty) | has_null
+                )
+                valid = ~unknown
+            col = Column(T.BOOLEAN, matched, valid, None)
+            return RelBatch(
+                list(probe.columns) + [col], probe.live_mask()
+            )
         if node.kind == "full":
             # hash-partitioned full outer: every build row lives on
             # exactly one shard, so shard-local matched flags are

@@ -464,6 +464,13 @@ class ExprConverter:
             return ir.Literal(
                 tuple(x.value for x in elems), T.array_of(elem_t)
             )
+        if isinstance(e, (ast.Exists, ast.InSubquery)):
+            # mark-join replacements register under the non-negated twin
+            plain = dataclasses.replace(e, negated=False)
+            hit = self.replacements.get(plain)
+            if hit is not None:
+                x: ir.Expr = ir.InputRef(hit[0], T.BOOLEAN)
+                return ir.not_(x) if e.negated else x
         if isinstance(e, (ast.Exists, ast.InSubquery, ast.ScalarSubquery)):
             raise AnalysisError(
                 "subquery in unsupported position (only WHERE/HAVING conjuncts)"
@@ -2237,6 +2244,11 @@ class Analyzer:
         if window_calls:
             self._plan_windows(builder, window_calls)
 
+        # -- subqueries in the SELECT list / ORDER BY: scalar subqueries
+        # join in, EXISTS/IN become mark-join boolean channels --
+        for e in select_exprs + [s.expr for s in order_by]:
+            self._plan_embedded_subqueries(builder, e, ctes)
+
         # -- select projection (+ hidden order-by channels) --
         conv = builder.converter()
         out_exprs = [conv.convert(e) for e in select_exprs]
@@ -3087,9 +3099,10 @@ class Analyzer:
             if isinstance(conj, ast.InSubquery):
                 self._plan_in_subquery(builder, conj, ctes)
                 continue
-            for sub in _scalar_subqueries(conj):
-                if sub not in builder.replacements:
-                    self._plan_scalar_subquery(builder, sub, ctes)
+            # general positions: EXISTS/IN under OR or NOT, scalar
+            # subqueries anywhere in the conjunct — mark joins +
+            # replacement channels
+            self._plan_embedded_subqueries(builder, conj, ctes)
             pred = builder.converter().convert(conj)
             builder.filter(pred)
 
@@ -3118,9 +3131,15 @@ class Analyzer:
         )
         # scope unchanged: semi/anti output = probe columns
 
-    def _decorrelate(self, builder: Builder, inner_items, pool):
+    def _decorrelate(self, builder: Builder, inner_items, pool,
+                     filter_outer: bool = True):
         """Assemble the subquery side and split its conjuncts into inner
-        filters / correlation equi keys / cross-scope residuals."""
+        filters / correlation equi keys / cross-scope residuals.
+
+        `filter_outer=False` (mark joins): outer-only conjuncts become
+        RESIDUALS instead of filters on the outer query — a mark join
+        must preserve outer cardinality, so an outer-only predicate may
+        only flip match flags, never delete outer rows."""
         inner_filters: List[ast.Expression] = []
         corr_pairs: List[Tuple[ast.Identifier, ast.Identifier]] = []
         residuals: List[ast.Expression] = []
@@ -3139,8 +3158,12 @@ class Analyzer:
                 else:
                     raise AnalysisError(f"cannot resolve {ident}")
             if refs_outer and not refs_inner:
-                # outer-only predicate inside subquery: apply to outer
-                self._plan_predicate(builder, c, {})
+                if filter_outer:
+                    # conjunct-position EXISTS: outer-only predicate
+                    # inside the subquery filters the outer query
+                    self._plan_predicate(builder, c, {})
+                else:
+                    residuals.append(c)
                 continue
             if not refs_outer:
                 inner_filters.append(c)
@@ -3285,6 +3308,205 @@ class Analyzer:
                 ),
             )
         )
+
+    def _plan_embedded_subqueries(self, builder: Builder, e, ctes) -> None:
+        """Plan every subquery appearing in a GENERAL position inside
+        `e` (under OR/NOT, in the SELECT list, in ORDER BY): scalar
+        subqueries join as before; EXISTS/IN become MARK joins whose
+        boolean channel replaces the subquery expression — the
+        TransformExistsApplyToCorrelatedJoin / semiJoinOutput device
+        (planner/iterative/rule/TransformExistsApplyToCorrelatedJoin
+        .java, plan/SemiJoinNode.java)."""
+
+        def walk(x):
+            if isinstance(x, ast.ScalarSubquery):
+                if x not in builder.replacements:
+                    self._plan_scalar_subquery(builder, x, ctes)
+                return
+            if isinstance(x, (ast.Exists, ast.InSubquery)):
+                self._plan_mark(builder, x, ctes)
+                if isinstance(x, ast.InSubquery):
+                    walk(x.value)
+                return
+            if dataclasses.is_dataclass(x):
+                for f in dataclasses.fields(x):
+                    walk(getattr(x, f.name))
+            elif isinstance(x, tuple):
+                for i in x:
+                    walk(i)
+
+        walk(e)
+
+    def _plan_mark(self, builder: Builder, node, ctes) -> None:
+        """EXISTS / IN in a general position -> mark join appending a
+        BOOLEAN channel. Uncorrelated IN keeps full three-valued
+        semantics ("mark"); EXISTS and correlated IN are two-valued
+        ("mark_exists" — for correlated IN that collapses UNKNOWN to
+        FALSE, exact in filter contexts where the two coincide)."""
+        plain = dataclasses.replace(node, negated=False)
+        if plain in builder.replacements:
+            return
+        ch = len(builder.scope)
+        fields = builder.node.fields + (P.Field(None, T.BOOLEAN),)
+        if isinstance(node, ast.Exists):
+            q = node.query
+            if not isinstance(q.body, ast.QuerySpec) or q.body.group_by \
+                    or q.with_:
+                raise AnalysisError("EXISTS subquery too complex")
+            spec = q.body
+            inner_items: List[RelationItem] = []
+            pool: List[ast.Expression] = []
+            self._collect_relations(spec.from_, inner_items, pool, ctes)
+            pool.extend(split_conjuncts(spec.where))
+            inner, probe_keys, build_keys, residuals = self._decorrelate(
+                builder, inner_items, pool, filter_outer=False
+            )
+            residual_ir = None
+            if residuals:
+                conv = ExprConverter(
+                    Scope.concat(builder.scope, inner.scope)
+                )
+                residual_ir = ir.and_(
+                    *[conv.convert(c) for c in residuals]
+                )
+            builder.node = P.JoinNode(
+                "mark_exists", builder.node, inner.node,
+                tuple(probe_keys), tuple(build_keys), residual_ir, fields,
+            )
+        else:  # InSubquery
+            value = node.value
+            if not isinstance(value, ast.Identifier):
+                raise AnalysisError(
+                    "IN (subquery) value must be a column"
+                )
+            q = node.query
+            correlated = self._query_is_correlated(builder, q, ctes)
+            if not correlated:
+                sub_node, _, _ = self.plan_query(q, ctes)
+                if len(sub_node.fields) != 1:
+                    raise AnalysisError(
+                        "IN subquery must return one column"
+                    )
+                probe_ch, _ = builder.scope.resolve(value.parts)
+                builder.node = P.JoinNode(
+                    "mark", builder.node, sub_node,
+                    (probe_ch,), (0,), None, fields,
+                )
+            else:
+                # correlated IN: full three-valued semantics from THREE
+                # two-valued marks (TransformCorrelatedInPredicateToJoin
+                # decomposition): match = EXISTS(corr AND c = x);
+                # null-in-set = EXISTS(corr AND c IS NULL);
+                # nonempty = EXISTS(corr). IN is then
+                # TRUE if match; NULL if null-in-set or (x IS NULL and
+                # nonempty); else FALSE.
+                if not isinstance(q.body, ast.QuerySpec) or \
+                        q.body.group_by or q.with_:
+                    raise AnalysisError(
+                        "correlated IN subquery too complex"
+                    )
+                spec = q.body
+                if len(spec.select) != 1 or isinstance(
+                    spec.select[0].expr, ast.Star
+                ):
+                    raise AnalysisError(
+                        "IN subquery must select one column"
+                    )
+                sel = spec.select[0].expr
+
+                def add_mark(extra: Optional[ast.Expression]) -> int:
+                    mark_ch = len(builder.scope)
+                    inner_items: List[RelationItem] = []
+                    pool: List[ast.Expression] = []
+                    self._collect_relations(
+                        spec.from_, inner_items, pool, ctes
+                    )
+                    pool.extend(split_conjuncts(spec.where))
+                    if extra is not None:
+                        pool.append(extra)
+                    inner, pk, bk, residuals = self._decorrelate(
+                        builder, inner_items, pool, filter_outer=False
+                    )
+                    residual_ir = None
+                    if residuals:
+                        conv = ExprConverter(
+                            Scope.concat(builder.scope, inner.scope)
+                        )
+                        residual_ir = ir.and_(
+                            *[conv.convert(c) for c in residuals]
+                        )
+                    builder.node = P.JoinNode(
+                        "mark_exists", builder.node, inner.node,
+                        tuple(pk), tuple(bk), residual_ir,
+                        builder.node.fields + (P.Field(None, T.BOOLEAN),),
+                    )
+                    builder.scope = Scope(
+                        builder.scope.fields
+                        + [ScopeField(None, None, T.BOOLEAN)]
+                    )
+                    return mark_ch
+
+                m_match = add_mark(ast.BinaryOp("eq", value, sel))
+                m_null = add_mark(ast.IsNullPredicate(sel, False))
+                m_any = add_mark(None)
+                conv = builder.converter()
+                v_ir = conv.convert(value)
+                b = T.BOOLEAN
+                in_ir = ir.Case(
+                    (
+                        ir.InputRef(m_match, b),
+                        ir.or_(
+                            ir.InputRef(m_null, b),
+                            ir.and_(
+                                ir.is_null(v_ir), ir.InputRef(m_any, b)
+                            ),
+                        ),
+                    ),
+                    (ir.Literal(True, b), ir.Literal(None, b)),
+                    ir.Literal(False, b),
+                    b,
+                )
+                # materialize the three-valued IN as a real channel
+                ch = len(builder.scope)
+                exprs = tuple(
+                    ir.InputRef(i, f.type)
+                    for i, f in enumerate(builder.node.fields)
+                ) + (in_ir,)
+                new_fields = builder.node.fields + (
+                    P.Field(None, T.BOOLEAN),
+                )
+                builder.node = P.ProjectNode(
+                    builder.node, exprs, new_fields
+                )
+                builder.scope = Scope(
+                    builder.scope.fields
+                    + [ScopeField(None, None, T.BOOLEAN)]
+                )
+                builder.replacements[plain] = (ch, T.BOOLEAN)
+                return
+        builder.scope = Scope(
+            builder.scope.fields + [ScopeField(None, None, T.BOOLEAN)]
+        )
+        builder.replacements[plain] = (ch, T.BOOLEAN)
+
+    def _query_is_correlated(self, builder: Builder, q: ast.Query,
+                             ctes) -> bool:
+        """Does the subquery reference the outer scope? (the
+        classification probe shared with _plan_scalar_subquery)."""
+        if not isinstance(q.body, ast.QuerySpec) or q.body.from_ is None:
+            return False
+        probe_items: List[RelationItem] = []
+        pool: List[ast.Expression] = []
+        self._collect_relations(q.body.from_, probe_items, pool, ctes)
+        probe_scope = Scope(
+            [f for it in probe_items for f in it.scope.fields]
+        )
+        for c in pool + split_conjuncts(q.body.where):
+            for ident in _idents(c):
+                if probe_scope.try_resolve(ident.parts) is None:
+                    if builder.scope.try_resolve(ident.parts) is not None:
+                        return True
+        return False
 
     def _plan_scalar_subquery(self, builder: Builder, sub: ast.ScalarSubquery, ctes) -> None:
         q = sub.query

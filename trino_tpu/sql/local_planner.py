@@ -361,6 +361,8 @@ class LocalPlanner:
         )
         if node.kind in ("semi", "anti"):
             return probe_chain, probe_schema
+        if node.kind in ("mark", "mark_exists"):
+            return probe_chain, probe_schema + [(T.BOOLEAN, None)]
         return probe_chain, probe_schema + build_schema
 
     def _visit_WindowNode(self, node: P.WindowNode):

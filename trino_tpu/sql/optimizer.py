@@ -560,6 +560,236 @@ class PushLimitThroughUnion(Rule):
         )
 
 
+_NONDETERMINISTIC_FNS = {"rand", "random", "uuid", "shuffle", "now"}
+
+
+def _is_deterministic(e) -> bool:
+    """False when the expression calls a volatile function — pushing it
+    below an aggregation/window re-evaluates it against a different row
+    set (PredicatePushDown pushes deterministic conjuncts only)."""
+    if isinstance(e, ir.Call):
+        if e.name in _NONDETERMINISTIC_FNS:
+            return False
+        return all(_is_deterministic(a) for a in e.args)
+    for f in dataclasses.fields(e) if dataclasses.is_dataclass(e) else ():
+        v = getattr(e, f.name)
+        if isinstance(v, ir.Expr) and not _is_deterministic(v):
+            return False
+        if isinstance(v, tuple) and any(
+            isinstance(i, ir.Expr) and not _is_deterministic(i) for i in v
+        ):
+            return False
+    return True
+
+
+class PushFilterThroughAggregation(Rule):
+    """Filter conjuncts touching only GROUP KEY outputs move below the
+    aggregation (PredicatePushDown.visitAggregation): the filter then
+    shrinks the aggregation's input instead of its output."""
+
+    name = "push_filter_through_aggregation"
+
+    def apply(self, node, ctx):
+        if not isinstance(node, P.FilterNode):
+            return None
+        agg = ctx.resolve(node.child)
+        if not isinstance(agg, P.AggregateNode) or agg.step != "single":
+            return None
+        k = len(agg.group_channels)
+        if k == 0:
+            return None
+        child_fields = ctx.resolve(agg.child).fields
+        mapping = {
+            i: ir.InputRef(
+                agg.group_channels[i],
+                child_fields[agg.group_channels[i]].type,
+            )
+            for i in range(k)
+        }
+        push, keep = [], []
+        for c in split_conjuncts(node.predicate):
+            refs = expr_refs(c)
+            if refs and max(refs) < k and _is_deterministic(c):
+                push.append(substitute(c, mapping))
+            else:
+                keep.append(c)
+        if not push:
+            return None
+        new_child = P.FilterNode(agg.child, ir.and_(*push), child_fields)
+        out: P.PlanNode = dataclasses.replace(agg, child=new_child)
+        if keep:
+            out = P.FilterNode(out, ir.and_(*keep), node.fields)
+        return out
+
+
+class PushFilterThroughWindow(Rule):
+    """Filter conjuncts over PARTITION BY columns move below the window
+    (rule/PushdownFilterIntoWindow's safe case): dropping whole
+    partitions cannot change any surviving row's window result."""
+
+    name = "push_filter_through_window"
+
+    def apply(self, node, ctx):
+        if not isinstance(node, P.FilterNode):
+            return None
+        win = ctx.resolve(node.child)
+        if not isinstance(win, P.WindowNode):
+            return None
+        part = set(win.partition_channels)
+        if not part:
+            return None
+        child_fields = ctx.resolve(win.child).fields
+        push, keep = [], []
+        for c in split_conjuncts(node.predicate):
+            refs = expr_refs(c)
+            if refs and all(r in part for r in refs) \
+                    and _is_deterministic(c):
+                push.append(c)  # window passes child channels through
+            else:
+                keep.append(c)
+        if not push:
+            return None
+        new_child = P.FilterNode(win.child, ir.and_(*push), child_fields)
+        out: P.PlanNode = dataclasses.replace(win, child=new_child)
+        if keep:
+            out = P.FilterNode(out, ir.and_(*keep), node.fields)
+        return out
+
+
+class FlattenUnion(Rule):
+    """UnionAll(UnionAll(a, b), c) -> UnionAll(a, b, c)
+    (rule/MergeUnion.java)."""
+
+    name = "flatten_union"
+
+    def apply(self, node, ctx):
+        if not isinstance(node, P.UnionAllNode):
+            return None
+        flat, changed = [], False
+        for inp in node.inputs:
+            r = ctx.resolve(inp)
+            if isinstance(r, P.UnionAllNode):
+                flat.extend(r.inputs)
+                changed = True
+            else:
+                flat.append(inp)
+        if not changed:
+            return None
+        return P.UnionAllNode(tuple(flat), node.fields)
+
+
+class PushFilterThroughUnion(Rule):
+    """Filter(UnionAll(inputs)) -> UnionAll(Filter(input)...) — branch
+    channels align 1:1, so the predicate applies verbatim per branch
+    (PredicatePushDown.visitUnion)."""
+
+    name = "push_filter_through_union"
+
+    def apply(self, node, ctx):
+        if not isinstance(node, P.FilterNode):
+            return None
+        u = ctx.resolve(node.child)
+        if not isinstance(u, P.UnionAllNode):
+            return None
+        new_inputs = tuple(
+            P.FilterNode(inp, node.predicate, ctx.resolve(inp).fields)
+            for inp in u.inputs
+        )
+        return P.UnionAllNode(new_inputs, u.fields)
+
+
+class RemoveRedundantDistinct(Rule):
+    """DISTINCT over an aggregation output keyed on every column is a
+    no-op: group keys are already unique
+    (rule/RemoveRedundantDistinctLimit's core observation)."""
+
+    name = "remove_redundant_distinct"
+
+    def apply(self, node, ctx):
+        if not isinstance(node, P.AggregateNode) or node.aggs:
+            return None
+        if tuple(node.group_channels) != tuple(range(len(node.fields))):
+            return None
+        child = ctx.resolve(node.child)
+        if not isinstance(child, P.AggregateNode):
+            return None
+        # the child's whole output is its group-key set (a distinct or
+        # a grouped aggregation selecting only its keys)
+        if len(child.fields) == len(child.group_channels) + len(child.aggs) \
+                and len(node.fields) == len(child.fields) \
+                and not child.aggs:
+            return child
+        return None
+
+
+class PushAggregationThroughOuterJoin(Rule):
+    """Aggregation grouping on ALL left-join probe columns, aggregating
+    only build columns, pushes below the join when the probe side is
+    provably distinct (rule/PushAggregationThroughOuterJoin.java —
+    the correlated-scalar / Q17 shape). count() over NULL-extended
+    rows restores its 0 via a coalesce projection."""
+
+    name = "push_aggregation_through_outer_join"
+
+    _PUSHABLE = {"sum", "min", "max", "avg", "any", "count"}
+
+    def apply(self, node, ctx):
+        if not isinstance(node, P.AggregateNode) or node.step != "single":
+            return None
+        join = ctx.resolve(node.child)
+        if not isinstance(join, P.JoinNode) or join.kind != "left" \
+                or join.residual is not None:
+            return None
+        left = ctx.resolve(join.left)
+        wl = len(left.fields)
+        # grouping must cover exactly the probe columns (any order)
+        if sorted(node.group_channels) != list(range(wl)):
+            return None
+        # probe side provably distinct: its own full-width distinct
+        if not (
+            isinstance(left, P.AggregateNode)
+            and not left.aggs
+            and tuple(left.group_channels) == tuple(range(len(left.fields)))
+        ):
+            return None
+        right = ctx.resolve(join.right)
+        for a in node.aggs:
+            if a.kind not in self._PUSHABLE or a.distinct:
+                return None
+            if a.arg_channel is None or a.arg_channel < wl:
+                return None
+            if a.arg2_channel is not None or a.arg3_channel is not None:
+                return None
+        rk = tuple(join.right_keys)
+        shifted = tuple(
+            dataclasses.replace(a, arg_channel=a.arg_channel - wl)
+            for a in node.aggs
+        )
+        r_fields = tuple(right.fields[c] for c in rk) + tuple(
+            P.Field(None, a.out_type) for a in node.aggs
+        )
+        right_agg = P.AggregateNode(join.right, rk, shifted, r_fields)
+        nj_fields = left.fields + r_fields
+        new_join = P.JoinNode(
+            "left", join.left, right_agg,
+            tuple(join.left_keys), tuple(range(len(rk))), None, nj_fields,
+        )
+        # restore the original output layout [group keys..., aggs...];
+        # count over a null-extended row reads 0, not NULL
+        exprs: List[ir.Expr] = []
+        for g in node.group_channels:
+            exprs.append(ir.InputRef(g, left.fields[g].type))
+        for i, a in enumerate(node.aggs):
+            ref: ir.Expr = ir.InputRef(wl + len(rk) + i, a.out_type)
+            if a.kind == "count":
+                ref = ir.Call(
+                    "coalesce", (ref, ir.Literal(0, a.out_type)),
+                    a.out_type,
+                )
+            exprs.append(ref)
+        return P.ProjectNode(new_join, tuple(exprs), node.fields)
+
+
 SIMPLIFICATION_RULES: Tuple[Rule, ...] = (
     MergeFilters(),
     InlineProjections(),
@@ -573,6 +803,12 @@ SIMPLIFICATION_RULES: Tuple[Rule, ...] = (
     PushTopNThroughProject(),
     RemoveTrivialFilters(),
     PushLimitThroughUnion(),
+    PushFilterThroughAggregation(),
+    PushFilterThroughWindow(),
+    FlattenUnion(),
+    PushFilterThroughUnion(),
+    RemoveRedundantDistinct(),
+    PushAggregationThroughOuterJoin(),
 )
 
 
