@@ -1,0 +1,124 @@
+"""Parquet SNAPPY/ZSTD + nested LIST interop — VERDICT r4 item #9.
+
+Cross-engine both directions against pyarrow (the "another engine"
+fixture writer the VERDICT asked for): pyarrow-written SNAPPY/ZSTD
+files with nested list columns read correctly, and files THIS codec
+writes read back identically in pyarrow. The pure-python SNAPPY codec
+(utils/snappy.py) is validated byte-level against pyarrow's."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+pa = pytest.importorskip("pyarrow")
+import pyarrow.parquet as pq  # noqa: E402
+
+from trino_tpu.connectors import parquet_format as PQ
+from trino_tpu.utils import snappy
+
+
+TAGS = [[1, 2], [], None, [5, None, 7]]
+
+
+def _fixture_table():
+    return pa.table({
+        "id": pa.array([1, 2, 3, 4], pa.int64()),
+        "tags": pa.array(TAGS, pa.list_(pa.int64())),
+        "name": pa.array(["a", "bb", None, "dd"]),
+        "score": pa.array([1.5, None, 3.5, 4.0], pa.float64()),
+    })
+
+
+def _write_pa(codec):
+    f = tempfile.mktemp(suffix=".parquet")
+    pq.write_table(
+        _fixture_table(), f, compression=codec, use_dictionary=False,
+        write_statistics=False, data_page_version="1.0",
+    )
+    return f
+
+
+class TestSnappyCodec:
+    def test_bidirectional_vs_pyarrow(self):
+        import random
+
+        random.seed(3)
+        for payload in (b"", b"x", b"ab" * 4000,
+                        bytes(random.randbytes(5000)), b"\0" * 65536):
+            mine = snappy.compress(payload)
+            assert bytes(pa.decompress(
+                mine, decompressed_size=len(payload), codec="snappy"
+            )) == payload
+            theirs = pa.compress(payload, codec="snappy", asbytes=True)
+            assert snappy.decompress(theirs) == payload
+
+
+class TestReadForeignFiles:
+    @pytest.mark.parametrize("codec", ["snappy", "zstd", "gzip"])
+    def test_read_pyarrow_nested(self, codec):
+        f = _write_pa(codec)
+        try:
+            cols, n = PQ.read_parquet(f)
+            by = {c.name: c for c in cols}
+            assert n == 4
+            tags = by["tags"]
+            assert list(tags.list_lengths) == [2, 0, 0, 3]
+            assert list(tags.valid) == [True, True, False, True]
+            assert list(tags.element_valid) == [
+                True, True, True, False, True
+            ]
+            dense = [
+                v for v, ok in zip(tags.values, tags.element_valid) if ok
+            ]
+            assert dense == [1, 2, 5, 7]
+            assert by["id"].values.tolist() == [1, 2, 3, 4]
+            assert by["score"].valid.tolist() == [
+                True, False, True, True
+            ]
+        finally:
+            os.unlink(f)
+
+
+class TestWriteForeignReadable:
+    @pytest.mark.parametrize("codec", ["snappy", "zstd", "gzip"])
+    def test_pyarrow_reads_our_files(self, codec):
+        src = _write_pa("snappy")
+        out = tempfile.mktemp(suffix=".parquet")
+        try:
+            cols, n = PQ.read_parquet(src)
+            PQ.write_parquet(out, cols, n, codec=codec)
+            t = pq.read_table(out)
+            assert t.column("id").to_pylist() == [1, 2, 3, 4]
+            assert t.column("tags").to_pylist() == TAGS
+            names = t.column("name").to_pylist()
+            names = [
+                x.decode() if isinstance(x, bytes) else x for x in names
+            ]
+            assert names == ["a", "bb", None, "dd"]
+            assert t.column("score").to_pylist() == [1.5, None, 3.5, 4.0]
+        finally:
+            os.unlink(src)
+            if os.path.exists(out):
+                os.unlink(out)
+
+    def test_self_round_trip_row_groups(self):
+        src = _write_pa("snappy")
+        out = tempfile.mktemp(suffix=".parquet")
+        try:
+            cols, n = PQ.read_parquet(src)
+            PQ.write_parquet(
+                out, cols, n, codec="zstd", row_group_rows=2
+            )
+            cols2, n2 = PQ.read_parquet(out)
+            assert n2 == 4
+            tg = {c.name: c for c in cols2}["tags"]
+            assert list(tg.list_lengths) == [2, 0, 0, 3]
+            assert list(tg.element_valid) == [
+                True, True, True, False, True
+            ]
+        finally:
+            os.unlink(src)
+            if os.path.exists(out):
+                os.unlink(out)

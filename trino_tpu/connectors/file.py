@@ -401,6 +401,14 @@ class _FileStore:
         first_cols, _ = per_file[0]
         columns: List[ColumnMetadata] = []
         for c in first_cols:
+            if c.list_lengths is not None:
+                # the parquet codec reads 3-level LISTs (r5); mapping
+                # them onto engine ArrayColumns through this connector
+                # is not wired yet — fail loudly, never flatten
+                raise ValueError(
+                    f"parquet LIST column {c.name!r} is not yet "
+                    "supported by the file connector"
+                )
             columns.append(ColumnMetadata(c.name, _parquet_type(c)))
         data: Dict[str, np.ndarray] = {}
         valid: Dict[str, Optional[np.ndarray]] = {}
@@ -787,8 +795,10 @@ class ParquetPageSink(ConnectorPageSink):
             cols.append(_to_parquet_column(
                 cm, data, None if valid.all() else valid, None
             ))
-        # gzip + dictionary pages by default (r4); 64k-row groups give
-        # the reader's min/max pruning real skip granularity
+        # gzip (C-speed zlib) + dictionary pages by default; SNAPPY/
+        # ZSTD are read+write capable (parquet_format) but the pure-
+        # python snappy encoder would tax every CTAS on this host.
+        # 64k-row groups give min/max pruning real skip granularity
         PQ.write_parquet(
             self._tmp, cols, self.rows, codec="gzip",
             row_group_rows=1 << 16,

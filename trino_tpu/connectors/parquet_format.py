@@ -1,22 +1,25 @@
-"""Minimal Parquet reader/writer (pure python, no external deps).
+"""Parquet reader/writer (from-scratch, no external parquet deps).
 
-Analogue of lib/trino-parquet (28.1k LoC in the reference): the subset
-the engine's types need — PLAIN encoding, UNCOMPRESSED pages, data page
-v1, optional fields via RLE/bit-packed definition levels, and the
-Thrift Compact Protocol for the footer metadata. Physical/logical
+Analogue of lib/trino-parquet (28.1k LoC in the reference), built
+directly on the parquet-format spec: Thrift Compact Protocol footers,
+v1 data pages with PLAIN and RLE_DICTIONARY encodings, RLE/bit-packed
+definition AND repetition levels, per-chunk min/max statistics driving
+row-group predicate pruning, and SNAPPY (pure-python, utils/snappy.py)
+/ GZIP (RFC-1952 framing) / ZSTD page compression. Nested 3-level
+LIST columns (the shape every modern writer emits) read and write with
+Dremel-style record assembly; interop is cross-checked against pyarrow
+in both directions (tests/test_parquet_interop.py). Physical/logical
 types covered:
 
-  BOOLEAN              <- boolean
-  INT32 (+DATE)        <- integer, date
+  BOOLEAN                           <- boolean
+  INT32 (+DATE)                     <- integer, date
   INT64 (+DECIMAL/TIMESTAMP_MICROS) <- bigint, decimal(<=18), timestamp
-  FLOAT / DOUBLE       <- real, double
-  BYTE_ARRAY (+UTF8)   <- varchar
+  FLOAT / DOUBLE                    <- real, double
+  BYTE_ARRAY (+UTF8)                <- varchar
+  3-level LIST of any of the above  <- array(T)
 
-The format follows the parquet-format spec directly (file magic PAR1,
-footer = thrift FileMetaData + little-endian length + PAR1; each column
-chunk = one v1 data page). The reader skips unknown thrift fields, so
-files written by other engines with extra metadata (statistics, CRCs,
-column indexes) still read as long as pages are PLAIN + uncompressed.
+The reader skips unknown thrift fields, so files with extra metadata
+(CRCs, column indexes, bloom filters) still read.
 """
 
 from __future__ import annotations
@@ -245,6 +248,11 @@ class ParquetColumn:
     precision: Optional[int] = None
     values: Any = None          # np.ndarray, or list[bytes] for BYTE_ARRAY
     valid: Optional[np.ndarray] = None
+    # LIST columns (3-level parquet lists): per-row element counts,
+    # per-FLAT-ELEMENT validity; `values` holds the flat elements and
+    # `valid` the per-row validity
+    list_lengths: Optional[np.ndarray] = None
+    element_valid: Optional[np.ndarray] = None
 
 
 def _bitpack_levels(valid: np.ndarray) -> bytes:
@@ -287,6 +295,66 @@ def _read_levels(data: bytes, pos: int, n: int) -> Tuple[np.ndarray, int]:
             out[i:i + take] = val & 1
             i += take
     return out.astype(bool), end
+
+
+def _read_levels_n(data: bytes, pos: int, n: int, width: int
+                   ) -> Tuple[np.ndarray, int]:
+    """RLE/bit-packed hybrid at an arbitrary bit width (repetition and
+    definition levels of nested columns), length-prefixed (v1 pages)."""
+    (total_len,) = struct.unpack_from("<I", data, pos)
+    pos += 4
+    end = pos + total_len
+    out = np.zeros(n, dtype=np.uint8)
+    i = 0
+    r = _Reader(data, pos)
+    vbytes = (width + 7) // 8
+    while i < n and r.pos < end:
+        header = r._uvarint()
+        if header & 1:  # bit-packed: (groups << 1) | 1
+            groups = header >> 1
+            cnt = groups * 8
+            raw = np.frombuffer(
+                r.d[r.pos:r.pos + groups * width], dtype=np.uint8
+            )
+            r.pos += groups * width
+            bits = np.unpackbits(raw, bitorder="little")
+            vals = np.zeros(cnt, dtype=np.uint8)
+            for b in range(width):
+                vals |= (bits[b::width][:cnt] << b).astype(np.uint8)
+            take = min(cnt, n - i)
+            out[i:i + take] = vals[:take]
+            i += take
+        else:  # RLE run: (count << 1); value in ceil(width/8) bytes
+            count = header >> 1
+            val = int.from_bytes(r.d[r.pos:r.pos + vbytes], "little")
+            r.pos += vbytes
+            take = min(count, n - i)
+            out[i:i + take] = val
+            i += take
+    return out, end
+
+
+def _bitpack_levels_n(levels: np.ndarray, width: int) -> bytes:
+    """Arbitrary-width levels as ONE bit-packed run of the hybrid."""
+    n = len(levels)
+    groups = (n + 7) // 8
+    padded = np.zeros(groups * 8, dtype=np.uint8)
+    padded[:n] = np.asarray(levels, np.uint8)
+    bits = np.zeros((groups * 8, width), dtype=np.uint8)
+    for b in range(width):
+        bits[:, b] = (padded >> b) & 1
+    packed = np.packbits(bits.reshape(-1), bitorder="little").tobytes()
+    header = bytes([(groups << 1) | 1]) if groups < 64 else None
+    if header is None:
+        out = bytearray()
+        g = groups
+        v = (g << 1) | 1
+        while v >= 0x80:
+            out.append((v & 0x7F) | 0x80)
+            v >>= 7
+        out.append(v)
+        header = bytes(out)
+    return header + packed
 
 
 def _plain_encode(col: ParquetColumn) -> bytes:
@@ -343,30 +411,58 @@ def _plain_decode(physical: int, data: bytes, n: int):
 # write
 # ---------------------------------------------------------------------------
 
-# parquet compression codecs this codec speaks (stdlib only: SNAPPY has
-# no stdlib decoder, LZ4/ZSTD none either — GZIP is the portable one)
+# parquet compression codecs this codec speaks: GZIP via zlib,
+# SNAPPY via the pure-python codec (utils/snappy.py — the codec real
+# lakes actually write), ZSTD via the baked-in zstandard module
 CODEC_UNCOMPRESSED = 0
+CODEC_SNAPPY = 1
 CODEC_GZIP = 2
-_CODEC_NAMES = {"none": CODEC_UNCOMPRESSED, "gzip": CODEC_GZIP}
+CODEC_ZSTD = 6
+_CODEC_NAMES = {"none": CODEC_UNCOMPRESSED, "snappy": CODEC_SNAPPY,
+                "gzip": CODEC_GZIP, "zstd": CODEC_ZSTD}
 
 
 def _compress(codec: int, payload: bytes) -> bytes:
     if codec == CODEC_GZIP:
-        import gzip
+        import zlib
 
-        return gzip.compress(payload, compresslevel=1)
+        # parquet GZIP is RFC-1952 gzip framing (other engines reject
+        # bare zlib streams)
+        co = zlib.compressobj(wbits=zlib.MAX_WBITS | 16)
+        return co.compress(payload) + co.flush()
+    if codec == CODEC_SNAPPY:
+        from trino_tpu.utils import snappy
+
+        return snappy.compress(payload)
+    if codec == CODEC_ZSTD:
+        import zstandard
+
+        return zstandard.ZstdCompressor().compress(payload)
     return payload
 
 
 def _decompress(codec: int, payload: bytes, uncompressed_size: int) -> bytes:
     if codec == CODEC_GZIP:
-        import gzip
+        import zlib
 
-        return gzip.decompress(payload)
+        # auto-detect gzip or legacy zlib framing (files this codec
+        # wrote before r5 used bare zlib)
+        return zlib.decompress(payload, wbits=zlib.MAX_WBITS | 32)
+    if codec == CODEC_SNAPPY:
+        from trino_tpu.utils import snappy
+
+        return snappy.decompress(payload)
+    if codec == CODEC_ZSTD:
+        import zstandard
+
+        return zstandard.ZstdDecompressor().decompress(
+            payload, max_output_size=max(uncompressed_size, 1)
+        )
     if codec == CODEC_UNCOMPRESSED:
         return payload
     raise ValueError(
-        f"unsupported parquet codec {codec} (UNCOMPRESSED/GZIP only)"
+        f"unsupported parquet codec {codec} "
+        "(UNCOMPRESSED/SNAPPY/GZIP/ZSTD)"
     )
 
 
@@ -467,6 +563,74 @@ def _write_chunk(body: bytearray, col: ParquetColumn, codec: int,
     first_offset = len(body)
 
     payload = bytearray()
+    if col.list_lengths is not None:
+        # LIST leaf: [rep levels][def levels][PLAIN dense values]
+        lengths = np.asarray(col.list_lengths, np.int64)
+        row_valid = (
+            np.ones(len(lengths), bool) if valid is None else valid
+        )
+        ev = (
+            np.ones(int(lengths.sum()), bool)
+            if col.element_valid is None
+            else np.asarray(col.element_valid, bool)
+        )
+        base = 1  # outer group written optional
+        max_def = 3  # outer optional + repeated + optional element
+        reps: List[int] = []
+        defs: List[int] = []
+        fi = 0
+        for L, rv in zip(lengths, row_valid):
+            if not rv:
+                reps.append(0)
+                defs.append(0)
+                fi += int(L)
+                continue
+            if L == 0:
+                reps.append(0)
+                defs.append(base)
+                continue
+            for j in range(int(L)):
+                reps.append(0 if j == 0 else 1)
+                defs.append(max_def if ev[fi] else max_def - 1)
+                fi += 1
+        n = len(reps)
+        rl = _bitpack_levels_n(np.asarray(reps, np.uint8), 1)
+        dl = _bitpack_levels_n(np.asarray(defs, np.uint8), 2)
+        payload += struct.pack("<I", len(rl)) + rl
+        payload += struct.pack("<I", len(dl)) + dl
+        # elements belonging to NULL rows carry no def-level entries,
+        # so they must not enter the dense value stream either
+        rv_per_elem = np.repeat(row_valid, lengths)
+        keep = ev & rv_per_elem
+        if col.physical == T_BYTE_ARRAY:
+            dense_vals = [v for v, ok in zip(col.values, keep) if ok]
+        else:
+            dense_vals = np.asarray(col.values)[keep]
+        use_dictionary = False
+        valid = None
+        payload += _plain_encode(
+            dataclasses.replace(
+                col, values=dense_vals, valid=None, list_lengths=None
+            )
+        )
+        raw = bytes(payload)
+        comp = _compress(codec, raw)
+        ph = _Writer()
+        ph.i32(1, 0)                # DATA_PAGE
+        ph.i32(2, len(raw))
+        ph.i32(3, len(comp))
+        ph.struct_begin(5)
+        ph.i32(1, n)
+        ph.i32(2, 0)                # PLAIN
+        ph.i32(3, 3)                # def levels: RLE
+        ph.i32(4, 3)                # rep levels: RLE
+        ph.struct_end()
+        ph.root_end()
+        first_offset = data_page_offset = len(body)
+        body += ph.buf
+        body += comp
+        nbytes = len(body) - first_offset
+        return None, data_page_offset, first_offset, nbytes, n, None
     if valid is not None:
         levels = _bitpack_levels(valid)
         payload += struct.pack("<I", len(levels))
@@ -553,6 +717,27 @@ def write_parquet(path: str, columns: List[ParquetColumn], num_rows: int,
         g1 = min(g0 + row_group_rows, num_rows)
         chunk_meta = []
         for col in columns:
+            if col.list_lengths is not None:
+                lens = np.asarray(col.list_lengths, np.int64)
+                cum = np.concatenate([[0], np.cumsum(lens)])
+                f0, f1 = int(cum[g0]), int(cum[g1])
+                sl = dataclasses.replace(
+                    col,
+                    values=(
+                        col.values[f0:f1]
+                        if col.physical == T_BYTE_ARRAY
+                        else np.asarray(col.values)[f0:f1]
+                    ),
+                    valid=None if col.valid is None
+                    else np.asarray(col.valid, bool)[g0:g1],
+                    list_lengths=lens[g0:g1],
+                    element_valid=None if col.element_valid is None
+                    else np.asarray(col.element_valid, bool)[f0:f1],
+                )
+                chunk_meta.append(
+                    (col, _write_chunk(body, sl, codec_id, False))
+                )
+                continue
             sl_vals = (
                 col.values[g0:g1]
                 if col.physical == T_BYTE_ARRAY
@@ -575,13 +760,45 @@ def write_parquet(path: str, columns: List[ParquetColumn], num_rows: int,
     w = _Writer()
     w.i32(1, 1)  # version
     # schema: root + leaves
-    w.list_begin(2, _CT_STRUCT, len(columns) + 1)
+    n_schema = 1 + sum(
+        3 if c.list_lengths is not None else 1 for c in columns
+    )
+    w.list_begin(2, _CT_STRUCT, n_schema)
     root = _Writer()
     root.string(4, "schema")
     root.i32(5, len(columns))
     root.root_end()
     w.buf += root.buf
     for col in columns:
+        if col.list_lengths is not None:
+            # 3-level LIST: optional group (LIST) > repeated group
+            # "list" > optional leaf "element"
+            outer = _Writer()
+            outer.i32(3, 1)
+            outer.string(4, col.name)
+            outer.i32(5, 1)
+            outer.i32(6, 3)  # converted LIST
+            outer.root_end()
+            w.buf += outer.buf
+            mid = _Writer()
+            mid.i32(3, 2)  # repeated
+            mid.string(4, "list")
+            mid.i32(5, 1)
+            mid.root_end()
+            w.buf += mid.buf
+            se = _Writer()
+            se.i32(1, col.physical)
+            se.i32(3, 1)  # optional element
+            se.string(4, "element")
+            if col.converted is not None:
+                se.i32(6, col.converted)
+            if col.scale is not None:
+                se.i32(7, col.scale)
+            if col.precision is not None:
+                se.i32(8, col.precision)
+            se.root_end()
+            w.buf += se.buf
+            continue
         se = _Writer()
         se.i32(1, col.physical)
         se.i32(3, 1 if col.valid is not None else 0)  # optional/required
@@ -609,8 +826,14 @@ def write_parquet(path: str, columns: List[ParquetColumn], num_rows: int,
             cc.list_i32_elem(0)             # PLAIN
             if dict_off is not None:
                 cc.list_i32_elem(8)         # RLE_DICTIONARY
-            cc.list_begin(3, _CT_BINARY, 1)
-            cc.list_string_elem(col.name)
+            if col.list_lengths is not None:
+                cc.list_begin(3, _CT_BINARY, 3)
+                cc.list_string_elem(col.name)
+                cc.list_string_elem("list")
+                cc.list_string_elem("element")
+            else:
+                cc.list_begin(3, _CT_BINARY, 1)
+                cc.list_string_elem(col.name)
             cc.i32(4, codec_id)
             cc.i64(5, nvals)
             cc.i64(6, nbytes)
@@ -656,6 +879,51 @@ def _decode_stat(physical: int, raw: bytes):
     return np.frombuffer(raw, fmt)[0].item()
 
 
+def _assemble_list_column(col: ParquetColumn, li: dict, parts) -> None:
+    """(rep, def, values) page parts -> per-row lengths + flat elements
+    (the record-shredding inverse, Dremel assembly)."""
+    base = 1 if li["outer_opt"] else 0
+    max_def = li["max_def"]
+    lengths: List[int] = []
+    row_valid: List[bool] = []
+    elem_valid: List[bool] = []
+    flats: List = []
+    for (rep, deff), vals in parts:
+        vi = 0
+        for i in range(len(rep)):
+            if rep[i] == 0:  # new row
+                lengths.append(0)
+                row_valid.append(deff[i] >= base)
+            if deff[i] > base:  # an element entry (maybe null)
+                lengths[-1] += 1
+                ok = deff[i] == max_def
+                elem_valid.append(bool(ok))
+                if ok:
+                    if col.physical == T_BYTE_ARRAY:
+                        flats.append(vals[vi])
+                    else:
+                        flats.append(vals[vi].item()
+                                     if hasattr(vals[vi], "item")
+                                     else vals[vi])
+                    vi += 1
+                else:
+                    flats.append(
+                        b"" if col.physical == T_BYTE_ARRAY else 0
+                    )
+    col.list_lengths = np.asarray(lengths, np.int32)
+    col.valid = (
+        np.asarray(row_valid, bool) if li["outer_opt"] else None
+    )
+    col.element_valid = np.asarray(elem_valid, bool)
+    if col.physical == T_BYTE_ARRAY:
+        col.values = flats
+    else:
+        dtype = {T_INT32: np.int32, T_INT64: np.int64,
+                 T_FLOAT: np.float32, T_DOUBLE: np.float64,
+                 T_BOOLEAN: np.bool_}.get(col.physical, np.float64)
+        col.values = np.asarray(flats, dtype=dtype)
+
+
 def read_parquet(path: str, predicate: Optional[Dict[str, tuple]] = None
                  ) -> Tuple[List[ParquetColumn], int]:
     """`predicate`: {column: (lo, hi)} closed ranges (None = unbounded
@@ -670,23 +938,71 @@ def read_parquet(path: str, predicate: Optional[Dict[str, tuple]] = None
     schema = meta[2]
     num_rows = meta[3]
     row_groups = meta[4]
-    # leaves (skip the root element); nested schemas unsupported
-    leaves = []
-    for se in schema[1:]:
-        if 5 in se and se.get(5, 0) > 0 and 1 not in se:
-            raise ValueError("nested parquet schemas not supported")
-        leaves.append(se)
-    cols: List[ParquetColumn] = [
-        ParquetColumn(
-            name=se[4].decode("utf-8"),
-            physical=se[1],
-            converted=se.get(6),
-            scale=se.get(7),
-            precision=se.get(8),
-            valid=None if se.get(3, 0) == 0 else np.zeros(0, bool),
-        )
-        for se in leaves
-    ]
+    # schema tree walk: flat leaves plus 3-level LIST groups (the
+    # shape every modern writer emits for arrays —
+    # LogicalTypes.md#lists). Leaf order matches row-group chunk order.
+    descs: List[dict] = []
+    idx = [1]
+
+    def _walk_field():
+        se = schema[idx[0]]
+        idx[0] += 1
+        nch = se.get(5, 0)
+        if nch == 0:
+            descs.append({"se": se, "list": None})
+            return
+        if se.get(6) == 3 and nch == 1:  # converted LIST
+            outer_opt = se.get(3, 0) == 1
+            mid = schema[idx[0]]
+            idx[0] += 1
+            if mid.get(3, 0) != 2 or mid.get(5, 0) != 1:
+                raise ValueError("unsupported LIST shape")
+            leaf = schema[idx[0]]
+            idx[0] += 1
+            if leaf.get(5, 0):
+                raise ValueError(
+                    "nested parquet beyond one LIST level not supported"
+                )
+            elem_opt = leaf.get(3, 0) == 1
+            descs.append({
+                "se": leaf, "list": {
+                    "name": se[4].decode("utf-8"),
+                    "outer_opt": outer_opt,
+                    "elem_opt": elem_opt,
+                    "max_def": (1 if outer_opt else 0) + 1
+                    + (1 if elem_opt else 0),
+                },
+            })
+            return
+        raise ValueError("nested parquet group schemas not supported")
+
+    n_root = schema[0].get(5, 0)
+    for _ in range(n_root):
+        _walk_field()
+
+    cols: List[ParquetColumn] = []
+    for d in descs:
+        se = d["se"]
+        if d["list"] is None:
+            cols.append(ParquetColumn(
+                name=se[4].decode("utf-8"),
+                physical=se[1],
+                converted=se.get(6),
+                scale=se.get(7),
+                precision=se.get(8),
+                valid=None if se.get(3, 0) == 0 else np.zeros(0, bool),
+            ))
+        else:
+            li = d["list"]
+            cols.append(ParquetColumn(
+                name=li["name"],
+                physical=se[1],
+                converted=se.get(6),
+                scale=se.get(7),
+                precision=se.get(8),
+                valid=np.zeros(0, bool) if li["outer_opt"] else None,
+                list_lengths=np.zeros(0, np.int32),
+            ))
     chunks: List[List[Tuple[np.ndarray, Any]]] = [[] for _ in cols]
     rows_read = 0
     for rg in row_groups:
@@ -739,6 +1055,25 @@ def read_parquet(path: str, predicate: Optional[Dict[str, tuple]] = None
                     continue
                 n_vals = dph[1]
                 enc = dph.get(2, 0)
+                li = descs[ci]["list"]
+                if li is not None:
+                    # [rep levels][def levels][PLAIN values]
+                    max_def = li["max_def"]
+                    def_w = max(max_def.bit_length(), 1)
+                    rep, p2 = _read_levels_n(page, 0, n_vals, 1)
+                    deff, p3 = _read_levels_n(page, p2, n_vals, def_w)
+                    if enc != 0:
+                        raise ValueError(
+                            "dictionary-encoded LIST pages not supported"
+                        )
+                    n_phys = int((deff == max_def).sum())
+                    vals = _plain_decode(
+                        cols[ci].physical, page[p3:], n_phys
+                    )
+                    chunks[ci].append(((rep, deff), vals))
+                    n_remaining -= n_vals
+                    pos = page_start + page_len
+                    continue
                 if cols[ci].valid is not None:
                     valid, vpos = _read_levels(page, 0, n_vals)
                     body_bytes = page[vpos:]
@@ -768,6 +1103,10 @@ def read_parquet(path: str, predicate: Optional[Dict[str, tuple]] = None
                 pos = page_start + page_len
     for ci, col in enumerate(cols):
         parts = chunks[ci]
+        li = descs[ci]["list"]
+        if li is not None:
+            _assemble_list_column(col, li, parts)
+            continue
         if col.physical == T_BYTE_ARRAY:
             dense: List[bytes] = []
             for _, v in parts:

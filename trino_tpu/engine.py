@@ -791,33 +791,33 @@ class LocalQueryRunner:
                 ),
                 from_=target_rel,
             )
-        items = []
-        for col in meta.columns:
-            old = tcol(col.name)
-            whens = []
-            for cl in m_clauses:
-                cond = cl.condition or true_lit
-                val = dict(cl.assignments).get(col.name, old) \
-                    if cl.action == "update" else old
-                whens.append(ast.WhenClause(
-                    ast.BinaryOp("and", matched, cond), val
+        else:
+            items = []
+            for col in meta.columns:
+                old = tcol(col.name)
+                whens = []
+                for cl in m_clauses:
+                    cond = cl.condition or true_lit
+                    val = dict(cl.assignments).get(col.name, old) \
+                        if cl.action == "update" else old
+                    whens.append(ast.WhenClause(
+                        ast.BinaryOp("and", matched, cond), val
+                    ))
+                items.append(ast.SelectItem(
+                    ast.Case(None, tuple(whens), old), col.name
                 ))
-            e = ast.Case(None, tuple(whens), old) if whens else old
-            items.append(ast.SelectItem(e, col.name))
-        # a row drops iff matched AND its first applicable arm is DELETE
-        del_whens = [
-            ast.WhenClause(
-                cl.condition or true_lit,
-                true_lit if cl.action == "delete" else false_lit,
+            # a row drops iff matched AND its first applicable arm is
+            # DELETE
+            del_whens = [
+                ast.WhenClause(
+                    cl.condition or true_lit,
+                    true_lit if cl.action == "delete" else false_lit,
+                )
+                for cl in m_clauses
+            ]
+            drop = ast.BinaryOp(
+                "and", matched, ast.Case(None, tuple(del_whens), false_lit)
             )
-            for cl in m_clauses
-        ]
-        drop = ast.BinaryOp(
-            "and", matched,
-            ast.Case(None, tuple(del_whens), false_lit)
-            if del_whens else false_lit,
-        )
-        if m_clauses:
             survivors = ast.QuerySpec(
                 tuple(items),
                 from_=ast.Join("left", target_rel, flagged_source, stmt.on),

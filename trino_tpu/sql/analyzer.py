@@ -3414,7 +3414,8 @@ class Analyzer:
                     )
                 sel = spec.select[0].expr
 
-                def add_mark(extra: Optional[ast.Expression]) -> int:
+                def add_mark(extra: Optional[ast.Expression],
+                             match_value: bool = False) -> int:
                     mark_ch = len(builder.scope)
                     inner_items: List[RelationItem] = []
                     pool: List[ast.Expression] = []
@@ -3427,6 +3428,26 @@ class Analyzer:
                     inner, pk, bk, residuals = self._decorrelate(
                         builder, inner_items, pool, filter_outer=False
                     )
+                    if match_value:
+                        # the value = sel correlation passes as EXPLICIT
+                        # key channels — injecting the equality into the
+                        # pool would let an outer value identifier
+                        # mis-resolve against a same-named inner column
+                        if not (
+                            isinstance(sel, ast.Identifier)
+                            and inner.scope.try_resolve(sel.parts)
+                            is not None
+                        ):
+                            raise AnalysisError(
+                                "correlated IN subquery must select a "
+                                "column"
+                            )
+                        pk = list(pk) + [
+                            builder.scope.resolve(value.parts)[0]
+                        ]
+                        bk = list(bk) + [
+                            inner.scope.resolve(sel.parts)[0]
+                        ]
                     residual_ir = None
                     if residuals:
                         conv = ExprConverter(
@@ -3446,7 +3467,7 @@ class Analyzer:
                     )
                     return mark_ch
 
-                m_match = add_mark(ast.BinaryOp("eq", value, sel))
+                m_match = add_mark(None, match_value=True)
                 m_null = add_mark(ast.IsNullPredicate(sel, False))
                 m_any = add_mark(None)
                 conv = builder.converter()

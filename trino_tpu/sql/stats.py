@@ -131,6 +131,10 @@ class StatsCalculator:
             return PlanStats(
                 max(left.row_count * 0.5, 1.0), dict(left.columns)
             )
+        if node.kind in ("mark", "mark_exists"):
+            # mark joins preserve probe cardinality exactly; the output
+            # is the probe columns + one BOOLEAN channel (no stats)
+            return PlanStats(left.row_count, dict(left.columns))
         # equi-join estimate: |L|*|R| / max(ndv of the key pair).
         # Unknown NDV defaults to the side's ROW COUNT (join keys are
         # near-unique on one side in analytic schemas — FK->PK). The old
