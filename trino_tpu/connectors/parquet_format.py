@@ -606,8 +606,6 @@ def _write_chunk(body: bytearray, col: ParquetColumn, codec: int,
             dense_vals = [v for v, ok in zip(col.values, keep) if ok]
         else:
             dense_vals = np.asarray(col.values)[keep]
-        use_dictionary = False
-        valid = None
         payload += _plain_encode(
             dataclasses.replace(
                 col, values=dense_vals, valid=None, list_lengths=None

@@ -77,16 +77,30 @@ class QueryScheduler:
         for sp in order:
             for c in sp.children:
                 consumer_counts[c.fragment.id] = task_counts[sp.fragment.id]
-        from trino_tpu.runtime.node_scheduler import UniformNodeSelector
+        from trino_tpu.runtime.node_scheduler import (
+            TopologyAwareNodeSelector,
+            UniformNodeSelector,
+        )
 
         # least-loaded placement with a per-node cap (NodeScheduler /
-        # UniformNodeSelector analogue; replaces blind round-robin)
-        selector = UniformNodeSelector(
-            max_tasks_per_node=max(
-                2,
-                (sum(task_counts.values()) + len(self.workers) - 1)
-                // max(len(self.workers), 1),
-            )
+        # UniformNodeSelector analogue; replaces blind round-robin).
+        # Workers carrying a `location` ("rack/host" — the ICI-island
+        # coordinate on a TPU pod) upgrade to tiered topology-aware
+        # selection (TopologyAwareNodeSelector.java)
+        cap = max(
+            2,
+            (sum(task_counts.values()) + len(self.workers) - 1)
+            // max(len(self.workers), 1),
+        )
+        locations = {
+            id(w): getattr(w, "location")
+            for w in self.workers
+            if getattr(w, "location", None)
+        }
+        selector = (
+            TopologyAwareNodeSelector(locations, max_tasks_per_node=cap)
+            if locations
+            else UniformNodeSelector(max_tasks_per_node=cap)
         )
         for sp in order:
             f = sp.fragment
@@ -122,7 +136,16 @@ class QueryScheduler:
                     collect_stats=self.collect_stats,
                     task_concurrency=self.session.task_concurrency,
                 )
-                worker = selector.select(self.workers)
+                if locations and created:
+                    # co-schedule a fragment's tasks on the FIRST
+                    # task's island: its exchanges then ride ICI, not
+                    # DCN (the TopologyAwareNodeSelector motivation)
+                    first_loc = locations.get(id(created[0][0]))
+                    worker = selector.select(
+                        self.workers, location=first_loc
+                    )
+                else:
+                    worker = selector.select(self.workers)
                 worker.create_task(spec)
                 created.append((worker, str(task_id)))
             self.tasks[f.id] = created

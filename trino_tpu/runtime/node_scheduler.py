@@ -48,6 +48,20 @@ class UniformNodeSelector:
                 self._baseline[key] = 0
         return self._baseline[key] + self._assigned.get(key, 0)
 
+    def _pick_below_cap_locked(self, pool: Sequence):
+        """Least-loaded node of `pool` under the cap, or None (caller
+        holds the lock)."""
+        loads = [(self._load(h), i, h) for i, h in enumerate(pool)]
+        loads.sort(key=lambda t: (t[0], t[1]))
+        for load, _, h in loads:
+            if (
+                self.max_tasks_per_node is None
+                or load < self.max_tasks_per_node
+            ):
+                self._assigned[id(h)] = self._assigned.get(id(h), 0) + 1
+                return h
+        return None
+
     def select(self, active: Sequence, preferred: Sequence = ()) -> object:
         if not active:
             raise RuntimeError("no active workers")
@@ -80,6 +94,50 @@ class UniformNodeSelector:
                 self._assigned[id(handle)] = n - 1
             else:
                 self._assigned.pop(id(handle), None)
+
+
+class TopologyAwareNodeSelector(UniformNodeSelector):
+    """Locality-tiered placement (TopologyAwareNodeSelector.java /
+    FlatNetworkTopology): a split carrying a preferred LOCATION fills
+    nodes tier by tier — same host, then same rack/pod (the ICI-island
+    analogue on a TPU pod: co-scheduling a fragment's tasks inside one
+    island keeps its exchanges on ICI instead of DCN), then anywhere.
+    Node locations are "host" or "rack/host" strings; each tier re-uses
+    the least-loaded policy of the parent class."""
+
+    def __init__(self, locations: Dict[int, str],
+                 max_tasks_per_node: Optional[int] = None):
+        super().__init__(max_tasks_per_node)
+        # id(handle) -> "rack/host" (or bare "host")
+        self._locations = dict(locations)
+
+    @staticmethod
+    def _rack(loc: str) -> str:
+        return loc.rsplit("/", 1)[0] if "/" in loc else loc
+
+    def select(self, active: Sequence, preferred: Sequence = (),
+               location: Optional[str] = None) -> object:
+        if location is None:
+            return super().select(active, preferred)
+        same_host = [
+            h for h in active
+            if self._locations.get(id(h)) == location
+        ]
+        want_rack = self._rack(location)
+        same_rack = [
+            h for h in active
+            if self._rack(self._locations.get(id(h), "")) == want_rack
+        ]
+        # STRICT tiers: a below-cap same-host node beats ANY same-rack
+        # node regardless of load; each tier is least-loaded internally
+        with self._lock:
+            for pool in (same_host, same_rack, list(preferred)):
+                if not pool:
+                    continue
+                pick = self._pick_below_cap_locked(pool)
+                if pick is not None:
+                    return pick
+        return super().select(active)
 
 
 class PartitionMemoryEstimator:

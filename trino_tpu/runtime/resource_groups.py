@@ -4,9 +4,11 @@ Analogue of main/execution/resourcegroups/ (InternalResourceGroupManager,
 InternalResourceGroup with hard/soft concurrency + queue limits,
 selector-based routing — SURVEY.md §2.3) and the file-based config
 plugin (trino-resource-group-managers). Groups form a tree; a query is
-admitted when every group on its path has a free concurrency slot, else
-it queues FIFO (the WeightedFairQueue reduces to FIFO until weights
-land). Selectors map (user, source) -> group path."""
+admitted when every group on its path has a free concurrency slot.
+Contending sibling groups share capacity by WEIGHTED FAIRNESS
+(scheduling_weight, the WeightedFairQueue analogue realized as stride
+scheduling: each admission advances the group's virtual pass by
+1/weight and the smallest pass admits next; FIFO within a group). Selectors map (user, source) -> group path."""
 
 from __future__ import annotations
 
@@ -25,6 +27,9 @@ class ResourceGroupSpec:
     name: str
     max_concurrency: int = 10
     max_queued: int = 100
+    # relative share under a contended parent (WeightedFairQueue's
+    # per-entry weight; execution/resourcegroups/WeightedFairQueue.java)
+    scheduling_weight: int = 1
     sub_groups: List["ResourceGroupSpec"] = dataclasses.field(default_factory=list)
 
 
@@ -44,12 +49,26 @@ class Selector:
         return True
 
 
+@dataclasses.dataclass
+class _Ticket:
+    """One waiting admission request (FIFO sequence within a group)."""
+
+    seq: int
+    admitted: bool = False
+
+
 class _Group:
     def __init__(self, spec: ResourceGroupSpec, parent: Optional["_Group"]):
         self.spec = spec
         self.parent = parent
         self.running = 0
         self.queued = 0
+        # stride-scheduling virtual pass: each admission advances the
+        # group by 1/weight; the smallest pass admits next. New or
+        # long-idle groups REJOIN at the scheduler's current pass, so
+        # history never starves active siblings
+        self.vpass = 0.0
+        self.waiters: List["_Ticket"] = []
         self.children: Dict[str, _Group] = {
             c.name: _Group(c, self) for c in spec.sub_groups
         }
@@ -73,6 +92,8 @@ class ResourceGroupManager:
         self._root = _Group(root, None)
         self._selectors = list(selectors)
         self._lock = threading.Condition()
+        self._next_seq = 0
+        self._gpass = 0.0
 
     def _resolve(self, user: str, source: str) -> _Group:
         for s in self._selectors:
@@ -92,41 +113,97 @@ class ResourceGroupManager:
             g = g.parent
         return out
 
+    def _schedule_locked(self) -> None:
+        """Admit as many waiting tickets as capacity allows, in
+        weighted-fair order: among groups with waiters, the smallest
+        stride-scheduling pass goes first (WeightedFairQueue's pick
+        rule); FIFO within a group."""
+        while True:
+            candidates = []
+
+            def collect(g: _Group) -> None:
+                if g.waiters:
+                    candidates.append(g)
+                for c in g.children.values():
+                    collect(c)
+
+            collect(self._root)
+            admitted = False
+            for g in sorted(
+                candidates,
+                key=lambda g: (g.vpass, g.waiters[0].seq),
+            ):
+                chain = self._chain(g)
+                if all(
+                    x.running < x.spec.max_concurrency for x in chain
+                ):
+                    t = g.waiters.pop(0)
+                    for x in chain:
+                        x.running += 1
+                        x.queued -= 1
+                    # stride advance; global pass trails the winner so
+                    # newcomers rejoin here, not at zero
+                    self._gpass = max(self._gpass, g.vpass)
+                    g.vpass = self._gpass + 1.0 / max(
+                        g.spec.scheduling_weight, 1
+                    )
+                    t.admitted = True
+                    admitted = True
+                    break
+            if not admitted:
+                return
+
     def acquire(self, user: str = "user", source: str = "", timeout: float = 60.0):
         """Returns a lease token (the group) once admitted."""
         group = self._resolve(user, source)
         chain = self._chain(group)
         with self._lock:
-            for g in chain:  # queue caps apply at EVERY level of the tree
-                if g.queued >= g.spec.max_queued:
-                    raise QueryQueueFullError(
-                        f"group {g.path()} queue is full "
-                        f"({g.spec.max_queued})"
-                    )
+            t = _Ticket(self._next_seq)
+            self._next_seq += 1
             for g in chain:
                 g.queued += 1
+            if not group.waiters:
+                # rejoin at the current pass: idle history is not a
+                # credit (the starvation guard of stride scheduling)
+                group.vpass = max(group.vpass, self._gpass)
+            group.waiters.append(t)
+            self._schedule_locked()
+            if not t.admitted:
+                # the queue cap counts WAITING queries only — a query
+                # admitted on arrival never queued (every tree level
+                # applies its own cap)
+                for g in chain:
+                    if g.queued > g.spec.max_queued:
+                        group.waiters.remove(t)
+                        for x in chain:
+                            x.queued -= 1
+                        raise QueryQueueFullError(
+                            f"group {g.path()} queue is full "
+                            f"({g.spec.max_queued})"
+                        )
+            self._lock.notify_all()
             try:
                 ok = self._lock.wait_for(
-                    lambda: all(
-                        g.running < g.spec.max_concurrency for g in chain
-                    ),
-                    timeout=timeout,
+                    lambda: t.admitted, timeout=timeout
                 )
-                if not ok:
-                    raise QueryQueueFullError(
-                        f"group {group.path()} admission timed out"
-                    )
-                for g in chain:
-                    g.running += 1
             finally:
-                for g in chain:
-                    g.queued -= 1
+                if not t.admitted:
+                    # timed out or interrupted: withdraw the ticket
+                    if t in group.waiters:
+                        group.waiters.remove(t)
+                    for g in chain:
+                        g.queued -= 1
+            if not ok:
+                raise QueryQueueFullError(
+                    f"group {group.path()} admission timed out"
+                )
         return group
 
     def release(self, group: _Group) -> None:
         with self._lock:
             for g in self._chain(group):
                 g.running -= 1
+            self._schedule_locked()
             self._lock.notify_all()
 
     def stats(self) -> Dict[str, Tuple[int, int]]:

@@ -24,8 +24,12 @@ class Worker:
         catalogs: Optional[CatalogManager] = None,
         failure_injector=None,
         memory_pool_bytes: Optional[int] = None,
+        location: Optional[str] = None,
     ):
         self.worker_id = worker_id
+        # "rack/host" network coordinate (the ICI-island id on a TPU
+        # pod); workers carrying one get topology-aware placement
+        self.location = location
         self.catalogs = catalogs or CatalogManager()
         self.failure_injector = failure_injector
         self.memory_pool = None

@@ -3433,21 +3433,40 @@ class Analyzer:
                         # key channels — injecting the equality into the
                         # pool would let an outer value identifier
                         # mis-resolve against a same-named inner column
-                        if not (
+                        if (
                             isinstance(sel, ast.Identifier)
                             and inner.scope.try_resolve(sel.parts)
                             is not None
                         ):
-                            raise AnalysisError(
-                                "correlated IN subquery must select a "
-                                "column"
+                            bk_ch = inner.scope.resolve(sel.parts)[0]
+                        else:
+                            # expression select item: project it onto a
+                            # fresh inner channel and key-join on that
+                            sel_ir = ExprConverter(
+                                inner.scope
+                            ).convert(sel)
+                            bk_ch = len(inner.scope.fields)
+                            exprs = tuple(
+                                ir.InputRef(i, f.type)
+                                for i, f in enumerate(inner.node.fields)
+                            ) + (sel_ir,)
+                            nf = inner.node.fields + (
+                                P.Field(None, sel_ir.type),
+                            )
+                            inner = RelationItem(
+                                P.ProjectNode(inner.node, exprs, nf),
+                                Scope(
+                                    inner.scope.fields
+                                    + [ScopeField(
+                                        None, None, sel_ir.type
+                                    )]
+                                ),
+                                0.0,
                             )
                         pk = list(pk) + [
                             builder.scope.resolve(value.parts)[0]
                         ]
-                        bk = list(bk) + [
-                            inner.scope.resolve(sel.parts)[0]
-                        ]
+                        bk = list(bk) + [bk_ch]
                     residual_ir = None
                     if residuals:
                         conv = ExprConverter(

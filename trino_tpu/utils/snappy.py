@@ -69,10 +69,16 @@ def decompress(data: bytes) -> bytes:
         start = len(out) - offset
         if start < 0:
             raise ValueError("snappy: offset before stream start")
-        # overlapping copies replicate byte-by-byte semantics
-        for _ in range(length):
-            out.append(out[start])
-            start += 1
+        if offset >= length:
+            # non-overlapping: one slice copy
+            out += out[start:start + length]
+        else:
+            # overlapping run: double a seed slice (byte-replication
+            # semantics) instead of a per-byte python loop
+            seed = bytes(out[start:])
+            while len(seed) < length:
+                seed = seed + seed
+            out += seed[:length]
     if len(out) != n:
         raise ValueError(
             f"snappy: length mismatch ({len(out)} != {n})"
