@@ -1075,10 +1075,10 @@ class LocalQueryRunner:
         groups = []
         wrapped_pipelines = []
         for p in pipelines:
-            ops, stats = instrument(p.operators)
+            ops, stats = instrument(p.operators, device_sync=True)
             groups.append(stats)
             wrapped_pipelines.append(Pipeline(ops))
-        main_ops, main_stats = instrument(chain)
+        main_ops, main_stats = instrument(chain, device_sync=True)
         groups.append(main_stats)
         for p in wrapped_pipelines:
             Driver(p).run()

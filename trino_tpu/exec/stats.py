@@ -3,10 +3,17 @@
 Analogue of OperatorStats/OperationTimer (main/operator/ — per-operator
 CPU/wall recorded on every getOutput/addInput, Driver.java:403/408,
 aggregated Driver->Pipeline->Task->Query and rendered by EXPLAIN ANALYZE
-— SURVEY.md §5.1). TPU caveat recorded honestly: wall time here measures
-HOST dispatch time; XLA executes asynchronously, so per-operator device
-time only appears at host-sync points (row_count, device_get) — the
-final sync is attributed to the sink that forces it.
+— SURVEY.md §5.1). Two timing modes:
+
+- default (pipelined): wall time measures HOST dispatch; XLA executes
+  asynchronously, so device time surfaces only at host-sync points and
+  the final sync lands on the sink that forces it.
+- device_sync (EXPLAIN ANALYZE): a device barrier closes every timed
+  section, so each operator's wall INCLUDES the device time of the
+  work it dispatched — true per-operator device attribution at the
+  cost of the async pipeline (the profile-run trade every engine's
+  ANALYZE makes; OperatorStats' added CPU accounting overhead is the
+  reference's version of the same).
 """
 
 from __future__ import annotations
@@ -28,6 +35,9 @@ class OperatorStats:
     add_input_s: float = 0.0
     get_output_s: float = 0.0
     finish_s: float = 0.0
+    # True when the timings above CLOSE with a device barrier (device-
+    # inclusive attribution); False = host dispatch only
+    device_synced: bool = False
 
     @property
     def total_s(self) -> float:
@@ -45,15 +55,27 @@ class OperatorStats:
         )
 
 
+def _device_barrier() -> None:
+    """Block until every dispatched device computation has finished
+    (same-device programs run in dispatch order, so blocking on a
+    freshly enqueued trivial program drains the queue)."""
+    import jax.numpy as jnp
+
+    jnp.zeros(()).block_until_ready()
+
+
 class InstrumentedOperator:
     """Transparent timing wrapper around one operator — the
     OperationTimer discipline without touching operator code."""
 
-    def __init__(self, inner, stats: OperatorStats, count_rows: bool):
+    def __init__(self, inner, stats: OperatorStats, count_rows: bool,
+                 device_sync: bool = False):
         self.inner = inner
         self.stats = stats
         self.stats.operator = type(inner).__name__
+        self.stats.device_synced = device_sync
         self._count_rows = count_rows
+        self._device_sync = device_sync
 
     def needs_input(self) -> bool:
         return self.inner.needs_input()
@@ -61,6 +83,8 @@ class InstrumentedOperator:
     def add_input(self, batch) -> None:
         t0 = time.monotonic()
         self.inner.add_input(batch)
+        if self._device_sync:
+            _device_barrier()
         self.stats.add_input_s += time.monotonic() - t0
         self.stats.add_input_calls += 1
         self.stats.input_batches += 1
@@ -70,6 +94,8 @@ class InstrumentedOperator:
     def get_output(self):
         t0 = time.monotonic()
         out = self.inner.get_output()
+        if self._device_sync:
+            _device_barrier()
         self.stats.get_output_s += time.monotonic() - t0
         self.stats.get_output_calls += 1
         if out is not None:
@@ -81,6 +107,8 @@ class InstrumentedOperator:
     def finish(self) -> None:
         t0 = time.monotonic()
         self.inner.finish()
+        if self._device_sync:
+            _device_barrier()
         self.stats.finish_s += time.monotonic() - t0
 
     def is_finished(self) -> bool:
@@ -94,11 +122,14 @@ class InstrumentedOperator:
         return getattr(self.inner, name)
 
 
-def instrument(operators, count_rows: bool = True):
-    """Wrap a pipeline's operators; returns (wrapped, [OperatorStats])."""
+def instrument(operators, count_rows: bool = True,
+               device_sync: bool = False):
+    """Wrap a pipeline's operators; returns (wrapped, [OperatorStats]).
+    `device_sync=True` closes every timed section with a device barrier
+    (EXPLAIN ANALYZE's per-operator device attribution)."""
     stats = [OperatorStats() for _ in operators]
     wrapped = [
-        InstrumentedOperator(op, st, count_rows)
+        InstrumentedOperator(op, st, count_rows, device_sync)
         for op, st in zip(operators, stats)
     ]
     return wrapped, stats
@@ -106,6 +137,13 @@ def instrument(operators, count_rows: bool = True):
 
 def render_stats(groups: List[List[OperatorStats]]) -> str:
     lines = []
+    synced = any(st.device_synced for g in groups for st in g)
+    if synced:
+        lines.append(
+            "Timings are DEVICE-INCLUSIVE (each operator section "
+            "closed by a device barrier; async pipelining disabled "
+            "for attribution)"
+        )
     for i, group in enumerate(groups):
         lines.append(f"Pipeline {i}:")
         for st in group:

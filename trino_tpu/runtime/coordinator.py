@@ -138,11 +138,13 @@ class QueryScheduler:
                 )
                 if locations and created:
                     # co-schedule a fragment's tasks on the FIRST
-                    # task's island: its exchanges then ride ICI, not
-                    # DCN (the TopologyAwareNodeSelector motivation)
-                    first_loc = locations.get(id(created[0][0]))
+                    # task's ISLAND (rack tier, not the host — stacking
+                    # a fragment on one host would serialize it): its
+                    # exchanges then ride ICI, not DCN
+                    first_loc = locations.get(id(created[0][0])) or ""
+                    island = first_loc.rsplit("/", 1)[0]
                     worker = selector.select(
-                        self.workers, location=first_loc
+                        self.workers, location=island
                     )
                 else:
                     worker = selector.select(self.workers)

@@ -66,19 +66,12 @@ class UniformNodeSelector:
         if not active:
             raise RuntimeError("no active workers")
         with self._lock:
-            pools = [p for p in (list(preferred), list(active)) if p]
-            for pool in pools:
-                loads = [(self._load(h), i, h) for i, h in enumerate(pool)]
-                loads.sort(key=lambda t: (t[0], t[1]))
-                for load, _, h in loads:
-                    if (
-                        self.max_tasks_per_node is None
-                        or load < self.max_tasks_per_node
-                    ):
-                        self._assigned[id(h)] = (
-                            self._assigned.get(id(h), 0) + 1
-                        )
-                        return h
+            for pool in (list(preferred), list(active)):
+                if not pool:
+                    continue
+                pick = self._pick_below_cap_locked(pool)
+                if pick is not None:
+                    return pick
             # every node at cap: least-loaded overall
             _, _, h = min(
                 ((self._load(h), i, h) for i, h in enumerate(active)),
