@@ -277,3 +277,24 @@ class TestHolisticLongDecimal:
         ).rows
         assert rows[0][2] == 20 and rows[1][2] == 50
         assert abs(rows[0][1] - (-1e35)) < 1e23
+
+
+class TestWindowValueFns:
+    """lead/lag/first/last/nth over Int128 limb-pair columns gather
+    row-wise (r5: take without axis flattened (n,2) arrays)."""
+
+    def test_lead_lag_first_last_over_long_decimal(self):
+        r = LocalQueryRunner(Session(catalog="memory", schema="t"))
+        r.register_catalog("memory", create_memory_connector())
+        r.execute("create table memory.t.wd (d decimal(38,2), g bigint)")
+        r.execute(
+            "insert into wd values (1.50, 1), "
+            "(99999999999999999999999999999999.00, 2), (3.25, 3)"
+        )
+        rows = r.execute(
+            "select lead(d) over (order by g), lag(d) over (order by g),"
+            " first_value(d) over (order by g) from wd"
+        ).rows
+        assert rows[0][0] == 1e32 and rows[0][1] is None
+        assert rows[1][1] == 1.5
+        assert all(row[2] == 1.5 for row in rows)

@@ -94,7 +94,9 @@ class InstrumentedOperator:
     def get_output(self):
         t0 = time.monotonic()
         out = self.inner.get_output()
-        if self._device_sync:
+        if self._device_sync and out is not None:
+            # a None poll dispatched nothing — a barrier there would
+            # charge one device round trip per idle poll
             _device_barrier()
         self.stats.get_output_s += time.monotonic() - t0
         self.stats.get_output_calls += 1

@@ -229,7 +229,8 @@ def shift_in_partition(
     seg = jnp.cumsum(part_start.astype(jnp.int32))
     ok = (idx - offset >= 0) & (idx - offset < n)
     ok = ok & (take_clip(seg, src) == seg)
-    out = take_clip(vals, src)
+    # axis=0: long-decimal (n, 2) limb pairs gather row-wise
+    out = take_clip(vals, src, axis=0)
     out_valid = ok if valid is None else (ok & take_clip(valid, src))
     return out, out_valid
 
@@ -240,7 +241,7 @@ def value_at(
     index: jnp.ndarray,
 ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
     """first_value/last_value: gather at a per-row frame boundary index."""
-    out = take_clip(vals, index)
+    out = take_clip(vals, index, axis=0)
     return out, None if valid is None else take_clip(valid, index)
 
 

@@ -136,15 +136,21 @@ class QueryScheduler:
                     collect_stats=self.collect_stats,
                     task_concurrency=self.session.task_concurrency,
                 )
-                if locations and created:
+                first_loc = (
+                    locations.get(id(created[0][0]))
+                    if locations and created else None
+                )
+                if first_loc is not None:
                     # co-schedule a fragment's tasks on the FIRST
                     # task's ISLAND (rack tier, not the host — stacking
                     # a fragment on one host would serialize it): its
-                    # exchanges then ride ICI, not DCN
-                    first_loc = locations.get(id(created[0][0])) or ""
-                    island = first_loc.rsplit("/", 1)[0]
+                    # exchanges then ride ICI, not DCN. A location-less
+                    # first task keeps uniform selection.
                     worker = selector.select(
-                        self.workers, location=island
+                        self.workers,
+                        location=TopologyAwareNodeSelector._rack(
+                            first_loc
+                        ),
                     )
                 else:
                     worker = selector.select(self.workers)
