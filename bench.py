@@ -29,6 +29,13 @@ Env knobs:
                       CPU baselines first (the driver's own timeout can
                       land anytime — the last emitted line always holds
                       the best complete result)
+  BENCH_WIDESTR_ROWS=N -> rows for the wide-string GROUP BY config
+
+Flags:
+  --watch [seconds]  dev-loop mode: re-run the device preflight every
+                     `seconds` (default 300) until a non-CPU backend
+                     initializes, then run the full bench once; device
+                     walls append to BENCH_DEV.json as usual
 """
 
 from __future__ import annotations
@@ -89,6 +96,47 @@ order by o_totalprice desc, o_orderdate
 limit 100
 """
 
+# BASELINE config 4: TPC-DS q72 (deep multi-build join tree;
+# partitioned lookup) — template matches tests/test_tpcds.py
+Q72 = """
+select i_item_desc, w_warehouse_name, d1.d_week_seq,
+  sum(case when p_promo_sk is null then 1 else 0 end) no_promo,
+  sum(case when p_promo_sk is not null then 1 else 0 end) promo,
+  count(*) total_cnt
+from catalog_sales
+join inventory on (cs_item_sk = inv_item_sk)
+join warehouse on (w_warehouse_sk = inv_warehouse_sk)
+join item on (i_item_sk = cs_item_sk)
+join customer_demographics on (cs_bill_cdemo_sk = cd_demo_sk)
+join household_demographics on (cs_bill_hdemo_sk = hd_demo_sk)
+join date_dim d1 on (cs_sold_date_sk = d1.d_date_sk)
+join date_dim d2 on (inv_date_sk = d2.d_date_sk)
+join date_dim d3 on (cs_ship_date_sk = d3.d_date_sk)
+left outer join promotion on (cs_promo_sk = p_promo_sk)
+left outer join catalog_returns on (cr_item_sk = cs_item_sk
+                                    and cr_order_number = cs_order_number)
+where d1.d_week_seq = d2.d_week_seq
+  and inv_quantity_on_hand < cs_quantity
+  and d3.d_date > d1.d_date + 5
+  and hd_buy_potential = '>10000'
+  and d1.d_year = 1999
+  and cd_marital_status = 'D'
+group by i_item_desc, w_warehouse_name, d1.d_week_seq
+order by total_cnt desc, i_item_desc, w_warehouse_name, d1.d_week_seq
+limit 100
+"""
+
+# BASELINE config 5: synthetic wide-string GROUP BY (variable-width ->
+# device dictionary encoding) over the memory connector
+WIDESTR = """
+select s, count(*) as cnt, sum(v) as total
+from widestr group by s order by cnt desc, s limit 10
+"""
+
+WIDESTR_ROWS = int(os.environ.get("BENCH_WIDESTR_ROWS", str(1 << 21)))
+WIDESTR_GROUPS = 512
+WIDESTR_WIDTH = 64
+
 # columns each config needs resident (pruned load keeps host+device RAM
 # proportional to what the queries touch)
 TABLE_COLUMNS = {
@@ -109,7 +157,7 @@ TABLE_COLUMNS = {
         "lineitem": ["l_orderkey", "l_quantity"],
     },
 }
-SQL = {"q1": Q1, "q3": Q3, "q18": Q18}
+SQL = {"q1": Q1, "q3": Q3, "q18": Q18, "q72": Q72, "widestr": WIDESTR}
 
 
 _TABLE_CACHE_DIR = os.path.expanduser(
@@ -210,7 +258,67 @@ def _configs():
         return [(name, float(sf))]
     if FAST:
         return [("q1", 1.0)]
-    return [("q1", 1.0), ("q3", 1.0), ("q3", SF_LARGE), ("q18", SF_LARGE)]
+    # q72/widestr (BASELINE configs 4-5) run LAST: the deadline logic
+    # sheds them first, protecting the headline configs
+    return [
+        ("q1", 1.0), ("q3", 1.0), ("q3", SF_LARGE), ("q18", SF_LARGE),
+        ("q72", SF_LARGE), ("widestr", 1.0),
+    ]
+
+
+def _make_tpcds_runner(sf: float):
+    """LocalQueryRunner over the tpcds connector (BASELINE config 4).
+    Generation is on-scan; the engine's plan cache snapshots splits, so
+    steady-state repeats re-read generated pages, not re-plan."""
+    from trino_tpu.connectors.tpcds import create_tpcds_connector
+    from trino_tpu.engine import LocalQueryRunner, Session
+
+    batch_rows = int(os.environ.get("BENCH_BATCH_ROWS", str(1 << 22)))
+    r = LocalQueryRunner(
+        Session(catalog="tpcds", schema=f"sf{sf:g}", batch_rows=batch_rows)
+    )
+    r.register_catalog("tpcds", create_tpcds_connector())
+    return r
+
+
+def _make_widestr_runner():
+    """Memory-connector table for BASELINE config 5: wide dictionary
+    strings (WIDESTR_WIDTH chars, WIDESTR_GROUPS distinct) + a value
+    column, exercising variable-width -> device dictionary encoding in
+    a skewed GROUP BY."""
+    import hashlib
+
+    import numpy as np
+
+    from trino_tpu import types as T
+    from trino_tpu.block import Dictionary
+    from trino_tpu.connectors.memory import create_memory_connector
+    from trino_tpu.connectors.spi import ColumnMetadata
+    from trino_tpu.engine import LocalQueryRunner, Session
+
+    vals = [
+        hashlib.sha256(f"widestr-{i}".encode()).hexdigest()[:WIDESTR_WIDTH]
+        .ljust(WIDESTR_WIDTH, "x")
+        for i in range(WIDESTR_GROUPS)
+    ]
+    rng = np.random.default_rng(7)
+    # zipf-ish skew: a few huge groups plus a long tail
+    codes = (
+        rng.zipf(1.3, WIDESTR_ROWS).astype(np.int64) % WIDESTR_GROUPS
+    )
+    v = rng.integers(0, 1_000_000, WIDESTR_ROWS, dtype=np.int64)
+    mem = create_memory_connector()
+    mem.load_table(
+        "bench", "widestr",
+        [ColumnMetadata("s", T.VARCHAR), ColumnMetadata("v", T.BIGINT)],
+        [codes, v], None, [Dictionary(vals), None],
+    )
+    batch_rows = int(os.environ.get("BENCH_BATCH_ROWS", str(1 << 22)))
+    r = LocalQueryRunner(
+        Session(catalog="memory", schema="bench", batch_rows=batch_rows)
+    )
+    r.register_catalog("memory", mem)
+    return r
 
 
 def run_benches() -> dict:
@@ -220,6 +328,8 @@ def run_benches() -> dict:
     out = {}
     by_sf = {}
     for name, sf in _configs():
+        if name not in TABLE_COLUMNS:
+            continue  # q72/widestr build their own runners below
         by_sf.setdefault(sf, {})
         for table, cols in TABLE_COLUMNS[name].items():
             cur = by_sf[sf].setdefault(table, [])
@@ -236,8 +346,14 @@ def run_benches() -> dict:
         runs = RUNS if sf <= 1 else min(RUNS, max(2, RUNS - 1))
         print(f"bench: running {name} sf={sf:g}...", file=sys.stderr, flush=True)
         t0 = time.time()
+        if name == "q72":
+            runner = _make_tpcds_runner(sf)
+        elif name == "widestr":
+            runner = _make_widestr_runner()
+        else:
+            runner = runners[sf]
         out[f"{name}_sf{sf:g}"] = round(
-            _median_wall(runners[sf], SQL[name], runs), 4
+            _median_wall(runner, SQL[name], runs), 4
         )
         print(
             f"bench: {name} sf={sf:g} wall={out[f'{name}_sf{sf:g}']}s "
@@ -656,6 +772,29 @@ def main() -> None:
         os.environ.get("BENCH_PREFLIGHT_TIMEOUTS", "45,75").split(",")
     ]
     pf_platform, pf_tail = _preflight_device(pf_timeouts)
+    # --watch [seconds]: dev-loop mode — keep re-running the preflight
+    # on an interval until a real device comes up, then fall through to
+    # one full bench (whose walls land in BENCH_DEV.json via
+    # record_bench_dev as usual)
+    if "--watch" in sys.argv:
+        i = sys.argv.index("--watch")
+        try:
+            watch_s = float(sys.argv[i + 1])
+        except (IndexError, ValueError):
+            watch_s = 300.0
+        while pf_platform in (None, "cpu"):
+            why = "backend init failed" if pf_platform is None else "cpu only"
+            print(
+                f"bench: watch — no device ({why}); retry in {watch_s:g}s",
+                file=sys.stderr, flush=True,
+            )
+            time.sleep(watch_s)
+            pf_platform, pf_tail = _preflight_device(pf_timeouts)
+        print(
+            f"bench: watch — device up ({pf_platform}); running full bench",
+            file=sys.stderr, flush=True,
+        )
+        t_start = time.time()  # the wait does not count against the deadline
     if pf_platform is None:
         dev_walls = latest_dev_walls()
         print(

@@ -24,7 +24,12 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # Older JAX: no jax_num_cpu_devices option. The XLA_FLAGS
+    # host-platform device-count fallback set above covers it.
+    pass
 
 # Persistent XLA compilation cache: the suite compiles 1000+ programs
 # and the per-module clear_caches() below (segfault workaround) forces

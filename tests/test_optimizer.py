@@ -112,8 +112,19 @@ def test_push_filter_into_join():
     assert isinstance(out, P.JoinNode)
     assert isinstance(out.left, P.FilterNode)
     assert isinstance(out.right, P.FilterNode)
-    # right-side predicate re-based to the right child's channels
-    assert out.right.predicate.args[0].index == 0
+    # equality inference mirrors each single-channel conjunct across
+    # the a = b join key, so BOTH sides carry both bounds, re-based to
+    # each child's channels
+    def _conjs(pred):
+        return sorted(
+            (c.name, c.args[0].index, c.args[1].value)
+            for c in (
+                pred.args if pred.name == "and" else (pred,)
+            )
+        )
+
+    assert _conjs(out.left.predicate) == [("gt", 0, 0), ("lt", 0, 3)]
+    assert _conjs(out.right.predicate) == [("gt", 0, 0), ("lt", 0, 3)]
 
 
 class _FakeCatalogs:

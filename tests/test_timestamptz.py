@@ -291,3 +291,62 @@ class TestCoercionAndFunctions:
             "timestamp '2024-12-31 23:00:00 -05:00' as ts)"
         ).rows[0]
         assert rows == [2024, 12, 23]
+
+
+class TestMixedZoneKeys:
+    """Equal instants in DIFFERENT zones must group/join/distinct as one
+    key (canonicalize_tstz_keys, sql/optimizer.py): 01:59 America/New_York
+    == 06:59 UTC on 2024-03-10."""
+
+    @pytest.fixture(scope="class")
+    def rz(self):
+        r = LocalQueryRunner(Session(catalog="memory", schema="z"))
+        r.register_catalog("memory", create_memory_connector())
+        r.execute("create table mz (ts timestamp with time zone, v bigint)")
+        r.execute(
+            "insert into mz values"
+            " (TIMESTAMP '2024-03-10 01:59:00 America/New_York', 1),"
+            " (TIMESTAMP '2024-03-10 06:59:00 UTC', 2),"
+            " (TIMESTAMP '2024-03-10 07:59:00 UTC', 5)"
+        )
+        return r
+
+    def test_group_by_merges_equal_instants(self, rz):
+        rows = rz.execute(
+            "select ts, sum(v) from mz group by ts order by 2"
+        ).rows
+        assert len(rows) == 2
+        assert sorted(x[1] for x in rows) == [3, 5]
+        # representative keeps an ORIGINAL zone from the group
+        assert rows[0][0] in (
+            "2024-03-10 01:59:00.000 America/New_York",
+            "2024-03-10 06:59:00.000 UTC",
+        )
+
+    def test_count_distinct_and_select_distinct(self, rz):
+        assert rz.execute("select count(distinct ts) from mz").rows[0][0] == 2
+        assert len(rz.execute("select distinct ts from mz").rows) == 2
+
+    def test_join_matches_across_zones(self, rz):
+        rz.execute("create table mu (ts timestamp with time zone, w bigint)")
+        rz.execute(
+            "insert into mu values (TIMESTAMP '2024-03-10 06:59:00 UTC', 77)"
+        )
+        rows = rz.execute(
+            "select mz.v, mu.w from mz join mu on mz.ts = mu.ts order by 1"
+        ).rows
+        assert rows == [[1, 77], [2, 77]]
+        semi = rz.execute(
+            "select v from mz where ts in (select ts from mu) order by 1"
+        ).rows
+        assert semi == [[1], [2]]
+
+    def test_optimizer_off_same_answers(self, rz):
+        rz.execute("SET SESSION enable_optimizer = false")
+        try:
+            rows = rz.execute(
+                "select ts, sum(v) from mz group by ts order by 2"
+            ).rows
+        finally:
+            rz.execute("SET SESSION enable_optimizer = true")
+        assert len(rows) == 2 and sorted(x[1] for x in rows) == [3, 5]

@@ -85,6 +85,9 @@ for _name, _type, _default, _desc, _allowed in [
      "run colocated fragments over the device-mesh collective exchange", None),
     ("enable_optimizer", bool, True,
      "run the iterative plan-optimizer pipeline", None),
+    ("enable_pushdown", bool, True,
+     "push supported filter conjuncts and projections into connector "
+     "scans (apply_filter/apply_projection SPI)", None),
     ("join_reordering_strategy", str, "automatic",
      "cost-based join reordering: automatic | none",
      ("automatic", "none")),

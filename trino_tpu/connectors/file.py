@@ -34,6 +34,12 @@ import numpy as np
 
 from trino_tpu import types as T
 from trino_tpu.block import Column, Dictionary, RelBatch, bucket_capacity
+from trino_tpu.connectors.pushdown import (
+    constraint_mask,
+    merge_handle_constraints,
+    range_predicate,
+    split_supported,
+)
 from trino_tpu.connectors.spi import (
     ColumnMetadata,
     Connector,
@@ -234,11 +240,28 @@ class _ParsedTable:
     stamp: tuple  # (paths, mtimes) fingerprint
 
 
+@dataclasses.dataclass
+class _ParquetMeta:
+    """Footer-derived table facts for all-parquet tables: schema, row
+    count, and chunk-statistics aggregates — enough for metadata,
+    statistics, and apply_filter without reading any data pages."""
+
+    columns: List[ColumnMetadata]
+    row_count: int
+    stats: Dict[str, Optional[tuple]]  # name -> (min, max, null_count)
+    stamp: tuple
+
+
 class _FileStore:
+    _MAX_FILTERED = 8  # bounded per-constraint-set parse cache
+
     def __init__(self, root: str):
         self.root = root
         self.lock = threading.Lock()
         self._cache: Dict[Tuple[str, str], _ParsedTable] = {}
+        # (schema, table, constraints) -> filtered _ParsedTable
+        self._filtered_cache: Dict[tuple, _ParsedTable] = {}
+        self._meta_cache: Dict[Tuple[str, str], _ParquetMeta] = {}
 
     # -- layout --
     def table_paths(self, schema: str, table: str) -> List[str]:
@@ -303,6 +326,101 @@ class _FileStore:
         with self.lock:
             self._cache[key] = parsed
         return parsed
+
+    def parquet_meta(self, schema: str, table: str) -> Optional[_ParquetMeta]:
+        """Footer-only schema + statistics for all-parquet tables (None
+        for text tables or LIST schemas). Reads no data pages, so
+        metadata and statistics queries never force a full parse — the
+        scan pays for exactly the row groups it keeps."""
+        paths = self.table_paths(schema, table)
+        if not paths or not all(p.endswith(".parquet") for p in paths):
+            return None
+        stamp = self._stamp(paths)
+        key = (schema, table)
+        with self.lock:
+            hit = self._meta_cache.get(key)
+            if hit is not None and hit.stamp == stamp:
+                return hit
+        from trino_tpu.connectors import parquet_format as PQ
+
+        per = [PQ.read_parquet_meta(p) for p in paths]
+        first_cols = per[0][0]
+        if any(c.list_lengths is not None for c in first_cols):
+            return None  # LIST columns: the parse path fails loudly
+        columns = [
+            ColumnMetadata(c.name, _parquet_type(c)) for c in first_cols
+        ]
+        row_count = sum(n for _, n, _ in per)
+        stats: Dict[str, Optional[tuple]] = {}
+        for cm in columns:
+            parts = [s.get(cm.name) for _, _, s in per]
+            if any(p is None for p in parts):
+                stats[cm.name] = None
+                continue
+            nulls = (
+                None
+                if any(p[2] is None for p in parts)
+                else sum(p[2] for p in parts)
+            )
+            stats[cm.name] = (
+                min(p[0] for p in parts), max(p[1] for p in parts), nulls
+            )
+        out = _ParquetMeta(columns, row_count, stats, stamp)
+        with self.lock:
+            self._meta_cache[key] = out
+        return out
+
+    def parsed_filtered(
+        self, schema: str, table: str, constraints: tuple
+    ) -> _ParsedTable:
+        """Parsed table with ``constraints`` fully enforced (rows
+        compacted). Parquet tables prune whole row groups by min/max
+        stats first (read_parquet predicate), then apply the exact
+        mask; text tables mask the cached full parse. Cached per
+        constraint set with the same mtime stamp as the base cache."""
+        if not constraints:
+            return self.parsed(schema, table)
+        paths = self.table_paths(schema, table)
+        if not paths:
+            raise KeyError(f"no files for table {schema}.{table}")
+        stamp = self._stamp(paths)
+        key = (schema, table, tuple(constraints))
+        with self.lock:
+            hit = self._filtered_cache.get(key)
+            if hit is not None and hit.stamp == stamp:
+                return hit
+            base = self._cache.get((schema, table))
+        if base is not None and base.stamp == stamp:
+            pass  # already in memory — masking beats re-reading
+        elif all(p.endswith(".parquet") for p in paths):
+            base = self._parse_parquet(
+                paths, stamp, predicate=range_predicate(constraints)
+            )
+        else:
+            base = self.parsed(schema, table)
+        mask = constraint_mask(
+            constraints,
+            lambda name: (base.data[name], base.valid[name]),
+        )
+        keep = (
+            np.nonzero(mask)[0]
+            if mask is not None
+            else np.arange(base.row_count)
+        )
+        data = {n: a[keep] for n, a in base.data.items()}
+        valid = {
+            n: (v[keep] if v is not None else None)
+            for n, v in base.valid.items()
+        }
+        out = _ParsedTable(
+            base.columns, data, valid, base.dictionaries,
+            int(len(keep)), stamp,
+        )
+        with self.lock:
+            if len(self._filtered_cache) >= self._MAX_FILTERED:
+                self._filtered_cache.pop(next(iter(self._filtered_cache)))
+            self._filtered_cache[key] = out
+        return out
 
     # -- parsing --
     def _rows_of(self, path: str) -> Tuple[List[str], List[List[str]]]:
@@ -392,12 +510,17 @@ class _FileStore:
             valid[cm.name] = ~nulls if nulls.any() else None
         return _ParsedTable(columns, data, valid, dicts, n, stamp)
 
-    def _parse_parquet(self, paths: List[str], stamp: tuple) -> _ParsedTable:
+    def _parse_parquet(
+        self, paths: List[str], stamp: tuple, predicate=None
+    ) -> _ParsedTable:
         """Typed parquet parts -> the parsed-table form (the
-        lib/trino-parquet read path reduced to the engine's types)."""
+        lib/trino-parquet read path reduced to the engine's types).
+        ``predicate`` ({col: (lo, hi)}) skips row groups whose min/max
+        stats fall outside the range — the caller must still enforce
+        the exact constraints on what survives."""
         from trino_tpu.connectors import parquet_format as PQ
 
-        per_file = [PQ.read_parquet(p) for p in paths]
+        per_file = [PQ.read_parquet(p, predicate=predicate) for p in paths]
         first_cols, _ = per_file[0]
         columns: List[ColumnMetadata] = []
         for c in first_cols:
@@ -503,16 +626,55 @@ class FileMetadata(ConnectorMetadata):
         return TableHandle("file", schema, table)
 
     def get_table_metadata(self, handle: TableHandle) -> TableMetadata:
+        pm = self.store.parquet_meta(handle.schema, handle.table)
+        if pm is not None:
+            return TableMetadata(
+                handle.schema, handle.table, tuple(pm.columns)
+            )
         parsed = self.store.parsed(handle.schema, handle.table)
         return TableMetadata(
             handle.schema, handle.table, tuple(parsed.columns)
         )
 
     def column_dictionary(self, handle: TableHandle, column: str):
-        parsed = self.store.parsed(handle.schema, handle.table)
+        pm = self.store.parquet_meta(handle.schema, handle.table)
+        if pm is not None:
+            t = next(
+                (c.type for c in pm.columns if c.name == column), None
+            )
+            if t is not None and not t.is_string:
+                return None  # footer answers without touching pages
+        # a constrained handle must hand out the FILTERED table's
+        # dictionary — its batches carry that table's codes
+        cs = getattr(handle, "constraints", ())
+        parsed = (
+            self.store.parsed_filtered(handle.schema, handle.table, cs)
+            if cs
+            else self.store.parsed(handle.schema, handle.table)
+        )
         return parsed.dictionaries.get(column)
 
     def get_table_statistics(self, handle: TableHandle) -> TableStatistics:
+        pm = self.store.parquet_meta(handle.schema, handle.table)
+        if pm is not None:
+            # footer chunk statistics: exact min/max/null-fraction, ndv
+            # unknowable without reading pages — live row count is the
+            # standard upper-bound estimate
+            cols = {}
+            for cm in pm.columns:
+                st = pm.stats.get(cm.name)
+                if cm.type.is_string or st is None or pm.row_count == 0:
+                    continue
+                mn, mx, nulls = st
+                nf = (
+                    float(nulls) / pm.row_count if nulls is not None else 0.0
+                )
+                cols[cm.name] = (
+                    pm.row_count * (1.0 - nf), nf, float(mn), float(mx)
+                )
+            return TableStatistics(
+                row_count=float(pm.row_count), columns=cols
+            )
         parsed = self.store.parsed(handle.schema, handle.table)
         cols = {}
         for cm in parsed.columns:
@@ -534,6 +696,24 @@ class FileMetadata(ConnectorMetadata):
         return TableStatistics(
             row_count=float(parsed.row_count), columns=cols
         )
+
+    def apply_filter(self, handle, constraints):
+        pm = self.store.parquet_meta(handle.schema, handle.table)
+        cols = (
+            pm.columns
+            if pm is not None
+            else self.store.parsed(handle.schema, handle.table).columns
+        )
+        types = {c.name: c.type for c in cols}
+        accepted, residual = split_supported(constraints, types.get)
+        if not accepted:
+            return None
+        return merge_handle_constraints(handle, accepted), tuple(residual)
+
+    def apply_projection(self, handle, columns):
+        # batches() already materializes only the requested columns;
+        # accepting keeps the ProjectNode narrowing in the plan
+        return handle
 
     def create_table(
         self, schema: str, table: str, columns: Sequence[ColumnMetadata]
@@ -585,6 +765,12 @@ class FileMetadata(ConnectorMetadata):
             os.unlink(p)
         with self.store.lock:
             self.store._cache.pop((handle.schema, handle.table), None)
+            self.store._meta_cache.pop((handle.schema, handle.table), None)
+            for k in [
+                k for k in self.store._filtered_cache
+                if k[:2] == (handle.schema, handle.table)
+            ]:
+                self.store._filtered_cache.pop(k, None)
 
 
 class FileSplitManager(ConnectorSplitManager):
@@ -596,7 +782,12 @@ class FileSplitManager(ConnectorSplitManager):
         self.store = store
 
     def get_splits(self, handle: TableHandle, target_split_count: int) -> List[Split]:
-        parsed = self.store.parsed(handle.schema, handle.table)
+        cs = getattr(handle, "constraints", ())
+        parsed = (
+            self.store.parsed_filtered(handle.schema, handle.table, cs)
+            if cs
+            else self.store.parsed(handle.schema, handle.table)
+        )
         n = parsed.row_count
         k = max(1, min(target_split_count, max(n, 1)))
         per = -(-max(n, 1) // k)
@@ -613,7 +804,14 @@ class FilePageSource(ConnectorPageSource):
     def batches(
         self, split: Split, columns: Sequence[str], batch_rows: int
     ) -> Iterator[RelBatch]:
-        t = self.store.parsed(split.table.schema, split.table.table)
+        cs = getattr(split.table, "constraints", ())
+        t = (
+            self.store.parsed_filtered(
+                split.table.schema, split.table.table, cs
+            )
+            if cs
+            else self.store.parsed(split.table.schema, split.table.table)
+        )
         lo, hi = split.row_range
         types = {c.name: c.type for c in t.columns}
         for a in range(lo, hi, batch_rows):

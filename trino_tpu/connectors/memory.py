@@ -147,6 +147,26 @@ class MemoryMetadata(ConnectorMetadata):
         self._stats_cache[key] = (t, t.version, ts)
         return ts
 
+    def apply_filter(self, handle: TableHandle, constraints):
+        """Accept constraints on flat numeric/temporal columns; the page
+        source masks the stored arrays before materializing device
+        batches (exact enforcement, composed with bucket splits)."""
+        from trino_tpu.connectors.pushdown import (
+            merge_handle_constraints,
+            split_supported,
+        )
+
+        t = self.store.tables[(handle.schema, handle.table)]
+        types = {c.name: c.type for c in t.columns}
+        accepted, residual = split_supported(constraints, types.get)
+        if not accepted:
+            return None
+        return merge_handle_constraints(handle, accepted), tuple(residual)
+
+    def apply_projection(self, handle: TableHandle, columns) -> TableHandle:
+        # _materialize already builds only the requested columns
+        return handle
+
     def create_table(self, schema: str, table: str, columns: Sequence[ColumnMetadata]) -> TableHandle:
         with self.store.lock:
             if (schema, table) in self.store.tables:
@@ -222,19 +242,40 @@ class MemoryPageSource(ConnectorPageSource):
 
     def batches(self, split: Split, columns: Sequence[str], batch_rows: int) -> Iterator[RelBatch]:
         t = self.store.tables[(split.table.schema, split.table.table)]
+        cs = getattr(split.table, "constraints", ())
         if split.payload is not None and split.payload[0] == "bucket":
             _, bi, nb = split.payload
             idx = np.nonzero(self._bucket_ids(t, nb) == bi)[0]
             lo = hi = None
-            cache_key = (t.version, tuple(columns), batch_rows, "bucket", bi, nb)
+            cache_key = (t.version, tuple(columns), batch_rows, "bucket", bi, nb, cs)
         else:
             lo, hi = split.row_range
             idx = None
-            cache_key = (t.version, tuple(columns), batch_rows, lo, hi)
+            cache_key = (t.version, tuple(columns), batch_rows, lo, hi, cs)
         cached = t.device_cache.get(cache_key)
         if cached is not None:
             yield from cached
             return
+        if cs and t.row_count:
+            # pushed-down predicate: mask the stored arrays, then route
+            # the surviving row indices through the gather path (the
+            # same one bucket splits use)
+            from trino_tpu.connectors.pushdown import constraint_mask
+
+            n = t.row_count
+            mask = constraint_mask(
+                cs,
+                lambda name: (
+                    np.asarray(t.data[name].data[:n]),
+                    None if t.data[name].valid is None
+                    else t.data[name].valid[:n],
+                ),
+            )
+            if idx is None:
+                idx = np.nonzero(mask[lo:hi])[0] + lo
+                lo = hi = None
+            else:
+                idx = idx[mask[idx]]
         out = []
         for batch in self._materialize(t, columns, batch_rows, lo, hi, idx):
             out.append(batch)

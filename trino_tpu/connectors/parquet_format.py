@@ -30,6 +30,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from trino_tpu.runtime.metrics import METRICS
+
 MAGIC = b"PAR1"
 
 # thrift compact type ids
@@ -922,23 +924,20 @@ def _assemble_list_column(col: ParquetColumn, li: dict, parts) -> None:
         col.values = np.asarray(flats, dtype=dtype)
 
 
-def read_parquet(path: str, predicate: Optional[Dict[str, tuple]] = None
-                 ) -> Tuple[List[ParquetColumn], int]:
-    """`predicate`: {column: (lo, hi)} closed ranges (None = unbounded
-    side); row groups whose min/max statistics prove emptiness are
-    skipped entirely (lib/trino-parquet predicate pushdown analogue)."""
+def _read_footer(path: str) -> Tuple[bytes, Any]:
     with open(path, "rb") as f:
         data = f.read()
     if data[:4] != MAGIC or data[-4:] != MAGIC:
         raise ValueError("not a parquet file")
     (meta_len,) = struct.unpack_from("<I", data, len(data) - 8)
     meta = _Reader(data, len(data) - 8 - meta_len).read_struct()
-    schema = meta[2]
-    num_rows = meta[3]
-    row_groups = meta[4]
-    # schema tree walk: flat leaves plus 3-level LIST groups (the
-    # shape every modern writer emits for arrays —
-    # LogicalTypes.md#lists). Leaf order matches row-group chunk order.
+    return data, meta
+
+
+def _schema_columns(schema) -> Tuple[List[dict], List[ParquetColumn]]:
+    """Schema tree walk: flat leaves plus 3-level LIST groups (the
+    shape every modern writer emits for arrays — LogicalTypes.md#lists).
+    Leaf order matches row-group chunk order."""
     descs: List[dict] = []
     idx = [1]
 
@@ -1001,6 +1000,57 @@ def read_parquet(path: str, predicate: Optional[Dict[str, tuple]] = None
                 valid=np.zeros(0, bool) if li["outer_opt"] else None,
                 list_lengths=np.zeros(0, np.int32),
             ))
+    return descs, cols
+
+
+def read_parquet_meta(path: str) -> Tuple[
+    List[ParquetColumn], int, Dict[str, Optional[tuple]]
+]:
+    """Footer-only read: (columns with empty values, num_rows,
+    {column: (min, max, null_count) | None}) with min/max/null_count
+    aggregated over row-group chunk statistics (None for a column when
+    any chunk lacks them). No data pages are touched, so this never
+    counts toward bytes_scanned — the seat metadata/statistics queries
+    use instead of parsing the whole file."""
+    _, meta = _read_footer(path)
+    _, cols = _schema_columns(meta[2])
+    stats: Dict[str, Optional[tuple]] = {c.name: None for c in cols}
+    complete = {c.name: True for c in cols}
+    acc: Dict[str, list] = {}
+    for rg in meta[4]:
+        for ci, cc in enumerate(rg[1]):
+            name = cols[ci].name
+            st = cc[3].get(12)
+            if not st or 5 not in st or 6 not in st:
+                complete[name] = False
+                continue
+            mn = _decode_stat(cols[ci].physical, st[6])
+            mx = _decode_stat(cols[ci].physical, st[5])
+            nulls = st.get(3)
+            if name not in acc:
+                acc[name] = [mn, mx, nulls]
+                continue
+            a = acc[name]
+            a[0] = min(a[0], mn)
+            a[1] = max(a[1], mx)
+            a[2] = (
+                None if a[2] is None or nulls is None else a[2] + nulls
+            )
+    for name, a in acc.items():
+        if complete[name]:
+            stats[name] = tuple(a)
+    return cols, meta[3], stats
+
+
+def read_parquet(path: str, predicate: Optional[Dict[str, tuple]] = None
+                 ) -> Tuple[List[ParquetColumn], int]:
+    """`predicate`: {column: (lo, hi)} closed ranges (None = unbounded
+    side); row groups whose min/max statistics prove emptiness are
+    skipped entirely (lib/trino-parquet predicate pushdown analogue)."""
+    data, meta = _read_footer(path)
+    num_rows = meta[3]
+    row_groups = meta[4]
+    descs, cols = _schema_columns(meta[2])
     chunks: List[List[Tuple[np.ndarray, Any]]] = [[] for _ in cols]
     rows_read = 0
     for rg in row_groups:
@@ -1037,6 +1087,7 @@ def read_parquet(path: str, predicate: Optional[Dict[str, tuple]] = None
                 raw_len = ph[2]
                 page_len = ph[3]
                 page_start = r.pos
+                METRICS.increment("bytes_scanned", page_len)
                 page = _decompress(
                     codec, data[page_start:page_start + page_len], raw_len
                 )

@@ -1,0 +1,157 @@
+"""Shared predicate-pushdown machinery for connectors.
+
+The engine side of the apply_filter/apply_projection SPI contract
+(spi.ConnectorMetadata): classification of filter conjuncts into
+per-column ``ColumnConstraint``s (the TupleDomain extraction seat,
+main/sql/planner/iterative/rule/PushPredicateIntoTableScan.java:141),
+plus the numpy evaluation helpers every host-side connector uses to
+ENFORCE accepted constraints exactly (the SPI contract requires full
+enforcement — row-group pruning alone is not enough).
+
+Constraint value space is the column's PHYSICAL representation (epoch
+days for DATE, scaled int64 for short DECIMAL), which is exactly the
+space the analyzer's comparison literals live in — classification
+requires the literal's IR type to EQUAL the column type, so no scale
+or unit conversion can hide here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.connectors.spi import ColumnConstraint, TableHandle
+from trino_tpu.expr import ir
+
+# op -> its mirror when the comparison is written literal-first
+_FLIP = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le", "eq": "eq", "ne": "ne"}
+
+_NP_OPS: Dict[str, Callable] = {
+    "lt": np.less,
+    "le": np.less_equal,
+    "gt": np.greater,
+    "ge": np.greater_equal,
+    "eq": np.equal,
+    "ne": np.not_equal,
+}
+
+
+def _pushable_type(t: T.DataType) -> bool:
+    """Single-lane numeric/temporal columns only: strings compare via
+    dictionaries, long decimals span two lanes, tstz packs a zone the
+    raw int64 compare would include."""
+    return not (
+        t.is_string
+        or t.is_nested
+        or t.lanes != 1
+        or t.kind == T.TypeKind.TIMESTAMP_TZ
+    )
+
+
+def classify_conjunct(e, columns, fields) -> Optional[ColumnConstraint]:
+    """``col op literal`` (either operand order) over a pushable column
+    -> ColumnConstraint, else None. InputRefs index the SCAN's output
+    channels, so ``columns[ref.index]`` is the connector column name."""
+    if not isinstance(e, ir.Call) or len(e.args) != 2:
+        return None
+    op = _FLIP.get(e.name)
+    if op is None:
+        return None
+    a, b = e.args
+    if isinstance(a, ir.Literal) and isinstance(b, ir.InputRef):
+        a, b, op = b, a, op
+    else:
+        op = e.name
+    if not (isinstance(a, ir.InputRef) and isinstance(b, ir.Literal)):
+        return None
+    if b.value is None:  # NULL comparisons never match; leave to filter
+        return None
+    t = fields[a.index].type
+    if not _pushable_type(t):
+        return None
+    # the constraint value must live in the column's RAW value space
+    # (decimal columns store scale-multiplied int64): rescale exact
+    # literals, refuse anything that would round
+    if t.is_decimal:
+        s = t.scale or 0
+        if b.type.is_decimal and (b.type.scale or 0) <= s:
+            return ColumnConstraint(
+                columns[a.index], op, int(round(b.value * (10 ** s)))
+            )
+        if b.type.is_integerlike and not isinstance(b.value, bool):
+            return ColumnConstraint(
+                columns[a.index], op, int(b.value) * (10 ** s)
+            )
+        return None
+    if not isinstance(b.value, (bool, int, float)):
+        return None
+    return ColumnConstraint(columns[a.index], op, b.value)
+
+
+def split_supported(
+    constraints: Sequence[ColumnConstraint],
+    type_of: Callable[[str], Optional[T.DataType]],
+) -> Tuple[List[ColumnConstraint], List[ColumnConstraint]]:
+    """(accepted, residual) under the shared host-side enforcement: a
+    constraint is accepted iff its column exists and is pushable."""
+    accepted: List[ColumnConstraint] = []
+    residual: List[ColumnConstraint] = []
+    for c in constraints:
+        t = type_of(c.column)
+        if t is not None and _pushable_type(t) and c.op in _NP_OPS:
+            accepted.append(c)
+        else:
+            residual.append(c)
+    return accepted, residual
+
+
+def merge_handle_constraints(
+    handle: TableHandle, accepted: Sequence[ColumnConstraint]
+) -> TableHandle:
+    """New handle with `accepted` folded into handle.constraints
+    (deduplicated, original order preserved — the handle participates
+    in plan-cache keys, so the representation must be canonical)."""
+    merged = list(handle.constraints)
+    for c in accepted:
+        if c not in merged:
+            merged.append(c)
+    return dataclasses.replace(handle, constraints=tuple(merged))
+
+
+def constraint_mask(
+    constraints: Sequence[ColumnConstraint],
+    column_data: Callable[[str], Tuple[np.ndarray, Optional[np.ndarray]]],
+) -> Optional[np.ndarray]:
+    """AND of all constraints over host arrays -> bool mask (None when
+    no constraints). ``column_data(name)`` returns (data, valid-or-None);
+    NULL rows never satisfy a comparison (SQL three-valued logic)."""
+    mask: Optional[np.ndarray] = None
+    for c in constraints:
+        data, valid = column_data(c.column)
+        m = _NP_OPS[c.op](np.asarray(data), c.value)
+        if valid is not None:
+            m = m & np.asarray(valid, dtype=bool)
+        mask = m if mask is None else (mask & m)
+    return mask
+
+
+def range_predicate(
+    constraints: Sequence[ColumnConstraint],
+) -> Dict[str, Tuple[Optional[Any], Optional[Any]]]:
+    """Constraints -> closed per-column [lo, hi] ranges for min/max
+    pruning (parquet row-group stats). Conservative: gt/lt keep the
+    bound closed (a group equal to the bound still reads and the exact
+    mask drops it); ne prunes nothing."""
+    out: Dict[str, Tuple[Optional[Any], Optional[Any]]] = {}
+    for c in constraints:
+        lo, hi = out.get(c.column, (None, None))
+        if c.op in ("gt", "ge", "eq"):
+            lo = c.value if lo is None else max(lo, c.value)
+        if c.op in ("lt", "le", "eq"):
+            hi = c.value if hi is None else min(hi, c.value)
+        if c.op in ("gt", "ge", "eq", "lt", "le"):
+            out[c.column] = (lo, hi)
+    return out

@@ -44,6 +44,19 @@ class TableMetadata:
 
 
 @dataclasses.dataclass(frozen=True)
+class ColumnConstraint:
+    """One pushed-down per-column predicate — the scalar reduction of
+    spi/predicate/TupleDomain: `column op value` with op in
+    {lt, le, gt, ge, eq, ne}. `value` is a python scalar in the
+    column's PHYSICAL value space (epoch days for DATE, scaled ints for
+    DECIMAL), matching what the connector's page source materializes."""
+
+    column: str
+    op: str
+    value: Any
+
+
+@dataclasses.dataclass(frozen=True)
 class TableHandle:
     """Engine-side opaque reference to a connector table."""
 
@@ -52,6 +65,9 @@ class TableHandle:
     table: str
     # connector-private payload (e.g. tpch scale factor)
     payload: Any = None
+    # constraints the connector has ACCEPTED via apply_filter — every
+    # row its page source emits for this handle satisfies all of them
+    constraints: Tuple[ColumnConstraint, ...] = ()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -99,6 +115,36 @@ class ConnectorMetadata:
 
     def get_table_statistics(self, handle: TableHandle) -> TableStatistics:
         return TableStatistics()
+
+    def apply_filter(
+        self, handle: TableHandle, constraints: Sequence[ColumnConstraint]
+    ) -> Optional[Tuple[TableHandle, Tuple[ColumnConstraint, ...]]]:
+        """PushPredicateIntoTableScan seat (the reference's
+        ConnectorMetadata.applyFilter, ConnectorMetadata.java:1290):
+        offered the scan-pushable conjuncts of a filter above this
+        table's scan. Return None when nothing can be pushed, or
+        ``(new_handle, residual)`` where ``new_handle`` carries the
+        accepted constraints (by convention in
+        ``TableHandle.constraints``) and ``residual`` lists the OFFERED
+        constraints this connector will not fully enforce — the engine
+        keeps their conjuncts in a FilterNode above the scan.
+
+        Enforcement contract: the page source must emit NO row that
+        violates an accepted constraint (full enforcement; connectors
+        that can only prune coarsely, e.g. by row group, must re-filter
+        exactly or leave the constraint in ``residual``)."""
+        return None
+
+    def apply_projection(
+        self, handle: TableHandle, columns: Sequence[str]
+    ) -> Optional[TableHandle]:
+        """PushProjectionIntoTableScan seat: asked to narrow the scan to
+        `columns` (a subset of the table's columns, in scan order).
+        Return a handle whose page source materializes ONLY those
+        columns (sources that already honor the per-call ``columns``
+        projection may return ``handle`` unchanged), or None when
+        unsupported — the engine then keeps the wide scan."""
+        return None
 
     def table_partitioning(self, handle: TableHandle) -> Optional[Tuple[str, ...]]:
         """Declared bucketing of a table: the ordered key columns whose

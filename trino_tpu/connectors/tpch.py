@@ -738,6 +738,27 @@ class TpchMetadata(ConnectorMetadata):
             row_count=rows, columns=_column_statistics(handle.table, sf, rows)
         )
 
+    def apply_filter(self, handle: TableHandle, constraints):
+        """Accept every numeric/temporal constraint: the page source
+        generates the constrained columns alongside the requested ones
+        and compacts each chunk exactly (full enforcement), so the
+        engine never sees a violating row."""
+        from trino_tpu.connectors.pushdown import (
+            merge_handle_constraints,
+            split_supported,
+        )
+
+        types = dict(TABLES[handle.table])
+        accepted, residual = split_supported(constraints, types.get)
+        if not accepted:
+            return None
+        return merge_handle_constraints(handle, accepted), tuple(residual)
+
+    def apply_projection(self, handle: TableHandle, columns) -> TableHandle:
+        # the generator already materializes only the requested columns
+        # per batches() call; accepting records the narrowed scan
+        return handle
+
 
 def _days(y: int, m: int, d: int) -> int:
     import datetime
@@ -832,29 +853,51 @@ class TpchPageSource(ConnectorPageSource):
     def batches(self, split: Split, columns: Sequence[str], batch_rows: int) -> Iterator[RelBatch]:
         table = split.table.table
         sf = split.table.payload
+        cs = getattr(split.table, "constraints", ())
         lo, hi = split.row_range
         types = dict(TABLES[table])
         step = batch_rows
         for a in range(lo, hi, step):
             b = min(a + step, hi)
-            cols = []
+            gen = {}
             nrows = None
             for name in columns:
                 data, d = generate_column(table, name, sf, a, b)
+                gen[name] = (np.asarray(data), d)
                 nrows = len(data)
-                cap = bucket_capacity(nrows)
-                typ = types[name]
-                arr = np.zeros(cap, dtype=typ.dtype)
-                arr[:nrows] = data
-                cols.append(Column(typ, jnp.asarray(arr), None, d))
+            keep = None
+            if cs:
+                # pushed-down predicate: generate the constrained
+                # columns for this chunk too (surviving-columns-only
+                # projection still holds — they are dropped after the
+                # mask) and compact exactly
+                from trino_tpu.connectors.pushdown import constraint_mask
+
+                def _coldata(nm, _a=a, _b=b, _gen=gen):
+                    if nm in _gen:
+                        return _gen[nm][0], None
+                    data, _ = generate_column(table, nm, sf, _a, _b)
+                    return np.asarray(data), None
+
+                mask = constraint_mask(cs, _coldata)
+                keep = np.nonzero(mask)[0]
+                nrows = len(keep)
             if nrows is None:  # no columns requested (count(*) scans)
                 oi_count = b - a
                 if table == "lineitem":
                     oi, _ = _lineitem_rows(a, b, sf)
                     oi_count = len(oi)
                 nrows = oi_count
+            cols = []
+            for name in columns:
+                data, d = gen[name]
+                if keep is not None:
+                    data = data[keep]
                 cap = bucket_capacity(nrows)
-                cols = []
+                typ = types[name]
+                arr = np.zeros(cap, dtype=typ.dtype)
+                arr[:nrows] = data
+                cols.append(Column(typ, jnp.asarray(arr), None, d))
             cap = bucket_capacity(nrows)
             live = None
             if nrows != cap:

@@ -57,6 +57,10 @@ class Session:
     # cost-based join reorderer (JOIN_REORDERING_STRATEGY analogue)
     enable_optimizer: bool = True
     join_reordering_strategy: str = "automatic"
+    # connector scan pushdown (sql/optimizer.py PushPredicateIntoTableScan
+    # / PushProjectionIntoTableScan via the apply_filter/apply_projection
+    # SPI hooks)
+    enable_pushdown: bool = True
     # FTE straggler mitigation: duplicate slow tasks, first wins
     # (retry-policy=TASK speculative execution)
     enable_speculative_execution: bool = True
@@ -437,7 +441,10 @@ class LocalQueryRunner:
             set_session_info,
             set_session_zone,
         )
-        from trino_tpu.sql.optimizer import optimize
+        from trino_tpu.sql.optimizer import (
+            canonicalize_tstz_keys,
+            optimize,
+        )
 
         set_session_zone(self.session.timezone)
         set_session_info(
@@ -445,7 +452,9 @@ class LocalQueryRunner:
             self.identity.user,
         )
         analyzer = Analyzer(self.catalogs, self.session.catalog, self.session.schema)
-        return optimize(analyzer.plan(q), self.catalogs, self.session)
+        root = optimize(analyzer.plan(q), self.catalogs, self.session)
+        # correctness pass: runs regardless of enable_optimizer
+        return canonicalize_tstz_keys(root)
 
     def _invalidate_plans(self) -> None:
         """Cached physical plans capture split lists (data snapshots) at
@@ -1002,6 +1011,7 @@ class LocalQueryRunner:
                 self.session.batch_rows,
                 self.session.target_splits,
                 self.session.enable_dynamic_filtering,
+                self.session.enable_pushdown,
             )
         cached = self._plan_cache.get(cache_key) if cache_key else None
         if cached is not None:
@@ -1065,9 +1075,15 @@ class LocalQueryRunner:
     def _explain_analyze(self, q: ast.Query) -> MaterializedResult:
         """EXPLAIN ANALYZE: run with instrumented operators, render plan
         + per-operator stats (ExplainAnalyzeOperator analogue)."""
-        from trino_tpu.exec.stats import instrument, render_stats
+        from trino_tpu.exec.stats import (
+            engine_counters_delta,
+            instrument,
+            render_stats,
+        )
+        from trino_tpu.runtime.metrics import METRICS
 
         output, physical = self._plan(q, sql_key=None)
+        before = METRICS.snapshot()
         ctx = self._execution_ctx()
         pipelines, chain = physical.instantiate(ctx)
         sink = CollectorSink()
@@ -1084,5 +1100,6 @@ class LocalQueryRunner:
             Driver(p).run()
         Driver(Pipeline(main_ops)).run()
         _raise_deferred_checks(ctx)
-        text = explain_text(output) + "\n\n" + render_stats(groups)
+        counters = engine_counters_delta(before, METRICS.snapshot())
+        text = explain_text(output) + "\n\n" + render_stats(groups, counters)
         return MaterializedResult([[text]], ["Query Plan"], [T.VARCHAR])

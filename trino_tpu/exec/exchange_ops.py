@@ -21,8 +21,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from trino_tpu import types as T
 from trino_tpu.block import RelBatch
 from trino_tpu.exec.operators import Operator, _concat_sort
+from trino_tpu.ops import tz
+from trino_tpu.runtime.metrics import METRICS
 from trino_tpu.exec.serde import Page
 from trino_tpu.ops.hashing import (
     canonical_hash_input,
@@ -124,7 +127,12 @@ def hash_split_batch(
             has_lut.append(True)
         else:
             has_lut.append(False)
-        keys.append(col.data)
+        data = col.data
+        if col.type.kind == T.TypeKind.TIMESTAMP_TZ:
+            # equal instants in different zones must land in the same
+            # partition: hash the packed millis, never the zone bits
+            data = data & ~tz.ZONE_MASK
+        keys.append(data)
         valids.append(col.valid_mask())
     pid = _partition_ids(
         tuple(keys), tuple(valids), tuple(luts),
@@ -192,21 +200,28 @@ class PartitionedOutputOperator(Operator):
             )
             for p, part in enumerate(parts):
                 if part.row_count:
+                    METRICS.increment("rows_shuffled", part.row_count)
                     self._buffer.enqueue(p, part)
             return
         page = Page.from_batch(batch)
         if page.row_count == 0:
             return
         if self._kind == "broadcast":
+            # each replica crosses the wire: count the copies
+            METRICS.increment("rows_shuffled", page.row_count * self._n)
             for p in range(self._n):
                 self._buffer.enqueue(p, page)
         elif self._kind == "arbitrary":
             # least-loaded by bytes, not blind round-robin: uneven page
             # sizes otherwise skew downstream tasks
+            METRICS.increment("rows_shuffled", page.row_count)
             self._buffer.enqueue(
                 self._rebalancer.pick(page.size_bytes()), page
             )
         else:
+            # single/gather (and hash collapsed to one partition) still
+            # crosses the exchange: count it
+            METRICS.increment("rows_shuffled", page.row_count)
             self._buffer.enqueue(0, page)
 
     def finish(self) -> None:

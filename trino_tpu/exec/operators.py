@@ -112,6 +112,15 @@ class TableScanOperator(Operator):
         if nxt is None:
             self._done = True
             return None
+        from trino_tpu.runtime.metrics import METRICS
+
+        if nxt.live is not None:
+            n = int(np.asarray(nxt.live).sum())
+        elif nxt.columns:
+            n = int(nxt.columns[0].data.shape[0])
+        else:
+            n = 0
+        METRICS.increment("rows_scanned", n)
         return nxt
 
     def is_finished(self) -> bool:

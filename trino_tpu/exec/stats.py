@@ -137,7 +137,26 @@ def instrument(operators, count_rows: bool = True,
     return wrapped, stats
 
 
-def render_stats(groups: List[List[OperatorStats]]) -> str:
+ENGINE_COUNTERS = (
+    "rows_scanned",
+    "bytes_scanned",
+    "rows_shuffled",
+    "exchanges_elided",
+)
+
+
+def engine_counters_delta(before: dict, after: dict) -> dict:
+    """Per-query view of the METRICS singleton's cumulative engine
+    counters: snapshot() before and after the run, subtract."""
+    return {
+        k: after.get(k, 0.0) - before.get(k, 0.0) for k in ENGINE_COUNTERS
+    }
+
+
+def render_stats(
+    groups: List[List[OperatorStats]],
+    counters: Optional[dict] = None,
+) -> str:
     lines = []
     synced = any(st.device_synced for g in groups for st in g)
     if synced:
@@ -150,4 +169,9 @@ def render_stats(groups: List[List[OperatorStats]]) -> str:
         lines.append(f"Pipeline {i}:")
         for st in group:
             lines.append("  " + st.line())
+    if counters is not None:
+        lines.append(
+            "Engine counters: "
+            + " ".join(f"{k}={counters.get(k, 0.0):.0f}" for k in ENGINE_COUNTERS)
+        )
     return "\n".join(lines)

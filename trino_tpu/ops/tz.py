@@ -13,14 +13,15 @@ with zero unpacking). Zone rules become per-zone sorted transition
 tables; the offset at an instant is one `searchsorted` + `take` on
 device — no per-row host callbacks, no data-dependent control flow.
 
-Documented deviation: COMPARISONS (=, <, BETWEEN, IN, IS DISTINCT)
-strip the zone bits and compare instants only — Trino semantics. The
-KEY paths (GROUP BY, JOIN keys, DISTINCT, hash partitioning) still key
-on the full packed value, so two values naming the same instant in
-DIFFERENT zones group/join as distinct where Trino conflates them.
-Mixed-zone columns arise only from heterogeneous varchar parsing;
-uniform-zone columns (the practical case) behave identically on every
-path.
+COMPARISONS (=, <, BETWEEN, IN, IS DISTINCT) strip the zone bits and
+compare instants only — Trino semantics. The KEY paths agree: the
+planner's canonicalize_tstz_keys pass (sql/optimizer.py) rewrites
+GROUP BY / JOIN / DISTINCT over tstz to key on a zone-masked copy
+(an any() aggregate preserves one original packed value per group as
+the rendered representative), and exchange hash partitioning masks the
+zone bits before hashing (exec/exchange_ops.py) — so equal instants in
+different zones group, join, and co-partition together, matching the
+reference's keying on getEpochMillis().
 
 The zone registry is deterministic: UTC = 0; fixed offsets ±14:00 map
 minutes -840..840 onto ids 1..1681; IANA names (sorted) start at 1800.

@@ -1,0 +1,138 @@
+"""Equality inference over join equivalence classes.
+
+Analogue of main/sql/planner/EqualityInference.java:57 reduced to the
+channel-reference form this planner's IR guarantees (join keys and
+conjunct equalities are always plain InputRefs — the analyzer
+materializes anything more complex through Project nodes first).
+
+Equivalence classes union over (a) inner-join equi-key pairs and
+(b) ``eq(InputRef, InputRef)`` conjuncts; ``derive`` then rewrites each
+single-channel deterministic conjunct onto every other member of its
+channel's class, which is what lets a filter on ``o_orderkey`` also
+constrain ``l_orderkey`` across the join and reach the other side's
+scan via the existing PushFilterIntoJoin/PushFilterThroughProject
+rules.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Sequence, Set, Tuple
+
+from trino_tpu.expr import ir
+
+
+def expr_channels(e: ir.Expr) -> Set[int]:
+    """All InputRef channels referenced by an expression."""
+    out: Set[int] = set()
+
+    def walk(x):
+        if isinstance(x, ir.InputRef):
+            out.add(x.index)
+        for c in x.children():
+            walk(c)
+
+    walk(e)
+    return out
+
+
+def substitute_channel(e: ir.Expr, src: int, dst: int, dst_type) -> ir.Expr:
+    """Copy of `e` with every InputRef(src) replaced by
+    InputRef(dst, dst_type)."""
+    import dataclasses
+
+    if isinstance(e, ir.InputRef):
+        return ir.InputRef(dst, dst_type) if e.index == src else e
+    if isinstance(e, ir.Call):
+        return ir.Call(
+            e.name,
+            tuple(substitute_channel(a, src, dst, dst_type) for a in e.args),
+            e.type,
+        )
+    if isinstance(e, ir.Cast):
+        return ir.Cast(substitute_channel(e.arg, src, dst, dst_type), e.type)
+    if isinstance(e, ir.InList):
+        return dataclasses.replace(
+            e, value=substitute_channel(e.value, src, dst, dst_type)
+        )
+    if isinstance(e, ir.Case):
+        return ir.Case(
+            tuple(substitute_channel(c, src, dst, dst_type) for c in e.conds),
+            tuple(substitute_channel(r, src, dst, dst_type) for r in e.results),
+            None
+            if e.default is None
+            else substitute_channel(e.default, src, dst, dst_type),
+            e.type,
+        )
+    return e  # Literal / LambdaVar: no channels
+
+
+class EqualityInference:
+    """Union-find over output channels of one join (or filter scope)."""
+
+    def __init__(self):
+        self._parent: Dict[int, int] = {}
+
+    def _find(self, x: int) -> int:
+        p = self._parent.setdefault(x, x)
+        while p != self._parent[p]:
+            self._parent[p] = self._parent[self._parent[p]]
+            p = self._parent[p]
+        self._parent[x] = p
+        return p
+
+    def add_equality(self, a: int, b: int) -> None:
+        ra, rb = self._find(a), self._find(b)
+        if ra != rb:
+            self._parent[ra] = rb
+
+    def add_conjunct_equalities(self, conjuncts: Iterable[ir.Expr]) -> None:
+        """Union channels named by ``eq(InputRef, InputRef)`` conjuncts."""
+        for c in conjuncts:
+            if (
+                isinstance(c, ir.Call)
+                and c.name == "eq"
+                and len(c.args) == 2
+                and all(isinstance(a, ir.InputRef) for a in c.args)
+            ):
+                self.add_equality(c.args[0].index, c.args[1].index)
+
+    def equivalent(self, channel: int) -> List[int]:
+        """All channels in `channel`'s class (including itself)."""
+        root = self._find(channel)
+        return sorted(
+            x for x in self._parent if self._find(x) == root
+        )
+
+    def derive(
+        self,
+        conjuncts: Sequence[ir.Expr],
+        fields,
+        is_deterministic,
+    ) -> List[ir.Expr]:
+        """New conjuncts obtained by rewriting each single-channel
+        deterministic conjunct onto every equivalent channel. Returns
+        only conjuncts not already present (structural equality), so a
+        caller that adds the result and re-runs gets [] — the fixpoint
+        terminates."""
+        existing: List[ir.Expr] = list(conjuncts)
+        derived: List[ir.Expr] = []
+        for c in conjuncts:
+            chans = expr_channels(c)
+            if len(chans) != 1 or not is_deterministic(c):
+                continue
+            (x,) = chans
+            # skip the equalities themselves: eq(a, a) after rewrite
+            # is vacuous and eq(a, b) rewritten is already implied
+            if (
+                isinstance(c, ir.Call)
+                and c.name == "eq"
+                and all(isinstance(a, ir.InputRef) for a in c.args)
+            ):
+                continue
+            for y in self.equivalent(x):
+                if y == x:
+                    continue
+                cand = substitute_channel(c, x, y, fields[y].type)
+                if cand not in existing and cand not in derived:
+                    derived.append(cand)
+        return derived

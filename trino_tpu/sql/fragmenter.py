@@ -30,6 +30,14 @@ from trino_tpu import types as T
 from trino_tpu.exec.operators import agg_state_meta
 from trino_tpu.sql import plan as P
 
+
+def _metrics():
+    # deferred: trino_tpu.runtime's package __init__ imports the task
+    # module, which imports this module (PlanFragment)
+    from trino_tpu.runtime.metrics import METRICS
+
+    return METRICS
+
 # -- distribution properties ------------------------------------------------
 
 SINGLE = ("single",)
@@ -191,7 +199,10 @@ class _AddExchanges:
             child = _gather(child)
         return dataclasses.replace(node, child=child), SINGLE
 
-    # aggregation: partial -> hash exchange -> final
+    # aggregation: naive single-step placement over a repartition or
+    # gather; push_partial_aggregation_through_exchange later splits it
+    # into partial -> exchange -> final (the Trino split between
+    # AddExchanges and PushPartialAggregationThroughExchange)
     def _AggregateNode(self, node):
         child, dist = self.visit(node.child)
         from trino_tpu.exec.operators import HOLISTIC_KINDS
@@ -208,31 +219,19 @@ class _AddExchanges:
             return dataclasses.replace(node, child=child), SINGLE
         groups = tuple(node.group_channels)
         if groups and dist == hash_dist(groups):
-            # child already partitioned on the exact grouping keys
+            # child already partitioned on the exact grouping keys: the
+            # repartition exchange is provably redundant (co-bucketed
+            # scans, or an upstream join/agg on the same keys)
+            _metrics().increment("exchanges_elided")
             out = dataclasses.replace(node, child=child)
             return out, hash_dist(tuple(range(len(groups))))
-        k = len(groups)
-        partial_fields = _partial_fields(node, child)
-        partial = dataclasses.replace(
-            node, child=child, step="partial", fields=tuple(partial_fields)
-        )
-        final_aggs = tuple(
-            dataclasses.replace(a, arg_channel=k + 2 * i)
-            for i, a in enumerate(node.aggs)
-        )
         if not groups:
-            gathered = _gather(partial)
-            final = P.AggregateNode(
-                gathered, (), final_aggs, node.fields, step="final"
-            )
-            return final, SINGLE
+            return dataclasses.replace(node, child=_gather(child)), SINGLE
         ex = P.ExchangeNode(
-            partial, "repartition", tuple(range(k)), tuple(partial_fields)
+            child, "repartition", groups, tuple(child.fields)
         )
-        final = P.AggregateNode(
-            ex, tuple(range(k)), final_aggs, node.fields, step="final"
-        )
-        return final, hash_dist(tuple(range(k)))
+        out = dataclasses.replace(node, child=ex)
+        return out, hash_dist(tuple(range(len(groups))))
 
     def _WindowNode(self, node):
         child, dist = self.visit(node.child)
@@ -247,6 +246,8 @@ class _AddExchanges:
             child = P.ExchangeNode(
                 child, "repartition", keys, tuple(node.child.fields)
             )
+        else:
+            _metrics().increment("exchanges_elided")
         out = dataclasses.replace(node, child=child)
         # window appends columns; partition channel positions survive
         return out, hash_dist(keys)
@@ -286,8 +287,12 @@ class _AddExchanges:
         lkeys, rkeys = tuple(node.left_keys), tuple(node.right_keys)
         if ldist != hash_dist(lkeys):
             left = P.ExchangeNode(left, "repartition", lkeys, _fields_of(node.left))
+        else:
+            _metrics().increment("exchanges_elided")
         if rdist != hash_dist(rkeys):
             right = P.ExchangeNode(right, "repartition", rkeys, _fields_of(node.right))
+        else:
+            _metrics().increment("exchanges_elided")
         out = dataclasses.replace(node, left=left, right=right)
         # semi/anti keep only left columns; inner/left keep left prefix —
         # either way the left keys' positions survive unchanged
@@ -321,6 +326,85 @@ def _spec_of(a: P.AggCall):
     return AggSpec(a.kind, a.arg_channel, a.out_type, a.distinct,
                    a.arg2_channel, a.percentile, a.separator,
                    a.arg3_channel, a.param, a.post)
+
+
+# -- exchange-tree rewrite passes --------------------------------------------
+
+
+def eliminate_redundant_exchanges(root: P.PlanNode) -> P.PlanNode:
+    """Drop a repartition feeding another repartition on the same keys:
+    the inner shuffle lays rows out exactly as the outer one will again,
+    so it only costs wire time. Arises when property tracking degrades
+    to ANY (e.g. through a projection that drops a key) and a
+    conservative repartition gets stacked on an existing one. Counted
+    in the `exchanges_elided` metric alongside the property-driven
+    skips in _AddExchanges."""
+
+    def walk(n: P.PlanNode) -> P.PlanNode:
+        kids = [walk(c) for c in n.children()]
+        if kids:
+            n = _replace_children(n, kids)
+        if (
+            isinstance(n, P.ExchangeNode)
+            and n.kind == "repartition"
+            and isinstance(n.child, P.ExchangeNode)
+            and n.child.kind == "repartition"
+            and n.child.hash_channels == n.hash_channels
+            and not n.child.merge_keys
+        ):
+            _metrics().increment("exchanges_elided")
+            n = dataclasses.replace(n, child=n.child.child)
+        return n
+
+    return walk(root)
+
+
+def push_partial_aggregation_through_exchange(root: P.PlanNode) -> P.PlanNode:
+    """Split a mergeable single-step aggregation sitting on a
+    repartition (or gather) exchange into partial -> exchange -> final,
+    so each producer task pre-aggregates before rows cross the wire
+    (PushPartialAggregationThroughExchange.java as an explicit pass
+    over the naive plan _AddExchanges now emits)."""
+    from trino_tpu.exec.operators import HOLISTIC_KINDS
+
+    def walk(n: P.PlanNode) -> P.PlanNode:
+        kids = [walk(c) for c in n.children()]
+        if kids:
+            n = _replace_children(n, kids)
+        if not isinstance(n, P.AggregateNode) or n.step != "single":
+            return n
+        if any(a.kind in HOLISTIC_KINDS or a.distinct for a in n.aggs):
+            return n
+        ex = n.child
+        if not isinstance(ex, P.ExchangeNode) or ex.merge_keys:
+            return n
+        groups = tuple(n.group_channels)
+        if ex.kind == "repartition":
+            if not groups or set(ex.hash_channels) != set(groups):
+                return n
+        elif ex.kind != "gather" or groups:
+            return n
+        k = len(groups)
+        partial_fields = tuple(_partial_fields(n, ex.child))
+        partial = dataclasses.replace(
+            n, child=ex.child, step="partial", fields=partial_fields
+        )
+        final_aggs = tuple(
+            dataclasses.replace(a, arg_channel=k + 2 * i)
+            for i, a in enumerate(n.aggs)
+        )
+        if ex.kind == "gather":
+            new_ex = P.ExchangeNode(partial, "gather", (), partial_fields)
+        else:
+            # partial output puts the group keys first
+            new_ex = P.ExchangeNode(
+                partial, "repartition", tuple(range(k)), partial_fields
+            )
+        return P.AggregateNode(
+            new_ex, tuple(range(k)), final_aggs, n.fields, step="final"
+        )
+
+    return walk(root)
 
 
 # -- row estimation: the cost-based StatsCalculator (sql/stats.py) -----------
@@ -493,6 +577,8 @@ def plan_distributed(
         scan_partitioning=_make_scan_partitioning(catalogs, target_splits),
     )
     annotated, _ = adder.visit(root)
+    annotated = eliminate_redundant_exchanges(annotated)
+    annotated = push_partial_aggregation_through_exchange(annotated)
     subplan = _Fragmenter().cut(annotated)
     # refine "hash" vs "single" partitioning now that producers are known,
     # and derive stats-driven partition counts per hash stage

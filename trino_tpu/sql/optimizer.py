@@ -340,6 +340,42 @@ class PushFilterThroughProject(Rule):
         )
 
 
+class InferTransitivePredicates(Rule):
+    """EqualityInference over a post-join filter (sql/equality.py —
+    main/sql/planner/EqualityInference.java:57): equivalence classes
+    from inner-join equi-keys and conjunct equalities; every
+    single-channel deterministic conjunct is replicated onto each
+    equivalent channel, so a filter on one join key reaches the other
+    side's scan once PushFilterIntoJoin distributes the conjuncts.
+    Fires at most once per filter (derive() returns only conjuncts not
+    already present), ordered BEFORE PushFilterIntoJoin so the derived
+    copies are still above the join when they appear."""
+
+    name = "infer_transitive_predicates"
+
+    def apply(self, node, ctx):
+        from trino_tpu.sql.equality import EqualityInference
+
+        if not isinstance(node, P.FilterNode):
+            return None
+        join = ctx.resolve(node.child)
+        if not isinstance(join, P.JoinNode) or join.kind not in ("inner", "cross"):
+            return None
+        left = ctx.resolve(join.left)
+        width_l = len(left.fields)
+        conjuncts = split_conjuncts(node.predicate)
+        inf = EqualityInference()
+        for lk, rk in zip(join.left_keys, join.right_keys):
+            inf.add_equality(lk, width_l + rk)
+        inf.add_conjunct_equalities(conjuncts)
+        derived = inf.derive(conjuncts, join.fields, _is_deterministic)
+        if not derived:
+            return None
+        return P.FilterNode(
+            node.child, ir.and_(*(conjuncts + derived)), node.fields
+        )
+
+
 class PushFilterIntoJoin(Rule):
     """Split a post-join filter's conjuncts to the join sides they
     reference (rule/PushPredicateIntoTableScan's ancestor pass,
@@ -389,6 +425,122 @@ class PushFilterIntoJoin(Rule):
         if keep:
             out = P.FilterNode(out, ir.and_(*keep), node.fields)
         return out
+
+
+class PushPredicateIntoTableScan(Rule):
+    """Filter(Scan) -> Scan' [+ residual Filter] through the connector's
+    apply_filter SPI hook (rule/PushPredicateIntoTableScan.java:141 +
+    ConnectorMetadata.applyFilter). Only conjuncts expressible as
+    per-column ``ColumnConstraint``s are offered; whatever the
+    connector declines — plus everything unclassifiable — stays in a
+    FilterNode above the scan (residual-predicate semantics)."""
+
+    name = "push_predicate_into_table_scan"
+
+    def __init__(self, catalogs):
+        self._catalogs = catalogs
+
+    def apply(self, node, ctx):
+        from trino_tpu.connectors.pushdown import (
+            classify_conjunct,
+            merge_handle_constraints,
+        )
+
+        if not isinstance(node, P.FilterNode):
+            return None
+        scan = ctx.resolve(node.child)
+        if not isinstance(scan, P.ScanNode):
+            return None
+        handle = scan.handle
+        conjuncts = split_conjuncts(node.predicate)
+        offered: Dict[int, object] = {}
+        for i, c in enumerate(conjuncts):
+            if not _is_deterministic(c):
+                continue
+            cc = classify_conjunct(c, scan.columns, scan.fields)
+            if cc is not None and cc not in handle.constraints:
+                offered[i] = cc
+        if not offered:
+            return None
+        try:
+            conn = self._catalogs.get(scan.catalog)
+        except KeyError:
+            return None
+        result = conn.metadata.apply_filter(handle, tuple(offered.values()))
+        if result is None:
+            return None
+        new_handle, residual = result
+        accepted = [cc for cc in offered.values() if cc not in residual]
+        if not accepted:
+            return None
+        if new_handle is handle or new_handle == handle:
+            # connector claimed acceptance but returned the same handle;
+            # fold the constraints in engine-side so the plan records them
+            new_handle = merge_handle_constraints(handle, accepted)
+        keep = [
+            c
+            for i, c in enumerate(conjuncts)
+            if i not in offered or offered[i] not in accepted
+        ]
+        new_scan = dataclasses.replace(scan, handle=new_handle)
+        if not keep:
+            return new_scan
+        return P.FilterNode(new_scan, ir.and_(*keep), node.fields)
+
+
+class PushProjectionIntoTableScan(Rule):
+    """Project(Scan) -> Project(Scan') with the scan narrowed to the
+    channels the projection actually reads, when the connector accepts
+    via apply_projection (rule/PushProjectionIntoTableScan.java). The
+    page source then materializes only surviving columns (the tpch
+    generator literally skips generating the rest)."""
+
+    name = "push_projection_into_table_scan"
+
+    def __init__(self, catalogs):
+        self._catalogs = catalogs
+
+    def apply(self, node, ctx):
+        if not isinstance(node, P.ProjectNode):
+            return None
+        scan = ctx.resolve(node.child)
+        if not isinstance(scan, P.ScanNode):
+            return None
+        used = sorted(set().union(*map(expr_refs, node.exprs)) if node.exprs else ())
+        if not used:
+            # count(*)-style: only the row count matters — scan the
+            # cheapest single column (fixed-width over dictionary)
+            used = [
+                min(
+                    range(len(scan.fields)),
+                    key=lambda i: (scan.fields[i].type.is_string, i),
+                )
+            ]
+        if len(used) >= len(scan.columns):
+            return None
+        try:
+            conn = self._catalogs.get(scan.catalog)
+        except KeyError:
+            return None
+        new_cols = tuple(scan.columns[i] for i in used)
+        new_handle = conn.metadata.apply_projection(scan.handle, new_cols)
+        if new_handle is None:
+            return None
+        remap = {
+            old: ir.InputRef(new, scan.fields[old].type)
+            for new, old in enumerate(used)
+        }
+        new_scan = P.ScanNode(
+            scan.catalog,
+            new_handle,
+            new_cols,
+            tuple(scan.fields[i] for i in used),
+        )
+        return P.ProjectNode(
+            new_scan,
+            tuple(substitute(e, remap) for e in node.exprs),
+            node.fields,
+        )
 
 
 class LimitOverSortToTopN(Rule):
@@ -795,6 +947,7 @@ SIMPLIFICATION_RULES: Tuple[Rule, ...] = (
     InlineProjections(),
     RemoveIdentityProject(),
     PushFilterThroughProject(),
+    InferTransitivePredicates(),
     PushFilterIntoJoin(),
     LimitOverSortToTopN(),
     EvaluateEmptyJoin(),
@@ -1680,7 +1833,13 @@ def optimize(
         return root
     strategy = getattr(session, "join_reordering_strategy", "automatic")
     stats = StatsCalculator(catalogs)
-    it = IterativeOptimizer()
+    rules: Tuple[Rule, ...] = SIMPLIFICATION_RULES
+    if getattr(session, "enable_pushdown", True) and catalogs is not None:
+        rules = rules + (
+            PushPredicateIntoTableScan(catalogs),
+            PushProjectionIntoTableScan(catalogs),
+        )
+    it = IterativeOptimizer(rules)
     root = it.optimize(root, stats)
     root = RewriteMultiSketch().rewrite(root)
     root = RewriteApproxDistinct().rewrite(root)
@@ -1690,4 +1849,167 @@ def optimize(
         cost = CostCalculator(stats)
         root = ReorderJoins(stats, cost).rewrite(root)
         root = it.optimize(root, stats)
+    return root
+
+
+# -- timestamptz key canonicalization (correctness, not optimization) --------
+
+
+def _is_tstz(t: T.DataType) -> bool:
+    return t.kind == T.TypeKind.TIMESTAMP_TZ
+
+
+def _masked_tstz(c: int, t: T.DataType) -> ir.Expr:
+    # at_timezone_id(x, 0) clears the packed zone bits while keeping the
+    # instant and validity — the canonical grouping/join key
+    return ir.Call(
+        "at_timezone_id",
+        (ir.InputRef(c, t), ir.Literal(0, T.INTEGER)),
+        t,
+    )
+
+
+def _tstz_side_project(child: P.PlanNode, need: List[int]):
+    """Project appending one zone-masked copy per channel in `need`;
+    returns (project, {orig channel: masked channel})."""
+    cf = child.fields
+    base = len(cf)
+    pos = {c: base + x for x, c in enumerate(need)}
+    exprs = tuple(ir.InputRef(i, f.type) for i, f in enumerate(cf)) + tuple(
+        _masked_tstz(c, cf[c].type) for c in need
+    )
+    flds = cf + tuple(
+        P.Field((cf[c].name or "tstz") + "$utc", cf[c].type)
+        for c in need
+    )
+    return P.ProjectNode(child, exprs, flds), pos
+
+
+def _canonicalize_agg(n: P.AggregateNode) -> P.PlanNode:
+    cf = n.child.fields
+    k = len(n.group_channels)
+    tg = [
+        j for j, c in enumerate(n.group_channels) if _is_tstz(cf[c].type)
+    ]
+    is_td = lambda a: (
+        a.distinct
+        and a.arg_channel is not None
+        and _is_tstz(cf[a.arg_channel].type)
+    )
+    if not tg and not any(is_td(a) for a in n.aggs):
+        return n
+    need: List[int] = []
+    for c in n.group_channels:
+        if _is_tstz(cf[c].type) and c not in need:
+            need.append(c)
+    for a in n.aggs:
+        if is_td(a) and a.arg_channel not in need:
+            need.append(a.arg_channel)
+    below, pos = _tstz_side_project(n.child, need)
+    groups = tuple(pos.get(c, c) for c in n.group_channels)
+    aggs = tuple(
+        dataclasses.replace(a, arg_channel=pos[a.arg_channel])
+        if is_td(a)
+        else a
+        for a in n.aggs
+    )
+    if not tg:
+        # only a DISTINCT arg was tstz: schema is unchanged
+        return dataclasses.replace(n, child=below, aggs=aggs)
+    # an any() per tstz key carries one ORIGINAL packed value (with its
+    # zone) out of each group, so rendering keeps the source zone
+    reps = tuple(
+        P.AggCall("any", n.group_channels[j], cf[n.group_channels[j]].type)
+        for j in tg
+    )
+    agg_fields = n.fields + tuple(
+        P.Field((n.fields[j].name or "tstz") + "$any", n.fields[j].type)
+        for j in tg
+    )
+    agg = P.AggregateNode(below, groups, aggs + reps, agg_fields, n.step)
+    rep_at = {j: k + len(aggs) + x for x, j in enumerate(tg)}
+    exprs = tuple(
+        ir.InputRef(rep_at.get(i, i), n.fields[i].type)
+        for i in range(len(n.fields))
+    )
+    return P.ProjectNode(agg, exprs, n.fields)
+
+
+def _canonicalize_join(n: P.JoinNode) -> P.PlanNode:
+    if not n.left_keys:
+        return n
+
+    def side(child, keys):
+        cf = child.fields
+        need = []
+        for c in keys:
+            if _is_tstz(cf[c].type) and c not in need:
+                need.append(c)
+        if not need:
+            return child, tuple(keys), 0
+        proj, pos = _tstz_side_project(child, need)
+        return proj, tuple(pos.get(c, c) for c in keys), len(need)
+
+    nleft, lk, el = side(n.left, n.left_keys)
+    nright, rk, er = side(n.right, n.right_keys)
+    if not el and not er:
+        return n
+    lf, rf = n.left.fields, n.right.fields
+    nl, nr = len(lf), len(rf)
+    residual = n.residual
+    if residual is not None and el:
+        # residual is typed over left++right: right-side refs shift past
+        # the appended left-side masked copies
+        mapping = {
+            i: ir.InputRef(
+                i if i < nl else i + el,
+                lf[i].type if i < nl else rf[i - nl].type,
+            )
+            for i in range(nl + nr)
+        }
+        residual = substitute(residual, mapping)
+    semi = n.kind in ("semi", "anti")
+    jfields = nleft.fields if semi else nleft.fields + nright.fields
+    j = dataclasses.replace(
+        n,
+        left=nleft,
+        right=nright,
+        left_keys=lk,
+        right_keys=rk,
+        residual=residual,
+        fields=jfields,
+    )
+    sel = (
+        tuple(range(nl))
+        if semi
+        else tuple(range(nl)) + tuple(nl + el + i for i in range(nr))
+    )
+    if len(sel) == len(jfields):
+        return j
+    exprs = tuple(ir.InputRef(i, jfields[i].type) for i in sel)
+    return P.ProjectNode(j, exprs, n.fields)
+
+
+def canonicalize_tstz_keys(root: P.PlanNode) -> P.PlanNode:
+    """Correctness pass, applied to every plan even when the optimizer
+    is off: timestamptz packs millis<<12 | zoneKey, but SQL equality is
+    instant-only, so GROUP BY / JOIN / DISTINCT must key on the instant
+    and never the zone bits (the reference keys on
+    LongTimestampWithTimeZone.getEpochMillis()). Rewrites tstz-keyed
+    aggregations and joins to key on a zone-masked copy appended by a
+    Project below; for group keys an any() aggregate preserves one
+    original packed value per group as the rendered representative, and
+    a Project above restores the original schema."""
+    kids = [canonicalize_tstz_keys(c) for c in root.children()]
+    if any(a is not b for a, b in zip(kids, root.children())):
+        if isinstance(root, P.JoinNode):
+            root = dataclasses.replace(root, left=kids[0], right=kids[1])
+        elif isinstance(root, P.UnionAllNode):
+            root = dataclasses.replace(root, inputs=tuple(kids))
+        else:
+            root = dataclasses.replace(root, child=kids[0])
+    if isinstance(root, P.AggregateNode) and root.step == "single":
+        return _canonicalize_agg(root)
+    if isinstance(root, P.JoinNode):
+        return _canonicalize_join(root)
     return root

@@ -320,6 +320,11 @@ def explain_text(node: PlanNode, indent: int = 0) -> str:
     if isinstance(node, ScanNode):
         h = node.handle
         detail = f" {node.catalog}.{h.schema}.{h.table} {list(node.columns)}"
+        pushed = getattr(h, "constraints", ())
+        if pushed:
+            detail += " pushed=[" + ", ".join(
+                f"{c.column} {c.op} {c.value!r}" for c in pushed
+            ) + "]"
     elif isinstance(node, FilterNode):
         detail = f" {node.predicate!r}"
     elif isinstance(node, ProjectNode):
