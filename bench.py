@@ -36,6 +36,11 @@ Flags:
                      `seconds` (default 300) until a non-CPU backend
                      initializes, then run the full bench once; device
                      walls append to BENCH_DEV.json as usual
+  --chaos-smoke [seed]  run the seeded chaos harness (runtime/chaos.py)
+                     over representative TPC-H shapes under every fault
+                     class and exit non-zero if any run diverges from
+                     the clean answer or exceeds its injected-failure
+                     bound; no device needed (runs before preflight)
 """
 
 from __future__ import annotations
@@ -726,7 +731,56 @@ def _emit(device: dict, baseline: dict, gbs, cached=None) -> None:
     )
 
 
+# chaos-smoke queries: the two plan shapes whose recovery paths differ
+# most (scan->partial/final agg with an exchange in between, and a
+# broadcast-join->agg with a build side worth losing mid-flight)
+CHAOS_QUERIES = {
+    "agg": (
+        "select l_returnflag, l_linestatus, sum(l_quantity), count(*) "
+        "from lineitem where l_shipdate <= date '1998-09-02' "
+        "group by l_returnflag, l_linestatus "
+        "order by l_returnflag, l_linestatus"
+    ),
+    "join": (
+        "select n_name, count(*) c from supplier, nation "
+        "where s_nationkey = n_nationkey group by n_name order by n_name"
+    ),
+}
+
+
+def _chaos_smoke(argv) -> int:
+    """--chaos-smoke [seed]: deterministic resiliency gate. Exit 0 iff
+    every (query, fault class) run is answer-equal to the clean run and
+    stays within its injected-failure bound; a failing run replays from
+    the printed seed."""
+    i = argv.index("--chaos-smoke")
+    try:
+        seed = int(argv[i + 1])
+    except (IndexError, ValueError):
+        seed = 42
+    from trino_tpu.runtime.chaos import FAULT_CLASSES, chaos_smoke
+
+    print(f"bench: chaos smoke seed={seed} "
+          f"fault_classes={','.join(FAULT_CLASSES)}")
+    t0 = time.time()
+    violations = chaos_smoke(seed, CHAOS_QUERIES)
+    wall = time.time() - t0
+    for v in violations:
+        print(f"bench: chaos VIOLATION: {v}", file=sys.stderr)
+    print(json.dumps({
+        "chaos_smoke": {
+            "seed": seed,
+            "cases": len(CHAOS_QUERIES) * len(FAULT_CLASSES),
+            "violations": len(violations),
+            "wall_s": round(wall, 2),
+        }
+    }))
+    return 1 if violations else 0
+
+
 def main() -> None:
+    if "--chaos-smoke" in sys.argv:
+        sys.exit(_chaos_smoke(sys.argv))
     if os.environ.get("BENCH_INNER") == "1":
         import jax
 

@@ -55,6 +55,14 @@ if os.environ.get("TRINO_TPU_NO_COMPILE_CACHE") != "1":
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long soaks (chaos soak, full mesh TPC-H sweep) excluded "
+        "from the tier-1 run (-m 'not slow'); run in the dev loop",
+    )
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_between_modules():
     """The full suite compiles 1000+ XLA programs in one process; this

@@ -1,0 +1,309 @@
+"""Seeded chaos tests for the cluster resiliency layer (PR2 tentpole).
+
+Fast tier-1 matrix: two representative TPC-H-shaped queries under every
+fault class (task crash at start/mid, exchange fetch loss, straggler,
+injected OOM) with a FIXED seed, asserting oracle-equal results and
+bounded attempt counts. The full 22-query soak carries
+@pytest.mark.slow. Graylist and low-memory-killer semantics get their
+own deterministic tests (no background heartbeat thread — the probe
+loop is driven by explicit ping_once calls)."""
+
+import threading
+
+import pytest
+
+from tests.oracle import assert_rows_match, sqlite_rows
+from tests.test_tpch import to_sqlite
+from trino_tpu.connectors.spi import CatalogManager
+from trino_tpu.connectors.tpch import create_tpch_connector
+from trino_tpu.engine import Session
+from trino_tpu.runtime import DistributedQueryRunner, Worker
+from trino_tpu.runtime.chaos import (
+    FAULT_CLASSES,
+    ChaosHarness,
+    DownableWorker,
+    generate_schedule,
+)
+from trino_tpu.runtime.failure import FailureInjector
+from trino_tpu.runtime.memory import ExceededMemoryLimitError
+
+SF = 0.01
+SEED = 42
+
+Q_AGG = (
+    "select l_returnflag, l_linestatus, sum(l_quantity), count(*) "
+    "from lineitem where l_shipdate <= date '1998-09-02' "
+    "group by l_returnflag, l_linestatus "
+    "order by l_returnflag, l_linestatus"
+)
+Q_JOIN = (
+    "select n_name, count(*) c from supplier, nation "
+    "where s_nationkey = n_nationkey "
+    "group by n_name order by n_name"
+)
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    import sqlite3
+
+    from tests.oracle import load_tpch_sqlite
+
+    conn = sqlite3.connect(":memory:")
+    load_tpch_sqlite(conn, SF)
+    yield conn
+    conn.close()
+
+
+@pytest.fixture(scope="module")
+def harness():
+    h = ChaosHarness(n_workers=2)
+    h.register_catalog("tpch", create_tpch_connector())
+    return h
+
+
+# -- the seeded fault matrix ------------------------------------------------
+
+@pytest.mark.parametrize("fault_class", FAULT_CLASSES)
+@pytest.mark.parametrize("sql", [Q_AGG, Q_JOIN], ids=["agg", "join"])
+def test_chaos_matrix(sql, fault_class, harness, oracle):
+    rows, stats = harness.run_case(sql, fault_class, seed=SEED)
+    expected = sqlite_rows(oracle, to_sqlite(sql))
+    assert_rows_match(rows, expected, ordered=True, abs_tol=1e-2)
+    # attempts stay bounded by the schedule: every injected failure can
+    # cause at most one retry (stalls cause speculation, not retries)
+    assert stats["retries"] <= stats["max_injected_failures"], stats
+    if fault_class == "fetch_loss":
+        # transient fetch loss is absorbed by the exchange retry loop:
+        # no task was ever re-run
+        assert stats["retries"] == 0, stats
+
+
+def test_schedule_determinism():
+    for fc in FAULT_CLASSES:
+        assert generate_schedule(SEED, fc) == generate_schedule(SEED, fc)
+    assert generate_schedule(1, "task_crash_start") != generate_schedule(
+        2, "task_crash_start"
+    ) or True  # different seeds may collide on tiny schedules; the
+    # invariant under test is same-seed stability above
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("fault_class", FAULT_CLASSES)
+@pytest.mark.parametrize("qid", list(range(1, 23)))
+def test_chaos_soak_tpch(qid, fault_class, harness, oracle):
+    """The full soak: all 22 TPC-H queries under every fault class."""
+    from tests.tpch_queries import QUERIES
+
+    sql = QUERIES[qid]
+    rows, stats = harness.run_case(sql, fault_class, seed=SEED + qid)
+    expected = sqlite_rows(oracle, to_sqlite(sql))
+    assert_rows_match(
+        rows, expected, ordered=("order by" in sql), abs_tol=1e-2
+    )
+    assert stats["retries"] <= stats["max_injected_failures"]
+
+
+# -- circuit breaker / graylist ---------------------------------------------
+
+def _fte_runner(workers):
+    session = Session(catalog="tpch", schema="tiny", retry_policy="task")
+    runner = DistributedQueryRunner(session, worker_handles=workers)
+    runner.register_catalog("tpch", create_tpch_connector())
+    return runner
+
+
+def test_graylisted_worker_gets_no_launches():
+    catalogs = CatalogManager()
+    catalogs.register("tpch", create_tpch_connector())
+    w_ok = Worker("w-ok", catalogs)
+    w_bad = DownableWorker(Worker("w-bad", catalogs))
+    runner = _fte_runner([w_ok, w_bad])
+    nm = runner.node_manager
+    sql = "select count(*) from nation"
+
+    # healthy cluster: both workers take launches over a few queries
+    assert runner.execute(sql).rows[0][0] == 25
+    assert w_bad.create_calls > 0
+
+    # worker goes dark: failed probes trip its breaker
+    w_bad.down = True
+    for _ in range(3):
+        nm.ping_once()
+    assert nm.breaker_states()["w-bad"] == "open"
+
+    # while graylisted: queries succeed and the dark worker receives
+    # ZERO launches (placement avoids it entirely, no timeout-per-task)
+    calls_while_open = w_bad.create_calls
+    assert runner.execute(sql).rows[0][0] == 25
+    assert w_bad.create_calls == calls_while_open
+
+    # recovery: one successful probe closes the breaker and the worker
+    # returns to rotation
+    w_bad.down = False
+    nm.ping_once()
+    assert nm.breaker_states()["w-bad"] == "closed"
+    assert runner.execute(sql).rows[0][0] == 25
+    assert w_bad.create_calls > calls_while_open
+
+
+def test_breaker_reopens_on_failed_probe():
+    from trino_tpu.runtime.discovery import CircuitBreaker
+
+    clock = [0.0]
+    b = CircuitBreaker(trip_threshold=2, cooldown_s=1.0,
+                       clock=lambda: clock[0])
+    b.record_failure()
+    assert b.state == "closed"
+    b.record_failure()
+    assert b.state == "open"
+    b.mark_probing()            # cooldown not elapsed
+    assert b.state == "open"
+    clock[0] = 2.0
+    b.mark_probing()
+    assert b.state == "half_open"
+    b.record_failure()          # probe failed: back to open
+    assert b.state == "open"
+    clock[0] = 4.0
+    b.mark_probing()
+    b.record_success()          # probe succeeded
+    assert b.state == "closed"
+
+
+# -- error tracker ----------------------------------------------------------
+
+def test_error_tracker_deterministic_backoff():
+    from trino_tpu.runtime.error_tracker import (
+        RequestErrorTracker,
+        RetryPolicy,
+    )
+
+    def schedule(seed):
+        sleeps = []
+        t = RequestErrorTracker(
+            "w", RetryPolicy(max_error_duration_s=1e9, max_errors=6),
+            seed=seed, clock=lambda: 0.0, sleep=sleeps.append,
+        )
+        for _ in range(5):
+            t.on_failure(ConnectionError("x"))
+        return sleeps
+
+    assert schedule(7) == schedule(7)  # replayable from the seed
+    s = schedule(7)
+    assert len(s) == 5 and all(x > 0 for x in s)
+    # exponential shape survives the jitter (factor 2, jitter 0.25)
+    assert s[3] > s[0]
+
+
+def test_error_tracker_budget_and_protocol_errors():
+    from trino_tpu.runtime.error_tracker import (
+        RequestFailedError,
+        RetryPolicy,
+        run_with_retry,
+    )
+
+    pol = RetryPolicy(max_error_duration_s=0.2, min_backoff_s=0.001,
+                      max_backoff_s=0.005)
+
+    def dead():
+        raise ConnectionError("down")
+
+    with pytest.raises(RequestFailedError) as ei:
+        run_with_retry("w-dead", dead, pol)
+    assert len(ei.value.failures) > 1  # it DID retry before giving up
+
+    def appfail():
+        raise ValueError("application error")
+
+    with pytest.raises(ValueError):  # non-transient: no retry loop
+        run_with_retry("w-app", appfail, pol)
+
+
+# -- low-memory killer ------------------------------------------------------
+
+# A join whose build side RETAINS a non-revocable reservation during
+# the probe (HashBuildSink.finish keeps the lookup source live): two
+# build tasks land on each worker pool at ~434KB apiece, so a 600KB
+# pool fits the first but exhausts on the second with nothing left to
+# revoke — the exact shape where spill cannot save you and the killer
+# must.
+BIG_SQL = (
+    "select o_orderpriority, count(*) c, sum(l_quantity) q "
+    "from orders, lineitem where o_orderkey = l_orderkey "
+    "group by o_orderpriority"
+)
+SMALL_SQL = "select count(*) from region"
+
+
+def test_oom_kills_largest_query_only(oracle):
+    """Pool exhaustion on shared worker pools — after revocation/spill
+    found nothing to free — kills ONE query (the largest reservation
+    holder) with a query-level ExceededMemoryLimitError; a small
+    concurrent query completes, and the workers survive to serve later
+    queries."""
+    session = Session(
+        catalog="tpch", schema="tiny", memory_pool_bytes=600 * 1024,
+        mesh_execution=False,  # mesh bypasses worker pools entirely
+    )
+    runner = DistributedQueryRunner(session, n_workers=2)
+    runner.register_catalog("tpch", create_tpch_connector())
+    assert runner.memory_manager is not None
+
+    big_err = []
+
+    def run_big():
+        try:
+            runner.execute(BIG_SQL)
+        except BaseException as e:
+            big_err.append(e)
+
+    t = threading.Thread(target=run_big, daemon=True)
+    t.start()
+    # the small query keeps working regardless of when the kill lands
+    small = runner.execute(SMALL_SQL)
+    assert small.rows[0][0] == 5
+    t.join(120)
+    assert not t.is_alive()
+    assert big_err, "big query should have been killed"
+    assert isinstance(big_err[0], ExceededMemoryLimitError), big_err[0]
+    assert "low-memory killer" in str(big_err[0])
+    assert len(runner.memory_manager.kills) == 1
+    # the kill freed the victim's ledger: pools drain back to zero
+    # once its tasks unwind, and the cluster still serves queries
+    after = runner.execute(SMALL_SQL)
+    assert after.rows[0][0] == 5
+    assert runner.memory_manager.kills and not runner.memory_manager.kills[1:]
+    # drain the doomed query's task threads before the interpreter
+    # starts tearing down (daemon threads mid-kernel abort the process)
+    for w in runner.workers:
+        for k in w.task_ids():
+            w.get_task(k).join(30)
+
+
+# -- mid-crash after spill: spool de-duplication ----------------------------
+
+def test_mid_crash_after_spill_no_duplicate_rows(oracle):
+    """A task that spilled under memory pressure, produced output, and
+    THEN died must retry without duplicating rows: consumers read only
+    the committed attempt (spool manifest de-duplication), and the
+    retry's spill state starts clean."""
+    injector = FailureInjector()
+    catalogs = CatalogManager()
+    catalogs.register("tpch", create_tpch_connector())
+    workers = [
+        Worker(f"spill-w{i}", catalogs, failure_injector=injector,
+               memory_pool_bytes=1 << 22)
+        for i in range(2)
+    ]
+    session = Session(catalog="tpch", schema="tiny", retry_policy="task")
+    runner = DistributedQueryRunner(session, worker_handles=workers)
+    runner.register_catalog("tpch", create_tpch_connector())
+
+    injector.inject(where="mid", attempts=(0,), max_hits=2)
+    try:
+        rows = runner.execute(Q_AGG).rows
+    finally:
+        injector.clear()
+    expected = sqlite_rows(oracle, to_sqlite(Q_AGG))
+    assert_rows_match(rows, expected, ordered=True, abs_tol=1e-2)
+    assert runner.last_fte_stats["retries"] >= 1

@@ -64,6 +64,41 @@ def test_uniform_selector_release():
     assert sel.select([a, b]).name == h.name
 
 
+class _FakeNodeManager:
+    """schedulable_workers() protocol double (runtime/discovery.py)."""
+
+    def __init__(self, ok):
+        self._ok = ok
+
+    def schedulable_workers(self):
+        return list(self._ok)
+
+
+def test_uniform_selector_skips_graylisted():
+    a, b = _Node("a"), _Node("b")
+    sel = UniformNodeSelector(node_manager=_FakeNodeManager([b]))
+    # a's breaker is open: every pick lands on b, even as "preferred"
+    assert all(sel.select([a, b]).name == "b" for _ in range(3))
+    assert sel.select([a, b], preferred=[a]).name == "b"
+
+
+def test_uniform_selector_all_gray_degrades():
+    a, b = _Node("a"), _Node("b")
+    sel = UniformNodeSelector(node_manager=_FakeNodeManager([]))
+    # every breaker open: degrade to the full set rather than starve
+    assert sel.select([a, b]).name in ("a", "b")
+
+
+def test_bin_packing_skips_graylisted():
+    small = _Node("small", pool_bytes=100)
+    big = _Node("big", pool_bytes=1000)
+    alloc = BinPackingNodeAllocator(
+        node_manager=_FakeNodeManager([small])
+    )
+    # big has more room but its breaker is open
+    assert alloc.acquire([small, big], estimated_bytes=10).name == "small"
+
+
 def test_bin_packing_picks_most_free():
     small = _Node("small", pool_bytes=100)
     big = _Node("big", pool_bytes=1000)

@@ -341,6 +341,25 @@ class TestMixedZoneKeys:
         ).rows
         assert semi == [[1], [2]]
 
+    def test_window_partition_by_merges_equal_instants(self, rz):
+        # window PARTITION BY must key on the instant, not the packed
+        # (millis, zone) value: rows 1 and 2 are the same instant in
+        # different zones and land in ONE partition
+        rows = rz.execute(
+            "select v, count(*) over (partition by ts) c, "
+            "sum(v) over (partition by ts) s from mz order by v"
+        ).rows
+        assert rows == [[1, 2, 3], [2, 2, 3], [5, 1, 5]]
+
+    def test_window_partition_by_tstz_rank_order(self, rz):
+        # ordered frame inside a tstz partition; the appended masked key
+        # must not shift the function's arg/order channels
+        rows = rz.execute(
+            "select v, row_number() over (partition by ts order by v) r "
+            "from mz order by v"
+        ).rows
+        assert rows == [[1, 1], [2, 2], [5, 1]]
+
     def test_optimizer_off_same_answers(self, rz):
         rz.execute("SET SESSION enable_optimizer = false")
         try:

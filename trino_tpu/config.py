@@ -49,6 +49,8 @@ class PropertyRegistry:
             raise ValueError(f"unknown session property {name!r}")
         if isinstance(value, str) and meta.type is not str:
             value = meta.parse(value)
+        elif meta.type is float and isinstance(value, int):
+            value = float(value)
         elif not isinstance(value, meta.type):
             raise ValueError(
                 f"{name}: expected {meta.type.__name__}, got {type(value).__name__}"
@@ -96,6 +98,19 @@ for _name, _type, _default, _desc, _allowed in [
     ("task_concurrency", int, 2,
      "intra-task pipeline parallelism via the local exchange (1 = off)",
      None),
+    # -- cluster resiliency (runtime/error_tracker, discovery, memory) --
+    ("request_max_error_duration_s", float, 30.0,
+     "per-destination transient-error budget before a remote request "
+     "is declared failed (RequestErrorTracker deadline)", None),
+    ("node_breaker_threshold", int, 3,
+     "consecutive failed probes/requests before a worker's circuit "
+     "breaker opens (graylist)", None),
+    ("node_breaker_cooldown_s", float, 1.0,
+     "seconds a graylisted worker sits out before a half-open probe",
+     None),
+    ("low_memory_killer_enabled", bool, True,
+     "under cluster pool exhaustion (after revocation/spill), kill the "
+     "single largest query instead of stalling everyone", None),
 ]:
     SYSTEM_PROPERTIES.register(_name, _type, _default, _desc, _allowed)
 

@@ -67,6 +67,14 @@ class Session:
     # intra-task pipeline parallelism (LocalExchange): parallel build
     # pipelines + host IO overlapped with device compute; 1 = off
     task_concurrency: int = 2
+    # cluster resiliency (PR 2): per-destination transient-error budget
+    # for inter-node requests (runtime/error_tracker.py), circuit
+    # breaker graylisting thresholds (runtime/discovery.py), and the
+    # last-resort low-memory killer (runtime/memory.py)
+    request_max_error_duration_s: float = 30.0
+    node_breaker_threshold: int = 3
+    node_breaker_cooldown_s: float = 1.0
+    low_memory_killer_enabled: bool = True
 
     def set_property(self, name: str, value) -> None:
         """SET SESSION entry point — validated through the typed

@@ -28,17 +28,26 @@ class Pipeline:
     operators: List[Operator]
 
 
+class TaskAbortedError(RuntimeError):
+    """Raised by Driver.run when the owning task was aborted or failed
+    externally (kill, low-memory killer) — cooperative cancellation at
+    batch boundaries so a doomed task stops burning device cycles."""
+
+
 class Driver:
     """Runs one pipeline to completion (Driver.processInternal analogue)."""
 
-    def __init__(self, pipeline: Pipeline):
+    def __init__(self, pipeline: Pipeline, should_stop=None):
         self.ops = pipeline.operators
         self._finish_signalled = [False] * len(self.ops)
+        self._should_stop = should_stop
 
     def run(self) -> None:
         ops = self.ops
         n = len(ops)
         while not ops[-1].is_finished():
+            if self._should_stop is not None and self._should_stop():
+                raise TaskAbortedError("task aborted")
             progressed = False
             for i in range(n - 1):
                 cur, nxt = ops[i], ops[i + 1]

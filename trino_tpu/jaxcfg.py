@@ -14,6 +14,41 @@ import jax
 
 jax.config.update("jax_enable_x64", True)
 
+
+def get_shard_map():
+    """Version-tolerant shard_map lookup: the top-level `jax.shard_map`
+    export (newer jax, `check_vma` kwarg) first, then
+    `jax.experimental.shard_map.shard_map` (0.4.x, `check_rep` kwarg)
+    behind an adapter that translates the renamed kwarg. Returns None
+    when neither exists so callers can degrade (mesh plane falls back to
+    the page exchange; mesh tests skip) instead of failing at import."""
+    try:
+        from jax import shard_map as sm
+
+        return sm
+    except ImportError:
+        pass
+    try:
+        from jax.experimental.shard_map import shard_map as _sm
+    except ImportError:
+        return None
+    import functools
+    import inspect
+
+    params = inspect.signature(_sm).parameters
+    if "check_vma" in params:
+        return _sm
+
+    @functools.wraps(_sm)
+    def sm(f, **kw):
+        if "check_vma" in kw:
+            check = kw.pop("check_vma")
+            if "check_rep" in params:
+                kw["check_rep"] = check
+        return _sm(f, **kw)
+
+    return sm
+
 # Persistent compilation cache: the engine compiles one XLA program per
 # (operator, shape) and TPU compiles are tens of seconds over a
 # tunneled device — caching them on disk makes every process after the

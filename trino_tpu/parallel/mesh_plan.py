@@ -38,8 +38,11 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as PSpec
+
+from trino_tpu.jaxcfg import get_shard_map
+
+shard_map = get_shard_map()
 
 from trino_tpu import types as T
 from trino_tpu.block import (
@@ -810,6 +813,8 @@ class MeshExecutor:
     def execute(self, subplan: SubPlan) -> List[list]:
         from trino_tpu.runtime.stages import topo_order
 
+        if shard_map is None:
+            raise MeshUnsupported("shard_map unavailable in this jax")
         order = topo_order(subplan)
         if len(order) < 2:
             raise MeshUnsupported("single-fragment plan")

@@ -1,0 +1,276 @@
+"""Deterministic seeded chaos harness for the cluster resiliency layer.
+
+Analogue of the reference's BaseFailureRecoveryTest matrix run as a
+harness instead of hand-written cases: a fixed seed generates a fault
+schedule (which partitions crash where, how many exchange fetches drop,
+who stalls, who OOMs), the schedule is installed into the shared
+FailureInjector, and TPC-H queries run through the fault-tolerant
+scheduler. Because every random draw — schedule generation AND the
+retry layer's backoff jitter (error_tracker seeds its RNG from the
+destination) — is seeded, a failing run replays exactly from its seed.
+
+Fault classes map onto distinct recovery paths:
+
+- task_crash_start: task dies before producing output (clean re-run)
+- task_crash_mid:   task dies AFTER its first output page (the
+                    partially-spooled path; spool commit manifests keep
+                    replayed attempts duplicate-free)
+- fetch_loss:       exchange page pulls fail transiently (absorbed by
+                    the RequestErrorTracker loop, no task retry at all)
+- straggler:        a task stalls; FTE speculation races a duplicate
+- oom:              a task raises ExceededMemoryLimitError (memory-
+                    classed: the partition memory estimator doubles
+                    before re-placement)
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional, Tuple
+
+FAULT_CLASSES = (
+    "task_crash_start",
+    "task_crash_mid",
+    "fetch_loss",
+    "straggler",
+    "oom",
+)
+
+
+def generate_schedule(
+    seed: int,
+    fault_class: str,
+    n_partitions: int = 2,
+    n_rules: int = 2,
+    stall_s: float = 1.0,
+) -> List[dict]:
+    """Deterministic fault schedule: FailureRule kwargs drawn from
+    random.Random(seed). Same (seed, fault_class) -> same schedule."""
+    if fault_class not in FAULT_CLASSES:
+        raise ValueError(f"unknown fault class: {fault_class}")
+    rng = random.Random(seed)
+    rules: List[dict] = []
+    for _ in range(n_rules):
+        p = rng.randrange(n_partitions)
+        if fault_class == "task_crash_start":
+            rules.append(dict(
+                where="start", kind="crash", partition=p,
+                attempts=(0,), max_hits=1,
+            ))
+        elif fault_class == "task_crash_mid":
+            rules.append(dict(
+                where="mid", kind="crash", partition=p,
+                attempts=(0,), max_hits=1,
+            ))
+        elif fault_class == "fetch_loss":
+            rules.append(dict(
+                where="fetch", kind="fetch_loss", partition=p,
+                attempts=(0, 1), max_hits=rng.randint(1, 3),
+            ))
+        elif fault_class == "straggler":
+            # one stall is enough to drive speculation; more would just
+            # serialize the test
+            if not rules:
+                rules.append(dict(
+                    where="start", partition=p, attempts=(0,),
+                    stall_s=stall_s + rng.random(), max_hits=1,
+                ))
+        elif fault_class == "oom":
+            rules.append(dict(
+                where="start", kind="oom", partition=p,
+                attempts=(0,), max_hits=1,
+            ))
+    return rules
+
+
+def schedule_max_failures(rules: List[dict]) -> int:
+    """Upper bound on injected failures a schedule can cause — the
+    bounded-attempt assertion compares observed retries against this."""
+    return sum(r.get("max_hits", 0) for r in rules if r.get("stall_s", 0) == 0)
+
+
+class DownableWorker:
+    """Proxy handle that can be taken down (every call raises
+    ConnectionError) and counts launches — the graylist assertions need
+    'zero create_task calls while the breaker is open'."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.worker_id = inner.worker_id
+        self.down = False
+        self.create_calls = 0
+
+    def _check(self) -> None:
+        if self.down:
+            raise ConnectionError(f"worker {self.worker_id} is down")
+
+    def create_task(self, spec):
+        self.create_calls += 1
+        self._check()
+        return self._inner.create_task(spec)
+
+    def task_state(self, task_id) -> dict:
+        self._check()
+        return self._inner.task_state(task_id)
+
+    def get_results(self, task_id, partition, token,
+                    max_pages=16, wait=0.0):
+        self._check()
+        return self._inner.get_results(
+            task_id, partition, token, max_pages, wait
+        )
+
+    def remove_task(self, task_id) -> None:
+        self._check()
+        self._inner.remove_task(task_id)
+
+    def results_location(self, task_id):
+        return self._inner.results_location(task_id)
+
+    def status(self) -> dict:
+        self._check()
+        return self._inner.status()
+
+    @property
+    def memory_pool(self):
+        return getattr(self._inner, "memory_pool", None)
+
+
+def _norm_rows(rows: List[list]) -> List[tuple]:
+    """Comparable row form: floats rounded so recomputation noise (a
+    retried attempt re-reduces in a different order) doesn't read as
+    corruption."""
+    out = []
+    for r in rows:
+        out.append(tuple(
+            round(v, 6) if isinstance(v, float) else v for v in r
+        ))
+    return out
+
+
+def rows_equal(a: List[list], b: List[list], ordered: bool = False) -> bool:
+    na, nb = _norm_rows(a), _norm_rows(b)
+    if ordered:
+        return na == nb
+    key = repr
+    return sorted(na, key=key) == sorted(nb, key=key)
+
+
+class ChaosHarness:
+    """One FTE cluster with a shared FailureInjector: run queries under
+    generated fault schedules and compare against a clean run.
+
+    The harness owns N in-process workers behind the coordinator's
+    worker_handles path (the FTE topology tests use), a NodeManager with
+    circuit breakers, and the spooling exchange. `run_case` returns
+    (rows, stats) where stats carries the FTE retry counters for
+    bounded-attempt assertions.
+    """
+
+    def __init__(
+        self,
+        n_workers: int = 2,
+        session=None,
+        catalogs: Optional[Dict[str, object]] = None,
+        hash_partitions: int = 2,
+        memory_pool_bytes: Optional[int] = None,
+    ):
+        from trino_tpu.engine import Session
+        from trino_tpu.runtime.coordinator import DistributedQueryRunner
+        from trino_tpu.runtime.failure import FailureInjector
+        from trino_tpu.runtime.worker import Worker
+
+        self.injector = FailureInjector()
+        self.session = session or Session(
+            catalog="tpch", schema="tiny", retry_policy="task"
+        )
+        from trino_tpu.connectors.spi import CatalogManager
+
+        self._catalogs = CatalogManager()
+        self.workers = [
+            Worker(
+                f"chaos-w{i}", self._catalogs,
+                failure_injector=self.injector,
+                memory_pool_bytes=memory_pool_bytes,
+            )
+            for i in range(n_workers)
+        ]
+        self.runner = DistributedQueryRunner(
+            self.session,
+            worker_handles=self.workers,
+            hash_partitions=hash_partitions,
+        )
+        for name, conn in (catalogs or {}).items():
+            self.register_catalog(name, conn)
+
+    def register_catalog(self, name: str, connector) -> None:
+        # planner-side AND worker-side (worker_handles topologies load
+        # catalogs per node, as the reference does)
+        self.runner.register_catalog(name, connector)
+        self._catalogs.register(name, connector)
+
+    def run_clean(self, sql: str) -> List[list]:
+        self.injector.clear()
+        return self.runner.execute(sql).rows
+
+    def run_case(
+        self, sql: str, fault_class: str, seed: int,
+        n_partitions: int = 2,
+    ) -> Tuple[List[list], dict]:
+        """Run one query under one generated fault schedule."""
+        rules = generate_schedule(seed, fault_class, n_partitions)
+        self.injector.clear()
+        for r in rules:
+            self.injector.inject(**r)
+        try:
+            rows = self.runner.execute(sql).rows
+        finally:
+            self.injector.clear()
+        stats = dict(self.runner.last_fte_stats or {})
+        stats["max_injected_failures"] = schedule_max_failures(rules)
+        stats["breakers"] = self.runner.node_manager.breaker_states()
+        return rows, stats
+
+
+def chaos_smoke(
+    seed: int,
+    queries: Dict[str, str],
+    fault_classes=FAULT_CLASSES,
+    verbose: bool = True,
+) -> List[str]:
+    """bench.py --chaos-smoke entry: every (query, fault class) pair
+    must be oracle-equal to the clean run and stay within its injected
+    failure bound. Returns the list of violation descriptions (empty =
+    pass)."""
+    from trino_tpu.connectors.tpch import create_tpch_connector
+
+    harness = ChaosHarness(n_workers=2)
+    harness.register_catalog("tpch", create_tpch_connector())
+    failures: List[str] = []
+    for name, sql in queries.items():
+        expected = harness.run_clean(sql)
+        ordered = "order by" in sql.lower()
+        for fc in fault_classes:
+            try:
+                rows, stats = harness.run_case(sql, fc, seed)
+            except Exception as e:
+                failures.append(f"{name}/{fc}: raised {type(e).__name__}: {e}")
+                continue
+            if not rows_equal(rows, expected, ordered=ordered):
+                failures.append(
+                    f"{name}/{fc}: rows diverged from clean run "
+                    f"({len(rows)} vs {len(expected)})"
+                )
+            bound = stats.get("max_injected_failures", 0)
+            if stats.get("retries", 0) > bound:
+                failures.append(
+                    f"{name}/{fc}: {stats['retries']} retries exceeds "
+                    f"injected-failure bound {bound}"
+                )
+            if verbose:
+                print(
+                    f"  chaos {name}/{fc}: ok rows={len(rows)} "
+                    f"retries={stats.get('retries')} "
+                    f"spec={stats.get('speculative_hits')}"
+                )
+    return failures

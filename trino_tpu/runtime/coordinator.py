@@ -211,12 +211,77 @@ class DistributedQueryRunner:
             self._in_process_workers = False
         else:
             self.workers = [
-                Worker(f"worker-{i}", self.catalogs) for i in range(n_workers)
+                Worker(
+                    f"worker-{i}", self.catalogs,
+                    memory_pool_bytes=self.session.memory_pool_bytes,
+                )
+                for i in range(n_workers)
             ]
             self._in_process_workers = True
         self.hash_partitions = hash_partitions
         # why the last query left the mesh plane (None = it didn't)
         self.last_mesh_fallback: Optional[str] = None
+        # resiliency plane: every worker is registered with a
+        # NodeManager whose per-node circuit breakers graylist
+        # misbehaving workers (ping loop NOT started here — call
+        # .node_manager.start() for live heartbeats, or ping_once() for
+        # deterministic tests)
+        from trino_tpu.runtime.discovery import NodeManager
+
+        self.node_manager = NodeManager(
+            breaker_threshold=self.session.node_breaker_threshold,
+            breaker_cooldown_s=self.session.node_breaker_cooldown_s,
+        )
+        for w in self.workers:
+            self.node_manager.register(w)
+            # remote handles (HttpWorkerClient): bind the session's
+            # retry budget and the breaker listener unless the caller
+            # already chose them explicitly
+            if getattr(w, "retry_policy", False) is None:
+                from trino_tpu.runtime.error_tracker import RetryPolicy
+
+                w.retry_policy = RetryPolicy(
+                    max_error_duration_s=(
+                        self.session.request_max_error_duration_s
+                    ),
+                )
+            if (
+                hasattr(w, "failure_listener")
+                and w.failure_listener is None
+            ):
+                w.failure_listener = self.node_manager
+        # FTE observability for bounded-attempt assertions
+        self.last_fte_stats: Optional[dict] = None
+        # cluster memory arbiter over the in-process workers' SHARED
+        # pools: on exhaustion kill the largest query, not the worker
+        self.memory_manager = None
+        if (
+            self._in_process_workers
+            and self.session.memory_pool_bytes
+            and self.session.low_memory_killer_enabled
+        ):
+            from trino_tpu.runtime.memory import ClusterMemoryManager
+
+            self.memory_manager = ClusterMemoryManager(
+                [w.memory_pool for w in self.workers],
+                fail_query=self._fail_query_on_workers,
+            )
+            self.memory_manager.install()
+
+    def _fail_query_on_workers(self, query_id: str, message: str) -> None:
+        for w in self.workers:
+            try:
+                w.fail_query(query_id, message)
+            except Exception:
+                pass
+
+    def _schedulable_workers(self) -> List:
+        """Placement pool for new launches: breaker-closed active nodes,
+        degrading to the full set rather than refusing to run."""
+        nm = self.node_manager
+        return (
+            nm.schedulable_workers() or nm.active_workers() or self.workers
+        )
 
     def _mesh_colocated(self) -> bool:
         """Mesh execution applies when every task would run in THIS
@@ -340,7 +405,7 @@ class DistributedQueryRunner:
             scheduler = QueryScheduler(
                 query_id,
                 subplan,
-                self.workers,
+                self._schedulable_workers(),
                 self.catalogs,
                 self.session,
                 self.hash_partitions,
@@ -435,8 +500,28 @@ class DistributedQueryRunner:
                 spool_dir,
                 self.hash_partitions,
                 max_task_retries=self.session.task_retries,
+                node_manager=self.node_manager,
             )
-            _, root_key = scheduler.run()
+            from trino_tpu.runtime.fte import TaskRetriesExceeded
+
+            try:
+                _, root_key = scheduler.run()
+            except TaskRetriesExceeded as e:
+                if "ExceededMemoryLimitError" in str(e) or (
+                    "low-memory killer" in str(e)
+                ):
+                    from trino_tpu.runtime.memory import (
+                        ExceededMemoryLimitError,
+                    )
+
+                    raise ExceededMemoryLimitError(str(e)) from e
+                raise
+            finally:
+                # bounded-attempt observability, success or failure
+                self.last_fte_stats = {
+                    "retries": scheduler.retries,
+                    "speculative_hits": scheduler.speculative_hits,
+                }
             import os
 
             root_dir = os.path.join(spool_dir, root_key)
@@ -475,16 +560,37 @@ class DistributedQueryRunner:
         rows: List[list] = []
         token = 0
         while True:
-            failed = scheduler.failed_tasks()
-            if failed:
-                raise RuntimeError("query failed: " + "; ".join(failed))
-            pages, token, complete = handle.get_results(
-                tid, 0, token, max_pages=16, wait=0.2
-            )
+            self._raise_if_failed(scheduler)
+            try:
+                pages, token, complete = handle.get_results(
+                    tid, 0, token, max_pages=16, wait=0.2
+                )
+            except RuntimeError:
+                # the root buffer can be aborted (low-memory kill, task
+                # failure) BETWEEN the failure check above and this
+                # fetch; re-read task states so the query-level verdict
+                # carries the real cause, not "buffer aborted"
+                self._raise_if_failed(scheduler)
+                raise
             for page in pages:
                 rows.extend(_page_rows(page))
             if complete:
                 return rows
+
+    @staticmethod
+    def _raise_if_failed(scheduler: QueryScheduler) -> None:
+        failed = scheduler.failed_tasks()
+        if not failed:
+            return
+        msg = "; ".join(failed)
+        if "ExceededMemoryLimitError" in msg or "low-memory killer" in msg:
+            # memory kill is a QUERY-level verdict: the caller sees the
+            # typed error while other queries (and the worker) keep
+            # running
+            from trino_tpu.runtime.memory import ExceededMemoryLimitError
+
+            raise ExceededMemoryLimitError("query failed: " + msg)
+        raise RuntimeError("query failed: " + msg)
 
 
 def _page_rows(page: Page) -> List[list]:

@@ -9,6 +9,14 @@ Collapsed to the engine's needs: a registry of worker handles, a
 background pinger with an exponentially-decayed failure rate, and an
 active-set the scheduler consults per scheduling pass (which is how
 workers join/leave mid-stream in FTE mode).
+
+Circuit breaking: every node also carries a CircuitBreaker fed by
+request outcomes (the NodeManager implements the error-tracker listener
+protocol, so HTTP clients and exchange pullers report into it). A node
+whose breaker trips is graylisted — `schedulable_workers()` excludes it
+so FTE re-placement and new launches avoid the node — while the
+heartbeat ping keeps probing it; one successful probe closes the
+breaker and returns the node to rotation.
 """
 
 from __future__ import annotations
@@ -18,12 +26,63 @@ import time
 from typing import Callable, Dict, List, Optional
 
 
+class CircuitBreaker:
+    """Per-node breaker: `trip_threshold` consecutive failures open it;
+    while open the node is graylisted (excluded from scheduling) but
+    still probed by the heartbeat loop. After `cooldown_s` the next
+    probe half-opens the breaker; a success closes it, another failure
+    re-opens it and restarts the cooldown."""
+
+    def __init__(self, trip_threshold: int = 3, cooldown_s: float = 1.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.trip_threshold = trip_threshold
+        self.cooldown_s = cooldown_s
+        self._clock = clock
+        self.state = "closed"  # closed | open | half_open
+        self.consecutive_failures = 0
+        self.opened_at: Optional[float] = None
+        self.trips = 0  # observability: how often this node graylisted
+
+    @property
+    def is_open(self) -> bool:
+        return self.state in ("open", "half_open")
+
+    def record_failure(self) -> None:
+        self.consecutive_failures += 1
+        if self.state == "half_open":
+            self.state = "open"  # probe failed: restart the cooldown
+            self.opened_at = self._clock()
+        elif (
+            self.state == "closed"
+            and self.consecutive_failures >= self.trip_threshold
+        ):
+            self.state = "open"
+            self.opened_at = self._clock()
+            self.trips += 1
+
+    def record_success(self) -> None:
+        self.consecutive_failures = 0
+        if self.state != "closed":
+            self.state = "closed"
+            self.opened_at = None
+
+    def mark_probing(self) -> None:
+        """Transition open -> half_open once the cooldown elapsed (the
+        heartbeat calls this right before its probe ping)."""
+        if (
+            self.state == "open"
+            and self._clock() - (self.opened_at or 0.0) >= self.cooldown_s
+        ):
+            self.state = "half_open"
+
+
 class NodeState:
-    def __init__(self, handle):
+    def __init__(self, handle, breaker: Optional[CircuitBreaker] = None):
         self.handle = handle
         self.state = "active"  # active | shutting_down | failed
         self.failure_rate = 0.0  # exponentially decayed
         self.last_seen = time.monotonic()
+        self.breaker = breaker or CircuitBreaker()
 
 
 class NodeManager:
@@ -34,16 +93,25 @@ class NodeManager:
     DECAY = 0.8  # per-ping decay of the failure rate
     FAIL_THRESHOLD = 0.6
 
-    def __init__(self, ping_interval: float = 1.0):
+    def __init__(self, ping_interval: float = 1.0,
+                 breaker_threshold: int = 3,
+                 breaker_cooldown_s: float = 1.0):
         self._nodes: Dict[str, NodeState] = {}
         self._lock = threading.Lock()
         self._interval = ping_interval
+        self._breaker_threshold = breaker_threshold
+        self._breaker_cooldown_s = breaker_cooldown_s
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def register(self, handle) -> None:
         with self._lock:
-            self._nodes[handle.worker_id] = NodeState(handle)
+            self._nodes[handle.worker_id] = NodeState(
+                handle,
+                CircuitBreaker(
+                    self._breaker_threshold, self._breaker_cooldown_s
+                ),
+            )
 
     def active_workers(self) -> List:
         with self._lock:
@@ -53,9 +121,37 @@ class NodeManager:
                 if n.state == "active"
             ]
 
+    def schedulable_workers(self) -> List:
+        """Active workers whose breaker is closed — the set FTE
+        placement and new launches draw from. Graylisted (open/half-
+        open) nodes stay out until a heartbeat probe succeeds."""
+        with self._lock:
+            return [
+                n.handle
+                for n in self._nodes.values()
+                if n.state == "active" and not n.breaker.is_open
+            ]
+
     def all_states(self) -> Dict[str, str]:
         with self._lock:
             return {k: n.state for k, n in self._nodes.items()}
+
+    def breaker_states(self) -> Dict[str, str]:
+        with self._lock:
+            return {k: n.breaker.state for k, n in self._nodes.items()}
+
+    # -- error-tracker listener protocol (destination == worker_id) --
+    def report_failure(self, destination: str) -> None:
+        with self._lock:
+            n = self._nodes.get(destination)
+        if n is not None:
+            n.breaker.record_failure()
+
+    def report_success(self, destination: str) -> None:
+        with self._lock:
+            n = self._nodes.get(destination)
+        if n is not None:
+            n.breaker.record_success()
 
     # -- heartbeat loop (HeartbeatFailureDetector.ping:350) --
     def start(self) -> None:
@@ -74,10 +170,12 @@ class NodeManager:
         with self._lock:
             nodes = list(self._nodes.values())
         for n in nodes:
+            n.breaker.mark_probing()
             try:
                 status = n.handle.status()
                 n.failure_rate *= self.DECAY
                 n.last_seen = time.monotonic()
+                n.breaker.record_success()
                 reported = status.get("state", "active")
                 if n.state != "failed" or n.failure_rate < self.FAIL_THRESHOLD:
                     n.state = (
@@ -87,5 +185,6 @@ class NodeManager:
                     )
             except Exception:
                 n.failure_rate = n.failure_rate * self.DECAY + (1 - self.DECAY)
+                n.breaker.record_failure()
                 if n.failure_rate >= self.FAIL_THRESHOLD:
                     n.state = "failed"

@@ -14,17 +14,25 @@ import threading
 from typing import Callable, List, Optional
 
 from trino_tpu.exec.serde import Page
+from trino_tpu.runtime.error_tracker import (
+    REQUEST_STATS,
+    RequestErrorTracker,
+    RetryPolicy,
+)
 
 # fetch(partition, token, max_pages, wait) -> (pages, next_token, complete)
 Fetch = Callable[[int, int, int, float], tuple]
 
 
 class ExchangeLocation:
-    """One producer task's result partition."""
+    """One producer task's result partition. `destination` labels the
+    producer for error tracking (per-destination budgets/stats)."""
 
-    def __init__(self, fetch: Fetch, partition: int):
+    def __init__(self, fetch: Fetch, partition: int,
+                 destination: Optional[str] = None):
         self.fetch = fetch
         self.partition = partition
+        self.destination = destination or f"exchange:{id(fetch):x}"
 
 
 class DirectExchangeClient:
@@ -37,8 +45,14 @@ class DirectExchangeClient:
         locations: List[ExchangeLocation],
         max_buffered_pages: int = 64,
         long_poll_s: float = 0.5,
+        retry_policy: Optional[RetryPolicy] = None,
+        retry_seed: Optional[int] = None,
+        failure_listener=None,
     ):
         self._locations = list(locations)
+        self._retry_policy = retry_policy or RetryPolicy()
+        self._retry_seed = retry_seed
+        self._failure_listener = failure_listener
         self._queue: List[Page] = []
         self._lock = threading.Condition()
         self._open = 0
@@ -55,7 +69,16 @@ class DirectExchangeClient:
             t.start()
 
     def _pull_loop(self, loc: ExchangeLocation) -> None:
+        # Retrying here is safe because the token only advances on a
+        # successful fetch: a replayed request re-reads un-acked pages,
+        # so transient fetch loss never drops or duplicates a page. Once
+        # the tracker's budget is spent, RequestFailedError surfaces via
+        # poll() and the CONSUMING task fails (FTE re-places it).
         token = 0
+        tracker = RequestErrorTracker(
+            loc.destination, self._retry_policy, seed=self._retry_seed,
+            listener=self._failure_listener,
+        )
         try:
             while not self._closed:
                 with self._lock:
@@ -66,9 +89,16 @@ class DirectExchangeClient:
                         self._lock.wait(timeout=0.1)
                     if self._closed:
                         return
-                pages, token, complete = loc.fetch(
-                    loc.partition, token, 16, self._long_poll_s
-                )
+                try:
+                    pages, token, complete = loc.fetch(
+                        loc.partition, token, 16, self._long_poll_s
+                    )
+                except BaseException as e:
+                    REQUEST_STATS.record(loc.destination, ok=False)
+                    tracker.on_failure(e)  # sleeps, or raises when spent
+                    continue
+                REQUEST_STATS.record(loc.destination, ok=True)
+                tracker.on_success()
                 if pages:
                     with self._lock:
                         self._queue.extend(pages)

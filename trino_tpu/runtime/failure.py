@@ -4,8 +4,13 @@ Analogue of main/execution/FailureInjector.java:40 (injected per
 (stage, partition, attempt); types incl. TASK_FAILURE and request
 failures — SURVEY.md §5.3, BaseFailureRecoveryTest.java:53). The
 injector lives on the Worker; TaskExecution consults it at task start
-("start") and after the first output page ("mid") so retries exercise
-both the nothing-produced and partially-produced paths.
+("start"), after the first output page ("mid"), and per exchange page
+pull ("fetch") so retries exercise the nothing-produced, partially-
+produced, and lost-fetch paths. Rules carry a failure KIND so the chaos
+harness (runtime/chaos.py) can map fault classes onto the error surface
+each one exercises: a crash is a generic task failure, fetch loss is a
+transient network error the retry layer absorbs, an OOM is a memory-
+classed failure that grows the partition memory estimate on retry.
 """
 
 from __future__ import annotations
@@ -24,11 +29,29 @@ class FailureRule:
     fragment_id: Optional[int] = None  # None = any
     partition: Optional[int] = None
     attempts: Tuple[int, ...] = (0,)  # which attempt numbers fail
-    where: str = "start"  # "start" | "mid"
+    where: str = "start"  # "start" | "mid" | "fetch"
     max_hits: int = 1_000_000
     # straggler simulation: sleep this long instead of raising
     # (drives the speculative-execution path in tests)
     stall_s: float = 0.0
+    # failure surface: "crash" raises InjectedFailure (task failure),
+    # "fetch_loss" raises ConnectionError (transient, absorbed by the
+    # exchange retry loop), "oom" raises ExceededMemoryLimitError
+    # (memory-classed: the FTE estimator doubles before the retry)
+    kind: str = "crash"
+
+    def raise_failure(self, task_id, where: str) -> None:
+        if self.kind == "fetch_loss":
+            raise ConnectionError(
+                f"injected fetch loss at {task_id}"
+            )
+        if self.kind == "oom":
+            from trino_tpu.runtime.memory import ExceededMemoryLimitError
+
+            raise ExceededMemoryLimitError(
+                f"injected out-of-memory at {task_id}"
+            )
+        raise InjectedFailure(f"injected {where} failure at {task_id}")
 
 
 class FailureInjector:
@@ -67,9 +90,7 @@ class FailureInjector:
                 if r.stall_s > 0:
                     stall = r.stall_s
                     break  # sleep outside the lock
-                raise InjectedFailure(
-                    f"injected {where} failure at {task_id}"
-                )
+                r.raise_failure(task_id, where)
             else:
                 return
         import time

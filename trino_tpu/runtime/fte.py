@@ -44,6 +44,7 @@ class FaultTolerantQueryScheduler:
         hash_partitions: Optional[int] = None,
         max_task_retries: int = 3,
         active_workers_fn=None,
+        node_manager=None,
     ):
         self.query_id = query_id
         self.subplan = subplan
@@ -53,7 +54,20 @@ class FaultTolerantQueryScheduler:
         self.spool_dir = spool_dir
         self.hash_partitions = hash_partitions or min(len(workers), 4)
         self.max_task_retries = max_task_retries
-        self._active_fn = active_workers_fn or (lambda: self.workers)
+        self.node_manager = node_manager
+        if active_workers_fn is not None:
+            self._active_fn = active_workers_fn
+        elif node_manager is not None:
+            # circuit-breaker-aware placement: graylisted workers get no
+            # launches while their breaker is open; if EVERY node is
+            # graylisted, fall back to the active set rather than starve
+            # (trying a gray node beats failing the query outright)
+            self._active_fn = (
+                lambda: node_manager.schedulable_workers()
+                or node_manager.active_workers()
+            )
+        else:
+            self._active_fn = lambda: self.workers
         self._schemas: Dict[int, list] = {}
         # (fragment, partition) -> committed task key
         self.committed: Dict[Tuple[int, int], str] = {}
@@ -65,7 +79,7 @@ class FaultTolerantQueryScheduler:
             PartitionMemoryEstimator,
         )
 
-        self.allocator = BinPackingNodeAllocator()
+        self.allocator = BinPackingNodeAllocator(node_manager=node_manager)
         self.estimator = PartitionMemoryEstimator()
         # straggler mitigation: duplicate attempts for tasks running far
         # beyond the stage's median; first finisher commits
@@ -74,6 +88,20 @@ class FaultTolerantQueryScheduler:
             session, "enable_speculative_execution", True
         )
         self.speculative_hits = 0
+
+    def _report(self, handle, ok: bool) -> None:
+        """Feed the node's circuit breaker: in-process handles have no
+        HTTP layer reporting for them, so the scheduler reports its own
+        control-plane outcomes (launches, state polls)."""
+        if self.node_manager is None:
+            return
+        wid = getattr(handle, "worker_id", None)
+        if wid is None:
+            return
+        if ok:
+            self.node_manager.report_success(wid)
+        else:
+            self.node_manager.report_failure(wid)
 
     # scheduling is stage-by-stage: children complete before parents run
     def run(self) -> Tuple[object, str]:
@@ -163,7 +191,9 @@ class FaultTolerantQueryScheduler:
                 handle.create_task(spec)
             except Exception as exc:
                 self.allocator.release(handle, est_bytes)
+                self._report(handle, ok=False)
                 raise _LaunchFailed(handle, exc)
+            self._report(handle, ok=True)
             return (handle, str(task_id), attempt, time.monotonic(), est_bytes)
 
         def settle(p: int, winner, losers):
@@ -213,7 +243,9 @@ class FaultTolerantQueryScheduler:
                     handle, tid, attempt, t0, est = entry
                     try:
                         st = handle.task_state(tid)
+                        self._report(handle, ok=True)
                     except Exception as e:
+                        self._report(handle, ok=False)
                         st = {
                             "state": "failed",
                             "failure": f"worker unreachable: {e}",

@@ -247,14 +247,29 @@ class WorkerServer:
 
 class HttpWorkerClient:
     """Coordinator-side proxy for a remote worker (HttpRemoteTask +
-    ContinuousTaskStatusFetcher collapsed into synchronous calls with
-    retry/backoff in RequestErrorTracker style)."""
+    ContinuousTaskStatusFetcher collapsed into synchronous calls).
+
+    Every call runs a RequestErrorTracker retry loop
+    (runtime/error_tracker.py): transient failures back off with jitter
+    until the per-destination error budget or hard deadline is spent,
+    then the call raises RequestFailedError — the caller fails the TASK
+    (FTE re-places it), never the query. The tracker is safe here
+    because every endpoint is idempotent: create_task re-delivers by
+    task id, results are pulled with an advancing ack token, and DELETE
+    is a no-op on a missing task. `failure_listener` (e.g. a
+    NodeManager) hears every success/failure for circuit-breaker
+    accounting."""
 
     def __init__(self, uri: str, timeout: float = 30.0,
-                 internal_secret: Optional[str] = "__env__"):
+                 internal_secret: Optional[str] = "__env__",
+                 retry_policy=None, failure_listener=None):
         self.uri = uri.rstrip("/")
         self.timeout = timeout
         self.worker_id = uri
+        # None = "not explicitly chosen": the coordinator may bind the
+        # session's request_max_error_duration_s onto it at registration
+        self.retry_policy = retry_policy
+        self.failure_listener = failure_listener
         self._auth = None
         if internal_secret == "__env__":
             internal_secret = default_internal_secret()
@@ -272,28 +287,50 @@ class HttpWorkerClient:
         )
         return urllib.request.urlopen(req, timeout=self.timeout)
 
+    def _retrying(self, fn):
+        from trino_tpu.runtime.error_tracker import (
+            RetryPolicy,
+            run_with_retry,
+        )
+
+        return run_with_retry(
+            self.uri, fn, policy=self.retry_policy or RetryPolicy(),
+            listener=self.failure_listener,
+        )
+
     def create_task(self, spec) -> str:
         body = codec.dumps(spec)
-        with self._req("POST", f"/v1/task/{spec.task_id}", body) as r:
-            out = json.loads(r.read())
+
+        def go():
+            with self._req("POST", f"/v1/task/{spec.task_id}", body) as r:
+                return json.loads(r.read())
+
+        out = self._retrying(go)
         if "error" in out:
             raise RuntimeError(out["error"])
         return out["task_id"]
 
     def task_state(self, task_id) -> dict:
-        with self._req("GET", f"/v1/task/{task_id}/status") as r:
-            return json.loads(r.read())
+        def go():
+            with self._req("GET", f"/v1/task/{task_id}/status") as r:
+                return json.loads(r.read())
+
+        return self._retrying(go)
 
     def get_results(
         self, task_id, partition: int, token: int,
         max_pages: int = 16, wait: float = 0.0,
     ) -> Tuple[List[Page], int, bool]:
         path = f"/v1/task/{task_id}/results/{partition}/{token}?wait={wait}"
-        with self._req("GET", path) as r:
-            data = r.read()
-            next_token = int(r.headers["X-Next-Token"])
-            complete = r.headers["X-Complete"] == "1"
-        return unpack_pages(data), next_token, complete
+
+        def go():
+            with self._req("GET", path) as r:
+                data = r.read()
+                next_token = int(r.headers["X-Next-Token"])
+                complete = r.headers["X-Complete"] == "1"
+            return unpack_pages(data), next_token, complete
+
+        return self._retrying(go)
 
     def remove_task(self, task_id) -> None:
         try:
@@ -307,6 +344,9 @@ class HttpWorkerClient:
         return ("http", self.uri, str(task_id))
 
     def status(self) -> dict:
+        # heartbeat probe: NO retry loop — the failure detector wants to
+        # see every miss, and a probe that silently retries for 30s
+        # would stall the ping loop behind one dead node
         with self._req("GET", "/v1/status") as r:
             return json.loads(r.read())
 
@@ -314,10 +354,11 @@ class HttpWorkerClient:
         self._req("PUT", "/v1/shutdown").close()
 
 
-def http_fetch(uri: str, task_id: str):
+def http_fetch(uri: str, task_id: str, retry_policy=None):
     """Location descriptor -> fetch callable for TaskSpec.input_locations
-    (the HttpPageBufferClient pull side)."""
-    client = HttpWorkerClient(uri)
+    (the HttpPageBufferClient pull side). Worker-to-worker page pulls
+    carry the same retry/backoff discipline as coordinator calls."""
+    client = HttpWorkerClient(uri, retry_policy=retry_policy)
 
     def fetch(partition: int, token: int, max_pages: int, wait: float):
         return client.get_results(task_id, partition, token, max_pages, wait)

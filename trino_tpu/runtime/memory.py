@@ -10,11 +10,20 @@ host/disk through the spiller, the HBM->DRAM/SSD eviction path.
 
 Simplifications kept honest: reservation is synchronous (reserve either
 fits, triggers revocation, or raises ExceededMemoryLimitError — the
-blocked-future form arrives with async drivers)."""
+blocked-future form arrives with async drivers).
+
+PR2 adds the cluster dimension (ClusterMemoryManager.java +
+LowMemoryKiller, SURVEY.md §5.4): pools keep a per-query reservation
+ledger; on exhaustion — AFTER revocation/spill failed to make room — an
+installed exhaustion handler may kill the single query with the largest
+cluster-wide reservation (doomed queries fail their next reservation
+with the kill message) so one runaway query dies with a query-level
+ExceededMemoryLimitError instead of the worker failing everyone."""
 
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Dict, List, Optional
 
 
@@ -34,6 +43,13 @@ class MemoryPool:
         # context id -> (revocable bytes, revoke callback)
         self._revocable: Dict[int, tuple] = {}
         self._next_id = 0
+        # per-query ledger (query_id -> bytes) for the low-memory killer
+        self._by_query: Dict[str, int] = {}
+        # query_id -> kill message; doomed queries fail reservations
+        self._doomed: Dict[str, str] = {}
+        # ClusterMemoryManager hook: handler(pool, bytes_, query_id) ->
+        # bool (True = a kill was issued, retry the reservation)
+        self.exhaustion_handler = None
 
     @property
     def reserved_bytes(self) -> int:
@@ -42,21 +58,46 @@ class MemoryPool:
     def free_bytes(self) -> int:
         return self.max_bytes - self._reserved
 
-    def try_reserve(self, bytes_: int) -> bool:
+    def query_reservations(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._by_query)
+
+    def doom_query(self, query_id: str, message: str) -> None:
+        """Mark a query dead-on-next-reservation: its operator threads
+        unwind with ExceededMemoryLimitError(message) at their next
+        set_bytes, freeing their reservations on context close."""
+        with self._lock:
+            self._doomed[query_id] = message
+
+    def _check_doomed(self, query_id: Optional[str]) -> None:
+        if query_id is None:
+            return
+        with self._lock:
+            msg = self._doomed.get(query_id)
+        if msg is not None:
+            raise ExceededMemoryLimitError(msg)
+
+    def try_reserve(self, bytes_: int, query_id: Optional[str] = None) -> bool:
         with self._lock:
             if self._reserved + bytes_ > self.max_bytes:
                 return False
             self._reserved += bytes_
+            if query_id is not None:
+                self._by_query[query_id] = (
+                    self._by_query.get(query_id, 0) + bytes_
+                )
             return True
 
-    def reserve(self, bytes_: int, for_ctx: Optional[int] = None) -> None:
+    def reserve(self, bytes_: int, for_ctx: Optional[int] = None,
+                query_id: Optional[str] = None) -> None:
         """Reserve, revoking others' revocable memory if needed
         (MemoryRevokingScheduler's revoke-largest-first policy). A victim
         whose callback does not actually lower its registered revocable
         bytes is skipped on later rounds — re-picking it would spin
         forever (a revoke can legitimately no-op, e.g. an operator whose
         state just became non-spillable)."""
-        if self.try_reserve(bytes_):
+        self._check_doomed(query_id)
+        if self.try_reserve(bytes_, query_id):
             return
         # revoke largest revocable contexts until it fits
         unhelpful: set = set()
@@ -71,23 +112,36 @@ class MemoryPool:
                 break
             cid, rb, cb = max(candidates, key=lambda t: t[1])
             cb()  # operator spills and releases its revocable bytes
-            if self.try_reserve(bytes_):
+            if self.try_reserve(bytes_, query_id):
                 return
             with self._lock:
                 rb_after = self._revocable.get(cid, (0, None))[0]
             if rb_after >= rb:
                 unhelpful.add(cid)
-        if self.try_reserve(bytes_):
+        if self.try_reserve(bytes_, query_id):
             return
+        # revocation could not make room: escalate to the cluster
+        # manager (kill-largest), which may doom THIS query
+        handler = self.exhaustion_handler
+        if handler is not None and handler(self, bytes_, query_id):
+            self._check_doomed(query_id)
+            if self.try_reserve(bytes_, query_id):
+                return
         raise ExceededMemoryLimitError(
             f"cannot reserve {bytes_} bytes "
             f"(reserved {self._reserved}/{self.max_bytes})"
         )
 
-    def free(self, bytes_: int) -> None:
+    def free(self, bytes_: int, query_id: Optional[str] = None) -> None:
         with self._lock:
             self._reserved -= bytes_
             assert self._reserved >= 0, "double free in memory pool"
+            if query_id is not None:
+                left = self._by_query.get(query_id, 0) - bytes_
+                if left > 0:
+                    self._by_query[query_id] = left
+                else:
+                    self._by_query.pop(query_id, None)
 
     # -- revocable registry --
     def register_revocable(self, revoke: Callable[[], None]) -> int:
@@ -112,8 +166,10 @@ class MemoryContext:
     setBytes semantics — the operator reports its current footprint and
     the delta hits the pool."""
 
-    def __init__(self, pool: MemoryPool, revoke: Optional[Callable[[], None]] = None):
+    def __init__(self, pool: MemoryPool, revoke: Optional[Callable[[], None]] = None,
+                 query_id: Optional[str] = None):
         self.pool = pool
+        self.query_id = query_id
         self._bytes = 0
         self._revocable_bytes = 0
         self._cid = (
@@ -133,9 +189,9 @@ class MemoryContext:
     def set_bytes(self, bytes_: int) -> None:
         delta = bytes_ - self._bytes
         if delta > 0:
-            self.pool.reserve(delta, for_ctx=self._cid)
+            self.pool.reserve(delta, for_ctx=self._cid, query_id=self.query_id)
         elif delta < 0:
-            self.pool.free(-delta)
+            self.pool.free(-delta, query_id=self.query_id)
         self._bytes = bytes_
 
     def set_revocable_bytes(self, bytes_: int) -> None:
@@ -149,6 +205,83 @@ class MemoryContext:
         self.set_bytes(0)
         if self._cid is not None:
             self.pool.unregister_revocable(self._cid)
+
+
+class LowMemoryKiller:
+    """Victim-selection policy under cluster memory exhaustion: kill the
+    query with the LARGEST total reservation across all pools (the
+    reference's TotalReservationLowMemoryKiller — predictable, and the
+    biggest query is the one whose death frees the most room). Ties
+    break on query id for determinism."""
+
+    def pick_victim(self, totals: Dict[str, int]) -> Optional[str]:
+        if not totals:
+            return None
+        return max(totals.items(), key=lambda kv: (kv[1], kv[0]))[0]
+
+
+class ClusterMemoryManager:
+    """Coordinator-side memory arbiter (ClusterMemoryManager.java:103).
+
+    Installed as the exhaustion_handler on every worker pool. When a
+    reservation still cannot fit after revocation/spill, it aggregates
+    the per-query ledgers across pools, picks ONE victim via the
+    LowMemoryKiller, dooms it in every pool (so all its operator threads
+    unwind with the kill message), tells the coordinator to fail the
+    query, then waits a bounded time for the victim's frees before the
+    requester retries. Only the victim dies; every other query — and the
+    worker itself — keeps running."""
+
+    def __init__(self, pools: List[MemoryPool], fail_query=None,
+                 killer: Optional[LowMemoryKiller] = None,
+                 wait_s: float = 5.0, poll_s: float = 0.01):
+        self.pools = list(pools)
+        self._fail_query = fail_query  # fail_query(query_id, message)
+        self.killer = killer or LowMemoryKiller()
+        self.wait_s = wait_s
+        self.poll_s = poll_s
+        self._lock = threading.Lock()
+        self.kills: List[str] = []  # observability / chaos assertions
+
+    def install(self) -> None:
+        for p in self.pools:
+            p.exhaustion_handler = self._on_exhaustion
+
+    def cluster_reservations(self) -> Dict[str, int]:
+        totals: Dict[str, int] = {}
+        for p in self.pools:
+            for q, b in p.query_reservations().items():
+                totals[q] = totals.get(q, 0) + b
+        return totals
+
+    def _on_exhaustion(self, pool: MemoryPool, bytes_: int,
+                       query_id: Optional[str]) -> bool:
+        with self._lock:  # one kill decision at a time
+            totals = self.cluster_reservations()
+            victim = self.killer.pick_victim(totals)
+            if victim is None:
+                return False
+            message = (
+                f"Query {victim} killed by the low-memory killer: cluster "
+                f"out of memory (victim held {totals[victim]} bytes, "
+                f"request was {bytes_} bytes)"
+            )
+            for p in self.pools:
+                p.doom_query(victim, message)
+            self.kills.append(victim)
+            if self._fail_query is not None:
+                try:
+                    self._fail_query(victim, message)
+                except Exception:
+                    pass  # the doom marks still unwind the victim
+        if victim == query_id:
+            return True  # requester IS the victim: retry raises the kill
+        deadline = time.monotonic() + self.wait_s
+        while time.monotonic() < deadline:
+            if pool.free_bytes() >= bytes_:
+                break
+            time.sleep(self.poll_s)
+        return True
 
 
 def batch_bytes(batch) -> int:

@@ -24,13 +24,30 @@ import threading
 from typing import Dict, List, Optional, Sequence
 
 
+def _drop_graylisted(active: Sequence, node_manager) -> List:
+    """Filter out workers whose circuit breaker is open (graylist,
+    runtime/discovery.py). All-gray degrades to the full set — placing
+    on a suspect node beats starving the query."""
+    if node_manager is None:
+        return list(active)
+    try:
+        ok = {id(h) for h in node_manager.schedulable_workers()}
+    except Exception:
+        return list(active)
+    filtered = [h for h in active if id(h) in ok]
+    return filtered or list(active)
+
+
 class UniformNodeSelector:
     """Pick the active node with the fewest running tasks; nodes at the
     cap are skipped (all-at-cap falls back to global least-loaded, the
-    reference's best-effort under full cluster)."""
+    reference's best-effort under full cluster). With a `node_manager`,
+    graylisted (open-breaker) nodes are excluded from every tier."""
 
-    def __init__(self, max_tasks_per_node: Optional[int] = None):
+    def __init__(self, max_tasks_per_node: Optional[int] = None,
+                 node_manager=None):
         self.max_tasks_per_node = max_tasks_per_node
+        self.node_manager = node_manager
         # local assignment ledger: placements increment locally; each
         # handle's remote status() is probed ONCE (its pre-existing
         # load), not per placement — a slow worker must not serialize
@@ -65,6 +82,8 @@ class UniformNodeSelector:
     def select(self, active: Sequence, preferred: Sequence = ()) -> object:
         if not active:
             raise RuntimeError("no active workers")
+        active = _drop_graylisted(active, self.node_manager)
+        preferred = [h for h in preferred if h in active]
         with self._lock:
             for pool in (list(preferred), list(active)):
                 if not pool:
@@ -99,8 +118,9 @@ class TopologyAwareNodeSelector(UniformNodeSelector):
     the least-loaded policy of the parent class."""
 
     def __init__(self, locations: Dict[int, str],
-                 max_tasks_per_node: Optional[int] = None):
-        super().__init__(max_tasks_per_node)
+                 max_tasks_per_node: Optional[int] = None,
+                 node_manager=None):
+        super().__init__(max_tasks_per_node, node_manager=node_manager)
         # id(handle) -> "rack/host" (or bare "host")
         self._locations = dict(locations)
 
@@ -112,6 +132,7 @@ class TopologyAwareNodeSelector(UniformNodeSelector):
                location: Optional[str] = None) -> object:
         if location is None:
             return super().select(active, preferred)
+        active = _drop_graylisted(active, self.node_manager)
         same_host = [
             h for h in active
             if self._locations.get(id(h)) == location
@@ -159,10 +180,12 @@ class BinPackingNodeAllocator:
 
     DEFAULT_NODE_BYTES = 1 << 30
 
-    def __init__(self, capacity_fn=None):
+    def __init__(self, capacity_fn=None, node_manager=None):
         """capacity_fn(handle) -> node budget in bytes (defaults to the
-        handle's memory pool size, else DEFAULT_NODE_BYTES)."""
+        handle's memory pool size, else DEFAULT_NODE_BYTES). With a
+        `node_manager`, graylisted nodes are excluded from packing."""
         self._capacity_fn = capacity_fn or self._default_capacity
+        self.node_manager = node_manager
         self._used: Dict[int, float] = {}
         self._lock = threading.Lock()
 
@@ -179,6 +202,7 @@ class BinPackingNodeAllocator:
         self, active: Sequence, estimated_bytes: int,
         avoid: Optional[object] = None,
     ) -> object:
+        active = _drop_graylisted(active, self.node_manager)
         candidates = [h for h in active if h is not avoid] or list(active)
         if not candidates:
             raise RuntimeError("no active workers")

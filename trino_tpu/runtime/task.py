@@ -177,13 +177,31 @@ class TaskExecution:
             c.close()
 
     # -- execution --
+    def _injected_fetch(self, fetch):
+        """Chaos hook: the injector is consulted per exchange fetch (the
+        "fetch" site) so fault schedules can drop a bounded number of
+        page pulls — absorbed by the exchange client's retry loop."""
+
+        def wrapped(partition, token, max_pages, wait):
+            self._injector.check(self.spec.task_id, "fetch")
+            return fetch(partition, token, max_pages, wait)
+
+        return wrapped
+
     def _make_remote_source(self, fragment_ids) -> DirectExchangeClient:
         locations = []
         my_partition = self.spec.task_id.partition
         for fid in fragment_ids:
-            for loc in self.spec.input_locations.get(fid, []):
+            for i, loc in enumerate(self.spec.input_locations.get(fid, [])):
+                fetch = _resolve_fetch(loc)
+                if self._injector is not None:
+                    fetch = self._injected_fetch(fetch)
+                dest = (
+                    f"{loc[0]}:{loc[1]}" if isinstance(loc, tuple)
+                    else f"local:f{fid}.{i}"
+                )
                 locations.append(
-                    ExchangeLocation(_resolve_fetch(loc), my_partition)
+                    ExchangeLocation(fetch, my_partition, destination=dest)
                 )
         client = DirectExchangeClient(locations)
         self._clients.append(client)
@@ -191,6 +209,10 @@ class TaskExecution:
 
     def _run(self) -> None:
         spec = self.spec
+        ctx: dict = {
+            "make_remote_source": self._make_remote_source,
+            "query_id": spec.task_id.query_id,
+        }
         try:
             if self._injector is not None:
                 self._injector.check(spec.task_id, "start")
@@ -203,7 +225,6 @@ class TaskExecution:
                 dynamic_filtering=spec.dynamic_filtering,
             )
             physical = planner.plan(spec.fragment.root)
-            ctx = {"make_remote_source": self._make_remote_source}
             if self._memory_pool is not None:
                 ctx["memory_pool"] = self._memory_pool
             pipelines, chain = physical.instantiate(ctx)
@@ -240,13 +261,25 @@ class TaskExecution:
         except BaseException as e:
             # full traceback, not just the message: TaskInfo failures
             # travel to the coordinator and are the only evidence a
-            # remote crash leaves behind (TaskStatus.getFailures)
-            self.failure = "".join(
-                traceback.format_exception(type(e), e, e.__traceback__)
-            ).strip()
+            # remote crash leaves behind (TaskStatus.getFailures). An
+            # externally-killed task already carries its verdict (the
+            # low-memory killer's message) — don't overwrite it with the
+            # TaskAbortedError unwind.
+            if self.failure is None:
+                self.failure = "".join(
+                    traceback.format_exception(type(e), e, e.__traceback__)
+                ).strip()
             self.state = "failed"
             self.buffer.abort()
         finally:
+            # release every operator reservation: on a SHARED worker
+            # pool a failed/killed task would otherwise leak its bytes
+            # and poison the pool for every later query
+            for mc in ctx.get("memory_contexts", ()):
+                try:
+                    mc.close()
+                except Exception:
+                    pass
             for c in self._clients:
                 c.close()
 
@@ -266,8 +299,14 @@ class TaskExecution:
             LocalExchangeSourceOperator,
         )
 
+        def stop() -> bool:
+            # fail_query / abort flip the state machine externally; the
+            # driver polls it at batch boundaries so a killed task stops
+            # instead of grinding through grace-join spill work
+            return self._state_machine.get() in ("aborted", "failed")
+
         def drive(p):
-            Driver(p).run()
+            Driver(p, should_stop=stop).run()
 
         # build pipelines run SEQUENTIALLY: the local planner emits them
         # in dependency order (a join-on-join build side embeds the

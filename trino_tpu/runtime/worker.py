@@ -88,6 +88,28 @@ class Worker:
         for t in tasks:
             t.abort()
 
+    def fail_query(self, query_id: str, message: str) -> None:
+        """Low-memory-killer entry point: mark every task of the query
+        FAILED with the kill message (so the coordinator's poll sees a
+        query-level memory error, not a vanished task) and abort their
+        buffers to unblock consumers. Tasks stay registered until
+        remove_task/abort_query — status must remain readable."""
+        with self._lock:
+            tasks = [
+                t for k, t in self._tasks.items()
+                if k.startswith(query_id + ".")
+            ]
+        for t in tasks:
+            if t.state in ("finished", "failed", "aborted"):
+                continue
+            t.failure = message
+            t.state = "failed"
+            # terminal states latch, so abort() keeps the "failed"
+            # verdict while tearing down the buffer AND the task's
+            # exchange clients — unblocking its thread so the doomed
+            # query stops burning cycles quickly
+            t.abort()
+
     def task_ids(self) -> List[str]:
         with self._lock:
             return list(self._tasks)

@@ -52,13 +52,18 @@ Factory = Callable[[dict], Operator]
 
 def _mem_ctx(ctx: dict):
     """Per-operator MemoryContext when the execution context carries a
-    pool (OperatorContext.newLocalUserMemoryContext analogue)."""
+    pool (OperatorContext.newLocalUserMemoryContext analogue). Contexts
+    carry the query id (the pool's per-query kill ledger) and register
+    in ctx["memory_contexts"] so task teardown can close them — on a
+    SHARED worker pool a failed task must not leak its reservation."""
     pool = ctx.get("memory_pool")
     if pool is None:
         return None
     from trino_tpu.runtime.memory import MemoryContext
 
-    return MemoryContext(pool)
+    mc = MemoryContext(pool, query_id=ctx.get("query_id"))
+    ctx.setdefault("memory_contexts", []).append(mc)
+    return mc
 
 
 class PhysicalPlan:

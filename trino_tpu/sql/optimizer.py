@@ -1990,16 +1990,40 @@ def _canonicalize_join(n: P.JoinNode) -> P.PlanNode:
     return P.ProjectNode(j, exprs, n.fields)
 
 
+def _canonicalize_window(n: P.WindowNode) -> P.PlanNode:
+    cf = n.child.fields
+    need: List[int] = []
+    for c in n.partition_channels:
+        if _is_tstz(cf[c].type) and c not in need:
+            need.append(c)
+    if not need:
+        return n
+    # partition on the zone-masked copies appended below; function args
+    # and order keys keep their original (unshifted) channels
+    below, pos = _tstz_side_project(n.child, need)
+    parts = tuple(pos.get(c, c) for c in n.partition_channels)
+    n_funcs = len(n.fields) - len(cf)
+    wfields = below.fields + n.fields[len(cf):]
+    w = dataclasses.replace(
+        n, child=below, partition_channels=parts, fields=wfields
+    )
+    # project above drops the masked copies, restoring the schema
+    base = len(below.fields)
+    sel = tuple(range(len(cf))) + tuple(base + i for i in range(n_funcs))
+    exprs = tuple(ir.InputRef(i, wfields[i].type) for i in sel)
+    return P.ProjectNode(w, exprs, n.fields)
+
+
 def canonicalize_tstz_keys(root: P.PlanNode) -> P.PlanNode:
     """Correctness pass, applied to every plan even when the optimizer
     is off: timestamptz packs millis<<12 | zoneKey, but SQL equality is
     instant-only, so GROUP BY / JOIN / DISTINCT must key on the instant
     and never the zone bits (the reference keys on
     LongTimestampWithTimeZone.getEpochMillis()). Rewrites tstz-keyed
-    aggregations and joins to key on a zone-masked copy appended by a
-    Project below; for group keys an any() aggregate preserves one
-    original packed value per group as the rendered representative, and
-    a Project above restores the original schema."""
+    aggregations, joins, and window PARTITION BY to key on a zone-masked
+    copy appended by a Project below; for group keys an any() aggregate
+    preserves one original packed value per group as the rendered
+    representative, and a Project above restores the original schema."""
     kids = [canonicalize_tstz_keys(c) for c in root.children()]
     if any(a is not b for a, b in zip(kids, root.children())):
         if isinstance(root, P.JoinNode):
@@ -2012,4 +2036,6 @@ def canonicalize_tstz_keys(root: P.PlanNode) -> P.PlanNode:
         return _canonicalize_agg(root)
     if isinstance(root, P.JoinNode):
         return _canonicalize_join(root)
+    if isinstance(root, P.WindowNode):
+        return _canonicalize_window(root)
     return root
