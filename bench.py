@@ -758,10 +758,15 @@ def _chaos_smoke(argv) -> int:
         seed = int(argv[i + 1])
     except (IndexError, ValueError):
         seed = 42
-    from trino_tpu.runtime.chaos import FAULT_CLASSES, chaos_smoke
+    from trino_tpu.runtime.chaos import (
+        FAULT_CLASSES,
+        LIFECYCLE_CLASSES,
+        chaos_smoke,
+    )
 
     print(f"bench: chaos smoke seed={seed} "
-          f"fault_classes={','.join(FAULT_CLASSES)}")
+          f"fault_classes={','.join(FAULT_CLASSES)} "
+          f"lifecycle={','.join(LIFECYCLE_CLASSES)}")
     t0 = time.time()
     violations = chaos_smoke(seed, CHAOS_QUERIES)
     wall = time.time() - t0
@@ -770,7 +775,8 @@ def _chaos_smoke(argv) -> int:
     print(json.dumps({
         "chaos_smoke": {
             "seed": seed,
-            "cases": len(CHAOS_QUERIES) * len(FAULT_CLASSES),
+            "cases": len(CHAOS_QUERIES) * len(FAULT_CLASSES)
+            + len(LIFECYCLE_CLASSES),
             "violations": len(violations),
             "wall_s": round(wall, 2),
         }

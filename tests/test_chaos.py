@@ -9,6 +9,7 @@ own deterministic tests (no background heartbeat thread — the probe
 loop is driven by explicit ping_once calls)."""
 
 import threading
+import time
 
 import pytest
 
@@ -102,6 +103,261 @@ def test_chaos_soak_tpch(qid, fault_class, harness, oracle):
         rows, expected, ordered=("order by" in sql), abs_tol=1e-2
     )
     assert stats["retries"] <= stats["max_injected_failures"]
+
+
+# -- cluster lifecycle: graceful drain + speculation (PR 3) -----------------
+
+
+def _lifecycle_harness(n: int = 3) -> ChaosHarness:
+    """Drains are one-way (a drained node never rejoins), so every
+    lifecycle test runs on a fresh harness."""
+    h = ChaosHarness(n_workers=n)
+    h.register_catalog("tpch", create_tpch_connector())
+    return h
+
+
+def test_drain_mid_query(oracle):
+    """Gracefully draining a worker mid-query: the query completes with
+    oracle-equal rows (no query-level failure, no duplicates), the
+    drained worker accepts ZERO launches after the drain landed, and the
+    node settles in the `drained` state."""
+    h = _lifecycle_harness()
+    rows, report = h.run_drain_case(Q_JOIN, seed=SEED)
+    expected = sqlite_rows(oracle, to_sqlite(Q_JOIN))
+    assert_rows_match(rows, expected, ordered=True, abs_tol=1e-2)
+    assert all(report["drained"].values()), report
+    assert report["launches_at_end"] == report["launches_at_drain"], report
+    for wid in report["drained"]:
+        assert report["node_states"][wid] == "drained", report
+
+
+def test_drain_all_but_one(oracle):
+    """Draining every worker except one mid-query still converges: the
+    survivor absorbs all remaining work."""
+    h = _lifecycle_harness()
+    rows, report = h.run_drain_case(
+        Q_JOIN, seed=SEED, drain_all_but_one=True
+    )
+    expected = sqlite_rows(oracle, to_sqlite(Q_JOIN))
+    assert_rows_match(rows, expected, ordered=True, abs_tol=1e-2)
+    assert len(report["drained"]) == 2
+    assert all(report["drained"].values()), report
+    assert report["launches_at_end"] == report["launches_at_drain"], report
+    states = report["node_states"]
+    assert sum(1 for s in states.values() if s == "active") == 1, states
+
+
+def test_straggler_speculation_wins(oracle):
+    """A hard-stalled first attempt loses to its speculative duplicate:
+    the win is RECORDED (not just a duplicate launched), rows carry no
+    duplicates, and attempts per partition stay bounded."""
+    h = _lifecycle_harness()
+    rows, stats = h.run_speculation_case(Q_AGG, seed=SEED)
+    expected = sqlite_rows(oracle, to_sqlite(Q_AGG))
+    assert_rows_match(rows, expected, ordered=True, abs_tol=1e-2)
+    assert stats["speculation_wins"] >= 1, stats
+    # stalls cause speculation, not retries; at most one duplicate each
+    assert max(stats["attempts_per_partition"].values()) <= 2, stats
+
+
+def test_speculation_disabled_by_session_property():
+    """speculation_enabled=false: the stalled attempt just runs long —
+    no duplicate is ever launched."""
+    session = Session(
+        catalog="tpch", schema="tiny", retry_policy="task",
+        speculation_enabled=False,
+    )
+    h = ChaosHarness(n_workers=2, session=session)
+    h.register_catalog("tpch", create_tpch_connector())
+    rows, stats = h.run_speculation_case(Q_JOIN, seed=SEED, stall_s=0.6)
+    assert rows
+    assert stats["speculative_hits"] == 0, stats
+
+
+# -- QUERY-level retry (retry_policy=query) ---------------------------------
+
+
+def _retry_cluster():
+    inj = FailureInjector()
+    cats = CatalogManager()
+    cats.register("tpch", create_tpch_connector())
+    workers = [
+        Worker(f"qr-w{i}", cats, failure_injector=inj) for i in range(2)
+    ]
+    return inj, workers
+
+
+def test_query_retry_recovers_where_task_retries_exhausted(oracle):
+    """The acceptance fault: partition 0 of the scan dies on its first
+    FOUR attempts. retry_policy=TASK exhausts its per-task budget and
+    fails; retry_policy=QUERY absorbs the same fault by re-running the
+    whole query (deterministic replay, fresh task namespace) and
+    recovers."""
+    from trino_tpu.runtime.fte import TaskRetriesExceeded
+
+    inj, workers = _retry_cluster()
+    fault = dict(
+        where="start", fragment_id=0, partition=0,
+        attempts=tuple(range(8)), max_hits=4,
+    )
+
+    r_task = DistributedQueryRunner(
+        Session(catalog="tpch", schema="tiny", retry_policy="task",
+                task_retries=3),
+        worker_handles=workers, hash_partitions=2,
+    )
+    r_task.register_catalog("tpch", create_tpch_connector())
+    inj.inject(**fault)
+    with pytest.raises(TaskRetriesExceeded):
+        r_task.execute(Q_JOIN)
+    inj.clear()
+
+    r_query = DistributedQueryRunner(
+        Session(catalog="tpch", schema="tiny", retry_policy="query",
+                query_retry_count=5),
+        worker_handles=workers, hash_partitions=2,
+    )
+    r_query.register_catalog("tpch", create_tpch_connector())
+    inj.inject(**fault)
+    try:
+        rows = r_query.execute(Q_JOIN).rows
+    finally:
+        inj.clear()
+    expected = sqlite_rows(oracle, to_sqlite(Q_JOIN))
+    assert_rows_match(rows, expected, ordered=True, abs_tol=1e-2)
+    # 4 failed whole-query attempts + the clean 5th
+    assert r_query.last_query_attempts == 5
+
+
+def test_query_retry_transparent_to_client_protocol():
+    """An internal whole-query retry is invisible on the client
+    statement protocol: one query id, nextUri polling just sees a
+    longer run, the final page carries the right rows."""
+    import json as _json
+    import urllib.request
+
+    inj, workers = _retry_cluster()
+    runner = DistributedQueryRunner(
+        Session(catalog="tpch", schema="tiny", retry_policy="query",
+                query_retry_count=2),
+        worker_handles=workers, hash_partitions=2,
+    )
+    runner.register_catalog("tpch", create_tpch_connector())
+
+    class _Front:
+        """CoordinatorServer passes `prepared`; the distributed runner
+        doesn't take it — adapt."""
+
+        def execute(self, sql, identity=None, transaction_id=None,
+                    prepared=None):
+            return runner.execute(
+                sql, identity=identity, transaction_id=transaction_id
+            )
+
+    from trino_tpu.runtime.server import CoordinatorServer
+
+    inj.inject(where="start", fragment_id=0, partition=0,
+               attempts=(0,), max_hits=1)
+    srv = CoordinatorServer(_Front(), port=0)
+    try:
+        req = urllib.request.Request(
+            srv.uri + "/v1/statement",
+            data=b"select count(*) from nation", method="POST",
+        )
+        resp = _json.load(urllib.request.urlopen(req, timeout=10))
+        qid = resp["id"]
+        seen_ids = {qid}
+        while "nextUri" in resp:
+            resp = _json.load(
+                urllib.request.urlopen(resp["nextUri"], timeout=10)
+            )
+            seen_ids.add(resp["id"])
+        assert resp["stats"]["state"] == "FINISHED", resp
+        assert resp["data"] == [[25]]
+        assert seen_ids == {qid}
+        assert runner.last_query_attempts == 2  # it DID retry internally
+    finally:
+        srv.stop()
+        inj.clear()
+
+
+# -- worker drain + kill over HTTP ------------------------------------------
+
+
+def test_http_fail_query_endpoint_kills_running_query():
+    """DELETE /v1/query/{id}?reason=... on the worker HTTP surface:
+    every task of the query fails with the kill reason and the
+    coordinator's poll surfaces it as the query-level error."""
+    from trino_tpu.runtime.http import HttpWorkerClient, WorkerServer
+
+    inj = FailureInjector()
+    cats = CatalogManager()
+    cats.register("tpch", create_tpch_connector())
+    w = Worker("kill-w0", cats, failure_injector=inj)
+    srv = WorkerServer(w, require_secret=False)
+    try:
+        handle = HttpWorkerClient(srv.uri)
+        runner = DistributedQueryRunner(
+            Session(catalog="tpch", schema="tiny"),
+            worker_handles=[handle],
+        )
+        runner.register_catalog("tpch", create_tpch_connector())
+        inj.inject(where="start", attempts=(0,), stall_s=5.0, max_hits=1)
+        err = []
+
+        def run():
+            try:
+                runner.execute("select count(*) from nation")
+            except Exception as e:
+                err.append(e)
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 10.0
+        while not w.task_ids() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert w.task_ids(), "query never launched a task"
+        qid = w.task_ids()[0].split(".")[0]
+        handle.fail_query(qid, "killed by test")
+        t.join(30)
+        assert not t.is_alive()
+        assert err, "kill should surface as a query-level failure"
+        assert "killed by test" in str(err[0])
+    finally:
+        srv.stop()
+        inj.clear()
+
+
+def test_http_drain_via_state_api_excludes_worker():
+    """PUT /v1/info/state "SHUTTING_DOWN" (the reference worker-state
+    API) over HTTP: the worker reports shutting_down, the heartbeat
+    settles it to drained, and new queries place zero tasks on it."""
+    from trino_tpu.runtime.http import HttpWorkerClient, WorkerServer
+
+    servers, handles, inner = [], [], []
+    try:
+        for i in range(2):
+            cats = CatalogManager()
+            cats.register("tpch", create_tpch_connector())
+            inner.append(Worker(f"drain-w{i}", cats))
+            servers.append(WorkerServer(inner[-1], require_secret=False))
+            handles.append(HttpWorkerClient(servers[-1].uri))
+        runner = DistributedQueryRunner(
+            Session(catalog="tpch", schema="tiny"),
+            worker_handles=handles, hash_partitions=2,
+        )
+        runner.register_catalog("tpch", create_tpch_connector())
+        handles[0].set_state("SHUTTING_DOWN")
+        assert handles[0].status()["state"] == "shutting_down"
+        runner.node_manager.ping_once()
+        states = runner.node_manager.all_states()
+        assert states[handles[0].worker_id] == "drained", states
+        res = runner.execute("select count(*) from nation")
+        assert res.rows == [[25]]
+        assert inner[0].task_ids() == []  # zero post-drain launches
+    finally:
+        for s in servers:
+            s.stop()
 
 
 # -- circuit breaker / graylist ---------------------------------------------

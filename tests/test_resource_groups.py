@@ -1,0 +1,183 @@
+"""Resource-group admission accounting regressions (PR 3 satellite).
+
+The invariant under test everywhere: a query that leaves the queue
+WITHOUT being admitted — timeout, queue-cap rejection, or kill — must
+release its queue slot and must NEVER have counted toward `running`.
+The admission-timeout path (acquire's wait_for deadline) had no
+coverage at all before these tests."""
+
+import threading
+import time
+
+import pytest
+
+from trino_tpu.runtime.resource_groups import (
+    QueryKilledWhileQueuedError,
+    QueryQueueFullError,
+    ResourceGroupManager,
+    ResourceGroupSpec,
+)
+
+
+def _mgr(max_concurrency: int = 1, max_queued: int = 10):
+    return ResourceGroupManager(
+        ResourceGroupSpec(
+            "global", max_concurrency=max_concurrency, max_queued=max_queued
+        )
+    )
+
+
+def test_admission_timeout_releases_queue_slot():
+    mgr = _mgr()
+    lease = mgr.acquire()
+    assert mgr.stats()["global"] == (1, 0)
+    with pytest.raises(QueryQueueFullError, match="timed out"):
+        mgr.acquire(timeout=0.05)
+    # the timed-out ticket fully unwound: nothing queued, nothing leaked
+    assert mgr.stats()["global"] == (1, 0)
+    mgr.release(lease)
+    assert mgr.stats()["global"] == (0, 0)
+    # and later admission still works (no phantom running count)
+    lease2 = mgr.acquire(timeout=1)
+    assert mgr.stats()["global"] == (1, 0)
+    mgr.release(lease2)
+    assert mgr.stats()["global"] == (0, 0)
+
+
+def test_queue_cap_rejection_keeps_counters_clean():
+    mgr = _mgr(max_concurrency=1, max_queued=1)
+    lease = mgr.acquire()
+    entered = threading.Event()
+    admitted = []
+
+    def second():
+        entered.set()
+        admitted.append(mgr.acquire(timeout=10))
+
+    t = threading.Thread(target=second, daemon=True)
+    t.start()
+    entered.wait()
+    deadline = time.monotonic() + 5
+    while mgr.stats()["global"][1] < 1 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert mgr.stats()["global"] == (1, 1)
+    with pytest.raises(QueryQueueFullError, match="full"):
+        mgr.acquire(timeout=1)
+    assert mgr.stats()["global"] == (1, 1)  # the rejection unwound itself
+    mgr.release(lease)
+    t.join(5)
+    assert admitted
+    assert mgr.stats()["global"] == (1, 0)
+    mgr.release(admitted[0])
+    assert mgr.stats()["global"] == (0, 0)
+
+
+def test_killed_while_queued_releases_slot_and_never_runs():
+    mgr = _mgr()
+    lease = mgr.acquire()
+    killed = threading.Event()
+    errs = []
+
+    def victim():
+        try:
+            mgr.acquire(timeout=30, cancelled=killed.is_set)
+        except BaseException as e:
+            errs.append(e)
+
+    t = threading.Thread(target=victim, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 5
+    while mgr.stats()["global"][1] < 1 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert mgr.stats()["global"] == (1, 1)
+    killed.set()
+    t.join(5)
+    assert not t.is_alive()
+    assert errs and isinstance(errs[0], QueryKilledWhileQueuedError), errs
+    # the kill released the QUEUE slot and never touched `running`
+    assert mgr.stats()["global"] == (1, 0)
+    mgr.release(lease)
+    assert mgr.stats()["global"] == (0, 0)
+
+
+def test_kill_racing_admission_hands_slot_back():
+    # kill lands while a slot is free: acquire notices the kill on the
+    # already-granted ticket and gives the running slot straight back
+    mgr = _mgr()
+    with pytest.raises(QueryKilledWhileQueuedError):
+        mgr.acquire(cancelled=lambda: True)
+    assert mgr.stats()["global"] == (0, 0)
+    lease = mgr.acquire()  # the handed-back slot is immediately usable
+    mgr.release(lease)
+
+
+def test_coordinator_delete_while_queued_releases_slot():
+    """End to end over the client protocol: DELETE on a QUEUED query
+    releases its admission slot, the query never executes, and the job
+    reports the kill verdict."""
+    import json as _json
+    import urllib.request
+
+    from trino_tpu import types as T
+    from trino_tpu.engine import MaterializedResult
+    from trino_tpu.runtime.server import CoordinatorServer
+
+    release_slow = threading.Event()
+    ran = []
+
+    class StubRunner:
+        def execute(self, sql, identity=None, transaction_id=None,
+                    prepared=None):
+            ran.append(sql)
+            if sql == "slow":
+                release_slow.wait(30)
+            return MaterializedResult([[1]], ["x"], [T.BIGINT])
+
+    mgr = _mgr()
+    srv = CoordinatorServer(StubRunner(), resource_groups=mgr)
+    try:
+        def post(sql: str) -> dict:
+            req = urllib.request.Request(
+                srv.uri + "/v1/statement", data=sql.encode(), method="POST"
+            )
+            return _json.load(urllib.request.urlopen(req, timeout=10))
+
+        def wait_stats(pred, what: str) -> None:
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if pred(mgr.stats()["global"]):
+                    return
+                time.sleep(0.01)
+            raise AssertionError(f"{what}: {mgr.stats()['global']}")
+
+        post("slow")
+        wait_stats(lambda s: s[0] == 1, "slow query never admitted")
+        victim = post("victim")
+        wait_stats(lambda s: s[1] == 1, "victim never queued")
+        req = urllib.request.Request(
+            srv.uri + f"/v1/statement/executing/{victim['id']}",
+            method="DELETE",
+        )
+        urllib.request.urlopen(req, timeout=10)
+        # the queue slot drains without the victim ever executing
+        wait_stats(lambda s: s == (1, 0), "kill did not release the slot")
+        assert ran == ["slow"]
+        resp = _json.load(urllib.request.urlopen(
+            srv.uri + f"/v1/statement/executing/{victim['id']}/0",
+            timeout=10,
+        ))
+        assert resp["stats"]["state"] == "FAILED", resp
+        assert "killed" in resp["error"]["message"].lower()
+        release_slow.set()
+        wait_stats(lambda s: s == (0, 0), "slow query never released")
+        # admission is healthy afterwards: a fresh query runs through
+        resp = post("after")
+        while "nextUri" in resp:
+            resp = _json.load(
+                urllib.request.urlopen(resp["nextUri"], timeout=10)
+            )
+        assert resp["stats"]["state"] == "FINISHED", resp
+        assert "victim" not in ran
+    finally:
+        release_slow.set()
+        srv.stop()

@@ -77,7 +77,8 @@ for _name, _type, _default, _desc, _allowed in [
     ("hash_partition_count", int, 4, "tasks per hash-distributed stage", None),
     ("retry_policy", str, "none", "none | query | task",
      ("none", "query", "task")),
-    ("query_retries", int, 2, "whole-query retry attempts", None),
+    ("query_retry_count", int, 2,
+     "whole-query retry attempts (retry_policy=query)", None),
     ("task_retries", int, 3, "per-task retry attempts (FTE)", None),
     ("memory_pool_bytes", int, 0, "per-query memory budget (0 = unlimited)", None),
     ("enable_dynamic_filtering", bool, True, "probe-side join pruning", None),
@@ -93,8 +94,11 @@ for _name, _type, _default, _desc, _allowed in [
     ("join_reordering_strategy", str, "automatic",
      "cost-based join reordering: automatic | none",
      ("automatic", "none")),
-    ("enable_speculative_execution", bool, True,
+    ("speculation_enabled", bool, True,
      "FTE: duplicate straggler tasks, first finisher wins", None),
+    ("speculation_quantile", float, 2.0,
+     "FTE: speculate once a task runs this multiple of the stage's "
+     "median committed-attempt wall time", None),
     ("task_concurrency", int, 2,
      "intra-task pipeline parallelism via the local exchange (1 = off)",
      None),

@@ -40,7 +40,7 @@ class Session:
     batch_rows: int = 1 << 20
     target_splits: int = 1
     retry_policy: str = "none"
-    query_retries: int = 2
+    query_retry_count: int = 2
     task_retries: int = 3
     # per-query memory budget (None = unlimited); exceeding it triggers
     # revocation/spill, then ExceededMemoryLimitError
@@ -62,8 +62,11 @@ class Session:
     # SPI hooks)
     enable_pushdown: bool = True
     # FTE straggler mitigation: duplicate slow tasks, first wins
-    # (retry-policy=TASK speculative execution)
-    enable_speculative_execution: bool = True
+    # (retry-policy=TASK speculative execution). A task speculates once
+    # it runs `speculation_quantile`x beyond the stage's median
+    # committed-attempt wall time AND a spare schedulable worker exists.
+    speculation_enabled: bool = True
+    speculation_quantile: float = 2.0
     # intra-task pipeline parallelism (LocalExchange): parallel build
     # pipelines + host IO overlapped with device compute; 1 = off
     task_concurrency: int = 2

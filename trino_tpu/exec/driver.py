@@ -55,6 +55,12 @@ class Driver:
                     continue
                 # move as many batches as the pair allows (Driver.java:389)
                 while nxt.needs_input():
+                    # cancellation is checked per batch, not just per
+                    # sweep: a killed task (low-memory killer, drain
+                    # re-placement, speculation loser) must stop inside
+                    # a long batch train, not after it
+                    if self._should_stop is not None and self._should_stop():
+                        raise TaskAbortedError("task aborted")
                     out = cur.get_output()
                     if out is None:
                         break

@@ -21,11 +21,20 @@ Fault classes map onto distinct recovery paths:
 - oom:              a task raises ExceededMemoryLimitError (memory-
                     classed: the partition memory estimator doubles
                     before re-placement)
+
+Lifecycle scenarios (LIFECYCLE_CLASSES) exercise the cluster-lifecycle
+layer end to end: drain_mid_query / drain_all_but_one gracefully drain
+workers while a query is in flight (oracle-equal result, zero accepted
+launches on the drained node after the drain, drain completes), and
+straggler_speculation demands a recorded speculative WIN, not just a
+launched duplicate.
 """
 
 from __future__ import annotations
 
 import random
+import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 FAULT_CLASSES = (
@@ -34,6 +43,15 @@ FAULT_CLASSES = (
     "fetch_loss",
     "straggler",
     "oom",
+)
+
+# cluster-lifecycle scenarios (PR 3): not injector schedules but whole-
+# cluster maneuvers — graceful drains racing a live query, and a
+# straggler that speculation must beat. Run via run_lifecycle_case.
+LIFECYCLE_CLASSES = (
+    "drain_mid_query",
+    "drain_all_but_one",
+    "straggler_speculation",
 )
 
 
@@ -92,13 +110,18 @@ def schedule_max_failures(rules: List[dict]) -> int:
 class DownableWorker:
     """Proxy handle that can be taken down (every call raises
     ConnectionError) and counts launches — the graylist assertions need
-    'zero create_task calls while the breaker is open'."""
+    'zero create_task calls while the breaker is open', and the drain
+    assertions need 'zero ACCEPTED launches after the drain landed'
+    (accepted_creates is bumped only after the worker took the task, so
+    it structurally cannot grow once the worker's state flipped to
+    shutting_down — a racing create raises instead)."""
 
     def __init__(self, inner):
         self._inner = inner
         self.worker_id = inner.worker_id
         self.down = False
         self.create_calls = 0
+        self.accepted_creates = 0
 
     def _check(self) -> None:
         if self.down:
@@ -107,7 +130,9 @@ class DownableWorker:
     def create_task(self, spec):
         self.create_calls += 1
         self._check()
-        return self._inner.create_task(spec)
+        out = self._inner.create_task(spec)
+        self.accepted_creates += 1
+        return out
 
     def task_state(self, task_id) -> dict:
         self._check()
@@ -130,6 +155,19 @@ class DownableWorker:
     def status(self) -> dict:
         self._check()
         return self._inner.status()
+
+    def fail_query(self, query_id, message) -> None:
+        self._check()
+        self._inner.fail_query(query_id, message)
+
+    def shutdown_gracefully(self) -> None:
+        # drain must go through even on a flaky node — request_drain
+        # treats delivery as best-effort anyway
+        self._inner.shutdown_gracefully()
+
+    @property
+    def state(self):
+        return getattr(self._inner, "state", "active")
 
     @property
     def memory_pool(self):
@@ -187,12 +225,15 @@ class ChaosHarness:
         from trino_tpu.connectors.spi import CatalogManager
 
         self._catalogs = CatalogManager()
+        # every worker sits behind a DownableWorker proxy so lifecycle
+        # cases can count ACCEPTED launches (drain assertions) and take
+        # nodes dark (graylist assertions) without touching the engine
         self.workers = [
-            Worker(
+            DownableWorker(Worker(
                 f"chaos-w{i}", self._catalogs,
                 failure_injector=self.injector,
                 memory_pool_bytes=memory_pool_bytes,
-            )
+            ))
             for i in range(n_workers)
         ]
         self.runner = DistributedQueryRunner(
@@ -231,6 +272,114 @@ class ChaosHarness:
         stats["breakers"] = self.runner.node_manager.breaker_states()
         return rows, stats
 
+    # -- cluster-lifecycle scenarios (graceful drain + speculation) --
+
+    def run_lifecycle_case(
+        self, sql: str, scenario: str, seed: int = 0,
+    ) -> Tuple[List[list], dict]:
+        """Drains are one-way (a drained node never rejoins), so run
+        each lifecycle case on a FRESH harness."""
+        if scenario == "drain_mid_query":
+            return self.run_drain_case(sql, seed)
+        if scenario == "drain_all_but_one":
+            return self.run_drain_case(sql, seed, drain_all_but_one=True)
+        if scenario == "straggler_speculation":
+            return self.run_speculation_case(sql, seed)
+        raise ValueError(f"unknown lifecycle scenario: {scenario}")
+
+    def run_drain_case(
+        self, sql: str, seed: int = 0, drain_all_but_one: bool = False,
+        stall_s: float = 0.8, drain_timeout_s: float = 60.0,
+    ) -> Tuple[List[list], dict]:
+        """Gracefully drain worker(s) while `sql` is mid-flight.
+
+        Every first attempt is stretched by `stall_s` so the drain is
+        guaranteed to land on a node with running tasks. Returns (rows,
+        report); report carries per-victim drain verdicts plus the
+        accepted-launch counter at drain time vs end of query — equal
+        counters prove the drained node took ZERO post-drain launches.
+        """
+        rng = random.Random(seed)
+        self.injector.clear()
+        self.injector.inject(
+            where="start", attempts=(0,), stall_s=stall_s,
+            max_hits=4 * len(self.workers),
+        )
+        result: dict = {}
+
+        def run():
+            try:
+                result["rows"] = self.runner.execute(sql).rows
+            except Exception as e:
+                result["error"] = e
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        # drain a node that ACTUALLY hosts work: wait for launches
+        deadline = time.monotonic() + 10.0
+        busy: List[DownableWorker] = []
+        while time.monotonic() < deadline and t.is_alive():
+            busy = [w for w in self.workers if w.accepted_creates > 0]
+            if busy:
+                break
+            time.sleep(0.002)
+        if drain_all_but_one:
+            victims = self.workers[:-1]
+        else:
+            victims = [busy[rng.randrange(len(busy))] if busy
+                       else self.workers[0]]
+        drained: Dict[str, bool] = {}
+        at_drain: Dict[str, int] = {}
+        for v in victims:
+            drained[v.worker_id] = self.runner.drain(
+                v.worker_id, timeout_s=drain_timeout_s
+            )
+            at_drain[v.worker_id] = v.accepted_creates
+        t.join(120.0)
+        self.injector.clear()
+        if "error" in result:
+            raise result["error"]
+        report = dict(self.runner.last_fte_stats or {})
+        report.update(
+            drained=drained,
+            launches_at_drain=at_drain,
+            launches_at_end={
+                v.worker_id: v.accepted_creates for v in victims
+            },
+            node_states=self.runner.node_manager.all_states(),
+        )
+        return result.get("rows"), report
+
+    def run_speculation_case(
+        self, sql: str, seed: int = 0, stall_s: float = 6.0,
+    ) -> Tuple[List[list], dict]:
+        """One partition's first attempt stalls hard; the speculative
+        duplicate on a spare worker must commit first (stats carry
+        speculation_wins/losses and attempts_per_partition).
+
+        stall_s must comfortably exceed the query's REAL per-task wall
+        time: the trigger is `age > speculation_quantile * median`, and
+        a stalled attempt's age only reaches `stall + wall`, so a stall
+        close to the task wall never crosses 2x median and the scenario
+        silently degrades to a plain wait. The duplicate wins and
+        cancels the stalled loser cooperatively, so a healthy run never
+        waits out the full stall."""
+        rng = random.Random(seed)
+        self.injector.clear()
+        # pin the stall to fragment 0 (the leaf stage, one task per
+        # worker): speculation needs sibling attempts to commit first so
+        # a median exists — a stall on a single-task fragment can never
+        # speculate and the scenario would silently degrade to a wait
+        self.injector.inject(
+            where="start", fragment_id=0, partition=rng.randrange(2),
+            attempts=(0,), stall_s=stall_s, max_hits=1,
+        )
+        try:
+            rows = self.runner.execute(sql).rows
+        finally:
+            self.injector.clear()
+        return rows, dict(self.runner.last_fte_stats or {})
+
 
 def chaos_smoke(
     seed: int,
@@ -268,9 +417,65 @@ def chaos_smoke(
                     f"injected-failure bound {bound}"
                 )
             if verbose:
+                app = stats.get("attempts_per_partition") or {}
                 print(
                     f"  chaos {name}/{fc}: ok rows={len(rows)} "
                     f"retries={stats.get('retries')} "
-                    f"spec={stats.get('speculative_hits')}"
+                    f"spec={stats.get('speculative_hits')} "
+                    f"wins={stats.get('speculation_wins')} "
+                    f"losses={stats.get('speculation_losses')} "
+                    f"max_attempts={max(app.values(), default=0)}"
                 )
+    # lifecycle scenarios: drains are one-way, so each runs on a fresh
+    # 3-worker harness (one spare survives drain_all_but_one)
+    lifecycle_sql = next(iter(queries.values()))
+    for scenario in LIFECYCLE_CLASSES:
+        h = ChaosHarness(n_workers=3)
+        h.register_catalog("tpch", create_tpch_connector())
+        expected = h.run_clean(lifecycle_sql)
+        try:
+            rows, report = h.run_lifecycle_case(
+                lifecycle_sql, scenario, seed
+            )
+        except Exception as e:
+            failures.append(
+                f"lifecycle/{scenario}: raised {type(e).__name__}: {e}"
+            )
+            continue
+        ordered = "order by" in lifecycle_sql.lower()
+        if not rows_equal(rows, expected, ordered=ordered):
+            failures.append(
+                f"lifecycle/{scenario}: rows diverged from clean run "
+                f"({len(rows)} vs {len(expected)})"
+            )
+        if scenario.startswith("drain"):
+            if not all(report["drained"].values()):
+                failures.append(
+                    f"lifecycle/{scenario}: drain timed out "
+                    f"({report['drained']})"
+                )
+            if report["launches_at_end"] != report["launches_at_drain"]:
+                failures.append(
+                    f"lifecycle/{scenario}: drained worker accepted "
+                    f"post-drain launches "
+                    f"({report['launches_at_drain']} -> "
+                    f"{report['launches_at_end']})"
+                )
+        if (
+            scenario == "straggler_speculation"
+            and not report.get("speculation_wins")
+        ):
+            failures.append(
+                f"lifecycle/{scenario}: no speculative win recorded "
+                f"({report})"
+            )
+        if verbose:
+            app = report.get("attempts_per_partition") or {}
+            print(
+                f"  chaos lifecycle/{scenario}: ok rows={len(rows)} "
+                f"retries={report.get('retries')} "
+                f"wins={report.get('speculation_wins')} "
+                f"losses={report.get('speculation_losses')} "
+                f"max_attempts={max(app.values(), default=0)}"
+            )
     return failures

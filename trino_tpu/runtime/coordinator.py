@@ -252,6 +252,9 @@ class DistributedQueryRunner:
                 w.failure_listener = self.node_manager
         # FTE observability for bounded-attempt assertions
         self.last_fte_stats: Optional[dict] = None
+        # how many whole-query attempts the last statement took
+        # (retry_policy=QUERY observability; 1 = no retry happened)
+        self.last_query_attempts: int = 0
         # cluster memory arbiter over the in-process workers' SHARED
         # pools: on exhaustion kill the largest query, not the worker
         self.memory_manager = None
@@ -274,6 +277,15 @@ class DistributedQueryRunner:
                 w.fail_query(query_id, message)
             except Exception:
                 pass
+
+    def drain(self, worker_id: str, timeout_s: float = 30.0) -> bool:
+        """Gracefully drain a worker: it leaves the placement pool
+        immediately, refuses new task launches, and this call returns
+        True once everything running on it reached a terminal state
+        (committed, or re-placed elsewhere by the scheduler). False on
+        timeout — the worker stays out of rotation, still serving its
+        spooled output."""
+        return self.node_manager.drain(worker_id, timeout_s=timeout_s)
 
     def _schedulable_workers(self) -> List:
         """Placement pool for new launches: breaker-closed active nodes,
@@ -395,13 +407,21 @@ class DistributedQueryRunner:
                     exc_info=True,
                 )
         attempts = (
-            1 + self.session.query_retries
+            1 + self.session.query_retry_count
             if self.session.retry_policy == "query"
             else 1
         )
         last_error: Optional[BaseException] = None
-        for _ in range(attempts):
-            query_id = f"q{next(_query_counter)}"
+        # retry_policy=QUERY deterministic replay: every attempt re-runs
+        # the SAME plan under a fresh internal task namespace (qN, qNr1,
+        # qNr2, ...) — create_task is idempotent BY ID, so reusing the
+        # first attempt's ids would hand back its dead TaskExecutions.
+        # No dot in the suffix: task keys are matched by the
+        # `query_id + "."` prefix and attempts must never cross-match.
+        base_qid = f"q{next(_query_counter)}"
+        for attempt in range(attempts):
+            query_id = base_qid if attempt == 0 else f"{base_qid}r{attempt}"
+            self.last_query_attempts = attempt + 1
             scheduler = QueryScheduler(
                 query_id,
                 subplan,
@@ -521,6 +541,11 @@ class DistributedQueryRunner:
                 self.last_fte_stats = {
                     "retries": scheduler.retries,
                     "speculative_hits": scheduler.speculative_hits,
+                    "speculation_wins": scheduler.speculation_wins,
+                    "speculation_losses": scheduler.speculation_losses,
+                    "attempts_per_partition": dict(
+                        scheduler.attempts_per_partition
+                    ),
                 }
             import os
 
@@ -565,11 +590,13 @@ class DistributedQueryRunner:
                 pages, token, complete = handle.get_results(
                     tid, 0, token, max_pages=16, wait=0.2
                 )
-            except RuntimeError:
+            except Exception:
                 # the root buffer can be aborted (low-memory kill, task
-                # failure) BETWEEN the failure check above and this
-                # fetch; re-read task states so the query-level verdict
-                # carries the real cause, not "buffer aborted"
+                # failure, DELETE /v1/query kill) BETWEEN the failure
+                # check above and this fetch — surfacing as RuntimeError
+                # in-process or as an HTTP 500 from a remote worker;
+                # re-read task states so the query-level verdict carries
+                # the real cause, not "buffer aborted"
                 self._raise_if_failed(scheduler)
                 raise
             for page in pages:

@@ -17,6 +17,14 @@ whose breaker trips is graylisted — `schedulable_workers()` excludes it
 so FTE re-placement and new launches avoid the node — while the
 heartbeat ping keeps probing it; one successful probe closes the
 breaker and returns the node to rotation.
+
+Graceful drain (GracefulShutdownHandler + the SHUTTING_DOWN node state
+driven end-to-end): `request_drain` marks a node shutting_down (new
+launches stop targeting it immediately) and tells the worker to refuse
+task creation; `drain(worker_id, timeout)` additionally waits until
+every task on the node reached a terminal state — committed, or failed
+and re-placed elsewhere — then marks it `drained` (decommissionable).
+Spooled output on a draining node stays readable throughout.
 """
 
 from __future__ import annotations
@@ -79,7 +87,11 @@ class CircuitBreaker:
 class NodeState:
     def __init__(self, handle, breaker: Optional[CircuitBreaker] = None):
         self.handle = handle
-        self.state = "active"  # active | shutting_down | failed
+        # lifecycle: active -> shutting_down (drain requested; running
+        # tasks finishing, no new launches) -> drained (nothing left
+        # running; the node can be decommissioned). `failed` is the
+        # heartbeat detector's verdict and can recover to active.
+        self.state = "active"  # active | shutting_down | drained | failed
         self.failure_rate = 0.0  # exponentially decayed
         self.last_seen = time.monotonic()
         self.breaker = breaker or CircuitBreaker()
@@ -140,6 +152,46 @@ class NodeManager:
         with self._lock:
             return {k: n.breaker.state for k, n in self._nodes.items()}
 
+    # -- graceful drain (DiscoveryNodeManager SHUTTING_DOWN end-to-end) --
+    def request_drain(self, worker_id: str) -> NodeState:
+        """Start draining a worker: mark it SHUTTING_DOWN locally FIRST
+        (placement stops targeting it before any network round trip),
+        then tell the worker to refuse new launches. An unreachable
+        worker still leaves rotation — that is the point of draining."""
+        with self._lock:
+            n = self._nodes.get(worker_id)
+            if n is None:
+                raise KeyError(f"unknown worker {worker_id}")
+            if n.state not in ("shutting_down", "drained"):
+                n.state = "shutting_down"
+        try:
+            n.handle.shutdown_gracefully()
+        except Exception:
+            pass
+        return n
+
+    def drain(self, worker_id: str, timeout_s: float = 30.0,
+              poll_s: float = 0.02) -> bool:
+        """Request a drain and wait until every task on the worker
+        reached a terminal state (committed, or failed and re-placed by
+        the scheduler onto other nodes). Returns True once the node is
+        `drained`; False on timeout (the node stays `shutting_down` —
+        still out of rotation, still serving its spooled output)."""
+        n = self.request_drain(worker_id)
+        deadline = time.monotonic() + timeout_s
+        while True:
+            try:
+                st = n.handle.status()
+                running = int(st.get("running", st.get("tasks", 0)))
+                if running == 0:
+                    n.state = "drained"
+                    return True
+            except Exception:
+                pass  # unreachable mid-drain: keep waiting for timeout
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(poll_s)
+
     # -- error-tracker listener protocol (destination == worker_id) --
     def report_failure(self, destination: str) -> None:
         with self._lock:
@@ -177,12 +229,17 @@ class NodeManager:
                 n.last_seen = time.monotonic()
                 n.breaker.record_success()
                 reported = status.get("state", "active")
-                if n.state != "failed" or n.failure_rate < self.FAIL_THRESHOLD:
-                    n.state = (
-                        "shutting_down"
-                        if reported == "shutting_down"
-                        else "active"
-                    )
+                running = int(status.get("running", status.get("tasks", 0)))
+                if (
+                    reported == "shutting_down"
+                    or n.state in ("shutting_down", "drained")
+                ):
+                    # drain is one-way (locally-requested drains stick
+                    # even before the worker acks); shutting_down
+                    # settles to drained once nothing is running
+                    n.state = "drained" if running == 0 else "shutting_down"
+                elif n.state != "failed" or n.failure_rate < self.FAIL_THRESHOLD:
+                    n.state = "active"
             except Exception:
                 n.failure_rate = n.failure_rate * self.DECAY + (1 - self.DECAY)
                 n.breaker.record_failure()

@@ -81,13 +81,22 @@ class FaultTolerantQueryScheduler:
 
         self.allocator = BinPackingNodeAllocator(node_manager=node_manager)
         self.estimator = PartitionMemoryEstimator()
-        # straggler mitigation: duplicate attempts for tasks running far
-        # beyond the stage's median; first finisher commits
-        # (FTE speculative execution)
-        self.enable_speculation = getattr(
-            session, "enable_speculative_execution", True
+        # straggler mitigation: duplicate attempts for tasks running
+        # `speculation_quantile`x beyond the stage's median COMMITTED-
+        # attempt wall time, provided a spare schedulable worker exists;
+        # first attempt to commit wins (the one-committed-attempt-per-
+        # partition selector), the loser is cancelled cooperatively
+        self.enable_speculation = getattr(session, "speculation_enabled", True)
+        self.speculation_quantile = float(
+            getattr(session, "speculation_quantile", 2.0)
         )
-        self.speculative_hits = 0
+        self.speculative_hits = 0  # speculative attempts launched
+        self.speculation_wins = 0  # ...that committed first
+        self.speculation_losses = 0  # ...cancelled or failed
+        # "fragment.partition" -> attempts ever launched (observability:
+        # chaos/bench assert attempt counts stay bounded per partition)
+        self.attempts_per_partition: Dict[str, int] = {}
+        self._speculative_tids: set = set()
 
     def _report(self, handle, ok: bool) -> None:
         """Feed the node's circuit breaker: in-process handles have no
@@ -173,6 +182,10 @@ class FaultTolerantQueryScheduler:
             est_bytes = self.estimator.estimate(f.id)
             handle = self.allocator.acquire(active, est_bytes, avoid=avoid_h)
             attempt_hwm[p] = max(attempt_hwm[p], attempt)
+            pkey = f"{f.id}.{p}"
+            self.attempts_per_partition[pkey] = (
+                self.attempts_per_partition.get(pkey, 0) + 1
+            )
             task_id = TaskId(self.query_id, f.id, p, attempt)
             spec = TaskSpec(
                 task_id=task_id,
@@ -205,8 +218,16 @@ class FaultTolerantQueryScheduler:
             durations.append(time.monotonic() - t0)
             self.committed[(f.id, p)] = tid
             self.allocator.release(handle, est)
+            if tid in self._speculative_tids:
+                self.speculation_wins += 1
             for h, other_tid, _, _, other_est in losers:
                 self.allocator.release(h, other_est)
+                if other_tid in self._speculative_tids:
+                    self.speculation_losses += 1
+                # cooperative cancel: remove_task aborts the loser's
+                # state machine, so its Driver stops at the next batch
+                # boundary; consumers only ever read the committed
+                # attempt, so a racing loser cannot add duplicate rows
                 try:
                     h.remove_task(other_tid)
                 except Exception:
@@ -258,6 +279,8 @@ class FaultTolerantQueryScheduler:
                         continue
                     if st["state"] == "failed":
                         self.allocator.release(handle, est)
+                        if tid in self._speculative_tids:
+                            self.speculation_losses += 1
                         self.estimator.register_failure(
                             f.id, st.get("failure")
                         )
@@ -293,14 +316,22 @@ class FaultTolerantQueryScheduler:
                     and median is not None
                     and len(durations) * 2 >= tc
                     and now - next_entries[0][3]
-                    > max(2.0 * median, 0.25)
+                    > max(self.speculation_quantile * median, 0.25)
                     and attempt_hwm[p] < self.max_task_retries
                 ):
                     handle = next_entries[0][0]
+                    # only speculate when a SPARE worker exists: a dup on
+                    # the straggler's own node races the same slowness
+                    spare = [
+                        h for h in list(self._active_fn()) if h is not handle
+                    ]
+                    if not spare:
+                        continue
                     try:
                         dup = launch(p, attempt_hwm[p] + 1, avoid_h=handle)
                         running[p].append(dup)
                         self.speculative_hits += 1
+                        self._speculative_tids.add(dup[1])
                     except _LaunchFailed:
                         pass  # speculation is best-effort
         return last_handle

@@ -20,8 +20,18 @@ Endpoints served by WorkerServer:
   GET    /v1/task/{taskId}/status              task state JSON
   GET    /v1/task/{taskId}/results/{p}/{tok}   pull pages (long-poll)
   DELETE /v1/task/{taskId}                     abort + remove
+  DELETE /v1/query/{queryId}?reason=...        fail every task of a query
+                                               (low-memory killer /
+                                               speculation-loser kill)
   GET    /v1/status                            worker heartbeat/info
   PUT    /v1/shutdown                          graceful shutdown (drain)
+  PUT    /v1/info/state                        body "SHUTTING_DOWN" ->
+                                               drain (reference API)
+
+A draining worker answers task creation with 409 — deliberately NOT a
+retryable status (503 would spin the RequestErrorTracker loop for the
+full error budget): the refusal is permanent, the scheduler must
+re-place the task elsewhere immediately.
 """
 
 from __future__ import annotations
@@ -36,7 +46,7 @@ from typing import List, Optional, Tuple
 
 from trino_tpu.exec.serde import Page, deserialize_page, serialize_page
 from trino_tpu.runtime import codec
-from trino_tpu.runtime.worker import Worker
+from trino_tpu.runtime.worker import Worker, WorkerShuttingDownError
 
 _U32 = struct.Struct("<I")
 
@@ -122,15 +132,9 @@ class _Handler(BaseHTTPRequestHandler):
         parts = [p for p in self.path.split("?")[0].split("/") if p]
         try:
             if parts[:2] == ["v1", "status"]:
-                w = self.worker
-                self._json(
-                    200,
-                    {
-                        "worker_id": w.worker_id,
-                        "state": self.server_ref.state,
-                        "tasks": len(w.task_ids()),
-                    },
-                )
+                # the worker's own status() carries lifecycle state +
+                # running-task count — the drain waiter reads both
+                self._json(200, self.worker.status())
                 return
             if parts[:2] == ["v1", "task"] and len(parts) >= 4:
                 task_id = parts[2]
@@ -166,8 +170,10 @@ class _Handler(BaseHTTPRequestHandler):
         parts = [p for p in self.path.split("/") if p]
         try:
             if parts[:2] == ["v1", "task"] and len(parts) == 3:
-                if self.server_ref.state != "active":
-                    self._json(503, {"error": "worker shutting down"})
+                if self.worker.state != "active":
+                    # 409, not 503: a drain refusal is permanent for
+                    # this worker — the client must re-place, not retry
+                    self._json(409, {"error": "worker shutting down"})
                     return
                 ln = int(self.headers.get("Content-Length", "0"))
                 spec = codec.loads(self.rfile.read(ln))
@@ -175,16 +181,31 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(200, {"task_id": str(task.spec.task_id), "state": task.state})
                 return
             self._json(404, {"error": f"no route {self.path}"})
+        except WorkerShuttingDownError as e:
+            self._json(409, {"error": str(e)})
         except Exception as e:
             self._json(500, {"error": repr(e)})
 
     def do_DELETE(self):
         if not self._authorized():
             return
-        parts = [p for p in self.path.split("/") if p]
+        path, _, query = self.path.partition("?")
+        parts = [p for p in path.split("/") if p]
         try:
             if parts[:2] == ["v1", "task"] and len(parts) == 3:
                 self.worker.remove_task(parts[2])
+                self._json(200, {})
+                return
+            if parts[:2] == ["v1", "query"] and len(parts) == 3:
+                # kill every task of a query with a reason (the
+                # low-memory killer / speculation-loser cancel path on
+                # HTTP topologies — Worker.fail_query over the wire)
+                import urllib.parse as _up
+
+                reason = _up.parse_qs(query).get("reason", [""])[0] or (
+                    "Query killed via DELETE /v1/query"
+                )
+                self.worker.fail_query(parts[2], reason)
                 self._json(200, {})
                 return
             self._json(404, {"error": f"no route {self.path}"})
@@ -198,7 +219,23 @@ class _Handler(BaseHTTPRequestHandler):
         if parts[:2] == ["v1", "shutdown"]:
             # graceful shutdown (GracefulShutdownHandler.java:43): stop
             # accepting tasks; running tasks drain
-            self.server_ref.state = "shutting_down"
+            self.worker.shutdown_gracefully()
+            self._json(200, {"state": "shutting_down"})
+            return
+        if parts[:3] == ["v1", "info", "state"]:
+            # the reference's worker-state API: PUT /v1/info/state with
+            # body "SHUTTING_DOWN" (JSON string) starts the drain
+            ln = int(self.headers.get("Content-Length", "0") or 0)
+            body = self.rfile.read(ln).decode("utf-8", "replace").strip()
+            want = body.strip('"').upper()
+            if want != "SHUTTING_DOWN":
+                self._json(
+                    400,
+                    {"error": f"unsupported state {body!r}: only "
+                              "SHUTTING_DOWN may be requested"},
+                )
+                return
+            self.worker.shutdown_gracefully()
             self._json(200, {"state": "shutting_down"})
             return
         self._json(404, {"error": f"no route {self.path}"})
@@ -213,7 +250,6 @@ class WorkerServer:
                  internal_secret: Optional[str] = "__env__",
                  require_secret: bool = True):
         self.worker = worker
-        self.state = "active"
         self.internal_auth = None
         if internal_secret == "__env__":
             internal_secret = default_internal_secret()
@@ -239,6 +275,12 @@ class WorkerServer:
             target=self._httpd.serve_forever, daemon=True
         )
         self._thread.start()
+
+    @property
+    def state(self) -> str:
+        """Lifecycle lives on the Worker (single source of truth shared
+        by the in-process and HTTP surfaces)."""
+        return self.worker.state
 
     def stop(self) -> None:
         self._httpd.shutdown()
@@ -302,8 +344,17 @@ class HttpWorkerClient:
         body = codec.dumps(spec)
 
         def go():
-            with self._req("POST", f"/v1/task/{spec.task_id}", body) as r:
-                return json.loads(r.read())
+            try:
+                with self._req("POST", f"/v1/task/{spec.task_id}", body) as r:
+                    return json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                if e.code == 409:
+                    # drain refusal: permanent for this worker, typed so
+                    # the scheduler re-places instead of retrying
+                    raise WorkerShuttingDownError(
+                        f"worker {self.uri} is shutting down"
+                    ) from e
+                raise
 
         out = self._retrying(go)
         if "error" in out:
@@ -338,6 +389,20 @@ class HttpWorkerClient:
         except (urllib.error.URLError, OSError):
             pass
 
+    def fail_query(self, query_id: str, message: str) -> None:
+        """DELETE /v1/query/{id}?reason=...: fail every task of the
+        query on this worker with the kill reason (low-memory killer /
+        speculation-loser cancellation over the wire)."""
+        import urllib.parse as _up
+
+        try:
+            self._req(
+                "DELETE",
+                f"/v1/query/{query_id}?reason={_up.quote(message)}",
+            ).close()
+        except (urllib.error.URLError, OSError):
+            pass  # a vanished worker has nothing left to kill
+
     def results_location(self, task_id):
         """Picklable location descriptor for TaskSpec.input_locations
         (resolved worker-side by task._resolve_fetch)."""
@@ -352,6 +417,13 @@ class HttpWorkerClient:
 
     def shutdown_gracefully(self) -> None:
         self._req("PUT", "/v1/shutdown").close()
+
+    def set_state(self, state: str) -> None:
+        """PUT /v1/info/state (the reference's worker-state API); only
+        "SHUTTING_DOWN" is accepted by the server."""
+        self._req(
+            "PUT", "/v1/info/state", json.dumps(state).encode()
+        ).close()
 
 
 def http_fetch(uri: str, task_id: str, retry_policy=None):

@@ -22,6 +22,11 @@ class QueryQueueFullError(RuntimeError):
     pass
 
 
+class QueryKilledWhileQueuedError(RuntimeError):
+    """The query was killed (DELETE / client abandon) while waiting for
+    admission: its ticket is withdrawn without ever counting as running."""
+
+
 @dataclasses.dataclass
 class ResourceGroupSpec:
     name: str
@@ -153,10 +158,19 @@ class ResourceGroupManager:
             if not admitted:
                 return
 
-    def acquire(self, user: str = "user", source: str = "", timeout: float = 60.0):
-        """Returns a lease token (the group) once admitted."""
+    def acquire(self, user: str = "user", source: str = "",
+                timeout: float = 60.0, cancelled=None):
+        """Returns a lease token (the group) once admitted. `cancelled`
+        (optional zero-arg callable) is polled while waiting: when it
+        turns true the ticket is withdrawn — releasing the queue slot
+        without EVER counting toward `running` — and
+        QueryKilledWhileQueuedError is raised (the dispatcher's
+        killed-while-queued path)."""
+        import time as _time
+
         group = self._resolve(user, source)
         chain = self._chain(group)
+        deadline = _time.monotonic() + timeout
         with self._lock:
             t = _Ticket(self._next_seq)
             self._next_seq += 1
@@ -182,18 +196,43 @@ class ResourceGroupManager:
                             f"({g.spec.max_queued})"
                         )
             self._lock.notify_all()
+            was_cancelled = False
             try:
-                ok = self._lock.wait_for(
-                    lambda: t.admitted, timeout=timeout
-                )
+                while not t.admitted:
+                    if cancelled is not None and cancelled():
+                        was_cancelled = True
+                        break
+                    remaining = deadline - _time.monotonic()
+                    if remaining <= 0:
+                        break
+                    # chunked wait so a kill is noticed promptly even
+                    # with a long admission timeout
+                    self._lock.wait_for(
+                        lambda: t.admitted,
+                        timeout=remaining if cancelled is None
+                        else min(remaining, 0.05),
+                    )
             finally:
                 if not t.admitted:
-                    # timed out or interrupted: withdraw the ticket
+                    # timed out, killed, or interrupted: withdraw the
+                    # ticket (queue slot released, `running` untouched)
                     if t in group.waiters:
                         group.waiters.remove(t)
                     for g in chain:
                         g.queued -= 1
-            if not ok:
+            if t.admitted and cancelled is not None and cancelled():
+                # killed in the admit-to-wakeup window: hand the slot
+                # straight back so it cannot leak
+                for g in chain:
+                    g.running -= 1
+                self._schedule_locked()
+                self._lock.notify_all()
+                was_cancelled = True
+            if was_cancelled:
+                raise QueryKilledWhileQueuedError(
+                    f"query killed while queued in group {group.path()}"
+                )
+            if not t.admitted:
                 raise QueryQueueFullError(
                     f"group {group.path()} admission timed out"
                 )

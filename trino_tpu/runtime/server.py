@@ -232,7 +232,7 @@ class CoordinatorServer:
                     len(parts) == 4
                     and parts[:3] == ["v1", "statement", "executing"]
                 ):
-                    outer._jobs.pop(parts[3], None)
+                    outer._kill(parts[3])
                     self._json(200, {})
                     return
                 self._json(404, {"error": "no route"})
@@ -333,6 +333,25 @@ class CoordinatorServer:
             for _, qid in drained[: len(drained) - self.MAX_COMPLETED]:
                 self._jobs.pop(qid, None)
 
+    def _kill(self, query_id: str) -> None:
+        """Client cancel (DELETE /v1/statement/executing/{id}): mark the
+        job dead instead of dropping it. A QUEUED job's admission wait
+        observes `abandoned` and withdraws its ticket — the queue slot
+        is released and the query never runs (and never counts toward
+        `running`); a RUNNING job keeps executing to completion but its
+        result is discarded and the verdict preserved."""
+        job = self._jobs.get(query_id)
+        if job is None:
+            return
+        with job.lock:
+            if job.finished_at is not None:
+                return  # already terminal: keep the real verdict
+            job.abandoned = True
+            job.state = "failed"
+            job.error = "Query killed by user (DELETE)"
+            job.finished_at = time.monotonic()
+            job.drained = True
+
     def _submit(self, sql: str, identity=None, transaction_id="NONE",
                 prepared=None) -> _QueryJob:
         from trino_tpu.runtime.metrics import METRICS
@@ -348,8 +367,14 @@ class CoordinatorServer:
             lease = None
             try:
                 if self.resource_groups is not None:
-                    # admission queueing (resource-group submit path)
-                    lease = self.resource_groups.acquire()
+                    # admission queueing (resource-group submit path); a
+                    # DELETE or client-abandon while queued flips
+                    # job.abandoned and acquire withdraws the ticket —
+                    # slot released, the query never runs
+                    lease = self.resource_groups.acquire(
+                        user=job.user or "user",
+                        cancelled=lambda: job.abandoned,
+                    )
                 with job.lock:
                     if job.abandoned:
                         return  # expired while queued: don't run or revive

@@ -176,6 +176,18 @@ class TaskExecution:
         for c in self._clients:
             c.close()
 
+    def fail(self, message: str) -> None:
+        """External kill (low-memory killer, DELETE /v1/query,
+        speculation-loser cancellation): latch a FAILED verdict carrying
+        `message`, then abort the buffer and exchange clients so the
+        task's driver stops cooperatively at its next batch boundary.
+        Terminal tasks keep their existing verdict."""
+        if self.state in ("finished", "failed", "aborted"):
+            return
+        self.failure = message
+        self.state = "failed"
+        self.abort()
+
     # -- execution --
     def _injected_fetch(self, fetch):
         """Chaos hook: the injector is consulted per exchange fetch (the

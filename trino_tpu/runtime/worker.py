@@ -17,6 +17,13 @@ from trino_tpu.connectors.spi import CatalogManager
 from trino_tpu.runtime.task import TaskExecution, TaskId, TaskSpec
 
 
+class WorkerShuttingDownError(RuntimeError):
+    """Raised by create_task on a draining worker. Schedulers treat it
+    like any launch failure and re-place the task on another node; it is
+    NOT transient (the worker will never accept the launch), so the HTTP
+    layer maps it to a non-retryable status code."""
+
+
 class Worker:
     def __init__(
         self,
@@ -30,6 +37,10 @@ class Worker:
         # "rack/host" network coordinate (the ICI-island id on a TPU
         # pod); workers carrying one get topology-aware placement
         self.location = location
+        # lifecycle (DiscoveryNodeManager's ACTIVE/SHUTTING_DOWN): a
+        # draining worker refuses new task launches while running tasks
+        # finish and already-produced output stays readable
+        self.state = "active"  # active | shutting_down
         self.catalogs = catalogs or CatalogManager()
         self.failure_injector = failure_injector
         self.memory_pool = None
@@ -40,10 +51,33 @@ class Worker:
         self._tasks: Dict[str, TaskExecution] = {}
         self._lock = threading.Lock()
 
+    # -- graceful drain (GracefulShutdownHandler analogue) --
+    def shutdown_gracefully(self) -> None:
+        """Enter SHUTTING_DOWN: every later create_task is refused (the
+        scheduler re-places those partitions); tasks already running
+        finish normally and their results/spool stay readable."""
+        with self._lock:
+            self.state = "shutting_down"
+
+    def running_tasks(self) -> int:
+        """Tasks not yet in a terminal state — the drain waiter's
+        completion condition (finished/failed/aborted tasks stay
+        registered so status and results remain readable)."""
+        with self._lock:
+            tasks = list(self._tasks.values())
+        return sum(
+            1 for t in tasks
+            if t.state not in ("finished", "failed", "aborted")
+        )
+
     # -- task lifecycle (SqlTaskManager.updateTask) --
     def create_task(self, spec: TaskSpec) -> TaskExecution:
         key = str(spec.task_id)
         with self._lock:
+            if self.state != "active":
+                raise WorkerShuttingDownError(
+                    f"worker {self.worker_id} is shutting down"
+                )
             existing = self._tasks.get(key)
             if existing is not None:
                 return existing  # idempotent re-delivery
@@ -100,15 +134,7 @@ class Worker:
                 if k.startswith(query_id + ".")
             ]
         for t in tasks:
-            if t.state in ("finished", "failed", "aborted"):
-                continue
-            t.failure = message
-            t.state = "failed"
-            # terminal states latch, so abort() keeps the "failed"
-            # verdict while tearing down the buffer AND the task's
-            # exchange clients — unblocking its thread so the doomed
-            # query stops burning cycles quickly
-            t.abort()
+            t.fail(message)
 
     def task_ids(self) -> List[str]:
         with self._lock:
@@ -123,6 +149,7 @@ class Worker:
     def status(self) -> dict:
         return {
             "worker_id": self.worker_id,
-            "state": "active",
+            "state": self.state,
             "tasks": len(self.task_ids()),
+            "running": self.running_tasks(),
         }
