@@ -38,9 +38,13 @@ Flags:
                      walls append to BENCH_DEV.json as usual
   --chaos-smoke [seed]  run the seeded chaos harness (runtime/chaos.py)
                      over representative TPC-H shapes under every fault
-                     class and exit non-zero if any run diverges from
-                     the clean answer or exceeds its injected-failure
-                     bound; no device needed (runs before preflight)
+                     class, the lifecycle maneuvers, and the timebound
+                     scenarios (hung operator vs the stuck-task
+                     watchdog, abandoned client vs the reaper); exits
+                     non-zero if any run diverges from the clean answer,
+                     exceeds its injected-failure bound, leaks a
+                     resource-group slot, or leaves memory reserved; no
+                     device needed (runs before preflight)
 """
 
 from __future__ import annotations
@@ -761,12 +765,14 @@ def _chaos_smoke(argv) -> int:
     from trino_tpu.runtime.chaos import (
         FAULT_CLASSES,
         LIFECYCLE_CLASSES,
+        TIMEBOUND_CLASSES,
         chaos_smoke,
     )
 
     print(f"bench: chaos smoke seed={seed} "
           f"fault_classes={','.join(FAULT_CLASSES)} "
-          f"lifecycle={','.join(LIFECYCLE_CLASSES)}")
+          f"lifecycle={','.join(LIFECYCLE_CLASSES)} "
+          f"timebound={','.join(TIMEBOUND_CLASSES)}")
     t0 = time.time()
     violations = chaos_smoke(seed, CHAOS_QUERIES)
     wall = time.time() - t0
@@ -776,7 +782,7 @@ def _chaos_smoke(argv) -> int:
         "chaos_smoke": {
             "seed": seed,
             "cases": len(CHAOS_QUERIES) * len(FAULT_CLASSES)
-            + len(LIFECYCLE_CLASSES),
+            + len(LIFECYCLE_CLASSES) + len(TIMEBOUND_CLASSES),
             "violations": len(violations),
             "wall_s": round(wall, 2),
         }

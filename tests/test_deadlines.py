@@ -1,0 +1,506 @@
+"""Deadline hierarchy, abandonment reaping, stuck-task watchdog (PR 4).
+
+Three authorities keep a query from hanging the cluster, each with its
+own tests here:
+
+  - the coordinator QueryTracker (runtime/query_tracker.py) enforces the
+    planning/execution/run/CPU budget hierarchy and latches TYPED,
+    NON-RETRYABLE errors — fake-clock unit tests pin which limit fires
+    in which phase, and integration tests prove neither QUERY retry nor
+    FTE task retry resubmits a killed query;
+  - the server-side abandonment reaper cancels a query whose client
+    stopped polling, with the resource-group slot and the memory-pool
+    ledger both verified drained;
+  - the worker stuck-task watchdog interrupts a wedged task with a
+    diagnostic naming the stuck operator — and that failure IS
+    retryable (the hung split may succeed elsewhere).
+"""
+
+import signal
+import threading
+import time
+
+import pytest
+
+from tests.oracle import assert_rows_match, sqlite_rows
+from tests.test_tpch import to_sqlite
+from trino_tpu.connectors.file import create_file_connector
+from trino_tpu.connectors.spi import CatalogManager
+from trino_tpu.connectors.tpch import create_tpch_connector
+from trino_tpu.engine import Session
+from trino_tpu.runtime import DistributedQueryRunner, Worker
+from trino_tpu.runtime.chaos import TIMEBOUND_CLASSES, ChaosHarness
+from trino_tpu.runtime.failure import FailureInjector
+from trino_tpu.runtime.query_tracker import (
+    EXCEEDED_CPU_LIMIT,
+    EXCEEDED_TIME_LIMIT,
+    EXECUTING,
+    PLANNING,
+    DeadlineLimits,
+    ExceededCpuLimitError,
+    ExceededTimeLimitError,
+    QueryDeadlineError,
+    QueryTracker,
+    deadline_code,
+    deadline_error,
+)
+from trino_tpu.runtime.worker import install_sigterm_self_drain
+
+SF = 0.01
+SEED = 42
+
+Q_AGG = (
+    "select l_returnflag, l_linestatus, sum(l_quantity), count(*) "
+    "from lineitem where l_shipdate <= date '1998-09-02' "
+    "group by l_returnflag, l_linestatus "
+    "order by l_returnflag, l_linestatus"
+)
+Q_JOIN = (
+    "select n_name, count(*) c from supplier, nation "
+    "where s_nationkey = n_nationkey "
+    "group by n_name order by n_name"
+)
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    import sqlite3
+
+    from tests.oracle import load_tpch_sqlite
+
+    conn = sqlite3.connect(":memory:")
+    load_tpch_sqlite(conn, SF)
+    yield conn
+    conn.close()
+
+
+# -- QueryTracker unit tests (fake clock, explicit ticks) -------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+def _tracker():
+    clock = FakeClock()
+    return QueryTracker(clock=clock), clock
+
+
+def test_run_time_limit_covers_queued_phase():
+    """query_max_run_time_s counts from submission, so a query stuck in
+    the admission queue burns budget and dies there — no phase is
+    exempt."""
+    tracker, clock = _tracker()
+    kills = []
+    tracker.register(
+        "q1", DeadlineLimits(max_run_time_s=10.0), kill=kills.append
+    )  # default phase: QUEUED
+    clock.t = 9.0
+    assert tracker.tick() == []
+    clock.t = 10.5
+    assert tracker.tick() == [("q1", EXCEEDED_TIME_LIMIT)]
+    assert len(kills) == 1 and EXCEEDED_TIME_LIMIT in kills[0]
+    # the kill latches: later ticks do not re-fire, check() raises it
+    clock.t = 20.0
+    assert tracker.tick() == []
+    assert len(kills) == 1
+    with pytest.raises(ExceededTimeLimitError):
+        tracker.check("q1")
+
+
+def test_planning_limit_fires_only_while_planning():
+    tracker, clock = _tracker()
+    limits = DeadlineLimits(max_planning_time_s=5.0)
+    tracker.register("fast", limits, phase=PLANNING)
+    tracker.register("slow", limits, phase=PLANNING)
+    clock.t = 1.0
+    tracker.transition("fast", EXECUTING)  # planned within budget
+    clock.t = 6.0
+    # "fast" left planning in time; only "slow" is still planning
+    assert tracker.tick() == [("slow", EXCEEDED_TIME_LIMIT)]
+    with pytest.raises(ExceededTimeLimitError):
+        tracker.check("slow")
+    tracker.check("fast")  # no latched error
+
+
+def test_execution_limit_excludes_queue_and_planning_time():
+    """The execution clock starts at the EXECUTING transition — time
+    spent queued or planning must not count against it."""
+    tracker, clock = _tracker()
+    tracker.register("q1", DeadlineLimits(max_execution_time_s=5.0),
+                     phase=PLANNING)
+    clock.t = 10.0  # ten seconds of planning: not execution time
+    tracker.transition("q1", EXECUTING)
+    clock.t = 14.9
+    assert tracker.tick() == []
+    clock.t = 15.1
+    assert tracker.tick() == [("q1", EXCEEDED_TIME_LIMIT)]
+
+
+def test_cpu_limit_reads_the_task_ledger():
+    tracker, clock = _tracker()
+    cpu = [0.0]
+    tracker.register(
+        "q1",
+        DeadlineLimits(max_cpu_time_s=1.0),
+        cpu_time_fn=lambda: cpu[0],
+        phase=EXECUTING,
+    )
+    clock.t = 100.0  # wall time is irrelevant to the CPU budget
+    assert tracker.tick() == []
+    cpu[0] = 1.5
+    assert tracker.tick() == [("q1", EXCEEDED_CPU_LIMIT)]
+    with pytest.raises(ExceededCpuLimitError):
+        tracker.check("q1")
+
+
+def test_completed_query_is_not_enforced():
+    tracker, clock = _tracker()
+    tracker.register("q1", DeadlineLimits(max_run_time_s=1.0))
+    tracker.complete("q1")
+    clock.t = 50.0
+    assert tracker.tick() == []
+    tracker.check("q1")  # unknown/completed queries never raise
+
+
+def test_deadline_code_survives_stringly_propagation():
+    """A kill message embeds its code in brackets; any layer that only
+    sees the string (task failure, HTTP 500 body) can re-type it."""
+    msg = f"Query q7 exceeded ... [{EXCEEDED_CPU_LIMIT}]"
+    assert deadline_code(msg) == EXCEEDED_CPU_LIMIT
+    assert deadline_code("task crashed: ordinary failure") is None
+    assert deadline_code(None) is None
+    err = deadline_error(msg)
+    assert isinstance(err, ExceededCpuLimitError)
+    assert isinstance(
+        deadline_error(f"x [{EXCEEDED_TIME_LIMIT}]"), ExceededTimeLimitError
+    )
+    # non-retryable by construction: retry layers key off this flag
+    assert QueryDeadlineError.retryable is False
+    assert err.retryable is False
+
+
+def test_limits_from_session():
+    s = Session(catalog="tpch", schema="tiny",
+                query_max_execution_time_s=2.5, query_max_cpu_time_s=1.0)
+    limits = DeadlineLimits.from_session(s)
+    assert limits.max_execution_time_s == 2.5
+    assert limits.max_cpu_time_s == 1.0
+    assert limits.max_planning_time_s == 0.0
+    assert limits.any()
+    assert not DeadlineLimits.from_session(
+        Session(catalog="tpch", schema="tiny")
+    ).any()
+
+
+# -- integration: deadline kills are terminal, not retried ------------------
+
+
+def _cluster(n_workers=2, **session_kw):
+    inj = FailureInjector()
+    cats = CatalogManager()
+    cats.register("tpch", create_tpch_connector())
+    workers = [
+        Worker(f"dl-w{i}", cats, failure_injector=inj)
+        for i in range(n_workers)
+    ]
+    runner = DistributedQueryRunner(
+        Session(catalog="tpch", schema="tiny", **session_kw),
+        worker_handles=workers, hash_partitions=2,
+    )
+    runner.register_catalog("tpch", create_tpch_connector())
+    return inj, runner
+
+
+def test_execution_limit_kills_stalled_query_and_is_not_retried():
+    """A batch-site stall with max_hits=1 would be absorbed by one
+    whole-query retry (the replay runs clean) — so a successful result
+    would prove the deadline error was WRONGLY retried. The correct
+    behaviour: the tracker kills attempt 1, the coordinator re-types
+    the failure, and QUERY retry refuses to resubmit."""
+    inj, runner = _cluster(
+        retry_policy="query", query_retry_count=5,
+        query_max_execution_time_s=0.2,
+    )
+    inj.inject(where="batch", attempts=(0, 1, 2, 3), stall_s=30.0,
+               max_hits=1)
+    t0 = time.monotonic()
+    try:
+        with pytest.raises(ExceededTimeLimitError) as ei:
+            runner.execute(Q_AGG)
+    finally:
+        inj.clear()
+    assert EXCEEDED_TIME_LIMIT in str(ei.value)
+    assert runner.last_query_attempts == 1, "deadline kill was resubmitted"
+    # the kill must also unwedge the stalled task: nowhere near the
+    # 30s stall, even on a slow box
+    assert time.monotonic() - t0 < 15.0
+
+
+def test_generic_crash_is_still_retried_under_query_policy(oracle):
+    """Contrast case: an ordinary task crash (no deadline code) keeps
+    its retryable classification and QUERY retry absorbs it."""
+    inj, runner = _cluster(retry_policy="query", query_retry_count=3)
+    inj.inject(where="start", fragment_id=0, partition=0,
+               attempts=(0, 1, 2, 3), max_hits=1)
+    try:
+        rows = runner.execute(Q_JOIN).rows
+    finally:
+        inj.clear()
+    assert_rows_match(
+        rows, sqlite_rows(oracle, to_sqlite(Q_JOIN)),
+        ordered=True, abs_tol=1e-2,
+    )
+    assert runner.last_query_attempts == 2
+
+
+def test_cpu_limit_kills_via_task_cpu_ledger():
+    """query_max_cpu_time_s aggregates worker-side thread_time ledgers
+    (task_state "cpu_s"). Any real scan burns more than a microsecond,
+    so a 1µs budget must die with the CPU-coded error — while the stall
+    holds the query open long enough for the tracker to tick."""
+    inj, runner = _cluster(
+        retry_policy="query", query_retry_count=3,
+        query_max_cpu_time_s=1e-6,
+    )
+    inj.inject(where="batch", attempts=(0, 1, 2, 3), stall_s=30.0,
+               max_hits=1)
+    try:
+        with pytest.raises(ExceededCpuLimitError) as ei:
+            runner.execute(Q_AGG)
+    finally:
+        inj.clear()
+    assert EXCEEDED_CPU_LIMIT in str(ei.value)
+    assert runner.last_query_attempts == 1
+
+
+def test_fte_does_not_retry_deadline_kills():
+    """Same non-retry contract on the FTE path: task retry absorbs
+    ordinary failures (max_hits=1 would succeed on replay) but must
+    surface a deadline-coded kill immediately."""
+    inj, runner = _cluster(
+        retry_policy="task", task_retries=3,
+        query_max_execution_time_s=0.2,
+    )
+    inj.inject(where="batch", attempts=(0, 1, 2, 3), stall_s=30.0,
+               max_hits=1)
+    try:
+        with pytest.raises(ExceededTimeLimitError):
+            runner.execute(Q_AGG)
+    finally:
+        inj.clear()
+
+
+# -- abandonment reaping ----------------------------------------------------
+
+
+def _timebound_harness() -> ChaosHarness:
+    h = ChaosHarness(
+        n_workers=3,
+        stuck_task_interrupt_s=1.0,
+        memory_pool_bytes=256 << 20,
+    )
+    h.register_catalog("tpch", create_tpch_connector())
+    return h
+
+
+def test_abandoned_client_is_reaped_slot_and_memory_drained():
+    """A client that submits and never polls: the reaper cancels the
+    query, the resource-group slot goes back (total_running == 0) and
+    every worker memory pool's per-query ledger drains to zero."""
+    _, report = _timebound_harness().run_abandoned_client_case(
+        Q_AGG, seed=SEED
+    )
+    assert report["reaped"], report
+    assert "abandoned" in (report["error"] or "").lower(), report
+    assert report["rg_running"] == 0, "resource-group slot leaked"
+    assert not any(report["ledgers"].values()), (
+        f"memory ledger not drained: {report['ledgers']}"
+    )
+
+
+# -- stuck-task watchdog ----------------------------------------------------
+
+
+def test_watchdog_interrupts_hung_operator_and_names_it(oracle):
+    """A wedged batch (hung operator) is interrupted by the worker
+    watchdog with a diagnostic naming the stuck operator and the last
+    batch timestamp; the failure is RETRYABLE, so FTE re-runs the task
+    and the query still answers correctly — well before the stall would
+    have expired on its own."""
+    h = _timebound_harness()
+    rows, report = h.run_hung_operator_case(Q_AGG, seed=SEED)
+    assert_rows_match(
+        rows, sqlite_rows(oracle, to_sqlite(Q_AGG)),
+        ordered=True, abs_tol=1e-2,
+    )
+    interrupts = report["watchdog_interrupts"]
+    assert interrupts, "watchdog never fired"
+    assert any("Stuck task" in d for d in interrupts), interrupts
+    assert any("in operator" in d for d in interrupts), (
+        f"diagnostic does not name the operator: {interrupts}"
+    )
+    assert any("last batch at t=" in d for d in interrupts), interrupts
+    # un-wedged proof: recovery overhead (elapsed beyond the warm clean
+    # baseline the case measured itself) stays under the stall — only a
+    # broken watchdog waits out the injected stall in full
+    overhead = report["elapsed_s"] - report["warm_clean_s"]
+    assert overhead < report["stall_s"], (
+        f"query waited out the stall (overhead {overhead:.2f}s) — "
+        f"the watchdog did not unwedge it"
+    )
+
+
+def test_watchdog_does_not_fire_on_healthy_tasks():
+    """A worker whose tasks make progress never trips the watchdog:
+    watchdog_once on an idle/healthy worker reports nothing."""
+    cats = CatalogManager()
+    cats.register("tpch", create_tpch_connector())
+    w = Worker("wd-w0", cats, stuck_task_interrupt_s=1.0)
+    runner = DistributedQueryRunner(
+        Session(catalog="tpch", schema="tiny"), worker_handles=[w],
+    )
+    runner.register_catalog("tpch", create_tpch_connector())
+    assert runner.execute("select count(*) from nation").rows == [[25]]
+    assert w.watchdog_once() == []
+    assert w.watchdog_interrupts == []
+
+
+# -- worker SIGTERM self-drain ----------------------------------------------
+
+
+def test_sigterm_drains_all_workers():
+    """SIGTERM routes into graceful drain: every registered worker flips
+    to SHUTTING_DOWN (new launches refused) instead of dying mid-task.
+    The handler is invoked directly — sending a real signal to the test
+    process would race pytest's own machinery."""
+    cats = CatalogManager()
+    cats.register("tpch", create_tpch_connector())
+    workers = [Worker(f"sig-w{i}", cats) for i in range(2)]
+    prev = install_sigterm_self_drain(workers)
+    try:
+        handler = signal.getsignal(signal.SIGTERM)
+        assert callable(handler)
+        handler(signal.SIGTERM, None)
+        assert all(w.state == "shutting_down" for w in workers)
+        from trino_tpu.runtime.worker import WorkerShuttingDownError
+        from trino_tpu.runtime.task import TaskSpec
+
+        with pytest.raises(WorkerShuttingDownError):
+            workers[0].create_task(
+                TaskSpec(
+                    task_id="sig-q0.0.0", fragment=None,
+                    n_output_partitions=1, remote_schemas={},
+                    scan_slice=None, input_locations={},
+                )
+            )
+    finally:
+        if prev is not None:
+            signal.signal(signal.SIGTERM, prev)
+
+
+# -- split-listing invalidation between QUERY attempts ----------------------
+
+
+def test_query_retry_invalidates_split_listings(tmp_path, oracle):
+    """A whole-query replay must not trust connector split caches from
+    the failed attempt (files may have changed underneath a cached
+    parse): each retry boundary calls invalidate_split_listings, visible
+    as the FileSplitManager invalidation counter ticking."""
+    data = tmp_path / "shop" / "sales.csv"
+    data.parent.mkdir(parents=True)
+    data.write_text(
+        "region,units\n"
+        "east,3\n"
+        "west,5\n"
+        "east,2\n"
+    )
+    file_conn = create_file_connector(str(tmp_path))
+    inj = FailureInjector()
+    cats = CatalogManager()
+    cats.register("files", file_conn)
+    workers = [
+        Worker(f"inv-w{i}", cats, failure_injector=inj) for i in range(2)
+    ]
+    runner = DistributedQueryRunner(
+        Session(catalog="files", schema="shop", retry_policy="query",
+                query_retry_count=3),
+        worker_handles=workers, hash_partitions=2,
+    )
+    runner.register_catalog("files", file_conn)
+    sm = file_conn.split_manager
+    assert sm.invalidations == 0
+    inj.inject(where="start", fragment_id=0, partition=0,
+               attempts=(0, 1, 2, 3), max_hits=1)
+    try:
+        rows = runner.execute(
+            "select region, sum(units) from sales "
+            "group by region order by region"
+        ).rows
+    finally:
+        inj.clear()
+    assert rows == [["east", 5], ["west", 5]]
+    assert runner.last_query_attempts == 2
+    assert sm.invalidations >= 1, (
+        "retry attempt reused the failed attempt's split listings"
+    )
+
+
+# -- p75 speculation threshold ----------------------------------------------
+
+
+def test_quantile_interpolation():
+    from trino_tpu.runtime.fte import _quantile
+
+    vals = [1.0, 2.0, 3.0, 4.0]
+    assert _quantile(vals, 0.5) == pytest.approx(2.5)
+    assert _quantile(vals, 0.75) == pytest.approx(3.25)
+    assert _quantile(vals, 1.0) == pytest.approx(4.0)
+    assert _quantile([7.0], 0.75) == pytest.approx(7.0)
+
+
+def test_fte_stats_surface_speculation_percentile():
+    """The straggler threshold is a per-fragment p75 of committed wall
+    times (session-tunable via speculation_percentile) and the quantile
+    used is surfaced in last_fte_stats."""
+    _, runner = _cluster(retry_policy="task", task_retries=2)
+    runner.execute(Q_AGG)
+    stats = runner.last_fte_stats
+    assert stats["speculation_percentile"] == pytest.approx(0.75)
+    assert "speculation_estimates" in stats
+
+    _, runner9 = _cluster(retry_policy="task", task_retries=2,
+                          speculation_percentile=0.9)
+    runner9.execute(Q_AGG)
+    assert runner9.last_fte_stats["speculation_percentile"] == (
+        pytest.approx(0.9)
+    )
+
+
+# -- slow soak: the timebound chaos classes over several seeds --------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("scenario", TIMEBOUND_CLASSES)
+def test_timebound_soak(scenario, seed, oracle):
+    h = _timebound_harness()
+    if scenario == "hung_operator":
+        rows, report = h.run_hung_operator_case(Q_AGG, seed=seed)
+        assert_rows_match(
+            rows, sqlite_rows(oracle, to_sqlite(Q_AGG)),
+            ordered=True, abs_tol=1e-2,
+        )
+        assert report["watchdog_interrupts"], report
+        overhead = report["elapsed_s"] - report["warm_clean_s"]
+        assert overhead < report["stall_s"], report
+    else:
+        h.run_clean(Q_AGG)  # warm generation caches before the stall
+        _, report = h.run_abandoned_client_case(Q_AGG, seed=seed)
+        assert report["reaped"], report
+        assert report["rg_running"] == 0, report
+        assert not any(report["ledgers"].values()), report

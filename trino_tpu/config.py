@@ -115,6 +115,29 @@ for _name, _type, _default, _desc, _allowed in [
     ("low_memory_killer_enabled", bool, True,
      "under cluster pool exhaustion (after revocation/spill), kill the "
      "single largest query instead of stalling everyone", None),
+    # -- deadline hierarchy (runtime/query_tracker.py); 0 = unlimited --
+    ("query_max_planning_time_s", float, 0.0,
+     "kill a query still PLANNING after this long "
+     "(EXCEEDED_TIME_LIMIT, non-retryable)", None),
+    ("query_max_execution_time_s", float, 0.0,
+     "kill a query EXECUTING (post-planning) after this long "
+     "(EXCEEDED_TIME_LIMIT, non-retryable)", None),
+    ("query_max_run_time_s", float, 0.0,
+     "end-to-end wall bound: queued + planning + execution "
+     "(EXCEEDED_TIME_LIMIT, non-retryable)", None),
+    ("query_max_cpu_time_s", float, 0.0,
+     "kill a query whose tasks' aggregated CPU ledgers exceed this "
+     "(EXCEEDED_CPU_LIMIT, non-retryable)", None),
+    ("client_timeout_s", float, 300.0,
+     "reap a query whose client stopped polling nextUri for this long: "
+     "tasks cancelled, resource-group slot and memory released", None),
+    ("stuck_task_interrupt_s", float, 0.0,
+     "worker watchdog: interrupt a task making no batch progress for "
+     "this long (failure is RETRYABLE — a hung split may succeed "
+     "elsewhere); 0 disables", None),
+    ("speculation_percentile", float, 0.75,
+     "FTE speculation bases its per-fragment duration estimate on this "
+     "quantile of committed attempt wall times (p75 default)", None),
 ]:
     SYSTEM_PROPERTIES.register(_name, _type, _default, _desc, _allowed)
 

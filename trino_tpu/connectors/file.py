@@ -780,6 +780,18 @@ class FileSplitManager(ConnectorSplitManager):
 
     def __init__(self, store: _FileStore):
         self.store = store
+        self.invalidations = 0  # observability for retry-boundary tests
+
+    def invalidate_cache(self) -> None:
+        """QUERY-retry boundary: drop every parsed/filtered/metadata
+        listing so the replay re-reads the files (the mtime stamp
+        already catches rewrites, but a stale-cache failure mode —
+        e.g. a file deleted underneath a cached parse — needs the hard
+        flush)."""
+        self.invalidations += 1
+        self.store._cache.clear()
+        self.store._filtered_cache.clear()
+        self.store._meta_cache.clear()
 
     def get_splits(self, handle: TableHandle, target_split_count: int) -> List[Split]:
         cs = getattr(handle, "constraints", ())

@@ -178,6 +178,13 @@ class ConnectorSplitManager:
     def get_splits(self, handle: TableHandle, target_split_count: int) -> List[Split]:
         raise NotImplementedError
 
+    def invalidate_cache(self) -> None:
+        """Drop any cached split listings. Called between whole-query
+        retry attempts (CachingHiveMetastore flush on retry): the first
+        attempt may have failed BECAUSE a cached listing went stale
+        under it (files compacted/deleted), so the replay must re-list.
+        Default: stateless split managers have nothing to drop."""
+
 
 class ConnectorPageSource:
     """Produces batches for one split (ConnectorPageSource.java:24).
@@ -244,6 +251,12 @@ class Connector:
 
         return ConnectorTransactionHandle()
 
+    def invalidate_split_caches(self) -> None:
+        """Flush this catalog's split-listing caches (whole-query retry
+        boundary — see ConnectorSplitManager.invalidate_cache)."""
+        if self.split_manager is not None:
+            self.split_manager.invalidate_cache()
+
 
 class CatalogManager:
     """Engine-wide catalog registry — MetadataManager/CatalogManager
@@ -269,3 +282,15 @@ class CatalogManager:
         if handle is None:
             raise KeyError(f"table '{catalog}.{schema}.{table}' does not exist")
         return conn, handle
+
+    def invalidate_split_listings(self) -> None:
+        """Flush split-listing caches across every catalog. The QUERY
+        retry loop calls this between attempts so a replay re-lists
+        splits instead of replaying the stale listing that may have
+        failed the first attempt. Connector errors are swallowed — a
+        broken cache flush must not mask the original query failure."""
+        for conn in self._catalogs.values():
+            try:
+                conn.invalidate_split_caches()
+            except Exception:
+                pass

@@ -78,6 +78,26 @@ class Session:
     node_breaker_threshold: int = 3
     node_breaker_cooldown_s: float = 1.0
     low_memory_killer_enabled: bool = True
+    # deadline hierarchy (PR 4, runtime/query_tracker.py): per-query
+    # time budgets (0 = unlimited). Breaches are typed NON-RETRYABLE
+    # errors (EXCEEDED_TIME_LIMIT / EXCEEDED_CPU_LIMIT) — the budget is
+    # a property of the query, so neither QUERY retry nor FTE task
+    # retry may resubmit past one
+    query_max_planning_time_s: float = 0.0
+    query_max_execution_time_s: float = 0.0
+    query_max_run_time_s: float = 0.0
+    query_max_cpu_time_s: float = 0.0
+    # client-abandonment reaping (CoordinatorServer): a query whose
+    # results page went unpolled this long is cancelled and its
+    # resource-group slot + memory reservation released
+    client_timeout_s: float = 300.0
+    # worker stuck-task watchdog: interrupt a task making no batch
+    # progress for this long (RETRYABLE, unlike deadline kills — a hung
+    # split may succeed on another worker); 0 disables
+    stuck_task_interrupt_s: float = 0.0
+    # FTE speculation duration estimate: quantile of committed attempt
+    # wall times per fragment (the reference's p75-based model)
+    speculation_percentile: float = 0.75
 
     def set_property(self, name: str, value) -> None:
         """SET SESSION entry point — validated through the typed

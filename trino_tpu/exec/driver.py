@@ -37,10 +37,16 @@ class TaskAbortedError(RuntimeError):
 class Driver:
     """Runs one pipeline to completion (Driver.processInternal analogue)."""
 
-    def __init__(self, pipeline: Pipeline, should_stop=None):
+    def __init__(self, pipeline: Pipeline, should_stop=None, observer=None):
         self.ops = pipeline.operators
         self._finish_signalled = [False] * len(self.ops)
         self._should_stop = should_stop
+        # observer(op_name, moved) fires after every batch move (moved=
+        # True) and on blocked waits (moved=False) — the stuck-task
+        # watchdog's per-batch heartbeat (TaskExecution._on_batch):
+        # a task whose heartbeat goes stale past stuck_task_interrupt_s
+        # is interrupted through should_stop
+        self._observer = observer
 
     def run(self) -> None:
         ops = self.ops
@@ -66,16 +72,24 @@ class Driver:
                         break
                     nxt.add_input(out)
                     progressed = True
+                    if self._observer is not None:
+                        self._observer(type(cur).__name__, True)
                 # finish cascade (Driver.java:417)
                 if cur.is_finished() and not self._finish_signalled[i + 1]:
                     nxt.finish()
                     self._finish_signalled[i + 1] = True
                     progressed = True
             if not progressed and not ops[-1].is_finished():
-                if any(o.is_blocked() for o in ops):
+                blocked = [o for o in ops if o.is_blocked()]
+                if blocked:
                     # blocked on remote pages / buffer space: yield the
                     # thread (Driver.java:446 union of blocked futures,
-                    # collapsed to a poll-and-sleep)
+                    # collapsed to a poll-and-sleep). This is NOT "stuck"
+                    # — starvation on input is the UPSTREAM task's
+                    # problem (its own watchdog names the real culprit),
+                    # so the heartbeat stays fresh here
+                    if self._observer is not None:
+                        self._observer(type(blocked[0]).__name__, False)
                     import time
 
                     time.sleep(0.001)

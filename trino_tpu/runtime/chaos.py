@@ -54,6 +54,16 @@ LIFECYCLE_CLASSES = (
     "straggler_speculation",
 )
 
+# time-bounding scenarios (PR 4): a hung operator the worker watchdog
+# must interrupt (and FTE must retry elsewhere — query still correct),
+# and a client that vanishes mid-query (reaper must cancel the query,
+# free its resource-group slot, and drain its memory reservations to
+# zero). Run via run_hung_operator_case / run_abandoned_client_case.
+TIMEBOUND_CLASSES = (
+    "hung_operator",
+    "abandoned_client",
+)
+
 
 def generate_schedule(
     seed: int,
@@ -165,6 +175,20 @@ class DownableWorker:
         # treats delivery as best-effort anyway
         self._inner.shutdown_gracefully()
 
+    # -- stuck-task watchdog passthrough (PR 4 timebound cases) --
+    def watchdog_once(self, now=None):
+        return self._inner.watchdog_once(now)
+
+    def start_watchdog(self, poll_s: float = 0.01) -> None:
+        self._inner.start_watchdog(poll_s)
+
+    def stop_watchdog(self) -> None:
+        self._inner.stop_watchdog()
+
+    @property
+    def watchdog_interrupts(self):
+        return self._inner.watchdog_interrupts
+
     @property
     def state(self):
         return getattr(self._inner, "state", "active")
@@ -212,6 +236,7 @@ class ChaosHarness:
         catalogs: Optional[Dict[str, object]] = None,
         hash_partitions: int = 2,
         memory_pool_bytes: Optional[int] = None,
+        stuck_task_interrupt_s: Optional[float] = None,
     ):
         from trino_tpu.engine import Session
         from trino_tpu.runtime.coordinator import DistributedQueryRunner
@@ -233,9 +258,18 @@ class ChaosHarness:
                 f"chaos-w{i}", self._catalogs,
                 failure_injector=self.injector,
                 memory_pool_bytes=memory_pool_bytes,
+                stuck_task_interrupt_s=stuck_task_interrupt_s,
             ))
             for i in range(n_workers)
         ]
+        # NOTE: workers carry the watchdog threshold but it is NOT
+        # armed here — run_hung_operator_case arms it around its own
+        # execution, after a warm run has compiled every jit shape the
+        # plan needs. Armed from birth, the watchdog would kill healthy
+        # COLD tasks (first-use XLA compilation and connector data
+        # generation happen inside one batch and dwarf any test-speed
+        # threshold), and each retry would re-block on the same warm-up.
+        self.stuck_task_interrupt_s = stuck_task_interrupt_s
         self.runner = DistributedQueryRunner(
             self.session,
             worker_handles=self.workers,
@@ -380,6 +414,137 @@ class ChaosHarness:
             self.injector.clear()
         return rows, dict(self.runner.last_fte_stats or {})
 
+    # -- time-bounding scenarios (watchdog + client-abandonment reaper) --
+
+    def run_hung_operator_case(
+        self, sql: str, seed: int = 0, stall_s: float = 8.0,
+    ) -> Tuple[List[list], dict]:
+        """One leaf task WEDGES mid-batch (a hung operator, not a slow
+        one: its heartbeat goes stale, where a straggler's keeps
+        ticking). The worker watchdog must interrupt it with a
+        diagnostic naming the stuck operator; the failure is retryable,
+        so FTE re-runs the partition (attempt 1 matches no rule) and the
+        query completes correctly — in far less wall time than the
+        stall, which is the no-query-may-hang-the-cluster property.
+
+        Heartbeats are batch-granular, so the watchdog threshold must
+        comfortably exceed the plan's honest single-batch duration or
+        healthy tasks get flagged. The floor is set by jit: a fresh
+        shape triggers an XLA lowering burst (~0.3s on CPU) INSIDE one
+        batch, and retries perturb batch capacities (dynamic-filter
+        pruning differs per surviving attempt) so no warm run covers
+        every shape — thresholds under ~1s WILL kill healthy tasks.
+        Operator-internal heartbeats are the recorded follow-up that
+        would allow tens-of-ms thresholds."""
+        rng = random.Random(seed)
+        # warm run first: compiles every jit shape this plan touches, so
+        # once the watchdog arms, the only task that can miss a
+        # heartbeat for stuck_task_interrupt_s is the genuinely wedged
+        # one (a cold compile inside one batch looks identical to a
+        # hang at batch granularity). Its duration is the honest-work
+        # baseline: the un-wedged proof is elapsed - warm < stall (the
+        # injected stall abort-polls, so a killed task wakes early and
+        # only a BROKEN watchdog ever waits out the full stall)
+        t_warm = time.monotonic()
+        self.run_clean(sql)
+        warm_clean_s = time.monotonic() - t_warm
+        self.injector.inject(
+            where="batch", fragment_id=0, partition=rng.randrange(2),
+            attempts=(0,), stall_s=stall_s, max_hits=1,
+        )
+        # speculation would race the watchdog to the rescue (a duplicate
+        # attempt commits and cancels the wedged loser) — turn it off so
+        # THIS case proves the watchdog path alone unhangs the query
+        was_spec = getattr(self.session, "speculation_enabled", True)
+        self.session.speculation_enabled = False
+        for w in self.workers:
+            w.start_watchdog()
+        t0 = time.monotonic()
+        try:
+            rows = self.runner.execute(sql).rows
+        finally:
+            for w in self.workers:
+                w.stop_watchdog()
+            self.session.speculation_enabled = was_spec
+            self.injector.clear()
+        report = dict(self.runner.last_fte_stats or {})
+        report["elapsed_s"] = time.monotonic() - t0
+        report["warm_clean_s"] = warm_clean_s
+        report["stall_s"] = stall_s
+        report["watchdog_interrupts"] = [
+            d for w in self.workers for _, d in w.watchdog_interrupts
+        ]
+        return rows, report
+
+    def run_abandoned_client_case(
+        self, sql: str, seed: int = 0, stall_s: float = 4.0,
+        client_timeout_s: float = 0.2,
+    ) -> Tuple[Optional[List[list]], dict]:
+        """Submit through the HTTP server's job path, then VANISH —
+        never poll the results page. The reaper must notice within
+        client_timeout_s, cancel the query (the runner's `cancel` hook
+        unwinds every running task), release the resource-group slot,
+        and drain the query's memory reservations back to zero. The
+        injected batch stall keeps the query mid-flight (with pages in
+        memory) when abandonment lands; it abort-polls, so teardown
+        never waits out the full stall."""
+        from trino_tpu.runtime.resource_groups import (
+            ResourceGroupManager,
+            ResourceGroupSpec,
+        )
+        from trino_tpu.runtime.server import CoordinatorServer
+
+        rg = ResourceGroupManager(
+            ResourceGroupSpec("global", max_concurrency=4)
+        )
+        self.injector.clear()
+        self.injector.inject(
+            where="batch", attempts=(0,), stall_s=stall_s,
+            max_hits=1_000,
+        )
+        server = CoordinatorServer(
+            self.runner,
+            resource_groups=rg,
+            client_timeout_s=client_timeout_s,
+            reap_interval_s=0.05,
+        )
+
+        def ledgers() -> Dict[str, Dict[str, int]]:
+            return {
+                w.worker_id: dict(w.memory_pool.query_reservations())
+                for w in self.workers
+                if w.memory_pool is not None
+            }
+
+        try:
+            job = server._submit(sql)
+            peak_reserved = 0
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                peak_reserved = max(
+                    peak_reserved,
+                    sum(sum(l.values()) for l in ledgers().values()),
+                )
+                if (
+                    job.finished_at is not None
+                    and rg.total_running() == 0
+                    and all(not l for l in ledgers().values())
+                ):
+                    break
+                time.sleep(0.01)
+            report = {
+                "reaped": job.state == "failed"
+                and "abandoned" in (job.error or "").lower(),
+                "error": job.error,
+                "rg_running": rg.total_running(),
+                "ledgers": ledgers(),
+                "peak_reserved_bytes": peak_reserved,
+            }
+            return None, report
+        finally:
+            self.injector.clear()
+            server.stop()
+
 
 def chaos_smoke(
     seed: int,
@@ -478,4 +643,96 @@ def chaos_smoke(
                 f"losses={report.get('speculation_losses')} "
                 f"max_attempts={max(app.values(), default=0)}"
             )
+    # time-bounding scenarios (PR 4): watchdog + abandonment reaper;
+    # fresh harnesses again (the abandoned case leaves a dead query in
+    # its server, the hung case arms a watchdog). The agg shape is the
+    # right query here: its batch capacities do not depend on which
+    # attempt survives, so one warm run covers every jit shape a retry
+    # can touch. The join's dynamic-filter pruning makes retry batch
+    # capacities attempt-dependent — each retry hits a FRESH >1s XLA
+    # lowering inside one batch, indistinguishable from a hang at any
+    # test-speed threshold
+    timebound_sql = lifecycle_sql
+    for scenario in TIMEBOUND_CLASSES:
+        h = ChaosHarness(
+            n_workers=3,
+            stuck_task_interrupt_s=1.0,
+            memory_pool_bytes=256 << 20,
+        )
+        h.register_catalog("tpch", create_tpch_connector())
+        if scenario == "hung_operator":
+            expected = h.run_clean(timebound_sql)
+            try:
+                rows, report = h.run_hung_operator_case(
+                    timebound_sql, seed
+                )
+            except Exception as e:
+                failures.append(
+                    f"timebound/{scenario}: raised "
+                    f"{type(e).__name__}: {e}"
+                )
+                continue
+            ordered = "order by" in timebound_sql.lower()
+            if not rows_equal(rows, expected, ordered=ordered):
+                failures.append(
+                    f"timebound/{scenario}: rows diverged from clean "
+                    f"run ({len(rows)} vs {len(expected)})"
+                )
+            interrupts = report.get("watchdog_interrupts") or []
+            if not interrupts:
+                failures.append(
+                    f"timebound/{scenario}: watchdog never fired"
+                )
+            elif not any("in operator" in d for d in interrupts):
+                failures.append(
+                    f"timebound/{scenario}: diagnostic does not name "
+                    f"the stuck operator ({interrupts[0]!r})"
+                )
+            overhead = report["elapsed_s"] - report["warm_clean_s"]
+            if overhead >= report["stall_s"]:
+                failures.append(
+                    f"timebound/{scenario}: query waited out the full "
+                    f"stall (recovery overhead {overhead:.2f}s >= "
+                    f"{report['stall_s']}s) — the watchdog did not "
+                    f"unwedge it"
+                )
+            if verbose:
+                print(
+                    f"  chaos timebound/{scenario}: ok rows={len(rows)} "
+                    f"elapsed={report['elapsed_s']:.2f}s "
+                    f"(warm clean {report['warm_clean_s']:.2f}s) "
+                    f"interrupts={len(interrupts)}"
+                )
+        else:  # abandoned_client
+            try:
+                _, report = h.run_abandoned_client_case(
+                    timebound_sql, seed
+                )
+            except Exception as e:
+                failures.append(
+                    f"timebound/{scenario}: raised "
+                    f"{type(e).__name__}: {e}"
+                )
+                continue
+            if not report["reaped"]:
+                failures.append(
+                    f"timebound/{scenario}: query was not reaped "
+                    f"(error={report['error']!r})"
+                )
+            if report["rg_running"] != 0:
+                failures.append(
+                    f"timebound/{scenario}: resource-group slot leaked "
+                    f"({report['rg_running']} still running)"
+                )
+            if any(report["ledgers"].values()):
+                failures.append(
+                    f"timebound/{scenario}: memory ledger not drained "
+                    f"({report['ledgers']})"
+                )
+            if verbose:
+                print(
+                    f"  chaos timebound/{scenario}: ok "
+                    f"peak_reserved={report['peak_reserved_bytes']} "
+                    f"ledgers_drained=True rg_running=0"
+                )
     return failures

@@ -270,6 +270,12 @@ class DistributedQueryRunner:
                 fail_query=self._fail_query_on_workers,
             )
             self.memory_manager.install()
+        # deadline hierarchy (runtime/query_tracker.py): every Query
+        # statement registers here; the enforcement tick thread starts
+        # lazily, on the first query that actually carries limits
+        from trino_tpu.runtime.query_tracker import QueryTracker
+
+        self.query_tracker = QueryTracker()
 
     def _fail_query_on_workers(self, query_id: str, message: str) -> None:
         for w in self.workers:
@@ -338,8 +344,13 @@ class DistributedQueryRunner:
 
     # -- entry point --
     def execute(
-        self, sql: str, identity=None, transaction_id=None
+        self, sql: str, identity=None, transaction_id=None,
+        prepared=None, cancel=None,
     ) -> MaterializedResult:
+        """`cancel` is a zero-arg callable polled while the query runs
+        (the client-abandonment reaper's hook): once it returns True the
+        query is torn down — tasks aborted, memory released — instead of
+        computing a result nobody will read."""
         stmt = parse(sql)
         if isinstance(stmt, ast.ExplainStatement):
             output = self._analyze(stmt.query)
@@ -361,8 +372,45 @@ class DistributedQueryRunner:
             # runner per statement would silently autocommit)
             return self._embedded_runner().execute(
                 sql, identity=identity,
-                transaction_id=transaction_id,
+                transaction_id=transaction_id, prepared=prepared,
             )
+        from trino_tpu.runtime.query_tracker import DeadlineLimits, PLANNING
+
+        limits = DeadlineLimits.from_session(self.session)
+        # retry_policy=QUERY deterministic replay: every attempt re-runs
+        # the SAME plan under a fresh internal task namespace (qN, qNr1,
+        # qNr2, ...) — create_task is idempotent BY ID, so reusing the
+        # first attempt's ids would hand back its dead TaskExecutions.
+        # No dot in the suffix: task keys are matched by the
+        # `query_id + "."` prefix and attempts must never cross-match.
+        base_qid = f"q{next(_query_counter)}"
+        tracker = self.query_tracker
+        tq = tracker.register(base_qid, limits, phase=PLANNING)
+        # bound late: the kill must target whichever ATTEMPT namespace is
+        # live when the tick fires (live_query_id tracks qN/qNr1/...)
+        tq.kill = lambda msg: self._fail_query_on_workers(
+            tq.live_query_id, msg
+        )
+        if limits.any():
+            tracker.start()
+        try:
+            return self._execute_query(
+                stmt, identity, base_qid, tq, limits, cancel
+            )
+        finally:
+            tracker.complete(base_qid)
+
+    def _execute_query(
+        self, stmt, identity, base_qid, tq, limits, cancel
+    ) -> MaterializedResult:
+        from trino_tpu.runtime.query_tracker import (
+            EXECUTING,
+            QueryDeadlineError,
+            deadline_code,
+            deadline_error,
+        )
+
+        tracker = self.query_tracker
         output = self._analyze(stmt)
         # reset BEFORE any plane decision: a stale reason from an earlier
         # query must not read as applying to this one
@@ -374,54 +422,90 @@ class DistributedQueryRunner:
             broadcast_threshold=self.session.broadcast_join_threshold,
             target_splits=self.session.target_splits,
         )
+        # planning is over: surface a planning-limit kill latched during
+        # the analyze/optimize/fragment work before any task launches
+        tracker.check(base_qid)
+        tracker.transition(base_qid, EXECUTING)
         result_meta = (list(output.names), [f.type for f in output.fields])
         if self.session.retry_policy == "task":
-            rows = self._execute_fte(subplan)
+            rows = self._execute_fte(
+                subplan, query_id=base_qid, cancel=cancel, tq=tq
+            )
             return MaterializedResult(rows, *result_meta, data_plane="fte")
         if self.session.mesh_execution and self._mesh_colocated():
-            # tasks share one host's device mesh: the exchange rides ICI
-            # collectives in one SPMD program (parallel/mesh_plan.py);
-            # unsupported plan shapes fall back to the page exchange
-            from trino_tpu.parallel.mesh_plan import MeshExecutor, MeshUnsupported
-
-            try:
-                rows = MeshExecutor(self.catalogs, self.session).execute(subplan)
-                return MaterializedResult(
-                    rows, *result_meta, data_plane="mesh"
-                )
-            except MeshUnsupported as ex:
-                # fallback must be OBSERVABLE, not silent: count it and
-                # record why (EXPLAIN ANALYZE / stats surface this)
+            if limits.any():
+                # the mesh plane runs ONE uninterruptible SPMD program —
+                # a deadline kill could not preempt it mid-collective, so
+                # bounded queries take the page exchange (observable
+                # fallback, like any unsupported plan shape)
                 from trino_tpu.parallel.mesh_plan import MESH_COUNTERS
 
                 MESH_COUNTERS["fallbacks"] += 1
-                self.last_mesh_fallback = str(ex)
-            except Exception:
-                # unexpected mesh runtime failure: the page-exchange path
-                # below re-executes from scratch (correctness preserved),
-                # but surface the regression instead of hiding it
-                import logging
-
-                logging.getLogger(__name__).warning(
-                    "mesh execution failed; falling back to page exchange",
-                    exc_info=True,
+                self.last_mesh_fallback = (
+                    "deadline limits set: mesh execution cannot be "
+                    "interrupted mid-program"
                 )
+            else:
+                # tasks share one host's device mesh: the exchange rides
+                # ICI collectives in one SPMD program
+                # (parallel/mesh_plan.py); unsupported plan shapes fall
+                # back to the page exchange
+                from trino_tpu.parallel.mesh_plan import (
+                    MeshExecutor,
+                    MeshUnsupported,
+                )
+
+                try:
+                    rows = MeshExecutor(
+                        self.catalogs, self.session
+                    ).execute(subplan)
+                    return MaterializedResult(
+                        rows, *result_meta, data_plane="mesh"
+                    )
+                except MeshUnsupported as ex:
+                    # fallback must be OBSERVABLE, not silent: count it
+                    # and record why (EXPLAIN ANALYZE / stats surface it)
+                    from trino_tpu.parallel.mesh_plan import MESH_COUNTERS
+
+                    MESH_COUNTERS["fallbacks"] += 1
+                    self.last_mesh_fallback = str(ex)
+                except Exception:
+                    # unexpected mesh runtime failure: the page-exchange
+                    # path below re-executes from scratch (correctness
+                    # preserved), but surface the regression
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "mesh execution failed; falling back to page "
+                        "exchange",
+                        exc_info=True,
+                    )
         attempts = (
             1 + self.session.query_retry_count
             if self.session.retry_policy == "query"
             else 1
         )
         last_error: Optional[BaseException] = None
-        # retry_policy=QUERY deterministic replay: every attempt re-runs
-        # the SAME plan under a fresh internal task namespace (qN, qNr1,
-        # qNr2, ...) — create_task is idempotent BY ID, so reusing the
-        # first attempt's ids would hand back its dead TaskExecutions.
-        # No dot in the suffix: task keys are matched by the
-        # `query_id + "."` prefix and attempts must never cross-match.
-        base_qid = f"q{next(_query_counter)}"
+        accrued_cpu = 0.0  # CPU spent by completed attempts
         for attempt in range(attempts):
             query_id = base_qid if attempt == 0 else f"{base_qid}r{attempt}"
             self.last_query_attempts = attempt + 1
+            tracker.set_live_query_id(base_qid, query_id)
+            # a deadline kill latched between attempts ends the query
+            # here — resubmitting a spent budget can only spend it again
+            tracker.check(base_qid)
+            if cancel is not None and cancel():
+                # nobody is waiting for this result: don't launch (or
+                # re-launch) tasks for it
+                raise RuntimeError(
+                    f"Query {base_qid} abandoned: client stopped "
+                    "polling results"
+                )
+            if attempt > 0:
+                # a stale cached split listing may be WHY the last
+                # attempt died (files compacted/deleted under it):
+                # re-list before replaying
+                self.catalogs.invalidate_split_listings()
             scheduler = QueryScheduler(
                 query_id,
                 subplan,
@@ -429,6 +513,12 @@ class DistributedQueryRunner:
                 self.catalogs,
                 self.session,
                 self.hash_partitions,
+            )
+            # the CPU budget reads the live attempt's task ledgers on
+            # top of what earlier attempts already burned
+            tq.cpu_time_fn = (
+                lambda s=scheduler, base=accrued_cpu:
+                base + _scheduler_cpu_s(s)
             )
             try:
                 # start() inside the try: a mid-launch failure must still
@@ -438,12 +528,24 @@ class DistributedQueryRunner:
                 # so catch broadly here — analysis errors were raised
                 # before this loop.
                 root_handle, root_tid = scheduler.start()
-                rows = self._collect(scheduler, root_handle, root_tid)
+                rows = self._collect(
+                    scheduler, root_handle, root_tid,
+                    cancel=cancel, base_qid=base_qid,
+                )
                 return MaterializedResult(
                     rows, *result_meta, data_plane="http"
                 )
+            except QueryDeadlineError:
+                raise  # non-retryable by classification
             except Exception as e:
-                last_error = e  # retry_policy=QUERY: whole-query re-run
+                if deadline_code(str(e)) is not None:
+                    # a deadline kill that travelled as a task-failure
+                    # string (HTTP 500 body, buffer-abort unwind):
+                    # re-type it so it stays non-retryable
+                    raise deadline_error(str(e)) from e
+                # retry_policy=QUERY: whole-query re-run
+                accrued_cpu += _scheduler_cpu_s(scheduler)
+                last_error = e
             finally:
                 scheduler.abort()
         raise last_error
@@ -500,7 +602,9 @@ class DistributedQueryRunner:
         finally:
             scheduler.abort()
 
-    def _execute_fte(self, subplan) -> List[list]:
+    def _execute_fte(
+        self, subplan, query_id=None, cancel=None, tq=None
+    ) -> List[list]:
         """retry_policy=TASK: FTE over the spooled exchange."""
         import shutil
         import tempfile
@@ -508,7 +612,7 @@ class DistributedQueryRunner:
         from trino_tpu.runtime.fte import FaultTolerantQueryScheduler
         from trino_tpu.runtime.spool import read_spool
 
-        query_id = f"q{next(_query_counter)}"
+        query_id = query_id or f"q{next(_query_counter)}"
         spool_dir = tempfile.mkdtemp(prefix=f"trino-tpu-spool-{query_id}-")
         try:
             scheduler = FaultTolerantQueryScheduler(
@@ -522,10 +626,15 @@ class DistributedQueryRunner:
                 max_task_retries=self.session.task_retries,
                 node_manager=self.node_manager,
             )
+            if tq is not None:
+                # CPU budget over the FTE attempt ledgers (polled task
+                # states carry cpu_s; finished attempts keep their last
+                # reading in the scheduler's per-task dict)
+                tq.cpu_time_fn = scheduler.cpu_time_s
             from trino_tpu.runtime.fte import TaskRetriesExceeded
 
             try:
-                _, root_key = scheduler.run()
+                _, root_key = scheduler.run(cancel=cancel)
             except TaskRetriesExceeded as e:
                 if "ExceededMemoryLimitError" in str(e) or (
                     "low-memory killer" in str(e)
@@ -545,6 +654,14 @@ class DistributedQueryRunner:
                     "speculation_losses": scheduler.speculation_losses,
                     "attempts_per_partition": dict(
                         scheduler.attempts_per_partition
+                    ),
+                    # which quantile sized the straggler threshold, and
+                    # the per-fragment wall-time estimates it produced
+                    "speculation_percentile": (
+                        scheduler.speculation_percentile
+                    ),
+                    "speculation_estimates": dict(
+                        scheduler.speculation_estimates
                     ),
                 }
             import os
@@ -578,13 +695,29 @@ class DistributedQueryRunner:
         )
         return optimize(analyzer.plan(q), self.catalogs, self.session)
 
-    def _collect(self, scheduler: QueryScheduler, handle, tid) -> List[list]:
+    def _collect(
+        self, scheduler: QueryScheduler, handle, tid,
+        cancel=None, base_qid=None,
+    ) -> List[list]:
         """Pull the root stage's single output partition (the
         Query.getNextResult / removePagesFromExchange path,
         server/protocol/Query.java:450)."""
         rows: List[list] = []
         token = 0
         while True:
+            if cancel is not None and cancel():
+                # client abandonment: raising here unwinds into the
+                # retry loop's finally — scheduler.abort() removes every
+                # task, whose own finally closes its memory contexts, so
+                # the pools ledger drains back to zero
+                raise RuntimeError(
+                    f"Query {scheduler.query_id} abandoned: client "
+                    "stopped polling results"
+                )
+            if base_qid is not None:
+                # deadline kills latch on the tracker before the failed
+                # task states propagate — surface the typed error first
+                self.query_tracker.check(base_qid)
             self._raise_if_failed(scheduler)
             try:
                 pages, token, complete = handle.get_results(
@@ -610,6 +743,16 @@ class DistributedQueryRunner:
         if not failed:
             return
         msg = "; ".join(failed)
+        from trino_tpu.runtime.query_tracker import (
+            deadline_code,
+            deadline_error,
+        )
+
+        if deadline_code(msg) is not None:
+            # a QueryTracker kill message embeds its error code — the
+            # query-level verdict is the typed, NON-RETRYABLE error, not
+            # a generic task failure the retry layers would replay
+            raise deadline_error("query failed: " + msg)
         if "ExceededMemoryLimitError" in msg or "low-memory killer" in msg:
             # memory kill is a QUERY-level verdict: the caller sees the
             # typed error while other queries (and the worker) keep
@@ -618,6 +761,21 @@ class DistributedQueryRunner:
 
             raise ExceededMemoryLimitError("query failed: " + msg)
         raise RuntimeError("query failed: " + msg)
+
+
+def _scheduler_cpu_s(scheduler) -> float:
+    """Aggregate a pipelined attempt's task CPU ledgers (the `cpu_s`
+    field every status poll carries) — the query_max_cpu_time_s input."""
+    total = 0.0
+    for ts in scheduler.tasks.values():
+        for handle, tid in ts:
+            try:
+                total += float(
+                    handle.task_state(tid).get("cpu_s") or 0.0
+                )
+            except Exception:
+                pass  # vanished task: its CPU is unknowable, not fatal
+    return total
 
 
 def _page_rows(page: Page) -> List[list]:

@@ -29,7 +29,10 @@ class FailureRule:
     fragment_id: Optional[int] = None  # None = any
     partition: Optional[int] = None
     attempts: Tuple[int, ...] = (0,)  # which attempt numbers fail
-    where: str = "start"  # "start" | "mid" | "fetch"
+    # "start" | "mid" | "fetch" | "batch" — "batch" fires at a driver
+    # batch boundary (TaskExecution._on_batch), where a stall models a
+    # HUNG OPERATOR the stuck-task watchdog must interrupt
+    where: str = "start"
     max_hits: int = 1_000_000
     # straggler simulation: sleep this long instead of raising
     # (drives the speculative-execution path in tests)
@@ -71,9 +74,12 @@ class FailureInjector:
             self._rules.clear()
             self._hits.clear()
 
-    def check(self, task_id, where: str) -> None:
+    def check(self, task_id, where: str, abort=None) -> None:
         """Raise InjectedFailure if a rule matches (task_id carries
-        fragment/partition/attempt)."""
+        fragment/partition/attempt). A matching STALL sleeps in small
+        chunks polling `abort` (zero-arg callable): a stalled task the
+        watchdog already failed wakes promptly instead of pinning its
+        thread for the full stall."""
         with self._lock:
             for i, r in enumerate(self._rules):
                 if r.where != where:
@@ -95,4 +101,11 @@ class FailureInjector:
                 return
         import time
 
-        time.sleep(stall)
+        deadline = time.monotonic() + stall
+        while True:
+            if abort is not None and abort():
+                return
+            left = deadline - time.monotonic()
+            if left <= 0:
+                return
+            time.sleep(min(0.01, left))

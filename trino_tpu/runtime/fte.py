@@ -26,6 +26,19 @@ class TaskRetriesExceeded(RuntimeError):
     pass
 
 
+def _quantile(sorted_vals: List[float], q: float) -> float:
+    """Linear-interpolated quantile of an ascending list (the numpy
+    default method, done by hand — no device round trip for a handful
+    of wall times)."""
+    if len(sorted_vals) == 1:
+        return sorted_vals[0]
+    idx = q * (len(sorted_vals) - 1)
+    lo = int(idx)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = idx - lo
+    return sorted_vals[lo] * (1.0 - frac) + sorted_vals[hi] * frac
+
+
 class _LaunchFailed(Exception):
     def __init__(self, handle, exc):
         self.handle = handle
@@ -82,17 +95,30 @@ class FaultTolerantQueryScheduler:
         self.allocator = BinPackingNodeAllocator(node_manager=node_manager)
         self.estimator = PartitionMemoryEstimator()
         # straggler mitigation: duplicate attempts for tasks running
-        # `speculation_quantile`x beyond the stage's median COMMITTED-
-        # attempt wall time, provided a spare schedulable worker exists;
-        # first attempt to commit wins (the one-committed-attempt-per-
-        # partition selector), the loser is cancelled cooperatively
+        # `speculation_quantile`x beyond the stage's PER-FRAGMENT p75
+        # (speculation_percentile) of committed-attempt wall times,
+        # provided a spare schedulable worker exists; first attempt to
+        # commit wins (the one-committed-attempt-per-partition
+        # selector), the loser is cancelled cooperatively. The upper
+        # quantile beats the old median on skewed stages: half the tasks
+        # being "slow-ish" no longer drags the threshold down and
+        # triggers duplicate storms.
         self.enable_speculation = getattr(session, "speculation_enabled", True)
         self.speculation_quantile = float(
             getattr(session, "speculation_quantile", 2.0)
         )
+        self.speculation_percentile = float(
+            getattr(session, "speculation_percentile", 0.75)
+        )
+        # fragment id -> the quantile wall-time estimate last used to
+        # size its straggler threshold (surfaced in last_fte_stats)
+        self.speculation_estimates: Dict[int, float] = {}
         self.speculative_hits = 0  # speculative attempts launched
         self.speculation_wins = 0  # ...that committed first
         self.speculation_losses = 0  # ...cancelled or failed
+        # task id -> last polled thread-CPU seconds (Worker.task_state
+        # "cpu_s"): summed into the query_max_cpu_time_s budget
+        self.cpu_by_task: Dict[str, float] = {}
         # "fragment.partition" -> attempts ever launched (observability:
         # chaos/bench assert attempt counts stay bounded per partition)
         self.attempts_per_partition: Dict[str, int] = {}
@@ -112,11 +138,18 @@ class FaultTolerantQueryScheduler:
         else:
             self.node_manager.report_failure(wid)
 
+    def cpu_time_s(self) -> float:
+        """Query-wide CPU spent, from the last polled per-task ledgers
+        (finished/failed attempts keep their final reading)."""
+        return sum(self.cpu_by_task.values())
+
     # scheduling is stage-by-stage: children complete before parents run
-    def run(self) -> Tuple[object, str]:
+    def run(self, cancel=None) -> Tuple[object, str]:
         """Execute every stage; returns (root worker handle, root task
         key) for result fetching (root output is spooled too, so any
-        handle can serve it — we return the one that ran it)."""
+        handle can serve it — we return the one that ran it). `cancel`
+        is polled between scheduling rounds: client abandonment tears
+        the query down instead of finishing work nobody reads."""
         from trino_tpu.runtime.stages import stage_task_count, topo_order
 
         order = topo_order(self.subplan)
@@ -135,11 +168,25 @@ class FaultTolerantQueryScheduler:
             root_handle = self._run_stage(
                 sp, task_counts[sp.fragment.id],
                 consumer_counts.get(sp.fragment.id, 1),
+                cancel=cancel,
             )
         root_key = self.committed[(self.subplan.fragment.id, 0)]
         return root_handle, root_key
 
-    def _run_stage(self, sp: SubPlan, tc: int, n_out: int):
+    @staticmethod
+    def _abort_running(running: Dict[int, List[Tuple]]) -> None:
+        """Cooperatively cancel every in-flight attempt (deadline kill /
+        abandonment unwind): remove_task flips each task's state machine
+        so its driver stops at the next batch boundary and its memory
+        contexts close."""
+        for entries in running.values():
+            for h, tid, _, _, _ in entries:
+                try:
+                    h.remove_task(tid)
+                except Exception:
+                    pass
+
+    def _run_stage(self, sp: SubPlan, tc: int, n_out: int, cancel=None):
         from trino_tpu.runtime.stages import fragment_schema
 
         f = sp.fragment
@@ -235,6 +282,12 @@ class FaultTolerantQueryScheduler:
             return handle
 
         while pending or running:
+            if cancel is not None and cancel():
+                self._abort_running(running)
+                raise RuntimeError(
+                    f"Query {self.query_id} abandoned: client stopped "
+                    "polling results"
+                )
             if not list(self._active_fn()):
                 raise TaskRetriesExceeded("no active workers")
             # launch
@@ -256,7 +309,20 @@ class FaultTolerantQueryScheduler:
             # poll
             time.sleep(0.01)
             now = time.monotonic()
-            median = sorted(durations)[len(durations) // 2] if durations else None
+            # straggler threshold: the per-fragment p75 (or whatever
+            # speculation_percentile says) of committed wall times. The
+            # availability gate is a QUARTER of the stage (min 1): an
+            # upper quantile stabilizes on fewer samples than the old
+            # median-of-half, so skewed stages speculate sooner — and a
+            # 2-task stage must still speculate off its single committed
+            # sibling, exactly the case where one straggler IS half the
+            # stage.
+            est_wall = None
+            if len(durations) >= max(1, -(-tc // 4)):
+                est_wall = _quantile(
+                    sorted(durations), self.speculation_percentile
+                )
+                self.speculation_estimates[f.id] = est_wall
             for p, entries in list(running.items()):
                 finished_entry = None
                 next_entries = []
@@ -271,6 +337,8 @@ class FaultTolerantQueryScheduler:
                             "state": "failed",
                             "failure": f"worker unreachable: {e}",
                         }
+                    if "cpu_s" in st:
+                        self.cpu_by_task[tid] = float(st["cpu_s"] or 0.0)
                     if st["state"] == "finished":
                         if finished_entry is None:
                             finished_entry = entry
@@ -279,15 +347,27 @@ class FaultTolerantQueryScheduler:
                         continue
                     if st["state"] == "failed":
                         self.allocator.release(handle, est)
+                        fmsg = st.get("failure")
+                        from trino_tpu.runtime.query_tracker import (
+                            deadline_code,
+                            deadline_error,
+                        )
+
+                        if deadline_code(fmsg) is not None:
+                            # deadline kill: NON-RETRYABLE by contract —
+                            # replaying a task of a query whose budget
+                            # is spent can only spend it again. Contrast
+                            # watchdog interrupts (no code), which stay
+                            # in the normal retry path below.
+                            self._abort_running(running)
+                            raise deadline_error(f"task {tid}: {fmsg}")
                         if tid in self._speculative_tids:
                             self.speculation_losses += 1
-                        self.estimator.register_failure(
-                            f.id, st.get("failure")
-                        )
+                        self.estimator.register_failure(f.id, fmsg)
                         if len(entries) == 1 and attempt + 1 > self.max_task_retries:
                             raise TaskRetriesExceeded(
                                 f"task {tid} failed after {attempt + 1} "
-                                f"attempts: {st.get('failure')}"
+                                f"attempts: {fmsg}"
                             )
                         self.retries += 1
                         avoid[p] = handle
@@ -313,10 +393,9 @@ class FaultTolerantQueryScheduler:
                 if (
                     self.enable_speculation
                     and len(next_entries) == 1
-                    and median is not None
-                    and len(durations) * 2 >= tc
+                    and est_wall is not None
                     and now - next_entries[0][3]
-                    > max(self.speculation_quantile * median, 0.25)
+                    > max(self.speculation_quantile * est_wall, 0.25)
                     and attempt_hwm[p] < self.max_task_retries
                 ):
                     handle = next_entries[0][0]

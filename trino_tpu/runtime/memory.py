@@ -62,6 +62,14 @@ class MemoryPool:
         with self._lock:
             return dict(self._by_query)
 
+    def query_reserved_bytes(self, query_id: str) -> int:
+        """One query's live reservation in this pool (0 once every task
+        memory context closed). The abandonment reaper's ledger check —
+        a reaped query must drain to zero here, or its bytes poison the
+        shared pool for every later query."""
+        with self._lock:
+            return self._by_query.get(query_id, 0)
+
     def doom_query(self, query_id: str, message: str) -> None:
         """Mark a query dead-on-next-reservation: its operator threads
         unwind with ExceededMemoryLimitError(message) at their next

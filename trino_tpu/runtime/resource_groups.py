@@ -245,6 +245,14 @@ class ResourceGroupManager:
             self._schedule_locked()
             self._lock.notify_all()
 
+    def total_running(self) -> int:
+        """Admitted-and-not-yet-released queries across the whole tree
+        (the root's counter — every admission increments it). The
+        abandonment reaper's post-condition: after a reaped query
+        unwinds, this must drop back, or a slot leaked."""
+        with self._lock:
+            return self._root.running
+
     def stats(self) -> Dict[str, Tuple[int, int]]:
         """group path -> (running, queued)."""
         out: Dict[str, Tuple[int, int]] = {}

@@ -82,6 +82,7 @@ class _QueryJob:
         self.finished_at: Optional[float] = None  # monotonic, for TTL expiry
         self.drained = False  # final result page delivered to the client
         self.abandoned = False
+        self.created_at = time.monotonic()  # admission-queue wait base
         self.last_heartbeat = time.monotonic()  # any client poll refreshes
         self.lock = threading.Lock()
 
@@ -108,6 +109,8 @@ class CoordinatorServer:
         max_concurrent: int = 4,
         resource_groups=None,  # runtime.resource_groups.ResourceGroupManager
         authenticator=None,  # security.Authenticator; None = insecure
+        client_timeout_s: Optional[float] = None,
+        reap_interval_s: Optional[float] = None,
     ):
         from trino_tpu.security import AuthenticationError, InsecureAuthenticator
 
@@ -116,6 +119,14 @@ class CoordinatorServer:
         self.authenticator = authenticator or InsecureAuthenticator()
         self._jobs: Dict[str, _QueryJob] = {}
         self._pool = ThreadPoolExecutor(max_workers=max_concurrent)
+        # client-abandonment TTL: explicit arg wins, else the runner
+        # session's client_timeout_s, else the class default
+        if client_timeout_s is None:
+            client_timeout_s = getattr(
+                getattr(runner, "session", None), "client_timeout_s", None
+            )
+        if client_timeout_s:
+            self.CLIENT_TTL_S = float(client_timeout_s)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -244,6 +255,30 @@ class CoordinatorServer:
             target=self._httpd.serve_forever, daemon=True
         )
         self._thread.start()
+        # abandonment reaper: _evict_completed used to run only on
+        # submit, so an idle server never noticed a vanished client —
+        # the RUNNING query it left behind kept its resource-group slot
+        # and memory forever. The reaper ticks independently of traffic;
+        # the running query observes job.abandoned through the `cancel`
+        # hook passed to runner.execute and unwinds, releasing both.
+        self._reaper_stop = threading.Event()
+        self._reap_interval_s = (
+            reap_interval_s
+            if reap_interval_s is not None
+            else max(0.05, min(1.0, self.CLIENT_TTL_S / 4.0))
+        )
+
+        def _reap_loop():
+            while not self._reaper_stop.wait(self._reap_interval_s):
+                try:
+                    self._evict_completed()
+                except Exception:
+                    pass  # a reaper crash must not take the server down
+
+        self._reaper = threading.Thread(
+            target=_reap_loop, name="client-reaper", daemon=True
+        )
+        self._reaper.start()
 
     def cluster_stats(self) -> dict:
         """ClusterStatsResource analogue."""
@@ -319,7 +354,7 @@ class CoordinatorServer:
                     j.state = "failed"
                     j.error = (
                         "Query abandoned: no client heartbeat for "
-                        f"{self.CLIENT_TTL_S:.0f}s"
+                        f"{self.CLIENT_TTL_S:g}s"
                     )
                     j.rows = []
                     j.finished_at = now
@@ -379,10 +414,45 @@ class CoordinatorServer:
                     if job.abandoned:
                         return  # expired while queued: don't run or revive
                     job.state = "running"
-                result = self.runner.execute(
-                    sql, identity=identity, transaction_id=transaction_id,
+                # query_max_run_time_s covers the QUEUED phase too: a
+                # query that burned its whole wall budget waiting for an
+                # admission slot fails typed, before launching anything
+                run_limit = float(
+                    getattr(
+                        getattr(self.runner, "session", None),
+                        "query_max_run_time_s", 0.0,
+                    ) or 0.0
+                )
+                if run_limit and (
+                    time.monotonic() - job.created_at > run_limit
+                ):
+                    from trino_tpu.runtime.query_tracker import (
+                        EXCEEDED_TIME_LIMIT,
+                        ExceededTimeLimitError,
+                    )
+
+                    raise ExceededTimeLimitError(
+                        f"Query {job.query_id} exceeded the maximum run "
+                        f"time limit of {run_limit}s while queued "
+                        f"[{EXCEEDED_TIME_LIMIT}]"
+                    )
+                kwargs = dict(
+                    identity=identity, transaction_id=transaction_id,
                     prepared=prepared or None,
                 )
+                # abandonment reaches INTO the running query: runners
+                # that take `cancel` poll it per result page / scheduling
+                # round and tear down tasks + memory when it flips
+                import inspect
+
+                try:
+                    if "cancel" in inspect.signature(
+                        self.runner.execute
+                    ).parameters:
+                        kwargs["cancel"] = lambda: job.abandoned
+                except (TypeError, ValueError):
+                    pass
+                result = self.runner.execute(sql, **kwargs)
                 with job.lock:
                     if job.abandoned:
                         return  # expired while executing: keep the verdict
@@ -465,6 +535,8 @@ class CoordinatorServer:
         return out
 
     def stop(self) -> None:
+        self._reaper_stop.set()
+        self._reaper.join(2)
         self._httpd.shutdown()
         self._httpd.server_close()
         self._pool.shutdown(wait=False)

@@ -134,6 +134,17 @@ class TaskExecution:
         self._memory_pool = memory_pool
         self._thread: Optional[threading.Thread] = None
         self._stat_groups = None  # [[OperatorStats]] when collect_stats
+        # stuck-task watchdog surface: drivers heartbeat per batch
+        # (Driver observer -> _on_batch); the watchdog compares
+        # last_progress_at against stuck_task_interrupt_s and the
+        # diagnostic names current_operator
+        self.last_progress_at: Optional[float] = None
+        self.current_operator: Optional[str] = None
+        # per-task CPU ledger (thread CPU seconds across this task's
+        # driver threads) — the coordinator QueryTracker aggregates
+        # these into the query_max_cpu_time_s budget
+        self._cpu_base: Dict[int, float] = {}
+        self._cpu_by_thread: Dict[int, float] = {}
 
     def operator_stats(self):
         """JSON-ready [[dict]] per pipeline, or None."""
@@ -175,6 +186,75 @@ class TaskExecution:
         self.buffer.abort()
         for c in self._clients:
             c.close()
+
+    # -- progress / CPU accounting (watchdog + deadline surfaces) --
+    def _stopping(self) -> bool:
+        return self.state in ("aborted", "failed")
+
+    def _on_batch(self, op_name: str, moved: bool) -> None:
+        """Driver observer: refresh the heartbeat and the CPU ledger.
+        `moved=False` marks a blocked wait (starved on input — upstream's
+        watchdog problem, not ours), which refreshes freshness without
+        consulting the injector's "batch" site."""
+        import time
+
+        if moved:
+            # only a COMPLETED batch arms the watchdog and names the
+            # operator; a blocked wait refreshes freshness but proves
+            # nothing about this task's own progress
+            self.current_operator = op_name
+        self.last_progress_at = time.monotonic()
+        tid = threading.get_ident()
+        ct = time.thread_time()
+        base = self._cpu_base.setdefault(tid, ct)
+        self._cpu_by_thread[tid] = ct - base
+        if moved and self._injector is not None:
+            # the hung-operator chaos site: a stall here models an
+            # operator wedged mid-batch; abort-polling lets a
+            # watchdog-failed task wake and unwind promptly
+            self._injector.check(
+                self.spec.task_id, "batch", abort=self._stopping
+            )
+
+    def cpu_time_s(self) -> float:
+        return sum(self._cpu_by_thread.values())
+
+    def interrupt_if_stuck(
+        self, timeout_s: float, now: Optional[float] = None
+    ) -> Optional[str]:
+        """Watchdog entry: if this RUNNING task has made no batch
+        progress for longer than timeout_s, fail it with a diagnostic
+        naming the stuck operator and its last batch timestamp, and
+        return the diagnostic. The failure carries NO deadline code —
+        stuck-task interrupts are RETRYABLE (a hung split on this worker
+        may succeed elsewhere), unlike QueryTracker deadline kills.
+
+        The watchdog arms at the FIRST batch boundary: startup work
+        before any batch (XLA compilation, cold split materialization,
+        connector data generation) is legitimate unbounded compute the
+        batch-granularity heartbeat cannot see inside, so killing on it
+        would interrupt healthy tasks — and each retry would re-block on
+        the same warm-up and die the same way. "No progress" means "was
+        progressing, then stopped"; a task wedged before its first batch
+        is the coordinator deadline hierarchy's kill, not ours."""
+        import time
+
+        if self.state != "running" or self.last_progress_at is None:
+            return None
+        if self.current_operator is None:
+            return None  # still in startup: not yet armed
+        now = time.monotonic() if now is None else now
+        age = now - self.last_progress_at
+        if age <= timeout_s:
+            return None
+        diag = (
+            f"Stuck task {self.spec.task_id}: no progress for {age:.3f}s "
+            f"(stuck_task_interrupt_s={timeout_s}) in operator "
+            f"{self.current_operator or 'task startup'}; last batch at "
+            f"t={self.last_progress_at:.3f}"
+        )
+        self.fail(diag)
+        return diag
 
     def fail(self, message: str) -> None:
         """External kill (low-memory killer, DELETE /v1/query,
@@ -220,11 +300,16 @@ class TaskExecution:
         return client
 
     def _run(self) -> None:
+        import time
+
         spec = self.spec
         ctx: dict = {
             "make_remote_source": self._make_remote_source,
             "query_id": spec.task_id.query_id,
         }
+        # heartbeat starts at task start, not first batch: a task hung
+        # before producing anything is still watchdog-visible
+        self.last_progress_at = time.monotonic()
         try:
             if self._injector is not None:
                 self._injector.check(spec.task_id, "start")
@@ -318,7 +403,7 @@ class TaskExecution:
             return self._state_machine.get() in ("aborted", "failed")
 
         def drive(p):
-            Driver(p, should_stop=stop).run()
+            Driver(p, should_stop=stop, observer=self._on_batch).run()
 
         # build pipelines run SEQUENTIALLY: the local planner emits them
         # in dependency order (a join-on-join build side embeds the

@@ -11,7 +11,7 @@ wraps these methods behind /v1/task endpoints).
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from trino_tpu.connectors.spi import CatalogManager
 from trino_tpu.runtime.task import TaskExecution, TaskId, TaskSpec
@@ -32,6 +32,7 @@ class Worker:
         failure_injector=None,
         memory_pool_bytes: Optional[int] = None,
         location: Optional[str] = None,
+        stuck_task_interrupt_s: Optional[float] = None,
     ):
         self.worker_id = worker_id
         # "rack/host" network coordinate (the ICI-island id on a TPU
@@ -50,6 +51,13 @@ class Worker:
             self.memory_pool = MemoryPool(memory_pool_bytes)
         self._tasks: Dict[str, TaskExecution] = {}
         self._lock = threading.Lock()
+        # stuck-task watchdog (StuckSplitTasksInterrupter analogue):
+        # interrupt any RUNNING task whose per-batch heartbeat is older
+        # than this; the failure is RETRYABLE (unlike deadline kills)
+        self.stuck_task_interrupt_s = stuck_task_interrupt_s
+        self.watchdog_interrupts: List[Tuple[str, str]] = []
+        self._watchdog_thread: Optional[threading.Thread] = None
+        self._watchdog_stop = threading.Event()
 
     # -- graceful drain (GracefulShutdownHandler analogue) --
     def shutdown_gracefully(self) -> None:
@@ -69,6 +77,47 @@ class Worker:
             1 for t in tasks
             if t.state not in ("finished", "failed", "aborted")
         )
+
+    # -- stuck-task watchdog (StuckSplitTasksInterrupter analogue) --
+    def watchdog_once(self, now: Optional[float] = None) -> List[str]:
+        """One watchdog sweep: interrupt every running task whose batch
+        heartbeat is staler than stuck_task_interrupt_s. Returns the
+        diagnostics raised this sweep; they also accumulate in
+        `watchdog_interrupts` as (task_id, diagnostic) for tests and the
+        chaos harness. Explicit-tick twin of start_watchdog, mirroring
+        NodeManager.ping_once."""
+        if not self.stuck_task_interrupt_s:
+            return []
+        with self._lock:
+            tasks = list(self._tasks.values())
+        fired: List[str] = []
+        for t in tasks:
+            diag = t.interrupt_if_stuck(self.stuck_task_interrupt_s, now=now)
+            if diag is not None:
+                fired.append(diag)
+                self.watchdog_interrupts.append((str(t.spec.task_id), diag))
+        return fired
+
+    def start_watchdog(self, poll_s: float = 0.01) -> None:
+        if self._watchdog_thread is not None or not self.stuck_task_interrupt_s:
+            return
+        self._watchdog_stop.clear()
+
+        def loop():
+            while not self._watchdog_stop.wait(poll_s):
+                self.watchdog_once()
+
+        self._watchdog_thread = threading.Thread(
+            target=loop, name=f"watchdog-{self.worker_id}", daemon=True
+        )
+        self._watchdog_thread.start()
+
+    def stop_watchdog(self) -> None:
+        if self._watchdog_thread is None:
+            return
+        self._watchdog_stop.set()
+        self._watchdog_thread.join(5)
+        self._watchdog_thread = None
 
     # -- task lifecycle (SqlTaskManager.updateTask) --
     def create_task(self, spec: TaskSpec) -> TaskExecution:
@@ -93,7 +142,11 @@ class Worker:
 
     def task_state(self, task_id) -> dict:
         t = self._tasks[str(task_id)]
-        out = {"state": t.state, "failure": t.failure}
+        # cpu_s rides along in every status poll so the coordinator's
+        # QueryTracker can sum per-task CPU ledgers into the
+        # query_max_cpu_time_s budget without an extra endpoint
+        out = {"state": t.state, "failure": t.failure,
+               "cpu_s": t.cpu_time_s()}
         stats = t.operator_stats()
         if stats is not None:
             out["stats"] = stats
@@ -153,3 +206,25 @@ class Worker:
             "tasks": len(self.task_ids()),
             "running": self.running_tasks(),
         }
+
+
+def install_sigterm_self_drain(workers) -> Optional[object]:
+    """Route SIGTERM into graceful drain (GracefulShutdownHandler wired
+    to the JVM shutdown hook): on the signal every worker in `workers`
+    flips to SHUTTING_DOWN — new launches refused, running tasks finish,
+    results stay readable — instead of dying mid-task. Returns the
+    previous handler (restore it in tests), or None when not on the main
+    thread (signal.signal is main-thread-only; embedded runners then
+    call shutdown_gracefully directly)."""
+    import signal
+
+    workers = list(workers)
+
+    def handler(signum, frame):
+        for w in workers:
+            w.shutdown_gracefully()
+
+    try:
+        return signal.signal(signal.SIGTERM, handler)
+    except ValueError:
+        return None
