@@ -790,9 +790,106 @@ def _chaos_smoke(argv) -> int:
     return 1 if violations else 0
 
 
+def _validate_corpus(argv) -> int:
+    """--validate-corpus: CI gate for the plan sanity checkers
+    (sql/validate.py). Plans — without executing — every TPC-H and
+    TPC-DS-subset query under plan_validation=rules (per-rule
+    validation + determinism double-planning), fragments it with
+    fragment-level validation, and prints per-checker violation counts
+    plus the compile-churn census. Exit 1 on any violation."""
+    from trino_tpu.connectors.tpch import create_tpch_connector
+    from trino_tpu.connectors.tpcds import create_tpcds_connector
+    from trino_tpu.engine import LocalQueryRunner, Session
+    from trino_tpu.sql.fragmenter import plan_distributed
+    from trino_tpu.sql.parser import parse
+    from trino_tpu.sql.validate import (
+        PlanValidationError,
+        check_sql_stability,
+        collect_subplan_violations,
+        collect_violations,
+        shape_census,
+    )
+    from tests.tpch_queries import QUERIES as TPCH_QUERIES
+    from tests.test_tpcds import QUERIES as TPCDS_QUERIES
+
+    def make_runner(catalog, create):
+        r = LocalQueryRunner(Session(catalog=catalog, schema="tiny"))
+        r.register_catalog(catalog, create())
+        r.session.plan_validation = "rules"
+        return r
+
+    corpora = [
+        ("tpch", make_runner("tpch", create_tpch_connector), TPCH_QUERIES),
+        ("tpcds", make_runner("tpcds", create_tpcds_connector),
+         TPCDS_QUERIES),
+    ]
+    per_checker: dict = {}
+    total_classes = 0
+    failures = 0
+    t0 = time.time()
+    for label, runner, queries in corpora:
+        for qid, sql in sorted(queries.items(), key=lambda kv: str(kv[0])):
+            name = f"{label} {qid if isinstance(qid, str) else f'q{qid}'}"
+            try:
+                check_sql_stability(sql, what=name)
+                stmt = parse(sql)
+                q = stmt.query if hasattr(stmt, "query") else stmt
+                # rules mode: per-rule validation + determinism run
+                # fire inside _analyze/optimize and raise on violation
+                output = runner._analyze(q)
+                subplan = plan_distributed(
+                    output, runner.catalogs, target_splits=2,
+                    validation="off",
+                )
+            except PlanValidationError as e:
+                failures += 1
+                per_checker[e.checker] = per_checker.get(e.checker, 0) + 1
+                print(f"bench: {name}: VIOLATION {e}", file=sys.stderr)
+                continue
+            except Exception as e:
+                failures += 1
+                per_checker["error"] = per_checker.get("error", 0) + 1
+                print(f"bench: {name}: ERROR {type(e).__name__}: {e}",
+                      file=sys.stderr)
+                continue
+            # collect-all pass over the final artifacts so one bad plan
+            # reports every checker it trips, not just the first
+            found = list(collect_violations(output))
+            found += list(collect_subplan_violations(subplan))
+            for v in found:
+                failures += 1
+                per_checker[v.checker] = per_checker.get(v.checker, 0) + 1
+                print(f"bench: {name}: VIOLATION [{v.checker}] "
+                      f"{v.node_path}: {v.message}", file=sys.stderr)
+            n_classes = sum(
+                len(shape_census(f.root, runner.catalogs))
+                for f in subplan.all_fragments()
+            )
+            total_classes += n_classes
+            print(f"bench: {name}: ok "
+                  f"fragments={len(subplan.all_fragments())} "
+                  f"expected_xla_lowerings={n_classes}")
+    checkers = ("refs", "types", "structure", "exchange_keys",
+                "determinism", "error")
+    print(json.dumps({
+        "validate_corpus": {
+            "queries": sum(len(q) for _, _, q in corpora),
+            "violations": failures,
+            "per_checker": {
+                c: per_checker.get(c, 0) for c in checkers
+            },
+            "expected_xla_lowerings_total": total_classes,
+            "wall_s": round(time.time() - t0, 2),
+        }
+    }))
+    return 1 if failures else 0
+
+
 def main() -> None:
     if "--chaos-smoke" in sys.argv:
         sys.exit(_chaos_smoke(sys.argv))
+    if "--validate-corpus" in sys.argv:
+        sys.exit(_validate_corpus(sys.argv))
     if os.environ.get("BENCH_INNER") == "1":
         import jax
 

@@ -41,10 +41,13 @@ from trino_tpu.sql.fragmenter import (
 from trino_tpu.sql.parser import parse
 
 HERE = os.path.dirname(os.path.abspath(__file__))
+# write_all() retargets this so the corpus-diff test can regenerate into
+# a tmp dir and diff against the committed files
+_OUT_DIR = [HERE]
 
 
-def emit(name: str, *sections):
-    path = os.path.join(HERE, name)
+def emit(name: str, *sections, out_dir: str = None):
+    path = os.path.join(out_dir or _OUT_DIR[0], name)
     body = []
     for title, text in sections:
         body.append("=" * 72)
@@ -147,6 +150,9 @@ def corpus_03_partial_agg():
     )
     pushed = push_partial_aggregation_through_exchange(naive)
     sp = plan_distributed(output, c)
+    # catalogs=... annotates each fragment header with its compile-churn
+    # census (expected_xla_lowerings — sql/validate.py)
+    distributed = explain_distributed(sp, catalogs=c)
     emit(
         "03_partial_agg_exchange.txt",
         (f"QUERY\n{sql}", ""),
@@ -159,8 +165,9 @@ def corpus_03_partial_agg():
          "merges)", P.explain_text(pushed)),
         ("full distributed plan (plan_distributed applies the rule; "
          "Aggregate[partial]\nsits in the scan fragment, "
-         "Aggregate[final] above the remote source)",
-         explain_distributed(sp)),
+         "Aggregate[final] above the remote source; each\nfragment "
+         "header carries its compile-churn census)",
+         distributed),
     )
 
 
@@ -204,7 +211,7 @@ def corpus_04_elided_exchange():
         before = METRICS.snapshot().get("exchanges_elided", 0.0)
         sp = plan_distributed(output, c, broadcast_threshold=0)
         elided = METRICS.snapshot().get("exchanges_elided", 0.0) - before
-        return explain_distributed(sp), elided
+        return explain_distributed(sp, catalogs=c), elided
 
     plain, e_plain = distributed_explain(False)
     bucketed, e_bucketed = distributed_explain(True)
@@ -222,8 +229,75 @@ def corpus_04_elided_exchange():
     )
 
 
+def corpus_05_plan_validation():
+    from trino_tpu.expr import ir
+    from trino_tpu.sql.validate import (
+        PlanValidationError,
+        census_text,
+        shape_census,
+        validate_logical,
+    )
+
+    # a rule mis-shifting a Ref — the error names checker + node path
+    vals = P.ValuesNode((P.Field("a", T.BIGINT),), ((0,),))
+    bad_ref = P.ProjectNode(
+        vals, (ir.InputRef(5, T.BIGINT),), (P.Field("x", T.BIGINT),)
+    )
+    try:
+        validate_logical(bad_ref, stage="optimizer", rule="example_rule")
+        ref_err = "NOT CAUGHT"
+    except PlanValidationError as e:
+        ref_err = str(e)
+    # an un-canonicalized tstz repartition key (zone bits would reach
+    # the hash) — the regression canonicalize_tstz_keys exists to stop
+    tvals = P.ValuesNode((P.Field("ts", T.TIMESTAMP_TZ),), ((0,),))
+    bad_tstz = P.ExchangeNode(tvals, "repartition", (0,), tvals.fields)
+    try:
+        validate_logical(bad_tstz)
+        tstz_err = "NOT CAUGHT"
+    except PlanValidationError as e:
+        tstz_err = str(e)
+    # census over a join plan: the dynamic filter's retry-variant class
+    c = CatalogManager()
+    c.register("tpch", create_tpch_connector())
+    sql = (
+        "select n_name, count(*) from supplier, nation "
+        "where s_nationkey = n_nationkey group by n_name"
+    )
+    output = Analyzer(c, "tpch", "tiny").plan(parse(sql))
+    census = census_text(shape_census(output, c), warn_threshold=32)
+    emit(
+        "05_plan_validation.txt",
+        ("corrupted plan: Project ref outside input width\n"
+         "(PlanValidationError names the checker, node path, stage and "
+         "last rule)", ref_err),
+        ("corrupted plan: repartition on a raw TIMESTAMP_TZ key\n"
+         "(exchange_keys checker demands the $utc zone-masked "
+         "projection)", tstz_err),
+        (f"QUERY\n{sql}", ""),
+        ("compile-churn census (logical plan): one line per expected "
+         "(operator,\ncapacity, dtype) XLA lowering; the "
+         "DynamicFilterOperator class is marked\nretry-variant — its "
+         "pruned probe capacity depends on which retry attempt's\n"
+         "build side survives, so it compiles fresh shapes no warm run "
+         "covers", census),
+    )
+
+
+def write_all(out_dir=None):
+    """Regenerate every corpus file (into `out_dir` when given — used
+    by tests/test_explain_corpus.py to diff against committed files)."""
+    if out_dir is not None:
+        _OUT_DIR[0] = out_dir
+    try:
+        corpus_01_transitive()
+        corpus_02_scan_pushdown()
+        corpus_03_partial_agg()
+        corpus_04_elided_exchange()
+        corpus_05_plan_validation()
+    finally:
+        _OUT_DIR[0] = HERE
+
+
 if __name__ == "__main__":
-    corpus_01_transitive()
-    corpus_02_scan_pushdown()
-    corpus_03_partial_agg()
-    corpus_04_elided_exchange()
+    write_all()

@@ -1,0 +1,61 @@
+"""Corpus-diff gate for explain_corpus/.
+
+Regenerates every corpus file into a tmp dir and diffs it against the
+committed copy. The corpus is deterministic (fixed seeds, tiny inputs),
+so a mismatch means the planner, the validator messages, or the census
+actually changed — rerun `JAX_PLATFORMS=cpu PYTHONPATH=. python
+explain_corpus/generate.py` and review the diff.
+"""
+
+import difflib
+import importlib.util
+import os
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+CORPUS = os.path.join(HERE, os.pardir, "explain_corpus")
+
+
+@pytest.fixture(scope="module")
+def generate():
+    spec = importlib.util.spec_from_file_location(
+        "explain_corpus_generate", os.path.join(CORPUS, "generate.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_corpus_matches_committed(generate, tmp_path):
+    generate.write_all(str(tmp_path))
+    names = sorted(
+        n for n in os.listdir(CORPUS) if n.endswith(".txt")
+    )
+    assert names, "no committed corpus files found"
+    regenerated = sorted(os.listdir(tmp_path))
+    assert regenerated == names, (
+        f"generate.py emits {regenerated}, committed corpus has {names}"
+    )
+    for name in names:
+        with open(os.path.join(CORPUS, name)) as fh:
+            committed = fh.read()
+        with open(tmp_path / name) as fh:
+            fresh = fh.read()
+        if committed != fresh:
+            diff = "\n".join(difflib.unified_diff(
+                committed.splitlines(), fresh.splitlines(),
+                f"committed/{name}", f"regenerated/{name}", lineterm="",
+            ))
+            pytest.fail(f"{name} drifted from committed corpus:\n{diff}")
+
+
+def test_corpus_carries_validation_annotations():
+    with open(os.path.join(CORPUS, "05_plan_validation.txt")) as fh:
+        body = fh.read()
+    assert "[refs] at Project" in body
+    assert "[exchange_keys] at Exchange" in body
+    assert "expected_xla_lowerings=" in body
+    assert "retry-variant" in body
+    with open(os.path.join(CORPUS, "03_partial_agg_exchange.txt")) as fh:
+        assert "expected_xla_lowerings=" in fh.read()

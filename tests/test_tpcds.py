@@ -251,7 +251,18 @@ def test_tpcds_query(name, runner, oracle):
     assert_rows_match(got, want, ordered=("order by" in sql), abs_tol=1e-2)
 
 
-@pytest.mark.parametrize("name", ["q3", "q72"])
+@pytest.mark.parametrize(
+    "name",
+    [
+        "q3",
+        # q72 distributed compiles ~6 min of XLA programs on a cold CPU
+        # cache and was the single largest tier-1 wall-clock item (the
+        # full suite overran its budget even before PR 5); it keeps
+        # single-node oracle coverage above and distributed coverage in
+        # the slow tier + bench.py
+        pytest.param("q72", marks=pytest.mark.slow),
+    ],
+)
 def test_tpcds_distributed(name, oracle):
     from trino_tpu.runtime import DistributedQueryRunner
 

@@ -138,6 +138,16 @@ for _name, _type, _default, _desc, _allowed in [
     ("speculation_percentile", float, 0.75,
      "FTE speculation bases its per-fragment duration estimate on this "
      "quantile of committed attempt wall times (p75 default)", None),
+    # -- plan validation (sql/validate.py, PlanSanityChecker analogue) --
+    ("plan_validation", str, "passes",
+     "run plan sanity checkers: off | passes (after each optimizer "
+     "pass + fragmentation) | rules (additionally after every rule "
+     "application, plus plan-determinism double-planning — debug mode)",
+     ("off", "passes", "rules")),
+    ("compile_churn_warn_threshold", int, 32,
+     "EXPLAIN (ANALYZE) warns when the shape census predicts more "
+     "distinct (operator, capacity, dtype) XLA lowerings than this",
+     None),
 ]:
     SYSTEM_PROPERTIES.register(_name, _type, _default, _desc, _allowed)
 

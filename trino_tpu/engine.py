@@ -98,6 +98,14 @@ class Session:
     # FTE speculation duration estimate: quantile of committed attempt
     # wall times per fragment (the reference's p75-based model)
     speculation_percentile: float = 0.75
+    # plan sanity checking (sql/validate.py, PlanSanityChecker
+    # analogue): "off" | "passes" (after each optimizer pass and after
+    # fragmentation) | "rules" (also after every rule application +
+    # plan-determinism double-planning — debug mode)
+    plan_validation: str = "passes"
+    # EXPLAIN (ANALYZE) warns when the shape census predicts more
+    # distinct XLA lowerings than this per plan/fragment
+    compile_churn_warn_threshold: int = 32
 
     def set_property(self, name: str, value) -> None:
         """SET SESSION entry point — validated through the typed
@@ -485,7 +493,28 @@ class LocalQueryRunner:
         analyzer = Analyzer(self.catalogs, self.session.catalog, self.session.schema)
         root = optimize(analyzer.plan(q), self.catalogs, self.session)
         # correctness pass: runs regardless of enable_optimizer
-        return canonicalize_tstz_keys(root)
+        root = canonicalize_tstz_keys(root)
+        mode = getattr(self.session, "plan_validation", "passes")
+        if mode != "off":
+            from trino_tpu.sql.validate import validate_logical
+
+            validate_logical(root, stage="canonicalize_tstz_keys")
+        if mode == "rules":
+            # PlanDeterminismChecker: replanning the same AST must yield
+            # byte-identical EXPLAIN text (fresh analyzer per run — the
+            # plan cache would otherwise mask nondeterminism)
+            from trino_tpu.sql.validate import check_plan_determinism
+
+            def plan_once():
+                a = Analyzer(
+                    self.catalogs, self.session.catalog, self.session.schema
+                )
+                return canonicalize_tstz_keys(
+                    optimize(a.plan(q), self.catalogs, self.session)
+                )
+
+            check_plan_determinism(plan_once)
+        return root
 
     def _invalidate_plans(self) -> None:
         """Cached physical plans capture split lists (data snapshots) at
@@ -1111,9 +1140,19 @@ class LocalQueryRunner:
             instrument,
             render_stats,
         )
-        from trino_tpu.runtime.metrics import METRICS
+        from trino_tpu.runtime.metrics import (
+            METRICS,
+            install_xla_compile_listener,
+        )
+        from trino_tpu.sql.validate import census_text, shape_census
 
+        install_xla_compile_listener()
         output, physical = self._plan(q, sql_key=None)
+        classes = shape_census(
+            output, self.catalogs,
+            batch_rows=self.session.batch_rows,
+            dynamic_filtering=self.session.enable_dynamic_filtering,
+        )
         before = METRICS.snapshot()
         ctx = self._execution_ctx()
         pipelines, chain = physical.instantiate(ctx)
@@ -1121,16 +1160,34 @@ class LocalQueryRunner:
         chain.append(sink)
         groups = []
         wrapped_pipelines = []
+        ledger = set()
         for p in pipelines:
-            ops, stats = instrument(p.operators, device_sync=True)
+            ops, stats = instrument(
+                p.operators, device_sync=True, shape_ledger=ledger
+            )
             groups.append(stats)
             wrapped_pipelines.append(Pipeline(ops))
-        main_ops, main_stats = instrument(chain, device_sync=True)
+        main_ops, main_stats = instrument(
+            chain, device_sync=True, shape_ledger=ledger
+        )
         groups.append(main_stats)
         for p in wrapped_pipelines:
             Driver(p).run()
         Driver(Pipeline(main_ops)).run()
         _raise_deferred_checks(ctx)
         counters = engine_counters_delta(before, METRICS.snapshot())
-        text = explain_text(output) + "\n\n" + render_stats(groups, counters)
+        census = census_text(
+            classes,
+            warn_threshold=getattr(
+                self.session, "compile_churn_warn_threshold", 0
+            ),
+            observed=len(ledger),
+        )
+        # census goes AFTER the runtime stats: per-class lines name
+        # operators too, and stats consumers grep for the first line
+        # mentioning an operator
+        text = (
+            explain_text(output) + "\n\n"
+            + render_stats(groups, counters) + "\n\n" + census
+        )
         return MaterializedResult([[text]], ["Query Plan"], [T.VARCHAR])

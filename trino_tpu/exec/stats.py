@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import List, Optional
+from typing import List, Optional, Set, Tuple
 
 
 @dataclasses.dataclass
@@ -69,13 +69,30 @@ class InstrumentedOperator:
     OperationTimer discipline without touching operator code."""
 
     def __init__(self, inner, stats: OperatorStats, count_rows: bool,
-                 device_sync: bool = False):
+                 device_sync: bool = False,
+                 shape_ledger: Optional[Set[Tuple]] = None):
         self.inner = inner
         self.stats = stats
         self.stats.operator = type(inner).__name__
         self.stats.device_synced = device_sync
         self._count_rows = count_rows
         self._device_sync = device_sync
+        # observed (operator, capacity, dtype-signature) classes — the
+        # same vocabulary sql/validate.py's shape census predicts over,
+        # so EXPLAIN ANALYZE can print expected vs observed side by side
+        self._shape_ledger = shape_ledger
+
+    def _record_shape(self, batch) -> None:
+        if self._shape_ledger is None:
+            return
+        try:
+            self._shape_ledger.add((
+                type(self.inner).__name__,
+                batch.capacity,
+                tuple(str(c.type) for c in batch.columns),
+            ))
+        except Exception:
+            pass  # ledger must never break execution
 
     def needs_input(self) -> bool:
         return self.inner.needs_input()
@@ -104,6 +121,7 @@ class InstrumentedOperator:
             self.stats.output_batches += 1
             if self._count_rows:
                 self.stats.output_rows += out.row_count()
+            self._record_shape(out)
         return out
 
     def finish(self) -> None:
@@ -125,13 +143,15 @@ class InstrumentedOperator:
 
 
 def instrument(operators, count_rows: bool = True,
-               device_sync: bool = False):
+               device_sync: bool = False,
+               shape_ledger: Optional[Set[Tuple]] = None):
     """Wrap a pipeline's operators; returns (wrapped, [OperatorStats]).
     `device_sync=True` closes every timed section with a device barrier
-    (EXPLAIN ANALYZE's per-operator device attribution)."""
+    (EXPLAIN ANALYZE's per-operator device attribution). Pass a shared
+    `shape_ledger` set to collect observed output shape classes."""
     stats = [OperatorStats() for _ in operators]
     wrapped = [
-        InstrumentedOperator(op, st, count_rows, device_sync)
+        InstrumentedOperator(op, st, count_rows, device_sync, shape_ledger)
         for op, st in zip(operators, stats)
     ]
     return wrapped, stats
@@ -142,6 +162,7 @@ ENGINE_COUNTERS = (
     "bytes_scanned",
     "rows_shuffled",
     "exchanges_elided",
+    "xla_compiles",
 )
 
 

@@ -359,11 +359,12 @@ class DistributedQueryRunner:
                 output, self.catalogs,
                 broadcast_threshold=self.session.broadcast_join_threshold,
                 target_splits=self.session.target_splits,
+                validation=getattr(self.session, "plan_validation", "passes"),
             )
             if stmt.analyze:
                 return self._explain_analyze(subplan)
             return MaterializedResult(
-                [[explain_distributed(subplan)]], ["Query Plan"], [T.VARCHAR]
+                [[self._explain_text(subplan)]], ["Query Plan"], [T.VARCHAR]
             )
         if not isinstance(stmt, ast.Query):
             # metadata/DML/transaction statements take the single-node
@@ -421,6 +422,7 @@ class DistributedQueryRunner:
             self.catalogs,
             broadcast_threshold=self.session.broadcast_join_threshold,
             target_splits=self.session.target_splits,
+            validation=getattr(self.session, "plan_validation", "passes"),
         )
         # planning is over: surface a planning-limit kill latched during
         # the analyze/optimize/fragment work before any task launches
@@ -550,6 +552,19 @@ class DistributedQueryRunner:
                 scheduler.abort()
         raise last_error
 
+    def _explain_text(self, subplan) -> str:
+        """Fragment rendering with per-fragment compile-churn census
+        annotations (expected_xla_lowerings — sql/validate.py)."""
+        return explain_distributed(
+            subplan,
+            catalogs=self.catalogs,
+            batch_rows=self.session.batch_rows,
+            dynamic_filtering=self.session.enable_dynamic_filtering,
+            warn_threshold=getattr(
+                self.session, "compile_churn_warn_threshold", 0
+            ),
+        )
+
     def _explain_analyze(self, subplan) -> MaterializedResult:
         """Distributed EXPLAIN ANALYZE: run the query with operator
         instrumentation on, pull each task's OperatorStats from its
@@ -564,7 +579,7 @@ class DistributedQueryRunner:
         try:
             root_handle, root_tid = scheduler.start()
             self._collect(scheduler, root_handle, root_tid)
-            lines = [explain_distributed(subplan)]
+            lines = [self._explain_text(subplan)]
             for fid in sorted(scheduler.tasks):
                 merged: List[List[dict]] = []
                 n_tasks = 0
@@ -679,7 +694,10 @@ class DistributedQueryRunner:
             shutil.rmtree(spool_dir, ignore_errors=True)
 
     def _analyze(self, q: ast.Query):
-        from trino_tpu.sql.optimizer import optimize
+        from trino_tpu.sql.optimizer import (
+            canonicalize_tstz_keys,
+            optimize,
+        )
 
         from trino_tpu.sql.analyzer import (
             set_session_info,
@@ -693,7 +711,17 @@ class DistributedQueryRunner:
         analyzer = Analyzer(
             self.catalogs, self.session.catalog, self.session.schema
         )
-        return optimize(analyzer.plan(q), self.catalogs, self.session)
+        root = optimize(analyzer.plan(q), self.catalogs, self.session)
+        # correctness pass (was missing here while present on the
+        # single-node path — found by the exchange-key validator:
+        # distributed plans hashed tstz join/group keys with the packed
+        # zone bits still set, splitting equal instants across tasks)
+        root = canonicalize_tstz_keys(root)
+        if getattr(self.session, "plan_validation", "passes") != "off":
+            from trino_tpu.sql.validate import validate_logical
+
+            validate_logical(root, stage="canonicalize_tstz_keys")
+        return root
 
     def _collect(
         self, scheduler: QueryScheduler, handle, tid,

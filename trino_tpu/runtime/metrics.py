@@ -50,3 +50,32 @@ class MetricsRegistry:
 
 # the process singleton (MBeanServer analogue)
 METRICS = MetricsRegistry()
+
+
+_xla_listener_installed = False
+
+
+def install_xla_compile_listener() -> bool:
+    """Bump the `xla_compiles` counter on every backend compile via
+    jax.monitoring. NOTE: this counts ALL compiles in the process —
+    jax-internal helper jits (jnp.zeros, barriers) included — so it is a
+    visibility counter for spotting churn trends, not a per-query
+    cache-miss count; the per-query expected-vs-observed comparison uses
+    the shape-class ledger (exec/stats.py), which shares a vocabulary
+    with the static census (sql/validate.py). Idempotent; returns False
+    when this jax build has no monitoring hooks."""
+    global _xla_listener_installed
+    if _xla_listener_installed:
+        return True
+    try:
+        from jax import monitoring
+
+        def _on_event(event: str, duration: float, **kw) -> None:
+            if event == "/jax/core/compile/backend_compile_duration":
+                METRICS.increment("xla_compiles")
+
+        monitoring.register_event_duration_secs_listener(_on_event)
+    except Exception:
+        return False
+    _xla_listener_installed = True
+    return True

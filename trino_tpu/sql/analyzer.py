@@ -3270,16 +3270,19 @@ class Analyzer:
         # (count(*), count(col)) -> filter
         # (count(*) = count(col)) AND (x IS NOT NULL OR count(*) = 0).
         # The shape of Trino's null-aware semi-join rewrite family.
-        # NOTE: the subquery plan `node` appears twice (build side AND
-        # count source), so its subtree executes twice — shared-subtree
-        # materialization (CTE reuse) is the planned fix.
+        # NOTE: the subquery appears twice (build side AND count
+        # source), so it executes twice — shared-subtree materialization
+        # (CTE reuse) is the planned fix. It is PLANNED twice so the two
+        # uses are distinct subtrees: node identity doubles as the plan-
+        # node id, and the structure validator rejects a DAG.
         builder.node = P.JoinNode(
             "anti", builder.node, node, (probe_ch,), (0,), None,
             builder.node.fields,
         )
         sub_t = node.fields[0].type
+        count_source, _, _ = self.plan_query(conj.query, ctes)
         counts = P.AggregateNode(
-            node,
+            count_source,
             (),
             (
                 P.AggCall("count_star", None, T.BIGINT),

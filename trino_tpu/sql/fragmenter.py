@@ -359,12 +359,26 @@ def eliminate_redundant_exchanges(root: P.PlanNode) -> P.PlanNode:
     return walk(root)
 
 
-def push_partial_aggregation_through_exchange(root: P.PlanNode) -> P.PlanNode:
+# skip the partial/final split when the estimated aggregation output is
+# at least this fraction of its input: the partial step would shrink
+# nothing, so it only adds a device pass + a wider wire schema
+PARTIAL_AGG_MIN_REDUCTION = 0.9
+
+
+def push_partial_aggregation_through_exchange(
+    root: P.PlanNode, stats=None
+) -> P.PlanNode:
     """Split a mergeable single-step aggregation sitting on a
     repartition (or gather) exchange into partial -> exchange -> final,
     so each producer task pre-aggregates before rows cross the wire
     (PushPartialAggregationThroughExchange.java as an explicit pass
-    over the naive plan _AddExchanges now emits)."""
+    over the naive plan _AddExchanges now emits).
+
+    With a StatsCalculator the split is cost-based: when NDV(group
+    keys) ~= input rows (estimated output >= PARTIAL_AGG_MIN_REDUCTION
+    of input) the partial step cannot reduce wire volume and is
+    skipped — Trino's preferPartialAggregation cost gate. Without
+    stats (legacy one-arg callers) the split stays structural."""
     from trino_tpu.exec.operators import HOLISTIC_KINDS
 
     def walk(n: P.PlanNode) -> P.PlanNode:
@@ -384,6 +398,27 @@ def push_partial_aggregation_through_exchange(root: P.PlanNode) -> P.PlanNode:
                 return n
         elif ex.kind != "gather" or groups:
             return n
+        if stats is not None and groups:
+            # skip the split ONLY on confident stats: every group key
+            # needs a known NDV. Unknown NDV defaults to sqrt(rows) in
+            # StatsCalculator, so with >=2 keys the product saturates
+            # at row_count and the gate would silently disable partial
+            # aggregation everywhere (TPC-DS q72 regressed ~20% wall
+            # from exactly that) — unknown stats keep the structural
+            # split, which is also runtime-adaptive on the wire.
+            try:
+                child_stats = stats.stats(ex.child)
+                in_rows = child_stats.row_count
+                ndvs = [child_stats.col(c).ndv for c in groups]
+            except Exception:
+                in_rows, ndvs = None, [None]
+            if in_rows and all(v is not None for v in ndvs):
+                out_rows = 1.0
+                for v in ndvs:
+                    out_rows *= v
+                out_rows = min(out_rows, in_rows)
+                if out_rows >= PARTIAL_AGG_MIN_REDUCTION * in_rows:
+                    return n
         k = len(groups)
         partial_fields = tuple(_partial_fields(n, ex.child))
         partial = dataclasses.replace(
@@ -568,17 +603,23 @@ def plan_distributed(
     catalogs,
     broadcast_threshold: int = 1_000_000,
     target_splits: int = 1,
+    validation: str = "passes",
 ) -> SubPlan:
     """Logical plan -> SubPlan tree of PlanFragments (the
-    LogicalPlanner->AddExchanges->PlanFragmenter.createSubPlans path)."""
-    estimate = make_row_estimator(catalogs)
+    LogicalPlanner->AddExchanges->PlanFragmenter.createSubPlans path).
+    `validation` != "off" runs the fragment-level sanity checkers
+    (sql/validate.py) over the result before it ships to schedulers."""
+    from trino_tpu.sql.stats import StatsCalculator
+
+    calc = StatsCalculator(catalogs)
+    estimate = lambda node: calc.stats(node).row_count
     adder = _AddExchanges(
         estimate, broadcast_threshold,
         scan_partitioning=_make_scan_partitioning(catalogs, target_splits),
     )
     annotated, _ = adder.visit(root)
     annotated = eliminate_redundant_exchanges(annotated)
-    annotated = push_partial_aggregation_through_exchange(annotated)
+    annotated = push_partial_aggregation_through_exchange(annotated, calc)
     subplan = _Fragmenter().cut(annotated)
     # refine "hash" vs "single" partitioning now that producers are known,
     # and derive stats-driven partition counts per hash stage
@@ -615,16 +656,37 @@ def plan_distributed(
             refine(c)
 
     refine(subplan)
+    if validation != "off":
+        from trino_tpu.sql.validate import validate_subplan
+
+        validate_subplan(subplan)
     return subplan
 
 
-def explain_distributed(subplan: SubPlan) -> str:
-    """EXPLAIN (TYPE DISTRIBUTED) rendering: one section per fragment."""
+def explain_distributed(
+    subplan: SubPlan,
+    catalogs=None,
+    batch_rows: int = 1 << 20,
+    dynamic_filtering: bool = True,
+    warn_threshold: int = 0,
+) -> str:
+    """EXPLAIN (TYPE DISTRIBUTED) rendering: one section per fragment.
+    With `catalogs` each fragment also carries its compile-churn census
+    summary (`expected_xla_lowerings` — sql/validate.py)."""
     lines = []
     for f in sorted(subplan.all_fragments(), key=lambda f: f.id):
         out = f.output_kind
         if f.output_channels:
             out += f" on={list(f.output_channels)}"
-        lines.append(f"Fragment {f.id} [{f.partitioning}] output={out}")
+        header = f"Fragment {f.id} [{f.partitioning}] output={out}"
+        if catalogs is not None:
+            from trino_tpu.sql.validate import census_line, shape_census
+
+            classes = shape_census(
+                f.root, catalogs, batch_rows=batch_rows,
+                dynamic_filtering=dynamic_filtering,
+            )
+            header += " " + census_line(classes, warn_threshold)
+        lines.append(header)
         lines.append(P.explain_text(f.root, indent=1))
     return "\n".join(lines)

@@ -128,6 +128,27 @@ def with_children(node: P.PlanNode, new_children: Sequence[P.PlanNode]) -> P.Pla
     return dataclasses.replace(node, child=new_children[0])
 
 
+def _fresh_tree(node: P.PlanNode) -> P.PlanNode:
+    """Rebuild every interior node of a subtree as a new object.
+
+    Rewrites that replicate a subtree into several plan positions (the
+    multi-sketch UNION ALL expansion) must not alias the same node
+    object from two parents: node identity doubles as the plan-node id,
+    and id()-keyed consumers (StatsCalculator's memo, the structure
+    validator) assume tree shape. Leaves stay shared — they have no
+    children for a traversal to double-visit.
+    """
+    kids = tuple(node.children())
+    if not kids:
+        return node
+    new_kids = [_fresh_tree(k) for k in kids]
+    if isinstance(node, P.JoinNode):
+        return dataclasses.replace(node, left=new_kids[0], right=new_kids[1])
+    if isinstance(node, P.UnionAllNode):
+        return dataclasses.replace(node, inputs=tuple(new_kids))
+    return dataclasses.replace(node, child=new_kids[0])
+
+
 # ---------------------------------------------------------------------------
 # Memo
 # ---------------------------------------------------------------------------
@@ -206,10 +227,13 @@ class Memo:
 
 @dataclasses.dataclass
 class Context:
-    """Rule.Context analogue: lookup + stats."""
+    """Rule.Context analogue: lookup + stats. `last_rule` records the
+    most recently applied rule so a PlanValidationError can name the
+    rewrite that broke the invariant."""
 
     memo: Memo
     stats: Optional[StatsCalculator] = None
+    last_rule: Optional[str] = None
 
     def resolve(self, node: P.PlanNode) -> P.PlanNode:
         return self.memo.resolve(node)
@@ -974,8 +998,15 @@ class IterativeOptimizer:
         self._rules = tuple(rules)
 
     def optimize(
-        self, root: P.PlanNode, stats: Optional[StatsCalculator] = None
+        self,
+        root: P.PlanNode,
+        stats: Optional[StatsCalculator] = None,
+        validator=None,
     ) -> P.PlanNode:
+        """`validator(plan, rule_name)` — when given (plan_validation=
+        rules), the extracted plan is re-validated after EVERY rule
+        application, so a violation names the exact rewrite that
+        introduced it."""
         memo = Memo(root)
         ctx = Context(memo, stats)
         for _ in range(MAX_FIXPOINT_PASSES):
@@ -991,6 +1022,9 @@ class IterativeOptimizer:
                         result = rule.apply(node, ctx)
                         if result is not None and result is not node:
                             memo.replace(gid, result)
+                            ctx.last_rule = rule.name
+                            if validator is not None:
+                                validator(memo.extract(), rule.name)
                             progress = True
                             fired = True
                             break
@@ -1164,6 +1198,10 @@ class RewriteMultiSketch:
         branches: List[P.PlanNode] = []
         branch_fields: Optional[Tuple[P.Field, ...]] = None
         for t, (pos, a) in enumerate(sketches):
+            # each branch gets its own copy of the child subtree —
+            # aliasing one object under two UnionAll inputs turns the
+            # tree into a DAG (see _fresh_tree)
+            src = child if t == 0 else _fresh_tree(child)
             exprs: List[ir.Expr] = [
                 ref(c, child) for c in node.group_channels
             ]
@@ -1203,7 +1241,7 @@ class RewriteMultiSketch:
                         ref(o.arg_channel, child) if t == 0 else null(ft.type)
                     )
                     fields.append(ft)
-            branches.append(P.ProjectNode(child, tuple(exprs), tuple(fields)))
+            branches.append(P.ProjectNode(src, tuple(exprs), tuple(fields)))
             branch_fields = branches[-1].fields
         u = P.UnionAllNode(tuple(branches), branch_fields)
 
@@ -1832,6 +1870,23 @@ def optimize(
     if session is not None and not getattr(session, "enable_optimizer", True):
         return root
     strategy = getattr(session, "join_reordering_strategy", "automatic")
+    validation = getattr(session, "plan_validation", "passes")
+    if validation != "off":
+        from trino_tpu.sql.validate import validate_logical
+    else:
+        validate_logical = None
+    per_rule = None
+    if validation == "rules":
+        per_rule = lambda plan, rule: validate_logical(
+            plan, stage="optimizer", rule=rule
+        )
+
+    def checkpoint(plan: P.PlanNode, stage: str) -> None:
+        # PlanSanityChecker.validateIntermediatePlan: every pass must
+        # hand the next one a well-formed plan
+        if validate_logical is not None:
+            validate_logical(plan, stage=stage)
+
     stats = StatsCalculator(catalogs)
     rules: Tuple[Rule, ...] = SIMPLIFICATION_RULES
     if getattr(session, "enable_pushdown", True) and catalogs is not None:
@@ -1840,15 +1895,20 @@ def optimize(
             PushProjectionIntoTableScan(catalogs),
         )
     it = IterativeOptimizer(rules)
-    root = it.optimize(root, stats)
+    checkpoint(root, "analyzer")
+    root = it.optimize(root, stats, validator=per_rule)
+    checkpoint(root, "iterative")
     root = RewriteMultiSketch().rewrite(root)
     root = RewriteApproxDistinct().rewrite(root)
     root = RewriteApproxPercentile().rewrite(root)
+    checkpoint(root, "approx_rewrites")
     root = RewriteDistinctAggs().rewrite(root)
+    checkpoint(root, "distinct_aggs")
     if strategy == "automatic":
         cost = CostCalculator(stats)
         root = ReorderJoins(stats, cost).rewrite(root)
-        root = it.optimize(root, stats)
+        root = it.optimize(root, stats, validator=per_rule)
+        checkpoint(root, "join_reordering")
     return root
 
 
