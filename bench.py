@@ -45,6 +45,12 @@ Flags:
                      exceeds its injected-failure bound, leaks a
                      resource-group slot, or leaves memory reserved; no
                      device needed (runs before preflight)
+  --warmup-smoke     run the q72-class plan cold-with-warmup vs
+                     cold-without (compile/warmup.py) and print per-arm
+                     compile counts + walls; exits non-zero if the
+                     warmup-on run observes more distinct XLA shape
+                     classes than the census predicted; no device
+                     needed (runs before preflight)
 """
 
 from __future__ import annotations
@@ -790,6 +796,103 @@ def _chaos_smoke(argv) -> int:
     return 1 if violations else 0
 
 
+def _parse_compile_lines(text: str) -> dict:
+    """Pull the compile-regime counters out of an EXPLAIN ANALYZE plan
+    text (census + warmup + cache lines, engine._explain_analyze)."""
+    import re
+
+    out: dict = {}
+    for key, pat in (
+        ("expected_lowerings", r"expected_xla_lowerings=(\d+)"),
+        ("observed_classes", r"observed_shape_classes=(\d+)"),
+        ("xla_compiles", r"xla_compiles_this_query=(\d+)"),
+    ):
+        m = re.search(pat, text)
+        if m:
+            out[key] = int(m.group(1))
+    m = re.search(
+        r"warmup: mode=(\w+) entries=(\d+) compiled=(\d+) failed=(\d+) "
+        r"skipped=(\d+)(?: hits=(\d+) misses=(\d+))?",
+        text,
+    )
+    if m:
+        out["warmup"] = {
+            "mode": m.group(1),
+            "entries": int(m.group(2)),
+            "compiled": int(m.group(3)),
+            "failed": int(m.group(4)),
+            "skipped": int(m.group(5)),
+        }
+        if m.group(6) is not None:
+            out["warmup"]["hits"] = int(m.group(6))
+            out["warmup"]["misses"] = int(m.group(7))
+    return out
+
+
+def _warmup_smoke(argv) -> int:
+    """--warmup-smoke: compile-regime gate. Runs the q72-class plan
+    (deep multi-build join tree) twice from a cold compile state on the
+    CPU backend — once with warmup off, once with warmup_mode=block —
+    and prints one JSON line with per-arm compile counts and walls.
+    Exit 1 iff the warmup-on arm observes more distinct shape classes
+    at runtime than the census predicted (shape stabilization failed to
+    land execution on the predicted lowerings) or the arms disagree on
+    the answer."""
+    import jax
+
+    from trino_tpu.compile.cache import PROGRAM_CACHE
+    from trino_tpu.compile.warmup import reset_warm_classes
+    from trino_tpu.connectors.tpcds import create_tpcds_connector
+    from trino_tpu.engine import LocalQueryRunner, Session
+
+    def run_arm(warmup_mode: str) -> dict:
+        # cold start: drop the engine's program cache, jax's dispatch
+        # caches, and the warm-class registry so each arm pays (or
+        # warms) its own compiles
+        PROGRAM_CACHE.clear()
+        reset_warm_classes()
+        jax.clear_caches()
+        r = LocalQueryRunner(Session(catalog="tpcds", schema="tiny"))
+        r.register_catalog("tpcds", create_tpcds_connector())
+        r.session.set_property("warmup_mode", warmup_mode)
+        t0 = time.time()
+        text = r.execute("EXPLAIN ANALYZE " + Q72).only_value()
+        wall = time.time() - t0
+        rows = r.execute(Q72).rows
+        stats = _parse_compile_lines(text)
+        stats["warmup_mode"] = warmup_mode
+        stats["wall_s"] = round(wall, 2)
+        return stats, rows
+
+    print("bench: warmup smoke (q72-class plan, tpcds tiny, CPU ok)")
+    base, base_rows = run_arm("off")
+    warm, warm_rows = run_arm("block")
+    violations = []
+    expected = warm.get("expected_lowerings")
+    observed = warm.get("observed_classes")
+    if expected is None or observed is None:
+        violations.append("compile census lines missing from EXPLAIN ANALYZE")
+    elif observed > expected:
+        violations.append(
+            f"warmup-on run observed {observed} distinct shape classes, "
+            f"census predicted {expected} — stabilization failed to land "
+            "execution on the predicted lowerings"
+        )
+    if base_rows != warm_rows:
+        violations.append("warmup changed the query answer")
+    for v in violations:
+        print(f"bench: warmup VIOLATION: {v}", file=sys.stderr)
+    print(json.dumps({
+        "warmup_smoke": {
+            "query": "q72",
+            "no_warmup": base,
+            "with_warmup": warm,
+            "violations": len(violations),
+        }
+    }))
+    return 1 if violations else 0
+
+
 def _validate_corpus(argv) -> int:
     """--validate-corpus: CI gate for the plan sanity checkers
     (sql/validate.py). Plans — without executing — every TPC-H and
@@ -888,6 +991,8 @@ def _validate_corpus(argv) -> int:
 def main() -> None:
     if "--chaos-smoke" in sys.argv:
         sys.exit(_chaos_smoke(sys.argv))
+    if "--warmup-smoke" in sys.argv:
+        sys.exit(_warmup_smoke(sys.argv))
     if "--validate-corpus" in sys.argv:
         sys.exit(_validate_corpus(sys.argv))
     if os.environ.get("BENCH_INNER") == "1":

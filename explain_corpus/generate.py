@@ -284,6 +284,77 @@ def corpus_05_plan_validation():
     )
 
 
+def corpus_06_compile_regime():
+    from trino_tpu.compile.shapes import CapacityLadder, ShapeStabilizer
+    from trino_tpu.compile.warmup import WarmupService
+    from trino_tpu.sql.validate import census_text, shape_census
+
+    # 1. the capacity ladder: how pruned spans snap onto stable rungs
+    lines = []
+    for base in (2, 4):
+        lad = CapacityLadder(base=base)
+        rungs = ", ".join(str(r) for r in lad.rungs(1 << 20))
+        lines.append(f"base={base}: {rungs}")
+    stab = ShapeStabilizer(CapacityLadder(base=2))
+    demo = []
+    for span, pruned in ((60175, 60175), (60175, 1732), (60175, 0)):
+        cap = stab.chunk_capacity(span)
+        demo.append(
+            f"span={span} rows_after_pruning={pruned} -> capacity={cap}"
+        )
+    ladder_text = (
+        "\n".join(lines)
+        + "\n\nchunk capacity is a function of the PRE-pruning span, so "
+        "pushdown- or\ndynamic-filter-pruned chunks land on the same "
+        "class as the unpruned scan:\n" + "\n".join(demo)
+    )
+
+    # 2. census with tail classes: a table larger than batch_rows scans
+    # in batch_rows chunks plus one smaller tail chunk
+    c = CatalogManager()
+    c.register("tpch", create_tpch_connector())
+    sql_tail = (
+        "select l_returnflag, sum(l_quantity) from lineitem "
+        "group by l_returnflag"
+    )
+    output = Analyzer(c, "tpch", "tiny").plan(parse(sql_tail))
+    census = census_text(
+        shape_census(
+            output, c, batch_rows=49152, ladder=CapacityLadder(base=2)
+        ),
+        warn_threshold=32,
+    )
+
+    # 3. the census-driven warmup plan: the fused filter/project stages
+    # the planner registered for AOT compilation, with their predicted
+    # capacity classes (plan-time artifact — no runtime counters)
+    r = LocalQueryRunner(Session(catalog="tpch", schema="tiny"))
+    r.register_catalog("tpch", create_tpch_connector())
+    sql_warm = (
+        "select l_orderkey + 1 from lineitem where l_quantity * 2 < 10"
+    )
+    stmt = parse(sql_warm)
+    q = stmt.query if hasattr(stmt, "query") else stmt
+    _, physical = r._plan(q, sql_key=None)
+    svc = WarmupService(physical.warmup_entries, mode="block")
+    emit(
+        "06_compile_regime.txt",
+        ("capacity ladder (compile/shapes.py): geometric rungs pruned "
+         "scan chunks,\nspill re-reads and exchange pages pad up to; "
+         "base is the session property\ncapacity_ladder_base",
+         ladder_text),
+        (f"QUERY\n{sql_tail}", ""),
+        ("stabilized shape census at batch_rows=49152 (lineitem tiny = "
+         "60175 rows\n> batch_rows, so the scan and its consumers carry "
+         "a tail capacity class\nbeside the main one)", census),
+        (f"QUERY\n{sql_warm}", ""),
+        ("warmup plan (compile/warmup.py): the fused FilterProject "
+         "stage the planner\nregistered, warmed once per predicted "
+         "capacity on an all-dead zero batch\nbefore (block) or while "
+         "(background) the query runs", svc.plan_text()),
+    )
+
+
 def write_all(out_dir=None):
     """Regenerate every corpus file (into `out_dir` when given — used
     by tests/test_explain_corpus.py to diff against committed files)."""
@@ -295,6 +366,7 @@ def write_all(out_dir=None):
         corpus_03_partial_agg()
         corpus_04_elided_exchange()
         corpus_05_plan_validation()
+        corpus_06_compile_regime()
     finally:
         _OUT_DIR[0] = HERE
 

@@ -339,6 +339,33 @@ def test_explain_analyze_census_matches_observed(tpch_runner):
     assert abs(expected - observed) <= 1, text
 
 
+def test_explain_analyze_census_tail_classes(tpch_runner):
+    """Tables larger than batch_rows scan in batch_rows chunks plus one
+    smaller tail chunk; the census must count the tail capacity class
+    (PR 5 carried a known miss here) and the ±1 acceptance bound must
+    hold through it. batch_rows=49152 puts lineitem tiny (60175 rows)
+    at main class 65536 + tail class 16384."""
+    tpch_runner.execute("SET SESSION batch_rows = 49152")
+    try:
+        res = tpch_runner.execute(
+            "explain analyze select l_returnflag, sum(l_quantity) "
+            "from lineitem group by l_returnflag"
+        )
+        text = res.rows[0][0]
+        expected = int(
+            text.split("expected_xla_lowerings=")[1].split()[0].rstrip(";")
+        )
+        observed = int(
+            text.split("observed_shape_classes=")[1].split()[0].rstrip(";")
+        )
+        assert abs(expected - observed) <= 1, text
+        # both the main and the tail scan class are predicted
+        assert "TableScanOperator cap=65536" in text, text
+        assert "TableScanOperator cap=16384" in text, text
+    finally:
+        tpch_runner.execute(f"SET SESSION batch_rows = {1 << 20}")
+
+
 def test_census_warns_above_threshold():
     classes = [
         Lowering(f"Op{i}", 16, ("bigint",)) for i in range(5)

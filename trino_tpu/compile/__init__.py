@@ -1,0 +1,23 @@
+"""Compile regime: shape stabilization, census-driven warmup, and
+managed XLA compile caches.
+
+Three cooperating parts (see README "Compile regime & warmup"):
+
+- `shapes`  — the capacity-class ladder and the per-plan
+  ShapeStabilizer policy that pads operator-facing batches (pruned
+  scans, tail chunks, spill re-reads) onto a small closed set of
+  capacity classes so retries re-land on already-compiled lowerings.
+- `warmup`  — a warmup service fed by the static shape census
+  (sql/validate.py) that precompiles predicted lowerings ahead of
+  first touch, plus the process-wide WARM_CLASSES registry consulted
+  by the stuck-task watchdog.
+- `cache`   — the in-process keyed program cache (cross-query jit
+  reuse) and the managed persistent XLA compilation-cache directory
+  (salted layout, startup scrub, size-bounded LRU eviction).
+
+Submodules are imported lazily by callers, not here: `cache` is pulled
+in by jaxcfg during early interpreter startup and must not drag the
+whole package (and its block.py dependency) with it.
+"""
+
+__all__ = ["shapes", "warmup", "cache"]

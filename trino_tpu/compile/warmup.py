@@ -1,0 +1,204 @@
+"""Census-driven AOT warmup.
+
+At plan time the LocalPlanner records, for every fused filter/project
+stage it builds, a WarmupEntry: the jitted callable, its input schema,
+and the capacity classes the shape census predicts the stage will see
+(the stabilized scan classes of the chain feeding it — main class plus
+the tail class for tables larger than batch_rows). The WarmupService
+then drives each callable once per predicted capacity on an all-dead
+zero batch, populating jax's jit dispatch cache ahead of first touch.
+
+Why execute a zero batch instead of `.lower().compile()`: the AOT path
+produces a separate Compiled object whose executable is not guaranteed
+to seed the jit wrapper's own dispatch cache on this jax version, so a
+"warmed" program could still compile again on first real call. Calling
+the wrapper itself with a dead batch (live mask all False — operators
+never read dead lanes, so the execution cost is one masked pass over
+zeros) is the warm path the query will actually take. jax's internal
+locking gives first-touch pipelining for free: the background thread
+compiles entries in order while the query runs, and execution blocks
+only if it reaches a program mid-compile — never on programs it does
+not need.
+
+Failure policy: a warmup failure marks the entry "failed" and moves
+on; the query compiles that program on demand exactly as without
+warmup. Warmup can slow a query down at worst — never fail it.
+
+The module also owns WARM_CLASSES, the process-global registry of
+(operator, capacity, dtype-sig) classes known compiled — fed by warmup
+compiles and by successfully completed tasks — which the stuck-task
+watchdog consults to apply the aggressive `stuck_task_interrupt_warm_s`
+threshold only to tasks whose predicted classes are all warm (a cold
+compile burst can no longer be mistaken for a hang).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Iterable, Optional, Sequence, Set, Tuple
+
+# (operator, capacity, dtype-sig) classes proven compiled in this
+# process — the same vocabulary as the shape ledger (exec/stats.py)
+# and the census (sql/validate.py Lowering).
+WARM_CLASSES: Set[Tuple] = set()
+_warm_lock = threading.Lock()
+
+
+def note_classes_warm(keys: Iterable[Tuple]) -> None:
+    """Record classes as compiled (warmup success or task completion)."""
+    with _warm_lock:
+        WARM_CLASSES.update(keys)
+
+
+def classes_warm(keys: Iterable[Tuple]) -> bool:
+    """True when every key is already registered warm (and there is at
+    least one key — an empty prediction proves nothing)."""
+    ks = set(keys)
+    if not ks:
+        return False
+    with _warm_lock:
+        return ks <= WARM_CLASSES
+
+
+def reset_warm_classes() -> None:
+    """Test hook: forget everything (a fresh 'process')."""
+    with _warm_lock:
+        WARM_CLASSES.clear()
+
+
+@dataclasses.dataclass
+class WarmupEntry:
+    """One fused stage to precompile across its predicted capacities."""
+
+    operator: str  # ledger/census operator name ("FilterProjectOperator")
+    fn: object  # the jitted batch->batch callable
+    in_schema: Sequence  # [(DataType, Dictionary|None)] feeding fn
+    out_dtypes: Tuple[str, ...]  # output column type strs (ledger sig)
+    capacities: Tuple[int, ...]
+    status: str = "pending"  # pending | compiled | failed | skipped
+    detail: str = ""
+
+    def keys(self) -> Set[Tuple]:
+        return {(self.operator, c, self.out_dtypes) for c in self.capacities}
+
+
+def zeros_batch(schema, capacity: int):
+    """All-dead batch of the given schema at the given capacity: zero
+    data, live mask all False. Raises for nested types (array/row zero
+    layouts are operator-specific; those entries degrade to
+    on-demand)."""
+    import jax.numpy as jnp
+
+    from trino_tpu.block import Column, RelBatch
+
+    cols = []
+    for typ, d in schema:
+        if getattr(typ, "is_nested", False):
+            raise NotImplementedError(f"nested warmup unsupported: {typ}")
+        cols.append(Column(typ, jnp.zeros((capacity,), dtype=typ.dtype), None, d))
+    return RelBatch(cols, jnp.zeros((capacity,), dtype=bool))
+
+
+class WarmupService:
+    """Drives a list of WarmupEntry to compiled status.
+
+    mode="background": compile on a daemon thread while the query runs.
+    mode="block": same thread work, but the caller wait()s before
+    execution starts (deterministic cold-start measurement, tests).
+    """
+
+    def __init__(self, entries: Sequence[WarmupEntry], mode: str = "background"):
+        self.entries = list(entries)
+        self.mode = mode
+        self._done = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- driving ---------------------------------------------------------
+
+    def start(self) -> "WarmupService":
+        if self.mode == "off" or not self.entries:
+            self._done.set()
+            return self
+        self._thread = threading.Thread(
+            target=self._run, name="trino-tpu-warmup", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    def _run(self) -> None:
+        try:
+            for entry in self.entries:
+                self._warm_entry(entry)
+        finally:
+            self._done.set()
+
+    def _warm_entry(self, entry: WarmupEntry) -> None:
+        from trino_tpu.runtime.metrics import METRICS
+
+        compiled = 0
+        for cap in entry.capacities:
+            key = (entry.operator, cap, entry.out_dtypes)
+            try:
+                batch = zeros_batch(entry.in_schema, cap)
+            except Exception as ex:
+                entry.status = "skipped"
+                entry.detail = str(ex)
+                METRICS.increment("warmup_skipped")
+                return
+            try:
+                entry.fn(batch)
+            except Exception as ex:
+                entry.status = "failed"
+                entry.detail = str(ex)
+                METRICS.increment("warmup_failures")
+                return  # degrade to on-demand compile; never fail the query
+            note_classes_warm([key])
+            compiled += 1
+            METRICS.increment("warmup_compiles")
+        entry.status = "compiled"
+        entry.detail = f"{compiled} capacities"
+
+    # -- reporting -------------------------------------------------------
+
+    def warmed_keys(self) -> Set[Tuple]:
+        out: Set[Tuple] = set()
+        for e in self.entries:
+            if e.status == "compiled":
+                out |= e.keys()
+        return out
+
+    def status_counts(self):
+        counts = {"compiled": 0, "failed": 0, "skipped": 0, "pending": 0}
+        for e in self.entries:
+            counts[e.status] = counts.get(e.status, 0) + 1
+        return counts
+
+    def report_line(self, ledger: Optional[Set[Tuple]] = None) -> str:
+        """EXPLAIN ANALYZE line, printed next to the census. Hits are
+        warmed classes the query actually executed; misses are observed
+        classes warmup did not cover (compiled on demand — scans,
+        aggregates, and any failed/skipped entries)."""
+        c = self.status_counts()
+        line = (
+            f"warmup: mode={self.mode} entries={len(self.entries)} "
+            f"compiled={c['compiled']} failed={c['failed']} "
+            f"skipped={c['skipped']}"
+        )
+        if ledger is not None:
+            warmed = self.warmed_keys()
+            hits = len(warmed & ledger)
+            misses = len(ledger - warmed)
+            line += f" hits={hits} misses={misses}"
+        return line
+
+    def plan_text(self) -> str:
+        """Deterministic pre-execution listing (explain_corpus)."""
+        lines = [f"Warmup plan: mode={self.mode} entries={len(self.entries)}"]
+        for e in self.entries:
+            caps = ",".join(str(c) for c in e.capacities)
+            lines.append(f"  {e.operator} caps=[{caps}] [{', '.join(e.out_dtypes)}]")
+        return "\n".join(lines)

@@ -148,6 +148,24 @@ for _name, _type, _default, _desc, _allowed in [
      "EXPLAIN (ANALYZE) warns when the shape census predicts more "
      "distinct (operator, capacity, dtype) XLA lowerings than this",
      None),
+    # -- compile regime (compile/: shapes, warmup, cache) --
+    ("shape_stabilization", bool, True,
+     "pad scan chunks to the capacity class of their pre-pruning span "
+     "so pushdown/dynamic-filter pruning and FTE retries re-land on "
+     "census-predicted XLA lowerings", None),
+    ("capacity_ladder_base", int, 2,
+     "geometric ratio between capacity-ladder rungs (power of two; "
+     "2 = the native bucket_capacity grid, larger = fewer, coarser "
+     "capacity classes)", None),
+    ("warmup_mode", str, "off",
+     "census-driven AOT warmup of predicted lowerings: off | "
+     "background (compile while the query runs) | block (wait for "
+     "warmup before execution)", ("off", "background", "block")),
+    ("stuck_task_interrupt_warm_s", float, 0.0,
+     "aggressive stuck-task watchdog threshold applied once a task's "
+     "predicted shape classes are all warm (warmup/cache hits or a "
+     "prior completed run); 0 falls back to stuck_task_interrupt_s",
+     None),
 ]:
     SYSTEM_PROPERTIES.register(_name, _type, _default, _desc, _allowed)
 

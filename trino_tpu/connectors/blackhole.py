@@ -64,7 +64,8 @@ class BlackholePageSource(ConnectorPageSource):
     def __init__(self, metadata: BlackholeMetadata):
         self.metadata = metadata
 
-    def batches(self, split: Split, columns: Sequence[str], batch_rows: int) -> Iterator[RelBatch]:
+    def batches(self, split: Split, columns: Sequence[str], batch_rows: int,
+                stabilizer=None) -> Iterator[RelBatch]:
         cols_meta = {
             c.name: c for c in self.metadata.tables[(split.table.schema, split.table.table)]
         }

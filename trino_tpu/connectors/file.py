@@ -814,7 +814,8 @@ class FilePageSource(ConnectorPageSource):
         self.store = store
 
     def batches(
-        self, split: Split, columns: Sequence[str], batch_rows: int
+        self, split: Split, columns: Sequence[str], batch_rows: int,
+        stabilizer=None,
     ) -> Iterator[RelBatch]:
         cs = getattr(split.table, "constraints", ())
         t = (
@@ -829,7 +830,11 @@ class FilePageSource(ConnectorPageSource):
         for a in range(lo, hi, batch_rows):
             b = min(a + batch_rows, hi)
             n = b - a
-            cap = bucket_capacity(n)
+            # chunks span the (pre-filtered) table contiguously, so the
+            # span equals the chunk length; the stabilizer only snaps it
+            # onto the session's capacity ladder
+            cap = (stabilizer.chunk_capacity(n) if stabilizer is not None
+                   else bucket_capacity(n))
             cols = []
             for name in columns:
                 typ = types[name]

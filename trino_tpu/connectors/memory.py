@@ -240,18 +240,27 @@ class MemoryPageSource(ConnectorPageSource):
     def __init__(self, store: _Store):
         self.store = store
 
-    def batches(self, split: Split, columns: Sequence[str], batch_rows: int) -> Iterator[RelBatch]:
+    def batches(self, split: Split, columns: Sequence[str], batch_rows: int,
+                stabilizer=None) -> Iterator[RelBatch]:
         t = self.store.tables[(split.table.schema, split.table.table)]
         cs = getattr(split.table, "constraints", ())
+        # the stabilizer changes batch capacities, so it must key the
+        # device cache (sessions with different ladders cannot share)
+        stab_sig = (
+            (stabilizer.ladder.base, stabilizer.ladder.min_capacity)
+            if stabilizer is not None else None
+        )
         if split.payload is not None and split.payload[0] == "bucket":
             _, bi, nb = split.payload
             idx = np.nonzero(self._bucket_ids(t, nb) == bi)[0]
             lo = hi = None
-            cache_key = (t.version, tuple(columns), batch_rows, "bucket", bi, nb, cs)
+            cache_key = (t.version, tuple(columns), batch_rows, "bucket", bi,
+                         nb, cs, stab_sig)
         else:
             lo, hi = split.row_range
             idx = None
-            cache_key = (t.version, tuple(columns), batch_rows, lo, hi, cs)
+            cache_key = (t.version, tuple(columns), batch_rows, lo, hi, cs,
+                         stab_sig)
         cached = t.device_cache.get(cache_key)
         if cached is not None:
             yield from cached
@@ -277,7 +286,8 @@ class MemoryPageSource(ConnectorPageSource):
             else:
                 idx = idx[mask[idx]]
         out = []
-        for batch in self._materialize(t, columns, batch_rows, lo, hi, idx):
+        for batch in self._materialize(t, columns, batch_rows, lo, hi, idx,
+                                       stabilizer=stabilizer):
             out.append(batch)
             yield batch
         for k in [k for k in t.device_cache if k[0] != t.version]:
@@ -318,7 +328,8 @@ class MemoryPageSource(ConnectorPageSource):
         return bids
 
     def _materialize(self, t, columns: Sequence[str], batch_rows: int,
-                     lo, hi, idx: Optional[np.ndarray] = None) -> Iterator[RelBatch]:
+                     lo, hi, idx: Optional[np.ndarray] = None,
+                     stabilizer=None) -> Iterator[RelBatch]:
         """Chunk either a contiguous [lo, hi) row range (plain splits —
         ndarray slicing, one memcpy per column) or an explicit row-index
         array (bucket splits — gathered copy)."""
@@ -335,7 +346,17 @@ class MemoryPageSource(ConnectorPageSource):
         for sel in sels:
             ranged = isinstance(sel, slice)
             n = (sel.stop - sel.start) if ranged else len(sel)
-            cap = bucket_capacity(n)
+            if stabilizer is None:
+                cap = bucket_capacity(n)
+            elif ranged:
+                # contiguous chunks are unpruned: the slice length IS
+                # the span, so main/tail classes match the census
+                cap = stabilizer.chunk_capacity(n)
+            else:
+                # index-gathered chunks (pushdown-pruned rows, bucket
+                # splits) have data-dependent sizes; pad to the table's
+                # main scan class so pruning never mints a new lowering
+                cap = stabilizer.chunk_capacity(min(t.row_count, batch_rows))
             cols = []
             for name in columns:
                 sc = t.data[name]

@@ -188,9 +188,18 @@ class ConnectorSplitManager:
 
 class ConnectorPageSource:
     """Produces batches for one split (ConnectorPageSource.java:24).
-    `columns` is the pruned projection (channel names)."""
+    `columns` is the pruned projection (channel names).
 
-    def batches(self, split: Split, columns: Sequence[str], batch_rows: int) -> Iterator[RelBatch]:
+    `stabilizer` (compile.shapes.ShapeStabilizer, optional) is the
+    session's capacity-class policy: when given, a source should pad
+    each chunk to `stabilizer.chunk_capacity(span)` of its pre-pruning
+    span so pushdown/dynamic-filter pruning lands on the same XLA
+    lowering class as the unpruned scan. Sources that ignore the kwarg
+    (older/external connectors) keep working — TableScanOperator falls
+    back to the 3-argument call on TypeError."""
+
+    def batches(self, split: Split, columns: Sequence[str], batch_rows: int,
+                stabilizer=None) -> Iterator[RelBatch]:
         raise NotImplementedError
 
 

@@ -502,14 +502,16 @@ class TpcdsSplitManager(ConnectorSplitManager):
 
 
 class TpcdsPageSource(ConnectorPageSource):
-    def batches(self, split: Split, columns: Sequence[str], batch_rows: int) -> Iterator[RelBatch]:
+    def batches(self, split: Split, columns: Sequence[str], batch_rows: int,
+                stabilizer=None) -> Iterator[RelBatch]:
         table = split.table.table
         sf = split.table.payload
         lo, hi = split.row_range
         types = dict(TABLES[table])
         for a in range(lo, hi, batch_rows):
             b = min(a + batch_rows, hi)
-            cap = bucket_capacity(b - a)
+            cap = (stabilizer.chunk_capacity(b - a) if stabilizer is not None
+                   else bucket_capacity(b - a))
             cols = []
             for name in columns:
                 data, d = generate_column(table, name, sf, a, b)

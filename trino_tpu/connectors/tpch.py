@@ -850,7 +850,8 @@ class TpchSplitManager(ConnectorSplitManager):
 
 
 class TpchPageSource(ConnectorPageSource):
-    def batches(self, split: Split, columns: Sequence[str], batch_rows: int) -> Iterator[RelBatch]:
+    def batches(self, split: Split, columns: Sequence[str], batch_rows: int,
+                stabilizer=None) -> Iterator[RelBatch]:
         table = split.table.table
         sf = split.table.payload
         cs = getattr(split.table, "constraints", ())
@@ -865,6 +866,7 @@ class TpchPageSource(ConnectorPageSource):
                 data, d = generate_column(table, name, sf, a, b)
                 gen[name] = (np.asarray(data), d)
                 nrows = len(data)
+            span = nrows  # pre-pruning chunk size (shape stabilization)
             keep = None
             if cs:
                 # pushed-down predicate: generate the constrained
@@ -881,6 +883,8 @@ class TpchPageSource(ConnectorPageSource):
 
                 mask = constraint_mask(cs, _coldata)
                 keep = np.nonzero(mask)[0]
+                if span is None:  # count(*) over a constrained scan
+                    span = len(mask)
                 nrows = len(keep)
             if nrows is None:  # no columns requested (count(*) scans)
                 oi_count = b - a
@@ -888,17 +892,23 @@ class TpchPageSource(ConnectorPageSource):
                     oi, _ = _lineitem_rows(a, b, sf)
                     oi_count = len(oi)
                 nrows = oi_count
+                span = nrows
+            # stabilized scans pad to the capacity class of the chunk's
+            # pre-pruning span, so pushdown/dynamic-filter pruning never
+            # mints a data-dependent (smaller) class
+            if stabilizer is not None:
+                cap = stabilizer.chunk_capacity(span)
+            else:
+                cap = bucket_capacity(nrows)
             cols = []
             for name in columns:
                 data, d = gen[name]
                 if keep is not None:
                     data = data[keep]
-                cap = bucket_capacity(nrows)
                 typ = types[name]
                 arr = np.zeros(cap, dtype=typ.dtype)
                 arr[:nrows] = data
                 cols.append(Column(typ, jnp.asarray(arr), None, d))
-            cap = bucket_capacity(nrows)
             live = None
             if nrows != cap:
                 lv = np.zeros(cap, dtype=bool)

@@ -106,6 +106,22 @@ class Session:
     # EXPLAIN (ANALYZE) warns when the shape census predicts more
     # distinct XLA lowerings than this per plan/fragment
     compile_churn_warn_threshold: int = 32
+    # shape stabilization (compile/shapes.py): pad scan chunks to the
+    # capacity class of their pre-pruning span so pushdown/dynamic-
+    # filter pruning and FTE retries re-land on census-predicted
+    # lowerings instead of minting data-dependent ones
+    shape_stabilization: bool = True
+    # geometric ratio between capacity-ladder rungs (power of two);
+    # 2 = the native bucket_capacity grid, larger = fewer classes
+    capacity_ladder_base: int = 2
+    # census-driven AOT warmup (compile/warmup.py): "off" | "background"
+    # (precompile predicted lowerings while the query runs) | "block"
+    # (wait for warmup before execution — deterministic cold starts)
+    warmup_mode: str = "off"
+    # aggressive watchdog threshold once a task's predicted shape
+    # classes are all warm (warmup/cache hits or a prior completed
+    # run); 0 falls back to stuck_task_interrupt_s
+    stuck_task_interrupt_warm_s: float = 0.0
 
     def set_property(self, name: str, value) -> None:
         """SET SESSION entry point — validated through the typed
@@ -1072,6 +1088,8 @@ class LocalQueryRunner:
                 self.session.target_splits,
                 self.session.enable_dynamic_filtering,
                 self.session.enable_pushdown,
+                self.session.shape_stabilization,
+                self.session.capacity_ladder_base,
             )
         cached = self._plan_cache.get(cache_key) if cache_key else None
         if cached is not None:
@@ -1093,6 +1111,7 @@ class LocalQueryRunner:
                 batch_rows=self.session.batch_rows,
                 target_splits=self.session.target_splits,
                 dynamic_filtering=self.session.enable_dynamic_filtering,
+                stabilizer=self._make_stabilizer(),
             )
             physical = planner.plan(output)
         # plans with analysis-time-folded volatile values (now(),
@@ -1109,23 +1128,62 @@ class LocalQueryRunner:
             ctx["memory_pool"] = MemoryPool(self.session.memory_pool_bytes)
         return ctx
 
+    def _make_stabilizer(self):
+        """Session's capacity policy (compile/shapes.py); None when
+        shape stabilization is off."""
+        if not getattr(self.session, "shape_stabilization", True):
+            return None
+        from trino_tpu.compile.shapes import CapacityLadder, ShapeStabilizer
+
+        return ShapeStabilizer(
+            CapacityLadder(
+                base=getattr(self.session, "capacity_ladder_base", 2)
+            ),
+            batch_rows=self.session.batch_rows,
+        )
+
+    def _start_warmup(self, physical):
+        """Kick off census-driven AOT warmup per warmup_mode; returns
+        the (started) WarmupService or None. mode=block waits here, so
+        execution starts with every predicted program compiled."""
+        mode = getattr(self.session, "warmup_mode", "off")
+        entries = getattr(physical, "warmup_entries", ())
+        if mode == "off" or not entries:
+            return None
+        from trino_tpu.compile.warmup import WarmupService
+
+        svc = WarmupService(entries, mode=mode).start()
+        if mode == "block":
+            svc.wait()
+        return svc
+
+    def _attribution_id(self) -> str:
+        self._query_seq += 1
+        return f"local-{self._query_seq}"
+
     def _execute_query(self, q: ast.Query, sql_key: Optional[str] = None) -> MaterializedResult:
+        from trino_tpu.runtime.metrics import set_compile_attribution
         from trino_tpu.utils.tracing import TRACER
 
         output, physical = self._plan(q, sql_key)
+        self._start_warmup(physical)
         ctx = self._execution_ctx()
         pipelines, chain = physical.instantiate(ctx)
         sink = CollectorSink()
         chain.append(sink)
-        with TRACER.span("execute"):
-            for p in pipelines:
-                Driver(p).run()
-            Driver(Pipeline(chain)).run()
-            checks = ctx.get("deferred_checks", ())
-            rows, flags = sink.rows_with(tuple(f for f, _ in checks))
-            for v, (_, msg) in zip(flags, checks):
-                if v:
-                    raise RuntimeError(msg)
+        prev_qid = set_compile_attribution(self._attribution_id())
+        try:
+            with TRACER.span("execute"):
+                for p in pipelines:
+                    Driver(p).run()
+                Driver(Pipeline(chain)).run()
+                checks = ctx.get("deferred_checks", ())
+                rows, flags = sink.rows_with(tuple(f for f, _ in checks))
+                for v, (_, msg) in zip(flags, checks):
+                    if v:
+                        raise RuntimeError(msg)
+        finally:
+            set_compile_attribution(prev_qid)
         return MaterializedResult(
             rows,
             list(output.names),
@@ -1143,16 +1201,21 @@ class LocalQueryRunner:
         from trino_tpu.runtime.metrics import (
             METRICS,
             install_xla_compile_listener,
+            set_compile_attribution,
         )
         from trino_tpu.sql.validate import census_text, shape_census
 
         install_xla_compile_listener()
         output, physical = self._plan(q, sql_key=None)
+        stabilizer = self._make_stabilizer()
         classes = shape_census(
             output, self.catalogs,
             batch_rows=self.session.batch_rows,
             dynamic_filtering=self.session.enable_dynamic_filtering,
+            ladder=stabilizer.ladder if stabilizer is not None else None,
         )
+        warmup_svc = self._start_warmup(physical)
+        qid = self._attribution_id()
         before = METRICS.snapshot()
         ctx = self._execution_ctx()
         pipelines, chain = physical.instantiate(ctx)
@@ -1171,11 +1234,16 @@ class LocalQueryRunner:
             chain, device_sync=True, shape_ledger=ledger
         )
         groups.append(main_stats)
-        for p in wrapped_pipelines:
-            Driver(p).run()
-        Driver(Pipeline(main_ops)).run()
+        prev_qid = set_compile_attribution(qid)
+        try:
+            for p in wrapped_pipelines:
+                Driver(p).run()
+            Driver(Pipeline(main_ops)).run()
+        finally:
+            set_compile_attribution(prev_qid)
         _raise_deferred_checks(ctx)
-        counters = engine_counters_delta(before, METRICS.snapshot())
+        after = METRICS.snapshot()
+        counters = engine_counters_delta(before, after)
         census = census_text(
             classes,
             warn_threshold=getattr(
@@ -1183,6 +1251,34 @@ class LocalQueryRunner:
             ),
             observed=len(ledger),
         )
+        # compile-regime lines ride directly under the census: per-query
+        # attributed compile count (satellite of the process-wide
+        # xla_compiles engine counter), warmup hit/miss, cache stats
+        qkey = f"xla_compiles_by_query.{qid}"
+        compiled_here = int(after.get(qkey, 0.0) - before.get(qkey, 0.0))
+        census += f"\nxla_compiles_this_query={compiled_here}"
+        if warmup_svc is not None:
+            if warmup_svc.mode == "background":
+                # settle before reporting so entry statuses are final
+                warmup_svc.wait(timeout=60.0)
+            census += "\n" + warmup_svc.report_line(ledger)
+        from trino_tpu.compile.cache import (
+            ACTIVE_PERSISTENT_CACHE,
+            PROGRAM_CACHE,
+        )
+
+        ps = PROGRAM_CACHE.stats()
+        census += (
+            f"\nprogram_cache: entries={ps['entries']} hits={ps['hits']} "
+            f"misses={ps['misses']} evictions={ps['evictions']}"
+        )
+        if ACTIVE_PERSISTENT_CACHE is not None:
+            cs = ACTIVE_PERSISTENT_CACHE.stats()
+            census += (
+                f"\npersistent_cache: entries={cs['entries']} "
+                f"bytes={cs['bytes']} scrubbed={cs['scrubbed']} "
+                f"evicted={cs['evicted']}"
+            )
         # census goes AFTER the runtime stats: per-class lines name
         # operators too, and stats consumers grep for the first line
         # mentioning an operator

@@ -242,11 +242,21 @@ class RemoteSourceOperator(Operator):
         self,
         source,  # poll() -> Optional[Page]; is_finished() -> bool
         merge_keys: Optional[Sequence[SortKey]] = None,
+        ladder=None,  # compile.shapes.CapacityLadder; snaps page capacities
     ):
         self._source = source
         self._merge_keys = tuple(merge_keys) if merge_keys else None
+        self._ladder = ladder
         self._pending: List[RelBatch] = []
         self._done = False
+
+    def _page_capacity(self, row_count: int) -> Optional[int]:
+        # snap exchange-page capacities onto the session's capacity
+        # ladder (base 2 == the native bucket grid, so the default is a
+        # no-op; a coarser ladder collapses consumer-side classes)
+        if self._ladder is None:
+            return None
+        return self._ladder.rung(row_count)
 
     def needs_input(self) -> bool:
         return False
@@ -258,7 +268,9 @@ class RemoteSourceOperator(Operator):
             page = self._source.poll()
             while page is not None:
                 if page.row_count:
-                    self._pending.append(page.to_batch())
+                    self._pending.append(
+                        page.to_batch(capacity=self._page_capacity(page.row_count))
+                    )
                 page = self._source.poll()
             if not self._source.is_finished():
                 return None
@@ -278,7 +290,7 @@ class RemoteSourceOperator(Operator):
             if self._source.is_finished():
                 self._done = True
             return None
-        return page.to_batch()
+        return page.to_batch(capacity=self._page_capacity(page.row_count))
 
     def is_blocked(self) -> bool:
         return not self._done and not self._source.is_finished()

@@ -94,12 +94,24 @@ class TableScanOperator(Operator):
     """Pulls batches from a ConnectorPageSource over a list of splits
     (TableScanOperator.java:47)."""
 
-    def __init__(self, page_source, splits, columns: Sequence[str], batch_rows: int):
-        self._iters = iter(
-            batch
-            for split in splits
-            for batch in page_source.batches(split, columns, batch_rows)
-        )
+    def __init__(self, page_source, splits, columns: Sequence[str], batch_rows: int,
+                 stabilizer=None):
+        def _gen():
+            for split in splits:
+                if stabilizer is not None:
+                    try:
+                        # argument binding raises TypeError immediately
+                        # for page sources predating the stabilizer kwarg
+                        it = page_source.batches(
+                            split, columns, batch_rows, stabilizer=stabilizer
+                        )
+                    except TypeError:
+                        it = page_source.batches(split, columns, batch_rows)
+                else:
+                    it = page_source.batches(split, columns, batch_rows)
+                yield from it
+
+        self._iters = _gen()
         self._done = False
 
     def needs_input(self) -> bool:
@@ -151,11 +163,15 @@ class ValuesOperator(Operator):
 
 
 def make_filter_project_fn(
-    filter_bound: Optional[Bound], projections: Sequence[Bound]
+    filter_bound: Optional[Bound], projections: Sequence[Bound],
+    name: str = "filter_project",
 ):
     """Compile the fused filter+project device program once; shared by
     every operator instance the factory creates (the PageProcessor cache
-    discipline — PageFunctionCompiler.java:103 caches per expression)."""
+    discipline — PageFunctionCompiler.java:103 caches per expression).
+    `name` labels the jit for profiles/compile logs; it must be stable
+    across queries (operator-derived, never a query id) or it would
+    split the persistent compile-cache key space."""
     projections = list(projections)
 
     def fn(batch: RelBatch) -> RelBatch:
@@ -200,17 +216,22 @@ def make_filter_project_fn(
             out_cols.append(Column(b.type, data, valid, d))
         return RelBatch(out_cols, live)
 
+    fn.__name__ = fn.__qualname__ = name
     return jax.jit(fn)
 
 
-def compose_batch_fns(f1, f2):
+def compose_batch_fns(f1, f2, name: str = "filter_project_chain"):
     """Fuse two per-batch device programs into one (plan-time; the
     composed jit is cached with the plan). On remote-attached devices
     every separate program launch costs a host round trip, so the
     planner folds adjacent filter/project stages — and folds them into
     the consuming blocking operator's kernel — the way XLA fusion folds
     elementwise ops into the matmul."""
-    return jax.jit(lambda b: f2(f1(b)))
+    def composed(b):
+        return f2(f1(b))
+
+    composed.__name__ = composed.__qualname__ = name
+    return jax.jit(composed)
 
 
 class FilterProjectOperator(Operator):

@@ -27,19 +27,23 @@ class FileSpiller:
             prefix="trino-tpu-spill-", suffix=".pages", dir=self._dir
         )
         self._file = os.fdopen(fd, "wb+")
-        self._offsets: List[tuple] = []  # (offset, length)
+        self._offsets: List[tuple] = []  # (offset, length, capacity|None)
         self.spilled_bytes = 0
 
     def spill(self, batch: RelBatch) -> None:
-        self._append(serialize_batch(batch))
+        # record the source capacity so re-reads re-enter the operator
+        # on the class it was first compiled for (shape stabilization:
+        # serialization compacts to live rows, and re-bucketing the
+        # compacted count would mint a fresh — usually smaller — class)
+        self._append(serialize_batch(batch), capacity=batch.capacity)
 
-    def spill_page(self, page: Page) -> None:
-        self._append(serialize_page(page))
+    def spill_page(self, page: Page, capacity: Optional[int] = None) -> None:
+        self._append(serialize_page(page), capacity=capacity)
 
-    def _append(self, data: bytes) -> None:
+    def _append(self, data: bytes, capacity: Optional[int] = None) -> None:
         off = self._file.tell()
         self._file.write(data)
-        self._offsets.append((off, len(data)))
+        self._offsets.append((off, len(data), capacity))
         self.spilled_bytes += len(data)
 
     @property
@@ -47,13 +51,17 @@ class FileSpiller:
         return len(self._offsets)
 
     def unspill(self) -> Iterator[RelBatch]:
-        """Read batches back (merge-on-unspill consumes these)."""
-        for page in self.unspill_pages():
-            yield page.to_batch()
+        """Read batches back (merge-on-unspill consumes these) at their
+        original spill-time capacity."""
+        self._file.flush()
+        for off, ln, cap in self._offsets:
+            self._file.seek(off)
+            page = deserialize_page(self._file.read(ln))
+            yield page.to_batch(capacity=cap)
 
     def unspill_pages(self) -> Iterator[Page]:
         self._file.flush()
-        for off, ln in self._offsets:
+        for off, ln, _cap in self._offsets:
             self._file.seek(off)
             yield deserialize_page(self._file.read(ln))
 

@@ -8,8 +8,6 @@ int64 which covers TPC-H's decimal(12,2) aggregates). Hot kernels
 TPU VPU runs native-width ops.
 """
 
-import os
-
 import jax
 
 jax.config.update("jax_enable_x64", True)
@@ -53,24 +51,13 @@ def get_shard_map():
 # (operator, shape) and TPU compiles are tens of seconds over a
 # tunneled device — caching them on disk makes every process after the
 # first (test runs, bench prewarm, the driver's bench) hit warm
-# executables. TPU-targeted processes only: XLA:CPU AOT cache entries
-# record compile-option pseudo-features (prefer-no-scatter etc.) that
-# the loader flags as machine mismatches and can SIGILL. Opt out with
-# TRINO_TPU_NO_COMPILE_CACHE=1.
-if (
-    os.environ.get("TRINO_TPU_NO_COMPILE_CACHE") != "1"
-    and "cpu" not in os.environ.get("JAX_PLATFORMS", "")
-):
-    _cache_dir = os.environ.get(
-        "TRINO_TPU_COMPILE_CACHE", os.path.expanduser("~/.trino_tpu_xla_cache")
-    )
-    try:
-        os.makedirs(_cache_dir, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", _cache_dir)
-        # 5s floor keeps XLA:CPU programs (sub-second compiles) out of
-        # the cache even when JAX silently falls back to CPU with
-        # JAX_PLATFORMS unset — CPU AOT entries record compile-option
-        # pseudo-features the loader rejects on reload
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
-    except Exception:
-        pass  # cache is an optimization; never fail import over it
+# executables. Management (salted directory layout, startup scrub,
+# LRU eviction, counters) lives in compile/cache.py; the gating — TPU
+# processes only, TRINO_TPU_NO_COMPILE_CACHE=1 opt-out — is applied
+# there too.
+try:
+    from trino_tpu.compile.cache import configure_persistent_cache
+
+    configure_persistent_cache()
+except Exception:
+    pass  # cache is an optimization; never fail import over it

@@ -237,6 +237,7 @@ class ChaosHarness:
         hash_partitions: int = 2,
         memory_pool_bytes: Optional[int] = None,
         stuck_task_interrupt_s: Optional[float] = None,
+        stuck_task_interrupt_warm_s: Optional[float] = None,
     ):
         from trino_tpu.engine import Session
         from trino_tpu.runtime.coordinator import DistributedQueryRunner
@@ -259,6 +260,7 @@ class ChaosHarness:
                 failure_injector=self.injector,
                 memory_pool_bytes=memory_pool_bytes,
                 stuck_task_interrupt_s=stuck_task_interrupt_s,
+                stuck_task_interrupt_warm_s=stuck_task_interrupt_warm_s,
             ))
             for i in range(n_workers)
         ]

@@ -135,6 +135,12 @@ class QueryScheduler:
                     dynamic_filtering=self.session.enable_dynamic_filtering,
                     collect_stats=self.collect_stats,
                     task_concurrency=self.session.task_concurrency,
+                    shape_stabilization=getattr(
+                        self.session, "shape_stabilization", True
+                    ),
+                    capacity_ladder_base=getattr(
+                        self.session, "capacity_ladder_base", 2
+                    ),
                 )
                 first_loc = (
                     locations.get(id(created[0][0]))
@@ -214,6 +220,12 @@ class DistributedQueryRunner:
                 Worker(
                     f"worker-{i}", self.catalogs,
                     memory_pool_bytes=self.session.memory_pool_bytes,
+                    stuck_task_interrupt_s=getattr(
+                        self.session, "stuck_task_interrupt_s", 0.0
+                    ) or None,
+                    stuck_task_interrupt_warm_s=getattr(
+                        self.session, "stuck_task_interrupt_warm_s", 0.0
+                    ) or None,
                 )
                 for i in range(n_workers)
             ]

@@ -246,6 +246,12 @@ class FaultTolerantQueryScheduler:
                 spool_dir=self.spool_dir,
                 dynamic_filtering=self.session.enable_dynamic_filtering,
                 task_concurrency=self.session.task_concurrency,
+                shape_stabilization=getattr(
+                    self.session, "shape_stabilization", True
+                ),
+                capacity_ladder_base=getattr(
+                    self.session, "capacity_ladder_base", 2
+                ),
             )
             try:
                 handle.create_task(spec)

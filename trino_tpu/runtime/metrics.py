@@ -52,6 +52,31 @@ class MetricsRegistry:
 METRICS = MetricsRegistry()
 
 
+# -- per-query compile attribution -------------------------------------
+#
+# jax.monitoring compile events carry a duration but no originating jit
+# name, and naming wrappers per query would split the persistent-cache
+# key space (defeating cross-query executable reuse). Instead the
+# execution paths bracket their dispatch with set_compile_attribution
+# and the listener charges each compile to whichever query id the
+# *compiling thread* is running — correct because backend compiles
+# happen synchronously on the dispatching thread.
+_attribution = threading.local()
+
+
+def set_compile_attribution(query_id) -> object:
+    """Tag this thread's subsequent XLA compiles with `query_id`
+    (None to clear). Returns the previous tag so callers can restore
+    it in a finally block."""
+    prev = getattr(_attribution, "query_id", None)
+    _attribution.query_id = query_id
+    return prev
+
+
+def compile_attribution():
+    return getattr(_attribution, "query_id", None)
+
+
 _xla_listener_installed = False
 
 
@@ -73,6 +98,9 @@ def install_xla_compile_listener() -> bool:
         def _on_event(event: str, duration: float, **kw) -> None:
             if event == "/jax/core/compile/backend_compile_duration":
                 METRICS.increment("xla_compiles")
+                qid = compile_attribution()
+                if qid is not None:
+                    METRICS.increment(f"xla_compiles_by_query.{qid}")
 
         monitoring.register_event_duration_secs_listener(_on_event)
     except Exception:

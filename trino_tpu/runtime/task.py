@@ -64,6 +64,11 @@ class TaskSpec:
     # pipelines concurrently and overlap remote-page pulls with the
     # compute chain (task.concurrency analogue)
     task_concurrency: int = 2
+    # compile regime: pad operator-facing batches onto the session's
+    # capacity ladder so FTE re-attempts re-land on already-compiled
+    # (operator, capacity, dtype) lowerings (compile/shapes.py)
+    shape_stabilization: bool = True
+    capacity_ladder_base: int = 2
 
 
 def _resolve_fetch(location):
@@ -145,6 +150,13 @@ class TaskExecution:
         # these into the query_max_cpu_time_s budget
         self._cpu_base: Dict[int, float] = {}
         self._cpu_by_thread: Dict[int, float] = {}
+        # True once every shape class the census predicts for this
+        # fragment is warm (warmup compile, or a prior completed run) —
+        # the worker watchdog may then apply the tighter
+        # stuck_task_interrupt_warm_s threshold: no first-batch compile
+        # stall is possible, so silence means genuinely stuck
+        self.shapes_warm: bool = False
+        self._census_keys: frozenset = frozenset()
 
     def operator_stats(self):
         """JSON-ready [[dict]] per pipeline, or None."""
@@ -310,9 +322,23 @@ class TaskExecution:
         # heartbeat starts at task start, not first batch: a task hung
         # before producing anything is still watchdog-visible
         self.last_progress_at = time.monotonic()
+        from trino_tpu.runtime.metrics import set_compile_attribution
+
+        prev_attr = set_compile_attribution(spec.task_id.query_id)
         try:
             if self._injector is not None:
                 self._injector.check(spec.task_id, "start")
+            stabilizer = None
+            if spec.shape_stabilization:
+                from trino_tpu.compile.shapes import (
+                    CapacityLadder,
+                    ShapeStabilizer,
+                )
+
+                stabilizer = ShapeStabilizer(
+                    CapacityLadder(base=spec.capacity_ladder_base),
+                    batch_rows=spec.batch_rows,
+                )
             planner = LocalPlanner(
                 self._catalogs,
                 batch_rows=spec.batch_rows,
@@ -320,8 +346,10 @@ class TaskExecution:
                 remote_schemas=spec.remote_schemas,
                 scan_slice=spec.scan_slice,
                 dynamic_filtering=spec.dynamic_filtering,
+                stabilizer=stabilizer,
             )
             physical = planner.plan(spec.fragment.root)
+            self._note_census(stabilizer)
             if self._memory_pool is not None:
                 ctx["memory_pool"] = self._memory_pool
             pipelines, chain = physical.instantiate(ctx)
@@ -354,6 +382,12 @@ class TaskExecution:
             from trino_tpu.engine import _raise_deferred_checks
 
             _raise_deferred_checks(ctx)
+            if self._census_keys:
+                # a completed run compiled (or reused) every class it
+                # touched — re-attempts of this fragment shape are warm
+                from trino_tpu.compile.warmup import note_classes_warm
+
+                note_classes_warm(self._census_keys)
             self.state = "finished"
         except BaseException as e:
             # full traceback, not just the message: TaskInfo failures
@@ -369,6 +403,7 @@ class TaskExecution:
             self.state = "failed"
             self.buffer.abort()
         finally:
+            set_compile_attribution(prev_attr)
             # release every operator reservation: on a SHARED worker
             # pool a failed/killed task would otherwise leak its bytes
             # and poison the pool for every later query
@@ -379,6 +414,30 @@ class TaskExecution:
                     pass
             for c in self._clients:
                 c.close()
+
+    def _note_census(self, stabilizer) -> None:
+        """Predict this fragment's shape classes and check them against
+        the process-wide warm registry. Best-effort: a census failure
+        (exotic plan shape, missing stats) just leaves shapes_warm
+        False, which keeps the conservative watchdog threshold."""
+        try:
+            from trino_tpu.compile.warmup import classes_warm
+            from trino_tpu.sql.validate import shape_census
+
+            census = shape_census(
+                self.spec.fragment.root,
+                self._catalogs,
+                batch_rows=self.spec.batch_rows,
+                dynamic_filtering=self.spec.dynamic_filtering,
+                ladder=stabilizer.ladder if stabilizer is not None else None,
+            )
+            self._census_keys = frozenset(
+                (c.operator, c.capacity, c.dtypes) for c in census
+            )
+            self.shapes_warm = classes_warm(self._census_keys)
+        except Exception:
+            self._census_keys = frozenset()
+            self.shapes_warm = False
 
     def _run_pipelines(self, pipelines, chain, concurrency: int) -> None:
         """Drive the task's pipelines. concurrency > 1 enables the
@@ -427,6 +486,11 @@ class TaskExecution:
             perr: List[BaseException] = []
 
             def run_producer():
+                # compiles attribute to the dispatching thread — the
+                # producer thread needs the task's query id too
+                from trino_tpu.runtime.metrics import set_compile_attribution
+
+                set_compile_attribution(self.spec.task_id.query_id)
                 try:
                     drive(producer)
                 except BaseException as e:

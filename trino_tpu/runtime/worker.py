@@ -33,6 +33,7 @@ class Worker:
         memory_pool_bytes: Optional[int] = None,
         location: Optional[str] = None,
         stuck_task_interrupt_s: Optional[float] = None,
+        stuck_task_interrupt_warm_s: Optional[float] = None,
     ):
         self.worker_id = worker_id
         # "rack/host" network coordinate (the ICI-island id on a TPU
@@ -55,6 +56,11 @@ class Worker:
         # interrupt any RUNNING task whose per-batch heartbeat is older
         # than this; the failure is RETRYABLE (unlike deadline kills)
         self.stuck_task_interrupt_s = stuck_task_interrupt_s
+        # tighter threshold for tasks whose predicted shape classes are
+        # all warm (warmup/cache hits or a prior completed run): no
+        # first-batch compile stall is possible, so a shorter silence
+        # already proves the task is stuck
+        self.stuck_task_interrupt_warm_s = stuck_task_interrupt_warm_s
         self.watchdog_interrupts: List[Tuple[str, str]] = []
         self._watchdog_thread: Optional[threading.Thread] = None
         self._watchdog_stop = threading.Event()
@@ -86,20 +92,36 @@ class Worker:
         `watchdog_interrupts` as (task_id, diagnostic) for tests and the
         chaos harness. Explicit-tick twin of start_watchdog, mirroring
         NodeManager.ping_once."""
-        if not self.stuck_task_interrupt_s:
+        if not (self.stuck_task_interrupt_s or self.stuck_task_interrupt_warm_s):
             return []
         with self._lock:
             tasks = list(self._tasks.values())
         fired: List[str] = []
         for t in tasks:
-            diag = t.interrupt_if_stuck(self.stuck_task_interrupt_s, now=now)
+            timeout = self._watchdog_timeout(t)
+            if not timeout:
+                continue
+            diag = t.interrupt_if_stuck(timeout, now=now)
             if diag is not None:
                 fired.append(diag)
                 self.watchdog_interrupts.append((str(t.spec.task_id), diag))
         return fired
 
+    def _watchdog_timeout(self, task) -> Optional[float]:
+        """Per-task threshold: the warm threshold applies only when the
+        task's predicted shape classes are ALL warm; otherwise fall back
+        to the conservative stuck_task_interrupt_s (which may be unset —
+        then warm-only watching still works)."""
+        if self.stuck_task_interrupt_warm_s and getattr(
+            task, "shapes_warm", False
+        ):
+            return self.stuck_task_interrupt_warm_s
+        return self.stuck_task_interrupt_s
+
     def start_watchdog(self, poll_s: float = 0.01) -> None:
-        if self._watchdog_thread is not None or not self.stuck_task_interrupt_s:
+        if self._watchdog_thread is not None or not (
+            self.stuck_task_interrupt_s or self.stuck_task_interrupt_warm_s
+        ):
             return
         self._watchdog_stop.clear()
 

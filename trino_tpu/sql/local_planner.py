@@ -75,10 +75,15 @@ class PhysicalPlan:
         pipelines: List[List[Factory]],
         chain: List[Factory],
         schema: Schema,
+        warmup_entries: Sequence = (),
     ):
         self.pipeline_factories = pipelines
         self.chain_factories = chain
         self.schema = schema
+        # compile.warmup.WarmupEntry list: the fused filter/project
+        # programs this plan will dispatch, with their census-predicted
+        # capacity classes (the AOT warmup input)
+        self.warmup_entries = list(warmup_entries)
 
     def instantiate(
         self, ctx: Optional[dict] = None
@@ -102,25 +107,34 @@ class LocalPlanner:
         remote_schemas: Optional[Dict[int, "Schema"]] = None,
         scan_slice: Optional[Tuple[int, int]] = None,
         dynamic_filtering: bool = True,
+        stabilizer=None,
     ):
         """`remote_schemas` maps producer fragment id -> output Schema
         (with dictionaries) for RemoteSourceNode leaves; `scan_slice`
         (task_index, task_count) restricts scans to this task's share of
         the connector splits (the SourcePartitionedScheduler assignment,
-        collapsed to deterministic round-robin)."""
+        collapsed to deterministic round-robin). `stabilizer`
+        (compile.shapes.ShapeStabilizer) pads scan chunks onto the
+        session's capacity ladder and enables warmup-entry collection."""
         self.catalogs = catalogs
         self.batch_rows = batch_rows
         self.target_splits = target_splits
         self.remote_schemas = remote_schemas or {}
         self.scan_slice = scan_slice
         self.dynamic_filtering = dynamic_filtering
+        self.stabilizer = stabilizer
         self.pipelines: List[List[Factory]] = []
         self._next_key = 0
+        self._warmup_entries: List = []
+        self._stats_calc = None
 
     # -- public --
     def plan(self, root: P.PlanNode) -> PhysicalPlan:
         chain, schema = self._visit(root)
-        return PhysicalPlan(self.pipelines, chain, schema)
+        return PhysicalPlan(
+            self.pipelines, chain, schema,
+            warmup_entries=self._warmup_entries,
+        )
 
     # -- helpers --
     def _key(self) -> int:
@@ -154,13 +168,38 @@ class LocalPlanner:
         columns = list(node.columns)
         page_source = conn.page_source
         batch_rows = self.batch_rows
+        stabilizer = self.stabilizer
         schema: Schema = [
             (f.type, conn.metadata.column_dictionary(node.handle, c))
             for c, f in zip(node.columns, node.fields)
         ]
-        return [
-            lambda ctx: TableScanOperator(page_source, splits, columns, batch_rows)
-        ], schema
+
+        def factory(ctx):
+            return TableScanOperator(
+                page_source, splits, columns, batch_rows, stabilizer=stabilizer
+            )
+
+        # predicted output capacity classes (main + tail) — consumed by
+        # _append_fp to build warmup entries for downstream fused stages
+        factory.out_caps = self._scan_caps(node)
+        return [factory], schema
+
+    def _scan_caps(self, node: P.PlanNode) -> Optional[Tuple[int, ...]]:
+        """Census-predicted capacity classes of a scan's output batches,
+        None when stabilization is off or stats are unusable."""
+        if self.stabilizer is None:
+            return None
+        try:
+            if self._stats_calc is None:
+                from trino_tpu.sql.stats import StatsCalculator
+
+                self._stats_calc = StatsCalculator(self.catalogs)
+            rows = self._stats_calc.stats(node).row_count
+        except Exception:
+            return None
+        if not rows or rows != rows or rows >= 1e9:  # missing-stats fallback
+            return None
+        return self.stabilizer.scan_classes(rows)
 
     def _visit_ValuesNode(self, node: P.ValuesNode):
         data = {f.name or f"_c{i}": [] for i, f in enumerate(node.fields)}
@@ -171,25 +210,93 @@ class LocalPlanner:
         schema_t = [(k, f.type) for k, f in zip(keys, node.fields)]
         batch = RelBatch.from_pydict(schema_t, data)
         schema: Schema = [(c.type, c.dictionary) for c in batch.columns]
-        return [lambda ctx: ValuesOperator([batch])], schema
+
+        def factory(ctx):
+            return ValuesOperator([batch])
+
+        if self.stabilizer is not None and batch.columns:
+            factory.out_caps = (batch.capacity,)
+        return [factory], schema
 
     # -- fusion helpers (program-count reduction; see compose_batch_fns) --
-    @staticmethod
-    def _append_fp(chain: List[Factory], fn) -> None:
+    def _cached_fp(self, flt: Optional[Bound], bounds: List[Bound],
+                   schema: Schema, fingerprint) -> object:
+        """Build (or reuse from the process-wide ProgramCache) the fused
+        filter/project jit for a structurally-identified stage. Cache
+        keys combine the expr-IR fingerprint with the input schema
+        signature (dictionary values included); anything uncacheable —
+        runtime dictionaries, non-structural reprs — builds a private
+        jit exactly as before."""
+        from trino_tpu.compile.cache import (
+            PROGRAM_CACHE,
+            expr_fingerprint,
+            schema_cache_key,
+        )
+
+        fp = expr_fingerprint(fingerprint) if fingerprint is not None else None
+        skey = schema_cache_key(schema)
+        if fp is None or skey is None:
+            return make_filter_project_fn(flt, bounds, name="FilterProjectOperator")
+        return PROGRAM_CACHE.get_or_create(
+            ("fp", fp, skey),
+            lambda: make_filter_project_fn(
+                flt, bounds, name="FilterProjectOperator"
+            ),
+        )
+
+    def _append_fp(self, chain: List[Factory], fn,
+                   in_schema: Optional[Schema],
+                   out_schema: Optional[Schema]) -> None:
         """Append a filter/project stage, folding it into a directly
-        preceding one so adjacent stages share a device program."""
+        preceding one so adjacent stages share a device program. Also
+        records the stage's warmup entry: the (possibly composed) jit,
+        the schema feeding it, and the capacity classes predicted for
+        the chain's source."""
+        from trino_tpu.compile.cache import PROGRAM_CACHE
         from trino_tpu.exec.operators import compose_batch_fns
 
         prev = chain[-1] if chain else None
         pf = getattr(prev, "fused_fn", None)
+        caps = getattr(prev, "out_caps", None)
         if pf is not None:
             chain.pop()
-            fn = compose_batch_fns(pf, fn)
+            prev_entry = getattr(prev, "warmup_entry", None)
+            if prev_entry is not None:
+                # the folded stage dispatches as one program; its parts
+                # must not be warmed separately
+                self._warmup_entries.remove(prev_entry)
+                in_schema = prev_entry.in_schema
+            inner = fn
+            k1, k2 = PROGRAM_CACHE.key_of(pf), PROGRAM_CACHE.key_of(inner)
+            if k1 is not None and k2 is not None:
+                fn = PROGRAM_CACHE.get_or_create(
+                    ("compose", k1, k2),
+                    lambda: compose_batch_fns(
+                        pf, inner, name="FilterProjectOperator"
+                    ),
+                )
+            else:
+                fn = compose_batch_fns(pf, inner, name="FilterProjectOperator")
 
         def factory(ctx, fn=fn):
             return FilterProjectOperator(None, (), fn=fn)
 
         factory.fused_fn = fn
+        # filter/project preserves capacity, so the source classes flow
+        # through for any further folding above this stage
+        factory.out_caps = caps
+        if caps and in_schema is not None and out_schema is not None:
+            from trino_tpu.compile.warmup import WarmupEntry
+
+            entry = WarmupEntry(
+                operator="FilterProjectOperator",
+                fn=fn,
+                in_schema=list(in_schema),
+                out_dtypes=tuple(str(t) for t, _ in out_schema),
+                capacities=tuple(caps),
+            )
+            factory.warmup_entry = entry
+            self._warmup_entries.append(entry)
         chain.append(factory)
 
     @staticmethod
@@ -215,17 +322,21 @@ class LocalPlanner:
         schema: Schema = schemas[0]
         fragment_ids = tuple(node.fragment_ids)
         merge_keys = list(node.merge_keys) if node.merge_keys else None
+        ladder = self.stabilizer.ladder if self.stabilizer is not None else None
         return [
             lambda ctx: RemoteSourceOperator(
-                ctx["make_remote_source"](fragment_ids), merge_keys
+                ctx["make_remote_source"](fragment_ids), merge_keys,
+                ladder=ladder,
             )
         ], schema
 
     def _visit_FilterNode(self, node: P.FilterNode):
         chain, schema = self._visit(node.child)
         flt = self._bind(node.predicate, schema)
-        fn = make_filter_project_fn(flt, self._identity(schema))
-        self._append_fp(chain, fn)
+        fn = self._cached_fp(
+            flt, self._identity(schema), schema, ("flt", repr(node.predicate))
+        )
+        self._append_fp(chain, fn, schema, schema)
         return chain, schema
 
     def _visit_ProjectNode(self, node: P.ProjectNode):
@@ -238,9 +349,15 @@ class LocalPlanner:
         else:
             chain, schema = self._visit(child)
         bounds = [self._bind(e, schema) for e in node.exprs]
-        fn = make_filter_project_fn(flt, bounds)
-        self._append_fp(chain, fn)
-        return chain, [(b.type, b.dictionary) for b in bounds]
+        fingerprint = (
+            "proj",
+            repr(child.predicate) if flt is not None else None,
+            tuple(repr(e) for e in node.exprs),
+        )
+        fn = self._cached_fp(flt, bounds, schema, fingerprint)
+        out_schema: Schema = [(b.type, b.dictionary) for b in bounds]
+        self._append_fp(chain, fn, schema, out_schema)
+        return chain, out_schema
 
     def _visit_AggregateNode(self, node: P.AggregateNode):
         chain, schema = self._visit(node.child)

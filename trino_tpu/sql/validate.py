@@ -736,11 +736,24 @@ def _sig(fields: Sequence[P.Field]) -> Tuple[str, ...]:
     return tuple(str(f.type) for f in fields)
 
 
-def _cap(rows: float, batch_rows: int) -> int:
+def _cap(rows: float, batch_rows: int, ladder=None) -> int:
     from trino_tpu.block import bucket_capacity
 
     n = int(min(max(rows, 1.0), float(batch_rows)))
+    if ladder is not None:
+        # snap through the session's capacity ladder so the census
+        # predicts the same classes a stabilized scan will produce
+        return ladder.rung(n)
     return bucket_capacity(n)
+
+
+def _tail_rows(rows: float, batch_rows: int) -> float:
+    """Rows in the final (smaller) chunk of a table larger than
+    batch_rows — 0 when the table fits one chunk or divides evenly."""
+    r = int(rows)
+    if r > batch_rows and r % batch_rows:
+        return float(r % batch_rows)
+    return 0.0
 
 
 _FUSE_CONSUMERS = (P.AggregateNode, P.SortNode, P.TopNNode)
@@ -752,6 +765,7 @@ def shape_census(
     batch_rows: int = 1 << 20,
     dynamic_filtering: bool = True,
     stats=None,
+    ladder=None,
 ) -> List[Lowering]:
     """Enumerate the distinct lowerings this (fragment) plan will
     request, mirroring LocalPlanner's operator selection and fusion:
@@ -759,7 +773,11 @@ def shape_census(
     program, and one feeding directly into an Aggregate/Sort/TopN runs
     inside the consumer's kernel (pre_fn) and compiles no program of its
     own. Capacities come from the stats framework, so the census is as
-    exact as the connector's row counts."""
+    exact as the connector's row counts. Tables larger than batch_rows
+    scan in batch_rows chunks plus one smaller tail chunk, so scans
+    (and filter/project chains directly over them) contribute a tail
+    capacity class too. `ladder` (compile.shapes.CapacityLadder) snaps
+    predicted capacities onto the session's stabilization ladder."""
     if stats is None:
         from trino_tpu.sql.stats import StatsCalculator
 
@@ -774,7 +792,9 @@ def shape_census(
 
     def add(op: str, rc: float, fields, retry_variant: bool = False):
         classes.append(
-            Lowering(op, _cap(rc, batch_rows), _sig(fields), retry_variant)
+            Lowering(
+                op, _cap(rc, batch_rows, ladder), _sig(fields), retry_variant
+            )
         )
 
     def visit(node: P.PlanNode, fused_into_consumer: bool = False) -> None:
@@ -790,11 +810,20 @@ def shape_census(
                 # filters keep capacity (live-mask discipline): the
                 # chain's class is the INPUT capacity at the chain's
                 # output signature
-                add("FilterProjectOperator", rows(bottom.child), node.fields)
+                src = rows(bottom.child)
+                add("FilterProjectOperator", src, node.fields)
+                if isinstance(bottom.child, P.ScanNode):
+                    tail = _tail_rows(src, batch_rows)
+                    if tail:
+                        add("FilterProjectOperator", tail, node.fields)
             visit(bottom.child)
             return
         if isinstance(node, P.ScanNode):
-            add("TableScanOperator", rows(node), node.fields)
+            rc = rows(node)
+            add("TableScanOperator", rc, node.fields)
+            tail = _tail_rows(rc, batch_rows)
+            if tail:
+                add("TableScanOperator", tail, node.fields)
             return
         if isinstance(node, P.ValuesNode):
             add("ValuesOperator", float(len(node.rows)), node.fields)
@@ -826,7 +855,15 @@ def shape_census(
                     # pruned class is a fresh lowering no warm run covers
                     add("DynamicFilterOperator", probe_rows,
                         node.left.fields, retry_variant=True)
+                # an equi-join's output rides at the bucketed MATCH
+                # capacity, which is data-dependent: selective keys land
+                # near the output-row estimate, FK-ish multiplicity
+                # lands near the probe's own class. Report both ends of
+                # that band (they coincide and dedup when the estimator
+                # is confident) so the census bounds join churn from
+                # above instead of trusting a collapsed estimate.
                 add("LookupJoinOperator", rows(node), node.fields)
+                add("LookupJoinOperator", probe_rows, node.fields)
             visit(node.left)
             visit(node.right)
             return
