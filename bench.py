@@ -51,6 +51,13 @@ Flags:
                      warmup-on run observes more distinct XLA shape
                      classes than the census predicted; no device
                      needed (runs before preflight)
+  --trace-smoke      run a traced distributed TPC-H query plus one
+                     chaos scenario (runtime/tracing.py), validate the
+                     exported span tree and Chrome trace-event schema,
+                     and measure tracing overhead on the Q1/Q6 pair;
+                     exits non-zero on an invariant violation or >5%
+                     wall overhead; no device needed (runs before
+                     preflight)
 """
 
 from __future__ import annotations
@@ -84,6 +91,16 @@ from lineitem
 where l_shipdate <= date '1998-12-01' - interval '90' day
 group by l_returnflag, l_linestatus
 order by l_returnflag, l_linestatus
+"""
+
+# TPC-H Q6: the trace-smoke overhead pair partner to Q1 — a scan-heavy
+# single-fragment aggregate where per-operator instrumentation cost has
+# nowhere to hide behind join/shuffle work
+Q6 = """
+select sum(l_extendedprice * l_discount) as revenue from lineitem
+where l_shipdate >= date '1994-01-01'
+  and l_shipdate < date '1995-01-01'
+  and l_discount between 0.05 and 0.07 and l_quantity < 24
 """
 
 Q3 = """
@@ -893,6 +910,131 @@ def _warmup_smoke(argv) -> int:
     return 1 if violations else 0
 
 
+def _trace_smoke(argv) -> int:
+    """--trace-smoke: observability gate (runtime/tracing.py). Runs a
+    traced distributed TPC-H query plus one chaos scenario, validates
+    the exported span tree (invariants + Chrome trace-event schema),
+    and measures tracing overhead traced-on vs traced-off on the Q1/Q6
+    CPU pair. Exit 1 iff the trace fails to parse, an invariant is
+    violated, a chaos annotation is missing, or overhead exceeds 5%
+    wall on either query."""
+    from trino_tpu.connectors.spi import CatalogManager
+    from trino_tpu.connectors.tpch import create_tpch_connector
+    from trino_tpu.engine import Session
+    from trino_tpu.runtime import DistributedQueryRunner, Worker
+    from trino_tpu.runtime.failure import FailureInjector
+    from trino_tpu.runtime.tracing import check_span_invariants
+
+    def cluster(tag, **session_kw):
+        inj = FailureInjector()
+        cats = CatalogManager()
+        cats.register("tpch", create_tpch_connector())
+        workers = [
+            Worker(f"{tag}-w{i}", cats, failure_injector=inj)
+            for i in range(2)
+        ]
+        r = DistributedQueryRunner(
+            Session(catalog="tpch", schema="tiny", **session_kw),
+            worker_handles=workers, hash_partitions=2,
+        )
+        r.register_catalog("tpch", create_tpch_connector())
+        return inj, r
+
+    violations = []
+    print("bench: trace smoke (distributed TPC-H, tpch tiny, CPU ok)")
+
+    # 1. traced run: the exported tree is complete, valid, and renders
+    # as loadable Chrome trace-event JSON
+    _, traced = cluster("ts", query_trace="on")
+    if not traced.execute(CHAOS_QUERIES["agg"]).rows:
+        violations.append("traced query returned no rows")
+    export = traced.query_trace_export(traced.last_query_id)
+    if export is None:
+        violations.append("traced query exported no trace")
+        export = {"spans": []}
+    violations += check_span_invariants(export)
+    kinds = {s["kind"] for s in export["spans"]}
+    missing = {"query", "phase", "stage", "task", "operator"} - kinds
+    if missing:
+        violations.append(f"trace missing span kinds: {sorted(missing)}")
+    chrome = traced.query_chrome_trace(traced.last_query_id) or {}
+    events = json.loads(json.dumps(chrome)).get("traceEvents", [])
+    if not any(e.get("ph") == "X" for e in events):
+        violations.append("chrome trace has no complete ('X') events")
+
+    # 2. chaos scenario: a crash-injected FTE run still exports one
+    # valid timeline, annotated where the fault and the retry landed
+    inj, fte = cluster("tc", query_trace="on", retry_policy="task")
+    inj.inject(where="start", kind="crash", fragment_id=0, partition=0,
+               attempts=(0,), max_hits=1)
+    try:
+        if not fte.execute(CHAOS_QUERIES["join"]).rows:
+            violations.append("chaos-injected query returned no rows")
+    finally:
+        inj.clear()
+    chaos_export = fte.query_trace_export(fte.last_query_id)
+    if chaos_export is None:
+        violations.append("chaos-injected query exported no trace")
+        chaos_export = {"spans": []}
+    violations += check_span_invariants(chaos_export)
+    task_events = [
+        e["name"] for s in chaos_export["spans"] if s["kind"] == "task"
+        for e in s["events"]
+    ]
+    stage_events = [
+        e["name"] for s in chaos_export["spans"] if s["kind"] == "stage"
+        for e in s["events"]
+    ]
+    if "chaos_fault" not in task_events:
+        violations.append("chaos_fault annotation missing from task spans")
+    if "task_retry" not in stage_events:
+        violations.append("task_retry annotation missing from stage spans")
+
+    # 3. overhead: best-of-N warm walls, traced-on vs traced-off, on
+    # the Q1/Q6 pair (aggregation-heavy and scan-heavy) — the traced
+    # arm pays operator spans + row counting, the baseline arm runs
+    # with instrumentation gated off
+    _, r_off = cluster("to")
+    _, r_on = cluster("tn", query_trace="on")
+    reps = 7
+    overhead = {}
+    for name, sql in (("q1", Q1), ("q6", Q6)):
+        for r in (r_off, r_on):
+            r.execute(sql)  # warm compiles before timing
+        # interleave the arms so machine drift (page cache, turbo,
+        # background load) lands on both equally; best-of-N per arm
+        walls = {"off": float("inf"), "on": float("inf")}
+        for _ in range(reps):
+            for arm, r in (("off", r_off), ("on", r_on)):
+                t0 = time.time()
+                r.execute(sql)
+                walls[arm] = min(walls[arm], time.time() - t0)
+        pct = (walls["on"] - walls["off"]) / walls["off"] * 100.0
+        overhead[name] = {
+            "wall_off_s": round(walls["off"], 4),
+            "wall_on_s": round(walls["on"], 4),
+            "overhead_pct": round(pct, 2),
+        }
+        if pct > 5.0:
+            violations.append(
+                f"tracing overhead on {name}: {pct:.1f}% > 5% "
+                f"(off={walls['off'] * 1000:.1f}ms "
+                f"on={walls['on'] * 1000:.1f}ms)"
+            )
+
+    for v in violations:
+        print(f"bench: trace VIOLATION: {v}", file=sys.stderr)
+    print(json.dumps({
+        "trace_smoke": {
+            "spans": len(export["spans"]),
+            "chaos_spans": len(chaos_export["spans"]),
+            "overhead": overhead,
+            "violations": len(violations),
+        }
+    }))
+    return 1 if violations else 0
+
+
 def _validate_corpus(argv) -> int:
     """--validate-corpus: CI gate for the plan sanity checkers
     (sql/validate.py). Plans — without executing — every TPC-H and
@@ -993,6 +1135,8 @@ def main() -> None:
         sys.exit(_chaos_smoke(sys.argv))
     if "--warmup-smoke" in sys.argv:
         sys.exit(_warmup_smoke(sys.argv))
+    if "--trace-smoke" in sys.argv:
+        sys.exit(_trace_smoke(sys.argv))
     if "--validate-corpus" in sys.argv:
         sys.exit(_validate_corpus(sys.argv))
     if os.environ.get("BENCH_INNER") == "1":

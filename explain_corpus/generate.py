@@ -355,6 +355,50 @@ def corpus_06_compile_regime():
     )
 
 
+def corpus_07_distributed_analyze():
+    """Distributed EXPLAIN ANALYZE through the TaskInfo aggregation
+    path (runtime/queryinfo.py): merged per-stage operator lines,
+    expected-vs-observed lowering counts, and per-task-attempt summary
+    lines. Wall/cpu timings and the process-global query counter are
+    nondeterministic, so they are redacted to `#` — the corpus pins the
+    structure (fragments, operators, row/batch counts, lowerings), not
+    the clock."""
+    import re
+
+    from trino_tpu.runtime import DistributedQueryRunner, Worker
+
+    cats = CatalogManager()
+    cats.register("tpch", create_tpch_connector())
+    workers = [Worker(f"corpus-w{i}", cats) for i in range(2)]
+    r = DistributedQueryRunner(
+        Session(catalog="tpch", schema="tiny"),
+        worker_handles=workers,
+        hash_partitions=2,
+    )
+    r.register_catalog("tpch", create_tpch_connector())
+    sql = (
+        "select n_regionkey, count(*) from nation group by n_regionkey"
+    )
+    out = r.execute("EXPLAIN ANALYZE " + sql).rows[0][0]
+
+    def redact(text):
+        text = re.sub(r"\b(wall|cpu)=\d+(\.\d+)?ms", r"\1=#ms", text)
+        text = re.sub(r"\b(add|get|finish)=\d+(\.\d+)?", r"\1=#", text)
+        text = re.sub(r"\btask q\d+\.", "task q#.", text)
+        return text
+
+    emit(
+        "07_distributed_analyze.txt",
+        (f"QUERY\n{sql}", ""),
+        ("distributed EXPLAIN ANALYZE (runtime/queryinfo.py rollup: "
+         "Driver -> Task ->\nStage; merged operator lines per fragment "
+         "through the shared OperatorStats\nformatter, "
+         "expected-vs-observed XLA lowerings from the census ledger,\n"
+         "one summary line per task attempt; wall-clock values "
+         "redacted to `#`)", redact(out)),
+    )
+
+
 def write_all(out_dir=None):
     """Regenerate every corpus file (into `out_dir` when given — used
     by tests/test_explain_corpus.py to diff against committed files)."""
@@ -367,6 +411,7 @@ def write_all(out_dir=None):
         corpus_04_elided_exchange()
         corpus_05_plan_validation()
         corpus_06_compile_regime()
+        corpus_07_distributed_analyze()
     finally:
         _OUT_DIR[0] = HERE
 

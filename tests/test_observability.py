@@ -7,7 +7,6 @@ import pytest
 from trino_tpu.connectors.tpch import create_tpch_connector
 from trino_tpu.engine import LocalQueryRunner, Session
 from trino_tpu.runtime.events import EventListener
-from trino_tpu.utils.tracing import Tracer
 
 
 @pytest.fixture(scope="module")
@@ -68,17 +67,26 @@ def test_event_listener_failure_state(runner):
 
 
 def test_tracer_span_tree():
-    t = Tracer()
-    with t.span("query", query_id="q1"):
-        with t.span("analyze"):
+    from trino_tpu.runtime.tracing import (
+        KIND_PHASE,
+        KIND_QUERY,
+        QueryTrace,
+        check_span_invariants,
+    )
+
+    t = QueryTrace("q1")
+    with t.span("query q1", KIND_QUERY, query_id="q1") as q:
+        with q.child("analyze", KIND_PHASE):
             pass
-        with t.span("execute"):
+        with q.child("execute", KIND_PHASE):
             pass
-    roots = t.export()
-    assert len(roots) == 1
-    assert roots[0]["name"] == "query"
-    assert [c["name"] for c in roots[0]["children"]] == ["analyze", "execute"]
-    assert roots[0]["attributes"]["query_id"] == "q1"
+    export = t.export()
+    assert check_span_invariants(export) == []
+    spans = export["spans"]
+    assert spans[0]["name"] == "query q1"
+    assert spans[0]["attributes"]["query_id"] == "q1"
+    assert [s["name"] for s in spans[1:]] == ["analyze", "execute"]
+    assert all(s["parent_id"] == spans[0]["span_id"] for s in spans[1:])
 
 
 def test_dynamic_filter_prunes_probe(runner):

@@ -1,0 +1,473 @@
+"""End-to-end query tracing, stats aggregation, and the observability
+plane (PR 7): span-tree invariants across success/failure/kill/retry/
+chaos runs, Chrome trace-event export schema, distribution metrics,
+per-query compile-counter retention, enriched completion events, and
+the aggregated QueryInfo REST surface.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from trino_tpu.connectors.spi import CatalogManager
+from trino_tpu.connectors.tpch import create_tpch_connector
+from trino_tpu.engine import LocalQueryRunner, Session
+from trino_tpu.runtime import DistributedQueryRunner, Worker
+from trino_tpu.runtime.chaos import ChaosHarness, rows_equal
+from trino_tpu.runtime.failure import FailureInjector
+from trino_tpu.runtime.metrics import (
+    METRICS,
+    Distribution,
+    retire_query_compiles,
+)
+from trino_tpu.runtime.query_tracker import (
+    EXCEEDED_TIME_LIMIT,
+    ExceededTimeLimitError,
+)
+from trino_tpu.runtime.tracing import (
+    KIND_OPERATOR,
+    KIND_PHASE,
+    KIND_QUERY,
+    KIND_STAGE,
+    KIND_TASK,
+    QueryTrace,
+    check_span_invariants,
+    chrome_trace,
+    wire_context,
+)
+
+SEED = 42
+
+Q_AGG = (
+    "select l_returnflag, l_linestatus, sum(l_quantity), count(*) "
+    "from lineitem where l_shipdate <= date '1998-09-02' "
+    "group by l_returnflag, l_linestatus "
+    "order by l_returnflag, l_linestatus"
+)
+Q_JOIN = (
+    "select n_name, count(*) c from supplier, nation "
+    "where s_nationkey = n_nationkey "
+    "group by n_name order by n_name"
+)
+
+
+def _cluster(n_workers=2, **session_kw):
+    inj = FailureInjector()
+    cats = CatalogManager()
+    cats.register("tpch", create_tpch_connector())
+    workers = [
+        Worker(f"tr-w{i}", cats, failure_injector=inj)
+        for i in range(n_workers)
+    ]
+    runner = DistributedQueryRunner(
+        Session(catalog="tpch", schema="tiny", **session_kw),
+        worker_handles=workers, hash_partitions=2,
+    )
+    runner.register_catalog("tpch", create_tpch_connector())
+    return inj, runner
+
+
+# -- tracer unit tests ------------------------------------------------------
+
+
+def test_wire_context_and_remote_graft():
+    """The coordinator hands a task span's context across the wire; the
+    worker records operator spans against it; graft closes the tree and
+    dedups repeat deliveries (a task polled twice)."""
+    trace = QueryTrace("q1")
+    root = trace.span("query q1", KIND_QUERY)
+    stage = root.child("stage 0", KIND_STAGE)
+    task = stage.child("task q1.0.0.0", KIND_TASK)
+    ctx = wire_context(task)
+    assert set(ctx) == {"trace_id", "span_id"}
+
+    remote = QueryTrace.remote(ctx)
+    op = remote.span("ScanOperator", KIND_OPERATOR, parent=ctx["span_id"])
+    op.set(input_rows=25)
+    op.end()
+    shipped = remote.export()["spans"]
+    assert trace.graft(shipped) == 1
+    assert trace.graft(shipped) == 0  # dedup by span_id
+    task.end()
+    stage.end()
+    root.end()
+    export = trace.export()
+    assert check_span_invariants(export) == []
+    grafted = [s for s in export["spans"] if s["kind"] == "operator"]
+    assert grafted[0]["parent_id"] == task.span_id
+    assert grafted[0]["trace_id"] == trace.trace_id  # rewritten on graft
+
+
+def test_end_open_spans_sweeps_abnormal_completion():
+    trace = QueryTrace("q2")
+    root = trace.span("query q2", KIND_QUERY)
+    root.child("stage 0", KIND_STAGE)  # never ended
+    assert "unclosed" in " ".join(check_span_invariants(trace.export()))
+    assert trace.end_open_spans() == 2
+    assert check_span_invariants(trace.export()) == []
+
+
+def test_span_context_manager_annotates_exceptions():
+    trace = QueryTrace("q3")
+    root = trace.span("query q3", KIND_QUERY)
+    with pytest.raises(ValueError):
+        with root.child("analyze", KIND_PHASE) as s:
+            raise ValueError("boom")
+    assert s.ended
+    assert s.attributes.get("error") is True
+    assert s.events[0]["name"] == "exception"
+
+
+def test_chrome_trace_schema():
+    """Golden structural schema for the Perfetto export: thread-name
+    metadata first, one complete ("X") event per span with microsecond
+    ts/dur, instant ("i") events for annotations, and track assignment
+    that gives stages and task attempts their own rows."""
+    trace = QueryTrace("q4")
+    root = trace.span("query q4", KIND_QUERY)
+    ph = root.child("analyze", KIND_PHASE)
+    ph.end()
+    stage = root.child("stage 0", KIND_STAGE)
+    task = stage.child("task t0", KIND_TASK)
+    task.event("task_retry", attempt=1)
+    op = task.child("ScanOperator", KIND_OPERATOR)
+    op.end()
+    task.end()
+    stage.end()
+    root.end()
+
+    events = chrome_trace(trace.export())
+    json.dumps(events)  # must be JSON-serializable as-is
+    meta = [e for e in events if e["ph"] == "M"]
+    complete = [e for e in events if e["ph"] == "X"]
+    instants = [e for e in events if e["ph"] == "i"]
+    assert {e["ph"] for e in events} == {"M", "X", "i"}
+    assert all(e["name"] == "thread_name" for e in meta)
+    assert len(complete) == 5  # one per span
+    for e in complete:
+        assert set(e) == {"name", "cat", "ph", "ts", "dur", "pid",
+                          "tid", "args"}
+        assert e["ts"] >= 0 and e["dur"] >= 0
+        assert "span_id" in e["args"]
+    assert instants and instants[0]["name"] == "task_retry"
+    assert instants[0]["s"] == "t"
+    by_name = {e["name"]: e["tid"] for e in complete}
+    assert by_name["query q4"] == 0  # coordinator track
+    assert by_name["analyze"] == 0  # phases ride the coordinator track
+    assert by_name["stage 0"] not in (0, by_name["task t0"])
+    assert by_name["ScanOperator"] == by_name["task t0"]  # ops inherit
+
+
+# -- distribution metrics ---------------------------------------------------
+
+
+def test_distribution_percentiles_and_summary():
+    d = Distribution()
+    for ms in range(1, 101):
+        d.add(ms / 1000.0)
+    s = d.summary()
+    assert s["count"] == 100
+    assert s["min"] == pytest.approx(0.001)
+    assert s["max"] == pytest.approx(0.100)
+    assert 0 < s["p50"] <= s["p95"] <= s["p99"] <= s["max"]
+    # bucket edges are powers of two: one-bucket (~2x) error bound
+    assert s["p50"] == pytest.approx(0.05, rel=1.5)
+
+
+def test_distribution_empty_is_zero():
+    d = Distribution()
+    assert d.percentile(0.99) == 0.0
+    assert d.summary()["count"] == 0
+
+
+def test_metrics_snapshot_flattens_distributions():
+    name = "test_tracing_dist_s"
+    try:
+        METRICS.observe(name, 0.25)
+        snap = METRICS.snapshot()
+        for stat in ("count", "avg", "p50", "p95", "p99"):
+            assert f"{name}.{stat}" in snap
+    finally:
+        METRICS.remove_prefix(name)
+
+
+# -- per-query compile-counter retention ------------------------------------
+
+
+def test_compile_counter_registry_stays_bounded():
+    """1000 queries' worth of per-query compile counters retire into
+    QueryInfo at completion; the registry must not grow with query
+    count (the leak this PR fixes)."""
+    base = len(METRICS.counter_names())
+    for i in range(1000):
+        qid = f"boundq{i}"
+        METRICS.increment(f"xla_compiles_by_query.{qid}", 2)
+        METRICS.increment(f"xla_compiles_by_query.{qid}r1")  # query retry
+        assert retire_query_compiles(qid) == 3
+    assert len(METRICS.counter_names()) == base
+    assert not [
+        n for n in METRICS.counter_names()
+        if n.startswith("xla_compiles_by_query.boundq")
+    ]
+
+
+def test_compile_counter_retirement_is_prefix_safe():
+    """Retiring q3 must not swallow q30 (exact id + `r` retry suffix
+    only, never a bare prefix match)."""
+    METRICS.increment("xla_compiles_by_query.prefq3", 1)
+    METRICS.increment("xla_compiles_by_query.prefq3r1", 1)
+    METRICS.increment("xla_compiles_by_query.prefq30", 5)
+    try:
+        assert retire_query_compiles("prefq3") == 2
+        assert METRICS.counter("xla_compiles_by_query.prefq30") == 5
+    finally:
+        METRICS.remove_prefix("xla_compiles_by_query.prefq3")
+
+
+# -- enriched completion events ---------------------------------------------
+
+
+def test_jsonl_event_listener_writes_one_line_per_query(tmp_path):
+    from trino_tpu.runtime.events import JsonlEventListener
+
+    path = tmp_path / "queries.jsonl"
+    r = LocalQueryRunner(Session(catalog="tpch", schema="tiny"))
+    r.register_catalog("tpch", create_tpch_connector())
+    r.event_listeners.add(JsonlEventListener(str(path)))
+    r.execute("select count(*) from region")
+    r.execute("select count(*) from nation")
+    lines = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert len(lines) == 2
+    first = lines[0]
+    assert first["event"] == "query_completed"
+    assert first["state"] == "finished"
+    assert first["rows"] == 1
+    for key in ("peak_memory_bytes", "rows_scanned", "bytes_scanned",
+                "rows_shuffled", "compile_count", "retry_count",
+                "attempt_count", "error_code", "emit_time"):
+        assert key in first, key
+
+
+def test_dispatch_failures_surfaces_as_gauge():
+    r = LocalQueryRunner(Session(catalog="tpch", schema="tiny"))
+    assert "event_listener_dispatch_failures" in METRICS.snapshot()
+    r.register_catalog("tpch", create_tpch_connector())
+
+    class Broken:
+        def query_created(self, e):
+            raise RuntimeError("boom")
+
+        def query_completed(self, e):
+            pass
+
+    r.event_listeners.add(Broken())
+    r.execute("select 1")
+    assert METRICS.snapshot()["event_listener_dispatch_failures"] >= 1
+
+
+# -- distributed tracing end to end -----------------------------------------
+
+
+@pytest.fixture(scope="module")
+def traced():
+    """One traced cluster shared by the happy-path assertions."""
+    inj, runner = _cluster(query_trace="on")
+    runner.execute(Q_AGG)
+    return inj, runner, runner.last_query_id
+
+
+def test_traced_query_exports_complete_span_tree(traced):
+    _, runner, qid = traced
+    export = runner.query_trace_export(qid)
+    assert export is not None and export["query_id"] == qid
+    assert check_span_invariants(export) == []
+    kinds = {s["kind"] for s in export["spans"]}
+    assert kinds == {"query", "phase", "stage", "task", "operator"}
+    phases = {s["name"] for s in export["spans"] if s["kind"] == "phase"}
+    assert {"parse", "analyze", "optimize", "fragment",
+            "schedule"} <= phases
+    # one task span per scheduled task, each under a stage span
+    by_id = {s["span_id"]: s for s in export["spans"]}
+    for s in export["spans"]:
+        if s["kind"] == "task":
+            assert by_id[s["parent_id"]]["kind"] == "stage"
+        if s["kind"] == "operator":
+            assert by_id[s["parent_id"]]["kind"] == "task"
+    # operator spans carry their final stats as attributes
+    ops = [s for s in export["spans"] if s["kind"] == "operator"]
+    assert any(s["attributes"].get("input_rows", 0) > 0 for s in ops)
+
+
+def test_traced_query_chrome_export_loads(traced):
+    _, runner, qid = traced
+    doc = runner.query_chrome_trace(qid)
+    assert doc is not None
+    events = doc["traceEvents"]
+    json.dumps(doc)
+    assert {"M", "X"} <= {e["ph"] for e in events}
+    names = {e["args"]["name"] for e in events if e["ph"] == "M"}
+    assert "coordinator" in names
+    assert any(n.startswith("stage") for n in names)
+    assert any(n.startswith("task") for n in names)
+
+
+def test_query_info_aggregates_stage_and_operator_stats(traced):
+    _, runner, qid = traced
+    info = runner.query_info(qid)
+    assert info["query_id"] == qid and info["state"] == "finished"
+    assert info["wall_s"] > 0
+    assert info["stages"], "no per-stage rollup"
+    summaries = [
+        op for st in info["stages"] for group in st["operator_summaries"]
+        for op in group
+    ]
+    assert any(op["input_rows"] > 0 for op in summaries)
+    leaf = info["stages"][-1]
+    assert leaf["tasks"] >= 1 and len(leaf["task_infos"]) == leaf["tasks"]
+    assert all(t["wall_s"] is not None for t in leaf["task_infos"])
+    # census-vs-ledger lowering comparison rode the TaskInfo surface
+    assert "expected_lowerings" in leaf and "observed_lowerings" in leaf
+
+
+def test_wall_time_distributions_recorded(traced):
+    snap = METRICS.snapshot()
+    for name in ("query_wall_s", "stage_wall_s"):
+        for stat in ("p50", "p95", "p99"):
+            assert f"{name}.{stat}" in snap, f"{name}.{stat}"
+    assert snap["query_wall_s.count"] >= 1
+
+
+def test_query_endpoints_over_http(traced):
+    from trino_tpu.runtime.server import CoordinatorServer
+
+    _, runner, qid = traced
+    srv = CoordinatorServer(runner, port=0)
+    try:
+        def get(path):
+            return json.load(urllib.request.urlopen(
+                srv.uri + path, timeout=10
+            ))
+
+        info = get(f"/v1/query/{qid}")
+        assert info["query_id"] == qid and info["stages"]
+        doc = get(f"/v1/query/{qid}/trace")
+        assert any(e["ph"] == "X" for e in doc["traceEvents"])
+        snap = get("/v1/metrics")
+        assert "query_wall_s.p50" in snap
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                srv.uri + "/v1/query/no-such-query", timeout=10
+            )
+        assert ei.value.code == 404
+    finally:
+        srv.stop()
+
+
+def test_untraced_query_records_no_trace():
+    """query_trace defaults off: no per-query trace is retained, but
+    the QueryInfo rollup (coordinator-side stats) still lands."""
+    _, runner = _cluster()
+    runner.execute(Q_JOIN)
+    qid = runner.last_query_id
+    info = runner.query_info(qid)
+    assert info is not None and info["state"] == "finished"
+    export = runner.query_trace_export(qid)
+    # coordinator spans exist either way; operator spans must NOT
+    # (workers only record them when the wire context says so)
+    assert not [s for s in export["spans"] if s["kind"] == "operator"]
+
+
+def test_failed_query_still_closes_its_trace():
+    _, runner = _cluster(query_trace="on")
+    with pytest.raises(Exception):
+        runner.execute("select no_such_column from region")
+    qid = runner.last_query_id
+    export = runner.query_trace_export(qid)
+    assert check_span_invariants(export) == []
+    root = export["spans"][0]
+    assert root["attributes"]["state"] == "failed"
+    assert any(e["name"] == "exception" for e in root["events"])
+    assert runner.query_info(qid)["state"] == "failed"
+
+
+def test_deadline_killed_query_trace_reads_as_one_timeline():
+    inj, runner = _cluster(
+        query_trace="on", query_max_execution_time_s=0.2,
+    )
+    inj.inject(where="batch", attempts=(0, 1, 2, 3), stall_s=20.0,
+               max_hits=1)
+    try:
+        with pytest.raises(ExceededTimeLimitError):
+            runner.execute(Q_AGG)
+    finally:
+        inj.clear()
+    qid = runner.last_query_id
+    export = runner.query_trace_export(qid)
+    assert check_span_invariants(export) == []
+    info = runner.query_info(qid)
+    assert info["state"] == "failed"
+    assert info["error_code"] == EXCEEDED_TIME_LIMIT
+    # the enforcement sweep that fired the kill recorded its duration
+    assert "tracker_tick_s.p50" in METRICS.snapshot()
+
+
+def test_fte_retry_and_chaos_annotations_land_on_spans():
+    """A crash-injected FTE run must read as one timeline: the failed
+    attempt's task span carries a chaos_fault annotation, the stage
+    span a task_retry, and the replayed attempt closes the tree."""
+    inj, runner = _cluster(retry_policy="task", query_trace="on")
+    inj.inject(where="start", kind="crash", fragment_id=0, partition=0,
+               attempts=(0,), max_hits=1)
+    try:
+        rows = runner.execute(Q_JOIN).rows
+    finally:
+        inj.clear()
+    assert rows
+    qid = runner.last_query_id
+    export = runner.query_trace_export(qid)
+    assert check_span_invariants(export) == []
+    task_events = [
+        e["name"] for s in export["spans"] if s["kind"] == "task"
+        for e in s["events"]
+    ]
+    stage_events = [
+        e["name"] for s in export["spans"] if s["kind"] == "stage"
+        for e in s["events"]
+    ]
+    assert "chaos_fault" in task_events
+    assert "task_retry" in stage_events
+    # the retry shows up as a second task-attempt span
+    assert any(
+        s["attributes"].get("attempt", 0) >= 1
+        for s in export["spans"] if s["kind"] == "task"
+    )
+
+
+# -- operator-internal heartbeats / tightened watchdog ----------------------
+
+
+def test_watchdog_fires_fast_on_warm_hung_operator():
+    """Operator-internal heartbeats (every add_input/get_output entry
+    and exit) let the WARM stuck-task threshold drop to hundreds of
+    milliseconds — far below the old ~1s batch-granularity floor — and
+    a wedged task is interrupted well before the injected stall."""
+    h = ChaosHarness(
+        n_workers=3,
+        stuck_task_interrupt_s=2.0,
+        stuck_task_interrupt_warm_s=0.3,
+        memory_pool_bytes=256 << 20,
+    )
+    h.register_catalog("tpch", create_tpch_connector())
+    rows, report = h.run_hung_operator_case(Q_AGG, seed=SEED, stall_s=8.0)
+    assert rows_equal(rows, h.run_clean(Q_AGG), ordered=True)
+    assert report["watchdog_interrupts"], "watchdog never fired"
+    assert any(
+        "Stuck task" in d for d in report["watchdog_interrupts"]
+    )
+    overhead = report["elapsed_s"] - report["warm_clean_s"]
+    assert overhead < report["stall_s"] / 2, (
+        f"tightened warm threshold did not unwedge quickly "
+        f"(overhead {overhead:.2f}s vs stall {report['stall_s']}s)"
+    )

@@ -166,6 +166,12 @@ for _name, _type, _default, _desc, _allowed in [
      "predicted shape classes are all warm (warmup/cache hits or a "
      "prior completed run); 0 falls back to stuck_task_interrupt_s",
      None),
+    # -- observability (runtime/tracing.py) --
+    ("query_trace", str, "off",
+     "record a full span tree per query (phases, stages, task attempts, "
+     "operators; worker spans grafted into the coordinator's tree) "
+     "exportable as JSON/Chrome trace-event via GET /v1/query/{id}/trace",
+     ("off", "on")),
 ]:
     SYSTEM_PROPERTIES.register(_name, _type, _default, _desc, _allowed)
 

@@ -122,6 +122,10 @@ class Session:
     # classes are all warm (warmup/cache hits or a prior completed
     # run); 0 falls back to stuck_task_interrupt_s
     stuck_task_interrupt_warm_s: float = 0.0
+    # query tracing (runtime/tracing.py): "on" records the full span
+    # tree (phases/stages/task attempts/operators; worker spans grafted
+    # into the coordinator's) for GET /v1/query/{id}/trace
+    query_trace: str = "off"
 
     def set_property(self, name: str, value) -> None:
         """SET SESSION entry point — validated through the typed
@@ -186,7 +190,18 @@ class LocalQueryRunner:
         from trino_tpu.runtime.events import EventListenerManager
 
         self.event_listeners = EventListenerManager()
+        self.event_listeners.register_metrics()
+        # per-query compile attribution + the xla_compile_duration_s
+        # histogram need the jax.monitoring hook from process start,
+        # not just from the first EXPLAIN ANALYZE
+        from trino_tpu.runtime.metrics import install_xla_compile_listener
+
+        install_xla_compile_listener()
         self._query_seq = 0
+        # observability surfaces filled per query: the execution ctx's
+        # memory pool (peak watermark) and the last completed span tree
+        self._last_pool = None
+        self._last_trace: Optional[tuple] = None
         self.access_control = access_control or AllowAllAccessControl()
         self.transactions = TransactionManager(self.catalogs)
         self._current_txn: Optional[str] = None
@@ -1044,39 +1059,92 @@ class LocalQueryRunner:
         the actual execution (SqlQueryExecution's tracing shape)."""
         import time as _time
 
-        from trino_tpu.runtime.events import (
-            QueryCompletedEvent,
-            QueryCreatedEvent,
-        )
-        from trino_tpu.utils.tracing import TRACER
+        from trino_tpu.runtime.events import QueryCreatedEvent
+        from trino_tpu.runtime.metrics import METRICS
+        from trino_tpu.runtime.tracing import KIND_QUERY, QueryTrace
 
         self._query_seq += 1
         query_id = f"local-{self._query_seq}"
-        t0 = _time.monotonic()
+        trace = QueryTrace(query_id)
+        qspan = trace.span(f"query {query_id}", KIND_QUERY, sql=sql[:500])
+        counters_before = METRICS.snapshot()
         self.event_listeners.query_created(
             QueryCreatedEvent(query_id, sql, _time.time())
         )
+        status, failure, rows_n = "finished", None, 0
         try:
-            with TRACER.span("query", query_id=query_id):
-                result = self._execute_query(stmt, sql_key=sql)
+            result = self._execute_query(
+                stmt, sql_key=sql, query_id=query_id,
+                trace=trace, query_span=qspan,
+            )
+            rows_n = len(result.rows)
+            return result
         except BaseException as e:
+            status, failure = "failed", repr(e)
+            if not qspan.ended:
+                qspan.event("exception", error=repr(e)[:300])
+                qspan.set(error=True)
+            raise
+        finally:
+            self._finalize_query(
+                query_id, sql, trace, qspan, status, failure, rows_n,
+                counters_before,
+            )
+
+    def _finalize_query(self, query_id, sql, trace, qspan, status,
+                        failure, rows_n, counters_before):
+        """Close the span tree, retire per-query compile counters, and
+        fire the enriched completion event. Observability finalization
+        must never mask the query's own verdict, so it swallows."""
+        try:
+            from trino_tpu.exec.stats import engine_counters_delta
+            from trino_tpu.runtime.events import QueryCompletedEvent
+            from trino_tpu.runtime.metrics import (
+                METRICS,
+                retire_query_compiles,
+            )
+
+            qspan.set(state=status)
+            qspan.end()
+            trace.end_open_spans(qspan.end_s)
+            wall = qspan.duration_s
+            METRICS.observe("query_wall_s", wall)
+            compile_count = retire_query_compiles(query_id)
+            counters = engine_counters_delta(
+                counters_before, METRICS.snapshot()
+            )
+            peak = 0
+            if self._last_pool is not None:
+                peaks = self._last_pool.query_peaks()
+                peak = int(max(peaks.values(), default=0))
+            self._last_trace = (query_id, trace)
             self.event_listeners.query_completed(
                 QueryCompletedEvent(
-                    query_id, sql, "failed", _time.monotonic() - t0,
-                    failure=repr(e),
+                    query_id, sql, status, wall,
+                    rows=rows_n, failure=failure,
+                    peak_memory_bytes=peak,
+                    rows_scanned=int(counters.get("rows_scanned", 0)),
+                    bytes_scanned=int(counters.get("bytes_scanned", 0)),
+                    rows_shuffled=int(counters.get("rows_shuffled", 0)),
+                    compile_count=compile_count,
                 )
             )
-            raise
-        self.event_listeners.query_completed(
-            QueryCompletedEvent(
-                query_id, sql, "finished", _time.monotonic() - t0,
-                rows=len(result.rows),
-            )
-        )
-        return result
+        except Exception:
+            import logging
 
-    def _plan(self, q: ast.Query, sql_key: Optional[str]):
-        from trino_tpu.utils.tracing import TRACER
+            logging.getLogger(__name__).warning(
+                "query finalization failed for %s", query_id, exc_info=True
+            )
+
+    def _plan(self, q: ast.Query, sql_key: Optional[str], query_span=None):
+        import contextlib
+
+        def phase(name):
+            if query_span is None:
+                return contextlib.nullcontext()
+            from trino_tpu.runtime.tracing import KIND_PHASE
+
+            return query_span.child(name, KIND_PHASE)
 
         # cache key includes the plan-shaping session properties, so
         # set_property takes effect however it was invoked
@@ -1102,10 +1170,10 @@ class LocalQueryRunner:
         )
 
         reset_volatile_plan()
-        with TRACER.span("analyze"):
+        with phase("analyze"):
             output = self._analyze(q)
         self._check_scans(output)
-        with TRACER.span("plan"):
+        with phase("optimize"):
             planner = LocalPlanner(
                 self.catalogs,
                 batch_rows=self.session.batch_rows,
@@ -1161,19 +1229,59 @@ class LocalQueryRunner:
         self._query_seq += 1
         return f"local-{self._query_seq}"
 
-    def _execute_query(self, q: ast.Query, sql_key: Optional[str] = None) -> MaterializedResult:
-        from trino_tpu.runtime.metrics import set_compile_attribution
-        from trino_tpu.utils.tracing import TRACER
+    # -- observability surface (runtime/tracing.py) --
+    def query_trace_export(self, query_id: Optional[str] = None):
+        """Span tree of the most recent query (the local runner keeps
+        only the last trace); None when the id does not match."""
+        if self._last_trace is None:
+            return None
+        qid, trace = self._last_trace
+        if query_id is not None and query_id != qid:
+            return None
+        return trace.export()
 
-        output, physical = self._plan(q, sql_key)
+    def query_chrome_trace(self, query_id: Optional[str] = None):
+        from trino_tpu.runtime.tracing import chrome_trace
+
+        export = self.query_trace_export(query_id)
+        if export is None:
+            return None
+        return {"traceEvents": chrome_trace(export)}
+
+    def _execute_query(
+        self, q: ast.Query, sql_key: Optional[str] = None,
+        query_id: Optional[str] = None, trace=None, query_span=None,
+    ) -> MaterializedResult:
+        import contextlib
+
+        from trino_tpu.runtime.metrics import set_compile_attribution
+
+        output, physical = self._plan(q, sql_key, query_span=query_span)
         self._start_warmup(physical)
         ctx = self._execution_ctx()
+        self._last_pool = ctx.get("memory_pool")
         pipelines, chain = physical.instantiate(ctx)
         sink = CollectorSink()
         chain.append(sink)
-        prev_qid = set_compile_attribution(self._attribution_id())
+        # compile attribution reuses the tracked query id, so the
+        # per-query counter retired at finalization is the same one the
+        # listener installed compiles under. Internal subqueries
+        # (DELETE count rewrites, MERGE match checks) inherit the
+        # enclosing statement's attribution so their compiles are
+        # charged — and retired — with the user's query instead of
+        # leaking one never-retired counter per helper
+        from trino_tpu.runtime.metrics import compile_attribution
+
+        prev_qid = set_compile_attribution(
+            query_id or compile_attribution() or self._attribution_id()
+        )
+        exec_span = contextlib.nullcontext()
+        if query_span is not None:
+            from trino_tpu.runtime.tracing import KIND_PHASE
+
+            exec_span = query_span.child("execute", KIND_PHASE)
         try:
-            with TRACER.span("execute"):
+            with exec_span:
                 for p in pipelines:
                     Driver(p).run()
                 Driver(Pipeline(chain)).run()
@@ -1201,6 +1309,7 @@ class LocalQueryRunner:
         from trino_tpu.runtime.metrics import (
             METRICS,
             install_xla_compile_listener,
+            retire_query_compiles,
             set_compile_attribution,
         )
         from trino_tpu.sql.validate import census_text, shape_census
@@ -1242,6 +1351,11 @@ class LocalQueryRunner:
         finally:
             set_compile_attribution(prev_qid)
         _raise_deferred_checks(ctx)
+        for p in wrapped_pipelines:
+            for op in p.operators:
+                op.flush_counts()
+        for op in main_ops:
+            op.flush_counts()
         after = METRICS.snapshot()
         counters = engine_counters_delta(before, after)
         census = census_text(
@@ -1256,6 +1370,9 @@ class LocalQueryRunner:
         # xla_compiles engine counter), warmup hit/miss, cache stats
         qkey = f"xla_compiles_by_query.{qid}"
         compiled_here = int(after.get(qkey, 0.0) - before.get(qkey, 0.0))
+        # EXPLAIN ANALYZE is this attribution id's terminal operation —
+        # retire its counter so the registry stays bounded
+        retire_query_compiles(qid)
         census += f"\nxla_compiles_this_query={compiled_here}"
         if warmup_svc is not None:
             if warmup_svc.mode == "background":

@@ -19,6 +19,7 @@ aggregated Driver->Pipeline->Task->Query and rendered by EXPLAIN ANALYZE
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from typing import List, Optional, Set, Tuple
 
@@ -66,11 +67,25 @@ def _device_barrier() -> None:
 
 class InstrumentedOperator:
     """Transparent timing wrapper around one operator — the
-    OperationTimer discipline without touching operator code."""
+    OperationTimer discipline without touching operator code.
+
+    Two observability hooks piggyback on the recording path:
+
+    - `heartbeat` (zero-arg callable) fires at ENTRY and EXIT of every
+      add_input/get_output/finish — operator-internal liveness at
+      tens-of-ms granularity, vs. the Driver's batch-boundary beats
+      (~1 s under compile/datagen): the worker watchdog's tightened
+      stuck-task threshold keys off these.
+    - `span` (runtime/tracing.py Span) gets its start re-anchored at
+      the operator's first activity, its end stamped at finish, and the
+      final OperatorStats attached as attributes — one operator span
+      per task in the query trace.
+    """
 
     def __init__(self, inner, stats: OperatorStats, count_rows: bool,
                  device_sync: bool = False,
-                 shape_ledger: Optional[Set[Tuple]] = None):
+                 shape_ledger: Optional[Set[Tuple]] = None,
+                 heartbeat=None, span=None):
         self.inner = inner
         self.stats = stats
         self.stats.operator = type(inner).__name__
@@ -81,6 +96,58 @@ class InstrumentedOperator:
         # same vocabulary sql/validate.py's shape census predicts over,
         # so EXPLAIN ANALYZE can print expected vs observed side by side
         self._shape_ledger = shape_ledger
+        self._heartbeat = heartbeat
+        self._span = span
+        self._span_anchored = False
+        # deferred row counts: masked batches enqueue a device-side
+        # jnp.sum scalar instead of forcing a host sync per batch (a
+        # round trip on a real accelerator); flush_counts() resolves
+        # them at pipeline completion / terminal status
+        self._pending_counts: list = []
+        self._pending_lock = threading.Lock()
+
+    def _beat(self) -> None:
+        if self._heartbeat is not None:
+            self._heartbeat()
+        if self._span is not None and not self._span_anchored:
+            # span start = first activity, not wrap time: operators
+            # deep in a pipeline idle until data reaches them, and the
+            # trace should show WHEN each operator ran, not task setup
+            self._span_anchored = True
+            self._span.start_s = time.time()
+
+    def _count(self, attr: str, batch) -> None:
+        live = getattr(batch, "live", None)
+        if live is None:
+            setattr(self.stats, attr, getattr(self.stats, attr)
+                    + batch.capacity)
+            return
+        import jax.numpy as jnp
+
+        with self._pending_lock:
+            self._pending_counts.append((attr, jnp.sum(live)))
+
+    def flush_counts(self) -> None:
+        """Resolve deferred row counts into the stats (one host sync
+        for the whole backlog instead of one per batch)."""
+        with self._pending_lock:
+            pending, self._pending_counts = self._pending_counts, []
+        for attr, v in pending:
+            setattr(self.stats, attr, getattr(self.stats, attr) + int(v))
+
+    def close_span(self) -> None:
+        """Finalize stats (flush deferred row counts), then end the
+        operator span with them attached (called by the task when the
+        pipeline completes — finish() can run long before the last
+        get_output drains)."""
+        self.flush_counts()
+        if self._span is None:
+            return
+        self._span.set(**{
+            k: v for k, v in dataclasses.asdict(self.stats).items()
+            if k != "operator"
+        })
+        self._span.end()
 
     def _record_shape(self, batch) -> None:
         if self._shape_ledger is None:
@@ -98,6 +165,7 @@ class InstrumentedOperator:
         return self.inner.needs_input()
 
     def add_input(self, batch) -> None:
+        self._beat()
         t0 = time.monotonic()
         self.inner.add_input(batch)
         if self._device_sync:
@@ -106,9 +174,11 @@ class InstrumentedOperator:
         self.stats.add_input_calls += 1
         self.stats.input_batches += 1
         if self._count_rows:
-            self.stats.input_rows += batch.row_count()
+            self._count("input_rows", batch)
+        self._beat()
 
     def get_output(self):
+        self._beat()
         t0 = time.monotonic()
         out = self.inner.get_output()
         if self._device_sync and out is not None:
@@ -120,16 +190,19 @@ class InstrumentedOperator:
         if out is not None:
             self.stats.output_batches += 1
             if self._count_rows:
-                self.stats.output_rows += out.row_count()
+                self._count("output_rows", out)
             self._record_shape(out)
+            self._beat()
         return out
 
     def finish(self) -> None:
+        self._beat()
         t0 = time.monotonic()
         self.inner.finish()
         if self._device_sync:
             _device_barrier()
         self.stats.finish_s += time.monotonic() - t0
+        self._beat()
 
     def is_finished(self) -> bool:
         return self.inner.is_finished()
@@ -144,14 +217,23 @@ class InstrumentedOperator:
 
 def instrument(operators, count_rows: bool = True,
                device_sync: bool = False,
-               shape_ledger: Optional[Set[Tuple]] = None):
+               shape_ledger: Optional[Set[Tuple]] = None,
+               heartbeat=None, span_factory=None):
     """Wrap a pipeline's operators; returns (wrapped, [OperatorStats]).
     `device_sync=True` closes every timed section with a device barrier
     (EXPLAIN ANALYZE's per-operator device attribution). Pass a shared
-    `shape_ledger` set to collect observed output shape classes."""
+    `shape_ledger` set to collect observed output shape classes,
+    `heartbeat` for operator-internal watchdog beats, and
+    `span_factory(operator_name) -> Span` to open one trace span per
+    operator (ended with stats attached via close_span)."""
     stats = [OperatorStats() for _ in operators]
     wrapped = [
-        InstrumentedOperator(op, st, count_rows, device_sync, shape_ledger)
+        InstrumentedOperator(
+            op, st, count_rows, device_sync, shape_ledger,
+            heartbeat=heartbeat,
+            span=(span_factory(type(op).__name__)
+                  if span_factory is not None else None),
+        )
         for op, st in zip(operators, stats)
     ]
     return wrapped, stats

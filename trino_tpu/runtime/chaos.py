@@ -429,15 +429,17 @@ class ChaosHarness:
         query completes correctly — in far less wall time than the
         stall, which is the no-query-may-hang-the-cluster property.
 
-        Heartbeats are batch-granular, so the watchdog threshold must
-        comfortably exceed the plan's honest single-batch duration or
-        healthy tasks get flagged. The floor is set by jit: a fresh
-        shape triggers an XLA lowering burst (~0.3s on CPU) INSIDE one
-        batch, and retries perturb batch capacities (dynamic-filter
-        pruning differs per surviving attempt) so no warm run covers
-        every shape — thresholds under ~1s WILL kill healthy tasks.
-        Operator-internal heartbeats are the recorded follow-up that
-        would allow tens-of-ms thresholds."""
+        The conservative threshold must comfortably exceed a cold
+        task's honest silence: a fresh shape triggers an XLA lowering
+        burst (~0.3s on CPU) INSIDE one operator call, and retries
+        perturb batch capacities (dynamic-filter pruning differs per
+        surviving attempt) so no warm run covers every shape. But
+        operator-internal heartbeats (InstrumentedOperator._beat fires
+        at entry AND exit of every add_input/get_output/finish, always
+        on since exec/stats.py instrumentation became unconditional)
+        mean a WARM task's longest honest silence is one operator call,
+        not one batch — so stuck_task_interrupt_warm_s can run at a few
+        hundred ms where the old batch-granular beats needed ~1s+."""
         rng = random.Random(seed)
         # warm run first: compiles every jit shape this plan touches, so
         # once the watchdog arms, the only task that can miss a

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 from typing import Dict, List, Optional
 
 from trino_tpu import types as T
@@ -43,6 +44,8 @@ class QueryScheduler:
         session: Session,
         hash_partitions: Optional[int] = None,
         collect_stats: bool = False,
+        trace=None,
+        query_span=None,
     ):
         self.query_id = query_id
         self.subplan = subplan
@@ -56,6 +59,13 @@ class QueryScheduler:
         # fragment id -> [(worker handle, task id string)]
         self.tasks: Dict[int, List] = {}
         self._schemas: Dict[int, list] = {}
+        # tracing (runtime/tracing.py): one stage span per fragment and
+        # one task span per launch, all hanging off `query_span`; tasks
+        # get wire_context on TaskSpec so worker operator spans graft in
+        self.trace = trace
+        self.query_span = query_span
+        self.stage_spans: Dict[int, object] = {}
+        self.task_spans: Dict[str, object] = {}
 
     def start(self):
         """Create all tasks bottom-up (producers first so consumers can
@@ -102,10 +112,21 @@ class QueryScheduler:
             if locations
             else UniformNodeSelector(max_tasks_per_node=cap)
         )
+        tracing = self.trace is not None and self.query_span is not None
+        if tracing:
+            from trino_tpu.runtime.tracing import (
+                KIND_STAGE,
+                KIND_TASK,
+                wire_context,
+            )
         for sp in order:
             f = sp.fragment
             tc = task_counts[f.id]
             n_out = consumer_counts.get(f.id, 1)
+            if tracing:
+                self.stage_spans[f.id] = self.query_span.child(
+                    f"stage {f.id}", KIND_STAGE, fragment_id=f.id, tasks=tc
+                )
             remote = {
                 c.fragment.id: self._schemas[c.fragment.id]
                 for c in sp.children
@@ -142,6 +163,15 @@ class QueryScheduler:
                         self.session, "capacity_ladder_base", 2
                     ),
                 )
+                if tracing:
+                    tspan = self.stage_spans[f.id].child(
+                        f"task {task_id}", KIND_TASK, partition=p
+                    )
+                    self.task_spans[str(task_id)] = tspan
+                    if self.collect_stats:
+                        # operator spans only under query_trace=on —
+                        # the traced-off run stays an honest baseline
+                        spec.trace_ctx = wire_context(tspan)
                 first_loc = (
                     locations.get(id(created[0][0]))
                     if locations and created else None
@@ -177,6 +207,60 @@ class QueryScheduler:
                 if st["state"] == "failed":
                     out.append(f"{tid}: {st.get('failure')}")
         return out
+
+    def finalize(self) -> Dict[int, List]:
+        """Terminal status sweep, run BEFORE abort() (remove_task
+        destroys the span/stats data): pull each task's final status,
+        graft its operator spans into the trace, and close the task and
+        stage spans with worker-reported wall bounds. Returns
+        fragment id -> [(task id, status dict)] for QueryInfo."""
+        # settle: draining the root output races the root task's own
+        # state flip by a few ms — wait for every task to go terminal
+        # so QueryInfo/EXPLAIN ANALYZE never snapshot a "running" task
+        # with half-flushed stats (bounded: failure paths have already
+        # flipped their tasks to failed before finalize runs)
+        deadline = time.time() + 2.0
+        while time.time() < deadline:
+            settled = True
+            for ts in self.tasks.values():
+                for handle, tid in ts:
+                    try:
+                        st = handle.task_state(tid)
+                    except Exception:
+                        continue
+                    if st.get("state") == "running":
+                        settled = False
+            if settled:
+                break
+            time.sleep(0.005)
+        states: Dict[int, List] = {}
+        for fid, ts in self.tasks.items():
+            lst = []
+            for handle, tid in ts:
+                try:
+                    st = handle.task_state(tid)
+                except Exception as e:
+                    st = {"state": "unknown",
+                          "failure": f"status fetch failed ({e})",
+                          "cpu_s": 0.0}
+                lst.append((tid, st))
+                span = self.task_spans.get(tid)
+                if span is not None:
+                    if st.get("start_time"):
+                        span.start_s = st["start_time"]
+                    span.set(state=st.get("state"),
+                             cpu_s=st.get("cpu_s") or 0.0)
+                    if st.get("failure"):
+                        span.set(error=True)
+                        span.event("task_failed",
+                                   message=str(st["failure"])[:500])
+                    span.end(st.get("end_time"))
+                if self.trace is not None:
+                    self.trace.graft(st.get("spans") or [])
+            states[fid] = lst
+        for span in self.stage_spans.values():
+            span.end()
+        return states
 
     def abort(self) -> None:
         for ts in self.tasks.values():
@@ -288,6 +372,27 @@ class DistributedQueryRunner:
         from trino_tpu.runtime.query_tracker import QueryTracker
 
         self.query_tracker = QueryTracker()
+        # observability plane: event listener SPI (QueryCreated/
+        # QueryCompleted with resource enrichment), the bounded
+        # completed-query registry behind GET /v1/query/{id} and
+        # /v1/query/{id}/trace, and in-flight traces for live lookups
+        from trino_tpu.runtime.events import EventListenerManager
+
+        self.event_listeners = EventListenerManager()
+        self.event_listeners.register_metrics()
+        # compile-attribution counters (xla_compiles_by_query.{qid} ->
+        # QueryInfo.compile_count) and the compile-duration histogram
+        # require the process-wide jax.monitoring listener
+        from trino_tpu.runtime.metrics import install_xla_compile_listener
+
+        install_xla_compile_listener()
+        import collections
+
+        self._completed_queries = collections.OrderedDict()
+        self._completed_queries_cap = 200
+        self.last_query_id: Optional[str] = None
+        self._active_traces: Dict[str, tuple] = {}
+        self._lock = threading.Lock()
 
     def _fail_query_on_workers(self, query_id: str, message: str) -> None:
         for w in self.workers:
@@ -363,7 +468,11 @@ class DistributedQueryRunner:
         (the client-abandonment reaper's hook): once it returns True the
         query is torn down — tasks aborted, memory released — instead of
         computing a result nobody will read."""
+        import time as _time
+
+        t_parse0 = _time.time()
         stmt = parse(sql)
+        t_parse1 = _time.time()
         if isinstance(stmt, ast.ExplainStatement):
             output = self._analyze(stmt.query)
             self._check_access(output, identity)
@@ -406,15 +515,55 @@ class DistributedQueryRunner:
         )
         if limits.any():
             tracker.start()
+        # every distributed query gets a coordinator-side span tree
+        # (query/phases/stages/tasks — a handful of spans); worker
+        # OPERATOR spans and row counting only under query_trace=on
+        from trino_tpu.runtime.events import QueryCreatedEvent
+        from trino_tpu.runtime.metrics import METRICS
+        from trino_tpu.runtime.tracing import (
+            KIND_PHASE,
+            KIND_QUERY,
+            QueryTrace,
+        )
+
+        trace = QueryTrace(base_qid)
+        qspan = trace.span(f"query {base_qid}", KIND_QUERY, sql=sql[:500])
+        qspan.start_s = t_parse0
+        pspan = qspan.child("parse", KIND_PHASE)
+        pspan.start_s = t_parse0
+        pspan.end(t_parse1)
+        with self._lock:
+            self._active_traces[base_qid] = trace
+        counters_before = METRICS.snapshot()
+        self.event_listeners.query_created(
+            QueryCreatedEvent(base_qid, sql, _time.time())
+        )
+        self._last_stage_infos = None
+        status, failure_txt, rows_n = "finished", None, 0
         try:
-            return self._execute_query(
-                stmt, identity, base_qid, tq, limits, cancel
+            result = self._execute_query(
+                stmt, identity, base_qid, tq, limits, cancel,
+                trace=trace, query_span=qspan,
             )
+            rows_n = len(result.rows)
+            return result
+        except BaseException as e:
+            status, failure_txt = "failed", repr(e)
+            if not qspan.ended:
+                qspan.event("exception", type=type(e).__name__,
+                            message=str(e)[:500])
+                qspan.set(error=True)
+            raise
         finally:
             tracker.complete(base_qid)
+            self._finalize_query(
+                base_qid, sql, trace, qspan, status, failure_txt,
+                rows_n, counters_before,
+            )
 
     def _execute_query(
-        self, stmt, identity, base_qid, tq, limits, cancel
+        self, stmt, identity, base_qid, tq, limits, cancel,
+        trace=None, query_span=None,
     ) -> MaterializedResult:
         from trino_tpu.runtime.query_tracker import (
             EXECUTING,
@@ -422,20 +571,31 @@ class DistributedQueryRunner:
             deadline_code,
             deadline_error,
         )
+        from trino_tpu.runtime.tracing import KIND_PHASE
+
+        def phase(name):
+            if query_span is None:
+                import contextlib
+
+                return contextlib.nullcontext()
+            return query_span.child(name, KIND_PHASE)
 
         tracker = self.query_tracker
-        output = self._analyze(stmt)
+        output = self._analyze(stmt, query_span=query_span)
         # reset BEFORE any plane decision: a stale reason from an earlier
         # query must not read as applying to this one
         self.last_mesh_fallback = None
         self._check_access(output, identity)
-        subplan = plan_distributed(
-            output,
-            self.catalogs,
-            broadcast_threshold=self.session.broadcast_join_threshold,
-            target_splits=self.session.target_splits,
-            validation=getattr(self.session, "plan_validation", "passes"),
-        )
+        with phase("fragment"):
+            subplan = plan_distributed(
+                output,
+                self.catalogs,
+                broadcast_threshold=self.session.broadcast_join_threshold,
+                target_splits=self.session.target_splits,
+                validation=getattr(
+                    self.session, "plan_validation", "passes"
+                ),
+            )
         # planning is over: surface a planning-limit kill latched during
         # the analyze/optimize/fragment work before any task launches
         tracker.check(base_qid)
@@ -443,7 +603,8 @@ class DistributedQueryRunner:
         result_meta = (list(output.names), [f.type for f in output.fields])
         if self.session.retry_policy == "task":
             rows = self._execute_fte(
-                subplan, query_id=base_qid, cancel=cancel, tq=tq
+                subplan, query_id=base_qid, cancel=cancel, tq=tq,
+                trace=trace, query_span=query_span,
             )
             return MaterializedResult(rows, *result_meta, data_plane="fte")
         if self.session.mesh_execution and self._mesh_colocated():
@@ -520,6 +681,11 @@ class DistributedQueryRunner:
                 # attempt died (files compacted/deleted under it):
                 # re-list before replaying
                 self.catalogs.invalidate_split_listings()
+                if query_span is not None:
+                    query_span.event(
+                        "query_retry", attempt=attempt,
+                        error=str(last_error)[:300],
+                    )
             scheduler = QueryScheduler(
                 query_id,
                 subplan,
@@ -527,6 +693,11 @@ class DistributedQueryRunner:
                 self.catalogs,
                 self.session,
                 self.hash_partitions,
+                collect_stats=(
+                    getattr(self.session, "query_trace", "off") == "on"
+                ),
+                trace=trace,
+                query_span=query_span,
             )
             # the CPU budget reads the live attempt's task ledgers on
             # top of what earlier attempts already burned
@@ -541,7 +712,8 @@ class DistributedQueryRunner:
                 # crashes surface as OSError/URLError, not RuntimeError,
                 # so catch broadly here — analysis errors were raised
                 # before this loop.
-                root_handle, root_tid = scheduler.start()
+                with phase("schedule"):
+                    root_handle, root_tid = scheduler.start()
                 rows = self._collect(
                     scheduler, root_handle, root_tid,
                     cancel=cancel, base_qid=base_qid,
@@ -561,6 +733,15 @@ class DistributedQueryRunner:
                 accrued_cpu += _scheduler_cpu_s(scheduler)
                 last_error = e
             finally:
+                # terminal sweep BEFORE abort (remove_task destroys the
+                # span/stats data): grafts worker spans, closes stage/
+                # task spans, snapshots task states for QueryInfo
+                try:
+                    self._last_stage_infos = self._stage_infos(
+                        scheduler.finalize()
+                    )
+                except Exception:
+                    pass  # observability must never mask the verdict
                 scheduler.abort()
         raise last_error
 
@@ -583,6 +764,8 @@ class DistributedQueryRunner:
         status (the TaskInfo aggregation path, Driver -> Task -> Stage),
         and render the fragment plan annotated with per-stage operator
         lines summed across that stage's tasks."""
+        from trino_tpu.runtime.queryinfo import stage_text
+
         query_id = f"q{next(_query_counter)}"
         scheduler = QueryScheduler(
             query_id, subplan, self.workers, self.catalogs, self.session,
@@ -591,38 +774,14 @@ class DistributedQueryRunner:
         try:
             root_handle, root_tid = scheduler.start()
             self._collect(scheduler, root_handle, root_tid)
+            # the TaskInfo aggregation path (runtime/queryinfo.py):
+            # merged per-stage operator lines through the shared
+            # OperatorStats formatter PLUS the per-task summary lines
+            # distributed EXPLAIN ANALYZE used to lose
+            stages = self._stage_infos(scheduler.finalize())
             lines = [self._explain_text(subplan)]
-            for fid in sorted(scheduler.tasks):
-                merged: List[List[dict]] = []
-                n_tasks = 0
-                for handle, tid in scheduler.tasks[fid]:
-                    st = handle.task_state(tid)
-                    stats = st.get("stats")
-                    if stats is None:
-                        continue
-                    n_tasks += 1
-                    for pi, group in enumerate(stats):
-                        while len(merged) <= pi:
-                            merged.append([])
-                        for oi, op in enumerate(group):
-                            if oi >= len(merged[pi]):
-                                merged[pi].append(dict(op))
-                            else:
-                                acc = merged[pi][oi]
-                                for k, v in op.items():
-                                    if isinstance(v, (int, float)):
-                                        acc[k] = acc.get(k, 0) + v
-                # rehydrate + render through the shared OperatorStats
-                # formatter so local and distributed EXPLAIN ANALYZE
-                # cannot drift apart
-                from trino_tpu.exec.stats import OperatorStats, render_stats
-
-                groups = [
-                    [OperatorStats(**op) for op in group]
-                    for group in merged
-                ]
-                lines.append(f"\nFragment {fid} [{n_tasks} tasks]:")
-                lines.append(render_stats(groups))
+            for stage in stages:
+                lines.append(stage_text(stage))
             return MaterializedResult(
                 [["\n".join(lines)]], ["Query Plan"], [T.VARCHAR]
             )
@@ -630,7 +789,8 @@ class DistributedQueryRunner:
             scheduler.abort()
 
     def _execute_fte(
-        self, subplan, query_id=None, cancel=None, tq=None
+        self, subplan, query_id=None, cancel=None, tq=None,
+        trace=None, query_span=None,
     ) -> List[list]:
         """retry_policy=TASK: FTE over the spooled exchange."""
         import shutil
@@ -652,6 +812,11 @@ class DistributedQueryRunner:
                 self.hash_partitions,
                 max_task_retries=self.session.task_retries,
                 node_manager=self.node_manager,
+                trace=trace,
+                query_span=query_span,
+                collect_stats=(
+                    getattr(self.session, "query_trace", "off") == "on"
+                ),
             )
             if tq is not None:
                 # CPU budget over the FTE attempt ledgers (polled task
@@ -691,6 +856,14 @@ class DistributedQueryRunner:
                         scheduler.speculation_estimates
                     ),
                 }
+                # QueryInfo stage rollups from the FTE attempt snapshots
+                # (taken at each attempt's terminal observation)
+                try:
+                    self._last_stage_infos = self._stage_infos(
+                        scheduler.task_snapshots()
+                    )
+                except Exception:
+                    pass
             import os
 
             root_dir = os.path.join(spool_dir, root_key)
@@ -705,7 +878,9 @@ class DistributedQueryRunner:
         finally:
             shutil.rmtree(spool_dir, ignore_errors=True)
 
-    def _analyze(self, q: ast.Query):
+    def _analyze(self, q: ast.Query, query_span=None):
+        import contextlib
+
         from trino_tpu.sql.optimizer import (
             canonicalize_tstz_keys,
             optimize,
@@ -716,6 +891,13 @@ class DistributedQueryRunner:
             set_session_zone,
         )
 
+        def phase(name):
+            if query_span is None:
+                return contextlib.nullcontext()
+            from trino_tpu.runtime.tracing import KIND_PHASE
+
+            return query_span.child(name, KIND_PHASE)
+
         set_session_zone(self.session.timezone)
         set_session_info(
             self.session.catalog, self.session.schema, self.session.user
@@ -723,16 +905,21 @@ class DistributedQueryRunner:
         analyzer = Analyzer(
             self.catalogs, self.session.catalog, self.session.schema
         )
-        root = optimize(analyzer.plan(q), self.catalogs, self.session)
-        # correctness pass (was missing here while present on the
-        # single-node path — found by the exchange-key validator:
-        # distributed plans hashed tstz join/group keys with the packed
-        # zone bits still set, splitting equal instants across tasks)
-        root = canonicalize_tstz_keys(root)
+        with phase("analyze"):
+            root = analyzer.plan(q)
+        with phase("optimize"):
+            root = optimize(root, self.catalogs, self.session)
+            # correctness pass (was missing here while present on the
+            # single-node path — found by the exchange-key validator:
+            # distributed plans hashed tstz join/group keys with the
+            # packed zone bits still set, splitting equal instants
+            # across tasks)
+            root = canonicalize_tstz_keys(root)
         if getattr(self.session, "plan_validation", "passes") != "off":
             from trino_tpu.sql.validate import validate_logical
 
-            validate_logical(root, stage="canonicalize_tstz_keys")
+            with phase("validate"):
+                validate_logical(root, stage="canonicalize_tstz_keys")
         return root
 
     def _collect(
@@ -742,6 +929,10 @@ class DistributedQueryRunner:
         """Pull the root stage's single output partition (the
         Query.getNextResult / removePagesFromExchange path,
         server/protocol/Query.java:450)."""
+        import time as _time
+
+        from trino_tpu.runtime.metrics import METRICS
+
         rows: List[list] = []
         token = 0
         while True:
@@ -754,11 +945,17 @@ class DistributedQueryRunner:
                     f"Query {scheduler.query_id} abandoned: client "
                     "stopped polling results"
                 )
+            # the status sweep is the pipelined scheduler's "tick" —
+            # its duration distribution is the control-loop health gauge
+            t_tick = _time.monotonic()
             if base_qid is not None:
                 # deadline kills latch on the tracker before the failed
                 # task states propagate — surface the typed error first
                 self.query_tracker.check(base_qid)
             self._raise_if_failed(scheduler)
+            METRICS.observe(
+                "scheduler_tick_s", _time.monotonic() - t_tick
+            )
             try:
                 pages, token, complete = handle.get_results(
                     tid, 0, token, max_pages=16, wait=0.2
@@ -776,6 +973,181 @@ class DistributedQueryRunner:
                 rows.extend(_page_rows(page))
             if complete:
                 return rows
+
+    # -- observability plane (QueryInfo registry + trace export) --
+
+    def _stage_infos(self, states) -> List[dict]:
+        """fragment id -> [(tid, status)] into StageInfo rollups, with
+        per-stage wall-time histogram samples."""
+        from trino_tpu.runtime.metrics import METRICS
+        from trino_tpu.runtime.queryinfo import (
+            build_stage_info,
+            build_task_info,
+        )
+
+        infos = []
+        for fid in sorted(states):
+            task_infos = [
+                build_task_info(tid, st) for tid, st in states[fid]
+            ]
+            expected = max(
+                (int(st.get("expected_shape_classes") or 0)
+                 for _, st in states[fid]),
+                default=0,
+            )
+            info = build_stage_info(
+                fid, task_infos, expected_lowerings=expected
+            )
+            if info["wall_s"] is not None:
+                METRICS.observe("stage_wall_s", info["wall_s"])
+            infos.append(info)
+        return infos
+
+    def _drain_query_peaks(self, base_qid: str) -> int:
+        """Sum per-worker peak-memory watermarks for this query (every
+        attempt namespace: qN, qNr1, ...) and retire them from in-process
+        pools. Sum-of-per-worker-peaks is an upper bound on any single
+        instant's cluster total — exact when one worker dominates."""
+        total = 0
+        for w in self.workers:
+            pool = getattr(w, "memory_pool", None)
+            if pool is not None:
+                peaks = pool.query_peaks()
+            else:
+                try:
+                    peaks = (w.status() or {}).get("query_peak_bytes")
+                except Exception:
+                    peaks = None
+            if not peaks:
+                continue
+            keys = [
+                k for k in peaks
+                if k == base_qid or k.startswith(base_qid + "r")
+            ]
+            vals = [peaks[k] for k in keys]
+            if vals:
+                # attempts are sequential, so the query's peak in this
+                # pool is the max attempt watermark, not their sum
+                total += max(vals)
+            if pool is not None:
+                for k in keys:
+                    pool.drop_query_peak(k)
+        return total
+
+    def _finalize_query(
+        self, base_qid, sql, trace, qspan, status, failure_txt,
+        rows_n, counters_before,
+    ) -> None:
+        """Close out the observability plane for one query (success OR
+        failure): end the span tree, record histograms, retire per-query
+        compile counters and memory watermarks, build the final
+        QueryInfo into the bounded registry, and fire the enriched
+        QueryCompletedEvent. Never raises — observability must not mask
+        the query verdict."""
+        try:
+            from trino_tpu.exec.stats import engine_counters_delta
+            from trino_tpu.runtime.events import QueryCompletedEvent
+            from trino_tpu.runtime.metrics import (
+                METRICS,
+                retire_query_compiles,
+            )
+            from trino_tpu.runtime.query_tracker import deadline_code
+            from trino_tpu.runtime.queryinfo import build_query_info
+
+            qspan.set(state=status)
+            qspan.end()
+            trace.end_open_spans(qspan.end_s)
+            wall = qspan.duration_s
+            METRICS.observe("query_wall_s", wall)
+            stages = self._last_stage_infos or []
+            compile_count = int(retire_query_compiles(base_qid))
+            peak = self._drain_query_peaks(base_qid)
+            counters = engine_counters_delta(
+                counters_before, METRICS.snapshot()
+            )
+            err_code = None
+            if failure_txt:
+                err_code = deadline_code(failure_txt)
+                if err_code is None and (
+                    "ExceededMemoryLimitError" in failure_txt
+                    or "low-memory killer" in failure_txt
+                ):
+                    err_code = "EXCEEDED_MEMORY_LIMIT"
+            retry_count = max(0, self.last_query_attempts - 1)
+            attempt_count = 1
+            is_fte = (
+                getattr(self.session, "retry_policy", "none") == "task"
+            )
+            if is_fte and self.last_fte_stats:
+                app = (
+                    self.last_fte_stats.get("attempts_per_partition")
+                    or {}
+                )
+                attempt_count = sum(app.values()) or 1
+            info = build_query_info(
+                base_qid, status, sql=sql, wall_s=wall, stages=stages,
+                peak_memory_bytes=peak, compile_count=compile_count,
+                counters=counters, error_code=err_code,
+                failure=failure_txt, retry_count=retry_count,
+                attempt_count=attempt_count,
+                data_plane="fte" if is_fte else "http",
+            )
+            with self._lock:
+                self._active_traces.pop(base_qid, None)
+                self.last_query_id = base_qid
+                self._completed_queries[base_qid] = {
+                    "info": info, "trace": trace,
+                }
+                while (
+                    len(self._completed_queries)
+                    > self._completed_queries_cap
+                ):
+                    self._completed_queries.popitem(last=False)
+            self.event_listeners.query_completed(QueryCompletedEvent(
+                base_qid, sql, status, wall, rows=rows_n,
+                failure=failure_txt,
+                peak_memory_bytes=peak,
+                rows_scanned=int(counters.get("rows_scanned", 0)),
+                bytes_scanned=int(counters.get("bytes_scanned", 0)),
+                rows_shuffled=int(counters.get("rows_shuffled", 0)),
+                compile_count=compile_count,
+                cpu_s=sum(s.get("cpu_s") or 0.0 for s in stages),
+                error_code=err_code,
+                retry_count=retry_count,
+                attempt_count=attempt_count,
+            ))
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "query observability finalization failed", exc_info=True
+            )
+
+    def query_info(self, query_id: str) -> Optional[dict]:
+        """GET /v1/query/{id}: the final aggregated QueryInfo."""
+        with self._lock:
+            entry = self._completed_queries.get(query_id)
+        return dict(entry["info"]) if entry else None
+
+    def query_trace_export(self, query_id: str) -> Optional[dict]:
+        """Structured span-list export (completed registry first, then
+        in-flight traces — a running query serves a partial tree)."""
+        with self._lock:
+            entry = self._completed_queries.get(query_id)
+            trace = (
+                entry["trace"] if entry
+                else self._active_traces.get(query_id)
+            )
+        return trace.export() if trace is not None else None
+
+    def query_chrome_trace(self, query_id: str) -> Optional[dict]:
+        """Perfetto-loadable Chrome trace-event rendering."""
+        from trino_tpu.runtime.tracing import chrome_trace
+
+        export = self.query_trace_export(query_id)
+        if export is None:
+            return None
+        return {"traceEvents": chrome_trace(export)}
 
     @staticmethod
     def _raise_if_failed(scheduler: QueryScheduler) -> None:

@@ -11,9 +11,11 @@ queue is over budget (scheduleRequestIfNecessary's memory gate).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, List, Optional
 
 from trino_tpu.exec.serde import Page
+from trino_tpu.runtime.metrics import METRICS
 from trino_tpu.runtime.error_tracker import (
     REQUEST_STATS,
     RequestErrorTracker,
@@ -89,6 +91,7 @@ class DirectExchangeClient:
                         self._lock.wait(timeout=0.1)
                     if self._closed:
                         return
+                t_pull = time.monotonic()
                 try:
                     pages, token, complete = loc.fetch(
                         loc.partition, token, 16, self._long_poll_s
@@ -100,6 +103,11 @@ class DirectExchangeClient:
                 REQUEST_STATS.record(loc.destination, ok=True)
                 tracker.on_success()
                 if pages:
+                    # data pulls only: an empty long-poll round measures
+                    # the poll timeout, not exchange latency
+                    METRICS.observe(
+                        "exchange_page_pull_s", time.monotonic() - t_pull
+                    )
                     with self._lock:
                         self._queue.extend(pages)
                         self._lock.notify_all()

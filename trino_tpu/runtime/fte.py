@@ -58,6 +58,9 @@ class FaultTolerantQueryScheduler:
         max_task_retries: int = 3,
         active_workers_fn=None,
         node_manager=None,
+        trace=None,
+        query_span=None,
+        collect_stats: bool = False,
     ):
         self.query_id = query_id
         self.subplan = subplan
@@ -123,6 +126,19 @@ class FaultTolerantQueryScheduler:
         # chaos/bench assert attempt counts stay bounded per partition)
         self.attempts_per_partition: Dict[str, int] = {}
         self._speculative_tids: set = set()
+        # tracing (runtime/tracing.py): one stage span per _run_stage,
+        # one task span per attempt (keyed by tid string — the running
+        # 5-tuples stay untouched); retry/speculation/deadline/watchdog/
+        # chaos events annotate the owning span. collect_stats rides
+        # TaskSpec so traced queries get row counts + operator spans.
+        self.trace = trace
+        self.query_span = query_span
+        self.collect_stats = collect_stats
+        self._task_spans: Dict[str, object] = {}
+        # tid -> (fragment id, last observed status dict) for the
+        # QueryInfo stage rollup (losers get a best-effort final fetch
+        # in settle, BEFORE remove_task destroys their status)
+        self._snapshots: Dict[str, Tuple[int, dict]] = {}
 
     def _report(self, handle, ok: bool) -> None:
         """Feed the node's circuit breaker: in-process handles have no
@@ -142,6 +158,53 @@ class FaultTolerantQueryScheduler:
         """Query-wide CPU spent, from the last polled per-task ledgers
         (finished/failed attempts keep their final reading)."""
         return sum(self.cpu_by_task.values())
+
+    def task_snapshots(self) -> Dict[int, List[Tuple[str, dict]]]:
+        """fragment id -> [(tid, last observed status)] across every
+        attempt — the QueryInfo stage-rollup input (same shape as
+        QueryScheduler.finalize)."""
+        out: Dict[int, List[Tuple[str, dict]]] = {}
+        for tid, (fid, st) in self._snapshots.items():
+            out.setdefault(fid, []).append((tid, st))
+        return out
+
+    def _observe(self, fid: int, tid: str, st: dict) -> None:
+        """Record an attempt's latest status; graft its operator spans
+        once terminal (the worker only ships spans for terminal tasks;
+        graft dedups by span_id so repeat polls are safe)."""
+        self._snapshots[tid] = (fid, st)
+        if self.trace is not None:
+            self.trace.graft(st.get("spans") or [])
+            if st.get("state") in ("finished", "failed", "aborted"):
+                span = self._task_spans.get(tid)
+                if span is not None and not span.ended:
+                    if st.get("failure"):
+                        # classified failure annotation: a chaos run
+                        # must read as one timeline (deadline /
+                        # watchdog_interrupt / chaos_fault / task_failed)
+                        span.event(self._failure_kind(st["failure"]),
+                                   error=str(st["failure"])[:300])
+                        span.set(error=True)
+                    if st.get("start_time"):
+                        span.start_s = st["start_time"]
+                    span.set(state=st.get("state"),
+                             cpu_s=st.get("cpu_s") or 0.0)
+                    span.end(st.get("end_time"))
+
+    @staticmethod
+    def _failure_kind(msg: Optional[str]) -> str:
+        """Classify a task-failure string into the annotation vocabulary
+        (works across HTTP topologies, where only the string travels)."""
+        from trino_tpu.runtime.query_tracker import deadline_code
+
+        msg = msg or ""
+        if deadline_code(msg) is not None:
+            return "deadline"
+        if "Stuck task" in msg:
+            return "watchdog_interrupt"
+        if "injected" in msg.lower():
+            return "chaos_fault"
+        return "task_failed"
 
     # scheduling is stage-by-stage: children complete before parents run
     def run(self, cancel=None) -> Tuple[object, str]:
@@ -190,6 +253,13 @@ class FaultTolerantQueryScheduler:
         from trino_tpu.runtime.stages import fragment_schema
 
         f = sp.fragment
+        stage_span = None
+        if self.trace is not None and self.query_span is not None:
+            from trino_tpu.runtime.tracing import KIND_STAGE
+
+            stage_span = self.query_span.child(
+                f"stage {f.id}", KIND_STAGE, fragment_id=f.id, tasks=tc
+            )
         remote = {
             c.fragment.id: self._schemas[c.fragment.id] for c in sp.children
         }
@@ -234,6 +304,16 @@ class FaultTolerantQueryScheduler:
                 self.attempts_per_partition.get(pkey, 0) + 1
             )
             task_id = TaskId(self.query_id, f.id, p, attempt)
+            tspan = None
+            if stage_span is not None:
+                from trino_tpu.runtime.tracing import KIND_TASK, wire_context
+
+                tspan = stage_span.child(
+                    f"task {task_id}", KIND_TASK,
+                    partition=p, attempt=attempt,
+                    worker=getattr(handle, "worker_id", None),
+                )
+                self._task_spans[str(task_id)] = tspan
             spec = TaskSpec(
                 task_id=task_id,
                 fragment=f,
@@ -252,12 +332,21 @@ class FaultTolerantQueryScheduler:
                 capacity_ladder_base=getattr(
                     self.session, "capacity_ladder_base", 2
                 ),
+                collect_stats=self.collect_stats,
             )
+            if tspan is not None and self.collect_stats:
+                # operator spans only under query_trace=on: the wire
+                # context is what tells the worker to record them
+                spec.trace_ctx = wire_context(tspan)
             try:
                 handle.create_task(spec)
             except Exception as exc:
                 self.allocator.release(handle, est_bytes)
                 self._report(handle, ok=False)
+                if tspan is not None and not tspan.ended:
+                    tspan.event("launch_failed", error=str(exc)[:300])
+                    tspan.set(error=True, state="launch_failed")
+                    tspan.end()
                 raise _LaunchFailed(handle, exc)
             self._report(handle, ok=True)
             return (handle, str(task_id), attempt, time.monotonic(), est_bytes)
@@ -273,10 +362,30 @@ class FaultTolerantQueryScheduler:
             self.allocator.release(handle, est)
             if tid in self._speculative_tids:
                 self.speculation_wins += 1
+                wspan = self._task_spans.get(tid)
+                if wspan is not None:
+                    wspan.event("speculation_won", partition=p)
             for h, other_tid, _, _, other_est in losers:
                 self.allocator.release(h, other_est)
-                if other_tid in self._speculative_tids:
+                was_speculative = other_tid in self._speculative_tids
+                if was_speculative:
                     self.speculation_losses += 1
+                lspan = self._task_spans.get(other_tid)
+                if lspan is not None:
+                    lspan.event(
+                        "speculation_lost" if was_speculative
+                        else "lost_to_speculation"
+                    )
+                # last look at the loser's status BEFORE remove_task
+                # destroys it: the stage rollup keeps every attempt, and
+                # a just-finished loser may have spans worth grafting
+                try:
+                    self._observe(f.id, other_tid, h.task_state(other_tid))
+                except Exception:
+                    pass
+                if lspan is not None and not lspan.ended:
+                    lspan.set(state="aborted")
+                    lspan.end()
                 # cooperative cancel: remove_task aborts the loser's
                 # state machine, so its Driver stops at the next batch
                 # boundary; consumers only ever read the committed
@@ -312,6 +421,10 @@ class FaultTolerantQueryScheduler:
                     self.retries += 1
                     avoid[p] = lf.handle
                     pending[p] = attempt_hwm[p] + 1
+                    if stage_span is not None:
+                        stage_span.event("task_retry", partition=p,
+                                         attempt=pending[p],
+                                         reason="launch_failed")
             # poll
             time.sleep(0.01)
             now = time.monotonic()
@@ -345,6 +458,7 @@ class FaultTolerantQueryScheduler:
                         }
                     if "cpu_s" in st:
                         self.cpu_by_task[tid] = float(st["cpu_s"] or 0.0)
+                    self._observe(f.id, tid, st)
                     if st["state"] == "finished":
                         if finished_entry is None:
                             finished_entry = entry
@@ -365,6 +479,8 @@ class FaultTolerantQueryScheduler:
                             # is spent can only spend it again. Contrast
                             # watchdog interrupts (no code), which stay
                             # in the normal retry path below.
+                            if stage_span is not None:
+                                stage_span.event("deadline_kill", task=tid)
                             self._abort_running(running)
                             raise deadline_error(f"task {tid}: {fmsg}")
                         if tid in self._speculative_tids:
@@ -392,6 +508,10 @@ class FaultTolerantQueryScheduler:
                             f"after {next_attempt} attempts"
                         )
                     pending[p] = next_attempt
+                    if stage_span is not None:
+                        stage_span.event("task_retry", partition=p,
+                                         attempt=next_attempt,
+                                         reason="task_failed")
                     continue
                 running[p] = next_entries
                 # speculation: the stage is mostly done, this partition
@@ -417,6 +537,16 @@ class FaultTolerantQueryScheduler:
                         running[p].append(dup)
                         self.speculative_hits += 1
                         self._speculative_tids.add(dup[1])
+                        dspan = self._task_spans.get(dup[1])
+                        if dspan is not None:
+                            dspan.set(speculative=True)
+                            dspan.event("speculative_launch",
+                                        straggler=next_entries[0][1])
                     except _LaunchFailed:
                         pass  # speculation is best-effort
+        if stage_span is not None:
+            # abnormal exits (deadline, retries exceeded, abandonment)
+            # leave the stage span open; the coordinator's finalize
+            # sweep (end_open_spans) closes it with the query
+            stage_span.end()
         return last_handle

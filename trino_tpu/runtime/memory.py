@@ -45,6 +45,9 @@ class MemoryPool:
         self._next_id = 0
         # per-query ledger (query_id -> bytes) for the low-memory killer
         self._by_query: Dict[str, int] = {}
+        # per-query high-water mark (never decremented; survives context
+        # close so the final QueryInfo can report peak memory)
+        self._query_peak: Dict[str, int] = {}
         # query_id -> kill message; doomed queries fail reservations
         self._doomed: Dict[str, str] = {}
         # ClusterMemoryManager hook: handler(pool, bytes_, query_id) ->
@@ -91,10 +94,27 @@ class MemoryPool:
                 return False
             self._reserved += bytes_
             if query_id is not None:
-                self._by_query[query_id] = (
-                    self._by_query.get(query_id, 0) + bytes_
-                )
+                now = self._by_query.get(query_id, 0) + bytes_
+                self._by_query[query_id] = now
+                if now > self._query_peak.get(query_id, 0):
+                    self._query_peak[query_id] = now
             return True
+
+    def query_peak_bytes(self, query_id: str) -> int:
+        """High-water mark of one query's reservation in this pool
+        (retained after the query drains; pruned by drop_query_peak so
+        the dict stays bounded across a long-lived worker)."""
+        with self._lock:
+            return self._query_peak.get(query_id, 0)
+
+    def query_peaks(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._query_peak)
+
+    def drop_query_peak(self, query_id: str) -> int:
+        """Retire a completed query's watermark, returning it."""
+        with self._lock:
+            return self._query_peak.pop(query_id, 0)
 
     def reserve(self, bytes_: int, for_ctx: Optional[int] = None,
                 query_id: Optional[str] = None) -> None:

@@ -245,6 +245,7 @@ class QueryTracker:
         kill issued this tick. A query already carrying an error is not
         re-killed (the kill latches)."""
         now = self._clock() if now is None else now
+        t_tick = time.monotonic()
         with self._lock:
             live = [
                 tq for tq in self._queries.values()
@@ -263,6 +264,9 @@ class QueryTracker:
                     tq.kill(str(err))
                 except Exception:
                     pass  # the latched error still fails the query
+        from trino_tpu.runtime.metrics import METRICS
+
+        METRICS.observe("tracker_tick_s", time.monotonic() - t_tick)
         return fired
 
     # -- background tick loop (live coordinators) --

@@ -222,6 +222,30 @@ class CoordinatorServer:
                 if parts == ["v1", "query"]:
                     self._json(200, outer.query_list(identity))
                     return
+                # per-query observability: aggregated QueryInfo and the
+                # Perfetto-loadable span tree (distributed runner only —
+                # getattr guards the local runner, which lacks the
+                # completed-query registry)
+                if len(parts) == 3 and parts[:2] == ["v1", "query"]:
+                    fn = getattr(outer.runner, "query_info", None)
+                    info = fn(parts[2]) if fn is not None else None
+                    if info is None:
+                        self._json(404, {"error": "unknown query"})
+                    else:
+                        self._json(200, info)
+                    return
+                if (
+                    len(parts) == 4
+                    and parts[:2] == ["v1", "query"]
+                    and parts[3] == "trace"
+                ):
+                    fn = getattr(outer.runner, "query_chrome_trace", None)
+                    tr = fn(parts[2]) if fn is not None else None
+                    if tr is None:
+                        self._json(404, {"error": "no trace for query"})
+                    else:
+                        self._json(200, tr)
+                    return
                 if len(parts) == 2 and parts[0] == "v1" and parts[1] == "info":
                     self._json(200, {"starting": False, "uptime": "n/a"})
                     return

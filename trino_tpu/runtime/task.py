@@ -69,6 +69,10 @@ class TaskSpec:
     # (operator, capacity, dtype) lowerings (compile/shapes.py)
     shape_stabilization: bool = True
     capacity_ladder_base: int = 2
+    # query tracing (runtime/tracing.py wire_context dict): when set the
+    # task records one operator span per operator, parented on the
+    # coordinator's task-attempt span, shipped back in terminal status
+    trace_ctx: Optional[dict] = None
 
 
 def _resolve_fetch(location):
@@ -157,6 +161,14 @@ class TaskExecution:
         # stall is possible, so silence means genuinely stuck
         self.shapes_warm: bool = False
         self._census_keys: frozenset = frozenset()
+        # observability: wall-clock bounds for TaskInfo; observed shape
+        # classes (expected-vs-observed lowerings per stage); the remote
+        # span recorder + wrapped operators when tracing is on
+        self.start_time: Optional[float] = None
+        self.end_time: Optional[float] = None
+        self._shape_ledger: set = set()
+        self._trace = None
+        self._instrumented: list = []
 
     def operator_stats(self):
         """JSON-ready [[dict]] per pipeline, or None."""
@@ -164,9 +176,39 @@ class TaskExecution:
 
         if self._stat_groups is None:
             return None
+        if self.state != "running":
+            # terminal: resolve deferred row counts so the final
+            # TaskInfo carries exact numbers even on the failure path,
+            # where the success-path close_span sweep never ran
+            for op in self._instrumented:
+                op.flush_counts()
         return [
             [_dc.asdict(s) for s in group] for group in self._stat_groups
         ]
+
+    def trace_spans(self):
+        """Exported operator span dicts (None when tracing is off).
+        The worker ships these only for TERMINAL tasks so the
+        coordinator never grafts a still-open span."""
+        if self._trace is None:
+            return None
+        return self._trace.export()["spans"]
+
+    def observed_shape_classes(self) -> int:
+        return len(self._shape_ledger)
+
+    def expected_shape_classes(self) -> int:
+        return len(self._census_keys)
+
+    def heartbeat(self) -> None:
+        """Operator-internal liveness beat (InstrumentedOperator fires
+        this at entry AND exit of every add_input/get_output/finish):
+        refreshes watchdog freshness at tens-of-ms granularity without
+        naming an operator, so it never ARMS the watchdog — arming
+        still requires a completed batch (_on_batch)."""
+        import time
+
+        self.last_progress_at = time.monotonic()
 
     @property
     def state(self) -> str:
@@ -322,6 +364,13 @@ class TaskExecution:
         # heartbeat starts at task start, not first batch: a task hung
         # before producing anything is still watchdog-visible
         self.last_progress_at = time.monotonic()
+        self.start_time = time.time()
+        if spec.trace_ctx is not None:
+            from trino_tpu.runtime.tracing import QueryTrace
+
+            self._trace = QueryTrace.remote(
+                spec.trace_ctx, query_id=spec.task_id.query_id
+            )
         from trino_tpu.runtime.metrics import set_compile_attribution
 
         prev_attr = set_compile_attribution(spec.task_id.query_id)
@@ -366,19 +415,46 @@ class TaskExecution:
                     spec.n_output_partitions,
                 )
             )
-            if spec.collect_stats:
-                # distributed EXPLAIN ANALYZE: per-operator stats travel
-                # back in task status (OperatorStats -> TaskInfo path)
-                from trino_tpu.exec.stats import instrument
+            # instrumentation is ALWAYS on: wall/batch counts, the
+            # operator-internal heartbeat, and the shape ledger are
+            # cheap (no device sync). Row counting (count_rows) forces a
+            # per-batch host sync, so it stays gated on collect_stats —
+            # EXPLAIN ANALYZE and query_trace=on set it, and the traced-
+            # off arm of the overhead gate is an honest baseline.
+            from trino_tpu.exec.stats import instrument
 
-                stat_groups = []
-                for p in pipelines:
-                    p.operators, stats = instrument(p.operators)
-                    stat_groups.append(stats)
-                chain, stats = instrument(chain)
+            span_factory = None
+            if self._trace is not None:
+                parent_id = spec.trace_ctx.get("span_id")
+                from trino_tpu.runtime.tracing import KIND_OPERATOR
+
+                def span_factory(op_name, _pid=parent_id):
+                    return self._trace.span(
+                        op_name, KIND_OPERATOR, parent=_pid,
+                        task=str(spec.task_id),
+                    )
+
+            def _wrap(ops):
+                wrapped, stats = instrument(
+                    ops,
+                    count_rows=spec.collect_stats,
+                    shape_ledger=self._shape_ledger,
+                    heartbeat=self.heartbeat,
+                    span_factory=span_factory,
+                )
+                self._instrumented.extend(wrapped)
+                return wrapped, stats
+
+            stat_groups = []
+            for p in pipelines:
+                p.operators, stats = _wrap(p.operators)
                 stat_groups.append(stats)
-                self._stat_groups = stat_groups
+            chain, stats = _wrap(chain)
+            stat_groups.append(stats)
+            self._stat_groups = stat_groups
             self._run_pipelines(pipelines, chain, spec.task_concurrency)
+            for op in self._instrumented:
+                op.close_span()
             from trino_tpu.engine import _raise_deferred_checks
 
             _raise_deferred_checks(ctx)
@@ -404,6 +480,11 @@ class TaskExecution:
             self.buffer.abort()
         finally:
             set_compile_attribution(prev_attr)
+            self.end_time = time.time()
+            if self._trace is not None:
+                # a failed/killed task still exports a fully-closed
+                # span set (the invariant checker rejects open spans)
+                self._trace.end_open_spans()
             # release every operator reservation: on a SHARED worker
             # pool a failed/killed task would otherwise leak its bytes
             # and poison the pool for every later query
@@ -471,10 +552,13 @@ class TaskExecution:
         for p in pipelines:
             drive(p)
         head = chain[0] if chain else None
+        # the head is wrapped by InstrumentedOperator — the concurrency
+        # split keys on the REAL operator underneath
+        head_inner = getattr(head, "inner", head)
         if (
             concurrency > 1
             and len(chain) > 1
-            and isinstance(head, RemoteSourceOperator)
+            and isinstance(head_inner, RemoteSourceOperator)
         ):
             # overlap remote-page pulls/deserialization with the device
             # compute downstream (the LocalExchange split)

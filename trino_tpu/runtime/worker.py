@@ -172,6 +172,19 @@ class Worker:
         stats = t.operator_stats()
         if stats is not None:
             out["stats"] = stats
+        # TaskInfo observability surface: wall bounds + lowering counts
+        if t.start_time is not None:
+            out["start_time"] = t.start_time
+        if t.end_time is not None:
+            out["end_time"] = t.end_time
+        out["shape_classes"] = t.observed_shape_classes()
+        out["expected_shape_classes"] = t.expected_shape_classes()
+        # operator spans ship only once the task is TERMINAL: grafting a
+        # still-open span would poison the coordinator's closed tree
+        if t.state in ("finished", "failed", "aborted"):
+            spans = t.trace_spans()
+            if spans is not None:
+                out["spans"] = spans
         return out
 
     def get_results(
@@ -222,12 +235,16 @@ class Worker:
         return self._tasks[str(task_id)].buffer.get_pages
 
     def status(self) -> dict:
-        return {
+        out = {
             "worker_id": self.worker_id,
             "state": self.state,
             "tasks": len(self.task_ids()),
             "running": self.running_tasks(),
         }
+        if self.memory_pool is not None:
+            # per-query peak watermarks for QueryInfo.peak_memory_bytes
+            out["query_peak_bytes"] = self.memory_pool.query_peaks()
+        return out
 
 
 def install_sigterm_self_drain(workers) -> Optional[object]:
