@@ -788,6 +788,7 @@ def _chaos_smoke(argv) -> int:
     from trino_tpu.runtime.chaos import (
         FAULT_CLASSES,
         LIFECYCLE_CLASSES,
+        SERVING_CLASSES,
         TIMEBOUND_CLASSES,
         chaos_smoke,
     )
@@ -795,7 +796,8 @@ def _chaos_smoke(argv) -> int:
     print(f"bench: chaos smoke seed={seed} "
           f"fault_classes={','.join(FAULT_CLASSES)} "
           f"lifecycle={','.join(LIFECYCLE_CLASSES)} "
-          f"timebound={','.join(TIMEBOUND_CLASSES)}")
+          f"timebound={','.join(TIMEBOUND_CLASSES)} "
+          f"serving={','.join(SERVING_CLASSES)}")
     t0 = time.time()
     violations = chaos_smoke(seed, CHAOS_QUERIES)
     wall = time.time() - t0
@@ -805,12 +807,78 @@ def _chaos_smoke(argv) -> int:
         "chaos_smoke": {
             "seed": seed,
             "cases": len(CHAOS_QUERIES) * len(FAULT_CLASSES)
-            + len(LIFECYCLE_CLASSES) + len(TIMEBOUND_CLASSES),
+            + len(LIFECYCLE_CLASSES) + len(TIMEBOUND_CLASSES)
+            + len(SERVING_CLASSES),
             "violations": len(violations),
             "wall_s": round(wall, 2),
         }
     }))
     return 1 if violations else 0
+
+
+# serve-smoke mix: the two analytic shapes the trace/chaos gates already
+# exercise, plus point lookups — the statement class the plan cache,
+# admission fast path, and micro-batcher were built for
+SERVE_QUERIES = {"q1": Q1, "q6": Q6}
+
+
+def _serve_flag(argv, name: str, default, cast=float):
+    if name in argv:
+        try:
+            return cast(argv[argv.index(name) + 1])
+        except (IndexError, ValueError):
+            pass
+    return default
+
+
+def _serve_smoke(argv) -> int:
+    """--serve-smoke [seed]: serving-tier gate. Drives the statement
+    protocol open-loop with >=8 concurrent clients on a q1/q6/point mix
+    and exits 0 iff every result is oracle-equal, nothing was shed,
+    the plan-cache hit rate stays >=90%, zero XLA lowerings happen
+    after warm-up, p99 <= 5x p50, and the batched phase coalesces
+    while staying oracle-equal."""
+    i = argv.index("--serve-smoke")
+    try:
+        seed = int(argv[i + 1])
+    except (IndexError, ValueError):
+        seed = 7
+    from trino_tpu.serving.harness import serve_smoke
+
+    n_clients = int(_serve_flag(argv, "--serve-clients", 8, int))
+    duration_s = _serve_flag(argv, "--serve-duration", 6.0)
+    print(f"bench: serve smoke seed={seed} clients={n_clients} "
+          f"duration={duration_s:g}s mix=q1,q6,point")
+    t0 = time.time()
+    report, violations = serve_smoke(
+        SERVE_QUERIES, n_clients=n_clients, duration_s=duration_s,
+        seed=seed,
+    )
+    for v in violations:
+        print(f"bench: serve VIOLATION: {v}", file=sys.stderr)
+    report["violations"] = len(violations)
+    report["wall_total_s"] = round(time.time() - t0, 2)
+    print(json.dumps({"serve_smoke": report}))
+    return 1 if violations else 0
+
+
+def _serve(argv) -> int:
+    """--serve: tunable open-loop load run (no gates, just the report).
+    Knobs: --serve-clients N --serve-duration S --serve-rate QPS
+    --serve-util U --serve-window MS --serve-seed N."""
+    from trino_tpu.serving.harness import run_serve_load
+
+    report = run_serve_load(
+        queries=SERVE_QUERIES,
+        n_clients=int(_serve_flag(argv, "--serve-clients", 8, int)),
+        duration_s=_serve_flag(argv, "--serve-duration", 6.0),
+        rate_qps=_serve_flag(argv, "--serve-rate", None),
+        utilization=_serve_flag(argv, "--serve-util", 0.5),
+        micro_batch_window_ms=_serve_flag(argv, "--serve-window", 3.0),
+        seed=int(_serve_flag(argv, "--serve-seed", 7, int)),
+    )
+    print(json.dumps({"serve": report}))
+    return 0
 
 
 def _parse_compile_lines(text: str) -> dict:
@@ -1131,6 +1199,10 @@ def _validate_corpus(argv) -> int:
 
 
 def main() -> None:
+    if "--serve-smoke" in sys.argv:
+        sys.exit(_serve_smoke(sys.argv))
+    if "--serve" in sys.argv:
+        sys.exit(_serve(sys.argv))
     if "--chaos-smoke" in sys.argv:
         sys.exit(_chaos_smoke(sys.argv))
     if "--warmup-smoke" in sys.argv:

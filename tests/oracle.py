@@ -25,9 +25,29 @@ def epoch_days(s: str) -> int:
     return (datetime.date(y, m, d) - datetime.date(1970, 1, 1)).days
 
 
+# template cache: ~10 tier-1 modules each load the SAME sf=0.01 TPC-H
+# dataset at module scope; generating it costs ~1.3s per module where a
+# sqlite backup-copy from a shared template costs ~4ms. Templates are
+# never handed out — every caller still gets its own connection to
+# mutate (the DML tests depend on that isolation).
+_TPCH_TEMPLATES: Dict[tuple, sqlite3.Connection] = {}
+
+
 def load_tpch_sqlite(conn: sqlite3.Connection, sf: float, tables: Sequence[str] = None):
     """Load generated TPC-H data into sqlite tables (same generator, so
-    the oracle sees byte-identical data)."""
+    the oracle sees byte-identical data). Loads are served from an
+    in-process template cache keyed by (sf, tables)."""
+    key = (sf, tuple(tables) if tables else None)
+    tmpl = _TPCH_TEMPLATES.get(key)
+    if tmpl is None:
+        tmpl = sqlite3.connect(":memory:", check_same_thread=False)
+        _generate_tpch_sqlite(tmpl, sf, tables)
+        _TPCH_TEMPLATES[key] = tmpl
+    tmpl.backup(conn)
+    conn.commit()
+
+
+def _generate_tpch_sqlite(conn: sqlite3.Connection, sf: float, tables: Sequence[str] = None):
     for table in tables or TABLES:
         cols = TABLES[table]
         coldefs = ", ".join(
@@ -94,6 +114,27 @@ def load_tpcds_sqlite(conn: sqlite3.Connection, sf: float, tables: Sequence[str]
 
 def sqlite_rows(conn: sqlite3.Connection, sql: str) -> List[tuple]:
     return [tuple(r) for r in conn.execute(sql).fetchall()]
+
+
+# memoized oracle answers, keyed by (sf, tables, sql): the TPC-H/window
+# cross-check suites ask the SAME oracle queries against the SAME
+# immutable template data in several modules. Only for read-only use —
+# anything that mutates its database must query its own connection.
+_ORACLE_ROWS: Dict[tuple, List[tuple]] = {}
+
+
+def oracle_rows(sf: float, sql: str, tables: Sequence[str] = None) -> List[tuple]:
+    key = (sf, tuple(tables) if tables else None, sql)
+    hit = _ORACLE_ROWS.get(key)
+    if hit is None:
+        tkey = (sf, tuple(tables) if tables else None)
+        tmpl = _TPCH_TEMPLATES.get(tkey)
+        if tmpl is None:
+            tmpl = sqlite3.connect(":memory:", check_same_thread=False)
+            _generate_tpch_sqlite(tmpl, sf, tables)
+            _TPCH_TEMPLATES[tkey] = tmpl
+        hit = _ORACLE_ROWS[key] = sqlite_rows(tmpl, sql)
+    return hit
 
 
 def assert_rows_match(actual: List[list], expected: List[tuple], ordered: bool,

@@ -166,6 +166,25 @@ for _name, _type, _default, _desc, _allowed in [
      "predicted shape classes are all warm (warmup/cache hits or a "
      "prior completed run); 0 falls back to stuck_task_interrupt_s",
      None),
+    # -- serving tier (trino_tpu/serving/) --
+    ("plan_cache_entries", int, 256,
+     "LRU bound of the prepared-statement plan cache (canonical text + "
+     "plan-shaping properties + parameter dtype vector keyed)", None),
+    ("micro_batch_window_ms", float, 0.0,
+     "inter-query micro-batching: coalesce same-shape point lookups "
+     "arriving within this window onto one shared device step; 0 "
+     "disables batching", None),
+    ("micro_batch_max", int, 16,
+     "max point lookups coalesced into one shared device step", None),
+    ("admission_fast_depth", int, 64,
+     "max in-flight submissions in the fast admission lane "
+     "(cached-plan point queries); arrivals beyond it are shed with "
+     "429 + Retry-After", None),
+    ("admission_general_depth", int, 256,
+     "max in-flight submissions in the general admission lane; "
+     "arrivals beyond it are shed with 429 + Retry-After", None),
+    ("admission_retry_after_s", float, 1.0,
+     "Retry-After hint returned with shed (429) submissions", None),
     # -- observability (runtime/tracing.py) --
     ("query_trace", str, "off",
      "record a full span tree per query (phases, stages, task attempts, "

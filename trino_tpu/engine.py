@@ -126,6 +126,15 @@ class Session:
     # tree (phases/stages/task attempts/operators; worker spans grafted
     # into the coordinator's) for GET /v1/query/{id}/trace
     query_trace: str = "off"
+    # serving tier (trino_tpu/serving/): plan-cache LRU bound,
+    # micro-batch coalescing window (0 = batching off) + per-flush cap,
+    # and the admission lanes' queue depths / shed Retry-After hint
+    plan_cache_entries: int = 256
+    micro_batch_window_ms: float = 0.0
+    micro_batch_max: int = 16
+    admission_fast_depth: int = 64
+    admission_general_depth: int = 256
+    admission_retry_after_s: float = 1.0
 
     def set_property(self, name: str, value) -> None:
         """SET SESSION entry point — validated through the typed
@@ -183,10 +192,23 @@ class LocalQueryRunner:
         # HTTP protocol's prepared-statement headers mirror this
         self._prepared: Dict[str, tuple] = {}
         self._request_prepared: Optional[Dict[str, str]] = None
-        # SQL text -> (OutputNode, PhysicalPlan): re-executing a cached
-        # query reuses every jitted device program (the reference's
-        # expression/operator caches keyed on expression, §2.9)
-        self._plan_cache: dict = {}
+        # canonical text -> (OutputNode, PhysicalPlan): re-executing a
+        # cached query reuses every jitted device program (the
+        # reference's expression/operator caches keyed on expression,
+        # §2.9); serving/plan_cache.py owns keying/LRU/counters
+        from trino_tpu.serving.plan_cache import PlanCache
+
+        self._plan_cache = PlanCache(
+            max_entries=getattr(self.session, "plan_cache_entries", 256)
+        )
+        # dtype vector of the current EXECUTE's bound parameters (part
+        # of the plan-cache key; set around the re-dispatch). Thread-
+        # local: the HTTP server runs concurrent statements on one
+        # runner, and one thread's EXECUTE must not perturb another
+        # thread's cache key.
+        import threading as _threading
+
+        self._bound_dtypes_tls = _threading.local()
         from trino_tpu.runtime.events import EventListenerManager
 
         self.event_listeners = EventListenerManager()
@@ -321,16 +343,26 @@ class LocalQueryRunner:
                 raise ValueError(
                     f"Prepared statement not found: {stmt.name}"
                 )
-            body = ast.substitute_parameters(hit[0], stmt.parameters)
-            # plan-cache key must identify the PREPARED text + bound
-            # parameters — distinct statements can share one EXECUTE
-            # text (the dbapi always names its statement "stmt")
-            from trino_tpu.sql.formatter import format_expression
+            # typed binding check BEFORE substitution: arity and dtype
+            # mismatches fail here with position/expected/got instead of
+            # surfacing as an analyzer error deep inside the spliced
+            # statement (serving/params.py)
+            from trino_tpu.serving.params import check_parameters
 
-            pkey = hit[1] + " /*USING*/ " + ",".join(
-                format_expression(pv) for pv in stmt.parameters
+            dtypes = check_parameters(
+                hit[0], stmt.parameters, self.catalogs,
+                self.session.catalog, self.session.schema,
             )
-            return self._dispatch(body, pkey, active, explicit)
+            body = ast.substitute_parameters(hit[0], stmt.parameters)
+            # the plan-cache key canonicalizes the BOUND statement, so
+            # distinct bindings plan separately; the dtype vector rides
+            # along as its own key component (serving/plan_cache.py)
+            prior = getattr(self._bound_dtypes_tls, "value", None)
+            self._bound_dtypes_tls.value = tuple(dtypes)
+            try:
+                return self._dispatch(body, sql, active, explicit)
+            finally:
+                self._bound_dtypes_tls.value = prior
         if isinstance(stmt, ast.Deallocate):
             if stmt.name not in self._prepared:
                 raise ValueError(
@@ -551,7 +583,7 @@ class LocalQueryRunner:
         """Cached physical plans capture split lists (data snapshots) at
         plan time, so any write/DDL invalidates them — the analogue of
         the reference re-planning every query against current metadata."""
-        self._plan_cache.clear()
+        self._plan_cache.invalidate()
 
     # -- DML (BeginTableWrite/TableWriter/TableFinish path) --
     def _resolve_target(self, parts):
@@ -1146,20 +1178,23 @@ class LocalQueryRunner:
 
             return query_span.child(name, KIND_PHASE)
 
-        # cache key includes the plan-shaping session properties, so
-        # set_property takes effect however it was invoked
+        # the key canonicalizes the statement through the formatter
+        # (fixpoint-checked in PR 5) and folds in the plan-shaping
+        # session properties + bound-parameter dtypes, so SET SESSION
+        # and EXECUTE bindings take effect however they were invoked
         cache_key = None
         if sql_key is not None:
-            cache_key = (
-                sql_key,
-                self.session.batch_rows,
-                self.session.target_splits,
-                self.session.enable_dynamic_filtering,
-                self.session.enable_pushdown,
-                self.session.shape_stabilization,
-                self.session.capacity_ladder_base,
+            try:
+                from trino_tpu.sql.formatter import format_statement
+
+                canonical = format_statement(q)
+            except Exception:
+                canonical = sql_key
+            cache_key = self._plan_cache.key(
+                canonical, self.session,
+                getattr(self._bound_dtypes_tls, "value", None) or (),
             )
-        cached = self._plan_cache.get(cache_key) if cache_key else None
+        cached = self._plan_cache.lookup(cache_key) if cache_key else None
         if cached is not None:
             # access control re-checks on every execution, cached or not
             self._check_scans(cached[0])
@@ -1169,6 +1204,9 @@ class LocalQueryRunner:
             reset_volatile_plan,
         )
 
+        # snapshot the generation BEFORE planning: a DDL landing while
+        # we plan must win over our store below
+        cache_generation = self._plan_cache.generation
         reset_volatile_plan()
         with phase("analyze"):
             output = self._analyze(q)
@@ -1185,7 +1223,9 @@ class LocalQueryRunner:
         # plans with analysis-time-folded volatile values (now(),
         # current_date, uuid()) re-analyze every execution
         if cache_key and not plan_is_volatile():
-            self._plan_cache[cache_key] = (output, physical)
+            self._plan_cache.store(
+                cache_key, (output, physical), generation=cache_generation
+            )
         return output, physical
 
     def _execution_ctx(self) -> dict:

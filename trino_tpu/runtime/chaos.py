@@ -64,6 +64,16 @@ TIMEBOUND_CLASSES = (
     "abandoned_client",
 )
 
+# serving scenarios (PR 8): faults injected while a POPULATION of
+# concurrent HTTP clients is mid-traffic, not around one query in
+# isolation — recovery must stay correct when retries contend with
+# live load for workers, memory, and admission slots. Every query must
+# end oracle-equal, shed (429), or as a TYPED failure, and every client
+# thread must come back (no hangs). Run via run_loaded_cluster_case.
+SERVING_CLASSES = (
+    "loaded_cluster",
+)
+
 
 def generate_schedule(
     seed: int,
@@ -549,6 +559,118 @@ class ChaosHarness:
             self.injector.clear()
             server.stop()
 
+    def run_loaded_cluster_case(
+        self, queries: Dict[str, str], seed: int = 0,
+        n_clients: int = 6, duration_s: float = 3.0,
+        join_timeout_s: float = 45.0,
+    ) -> Tuple[None, dict]:
+        """Faults under LIVE concurrent load, through the HTTP serving
+        path end to end (admission lanes, plan cache, statement
+        protocol). N client threads drive the query mix closed-loop
+        while the fault schedule lands mid-traffic; every completion is
+        checked against the clean-run oracle. Acceptable per-query
+        outcomes: oracle-equal rows, an overload shed (HTTP 429), or a
+        TYPED failure (a bracketed error code the client can act on).
+        An untyped error or a client thread that never returns is a
+        violation — under concurrency, a silent hang is the failure
+        mode this case exists to catch."""
+        import re
+        import urllib.error
+
+        from trino_tpu.client import Client, QueryError
+        from trino_tpu.runtime.server import CoordinatorServer
+
+        rng = random.Random(seed)
+        self.injector.clear()
+        oracle = {n: self.run_clean(sql) for n, sql in queries.items()}
+        ordered = {
+            n: "order by" in sql.lower() for n, sql in queries.items()
+        }
+        server = CoordinatorServer(self.runner, max_concurrent=n_clients)
+        lock = threading.Lock()
+        stats = {
+            "completed": 0, "ok": 0, "mismatches": 0, "sheds": 0,
+            "typed_failures": 0, "untyped_errors": [], "hung_threads": 0,
+        }
+        typed = re.compile(r"\[[A-Z][A-Z_]+\]")
+        stop_at = time.monotonic() + duration_s
+
+        def client_loop(i: int):
+            r = random.Random(seed * 997 + i)
+            c = Client(server.uri, timeout=30.0, poll_interval=0.005)
+            names = list(queries)
+            while time.monotonic() < stop_at:
+                name = r.choice(names)
+                try:
+                    rows = c.execute(queries[name]).rows
+                    with lock:
+                        stats["completed"] += 1
+                        if rows_equal(rows, oracle[name],
+                                      ordered=ordered[name]):
+                            stats["ok"] += 1
+                        else:
+                            stats["mismatches"] += 1
+                except urllib.error.HTTPError as e:
+                    with lock:
+                        stats["completed"] += 1
+                        if e.code == 429:
+                            stats["sheds"] += 1
+                        else:
+                            stats["untyped_errors"].append(
+                                f"{name}: HTTP {e.code}"
+                            )
+                except QueryError as e:
+                    with lock:
+                        stats["completed"] += 1
+                        if typed.search(str(e)):
+                            stats["typed_failures"] += 1
+                        else:
+                            stats["untyped_errors"].append(
+                                f"{name}: {e}"
+                            )
+                except Exception as e:
+                    with lock:
+                        stats["completed"] += 1
+                        stats["untyped_errors"].append(
+                            f"{name}: {type(e).__name__}: {e}"
+                        )
+
+        threads = [
+            threading.Thread(target=client_loop, args=(i,), daemon=True)
+            for i in range(n_clients)
+        ]
+        try:
+            for t in threads:
+                t.start()
+            # let traffic establish, then land a burst of every injector
+            # fault class MID-FLIGHT; clear before the phase ends so the
+            # tail of the run proves the cluster comes back clean
+            time.sleep(min(0.4, duration_s / 4))
+            for fc in ("task_crash_start", "task_crash_mid",
+                       "fetch_loss", "oom"):
+                for rule in generate_schedule(rng.randrange(1 << 20), fc):
+                    self.injector.inject(**rule)
+            # and a lifecycle maneuver on top: gracefully drain one
+            # worker out from under the live population (one-way, so
+            # the remaining nodes carry the tail of the run)
+            drain_ok = self.runner.drain(
+                self.workers[rng.randrange(len(self.workers))].worker_id,
+                timeout_s=30.0,
+            )
+            time.sleep(min(1.0, duration_s / 2))
+            self.injector.clear()
+            deadline = time.monotonic() + duration_s + join_timeout_s
+            for t in threads:
+                t.join(max(0.1, deadline - time.monotonic()))
+            stats["hung_threads"] = sum(t.is_alive() for t in threads)
+        finally:
+            self.injector.clear()
+            server.stop()
+        stats["drained"] = bool(drain_ok)
+        stats["untyped_error_count"] = len(stats["untyped_errors"])
+        stats["untyped_errors"] = stats["untyped_errors"][:5]
+        return None, stats
+
 
 def chaos_smoke(
     seed: int,
@@ -739,4 +861,53 @@ def chaos_smoke(
                     f"peak_reserved={report['peak_reserved_bytes']} "
                     f"ledgers_drained=True rg_running=0"
                 )
+    # serving scenario (PR 8): the same fault classes, but landing on a
+    # cluster that is actively serving a concurrent client population
+    # through the HTTP path — fresh harness (faults + server leftovers)
+    for scenario in SERVING_CLASSES:
+        h = ChaosHarness(n_workers=3)
+        h.register_catalog("tpch", create_tpch_connector())
+        try:
+            _, report = h.run_loaded_cluster_case(queries, seed)
+        except Exception as e:
+            failures.append(
+                f"serving/{scenario}: raised {type(e).__name__}: {e}"
+            )
+            continue
+        if report["completed"] == 0:
+            failures.append(
+                f"serving/{scenario}: no query completed under load"
+            )
+        if report["ok"] == 0:
+            failures.append(
+                f"serving/{scenario}: zero oracle-equal results "
+                f"({report})"
+            )
+        if report["mismatches"]:
+            failures.append(
+                f"serving/{scenario}: {report['mismatches']} results "
+                f"diverged from clean run under faults"
+            )
+        if report["untyped_error_count"]:
+            failures.append(
+                f"serving/{scenario}: {report['untyped_error_count']} "
+                f"untyped errors (first: {report['untyped_errors'][:1]})"
+            )
+        if report["hung_threads"]:
+            failures.append(
+                f"serving/{scenario}: {report['hung_threads']} client "
+                f"threads never returned"
+            )
+        if not report["drained"]:
+            failures.append(
+                f"serving/{scenario}: mid-traffic drain timed out"
+            )
+        if verbose:
+            print(
+                f"  chaos serving/{scenario}: ok "
+                f"completed={report['completed']} ok={report['ok']} "
+                f"sheds={report['sheds']} "
+                f"typed_failures={report['typed_failures']} "
+                f"drained={report['drained']} hung=0"
+            )
     return failures

@@ -46,6 +46,7 @@ class QueryScheduler:
         collect_stats: bool = False,
         trace=None,
         query_span=None,
+        deadline_epoch_s: Optional[float] = None,
     ):
         self.query_id = query_id
         self.subplan = subplan
@@ -53,6 +54,7 @@ class QueryScheduler:
         self.catalogs = catalogs
         self.session = session
         self.collect_stats = collect_stats
+        self.deadline_epoch_s = deadline_epoch_s
         self.hash_partitions = hash_partitions or min(
             len(workers), session.hash_partition_count
         )
@@ -162,6 +164,7 @@ class QueryScheduler:
                     capacity_ladder_base=getattr(
                         self.session, "capacity_ladder_base", 2
                     ),
+                    deadline_epoch_s=self.deadline_epoch_s,
                 )
                 if tracing:
                     tspan = self.stage_spans[f.id].child(
@@ -386,6 +389,16 @@ class DistributedQueryRunner:
         from trino_tpu.runtime.metrics import install_xla_compile_listener
 
         install_xla_compile_listener()
+        # serving tier: canonical-text plan cache over the distributed
+        # planning pipeline (analyze -> optimize -> fragment). DDL/DML
+        # through the embedded runner and catalog registration
+        # invalidate wholesale — fragments capture table handles whose
+        # split listings describe a data snapshot.
+        from trino_tpu.serving.plan_cache import PlanCache
+
+        self._plan_cache = PlanCache(
+            max_entries=getattr(self.session, "plan_cache_entries", 256)
+        )
         import collections
 
         self._completed_queries = collections.OrderedDict()
@@ -426,6 +439,7 @@ class DistributedQueryRunner:
 
     def register_catalog(self, name: str, connector: Connector) -> None:
         self.catalogs.register(name, connector)
+        self._plan_cache.invalidate()
 
     def _embedded_runner(self):
         if getattr(self, "_embedded", None) is None:
@@ -487,15 +501,51 @@ class DistributedQueryRunner:
             return MaterializedResult(
                 [[self._explain_text(subplan)]], ["Query Plan"], [T.VARCHAR]
             )
+        param_dtypes: tuple = ()
+        if isinstance(stmt, ast.ExecuteStmt):
+            # EXECUTE of a prepared Query runs DISTRIBUTED: resolve the
+            # text (request-carried headers take precedence over the
+            # shared embedded store, mirroring LocalQueryRunner), check
+            # the binding up front (typed arity/dtype errors instead of
+            # analyzer failures deep in the substituted tree), then fall
+            # through with the bound statement and its dtype vector as a
+            # plan-cache key component
+            text = (prepared or {}).get(stmt.name)
+            if text is None:
+                hit = self._embedded_runner()._prepared.get(stmt.name)
+                text = hit[1] if hit else None
+            if text is not None:
+                from trino_tpu.serving.params import check_parameters
+
+                body = parse(text)
+                dtypes = check_parameters(
+                    body, stmt.parameters, self.catalogs,
+                    self.session.catalog, self.session.schema,
+                )
+                bound = ast.substitute_parameters(body, stmt.parameters)
+                if isinstance(bound, ast.Query):
+                    stmt = bound
+                    param_dtypes = tuple(dtypes)
+            # unknown name / non-Query body: the embedded path below
+            # reports or runs it
         if not isinstance(stmt, ast.Query):
             # metadata/DML/transaction statements take the single-node
             # path — through ONE persistent embedded runner, so
             # transaction state survives across statements (a throwaway
             # runner per statement would silently autocommit)
-            return self._embedded_runner().execute(
+            result = self._embedded_runner().execute(
                 sql, identity=identity,
                 transaction_id=transaction_id, prepared=prepared,
             )
+            if isinstance(stmt, (
+                ast.CreateTable, ast.CreateTableAs, ast.Insert,
+                ast.Delete, ast.Update, ast.Merge, ast.DropTable,
+                ast.Commit, ast.Rollback,
+            )):
+                # cached plans captured split listings over data this
+                # statement may have changed
+                self._plan_cache.invalidate()
+            return result
         from trino_tpu.runtime.query_tracker import DeadlineLimits, PLANNING
 
         limits = DeadlineLimits.from_session(self.session)
@@ -543,7 +593,7 @@ class DistributedQueryRunner:
         try:
             result = self._execute_query(
                 stmt, identity, base_qid, tq, limits, cancel,
-                trace=trace, query_span=qspan,
+                trace=trace, query_span=qspan, param_dtypes=param_dtypes,
             )
             rows_n = len(result.rows)
             return result
@@ -563,7 +613,7 @@ class DistributedQueryRunner:
 
     def _execute_query(
         self, stmt, identity, base_qid, tq, limits, cancel,
-        trace=None, query_span=None,
+        trace=None, query_span=None, param_dtypes=(),
     ) -> MaterializedResult:
         from trino_tpu.runtime.query_tracker import (
             EXECUTING,
@@ -581,30 +631,82 @@ class DistributedQueryRunner:
             return query_span.child(name, KIND_PHASE)
 
         tracker = self.query_tracker
-        output = self._analyze(stmt, query_span=query_span)
         # reset BEFORE any plane decision: a stale reason from an earlier
         # query must not read as applying to this one
         self.last_mesh_fallback = None
-        self._check_access(output, identity)
-        with phase("fragment"):
-            subplan = plan_distributed(
-                output,
-                self.catalogs,
-                broadcast_threshold=self.session.broadcast_join_threshold,
-                target_splits=self.session.target_splits,
-                validation=getattr(
-                    self.session, "plan_validation", "passes"
-                ),
+        cache_key = None
+        try:
+            from trino_tpu.sql.formatter import format_statement
+
+            cache_key = self._plan_cache.key(
+                format_statement(stmt), self.session, param_dtypes
             )
+        except Exception:
+            pass  # unformattable statement: plan uncached
+        cached = self._plan_cache.lookup(cache_key) if cache_key else None
+        if cached is not None:
+            output, subplan = cached
+            # access control is NOT part of the key: the cached logical
+            # plan is re-checked under THIS caller's identity
+            self._check_access(output, identity)
+            if query_span is not None:
+                query_span.event("plan_cache_hit")
+        else:
+            from trino_tpu.sql.analyzer import (
+                plan_is_volatile,
+                reset_volatile_plan,
+            )
+
+            # snapshot BEFORE planning: a catalog change racing the
+            # analyze/optimize/fragment work below must void this store
+            cache_generation = self._plan_cache.generation
+            reset_volatile_plan()
+            output = self._analyze(stmt, query_span=query_span)
+            self._check_access(output, identity)
+            with phase("fragment"):
+                subplan = plan_distributed(
+                    output,
+                    self.catalogs,
+                    broadcast_threshold=self.session.broadcast_join_threshold,
+                    target_splits=self.session.target_splits,
+                    validation=getattr(
+                        self.session, "plan_validation", "passes"
+                    ),
+                )
+            if cache_key is not None and not plan_is_volatile():
+                self._plan_cache.store(
+                    cache_key, (output, subplan),
+                    generation=cache_generation,
+                )
         # planning is over: surface a planning-limit kill latched during
         # the analyze/optimize/fragment work before any task launches
         tracker.check(base_qid)
         tracker.transition(base_qid, EXECUTING)
+        # worker-local deadline: translate the query's remaining wall
+        # budget into the epoch-seconds deadline every TaskSpec carries,
+        # so workers self-terminate between batches instead of waiting
+        # for the coordinator's enforcement tick to reach them
+        deadline_epoch_s = None
+        if limits is not None:
+            import time as _time
+
+            budgets = []
+            if limits.max_execution_time_s:
+                budgets.append(limits.max_execution_time_s)
+            if limits.max_run_time_s:
+                budgets.append(max(
+                    0.0,
+                    limits.max_run_time_s
+                    - (_time.monotonic() - tq.created_at),
+                ))
+            if budgets:
+                deadline_epoch_s = _time.time() + min(budgets)
         result_meta = (list(output.names), [f.type for f in output.fields])
         if self.session.retry_policy == "task":
             rows = self._execute_fte(
                 subplan, query_id=base_qid, cancel=cancel, tq=tq,
                 trace=trace, query_span=query_span,
+                deadline_epoch_s=deadline_epoch_s,
             )
             return MaterializedResult(rows, *result_meta, data_plane="fte")
         if self.session.mesh_execution and self._mesh_colocated():
@@ -698,6 +800,7 @@ class DistributedQueryRunner:
                 ),
                 trace=trace,
                 query_span=query_span,
+                deadline_epoch_s=deadline_epoch_s,
             )
             # the CPU budget reads the live attempt's task ledgers on
             # top of what earlier attempts already burned
@@ -790,7 +893,7 @@ class DistributedQueryRunner:
 
     def _execute_fte(
         self, subplan, query_id=None, cancel=None, tq=None,
-        trace=None, query_span=None,
+        trace=None, query_span=None, deadline_epoch_s=None,
     ) -> List[list]:
         """retry_policy=TASK: FTE over the spooled exchange."""
         import shutil
@@ -817,6 +920,7 @@ class DistributedQueryRunner:
                 collect_stats=(
                     getattr(self.session, "query_trace", "off") == "on"
                 ),
+                deadline_epoch_s=deadline_epoch_s,
             )
             if tq is not None:
                 # CPU budget over the FTE attempt ledgers (polled task

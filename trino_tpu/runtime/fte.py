@@ -61,8 +61,10 @@ class FaultTolerantQueryScheduler:
         trace=None,
         query_span=None,
         collect_stats: bool = False,
+        deadline_epoch_s: Optional[float] = None,
     ):
         self.query_id = query_id
+        self.deadline_epoch_s = deadline_epoch_s
         self.subplan = subplan
         self.workers = workers
         self.catalogs = catalogs
@@ -333,6 +335,7 @@ class FaultTolerantQueryScheduler:
                     self.session, "capacity_ladder_base", 2
                 ),
                 collect_stats=self.collect_stats,
+                deadline_epoch_s=self.deadline_epoch_s,
             )
             if tspan is not None and self.collect_stats:
                 # operator spans only under query_trace=on: the wire

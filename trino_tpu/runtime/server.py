@@ -111,12 +111,45 @@ class CoordinatorServer:
         authenticator=None,  # security.Authenticator; None = insecure
         client_timeout_s: Optional[float] = None,
         reap_interval_s: Optional[float] = None,
+        admission=None,  # serving.admission.AdmissionPipeline
+        batcher=None,  # serving.batcher.MicroBatcher
     ):
         from trino_tpu.security import AuthenticationError, InsecureAuthenticator
 
         self.runner = runner
         self.resource_groups = resource_groups
         self.authenticator = authenticator or InsecureAuthenticator()
+        # serving tier: lane-based admission (shed with 429 instead of
+        # queueing without bound) and optional point-lookup coalescing
+        _sess = getattr(runner, "session", None)
+        if admission is None:
+            from trino_tpu.serving.admission import AdmissionPipeline
+
+            admission = AdmissionPipeline(
+                resource_groups,
+                fast_depth=int(
+                    getattr(_sess, "admission_fast_depth", 64) or 64
+                ),
+                general_depth=int(
+                    getattr(_sess, "admission_general_depth", 256) or 256
+                ),
+                retry_after_s=float(
+                    getattr(_sess, "admission_retry_after_s", 1.0) or 1.0
+                ),
+            )
+        self.admission = admission
+        _window_ms = float(
+            getattr(_sess, "micro_batch_window_ms", 0.0) or 0.0
+        )
+        if batcher is None and _window_ms > 0:
+            from trino_tpu.serving.batcher import MicroBatcher
+
+            batcher = MicroBatcher(
+                runner,
+                window_s=_window_ms / 1000.0,
+                max_batch=int(getattr(_sess, "micro_batch_max", 16) or 16),
+            )
+        self.batcher = batcher
         self._jobs: Dict[str, _QueryJob] = {}
         self._pool = ThreadPoolExecutor(max_workers=max_concurrent)
         # client-abandonment TTL: explicit arg wins, else the runner
@@ -135,11 +168,13 @@ class CoordinatorServer:
             def log_message(self, *a):
                 pass
 
-            def _json(self, code: int, obj) -> None:
+            def _json(self, code: int, obj, headers=None) -> None:
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, str(v))
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -189,7 +224,24 @@ class CoordinatorServer:
                             if "=" in part:
                                 k, v = part.split("=", 1)
                                 prepared[k.strip()] = _up.unquote(v)
-                    job = outer._submit(sql, identity, txn, prepared)
+                    from trino_tpu.serving.admission import (
+                        OverloadSheddedError,
+                    )
+
+                    try:
+                        job = outer._submit(sql, identity, txn, prepared)
+                    except OverloadSheddedError as ex:
+                        # shed at admission: the client backs off and
+                        # retries instead of growing an unbounded queue
+                        self._json(
+                            429,
+                            {"error": {
+                                "message": str(ex),
+                                "errorName": "SERVER_OVERLOADED",
+                            }},
+                            headers={"Retry-After": f"{ex.retry_after_s:g}"},
+                        )
+                        return
                     self._json(200, outer._response(job, 0))
                     return
                 self._json(404, {"error": "no route"})
@@ -414,8 +466,16 @@ class CoordinatorServer:
     def _submit(self, sql: str, identity=None, transaction_id="NONE",
                 prepared=None) -> _QueryJob:
         from trino_tpu.runtime.metrics import METRICS
+        from trino_tpu.serving.admission import fast_path_probe
 
         self._evict_completed()
+        # synchronous shed point, BEFORE a job exists: cached-plan point
+        # lookups ride the short fast lane, everything else the general
+        # lane; a full lane raises OverloadSheddedError (HTTP 429) here
+        # on the request thread
+        reservation = self.admission.reserve(
+            fast=fast_path_probe(self.runner, sql, prepared)
+        )
         job = _QueryJob(
             uuid.uuid4().hex[:16], sql, getattr(identity, "user", None)
         )
@@ -423,17 +483,15 @@ class CoordinatorServer:
         METRICS.increment("queries.submitted")
 
         def run():
-            lease = None
             try:
-                if self.resource_groups is not None:
-                    # admission queueing (resource-group submit path); a
-                    # DELETE or client-abandon while queued flips
-                    # job.abandoned and acquire withdraws the ticket —
-                    # slot released, the query never runs
-                    lease = self.resource_groups.acquire(
-                        user=job.user or "user",
-                        cancelled=lambda: job.abandoned,
-                    )
+                # resource-group queueing (lane passed as selector
+                # source); a DELETE or client-abandon while queued flips
+                # job.abandoned and acquire withdraws the ticket — slot
+                # released, the query never runs
+                self.admission.wait(
+                    reservation, user=job.user or "user",
+                    cancelled=lambda: job.abandoned,
+                )
                 with job.lock:
                     if job.abandoned:
                         return  # expired while queued: don't run or revive
@@ -476,7 +534,15 @@ class CoordinatorServer:
                         kwargs["cancel"] = lambda: job.abandoned
                 except (TypeError, ValueError):
                     pass
-                result = self.runner.execute(sql, **kwargs)
+                result = None
+                if self.batcher is not None:
+                    # point lookups coalesce onto one shared device step
+                    # (None = not batchable: normal execution below)
+                    result = self.batcher.submit(
+                        sql, identity=identity, prepared=prepared or None
+                    )
+                if result is None:
+                    result = self.runner.execute(sql, **kwargs)
                 with job.lock:
                     if job.abandoned:
                         return  # expired while executing: keep the verdict
@@ -515,8 +581,7 @@ class CoordinatorServer:
                     if head.startswith("COMMIT") or head.startswith("ROLLBACK"):
                         job.cleared_transaction = True
             finally:
-                if lease is not None:
-                    self.resource_groups.release(lease)
+                self.admission.release(reservation)
 
         self._pool.submit(run)
         return job

@@ -73,6 +73,13 @@ class TaskSpec:
     # task records one operator span per operator, parented on the
     # coordinator's task-attempt span, shipped back in terminal status
     trace_ctx: Optional[dict] = None
+    # worker-LOCAL deadline (wall-clock epoch seconds, so the value
+    # survives crossing a process boundary): the driver checks it at
+    # every batch boundary and fails the task itself instead of waiting
+    # for the coordinator's enforcement tick to reach across the wire.
+    # Carries the EXCEEDED_TIME_LIMIT code so the coordinator re-types
+    # the travelled string as non-retryable. None = no local deadline.
+    deadline_epoch_s: Optional[float] = None
 
 
 def _resolve_fetch(location):
@@ -262,6 +269,21 @@ class TaskExecution:
         ct = time.thread_time()
         base = self._cpu_base.setdefault(tid, ct)
         self._cpu_by_thread[tid] = ct - base
+        deadline = self.spec.deadline_epoch_s
+        if deadline is not None and time.time() > deadline:
+            # worker-local enforcement: kill between batches without a
+            # coordinator round trip; fail() is idempotent on terminal
+            # states so racing the coordinator's own kill is safe
+            from trino_tpu.runtime.query_tracker import (
+                EXCEEDED_TIME_LIMIT,
+            )
+
+            self.fail(
+                f"Task {self.spec.task_id}: worker-local deadline "
+                f"passed ({time.time() - deadline:.3f}s over) "
+                f"[{EXCEEDED_TIME_LIMIT}]"
+            )
+            return
         if moved and self._injector is not None:
             # the hung-operator chaos site: a stall here models an
             # operator wedged mid-batch; abort-polling lets a

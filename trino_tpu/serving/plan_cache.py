@@ -1,0 +1,152 @@
+"""Prepared-statement plan cache.
+
+A hit skips the whole parse→analyze→optimize→(fragment) pipeline: the
+runner re-uses the cached (logical output, physical/fragment plan)
+pair and goes straight to execution. The key has three parts:
+
+1. the formatter's CANONICAL sql text — the PR 5 formatter-fixpoint
+   checker (format(parse(format(x))) == format(x)) is what makes a
+   text key safe: two spellings of one statement canonicalize to one
+   entry. EXECUTE keys canonicalize the BOUND statement (parameters
+   substituted), so distinct bindings plan separately — values are
+   folded into pushdown constraints at analysis time, and a
+   value-blind key would serve wrong splits;
+2. the plan-affecting session properties (a property flipped via SET
+   SESSION must miss, not serve a stale shape);
+3. the bound-parameter dtype vector (an EXECUTE binding 1 and one
+   binding 1.5 compile different kernels even for equal canonical
+   prefixes).
+
+Entries are LRU-bounded, invalidated wholesale on any catalog/schema
+change (cached physical plans capture split listings — data
+snapshots), and never store volatile plans (now(), uuid() fold at
+analysis time). Counters surface in /v1/metrics as
+plan_cache.{hits,misses,evictions,invalidations}.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Optional, Tuple
+
+# Session properties that shape the plan (resolution, optimizer
+# decisions, physical layout, fragmenting). Anything listed here that
+# changes between two executions of the same text yields a different
+# key — SET SESSION never needs to invalidate.
+PLAN_AFFECTING_PROPERTIES = (
+    "catalog",
+    "schema",
+    "timezone",
+    "batch_rows",
+    "target_splits",
+    "enable_dynamic_filtering",
+    "enable_pushdown",
+    "enable_optimizer",
+    "join_reordering_strategy",
+    "broadcast_join_threshold",
+    "shape_stabilization",
+    "capacity_ladder_base",
+    "plan_validation",
+)
+
+
+def plan_properties(session) -> Tuple:
+    """The plan-shaping slice of a Session, as a hashable tuple."""
+    return tuple(
+        getattr(session, name, None) for name in PLAN_AFFECTING_PROPERTIES
+    )
+
+
+class PlanCache:
+    """Thread-safe bounded-LRU plan cache with metric counters.
+
+    Values are opaque to the cache: the local runner stores
+    (OutputNode, PhysicalPlan), the distributed runner stores
+    (OutputNode, SubPlan)."""
+
+    def __init__(self, max_entries: int = 256, metrics_prefix: str = "plan_cache"):
+        self.max_entries = max(1, int(max_entries))
+        self._prefix = metrics_prefix
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[Tuple, Any]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.invalidations = 0
+        # bumped on every invalidate: a long-running planner that began
+        # before a DDL must not store its now-stale plan after it
+        self.generation = 0
+
+    # -- keying --
+    def key(self, canonical_sql: str, session, param_dtypes=()) -> Tuple:
+        return (
+            canonical_sql,
+            plan_properties(session),
+            tuple(str(d) for d in param_dtypes),
+        )
+
+    # -- cache ops --
+    def lookup(self, key: Tuple) -> Optional[Any]:
+        from trino_tpu.runtime.metrics import METRICS
+
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                self.misses += 1
+                METRICS.increment(f"{self._prefix}.misses")
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            METRICS.increment(f"{self._prefix}.hits")
+            return entry
+
+    def contains(self, key: Tuple) -> bool:
+        """Presence probe that does NOT touch LRU order or counters
+        (the admission fast-path classifier must not inflate the hit
+        rate or refresh entries it will not use)."""
+        with self._lock:
+            return key in self._entries
+
+    def store(self, key: Tuple, value: Any, generation: Optional[int] = None) -> None:
+        from trino_tpu.runtime.metrics import METRICS
+
+        with self._lock:
+            if generation is not None and generation != self.generation:
+                return  # invalidated while planning: the plan is stale
+            self._entries[key] = value
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+                METRICS.increment(f"{self._prefix}.evictions")
+
+    def invalidate(self) -> None:
+        """Catalog/schema changed (DDL, DML, commit): every cached plan
+        captured split listings that may no longer describe the data."""
+        from trino_tpu.runtime.metrics import METRICS
+
+        with self._lock:
+            self._entries.clear()
+            self.generation += 1
+            self.invalidations += 1
+            METRICS.increment(f"{self._prefix}.invalidations")
+
+    # dict-compat shims: callers predating the serving tier used a raw
+    # dict here (engine._plan_cache), and tests poke it directly
+    def clear(self) -> None:
+        self.invalidate()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "invalidations": self.invalidations,
+            }
