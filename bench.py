@@ -58,6 +58,14 @@ Flags:
                      exits non-zero on an invariant violation or >5%
                      wall overhead; no device needed (runs before
                      preflight)
+  --mesh-smoke       run Q1/Q6 plus a hash join chunked over an
+                     8-device CPU mesh (parallel/mesh_chunk.py):
+                     answer-equality vs the page plane, >=1 all_to_all,
+                     zero new XLA lowerings on second execution, and a
+                     mid-query deadline kill preempting between chunks
+                     with the typed EXCEEDED_TIME_LIMIT error and no
+                     page fallback; re-execs itself with an 8-device
+                     host platform, so no device needed
 """
 
 from __future__ import annotations
@@ -1103,6 +1111,143 @@ def _trace_smoke(argv) -> int:
     return 1 if violations else 0
 
 
+def _mesh_smoke(argv) -> int:
+    """--mesh-smoke: CI gate for the chunked GSPMD mesh plane
+    (parallel/mesh_chunk.py). Re-execs itself with an 8-virtual-device
+    CPU host platform, then runs Q1, Q6 and a hash join chunked over
+    the mesh and checks: answer-equality vs the page plane, at least
+    one all_to_all exchange, zero new XLA lowerings when a query
+    executes a second time, and a mid-query deadline kill that preempts
+    between chunks with the typed EXCEEDED_TIME_LIMIT error and no
+    page-plane fallback. Exit 1 on any violation."""
+    if os.environ.get("MESH_SMOKE_INNER") != "1":
+        # the 8-device mesh needs XLA_FLAGS before the backend
+        # initializes, and the injected sitecustomize may have imported
+        # jax already — a child process is the only clean slate
+        env = dict(os.environ)
+        env["MESH_SMOKE_INNER"] = "1"
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        return subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--mesh-smoke"],
+            env=env,
+        ).returncode
+
+    import jax
+
+    # legal until a backend initializes (see the BENCH_INNER note):
+    # the mesh smoke is a CPU-semantics gate, not a device bench
+    jax.config.update("jax_platforms", "cpu")
+    n_dev = len(jax.devices())
+
+    from trino_tpu.connectors.tpch import create_tpch_connector
+    from trino_tpu.engine import Session
+    from trino_tpu.parallel.mesh_chunk import LAST_RUN_INFO
+    from trino_tpu.parallel.mesh_plan import MESH_COUNTERS
+    from trino_tpu.runtime import DistributedQueryRunner
+    from trino_tpu.runtime.metrics import METRICS
+    from trino_tpu.runtime.query_tracker import (
+        EXCEEDED_TIME_LIMIT,
+        QueryDeadlineError,
+    )
+
+    def mk(**session_kw):
+        r = DistributedQueryRunner(
+            Session(catalog="tpch", schema="tiny", **session_kw),
+            n_workers=2, hash_partitions=2,
+        )
+        r.register_catalog("tpch", create_tpch_connector())
+        return r
+
+    join = (
+        "select o_orderpriority, count(*) c from orders join customer "
+        "on o_custkey = c_custkey group by o_orderpriority "
+        "order by o_orderpriority"
+    )
+    violations = []
+    print(f"bench: mesh smoke ({n_dev}-device cpu mesh, tpch tiny)")
+    if n_dev < 8:
+        violations.append(f"expected an 8-device mesh, got {n_dev}")
+
+    page = mk(mesh_execution=False)
+    mesh = mk(mesh_chunk_rows=512)
+    report = {}
+    for name, sql in (("q1", Q1), ("q6", Q6), ("join", join)):
+        before = dict(MESH_COUNTERS)
+        expect = page.execute(sql).rows
+        got = mesh.execute(sql).rows
+        if mesh._last_data_plane != "mesh":
+            violations.append(
+                f"{name}: ran on {mesh._last_data_plane}, not the mesh "
+                f"(fallback: {mesh.last_mesh_fallback})"
+            )
+        if got != expect:
+            violations.append(f"{name}: mesh answer != page answer")
+        a2a = MESH_COUNTERS["all_to_all"] - before["all_to_all"]
+        # second execution of the same program: the chunk-step records
+        # are cached, so NO new XLA lowerings may appear
+        compiles0 = METRICS.snapshot().get("xla_compiles", 0.0)
+        got2 = mesh.execute(sql).rows
+        compiles = METRICS.snapshot().get("xla_compiles", 0.0) - compiles0
+        if got2 != expect:
+            violations.append(f"{name}: second mesh run diverged")
+        if compiles > 0:
+            violations.append(
+                f"{name}: second execution lowered {compiles:g} new "
+                "XLA programs (expected 0)"
+            )
+        report[name] = {
+            "rows": len(got),
+            "all_to_all": a2a,
+            "chunks": LAST_RUN_INFO.get("chunks"),
+            "relowerings_second_run": compiles,
+        }
+    if all(r["all_to_all"] <= 0 for r in report.values()):
+        violations.append("no query exchanged via all_to_all")
+
+    # mid-query deadline kill: warm the chunked programs, slow the
+    # tracker tick so the chunk-boundary check is the enforcement path,
+    # then run under a wall budget that expires inside the chunk loop
+    killer = mk(mesh_chunk_rows=128)
+    killer.execute(Q1)
+    killer.query_tracker.tick_interval_s = 60.0
+    killer.session.query_max_execution_time_s = 0.05
+    kill_msg = None
+    try:
+        killer.execute(Q1)
+        violations.append("deadline query completed instead of dying")
+    except QueryDeadlineError as e:
+        kill_msg = str(e)
+        if EXCEEDED_TIME_LIMIT not in kill_msg:
+            violations.append(f"kill not typed: {kill_msg}")
+        if "mesh chunk" not in kill_msg:
+            violations.append(
+                f"kill did not preempt at a chunk boundary: {kill_msg}"
+            )
+    except Exception as e:
+        violations.append(f"wrong kill type {type(e).__name__}: {e}")
+    if killer.last_mesh_fallback is not None:
+        violations.append(
+            f"deadline kill fell back to the page plane: "
+            f"{killer.last_mesh_fallback}"
+        )
+    report["deadline_kill"] = kill_msg
+
+    for v in violations:
+        print(f"bench: mesh VIOLATION: {v}", file=sys.stderr)
+    print(json.dumps({
+        "mesh_smoke": {
+            "devices": n_dev,
+            "queries": report,
+            "violations": len(violations),
+        }
+    }))
+    return 1 if violations else 0
+
+
 def _validate_corpus(argv) -> int:
     """--validate-corpus: CI gate for the plan sanity checkers
     (sql/validate.py). Plans — without executing — every TPC-H and
@@ -1209,6 +1354,8 @@ def main() -> None:
         sys.exit(_warmup_smoke(sys.argv))
     if "--trace-smoke" in sys.argv:
         sys.exit(_trace_smoke(sys.argv))
+    if "--mesh-smoke" in sys.argv:
+        sys.exit(_mesh_smoke(sys.argv))
     if "--validate-corpus" in sys.argv:
         sys.exit(_validate_corpus(sys.argv))
     if os.environ.get("BENCH_INNER") == "1":

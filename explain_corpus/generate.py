@@ -399,6 +399,55 @@ def corpus_07_distributed_analyze():
     )
 
 
+def corpus_08_mesh_analyze():
+    """Distributed EXPLAIN ANALYZE on the chunked mesh plane
+    (parallel/mesh_plan.py + mesh_chunk.py): a colocated in-process
+    cluster reports `data_plane=mesh` with the statically counted ICI
+    collectives (all_to_all per hash exchange, all_gather per broadcast
+    / single-row enforcement) and the session's chunk granularity; an
+    ineligible plan reports the fallback reason instead. Timings
+    redacted as in corpus 07."""
+    import re
+
+    from trino_tpu.runtime import DistributedQueryRunner
+
+    r = DistributedQueryRunner(
+        Session(catalog="tpch", schema="tiny"),
+        n_workers=2,
+        hash_partitions=2,
+    )
+    r.register_catalog("tpch", create_tpch_connector())
+    r.session.mesh_chunk_rows = 256
+    sql = (
+        "select o_orderpriority, count(*) from orders join customer "
+        "on o_custkey = c_custkey group by o_orderpriority"
+    )
+    out = r.execute("EXPLAIN ANALYZE " + sql).rows[0][0]
+    sql_single = "select 1"
+    out_single = r.execute("EXPLAIN ANALYZE " + sql_single).rows[0][0]
+
+    def redact(text):
+        text = re.sub(r"\b(wall|cpu)=\d+(\.\d+)?ms", r"\1=#ms", text)
+        text = re.sub(r"\b(add|get|finish)=\d+(\.\d+)?", r"\1=#", text)
+        text = re.sub(r"\btask q\d+\.", "task q#.", text)
+        return text
+
+    emit(
+        "08_mesh_analyze.txt",
+        (f"QUERY\n{sql}", ""),
+        ("mesh-eligible EXPLAIN ANALYZE: the trailing data_plane line "
+         "shows where\nthe query's data plane runs — here the mesh, "
+         "with the static collective\ncounts (the broadcast join rides "
+         "all_gather, the partial->final agg\nexchange rides "
+         "all_to_all) and mesh_chunk_rows=256 preemptible chunking\n"
+         "(wall-clock values redacted to `#`)", redact(out)),
+        (f"QUERY\n{sql_single}", ""),
+        ("ineligible plan: a single-fragment query never reaches the "
+         "mesh — the\ndata_plane line carries the static refusal "
+         "reason", redact(out_single)),
+    )
+
+
 def write_all(out_dir=None):
     """Regenerate every corpus file (into `out_dir` when given — used
     by tests/test_explain_corpus.py to diff against committed files)."""
@@ -412,6 +461,7 @@ def write_all(out_dir=None):
         corpus_05_plan_validation()
         corpus_06_compile_regime()
         corpus_07_distributed_analyze()
+        corpus_08_mesh_analyze()
     finally:
         _OUT_DIR[0] = HERE
 

@@ -80,6 +80,26 @@ def test_multi_producer_completion():
     assert got == ["b"]
 
 
+def test_producer_failure_raises_in_consumer():
+    """A dead producer must FAIL the consumer, not read as clean
+    end-of-input: before producer_failed existed, the task-concurrency
+    split turned a killed upstream into an empty 'complete' result
+    (the deadline-kill-returns-empty-success race in
+    TaskExecution._run_pipelines)."""
+    ex = LocalExchange(n_consumers=1)
+    sink = LocalExchangeSinkOperator(ex)
+    sink.add_input("a")
+    boom = RuntimeError("exchange pull failed")
+    ex.producer_failed(boom)
+    src = LocalExchangeSourceOperator(ex, 0)
+    with pytest.raises(RuntimeError, match="producer failed") as ei:
+        src.get_output()
+    assert ei.value.__cause__ is boom
+    # the latch is sticky: a consumer polling is_blocked() fails too
+    with pytest.raises(RuntimeError, match="producer failed"):
+        src.is_blocked()
+
+
 def test_backpressure_bounds_buffering():
     ex = LocalExchange(n_consumers=1, max_buffered_batches=2)
     sink = LocalExchangeSinkOperator(ex)

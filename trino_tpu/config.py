@@ -86,6 +86,11 @@ for _name, _type, _default, _desc, _allowed in [
      "max estimated build rows for a broadcast join", None),
     ("mesh_execution", bool, True,
      "run colocated fragments over the device-mesh collective exchange", None),
+    ("mesh_chunk_rows", int, 0,
+     "per-shard rows per mesh chunk-step: the driver scan splits into "
+     "ceil(rows/chunk) jit steps with host preemption checks (deadline/"
+     "abandonment/watchdog) at every chunk boundary; 0 compiles the "
+     "plan as one program (preemption checks only bracket it)", None),
     ("enable_optimizer", bool, True,
      "run the iterative plan-optimizer pipeline", None),
     ("enable_pushdown", bool, True,

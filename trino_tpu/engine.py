@@ -53,6 +53,10 @@ class Session:
     # (parallel/mesh_plan.py); ineligible plans and cross-host/FTE
     # topologies fall back to the HTTP page exchange
     mesh_execution: bool = True
+    # rows per mesh chunk-step per shard: >0 splits the driver scan into
+    # ceil(rows/chunk) jit steps with host preemption checks at every
+    # chunk boundary; 0 compiles the plan as one program
+    mesh_chunk_rows: int = 0
     # optimizer (sql/optimizer.py): the iterative rule pipeline and the
     # cost-based join reorderer (JOIN_REORDERING_STRATEGY analogue)
     enable_optimizer: bool = True

@@ -44,6 +44,7 @@ class LocalExchange:
         self._producers = 0
         self._producers_done = False
         self._aborted = False
+        self._error: Optional[BaseException] = None
         self._rr = 0
 
     def abort(self) -> None:
@@ -68,6 +69,20 @@ class LocalExchange:
             if self._producers <= 0:
                 self._producers_done = True
                 self._not_empty.notify_all()
+
+    def producer_failed(self, error: BaseException) -> None:
+        """A producer pipeline died mid-stream: latch its error so
+        consumers RAISE instead of reading the truncated stream as a
+        clean end-of-input. Without the latch, a killed upstream lets
+        the consumer half finish the task's sink normally and the task
+        publishes an empty 'complete' result — a wrong answer, not a
+        failure."""
+        with self._lock:
+            if self._error is None:
+                self._error = error
+            self._producers_done = True
+            self._not_empty.notify_all()
+            self._not_full.notify_all()
 
     def put(self, batch) -> None:
         with self._not_full:
@@ -106,9 +121,17 @@ class LocalExchange:
         """(batch | None, done). done=True only when producers finished
         AND this consumer's queue drained."""
         with self._not_empty:
+            if self._error is not None:
+                raise RuntimeError(
+                    "local exchange producer failed"
+                ) from self._error
             q = self._queues[consumer]
             if not q and not self._producers_done:
                 self._not_empty.wait(timeout)
+            if self._error is not None:
+                raise RuntimeError(
+                    "local exchange producer failed"
+                ) from self._error
             if q:
                 batch = q.popleft()
                 self._not_full.notify_all()

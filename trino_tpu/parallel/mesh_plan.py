@@ -94,6 +94,24 @@ AXIS = "shard"
 # before/after deltas, never absolute values.
 MESH_COUNTERS = {"queries": 0, "all_to_all": 0, "all_gather": 0, "fallbacks": 0}
 
+_METRICS_REGISTERED = False
+
+
+def register_mesh_metrics() -> None:
+    """Expose MESH_COUNTERS as mesh_* gauges in the METRICS registry
+    (and so in /v1/metrics). Idempotent; gauges read live at snapshot
+    time, so the export tracks the trace-time counters for free."""
+    global _METRICS_REGISTERED
+    if _METRICS_REGISTERED:
+        return
+    from trino_tpu.runtime.metrics import METRICS
+
+    for name in MESH_COUNTERS:
+        METRICS.register_gauge(
+            f"mesh_{name}", lambda n=name: float(MESH_COUNTERS[n])
+        )
+    _METRICS_REGISTERED = True
+
 
 class MeshUnsupported(Exception):
     """Plan shape the mesh compiler cannot run; the coordinator falls
@@ -786,12 +804,54 @@ class _ListSource:
         return not self._pages
 
 
-@dataclasses.dataclass
-class _GatherOut:
-    fid: int
-    local_capacity: int
-    replicated: bool
-    batch: RelBatch  # global (n * local_capacity,) arrays
+def _replicated_map(mesh_sps) -> Dict[int, bool]:
+    """Compile-time data placement per fragment: a fragment with no
+    scans whose inputs are all replicated executes replicated (every
+    shard computes the full result deterministically)."""
+    repl: Dict[int, bool] = {}
+    for sp in mesh_sps:
+        frag = sp.fragment
+        if _contains_scan(frag.root):
+            repl[frag.id] = False
+            continue
+        child_ok = True
+        for c in sp.children:
+            k = c.fragment.output_kind
+            # hash input -> sharded; broadcast/gather input -> the
+            # exchange itself replicates it
+            if k == "hash":
+                child_ok = False
+        repl[frag.id] = child_ok
+    return repl
+
+
+def mesh_eligibility(subplan: SubPlan) -> Dict[str, int]:
+    """Static mesh-plane eligibility check (no execution, no device
+    work): raises MeshUnsupported with the fallback reason for plan
+    shapes the mesh compiler cannot run, else returns a structural
+    summary with the per-compiled-pass collective census. Deterministic,
+    so EXPLAIN surfaces can print it under program-cache hits (when the
+    trace-time counters would not move)."""
+    from trino_tpu.parallel.mesh_chunk import static_collective_counts
+    from trino_tpu.runtime.stages import topo_order
+
+    if shard_map is None:
+        raise MeshUnsupported("shard_map unavailable in this jax")
+    order = topo_order(subplan)
+    if len(order) < 2:
+        raise MeshUnsupported("single-fragment plan")
+    mesh_sps = order[:-1]
+    root_sp = order[-1]
+    for sp in mesh_sps:
+        _check_node(sp.fragment.root)
+    root_child_ids = {c.fragment.id for c in root_sp.children}
+    repl = _replicated_map(mesh_sps)
+    a2a, ag = static_collective_counts(mesh_sps, root_child_ids, repl)
+    return {
+        "fragments": len(mesh_sps),
+        "all_to_all": a2a,
+        "all_gather": ag,
+    }
 
 
 class MeshExecutor:
@@ -808,9 +868,19 @@ class MeshExecutor:
         devs = list(devices) if devices is not None else list(jax.devices())
         self.n = len(devs)
         self.mesh = Mesh(np.array(devs), (AXIS,))
+        self.last_run: Dict[str, object] = {}
 
     # -- public --
-    def execute(self, subplan: SubPlan) -> List[list]:
+    def execute(self, subplan: SubPlan, preempt=None,
+                query_span=None) -> List[list]:
+        """Run the SubPlan over the mesh. `preempt(done, total)` is the
+        coordinator's chunk-boundary hook (deadline / abandonment
+        checks); `query_span` roots the mesh stage/task/operator spans.
+        The chunked runner splits the plan into prelude / chunk-step /
+        flush programs when mesh_chunk_rows > 0, else compiles one
+        program — either way preemption checks bracket every program
+        boundary."""
+        from trino_tpu.parallel.mesh_chunk import ChunkedMeshRunner
         from trino_tpu.runtime.stages import topo_order
 
         if shard_map is None:
@@ -823,77 +893,32 @@ class MeshExecutor:
         for sp in mesh_sps:
             _check_node(sp.fragment.root)
         root_child_ids = {c.fragment.id for c in root_sp.children}
-        repl = self._replicated_map(mesh_sps)
-        feeds, feed_args = self._load_scans(mesh_sps)
+        repl = _replicated_map(mesh_sps)
+        feeds, host_feeds = self._load_scans(mesh_sps)
 
-        caps: Dict[str, int] = {}
-        for _ in range(12):
-            flag_sites: List[str] = []
-            out_meta: List[Tuple[int, bool]] = []
-            program = self._build_program(
-                mesh_sps, root_child_ids, repl, feeds, caps, flag_sites, out_meta
-            )
-            outs, flags = program(*feed_args)
-            flags_np = np.asarray(jax.device_get(flags)).reshape(self.n, -1)
-            over = flags_np.max(axis=0)
-            overflowed = [
-                (site, int(o)) for site, o in zip(flag_sites, over) if o
-            ]
-            if not overflowed:
-                break
-            for site, needed in overflowed:
-                if site.startswith("err:single_row"):
-                    raise RuntimeError(
-                        "Scalar sub-query has returned multiple rows"
-                    )
-                # flags carry the exact required size: jump straight
-                # there rather than climbing a x2 retrace ladder
-                caps[site] = max(
-                    caps[site] * 2, bucket_capacity(max(needed, 16))
-                )
-        else:
-            raise RuntimeError("mesh capacity retry limit exceeded")
-        # count only after the program has actually produced results —
+        runner = ChunkedMeshRunner(
+            self, mesh_sps, root_child_ids, repl, feeds, host_feeds
+        )
+        sources = runner.run(preempt=preempt, query_span=query_span)
+        # count only after the programs have actually produced results —
         # a failure above falls back to the page exchange, which must not
         # register as a mesh-executed query
         MESH_COUNTERS["queries"] += 1
-
-        sources = {}
-        for (fid, replicated), batch in zip(out_meta, outs):
-            sources[fid] = self._shard_pages(batch, replicated)
+        self.last_run = dict(runner.info)
         return self._run_root(subplan, root_sp, sources)
 
     # -- planning helpers --
-    def _replicated_map(self, mesh_sps) -> Dict[int, bool]:
-        """Compile-time data placement per fragment: a fragment with no
-        scans whose inputs are all replicated executes replicated (every
-        shard computes the full result deterministically)."""
-        repl: Dict[int, bool] = {}
-        for sp in mesh_sps:
-            frag = sp.fragment
-            if _contains_scan(frag.root):
-                repl[frag.id] = False
-                continue
-            child_ok = True
-            for c in sp.children:
-                k = c.fragment.output_kind
-                # hash input -> sharded; broadcast/gather input -> the
-                # exchange itself replicates it
-                if k == "hash":
-                    child_ok = False
-            repl[frag.id] = child_ok
-        return repl
-
     def _load_scans(self, mesh_sps):
         """Host side of SOURCE distribution: each shard scans its slice
-        of the connector splits; slices stack into one globally-sharded
-        RelBatch per ScanNode (the SourcePartitionedScheduler assignment
-        collapsed onto the mesh)."""
+        of the connector splits; slices stack into one host RelBatch per
+        ScanNode of global shape (n * cap,) (the
+        SourcePartitionedScheduler assignment collapsed onto the mesh).
+        Device placement is deferred to the chunk runner, which may
+        re-pad the driver feed to a chunk-aligned capacity first."""
         from trino_tpu.exec.operators import TableScanOperator
 
         feeds: Dict[int, int] = {}  # id(node) -> feed position
-        feed_args: List[RelBatch] = []
-        sharding = NamedSharding(self.mesh, PSpec(AXIS))
+        host_feeds: List[RelBatch] = []
         for sp in mesh_sps:
             for node in _scan_nodes(sp.fragment.root):
                 if id(node) in feeds:
@@ -927,65 +952,9 @@ class MeshExecutor:
                         shard_batches.append(concat_batches(parts))
                     else:
                         shard_batches.append(_empty_batch(schema))
-                feeds[id(node)] = len(feed_args)
-                feed_args.append(
-                    jax.device_put(_stack_shards(shard_batches, self.n), sharding)
-                )
-        return feeds, feed_args
-
-    def _build_program(self, mesh_sps, root_child_ids, repl, feeds, caps,
-                       flag_sites, out_meta):
-        n = self.n
-
-        def body(*feed_batches):
-            # host-visible side lists are cleared at trace entry so a
-            # re-trace (jit weak-type promotion etc.) cannot double-append
-            # and misalign out_meta with the traced outputs
-            flag_sites.clear()
-            out_meta.clear()
-            ctx: Dict[int, RelBatch] = {}
-            flags: List[Tuple[str, jnp.ndarray]] = []
-            outputs: List[RelBatch] = []
-            for sp in mesh_sps:
-                frag = sp.fragment
-                local_feeds = {
-                    key: feed_batches[pos] for key, pos in feeds.items()
-                }
-                vis = _FragVisitor(self, frag.id, local_feeds, ctx, caps, flags)
-                batch = vis.visit(frag.root)
-                if frag.id in root_child_ids:
-                    outputs.append(batch)
-                    out_meta.append((frag.id, repl[frag.id]))
-                    continue
-                kind = frag.output_kind
-                if kind == "hash":
-                    if repl[frag.id]:
-                        ctx[frag.id] = _local_partition(
-                            batch, frag.output_channels, n
-                        )
-                    else:
-                        ctx[frag.id] = _exchange_hash(
-                            batch, frag.output_channels, n
-                        )
-                elif kind == "broadcast":
-                    ctx[frag.id] = batch if repl[frag.id] else _replicate(batch)
-                else:  # gather consumed by another mesh fragment
-                    ctx[frag.id] = batch if repl[frag.id] else _replicate(batch)
-            if flags:
-                flag_sites.extend(s for s, _ in flags)
-                flag_arr = jnp.stack([f for _, f in flags])
-            else:
-                flag_arr = jnp.zeros(1, dtype=jnp.int32)
-            return tuple(outputs), flag_arr
-
-        f = shard_map(
-            body,
-            mesh=self.mesh,
-            in_specs=tuple(PSpec(AXIS) for _ in feeds),
-            out_specs=PSpec(AXIS),
-            check_vma=False,
-        )
-        return jax.jit(f)
+                feeds[id(node)] = len(host_feeds)
+                host_feeds.append(_stack_shards(shard_batches, self.n))
+        return feeds, host_feeds
 
     # -- host boundary --
     def _shard_pages(self, batch: RelBatch, replicated: bool) -> List[Page]:

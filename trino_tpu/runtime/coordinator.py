@@ -389,6 +389,11 @@ class DistributedQueryRunner:
         from trino_tpu.runtime.metrics import install_xla_compile_listener
 
         install_xla_compile_listener()
+        # mesh data-plane counters (queries / all_to_all / all_gather /
+        # fallbacks) ride the same registry as gauges -> /v1/metrics
+        from trino_tpu.parallel.mesh_plan import register_mesh_metrics
+
+        register_mesh_metrics()
         # serving tier: canonical-text plan cache over the distributed
         # planning pipeline (analyze -> optimize -> fragment). DDL/DML
         # through the embedded runner and catalog registration
@@ -589,6 +594,7 @@ class DistributedQueryRunner:
             QueryCreatedEvent(base_qid, sql, _time.time())
         )
         self._last_stage_infos = None
+        self._last_data_plane = "http"
         status, failure_txt, rows_n = "finished", None, 0
         try:
             result = self._execute_query(
@@ -703,6 +709,7 @@ class DistributedQueryRunner:
                 deadline_epoch_s = _time.time() + min(budgets)
         result_meta = (list(output.names), [f.type for f in output.fields])
         if self.session.retry_policy == "task":
+            self._last_data_plane = "fte"
             rows = self._execute_fte(
                 subplan, query_id=base_qid, cancel=cancel, tq=tq,
                 trace=trace, query_span=query_span,
@@ -710,53 +717,65 @@ class DistributedQueryRunner:
             )
             return MaterializedResult(rows, *result_meta, data_plane="fte")
         if self.session.mesh_execution and self._mesh_colocated():
-            if limits.any():
-                # the mesh plane runs ONE uninterruptible SPMD program —
-                # a deadline kill could not preempt it mid-collective, so
-                # bounded queries take the page exchange (observable
-                # fallback, like any unsupported plan shape)
-                from trino_tpu.parallel.mesh_plan import MESH_COUNTERS
+            # tasks share one host's device mesh: exchanges ride ICI
+            # collectives in chunked SPMD programs (parallel/mesh_chunk)
+            # with host preemption checks at every chunk boundary — so
+            # deadline-bearing queries run here too, killed between
+            # chunks with the same typed errors the page plane raises.
+            # Unsupported plan shapes fall back to the page exchange.
+            from trino_tpu.parallel.mesh_plan import (
+                MeshExecutor,
+                MeshUnsupported,
+            )
+            from trino_tpu.parallel.mesh_chunk import MeshStuck
+            from trino_tpu.runtime.metrics import set_compile_attribution
+            from trino_tpu.runtime.query_tracker import (
+                QueryAbandonedError,
+                preemption_check,
+            )
 
-                MESH_COUNTERS["fallbacks"] += 1
-                self.last_mesh_fallback = (
-                    "deadline limits set: mesh execution cannot be "
-                    "interrupted mid-program"
+            preempt = preemption_check(
+                tracker, base_qid, cancel=cancel,
+                deadline_epoch_s=deadline_epoch_s,
+            )
+            prev = set_compile_attribution(base_qid)
+            try:
+                rows = MeshExecutor(
+                    self.catalogs, self.session
+                ).execute(subplan, preempt=preempt, query_span=query_span)
+                self._last_data_plane = "mesh"
+                return MaterializedResult(
+                    rows, *result_meta, data_plane="mesh"
                 )
-            else:
-                # tasks share one host's device mesh: the exchange rides
-                # ICI collectives in one SPMD program
-                # (parallel/mesh_plan.py); unsupported plan shapes fall
-                # back to the page exchange
-                from trino_tpu.parallel.mesh_plan import (
-                    MeshExecutor,
-                    MeshUnsupported,
+            except MeshUnsupported as ex:
+                # fallback must be OBSERVABLE, not silent: count it and
+                # record why (EXPLAIN ANALYZE / QueryInfo / metrics
+                # surface it) — whether raised statically or mid-run
+                self._record_mesh_fallback(str(ex), query_span)
+            except (QueryDeadlineError, QueryAbandonedError):
+                raise  # the preemption hook fired: typed, no fallback
+            except MeshStuck as ex:
+                # retryable by classification: a program hung here may
+                # succeed on the page plane, so fall back observably
+                self._record_mesh_fallback(str(ex), query_span)
+            except Exception as e:
+                if deadline_code(str(e)) is not None:
+                    # a latched kill that travelled as a failure string:
+                    # re-type it so it stays non-retryable, no fallback
+                    raise deadline_error(str(e)) from e
+                # unexpected mesh runtime failure: the page-exchange
+                # path below re-executes from scratch (correctness
+                # preserved), but surface the regression
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "mesh execution failed; falling back to page "
+                    "exchange",
+                    exc_info=True,
                 )
-
-                try:
-                    rows = MeshExecutor(
-                        self.catalogs, self.session
-                    ).execute(subplan)
-                    return MaterializedResult(
-                        rows, *result_meta, data_plane="mesh"
-                    )
-                except MeshUnsupported as ex:
-                    # fallback must be OBSERVABLE, not silent: count it
-                    # and record why (EXPLAIN ANALYZE / stats surface it)
-                    from trino_tpu.parallel.mesh_plan import MESH_COUNTERS
-
-                    MESH_COUNTERS["fallbacks"] += 1
-                    self.last_mesh_fallback = str(ex)
-                except Exception:
-                    # unexpected mesh runtime failure: the page-exchange
-                    # path below re-executes from scratch (correctness
-                    # preserved), but surface the regression
-                    import logging
-
-                    logging.getLogger(__name__).warning(
-                        "mesh execution failed; falling back to page "
-                        "exchange",
-                        exc_info=True,
-                    )
+                self._record_mesh_fallback(f"error: {e}", query_span)
+            finally:
+                set_compile_attribution(prev)
         attempts = (
             1 + self.session.query_retry_count
             if self.session.retry_policy == "query"
@@ -774,7 +793,11 @@ class DistributedQueryRunner:
             if cancel is not None and cancel():
                 # nobody is waiting for this result: don't launch (or
                 # re-launch) tasks for it
-                raise RuntimeError(
+                from trino_tpu.runtime.query_tracker import (
+                    QueryAbandonedError,
+                )
+
+                raise QueryAbandonedError(
                     f"Query {base_qid} abandoned: client stopped "
                     "polling results"
                 )
@@ -848,6 +871,52 @@ class DistributedQueryRunner:
                 scheduler.abort()
         raise last_error
 
+    def _record_mesh_fallback(self, reason: str, query_span=None) -> None:
+        """One mesh->page fallback: bump the aggregate counter, latch
+        the reason for QueryInfo/EXPLAIN, export a per-reason counter
+        (mesh_fallbacks.{slug}) and drop an instant event on the query
+        span so the trace timeline shows where the plane switched."""
+        import re
+
+        from trino_tpu.parallel.mesh_plan import MESH_COUNTERS
+        from trino_tpu.runtime.metrics import METRICS
+
+        MESH_COUNTERS["fallbacks"] += 1
+        self.last_mesh_fallback = reason
+        slug = re.sub(r"[^a-z0-9]+", "_", reason.lower()).strip("_")[:40]
+        if slug:
+            METRICS.increment(f"mesh_fallbacks.{slug}")
+        if query_span is not None:
+            query_span.event("mesh_fallback", reason=reason[:300])
+
+    def _mesh_plane_line(self, subplan) -> str:
+        """The EXPLAIN ANALYZE data-plane line: which plane `execute`
+        would pick for this plan, decided STATICALLY (structural
+        eligibility + collective census, no second execution) so the
+        output is deterministic under program-cache hits."""
+        if getattr(self.session, "retry_policy", "none") == "task":
+            return "data_plane=fte"
+        if not (self.session.mesh_execution and self._mesh_colocated()):
+            return "data_plane=http"
+        from trino_tpu.parallel.mesh_plan import (
+            MeshUnsupported,
+            mesh_eligibility,
+        )
+
+        try:
+            info = mesh_eligibility(subplan)
+        except MeshUnsupported as ex:
+            self._record_mesh_fallback(str(ex))
+            return f"data_plane=http (mesh fallback: {ex})"
+        chunk_rows = int(getattr(self.session, "mesh_chunk_rows", 0) or 0)
+        chunking = (
+            f"chunk_rows={chunk_rows}" if chunk_rows > 0 else "unchunked"
+        )
+        return (
+            f"data_plane=mesh (all_to_all={info['all_to_all']}, "
+            f"all_gather={info['all_gather']}, {chunking})"
+        )
+
     def _explain_text(self, subplan) -> str:
         """Fragment rendering with per-fragment compile-churn census
         annotations (expected_xla_lowerings — sql/validate.py)."""
@@ -885,6 +954,10 @@ class DistributedQueryRunner:
             lines = [self._explain_text(subplan)]
             for stage in stages:
                 lines.append(stage_text(stage))
+            # which plane a plain `execute` of this statement would
+            # take (the ANALYZE instrumentation itself runs the page
+            # scheduler above either way, for the operator stats)
+            lines.append(self._mesh_plane_line(subplan))
             return MaterializedResult(
                 [["\n".join(lines)]], ["Query Plan"], [T.VARCHAR]
             )
@@ -1076,6 +1149,14 @@ class DistributedQueryRunner:
             for page in pages:
                 rows.extend(_page_rows(page))
             if complete:
+                # a kill can land between the sweep above and this
+                # fetch's completion: a latched tracker error or a
+                # failed task must win over a racy 'complete' — on the
+                # pipelined plane a failed task always dooms the query,
+                # so returning here would hand back a truncated result
+                if base_qid is not None:
+                    self.query_tracker.check(base_qid)
+                self._raise_if_failed(scheduler)
                 return rows
 
     # -- observability plane (QueryInfo registry + trace export) --
@@ -1194,7 +1275,10 @@ class DistributedQueryRunner:
                 counters=counters, error_code=err_code,
                 failure=failure_txt, retry_count=retry_count,
                 attempt_count=attempt_count,
-                data_plane="fte" if is_fte else "http",
+                data_plane=getattr(
+                    self, "_last_data_plane", None
+                ) or ("fte" if is_fte else "http"),
+                mesh_fallback=self.last_mesh_fallback,
             )
             with self._lock:
                 self._active_traces.pop(base_qid, None)

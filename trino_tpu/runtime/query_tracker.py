@@ -77,6 +77,48 @@ def deadline_error(message: str) -> QueryDeadlineError:
     return cls(message)
 
 
+class QueryAbandonedError(RuntimeError):
+    """The client stopped polling results; the query is torn down
+    instead of computing a result nobody will read. Not a deadline kill
+    (no bracketed code) and not retryable — resubmitting an abandoned
+    query would just abandon it again."""
+
+    retryable = False
+
+
+def preemption_check(tracker, base_qid, cancel=None, deadline_epoch_s=None,
+                     clock=None):
+    """Build the chunk-boundary preemption hook for in-process data
+    planes (the mesh chunk loop). The returned callable mirrors what the
+    page plane enforces between batches — latched tracker kills, client
+    abandonment, the worker-local wall deadline — so a mesh query under
+    limits dies with the same typed errors, just at chunk granularity.
+
+    Signature: check(done, total) — the caller's progress through its
+    preemption boundaries, embedded in the kill message for
+    observability."""
+    import time as _time
+
+    clock = clock or _time.time
+
+    def check(done: int, total: int) -> None:
+        # a kill latched by the enforcement tick (planning/run/cpu
+        # limits) surfaces here as its typed error
+        tracker.check(base_qid)
+        if cancel is not None and cancel():
+            raise QueryAbandonedError(
+                f"Query {base_qid} abandoned: client stopped "
+                "polling results"
+            )
+        if deadline_epoch_s is not None and clock() > deadline_epoch_s:
+            raise ExceededTimeLimitError(
+                "Query exceeded the execution-time limit at mesh chunk "
+                f"{done}/{total} [{EXCEEDED_TIME_LIMIT}]"
+            )
+
+    return check
+
+
 @dataclasses.dataclass(frozen=True)
 class DeadlineLimits:
     """Per-query budgets; 0 (or None) disables a limit."""

@@ -110,6 +110,7 @@ def build_query_info(
     retry_count: int = 0,
     attempt_count: int = 1,
     data_plane: str = "http",
+    mesh_fallback: Optional[str] = None,
 ) -> dict:
     """The final QueryInfo document. Counters are the engine-counter
     deltas (rows_scanned/bytes_scanned/rows_shuffled/...) attributed to
@@ -131,6 +132,9 @@ def build_query_info(
         "retry_count": int(retry_count),
         "attempt_count": int(attempt_count),
         "data_plane": data_plane,
+        # why the query left the mesh plane (None = it ran there, or
+        # never would have — read together with data_plane)
+        "mesh_fallback": mesh_fallback,
         "stages": stages,
     }
 

@@ -601,7 +601,13 @@ class TaskExecution:
                     drive(producer)
                 except BaseException as e:
                     perr.append(e)
-                    ex.producer_finished()  # unblock the consumer
+                    # unblock the consumer by FAILING the exchange, not
+                    # finishing it: a clean producer_finished() here
+                    # would let the consumer treat the truncated stream
+                    # as end-of-input and publish an empty 'complete'
+                    # result while the upstream failure is still in
+                    # flight (the killed-query-returns-empty race)
+                    ex.producer_failed(e)
 
             t = threading.Thread(target=run_producer, daemon=True)
             t.start()
@@ -613,6 +619,10 @@ class TaskExecution:
                 # makes further puts no-ops
                 ex.abort()
                 t.join(5)
+                if perr:
+                    # the producer died first — its error is the root
+                    # cause; the consumer unwind is secondary noise
+                    raise perr[0]
                 raise
             t.join()
             if perr:
