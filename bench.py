@@ -66,6 +66,15 @@ Flags:
                      with the typed EXCEEDED_TIME_LIMIT error and no
                      page fallback; re-execs itself with an 8-device
                      host platform, so no device needed
+  --resident-smoke   exercise the resident state tier
+                     (trino_tpu/resident/): warm point-lookup p50 at
+                     device-probe latency (faster than the cold path,
+                     resident.hits > 0, zero rebuilds), oracle-equality
+                     through DML invalidation, the delta-append path
+                     and background compaction, zero post-warmup XLA
+                     lowerings for repeated pinned probes, and graceful
+                     cold-path degradation under a zero pin budget; no
+                     device needed (runs before preflight)
 """
 
 from __future__ import annotations
@@ -1248,6 +1257,162 @@ def _mesh_smoke(argv) -> int:
     return 1 if violations else 0
 
 
+def _resident_smoke(argv) -> int:
+    """--resident-smoke: CI gate for the resident state tier
+    (trino_tpu/resident/). Checks: (1) warm pinned point lookups beat
+    the cold execute path on p50 with resident.hits > 0 and zero
+    rebuild pins in the warm loop; (2) repeated pinned probes — and
+    repeated post-compaction probes — mint zero new XLA lowerings;
+    (3) answers stay oracle-equal through DML invalidation (generation
+    bump -> rebuild), the delta-append path, and background compaction;
+    (4) a zero pin budget degrades to the cold path without failing any
+    lookup. Exit 1 on any violation."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from trino_tpu import types as Ty
+    from trino_tpu.connectors.memory import create_memory_connector
+    from trino_tpu.connectors.spi import ColumnMetadata
+    from trino_tpu.engine import LocalQueryRunner, Session
+    from trino_tpu.resident import RESIDENT
+    from trino_tpu.resident.fastlane import (
+        drain_compactions,
+        try_resident_lookup,
+    )
+    from trino_tpu.runtime.metrics import METRICS
+
+    violations = []
+    print("bench: resident smoke (memory connector, pinned fast lane)")
+    mem = create_memory_connector()
+    r = LocalQueryRunner(Session(
+        catalog="memory", schema="s",
+        resident_tables="s.kv", resident_delta_max_rows=64,
+    ))
+    r.register_catalog("memory", mem)
+    n = 1000
+    rng = np.random.default_rng(3)
+    mem.load_table(
+        "s", "kv",
+        [ColumnMetadata("k", Ty.BIGINT), ColumnMetadata("v", Ty.BIGINT)],
+        [np.arange(n, dtype=np.int64),
+         rng.integers(0, 1 << 30, n).astype(np.int64)],
+    )
+    RESIDENT.evict_all()
+    RESIDENT.reset_stats()
+
+    def oracle(k):
+        return r.execute(f"select v from kv where k = {k}").rows
+
+    def fast(k):
+        res = try_resident_lookup(r, f"select v from kv where k = {k}")
+        return None if res is None else res.rows
+
+    # -- 1. build, then warm-loop latency + zero lowerings ------------
+    if fast(7) != oracle(7):
+        violations.append("first (build) lookup diverged from oracle")
+    keys = [int(k) for k in rng.integers(0, n, 200)]
+    fast(keys[0])  # one warm probe before timing
+    pins0 = RESIDENT.stats()["pins"]
+    compiles0 = METRICS.snapshot().get("xla_compiles", 0.0)
+    warm_times = []
+    for k in keys:
+        t0 = time.perf_counter()
+        rows = fast(k)
+        warm_times.append(time.perf_counter() - t0)
+        if rows is None:
+            violations.append(f"warm lookup k={k} fell to the cold path")
+            break
+    warm_compiles = METRICS.snapshot().get("xla_compiles", 0.0) - compiles0
+    if warm_compiles > 0:
+        violations.append(
+            f"warm probes lowered {warm_compiles:g} new XLA programs "
+            "(expected 0)"
+        )
+    if RESIDENT.stats()["pins"] != pins0:
+        violations.append("warm loop rebuilt the pinned table")
+    if RESIDENT.stats()["hits"] <= 0:
+        violations.append("no resident hits recorded")
+    for k in keys[:5]:
+        if fast(k) != oracle(k):
+            violations.append(f"warm lookup k={k} diverged from oracle")
+    cold_times = []
+    for k in keys[:20]:
+        t0 = time.perf_counter()
+        oracle(k)
+        cold_times.append(time.perf_counter() - t0)
+    warm_p50 = sorted(warm_times)[len(warm_times) // 2]
+    cold_p50 = sorted(cold_times)[len(cold_times) // 2]
+    if warm_p50 >= cold_p50:
+        violations.append(
+            f"warm p50 {warm_p50 * 1e3:.3f}ms not below cold p50 "
+            f"{cold_p50 * 1e3:.3f}ms"
+        )
+
+    # -- 2. DML invalidation: generation bump -> rebuild, oracle-equal
+    r.execute("update kv set v = -1 where k = 7")
+    if fast(7) != oracle(7) or fast(7) != [[-1]]:
+        violations.append("post-UPDATE lookup not oracle-equal")
+    if RESIDENT.stats()["evictions"] <= 0:
+        violations.append("UPDATE did not evict the stale pin")
+
+    # -- 3. delta-append path + background compaction -----------------
+    pins_before_delta = RESIDENT.stats()["pins"]
+    for i in range(40):  # delta_max_rows=64 -> compaction at 32
+        r.execute(f"insert into kv values ({2000 + i}, {i})")
+    drain_compactions()
+    if RESIDENT.stats()["pins"] != pins_before_delta:
+        violations.append(
+            "delta appends re-pinned instead of re-keying the live pin"
+        )
+    if RESIDENT.stats()["compactions"] <= 0:
+        violations.append("delta never crossed into background compaction")
+    for k in (2000, 2039, 7, 500):
+        if fast(k) != oracle(k):
+            violations.append(
+                f"post-delta/compaction lookup k={k} diverged from oracle"
+            )
+    compiles0 = METRICS.snapshot().get("xla_compiles", 0.0)
+    for k in keys[:50]:
+        fast(k)
+    post_compiles = METRICS.snapshot().get("xla_compiles", 0.0) - compiles0
+    if post_compiles > 0:
+        violations.append(
+            f"post-compaction probes lowered {post_compiles:g} new XLA "
+            "programs (expected 0)"
+        )
+
+    # -- 4. pin-budget overflow degrades to the cold path -------------
+    r.session.resident_pin_budget_mb = 0
+    RESIDENT.evict_all()
+    got = fast(7)
+    if got != oracle(7):
+        violations.append(
+            f"zero-budget lookup failed or diverged (got {got})"
+        )
+    if RESIDENT.stats()["entries"] != 0:
+        violations.append("zero-budget lookup left a pin behind")
+
+    for v in violations:
+        print(f"bench: resident VIOLATION: {v}", file=sys.stderr)
+    stats = RESIDENT.stats()
+    print(json.dumps({
+        "resident_smoke": {
+            "warm_p50_ms": round(warm_p50 * 1e3, 4),
+            "cold_p50_ms": round(cold_p50 * 1e3, 4),
+            "speedup": round(cold_p50 / max(warm_p50, 1e-9), 1),
+            "hits": stats["hits"],
+            "misses": stats["misses"],
+            "pins": stats["pins"],
+            "evictions": stats["evictions"],
+            "compactions": stats["compactions"],
+            "violations": len(violations),
+        }
+    }))
+    return 1 if violations else 0
+
+
 def _validate_corpus(argv) -> int:
     """--validate-corpus: CI gate for the plan sanity checkers
     (sql/validate.py). Plans — without executing — every TPC-H and
@@ -1356,6 +1521,8 @@ def main() -> None:
         sys.exit(_trace_smoke(sys.argv))
     if "--mesh-smoke" in sys.argv:
         sys.exit(_mesh_smoke(sys.argv))
+    if "--resident-smoke" in sys.argv:
+        sys.exit(_resident_smoke(sys.argv))
     if "--validate-corpus" in sys.argv:
         sys.exit(_validate_corpus(sys.argv))
     if os.environ.get("BENCH_INNER") == "1":

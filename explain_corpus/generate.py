@@ -385,6 +385,9 @@ def corpus_07_distributed_analyze():
         text = re.sub(r"\b(wall|cpu)=\d+(\.\d+)?ms", r"\1=#ms", text)
         text = re.sub(r"\b(add|get|finish)=\d+(\.\d+)?", r"\1=#", text)
         text = re.sub(r"\btask q\d+\.", "task q#.", text)
+        # process-global resident-tier counters depend on what ran
+        # before this corpus fn — corpus 09 pins the real numbers
+        text = re.sub(r"resident= .*", "resident= #", text)
         return text
 
     emit(
@@ -430,6 +433,7 @@ def corpus_08_mesh_analyze():
         text = re.sub(r"\b(wall|cpu)=\d+(\.\d+)?ms", r"\1=#ms", text)
         text = re.sub(r"\b(add|get|finish)=\d+(\.\d+)?", r"\1=#", text)
         text = re.sub(r"\btask q\d+\.", "task q#.", text)
+        text = re.sub(r"resident= .*", "resident= #", text)
         return text
 
     emit(
@@ -448,6 +452,93 @@ def corpus_08_mesh_analyze():
     )
 
 
+def corpus_09_resident_analyze():
+    """The resident state tier (trino_tpu/resident/): a point lookup
+    over a table named in `resident_tables` builds and pins a
+    device-resident hash table on first touch (miss), probes it with a
+    shape-stable jitted program thereafter (hit, zero rebuild), rides
+    an INSERT on the append-only delta side (the pin survives under the
+    table's NEW generation), and is evicted by non-append DML
+    (generation bump -> rebuild on next touch, oracle-equal). The
+    trailing `resident=` line of distributed EXPLAIN ANALYZE reports
+    the pin population and lifetime counters; device byte counts are
+    layout-dependent and redacted to `#`."""
+    import re
+
+    from trino_tpu.resident import GENERATIONS, RESIDENT
+    from trino_tpu.resident.fastlane import (
+        drain_compactions,
+        try_resident_lookup,
+    )
+    from trino_tpu.runtime import DistributedQueryRunner
+
+    RESIDENT.evict_all()
+    RESIDENT.reset_stats()
+    r = LocalQueryRunner(
+        Session(catalog="memory", schema="s", resident_tables="s.kv")
+    )
+    r.register_catalog("memory", create_memory_connector())
+    mem = r.catalogs.get("memory")
+    n = 64
+    mem.load_table(
+        "s", "kv",
+        [ColumnMetadata("k", T.BIGINT), ColumnMetadata("v", T.BIGINT)],
+        [np.arange(n, dtype=np.int64), np.arange(n, dtype=np.int64) * 10],
+    )
+    events = []
+
+    def look(k):
+        res = try_resident_lookup(r, f"select v from kv where k = {k}")
+        return None if res is None else res.rows
+
+    events.append(f"lookup k=7        -> {look(7)}   (miss: build + pin)")
+    events.append(f"lookup k=7        -> {look(7)}   (hit: device probe)")
+    r.execute("insert into kv values (1000, 12345)")
+    events.append(
+        f"insert (1000, 12345); lookup k=1000 -> {look(1000)}   "
+        "(delta append: pin survived re-keyed)"
+    )
+    drain_compactions()
+    r.execute("update kv set v = 0 where k = 7")
+    events.append(
+        f"update k=7 -> v=0; lookup k=7       -> {look(7)}   "
+        "(generation bump evicted the pin; rebuild, oracle-equal)"
+    )
+    stats = RESIDENT.stats()
+    events.append(
+        "counters: hits={hits} misses={misses} pins={pins} "
+        "evictions={evictions} compactions={compactions}".format(**stats)
+    )
+
+    # the resident= line on a distributed EXPLAIN ANALYZE (stats are
+    # process-global; the distributed runner reports the same tier)
+    dr = DistributedQueryRunner(
+        Session(catalog="tpch", schema="tiny"), n_workers=2,
+        hash_partitions=2,
+    )
+    dr.register_catalog("tpch", create_tpch_connector())
+    out = dr.execute(
+        "EXPLAIN ANALYZE select count(*) from nation"
+    ).rows[0][0]
+
+    def redact(text):
+        text = re.sub(r"\b(wall|cpu)=\d+(\.\d+)?ms", r"\1=#ms", text)
+        text = re.sub(r"\b(add|get|finish)=\d+(\.\d+)?", r"\1=#", text)
+        text = re.sub(r"\btask q\d+\.", "task q#.", text)
+        text = re.sub(r"pinned_bytes=\d+", "pinned_bytes=#", text)
+        return text
+
+    emit(
+        "09_resident_analyze.txt",
+        ("resident fast-lane lifecycle (miss -> hit -> delta append -> "
+         "DML eviction\n-> rebuild); every lookup answer is "
+         "oracle-equal to the cold path", "\n".join(events)),
+        ("distributed EXPLAIN ANALYZE: the trailing resident= line "
+         "(process-global\npin population + lifetime counters; byte "
+         "counts redacted to `#`)", redact(out)),
+    )
+
+
 def write_all(out_dir=None):
     """Regenerate every corpus file (into `out_dir` when given — used
     by tests/test_explain_corpus.py to diff against committed files)."""
@@ -462,6 +553,7 @@ def write_all(out_dir=None):
         corpus_06_compile_regime()
         corpus_07_distributed_analyze()
         corpus_08_mesh_analyze()
+        corpus_09_resident_analyze()
     finally:
         _OUT_DIR[0] = HERE
 

@@ -63,6 +63,63 @@ def pytest_configure(config):
     )
 
 
+# -- shared read-only runners (tier-1 wall trim) -----------------------
+# Many modules used to build identical tpch/tpcds-tiny runners — and
+# 2-worker distributed clusters — once per module, or even once per
+# parametrized case. These session-scoped fixtures build each exactly
+# once per run. Tests using them MUST be read-only: no DML/DDL, no SET
+# SESSION, no session-attribute mutation; a test that mutates state
+# builds its own runner.
+
+
+@pytest.fixture(scope="session")
+def tpch_local():
+    from trino_tpu.connectors.tpch import create_tpch_connector
+    from trino_tpu.engine import LocalQueryRunner, Session
+
+    r = LocalQueryRunner(Session(catalog="tpch", schema="tiny"))
+    r.register_catalog("tpch", create_tpch_connector())
+    return r
+
+
+@pytest.fixture(scope="session")
+def tpcds_local():
+    from trino_tpu.connectors.tpcds import create_tpcds_connector
+    from trino_tpu.engine import LocalQueryRunner, Session
+
+    r = LocalQueryRunner(Session(catalog="tpcds", schema="tiny"))
+    r.register_catalog("tpcds", create_tpcds_connector())
+    return r
+
+
+@pytest.fixture(scope="session")
+def tpch_cluster():
+    from trino_tpu.connectors.tpch import create_tpch_connector
+    from trino_tpu.engine import Session
+    from trino_tpu.runtime import DistributedQueryRunner
+
+    r = DistributedQueryRunner(
+        Session(catalog="tpch", schema="tiny"),
+        n_workers=2, hash_partitions=2,
+    )
+    r.register_catalog("tpch", create_tpch_connector())
+    return r
+
+
+@pytest.fixture(scope="session")
+def tpcds_cluster():
+    from trino_tpu.connectors.tpcds import create_tpcds_connector
+    from trino_tpu.engine import Session
+    from trino_tpu.runtime import DistributedQueryRunner
+
+    r = DistributedQueryRunner(
+        Session(catalog="tpcds", schema="tiny"),
+        n_workers=2, hash_partitions=2,
+    )
+    r.register_catalog("tpcds", create_tpcds_connector())
+    return r
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_between_modules():
     """The full suite compiles 1000+ XLA programs in one process; this

@@ -10,15 +10,11 @@ import math
 import numpy as np
 import pytest
 
-from trino_tpu.connectors.tpch import create_tpch_connector
-from trino_tpu.engine import LocalQueryRunner, Session
 
 
 @pytest.fixture(scope="module")
-def runner():
-    r = LocalQueryRunner(Session(catalog="tpch", schema="tiny"))
-    r.register_catalog("tpch", create_tpch_connector())
-    return r
+def runner(tpch_local):
+    return tpch_local
 
 
 V = "(VALUES (1.0), (2.0), (3.0), (4.0), (10.0)) t(x)"
@@ -201,15 +197,8 @@ class TestApproxDistinct:
         # 2048 registers, 3 sigma of the 2.3% standard error
         assert abs(rows[0][1] - check) <= max(3 * 0.023 * check, 1)
 
-    def test_distributed_matches_local(self, runner):
-        from trino_tpu.connectors.tpch import create_tpch_connector
-        from trino_tpu.runtime import DistributedQueryRunner
-
-        d = DistributedQueryRunner(
-            Session(catalog="tpch", schema="tiny"),
-            n_workers=2, hash_partitions=2,
-        )
-        d.register_catalog("tpch", create_tpch_connector())
+    def test_distributed_matches_local(self, runner, tpch_cluster):
+        d = tpch_cluster
         assert d.execute(self.MIXED_Q).rows == runner.execute(self.MIXED_Q).rows
         # approx_percentile distributed rides the same gathered path
         pq = (
@@ -337,15 +326,8 @@ class TestHolisticAggregates:
         ).rows
         assert rows == [[None, None, 0]]
 
-    def test_distributed_forces_single_step(self):
-        from trino_tpu.connectors.tpch import create_tpch_connector
-        from trino_tpu.runtime.coordinator import DistributedQueryRunner
-        from trino_tpu.engine import Session
-
-        d = DistributedQueryRunner(
-            Session(catalog="tpch", schema="tiny"), n_workers=2
-        )
-        d.register_catalog("tpch", create_tpch_connector())
+    def test_distributed_forces_single_step(self, tpch_cluster):
+        d = tpch_cluster
         rows = d.execute(
             "SELECT l_returnflag, approx_percentile(l_quantity, 0.5),"
             " max_by(l_orderkey, l_extendedprice)"

@@ -3,15 +3,11 @@ main/operator/scalar/ coverage tier, SURVEY.md §2.10)."""
 
 import pytest
 
-from trino_tpu.connectors.tpch import create_tpch_connector
-from trino_tpu.engine import LocalQueryRunner, Session
 
 
 @pytest.fixture(scope="module")
-def runner():
-    r = LocalQueryRunner(Session(catalog="tpch", schema="tiny"))
-    r.register_catalog("tpch", create_tpch_connector())
-    return r
+def runner(tpch_local):
+    return tpch_local
 
 
 CASES = [

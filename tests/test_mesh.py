@@ -21,10 +21,7 @@ import pytest
 from tests.oracle import assert_rows_match, sqlite_rows
 from tests.test_tpch import to_sqlite
 from tests.tpch_queries import QUERIES
-from trino_tpu.connectors.tpch import create_tpch_connector
-from trino_tpu.engine import Session
 from trino_tpu.parallel import mesh_plan
-from trino_tpu.runtime import DistributedQueryRunner
 
 SF = 0.01
 FAST_MESH_QUERIES = (1, 3, 5, 6, 11, 12, 14, 19, 20, 22)
@@ -47,12 +44,8 @@ def oracle():
 
 
 @pytest.fixture(scope="module")
-def runner():
-    r = DistributedQueryRunner(
-        Session(catalog="tpch", schema="tiny"), n_workers=2, hash_partitions=2
-    )
-    r.register_catalog("tpch", create_tpch_connector())
-    return r
+def runner(tpch_cluster):
+    return tpch_cluster
 
 
 @pytest.mark.parametrize("qid", MESH_QUERIES)

@@ -10,10 +10,8 @@ from trino_tpu.runtime.events import EventListener
 
 
 @pytest.fixture(scope="module")
-def runner():
-    r = LocalQueryRunner(Session(catalog="tpch", schema="tiny"))
-    r.register_catalog("tpch", create_tpch_connector())
-    return r
+def runner(tpch_local):
+    return tpch_local
 
 
 def test_explain_analyze_stats(runner):

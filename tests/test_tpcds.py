@@ -11,8 +11,7 @@ import sqlite3
 import pytest
 
 from tests.oracle import assert_rows_match, load_tpcds_sqlite, sqlite_rows
-from trino_tpu.connectors.tpcds import create_tpcds_connector, row_count
-from trino_tpu.engine import LocalQueryRunner, Session
+from trino_tpu.connectors.tpcds import row_count
 
 SF = 0.01
 
@@ -26,10 +25,8 @@ def oracle():
 
 
 @pytest.fixture(scope="module")
-def runner():
-    r = LocalQueryRunner(Session(catalog="tpcds", schema="tiny"))
-    r.register_catalog("tpcds", create_tpcds_connector())
-    return r
+def runner(tpcds_local):
+    return tpcds_local
 
 
 def test_row_counts(runner):
@@ -263,13 +260,8 @@ def test_tpcds_query(name, runner, oracle):
         pytest.param("q72", marks=pytest.mark.slow),
     ],
 )
-def test_tpcds_distributed(name, oracle):
-    from trino_tpu.runtime import DistributedQueryRunner
-
-    r = DistributedQueryRunner(
-        Session(catalog="tpcds", schema="tiny"), n_workers=2, hash_partitions=2
-    )
-    r.register_catalog("tpcds", create_tpcds_connector())
+def test_tpcds_distributed(name, oracle, tpcds_cluster):
+    r = tpcds_cluster
     sql = _sql_for(name, oracle)
     got = r.execute(sql).rows
     want = _oracle_rows(oracle, sql)

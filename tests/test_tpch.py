@@ -9,8 +9,6 @@ import pytest
 
 from tests.oracle import assert_rows_match, load_tpch_sqlite, sqlite_rows
 from tests.tpch_queries import QUERIES
-from trino_tpu.connectors.tpch import create_tpch_connector
-from trino_tpu.engine import LocalQueryRunner, Session
 
 SF = 0.01
 _EPOCH = datetime.date(1970, 1, 1)
@@ -77,10 +75,8 @@ def oracle():
 
 
 @pytest.fixture(scope="module")
-def runner():
-    r = LocalQueryRunner(Session(catalog="tpch", schema="tiny"))
-    r.register_catalog("tpch", create_tpch_connector())
-    return r
+def runner(tpch_local):
+    return tpch_local
 
 
 ORDERED = {q for q in QUERIES if "order by" in QUERIES[q]}

@@ -7,8 +7,6 @@ import pytest
 
 from tests.oracle import assert_rows_match, load_tpch_sqlite, sqlite_rows
 from tests.test_tpch import to_sqlite
-from trino_tpu.connectors.tpch import create_tpch_connector
-from trino_tpu.engine import LocalQueryRunner, Session
 
 SF = 0.01
 
@@ -22,10 +20,8 @@ def oracle():
 
 
 @pytest.fixture(scope="module")
-def runner():
-    r = LocalQueryRunner(Session(catalog="tpch", schema="tiny"))
-    r.register_catalog("tpch", create_tpch_connector())
-    return r
+def runner(tpch_local):
+    return tpch_local
 
 
 WINDOW_QUERIES = [
@@ -105,15 +101,10 @@ def test_unsupported_frame_rejected(runner):
         )
 
 
-def test_window_distributed(oracle):
+def test_window_distributed(oracle, tpch_cluster):
     """Window functions through the fragmenter: repartition on the
     PARTITION BY keys, window per task."""
-    from trino_tpu.runtime import DistributedQueryRunner
-
-    r = DistributedQueryRunner(
-        Session(catalog="tpch", schema="tiny"), n_workers=2, hash_partitions=2
-    )
-    r.register_catalog("tpch", create_tpch_connector())
+    r = tpch_cluster
     sql = (
         "select s_nationkey, s_name, sum(s_acctbal) over (partition by s_nationkey) t,"
         " row_number() over (partition by s_nationkey order by s_name) rn"

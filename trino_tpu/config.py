@@ -190,6 +190,20 @@ for _name, _type, _default, _desc, _allowed in [
      "arrivals beyond it are shed with 429 + Retry-After", None),
     ("admission_retry_after_s", float, 1.0,
      "Retry-After hint returned with shed (429) submissions", None),
+    # -- resident state tier (trino_tpu/resident/) --
+    ("resident_tables", str, "",
+     "comma-separated tables (table, schema.table or "
+     "catalog.schema.table) whose point lookups the serving fast lane "
+     "serves from pinned device-resident hash tables; empty disables "
+     "the fast lane", None),
+    ("resident_pin_budget_mb", int, 64,
+     "device-memory budget for resident pins (fast-lane hash tables "
+     "and mesh prelude contexts), LRU-evicted and revocable under "
+     "memory pressure; 0 disables pinning entirely", None),
+    ("resident_delta_max_rows", int, 4096,
+     "capacity of a pinned table's append-only delta side; background "
+     "compaction folds the delta into the base once it crosses half "
+     "this, and an insert that cannot fit evicts the pin instead", None),
     # -- observability (runtime/tracing.py) --
     ("query_trace", str, "off",
      "record a full span tree per query (phases, stages, task attempts, "

@@ -139,6 +139,13 @@ class Session:
     admission_fast_depth: int = 64
     admission_general_depth: int = 256
     admission_retry_after_s: float = 1.0
+    # resident state tier (trino_tpu/resident/): tables whose point
+    # lookups serve from pinned device-resident hash tables, the
+    # device-memory pin budget (0 disables pinning), and the delta-side
+    # capacity before background compaction folds it into the base
+    resident_tables: str = ""
+    resident_pin_budget_mb: int = 64
+    resident_delta_max_rows: int = 4096
 
     def set_property(self, name: str, value) -> None:
         """SET SESSION entry point — validated through the typed
@@ -424,7 +431,7 @@ class LocalQueryRunner:
             from trino_tpu.connectors.spi import ColumnMetadata
             from trino_tpu.sql.analyzer import resolve_type
 
-            conn, schema, table = self._resolve_target(stmt.table)
+            cat, conn, schema, table = self._resolve_target(stmt.table)
             self.access_control.check_can_create_table(
                 self.identity, conn.name, schema, table
             )
@@ -433,7 +440,7 @@ class LocalQueryRunner:
                 ColumnMetadata(n, resolve_type(t)) for n, t in stmt.columns
             ]
             conn.metadata.create_table(schema, table, cols)
-            self._invalidate_plans()
+            self._invalidate_plans(table=(cat, schema, table))
             return MaterializedResult([[True]], ["result"], [T.BOOLEAN])
         if isinstance(stmt, ast.CreateTableAs):
             return self._execute_ctas(stmt)
@@ -451,7 +458,7 @@ class LocalQueryRunner:
                 stmt.table, stmt.where, dict(stmt.assignments)
             )
         if isinstance(stmt, ast.DropTable):
-            conn, schema, table = self._resolve_target(stmt.table)
+            cat, conn, schema, table = self._resolve_target(stmt.table)
             self.access_control.check_can_drop_table(
                 self.identity, conn.name, schema, table
             )
@@ -460,7 +467,7 @@ class LocalQueryRunner:
             if handle is None:
                 raise AnalysisError(f"table {schema}.{table} does not exist")
             conn.metadata.drop_table(handle)
-            self._invalidate_plans()
+            self._invalidate_plans(table=(cat, schema, table))
             return MaterializedResult([[True]], ["result"], [T.BOOLEAN])
         if isinstance(stmt, ast.SetSession):
             self.access_control.check_can_set_session_property(
@@ -583,28 +590,54 @@ class LocalQueryRunner:
             check_plan_determinism(plan_once)
         return root
 
-    def _invalidate_plans(self) -> None:
+    def _invalidate_plans(self, table=None, appended: bool = False,
+                          tap=None) -> None:
         """Cached physical plans capture split lists (data snapshots) at
         plan time, so any write/DDL invalidates them — the analogue of
-        the reference re-planning every query against current metadata."""
-        self._plan_cache.invalidate()
+        the reference re-planning every query against current metadata.
+
+        When the write can name its target (`table` = (catalog, schema,
+        table)), invalidation is table-granular: only plans reading the
+        written table drop, the table's generation counter bumps (the
+        resident-tier invalidation protocol), and pinned resident state
+        over the table is evicted — or, for an INSERT whose rows a
+        `DeltaTap` captured (`appended`/`tap`), re-keyed onto the delta
+        side so the pin stays warm. Writes that cannot name a table
+        (COMMIT) stay wholesale."""
+        from trino_tpu.resident import GENERATIONS, RESIDENT
+        from trino_tpu.resident import fastlane as _fastlane
+        from trino_tpu.resident.manager import table_key
+
+        if table is None:
+            self._plan_cache.invalidate()
+            GENERATIONS.bump_all()
+            RESIDENT.evict_all()
+            return
+        tkey = table_key(*table)
+        self._plan_cache.invalidate_tables([tkey])
+        GENERATIONS.bump(tkey)
+        _fastlane.table_written(*tkey, appended=appended, tap=tap)
 
     # -- DML (BeginTableWrite/TableWriter/TableFinish path) --
     def _resolve_target(self, parts):
+        # returns the REGISTERED catalog name alongside the connector:
+        # conn.name is the connector type ("file"), which need not match
+        # the registration name ("files") that plan/resident table keys
+        # are built from on the read side
         cat, schema = self.session.catalog, self.session.schema
         table = parts[-1]
         if len(parts) == 2:
             schema = parts[0]
         elif len(parts) == 3:
             cat, schema = parts[0], parts[1]
-        return self.catalogs.get(cat), schema, table
+        return cat, self.catalogs.get(cat), schema, table
 
     def _execute_ctas(self, stmt: ast.CreateTableAs) -> MaterializedResult:
         from trino_tpu.connectors.spi import ColumnMetadata
 
         output = self._analyze(stmt.query)
         self._check_scans(output)
-        conn, schema, table = self._resolve_target(stmt.table)
+        cat, conn, schema, table = self._resolve_target(stmt.table)
         self.access_control.check_can_create_table(
             self.identity, conn.name, schema, table
         )
@@ -614,17 +647,19 @@ class LocalQueryRunner:
             for i, (n, f) in enumerate(zip(output.names, output.fields))
         ]
         conn.metadata.create_table(schema, table, cols)
-        return self._write_into(conn, schema, table, output, list(output.names))
+        return self._write_into(
+            cat, conn, schema, table, output, list(output.names)
+        )
 
     def _execute_insert(self, parts, columns, query: ast.Query) -> MaterializedResult:
-        conn, schema, table = self._resolve_target(parts)
+        cat, conn, schema, table = self._resolve_target(parts)
         self.access_control.check_can_insert(
             self.identity, conn.name, schema, table
         )
         output = self._analyze(query)
         self._check_scans(output)
         return self._write_into(
-            conn, schema, table, output,
+            cat, conn, schema, table, output,
             list(columns) if columns else None,
         )
 
@@ -638,7 +673,7 @@ class LocalQueryRunner:
         a matched-rows count pass."""
         from trino_tpu.transaction import TransactionError
 
-        conn, schema, table = self._resolve_target(parts)
+        cat, conn, schema, table = self._resolve_target(parts)
         check = (
             self.access_control.check_can_delete
             if assignments is None
@@ -688,7 +723,7 @@ class LocalQueryRunner:
             )
             if keep is None:  # unconditional DELETE = truncate
                 conn.metadata.truncate_table(handle)
-                self._invalidate_plans()
+                self._invalidate_plans(table=(cat, schema, table))
                 return MaterializedResult([[affected]], ["rows"], [T.BIGINT])
             select = tuple(
                 ast.SelectItem(ast.Identifier((c.name,))) for c in meta.columns
@@ -717,11 +752,11 @@ class LocalQueryRunner:
                 items.append(ast.SelectItem(e, c.name))
             rewrite_q = ast.Query(ast.QuerySpec(tuple(items), from_=rel))
 
-        self._replace_table_from_queries(conn, handle, meta, [rewrite_q])
+        self._replace_table_from_queries(cat, conn, handle, meta, [rewrite_q])
         return MaterializedResult([[affected]], ["rows"], [T.BIGINT])
 
     def _replace_table_from_queries(
-        self, conn, handle, meta, queries
+        self, cat, conn, handle, meta, queries
     ) -> List[int]:
         """Materialize each rewrite query, coerce onto the table
         schema, and swap the combined batches in as the table's new
@@ -778,7 +813,9 @@ class LocalQueryRunner:
             for b in batches:
                 writer_sink.append(b)
             writer_sink.finish()
-        self._invalidate_plans()
+        self._invalidate_plans(
+            table=(cat, handle.schema, handle.table)
+        )
         return counts
 
     def _execute_merge(self, stmt: ast.Merge) -> MaterializedResult:
@@ -798,7 +835,7 @@ class LocalQueryRunner:
           grouped count before the rewrite."""
         from trino_tpu.transaction import TransactionError
 
-        conn, schema, table = self._resolve_target(stmt.table)
+        cat, conn, schema, table = self._resolve_target(stmt.table)
         # each privilege gates only on the arms actually present
         # (Trino checks UPDATE/DELETE/INSERT per MERGE case kind)
         if any(c.action == "update" for c in stmt.clauses):
@@ -1008,7 +1045,7 @@ class LocalQueryRunner:
         if nm_clauses:
             queries.append(ast.Query(insert_spec))
         counts = self._replace_table_from_queries(
-            conn, handle, meta, queries
+            cat, conn, handle, meta, queries
         )
         # the insert rewrite IS the anti-join — its materialized row
         # count is the inserted count (no third join execution)
@@ -1018,7 +1055,7 @@ class LocalQueryRunner:
         )
 
     def _write_into(
-        self, conn, schema: str, table: str, output: OutputNode,
+        self, cat: str, conn, schema: str, table: str, output: OutputNode,
         insert_columns: Optional[List[str]],
     ) -> MaterializedResult:
         """Coerce the source onto the table schema and stream it into
@@ -1081,13 +1118,25 @@ class LocalQueryRunner:
             )
         else:
             sink_impl = conn.page_sink(handle, transaction=txn_handle)
+        # when a resident pin covers this table, tee the written rows
+        # through a DeltaTap so the pin can absorb the insert on its
+        # delta side instead of being evicted
+        from trino_tpu.resident import fastlane as _fastlane
+
+        tap = _fastlane.delta_tap(
+            cat, schema, table, [c.name for c in meta.columns]
+        )
+        if tap is not None:
+            sink_impl = _fastlane.TeeSink(sink_impl, tap)
         writer = TableWriterOperator(sink_impl)
         chain.append(writer)
         for p in pipelines:
             Driver(p).run()
         Driver(Pipeline(chain)).run()
         _raise_deferred_checks(ctx)
-        self._invalidate_plans()
+        self._invalidate_plans(
+            table=(cat, schema, table), appended=True, tap=tap
+        )
         return MaterializedResult([[writer.rows_written]], ["rows"], [T.BIGINT])
 
     def _run_tracked(self, sql: str, stmt: ast.Query) -> MaterializedResult:
@@ -1227,8 +1276,11 @@ class LocalQueryRunner:
         # plans with analysis-time-folded volatile values (now(),
         # current_date, uuid()) re-analyze every execution
         if cache_key and not plan_is_volatile():
+            from trino_tpu.serving.plan_cache import plan_tables
+
             self._plan_cache.store(
-                cache_key, (output, physical), generation=cache_generation
+                cache_key, (output, physical), generation=cache_generation,
+                tables=plan_tables(output),
             )
         return output, physical
 
@@ -1238,6 +1290,12 @@ class LocalQueryRunner:
             from trino_tpu.runtime.memory import MemoryPool
 
             ctx["memory_pool"] = MemoryPool(self.session.memory_pool_bytes)
+            # register resident pins revocable in this query's pool: a
+            # reservation that cannot fit reclaims warm state BEFORE the
+            # exhaustion handler considers killing a query
+            from trino_tpu.resident import RESIDENT
+
+            RESIDENT.attach_pool(ctx["memory_pool"])
         return ctx
 
     def _make_stabilizer(self):

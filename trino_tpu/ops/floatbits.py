@@ -32,8 +32,12 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-_SIGN32 = jnp.uint32(0x80000000)
+# numpy on purpose: this module is imported lazily from inside traced
+# code (ops/hashing), and a jnp constant created mid-trace would be a
+# tracer pinned to that trace — poisoning every later retrace
+_SIGN32 = np.uint32(0x80000000)
 
 
 def _flip32(bits: jnp.ndarray) -> jnp.ndarray:

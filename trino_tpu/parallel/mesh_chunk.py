@@ -765,9 +765,12 @@ class ChunkedMeshRunner:
     (deterministic ladder — a second execution replays the same
     capacity sequence and hits every cached program)."""
 
-    def __init__(self, ex, mesh_sps, root_child_ids, repl, feeds, host_feeds):
+    def __init__(self, ex, mesh_sps, root_child_ids, repl, feeds, host_feeds,
+                 feed_tables=()):
         self.ex = ex
         self.session = ex.session
+        # source table per feed (resident-tier generation domain)
+        self.feed_tables = tuple(feed_tables)
         self.mesh_sps = mesh_sps
         self.root_child_ids = root_child_ids
         self.repl = repl
@@ -797,6 +800,7 @@ class ChunkedMeshRunner:
             jax.device_put(b, self.sharding) for b in host_feeds
         )
         self.info: Dict[str, object] = {}
+        self._last_record_key = None
 
     # -- program record ----------------------------------------------
     def _record(self, caps) -> MeshProgramRecord:
@@ -810,6 +814,7 @@ class ChunkedMeshRunner:
             self.ex, self.mesh_sps, self.root_child_ids, self.repl,
             self.feed_sigs, self.cplan, caps,
         )
+        self._last_record_key = key
         if key is None:
             return build()
         record = PROGRAM_CACHE.get_or_create(key, build)
@@ -895,9 +900,9 @@ class ChunkedMeshRunner:
             preempt(0, K)
         pctx: tuple = ()
         if record.prelude_fn is not None:
-            with op_span("MeshPrelude", attempt=attempt):
-                p_outs, pctx, flags = record.prelude_fn(self.feed_args)
-                self._check_flags(record.prelude_sites, flags, n)
+            p_outs, pctx = self._run_prelude(
+                record, task_span, op_span, attempt, n
+            )
             for (fid, rep), b in zip(record.prelude_out_meta, p_outs):
                 outs[fid] = (b, rep)
 
@@ -956,6 +961,62 @@ class ChunkedMeshRunner:
             fid: self.ex._shard_pages(batch, rep)
             for fid, (batch, rep) in outs.items()
         }
+
+    def _run_prelude(self, record: MeshProgramRecord, task_span, op_span,
+                     attempt: int, n: int):
+        """Prelude with a resident-tier consult: a warm hit reuses the
+        pinned (p_outs, pctx) and skips the dispatch entirely (neither
+        is ever donated — step donates only carries — so reuse is
+        safe); a miss runs the prelude and pins the exported ctx under
+        the feed tables' generation snapshot. Keyed off the program
+        record key, so uncacheable plans (repr-identity leaks) never
+        pin."""
+        rkey = None
+        budget_mb = int(
+            getattr(self.session, "resident_pin_budget_mb", 64) or 0
+        )
+        if self._last_record_key is not None and budget_mb > 0:
+            from trino_tpu.resident import GENERATIONS, RESIDENT
+
+            rkey = (
+                "resident-mesh",
+                self._last_record_key,
+                GENERATIONS.snapshot(self.feed_tables),
+            )
+            cached = RESIDENT.lookup(rkey)
+            if cached is not None:
+                if task_span is not None:
+                    task_span.event("resident_hit", tier="mesh-prelude")
+                self.info["prelude_resident"] = True
+                return cached
+            # a live entry under a stale generation is unreachable by
+            # key; reclaim its device memory eagerly
+            for stale in RESIDENT.entries_for_prefix(
+                ("resident-mesh", self._last_record_key)
+            ):
+                if stale != rkey and RESIDENT.evict(stale):
+                    if task_span is not None:
+                        task_span.event(
+                            "resident_evict", tier="mesh-prelude"
+                        )
+        with op_span("MeshPrelude", attempt=attempt):
+            p_outs, pctx, flags = record.prelude_fn(self.feed_args)
+            self._check_flags(record.prelude_sites, flags, n)
+        if rkey is not None:
+            import jax.tree_util as jtu
+
+            from trino_tpu.resident import RESIDENT
+
+            bytes_ = sum(
+                int(getattr(x, "nbytes", 0))
+                for x in jtu.tree_leaves((p_outs, pctx))
+            )
+            RESIDENT.configure(budget_mb << 20)
+            RESIDENT.pin(
+                rkey, (tuple(p_outs), pctx), bytes_,
+                set(self.feed_tables),
+            )
+        return tuple(p_outs), pctx
 
     def _check_flags(self, sites, flag_arr, n):
         vals = np.asarray(jax.device_get(flag_arr))

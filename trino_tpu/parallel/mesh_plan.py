@@ -894,10 +894,15 @@ class MeshExecutor:
             _check_node(sp.fragment.root)
         root_child_ids = {c.fragment.id for c in root_sp.children}
         repl = _replicated_map(mesh_sps)
+        # feed_tables (aligned 1:1 with host_feeds) names each feed's
+        # source table — the resident tier's generation-snapshot domain
+        # for pinned prelude contexts
+        self._feed_tables: List[tuple] = []
         feeds, host_feeds = self._load_scans(mesh_sps)
 
         runner = ChunkedMeshRunner(
-            self, mesh_sps, root_child_ids, repl, feeds, host_feeds
+            self, mesh_sps, root_child_ids, repl, feeds, host_feeds,
+            feed_tables=tuple(self._feed_tables),
         )
         sources = runner.run(preempt=preempt, query_span=query_span)
         # count only after the programs have actually produced results —
@@ -954,6 +959,11 @@ class MeshExecutor:
                         shard_batches.append(_empty_batch(schema))
                 feeds[id(node)] = len(host_feeds)
                 host_feeds.append(_stack_shards(shard_batches, self.n))
+                self._feed_tables.append((
+                    str(node.catalog).lower(),
+                    str(node.handle.schema).lower(),
+                    str(node.handle.table).lower(),
+                ))
         return feeds, host_feeds
 
     # -- host boundary --

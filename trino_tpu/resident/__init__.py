@@ -1,0 +1,35 @@
+"""Resident state tier: device-pinned warm state shared ACROSS queries.
+
+The serving tier (PR 8) cached plans; the mesh plane (PR 10) cached
+compiled programs. Both still rebuild their DATA every execution: the
+mesh prelude re-runs build sides from host batches, and the fast lane's
+point lookups re-scan the probed table. This package pins that state on
+the device between queries:
+
+- `manager.py` — per-table generation counters (the plan cache's
+  generation guard made table-granular) and the `ResidentStateManager`:
+  a pin budget with LRU eviction, optional charging against a PR 2
+  MemoryPool (the low-memory killer revokes pins before killing
+  queries), and the `resident.*` counter surface.
+- `table.py` — `ResidentTable`: a point-lookup hash table whose probe
+  side lives on device at a capacity-ladder rung, probed by a
+  shape-stable jitted program, with an append-only delta side and a
+  background compaction merge that folds the delta back at ladder
+  rungs.
+- `fastlane.py` — the serving-tier hook: classify a point lookup (the
+  micro-batcher's strict classifier), probe the pinned table on a hit,
+  build+pin on a miss, and degrade to the cold execute path whenever
+  anything is surprising.
+
+Invalidation protocol: DML bumps the written table's generation (an
+INSERT may instead ride the delta path and re-key the entry), DDL drops
+the table's entries, and wholesale events (COMMIT, catalog
+registration) bump a global epoch that stales every key.
+"""
+
+from trino_tpu.resident.manager import (  # noqa: F401
+    GENERATIONS,
+    RESIDENT,
+    ResidentStateManager,
+    TableGenerations,
+)

@@ -1,0 +1,335 @@
+"""Device-resident point-lookup hash tables with delta maintenance.
+
+A `ResidentTable` pins the PROBE side of a point lookup on device: the
+key column as an int64 array padded to a capacity-ladder rung plus a
+live mask. Probing is a shape-stable jitted program — mask-and-
+`nonzero(size=...)` — so a warm lookup does zero host->device table
+transfer and zero rebuild; the only readback is a tiny index vector.
+Result VALUES stay host-side (result rows materialize on the host
+regardless), indexed positionally by the device match indices. String
+keys dictionary-encode through a host map (the dictionary IS the string
+hash table; the device still arbitrates the probe so dtype/shape
+classes stay uniform).
+
+Writes ride an append-only delta: inserts land in a small delta-side
+table at a low capacity rung (`resident_delta_max_rows`), probes check
+base+delta (two dispatches of the SAME probe program at two rungs), and
+a background compaction merge — a jitted densify-concat program at
+ladder rungs — folds the delta back into the base so probe shapes stay
+inside already-compiled classes. Probe and compaction programs register
+WarmupEntrys (the compile regime can AOT-warm them) and are cached in
+PROGRAM_CACHE keyed by their capacity pair, shared across tables.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.block import bucket_capacity
+from trino_tpu.compile.cache import PROGRAM_CACHE
+from trino_tpu.compile.warmup import WarmupEntry, note_classes_warm
+
+# matches returned per probe before the fast path bails to the cold
+# execute (a point-lookup key with >16 duplicate rows is not a point
+# lookup worth pinning)
+PROBE_OUT_CAP = 16
+
+# WarmupEntry registry for resident programs (the MESH_WARMUP_ENTRIES
+# idiom): bounded, observable, consumable by any WarmupService.
+RESIDENT_WARMUP_ENTRIES: List[WarmupEntry] = []
+_MAX_WARMUP_ENTRIES = 64
+_warm_lock = threading.Lock()
+
+
+def register_resident_warmup(entries: Sequence[WarmupEntry]) -> None:
+    with _warm_lock:
+        known = {(e.operator, e.capacities, e.out_dtypes)
+                 for e in RESIDENT_WARMUP_ENTRIES}
+        RESIDENT_WARMUP_ENTRIES.extend(
+            e for e in entries
+            if (e.operator, e.capacities, e.out_dtypes) not in known
+        )
+        del RESIDENT_WARMUP_ENTRIES[:-_MAX_WARMUP_ENTRIES]
+
+
+def resident_warmup_entries() -> List[WarmupEntry]:
+    with _warm_lock:
+        return list(RESIDENT_WARMUP_ENTRIES)
+
+
+# -- programs (shared across tables, keyed by capacity class) ----------
+
+
+def _probe_program(cap: int, out_cap: int):
+    def build():
+        def probe(keys, valid, q):
+            match = valid & (keys == q)
+            idx = jnp.nonzero(match, size=out_cap, fill_value=cap)[0]
+            return idx, jnp.sum(match)
+
+        return jax.jit(probe)
+
+    return PROGRAM_CACHE.get_or_create(
+        ("resident-probe", cap, out_cap), build
+    )
+
+
+def _compact_program(base_cap: int, delta_cap: int, out_cap: int):
+    """Densify-concat merge: live base keys then live delta keys, in
+    order, padded to `out_cap` (a ladder rung sized to the live
+    total)."""
+
+    def build():
+        def compact(bk, bv, dk, dv):
+            keys = jnp.concatenate([bk, dk])
+            valid = jnp.concatenate([bv, dv])
+            total = keys.shape[0]
+            idx = jnp.nonzero(valid, size=out_cap, fill_value=total)[0]
+            guarded = jnp.concatenate(
+                [keys, jnp.zeros((1,), dtype=keys.dtype)]
+            )
+            new_keys = guarded[idx]
+            new_valid = jnp.arange(out_cap) < jnp.sum(valid)
+            return new_keys, new_valid
+
+        return jax.jit(compact)
+
+    return PROGRAM_CACHE.get_or_create(
+        ("resident-compact", base_cap, delta_cap, out_cap), build
+    )
+
+
+class _ProbeWarmer:
+    """WarmupEntry.fn adapter: ignores the zeros batch the service
+    hands it and dispatches the probe at its recorded shapes."""
+
+    def __init__(self, cap: int, out_cap: int):
+        self.cap, self.out_cap = cap, out_cap
+
+    def __call__(self, _batch) -> None:
+        fn = _probe_program(self.cap, self.out_cap)
+        idx, n = fn(
+            jnp.zeros((self.cap,), dtype=jnp.int64),
+            jnp.zeros((self.cap,), dtype=bool),
+            jnp.asarray(0, dtype=jnp.int64),
+        )
+        jax.block_until_ready((idx, n))
+
+
+class _CompactWarmer:
+    def __init__(self, base_cap: int, delta_cap: int, out_cap: int):
+        self.base_cap, self.delta_cap, self.out_cap = (
+            base_cap, delta_cap, out_cap,
+        )
+
+    def __call__(self, _batch) -> None:
+        fn = _compact_program(self.base_cap, self.delta_cap, self.out_cap)
+        out = fn(
+            jnp.zeros((self.base_cap,), dtype=jnp.int64),
+            jnp.zeros((self.base_cap,), dtype=bool),
+            jnp.zeros((self.delta_cap,), dtype=jnp.int64),
+            jnp.zeros((self.delta_cap,), dtype=bool),
+        )
+        jax.block_until_ready(out)
+
+
+def _probe_entry(cap: int) -> WarmupEntry:
+    return WarmupEntry(
+        operator="ResidentProbe",
+        fn=_ProbeWarmer(cap, PROBE_OUT_CAP),
+        in_schema=[(T.BIGINT, None)],
+        out_dtypes=("int64",),
+        capacities=(cap,),
+    )
+
+
+def _compact_entry(base_cap: int, delta_cap: int, out_cap: int) -> WarmupEntry:
+    return WarmupEntry(
+        operator="ResidentCompact",
+        fn=_CompactWarmer(base_cap, delta_cap, out_cap),
+        in_schema=[(T.BIGINT, None)],
+        out_dtypes=(f"d{delta_cap}", f"o{out_cap}"),
+        capacities=(base_cap,),
+    )
+
+
+# -- the table ---------------------------------------------------------
+
+
+class ResidentTable:
+    """One pinned point-lookup table: key column + live mask on device,
+    value rows host-side, plus an append-only delta at a low rung."""
+
+    def __init__(self, key_col: str, names: List[str], types: List,
+                 key_values: List, value_rows: List[list],
+                 string_key: bool, delta_max_rows: int = 4096):
+        self.key_col = key_col
+        self.names = list(names)
+        self.types = list(types)
+        self.string_key = bool(string_key)
+        self.delta_max_rows = max(1, int(delta_max_rows))
+        # string keys dictionary-encode through a host map; int keys
+        # are their own code
+        self._code_of = {} if string_key else None
+        codes = [self._encode(k) for k in key_values]
+        self.base_cap = bucket_capacity(max(16, len(codes)))
+        self.base_live = len(codes)
+        self.base_keys = jnp.asarray(
+            np.pad(
+                np.asarray(codes, dtype=np.int64),
+                (0, self.base_cap - len(codes)),
+            )
+        )
+        self.base_valid = jnp.asarray(
+            np.arange(self.base_cap) < len(codes)
+        )
+        self.base_rows: List[list] = [list(r) for r in value_rows]
+        self.delta_cap = bucket_capacity(max(16, self.delta_max_rows))
+        self._delta_codes: List[int] = []
+        self.delta_rows: List[list] = []
+        self._delta_keys = None
+        self._delta_valid = None
+        self._lock = threading.RLock()
+        register_resident_warmup(
+            [_probe_entry(self.base_cap), _probe_entry(self.delta_cap)]
+        )
+        # pay probe compiles at build time (the build already paid a
+        # full table scan; two dead dispatches keep them off the first
+        # warm lookup) and mark the classes warm for the watchdog
+        _ProbeWarmer(self.base_cap, PROBE_OUT_CAP)(None)
+        _ProbeWarmer(self.delta_cap, PROBE_OUT_CAP)(None)
+        note_classes_warm([
+            ("ResidentProbe", self.base_cap, ("int64",)),
+            ("ResidentProbe", self.delta_cap, ("int64",)),
+        ])
+
+    # -- keys ----------------------------------------------------------
+    def _encode(self, key, create: bool = True) -> Optional[int]:
+        if self._code_of is None:
+            return int(key)
+        code = self._code_of.get(key)
+        if code is None and create:
+            code = len(self._code_of)
+            self._code_of[key] = code
+        return code
+
+    @property
+    def dtype_sig(self) -> Tuple[str, ...]:
+        return ("str" if self.string_key else "int64",) + tuple(
+            str(t) for t in self.types
+        )
+
+    @property
+    def device_bytes(self) -> int:
+        total = self.base_keys.nbytes + self.base_valid.nbytes
+        if self._delta_keys is not None:
+            total += self._delta_keys.nbytes + self._delta_valid.nbytes
+        return int(total)
+
+    # -- probe ---------------------------------------------------------
+    def probe(self, key) -> Optional[List[list]]:
+        """All value rows matching `key`, base order then delta order
+        (append order — the oracle's scan order). None = bail to the
+        cold path (per-key fanout exceeded PROBE_OUT_CAP)."""
+        with self._lock:
+            code = self._encode(key, create=False)
+            if code is None:
+                return []  # never-seen string key: provably no rows
+            fn = _probe_program(self.base_cap, PROBE_OUT_CAP)
+            q = jnp.asarray(code, dtype=jnp.int64)
+            idx, n = fn(self.base_keys, self.base_valid, q)
+            parts = [(idx, n, self.base_rows, self.base_cap)]
+            if self._delta_keys is not None:
+                dfn = _probe_program(self.delta_cap, PROBE_OUT_CAP)
+                didx, dn = dfn(self._delta_keys, self._delta_valid, q)
+                parts.append((didx, dn, self.delta_rows, self.delta_cap))
+            out: List[list] = []
+            for pidx, pn, rows, cap in parts:
+                host_idx, host_n = jax.device_get((pidx, pn))
+                if int(host_n) > PROBE_OUT_CAP:
+                    return None
+                for i in np.asarray(host_idx):
+                    if int(i) < cap and int(i) < len(rows):
+                        out.append(list(rows[int(i)]))
+            return out
+
+    # -- delta maintenance --------------------------------------------
+    def delta_room(self, n_rows: int) -> bool:
+        with self._lock:
+            return len(self.delta_rows) + n_rows <= self.delta_max_rows
+
+    def append_delta(self, key_values: List, value_rows: List[list]) -> bool:
+        """Append inserted rows to the delta side. False = out of delta
+        room (caller evicts; the next lookup rebuilds cold)."""
+        with self._lock:
+            if len(self.delta_rows) + len(key_values) > self.delta_max_rows:
+                return False
+            self._delta_codes.extend(self._encode(k) for k in key_values)
+            self.delta_rows.extend(list(r) for r in value_rows)
+            n = len(self._delta_codes)
+            self._delta_keys = jnp.asarray(
+                np.pad(
+                    np.asarray(self._delta_codes, dtype=np.int64),
+                    (0, self.delta_cap - n),
+                )
+            )
+            self._delta_valid = jnp.asarray(np.arange(self.delta_cap) < n)
+            return True
+
+    @property
+    def delta_count(self) -> int:
+        with self._lock:
+            return len(self.delta_rows)
+
+    def wants_compaction(self) -> bool:
+        with self._lock:
+            return len(self.delta_rows) >= max(
+                1, self.delta_max_rows // 2
+            )
+
+    def compact(self) -> None:
+        """Fold the delta into the base at a ladder rung sized to the
+        live total, via the jitted densify-concat program, then warm
+        the probe at the (possibly new) base rung so post-compaction
+        probes land on a compiled class."""
+        with self._lock:
+            if not self.delta_rows or self._delta_keys is None:
+                return
+            live_total = self.base_live + len(self.delta_rows)
+            out_cap = bucket_capacity(max(16, live_total))
+            old_cap = self.base_cap
+            register_resident_warmup([
+                _compact_entry(old_cap, self.delta_cap, out_cap),
+                _probe_entry(out_cap),
+            ])
+            fn = _compact_program(old_cap, self.delta_cap, out_cap)
+            new_keys, new_valid = fn(
+                self.base_keys, self.base_valid,
+                self._delta_keys, self._delta_valid,
+            )
+            # host rows follow the same densify order: live base rows
+            # (positions 0..L-1 are dense by construction) then delta
+            merged = [list(r) for r in self.base_rows[: self.base_live]]
+            merged.extend(list(r) for r in self.delta_rows)
+            self.base_keys = new_keys
+            self.base_valid = new_valid
+            self.base_cap = out_cap
+            self.base_live = live_total
+            self.base_rows = merged
+            self._delta_codes = []
+            self.delta_rows = []
+            self._delta_keys = None
+            self._delta_valid = None
+            # pre-warm the probe at the new rung off the query path
+            _ProbeWarmer(self.base_cap, PROBE_OUT_CAP)(None)
+            note_classes_warm([
+                ("ResidentProbe", self.base_cap, ("int64",)),
+                ("ResidentCompact", old_cap, (f"d{self.delta_cap}",
+                                              f"o{out_cap}")),
+            ])

@@ -445,6 +445,28 @@ class DistributedQueryRunner:
     def register_catalog(self, name: str, connector: Connector) -> None:
         self.catalogs.register(name, connector)
         self._plan_cache.invalidate()
+        # a new catalog can shadow names any cached state resolved
+        # against — wholesale epoch bump, not table-granular
+        from trino_tpu.resident import GENERATIONS, RESIDENT
+
+        GENERATIONS.bump_all()
+        RESIDENT.evict_all()
+
+    def _dml_target(self, stmt):
+        """(catalog, schema, table) a non-Query statement writes, via
+        the session defaults (the embedded runner's _resolve_target
+        rule); None = cannot name one (COMMIT/ROLLBACK — wholesale)."""
+        parts = getattr(stmt, "table", None)
+        if not parts or not isinstance(parts, (tuple, list)):
+            return None
+        cat, schema = self.session.catalog, self.session.schema
+        if len(parts) == 2:
+            schema = parts[0]
+        elif len(parts) == 3:
+            cat, schema = parts[0], parts[1]
+        from trino_tpu.resident.manager import table_key
+
+        return table_key(cat, schema, parts[-1])
 
     def _embedded_runner(self):
         if getattr(self, "_embedded", None) is None:
@@ -548,8 +570,16 @@ class DistributedQueryRunner:
                 ast.Commit, ast.Rollback,
             )):
                 # cached plans captured split listings over data this
-                # statement may have changed
-                self._plan_cache.invalidate()
+                # statement may have changed. The embedded runner already
+                # drove the resident-tier protocol (generation bump /
+                # delta re-key) — here only the DISTRIBUTED plan cache
+                # needs dropping, table-granular when the statement names
+                # its target
+                tkey = self._dml_target(stmt)
+                if tkey is not None:
+                    self._plan_cache.invalidate_tables([tkey])
+                else:
+                    self._plan_cache.invalidate()
             return result
         from trino_tpu.runtime.query_tracker import DeadlineLimits, PLANNING
 
@@ -680,9 +710,12 @@ class DistributedQueryRunner:
                     ),
                 )
             if cache_key is not None and not plan_is_volatile():
+                from trino_tpu.serving.plan_cache import plan_tables
+
                 self._plan_cache.store(
                     cache_key, (output, subplan),
                     generation=cache_generation,
+                    tables=plan_tables(output),
                 )
         # planning is over: surface a planning-limit kill latched during
         # the analyze/optimize/fragment work before any task launches
@@ -917,6 +950,21 @@ class DistributedQueryRunner:
             f"all_gather={info['all_gather']}, {chunking})"
         )
 
+    def _resident_line(self) -> str:
+        """The EXPLAIN ANALYZE resident-tier line: current pin
+        population and lifetime counter totals from the process
+        singleton (what warm state a re-execution could reuse)."""
+        from trino_tpu.resident import RESIDENT
+
+        s = RESIDENT.stats()
+        return (
+            f"resident= entries={s['entries']} "
+            f"pinned_bytes={s['pinned_bytes']} hits={s['hits']} "
+            f"misses={s['misses']} pins={s['pins']} "
+            f"evictions={s['evictions']} revocations={s['revocations']} "
+            f"compactions={s['compactions']}"
+        )
+
     def _explain_text(self, subplan) -> str:
         """Fragment rendering with per-fragment compile-churn census
         annotations (expected_xla_lowerings — sql/validate.py)."""
@@ -958,6 +1006,7 @@ class DistributedQueryRunner:
             # take (the ANALYZE instrumentation itself runs the page
             # scheduler above either way, for the operator stats)
             lines.append(self._mesh_plane_line(subplan))
+            lines.append(self._resident_line())
             return MaterializedResult(
                 [["\n".join(lines)]], ["Query Plan"], [T.VARCHAR]
             )

@@ -535,7 +535,18 @@ class CoordinatorServer:
                 except (TypeError, ValueError):
                     pass
                 result = None
-                if self.batcher is not None:
+                # resident fast lane first: a pinned point lookup is a
+                # device probe — faster than even a batched execution,
+                # and a None falls through unchanged
+                from trino_tpu.resident.fastlane import (
+                    try_resident_lookup,
+                )
+
+                result = try_resident_lookup(
+                    self.runner, sql, identity=identity,
+                    prepared=prepared or None,
+                )
+                if result is None and self.batcher is not None:
                     # point lookups coalesce onto one shared device step
                     # (None = not batchable: normal execution below)
                     result = self.batcher.submit(

@@ -17,10 +17,13 @@ pair and goes straight to execution. The key has three parts:
    binding 1.5 compile different kernels even for equal canonical
    prefixes).
 
-Entries are LRU-bounded, invalidated wholesale on any catalog/schema
-change (cached physical plans capture split listings — data
-snapshots), and never store volatile plans (now(), uuid() fold at
-analysis time). Counters surface in /v1/metrics as
+Entries are LRU-bounded and never store volatile plans (now(), uuid()
+fold at analysis time). Invalidation is table-granular when the write
+can name its target (`invalidate_tables` — DML drops only plans that
+read the written table, the resident-tier protocol) and wholesale
+otherwise (`invalidate` — COMMIT, catalog registration; cached
+physical plans capture split listings, i.e. data snapshots). Counters
+surface in /v1/metrics as
 plan_cache.{hits,misses,evictions,invalidations}.
 """
 
@@ -58,6 +61,28 @@ def plan_properties(session) -> Tuple:
     )
 
 
+def plan_tables(root) -> frozenset:
+    """Lowercased (catalog, schema, table) triples of every ScanNode
+    under a plan root — the `tables=` tag for `store`, aligned with the
+    resident tier's `table_key` convention."""
+    out = set()
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        handle = getattr(node, "handle", None)
+        if handle is not None and hasattr(handle, "table"):
+            catalog = getattr(node, "catalog", None) or getattr(
+                handle, "catalog", ""
+            )
+            out.add((
+                str(catalog).lower(),
+                str(handle.schema).lower(),
+                str(handle.table).lower(),
+            ))
+        stack.extend(getattr(node, "children", lambda: ())())
+    return frozenset(out)
+
+
 class PlanCache:
     """Thread-safe bounded-LRU plan cache with metric counters.
 
@@ -70,6 +95,7 @@ class PlanCache:
         self._prefix = metrics_prefix
         self._lock = threading.Lock()
         self._entries: "OrderedDict[Tuple, Any]" = OrderedDict()
+        self._tables: dict = {}  # key -> frozenset of source tables
         self.hits = 0
         self.misses = 0
         self.evictions = 0
@@ -108,7 +134,12 @@ class PlanCache:
         with self._lock:
             return key in self._entries
 
-    def store(self, key: Tuple, value: Any, generation: Optional[int] = None) -> None:
+    def store(self, key: Tuple, value: Any, generation: Optional[int] = None,
+              tables=()) -> None:
+        """`tables` is the plan's source-table set (lowercased
+        (catalog, schema, table) triples); entries tagged with it are
+        droppable table-granularly by `invalidate_tables`. Untagged
+        entries only fall to wholesale `invalidate`."""
         from trino_tpu.runtime.metrics import METRICS
 
         with self._lock:
@@ -116,8 +147,10 @@ class PlanCache:
                 return  # invalidated while planning: the plan is stale
             self._entries[key] = value
             self._entries.move_to_end(key)
+            self._tables[key] = frozenset(tables)
             while len(self._entries) > self.max_entries:
-                self._entries.popitem(last=False)
+                old, _ = self._entries.popitem(last=False)
+                self._tables.pop(old, None)
                 self.evictions += 1
                 METRICS.increment(f"{self._prefix}.evictions")
 
@@ -128,9 +161,35 @@ class PlanCache:
 
         with self._lock:
             self._entries.clear()
+            self._tables.clear()
             self.generation += 1
             self.invalidations += 1
             METRICS.increment(f"{self._prefix}.invalidations")
+
+    def invalidate_tables(self, tables) -> int:
+        """Table-granular invalidation: drop plans that read any of
+        `tables`, plus untagged plans (their source set is unknown, so
+        they must be assumed dirty). Plans over other tables survive —
+        the resident-tier protocol (DML names its target). The
+        generation still bumps: a concurrent planner racing the write
+        may be planning against the written table, and a refused store
+        on an unaffected plan only costs one replan."""
+        from trino_tpu.runtime.metrics import METRICS
+
+        tset = {tuple(str(p).lower() for p in t) for t in tables}
+        with self._lock:
+            victims = [
+                k
+                for k in self._entries
+                if not self._tables.get(k) or self._tables[k] & tset
+            ]
+            for k in victims:
+                del self._entries[k]
+                self._tables.pop(k, None)
+            self.generation += 1
+            self.invalidations += 1
+            METRICS.increment(f"{self._prefix}.invalidations")
+            return len(victims)
 
     # dict-compat shims: callers predating the serving tier used a raw
     # dict here (engine._plan_cache), and tests poke it directly

@@ -98,6 +98,59 @@ class PhysicalPlan:
         return pipelines, chain
 
 
+class _AggWarmer:
+    """WarmupEntry.fn adapter for aggregation kernels. The group-reduce
+    programs (ops/groupby) are module-level jits keyed by shape and
+    static config, so driving a throwaway operator instance over the
+    dead batch seeds the very dispatch cache the real query hits."""
+
+    def __init__(self, groups, specs, schema, step):
+        self.groups = list(groups)
+        self.specs = list(specs)
+        self.schema = list(schema)
+        self.step = step
+
+    def __call__(self, batch):
+        op = HashAggregationOperator(
+            self.groups, self.specs, self.schema, step=self.step
+        )
+        op.add_input(batch)
+        op.finish()
+        for _ in range(8):
+            if op.get_output() is None:
+                break
+
+
+class _JoinWarmer:
+    """Dead-batch join warmup: build an empty lookup source at the
+    build side's predicted capacity, then probe it at the entry's
+    capacity — the (probe_cap, build_cap) pair the real query
+    dispatches."""
+
+    def __init__(self, lkeys, rkeys, kind, probe_schema, build_schema,
+                 build_cap):
+        self.lkeys, self.rkeys, self.kind = list(lkeys), list(rkeys), kind
+        self.probe_schema = list(probe_schema)
+        self.build_schema = list(build_schema)
+        self.build_cap = int(build_cap)
+
+    def __call__(self, batch):
+        from trino_tpu.compile.warmup import zeros_batch
+
+        bridge = JoinBridge()
+        sink = HashBuildSink(bridge, self.rkeys, self.build_schema)
+        sink.add_input(zeros_batch(self.build_schema, self.build_cap))
+        sink.finish()
+        op = LookupJoinOperator(
+            bridge, self.lkeys, self.kind, self.probe_schema
+        )
+        op.add_input(batch)
+        op.finish()
+        for _ in range(8):
+            if op.get_output() is None:
+                break
+
+
 class LocalPlanner:
     def __init__(
         self,
@@ -299,6 +352,24 @@ class LocalPlanner:
             self._warmup_entries.append(entry)
         chain.append(factory)
 
+    def _record_kernel_warmup(self, operator: str, warmer, in_schema,
+                              out_schema, caps) -> None:
+        """Warmup entry for a blocking kernel (aggregation / join):
+        the census predicted `caps` input classes; the warmer drives a
+        throwaway operator so the shared kernel jits compile ahead of
+        first touch. No-op when the census has no prediction."""
+        if not caps:
+            return
+        from trino_tpu.compile.warmup import WarmupEntry
+
+        self._warmup_entries.append(WarmupEntry(
+            operator=operator,
+            fn=warmer,
+            in_schema=list(in_schema),
+            out_dtypes=tuple(str(t) for t, _ in out_schema),
+            capacities=tuple(caps),
+        ))
+
     @staticmethod
     def _take_fused(chain: List[Factory]):
         """Pop a trailing fused filter/project stage so a blocking
@@ -372,6 +443,9 @@ class LocalPlanner:
         ]
         groups = list(node.group_channels)
         step = node.step
+        # input capacity classes before the fused stage is absorbed
+        # (filter/project preserves capacity, so they flow through)
+        src_caps = getattr(chain[-1], "out_caps", None) if chain else None
         pre = self._take_fused(chain)
         chain.append(
             lambda ctx: HashAggregationOperator(
@@ -383,7 +457,13 @@ class LocalPlanner:
         if step == "partial":
             from trino_tpu.exec.operators import partial_output_schema
 
-            return chain, partial_output_schema(specs, groups, schema)
+            out_schema = partial_output_schema(specs, groups, schema)
+            self._record_kernel_warmup(
+                "HashAggregationOperator",
+                _AggWarmer(groups, specs, schema, step),
+                schema, out_schema, src_caps,
+            )
+            return chain, out_schema
         # min/max/any and the holistic kinds return a value from the
         # argument column, so its dictionary must ride along (a string
         # result without its dictionary renders as raw codes)
@@ -412,6 +492,11 @@ class LocalPlanner:
                 (a.out_type, schema[len(groups) + 2 * i][1])
                 for i, a in enumerate(node.aggs)
             ]
+        self._record_kernel_warmup(
+            "HashAggregationOperator",
+            _AggWarmer(groups, specs, schema, step),
+            schema, out_schema, src_caps,
+        )
         return chain, out_schema
 
     def _distinct_agg(self, node: P.AggregateNode, chain, schema: Schema):
@@ -442,6 +527,14 @@ class LocalPlanner:
     def _visit_JoinNode(self, node: P.JoinNode):
         build_chain, build_schema = self._visit(node.right)
         probe_chain, probe_schema = self._visit(node.left)
+        build_caps = (
+            getattr(build_chain[-1], "out_caps", None) if build_chain
+            else None
+        )
+        probe_caps = (
+            getattr(probe_chain[-1], "out_caps", None) if probe_chain
+            else None
+        )
         key = self._key()
 
         def bridge_of(ctx) -> JoinBridge:
@@ -482,10 +575,21 @@ class LocalPlanner:
             )
         )
         if node.kind in ("semi", "anti"):
-            return probe_chain, probe_schema
-        if node.kind in ("mark", "mark_exists"):
-            return probe_chain, probe_schema + [(T.BOOLEAN, None)]
-        return probe_chain, probe_schema + build_schema
+            out_schema = probe_schema
+        elif node.kind in ("mark", "mark_exists"):
+            out_schema = probe_schema + [(T.BOOLEAN, None)]
+        else:
+            out_schema = probe_schema + build_schema
+        # residual joins skip: the residual program binds to this plan's
+        # expressions, which the dead-batch warmer does not replicate
+        if probe_caps and build_caps and residual_fn is None:
+            self._record_kernel_warmup(
+                "LookupJoinOperator",
+                _JoinWarmer(lkeys, rkeys, kind, probe_schema,
+                            build_schema, build_caps[0]),
+                probe_schema, out_schema, probe_caps,
+            )
+        return probe_chain, out_schema
 
     def _visit_WindowNode(self, node: P.WindowNode):
         from trino_tpu.exec.operators import WindowOperator

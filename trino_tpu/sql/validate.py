@@ -730,6 +730,19 @@ class Lowering:
     capacity: int
     dtypes: Tuple[str, ...]
     retry_variant: bool = False
+    # any column in the class is array/map/row-typed: no scalar device
+    # layout exists, so the class is ineligible for zero-batch warmup
+    # AND for resident pinning (resident/fastlane skips it) — the census
+    # names these classes instead of letting them vanish silently
+    nested: bool = False
+
+
+def nested_column_types(types) -> List[str]:
+    """The nested-kind entries in a column-type set — the shared
+    eligibility predicate for warmup and resident pinning. Non-empty
+    means 'skip, and say so' (resident.skips_nested / census [nested]
+    marker), never a silent drop."""
+    return [str(t) for t in types if getattr(t, "is_nested", False)]
 
 
 def _sig(fields: Sequence[P.Field]) -> Tuple[str, ...]:
@@ -793,7 +806,10 @@ def shape_census(
     def add(op: str, rc: float, fields, retry_variant: bool = False):
         classes.append(
             Lowering(
-                op, _cap(rc, batch_rows, ladder), _sig(fields), retry_variant
+                op, _cap(rc, batch_rows, ladder), _sig(fields), retry_variant,
+                nested=bool(
+                    nested_column_types([f.type for f in fields])
+                ),
             )
         )
 
@@ -898,9 +914,12 @@ def census_line(classes: List[Lowering], warn_threshold: int = 0) -> str:
     """One summary line for EXPLAIN (ANALYZE) output."""
     n = len(classes)
     variants = sum(1 for c in classes if c.retry_variant)
+    nested = sum(1 for c in classes if c.nested)
     line = f"expected_xla_lowerings={n}"
     if variants:
         line += f" ({variants} retry-variant)"
+    if nested:
+        line += f" ({nested} nested: warmup/resident-ineligible)"
     if warn_threshold and n > warn_threshold:
         line += (
             f"  WARNING: exceeds compile_churn_warn_threshold="
@@ -921,6 +940,8 @@ def census_text(
         lines[0] += f" observed_shape_classes={observed}"
     for c in sorted(classes, key=lambda c: (c.operator, c.capacity)):
         mark = " [retry-variant]" if c.retry_variant else ""
+        if c.nested:
+            mark += " [nested]"
         lines.append(
             f"  {c.operator} cap={c.capacity} "
             f"[{', '.join(c.dtypes)}]{mark}"
