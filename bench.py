@@ -75,6 +75,15 @@ Flags:
                      lowerings for repeated pinned probes, and graceful
                      cold-path degradation under a zero pin budget; no
                      device needed (runs before preflight)
+  --adaptive-smoke   exercise the adaptive execution tier
+                     (trino_tpu/adaptive/): a q72-class join over
+                     deliberately misestimated stats, two arms on the
+                     same lying catalog; the adaptive arm must re-plan
+                     >=1 time, stay oracle-equal with the non-adaptive
+                     arm, beat its warm wall, and mint zero new XLA
+                     lowerings in the warm loop; JSON re-plan counts,
+                     exit 1 on violation; no device needed (runs before
+                     preflight)
 """
 
 from __future__ import annotations
@@ -803,6 +812,7 @@ def _chaos_smoke(argv) -> int:
     except (IndexError, ValueError):
         seed = 42
     from trino_tpu.runtime.chaos import (
+        ADAPTIVE_CLASSES,
         FAULT_CLASSES,
         LIFECYCLE_CLASSES,
         SERVING_CLASSES,
@@ -814,7 +824,8 @@ def _chaos_smoke(argv) -> int:
           f"fault_classes={','.join(FAULT_CLASSES)} "
           f"lifecycle={','.join(LIFECYCLE_CLASSES)} "
           f"timebound={','.join(TIMEBOUND_CLASSES)} "
-          f"serving={','.join(SERVING_CLASSES)}")
+          f"serving={','.join(SERVING_CLASSES)} "
+          f"adaptive={','.join(ADAPTIVE_CLASSES)}")
     t0 = time.time()
     violations = chaos_smoke(seed, CHAOS_QUERIES)
     wall = time.time() - t0
@@ -825,7 +836,7 @@ def _chaos_smoke(argv) -> int:
             "seed": seed,
             "cases": len(CHAOS_QUERIES) * len(FAULT_CLASSES)
             + len(LIFECYCLE_CLASSES) + len(TIMEBOUND_CLASSES)
-            + len(SERVING_CLASSES),
+            + len(SERVING_CLASSES) + len(ADAPTIVE_CLASSES),
             "violations": len(violations),
             "wall_s": round(wall, 2),
         }
@@ -1413,6 +1424,144 @@ def _resident_smoke(argv) -> int:
     return 1 if violations else 0
 
 
+def _adaptive_smoke(argv) -> int:
+    """--adaptive-smoke: CI gate for the adaptive execution tier
+    (trino_tpu/adaptive/). A q72-class multi-join over the memory
+    connector whose dimension stats LIE (a fan-out build side reported
+    at 1/20th of its true cardinality), so the optimizer's first plan
+    is wrong on purpose. Two arms run the same query over the same
+    lying catalog: non-adaptive rides the bad plan; adaptive observes
+    the completed build at the barrier, crosses the re-plan threshold,
+    and re-optimizes the remainder seeded with observed stats. Exit 1
+    iff the adaptive arm fails to re-plan, the arms disagree on the
+    answer, the adaptive warm wall does not beat the non-adaptive warm
+    wall, or the adaptive warm loop mints a new XLA lowering."""
+    import dataclasses
+
+    import numpy as np
+
+    from trino_tpu import types as T
+    from trino_tpu.adaptive import SPOOL
+    from trino_tpu.connectors.memory import MemoryConnector
+    from trino_tpu.connectors.spi import ColumnMetadata
+    from trino_tpu.engine import LocalQueryRunner, Session
+    from trino_tpu.runtime.metrics import METRICS
+
+    def build_catalog() -> MemoryConnector:
+        conn = MemoryConnector()
+        rng = np.random.default_rng(17)
+        n, keys, fan = 50_000, 40, 20
+        conn.load_table(
+            "s", "facts",
+            [ColumnMetadata("k1", T.BIGINT), ColumnMetadata("k2", T.BIGINT),
+             ColumnMetadata("v", T.BIGINT)],
+            [rng.integers(0, keys, n).astype(np.int64),
+             rng.integers(0, 1000, n).astype(np.int64),
+             rng.integers(0, 100, n).astype(np.int64)],
+        )
+        # d1 fans out (each key 20x); the lie below hides the fan-out
+        conn.load_table(
+            "s", "d1",
+            [ColumnMetadata("k", T.BIGINT), ColumnMetadata("tag", T.BIGINT)],
+            [np.repeat(np.arange(keys, dtype=np.int64), fan),
+             np.arange(keys * fan, dtype=np.int64)],
+        )
+        conn.load_table(
+            "s", "d2",
+            [ColumnMetadata("k", T.BIGINT), ColumnMetadata("w", T.BIGINT)],
+            [np.arange(2, dtype=np.int64), np.arange(2, dtype=np.int64)],
+        )
+        real = conn.metadata.get_table_statistics
+
+        def lying(handle):
+            ts = real(handle)
+            if handle.table == "d1" and ts.row_count is not None:
+                return dataclasses.replace(
+                    ts, row_count=ts.row_count / 20.0, columns={}
+                )
+            return ts
+
+        conn.metadata.get_table_statistics = lying
+        return conn
+
+    sql = (
+        "select count(*), sum(f.v + d1.tag + d2.w) from facts f "
+        "join d1 on f.k1 = d1.k join d2 on f.k2 = d2.k"
+    )
+
+    def run_arm(adaptive: bool) -> dict:
+        SPOOL.clear()
+        r = LocalQueryRunner(Session(
+            catalog="memory", schema="s",
+            adaptive_execution=adaptive,
+            adaptive_replan_threshold=2.0,
+        ))
+        r.register_catalog("memory", build_catalog())
+        t0 = time.time()
+        rows = r.execute(sql).rows
+        cold = time.time() - t0
+        walls = []
+        compiles0 = METRICS.counter("xla_compiles")
+        for _ in range(3):
+            t0 = time.time()
+            assert r.execute(sql).rows == rows
+            walls.append(time.time() - t0)
+        new_lowerings = METRICS.counter("xla_compiles") - compiles0
+        report = r._last_adaptive_report
+        return {
+            "rows": rows,
+            "cold_wall_s": round(cold, 3),
+            "warm_wall_s": round(sorted(walls)[1], 4),  # median of 3
+            "warm_new_lowerings": int(new_lowerings),
+            "replans": report.replans if report is not None else 0,
+            "observations": (
+                len(report.observations) if report is not None else 0
+            ),
+        }
+
+    print("bench: adaptive smoke (misestimated q72-class join, "
+          "memory connector, CPU ok)")
+    base = run_arm(adaptive=False)
+    adapt = run_arm(adaptive=True)
+    violations = []
+    if adapt["replans"] < 1:
+        violations.append(
+            "adaptive arm never re-planned — the misestimate was not "
+            "observed at the barrier"
+        )
+    if base["rows"] != adapt["rows"]:
+        violations.append(
+            f"arms disagree: base={base['rows']} adaptive={adapt['rows']}"
+        )
+    if adapt["warm_wall_s"] >= base["warm_wall_s"]:
+        violations.append(
+            f"adaptive warm wall {adapt['warm_wall_s']}s did not beat "
+            f"non-adaptive {base['warm_wall_s']}s"
+        )
+    if adapt["warm_new_lowerings"] != 0:
+        violations.append(
+            f"adaptive warm loop minted {adapt['warm_new_lowerings']} "
+            "new XLA lowerings — re-planned programs left the "
+            "capacity ladder"
+        )
+    for v in violations:
+        print(f"bench: adaptive VIOLATION: {v}", file=sys.stderr)
+    base.pop("rows")
+    adapt.pop("rows")
+    print(json.dumps({
+        "adaptive_smoke": {
+            "query": "q72-class misestimated join",
+            "base": base,
+            "adaptive": adapt,
+            "speedup": round(
+                base["warm_wall_s"] / max(adapt["warm_wall_s"], 1e-9), 2
+            ),
+            "violations": len(violations),
+        }
+    }))
+    return 1 if violations else 0
+
+
 def _validate_corpus(argv) -> int:
     """--validate-corpus: CI gate for the plan sanity checkers
     (sql/validate.py). Plans — without executing — every TPC-H and
@@ -1523,6 +1672,8 @@ def main() -> None:
         sys.exit(_mesh_smoke(sys.argv))
     if "--resident-smoke" in sys.argv:
         sys.exit(_resident_smoke(sys.argv))
+    if "--adaptive-smoke" in sys.argv:
+        sys.exit(_adaptive_smoke(sys.argv))
     if "--validate-corpus" in sys.argv:
         sys.exit(_validate_corpus(sys.argv))
     if os.environ.get("BENCH_INNER") == "1":

@@ -1,0 +1,278 @@
+"""Adaptive execution tier (PR 13, trino_tpu/adaptive/): mid-query
+re-planning from observed stats + shared-subtree materialization.
+
+The estimate->observe->re-plan loop runs at materialization barriers
+(completed build sides): observed row counts are diffed against the
+optimizer's estimates, and when the divergence crosses
+adaptive_replan_threshold the REMAINING plan is re-optimized with the
+completed subtree riding along as a literal source (never redone).
+These tests force misestimates through a lying get_table_statistics
+wrapper, then assert: re-plans trigger, results stay oracle-equal
+across 0/1/2 re-plans, re-planned programs land on already-compiled
+shapes, a deadline kill mid-re-plan stays typed, NOT IN's duplicated
+subquery materializes once, and the off-path is untouched.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.adaptive import SPOOL, AdaptiveController
+from trino_tpu.connectors.memory import MemoryConnector
+from trino_tpu.connectors.spi import ColumnMetadata
+from trino_tpu.engine import LocalQueryRunner, Session
+from trino_tpu.runtime.metrics import METRICS
+from trino_tpu.runtime.query_tracker import (
+    EXCEEDED_TIME_LIMIT,
+    ExceededTimeLimitError,
+)
+
+
+def _connector(seed=7, n=4000, n_keys=40):
+    conn = MemoryConnector()
+    rng = np.random.default_rng(seed)
+    conn.load_table(
+        "s", "facts",
+        [ColumnMetadata("k1", T.BIGINT), ColumnMetadata("k2", T.BIGINT),
+         ColumnMetadata("v", T.BIGINT)],
+        [rng.integers(0, n_keys, n).astype(np.int64),
+         rng.integers(0, n_keys, n).astype(np.int64),
+         rng.integers(0, 100, n).astype(np.int64)],
+    )
+    for name in ("dim1", "dim2"):
+        conn.load_table(
+            "s", name,
+            [ColumnMetadata("k", T.BIGINT), ColumnMetadata("name", T.VARCHAR)],
+            [np.arange(n_keys, dtype=np.int64),
+             np.array([f"{name}-{i}" for i in range(n_keys)], dtype=object)],
+        )
+    return conn
+
+
+def _lie_about_rows(conn, factors):
+    """Scale get_table_statistics row counts per table name — the
+    forced-misestimate fixture. factors: {table: multiplier}."""
+    real = conn.metadata.get_table_statistics
+
+    def lying(handle):
+        ts = real(handle)
+        f = factors.get(handle.table)
+        if f is not None and ts.row_count is not None:
+            return dataclasses.replace(ts, row_count=ts.row_count * f)
+        return ts
+
+    conn.metadata.get_table_statistics = lying
+
+
+def _runner(conn, **session_kw):
+    r = LocalQueryRunner(Session(catalog="memory", schema="s", **session_kw))
+    r.register_catalog("memory", conn)
+    return r
+
+
+TWO_JOIN_Q = (
+    "select d1.name, d2.name, sum(f.v) from facts f "
+    "join dim1 d1 on f.k1 = d1.k join dim2 d2 on f.k2 = d2.k "
+    "group by d1.name, d2.name order by 1, 2 limit 10"
+)
+
+
+def test_replan_triggers_on_misestimate():
+    SPOOL.clear()
+    conn = _connector()
+    _lie_about_rows(conn, {"dim1": 0.1})
+    r = _runner(conn, adaptive_execution=True, adaptive_replan_threshold=2.0)
+    q = ("select d1.name, sum(f.v) from facts f join dim1 d1 "
+         "on f.k1 = d1.k group by d1.name order by 1 limit 5")
+    before = METRICS.snapshot().get("adaptive.replans", 0.0)
+    rows = r.execute(q).rows
+    report = r._last_adaptive_report
+    assert report is not None and report.replans == 1
+    obs = report.observations[0]
+    assert obs["ratio"] >= 2.0 and obs.get("replanned")
+    assert METRICS.snapshot().get("adaptive.replans", 0.0) - before >= 1
+    # oracle: same connector, adaptive off (the lie does not change data)
+    off = _runner(conn).execute(q).rows
+    assert rows == off
+
+
+@pytest.mark.parametrize(
+    "factors,expected_replans",
+    [
+        ({}, 0),                           # estimates hold: observe only
+        ({"dim2": 0.1}, 1),                # innermost build side lies
+        ({"dim1": 0.1, "dim2": 0.1}, 2),   # both lie: budget of 2 spent
+    ],
+)
+def test_oracle_equality_across_replans(factors, expected_replans):
+    # dims sized so the optimizer keeps TWO join barriers (tiny dims
+    # collapse into one cross-joined build side = a single barrier)
+    SPOOL.clear()
+    conn = _connector(n_keys=200)
+    _lie_about_rows(conn, factors)
+    r = _runner(conn, adaptive_execution=True, adaptive_replan_threshold=2.0)
+    rows = r.execute(TWO_JOIN_Q).rows
+    report = r._last_adaptive_report
+    assert report is not None
+    assert report.replans == expected_replans, report.as_dict()
+    off = _runner(conn).execute(TWO_JOIN_Q).rows
+    assert rows == off
+
+
+def test_replanned_programs_mint_no_new_lowerings():
+    """The zero-new-lowerings gate: a re-planned program must land on
+    capacity-ladder shapes the first execution already compiled — the
+    second adaptive run (same re-plan, warm spool) compiles nothing."""
+    SPOOL.clear()
+    conn = _connector()
+    _lie_about_rows(conn, {"dim1": 0.1})
+    r = _runner(conn, adaptive_execution=True, adaptive_replan_threshold=2.0)
+    q = ("select d1.name, sum(f.v) from facts f join dim1 d1 "
+         "on f.k1 = d1.k group by d1.name order by 1 limit 5")
+    first = r.execute(q).rows
+    assert r._last_adaptive_report.replans == 1
+    before = METRICS.counter("xla_compiles")
+    assert r.execute(q).rows == first
+    delta = METRICS.counter("xla_compiles") - before
+    assert delta == 0, f"adaptive re-run minted {delta} new lowerings"
+
+
+def test_deadline_kill_mid_replan_stays_typed():
+    """The controller's preempt hook fires at every barrier; a deadline
+    kill landing there must surface as the TYPED deadline error, not a
+    swallowed observation or an untyped crash."""
+    SPOOL.clear()
+    conn = _connector()
+    _lie_about_rows(conn, {"dim1": 0.1})
+    r = _runner(conn, adaptive_execution=True, adaptive_replan_threshold=2.0)
+    from trino_tpu.sql.parser import parse
+
+    root = r._analyze(parse(TWO_JOIN_Q))
+    calls = [0]
+
+    def preempt():
+        calls[0] += 1
+        if calls[0] >= 2:  # first barrier observed; kill mid-loop
+            raise ExceededTimeLimitError(
+                f"query exceeded planning limit [{EXCEEDED_TIME_LIMIT}]"
+            )
+
+    controller = AdaptiveController(r.catalogs, r.session, preempt=preempt)
+    with pytest.raises(ExceededTimeLimitError) as ei:
+        controller.prepare(root)
+    assert EXCEEDED_TIME_LIMIT in str(ei.value)
+    assert calls[0] >= 2
+    # the kill must not have corrupted the spool: the same query still
+    # runs to the oracle answer afterwards
+    assert r.execute(TWO_JOIN_Q).rows == _runner(conn).execute(TWO_JOIN_Q).rows
+
+
+def test_distributed_deadline_during_adaptive_planning_stays_typed():
+    from trino_tpu.connectors.tpch import create_tpch_connector
+    from trino_tpu.runtime.coordinator import DistributedQueryRunner
+
+    r = DistributedQueryRunner(
+        Session(
+            catalog="tpch", schema="tiny", retry_policy="task",
+            adaptive_execution=True, adaptive_replan_threshold=1.3,
+            query_max_planning_time_s=1e-6,
+        ),
+        n_workers=2, hash_partitions=2,
+    )
+    r.register_catalog("tpch", create_tpch_connector())
+    with pytest.raises(ExceededTimeLimitError) as ei:
+        r.execute(
+            "select count(*) from supplier s join nation n "
+            "on s_nationkey = n_nationkey where n_nationkey % 2 = 0"
+        )
+    assert EXCEEDED_TIME_LIMIT in str(ei.value)
+
+
+def test_not_in_subquery_materializes_once():
+    """NOT IN's rewrite plans the subquery twice; shared-subtree
+    materialization runs it ONCE and feeds both seats from one
+    generation-guarded spool entry."""
+    SPOOL.clear()
+    conn = _connector()
+    r = _runner(conn, shared_subtree_materialization=True)
+    q = ("select count(*) from facts where k1 not in "
+         "(select k from dim1 where k < 10)")
+    h0 = METRICS.snapshot().get("adaptive.spool_hits", 0.0)
+    rows = r.execute(q).rows
+    report = r._last_adaptive_report
+    assert report is not None
+    assert report.shared_subtrees == 1, report.as_dict()
+    assert report.spool_stores == 1  # ran once ...
+    assert report.spool_hits == 1    # ... second seat fed from the spool
+    assert METRICS.snapshot().get("adaptive.spool_hits", 0.0) - h0 >= 1
+    assert rows == _runner(conn).execute(q).rows
+
+
+def test_spool_invalidated_by_table_write():
+    """The spool is generation-guarded: DML on a source table drops the
+    entry, so a re-run materializes fresh rows (oracle-equal, never
+    stale)."""
+    SPOOL.clear()
+    conn = _connector()
+    r = _runner(conn, shared_subtree_materialization=True)
+    q = ("select count(*) from facts where k1 not in "
+         "(select k from dim1 where k < 100)")
+    first = r.execute(q).rows
+    r.execute("insert into dim1 values (50, 'late')")
+    # k1 < 40 in facts, so adding key 50 changes nothing semantically —
+    # but the generation bump must force a fresh materialization
+    second = r.execute(q).rows
+    assert second == _runner(conn).execute(q).rows
+    assert first == second  # key 50 never matches any fact row
+
+
+def test_divergence_recorded_with_adaptive_off():
+    """adaptive_execution=off still reports: distributed EXPLAIN
+    ANALYZE carries per-fragment estimated_vs_observed lines and the
+    divergence counter moves — but no re-plan and no plan transform."""
+    from trino_tpu.connectors.tpch import create_tpch_connector
+    from trino_tpu.runtime.coordinator import DistributedQueryRunner
+
+    r = DistributedQueryRunner(
+        Session(catalog="tpch", schema="tiny", adaptive_replan_threshold=1.3),
+        n_workers=2, hash_partitions=2,
+    )
+    r.register_catalog("tpch", create_tpch_connector())
+    q = ("select count(*) from supplier s join nation n "
+         "on s_nationkey = n_nationkey where n_nationkey % 2 = 0")
+    d0 = METRICS.snapshot().get("adaptive.divergences", 0.0)
+    r0 = METRICS.snapshot().get("adaptive.replans", 0.0)
+    txt = r.execute("explain analyze " + q).rows[0][0]
+    assert "estimated_vs_observed: fragment:" in txt
+    assert "SpooledValues" not in txt
+    assert "adaptive:" not in txt  # no controller section when off
+    assert METRICS.snapshot().get("adaptive.divergences", 0.0) > d0
+    assert METRICS.snapshot().get("adaptive.replans", 0.0) == r0
+
+
+def test_off_path_plans_byte_identical():
+    """With every adaptive property at its default-off value, EXPLAIN
+    output is byte-identical to a plain session's — the tier leaves the
+    off-path untouched."""
+    conn = _connector()
+    plain = _runner(conn).execute("explain " + TWO_JOIN_Q).rows[0][0]
+    off = _runner(
+        conn, adaptive_execution=False, shared_subtree_materialization=False
+    ).execute("explain " + TWO_JOIN_Q).rows[0][0]
+    assert plain == off
+    assert "SpooledValues" not in plain
+
+
+def test_analyze_renders_adaptive_section_locally():
+    SPOOL.clear()
+    conn = _connector()
+    _lie_about_rows(conn, {"dim1": 0.1})
+    r = _runner(conn, adaptive_execution=True, adaptive_replan_threshold=2.0)
+    q = ("select d1.name, sum(f.v) from facts f join dim1 d1 "
+         "on f.k1 = d1.k group by d1.name order by 1 limit 5")
+    txt = r.execute("explain analyze " + q).rows[0][0]
+    assert "adaptive: observations=" in txt, txt
+    assert "estimated_vs_observed: build:" in txt
+    assert "-> replanned" in txt

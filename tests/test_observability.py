@@ -95,9 +95,17 @@ def test_dynamic_filter_prunes_probe(runner):
     df_line = next(
         line for line in out.splitlines() if "DynamicFilterOperator" in line
     )
-    # probe side shrank from the full table to the build domain
-    assert "in=60064 rows" in df_line
+    # the build-side domain now lands on the probe SCAN as a runtime
+    # ColumnConstraint (PR 13), so pruning happens upstream of the
+    # DynamicFilterOperator: the scan emits only the 98 matching rows
+    # instead of the full 60064-row table
+    assert "in=98 rows" in df_line
     assert "out=98 rows" in df_line
+    scan_line = next(
+        line for line in out.splitlines()
+        if "TableScanOperator" in line and "out=98 rows" in line
+    )
+    assert scan_line
 
 
 def test_dynamic_filter_correctness(runner):

@@ -403,3 +403,132 @@ def test_cobucketed_join_elides_exchanges():
     assert e1 - e0 > 0  # join + agg over declared bucketing plan free
     # and the co-bucketed run moves fewer rows through exchanges
     assert sh1 - sh0 < sh2 - sh1
+
+
+# -- multi-range pushdown (PR 13): IN-lists and OR-of-ranges ----------
+
+
+def test_in_list_pushed_and_exact(mem_runner):
+    txt = mem_runner.execute(
+        "explain select v from t where k in (3, 1, 4, 1, 5)"
+    ).rows[0][0]
+    # canonical sorted/deduped tuple on the scan, no residual Filter
+    assert "k in (1, 3, 4, 5)" in txt, txt
+    assert "Filter" not in txt
+    rows = mem_runner.execute(
+        "select sum(v) from t where k in (3, 1, 4, 1, 5)"
+    ).rows
+    mem_runner.execute("SET SESSION enable_pushdown = false")
+    try:
+        off = mem_runner.execute(
+            "select sum(v) from t where k in (3, 1, 4, 1, 5)"
+        ).rows
+    finally:
+        mem_runner.execute("SET SESSION enable_pushdown = true")
+    assert rows == off
+
+
+def test_or_of_ranges_pushed_and_exact(mem_runner):
+    sql = "select sum(v) from t where k < 5 or k > 9995"
+    txt = mem_runner.execute("explain " + sql).rows[0][0]
+    assert "k (lt 5 or gt 9995)" in txt, txt
+    assert "Filter" not in txt
+    s0 = _scanned()
+    on = mem_runner.execute(sql).rows
+    s1 = _scanned()
+    mem_runner.execute("SET SESSION enable_pushdown = false")
+    try:
+        off = mem_runner.execute(sql).rows
+    finally:
+        mem_runner.execute("SET SESSION enable_pushdown = true")
+    s2 = _scanned()
+    assert on == off
+    assert s1 - s0 < s2 - s1  # exact enforcement still prunes rows
+
+
+def test_or_across_columns_stays_residual(mem_runner):
+    # disjuncts on different columns can't become one ColumnConstraint
+    txt = mem_runner.execute(
+        "explain select v from t where k < 5 or v > 90"
+    ).rows[0][0]
+    assert "pushed=" not in txt
+    assert "Filter" in txt
+
+
+def test_in_list_with_null_option_stays_residual(mem_runner):
+    txt = mem_runner.execute(
+        "explain select v from t where k in (1, 2, null)"
+    ).rows[0][0]
+    assert "pushed=" not in txt
+
+
+def test_parquet_row_groups_pruned_by_in_list(tmp_path):
+    from trino_tpu.connectors.file import create_file_connector
+    from trino_tpu.connectors.parquet_format import (
+        ParquetColumn,
+        T_INT64,
+        write_parquet,
+    )
+
+    n = 4000
+    (tmp_path / "s").mkdir()
+    write_parquet(
+        str(tmp_path / "s" / "t.parquet"),
+        [
+            ParquetColumn("id", T_INT64, values=np.arange(n, dtype=np.int64)),
+            ParquetColumn(
+                "v", T_INT64, values=np.arange(n, dtype=np.int64) * 3
+            ),
+        ],
+        n,
+        row_group_rows=500,
+    )
+    r = LocalQueryRunner(Session(catalog="file", schema="s"))
+    r.register_catalog("file", create_file_connector(str(tmp_path)))
+    # IN bounds to [100, 120]: one of 8 groups survives min/max pruning
+    sql = "select sum(v) from t where id in (100, 110, 120)"
+    b0 = _bytes()
+    on = r.execute(sql).rows
+    b1 = _bytes()
+    r.execute("SET SESSION enable_pushdown = false")
+    off = r.execute(sql).rows
+    b2 = _bytes()
+    assert on == off == [[(100 + 110 + 120) * 3]]
+    assert b1 - b0 < b2 - b1, (b1 - b0, b2 - b1)
+
+
+def test_dynamic_filter_domain_lands_on_probe_scan():
+    """PR 13: the dynamic-filter bridge's build-side key domain is
+    re-used as a runtime scan constraint — the probe TableScanOperator
+    merges an IN-list (small domains) into its splits before producing,
+    so connector-level enforcement prunes rows the DynamicFilterOperator
+    would otherwise drop one batch later."""
+    r = LocalQueryRunner(Session(catalog="memory", schema="s"))
+    r.register_catalog("memory", create_memory_connector())
+    mem = r.catalogs.get("memory")
+    rng = np.random.default_rng(5)
+    n = 5000
+    mem.load_table(
+        "s", "big",
+        [ColumnMetadata("k", T.BIGINT), ColumnMetadata("v", T.BIGINT)],
+        [rng.integers(0, 1000, n).astype(np.int64),
+         rng.integers(0, 100, n).astype(np.int64)],
+    )
+    mem.load_table(
+        "s", "small",
+        [ColumnMetadata("k", T.BIGINT)],
+        [np.array([5, 10, 17], dtype=np.int64)],
+    )
+    sql = "select count(*), sum(b.v) from big b join small s on b.k = s.k"
+    c0 = METRICS.snapshot().get("dynamic_filter_scan_constraints", 0.0)
+    s0 = _scanned()
+    on = r.execute(sql).rows
+    c1 = METRICS.snapshot().get("dynamic_filter_scan_constraints", 0.0)
+    s1 = _scanned()
+    assert c1 - c0 >= 1  # the probe scan took the bridge's domain
+    r.execute("SET SESSION enable_dynamic_filtering = false")
+    off = r.execute(sql).rows
+    s2 = _scanned()
+    assert on == off
+    # the constrained scan produced only matching rows
+    assert s1 - s0 < s2 - s1, (s1 - s0, s2 - s1)

@@ -1,0 +1,360 @@
+"""The adaptive execution controller.
+
+`prepare(root)` runs BETWEEN logical optimization and physical
+planning (single-node LocalPlanner or the distributed fragmenter —
+both paths call it), and closes the estimate->observe->re-plan loop:
+
+1. shared-subtree materialization: identical subtrees (the analyzer's
+   NOT IN rewrite plans its subquery twice; CTEs referenced twice) are
+   materialized ONCE into the generation-guarded spool and every seat
+   is substituted with the same SpooledValuesNode.
+2. barrier observation: the innermost join's build side is a pipeline
+   barrier — it completes before its probe starts — so the controller
+   materializes it, snapshots observed rows/NDV/heavy-hitters, and
+   records the divergence against the optimizer's estimate.
+3. mid-query re-planning: when divergence crosses
+   `adaptive_replan_threshold`, the REMAINING plan is re-optimized
+   with the materialized subtree substituted as a literal source
+   carrying exact observed stats (StatsCalculator short-circuits on
+   `plan_stats`), so the reorderer/broadcast/partial-agg decisions see
+   truth. Completed work is never redone: it rides along as rows. When
+   divergence stays under the threshold the loop STOPS — estimates are
+   trusted and no further barriers pay the materialization toll.
+
+Re-planned programs re-land on existing capacity-ladder shape classes:
+materialized batches pad to bucket_capacity like every other batch,
+and the re-optimization runs the same rule set, so the warm loop mints
+zero new XLA lowerings (the bench --adaptive-smoke gate).
+
+`preempt` is called at every barrier: a deadline kill latched during
+materialization or re-planning surfaces as the same typed error the
+execution path raises (EXCEEDED_TIME_LIMIT stays non-retryable
+mid-re-plan)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional, Tuple
+
+from trino_tpu.adaptive.observer import (
+    estimated_vs_observed_line,
+    observe_rows,
+    record_observation,
+)
+from trino_tpu.adaptive.spool import (
+    MAX_SPOOL_ROWS,
+    SPOOL,
+    SpooledValuesNode,
+    duplicate_subtrees,
+    materializable,
+    plan_fingerprint,
+    spooled_node,
+    substitute,
+    subtree_tables,
+)
+from trino_tpu.sql import plan as P
+
+MAX_REPLANS = 2
+
+
+@dataclasses.dataclass
+class AdaptiveReport:
+    """What the controller did to one query — rides into QueryInfo and
+    the EXPLAIN ANALYZE `adaptive=` section."""
+
+    observations: List[dict] = dataclasses.field(default_factory=list)
+    replans: int = 0
+    spool_hits: int = 0
+    spool_stores: int = 0
+    shared_subtrees: int = 0
+    transformed: bool = False
+
+    def as_dict(self) -> dict:
+        return {
+            "observations": list(self.observations),
+            "replans": self.replans,
+            "spool_hits": self.spool_hits,
+            "spool_stores": self.spool_stores,
+            "shared_subtrees": self.shared_subtrees,
+        }
+
+    def lines(self) -> List[str]:
+        out = [
+            f"adaptive: observations={len(self.observations)} "
+            f"replans={self.replans} spool_hits={self.spool_hits} "
+            f"spool_stores={self.spool_stores} "
+            f"shared_subtrees={self.shared_subtrees}"
+        ]
+        for o in self.observations:
+            out.append(
+                estimated_vs_observed_line(
+                    o["site"], o["estimated"], o["observed"], o["ratio"]
+                )
+                + (" -> replanned" if o.get("replanned") else "")
+            )
+        return out
+
+
+class AdaptiveController:
+    def __init__(
+        self,
+        catalogs,
+        session,
+        span=None,
+        preempt: Optional[Callable[[], None]] = None,
+        stabilizer=None,
+        max_replans: int = MAX_REPLANS,
+    ):
+        self.catalogs = catalogs
+        self.session = session
+        self.span = span
+        self.preempt = preempt
+        self.stabilizer = stabilizer
+        self.max_replans = max_replans
+        self.report = AdaptiveReport()
+        self._stats_calc = None
+
+    # -- config ------------------------------------------------------
+    @property
+    def _adaptive_on(self) -> bool:
+        return bool(getattr(self.session, "adaptive_execution", False))
+
+    @property
+    def _shared_on(self) -> bool:
+        return bool(
+            getattr(self.session, "shared_subtree_materialization", False)
+        )
+
+    @property
+    def _threshold(self) -> float:
+        return float(
+            getattr(self.session, "adaptive_replan_threshold", 4.0) or 4.0
+        )
+
+    def enabled(self) -> bool:
+        return self._adaptive_on or self._shared_on
+
+    # -- stats -------------------------------------------------------
+    def _estimate(self, node: P.PlanNode) -> float:
+        from trino_tpu.sql.stats import StatsCalculator
+
+        if self._stats_calc is None:
+            self._stats_calc = StatsCalculator(self.catalogs)
+        try:
+            return self._stats_calc.stats(node).row_count
+        except Exception:
+            return 1e9
+
+    def _check_preempt(self) -> None:
+        if self.preempt is not None:
+            self.preempt()
+
+    # -- materialization ---------------------------------------------
+    def _run_subtree(self, node: P.PlanNode) -> Optional[list]:
+        """Execute one subtree locally to python rows (the completed
+        build side / shared subtree). Deterministic by the
+        materializable() gate, so running it here and substituting the
+        rows is semantically the plan itself."""
+        from trino_tpu.exec import CollectorSink, Driver, Pipeline
+        from trino_tpu.sql.local_planner import LocalPlanner
+
+        planner = LocalPlanner(
+            self.catalogs,
+            batch_rows=self.session.batch_rows,
+            target_splits=self.session.target_splits,
+            dynamic_filtering=self.session.enable_dynamic_filtering,
+            stabilizer=self.stabilizer,
+        )
+        physical = planner.plan(node)
+        ctx: dict = {}
+        pipelines, chain = physical.instantiate(ctx)
+        sink = CollectorSink()
+        chain.append(sink)
+        for p in pipelines:
+            Driver(p).run()
+        Driver(Pipeline(chain)).run()
+        for flag, msg in ctx.get("deferred_checks", ()):
+            if bool(flag):
+                raise RuntimeError(msg)
+        return sink.rows()
+
+    def _materialize(
+        self, node: P.PlanNode, key_channels=None
+    ) -> Optional[Tuple[object, str, bool]]:
+        """(spool entry, key, was_hit) — None when the subtree exceeds
+        the spool bound (it stays in the plan and runs as planned)."""
+        key = SPOOL.key(node)
+        tables = subtree_tables(node)
+        entry = SPOOL.get(key, tables)
+        if entry is not None:
+            self.report.spool_hits += 1
+            return entry, key, True
+        rows = self._run_subtree(node)
+        if rows is None or len(rows) > MAX_SPOOL_ROWS:
+            return None
+        obs = observe_rows(rows, channels=key_channels)
+        entry = SPOOL.put(key, rows, node.fields, obs.plan_stats(), tables)
+        self.report.spool_stores += 1
+        return entry, key, False
+
+    # -- barrier selection -------------------------------------------
+    def _next_barrier(
+        self, root: P.PlanNode, visited: set
+    ) -> Optional[Tuple[P.JoinNode, P.PlanNode]]:
+        """Innermost join whose build side is materializable and not
+        yet observed — the first barrier runtime would complete."""
+        found: List[Tuple[P.JoinNode, P.PlanNode]] = []
+
+        def walk(n):
+            for c in n.children():
+                walk(c)
+            if isinstance(n, P.JoinNode) and n.kind != "cross":
+                sub = n.right
+                if (
+                    materializable(sub)
+                    and plan_fingerprint(sub) not in visited
+                ):
+                    found.append((n, sub))
+
+        walk(root)
+        return found[0] if found else None
+
+    def _validate(self, root: P.PlanNode) -> None:
+        if getattr(self.session, "plan_validation", "passes") == "off":
+            return
+        from trino_tpu.sql.validate import validate_logical
+
+        validate_logical(root, stage="adaptive", rule="adaptive_controller")
+
+    def _replan(self, root: P.PlanNode) -> P.PlanNode:
+        """Re-optimize the remaining plan seeded with observed stats
+        (the spooled nodes' plan_stats short-circuit the calculator)."""
+        from trino_tpu.sql.optimizer import canonicalize_tstz_keys, optimize
+
+        self._stats_calc = None  # new plan, fresh memo
+        out = canonicalize_tstz_keys(
+            optimize(root, self.catalogs, self.session)
+        )
+        self._validate(out)
+        return out
+
+    # -- entry point --------------------------------------------------
+    def prepare(self, root: P.PlanNode) -> P.PlanNode:
+        """The estimate->observe->re-plan loop. Returns the (possibly
+        transformed) plan; self.report records what happened."""
+        if not self.enabled():
+            return root
+        if self._shared_on:
+            root = self._materialize_shared(root)
+        if self._adaptive_on:
+            root = self._observe_barriers(root)
+        if self.report.transformed:
+            self._validate(root)
+        return root
+
+    def _materialize_shared(self, root: P.PlanNode) -> P.PlanNode:
+        for nodes in duplicate_subtrees(root):
+            self._check_preempt()
+            proto = nodes[0]
+            est = self._estimate(proto)
+            try:
+                res = self._materialize(proto)
+            except Exception:
+                if self.span is not None:
+                    self.span.event(
+                        "adaptive_spool_skip",
+                        site=type(proto).__name__,
+                    )
+                continue
+            if res is None:
+                continue
+            entry, key, _hit = res
+            site = f"shared:{type(proto).__name__}[x{len(nodes)}]"
+            ratio = record_observation(
+                site, est, entry.stats.row_count, self._threshold,
+                span=self.span,
+            )
+            self.report.observations.append({
+                "site": site,
+                "estimated": est,
+                "observed": entry.stats.row_count,
+                "ratio": ratio,
+            })
+            spooled = spooled_node(entry, key, site)
+            root = substitute(root, {id(n): spooled for n in nodes})
+            # the extra seats reuse the one materialization
+            extra = len(nodes) - 1
+            self.report.spool_hits += extra
+            self.report.shared_subtrees += 1
+            from trino_tpu.runtime.metrics import METRICS
+
+            METRICS.increment("adaptive.spool_hits", extra)
+            self.report.transformed = True
+        return root
+
+    def _observe_barriers(self, root: P.PlanNode) -> P.PlanNode:
+        visited: set = set()
+        replans = 0
+        while True:
+            self._check_preempt()
+            barrier = self._next_barrier(root, visited)
+            if barrier is None:
+                break
+            join, sub = barrier
+            visited.add(plan_fingerprint(sub))
+            est = self._estimate(sub)
+            if est > MAX_SPOOL_ROWS * 4:
+                # the estimate itself says this barrier is too big to
+                # spool; skip it rather than materialize-and-discard
+                continue
+            try:
+                res = self._materialize(
+                    sub, key_channels=tuple(join.right_keys)
+                )
+            except Exception:
+                if self.span is not None:
+                    self.span.event(
+                        "adaptive_observe_skip",
+                        site=type(sub).__name__,
+                    )
+                continue
+            if res is None:
+                continue
+            entry, key, _hit = res
+            site = f"build:{type(sub).__name__}"
+            ratio = record_observation(
+                site, est, entry.stats.row_count, self._threshold,
+                span=self.span,
+            )
+            obs = {
+                "site": site,
+                "estimated": est,
+                "observed": entry.stats.row_count,
+                "ratio": ratio,
+            }
+            self.report.observations.append(obs)
+            root = substitute(
+                root, {id(sub): spooled_node(entry, key, site)}
+            )
+            self.report.transformed = True
+            if ratio >= self._threshold and replans < self.max_replans:
+                self._check_preempt()
+                root = self._replan(root)
+                replans += 1
+                obs["replanned"] = True
+                self.report.replans += 1
+                from trino_tpu.runtime.metrics import METRICS
+
+                METRICS.increment("adaptive.replans")
+                if self.span is not None:
+                    self.span.event(
+                        "adaptive_replan",
+                        site=site,
+                        divergence=round(ratio, 3),
+                        attempt=replans,
+                    )
+            else:
+                # estimates held (or the budget is spent): stop paying
+                # the materialization toll
+                break
+        return root

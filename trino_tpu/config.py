@@ -204,6 +204,23 @@ for _name, _type, _default, _desc, _allowed in [
      "capacity of a pinned table's append-only delta side; background "
      "compaction folds the delta into the base once it crosses half "
      "this, and an insert that cannot fit evicts the pin instead", None),
+    # -- adaptive execution tier (trino_tpu/adaptive/) --
+    ("adaptive_execution", bool, False,
+     "mid-query re-planning: materialize pipeline barriers (completed "
+     "join build sides), diff observed rows/NDV against sql/stats.py "
+     "estimates, and re-optimize the remaining plan when divergence "
+     "crosses adaptive_replan_threshold; completed work is substituted "
+     "back as literal sources and never redone", None),
+    ("adaptive_replan_threshold", float, 4.0,
+     "divergence ratio max(est,obs)/min(est,obs) at or above which an "
+     "observation triggers re-planning of the remaining plan (and is "
+     "counted in adaptive.divergences regardless of whether "
+     "adaptive_execution is on)", None),
+    ("shared_subtree_materialization", bool, False,
+     "materialize identical subtrees (NOT IN rewrites plan the "
+     "subquery twice; CTEs referenced twice) once into the "
+     "generation-guarded spool and feed every consumer — and the "
+     "re-planner — from the same rows", None),
     # -- observability (runtime/tracing.py) --
     ("query_trace", str, "off",
      "record a full span tree per query (phases, stages, task attempts, "

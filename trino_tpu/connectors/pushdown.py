@@ -51,10 +51,28 @@ def _pushable_type(t: T.DataType) -> bool:
     )
 
 
-def classify_conjunct(e, columns, fields) -> Optional[ColumnConstraint]:
+def _literal_value(t: T.DataType, b: ir.Literal) -> Optional[Any]:
+    """Literal -> the column's RAW value space, or None when pushing it
+    would round. Decimal columns store scale-multiplied int64: rescale
+    exact literals, refuse anything lossy. NULL never classifies (a
+    NULL comparison never matches; the filter keeps it)."""
+    if b.value is None:
+        return None
+    if t.is_decimal:
+        s = t.scale or 0
+        if b.type.is_decimal and (b.type.scale or 0) <= s:
+            return int(round(b.value * (10 ** s)))
+        if b.type.is_integerlike and not isinstance(b.value, bool):
+            return int(b.value) * (10 ** s)
+        return None
+    if not isinstance(b.value, (bool, int, float)):
+        return None
+    return b.value
+
+
+def _classify_comparison(e, columns, fields) -> Optional[ColumnConstraint]:
     """``col op literal`` (either operand order) over a pushable column
-    -> ColumnConstraint, else None. InputRefs index the SCAN's output
-    channels, so ``columns[ref.index]`` is the connector column name."""
+    -> ColumnConstraint, else None."""
     if not isinstance(e, ir.Call) or len(e.args) != 2:
         return None
     op = _FLIP.get(e.name)
@@ -67,28 +85,89 @@ def classify_conjunct(e, columns, fields) -> Optional[ColumnConstraint]:
         op = e.name
     if not (isinstance(a, ir.InputRef) and isinstance(b, ir.Literal)):
         return None
-    if b.value is None:  # NULL comparisons never match; leave to filter
-        return None
     t = fields[a.index].type
     if not _pushable_type(t):
         return None
-    # the constraint value must live in the column's RAW value space
-    # (decimal columns store scale-multiplied int64): rescale exact
-    # literals, refuse anything that would round
-    if t.is_decimal:
-        s = t.scale or 0
-        if b.type.is_decimal and (b.type.scale or 0) <= s:
-            return ColumnConstraint(
-                columns[a.index], op, int(round(b.value * (10 ** s)))
-            )
-        if b.type.is_integerlike and not isinstance(b.value, bool):
-            return ColumnConstraint(
-                columns[a.index], op, int(b.value) * (10 ** s)
-            )
+    value = _literal_value(t, b)
+    if value is None:
         return None
-    if not isinstance(b.value, (bool, int, float)):
+    return ColumnConstraint(columns[a.index], op, value)
+
+
+def _classify_in_list(e, columns, fields) -> Optional[ColumnConstraint]:
+    """``col IN (lit, ...)`` -> op="in" with a sorted value tuple (the
+    handle participates in plan-cache keys, so the representation must
+    be canonical). Every option must rescale exactly; one lossy or NULL
+    option keeps the whole predicate in the filter."""
+    if not isinstance(e.value, ir.InputRef) or not e.options:
         return None
-    return ColumnConstraint(columns[a.index], op, b.value)
+    t = fields[e.value.index].type
+    if not _pushable_type(t):
+        return None
+    vals = []
+    for opt in e.options:
+        if not isinstance(opt, ir.Literal):
+            return None
+        v = _literal_value(t, opt)
+        if v is None:
+            return None
+        vals.append(v)
+    return ColumnConstraint(
+        columns[e.value.index], "in", tuple(sorted(set(vals)))
+    )
+
+
+def _flatten_or(e) -> List:
+    if isinstance(e, ir.Call) and e.name == "or":
+        out: List = []
+        for a in e.args:
+            out.extend(_flatten_or(a))
+        return out
+    return [e]
+
+
+def _classify_or(e, columns, fields) -> Optional[ColumnConstraint]:
+    """OR tree whose every disjunct classifies against the SAME column
+    -> op="or" with a tuple of (atomic op, value) pairs — the
+    TupleDomain multi-range seat. IN-list disjuncts expand to eq pairs.
+    Any disjunct touching another column (or not classifying at all)
+    keeps the whole tree in the filter: pushing a weakened OR would be
+    wrong under the exact-enforcement contract."""
+    disjuncts: List[Tuple[str, Any]] = []
+    column: Optional[str] = None
+    for leaf in _flatten_or(e):
+        c = (
+            _classify_in_list(leaf, columns, fields)
+            if isinstance(leaf, ir.InList)
+            else _classify_comparison(leaf, columns, fields)
+        )
+        if c is None:
+            return None
+        if column is None:
+            column = c.column
+        elif c.column != column:
+            return None
+        if c.op == "in":
+            disjuncts.extend(("eq", v) for v in c.value)
+        else:
+            disjuncts.append((c.op, c.value))
+    if column is None or len(disjuncts) < 2:
+        return None
+    return ColumnConstraint(column, "or", tuple(disjuncts))
+
+
+def classify_conjunct(e, columns, fields) -> Optional[ColumnConstraint]:
+    """One filter conjunct -> ColumnConstraint, else None. Handles
+    ``col op literal`` (either operand order), ``col IN (literals)``
+    (op="in", value = sorted scalar tuple), and single-column OR trees
+    (op="or", value = tuple of (op, value) atomic pairs). InputRefs
+    index the SCAN's output channels, so ``columns[ref.index]`` is the
+    connector column name."""
+    if isinstance(e, ir.InList):
+        return _classify_in_list(e, columns, fields)
+    if isinstance(e, ir.Call) and e.name == "or":
+        return _classify_or(e, columns, fields)
+    return _classify_comparison(e, columns, fields)
 
 
 def split_supported(
@@ -101,7 +180,11 @@ def split_supported(
     residual: List[ColumnConstraint] = []
     for c in constraints:
         t = type_of(c.column)
-        if t is not None and _pushable_type(t) and c.op in _NP_OPS:
+        if (
+            t is not None
+            and _pushable_type(t)
+            and (c.op in _NP_OPS or c.op in ("in", "or"))
+        ):
             accepted.append(c)
         else:
             residual.append(c)
@@ -131,7 +214,15 @@ def constraint_mask(
     mask: Optional[np.ndarray] = None
     for c in constraints:
         data, valid = column_data(c.column)
-        m = _NP_OPS[c.op](np.asarray(data), c.value)
+        arr = np.asarray(data)
+        if c.op == "in":
+            m = np.isin(arr, np.asarray(c.value))
+        elif c.op == "or":
+            m = np.zeros(arr.shape, dtype=bool)
+            for op, v in c.value:
+                m = m | _NP_OPS[op](arr, v)
+        else:
+            m = _NP_OPS[c.op](arr, c.value)
         if valid is not None:
             m = m & np.asarray(valid, dtype=bool)
         mask = m if mask is None else (mask & m)
@@ -144,14 +235,48 @@ def range_predicate(
     """Constraints -> closed per-column [lo, hi] ranges for min/max
     pruning (parquet row-group stats). Conservative: gt/lt keep the
     bound closed (a group equal to the bound still reads and the exact
-    mask drops it); ne prunes nothing."""
+    mask drops it); ne prunes nothing. Multi-range constraints
+    contribute the UNION of their disjuncts' bounds — an "or" only
+    bounds a side when every disjunct bounds that side."""
     out: Dict[str, Tuple[Optional[Any], Optional[Any]]] = {}
     for c in constraints:
+        bounds = _constraint_bounds(c)
+        if bounds is None:
+            continue
+        clo, chi = bounds
         lo, hi = out.get(c.column, (None, None))
-        if c.op in ("gt", "ge", "eq"):
-            lo = c.value if lo is None else max(lo, c.value)
-        if c.op in ("lt", "le", "eq"):
-            hi = c.value if hi is None else min(hi, c.value)
-        if c.op in ("gt", "ge", "eq", "lt", "le"):
-            out[c.column] = (lo, hi)
+        if clo is not None:
+            lo = clo if lo is None else max(lo, clo)
+        if chi is not None:
+            hi = chi if hi is None else min(hi, chi)
+        out[c.column] = (lo, hi)
     return out
+
+
+def _constraint_bounds(
+    c: ColumnConstraint,
+) -> Optional[Tuple[Optional[Any], Optional[Any]]]:
+    """One constraint's own [lo, hi] contribution (None = no
+    contribution at all, e.g. ne)."""
+    if c.op in ("gt", "ge"):
+        return (c.value, None)
+    if c.op in ("lt", "le"):
+        return (None, c.value)
+    if c.op == "eq":
+        return (c.value, c.value)
+    if c.op == "in":
+        return (min(c.value), max(c.value)) if c.value else None
+    if c.op == "or":
+        los, his = [], []
+        for op, v in c.value:
+            b = _constraint_bounds(ColumnConstraint(c.column, op, v))
+            if b is None:
+                return None  # a ne disjunct admits everything
+            los.append(b[0])
+            his.append(b[1])
+        lo = min(los) if all(x is not None for x in los) else None
+        hi = max(his) if all(x is not None for x in his) else None
+        if lo is None and hi is None:
+            return None
+        return (lo, hi)
+    return None

@@ -146,6 +146,12 @@ class Session:
     resident_tables: str = ""
     resident_pin_budget_mb: int = 64
     resident_delta_max_rows: int = 4096
+    # adaptive execution tier (trino_tpu/adaptive/): mid-query
+    # re-planning from observed barrier stats, the divergence ratio
+    # that triggers it, and shared-subtree (NOT IN / CTE) spooling
+    adaptive_execution: bool = False
+    adaptive_replan_threshold: float = 4.0
+    shared_subtree_materialization: bool = False
 
     def set_property(self, name: str, value) -> None:
         """SET SESSION entry point — validated through the typed
@@ -1224,6 +1230,8 @@ class LocalQueryRunner:
     def _plan(self, q: ast.Query, sql_key: Optional[str], query_span=None):
         import contextlib
 
+        self._last_adaptive_report = None  # set again if adaptive runs
+
         def phase(name):
             if query_span is None:
                 return contextlib.nullcontext()
@@ -1264,6 +1272,21 @@ class LocalQueryRunner:
         with phase("analyze"):
             output = self._analyze(q)
         self._check_scans(output)
+        # adaptive execution: observe materialization barriers and
+        # re-plan the remainder BEFORE physical planning; transformed
+        # plans embed data snapshots so they never enter the plan cache
+        adaptive_report = None
+        from trino_tpu.adaptive import AdaptiveController
+
+        controller = AdaptiveController(
+            self.catalogs, self.session, span=query_span,
+            stabilizer=self._make_stabilizer(),
+        )
+        if controller.enabled():
+            with phase("adaptive"):
+                output = controller.prepare(output)
+            adaptive_report = controller.report
+        self._last_adaptive_report = adaptive_report
         with phase("optimize"):
             planner = LocalPlanner(
                 self.catalogs,
@@ -1275,7 +1298,11 @@ class LocalQueryRunner:
             physical = planner.plan(output)
         # plans with analysis-time-folded volatile values (now(),
         # current_date, uuid()) re-analyze every execution
-        if cache_key and not plan_is_volatile():
+        if (
+            cache_key
+            and not plan_is_volatile()
+            and not (adaptive_report is not None and adaptive_report.transformed)
+        ):
             from trino_tpu.serving.plan_cache import plan_tables
 
             self._plan_cache.store(
@@ -1498,6 +1525,11 @@ class LocalQueryRunner:
                 f"bytes={cs['bytes']} scrubbed={cs['scrubbed']} "
                 f"evicted={cs['evicted']}"
             )
+        # adaptive section: what the controller observed and did
+        # (estimated_vs_observed per barrier, replan/spool counts)
+        report = getattr(self, "_last_adaptive_report", None)
+        if report is not None:
+            census += "\n" + "\n".join(report.lines())
         # census goes AFTER the runtime stats: per-class lines name
         # operators too, and stats consumers grep for the first line
         # mentioning an operator

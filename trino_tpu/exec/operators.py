@@ -96,6 +96,48 @@ class TableScanOperator(Operator):
 
     def __init__(self, page_source, splits, columns: Sequence[str], batch_rows: int,
                  stabilizer=None):
+        self._page_source = page_source
+        self._splits = list(splits)
+        self._columns = columns
+        self._batch_rows = batch_rows
+        self._stabilizer = stabilizer
+        # zero-arg callable -> ColumnConstraints discovered at runtime
+        # (dynamic-filter build domains); folded into every split's
+        # handle just before the first page is pulled, so connector-
+        # level pruning (parquet row-group stats, constraint masks)
+        # applies to them exactly like planned pushdown
+        self._runtime_constraints = None
+        self._iters = None
+        self._done = False
+
+    def set_runtime_constraints(self, fn) -> None:
+        self._runtime_constraints = fn
+
+    def _start(self):
+        splits = self._splits
+        if self._runtime_constraints is not None:
+            try:
+                cs = tuple(self._runtime_constraints() or ())
+            except Exception:
+                cs = ()  # pruning is best-effort; the join still filters
+            if cs:
+                import dataclasses as _dc
+
+                from trino_tpu.connectors.pushdown import (
+                    merge_handle_constraints,
+                )
+                from trino_tpu.runtime.metrics import METRICS
+
+                splits = [
+                    _dc.replace(
+                        s, table=merge_handle_constraints(s.table, cs)
+                    )
+                    for s in splits
+                ]
+                METRICS.increment("dynamic_filter_scan_constraints")
+        page_source, columns = self._page_source, self._columns
+        batch_rows, stabilizer = self._batch_rows, self._stabilizer
+
         def _gen():
             for split in splits:
                 if stabilizer is not None:
@@ -111,8 +153,7 @@ class TableScanOperator(Operator):
                     it = page_source.batches(split, columns, batch_rows)
                 yield from it
 
-        self._iters = _gen()
-        self._done = False
+        return _gen()
 
     def needs_input(self) -> bool:
         return False
@@ -120,6 +161,8 @@ class TableScanOperator(Operator):
     def get_output(self) -> Optional[RelBatch]:
         if self._done:
             return None
+        if self._iters is None:
+            self._iters = self._start()
         nxt = next(self._iters, None)
         if nxt is None:
             self._done = True
@@ -3063,6 +3106,62 @@ class DynamicFilterOperator(Operator):
 
     def is_finished(self) -> bool:
         return self._finishing and self._out is None
+
+
+def dynamic_filter_constraints(
+    bridge: JoinBridge,
+    key_types,
+    key_names,
+    max_in_list: int = 64,
+) -> tuple:
+    """Build-side key domains as ColumnConstraints — the connector
+    reuse of dynamic filtering: when the probe is a bare scan, these
+    fold into its splits' handles so build-side bounds prune parquet
+    row groups (range_predicate) and mask rows (constraint_mask) at the
+    source, not just at the DynamicFilterOperator.
+
+    Per key: an IN-list when the build has few distinct values (exact
+    multi-range domain), else the [min, max] range. Returns () until
+    the build completes (the probe's driver runs after the build
+    pipeline, so by first probe page the bridge is populated — but a
+    non-blocking peek keeps this safe anywhere)."""
+    from trino_tpu.connectors.pushdown import _pushable_type
+    from trino_tpu.connectors.spi import ColumnConstraint
+
+    build = bridge.build_batch
+    if build is None:
+        return ()
+    live = np.asarray(jax.device_get(build.live_mask())).astype(bool)
+    out = []
+    for i, bc in enumerate(bridge.build_key_channels):
+        if i >= len(key_names):
+            break
+        t = key_types[i]
+        if t is None or not _pushable_type(t):
+            continue
+        col = build.columns[bc]
+        if getattr(col.data, "ndim", 1) == 2 or col.dictionary is not None:
+            continue  # long-decimal limbs / dictionary codes: no raw domain
+        data = np.asarray(jax.device_get(col.data))
+        w = live
+        if col.valid is not None:
+            w = w & np.asarray(jax.device_get(col.valid)).astype(bool)
+        vals = data[w]
+        if vals.size == 0:
+            continue  # empty build: the join itself yields nothing
+        uniq = np.unique(vals)
+        if uniq.size <= max_in_list:
+            out.append(ColumnConstraint(
+                key_names[i], "in", tuple(v.item() for v in uniq)
+            ))
+        else:
+            out.append(
+                ColumnConstraint(key_names[i], "ge", uniq[0].item())
+            )
+            out.append(
+                ColumnConstraint(key_names[i], "le", uniq[-1].item())
+            )
+    return tuple(out)
 
 
 # ---------------------------------------------------------------------------

@@ -859,6 +859,7 @@ class ChunkedMeshRunner:
                     }
                     LAST_RUN_INFO.clear()
                     LAST_RUN_INFO.update(self.info)
+                    self._record_divergences(sources, query_span)
                     return sources
                 except _Overflow as ov:
                     for site, _needed in ov.sites:
@@ -961,6 +962,62 @@ class ChunkedMeshRunner:
             fid: self.ex._shard_pages(batch, rep)
             for fid, (batch, rep) in outs.items()
         }
+
+    def _record_divergences(self, sources, query_span) -> None:
+        """Adaptive-tier observability at the mesh barrier: diff each
+        mesh fragment's exported row count (prelude exports + finished
+        chunk-stream outputs) against the optimizer's estimate. Instant
+        events + adaptive.divergences counters only — the mesh plane
+        never re-plans mid-flight; a divergent query's NEXT execution
+        re-plans through the controller."""
+        try:
+            from trino_tpu.adaptive.observer import record_observation
+            from trino_tpu.sql.stats import StatsCalculator
+
+            threshold = float(
+                getattr(self.session, "adaptive_replan_threshold", 4.0)
+                or 4.0
+            )
+            from trino_tpu.sql.stats import PlanStats
+
+            frag_rows: Dict[int, float] = {}
+
+            class _FragmentStats(StatsCalculator):
+                # producer fragments' estimates feed consumer leaves,
+                # same stitching the coordinator's stage diff uses
+                def _RemoteSourceNode(self, node):
+                    rows = sum(
+                        frag_rows.get(fid, 1.0)
+                        for fid in node.fragment_ids
+                    )
+                    return PlanStats(max(rows, 1.0))
+
+            calc = _FragmentStats(self.ex.catalogs)
+
+            def estimate(sp) -> float:
+                for c in sp.children:
+                    estimate(c)
+                fid = sp.fragment.id
+                if fid not in frag_rows:
+                    frag_rows[fid] = calc.stats(
+                        sp.fragment.root
+                    ).row_count
+                return frag_rows[fid]
+
+            for sp in self.mesh_sps:
+                estimate(sp)
+            for sp in self.mesh_sps:
+                fid = sp.fragment.id
+                pages = sources.get(fid)
+                if pages is None:
+                    continue
+                observed = sum(int(p.row_count) for p in pages)
+                record_observation(
+                    f"mesh-fragment:{fid}", frag_rows.get(fid, 1.0),
+                    observed, threshold, span=query_span,
+                )
+        except Exception:
+            pass  # observability must never fail the run
 
     def _run_prelude(self, record: MeshProgramRecord, task_span, op_span,
                      attempt: int, n: int):

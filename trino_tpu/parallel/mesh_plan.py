@@ -289,13 +289,19 @@ class _FragVisitor:
         return self.feeds[id(node)]
 
     def _visit_ValuesNode(self, node):
-        data = {f.name or f"_c{i}": [] for i, f in enumerate(node.fields)}
-        keys = list(data)
+        keys = [f.name or f"_c{i}" for i, f in enumerate(node.fields)]
+        if len(set(keys)) != len(keys):
+            # spooled join subtrees repeat column names (k, name, k,
+            # name); a name-keyed dict would silently drop channels
+            keys = [f"{k}_{i}" for i, k in enumerate(keys)]
+        data = {k: [] for k in keys}
         for row in node.rows:
             for k, v in zip(keys, row):
                 data[k].append(v)
         schema_t = [(k, f.type) for k, f in zip(keys, node.fields)]
         return RelBatch.from_pydict(schema_t, data)
+
+    _visit_SpooledValuesNode = _visit_ValuesNode
 
     def _visit_RemoteSourceNode(self, node):
         parts = [self.ctx[fid] for fid in node.fragment_ids]

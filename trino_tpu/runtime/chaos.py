@@ -74,6 +74,17 @@ SERVING_CLASSES = (
     "loaded_cluster",
 )
 
+# adaptive scenarios (PR 13): the loaded-cluster fault burst + the
+# mid-traffic drain, on a population whose session runs ADAPTIVELY — a
+# query mix seeded with a misestimated join so the coordinator is
+# re-planning mid-query while workers crash and drain out from under
+# it. Re-planned queries must stay oracle-equal and the run must record
+# at least one re-plan (otherwise the scenario proved nothing). Run via
+# run_adaptive_drain_case.
+ADAPTIVE_CLASSES = (
+    "adaptive_loaded_drain",
+)
+
 
 def generate_schedule(
     seed: int,
@@ -671,6 +682,27 @@ class ChaosHarness:
         stats["untyped_errors"] = stats["untyped_errors"][:5]
         return None, stats
 
+    def run_adaptive_drain_case(
+        self, queries: Dict[str, str], seed: int = 0, **kw,
+    ) -> Tuple[None, dict]:
+        """PR 13: loaded-cluster faults + mid-traffic drain against an
+        ADAPTIVE session (construct the harness with adaptive_execution
+        on and a permissive re-plan threshold). Delegates the population
+        mechanics to run_loaded_cluster_case and adds the adaptive
+        counters observed during the phase, so the caller can assert
+        the drain actually landed on a cluster that was re-planning."""
+        from trino_tpu.runtime.metrics import METRICS
+
+        before = METRICS.snapshot()
+        _, report = self.run_loaded_cluster_case(queries, seed, **kw)
+        after = METRICS.snapshot()
+        for counter in ("adaptive.replans", "adaptive.divergences",
+                        "adaptive.spool_hits"):
+            report[counter] = int(
+                after.get(counter, 0) - before.get(counter, 0)
+            )
+        return None, report
+
 
 def chaos_smoke(
     seed: int,
@@ -908,6 +940,75 @@ def chaos_smoke(
                 f"completed={report['completed']} ok={report['ok']} "
                 f"sheds={report['sheds']} "
                 f"typed_failures={report['typed_failures']} "
+                f"drained={report['drained']} hung=0"
+            )
+    # adaptive scenario (PR 13): the same loaded-cluster burst + drain,
+    # on a session that re-plans mid-query. The query mix adds a join
+    # whose build-side filter the stats heuristics misestimate, so with
+    # the permissive threshold every execution crosses the re-plan gate
+    # — the drain and fault burst land while re-planned programs are in
+    # flight, and each completion is still checked against the clean run
+    from trino_tpu.engine import Session
+
+    adaptive_queries = dict(queries)
+    adaptive_queries["replan"] = (
+        "select count(*) from supplier s "
+        "join nation n on s_nationkey = n_nationkey "
+        "where n_nationkey % 2 = 0"
+    )
+    for scenario in ADAPTIVE_CLASSES:
+        h = ChaosHarness(
+            n_workers=3,
+            session=Session(
+                catalog="tpch", schema="tiny", retry_policy="task",
+                adaptive_execution=True,
+                shared_subtree_materialization=True,
+                adaptive_replan_threshold=1.3,
+            ),
+        )
+        h.register_catalog("tpch", create_tpch_connector())
+        try:
+            _, report = h.run_adaptive_drain_case(adaptive_queries, seed)
+        except Exception as e:
+            failures.append(
+                f"adaptive/{scenario}: raised {type(e).__name__}: {e}"
+            )
+            continue
+        if report["ok"] == 0:
+            failures.append(
+                f"adaptive/{scenario}: zero oracle-equal results "
+                f"({report})"
+            )
+        if report["mismatches"]:
+            failures.append(
+                f"adaptive/{scenario}: {report['mismatches']} re-planned "
+                f"results diverged from clean run under faults"
+            )
+        if report["untyped_error_count"]:
+            failures.append(
+                f"adaptive/{scenario}: {report['untyped_error_count']} "
+                f"untyped errors (first: {report['untyped_errors'][:1]})"
+            )
+        if report["hung_threads"]:
+            failures.append(
+                f"adaptive/{scenario}: {report['hung_threads']} client "
+                f"threads never returned"
+            )
+        if not report["drained"]:
+            failures.append(
+                f"adaptive/{scenario}: mid-traffic drain timed out"
+            )
+        if report["adaptive.replans"] < 1:
+            failures.append(
+                f"adaptive/{scenario}: no re-plan happened during the "
+                f"run — the drain never raced a re-planning query"
+            )
+        if verbose:
+            print(
+                f"  chaos adaptive/{scenario}: ok "
+                f"completed={report['completed']} ok={report['ok']} "
+                f"replans={report['adaptive.replans']} "
+                f"spool_hits={report['adaptive.spool_hits']} "
                 f"drained={report['drained']} hung=0"
             )
     return failures

@@ -61,6 +61,12 @@ def _registry() -> Dict[str, type]:
         # task layer
         task_mod.TaskId, task_mod.TaskSpec,
     ]
+    # adaptive tier: spooled subtrees (and the exact observed stats
+    # they carry) travel inside distributed fragments
+    from trino_tpu.adaptive.spool import SpooledValuesNode
+    from trino_tpu.sql.stats import ColStats, PlanStats
+
+    classes += [SpooledValuesNode, PlanStats, ColStats]
     return {c.__name__: c for c in classes}
 
 

@@ -517,6 +517,16 @@ class DistributedQueryRunner:
         if isinstance(stmt, ast.ExplainStatement):
             output = self._analyze(stmt.query)
             self._check_access(output, identity)
+            # EXPLAIN ANALYZE runs the adaptive controller exactly like
+            # execute would, so the rendered plan/adaptive section shows
+            # what a plain run of the statement does
+            from trino_tpu.adaptive import AdaptiveController
+
+            self._last_adaptive_report = None
+            controller = AdaptiveController(self.catalogs, self.session)
+            if stmt.analyze and controller.enabled():
+                output = controller.prepare(output)
+                self._last_adaptive_report = controller.report
             subplan = plan_distributed(
                 output, self.catalogs,
                 broadcast_threshold=self.session.broadcast_join_threshold,
@@ -670,6 +680,7 @@ class DistributedQueryRunner:
         # reset BEFORE any plane decision: a stale reason from an earlier
         # query must not read as applying to this one
         self.last_mesh_fallback = None
+        self._last_adaptive_report = None
         cache_key = None
         try:
             from trino_tpu.sql.formatter import format_statement
@@ -699,6 +710,23 @@ class DistributedQueryRunner:
             reset_volatile_plan()
             output = self._analyze(stmt, query_span=query_span)
             self._check_access(output, identity)
+            # adaptive execution: materialize barriers on the
+            # coordinator's catalogs and re-plan the remainder before
+            # fragmenting. tracker.check at every barrier keeps a kill
+            # latched mid-re-plan typed (EXCEEDED_TIME_LIMIT, not a
+            # retryable transport error).
+            adaptive_report = None
+            from trino_tpu.adaptive import AdaptiveController
+
+            controller = AdaptiveController(
+                self.catalogs, self.session, span=query_span,
+                preempt=lambda: tracker.check(base_qid),
+            )
+            if controller.enabled():
+                with phase("adaptive"):
+                    output = controller.prepare(output)
+                adaptive_report = controller.report
+            self._last_adaptive_report = adaptive_report
             with phase("fragment"):
                 subplan = plan_distributed(
                     output,
@@ -709,7 +737,14 @@ class DistributedQueryRunner:
                         self.session, "plan_validation", "passes"
                     ),
                 )
-            if cache_key is not None and not plan_is_volatile():
+            if (
+                cache_key is not None
+                and not plan_is_volatile()
+                and not (
+                    adaptive_report is not None
+                    and adaptive_report.transformed
+                )
+            ):
                 from trino_tpu.serving.plan_cache import plan_tables
 
                 self._plan_cache.store(
@@ -899,6 +934,9 @@ class DistributedQueryRunner:
                     self._last_stage_infos = self._stage_infos(
                         scheduler.finalize()
                     )
+                    self._record_stage_divergences(
+                        subplan, self._last_stage_infos, query_span
+                    )
                 except Exception:
                     pass  # observability must never mask the verdict
                 scheduler.abort()
@@ -999,9 +1037,13 @@ class DistributedQueryRunner:
             # OperatorStats formatter PLUS the per-task summary lines
             # distributed EXPLAIN ANALYZE used to lose
             stages = self._stage_infos(scheduler.finalize())
+            self._record_stage_divergences(subplan, stages)
             lines = [self._explain_text(subplan)]
             for stage in stages:
                 lines.append(stage_text(stage))
+            report = getattr(self, "_last_adaptive_report", None)
+            if report is not None:
+                lines.append("\n" + "\n".join(report.lines()))
             # which plane a plain `execute` of this statement would
             # take (the ANALYZE instrumentation itself runs the page
             # scheduler above either way, for the operator stats)
@@ -1087,6 +1129,9 @@ class DistributedQueryRunner:
                 try:
                     self._last_stage_infos = self._stage_infos(
                         scheduler.task_snapshots()
+                    )
+                    self._record_stage_divergences(
+                        subplan, self._last_stage_infos, query_span
                     )
                 except Exception:
                     pass
@@ -1236,6 +1281,88 @@ class DistributedQueryRunner:
                 METRICS.observe("stage_wall_s", info["wall_s"])
             infos.append(info)
         return infos
+
+    def _fragment_estimates(self, subplan) -> Dict[int, float]:
+        """Optimizer row estimate per fragment root. RemoteSourceNode
+        leaves resolve to the (already computed) producer-fragment
+        estimates, so every stage diffs against the same numbers the
+        fragmenter's partition-count decision used."""
+        from trino_tpu.sql import plan as P
+        from trino_tpu.sql.stats import PlanStats, StatsCalculator
+
+        frag_rows: Dict[int, float] = {}
+
+        class _FragmentStats(StatsCalculator):
+            def _RemoteSourceNode(self, node):
+                rows = sum(
+                    frag_rows.get(fid, 1.0) for fid in node.fragment_ids
+                )
+                return PlanStats(max(rows, 1.0))
+
+        calc = _FragmentStats(self.catalogs)
+
+        def walk(sp):
+            for c in sp.children:
+                walk(c)
+            frag_rows[sp.fragment.id] = calc.stats(
+                sp.fragment.root
+            ).row_count
+
+        walk(subplan)
+        return frag_rows
+
+    @staticmethod
+    def _stage_output_rows(stage: dict) -> Optional[int]:
+        """Rows leaving the stage: what entered the terminal output/sink
+        operator of the final pipeline (sinks emit no batches, so their
+        input side IS the fragment's output)."""
+        groups = stage.get("operator_summaries") or []
+        for group in reversed(groups):
+            if not group:
+                continue
+            last = group[-1]
+            name = str(last.get("operator") or "")
+            if "Output" in name or "Sink" in name:
+                return int(last.get("input_rows") or 0)
+            return int(last.get("output_rows") or 0)
+        return None
+
+    def _record_stage_divergences(
+        self, subplan, stages, query_span=None
+    ) -> None:
+        """Per-fragment estimated_vs_observed: annotate the stage
+        rollups (QueryInfo + distributed EXPLAIN ANALYZE render them),
+        drop tracer instant events, and count adaptive.divergences.
+        Recording is unconditional — divergence observability does not
+        depend on adaptive_execution being on."""
+        if not stages:
+            return
+        try:
+            from trino_tpu.adaptive.observer import (
+                estimated_vs_observed_line,
+                record_observation,
+            )
+
+            estimates = self._fragment_estimates(subplan)
+            threshold = float(
+                getattr(self.session, "adaptive_replan_threshold", 4.0)
+                or 4.0
+            )
+            for stage in stages:
+                fid = stage.get("fragment_id")
+                est = estimates.get(fid)
+                observed = self._stage_output_rows(stage)
+                if est is None or observed is None:
+                    continue
+                site = f"fragment:{fid}"
+                ratio = record_observation(
+                    site, est, observed, threshold, span=query_span
+                )
+                stage["estimated_vs_observed"] = estimated_vs_observed_line(
+                    site, est, observed, ratio
+                )
+        except Exception:
+            pass  # observability must never mask the verdict
 
     def _drain_query_peaks(self, base_qid: str) -> int:
         """Sum per-worker peak-memory watermarks for this query (every

@@ -161,6 +161,8 @@ def stage_text(stage: dict) -> str:
             f"lowerings: expected={stage['expected_lowerings']} "
             f"observed={stage['observed_lowerings']}"
         )
+    if stage.get("estimated_vs_observed"):
+        lines.append(stage["estimated_vs_observed"])
     for t in stage["task_infos"]:
         wall = t.get("wall_s")
         wall_txt = f"{wall * 1000:.1f}ms" if wall is not None else "?"

@@ -51,6 +51,9 @@ PLAN_AFFECTING_PROPERTIES = (
     "shape_stabilization",
     "capacity_ladder_base",
     "plan_validation",
+    "adaptive_execution",
+    "adaptive_replan_threshold",
+    "shared_subtree_materialization",
 )
 
 

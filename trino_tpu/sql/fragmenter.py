@@ -119,6 +119,9 @@ class _AddExchanges:
     def _ValuesNode(self, node):
         return node, SINGLE
 
+    # a spooled (adaptively materialized) subtree is a literal leaf
+    _SpooledValuesNode = _ValuesNode
+
     # pass-through (channels unchanged)
     def _FilterNode(self, node):
         child, dist = self.visit(node.child)

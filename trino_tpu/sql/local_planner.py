@@ -255,8 +255,12 @@ class LocalPlanner:
         return self.stabilizer.scan_classes(rows)
 
     def _visit_ValuesNode(self, node: P.ValuesNode):
-        data = {f.name or f"_c{i}": [] for i, f in enumerate(node.fields)}
-        keys = list(data)
+        keys = [f.name or f"_c{i}" for i, f in enumerate(node.fields)]
+        if len(set(keys)) != len(keys):
+            # spooled join subtrees repeat column names (k, name, k,
+            # name); a name-keyed dict would silently drop channels
+            keys = [f"{k}_{i}" for i, k in enumerate(keys)]
+        data = {k: [] for k in keys}
         for row in node.rows:
             for k, v in zip(keys, row):
                 data[k].append(v)
@@ -270,6 +274,11 @@ class LocalPlanner:
         if self.stabilizer is not None and batch.columns:
             factory.out_caps = (batch.capacity,)
         return [factory], schema
+
+    # adaptive execution: a materialized subtree IS a values source;
+    # its batch pads to bucket_capacity like any other, so re-planned
+    # programs land on existing capacity-ladder shape classes
+    _visit_SpooledValuesNode = _visit_ValuesNode
 
     # -- fusion helpers (program-count reduction; see compose_batch_fns) --
     def _cached_fp(self, flt: Optional[Bound], bounds: List[Bound],
@@ -565,6 +574,37 @@ class LocalPlanner:
         if kind in ("inner", "semi") and self.dynamic_filtering:
             from trino_tpu.exec.operators import DynamicFilterOperator
 
+            # connector reuse: when the probe side is a bare scan, feed
+            # the build-side key domains into the scan's split handles
+            # (evaluated lazily at first probe page — the build pipeline
+            # has completed by then) so parquet row-group pruning and
+            # constraint masks apply to dynamic-filter bounds too. The
+            # DynamicFilterOperator below still enforces, so an
+            # unpopulated bridge only costs the pruning, never rows.
+            if isinstance(node.left, P.ScanNode) and len(probe_chain) == 1:
+                from trino_tpu.exec.operators import (
+                    dynamic_filter_constraints,
+                )
+
+                scan = node.left
+                key_names = [scan.columns[c] for c in lkeys]
+                key_types = [scan.fields[c].type for c in lkeys]
+                scan_factory = probe_chain[0]
+
+                def df_scan_factory(ctx, _f=scan_factory):
+                    op = _f(ctx)
+                    if hasattr(op, "set_runtime_constraints"):
+                        op.set_runtime_constraints(
+                            lambda: dynamic_filter_constraints(
+                                bridge_of(ctx), key_types, key_names
+                            )
+                        )
+                    return op
+
+                caps = getattr(scan_factory, "out_caps", None)
+                if caps is not None:
+                    df_scan_factory.out_caps = caps
+                probe_chain[0] = df_scan_factory
             probe_chain.append(
                 lambda ctx: DynamicFilterOperator(bridge_of(ctx), lkeys)
             )

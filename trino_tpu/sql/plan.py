@@ -322,8 +322,15 @@ def explain_text(node: PlanNode, indent: int = 0) -> str:
         detail = f" {node.catalog}.{h.schema}.{h.table} {list(node.columns)}"
         pushed = getattr(h, "constraints", ())
         if pushed:
+            def _ctext(c):
+                if c.op == "or":  # multi-range: render the disjuncts
+                    return c.column + " (" + " or ".join(
+                        f"{op} {v!r}" for op, v in c.value
+                    ) + ")"
+                return f"{c.column} {c.op} {c.value!r}"
+
             detail += " pushed=[" + ", ".join(
-                f"{c.column} {c.op} {c.value!r}" for c in pushed
+                _ctext(c) for c in pushed
             ) + "]"
     elif isinstance(node, FilterNode):
         detail = f" {node.predicate!r}"
@@ -354,6 +361,12 @@ def explain_text(node: PlanNode, indent: int = 0) -> str:
         detail = f" n={node.count} offset={node.offset}"
     elif isinstance(node, OutputNode):
         detail = f" {list(node.names)}"
+    elif isinstance(node, ValuesNode) and getattr(node, "spool_key", ""):
+        # adaptively materialized subtree riding along as a literal
+        detail = (
+            f" rows={len(node.rows)} spool={node.spool_key}"
+            f" [{getattr(node, 'source_desc', '')}]"
+        )
     lines = [f"{pad}{name}{detail}"]
     for c in node.children():
         lines.append(explain_text(c, indent + 1))

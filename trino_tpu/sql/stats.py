@@ -49,7 +49,16 @@ class StatsCalculator:
         hit = self._memo.get(key)
         if hit is not None and hit[0] is node:
             return hit[1]
+        # adaptive execution substitutes materialized subtrees back into
+        # the plan carrying their EXACT observed statistics — those beat
+        # any estimate this calculator could derive
+        ps = getattr(node, "plan_stats", None)
+        if isinstance(ps, PlanStats):
+            self._memo[key] = (node, ps)
+            return ps
         m = getattr(self, f"_{type(node).__name__}", None)
+        if m is None and isinstance(node, P.ValuesNode):
+            m = self._ValuesNode
         out = m(node) if m is not None else self._default(node)
         self._memo[key] = (node, out)
         return out
