@@ -84,6 +84,18 @@ Flags:
                      lowerings in the warm loop; JSON re-plan counts,
                      exit 1 on violation; no device needed (runs before
                      preflight)
+  --recovery-smoke   exercise the recovery tier (trino_tpu/recovery/):
+                     a q72-class deep join chunked over an 8-device CPU
+                     mesh takes an injected device loss at chunk k of K
+                     twice — once with checkpointing off (the fault
+                     discards every completed chunk and the page plane
+                     recomputes from zero) and once with chunk
+                     checkpointing on (the run resumes from the last
+                     checkpoint); the resumed arm must stay oracle-
+                     equal, re-execute fewer chunks than the restart,
+                     beat the restart wall, and mint zero new XLA
+                     lowerings; re-execs itself with an 8-device host
+                     platform, so no device needed
 """
 
 from __future__ import annotations
@@ -815,6 +827,7 @@ def _chaos_smoke(argv) -> int:
         ADAPTIVE_CLASSES,
         FAULT_CLASSES,
         LIFECYCLE_CLASSES,
+        RECOVERY_CLASSES,
         SERVING_CLASSES,
         TIMEBOUND_CLASSES,
         chaos_smoke,
@@ -825,7 +838,8 @@ def _chaos_smoke(argv) -> int:
           f"lifecycle={','.join(LIFECYCLE_CLASSES)} "
           f"timebound={','.join(TIMEBOUND_CLASSES)} "
           f"serving={','.join(SERVING_CLASSES)} "
-          f"adaptive={','.join(ADAPTIVE_CLASSES)}")
+          f"adaptive={','.join(ADAPTIVE_CLASSES)} "
+          f"recovery={','.join(RECOVERY_CLASSES)},recovery_loaded_drain")
     t0 = time.time()
     violations = chaos_smoke(seed, CHAOS_QUERIES)
     wall = time.time() - t0
@@ -836,7 +850,8 @@ def _chaos_smoke(argv) -> int:
             "seed": seed,
             "cases": len(CHAOS_QUERIES) * len(FAULT_CLASSES)
             + len(LIFECYCLE_CLASSES) + len(TIMEBOUND_CLASSES)
-            + len(SERVING_CLASSES) + len(ADAPTIVE_CLASSES),
+            + len(SERVING_CLASSES) + len(ADAPTIVE_CLASSES)
+            + len(RECOVERY_CLASSES) + 1,
             "violations": len(violations),
             "wall_s": round(wall, 2),
         }
@@ -1562,6 +1577,173 @@ def _adaptive_smoke(argv) -> int:
     return 1 if violations else 0
 
 
+# recovery-smoke query: a q72-class deep multi-build join (4 tables,
+# grouped agg) that the mesh plane chunks into dozens of steps — deep
+# enough that discarding completed chunks is genuinely expensive
+RECOVERY_Q = (
+    "select c_mktsegment, n_name, count(*) c, sum(l_quantity) q "
+    "from lineitem join orders on l_orderkey = o_orderkey "
+    "join customer on o_custkey = c_custkey "
+    "join nation on c_nationkey = n_nationkey "
+    "group by c_mktsegment, n_name order by c_mktsegment, n_name"
+)
+
+
+def _recovery_smoke(argv) -> int:
+    """--recovery-smoke: CI gate for the recovery tier
+    (trino_tpu/recovery/). An injected device loss lands at chunk k of
+    K on a q72-class join, twice: the RESTART arm runs with
+    checkpointing off — the fault discards every completed chunk and
+    the page plane recomputes from zero (the pre-recovery behavior) —
+    and the RESUME arm runs with chunk checkpointing on, so the mesh
+    resumes from its last checkpoint. Gates: both arms oracle-equal to
+    the page plane, the resume arm stays ON the mesh, resumes >= 1,
+    re-executes fewer chunks than the restart discards, beats the
+    restart wall, and mints zero new XLA lowerings (resumed carries
+    land on already-warm capacity-ladder rungs). Exit 1 on violation."""
+    if os.environ.get("RECOVERY_SMOKE_INNER") != "1":
+        # same clean-slate re-exec as --mesh-smoke: the multi-device
+        # host platform must be configured before jax initializes
+        env = dict(os.environ)
+        env["RECOVERY_SMOKE_INNER"] = "1"
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        return subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--recovery-smoke"],
+            env=env,
+        ).returncode
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    n_dev = len(jax.devices())
+
+    from trino_tpu.connectors.tpch import create_tpch_connector
+    from trino_tpu.engine import Session
+    from trino_tpu.parallel import mesh_chunk
+    from trino_tpu.parallel.mesh_chunk import LAST_RUN_INFO, MeshDeviceLost
+    from trino_tpu.runtime import DistributedQueryRunner
+    from trino_tpu.runtime.metrics import METRICS
+
+    def mk(**session_kw):
+        r = DistributedQueryRunner(
+            Session(catalog="tpch", schema="tiny", **session_kw),
+            n_workers=2, hash_partitions=2,
+        )
+        r.register_catalog("tpch", create_tpch_connector())
+        return r
+
+    violations = []
+    print(f"bench: recovery smoke ({n_dev}-device cpu mesh, "
+          "q72-class join, tpch tiny)")
+    page = mk(mesh_execution=False)
+    oracle = page.execute(RECOVERY_Q).rows
+
+    resume = mk(mesh_chunk_rows=256, mesh_checkpoint_interval_chunks=4)
+    warm = resume.execute(RECOVERY_Q).rows  # warm clean run
+    if resume._last_data_plane != "mesh":
+        violations.append(
+            f"clean run took {resume._last_data_plane}, not the mesh "
+            f"(fallback: {resume.last_mesh_fallback})"
+        )
+    if warm != oracle:
+        violations.append("clean mesh run != page-plane oracle")
+    K = int(LAST_RUN_INFO.get("chunks") or 0)
+    fault_k = max(1, (3 * K) // 4)
+
+    def make_hook():
+        state = {"fired": 0}
+
+        def hook(k, Ktot):
+            if k == fault_k and not state["fired"]:
+                state["fired"] = 1
+                raise MeshDeviceLost(
+                    f"recovery smoke: injected device loss at chunk "
+                    f"{k}/{Ktot}"
+                )
+
+        return hook, state
+
+    # RESTART arm: no checkpoints — the fault unwinds the whole mesh
+    # run and the page plane recomputes from zero
+    restart = mk(mesh_chunk_rows=256)
+    restart.execute(RECOVERY_Q)  # warm its mesh programs too
+    hook, st_restart = make_hook()
+    mesh_chunk.MESH_FAULT_HOOK = hook
+    t0 = time.time()
+    try:
+        rows_restart = restart.execute(RECOVERY_Q).rows
+    finally:
+        mesh_chunk.MESH_FAULT_HOOK = None
+    wall_restart = time.time() - t0
+    if rows_restart != oracle:
+        violations.append("restart arm diverged from the oracle")
+    if not st_restart["fired"]:
+        violations.append("restart arm: fault never fired")
+
+    # RESUME arm: same fault, checkpoint every 4 chunks
+    hook, st_resume = make_hook()
+    compiles0 = METRICS.snapshot().get("xla_compiles", 0.0)
+    mesh_chunk.MESH_FAULT_HOOK = hook
+    t0 = time.time()
+    try:
+        rows_resume = resume.execute(RECOVERY_Q).rows
+    finally:
+        mesh_chunk.MESH_FAULT_HOOK = None
+    wall_resume = time.time() - t0
+    new_lowerings = METRICS.snapshot().get("xla_compiles", 0.0) - compiles0
+    info = dict(LAST_RUN_INFO)
+    re_executed = int(info.get("executed_chunk_steps") or 0) - K
+    if rows_resume != oracle:
+        violations.append("resume arm diverged from the oracle")
+    if not st_resume["fired"]:
+        violations.append("resume arm: fault never fired")
+    elif resume._last_data_plane != "mesh":
+        violations.append(
+            f"resume arm left the mesh plane "
+            f"({resume._last_data_plane}: {resume.last_mesh_fallback})"
+        )
+    elif not info.get("resumes"):
+        violations.append(f"resume arm never resumed ({info})")
+    elif re_executed >= fault_k:
+        violations.append(
+            f"resume arm re-executed {re_executed} chunks — the "
+            f"restart arm discards {fault_k}; the checkpoint saved "
+            "nothing"
+        )
+    if wall_resume >= wall_restart:
+        violations.append(
+            f"resume wall {wall_resume:.2f}s did not beat the "
+            f"full-restart wall {wall_restart:.2f}s"
+        )
+    if new_lowerings > 0:
+        violations.append(
+            f"resumed run lowered {new_lowerings:g} new XLA programs "
+            "(expected 0: carries are ladder-stable)"
+        )
+
+    for v in violations:
+        print(f"bench: recovery VIOLATION: {v}", file=sys.stderr)
+    print(json.dumps({
+        "recovery_smoke": {
+            "devices": n_dev,
+            "chunks": K,
+            "fault_chunk": fault_k,
+            "resumed_from_chunk": info.get("resumed_from_chunk"),
+            "re_executed_chunks": re_executed,
+            "restart_wall_s": round(wall_restart, 3),
+            "resume_wall_s": round(wall_resume, 3),
+            "new_lowerings_on_resume": new_lowerings,
+            "violations": len(violations),
+        }
+    }))
+    return 1 if violations else 0
+
+
 def _validate_corpus(argv) -> int:
     """--validate-corpus: CI gate for the plan sanity checkers
     (sql/validate.py). Plans — without executing — every TPC-H and
@@ -1674,6 +1856,8 @@ def main() -> None:
         sys.exit(_resident_smoke(sys.argv))
     if "--adaptive-smoke" in sys.argv:
         sys.exit(_adaptive_smoke(sys.argv))
+    if "--recovery-smoke" in sys.argv:
+        sys.exit(_recovery_smoke(sys.argv))
     if "--validate-corpus" in sys.argv:
         sys.exit(_validate_corpus(sys.argv))
     if os.environ.get("BENCH_INNER") == "1":

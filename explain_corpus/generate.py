@@ -23,6 +23,25 @@ future PR mean the planner actually changed.
 
 import os
 
+# corpus 11 exercises the chunked mesh plane, whose chunk count depends
+# on the per-shard extent — force the same virtual 8-device CPU mesh the
+# test suite runs under (tests/conftest.py) so standalone regeneration
+# matches the corpus-diff gate
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass
+
 import numpy as np
 
 from trino_tpu import types as T
@@ -385,9 +404,11 @@ def corpus_07_distributed_analyze():
         text = re.sub(r"\b(wall|cpu)=\d+(\.\d+)?ms", r"\1=#ms", text)
         text = re.sub(r"\b(add|get|finish)=\d+(\.\d+)?", r"\1=#", text)
         text = re.sub(r"\btask q\d+\.", "task q#.", text)
-        # process-global resident-tier counters depend on what ran
-        # before this corpus fn — corpus 09 pins the real numbers
+        # process-global resident/recovery-tier counters depend on what
+        # ran before this corpus fn — corpora 09 and 11 pin the real
+        # numbers
         text = re.sub(r"resident= .*", "resident= #", text)
+        text = re.sub(r"recovery= .*", "recovery= #", text)
         return text
 
     emit(
@@ -434,6 +455,7 @@ def corpus_08_mesh_analyze():
         text = re.sub(r"\b(add|get|finish)=\d+(\.\d+)?", r"\1=#", text)
         text = re.sub(r"\btask q\d+\.", "task q#.", text)
         text = re.sub(r"resident= .*", "resident= #", text)
+        text = re.sub(r"recovery= .*", "recovery= #", text)
         return text
 
     emit(
@@ -526,6 +548,7 @@ def corpus_09_resident_analyze():
         text = re.sub(r"\b(add|get|finish)=\d+(\.\d+)?", r"\1=#", text)
         text = re.sub(r"\btask q\d+\.", "task q#.", text)
         text = re.sub(r"pinned_bytes=\d+", "pinned_bytes=#", text)
+        text = re.sub(r"recovery= .*", "recovery= #", text)
         return text
 
     emit(
@@ -593,6 +616,7 @@ def corpus_10_adaptive_analyze():
         text = re.sub(r"\b(add|get|finish)=\d+(\.\d+)?", r"\1=#", text)
         text = re.sub(r"\btask q\d+\.", "task q#.", text)
         text = re.sub(r"resident= .*", "resident= #", text)
+        text = re.sub(r"recovery= .*", "recovery= #", text)
         text = re.sub(r"spool=[0-9a-f]+", "spool=#", text)
         return text
 
@@ -612,6 +636,95 @@ def corpus_10_adaptive_analyze():
     )
 
 
+def corpus_11_recovery_analyze():
+    """The recovery tier (trino_tpu/recovery/): a chunked mesh query
+    with `mesh_checkpoint_interval_chunks` set snapshots its device
+    carries at checkpoint boundaries; an injected MeshDeviceLost
+    mid-run resumes from the last checkpoint instead of chunk 0 (the
+    already-accumulated chunks are never re-executed and the resumed
+    stretch lands on the same warm ladder rungs), oracle-equal to the
+    uninterrupted run. The trailing `recovery=` line of EXPLAIN ANALYZE
+    pins the lifetime counters and the `resumed_from_chunk=k/K`
+    position of the most recent mesh run (ANALYZE itself executes the
+    task plane to collect per-operator stats, so the faulted run comes
+    first). Counters are reset up front so the numbers are exact;
+    timings redacted as in corpus 07."""
+    import re
+
+    from trino_tpu.parallel import mesh_chunk
+    from trino_tpu.recovery import CHECKPOINTS
+    from trino_tpu.runtime import DistributedQueryRunner
+
+    CHECKPOINTS.clear()
+    CHECKPOINTS.reset_stats()
+    METRICS.remove("recovery.spooled_stage_hits")
+    r = DistributedQueryRunner(
+        Session(
+            catalog="tpch", schema="tiny",
+            mesh_chunk_rows=1024, mesh_checkpoint_interval_chunks=2,
+        ),
+        n_workers=2,
+        hash_partitions=2,
+    )
+    r.register_catalog("tpch", create_tpch_connector())
+    sql = (
+        "select l_returnflag, count(*), sum(l_quantity) from lineitem "
+        "group by l_returnflag"
+    )
+    # one clean run to learn the chunk geometry (and warm the ladder)
+    clean = r.execute(sql).rows
+    clean_taken = CHECKPOINTS.taken
+    n_chunks = mesh_chunk.LAST_RUN_INFO["chunks"]
+    target = n_chunks - 2  # fault late: most chunks already settled
+    state = {"fired": False}
+
+    def fault_once(k, K):
+        if not state["fired"] and k == target:
+            state["fired"] = True
+            raise mesh_chunk.MeshDeviceLost(
+                f"injected device loss at chunk {k}/{K}"
+            )
+
+    mesh_chunk.MESH_FAULT_HOOK = fault_once
+    try:
+        faulted = r.execute(sql).rows
+    finally:
+        mesh_chunk.MESH_FAULT_HOOK = None
+    assert state["fired"], "fault hook never reached its target chunk"
+    info = mesh_chunk.LAST_RUN_INFO
+    events = [
+        f"clean run: chunks={n_chunks} "
+        f"checkpoints_taken={clean_taken}",
+        f"device loss injected at chunk {target}/{n_chunks}",
+        f"resumed_from_chunk={info['resumed_from_chunk']} "
+        f"resumes={info['resumes']} "
+        f"executed_chunk_steps={info['executed_chunk_steps']} "
+        "(completed chunks never re-executed)",
+        f"rows oracle-equal to uninterrupted run: {faulted == clean}",
+    ]
+    out = r.execute("EXPLAIN ANALYZE " + sql).rows[0][0]
+
+    def redact(text):
+        text = re.sub(r"\b(wall|cpu)=\d+(\.\d+)?ms", r"\1=#ms", text)
+        text = re.sub(r"\b(add|get|finish)=\d+(\.\d+)?", r"\1=#", text)
+        text = re.sub(r"\btask q\d+\.", "task q#.", text)
+        text = re.sub(r"resident= .*", "resident= #", text)
+        return text
+
+    emit(
+        "11_recovery_analyze.txt",
+        (f"QUERY\n{sql}", ""),
+        ("checkpointed mesh run under an injected device loss "
+         "(mesh_chunk_rows=1024,\nmesh_checkpoint_interval_chunks=2): "
+         "the run resumes from the last checkpoint\ninstead of chunk 0 "
+         "and stays on the mesh plane", "\n".join(events)),
+        ("EXPLAIN ANALYZE after the faulted run: the trailing "
+         "recovery= line reports\nthe lifetime checkpoint/resume "
+         "counters plus the resume position of the\nmost recent mesh "
+         "run (wall-clock values redacted to `#`)", redact(out)),
+    )
+
+
 def write_all(out_dir=None):
     """Regenerate every corpus file (into `out_dir` when given — used
     by tests/test_explain_corpus.py to diff against committed files)."""
@@ -628,6 +741,7 @@ def write_all(out_dir=None):
         corpus_08_mesh_analyze()
         corpus_09_resident_analyze()
         corpus_10_adaptive_analyze()
+        corpus_11_recovery_analyze()
     finally:
         _OUT_DIR[0] = HERE
 

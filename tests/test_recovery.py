@@ -1,0 +1,331 @@
+"""Recovery-tier tests (PR 14): chunk checkpoints + spooled stage reuse.
+
+The mesh plane checkpoints its chunk loop at
+`mesh_checkpoint_interval_chunks` boundaries (recovery/checkpoint.py),
+so a MeshStuck / MeshDeviceLost mid-run resumes from the last snapshot
+instead of chunk 0; the page plane tees completed fragment outputs into
+the subtree spool (recovery/stage_spool.py), so QUERY retry replays
+settled stages instead of recomputing them. These tests pin:
+
+  - byte-identity of a resumed run against an uninterrupted one, with
+    the fault at chunk 0 (no checkpoint yet -> observable page-plane
+    fallback), mid-run and at the last chunk;
+  - checkpoint invalidation: INSERT / UPDATE on a feed table drops its
+    checkpoints (eager DML path AND the lazy generation guard);
+  - spooled-stage reuse on QUERY retry substitutes completed fragments
+    with ZERO upstream re-execution;
+  - a resumed run mints zero new XLA lowerings (the warm capacity
+    ladder + program-cache records survive the fault);
+  - a deadline kill landing during the resumed stretch keeps the typed
+    [EXCEEDED_TIME_LIMIT] error (resuming never refreshes a budget).
+"""
+
+import time
+
+import pytest
+
+from trino_tpu.connectors.memory import create_memory_connector
+from trino_tpu.connectors.spi import CatalogManager
+from trino_tpu.connectors.tpch import create_tpch_connector
+from trino_tpu.engine import LocalQueryRunner, Session
+from trino_tpu.parallel import mesh_chunk, mesh_plan
+from trino_tpu.recovery import CHECKPOINTS, MeshCheckpoint
+from trino_tpu.resident import GENERATIONS
+from trino_tpu.runtime import DistributedQueryRunner
+from trino_tpu.runtime.failure import FailureInjector
+from trino_tpu.runtime.metrics import METRICS
+from trino_tpu.runtime.query_tracker import (
+    EXCEEDED_TIME_LIMIT,
+    DeadlineLimits,
+    ExceededTimeLimitError,
+    QueryDeadlineError,
+    QueryTracker,
+    preemption_check,
+)
+from trino_tpu.runtime.worker import Worker
+
+# exact-valued aggregates only (int results): chunked accumulation and
+# resume must both be byte-identical to the page plane
+Q_GROUP = (
+    "select l_returnflag, l_linestatus, count(*) c, "
+    "sum(l_quantity) q, min(l_orderkey) mn, max(l_orderkey) mx "
+    "from lineitem group by l_returnflag, l_linestatus "
+    "order by l_returnflag, l_linestatus"
+)
+
+
+def mk_runner(**session_kw):
+    kw = dict(
+        mesh_chunk_rows=512, mesh_checkpoint_interval_chunks=1,
+    )
+    kw.update(session_kw)
+    r = DistributedQueryRunner(
+        Session(catalog="tpch", schema="tiny", **kw),
+        n_workers=2, hash_partitions=2,
+    )
+    r.register_catalog("tpch", create_tpch_connector())
+    return r
+
+
+@pytest.fixture(autouse=True)
+def _clean_recovery_state():
+    """Every test starts with an empty checkpoint store and no fault
+    hook (a leaked one-shot hook would fire in an unrelated test)."""
+    CHECKPOINTS.clear()
+    mesh_chunk.MESH_FAULT_HOOK = None
+    yield
+    CHECKPOINTS.clear()
+    mesh_chunk.MESH_FAULT_HOOK = None
+
+
+@pytest.fixture(scope="module")
+def baseline_rows():
+    r = mk_runner(mesh_execution=False)
+    return r.execute(Q_GROUP).rows
+
+
+class OneShotFault:
+    """MESH_FAULT_HOOK that raises `exc` the first time the chunk loop
+    reaches `target`; subsequent arrivals (the resumed stretch) pass."""
+
+    def __init__(self, target, exc=mesh_chunk.MeshStuck):
+        self.target = target
+        self.exc = exc
+        self.fired = False
+
+    def __call__(self, k, K):
+        if not self.fired and k == self.target:
+            self.fired = True
+            raise self.exc(f"injected mesh fault at chunk {k}/{K}")
+
+
+# -- byte-identity across fault points ---------------------------------
+
+
+@pytest.mark.parametrize("where", ["mid", "last"])
+def test_resume_byte_identical(where, baseline_rows):
+    """A fault mid-run or at the last chunk resumes from the latest
+    checkpoint: identical rows, stays on the mesh, and (interval=1)
+    re-executes ZERO chunks."""
+    r = mk_runner()
+    assert r.execute(Q_GROUP).rows == baseline_rows  # warm, no fault
+    K = mesh_chunk.LAST_RUN_INFO["chunks"]
+    assert K >= 4, f"query too small to chunk ({K})"
+    target = K // 2 if where == "mid" else K - 1
+    fault = OneShotFault(target, mesh_chunk.MeshDeviceLost)
+    mesh_chunk.MESH_FAULT_HOOK = fault
+    before = mesh_plan.MESH_COUNTERS["queries"]
+    assert r.execute(Q_GROUP).rows == baseline_rows
+    assert fault.fired
+    info = mesh_chunk.LAST_RUN_INFO
+    assert mesh_plan.MESH_COUNTERS["queries"] == before + 1, \
+        f"fell back to HTTP: {r.last_mesh_fallback}"
+    assert info["resumes"] == 1
+    assert info["resumed_from_chunk"] == target
+    assert info["executed_chunk_steps"] == K, \
+        "resume re-executed already-completed chunks"
+
+
+def test_fault_at_chunk_zero_falls_back(baseline_rows):
+    """Chunk 0 precedes the first checkpoint, so there is nothing to
+    resume from: the fault keeps its retryable type and the coordinator
+    takes the OBSERVABLE page-plane fallback — correct rows, reason
+    recorded, no resume counted."""
+    r = mk_runner()
+    assert r.execute(Q_GROUP).rows == baseline_rows  # warm
+    resumed0 = CHECKPOINTS.resumed
+    fault = OneShotFault(0, mesh_chunk.MeshStuck)
+    mesh_chunk.MESH_FAULT_HOOK = fault
+    fallbacks = mesh_plan.MESH_COUNTERS["fallbacks"]
+    assert r.execute(Q_GROUP).rows == baseline_rows
+    assert fault.fired
+    assert mesh_plan.MESH_COUNTERS["fallbacks"] == fallbacks + 1
+    assert r.last_mesh_fallback is not None
+    assert CHECKPOINTS.resumed == resumed0
+
+
+# -- checkpoint invalidation on DML ------------------------------------
+
+
+def _fake_ckpt(tables):
+    return MeshCheckpoint(
+        next_chunk=1, n_chunks=4, chunk_cap=512, resolved_caps={},
+        carries_host=(), tables=tables,
+        generations=GENERATIONS.snapshot(tables),
+    )
+
+
+def test_insert_and_update_invalidate_checkpoints():
+    """The engine's DML path drops checkpoints keyed to the written
+    table (eagerly, via invalidate_table) while leaving checkpoints on
+    other tables alone."""
+    r = LocalQueryRunner(Session(catalog="memory", schema="default"))
+    r.register_catalog("memory", create_memory_connector())
+    r.execute("CREATE TABLE ckpt_t (a bigint, b varchar)")
+    r.execute("INSERT INTO ckpt_t VALUES (1, 'x'), (2, 'y')")
+
+    fed = (("memory", "default", "ckpt_t"),)
+    other = (("tpch", "tiny", "lineitem"),)
+    CHECKPOINTS.put(("mesh-ckpt", "fed"), _fake_ckpt(fed))
+    CHECKPOINTS.put(("mesh-ckpt", "other"), _fake_ckpt(other))
+    inv0 = CHECKPOINTS.invalidated
+
+    r.execute("INSERT INTO ckpt_t VALUES (3, 'z')")
+    assert CHECKPOINTS.get(("mesh-ckpt", "fed")) is None, \
+        "INSERT must invalidate checkpoints over the written table"
+    assert CHECKPOINTS.get(("mesh-ckpt", "other")) is not None, \
+        "INSERT must not touch checkpoints over other tables"
+    assert CHECKPOINTS.invalidated > inv0
+
+    CHECKPOINTS.put(("mesh-ckpt", "fed"), _fake_ckpt(fed))
+    r.execute("UPDATE ckpt_t SET b = 'w' WHERE a = 1")
+    assert CHECKPOINTS.get(("mesh-ckpt", "fed")) is None, \
+        "UPDATE must invalidate checkpoints over the written table"
+    r.execute("DROP TABLE ckpt_t")
+
+
+def test_generation_guard_catches_unannounced_write():
+    """Even without the eager DML hook, `get` revalidates the snapshot
+    generation vector: a bumped feed-table generation makes the entry
+    unreachable (counted as an invalidation) instead of serving stale
+    carries."""
+    tables = (("memory", "default", "gen_t"),)
+    CHECKPOINTS.put(("mesh-ckpt", "gen"), _fake_ckpt(tables))
+    assert CHECKPOINTS.get(("mesh-ckpt", "gen")) is not None
+    inv0 = CHECKPOINTS.invalidated
+    GENERATIONS.bump(tables[0])
+    assert CHECKPOINTS.get(("mesh-ckpt", "gen")) is None
+    assert CHECKPOINTS.invalidated == inv0 + 1
+
+
+# -- spooled stage reuse on QUERY retry --------------------------------
+
+
+def test_spooled_stage_reuse_zero_upstream_reexecution():
+    """A QUERY retry substitutes every fully-recorded completed
+    fragment with its spooled output: same rows as a clean run, and the
+    retry attempt never re-schedules the substituted fragment's
+    producers (zero upstream re-execution)."""
+    sql = (
+        "select n_name, count(*) c from supplier, nation "
+        "where s_nationkey = n_nationkey group by n_name order by n_name"
+    )
+    inj = FailureInjector()
+    cats = CatalogManager()
+    workers = [
+        Worker(f"rec-w{i}", cats, failure_injector=inj) for i in range(2)
+    ]
+    runner = DistributedQueryRunner(
+        Session(catalog="tpch", schema="tiny", retry_policy="query",
+                query_retry_count=2, recovery_spool_stages=True),
+        worker_handles=workers, hash_partitions=2,
+    )
+    conn = create_tpch_connector()
+    runner.register_catalog("tpch", conn)
+    cats.register("tpch", conn)
+
+    expected = runner.execute(sql).rows
+    root_id = max(si["fragment_id"] for si in runner._last_stage_infos)
+
+    created = []
+    orig = Worker.create_task
+
+    def spy(self, spec):
+        created.append(str(spec.task_id))
+        return orig(self, spec)
+
+    Worker.create_task = spy
+    hits0 = METRICS.snapshot().get("recovery.spooled_stage_hits", 0.0)
+    inj.inject(where="mid", fragment_id=root_id, attempts=(0,), max_hits=1)
+    try:
+        rows = runner.execute(sql).rows
+    finally:
+        Worker.create_task = orig
+        inj.clear()
+
+    assert rows == expected
+    hits = METRICS.snapshot().get("recovery.spooled_stage_hits", 0.0) - hits0
+    assert hits >= 1, "retry did not substitute any spooled stage"
+    retry_tasks = [t for t in created if "r1." in t]
+    assert retry_tasks, "no retry attempt ran"
+    # the substituted fragment's producers (scan stages, fragment 0)
+    # must not re-run: the deepest fragment id in the retry namespace
+    # is the replay fragment, not a scan
+    retry_fids = {int(t.split(".")[1]) for t in retry_tasks}
+    assert 0 not in retry_fids, \
+        f"retry re-executed upstream scan fragments: {sorted(retry_fids)}"
+    assert root_id in retry_fids
+
+
+# -- warm resume: zero new lowerings -----------------------------------
+
+
+def test_resume_zero_new_lowerings(baseline_rows):
+    """Resuming lands on the SAME program-cache records and ladder
+    rungs as the faulted run: no new XLA programs are lowered."""
+    r = mk_runner()
+    assert r.execute(Q_GROUP).rows == baseline_rows  # warm
+    K = mesh_chunk.LAST_RUN_INFO["chunks"]
+    mesh_chunk.MESH_FAULT_HOOK = OneShotFault(
+        max(K // 2, 1), mesh_chunk.MeshDeviceLost
+    )
+    compiles0 = METRICS.snapshot().get("xla_compiles", 0.0)
+    assert r.execute(Q_GROUP).rows == baseline_rows
+    delta = METRICS.snapshot().get("xla_compiles", 0.0) - compiles0
+    assert delta == 0, f"resume lowered {delta:g} new XLA programs"
+    assert mesh_chunk.LAST_RUN_INFO["resumes"] == 1
+
+
+# -- deadline kills during resume stay typed ---------------------------
+
+
+def test_deadline_message_names_resume_point():
+    """The chunk-boundary wall check embeds the resume origin in its
+    kill message while keeping the typed [EXCEEDED_TIME_LIMIT] code —
+    resuming does not refresh a spent budget."""
+    tracker = QueryTracker()
+    tracker.register("qx", DeadlineLimits())
+    check = preemption_check(
+        tracker, "qx", deadline_epoch_s=time.time() - 1.0
+    )
+    check.resumed_from = 7
+    with pytest.raises(ExceededTimeLimitError) as ei:
+        check(9, 16)
+    msg = str(ei.value)
+    assert EXCEEDED_TIME_LIMIT in msg
+    assert "(resumed from chunk 7)" in msg
+    assert "9/16" in msg
+
+
+def test_deadline_kill_during_resume_stays_typed(baseline_rows):
+    """A tracker kill latched while the resumed stretch is executing
+    surfaces as the typed, non-retryable deadline error — no page-plane
+    fallback, no silent retry."""
+    r = mk_runner()
+    assert r.execute(Q_GROUP).rows == baseline_rows  # warm
+    K = mesh_chunk.LAST_RUN_INFO["chunks"]
+    target = K // 2
+    state = {"faulted": False}
+
+    def hook(k, K_):
+        if not state["faulted"] and k == target:
+            state["faulted"] = True
+            raise mesh_chunk.MeshDeviceLost("injected fault")
+        if state["faulted"]:
+            # the resumed stretch: latch a deadline kill exactly as the
+            # enforcement tick would
+            for tq in list(r.query_tracker._queries.values()):
+                if tq.error is None:
+                    tq.error = ExceededTimeLimitError(
+                        f"Query {tq.query_id} exceeded the execution "
+                        f"time limit [{EXCEEDED_TIME_LIMIT}]"
+                    )
+
+    mesh_chunk.MESH_FAULT_HOOK = hook
+    resumed0 = CHECKPOINTS.resumed
+    with pytest.raises(QueryDeadlineError) as ei:
+        r.execute(Q_GROUP)
+    assert EXCEEDED_TIME_LIMIT in str(ei.value)
+    assert CHECKPOINTS.resumed == resumed0 + 1, "fault did not resume"
+    assert r.last_mesh_fallback is None, \
+        "typed deadline error must not trigger a page-plane fallback"

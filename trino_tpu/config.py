@@ -221,6 +221,20 @@ for _name, _type, _default, _desc, _allowed in [
      "subquery twice; CTEs referenced twice) once into the "
      "generation-guarded spool and feed every consumer — and the "
      "re-planner — from the same rows", None),
+    # -- recovery tier (trino_tpu/recovery/) --
+    ("mesh_checkpoint_interval_chunks", int, 0,
+     "snapshot the mesh step loop's device carries to the host-side "
+     "generation-guarded checkpoint store every N chunk boundaries so "
+     "MeshStuck/device-loss faults resume from the last checkpoint "
+     "instead of chunk 0; 0 disables checkpointing", None),
+    ("mesh_resume_attempts", int, 2,
+     "max in-run resume attempts from a mesh checkpoint before the "
+     "fault escalates to the page-plane fallback / QUERY retry", None),
+    ("recovery_spool_stages", bool, False,
+     "tee completed non-root fragment outputs into the subtree spool "
+     "so QUERY-level retry substitutes finished stages as literal "
+     "sources instead of recomputing them (FTE settles lift committed "
+     "stage spool files into the same store)", None),
     # -- observability (runtime/tracing.py) --
     ("query_trace", str, "off",
      "record a full span tree per query (phases, stages, task attempts, "

@@ -152,6 +152,14 @@ class Session:
     adaptive_execution: bool = False
     adaptive_replan_threshold: float = 4.0
     shared_subtree_materialization: bool = False
+    # recovery tier (trino_tpu/recovery/): checkpoint the mesh step
+    # loop's carries every N chunk boundaries (0 = off) so mesh faults
+    # resume from the last checkpoint; bound in-run resume attempts;
+    # tee completed fragment outputs into the subtree spool so QUERY
+    # retry substitutes finished stages instead of recomputing them
+    mesh_checkpoint_interval_chunks: int = 0
+    mesh_resume_attempts: int = 2
+    recovery_spool_stages: bool = False
 
     def set_property(self, name: str, value) -> None:
         """SET SESSION entry point — validated through the typed
@@ -614,15 +622,22 @@ class LocalQueryRunner:
         from trino_tpu.resident import fastlane as _fastlane
         from trino_tpu.resident.manager import table_key
 
+        from trino_tpu.recovery import CHECKPOINTS
+
         if table is None:
             self._plan_cache.invalidate()
             GENERATIONS.bump_all()
             RESIDENT.evict_all()
+            CHECKPOINTS.clear()
             return
         tkey = table_key(*table)
         self._plan_cache.invalidate_tables([tkey])
         GENERATIONS.bump(tkey)
         _fastlane.table_written(*tkey, appended=appended, tap=tap)
+        # mesh checkpoints over the written table are stale by
+        # construction: the generation guard already makes them
+        # unreachable — reclaim their host memory eagerly
+        CHECKPOINTS.invalidate_table(*tkey)
 
     # -- DML (BeginTableWrite/TableWriter/TableFinish path) --
     def _resolve_target(self, parts):

@@ -1423,6 +1423,12 @@ class HashAggregationOperator(Operator):
         # device program at the next materialization point
         self._acc = None
         self._pending: List[tuple] = []
+        # a state ingested off the wire (_add_state_input) may carry
+        # DUPLICATE group keys within one batch (a spooled-stage replay
+        # concatenates several producer pages into one values batch), so
+        # it must pass through a group-reduce even when it is the only
+        # pending state
+        self._unreduced_state = False
         # deferred per-batch overflow records: (pending index, device
         # ovf flag, device ngroups, retained input batch, capacity)
         self._pending_meta: List[tuple] = []
@@ -1668,7 +1674,7 @@ class HashAggregationOperator(Operator):
         self._pending = []
         if not states:
             return
-        if len(states) == 1:
+        if len(states) == 1 and not self._unreduced_state:
             self._acc = states[0]
             return
         reducers = []
@@ -1693,6 +1699,7 @@ class HashAggregationOperator(Operator):
                 break
             self._cap = max(self._cap * 2, bucket_capacity(int(ngroups)))
         self._acc = merged
+        self._unreduced_state = False
 
     # -- final step: consume serialized accumulator state --
     def _add_state_input(self, batch: RelBatch) -> None:
@@ -1736,6 +1743,7 @@ class HashAggregationOperator(Operator):
         new = (tuple(keys), tuple(valids), live, tuple(vals), tuple(cnts))
         with self._state_lock:
             self._pending.append(new)
+            self._unreduced_state = True
         self._track_memory()
 
     def _merge_global_state(self, batch: RelBatch, live) -> None:

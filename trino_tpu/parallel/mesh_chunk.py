@@ -95,6 +95,20 @@ class MeshStuck(RuntimeError):
     query."""
 
 
+class MeshDeviceLost(RuntimeError):
+    """A device backing the mesh failed mid-run (or a chaos fault
+    simulated one). Retryable like MeshStuck: the checkpointed
+    remainder replays on the restored mesh, or the whole query falls
+    back to the page plane."""
+
+
+# Chaos seam: when set, called as hook(chunk_index, n_chunks) at every
+# chunk boundary BEFORE the step dispatch. The chaos harness raises
+# MeshStuck / MeshDeviceLost from here to inject deterministic
+# mid-chunk faults (runtime/chaos.py).
+MESH_FAULT_HOOK: Optional[Callable[[int, int], None]] = None
+
+
 class _Overflow(Exception):
     """Device overflow flags fired; restart the run with bumped caps."""
 
@@ -801,6 +815,14 @@ class ChunkedMeshRunner:
         )
         self.info: Dict[str, object] = {}
         self._last_record_key = None
+        # recovery bookkeeping for the current run (chaos harness and
+        # EXPLAIN ANALYZE read these back through self.info)
+        self._run_stats: Dict[str, object] = {
+            "executed_chunk_steps": 0,
+            "checkpoints": 0,
+            "resumes": 0,
+            "resumed_from_chunk": None,
+        }
 
     # -- program record ----------------------------------------------
     def _record(self, caps) -> MeshProgramRecord:
@@ -822,6 +844,16 @@ class ChunkedMeshRunner:
             return build()  # foreign entry under a colliding key
         return record
 
+    def _ckpt_key(self) -> Optional[tuple]:
+        """Checkpoint-store key: the program identity minus the caps
+        element (the record key's last component), so a resume after an
+        overflow cap bump still finds its checkpoint. None when the
+        program itself is uncacheable (repr identity leak) — such plans
+        never checkpoint."""
+        if self._last_record_key is None:
+            return None
+        return ("mesh-ckpt",) + tuple(self._last_record_key[:-1])
+
     # -- execution ---------------------------------------------------
     def run(self, preempt=None, query_span=None) -> Dict[int, list]:
         from trino_tpu.runtime.tracing import KIND_STAGE, KIND_TASK
@@ -838,35 +870,35 @@ class ChunkedMeshRunner:
             )
         try:
             caps: Dict[str, int] = {}
-            for attempt in range(12):
+            self._run_stats = {
+                "executed_chunk_steps": 0,
+                "checkpoints": 0,
+                "resumes": 0,
+                "resumed_from_chunk": None,
+            }
+            resume_budget = int(
+                getattr(self.session, "mesh_resume_attempts", 2) or 0
+            )
+            overflows = 0
+            attempt = 0
+            while True:
                 record = self._record(caps)
                 try:
                     sources = self._execute(
                         record, preempt, task_span, attempt
                     )
-                    if record.warmup_entries:
-                        register_mesh_warmup(record.warmup_entries)
-                        note_classes_warm(record.class_keys)
-                    self.info = {
-                        "chunked": self.cplan.chunked,
-                        "chunks": record.n_chunks,
-                        "chunk_cap": record.chunk_cap,
-                        "driver_pos": self.cplan.driver_pos,
-                        "prelude_fragments": sorted(self.cplan.prelude_fids),
-                        "stream_fragments": sorted(self.cplan.stream_fids),
-                        "flush_fragments": sorted(self.cplan.flush_fids),
-                        "attempts": attempt + 1,
-                    }
-                    LAST_RUN_INFO.clear()
-                    LAST_RUN_INFO.update(self.info)
-                    self._record_divergences(sources, query_span)
-                    return sources
+                    break
                 except _Overflow as ov:
                     for site, _needed in ov.sites:
                         if site.startswith("err:single_row"):
                             raise RuntimeError(
                                 "Scalar sub-query has returned multiple rows"
                             ) from None
+                    overflows += 1
+                    if overflows >= 12:
+                        raise RuntimeError(
+                            "mesh capacity retry limit exceeded"
+                        )
                     # restart from the record's fully resolved caps so
                     # the ladder is deterministic across executions
                     caps = dict(record.resolved_caps)
@@ -875,7 +907,61 @@ class ChunkedMeshRunner:
                             caps.get(site, 16) * 2,
                             bucket_capacity(max(needed, 16)),
                         )
-            raise RuntimeError("mesh capacity retry limit exceeded")
+                    attempt += 1
+                except (MeshStuck, MeshDeviceLost) as e:
+                    # in-run resume: only when a live checkpoint exists
+                    # and budget remains; otherwise the fault keeps its
+                    # type and the coordinator's fallback dispatch (page
+                    # plane / QUERY retry) takes over. Typed deadline /
+                    # abandonment errors never land here — they
+                    # propagate from preempt() uncaught.
+                    key = self._ckpt_key()
+                    ckpt = None
+                    if key is not None and resume_budget > 0:
+                        from trino_tpu.recovery.checkpoint import (
+                            CHECKPOINTS,
+                        )
+
+                        ckpt = CHECKPOINTS.get(key)
+                    if ckpt is None:
+                        raise
+                    resume_budget -= 1
+                    if task_span is not None:
+                        task_span.event(
+                            "mesh_fault",
+                            error=type(e).__name__,
+                            resume_from=ckpt.next_chunk,
+                        )
+                    attempt += 1
+            if record.warmup_entries:
+                register_mesh_warmup(record.warmup_entries)
+                note_classes_warm(record.class_keys)
+            stats = self._run_stats
+            self.info = {
+                "chunked": self.cplan.chunked,
+                "chunks": record.n_chunks,
+                "chunk_cap": record.chunk_cap,
+                "driver_pos": self.cplan.driver_pos,
+                "prelude_fragments": sorted(self.cplan.prelude_fids),
+                "stream_fragments": sorted(self.cplan.stream_fids),
+                "flush_fragments": sorted(self.cplan.flush_fids),
+                "attempts": attempt + 1,
+                "executed_chunk_steps": stats["executed_chunk_steps"],
+                "checkpoints": stats["checkpoints"],
+                "resumes": stats["resumes"],
+                "resumed_from_chunk": stats["resumed_from_chunk"],
+            }
+            key = self._ckpt_key()
+            if key is not None:
+                # a completed run's checkpoint is spent — a later
+                # identical query must start fresh, not resume
+                from trino_tpu.recovery.checkpoint import CHECKPOINTS
+
+                CHECKPOINTS.discard(key)
+            LAST_RUN_INFO.clear()
+            LAST_RUN_INFO.update(self.info)
+            self._record_divergences(sources, query_span)
+            return sources
         finally:
             if task_span is not None:
                 task_span.end()
@@ -907,21 +993,58 @@ class ChunkedMeshRunner:
             for (fid, rep), b in zip(record.prelude_out_meta, p_outs):
                 outs[fid] = (b, rep)
 
+        interval = int(
+            getattr(self.session, "mesh_checkpoint_interval_chunks", 0)
+            or 0
+        )
+        ckpt_key = (
+            self._ckpt_key()
+            if interval > 0 and self.cplan.chunked
+            else None
+        )
+
         carries: tuple = ()
         if record.step_fn is not None:
-            carries = tuple(
-                jax.tree_util.tree_map(
-                    lambda s: jax.device_put(
-                        jnp.zeros(s.shape, s.dtype), self.sharding
-                    ),
-                    t,
+            k0 = 0
+            carries = None
+            if ckpt_key is not None:
+                from trino_tpu.recovery.checkpoint import CHECKPOINTS
+
+                ck = CHECKPOINTS.get(ckpt_key)
+                if ck is not None and ck.n_chunks == K and 0 < ck.next_chunk <= K:
+                    carries = self._restore_carries(ck, record)
+                    if carries is not None:
+                        k0 = ck.next_chunk
+                        CHECKPOINTS.note_resume()
+                        self._run_stats["resumes"] = (
+                            int(self._run_stats["resumes"]) + 1
+                        )
+                        self._run_stats["resumed_from_chunk"] = k0
+                        # deadline kills during the resumed stretch name
+                        # the resume point (query_tracker embeds it in
+                        # the typed [EXCEEDED_TIME_LIMIT] message)
+                        try:
+                            preempt.resumed_from = k0
+                        except AttributeError:
+                            pass  # bare-callable hooks (tests) are fine
+                        if task_span is not None:
+                            task_span.event("resume", from_chunk=k0, of=K)
+            if carries is None:
+                carries = tuple(
+                    jax.tree_util.tree_map(
+                        lambda s: jax.device_put(
+                            jnp.zeros(s.shape, s.dtype), self.sharding
+                        ),
+                        t,
+                    )
+                    for t in record.carry_sds
                 )
-                for t in record.carry_sds
-            )
             with op_span("MeshChunkStep", attempt=attempt, chunks=K):
-                for k in range(K):
+                for k in range(k0, K):
                     if preempt is not None:
                         preempt(k, K)
+                    if MESH_FAULT_HOOK is not None:
+                        MESH_FAULT_HOOK(k, K)
                     t0 = time.monotonic()
                     carries, flags = record.step_fn(
                         jnp.asarray(k, dtype=jnp.int32),
@@ -930,6 +1053,22 @@ class ChunkedMeshRunner:
                     # flag readback is the natural device sync point
                     self._check_flags(record.step_sites, flags, n)
                     dt = time.monotonic() - t0
+                    self._run_stats["executed_chunk_steps"] = (
+                        int(self._run_stats["executed_chunk_steps"]) + 1
+                    )
+                    # a completed boundary is a safe snapshot point:
+                    # the flag readback synced the device, and the
+                    # carries are only donated when passed into the
+                    # NEXT step dispatch
+                    if (
+                        ckpt_key is not None
+                        and (k + 1) % interval == 0
+                        and (k + 1) < K
+                    ):
+                        self._checkpoint(
+                            ckpt_key, record, carries, k + 1, K,
+                            task_span,
+                        )
                     if task_span is not None:
                         task_span.event(
                             "chunk", index=k, of=K, wall_s=round(dt, 6)
@@ -1074,6 +1213,84 @@ class ChunkedMeshRunner:
                 set(self.feed_tables),
             )
         return tuple(p_outs), pctx
+
+    def _checkpoint(self, key, record, carries, next_chunk, K,
+                    task_span) -> None:
+        """Snapshot the device carries to the host checkpoint store as
+        of having completed chunks [0, next_chunk). Best-effort: a
+        snapshot failure must never fail the run it exists to protect."""
+        try:
+            from trino_tpu.recovery.checkpoint import (
+                CHECKPOINTS,
+                MeshCheckpoint,
+            )
+            from trino_tpu.resident import GENERATIONS
+
+            host = tuple(
+                jax.tree_util.tree_map(
+                    lambda x: np.asarray(jax.device_get(x)), c
+                )
+                for c in carries
+            )
+            CHECKPOINTS.put(key, MeshCheckpoint(
+                next_chunk=next_chunk,
+                n_chunks=K,
+                chunk_cap=record.chunk_cap,
+                resolved_caps=dict(record.resolved_caps),
+                carries_host=host,
+                tables=self.feed_tables,
+                generations=GENERATIONS.snapshot(self.feed_tables),
+            ))
+            self._run_stats["checkpoints"] = (
+                int(self._run_stats["checkpoints"]) + 1
+            )
+            if task_span is not None:
+                task_span.event("checkpoint", chunk=next_chunk, of=K)
+        except Exception:
+            pass
+
+    def _restore_carries(self, ck, record) -> Optional[tuple]:
+        """Re-place a checkpoint's host carries onto the mesh, re-padding
+        each accumulator whose capacity rung grew since the snapshot
+        (overflow restarts bump caps; live rows stay densely packed at
+        the front, so tail padding with dead rows is exact). Returns
+        None — start fresh — on any shape disagreement."""
+        n = self.ex.n
+        try:
+            if len(ck.carries_host) != len(record.carry_sds):
+                return None
+            host = []
+            for (_kind, fid), batch in zip(
+                record.carry_meta, ck.carries_host
+            ):
+                site = f"carry:f{fid}"
+                old_cap = int(ck.resolved_caps.get(site, 0))
+                new_cap = int(record.resolved_caps.get(site, old_cap))
+                if old_cap and new_cap != old_cap:
+                    if new_cap < old_cap:
+                        return None  # shrunk rung: rows may not fit
+                    batch = _pad_shards(batch, n, old_cap, new_cap)
+                host.append(batch)
+            for b, t in zip(host, record.carry_sds):
+                bl = jax.tree_util.tree_leaves(b)
+                tl = jax.tree_util.tree_leaves(t)
+                if len(bl) != len(tl) or any(
+                    np.shape(x) != s.shape
+                    or np.asarray(x).dtype != s.dtype
+                    for x, s in zip(bl, tl)
+                ):
+                    return None
+            return tuple(
+                jax.tree_util.tree_map(
+                    lambda x: jax.device_put(
+                        np.asarray(x), self.sharding
+                    ),
+                    b,
+                )
+                for b in host
+            )
+        except Exception:
+            return None
 
     def _check_flags(self, sites, flag_arr, n):
         vals = np.asarray(jax.device_get(flag_arr))

@@ -1,0 +1,176 @@
+"""Chunk-granular mesh checkpoints: host-side snapshots of the step loop.
+
+The chunked mesh plane (parallel/mesh_chunk.py) already owns a natural
+recovery boundary — the host regains control between chunk steps, and
+carry shapes are ladder-stable across the whole run — so a checkpoint
+is cheap and exact: `jax.device_get` the carries right after a step
+returns (the flag readback has already synced the device, and donation
+only claims an array when it is passed into the NEXT step call), plus
+the chunk index. Feed offsets are implied: chunk k reads the device
+slice [k*chunk_cap, (k+1)*chunk_cap) of the immutable padded feeds, so
+resuming at `next_chunk` replays exactly the unexecuted slices.
+
+Entries are generation-guarded exactly like the subtree spool
+(adaptive/spool.py) and the resident pins: the key carries the feed
+tables' write-generation vector at snapshot time, and `get` revalidates
+it — DML on any table the run read makes the checkpoint unreachable
+(counted as recovery.invalidations) instead of serving stale carries.
+
+The store is host-memory LRU, process-wide, and deliberately small:
+checkpoints exist to survive a fault *within or immediately after* a
+run, not to archive history. A successful run discards its own entry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from collections import OrderedDict
+from typing import Dict, Optional, Tuple
+
+# counter names exported through /v1/metrics (registered as zero-valued
+# keys by register_recovery_metrics so the surface is visible before the
+# first fault)
+CHECKPOINTS_TAKEN = "recovery.checkpoints"
+RESUMES = "recovery.resumes"
+INVALIDATIONS = "recovery.invalidations"
+SPOOLED_STAGE_HITS = "recovery.spooled_stage_hits"
+
+_COUNTERS = (CHECKPOINTS_TAKEN, RESUMES, INVALIDATIONS, SPOOLED_STAGE_HITS)
+
+
+def register_recovery_metrics() -> None:
+    """Make the recovery counters appear in /v1/metrics snapshots at
+    zero (a counter otherwise only materializes on first bump, hiding
+    the surface from dashboards until something fails)."""
+    from trino_tpu.runtime.metrics import METRICS
+
+    for name in _COUNTERS:
+        METRICS.increment(name, 0.0)
+
+
+@dataclasses.dataclass
+class MeshCheckpoint:
+    """One resumable position in a chunk-step loop.
+
+    `carries_host` is the host (numpy-leaf) pytree of device carries as
+    of having completed chunks [0, next_chunk); `resolved_caps` is the
+    capacity dict the carries were shaped under, so a resume that lands
+    after an overflow cap-bump can re-pad them onto the new rungs.
+    """
+
+    next_chunk: int  # first chunk NOT yet executed
+    n_chunks: int
+    chunk_cap: int
+    resolved_caps: Dict[str, int]
+    carries_host: tuple
+    tables: Tuple[Tuple[str, str, str], ...]
+    generations: Tuple[int, ...]
+    query_id: str = ""
+
+
+class MeshCheckpointStore:
+    """Generation-guarded LRU of mesh checkpoints, keyed by the program
+    identity (the mesh record key minus capacities, so a resume across
+    overflow cap bumps still finds its checkpoint)."""
+
+    def __init__(self, max_entries: int = 16):
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, MeshCheckpoint]" = OrderedDict()
+        self._max = max_entries
+        self.taken = 0
+        self.resumed = 0
+        self.invalidated = 0
+
+    def _generations(self, tables) -> Tuple[int, ...]:
+        from trino_tpu.resident import GENERATIONS
+
+        return GENERATIONS.snapshot(tables)
+
+    def put(self, key: tuple, ckpt: MeshCheckpoint) -> None:
+        from trino_tpu.runtime.metrics import METRICS
+
+        with self._lock:
+            self._entries[key] = ckpt
+            self._entries.move_to_end(key)
+            self.taken += 1
+            while len(self._entries) > self._max:
+                self._entries.popitem(last=False)
+        METRICS.increment(CHECKPOINTS_TAKEN)
+
+    def get(self, key: tuple) -> Optional[MeshCheckpoint]:
+        """Return a live checkpoint, or None. A stale generation vector
+        (DML landed on a feed table since the snapshot) drops the entry:
+        its carries aggregate rows the tables no longer hold."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                return None
+            if self._generations(e.tables) != e.generations:
+                del self._entries[key]
+                self.invalidated += 1
+                from trino_tpu.runtime.metrics import METRICS
+
+                METRICS.increment(INVALIDATIONS)
+                return None
+            self._entries.move_to_end(key)
+            return e
+
+    def note_resume(self) -> None:
+        from trino_tpu.runtime.metrics import METRICS
+
+        with self._lock:
+            self.resumed += 1
+        METRICS.increment(RESUMES)
+
+    def discard(self, key: tuple) -> None:
+        with self._lock:
+            self._entries.pop(key, None)
+
+    def invalidate_table(self, catalog: str, schema: str, table: str) -> int:
+        """Proactive drop for the DML path (engine.py): generation
+        guarding already makes stale entries unreachable lazily; this
+        reclaims their host memory eagerly and makes the invalidation
+        visible in metrics at write time."""
+        triple = (catalog.lower(), schema.lower(), table.lower())
+        with self._lock:
+            stale = [
+                k for k, e in self._entries.items() if triple in e.tables
+            ]
+            for k in stale:
+                del self._entries[k]
+            self.invalidated += len(stale)
+        if stale:
+            from trino_tpu.runtime.metrics import METRICS
+
+            METRICS.increment(INVALIDATIONS, float(len(stale)))
+        return len(stale)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+    def reset_stats(self) -> None:
+        """Zero the lifetime counters (corpus generation and tests pin
+        exact counts; mirrors RESIDENT.reset_stats)."""
+        with self._lock:
+            self.taken = 0
+            self.resumed = 0
+            self.invalidated = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats_line(self) -> str:
+        with self._lock:
+            return (
+                f"checkpoints: entries={len(self._entries)} "
+                f"taken={self.taken} resumed={self.resumed} "
+                f"invalidated={self.invalidated}"
+            )
+
+
+# the process singleton (one coordinator process, one store — mirrors
+# adaptive.spool.SPOOL and resident.RESIDENT)
+CHECKPOINTS = MeshCheckpointStore()

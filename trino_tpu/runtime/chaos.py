@@ -85,6 +85,19 @@ ADAPTIVE_CLASSES = (
     "adaptive_loaded_drain",
 )
 
+# recovery scenarios (PR 14): chunk-granular checkpoint/resume for the
+# mesh plane (trino_tpu/recovery/). The injector schedules above land
+# on the page/FTE planes; these land INSIDE the mesh chunk loop via
+# parallel.mesh_chunk.MESH_FAULT_HOOK — a seeded chunk boundary raises
+# MeshStuck (the watchdog classification) or MeshDeviceLost (device
+# loss), and the run must RESUME from its last checkpoint: oracle-equal
+# rows AND strictly fewer re-executed chunks than restarting from chunk
+# 0. Run via run_mesh_recovery_case.
+RECOVERY_CLASSES = (
+    "mesh_fault_mid_chunk",
+    "device_lost_resume",
+)
+
 
 def generate_schedule(
     seed: int,
@@ -136,6 +149,73 @@ def schedule_max_failures(rules: List[dict]) -> int:
     """Upper bound on injected failures a schedule can cause — the
     bounded-attempt assertion compares observed retries against this."""
     return sum(r.get("max_hits", 0) for r in rules if r.get("stall_s", 0) == 0)
+
+
+def run_mesh_recovery_case(
+    sql: str, fault_class: str, seed: int,
+    checkpoint_interval: int = 1, mesh_chunk_rows: int = 256,
+) -> Tuple[List[list], dict]:
+    """One seeded mesh fault mid-chunk against an in-process (mesh-
+    colocated) runner with chunk checkpointing on. The fault chunk is
+    drawn deterministically from the seed once the chunk count is known
+    (same seed -> same boundary), fires exactly once, and the run must
+    resume from its last checkpoint rather than restart: the report's
+    executed_chunk_steps counts every chunk step across attempts, so
+    `executed_chunk_steps - chunks` is the number of RE-executed chunks
+    (a restart-from-zero re-executes all `fault_chunk` completed ones)."""
+    from trino_tpu.connectors.tpch import create_tpch_connector
+    from trino_tpu.engine import Session
+    from trino_tpu.parallel import mesh_chunk
+    from trino_tpu.runtime.coordinator import DistributedQueryRunner
+
+    if fault_class not in RECOVERY_CLASSES:
+        raise ValueError(f"unknown recovery fault class: {fault_class}")
+    exc = (
+        mesh_chunk.MeshStuck
+        if fault_class == "mesh_fault_mid_chunk"
+        else mesh_chunk.MeshDeviceLost
+    )
+    runner = DistributedQueryRunner(
+        Session(
+            catalog="tpch", schema="tiny",
+            mesh_chunk_rows=mesh_chunk_rows,
+            mesh_checkpoint_interval_chunks=checkpoint_interval,
+        ),
+        n_workers=2, hash_partitions=2,
+    )
+    runner.register_catalog("tpch", create_tpch_connector())
+    expected = runner.execute(sql).rows  # warm run doubles as oracle
+    mesh_clean = runner._last_data_plane == "mesh"
+    rng = random.Random(seed)
+    state = {"target": None, "fired": 0}
+
+    def hook(k: int, K: int) -> None:
+        if state["target"] is None:
+            # any boundary but 0: chunk 0 never has a checkpoint below
+            # it (tests/test_recovery.py covers the k=0 degenerate)
+            state["target"] = 1 + rng.randrange(max(K - 1, 1))
+        if k == state["target"] and not state["fired"]:
+            state["fired"] = 1
+            raise exc(f"chaos[{fault_class}]: injected at chunk {k}/{K}")
+
+    mesh_chunk.MESH_FAULT_HOOK = hook
+    try:
+        rows = runner.execute(sql).rows
+    finally:
+        mesh_chunk.MESH_FAULT_HOOK = None
+    info = dict(mesh_chunk.LAST_RUN_INFO)
+    report = {
+        "mesh_clean_plane": mesh_clean,
+        "mesh_fault_plane": runner._last_data_plane,
+        "fault_chunk": state["target"],
+        "fired": state["fired"],
+        "chunks": info.get("chunks"),
+        "executed_chunk_steps": info.get("executed_chunk_steps"),
+        "resumes": info.get("resumes"),
+        "resumed_from_chunk": info.get("resumed_from_chunk"),
+        "expected": expected,
+    }
+    return rows, report
 
 
 class DownableWorker:
@@ -259,6 +339,7 @@ class ChaosHarness:
         memory_pool_bytes: Optional[int] = None,
         stuck_task_interrupt_s: Optional[float] = None,
         stuck_task_interrupt_warm_s: Optional[float] = None,
+        in_process: bool = False,
     ):
         from trino_tpu.engine import Session
         from trino_tpu.runtime.coordinator import DistributedQueryRunner
@@ -272,6 +353,23 @@ class ChaosHarness:
         from trino_tpu.connectors.spi import CatalogManager
 
         self._catalogs = CatalogManager()
+        self.in_process = in_process
+        if in_process:
+            # the mesh plane only engages on COLOCATED (engine-owned)
+            # workers, so the recovery drain case builds the runner on
+            # the n_workers path and exposes its Workers for the drain
+            # bookkeeping. Injector schedules do not land here — mesh
+            # faults arrive through MESH_FAULT_HOOK instead.
+            self.stuck_task_interrupt_s = stuck_task_interrupt_s
+            self.runner = DistributedQueryRunner(
+                self.session,
+                n_workers=n_workers,
+                hash_partitions=hash_partitions,
+            )
+            self.workers = list(self.runner.workers)
+            for name, conn in (catalogs or {}).items():
+                self.register_catalog(name, conn)
+            return
         # every worker sits behind a DownableWorker proxy so lifecycle
         # cases can count ACCEPTED launches (drain assertions) and take
         # nodes dark (graylist assertions) without touching the engine
@@ -703,6 +801,54 @@ class ChaosHarness:
             )
         return None, report
 
+    def run_recovery_drain_case(
+        self, queries: Dict[str, str], seed: int = 0,
+        n_faults: int = 3, **kw,
+    ) -> Tuple[None, dict]:
+        """PR 8 carry-forward, re-aimed (PR 14): the drain_mid_query /
+        drain_all_but_one maneuvers now land on the loaded_cluster
+        POPULATION instead of one isolated query — construct the
+        harness with in_process=True and mesh checkpointing on, and
+        mesh faults raise mid-chunk (MESH_FAULT_HOOK at the middle
+        boundary, first n_faults hits) while run_loaded_cluster_case
+        drains a worker out from under the live traffic. Faulted
+        queries must RESUME from checkpoint on the surviving capacity
+        (report carries checkpoint_resumes from the store's counters),
+        and every completion still checks against the clean oracle."""
+        from trino_tpu.parallel import mesh_chunk
+        from trino_tpu.recovery import CHECKPOINTS
+
+        if not self.in_process:
+            raise ValueError(
+                "run_recovery_drain_case needs in_process=True (the "
+                "mesh plane only engages on colocated workers)"
+            )
+        lock = threading.Lock()
+        state = {"fired": 0}
+
+        def hook(k: int, K: int) -> None:
+            # deterministic allowance, not a coin flip: the first
+            # n_faults arrivals at a mid-run boundary fault; everything
+            # after runs clean so the tail proves the cluster recovered
+            with lock:
+                if K >= 2 and k == max(1, K // 2) \
+                        and state["fired"] < n_faults:
+                    state["fired"] += 1
+                    raise mesh_chunk.MeshDeviceLost(
+                        f"chaos[recovery_drain]: injected device loss "
+                        f"at chunk {k}/{K}"
+                    )
+
+        resumed0 = CHECKPOINTS.resumed
+        mesh_chunk.MESH_FAULT_HOOK = hook
+        try:
+            _, report = self.run_loaded_cluster_case(queries, seed, **kw)
+        finally:
+            mesh_chunk.MESH_FAULT_HOOK = None
+        report["mesh_faults_fired"] = state["fired"]
+        report["checkpoint_resumes"] = CHECKPOINTS.resumed - resumed0
+        return None, report
+
 
 def chaos_smoke(
     seed: int,
@@ -1009,6 +1155,125 @@ def chaos_smoke(
                 f"completed={report['completed']} ok={report['ok']} "
                 f"replans={report['adaptive.replans']} "
                 f"spool_hits={report['adaptive.spool_hits']} "
+                f"drained={report['drained']} hung=0"
+            )
+    # recovery scenarios (PR 14): seeded faults INSIDE the mesh chunk
+    # loop must resume from the last checkpoint — oracle-equal rows and
+    # strictly fewer re-executed chunks than restarting from chunk 0
+    recovery_sql = (
+        "select o_orderpriority, count(*) c from orders join customer "
+        "on o_custkey = c_custkey group by o_orderpriority "
+        "order by o_orderpriority"
+    )
+    for fc in RECOVERY_CLASSES:
+        try:
+            rows, rep = run_mesh_recovery_case(recovery_sql, fc, seed)
+        except Exception as e:
+            failures.append(
+                f"recovery/{fc}: raised {type(e).__name__}: {e}"
+            )
+            continue
+        if not rep["mesh_clean_plane"]:
+            failures.append(
+                f"recovery/{fc}: clean run did not take the mesh plane"
+            )
+            continue
+        K = rep["chunks"] or 0
+        steps = rep["executed_chunk_steps"] or 0
+        fault_k = rep["fault_chunk"] or 0
+        re_executed = steps - K
+        if not rows_equal(rows, rep["expected"], ordered=True):
+            failures.append(
+                f"recovery/{fc}: rows diverged from clean run "
+                f"({len(rows)} vs {len(rep['expected'])})"
+            )
+        if not rep["fired"]:
+            failures.append(f"recovery/{fc}: fault never fired ({rep})")
+        elif rep["mesh_fault_plane"] != "mesh":
+            failures.append(
+                f"recovery/{fc}: faulted run left the mesh plane "
+                f"({rep['mesh_fault_plane']})"
+            )
+        elif not rep["resumes"]:
+            failures.append(
+                f"recovery/{fc}: no checkpoint resume recorded ({rep})"
+            )
+        elif re_executed >= max(fault_k, 1) or re_executed >= K:
+            failures.append(
+                f"recovery/{fc}: re-executed {re_executed} of {K} "
+                f"chunks — a restart-from-zero re-executes {fault_k}; "
+                f"the checkpoint saved nothing"
+            )
+        if verbose:
+            print(
+                f"  chaos recovery/{fc}: ok rows={len(rows)} "
+                f"fault_chunk={fault_k}/{K} "
+                f"resumed_from={rep['resumed_from_chunk']} "
+                f"re_executed={re_executed}"
+            )
+    # carry-forward (PR 8 -> PR 14): the drain maneuvers aimed at the
+    # loaded_cluster population, with mesh checkpointing on — device
+    # losses land mid-chunk while a worker drains out from under the
+    # live traffic, and the faulted queries must resume from checkpoint
+    # on what survives
+    h = ChaosHarness(
+        n_workers=3, in_process=True,
+        session=Session(
+            catalog="tpch", schema="tiny",
+            mesh_chunk_rows=256,
+            mesh_checkpoint_interval_chunks=1,
+        ),
+    )
+    h.register_catalog("tpch", create_tpch_connector())
+    scenario = "recovery_loaded_drain"
+    try:
+        _, report = h.run_recovery_drain_case(queries, seed)
+    except Exception as e:
+        failures.append(
+            f"recovery/{scenario}: raised {type(e).__name__}: {e}"
+        )
+        report = None
+    if report is not None:
+        if report["ok"] == 0:
+            failures.append(
+                f"recovery/{scenario}: zero oracle-equal results "
+                f"({report})"
+            )
+        if report["mismatches"]:
+            failures.append(
+                f"recovery/{scenario}: {report['mismatches']} results "
+                f"diverged from clean run under mesh faults"
+            )
+        if report["untyped_error_count"]:
+            failures.append(
+                f"recovery/{scenario}: {report['untyped_error_count']} "
+                f"untyped errors (first: {report['untyped_errors'][:1]})"
+            )
+        if report["hung_threads"]:
+            failures.append(
+                f"recovery/{scenario}: {report['hung_threads']} client "
+                f"threads never returned"
+            )
+        if not report["drained"]:
+            failures.append(
+                f"recovery/{scenario}: mid-traffic drain timed out"
+            )
+        if not report["mesh_faults_fired"]:
+            failures.append(
+                f"recovery/{scenario}: no mesh fault landed — the "
+                f"drain never raced a resuming query"
+            )
+        elif not report["checkpoint_resumes"]:
+            failures.append(
+                f"recovery/{scenario}: faults fired but nothing "
+                f"resumed from checkpoint ({report})"
+            )
+        if verbose:
+            print(
+                f"  chaos recovery/{scenario}: ok "
+                f"completed={report['completed']} ok={report['ok']} "
+                f"faults={report['mesh_faults_fired']} "
+                f"resumes={report['checkpoint_resumes']} "
                 f"drained={report['drained']} hung=0"
             )
     return failures

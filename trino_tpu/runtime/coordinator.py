@@ -121,9 +121,21 @@ class QueryScheduler:
                 KIND_TASK,
                 wire_context,
             )
+        record_stages = bool(
+            getattr(self.session, "recovery_spool_stages", False)
+        )
+        if record_stages:
+            from trino_tpu.recovery import RECORDER, fragment_recordable
+        root_fid = self.subplan.fragment.id
         for sp in order:
             f = sp.fragment
             tc = task_counts[f.id]
+            record_this = (
+                record_stages
+                and fragment_recordable(sp, f.id == root_fid)
+            )
+            if record_this:
+                RECORDER.expect(self.query_id, f.id, tc)
             n_out = consumer_counts.get(f.id, 1)
             if tracing:
                 self.stage_spans[f.id] = self.query_span.child(
@@ -165,6 +177,7 @@ class QueryScheduler:
                         self.session, "capacity_ladder_base", 2
                     ),
                     deadline_epoch_s=self.deadline_epoch_s,
+                    record_output=record_this,
                 )
                 if tracing:
                     tspan = self.stage_spans[f.id].child(
@@ -318,6 +331,12 @@ class DistributedQueryRunner:
             ]
             self._in_process_workers = True
         self.hash_partitions = hash_partitions
+        # recovery tier: surface the recovery.* counters in /v1/metrics
+        # at zero from process start (a counter only materializes on
+        # first bump otherwise)
+        from trino_tpu.recovery import register_recovery_metrics
+
+        register_recovery_metrics()
         # why the last query left the mesh plane (None = it didn't)
         self.last_mesh_fallback: Optional[str] = None
         # resiliency plane: every worker is registered with a
@@ -753,7 +772,10 @@ class DistributedQueryRunner:
                     tables=plan_tables(output),
                 )
         # planning is over: surface a planning-limit kill latched during
-        # the analyze/optimize/fragment work before any task launches
+        # the analyze/optimize/fragment work before any task launches.
+        # Enforce synchronously first — a planning phase that finishes
+        # between background ticks must not outrun its own budget
+        tracker.enforce_now(base_qid)
         tracker.check(base_qid)
         tracker.transition(base_qid, EXECUTING)
         # worker-local deadline: translate the query's remaining wall
@@ -795,7 +817,10 @@ class DistributedQueryRunner:
                 MeshExecutor,
                 MeshUnsupported,
             )
-            from trino_tpu.parallel.mesh_chunk import MeshStuck
+            from trino_tpu.parallel.mesh_chunk import (
+                MeshDeviceLost,
+                MeshStuck,
+            )
             from trino_tpu.runtime.metrics import set_compile_attribution
             from trino_tpu.runtime.query_tracker import (
                 QueryAbandonedError,
@@ -822,9 +847,12 @@ class DistributedQueryRunner:
                 self._record_mesh_fallback(str(ex), query_span)
             except (QueryDeadlineError, QueryAbandonedError):
                 raise  # the preemption hook fired: typed, no fallback
-            except MeshStuck as ex:
-                # retryable by classification: a program hung here may
-                # succeed on the page plane, so fall back observably
+            except (MeshStuck, MeshDeviceLost) as ex:
+                # retryable by classification: a program hung (or lost
+                # its device) after exhausting in-run checkpoint
+                # resumes may succeed on the page plane, so fall back
+                # observably. The mesh checkpoint survives — the next
+                # mesh execution of this plan resumes from it.
                 self._record_mesh_fallback(str(ex), query_span)
             except Exception as e:
                 if deadline_code(str(e)) is not None:
@@ -849,6 +877,14 @@ class DistributedQueryRunner:
             if self.session.retry_policy == "query"
             else 1
         )
+        # recovery tier: with recovery_spool_stages on, every non-root
+        # task tees its wire pages into the stage-output recorder; a
+        # failed attempt's fully-finished fragments are harvested into
+        # the subtree spool and the NEXT attempt substitutes them as
+        # literal sources (only the work that failed is recomputed)
+        spool_stages = attempts > 1 and bool(
+            getattr(self.session, "recovery_spool_stages", False)
+        )
         last_error: Optional[BaseException] = None
         accrued_cpu = 0.0  # CPU spent by completed attempts
         for attempt in range(attempts):
@@ -869,6 +905,7 @@ class DistributedQueryRunner:
                     f"Query {base_qid} abandoned: client stopped "
                     "polling results"
                 )
+            attempt_subplan = subplan
             if attempt > 0:
                 # a stale cached split listing may be WHY the last
                 # attempt died (files compacted/deleted under it):
@@ -879,9 +916,30 @@ class DistributedQueryRunner:
                         "query_retry", attempt=attempt,
                         error=str(last_error)[:300],
                     )
+                if spool_stages:
+                    from trino_tpu.recovery import (
+                        harvest_recorded_stages,
+                        substitute_spooled_fragments,
+                    )
+
+                    prev_qid = (
+                        base_qid if attempt == 1
+                        else f"{base_qid}r{attempt - 1}"
+                    )
+                    banked = harvest_recorded_stages(prev_qid, subplan)
+                    attempt_subplan, spooled = (
+                        substitute_spooled_fragments(
+                            subplan, span=query_span
+                        )
+                    )
+                    if query_span is not None and (banked or spooled):
+                        query_span.event(
+                            "stage_recovery", banked=banked,
+                            substituted=spooled,
+                        )
             scheduler = QueryScheduler(
                 query_id,
-                subplan,
+                attempt_subplan,
                 self._schedulable_workers(),
                 self.catalogs,
                 self.session,
@@ -935,7 +993,8 @@ class DistributedQueryRunner:
                         scheduler.finalize()
                     )
                     self._record_stage_divergences(
-                        subplan, self._last_stage_infos, query_span
+                        attempt_subplan, self._last_stage_infos,
+                        query_span,
                     )
                 except Exception:
                     pass  # observability must never mask the verdict
@@ -1003,6 +1062,29 @@ class DistributedQueryRunner:
             f"compactions={s['compactions']}"
         )
 
+    def _recovery_line(self) -> str:
+        """The EXPLAIN ANALYZE recovery-tier line: lifetime
+        checkpoint/resume counters from the process singletons, plus
+        the most recent mesh run's resume position when it resumed."""
+        from trino_tpu.parallel.mesh_chunk import LAST_RUN_INFO
+        from trino_tpu.recovery import CHECKPOINTS
+        from trino_tpu.runtime.metrics import METRICS
+
+        line = (
+            f"recovery= checkpoints={CHECKPOINTS.taken} "
+            f"resumes={CHECKPOINTS.resumed} "
+            f"invalidations={CHECKPOINTS.invalidated} "
+            f"spooled_stage_hits="
+            f"{int(METRICS.counter('recovery.spooled_stage_hits'))}"
+        )
+        resumed = LAST_RUN_INFO.get("resumed_from_chunk")
+        if resumed is not None:
+            line += (
+                f" resumed_from_chunk={resumed}/"
+                f"{LAST_RUN_INFO.get('chunks')}"
+            )
+        return line
+
     def _explain_text(self, subplan) -> str:
         """Fragment rendering with per-fragment compile-churn census
         annotations (expected_xla_lowerings — sql/validate.py)."""
@@ -1049,6 +1131,7 @@ class DistributedQueryRunner:
             # scheduler above either way, for the operator stats)
             lines.append(self._mesh_plane_line(subplan))
             lines.append(self._resident_line())
+            lines.append(self._recovery_line())
             return MaterializedResult(
                 [["\n".join(lines)]], ["Query Plan"], [T.VARCHAR]
             )
@@ -1421,6 +1504,12 @@ class DistributedQueryRunner:
             wall = qspan.duration_s
             METRICS.observe("query_wall_s", wall)
             stages = self._last_stage_infos or []
+            # recovery tier: a finished query's stage recordings (every
+            # attempt namespace) are dead weight — drop them so the
+            # recorder stays bounded by in-flight queries
+            from trino_tpu.recovery import RECORDER
+
+            RECORDER.purge(base_qid)
             compile_count = int(retire_query_compiles(base_qid))
             peak = self._drain_query_peaks(base_qid)
             counters = engine_counters_delta(

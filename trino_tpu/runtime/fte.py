@@ -217,6 +217,26 @@ class FaultTolerantQueryScheduler:
         the query down instead of finishing work nobody reads."""
         from trino_tpu.runtime.stages import stage_task_count, topo_order
 
+        # recovery tier: a prior attempt (or prior submission) of this
+        # plan may have banked complete stage outputs in the subtree
+        # spool — replay those as literal sources and skip their whole
+        # producer subtrees. Conversely, every stage that settles below
+        # records its committed spool files back into the spool so the
+        # NEXT attempt after a failure starts further along.
+        spooled_ids: set = set()
+        record_stages = bool(
+            getattr(self.session, "recovery_spool_stages", False)
+        )
+        if record_stages:
+            from trino_tpu.recovery import substitute_spooled_fragments
+
+            new_subplan, hits = substitute_spooled_fragments(
+                self.subplan, span=self.query_span
+            )
+            if hits:
+                self.subplan = new_subplan
+                spooled_ids = set(hits)
+
         order = topo_order(self.subplan)
         task_counts = {
             sp.fragment.id: stage_task_count(
@@ -229,13 +249,23 @@ class FaultTolerantQueryScheduler:
             for c in sp.children:
                 consumer_counts[c.fragment.id] = task_counts[sp.fragment.id]
         root_handle = None
+        root_id = self.subplan.fragment.id
         for sp in order:
+            fid = sp.fragment.id
+            n_out = consumer_counts.get(fid, 1)
             root_handle = self._run_stage(
-                sp, task_counts[sp.fragment.id],
-                consumer_counts.get(sp.fragment.id, 1),
-                cancel=cancel,
+                sp, task_counts[fid], n_out, cancel=cancel,
             )
-        root_key = self.committed[(self.subplan.fragment.id, 0)]
+            if record_stages and fid != root_id and fid not in spooled_ids:
+                from trino_tpu.recovery import record_committed_stage
+
+                record_committed_stage(
+                    self.spool_dir,
+                    [self.committed[(fid, p)]
+                     for p in range(task_counts[fid])],
+                    sp, n_out, is_root=False,
+                )
+        root_key = self.committed[(root_id, 0)]
         return root_handle, root_key
 
     @staticmethod

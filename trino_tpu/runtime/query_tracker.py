@@ -96,7 +96,10 @@ def preemption_check(tracker, base_qid, cancel=None, deadline_epoch_s=None,
 
     Signature: check(done, total) — the caller's progress through its
     preemption boundaries, embedded in the kill message for
-    observability."""
+    observability. A checkpoint-resumed run (recovery tier) sets
+    `check.resumed_from` so a deadline kill mid-resume names where the
+    run restarted — the error stays typed and non-retryable either way:
+    resuming does not refresh a spent budget."""
     import time as _time
 
     clock = clock or _time.time
@@ -111,11 +114,17 @@ def preemption_check(tracker, base_qid, cancel=None, deadline_epoch_s=None,
                 "polling results"
             )
         if deadline_epoch_s is not None and clock() > deadline_epoch_s:
+            resumed = getattr(check, "resumed_from", None)
+            ctx = (
+                f" (resumed from chunk {resumed})"
+                if resumed is not None else ""
+            )
             raise ExceededTimeLimitError(
                 "Query exceeded the execution-time limit at mesh chunk "
-                f"{done}/{total} [{EXCEEDED_TIME_LIMIT}]"
+                f"{done}/{total}{ctx} [{EXCEEDED_TIME_LIMIT}]"
             )
 
+    check.resumed_from = None
     return check
 
 
@@ -240,6 +249,27 @@ class QueryTracker:
         tq = self._queries.get(query_id)
         if tq is not None and tq.error is not None:
             raise tq.error
+
+    def enforce_now(self, query_id: str) -> None:
+        """One synchronous enforcement sweep for one query. Phase
+        boundaries call this so a budget blown inside a sub-tick phase
+        (planning that finishes before the first background tick fires)
+        still latches its typed kill — identical to a tick landing at
+        this instant."""
+        with self._lock:
+            tq = self._queries.get(query_id)
+            if tq is None or tq.error is not None or tq.phase == DONE:
+                return
+        err = self._enforce(tq, self._clock())
+        if err is None:
+            return
+        tq.error = err
+        self.kills.append((tq.query_id, err.code, str(err)))
+        if tq.kill is not None:
+            try:
+                tq.kill(str(err))
+            except Exception:
+                pass  # the latched error still fails the query
 
     # -- enforcement --
     def _enforce(self, tq: TrackedQuery, now: float) -> Optional[QueryDeadlineError]:
