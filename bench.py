@@ -96,6 +96,15 @@ Flags:
                      beat the restart wall, and mint zero new XLA
                      lowerings; re-execs itself with an 8-device host
                      platform, so no device needed
+  --skew-smoke       exercise the skew-aware join plane: a zipf-skewed
+                     join whose build barrier detects the heavy hitter
+                     from observed stats and salts the mesh exchange
+                     (oracle-equal, salted counters advance, zero new
+                     lowerings warm), plus a high-fanout join-aggregate
+                     lowered to the MXU join-project kernel (oracle-
+                     equal vs the gather path, beats its warm wall);
+                     re-execs itself with an 8-device host platform,
+                     so no device needed
 """
 
 from __future__ import annotations
@@ -1744,6 +1753,271 @@ def _recovery_smoke(argv) -> int:
     return 1 if violations else 0
 
 
+def _zipf_keys(rng, n: int, n_keys: int, s: float):
+    """Seedable zipf-distributed join keys in [0, n_keys): key rank r
+    drawn with probability proportional to 1/(r+1)^s. At s=1.4 over 64
+    keys the modal key holds ~38% of the rows — past any reasonable
+    skew_hot_key_threshold — while staying bounded (np's unbounded
+    rng.zipf tail would break fixture determinism across clips)."""
+    import numpy as np
+
+    p = 1.0 / np.arange(1, n_keys + 1, dtype=np.float64) ** s
+    p /= p.sum()
+    return rng.choice(n_keys, size=n, p=p).astype(np.int64)
+
+
+def _skew_smoke(argv) -> int:
+    """--skew-smoke: CI gate for the skew-aware join plane (heavy-hitter
+    salted repartition + MXU join-project, ISSUE 16). Two sections over
+    seedable zipf key distributions:
+
+    SALTED (8-device cpu mesh): a join whose build side's modal key
+    holds ~38% of its rows runs plain, then with adaptive execution +
+    skewed_join_salting — the build barrier classifies the heavy hitter
+    from OBSERVED stats, annotates the join, and the mesh plane runs
+    the exchange salted (hot build rows replicated over all_gather, hot
+    probe rows scattered across the all_to_all). Gates: the salted arm
+    stays on the mesh, is oracle-equal to the unsalted arm,
+    skew.heavy_hitters_detected and skew.salted_exchanges advance, and
+    a warm repeat mints zero new XLA lowerings.
+
+    MXU (local path): a high-fanout zipf join feeding SUM/COUNT runs on
+    the gather-expansion path, then with mxu_join_enabled — the grouped
+    aggregate lowers to the indicator-matmul kernel and the pair batch
+    never exists. Gates: oracle-equal, skew.mxu_join_selected advances,
+    zero new lowerings on the warm repeat, and the combined skew-aware
+    warm wall (salted mesh + MXU local) beats the combined baseline
+    warm wall. Exit 1 on any violation."""
+    if os.environ.get("SKEW_SMOKE_INNER") != "1":
+        # same clean-slate re-exec as --mesh-smoke: the multi-device
+        # host platform must be configured before jax initializes
+        env = dict(os.environ)
+        env["SKEW_SMOKE_INNER"] = "1"
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        return subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--skew-smoke"],
+            env=env,
+        ).returncode
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    n_dev = len(jax.devices())
+
+    import numpy as np
+
+    from trino_tpu import types as T
+    from trino_tpu.adaptive import SPOOL
+    from trino_tpu.connectors.memory import MemoryConnector
+    from trino_tpu.connectors.spi import ColumnMetadata
+    from trino_tpu.engine import LocalQueryRunner, Session
+    from trino_tpu.runtime import DistributedQueryRunner
+    from trino_tpu.runtime.metrics import METRICS
+
+    def skew_counter(name: str) -> float:
+        return METRICS.snapshot().get(f"skew.{name}", 0.0)
+
+    def warm_wall(runner, sql: str, expect) -> tuple:
+        """(median-of-3 warm wall, new lowerings over the loop)."""
+        walls = []
+        compiles0 = METRICS.counter("xla_compiles")
+        for _ in range(3):
+            t0 = time.time()
+            rows = runner.execute(sql).rows
+            walls.append(time.time() - t0)
+            if rows != expect:
+                return None, None
+        return (
+            sorted(walls)[1],
+            METRICS.counter("xla_compiles") - compiles0,
+        )
+
+    violations = []
+    print(f"bench: skew smoke ({n_dev}-device cpu mesh, zipf keys, "
+          "CPU ok)")
+    if n_dev < 8:
+        violations.append(f"expected an 8-device mesh, got {n_dev}")
+
+    # ---- SALTED section: heavy-hitter detection -> mesh salting ----
+    def salted_catalog() -> MemoryConnector:
+        conn = MemoryConnector()
+        rng = np.random.default_rng(29)
+        n, nk = 8000, 64
+        conn.load_table(
+            "s", "facts",
+            [ColumnMetadata("k1", T.BIGINT), ColumnMetadata("v", T.BIGINT)],
+            [_zipf_keys(rng, n, nk, 1.4),
+             rng.integers(0, 100, n).astype(np.int64)],
+        )
+        conn.load_table(
+            "s", "dim",
+            [ColumnMetadata("k", T.BIGINT), ColumnMetadata("w", T.BIGINT)],
+            [_zipf_keys(rng, 2000, nk, 1.4),
+             rng.integers(0, 10, 2000).astype(np.int64)],
+        )
+        return conn
+
+    def mk_mesh(**session_kw):
+        r = DistributedQueryRunner(
+            Session(
+                catalog="memory", schema="s",
+                broadcast_join_threshold=0, mesh_chunk_rows=4096,
+                **session_kw,
+            ),
+            n_workers=2, hash_partitions=2,
+        )
+        r.register_catalog("memory", salted_catalog())
+        return r
+
+    # the partial aggregate above the join is placement-insensitive,
+    # so the salted exchange map accepts the plan (a single-step agg
+    # grouping ON the join key would rely on key colocation and is
+    # correctly refused)
+    salt_sql = (
+        "select sum(f.v + d.w), count(*) from facts f "
+        "join dim d on f.k1 = d.k"
+    )
+    SPOOL.clear()
+    plain = mk_mesh()
+    oracle = plain.execute(salt_sql).rows
+    if plain._last_data_plane != "mesh":
+        violations.append(
+            f"unsalted arm ran on {plain._last_data_plane}, not the "
+            f"mesh (fallback: {plain.last_mesh_fallback})"
+        )
+    plain_warm, _ = warm_wall(plain, salt_sql, oracle)
+    if plain_warm is None:
+        violations.append("unsalted warm repeat diverged")
+        plain_warm = 0.0
+
+    salted = mk_mesh(
+        adaptive_execution=True, skewed_join_salting=True,
+        skew_hot_key_threshold=0.2,
+    )
+    hh0 = skew_counter("heavy_hitters_detected")
+    se0 = skew_counter("salted_exchanges")
+    got = salted.execute(salt_sql).rows
+    hh = skew_counter("heavy_hitters_detected") - hh0
+    se = skew_counter("salted_exchanges") - se0
+    if salted._last_data_plane != "mesh":
+        violations.append(
+            f"salted arm ran on {salted._last_data_plane}, not the "
+            f"mesh (fallback: {salted.last_mesh_fallback})"
+        )
+    if got != oracle:
+        violations.append("salted arm != unsalted oracle")
+    if hh < 1:
+        violations.append(
+            "no heavy hitter detected from observed build stats"
+        )
+    if se < 1:
+        violations.append("no exchange ran salted on the mesh")
+    salted_warm, salted_lowerings = warm_wall(salted, salt_sql, oracle)
+    if salted_warm is None:
+        violations.append("salted warm repeat diverged")
+        salted_warm = 0.0
+    elif salted_lowerings > 0:
+        violations.append(
+            f"salted warm repeat lowered {salted_lowerings:g} new XLA "
+            "programs (expected 0)"
+        )
+
+    # ---- MXU section: high-fanout join-project as matmul ----
+    def mxu_catalog() -> MemoryConnector:
+        conn = MemoryConnector()
+        rng = np.random.default_rng(31)
+        n, nk, fan = 50_000, 64, 16
+        conn.load_table(
+            "s", "facts",
+            [ColumnMetadata("k1", T.BIGINT), ColumnMetadata("v", T.BIGINT)],
+            [_zipf_keys(rng, n, nk, 1.2),
+             rng.integers(0, 100, n).astype(np.int64)],
+        )
+        # uniform fan-out build: every probe row matches `fan` rows, so
+        # the gather path expands n*fan pairs the MXU path never builds
+        conn.load_table(
+            "s", "dim",
+            [ColumnMetadata("k", T.BIGINT), ColumnMetadata("g", T.BIGINT)],
+            [np.repeat(np.arange(nk, dtype=np.int64), fan),
+             np.arange(nk * fan, dtype=np.int64) % 11],
+        )
+        return conn
+
+    def mk_local(**session_kw):
+        r = LocalQueryRunner(
+            Session(catalog="memory", schema="s", **session_kw)
+        )
+        r.register_catalog("memory", mxu_catalog())
+        return r
+
+    mxu_sql = (
+        "select d.g, sum(f.v), count(*) from facts f "
+        "join dim d on f.k1 = d.k group by d.g order by 1"
+    )
+    gather = mk_local()
+    mxu_oracle = gather.execute(mxu_sql).rows
+    gather_warm, _ = warm_wall(gather, mxu_sql, mxu_oracle)
+    if gather_warm is None:
+        violations.append("gather warm repeat diverged")
+        gather_warm = 0.0
+
+    mxu = mk_local(mxu_join_enabled=True, mxu_join_min_work=16.0)
+    mj0 = skew_counter("mxu_join_selected")
+    mxu_rows = mxu.execute(mxu_sql).rows
+    mj = skew_counter("mxu_join_selected") - mj0
+    if mxu_rows != mxu_oracle:
+        violations.append("MXU arm != gather oracle")
+    if mj < 1:
+        violations.append("MXU join-project was never selected")
+    mxu_warm, mxu_lowerings = warm_wall(mxu, mxu_sql, mxu_oracle)
+    if mxu_warm is None:
+        violations.append("MXU warm repeat diverged")
+        mxu_warm = 0.0
+    elif mxu_lowerings > 0:
+        violations.append(
+            f"MXU warm repeat lowered {mxu_lowerings:g} new XLA "
+            "programs (expected 0)"
+        )
+
+    # the arm gate: everything-on must beat everything-off on warm
+    # walls over the zipf config (the MXU fanout elimination is the
+    # CPU-visible win; salting's serialization win needs real shards)
+    base_total = plain_warm + gather_warm
+    skew_total = salted_warm + mxu_warm
+    if skew_total >= base_total:
+        violations.append(
+            f"skew-aware warm wall {skew_total:.3f}s did not beat the "
+            f"baseline {base_total:.3f}s"
+        )
+
+    for v in violations:
+        print(f"bench: skew VIOLATION: {v}", file=sys.stderr)
+    print(json.dumps({
+        "skew_smoke": {
+            "devices": n_dev,
+            "salted": {
+                "heavy_hitters_detected": hh,
+                "salted_exchanges": se,
+                "plain_warm_wall_s": round(plain_warm, 4),
+                "salted_warm_wall_s": round(salted_warm, 4),
+                "warm_new_lowerings": salted_lowerings,
+            },
+            "mxu": {
+                "selected": mj,
+                "gather_warm_wall_s": round(gather_warm, 4),
+                "mxu_warm_wall_s": round(mxu_warm, 4),
+                "warm_new_lowerings": mxu_lowerings,
+            },
+            "violations": len(violations),
+        }
+    }))
+    return 1 if violations else 0
+
+
 def _validate_corpus(argv) -> int:
     """--validate-corpus: CI gate for the plan sanity checkers
     (sql/validate.py). Plans — without executing — every TPC-H and
@@ -1858,6 +2132,8 @@ def main() -> None:
         sys.exit(_adaptive_smoke(sys.argv))
     if "--recovery-smoke" in sys.argv:
         sys.exit(_recovery_smoke(sys.argv))
+    if "--skew-smoke" in sys.argv:
+        sys.exit(_skew_smoke(sys.argv))
     if "--validate-corpus" in sys.argv:
         sys.exit(_validate_corpus(sys.argv))
     if os.environ.get("BENCH_INNER") == "1":

@@ -409,6 +409,7 @@ def corpus_07_distributed_analyze():
         # numbers
         text = re.sub(r"resident= .*", "resident= #", text)
         text = re.sub(r"recovery= .*", "recovery= #", text)
+        text = re.sub(r"skew= .*", "skew= #", text)
         return text
 
     emit(
@@ -456,6 +457,7 @@ def corpus_08_mesh_analyze():
         text = re.sub(r"\btask q\d+\.", "task q#.", text)
         text = re.sub(r"resident= .*", "resident= #", text)
         text = re.sub(r"recovery= .*", "recovery= #", text)
+        text = re.sub(r"skew= .*", "skew= #", text)
         return text
 
     emit(
@@ -549,6 +551,7 @@ def corpus_09_resident_analyze():
         text = re.sub(r"\btask q\d+\.", "task q#.", text)
         text = re.sub(r"pinned_bytes=\d+", "pinned_bytes=#", text)
         text = re.sub(r"recovery= .*", "recovery= #", text)
+        text = re.sub(r"skew= .*", "skew= #", text)
         return text
 
     emit(
@@ -617,6 +620,7 @@ def corpus_10_adaptive_analyze():
         text = re.sub(r"\btask q\d+\.", "task q#.", text)
         text = re.sub(r"resident= .*", "resident= #", text)
         text = re.sub(r"recovery= .*", "recovery= #", text)
+        text = re.sub(r"skew= .*", "skew= #", text)
         text = re.sub(r"spool=[0-9a-f]+", "spool=#", text)
         return text
 
@@ -709,6 +713,7 @@ def corpus_11_recovery_analyze():
         text = re.sub(r"\b(add|get|finish)=\d+(\.\d+)?", r"\1=#", text)
         text = re.sub(r"\btask q\d+\.", "task q#.", text)
         text = re.sub(r"resident= .*", "resident= #", text)
+        text = re.sub(r"skew= .*", "skew= #", text)
         return text
 
     emit(
@@ -722,6 +727,113 @@ def corpus_11_recovery_analyze():
          "recovery= line reports\nthe lifetime checkpoint/resume "
          "counters plus the resume position of the\nmost recent mesh "
          "run (wall-clock values redacted to `#`)", redact(out)),
+    )
+
+
+def corpus_12_skew_analyze():
+    """The skew-aware join plane (ISSUE 16): a build side whose modal
+    key holds 40% of its rows crosses skew_hot_key_threshold at the
+    adaptive build barrier — the controller classifies the heavy hitter
+    from OBSERVED stats (never estimates), annotates the join with
+    skew_hot_keys (salted repartition on the mesh plane: hot build rows
+    replicate over all_gather, hot probe rows salt across shards), and
+    the adaptive report grows a `skew:` line. Separately the MXU
+    join-project kernel (ops/mxu_join.py) takes a high-fanout
+    agg-over-join on the local path without ever expanding the pair
+    batch. The trailing `skew=` line of distributed EXPLAIN ANALYZE
+    pins the lifetime counters; they are reset up front so the numbers
+    are exact. Timings redacted as in corpus 07."""
+    import re
+
+    from trino_tpu.adaptive import SPOOL
+    from trino_tpu.connectors.memory import MemoryConnector
+    from trino_tpu.runtime import DistributedQueryRunner, Worker
+
+    SPOOL.clear()
+    for c in ("heavy_hitters_detected", "salted_exchanges",
+              "mxu_join_selected", "spill_mode_replans"):
+        METRICS.remove(f"skew.{c}")
+
+    def load(conn):
+        rng = np.random.default_rng(23)
+        n, nk = 2000, 40
+        conn.load_table(
+            "s", "facts",
+            [ColumnMetadata("k1", T.BIGINT), ColumnMetadata("v", T.BIGINT)],
+            [rng.integers(0, nk, n).astype(np.int64),
+             rng.integers(0, 100, n).astype(np.int64)],
+        )
+        # build side with a 40% modal key (key 0): the heavy hitter
+        bk = np.concatenate([
+            np.zeros(160, dtype=np.int64),
+            rng.integers(1, nk, 240).astype(np.int64),
+        ])
+        conn.load_table(
+            "s", "hot_dim",
+            [ColumnMetadata("k", T.BIGINT), ColumnMetadata("name", T.VARCHAR)],
+            [bk, np.array([f"g{i % 6}" for i in range(bk.size)],
+                          dtype=object)],
+        )
+        return conn
+
+    sql = (
+        "select d.name, sum(f.v), count(*) from facts f "
+        "join hot_dim d on f.k1 = d.k group by d.name order by 1"
+    )
+
+    # 1. MXU join-project on the local path (fanout 10 x ndv 40)
+    lr = LocalQueryRunner(Session(
+        catalog="memory", schema="s",
+        mxu_join_enabled=True, mxu_join_min_work=16.0,
+    ))
+    lr.register_catalog("memory", load(MemoryConnector()))
+    mxu_rows = lr.execute(sql).rows
+    events = [
+        f"local MXU join-project: {len(mxu_rows)} groups, "
+        f"mxu_join_selected="
+        f"{int(METRICS.snapshot().get('skew.mxu_join_selected', 0.0))}",
+    ]
+
+    # 2. heavy-hitter classification at the adaptive build barrier
+    cats = CatalogManager()
+    cats.register("memory", load(MemoryConnector()))
+    workers = [Worker(f"corpus-sw{i}", cats) for i in range(2)]
+    r = DistributedQueryRunner(
+        Session(
+            catalog="memory", schema="s",
+            adaptive_execution=True,
+            skewed_join_salting=True,
+            skew_hot_key_threshold=0.2,
+        ),
+        worker_handles=workers,
+        hash_partitions=2,
+    )
+    r.register_catalog("memory", load(MemoryConnector()))
+    out = r.execute("EXPLAIN ANALYZE " + sql).rows[0][0]
+
+    def redact(text):
+        text = re.sub(r"\b(wall|cpu)=\d+(\.\d+)?ms", r"\1=#ms", text)
+        text = re.sub(r"\b(add|get|finish)=\d+(\.\d+)?", r"\1=#", text)
+        text = re.sub(r"\btask q\d+\.", "task q#.", text)
+        text = re.sub(r"resident= .*", "resident= #", text)
+        text = re.sub(r"recovery= .*", "recovery= #", text)
+        text = re.sub(r"spool=[0-9a-f]+", "spool=#", text)
+        return text
+
+    emit(
+        "12_skew_analyze.txt",
+        (f"QUERY\n{sql}", ""),
+        ("MXU join-project selection (mxu_join_enabled=true): the "
+         "grouped aggregate\nover the inner join lowers to the "
+         "indicator-matmul kernel — per-key sums\non the systolic "
+         "array, no pair expansion", "\n".join(events)),
+        ("distributed EXPLAIN ANALYZE with adaptive_execution=true, "
+         "skewed_join_salting\n=true (hot_dim's modal key holds 40% of "
+         "build rows > skew_hot_key_threshold\n=0.2: the build barrier "
+         "classifies it from observed stats, the adaptive\nsection "
+         "grows its skew: line, and the join is annotated for salted "
+         "mesh\nrepartition; the trailing skew= line pins the lifetime "
+         "counters)", redact(out)),
     )
 
 
@@ -742,6 +854,7 @@ def write_all(out_dir=None):
         corpus_09_resident_analyze()
         corpus_10_adaptive_analyze()
         corpus_11_recovery_analyze()
+        corpus_12_skew_analyze()
     finally:
         _OUT_DIR[0] = HERE
 

@@ -1,0 +1,456 @@
+"""Skew-aware join plane (ISSUE 16): heavy-hitter salted repartition
++ MXU matmul join-project.
+
+The plane has three triggers and these tests pin all of them:
+
+  - the heavy-hitter classifier (adaptive/observer.py hot_keys) names
+    modal build keys from OBSERVED stats at the barrier — never from
+    estimates — and only plain integer keys qualify;
+  - a classified join is annotated skew_hot_keys and the mesh plane
+    (parallel/mesh_chunk.py) runs its exchange salted: hot build rows
+    replicate over all_gather, hot probe rows scatter across the
+    all_to_all — byte-equal to the unsalted run across chunk settings,
+    zero new XLA lowerings on a warm repeat, and a deadline kill lands
+    typed at a chunk boundary mid-salted-exchange;
+  - the MXU join-project kernel (ops/mxu_join.py) aggregates a
+    high-fanout equi-join without expanding the pair batch —
+    oracle-equal to the gather path including NULL keys, NULL values,
+    NULL group keys and an empty build side;
+  - a build overflow past the spool bound re-plans the join into
+    hybrid-hash spill mode (DHHJ) instead of thrashing;
+  - a no-skew plan is byte-identical with the salting feature on.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.adaptive import SPOOL, AdaptiveController
+from trino_tpu.adaptive.observer import observe_rows, hot_keys
+from trino_tpu.connectors.memory import MemoryConnector
+from trino_tpu.connectors.spi import CatalogManager, ColumnMetadata
+from trino_tpu.engine import LocalQueryRunner, Session
+from trino_tpu.runtime import DistributedQueryRunner
+from trino_tpu.runtime.metrics import METRICS
+from trino_tpu.runtime.query_tracker import (
+    EXCEEDED_TIME_LIMIT,
+    QueryDeadlineError,
+)
+from trino_tpu.sql import plan as P
+from trino_tpu.sql.analyzer import Analyzer
+from trino_tpu.sql.parser import parse
+
+
+def _zipf(rng, n, n_keys, s):
+    p = 1.0 / np.arange(1, n_keys + 1, dtype=np.float64) ** s
+    p /= p.sum()
+    return rng.choice(n_keys, size=n, p=p).astype(np.int64)
+
+
+# ---------------------------------------------------------------- #
+# heavy-hitter classifier                                          #
+# ---------------------------------------------------------------- #
+
+
+def test_classifier_names_hot_keys_from_observations():
+    rows = (
+        [(0, "a")] * 40
+        + [(1, "b")] * 25
+        + [(k + 100, "c") for k in range(35)]
+    )
+    obs = observe_rows(rows, channels=[0])
+    assert obs.rows == 100
+    assert obs.heavy_hitter[0] == 40
+    assert hot_keys(obs, 0, 0.3) == (0,)
+    assert set(hot_keys(obs, 0, 0.2)) == {0, 1}
+    assert hot_keys(obs, 0, 0.5) == ()
+
+
+def test_classifier_threshold_is_inclusive():
+    rows = [(7,)] * 20 + [(i + 100,) for i in range(80)]
+    obs = observe_rows(rows, channels=[0])
+    assert hot_keys(obs, 0, 0.20) == (7,)   # 20/100 == threshold
+    assert hot_keys(obs, 0, 0.21) == ()
+
+
+def test_classifier_only_plain_integer_keys():
+    rows = [("hot",)] * 60 + [(True,)] * 30 + [(None,)] * 10
+    obs = observe_rows(rows, channels=[0])
+    # strings and bools never qualify (they cannot be compared against
+    # the device key column at trace time); NULLs are not keys at all
+    assert hot_keys(obs, 0, 0.1) == ()
+    assert hot_keys(obs, 0, 0.0) == ()  # degenerate threshold: off
+    assert hot_keys(observe_rows([], channels=[0]), 0, 0.2) == ()
+
+
+# ---------------------------------------------------------------- #
+# salted repartition on the mesh plane                             #
+# ---------------------------------------------------------------- #
+
+# global partial aggregate above the join: placement-insensitive, so
+# the salted exchange map accepts the plan. Integer sums only — the
+# byte-equality assert must not depend on float merge order.
+SALT_SQL = (
+    "select sum(f.v + d.w), count(*) from facts f "
+    "join dim d on f.k1 = d.k"
+)
+
+
+def _skewed_catalog():
+    conn = MemoryConnector()
+    rng = np.random.default_rng(29)
+    n, nk = 4000, 64
+    conn.load_table(
+        "s", "facts",
+        [ColumnMetadata("k1", T.BIGINT), ColumnMetadata("v", T.BIGINT)],
+        [_zipf(rng, n, nk, 1.4), rng.integers(0, 100, n).astype(np.int64)],
+    )
+    conn.load_table(
+        "s", "dim",
+        [ColumnMetadata("k", T.BIGINT), ColumnMetadata("w", T.BIGINT)],
+        [_zipf(rng, 1000, nk, 1.4),
+         rng.integers(0, 10, 1000).astype(np.int64)],
+    )
+    return conn
+
+
+def _mk_mesh(**session_kw):
+    r = DistributedQueryRunner(
+        Session(
+            catalog="memory", schema="s", broadcast_join_threshold=0,
+            **session_kw,
+        ),
+        n_workers=2, hash_partitions=2,
+    )
+    r.register_catalog("memory", _skewed_catalog())
+    return r
+
+
+def _mk_salted(**session_kw):
+    return _mk_mesh(
+        adaptive_execution=True, skewed_join_salting=True,
+        skew_hot_key_threshold=0.2, **session_kw,
+    )
+
+
+@pytest.fixture(scope="module")
+def salt_oracle():
+    r = _mk_mesh(mesh_execution=False)
+    return r.execute(SALT_SQL).rows
+
+
+# per-shard extent is 4000/8 = 500 rows: 0 -> unchunked, 256 -> two
+# chunks, 128 -> four (the extra chunk-count rung rides tier-2: each
+# setting compiles its own program family)
+@pytest.mark.parametrize(
+    "chunk_rows", [0, 256, pytest.param(128, marks=pytest.mark.slow)]
+)
+def test_salted_byte_equality_across_chunk_counts(chunk_rows, salt_oracle):
+    SPOOL.clear()
+    r = _mk_salted(mesh_chunk_rows=chunk_rows)
+    hh0 = METRICS.snapshot().get("skew.heavy_hitters_detected", 0.0)
+    se0 = METRICS.snapshot().get("skew.salted_exchanges", 0.0)
+    assert r.execute(SALT_SQL).rows == salt_oracle
+    assert r._last_data_plane == "mesh", r.last_mesh_fallback
+    assert METRICS.snapshot().get("skew.heavy_hitters_detected", 0.0) > hh0
+    assert METRICS.snapshot().get("skew.salted_exchanges", 0.0) > se0
+    rep = r._last_adaptive_report
+    assert rep is not None and rep.heavy_hitters >= 1
+    assert rep.salted_joins >= 1
+
+
+def test_salted_warm_repeat_zero_relowerings(salt_oracle):
+    SPOOL.clear()
+    r = _mk_salted(mesh_chunk_rows=256)
+    assert r.execute(SALT_SQL).rows == salt_oracle  # cold: compiles
+    compiles0 = METRICS.snapshot().get("xla_compiles", 0.0)
+    assert r.execute(SALT_SQL).rows == salt_oracle
+    delta = METRICS.snapshot().get("xla_compiles", 0.0) - compiles0
+    assert delta == 0, f"salted warm repeat lowered {delta:g} programs"
+    assert r._last_data_plane == "mesh", r.last_mesh_fallback
+
+
+def test_deadline_kill_mid_salted_exchange_stays_typed(salt_oracle):
+    """A wall deadline expiring inside the salted chunk loop preempts
+    at a chunk boundary: typed EXCEEDED_TIME_LIMIT, no page-plane
+    fallback, exactly like the unsalted mesh contract."""
+    SPOOL.clear()
+    # chunk_rows=256 reuses the program family the equality test
+    # already compiled (PROGRAM_CACHE is global), keeping this cheap
+    r = _mk_salted(mesh_chunk_rows=256)
+    assert r.execute(SALT_SQL).rows == salt_oracle  # warm
+    r.query_tracker.tick_interval_s = 60.0
+    r.session.query_max_execution_time_s = 0.05
+    with pytest.raises(QueryDeadlineError) as ei:
+        r.execute(SALT_SQL)
+    msg = str(ei.value)
+    assert EXCEEDED_TIME_LIMIT in msg
+    assert "mesh chunk" in msg
+    assert r.last_mesh_fallback is None, "deadline kill must not fall back"
+
+
+def test_no_skew_plan_is_byte_identical():
+    """A uniform-key catalog never crosses the hot-key threshold: the
+    adaptive-transformed plan with salting ON renders byte-identically
+    to salting OFF, and no skew counter moves."""
+    conn = MemoryConnector()
+    rng = np.random.default_rng(3)
+    n, nk = 2000, 50
+    conn.load_table(
+        "s", "facts",
+        [ColumnMetadata("k1", T.BIGINT), ColumnMetadata("v", T.BIGINT)],
+        [rng.integers(0, nk, n).astype(np.int64),
+         rng.integers(0, 100, n).astype(np.int64)],
+    )
+    conn.load_table(
+        "s", "dim",
+        [ColumnMetadata("k", T.BIGINT), ColumnMetadata("w", T.BIGINT)],
+        [np.arange(nk, dtype=np.int64),
+         rng.integers(0, 10, nk).astype(np.int64)],
+    )
+    cats = CatalogManager()
+    cats.register("memory", conn)
+    out = Analyzer(cats, "memory", "s").plan(parse(SALT_SQL))
+
+    def prepared(salting):
+        SPOOL.clear()
+        sess = Session(
+            catalog="memory", schema="s", adaptive_execution=True,
+            skewed_join_salting=salting, skew_hot_key_threshold=0.2,
+        )
+        ctl = AdaptiveController(cats, sess)
+        root = ctl.prepare(out)
+        return P.explain_text(root), ctl.report
+
+    se0 = METRICS.snapshot().get("skew.salted_exchanges", 0.0)
+    off_text, off_rep = prepared(False)
+    on_text, on_rep = prepared(True)
+    assert on_text == off_text
+    assert on_rep.heavy_hitters == 0 and on_rep.salted_joins == 0
+    assert METRICS.snapshot().get("skew.salted_exchanges", 0.0) == se0
+
+
+# ---------------------------------------------------------------- #
+# MXU join-project                                                 #
+# ---------------------------------------------------------------- #
+
+MXU_SQL = (
+    "select d.name, sum(f.v), count(f.v), count(*) from facts f "
+    "join dim d on f.k1 = d.k group by d.name order by 1"
+)
+
+
+def _mk_local(conn, **session_kw):
+    r = LocalQueryRunner(Session(catalog="memory", schema="s", **session_kw))
+    r.register_catalog("memory", conn)
+    return r
+
+
+def _mxu_vs_gather(conn, sql=MXU_SQL):
+    before = METRICS.snapshot().get("skew.mxu_join_selected", 0.0)
+    on = _mk_local(
+        conn, mxu_join_enabled=True, mxu_join_min_work=0.0
+    ).execute(sql).rows
+    selected = (
+        METRICS.snapshot().get("skew.mxu_join_selected", 0.0) - before
+    )
+    off = _mk_local(conn).execute(sql).rows
+    return on, off, selected
+
+
+def test_mxu_oracle_equality_high_fanout():
+    conn = MemoryConnector()
+    rng = np.random.default_rng(5)
+    n, nk, fan = 5000, 30, 3
+    conn.load_table(
+        "s", "facts",
+        [ColumnMetadata("k1", T.BIGINT), ColumnMetadata("v", T.BIGINT)],
+        [_zipf(rng, n, nk, 1.2),
+         rng.integers(-50, 100, n).astype(np.int64)],
+    )
+    bk = np.concatenate([np.arange(nk, dtype=np.int64)] * fan)
+    conn.load_table(
+        "s", "dim",
+        [ColumnMetadata("k", T.BIGINT), ColumnMetadata("name", T.VARCHAR)],
+        [bk, np.array([f"g{i % 7}" for i in range(bk.size)], dtype=object)],
+    )
+    on, off, selected = _mxu_vs_gather(conn)
+    assert selected >= 1, "MXU join-project was not selected"
+    assert on == off
+
+
+def test_mxu_null_keys_values_and_group_keys():
+    conn = MemoryConnector()
+    rng = np.random.default_rng(5)
+    n, nk = 3000, 25
+    k1 = rng.integers(0, nk, n).astype(np.int64)
+    v = rng.integers(-50, 100, n).astype(np.int64)
+    conn.load_table(
+        "s", "facts",
+        [ColumnMetadata("k1", T.BIGINT), ColumnMetadata("v", T.BIGINT)],
+        [k1, v],
+        valids=[rng.random(n) >= 0.1, rng.random(n) >= 0.15],
+    )
+    bk = np.concatenate([np.arange(nk, dtype=np.int64)] * 2)
+    bkval = np.ones(bk.size, dtype=bool)
+    bkval[3] = False  # NULL build key: joins nothing
+    conn.load_table(
+        "s", "dim",
+        [ColumnMetadata("k", T.BIGINT), ColumnMetadata("name", T.VARCHAR)],
+        [bk, np.array([f"g{i % 5}" for i in range(bk.size)], dtype=object)],
+        valids=[bkval, np.array([bool(i % 11) for i in range(bk.size)])],
+    )
+    on, off, selected = _mxu_vs_gather(conn)
+    assert selected >= 1
+    assert on == off  # incl. the NULL group-key row and SUM-of-NULLs
+
+
+def test_mxu_empty_build():
+    conn = MemoryConnector()
+    rng = np.random.default_rng(5)
+    conn.load_table(
+        "s", "facts",
+        [ColumnMetadata("k1", T.BIGINT), ColumnMetadata("v", T.BIGINT)],
+        [rng.integers(0, 25, 3000).astype(np.int64),
+         rng.integers(0, 100, 3000).astype(np.int64)],
+    )
+    conn.load_table(
+        "s", "dim",
+        [ColumnMetadata("k", T.BIGINT), ColumnMetadata("name", T.VARCHAR)],
+        [np.array([], dtype=np.int64), np.array([], dtype=object)],
+    )
+    on, off, selected = _mxu_vs_gather(conn)
+    assert selected >= 1
+    assert on == off == []
+
+
+def test_mxu_not_selected_below_work_threshold():
+    conn = MemoryConnector()
+    rng = np.random.default_rng(5)
+    conn.load_table(
+        "s", "facts",
+        [ColumnMetadata("k1", T.BIGINT), ColumnMetadata("v", T.BIGINT)],
+        [rng.integers(0, 10, 500).astype(np.int64),
+         rng.integers(0, 100, 500).astype(np.int64)],
+    )
+    conn.load_table(
+        "s", "dim",
+        [ColumnMetadata("k", T.BIGINT), ColumnMetadata("name", T.VARCHAR)],
+        [np.arange(10, dtype=np.int64),
+         np.array([f"g{i}" for i in range(10)], dtype=object)],
+    )
+    before = METRICS.snapshot().get("skew.mxu_join_selected", 0.0)
+    on = _mk_local(
+        conn, mxu_join_enabled=True, mxu_join_min_work=1e12
+    ).execute(MXU_SQL).rows
+    assert METRICS.snapshot().get("skew.mxu_join_selected", 0.0) == before
+    assert on == _mk_local(conn).execute(MXU_SQL).rows
+
+
+# ---------------------------------------------------------------- #
+# DHHJ spill-mode re-plan                                          #
+# ---------------------------------------------------------------- #
+
+
+def test_spill_mode_replan_on_build_overflow(monkeypatch):
+    """A build side that overflows the spool bound past the divergence
+    threshold re-plans the join into hybrid-hash spill mode: the
+    annotation reaches HashBuildSink as force_spill (grace partitions
+    pre-opened) and the answer stays oracle-equal."""
+    from trino_tpu.adaptive import controller as ctl_mod
+
+    conn = MemoryConnector()
+    rng = np.random.default_rng(17)
+    n, keys, fan = 4000, 40, 20
+    conn.load_table(
+        "s", "facts",
+        [ColumnMetadata("k1", T.BIGINT), ColumnMetadata("v", T.BIGINT)],
+        [rng.integers(0, keys, n).astype(np.int64),
+         rng.integers(0, 100, n).astype(np.int64)],
+    )
+    conn.load_table(
+        "s", "d1",
+        [ColumnMetadata("k", T.BIGINT), ColumnMetadata("tag", T.BIGINT)],
+        [np.repeat(np.arange(keys, dtype=np.int64), fan),
+         np.arange(keys * fan, dtype=np.int64)],
+    )
+    # the lie: d1 reported at 1/10th (est 80), true build is 800 rows —
+    # past the shrunken spool bound below, so the barrier OVERFLOWS
+    real = conn.metadata.get_table_statistics
+
+    def lying(handle):
+        ts = real(handle)
+        if handle.table == "d1" and ts.row_count is not None:
+            return dataclasses.replace(
+                ts, row_count=ts.row_count / 10.0, columns={}
+            )
+        return ts
+
+    conn.metadata.get_table_statistics = lying
+    monkeypatch.setattr(ctl_mod, "MAX_SPOOL_ROWS", 100)
+
+    sql = (
+        "select count(*), sum(f.v + d1.tag) from facts f "
+        "join d1 on f.k1 = d1.k"
+    )
+    SPOOL.clear()
+    spills0 = METRICS.snapshot().get("skew.spill_mode_replans", 0.0)
+    r = _mk_local(
+        conn, adaptive_execution=True, adaptive_replan_threshold=2.0,
+        skew_spill_min_rows=100,
+    )
+    rows = r.execute(sql).rows
+    rep = r._last_adaptive_report
+    assert rep is not None and rep.spill_builds == 1
+    assert any(o.get("spill") for o in rep.observations)
+    assert (
+        METRICS.snapshot().get("skew.spill_mode_replans", 0.0)
+        == spills0 + 1
+    )
+    assert rows == _mk_local(conn).execute(sql).rows
+
+
+def test_spill_replan_respects_min_rows_floor(monkeypatch):
+    """The same overflow below skew_spill_min_rows must NOT flip the
+    join to spill mode — tiny builds never benefit from grace
+    partitioning."""
+    from trino_tpu.adaptive import controller as ctl_mod
+
+    conn = MemoryConnector()
+    rng = np.random.default_rng(17)
+    conn.load_table(
+        "s", "facts",
+        [ColumnMetadata("k1", T.BIGINT), ColumnMetadata("v", T.BIGINT)],
+        [rng.integers(0, 40, 2000).astype(np.int64),
+         rng.integers(0, 100, 2000).astype(np.int64)],
+    )
+    conn.load_table(
+        "s", "d1",
+        [ColumnMetadata("k", T.BIGINT), ColumnMetadata("tag", T.BIGINT)],
+        [np.repeat(np.arange(40, dtype=np.int64), 20),
+         np.arange(800, dtype=np.int64)],
+    )
+    real = conn.metadata.get_table_statistics
+
+    def lying(handle):
+        ts = real(handle)
+        if handle.table == "d1" and ts.row_count is not None:
+            return dataclasses.replace(
+                ts, row_count=ts.row_count / 10.0, columns={}
+            )
+        return ts
+
+    conn.metadata.get_table_statistics = lying
+    monkeypatch.setattr(ctl_mod, "MAX_SPOOL_ROWS", 100)
+    sql = "select count(*) from facts f join d1 on f.k1 = d1.k"
+    SPOOL.clear()
+    r = _mk_local(
+        conn, adaptive_execution=True, adaptive_replan_threshold=2.0,
+        skew_spill_min_rows=1 << 18,  # the default floor: 800 << it
+    )
+    rows = r.execute(sql).rows
+    rep = r._last_adaptive_report
+    assert rep is not None and rep.spill_builds == 0
+    assert rows == _mk_local(conn).execute(sql).rows

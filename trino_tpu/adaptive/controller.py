@@ -37,7 +37,9 @@ import dataclasses
 from typing import Callable, List, Optional, Tuple
 
 from trino_tpu.adaptive.observer import (
+    divergence_ratio,
     estimated_vs_observed_line,
+    hot_keys,
     observe_rows,
     record_observation,
 )
@@ -58,6 +60,18 @@ MAX_REPLANS = 2
 
 
 @dataclasses.dataclass
+class _MatResult:
+    """One materialization attempt. `entry` is None on spool overflow,
+    in which case `overflow_rows` carries the observed row count."""
+
+    entry: Optional[object]
+    key: str
+    hit: bool
+    obs: Optional[object]  # observer.ObservedStats
+    overflow_rows: Optional[int]
+
+
+@dataclasses.dataclass
 class AdaptiveReport:
     """What the controller did to one query — rides into QueryInfo and
     the EXPLAIN ANALYZE `adaptive=` section."""
@@ -68,6 +82,12 @@ class AdaptiveReport:
     spool_stores: int = 0
     shared_subtrees: int = 0
     transformed: bool = False
+    # skew plane (ISSUE 16): heavy hitters classified at build-side
+    # barriers, joins annotated for salted repartition, joins re-planned
+    # into hybrid-hash spill mode after a build overflow
+    heavy_hitters: int = 0
+    salted_joins: int = 0
+    spill_builds: int = 0
 
     def as_dict(self) -> dict:
         return {
@@ -76,6 +96,9 @@ class AdaptiveReport:
             "spool_hits": self.spool_hits,
             "spool_stores": self.spool_stores,
             "shared_subtrees": self.shared_subtrees,
+            "heavy_hitters": self.heavy_hitters,
+            "salted_joins": self.salted_joins,
+            "spill_builds": self.spill_builds,
         }
 
     def lines(self) -> List[str]:
@@ -85,12 +108,29 @@ class AdaptiveReport:
             f"spool_stores={self.spool_stores} "
             f"shared_subtrees={self.shared_subtrees}"
         ]
+        # the skew line appears only when a skew action fired, so
+        # no-skew queries render byte-identically to before
+        if self.heavy_hitters or self.salted_joins or self.spill_builds:
+            out.append(
+                f"skew: heavy_hitters={self.heavy_hitters} "
+                f"salted_joins={self.salted_joins} "
+                f"spill_builds={self.spill_builds}"
+            )
         for o in self.observations:
+            suffix = ""
+            if o.get("salted"):
+                suffix += f" -> salted[{o['salted']}]"
+            if o.get("spill"):
+                suffix += " -> spill_build"
+            if o.get("replanned"):
+                suffix += " -> replanned"
+                if o.get("trigger") == "ndv":
+                    suffix += " (ndv)"
             out.append(
                 estimated_vs_observed_line(
                     o["site"], o["estimated"], o["observed"], o["ratio"]
                 )
-                + (" -> replanned" if o.get("replanned") else "")
+                + suffix
             )
         return out
 
@@ -131,19 +171,39 @@ class AdaptiveController:
             getattr(self.session, "adaptive_replan_threshold", 4.0) or 4.0
         )
 
+    @property
+    def _salting_on(self) -> bool:
+        return bool(getattr(self.session, "skewed_join_salting", False))
+
+    @property
+    def _hot_threshold(self) -> float:
+        return float(
+            getattr(self.session, "skew_hot_key_threshold", 0.2) or 0.2
+        )
+
+    @property
+    def _spill_min_rows(self) -> int:
+        return int(
+            getattr(self.session, "skew_spill_min_rows", 1 << 18) or 1 << 18
+        )
+
     def enabled(self) -> bool:
         return self._adaptive_on or self._shared_on
 
     # -- stats -------------------------------------------------------
-    def _estimate(self, node: P.PlanNode) -> float:
+    def _estimate_stats(self, node: P.PlanNode):
         from trino_tpu.sql.stats import StatsCalculator
 
         if self._stats_calc is None:
             self._stats_calc = StatsCalculator(self.catalogs)
         try:
-            return self._stats_calc.stats(node).row_count
+            return self._stats_calc.stats(node)
         except Exception:
-            return 1e9
+            return None
+
+    def _estimate(self, node: P.PlanNode) -> float:
+        st = self._estimate_stats(node)
+        return st.row_count if st is not None else 1e9
 
     def _check_preempt(self) -> None:
         if self.preempt is not None:
@@ -180,22 +240,38 @@ class AdaptiveController:
 
     def _materialize(
         self, node: P.PlanNode, key_channels=None
-    ) -> Optional[Tuple[object, str, bool]]:
-        """(spool entry, key, was_hit) — None when the subtree exceeds
-        the spool bound (it stays in the plan and runs as planned)."""
+    ) -> Optional["_MatResult"]:
+        """Materialize one subtree into the spool. entry is None when
+        the rows exceed the spool bound — the subtree stays in the plan
+        — but overflow_rows still reports the observed count, which is
+        exactly the DHHJ spill signal (the rows were computed either
+        way). Returns None only when nothing ran."""
         key = SPOOL.key(node)
         tables = subtree_tables(node)
         entry = SPOOL.get(key, tables)
         if entry is not None:
             self.report.spool_hits += 1
-            return entry, key, True
+            obs = getattr(entry, "obs", None)
+            if key_channels and (
+                obs is None
+                or any(ch not in obs.ndv for ch in key_channels)
+            ):
+                # entry stored by another consumer (or an older path)
+                # without this join's key channels — re-observe from the
+                # spooled rows so warm runs classify identically to cold
+                obs = observe_rows(entry.rows, channels=key_channels)
+            return _MatResult(entry, key, True, obs, None)
         rows = self._run_subtree(node)
-        if rows is None or len(rows) > MAX_SPOOL_ROWS:
+        if rows is None:
             return None
+        if len(rows) > MAX_SPOOL_ROWS:
+            return _MatResult(None, key, False, None, len(rows))
         obs = observe_rows(rows, channels=key_channels)
-        entry = SPOOL.put(key, rows, node.fields, obs.plan_stats(), tables)
+        entry = SPOOL.put(
+            key, rows, node.fields, obs.plan_stats(), tables, obs=obs
+        )
         self.report.spool_stores += 1
-        return entry, key, False
+        return _MatResult(entry, key, False, obs, None)
 
     # -- barrier selection -------------------------------------------
     def _next_barrier(
@@ -266,9 +342,9 @@ class AdaptiveController:
                         site=type(proto).__name__,
                     )
                 continue
-            if res is None:
+            if res is None or res.entry is None:
                 continue
-            entry, key, _hit = res
+            entry, key = res.entry, res.key
             site = f"shared:{type(proto).__name__}[x{len(nodes)}]"
             ratio = record_observation(
                 site, est, entry.stats.row_count, self._threshold,
@@ -293,6 +369,8 @@ class AdaptiveController:
         return root
 
     def _observe_barriers(self, root: P.PlanNode) -> P.PlanNode:
+        from trino_tpu.runtime.metrics import METRICS
+
         visited: set = set()
         replans = 0
         while True:
@@ -320,8 +398,50 @@ class AdaptiveController:
                 continue
             if res is None:
                 continue
-            entry, key, _hit = res
             site = f"build:{type(sub).__name__}"
+            if res.entry is None:
+                # spool overflow: the build side blew past the estimate
+                # hard enough that materializing it is off the table —
+                # the DHHJ signal. Annotate the join to pre-open grace
+                # partitions (hybrid hash) instead of letting the build
+                # thrash through memory revocation at run time.
+                observed = int(res.overflow_rows or 0)
+                ratio = record_observation(
+                    site, est, observed, self._threshold, span=self.span
+                )
+                obs = {
+                    "site": site,
+                    "estimated": est,
+                    "observed": observed,
+                    "ratio": ratio,
+                }
+                self.report.observations.append(obs)
+                if (
+                    ratio >= self._threshold
+                    and observed > self._spill_min_rows
+                    and not join.spill_build
+                    and replans < self.max_replans
+                ):
+                    root = substitute(
+                        root,
+                        {id(join): dataclasses.replace(
+                            join, spill_build=True
+                        )},
+                    )
+                    self.report.transformed = True
+                    self.report.spill_builds += 1
+                    replans += 1  # spill re-plan spends re-plan budget
+                    obs["spill"] = True
+                    METRICS.increment("skew.spill_mode_replans")
+                    if self.span is not None:
+                        self.span.event(
+                            "skew_spill_replan",
+                            site=site,
+                            observed_rows=observed,
+                            divergence=round(ratio, 3),
+                        )
+                continue
+            entry, key = res.entry, res.key
             ratio = record_observation(
                 site, est, entry.stats.row_count, self._threshold,
                 span=self.span,
@@ -333,28 +453,119 @@ class AdaptiveController:
                 "ratio": ratio,
             }
             self.report.observations.append(obs)
-            root = substitute(
-                root, {id(sub): spooled_node(entry, key, site)}
+            # NDV divergence (PR 13 carry-forward): a build side whose
+            # key NDV estimate is badly wrong flips build-side selection
+            # even when the row count held, so it triggers re-planning
+            # on its own — the spooled node's exact plan_stats then seed
+            # the re-optimization with observed NDV.
+            ndv_ratio = 1.0
+            est_stats = self._estimate_stats(sub)
+            if res.obs is not None and est_stats is not None:
+                for rk in join.right_keys:
+                    o_ndv = res.obs.ndv.get(rk)
+                    if not o_ndv:
+                        continue
+                    e_ndv = est_stats.col(rk).ndv
+                    if e_ndv is None:
+                        e_ndv = est_stats.row_count
+                    ndv_ratio = max(
+                        ndv_ratio, divergence_ratio(e_ndv, o_ndv)
+                    )
+            # heavy-hitter classification (JSPIM): the modal build keys
+            # against the session threshold, from OBSERVED stats
+            hot: Tuple = ()
+            if res.obs is not None and len(join.right_keys) == 1:
+                hot = hot_keys(
+                    res.obs, join.right_keys[0], self._hot_threshold
+                )
+            if hot:
+                self.report.heavy_hitters += len(hot)
+                METRICS.increment(
+                    "skew.heavy_hitters_detected", len(hot)
+                )
+                if self.span is not None:
+                    self.span.event(
+                        "skew_heavy_hitters",
+                        site=site,
+                        hot_keys=len(hot),
+                        modal_count=res.obs.heavy_hitter.get(
+                            join.right_keys[0], 0
+                        ),
+                        build_rows=entry.stats.row_count,
+                    )
+            salt = bool(
+                hot
+                and self._salting_on
+                and join.kind in ("inner", "left", "semi", "anti")
+                and len(join.right_keys) == 1
+                and not join.skew_hot_keys
             )
+            spooled = spooled_node(entry, key, site)
+            if salt:
+                root = substitute(
+                    root,
+                    {id(join): dataclasses.replace(
+                        join, right=spooled, skew_hot_keys=tuple(hot)
+                    )},
+                )
+                self.report.salted_joins += 1
+                obs["salted"] = len(hot)
+            else:
+                root = substitute(root, {id(sub): spooled})
             self.report.transformed = True
-            if ratio >= self._threshold and replans < self.max_replans:
+            trigger_ratio = max(ratio, ndv_ratio)
+            if trigger_ratio >= self._threshold and replans < self.max_replans:
                 self._check_preempt()
                 root = self._replan(root)
                 replans += 1
                 obs["replanned"] = True
+                if ratio < self._threshold <= ndv_ratio:
+                    obs["trigger"] = "ndv"
                 self.report.replans += 1
-                from trino_tpu.runtime.metrics import METRICS
-
                 METRICS.increment("adaptive.replans")
                 if self.span is not None:
                     self.span.event(
                         "adaptive_replan",
                         site=site,
                         divergence=round(ratio, 3),
+                        ndv_divergence=round(ndv_ratio, 3),
                         attempt=replans,
                     )
+                if salt:
+                    # re-optimization rebuilds join nodes from scratch;
+                    # re-seat the salting annotation on the join that
+                    # still builds from our spooled rows
+                    root = self._reannotate(root, key, tuple(hot))
             else:
                 # estimates held (or the budget is spent): stop paying
                 # the materialization toll
                 break
         return root
+
+    def _reannotate(
+        self, root: P.PlanNode, spool_key: str, hot: Tuple
+    ) -> P.PlanNode:
+        """Re-apply skew_hot_keys after a re-plan: find the join whose
+        build side is still the spooled node we classified. If the
+        re-optimizer flipped build sides the hot set describes the
+        wrong side — leave the join unannotated (correct, just not
+        salted)."""
+        replacements = {}
+
+        def walk(n):
+            for c in n.children():
+                walk(c)
+            if (
+                isinstance(n, P.JoinNode)
+                and n.kind in ("inner", "left", "semi", "anti")
+                and len(n.right_keys) == 1
+                and not n.skew_hot_keys
+                and isinstance(n.right, SpooledValuesNode)
+                and n.right.spool_key == spool_key
+            ):
+                replacements[id(n)] = dataclasses.replace(
+                    n, skew_hot_keys=hot
+                )
+
+        walk(root)
+        return substitute(root, replacements) if replacements else root

@@ -18,12 +18,23 @@ from typing import Dict, Optional, Sequence, Tuple
 
 from trino_tpu.sql.stats import ColStats, PlanStats
 
+# heavy-hitter candidates retained per channel: replicating more than a
+# handful of hot build keys approaches a broadcast join, which the
+# planner would have chosen outright if it were profitable
+MAX_HOT_KEYS = 4
+
 
 @dataclasses.dataclass
 class ObservedStats:
     rows: int
     ndv: Dict[int, int]  # channel -> distinct non-null values
     heavy_hitter: Dict[int, int]  # channel -> modal value count
+    # channel -> ((value, count), ...) for the top values, so the skew
+    # classifier can name WHICH keys are hot, not just how hot the
+    # modal one is
+    hot: Dict[int, Tuple[Tuple[object, int], ...]] = dataclasses.field(
+        default_factory=dict
+    )
 
     def plan_stats(self) -> PlanStats:
         """Exact PlanStats for re-optimization seeding (low/high ride
@@ -48,13 +59,33 @@ def observe_rows(
         channels = range(min(width, ndv_channel_cap))
     ndv: Dict[int, int] = {}
     hh: Dict[int, int] = {}
+    hot: Dict[int, Tuple[Tuple[object, int], ...]] = {}
     for ch in channels:
         if ch >= width:
             continue
         counts = Counter(r[ch] for r in rows if r[ch] is not None)
         ndv[ch] = len(counts)
         hh[ch] = max(counts.values()) if counts else 0
-    return ObservedStats(n, ndv, hh)
+        hot[ch] = tuple(counts.most_common(MAX_HOT_KEYS))
+    return ObservedStats(n, ndv, hh, hot)
+
+
+def hot_keys(
+    obs: ObservedStats, channel: int, threshold: float
+) -> Tuple[object, ...]:
+    """Heavy-hitter classification (the JSPIM skew test): key values
+    whose observed count is at least `threshold` of the rows. Hot keys
+    must be plain hashable scalars — integer join keys in practice —
+    because they are carried on the plan node and compared against the
+    key column at trace time."""
+    if obs.rows <= 0 or threshold <= 0.0:
+        return ()
+    return tuple(
+        v
+        for v, c in obs.hot.get(channel, ())
+        if c >= threshold * obs.rows and isinstance(v, int)
+        and not isinstance(v, bool)
+    )
 
 
 def divergence_ratio(estimated: float, observed: float) -> float:

@@ -130,6 +130,12 @@ class SpoolEntry:
     stats: object  # sql.stats.PlanStats
     tables: Tuple[Tuple[str, str, str], ...]
     generations: Tuple[int, ...]
+    # the full ObservedStats snapshot (None for entries stored by paths
+    # that never observed one, e.g. recovery stage teeing). Persisting
+    # it matters for skew: a WARM spool hit must re-classify the same
+    # heavy hitters the cold run saw, or the warm plan diverges from
+    # the cold one and mints new lowerings.
+    obs: Optional[object] = None
 
 
 class SubtreeSpool:
@@ -172,13 +178,15 @@ class SubtreeSpool:
             METRICS.increment("adaptive.spool_hits")
             return e
 
-    def put(self, key: str, rows, fields, stats, tables) -> SpoolEntry:
+    def put(self, key: str, rows, fields, stats, tables,
+            obs=None) -> SpoolEntry:
         e = SpoolEntry(
             rows=tuple(tuple(r) for r in rows),
             fields=tuple(fields),
             stats=stats,
             tables=tuple(tables),
             generations=self._generations(tables),
+            obs=obs,
         )
         with self._lock:
             self._entries[key] = e

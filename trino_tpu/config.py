@@ -216,6 +216,26 @@ for _name, _type, _default, _desc, _allowed in [
      "observation triggers re-planning of the remaining plan (and is "
      "counted in adaptive.divergences regardless of whether "
      "adaptive_execution is on)", None),
+    ("skewed_join_salting", bool, False,
+     "skew-aware join plane: when a build-side barrier's modal key "
+     "crosses skew_hot_key_threshold, annotate the join so the mesh "
+     "plane replicates hot build rows to every shard and salts hot "
+     "probe rows across the all_to_all (requires adaptive_execution)",
+     None),
+    ("skew_hot_key_threshold", float, 0.2,
+     "fraction of observed build rows a single key value must reach "
+     "to be classified a heavy hitter", None),
+    ("skew_spill_min_rows", int, 1 << 18,
+     "minimum observed build rows before a divergent build-side "
+     "barrier re-plans the join into hybrid-hash spill mode "
+     "(pre-opened grace partitions)", None),
+    ("mxu_join_enabled", bool, False,
+     "plan high-fanout equi-join + aggregation as the MXU matmul "
+     "join-project kernel (ops/mxu_join.py) when profitable", None),
+    ("mxu_join_min_work", float, 16.0,
+     "estimated fanout x build-NDV product at or above which the MXU "
+     "join-project kernel is selected over the padded-gather path",
+     None),
     ("shared_subtree_materialization", bool, False,
      "materialize identical subtrees (NOT IN rewrites plan the "
      "subquery twice; CTEs referenced twice) once into the "

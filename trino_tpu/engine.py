@@ -152,6 +152,15 @@ class Session:
     adaptive_execution: bool = False
     adaptive_replan_threshold: float = 4.0
     shared_subtree_materialization: bool = False
+    # skew-aware join plane (ISSUE 16): heavy-hitter classification at
+    # build-side barriers, salted repartition on the mesh plane, the
+    # DHHJ spill-mode re-plan floor, and the MXU matmul join-project
+    # kernel with its profitability threshold
+    skewed_join_salting: bool = False
+    skew_hot_key_threshold: float = 0.2
+    skew_spill_min_rows: int = 1 << 18
+    mxu_join_enabled: bool = False
+    mxu_join_min_work: float = 16.0
     # recovery tier (trino_tpu/recovery/): checkpoint the mesh step
     # loop's carries every N chunk boundaries (0 = off) so mesh faults
     # resume from the last checkpoint; bound in-run resume attempts;
@@ -1309,6 +1318,8 @@ class LocalQueryRunner:
                 target_splits=self.session.target_splits,
                 dynamic_filtering=self.session.enable_dynamic_filtering,
                 stabilizer=self._make_stabilizer(),
+                mxu_join=self.session.mxu_join_enabled,
+                mxu_join_min_work=self.session.mxu_join_min_work,
             )
             physical = planner.plan(output)
         # plans with analysis-time-folded volatile values (now(),

@@ -2466,7 +2466,7 @@ class HashBuildSink(Operator):
 
     def __init__(self, bridge: JoinBridge, key_channels: Sequence[int],
                  input_schema: Sequence[Tuple[T.DataType, Optional[Dictionary]]],
-                 memory_context=None):
+                 memory_context=None, force_spill: bool = False):
         self._bridge = bridge
         self._keys = list(key_channels)
         self._schema = list(input_schema)
@@ -2474,6 +2474,14 @@ class HashBuildSink(Operator):
         self._memory = memory_context
         self._grace = None
         self._state_lock = _threading.Lock()
+        if force_spill:
+            # adaptive spill-mode re-plan (skewed/oversized build): open
+            # the grace partitions up front instead of waiting for the
+            # pool's revocation callback — every batch partitions to
+            # disk on arrival and the device never holds the full build
+            from trino_tpu.exec.spill import GracePartitionSpill
+
+            self._grace = GracePartitionSpill(GRACE_PARTITIONS, self._keys)
         if self._memory is not None:
             self._memory.set_revoker(self._revoke_memory)
 
@@ -2581,6 +2589,119 @@ class HashBuildSink(Operator):
 
     def is_finished(self) -> bool:
         return self._finishing
+
+
+class MxuJoinAggOperator(Operator):
+    """Join-project-aggregate over the MXU (ops/mxu_join.py): consumes
+    probe pages of an inner single-key equi-join whose aggregate
+    arguments are all probe-side and whose group columns are all
+    build-side, and contracts each page against the one-hot key-id
+    indicator on the systolic array instead of expanding pairs.
+
+    Emits ONE partial page at finish — per build row, the summed probe
+    contributions of its key — which the planner feeds into an ordinary
+    HashAggregationOperator for the final grouping. The build side
+    arrives through the standard JoinBridge (the planner runs the build
+    pipeline to completion first); the planner constructs that sink
+    without a memory context, so the bridge never flips to grace mode
+    under this operator."""
+
+    def __init__(self, bridge: JoinBridge, key_channel: int, aggs,
+                 group_channels: Sequence[int]):
+        self._bridge = bridge
+        self._key = key_channel
+        # static layout for the kernel: agg kinds + probe arg channels
+        self._kinds = tuple(a.kind for a in aggs)
+        self._args = tuple(a.arg_channel for a in aggs)
+        self._groups = list(group_channels)
+        self._analysis = None
+        self._acc = None
+        self._outputs: List[RelBatch] = []
+
+    def _analyze(self):
+        from trino_tpu.ops import mxu_join as MJ
+
+        ls = self._bridge.lookup_source
+        build = self._bridge.build_batch
+        kc = self._bridge.build_key_channels[0]
+        col = build.columns[kc]
+        kid, kid_by_pos, distinct, n_distinct, hash_pure = (
+            MJ.build_key_analysis(
+                col.data, col.valid_mask(), build.live_mask(),
+                ls.sorted_hash, ls.perm,
+            )
+        )
+        # one host read at the build barrier: hash-collision purity
+        # decides the probe lookup path for the whole query
+        self._analysis = (
+            kid, kid_by_pos, distinct, n_distinct,
+            bool(jax.device_get(hash_pure)),
+        )
+
+    def add_input(self, probe: RelBatch) -> None:
+        from trino_tpu.ops import mxu_join as MJ
+
+        if self._analysis is None:
+            self._analyze()
+        _kid, kid_by_pos, distinct, n_distinct, hash_pure = self._analysis
+        kcol = probe.columns[self._key]
+        kv = kcol.valid_mask()
+        arg_data, arg_valid = [], []
+        for ch in self._args:
+            if ch is None:  # count_star placeholder, unread
+                arg_data.append(kcol.data)
+                arg_valid.append(kv)
+            else:
+                c = probe.columns[ch]
+                arg_data.append(c.data)
+                arg_valid.append(c.valid_mask())
+        capacity = self._bridge.build_batch.capacity
+        use_mxu = (
+            capacity <= MJ.MAX_CAPACITY and probe.capacity <= MJ.MAX_ROWS
+        )
+        sums = MJ.probe_page_sums(
+            self._bridge.lookup_source, kid_by_pos, distinct, n_distinct,
+            kcol.data, kv, probe.live_mask(),
+            tuple(arg_data), tuple(arg_valid), self._kinds, capacity,
+            use_mxu, jax.default_backend() != "tpu", hash_pure,
+        )
+        self._acc = (
+            list(sums)
+            if self._acc is None
+            else [a + s for a, s in zip(self._acc, sums)]
+        )
+
+    def finish(self) -> None:
+        from trino_tpu.ops import mxu_join as MJ
+
+        if self._finishing:
+            return
+        self._finishing = True
+        if self._analysis is None:
+            self._analyze()
+        kid = self._analysis[0]
+        build = self._bridge.build_batch
+        if self._acc is None:
+            # no probe pages arrived: zero accumulators, nothing matches
+            n_cols = sum(
+                2 if k == "sum" else (1 if k == "count" else 0)
+                for k in self._kinds
+            )
+            z = jnp.zeros(build.capacity, dtype=jnp.int64)
+            self._acc = [z] * (n_cols + 1)
+        live, outs = MJ.finalize_partials(
+            kid, build.live_mask(), tuple(self._acc), self._kinds
+        )
+        cols = [build.columns[ch] for ch in self._groups]
+        for data, valid in outs:
+            cols.append(Column(T.BIGINT, data, valid, None))
+        self._outputs.append(RelBatch(cols, live))
+
+    def get_output(self) -> Optional[RelBatch]:
+        return self._outputs.pop(0) if self._outputs else None
+
+    def is_finished(self) -> bool:
+        return self._finishing and not self._outputs
 
 
 @partial(jax.jit, static_argnames=("out_cap", "pkc", "bkc"))

@@ -1,0 +1,203 @@
+"""MXU matmul join-project kernel: aggregate an equi-join without
+expanding it.
+
+For the high-fanout shape
+
+    SELECT b.g..., SUM(p.x), COUNT(p.y), COUNT(*)
+    FROM probe p JOIN build b ON p.k = b.k
+    GROUP BY b.g...
+
+the gather-expansion join (ops/join.py) materializes |pairs| rows only
+for the aggregation to immediately reduce them — at fanout F the
+expansion writes F·|probe| rows of HBM traffic. Because every aggregate
+argument comes off the PROBE side and every group column off the BUILD
+side, the pair sum factors through the key:
+
+    result[g] = sum_j [j matched, group(j) = g] · S[kid(j)]
+    S[k]      = sum over probe rows i with key(i) = k of f(i)
+
+S is computed per probe page on the systolic array as a one-hot
+indicator contraction — the grouped_sum_mxu kernel (ops/mxu_groupby.py):
+limbs(values)[L, R] @ one_hot(kid)[C, R]^T with f32 accumulate, exact
+int64 via 8-bit limb planes — so the join-project is a matmul and no
+pair batch ever exists. The outer sum over build rows is a gather of
+S[kid(j)] (at most |build| rows); the ordinary HashAggregationOperator
+performs the final grouping, which brings exact group canonicalization,
+NULL group keys and dictionary columns for free.
+
+Key-id assignment is exact, not hash-trusting: build keys get dense ids
+by value (one two-operand sort). The probe→kid lookup normally rides
+the join plane's sorted-hash run machinery (two packed sorts, ~2ms/M)
+with a representative-key verify; when the build side contains a 32-bit
+hash collision between DISTINCT keys — detected once at the barrier by
+comparing distinct-hash and distinct-key counts — runs are no longer
+key-pure and the lookup falls back to an exact searchsorted over the
+sorted distinct keys. Past the Pallas capacity/row bounds
+(MAX_CAPACITY/MAX_ROWS) the contraction itself falls back to the XLA
+scatter segment-sum with identical semantics.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from trino_tpu.ops import join as J
+from trino_tpu.ops.gather import take_clip
+from trino_tpu.ops.mxu_groupby import (
+    MAX_CAPACITY,
+    MAX_ROWS,
+    grouped_sum_mxu,
+    grouped_sum_reference,
+)
+
+__all__ = [
+    "MAX_CAPACITY",
+    "MAX_ROWS",
+    "build_key_analysis",
+    "probe_page_sums",
+    "finalize_partials",
+]
+
+
+@jax.jit
+def build_key_analysis(key, valid, live, sorted_hash, perm):
+    """Dense key ids for the build side, plus the probe-lookup tables.
+
+    Returns (kid, kid_by_pos, distinct_keys, n_distinct, hash_pure):
+
+    - kid[j] in [0, n_distinct) for usable build rows (live, non-NULL
+      key); the batch capacity B for the rest (out-of-domain sentinel).
+      Ids are assigned in key-sorted order, so distinct_keys is sorted.
+    - kid_by_pos[p] = kid of the build row at sorted-hash position p
+      (LookupSource.perm order) — the hash-path probe reads its run's
+      first position here.
+    - distinct_keys[k] = the key value owning id k; tail slots hold the
+      dtype max so searchsorted order is preserved.
+    - hash_pure: every sorted-hash run contains exactly one distinct
+      key (no 32-bit collision between distinct build keys), i.e. the
+      hash-path lookup is exact after a representative-key verify.
+    """
+    B = key.shape[0]
+    usable = live & valid
+    dead = (~usable).astype(jnp.int32)
+    iota = jnp.arange(B, dtype=jnp.int32)
+    d_s, k_s, order = jax.lax.sort((dead, key, iota), num_keys=2)
+    us = d_s == 0  # usable rows sort first
+    same_prev = jnp.concatenate([
+        jnp.zeros(1, dtype=jnp.bool_),
+        (k_s[1:] == k_s[:-1]) & us[1:] & us[:-1],
+    ])
+    starts = us & ~same_prev
+    kid_s = jnp.cumsum(starts.astype(jnp.int32)) - 1
+    kid_s = jnp.where(us, kid_s, B)
+    kid = jnp.zeros(B, jnp.int32).at[order].set(kid_s)
+    n_distinct = jnp.sum(starts.astype(jnp.int32))
+    distinct_keys = jnp.full(B, jnp.iinfo(key.dtype).max, dtype=key.dtype)
+    distinct_keys = distinct_keys.at[kid_s].set(k_s, mode="drop")
+    kid_by_pos = take_clip(kid, perm)
+    # distinct real hashes == distinct keys <=> runs are key-pure
+    real = sorted_hash <= jnp.uint32(0xFFFFFFFD)
+    h_start = jnp.concatenate([
+        jnp.ones(1, dtype=jnp.bool_), sorted_hash[1:] != sorted_hash[:-1]
+    ])
+    n_hash = jnp.sum((real & h_start).astype(jnp.int32))
+    return kid, kid_by_pos, distinct_keys, n_distinct, n_hash == n_distinct
+
+
+@partial(
+    jax.jit,
+    static_argnames=("kinds", "capacity", "use_mxu", "interpret", "hash_path"),
+)
+def probe_page_sums(
+    ls,
+    kid_by_pos,
+    distinct_keys,
+    n_distinct,
+    probe_key,
+    probe_key_valid,
+    probe_live,
+    arg_data,
+    arg_valid,
+    kinds,
+    capacity: int,
+    use_mxu: bool,
+    interpret: bool,
+    hash_path: bool,
+):
+    """One probe page's per-key contraction.
+
+    `kinds` is the static aggregate layout; arg_data/arg_valid align
+    with it (placeholders for count_star). Per kind the value columns
+    are: sum -> (NULL-zeroed values, non-NULL indicator); count ->
+    (non-NULL indicator); count_star -> none (the kernel's appended
+    live-row count serves it). Returns the per-kid int64 sums in that
+    column order with the matched-row count last.
+    """
+    if hash_path:
+        lo, counts, _total = J.probe_counts(
+            ls, [probe_key], [probe_key_valid], probe_live
+        )
+        pos = jnp.clip(lo, 0, ls.perm.shape[0] - 1)
+        bi = take_clip(ls.perm, pos)
+        rep = take_clip(ls.key_cols[0], bi)
+        repv = take_clip(ls.key_valids[0], bi)
+        kid = take_clip(kid_by_pos, pos)
+        matched = (counts > 0) & (rep == probe_key) & repv
+    else:
+        pos = jnp.searchsorted(distinct_keys, probe_key).astype(jnp.int32)
+        kid = jnp.clip(pos, 0, distinct_keys.shape[0] - 1)
+        matched = (pos < n_distinct) & (
+            take_clip(distinct_keys, kid) == probe_key
+        )
+    matched = matched & probe_key_valid & probe_live
+    cols = []
+    for kind, d, v in zip(kinds, arg_data, arg_valid):
+        if kind == "sum":
+            cols.append(jnp.where(v, d.astype(jnp.int64), 0))
+            cols.append(v.astype(jnp.int64))
+        elif kind == "count":
+            cols.append(v.astype(jnp.int64))
+        # count_star rides the appended live-row count
+    gid = jnp.where(matched, kid, capacity)
+    if use_mxu:
+        return tuple(grouped_sum_mxu(
+            gid, tuple(cols), matched, capacity, interpret=interpret
+        ))
+    return tuple(grouped_sum_reference(gid, tuple(cols), matched, capacity))
+
+
+@partial(jax.jit, static_argnames=("kinds",))
+def finalize_partials(kid, build_live, sums, kinds):
+    """Expand the accumulated per-kid sums back onto build rows.
+
+    A build row is live iff it is usable (kid < capacity), its batch
+    row is live, and at least one probe row matched its key — an
+    unmatched build row contributes no pairs, so its group must not
+    exist unless another build row creates it. Returns
+    (live, [(data, valid), ...] per aggregate); SUM carries
+    valid = any non-NULL contribution (SQL: SUM over only NULLs is
+    NULL), COUNT/COUNT(*) are always valid.
+    """
+    capacity = sums[-1].shape[0]
+    kidc = jnp.clip(kid, 0, capacity - 1)
+    cnt = take_clip(sums[-1], kidc)
+    live = build_live & (kid < capacity) & (cnt > 0)
+    always = jnp.ones(kid.shape[0], dtype=jnp.bool_)
+    outs = []
+    i = 0
+    for kind in kinds:
+        if kind == "sum":
+            s = take_clip(sums[i], kidc)
+            nn = take_clip(sums[i + 1], kidc)
+            i += 2
+            outs.append((s, nn > 0))
+        elif kind == "count":
+            c = take_clip(sums[i], kidc)
+            i += 1
+            outs.append((c, always))
+        else:  # count_star
+            outs.append((cnt, always))
+    return live, outs

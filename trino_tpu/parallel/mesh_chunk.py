@@ -74,6 +74,8 @@ from trino_tpu.parallel.mesh_plan import (
     _FragVisitor,
     _local_partition,
     _replicate,
+    _salted_exchange_hash,
+    _salted_local_partition,
     shard_map,
 )
 
@@ -285,17 +287,128 @@ def build_chunk_plan(mesh_sps, root_child_ids, feeds, shard_caps, session):
     )
 
 
+# Join kinds whose per-probe-row verdict stays exact when hot build
+# rows are replicated to every shard and hot probe rows are salted off
+# their canonical shard. FULL and MARK need globally consistent
+# build-side placement, so they never salt.
+_SALTED_JOIN_KINDS = ("inner", "left", "semi", "anti")
+
+
+def _skew_exchange_map(mesh_sps, root_child_ids):
+    """{producer fid: ("build"|"probe", hot_values)} for every exchange
+    edge that should trace the salted repartition variant.
+
+    A JoinNode annotated with `skew_hot_keys` (adaptive controller,
+    heavy-hitter classification at the build barrier) qualifies only
+    when the plan shape guarantees salting changes nothing but row
+    routing:
+
+    - kind inner/left/semi/anti with a single integer-like equi key on
+      both sides (the classifier only emits plain-int hot values, and
+      `_hot_mask` must see a raw 1-D integer lane on BOTH sides or on
+      neither — one-sided degradation would reroute probes whose build
+      rows were never replicated);
+    - both join inputs are RemoteSourceNode leaves (an inline side has
+      no exchange to salt) and every producer fragment behind either
+      side emits a single-channel FIXED_HASH exchange (a broadcast
+      build is already fully replicated — nothing to fix);
+    - each producer fragment feeds exactly this consumer edge: another
+      consumer of the same exchange output would observe salted
+      placement while assuming canonical hash placement;
+    - above the join inside the consumer fragment only Filter/Project
+      and PARTIAL aggregations appear. Anything partition-reliant (a
+      single/final-step grouped aggregate riding the join key's
+      partitioning, another join) keeps canonical placement.
+
+    Probe-side salting is only correct when the hot build rows are
+    replicated, so the map is all-or-nothing per join: both sides
+    resolve, or neither is salted.
+    """
+    frag_by_id = {sp.fragment.id: sp.fragment for sp in mesh_sps}
+    ref_count: Dict[int, int] = {}
+
+    def count_refs(node):
+        if isinstance(node, P.RemoteSourceNode):
+            for fid in node.fragment_ids:
+                ref_count[fid] = ref_count.get(fid, 0) + 1
+        for c in node.children():
+            count_refs(c)
+
+    for sp in mesh_sps:
+        count_refs(sp.fragment.root)
+
+    out: Dict[int, Tuple[str, tuple]] = {}
+
+    def consider(join):
+        if join.kind not in _SALTED_JOIN_KINDS:
+            return
+        if len(join.left_keys) != 1 or len(join.right_keys) != 1:
+            return
+        for node, ch in ((join.left, join.left_keys[0]),
+                         (join.right, join.right_keys[0])):
+            t = node.fields[ch].type
+            if t.is_nested or t.lanes != 1 or not t.is_integerlike:
+                return
+        if not (
+            isinstance(join.left, P.RemoteSourceNode)
+            and isinstance(join.right, P.RemoteSourceNode)
+        ):
+            return
+        probe_fids = tuple(join.left.fragment_ids)
+        build_fids = tuple(join.right.fragment_ids)
+        for fid in probe_fids + build_fids:
+            frag = frag_by_id.get(fid)
+            if (
+                frag is None
+                or ref_count.get(fid, 0) != 1
+                or fid in root_child_ids
+                or fid in out
+                or frag.output_kind != "hash"
+                or len(frag.output_channels) != 1
+            ):
+                return
+        hot = tuple(join.skew_hot_keys)
+        for fid in build_fids:
+            out[fid] = ("build", hot)
+        for fid in probe_fids:
+            out[fid] = ("probe", hot)
+
+    def walk(node, clean):
+        if (
+            isinstance(node, P.JoinNode)
+            and getattr(node, "skew_hot_keys", ())
+            and clean
+        ):
+            consider(node)
+        kid_clean = clean and (
+            isinstance(node, (P.FilterNode, P.ProjectNode))
+            or (
+                isinstance(node, P.AggregateNode)
+                and node.step == "partial"
+            )
+        )
+        for c in node.children():
+            walk(c, kid_clean)
+
+    for sp in mesh_sps:
+        walk(sp.fragment.root, True)
+    return out
+
+
 def static_collective_counts(mesh_sps, root_child_ids, repl) -> Tuple[int, int]:
     """Structural collective census for one compiled pass over the plan:
     each non-replicated hash edge traces one all_to_all, each
     non-replicated broadcast/gather edge one all_gather, plus one
-    all_gather per EnforceSingleRow occurrence. Static (no execution),
-    so EXPLAIN surfaces stay deterministic under program-cache hits."""
+    all_gather per EnforceSingleRow occurrence and one per salted
+    non-replicated BUILD edge (hot build rows ride an all_gather on top
+    of the cold rows' all_to_all). Static (no execution), so EXPLAIN
+    surfaces stay deterministic under program-cache hits."""
 
     def count_sr(node) -> int:
         own = 1 if isinstance(node, P.EnforceSingleRowNode) else 0
         return own + sum(count_sr(c) for c in node.children())
 
+    skew = _skew_exchange_map(mesh_sps, root_child_ids)
     a2a = ag = 0
     for sp in mesh_sps:
         frag = sp.fragment
@@ -306,6 +419,8 @@ def static_collective_counts(mesh_sps, root_child_ids, repl) -> Tuple[int, int]:
             continue  # replicated producers exchange without collectives
         if frag.output_kind == "hash":
             a2a += 1
+            if skew.get(frag.id, ("", ()))[0] == "build":
+                ag += 1
         else:
             ag += 1
     return a2a, ag
@@ -549,13 +664,28 @@ def _build_record(ex, mesh_sps, root_child_ids, repl, feeds, feed_sds,
     carry_meta = tuple(carry_meta)
     carry_index = {fid: i for i, (_k, fid) in enumerate(carry_meta)}
 
+    skew_map = _skew_exchange_map(mesh_sps, root_child_ids)
+
     def emit_exchange(frag, batch, ctx):
         if frag.output_kind == "hash":
-            ctx[frag.id] = (
-                _local_partition(batch, frag.output_channels, n)
-                if repl[frag.id]
-                else _exchange_hash(batch, frag.output_channels, n)
-            )
+            sk = skew_map.get(frag.id)
+            if sk is not None:
+                role, hot = sk
+                ctx[frag.id] = (
+                    _salted_local_partition(
+                        batch, frag.output_channels, n, hot, role
+                    )
+                    if repl[frag.id]
+                    else _salted_exchange_hash(
+                        batch, frag.output_channels, n, hot, role
+                    )
+                )
+            else:
+                ctx[frag.id] = (
+                    _local_partition(batch, frag.output_channels, n)
+                    if repl[frag.id]
+                    else _exchange_hash(batch, frag.output_channels, n)
+                )
         else:  # broadcast, or gather consumed by another mesh fragment
             ctx[frag.id] = batch if repl[frag.id] else _replicate(batch)
 
@@ -790,6 +920,7 @@ class ChunkedMeshRunner:
         self.repl = repl
         self.feeds = feeds
         self.sharding = NamedSharding(ex.mesh, PSpec(AXIS))
+        self.skew_map = _skew_exchange_map(mesh_sps, root_child_ids)
         n = ex.n
         shard_caps = [b.capacity // n for b in host_feeds]
         self.cplan = build_chunk_plan(
@@ -936,6 +1067,12 @@ class ChunkedMeshRunner:
             if record.warmup_entries:
                 register_mesh_warmup(record.warmup_entries)
                 note_classes_warm(record.class_keys)
+            if self.skew_map:
+                from trino_tpu.runtime.metrics import METRICS
+
+                METRICS.increment(
+                    "skew.salted_exchanges", len(self.skew_map)
+                )
             stats = self._run_stats
             self.info = {
                 "chunked": self.cplan.chunked,
@@ -946,6 +1083,7 @@ class ChunkedMeshRunner:
                 "stream_fragments": sorted(self.cplan.stream_fids),
                 "flush_fragments": sorted(self.cplan.flush_fids),
                 "attempts": attempt + 1,
+                "salted_exchanges": len(self.skew_map),
                 "executed_chunk_steps": stats["executed_chunk_steps"],
                 "checkpoints": stats["checkpoints"],
                 "resumes": stats["resumes"],

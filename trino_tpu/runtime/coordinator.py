@@ -1085,6 +1085,25 @@ class DistributedQueryRunner:
             )
         return line
 
+    def _skew_line(self) -> str:
+        """The EXPLAIN ANALYZE skew-tier line: lifetime skew-plane
+        counters — how often observed stats flagged a hot build key,
+        how many exchange edges ran salted, MXU join-project
+        selections, and build-overflow spill-mode re-plans."""
+        from trino_tpu.runtime.metrics import METRICS
+
+        s = METRICS.snapshot()
+
+        def c(name):
+            return int(s.get(f"skew.{name}", 0.0))
+
+        return (
+            f"skew= heavy_hitters_detected={c('heavy_hitters_detected')} "
+            f"salted_exchanges={c('salted_exchanges')} "
+            f"mxu_join_selected={c('mxu_join_selected')} "
+            f"spill_mode_replans={c('spill_mode_replans')}"
+        )
+
     def _explain_text(self, subplan) -> str:
         """Fragment rendering with per-fragment compile-churn census
         annotations (expected_xla_lowerings — sql/validate.py)."""
@@ -1132,6 +1151,7 @@ class DistributedQueryRunner:
             lines.append(self._mesh_plane_line(subplan))
             lines.append(self._resident_line())
             lines.append(self._recovery_line())
+            lines.append(self._skew_line())
             return MaterializedResult(
                 [["\n".join(lines)]], ["Query Plan"], [T.VARCHAR]
             )

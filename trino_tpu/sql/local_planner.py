@@ -17,6 +17,7 @@ mutable state.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from trino_tpu import types as T
@@ -34,6 +35,7 @@ from trino_tpu.exec import (
     JoinBridge,
     LimitOperator,
     LookupJoinOperator,
+    MxuJoinAggOperator,
     Operator,
     Pipeline,
     SortOperator,
@@ -161,6 +163,8 @@ class LocalPlanner:
         scan_slice: Optional[Tuple[int, int]] = None,
         dynamic_filtering: bool = True,
         stabilizer=None,
+        mxu_join: bool = False,
+        mxu_join_min_work: float = 16.0,
     ):
         """`remote_schemas` maps producer fragment id -> output Schema
         (with dictionaries) for RemoteSourceNode leaves; `scan_slice`
@@ -176,6 +180,8 @@ class LocalPlanner:
         self.scan_slice = scan_slice
         self.dynamic_filtering = dynamic_filtering
         self.stabilizer = stabilizer
+        self.mxu_join = mxu_join
+        self.mxu_join_min_work = float(mxu_join_min_work)
         self.pipelines: List[List[Factory]] = []
         self._next_key = 0
         self._warmup_entries: List = []
@@ -440,6 +446,9 @@ class LocalPlanner:
         return chain, out_schema
 
     def _visit_AggregateNode(self, node: P.AggregateNode):
+        mxu = self._try_mxu_join_agg(node)
+        if mxu is not None:
+            return mxu
         chain, schema = self._visit(node.child)
         if any(a.distinct for a in node.aggs):
             return self._distinct_agg(node, chain, schema)
@@ -508,6 +517,134 @@ class LocalPlanner:
         )
         return chain, out_schema
 
+    def _try_mxu_join_agg(self, node: P.AggregateNode):
+        """MXU join-project selection (ops/mxu_join.py): a single-step
+        grouped aggregate directly over an inner single-integer-key
+        equi-join, all group columns build-side, all aggregate
+        arguments probe-side (or COUNT(*)), kinds in sum/count — the
+        shape where the pair sum factors through the key and the join
+        never needs to expand. Returns (chain, schema) when selected,
+        None to fall through to the standard agg-over-join plan."""
+        if not self.mxu_join:
+            return None
+        # the column pruner routinely leaves an identity Project
+        # (pure channel references) between the aggregate and the
+        # join — look through it, composing the channel map
+        join = node.child
+        cmap: Optional[List[int]] = None
+        while isinstance(join, P.ProjectNode) and all(
+            isinstance(e, InputRef) for e in join.exprs
+        ):
+            m = [e.index for e in join.exprs]
+            cmap = m if cmap is None else [m[c] for c in cmap]
+            join = join.child
+        if not isinstance(join, P.JoinNode):
+            return None
+
+        def tr(ch: int) -> int:
+            return cmap[ch] if cmap is not None else ch
+
+        if (
+            join.kind != "inner"
+            or join.residual is not None
+            or len(join.left_keys) != 1
+            or len(join.right_keys) != 1
+            or getattr(join, "spill_build", False)
+        ):
+            return None
+        if node.step != "single" or not node.group_channels or not node.aggs:
+            return None
+        probe_width = len(join.left.fields)
+        if any(tr(ch) < probe_width for ch in node.group_channels):
+            return None
+        for side, ch in ((join.left, join.left_keys[0]),
+                         (join.right, join.right_keys[0])):
+            t = side.fields[ch].type
+            if t.is_nested or t.lanes != 1 or not t.is_integerlike:
+                return None
+        for a in node.aggs:
+            if a.kind not in ("sum", "count", "count_star") or a.distinct:
+                return None
+            if (
+                a.arg2_channel is not None or a.arg3_channel is not None
+                or a.post is not None or a.out_type != T.BIGINT
+            ):
+                return None
+            if a.kind == "count_star":
+                if a.arg_channel is not None:
+                    return None
+                continue
+            if a.arg_channel is None or tr(a.arg_channel) >= probe_width:
+                return None
+            at = join.left.fields[tr(a.arg_channel)].type
+            if at.is_nested or at.lanes != 1 or not at.is_integerlike:
+                return None
+        # work gate: expected pairs per probe row (fanout) x build key
+        # NDV must clear the threshold — below it the expansion is
+        # cheap and the standard join keeps its dynamic-filter and
+        # warmup advantages
+        try:
+            if self._stats_calc is None:
+                from trino_tpu.sql.stats import StatsCalculator
+
+                self._stats_calc = StatsCalculator(self.catalogs)
+            bs = self._stats_calc.stats(join.right)
+            rows = float(bs.row_count or 0.0)
+            ndv = float(bs.col(join.right_keys[0]).ndv or rows)
+            fanout = rows / max(ndv, 1.0)
+            if fanout * ndv < self.mxu_join_min_work:
+                return None
+        except Exception:
+            return None
+
+        build_chain, build_schema = self._visit(join.right)
+        probe_chain, probe_schema = self._visit(join.left)
+        key = self._key()
+
+        def bridge_of(ctx) -> JoinBridge:
+            return ctx.setdefault(key, JoinBridge())
+
+        rkeys = [join.right_keys[0]]
+        # no memory context: this path has no grace-mode probe, so the
+        # build sink must never flip to spill under pool pressure
+        build_chain.append(
+            lambda ctx: HashBuildSink(bridge_of(ctx), rkeys, build_schema)
+        )
+        self.pipelines.append(build_chain)
+        lkey = join.left_keys[0]
+        aggs = [
+            dataclasses.replace(a, arg_channel=tr(a.arg_channel))
+            if a.arg_channel is not None else a
+            for a in node.aggs
+        ]
+        groups_b = [tr(ch) - probe_width for ch in node.group_channels]
+        probe_chain.append(
+            lambda ctx: MxuJoinAggOperator(bridge_of(ctx), lkey, aggs, groups_b)
+        )
+        # final grouping over the per-build-row partials: SUM of each
+        # partial column (NULL partials drop out, so SUM-over-only-NULLs
+        # is NULL and COUNT partials — always valid — total exactly)
+        g = len(groups_b)
+        partial_schema: Schema = [build_schema[ch] for ch in groups_b] + [
+            (a.out_type, None) for a in aggs
+        ]
+        specs = [
+            AggSpec("sum", g + i, a.out_type) for i, a in enumerate(aggs)
+        ]
+        probe_chain.append(
+            lambda ctx: HashAggregationOperator(
+                list(range(g)), specs, partial_schema,
+                memory_context=_mem_ctx(ctx),
+            )
+        )
+        from trino_tpu.runtime.metrics import METRICS
+
+        METRICS.increment("skew.mxu_join_selected")
+        out_schema: Schema = partial_schema[:g] + [
+            (a.out_type, None) for a in aggs
+        ]
+        return probe_chain, out_schema
+
     def _distinct_agg(self, node: P.AggregateNode, chain, schema: Schema):
         """DISTINCT aggregates via dedup-then-aggregate (the
         MarkDistinct/MultipleDistinctAggregationToMarkDistinct analogue,
@@ -557,10 +694,13 @@ class LocalPlanner:
             probe_chain.append(lambda ctx: CrossJoinOperator(bridge_of(ctx)))
             return probe_chain, probe_schema + build_schema
         rkeys = list(node.right_keys)
+        # adaptive spill-mode annotation (skewed/oversized build side):
+        # grace partitions open before the first batch arrives
+        force_spill = bool(getattr(node, "spill_build", False))
         build_chain.append(
             lambda ctx: HashBuildSink(
                 bridge_of(ctx), rkeys, build_schema,
-                memory_context=_mem_ctx(ctx),
+                memory_context=_mem_ctx(ctx), force_spill=force_spill,
             )
         )
         self.pipelines.append(build_chain)

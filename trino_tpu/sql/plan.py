@@ -124,6 +124,19 @@ class JoinNode(PlanNode):
     right_keys: Tuple[int, ...]
     residual: Optional[Expr]
     fields: Tuple[Field, ...]
+    # skew-aware execution annotations (adaptive/controller.py). Both
+    # are declared fields, so they ride through dataclasses.replace and
+    # appear in the repr — which is what plan fingerprints, spool keys
+    # and the mesh program-cache key hash, keeping annotated and plain
+    # plans distinct without any explicit key plumbing.
+    #
+    # skew_hot_keys: observed heavy-hitter values of the (single) join
+    # key; the mesh plane replicates hot BUILD rows to every shard and
+    # salts hot PROBE rows across the all_to_all. spill_build: observed
+    # build rows overflowed the estimate — the local planner pre-opens
+    # grace partitions (hybrid hash) instead of thrashing revocation.
+    skew_hot_keys: Tuple = ()
+    spill_build: bool = False
 
     def children(self):
         return (self.left, self.right)
@@ -353,6 +366,12 @@ def explain_text(node: PlanNode, indent: int = 0) -> str:
             f" {node.kind} L{list(node.left_keys)}=R{list(node.right_keys)}"
             + (" +residual" if node.residual is not None else "")
         )
+        # skew annotations render only when present, so plans with no
+        # skew stay byte-identical to the unannotated output
+        if node.skew_hot_keys:
+            detail += f" hot={list(node.skew_hot_keys)}"
+        if node.spill_build:
+            detail += " spill_build"
     elif isinstance(node, (SortNode, TopNNode)):
         detail = f" keys={[(k.channel, 'desc' if k.descending else 'asc') for k in node.keys]}"
         if isinstance(node, TopNNode):
