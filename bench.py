@@ -832,11 +832,20 @@ def _chaos_smoke(argv) -> int:
         seed = int(argv[i + 1])
     except (IndexError, ValueError):
         seed = 42
+    # the replica scenarios carve 2 sub-meshes from the device set —
+    # make sure the host platform exposes enough devices before any
+    # backend initializes (a real accelerator platform ignores this)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
     from trino_tpu.runtime.chaos import (
         ADAPTIVE_CLASSES,
         FAULT_CLASSES,
         LIFECYCLE_CLASSES,
         RECOVERY_CLASSES,
+        REPLICA_CLASSES,
         SERVING_CLASSES,
         TIMEBOUND_CLASSES,
         chaos_smoke,
@@ -848,7 +857,8 @@ def _chaos_smoke(argv) -> int:
           f"timebound={','.join(TIMEBOUND_CLASSES)} "
           f"serving={','.join(SERVING_CLASSES)} "
           f"adaptive={','.join(ADAPTIVE_CLASSES)} "
-          f"recovery={','.join(RECOVERY_CLASSES)},recovery_loaded_drain")
+          f"recovery={','.join(RECOVERY_CLASSES)},recovery_loaded_drain "
+          f"replica={','.join(REPLICA_CLASSES)}")
     t0 = time.time()
     violations = chaos_smoke(seed, CHAOS_QUERIES)
     wall = time.time() - t0
@@ -860,7 +870,7 @@ def _chaos_smoke(argv) -> int:
             "cases": len(CHAOS_QUERIES) * len(FAULT_CLASSES)
             + len(LIFECYCLE_CLASSES) + len(TIMEBOUND_CLASSES)
             + len(SERVING_CLASSES) + len(ADAPTIVE_CLASSES)
-            + len(RECOVERY_CLASSES) + 1,
+            + len(RECOVERY_CLASSES) + 1 + len(REPLICA_CLASSES),
             "violations": len(violations),
             "wall_s": round(wall, 2),
         }
@@ -917,7 +927,14 @@ def _serve_smoke(argv) -> int:
 def _serve(argv) -> int:
     """--serve: tunable open-loop load run (no gates, just the report).
     Knobs: --serve-clients N --serve-duration S --serve-rate QPS
-    --serve-util U --serve-window MS --serve-seed N."""
+    --serve-util U --serve-window MS --serve-seed N.
+    --serve-replicas 1,2,4 switches to the replica sweep: the same
+    mixed workload is offered at a FIXED rate (derived once, from the
+    first arm) to a replicated mesh runner per arm, reporting QPS and
+    p50/p99 per replica count — and gating that QPS does not degrade
+    as replicas are added, no arm sheds, and tail bounds hold."""
+    if _serve_flag(argv, "--serve-replicas", None, str) is not None:
+        return _serve_replica_sweep(argv)
     from trino_tpu.serving.harness import run_serve_load
 
     report = run_serve_load(
@@ -931,6 +948,139 @@ def _serve(argv) -> int:
     )
     print(json.dumps({"serve": report}))
     return 0
+
+
+def _serve_replica_sweep(argv) -> int:
+    """--serve --serve-replicas 1,2,4: the PR 8 mixed workload against
+    a replicated mesh serving plane, one arm per replica count. Each
+    arm builds a distributed runner whose mesh is carved into R
+    sub-meshes; every replica is warmed before the measured phase
+    (warmup_rounds=R) and all arms share ONE offered rate, derived from
+    the first arm's warm service times, so per-arm QPS and percentiles
+    are comparable. Replicas are the mesh plane's units of serving
+    concurrency (one program per sub-mesh at a time), so QPS must not
+    DEGRADE as replicas are added while the offered load holds. Exit 1
+    if any arm sheds, mismatches, errors, compiles after warmup, drops
+    QPS below the 1-replica arm by more than 10%, or blows the tail
+    bound (p99 <= 8x p50)."""
+    if os.environ.get("SERVE_SWEEP_INNER") != "1":
+        env = dict(os.environ)
+        env["SERVE_SWEEP_INNER"] = "1"
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        return subprocess.run(
+            [sys.executable, os.path.abspath(__file__)] + argv[1:],
+            env=env,
+        ).returncode
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from trino_tpu.connectors.tpch import create_tpch_connector
+    from trino_tpu.engine import Session
+    from trino_tpu.runtime import DistributedQueryRunner
+    from trino_tpu.runtime.metrics import install_xla_compile_listener
+    from trino_tpu.serving.harness import run_serve_load
+
+    install_xla_compile_listener()
+    arms_spec = _serve_flag(argv, "--serve-replicas", "1,2,4", str)
+    arm_replicas = [int(x) for x in arms_spec.split(",") if x.strip()]
+    n_clients = int(_serve_flag(argv, "--serve-clients", 8, int))
+    duration_s = _serve_flag(argv, "--serve-duration", 6.0)
+    seed = int(_serve_flag(argv, "--serve-seed", 7, int))
+    n_dev = len(jax.devices())
+    print(f"bench: serve replica sweep arms={arm_replicas} "
+          f"({n_dev}-device cpu mesh, clients={n_clients}, "
+          f"duration={duration_s:g}s)")
+
+    def mk(n_replicas: int):
+        r = DistributedQueryRunner(
+            Session(
+                catalog="tpch", schema="tiny",
+                mesh_replicas=n_replicas,
+                mesh_chunk_rows=512,
+                mesh_checkpoint_interval_chunks=4,
+            ),
+            n_workers=2, hash_partitions=2,
+        )
+        r.register_catalog("tpch", create_tpch_connector())
+        return r
+
+    violations = []
+    arms = {}
+    rate = _serve_flag(argv, "--serve-rate", None)
+    for n_replicas in arm_replicas:
+        runner = mk(n_replicas)
+        report = run_serve_load(
+            queries=SERVE_QUERIES,
+            n_clients=n_clients,
+            duration_s=duration_s,
+            rate_qps=rate,
+            utilization=_serve_flag(argv, "--serve-util", 0.9),
+            batch_phase_s=0.0,
+            seed=seed,
+            runner=runner,
+            warmup_rounds=max(1, n_replicas),
+        )
+        # all arms offer the SAME load: reuse the first arm's derived
+        # rate so the sweep compares service capacity, not schedules
+        rate = report["rate_qps"]
+        rm = getattr(runner, "_replicas", None)
+        arms[n_replicas] = {
+            k: report[k]
+            for k in ("rate_qps", "offered", "completed", "qps",
+                      "p50_ms", "p95_ms", "p99_ms", "p99_over_p50",
+                      "shed", "mismatches", "error_count",
+                      "plan_cache_hit_rate", "xla_compiles_after_warmup")
+        }
+        arms[n_replicas]["replica_stats"] = rm.stats() if rm else None
+        if report["mismatches"]:
+            violations.append(
+                f"arm r={n_replicas}: {report['mismatches']} results "
+                "diverged from the oracle"
+            )
+        if report["error_count"]:
+            violations.append(
+                f"arm r={n_replicas}: {report['error_count']} errors "
+                f"(first: {report['errors'][:1]})"
+            )
+        if report["shed"]:
+            violations.append(
+                f"arm r={n_replicas}: {report['shed']} sheds under the "
+                "shared offered rate"
+            )
+        if report["xla_compiles_after_warmup"]:
+            violations.append(
+                f"arm r={n_replicas}: "
+                f"{report['xla_compiles_after_warmup']} XLA lowerings "
+                "in the measured phase (warmup_rounds missed a replica)"
+            )
+        if report["p99_over_p50"] > 8.0:
+            violations.append(
+                f"arm r={n_replicas}: p99/p50 = "
+                f"{report['p99_over_p50']} blows the 8x tail bound"
+            )
+    base_qps = arms[arm_replicas[0]]["qps"]
+    for n_replicas in arm_replicas[1:]:
+        if arms[n_replicas]["qps"] < 0.90 * base_qps:
+            violations.append(
+                f"arm r={n_replicas}: qps {arms[n_replicas]['qps']} "
+                f"degraded >10% below the 1-replica arm ({base_qps})"
+            )
+    for v in violations:
+        print(f"bench: serve-sweep VIOLATION: {v}", file=sys.stderr)
+    print(json.dumps({
+        "serve_replica_sweep": {
+            "devices": n_dev,
+            "arms": {str(k): v for k, v in arms.items()},
+            "violations": len(violations),
+        }
+    }))
+    return 1 if violations else 0
 
 
 def _parse_compile_lines(text: str) -> dict:
@@ -1753,6 +1903,265 @@ def _recovery_smoke(argv) -> int:
     return 1 if violations else 0
 
 
+def _failover_smoke(argv) -> int:
+    """--failover-smoke: CI gate for the replicated serving plane
+    (trino_tpu/runtime/replicas.py). Two replicas are carved from an
+    8-device CPU mesh; an injected device loss hard-kills whichever
+    replica serves the query at chunk 3K/4, twice: the RESTART arm runs
+    with checkpointing off — the sibling sub-mesh takes the query over
+    but must recompute from chunk 0 — and the RESUME arm runs with
+    chunk checkpointing on, so the sibling restores the host-portable
+    checkpoint and continues from chunk k. Gates: both arms
+    oracle-equal and ON the mesh plane (failover, not page fallback),
+    exactly one failover each, the resume arm re-executes fewer chunks
+    than the restart arm recomputes, beats its wall, mints zero new XLA
+    lowerings (the sibling is warm), and a deadline expiring during the
+    failed-over stretch still kills typed, naming the resume point and
+    replica. Exit 1 on violation."""
+    if os.environ.get("FAILOVER_SMOKE_INNER") != "1":
+        # same clean-slate re-exec as --recovery-smoke: the multi-device
+        # host platform must be configured before jax initializes
+        env = dict(os.environ)
+        env["FAILOVER_SMOKE_INNER"] = "1"
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        return subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--failover-smoke"],
+            env=env,
+        ).returncode
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    n_dev = len(jax.devices())
+
+    from trino_tpu.connectors.tpch import create_tpch_connector
+    from trino_tpu.engine import Session
+    from trino_tpu.parallel import mesh_chunk
+    from trino_tpu.parallel.mesh_chunk import LAST_RUN_INFO, MeshDeviceLost
+    from trino_tpu.recovery import CHECKPOINTS
+    from trino_tpu.runtime import DistributedQueryRunner
+    from trino_tpu.runtime.metrics import METRICS
+    from trino_tpu.runtime.query_tracker import ExceededTimeLimitError
+
+    def mk(**session_kw):
+        r = DistributedQueryRunner(
+            Session(
+                catalog="tpch", schema="tiny", mesh_replicas=2,
+                mesh_chunk_rows=256, mesh_resume_attempts=0,
+                **session_kw,
+            ),
+            n_workers=2, hash_partitions=2,
+        )
+        r.register_catalog("tpch", create_tpch_connector())
+        return r
+
+    violations = []
+    print(f"bench: failover smoke ({n_dev}-device cpu mesh, 2 replicas, "
+          "q72-class join, tpch tiny)")
+    page = mk(mesh_execution=False)
+    oracle = page.execute(RECOVERY_Q).rows
+
+    def warm(runner) -> int:
+        """Warm BOTH replicas (sequential placements round-robin) and
+        learn K; returns the chunk count of the warm run."""
+        for _ in range(2):
+            rows = runner.execute(RECOVERY_Q).rows
+            if rows != oracle:
+                violations.append("warm replicated run != page oracle")
+            if runner._last_data_plane != "mesh":
+                violations.append(
+                    f"warm run took {runner._last_data_plane}, not the "
+                    f"mesh (fallback: {runner.last_mesh_fallback})"
+                )
+        return int(LAST_RUN_INFO.get("chunks") or 0)
+
+    def make_kill_hook(fault_k):
+        """Kill whichever replica serves the run's first chunk — the
+        victim is discovered, not hardcoded, so placement order cannot
+        unseat the fault. Persistent: a hard-killed replica stays dead
+        for the rest of the arm."""
+        state = {"victim": None, "fired": 0}
+
+        def hook(k, Ktot):
+            rep = mesh_chunk.active_replica()
+            if rep is None:
+                return
+            if state["victim"] is None:
+                state["victim"] = rep
+            if rep == state["victim"] and k >= fault_k:
+                state["fired"] += 1
+                raise MeshDeviceLost(
+                    f"failover smoke: replica {rep} hard-killed at "
+                    f"chunk {k}/{Ktot}"
+                )
+
+        return hook, state
+
+    def run_arm(runner, fault_k):
+        hook, st = make_kill_hook(fault_k)
+        steps0 = METRICS.counter("mesh.chunk_steps")
+        compiles0 = METRICS.counter("xla_compiles")
+        mesh_chunk.MESH_FAULT_HOOK = hook
+        t0 = time.time()
+        try:
+            rows = runner.execute(RECOVERY_Q).rows
+        finally:
+            mesh_chunk.MESH_FAULT_HOOK = None
+        return {
+            "rows": rows,
+            "wall": time.time() - t0,
+            "fired": st["fired"],
+            "victim": st["victim"],
+            "steps": int(METRICS.counter("mesh.chunk_steps") - steps0),
+            "lowerings": int(METRICS.counter("xla_compiles") - compiles0),
+            "plane": runner._last_data_plane,
+            "info": dict(LAST_RUN_INFO),
+            "rm": runner._replicas.stats() if runner._replicas else {},
+        }
+
+    # RESTART arm: no checkpoints — failover lands the sibling at chunk 0
+    restart = mk()
+    K = warm(restart)
+    fault_k = max(1, (3 * K) // 4)
+    a_restart = run_arm(restart, fault_k)
+    # the victim executed chunks [0, fault_k), the sibling all K: the
+    # failover recomputed everything the kill discarded
+    re_restart = a_restart["steps"] - K
+    if a_restart["rows"] != oracle:
+        violations.append("restart arm diverged from the oracle")
+    if not a_restart["fired"]:
+        violations.append("restart arm: kill never fired")
+    elif a_restart["plane"] != "mesh":
+        violations.append(
+            f"restart arm left the mesh plane ({a_restart['plane']}: "
+            f"{restart.last_mesh_fallback})"
+        )
+    elif a_restart["rm"].get("failovers") != 1:
+        violations.append(
+            f"restart arm: expected exactly 1 failover "
+            f"({a_restart['rm']})"
+        )
+
+    # RESUME arm: same kill, checkpoint every 4 chunks — the sibling
+    # restores the host-portable checkpoint instead of starting over
+    resume = mk(mesh_checkpoint_interval_chunks=4)
+    warm(resume)
+    a_resume = run_arm(resume, fault_k)
+    re_resume = a_resume["steps"] - K
+    info = a_resume["info"]
+    if a_resume["rows"] != oracle:
+        violations.append("resume arm diverged from the oracle")
+    if not a_resume["fired"]:
+        violations.append("resume arm: kill never fired")
+    elif a_resume["plane"] != "mesh":
+        violations.append(
+            f"resume arm left the mesh plane ({a_resume['plane']}: "
+            f"{resume.last_mesh_fallback})"
+        )
+    elif not info.get("resumes"):
+        violations.append(
+            f"resume arm: sibling never restored the checkpoint ({info})"
+        )
+    elif a_resume["rm"].get("failovers") != 1:
+        violations.append(
+            f"resume arm: expected exactly 1 failover ({a_resume['rm']})"
+        )
+    if re_resume >= max(re_restart, 1):
+        violations.append(
+            f"resume arm re-executed {re_resume} chunks — the restart "
+            f"arm recomputed {re_restart}; the checkpoint saved nothing"
+        )
+    if a_resume["wall"] >= a_restart["wall"]:
+        violations.append(
+            f"resume wall {a_resume['wall']:.2f}s did not beat the "
+            f"restart-from-zero wall {a_restart['wall']:.2f}s"
+        )
+    if a_resume["lowerings"] > 0:
+        violations.append(
+            f"failover lowered {a_resume['lowerings']} new XLA programs "
+            "on the sibling (expected 0: both replicas are warm)"
+        )
+
+    # DEADLINE arm: the execution-time limit expires while the sibling
+    # is working through the failed-over stretch — the kill must stay
+    # typed and name where the run restarted. The hook stalls the
+    # sibling (not the victim) past the deadline once a resume has been
+    # recorded, so expiry deterministically lands mid-failed-over-chunk.
+    deadline_s = 8.0
+    resume.session.set_property(
+        "query_max_execution_time_s", str(deadline_s)
+    )
+    hook, st = make_kill_hook(fault_k)
+    resumed0 = CHECKPOINTS.resumed
+    t_arm = [None]
+
+    def deadline_hook(k, Ktot):
+        hook(k, Ktot)
+        rep = mesh_chunk.active_replica()
+        if (
+            rep is not None and st["victim"] is not None
+            and rep != st["victim"] and k >= fault_k
+            and CHECKPOINTS.resumed > resumed0
+        ):
+            stall = (t_arm[0] + deadline_s + 0.5) - time.time()
+            if stall > 0:
+                time.sleep(stall)
+
+    deadline_err = None
+    mesh_chunk.MESH_FAULT_HOOK = deadline_hook
+    t_arm[0] = time.time()
+    try:
+        resume.execute(RECOVERY_Q)
+        violations.append(
+            "deadline arm: query outlived its execution-time limit"
+        )
+    except ExceededTimeLimitError as e:
+        deadline_err = str(e)
+    except Exception as e:
+        violations.append(
+            f"deadline arm: untyped kill {type(e).__name__}: {e}"
+        )
+    finally:
+        mesh_chunk.MESH_FAULT_HOOK = None
+        resume.session.set_property("query_max_execution_time_s", "0")
+    if deadline_err is not None:
+        if "[EXCEEDED_TIME_LIMIT]" not in deadline_err:
+            violations.append(
+                f"deadline arm: kill lost its code ({deadline_err})"
+            )
+        if "resumed from chunk" not in deadline_err \
+                or "on replica" not in deadline_err:
+            violations.append(
+                f"deadline arm: kill does not name the resume point "
+                f"({deadline_err})"
+            )
+
+    for v in violations:
+        print(f"bench: failover VIOLATION: {v}", file=sys.stderr)
+    print(json.dumps({
+        "failover_smoke": {
+            "devices": n_dev,
+            "replicas": 2,
+            "chunks": K,
+            "fault_chunk": fault_k,
+            "resumed_from_chunk": info.get("resumed_from_chunk"),
+            "re_executed_restart": re_restart,
+            "re_executed_resume": re_resume,
+            "restart_wall_s": round(a_restart["wall"], 3),
+            "resume_wall_s": round(a_resume["wall"], 3),
+            "new_lowerings_on_failover": a_resume["lowerings"],
+            "deadline_error": (deadline_err or "")[-120:],
+            "violations": len(violations),
+        }
+    }))
+    return 1 if violations else 0
+
+
 def _zipf_keys(rng, n: int, n_keys: int, s: float):
     """Seedable zipf-distributed join keys in [0, n_keys): key rank r
     drawn with probability proportional to 1/(r+1)^s. At s=1.4 over 64
@@ -2132,6 +2541,8 @@ def main() -> None:
         sys.exit(_adaptive_smoke(sys.argv))
     if "--recovery-smoke" in sys.argv:
         sys.exit(_recovery_smoke(sys.argv))
+    if "--failover-smoke" in sys.argv:
+        sys.exit(_failover_smoke(sys.argv))
     if "--skew-smoke" in sys.argv:
         sys.exit(_skew_smoke(sys.argv))
     if "--validate-corpus" in sys.argv:

@@ -404,6 +404,7 @@ def corpus_07_distributed_analyze():
         text = re.sub(r"\b(wall|cpu)=\d+(\.\d+)?ms", r"\1=#ms", text)
         text = re.sub(r"\b(add|get|finish)=\d+(\.\d+)?", r"\1=#", text)
         text = re.sub(r"\btask q\d+\.", "task q#.", text)
+        text = re.sub(r"replicas= .*", "replicas= #", text)
         # process-global resident/recovery-tier counters depend on what
         # ran before this corpus fn — corpora 09 and 11 pin the real
         # numbers
@@ -455,6 +456,7 @@ def corpus_08_mesh_analyze():
         text = re.sub(r"\b(wall|cpu)=\d+(\.\d+)?ms", r"\1=#ms", text)
         text = re.sub(r"\b(add|get|finish)=\d+(\.\d+)?", r"\1=#", text)
         text = re.sub(r"\btask q\d+\.", "task q#.", text)
+        text = re.sub(r"replicas= .*", "replicas= #", text)
         text = re.sub(r"resident= .*", "resident= #", text)
         text = re.sub(r"recovery= .*", "recovery= #", text)
         text = re.sub(r"skew= .*", "skew= #", text)
@@ -549,6 +551,7 @@ def corpus_09_resident_analyze():
         text = re.sub(r"\b(wall|cpu)=\d+(\.\d+)?ms", r"\1=#ms", text)
         text = re.sub(r"\b(add|get|finish)=\d+(\.\d+)?", r"\1=#", text)
         text = re.sub(r"\btask q\d+\.", "task q#.", text)
+        text = re.sub(r"replicas= .*", "replicas= #", text)
         text = re.sub(r"pinned_bytes=\d+", "pinned_bytes=#", text)
         text = re.sub(r"recovery= .*", "recovery= #", text)
         text = re.sub(r"skew= .*", "skew= #", text)
@@ -618,6 +621,7 @@ def corpus_10_adaptive_analyze():
         text = re.sub(r"\b(wall|cpu)=\d+(\.\d+)?ms", r"\1=#ms", text)
         text = re.sub(r"\b(add|get|finish)=\d+(\.\d+)?", r"\1=#", text)
         text = re.sub(r"\btask q\d+\.", "task q#.", text)
+        text = re.sub(r"replicas= .*", "replicas= #", text)
         text = re.sub(r"resident= .*", "resident= #", text)
         text = re.sub(r"recovery= .*", "recovery= #", text)
         text = re.sub(r"skew= .*", "skew= #", text)
@@ -712,6 +716,7 @@ def corpus_11_recovery_analyze():
         text = re.sub(r"\b(wall|cpu)=\d+(\.\d+)?ms", r"\1=#ms", text)
         text = re.sub(r"\b(add|get|finish)=\d+(\.\d+)?", r"\1=#", text)
         text = re.sub(r"\btask q\d+\.", "task q#.", text)
+        text = re.sub(r"replicas= .*", "replicas= #", text)
         text = re.sub(r"resident= .*", "resident= #", text)
         text = re.sub(r"skew= .*", "skew= #", text)
         return text
@@ -815,6 +820,7 @@ def corpus_12_skew_analyze():
         text = re.sub(r"\b(wall|cpu)=\d+(\.\d+)?ms", r"\1=#ms", text)
         text = re.sub(r"\b(add|get|finish)=\d+(\.\d+)?", r"\1=#", text)
         text = re.sub(r"\btask q\d+\.", "task q#.", text)
+        text = re.sub(r"replicas= .*", "replicas= #", text)
         text = re.sub(r"resident= .*", "resident= #", text)
         text = re.sub(r"recovery= .*", "recovery= #", text)
         text = re.sub(r"spool=[0-9a-f]+", "spool=#", text)
@@ -837,6 +843,101 @@ def corpus_12_skew_analyze():
     )
 
 
+def corpus_13_replica_analyze():
+    """The replicated serving plane (trino_tpu/runtime/replicas.py): the
+    8-device corpus mesh carved into two 4-wide sub-meshes. Two warm
+    runs alternate across the replicas (round-robin placement — each
+    sub-mesh pays its device-set lowering once); an injected
+    MeshDeviceLost on the replica serving the third run fails the query
+    over to its sibling, which resumes from the host-portable
+    checkpoint. The trailing `replicas=` line of EXPLAIN ANALYZE pins
+    the grid shape, per-replica lifecycle states and THIS runner's
+    placement/failover counters — instance-scoped, so the numbers are
+    exact. Timings redacted as in corpus 07."""
+    import re
+
+    from trino_tpu.parallel import mesh_chunk
+    from trino_tpu.recovery import CHECKPOINTS
+    from trino_tpu.runtime import DistributedQueryRunner
+
+    CHECKPOINTS.clear()
+    r = DistributedQueryRunner(
+        Session(
+            catalog="tpch", schema="tiny",
+            mesh_replicas=2, mesh_chunk_rows=1024,
+            mesh_checkpoint_interval_chunks=1, mesh_resume_attempts=0,
+        ),
+        n_workers=2,
+        hash_partitions=2,
+    )
+    r.register_catalog("tpch", create_tpch_connector())
+    sql = (
+        "select l_returnflag, count(*), sum(l_quantity) from lineitem "
+        "group by l_returnflag"
+    )
+    # two warm runs: sequential placements alternate replicas, so both
+    # sub-meshes hold warm programs before the fault
+    clean = r.execute(sql).rows
+    r.execute(sql)
+    n_chunks = mesh_chunk.LAST_RUN_INFO["chunks"]
+    target = n_chunks - 2
+    state = {"victim": None, "fired": False}
+
+    def kill_victim(k, K):
+        rep = mesh_chunk.active_replica()
+        if rep is None:
+            return
+        if state["victim"] is None:
+            state["victim"] = rep
+        if not state["fired"] and rep == state["victim"] and k >= target:
+            state["fired"] = True
+            raise mesh_chunk.MeshDeviceLost(
+                f"injected: replica {rep} lost at chunk {k}/{K}"
+            )
+
+    mesh_chunk.MESH_FAULT_HOOK = kill_victim
+    try:
+        faulted = r.execute(sql).rows
+    finally:
+        mesh_chunk.MESH_FAULT_HOOK = None
+    assert state["fired"], "fault hook never reached its target chunk"
+    info = mesh_chunk.LAST_RUN_INFO
+    rm = r._replicas
+    events = [
+        f"grid: {rm.n_replicas} replicas x {rm.partition_width} devices "
+        "(two 4-wide sub-meshes of the 8-device corpus mesh)",
+        f"replica {state['victim']} lost at chunk "
+        f"{target}/{n_chunks}",
+        f"failover: resumed_from_chunk={info['resumed_from_chunk']} "
+        f"on the sibling sub-mesh (failovers={rm.failovers})",
+        f"rows oracle-equal to the uninterrupted run: {faulted == clean}",
+    ]
+    out = r.execute("EXPLAIN ANALYZE " + sql).rows[0][0]
+
+    def redact(text):
+        text = re.sub(r"\b(wall|cpu)=\d+(\.\d+)?ms", r"\1=#ms", text)
+        text = re.sub(r"\b(add|get|finish)=\d+(\.\d+)?", r"\1=#", text)
+        text = re.sub(r"\btask q\d+\.", "task q#.", text)
+        text = re.sub(r"resident= .*", "resident= #", text)
+        text = re.sub(r"recovery= .*", "recovery= #", text)
+        text = re.sub(r"skew= .*", "skew= #", text)
+        return text
+
+    emit(
+        "13_replica_analyze.txt",
+        (f"QUERY\n{sql}", ""),
+        ("replica failover under an injected device loss "
+         "(mesh_replicas=2): the\nquery resumes on the sibling sub-mesh "
+         "from the host-portable checkpoint\ninstead of restarting at "
+         "chunk 0", "\n".join(events)),
+        ("EXPLAIN ANALYZE after the failover: the trailing replicas= "
+         "line reports\nthe grid shape, per-replica lifecycle states "
+         "(a=active) and this runner's\ninstance-scoped "
+         "placement/failover counters (wall-clock values redacted\nto "
+         "`#`)", redact(out)),
+    )
+
+
 def write_all(out_dir=None):
     """Regenerate every corpus file (into `out_dir` when given — used
     by tests/test_explain_corpus.py to diff against committed files)."""
@@ -855,6 +956,7 @@ def write_all(out_dir=None):
         corpus_10_adaptive_analyze()
         corpus_11_recovery_analyze()
         corpus_12_skew_analyze()
+        corpus_13_replica_analyze()
     finally:
         _OUT_DIR[0] = HERE
 

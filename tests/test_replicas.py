@@ -1,0 +1,448 @@
+"""Replicated serving meshes (PR 17): placement, drain, failover,
+host-portable checkpoints.
+
+runtime/replicas.py carves the device set into N identical sub-meshes
+and the coordinator places each mesh run on the least-loaded healthy
+replica; a replica lost (MeshDeviceLost) or draining
+(MeshReplicaDraining) mid-run hands its chunked query to a sibling
+sub-mesh, which resumes byte-identically from the host-portable
+checkpoint (recovery/checkpoint.py bytes APIs). These tests pin:
+
+  - the grid carving (identical widths, leftover devices dropped, too
+    few devices refused);
+  - placement policy: least-inflight with round-robin tiebreak, breaker
+    avoidance with half-open probes, exclusion exhaustion -> None;
+  - the drain lifecycle: idempotent request_drain, drain_check raising
+    MeshReplicaDraining off the active state, graceful drain/undrain;
+  - replica failover end to end: a victim kill mid-run resumes on the
+    sibling with identical rows, zero re-executed chunk steps, zero new
+    XLA lowerings, and the EXPLAIN ANALYZE `replicas=` line counts it;
+  - a drain requested mid-run fails over WITHOUT spending the in-run
+    resume budget (MeshReplicaDraining is not in-run resumable);
+  - checkpoint host portability: export_bytes on "host A", import_bytes
+    into a cleared store, and a fresh runner resumes from the imported
+    snapshot byte-identically;
+  - the generation guard survives the host boundary: a feed-table write
+    between export and import makes the imported entry unreachable (the
+    run cold-starts instead of resurfacing pre-write carries);
+  - deadline kills after a failover name both the resume chunk and the
+    replica that picked the run up.
+"""
+
+import time
+
+import pytest
+
+from trino_tpu.connectors.tpch import create_tpch_connector
+from trino_tpu.engine import Session
+from trino_tpu.parallel import mesh_chunk
+from trino_tpu.parallel.mesh_chunk import (
+    MeshDeviceLost,
+    MeshReplicaDraining,
+)
+from trino_tpu.recovery import CHECKPOINTS, MeshCheckpoint
+from trino_tpu.resident import GENERATIONS
+from trino_tpu.runtime import DistributedQueryRunner
+from trino_tpu.runtime.metrics import METRICS
+from trino_tpu.runtime.query_tracker import (
+    EXCEEDED_TIME_LIMIT,
+    DeadlineLimits,
+    ExceededTimeLimitError,
+    QueryTracker,
+    preemption_check,
+)
+from trino_tpu.runtime.replicas import ReplicaManager
+
+# exact-valued aggregates only: a failover resume must be byte-identical
+# to the uninterrupted run (same query as test_recovery.py)
+Q_GROUP = (
+    "select l_returnflag, l_linestatus, count(*) c, "
+    "sum(l_quantity) q, min(l_orderkey) mn, max(l_orderkey) mx "
+    "from lineitem group by l_returnflag, l_linestatus "
+    "order by l_returnflag, l_linestatus"
+)
+
+
+def mk_runner(**session_kw):
+    kw = dict(
+        mesh_chunk_rows=512, mesh_checkpoint_interval_chunks=1,
+    )
+    kw.update(session_kw)
+    r = DistributedQueryRunner(
+        Session(catalog="tpch", schema="tiny", **kw),
+        n_workers=2, hash_partitions=2,
+    )
+    r.register_catalog("tpch", create_tpch_connector())
+    return r
+
+
+@pytest.fixture(autouse=True)
+def _clean_replica_state():
+    CHECKPOINTS.clear()
+    mesh_chunk.MESH_FAULT_HOOK = None
+    yield
+    CHECKPOINTS.clear()
+    mesh_chunk.MESH_FAULT_HOOK = None
+
+
+@pytest.fixture(scope="module")
+def baseline_rows():
+    r = mk_runner(mesh_execution=False)
+    return r.execute(Q_GROUP).rows
+
+
+# -- grid carving -------------------------------------------------------
+
+
+def fake_devices(n):
+    return [f"fake-dev-{i}" for i in range(n)]
+
+
+def test_carving_identical_widths_drops_leftover():
+    """8 devices / 3 replicas -> three rows of 2; the leftover pair
+    stays out of the grid (identical widths keep checkpoints portable:
+    carry shapes are (n*cap,))."""
+    rm = ReplicaManager(3, devices=fake_devices(8))
+    assert rm.grid.shape == (3, 2)
+    assert rm.partition_width == 2
+    assert rm.replicas[2].devices == ["fake-dev-4", "fake-dev-5"]
+    carved = {d for rep in rm.replicas for d in rep.devices}
+    assert "fake-dev-6" not in carved and "fake-dev-7" not in carved
+
+
+def test_carving_refuses_too_few_devices():
+    with pytest.raises(ValueError):
+        ReplicaManager(5, devices=fake_devices(3))
+    with pytest.raises(ValueError):
+        ReplicaManager(0, devices=fake_devices(4))
+
+
+# -- placement policy ---------------------------------------------------
+
+
+def test_place_least_inflight_with_round_robin_tiebreak():
+    rm = ReplicaManager(2, devices=fake_devices(4))
+    # concurrent placements spread: the second lands on the idle sibling
+    a = rm.place()
+    b = rm.place()
+    assert {a.replica_id, b.replica_id} == {0, 1}
+    assert a.inflight == 1 and b.inflight == 1
+    rm.release(a)
+    rm.release(b)
+    assert a.inflight == 0 and b.inflight == 0
+    # sequential placements alternate on the round-robin cursor (this
+    # is what warms every replica during serving warmup rounds)
+    seen = []
+    for _ in range(4):
+        rep = rm.place()
+        seen.append(rep.replica_id)
+        rm.release(rep)
+    assert seen == [0, 1, 0, 1]
+    assert rm.placements == 6
+
+
+def test_place_exhausted_exclusion_returns_none():
+    rm = ReplicaManager(2, devices=fake_devices(4))
+    assert rm.place(exclude=(0, 1)) is None
+    assert rm.placements == 0
+
+
+def test_breaker_trip_avoidance_and_half_open_probe():
+    rm = ReplicaManager(
+        2, devices=fake_devices(4),
+        breaker_threshold=2, breaker_cooldown_s=0.5,
+    )
+    opens0 = METRICS.snapshot().get("replica.breaker_opens", 0.0)
+    rep0 = rm.replicas[0]
+    rm.report_failure(rep0)
+    assert rm.breaker_states()[0] == "closed"  # below threshold
+    rm.report_failure(rep0)
+    assert rm.breaker_states()[0] == "open"
+    assert rm.breaker_opens == 1
+    assert (
+        METRICS.snapshot().get("replica.breaker_opens", 0.0) - opens0 == 1
+    )
+    assert rm.healthy_count() == 1
+    # every placement avoids the open replica while a closed one exists
+    for _ in range(3):
+        rep = rm.place()
+        assert rep.replica_id == 1
+        rm.release(rep)
+    # with the sibling excluded, degrade rather than refuse: the open
+    # replica still serves (mirrors _schedulable_workers)
+    rep = rm.place(exclude=(1,))
+    assert rep.replica_id == 0
+    rm.release(rep)
+    # cooldown elapsed -> half-open probe placement, success closes it
+    time.sleep(0.55)
+    rep = rm.place(exclude=(1,))
+    assert rep.replica_id == 0
+    assert rm.breaker_states()[0] == "half_open"
+    rm.report_success(rep)
+    rm.release(rep)
+    assert rm.breaker_states()[0] == "closed"
+
+
+# -- drain lifecycle ----------------------------------------------------
+
+
+def test_drain_lifecycle_and_drain_check():
+    rm = ReplicaManager(2, devices=fake_devices(4))
+    drains0 = METRICS.snapshot().get("replica.drains", 0.0)
+    rep = rm.request_drain(0)
+    assert rep.state == "shutting_down"
+    rm.request_drain(0)  # idempotent: no double count
+    assert rm.drains == 1
+    assert METRICS.snapshot().get("replica.drains", 0.0) - drains0 == 1
+    # placements skip the draining replica immediately
+    for _ in range(3):
+        placed = rm.place()
+        assert placed.replica_id == 1
+        rm.release(placed)
+    # in-flight chunk loops on it raise at their next boundary
+    with pytest.raises(MeshReplicaDraining) as ei:
+        rm.drain_check(rep)()
+    assert not ei.value.in_run_resumable
+    # nothing in flight -> graceful drain completes; undrain re-admits
+    assert rm.drain(0, timeout_s=1.0)
+    assert rep.state == "drained"
+    rm.undrain(0)
+    assert rep.state == "active"
+    rm.drain_check(rep)()  # active again: no raise
+    assert rm.stats_line() == (
+        f"replicas= n=2x2 states=aa placements={rm.placements} "
+        "failovers=0 drains=1 breaker_opens=0"
+    )
+
+
+# -- replica failover end to end ---------------------------------------
+
+
+class VictimKill:
+    """Kill whichever replica serves the run's first chunk, once it
+    reaches `target` — victim discovery instead of a hardcoded id, so
+    the round-robin placement order can never unseat the fault."""
+
+    def __init__(self, target):
+        self.target = target
+        self.victim = None
+        self.fired = False
+
+    def __call__(self, k, K):
+        rep = mesh_chunk.active_replica()
+        if rep is None:
+            return
+        if self.victim is None:
+            self.victim = rep
+        if not self.fired and rep == self.victim and k >= self.target:
+            self.fired = True
+            raise MeshDeviceLost(
+                f"injected: replica {rep} lost at chunk {k}/{K}"
+            )
+
+
+def warm_replicas(r, baseline_rows, rounds=2):
+    """Sequential placements alternate replicas, so N rounds warm all N
+    sub-meshes (each pays its own device-set lowering once)."""
+    for _ in range(rounds):
+        assert r.execute(Q_GROUP).rows == baseline_rows
+        assert r._last_data_plane == "mesh", r.last_mesh_fallback
+    return int(mesh_chunk.LAST_RUN_INFO["chunks"])
+
+
+def test_failover_resumes_on_sibling_byte_identical(baseline_rows):
+    """A replica lost at 3K/4 fails the run over to its sibling, which
+    resumes from the portable checkpoint: identical rows, zero chunk
+    steps re-executed (interval=1), zero new XLA lowerings (the sibling
+    is warm), failover counted and visible in EXPLAIN ANALYZE."""
+    r = mk_runner(mesh_replicas=2, mesh_resume_attempts=0)
+    K = warm_replicas(r, baseline_rows)
+    assert K >= 4, f"query too small to chunk ({K})"
+    rm = r._replicas
+    assert rm is not None and rm.n_replicas == 2
+
+    target = max(1, (3 * K) // 4)
+    hook = VictimKill(target)
+    mesh_chunk.MESH_FAULT_HOOK = hook
+    resumed0 = CHECKPOINTS.resumed
+    steps0 = METRICS.snapshot().get("mesh.chunk_steps", 0.0)
+    compiles0 = METRICS.snapshot().get("xla_compiles", 0.0)
+    try:
+        assert r.execute(Q_GROUP).rows == baseline_rows
+    finally:
+        mesh_chunk.MESH_FAULT_HOOK = None
+    assert hook.fired
+    assert r._last_data_plane == "mesh", r.last_mesh_fallback
+    assert rm.failovers == 1
+    assert CHECKPOINTS.resumed == resumed0 + 1
+    # the sibling's runner reports the resume point; the process-wide
+    # step ledger proves the query as a whole re-executed nothing
+    info = mesh_chunk.LAST_RUN_INFO
+    assert info["resumed_from_chunk"] == target
+    steps = METRICS.snapshot().get("mesh.chunk_steps", 0.0) - steps0
+    assert steps == K, f"failover re-executed {steps - K:g} chunk steps"
+    compiles = METRICS.snapshot().get("xla_compiles", 0.0) - compiles0
+    assert compiles == 0, f"failover lowered {compiles:g} new programs"
+
+    out = r.execute(f"EXPLAIN ANALYZE {Q_GROUP}").rows[0][0]
+    assert "replicas= n=2x4 " in out
+    assert "failovers=1" in out
+
+
+def test_drain_mid_run_fails_over_without_resume_budget(baseline_rows):
+    """request_drain on the serving replica mid-run: the chunk loop
+    raises MeshReplicaDraining at the next boundary and the coordinator
+    fails over DESPITE a full in-run resume budget (draining disables
+    in-run resume — retrying in place would land back on the draining
+    replica). The sibling finishes the query byte-identically."""
+    r = mk_runner(mesh_replicas=2)  # default mesh_resume_attempts
+    K = warm_replicas(r, baseline_rows)
+    rm = r._replicas
+    state = {"victim": None, "requested": False}
+
+    def hook(k, K_):
+        rep = mesh_chunk.active_replica()
+        if rep is None:
+            return
+        if state["victim"] is None:
+            state["victim"] = rep
+        if (
+            not state["requested"]
+            and rep == state["victim"]
+            and k >= max(1, K_ // 2)
+        ):
+            state["requested"] = True
+            rm.request_drain(rep)
+
+    mesh_chunk.MESH_FAULT_HOOK = hook
+    resumed0 = CHECKPOINTS.resumed
+    try:
+        assert r.execute(Q_GROUP).rows == baseline_rows
+    finally:
+        mesh_chunk.MESH_FAULT_HOOK = None
+    assert state["requested"]
+    assert r._last_data_plane == "mesh", r.last_mesh_fallback
+    assert rm.failovers == 1
+    assert rm.drains == 1
+    assert CHECKPOINTS.resumed == resumed0 + 1
+    victim = rm.replicas[state["victim"]]
+    assert victim.state == "shutting_down"
+    assert rm.drain(state["victim"], timeout_s=5.0)
+    rm.undrain(state["victim"])
+
+
+# -- checkpoint host portability ---------------------------------------
+
+
+class ExportingKill:
+    """At `target`, export the run's live checkpoint bytes (what a
+    failing host would ship to the pod) and kill the mesh."""
+
+    def __init__(self, target):
+        self.target = target
+        self.key = None
+        self.data = None
+
+    def __call__(self, k, K):
+        if self.data is None and k == self.target:
+            # the fixture cleared the store and interval=1 checkpoints
+            # every boundary, so the single live entry is this run's
+            assert len(CHECKPOINTS) == 1
+            self.key = next(iter(CHECKPOINTS._entries))
+            self.data = CHECKPOINTS.export_bytes(self.key)
+            raise MeshDeviceLost(f"injected: host lost at chunk {k}/{K}")
+
+
+def capture_checkpoint_bytes(baseline_rows):
+    """Run on 'host A' (resume budget 0 -> the fault falls back to the
+    page plane there), capturing the mid-run checkpoint bytes. Returns
+    the receiving 'host B' runner too: B's catalogs must exist BEFORE
+    the snapshot — registering a catalog bumps the global generation
+    epoch (it can shadow names), which correctly fences any checkpoint
+    taken under the previous epoch."""
+    a = mk_runner(mesh_resume_attempts=0)
+    b = mk_runner(mesh_resume_attempts=0)
+    assert a.execute(Q_GROUP).rows == baseline_rows  # warm
+    assert a._last_data_plane == "mesh", a.last_mesh_fallback
+    K = int(mesh_chunk.LAST_RUN_INFO["chunks"])
+    hook = ExportingKill(K // 2)
+    mesh_chunk.MESH_FAULT_HOOK = hook
+    try:
+        assert a.execute(Q_GROUP).rows == baseline_rows  # page fallback
+    finally:
+        mesh_chunk.MESH_FAULT_HOOK = None
+    assert hook.data is not None
+    assert a.last_mesh_fallback is not None, \
+        "host A had no resume budget: expected the page-plane fallback"
+    return b, hook.key, hook.data, K, hook.target
+
+
+def test_checkpoint_bytes_resume_across_host_boundary(baseline_rows):
+    """export_bytes on host A -> import_bytes into a cleared store
+    ("host B") -> a FRESH runner resumes from the imported snapshot:
+    identical rows, exactly the unexecuted chunks replayed. The key is
+    program identity minus device identity, so B's runner finds A's
+    checkpoint as its own."""
+    b, key, data, K, target = capture_checkpoint_bytes(baseline_rows)
+    CHECKPOINTS.clear()  # host B starts with an empty store
+    assert len(CHECKPOINTS) == 0
+    assert CHECKPOINTS.import_bytes(key, data)
+    assert not CHECKPOINTS.import_bytes(key, b"truncated-transfer")
+
+    resumed0 = CHECKPOINTS.resumed
+    assert b.execute(Q_GROUP).rows == baseline_rows
+    assert b._last_data_plane == "mesh", b.last_mesh_fallback
+    assert CHECKPOINTS.resumed == resumed0 + 1
+    info = mesh_chunk.LAST_RUN_INFO
+    assert info["resumed_from_chunk"] == target
+    assert info["executed_chunk_steps"] == K - target, \
+        "host B re-executed chunks host A had already completed"
+
+
+def test_imported_checkpoint_respects_local_generations(baseline_rows):
+    """A feed-table write between export and import fences the imported
+    entry: the receiving store's generation guard drops it on first
+    `get`, so host B cold-starts instead of resurfacing pre-write
+    carries. Imported bytes never bypass local DML visibility."""
+    b, key, data, K, _ = capture_checkpoint_bytes(baseline_rows)
+    CHECKPOINTS.clear()
+    assert CHECKPOINTS.import_bytes(key, data)
+    inv0 = CHECKPOINTS.invalidated
+    # "DML" landing while the bytes were in flight on the host boundary:
+    # bump the generation of a table the snapshot actually recorded
+    fed = MeshCheckpoint.from_bytes(data).tables[0]
+    GENERATIONS.bump(fed)
+    assert CHECKPOINTS.get(key) is None
+    assert CHECKPOINTS.invalidated == inv0 + 1
+
+    # the run itself cold-starts and still agrees with the baseline
+    CHECKPOINTS.clear()
+    assert CHECKPOINTS.import_bytes(key, data)
+    resumed0 = CHECKPOINTS.resumed
+    assert b.execute(Q_GROUP).rows == baseline_rows
+    assert b._last_data_plane == "mesh", b.last_mesh_fallback
+    assert CHECKPOINTS.resumed == resumed0, \
+        "a generation-fenced import must not be resumed from"
+    assert mesh_chunk.LAST_RUN_INFO["executed_chunk_steps"] == K
+
+
+# -- deadline kills name the failover target ---------------------------
+
+
+def test_deadline_message_names_resume_replica():
+    """After a failover, the chunk-boundary wall check embeds BOTH the
+    resume chunk and the replica that picked the run up, keeping the
+    typed [EXCEEDED_TIME_LIMIT] code."""
+    tracker = QueryTracker()
+    tracker.register("qr", DeadlineLimits())
+    check = preemption_check(
+        tracker, "qr", deadline_epoch_s=time.time() - 1.0
+    )
+    check.resumed_from = 7
+    check.resumed_on = 1
+    with pytest.raises(ExceededTimeLimitError) as ei:
+        check(9, 16)
+    msg = str(ei.value)
+    assert EXCEEDED_TIME_LIMIT in msg
+    assert "(resumed from chunk 7 on replica 1)" in msg
+    assert "9/16" in msg

@@ -255,6 +255,25 @@ for _name, _type, _default, _desc, _allowed in [
      "so QUERY-level retry substitutes finished stages as literal "
      "sources instead of recomputing them (FTE settles lift committed "
      "stage spool files into the same store)", None),
+    # -- replicated serving meshes (trino_tpu/runtime/replicas.py) --
+    ("mesh_replicas", int, 1,
+     "carve the device set into this many identical sub-meshes "
+     "(replica x partition named-axis grid); the coordinator "
+     "load-balances mesh queries across healthy replicas and each "
+     "replica runs the same prelude/step/flush programs unchanged; "
+     "1 (or too few devices) keeps the single full-width mesh", None),
+    ("replica_failover_enabled", bool, True,
+     "when a replica dies or drains mid-query, re-place its in-flight "
+     "chunked query onto a healthy sibling sub-mesh — the sibling "
+     "restores the host-portable mesh checkpoint and continues from "
+     "chunk k instead of falling back to the page plane", None),
+    ("replica_breaker_threshold", int, 3,
+     "consecutive mesh-run failures before a replica's circuit breaker "
+     "opens (the replica leaves the placement pool until a later "
+     "success closes it)", None),
+    ("replica_breaker_cooldown_s", float, 1.0,
+     "seconds an open replica breaker sits out before a half-open "
+     "placement probe may try the replica again", None),
     # -- observability (runtime/tracing.py) --
     ("query_trace", str, "off",
      "record a full span tree per query (phases, stages, task attempts, "

@@ -169,6 +169,17 @@ class Session:
     mesh_checkpoint_interval_chunks: int = 0
     mesh_resume_attempts: int = 2
     recovery_spool_stages: bool = False
+    # replicated serving meshes (trino_tpu/runtime/replicas.py): carve
+    # the device set into N identical sub-meshes, each running the same
+    # prelude/step/flush programs; the coordinator load-balances across
+    # healthy replicas and, with failover on, re-places an in-flight
+    # chunked query onto a sibling when its replica dies or drains
+    # (resuming from the host-portable checkpoint). Breaker thresholds
+    # mirror the worker graylist (node_breaker_*), per replica.
+    mesh_replicas: int = 1
+    replica_failover_enabled: bool = True
+    replica_breaker_threshold: int = 3
+    replica_breaker_cooldown_s: float = 1.0
 
     def set_property(self, name: str, value) -> None:
         """SET SESSION entry point — validated through the typed

@@ -49,6 +49,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import math
+import threading as _threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -103,12 +104,41 @@ class MeshDeviceLost(RuntimeError):
     remainder replays on the restored mesh, or the whole query falls
     back to the page plane."""
 
+    # an in-run resume retries on the SAME mesh; subclasses a sibling
+    # sub-mesh must take over for (drain) turn this off so the fault
+    # escalates straight to the coordinator's replica failover
+    in_run_resumable = True
+
+
+class MeshReplicaDraining(MeshDeviceLost):
+    """The replica serving this run started draining mid-query: the
+    chunk loop stops at the next boundary so the coordinator can fail
+    the query over to a healthy sibling sub-mesh (which resumes from
+    the host-portable checkpoint). Resuming in-run would land back on
+    the draining replica, so it is disabled for this fault."""
+
+    in_run_resumable = False
+
 
 # Chaos seam: when set, called as hook(chunk_index, n_chunks) at every
 # chunk boundary BEFORE the step dispatch. The chaos harness raises
 # MeshStuck / MeshDeviceLost from here to inject deterministic
 # mid-chunk faults (runtime/chaos.py).
 MESH_FAULT_HOOK: Optional[Callable[[int, int], None]] = None
+
+# Which replica's sub-mesh the calling thread's chunk loop runs on
+# (None outside a run, or on the single full-width mesh). THREAD-local:
+# under serving load several chunk loops interleave on different
+# replicas, and a replica-targeted fault hook must see the replica of
+# the loop that invoked it, not whichever run() started last.
+_ACTIVE_REPLICA = _threading.local()
+
+
+def active_replica() -> Optional[int]:
+    """Replica id of the sub-mesh the current thread's chunk loop runs
+    on, or None. Replica-aware chaos hooks consult this to target one
+    fault domain without changing the hook(k, K) signature."""
+    return getattr(_ACTIVE_REPLICA, "replica", None)
 
 
 class _Overflow(Exception):
@@ -978,12 +1008,18 @@ class ChunkedMeshRunner:
     def _ckpt_key(self) -> Optional[tuple]:
         """Checkpoint-store key: the program identity minus the caps
         element (the record key's last component), so a resume after an
-        overflow cap bump still finds its checkpoint. None when the
+        overflow cap bump still finds its checkpoint, and minus the
+        DEVICE identity (record-key element 2), so the checkpoint is
+        host-portable — a sibling sub-mesh of the same width n (carry
+        shapes are (n*cap,)) restores it after a replica failover. The
+        shard count n stays in the key: carries from a different-width
+        mesh could never be re-placed shape-exactly. None when the
         program itself is uncacheable (repr identity leak) — such plans
         never checkpoint."""
         if self._last_record_key is None:
             return None
-        return ("mesh-ckpt",) + tuple(self._last_record_key[:-1])
+        key = self._last_record_key
+        return ("mesh-ckpt", key[1]) + tuple(key[3:-1])
 
     # -- execution ---------------------------------------------------
     def run(self, preempt=None, query_span=None) -> Dict[int, list]:
@@ -999,6 +1035,8 @@ class ChunkedMeshRunner:
                 "task mesh.0", KIND_TASK,
                 chunks=self.cplan.n_chunks, chunk_rows=self.cplan.chunk_cap,
             )
+        prev_replica = active_replica()
+        _ACTIVE_REPLICA.replica = getattr(self.ex, "replica_id", None)
         try:
             caps: Dict[str, int] = {}
             self._run_stats = {
@@ -1048,7 +1086,11 @@ class ChunkedMeshRunner:
                     # propagate from preempt() uncaught.
                     key = self._ckpt_key()
                     ckpt = None
-                    if key is not None and resume_budget > 0:
+                    if (
+                        key is not None
+                        and resume_budget > 0
+                        and getattr(e, "in_run_resumable", True)
+                    ):
                         from trino_tpu.recovery.checkpoint import (
                             CHECKPOINTS,
                         )
@@ -1101,6 +1143,7 @@ class ChunkedMeshRunner:
             self._record_divergences(sources, query_span)
             return sources
         finally:
+            _ACTIVE_REPLICA.replica = prev_replica
             if task_span is not None:
                 task_span.end()
                 stage_span.end()
@@ -1159,10 +1202,13 @@ class ChunkedMeshRunner:
                         )
                         self._run_stats["resumed_from_chunk"] = k0
                         # deadline kills during the resumed stretch name
-                        # the resume point (query_tracker embeds it in
-                        # the typed [EXCEEDED_TIME_LIMIT] message)
+                        # the resume point — and, after a replica
+                        # failover, which replica picked the run up
+                        # (query_tracker embeds both in the typed
+                        # [EXCEEDED_TIME_LIMIT] message)
                         try:
                             preempt.resumed_from = k0
+                            preempt.resumed_on = active_replica()
                         except AttributeError:
                             pass  # bare-callable hooks (tests) are fine
                         if task_span is not None:
@@ -1177,10 +1223,19 @@ class ChunkedMeshRunner:
                     )
                     for t in record.carry_sds
                 )
+            drain_check = getattr(self.ex, "drain_check", None)
+            from trino_tpu.runtime.metrics import METRICS
+
             with op_span("MeshChunkStep", attempt=attempt, chunks=K):
                 for k in range(k0, K):
                     if preempt is not None:
                         preempt(k, K)
+                    if drain_check is not None:
+                        # replica lifecycle: a drain requested on this
+                        # sub-mesh raises MeshReplicaDraining here so
+                        # the coordinator fails the run over to a
+                        # sibling at this boundary
+                        drain_check()
                     if MESH_FAULT_HOOK is not None:
                         MESH_FAULT_HOOK(k, K)
                     t0 = time.monotonic()
@@ -1194,6 +1249,12 @@ class ChunkedMeshRunner:
                     self._run_stats["executed_chunk_steps"] = (
                         int(self._run_stats["executed_chunk_steps"]) + 1
                     )
+                    # process-wide ledger: a failover spans TWO runners
+                    # (the faulted one and the sibling's), so per-run
+                    # stats alone cannot say how much work the whole
+                    # query re-executed — bench's failover gate diffs
+                    # this counter instead
+                    METRICS.increment("mesh.chunk_steps")
                     # a completed boundary is a safe snapshot point:
                     # the flag readback synced the device, and the
                     # carries are only donated when passed into the

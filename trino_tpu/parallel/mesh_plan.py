@@ -89,6 +89,13 @@ from trino_tpu.sql.fragmenter import SubPlan
 
 AXIS = "shard"
 
+# Second named axis of the replicated serving plane: the full device
+# set carves into a (replica x partition) grid (runtime/replicas.py)
+# whose rows are identical 1-D sub-meshes over AXIS. Sub-mesh programs
+# never reference REPLICA_AXIS — that is the point: the SAME
+# prelude/step/flush lowerings serve any replica unchanged.
+REPLICA_AXIS = "replica"
+
 # Trace-time counters, monotonically increasing for the process life
 # (capacity-overflow retraces count again). Tests must assert on
 # before/after deltas, never absolute values.
@@ -945,12 +952,22 @@ class MeshExecutor:
     pipeline (so sort-merge gathers, final TopN/limit and output
     decoration share code with the HTTP path)."""
 
-    def __init__(self, catalogs, session, devices=None):
+    def __init__(self, catalogs, session, devices=None, replica_id=None,
+                 drain_check=None):
+        """`devices` restricts the mesh to a sub-mesh (a replica row of
+        the replica x partition grid); `replica_id` labels it for
+        observability (chunk runners export it as ACTIVE_REPLICA, fault
+        messages and deadline kills name it); `drain_check` is the
+        replica manager's chunk-boundary lifecycle hook — it raises
+        MeshReplicaDraining when the replica leaves rotation so the
+        coordinator fails the run over to a sibling."""
         self.catalogs = catalogs
         self.session = session
         devs = list(devices) if devices is not None else list(jax.devices())
         self.n = len(devs)
         self.mesh = Mesh(np.array(devs), (AXIS,))
+        self.replica_id = replica_id
+        self.drain_check = drain_check
         self.last_run: Dict[str, object] = {}
 
     # -- public --

@@ -68,6 +68,30 @@ class MeshCheckpoint:
     generations: Tuple[int, ...]
     query_id: str = ""
 
+    # -- host portability (replicated meshes / multi-host failover) --
+    # `carries_host` is already a pure host value (numpy-leaf pytrees of
+    # the engine's container dataclasses), so a checkpoint serializes
+    # without touching the device: a sibling sub-mesh — or another host
+    # in the pod — deserializes the bytes and `_restore_carries` places
+    # them under ITS sharding. The generation vector travels inside, so
+    # the receiving store's `get` revalidation still fences DML that
+    # landed between snapshot and restore.
+    def to_bytes(self) -> bytes:
+        import pickle
+
+        return pickle.dumps(self, protocol=pickle.HIGHEST_PROTOCOL)
+
+    @staticmethod
+    def from_bytes(data: bytes) -> "MeshCheckpoint":
+        import pickle
+
+        ckpt = pickle.loads(data)
+        if not isinstance(ckpt, MeshCheckpoint):
+            raise TypeError(
+                f"checkpoint bytes decoded to {type(ckpt).__name__}"
+            )
+        return ckpt
+
 
 class MeshCheckpointStore:
     """Generation-guarded LRU of mesh checkpoints, keyed by the program
@@ -126,6 +150,29 @@ class MeshCheckpointStore:
     def discard(self, key: tuple) -> None:
         with self._lock:
             self._entries.pop(key, None)
+
+    # -- host-boundary transfer (replicated meshes) -------------------
+    def export_bytes(self, key: tuple) -> Optional[bytes]:
+        """Serialize a live checkpoint for transfer across the host
+        boundary. Goes through `get` so a stale generation vector is
+        never exported — the receiver would only re-discover the
+        invalidation it could have learned here."""
+        ckpt = self.get(key)
+        return None if ckpt is None else ckpt.to_bytes()
+
+    def import_bytes(self, key: tuple, data: bytes) -> bool:
+        """Install a checkpoint received from another host (or another
+        store). The entry lands under THIS process's generation check:
+        if local DML advanced any feed table past the snapshot's
+        vector, the very next `get` drops it — imported bytes can never
+        resurface pre-write state. Returns False on undecodable bytes
+        (a truncated transfer must not poison the store)."""
+        try:
+            ckpt = MeshCheckpoint.from_bytes(data)
+        except Exception:
+            return False
+        self.put(key, ckpt)
+        return True
 
     def invalidate_table(self, catalog: str, schema: str, table: str) -> int:
         """Proactive drop for the DML path (engine.py): generation

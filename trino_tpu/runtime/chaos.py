@@ -98,6 +98,11 @@ RECOVERY_CLASSES = (
     "device_lost_resume",
 )
 
+REPLICA_CLASSES = (
+    "replica_down_mid_serve",
+    "replica_drain_under_load",
+)
+
 
 def generate_schedule(
     seed: int,
@@ -849,6 +854,118 @@ class ChaosHarness:
         report["checkpoint_resumes"] = CHECKPOINTS.resumed - resumed0
         return None, report
 
+    def run_replica_down_case(
+        self, queries: Dict[str, str], seed: int = 0, **kw,
+    ) -> Tuple[None, dict]:
+        """PR 17: hard-kill one replica's sub-mesh mid-chunk under live
+        serving load. Construct the harness with in_process=True and a
+        session with mesh_replicas >= 2 + chunking + checkpointing. A
+        PERSISTENT fault hook kills every chunk loop that reaches a
+        mid-run boundary on replica 0 — the coordinator must fail each
+        one over to the sibling sub-mesh (resuming from the last
+        host-portable checkpoint), replica 0's breaker trips after the
+        configured consecutive failures, and placement routes the tail
+        of the population around the dead sub-mesh. Zero queries may be
+        lost: the delegated loaded-cluster case oracle-checks every
+        completion. The hook ignores the case thread so the oracle
+        pre-pass runs clean; only server-side executions fault."""
+        from trino_tpu.parallel import mesh_chunk
+        from trino_tpu.recovery import CHECKPOINTS
+        from trino_tpu.runtime.metrics import METRICS
+
+        if not self.in_process:
+            raise ValueError(
+                "run_replica_down_case needs in_process=True (the mesh "
+                "plane only engages on colocated workers)"
+            )
+        lock = threading.Lock()
+        state = {"fired": 0}
+        case_thread = threading.current_thread()
+
+        def hook(k: int, K: int) -> None:
+            if threading.current_thread() is case_thread:
+                return  # oracle pre-pass: the clean runs stay clean
+            if mesh_chunk.active_replica() == 0 and K >= 2 \
+                    and k >= max(1, K // 2):
+                with lock:
+                    state["fired"] += 1
+                raise mesh_chunk.MeshDeviceLost(
+                    f"chaos[replica_down]: replica 0 sub-mesh "
+                    f"hard-killed at chunk {k}/{K}"
+                )
+
+        before = METRICS.snapshot()
+        resumed0 = CHECKPOINTS.resumed
+        mesh_chunk.MESH_FAULT_HOOK = hook
+        try:
+            _, report = self.run_loaded_cluster_case(queries, seed, **kw)
+        finally:
+            mesh_chunk.MESH_FAULT_HOOK = None
+        after = METRICS.snapshot()
+        report["mesh_faults_fired"] = state["fired"]
+        report["checkpoint_resumes"] = CHECKPOINTS.resumed - resumed0
+        for name in ("replica.failovers", "replica.breaker_opens"):
+            report[name] = int(after.get(name, 0) - before.get(name, 0))
+        return None, report
+
+    def run_replica_drain_case(
+        self, queries: Dict[str, str], seed: int = 0, **kw,
+    ) -> Tuple[None, dict]:
+        """PR 17: gracefully drain one replica with a chunked query in
+        flight on it, under live serving load. The fault hook does not
+        raise — the FIRST server-side chunk loop to reach a mid-run
+        boundary on replica 0 triggers request_drain(0) synchronously,
+        so that same run's next boundary hits the drain check, raises
+        MeshReplicaDraining, and fails over to the sibling with a query
+        deterministically in flight (no timer races). The drained
+        replica takes no further placements; after the population
+        finishes, drain() must confirm it quiesced to zero inflight."""
+        from trino_tpu.parallel import mesh_chunk
+        from trino_tpu.recovery import CHECKPOINTS
+        from trino_tpu.runtime.metrics import METRICS
+
+        if not self.in_process:
+            raise ValueError(
+                "run_replica_drain_case needs in_process=True (the mesh "
+                "plane only engages on colocated workers)"
+            )
+        lock = threading.Lock()
+        state = {"drain_requested": 0}
+        case_thread = threading.current_thread()
+
+        def hook(k: int, K: int) -> None:
+            if threading.current_thread() is case_thread:
+                return  # oracle pre-pass: don't drain before load starts
+            if mesh_chunk.active_replica() == 0 and K >= 2 \
+                    and k >= max(1, K // 2):
+                rm = getattr(self.runner, "_replicas", None)
+                if rm is None:
+                    return
+                with lock:
+                    if state["drain_requested"]:
+                        return
+                    state["drain_requested"] = 1
+                rm.request_drain(0)
+
+        before = METRICS.snapshot()
+        resumed0 = CHECKPOINTS.resumed
+        mesh_chunk.MESH_FAULT_HOOK = hook
+        try:
+            _, report = self.run_loaded_cluster_case(queries, seed, **kw)
+        finally:
+            mesh_chunk.MESH_FAULT_HOOK = None
+        rm = getattr(self.runner, "_replicas", None)
+        report["drain_requested"] = bool(state["drain_requested"])
+        report["replica_drained"] = bool(
+            rm is not None and state["drain_requested"]
+            and rm.drain(0, timeout_s=30.0)
+        )
+        after = METRICS.snapshot()
+        report["checkpoint_resumes"] = CHECKPOINTS.resumed - resumed0
+        for name in ("replica.failovers", "replica.drains"):
+            report[name] = int(after.get(name, 0) - before.get(name, 0))
+        return None, report
+
 
 def chaos_smoke(
     seed: int,
@@ -1276,4 +1393,98 @@ def chaos_smoke(
                 f"resumes={report['checkpoint_resumes']} "
                 f"drained={report['drained']} hung=0"
             )
+    # replica scenarios (PR 17): the same live population against a
+    # REPLICATED serving plane (two sub-meshes carved from the device
+    # set) — one replica hard-killed mid-chunk, then (fresh harness)
+    # gracefully drained with a query in flight. In-flight chunked
+    # queries must fail over to the sibling sub-mesh and resume from
+    # the host-portable checkpoint; zero queries lost either way.
+    import jax
+
+    if len(jax.devices()) < 2:
+        if verbose:
+            print(
+                "  chaos replica/*: skipped (needs >= 2 devices to "
+                "carve sub-meshes; run with "
+                "--xla_force_host_platform_device_count)"
+            )
+    else:
+        for scenario in REPLICA_CLASSES:
+            h = ChaosHarness(
+                n_workers=2, in_process=True,
+                session=Session(
+                    catalog="tpch", schema="tiny",
+                    mesh_replicas=2,
+                    mesh_chunk_rows=256,
+                    mesh_checkpoint_interval_chunks=1,
+                    mesh_resume_attempts=0,
+                ),
+            )
+            h.register_catalog("tpch", create_tpch_connector())
+            case = (
+                h.run_replica_down_case
+                if scenario == "replica_down_mid_serve"
+                else h.run_replica_drain_case
+            )
+            try:
+                _, report = case(queries, seed)
+            except Exception as e:
+                failures.append(
+                    f"replica/{scenario}: raised {type(e).__name__}: {e}"
+                )
+                continue
+            if report["ok"] == 0:
+                failures.append(
+                    f"replica/{scenario}: zero oracle-equal results "
+                    f"({report})"
+                )
+            if report["mismatches"]:
+                failures.append(
+                    f"replica/{scenario}: {report['mismatches']} results "
+                    f"diverged from clean run with a replica down"
+                )
+            if report["untyped_error_count"]:
+                failures.append(
+                    f"replica/{scenario}: {report['untyped_error_count']} "
+                    f"untyped errors (first: {report['untyped_errors'][:1]})"
+                )
+            if report["hung_threads"]:
+                failures.append(
+                    f"replica/{scenario}: {report['hung_threads']} client "
+                    f"threads never returned — a query was lost"
+                )
+            if scenario == "replica_down_mid_serve":
+                if not report["mesh_faults_fired"]:
+                    failures.append(
+                        f"replica/{scenario}: the kill never landed on a "
+                        f"mid-chunk boundary ({report})"
+                    )
+                elif not report["replica.failovers"]:
+                    failures.append(
+                        f"replica/{scenario}: replica 0 died but nothing "
+                        f"failed over to the sibling ({report})"
+                    )
+            else:
+                if not report["drain_requested"]:
+                    failures.append(
+                        f"replica/{scenario}: the drain never raced an "
+                        f"in-flight chunked query ({report})"
+                    )
+                elif not report["replica_drained"]:
+                    failures.append(
+                        f"replica/{scenario}: replica 0 never quiesced "
+                        f"to zero inflight ({report})"
+                    )
+                elif not report["replica.failovers"]:
+                    failures.append(
+                        f"replica/{scenario}: drained with a query in "
+                        f"flight but nothing failed over ({report})"
+                    )
+            if verbose:
+                print(
+                    f"  chaos replica/{scenario}: ok "
+                    f"completed={report['completed']} ok={report['ok']} "
+                    f"failovers={report['replica.failovers']} "
+                    f"resumes={report['checkpoint_resumes']} hung=0"
+                )
     return failures

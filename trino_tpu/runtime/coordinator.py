@@ -423,6 +423,17 @@ class DistributedQueryRunner:
         self._plan_cache = PlanCache(
             max_entries=getattr(self.session, "plan_cache_entries", 256)
         )
+        # replicated serving meshes (runtime/replicas.py): carved
+        # lazily on the first mesh dispatch with mesh_replicas >= 2
+        # (device carving needs jax initialized, which query execution
+        # guarantees and construction must not force)
+        self._replicas = None
+        # serializes mesh runs on the single full-width mesh: a mesh is
+        # a single-program resource (two programs interleaving
+        # collectives on one device set deadlock their rendezvous).
+        # With a replica plane, the per-replica exec_lock takes over —
+        # replicas are the units of mesh concurrency.
+        self._mesh_exec_lock = threading.Lock()
         import collections
 
         self._completed_queries = collections.OrderedDict()
@@ -813,10 +824,7 @@ class DistributedQueryRunner:
             # deadline-bearing queries run here too, killed between
             # chunks with the same typed errors the page plane raises.
             # Unsupported plan shapes fall back to the page exchange.
-            from trino_tpu.parallel.mesh_plan import (
-                MeshExecutor,
-                MeshUnsupported,
-            )
+            from trino_tpu.parallel.mesh_plan import MeshUnsupported
             from trino_tpu.parallel.mesh_chunk import (
                 MeshDeviceLost,
                 MeshStuck,
@@ -833,9 +841,7 @@ class DistributedQueryRunner:
             )
             prev = set_compile_attribution(base_qid)
             try:
-                rows = MeshExecutor(
-                    self.catalogs, self.session
-                ).execute(subplan, preempt=preempt, query_span=query_span)
+                rows = self._execute_mesh(subplan, preempt, query_span)
                 self._last_data_plane = "mesh"
                 return MaterializedResult(
                     rows, *result_meta, data_plane="mesh"
@@ -1001,6 +1007,116 @@ class DistributedQueryRunner:
                 scheduler.abort()
         raise last_error
 
+    def _replica_manager(self):
+        """The replica plane, carved lazily on first mesh dispatch:
+        session.mesh_replicas >= 2 splits the device set into that many
+        identical sub-meshes (runtime/replicas.py). None — the single
+        full-width mesh — when replication is off or the device set is
+        too small to carve."""
+        n = int(getattr(self.session, "mesh_replicas", 1) or 1)
+        if n < 2:
+            return None
+        rm = self._replicas
+        if rm is not None and rm.n_replicas == n:
+            return rm
+        from trino_tpu.runtime.replicas import ReplicaManager
+
+        try:
+            rm = ReplicaManager(
+                n,
+                breaker_threshold=int(getattr(
+                    self.session, "replica_breaker_threshold", 3
+                )),
+                breaker_cooldown_s=float(getattr(
+                    self.session, "replica_breaker_cooldown_s", 1.0
+                )),
+            )
+        except ValueError:
+            rm = None  # fewer devices than replicas: keep one mesh
+        self._replicas = rm
+        return rm
+
+    def _execute_mesh(self, subplan, preempt, query_span):
+        """Mesh dispatch with replica placement and chunk-granular
+        failover. Single-replica sessions run the full-width mesh
+        directly. With a replica plane: place the least-loaded healthy
+        sub-mesh; when it dies (MeshStuck/MeshDeviceLost) or drains
+        mid-query, re-place onto a sibling — the sibling's chunk runner
+        finds the host-portable checkpoint under the device-independent
+        key and continues from chunk k on its own warm programs. Only
+        when no sibling remains (or failover is off) does the fault
+        re-raise into the caller's page-plane fallback."""
+        from trino_tpu.parallel.mesh_chunk import (
+            MeshDeviceLost,
+            MeshReplicaDraining,
+            MeshStuck,
+        )
+        from trino_tpu.parallel.mesh_plan import MeshExecutor
+
+        import contextlib
+
+        rm = self._replica_manager()
+        if rm is None:
+            ex = MeshExecutor(self.catalogs, self.session)
+            # width-1 meshes run no collectives and keep their historic
+            # concurrency; wider meshes serialize (see _mesh_exec_lock)
+            guard = (
+                self._mesh_exec_lock if getattr(ex, "n", 1) > 1
+                else contextlib.nullcontext()
+            )
+            with guard:
+                return ex.execute(
+                    subplan, preempt=preempt, query_span=query_span
+                )
+        failover_on = bool(
+            getattr(self.session, "replica_failover_enabled", True)
+        )
+        tried: set = set()
+        while True:
+            rep = rm.place(exclude=tried)
+            if rep is None:
+                raise MeshDeviceLost(
+                    "no schedulable replica "
+                    f"(tried {sorted(tried)} of {rm.n_replicas})"
+                )
+            try:
+                ex = MeshExecutor(
+                    self.catalogs, self.session,
+                    devices=rep.devices, replica_id=rep.replica_id,
+                    drain_check=rm.drain_check(rep),
+                )
+                # one mesh program at a time per sub-mesh (see
+                # Replica.exec_lock); concurrent queries spread across
+                # replicas via place() and queue only when all are busy
+                with rep.exec_lock:
+                    rows = ex.execute(
+                        subplan, preempt=preempt, query_span=query_span
+                    )
+                rm.report_success(rep)
+                return rows
+            except (MeshStuck, MeshDeviceLost) as e:
+                # a drain is a deliberate lifecycle maneuver, not a
+                # health signal — it must not push the breaker open
+                if not isinstance(e, MeshReplicaDraining):
+                    rm.report_failure(rep)
+                tried.add(rep.replica_id)
+                have_sibling = any(
+                    r.state == "active" and r.replica_id not in tried
+                    for r in rm.replicas
+                )
+                if not failover_on or not have_sibling:
+                    raise
+                rm.note_failover(rep)
+                if query_span is not None:
+                    query_span.event(
+                        "replica_failover",
+                        from_replica=rep.replica_id,
+                        error=type(e).__name__,
+                        reason=str(e)[:300],
+                    )
+            finally:
+                rm.release(rep)
+
     def _record_mesh_fallback(self, reason: str, query_span=None) -> None:
         """One mesh->page fallback: bump the aggregate counter, latch
         the reason for QueryInfo/EXPLAIN, export a per-reason counter
@@ -1104,6 +1220,17 @@ class DistributedQueryRunner:
             f"spill_mode_replans={c('spill_mode_replans')}"
         )
 
+    def _replica_line(self) -> str:
+        """The EXPLAIN ANALYZE replica-plane line: grid shape,
+        per-replica lifecycle states (first letter each: a/s/d) and
+        THIS runner's placement/failover counters — instance-scoped so
+        corpus output stays deterministic across process reuse."""
+        rm = self._replicas
+        if rm is None:
+            n = int(getattr(self.session, "mesh_replicas", 1) or 1)
+            return f"replicas= n={n} (single mesh)"
+        return rm.stats_line()
+
     def _explain_text(self, subplan) -> str:
         """Fragment rendering with per-fragment compile-churn census
         annotations (expected_xla_lowerings — sql/validate.py)."""
@@ -1152,6 +1279,7 @@ class DistributedQueryRunner:
             lines.append(self._resident_line())
             lines.append(self._recovery_line())
             lines.append(self._skew_line())
+            lines.append(self._replica_line())
             return MaterializedResult(
                 [["\n".join(lines)]], ["Query Plan"], [T.VARCHAR]
             )

@@ -42,7 +42,8 @@ class CircuitBreaker:
     re-opens it and restarts the cooldown."""
 
     def __init__(self, trip_threshold: int = 3, cooldown_s: float = 1.0,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 on_open: Optional[Callable[[], None]] = None):
         self.trip_threshold = trip_threshold
         self.cooldown_s = cooldown_s
         self._clock = clock
@@ -50,6 +51,9 @@ class CircuitBreaker:
         self.consecutive_failures = 0
         self.opened_at: Optional[float] = None
         self.trips = 0  # observability: how often this node graylisted
+        # trip listener (replica plane counts breaker_opens through it);
+        # fired on closed -> open only, never on half_open re-opens
+        self._on_open = on_open
 
     @property
     def is_open(self) -> bool:
@@ -67,6 +71,11 @@ class CircuitBreaker:
             self.state = "open"
             self.opened_at = self._clock()
             self.trips += 1
+            if self._on_open is not None:
+                try:
+                    self._on_open()
+                except Exception:
+                    pass  # a listener must never mask the trip itself
 
     def record_success(self) -> None:
         self.consecutive_failures = 0

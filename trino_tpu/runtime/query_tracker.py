@@ -97,34 +97,50 @@ def preemption_check(tracker, base_qid, cancel=None, deadline_epoch_s=None,
     Signature: check(done, total) — the caller's progress through its
     preemption boundaries, embedded in the kill message for
     observability. A checkpoint-resumed run (recovery tier) sets
-    `check.resumed_from` so a deadline kill mid-resume names where the
-    run restarted — the error stays typed and non-retryable either way:
-    resuming does not refresh a spent budget."""
+    `check.resumed_from` — and, after a replica failover, the replica
+    that picked the run up via `check.resumed_on` — so a deadline kill
+    mid-resume names where the run restarted — the error stays typed
+    and non-retryable either way: resuming does not refresh a spent
+    budget."""
     import time as _time
 
     clock = clock or _time.time
 
+    def _resume_ctx() -> str:
+        resumed = getattr(check, "resumed_from", None)
+        if resumed is None:
+            return ""
+        replica = getattr(check, "resumed_on", None)
+        on = f" on replica {replica}" if replica is not None else ""
+        return f" (resumed from chunk {resumed}{on})"
+
     def check(done: int, total: int) -> None:
         # a kill latched by the enforcement tick (planning/run/cpu
-        # limits) surfaces here as its typed error
-        tracker.check(base_qid)
+        # limits) surfaces here as its typed error — after a checkpoint
+        # restore it must still name the resume point, whichever
+        # enforcement path landed the kill first
+        try:
+            tracker.check(base_qid)
+        except QueryDeadlineError as e:
+            ctx = _resume_ctx()
+            if not ctx:
+                raise
+            raise type(e)(
+                f"{e} at mesh chunk {done}/{total}{ctx}"
+            ) from None
         if cancel is not None and cancel():
             raise QueryAbandonedError(
                 f"Query {base_qid} abandoned: client stopped "
                 "polling results"
             )
         if deadline_epoch_s is not None and clock() > deadline_epoch_s:
-            resumed = getattr(check, "resumed_from", None)
-            ctx = (
-                f" (resumed from chunk {resumed})"
-                if resumed is not None else ""
-            )
             raise ExceededTimeLimitError(
                 "Query exceeded the execution-time limit at mesh chunk "
-                f"{done}/{total}{ctx} [{EXCEEDED_TIME_LIMIT}]"
+                f"{done}/{total}{_resume_ctx()} [{EXCEEDED_TIME_LIMIT}]"
             )
 
     check.resumed_from = None
+    check.resumed_on = None
     return check
 
 

@@ -138,6 +138,11 @@ class CoordinatorServer:
                 ),
             )
         self.admission = admission
+        # replica-plane visibility in admission stats (the manager is
+        # carved lazily by the runner, hence a supplier, not a value)
+        self.admission.attach_replicas(
+            lambda: getattr(runner, "_replicas", None)
+        )
         _window_ms = float(
             getattr(_sess, "micro_batch_window_ms", 0.0) or 0.0
         )

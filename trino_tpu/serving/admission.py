@@ -77,11 +77,23 @@ class AdmissionPipeline:
         self.sheds = {lane: 0 for lane in LANES}
         self.admitted = {lane: 0 for lane in LANES}
         self._lock = threading.Lock()
+        # replica plane supplier (runtime/replicas.ReplicaManager or
+        # None): admitted lanes drain onto whichever healthy sub-mesh
+        # the coordinator places them on; stats() surfaces that balance
+        # next to the lane depths so one endpoint shows the whole
+        # admission -> placement funnel
+        self._replica_supplier = None
         for lane in LANES:
             METRICS.register_gauge(
                 f"admission.{lane}.queue_depth",
                 lambda lane=lane: float(self._depth[lane]),
             )
+
+    def attach_replicas(self, supplier) -> None:
+        """`supplier()` returns the live ReplicaManager (or None) at
+        stats time — a callable because the coordinator carves the
+        replica plane lazily, after this pipeline is built."""
+        self._replica_supplier = supplier
 
     def reserve(self, fast: bool = False) -> AdmissionReservation:
         from trino_tpu.runtime.metrics import METRICS
@@ -128,7 +140,7 @@ class AdmissionPipeline:
 
     def stats(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 lane: {
                     "depth": self._depth[lane],
                     "max_depth": self._max[lane],
@@ -137,6 +149,15 @@ class AdmissionPipeline:
                 }
                 for lane in LANES
             }
+        supplier = self._replica_supplier
+        if supplier is not None:
+            try:
+                rm = supplier()
+            except Exception:
+                rm = None
+            if rm is not None:
+                out["replicas"] = rm.stats()
+        return out
 
 
 # -- fast-path classification -------------------------------------------------

@@ -94,11 +94,16 @@ def run_serve_load(
     micro_batch_window_ms: float = 3.0,
     seed: int = 7,
     runner=None,
+    warmup_rounds: int = 1,
 ) -> dict:
     """Drive the statement protocol with an open-loop mixed workload;
     returns a report dict (see bench.py --serve for the JSON shape).
     `rate_qps=None` sizes the arrival rate from the warm-up latencies so
-    the offered load lands at `utilization` of measured capacity."""
+    the offered load lands at `utilization` of measured capacity.
+    `warmup_rounds` repeats the cold pass — a runner with N replicated
+    sub-meshes needs N rounds so every replica compiles its programs
+    before the measured phase (placements round-robin, so sequential
+    rounds land on distinct replicas)."""
     from trino_tpu.client import Client
     from trino_tpu.runtime.chaos import rows_equal
     from trino_tpu.runtime.metrics import METRICS
@@ -117,7 +122,8 @@ def run_serve_load(
     oracle: Dict[str, list] = {}
     warm_s: Dict[str, float] = {}
     for name, sql in statements.items():
-        runner.execute(sql)  # cold pass: compiles don't skew service time
+        for _ in range(max(1, warmup_rounds)):
+            runner.execute(sql)  # cold pass: compiles don't skew timing
         t0 = time.perf_counter()
         oracle[name] = runner.execute(sql).rows
         warm_s[name] = time.perf_counter() - t0
