@@ -105,6 +105,17 @@ Flags:
                      equal vs the gather path, beats its warm wall);
                      re-execs itself with an 8-device host platform,
                      so no device needed
+  --preempt-smoke    exercise checkpoint-backed preemptive
+                     multi-tenancy (runtime/scheduler.py): point-
+                     lookup p99 under a streaming q72-class analytic
+                     must stay within 5x the solo p99 (the fast lane
+                     preempts at chunk boundaries), a mid-analytic
+                     arrival parks the device carries and resumes them
+                     byte-identical with zero re-executed chunks and
+                     zero new lowerings, and the park/resume wall must
+                     beat abandoning + rerunning the analytic;
+                     re-execs itself with an 8-device host platform,
+                     so no device needed
 """
 
 from __future__ import annotations
@@ -2162,6 +2173,329 @@ def _failover_smoke(argv) -> int:
     return 1 if violations else 0
 
 
+def _preempt_smoke(argv) -> int:
+    """--preempt-smoke: CI gate for checkpoint-backed preemptive
+    multi-tenancy (trino_tpu/runtime/scheduler.py). One full-width
+    8-device CPU mesh is shared by a q72-class analytic and a
+    dimension point lookup. Three sections:
+
+    LATENCY: point p99 while the analytic streams chunks must stay
+    within 5x the solo point p99 — the fast lane preempts at the next
+    chunk boundary instead of queueing behind the whole scan — with
+    preemptions >= 1 and every mixed-mode analytic run oracle-equal.
+
+    PARK: a point arrival mid-analytic parks the analytic's device
+    carries into the host checkpoint store, the point answers, and the
+    analytic resumes from the parked boundary warm. Gates:
+    byte-identical rows, executed_chunk_steps == K (zero re-executed
+    chunks), parks == 1, zero new XLA lowerings, no parked state left.
+
+    KILL baseline: the same arrival handled the pre-scheduler way —
+    abandon the analytic at the same chunk, answer the point, re-run
+    the analytic from scratch. The park arm must beat this wall while
+    executing fewer chunk-steps. Exit 1 on any violation."""
+    if os.environ.get("PREEMPT_SMOKE_INNER") != "1":
+        # same clean-slate re-exec as --mesh-smoke: the multi-device
+        # host platform must be configured before jax initializes
+        env = dict(os.environ)
+        env["PREEMPT_SMOKE_INNER"] = "1"
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        return subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--preempt-smoke"],
+            env=env,
+        ).returncode
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    n_dev = len(jax.devices())
+
+    from trino_tpu.connectors.tpch import create_tpch_connector
+    from trino_tpu.engine import Session
+    from trino_tpu.parallel import mesh_chunk
+    from trino_tpu.parallel.mesh_chunk import LAST_RUN_INFO
+    from trino_tpu.recovery.checkpoint import CHECKPOINTS
+    from trino_tpu.runtime import DistributedQueryRunner
+    from trino_tpu.runtime.metrics import METRICS
+    from trino_tpu.runtime.query_tracker import QueryAbandonedError
+
+    POINT_Q = (
+        "select n_name, r_name from nation join region "
+        "on n_regionkey = r_regionkey where n_nationkey = 3"
+    )
+
+    def mk(**session_kw):
+        r = DistributedQueryRunner(
+            Session(catalog="tpch", schema="tiny", **session_kw),
+            n_workers=2, hash_partitions=2,
+        )
+        r.register_catalog("tpch", create_tpch_connector())
+        return r
+
+    violations = []
+    print(f"bench: preempt smoke ({n_dev}-device cpu mesh, q72-class "
+          "analytic vs point lookups, tpch tiny)")
+    oracle = mk(mesh_execution=False).execute(RECOVERY_Q).rows
+
+    # periodic checkpointing stays at its default (off): park takes its
+    # own exact snapshot at the preempted boundary, so the latency and
+    # park arms don't need interval snapshots — and a device_get every
+    # chunk boundary would widen the very gaps the point lookups wait on
+    r = mk(mesh_chunk_rows=256)
+    clean = r.execute(RECOVERY_Q).rows  # warm the analytic programs
+    if r._last_data_plane != "mesh":
+        violations.append(
+            f"clean analytic took {r._last_data_plane}, not the mesh "
+            f"(fallback: {r.last_mesh_fallback})"
+        )
+    if clean != oracle:
+        violations.append("clean mesh analytic != page-plane oracle")
+    K = int(LAST_RUN_INFO.get("chunks") or 0)
+    point_clean = r.execute(POINT_Q).rows  # warm the point programs
+    sched = r._mesh_scheduler
+    if sched is None:
+        violations.append("mesh scheduler never engaged on dispatch")
+        for v in violations:
+            print(f"bench: preempt VIOLATION: {v}", file=sys.stderr)
+        return 1
+
+    # -- KILL baseline: abandon at fault_k, answer, rerun from zero --
+    fault_k = max(1, K // 2)
+    st_kill = {"fired": 0}
+
+    def abandon_hook(k, Ktot):
+        if k == fault_k and not st_kill["fired"]:
+            st_kill["fired"] = 1
+
+    steps0 = METRICS.snapshot().get("mesh.chunk_steps", 0.0)
+    mesh_chunk.MESH_FAULT_HOOK = abandon_hook
+    t0 = time.time()
+    try:
+        r.execute(RECOVERY_Q, cancel=lambda: bool(st_kill["fired"]))
+        violations.append("kill arm: abandoned analytic completed")
+    except QueryAbandonedError:
+        pass
+    finally:
+        mesh_chunk.MESH_FAULT_HOOK = None
+    if r.execute(POINT_Q).rows != point_clean:
+        violations.append("kill arm: point run diverged")
+    # belt and braces: if the abandoned run left any snapshot behind,
+    # resubmitting the same statement would warm-resume mid-query —
+    # that's the recovery tier helping, not the kill baseline. Drop
+    # everything so the rerun honestly starts at zero.
+    CHECKPOINTS.clear()
+    if r.execute(RECOVERY_Q).rows != oracle:
+        violations.append("kill arm: analytic rerun diverged")
+    wall_kill = time.time() - t0
+    steps_kill = METRICS.snapshot().get("mesh.chunk_steps", 0.0) - steps0
+    if not st_kill["fired"]:
+        violations.append("kill arm: the abandon hook never fired")
+
+    # -- PARK: same arrival chunk, park/resume instead ----------------
+    main_t = threading.current_thread()
+    st_park = {"fired": 0, "rows": None, "err": None}
+
+    def point_runner():
+        try:
+            st_park["rows"] = r.execute(POINT_Q).rows
+        except Exception as e:
+            st_park["err"] = f"{type(e).__name__}: {e}"
+
+    pth = threading.Thread(target=point_runner, daemon=True)
+
+    def park_hook(k, Ktot):
+        # main-thread filter: the point thread's own chunk loop fires
+        # this hook too. Holding the boundary until the fast seat is
+        # visible makes the NEXT boundary park deterministically.
+        if (k == fault_k and not st_park["fired"]
+                and threading.current_thread() is main_t):
+            st_park["fired"] = 1
+            pth.start()
+            wait_until = time.time() + 10.0
+            while (not sched.waiting_count(fast=True)
+                   and time.time() < wait_until):
+                time.sleep(0.002)
+
+    s0 = sched.stats()
+    compiles0 = METRICS.snapshot().get("xla_compiles", 0.0)
+    steps1 = METRICS.snapshot().get("mesh.chunk_steps", 0.0)
+    mesh_chunk.MESH_FAULT_HOOK = park_hook
+    t0 = time.time()
+    try:
+        rows_park = r.execute(RECOVERY_Q).rows
+    finally:
+        mesh_chunk.MESH_FAULT_HOOK = None
+    pth.join(timeout=60.0)
+    wall_park = time.time() - t0
+    steps_park = METRICS.snapshot().get("mesh.chunk_steps", 0.0) - steps1
+    new_lowerings = METRICS.snapshot().get("xla_compiles", 0.0) - compiles0
+    info = dict(LAST_RUN_INFO)
+    parks = sched.stats()["parks"] - s0["parks"]
+    re_exec_park = int(info.get("executed_chunk_steps") or 0) - K
+    if not st_park["fired"]:
+        violations.append("park arm: the arrival hook never fired")
+    if st_park["err"]:
+        violations.append(f"park arm: point died: {st_park['err']}")
+    elif st_park["rows"] != point_clean:
+        violations.append("park arm: point run diverged")
+    if rows_park != clean:
+        violations.append(
+            "park arm: resumed analytic is not byte-identical to the "
+            "clean run"
+        )
+    if parks != 1:
+        violations.append(f"park arm: expected exactly 1 park, saw "
+                          f"{parks}")
+    if re_exec_park != 0:
+        violations.append(
+            f"park arm re-executed {re_exec_park} chunk-steps "
+            "(expected 0: resume is from the parked boundary)"
+        )
+    if new_lowerings > 0:
+        violations.append(
+            f"park arm lowered {new_lowerings:g} new XLA programs "
+            "(expected 0: parked carries restore onto warm rungs)"
+        )
+    if CHECKPOINTS.parked_count():
+        violations.append(
+            f"{CHECKPOINTS.parked_count()} parked snapshots leaked "
+            "past resume"
+        )
+    if wall_park >= wall_kill:
+        violations.append(
+            f"park wall {wall_park:.2f}s did not beat the "
+            f"abandon+rerun wall {wall_kill:.2f}s"
+        )
+    if steps_park >= steps_kill:
+        violations.append(
+            f"park arm spent {steps_park:g} chunk-steps vs the kill "
+            f"arm's {steps_kill:g} — parking saved nothing"
+        )
+
+    # -- LATENCY: solo point p99, then point p99 under the analytic --
+    # runs last: the park arm above warmed the park path (first-ever
+    # park pays one-time host-buffer costs that would otherwise land on
+    # the first mixed sample)
+    # 100 mixed samples so p99 is a real percentile (index 98), not the
+    # sample max — the mixed tail is one in-flight chunk gap + a park
+    # cycle + the point itself (~90-140ms), and a single GIL-jitter
+    # outlier shouldn't decide the gate
+    solo_reps, mixed_reps = 50, 100
+
+    def p99(walls):
+        w = sorted(walls)
+        return w[min(len(w) - 1, int(round(0.99 * (len(w) - 1))))]
+
+    solo = []
+    for _ in range(solo_reps):
+        t0 = time.time()
+        rows = r.execute(POINT_Q).rows
+        solo.append(time.time() - t0)
+        if rows != point_clean:
+            violations.append("solo point run diverged")
+            break
+    p99_solo = p99(solo)
+
+    stop = threading.Event()
+    analytic = {"runs": 0, "bad": 0, "err": None}
+
+    def analytic_loop():
+        try:
+            while not stop.is_set():
+                if r.execute(RECOVERY_Q).rows != oracle:
+                    analytic["bad"] += 1
+                analytic["runs"] += 1
+        except Exception as e:  # surfaced as a violation below
+            analytic["err"] = f"{type(e).__name__}: {e}"
+
+    pre0 = sched.stats()["preemptions"]
+    th = threading.Thread(target=analytic_loop, daemon=True)
+    th.start()
+    wait_until = time.time() + 5.0
+    while sched.holder_query() is None and time.time() < wait_until:
+        time.sleep(0.005)  # let the analytic actually hold the mesh
+    mixed, streaming = [], []
+    for _ in range(mixed_reps):
+        # the p99 bound is scoped to arrivals while the analytic holds
+        # the mesh (streams chunks) — that's the wait the scheduler
+        # owns. Arrivals during the analytic's host planning/feed-build
+        # phases contend only for host CPU (the seat is free); they're
+        # reported in the overall p99 but not gated
+        holder_at_arrival = sched.holder_query() is not None
+        t0 = time.time()
+        rows = r.execute(POINT_Q).rows
+        wall = time.time() - t0
+        mixed.append(wall)
+        if holder_at_arrival:
+            streaming.append(wall)
+        if rows != point_clean:
+            violations.append("mixed point run diverged")
+            break
+        time.sleep(0.02)  # hand chunks back to the analytic
+    stop.set()
+    th.join(timeout=120.0)
+    p99_mixed = p99(mixed)
+    p99_stream = p99(streaming) if streaming else p99_mixed
+    preempts = sched.stats()["preemptions"] - pre0
+    if len(streaming) < 10:
+        violations.append(
+            f"only {len(streaming)} of {len(mixed)} points arrived "
+            "while the analytic held the mesh — the mixed window "
+            "never really contended"
+        )
+    if analytic["err"]:
+        violations.append(f"mixed analytic died: {analytic['err']}")
+    if analytic["bad"]:
+        violations.append(
+            f"{analytic['bad']} mixed analytic runs diverged from "
+            "the oracle"
+        )
+    if analytic["runs"] < 1:
+        violations.append(
+            "the analytic made no progress during the mixed window"
+        )
+    if preempts < 1:
+        violations.append(
+            "no fast-lane preemption ever fired during mixed traffic"
+        )
+    if p99_stream > 5.0 * p99_solo:
+        violations.append(
+            f"streaming-phase point p99 {p99_stream * 1e3:.1f}ms blew "
+            f"the 5x solo-p99 bound ({p99_solo * 1e3:.1f}ms solo)"
+        )
+
+    for v in violations:
+        print(f"bench: preempt VIOLATION: {v}", file=sys.stderr)
+    print(json.dumps({
+        "preempt_smoke": {
+            "devices": n_dev,
+            "chunks": K,
+            "point_p99_solo_ms": round(p99_solo * 1e3, 2),
+            "point_p99_streaming_ms": round(p99_stream * 1e3, 2),
+            "point_p99_mixed_overall_ms": round(p99_mixed * 1e3, 2),
+            "streaming_samples": len(streaming),
+            "slowdown_x": round(p99_stream / max(p99_solo, 1e-9), 2),
+            "analytic_runs_during_mixed": analytic["runs"],
+            "preemptions_during_mixed": preempts,
+            "park_chunk": fault_k + 1,
+            "parks": parks,
+            "re_executed_chunks_park": re_exec_park,
+            "chunk_steps_kill": steps_kill,
+            "chunk_steps_park": steps_park,
+            "kill_wall_s": round(wall_kill, 3),
+            "park_wall_s": round(wall_park, 3),
+            "new_lowerings_on_park": new_lowerings,
+            "violations": len(violations),
+        }
+    }))
+    return 1 if violations else 0
+
+
 def _zipf_keys(rng, n: int, n_keys: int, s: float):
     """Seedable zipf-distributed join keys in [0, n_keys): key rank r
     drawn with probability proportional to 1/(r+1)^s. At s=1.4 over 64
@@ -2545,6 +2879,8 @@ def main() -> None:
         sys.exit(_failover_smoke(sys.argv))
     if "--skew-smoke" in sys.argv:
         sys.exit(_skew_smoke(sys.argv))
+    if "--preempt-smoke" in sys.argv:
+        sys.exit(_preempt_smoke(sys.argv))
     if "--validate-corpus" in sys.argv:
         sys.exit(_validate_corpus(sys.argv))
     if os.environ.get("BENCH_INNER") == "1":

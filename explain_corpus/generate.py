@@ -938,6 +938,122 @@ def corpus_13_replica_analyze():
     )
 
 
+def corpus_14_scheduler_analyze():
+    """The preemptive mesh scheduler (trino_tpu/runtime/scheduler.py):
+    a chunked analytic streams chunk-steps on the full-width mesh; a
+    fast-lane point lookup (dimension-decorated, serving/admission.py
+    `is_fast_lane`) arrives mid-stream and PREEMPTS it — the analytic
+    parks (device carries snapshot to the host checkpoint store, device
+    memory released), the lookup runs, and the analytic resumes from
+    chunk k on the same warm rungs: zero re-executed chunk-steps,
+    byte-identical rows. The trailing `scheduler=` line of EXPLAIN
+    ANALYZE pins the park/resume/preemption counters — instance-scoped,
+    so the numbers are exact. Timings redacted as in corpus 07."""
+    import re
+    import threading
+    import time
+
+    from trino_tpu.parallel import mesh_chunk
+    from trino_tpu.recovery import CHECKPOINTS
+    from trino_tpu.runtime import DistributedQueryRunner
+
+    CHECKPOINTS.clear()
+    r = DistributedQueryRunner(
+        Session(catalog="tpch", schema="tiny", mesh_chunk_rows=1024),
+        n_workers=2,
+        hash_partitions=2,
+    )
+    r.register_catalog("tpch", create_tpch_connector())
+    analytic = (
+        "select l_returnflag, count(*), sum(l_quantity) from lineitem "
+        "group by l_returnflag"
+    )
+    point = (
+        "select n_name, r_name from nation join region "
+        "on n_regionkey = r_regionkey where n_nationkey = 3"
+    )
+    # warm both shapes solo: every program below re-dispatches cached
+    # rungs, so the preempted run demonstrably mints zero new lowerings
+    clean = r.execute(analytic).rows
+    n_chunks = mesh_chunk.LAST_RUN_INFO["chunks"]
+    point_clean = r.execute(point).rows
+    state = {"fired": False, "point_rows": None}
+    main_thread = threading.current_thread()
+
+    def inject_point(k, K):
+        # fire once, on the analytic's chunk loop only (the point
+        # lookup is single-chunk, and its run is on another thread)
+        if threading.current_thread() is not main_thread:
+            return
+        if state["fired"] or k < 1 or K < 3:
+            return
+        state["fired"] = True
+
+        def run_point():
+            state["point_rows"] = r.execute(point).rows
+
+        threading.Thread(target=run_point, daemon=True).start()
+        # hold this boundary until the fast submission reaches the run
+        # queue, so the NEXT boundary deterministically parks
+        sched = r._mesh_scheduler
+        deadline = time.monotonic() + 10.0
+        while (
+            sched.waiting_count(fast=True) < 1
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.002)
+
+    mesh_chunk.MESH_FAULT_HOOK = inject_point
+    try:
+        parked_rows = r.execute(analytic).rows
+    finally:
+        mesh_chunk.MESH_FAULT_HOOK = None
+    assert state["fired"], "preempt hook never fired"
+    info = mesh_chunk.LAST_RUN_INFO
+    assert info["parks"] == 1, f"expected exactly one park: {info}"
+    deadline = time.monotonic() + 10.0
+    while state["point_rows"] is None and time.monotonic() < deadline:
+        time.sleep(0.002)
+    events = [
+        f"analytic: {n_chunks} chunk-steps on the full-width mesh; a "
+        "fast-lane point lookup arrived at chunk 1",
+        f"park: parks={info['parks']} — carries snapshotted to the "
+        "host checkpoint store, device memory released, lookup granted "
+        "the mesh",
+        f"point lookup rows == warm solo run: "
+        f"{state['point_rows'] == point_clean}",
+        f"resume: unparks={info['unparks']}, "
+        f"executed_chunk_steps={info['executed_chunk_steps']} "
+        f"(== {n_chunks}: zero re-executed chunk-steps)",
+        f"rows byte-identical to the uninterrupted run: "
+        f"{parked_rows == clean}",
+    ]
+    out = r.execute("EXPLAIN ANALYZE " + analytic).rows[0][0]
+
+    def redact(text):
+        text = re.sub(r"\b(wall|cpu)=\d+(\.\d+)?ms", r"\1=#ms", text)
+        text = re.sub(r"\b(add|get|finish)=\d+(\.\d+)?", r"\1=#", text)
+        text = re.sub(r"\btask q\d+\.", "task q#.", text)
+        text = re.sub(r"resident= .*", "resident= #", text)
+        text = re.sub(r"recovery= .*", "recovery= #", text)
+        text = re.sub(r"skew= .*", "skew= #", text)
+        return text
+
+    emit(
+        "14_scheduler_analyze.txt",
+        (f"QUERY\n{analytic}", ""),
+        ("checkpoint-backed preemption on one mesh: a fast-lane point "
+         "lookup\narriving mid-stream parks the running analytic at the "
+         "next chunk boundary\nand the analytic resumes from chunk k "
+         "warm — zero re-executed chunk-steps,\nbyte-identical rows",
+         "\n".join(events)),
+        ("EXPLAIN ANALYZE after the park/resume cycle: the trailing "
+         "scheduler=\nline reports this runner's instance-scoped "
+         "park/resume/preemption\ncounters (wall-clock values redacted "
+         "to `#`)", redact(out)),
+    )
+
+
 def write_all(out_dir=None):
     """Regenerate every corpus file (into `out_dir` when given — used
     by tests/test_explain_corpus.py to diff against committed files)."""
@@ -957,6 +1073,7 @@ def write_all(out_dir=None):
         corpus_11_recovery_analyze()
         corpus_12_skew_analyze()
         corpus_13_replica_analyze()
+        corpus_14_scheduler_analyze()
     finally:
         _OUT_DIR[0] = HERE
 

@@ -120,6 +120,25 @@ def tpcds_cluster():
     return r
 
 
+@pytest.fixture(scope="session")
+def tpch_cluster_mesh_off():
+    """Page-plane (mesh_execution=False) 2-worker cluster. The chunk /
+    recovery / replica modules each need the page plane's answers as a
+    byte-identity oracle, and test_local_exchange needs a
+    task_concurrency=2 cluster (2 is the session default) — one shared
+    runner serves all of them."""
+    from trino_tpu.connectors.tpch import create_tpch_connector
+    from trino_tpu.engine import Session
+    from trino_tpu.runtime import DistributedQueryRunner
+
+    r = DistributedQueryRunner(
+        Session(catalog="tpch", schema="tiny", mesh_execution=False),
+        n_workers=2, hash_partitions=2,
+    )
+    r.register_catalog("tpch", create_tpch_connector())
+    return r
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_between_modules():
     """The full suite compiles 1000+ XLA programs in one process; this

@@ -63,6 +63,18 @@ def _runner(bucketed):
     return r, data
 
 
+# the join tests below are read-only — share one cluster per layout
+# instead of rebuilding runner + data per test (tier-1 wall)
+@pytest.fixture(scope="module")
+def bucketed_cluster():
+    return _runner(bucketed=True)
+
+
+@pytest.fixture(scope="module")
+def unbucketed_cluster():
+    return _runner(bucketed=False)
+
+
 SQL = ("select a.k, sum(a.v + b.w) from ta a join tb b on a.k = b.k "
        "group by a.k")
 
@@ -112,7 +124,9 @@ def test_bucket_splits_partition_and_cover_the_table():
         assert sorted(seen) == sorted(zip(ka.tolist(), va.tolist()))
 
 
-def test_cobucketed_plan_has_no_repartition():
+def test_cobucketed_plan_has_no_repartition(
+    bucketed_cluster, unbucketed_cluster
+):
     from trino_tpu.sql.fragmenter import plan_distributed
     from trino_tpu.sql.parser import parse
 
@@ -123,14 +137,14 @@ def test_cobucketed_plan_has_no_repartition():
         )
         return sum(1 for f in sub.all_fragments() if f.output_kind == "hash")
 
-    rb, _ = _runner(bucketed=True)
-    ru, _ = _runner(bucketed=False)
+    rb, _ = bucketed_cluster
+    ru, _ = unbucketed_cluster
     assert n_hash_fragments(rb) == 0
     assert n_hash_fragments(ru) >= 1
 
 
-def test_cobucketed_join_runs_exchange_free_on_mesh():
-    r, (ka, va, kb, wb) = _runner(bucketed=True)
+def test_cobucketed_join_runs_exchange_free_on_mesh(bucketed_cluster):
+    r, (ka, va, kb, wb) = bucketed_cluster
     before = dict(mesh_plan.MESH_COUNTERS)
     res = r.execute(SQL)
     after = mesh_plan.MESH_COUNTERS
@@ -142,10 +156,10 @@ def test_cobucketed_join_runs_exchange_free_on_mesh():
         _expected_join_sum(ka, va, kb, wb)
 
 
-def test_unbucketed_join_does_repartition():
+def test_unbucketed_join_does_repartition(unbucketed_cluster):
     """The exchange-free assert above is meaningful: the same query over
     unbucketed tables DOES ride all_to_all."""
-    r, (ka, va, kb, wb) = _runner(bucketed=False)
+    r, (ka, va, kb, wb) = unbucketed_cluster
     before = dict(mesh_plan.MESH_COUNTERS)
     res = r.execute(SQL)
     after = mesh_plan.MESH_COUNTERS
@@ -155,11 +169,11 @@ def test_unbucketed_join_does_repartition():
         _expected_join_sum(ka, va, kb, wb)
 
 
-def test_bucketed_join_against_repartitioned_side():
+def test_bucketed_join_against_repartitioned_side(bucketed_cluster):
     """Mixed case: a bucketed scan joined with a DERIVED (runtime
     repartitioned) side must still align bucket i with partition i —
     this is exactly the np/device hash parity contract."""
-    r, (ka, va, kb, wb) = _runner(bucketed=True)
+    r, (ka, va, kb, wb) = bucketed_cluster
     sql = ("select a.k, sum(a.v + d.mw) from ta a join "
            "(select k, max(w) mw from tb group by k) d on a.k = d.k "
            "group by a.k")

@@ -154,17 +154,15 @@ def test_threaded_pipeline_overlap():
 # -- end to end: distributed queries with intra-task parallelism on --
 
 
-def test_distributed_with_task_concurrency():
+def test_distributed_with_task_concurrency(tpch_cluster_mesh_off):
     from trino_tpu.connectors.tpch import create_tpch_connector
     from trino_tpu.engine import Session
     from trino_tpu.runtime.coordinator import DistributedQueryRunner
 
-    r = DistributedQueryRunner(
-        Session(catalog="tpch", schema="tiny", mesh_execution=False,
-                task_concurrency=2),
-        n_workers=2, hash_partitions=2,
-    )
-    r.register_catalog("tpch", create_tpch_connector())
+    # the shared page-plane cluster runs at the session default
+    # task_concurrency=2 — exactly the concurrent arm this test needs
+    r = tpch_cluster_mesh_off
+    assert r.session.task_concurrency == 2
     # multi-build join + distributed agg: builds run concurrently and
     # the final stage overlaps remote pulls with compute
     rows = r.execute(

@@ -59,12 +59,12 @@ def mk_runner(**session_kw):
 
 
 @pytest.fixture(scope="module")
-def baseline_rows():
-    """Page-plane answers — the oracle every chunk setting must hit."""
-    r = mk_runner(mesh_execution=False)
+def baseline_rows(tpch_cluster_mesh_off):
+    """Page-plane answers — the oracle every chunk setting must hit.
+    Read-only queries on the shared session cluster (tier-1 wall)."""
     return {
-        "group": r.execute(Q_GROUP).rows,
-        "join": r.execute(Q_JOIN).rows,
+        "group": tpch_cluster_mesh_off.execute(Q_GROUP).rows,
+        "join": tpch_cluster_mesh_off.execute(Q_JOIN).rows,
     }
 
 

@@ -79,9 +79,9 @@ def _clean_recovery_state():
 
 
 @pytest.fixture(scope="module")
-def baseline_rows():
-    r = mk_runner(mesh_execution=False)
-    return r.execute(Q_GROUP).rows
+def baseline_rows(tpch_cluster_mesh_off):
+    # read-only query on the shared page-plane cluster (tier-1 wall)
+    return tpch_cluster_mesh_off.execute(Q_GROUP).rows
 
 
 class OneShotFault:

@@ -274,6 +274,40 @@ for _name, _type, _default, _desc, _allowed in [
     ("replica_breaker_cooldown_s", float, 1.0,
      "seconds an open replica breaker sits out before a half-open "
      "placement probe may try the replica again", None),
+    # -- preemptive multi-tenancy (runtime/scheduler.py) --
+    ("mesh_scheduler", bool, True,
+     "run mesh queries through the chunk-granular weighted-fair "
+     "scheduler (per-mesh run queue with fast-lane point lookups and "
+     "virtual-time accounting per resource group) instead of a bare "
+     "exec lock; False restores PR 17 serialization", None),
+    ("preemption_enabled", bool, True,
+     "allow a fast-lane arrival to park the running analytic at the "
+     "next chunk boundary (device carries snapshot to the host "
+     "checkpoint store, device memory released, resume from chunk k "
+     "on the same warm rungs); False degrades preemption to in-place "
+     "yields between whole runs", None),
+    ("park_max_bytes", int, 256 << 20,
+     "host-memory budget for parked query snapshots in the mesh "
+     "checkpoint store; a park that would exceed it is refused and "
+     "the query runs to completion instead (never query failure)",
+     None),
+    ("mesh_scheduler_weights", str, "",
+     "per-resource-group scheduling weights for the mesh scheduler, "
+     "'group=weight,...' (scheduling_weight analogue); unlisted "
+     "groups weigh 1", None),
+    ("mesh_scheduler_min_slice_chunks", int, 1,
+     "minimum chunk-steps a query runs between preemptions "
+     "(bounded-slice guarantee: a continuous fast-lane stream cannot "
+     "live-lock the analytic)", None),
+    ("mesh_scheduler_group", str, "",
+     "resource group this session's mesh queries are accounted to in "
+     "the weighted-fair scheduler; empty uses 'default'", None),
+    ("mesh_steal_enabled", bool, True,
+     "on drain failover of a chunked all-append query, split the "
+     "unstarted chunk range across two sibling replicas (primary "
+     "resumes [k, mid), helper computes [mid, K) and the primary "
+     "merges the helper's packed live rows) instead of resuming "
+     "wholesale on one", None),
     # -- observability (runtime/tracing.py) --
     ("query_trace", str, "off",
      "record a full span tree per query (phases, stages, task attempts, "

@@ -180,6 +180,19 @@ class Session:
     replica_failover_enabled: bool = True
     replica_breaker_threshold: int = 3
     replica_breaker_cooldown_s: float = 1.0
+    # preemptive multi-tenancy (runtime/scheduler.py): chunk-granular
+    # weighted-fair run queue per mesh with a fast lane for point
+    # lookups; a fast arrival parks the running analytic (carries
+    # snapshot to the host checkpoint store within park_max_bytes,
+    # resume from chunk k warm); drain failover may split the
+    # unstarted chunk range across siblings (work stealing)
+    mesh_scheduler: bool = True
+    preemption_enabled: bool = True
+    park_max_bytes: int = 256 << 20
+    mesh_scheduler_weights: str = ""
+    mesh_scheduler_min_slice_chunks: int = 1
+    mesh_scheduler_group: str = ""
+    mesh_steal_enabled: bool = True
 
     def set_property(self, name: str, value) -> None:
         """SET SESSION entry point — validated through the typed

@@ -564,6 +564,73 @@ def _pad_shards(batch: RelBatch, n: int, old_cap: int, new_cap: int) -> RelBatch
     return RelBatch(cols, lv.reshape(-1))
 
 
+def _merge_out_carry(mine: RelBatch, theirs: RelBatch,
+                     n: int) -> Optional[RelBatch]:
+    """Append `theirs`'s packed live rows after `mine`'s per-shard live
+    count (drain-failover work stealing: `mine` holds chunks [k0, mid),
+    `theirs` holds [mid, K) computed from zero carries on a sibling).
+    `_accumulate` packs live rows densely at the shard front in chunk
+    order, so this concatenation is byte-identical to the sequential
+    layout. Returns None when the combined rows overflow the shard
+    capacity (a sequential run would have taken the overflow-restart
+    ladder, which a merge cannot replay) or the packing precondition
+    fails."""
+    try:
+        if mine.width != theirs.width or mine.capacity != theirs.capacity:
+            return None
+        cap = mine.capacity // n
+        m_live = np.asarray(mine.live_mask()).astype(bool).reshape(n, cap)
+        t_live = np.asarray(theirs.live_mask()).astype(bool).reshape(n, cap)
+        datas, valids = [], []
+        for c in mine.columns:
+            d = np.asarray(c.data)
+            datas.append(d.reshape((n, cap) + d.shape[1:]).copy())
+            valids.append(
+                None
+                if c.valid is None
+                else np.asarray(c.valid).astype(bool).reshape(n, cap).copy()
+            )
+        new_live = m_live.copy()
+        for s in range(n):
+            cm = int(m_live[s].sum())
+            idx_t = np.nonzero(t_live[s])[0]
+            ct = len(idx_t)
+            if cm + ct > cap:
+                return None
+            if (cm and not m_live[s][:cm].all()) or (
+                ct and int(idx_t[-1]) != ct - 1
+            ):
+                return None  # rows not packed at the front
+            if ct == 0:
+                continue
+            for j, c in enumerate(theirs.columns):
+                td = np.asarray(c.data)
+                td = td.reshape((n, cap) + td.shape[1:])
+                datas[j][s, cm:cm + ct] = td[s, idx_t]
+                if valids[j] is not None:
+                    tv = (
+                        np.ones(cap, dtype=bool)
+                        if c.valid is None
+                        else np.asarray(c.valid).astype(bool).reshape(
+                            n, cap
+                        )[s]
+                    )
+                    valids[j][s, cm:cm + ct] = tv[idx_t]
+            new_live[s, cm:cm + ct] = True
+        cols = [
+            Column(
+                c.type,
+                datas[j].reshape((n * cap,) + datas[j].shape[2:]),
+                None if valids[j] is None else valids[j].reshape(-1),
+                c.dictionary,
+            )
+            for j, c in enumerate(mine.columns)
+        ]
+        return RelBatch(cols, new_live.reshape(-1))
+    except Exception:
+        return None
+
+
 # ---------------------------------------------------------------------------
 # Program record: jitted prelude/step/flush + host metadata, cacheable
 # ---------------------------------------------------------------------------
@@ -983,6 +1050,9 @@ class ChunkedMeshRunner:
             "checkpoints": 0,
             "resumes": 0,
             "resumed_from_chunk": None,
+            "parks": 0,
+            "unparks": 0,
+            "steals": 0,
         }
 
     # -- program record ----------------------------------------------
@@ -1038,12 +1108,24 @@ class ChunkedMeshRunner:
         prev_replica = active_replica()
         _ACTIVE_REPLICA.replica = getattr(self.ex, "replica_id", None)
         try:
+            sched_job = getattr(self.ex, "sched_job", None)
+            if sched_job is not None:
+                # the seat guards DEVICE phases only (prelude, chunk
+                # steps, flush): planning and host feed builds already
+                # ran before this point, outside the seat, so a fast
+                # arrival never queues behind another query's host
+                # prep. Typed kills and drain checks fire out of the
+                # wait as they do at any boundary.
+                sched_job.scheduler.acquire(sched_job)
             caps: Dict[str, int] = {}
             self._run_stats = {
                 "executed_chunk_steps": 0,
                 "checkpoints": 0,
                 "resumes": 0,
                 "resumed_from_chunk": None,
+                "parks": 0,
+                "unparks": 0,
+                "steals": 0,
             }
             resume_budget = int(
                 getattr(self.session, "mesh_resume_attempts", 2) or 0
@@ -1097,6 +1179,18 @@ class ChunkedMeshRunner:
 
                         ckpt = CHECKPOINTS.get(key)
                     if ckpt is None:
+                        # annotate for the coordinator's failover path:
+                        # with a live checkpoint under this key, the
+                        # unstarted chunk range can be split across two
+                        # sibling replicas (work stealing) — but only
+                        # when every carry is an append accumulator
+                        # (group carries hold cross-chunk state that
+                        # cannot merge byte-identically)
+                        e.ckpt_key = key
+                        e.steal_ok = bool(record.carry_meta) and all(
+                            kind == "out"
+                            for kind, _fid in record.carry_meta
+                        )
                         raise
                     resume_budget -= 1
                     if task_span is not None:
@@ -1130,6 +1224,9 @@ class ChunkedMeshRunner:
                 "checkpoints": stats["checkpoints"],
                 "resumes": stats["resumes"],
                 "resumed_from_chunk": stats["resumed_from_chunk"],
+                "parks": stats["parks"],
+                "unparks": stats["unparks"],
+                "steals": stats["steals"],
             }
             key = self._ckpt_key()
             if key is not None:
@@ -1178,20 +1275,22 @@ class ChunkedMeshRunner:
             getattr(self.session, "mesh_checkpoint_interval_chunks", 0)
             or 0
         )
-        ckpt_key = (
-            self._ckpt_key()
-            if interval > 0 and self.cplan.chunked
-            else None
-        )
+        # park_key: program identity for scheduler parks (and for the
+        # resume-on-entry lookup — a parked query failed over by a
+        # drain resumes here on the sibling even with periodic
+        # checkpointing off); ckpt_key additionally gates the
+        # every-N-chunks fault snapshots
+        park_key = self._ckpt_key() if self.cplan.chunked else None
+        ckpt_key = park_key if interval > 0 else None
 
         carries: tuple = ()
         if record.step_fn is not None:
             k0 = 0
             carries = None
-            if ckpt_key is not None:
+            if park_key is not None:
                 from trino_tpu.recovery.checkpoint import CHECKPOINTS
 
-                ck = CHECKPOINTS.get(ckpt_key)
+                ck = CHECKPOINTS.get(park_key)
                 if ck is not None and ck.n_chunks == K and 0 < ck.next_chunk <= K:
                     carries = self._restore_carries(ck, record)
                     if carries is not None:
@@ -1224,6 +1323,17 @@ class ChunkedMeshRunner:
                     for t in record.carry_sds
                 )
             drain_check = getattr(self.ex, "drain_check", None)
+            # preemptive scheduler seat (runtime/scheduler.py): consult
+            # at every completed boundary whether to keep the mesh,
+            # yield in place, or park to the checkpoint store
+            sched_job = getattr(self.ex, "sched_job", None)
+            # drain-failover work stealing, primary side: at boundary
+            # `mid` adopt the helper replica's [mid, K) carries instead
+            # of executing those chunks ("merge", mid, key, done_event,
+            # caps, timeout_s)
+            steal = getattr(self.ex, "steal_ctx", None)
+            if steal is not None and steal[0] != "merge":
+                steal = None
             from trino_tpu.runtime.metrics import METRICS
 
             with op_span("MeshChunkStep", attempt=attempt, chunks=K):
@@ -1280,6 +1390,37 @@ class ChunkedMeshRunner:
                             f"{dt:.3f}s (stuck_task_interrupt_s="
                             f"{watchdog_s}); retryable on the page plane"
                         )
+                    if (
+                        steal is not None
+                        and (k + 1) == steal[1]
+                        and (k + 1) < K
+                    ):
+                        merged = self._steal_merge(record, carries, steal)
+                        if merged is not None:
+                            carries = merged
+                            self._run_stats["steals"] = (
+                                int(self._run_stats["steals"]) + 1
+                            )
+                            METRICS.increment("scheduler.steals")
+                            if task_span is not None:
+                                task_span.event(
+                                    "steal_merge", at_chunk=k + 1, of=K
+                                )
+                            break  # helper computed [mid, K)
+                        # helper failed: fall through and run the
+                        # remainder sequentially (stealing is
+                        # opportunistic, never correctness-bearing)
+                        steal = None
+                    if sched_job is not None and (k + 1) < K:
+                        decision = sched_job.boundary(
+                            k + 1, K, dt,
+                            parkable=park_key is not None,
+                        )
+                        if decision == "park":
+                            carries = self._park(
+                                park_key, record, carries, k + 1, K,
+                                task_span, sched_job,
+                            )
 
         if preempt is not None:
             preempt(K, K)
@@ -1447,6 +1588,204 @@ class ChunkedMeshRunner:
                 task_span.event("checkpoint", chunk=next_chunk, of=K)
         except Exception:
             pass
+
+    def _park(self, key, record, carries, next_chunk, K, task_span,
+              job) -> tuple:
+        """Park this run: snapshot the device carries to the host
+        checkpoint store (accounted against park_max_bytes), release
+        the device memory, and block in the scheduler until regranted —
+        then re-place the same snapshot and continue from `next_chunk`.
+
+        Budget refusal returns the original carries untouched: the
+        query keeps the mesh and runs to completion (degradation is
+        never query failure). Typed kills (deadline / abandonment)
+        raise out of the parked wait with the snapshot discarded — a
+        dead query never resumes; mesh faults (drain surfacing while
+        parked) keep the snapshot so a sibling replica can restore it
+        through the host-portable path."""
+        from trino_tpu.recovery.checkpoint import (
+            CHECKPOINTS,
+            MeshCheckpoint,
+        )
+        from trino_tpu.resident import GENERATIONS
+
+        host = tuple(
+            jax.tree_util.tree_map(
+                lambda x: np.asarray(jax.device_get(x)), c
+            )
+            for c in carries
+        )
+        ckpt = MeshCheckpoint(
+            next_chunk=next_chunk,
+            n_chunks=K,
+            chunk_cap=record.chunk_cap,
+            resolved_caps=dict(record.resolved_caps),
+            carries_host=host,
+            tables=self.feed_tables,
+            generations=GENERATIONS.snapshot(self.feed_tables),
+        )
+        budget = int(
+            getattr(self.session, "park_max_bytes", 256 << 20)
+        )
+        if not CHECKPOINTS.park(key, ckpt, budget):
+            job.park_refused()
+            if task_span is not None:
+                task_span.event("park_refused", chunk=next_chunk, of=K)
+            return carries
+        carries = None  # the snapshot is now the only copy
+        self._run_stats["parks"] = int(self._run_stats["parks"]) + 1
+        if task_span is not None:
+            task_span.event("park", chunk=next_chunk, of=K)
+        try:
+            job.park_wait(next_chunk, K)
+        except (MeshStuck, MeshDeviceLost):
+            # mesh lifecycle fault while parked (drain): keep the
+            # snapshot — the coordinator's failover restores it on a
+            # sibling via the portable-bytes path
+            CHECKPOINTS.unpark(key, keep=True)
+            raise
+        except BaseException:
+            # typed kill (deadline / abandonment) while parked: the
+            # query is dead and must never resume
+            CHECKPOINTS.unpark(key, keep=False)
+            raise
+        # regranted: restore from the LOCAL snapshot object (immune to
+        # DML generation invalidation — this run's feeds are an
+        # immutable device snapshot, so its carries stay exact even if
+        # the source tables moved on)
+        restored = self._restore_carries(ckpt, record)
+        CHECKPOINTS.unpark(key, keep=True)
+        if restored is None:
+            # cannot happen under an unchanged record (same caps, same
+            # shapes) — but if it does, the kept store entry feeds the
+            # in-run resume path rather than losing progress
+            raise MeshDeviceLost(
+                f"parked carries failed to restore at chunk {next_chunk}"
+            )
+        self._run_stats["unparks"] = (
+            int(self._run_stats["unparks"]) + 1
+        )
+        if task_span is not None:
+            task_span.event("unpark", chunk=next_chunk, of=K)
+        return restored
+
+    # -- drain-failover work stealing --------------------------------
+    def run_steal_helper(self, steal) -> None:
+        """Helper side: run chunks [mid, K) of a stolen query on this
+        sub-mesh from ZERO carries and publish the resulting carries as
+        a checkpoint under the steal key. No store resume on entry, no
+        periodic checkpointing (the primary's own key is this program's
+        identity — a helper snapshot would collide), no flush, no
+        output emission: the primary merges these carries at its `mid`
+        boundary and owns the rest of the run. Any failure simply skips
+        the publish — the primary times out and continues sequentially."""
+        _mode, mid, steal_key, done, caps = steal[:5]
+        prev_replica = active_replica()
+        _ACTIVE_REPLICA.replica = getattr(self.ex, "replica_id", None)
+        try:
+            from trino_tpu.recovery.checkpoint import (
+                CHECKPOINTS,
+                MeshCheckpoint,
+            )
+            from trino_tpu.resident import GENERATIONS
+            from trino_tpu.runtime.metrics import METRICS
+
+            record = self._record(dict(caps))
+            n = self.ex.n
+            K = record.n_chunks
+            if not (0 < mid < K) or record.step_fn is None:
+                return
+            pctx: tuple = ()
+            if record.prelude_fn is not None:
+                p_outs, pctx = self._run_prelude(
+                    record, None,
+                    lambda name, **attrs: contextlib.nullcontext(),
+                    0, n,
+                )
+            carries = tuple(
+                jax.tree_util.tree_map(
+                    lambda s: jax.device_put(
+                        jnp.zeros(s.shape, s.dtype), self.sharding
+                    ),
+                    t,
+                )
+                for t in record.carry_sds
+            )
+            drain_check = getattr(self.ex, "drain_check", None)
+            for k in range(mid, K):
+                if drain_check is not None:
+                    drain_check()
+                carries, flags = record.step_fn(
+                    jnp.asarray(k, dtype=jnp.int32),
+                    self.feed_args, pctx, carries,
+                )
+                self._check_flags(record.step_sites, flags, n)
+                METRICS.increment("mesh.chunk_steps")
+            host = tuple(
+                jax.tree_util.tree_map(
+                    lambda x: np.asarray(jax.device_get(x)), c
+                )
+                for c in carries
+            )
+            CHECKPOINTS.put(steal_key, MeshCheckpoint(
+                next_chunk=K,
+                n_chunks=K,
+                chunk_cap=record.chunk_cap,
+                resolved_caps=dict(record.resolved_caps),
+                carries_host=host,
+                tables=self.feed_tables,
+                generations=GENERATIONS.snapshot(self.feed_tables),
+            ))
+        except Exception:
+            pass  # opportunistic: the primary covers [mid, K) itself
+        finally:
+            _ACTIVE_REPLICA.replica = prev_replica
+            done.set()
+
+    def _steal_merge(self, record, carries, steal) -> Optional[tuple]:
+        """Primary side: adopt the helper's [mid, K) carries. Byte
+        identity holds because `_accumulate` packs live rows densely at
+        the front of each shard in chunk execution order — appending
+        the helper's packed rows after the primary's per-shard live
+        count reproduces exactly the layout a sequential run of chunks
+        [mid, K) would have written, and both sides ran the same record
+        at the same resolved caps so shard shapes agree. Returns None
+        on any disagreement (timeout, caps drift, non-append carry,
+        combined overflow): the primary continues sequentially."""
+        _mode, mid, steal_key, done, caps, timeout_s = steal
+        try:
+            from trino_tpu.recovery.checkpoint import CHECKPOINTS
+
+            if not done.wait(timeout_s):
+                return None
+            ck = CHECKPOINTS.get(steal_key)
+            CHECKPOINTS.discard(steal_key)
+            if (
+                ck is None
+                or ck.n_chunks != record.n_chunks
+                or ck.resolved_caps != dict(record.resolved_caps)
+                or len(ck.carries_host) != len(record.carry_sds)
+            ):
+                return None
+            n = self.ex.n
+            merged = []
+            for (kind, _fid), mine_dev, theirs in zip(
+                record.carry_meta, carries, ck.carries_host
+            ):
+                if kind != "out":
+                    return None
+                mine = jax.tree_util.tree_map(
+                    lambda x: np.asarray(jax.device_get(x)), mine_dev
+                )
+                m = _merge_out_carry(mine, theirs, n)
+                if m is None:
+                    return None
+                merged.append(m)
+            return tuple(
+                jax.device_put(b, self.sharding) for b in merged
+            )
+        except Exception:
+            return None
 
     def _restore_carries(self, ck, record) -> Optional[tuple]:
         """Re-place a checkpoint's host carries onto the mesh, re-padding

@@ -969,6 +969,13 @@ class MeshExecutor:
         self.replica_id = replica_id
         self.drain_check = drain_check
         self.last_run: Dict[str, object] = {}
+        # preemptive multi-tenancy: the scheduler seat the chunk runner
+        # consults at every boundary (runtime/scheduler.py MeshJob),
+        # and the work-stealing context ("emit" on a helper replica,
+        # "merge" on the failover primary) — both set per-execution by
+        # the coordinator
+        self.sched_job = None
+        self.steal_ctx = None
 
     # -- public --
     def execute(self, subplan: SubPlan, preempt=None,
@@ -1004,6 +1011,13 @@ class MeshExecutor:
             self, mesh_sps, root_child_ids, repl, feeds, host_feeds,
             feed_tables=tuple(self._feed_tables),
         )
+        steal = self.steal_ctx
+        if steal is not None and steal[0] == "emit":
+            # work-stealing helper: compute chunks [mid, K) from zero
+            # carries and publish them for the primary to merge — no
+            # root fragment, no client-visible result
+            runner.run_steal_helper(steal)
+            return []
         sources = runner.run(preempt=preempt, query_span=query_span)
         # count only after the programs have actually produced results —
         # a failure above falls back to the page exchange, which must not

@@ -105,6 +105,13 @@ class MeshCheckpointStore:
         self.taken = 0
         self.resumed = 0
         self.invalidated = 0
+        # park lifecycle (runtime/scheduler.py): keys whose entry is a
+        # *parked* query's snapshot — the query's device memory is
+        # gone, so the entry is the only copy of its progress. Parked
+        # keys are pinned (immune to LRU eviction) and their host
+        # bytes are accounted against the session park budget.
+        self._parked: Dict[tuple, int] = {}  # key -> accounted bytes
+        self.parked_refused = 0
 
     def _generations(self, tables) -> Tuple[int, ...]:
         from trino_tpu.resident import GENERATIONS
@@ -119,7 +126,15 @@ class MeshCheckpointStore:
             self._entries.move_to_end(key)
             self.taken += 1
             while len(self._entries) > self._max:
-                self._entries.popitem(last=False)
+                # evict oldest UNPARKED entry: a parked entry is the
+                # only copy of its query's progress
+                victim = next(
+                    (k for k in self._entries if k not in self._parked),
+                    None,
+                )
+                if victim is None:
+                    break
+                del self._entries[victim]
         METRICS.increment(CHECKPOINTS_TAKEN)
 
     def get(self, key: tuple) -> Optional[MeshCheckpoint]:
@@ -150,6 +165,62 @@ class MeshCheckpointStore:
     def discard(self, key: tuple) -> None:
         with self._lock:
             self._entries.pop(key, None)
+            self._parked.pop(key, None)
+
+    # -- park lifecycle (preemptive scheduler) ------------------------
+    @staticmethod
+    def _ckpt_nbytes(ckpt: MeshCheckpoint) -> int:
+        """Host footprint of a snapshot: sum of numpy-leaf nbytes."""
+        import jax
+        import numpy as np
+
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(ckpt.carries_host):
+            arr = np.asarray(leaf)
+            total += int(arr.nbytes)
+        return total
+
+    def park(self, key: tuple, ckpt: MeshCheckpoint,
+             max_bytes: int) -> bool:
+        """Install a parked query's snapshot, accounting its host bytes
+        against `max_bytes` together with every other parked entry.
+        Returns False (store untouched) when the budget refuses — the
+        caller keeps its device carries and runs to completion."""
+        from trino_tpu.runtime.metrics import METRICS
+
+        nbytes = self._ckpt_nbytes(ckpt)
+        with self._lock:
+            in_use = sum(
+                b for k, b in self._parked.items() if k != key
+            )
+            if max_bytes >= 0 and in_use + nbytes > max_bytes:
+                self.parked_refused += 1
+                return False
+            self._entries[key] = ckpt
+            self._entries.move_to_end(key)
+            self._parked[key] = nbytes
+            self.taken += 1
+        METRICS.increment(CHECKPOINTS_TAKEN)
+        return True
+
+    def unpark(self, key: tuple, keep: bool = True) -> None:
+        """Release a parked entry's budget accounting. `keep=True`
+        leaves the snapshot in the store as an ordinary LRU entry (the
+        resume path — and drain failover, which re-reads it on a
+        sibling — still finds it); `keep=False` drops it entirely
+        (typed kills: a dead query must never resume)."""
+        with self._lock:
+            self._parked.pop(key, None)
+            if not keep:
+                self._entries.pop(key, None)
+
+    def parked_bytes(self) -> int:
+        with self._lock:
+            return sum(self._parked.values())
+
+    def parked_count(self) -> int:
+        with self._lock:
+            return len(self._parked)
 
     # -- host-boundary transfer (replicated meshes) -------------------
     def export_bytes(self, key: tuple) -> Optional[bytes]:
@@ -196,6 +267,7 @@ class MeshCheckpointStore:
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
+            self._parked.clear()
 
     def reset_stats(self) -> None:
         """Zero the lifetime counters (corpus generation and tests pin

@@ -103,6 +103,21 @@ REPLICA_CLASSES = (
     "replica_drain_under_load",
 )
 
+# preemptive multi-tenancy scenarios (PR 18): the chunk-granular mesh
+# scheduler (runtime/scheduler.py) under adversity. A fast-lane point
+# lookup parks a streaming analytic at a seeded chunk boundary, then a
+# device loss lands AFTER the resume — the checkpoint machinery must
+# compose with parked state (park -> resume -> fault -> in-run resume,
+# all in one run, byte-identical, nothing re-executed). And a replica
+# drain surfacing while a query sits PARKED must raise out of the
+# parked wait and resume the query from its parked host-portable
+# snapshot on the sibling sub-mesh. Run via run_preempt_park_resume_case
+# / run_preempt_under_drain_case.
+PREEMPT_CLASSES = (
+    "preempt_park_resume",
+    "preempt_under_drain",
+)
+
 
 def generate_schedule(
     seed: int,
@@ -218,6 +233,234 @@ def run_mesh_recovery_case(
         "executed_chunk_steps": info.get("executed_chunk_steps"),
         "resumes": info.get("resumes"),
         "resumed_from_chunk": info.get("resumed_from_chunk"),
+        "expected": expected,
+    }
+    return rows, report
+
+
+def run_preempt_park_resume_case(
+    sql: str, seed: int, mesh_chunk_rows: int = 256,
+) -> Tuple[List[list], dict]:
+    """Park/resume composed with checkpoint recovery in ONE run: a
+    fast-lane point lookup arrives at a seeded chunk boundary and parks
+    the analytic (device carries snapshot to host, lookup runs, resume
+    from chunk k warm); then a MeshDeviceLost lands at a later seeded
+    boundary and the run must resume IN-RUN from its last checkpoint.
+    Oracle-equal rows, exactly one park/unpark, at least one resume,
+    and zero re-executed chunk-steps across the whole maneuver."""
+    from trino_tpu.connectors.tpch import create_tpch_connector
+    from trino_tpu.engine import Session
+    from trino_tpu.parallel import mesh_chunk
+    from trino_tpu.runtime.coordinator import DistributedQueryRunner
+
+    point = (
+        "select n_name, r_name from nation join region "
+        "on n_regionkey = r_regionkey where n_nationkey = 3"
+    )
+    runner = DistributedQueryRunner(
+        Session(
+            catalog="tpch", schema="tiny",
+            mesh_chunk_rows=mesh_chunk_rows,
+            mesh_checkpoint_interval_chunks=1,
+            mesh_resume_attempts=1,
+        ),
+        n_workers=2, hash_partitions=2,
+    )
+    runner.register_catalog("tpch", create_tpch_connector())
+    expected = runner.execute(sql).rows  # warm run doubles as oracle
+    mesh_clean = runner._last_data_plane == "mesh"
+    point_expected = runner.execute(point).rows
+    rng = random.Random(seed)
+    state = {
+        "park_target": None, "fault_target": None,
+        "parked": 0, "faulted": 0, "point_rows": None,
+    }
+    case_thread = threading.current_thread()
+
+    def hook(k: int, K: int) -> None:
+        if threading.current_thread() is not case_thread:
+            return  # the point lookup's own chunk loop
+        if state["park_target"] is None:
+            # the park lands at park_target+1; the device loss lands
+            # strictly after the resume so both maneuvers compose
+            state["park_target"] = rng.randrange(max(K - 2, 1))
+            state["fault_target"] = (
+                state["park_target"] + 1
+                + rng.randrange(max(K - state["park_target"] - 2, 1))
+            )
+        if k == state["park_target"] and not state["parked"]:
+            state["parked"] = 1
+
+            def run_point():
+                state["point_rows"] = runner.execute(point).rows
+
+            threading.Thread(target=run_point, daemon=True).start()
+            # hold this boundary until the fast seat is queued, so the
+            # NEXT boundary deterministically parks
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                sched = runner._mesh_scheduler
+                if sched is not None and sched.waiting_count(fast=True):
+                    break
+                time.sleep(0.002)
+            return
+        if (
+            k == state["fault_target"]
+            and state["parked"]
+            and not state["faulted"]
+        ):
+            state["faulted"] = 1
+            raise mesh_chunk.MeshDeviceLost(
+                f"chaos[preempt_park_resume]: device loss at chunk "
+                f"{k}/{K} after the park/resume cycle"
+            )
+
+    mesh_chunk.MESH_FAULT_HOOK = hook
+    try:
+        rows = runner.execute(sql).rows
+    finally:
+        mesh_chunk.MESH_FAULT_HOOK = None
+    deadline = time.monotonic() + 10.0
+    while state["point_rows"] is None and time.monotonic() < deadline:
+        time.sleep(0.002)
+    info = dict(mesh_chunk.LAST_RUN_INFO)
+    report = {
+        "mesh_clean_plane": mesh_clean,
+        "mesh_fault_plane": runner._last_data_plane,
+        "park_chunk": (
+            None if state["park_target"] is None
+            else state["park_target"] + 1
+        ),
+        "fault_chunk": state["fault_target"],
+        "parked": state["parked"],
+        "faulted": state["faulted"],
+        "chunks": info.get("chunks"),
+        "executed_chunk_steps": info.get("executed_chunk_steps"),
+        "parks": info.get("parks"),
+        "unparks": info.get("unparks"),
+        "resumes": info.get("resumes"),
+        "point_ok": state["point_rows"] == point_expected,
+        "expected": expected,
+    }
+    return rows, report
+
+
+def run_preempt_under_drain_case(
+    sql: str, seed: int, mesh_chunk_rows: int = 256,
+) -> Tuple[List[list], dict]:
+    """A replica drain surfacing while a query sits PARKED: a fast seat
+    parks the analytic at a seeded boundary, then the victim replica is
+    drained while the query is in the parked wait. The drain must raise
+    MeshReplicaDraining OUT of the parked wait, keep the parked
+    host-portable snapshot, and resume the query on the sibling
+    sub-mesh from exactly the park boundary — oracle-equal, nothing
+    re-executed, and the victim quiesces."""
+    from trino_tpu.connectors.tpch import create_tpch_connector
+    from trino_tpu.engine import Session
+    from trino_tpu.parallel import mesh_chunk
+    from trino_tpu.recovery import CHECKPOINTS
+    from trino_tpu.runtime.coordinator import DistributedQueryRunner
+    from trino_tpu.runtime.metrics import METRICS
+
+    runner = DistributedQueryRunner(
+        Session(
+            catalog="tpch", schema="tiny",
+            mesh_replicas=2,
+            mesh_chunk_rows=mesh_chunk_rows,
+            mesh_checkpoint_interval_chunks=1,
+            mesh_resume_attempts=0,
+        ),
+        n_workers=2, hash_partitions=2,
+    )
+    runner.register_catalog("tpch", create_tpch_connector())
+    # sequential placements alternate replicas: two rounds warm both
+    # sub-meshes, so the sibling resume mints no new lowerings
+    expected = runner.execute(sql).rows
+    runner.execute(sql)
+    mesh_clean = runner._last_data_plane == "mesh"
+    rm = runner._replicas
+    rng = random.Random(seed)
+    state = {
+        "target": None, "victim": None, "fake": None,
+        "parked": 0, "drained": 0,
+    }
+
+    def drain_when_parked(victim: int) -> None:
+        vic = rm.replicas[victim]
+        parks0 = vic.scheduler.parks
+        state["fake"] = vic.scheduler.submit(
+            "chaos-fast-seat", fast=True
+        )
+        # synthetic waiter: never calls acquire, so mark it ready by
+        # hand — only ready waiters exert preemption pressure
+        state["fake"].ready = True
+        deadline = time.monotonic() + 10.0
+        while (
+            vic.scheduler.parks <= parks0
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.002)
+        if vic.scheduler.parks > parks0:
+            state["parked"] = 1
+            state["drained"] = 1
+            rm.request_drain(victim)
+
+    def hook(k: int, K: int) -> None:
+        rep = mesh_chunk.active_replica()
+        if rep is None:
+            return
+        if state["target"] is None:
+            state["target"] = rng.randrange(max(K - 2, 1))
+        if k == state["target"] and state["victim"] is None:
+            state["victim"] = rep
+            threading.Thread(
+                target=drain_when_parked, args=(rep,), daemon=True,
+            ).start()
+            # hold this boundary until the fast seat is queued: the
+            # next boundary parks, and the side thread drains the
+            # victim while the query sits parked
+            vic = rm.replicas[rep]
+            deadline = time.monotonic() + 10.0
+            while (
+                not vic.scheduler.waiting_count(fast=True)
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.002)
+
+    failovers0 = rm.failovers
+    resumed0 = CHECKPOINTS.resumed
+    steps0 = METRICS.snapshot().get("mesh.chunk_steps", 0.0)
+    mesh_chunk.MESH_FAULT_HOOK = hook
+    try:
+        rows = runner.execute(sql).rows
+    finally:
+        mesh_chunk.MESH_FAULT_HOOK = None
+        if state["fake"] is not None and state["victim"] is not None:
+            rm.replicas[state["victim"]].scheduler.finish(state["fake"])
+    info = dict(mesh_chunk.LAST_RUN_INFO)
+    quiesced = bool(
+        state["drained"]
+        and state["victim"] is not None
+        and rm.drain(state["victim"], timeout_s=30.0)
+    )
+    if quiesced:
+        rm.undrain(state["victim"])
+    report = {
+        "mesh_clean_plane": mesh_clean,
+        "mesh_fault_plane": runner._last_data_plane,
+        "park_chunk": (
+            None if state["target"] is None else state["target"] + 1
+        ),
+        "parked": state["parked"],
+        "drain_requested": state["drained"],
+        "replica_drained": quiesced,
+        "failovers": rm.failovers - failovers0,
+        "checkpoint_resumes": CHECKPOINTS.resumed - resumed0,
+        "chunks": info.get("chunks"),
+        "resumed_from_chunk": info.get("resumed_from_chunk"),
+        "chunk_steps": int(
+            METRICS.snapshot().get("mesh.chunk_steps", 0.0) - steps0
+        ),
         "expected": expected,
     }
     return rows, report
@@ -1486,5 +1729,131 @@ def chaos_smoke(
                     f"completed={report['completed']} ok={report['ok']} "
                     f"failovers={report['replica.failovers']} "
                     f"resumes={report['checkpoint_resumes']} hung=0"
+                )
+    # preemptive multi-tenancy scenarios (PR 18): the chunk-granular
+    # mesh scheduler's park/resume composed with checkpoint recovery
+    # (device loss after a park) and with the replica drain lifecycle
+    # (drain surfacing while parked -> sibling resumes the parked
+    # snapshot). Same device gate as the replica scenarios.
+    if len(jax.devices()) < 2:
+        if verbose:
+            print(
+                "  chaos preempt/*: skipped (needs >= 2 devices; run "
+                "with --xla_force_host_platform_device_count)"
+            )
+        return failures
+    preempt_sql = recovery_sql
+    for scenario in PREEMPT_CLASSES:
+        case = (
+            run_preempt_park_resume_case
+            if scenario == "preempt_park_resume"
+            else run_preempt_under_drain_case
+        )
+        try:
+            rows, rep = case(preempt_sql, seed)
+        except Exception as e:
+            failures.append(
+                f"preempt/{scenario}: raised {type(e).__name__}: {e}"
+            )
+            continue
+        if not rep["mesh_clean_plane"]:
+            failures.append(
+                f"preempt/{scenario}: clean run did not take the mesh "
+                f"plane"
+            )
+            continue
+        if not rows_equal(rows, rep["expected"], ordered=True):
+            failures.append(
+                f"preempt/{scenario}: rows diverged from clean run "
+                f"({len(rows)} vs {len(rep['expected'])})"
+            )
+        if not rep["parked"]:
+            failures.append(
+                f"preempt/{scenario}: the fast-lane seat never parked "
+                f"the analytic ({rep})"
+            )
+        if scenario == "preempt_park_resume":
+            if not rep["faulted"]:
+                failures.append(
+                    f"preempt/{scenario}: the post-resume device loss "
+                    f"never fired ({rep})"
+                )
+            elif rep["mesh_fault_plane"] != "mesh":
+                failures.append(
+                    f"preempt/{scenario}: faulted run left the mesh "
+                    f"plane ({rep['mesh_fault_plane']})"
+                )
+            elif rep["parks"] != 1 or rep["unparks"] != 1:
+                failures.append(
+                    f"preempt/{scenario}: expected exactly one "
+                    f"park/unpark cycle ({rep})"
+                )
+            elif not rep["resumes"]:
+                failures.append(
+                    f"preempt/{scenario}: no in-run checkpoint resume "
+                    f"after the device loss ({rep})"
+                )
+            elif rep["executed_chunk_steps"] != rep["chunks"]:
+                failures.append(
+                    f"preempt/{scenario}: park+fault re-executed "
+                    f"{rep['executed_chunk_steps'] - rep['chunks']} of "
+                    f"{rep['chunks']} chunks"
+                )
+            if not rep["point_ok"]:
+                failures.append(
+                    f"preempt/{scenario}: the preempting point lookup "
+                    f"answered wrong ({rep})"
+                )
+            if verbose and not any(
+                f.startswith(f"preempt/{scenario}") for f in failures
+            ):
+                print(
+                    f"  chaos preempt/{scenario}: ok rows={len(rows)} "
+                    f"park_chunk={rep['park_chunk']} "
+                    f"fault_chunk={rep['fault_chunk']}/{rep['chunks']} "
+                    f"resumes={rep['resumes']} re_executed=0"
+                )
+        else:  # preempt_under_drain
+            if not rep["drain_requested"]:
+                failures.append(
+                    f"preempt/{scenario}: the drain never landed while "
+                    f"the query sat parked ({rep})"
+                )
+            elif not rep["failovers"]:
+                failures.append(
+                    f"preempt/{scenario}: drained while parked but "
+                    f"nothing failed over to the sibling ({rep})"
+                )
+            elif not rep["checkpoint_resumes"]:
+                failures.append(
+                    f"preempt/{scenario}: sibling did not resume from "
+                    f"the parked snapshot ({rep})"
+                )
+            elif rep["resumed_from_chunk"] != rep["park_chunk"]:
+                failures.append(
+                    f"preempt/{scenario}: sibling resumed from chunk "
+                    f"{rep['resumed_from_chunk']}, expected the park "
+                    f"boundary {rep['park_chunk']}"
+                )
+            elif rep["chunk_steps"] != rep["chunks"]:
+                failures.append(
+                    f"preempt/{scenario}: drain-while-parked "
+                    f"re-executed "
+                    f"{rep['chunk_steps'] - rep['chunks']} of "
+                    f"{rep['chunks']} chunks"
+                )
+            if not rep["replica_drained"]:
+                failures.append(
+                    f"preempt/{scenario}: the victim replica never "
+                    f"quiesced to zero inflight ({rep})"
+                )
+            if verbose and not any(
+                f.startswith(f"preempt/{scenario}") for f in failures
+            ):
+                print(
+                    f"  chaos preempt/{scenario}: ok rows={len(rows)} "
+                    f"park_chunk={rep['park_chunk']}/{rep['chunks']} "
+                    f"failovers={rep['failovers']} "
+                    f"resumes={rep['checkpoint_resumes']} re_executed=0"
                 )
     return failures

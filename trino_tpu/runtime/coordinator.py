@@ -434,6 +434,14 @@ class DistributedQueryRunner:
         # With a replica plane, the per-replica exec_lock takes over —
         # replicas are the units of mesh concurrency.
         self._mesh_exec_lock = threading.Lock()
+        # preemptive multi-tenancy (runtime/scheduler.py): the single
+        # full-width mesh's chunk-granular run queue, built lazily on
+        # first scheduled dispatch (replica planes carry one scheduler
+        # per Replica instead); _sched_steals counts completed
+        # work-stealing dispatches, instance-scoped for the EXPLAIN
+        # `scheduler=` line
+        self._mesh_scheduler = None
+        self._sched_steals = 0
         import collections
 
         self._completed_queries = collections.OrderedDict()
@@ -839,9 +847,22 @@ class DistributedQueryRunner:
                 tracker, base_qid, cancel=cancel,
                 deadline_epoch_s=deadline_epoch_s,
             )
+            # fast-lane classification for the mesh scheduler: point
+            # lookups (possibly dimension-decorated) preempt a running
+            # analytic at its next chunk boundary instead of queueing
+            # behind the whole run
+            try:
+                from trino_tpu.serving.admission import is_fast_lane
+
+                fast_lane = is_fast_lane(stmt)
+            except Exception:
+                fast_lane = False
             prev = set_compile_attribution(base_qid)
             try:
-                rows = self._execute_mesh(subplan, preempt, query_span)
+                rows = self._execute_mesh(
+                    subplan, preempt, query_span,
+                    fast=fast_lane, query_id=base_qid,
+                )
                 self._last_data_plane = "mesh"
                 return MaterializedResult(
                     rows, *result_meta, data_plane="mesh"
@@ -1030,13 +1051,55 @@ class DistributedQueryRunner:
                 breaker_cooldown_s=float(getattr(
                     self.session, "replica_breaker_cooldown_s", 1.0
                 )),
+                scheduler_kw=self._scheduler_kw(),
             )
         except ValueError:
             rm = None  # fewer devices than replicas: keep one mesh
         self._replicas = rm
         return rm
 
-    def _execute_mesh(self, subplan, preempt, query_span):
+    def _scheduler_kw(self) -> dict:
+        from trino_tpu.runtime.scheduler import parse_group_weights
+
+        return {
+            "min_slice_chunks": int(getattr(
+                self.session, "mesh_scheduler_min_slice_chunks", 1
+            ) or 1),
+            "preemption_enabled": bool(getattr(
+                self.session, "preemption_enabled", True
+            )),
+            "weights": parse_group_weights(str(getattr(
+                self.session, "mesh_scheduler_weights", ""
+            ) or "")),
+        }
+
+    def _tune_scheduler(self, sched) -> None:
+        """Refresh a live scheduler's knobs from the current session —
+        SET SESSION between queries must take effect without rebuilding
+        the run queue (waiting jobs keep their seats)."""
+        kw = self._scheduler_kw()
+        sched.min_slice_chunks = max(1, int(kw["min_slice_chunks"]))
+        sched.preemption_enabled = bool(kw["preemption_enabled"])
+        sched.weights = dict(kw["weights"])
+
+    def _mesh_scheduler_for(self):
+        if self._mesh_scheduler is None:
+            from trino_tpu.runtime.scheduler import MeshScheduler
+
+            self._mesh_scheduler = MeshScheduler(
+                name="mesh", **self._scheduler_kw()
+            )
+        else:
+            self._tune_scheduler(self._mesh_scheduler)
+        return self._mesh_scheduler
+
+    def _sched_group(self) -> str:
+        return str(getattr(
+            self.session, "mesh_scheduler_group", ""
+        ) or "") or "default"
+
+    def _execute_mesh(self, subplan, preempt, query_span, fast=False,
+                      query_id=""):
         """Mesh dispatch with replica placement and chunk-granular
         failover. Single-replica sessions run the full-width mesh
         directly. With a replica plane: place the least-loaded healthy
@@ -1045,7 +1108,15 @@ class DistributedQueryRunner:
         finds the host-portable checkpoint under the device-independent
         key and continues from chunk k on its own warm programs. Only
         when no sibling remains (or failover is off) does the fault
-        re-raise into the caller's page-plane fallback."""
+        re-raise into the caller's page-plane fallback.
+
+        With mesh_scheduler on (the default), the serialization point
+        is the weighted-fair run queue (runtime/scheduler.py) instead
+        of a bare lock: the holder's chunk loop consults the scheduler
+        at every boundary, `fast` submissions ride the preempting fast
+        lane, and a drain fault whose unstarted chunk range is large
+        enough may be SPLIT across two sibling replicas (work
+        stealing) instead of resuming wholesale on one."""
         from trino_tpu.parallel.mesh_chunk import (
             MeshDeviceLost,
             MeshReplicaDraining,
@@ -1055,11 +1126,30 @@ class DistributedQueryRunner:
 
         import contextlib
 
+        use_sched = bool(getattr(self.session, "mesh_scheduler", True))
+        group = self._sched_group()
         rm = self._replica_manager()
         if rm is None:
             ex = MeshExecutor(self.catalogs, self.session)
             # width-1 meshes run no collectives and keep their historic
-            # concurrency; wider meshes serialize (see _mesh_exec_lock)
+            # concurrency; wider meshes serialize — through the
+            # scheduler's run queue when it is on, else the bare lock
+            if getattr(ex, "n", 1) > 1 and use_sched:
+                sched = self._mesh_scheduler_for()
+                job = sched.submit(
+                    query_id or "q?", group=group, fast=fast,
+                    poll=preempt,
+                )
+                # the chunk runner acquires the seat itself, at device-
+                # phase entry — host planning and feed builds for this
+                # query run before the grant, outside the seat
+                ex.sched_job = job
+                try:
+                    return ex.execute(
+                        subplan, preempt=preempt, query_span=query_span
+                    )
+                finally:
+                    sched.finish(job)
             guard = (
                 self._mesh_exec_lock if getattr(ex, "n", 1) > 1
                 else contextlib.nullcontext()
@@ -1070,6 +1160,9 @@ class DistributedQueryRunner:
                 )
         failover_on = bool(
             getattr(self.session, "replica_failover_enabled", True)
+        )
+        steal_on = use_sched and bool(
+            getattr(self.session, "mesh_steal_enabled", True)
         )
         tried: set = set()
         while True:
@@ -1086,12 +1179,36 @@ class DistributedQueryRunner:
                     drain_check=rm.drain_check(rep),
                 )
                 # one mesh program at a time per sub-mesh (see
-                # Replica.exec_lock); concurrent queries spread across
-                # replicas via place() and queue only when all are busy
-                with rep.exec_lock:
-                    rows = ex.execute(
-                        subplan, preempt=preempt, query_span=query_span
+                # Replica.exec_lock / Replica.scheduler); concurrent
+                # queries spread across replicas via place() and queue
+                # only when all are busy
+                if use_sched:
+                    sched = rep.scheduler
+                    self._tune_scheduler(sched)
+                    job = sched.submit(
+                        query_id or "q?", group=group, fast=fast,
+                        poll=preempt,
                     )
+                    # a drain surfacing while queued (or parked) raises
+                    # MeshReplicaDraining out of the wait — failover,
+                    # not a grant on decommissioned capacity. The chunk
+                    # runner acquires the seat at device-phase entry;
+                    # host feed builds run before the grant
+                    job.aux_check = rm.drain_check(rep)
+                    ex.sched_job = job
+                    try:
+                        rows = ex.execute(
+                            subplan, preempt=preempt,
+                            query_span=query_span,
+                        )
+                    finally:
+                        sched.finish(job)
+                else:
+                    with rep.exec_lock:
+                        rows = ex.execute(
+                            subplan, preempt=preempt,
+                            query_span=query_span,
+                        )
                 rm.report_success(rep)
                 return rows
             except (MeshStuck, MeshDeviceLost) as e:
@@ -1114,8 +1231,117 @@ class DistributedQueryRunner:
                         error=type(e).__name__,
                         reason=str(e)[:300],
                     )
+                if (
+                    steal_on
+                    and isinstance(e, MeshReplicaDraining)
+                    and getattr(e, "steal_ok", False)
+                    and getattr(e, "ckpt_key", None) is not None
+                ):
+                    rows = self._try_steal_dispatch(
+                        subplan, preempt, query_span, e.ckpt_key,
+                        rm, tried, fast, query_id, group,
+                    )
+                    if rows is not None:
+                        return rows
             finally:
                 rm.release(rep)
+
+    def _try_steal_dispatch(self, subplan, preempt, query_span, key,
+                            rm, tried, fast, query_id, group):
+        """Drain-failover work stealing: instead of resuming the
+        drained query wholesale on one sibling, split its UNSTARTED
+        chunk range [k0, K) at mid — the primary sibling resumes
+        [k0, mid) from the host-portable checkpoint while a helper
+        sibling computes [mid, K) from zero carries and publishes them;
+        the primary merges the helper's packed rows at its mid boundary
+        (byte-identical: append accumulators pack live rows in chunk
+        order). Opportunistic end to end — returns None (the caller's
+        failover loop resumes wholesale) when fewer than two siblings
+        are placeable, the range is too small, or any stage falls
+        apart."""
+        import threading as _t
+
+        from trino_tpu.parallel.mesh_chunk import (
+            MeshDeviceLost,
+            MeshStuck,
+        )
+        from trino_tpu.parallel.mesh_plan import MeshExecutor
+        from trino_tpu.recovery.checkpoint import CHECKPOINTS
+
+        ck = CHECKPOINTS.get(key)
+        if ck is None or ck.n_chunks - ck.next_chunk < 2:
+            return None
+        prim = rm.place(exclude=tried)
+        if prim is None:
+            return None
+        helper = rm.place(exclude=set(tried) | {prim.replica_id})
+        if helper is None:
+            rm.release(prim)
+            return None
+        k0, K = ck.next_chunk, ck.n_chunks
+        mid = k0 + (K - k0 + 1) // 2
+        steal_key = ("steal",) + tuple(key)
+        done = _t.Event()
+        caps = dict(ck.resolved_caps)
+        try:
+            ex_h = MeshExecutor(
+                self.catalogs, self.session,
+                devices=helper.devices, replica_id=helper.replica_id,
+                drain_check=rm.drain_check(helper),
+            )
+            ex_h.steal_ctx = ("emit", mid, steal_key, done, caps)
+
+            def run_helper():
+                hjob = helper.scheduler.submit(
+                    f"{query_id or 'q?'}-steal", group=group,
+                )
+                try:
+                    helper.scheduler.acquire(hjob)
+                    ex_h.execute(subplan)
+                except Exception:
+                    pass  # no publish; the primary runs [mid, K) itself
+                finally:
+                    helper.scheduler.finish(hjob)
+                    done.set()
+
+            th = _t.Thread(target=run_helper, daemon=True)
+            th.start()
+            ex_p = MeshExecutor(
+                self.catalogs, self.session,
+                devices=prim.devices, replica_id=prim.replica_id,
+                drain_check=rm.drain_check(prim),
+            )
+            ex_p.steal_ctx = ("merge", mid, steal_key, done, caps, 120.0)
+            job = prim.scheduler.submit(
+                query_id or "q?", group=group, fast=fast, poll=preempt,
+            )
+            job.aux_check = rm.drain_check(prim)
+            ex_p.sched_job = job
+            try:
+                rows = ex_p.execute(
+                    subplan, preempt=preempt, query_span=query_span
+                )
+            finally:
+                prim.scheduler.finish(job)
+            th.join(timeout=10.0)
+            rm.report_success(prim)
+            stolen = int(ex_p.last_run.get("steals", 0) or 0)
+            self._sched_steals += stolen
+            if query_span is not None and stolen:
+                query_span.event(
+                    "work_steal",
+                    primary=prim.replica_id, helper=helper.replica_id,
+                    split_at=mid, of=K,
+                )
+            return rows
+        except (MeshStuck, MeshDeviceLost):
+            # the split dispatch itself faulted: hand back to the
+            # wholesale failover loop (the checkpoint is still live)
+            return None
+        finally:
+            CHECKPOINTS.discard(steal_key)
+            rm.release(helper)
+            rm.release(prim)
 
     def _record_mesh_fallback(self, reason: str, query_span=None) -> None:
         """One mesh->page fallback: bump the aggregate counter, latch
@@ -1231,6 +1457,29 @@ class DistributedQueryRunner:
             return f"replicas= n={n} (single mesh)"
         return rm.stats_line()
 
+    def _scheduler_line(self) -> str:
+        """The EXPLAIN ANALYZE preemptive-scheduler line: park/resume/
+        preemption counters summed across this runner's schedulers (the
+        single-mesh queue plus any replica run queues) and completed
+        work-stealing dispatches — instance-scoped, like the replica
+        line, so corpus output stays deterministic across process
+        reuse."""
+        scheds = []
+        if self._mesh_scheduler is not None:
+            scheds.append(self._mesh_scheduler)
+        rm = self._replicas
+        if rm is not None:
+            scheds.extend(r.scheduler for r in rm.replicas)
+        parks = sum(s.parks for s in scheds)
+        resumes = sum(s.resumes for s in scheds)
+        preempts = sum(s.preemptions for s in scheds)
+        refusals = sum(s.park_refusals for s in scheds)
+        return (
+            f"scheduler= parks={parks} resumes={resumes} "
+            f"preemptions={preempts} park_refusals={refusals} "
+            f"steals={self._sched_steals}"
+        )
+
     def _explain_text(self, subplan) -> str:
         """Fragment rendering with per-fragment compile-churn census
         annotations (expected_xla_lowerings — sql/validate.py)."""
@@ -1280,6 +1529,7 @@ class DistributedQueryRunner:
             lines.append(self._recovery_line())
             lines.append(self._skew_line())
             lines.append(self._replica_line())
+            lines.append(self._scheduler_line())
             return MaterializedResult(
                 [["\n".join(lines)]], ["Query Plan"], [T.VARCHAR]
             )

@@ -114,6 +114,18 @@ def preemption_check(tracker, base_qid, cancel=None, deadline_epoch_s=None,
         on = f" on replica {replica}" if replica is not None else ""
         return f" (resumed from chunk {resumed}{on})"
 
+    def _park_ctx() -> str:
+        # the scheduler's wait loops update `check.parked_s` while the
+        # query sits parked or queued — a deadline firing there names
+        # the time spent preempted. Deliberately counted against the
+        # budget: parking does not stop a query's wall clock, so a
+        # parked query that exceeds its deadline dies typed and never
+        # resumes.
+        parked = float(getattr(check, "parked_s", 0.0) or 0.0)
+        if parked <= 0.0:
+            return ""
+        return f" (parked {parked:.2f}s)"
+
     def check(done: int, total: int) -> None:
         # a kill latched by the enforcement tick (planning/run/cpu
         # limits) surfaces here as its typed error — after a checkpoint
@@ -122,7 +134,7 @@ def preemption_check(tracker, base_qid, cancel=None, deadline_epoch_s=None,
         try:
             tracker.check(base_qid)
         except QueryDeadlineError as e:
-            ctx = _resume_ctx()
+            ctx = _resume_ctx() + _park_ctx()
             if not ctx:
                 raise
             raise type(e)(
@@ -136,11 +148,13 @@ def preemption_check(tracker, base_qid, cancel=None, deadline_epoch_s=None,
         if deadline_epoch_s is not None and clock() > deadline_epoch_s:
             raise ExceededTimeLimitError(
                 "Query exceeded the execution-time limit at mesh chunk "
-                f"{done}/{total}{_resume_ctx()} [{EXCEEDED_TIME_LIMIT}]"
+                f"{done}/{total}{_resume_ctx()}{_park_ctx()} "
+                f"[{EXCEEDED_TIME_LIMIT}]"
             )
 
     check.resumed_from = None
     check.resumed_on = None
+    check.parked_s = 0.0
     return check
 
 

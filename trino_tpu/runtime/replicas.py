@@ -94,7 +94,7 @@ class Replica:
     slice, breaker-tracked health, lifecycle state and live depth."""
 
     def __init__(self, replica_id: int, devices: Sequence,
-                 breaker: CircuitBreaker):
+                 breaker: CircuitBreaker, scheduler_kw=None):
         self.replica_id = replica_id
         self.devices = list(devices)
         self.breaker = breaker
@@ -105,6 +105,18 @@ class Replica:
         # on this lock per replica — REPLICAS are the serving tier's
         # units of mesh concurrency, not threads on one mesh.
         self.exec_lock = threading.Lock()
+        # the replica's run queue (runtime/scheduler.py): the same
+        # single-program guarantee as exec_lock, but chunk-granular —
+        # the holder's chunk loop consults the scheduler at every
+        # boundary, so fast-lane arrivals preempt (park) the running
+        # analytic instead of queueing behind its whole run. The
+        # coordinator routes through this when mesh_scheduler is on,
+        # and through the bare exec_lock otherwise.
+        from trino_tpu.runtime.scheduler import MeshScheduler
+
+        self.scheduler = MeshScheduler(
+            name=f"replica-{replica_id}", **(scheduler_kw or {})
+        )
         # active -> shutting_down (drain requested: no new placements,
         # in-flight chunk loops fail over at the next boundary) ->
         # drained (nothing in flight; decommissionable)
@@ -121,7 +133,8 @@ class ReplicaManager:
 
     def __init__(self, n_replicas: int, devices=None,
                  breaker_threshold: int = 3,
-                 breaker_cooldown_s: float = 1.0):
+                 breaker_cooldown_s: float = 1.0,
+                 scheduler_kw=None):
         import jax
 
         maybe_initialize_distributed()
@@ -156,6 +169,7 @@ class ReplicaManager:
                     breaker_threshold, breaker_cooldown_s,
                     on_open=self._on_breaker_open,
                 ),
+                scheduler_kw=scheduler_kw,
             )
             for r in range(n_replicas)
         ]

@@ -194,6 +194,45 @@ def is_point_lookup(stmt) -> bool:
     return has_key_predicate(spec.where)
 
 
+def is_fast_lane(stmt) -> bool:
+    """Mesh-scheduler fast-lane shape test: a point lookup, possibly
+    decorated with one dimension join (key-predicated base table joined
+    to a plain table ref). The scheduler's fast lane preempts the
+    running analytic at chunk boundaries, so eligibility must stay
+    cheap — a few chunk-steps of work, never a streaming driver —
+    which a single decorated lookup satisfies but a multi-join tree
+    does not. Never raises: surprises classify as NOT fast."""
+    try:
+        from trino_tpu.sql import ast
+
+        if is_point_lookup(stmt):
+            return True
+        if not isinstance(stmt, ast.Query):
+            return False
+        spec = stmt.body
+        if not isinstance(spec, ast.QuerySpec):
+            return False
+        j = spec.from_
+        if not isinstance(j, ast.Join):
+            return False
+        if not (
+            isinstance(j.left, ast.TableRef)
+            and isinstance(j.right, ast.TableRef)
+        ):
+            return False
+        if spec.where is None:
+            return False
+        # reuse the point-lookup key test over the decorated shape
+        probe = ast.Query(
+            body=ast.QuerySpec(
+                select=spec.select, from_=j.left, where=spec.where,
+            )
+        )
+        return is_point_lookup(probe)
+    except Exception:
+        return False
+
+
 def fast_path_probe(runner, sql: str, prepared=None) -> bool:
     """True iff `sql` is a point lookup whose plan the runner already
     holds — the submission can skip the general lane. Never raises:
