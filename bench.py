@@ -116,6 +116,18 @@ Flags:
                      beat abandoning + rerunning the analytic;
                      re-execs itself with an 8-device host platform,
                      so no device needed
+  --multihost-smoke  exercise the multi-host replica fabric
+                     (runtime/fabric.py) across a REAL process
+                     boundary: a victim coordinator subprocess streams
+                     its chunk checkpoints to the survivor's fabric
+                     endpoint and hard-kills itself (os._exit) at
+                     chunk 3K/4; the survivor digest-rejects a
+                     corrupted replay, then resumes the query from
+                     exactly the fault chunk — oracle-equal, zero
+                     re-executed chunk-steps, zero new lowerings,
+                     beating its own warm full-length wall;
+                     re-execs itself with an 8-device host platform,
+                     so no device needed
 """
 
 from __future__ import annotations
@@ -1032,7 +1044,10 @@ def _serve_replica_sweep(argv) -> int:
             duration_s=duration_s,
             rate_qps=rate,
             utilization=_serve_flag(argv, "--serve-util", 0.9),
-            batch_phase_s=0.0,
+            # batched burst runs on the replicated runner too: the
+            # combined IN-list lookups must ride the MeshScheduler
+            # fast lane on the replica run queues (gated below)
+            batch_phase_s=_serve_flag(argv, "--serve-batch", 1.0),
             seed=seed,
             runner=runner,
             warmup_rounds=max(1, n_replicas),
@@ -1049,6 +1064,32 @@ def _serve_replica_sweep(argv) -> int:
                       "plan_cache_hit_rate", "xla_compiles_after_warmup")
         }
         arms[n_replicas]["replica_stats"] = rm.stats() if rm else None
+        bp = report.get("batch_phase")
+        if bp is not None:
+            arms[n_replicas]["batch_phase"] = {
+                k: bp[k]
+                for k in ("queries", "mismatches", "error_count",
+                          "batches", "batched_queries", "mesh_fast_lane")
+            }
+            if bp["mismatches"] or bp["error_count"]:
+                violations.append(
+                    f"arm r={n_replicas}: batch phase "
+                    f"{bp['mismatches']} mismatches, "
+                    f"{bp['error_count']} errors"
+                )
+            if bp["batches"] == 0 or bp["batched_queries"] <= bp["batches"]:
+                violations.append(
+                    f"arm r={n_replicas}: batch phase never coalesced "
+                    f"(batches={bp['batches']}, "
+                    f"batched_queries={bp['batched_queries']})"
+                )
+            if bp["mesh_fast_lane"] < bp["batches"]:
+                violations.append(
+                    f"arm r={n_replicas}: batched lookups bypassed the "
+                    f"mesh scheduler fast lane "
+                    f"(fast submissions {bp['mesh_fast_lane']} < "
+                    f"batches {bp['batches']})"
+                )
         if report["mismatches"]:
             violations.append(
                 f"arm r={n_replicas}: {report['mismatches']} results "
@@ -2173,6 +2214,286 @@ def _failover_smoke(argv) -> int:
     return 1 if violations else 0
 
 
+def _multihost_victim() -> int:
+    """The victim coordinator of --multihost-smoke: its own process,
+    its own 8-device CPU mesh, the survivor's fabric endpoint as its
+    only peer. Runs the recovery query with checkpointing every chunk
+    (each boundary's snapshot streams to the survivor), then HARD-KILLS
+    itself (os._exit — no unwind, no goodbye) at chunk 3K/4 after
+    forcing the last snapshot onto the wire."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from trino_tpu.connectors.tpch import create_tpch_connector
+    from trino_tpu.engine import Session
+    from trino_tpu.parallel import mesh_chunk
+    from trino_tpu.recovery import CHECKPOINTS
+    from trino_tpu.runtime import DistributedQueryRunner
+    from trino_tpu.runtime.fabric import active_fabric
+
+    uri = os.environ["MULTIHOST_FABRIC_URI"]
+    runner = DistributedQueryRunner(
+        Session(
+            catalog="tpch", schema="tiny", mesh_replicas=2,
+            mesh_chunk_rows=256, mesh_resume_attempts=0,
+            mesh_checkpoint_interval_chunks=1, fabric_peers=uri,
+        ),
+        n_workers=2, hash_partitions=2,
+    )
+    runner.register_catalog("tpch", create_tpch_connector())
+
+    def hook(k, K):
+        fault_k = max(1, (3 * K) // 4)
+        if k != fault_k:
+            return
+        fab = active_fabric()
+        if fab is not None:
+            # drain the async queue, then ship the LATEST snapshot of
+            # every live entry synchronously: the survivor must hold
+            # next_chunk == fault_k before this process ceases to exist
+            fab.pusher.flush(10.0)
+            for key in list(CHECKPOINTS._entries):
+                fab.pusher._push(key)
+        print(json.dumps(
+            {"victim": {"fault_chunk": k, "chunks": K}}
+        ), flush=True)
+        os._exit(9)
+
+    mesh_chunk.MESH_FAULT_HOOK = hook
+    runner.execute(RECOVERY_Q)
+    print(json.dumps({"victim": {"error": "fault never fired"}}),
+          flush=True)
+    return 1
+
+
+def _multihost_smoke(argv) -> int:
+    """--multihost-smoke: CI gate for the multi-host replica fabric
+    (trino_tpu/runtime/fabric.py) across a REAL process boundary. Two
+    coordinator processes, each over its own 8-device CPU mesh: the
+    SURVIVOR warms the recovery query and opens a fabric endpoint over
+    its checkpoint store; the VICTIM subprocess attaches that endpoint
+    as its fabric peer, checkpoints every chunk (each boundary's bytes
+    stream to the survivor), and hard-kills itself (os._exit 9, no
+    unwind) at chunk 3K/4. Gates: the pushed snapshot landed in the
+    survivor's store across the process boundary; a corrupted replay of
+    it (bit-flipped bytes under the original digest) is digest-rejected
+    without poisoning the store (fabric.digest_rejects >= 1); the
+    survivor's next run of the same query resumes from exactly the
+    victim's fault chunk — oracle-equal bytes, zero re-executed
+    chunk-steps, zero new XLA lowerings — and beats the survivor's own
+    warm full-length wall. Exit 1 on violation."""
+    if os.environ.get("MULTIHOST_SMOKE_VICTIM") == "1":
+        return _multihost_victim()
+    if os.environ.get("MULTIHOST_SMOKE_INNER") != "1":
+        env = dict(os.environ)
+        env["MULTIHOST_SMOKE_INNER"] = "1"
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        return subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--multihost-smoke"],
+            env=env,
+        ).returncode
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    n_dev = len(jax.devices())
+
+    # both processes authenticate fabric traffic with the same secret
+    os.environ.setdefault("TRINO_TPU_INTERNAL_SECRET", "multihost-smoke")
+
+    from trino_tpu.connectors.tpch import create_tpch_connector
+    from trino_tpu.engine import Session
+    from trino_tpu.parallel.mesh_chunk import LAST_RUN_INFO
+    from trino_tpu.recovery import CHECKPOINTS
+    from trino_tpu.runtime import DistributedQueryRunner
+    from trino_tpu.runtime.fabric import HostFabric, checkpoint_digest
+    from trino_tpu.runtime.http import FabricClient, FabricServer
+    from trino_tpu.runtime.metrics import METRICS
+
+    violations = []
+    print(f"bench: multihost smoke ({n_dev}-device cpu mesh per "
+          "coordinator, 2 processes, q72-class join, tpch tiny)")
+
+    def mk(**session_kw):
+        r = DistributedQueryRunner(
+            Session(
+                catalog="tpch", schema="tiny", mesh_replicas=2,
+                mesh_chunk_rows=256, mesh_resume_attempts=0,
+                mesh_checkpoint_interval_chunks=1, **session_kw,
+            ),
+            n_workers=2, hash_partitions=2,
+        )
+        r.register_catalog("tpch", create_tpch_connector())
+        return r
+
+    page = mk(mesh_execution=False)
+    oracle = page.execute(RECOVERY_Q).rows
+
+    survivor = mk()
+    # warm both replicas; the second (fully warm) run's wall is the
+    # cold-restart baseline the resume must beat
+    wall_cold = None
+    for _ in range(2):
+        t0 = time.time()
+        rows = survivor.execute(RECOVERY_Q).rows
+        wall_cold = time.time() - t0
+        if rows != oracle:
+            violations.append("survivor warm run != page oracle")
+        if survivor._last_data_plane != "mesh":
+            violations.append(
+                f"survivor warm run took {survivor._last_data_plane}, "
+                f"not the mesh ({survivor.last_mesh_fallback})"
+            )
+    K_local = int(LAST_RUN_INFO.get("chunks") or 0)
+
+    # the survivor's fabric endpoint, bound over its LIVE store — what
+    # the victim pushes is exactly what resume-on-entry will find
+    peer = HostFabric(host_id="survivor")
+    srv = FabricServer(peer)
+    CHECKPOINTS.clear()  # all entries after the victim dies are pushed ones
+
+    victim_env = dict(os.environ)
+    victim_env["MULTIHOST_SMOKE_VICTIM"] = "1"
+    victim_env["MULTIHOST_FABRIC_URI"] = srv.uri
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--multihost-smoke"],
+        env=victim_env, capture_output=True, text=True, timeout=600,
+    )
+    wall_victim = time.time() - t0
+    victim = {}
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                victim = json.loads(line).get("victim", {})
+            except ValueError:
+                pass
+    if proc.returncode != 9:
+        violations.append(
+            f"victim exited {proc.returncode}, expected the hard-kill 9 "
+            f"(stderr tail: {proc.stderr[-300:]!r})"
+        )
+    fault_k = victim.get("fault_chunk")
+    K = victim.get("chunks")
+    if not fault_k or not K:
+        violations.append(f"victim never reported its fault point ({victim})")
+    elif K != K_local:
+        violations.append(
+            f"chunking diverged across hosts: victim ran {K} chunks, "
+            f"survivor {K_local} — checkpoint keys cannot line up"
+        )
+    if peer.received < 1 or len(CHECKPOINTS) < 1:
+        violations.append(
+            f"no checkpoint crossed the process boundary "
+            f"(received={peer.received}, entries={len(CHECKPOINTS)})"
+        )
+
+    # corruption arm: replay the pushed snapshot bit-flipped under its
+    # ORIGINAL digest — the digest gate must reject it and leave the
+    # genuine entry untouched for the resume arm below
+    rejects0 = METRICS.counter("fabric.digest_rejects")
+    pushed_key = next(iter(CHECKPOINTS._entries), None)
+    if pushed_key is not None:
+        data = CHECKPOINTS.export_bytes(pushed_key)
+        flipped = bytearray(data)
+        flipped[len(flipped) // 2] ^= 0xFF
+        client = FabricClient(srv.uri)
+        out = client.push_checkpoint(
+            pushed_key, bytes(flipped), digest=checkpoint_digest(data)
+        )
+        if out.get("imported") is not False or (
+            out.get("reason") != "digest_mismatch"
+        ):
+            violations.append(
+                f"corrupted payload was not digest-rejected ({out})"
+            )
+        if METRICS.counter("fabric.digest_rejects") - rejects0 < 1:
+            violations.append(
+                "fabric.digest_rejects did not count the corrupt replay"
+            )
+        if CHECKPOINTS.export_bytes(pushed_key) != data:
+            violations.append(
+                "corrupt replay POISONED the stored checkpoint bytes"
+            )
+
+    # resume arm: the survivor re-runs the query; resume-on-entry finds
+    # the victim's pushed snapshot in the local store and continues from
+    # exactly the fault chunk on warm programs
+    steps0 = METRICS.counter("mesh.chunk_steps")
+    compiles0 = METRICS.counter("xla_compiles")
+    t0 = time.time()
+    rows = survivor.execute(RECOVERY_Q).rows
+    wall_resume = time.time() - t0
+    steps = int(METRICS.counter("mesh.chunk_steps") - steps0)
+    new_lowerings = int(METRICS.counter("xla_compiles") - compiles0)
+    info = dict(LAST_RUN_INFO)
+    if rows != oracle:
+        violations.append("survivor resume diverged from the oracle")
+    if survivor._last_data_plane != "mesh":
+        violations.append(
+            f"survivor resume took {survivor._last_data_plane}, not the "
+            f"mesh ({survivor.last_mesh_fallback})"
+        )
+    if not info.get("resumes"):
+        violations.append(
+            f"survivor never resumed from the pushed checkpoint ({info})"
+        )
+    elif fault_k and info.get("resumed_from_chunk") != fault_k:
+        violations.append(
+            f"survivor resumed from chunk {info.get('resumed_from_chunk')}"
+            f", not the victim's fault chunk {fault_k} — the last push "
+            f"did not make it"
+        )
+    if fault_k and K and steps != K - fault_k:
+        violations.append(
+            f"re-executed {steps - (K - fault_k)} chunk-steps "
+            f"({steps} steps for {K - fault_k} remaining chunks)"
+        )
+    if new_lowerings > 0:
+        violations.append(
+            f"survivor minted {new_lowerings} new XLA lowerings on "
+            "resume (expected 0: its programs were already warm)"
+        )
+    if wall_cold is not None and wall_resume >= wall_cold:
+        violations.append(
+            f"resume wall {wall_resume:.2f}s did not beat the warm "
+            f"full-length wall {wall_cold:.2f}s"
+        )
+
+    srv.stop()
+    for v in violations:
+        print(f"bench: multihost VIOLATION: {v}", file=sys.stderr)
+    print(json.dumps({
+        "multihost_smoke": {
+            "devices": n_dev,
+            "chunks": K,
+            "fault_chunk": fault_k,
+            "victim_exit": proc.returncode,
+            "victim_wall_s": round(wall_victim, 3),
+            "pushed_entries": peer.received,
+            "digest_rejects": int(
+                METRICS.counter("fabric.digest_rejects") - rejects0
+            ),
+            "resumed_from_chunk": info.get("resumed_from_chunk"),
+            "re_executed_chunk_steps": (
+                steps - (K - fault_k) if fault_k and K else None
+            ),
+            "new_lowerings_on_resume": new_lowerings,
+            "cold_wall_s": round(wall_cold, 3) if wall_cold else None,
+            "resume_wall_s": round(wall_resume, 3),
+            "violations": len(violations),
+        }
+    }))
+    return 1 if violations else 0
+
+
 def _preempt_smoke(argv) -> int:
     """--preempt-smoke: CI gate for checkpoint-backed preemptive
     multi-tenancy (trino_tpu/runtime/scheduler.py). One full-width
@@ -2879,6 +3200,8 @@ def main() -> None:
         sys.exit(_failover_smoke(sys.argv))
     if "--skew-smoke" in sys.argv:
         sys.exit(_skew_smoke(sys.argv))
+    if "--multihost-smoke" in sys.argv:
+        sys.exit(_multihost_smoke(sys.argv))
     if "--preempt-smoke" in sys.argv:
         sys.exit(_preempt_smoke(sys.argv))
     if "--validate-corpus" in sys.argv:

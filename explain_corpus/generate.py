@@ -404,6 +404,8 @@ def corpus_07_distributed_analyze():
         text = re.sub(r"\b(wall|cpu)=\d+(\.\d+)?ms", r"\1=#ms", text)
         text = re.sub(r"\b(add|get|finish)=\d+(\.\d+)?", r"\1=#", text)
         text = re.sub(r"\btask q\d+\.", "task q#.", text)
+        # corpus 15 pins the real membership= line
+        text = re.sub(r"membership= .*", "membership= #", text)
         text = re.sub(r"replicas= .*", "replicas= #", text)
         # process-global resident/recovery-tier counters depend on what
         # ran before this corpus fn — corpora 09 and 11 pin the real
@@ -456,6 +458,8 @@ def corpus_08_mesh_analyze():
         text = re.sub(r"\b(wall|cpu)=\d+(\.\d+)?ms", r"\1=#ms", text)
         text = re.sub(r"\b(add|get|finish)=\d+(\.\d+)?", r"\1=#", text)
         text = re.sub(r"\btask q\d+\.", "task q#.", text)
+        # corpus 15 pins the real membership= line
+        text = re.sub(r"membership= .*", "membership= #", text)
         text = re.sub(r"replicas= .*", "replicas= #", text)
         text = re.sub(r"resident= .*", "resident= #", text)
         text = re.sub(r"recovery= .*", "recovery= #", text)
@@ -551,6 +555,8 @@ def corpus_09_resident_analyze():
         text = re.sub(r"\b(wall|cpu)=\d+(\.\d+)?ms", r"\1=#ms", text)
         text = re.sub(r"\b(add|get|finish)=\d+(\.\d+)?", r"\1=#", text)
         text = re.sub(r"\btask q\d+\.", "task q#.", text)
+        # corpus 15 pins the real membership= line
+        text = re.sub(r"membership= .*", "membership= #", text)
         text = re.sub(r"replicas= .*", "replicas= #", text)
         text = re.sub(r"pinned_bytes=\d+", "pinned_bytes=#", text)
         text = re.sub(r"recovery= .*", "recovery= #", text)
@@ -621,6 +627,8 @@ def corpus_10_adaptive_analyze():
         text = re.sub(r"\b(wall|cpu)=\d+(\.\d+)?ms", r"\1=#ms", text)
         text = re.sub(r"\b(add|get|finish)=\d+(\.\d+)?", r"\1=#", text)
         text = re.sub(r"\btask q\d+\.", "task q#.", text)
+        # corpus 15 pins the real membership= line
+        text = re.sub(r"membership= .*", "membership= #", text)
         text = re.sub(r"replicas= .*", "replicas= #", text)
         text = re.sub(r"resident= .*", "resident= #", text)
         text = re.sub(r"recovery= .*", "recovery= #", text)
@@ -716,6 +724,8 @@ def corpus_11_recovery_analyze():
         text = re.sub(r"\b(wall|cpu)=\d+(\.\d+)?ms", r"\1=#ms", text)
         text = re.sub(r"\b(add|get|finish)=\d+(\.\d+)?", r"\1=#", text)
         text = re.sub(r"\btask q\d+\.", "task q#.", text)
+        # corpus 15 pins the real membership= line
+        text = re.sub(r"membership= .*", "membership= #", text)
         text = re.sub(r"replicas= .*", "replicas= #", text)
         text = re.sub(r"resident= .*", "resident= #", text)
         text = re.sub(r"skew= .*", "skew= #", text)
@@ -820,6 +830,8 @@ def corpus_12_skew_analyze():
         text = re.sub(r"\b(wall|cpu)=\d+(\.\d+)?ms", r"\1=#ms", text)
         text = re.sub(r"\b(add|get|finish)=\d+(\.\d+)?", r"\1=#", text)
         text = re.sub(r"\btask q\d+\.", "task q#.", text)
+        # corpus 15 pins the real membership= line
+        text = re.sub(r"membership= .*", "membership= #", text)
         text = re.sub(r"replicas= .*", "replicas= #", text)
         text = re.sub(r"resident= .*", "resident= #", text)
         text = re.sub(r"recovery= .*", "recovery= #", text)
@@ -918,6 +930,8 @@ def corpus_13_replica_analyze():
         text = re.sub(r"\b(wall|cpu)=\d+(\.\d+)?ms", r"\1=#ms", text)
         text = re.sub(r"\b(add|get|finish)=\d+(\.\d+)?", r"\1=#", text)
         text = re.sub(r"\btask q\d+\.", "task q#.", text)
+        # corpus 15 pins the real membership= line
+        text = re.sub(r"membership= .*", "membership= #", text)
         text = re.sub(r"resident= .*", "resident= #", text)
         text = re.sub(r"recovery= .*", "recovery= #", text)
         text = re.sub(r"skew= .*", "skew= #", text)
@@ -1034,6 +1048,8 @@ def corpus_14_scheduler_analyze():
         text = re.sub(r"\b(wall|cpu)=\d+(\.\d+)?ms", r"\1=#ms", text)
         text = re.sub(r"\b(add|get|finish)=\d+(\.\d+)?", r"\1=#", text)
         text = re.sub(r"\btask q\d+\.", "task q#.", text)
+        # corpus 15 pins the real membership= line
+        text = re.sub(r"membership= .*", "membership= #", text)
         text = re.sub(r"resident= .*", "resident= #", text)
         text = re.sub(r"recovery= .*", "recovery= #", text)
         text = re.sub(r"skew= .*", "skew= #", text)
@@ -1051,6 +1067,183 @@ def corpus_14_scheduler_analyze():
          "scheduler=\nline reports this runner's instance-scoped "
          "park/resume/preemption\ncounters (wall-clock values redacted "
          "to `#`)", redact(out)),
+    )
+
+
+def corpus_15_fabric_analyze():
+    """The multi-host replica fabric (trino_tpu/runtime/fabric.py).
+    Two legs. Transport: a loopback FabricServer fronting a peer
+    HostFabric takes a framed checkpoint push, serves it back
+    byte-identical, and refuses a corrupted payload typed on its
+    sha256 digest — instance-scoped endpoint counters pin the
+    exchange. Membership: a replicated runner suffers a sibling
+    membership flap (leave + rejoin, each bumping the monotonic
+    epoch) immediately followed by a device loss on the serving
+    replica; failover resumes on the rejoined sibling because its
+    join_epoch equals the fault epoch, while a resume context
+    captured BEFORE the flap is refused typed (MembershipEpochError).
+    The trailing `membership=` line of EXPLAIN ANALYZE pins the epoch
+    and join/leave/fence counters — instance-scoped, so the numbers
+    are exact. Timings redacted as in corpus 07."""
+    import re
+
+    from trino_tpu.parallel import mesh_chunk
+    from trino_tpu.recovery import CHECKPOINTS
+    from trino_tpu.recovery.checkpoint import (
+        MeshCheckpoint,
+        MeshCheckpointStore,
+    )
+    from trino_tpu.runtime import DistributedQueryRunner
+    from trino_tpu.runtime.fabric import (
+        HostFabric,
+        MembershipEpochError,
+        checkpoint_digest,
+    )
+    from trino_tpu.runtime.http import FabricClient, FabricServer
+
+    # -- transport leg: push / pull / corrupt over a loopback endpoint
+    peer_store = MeshCheckpointStore()
+    peer = HostFabric(store=peer_store, host_id="peer")
+    srv = FabricServer(peer, internal_secret=None, require_secret=False)
+    client = FabricClient(srv.uri, internal_secret=None)
+    key = ("corpus15", "fabric", 0)
+    data = MeshCheckpoint(
+        next_chunk=3, n_chunks=8, chunk_cap=64,
+        resolved_caps={"rows": 64},
+        carries_host=(
+            np.arange(64, dtype=np.int64),
+            np.linspace(0.0, 1.0, 64),
+        ),
+        tables=(), generations=(),
+    ).to_bytes()
+    pushed = client.push_checkpoint(key, data)
+    back, digest = client.pull_checkpoint(key)
+    corrupt = bytearray(data)
+    corrupt[len(corrupt) // 2] ^= 0xFF
+    # original digest over corrupted bytes: the endpoint must refuse
+    rejected = client.push_checkpoint(
+        key, bytes(corrupt), digest=checkpoint_digest(data)
+    )
+    stored = peer_store.export_bytes(key)
+    srv.stop()
+    transport = [
+        "peer endpoint: HostFabric behind a loopback FabricServer "
+        "(single-process\nembedding, require_secret=False; a networked "
+        "fabric refuses to start\nwithout TRINO_TPU_INTERNAL_SECRET)",
+        f"push accepted: imported={pushed.get('imported')} — the "
+        "encoded checkpoint key\ntravels length-prefixed in the request "
+        "BODY, never the request line",
+        f"pull round-trip byte-identical: {back == data} (digest "
+        f"match: {digest == checkpoint_digest(data)})",
+        "corrupted payload under the original digest refused typed: "
+        f"imported={rejected.get('imported')} "
+        f"reason={rejected.get('reason')}",
+        f"stored entry unpoisoned by the refused push: {stored == data}",
+        f"endpoint counters: received={peer.received} "
+        f"served={peer.served} digest_rejects={peer.digest_rejects}",
+    ]
+
+    # -- membership leg: flap + host loss on a replicated runner ------
+    CHECKPOINTS.clear()
+    r = DistributedQueryRunner(
+        Session(
+            catalog="tpch", schema="tiny",
+            mesh_replicas=2, mesh_chunk_rows=1024,
+            mesh_checkpoint_interval_chunks=1, mesh_resume_attempts=0,
+        ),
+        n_workers=2,
+        hash_partitions=2,
+    )
+    r.register_catalog("tpch", create_tpch_connector())
+    sql = (
+        "select l_returnflag, count(*), sum(l_quantity) from lineitem "
+        "group by l_returnflag"
+    )
+    # two warm runs: round-robin placement warms both sub-meshes
+    clean = r.execute(sql).rows
+    r.execute(sql)
+    n_chunks = mesh_chunk.LAST_RUN_INFO["chunks"]
+    target = n_chunks - 2
+    state = {"victim": None, "fired": False, "pre_epoch": None}
+
+    def flap_then_kill(k, K):
+        rep = mesh_chunk.active_replica()
+        if rep is None:
+            return
+        if state["victim"] is None:
+            state["victim"] = rep
+        if not state["fired"] and rep == state["victim"] and k >= target:
+            state["fired"] = True
+            rm_ = r._replicas
+            state["pre_epoch"] = rm_.membership_epoch
+            # sibling flaps (heartbeat loss + recovery) just before the
+            # serving replica dies: two epoch bumps, then the fault
+            rm_.leave(1 - rep)
+            rm_.join(1 - rep)
+            raise mesh_chunk.MeshDeviceLost(
+                f"injected: replica {rep} lost at chunk {k}/{K} "
+                "after a sibling membership flap"
+            )
+
+    mesh_chunk.MESH_FAULT_HOOK = flap_then_kill
+    try:
+        faulted = r.execute(sql).rows
+    finally:
+        mesh_chunk.MESH_FAULT_HOOK = None
+    assert state["fired"], "fault hook never reached its target chunk"
+    info = mesh_chunk.LAST_RUN_INFO
+    rm = r._replicas
+    sib = 1 - state["victim"]
+    # a resume context captured BEFORE the flap is stale: the sibling's
+    # join_epoch has moved past it, so the fence refuses it typed
+    try:
+        rm.require_epoch(rm.replicas[sib], state["pre_epoch"])
+        fenced = False
+    except MembershipEpochError:
+        fenced = True
+    events = [
+        f"grid: {rm.n_replicas} replicas x {rm.partition_width} "
+        f"devices; membership epoch starts at {state['pre_epoch']}",
+        f"flap: replica {sib} left and rejoined mid-run (epoch "
+        f"{state['pre_epoch']} -> {rm.membership_epoch}: every leave "
+        "and join bumps it)",
+        f"replica {state['victim']} lost at chunk {target}/{n_chunks}; "
+        f"failover resumed_from_chunk={info['resumed_from_chunk']} on "
+        "the rejoined sibling\n(its join_epoch equals the fault epoch, "
+        "so the resume is admitted)",
+        f"rows oracle-equal to the uninterrupted run: {faulted == clean}",
+        f"stale resume context (epoch {state['pre_epoch']}, captured "
+        "before the flap)\nrefused typed with MembershipEpochError: "
+        f"{fenced}",
+    ]
+    out = r.execute("EXPLAIN ANALYZE " + sql).rows[0][0]
+
+    def redact(text):
+        text = re.sub(r"\b(wall|cpu)=\d+(\.\d+)?ms", r"\1=#ms", text)
+        text = re.sub(r"\b(add|get|finish)=\d+(\.\d+)?", r"\1=#", text)
+        text = re.sub(r"\btask q\d+\.", "task q#.", text)
+        text = re.sub(r"replicas= .*", "replicas= #", text)
+        text = re.sub(r"resident= .*", "resident= #", text)
+        text = re.sub(r"recovery= .*", "recovery= #", text)
+        text = re.sub(r"skew= .*", "skew= #", text)
+        return text
+
+    emit(
+        "15_fabric_analyze.txt",
+        (f"QUERY\n{sql}", ""),
+        ("checkpoint transport across the host boundary: framed "
+         "push/pull with\nsha256 content digests; a corrupted payload "
+         "is refused typed and never\npoisons the receiving store",
+         "\n".join(transport)),
+        ("heartbeat-driven membership under a flap + host loss "
+         "(mesh_replicas=2):\nthe rejoined sibling resumes from the "
+         "host-portable checkpoint; a\npre-flap resume context is "
+         "fenced on the membership epoch",
+         "\n".join(events)),
+        ("EXPLAIN ANALYZE after the flap + failover: the trailing "
+         "membership=\nline reports the monotonic epoch and this "
+         "runner's instance-scoped\njoin/leave/fence counters "
+         "(wall-clock values redacted to `#`)", redact(out)),
     )
 
 
@@ -1074,6 +1267,7 @@ def write_all(out_dir=None):
         corpus_12_skew_analyze()
         corpus_13_replica_analyze()
         corpus_14_scheduler_analyze()
+        corpus_15_fabric_analyze()
     finally:
         _OUT_DIR[0] = HERE
 

@@ -105,6 +105,15 @@ class ProgramCache:
                 "evictions": self.evictions,
             }
 
+    def fingerprints(self) -> list:
+        """Stable string forms of every cached program key, in LRU
+        order — the joining-host warm manifest (runtime/fabric.py)
+        ships these so a new host can see which program identities the
+        pod has compiled (observability: keys are structural tuples,
+        repr is their canonical printable form)."""
+        with self._lock:
+            return [repr(k) for k in self._entries]
+
 
 # the process singleton the planner uses
 PROGRAM_CACHE = ProgramCache()

@@ -67,6 +67,34 @@ def reset_warm_classes() -> None:
         WARM_CLASSES.clear()
 
 
+def warm_manifest() -> list:
+    """JSON-serializable snapshot of the warm-class registry, sorted
+    for determinism — the payload a joining host replays (multi-host
+    fabric warm join) so its first placed query mints zero new
+    lowerings for classes the pod has already proven."""
+    with _warm_lock:
+        keys = sorted(WARM_CLASSES)
+    return [[op, int(cap), list(dts)] for op, cap, dts in keys]
+
+
+def apply_manifest(manifest) -> int:
+    """Install a warm-class manifest produced by `warm_manifest` on
+    another host. Malformed items are skipped, never raised — a bad
+    manifest degrades to on-demand compilation, not to failure.
+    Returns the number of classes applied."""
+    keys = []
+    for item in manifest or []:
+        try:
+            op, cap, dts = item
+            keys.append(
+                (str(op), int(cap), tuple(str(d) for d in dts))
+            )
+        except Exception:
+            continue
+    note_classes_warm(keys)
+    return len(keys)
+
+
 @dataclasses.dataclass
 class WarmupEntry:
     """One fused stage to precompile across its predicted capacities."""

@@ -308,6 +308,25 @@ for _name, _type, _default, _desc, _allowed in [
      "resumes [k, mid), helper computes [mid, K) and the primary "
      "merges the helper's packed live rows) instead of resuming "
      "wholesale on one", None),
+    ("mesh_park_max_bytes", int, 0,
+     "aggregate host-memory pool for parked snapshots apportioned "
+     "across resource groups by scheduler weight (a group over its "
+     "share gets an in-place yield instead of a park); 0 keeps the "
+     "single undivided park_max_bytes budget", None),
+    # -- multi-host replica fabric (runtime/fabric.py) --
+    ("fabric_peers", str, "",
+     "comma-separated base URIs of peer coordinator fabric endpoints "
+     "(http://host:port); non-empty attaches the checkpoint push/pull "
+     "fabric: checkpoints stream asynchronously to every peer and "
+     "failover pulls the last pushed snapshot on demand", None),
+    ("fabric_queue_depth", int, 8,
+     "bounded depth of the asynchronous checkpoint push queue; a full "
+     "queue sheds the push (fabric.push_sheds) instead of blocking "
+     "the chunk loop", None),
+    ("fabric_max_error_duration_s", float, 5.0,
+     "per-peer transient-error budget for fabric pushes and pulls "
+     "(RequestErrorTracker deadline); exhaustion degrades to a local "
+     "restart, never query failure", None),
     # -- observability (runtime/tracing.py) --
     ("query_trace", str, "off",
      "record a full span tree per query (phases, stages, task attempts, "

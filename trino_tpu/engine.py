@@ -193,6 +193,17 @@ class Session:
     mesh_scheduler_min_slice_chunks: int = 1
     mesh_scheduler_group: str = ""
     mesh_steal_enabled: bool = True
+    # multi-host replica fabric (runtime/fabric.py): park budgets are
+    # apportioned across resource groups by scheduler weight out of
+    # mesh_park_max_bytes (0 = unscoped, fall back to park_max_bytes);
+    # fabric_peers names sibling coordinators whose checkpoint stores
+    # receive async pushes at checkpoint boundaries and serve pulls at
+    # failover, with fabric_max_error_duration_s bounding the retry
+    # budget per peer request
+    mesh_park_max_bytes: int = 0
+    fabric_peers: str = ""
+    fabric_queue_depth: int = 8
+    fabric_max_error_duration_s: float = 5.0
 
     def set_property(self, name: str, value) -> None:
         """SET SESSION entry point — validated through the typed

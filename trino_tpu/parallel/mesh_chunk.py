@@ -126,6 +126,13 @@ class MeshReplicaDraining(MeshDeviceLost):
 # mid-chunk faults (runtime/chaos.py).
 MESH_FAULT_HOOK: Optional[Callable[[int, int], None]] = None
 
+# Multi-host fabric seam (runtime/fabric.py): when set, called as
+# hook(key) right after a checkpoint (or park snapshot) lands in the
+# local store, so the fabric can enqueue the bytes for asynchronous
+# push to peer coordinators. The hook only offers to a bounded queue —
+# shedding never blocks the chunk loop.
+CHECKPOINT_PUSH_HOOK: Optional[Callable[[tuple], None]] = None
+
 # Which replica's sub-mesh the calling thread's chunk loop runs on
 # (None outside a run, or on the single full-width mesh). THREAD-local:
 # under serving load several chunk loops interleave on different
@@ -1586,6 +1593,8 @@ class ChunkedMeshRunner:
             )
             if task_span is not None:
                 task_span.event("checkpoint", chunk=next_chunk, of=K)
+            if CHECKPOINT_PUSH_HOOK is not None:
+                CHECKPOINT_PUSH_HOOK(key)
         except Exception:
             pass
 
@@ -1627,7 +1636,15 @@ class ChunkedMeshRunner:
         budget = int(
             getattr(self.session, "park_max_bytes", 256 << 20)
         )
-        if not CHECKPOINTS.park(key, ckpt, budget):
+        group = None
+        # admission-weighted park pool: mesh_park_max_bytes apportioned
+        # across resource groups by scheduler weight — a group past its
+        # share gets refused (in-place yield), never failed
+        pool = int(getattr(self.session, "mesh_park_max_bytes", 0) or 0)
+        if pool > 0:
+            budget = job.scheduler.park_budget_for(job, pool)
+            group = job.group
+        if not CHECKPOINTS.park(key, ckpt, budget, group=group):
             job.park_refused()
             if task_span is not None:
                 task_span.event("park_refused", chunk=next_chunk, of=K)
@@ -1636,6 +1653,11 @@ class ChunkedMeshRunner:
         self._run_stats["parks"] = int(self._run_stats["parks"]) + 1
         if task_span is not None:
             task_span.event("park", chunk=next_chunk, of=K)
+        if CHECKPOINT_PUSH_HOOK is not None:
+            try:
+                CHECKPOINT_PUSH_HOOK(key)
+            except Exception:
+                pass  # push is best-effort; the park itself succeeded
         try:
             job.park_wait(next_chunk, K)
         except (MeshStuck, MeshDeviceLost):

@@ -111,6 +111,9 @@ class MeshCheckpointStore:
         # keys are pinned (immune to LRU eviction) and their host
         # bytes are accounted against the session park budget.
         self._parked: Dict[tuple, int] = {}  # key -> accounted bytes
+        # resource group a parked entry is accounted to (admission-
+        # weighted park budgets: runtime/scheduler.py park_budget_for)
+        self._park_groups: Dict[tuple, str] = {}
         self.parked_refused = 0
 
     def _generations(self, tables) -> Tuple[int, ...]:
@@ -166,6 +169,7 @@ class MeshCheckpointStore:
         with self._lock:
             self._entries.pop(key, None)
             self._parked.pop(key, None)
+            self._park_groups.pop(key, None)
 
     # -- park lifecycle (preemptive scheduler) ------------------------
     @staticmethod
@@ -181,17 +185,24 @@ class MeshCheckpointStore:
         return total
 
     def park(self, key: tuple, ckpt: MeshCheckpoint,
-             max_bytes: int) -> bool:
+             max_bytes: int, group: Optional[str] = None) -> bool:
         """Install a parked query's snapshot, accounting its host bytes
-        against `max_bytes` together with every other parked entry.
-        Returns False (store untouched) when the budget refuses — the
-        caller keeps its device carries and runs to completion."""
+        against `max_bytes`. With `group=None` the budget is shared by
+        every parked entry (the park_max_bytes pool); with a group the
+        budget is that GROUP's share of the admission-weighted pool
+        (mesh_park_max_bytes apportioned by scheduler weight) and only
+        same-group entries count against it — one group past its share
+        cannot starve another's parks. Returns False (store untouched)
+        when the budget refuses — the caller keeps its device carries
+        and runs to completion."""
         from trino_tpu.runtime.metrics import METRICS
 
         nbytes = self._ckpt_nbytes(ckpt)
         with self._lock:
             in_use = sum(
-                b for k, b in self._parked.items() if k != key
+                b for k, b in self._parked.items()
+                if k != key
+                and (group is None or self._park_groups.get(k) == group)
             )
             if max_bytes >= 0 and in_use + nbytes > max_bytes:
                 self.parked_refused += 1
@@ -199,6 +210,10 @@ class MeshCheckpointStore:
             self._entries[key] = ckpt
             self._entries.move_to_end(key)
             self._parked[key] = nbytes
+            if group is not None:
+                self._park_groups[key] = group
+            else:
+                self._park_groups.pop(key, None)
             self.taken += 1
         METRICS.increment(CHECKPOINTS_TAKEN)
         return True
@@ -211,6 +226,7 @@ class MeshCheckpointStore:
         (typed kills: a dead query must never resume)."""
         with self._lock:
             self._parked.pop(key, None)
+            self._park_groups.pop(key, None)
             if not keep:
                 self._entries.pop(key, None)
 
@@ -231,17 +247,34 @@ class MeshCheckpointStore:
         ckpt = self.get(key)
         return None if ckpt is None else ckpt.to_bytes()
 
-    def import_bytes(self, key: tuple, data: bytes) -> bool:
+    def import_bytes(self, key: tuple, data: bytes,
+                     rebase_epoch: bool = False) -> bool:
         """Install a checkpoint received from another host (or another
         store). The entry lands under THIS process's generation check:
         if local DML advanced any feed table past the snapshot's
         vector, the very next `get` drops it — imported bytes can never
         resurface pre-write state. Returns False on undecodable bytes
-        (a truncated transfer must not poison the store)."""
+        (a truncated transfer must not poison the store).
+
+        `rebase_epoch=True` is the cross-HOST transport mode (the
+        fabric's receive/pull paths): the global generation epoch
+        counts process-local wholesale events (catalog registration,
+        COMMIT), so two coordinators' epochs are incomparable and a
+        peer's snapshot would be stillborn under the local epoch.
+        Rebasing adopts the local epoch per table while KEEPING the
+        snapshot's per-table write counters — table-level DML fencing
+        stays live across the wire."""
         try:
             ckpt = MeshCheckpoint.from_bytes(data)
         except Exception:
             return False
+        if rebase_epoch and ckpt.tables:
+            from trino_tpu.resident import GENERATIONS
+
+            ckpt = dataclasses.replace(ckpt, generations=tuple(sorted(
+                (k, (GENERATIONS.get(k)[0], gen))
+                for (k, (_ep, gen)) in ckpt.generations
+            )))
         self.put(key, ckpt)
         return True
 
@@ -268,6 +301,7 @@ class MeshCheckpointStore:
         with self._lock:
             self._entries.clear()
             self._parked.clear()
+            self._park_groups.clear()
 
     def reset_stats(self) -> None:
         """Zero the lifetime counters (corpus generation and tests pin

@@ -118,6 +118,25 @@ PREEMPT_CLASSES = (
     "preempt_under_drain",
 )
 
+# multi-host fabric scenarios (PR 19): the checkpoint transport and
+# membership tier (runtime/fabric.py) under adversity. host_lost_mid_
+# chunk wipes the local checkpoint store at a seeded boundary (the
+# whole "host" dies, not just a sub-mesh) — failover must PULL the last
+# pushed snapshot from a fabric peer and resume with zero re-executed
+# chunk-steps. membership_flap leaves-and-rejoins the sibling replica
+# mid-fault — the membership epoch must advance, a second claim on an
+# owned query must be refused (no double placement across epochs), and
+# the query still completes oracle-equal. transport_corruption serves
+# bit-flipped payloads from the peer — the digest check must reject
+# them (fabric.digest_rejects) so failover degrades to a clean restart,
+# never a resume from corrupt carries. Run via run_host_lost_case /
+# run_membership_flap_case / run_transport_corruption_case.
+FABRIC_CLASSES = (
+    "host_lost_mid_chunk",
+    "membership_flap",
+    "transport_corruption",
+)
+
 
 def generate_schedule(
     seed: int,
@@ -464,6 +483,294 @@ def run_preempt_under_drain_case(
         "expected": expected,
     }
     return rows, report
+
+
+def _fabric_case_runner(srv_uri: str, mesh_chunk_rows: int,
+                        resume_attempts: int = 1):
+    """Replicated runner whose session attaches the checkpoint fabric
+    to one peer endpoint (the chaos cases' simulated surviving host)."""
+    from trino_tpu.connectors.tpch import create_tpch_connector
+    from trino_tpu.engine import Session
+    from trino_tpu.runtime.coordinator import DistributedQueryRunner
+
+    runner = DistributedQueryRunner(
+        Session(
+            catalog="tpch", schema="tiny",
+            mesh_chunk_rows=mesh_chunk_rows,
+            mesh_checkpoint_interval_chunks=1,
+            mesh_replicas=2,
+            mesh_resume_attempts=resume_attempts,
+            fabric_peers=srv_uri,
+        ),
+        n_workers=2, hash_partitions=2,
+    )
+    runner.register_catalog("tpch", create_tpch_connector())
+    return runner
+
+
+def run_host_lost_case(
+    sql: str, seed: int, mesh_chunk_rows: int = 256,
+) -> Tuple[List[list], dict]:
+    """Hard host loss mid-chunk with the fabric attached: at a seeded
+    boundary the LOCAL checkpoint store is wiped (the host's memory
+    died with it) and the active sub-mesh raises MeshDeviceLost. The
+    coordinator's failover must find the local store empty, PULL the
+    last pushed snapshot from the fabric peer, and resume the query on
+    the sibling from exactly the fault boundary — oracle-equal with
+    zero re-executed chunk-steps."""
+    import os
+
+    from trino_tpu.parallel import mesh_chunk
+    from trino_tpu.recovery.checkpoint import (
+        CHECKPOINTS,
+        MeshCheckpointStore,
+    )
+    from trino_tpu.runtime.fabric import (
+        HostFabric,
+        active_fabric,
+        stop_fabric,
+    )
+    from trino_tpu.runtime.http import FabricServer
+    from trino_tpu.runtime.metrics import METRICS
+
+    secret = os.environ.setdefault(
+        "TRINO_TPU_INTERNAL_SECRET", "chaos-fabric"
+    )
+    peer_store = MeshCheckpointStore()
+    peer = HostFabric(store=peer_store, host_id="chaos-peer")
+    srv = FabricServer(peer, internal_secret=secret)
+    stop_fabric()  # fresh attachment: the session below re-binds it
+    runner = _fabric_case_runner(srv.uri, mesh_chunk_rows)
+    try:
+        expected = runner.execute(sql).rows  # warm run doubles as oracle
+        mesh_clean = runner._last_data_plane == "mesh"
+        rng = random.Random(seed)
+        state = {"target": None, "fired": 0}
+
+        def hook(k: int, K: int) -> None:
+            if state["target"] is None:
+                state["target"] = 1 + rng.randrange(max(K - 1, 1))
+            if k == state["target"] and not state["fired"]:
+                state["fired"] = 1
+                fab = active_fabric()
+                if fab is not None:
+                    # the host's last push must be on the wire before
+                    # it dies — the smoke's victim does the same flush
+                    fab.pusher.flush(10.0)
+                CHECKPOINTS.clear()  # the store dies with the host
+                raise mesh_chunk.MeshDeviceLost(
+                    f"chaos[host_lost_mid_chunk]: host lost at "
+                    f"chunk {k}/{K}"
+                )
+
+        before = METRICS.snapshot()
+        mesh_chunk.MESH_FAULT_HOOK = hook
+        try:
+            rows = runner.execute(sql).rows
+        finally:
+            mesh_chunk.MESH_FAULT_HOOK = None
+        after = METRICS.snapshot()
+        info = dict(mesh_chunk.LAST_RUN_INFO)
+        report = {
+            "mesh_clean_plane": mesh_clean,
+            "mesh_fault_plane": runner._last_data_plane,
+            "fault_chunk": state["target"],
+            "fired": state["fired"],
+            "chunks": info.get("chunks"),
+            "executed_chunk_steps": info.get("executed_chunk_steps"),
+            "resumes": info.get("resumes"),
+            "resumed_from_chunk": info.get("resumed_from_chunk"),
+            "pushes": int(
+                after.get("fabric.pushes", 0) - before.get("fabric.pushes", 0)
+            ),
+            "pulls": int(
+                after.get("fabric.pulls", 0) - before.get("fabric.pulls", 0)
+            ),
+            "peer_served": peer.served,
+            "expected": expected,
+        }
+        return rows, report
+    finally:
+        stop_fabric()
+        srv.stop()
+
+
+def run_membership_flap_case(
+    sql: str, seed: int, mesh_chunk_rows: int = 256,
+) -> Tuple[List[list], dict]:
+    """A membership flap racing a failover: at a seeded boundary the
+    SIBLING replica leaves and immediately rejoins (epoch advances
+    twice), a second claim on the in-flight query is attempted and must
+    be REFUSED (exactly one owner per query, across epochs), then the
+    active sub-mesh dies. Failover lands on the freshly rejoined
+    sibling — whose join epoch matches the post-flap fault epoch, so
+    the resume proceeds from checkpoint — and the query completes
+    oracle-equal with the ownership map drained."""
+    from trino_tpu.parallel import mesh_chunk
+
+    runner = _fabric_case_runner("", mesh_chunk_rows, resume_attempts=0)
+    rm = runner._replica_manager()
+    expected = runner.execute(sql).rows
+    mesh_clean = runner._last_data_plane == "mesh"
+    rng = random.Random(seed)
+    epoch0 = rm.membership_epoch
+    state = {
+        "target": None, "fired": 0, "flapped": 0, "double_refused": -1,
+    }
+
+    def hook(k: int, K: int) -> None:
+        if state["target"] is None:
+            state["target"] = 1 + rng.randrange(max(K - 1, 1))
+        if k == state["target"] and not state["fired"]:
+            state["fired"] = 1
+            owners = dict(rm._owners)
+            if owners:
+                qid, (rid, _ep) = next(iter(owners.items()))
+                sib = rm.replicas[1 - rid]
+                state["double_refused"] = int(not rm.claim(qid, sib))
+            sib_id = 1 - (mesh_chunk.active_replica() or 0)
+            rm.leave(sib_id)
+            rm.join(sib_id)
+            state["flapped"] = 1
+            raise mesh_chunk.MeshDeviceLost(
+                f"chaos[membership_flap]: sub-mesh lost at chunk {k}/{K} "
+                f"with replica {sib_id} mid-flap"
+            )
+
+    mesh_chunk.MESH_FAULT_HOOK = hook
+    try:
+        rows = runner.execute(sql).rows
+    finally:
+        mesh_chunk.MESH_FAULT_HOOK = None
+    info = dict(mesh_chunk.LAST_RUN_INFO)
+    report = {
+        "mesh_clean_plane": mesh_clean,
+        "mesh_fault_plane": runner._last_data_plane,
+        "fault_chunk": state["target"],
+        "fired": state["fired"],
+        "flapped": state["flapped"],
+        "double_refused": state["double_refused"],
+        "epoch_delta": rm.membership_epoch - epoch0,
+        "joins": rm.joins,
+        "leaves": rm.leaves,
+        "epoch_fences": rm.epoch_fences,
+        "owners_at_end": len(rm._owners),
+        "chunks": info.get("chunks"),
+        "executed_chunk_steps": info.get("executed_chunk_steps"),
+        "resumes": info.get("resumes"),
+        "expected": expected,
+    }
+    return rows, report
+
+
+def run_transport_corruption_case(
+    sql: str, seed: int, mesh_chunk_rows: int = 256,
+) -> Tuple[List[list], dict]:
+    """Transport corruption on the failover pull: the peer serves a
+    BIT-FLIPPED payload under the original digest (in-flight
+    corruption). The digest check must reject it (fabric.digest_rejects
+    grows, fabric.pulls does not), try_pull returns False, and the
+    failover degrades to a CLEAN restart on the sibling — oracle-equal
+    rows, never a resume from corrupt carries. A truncated payload with
+    a matching digest is also pushed at the receive side and must come
+    back `imported: False` (undecodable bytes never poison a store)."""
+    import os
+
+    from trino_tpu.parallel import mesh_chunk
+    from trino_tpu.recovery.checkpoint import (
+        CHECKPOINTS,
+        MeshCheckpointStore,
+    )
+    from trino_tpu.runtime.fabric import (
+        HostFabric,
+        active_fabric,
+        checkpoint_digest,
+        encode_key,
+        stop_fabric,
+    )
+    from trino_tpu.runtime.http import FabricServer
+    from trino_tpu.runtime.metrics import METRICS
+
+    class _CorruptingFabric(HostFabric):
+        def serve_checkpoint(self, ekey):
+            out = HostFabric.serve_checkpoint(self, ekey)
+            if out is None:
+                return None
+            data, digest = out
+            bad = bytearray(data)
+            bad[len(bad) // 2] ^= 0xFF
+            return bytes(bad), digest  # digest of the ORIGINAL bytes
+
+    secret = os.environ.setdefault(
+        "TRINO_TPU_INTERNAL_SECRET", "chaos-fabric"
+    )
+    peer_store = MeshCheckpointStore()
+    peer = _CorruptingFabric(store=peer_store, host_id="chaos-corrupt")
+    srv = FabricServer(peer, internal_secret=secret)
+    stop_fabric()
+    runner = _fabric_case_runner(srv.uri, mesh_chunk_rows)
+    try:
+        expected = runner.execute(sql).rows
+        mesh_clean = runner._last_data_plane == "mesh"
+        rng = random.Random(seed)
+        state = {"target": None, "fired": 0, "truncated_import": None}
+
+        def hook(k: int, K: int) -> None:
+            if state["target"] is None:
+                state["target"] = 1 + rng.randrange(max(K - 1, 1))
+            if k == state["target"] and not state["fired"]:
+                state["fired"] = 1
+                fab = active_fabric()
+                if fab is not None:
+                    fab.pusher.flush(10.0)
+                # receive-side truncation probe while the peer holds a
+                # live entry: decodes to garbage -> imported False
+                for key in list(peer_store._entries):
+                    data = peer_store.export_bytes(key)
+                    if data is None:
+                        continue
+                    cut = data[: len(data) // 2]
+                    r = peer.receive_checkpoint(
+                        encode_key(key), cut, checkpoint_digest(cut)
+                    )
+                    state["truncated_import"] = r.get("imported")
+                    break
+                CHECKPOINTS.clear()
+                raise mesh_chunk.MeshDeviceLost(
+                    f"chaos[transport_corruption]: host lost at "
+                    f"chunk {k}/{K}; peer payloads corrupt"
+                )
+
+        before = METRICS.snapshot()
+        mesh_chunk.MESH_FAULT_HOOK = hook
+        try:
+            rows = runner.execute(sql).rows
+        finally:
+            mesh_chunk.MESH_FAULT_HOOK = None
+        after = METRICS.snapshot()
+        info = dict(mesh_chunk.LAST_RUN_INFO)
+        report = {
+            "mesh_clean_plane": mesh_clean,
+            "mesh_fault_plane": runner._last_data_plane,
+            "fault_chunk": state["target"],
+            "fired": state["fired"],
+            "truncated_import": state["truncated_import"],
+            "chunks": info.get("chunks"),
+            "executed_chunk_steps": info.get("executed_chunk_steps"),
+            "resumes": info.get("resumes"),
+            "digest_rejects": int(
+                after.get("fabric.digest_rejects", 0)
+                - before.get("fabric.digest_rejects", 0)
+            ),
+            "pulls": int(
+                after.get("fabric.pulls", 0) - before.get("fabric.pulls", 0)
+            ),
+            "expected": expected,
+        }
+        return rows, report
+    finally:
+        stop_fabric()
+        srv.stop()
 
 
 class DownableWorker:
@@ -1855,5 +2162,140 @@ def chaos_smoke(
                     f"park_chunk={rep['park_chunk']}/{rep['chunks']} "
                     f"failovers={rep['failovers']} "
                     f"resumes={rep['checkpoint_resumes']} re_executed=0"
+                )
+    # multi-host fabric scenarios (PR 19): checkpoint transport +
+    # membership under adversity. Same >= 2 device gate (replicated
+    # sub-meshes) as above — reached only past the earlier early-return.
+    fabric_sql = recovery_sql
+    for scenario in FABRIC_CLASSES:
+        case = {
+            "host_lost_mid_chunk": run_host_lost_case,
+            "membership_flap": run_membership_flap_case,
+            "transport_corruption": run_transport_corruption_case,
+        }[scenario]
+        try:
+            rows, rep = case(fabric_sql, seed)
+        except Exception as e:
+            failures.append(
+                f"fabric/{scenario}: raised {type(e).__name__}: {e}"
+            )
+            continue
+        if not rep["mesh_clean_plane"]:
+            failures.append(
+                f"fabric/{scenario}: clean run did not take the mesh plane"
+            )
+            continue
+        if not rows_equal(rows, rep["expected"], ordered=True):
+            failures.append(
+                f"fabric/{scenario}: rows diverged from clean run "
+                f"({len(rows)} vs {len(rep['expected'])})"
+            )
+        if not rep["fired"]:
+            failures.append(
+                f"fabric/{scenario}: fault never fired ({rep})"
+            )
+            continue
+        K = rep["chunks"] or 0
+        steps = rep["executed_chunk_steps"] or 0
+        if scenario == "host_lost_mid_chunk":
+            if not rep["pushes"]:
+                failures.append(
+                    f"fabric/{scenario}: nothing was ever pushed to the "
+                    f"peer ({rep})"
+                )
+            elif not rep["pulls"]:
+                failures.append(
+                    f"fabric/{scenario}: local store wiped but failover "
+                    f"never pulled from the peer ({rep})"
+                )
+            elif not rep["resumes"]:
+                failures.append(
+                    f"fabric/{scenario}: pulled a checkpoint but never "
+                    f"resumed from it ({rep})"
+                )
+            elif steps != K - (rep["fault_chunk"] or 0):
+                # the failover re-place runs a fresh attempt whose step
+                # counter starts at the resume point: exactly the
+                # not-yet-executed chunks remain
+                failures.append(
+                    f"fabric/{scenario}: re-executed "
+                    f"{steps - (K - (rep['fault_chunk'] or 0))} chunk-steps "
+                    f"after the fabric pull ({steps} steps for "
+                    f"{K - (rep['fault_chunk'] or 0)} remaining chunks)"
+                )
+            if verbose and not any(
+                f.startswith(f"fabric/{scenario}") for f in failures
+            ):
+                print(
+                    f"  chaos fabric/{scenario}: ok rows={len(rows)} "
+                    f"fault_chunk={rep['fault_chunk']}/{K} "
+                    f"pushes={rep['pushes']} pulls={rep['pulls']} "
+                    f"resumed_from={rep['resumed_from_chunk']} "
+                    f"re_executed=0"
+                )
+        elif scenario == "membership_flap":
+            if not rep["flapped"]:
+                failures.append(
+                    f"fabric/{scenario}: the flap never happened ({rep})"
+                )
+            elif rep["double_refused"] != 1:
+                failures.append(
+                    f"fabric/{scenario}: a second claim on an owned "
+                    f"query was NOT refused — double placement across "
+                    f"epochs ({rep})"
+                )
+            elif rep["epoch_delta"] < 2:
+                failures.append(
+                    f"fabric/{scenario}: membership epoch did not "
+                    f"advance across the flap ({rep})"
+                )
+            elif rep["owners_at_end"] != 0:
+                failures.append(
+                    f"fabric/{scenario}: {rep['owners_at_end']} ownership "
+                    f"claims leaked past query completion"
+                )
+            elif not rep["resumes"] and not rep["epoch_fences"]:
+                failures.append(
+                    f"fabric/{scenario}: neither a resume nor a typed "
+                    f"epoch-fence restart happened after the flap ({rep})"
+                )
+            if verbose and not any(
+                f.startswith(f"fabric/{scenario}") for f in failures
+            ):
+                print(
+                    f"  chaos fabric/{scenario}: ok rows={len(rows)} "
+                    f"fault_chunk={rep['fault_chunk']}/{K} "
+                    f"epoch_delta={rep['epoch_delta']} "
+                    f"double_refused=1 owners=0"
+                )
+        else:  # transport_corruption
+            if not rep["digest_rejects"]:
+                failures.append(
+                    f"fabric/{scenario}: corrupted payload was never "
+                    f"digest-rejected ({rep})"
+                )
+            elif rep["pulls"]:
+                failures.append(
+                    f"fabric/{scenario}: a corrupted payload was "
+                    f"IMPORTED ({rep['pulls']} pulls landed)"
+                )
+            elif rep["truncated_import"] is not False:
+                failures.append(
+                    f"fabric/{scenario}: truncated payload import was "
+                    f"not refused ({rep['truncated_import']!r})"
+                )
+            elif rep["resumes"]:
+                failures.append(
+                    f"fabric/{scenario}: resumed after a rejected "
+                    f"transfer — restart expected ({rep})"
+                )
+            if verbose and not any(
+                f.startswith(f"fabric/{scenario}") for f in failures
+            ):
+                print(
+                    f"  chaos fabric/{scenario}: ok rows={len(rows)} "
+                    f"fault_chunk={rep['fault_chunk']}/{K} "
+                    f"digest_rejects={rep['digest_rejects']} "
+                    f"pulls=0 clean_restart=True"
                 )
     return failures

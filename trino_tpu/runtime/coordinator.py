@@ -1128,6 +1128,18 @@ class DistributedQueryRunner:
 
         use_sched = bool(getattr(self.session, "mesh_scheduler", True))
         group = self._sched_group()
+        # multi-host fabric attach (no-op unless fabric_peers is set):
+        # checkpoints taken by this run stream asynchronously to peer
+        # coordinators, and failover below can pull the last pushed
+        # snapshot on demand. Attached before the single-mesh branch so
+        # a single-mesh coordinator pushes too.
+        from trino_tpu.runtime.fabric import (
+            MembershipEpochError,
+            active_fabric,
+            maybe_start_fabric,
+        )
+
+        maybe_start_fabric(self.session)
         rm = self._replica_manager()
         if rm is None:
             ex = MeshExecutor(self.catalogs, self.session)
@@ -1165,6 +1177,12 @@ class DistributedQueryRunner:
             getattr(self.session, "mesh_steal_enabled", True)
         )
         tried: set = set()
+        # membership-epoch fencing: a failover remembers the epoch it
+        # faulted under; a resume target whose join_epoch moved past it
+        # (the host left and rejoined — effectively a new host) is
+        # refused typed and the query restarts fresh instead
+        fault_key = None
+        fault_epoch = rm.membership_epoch
         while True:
             rep = rm.place(exclude=tried)
             if rep is None:
@@ -1172,6 +1190,26 @@ class DistributedQueryRunner:
                     "no schedulable replica "
                     f"(tried {sorted(tried)} of {rm.n_replicas})"
                 )
+            # exactly-one-owner: a query may never run on two replicas
+            # at once, even across a membership flap — the claim stays
+            # latched until the owning loop fully unwinds
+            if not rm.claim(query_id, rep):
+                rm.release(rep)
+                raise MeshDeviceLost(
+                    f"query {query_id!r} already owned by another "
+                    "replica; refusing double placement"
+                )
+            if fault_key is not None:
+                try:
+                    rm.require_epoch(rep, fault_epoch)
+                except MembershipEpochError:
+                    # typed refusal consumed here: drop the stale
+                    # checkpoint so the runner starts this replica's
+                    # attempt from chunk 0 (restart, not resume)
+                    from trino_tpu.recovery.checkpoint import CHECKPOINTS
+
+                    CHECKPOINTS.discard(fault_key)
+                    fault_key = None
             try:
                 ex = MeshExecutor(
                     self.catalogs, self.session,
@@ -1217,6 +1255,21 @@ class DistributedQueryRunner:
                 if not isinstance(e, MeshReplicaDraining):
                     rm.report_failure(rep)
                 tried.add(rep.replica_id)
+                fault_key = getattr(e, "ckpt_key", None)
+                fault_epoch = rm.membership_epoch
+                # host-loss failover: when the faulted replica's
+                # checkpoint is not in the local store (the whole host
+                # died), pull the last pushed snapshot from a fabric
+                # peer before resuming
+                from trino_tpu.recovery.checkpoint import CHECKPOINTS
+
+                fab = active_fabric()
+                if (
+                    fault_key is not None
+                    and fab is not None
+                    and CHECKPOINTS.get(fault_key) is None
+                ):
+                    fab.try_pull(fault_key)
                 have_sibling = any(
                     r.state == "active" and r.replica_id not in tried
                     for r in rm.replicas
@@ -1244,6 +1297,7 @@ class DistributedQueryRunner:
                     if rows is not None:
                         return rows
             finally:
+                rm.unclaim(query_id, rep)
                 rm.release(rep)
 
     def _try_steal_dispatch(self, subplan, preempt, query_span, key,
@@ -1480,6 +1534,16 @@ class DistributedQueryRunner:
             f"steals={self._sched_steals}"
         )
 
+    def _membership_line(self) -> str:
+        """The EXPLAIN ANALYZE membership line: epoch and join/leave/
+        fence counters of the replica plane's heartbeat-driven
+        membership (runtime/fabric.py MembershipDriver) — instance-
+        scoped like the replica line."""
+        rm = self._replicas
+        if rm is None:
+            return "membership= epoch=0 (single mesh)"
+        return rm.membership_line()
+
     def _explain_text(self, subplan) -> str:
         """Fragment rendering with per-fragment compile-churn census
         annotations (expected_xla_lowerings — sql/validate.py)."""
@@ -1530,6 +1594,7 @@ class DistributedQueryRunner:
             lines.append(self._skew_line())
             lines.append(self._replica_line())
             lines.append(self._scheduler_line())
+            lines.append(self._membership_line())
             return MaterializedResult(
                 [["\n".join(lines)]], ["Query Plan"], [T.VARCHAR]
             )

@@ -124,6 +124,11 @@ class NodeManager:
         self._breaker_cooldown_s = breaker_cooldown_s
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # state-transition listeners fired from the heartbeat loop:
+        # fn(worker_id, old_state, new_state). The membership bridge
+        # (runtime/fabric.py MembershipDriver) drives replica
+        # join/leave through these.
+        self._state_listeners: List[Callable[[str, str, str], None]] = []
 
     def register(self, handle) -> None:
         with self._lock:
@@ -227,10 +232,28 @@ class NodeManager:
         while not self._stop.wait(self._interval):
             self.ping_once()
 
+    def add_state_listener(
+        self, fn: Callable[[str, str, str], None]
+    ) -> None:
+        """Register fn(worker_id, old_state, new_state), fired from the
+        heartbeat loop on every node state transition. A listener error
+        never stalls the ping loop."""
+        self._state_listeners.append(fn)
+
+    def _notify_state(self, worker_id: str, old: str, new: str) -> None:
+        if old == new:
+            return
+        for fn in self._state_listeners:
+            try:
+                fn(worker_id, old, new)
+            except Exception:
+                pass  # membership bridges must not break failure detection
+
     def ping_once(self) -> None:
         with self._lock:
             nodes = list(self._nodes.values())
         for n in nodes:
+            before = n.state
             n.breaker.mark_probing()
             try:
                 status = n.handle.status()
@@ -254,3 +277,4 @@ class NodeManager:
                 n.breaker.record_failure()
                 if n.failure_rate >= self.FAIL_THRESHOLD:
                     n.state = "failed"
+            self._notify_state(n.handle.worker_id, before, n.state)

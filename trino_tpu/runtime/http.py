@@ -426,6 +426,219 @@ class HttpWorkerClient:
         ).close()
 
 
+def frame_fabric_body(ekey: str, payload: bytes) -> bytes:
+    """Length-prefix framing for fabric POST bodies. The encoded mesh
+    record key is a pickled program identity and routinely exceeds the
+    64 KiB request-line limit of http.server, so it rides in the BODY
+    (never the URI or a header): 8-byte big-endian key length, the
+    ascii key, then the checkpoint payload."""
+    kb = ekey.encode("ascii")
+    return struct.pack(">Q", len(kb)) + kb + payload
+
+
+def unframe_fabric_body(body: bytes) -> Tuple[str, bytes]:
+    if len(body) < 8:
+        raise ValueError("fabric body too short for key frame")
+    (klen,) = struct.unpack(">Q", body[:8])
+    if klen > len(body) - 8:
+        raise ValueError("fabric body key frame overruns body")
+    return body[8 : 8 + klen].decode("ascii"), body[8 + klen :]
+
+
+class _FabricHandler(_Handler):
+    """Routes of the coordinator-to-coordinator checkpoint fabric
+    (runtime/fabric.py HostFabric behind them):
+
+      POST /v1/fabric/checkpoint        receive pushed bytes; framed
+                                        body (key + payload), the
+                                        X-Fabric-Digest header covers
+                                        the payload and is verified
+                                        before import_bytes
+      POST /v1/fabric/checkpoint/pull   body is the encoded key; serve
+                                        bytes + digest (404 when
+                                        absent/stale)
+      GET  /v1/fabric/status            endpoint state JSON
+
+    Inherits _Handler's responders and the internal-auth gate; the
+    worker task routes 404 here (no worker is bound)."""
+
+    fabric = None  # set by server factory
+
+    def do_GET(self):
+        if not self._authorized():
+            return
+        parts = [p for p in self.path.split("?")[0].split("/") if p]
+        try:
+            if parts == ["v1", "fabric", "status"]:
+                self._json(200, self.fabric.status())
+                return
+            self._json(404, {"error": f"no route {self.path}"})
+        except Exception as e:
+            self._json(500, {"error": repr(e)})
+
+    def do_POST(self):
+        if not self._authorized():
+            return
+        parts = [p for p in self.path.split("/") if p]
+        try:
+            ln = int(self.headers.get("Content-Length", "0") or 0)
+            body = self.rfile.read(ln)
+            if parts == ["v1", "fabric", "checkpoint"]:
+                ekey, data = unframe_fabric_body(body)
+                digest = self.headers.get(FabricClient.HEADER_DIGEST, "")
+                self._json(
+                    200, self.fabric.receive_checkpoint(ekey, data, digest)
+                )
+                return
+            if parts == ["v1", "fabric", "checkpoint", "pull"]:
+                out = self.fabric.serve_checkpoint(body.decode("ascii"))
+                if out is None:
+                    self._json(404, {"error": "no checkpoint"})
+                    return
+                data, digest = out
+                self._bytes(
+                    200, data, [(FabricClient.HEADER_DIGEST, digest)]
+                )
+                return
+            self._json(404, {"error": f"no route {self.path}"})
+        except Exception as e:
+            self._json(500, {"error": repr(e)})
+
+
+class FabricServer:
+    """HTTP front of one HostFabric — a coordinator's checkpoint-
+    transport endpoint. Same auth posture as WorkerServer: a fabric
+    port without a secret accepts (and serves) checkpoint bytes from
+    anyone who can reach it, so a networked fabric refuses to start
+    without one; require_secret=False is for single-process tests."""
+
+    def __init__(self, fabric, port: int = 0,
+                 internal_secret: Optional[str] = "__env__",
+                 require_secret: bool = True):
+        self.fabric = fabric
+        self.internal_auth = None
+        if internal_secret == "__env__":
+            internal_secret = default_internal_secret()
+        if internal_secret is None and require_secret:
+            raise RuntimeError(
+                "refusing to start a networked fabric endpoint without an "
+                "internal secret: set TRINO_TPU_INTERNAL_SECRET (or pass "
+                "internal_secret=...), or pass require_secret=False for "
+                "single-process embedding"
+            )
+        if internal_secret is not None:
+            from trino_tpu.security import InternalAuthenticator
+
+            self.internal_auth = InternalAuthenticator(internal_secret)
+        handler = type(
+            "BoundFabricHandler", (_FabricHandler,),
+            {"fabric": fabric, "server_ref": self},
+        )
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self.port = self._httpd.server_port
+        self.uri = f"http://127.0.0.1:{self.port}"
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class FabricClient:
+    """Peer-coordinator side of the checkpoint fabric: push/pull
+    MeshCheckpoint bytes with content digests, every call inside the
+    RequestErrorTracker backoff/budget loop (same discipline as
+    HttpWorkerClient — a spent budget raises RequestFailedError and
+    the fabric degrades to pull-on-demand or a cold restart, never a
+    blocked chunk loop)."""
+
+    HEADER_DIGEST = "X-Fabric-Digest"
+
+    def __init__(self, uri: str, timeout: float = 10.0,
+                 internal_secret: Optional[str] = "__env__",
+                 retry_policy=None, failure_listener=None):
+        self.uri = uri.rstrip("/")
+        self.timeout = timeout
+        self.retry_policy = retry_policy
+        self.failure_listener = failure_listener
+        self._auth = None
+        if internal_secret == "__env__":
+            internal_secret = default_internal_secret()
+        if internal_secret is not None:
+            from trino_tpu.security import InternalAuthenticator
+
+            self._auth = InternalAuthenticator(internal_secret)
+
+    def _req(self, method: str, path: str, body: Optional[bytes] = None,
+             headers: Optional[dict] = None):
+        hdrs = dict(headers or {})
+        if self._auth is not None:
+            hdrs[self._auth.HEADER] = self._auth.token()
+        req = urllib.request.Request(
+            self.uri + path, data=body, method=method, headers=hdrs
+        )
+        return urllib.request.urlopen(req, timeout=self.timeout)
+
+    def _retrying(self, fn):
+        from trino_tpu.runtime.error_tracker import (
+            RetryPolicy,
+            run_with_retry,
+        )
+
+        return run_with_retry(
+            self.uri, fn, policy=self.retry_policy or RetryPolicy(),
+            listener=self.failure_listener,
+        )
+
+    def push_checkpoint(self, key: tuple, data: bytes,
+                        digest: Optional[str] = None) -> dict:
+        from trino_tpu.runtime.fabric import checkpoint_digest, encode_key
+
+        digest = digest or checkpoint_digest(data)
+        body = frame_fabric_body(encode_key(key), data)
+
+        def go():
+            with self._req(
+                "POST", "/v1/fabric/checkpoint", body=body,
+                headers={self.HEADER_DIGEST: digest},
+            ) as r:
+                return json.loads(r.read())
+
+        return self._retrying(go)
+
+    def pull_checkpoint(
+        self, key: tuple
+    ) -> Tuple[Optional[bytes], Optional[str]]:
+        """(bytes, digest) of the peer's live entry, or (None, None)
+        when the peer has no (non-stale) checkpoint under the key."""
+        from trino_tpu.runtime.fabric import encode_key
+
+        body = encode_key(key).encode("ascii")
+
+        def go():
+            try:
+                with self._req(
+                    "POST", "/v1/fabric/checkpoint/pull", body=body
+                ) as r:
+                    return r.read(), r.headers.get(self.HEADER_DIGEST)
+            except urllib.error.HTTPError as e:
+                if e.code == 404:
+                    return None, None  # absent is an answer, not an error
+                raise
+
+        return self._retrying(go)
+
+    def status(self) -> dict:
+        def go():
+            with self._req("GET", "/v1/fabric/status") as r:
+                return json.loads(r.read())
+
+        return self._retrying(go)
+
+
 def http_fetch(uri: str, task_id: str, retry_policy=None):
     """Location descriptor -> fetch callable for TaskSpec.input_locations
     (the HttpPageBufferClient pull side). Worker-to-worker page pulls

@@ -119,8 +119,14 @@ class Replica:
         )
         # active -> shutting_down (drain requested: no new placements,
         # in-flight chunk loops fail over at the next boundary) ->
-        # drained (nothing in flight; decommissionable)
+        # drained (nothing in flight; decommissionable). "left" is the
+        # heartbeat tier's verdict (host lost / flapped — see
+        # ReplicaManager.leave): out of the placement pool like a
+        # drain, but recoverable through join() under a new epoch.
         self.state = "active"
+        # membership epoch this replica (re)joined under; a rejoin
+        # after a flap moves it, which is what fences stale resumes
+        self.join_epoch = 0
         self.inflight = 0
         self.served = 0  # lifetime placements onto this replica
 
@@ -162,6 +168,18 @@ class ReplicaManager:
         self.failovers = 0
         self.drains = 0
         self.breaker_opens = 0
+        # -- live membership (runtime/fabric.py drives this) ----------
+        # monotonic: every join or leave advances it; resumes carry the
+        # epoch their checkpoint context was taken under and
+        # require_epoch fences the ones whose target moved on
+        self.membership_epoch = 1
+        self.joins = 0
+        self.leaves = 0
+        self.epoch_fences = 0
+        # exactly-one-owner ledger: query_id -> (replica_id, epoch) of
+        # the single replica allowed to run it right now — a flapped
+        # host must never end up racing the sibling that took over
+        self._owners: Dict[str, tuple] = {}
         self.replicas = [
             Replica(
                 r, list(self.grid[r]),
@@ -173,7 +191,12 @@ class ReplicaManager:
             )
             for r in range(n_replicas)
         ]
+        for rep in self.replicas:
+            rep.join_epoch = self.membership_epoch
         register_replica_metrics()
+        from trino_tpu.runtime.fabric import register_fabric_metrics
+
+        register_fabric_metrics()
         from trino_tpu.runtime.metrics import METRICS
 
         for rep in self.replicas:
@@ -294,6 +317,126 @@ class ReplicaManager:
         rep = self.replicas[replica_id]
         with self._lock:
             rep.state = "active"
+
+    # -- live membership (heartbeat-driven; runtime/fabric.py) --------
+    def leave(self, replica_id: int) -> Replica:
+        """Heartbeat-driven departure: the replica leaves the placement
+        pool under a NEW membership epoch. The Replica object — breaker
+        state, lifetime counters — survives, so a flap (leave + rejoin)
+        never resets health history. In-flight chunk loops on it fail
+        over through the same drain_check boundary hook a drain uses
+        (state left the active set)."""
+        from trino_tpu.runtime.fabric import LEAVES
+        from trino_tpu.runtime.metrics import METRICS
+
+        rep = self.replicas[replica_id]
+        with self._lock:
+            if rep.state == "left":
+                return rep  # already out: don't double-advance the epoch
+            rep.state = "left"
+            self.membership_epoch += 1
+            self.leaves += 1
+        METRICS.increment(LEAVES)
+        return rep
+
+    def join(self, replica_id: int, warm=None) -> Replica:
+        """(Re)admit a replica under a new membership epoch. `warm`
+        runs BEFORE the replica enters the placement pool (the
+        joining-host warmup replay of runtime/fabric.py: its first
+        placed query must mint zero new lowerings); a warm failure
+        still joins — warmup delays availability, never gates it."""
+        from trino_tpu.runtime.fabric import JOINS
+        from trino_tpu.runtime.metrics import METRICS
+
+        rep = self.replicas[replica_id]
+        if rep.state == "active":
+            return rep
+        if warm is not None:
+            try:
+                warm()
+            except Exception:
+                pass
+        with self._lock:
+            self.membership_epoch += 1
+            rep.state = "active"
+            rep.join_epoch = self.membership_epoch
+            self.joins += 1
+        METRICS.increment(JOINS)
+        return rep
+
+    # -- ownership ledger (exactly one owner per in-flight query) -----
+    def claim(self, query_id: str, replica: Replica) -> bool:
+        """Record `replica` as the single owner of `query_id` under the
+        current epoch. Refused while ANOTHER replica's claim is live —
+        even if that replica has since left (its chunk loop may still
+        be unwinding), so a membership flap can never double-place a
+        query across epochs. Re-claim by the same replica is a no-op
+        refresh."""
+        if not query_id:
+            return True  # anonymous dispatch: nothing to fence
+        with self._lock:
+            cur = self._owners.get(query_id)
+            if cur is not None and cur[0] != replica.replica_id:
+                return False
+            self._owners[query_id] = (
+                replica.replica_id, self.membership_epoch
+            )
+            return True
+
+    def unclaim(self, query_id: str, replica: Replica) -> None:
+        if not query_id:
+            return
+        with self._lock:
+            cur = self._owners.get(query_id)
+            if cur is not None and cur[0] == replica.replica_id:
+                del self._owners[query_id]
+
+    def owner_of(self, query_id: str):
+        """(replica_id, epoch) of the live claim, or None."""
+        with self._lock:
+            return self._owners.get(query_id)
+
+    def require_epoch(self, replica: Replica, expected_epoch: int) -> None:
+        """Fence a resume: refuse (typed MembershipEpochError) when the
+        target replica's epoch moved past the one the resume context
+        was taken under, or it is no longer active — it left and
+        rejoined in between, so carrying the old resume would hand
+        stale state to what is effectively a new host. The caller
+        discards the checkpoint and restarts fresh."""
+        from trino_tpu.runtime.fabric import (
+            EPOCH_FENCES,
+            MembershipEpochError,
+        )
+        from trino_tpu.runtime.metrics import METRICS
+
+        with self._lock:
+            moved = (
+                replica.join_epoch > expected_epoch
+                or replica.state != "active"
+            )
+            if moved:
+                self.epoch_fences += 1
+        if moved:
+            METRICS.increment(EPOCH_FENCES)
+            raise MembershipEpochError(
+                f"replica {replica.replica_id} membership epoch moved "
+                f"({expected_epoch} -> {replica.join_epoch}, "
+                f"state={replica.state}): resume refused, restart fresh",
+                replica_id=replica.replica_id,
+                expected_epoch=expected_epoch,
+                actual_epoch=replica.join_epoch,
+            )
+
+    def membership_line(self) -> str:
+        """The EXPLAIN ANALYZE membership line (instance-scoped, like
+        stats_line, so corpus output stays deterministic)."""
+        with self._lock:
+            return (
+                f"membership= epoch={self.membership_epoch} "
+                f"joins={self.joins} leaves={self.leaves} "
+                f"epoch_fences={self.epoch_fences} "
+                f"owners={len(self._owners)}"
+            )
 
     def drain_check(self, replica: Replica):
         """The chunk-boundary hook a MeshExecutor carries: raises

@@ -169,6 +169,7 @@ class MeshScheduler:
         self.yields = 0
         self.park_refusals = 0
         self.submitted = 0
+        self.fast_submitted = 0  # fast-lane share of `submitted`
         self.fast_holds = 0
         register_scheduler_metrics()
 
@@ -190,6 +191,8 @@ class MeshScheduler:
             )
             self._waiting.append(job)
             self.submitted += 1
+            if job.fast:
+                self.fast_submitted += 1
             self._cond.notify_all()
             return job
 
@@ -298,6 +301,28 @@ class MeshScheduler:
         with self._lock:
             self.resumes += 1
         METRICS.increment(RESUMES)
+
+    def park_budget_for(self, job: MeshJob, total_bytes: int) -> int:
+        """Admission-weighted park budget: `total_bytes` (the
+        mesh_park_max_bytes pool) apportioned across the groups this
+        scheduler has seen by their scheduling weight — the park-store
+        analogue of the vtime share. A group over its share gets its
+        park refused (the chunk loop degrades to an in-place yield via
+        the latched no_park, never to failure). A single-group
+        scheduler keeps the whole pool; an unbounded pool (< 0) passes
+        through."""
+        if total_bytes < 0:
+            return int(total_bytes)
+        with self._lock:
+            groups = set(self._vtime) | set(self.weights) | {job.group}
+            if len(groups) <= 1:
+                return int(total_bytes)
+            wsum = sum(self.weights.get(g, 1.0) for g in groups)
+            share = (
+                self.weights.get(job.group, 1.0) / wsum
+                if wsum > 0 else 1.0
+            )
+        return int(total_bytes * share)
 
     def park_refused(self, job: MeshJob) -> None:
         """The park budget refused the snapshot: latch no_park so the
@@ -436,6 +461,7 @@ class MeshScheduler:
         with self._lock:
             return {
                 "submitted": self.submitted,
+                "fast_submitted": self.fast_submitted,
                 "parks": self.parks,
                 "resumes": self.resumes,
                 "preemptions": self.preemptions,

@@ -276,6 +276,11 @@ class CoordinatorServer:
 
                     self._json(200, METRICS.snapshot())
                     return
+                if parts == ["v1", "fabric"]:
+                    from trino_tpu.runtime.fabric import fabric_status
+
+                    self._json(200, fabric_status())
+                    return
                 if parts == ["v1", "query"]:
                     self._json(200, outer.query_list(identity))
                     return

@@ -62,6 +62,25 @@ def build_tiny_runner(**session_kw):
     return r
 
 
+def _mesh_fast_submitted(runner) -> int:
+    """Sum of fast-lane submissions across the runner's mesh
+    schedulers (the single-mesh run queue plus any replica run
+    queues) — how the batched phase proves its combined point lookups
+    actually rode the MeshScheduler fast lane rather than the page
+    plane or a bare lock."""
+    total = 0
+    sched = getattr(runner, "_mesh_scheduler", None)
+    if sched is not None:
+        total += int(getattr(sched, "fast_submitted", 0))
+    rm = getattr(runner, "_replicas", None)
+    if rm is not None:
+        total += sum(
+            int(getattr(r.scheduler, "fast_submitted", 0))
+            for r in rm.replicas
+        )
+    return total
+
+
 def _weighted_schedule(
     rng: random.Random,
     names: List[str],
@@ -254,6 +273,7 @@ def run_serve_load(
         b_mismatch = [0]
         b_done = [0]
         b_errors: List[str] = []
+        fast0 = _mesh_fast_submitted(runner)
         stop_at = time.perf_counter() + batch_phase_s
 
         def burst_loop(i: int):
@@ -286,6 +306,11 @@ def run_serve_load(
             "mismatches": b_mismatch[0],
             "errors": b_errors[:5],
             "error_count": len(b_errors),
+            # combined IN-list lookups classify as fast lane
+            # (serving/admission.py is_point_lookup handles InList), so
+            # on a mesh-scheduled runner every batch leader's execute
+            # lands as a fast submission on some sub-mesh's run queue
+            "mesh_fast_lane": _mesh_fast_submitted(runner) - fast0,
             **batcher.stats(),
         }
     return report
