@@ -128,6 +128,14 @@ Flags:
                      beating its own warm full-length wall;
                      re-execs itself with an 8-device host platform,
                      so no device needed
+  --analyze          run the static concurrency analyzer
+                     (trino_tpu/analysis/) over the whole package:
+                     lock-order cycle detection on the may-hold-while-
+                     acquiring graph, guarded_by annotation checking,
+                     unlocked-global-write lint, and the unregistered-
+                     thread-spawn lint; prints a JSON summary plus one
+                     ANALYZE-VIOLATION line per finding at file:line;
+                     exits non-zero on any finding; no device needed
 """
 
 from __future__ import annotations
@@ -3177,6 +3185,26 @@ def _validate_corpus(argv) -> int:
     return 1 if failures else 0
 
 
+def _analyze(argv) -> int:
+    """--analyze: CI gate for the concurrency soundness plane
+    (trino_tpu/analysis/). Statically scans every module in the package
+    for lock-order cycles, guarded_by violations, unlocked writes to
+    module-level mutable globals, condition-waits while holding another
+    lock, non-reentrant re-entry, and thread spawns that bypass the
+    registry. Exit 1 on any finding."""
+    from trino_tpu.analysis import analyze_package
+
+    t0 = time.time()
+    rep = analyze_package()
+    for f in rep.findings:
+        print(f"bench: ANALYZE-VIOLATION [{f.kind}] {f.file}:{f.line}: "
+              f"{f.message}", file=sys.stderr)
+    summary = rep.summary()
+    summary["wall_s"] = round(time.time() - t0, 2)
+    print(json.dumps({"analyze": summary}))
+    return 0 if rep.ok else 1
+
+
 def main() -> None:
     if "--serve-smoke" in sys.argv:
         sys.exit(_serve_smoke(sys.argv))
@@ -3206,6 +3234,8 @@ def main() -> None:
         sys.exit(_preempt_smoke(sys.argv))
     if "--validate-corpus" in sys.argv:
         sys.exit(_validate_corpus(sys.argv))
+    if "--analyze" in sys.argv:
+        sys.exit(_analyze(sys.argv))
     if os.environ.get("BENCH_INNER") == "1":
         import jax
 

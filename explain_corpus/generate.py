@@ -413,6 +413,9 @@ def corpus_07_distributed_analyze():
         text = re.sub(r"resident= .*", "resident= #", text)
         text = re.sub(r"recovery= .*", "recovery= #", text)
         text = re.sub(r"skew= .*", "skew= #", text)
+        # process-global witness registry: lock/thread counts depend
+        # on what ran before — corpus 16 pins the analyzer itself
+        text = re.sub(r"concurrency= .*", "concurrency= #", text)
         return text
 
     emit(
@@ -464,6 +467,9 @@ def corpus_08_mesh_analyze():
         text = re.sub(r"resident= .*", "resident= #", text)
         text = re.sub(r"recovery= .*", "recovery= #", text)
         text = re.sub(r"skew= .*", "skew= #", text)
+        # process-global witness registry: lock/thread counts depend
+        # on what ran before — corpus 16 pins the analyzer itself
+        text = re.sub(r"concurrency= .*", "concurrency= #", text)
         return text
 
     emit(
@@ -561,6 +567,9 @@ def corpus_09_resident_analyze():
         text = re.sub(r"pinned_bytes=\d+", "pinned_bytes=#", text)
         text = re.sub(r"recovery= .*", "recovery= #", text)
         text = re.sub(r"skew= .*", "skew= #", text)
+        # process-global witness registry: lock/thread counts depend
+        # on what ran before — corpus 16 pins the analyzer itself
+        text = re.sub(r"concurrency= .*", "concurrency= #", text)
         return text
 
     emit(
@@ -633,6 +642,9 @@ def corpus_10_adaptive_analyze():
         text = re.sub(r"resident= .*", "resident= #", text)
         text = re.sub(r"recovery= .*", "recovery= #", text)
         text = re.sub(r"skew= .*", "skew= #", text)
+        # process-global witness registry: lock/thread counts depend
+        # on what ran before — corpus 16 pins the analyzer itself
+        text = re.sub(r"concurrency= .*", "concurrency= #", text)
         text = re.sub(r"spool=[0-9a-f]+", "spool=#", text)
         return text
 
@@ -729,6 +741,9 @@ def corpus_11_recovery_analyze():
         text = re.sub(r"replicas= .*", "replicas= #", text)
         text = re.sub(r"resident= .*", "resident= #", text)
         text = re.sub(r"skew= .*", "skew= #", text)
+        # process-global witness registry: lock/thread counts depend
+        # on what ran before — corpus 16 pins the analyzer itself
+        text = re.sub(r"concurrency= .*", "concurrency= #", text)
         return text
 
     emit(
@@ -836,6 +851,9 @@ def corpus_12_skew_analyze():
         text = re.sub(r"resident= .*", "resident= #", text)
         text = re.sub(r"recovery= .*", "recovery= #", text)
         text = re.sub(r"spool=[0-9a-f]+", "spool=#", text)
+        # process-global witness registry: lock/thread counts depend
+        # on what ran before — corpus 16 pins the analyzer itself
+        text = re.sub(r"concurrency= .*", "concurrency= #", text)
         return text
 
     emit(
@@ -935,6 +953,9 @@ def corpus_13_replica_analyze():
         text = re.sub(r"resident= .*", "resident= #", text)
         text = re.sub(r"recovery= .*", "recovery= #", text)
         text = re.sub(r"skew= .*", "skew= #", text)
+        # process-global witness registry: lock/thread counts depend
+        # on what ran before — corpus 16 pins the analyzer itself
+        text = re.sub(r"concurrency= .*", "concurrency= #", text)
         return text
 
     emit(
@@ -1053,6 +1074,9 @@ def corpus_14_scheduler_analyze():
         text = re.sub(r"resident= .*", "resident= #", text)
         text = re.sub(r"recovery= .*", "recovery= #", text)
         text = re.sub(r"skew= .*", "skew= #", text)
+        # process-global witness registry: lock/thread counts depend
+        # on what ran before — corpus 16 pins the analyzer itself
+        text = re.sub(r"concurrency= .*", "concurrency= #", text)
         return text
 
     emit(
@@ -1226,6 +1250,9 @@ def corpus_15_fabric_analyze():
         text = re.sub(r"resident= .*", "resident= #", text)
         text = re.sub(r"recovery= .*", "recovery= #", text)
         text = re.sub(r"skew= .*", "skew= #", text)
+        # process-global witness registry: lock/thread counts depend
+        # on what ran before — corpus 16 pins the analyzer itself
+        text = re.sub(r"concurrency= .*", "concurrency= #", text)
         return text
 
     emit(
@@ -1244,6 +1271,86 @@ def corpus_15_fabric_analyze():
          "membership=\nline reports the monotonic epoch and this "
          "runner's instance-scoped\njoin/leave/fence counters "
          "(wall-clock values redacted to `#`)", redact(out)),
+    )
+
+
+# deliberately-broken fixture modules for corpus 16: a two-lock order
+# cycle and a bare write to a guarded_by-annotated global. Analyzed
+# in-memory (never imported), so the file:line coordinates are stable.
+_CYCLE_FIXTURE = """\
+from trino_tpu.analysis.witness import named_lock
+
+_lock_a = named_lock("deadlock_fixture._lock_a")
+_lock_b = named_lock("deadlock_fixture._lock_b")
+
+
+def forward():
+    with _lock_a:
+        with _lock_b:
+            pass
+
+
+def backward():
+    with _lock_b:
+        with _lock_a:
+            pass
+"""
+
+_BARE_WRITE_FIXTURE = """\
+from trino_tpu.analysis.witness import named_lock
+
+_cache_lock = named_lock("bare_write_fixture._cache_lock")
+CACHE = {}  # guarded_by: _cache_lock
+
+
+def bad_write(key, value):
+    CACHE[key] = value
+"""
+
+
+def corpus_16_concurrency_analyze():
+    """The concurrency soundness plane (trino_tpu/analysis/): the pinned
+    output of the static lock-order / shared-state analyzer over the
+    whole package — the lock inventory, the may-hold-while-acquiring
+    order, and zero findings — plus the analyzer's findings on two
+    deliberately broken fixture modules, showing what a violation report
+    looks like (cycle with both witness paths; bare guarded write)."""
+    from trino_tpu.analysis import analyze_package, analyze_sources
+
+    rep = analyze_package()
+    s = rep.summary()
+    summary = "\n".join(f"{k}={v}" for k, v in s.items())
+    order = "\n".join(
+        f"{a} -> {b}" for a, b in sorted(rep.graph.edges)
+    ) or "(no lock is ever acquired while another is held)"
+
+    bad = analyze_sources({
+        "deadlock_fixture": (
+            "fixtures/deadlock_fixture.py", _CYCLE_FIXTURE),
+        "bare_write_fixture": (
+            "fixtures/bare_write_fixture.py", _BARE_WRITE_FIXTURE),
+    })
+    findings = "\n".join(
+        f"[{f.kind}] {f.file}:{f.line}\n  {f.message}"
+        for f in bad.findings
+    )
+
+    emit(
+        "16_concurrency_analyze.txt",
+        ("QUERY\nbench.py --analyze  (trino_tpu/analysis/ static passes)",
+         ""),
+        ("whole-package summary (the CI gate's JSON, one key per line; "
+         "a diff\nhere means the engine's locking structure actually "
+         "changed)", summary),
+        ("the may-hold-while-acquiring order — every (held, acquired) "
+         "pair the\nstatic pass can prove, including through call "
+         "edges; the runtime\nwitness seeds its partial order from "
+         "these", order),
+        ("analyzer findings on two deliberately broken fixture modules "
+         "(the\nsame fixtures tests/test_concurrency_analysis.py "
+         "asserts on): a\ntwo-lock acquisition cycle reported with "
+         "both witness paths, and a\nbare write to a guarded_by-"
+         "annotated global", findings),
     )
 
 
@@ -1268,6 +1375,7 @@ def write_all(out_dir=None):
         corpus_13_replica_analyze()
         corpus_14_scheduler_analyze()
         corpus_15_fabric_analyze()
+        corpus_16_concurrency_analyze()
     finally:
         _OUT_DIR[0] = HERE
 

@@ -140,6 +140,54 @@ def tpch_cluster_mesh_off():
 
 
 @pytest.fixture(autouse=True, scope="module")
+def _concurrency_sanitizer(request):
+    """Thread-leak and held-lock sanitizer: after each module, every
+    registered background thread must have exited (or be daemon) and no
+    witness lock may still be held. Session-scoped servers (statement
+    server, proxy, worker HTTP) are daemon threads, so they pass; a test
+    that forgets to stop a non-daemon worker fails its module here with
+    the thread's registered name and owner."""
+    yield
+    import time as _time
+
+    from trino_tpu.analysis import threadreg, witness
+
+    _t0 = _time.monotonic()
+    leaks = threadreg.THREADS.non_daemon_leaks()
+    if leaks:
+        # grace for threads mid-exit (target returned, join pending)
+        deadline = _time.monotonic() + 2.0
+        while leaks and _time.monotonic() < deadline:
+            _time.sleep(0.02)
+            leaks = threadreg.THREADS.non_daemon_leaks()
+    assert not leaks, (
+        "non-daemon threads leaked by this module: " + ", ".join(leaks)
+    )
+
+    held = witness.held_locks()
+    if held:
+        # a background daemon may transiently hold a lock; retry briefly
+        deadline = _time.monotonic() + 1.0
+        while held and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+            held = witness.held_locks()
+    assert not held, f"locks still held after module: {held}"
+    assert witness.violation_count() == 0, (
+        f"{witness.violation_count()} lock-witness violations recorded "
+        "(a LockOrderError was raised and swallowed somewhere)"
+    )
+    dbg = os.environ.get("TRINO_TPU_SANITIZER_DEBUG")
+    if dbg:
+        with open(dbg, "a") as fh:
+            fh.write(
+                "[sanitizer] %s teardown=%.3fs locks=%d threads=%d t=%.1f\n"
+                % (request.module.__name__, _time.monotonic() - _t0,
+                   witness.lock_count(), threadreg.THREADS.spawned_total,
+                   _time.monotonic())
+            )
+
+
+@pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_between_modules():
     """The full suite compiles 1000+ XLA programs in one process; this
     environment's XLA CPU compiler segfaults under that accumulated

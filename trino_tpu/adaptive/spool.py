@@ -21,6 +21,7 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import threading
+from trino_tpu.analysis.witness import named_condition, named_lock, named_rlock
 from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -145,7 +146,7 @@ class SubtreeSpool:
     the same query until a table it read is written."""
 
     def __init__(self, max_entries: int = 64):
-        self._lock = threading.Lock()
+        self._lock = named_lock("SubtreeSpool._lock")
         self._entries: "OrderedDict[str, SpoolEntry]" = OrderedDict()
         self._max = max_entries
         self.stores = 0

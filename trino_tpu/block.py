@@ -47,7 +47,7 @@ def ones_mask(n: int) -> jnp.ndarray:
         return a
     a = jnp.ones(n, dtype=jnp.bool_)
     if isinstance(n, int) and not isinstance(a, jax.core.Tracer):
-        _ONES_CACHE[n] = a
+        _ONES_CACHE[n] = a  # unlocked-ok: GIL-atomic setitem of an idempotent value
     return a
 
 

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import os
 import threading
+from trino_tpu.analysis.witness import named_condition, named_lock, named_rlock
 from collections import OrderedDict
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -52,9 +53,9 @@ class ProgramCache:
 
     def __init__(self, max_entries: int = 1024):
         self._max_entries = max_entries
-        self._lock = threading.Lock()
-        self._entries: "OrderedDict[Any, Any]" = OrderedDict()
-        self._keys_by_id: Dict[int, Any] = {}
+        self._lock = named_lock("ProgramCache._lock")
+        self._entries: "OrderedDict[Any, Any]" = OrderedDict()  # guarded_by: _lock
+        self._keys_by_id: Dict[int, Any] = {}  # guarded_by: _lock
         self.hits = 0
         self.misses = 0
         self.evictions = 0

@@ -36,13 +36,15 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+from trino_tpu.analysis import threadreg
+from trino_tpu.analysis.witness import named_condition, named_lock, named_rlock
 from typing import Iterable, Optional, Sequence, Set, Tuple
 
 # (operator, capacity, dtype-sig) classes proven compiled in this
 # process — the same vocabulary as the shape ledger (exec/stats.py)
 # and the census (sql/validate.py Lowering).
 WARM_CLASSES: Set[Tuple] = set()
-_warm_lock = threading.Lock()
+_warm_lock = named_lock("warmup._warm_lock")
 
 
 def note_classes_warm(keys: Iterable[Tuple]) -> None:
@@ -148,10 +150,9 @@ class WarmupService:
         if self.mode == "off" or not self.entries:
             self._done.set()
             return self
-        self._thread = threading.Thread(
-            target=self._run, name="trino-tpu-warmup", daemon=True
+        self._thread = threadreg.spawn(
+            "trino-tpu-warmup", self._run, owner="WarmupService"
         )
-        self._thread.start()
         return self
 
     def wait(self, timeout: Optional[float] = None) -> bool:

@@ -27,6 +27,7 @@ import datetime
 import json
 import os
 import threading
+from trino_tpu.analysis.witness import named_condition, named_lock, named_rlock
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
@@ -257,7 +258,7 @@ class _FileStore:
 
     def __init__(self, root: str):
         self.root = root
-        self.lock = threading.Lock()
+        self.lock = named_lock("_FileStore.lock")
         self._cache: Dict[Tuple[str, str], _ParsedTable] = {}
         # (schema, table, constraints) -> filtered _ParsedTable
         self._filtered_cache: Dict[tuple, _ParsedTable] = {}

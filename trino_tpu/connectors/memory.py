@@ -11,6 +11,7 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import threading
+from trino_tpu.analysis.witness import named_condition, named_lock, named_rlock
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
@@ -63,7 +64,7 @@ class _Store:
 
     def __init__(self):
         self.tables: Dict[Tuple[str, str], _StoredTable] = {}
-        self.lock = threading.Lock()
+        self.lock = named_lock("_Store.lock")
         self._ids = itertools.count()
 
 

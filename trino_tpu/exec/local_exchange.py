@@ -23,6 +23,7 @@ local form), "broadcast" (every consumer sees every batch),
 from __future__ import annotations
 
 import threading
+from trino_tpu.analysis.witness import named_condition, named_lock, named_rlock
 from collections import deque
 from typing import List, Optional
 
@@ -37,7 +38,7 @@ class LocalExchange:
         assert mode in ("arbitrary", "broadcast", "round_robin")
         self.mode = mode
         self._queues: List[deque] = [deque() for _ in range(n_consumers)]
-        self._lock = threading.Lock()
+        self._lock = named_lock("LocalExchange._lock")
         self._not_full = threading.Condition(self._lock)
         self._not_empty = threading.Condition(self._lock)
         self._max = max_buffered_batches

@@ -20,6 +20,7 @@ from __future__ import annotations
 import dataclasses
 import os as _os
 import threading as _threading
+from trino_tpu.analysis.witness import named_condition, named_lock, named_rlock
 from functools import partial
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -433,7 +434,7 @@ class SortOperator(Operator):
         self._in_finish = False
         # cross-thread revocation (see HashAggregationOperator) serializes
         # all buffered-state mutation on this lock
-        self._state_lock = _threading.Lock()
+        self._state_lock = named_lock("SortOperator._state_lock")
         if self._memory is not None:
             self._memory.set_revoker(self._revoke_memory)
 
@@ -1308,6 +1309,10 @@ def _finalize_grouped(acc, aggs: tuple, arg_types: tuple):
     return out
 
 
+# Shared across concurrent query threads; the unlocked check-then-insert
+# let two threads mint distinct jitted callables for the same spec
+# (dispatch-cache churn on every later call). First build wins now.
+_global_fn_lock = named_lock("operators._global_fn_lock")
 _GLOBAL_FN_CACHE: Dict[Tuple[AggSpec, ...], object] = {}
 
 
@@ -1378,7 +1383,8 @@ def _global_update_fn(aggs: Tuple[AggSpec, ...], long_flags: tuple = ()):
                     raise NotImplementedError(a.kind)
             return out
 
-        _GLOBAL_FN_CACHE[(aggs, long_flags)] = update
+        with _global_fn_lock:
+            _GLOBAL_FN_CACHE.setdefault((aggs, long_flags), update)
     return _GLOBAL_FN_CACHE[(aggs, long_flags)]
 
 
@@ -1452,7 +1458,7 @@ class HashAggregationOperator(Operator):
         # calls the victim's callback), so every state mutation and the
         # revoke itself serialize on this lock; accounting calls happen
         # OUTSIDE it to keep lock ordering acyclic across operators
-        self._state_lock = _threading.Lock()
+        self._state_lock = named_lock("HashAggregationOperator._state_lock")
         if self._memory is not None and not self._global and not self._holistic:
             self._memory.set_revoker(self._revoke_memory)
         self._arg_meta = [
@@ -2473,7 +2479,7 @@ class HashBuildSink(Operator):
         self._inputs: List[RelBatch] = []
         self._memory = memory_context
         self._grace = None
-        self._state_lock = _threading.Lock()
+        self._state_lock = named_lock("HashBuildSink._state_lock")
         if force_spill:
             # adaptive spill-mode re-plan (skewed/oversized build): open
             # the grace partitions up front instead of waiting for the

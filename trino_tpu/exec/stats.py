@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+from trino_tpu.analysis.witness import named_condition, named_lock, named_rlock
 import time
 from typing import List, Optional, Set, Tuple
 
@@ -104,7 +105,7 @@ class InstrumentedOperator:
         # round trip on a real accelerator); flush_counts() resolves
         # them at pipeline completion / terminal status
         self._pending_counts: list = []
-        self._pending_lock = threading.Lock()
+        self._pending_lock = named_lock("InstrumentedOperator._pending_lock")
 
     def _beat(self) -> None:
         if self._heartbeat is not None:

@@ -13,6 +13,7 @@ import ctypes
 import os
 import subprocess
 import threading
+from trino_tpu.analysis.witness import named_condition, named_lock, named_rlock
 from typing import List, Optional
 
 import numpy as np
@@ -21,7 +22,7 @@ _DIR = os.path.dirname(__file__)
 _SRC = os.path.join(_DIR, "pagesplit.cpp")
 _LIB = os.path.join(_DIR, "libpagesplit.so")
 
-_lock = threading.Lock()
+_lock = named_lock("native._lock")
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 

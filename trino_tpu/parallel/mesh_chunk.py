@@ -59,6 +59,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as PSpec
 
 from trino_tpu import types as T
+from trino_tpu.analysis.witness import named_lock
 from trino_tpu.block import Column, RelBatch, bucket_capacity
 from trino_tpu.compile.cache import (
     PROGRAM_CACHE,
@@ -81,13 +82,32 @@ from trino_tpu.parallel.mesh_plan import (
 )
 
 # Most recent chunked run, for tests and EXPLAIN surfaces: chunk shape,
-# fragment classification and attempt count. Observability only.
-LAST_RUN_INFO: Dict[str, object] = {}
+# fragment classification and attempt count. Observability only, but
+# written by chunk loops racing chaos/EXPLAIN readers — the two-step
+# clear()+update() must not expose an empty dict mid-publish.
+_run_info_lock = named_lock("mesh_chunk._run_info_lock")
+LAST_RUN_INFO: Dict[str, object] = {}  # guarded_by: _run_info_lock
+
+
+def last_run_info() -> Dict[str, object]:
+    """Snapshot of the most recent chunked run's info dict."""
+    with _run_info_lock:
+        return dict(LAST_RUN_INFO)
+
+
+def publish_run_info(info: Dict[str, object]) -> None:
+    """Atomically replace LAST_RUN_INFO with `info`."""
+    with _run_info_lock:
+        LAST_RUN_INFO.clear()
+        LAST_RUN_INFO.update(info)
+
 
 # WarmupEntry registry for mesh programs (census analogue of the local
 # operator registry): the warmup service can AOT-compile chunk steps by
 # replaying recorded program thunks. Bounded; oldest entries drop.
-MESH_WARMUP_ENTRIES: List[WarmupEntry] = []
+# Written at plan time from concurrent query threads, read by warmup.
+_warmup_entries_lock = named_lock("mesh_chunk._warmup_entries_lock")
+MESH_WARMUP_ENTRIES: List[WarmupEntry] = []  # guarded_by: _warmup_entries_lock
 _MAX_WARMUP_ENTRIES = 128
 
 
@@ -157,13 +177,15 @@ class _Overflow(Exception):
 
 
 def register_mesh_warmup(entries: Sequence[WarmupEntry]) -> None:
-    known = {id(e.fn) for e in MESH_WARMUP_ENTRIES}
-    MESH_WARMUP_ENTRIES.extend(e for e in entries if id(e.fn) not in known)
-    del MESH_WARMUP_ENTRIES[:-_MAX_WARMUP_ENTRIES]
+    with _warmup_entries_lock:
+        known = {id(e.fn) for e in MESH_WARMUP_ENTRIES}
+        MESH_WARMUP_ENTRIES.extend(e for e in entries if id(e.fn) not in known)
+        del MESH_WARMUP_ENTRIES[:-_MAX_WARMUP_ENTRIES]
 
 
 def mesh_warmup_entries() -> List[WarmupEntry]:
-    return list(MESH_WARMUP_ENTRIES)
+    with _warmup_entries_lock:
+        return list(MESH_WARMUP_ENTRIES)
 
 
 # ---------------------------------------------------------------------------
@@ -1242,8 +1264,7 @@ class ChunkedMeshRunner:
                 from trino_tpu.recovery.checkpoint import CHECKPOINTS
 
                 CHECKPOINTS.discard(key)
-            LAST_RUN_INFO.clear()
-            LAST_RUN_INFO.update(self.info)
+            publish_run_info(self.info)
             self._record_divergences(sources, query_span)
             return sources
         finally:

@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as PSpec
 
+from trino_tpu.analysis.witness import named_lock
 from trino_tpu.jaxcfg import get_shard_map
 
 shard_map = get_shard_map()
@@ -98,10 +99,28 @@ REPLICA_AXIS = "replica"
 
 # Trace-time counters, monotonically increasing for the process life
 # (capacity-overflow retraces count again). Tests must assert on
-# before/after deltas, never absolute values.
-MESH_COUNTERS = {"queries": 0, "all_to_all": 0, "all_gather": 0, "fallbacks": 0}
+# before/after deltas, never absolute values.  `+=` on a dict slot is a
+# non-atomic read-modify-write, and these fire from concurrent query
+# threads — all bumps go through bump_mesh_counter.
+_counters_lock = named_lock("mesh_plan._counters_lock")
+MESH_COUNTERS = {"queries": 0, "all_to_all": 0, "all_gather": 0, "fallbacks": 0}  # guarded_by: _counters_lock
 
 _METRICS_REGISTERED = False
+
+
+def bump_mesh_counter(name: str, n: int = 1) -> None:
+    with _counters_lock:
+        MESH_COUNTERS[name] += n
+
+
+def mesh_counter(name: str) -> int:
+    with _counters_lock:
+        return MESH_COUNTERS[name]
+
+
+def mesh_counters_snapshot() -> dict:
+    with _counters_lock:
+        return dict(MESH_COUNTERS)
 
 
 def register_mesh_metrics() -> None:
@@ -113,9 +132,9 @@ def register_mesh_metrics() -> None:
         return
     from trino_tpu.runtime.metrics import METRICS
 
-    for name in MESH_COUNTERS:
+    for name in mesh_counters_snapshot():
         METRICS.register_gauge(
-            f"mesh_{name}", lambda n=name: float(MESH_COUNTERS[n])
+            f"mesh_{name}", lambda n=name: float(mesh_counter(n))
         )
     _METRICS_REGISTERED = True
 
@@ -226,7 +245,7 @@ def _exchange_with_pids(batch: RelBatch, pid, n: int) -> RelBatch:
         arrays.append(c.data)
         arrays.append(c.valid_mask())
     blocks, live_b = _scatter_to_blocks(arrays, batch.live_mask(), pid, n, block)
-    MESH_COUNTERS["all_to_all"] += 1
+    bump_mesh_counter("all_to_all")
     ex = [jax.lax.all_to_all(b, AXIS, 0, 0, tiled=True) for b in blocks]
     live_ex = jax.lax.all_to_all(live_b, AXIS, 0, 0, tiled=True)
     cols = []
@@ -317,7 +336,7 @@ def _salted_local_partition(
 
 def _replicate(batch: RelBatch) -> RelBatch:
     """FIXED_BROADCAST exchange as all_gather (every shard gets all rows)."""
-    MESH_COUNTERS["all_gather"] += 1
+    bump_mesh_counter("all_gather")
 
     def ag(x):
         return jax.lax.all_gather(x, AXIS, tiled=True)
@@ -1022,7 +1041,7 @@ class MeshExecutor:
         # count only after the programs have actually produced results —
         # a failure above falls back to the page exchange, which must not
         # register as a mesh-executed query
-        MESH_COUNTERS["queries"] += 1
+        bump_mesh_counter("queries")
         self.last_run = dict(runner.info)
         return self._run_root(subplan, root_sp, sources)
 

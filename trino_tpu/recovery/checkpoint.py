@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+from trino_tpu.analysis.witness import named_condition, named_lock, named_rlock
 from collections import OrderedDict
 from typing import Dict, Optional, Tuple
 
@@ -99,8 +100,8 @@ class MeshCheckpointStore:
     overflow cap bumps still finds its checkpoint)."""
 
     def __init__(self, max_entries: int = 16):
-        self._lock = threading.Lock()
-        self._entries: "OrderedDict[tuple, MeshCheckpoint]" = OrderedDict()
+        self._lock = named_lock("MeshCheckpointStore._lock")
+        self._entries: "OrderedDict[tuple, MeshCheckpoint]" = OrderedDict()  # guarded_by: _lock
         self._max = max_entries
         self.taken = 0
         self.resumed = 0
@@ -110,10 +111,10 @@ class MeshCheckpointStore:
         # gone, so the entry is the only copy of its progress. Parked
         # keys are pinned (immune to LRU eviction) and their host
         # bytes are accounted against the session park budget.
-        self._parked: Dict[tuple, int] = {}  # key -> accounted bytes
+        self._parked: Dict[tuple, int] = {}  # guarded_by: _lock — key -> accounted bytes
         # resource group a parked entry is accounted to (admission-
         # weighted park budgets: runtime/scheduler.py park_budget_for)
-        self._park_groups: Dict[tuple, str] = {}
+        self._park_groups: Dict[tuple, str] = {}  # guarded_by: _lock
         self.parked_refused = 0
 
     def _generations(self, tables) -> Tuple[int, ...]:

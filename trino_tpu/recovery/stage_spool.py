@@ -32,6 +32,7 @@ and generation guarding makes entries from before a DML unreachable.
 from __future__ import annotations
 
 import threading
+from trino_tpu.analysis.witness import named_condition, named_lock, named_rlock
 from typing import Dict, List, Optional, Tuple
 
 from trino_tpu.adaptive.spool import (
@@ -156,7 +157,7 @@ class StageOutputRecorder:
     spool on retry and purges the query's recordings at finalize."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = named_lock("StageOutputRecorder._lock")
         self._recs: Dict[Tuple[str, int], _FragmentRecording] = {}
 
     def expect(self, query_id: str, fragment_id: int, n_tasks: int) -> None:

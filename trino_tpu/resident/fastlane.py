@@ -26,12 +26,13 @@ folds it into the base at a ladder rung.
 from __future__ import annotations
 
 import threading
+from trino_tpu.analysis.witness import named_condition, named_lock, named_rlock
 from typing import List, Optional, Tuple
 
 from trino_tpu.resident.manager import GENERATIONS, RESIDENT, table_key
 from trino_tpu.resident.table import ResidentTable
 
-_lock = threading.Lock()
+_lock = named_lock("fastlane._lock")
 _compaction_pool = None
 _pending_compactions: List = []
 
@@ -316,9 +317,16 @@ def _schedule_compaction(key: Tuple, table: ResidentTable) -> None:
         if _compaction_pool is None:
             from concurrent.futures import ThreadPoolExecutor
 
+            from trino_tpu.analysis.threadreg import THREADS
+
+            # Executor workers are non-daemon on 3.9+; the pool is a
+            # process-lifetime singleton, so sanction its one worker
+            # with the registry rather than tearing it down per-query.
             _compaction_pool = ThreadPoolExecutor(
                 max_workers=1,
                 thread_name_prefix="trino-tpu-resident-compact",
+                initializer=lambda: THREADS.adopt_current(
+                    owner="ResidentManager", long_lived=True),
             )
         fut = _compaction_pool.submit(_compact_one, key, table)
         _pending_compactions[:] = [

@@ -25,6 +25,7 @@ resident_pinned_bytes gauge.
 from __future__ import annotations
 
 import threading
+from trino_tpu.analysis.witness import named_condition, named_lock, named_rlock
 from collections import OrderedDict
 from typing import Dict, FrozenSet, List, Optional, Tuple
 
@@ -40,7 +41,7 @@ class TableGenerations:
     events (COMMIT, catalog registration) that cannot name a table."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = named_lock("TableGenerations._lock")
         self._gens: Dict[Tuple[str, str, str], int] = {}
         self._epoch = 0
 
@@ -83,7 +84,7 @@ class ResidentStateManager:
     recomputing build-time key components (dtype sig, capacity rung)."""
 
     def __init__(self, budget_bytes: int = 64 << 20):
-        self._lock = threading.RLock()
+        self._lock = named_rlock("ResidentStateManager._lock")
         self.budget_bytes = int(budget_bytes)
         self._entries: "OrderedDict[Tuple, _Entry]" = OrderedDict()
         self._index: Dict[Tuple, Tuple] = {}

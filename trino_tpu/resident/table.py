@@ -24,6 +24,7 @@ PROGRAM_CACHE keyed by their capacity pair, shared across tables.
 from __future__ import annotations
 
 import threading
+from trino_tpu.analysis.witness import named_condition, named_lock, named_rlock
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -44,7 +45,7 @@ PROBE_OUT_CAP = 16
 # idiom): bounded, observable, consumable by any WarmupService.
 RESIDENT_WARMUP_ENTRIES: List[WarmupEntry] = []
 _MAX_WARMUP_ENTRIES = 64
-_warm_lock = threading.Lock()
+_warm_lock = named_lock("table._warm_lock")
 
 
 def register_resident_warmup(entries: Sequence[WarmupEntry]) -> None:
@@ -195,7 +196,7 @@ class ResidentTable:
         self.delta_rows: List[list] = []
         self._delta_keys = None
         self._delta_valid = None
-        self._lock = threading.RLock()
+        self._lock = named_rlock("ResidentTable._lock")
         register_resident_warmup(
             [_probe_entry(self.base_cap), _probe_entry(self.delta_cap)]
         )

@@ -12,6 +12,7 @@ once buffered bytes exceed the limit until consumers drain
 from __future__ import annotations
 
 import threading
+from trino_tpu.analysis.witness import named_condition, named_lock, named_rlock
 from typing import List, Optional, Tuple
 
 from trino_tpu.exec.serde import Page
@@ -23,7 +24,7 @@ class OutputBuffer:
     def __init__(self, n_partitions: int, max_bytes: int = 128 << 20):
         self._n = n_partitions
         self._max_bytes = max_bytes
-        self._lock = threading.Condition()
+        self._lock = named_condition("OutputBuffer._lock")
         # per partition: pages kept from first_token onward
         self._pages: List[List[Page]] = [[] for _ in range(n_partitions)]
         self._first_token: List[int] = [0] * n_partitions

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import random
 import threading
+from trino_tpu.analysis import threadreg
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -242,7 +243,7 @@ def run_mesh_recovery_case(
         rows = runner.execute(sql).rows
     finally:
         mesh_chunk.MESH_FAULT_HOOK = None
-    info = dict(mesh_chunk.LAST_RUN_INFO)
+    info = mesh_chunk.last_run_info()
     report = {
         "mesh_clean_plane": mesh_clean,
         "mesh_fault_plane": runner._last_data_plane,
@@ -313,7 +314,7 @@ def run_preempt_park_resume_case(
             def run_point():
                 state["point_rows"] = runner.execute(point).rows
 
-            threading.Thread(target=run_point, daemon=True).start()
+            threadreg.spawn("chaos-point-query", run_point, owner="chaos")
             # hold this boundary until the fast seat is queued, so the
             # NEXT boundary deterministically parks
             deadline = time.monotonic() + 10.0
@@ -342,7 +343,7 @@ def run_preempt_park_resume_case(
     deadline = time.monotonic() + 10.0
     while state["point_rows"] is None and time.monotonic() < deadline:
         time.sleep(0.002)
-    info = dict(mesh_chunk.LAST_RUN_INFO)
+    info = mesh_chunk.last_run_info()
     report = {
         "mesh_clean_plane": mesh_clean,
         "mesh_fault_plane": runner._last_data_plane,
@@ -432,9 +433,10 @@ def run_preempt_under_drain_case(
             state["target"] = rng.randrange(max(K - 2, 1))
         if k == state["target"] and state["victim"] is None:
             state["victim"] = rep
-            threading.Thread(
-                target=drain_when_parked, args=(rep,), daemon=True,
-            ).start()
+            threadreg.spawn(
+                "chaos-drain-when-parked", drain_when_parked, args=(rep,),
+                owner="chaos",
+            )
             # hold this boundary until the fast seat is queued: the
             # next boundary parks, and the side thread drains the
             # victim while the query sits parked
@@ -456,7 +458,7 @@ def run_preempt_under_drain_case(
         mesh_chunk.MESH_FAULT_HOOK = None
         if state["fake"] is not None and state["victim"] is not None:
             rm.replicas[state["victim"]].scheduler.finish(state["fake"])
-    info = dict(mesh_chunk.LAST_RUN_INFO)
+    info = mesh_chunk.last_run_info()
     quiesced = bool(
         state["drained"]
         and state["victim"] is not None
@@ -570,7 +572,7 @@ def run_host_lost_case(
         finally:
             mesh_chunk.MESH_FAULT_HOOK = None
         after = METRICS.snapshot()
-        info = dict(mesh_chunk.LAST_RUN_INFO)
+        info = mesh_chunk.last_run_info()
         report = {
             "mesh_clean_plane": mesh_clean,
             "mesh_fault_plane": runner._last_data_plane,
@@ -642,7 +644,7 @@ def run_membership_flap_case(
         rows = runner.execute(sql).rows
     finally:
         mesh_chunk.MESH_FAULT_HOOK = None
-    info = dict(mesh_chunk.LAST_RUN_INFO)
+    info = mesh_chunk.last_run_info()
     report = {
         "mesh_clean_plane": mesh_clean,
         "mesh_fault_plane": runner._last_data_plane,
@@ -748,7 +750,7 @@ def run_transport_corruption_case(
         finally:
             mesh_chunk.MESH_FAULT_HOOK = None
         after = METRICS.snapshot()
-        info = dict(mesh_chunk.LAST_RUN_INFO)
+        info = mesh_chunk.last_run_info()
         report = {
             "mesh_clean_plane": mesh_clean,
             "mesh_fault_plane": runner._last_data_plane,
@@ -1023,8 +1025,7 @@ class ChaosHarness:
             except Exception as e:
                 result["error"] = e
 
-        t = threading.Thread(target=run, daemon=True)
-        t.start()
+        t = threadreg.spawn("chaos-query-driver", run, owner="chaos")
         # drain a node that ACTUALLY hosts work: wait for launches
         deadline = time.monotonic() + 10.0
         busy: List[DownableWorker] = []
@@ -1300,7 +1301,8 @@ class ChaosHarness:
                         )
 
         threads = [
-            threading.Thread(target=client_loop, args=(i,), daemon=True)
+            threadreg.spawn(f"chaos-client-{i}", client_loop, args=(i,),
+                            owner="chaos", start=False)
             for i in range(n_clients)
         ]
         try:
@@ -2056,6 +2058,21 @@ def chaos_smoke(
             if scenario == "preempt_park_resume"
             else run_preempt_under_drain_case
         )
+        # park_resume doubles as the lock-witness gate: the scheduler's
+        # condition wait, the checkpoint store, and the fast-lane seat
+        # all interleave here, so run it with order checking live and
+        # require zero recorded violations.
+        witness_case = scenario == "preempt_park_resume"
+        if witness_case:
+            from trino_tpu.analysis.witness import (
+                enable_witness,
+                violation_count,
+                witness_enabled,
+            )
+
+            was_enabled = witness_enabled()
+            violations_before = violation_count()
+            enable_witness(True)
         try:
             rows, rep = case(preempt_sql, seed)
         except Exception as e:
@@ -2063,6 +2080,15 @@ def chaos_smoke(
                 f"preempt/{scenario}: raised {type(e).__name__}: {e}"
             )
             continue
+        finally:
+            if witness_case:
+                enable_witness(was_enabled)
+        if witness_case and violation_count() != violations_before:
+            failures.append(
+                f"preempt/{scenario}: "
+                f"{violation_count() - violations_before} lock-witness "
+                f"violation(s) recorded during the park/resume run"
+            )
         if not rep["mesh_clean_plane"]:
             failures.append(
                 f"preempt/{scenario}: clean run did not take the mesh "

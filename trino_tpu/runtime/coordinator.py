@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+from trino_tpu.analysis.witness import named_condition, named_lock, named_rlock
 import time
 from typing import Dict, List, Optional
 
@@ -413,6 +414,11 @@ class DistributedQueryRunner:
         from trino_tpu.parallel.mesh_plan import register_mesh_metrics
 
         register_mesh_metrics()
+        # concurrency soundness plane gauges (analysis.locks /
+        # analysis.threads_live / analysis.witness_violations)
+        from trino_tpu.analysis import register_analysis_metrics
+
+        register_analysis_metrics()
         # serving tier: canonical-text plan cache over the distributed
         # planning pipeline (analyze -> optimize -> fragment). DDL/DML
         # through the embedded runner and catalog registration
@@ -433,7 +439,7 @@ class DistributedQueryRunner:
         # collectives on one device set deadlock their rendezvous).
         # With a replica plane, the per-replica exec_lock takes over —
         # replicas are the units of mesh concurrency.
-        self._mesh_exec_lock = threading.Lock()
+        self._mesh_exec_lock = named_lock("DistributedQueryRunner._mesh_exec_lock")
         # preemptive multi-tenancy (runtime/scheduler.py): the single
         # full-width mesh's chunk-granular run queue, built lazily on
         # first scheduled dispatch (replica planes carry one scheduler
@@ -448,7 +454,7 @@ class DistributedQueryRunner:
         self._completed_queries_cap = 200
         self.last_query_id: Optional[str] = None
         self._active_traces: Dict[str, tuple] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("DistributedQueryRunner._lock")
 
     def _fail_query_on_workers(self, query_id: str, message: str) -> None:
         for w in self.workers:
@@ -1404,10 +1410,10 @@ class DistributedQueryRunner:
         span so the trace timeline shows where the plane switched."""
         import re
 
-        from trino_tpu.parallel.mesh_plan import MESH_COUNTERS
+        from trino_tpu.parallel.mesh_plan import bump_mesh_counter
         from trino_tpu.runtime.metrics import METRICS
 
-        MESH_COUNTERS["fallbacks"] += 1
+        bump_mesh_counter("fallbacks")
         self.last_mesh_fallback = reason
         slug = re.sub(r"[^a-z0-9]+", "_", reason.lower()).strip("_")[:40]
         if slug:
@@ -1462,7 +1468,7 @@ class DistributedQueryRunner:
         """The EXPLAIN ANALYZE recovery-tier line: lifetime
         checkpoint/resume counters from the process singletons, plus
         the most recent mesh run's resume position when it resumed."""
-        from trino_tpu.parallel.mesh_chunk import LAST_RUN_INFO
+        from trino_tpu.parallel.mesh_chunk import last_run_info
         from trino_tpu.recovery import CHECKPOINTS
         from trino_tpu.runtime.metrics import METRICS
 
@@ -1473,11 +1479,12 @@ class DistributedQueryRunner:
             f"spooled_stage_hits="
             f"{int(METRICS.counter('recovery.spooled_stage_hits'))}"
         )
-        resumed = LAST_RUN_INFO.get("resumed_from_chunk")
+        info = last_run_info()
+        resumed = info.get("resumed_from_chunk")
         if resumed is not None:
             line += (
                 f" resumed_from_chunk={resumed}/"
-                f"{LAST_RUN_INFO.get('chunks')}"
+                f"{info.get('chunks')}"
             )
         return line
 
@@ -1544,6 +1551,23 @@ class DistributedQueryRunner:
             return "membership= epoch=0 (single mesh)"
         return rm.membership_line()
 
+    def _concurrency_line(self) -> str:
+        """The EXPLAIN ANALYZE concurrency line: live counts from the
+        soundness plane (trino_tpu/analysis/) — registered witness
+        locks, observed order edges, registered background threads, and
+        lifetime witness violations (0 on a sound engine)."""
+        from trino_tpu.analysis import concurrency_summary
+
+        s = concurrency_summary()
+        return (
+            f"concurrency= locks={s['locks']} "
+            f"order_edges={s['order_edges']} "
+            f"threads_live={s['threads_live']} "
+            f"threads_spawned={s['threads_spawned']} "
+            f"witness={'on' if s['witness'] else 'off'} "
+            f"violations={s['witness_violations']}"
+        )
+
     def _explain_text(self, subplan) -> str:
         """Fragment rendering with per-fragment compile-churn census
         annotations (expected_xla_lowerings — sql/validate.py)."""
@@ -1595,6 +1619,7 @@ class DistributedQueryRunner:
             lines.append(self._replica_line())
             lines.append(self._scheduler_line())
             lines.append(self._membership_line())
+            lines.append(self._concurrency_line())
             return MaterializedResult(
                 [["\n".join(lines)]], ["Query Plan"], [T.VARCHAR]
             )

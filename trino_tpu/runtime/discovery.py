@@ -30,6 +30,8 @@ Spooled output on a draining node stays readable throughout.
 from __future__ import annotations
 
 import threading
+from trino_tpu.analysis import threadreg
+from trino_tpu.analysis.witness import named_condition, named_lock, named_rlock
 import time
 from typing import Callable, Dict, List, Optional
 
@@ -118,7 +120,7 @@ class NodeManager:
                  breaker_threshold: int = 3,
                  breaker_cooldown_s: float = 1.0):
         self._nodes: Dict[str, NodeState] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("NodeManager._lock")
         self._interval = ping_interval
         self._breaker_threshold = breaker_threshold
         self._breaker_cooldown_s = breaker_cooldown_s
@@ -222,8 +224,9 @@ class NodeManager:
     # -- heartbeat loop (HeartbeatFailureDetector.ping:350) --
     def start(self) -> None:
         if self._thread is None:
-            self._thread = threading.Thread(target=self._loop, daemon=True)
-            self._thread.start()
+            self._thread = threadreg.spawn(
+                "heartbeat-detector", self._loop, owner="HeartbeatFailureDetector"
+            )
 
     def stop(self) -> None:
         self._stop.set()

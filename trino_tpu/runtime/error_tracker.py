@@ -20,6 +20,7 @@ from __future__ import annotations
 import dataclasses
 import random
 import threading
+from trino_tpu.analysis.witness import named_condition, named_lock, named_rlock
 import time
 import urllib.error
 from typing import Callable, List, Optional
@@ -160,7 +161,7 @@ class DestinationErrorStats:
     bounded attempt counts)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = named_lock("DestinationErrorStats._lock")
         self._errors: dict = {}
         self._requests: dict = {}
 

@@ -10,6 +10,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import threading
+from trino_tpu.analysis.witness import named_condition, named_lock, named_rlock
 import time
 from typing import Any, Dict, List, Optional
 
@@ -76,7 +77,7 @@ class JsonlEventListener(EventListener):
 
     def __init__(self, path: str):
         self.path = path
-        self._lock = threading.Lock()
+        self._lock = named_lock("JsonlEventListener._lock")
 
     def query_completed(self, event: QueryCompletedEvent) -> None:
         record: Dict[str, Any] = {"event": "query_completed",

@@ -11,6 +11,8 @@ queue is over budget (scheduleRequestIfNecessary's memory gate).
 from __future__ import annotations
 
 import threading
+from trino_tpu.analysis import threadreg
+from trino_tpu.analysis.witness import named_condition, named_lock, named_rlock
 import time
 from typing import Callable, List, Optional
 
@@ -56,7 +58,7 @@ class DirectExchangeClient:
         self._retry_seed = retry_seed
         self._failure_listener = failure_listener
         self._queue: List[Page] = []
-        self._lock = threading.Condition()
+        self._lock = named_condition("DirectExchangeClient._lock")
         self._open = 0
         self._max_buffered = max_buffered_pages
         self._long_poll_s = long_poll_s
@@ -64,7 +66,10 @@ class DirectExchangeClient:
         self._closed = False
         self._threads: List[threading.Thread] = []
         for loc in self._locations:
-            t = threading.Thread(target=self._pull_loop, args=(loc,), daemon=True)
+            t = threadreg.spawn(
+                f"exchange-pull-{loc.destination}", self._pull_loop, args=(loc,),
+                owner="DirectExchangeClient", start=False,
+            )
             self._open += 1
             self._threads.append(t)
         for t in self._threads:

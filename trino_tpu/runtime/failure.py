@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+from trino_tpu.analysis.witness import named_condition, named_lock, named_rlock
 from typing import Dict, List, Optional, Tuple
 
 
@@ -61,7 +62,7 @@ class FailureInjector:
     def __init__(self):
         self._rules: List[FailureRule] = []
         self._hits: Dict[int, int] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("FailureInjector._lock")
 
     def inject(self, **kw) -> FailureRule:
         rule = FailureRule(**kw)

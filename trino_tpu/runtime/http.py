@@ -39,6 +39,7 @@ from __future__ import annotations
 import json
 import struct
 import threading
+from trino_tpu.analysis import threadreg
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -271,10 +272,10 @@ class WorkerServer:
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.port = self._httpd.server_port
         self.uri = f"http://127.0.0.1:{self.port}"
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True
+        self._thread = threadreg.spawn(
+            f"worker-http-{self.port}", self._httpd.serve_forever,
+            owner="WorkerServer",
         )
-        self._thread.start()
 
     @property
     def state(self) -> str:
@@ -537,10 +538,10 @@ class FabricServer:
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.port = self._httpd.server_port
         self.uri = f"http://127.0.0.1:{self.port}"
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True
+        self._thread = threadreg.spawn(
+            f"fabric-http-{self.port}", self._httpd.serve_forever,
+            owner="FabricServer",
         )
-        self._thread.start()
 
     def stop(self) -> None:
         self._httpd.shutdown()

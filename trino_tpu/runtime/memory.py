@@ -23,6 +23,7 @@ ExceededMemoryLimitError instead of the worker failing everyone."""
 from __future__ import annotations
 
 import threading
+from trino_tpu.analysis.witness import named_condition, named_lock, named_rlock
 import time
 from typing import Callable, Dict, List, Optional
 
@@ -39,7 +40,7 @@ class MemoryPool:
     def __init__(self, max_bytes: int):
         self.max_bytes = max_bytes
         self._reserved = 0
-        self._lock = threading.Lock()
+        self._lock = named_lock("MemoryPool._lock")
         # context id -> (revocable bytes, revoke callback)
         self._revocable: Dict[int, tuple] = {}
         self._next_id = 0
@@ -268,7 +269,7 @@ class ClusterMemoryManager:
         self.killer = killer or LowMemoryKiller()
         self.wait_s = wait_s
         self.poll_s = poll_s
-        self._lock = threading.Lock()
+        self._lock = named_lock("ClusterMemoryManager._lock")
         self.kills: List[str] = []  # observability / chaos assertions
 
     def install(self) -> None:

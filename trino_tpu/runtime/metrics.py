@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import math
 import threading
+from trino_tpu.analysis.witness import named_condition, named_lock, named_rlock
 from typing import Callable, Dict, List, Optional
 
 
@@ -85,7 +86,7 @@ class MetricsRegistry:
         self._counters: Dict[str, float] = {}
         self._gauges: Dict[str, Callable[[], float]] = {}
         self._distributions: Dict[str, Distribution] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("MetricsRegistry._lock")
 
     def increment(self, name: str, delta: float = 1.0) -> None:
         with self._lock:

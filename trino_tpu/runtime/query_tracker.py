@@ -28,6 +28,8 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+from trino_tpu.analysis import threadreg
+from trino_tpu.analysis.witness import named_condition, named_lock, named_rlock
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -229,7 +231,7 @@ class QueryTracker:
         self._clock = clock
         self.tick_interval_s = tick_interval_s
         self._queries: Dict[str, TrackedQuery] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("QueryTracker._lock")
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         # observability: (query_id, code, message) per enforcement kill
@@ -381,10 +383,9 @@ class QueryTracker:
             while not self._stop.wait(self.tick_interval_s):
                 self.tick()
 
-        self._thread = threading.Thread(
-            target=loop, name="query-tracker", daemon=True
+        self._thread = threadreg.spawn(
+            "query-tracker", loop, owner="QueryTracker"
         )
-        self._thread.start()
 
     def stop(self) -> None:
         self._stop.set()

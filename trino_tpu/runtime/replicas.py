@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import os
 import threading
+from trino_tpu.analysis.witness import named_condition, named_lock, named_rlock
 import time
 from typing import Dict, List, Optional, Sequence
 
@@ -104,7 +105,7 @@ class Replica:
         # participants the other program occupies). Mesh runs serialize
         # on this lock per replica — REPLICAS are the serving tier's
         # units of mesh concurrency, not threads on one mesh.
-        self.exec_lock = threading.Lock()
+        self.exec_lock = named_lock("Replica.exec_lock")
         # the replica's run queue (runtime/scheduler.py): the same
         # single-program guarantee as exec_lock, but chunk-granular —
         # the holder's chunk loop consults the scheduler at every
@@ -162,8 +163,8 @@ class ReplicaManager:
         )
         self.n_replicas = n_replicas
         self.partition_width = per
-        self._lock = threading.Lock()
-        self._rr = 0  # round-robin tiebreak cursor
+        self._lock = named_lock("ReplicaManager._lock")
+        self._rr = 0  # guarded_by: _lock — round-robin tiebreak cursor
         self.placements = 0
         self.failovers = 0
         self.drains = 0
@@ -179,7 +180,7 @@ class ReplicaManager:
         # exactly-one-owner ledger: query_id -> (replica_id, epoch) of
         # the single replica allowed to run it right now — a flapped
         # host must never end up racing the sibling that took over
-        self._owners: Dict[str, tuple] = {}
+        self._owners: Dict[str, tuple] = {}  # guarded_by: _lock
         self.replicas = [
             Replica(
                 r, list(self.grid[r]),
@@ -307,8 +308,14 @@ class ReplicaManager:
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
             if rep.inflight == 0:
-                rep.state = "drained"
-                return True
+                # state transitions happen under _lock everywhere else
+                # (request_drain, undrain, leave); an unlocked write here
+                # could race an undrain() and resurrect a dead replica.
+                with self._lock:
+                    if rep.inflight == 0:
+                        rep.state = "drained"
+                        return True
+                continue
             time.sleep(poll_s)
         return rep.inflight == 0
 

@@ -15,6 +15,7 @@ from __future__ import annotations
 import dataclasses
 import re
 import threading
+from trino_tpu.analysis.witness import named_condition, named_lock, named_rlock
 from typing import Dict, List, Optional, Tuple
 
 
@@ -96,7 +97,7 @@ class ResourceGroupManager:
     def __init__(self, root: ResourceGroupSpec, selectors: List[Selector] = ()):
         self._root = _Group(root, None)
         self._selectors = list(selectors)
-        self._lock = threading.Condition()
+        self._lock = named_condition("ResourceGroupManager._lock")
         self._next_seq = 0
         self._gpass = 0.0
 

@@ -52,6 +52,7 @@ the full-width mesh; each Replica owns one as its run queue.
 from __future__ import annotations
 
 import threading
+from trino_tpu.analysis.witness import named_condition, named_lock, named_rlock
 import time
 from typing import Dict, List, Optional
 
@@ -153,15 +154,15 @@ class MeshScheduler:
         self.min_slice_chunks = max(1, int(min_slice_chunks))
         self.preemption_enabled = bool(preemption_enabled)
         self.weights = dict(weights or {})
-        self._lock = threading.Lock()
+        self._lock = named_lock("MeshScheduler._lock")
         self._cond = threading.Condition(self._lock)
-        self._holder: Optional[MeshJob] = None
-        self._waiting: List[MeshJob] = []
-        self._seq = 0
+        self._holder: Optional[MeshJob] = None  # guarded_by: _lock
+        self._waiting: List[MeshJob] = []  # guarded_by: _lock
+        self._seq = 0  # guarded_by: _lock
         # per-group virtual time (stride scheduling: vtime grows by
         # chunk_wall / weight; the group with the smallest account runs)
-        self._vtime: Dict[str, float] = {}
-        self._gpass = 0.0  # high-water pass idle groups rejoin at
+        self._vtime: Dict[str, float] = {}  # guarded_by: _lock
+        self._gpass = 0.0  # guarded_by: _lock — high-water pass idle groups rejoin at
         # instance counters (EXPLAIN line) — mirrored to METRICS
         self.parks = 0
         self.resumes = 0

@@ -18,6 +18,8 @@ from __future__ import annotations
 
 import json
 import threading
+from trino_tpu.analysis import threadreg
+from trino_tpu.analysis.witness import named_condition, named_lock, named_rlock
 import time
 import uuid
 from concurrent.futures import ThreadPoolExecutor
@@ -84,7 +86,7 @@ class _QueryJob:
         self.abandoned = False
         self.created_at = time.monotonic()  # admission-queue wait base
         self.last_heartbeat = time.monotonic()  # any client poll refreshes
-        self.lock = threading.Lock()
+        self.lock = named_lock("_QueryJob.lock")
 
     def snapshot(self, token: int):
         with self.lock:
@@ -337,10 +339,9 @@ class CoordinatorServer:
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
         self.port = self._httpd.server_port
         self.uri = f"http://127.0.0.1:{self.port}"
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True
+        self._thread = threadreg.spawn(
+            "statement-server", self._httpd.serve_forever, owner="StatementServer"
         )
-        self._thread.start()
         # abandonment reaper: _evict_completed used to run only on
         # submit, so an idle server never noticed a vanished client —
         # the RUNNING query it left behind kept its resource-group slot
@@ -361,10 +362,9 @@ class CoordinatorServer:
                 except Exception:
                     pass  # a reaper crash must not take the server down
 
-        self._reaper = threading.Thread(
-            target=_reap_loop, name="client-reaper", daemon=True
+        self._reaper = threadreg.spawn(
+            "client-reaper", _reap_loop, owner="StatementServer"
         )
-        self._reaper.start()
 
     def cluster_stats(self) -> dict:
         """ClusterStatsResource analogue."""

@@ -17,6 +17,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+from trino_tpu.analysis.witness import named_condition, named_lock, named_rlock
 from typing import Dict, List, Optional, Tuple
 
 from trino_tpu.exec.serde import Page, deserialize_page, serialize_page
@@ -37,7 +38,7 @@ class SpoolingExchangeSink:
         self._seq = [0] * n_partitions
         self._committed = False
         self._aborted = False
-        self._lock = threading.Condition()
+        self._lock = named_condition("SpoolingExchangeSink._lock")
 
     @property
     def n_partitions(self) -> int:

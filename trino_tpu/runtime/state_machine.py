@@ -15,6 +15,7 @@ transitions through it.
 from __future__ import annotations
 
 import threading
+from trino_tpu.analysis.witness import named_condition, named_lock, named_rlock
 import time
 from typing import Callable, List, Optional, Sequence
 
@@ -30,12 +31,12 @@ class StateMachine:
         self._state = initial
         self._terminal = frozenset(terminal_states)
         self._listeners: List[Callable[[str], None]] = []
-        self._lock = threading.Lock()
+        self._lock = named_lock("StateMachine._lock")
         self._changed = threading.Condition(self._lock)
         # serializes listener delivery so states arrive in transition
         # order; reentrant because a listener may transition the machine
         # from inside its callback
-        self._dispatch = threading.RLock()
+        self._dispatch = named_rlock("StateMachine._dispatch")
 
     def get(self) -> str:
         with self._lock:

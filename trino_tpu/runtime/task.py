@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+from trino_tpu.analysis import threadreg
 import traceback
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -235,10 +236,9 @@ class TaskExecution:
     # -- lifecycle --
     def start(self) -> None:
         self.state = "running"
-        self._thread = threading.Thread(
-            target=self._run, name=str(self.spec.task_id), daemon=True
+        self._thread = threadreg.spawn(
+            str(self.spec.task_id), self._run, owner="TaskExecution"
         )
-        self._thread.start()
 
     def join(self, timeout: Optional[float] = None) -> None:
         if self._thread is not None:
@@ -625,8 +625,8 @@ class TaskExecution:
                     # flight (the killed-query-returns-empty race)
                     ex.producer_failed(e)
 
-            t = threading.Thread(target=run_producer, daemon=True)
-            t.start()
+            t = threadreg.spawn("pipeline-producer", run_producer,
+                                owner="TaskExecution")
             try:
                 drive(consumer)
             except BaseException:

@@ -30,6 +30,7 @@ off stages (one per attempt); ``operator`` spans hang off tasks.
 from __future__ import annotations
 
 import threading
+from trino_tpu.analysis.witness import named_condition, named_lock, named_rlock
 import time
 import uuid
 from typing import Any, Dict, List, Optional, Union
@@ -141,7 +142,7 @@ class QueryTrace:
     def __init__(self, query_id: str, trace_id: Optional[str] = None):
         self.query_id = query_id
         self.trace_id = trace_id or _new_id()
-        self._lock = threading.Lock()
+        self._lock = named_lock("QueryTrace._lock")
         self._spans: List[Span] = []
         self._grafted: List[dict] = []
 

@@ -11,6 +11,8 @@ wraps these methods behind /v1/task endpoints).
 from __future__ import annotations
 
 import threading
+from trino_tpu.analysis import threadreg
+from trino_tpu.analysis.witness import named_condition, named_lock, named_rlock
 from typing import Dict, List, Optional, Tuple
 
 from trino_tpu.connectors.spi import CatalogManager
@@ -51,7 +53,7 @@ class Worker:
 
             self.memory_pool = MemoryPool(memory_pool_bytes)
         self._tasks: Dict[str, TaskExecution] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("Worker._lock")
         # stuck-task watchdog (StuckSplitTasksInterrupter analogue):
         # interrupt any RUNNING task whose per-batch heartbeat is older
         # than this; the failure is RETRYABLE (unlike deadline kills)
@@ -129,10 +131,9 @@ class Worker:
             while not self._watchdog_stop.wait(poll_s):
                 self.watchdog_once()
 
-        self._watchdog_thread = threading.Thread(
-            target=loop, name=f"watchdog-{self.worker_id}", daemon=True
+        self._watchdog_thread = threadreg.spawn(
+            f"watchdog-{self.worker_id}", loop, owner="Worker"
         )
-        self._watchdog_thread.start()
 
     def stop_watchdog(self) -> None:
         if self._watchdog_thread is None:

@@ -10,6 +10,8 @@ from __future__ import annotations
 
 import json
 import threading
+from trino_tpu.analysis import threadreg
+from trino_tpu.analysis.witness import named_condition, named_lock, named_rlock
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -22,7 +24,7 @@ class ProxyServer:
         assert self.backends, "proxy needs at least one backend"
         self._rr = 0
         self._owner: Dict[str, str] = {}  # query id -> backend uri
-        self._lock = threading.Lock()
+        self._lock = named_lock("ProxyServer._lock")
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -111,10 +113,9 @@ class ProxyServer:
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
         self.port = self._httpd.server_port
         self.uri = f"http://127.0.0.1:{self.port}"
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True
+        self._thread = threadreg.spawn(
+            "proxy-server", self._httpd.serve_forever, owner="ProxyServer"
         )
-        self._thread.start()
 
     _MAX_TRACKED = 10_000
 

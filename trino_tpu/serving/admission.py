@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+from trino_tpu.analysis.witness import named_condition, named_lock, named_rlock
 from typing import Any, Optional
 
 LANES = ("fast", "general")
@@ -76,7 +77,7 @@ class AdmissionPipeline:
         self._depth = {lane: 0 for lane in LANES}
         self.sheds = {lane: 0 for lane in LANES}
         self.admitted = {lane: 0 for lane in LANES}
-        self._lock = threading.Lock()
+        self._lock = named_lock("AdmissionPipeline._lock")
         # replica plane supplier (runtime/replicas.ReplicaManager or
         # None): admitted lanes drain onto whichever healthy sub-mesh
         # the coordinator places them on; stats() surfaces that balance

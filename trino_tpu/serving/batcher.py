@@ -30,6 +30,7 @@ execute path.
 from __future__ import annotations
 
 import threading
+from trino_tpu.analysis.witness import named_condition, named_lock, named_rlock
 from typing import Dict, List, Optional, Tuple
 
 # follower safety net: if the leader thread dies without settling the
@@ -161,7 +162,7 @@ class MicroBatcher:
         self.runner = runner
         self.window_s = window_s
         self.max_batch = max(1, int(max_batch))
-        self._lock = threading.Lock()
+        self._lock = named_lock("MicroBatcher._lock")
         self._groups: Dict[Tuple, _Group] = {}
         self.batches = 0
         self.batched_queries = 0

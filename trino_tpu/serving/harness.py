@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import random
 import threading
+from trino_tpu.analysis import threadreg
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -212,8 +213,9 @@ def run_serve_load(
                     errors.append(f"{name}: {e!r}")
 
     threads = [
-        threading.Thread(target=client_loop, daemon=True)
-        for _ in range(n_clients)
+        threadreg.spawn(f"serving-client-{i}", client_loop,
+                        owner="serving-harness", start=False)
+        for i in range(n_clients)
     ]
     for t in threads:
         t.start()
@@ -293,7 +295,8 @@ def run_serve_load(
                         b_errors.append(f"{name}: {e!r}")
 
         bts = [
-            threading.Thread(target=burst_loop, args=(i,), daemon=True)
+            threadreg.spawn(f"serving-burst-{i}", burst_loop, args=(i,),
+                            owner="serving-harness", start=False)
             for i in range(n_clients)
         ]
         for t in bts:

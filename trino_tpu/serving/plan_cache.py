@@ -30,6 +30,7 @@ plan_cache.{hits,misses,evictions,invalidations}.
 from __future__ import annotations
 
 import threading
+from trino_tpu.analysis.witness import named_condition, named_lock, named_rlock
 from collections import OrderedDict
 from typing import Any, Optional, Tuple
 
@@ -96,7 +97,7 @@ class PlanCache:
     def __init__(self, max_entries: int = 256, metrics_prefix: str = "plan_cache"):
         self.max_entries = max(1, int(max_entries))
         self._prefix = metrics_prefix
-        self._lock = threading.Lock()
+        self._lock = named_lock("PlanCache._lock")
         self._entries: "OrderedDict[Tuple, Any]" = OrderedDict()
         self._tables: dict = {}  # key -> frozenset of source tables
         self.hits = 0

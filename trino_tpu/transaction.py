@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+from trino_tpu.analysis.witness import named_condition, named_lock, named_rlock
 import uuid
 from typing import Dict
 
@@ -53,7 +54,7 @@ class TransactionManager:
     def __init__(self, catalogs):
         self.catalogs = catalogs
         self._transactions: Dict[str, TransactionInfo] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("TransactionManager._lock")
 
     def begin(self, read_only: bool = False) -> str:
         tx = TransactionInfo(uuid.uuid4().hex[:16], read_only)
